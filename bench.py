@@ -1,0 +1,90 @@
+"""Benchmark: time-to-validated-accelerator, plus MXU/HBM roofline probes.
+
+The reference publishes no benchmark numbers (BASELINE.md). Its only
+quantitative operational claim is that the GPU Operator needs **~5 minutes**
+after ``terraform apply`` before the accelerator stack is usable, and even then
+validation is a human running ``kubectl get pods``
+(``/root/reference/gke/README.md:50``). Our equivalent stage — the smoke-test
+Job payload that proves devices, collectives, and a sharded train step all work
+— is fully automated, so the headline metric is how long that validation takes
+on the chip: lower is better, baseline is the reference's 300 s manual wait.
+
+Prints ONE JSON line:
+  metric       accelerator_validation_seconds (lower is better)
+  vs_baseline  300 / value  (×-faster than the reference's operator wait)
+plus secondary fields: achieved bf16 matmul TFLOP/s, HBM GiB/s, psum status.
+Runs on whatever ``jax.devices()`` exposes (one real TPU chip under the
+driver; the virtual CPU mesh during offline development).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+REFERENCE_OPERATOR_WAIT_S = 300.0  # /root/reference/gke/README.md:50 ("~5 min")
+
+
+def main() -> None:
+    import jax
+
+    t0 = time.perf_counter()
+
+    from nvidia_terraform_modules_tpu.ops import hbm_probe, matmul_probe
+    from nvidia_terraform_modules_tpu.smoketest import run_smoketest
+
+    n_dev = len(jax.devices())
+    level = "burnin" if n_dev >= 2 else "psum"
+    smoke = run_smoketest(level=level, env={})
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    mm = matmul_probe(n=4096 if on_tpu else 512, iters=8 if on_tpu else 2)
+    hbm = hbm_probe(mib=256 if on_tpu else 32, iters=8 if on_tpu else 2)
+
+    # single-chip burn-in train-step throughput (tokens/s) on a mid-size config
+    from nvidia_terraform_modules_tpu.models import (
+        BurnInConfig,
+        init_params,
+        make_train_step,
+        synthetic_batch,
+    )
+    import jax.numpy as jnp
+
+    cfg = (
+        BurnInConfig(vocab=8192, d_model=512, n_heads=8, d_ff=2048, n_layers=4,
+                     seq_len=512, batch=16)
+        if on_tpu
+        else BurnInConfig(vocab=256, d_model=64, n_heads=4, d_ff=128,
+                          n_layers=2, seq_len=32, batch=4, dtype=jnp.float32)
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    step = make_train_step(cfg)
+    batch = synthetic_batch(jax.random.PRNGKey(1), cfg)
+    params, _ = jax.block_until_ready(step(params, batch))  # compile
+    t_step = time.perf_counter()
+    iters = 10
+    for _ in range(iters):
+        params, loss = step(params, batch)
+    jax.block_until_ready(loss)
+    tokens_per_s = cfg.batch * cfg.seq_len * iters / (time.perf_counter() - t_step)
+
+    total = time.perf_counter() - t0
+    line = {
+        "metric": "accelerator_validation_seconds",
+        "value": round(total, 2),
+        "unit": "s",
+        "vs_baseline": round(REFERENCE_OPERATOR_WAIT_S / total, 2),
+        "smoke_ok": smoke.ok,
+        "devices": n_dev,
+        "device_kind": jax.devices()[0].device_kind,
+        "matmul_tflops": round(mm["tflops"], 2),
+        "matmul_roofline": round(mm["roofline_fraction"], 3),
+        "hbm_gibps": round(hbm["gibps"], 1),
+        "burnin_tokens_per_s": round(tokens_per_s, 1),
+    }
+    print(json.dumps(line), flush=True)
+
+
+if __name__ == "__main__":
+    main()

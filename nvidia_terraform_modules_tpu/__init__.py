@@ -1,0 +1,24 @@
+"""nvidia_terraform_modules_tpu — TPU-native cluster-validation & IaC-test library.
+
+This package is the *runtime* half of the tpu-terraform-modules framework. The
+reference project (``nvidia-terraform-modules``) ships only declarative HCL and
+delegates accelerator validation to manual runbooks (see
+``/root/reference/gke/README.md:50``, ``/root/reference/eks/examples/cnpack/Readme.md:107-163``).
+We replace those runbooks with executable code:
+
+- :mod:`~nvidia_terraform_modules_tpu.smoketest` — the in-cluster JAX ``psum``
+  all-reduce validation Job payload (single-host and multi-host slices).
+- :mod:`~nvidia_terraform_modules_tpu.models` — the burn-in workload (a small
+  sharded transformer) used to prove a freshly provisioned slice trains.
+- :mod:`~nvidia_terraform_modules_tpu.ops` — MXU/HBM/ICI micro-probes used by
+  ``bench.py`` and the smoke test.
+- :mod:`~nvidia_terraform_modules_tpu.parallel` — mesh construction, sharding
+  rules and multi-host bootstrap for GKE indexed Jobs / JobSets.
+- :mod:`~nvidia_terraform_modules_tpu.tfsim` — an offline Terraform module
+  validator (HCL2 parser + plan-graph simulator) standing in for
+  ``terraform fmt/validate/plan`` golden tests where no cloud or terraform
+  binary is available (the reference has no automated tests at all —
+  ``/root/reference/CONTRIBUTING.md:56``).
+"""
+
+__version__ = "0.1.0"

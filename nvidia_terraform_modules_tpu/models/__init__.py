@@ -1,0 +1,10 @@
+"""Burn-in workloads run on freshly provisioned slices."""
+
+from .burnin import (  # noqa: F401
+    BurnInConfig,
+    init_params,
+    forward,
+    loss_fn,
+    make_train_step,
+    synthetic_batch,
+)

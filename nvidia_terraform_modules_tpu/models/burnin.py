@@ -1,0 +1,187 @@
+"""Burn-in transformer: the training workload a new slice must survive.
+
+The reference framework proves a cluster works by installing the GPU Operator
+and eyeballing pod states (``/root/reference/gke/README.md:50``). We go
+further: after the psum smoke test, the validation Job can train this small
+decoder-only transformer for a few steps. It exercises every subsystem a real
+workload will: MXU matmuls (bf16), HBM traffic, and — through its sharding
+annotations — DP gradient psums, Megatron-style TP all-gathers /
+reduce-scatters, and sequence-parallel layouts over the mesh the ``gke-tpu``
+module provisioned.
+
+Design notes (TPU-first):
+- pure-functional pytree params + ``jax.jit`` with explicit in/out shardings;
+- ``with_sharding_constraint`` pins activation layouts; XLA inserts the
+  collectives (no hand-written NCCL analogue);
+- static shapes everywhere; the step is one compiled XLA program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel.sharding import ShardingRules
+
+
+@dataclasses.dataclass(frozen=True)
+class BurnInConfig:
+    vocab: int = 512
+    d_model: int = 128
+    n_heads: int = 4
+    d_ff: int = 512
+    n_layers: int = 2
+    seq_len: int = 128
+    batch: int = 8
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def _rmsnorm(x, scale):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
+
+
+def init_params(rng, cfg: BurnInConfig, rules: ShardingRules | None = None):
+    """Initialise parameters; if ``rules`` given, place them sharded."""
+    keys = jax.random.split(rng, 2 + cfg.n_layers)
+
+    def dense(key, shape, scale=0.02):
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(cfg.dtype)
+
+    params: dict[str, Any] = {
+        "embed": dense(keys[0], (cfg.vocab, cfg.d_model)),
+        "out_norm": jnp.ones((cfg.d_model,), dtype=cfg.dtype),
+        "layers": [],
+    }
+    for i in range(cfg.n_layers):
+        lk = jax.random.split(keys[2 + i], 6)
+        params["layers"].append(
+            {
+                "attn_norm": jnp.ones((cfg.d_model,), dtype=cfg.dtype),
+                "wq": dense(lk[0], (cfg.d_model, cfg.d_model)),
+                "wk": dense(lk[1], (cfg.d_model, cfg.d_model)),
+                "wv": dense(lk[2], (cfg.d_model, cfg.d_model)),
+                "wo": dense(lk[3], (cfg.d_model, cfg.d_model)),
+                "mlp_norm": jnp.ones((cfg.d_model,), dtype=cfg.dtype),
+                "up": dense(lk[4], (cfg.d_model, cfg.d_ff)),
+                "down": dense(lk[5], (cfg.d_ff, cfg.d_model)),
+            }
+        )
+    if rules is not None:
+        params = shard_params(params, rules)
+    return params
+
+
+def param_shardings(params, rules: ShardingRules):
+    """Pytree of NamedShardings matching ``params`` via path-based rules."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    shardings = [
+        rules.param_sharding(tuple(str(k) for k in path)) for path, _ in flat
+    ]
+    return jax.tree_util.tree_unflatten(treedef, shardings)
+
+
+def shard_params(params, rules: ShardingRules):
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, s), params, param_shardings(params, rules)
+    )
+
+
+def forward(params, tokens, cfg: BurnInConfig, rules: ShardingRules | None = None):
+    """Decoder-only forward pass → logits [batch, seq, vocab]."""
+
+    def constrain(x, spec):
+        if rules is None:
+            return x
+        return jax.lax.with_sharding_constraint(x, NamedSharding(rules.mesh, spec))
+
+    x = params["embed"][tokens]                       # [B, S, D]
+    # sequence-parallel resident layout between blocks
+    x = constrain(x, P("dp", "sp", None))
+
+    causal = jnp.tril(jnp.ones((cfg.seq_len, cfg.seq_len), dtype=jnp.bool_))
+    for layer in params["layers"]:
+        h = _rmsnorm(x, layer["attn_norm"])
+        # attention needs the full sequence: gather sp → shard heads on tp
+        h = constrain(h, P("dp", None, None))
+        q = h @ layer["wq"]
+        k = h @ layer["wk"]
+        v = h @ layer["wv"]
+
+        def split(t):
+            t = t.reshape(t.shape[0], t.shape[1], cfg.n_heads, cfg.head_dim)
+            return constrain(t, P("dp", None, "tp", None))
+
+        q, k, v = split(q), split(k), split(v)
+        scores = jnp.einsum("bshd,bthd->bhst", q, k) / jnp.sqrt(
+            jnp.asarray(cfg.head_dim, dtype=jnp.float32)
+        ).astype(q.dtype)
+        scores = jnp.where(causal[None, None, :, :], scores, -1e9)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+        attn = jnp.einsum("bhst,bthd->bshd", probs, v)
+        attn = attn.reshape(attn.shape[0], attn.shape[1], cfg.d_model)
+        x = x + constrain(attn @ layer["wo"], P("dp", "sp", None))
+
+        h = _rmsnorm(x, layer["mlp_norm"])
+        h = constrain(h, P("dp", None, None))
+        h = jax.nn.gelu((h @ layer["up"]).astype(jnp.float32)).astype(cfg.dtype)
+        h = constrain(h, P("dp", None, "tp"))
+        x = x + constrain(h @ layer["down"], P("dp", "sp", None))
+
+    x = _rmsnorm(x, params["out_norm"])
+    logits = x @ params["embed"].T                    # weight-tied head
+    return constrain(logits, P("dp", "sp", None))
+
+
+def loss_fn(params, batch, cfg: BurnInConfig, rules: ShardingRules | None = None):
+    tokens, targets = batch
+    logits = forward(params, tokens, cfg, rules).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+    return jnp.mean(nll)
+
+
+def synthetic_batch(rng, cfg: BurnInConfig, rules: ShardingRules | None = None):
+    """Deterministic synthetic LM batch (next-token of a random stream)."""
+    stream = jax.random.randint(rng, (cfg.batch, cfg.seq_len + 1), 0, cfg.vocab)
+    tokens, targets = stream[:, :-1], stream[:, 1:]
+    if rules is not None:
+        s = rules.shard(P("dp", None))
+        tokens, targets = jax.device_put(tokens, s), jax.device_put(targets, s)
+    return tokens, targets
+
+
+def make_train_step(cfg: BurnInConfig, rules: ShardingRules | None = None, lr: float = 1e-3):
+    """Build a jitted SGD train step with explicit in/out shardings.
+
+    Plain SGD keeps the optimizer state-free, so the step's sharding story is
+    entirely the parameter/activation story — ideal for a burn-in that must
+    compile fast on a cold cluster. (Real training would swap in optax here.)
+    """
+
+    def step(params, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, rules)
+        params = jax.tree.map(lambda p, g: (p - lr * g.astype(p.dtype)), params, grads)
+        return params, loss
+
+    if rules is None:
+        return jax.jit(step)
+    # abstract init: only the pytree structure is needed to derive shardings
+    abstract_params = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg)
+    )
+    ps = param_shardings(abstract_params, rules)
+    batch_s = rules.shard(P("dp", None))
+    return jax.jit(
+        step,
+        in_shardings=(ps, (batch_s, batch_s)),
+        out_shardings=(ps, NamedSharding(rules.mesh, P())),
+    )

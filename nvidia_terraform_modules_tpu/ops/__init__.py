@@ -1,0 +1,3 @@
+"""Hardware micro-probes (MXU matmul, HBM streaming) used by bench + smoketest."""
+
+from .probes import hbm_probe, matmul_probe  # noqa: F401

@@ -1,0 +1,78 @@
+"""MXU and HBM micro-probes.
+
+The reference's only hardware validation is "wait ~5 minutes, then kubectl get
+pods" (``/root/reference/gke/README.md:50``). These probes turn cluster burn-in
+into numbers: achieved bf16 matmul TFLOP/s (MXU health) and f32 streaming
+bandwidth (HBM health), reported as roofline fractions by ``bench.py``.
+
+Shapes are static, large, and bf16 so XLA tiles them straight onto the
+128×128 systolic array.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.device import device_spec
+from ..utils.timing import median_time
+
+
+def matmul_probe(n: int = 4096, dtype=jnp.bfloat16, iters: int = 8) -> dict[str, Any]:
+    """Chained square matmuls; returns achieved TFLOP/s and roofline fraction.
+
+    A `lax.scan` of ``iters`` dependent matmuls keeps the MXU busy across a
+    single dispatch, so launch overhead amortises out of the measurement.
+    """
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (n, n), dtype=dtype)
+    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), dtype=dtype)
+
+    @jax.jit
+    def chain(a, b):
+        def step(acc, _):
+            return jnp.dot(acc, b, preferred_element_type=jnp.float32).astype(dtype), None
+
+        out, _ = jax.lax.scan(step, a, None, length=iters)
+        return out
+
+    secs = median_time(chain, a, b)
+    flops = 2.0 * n * n * n * iters
+    tflops = flops / secs / 1e12
+    spec = device_spec()
+    return {
+        "n": n,
+        "seconds": secs,
+        "tflops": tflops,
+        "roofline_fraction": tflops / spec.bf16_tflops,
+        "device": spec.kind,
+    }
+
+
+def hbm_probe(mib: int = 256, iters: int = 8) -> dict[str, Any]:
+    """Streaming triad (read 2, write 1 array); returns achieved GiB/s."""
+    n = mib * (1 << 20) // 4  # f32 elements
+    x = jnp.ones((n,), dtype=jnp.float32)
+    y = jnp.full((n,), 2.0, dtype=jnp.float32)
+
+    @jax.jit
+    def triad(x, y):
+        def step(acc, _):
+            return acc * 1.0001 + y, None
+
+        out, _ = jax.lax.scan(step, x, None, length=iters)
+        return out
+
+    secs = median_time(triad, x, y)
+    moved = 3.0 * x.nbytes * iters  # read acc, read y, write acc
+    gibps = moved / secs / (1 << 30)
+    spec = device_spec()
+    return {
+        "mib": mib,
+        "seconds": secs,
+        "gibps": gibps,
+        "roofline_fraction": gibps / (spec.hbm_gbps * 1e9 / (1 << 30)),
+        "device": spec.kind,
+    }

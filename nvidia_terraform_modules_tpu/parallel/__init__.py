@@ -1,0 +1,18 @@
+"""Mesh construction, sharding rules, collective probes, multi-host bootstrap.
+
+The reference provisions the *fabric* (node-to-node security-group rules,
+``/root/reference/eks/main.tf:28-49``) and delegates collectives to NCCL inside
+user pods. Our TPU-native equivalent: the Terraform layer provisions slice
+topology (ICI) and this package exercises it with XLA collectives over a
+``jax.sharding.Mesh``.
+"""
+
+from .mesh import MeshPlan, build_mesh, plan_mesh  # noqa: F401
+from .sharding import ShardingRules, make_rules  # noqa: F401
+from .collectives import (  # noqa: F401
+    all_gather_probe,
+    psum_probe,
+    reduce_scatter_probe,
+    ring_permute_probe,
+)
+from .multihost import job_env_from_environ, maybe_initialize_distributed  # noqa: F401

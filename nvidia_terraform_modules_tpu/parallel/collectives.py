@@ -1,0 +1,124 @@
+"""Collective micro-probes: correctness + achieved ICI bandwidth.
+
+These are the executable replacement for the reference's manual "is the fabric
+up" checks (node-to-node SG rules at ``/root/reference/eks/main.tf:28-49`` plus
+README runbooks). Each probe returns (ok, seconds, bytes_moved) so callers can
+derive achieved bandwidth. All are built on ``shard_map`` so they compile to
+bare XLA collectives over the mesh — no NCCL analogue, the compiler owns the
+schedule.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+shard_map = jax.shard_map
+
+from ..utils.timing import median_time
+
+
+def _axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]
+
+
+def psum_probe(mesh: Mesh, axis: str = "dp", n_elems: int = 1 << 20) -> dict[str, Any]:
+    """All-reduce over ``axis``; verifies the sum matches the axis size.
+
+    Each shard contributes a vector of ones, so the psum result must equal the
+    number of participants — the same invariant the north-star smoke test
+    asserts in-cluster.
+    """
+    n_dev = _axis_size(mesh, axis)
+    spec = P(axis)
+
+    @jax.jit
+    @functools.partial(shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
+    def allreduce(x):
+        return jax.lax.psum(x, axis)
+
+    x = jnp.ones((n_dev * n_elems,), dtype=jnp.float32)
+    out = jax.device_get(allreduce(x))
+    ok = bool(np.allclose(out, float(n_dev)))
+    secs = median_time(allreduce, x)
+    # ring all-reduce moves 2*(n-1)/n of the full buffer per chip
+    moved = 2 * (n_dev - 1) / n_dev * x.nbytes
+    return {"ok": ok, "seconds": secs, "bytes": moved, "participants": n_dev}
+
+
+def all_gather_probe(mesh: Mesh, axis: str = "tp", n_elems: int = 1 << 18) -> dict[str, Any]:
+    """All-gather over ``axis``; verifies every shard sees every contribution."""
+    n_dev = _axis_size(mesh, axis)
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(axis)
+    )
+    def gather(x):
+        g = jax.lax.all_gather(x, axis, tiled=True)
+        # collapse so out_specs stays sharded; content check happens on host
+        return g
+
+    x = jnp.tile(jnp.arange(n_dev, dtype=jnp.float32), (n_elems,)).reshape(-1)
+    x = jnp.sort(x)  # shard i holds value i everywhere
+    out = jax.device_get(gather(x))
+    ok = bool(np.unique(out).size == n_dev)
+    secs = median_time(gather, x)
+    moved = (n_dev - 1) / n_dev * (x.nbytes * n_dev)
+    return {"ok": ok, "seconds": secs, "bytes": moved, "participants": n_dev}
+
+
+def reduce_scatter_probe(mesh: Mesh, axis: str = "tp", n_elems: int = 1 << 18) -> dict[str, Any]:
+    """psum_scatter over ``axis`` — the backbone of row-parallel matmuls."""
+    n_dev = _axis_size(mesh, axis)
+
+    @jax.jit
+    @functools.partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+    def rscatter(x):
+        return jax.lax.psum_scatter(x, axis, tiled=True)
+
+    x = jnp.ones((n_dev * n_dev * n_elems,), dtype=jnp.float32)
+    out = jax.device_get(rscatter(x))
+    ok = bool(np.allclose(out, float(n_dev)))
+    secs = median_time(rscatter, x)
+    moved = (n_dev - 1) / n_dev * x.nbytes
+    return {"ok": ok, "seconds": secs, "bytes": moved, "participants": n_dev}
+
+
+def ring_permute_probe(mesh: Mesh, axis: str = "sp", n_elems: int = 1 << 18) -> dict[str, Any]:
+    """One hop of a ring ``ppermute`` — the primitive under ring attention.
+
+    Long-context sequence parallelism (ring attention) is a chain of these
+    neighbour exchanges; a working ring hop on every axis position proves the
+    ICI ring the ``gke-tpu`` placement policy promised actually exists.
+    """
+    n_dev = _axis_size(mesh, axis)
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    @jax.jit
+    @functools.partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+    def ring_hop(x):
+        idx = jax.lax.axis_index(axis).astype(jnp.float32)
+        payload = x + idx
+        return jax.lax.ppermute(payload, axis, perm)
+
+    x = jnp.zeros((n_dev * n_elems,), dtype=jnp.float32)
+    out = jax.device_get(ring_hop(x)).reshape(n_dev, n_elems)
+    expected = (np.arange(n_dev, dtype=np.float32) - 1) % n_dev
+    ok = bool(np.allclose(out, expected[:, None]))
+    secs = median_time(ring_hop, x)
+    moved = x.nbytes  # every chip sends its full shard one hop
+    return {"ok": ok, "seconds": secs, "bytes": moved, "participants": n_dev}
+
+
+ALL_PROBES = {
+    "psum": psum_probe,
+    "all_gather": all_gather_probe,
+    "reduce_scatter": reduce_scatter_probe,
+    "ring_permute": ring_permute_probe,
+}

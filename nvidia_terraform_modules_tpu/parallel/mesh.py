@@ -1,0 +1,94 @@
+"""Device-mesh planning.
+
+A slice's physical topology comes from the Terraform layer
+(``gke-tpu`` variable ``tpu_topology``, e.g. ``"2x4"``); at runtime we fold the
+visible devices into a logical mesh with named axes:
+
+- ``dp``  — data parallel (gradient psum rides ICI)
+- ``tp``  — tensor/model parallel (activations all-gather / reduce-scatter)
+- ``sp``  — sequence/context parallel (ring collectives for long context)
+
+The planner keeps ``tp`` innermost so tensor-parallel collectives map onto the
+fastest ICI dimension, mirroring the scaling-book recipe: pick a mesh, annotate
+shardings, let XLA insert the collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """A named logical mesh shape over ``n_devices`` chips."""
+
+    axis_names: tuple[str, ...]
+    shape: tuple[int, ...]
+
+    @property
+    def n_devices(self) -> int:
+        return math.prod(self.shape)
+
+    def describe(self) -> str:
+        return " × ".join(f"{n}:{s}" for n, s in zip(self.axis_names, self.shape))
+
+
+def plan_mesh(
+    n_devices: int,
+    *,
+    tp: int | None = None,
+    sp: int = 1,
+    axis_names: Sequence[str] = ("dp", "sp", "tp"),
+) -> MeshPlan:
+    """Choose a (dp, sp, tp) factorisation of ``n_devices``.
+
+    ``tp`` defaults to the largest power of two ≤ 4 dividing the device count —
+    small enough that a v5e-8 slice still has a data axis, large enough to
+    exercise tensor-parallel collectives.
+    """
+    if n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+    if n_devices % sp != 0:
+        raise ValueError(f"sp = {sp} does not divide device count {n_devices}")
+    if tp is None:
+        tp = 1
+        while tp < 4 and n_devices % (tp * 2 * sp) == 0:
+            tp *= 2
+    if n_devices % (tp * sp) != 0:
+        raise ValueError(
+            f"tp*sp = {tp}*{sp} does not divide device count {n_devices}"
+        )
+    dp = n_devices // (tp * sp)
+    return MeshPlan(tuple(axis_names), (dp, sp, tp))
+
+
+def build_mesh(plan: MeshPlan | None = None, *, devices=None):
+    """Materialise a ``jax.sharding.Mesh`` for ``plan`` over ``devices``.
+
+    Uses ``mesh_utils.create_device_mesh`` when the full process-global device
+    set is used, so physical ICI neighbours land adjacent in the logical mesh;
+    falls back to a plain reshape for explicit device subsets.
+    """
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if plan is None:
+        plan = plan_mesh(len(devices))
+    if plan.n_devices != len(devices):
+        raise ValueError(
+            f"plan wants {plan.n_devices} devices, got {len(devices)}"
+        )
+    import numpy as np
+
+    if len(devices) == len(jax.devices()) and all(
+        a is b for a, b in zip(devices, jax.devices())
+    ):
+        dev_array = mesh_utils.create_device_mesh(plan.shape, devices=devices)
+    else:
+        dev_array = np.asarray(devices).reshape(plan.shape)
+    return Mesh(dev_array, plan.axis_names)

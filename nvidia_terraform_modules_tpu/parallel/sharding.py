@@ -1,0 +1,46 @@
+"""Named sharding rules for the burn-in workload.
+
+Logical array dimensions map onto mesh axes once, here, and every model /
+optimizer tensor derives its ``NamedSharding`` from these rules. This is the
+TPU-idiomatic replacement for per-tensor device placement: annotate, and let
+XLA insert all-gathers / reduce-scatters over ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    """PartitionSpecs for each logical tensor role in the burn-in model."""
+
+    mesh: Mesh
+    batch: P = P("dp")                     # [batch, seq, d]
+    batch_seq: P = P("dp", "sp")           # sequence-parallel activations
+    embed: P = P(None, "tp")               # [vocab, d_model]
+    attn_qkv: P = P(None, "tp")            # [d_model, heads*head_dim] col-parallel
+    attn_out: P = P("tp", None)            # [heads*head_dim, d_model] row-parallel
+    mlp_up: P = P(None, "tp")              # [d_model, d_ff] col-parallel
+    mlp_down: P = P("tp", None)            # [d_ff, d_model] row-parallel
+    replicated: P = P()
+
+    def shard(self, spec: P) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+    def param_sharding(self, path: tuple[str, ...]) -> NamedSharding:
+        """Sharding for a parameter by its pytree path (leaf names)."""
+        name = "/".join(str(p) for p in path)
+        if "embed" in name:
+            return self.shard(self.embed)
+        if "wq" in name or "wk" in name or "wv" in name or "up" in name or "gate" in name:
+            return self.shard(self.mlp_up)
+        if "wo" in name or "down" in name:
+            return self.shard(self.mlp_down)
+        return self.shard(self.replicated)
+
+
+def make_rules(mesh: Mesh) -> ShardingRules:
+    return ShardingRules(mesh=mesh)

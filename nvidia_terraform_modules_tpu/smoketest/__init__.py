@@ -1,0 +1,3 @@
+"""In-cluster TPU validation: the executable replacement for manual runbooks."""
+
+from .runner import SmokeResult, run_smoketest  # noqa: F401

@@ -1,0 +1,46 @@
+"""CLI entry: ``python -m nvidia_terraform_modules_tpu.smoketest``.
+
+This is the command the ``gke-tpu`` smoke-test Job container runs. Env
+contract (injected by the Job template in ``gke-tpu/smoketest.tf``):
+
+- ``TPU_SMOKETEST_EXPECTED_DEVICES`` — chips this host must see after init;
+- ``TPU_SMOKETEST_LEVEL`` — psum | probes | burnin;
+- ``TPU_SMOKETEST_HOSTS`` / ``TPU_SMOKETEST_COORDINATOR`` /
+  ``JOB_COMPLETION_INDEX`` — multi-host bootstrap (see parallel/multihost.py).
+"""
+
+import os
+import sys
+
+from .runner import run_smoketest
+
+
+def _steer_platform() -> None:
+    """Honour TPU_SMOKETEST_PLATFORM before the first backend init.
+
+    Some rigs pre-import jax pinned to a TPU platform (sitecustomize) in a way
+    that ignores ``JAX_PLATFORMS``; the config route still works as long as no
+    device has been touched. In-cluster the default (TPU) is what we want; CPU
+    smoke rigs set ``TPU_SMOKETEST_PLATFORM=cpu``.
+    """
+    plat = os.environ.get("TPU_SMOKETEST_PLATFORM")
+    if not plat:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", plat)
+    except Exception:
+        pass
+
+
+def main() -> int:
+    _steer_platform()
+    level = os.environ.get("TPU_SMOKETEST_LEVEL", "probes")
+    result = run_smoketest(level=level)
+    print(result.to_json(), flush=True)
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,129 @@
+"""The psum smoke test + optional deeper burn-in.
+
+North-star behaviour (BASELINE.json): after ``terraform apply`` on ``gke-tpu``,
+a Kubernetes Job runs this module on every host of the slice and asserts
+
+1. the expected number of TPU chips is visible (device plugin + topology OK);
+2. a ``psum`` all-reduce over all chips returns the participant count (ICI OK);
+
+and, at deeper validation levels,
+
+3. collective micro-probes on every mesh axis (all-gather, reduce-scatter,
+   ring permute — the ring-attention primitive) pass and report bandwidth;
+4. a few train steps of the sharded burn-in transformer run loss-decreasing.
+
+Output is ONE JSON line on stdout per host; exit code 0 iff everything passed,
+so the Terraform ``kubernetes_job`` with ``wait_for_completion = true`` turns
+``terraform apply`` itself into the integration test (vs. the reference's
+"wait ~5 minutes and kubectl get pods", ``/root/reference/gke/README.md:50``).
+
+The reference analogue of level "burnin" does not exist — the GPU modules never
+run a training workload (``/root/reference/CONTRIBUTING.md:56``: manual testing
+only).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any
+
+
+@dataclasses.dataclass
+class SmokeResult:
+    ok: bool
+    checks: dict[str, Any]
+    seconds: float
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"ok": self.ok, "seconds": round(self.seconds, 3), **self.checks}
+        )
+
+
+def run_smoketest(
+    expected_devices: int | None = None,
+    level: str = "probes",
+    env: dict[str, str] | None = None,
+) -> SmokeResult:
+    """Run the validation suite. ``level`` ∈ {"psum", "probes", "burnin"}."""
+    if level not in ("psum", "probes", "burnin"):
+        raise ValueError(
+            f"unknown smoke-test level {level!r}: expected psum|probes|burnin"
+        )
+    e = os.environ if env is None else env
+    t0 = time.perf_counter()
+    checks: dict[str, Any] = {"level": level}
+    ok = True
+
+    from ..parallel import (
+        build_mesh,
+        make_rules,
+        maybe_initialize_distributed,
+        plan_mesh,
+    )
+    from ..parallel.collectives import ALL_PROBES
+
+    job = maybe_initialize_distributed(e)
+    checks["process_id"] = job.process_id if job else 0
+    checks["num_processes"] = job.num_processes if job else 1
+
+    import jax
+
+    n_dev = len(jax.devices())
+    checks["devices"] = n_dev
+    checks["device_kind"] = jax.devices()[0].device_kind
+    if expected_devices is None and "TPU_SMOKETEST_EXPECTED_DEVICES" in e:
+        expected_devices = int(e["TPU_SMOKETEST_EXPECTED_DEVICES"])
+    if expected_devices is not None:
+        checks["expected_devices"] = expected_devices
+        if n_dev != expected_devices:
+            checks["device_count_ok"] = False
+            return SmokeResult(False, checks, time.perf_counter() - t0)
+        checks["device_count_ok"] = True
+
+    # 1. the north-star check: psum over ALL chips on a flat mesh
+    flat = build_mesh(plan_mesh(n_dev, tp=1, sp=1, axis_names=("dp", "sp", "tp")))
+    from ..parallel.collectives import psum_probe
+
+    r = psum_probe(flat, axis="dp", n_elems=1 << 16)
+    checks["psum_ok"] = r["ok"]
+    checks["psum_participants"] = r["participants"]
+    ok &= r["ok"]
+
+    if level in ("probes", "burnin") and ok:
+        mesh = build_mesh(plan_mesh(n_dev))
+        checks["mesh"] = dict(mesh.shape)
+        for name, probe in ALL_PROBES.items():
+            axis = {"psum": "dp", "all_gather": "tp", "reduce_scatter": "tp",
+                    "ring_permute": "dp"}[name]
+            if mesh.shape[axis] == 1:
+                axis = "dp" if mesh.shape["dp"] > 1 else "tp"
+            if mesh.shape[axis] == 1:
+                continue
+            pr = probe(mesh, axis=axis, n_elems=1 << 14)
+            checks[f"{name}_ok"] = pr["ok"]
+            checks[f"{name}_gibps"] = round(pr["bytes"] / max(pr["seconds"], 1e-9) / (1 << 30), 3)
+            ok &= pr["ok"]
+
+    if level == "burnin" and ok:
+        from ..models import BurnInConfig, init_params, make_train_step, synthetic_batch
+
+        mesh = build_mesh(plan_mesh(n_dev))
+        rules = make_rules(mesh)
+        cfg = BurnInConfig(batch=max(8, 2 * mesh.shape["dp"]))
+        params = init_params(jax.random.PRNGKey(0), cfg, rules)
+        step = make_train_step(cfg, rules)
+        batch = synthetic_batch(jax.random.PRNGKey(1), cfg, rules)
+        losses = []
+        for _ in range(5):
+            params, loss = step(params, batch)
+            losses.append(float(loss))
+        checks["burnin_first_loss"] = round(losses[0], 4)
+        checks["burnin_last_loss"] = round(losses[-1], 4)
+        checks["burnin_ok"] = losses[-1] < losses[0]
+        ok &= checks["burnin_ok"]
+
+    return SmokeResult(bool(ok), checks, time.perf_counter() - t0)

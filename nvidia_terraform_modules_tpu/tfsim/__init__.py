@@ -1,0 +1,27 @@
+"""tfsim — an offline Terraform module validator and plan simulator.
+
+Why this exists: the reference repo has **no automated tests at all**
+(``/root/reference/CONTRIBUTING.md:56`` — manual testing only), and its
+quality gates are ``terraform fmt``/``validate`` run by hand. This build must
+exceed that (SURVEY.md §4), but the build/test environment has neither a
+``terraform`` binary nor cloud credentials. tfsim closes the gap: a pure-Python
+HCL2 front-end plus a plan-graph simulator, deep enough to
+
+- parse every ``.tf`` file in this repo into a full expression AST;
+- validate modules the way ``terraform validate`` does (undeclared variable /
+  local / resource references, duplicate addresses, missing providers);
+- evaluate variables + locals + resource ``count``/``for_each`` against a
+  ``terraform.tfvars`` fixture and emit a concrete *plan*: the set of resource
+  instances that would be created, their evaluated attributes, and the
+  dependency DAG (cycle-checked, topologically ordered);
+- drive golden-plan tests in CI with no cloud, no state, no providers.
+
+It is intentionally a *subset* of HCL2 — exactly the subset a disciplined
+module uses — and fails loudly on anything outside it, which doubles as a
+style gate.
+"""
+
+from .parser import parse_hcl, HclParseError  # noqa: F401
+from .module import Module, load_module  # noqa: F401
+from .validate import validate_module, Finding  # noqa: F401
+from .plan import simulate_plan, Plan, PlanError  # noqa: F401

@@ -1,0 +1,209 @@
+"""AST node types for the tfsim HCL2 subset."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Union
+
+
+class Node:
+    line: int = 0
+
+
+@dataclasses.dataclass
+class Literal(Node):
+    value: Any            # str | int | float | bool | None
+    line: int = 0
+
+
+@dataclasses.dataclass
+class Template(Node):
+    """Interpolated string: parts are str literals or embedded expressions."""
+
+    parts: list[Union[str, "Expr"]]
+    line: int = 0
+
+
+@dataclasses.dataclass
+class TupleExpr(Node):
+    items: list["Expr"]
+    line: int = 0
+
+
+@dataclasses.dataclass
+class ObjectItem(Node):
+    key: "Expr"           # Literal(str) for bare idents, else arbitrary expr
+    value: "Expr"
+    line: int = 0
+
+
+@dataclasses.dataclass
+class ObjectExpr(Node):
+    items: list[ObjectItem]
+    line: int = 0
+
+
+@dataclasses.dataclass
+class Traversal(Node):
+    """`var.x`, `google_container_cluster.c[0].name`, `a.b[*].id` ..."""
+
+    root: str
+    ops: list[tuple]      # ("attr", name) | ("index", Expr) | ("splat",)
+    line: int = 0
+
+    def path_str(self) -> str:
+        out = self.root
+        for op in self.ops:
+            if op[0] == "attr":
+                out += f".{op[1]}"
+            elif op[0] == "index":
+                out += "[…]"
+            else:
+                out += "[*]"
+        return out
+
+
+@dataclasses.dataclass
+class Call(Node):
+    name: str
+    args: list["Expr"]
+    expand_last: bool = False   # f(a, b...)
+    line: int = 0
+
+
+@dataclasses.dataclass
+class Unary(Node):
+    op: str
+    operand: "Expr"
+    line: int = 0
+
+
+@dataclasses.dataclass
+class Binary(Node):
+    op: str
+    left: "Expr"
+    right: "Expr"
+    line: int = 0
+
+
+@dataclasses.dataclass
+class Conditional(Node):
+    cond: "Expr"
+    if_true: "Expr"
+    if_false: "Expr"
+    line: int = 0
+
+
+@dataclasses.dataclass
+class ForExpr(Node):
+    """`[for v in coll : expr if cond]` / `{for k, v in coll : k => v}`"""
+
+    key_var: Optional[str]      # None for single-var form
+    value_var: str
+    collection: "Expr"
+    key_expr: Optional["Expr"]  # set → object form
+    value_expr: "Expr"
+    cond: Optional["Expr"]
+    grouping: bool = False      # `=>` followed by `...`
+    line: int = 0
+
+
+Expr = Union[
+    Literal, Template, TupleExpr, ObjectExpr, Traversal, Call, Unary, Binary,
+    Conditional, ForExpr,
+]
+
+
+@dataclasses.dataclass
+class Attribute(Node):
+    name: str
+    expr: Expr
+    line: int = 0
+
+
+@dataclasses.dataclass
+class Block(Node):
+    type: str
+    labels: list[str]
+    body: "Body"
+    line: int = 0
+
+
+@dataclasses.dataclass
+class Body(Node):
+    attributes: list[Attribute]
+    blocks: list[Block]
+    line: int = 0
+
+    def attr(self, name: str) -> Optional[Attribute]:
+        for a in self.attributes:
+            if a.name == name:
+                return a
+        return None
+
+    def blocks_of(self, type_: str) -> list[Block]:
+        return [b for b in self.blocks if b.type == type_]
+
+
+def walk(node) -> "list[Node]":
+    """Flatten an AST (or Body) into a node list, depth-first."""
+    out: list[Node] = []
+
+    def rec(x):
+        if isinstance(x, Node):
+            out.append(x)
+        if dataclasses.is_dataclass(x) and not isinstance(x, type):
+            for f in dataclasses.fields(x):
+                rec(getattr(x, f.name))
+        elif isinstance(x, (list, tuple)):
+            for item in x:
+                rec(item)
+    rec(node)
+    return out
+
+
+def scoped_traversals(node, bound: frozenset = frozenset()):
+    """Yield ``(Traversal, bound_names)`` pairs with correct lexical scoping.
+
+    The single source of truth for scope-aware AST walking, shared by the
+    validator (reference checking) and the planner (dependency extraction):
+    for-expression variables and ``dynamic`` block iterators are tracked as
+    bound names; ``lifecycle`` blocks are skipped (their ``ignore_changes``
+    entries are attribute names, not references).
+    """
+    if isinstance(node, ForExpr):
+        names = {node.value_var} | ({node.key_var} if node.key_var else set())
+        yield from scoped_traversals(node.collection, bound)
+        inner = bound | names
+        for sub in (node.key_expr, node.value_expr, node.cond):
+            if sub is not None:
+                yield from scoped_traversals(sub, inner)
+        return
+    if isinstance(node, Block):
+        if node.type == "lifecycle":
+            return
+        if node.type == "dynamic" and node.labels:
+            iterator = node.labels[0]
+            it_attr = node.body.attr("iterator")
+            if it_attr is not None and isinstance(it_attr.expr, Traversal):
+                iterator = it_attr.expr.root
+            fe = node.body.attr("for_each")
+            if fe is not None:
+                yield from scoped_traversals(fe.expr, bound)
+            for content in node.body.blocks_of("content"):
+                yield from scoped_traversals(content, bound | {iterator})
+            return
+    if isinstance(node, Traversal):
+        yield node, bound
+        if hasattr(node, "root_expr"):
+            yield from scoped_traversals(node.root_expr, bound)
+        for op in node.ops:
+            if op[0] == "index":
+                yield from scoped_traversals(op[1], bound)
+        return
+    if dataclasses.is_dataclass(node) and not isinstance(node, type):
+        for f in dataclasses.fields(node):
+            yield from scoped_traversals(getattr(node, f.name), bound)
+    elif isinstance(node, (list, tuple)):
+        for item in node:
+            yield from scoped_traversals(item, bound)

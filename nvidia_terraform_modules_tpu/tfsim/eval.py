@@ -1,0 +1,337 @@
+"""Expression evaluator with Terraform-style unknown-value propagation.
+
+Anything not derivable at plan time (provider-computed attributes like a
+cluster endpoint) evaluates to the :data:`COMPUTED` sentinel, which propagates
+through every operation — exactly how a real plan renders
+``(known after apply)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from . import ast as A
+from .functions import FUNCTIONS, FunctionError
+
+
+class EvalError(ValueError):
+    pass
+
+
+class _Computed:
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "<computed>"
+
+    def __bool__(self):
+        raise EvalError("cannot branch on a computed value at plan time")
+
+
+COMPUTED = _Computed()
+
+
+class _TryError:
+    """Sentinel carried into try()/can() for failed lazy evaluations."""
+
+    def __init__(self, error: Exception):
+        self.error = error
+
+
+def is_computed(v: Any) -> bool:
+    if v is COMPUTED:
+        return True
+    if isinstance(v, list):
+        return any(is_computed(x) for x in v)
+    if isinstance(v, dict):
+        return any(is_computed(x) for x in v.values())
+    return False
+
+
+class Scope:
+    """Name resolution for one module evaluation."""
+
+    def __init__(
+        self,
+        variables: dict[str, Any] | None = None,
+        locals_: dict[str, Any] | None = None,
+        resources: dict[str, dict[str, Any]] | None = None,
+        data: dict[str, dict[str, Any]] | None = None,
+        modules: dict[str, Any] | None = None,
+        each: Any = None,
+        count_index: int | None = None,
+        path_module: str = ".",
+    ):
+        self.variables = variables or {}
+        self.locals = locals_ or {}
+        self.resources = resources or {}
+        self.data = data or {}
+        self.modules = modules or {}
+        self.each = each
+        self.count_index = count_index
+        self.path_module = path_module
+        self.bindings: dict[str, Any] = {}  # for-expression vars
+
+    def child_bindings(self, **kw: Any) -> "Scope":
+        s = Scope(
+            self.variables, self.locals, self.resources, self.data,
+            self.modules, self.each, self.count_index, self.path_module,
+        )
+        s.bindings = {**self.bindings, **kw}
+        return s
+
+
+def evaluate(expr: A.Expr, scope: Scope) -> Any:
+    return _Evaluator(scope).eval(expr)
+
+
+class _Evaluator:
+    def __init__(self, scope: Scope):
+        self.scope = scope
+
+    def eval(self, e: A.Expr) -> Any:
+        m = getattr(self, f"_eval_{type(e).__name__}", None)
+        if m is None:
+            raise EvalError(f"cannot evaluate node {type(e).__name__}")
+        return m(e)
+
+    # ----------------------------------------------------------- literals
+    def _eval_Literal(self, e: A.Literal):
+        return e.value
+
+    def _eval_Template(self, e: A.Template):
+        parts = []
+        for p in e.parts:
+            if isinstance(p, str):
+                parts.append(p)
+            else:
+                v = self.eval(p)
+                if v is COMPUTED:
+                    return COMPUTED
+                parts.append(_stringify(v))
+        return "".join(parts)
+
+    def _eval_TupleExpr(self, e: A.TupleExpr):
+        return [self.eval(x) for x in e.items]
+
+    def _eval_ObjectExpr(self, e: A.ObjectExpr):
+        out = {}
+        for item in e.items:
+            k = self.eval(item.key)
+            if k is COMPUTED:
+                raise EvalError("computed map key at plan time")
+            out[_stringify(k)] = self.eval(item.value)
+        return out
+
+    # ---------------------------------------------------------- operators
+    def _eval_Unary(self, e: A.Unary):
+        v = self.eval(e.operand)
+        if v is COMPUTED:
+            return COMPUTED
+        if e.op == "!":
+            return not v
+        if e.op == "-":
+            return -v
+        raise EvalError(f"unary {e.op}")
+
+    def _eval_Binary(self, e: A.Binary):
+        l = self.eval(e.left)
+        r = self.eval(e.right)
+        if l is COMPUTED or r is COMPUTED:
+            return COMPUTED
+        ops = {
+            "==": lambda: l == r, "!=": lambda: l != r,
+            "<": lambda: l < r, ">": lambda: l > r,
+            "<=": lambda: l <= r, ">=": lambda: l >= r,
+            "+": lambda: l + r, "-": lambda: l - r,
+            "*": lambda: l * r, "/": lambda: l / r, "%": lambda: l % r,
+            "&&": lambda: bool(l) and bool(r), "||": lambda: bool(l) or bool(r),
+        }
+        if e.op not in ops:
+            raise EvalError(f"binary {e.op}")
+        return ops[e.op]()
+
+    def _eval_Conditional(self, e: A.Conditional):
+        c = self.eval(e.cond)
+        if c is COMPUTED:
+            return COMPUTED
+        return self.eval(e.if_true) if c else self.eval(e.if_false)
+
+    # ---------------------------------------------------------- traversals
+    def _eval_Traversal(self, e: A.Traversal):
+        if hasattr(e, "root_expr"):
+            value = self.eval(e.root_expr)  # type: ignore[attr-defined]
+            ops = e.ops
+        else:
+            value, ops = self._resolve_root(e)
+        return self._apply_ops(value, ops, e)
+
+    def _resolve_root(self, e: A.Traversal):
+        s = self.scope
+        root = e.root
+        if root in s.bindings:
+            return s.bindings[root], e.ops
+        if root == "var":
+            return self._attr_step(s.variables, e.ops, e, "variable")
+        if root == "local":
+            return self._attr_step(s.locals, e.ops, e, "local")
+        if root == "each":
+            if s.each is None:
+                raise EvalError("each.* used outside for_each context")
+            return s.each, e.ops
+        if root == "count":
+            if s.count_index is None:
+                raise EvalError("count.index used outside count context")
+            return {"index": s.count_index}, e.ops
+        if root == "path":
+            return {"module": s.path_module, "root": s.path_module, "cwd": "."}, e.ops
+        if root == "terraform":
+            return {"workspace": "default"}, e.ops
+        if root == "data":
+            if not e.ops or e.ops[0][0] != "attr":
+                raise EvalError("data reference needs a type")
+            dtype = e.ops[0][1]
+            if dtype not in s.data:
+                raise EvalError(f"unknown data source type {dtype!r}")
+            return self._attr_step(s.data[dtype], e.ops[1:], e, f"data.{dtype}")
+        if root == "module":
+            return self._attr_step(s.modules, e.ops, e, "module")
+        if root in s.resources:
+            return self._attr_step(s.resources[root], e.ops, e, f"resource {root}")
+        raise EvalError(f"unknown reference {e.path_str()!r}")
+
+    def _attr_step(self, table: dict, ops: list, e: A.Traversal, what: str):
+        if not ops or ops[0][0] != "attr":
+            return table, ops
+        name = ops[0][1]
+        if name not in table:
+            raise EvalError(f"{what} {name!r} not declared (in {e.path_str()})")
+        return table[name], ops[1:]
+
+    def _apply_ops(self, value: Any, ops: list, e: A.Traversal):
+        for i, op in enumerate(ops):
+            if value is COMPUTED:
+                return COMPUTED
+            if op[0] == "attr":
+                if isinstance(value, dict):
+                    try:
+                        value = value[op[1]]  # ResourceAttrs yields COMPUTED
+                    except KeyError:
+                        raise EvalError(
+                            f"attribute {op[1]!r} not present (in {e.path_str()})"
+                        )
+                else:
+                    raise EvalError(f"cannot access .{op[1]} on {type(value).__name__}")
+            elif op[0] == "index":
+                idx = self.eval(op[1])
+                if idx is COMPUTED:
+                    return COMPUTED
+                try:
+                    value = value[int(idx) if isinstance(value, list) else idx]
+                except (KeyError, IndexError, TypeError) as ex:
+                    raise EvalError(f"index {idx!r} failed on {e.path_str()}: {ex}")
+            elif op[0] == "splat":
+                rest = ops[i + 1:]
+                if value is None:
+                    return []
+                if not isinstance(value, list):
+                    value = [value]
+                return [self._apply_ops(v, rest, e) for v in value]
+        return value
+
+    # ---------------------------------------------------------- functions
+    def _eval_Call(self, e: A.Call):
+        if e.name in ("try", "can"):
+            return self._lazy_call(e)
+        args = []
+        for i, a in enumerate(e.args):
+            v = self.eval(a)
+            if e.expand_last and i == len(e.args) - 1:
+                if v is COMPUTED:
+                    return COMPUTED
+                args.extend(v)
+            else:
+                args.append(v)
+        if e.name not in FUNCTIONS:
+            raise EvalError(f"function {e.name!r} not in tfsim subset")
+        if any(v is COMPUTED for v in args):
+            return COMPUTED
+        try:
+            return FUNCTIONS[e.name](*args)
+        except FunctionError:
+            raise
+        except Exception as ex:
+            raise EvalError(f"{e.name}(): {ex}")
+
+    def _lazy_call(self, e: A.Call):
+        results = []
+        for a in e.args:
+            try:
+                results.append(self.eval(a))
+            except (EvalError, FunctionError) as ex:
+                results.append(_TryError(ex))
+        if e.name == "can":
+            return not isinstance(results[0], _TryError)
+        for r in results:
+            if not isinstance(r, _TryError):
+                return r
+        raise EvalError("try(): all expressions failed")
+
+    # ------------------------------------------------------- comprehensions
+    def _eval_ForExpr(self, e: A.ForExpr):
+        coll = self.eval(e.collection)
+        if coll is COMPUTED:
+            return COMPUTED
+        if isinstance(coll, dict):
+            pairs = [(k, coll[k]) for k in coll]
+        else:
+            pairs = list(enumerate(coll))
+        if e.key_expr is None:
+            out_list = []
+            for k, v in pairs:
+                sub = self._bind(e, k, v)
+                if e.cond is not None:
+                    c = sub.eval(e.cond)
+                    if c is COMPUTED:
+                        return COMPUTED
+                    if not c:
+                        continue
+                out_list.append(sub.eval(e.value_expr))
+            return out_list
+        out: dict = {}
+        for k, v in pairs:
+            sub = self._bind(e, k, v)
+            if e.cond is not None:
+                c = sub.eval(e.cond)
+                if c is COMPUTED:
+                    return COMPUTED
+                if not c:
+                    continue
+            key = _stringify(sub.eval(e.key_expr))
+            val = sub.eval(e.value_expr)
+            if e.grouping:
+                out.setdefault(key, []).append(val)
+            else:
+                out[key] = val
+        return out
+
+    def _bind(self, e: A.ForExpr, k, v) -> "_Evaluator":
+        kw = {e.value_var: v}
+        if e.key_var:
+            kw[e.key_var] = k
+        return _Evaluator(self.scope.child_bindings(**kw))
+
+
+def _stringify(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None:
+        return ""
+    if isinstance(v, float) and v == int(v):
+        return str(int(v))
+    return str(v)

@@ -1,0 +1,196 @@
+"""The Terraform function stdlib subset tfsim evaluates.
+
+Only functions actually used by modules in this repo (plus close neighbours)
+are implemented; anything else raises, which keeps module authors inside the
+simulatable subset.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import json
+import math
+import re
+from typing import Any
+
+
+class FunctionError(ValueError):
+    pass
+
+
+def _fn_cidrsubnet(prefix: str, newbits: int, netnum: int) -> str:
+    net = ipaddress.ip_network(prefix)
+    new_prefix = net.prefixlen + int(newbits)
+    subnets = list(net.subnets(new_prefix=new_prefix))
+    if netnum >= len(subnets):
+        raise FunctionError(f"cidrsubnet: netnum {netnum} out of range for {prefix}")
+    return str(subnets[int(netnum)])
+
+
+def _fn_format(fmt: str, *args: Any) -> str:
+    out, ai = [], 0
+    i = 0
+    while i < len(fmt):
+        if fmt[i] == "%" and i + 1 < len(fmt):
+            c = fmt[i + 1]
+            if c == "%":
+                out.append("%")
+            elif c in "sdvq":
+                v = args[ai]
+                ai += 1
+                if c == "d":
+                    out.append(str(int(v)))
+                elif c == "q":
+                    out.append(json.dumps(str(v)))
+                else:
+                    out.append(_to_string(v))
+            else:
+                raise FunctionError(f"format: unsupported verb %{c}")
+            i += 2
+        else:
+            out.append(fmt[i])
+            i += 1
+    return "".join(out)
+
+
+def _to_string(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None:
+        return ""
+    return str(v)
+
+
+def _fn_lookup(m: dict, key: str, *default: Any) -> Any:
+    if key in m:
+        return m[key]
+    if default:
+        return default[0]
+    raise FunctionError(f"lookup: key {key!r} not found and no default")
+
+
+def _fn_one(coll) -> Any:
+    items = list(coll.values()) if isinstance(coll, dict) else list(coll)
+    if len(items) == 0:
+        return None
+    if len(items) == 1:
+        return items[0]
+    raise FunctionError(f"one: collection has {len(items)} elements")
+
+
+def _fn_coalesce(*args: Any) -> Any:
+    for a in args:
+        if a is not None and a != "":
+            return a
+    raise FunctionError("coalesce: all arguments are null/empty")
+
+
+def _fn_try(*args: Any) -> Any:
+    # evaluation errors are handled by the evaluator (lazy); here just pick
+    # the first non-sentinel
+    from .eval import _TryError
+
+    for a in args:
+        if not isinstance(a, _TryError):
+            return a
+    raise FunctionError("try: all expressions failed")
+
+
+def _fn_merge(*maps: dict) -> dict:
+    out: dict = {}
+    for m in maps:
+        if m is None:
+            continue
+        if not isinstance(m, dict):
+            raise FunctionError(f"merge: expected map, got {type(m).__name__}")
+        out.update(m)
+    return out
+
+
+def _fn_concat(*lists) -> list:
+    out: list = []
+    for l in lists:
+        if l is None:
+            continue
+        out.extend(l)
+    return out
+
+
+def _fn_regex(pattern: str, s: str):
+    m = re.search(pattern, s)
+    if not m:
+        raise FunctionError(f"regex: pattern {pattern!r} did not match")
+    if m.groupdict():
+        return m.groupdict()
+    if m.groups():
+        g = m.groups()
+        return list(g) if len(g) > 1 else g[0]
+    return m.group(0)
+
+
+FUNCTIONS: dict[str, Any] = {
+    "abs": abs,
+    "can": lambda v: True,          # refined by evaluator (lazy)
+    "ceil": math.ceil,
+    "floor": math.floor,
+    "cidrsubnet": _fn_cidrsubnet,
+    "coalesce": _fn_coalesce,
+    "coalescelist": lambda *ls: next((l for l in ls if l), []),
+    "compact": lambda l: [x for x in l if x not in (None, "")],
+    "concat": _fn_concat,
+    "contains": lambda coll, v: v in coll,
+    "distinct": lambda l: list(dict.fromkeys(l)),
+    "element": lambda l, i: l[int(i) % len(l)],
+    "endswith": lambda s, suf: str(s).endswith(suf),
+    "flatten": lambda l: _flatten(l),
+    "format": _fn_format,
+    "join": lambda sep, l: sep.join(_to_string(x) for x in l),
+    "jsondecode": json.loads,
+    "jsonencode": lambda v: json.dumps(v, separators=(",", ":")),
+    "keys": lambda m: sorted(m.keys()),
+    "length": len,
+    "lower": lambda s: str(s).lower(),
+    "lookup": _fn_lookup,
+    "max": max,
+    "merge": _fn_merge,
+    "min": min,
+    "one": _fn_one,
+    "range": lambda *a: list(range(*(int(x) for x in a))),
+    "regex": _fn_regex,
+    "replace": lambda s, old, new: re.sub(old[1:-1], new, s)
+    if len(old) > 1 and old.startswith("/") and old.endswith("/")
+    else str(s).replace(old, new),
+    "reverse": lambda l: list(reversed(l)),
+    "sort": sorted,
+    "split": lambda sep, s: str(s).split(sep),
+    "startswith": lambda s, pre: str(s).startswith(pre),
+    "substr": lambda s, off, length: str(s)[int(off):] if length < 0
+    else str(s)[int(off): int(off) + int(length)],
+    "sum": sum,
+    "title": lambda s: str(s).title(),
+    "tobool": lambda v: v if isinstance(v, bool) else {"true": True, "false": False}[str(v)],
+    "tolist": list,
+    "tomap": dict,
+    "tonumber": lambda v: v if isinstance(v, (int, float)) else float(v)
+    if "." in str(v) else int(v),
+    "toset": lambda l: sorted(set(l)),
+    "tostring": _to_string,
+    "trim": lambda s, cut: str(s).strip(cut),
+    "trimprefix": lambda s, p: s[len(p):] if str(s).startswith(p) else s,
+    "trimspace": lambda s: str(s).strip(),
+    "trimsuffix": lambda s, p: s[: -len(p)] if p and str(s).endswith(p) else s,
+    "try": _fn_try,
+    "upper": lambda s: str(s).upper(),
+    "values": lambda m: [m[k] for k in sorted(m.keys())],
+    "zipmap": lambda ks, vs: dict(zip(ks, vs)),
+}
+
+
+def _flatten(l):
+    out = []
+    for x in l:
+        if isinstance(x, (list, tuple)):
+            out.extend(_flatten(x))
+        else:
+            out.append(x)
+    return out

@@ -1,0 +1,178 @@
+"""HCL2 lexer: source text → token stream.
+
+Covers the token inventory used by real-world Terraform modules: identifiers,
+numbers, quoted strings with ``${...}`` interpolation left raw for the parser,
+heredocs, comments (``#``, ``//``, ``/* */``), operators and punctuation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+
+class HclLexError(SyntaxError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Token:
+    kind: str      # IDENT NUMBER STRING HEREDOC OP NEWLINE EOF
+    value: str
+    line: int
+    col: int
+
+    def __repr__(self) -> str:  # compact for parser error messages
+        return f"{self.kind}({self.value!r})@{self.line}"
+
+
+_OPS = [
+    "<<~", "<<", "=>", "==", "!=", "<=", ">=", "&&", "||", "...",
+    "?", ":", "=", "{", "}", "[", "]", "(", ")", ",", ".", "*", "/", "%",
+    "+", "-", "!", "<", ">",
+]
+_IDENT_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_-]*")
+_NUMBER_RE = re.compile(r"\d+(\.\d+)?([eE][+-]?\d+)?")
+
+
+def tokenize(src: str, filename: str = "<hcl>") -> list[Token]:
+    toks: list[Token] = []
+    i, line, col = 0, 1, 1
+    n = len(src)
+
+    def err(msg: str):
+        raise HclLexError(f"{filename}:{line}:{col}: {msg}")
+
+    while i < n:
+        c = src[i]
+        # --- whitespace & newlines ---
+        if c == "\n":
+            toks.append(Token("NEWLINE", "\n", line, col))
+            i += 1
+            line += 1
+            col = 1
+            continue
+        if c in " \t\r":
+            i += 1
+            col += 1
+            continue
+        # --- comments ---
+        if c == "#" or src.startswith("//", i):
+            while i < n and src[i] != "\n":
+                i += 1
+            continue
+        if src.startswith("/*", i):
+            end = src.find("*/", i + 2)
+            if end < 0:
+                err("unterminated block comment")
+            skipped = src[i : end + 2]
+            line += skipped.count("\n")
+            i = end + 2
+            continue
+        # --- heredoc ---
+        if src.startswith("<<", i):
+            strip_indent = src.startswith("<<~", i) or src.startswith("<<-", i)
+            j = i + (3 if strip_indent else 2)
+            m = _IDENT_RE.match(src, j)
+            if not m:
+                err("heredoc marker expected")
+            marker = m.group(0)
+            body_start = src.find("\n", m.end())
+            if body_start < 0:
+                err("unterminated heredoc")
+            body_start += 1
+            end_re = re.compile(rf"^[ \t]*{re.escape(marker)}[ \t]*$", re.M)
+            em = end_re.search(src, body_start)
+            if not em:
+                err(f"heredoc end marker {marker} not found")
+            body = src[body_start : em.start()]
+            if strip_indent:
+                lines = body.split("\n")
+                indents = [
+                    len(l) - len(l.lstrip()) for l in lines if l.strip()
+                ]
+                pad = min(indents) if indents else 0
+                body = "\n".join(l[pad:] if l.strip() else l for l in lines)
+            toks.append(Token("HEREDOC", body, line, col))
+            line += src.count("\n", i, em.end())
+            i = em.end()
+            # consume trailing newline of the marker line if present
+            if i < n and src[i] == "\n":
+                toks.append(Token("NEWLINE", "\n", line, col))
+                i += 1
+                line += 1
+            col = 1
+            continue
+        # --- quoted string (interpolation kept raw) ---
+        # A context stack tracks nesting: "str" = inside a quoted string,
+        # "interp" = inside ${...} / %{...}, "brace" = bare { } within an
+        # interpolation. This keeps `"${replace(var.a, "}", "x")}"` intact —
+        # braces inside nested string literals don't close the interpolation.
+        if c == '"':
+            j = i + 1
+            stack = ["str"]
+            while j < n and stack:
+                ch = src[j]
+                top = stack[-1]
+                if top == "str":
+                    if ch == "\\":
+                        j += 2
+                        continue
+                    if src.startswith("${", j) or src.startswith("%{", j):
+                        stack.append("interp")
+                        j += 2
+                        continue
+                    if ch == '"':
+                        stack.pop()
+                        j += 1
+                        continue
+                    if ch == "\n" and len(stack) == 1:
+                        err("newline in string literal")
+                    j += 1
+                else:  # interp / brace
+                    if ch == '"':
+                        stack.append("str")
+                        j += 1
+                        continue
+                    if ch == "{":
+                        stack.append("brace")
+                        j += 1
+                        continue
+                    if ch == "}":
+                        stack.pop()
+                        j += 1
+                        continue
+                    j += 1
+            if stack:
+                err("unterminated string")
+            j -= 1  # j is one past the closing quote
+            toks.append(Token("STRING", src[i + 1 : j], line, col))
+            col += j - i + 1
+            line += src.count("\n", i, j)
+            i = j + 1
+            continue
+        # --- number ---
+        if c.isdigit():
+            m = _NUMBER_RE.match(src, i)
+            toks.append(Token("NUMBER", m.group(0), line, col))
+            col += m.end() - i
+            i = m.end()
+            continue
+        # --- identifier / keyword ---
+        if c.isalpha() or c == "_":
+            m = _IDENT_RE.match(src, i)
+            toks.append(Token("IDENT", m.group(0), line, col))
+            col += m.end() - i
+            i = m.end()
+            continue
+        # --- operators / punctuation ---
+        for op in _OPS:
+            if src.startswith(op, i):
+                toks.append(Token("OP", op, line, col))
+                i += len(op)
+                col += len(op)
+                break
+        else:
+            err(f"unexpected character {c!r}")
+    toks.append(Token("EOF", "", line, col))
+    return toks

@@ -1,0 +1,252 @@
+"""Module model: a directory of ``.tf`` files → structured Module object."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional
+
+from . import ast as A
+from .parser import parse_hcl
+
+
+@dataclasses.dataclass
+class Variable:
+    name: str
+    type: Optional[str]
+    default: Optional[A.Expr]
+    description: Optional[str]
+    sensitive: bool
+    nullable: bool
+    validations: list[A.Block]
+    file: str
+    line: int
+
+
+@dataclasses.dataclass
+class Output:
+    name: str
+    expr: Optional[A.Expr]
+    description: Optional[str]
+    sensitive: bool
+    file: str
+    line: int
+
+
+@dataclasses.dataclass
+class Resource:
+    mode: str                 # "managed" | "data"
+    type: str
+    name: str
+    body: A.Body
+    file: str
+    line: int
+
+    @property
+    def address(self) -> str:
+        prefix = "data." if self.mode == "data" else ""
+        return f"{prefix}{self.type}.{self.name}"
+
+
+@dataclasses.dataclass
+class ModuleCall:
+    name: str
+    body: A.Body
+    file: str
+    line: int
+
+
+@dataclasses.dataclass
+class Provider:
+    name: str
+    alias: Optional[str]
+    body: A.Body
+    file: str
+
+
+@dataclasses.dataclass
+class Module:
+    path: str
+    variables: dict[str, Variable]
+    locals: dict[str, A.Expr]
+    resources: dict[str, Resource]          # address → Resource
+    data_sources: dict[str, Resource]       # address → Resource
+    outputs: dict[str, Output]
+    module_calls: dict[str, ModuleCall]
+    providers: list[Provider]
+    required_providers: dict[str, dict[str, Any]]
+    required_version: Optional[str]
+    files: dict[str, A.Body]
+    moved: list[A.Block] = dataclasses.field(default_factory=list)
+    checks: list[A.Block] = dataclasses.field(default_factory=list)
+
+    def resource(self, type_: str, name: str) -> Resource:
+        return self.resources[f"{type_}.{name}"]
+
+
+def _str_attr(body: A.Body, name: str) -> Optional[str]:
+    a = body.attr(name)
+    if a is None:
+        return None
+    if isinstance(a.expr, A.Literal) and isinstance(a.expr.value, str):
+        return a.expr.value
+    if isinstance(a.expr, A.Traversal):
+        return a.expr.path_str()
+    return None
+
+
+def _bool_attr(body: A.Body, name: str, default: bool = False) -> bool:
+    a = body.attr(name)
+    if a is None:
+        return default
+    if isinstance(a.expr, A.Literal) and isinstance(a.expr.value, bool):
+        return a.expr.value
+    return default
+
+
+def _type_expr_str(body: A.Body) -> Optional[str]:
+    a = body.attr("type")
+    if a is None:
+        return None
+    return _render_type(a.expr)
+
+
+def _render_type(e: A.Expr) -> str:
+    if isinstance(e, A.Traversal):
+        base = e.root
+        return base
+    if isinstance(e, A.Call):
+        inner = ", ".join(_render_type(x) for x in e.args)
+        return f"{e.name}({inner})"
+    if isinstance(e, A.ObjectExpr):
+        inner = ", ".join(
+            f"{it.key.value if isinstance(it.key, A.Literal) else '?'} = "
+            f"{_render_type(it.value)}"
+            for it in e.items
+        )
+        return f"{{{inner}}}"
+    if isinstance(e, A.Literal):
+        return str(e.value)
+    return type(e).__name__
+
+
+class ModuleLoadError(ValueError):
+    pass
+
+
+def load_module(path: str) -> Module:
+    """Parse all ``*.tf`` files directly inside ``path`` into a Module."""
+    tf_files = sorted(
+        f for f in os.listdir(path) if f.endswith(".tf") and
+        os.path.isfile(os.path.join(path, f))
+    )
+    if not tf_files:
+        raise ModuleLoadError(f"no .tf files in {path}")
+
+    mod = Module(
+        path=path, variables={}, locals={}, resources={}, data_sources={},
+        outputs={}, module_calls={}, providers=[], required_providers={},
+        required_version=None, files={},
+    )
+
+    for fname in tf_files:
+        full = os.path.join(path, fname)
+        with open(full, "r") as fh:
+            body = parse_hcl(fh.read(), filename=full)
+        mod.files[fname] = body
+        for attr in body.attributes:
+            raise ModuleLoadError(
+                f"{full}:{attr.line}: top-level attribute {attr.name!r} not allowed"
+            )
+        for blk in body.blocks:
+            _ingest(mod, blk, fname)
+    return mod
+
+
+def _ingest(mod: Module, blk: A.Block, fname: str) -> None:
+    full = os.path.join(mod.path, fname)
+
+    def dup(kind: str, key: str):
+        raise ModuleLoadError(f"{full}:{blk.line}: duplicate {kind} {key!r}")
+
+    if blk.type == "variable":
+        if len(blk.labels) != 1:
+            raise ModuleLoadError(f"{full}:{blk.line}: variable needs exactly one label")
+        name = blk.labels[0]
+        if name in mod.variables:
+            dup("variable", name)
+        d = blk.body.attr("default")
+        mod.variables[name] = Variable(
+            name=name,
+            type=_type_expr_str(blk.body),
+            default=d.expr if d else None,
+            description=_str_attr(blk.body, "description"),
+            sensitive=_bool_attr(blk.body, "sensitive"),
+            nullable=_bool_attr(blk.body, "nullable", default=True),
+            validations=blk.body.blocks_of("validation"),
+            file=fname, line=blk.line,
+        )
+    elif blk.type == "locals":
+        for attr in blk.body.attributes:
+            if attr.name in mod.locals:
+                dup("local", attr.name)
+            mod.locals[attr.name] = attr.expr
+    elif blk.type == "resource":
+        if len(blk.labels) != 2:
+            raise ModuleLoadError(f"{full}:{blk.line}: resource needs two labels")
+        r = Resource("managed", blk.labels[0], blk.labels[1], blk.body, fname, blk.line)
+        if r.address in mod.resources:
+            dup("resource", r.address)
+        mod.resources[r.address] = r
+    elif blk.type == "data":
+        if len(blk.labels) != 2:
+            raise ModuleLoadError(f"{full}:{blk.line}: data needs two labels")
+        r = Resource("data", blk.labels[0], blk.labels[1], blk.body, fname, blk.line)
+        if r.address in mod.data_sources:
+            dup("data source", r.address)
+        mod.data_sources[r.address] = r
+    elif blk.type == "output":
+        if len(blk.labels) != 1:
+            raise ModuleLoadError(f"{full}:{blk.line}: output needs exactly one label")
+        name = blk.labels[0]
+        if name in mod.outputs:
+            dup("output", name)
+        v = blk.body.attr("value")
+        mod.outputs[name] = Output(
+            name=name, expr=v.expr if v else None,
+            description=_str_attr(blk.body, "description"),
+            sensitive=_bool_attr(blk.body, "sensitive"),
+            file=fname, line=blk.line,
+        )
+    elif blk.type == "module":
+        if len(blk.labels) != 1:
+            raise ModuleLoadError(f"{full}:{blk.line}: module call needs one label")
+        name = blk.labels[0]
+        if name in mod.module_calls:
+            dup("module call", name)
+        mod.module_calls[name] = ModuleCall(name, blk.body, fname, blk.line)
+    elif blk.type == "provider":
+        mod.providers.append(
+            Provider(blk.labels[0] if blk.labels else "?",
+                     _str_attr(blk.body, "alias"), blk.body, fname)
+        )
+    elif blk.type == "terraform":
+        rv = blk.body.attr("required_version")
+        if rv and isinstance(rv.expr, A.Literal):
+            mod.required_version = rv.expr.value
+        for rp in blk.body.blocks_of("required_providers"):
+            for attr in rp.body.attributes:
+                spec: dict[str, Any] = {}
+                if isinstance(attr.expr, A.ObjectExpr):
+                    for item in attr.expr.items:
+                        if isinstance(item.key, A.Literal) and isinstance(item.value, A.Literal):
+                            spec[str(item.key.value)] = item.value.value
+                mod.required_providers[attr.name] = spec
+    elif blk.type == "moved":
+        mod.moved.append(blk)
+    elif blk.type == "check":
+        mod.checks.append(blk)
+    else:
+        raise ModuleLoadError(
+            f"{full}:{blk.line}: unsupported top-level block {blk.type!r}"
+        )

@@ -1,0 +1,395 @@
+"""Recursive-descent parser: tokens → Body / expression AST."""
+
+from __future__ import annotations
+
+from . import ast as A
+from .lexer import Token, tokenize
+
+
+class HclParseError(SyntaxError):
+    pass
+
+
+_KEYWORD_LITERALS = {"true": True, "false": False, "null": None}
+
+
+class Parser:
+    def __init__(self, tokens: list[Token], filename: str = "<hcl>"):
+        self.toks = tokens
+        self.pos = 0
+        self.filename = filename
+
+    # ------------------------------------------------------------- helpers
+    def peek(self, offset: int = 0) -> Token:
+        return self.toks[min(self.pos + offset, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.pos]
+        if t.kind != "EOF":
+            self.pos += 1
+        return t
+
+    def skip_newlines(self):
+        while self.peek().kind == "NEWLINE":
+            self.next()
+
+    def expect(self, kind: str, value: str | None = None) -> Token:
+        t = self.next()
+        if t.kind != kind or (value is not None and t.value != value):
+            self.err(f"expected {value or kind}, got {t}", t)
+        return t
+
+    def at_op(self, value: str) -> bool:
+        t = self.peek()
+        return t.kind == "OP" and t.value == value
+
+    def eat_op(self, value: str) -> bool:
+        if self.at_op(value):
+            self.next()
+            return True
+        return False
+
+    def err(self, msg: str, tok: Token | None = None):
+        t = tok or self.peek()
+        raise HclParseError(f"{self.filename}:{t.line}: {msg}")
+
+    # ---------------------------------------------------------------- body
+    def parse_body(self, until: str | None = None) -> A.Body:
+        attrs: list[A.Attribute] = []
+        blocks: list[A.Block] = []
+        self.skip_newlines()
+        first = self.peek()
+        while True:
+            self.skip_newlines()
+            t = self.peek()
+            if t.kind == "EOF":
+                if until:
+                    self.err(f"unexpected EOF, expected {until!r}")
+                break
+            if until and t.kind == "OP" and t.value == until:
+                break
+            if t.kind != "IDENT":
+                self.err(f"expected attribute or block, got {t}")
+            # lookahead: `ident =` → attribute; `ident (STRING|IDENT)* {` → block
+            if self.peek(1).kind == "OP" and self.peek(1).value == "=":
+                name = self.next().value
+                self.next()  # '='
+                expr = self.parse_expr()
+                attrs.append(A.Attribute(name, expr, line=t.line))
+                self._end_of_item()
+            else:
+                blocks.append(self.parse_block())
+        return A.Body(attrs, blocks, line=first.line)
+
+    def _end_of_item(self):
+        t = self.peek()
+        if t.kind in ("NEWLINE", "EOF"):
+            return
+        if t.kind == "OP" and t.value in ("}",):
+            return
+        self.err(f"expected newline after item, got {t}")
+
+    def parse_block(self) -> A.Block:
+        t = self.expect("IDENT")
+        labels: list[str] = []
+        while self.peek().kind in ("STRING", "IDENT"):
+            labels.append(self.next().value)
+        self.expect("OP", "{")
+        body = self.parse_body(until="}")
+        self.expect("OP", "}")
+        return A.Block(t.value, labels, body, line=t.line)
+
+    # ---------------------------------------------------------- expressions
+    def parse_expr(self) -> A.Expr:
+        return self.parse_conditional()
+
+    def parse_conditional(self) -> A.Expr:
+        cond = self.parse_binary(0)
+        if self.eat_op("?"):
+            self.skip_newlines()
+            t = self.parse_expr()
+            self.skip_newlines()
+            self.expect("OP", ":")
+            self.skip_newlines()
+            f = self.parse_expr()
+            return A.Conditional(cond, t, f, line=cond.line)
+        return cond
+
+    _PRECEDENCE = [
+        ["||"],
+        ["&&"],
+        ["==", "!="],
+        ["<", ">", "<=", ">="],
+        ["+", "-"],
+        ["*", "/", "%"],
+    ]
+
+    def parse_binary(self, level: int) -> A.Expr:
+        if level >= len(self._PRECEDENCE):
+            return self.parse_unary()
+        left = self.parse_binary(level + 1)
+        while self.peek().kind == "OP" and self.peek().value in self._PRECEDENCE[level]:
+            op = self.next().value
+            self.skip_newlines()
+            right = self.parse_binary(level + 1)
+            left = A.Binary(op, left, right, line=left.line)
+        return left
+
+    def parse_unary(self) -> A.Expr:
+        t = self.peek()
+        if t.kind == "OP" and t.value in ("!", "-"):
+            self.next()
+            return A.Unary(t.value, self.parse_unary(), line=t.line)
+        return self.parse_postfix()
+
+    def parse_postfix(self) -> A.Expr:
+        expr = self.parse_primary()
+        while True:
+            if self.at_op("."):
+                # `.` then ident / number (tuple index) / `*` splat
+                self.next()
+                nt = self.next()
+                if nt.kind == "IDENT":
+                    expr = self._attach(expr, ("attr", nt.value))
+                elif nt.kind == "NUMBER":
+                    expr = self._attach(expr, ("index", A.Literal(int(nt.value), line=nt.line)))
+                elif nt.kind == "OP" and nt.value == "*":
+                    expr = self._attach(expr, ("splat",))
+                else:
+                    self.err(f"bad traversal after '.': {nt}", nt)
+            elif self.at_op("["):
+                self.next()
+                if self.eat_op("*"):
+                    self.expect("OP", "]")
+                    expr = self._attach(expr, ("splat",))
+                else:
+                    idx = self.parse_expr()
+                    self.expect("OP", "]")
+                    expr = self._attach(expr, ("index", idx))
+            else:
+                return expr
+
+    def _attach(self, expr: A.Expr, op: tuple) -> A.Expr:
+        if isinstance(expr, A.Traversal):
+            expr.ops.append(op)
+            return expr
+        # non-traversal base (e.g. function call result, tuple literal)
+        t = A.Traversal("", [op], line=expr.line)
+        t.root_expr = expr  # type: ignore[attr-defined]
+        return t
+
+    def parse_primary(self) -> A.Expr:
+        t = self.peek()
+        if t.kind == "NUMBER":
+            self.next()
+            v = float(t.value) if ("." in t.value or "e" in t.value.lower()) else int(t.value)
+            return A.Literal(v, line=t.line)
+        if t.kind == "STRING":
+            self.next()
+            return self._parse_template(t)
+        if t.kind == "HEREDOC":
+            self.next()
+            return self._parse_template(t)
+        if t.kind == "IDENT":
+            if t.value in _KEYWORD_LITERALS:
+                self.next()
+                return A.Literal(_KEYWORD_LITERALS[t.value], line=t.line)
+            if t.value == "for":
+                self.err("for-expression outside [ ] / { }")
+            # function call?
+            if self.peek(1).kind == "OP" and self.peek(1).value == "(":
+                return self.parse_call()
+            self.next()
+            return A.Traversal(t.value, [], line=t.line)
+        if t.kind == "OP":
+            if t.value == "(":
+                self.next()
+                self.skip_newlines()
+                inner = self.parse_expr()
+                self.skip_newlines()
+                self.expect("OP", ")")
+                return inner
+            if t.value == "[":
+                return self.parse_tuple()
+            if t.value == "{":
+                return self.parse_object()
+        self.err(f"unexpected token in expression: {t}")
+
+    def parse_call(self) -> A.Expr:
+        name = self.expect("IDENT").value
+        self.expect("OP", "(")
+        args: list[A.Expr] = []
+        expand = False
+        self.skip_newlines()
+        while not self.at_op(")"):
+            args.append(self.parse_expr())
+            if self.eat_op("..."):
+                expand = True
+                self.skip_newlines()
+                break
+            if not self.eat_op(","):
+                self.skip_newlines()
+                break
+            self.skip_newlines()
+        self.skip_newlines()
+        self.expect("OP", ")")
+        return A.Call(name, args, expand_last=expand)
+
+    def parse_tuple(self) -> A.Expr:
+        t = self.expect("OP", "[")
+        self.skip_newlines()
+        if self.peek().kind == "IDENT" and self.peek().value == "for":
+            fe = self.parse_for(object_form=False)
+            self.expect("OP", "]")
+            return fe
+        items: list[A.Expr] = []
+        while not self.at_op("]"):
+            items.append(self.parse_expr())
+            self.skip_newlines()
+            if not self.eat_op(","):
+                self.skip_newlines()
+                break
+            self.skip_newlines()
+        self.expect("OP", "]")
+        return A.TupleExpr(items, line=t.line)
+
+    def parse_object(self) -> A.Expr:
+        t = self.expect("OP", "{")
+        self.skip_newlines()
+        if self.peek().kind == "IDENT" and self.peek().value == "for":
+            fe = self.parse_for(object_form=True)
+            self.expect("OP", "}")
+            return fe
+        items: list[A.ObjectItem] = []
+        while not self.at_op("}"):
+            key_tok = self.peek()
+            if key_tok.kind == "IDENT" and self.peek(1).kind == "OP" and \
+                    self.peek(1).value in ("=", ":"):
+                self.next()
+                key: A.Expr = A.Literal(key_tok.value, line=key_tok.line)
+            elif key_tok.kind == "STRING" and self.peek(1).kind == "OP" and \
+                    self.peek(1).value in ("=", ":"):
+                self.next()
+                key = self._parse_template(key_tok)
+            elif self.eat_op("("):
+                key = self.parse_expr()
+                self.expect("OP", ")")
+            else:
+                key = self.parse_expr()
+            op = self.next()
+            if not (op.kind == "OP" and op.value in ("=", ":")):
+                self.err(f"expected '=' or ':' in object, got {op}", op)
+            self.skip_newlines()
+            value = self.parse_expr()
+            items.append(A.ObjectItem(key, value, line=key_tok.line))
+            self.skip_newlines()
+            self.eat_op(",")
+            self.skip_newlines()
+        self.expect("OP", "}")
+        return A.ObjectExpr(items, line=t.line)
+
+    def parse_for(self, object_form: bool) -> A.ForExpr:
+        t = self.expect("IDENT")  # 'for'
+        v1 = self.expect("IDENT").value
+        key_var = None
+        value_var = v1
+        if self.eat_op(","):
+            key_var = v1
+            value_var = self.expect("IDENT").value
+        in_kw = self.expect("IDENT")
+        if in_kw.value != "in":
+            self.err("expected 'in' in for-expression", in_kw)
+        coll = self.parse_expr()
+        self.expect("OP", ":")
+        self.skip_newlines()
+        key_expr = None
+        grouping = False
+        first = self.parse_expr()
+        if object_form and self.eat_op("=>"):
+            key_expr = first
+            value_expr = self.parse_expr()
+            if self.eat_op("..."):
+                grouping = True
+        else:
+            value_expr = first
+        cond = None
+        self.skip_newlines()
+        if self.peek().kind == "IDENT" and self.peek().value == "if":
+            self.next()
+            cond = self.parse_expr()
+        self.skip_newlines()
+        return A.ForExpr(key_var, value_var, coll, key_expr, value_expr, cond,
+                         grouping, line=t.line)
+
+    # ------------------------------------------------------------ templates
+    def _parse_template(self, tok: Token) -> A.Expr:
+        """Split a raw string token into literal/interp parts."""
+        raw = tok.value
+        parts: list = []
+        buf: list[str] = []
+        i, n = 0, len(raw)
+        while i < n:
+            if raw[i] == "\\" and tok.kind == "STRING" and i + 1 < n:
+                esc = raw[i + 1]
+                buf.append({"n": "\n", "t": "\t", '"': '"', "\\": "\\"}.get(esc, "\\" + esc))
+                i += 2
+                continue
+            if raw.startswith("$${", i) or raw.startswith("%%{", i):
+                buf.append(raw[i + 1 :][: 2])
+                i += 3
+                continue
+            if raw.startswith("${", i):
+                # find matching close brace, skipping nested string literals
+                # (a `}` inside "..." must not close the interpolation)
+                depth, j = 1, i + 2
+                in_str = False
+                while j < n and depth:
+                    ch = raw[j]
+                    if in_str:
+                        if ch == "\\":
+                            j += 2
+                            continue
+                        if ch == '"':
+                            in_str = False
+                    elif ch == '"':
+                        in_str = True
+                    elif ch == "{":
+                        depth += 1
+                    elif ch == "}":
+                        depth -= 1
+                    j += 1
+                if depth:
+                    self.err("unterminated interpolation", tok)
+                inner_src = raw[i + 2 : j - 1]
+                sub = Parser(tokenize(inner_src, self.filename), self.filename)
+                sub.skip_newlines()
+                expr = sub.parse_expr()
+                if buf:
+                    parts.append("".join(buf))
+                    buf = []
+                parts.append(expr)
+                i = j
+                continue
+            if raw.startswith("%{", i):
+                # template directives (%{ if } / %{ for }) — out of subset
+                self.err("template directives %{...} not supported by tfsim", tok)
+            buf.append(raw[i])
+            i += 1
+        if buf:
+            parts.append("".join(buf))
+        if len(parts) == 1 and isinstance(parts[0], str):
+            return A.Literal(parts[0], line=tok.line)
+        if not parts:
+            return A.Literal("", line=tok.line)
+        return A.Template(parts, line=tok.line)
+
+
+def parse_hcl(src: str, filename: str = "<hcl>") -> A.Body:
+    p = Parser(tokenize(src, filename), filename)
+    return p.parse_body()
+
+
+def parse_expression(src: str, filename: str = "<expr>") -> A.Expr:
+    p = Parser(tokenize(src, filename), filename)
+    p.skip_newlines()
+    return p.parse_expr()

@@ -1,0 +1,384 @@
+"""Plan simulation: evaluate a module against tfvars, offline.
+
+Produces the set of resource instances a ``terraform plan`` would create —
+with provider-computed attributes rendered as ``<computed>`` — plus the
+dependency DAG (cycle-checked, topologically ordered) and evaluated outputs.
+Local-path child modules (``source = "../../"``, the reference's
+examples/cnpack idiom — ``/root/reference/gke/examples/cnpack/main.tf:7``) are
+simulated recursively; registry modules become fully-computed stubs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional
+
+from . import ast as A
+from .eval import COMPUTED, EvalError, Scope, evaluate, is_computed
+from .module import Module, Resource, load_module
+from .parser import parse_hcl
+
+
+class PlanError(ValueError):
+    pass
+
+
+class ResourceAttrs(dict):
+    """Attribute map of a planned resource: unset keys are computed-at-apply."""
+
+    def __missing__(self, key):
+        return COMPUTED
+
+
+@dataclasses.dataclass
+class PlannedInstance:
+    address: str            # e.g. google_container_cluster.cluster[0]
+    attrs: ResourceAttrs
+
+
+@dataclasses.dataclass
+class Plan:
+    module_path: str
+    instances: dict[str, PlannedInstance]        # address → instance
+    outputs: dict[str, Any]
+    edges: list[tuple[str, str]]                 # (from_address, to_address)
+    order: list[str]                             # topological apply order
+    child_plans: dict[str, "Plan"] = dataclasses.field(default_factory=dict)
+
+    def instance(self, address: str) -> PlannedInstance:
+        return self.instances[address]
+
+    def addresses_of_type(self, rtype: str) -> list[str]:
+        return [a for a in self.instances if a.split(".")[0] == rtype or
+                (a.startswith("data.") and a.split(".")[1] == rtype)]
+
+
+def load_tfvars(path: str) -> dict[str, Any]:
+    """Parse a ``terraform.tfvars`` file (attributes of literals only)."""
+    with open(path) as fh:
+        body = parse_hcl(fh.read(), filename=path)
+    scope = Scope()
+    out = {}
+    for attr in body.attributes:
+        out[attr.name] = evaluate(attr.expr, scope)
+    return out
+
+
+# --------------------------------------------------------------------------
+# reference extraction (for dependency edges)
+# --------------------------------------------------------------------------
+
+def _collect_addresses(node, resource_types: set[str]) -> set[str]:
+    """All resource/data/module addresses referenced from an AST subtree."""
+    out: set[str] = set()
+    for t, bound in A.scoped_traversals(node):
+        if t.root in bound:
+            continue
+        if t.root == "data" and len(t.ops) >= 2 and \
+                t.ops[0][0] == "attr" and t.ops[1][0] == "attr":
+            out.add(f"data.{t.ops[0][1]}.{t.ops[1][1]}")
+        elif t.root == "module" and t.ops and t.ops[0][0] == "attr":
+            out.add(f"module.{t.ops[0][1]}")
+        elif t.root in resource_types and t.ops and t.ops[0][0] == "attr":
+            out.add(f"{t.root}.{t.ops[0][1]}")
+    return out
+
+
+# --------------------------------------------------------------------------
+# body evaluation
+# --------------------------------------------------------------------------
+
+_META_ATTRS = {"count", "for_each", "depends_on", "provider"}
+_META_BLOCKS = {"lifecycle"}
+
+
+def _eval_body(body: A.Body, scope: Scope) -> ResourceAttrs:
+    out = ResourceAttrs()
+    for attr in body.attributes:
+        if attr.name in _META_ATTRS:
+            continue
+        out[attr.name] = evaluate(attr.expr, scope)
+    for blk in body.blocks:
+        if blk.type in _META_BLOCKS:
+            continue
+        if blk.type == "dynamic" and blk.labels:
+            name = blk.labels[0]
+            iterator = name
+            ia = blk.body.attr("iterator")
+            if ia is not None and isinstance(ia.expr, A.Traversal):
+                iterator = ia.expr.root
+            fe_attr = blk.body.attr("for_each")
+            if fe_attr is None:
+                raise PlanError(f"dynamic {name!r} block without for_each")
+            coll = evaluate(fe_attr.expr, scope)
+            if coll is COMPUTED:
+                out.setdefault(name, COMPUTED)
+                continue
+            items = (
+                list(coll.items()) if isinstance(coll, dict)
+                else list(enumerate(coll))
+            )
+            content_blocks = blk.body.blocks_of("content")
+            for k, v in items:
+                sub = scope.child_bindings(**{iterator: {"key": k, "value": v}})
+                for c in content_blocks:
+                    out.setdefault(name, []).append(_eval_body(c, sub))
+        else:
+            out.setdefault(blk.type, []).append(_eval_body(blk.body, scope))
+    return out
+
+
+# --------------------------------------------------------------------------
+# the simulator
+# --------------------------------------------------------------------------
+
+def simulate_plan(
+    module: Module | str,
+    tfvars: dict[str, Any] | None = None,
+    *,
+    _depth: int = 0,
+) -> Plan:
+    if isinstance(module, str):
+        module = load_module(module)
+    if _depth > 4:
+        raise PlanError("module recursion too deep")
+    tfvars = dict(tfvars or {})
+
+    # 1. variables ------------------------------------------------------
+    variables: dict[str, Any] = {}
+    base_scope = Scope()
+    for name, var in module.variables.items():
+        if name in tfvars:
+            variables[name] = tfvars.pop(name)
+        elif var.default is not None:
+            variables[name] = evaluate(var.default, base_scope)
+        else:
+            raise PlanError(f"required variable {name!r} not set")
+    if tfvars:
+        raise PlanError(f"unknown tfvars: {sorted(tfvars)}")
+
+    scope = Scope(variables=variables, path_module=module.path)
+
+    # 2. locals (fixed-point: locals may reference locals) --------------
+    pending = dict(module.locals)
+    for _ in range(len(pending) + 1):
+        progressed = False
+        for name in list(pending):
+            try:
+                scope.locals[name] = evaluate(pending[name], scope)
+                del pending[name]
+                progressed = True
+            except EvalError:
+                continue
+        if not pending:
+            break
+        if not progressed:
+            # leave unresolvable locals (e.g. referencing resources) computed
+            for name in list(pending):
+                try:
+                    scope.locals[name] = evaluate(pending[name], scope)
+                except EvalError:
+                    scope.locals[name] = COMPUTED
+                del pending[name]
+            break
+
+    # 3. dependency graph over resources + data + module calls ----------
+    resource_types = {r.type for r in module.resources.values()}
+    nodes: dict[str, Any] = {}
+    for addr, r in {**module.data_sources, **module.resources}.items():
+        nodes[addr] = r
+    for name, mc in module.module_calls.items():
+        nodes[f"module.{name}"] = mc
+
+    deps: dict[str, set[str]] = {}
+    for addr, obj in nodes.items():
+        body = obj.body
+        refs = _collect_addresses(body, resource_types)
+        deps[addr] = {r for r in refs if r in nodes and r != addr}
+
+    order = _toposort(deps)
+
+    # 4. walk in order, planning each node ------------------------------
+    instances: dict[str, PlannedInstance] = {}
+    for addr in order:
+        obj = nodes[addr]
+        if addr.startswith("module."):
+            _plan_module_call(addr, obj, module, scope, instances, _depth)
+        else:
+            _plan_resource(addr, obj, scope, instances)
+
+    # 5. outputs --------------------------------------------------------
+    outputs: dict[str, Any] = {}
+    for name, out in module.outputs.items():
+        if out.expr is None:
+            outputs[name] = COMPUTED
+            continue
+        try:
+            outputs[name] = evaluate(out.expr, scope)
+        except EvalError as ex:
+            raise PlanError(f"output {name!r}: {ex}")
+
+    edges = [(a, d) for a, ds in deps.items() for d in ds]
+    return Plan(
+        module_path=module.path, instances=instances, outputs=outputs,
+        edges=edges, order=order,
+    )
+
+
+def _plan_resource(addr: str, r: Resource, scope: Scope,
+                   instances: dict[str, PlannedInstance]) -> None:
+    count_attr = r.body.attr("count")
+    foreach_attr = r.body.attr("for_each")
+
+    def register(value: Any):
+        table = scope.data if r.mode == "data" else scope.resources
+        table.setdefault(r.type, {})[r.name] = value
+
+    if count_attr is not None:
+        n = evaluate(count_attr.expr, scope)
+        if n is COMPUTED:
+            raise PlanError(f"{addr}: count is computed at plan time")
+        n = int(n)
+        vals = []
+        for i in range(n):
+            sub = Scope(scope.variables, scope.locals, scope.resources,
+                        scope.data, scope.modules, None, i, scope.path_module)
+            sub.bindings = dict(scope.bindings)
+            attrs = _eval_body(r.body, sub)
+            attrs.setdefault("id", COMPUTED)
+            inst = PlannedInstance(f"{addr}[{i}]", attrs)
+            instances[inst.address] = inst
+            vals.append(attrs)
+        register(vals)
+    elif foreach_attr is not None:
+        coll = evaluate(foreach_attr.expr, scope)
+        if coll is COMPUTED:
+            raise PlanError(f"{addr}: for_each is computed at plan time")
+        items = (
+            list(coll.items()) if isinstance(coll, dict)
+            else [(k, k) for k in coll]
+        )
+        vals = {}
+        for k, v in items:
+            sub = Scope(scope.variables, scope.locals, scope.resources,
+                        scope.data, scope.modules,
+                        {"key": k, "value": v}, None, scope.path_module)
+            sub.bindings = dict(scope.bindings)
+            attrs = _eval_body(r.body, sub)
+            attrs.setdefault("id", COMPUTED)
+            inst = PlannedInstance(f'{addr}["{k}"]', attrs)
+            instances[inst.address] = inst
+            vals[k] = attrs
+        register(vals)
+    else:
+        attrs = _eval_body(r.body, scope)
+        attrs.setdefault("id", COMPUTED)
+        inst = PlannedInstance(addr, attrs)
+        instances[inst.address] = inst
+        register(attrs)
+
+
+class _ComputedModule(dict):
+    def __missing__(self, key):
+        return COMPUTED
+
+
+def _plan_module_call(addr: str, mc, parent: Module, scope: Scope,
+                      instances: dict[str, PlannedInstance],
+                      depth: int) -> None:
+    src_attr = mc.body.attr("source")
+    src = None
+    if src_attr is not None and isinstance(src_attr.expr, A.Literal):
+        src = src_attr.expr.value
+
+    # expansion: count = 0/N and for_each are honoured (a conditional module
+    # with count = 0 must plan nothing)
+    count_attr = mc.body.attr("count")
+    foreach_attr = mc.body.attr("for_each")
+    if count_attr is not None and foreach_attr is not None:
+        raise PlanError(f"{addr}: both count and for_each set")
+    expansions: list[tuple[str, Scope]]  # (address suffix, scope for args)
+    if count_attr is not None:
+        n = evaluate(count_attr.expr, scope)
+        if n is COMPUTED:
+            raise PlanError(f"{addr}: count is computed at plan time")
+        expansions = []
+        for i in range(int(n)):
+            sub = Scope(scope.variables, scope.locals, scope.resources,
+                        scope.data, scope.modules, None, i, scope.path_module)
+            sub.bindings = dict(scope.bindings)
+            expansions.append((f"[{i}]", sub))
+    elif foreach_attr is not None:
+        coll = evaluate(foreach_attr.expr, scope)
+        if coll is COMPUTED:
+            raise PlanError(f"{addr}: for_each is computed at plan time")
+        items = (list(coll.items()) if isinstance(coll, dict)
+                 else [(k, k) for k in coll])
+        expansions = []
+        for k, v in items:
+            sub = Scope(scope.variables, scope.locals, scope.resources,
+                        scope.data, scope.modules, {"key": k, "value": v},
+                        None, scope.path_module)
+            sub.bindings = dict(scope.bindings)
+            expansions.append((f'["{k}"]', sub))
+    else:
+        expansions = [("", scope)]
+
+    def plan_one(suffix: str, sub_scope: Scope):
+        args = {}
+        for attr in mc.body.attributes:
+            if attr.name in ("source", "version", "providers", "depends_on",
+                             "count", "for_each"):
+                continue
+            args[attr.name] = evaluate(attr.expr, sub_scope)
+        if src and (src.startswith("./") or src.startswith("../")):
+            child_path = os.path.normpath(os.path.join(parent.path, src))
+            child_plan = simulate_plan(child_path, args, _depth=depth + 1)
+            for iaddr, inst in child_plan.instances.items():
+                instances[f"{addr}{suffix}.{iaddr}"] = inst
+            return dict(child_plan.outputs)
+        instances[f"{addr}{suffix}"] = PlannedInstance(
+            f"{addr}{suffix}", ResourceAttrs(args))
+        return _ComputedModule()
+
+    if count_attr is not None:
+        scope.modules[mc.name] = [plan_one(s, sc) for s, sc in expansions]
+    elif foreach_attr is not None:
+        scope.modules[mc.name] = {
+            s[2:-2]: plan_one(s, sc) for s, sc in expansions}
+    else:
+        scope.modules[mc.name] = plan_one("", scope)
+
+
+def _toposort(deps: dict[str, set[str]]) -> list[str]:
+    order: list[str] = []
+    state: dict[str, int] = {}  # 0 new, 1 visiting, 2 done
+
+    def visit(n: str, chain: list[str]):
+        st = state.get(n, 0)
+        if st == 2:
+            return
+        if st == 1:
+            cycle = chain[chain.index(n):] + [n]
+            raise PlanError("dependency cycle: " + " → ".join(cycle))
+        state[n] = 1
+        for d in sorted(deps.get(n, ())):
+            visit(d, chain + [n])
+        state[n] = 2
+        order.append(n)
+
+    for n in sorted(deps):
+        visit(n, [])
+    return order
+
+
+def render(value: Any) -> Any:
+    """Plan value → JSON-friendly structure (COMPUTED → "<computed>")."""
+    if value is COMPUTED:
+        return "<computed>"
+    if isinstance(value, dict):
+        return {k: render(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [render(v) for v in value]
+    return value

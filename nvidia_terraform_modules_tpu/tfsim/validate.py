@@ -1,0 +1,163 @@
+"""Static validation: the offline stand-in for ``terraform validate``.
+
+Checks reference integrity (every ``var.``/``local.``/resource/data reference
+resolves), provider requirements, count/for_each exclusivity, and the style
+gates the reference enforces only by convention (descriptions on variables and
+outputs — cf. terraform-docs-generated READMEs, ``/root/reference/CONTRIBUTING.md:14``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from . import ast as A
+from .module import Module, Resource
+
+
+@dataclasses.dataclass
+class Finding:
+    severity: str   # "error" | "warning"
+    where: str      # file:line
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.severity}: {self.where}: {self.message}"
+
+
+_BUILTIN_ROOTS = {"var", "local", "data", "module", "each", "count", "path",
+                  "terraform", "self"}
+
+# resource-type prefix → acceptable provider local names
+_PROVIDER_OF_PREFIX = {
+    "google": {"google", "google-beta"},
+    "kubernetes": {"kubernetes"},
+    "helm": {"helm"},
+    "random": {"random"},
+    "null": {"null"},
+    "local": {"local"},
+    "time": {"time"},
+    "tls": {"tls"},
+}
+
+
+def _provider_for_type(rtype: str) -> str:
+    return rtype.split("_", 1)[0]
+
+
+def validate_module(mod: Module) -> list[Finding]:
+    findings: list[Finding] = []
+    add = findings.append
+
+    resource_types = {r.type for r in mod.resources.values()}
+    data_types: dict[str, set[str]] = {}
+    for r in mod.data_sources.values():
+        data_types.setdefault(r.type, set()).add(r.name)
+    resources_by_type: dict[str, set[str]] = {}
+    for r in mod.resources.values():
+        resources_by_type.setdefault(r.type, set()).add(r.name)
+
+    # ---- style gates -------------------------------------------------
+    for v in mod.variables.values():
+        where = f"{v.file}:{v.line}"
+        if not v.description:
+            add(Finding("warning", where, f"variable {v.name!r} has no description"))
+        if v.type is None:
+            add(Finding("warning", where, f"variable {v.name!r} has no type"))
+    for o in mod.outputs.values():
+        where = f"{o.file}:{o.line}"
+        if not o.description:
+            add(Finding("warning", where, f"output {o.name!r} has no description"))
+        if o.expr is None:
+            add(Finding("error", where, f"output {o.name!r} has no value"))
+
+    # ---- resource-level checks ---------------------------------------
+    for r in list(mod.resources.values()) + list(mod.data_sources.values()):
+        where = f"{r.file}:{r.line}"
+        if r.body.attr("count") is not None and r.body.attr("for_each") is not None:
+            add(Finding("error", where,
+                        f"{r.address}: both count and for_each set"))
+        prov = _provider_for_type(r.type)
+        accepted = _PROVIDER_OF_PREFIX.get(prov, {prov})
+        if mod.required_providers and not (accepted & set(mod.required_providers)):
+            add(Finding("error", where,
+                        f"{r.address}: no required_providers entry for "
+                        f"provider {prov!r}"))
+
+    if not mod.required_providers and (mod.resources or mod.data_sources):
+        add(Finding("warning", "versions.tf:0",
+                    "module declares no required_providers"))
+    if mod.required_version is None and (mod.resources or mod.data_sources):
+        add(Finding("warning", "versions.tf:0",
+                    "module declares no required_version"))
+
+    # ---- module calls ------------------------------------------------
+    for mc in mod.module_calls.values():
+        if mc.body.attr("source") is None:
+            add(Finding("error", f"{mc.file}:{mc.line}",
+                        f"module {mc.name!r} has no source"))
+
+    # ---- reference integrity ----------------------------------------
+    def check_refs(body_or_expr, file: str):
+        for trav, bound in A.scoped_traversals(body_or_expr):
+            if trav.root not in bound:
+                _check_traversal(trav, file, mod, resources_by_type,
+                                 data_types, add)
+
+    for r in list(mod.resources.values()) + list(mod.data_sources.values()):
+        check_refs(r.body, r.file)
+    for name, expr in mod.locals.items():
+        check_refs(expr, "locals")
+    for o in mod.outputs.values():
+        if o.expr is not None:
+            check_refs(o.expr, o.file)
+    for mc in mod.module_calls.values():
+        check_refs(mc.body, mc.file)
+    for p in mod.providers:
+        check_refs(p.body, p.file)
+
+    return findings
+
+
+def _check_traversal(t: A.Traversal, file, mod, resources_by_type,
+                     data_types, add):
+    line = f"{file}:{t.line}"
+    root = t.root
+    if root == "":
+        return
+    if root == "var":
+        if t.ops and t.ops[0][0] == "attr" and t.ops[0][1] not in mod.variables:
+            add(Finding("error", line,
+                        f"reference to undeclared variable var.{t.ops[0][1]}"))
+        return
+    if root == "local":
+        if t.ops and t.ops[0][0] == "attr" and t.ops[0][1] not in mod.locals:
+            add(Finding("error", line,
+                        f"reference to undeclared local local.{t.ops[0][1]}"))
+        return
+    if root == "data":
+        if len(t.ops) >= 2 and t.ops[0][0] == "attr" and t.ops[1][0] == "attr":
+            dtype, dname = t.ops[0][1], t.ops[1][1]
+            if dtype not in data_types or dname not in data_types[dtype]:
+                add(Finding("error", line,
+                            f"reference to undeclared data.{dtype}.{dname}"))
+        return
+    if root == "module":
+        if t.ops and t.ops[0][0] == "attr" and t.ops[0][1] not in mod.module_calls:
+            add(Finding("error", line,
+                        f"reference to undeclared module.{t.ops[0][1]}"))
+        return
+    if root in _BUILTIN_ROOTS:
+        return
+    if root in resources_by_type:
+        if t.ops and t.ops[0][0] == "attr" and t.ops[0][1] not in resources_by_type[root]:
+            add(Finding("error", line,
+                        f"reference to undeclared resource {root}.{t.ops[0][1]}"))
+        return
+    if "_" in root:
+        add(Finding("error", line,
+                    f"reference to undeclared resource type {root!r} "
+                    f"({t.path_str()})"))
+    # bare single identifiers that are neither builtins nor resource types are
+    # type keywords (string, number, bool, any, ...) or iterator names handled
+    # by `bound`; type keywords only appear inside variable type exprs, which
+    # we do not walk.

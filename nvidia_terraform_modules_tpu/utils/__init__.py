@@ -1,0 +1,10 @@
+"""Utility helpers: device discovery, peak-spec tables, timing."""
+
+from .device import (  # noqa: F401
+    DeviceSpec,
+    PEAK_SPECS,
+    device_kind,
+    device_spec,
+    is_tpu,
+)
+from .timing import timed, median_time  # noqa: F401

@@ -1,0 +1,63 @@
+"""Device discovery and per-chip peak specs.
+
+The reference framework's notion of "what accelerator am I on" is a Terraform
+variable (``gpu_type``, ``/root/reference/gke/variables.tf:83-110``). On TPU the
+machine type *implies* the chip, so at runtime we instead introspect
+``jax.devices()`` and map the device kind onto a peak-spec table. The specs are
+used to normalise benchmark output (``bench.py``) into roofline fractions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceSpec:
+    """Peak per-chip numbers used to normalise probe results."""
+
+    kind: str
+    bf16_tflops: float        # dense MXU peak, bf16 in / f32 accumulate
+    hbm_gbps: float           # HBM bandwidth per chip
+    hbm_gib: float            # HBM capacity per chip
+    ici_gbps: float           # aggregate inter-chip-interconnect bandwidth
+
+
+# Public figures (cloud.google.com/tpu/docs/system-architecture-tpu-vm).
+PEAK_SPECS: dict[str, DeviceSpec] = {
+    "TPU v4": DeviceSpec("TPU v4", 275.0, 1228.0, 32.0, 2400.0),
+    "TPU v5e": DeviceSpec("TPU v5e", 197.0, 819.0, 16.0, 1600.0),
+    "TPU v5 lite": DeviceSpec("TPU v5e", 197.0, 819.0, 16.0, 1600.0),
+    "TPU v5p": DeviceSpec("TPU v5p", 459.0, 2765.0, 95.0, 4800.0),
+    "TPU v6e": DeviceSpec("TPU v6e", 918.0, 1640.0, 32.0, 3584.0),
+    "TPU v6 lite": DeviceSpec("TPU v6e", 918.0, 1640.0, 32.0, 3584.0),
+    # CPU fallback so every probe also runs on the 8-device host-platform mesh
+    # used by the offline test suite.  Peaks are nominal, not meaningful.
+    "cpu": DeviceSpec("cpu", 0.5, 50.0, 16.0, 10.0),
+}
+
+
+def device_kind() -> str:
+    """Kind string of device 0 (e.g. ``"TPU v5e"`` or ``"cpu"``)."""
+    import jax
+
+    return jax.devices()[0].device_kind
+
+
+def is_tpu() -> bool:
+    import jax
+
+    return jax.devices()[0].platform == "tpu"
+
+
+@functools.lru_cache(maxsize=None)
+def device_spec(kind: str | None = None) -> DeviceSpec:
+    """Best-effort spec lookup; unknown kinds get a conservative stub."""
+    k = kind if kind is not None else device_kind()
+    if k in PEAK_SPECS:
+        return PEAK_SPECS[k]
+    for name, spec in PEAK_SPECS.items():
+        if name != "cpu" and (k.startswith(name) or name.startswith(k)):
+            return spec
+    return dataclasses.replace(PEAK_SPECS["cpu"], kind=k)

@@ -1,0 +1,32 @@
+"""Timing helpers for device-side work.
+
+Everything here blocks on the returned arrays (``block_until_ready``) so we
+time actual device execution, not async dispatch.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+
+def timed(fn: Callable[..., Any], *args: Any) -> tuple[Any, float]:
+    """Run ``fn(*args)``, block until its outputs are ready, return (out, seconds)."""
+    import jax
+
+    t0 = time.perf_counter()
+    out = fn(*args)
+    out = jax.block_until_ready(out)
+    return out, time.perf_counter() - t0
+
+
+def median_time(fn: Callable[..., Any], *args: Any, iters: int = 5, warmup: int = 2) -> float:
+    """Median wall-clock seconds of ``fn(*args)`` over ``iters`` timed runs.
+
+    ``warmup`` untimed runs first absorb compilation (first XLA compile of a
+    probe is 20-40s on TPU; steady-state is what we report).
+    """
+    for _ in range(warmup):
+        timed(fn, *args)
+    samples = sorted(timed(fn, *args)[1] for _ in range(iters))
+    return samples[len(samples) // 2]

@@ -1,0 +1,36 @@
+"""Test rig: force an 8-device virtual CPU platform BEFORE jax initialises.
+
+This mirrors the SURVEY §4 implication: the reference tests nothing without a
+live cloud; we exercise every collective/sharding path on a virtual mesh
+(XLA host-platform device count), so `pytest` needs no TPU and no cloud.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env may point at a TPU
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Some rigs pre-import jax (sitecustomize) with a TPU platform already chosen;
+# the backend is lazy, so a config update before first use still wins.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def jax8():
+    import jax
+
+    assert len(jax.devices()) == 8, "virtual 8-device CPU platform not active"
+    return jax
+
+
+@pytest.fixture(scope="session")
+def repo_root():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -1,0 +1,51 @@
+"""Multi-host Job-env bootstrap logic (pure, no cluster needed)."""
+
+import pytest
+
+from nvidia_terraform_modules_tpu.parallel.multihost import (
+    COORDINATOR_PORT,
+    job_env_from_environ,
+)
+
+
+def test_single_host_returns_none():
+    assert job_env_from_environ({}) is None
+    assert job_env_from_environ({"TPU_SMOKETEST_HOSTS": "1"}) is None
+
+
+def test_indexed_job_env():
+    env = {
+        "TPU_SMOKETEST_HOSTS": "2",
+        "JOB_COMPLETION_INDEX": "1",
+        "TPU_SMOKETEST_COORDINATOR": "tpu-smoketest-0.tpu-smoketest",
+    }
+    job = job_env_from_environ(env)
+    assert job.process_id == 1
+    assert job.num_processes == 2
+    assert job.coordinator_address == f"tpu-smoketest-0.tpu-smoketest:{COORDINATOR_PORT}"
+    assert not job.is_coordinator
+
+
+def test_explicit_port_preserved():
+    env = {
+        "TPU_SMOKETEST_HOSTS": "4",
+        "JOB_COMPLETION_INDEX": "0",
+        "TPU_SMOKETEST_COORDINATOR": "coord:1234",
+    }
+    assert job_env_from_environ(env).coordinator_address == "coord:1234"
+
+
+def test_tpu_worker_hostnames_fallback():
+    env = {
+        "TPU_SMOKETEST_HOSTS": "2",
+        "TPU_WORKER_ID": "1",
+        "TPU_WORKER_HOSTNAMES": "host-a, host-b",
+    }
+    job = job_env_from_environ(env)
+    assert job.process_id == 1
+    assert job.coordinator_address == f"host-a:{COORDINATOR_PORT}"
+
+
+def test_missing_coordinator_raises():
+    with pytest.raises(RuntimeError):
+        job_env_from_environ({"TPU_SMOKETEST_HOSTS": "2"})

@@ -1,0 +1,61 @@
+"""Mesh planning + collective probes on the 8-device virtual mesh."""
+
+import pytest
+
+from nvidia_terraform_modules_tpu.parallel import (
+    build_mesh,
+    plan_mesh,
+)
+from nvidia_terraform_modules_tpu.parallel.collectives import (
+    all_gather_probe,
+    psum_probe,
+    reduce_scatter_probe,
+    ring_permute_probe,
+)
+
+
+def test_plan_mesh_default_factorisation():
+    plan = plan_mesh(8)
+    assert plan.shape == (2, 1, 4)
+    assert plan.axis_names == ("dp", "sp", "tp")
+    assert plan.n_devices == 8
+
+
+def test_plan_mesh_explicit_tp_sp():
+    plan = plan_mesh(8, tp=2, sp=2)
+    assert plan.shape == (2, 2, 2)
+
+
+def test_plan_mesh_rejects_nondividing():
+    with pytest.raises(ValueError):
+        plan_mesh(8, tp=3)
+
+
+def test_build_mesh_shape(jax8):
+    mesh = build_mesh(plan_mesh(8))
+    assert dict(mesh.shape) == {"dp": 2, "sp": 1, "tp": 4}
+
+
+def test_psum_probe_all_devices(jax8):
+    mesh = build_mesh(plan_mesh(8, tp=1, sp=1))
+    r = psum_probe(mesh, axis="dp", n_elems=1 << 10)
+    assert r["ok"]
+    assert r["participants"] == 8
+
+
+def test_all_gather_probe(jax8):
+    mesh = build_mesh(plan_mesh(8))
+    r = all_gather_probe(mesh, axis="tp", n_elems=64)
+    assert r["ok"]
+
+
+def test_reduce_scatter_probe(jax8):
+    mesh = build_mesh(plan_mesh(8))
+    r = reduce_scatter_probe(mesh, axis="tp", n_elems=64)
+    assert r["ok"]
+
+
+def test_ring_permute_probe(jax8):
+    mesh = build_mesh(plan_mesh(8, tp=1, sp=1))
+    r = ring_permute_probe(mesh, axis="dp", n_elems=64)
+    assert r["ok"]

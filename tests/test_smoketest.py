@@ -1,0 +1,41 @@
+"""The north-star smoke test, run end-to-end on the virtual 8-device mesh."""
+
+import json
+
+from nvidia_terraform_modules_tpu.smoketest import run_smoketest
+
+
+def test_psum_level(jax8):
+    r = run_smoketest(expected_devices=8, level="psum", env={})
+    assert r.ok
+    assert r.checks["psum_ok"]
+    assert r.checks["psum_participants"] == 8
+    assert r.checks["device_count_ok"]
+
+
+def test_device_count_mismatch_fails(jax8):
+    r = run_smoketest(expected_devices=16, level="psum", env={})
+    assert not r.ok
+    assert r.checks["device_count_ok"] is False
+
+
+def test_probes_level(jax8):
+    r = run_smoketest(level="probes", env={})
+    assert r.ok
+    assert r.checks["all_gather_ok"]
+    assert r.checks["reduce_scatter_ok"]
+    assert r.checks["ring_permute_ok"]
+
+
+def test_json_line_contract(jax8):
+    """The Job log contract: one parseable JSON line with an 'ok' verdict."""
+    r = run_smoketest(level="psum", env={})
+    parsed = json.loads(r.to_json())
+    assert parsed["ok"] is True
+    assert "seconds" in parsed
+
+
+def test_burnin_level(jax8):
+    r = run_smoketest(level="burnin", env={})
+    assert r.ok, r.checks
+    assert r.checks["burnin_ok"]

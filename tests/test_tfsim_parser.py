@@ -1,0 +1,113 @@
+"""tfsim front-end: lexer + parser on representative HCL."""
+
+import pytest
+
+from nvidia_terraform_modules_tpu.tfsim import parse_hcl
+from nvidia_terraform_modules_tpu.tfsim.parser import HclParseError, parse_expression
+from nvidia_terraform_modules_tpu.tfsim import ast as A
+
+
+def test_parse_block_with_labels_and_attrs():
+    body = parse_hcl('''
+resource "google_compute_network" "vpc" {
+  name                    = var.network_name
+  auto_create_subnetworks = false
+}
+''')
+    assert len(body.blocks) == 1
+    blk = body.blocks[0]
+    assert blk.type == "resource"
+    assert blk.labels == ["google_compute_network", "vpc"]
+    assert blk.body.attr("auto_create_subnetworks").expr.value is False
+    name = blk.body.attr("name").expr
+    assert isinstance(name, A.Traversal) and name.root == "var"
+
+
+def test_parse_nested_blocks():
+    body = parse_hcl('''
+resource "google_container_node_pool" "pool" {
+  autoscaling {
+    min_node_count = 1
+    max_node_count = 4
+  }
+  node_config {
+    machine_type = "n2-standard-8"
+    labels = { role = "cpu" }
+  }
+}
+''')
+    blk = body.blocks[0]
+    assert len(blk.body.blocks_of("autoscaling")) == 1
+    labels = blk.body.blocks_of("node_config")[0].body.attr("labels").expr
+    assert isinstance(labels, A.ObjectExpr)
+
+
+def test_parse_conditional_and_arith():
+    e = parse_expression("length(var.zones) == 1 ? one(var.zones) : var.region")
+    assert isinstance(e, A.Conditional)
+    assert isinstance(e.cond, A.Binary)
+
+
+def test_parse_interpolation():
+    e = parse_expression('"tpu-${var.cluster_name}-${count.index + 1}"')
+    assert isinstance(e, A.Template)
+    assert e.parts[0] == "tpu-"
+    assert isinstance(e.parts[1], A.Traversal)
+    assert isinstance(e.parts[3], A.Binary)
+
+
+def test_parse_escaped_interpolation_stays_literal():
+    e = parse_expression('"cost-center-$${literal}"')
+    assert isinstance(e, A.Literal)
+    assert e.value == "cost-center-${literal}"
+
+
+def test_parse_for_expressions():
+    l = parse_expression('[for z in var.zones : upper(z) if z != ""]')
+    assert isinstance(l, A.ForExpr) and l.key_expr is None
+    m = parse_expression('{ for i, z in var.zones : z => i }')
+    assert isinstance(m, A.ForExpr) and m.key_expr is not None
+
+
+def test_parse_splat_and_index():
+    e = parse_expression("google_container_node_pool.tpu[*].name")
+    assert isinstance(e, A.Traversal)
+    assert ("splat",) in [tuple(op[:1]) for op in e.ops]
+    e2 = parse_expression("var.zones[0]")
+    assert e2.ops == [("attr", "zones")] or e2.ops[1][0] == "index"
+    assert e2.ops[-1][0] == "index"
+
+
+def test_parse_heredoc():
+    body = parse_hcl('''
+locals {
+  script = <<-EOT
+    #!/bin/bash
+    echo hello
+  EOT
+}
+''')
+    script = body.blocks[0].body.attr("script").expr
+    assert "echo hello" in script.value
+
+
+def test_parse_error_has_location():
+    with pytest.raises(HclParseError) as ei:
+        parse_hcl("resource {", filename="bad.tf")
+    assert "bad.tf" in str(ei.value)
+
+
+def test_dynamic_block_parses_as_block():
+    body = parse_hcl('''
+resource "x_y" "z" {
+  dynamic "guest_accelerator" {
+    for_each = var.gpus
+    content {
+      type  = guest_accelerator.value.type
+      count = guest_accelerator.value.count
+    }
+  }
+}
+''')
+    dyn = body.blocks[0].body.blocks_of("dynamic")[0]
+    assert dyn.labels == ["guest_accelerator"]

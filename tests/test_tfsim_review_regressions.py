@@ -1,0 +1,101 @@
+"""Regression tests for code-review findings on the tfsim front-end."""
+
+import textwrap
+
+from nvidia_terraform_modules_tpu.tfsim import simulate_plan
+from nvidia_terraform_modules_tpu.tfsim.eval import Scope, evaluate
+from nvidia_terraform_modules_tpu.tfsim.functions import FUNCTIONS
+from nvidia_terraform_modules_tpu.tfsim.parser import parse_expression
+from nvidia_terraform_modules_tpu.parallel.mesh import plan_mesh
+
+
+def test_ceil_negative():
+    assert FUNCTIONS["ceil"](-2.5) == -2
+    assert FUNCTIONS["ceil"](2.5) == 3
+    assert FUNCTIONS["floor"](-2.5) == -3
+
+
+def test_trimsuffix_empty_suffix():
+    assert FUNCTIONS["trimsuffix"]("abc", "") == "abc"
+    assert FUNCTIONS["trimsuffix"]("abc", "c") == "ab"
+
+
+def test_nested_string_brace_in_interpolation():
+    e = parse_expression('"${replace(var.a, "}", "y")}"')
+    v = evaluate(e, Scope(variables={"a": "x}z"}))
+    assert v == "xyz"
+
+
+def test_nested_string_with_interp_inside_interp():
+    e = parse_expression('"${join("-", ["a", "${var.b}"])}"')
+    assert evaluate(e, Scope(variables={"b": "c"})) == "a-c"
+
+
+def test_plan_mesh_sp_aware_default_tp():
+    plan = plan_mesh(4, sp=2)
+    assert plan.shape == (1, 2, 2)
+
+
+def test_module_call_count_zero_plans_nothing(tmp_path):
+    child = tmp_path / "child"
+    child.mkdir()
+    (child / "main.tf").write_text(textwrap.dedent('''
+        variable "name" {
+          description = "n"
+          type        = string
+          default     = "x"
+        }
+        resource "null_resource" "r" {
+          triggers = { n = var.name }
+        }
+        output "marker" {
+          description = "m"
+          value       = null_resource.r.id
+        }
+    '''))
+    root = tmp_path / "root"
+    root.mkdir()
+    (root / "main.tf").write_text(textwrap.dedent('''
+        variable "enabled" {
+          description = "flag"
+          type        = bool
+          default     = false
+        }
+        module "maybe" {
+          source = "../child"
+          count  = var.enabled ? 1 : 0
+          name   = "demo"
+        }
+    '''))
+    off = simulate_plan(str(root), {"enabled": False})
+    assert off.instances == {}
+    on = simulate_plan(str(root), {"enabled": True})
+    assert "module.maybe[0].null_resource.r" in on.instances
+
+
+def test_module_call_foreach(tmp_path):
+    child = tmp_path / "c"
+    child.mkdir()
+    (child / "main.tf").write_text(textwrap.dedent('''
+        variable "size" {
+          description = "s"
+          type        = number
+        }
+        resource "null_resource" "n" {
+          triggers = { s = var.size }
+        }
+    '''))
+    root = tmp_path / "r"
+    root.mkdir()
+    (root / "main.tf").write_text(textwrap.dedent('''
+        module "slices" {
+          source   = "../c"
+          for_each = { small = 1, big = 8 }
+          size     = each.value
+        }
+    '''))
+    plan = simulate_plan(str(root))
+    assert 'module.slices["small"].null_resource.n' in plan.instances
+    assert 'module.slices["big"].null_resource.n' in plan.instances
+    assert plan.instances['module.slices["big"].null_resource.n'].attrs[
+        "triggers"]["s"] == 8
