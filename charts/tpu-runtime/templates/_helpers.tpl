@@ -1,0 +1,6 @@
+{{- define "tpu-runtime.labels" -}}
+app.kubernetes.io/name: tpu-runtime
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+app.kubernetes.io/part-of: tpu-terraform-modules
+{{- end }}

@@ -1,0 +1,129 @@
+# Network, control plane, and CPU pool for the TPU cluster.
+#
+# Same L1-L3 capability as the gke/ sibling (VPC toggle, zonal/regional
+# cluster, Workload Identity, autoscaled CPU pool) plus cluster-autoscaling /
+# node-auto-provisioning limits for elastic TPU capacity (BASELINE config 5).
+
+locals {
+  create_vpc      = var.network.create
+  network_name    = local.create_vpc ? google_compute_network.vpc[0].name : var.network.existing_network
+  subnetwork_name = local.create_vpc ? google_compute_subnetwork.cluster[0].name : var.network.existing_subnetwork
+
+  zonal            = length(var.node_zones) == 1
+  cluster_location = local.zonal ? one(var.node_zones) : var.region
+  pool_zones       = local.zonal ? null : var.node_zones
+
+  node_oauth_scopes = [
+    "https://www.googleapis.com/auth/logging.write",
+    "https://www.googleapis.com/auth/monitoring",
+    "https://www.googleapis.com/auth/devstorage.read_only",
+  ]
+}
+
+resource "google_compute_network" "vpc" {
+  count = local.create_vpc ? 1 : 0
+
+  name                    = "${var.cluster_name}-net"
+  project                 = var.project_id
+  auto_create_subnetworks = false
+}
+
+resource "google_compute_subnetwork" "cluster" {
+  count = local.create_vpc ? 1 : 0
+
+  name                     = "${var.cluster_name}-subnet"
+  project                  = var.project_id
+  region                   = var.region
+  network                  = google_compute_network.vpc[0].id
+  ip_cidr_range            = var.network.subnet_cidr
+  private_ip_google_access = true
+}
+
+data "google_project" "this" {
+  project_id = var.project_id
+}
+
+data "google_container_engine_versions" "channel" {
+  provider = google-beta
+
+  project  = var.project_id
+  location = local.cluster_location
+}
+
+resource "google_container_cluster" "this" {
+  name     = var.cluster_name
+  project  = var.project_id
+  location = local.cluster_location
+
+  network    = local.network_name
+  subnetwork = local.subnetwork_name
+
+  remove_default_node_pool = true
+  initial_node_count       = 1
+
+  deletion_protection = var.deletion_protection
+
+  release_channel {
+    channel = var.release_channel
+  }
+
+  workload_identity_config {
+    workload_pool = "${var.project_id}.svc.id.goog"
+  }
+
+  dynamic "cluster_autoscaling" {
+    for_each = var.node_auto_provisioning.enabled ? [1] : []
+    content {
+      enabled = true
+
+      dynamic "resource_limits" {
+        for_each = var.node_auto_provisioning.resource_limits
+        content {
+          resource_type = resource_limits.value.resource_type
+          minimum       = resource_limits.value.minimum
+          maximum       = resource_limits.value.maximum
+        }
+      }
+    }
+  }
+
+  timeouts {
+    create = "45m"
+    update = "30m"
+    delete = "45m"
+  }
+}
+
+resource "google_container_node_pool" "cpu" {
+  name     = "${var.cluster_name}-cpu"
+  project  = var.project_id
+  cluster  = google_container_cluster.this.name
+  location = local.cluster_location
+
+  node_locations     = local.pool_zones
+  initial_node_count = var.cpu_pool.initial_nodes
+
+  autoscaling {
+    min_node_count = var.cpu_pool.min_nodes
+    max_node_count = var.cpu_pool.max_nodes
+  }
+
+  node_config {
+    machine_type = var.cpu_pool.machine_type
+    disk_size_gb = var.cpu_pool.disk_size_gb
+    disk_type    = var.cpu_pool.disk_type
+    spot         = var.cpu_pool.spot
+    labels       = var.cpu_pool.labels
+
+    oauth_scopes = local.node_oauth_scopes
+
+    workload_metadata_config {
+      mode = "GKE_METADATA"
+    }
+  }
+
+  timeouts {
+    create = "30m"
+    update = "20m"
+  }
+}

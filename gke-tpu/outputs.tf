@@ -1,0 +1,82 @@
+# Output surface: cluster facts + derived per-slice topology facts.
+
+output "cluster_name" {
+  description = "Name of the created GKE cluster."
+  value       = google_container_cluster.this.name
+}
+
+output "cluster_location" {
+  description = "Location (zone or region) of the cluster."
+  value       = google_container_cluster.this.location
+}
+
+output "cluster_endpoint" {
+  description = "Cluster API endpoint."
+  value       = google_container_cluster.this.endpoint
+  sensitive   = true
+}
+
+output "cluster_ca_certificate" {
+  description = "Base64-encoded public CA certificate of the cluster."
+  value       = google_container_cluster.this.master_auth[0].cluster_ca_certificate
+  sensitive   = true
+}
+
+output "project_id" {
+  description = "Project the cluster runs in."
+  value       = var.project_id
+}
+
+output "region" {
+  description = "Region of the cluster network."
+  value       = var.region
+}
+
+output "network_name" {
+  description = "VPC network the cluster is attached to."
+  value       = local.network_name
+}
+
+output "subnetwork_name" {
+  description = "Subnetwork the cluster is attached to."
+  value       = local.subnetwork_name
+}
+
+output "tpu_slices" {
+  description = "Derived facts per TPU slice: machine type, hosts, chips per host, total chips, topology, multi-host flag, and node-selector labels."
+  value = {
+    for name, s in local.tpu_slice : name => {
+      node_pool      = local.tpu_enabled ? google_container_node_pool.tpu_slice[name].name : null
+      machine_type   = s.machine_type
+      topology       = s.topology
+      hosts          = s.hosts
+      chips_per_host = s.chips_per_host
+      total_chips    = s.chips
+      multi_host     = s.multi_host
+      node_selectors = {
+        "cloud.google.com/gke-tpu-accelerator" = s.node_selector
+        "cloud.google.com/gke-tpu-topology"    = s.topology
+      }
+    }
+  }
+}
+
+output "total_tpu_chips" {
+  description = "Total TPU chips across all slices."
+  value       = sum(concat([0], [for s in values(local.tpu_slice) : s.chips]))
+}
+
+output "smoketest_job" {
+  description = "Name of the validation Job (null when disabled); `kubectl logs job/<name> -n <ns>` shows the per-host JSON verdicts."
+  value       = local.smoketest_enabled ? kubernetes_job_v1.tpu_smoketest[0].metadata[0].name : null
+}
+
+output "runtime_namespace" {
+  description = "Namespace of the TPU runtime layer."
+  value       = var.tpu_runtime.namespace
+}
+
+output "latest_version_per_channel" {
+  description = "Latest available GKE master versions, per release channel."
+  value       = data.google_container_engine_versions.channel.release_channel_latest_version
+}

@@ -1,0 +1,25 @@
+# Cluster-auth wiring: kubernetes + helm providers against the cluster
+# created in this same apply (token auth, no local-exec, no kubeconfig
+# mutation — the reference's cleanest of three bootstrap variants, adopted
+# per SURVEY.md §7 / §3.3).
+
+data "google_client_config" "current" {}
+
+locals {
+  cluster_endpoint = "https://${google_container_cluster.this.endpoint}"
+  cluster_ca       = base64decode(google_container_cluster.this.master_auth[0].cluster_ca_certificate)
+}
+
+provider "kubernetes" {
+  host                   = local.cluster_endpoint
+  token                  = data.google_client_config.current.access_token
+  cluster_ca_certificate = local.cluster_ca
+}
+
+provider "helm" {
+  kubernetes {
+    host                   = local.cluster_endpoint
+    token                  = data.google_client_config.current.access_token
+    cluster_ca_certificate = local.cluster_ca
+  }
+}

@@ -1,0 +1,43 @@
+# TPU runtime layer (L4): the TPU-native replacement for the GPU Operator.
+#
+# On GKE TPU node pools the driver-equivalent (libtpu) and the TPU device
+# plugin ship with the node image — there is no NVIDIA-style driver install
+# to orchestrate. What remains, and what this layer installs from the in-repo
+# chart charts/tpu-runtime, is the operational envelope the GPU Operator
+# provided on the GPU side (/root/reference/gke/main.tf:156-213):
+#
+#   - a node health-probe DaemonSet on every TPU host (device enumeration via
+#     libtpu, exported as node conditions for the autoscaler / alerting);
+#   - a priority class + namespace quota so runtime pods schedule ahead of
+#     workloads (mirroring the reference's system-priority quota);
+#   - labels/tolerations wiring for google.com/tpu resources.
+#
+# The chart owns its namespace objects, and the release depends on the slice
+# pools — so destroy unwinds release → pools → cluster without the
+# reference's manual `state rm` step (survey §3.4).
+
+resource "helm_release" "tpu_runtime" {
+  count = local.tpu_enabled && var.tpu_runtime.enabled ? 1 : 0
+
+  name      = "tpu-runtime"
+  chart     = "${path.module}/../charts/tpu-runtime"
+  namespace = var.tpu_runtime.namespace
+
+  create_namespace = true
+  atomic           = true
+  cleanup_on_fail  = true
+  replace          = true
+  timeout          = 900
+
+  set {
+    name  = "image.probe"
+    value = var.tpu_runtime.image
+  }
+
+  set {
+    name  = "tpu.nodeSelectors"
+    value = join(",", distinct([for s in local.tpu_slice : s.node_selector]))
+  }
+
+  depends_on = [google_container_node_pool.tpu_slice]
+}

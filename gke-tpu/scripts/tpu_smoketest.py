@@ -1,0 +1,159 @@
+#!/usr/bin/env python3
+"""Self-contained in-cluster TPU smoke test (single-file Job payload).
+
+This is the deployable bundle of nvidia_terraform_modules_tpu.smoketest: the
+same env contract, JSON-line output, and exit-code semantics, with zero
+package dependencies beyond jax — it is mounted from a ConfigMap into any
+JAX-capable image (see smoketest.tf).
+
+Env contract (injected by the gke-tpu module):
+  TPU_SMOKETEST_EXPECTED_DEVICES  chips the whole slice must expose
+  TPU_SMOKETEST_LEVEL             psum | probes | burnin
+  TPU_SMOKETEST_HOSTS             hosts in the slice (Job completions)
+  TPU_SMOKETEST_COORDINATOR       headless-service DNS of pod 0
+  TPU_SMOKETEST_INIT_TIMEOUT      seconds to wait for the full slice (300)
+  JOB_COMPLETION_INDEX            set by Kubernetes on Indexed Jobs
+
+Prints ONE JSON line; exit 0 iff every check passed. `terraform apply`
+blocks on this via wait_for_completion — apply succeeding IS the test
+passing (north star: BASELINE.json).
+"""
+
+import functools
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    t0 = time.perf_counter()
+    out = {"ok": False}
+
+    level = os.environ.get("TPU_SMOKETEST_LEVEL", "probes")
+    if level not in ("psum", "probes", "burnin"):
+        out["error"] = f"unknown level {level!r}"
+        print(json.dumps(out), flush=True)
+        return 2
+
+    hosts = int(os.environ.get("TPU_SMOKETEST_HOSTS", "1"))
+    idx = int(os.environ.get("JOB_COMPLETION_INDEX", "0"))
+    out.update({"level": level, "process_id": idx, "num_processes": hosts})
+
+    import jax
+
+    if hosts > 1:
+        coord = os.environ["TPU_SMOKETEST_COORDINATOR"]
+        if ":" not in coord:
+            coord = f"{coord}:8476"
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=hosts,
+            process_id=idx,
+            initialization_timeout=int(
+                os.environ.get("TPU_SMOKETEST_INIT_TIMEOUT", "300")),
+        )
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devices = jax.devices()
+    n = len(devices)
+    out["devices"] = n
+    out["device_kind"] = devices[0].device_kind
+
+    expected = os.environ.get("TPU_SMOKETEST_EXPECTED_DEVICES")
+    if expected is not None and int(expected) != n:
+        out["expected_devices"] = int(expected)
+        out["device_count_ok"] = False
+        print(json.dumps(out), flush=True)
+        return 1
+    out["device_count_ok"] = True
+
+    mesh = Mesh(np.asarray(devices), ("x",))
+    shard = functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=(), out_specs=P("x"))
+
+    # Multi-host discipline: inputs are generated INSIDE the sharded
+    # computation (no host→global transfers) and results are verified
+    # through each process's addressable shards only — a jax.Array from a
+    # multi-host mesh spans devices this process cannot fetch.
+    def local_values(arr):
+        shards = sorted(
+            arr.addressable_shards,
+            key=lambda s: s.index[0].start if s.index and s.index[0].start else 0,
+        )
+        return np.concatenate([np.asarray(s.data) for s in shards])
+
+    # 1. the north-star psum: every chip contributes 1, sum must equal n
+    @jax.jit
+    @shard
+    def allreduce():
+        return jax.lax.psum(jnp.ones((1024,), jnp.float32), "x")
+
+    out["psum_ok"] = bool(np.allclose(local_values(allreduce()), float(n)))
+    ok = out["psum_ok"]
+
+    # 2. collective probes over the same ring
+    if level in ("probes", "burnin") and ok and n > 1:
+        @jax.jit
+        @shard
+        def ring_hop():
+            i = jax.lax.axis_index("x").astype(jnp.float32)
+            payload = jnp.full((256,), 0.0, jnp.float32) + i
+            return jax.lax.ppermute(
+                payload, "x", [(j, (j + 1) % n) for j in range(n)])
+
+        hop = local_values(ring_hop()).reshape(-1, 256)
+        # this process's shards hold positions [idx*k, (idx+1)*k) of the ring
+        k = hop.shape[0]
+        mine = (np.arange(idx * k, (idx + 1) * k, dtype=np.float32) - 1) % n
+        out["ring_ok"] = bool(np.allclose(hop, mine[:, None]))
+
+        @jax.jit
+        @shard
+        def gather():
+            i = jax.lax.axis_index("x").astype(jnp.float32)
+            g = jax.lax.all_gather(jnp.full((64,), i, jnp.float32), "x")
+            # every position sees every contribution; re-shard the sum so
+            # out_specs stays P("x")
+            return jnp.sum(g, axis=0)
+
+        g = local_values(gather())
+        expect = sum(range(n))  # 0+1+...+(n-1) at every element
+        out["all_gather_ok"] = bool(np.allclose(g, float(expect)))
+        ok = ok and out["ring_ok"] and out["all_gather_ok"]
+
+    # 3. burn-in: a few bf16 matmul train steps must reduce a quadratic loss
+    if level == "burnin" and ok:
+        key = jax.random.PRNGKey(0)
+        w = jax.random.normal(key, (256, 256), jnp.float32)
+        x = jax.random.normal(jax.random.PRNGKey(1), (1024, 256), jnp.bfloat16)
+
+        def loss_fn(w, x):
+            y = (x @ w.astype(jnp.bfloat16)).astype(jnp.float32)
+            return jnp.mean(jnp.square(y))
+
+        @jax.jit
+        def step(w, x):
+            l, g = jax.value_and_grad(loss_fn)(w, x)
+            return w - 0.05 * g, l
+
+        losses = []
+        for _ in range(5):
+            w, l = step(w, x)
+            losses.append(float(l))
+        out["burnin_first_loss"] = round(losses[0], 5)
+        out["burnin_last_loss"] = round(losses[-1], 5)
+        out["burnin_ok"] = losses[-1] < losses[0]
+        ok = ok and out["burnin_ok"]
+
+    out["ok"] = bool(ok)
+    out["seconds"] = round(time.perf_counter() - t0, 3)
+    print(json.dumps(out), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,160 @@
+# The JAX psum smoke-test Job: `terraform apply` is the integration test.
+#
+# North star (BASELINE.json): after apply, a Job runs jax.devices() and a
+# psum all-reduce over the whole slice, and the apply only succeeds if it
+# passes (wait_for_completion). This replaces the reference's manual
+# runbook validation ("wait ~5 min, kubectl get pods" —
+# /root/reference/gke/README.md:50) with an automated gate, and replaces its
+# plan-time node gate (/root/reference/eks/main.tf:186, a two-phase-apply
+# wart) with real apply-time readiness.
+#
+# Multi-host choreography (no reference precedent): an Indexed Job with
+# completions = hosts-per-slice, one pod per TPU host; a headless Service
+# gives pod 0 a stable DNS name that every pod uses as the
+# jax.distributed.initialize coordinator; the TPU node selectors pin pods to
+# the target slice and `google.com/tpu` requests claim every chip on each
+# host. The pod payload is the single-file bundle of this repo's
+# nvidia_terraform_modules_tpu.smoketest (scripts/tpu_smoketest.py), shipped
+# via ConfigMap so any JAX-capable image works unmodified.
+
+locals {
+  smoketest_enabled = local.tpu_enabled && var.smoketest.enabled
+  smoke_slice       = local.smoketest_enabled ? local.tpu_slice[var.smoketest.target_slice] : null
+  smoke_ns          = var.tpu_runtime.namespace
+  smoke_name        = "${var.cluster_name}-tpu-smoketest"
+}
+
+resource "kubernetes_config_map_v1" "smoketest_script" {
+  count = local.smoketest_enabled ? 1 : 0
+
+  metadata {
+    name      = "${local.smoke_name}-script"
+    namespace = local.smoke_ns
+  }
+
+  data = {
+    "tpu_smoketest.py" = file("${path.module}/scripts/tpu_smoketest.py")
+  }
+
+  depends_on = [helm_release.tpu_runtime]
+}
+
+resource "kubernetes_service_v1" "smoketest_coordinator" {
+  count = local.smoketest_enabled ? 1 : 0
+
+  metadata {
+    name      = local.smoke_name
+    namespace = local.smoke_ns
+  }
+
+  spec {
+    cluster_ip = "None" # headless: stable per-pod DNS for the coordinator
+    selector = {
+      "job-name" = local.smoke_name
+    }
+    port {
+      name = "coordinator"
+      port = 8476
+    }
+  }
+
+  depends_on = [helm_release.tpu_runtime]
+}
+
+resource "kubernetes_job_v1" "tpu_smoketest" {
+  count = local.smoketest_enabled ? 1 : 0
+
+  metadata {
+    name      = local.smoke_name
+    namespace = local.smoke_ns
+    labels = {
+      "app.kubernetes.io/part-of" = "tpu-terraform-modules"
+    }
+  }
+
+  spec {
+    completions     = local.smoke_slice.hosts
+    parallelism     = local.smoke_slice.hosts
+    completion_mode = "Indexed"
+    backoff_limit   = 2
+
+    template {
+      metadata {
+        labels = {
+          "job-name" = local.smoke_name
+        }
+      }
+
+      spec {
+        subdomain      = local.smoke_name
+        restart_policy = "Never"
+
+        node_selector = {
+          "cloud.google.com/gke-tpu-accelerator" = local.smoke_slice.node_selector
+          "cloud.google.com/gke-tpu-topology"    = local.smoke_slice.topology
+        }
+
+        toleration {
+          key      = "google.com/tpu"
+          operator = "Exists"
+          effect   = "NoSchedule"
+        }
+
+        container {
+          name    = "smoketest"
+          image   = var.tpu_runtime.jax_image
+          command = ["python", "/opt/smoketest/tpu_smoketest.py"]
+
+          env {
+            name  = "TPU_SMOKETEST_EXPECTED_DEVICES"
+            value = tostring(local.smoke_slice.chips)
+          }
+          env {
+            name  = "TPU_SMOKETEST_LEVEL"
+            value = var.smoketest.level
+          }
+          env {
+            name  = "TPU_SMOKETEST_HOSTS"
+            value = tostring(local.smoke_slice.hosts)
+          }
+          env {
+            name  = "TPU_SMOKETEST_COORDINATOR"
+            value = "${local.smoke_name}-0.${local.smoke_name}.${local.smoke_ns}.svc"
+          }
+
+          resources {
+            requests = {
+              "google.com/tpu" = local.smoke_slice.chips_per_host
+            }
+            limits = {
+              "google.com/tpu" = local.smoke_slice.chips_per_host
+            }
+          }
+
+          volume_mount {
+            name       = "script"
+            mount_path = "/opt/smoketest"
+          }
+        }
+
+        volume {
+          name = "script"
+          config_map {
+            name = kubernetes_config_map_v1.smoketest_script[0].metadata[0].name
+          }
+        }
+      }
+    }
+  }
+
+  wait_for_completion = true
+
+  timeouts {
+    create = "${var.smoketest.timeout_seconds}s"
+  }
+
+  depends_on = [
+    google_container_node_pool.tpu_slice,
+    kubernetes_service_v1.smoketest_coordinator,
+  ]
+}

@@ -1,0 +1,176 @@
+# TPU slice node pools — the heart of the module.
+#
+# TPU-native accelerator provisioning has no reference precedent: where a GPU
+# pool attaches N accelerators to an arbitrary machine type
+# (/root/reference/gke/main.tf:106-151), a TPU slice IS the machine. The
+# (version, topology) pair determines the machine type, the number of VM
+# hosts, the chips per host, and — for multi-host slices — the COMPACT
+# placement policy that guarantees the hosts sit on one ICI mesh. Everything
+# below derives from the per-generation table in `local.tpu_generations`.
+
+locals {
+  tpu_enabled = var.accelerator_type == "tpu"
+
+  # per-generation facts:
+  #   node_selector — value of cloud.google.com/gke-tpu-accelerator
+  #   machine       — machine-type prefix; suffix is "<chips_per_host>t"
+  #   chips_per_host— fixed for v4/v5p; v5e/v6e single-host pools may pack
+  #                   1, 4 or 8 chips on one host
+  tpu_generations = {
+    v4 = {
+      node_selector  = "tpu-v4-podslice"
+      machine        = "ct4p-hightpu"
+      chips_per_host = 4
+    }
+    v5e = {
+      node_selector  = "tpu-v5-lite-podslice"
+      machine        = "ct5lp-hightpu"
+      chips_per_host = 4
+    }
+    v5p = {
+      node_selector  = "tpu-v5p-slice"
+      machine        = "ct5p-hightpu"
+      chips_per_host = 4
+    }
+    v6e = {
+      node_selector  = "tpu-v6e-slice"
+      machine        = "ct6e-standard"
+      chips_per_host = 4
+    }
+  }
+
+  # Derivation happens in stages (HCL has no let-bindings inside a
+  # for-expression): chip product first, then chips-per-host, then the full
+  # per-slice fact table consumed by the node pool, Job, and outputs.
+  tpu_chip_count = {
+    for name, s in var.tpu_slices :
+    name => length(split("x", s.topology)) == 2
+    ? tonumber(split("x", s.topology)[0]) * tonumber(split("x", s.topology)[1])
+    : tonumber(split("x", s.topology)[0]) * tonumber(split("x", s.topology)[1]) * tonumber(split("x", s.topology)[2])
+  }
+
+  tpu_chips_per_host = {
+    for name, s in var.tpu_slices :
+    name => (
+      contains(["v5e", "v6e"], s.version)
+      ? (
+        local.tpu_chip_count[name] <= 4
+        ? local.tpu_chip_count[name]
+        : (s.prefer_single_host && local.tpu_chip_count[name] == 8 ? 8 : 4)
+      )
+      : local.tpu_generations[s.version].chips_per_host
+    )
+  }
+
+  tpu_slice = {
+    for name, s in var.tpu_slices : name => {
+      version        = s.version
+      topology       = s.topology
+      node_selector  = local.tpu_generations[s.version].node_selector
+      chips          = local.tpu_chip_count[name]
+      chips_per_host = local.tpu_chips_per_host[name]
+      hosts          = max(1, floor(local.tpu_chip_count[name] / local.tpu_chips_per_host[name]))
+      multi_host     = local.tpu_chip_count[name] > local.tpu_chips_per_host[name]
+      machine_type   = "${local.tpu_generations[s.version].machine}-${local.tpu_chips_per_host[name]}t"
+      spot           = s.spot
+      reservation    = s.reservation
+      disk_size_gb   = s.disk_size_gb
+      disk_type      = s.disk_type
+      labels         = s.labels
+    }
+  }
+}
+
+resource "google_container_node_pool" "tpu_slice" {
+  for_each = local.tpu_enabled ? local.tpu_slice : {}
+
+  name     = "${var.cluster_name}-${each.key}"
+  project  = var.project_id
+  cluster  = google_container_cluster.this.name
+  location = local.cluster_location
+
+  # a multi-host slice is one atomic unit: exactly `hosts` nodes, scheduled
+  # together on one ICI mesh — no per-node autoscaling
+  node_count = each.value.hosts
+
+  dynamic "placement_policy" {
+    for_each = each.value.multi_host ? [each.value.topology] : []
+    content {
+      type         = "COMPACT"
+      tpu_topology = placement_policy.value
+    }
+  }
+
+  node_config {
+    machine_type = each.value.machine_type
+    disk_size_gb = each.value.disk_size_gb
+    disk_type    = each.value.disk_type
+    spot         = each.value.spot
+
+    labels = merge(each.value.labels, {
+      "tpu-slice"   = each.key
+      "tpu-version" = each.value.version
+    })
+
+    dynamic "reservation_affinity" {
+      for_each = each.value.reservation != null ? [each.value.reservation] : []
+      content {
+        consume_reservation_type = "SPECIFIC_RESERVATION"
+        key                      = "compute.googleapis.com/reservation-name"
+        values                   = [reservation_affinity.value]
+      }
+    }
+
+    oauth_scopes = local.node_oauth_scopes
+
+    workload_metadata_config {
+      mode = "GKE_METADATA"
+    }
+  }
+
+  timeouts {
+    create = "45m"
+    update = "30m"
+  }
+}
+
+# GPU passthrough pool (accelerator_type = "gpu"): capability parity with the
+# gke/ module so one module call can serve mixed fleets.
+resource "google_container_node_pool" "gpu" {
+  count = var.accelerator_type == "gpu" ? 1 : 0
+
+  name     = "${var.cluster_name}-gpu"
+  project  = var.project_id
+  cluster  = google_container_cluster.this.name
+  location = local.cluster_location
+
+  node_locations     = local.pool_zones
+  initial_node_count = var.gpu_pool.initial_nodes
+
+  autoscaling {
+    min_node_count = var.gpu_pool.min_nodes
+    max_node_count = var.gpu_pool.max_nodes
+  }
+
+  node_config {
+    machine_type = var.gpu_pool.machine_type
+    disk_size_gb = var.gpu_pool.disk_size_gb
+    spot         = var.gpu_pool.spot
+
+    guest_accelerator {
+      type  = var.gpu_pool.gpu_type
+      count = var.gpu_pool.gpu_count
+    }
+
+    oauth_scopes = local.node_oauth_scopes
+
+    workload_metadata_config {
+      mode = "GKE_METADATA"
+    }
+  }
+
+  timeouts {
+    create = "30m"
+    update = "20m"
+  }
+}

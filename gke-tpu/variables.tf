@@ -1,0 +1,212 @@
+# Input surface of the flagship TPU GKE module.
+#
+# Same module shape as gke/ (variables-as-API), with the accelerator layer
+# re-thought for TPUs: instead of the reference's guest_accelerator
+# (gpu_type, gpu_count — /root/reference/gke/variables.tf:83-110), a TPU
+# slice is declared by (tpu generation, ICI topology) and the module derives
+# machine type, hosts-per-slice, and placement. accelerator_type switches the
+# whole accelerator layer between "tpu" and "gpu" (BASELINE.json north star).
+
+variable "project_id" {
+  description = "GCP project to deploy into."
+  type        = string
+}
+
+variable "cluster_name" {
+  description = "Name of the GKE cluster (also prefixes network resources)."
+  type        = string
+  default     = "tpu-cluster"
+}
+
+variable "region" {
+  description = "Region for the cluster and its network. TPU capacity is region/zone constrained (e.g. v5e in us-east5, us-west4; v4 in us-central2)."
+  type        = string
+  default     = "us-east5"
+}
+
+variable "node_zones" {
+  description = "Zones for node placement. Exactly one zone produces a zonal cluster; multi-host TPU slices must sit entirely in one zone."
+  type        = list(string)
+  default     = ["us-east5-b"]
+
+  validation {
+    condition     = length(var.node_zones) > 0
+    error_message = "At least one node zone is required."
+  }
+}
+
+variable "release_channel" {
+  description = "GKE release channel. TPU v5e/v6e need recent minors; RAPID recommended for newest TPU generations."
+  type        = string
+  default     = "RAPID"
+}
+
+variable "deletion_protection" {
+  description = "Protect the cluster from accidental terraform destroy."
+  type        = bool
+  default     = false
+}
+
+variable "accelerator_type" {
+  description = "Which accelerator layer to provision: \"tpu\" (tpu_slices) or \"gpu\" (gpu_pool passthrough parity with the gke/ module)."
+  type        = string
+  default     = "tpu"
+
+  validation {
+    condition     = contains(["tpu", "gpu"], var.accelerator_type)
+    error_message = "accelerator_type must be \"tpu\" or \"gpu\"."
+  }
+}
+
+# ---------------------------------------------------------------- network
+
+variable "network" {
+  description = "Network configuration: create a dedicated VPC + subnet, or attach to an existing pair."
+  type = object({
+    create              = optional(bool, true)
+    subnet_cidr         = optional(string, "10.160.0.0/20")
+    existing_network    = optional(string)
+    existing_subnetwork = optional(string)
+  })
+  default = {}
+}
+
+# ---------------------------------------------------------------- CPU pool
+
+variable "cpu_pool" {
+  description = "Shape of the general-purpose (CPU) node pool that hosts system pods, coordinators, and the observability stack."
+  type = object({
+    machine_type  = optional(string, "n2-standard-8")
+    min_nodes     = optional(number, 1)
+    max_nodes     = optional(number, 5)
+    initial_nodes = optional(number, 1)
+    disk_size_gb  = optional(number, 100)
+    disk_type     = optional(string, "pd-balanced")
+    spot          = optional(bool, false)
+    labels        = optional(map(string), {})
+  })
+  default = {}
+}
+
+# --------------------------------------------------------------- TPU slices
+
+variable "tpu_slices" {
+  description = <<-EOT
+    TPU slices to provision, one node pool per slice (multi-slice training
+    declares several entries; inter-slice traffic rides DCN, intra-slice ICI).
+    For each slice the module derives machine type, hosts-per-slice and chip
+    counts from (version, topology):
+
+      version  — "v4" | "v5e" | "v5p" | "v6e"
+      topology — ICI mesh, e.g. "1x1" (v5e-1), "2x4" (v5e-8),
+                 "2x2x4" (v4-32), "4x4" (v6e-16)
+
+    prefer_single_host packs an 8-chip v5e/v6e topology onto one
+    ct5lp-hightpu-8t host instead of 2×4t (no ICI placement policy needed);
+    leave false to exercise the multi-host path.
+    spot and reservation select the capacity type (mutually exclusive).
+  EOT
+  type = map(object({
+    version            = optional(string, "v5e")
+    topology           = optional(string, "2x4")
+    prefer_single_host = optional(bool, false)
+    spot               = optional(bool, false)
+    reservation        = optional(string)
+    disk_size_gb       = optional(number, 100)
+    disk_type          = optional(string, "pd-balanced")
+    labels             = optional(map(string), {})
+  }))
+  default = {
+    default = {}
+  }
+
+  validation {
+    condition = alltrue([
+      for s in values(var.tpu_slices) :
+      contains(["v4", "v5e", "v5p", "v6e"], s.version)
+    ])
+    error_message = "tpu_slices[*].version must be one of v4, v5e, v5p, v6e."
+  }
+
+  validation {
+    condition = alltrue([
+      for s in values(var.tpu_slices) :
+      can(regex("^\\d+x\\d+(x\\d+)?$", s.topology))
+    ])
+    error_message = "tpu_slices[*].topology must look like \"2x4\" or \"2x2x4\"."
+  }
+}
+
+# ------------------------------------------------- GPU passthrough (parity)
+
+variable "gpu_pool" {
+  description = "GPU pool used when accelerator_type = \"gpu\" (parity with the gke/ module's accelerator pool)."
+  type = object({
+    machine_type  = optional(string, "n1-standard-8")
+    gpu_type      = optional(string, "nvidia-tesla-v100")
+    gpu_count     = optional(number, 1)
+    min_nodes     = optional(number, 1)
+    max_nodes     = optional(number, 5)
+    initial_nodes = optional(number, 2)
+    disk_size_gb  = optional(number, 512)
+    spot          = optional(bool, false)
+  })
+  default = {}
+}
+
+# ------------------------------------------------------------- NAP (config 5)
+
+variable "node_auto_provisioning" {
+  description = <<-EOT
+    GKE node-auto-provisioning for elastic TPU capacity (BASELINE config 5:
+    v4 pod slice with NAP + preemptible). resource_limits entries are passed
+    through to cluster_autoscaling (e.g. resource_type "tpu-v4-podslice-chips").
+  EOT
+  type = object({
+    enabled = optional(bool, false)
+    resource_limits = optional(list(object({
+      resource_type = string
+      minimum       = optional(number, 0)
+      maximum       = number
+    })), [])
+  })
+  default = {}
+}
+
+# ------------------------------------------------------------ runtime layer
+
+variable "tpu_runtime" {
+  description = <<-EOT
+    The JAX/XLA runtime layer installed via Helm — the TPU-native replacement
+    for the reference's NVIDIA GPU Operator (driver/toolkit DaemonSets).
+    GKE TPU nodes already ship libtpu + device plugin; this layer adds the
+    node health-probe DaemonSet, priority class, and namespace quota from the
+    in-repo chart charts/tpu-runtime.
+  EOT
+  type = object({
+    enabled   = optional(bool, true)
+    namespace = optional(string, "tpu-runtime")
+    image     = optional(string, "python:3.12-slim")
+    jax_image = optional(string, "us-docker.pkg.dev/cloud-tpu-images/jax-stable-stack/tpu:latest")
+  })
+  default = {}
+}
+
+# ---------------------------------------------------------------- smoke test
+
+variable "smoketest" {
+  description = <<-EOT
+    In-cluster JAX psum validation Job (north star: terraform apply itself
+    proves the slice runs collectives). Runs one pod per slice host as an
+    indexed Job with a headless service for jax.distributed bootstrap;
+    wait_for_completion makes apply block on the result. target_slice names
+    the tpu_slices key to validate. Levels: psum | probes | burnin.
+  EOT
+  type = object({
+    enabled         = optional(bool, true)
+    target_slice    = optional(string, "default")
+    level           = optional(string, "probes")
+    timeout_seconds = optional(number, 1200)
+  })
+  default = {}
+}

@@ -1,0 +1,27 @@
+# Toolchain and provider pins for the TPU GKE module.
+#
+# TPU node pools, placement policies, and the TPU device plugin need current
+# google provider majors; terraform >= 1.5 for optional() object attributes.
+
+terraform {
+  required_version = ">= 1.5.0"
+
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = "~> 6.8"
+    }
+    google-beta = {
+      source  = "hashicorp/google-beta"
+      version = "~> 6.8"
+    }
+    kubernetes = {
+      source  = "hashicorp/kubernetes"
+      version = "~> 2.32"
+    }
+    helm = {
+      source  = "hashicorp/helm"
+      version = "~> 2.15"
+    }
+  }
+}

@@ -1,0 +1,60 @@
+# GKE control plane (L2) and version discovery.
+#
+# Capability parity with google_container_cluster.holoscan
+# (/root/reference/gke/main.tf:31-56): zonal-vs-regional placement from the
+# zone list, default node pool removed in favour of explicitly managed pools,
+# Workload Identity enabled, release-channel driven versioning plus a
+# latest-version data probe surfaced through outputs.
+
+data "google_project" "this" {
+  project_id = var.project_id
+}
+
+data "google_container_engine_versions" "channel" {
+  provider = google-beta
+
+  project  = var.project_id
+  location = local.cluster_location
+}
+
+locals {
+  # one zone → zonal cluster pinned to it; several → regional cluster
+  zonal            = length(var.node_zones) == 1
+  cluster_location = local.zonal ? one(var.node_zones) : var.region
+  pool_zones       = local.zonal ? null : var.node_zones
+}
+
+resource "google_container_cluster" "this" {
+  name     = var.cluster_name
+  project  = var.project_id
+  location = local.cluster_location
+
+  network    = local.network_name
+  subnetwork = local.subnetwork_name
+
+  # pools are managed as first-class resources below; the implicit default
+  # pool is created only to be removed
+  remove_default_node_pool = true
+  initial_node_count       = 1
+
+  deletion_protection = var.deletion_protection
+
+  dynamic "release_channel" {
+    for_each = var.release_channel == "UNSPECIFIED" ? [] : [var.release_channel]
+    content {
+      channel = release_channel.value
+    }
+  }
+
+  min_master_version = var.release_channel == "UNSPECIFIED" ? var.min_master_version : null
+
+  workload_identity_config {
+    workload_pool = "${var.project_id}.svc.id.goog"
+  }
+
+  timeouts {
+    create = "45m"
+    update = "30m"
+    delete = "45m"
+  }
+}

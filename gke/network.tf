@@ -1,0 +1,32 @@
+# Optional dedicated VPC + subnet (L1 in the survey layer map).
+#
+# Capability parity: reference creates holoscan-vpc / holoscan-subnet gated on
+# vpc_enabled (/root/reference/gke/main.tf:7-24). Here the toggle and the
+# bring-your-own names live in one object variable, and the derived
+# network/subnetwork selection is a local so the cluster resource reads one
+# expression instead of repeating the conditional.
+
+locals {
+  create_vpc      = var.network.create
+  network_name    = local.create_vpc ? google_compute_network.vpc[0].name : var.network.existing_network
+  subnetwork_name = local.create_vpc ? google_compute_subnetwork.cluster[0].name : var.network.existing_subnetwork
+}
+
+resource "google_compute_network" "vpc" {
+  count = local.create_vpc ? 1 : 0
+
+  name                    = "${var.cluster_name}-net"
+  project                 = var.project_id
+  auto_create_subnetworks = false
+}
+
+resource "google_compute_subnetwork" "cluster" {
+  count = local.create_vpc ? 1 : 0
+
+  name                     = "${var.cluster_name}-subnet"
+  project                  = var.project_id
+  region                   = var.region
+  network                  = google_compute_network.vpc[0].id
+  ip_cidr_range            = var.network.subnet_cidr
+  private_ip_google_access = true
+}

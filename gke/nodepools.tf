@@ -1,0 +1,93 @@
+# Node pools (L3): one general-purpose CPU pool, one GPU pool.
+#
+# Capability parity with google_container_node_pool.cpu_nodes / gpu_nodes
+# (/root/reference/gke/main.tf:60-151): autoscaling bounds, disk shaping,
+# spot capacity, logging/monitoring scopes, GKE_METADATA workload metadata,
+# and guest_accelerator on the GPU pool. Shared config is factored into a
+# local instead of being duplicated across the two pools.
+
+locals {
+  node_oauth_scopes = [
+    "https://www.googleapis.com/auth/logging.write",
+    "https://www.googleapis.com/auth/monitoring",
+    "https://www.googleapis.com/auth/devstorage.read_only",
+  ]
+}
+
+resource "google_container_node_pool" "cpu" {
+  name     = "${var.cluster_name}-cpu"
+  project  = var.project_id
+  cluster  = google_container_cluster.this.name
+  location = local.cluster_location
+
+  node_locations     = local.pool_zones
+  initial_node_count = var.cpu_pool.initial_nodes
+
+  autoscaling {
+    min_node_count = var.cpu_pool.min_nodes
+    max_node_count = var.cpu_pool.max_nodes
+  }
+
+  node_config {
+    machine_type = var.cpu_pool.machine_type
+    disk_size_gb = var.cpu_pool.disk_size_gb
+    disk_type    = var.cpu_pool.disk_type
+    image_type   = var.cpu_pool.image_type
+    spot         = var.cpu_pool.spot
+    labels       = var.cpu_pool.labels
+
+    oauth_scopes = local.node_oauth_scopes
+
+    workload_metadata_config {
+      mode = "GKE_METADATA"
+    }
+  }
+
+  timeouts {
+    create = "30m"
+    update = "20m"
+  }
+}
+
+resource "google_container_node_pool" "gpu" {
+  count = var.gpu_pool.enabled ? 1 : 0
+
+  name     = "${var.cluster_name}-gpu"
+  project  = var.project_id
+  cluster  = google_container_cluster.this.name
+  location = local.cluster_location
+
+  node_locations     = local.pool_zones
+  initial_node_count = var.gpu_pool.initial_nodes
+
+  autoscaling {
+    min_node_count = var.gpu_pool.min_nodes
+    max_node_count = var.gpu_pool.max_nodes
+  }
+
+  node_config {
+    machine_type = var.gpu_pool.machine_type
+    disk_size_gb = var.gpu_pool.disk_size_gb
+    disk_type    = var.gpu_pool.disk_type
+    image_type   = var.gpu_pool.image_type
+    spot         = var.gpu_pool.spot
+
+    labels = merge(var.gpu_pool.labels, { "accelerator" = var.gpu_pool.gpu_type })
+
+    guest_accelerator {
+      type  = var.gpu_pool.gpu_type
+      count = var.gpu_pool.gpu_count
+    }
+
+    oauth_scopes = local.node_oauth_scopes
+
+    workload_metadata_config {
+      mode = "GKE_METADATA"
+    }
+  }
+
+  timeouts {
+    create = "30m"
+    update = "20m"
+  }
+}

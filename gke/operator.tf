@@ -1,0 +1,83 @@
+# Accelerator enablement (L4): NVIDIA GPU Operator via Helm.
+#
+# Capability parity with /root/reference/gke/main.tf:156-213: dedicated
+# namespace, the GKE-required pods quota scoped to system priority classes
+# (operator pods schedule at system priority; without the quota GKE rejects
+# them), and an atomic/self-healing helm_release pinned to chart + driver
+# versions.
+#
+# Teardown wart designed out (survey §3.4): the reference requires a manual
+# `terraform state rm` of the namespace before destroy because the namespace
+# outlives its ability to be deleted. Here the namespace depends on the GPU
+# pool, and the helm release depends on namespace + quota + pool, so destroy
+# order is release → quota/namespace → pool → cluster while the API server
+# and nodes still exist.
+
+resource "kubernetes_namespace_v1" "gpu_operator" {
+  count = local.operator_enabled ? 1 : 0
+
+  metadata {
+    name = var.gpu_operator.namespace
+
+    labels = {
+      "app.kubernetes.io/managed-by" = "terraform"
+      "accelerator-stack"            = "nvidia-gpu-operator"
+    }
+  }
+
+  depends_on = [google_container_node_pool.gpu]
+}
+
+resource "kubernetes_resource_quota_v1" "operator_pods" {
+  count = local.operator_enabled ? 1 : 0
+
+  metadata {
+    name      = "gpu-operator-quota"
+    namespace = kubernetes_namespace_v1.gpu_operator[0].metadata[0].name
+  }
+
+  spec {
+    hard = {
+      pods = 100
+    }
+    scope_selector {
+      match_expression {
+        scope_name = "PriorityClass"
+        operator   = "In"
+        values = [
+          "system-node-critical",
+          "system-cluster-critical",
+        ]
+      }
+    }
+  }
+}
+
+locals {
+  operator_enabled = var.gpu_operator.enabled && var.gpu_pool.enabled
+}
+
+resource "helm_release" "gpu_operator" {
+  count = local.operator_enabled ? 1 : 0
+
+  name       = "gpu-operator"
+  repository = "https://helm.ngc.nvidia.com/nvidia"
+  chart      = "gpu-operator"
+  version    = var.gpu_operator.version
+  namespace  = kubernetes_namespace_v1.gpu_operator[0].metadata[0].name
+
+  atomic          = true
+  cleanup_on_fail = true
+  replace         = true
+  timeout         = 1200
+
+  set {
+    name  = "driver.version"
+    value = var.gpu_operator.driver_version
+  }
+
+  depends_on = [
+    google_container_node_pool.gpu,
+    kubernetes_resource_quota_v1.operator_pods,
+  ]
+}

@@ -1,0 +1,57 @@
+# Output surface (composition API for examples/ and sibling modules).
+#
+# Capability parity with the reference's 10 outputs
+# (/root/reference/gke/outputs.tf:8-63): cluster identity/endpoint/CA,
+# network facts, and latest-version probes per channel.
+
+output "cluster_name" {
+  description = "Name of the created GKE cluster."
+  value       = google_container_cluster.this.name
+}
+
+output "cluster_location" {
+  description = "Location (zone or region) of the cluster."
+  value       = google_container_cluster.this.location
+}
+
+output "cluster_endpoint" {
+  description = "Cluster API endpoint."
+  value       = google_container_cluster.this.endpoint
+  sensitive   = true
+}
+
+output "cluster_ca_certificate" {
+  description = "Base64-encoded public CA certificate of the cluster."
+  value       = google_container_cluster.this.master_auth[0].cluster_ca_certificate
+  sensitive   = true
+}
+
+output "project_id" {
+  description = "Project the cluster runs in."
+  value       = var.project_id
+}
+
+output "region" {
+  description = "Region of the cluster network."
+  value       = var.region
+}
+
+output "network_name" {
+  description = "VPC network the cluster is attached to."
+  value       = local.network_name
+}
+
+output "subnetwork_name" {
+  description = "Subnetwork the cluster is attached to."
+  value       = local.subnetwork_name
+}
+
+output "gpu_pool_name" {
+  description = "Name of the GPU node pool (null when disabled)."
+  value       = var.gpu_pool.enabled ? google_container_node_pool.gpu[0].name : null
+}
+
+output "latest_version_per_channel" {
+  description = "Latest available GKE master versions, per release channel."
+  value       = data.google_container_engine_versions.channel.release_channel_latest_version
+}

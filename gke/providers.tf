@@ -1,0 +1,28 @@
+# Cluster-auth wiring (L5): point the kubernetes and helm providers at the
+# cluster created in this same apply.
+#
+# Capability parity with /root/reference/gke/providers.tf:4-20 — the one
+# bootstrap approach of the reference's three that needs no local-exec and no
+# kubeconfig mutation (survey §3.3 discusses why the AKS local-exec variant is
+# worse); adopted here per SURVEY.md §7.
+
+data "google_client_config" "current" {}
+
+locals {
+  cluster_endpoint = "https://${google_container_cluster.this.endpoint}"
+  cluster_ca       = base64decode(google_container_cluster.this.master_auth[0].cluster_ca_certificate)
+}
+
+provider "kubernetes" {
+  host                   = local.cluster_endpoint
+  token                  = data.google_client_config.current.access_token
+  cluster_ca_certificate = local.cluster_ca
+}
+
+provider "helm" {
+  kubernetes {
+    host                   = local.cluster_endpoint
+    token                  = data.google_client_config.current.access_token
+    cluster_ca_certificate = local.cluster_ca
+  }
+}

@@ -1,0 +1,131 @@
+# Input surface of the GPU-parity GKE module.
+#
+# Capability parity with the reference's 24 variables
+# (/root/reference/gke/variables.tf:7-145): project/region/zone selection,
+# bring-your-own network, cluster channel/version, CPU + GPU pool shaping,
+# spot capacity, and GPU Operator pinning — expressed with modern typed
+# objects instead of parallel scalar variables.
+
+variable "project_id" {
+  description = "GCP project to deploy into."
+  type        = string
+}
+
+variable "cluster_name" {
+  description = "Name of the GKE cluster (also used as a prefix for network resources)."
+  type        = string
+  default     = "accel-cluster"
+}
+
+variable "region" {
+  description = "Region for the cluster and its network."
+  type        = string
+  default     = "us-central1"
+}
+
+variable "node_zones" {
+  description = "Zones for node placement. Exactly one zone produces a zonal cluster; multiple zones produce a regional cluster spanning them."
+  type        = list(string)
+  default     = ["us-central1-a"]
+
+  validation {
+    condition     = length(var.node_zones) > 0
+    error_message = "At least one node zone is required."
+  }
+}
+
+variable "release_channel" {
+  description = "GKE release channel (RAPID, REGULAR, STABLE, or UNSPECIFIED to pin min_master_version)."
+  type        = string
+  default     = "REGULAR"
+}
+
+variable "min_master_version" {
+  description = "Minimum master version when release_channel is UNSPECIFIED; ignored otherwise."
+  type        = string
+  default     = null
+}
+
+variable "deletion_protection" {
+  description = "Protect the cluster from accidental terraform destroy."
+  type        = bool
+  default     = false
+}
+
+# ---------------------------------------------------------------- network
+
+variable "network" {
+  description = <<-EOT
+    Network configuration. With create = true a dedicated VPC and subnet are
+    provisioned; with create = false, existing_network / existing_subnetwork
+    must name the network to attach to (bring-your-own, the reference's
+    vpc_enabled / existing_vpc_details toggle).
+  EOT
+  type = object({
+    create              = optional(bool, true)
+    subnet_cidr         = optional(string, "10.150.0.0/20")
+    existing_network    = optional(string)
+    existing_subnetwork = optional(string)
+  })
+  default = {}
+}
+
+# ---------------------------------------------------------------- CPU pool
+
+variable "cpu_pool" {
+  description = "Shape of the general-purpose (CPU) node pool."
+  type = object({
+    machine_type   = optional(string, "n2-standard-8")
+    min_nodes      = optional(number, 1)
+    max_nodes      = optional(number, 5)
+    initial_nodes  = optional(number, 1)
+    disk_size_gb   = optional(number, 100)
+    disk_type      = optional(string, "pd-balanced")
+    image_type     = optional(string, "COS_CONTAINERD")
+    spot           = optional(bool, false)
+    labels         = optional(map(string), {})
+  })
+  default = {}
+}
+
+# ---------------------------------------------------------------- GPU pool
+
+variable "gpu_pool" {
+  description = <<-EOT
+    Shape of the accelerator node pool. gpu_type/gpu_count mirror the
+    reference's guest_accelerator knobs (e.g. nvidia-tesla-v100 x1); set
+    enabled = false for a CPU-only cluster (baseline config 1).
+  EOT
+  type = object({
+    enabled        = optional(bool, true)
+    machine_type   = optional(string, "n1-standard-8")
+    gpu_type       = optional(string, "nvidia-tesla-v100")
+    gpu_count      = optional(number, 1)
+    min_nodes      = optional(number, 1)
+    max_nodes      = optional(number, 5)
+    initial_nodes  = optional(number, 2)
+    disk_size_gb   = optional(number, 512)
+    disk_type      = optional(string, "pd-ssd")
+    image_type     = optional(string, "UBUNTU_CONTAINERD")
+    spot           = optional(bool, false)
+    labels         = optional(map(string), {})
+  })
+  default = {}
+}
+
+# ------------------------------------------------------------ GPU Operator
+
+variable "gpu_operator" {
+  description = <<-EOT
+    NVIDIA GPU Operator install knobs: Helm chart version, driver branch, and
+    target namespace (reference: gpu_operator_version /
+    gpu_operator_driver_version / gpu_operator_namespace).
+  EOT
+  type = object({
+    enabled        = optional(bool, true)
+    version        = optional(string, "v25.3.0")
+    driver_version = optional(string, "570.124.06")
+    namespace      = optional(string, "gpu-operator")
+  })
+  default = {}
+}

@@ -1,0 +1,29 @@
+# Toolchain and provider pins for the GPU-parity GKE module.
+#
+# Capability parity: reference pins google 4.27 / google-beta 4.57 / helm 2.x
+# and terraform >= 0.14 (/root/reference/gke/versions.tf:3-16). We pin the
+# current major lines and a modern terraform floor so `optional()` object
+# attributes and provider-defined functions are available.
+
+terraform {
+  required_version = ">= 1.5.0"
+
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = "~> 6.8"
+    }
+    google-beta = {
+      source  = "hashicorp/google-beta"
+      version = "~> 6.8"
+    }
+    kubernetes = {
+      source  = "hashicorp/kubernetes"
+      version = "~> 2.32"
+    }
+    helm = {
+      source  = "hashicorp/helm"
+      version = "~> 2.15"
+    }
+  }
+}

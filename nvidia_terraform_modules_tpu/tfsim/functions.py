@@ -7,9 +7,11 @@ simulatable subset.
 
 from __future__ import annotations
 
+import base64
 import ipaddress
 import json
 import math
+import os.path
 import re
 from typing import Any
 
@@ -130,6 +132,16 @@ def _fn_regex(pattern: str, s: str):
 
 FUNCTIONS: dict[str, Any] = {
     "abs": abs,
+    "alltrue": lambda l: all(bool(x) for x in l),
+    "anytrue": lambda l: any(bool(x) for x in l),
+    "abspath": os.path.abspath,
+    "basename": os.path.basename,
+    "dirname": os.path.dirname,
+    "file": lambda p: open(p).read(),
+    "fileexists": os.path.isfile,
+    "filebase64": lambda p: base64.b64encode(open(p, "rb").read()).decode(),
+    "base64decode": lambda s: base64.b64decode(s).decode(),
+    "base64encode": lambda s: base64.b64encode(str(s).encode()).decode(),
     "can": lambda v: True,          # refined by evaluator (lazy)
     "ceil": math.ceil,
     "floor": math.floor,

@@ -21,6 +21,7 @@ class Variable:
     validations: list[A.Block]
     file: str
     line: int
+    type_expr: Optional[A.Expr] = None  # raw type AST (for optional() defaults)
 
 
 @dataclasses.dataclass
@@ -176,6 +177,7 @@ def _ingest(mod: Module, blk: A.Block, fname: str) -> None:
         if name in mod.variables:
             dup("variable", name)
         d = blk.body.attr("default")
+        t = blk.body.attr("type")
         mod.variables[name] = Variable(
             name=name,
             type=_type_expr_str(blk.body),
@@ -185,6 +187,7 @@ def _ingest(mod: Module, blk: A.Block, fname: str) -> None:
             nullable=_bool_attr(blk.body, "nullable", default=True),
             validations=blk.body.blocks_of("validation"),
             file=fname, line=blk.line,
+            type_expr=t.expr if t else None,
         )
     elif blk.type == "locals":
         for attr in blk.body.attributes:

@@ -103,9 +103,22 @@ class Parser:
     def parse_expr(self) -> A.Expr:
         return self.parse_conditional()
 
+    def _at_op_through_newlines(self, value: str) -> bool:
+        """True if the next non-newline token is OP(value); consumes the
+        newlines when it is. Safe for '?'/':' — no body item or collection
+        element can begin with them."""
+        off = 0
+        while self.peek(off).kind == "NEWLINE":
+            off += 1
+        t = self.peek(off)
+        if t.kind == "OP" and t.value == value:
+            self.skip_newlines()
+            return True
+        return False
+
     def parse_conditional(self) -> A.Expr:
         cond = self.parse_binary(0)
-        if self.eat_op("?"):
+        if self._at_op_through_newlines("?") and self.eat_op("?"):
             self.skip_newlines()
             t = self.parse_expr()
             self.skip_newlines()

@@ -93,14 +93,17 @@ _META_ATTRS = {"count", "for_each", "depends_on", "provider"}
 _META_BLOCKS = {"lifecycle"}
 
 
-def _eval_body(body: A.Body, scope: Scope) -> ResourceAttrs:
+def _eval_body(body: A.Body, scope: Scope, top_level: bool = False) -> ResourceAttrs:
     out = ResourceAttrs()
     for attr in body.attributes:
-        if attr.name in _META_ATTRS:
+        # count/for_each/etc are resource meta-arguments only at the top level;
+        # a nested block may legitimately have an attribute named "count"
+        # (e.g. guest_accelerator { count = 2 })
+        if top_level and attr.name in _META_ATTRS:
             continue
         out[attr.name] = evaluate(attr.expr, scope)
     for blk in body.blocks:
-        if blk.type in _META_BLOCKS:
+        if top_level and blk.type in _META_BLOCKS:
             continue
         if blk.type == "dynamic" and blk.labels:
             name = blk.labels[0]
@@ -123,7 +126,7 @@ def _eval_body(body: A.Body, scope: Scope) -> ResourceAttrs:
             for k, v in items:
                 sub = scope.child_bindings(**{iterator: {"key": k, "value": v}})
                 for c in content_blocks:
-                    out.setdefault(name, []).append(_eval_body(c, sub))
+                    out.setdefault(name, []).append(_eval_body(c.body, sub))
         else:
             out.setdefault(blk.type, []).append(_eval_body(blk.body, scope))
     return out
@@ -155,10 +158,33 @@ def simulate_plan(
             variables[name] = evaluate(var.default, base_scope)
         else:
             raise PlanError(f"required variable {name!r} not set")
+        variables[name] = _apply_type_defaults(
+            variables[name], var.type_expr, base_scope)
     if tfvars:
         raise PlanError(f"unknown tfvars: {sorted(tfvars)}")
 
     scope = Scope(variables=variables, path_module=module.path)
+
+    # variable validation blocks (condition + error_message)
+    for name, var in module.variables.items():
+        for vblock in var.validations:
+            cond_attr = vblock.body.attr("condition")
+            if cond_attr is None:
+                continue
+            try:
+                ok_v = evaluate(cond_attr.expr, scope)
+            except EvalError:
+                continue
+            if ok_v is COMPUTED or ok_v:
+                continue
+            msg_attr = vblock.body.attr("error_message")
+            msg = ""
+            try:
+                if msg_attr is not None:
+                    msg = evaluate(msg_attr.expr, scope)
+            except EvalError:
+                pass
+            raise PlanError(f"variable {name!r} validation failed: {msg}")
 
     # 2. locals (fixed-point: locals may reference locals) --------------
     pending = dict(module.locals)
@@ -226,6 +252,53 @@ def simulate_plan(
     )
 
 
+def _apply_type_defaults(value: Any, type_expr, scope: Scope) -> Any:
+    """Fill ``optional(T, default)`` object attributes, Terraform-style.
+
+    ``variable "x" { type = object({ a = optional(bool, true) }) }`` with
+    ``x = {}`` must evaluate ``var.x.a`` to ``true``. Handles nested objects
+    and ``list(object)`` / ``map(object)`` element types; non-constructor
+    types pass values through untouched.
+    """
+    if type_expr is None or value is None or value is COMPUTED:
+        return value
+    # unwrap optional(T, d) to its inner type
+    if isinstance(type_expr, A.Call) and type_expr.name == "optional" and type_expr.args:
+        return _apply_type_defaults(value, type_expr.args[0], scope)
+    if isinstance(type_expr, A.Call) and type_expr.name == "object" and type_expr.args:
+        spec = type_expr.args[0]
+        if not isinstance(spec, A.ObjectExpr) or not isinstance(value, dict):
+            return value
+        out = dict(value)
+        for item in spec.items:
+            if not isinstance(item.key, A.Literal):
+                continue
+            key = str(item.key.value)
+            t = item.value
+            if out.get(key) is not None:
+                out[key] = _apply_type_defaults(out[key], t, scope)
+            elif isinstance(t, A.Call) and t.name == "optional":
+                # Terraform 1.3+: both a missing attribute AND an explicit
+                # null take the optional() default
+                default = (
+                    evaluate(t.args[1], scope) if len(t.args) > 1 else None
+                )
+                out[key] = _apply_type_defaults(default, t.args[0], scope)
+            elif key in out:
+                out[key] = None  # explicit null on a non-optional attribute
+            else:
+                raise PlanError(f"object value missing required attribute {key!r}")
+        return out
+    if isinstance(type_expr, A.Call) and type_expr.name in ("list", "set") and \
+            type_expr.args and isinstance(value, list):
+        return [_apply_type_defaults(v, type_expr.args[0], scope) for v in value]
+    if isinstance(type_expr, A.Call) and type_expr.name == "map" and \
+            type_expr.args and isinstance(value, dict):
+        return {k: _apply_type_defaults(v, type_expr.args[0], scope)
+                for k, v in value.items()}
+    return value
+
+
 def _plan_resource(addr: str, r: Resource, scope: Scope,
                    instances: dict[str, PlannedInstance]) -> None:
     count_attr = r.body.attr("count")
@@ -245,7 +318,7 @@ def _plan_resource(addr: str, r: Resource, scope: Scope,
             sub = Scope(scope.variables, scope.locals, scope.resources,
                         scope.data, scope.modules, None, i, scope.path_module)
             sub.bindings = dict(scope.bindings)
-            attrs = _eval_body(r.body, sub)
+            attrs = _eval_body(r.body, sub, top_level=True)
             attrs.setdefault("id", COMPUTED)
             inst = PlannedInstance(f"{addr}[{i}]", attrs)
             instances[inst.address] = inst
@@ -265,14 +338,14 @@ def _plan_resource(addr: str, r: Resource, scope: Scope,
                         scope.data, scope.modules,
                         {"key": k, "value": v}, None, scope.path_module)
             sub.bindings = dict(scope.bindings)
-            attrs = _eval_body(r.body, sub)
+            attrs = _eval_body(r.body, sub, top_level=True)
             attrs.setdefault("id", COMPUTED)
             inst = PlannedInstance(f'{addr}["{k}"]', attrs)
             instances[inst.address] = inst
             vals[k] = attrs
         register(vals)
     else:
-        attrs = _eval_body(r.body, scope)
+        attrs = _eval_body(r.body, scope, top_level=True)
         attrs.setdefault("id", COMPUTED)
         inst = PlannedInstance(addr, attrs)
         instances[inst.address] = inst

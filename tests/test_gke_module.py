@@ -1,0 +1,146 @@
+"""Golden-plan tests for the gke/ (GPU-parity) module via tfsim.
+
+The offline analogue of `terraform validate` + plan-fixture testing
+(SURVEY.md §4: the reference has no automated tests; these are ours).
+"""
+
+import os
+
+import pytest
+
+from nvidia_terraform_modules_tpu.tfsim import (
+    load_module,
+    simulate_plan,
+    validate_module,
+)
+from nvidia_terraform_modules_tpu.tfsim.plan import PlanError, render
+
+
+@pytest.fixture(scope="module")
+def gke(repo_root_mod):
+    return load_module(os.path.join(repo_root_mod, "gke"))
+
+
+@pytest.fixture(scope="module")
+def repo_root_mod():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+BASE_VARS = {"project_id": "proj-x", "cluster_name": "demo"}
+
+
+def test_validate_no_errors(gke):
+    findings = validate_module(gke)
+    errors = [f for f in findings if f.severity == "error"]
+    assert errors == [], [str(e) for e in errors]
+
+
+def test_validate_no_warnings(gke):
+    # style gate: every variable/output described & typed, providers pinned
+    findings = validate_module(gke)
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_default_plan_shape(gke):
+    plan = simulate_plan(gke, dict(BASE_VARS))
+    addrs = set(plan.instances)
+    assert "google_compute_network.vpc[0]" in addrs
+    assert "google_compute_subnetwork.cluster[0]" in addrs
+    assert "google_container_cluster.this" in addrs
+    assert "google_container_node_pool.cpu" in addrs
+    assert "google_container_node_pool.gpu[0]" in addrs
+    assert "kubernetes_namespace_v1.gpu_operator[0]" in addrs
+    assert "kubernetes_resource_quota_v1.operator_pods[0]" in addrs
+    assert "helm_release.gpu_operator[0]" in addrs
+
+
+def test_zonal_vs_regional(gke):
+    zonal = simulate_plan(gke, dict(BASE_VARS))
+    assert zonal.instance("google_container_cluster.this").attrs[
+        "location"] == "us-central1-a"
+    regional = simulate_plan(gke, {
+        **BASE_VARS, "node_zones": ["us-central1-a", "us-central1-b"]})
+    assert regional.instance("google_container_cluster.this").attrs[
+        "location"] == "us-central1"
+
+
+def test_cpu_only_baseline_config(gke):
+    """BASELINE config 1: CPU-only pool, operator disabled."""
+    plan = simulate_plan(gke, {
+        **BASE_VARS,
+        "gpu_pool": {"enabled": False},
+    })
+    addrs = set(plan.instances)
+    assert "google_container_node_pool.cpu" in addrs
+    assert not any(a.startswith("google_container_node_pool.gpu") for a in addrs)
+    assert not any(a.startswith("helm_release") for a in addrs)
+    assert not any(a.startswith("kubernetes_namespace") for a in addrs)
+    assert plan.outputs["gpu_pool_name"] is None
+
+
+def test_byo_network(gke):
+    plan = simulate_plan(gke, {
+        **BASE_VARS,
+        "network": {
+            "create": False,
+            "existing_network": "shared-vpc",
+            "existing_subnetwork": "shared-subnet",
+        },
+    })
+    assert not any(a.startswith("google_compute_network") for a in plan.instances)
+    cluster = plan.instance("google_container_cluster.this")
+    assert cluster.attrs["network"] == "shared-vpc"
+    assert cluster.attrs["subnetwork"] == "shared-subnet"
+
+
+def test_gpu_pool_accelerator_config(gke):
+    plan = simulate_plan(gke, {
+        **BASE_VARS,
+        "gpu_pool": {"gpu_type": "nvidia-l4", "gpu_count": 2, "spot": True},
+    })
+    gpu = plan.instance("google_container_node_pool.gpu[0]")
+    acc = gpu.attrs["node_config"][0]["guest_accelerator"][0]
+    assert acc == {"type": "nvidia-l4", "count": 2}
+    assert gpu.attrs["node_config"][0]["spot"] is True
+    # optional() defaults preserved for attrs not overridden
+    assert gpu.attrs["node_config"][0]["machine_type"] == "n1-standard-8"
+
+
+def test_operator_pinning_flows_to_release(gke):
+    plan = simulate_plan(gke, {
+        **BASE_VARS,
+        "gpu_operator": {"version": "v25.3.1", "driver_version": "999.1"},
+    })
+    rel = plan.instance("helm_release.gpu_operator[0]")
+    assert rel.attrs["version"] == "v25.3.1"
+    assert rel.attrs["set"][0] == {"name": "driver.version", "value": "999.1"}
+    assert rel.attrs["atomic"] is True
+    assert rel.attrs["cleanup_on_fail"] is True
+
+
+def test_apply_order_cluster_before_pools_before_operator(gke):
+    plan = simulate_plan(gke, dict(BASE_VARS))
+    o = plan.order
+    assert o.index("google_container_cluster.this") < o.index(
+        "google_container_node_pool.gpu")
+    assert o.index("google_container_node_pool.gpu") < o.index(
+        "kubernetes_namespace_v1.gpu_operator")
+    assert o.index("kubernetes_resource_quota_v1.operator_pods") < o.index(
+        "helm_release.gpu_operator")
+
+
+def test_empty_zones_rejected(gke):
+    with pytest.raises(PlanError) as ei:
+        simulate_plan(gke, {**BASE_VARS, "node_zones": []})
+    assert "node zone" in str(ei.value).lower()
+
+
+def test_release_channel_unspecified_pins_version(gke):
+    plan = simulate_plan(gke, {
+        **BASE_VARS,
+        "release_channel": "UNSPECIFIED",
+        "min_master_version": "1.29.1",
+    })
+    cluster = plan.instance("google_container_cluster.this")
+    assert cluster.attrs["min_master_version"] == "1.29.1"
+    assert "release_channel" not in cluster.attrs  # dynamic block empty

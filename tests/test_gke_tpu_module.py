@@ -1,0 +1,205 @@
+"""Golden-plan tests for the flagship gke-tpu/ module via tfsim.
+
+Locks down the module's core logic — deriving machine type, hosts-per-slice,
+chips-per-host, and placement policy from (tpu generation, ICI topology) —
+across the BASELINE.json target configs.
+"""
+
+import os
+
+import pytest
+
+from nvidia_terraform_modules_tpu.tfsim import (
+    load_module,
+    simulate_plan,
+    validate_module,
+)
+from nvidia_terraform_modules_tpu.tfsim.plan import PlanError
+
+
+@pytest.fixture(scope="module")
+def tpu_mod():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return load_module(os.path.join(root, "gke-tpu"))
+
+
+BASE = {"project_id": "proj-x", "cluster_name": "tpu-demo"}
+
+
+def _slice_output(plan, name="default"):
+    return plan.outputs["tpu_slices"][name]
+
+
+def test_validate_clean(tpu_mod):
+    findings = validate_module(tpu_mod)
+    assert findings == [], [str(f) for f in findings]
+
+
+# ---- topology derivation table (the heart of the module) -----------------
+
+@pytest.mark.parametrize(
+    "version,topology,prefer_single,machine,hosts,chips_per_host,chips,multi",
+    [
+        # BASELINE config 2: single-host v5e-1
+        ("v5e", "1x1", False, "ct5lp-hightpu-1t", 1, 1, 1, False),
+        ("v5e", "2x2", False, "ct5lp-hightpu-4t", 1, 4, 4, False),
+        # BASELINE config 3: multi-host v5e-8
+        ("v5e", "2x4", False, "ct5lp-hightpu-4t", 2, 4, 8, True),
+        # same 8 chips packed on one host when preferred
+        ("v5e", "2x4", True, "ct5lp-hightpu-8t", 1, 8, 8, False),
+        ("v5e", "4x4", False, "ct5lp-hightpu-4t", 4, 4, 16, True),
+        # BASELINE config 5: v4-32 pod slice (16 chips, 4 hosts)
+        ("v4", "2x2x4", False, "ct4p-hightpu-4t", 4, 4, 16, True),
+        ("v4", "2x2x1", False, "ct4p-hightpu-4t", 1, 4, 4, False),
+        ("v5p", "2x2x2", False, "ct5p-hightpu-4t", 2, 4, 8, True),
+        ("v6e", "4x4", False, "ct6e-standard-4t", 4, 4, 16, True),
+        ("v6e", "1x1", False, "ct6e-standard-1t", 1, 1, 1, False),
+    ],
+)
+def test_topology_derivation(tpu_mod, version, topology, prefer_single,
+                             machine, hosts, chips_per_host, chips, multi):
+    plan = simulate_plan(tpu_mod, {
+        **BASE,
+        "tpu_slices": {"default": {
+            "version": version, "topology": topology,
+            "prefer_single_host": prefer_single,
+        }},
+        "smoketest": {"enabled": False},
+    })
+    s = _slice_output(plan)
+    assert s["machine_type"] == machine
+    assert s["hosts"] == hosts
+    assert s["chips_per_host"] == chips_per_host
+    assert s["total_chips"] == chips
+    assert s["multi_host"] == multi
+    pool = plan.instance('google_container_node_pool.tpu_slice["default"]')
+    assert pool.attrs["node_count"] == hosts
+    assert pool.attrs["node_config"][0]["machine_type"] == machine
+    if multi:
+        assert pool.attrs["placement_policy"][0] == {
+            "type": "COMPACT", "tpu_topology": topology}
+    else:
+        assert "placement_policy" not in pool.attrs
+
+
+def test_default_plan_is_v5e8_multihost(tpu_mod):
+    plan = simulate_plan(tpu_mod, dict(BASE))
+    s = _slice_output(plan)
+    assert (s["machine_type"], s["hosts"], s["total_chips"]) == (
+        "ct5lp-hightpu-4t", 2, 8)
+    assert plan.outputs["total_tpu_chips"] == 8
+
+
+def test_smoketest_job_wiring(tpu_mod):
+    """The north-star Job: indexed, one pod per host, full-slice env."""
+    plan = simulate_plan(tpu_mod, dict(BASE))
+    job = plan.instance("kubernetes_job_v1.tpu_smoketest[0]")
+    spec = job.attrs["spec"][0]
+    assert spec["completions"] == 2
+    assert spec["parallelism"] == 2
+    assert spec["completion_mode"] == "Indexed"
+    pod = spec["template"][0]["spec"][0]
+    assert pod["node_selector"][
+        "cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+    assert pod["node_selector"]["cloud.google.com/gke-tpu-topology"] == "2x4"
+    container = pod["container"][0]
+    env = {e["name"]: e["value"] for e in container["env"]}
+    assert env["TPU_SMOKETEST_EXPECTED_DEVICES"] == "8"
+    assert env["TPU_SMOKETEST_HOSTS"] == "2"
+    assert env["TPU_SMOKETEST_COORDINATOR"].startswith(
+        "tpu-demo-tpu-smoketest-0.")
+    assert container["resources"][0]["requests"]["google.com/tpu"] == 4
+    assert job.attrs["wait_for_completion"] is True
+    # headless coordinator service
+    svc = plan.instance("kubernetes_service_v1.smoketest_coordinator[0]")
+    assert svc.attrs["spec"][0]["cluster_ip"] == "None"
+
+
+def test_smoketest_script_shipped_via_configmap(tpu_mod):
+    plan = simulate_plan(tpu_mod, dict(BASE))
+    cm = plan.instance("kubernetes_config_map_v1.smoketest_script[0]")
+    script = cm.attrs["data"]["tpu_smoketest.py"]
+    assert "TPU_SMOKETEST_EXPECTED_DEVICES" in script
+    assert "psum" in script
+
+
+def test_multi_slice_fleet(tpu_mod):
+    plan = simulate_plan(tpu_mod, {
+        **BASE,
+        "tpu_slices": {
+            "train": {"version": "v4", "topology": "2x2x4"},
+            "serve": {"version": "v5e", "topology": "2x2", "spot": True},
+        },
+        "smoketest": {"target_slice": "train"},
+    })
+    assert plan.outputs["total_tpu_chips"] == 20
+    serve = plan.instance('google_container_node_pool.tpu_slice["serve"]')
+    assert serve.attrs["node_config"][0]["spot"] is True
+    job = plan.instance("kubernetes_job_v1.tpu_smoketest[0]")
+    assert job.attrs["spec"][0]["completions"] == 4  # v4-32 hosts
+
+
+def test_gpu_passthrough_mode(tpu_mod):
+    plan = simulate_plan(tpu_mod, {
+        **BASE,
+        "accelerator_type": "gpu",
+        "smoketest": {"enabled": False},
+    })
+    addrs = set(plan.instances)
+    assert "google_container_node_pool.gpu[0]" in addrs
+    assert not any("tpu_slice" in a for a in addrs)
+    assert not any(a.startswith("helm_release") for a in addrs)
+
+
+def test_invalid_accelerator_type_rejected(tpu_mod):
+    with pytest.raises(PlanError):
+        simulate_plan(tpu_mod, {**BASE, "accelerator_type": "qpu"})
+
+
+def test_invalid_topology_rejected(tpu_mod):
+    with pytest.raises(PlanError):
+        simulate_plan(tpu_mod, {
+            **BASE, "tpu_slices": {"default": {"topology": "2by4"}}})
+
+
+def test_reservation_affinity(tpu_mod):
+    plan = simulate_plan(tpu_mod, {
+        **BASE,
+        "tpu_slices": {"default": {"reservation": "my-resv"}},
+        "smoketest": {"enabled": False},
+    })
+    pool = plan.instance('google_container_node_pool.tpu_slice["default"]')
+    ra = pool.attrs["node_config"][0]["reservation_affinity"][0]
+    assert ra["consume_reservation_type"] == "SPECIFIC_RESERVATION"
+    assert ra["values"] == ["my-resv"]
+
+
+def test_nap_config5(tpu_mod):
+    plan = simulate_plan(tpu_mod, {
+        **BASE,
+        "tpu_slices": {"default": {
+            "version": "v4", "topology": "2x2x4", "spot": True}},
+        "node_auto_provisioning": {
+            "enabled": True,
+            "resource_limits": [
+                {"resource_type": "tpu-v4-podslice-chips", "maximum": 64},
+            ],
+        },
+        "smoketest": {"enabled": False},
+    })
+    cluster = plan.instance("google_container_cluster.this")
+    ca = cluster.attrs["cluster_autoscaling"][0]
+    assert ca["enabled"] is True
+    assert ca["resource_limits"][0]["resource_type"] == "tpu-v4-podslice-chips"
+    assert ca["resource_limits"][0]["maximum"] == 64
+
+
+def test_apply_order_pools_before_runtime_before_job(tpu_mod):
+    plan = simulate_plan(tpu_mod, dict(BASE))
+    o = plan.order
+    assert o.index("google_container_node_pool.tpu_slice") < o.index(
+        "helm_release.tpu_runtime")
+    assert o.index("helm_release.tpu_runtime") < o.index(
+        "kubernetes_config_map_v1.smoketest_script")
+    assert o.index("kubernetes_service_v1.smoketest_coordinator") < o.index(
+        "kubernetes_job_v1.tpu_smoketest")

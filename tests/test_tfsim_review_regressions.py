@@ -99,3 +99,20 @@ def test_module_call_foreach(tmp_path):
     assert 'module.slices["big"].null_resource.n' in plan.instances
     assert plan.instances['module.slices["big"].null_resource.n'].attrs[
         "triggers"]["s"] == 8
+
+
+def test_optional_default_applies_to_explicit_null(tmp_path):
+    (tmp_path / "main.tf").write_text('''
+variable "x" {
+  description = "obj"
+  type = object({ a = optional(bool, true) })
+  default = {}
+}
+resource "null_resource" "r" {
+  count = var.x.a ? 1 : 0
+}
+''')
+    on = simulate_plan(str(tmp_path), {"x": {"a": None}})
+    assert "null_resource.r[0]" in on.instances  # null takes the default
+    off = simulate_plan(str(tmp_path), {"x": {"a": False}})
+    assert off.instances == {}
