@@ -39,10 +39,6 @@ resource "google_compute_subnetwork" "cluster" {
   private_ip_google_access = true
 }
 
-data "google_project" "this" {
-  project_id = var.project_id
-}
-
 data "google_container_engine_versions" "channel" {
   provider = google-beta
 

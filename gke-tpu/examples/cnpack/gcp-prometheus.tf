@@ -1,0 +1,41 @@
+# Managed-Prometheus identity for the in-cluster metrics agent.
+#
+# Capability parity with /root/reference/gke/examples/cnpack/gcp-prometheus.tf:7-45:
+# a dedicated GCP service account, a Workload Identity binding from the
+# monitoring namespace's KSA, and roles/monitoring.metricWriter so the agent
+# can remote-write into Google Managed Prometheus. The KSA name matches the
+# tpu-monitoring stack installed by the platform installer.
+
+locals {
+  monitoring_namespace = "tpu-monitoring"
+  monitoring_ksa       = "tpu-prometheus"
+}
+
+resource "random_id" "sa_suffix" {
+  byte_length = 3
+}
+
+resource "google_service_account" "prometheus" {
+  project      = var.project_id
+  account_id   = "tpu-prometheus-${random_id.sa_suffix.hex}"
+  display_name = "Managed Prometheus writer for ${var.cluster_name}"
+}
+
+# let the monitoring KSA impersonate the GSA via Workload Identity
+resource "google_service_account_iam_member" "wi_binding" {
+  service_account_id = google_service_account.prometheus.name
+  role               = "roles/iam.workloadIdentityUser"
+  member             = "serviceAccount:${var.project_id}.svc.id.goog[${local.monitoring_namespace}/${local.monitoring_ksa}]"
+}
+
+resource "google_project_iam_member" "metric_writer" {
+  project = var.project_id
+  role    = "roles/monitoring.metricWriter"
+  member  = "serviceAccount:${google_service_account.prometheus.email}"
+}
+
+resource "google_project_iam_member" "metric_viewer" {
+  project = var.project_id
+  role    = "roles/monitoring.viewer"
+  member  = "serviceAccount:${google_service_account.prometheus.email}"
+}

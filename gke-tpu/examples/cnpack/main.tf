@@ -1,0 +1,68 @@
+# CNPack-style observability composition on a TPU slice (BASELINE config 4).
+#
+# Capability parity with the reference's examples/cnpack compositions
+# (/root/reference/gke/examples/cnpack/main.tf:7-13): a root module that wraps
+# the cloud module and adds managed observability, emitting outputs to paste
+# into the platform config. TPU twist: the monitoring identity is wired for
+# GKE's TPU metrics (duty cycle, HBM usage, uptime) alongside the workload
+# metrics a Prometheus agent scrapes.
+
+terraform {
+  required_version = ">= 1.5.0"
+
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = "~> 6.8"
+    }
+    random = {
+      source  = "hashicorp/random"
+      version = "~> 3.6"
+    }
+  }
+}
+
+variable "project_id" {
+  description = "GCP project to deploy into."
+  type        = string
+}
+
+variable "cluster_name" {
+  description = "Name for the TPU cluster."
+  type        = string
+  default     = "tpu-cnpack"
+}
+
+variable "region" {
+  description = "Region with v5e capacity."
+  type        = string
+  default     = "us-east5"
+}
+
+variable "node_zones" {
+  description = "Zone for the slice."
+  type        = list(string)
+  default     = ["us-east5-b"]
+}
+
+module "tpu_cluster" {
+  source = "../../"
+
+  project_id   = var.project_id
+  cluster_name = var.cluster_name
+  region       = var.region
+  node_zones   = var.node_zones
+
+  # v5e-8 multi-host slice, as in BASELINE config 4
+  tpu_slices = {
+    default = {
+      version  = "v5e"
+      topology = "2x4"
+    }
+  }
+
+  smoketest = {
+    enabled = true
+    level   = "probes"
+  }
+}

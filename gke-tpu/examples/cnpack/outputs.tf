@@ -1,0 +1,39 @@
+# Values the operator pastes into the platform installer config — the same
+# handoff shape as the reference's CNPack flow
+# (/root/reference/eks/examples/cnpack/Readme.md:49-94), plus the TPU metric
+# names GKE exports for the provisioned slice.
+
+output "cluster_name" {
+  description = "Name of the TPU cluster."
+  value       = module.tpu_cluster.cluster_name
+}
+
+output "prometheus_service_account_email" {
+  description = "GSA the monitoring KSA impersonates (annotate the KSA with this)."
+  value       = google_service_account.prometheus.email
+}
+
+output "prometheus_ksa_annotation" {
+  description = "Ready-to-paste Workload Identity annotation for the monitoring KSA."
+  value       = "iam.gke.io/gcp-service-account: ${google_service_account.prometheus.email}"
+}
+
+output "monitoring_namespace" {
+  description = "Namespace the monitoring stack must be installed into."
+  value       = local.monitoring_namespace
+}
+
+output "tpu_slices" {
+  description = "Slice facts (selectors, hosts, chips) for scrape-config targeting."
+  value       = module.tpu_cluster.tpu_slices
+}
+
+output "tpu_metric_types" {
+  description = "GKE system metrics exported for TPU nodes; use in dashboards/alerts."
+  value = [
+    "kubernetes.io/node/accelerator/duty_cycle",
+    "kubernetes.io/node/accelerator/memory_used",
+    "kubernetes.io/node/accelerator/memory_total",
+    "kubernetes.io/container/accelerator/tensorcore_utilization",
+  ]
+}

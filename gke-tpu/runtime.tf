@@ -16,28 +16,48 @@
 # pools — so destroy unwinds release → pools → cluster without the
 # reference's manual `state rm` step (survey §3.4).
 
+# The namespace is a first-class resource (not helm create_namespace) so the
+# smoke-test resources can live in it even when the runtime layer is
+# disabled; it depends on the slice pools to keep destroy ordering clean.
+resource "kubernetes_namespace_v1" "tpu_runtime" {
+  count = local.tpu_enabled && (var.tpu_runtime.enabled || var.smoketest.enabled) ? 1 : 0
+
+  metadata {
+    name = var.tpu_runtime.namespace
+
+    labels = {
+      "app.kubernetes.io/managed-by" = "terraform"
+      "app.kubernetes.io/part-of"    = "tpu-terraform-modules"
+    }
+  }
+
+  depends_on = [google_container_node_pool.tpu_slice]
+}
+
 resource "helm_release" "tpu_runtime" {
   count = local.tpu_enabled && var.tpu_runtime.enabled ? 1 : 0
 
   name      = "tpu-runtime"
   chart     = "${path.module}/../charts/tpu-runtime"
-  namespace = var.tpu_runtime.namespace
+  namespace = kubernetes_namespace_v1.tpu_runtime[0].metadata[0].name
 
-  create_namespace = true
-  atomic           = true
-  cleanup_on_fail  = true
-  replace          = true
-  timeout          = 900
+  atomic          = true
+  cleanup_on_fail = true
+  replace         = true
+  timeout         = 900
 
-  set {
-    name  = "image.probe"
-    value = var.tpu_runtime.image
-  }
-
-  set {
-    name  = "tpu.nodeSelectors"
-    value = join(",", distinct([for s in local.tpu_slice : s.node_selector]))
-  }
+  # yamlencode'd values block — immune to Helm's --set comma parsing, which
+  # would truncate a multi-generation selector list passed via `set`
+  values = [
+    yamlencode({
+      image = {
+        probe = var.tpu_runtime.image
+      }
+      tpu = {
+        nodeSelectors = join(",", distinct([for s in local.tpu_slice : s.node_selector]))
+      }
+    })
+  ]
 
   depends_on = [google_container_node_pool.tpu_slice]
 }

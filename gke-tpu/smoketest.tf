@@ -20,7 +20,7 @@
 locals {
   smoketest_enabled = local.tpu_enabled && var.smoketest.enabled
   smoke_slice       = local.smoketest_enabled ? local.tpu_slice[var.smoketest.target_slice] : null
-  smoke_ns          = var.tpu_runtime.namespace
+  smoke_ns          = local.smoketest_enabled ? kubernetes_namespace_v1.tpu_runtime[0].metadata[0].name : var.tpu_runtime.namespace
   smoke_name        = "${var.cluster_name}-tpu-smoketest"
 }
 
@@ -36,7 +36,7 @@ resource "kubernetes_config_map_v1" "smoketest_script" {
     "tpu_smoketest.py" = file("${path.module}/scripts/tpu_smoketest.py")
   }
 
-  depends_on = [helm_release.tpu_runtime]
+  depends_on = [kubernetes_namespace_v1.tpu_runtime]
 }
 
 resource "kubernetes_service_v1" "smoketest_coordinator" {
@@ -58,7 +58,7 @@ resource "kubernetes_service_v1" "smoketest_coordinator" {
     }
   }
 
-  depends_on = [helm_release.tpu_runtime]
+  depends_on = [kubernetes_namespace_v1.tpu_runtime]
 }
 
 resource "kubernetes_job_v1" "tpu_smoketest" {

@@ -62,8 +62,10 @@ locals {
     )
   }
 
+  # empty under accelerator_type = "gpu" so pools, runtime, smoke test, and
+  # outputs all see zero TPU capacity instead of phantom slices
   tpu_slice = {
-    for name, s in var.tpu_slices : name => {
+    for name, s in local.tpu_enabled ? var.tpu_slices : {} : name => {
       version        = s.version
       topology       = s.topology
       node_selector  = local.tpu_generations[s.version].node_selector
@@ -82,7 +84,7 @@ locals {
 }
 
 resource "google_container_node_pool" "tpu_slice" {
-  for_each = local.tpu_enabled ? local.tpu_slice : {}
+  for_each = local.tpu_slice
 
   name     = "${var.cluster_name}-${each.key}"
   project  = var.project_id

@@ -135,6 +135,13 @@ variable "tpu_slices" {
     ])
     error_message = "tpu_slices[*].topology must look like \"2x4\" or \"2x2x4\"."
   }
+
+  validation {
+    condition = alltrue([
+      for s in values(var.tpu_slices) : !(s.spot && s.reservation != null)
+    ])
+    error_message = "tpu_slices[*]: spot and reservation are mutually exclusive (the GCE API rejects both; fail at plan, not 20 minutes into apply)."
+  }
 }
 
 # ------------------------------------------------- GPU passthrough (parity)

@@ -6,10 +6,6 @@
 # Workload Identity enabled, release-channel driven versioning plus a
 # latest-version data probe surfaced through outputs.
 
-data "google_project" "this" {
-  project_id = var.project_id
-}
-
 data "google_container_engine_versions" "channel" {
   provider = google-beta
 

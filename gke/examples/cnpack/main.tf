@@ -1,0 +1,94 @@
+# CNPack-style observability composition on the GPU-parity module.
+#
+# Capability parity with /root/reference/gke/examples/cnpack/: wraps the root
+# module and provisions the Managed-Prometheus Workload-Identity plumbing for
+# the monitoring stack.
+
+terraform {
+  required_version = ">= 1.5.0"
+
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = "~> 6.8"
+    }
+    random = {
+      source  = "hashicorp/random"
+      version = "~> 3.6"
+    }
+  }
+}
+
+variable "project_id" {
+  description = "GCP project to deploy into."
+  type        = string
+}
+
+variable "cluster_name" {
+  description = "Name for the GPU cluster."
+  type        = string
+  default     = "gpu-cnpack"
+}
+
+variable "region" {
+  description = "Cluster region."
+  type        = string
+  default     = "us-central1"
+}
+
+variable "node_zones" {
+  description = "Zones for node placement."
+  type        = list(string)
+  default     = ["us-central1-a"]
+}
+
+module "gpu_cluster" {
+  source = "../../"
+
+  project_id   = var.project_id
+  cluster_name = var.cluster_name
+  region       = var.region
+  node_zones   = var.node_zones
+}
+
+locals {
+  monitoring_namespace = "nvidia-monitoring"
+  monitoring_ksa       = "nvidia-prometheus"
+}
+
+resource "random_id" "sa_suffix" {
+  byte_length = 3
+}
+
+resource "google_service_account" "prometheus" {
+  project      = var.project_id
+  account_id   = "gpu-prometheus-${random_id.sa_suffix.hex}"
+  display_name = "Managed Prometheus writer for ${var.cluster_name}"
+}
+
+resource "google_service_account_iam_member" "wi_binding" {
+  service_account_id = google_service_account.prometheus.name
+  role               = "roles/iam.workloadIdentityUser"
+  member             = "serviceAccount:${var.project_id}.svc.id.goog[${local.monitoring_namespace}/${local.monitoring_ksa}]"
+}
+
+resource "google_project_iam_member" "metric_writer" {
+  project = var.project_id
+  role    = "roles/monitoring.metricWriter"
+  member  = "serviceAccount:${google_service_account.prometheus.email}"
+}
+
+output "cluster_name" {
+  description = "Name of the GPU cluster."
+  value       = module.gpu_cluster.cluster_name
+}
+
+output "prometheus_service_account_email" {
+  description = "GSA the monitoring KSA impersonates."
+  value       = google_service_account.prometheus.email
+}
+
+output "monitoring_namespace" {
+  description = "Namespace the monitoring stack must be installed into."
+  value       = local.monitoring_namespace
+}

@@ -194,6 +194,10 @@ FUNCTIONS: dict[str, Any] = {
     "try": _fn_try,
     "upper": lambda s: str(s).upper(),
     "values": lambda m: [m[k] for k in sorted(m.keys())],
+    # JSON is a subset of YAML; emitting it keeps tfsim dependency-free and
+    # Helm/K8s consumers parse it identically
+    "yamlencode": lambda v: json.dumps(v, separators=(",", ":")),
+    "yamldecode": json.loads,
     "zipmap": lambda ks, vs: dict(zip(ks, vs)),
 }
 

@@ -69,8 +69,14 @@ def load_tfvars(path: str) -> dict[str, Any]:
 # reference extraction (for dependency edges)
 # --------------------------------------------------------------------------
 
-def _collect_addresses(node, resource_types: set[str]) -> set[str]:
-    """All resource/data/module addresses referenced from an AST subtree."""
+def _collect_addresses(node, resource_types: set[str],
+                       locals_refs: dict[str, set[str]] | None = None) -> set[str]:
+    """All resource/data/module addresses referenced from an AST subtree.
+
+    ``locals_refs`` maps local name → addresses that local (transitively)
+    references; a ``local.X`` reference pulls them in, so a resource that
+    consumes a local depends on whatever the local reads.
+    """
     out: set[str] = set()
     for t, bound in A.scoped_traversals(node):
         if t.root in bound:
@@ -82,7 +88,50 @@ def _collect_addresses(node, resource_types: set[str]) -> set[str]:
             out.add(f"module.{t.ops[0][1]}")
         elif t.root in resource_types and t.ops and t.ops[0][0] == "attr":
             out.add(f"{t.root}.{t.ops[0][1]}")
+        elif t.root == "local" and locals_refs is not None and t.ops and \
+                t.ops[0][0] == "attr":
+            out |= locals_refs.get(t.ops[0][1], set())
     return out
+
+
+class LazyLocals:
+    """Terraform-faithful locals: evaluated on first reference, not up-front.
+
+    A local may read resource attributes; eager evaluation would freeze it to
+    ``<computed>`` before the resource is planned. Lazy evaluation (plus
+    dependency expansion via ``locals_refs``) means a local referenced from a
+    resource body sees every resource the plan order guarantees to exist.
+    """
+
+    def __init__(self, exprs: dict[str, A.Expr], scope: "Scope"):
+        self._exprs = dict(exprs)
+        self._scope = scope
+        self._cache: dict[str, Any] = {}
+        self._evaluating: set[str] = set()
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._exprs or name in self._cache
+
+    def __getitem__(self, name: str) -> Any:
+        if name in self._cache:
+            return self._cache[name]
+        if name not in self._exprs:
+            raise KeyError(name)
+        if name in self._evaluating:
+            raise EvalError(f"dependency cycle through local.{name}")
+        self._evaluating.add(name)
+        try:
+            value = evaluate(self._exprs[name], self._scope)
+        finally:
+            self._evaluating.discard(name)
+        self._cache[name] = value
+        return value
+
+    def __setitem__(self, name: str, value: Any) -> None:
+        self._cache[name] = value
+
+    def keys(self):
+        return self._exprs.keys()
 
 
 # --------------------------------------------------------------------------
@@ -186,28 +235,9 @@ def simulate_plan(
                 pass
             raise PlanError(f"variable {name!r} validation failed: {msg}")
 
-    # 2. locals (fixed-point: locals may reference locals) --------------
-    pending = dict(module.locals)
-    for _ in range(len(pending) + 1):
-        progressed = False
-        for name in list(pending):
-            try:
-                scope.locals[name] = evaluate(pending[name], scope)
-                del pending[name]
-                progressed = True
-            except EvalError:
-                continue
-        if not pending:
-            break
-        if not progressed:
-            # leave unresolvable locals (e.g. referencing resources) computed
-            for name in list(pending):
-                try:
-                    scope.locals[name] = evaluate(pending[name], scope)
-                except EvalError:
-                    scope.locals[name] = COMPUTED
-                del pending[name]
-            break
+    # 2. locals: lazy, Terraform-style (a local may read resources planned
+    #    later; evaluation happens at first reference, in plan order)
+    scope.locals = LazyLocals(module.locals, scope)
 
     # 3. dependency graph over resources + data + module calls ----------
     resource_types = {r.type for r in module.resources.values()}
@@ -217,10 +247,35 @@ def simulate_plan(
     for name, mc in module.module_calls.items():
         nodes[f"module.{name}"] = mc
 
+    # per-local address refs, transitively closed through other locals
+    locals_refs: dict[str, set[str]] = {
+        name: _collect_addresses(expr, resource_types)
+        for name, expr in module.locals.items()
+    }
+    local_deps = {
+        name: {
+            t.ops[0][1]
+            for t, bound in A.scoped_traversals(expr)
+            if t.root == "local" and t.root not in bound and t.ops and
+            t.ops[0][0] == "attr"
+        }
+        for name, expr in module.locals.items()
+    }
+    for _ in range(len(locals_refs)):
+        changed = False
+        for name, dep_names in local_deps.items():
+            for d in dep_names:
+                extra = locals_refs.get(d, set()) - locals_refs[name]
+                if extra:
+                    locals_refs[name] |= extra
+                    changed = True
+        if not changed:
+            break
+
     deps: dict[str, set[str]] = {}
     for addr, obj in nodes.items():
         body = obj.body
-        refs = _collect_addresses(body, resource_types)
+        refs = _collect_addresses(body, resource_types, locals_refs)
         deps[addr] = {r for r in refs if r in nodes and r != addr}
 
     order = _toposort(deps)

@@ -1,0 +1,60 @@
+"""Golden-plan tests for the examples/cnpack compositions.
+
+These exercise tfsim's recursive module simulation: the example root modules
+call the real gke / gke-tpu modules via `source = "../../"` — the same
+integration-fixture role the reference's examples play (SURVEY.md §2.4).
+"""
+
+import os
+
+import pytest
+
+from nvidia_terraform_modules_tpu.tfsim import (
+    load_module,
+    simulate_plan,
+    validate_module,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("path", [
+    "gke/examples/cnpack",
+    "gke-tpu/examples/cnpack",
+])
+def test_examples_validate_clean(path):
+    findings = validate_module(load_module(os.path.join(ROOT, path)))
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_tpu_example_plans_slice_and_identity():
+    plan = simulate_plan(
+        os.path.join(ROOT, "gke-tpu", "examples", "cnpack"),
+        {"project_id": "proj-y"},
+    )
+    addrs = set(plan.instances)
+    # child module resources planned through the wrap
+    assert ('module.tpu_cluster.google_container_node_pool.'
+            'tpu_slice["default"]') in addrs
+    assert "module.tpu_cluster.kubernetes_job_v1.tpu_smoketest[0]" in addrs
+    # observability identity
+    assert "google_service_account.prometheus" in addrs
+    assert "google_service_account_iam_member.wi_binding" in addrs
+    wi = plan.instance("google_service_account_iam_member.wi_binding")
+    assert "tpu-monitoring/tpu-prometheus" in wi.attrs["member"]
+    assert plan.outputs["monitoring_namespace"] == "tpu-monitoring"
+    assert len(plan.outputs["tpu_metric_types"]) >= 4
+    # slice facts surface through the wrap
+    assert plan.outputs["tpu_slices"]["default"]["total_chips"] == 8
+
+
+def test_gpu_example_plans_cluster_and_identity():
+    plan = simulate_plan(
+        os.path.join(ROOT, "gke", "examples", "cnpack"),
+        {"project_id": "proj-y"},
+    )
+    addrs = set(plan.instances)
+    assert "module.gpu_cluster.google_container_cluster.this" in addrs
+    assert "module.gpu_cluster.helm_release.gpu_operator[0]" in addrs
+    assert "google_project_iam_member.metric_writer" in addrs
+    assert plan.outputs["monitoring_namespace"] == "nvidia-monitoring"

@@ -203,3 +203,49 @@ def test_apply_order_pools_before_runtime_before_job(tpu_mod):
         "kubernetes_config_map_v1.smoketest_script")
     assert o.index("kubernetes_service_v1.smoketest_coordinator") < o.index(
         "kubernetes_job_v1.tpu_smoketest")
+
+
+def test_gpu_mode_reports_zero_tpu_capacity(tpu_mod):
+    """accelerator_type=gpu must not emit phantom slice facts."""
+    plan = simulate_plan(tpu_mod, {
+        **BASE, "accelerator_type": "gpu", "smoketest": {"enabled": False}})
+    assert plan.outputs["tpu_slices"] == {}
+    assert plan.outputs["total_tpu_chips"] == 0
+
+
+def test_spot_and_reservation_mutually_exclusive(tpu_mod):
+    with pytest.raises(PlanError) as ei:
+        simulate_plan(tpu_mod, {
+            **BASE,
+            "tpu_slices": {"default": {"spot": True, "reservation": "r1"}},
+        })
+    assert "mutually exclusive" in str(ei.value)
+
+
+def test_smoketest_without_runtime_layer(tpu_mod):
+    """Disabling the runtime chart must not orphan the smoketest namespace."""
+    plan = simulate_plan(tpu_mod, {
+        **BASE, "tpu_runtime": {"enabled": False}})
+    addrs = set(plan.instances)
+    assert "kubernetes_namespace_v1.tpu_runtime[0]" in addrs
+    assert not any(a.startswith("helm_release") for a in addrs)
+    assert "kubernetes_job_v1.tpu_smoketest[0]" in addrs
+
+
+def test_runtime_values_yaml_not_set(tpu_mod):
+    """Node selectors ride a yamlencode'd values block (comma-safe), not set."""
+    plan = simulate_plan(tpu_mod, {
+        **BASE,
+        "tpu_slices": {
+            "a": {"version": "v4", "topology": "2x2x1"},
+            "b": {"version": "v5e", "topology": "2x2"},
+        },
+        "smoketest": {"target_slice": "a"},
+    })
+    rel = plan.instance("helm_release.tpu_runtime[0]")
+    import json as _json
+
+    vals = _json.loads(rel.attrs["values"][0])
+    sels = set(vals["tpu"]["nodeSelectors"].split(","))
+    assert sels == {"tpu-v4-podslice", "tpu-v5-lite-podslice"}
+    assert "set" not in rel.attrs

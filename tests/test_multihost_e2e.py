@@ -1,0 +1,82 @@
+"""True multi-process e2e: the smoke-test payloads under jax.distributed.
+
+Spawns two processes (4 virtual CPU devices each) that form one 8-device
+global mesh over a localhost coordinator — the exact choreography of the
+gke-tpu indexed Job across slice hosts, minus the TPUs.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BOOTSTRAP = (
+    "import jax, runpy;"
+    "jax.config.update('jax_platforms', 'cpu');"
+    "runpy.run_path(r'{script}', run_name='__main__')"
+)
+
+
+def _spawn(idx: int, script: str, extra_env: dict, port: int):
+    env = dict(os.environ)
+    env.update(
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        JAX_PLATFORMS="cpu",
+        TPU_SMOKETEST_HOSTS="2",
+        JOB_COMPLETION_INDEX=str(idx),
+        TPU_SMOKETEST_COORDINATOR=f"localhost:{port}",
+        TPU_SMOKETEST_EXPECTED_DEVICES="8",
+        TPU_SMOKETEST_INIT_TIMEOUT="60",
+        **extra_env,
+    )
+    return subprocess.Popen(
+        [sys.executable, "-c", BOOTSTRAP.format(script=script)],
+        env=env, cwd=ROOT,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+
+
+def _run_pair(script: str, extra_env: dict, port: int):
+    procs = [_spawn(i, script, extra_env, port) for i in range(2)]
+    results = []
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        results.append((p.returncode, out, err))
+    return results
+
+
+@pytest.mark.slow
+def test_standalone_script_two_hosts():
+    script = os.path.join(ROOT, "gke-tpu", "scripts", "tpu_smoketest.py")
+    results = _run_pair(script, {"TPU_SMOKETEST_LEVEL": "probes"}, port=8491)
+    for rc, out, err in results:
+        assert rc == 0, f"stdout={out!r}\nstderr={err[-2000:]!r}"
+        line = [l for l in out.splitlines() if l.startswith("{")][-1]
+        verdict = json.loads(line)
+        assert verdict["ok"] is True
+        assert verdict["devices"] == 8
+        assert verdict["num_processes"] == 2
+        assert verdict["psum_ok"] and verdict["ring_ok"] and verdict["all_gather_ok"]
+
+
+@pytest.mark.slow
+def test_package_runner_two_hosts(tmp_path):
+    # drive the installable package runner the same way
+    runner = tmp_path / "run_pkg.py"
+    runner.write_text(
+        "import sys, os; sys.path.insert(0, r'%s')\n"
+        "from nvidia_terraform_modules_tpu.smoketest.__main__ import main\n"
+        "sys.exit(main())\n" % ROOT
+    )
+    results = _run_pair(str(runner), {"TPU_SMOKETEST_LEVEL": "psum"}, port=8492)
+    for rc, out, err in results:
+        assert rc == 0, f"stdout={out!r}\nstderr={err[-2000:]!r}"
+        verdict = json.loads(
+            [l for l in out.splitlines() if l.startswith("{")][-1])
+        assert verdict["ok"] is True
+        assert verdict["devices"] == 8
+        assert verdict["psum_participants"] == 8

@@ -116,3 +116,23 @@ resource "null_resource" "r" {
     assert "null_resource.r[0]" in on.instances  # null takes the default
     off = simulate_plan(str(tmp_path), {"x": {"a": False}})
     assert off.instances == {}
+
+
+def test_lazy_local_reads_resource_attr(tmp_path):
+    """A local referencing a resource must see its planned value (lazy eval),
+    and consumers of the local must be ordered after that resource."""
+    (tmp_path / "main.tf").write_text('''
+locals {
+  ns = null_resource.first.triggers.name
+}
+resource "null_resource" "first" {
+  triggers = { name = "alpha" }
+}
+resource "null_resource" "second" {
+  triggers = { ns = local.ns }
+}
+''')
+    plan = simulate_plan(str(tmp_path))
+    assert plan.instances["null_resource.second"].attrs["triggers"]["ns"] == "alpha"
+    assert plan.order.index("null_resource.first") < plan.order.index(
+        "null_resource.second")
