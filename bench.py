@@ -58,15 +58,18 @@ def main() -> None:
         else BurnInConfig(vocab=256, d_model=64, n_heads=4, d_ff=128,
                           n_layers=2, seq_len=32, batch=4, dtype=jnp.float32)
     )
+    from nvidia_terraform_modules_tpu.utils.timing import sync
+
     params = init_params(jax.random.PRNGKey(0), cfg)
     step = make_train_step(cfg)
     batch = synthetic_batch(jax.random.PRNGKey(1), cfg)
-    params, _ = jax.block_until_ready(step(params, batch))  # compile
+    params, loss = step(params, batch)  # compile
+    sync(loss)
     t_step = time.perf_counter()
     iters = 10
     for _ in range(iters):
         params, loss = step(params, batch)
-    jax.block_until_ready(loss)
+    sync(loss)  # d2h readback: the only reliable barrier on tunnelled backends
     tokens_per_s = cfg.batch * cfg.seq_len * iters / (time.perf_counter() - t_step)
 
     total = time.perf_counter() - t0

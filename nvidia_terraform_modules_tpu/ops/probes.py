@@ -17,28 +17,34 @@ import jax
 import jax.numpy as jnp
 
 from ..utils.device import device_spec
-from ..utils.timing import median_time
+from ..utils.timing import delta_time
 
 
 def matmul_probe(n: int = 4096, dtype=jnp.bfloat16, iters: int = 8) -> dict[str, Any]:
     """Chained square matmuls; returns achieved TFLOP/s and roofline fraction.
 
-    A `lax.scan` of ``iters`` dependent matmuls keeps the MXU busy across a
-    single dispatch, so launch overhead amortises out of the measurement.
+    A `lax.scan` of dependent matmuls keeps the MXU busy across a single
+    dispatch; the two-point ``delta_time`` measurement (``iters`` vs
+    ``8*iters``) cancels fixed dispatch/readback latency, which otherwise
+    dominates on tunnelled backends.
     """
     key = jax.random.PRNGKey(0)
     a = jax.random.normal(key, (n, n), dtype=dtype)
     b = jax.random.normal(jax.random.PRNGKey(1), (n, n), dtype=dtype)
 
-    @jax.jit
-    def chain(a, b):
-        def step(acc, _):
-            return jnp.dot(acc, b, preferred_element_type=jnp.float32).astype(dtype), None
+    def make_chain(length):
+        @jax.jit
+        def chain(a, b):
+            def step(acc, _):
+                return jnp.dot(acc, b, preferred_element_type=jnp.float32).astype(dtype), None
 
-        out, _ = jax.lax.scan(step, a, None, length=iters)
-        return out
+            out, _ = jax.lax.scan(step, a, None, length=length)
+            return out
 
-    secs = median_time(chain, a, b)
+        return chain
+
+    secs_per_iter = delta_time(make_chain, a, b, iters_lo=iters, iters_hi=8 * iters)
+    secs = secs_per_iter * iters
     flops = 2.0 * n * n * n * iters
     tflops = flops / secs / 1e12
     spec = device_spec()
@@ -57,15 +63,19 @@ def hbm_probe(mib: int = 256, iters: int = 8) -> dict[str, Any]:
     x = jnp.ones((n,), dtype=jnp.float32)
     y = jnp.full((n,), 2.0, dtype=jnp.float32)
 
-    @jax.jit
-    def triad(x, y):
-        def step(acc, _):
-            return acc * 1.0001 + y, None
+    def make_triad(length):
+        @jax.jit
+        def triad(x, y):
+            def step(acc, _):
+                return acc * 1.0001 + y, None
 
-        out, _ = jax.lax.scan(step, x, None, length=iters)
-        return out
+            out, _ = jax.lax.scan(step, x, None, length=length)
+            return out
 
-    secs = median_time(triad, x, y)
+        return triad
+
+    secs_per_iter = delta_time(make_triad, x, y, iters_lo=iters, iters_hi=8 * iters)
+    secs = secs_per_iter * iters
     moved = 3.0 * x.nbytes * iters  # read acc, read y, write acc
     gibps = moved / secs / (1 << 30)
     spec = device_spec()

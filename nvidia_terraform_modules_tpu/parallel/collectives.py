@@ -69,16 +69,26 @@ def _run(mesh: Mesh, verify_kernel, timed_kernel, timed_spec,
 
 
 def psum_probe(mesh: Mesh, axis: str = "dp", n_elems: int = 1 << 20) -> dict[str, Any]:
-    """All-reduce over ``axis``; each shard contributes ones, so the result
-    must equal the participant count everywhere — the north-star invariant."""
+    """All-reduce over ``axis`` — the north-star invariant.
+
+    Each shard contributes ``axis_index + 1`` (NOT a replicated constant:
+    XLA's replication analysis rewrites an all-reduce of provably-identical
+    operands into local arithmetic, which would verify — and time — nothing),
+    so the result must equal 1 + 2 + … + n everywhere.
+    """
     n_dev = _axis_size(mesh, axis)
+    want = n_dev * (n_dev + 1) / 2
+
+    def contribution():
+        i = jax.lax.axis_index(axis).astype(jnp.float32)
+        return jnp.full((n_elems,), 1.0, jnp.float32) + i
 
     def verify():
-        out = jax.lax.psum(jnp.ones((n_elems,), jnp.float32), axis)
-        return _replicate(jnp.max(jnp.abs(out - n_dev)), mesh)
+        out = jax.lax.psum(contribution(), axis)
+        return _replicate(jnp.max(jnp.abs(out - want)), mesh)
 
     def timed():
-        return jax.lax.psum(jnp.ones((n_elems,), jnp.float32), axis)
+        return jax.lax.psum(contribution(), axis)
 
     moved = 2 * (n_dev - 1) / n_dev * (n_dev * n_elems * 4)
     return _run(mesh, verify, timed, P(axis), moved, n_dev)
@@ -108,14 +118,20 @@ def reduce_scatter_probe(mesh: Mesh, axis: str = "tp", n_elems: int = 1 << 18) -
     """psum_scatter over ``axis`` — the backbone of row-parallel matmuls."""
     n_dev = _axis_size(mesh, axis)
 
+    want = n_dev * (n_dev + 1) / 2
+
+    def contribution():
+        # axis-index-dependent so replication analysis can't fold the
+        # collective into local math (see psum_probe)
+        i = jax.lax.axis_index(axis).astype(jnp.float32)
+        return jnp.full((n_dev * n_elems,), 1.0, jnp.float32) + i
+
     def verify():
-        x = jnp.ones((n_dev * n_elems,), jnp.float32)
-        out = jax.lax.psum_scatter(x, axis, tiled=True)
-        return _replicate(jnp.max(jnp.abs(out - n_dev)), mesh)
+        out = jax.lax.psum_scatter(contribution(), axis, tiled=True)
+        return _replicate(jnp.max(jnp.abs(out - want)), mesh)
 
     def timed():
-        x = jnp.ones((n_dev * n_elems,), jnp.float32)
-        return jax.lax.psum_scatter(x, axis, tiled=True)
+        return jax.lax.psum_scatter(contribution(), axis, tiled=True)
 
     moved = (n_dev - 1) / n_dev * (n_dev * n_dev * n_elems * 4)
     return _run(mesh, verify, timed, P(axis), moved, n_dev)

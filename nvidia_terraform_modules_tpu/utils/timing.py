@@ -1,7 +1,14 @@
 """Timing helpers for device-side work.
 
-Everything here blocks on the returned arrays (``block_until_ready``) so we
-time actual device execution, not async dispatch.
+``jax.block_until_ready`` is not a reliable barrier on every backend (the
+tunnelled ``axon`` TPU platform acks dispatch without waiting for execution),
+so everything here synchronises by reading one element of the output back to
+the host — a d2h copy can only complete after the producing kernel has.
+
+That readback costs a fixed per-call latency (tens of ms over a tunnel),
+which would swamp short kernels. ``delta_time`` therefore measures the same
+computation at two different iteration counts and reports the per-iteration
+cost from the difference, cancelling the fixed sync overhead.
 """
 
 from __future__ import annotations
@@ -10,13 +17,31 @@ import time
 from typing import Any, Callable
 
 
-def timed(fn: Callable[..., Any], *args: Any) -> tuple[Any, float]:
-    """Run ``fn(*args)``, block until its outputs are ready, return (out, seconds)."""
-    import jax
+def sync(out: Any) -> None:
+    """Barrier that provably waits for device execution of ``out``.
 
+    Reads a single element of the first non-empty array leaf back to the
+    host. For sharded (possibly non-fully-addressable, multi-host) arrays the
+    read goes through the local addressable shard, so every process syncs on
+    its own data without a cross-process fetch. Falls back to
+    ``block_until_ready`` for non-array outputs.
+    """
+    import jax
+    import numpy as np
+
+    for leaf in jax.tree.leaves(out):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards and shards[0].data.size:
+            np.asarray(jax.device_get(shards[0].data.ravel()[0:1]))
+            return
+    jax.block_until_ready(out)
+
+
+def timed(fn: Callable[..., Any], *args: Any) -> tuple[Any, float]:
+    """Run ``fn(*args)``, wait for device execution, return (out, seconds)."""
     t0 = time.perf_counter()
     out = fn(*args)
-    out = jax.block_until_ready(out)
+    sync(out)
     return out, time.perf_counter() - t0
 
 
@@ -24,9 +49,36 @@ def median_time(fn: Callable[..., Any], *args: Any, iters: int = 5, warmup: int 
     """Median wall-clock seconds of ``fn(*args)`` over ``iters`` timed runs.
 
     ``warmup`` untimed runs first absorb compilation (first XLA compile of a
-    probe is 20-40s on TPU; steady-state is what we report).
+    probe is 20-40s on TPU; steady-state is what we report). Includes the
+    fixed sync latency — use ``delta_time`` when that must cancel out.
     """
     for _ in range(warmup):
         timed(fn, *args)
     samples = sorted(timed(fn, *args)[1] for _ in range(iters))
     return samples[len(samples) // 2]
+
+
+def delta_time(
+    make_fn: Callable[[int], Callable[..., Any]],
+    *args: Any,
+    iters_lo: int,
+    iters_hi: int,
+    samples: int = 3,
+) -> float:
+    """Per-iteration seconds via two-point measurement.
+
+    ``make_fn(n)`` must return a callable running ``n`` iterations of the
+    kernel under test. Timing both ``iters_lo`` and ``iters_hi`` and dividing
+    the difference removes fixed overhead (dispatch + host readback), which
+    otherwise dominates short kernels on tunnelled backends.
+    """
+    assert iters_hi > iters_lo
+    fn_lo, fn_hi = make_fn(iters_lo), make_fn(iters_hi)
+    t_lo = median_time(fn_lo, *args, iters=samples)
+    t_hi = median_time(fn_hi, *args, iters=samples)
+    if t_hi <= t_lo:
+        # Jitter swamped the delta; fall back to the bounded single-point
+        # estimate (includes fixed overhead → conservative underestimate of
+        # throughput) rather than reporting a nonsense near-zero time.
+        return t_hi / iters_hi
+    return (t_hi - t_lo) / (iters_hi - iters_lo)
