@@ -1,0 +1,112 @@
+# Private certificate authority for in-cluster TLS.
+#
+# Capability parity with the reference's AWS Private CA composition
+# (/root/reference/eks/examples/cnpack/aws-pca.tf:9-105): a ROOT CA the
+# platform's cert-manager issuer chains from, plus the IAM that lets the
+# issuer request certificates. GCP-native shape: Certificate Authority
+# Service (CAS) pool + self-signed root, consumed by cert-manager's
+# google-cas-issuer via Workload Identity — no node-role policy attachments
+# (the reference grants issuing rights to node IAM roles; Workload Identity
+# scopes it to the issuer's KSA instead).
+
+variable "private_ca_enabled" {
+  description = "Provision a Certificate Authority Service root CA (reference: pca_enabled)."
+  type        = bool
+  default     = true
+}
+
+variable "common_name" {
+  description = "Common Name of the root CA certificate."
+  type        = string
+  default     = "cluster.local"
+}
+
+resource "google_privateca_ca_pool" "cnpack" {
+  count = var.private_ca_enabled ? 1 : 0
+
+  project  = var.project_id
+  name     = "${var.cluster_name}-ca-pool"
+  location = var.region
+  tier     = "ENTERPRISE"
+
+  publishing_options {
+    publish_ca_cert = true
+    publish_crl     = true
+  }
+}
+
+# Self-signed ROOT authority. The reference uses RSA-4096/SHA-512
+# (aws-pca.tf:13-14); CAS's strongest RSA PKCS1 signing spec is 4096/SHA-256.
+resource "google_privateca_certificate_authority" "cnpack" {
+  count = var.private_ca_enabled ? 1 : 0
+
+  project                  = var.project_id
+  pool                     = google_privateca_ca_pool.cnpack[count.index].name
+  location                 = var.region
+  certificate_authority_id = "${var.cluster_name}-root-ca"
+  type                     = "SELF_SIGNED"
+
+  # reference root cert validity: 1 year (aws-pca.tf:36-39)
+  lifetime = "31536000s"
+
+  key_spec {
+    algorithm = "RSA_PKCS1_4096_SHA256"
+  }
+
+  config {
+    subject_config {
+      subject {
+        common_name  = var.common_name
+        organization = "tpu-platform"
+      }
+    }
+    x509_config {
+      ca_options {
+        is_ca = true
+      }
+      key_usage {
+        base_key_usage {
+          cert_sign = true
+          crl_sign  = true
+        }
+        extended_key_usage {
+          server_auth = true
+          client_auth = true
+        }
+      }
+    }
+  }
+
+  # parity with permanent_deletion_time_in_days = 7 (aws-pca.tf:22): allow
+  # terraform destroy to actually remove the CA instead of wedging the pool
+  deletion_protection                    = false
+  skip_grace_period                      = true
+  ignore_active_certificates_on_deletion = true
+}
+
+# Identity for cert-manager's google-cas-issuer controller.
+resource "google_service_account" "cas_issuer" {
+  count = var.private_ca_enabled ? 1 : 0
+
+  project      = var.project_id
+  account_id   = "tpu-cas-issuer-${random_id.sa_suffix.hex}"
+  display_name = "cert-manager CAS issuer for ${var.cluster_name}"
+}
+
+resource "google_service_account_iam_member" "cas_issuer_wi" {
+  count = var.private_ca_enabled ? 1 : 0
+
+  service_account_id = google_service_account.cas_issuer[count.index].name
+  role               = "roles/iam.workloadIdentityUser"
+  member             = "serviceAccount:${var.project_id}.svc.id.goog[cert-manager/google-cas-issuer]"
+}
+
+# Issuing rights scoped to the pool, not the project (least privilege vs the
+# reference's node-role-wide policy, aws-pca.tf:74-105).
+resource "google_privateca_ca_pool_iam_member" "cas_issuer_requester" {
+  count = var.private_ca_enabled ? 1 : 0
+
+  ca_pool = google_privateca_ca_pool.cnpack[count.index].id
+  role    = "roles/privateca.certificateRequester"
+  member  = "serviceAccount:${google_service_account.cas_issuer[count.index].email}"
+}

@@ -1,0 +1,77 @@
+# Log shipping for the platform's Fluent Bit DaemonSet.
+#
+# Capability parity with /root/reference/eks/examples/cnpack/aws-fluentbit.tf:9-27
+# (CloudWatch agent policy attached to node IAM roles — note both attachments
+# there target the GPU role; the CPU one is a copy-paste bug the survey calls
+# out, SURVEY.md §2.4). Designed out here: ONE Workload-Identity-scoped log
+# writer identity that every pool's Fluent Bit pod impersonates, plus a
+# dedicated Cloud Logging bucket with bounded retention.
+
+variable "fluentbit_enabled" {
+  description = "Provision the Fluent Bit log-writer identity and log bucket."
+  type        = bool
+  default     = true
+}
+
+variable "log_retention_days" {
+  description = "Retention of the dedicated cluster log bucket."
+  type        = number
+  default     = 30
+}
+
+resource "google_service_account" "fluentbit" {
+  count = var.fluentbit_enabled ? 1 : 0
+
+  project      = var.project_id
+  account_id   = "tpu-fluentbit-${random_id.sa_suffix.hex}"
+  display_name = "Fluent Bit log writer for ${var.cluster_name}"
+}
+
+resource "google_service_account_iam_member" "fluentbit_wi" {
+  count = var.fluentbit_enabled ? 1 : 0
+
+  service_account_id = google_service_account.fluentbit[count.index].name
+  role               = "roles/iam.workloadIdentityUser"
+  member             = "serviceAccount:${var.project_id}.svc.id.goog[${local.monitoring_namespace}/tpu-fluentbit]"
+}
+
+resource "google_project_iam_member" "fluentbit_log_writer" {
+  count = var.fluentbit_enabled ? 1 : 0
+
+  project = var.project_id
+  role    = "roles/logging.logWriter"
+  member  = "serviceAccount:${google_service_account.fluentbit[count.index].email}"
+}
+
+resource "google_logging_project_bucket_config" "cnpack" {
+  count = var.fluentbit_enabled ? 1 : 0
+
+  project        = var.project_id
+  location       = "global"
+  bucket_id      = "${var.cluster_name}-logs"
+  retention_days = var.log_retention_days
+  description    = "Cluster logs shipped by the ${var.cluster_name} Fluent Bit DaemonSet"
+}
+
+# Route this cluster's container logs into the bucket — without a sink the
+# _Default sink would keep sending them to the _Default bucket and the
+# retention knob above would govern an empty bucket.
+resource "google_logging_project_sink" "cnpack" {
+  count = var.fluentbit_enabled ? 1 : 0
+
+  project     = var.project_id
+  name        = "${var.cluster_name}-to-log-bucket"
+  destination = "logging.googleapis.com/projects/${var.project_id}/locations/global/buckets/${google_logging_project_bucket_config.cnpack[count.index].bucket_id}"
+  filter      = "resource.type=\"k8s_container\" AND resource.labels.cluster_name=\"${var.cluster_name}\""
+
+  unique_writer_identity = true
+}
+
+# the sink's service-account identity needs write access on the bucket
+resource "google_project_iam_member" "sink_bucket_writer" {
+  count = var.fluentbit_enabled ? 1 : 0
+
+  project = var.project_id
+  role    = "roles/logging.bucketWriter"
+  member  = google_logging_project_sink.cnpack[count.index].writer_identity
+}

@@ -37,3 +37,28 @@ output "tpu_metric_types" {
     "kubernetes.io/container/accelerator/tensorcore_utilization",
   ]
 }
+
+output "ca_pool" {
+  description = "CAS pool the GoogleCASClusterIssuer must reference (null when private_ca_enabled = false)."
+  value       = var.private_ca_enabled ? google_privateca_ca_pool.cnpack[0].name : null
+}
+
+output "ca_resource_name" {
+  description = "Fully-qualified root CA resource (paste into the issuer spec)."
+  value       = var.private_ca_enabled ? google_privateca_certificate_authority.cnpack[0].id : null
+}
+
+output "cas_issuer_service_account_email" {
+  description = "GSA the cert-manager google-cas-issuer KSA impersonates."
+  value       = var.private_ca_enabled ? google_service_account.cas_issuer[0].email : null
+}
+
+output "fluentbit_service_account_email" {
+  description = "GSA the Fluent Bit DaemonSet KSA impersonates."
+  value       = var.fluentbit_enabled ? google_service_account.fluentbit[0].email : null
+}
+
+output "log_bucket" {
+  description = "Dedicated Cloud Logging bucket receiving cluster logs."
+  value       = var.fluentbit_enabled ? google_logging_project_bucket_config.cnpack[0].bucket_id : null
+}

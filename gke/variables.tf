@@ -75,15 +75,15 @@ variable "network" {
 variable "cpu_pool" {
   description = "Shape of the general-purpose (CPU) node pool."
   type = object({
-    machine_type   = optional(string, "n2-standard-8")
-    min_nodes      = optional(number, 1)
-    max_nodes      = optional(number, 5)
-    initial_nodes  = optional(number, 1)
-    disk_size_gb   = optional(number, 100)
-    disk_type      = optional(string, "pd-balanced")
-    image_type     = optional(string, "COS_CONTAINERD")
-    spot           = optional(bool, false)
-    labels         = optional(map(string), {})
+    machine_type  = optional(string, "n2-standard-8")
+    min_nodes     = optional(number, 1)
+    max_nodes     = optional(number, 5)
+    initial_nodes = optional(number, 1)
+    disk_size_gb  = optional(number, 100)
+    disk_type     = optional(string, "pd-balanced")
+    image_type    = optional(string, "COS_CONTAINERD")
+    spot          = optional(bool, false)
+    labels        = optional(map(string), {})
   })
   default = {}
 }
@@ -97,18 +97,18 @@ variable "gpu_pool" {
     enabled = false for a CPU-only cluster (baseline config 1).
   EOT
   type = object({
-    enabled        = optional(bool, true)
-    machine_type   = optional(string, "n1-standard-8")
-    gpu_type       = optional(string, "nvidia-tesla-v100")
-    gpu_count      = optional(number, 1)
-    min_nodes      = optional(number, 1)
-    max_nodes      = optional(number, 5)
-    initial_nodes  = optional(number, 2)
-    disk_size_gb   = optional(number, 512)
-    disk_type      = optional(string, "pd-ssd")
-    image_type     = optional(string, "UBUNTU_CONTAINERD")
-    spot           = optional(bool, false)
-    labels         = optional(map(string), {})
+    enabled       = optional(bool, true)
+    machine_type  = optional(string, "n1-standard-8")
+    gpu_type      = optional(string, "nvidia-tesla-v100")
+    gpu_count     = optional(number, 1)
+    min_nodes     = optional(number, 1)
+    max_nodes     = optional(number, 5)
+    initial_nodes = optional(number, 2)
+    disk_size_gb  = optional(number, 512)
+    disk_type     = optional(string, "pd-ssd")
+    image_type    = optional(string, "UBUNTU_CONTAINERD")
+    spot          = optional(bool, false)
+    labels        = optional(map(string), {})
   })
   default = {}
 }

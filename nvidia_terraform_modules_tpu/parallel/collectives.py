@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..utils.timing import median_time
+from ..utils.timing import delta_time
 
 shard_map = jax.shard_map
 
@@ -38,27 +38,40 @@ def _replicate(err, mesh: Mesh):
     return jax.lax.pmax(err, tuple(mesh.axis_names))
 
 
-def _run(mesh: Mesh, verify_kernel, timed_kernel, timed_spec,
+def _run(mesh: Mesh, verify_kernel, timed_step, timed_spec,
          moved_bytes: float, n_dev: int, tol: float = 1e-5):
     """Judge correctness and time the collective as two separate programs.
 
     - ``verify_kernel`` returns a replicated error scalar (fetchable from any
       process) — correctness, fused with whatever math it needs.
-    - ``timed_kernel`` is the BARE collective with in-kernel data and a
-      sharded output that is only block_until_ready'd, never fetched —
-      so "seconds" measures the link, not the verification arithmetic.
+    - ``timed_step(carry) -> carry`` is one data-dependent hop of the bare
+      collective; a ``lax.scan`` chains it and the two-point ``delta_time``
+      (1 vs 9 iterations) cancels the fixed dispatch + host-sync latency —
+      which would otherwise swamp a sub-ms collective on a tunnelled
+      backend. Sync reads one element of the LOCAL shard per process, so
+      the measurement is multi-host safe.
     """
     verify = jax.jit(
         functools.partial(shard_map, mesh=mesh, in_specs=(), out_specs=P())(
             verify_kernel)
     )
     err = float(jax.device_get(verify()))
-    timed = jax.jit(
-        functools.partial(
-            shard_map, mesh=mesh, in_specs=(), out_specs=timed_spec)(
-            timed_kernel)
-    )
-    secs = median_time(timed)
+
+    def make_chain(length):
+        def kernel():
+            def step(carry, _):
+                return timed_step(carry), None
+
+            out, _ = jax.lax.scan(step, timed_step(None), None, length=length)
+            return out
+
+        return jax.jit(
+            functools.partial(
+                shard_map, mesh=mesh, in_specs=(), out_specs=timed_spec)(
+                kernel)
+        )
+
+    secs = delta_time(make_chain, iters_lo=1, iters_hi=9)
     return {
         "ok": err <= tol,
         "max_error": err,
@@ -87,11 +100,18 @@ def psum_probe(mesh: Mesh, axis: str = "dp", n_elems: int = 1 << 20) -> dict[str
         out = jax.lax.psum(contribution(), axis)
         return _replicate(jnp.max(jnp.abs(out - want)), mesh)
 
-    def timed():
-        return jax.lax.psum(contribution(), axis)
+    def timed_step(carry):
+        i = jax.lax.axis_index(axis).astype(jnp.float32)
+        if carry is None:
+            return contribution()
+        # mix the previous result back in: every hop stays data-dependent
+        # and per-shard distinct, so XLA can neither reorder nor fold it.
+        # `+ i` keeps the carry varying over the axis (a bare psum output is
+        # replicated, which scan rejects as a carry-type change).
+        return jax.lax.psum(contribution() + 1e-6 * carry, axis) + i
 
     moved = 2 * (n_dev - 1) / n_dev * (n_dev * n_elems * 4)
-    return _run(mesh, verify, timed, P(axis), moved, n_dev)
+    return _run(mesh, verify, timed_step, P(axis), moved, n_dev)
 
 
 def all_gather_probe(mesh: Mesh, axis: str = "tp", n_elems: int = 1 << 18) -> dict[str, Any]:
@@ -105,13 +125,15 @@ def all_gather_probe(mesh: Mesh, axis: str = "tp", n_elems: int = 1 << 18) -> di
         want = jnp.arange(n_dev, dtype=jnp.float32)[:, None]
         return _replicate(jnp.max(jnp.abs(g - want)), mesh)
 
-    def timed():
+    def timed_step(carry):
         i = jax.lax.axis_index(axis).astype(jnp.float32)
-        g = jax.lax.all_gather(jnp.full((n_elems,), i, jnp.float32), axis)
-        return g.reshape(-1)
+        if carry is None:
+            return jnp.full((n_elems,), i, jnp.float32)
+        g = jax.lax.all_gather(carry + i, axis)       # (n_dev, n_elems)
+        return jnp.mean(g, axis=0) + i                # keep carry varying
 
     moved = (n_dev - 1) / n_dev * (n_dev * n_elems * 4) * n_dev
-    return _run(mesh, verify, timed, P(axis), moved, n_dev)
+    return _run(mesh, verify, timed_step, P(axis), moved, n_dev)
 
 
 def reduce_scatter_probe(mesh: Mesh, axis: str = "tp", n_elems: int = 1 << 18) -> dict[str, Any]:
@@ -130,11 +152,15 @@ def reduce_scatter_probe(mesh: Mesh, axis: str = "tp", n_elems: int = 1 << 18) -
         out = jax.lax.psum_scatter(contribution(), axis, tiled=True)
         return _replicate(jnp.max(jnp.abs(out - want)), mesh)
 
-    def timed():
-        return jax.lax.psum_scatter(contribution(), axis, tiled=True)
+    def timed_step(carry):
+        i = jax.lax.axis_index(axis).astype(jnp.float32)
+        if carry is None:
+            return jnp.full((n_elems,), i, jnp.float32)
+        x = contribution() + 1e-6 * jnp.tile(carry, n_dev)
+        return jax.lax.psum_scatter(x, axis, tiled=True)
 
     moved = (n_dev - 1) / n_dev * (n_dev * n_dev * n_elems * 4)
-    return _run(mesh, verify, timed, P(axis), moved, n_dev)
+    return _run(mesh, verify, timed_step, P(axis), moved, n_dev)
 
 
 def ring_permute_probe(mesh: Mesh, axis: str = "sp", n_elems: int = 1 << 18) -> dict[str, Any]:
@@ -154,13 +180,14 @@ def ring_permute_probe(mesh: Mesh, axis: str = "sp", n_elems: int = 1 << 18) -> 
         want = (jax.lax.axis_index(axis).astype(jnp.float32) - 1) % n_dev
         return _replicate(jnp.max(jnp.abs(out - want)), mesh)
 
-    def timed():
+    def timed_step(carry):
         i = jax.lax.axis_index(axis).astype(jnp.float32)
-        payload = jnp.full((n_elems,), 0.0, jnp.float32) + i
-        return jax.lax.ppermute(payload, axis, perm)
+        if carry is None:
+            return jnp.full((n_elems,), 0.0, jnp.float32) + i
+        return jax.lax.ppermute(carry + i, axis, perm)
 
     moved = n_dev * n_elems * 4
-    return _run(mesh, verify, timed, P(axis), moved, n_dev)
+    return _run(mesh, verify, timed_step, P(axis), moved, n_dev)
 
 
 ALL_PROBES = {

@@ -1,0 +1,193 @@
+"""README generation: the offline stand-in for ``terraform-docs``.
+
+The reference's contributor workflow regenerates each module README's API
+tables with terraform-docs (``/root/reference/CONTRIBUTING.md:14``) — the
+README *is* the module's API documentation (SURVEY.md L7). This module
+renders the same tables (requirements, resources, inputs, outputs) from
+tfsim's parsed ``Module`` and splices them between marker comments, so CI can
+assert the docs never drift from ``variables.tf``/``outputs.tf``:
+
+    <!-- BEGIN_TF_DOCS -->
+    ...generated, do not edit by hand...
+    <!-- END_TF_DOCS -->
+"""
+
+from __future__ import annotations
+
+import json
+
+from . import ast as A
+from .module import Module
+
+BEGIN = "<!-- BEGIN_TF_DOCS -->"
+END = "<!-- END_TF_DOCS -->"
+
+
+def _render_default(expr: A.Expr | None) -> str | None:
+    """Best-effort literal rendering of a variable default, JSON-style."""
+    if expr is None:
+        return None
+    v = _literal(expr)
+    if v is _RAW:
+        return "`<expression>`"
+    return f"`{json.dumps(v)}`"
+
+
+_RAW = object()
+
+
+def _literal(e: A.Expr):
+    if isinstance(e, A.Literal):
+        return e.value
+    if isinstance(e, A.TupleExpr):
+        items = [_literal(x) for x in e.items]
+        return _RAW if any(x is _RAW for x in items) else items
+    if isinstance(e, A.ObjectExpr):
+        out = {}
+        for it in e.items:
+            k = it.key.value if isinstance(it.key, A.Literal) else _RAW
+            v = _literal(it.value)
+            if k is _RAW or v is _RAW:
+                return _RAW
+            out[str(k)] = v
+        return out
+    return _RAW
+
+
+def _md_escape(text: str) -> str:
+    return text.replace("\n", " ").replace("|", "\\|").strip()
+
+
+def generate_docs(mod: Module) -> str:
+    """Render the generated-docs block (without the BEGIN/END markers)."""
+    lines: list[str] = []
+    add = lines.append
+
+    # ---- requirements ------------------------------------------------
+    add("## Requirements")
+    add("")
+    add("| Name | Version |")
+    add("|------|---------|")
+    add(f"| terraform | `{mod.required_version or 'any'}` |")
+    for name in sorted(mod.required_providers):
+        spec = mod.required_providers[name]
+        ver = spec.get("version", "any")
+        src = spec.get("source", name)
+        add(f"| {name} ({src}) | `{ver}` |")
+    add("")
+
+    # ---- resources ---------------------------------------------------
+    managed = sorted(mod.resources)
+    data = sorted(mod.data_sources)
+    if managed or data:
+        add("## Resources")
+        add("")
+        add("| Address | Defined in |")
+        add("|---------|------------|")
+        for addr in managed:
+            r = mod.resources[addr]
+            add(f"| `{addr}` | `{r.file}:{r.line}` |")
+        for addr in data:
+            r = mod.data_sources[addr]
+            add(f"| `{addr}` | `{r.file}:{r.line}` |")
+        add("")
+
+    # ---- inputs ------------------------------------------------------
+    if mod.variables:
+        add("## Inputs")
+        add("")
+        add("| Name | Description | Type | Default | Required |")
+        add("|------|-------------|------|---------|:--------:|")
+        for name in sorted(mod.variables):
+            v = mod.variables[name]
+            desc = _md_escape(v.description or "n/a")
+            vtype = f"`{v.type}`" if v.type else "`any`"
+            default = _render_default(v.default)
+            required = "yes" if default is None else "no"
+            add(f"| {name} | {desc} | {vtype} | {default or 'n/a'} | {required} |")
+        add("")
+
+    # ---- outputs -----------------------------------------------------
+    if mod.outputs:
+        add("## Outputs")
+        add("")
+        add("| Name | Description | Sensitive |")
+        add("|------|-------------|:---------:|")
+        for name in sorted(mod.outputs):
+            o = mod.outputs[name]
+            desc = _md_escape(o.description or "n/a")
+            add(f"| {name} | {desc} | {'yes' if o.sensitive else ''} |")
+        add("")
+
+    return "\n".join(lines).rstrip() + "\n"
+
+
+class DocsError(ValueError):
+    pass
+
+
+def inject_docs(readme_text: str, mod: Module) -> str:
+    """Replace the text between the BEGIN/END markers with generated docs."""
+    if BEGIN not in readme_text or END not in readme_text:
+        raise DocsError(
+            f"README has no {BEGIN} / {END} markers to inject into"
+        )
+    head, rest = readme_text.split(BEGIN, 1)
+    _, tail = rest.split(END, 1)
+    return f"{head}{BEGIN}\n{generate_docs(mod)}{END}{tail}"
+
+
+def check_readme(module_dir: str) -> bool:
+    """True iff ``module_dir/README.md`` is in sync with the module."""
+    import os
+
+    from .module import load_module
+
+    readme = os.path.join(module_dir, "README.md")
+    with open(readme, encoding="utf-8") as f:
+        text = f.read()
+    return inject_docs(text, load_module(module_dir)) == text
+
+
+def update_readme(module_dir: str, write: bool = True) -> bool:
+    """Regenerate the docs block. Returns True if it was already in sync."""
+    import os
+
+    from .module import load_module
+
+    readme = os.path.join(module_dir, "README.md")
+    with open(readme, encoding="utf-8") as f:
+        text = f.read()
+    new = inject_docs(text, load_module(module_dir))
+    if new == text:
+        return True
+    if write:
+        with open(readme, "w", encoding="utf-8") as f:
+            f.write(new)
+    return False
+
+
+def main(argv: list[str] | None = None) -> int:
+    """CLI: ``python -m nvidia_terraform_modules_tpu.tfsim.docs [-check] DIR...``"""
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(prog="tfsim docs")
+    ap.add_argument("-check", action="store_true",
+                    help="fail (exit 3) if any README is out of sync")
+    ap.add_argument("dirs", nargs="+")
+    args = ap.parse_args(argv)
+
+    drift = 0
+    for d in args.dirs:
+        if args.check:
+            if not check_readme(d):
+                print(f"{d}/README.md: docs block out of sync", file=sys.stderr)
+                drift += 1
+        elif not update_readme(d):
+            print(f"{d}/README.md: updated")
+    return 3 if drift else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,369 @@
+"""Canonical HCL formatting: the offline stand-in for ``terraform fmt``.
+
+The reference's pre-checkin gate is ``terraform fmt`` run by hand
+(``/root/reference/CONTRIBUTING.md:12``); with no terraform binary in the
+test environment, this module reimplements the formatter's observable
+behaviour so CI can enforce it (``check_text``) and fix it (``format_text``):
+
+- two-space indentation derived from bracket structure, one level per line
+  that opens a group (hclwrite's rule: ``object({`` is ONE level, not two);
+- ``=`` alignment across runs of consecutive single-line attributes;
+- single space around ``=``; no trailing whitespace; tabs → spaces;
+- runs of blank lines collapsed to one; exactly one trailing newline;
+- heredoc bodies and block-comment interiors left verbatim.
+
+Like tfsim itself, it is a deliberate subset: it handles the HCL this repo
+writes and fails loudly (via the parser) on anything it cannot lex.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+_OPENERS = "([{"
+_CLOSERS = ")]}"
+_MATCH = {")": "(", "]": "[", "}": "{"}
+
+# attribute line: `name = expr` (not ==, =>, <=, >=, !=)
+_ATTR_RE = re.compile(
+    r"^(?P<name>[A-Za-z_][A-Za-z0-9_-]*)\s*=(?![=>])\s*(?P<value>.*)$"
+)
+
+
+@dataclasses.dataclass
+class _Line:
+    raw: str                 # original text, no trailing newline
+    verbatim: bool = False   # heredoc body / block-comment interior: untouched
+    blank: bool = False
+    # delimiters outside strings/comments, in order
+    delims: str = ""
+    # True if the line *starts* (after indent) with a closer
+    heredoc_open: bool = False
+
+
+def _scan(text: str) -> list[_Line]:
+    """Split source into lines annotated with structural facts.
+
+    A single forward scan tracks string / interpolation / comment / heredoc
+    state so delimiters inside them are not mistaken for structure.
+    """
+    lines = [_Line(raw=l) for l in text.split("\n")]
+    i, n = 0, len(text)
+    lineno = 0
+    # string-scanner context stack, as in lexer.py: str / interp / brace
+    stack: list[str] = []
+    in_block_comment = False
+    heredoc_marker: str | None = None
+    line_start = True
+
+    def cur(idx: int) -> _Line:
+        return lines[idx]
+
+    while i < n:
+        c = text[i]
+        if c == "\n":
+            # blank lines inside heredocs / block comments never reach the
+            # branches below — mark them verbatim here so the blank-run
+            # collapse can't eat heredoc content
+            if (heredoc_marker is not None or in_block_comment) and (
+                lines[lineno].raw.strip() == ""
+            ):
+                lines[lineno].verbatim = True
+            lineno += 1
+            i += 1
+            line_start = True
+            continue
+        ln = cur(lineno)
+
+        if heredoc_marker is not None:
+            ln.verbatim = True
+            if line_start and ln.raw.strip() == heredoc_marker:
+                heredoc_marker = None
+                ln.verbatim = True  # the end marker keeps its own indent
+            # skip to end of line
+            eol = text.find("\n", i)
+            i = n if eol < 0 else eol
+            continue
+
+        if in_block_comment:
+            end = text.find("*/", i)
+            eol = text.find("\n", i)
+            if end >= 0 and (eol < 0 or end < eol):
+                in_block_comment = False
+                if line_start:
+                    # line began inside the comment: keep it verbatim even
+                    # though the comment closes here
+                    ln.verbatim = True
+                i = end + 2
+            else:
+                if not line_start or ln.raw.strip() != "":
+                    ln.verbatim = ln.verbatim or not line_start
+                if line_start:
+                    ln.verbatim = True
+                i = n if eol < 0 else eol
+            continue
+
+        if stack:
+            # inside a (possibly interpolated) string
+            top = stack[-1]
+            if top == "str":
+                if c == "\\":
+                    i += 2
+                    continue
+                if text.startswith("${", i) or text.startswith("%{", i):
+                    stack.append("interp")
+                    i += 2
+                    continue
+                if c == '"':
+                    stack.pop()
+            else:
+                if c == '"':
+                    stack.append("str")
+                elif c == "{":
+                    stack.append("brace")
+                elif c == "}":
+                    stack.pop()
+            i += 1
+            line_start = False
+            continue
+
+        # ---- outside any string ----
+        if c == '"':
+            stack.append("str")
+            i += 1
+            line_start = False
+            continue
+        if c == "#" or text.startswith("//", i):
+            eol = text.find("\n", i)
+            i = n if eol < 0 else eol
+            continue
+        if text.startswith("/*", i):
+            in_block_comment = True
+            i += 2
+            line_start = False
+            continue
+        if text.startswith("<<", i):
+            j = i + 2
+            if j < n and text[j] in "-~":
+                j += 1
+            m = re.match(r"[A-Za-z_][A-Za-z0-9_]*", text[j:])
+            if m:
+                heredoc_marker = m.group(0)
+                ln.heredoc_open = True
+                eol = text.find("\n", i)
+                i = n if eol < 0 else eol
+                continue
+            i += 2
+            continue
+        if c in _OPENERS or c in _CLOSERS:
+            ln.delims += c
+        i += 1
+        line_start = False
+
+    for ln in lines:
+        ln.blank = (not ln.verbatim) and ln.raw.strip() == ""
+    return lines
+
+
+def _reindent(lines: list[_Line]) -> list[str]:
+    """Recompute indentation from bracket structure (2 spaces per level)."""
+    out: list[str] = []
+    # stack entries = number of delimiters opened by one source line
+    stack: list[int] = []
+    for ln in lines:
+        if ln.verbatim:
+            out.append(ln.raw)
+            continue
+        if ln.blank:
+            out.append("")
+            continue
+        content = ln.raw.strip()
+        # a line that starts with a closer sits at its opener's level
+        # (hclwrite's rule — even when it only partially closes the group,
+        # e.g. `})), [])` under `optional(list(object({`)
+        dedented = content[:1] in _CLOSERS and stack
+        level = len(stack) - 1 if dedented else len(stack)
+        opened = 0
+        for d in ln.delims:
+            if d in _OPENERS:
+                opened += 1
+            else:
+                if opened > 0:
+                    opened -= 1
+                elif stack:
+                    stack[-1] -= 1
+                    if stack[-1] == 0:
+                        stack.pop()
+        if opened > 0:
+            stack.append(opened)
+        out.append("  " * level + content)
+    return out
+
+
+def _align(lines: list[str], scanned: list[_Line]) -> list[str]:
+    """Align ``=`` across runs of consecutive single-line attributes."""
+    out = list(lines)
+    run: list[int] = []
+
+    def flush():
+        if len(run) >= 2:
+            parsed = []
+            for idx in run:
+                indent = len(out[idx]) - len(out[idx].lstrip())
+                m = _ATTR_RE.match(out[idx].strip())
+                parsed.append((idx, indent, m.group("name"), m.group("value")))
+            width = max(len(name) for _, _, name, _ in parsed)
+            for idx, indent, name, value in parsed:
+                out[idx] = f"{' ' * indent}{name}{' ' * (width - len(name))} = {value}"
+        run.clear()
+
+    prev_indent = None
+    for idx, text in enumerate(out):
+        if scanned[idx].verbatim:
+            flush()
+            prev_indent = None
+            continue
+        stripped = text.strip()
+        indent = len(text) - len(text.lstrip())
+        m = _ATTR_RE.match(stripped)
+        # a run member must be a one-line attribute (balanced delimiters,
+        # no heredoc opener) at the same indent as the rest of the run
+        one_line = (
+            m is not None
+            and not scanned[idx].heredoc_open
+            and _balanced(scanned[idx].delims)
+        )
+        if one_line and (prev_indent is None or indent == prev_indent or not run):
+            if run and indent != prev_indent:
+                flush()
+            run.append(idx)
+            prev_indent = indent
+        else:
+            flush()
+            prev_indent = None
+    flush()
+    return out
+
+
+def _balanced(delims: str) -> bool:
+    stack: list[str] = []
+    for d in delims:
+        if d in _OPENERS:
+            stack.append(d)
+        else:
+            if not stack or stack[-1] != _MATCH[d]:
+                return False
+            stack.pop()
+    return not stack
+
+
+def format_text(text: str) -> str:
+    """Return the canonical form of ``text``."""
+    scanned = _scan(text)
+    indented = _reindent(scanned)
+    aligned = _align(indented, scanned)
+    # collapse blank-line runs (outside verbatim regions), drop leading blanks
+    out: list[str] = []
+    blank_pending = False
+    for ln, meta in zip(aligned, scanned):
+        if not meta.verbatim and ln.strip() == "":
+            blank_pending = bool(out)
+            continue
+        if blank_pending:
+            out.append("")
+            blank_pending = False
+        out.append(ln if meta.verbatim else ln.rstrip())
+    return "\n".join(out) + "\n"
+
+
+@dataclasses.dataclass
+class FmtDiff:
+    path: str
+    line: int       # 1-based line in the ORIGINAL file
+    got: str
+    want: str
+
+    def __str__(self) -> str:
+        return (f"{self.path}:{self.line}: not canonically formatted\n"
+                f"  got:  {self.got!r}\n  want: {self.want!r}")
+
+
+def check_text(text: str, path: str = "<hcl>") -> list[FmtDiff]:
+    """Diff ``text`` against its canonical form; empty list = already canonical."""
+    formatted = format_text(text)
+    if formatted == text:
+        return []
+    import difflib
+
+    diffs: list[FmtDiff] = []
+    orig = text.split("\n")
+    new = formatted.split("\n")
+    sm = difflib.SequenceMatcher(a=orig, b=new, autojunk=False)
+    for tag, i1, i2, j1, j2 in sm.get_opcodes():
+        if tag == "equal":
+            continue
+        got = orig[i1] if i1 < len(orig) else ""
+        want = new[j1] if j1 < len(new) else ""
+        diffs.append(FmtDiff(path, i1 + 1, got, want))
+    return diffs
+
+
+def check_file(path: str) -> list[FmtDiff]:
+    with open(path, encoding="utf-8") as f:
+        return check_text(f.read(), path)
+
+
+def format_file(path: str, write: bool = False) -> bool:
+    """Format one file. Returns True if it was already canonical."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    formatted = format_text(text)
+    if formatted == text:
+        return True
+    if write:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(formatted)
+    return False
+
+
+def main(argv: list[str] | None = None) -> int:
+    """CLI: ``python -m nvidia_terraform_modules_tpu.tfsim.fmt [-check] PATH...``
+
+    Mirrors ``terraform fmt``: rewrites files to canonical form by default;
+    ``-check`` only reports (exit 3 on drift, like terraform's ``-check``).
+    Directory arguments are searched recursively for ``*.tf``.
+    """
+    import argparse
+    import glob as _glob
+    import os
+    import sys
+
+    ap = argparse.ArgumentParser(prog="tfsim fmt")
+    ap.add_argument("-check", action="store_true",
+                    help="report files that are not canonically formatted")
+    ap.add_argument("paths", nargs="+")
+    args = ap.parse_args(argv)
+
+    files: list[str] = []
+    for p in args.paths:
+        if os.path.isdir(p):
+            files += sorted(_glob.glob(os.path.join(p, "**", "*.tf"),
+                                       recursive=True))
+        else:
+            files.append(p)
+
+    drift = 0
+    for path in files:
+        if args.check:
+            for d in check_file(path):
+                print(d, file=sys.stderr)
+                drift += 1
+        elif not format_file(path, write=True):
+            print(path)
+            drift += 1
+    return 3 if (args.check and drift) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -113,6 +113,7 @@ def _type_expr_str(body: A.Body) -> Optional[str]:
 
 
 def _render_type(e: A.Expr) -> str:
+    """Render a type expression back to valid HCL (for docs / messages)."""
     if isinstance(e, A.Traversal):
         base = e.root
         return base
@@ -126,7 +127,16 @@ def _render_type(e: A.Expr) -> str:
             for it in e.items
         )
         return f"{{{inner}}}"
+    if isinstance(e, A.TupleExpr):
+        return f"[{', '.join(_render_type(x) for x in e.items)}]"
     if isinstance(e, A.Literal):
+        # HCL literals, not Python reprs: true/false, quoted strings
+        if isinstance(e.value, bool):
+            return "true" if e.value else "false"
+        if isinstance(e.value, str):
+            return f'"{e.value}"'
+        if e.value is None:
+            return "null"
         return str(e.value)
     return type(e).__name__
 

@@ -48,6 +48,38 @@ def test_tpu_example_plans_slice_and_identity():
     assert plan.outputs["tpu_slices"]["default"]["total_chips"] == 8
 
 
+def test_tpu_example_plans_private_ca_and_fluentbit():
+    plan = simulate_plan(
+        os.path.join(ROOT, "gke-tpu", "examples", "cnpack"),
+        {"project_id": "proj-y"},
+    )
+    addrs = set(plan.instances)
+    # CAS private CA (reference analogue: aws-pca.tf)
+    assert "google_privateca_ca_pool.cnpack[0]" in addrs
+    assert "google_privateca_certificate_authority.cnpack[0]" in addrs
+    assert "google_privateca_ca_pool_iam_member.cas_issuer_requester[0]" in addrs
+    wi = plan.instance("google_service_account_iam_member.cas_issuer_wi[0]")
+    assert "cert-manager/google-cas-issuer" in wi.attrs["member"]
+    # Fluent Bit log shipping (reference analogue: aws-fluentbit.tf)
+    assert "google_logging_project_bucket_config.cnpack[0]" in addrs
+    fb = plan.instance("google_service_account_iam_member.fluentbit_wi[0]")
+    assert "tpu-monitoring/tpu-fluentbit" in fb.attrs["member"]
+    assert plan.outputs["log_bucket"] == "tpu-cnpack-logs"
+    assert plan.outputs["ca_pool"] == "tpu-cnpack-ca-pool"
+
+
+def test_tpu_example_ca_and_fluentbit_toggles_off():
+    plan = simulate_plan(
+        os.path.join(ROOT, "gke-tpu", "examples", "cnpack"),
+        {"project_id": "proj-y", "private_ca_enabled": False,
+         "fluentbit_enabled": False},
+    )
+    assert not any("privateca" in a or "fluentbit" in a.lower()
+                   for a in plan.instances)
+    assert plan.outputs["ca_pool"] is None
+    assert plan.outputs["log_bucket"] is None
+
+
 def test_gpu_example_plans_cluster_and_identity():
     plan = simulate_plan(
         os.path.join(ROOT, "gke", "examples", "cnpack"),

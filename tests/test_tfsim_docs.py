@@ -1,0 +1,67 @@
+"""Tests for tfsim.docs — the ``terraform-docs`` stand-in.
+
+The reference regenerates README API tables with terraform-docs
+(``/root/reference/CONTRIBUTING.md:14``); here CI enforces that every module
+README's generated block is in sync with the parsed module.
+"""
+
+import os
+
+import pytest
+
+from nvidia_terraform_modules_tpu.tfsim.docs import (
+    DocsError,
+    check_readme,
+    generate_docs,
+    inject_docs,
+)
+from nvidia_terraform_modules_tpu.tfsim.module import load_module
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MODULES = ["gke", "gke-tpu", "gke/examples/cnpack", "gke-tpu/examples/cnpack"]
+
+
+@pytest.mark.parametrize("moddir", MODULES)
+def test_readme_docs_in_sync(moddir):
+    assert check_readme(os.path.join(ROOT, moddir)), (
+        f"{moddir}/README.md drifted — regenerate with "
+        f"`python -m nvidia_terraform_modules_tpu.tfsim.docs {moddir}`"
+    )
+
+
+def test_generated_docs_cover_all_variables_and_outputs():
+    mod = load_module(os.path.join(ROOT, "gke-tpu"))
+    docs = generate_docs(mod)
+    for name in mod.variables:
+        assert f"| {name} |" in docs, f"variable {name} missing from docs"
+    for name in mod.outputs:
+        assert f"| {name} |" in docs, f"output {name} missing from docs"
+    # required/optional classification
+    assert "| project_id | GCP project to deploy into. | `string` | n/a | yes |" in docs
+
+
+def test_sensitive_outputs_flagged():
+    mod = load_module(os.path.join(ROOT, "gke-tpu"))
+    docs = generate_docs(mod)
+    sensitive = [o.name for o in mod.outputs.values() if o.sensitive]
+    assert sensitive, "expected at least one sensitive output in gke-tpu"
+    for name in sensitive:
+        row = next(l for l in docs.splitlines() if l.startswith(f"| {name} |"))
+        assert row.rstrip().endswith("yes |")
+
+
+def test_inject_requires_markers():
+    mod = load_module(os.path.join(ROOT, "gke"))
+    with pytest.raises(DocsError):
+        inject_docs("# readme without markers\n", mod)
+
+
+def test_inject_preserves_surrounding_prose():
+    mod = load_module(os.path.join(ROOT, "gke"))
+    text = "# Title\n\nprose before\n\n<!-- BEGIN_TF_DOCS -->\nstale\n<!-- END_TF_DOCS -->\n\nprose after\n"
+    new = inject_docs(text, mod)
+    assert new.startswith("# Title\n\nprose before\n")
+    assert new.endswith("\n\nprose after\n")
+    assert "stale" not in new
+    assert "## Inputs" in new
