@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..ops.ring_attention import dense_reference_attention, ring_self_attention
 from ..parallel.sharding import ShardingRules
 
 
@@ -38,6 +39,15 @@ class BurnInConfig:
     seq_len: int = 128
     batch: int = 8
     dtype: Any = jnp.bfloat16
+    # "dense": gather the sequence, O(S²) attention sharded over heads (tp).
+    # "ring":  keep the sequence sharded on sp; K/V blocks rotate over the ICI
+    #          ring (ops.ring_attention) — exact, O(S/sp) resident memory, the
+    #          long-context path the slice's placement policy exists for.
+    attn: str = "dense"
+
+    def __post_init__(self):
+        if self.attn not in ("dense", "ring"):
+            raise ValueError(f"unknown attn impl {self.attn!r}; use dense|ring")
 
     @property
     def head_dim(self) -> int:
@@ -107,26 +117,32 @@ def forward(params, tokens, cfg: BurnInConfig, rules: ShardingRules | None = Non
     # sequence-parallel resident layout between blocks
     x = constrain(x, P("dp", "sp", None))
 
-    causal = jnp.tril(jnp.ones((cfg.seq_len, cfg.seq_len), dtype=jnp.bool_))
+    use_ring = cfg.attn == "ring" and rules is not None
     for layer in params["layers"]:
         h = _rmsnorm(x, layer["attn_norm"])
-        # attention needs the full sequence: gather sp → shard heads on tp
-        h = constrain(h, P("dp", None, None))
+        if use_ring:
+            # sequence stays sharded on sp; only K/V blocks travel (ICI ring)
+            h = constrain(h, P("dp", "sp", None))
+            seq_spec = P("dp", "sp", "tp", None)
+        else:
+            # attention needs the full sequence: gather sp → shard heads on tp
+            h = constrain(h, P("dp", None, None))
+            seq_spec = P("dp", None, "tp", None)
         q = h @ layer["wq"]
         k = h @ layer["wk"]
         v = h @ layer["wv"]
 
         def split(t):
             t = t.reshape(t.shape[0], t.shape[1], cfg.n_heads, cfg.head_dim)
-            return constrain(t, P("dp", None, "tp", None))
+            return constrain(t, seq_spec)
 
         q, k, v = split(q), split(k), split(v)
-        scores = jnp.einsum("bshd,bthd->bhst", q, k) / jnp.sqrt(
-            jnp.asarray(cfg.head_dim, dtype=jnp.float32)
-        ).astype(q.dtype)
-        scores = jnp.where(causal[None, None, :, :], scores, -1e9)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-        attn = jnp.einsum("bhst,bthd->bshd", probs, v)
+        if use_ring:
+            attn = ring_self_attention(
+                q, k, v, rules.mesh, causal=True, spec=seq_spec
+            )
+        else:
+            attn = dense_reference_attention(q, k, v, causal=True)
         attn = attn.reshape(attn.shape[0], attn.shape[1], cfg.d_model)
         x = x + constrain(attn @ layer["wo"], P("dp", "sp", None))
 

@@ -1,3 +1,8 @@
-"""Hardware micro-probes (MXU matmul, HBM streaming) used by bench + smoketest."""
+"""Hardware micro-probes and TPU-first compute ops (ring attention)."""
 
 from .probes import hbm_probe, matmul_probe  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    dense_reference_attention,
+    ring_attention_kernel,
+    ring_self_attention,
+)

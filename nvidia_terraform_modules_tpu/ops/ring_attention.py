@@ -1,0 +1,142 @@
+"""Ring attention: exact long-context attention over the ``sp`` mesh axis.
+
+The reference framework has no sequence dimension at all (SURVEY §5 — it is an
+IaC repo); its long-context analogue is "scale the slice". This module is the
+workload-side half of that story: the ``gke-tpu`` placement policy promises an
+ICI ring (validated by ``parallel.collectives.ring_permute_probe``), and ring
+attention is the op that *uses* the ring — each device keeps only its sequence
+shard resident and K/V blocks rotate neighbour-to-neighbour, so attention over
+a sequence of length S costs O(S/sp) memory per chip while staying exact.
+
+TPU-first design:
+- built on ``shard_map`` + ``jax.lax.ppermute`` so XLA lowers the rotation to
+  bare ICI sends — the compiler overlaps the next block's transfer with the
+  current block's matmuls (collective-permute is async on TPU);
+- blockwise online softmax (running max / running normaliser) in f32 on the
+  VPU, block matmuls on the MXU in the input dtype;
+- a ``lax.scan`` over ring steps: one traced step, n executions, static shapes
+  throughout;
+- fully differentiable (scan + ppermute both have transpose rules), so the
+  burn-in train step can run with ring attention unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30  # finite ­"-inf": avoids NaN from (-inf) - (-inf) in the update
+
+
+def _block_scores(q_f32, k, mask):
+    """Masked attention scores for one (q-shard × kv-block) tile: [B,H,Q,K]."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q_f32, k.astype(jnp.float32))
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    return s
+
+
+def ring_attention_kernel(q, k, v, *, axis_name: str, causal: bool = True,
+                          scale: float | None = None):
+    """Per-shard ring attention body; call inside ``shard_map``.
+
+    Args:
+      q, k, v: local shards ``[B, S_local, H, D]``, sequence sharded over
+        ``axis_name``.
+      axis_name: mesh axis carrying the sequence shards (the ICI ring).
+      causal: apply a causal mask in *global* sequence positions.
+      scale: softmax scale; defaults to ``1/sqrt(D)``.
+
+    Returns the attention output ``[B, S_local, H, D]`` in ``q.dtype``.
+    """
+    n = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    q_f32 = q.astype(jnp.float32) * scale
+    q_pos = me * s_loc + jnp.arange(s_loc)
+
+    # send my current K/V block to the next rank; receive from the previous,
+    # so at ring step t I hold the block originally owned by (me - t) mod n.
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def update(m, l, o, k_blk, v_blk, t):
+        """Online-softmax fold of the block owned by rank ``(me - t) mod n``."""
+        src = (me - t) % n
+        if causal:
+            k_pos = src * s_loc + jnp.arange(s_loc)
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = None
+        s = _block_scores(q_f32, k_blk, mask)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))               # [B,H,Q]
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)  # masked entries contribute 0
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
+        corr = jnp.exp(m - m_new)                                 # [B,H,Q]
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * jnp.swapaxes(corr, 1, 2)[..., None] + pv
+        return m_new, l, o
+
+    def step(carry, t):
+        m, l, o, k_blk, v_blk = carry
+        m, l, o = update(m, l, o, k_blk, v_blk, t)
+        # the send only reads this step's block, so XLA can launch the
+        # collective-permute before/alongside the block matmuls above
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (m, l, o, k_blk, v_blk), None
+
+    m = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, s_loc), jnp.float32)
+    o = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    k_blk, v_blk = k, v
+    if n > 1:  # rotate through the first n-1 blocks…
+        (m, l, o, k_blk, v_blk), _ = jax.lax.scan(
+            step, (m, l, o, k_blk, v_blk), jnp.arange(n - 1)
+        )
+    # …and fold the final block without the wasted last hop
+    m, l, o = update(m, l, o, k_blk, v_blk, n - 1)
+    l = jnp.maximum(l, 1e-30)  # fully-masked rows (non-causal n/a) stay finite
+    out = o / jnp.swapaxes(l, 1, 2)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_self_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
+                        axis_name: str = "sp",
+                        spec: P = P("dp", "sp", "tp", None),
+                        scale: float | None = None):
+    """shard_map wrapper: exact attention with sequence sharded on ``axis_name``.
+
+    ``q, k, v`` are global arrays ``[B, S, H, D]``; ``spec`` maps (batch → dp,
+    sequence → sp ring, heads → tp). Heads stay local — only K/V blocks move,
+    one neighbour hop per ring step.
+    """
+    kernel = functools.partial(
+        ring_attention_kernel, axis_name=axis_name, causal=causal, scale=scale
+    )
+    return jax.shard_map(
+        kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def dense_reference_attention(q, k, v, *, causal: bool = True,
+                              scale: float | None = None):
+    """Unsharded O(S²) reference used by tests and single-device fallback."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32))
+    if causal:
+        s_len = q.shape[1]
+        mask = jnp.tril(jnp.ones((s_len, s_len), jnp.bool_))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
