@@ -53,6 +53,8 @@ def main() -> None:
     import jax.numpy as jnp
 
     cfg = (
+        # dense attention: at S=512 XLA's fused op beats the pallas kernel
+        # (flash wins from ~2k context — measured separately below)
         BurnInConfig(vocab=8192, d_model=512, n_heads=8, d_ff=2048, n_layers=4,
                      seq_len=512, batch=16)
         if on_tpu
@@ -73,6 +75,43 @@ def main() -> None:
     sync(loss)  # d2h readback: the only reliable barrier on tunnelled backends
     tokens_per_s = cfg.batch * cfg.seq_len * iters / (time.perf_counter() - t_step)
 
+    # long-context attention: pallas flash kernel vs XLA dense at S=4096 —
+    # the regime ring/flash attention exist for (O(S²) HBM traffic dominates)
+    longctx: dict[str, float] = {}
+    if on_tpu:
+        from nvidia_terraform_modules_tpu.ops import flash_attention
+        from nvidia_terraform_modules_tpu.ops.ring_attention import (
+            dense_reference_attention,
+        )
+        from nvidia_terraform_modules_tpu.utils.timing import delta_time
+
+        S = 4096
+        ks = jax.random.split(jax.random.PRNGKey(2), 3)
+        q, k, v = (jax.random.normal(kk, (2, S, 8, 64), jnp.bfloat16)
+                   for kk in ks)
+
+        def make_chain(op):
+            def factory(length):
+                @jax.jit
+                def chain(q, k, v):
+                    def s(acc, _):
+                        return op(acc, k, v), None
+                    out, _ = jax.lax.scan(s, q, None, length=length)
+                    return out
+                return chain
+            return factory
+
+        t_flash = delta_time(make_chain(flash_attention), q, k, v,
+                             iters_lo=2, iters_hi=10)
+        t_dense = delta_time(make_chain(dense_reference_attention), q, k, v,
+                             iters_lo=2, iters_hi=10)
+        longctx = {
+            "longctx_s": S,
+            "longctx_flash_ms": round(t_flash * 1e3, 3),
+            "longctx_dense_ms": round(t_dense * 1e3, 3),
+            "longctx_flash_speedup": round(t_dense / t_flash, 2),
+        }
+
     line = {
         "metric": "accelerator_validation_seconds",
         "value": round(validation_seconds, 2),
@@ -86,6 +125,8 @@ def main() -> None:
         "matmul_roofline": round(mm["roofline_fraction"], 3),
         "hbm_gibps": round(hbm["gibps"], 1),
         "burnin_tokens_per_s": round(tokens_per_s, 1),
+        "burnin_attn": cfg.attn,
+        **longctx,
     }
     print(json.dumps(line), flush=True)
 

@@ -19,12 +19,14 @@ Design notes (TPU-first):
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..ops.flash_attention import flash_attention
 from ..ops.ring_attention import dense_reference_attention, ring_self_attention
 from ..parallel.sharding import ShardingRules
 
@@ -43,11 +45,14 @@ class BurnInConfig:
     # "ring":  keep the sequence sharded on sp; K/V blocks rotate over the ICI
     #          ring (ops.ring_attention) — exact, O(S/sp) resident memory, the
     #          long-context path the slice's placement policy exists for.
+    # "flash": fused pallas kernel (ops.flash_attention) on the gathered
+    #          sequence — the [S,S] score matrix never touches HBM.
     attn: str = "dense"
 
     def __post_init__(self):
-        if self.attn not in ("dense", "ring"):
-            raise ValueError(f"unknown attn impl {self.attn!r}; use dense|ring")
+        if self.attn not in ("dense", "ring", "flash"):
+            raise ValueError(
+                f"unknown attn impl {self.attn!r}; use dense|ring|flash")
 
     @property
     def head_dim(self) -> int:
@@ -141,6 +146,17 @@ def forward(params, tokens, cfg: BurnInConfig, rules: ShardingRules | None = Non
             attn = ring_self_attention(
                 q, k, v, rules.mesh, causal=True, spec=seq_spec
             )
+        elif cfg.attn == "flash":
+            fa = functools.partial(flash_attention, causal=True)
+            if rules is None:
+                attn = fa(q, k, v)
+            else:
+                # pallas_call is a per-device program: shard_map it so each
+                # device runs the kernel on its (batch, head) shards
+                attn = jax.shard_map(
+                    fa, mesh=rules.mesh, in_specs=(seq_spec,) * 3,
+                    out_specs=seq_spec, check_vma=False,
+                )(q, k, v)
         else:
             attn = dense_reference_attention(q, k, v, causal=True)
         attn = attn.reshape(attn.shape[0], attn.shape[1], cfg.d_model)

@@ -1,5 +1,6 @@
 """Hardware micro-probes and TPU-first compute ops (ring attention)."""
 
+from .flash_attention import flash_attention  # noqa: F401
 from .probes import hbm_probe, matmul_probe  # noqa: F401
 from .ring_attention import (  # noqa: F401
     dense_reference_attention,

@@ -1,0 +1,332 @@
+"""Pallas TPU flash attention: fused, tiled, O(S) memory, custom VJP.
+
+The hot op of the burn-in workload (and of any transformer a provisioned slice
+will run) is attention. XLA already fuses elementwise chains into the matmuls;
+what it does NOT do is tile the softmax(QKᵀ)V contraction so the [S, S] score
+matrix never materialises in HBM. That is this kernel's job — the classic
+flash-attention recurrence, written for the MXU/VMEM model of the pallas guide
+(`/opt/skills/guides/pallas_guide.md`):
+
+- grid (batch·heads, q-blocks, k-blocks); k innermost so the f32 accumulators
+  (o, m, l) live in VMEM scratch across the k sweep;
+- block matmuls run in the input dtype on the MXU (bf16 in production) with
+  ``preferred_element_type=f32`` accumulation; the online softmax runs on the
+  VPU in f32;
+- causal masking is block-sparse: k-blocks strictly above the diagonal are
+  skipped with ``pl.when`` (no FLOPs, no mask materialisation);
+- the backward pass recomputes P = exp(S - L) per tile from the saved
+  logsumexp L (flash-style rematerialisation: trade FLOPs for HBM) in two
+  kernels — dq, and (dk, dv) — matching the split the forward's tiling
+  induces.
+
+CPU runs (tests, the virtual-mesh rig) use ``interpret=True`` automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _on_interpret_platform() -> bool:
+    return jax.devices()[0].platform != "tpu"
+
+
+def _tile_scores(q_ref, k_ref, qi, ki, *, scale, causal, block_q, block_k):
+    """Scaled, causally-masked f32 scores for one (q-block × k-block) tile.
+
+    Shared by the forward and both backward kernels so masking/precision can
+    never drift between them. The matmul keeps the input dtype on the MXU and
+    accumulates f32; the scale is applied to the f32 scores.
+    """
+    s = jax.lax.dot_general(
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale          # [bq, bk]
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    return s
+
+
+def _masked_exp(s, ref):
+    """exp(s - ref) with fully-masked entries forced to 0 (not exp(0))."""
+    p = jnp.exp(s - ref)
+    return jnp.where(s <= NEG_INF / 2, 0.0, p)
+
+
+def _causal_live(qi, ki, *, causal, block_q, block_k):
+    """Python-level predicate: does block (qi, ki) intersect the causal mask?
+
+    Evaluated on traced grid ids → returns a traced bool for ``pl.when``;
+    k-blocks strictly above the diagonal are skipped entirely.
+    """
+    if not causal:
+        return True
+    return ki * block_k <= qi * block_q + block_q - 1
+
+
+# ---------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale: float, causal: bool,
+                block_q: int, block_k: int):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(_causal_live(qi, ki, causal=causal, block_q=block_q,
+                          block_k=block_k))
+    def _compute():
+        s = _tile_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+                         block_q=block_q, block_k=block_k)
+        m_prev, l_prev = m_scr[:], l_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = _masked_exp(s, m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [bq, d]
+        acc_scr[:] = acc_scr[:] * corr + pv
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:] + jnp.log(l)
+
+
+def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+    bh, s, d = q.shape
+    nq, nk = s // block_q, s // block_k
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k)
+    grid = (bh, nq, nk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            # [bh, s, 1]: trailing singleton keeps the block TPU-tileable
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running max m
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running normaliser l
+            pltpu.VMEM((block_q, d), jnp.float32),   # output accumulator
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ------------------------------------------------------------- backward
+
+def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki, *,
+              scale, causal, block_q, block_k):
+    """Rematerialised P and dS for one tile (shared by dq and dk/dv)."""
+    s = _tile_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+                     block_q=block_q, block_k=block_k)
+    p = _masked_exp(s, lse_ref[0])                           # [bq, bk]
+    do = do_ref[0]
+    dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0])                             # [bq, bk] f32
+    return p, ds, do
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_scr, *, scale: float, causal: bool,
+               block_q: int, block_k: int):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(_causal_live(qi, ki, causal=causal, block_q=block_q,
+                          block_k=block_k))
+    def _compute():
+        _, ds, _ = _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                             qi, ki, scale=scale, causal=causal,
+                             block_q=block_q, block_k=block_k)
+        acc_scr[:] = acc_scr[:] + jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = (acc_scr[:] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                causal: bool, block_q: int, block_k: int):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(_causal_live(qi, ki, causal=causal, block_q=block_q,
+                          block_k=block_k))
+    def _compute():
+        p, ds, do = _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                              qi, ki, scale=scale, causal=causal,
+                              block_q=block_q, block_k=block_k)
+        # dV += Pᵀ dO
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dK += dSᵀ Q  (scale applied at finalize)
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = (dk_scr[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+# ------------------------------------------------------ public wrapper
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhsd(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, _ = _fwd(q, k, v, scale=scale, causal=causal,
+                block_q=block_q, block_k=block_k, interpret=interpret)
+    return o
+
+
+def _flash_bhsd_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, scale=scale, causal=causal,
+                  block_q=block_q, block_k=block_k, interpret=interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bhsd_bwd(scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    bh, s, d = q.shape
+    nq, nk = s // block_q, s // block_k
+    # delta = rowsum(dO ⊙ O): a cheap fused XLA reduction, computed once
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)                     # [bh, s, 1]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+_flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
+
+
+def _fit_block(s: int, want: int | None) -> int:
+    """Largest divisor of ``s`` ≤ ``want``; ``None`` picks a size by S.
+
+    Measured on v5e: 128-blocks win at short S (grid overhead amortises
+    poorly), 512-blocks win at long S (fewer, fatter MXU tiles) — crossover
+    around S/8.
+    """
+    if want is None:
+        want = min(512, max(128, s // 8))
+    b = min(want, s)
+    while s % b:
+        b -= 1
+    return b
+
+
+def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
+                    block_q: int | None = None, block_k: int | None = None,
+                    interpret: bool | None = None):
+    """Fused flash attention on ``[B, S, H, D]`` inputs (burn-in layout).
+
+    Blocks default to a measured size heuristic and shrink to the largest
+    divisor of S ≤ the requested size, so any sequence length works; sizes
+    that leave no MXU-tileable divisor (< 8 for an S > 8) are rejected.
+    Returns ``[B, S, H, D]`` in the input dtype.
+    """
+    b, s, h, d = q.shape
+    block_q, block_k = _fit_block(s, block_q), _fit_block(s, block_k)
+    if s > 8 and (block_q < 8 or block_k < 8):
+        raise ValueError(
+            f"seq len {s} has no block divisor in [8, 128]; pad the sequence")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = _on_interpret_platform()
+
+    def to_bhsd(t):
+        return t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    o = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), scale, causal,
+                    block_q, block_k, interpret)
+    return o.reshape(b, h, s, d).transpose(0, 2, 1, 3)

@@ -31,9 +31,14 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -1e30  # finite ­"-inf": avoids NaN from (-inf) - (-inf) in the update
 
 
-def _block_scores(q_f32, k, mask):
-    """Masked attention scores for one (q-shard × kv-block) tile: [B,H,Q,K]."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q_f32, k.astype(jnp.float32))
+def _block_scores(q, k, scale, mask):
+    """Masked attention scores for one (q-shard × kv-block) tile: [B,H,Q,K].
+
+    The matmul stays in the input dtype (bf16 on the MXU) and accumulates in
+    f32; the scale is applied to the f32 scores, not the bf16 operands.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if mask is not None:
         s = jnp.where(mask[None, None], s, NEG_INF)
     return s
@@ -57,7 +62,6 @@ def ring_attention_kernel(q, k, v, *, axis_name: str, causal: bool = True,
     b, s_loc, h, d = q.shape
     if scale is None:
         scale = 1.0 / (d ** 0.5)
-    q_f32 = q.astype(jnp.float32) * scale
     q_pos = me * s_loc + jnp.arange(s_loc)
 
     # send my current K/V block to the next rank; receive from the previous,
@@ -72,11 +76,12 @@ def ring_attention_kernel(q, k, v, *, axis_name: str, causal: bool = True,
             mask = q_pos[:, None] >= k_pos[None, :]
         else:
             mask = None
-        s = _block_scores(q_f32, k_blk, mask)
+        s = _block_scores(q, k_blk, scale, mask)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))               # [B,H,Q]
         p = jnp.exp(s - m_new[..., None])
         p = jnp.where(s <= NEG_INF / 2, 0.0, p)  # masked entries contribute 0
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk,
+                        preferred_element_type=jnp.float32)
         corr = jnp.exp(m - m_new)                                 # [B,H,Q]
         l = l * corr + jnp.sum(p, axis=-1)
         o = o * jnp.swapaxes(corr, 1, 2)[..., None] + pv
@@ -131,12 +136,12 @@ def dense_reference_attention(q, k, v, *, causal: bool = True,
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
-                   k.astype(jnp.float32))
+    mask = None
     if causal:
         s_len = q.shape[1]
         mask = jnp.tril(jnp.ones((s_len, s_len), jnp.bool_))
-        s = jnp.where(mask[None, None], s, NEG_INF)
+    s = _block_scores(q, k, scale, mask)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
     return out.astype(q.dtype)

@@ -1,0 +1,124 @@
+"""Pallas flash attention: exactness vs dense, grads, burn-in integration.
+
+Runs in pallas interpret mode on the virtual CPU mesh (the kernel's TPU
+lowering shares the same trace), mirroring how tfsim stands in for terraform:
+full logic coverage offline, hardware numbers from bench.py on the chip.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nvidia_terraform_modules_tpu.models import (
+    BurnInConfig,
+    forward,
+    init_params,
+    make_train_step,
+    synthetic_batch,
+)
+from nvidia_terraform_modules_tpu.ops import flash_attention
+from nvidia_terraform_modules_tpu.ops.ring_attention import (
+    dense_reference_attention,
+)
+from nvidia_terraform_modules_tpu.parallel import build_mesh, make_rules, plan_mesh
+
+
+def _qkv(b=2, s=64, h=2, d=16, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    return tuple(jax.random.normal(k, (b, s, h, d), dtype) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("block", [16, 32, 64])
+def test_flash_matches_dense(causal, block):
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, causal=causal, block_q=block, block_k=block)
+    ref = dense_reference_attention(q, k, v, causal=causal)
+    assert jnp.max(jnp.abs(out - ref)) < 1e-5
+
+
+def test_flash_rectangular_blocks():
+    q, k, v = _qkv(s=64)
+    out = flash_attention(q, k, v, block_q=16, block_k=32)
+    ref = dense_reference_attention(q, k, v)
+    assert jnp.max(jnp.abs(out - ref)) < 1e-5
+
+
+def test_flash_gradients_match_dense():
+    q, k, v = _qkv(s=32)
+
+    def f1(q, k, v):
+        return jnp.sum(jnp.square(flash_attention(q, k, v, block_q=16,
+                                                  block_k=16)))
+
+    def f2(q, k, v):
+        return jnp.sum(jnp.square(dense_reference_attention(q, k, v)))
+
+    g1 = jax.grad(f1, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f2, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        assert jnp.max(jnp.abs(a - b)) < 1e-4
+
+
+def test_flash_bf16_close_to_f32_dense():
+    q, k, v = _qkv(s=32, dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v).astype(jnp.float32)
+    ref = dense_reference_attention(
+        *(t.astype(jnp.float32) for t in (q, k, v)))
+    assert jnp.max(jnp.abs(out - ref)) < 0.05  # bf16 inputs, f32 accumulate
+
+
+def test_flash_blocks_autoshrink_to_divisor():
+    # S=48 with requested 32 → blocks shrink to 24; numbers unchanged
+    q, k, v = _qkv(s=48)
+    out = flash_attention(q, k, v, block_q=32, block_k=32)
+    ref = dense_reference_attention(q, k, v)
+    assert jnp.max(jnp.abs(out - ref)) < 1e-5
+
+
+def test_flash_rejects_untileable_seq():
+    # prime S with a smaller requested block leaves no divisor ≥ 8
+    q, k, v = _qkv(s=97)
+    with pytest.raises(ValueError, match="no block divisor"):
+        flash_attention(q, k, v, block_q=32, block_k=32)
+
+
+def test_burnin_flash_matches_dense_forward_unsharded():
+    base = dict(vocab=64, d_model=32, n_heads=2, d_ff=64, n_layers=2,
+                seq_len=16, batch=4, dtype=jnp.float32)
+    cfg_d = BurnInConfig(**base, attn="dense")
+    cfg_f = BurnInConfig(**base, attn="flash")
+    params = init_params(jax.random.PRNGKey(0), cfg_d)
+    tokens, _ = synthetic_batch(jax.random.PRNGKey(1), cfg_d)
+    dense = forward(params, tokens, cfg_d)
+    flash = forward(params, tokens, cfg_f)
+    assert jnp.max(jnp.abs(dense - flash)) < 1e-5
+
+
+def test_burnin_flash_matches_dense_forward_sharded(jax8):
+    mesh = build_mesh(plan_mesh(8, tp=2, sp=2))
+    rules = make_rules(mesh)
+    base = dict(vocab=64, d_model=32, n_heads=2, d_ff=64, n_layers=1,
+                seq_len=16, batch=8, dtype=jnp.float32)
+    cfg_d = BurnInConfig(**base, attn="dense")
+    cfg_f = BurnInConfig(**base, attn="flash")
+    params = init_params(jax.random.PRNGKey(0), cfg_d, rules)
+    tokens, _ = synthetic_batch(jax.random.PRNGKey(1), cfg_d, rules)
+    dense = forward(params, tokens, cfg_d, rules)
+    flash = forward(params, tokens, cfg_f, rules)
+    assert jnp.max(jnp.abs(dense - flash)) < 1e-5
+
+
+def test_burnin_flash_train_step_decreases_loss(jax8):
+    mesh = build_mesh(plan_mesh(8, tp=2, sp=2))
+    rules = make_rules(mesh)
+    cfg = BurnInConfig(vocab=64, d_model=32, n_heads=2, d_ff=64, n_layers=1,
+                       seq_len=16, batch=8, attn="flash")
+    params = init_params(jax.random.PRNGKey(0), cfg, rules)
+    step = make_train_step(cfg, rules, lr=5e-2)
+    batch = synthetic_batch(jax.random.PRNGKey(1), cfg, rules)
+    losses = []
+    for _ in range(4):
+        params, loss = step(params, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
