@@ -118,21 +118,25 @@ def forward(params, tokens, cfg: BurnInConfig, rules: ShardingRules | None = Non
             return x
         return jax.lax.with_sharding_constraint(x, NamedSharding(rules.mesh, spec))
 
+    # batch shards over the data axes — ("dp",), or ("slice", "dp") on a
+    # multi-slice mesh so gradient psums reduce intra-slice before DCN
+    data = rules.data if rules is not None else ("dp",)
+
     x = params["embed"][tokens]                       # [B, S, D]
     # sequence-parallel resident layout between blocks
-    x = constrain(x, P("dp", "sp", None))
+    x = constrain(x, P(data, "sp", None))
 
     use_ring = cfg.attn == "ring" and rules is not None
     for layer in params["layers"]:
         h = _rmsnorm(x, layer["attn_norm"])
         if use_ring:
             # sequence stays sharded on sp; only K/V blocks travel (ICI ring)
-            h = constrain(h, P("dp", "sp", None))
-            seq_spec = P("dp", "sp", "tp", None)
+            h = constrain(h, P(data, "sp", None))
+            seq_spec = P(data, "sp", "tp", None)
         else:
             # attention needs the full sequence: gather sp → shard heads on tp
-            h = constrain(h, P("dp", None, None))
-            seq_spec = P("dp", None, "tp", None)
+            h = constrain(h, P(data, None, None))
+            seq_spec = P(data, None, "tp", None)
         q = h @ layer["wq"]
         k = h @ layer["wk"]
         v = h @ layer["wv"]
@@ -160,17 +164,17 @@ def forward(params, tokens, cfg: BurnInConfig, rules: ShardingRules | None = Non
         else:
             attn = dense_reference_attention(q, k, v, causal=True)
         attn = attn.reshape(attn.shape[0], attn.shape[1], cfg.d_model)
-        x = x + constrain(attn @ layer["wo"], P("dp", "sp", None))
+        x = x + constrain(attn @ layer["wo"], P(data, "sp", None))
 
         h = _rmsnorm(x, layer["mlp_norm"])
-        h = constrain(h, P("dp", None, None))
+        h = constrain(h, P(data, None, None))
         h = jax.nn.gelu((h @ layer["up"]).astype(jnp.float32)).astype(cfg.dtype)
-        h = constrain(h, P("dp", None, "tp"))
-        x = x + constrain(h @ layer["down"], P("dp", "sp", None))
+        h = constrain(h, P(data, None, "tp"))
+        x = x + constrain(h @ layer["down"], P(data, "sp", None))
 
     x = _rmsnorm(x, params["out_norm"])
     logits = x @ params["embed"].T                    # weight-tied head
-    return constrain(logits, P("dp", "sp", None))
+    return constrain(logits, P(data, "sp", None))
 
 
 def loss_fn(params, batch, cfg: BurnInConfig, rules: ShardingRules | None = None):
@@ -186,7 +190,7 @@ def synthetic_batch(rng, cfg: BurnInConfig, rules: ShardingRules | None = None):
     stream = jax.random.randint(rng, (cfg.batch, cfg.seq_len + 1), 0, cfg.vocab)
     tokens, targets = stream[:, :-1], stream[:, 1:]
     if rules is not None:
-        s = rules.shard(P("dp", None))
+        s = rules.shard(rules.act(None))
         tokens, targets = jax.device_put(tokens, s), jax.device_put(targets, s)
     return tokens, targets
 
@@ -211,7 +215,7 @@ def make_train_step(cfg: BurnInConfig, rules: ShardingRules | None = None, lr: f
         lambda: init_params(jax.random.PRNGKey(0), cfg)
     )
     ps = param_shardings(abstract_params, rules)
-    batch_s = rules.shard(P("dp", None))
+    batch_s = rules.shard(rules.act(None))
     return jax.jit(
         step,
         in_shardings=(ps, (batch_s, batch_s)),

@@ -8,6 +8,12 @@ topology (ICI) and this package exercises it with XLA collectives over a
 """
 
 from .mesh import MeshPlan, build_mesh, plan_mesh  # noqa: F401
+from .multislice import (  # noqa: F401
+    build_multislice_mesh,
+    dcn_slice_count,
+    group_devices_by_slice,
+    plan_multislice,
+)
 from .sharding import ShardingRules, make_rules  # noqa: F401
 from .collectives import (  # noqa: F401
     all_gather_probe,

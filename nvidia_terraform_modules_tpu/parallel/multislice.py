@@ -1,0 +1,127 @@
+"""Multi-slice meshes: data parallelism over DCN, model axes inside ICI.
+
+A single TPU slice gets its fast interconnect (ICI) from the ``gke-tpu``
+node pool's ``placement_policy { tpu_topology }``; *between* slices there is
+only the data-center network (DCN) — ordinary VPC networking, the analogue of
+the reference's node-to-node security-group rules
+(``/root/reference/eks/main.tf:28-49``). The scaling-book recipe for that
+asymmetry: put the bandwidth-light axis (data-parallel gradient psum, which
+overlaps with backward compute) across DCN, and keep bandwidth-hungry axes
+(tp/sp activation collectives) inside a slice.
+
+This module plans a 4-axis mesh ``("slice", "dp", "sp", "tp")`` where the
+``slice`` axis maps device groups slice-by-slice, so XLA emits hierarchical
+collectives: intra-slice reductions ride ICI, the cross-slice hop rides DCN
+once per step. On real multi-slice hardware devices carry a ``slice_index``
+attribute (populated by the megascale runtime the ``tpu_slices`` Terraform
+layer provisions); test rigs fall back to contiguous grouping.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Sequence
+
+from .mesh import MeshPlan, plan_mesh
+
+
+def plan_multislice(
+    n_devices: int,
+    n_slices: int,
+    *,
+    tp: int | None = None,
+    sp: int = 1,
+) -> MeshPlan:
+    """Factorise ``n_devices`` over ``n_slices`` DCN groups × (dp, sp, tp) ICI.
+
+    The per-slice factorisation reuses :func:`plan_mesh`, so tp stays the
+    innermost (fastest-ICI) axis; ``slice`` is outermost — the only axis whose
+    collectives cross DCN.
+    """
+    if n_slices < 1:
+        raise ValueError(f"n_slices must be >= 1, got {n_slices}")
+    if n_devices % n_slices:
+        raise ValueError(
+            f"{n_slices} slices do not evenly divide {n_devices} devices")
+    per = plan_mesh(n_devices // n_slices, tp=tp, sp=sp)
+    return MeshPlan(("slice",) + per.axis_names, (n_slices,) + per.shape)
+
+
+def group_devices_by_slice(devices: Sequence, n_slices: int) -> list[list]:
+    """Order devices slice-major: real ``slice_index`` if present, else chunks.
+
+    Pure function so the grouping policy is testable without TPU hardware.
+    """
+    if n_slices == 1:
+        return [list(devices)]
+    indices = [getattr(d, "slice_index", None) for d in devices]
+    if all(i is not None for i in indices):
+        groups: dict[int, list] = collections.defaultdict(list)
+        for d, i in zip(devices, indices):
+            groups[i].append(d)
+        if len(groups) != n_slices:
+            raise ValueError(
+                f"devices report {len(groups)} distinct slice_index values, "
+                f"expected {n_slices}")
+        sizes = {len(g) for g in groups.values()}
+        if len(sizes) != 1:
+            raise ValueError(f"uneven slices: sizes {sorted(sizes)}")
+        return [groups[i] for i in sorted(groups)]
+    # CPU rigs / single-slice backends: contiguous chunks stand in for slices
+    if len(devices) % n_slices:
+        raise ValueError(
+            f"{n_slices} slices do not evenly divide {len(devices)} devices")
+    per = len(devices) // n_slices
+    return [list(devices[i * per:(i + 1) * per]) for i in range(n_slices)]
+
+
+def build_multislice_mesh(plan: MeshPlan | None = None, *,
+                          n_slices: int | None = None, devices=None):
+    """Materialise the 4-axis mesh; slice-major device order.
+
+    Either ``plan`` (from :func:`plan_multislice`) or ``n_slices`` must be
+    given. On real multi-slice hardware (devices expose ``slice_index``) the
+    layout is delegated to ``mesh_utils.create_hybrid_device_mesh`` so
+    in-slice axes follow the physical torus (logical tp neighbours are ICI
+    neighbours); rigs without slice metadata fall back to contiguous
+    grouping, where ordering carries no physical meaning.
+    """
+    import jax
+    import numpy as np
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if plan is None:
+        if n_slices is None:
+            raise ValueError("pass plan= or n_slices=")
+        plan = plan_multislice(len(devices), n_slices)
+    if plan.axis_names[0] != "slice":
+        raise ValueError(f"not a multislice plan: axes {plan.axis_names}")
+    n_slices = plan.shape[0]
+    if plan.n_devices != len(devices):
+        raise ValueError(
+            f"plan wants {plan.n_devices} devices, got {len(devices)}")
+    per_shape = plan.shape[1:]
+    if all(getattr(d, "slice_index", None) is not None for d in devices):
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            (1,) + per_shape, (n_slices,) + (1,) * len(per_shape),
+            devices=devices)
+    else:
+        groups = group_devices_by_slice(devices, n_slices)
+        dev_array = np.stack(
+            [np.asarray(g, dtype=object).reshape(per_shape) for g in groups])
+    return Mesh(dev_array, plan.axis_names)
+
+
+def dcn_slice_count(devices=None) -> int:
+    """How many slices the visible devices span (1 on single-slice rigs)."""
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    indices = {getattr(d, "slice_index", None) for d in devices}
+    if None in indices:
+        return 1
+    return max(len(indices), 1)

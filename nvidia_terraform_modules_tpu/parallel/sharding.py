@@ -4,6 +4,10 @@ Logical array dimensions map onto mesh axes once, here, and every model /
 optimizer tensor derives its ``NamedSharding`` from these rules. This is the
 TPU-idiomatic replacement for per-tensor device placement: annotate, and let
 XLA insert all-gathers / reduce-scatters over ICI.
+
+On a multi-slice mesh (axes ``("slice", "dp", "sp", "tp")``) the batch
+dimension shards over BOTH ``slice`` and ``dp`` — gradient psums then lower to
+a hierarchical reduction: intra-slice over ICI, one cross-slice hop over DCN.
 """
 
 from __future__ import annotations
@@ -18,14 +22,26 @@ class ShardingRules:
     """PartitionSpecs for each logical tensor role in the burn-in model."""
 
     mesh: Mesh
-    batch: P = P("dp")                     # [batch, seq, d]
-    batch_seq: P = P("dp", "sp")           # sequence-parallel activations
+    # mesh axes carrying the batch dimension: ("dp",), or ("slice", "dp")
+    data: tuple[str, ...] = ("dp",)
     embed: P = P(None, "tp")               # [vocab, d_model]
     attn_qkv: P = P(None, "tp")            # [d_model, heads*head_dim] col-parallel
     attn_out: P = P("tp", None)            # [heads*head_dim, d_model] row-parallel
     mlp_up: P = P(None, "tp")              # [d_model, d_ff] col-parallel
     mlp_down: P = P("tp", None)            # [d_ff, d_model] row-parallel
     replicated: P = P()
+
+    @property
+    def batch(self) -> P:                  # [batch, ...]
+        return P(self.data)
+
+    @property
+    def batch_seq(self) -> P:              # sequence-parallel activations
+        return P(self.data, "sp")
+
+    def act(self, *rest) -> P:
+        """Activation spec: batch over the data axes, then ``rest`` dims."""
+        return P(self.data, *rest)
 
     def shard(self, spec: P) -> NamedSharding:
         return NamedSharding(self.mesh, spec)
@@ -43,4 +59,5 @@ class ShardingRules:
 
 
 def make_rules(mesh: Mesh) -> ShardingRules:
-    return ShardingRules(mesh=mesh)
+    data = ("slice", "dp") if "slice" in mesh.axis_names else ("dp",)
+    return ShardingRules(mesh=mesh, data=data)

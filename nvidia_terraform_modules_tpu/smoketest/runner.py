@@ -93,8 +93,30 @@ def run_smoketest(
     checks["psum_participants"] = r["participants"]
     ok &= r["ok"]
 
+    # DCN validation: with >1 slice (explicit TPU_SMOKETEST_SLICES, or device
+    # metadata on real multi-slice), psum over the slice axis proves the
+    # cross-slice path — the analogue of the reference's node-to-node SG rules
+    # (/root/reference/eks/main.tf:28-49) actually carrying traffic. A bad
+    # slice config must FAIL the JSON contract, not crash it.
+    from ..parallel import build_multislice_mesh, dcn_slice_count, plan_multislice
+
+    ms_mesh = None
+    try:
+        n_slices = int(e.get("TPU_SMOKETEST_SLICES", "0")) or dcn_slice_count()
+        if n_slices > 1:
+            ms_mesh = build_multislice_mesh(plan_multislice(n_dev, n_slices))
+    except (ValueError, TypeError) as exc:
+        checks["slices_error"] = str(exc)
+        return SmokeResult(False, checks, time.perf_counter() - t0)
+    if ms_mesh is not None and ok:
+        checks["slices"] = n_slices
+        r = psum_probe(ms_mesh, axis="slice", n_elems=1 << 14)
+        checks["dcn_psum_ok"] = r["ok"]
+        checks["dcn_psum_participants"] = r["participants"]
+        ok &= r["ok"]
+
     if level in ("probes", "burnin") and ok:
-        mesh = build_mesh(plan_mesh(n_dev))
+        mesh = ms_mesh if ms_mesh is not None else build_mesh(plan_mesh(n_dev))
         checks["mesh"] = dict(mesh.shape)
         for name, probe in ALL_PROBES.items():
             axis = {"psum": "dp", "all_gather": "tp", "reduce_scatter": "tp",
@@ -111,9 +133,10 @@ def run_smoketest(
     if level == "burnin" and ok:
         from ..models import BurnInConfig, init_params, make_train_step, synthetic_batch
 
-        mesh = build_mesh(plan_mesh(n_dev))
+        mesh = ms_mesh if ms_mesh is not None else build_mesh(plan_mesh(n_dev))
         rules = make_rules(mesh)
-        cfg = BurnInConfig(batch=max(8, 2 * mesh.shape["dp"]))
+        data_shards = mesh.shape["dp"] * mesh.shape.get("slice", 1)
+        cfg = BurnInConfig(batch=max(8, 2 * data_shards))
         params = init_params(jax.random.PRNGKey(0), cfg, rules)
         step = make_train_step(cfg, rules)
         batch = synthetic_batch(jax.random.PRNGKey(1), cfg, rules)
