@@ -113,37 +113,39 @@ def shard_params(params, rules: ShardingRules):
 def forward(params, tokens, cfg: BurnInConfig, rules: ShardingRules | None = None):
     """Decoder-only forward pass → logits [batch, seq, vocab]."""
 
-    def constrain(x, spec):
+    def act(x, *rest):
+        """Constrain an activation: batch over the data axes, then ``rest``.
+
+        On a multi-slice mesh the data axes are ("slice", "dp"), so gradient
+        psums reduce intra-slice over ICI before the DCN hop. No-op unsharded.
+        """
         if rules is None:
             return x
-        return jax.lax.with_sharding_constraint(x, NamedSharding(rules.mesh, spec))
-
-    # batch shards over the data axes — ("dp",), or ("slice", "dp") on a
-    # multi-slice mesh so gradient psums reduce intra-slice before DCN
-    data = rules.data if rules is not None else ("dp",)
+        return jax.lax.with_sharding_constraint(x, rules.shard(rules.act(*rest)))
 
     x = params["embed"][tokens]                       # [B, S, D]
     # sequence-parallel resident layout between blocks
-    x = constrain(x, P(data, "sp", None))
+    x = act(x, "sp", None)
 
     use_ring = cfg.attn == "ring" and rules is not None
     for layer in params["layers"]:
         h = _rmsnorm(x, layer["attn_norm"])
         if use_ring:
             # sequence stays sharded on sp; only K/V blocks travel (ICI ring)
-            h = constrain(h, P(data, "sp", None))
-            seq_spec = P(data, "sp", "tp", None)
+            h = act(h, "sp", None)
+            seq_dims = ("sp", "tp", None)
         else:
             # attention needs the full sequence: gather sp → shard heads on tp
-            h = constrain(h, P(data, None, None))
-            seq_spec = P(data, None, "tp", None)
+            h = act(h, None, None)
+            seq_dims = (None, "tp", None)
+        seq_spec = rules.act(*seq_dims) if rules is not None else None
         q = h @ layer["wq"]
         k = h @ layer["wk"]
         v = h @ layer["wv"]
 
         def split(t):
             t = t.reshape(t.shape[0], t.shape[1], cfg.n_heads, cfg.head_dim)
-            return constrain(t, seq_spec)
+            return act(t, *seq_dims)
 
         q, k, v = split(q), split(k), split(v)
         if use_ring:
@@ -164,17 +166,17 @@ def forward(params, tokens, cfg: BurnInConfig, rules: ShardingRules | None = Non
         else:
             attn = dense_reference_attention(q, k, v, causal=True)
         attn = attn.reshape(attn.shape[0], attn.shape[1], cfg.d_model)
-        x = x + constrain(attn @ layer["wo"], P(data, "sp", None))
+        x = x + act(attn @ layer["wo"], "sp", None)
 
         h = _rmsnorm(x, layer["mlp_norm"])
-        h = constrain(h, P(data, None, None))
+        h = act(h, None, None)
         h = jax.nn.gelu((h @ layer["up"]).astype(jnp.float32)).astype(cfg.dtype)
-        h = constrain(h, P(data, None, "tp"))
-        x = x + constrain(h @ layer["down"], P(data, "sp", None))
+        h = act(h, None, "tp")
+        x = x + act(h @ layer["down"], "sp", None)
 
     x = _rmsnorm(x, params["out_norm"])
     logits = x @ params["embed"].T                    # weight-tied head
-    return constrain(logits, P(data, "sp", None))
+    return act(logits, "sp", None)
 
 
 def loss_fn(params, batch, cfg: BurnInConfig, rules: ShardingRules | None = None):

@@ -1,0 +1,161 @@
+"""Destroy simulation: teardown order + provider-dependency hazard analysis.
+
+The reference's documented teardown bug (SURVEY §3.4): destroying ``gke/``
+requires a manual ``terraform state rm kubernetes_namespace_v1.gpu-operator``
+first (``/root/reference/gke/README.md:59``) because an in-cluster resource
+can outlive its ability to be deleted — its provider is configured from the
+cluster's own attributes, and nothing forces the resource to be destroyed
+while the cluster still answers.
+
+This module makes that failure class *testable offline*:
+
+- ``order``: the destroy walk — reverse topological apply order, managed
+  resources only (data sources and provider configs have nothing to destroy),
+  with local child modules (the examples/cnpack idiom) expanded in place;
+- ``hazards``: every managed resource whose provider configuration reads
+  attributes of other managed resources in the same plan — directly or
+  through ``local.*`` indirection — where the resource does NOT transitively
+  depend on those resources. Without that edge, Terraform's reverse-order
+  walk is free to destroy the cluster first and the orphaned resource can
+  never be deleted again: the ``state rm`` wart.
+
+The fix the ``gke``/``gke-tpu`` modules use (an explicit ``depends_on`` chain
+resource → node pool → cluster) creates exactly the missing edge, and the CI
+test asserts both modules (and their cnpack examples) plan hazard-free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from . import ast as A
+from .module import Module, Resource, load_module
+from .plan import Plan, _collect_addresses, module_locals_refs, simulate_plan
+
+
+@dataclasses.dataclass
+class DestroyHazard:
+    resource: str               # at-risk managed resource address
+    provider: str               # provider whose config is the lifeline
+    provider_needs: list[str]   # managed resources the provider config reads
+    missing_edges: list[str]    # the needs the resource does not depend on
+
+    def describe(self) -> str:
+        return (
+            f"{self.resource}: provider {self.provider!r} is configured from "
+            f"{', '.join(self.provider_needs)}, but the resource has no "
+            f"dependency on {', '.join(self.missing_edges)} — destroy order "
+            "may remove the provider's backing infrastructure first "
+            "(the reference's `state rm` wart, gke/README.md:59)"
+        )
+
+
+@dataclasses.dataclass
+class DestroyPlan:
+    order: list[str]            # destroy order over managed resource nodes
+    hazards: list[DestroyHazard]
+
+    @property
+    def ok(self) -> bool:
+        return not self.hazards
+
+
+def _transitive_deps(edges: list[tuple[str, str]]) -> dict[str, set[str]]:
+    """addr → every node reachable via dependency edges (addr depends on *)."""
+    direct: dict[str, set[str]] = {}
+    for frm, to in edges:
+        direct.setdefault(frm, set()).add(to)
+    closed: dict[str, set[str]] = {}
+
+    def walk(n: str, seen: set[str]) -> set[str]:
+        if n in closed:
+            return closed[n]
+        if n in seen:           # cycle — plan already rejects these
+            return set()
+        seen = seen | {n}
+        out: set[str] = set()
+        for d in direct.get(n, ()):
+            out.add(d)
+            out |= walk(d, seen)
+        closed[n] = out
+        return out
+
+    for n in set(direct) | {t for _, t in edges}:
+        walk(n, set())
+    return closed
+
+
+def _provider_key(r: Resource) -> str:
+    """Provider config a resource binds to: explicit ``provider`` meta-arg
+    (``kubernetes.gke`` for an alias), else terraform's type-prefix rule."""
+    pa = r.body.attr("provider")
+    if pa is not None and isinstance(pa.expr, A.Traversal):
+        return pa.expr.path_str()
+    return r.type.split("_")[0]
+
+
+def _analyze_module(module: Module, plan: Plan,
+                    prefix: str = "") -> DestroyPlan:
+    managed = [a for a in plan.order
+               if not a.startswith("data.") and not a.startswith("module.")]
+
+    # what each provider's configuration reads — through locals too —
+    # filtered to managed resources of this module
+    resource_types = {r.type for r in module.resources.values()}
+    locals_refs = module_locals_refs(module, resource_types)
+    node_addrs = set(plan.order)
+    provider_needs: dict[str, set[str]] = {}
+    for prov in module.providers:
+        refs = _collect_addresses(prov.body, resource_types, locals_refs)
+        needs = {r for r in refs if r in node_addrs and
+                 not r.startswith("data.")}
+        if needs:
+            key = prov.name if prov.alias is None else f"{prov.name}.{prov.alias}"
+            provider_needs.setdefault(key, set()).update(needs)
+
+    closure = _transitive_deps(plan.edges)
+    hazards: list[DestroyHazard] = []
+    for addr in managed:
+        needs = provider_needs.get(_provider_key(module.resources[addr]))
+        if not needs:
+            continue
+        deps = closure.get(addr, set())
+        missing = sorted(n for n in needs if n != addr and n not in deps)
+        if missing:
+            hazards.append(DestroyHazard(
+                resource=prefix + addr,
+                provider=_provider_key(module.resources[addr]),
+                provider_needs=sorted(prefix + n for n in needs),
+                missing_edges=sorted(prefix + n for n in missing)))
+
+    # destroy order: reverse apply order, local child modules expanded in
+    # place (a child's resources are destroyed where the module node sits)
+    order: list[str] = []
+    for addr in reversed(plan.order):
+        if addr.startswith("data."):
+            continue
+        if addr.startswith("module."):
+            for caddr, cplan in plan.child_plans.items():
+                if caddr == addr or caddr.startswith(addr + "["):
+                    child = _analyze_module(
+                        load_module(cplan.module_path), cplan,
+                        prefix=f"{prefix}{caddr}.")
+                    order.extend(child.order)
+                    hazards.extend(child.hazards)
+            continue
+        order.append(prefix + addr)
+    return DestroyPlan(order=order, hazards=hazards)
+
+
+def simulate_destroy(
+    module: Module | str,
+    tfvars: dict | None = None,
+    *,
+    plan: Plan | None = None,
+) -> DestroyPlan:
+    """Simulate ``terraform destroy`` for ``module`` against ``tfvars``."""
+    if isinstance(module, str):
+        module = load_module(module)
+    if plan is None:
+        plan = simulate_plan(module, tfvars)
+    return _analyze_module(module, plan)

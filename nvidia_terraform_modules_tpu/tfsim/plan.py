@@ -134,6 +134,35 @@ class LazyLocals:
         return self._exprs.keys()
 
 
+
+def module_locals_refs(module: Module, resource_types: set[str]) -> dict[str, set[str]]:
+    """local name → resource/data/module addresses it (transitively) reads."""
+    locals_refs: dict[str, set[str]] = {
+        name: _collect_addresses(expr, resource_types)
+        for name, expr in module.locals.items()
+    }
+    local_deps = {
+        name: {
+            t.ops[0][1]
+            for t, bound in A.scoped_traversals(expr)
+            if t.root == "local" and t.root not in bound and t.ops and
+            t.ops[0][0] == "attr"
+        }
+        for name, expr in module.locals.items()
+    }
+    for _ in range(len(locals_refs)):
+        changed = False
+        for name, dep_names in local_deps.items():
+            for d in dep_names:
+                extra = locals_refs.get(d, set()) - locals_refs[name]
+                if extra:
+                    locals_refs[name] |= extra
+                    changed = True
+        if not changed:
+            break
+    return locals_refs
+
+
 # --------------------------------------------------------------------------
 # body evaluation
 # --------------------------------------------------------------------------
@@ -248,29 +277,7 @@ def simulate_plan(
         nodes[f"module.{name}"] = mc
 
     # per-local address refs, transitively closed through other locals
-    locals_refs: dict[str, set[str]] = {
-        name: _collect_addresses(expr, resource_types)
-        for name, expr in module.locals.items()
-    }
-    local_deps = {
-        name: {
-            t.ops[0][1]
-            for t, bound in A.scoped_traversals(expr)
-            if t.root == "local" and t.root not in bound and t.ops and
-            t.ops[0][0] == "attr"
-        }
-        for name, expr in module.locals.items()
-    }
-    for _ in range(len(locals_refs)):
-        changed = False
-        for name, dep_names in local_deps.items():
-            for d in dep_names:
-                extra = locals_refs.get(d, set()) - locals_refs[name]
-                if extra:
-                    locals_refs[name] |= extra
-                    changed = True
-        if not changed:
-            break
+    locals_refs = module_locals_refs(module, resource_types)
 
     deps: dict[str, set[str]] = {}
     for addr, obj in nodes.items():
@@ -282,10 +289,12 @@ def simulate_plan(
 
     # 4. walk in order, planning each node ------------------------------
     instances: dict[str, PlannedInstance] = {}
+    child_plans: dict[str, Plan] = {}
     for addr in order:
         obj = nodes[addr]
         if addr.startswith("module."):
-            _plan_module_call(addr, obj, module, scope, instances, _depth)
+            _plan_module_call(addr, obj, module, scope, instances, _depth,
+                              child_plans)
         else:
             _plan_resource(addr, obj, scope, instances)
 
@@ -303,7 +312,7 @@ def simulate_plan(
     edges = [(a, d) for a, ds in deps.items() for d in ds]
     return Plan(
         module_path=module.path, instances=instances, outputs=outputs,
-        edges=edges, order=order,
+        edges=edges, order=order, child_plans=child_plans,
     )
 
 
@@ -414,7 +423,8 @@ class _ComputedModule(dict):
 
 def _plan_module_call(addr: str, mc, parent: Module, scope: Scope,
                       instances: dict[str, PlannedInstance],
-                      depth: int) -> None:
+                      depth: int,
+                      child_plans: dict[str, "Plan"] | None = None) -> None:
     src_attr = mc.body.attr("source")
     src = None
     if src_attr is not None and isinstance(src_attr.expr, A.Literal):
@@ -463,6 +473,8 @@ def _plan_module_call(addr: str, mc, parent: Module, scope: Scope,
         if src and (src.startswith("./") or src.startswith("../")):
             child_path = os.path.normpath(os.path.join(parent.path, src))
             child_plan = simulate_plan(child_path, args, _depth=depth + 1)
+            if child_plans is not None:
+                child_plans[f"{addr}{suffix}"] = child_plan
             for iaddr, inst in child_plan.instances.items():
                 instances[f"{addr}{suffix}.{iaddr}"] = inst
             return dict(child_plan.outputs)

@@ -1,0 +1,220 @@
+"""Destroy simulation: teardown order + the reference's `state rm` wart.
+
+SURVEY §3.4: the reference requires `terraform state rm` of the operator
+namespace before `terraform destroy` (/root/reference/gke/README.md:59).
+These tests (a) reproduce that hazard class on a synthetic module shaped like
+the reference, and (b) prove both of our modules plan hazard-free because the
+depends_on chain gives Terraform the edge the reference is missing.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from nvidia_terraform_modules_tpu.tfsim import (
+    simulate_destroy,
+    simulate_plan,
+)
+
+MODULE_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GKE_VARS = {"project_id": "proj-x", "cluster_name": "demo"}
+TPU_VARS = {"project_id": "proj-x", "cluster_name": "demo"}
+
+
+def _write_module(tmp_path, main_tf: str) -> str:
+    (tmp_path / "main.tf").write_text(textwrap.dedent(main_tf))
+    return str(tmp_path)
+
+
+WART_MODULE = """
+    variable "name" {
+      type    = string
+      default = "demo"
+    }
+
+    resource "google_container_cluster" "c" {
+      name = var.name
+    }
+
+    provider "kubernetes" {
+      host = google_container_cluster.c.endpoint
+    }
+
+    resource "kubernetes_namespace_v1" "ns" {
+      metadata {
+        name = "operator"
+      }
+      %s
+    }
+"""
+
+
+def test_reference_wart_is_flagged(tmp_path):
+    """Namespace with no edge to the cluster → the `state rm` hazard."""
+    path = _write_module(tmp_path, WART_MODULE % "")
+    d = simulate_destroy(path, {})
+    assert not d.ok
+    (h,) = d.hazards
+    assert h.resource == "kubernetes_namespace_v1.ns"
+    assert h.provider == "kubernetes"
+    assert h.missing_edges == ["google_container_cluster.c"]
+    assert "state rm" in h.describe()
+
+
+def test_depends_on_designs_the_wart_out(tmp_path):
+    path = _write_module(
+        tmp_path, WART_MODULE % "depends_on = [google_container_cluster.c]")
+    d = simulate_destroy(path, {})
+    assert d.ok, [h.describe() for h in d.hazards]
+    # and the destroy order then respects the edge: namespace dies first
+    assert d.order.index("kubernetes_namespace_v1.ns") < \
+        d.order.index("google_container_cluster.c")
+
+
+def test_destroy_order_is_reverse_apply(tmp_path):
+    path = _write_module(tmp_path, """
+        resource "google_compute_network" "net" {
+          name = "n"
+        }
+
+        resource "google_compute_subnetwork" "sub" {
+          network = google_compute_network.net.id
+        }
+
+        data "google_project" "p" {}
+    """)
+    d = simulate_destroy(path, {})
+    assert d.order == [
+        "google_compute_subnetwork.sub", "google_compute_network.net"]
+    assert all(not a.startswith("data.") for a in d.order)
+
+
+def test_gke_module_destroys_hazard_free():
+    d = simulate_destroy(os.path.join(MODULE_DIR, "gke"), dict(GKE_VARS))
+    assert d.ok, [h.describe() for h in d.hazards]
+    # release → namespace → pool → cluster while the API server still exists
+    idx = {a: i for i, a in enumerate(d.order)}
+    assert idx["helm_release.gpu_operator"] < idx["kubernetes_namespace_v1.gpu_operator"]
+    assert idx["kubernetes_namespace_v1.gpu_operator"] < idx["google_container_node_pool.gpu"]
+    assert idx["google_container_node_pool.gpu"] < idx["google_container_cluster.this"]
+
+
+def test_gke_tpu_module_destroys_hazard_free():
+    d = simulate_destroy(os.path.join(MODULE_DIR, "gke-tpu"), dict(TPU_VARS))
+    assert d.ok, [h.describe() for h in d.hazards]
+    idx = {a: i for i, a in enumerate(d.order)}
+    assert idx["helm_release.tpu_runtime"] < idx["kubernetes_namespace_v1.tpu_runtime"]
+    assert idx["kubernetes_namespace_v1.tpu_runtime"] < idx["google_container_cluster.this"]
+
+
+def test_existing_plan_can_be_reused(tmp_path):
+    path = _write_module(tmp_path, WART_MODULE % "")
+    plan = simulate_plan(path, {})
+    d = simulate_destroy(path, {}, plan=plan)
+    assert not d.ok
+
+
+def test_aliased_provider_meta_arg_is_tracked(tmp_path):
+    """`provider = kubernetes.gke` binds to the aliased config's needs."""
+    path = _write_module(tmp_path, """
+        resource "google_container_cluster" "c" {
+          name = "x"
+        }
+
+        provider "kubernetes" {
+          alias = "gke"
+          host  = google_container_cluster.c.endpoint
+        }
+
+        resource "kubernetes_namespace_v1" "ns" {
+          provider = kubernetes.gke
+          metadata {
+            name = "operator"
+          }
+        }
+    """)
+    d = simulate_destroy(path, {})
+    assert not d.ok
+    assert d.hazards[0].provider == "kubernetes.gke"
+
+
+def test_statically_configured_alias_not_false_flagged(tmp_path):
+    """A resource on a static aliased provider must not inherit the default
+    provider's needs."""
+    path = _write_module(tmp_path, """
+        resource "google_container_cluster" "c" {
+          name = "x"
+        }
+
+        provider "kubernetes" {
+          host = google_container_cluster.c.endpoint
+        }
+
+        provider "kubernetes" {
+          alias = "static"
+          host  = "https://example.invalid"
+        }
+
+        resource "kubernetes_namespace_v1" "ns" {
+          provider = kubernetes.static
+          metadata {
+            name = "operator"
+          }
+        }
+    """)
+    d = simulate_destroy(path, {})
+    assert d.ok, [h.describe() for h in d.hazards]
+
+
+def test_provider_config_through_local_is_tracked(tmp_path):
+    """cluster attr routed through a local still counts as a provider need."""
+    path = _write_module(tmp_path, """
+        resource "google_container_cluster" "c" {
+          name = "x"
+        }
+
+        locals {
+          ep = google_container_cluster.c.endpoint
+        }
+
+        provider "kubernetes" {
+          host = local.ep
+        }
+
+        resource "kubernetes_namespace_v1" "ns" {
+          metadata {
+            name = "operator"
+          }
+        }
+    """)
+    d = simulate_destroy(path, {})
+    assert not d.ok
+    assert d.hazards[0].missing_edges == ["google_container_cluster.c"]
+
+
+def test_child_module_wart_detected_and_order_expanded(tmp_path):
+    """A wart inside a local child module (the examples/cnpack idiom) is
+    found, and the child's resources appear in the destroy order."""
+    child = tmp_path / "child"
+    child.mkdir()
+    (child / "main.tf").write_text(textwrap.dedent(WART_MODULE % ""))
+    (tmp_path / "main.tf").write_text(textwrap.dedent("""
+        module "wrap" {
+          source = "./child"
+          name   = "demo"
+        }
+    """))
+    d = simulate_destroy(str(tmp_path), {})
+    assert not d.ok
+    assert d.hazards[0].resource == "module.wrap.kubernetes_namespace_v1.ns"
+    assert "module.wrap.google_container_cluster.c" in d.order
+
+
+def test_cnpack_examples_destroy_hazard_free():
+    for path in ("gke/examples/cnpack", "gke-tpu/examples/cnpack"):
+        d = simulate_destroy(os.path.join(MODULE_DIR, path),
+                             {"project_id": "proj-y"})
+        assert d.ok, (path, [h.describe() for h in d.hazards])
+        # the wrapped module's resources are part of the teardown walk
+        assert any(".google_container_cluster.this" in a for a in d.order)
