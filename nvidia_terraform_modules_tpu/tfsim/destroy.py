@@ -13,11 +13,12 @@ This module makes that failure class *testable offline*:
   resources only (data sources and provider configs have nothing to destroy),
   with local child modules (the examples/cnpack idiom) expanded in place;
 - ``hazards``: every managed resource whose provider configuration reads
-  attributes of other managed resources in the same plan — directly or
-  through ``local.*`` indirection — where the resource does NOT transitively
-  depend on those resources. Without that edge, Terraform's reverse-order
-  walk is free to destroy the cluster first and the orphaned resource can
-  never be deleted again: the ``state rm`` wart.
+  attributes of other managed resources or module outputs — directly,
+  through ``local.*`` indirection, or inherited from the parent module the
+  way Terraform passes default providers down — where the resource does NOT
+  transitively depend on those sources. Without that edge, Terraform's
+  reverse-order walk is free to destroy the cluster first and the orphaned
+  resource can never be deleted again: the ``state rm`` wart.
 
 The fix the ``gke``/``gke-tpu`` modules use (an explicit ``depends_on`` chain
 resource → node pool → cluster) creates exactly the missing edge, and the CI
@@ -37,7 +38,7 @@ from .plan import Plan, _collect_addresses, module_locals_refs, simulate_plan
 class DestroyHazard:
     resource: str               # at-risk managed resource address
     provider: str               # provider whose config is the lifeline
-    provider_needs: list[str]   # managed resources the provider config reads
+    provider_needs: list[str]   # resources/modules the provider config reads
     missing_edges: list[str]    # the needs the resource does not depend on
 
     def describe(self) -> str:
@@ -94,39 +95,58 @@ def _provider_key(r: Resource) -> str:
     return r.type.split("_")[0]
 
 
-def _analyze_module(module: Module, plan: Plan,
-                    prefix: str = "") -> DestroyPlan:
+def _analyze_module(module: Module, plan: Plan, *, prefix: str = "",
+                    inherited_needs: dict[str, set[str]] | None = None,
+                    protected: set[str] | None = None,
+                    module_cache: dict[str, Module] | None = None) -> DestroyPlan:
+    """Recursive destroy analysis of one module instance.
+
+    ``inherited_needs``: provider key → needs in the PARENT's address space
+    (terraform passes default providers into child modules); ``protected``:
+    parent-space addresses this module instance transitively depends on, so
+    inherited needs among them are destroy-ordered safely.
+    """
+    inherited_needs = inherited_needs or {}
+    protected = protected or set()
+    module_cache = {} if module_cache is None else module_cache
     managed = [a for a in plan.order
                if not a.startswith("data.") and not a.startswith("module.")]
 
     # what each provider's configuration reads — through locals too —
-    # filtered to managed resources of this module
+    # including module outputs (the provider-on-module-output idiom)
     resource_types = {r.type for r in module.resources.values()}
     locals_refs = module_locals_refs(module, resource_types)
     node_addrs = set(plan.order)
-    provider_needs: dict[str, set[str]] = {}
+    own_needs: dict[str, set[str]] = {}
     for prov in module.providers:
         refs = _collect_addresses(prov.body, resource_types, locals_refs)
         needs = {r for r in refs if r in node_addrs and
                  not r.startswith("data.")}
         if needs:
             key = prov.name if prov.alias is None else f"{prov.name}.{prov.alias}"
-            provider_needs.setdefault(key, set()).update(needs)
+            own_needs.setdefault(key, set()).update(needs)
 
     closure = _transitive_deps(plan.edges)
     hazards: list[DestroyHazard] = []
     for addr in managed:
-        needs = provider_needs.get(_provider_key(module.resources[addr]))
-        if not needs:
-            continue
+        pkey = _provider_key(module.resources[addr])
         deps = closure.get(addr, set())
-        missing = sorted(n for n in needs if n != addr and n not in deps)
+        missing: set[str] = set()
+        needs_report: set[str] = set()
+        if pkey in own_needs:
+            needs_report |= {prefix + n for n in own_needs[pkey]}
+            missing |= {prefix + n for n in own_needs[pkey]
+                        if n != addr and n not in deps}
+        elif pkey in inherited_needs:
+            # parent-space needs: safe only if the whole module instance
+            # depends on them (nothing inside this plan can create the edge)
+            needs_report |= inherited_needs[pkey]
+            missing |= inherited_needs[pkey] - protected
         if missing:
             hazards.append(DestroyHazard(
-                resource=prefix + addr,
-                provider=_provider_key(module.resources[addr]),
-                provider_needs=sorted(prefix + n for n in needs),
-                missing_edges=sorted(prefix + n for n in missing)))
+                resource=prefix + addr, provider=pkey,
+                provider_needs=sorted(needs_report),
+                missing_edges=sorted(missing)))
 
     # destroy order: reverse apply order, local child modules expanded in
     # place (a child's resources are destroyed where the module node sits)
@@ -136,12 +156,26 @@ def _analyze_module(module: Module, plan: Plan,
             continue
         if addr.startswith("module."):
             for caddr, cplan in plan.child_plans.items():
-                if caddr == addr or caddr.startswith(addr + "["):
-                    child = _analyze_module(
-                        load_module(cplan.module_path), cplan,
-                        prefix=f"{prefix}{caddr}.")
-                    order.extend(child.order)
-                    hazards.extend(child.hazards)
+                if caddr != addr and not caddr.startswith(addr + "["):
+                    continue
+                child_mod = module_cache.get(cplan.module_path)
+                if child_mod is None:
+                    child_mod = load_module(cplan.module_path)
+                    module_cache[cplan.module_path] = child_mod
+                # providers inherit downward; needs stay in OUR address space
+                child_inherited = {
+                    k: {prefix + n for n in v} for k, v in own_needs.items()}
+                for k, v in inherited_needs.items():
+                    child_inherited.setdefault(k, set()).update(v)
+                # what this module call is ordered after, in parent space
+                call_deps = {prefix + d for d in closure.get(addr, set())}
+                child = _analyze_module(
+                    child_mod, cplan, prefix=f"{prefix}{caddr}.",
+                    inherited_needs=child_inherited,
+                    protected=protected | call_deps,
+                    module_cache=module_cache)
+                order.extend(child.order)
+                hazards.extend(child.hazards)
             continue
         order.append(prefix + addr)
     return DestroyPlan(order=order, hazards=hazards)
@@ -158,4 +192,4 @@ def simulate_destroy(
         module = load_module(module)
     if plan is None:
         plan = simulate_plan(module, tfvars)
-    return _analyze_module(module, plan)
+    return _analyze_module(module, plan, module_cache={module.path: module})

@@ -1,0 +1,140 @@
+"""State simulation: apply, persist, re-plan, diff — terraform's checkpoint.
+
+SURVEY §5 maps the reference's checkpoint/resume story onto Terraform state:
+"apply is resumable/idempotent; remote state recommended but not configured"
+(``/root/reference/README.md:89-91``). The reference cannot test any of that
+without a live cloud. This module simulates the state lifecycle offline:
+
+- ``apply_plan`` turns a simulated plan into a :class:`State` (the checkpoint);
+- ``State.to_json``/``from_json`` round-trip it (the "remote state" file);
+- ``diff`` compares a fresh plan against a prior state the way
+  ``terraform plan`` reports actions: create / update / delete / no-op.
+
+Semantics mirror Terraform's: provider-computed attributes (``<computed>``)
+never drive updates — only config-driven values do — so a re-plan against an
+unchanged module is a full no-op (the idempotence/resume guarantee), while a
+changed tfvar surfaces as exactly the updates it causes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+from .plan import Plan, render
+
+COMPUTED_STR = "<computed>"
+
+
+@dataclasses.dataclass
+class State:
+    """Applied resource attributes by address — the checkpoint artifact."""
+
+    resources: dict[str, Any] = dataclasses.field(default_factory=dict)
+    serial: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"serial": self.serial, "resources": self.resources},
+            indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "State":
+        raw = json.loads(text)
+        return cls(resources=raw["resources"], serial=raw["serial"])
+
+
+@dataclasses.dataclass
+class Diff:
+    """Plan-vs-state actions, terraform-plan style."""
+
+    actions: dict[str, str]               # address → create|update|delete|no-op
+    changed_keys: dict[str, list[str]]    # address → keys driving an update
+
+    def by_action(self, action: str) -> list[str]:
+        return sorted(a for a, act in self.actions.items() if act == action)
+
+    @property
+    def is_noop(self) -> bool:
+        return all(a == "no-op" for a in self.actions.values())
+
+    def summary(self) -> str:
+        c, u, d = (len(self.by_action(a)) for a in ("create", "update", "delete"))
+        return f"Plan: {c} to add, {u} to change, {d} to destroy."
+
+
+_MISSING = object()   # key present in state but absent from the new plan
+
+
+def _values_match(planned: Any, applied: Any) -> bool:
+    """Deep equality where a planned ``<computed>`` matches anything.
+
+    Terraform only diffs config-driven values; attributes the provider fills
+    at apply time cannot cause an update on re-plan. A key *removed* from
+    config (``_MISSING``) is a change unless the stored value was itself
+    provider-computed.
+    """
+    if planned is _MISSING:
+        return applied == COMPUTED_STR
+    if planned == COMPUTED_STR:
+        return True
+    if isinstance(planned, dict) and isinstance(applied, dict):
+        return set(planned) == set(applied) and all(
+            _values_match(v, applied[k]) for k, v in planned.items())
+    if isinstance(planned, list) and isinstance(applied, list):
+        return len(planned) == len(applied) and all(
+            _values_match(p, a) for p, a in zip(planned, applied))
+    return planned == applied
+
+
+def _rendered_instances(plan: Plan) -> dict[str, Any]:
+    # data sources are read every run, never tracked — terraform counts
+    # neither their reads nor their disappearance as plan actions
+    return {addr: render(dict(inst.attrs))
+            for addr, inst in plan.instances.items()
+            if not addr.startswith("data.")}
+
+
+def diff(plan: Plan, state: State | None) -> Diff:
+    """What ``terraform apply`` would do to ``state`` to realise ``plan``."""
+    planned = _rendered_instances(plan)
+    prior = dict(state.resources) if state else {}
+    actions: dict[str, str] = {}
+    changed: dict[str, list[str]] = {}
+    for addr, attrs in planned.items():
+        if addr not in prior:
+            actions[addr] = "create"
+            continue
+        keys = sorted(
+            k for k in set(attrs) | set(prior[addr])
+            if not _values_match(attrs.get(k, _MISSING),
+                                 prior[addr].get(k)))
+        if keys:
+            actions[addr] = "update"
+            changed[addr] = keys
+        else:
+            actions[addr] = "no-op"
+    for addr in prior:
+        if addr not in planned:
+            actions[addr] = "delete"
+    return Diff(actions=actions, changed_keys=changed)
+
+
+def apply_plan(plan: Plan, state: State | None = None) -> State:
+    """Advance ``state`` to ``plan``: the simulated ``terraform apply``.
+
+    Computed attributes keep their ``<computed>`` marker in state — the
+    simulator has no providers to fill them, and :func:`diff` treats them as
+    provider-owned either way. Deleted addresses drop out; the serial bumps
+    iff anything changed (terraform's own behaviour for state versioning).
+    """
+    d = diff(plan, state)
+    resources = dict(state.resources) if state else {}
+    for addr in d.by_action("delete"):
+        resources.pop(addr, None)
+    planned = _rendered_instances(plan)
+    for addr in d.by_action("create") + d.by_action("update"):
+        resources[addr] = planned[addr]
+    serial = (state.serial if state else 0) + (0 if d.is_noop else 1)
+    return State(resources=resources, serial=serial)

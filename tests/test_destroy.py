@@ -211,6 +211,75 @@ def test_child_module_wart_detected_and_order_expanded(tmp_path):
     assert "module.wrap.google_container_cluster.c" in d.order
 
 
+PARENT_PROVIDER_LAYOUT = """
+    module "gke" {
+      source = "./gke"
+    }
+
+    provider "kubernetes" {
+      host = module.gke.endpoint
+    }
+
+    module "app" {
+      source = "./app"
+      %s
+    }
+"""
+
+
+def _parent_provider_fixture(tmp_path, app_args=""):
+    """Root configures the provider from module.gke; module.app consumes it —
+    the cnpack idiom (provider in the example root, resources in the wrap)."""
+    import textwrap
+    for name, body in [
+        ("gke", """
+            resource "google_container_cluster" "c" {
+              name = "x"
+            }
+
+            output "endpoint" {
+              value = google_container_cluster.c.endpoint
+            }
+        """),
+        ("app", """
+            variable "dep" {
+              type    = string
+              default = ""
+            }
+
+            resource "kubernetes_namespace_v1" "ns" {
+              metadata {
+                name = "operator"
+              }
+            }
+        """),
+    ]:
+        d = tmp_path / name
+        d.mkdir()
+        (d / "main.tf").write_text(textwrap.dedent(body))
+    (tmp_path / "main.tf").write_text(
+        textwrap.dedent(PARENT_PROVIDER_LAYOUT % app_args))
+    return str(tmp_path)
+
+
+def test_parent_provider_child_resource_wart_detected(tmp_path):
+    path = _parent_provider_fixture(tmp_path)
+    d = simulate_destroy(path, {})
+    assert not d.ok
+    (h,) = d.hazards
+    assert h.resource == "module.app.kubernetes_namespace_v1.ns"
+    assert h.missing_edges == ["module.gke"]
+
+
+def test_parent_provider_protected_by_module_dependency(tmp_path):
+    # wiring module.gke's output into module.app creates the ordering edge
+    path = _parent_provider_fixture(tmp_path, "dep = module.gke.endpoint")
+    d = simulate_destroy(path, {})
+    assert d.ok, [h.describe() for h in d.hazards]
+    assert d.order.index("module.app.kubernetes_namespace_v1.ns") < \
+        d.order.index("module.gke.google_container_cluster.c")
+
+
 def test_cnpack_examples_destroy_hazard_free():
     for path in ("gke/examples/cnpack", "gke-tpu/examples/cnpack"):
         d = simulate_destroy(os.path.join(MODULE_DIR, path),

@@ -1,0 +1,140 @@
+"""State lifecycle: apply → checkpoint → re-plan → diff (SURVEY §5).
+
+The reference's checkpoint/resume story is "terraform state is the
+checkpoint; apply is idempotent" — untestable there without a cloud. Here the
+whole lifecycle runs offline: idempotent re-plan, surgical diffs on variable
+changes, and JSON round-trip of the state artifact.
+"""
+
+import os
+
+from nvidia_terraform_modules_tpu.tfsim import (
+    State,
+    apply_plan,
+    diff,
+    simulate_plan,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASE = {"project_id": "proj-x", "cluster_name": "demo"}
+
+
+def _plan(extra=None):
+    return simulate_plan(os.path.join(ROOT, "gke-tpu"), {**BASE, **(extra or {})})
+
+
+def test_apply_then_replan_is_noop():
+    """The resume guarantee: unchanged config plans to zero actions."""
+    plan = _plan()
+    state = apply_plan(plan)
+    assert state.serial == 1
+    d = diff(_plan(), state)
+    assert d.is_noop, d.actions
+    assert d.summary() == "Plan: 0 to add, 0 to change, 0 to destroy."
+    # applying a no-op must not bump the checkpoint serial
+    assert apply_plan(_plan(), state).serial == 1
+
+
+def test_added_slice_plans_exactly_one_create():
+    state = apply_plan(_plan())
+    d = diff(_plan({"tpu_slices": {"default": {}, "big": {"topology": "4x4"}}}),
+             state)
+    creates = d.by_action("create")
+    assert 'google_container_node_pool.tpu_slice["big"]' in creates
+    assert d.by_action("delete") == []
+    # pre-existing resources untouched
+    assert d.actions['google_container_cluster.this'] == "no-op"
+
+
+def test_removed_slice_plans_delete():
+    state = apply_plan(_plan({"tpu_slices": {"default": {}, "big": {"topology": "4x4"}}}))
+    d = diff(_plan(), state)
+    deletes = d.by_action("delete")
+    assert 'google_container_node_pool.tpu_slice["big"]' in deletes
+    assert 'google_container_node_pool.tpu_slice["default"]' not in deletes
+
+
+def test_changed_machine_type_plans_update_with_key():
+    state = apply_plan(_plan())
+    d = diff(_plan({"cpu_pool": {"machine_type": "n2-standard-16"}}), state)
+    addr = "google_container_node_pool.cpu"
+    assert d.actions[addr] == "update"
+    assert "node_config" in d.changed_keys[addr]
+    # the cluster itself must not churn on a pool-only change
+    assert d.actions["google_container_cluster.this"] == "no-op"
+
+
+def test_computed_attrs_never_drive_updates():
+    plan = _plan()
+    state = apply_plan(plan)
+    # every instance has id = <computed>; a second diff must not call that a
+    # change (provider-owned attributes are not config drift)
+    d = diff(plan, state)
+    assert d.is_noop
+
+
+def test_state_json_roundtrip(tmp_path):
+    state = apply_plan(_plan())
+    path = tmp_path / "terraform.tfstate"
+    path.write_text(state.to_json())
+    restored = State.from_json(path.read_text())
+    assert restored.serial == state.serial
+    assert restored.resources == state.resources
+    assert diff(_plan(), restored).is_noop
+
+
+def test_removed_config_attribute_surfaces_as_update(tmp_path):
+    """Dropping a block from config must plan an update, not a no-op."""
+    import textwrap
+
+    def write(body):
+        (tmp_path / "main.tf").write_text(textwrap.dedent(body))
+        return simulate_plan(str(tmp_path), {})
+
+    plan = write("""
+        resource "google_container_node_pool" "p" {
+          name = "x"
+          placement_policy {
+            type = "COMPACT"
+          }
+        }
+    """)
+    state = apply_plan(plan)
+    plan2 = write("""
+        resource "google_container_node_pool" "p" {
+          name = "x"
+        }
+    """)
+    d = diff(plan2, state)
+    assert d.actions["google_container_node_pool.p"] == "update"
+    assert d.changed_keys["google_container_node_pool.p"] == ["placement_policy"]
+
+
+def test_data_sources_are_not_plan_actions(tmp_path):
+    import textwrap
+    (tmp_path / "main.tf").write_text(textwrap.dedent("""
+        data "google_project" "p" {}
+
+        resource "google_compute_network" "n" {
+          name = "x"
+        }
+    """))
+    plan = simulate_plan(str(tmp_path), {})
+    d = diff(plan, None)
+    assert "data.google_project.p" not in d.actions
+    assert d.summary() == "Plan: 1 to add, 0 to change, 0 to destroy."
+    state = apply_plan(plan)
+    assert "data.google_project.p" not in state.resources
+
+
+def test_incremental_apply_converges():
+    state = apply_plan(_plan())
+    plan2 = _plan({"tpu_slices": {"default": {}, "b": {"topology": "2x2x4",
+                                                       "version": "v4"}}})
+    state2 = apply_plan(plan2, state)
+    assert state2.serial == 2
+    assert diff(plan2, state2).is_noop
+    # and rolling back reconverges too
+    state3 = apply_plan(_plan(), state2)
+    assert 'google_container_node_pool.tpu_slice["b"]' not in state3.resources
+    assert diff(_plan(), state3).is_noop
