@@ -1,0 +1,221 @@
+"""tfsim CLI — the operator surface, shaped like terraform's (SURVEY L7).
+
+The reference's user interface is the ``terraform`` CLI itself
+(``/root/reference/README.md:43-79``: init/plan/apply/destroy plus
+``terraform fmt``/``validate`` as the contribution gates). This build has no
+terraform binary in CI, so tfsim ships the same verbs offline::
+
+    python -m nvidia_terraform_modules_tpu.tfsim validate gke-tpu
+    python -m nvidia_terraform_modules_tpu.tfsim plan gke-tpu -var project_id=p \
+        -var cluster_name=c [-state terraform.tfstate.json] [-json]
+    python -m nvidia_terraform_modules_tpu.tfsim apply gke-tpu ... -state f
+    python -m nvidia_terraform_modules_tpu.tfsim destroy gke-tpu ...
+    python -m nvidia_terraform_modules_tpu.tfsim fmt -check gke-tpu gke
+    python -m nvidia_terraform_modules_tpu.tfsim docs -check gke-tpu
+
+Exit codes follow the terraform convention: 0 success / no diffs, 1 findings
+(validation errors, fmt diffs, destroy hazards), 2 usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .destroy import simulate_destroy
+from .docs import check_readme, generate_docs
+from .fmt import check_text, format_text
+from .module import load_module
+from .plan import PlanError, load_tfvars, render, simulate_plan
+from .state import State, apply_plan, diff
+from .validate import validate_module
+
+
+def _parse_var(kv: str):
+    if "=" not in kv:
+        raise SystemExit(f"-var expects name=value, got {kv!r}")
+    k, v = kv.split("=", 1)
+    try:
+        return k, json.loads(v)   # numbers, bools, JSON lists/objects
+    except json.JSONDecodeError:
+        return k, v               # bare string
+
+
+def _gather_vars(args) -> dict:
+    tfvars: dict = {}
+    for f in args.var_file or []:
+        tfvars.update(load_tfvars(f))
+    for kv in args.var or []:
+        k, v = _parse_var(kv)
+        tfvars[k] = v
+    return tfvars
+
+
+def _load_state(path: str | None) -> State | None:
+    if path and os.path.exists(path):
+        with open(path) as fh:
+            return State.from_json(fh.read())
+    return None
+
+
+def cmd_validate(args) -> int:
+    findings = validate_module(load_module(args.dir))
+    for f in findings:
+        print(f)
+    errors = [f for f in findings if f.severity == "error"]
+    print(f"{'Success! ' if not errors else ''}{len(findings)} finding(s), "
+          f"{len(errors)} error(s).")
+    return 1 if errors else 0
+
+
+def cmd_plan(args) -> int:
+    try:
+        plan = simulate_plan(args.dir, _gather_vars(args))
+    except PlanError as ex:
+        print(f"Error: {ex}", file=sys.stderr)
+        return 1
+    d = diff(plan, _load_state(args.state))
+    if args.json:
+        print(json.dumps({
+            "actions": d.actions,
+            "changed_keys": d.changed_keys,
+            "outputs": render(plan.outputs),
+        }, indent=2, sort_keys=True))
+        return 0
+    marks = {"create": "+", "update": "~"}
+    for addr in plan.order:
+        for iaddr in sorted(a for a in d.actions
+                            if d.actions[a] != "delete" and (
+                                a == addr or a.startswith(addr + "[") or
+                                a.startswith(addr + "."))):
+            act = d.actions[iaddr]
+            if act == "no-op" and not args.show_noop:
+                continue
+            line = f"  {marks.get(act, ' ')} {iaddr}"
+            if act == "update":
+                line += f"  ({', '.join(d.changed_keys[iaddr])})"
+            print(line)
+    for iaddr in d.by_action("delete"):
+        print(f"  - {iaddr}")
+    print(d.summary())
+    return 0
+
+
+def cmd_apply(args) -> int:
+    try:
+        plan = simulate_plan(args.dir, _gather_vars(args))
+    except PlanError as ex:
+        print(f"Error: {ex}", file=sys.stderr)
+        return 1
+    prior = _load_state(args.state)
+    d = diff(plan, prior)
+    state = apply_plan(plan, prior)
+    if args.state:
+        with open(args.state, "w") as fh:
+            fh.write(state.to_json())
+    print(d.summary().replace("Plan:", "Apply complete:")
+          .replace("to add", "added").replace("to change", "changed")
+          .replace("to destroy", "destroyed"))
+    return 0
+
+
+def cmd_destroy(args) -> int:
+    try:
+        d = simulate_destroy(args.dir, _gather_vars(args))
+    except PlanError as ex:
+        print(f"Error: {ex}", file=sys.stderr)
+        return 1
+    for addr in d.order:
+        print(f"  - {addr}")
+    for h in d.hazards:
+        print(f"HAZARD: {h.describe()}", file=sys.stderr)
+    print(f"Destroy: {len(d.order)} to destroy, {len(d.hazards)} hazard(s).")
+    return 1 if d.hazards else 0
+
+
+def _tf_files(paths: list[str]) -> list[str]:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if f.endswith(".tf")))
+        else:
+            out.append(p)
+    return out
+
+
+def cmd_fmt(args) -> int:
+    dirty = 0
+    for path in _tf_files(args.paths):
+        with open(path) as fh:
+            text = fh.read()
+        formatted = format_text(text)
+        if formatted == text:
+            continue
+        dirty += 1
+        if args.check:
+            print(path)
+            for fd in check_text(text, path):
+                print(f"  {fd}")
+        else:
+            with open(path, "w") as fh:
+                fh.write(formatted)
+            print(f"rewrote {path}")
+    return 1 if (args.check and dirty) else 0
+
+
+def cmd_docs(args) -> int:
+    if args.check:
+        ok = check_readme(args.dir)
+        print("README up to date." if ok else
+              "README is stale — regenerate with the docs command.")
+        return 0 if ok else 1
+    print(generate_docs(load_module(args.dir)))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tfsim", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def add_module_cmd(name, fn, state=False):
+        c = sub.add_parser(name)
+        c.add_argument("dir")
+        c.add_argument("-var", action="append", dest="var")
+        c.add_argument("-var-file", action="append", dest="var_file")
+        if state:
+            c.add_argument("-state", default=None)
+        c.set_defaults(fn=fn)
+        return c
+
+    v = sub.add_parser("validate")
+    v.add_argument("dir")
+    v.set_defaults(fn=cmd_validate)
+
+    c = add_module_cmd("plan", cmd_plan, state=True)
+    c.add_argument("-json", action="store_true")
+    c.add_argument("-show-noop", action="store_true")
+    add_module_cmd("apply", cmd_apply, state=True)
+    add_module_cmd("destroy", cmd_destroy)
+
+    f = sub.add_parser("fmt")
+    f.add_argument("paths", nargs="+")
+    f.add_argument("-check", action="store_true")
+    f.set_defaults(fn=cmd_fmt)
+
+    d = sub.add_parser("docs")
+    d.add_argument("dir")
+    d.add_argument("-check", action="store_true")
+    d.set_defaults(fn=cmd_docs)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

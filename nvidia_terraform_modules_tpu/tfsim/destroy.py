@@ -118,12 +118,14 @@ def _analyze_module(module: Module, plan: Plan, *, prefix: str = "",
     locals_refs = module_locals_refs(module, resource_types)
     node_addrs = set(plan.order)
     own_needs: dict[str, set[str]] = {}
+    declared: set[str] = set()   # provider keys this module configures itself
     for prov in module.providers:
+        key = prov.name if prov.alias is None else f"{prov.name}.{prov.alias}"
+        declared.add(key)
         refs = _collect_addresses(prov.body, resource_types, locals_refs)
         needs = {r for r in refs if r in node_addrs and
                  not r.startswith("data.")}
         if needs:
-            key = prov.name if prov.alias is None else f"{prov.name}.{prov.alias}"
             own_needs.setdefault(key, set()).update(needs)
 
     closure = _transitive_deps(plan.edges)
@@ -137,7 +139,9 @@ def _analyze_module(module: Module, plan: Plan, *, prefix: str = "",
             needs_report |= {prefix + n for n in own_needs[pkey]}
             missing |= {prefix + n for n in own_needs[pkey]
                         if n != addr and n not in deps}
-        elif pkey in inherited_needs:
+        elif pkey not in declared and pkey in inherited_needs:
+            # a provider block declared here shadows the inherited config,
+            # even when its own configuration reads no resources
             # parent-space needs: safe only if the whole module instance
             # depends on them (nothing inside this plan can create the edge)
             needs_report |= inherited_needs[pkey]
@@ -162,11 +166,13 @@ def _analyze_module(module: Module, plan: Plan, *, prefix: str = "",
                 if child_mod is None:
                     child_mod = load_module(cplan.module_path)
                     module_cache[cplan.module_path] = child_mod
-                # providers inherit downward; needs stay in OUR address space
+                # providers inherit downward; needs stay in OUR address
+                # space; our declarations shadow what we inherited
                 child_inherited = {
                     k: {prefix + n for n in v} for k, v in own_needs.items()}
                 for k, v in inherited_needs.items():
-                    child_inherited.setdefault(k, set()).update(v)
+                    if k not in declared:
+                        child_inherited.setdefault(k, set()).update(v)
                 # what this module call is ordered after, in parent space
                 call_deps = {prefix + d for d in closure.get(addr, set())}
                 child = _analyze_module(

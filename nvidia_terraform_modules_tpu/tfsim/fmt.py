@@ -207,7 +207,9 @@ def _align(lines: list[str], scanned: list[_Line]) -> list[str]:
     run: list[int] = []
 
     def flush():
-        if len(run) >= 2:
+        # a run of one still gets `name = value` spacing (width == len(name));
+        # runs of two or more additionally align their `=` columns
+        if run:
             parsed = []
             for idx in run:
                 indent = len(out[idx]) - len(out[idx].lstrip())

@@ -80,12 +80,21 @@ def _values_match(planned: Any, applied: Any) -> bool:
     if planned == COMPUTED_STR:
         return True
     if isinstance(planned, dict) and isinstance(applied, dict):
-        return set(planned) == set(applied) and all(
-            _values_match(v, applied[k]) for k, v in planned.items())
+        # same missing-key rule at every depth: a key gone from config is
+        # only a change if its stored value was config-driven
+        return all(_values_match(planned.get(k, _MISSING), applied.get(k))
+                   for k in set(planned) | set(applied))
     if isinstance(planned, list) and isinstance(applied, list):
         return len(planned) == len(applied) and all(
             _values_match(p, a) for p, a in zip(planned, applied))
     return planned == applied
+
+
+def _is_data(addr: str) -> bool:
+    """True for data sources at any module depth (module.x.data.t.n too)."""
+    while addr.startswith("module."):
+        addr = addr.split(".", 2)[2]
+    return addr.startswith("data.")
 
 
 def _rendered_instances(plan: Plan) -> dict[str, Any]:
@@ -93,7 +102,7 @@ def _rendered_instances(plan: Plan) -> dict[str, Any]:
     # neither their reads nor their disappearance as plan actions
     return {addr: render(dict(inst.attrs))
             for addr, inst in plan.instances.items()
-            if not addr.startswith("data.")}
+            if not _is_data(addr)}
 
 
 def diff(plan: Plan, state: State | None) -> Diff:

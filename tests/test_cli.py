@@ -1,0 +1,123 @@
+"""tfsim CLI: the terraform-shaped operator surface (SURVEY L7), offline.
+
+Each verb is exercised through main(argv) — same code path as
+``python -m nvidia_terraform_modules_tpu.tfsim`` — against the shipped
+modules, including a full plan → apply → re-plan statefile round-trip.
+"""
+
+import json
+import os
+
+import pytest
+
+from nvidia_terraform_modules_tpu.tfsim.__main__ import main
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GKE_TPU = os.path.join(ROOT, "gke-tpu")
+VARS = ["-var", "project_id=p", "-var", "cluster_name=c"]
+
+
+def test_validate_ok(capsys):
+    assert main(["validate", GKE_TPU]) == 0
+    assert "0 error(s)" in capsys.readouterr().out
+
+
+def test_validate_catches_errors(tmp_path, capsys):
+    (tmp_path / "main.tf").write_text(
+        'resource "google_compute_network" "n" {\n  name = var.missing\n}\n')
+    assert main(["validate", str(tmp_path)]) == 1
+    assert "missing" in capsys.readouterr().out
+
+
+def test_plan_fresh_shows_creates(capsys):
+    assert main(["plan", GKE_TPU] + VARS) == 0
+    out = capsys.readouterr().out
+    assert '  + google_container_cluster.this' in out
+    assert "Plan: 10 to add, 0 to change, 0 to destroy." in out
+
+
+def test_plan_json(capsys):
+    assert main(["plan", GKE_TPU, "-json"] + VARS) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["actions"]["google_container_cluster.this"] == "create"
+    assert payload["outputs"]["cluster_name"] == "c"
+
+
+def test_plan_missing_var_fails(capsys):
+    assert main(["plan", GKE_TPU]) == 1
+    assert "project_id" in capsys.readouterr().err
+
+
+def test_apply_plan_roundtrip_via_statefile(tmp_path, capsys):
+    state = str(tmp_path / "terraform.tfstate.json")
+    assert main(["apply", GKE_TPU, "-state", state] + VARS) == 0
+    assert "Apply complete: 10 added" in capsys.readouterr().out
+    # unchanged re-plan against the saved state: all no-op
+    assert main(["plan", GKE_TPU, "-state", state] + VARS) == 0
+    assert "Plan: 0 to add, 0 to change, 0 to destroy." in capsys.readouterr().out
+    # a drifted variable surfaces as exactly one update
+    assert main(["plan", GKE_TPU, "-state", state, "-var",
+                 'cpu_pool={"machine_type": "n2-standard-16"}'] + VARS) == 0
+    out = capsys.readouterr().out
+    assert "~ google_container_node_pool.cpu  (node_config)" in out
+    assert "Plan: 0 to add, 1 to change, 0 to destroy." in out
+
+
+def test_destroy_reports_order_and_exit(capsys):
+    assert main(["destroy", GKE_TPU] + VARS) == 0
+    out = capsys.readouterr().out
+    assert "Destroy: 11 to destroy, 0 hazard(s)." in out
+    assert out.strip().splitlines()[-2].strip() == "- google_compute_network.vpc"
+
+
+def test_destroy_hazard_exit_code(tmp_path, capsys):
+    (tmp_path / "main.tf").write_text("""
+resource "google_container_cluster" "c" {
+  name = "x"
+}
+
+provider "kubernetes" {
+  host = google_container_cluster.c.endpoint
+}
+
+resource "kubernetes_namespace_v1" "ns" {
+  metadata {
+    name = "op"
+  }
+}
+""")
+    assert main(["destroy", str(tmp_path)]) == 1
+    assert "HAZARD" in capsys.readouterr().err
+
+
+def test_fmt_check_clean_tree():
+    assert main(["fmt", "-check", os.path.join(ROOT, "gke"), GKE_TPU]) == 0
+
+
+def test_fmt_check_flags_dirty(tmp_path, capsys):
+    (tmp_path / "main.tf").write_text(
+        'resource "google_compute_network" "n" {\nname="x"\n}\n')
+    assert main(["fmt", "-check", str(tmp_path)]) == 1
+    assert "main.tf" in capsys.readouterr().out
+
+
+def test_fmt_rewrites_in_place(tmp_path):
+    f = tmp_path / "main.tf"
+    f.write_text('resource "google_compute_network" "n" {\nname="x"\n}\n')
+    assert main(["fmt", str(tmp_path)]) == 0
+    assert main(["fmt", "-check", str(tmp_path)]) == 0
+    assert 'name = "x"' in f.read_text()
+
+
+def test_docs_check_and_render(capsys):
+    assert main(["docs", "-check", GKE_TPU]) == 0
+    capsys.readouterr()
+    assert main(["docs", GKE_TPU]) == 0
+    assert "tpu_slices" in capsys.readouterr().out
+
+
+def test_var_file(tmp_path, capsys):
+    vf = tmp_path / "fixture.tfvars"
+    vf.write_text('project_id = "p"\ncluster_name = "c"\n')
+    assert main(["plan", GKE_TPU, "-var-file", str(vf)]) == 0
+    assert "Plan: 10 to add" in capsys.readouterr().out

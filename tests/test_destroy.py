@@ -280,6 +280,57 @@ def test_parent_provider_protected_by_module_dependency(tmp_path):
         d.order.index("module.gke.google_container_cluster.c")
 
 
+def test_child_declared_provider_shadows_inherited(tmp_path):
+    """A child module with its OWN provider block (even statically
+    configured) must not inherit the parent's provider needs."""
+    import textwrap
+    for name, body in [
+        ("gke", """
+            resource "google_container_cluster" "c" {
+              name = "x"
+            }
+
+            output "endpoint" {
+              value = google_container_cluster.c.endpoint
+            }
+        """),
+        ("app", """
+            variable "host" {
+              type    = string
+              default = "https://static.invalid"
+            }
+
+            provider "kubernetes" {
+              host = var.host
+            }
+
+            resource "kubernetes_namespace_v1" "ns" {
+              metadata {
+                name = "operator"
+              }
+            }
+        """),
+    ]:
+        d = tmp_path / name
+        d.mkdir()
+        (d / "main.tf").write_text(textwrap.dedent(body))
+    (tmp_path / "main.tf").write_text(textwrap.dedent("""
+        module "gke" {
+          source = "./gke"
+        }
+
+        provider "kubernetes" {
+          host = module.gke.endpoint
+        }
+
+        module "app" {
+          source = "./app"
+        }
+    """))
+    d = simulate_destroy(str(tmp_path), {})
+    assert d.ok, [h.describe() for h in d.hazards]
+
+
 def test_cnpack_examples_destroy_hazard_free():
     for path in ("gke/examples/cnpack", "gke-tpu/examples/cnpack"):
         d = simulate_destroy(os.path.join(MODULE_DIR, path),

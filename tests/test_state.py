@@ -127,6 +127,50 @@ def test_data_sources_are_not_plan_actions(tmp_path):
     assert "data.google_project.p" not in state.resources
 
 
+def test_child_module_data_sources_not_tracked(tmp_path):
+    import textwrap
+    child = tmp_path / "child"
+    child.mkdir()
+    (child / "main.tf").write_text(textwrap.dedent("""
+        data "google_project" "p" {}
+
+        resource "google_compute_network" "n" {
+          name = "x"
+        }
+    """))
+    (tmp_path / "main.tf").write_text(
+        'module "c" {\n  source = "./child"\n}\n')
+    plan = simulate_plan(str(tmp_path), {})
+    d = diff(plan, None)
+    assert "module.c.data.google_project.p" not in d.actions
+    assert d.summary() == "Plan: 1 to add, 0 to change, 0 to destroy."
+
+
+def test_nested_computed_key_removal_is_noop(tmp_path):
+    """The provider-owned rule must hold at any nesting depth."""
+    import textwrap
+
+    def write(labels_line):
+        (tmp_path / "main.tf").write_text(textwrap.dedent(f"""
+            resource "google_container_cluster" "c" {{
+              name = "x"
+            }}
+
+            resource "google_compute_network" "n" {{
+              name = "y"
+              labels = {{
+                {labels_line}
+              }}
+            }}
+        """))
+        return simulate_plan(str(tmp_path), {})
+
+    state = apply_plan(write('owner = google_container_cluster.c.id'))
+    d = diff(write(""), state)
+    # the removed nested key's stored value was <computed> → not config drift
+    assert d.actions["google_compute_network.n"] == "no-op", d.changed_keys
+
+
 def test_incremental_apply_converges():
     state = apply_plan(_plan())
     plan2 = _plan({"tpu_slices": {"default": {}, "b": {"topology": "2x2x4",
