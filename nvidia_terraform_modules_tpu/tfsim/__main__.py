@@ -29,7 +29,7 @@ from .docs import check_readme, generate_docs
 from .fmt import check_text, format_text
 from .module import load_module
 from .plan import PlanError, load_tfvars, render, simulate_plan
-from .state import State, apply_plan, diff
+from .state import State, apply_plan, diff, migrate_state
 from .validate import validate_module
 
 
@@ -70,18 +70,32 @@ def cmd_validate(args) -> int:
     return 1 if errors else 0
 
 
+def _plan_against_state(args):
+    """(plan, prior-state-after-moved-migration) for plan/apply verbs."""
+    mod = load_module(args.dir)
+    plan = simulate_plan(mod, _gather_vars(args))
+    prior = _load_state(args.state)
+    if prior is not None:
+        prior, renames = migrate_state(prior, mod)
+        for old, new in renames:
+            # stderr: diagnostics must not corrupt `plan -json` stdout
+            print(f"  moved: {old} -> {new}", file=sys.stderr)
+    return plan, prior
+
+
 def cmd_plan(args) -> int:
     try:
-        plan = simulate_plan(args.dir, _gather_vars(args))
-    except PlanError as ex:
+        plan, prior = _plan_against_state(args)
+    except (PlanError, ValueError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
         return 1
-    d = diff(plan, _load_state(args.state))
+    d = diff(plan, prior)
     if args.json:
         print(json.dumps({
             "actions": d.actions,
             "changed_keys": d.changed_keys,
             "outputs": render(plan.outputs),
+            "check_failures": plan.check_failures,
         }, indent=2, sort_keys=True))
         return 0
     marks = {"create": "+", "update": "~"}
@@ -99,22 +113,25 @@ def cmd_plan(args) -> int:
             print(line)
     for iaddr in d.by_action("delete"):
         print(f"  - {iaddr}")
+    for failure in plan.check_failures:
+        print(f"Warning: {failure}", file=sys.stderr)
     print(d.summary())
     return 0
 
 
 def cmd_apply(args) -> int:
     try:
-        plan = simulate_plan(args.dir, _gather_vars(args))
-    except PlanError as ex:
+        plan, prior = _plan_against_state(args)
+    except (PlanError, ValueError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
         return 1
-    prior = _load_state(args.state)
     d = diff(plan, prior)
     state = apply_plan(plan, prior)
     if args.state:
         with open(args.state, "w") as fh:
             fh.write(state.to_json())
+    for failure in plan.check_failures:
+        print(f"Warning: {failure}", file=sys.stderr)
     print(d.summary().replace("Plan:", "Apply complete:")
           .replace("to add", "added").replace("to change", "changed")
           .replace("to destroy", "destroyed"))

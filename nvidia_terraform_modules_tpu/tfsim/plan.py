@@ -45,6 +45,7 @@ class Plan:
     edges: list[tuple[str, str]]                 # (from_address, to_address)
     order: list[str]                             # topological apply order
     child_plans: dict[str, "Plan"] = dataclasses.field(default_factory=dict)
+    check_failures: list[str] = dataclasses.field(default_factory=list)
 
     def instance(self, address: str) -> PlannedInstance:
         return self.instances[address]
@@ -309,10 +310,35 @@ def simulate_plan(
         except EvalError as ex:
             raise PlanError(f"output {name!r}: {ex}")
 
+    # 6. check blocks: postconditions, terraform-style (failures warn, the
+    #    plan itself still succeeds) -------------------------------------
+    check_failures: list[str] = []
+    for blk in module.checks:
+        label = blk.labels[0] if blk.labels else "<unnamed>"
+        for ab in blk.body.blocks_of("assert"):
+            cond_attr = ab.body.attr("condition")
+            if cond_attr is None:
+                continue
+            try:
+                ok_v = evaluate(cond_attr.expr, scope)
+            except EvalError:
+                continue
+            if ok_v is COMPUTED or ok_v:
+                continue
+            msg = ""
+            msg_attr = ab.body.attr("error_message")
+            try:
+                if msg_attr is not None:
+                    msg = evaluate(msg_attr.expr, scope)
+            except EvalError:
+                pass
+            check_failures.append(f"check {label!r}: {msg}")
+
     edges = [(a, d) for a, ds in deps.items() for d in ds]
     return Plan(
         module_path=module.path, instances=instances, outputs=outputs,
         edges=edges, order=order, child_plans=child_plans,
+        check_failures=check_failures,
     )
 
 

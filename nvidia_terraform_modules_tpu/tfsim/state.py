@@ -130,6 +130,62 @@ def diff(plan: Plan, state: State | None) -> Diff:
     return Diff(actions=actions, changed_keys=changed)
 
 
+def _moved_addr(expr) -> str | None:
+    """Render a ``moved`` from/to traversal as a state address.
+
+    Unlike ``path_str`` (diagnostics), index ops render their literal keys —
+    ``a.b[1]`` / ``a.b["k"]`` — so instance-keyed moves match state entries.
+    """
+    from . import ast as A
+
+    if not isinstance(expr, A.Traversal):
+        return None
+    out = expr.root
+    for op in expr.ops:
+        if op[0] == "attr":
+            out += f".{op[1]}"
+        elif op[0] == "index" and isinstance(op[1], A.Literal):
+            v = op[1].value
+            out += f'["{v}"]' if isinstance(v, str) else f"[{int(v)}]"
+        else:
+            return None   # splat / computed index: not a concrete address
+    return out
+
+
+def migrate_state(state: State, module) -> tuple[State, list[tuple[str, str]]]:
+    """Honour ``moved {}`` blocks: rename state addresses, no destroy/create.
+
+    Terraform 1.1+ refactoring support — ``moved { from = a.b  to = a.c }``
+    retargets existing state so a rename plans as no-op instead of
+    destroy+create. Handles whole resources (instance suffixes follow),
+    single instances (``from = a.b[1]``), and module renames
+    (``from = module.a``). Raises ``ValueError`` when the destination
+    already exists in state (terraform: "resource already exists").
+    """
+    renames: list[tuple[str, str]] = []
+    resources = dict(state.resources)
+    for blk in getattr(module, "moved", []):
+        frm_attr, to_attr = blk.body.attr("from"), blk.body.attr("to")
+        frm = _moved_addr(frm_attr.expr) if frm_attr is not None else None
+        to = _moved_addr(to_attr.expr) if to_attr is not None else None
+        if frm is None or to is None:
+            continue
+        for addr in list(resources):
+            # exact node/instance, an instance of the node, or a child of a
+            # moved module — never a mere name prefix (module.a vs module.ab)
+            if addr == frm or addr.startswith(frm + "[") or \
+                    addr.startswith(frm + "."):
+                new = to + addr[len(frm):]
+                if new in resources:
+                    raise ValueError(
+                        f"moved: target {new!r} already exists in state")
+                resources[new] = resources.pop(addr)
+                renames.append((addr, new))
+    if not renames:
+        return state, []
+    return State(resources=resources, serial=state.serial + 1), renames
+
+
 def apply_plan(plan: Plan, state: State | None = None) -> State:
     """Advance ``state`` to ``plan``: the simulated ``terraform apply``.
 

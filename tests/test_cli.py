@@ -116,6 +116,44 @@ def test_docs_check_and_render(capsys):
     assert "tpu_slices" in capsys.readouterr().out
 
 
+def test_plan_json_stays_parseable_with_moved_blocks(tmp_path, capsys):
+    """moved diagnostics go to stderr; -json stdout must json.loads clean."""
+    state = str(tmp_path / "s.json")
+    (tmp_path / "main.tf").write_text(
+        'resource "google_compute_network" "old" {\n  name = "x"\n}\n')
+    assert main(["apply", str(tmp_path), "-state", state]) == 0
+    (tmp_path / "main.tf").write_text(
+        'resource "google_compute_network" "new" {\n  name = "x"\n}\n\n'
+        'moved {\n  from = google_compute_network.old\n'
+        '  to   = google_compute_network.new\n}\n')
+    capsys.readouterr()
+    assert main(["plan", str(tmp_path), "-state", state, "-json"]) == 0
+    cap = capsys.readouterr()
+    payload = json.loads(cap.out)
+    assert payload["actions"]["google_compute_network.new"] == "no-op"
+    assert "moved:" in cap.err
+
+
+def test_check_failures_in_json_and_apply(tmp_path, capsys):
+    (tmp_path / "main.tf").write_text("""
+resource "google_compute_network" "n" {
+  name = "x"
+}
+
+check "quota" {
+  assert {
+    condition     = 1 == 2
+    error_message = "over quota"
+  }
+}
+""")
+    assert main(["plan", str(tmp_path), "-json"]) == 0
+    cap = capsys.readouterr()
+    assert json.loads(cap.out)["check_failures"] == ["check 'quota': over quota"]
+    assert main(["apply", str(tmp_path)]) == 0
+    assert "over quota" in capsys.readouterr().err
+
+
 def test_var_file(tmp_path, capsys):
     vf = tmp_path / "fixture.tfvars"
     vf.write_text('project_id = "p"\ncluster_name = "c"\n')

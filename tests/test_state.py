@@ -171,6 +171,123 @@ def test_nested_computed_key_removal_is_noop(tmp_path):
     assert d.actions["google_compute_network.n"] == "no-op", d.changed_keys
 
 
+def test_moved_block_renames_state_without_churn(tmp_path):
+    """terraform 1.1 refactoring: a rename plans no-op, not destroy+create."""
+    import textwrap
+
+    from nvidia_terraform_modules_tpu.tfsim import load_module, migrate_state
+
+    def write(body):
+        (tmp_path / "main.tf").write_text(textwrap.dedent(body))
+        return str(tmp_path)
+
+    path = write("""
+        resource "google_compute_network" "old" {
+          count = 2
+          name  = "net-${count.index}"
+        }
+    """)
+    state = apply_plan(simulate_plan(path, {}))
+    assert "google_compute_network.old[1]" in state.resources
+
+    path = write("""
+        resource "google_compute_network" "new" {
+          count = 2
+          name  = "net-${count.index}"
+        }
+
+        moved {
+          from = google_compute_network.old
+          to   = google_compute_network.new
+        }
+    """)
+    migrated, renames = migrate_state(state, load_module(path))
+    assert ("google_compute_network.old[0]",
+            "google_compute_network.new[0]") in renames
+    d = diff(simulate_plan(path, {}), migrated)
+    assert d.is_noop, d.actions
+    # and with no moved blocks the same refactor would churn
+    d_raw = diff(simulate_plan(path, {}), state)
+    assert d_raw.by_action("create") and d_raw.by_action("delete")
+
+
+def test_moved_single_instance_and_module(tmp_path):
+    import textwrap
+
+    from nvidia_terraform_modules_tpu.tfsim import load_module, migrate_state
+
+    state = State(resources={
+        "google_compute_network.a[0]": {"name": "n0"},
+        "google_compute_network.a[1]": {"name": "n1"},
+        "module.a.google_compute_network.n": {"name": "child"},
+        "module.ab.google_compute_network.n": {"name": "other"},
+    }, serial=1)
+    (tmp_path / "main.tf").write_text(textwrap.dedent("""
+        moved {
+          from = google_compute_network.a[1]
+          to   = google_compute_network.b
+        }
+
+        moved {
+          from = module.a
+          to   = module.z
+        }
+    """))
+    migrated, renames = migrate_state(state, load_module(str(tmp_path)))
+    assert ("google_compute_network.a[1]", "google_compute_network.b") in renames
+    assert ("module.a.google_compute_network.n",
+            "module.z.google_compute_network.n") in renames
+    # name-prefix sibling untouched; unmoved instance untouched
+    assert "module.ab.google_compute_network.n" in migrated.resources
+    assert "google_compute_network.a[0]" in migrated.resources
+
+
+def test_moved_collision_raises(tmp_path):
+    import textwrap
+
+    import pytest
+
+    from nvidia_terraform_modules_tpu.tfsim import load_module, migrate_state
+
+    state = State(resources={
+        "google_compute_network.a": {"name": "x"},
+        "google_compute_network.b": {"name": "y"},
+    }, serial=1)
+    (tmp_path / "main.tf").write_text(textwrap.dedent("""
+        moved {
+          from = google_compute_network.a
+          to   = google_compute_network.b
+        }
+    """))
+    with pytest.raises(ValueError, match="already exists"):
+        migrate_state(state, load_module(str(tmp_path)))
+
+
+def test_check_block_failures_surface_as_warnings(tmp_path):
+    import textwrap
+    (tmp_path / "main.tf").write_text(textwrap.dedent("""
+        variable "n" {
+          type    = number
+          default = 3
+        }
+
+        resource "google_compute_network" "net" {
+          name = "x"
+        }
+
+        check "capacity" {
+          assert {
+            condition     = var.n <= 2
+            error_message = "n must stay within quota"
+          }
+        }
+    """))
+    plan = simulate_plan(str(tmp_path), {})
+    assert plan.check_failures == ["check 'capacity': n must stay within quota"]
+    ok_plan = simulate_plan(str(tmp_path), {"n": 1})
+    assert ok_plan.check_failures == []
+
+
 def test_incremental_apply_converges():
     state = apply_plan(_plan())
     plan2 = _plan({"tpu_slices": {"default": {}, "b": {"topology": "2x2x4",
