@@ -67,8 +67,8 @@ output "total_tpu_chips" {
 }
 
 output "smoketest_job" {
-  description = "Name of the validation Job (null when disabled); `kubectl logs job/<name> -n <ns>` shows the per-host JSON verdicts."
-  value       = local.smoketest_enabled ? kubernetes_job_v1.tpu_smoketest[0].metadata[0].name : null
+  description = "Validation Job names, one per validated slice (null when disabled); `kubectl logs job/<name> -n <ns>` shows the per-host JSON verdicts."
+  value       = local.smoketest_enabled ? [for j in values(kubernetes_job_v1.tpu_smoketest) : j.metadata[0].name] : null
 }
 
 output "runtime_namespace" {

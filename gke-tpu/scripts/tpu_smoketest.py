@@ -7,11 +7,14 @@ package dependencies beyond jax — it is mounted from a ConfigMap into any
 JAX-capable image (see smoketest.tf).
 
 Env contract (injected by the gke-tpu module):
-  TPU_SMOKETEST_EXPECTED_DEVICES  chips the whole slice must expose
+  TPU_SMOKETEST_EXPECTED_DEVICES  chips the whole world must expose
   TPU_SMOKETEST_LEVEL             psum | probes | burnin
-  TPU_SMOKETEST_HOSTS             hosts in the slice (Job completions)
-  TPU_SMOKETEST_COORDINATOR       headless-service DNS of pod 0
-  TPU_SMOKETEST_INIT_TIMEOUT      seconds to wait for the full slice (300)
+  TPU_SMOKETEST_HOSTS             TOTAL hosts in the world (all slices)
+  TPU_SMOKETEST_PROCESS_BASE      this slice's host-index offset (0 default)
+  TPU_SMOKETEST_SLICES            slice count; > 1 adds a cross-slice (DCN)
+                                  psum check
+  TPU_SMOKETEST_COORDINATOR       headless-service DNS of slice-0 pod 0
+  TPU_SMOKETEST_INIT_TIMEOUT      seconds to wait for the full world (300)
   JOB_COMPLETION_INDEX            set by Kubernetes on Indexed Jobs
 
 Prints ONE JSON line; exit 0 iff every check passed. `terraform apply`
@@ -37,7 +40,8 @@ def main() -> int:
         return 2
 
     hosts = int(os.environ.get("TPU_SMOKETEST_HOSTS", "1"))
-    idx = int(os.environ.get("JOB_COMPLETION_INDEX", "0"))
+    idx = int(os.environ.get("JOB_COMPLETION_INDEX", "0")) + \
+        int(os.environ.get("TPU_SMOKETEST_PROCESS_BASE", "0"))
     out.update({"level": level, "process_id": idx, "num_processes": hosts})
 
     import jax
@@ -94,6 +98,33 @@ def main() -> int:
 
     out["psum_ok"] = bool(np.allclose(local_values(allreduce()), float(n)))
     ok = out["psum_ok"]
+
+    # 1b. cross-slice (DCN) psum: a reduction over the slice axis proves the
+    # inter-slice path carries collectives, not just the in-slice ICI ring.
+    # Devices group by slice_index metadata when the runtime provides it
+    # (real multi-slice); contiguous grouping otherwise (process-major
+    # enumeration puts each slice's hosts together).
+    slices = int(os.environ.get("TPU_SMOKETEST_SLICES", "1"))
+    if slices > 1 and ok and n % slices == 0:
+        if all(getattr(d, "slice_index", None) is not None for d in devices):
+            devs = sorted(devices, key=lambda d: (d.slice_index, d.id))
+        else:
+            devs = list(devices)
+        per = n // slices
+        mesh2 = Mesh(
+            np.asarray(devs).reshape(slices, per), ("slice", "x"))
+
+        @jax.jit
+        @functools.partial(
+            jax.shard_map, mesh=mesh2, in_specs=(), out_specs=P("slice", "x"))
+        def dcn_psum():
+            return jax.lax.psum(jnp.ones((1, 256), jnp.float32), "slice")
+
+        shards = dcn_psum().addressable_shards
+        out["dcn_psum_ok"] = bool(all(
+            np.allclose(np.asarray(s.data), float(slices)) for s in shards))
+        out["slices"] = slices
+        ok = ok and out["dcn_psum_ok"]
 
     # 2. collective probes over the same ring
     if level in ("probes", "burnin") and ok and n > 1:

@@ -16,12 +16,50 @@
 # host. The pod payload is the single-file bundle of this repo's
 # nvidia_terraform_modules_tpu.smoketest (scripts/tpu_smoketest.py), shipped
 # via ConfigMap so any JAX-capable image works unmodified.
+#
+# Multi-slice (smoketest.multislice = true): one indexed Job PER slice, all
+# joined into a single jax.distributed world — process ids are offset per
+# slice (TPU_SMOKETEST_PROCESS_BASE), every pod dials slice 0's pod 0, and
+# MEGASCALE_* env wires libtpu's DCN transport. The payload then also runs a
+# cross-slice psum, proving the DCN path the way the single-slice test
+# proves ICI.
 
 locals {
   smoketest_enabled = local.tpu_enabled && var.smoketest.enabled
-  smoke_slice       = local.smoketest_enabled ? local.tpu_slice[var.smoketest.target_slice] : null
-  smoke_ns          = local.smoketest_enabled ? kubernetes_namespace_v1.tpu_runtime[0].metadata[0].name : var.tpu_runtime.namespace
-  smoke_name        = "${var.cluster_name}-tpu-smoketest"
+  smoke_slices = (
+    local.smoketest_enabled
+    ? (
+      var.smoketest.multislice
+      ? local.tpu_slice
+      : { (var.smoketest.target_slice) = local.tpu_slice[var.smoketest.target_slice] }
+    )
+    : {}
+  )
+  # deterministic slice order → process-id layout; lexicographic `<` below
+  # matches sort()'s ordering
+  smoke_slice_order = sort(keys(local.smoke_slices))
+  smoke_total_hosts = sum(concat([0], [for s in values(local.smoke_slices) : s.hosts]))
+  smoke_total_chips = sum(concat([0], [for s in values(local.smoke_slices) : s.chips]))
+  smoke_process_base = {
+    for name in local.smoke_slice_order :
+    name => sum(concat([0], [
+      for other in local.smoke_slice_order :
+      local.smoke_slices[other].hosts if other < name
+    ]))
+  }
+  smoke_slice_id = {
+    for name in local.smoke_slice_order :
+    name => length([for other in local.smoke_slice_order : other if other < name])
+  }
+  smoke_ns   = local.smoketest_enabled ? kubernetes_namespace_v1.tpu_runtime[0].metadata[0].name : var.tpu_runtime.namespace
+  smoke_name = "${var.cluster_name}-tpu-smoketest"
+  # jax.distributed coordinator: slice 0, pod 0 (indexed-Job hostname
+  # "<job-name>-<index>" under the headless service's subdomain)
+  smoke_coordinator = (
+    length(local.smoke_slice_order) > 0
+    ? "${local.smoke_name}-${local.smoke_slice_order[0]}-0.${local.smoke_name}.${local.smoke_ns}.svc"
+    : ""
+  )
 }
 
 resource "kubernetes_config_map_v1" "smoketest_script" {
@@ -50,7 +88,9 @@ resource "kubernetes_service_v1" "smoketest_coordinator" {
   spec {
     cluster_ip = "None" # headless: stable per-pod DNS for the coordinator
     selector = {
-      "job-name" = local.smoke_name
+      # one service spans every slice's Job pods (multi-slice worlds share
+      # the coordinator), so match the group label, not job-name
+      "smoketest-group" = local.smoke_name
     }
     port {
       name = "coordinator"
@@ -62,10 +102,10 @@ resource "kubernetes_service_v1" "smoketest_coordinator" {
 }
 
 resource "kubernetes_job_v1" "tpu_smoketest" {
-  count = local.smoketest_enabled ? 1 : 0
+  for_each = local.smoke_slices
 
   metadata {
-    name      = local.smoke_name
+    name      = "${local.smoke_name}-${each.key}"
     namespace = local.smoke_ns
     labels = {
       "app.kubernetes.io/part-of" = "tpu-terraform-modules"
@@ -73,15 +113,16 @@ resource "kubernetes_job_v1" "tpu_smoketest" {
   }
 
   spec {
-    completions     = local.smoke_slice.hosts
-    parallelism     = local.smoke_slice.hosts
+    completions     = each.value.hosts
+    parallelism     = each.value.hosts
     completion_mode = "Indexed"
     backoff_limit   = 2
 
     template {
       metadata {
         labels = {
-          "job-name" = local.smoke_name
+          "smoketest-group" = local.smoke_name
+          "smoketest-slice" = each.key
         }
       }
 
@@ -90,8 +131,8 @@ resource "kubernetes_job_v1" "tpu_smoketest" {
         restart_policy = "Never"
 
         node_selector = {
-          "cloud.google.com/gke-tpu-accelerator" = local.smoke_slice.node_selector
-          "cloud.google.com/gke-tpu-topology"    = local.smoke_slice.topology
+          "cloud.google.com/gke-tpu-accelerator" = each.value.node_selector
+          "cloud.google.com/gke-tpu-topology"    = each.value.topology
         }
 
         toleration {
@@ -107,7 +148,7 @@ resource "kubernetes_job_v1" "tpu_smoketest" {
 
           env {
             name  = "TPU_SMOKETEST_EXPECTED_DEVICES"
-            value = tostring(local.smoke_slice.chips)
+            value = tostring(local.smoke_total_chips)
           }
           env {
             name  = "TPU_SMOKETEST_LEVEL"
@@ -115,19 +156,40 @@ resource "kubernetes_job_v1" "tpu_smoketest" {
           }
           env {
             name  = "TPU_SMOKETEST_HOSTS"
-            value = tostring(local.smoke_slice.hosts)
+            value = tostring(local.smoke_total_hosts)
+          }
+          env {
+            name  = "TPU_SMOKETEST_PROCESS_BASE"
+            value = tostring(local.smoke_process_base[each.key])
+          }
+          env {
+            name  = "TPU_SMOKETEST_SLICES"
+            value = tostring(length(local.smoke_slice_order))
           }
           env {
             name  = "TPU_SMOKETEST_COORDINATOR"
-            value = "${local.smoke_name}-0.${local.smoke_name}.${local.smoke_ns}.svc"
+            value = local.smoke_coordinator
+          }
+
+          # libtpu's DCN transport for cross-slice collectives
+          dynamic "env" {
+            for_each = length(local.smoke_slice_order) > 1 ? {
+              MEGASCALE_NUM_SLICES          = tostring(length(local.smoke_slice_order))
+              MEGASCALE_SLICE_ID            = tostring(local.smoke_slice_id[each.key])
+              MEGASCALE_COORDINATOR_ADDRESS = "${local.smoke_coordinator}:8080"
+            } : {}
+            content {
+              name  = env.key
+              value = env.value
+            }
           }
 
           resources {
             requests = {
-              "google.com/tpu" = local.smoke_slice.chips_per_host
+              "google.com/tpu" = each.value.chips_per_host
             }
             limits = {
-              "google.com/tpu" = local.smoke_slice.chips_per_host
+              "google.com/tpu" = each.value.chips_per_host
             }
           }
 

@@ -207,11 +207,15 @@ variable "smoketest" {
     proves the slice runs collectives). Runs one pod per slice host as an
     indexed Job with a headless service for jax.distributed bootstrap;
     wait_for_completion makes apply block on the result. target_slice names
-    the tpu_slices key to validate. Levels: psum | probes | burnin.
+    the tpu_slices key to validate; multislice = true instead validates ALL
+    declared slices as one jax.distributed world (one Job per slice,
+    MEGASCALE env for libtpu's DCN transport, plus a cross-slice psum).
+    Levels: psum | probes | burnin.
   EOT
   type = object({
     enabled         = optional(bool, true)
     target_slice    = optional(string, "default")
+    multislice      = optional(bool, false)
     level           = optional(string, "probes")
     timeout_seconds = optional(number, 1200)
   })

@@ -43,7 +43,9 @@ def job_env_from_environ(env: dict[str, str] | None = None) -> JobEnv | None:
     injected by the ``gke-tpu`` smoke-test Job template:
 
     - ``JOB_COMPLETION_INDEX`` — set by Kubernetes on indexed Jobs.
-    - ``TPU_SMOKETEST_HOSTS`` — host count (Job ``completions``).
+    - ``TPU_SMOKETEST_HOSTS`` — TOTAL host count of the world (all slices).
+    - ``TPU_SMOKETEST_PROCESS_BASE`` — this slice's host-index offset into
+      the world (0 for single-slice; multi-slice Jobs each get their own).
     - ``TPU_SMOKETEST_COORDINATOR`` — headless-service DNS of pod 0, with or
       without an explicit port.
     """
@@ -51,7 +53,8 @@ def job_env_from_environ(env: dict[str, str] | None = None) -> JobEnv | None:
     hosts = int(e.get("TPU_SMOKETEST_HOSTS", "1"))
     if hosts <= 1:
         return None
-    idx = int(e.get("JOB_COMPLETION_INDEX", e.get("TPU_WORKER_ID", "0")))
+    idx = int(e.get("JOB_COMPLETION_INDEX", e.get("TPU_WORKER_ID", "0"))) + \
+        int(e.get("TPU_SMOKETEST_PROCESS_BASE", "0"))
     coord = e.get("TPU_SMOKETEST_COORDINATOR", "")
     if not coord:
         hostnames = e.get("TPU_WORKER_HOSTNAMES", "")
