@@ -36,7 +36,8 @@ def test_tpu_example_plans_slice_and_identity():
     # child module resources planned through the wrap
     assert ('module.tpu_cluster.google_container_node_pool.'
             'tpu_slice["default"]') in addrs
-    assert "module.tpu_cluster.kubernetes_job_v1.tpu_smoketest[0]" in addrs
+    assert ('module.tpu_cluster.kubernetes_job_v1.'
+            'tpu_smoketest["default"]') in addrs
     # observability identity
     assert "google_service_account.prometheus" in addrs
     assert "google_service_account_iam_member.wi_binding" in addrs

@@ -93,7 +93,7 @@ def test_default_plan_is_v5e8_multihost(tpu_mod):
 def test_smoketest_job_wiring(tpu_mod):
     """The north-star Job: indexed, one pod per host, full-slice env."""
     plan = simulate_plan(tpu_mod, dict(BASE))
-    job = plan.instance("kubernetes_job_v1.tpu_smoketest[0]")
+    job = plan.instance('kubernetes_job_v1.tpu_smoketest["default"]')
     spec = job.attrs["spec"][0]
     assert spec["completions"] == 2
     assert spec["parallelism"] == 2
@@ -107,7 +107,7 @@ def test_smoketest_job_wiring(tpu_mod):
     assert env["TPU_SMOKETEST_EXPECTED_DEVICES"] == "8"
     assert env["TPU_SMOKETEST_HOSTS"] == "2"
     assert env["TPU_SMOKETEST_COORDINATOR"].startswith(
-        "tpu-demo-tpu-smoketest-0.")
+        "tpu-demo-tpu-smoketest-default-0.")
     assert container["resources"][0]["requests"]["google.com/tpu"] == 4
     assert job.attrs["wait_for_completion"] is True
     # headless coordinator service
@@ -135,8 +135,50 @@ def test_multi_slice_fleet(tpu_mod):
     assert plan.outputs["total_tpu_chips"] == 20
     serve = plan.instance('google_container_node_pool.tpu_slice["serve"]')
     assert serve.attrs["node_config"][0]["spot"] is True
-    job = plan.instance("kubernetes_job_v1.tpu_smoketest[0]")
+    job = plan.instance('kubernetes_job_v1.tpu_smoketest["train"]')
     assert job.attrs["spec"][0]["completions"] == 4  # v4-32 hosts
+
+
+def test_multislice_smoketest_wiring(tpu_mod):
+    """multislice=true: one indexed Job per slice, a single shared coordinator
+    (slice 0 pod 0), per-slice process-id bases, and MEGASCALE_* DCN env."""
+    plan = simulate_plan(tpu_mod, {
+        **BASE,
+        "tpu_slices": {
+            "a": {"version": "v5e", "topology": "2x4"},   # 2 hosts, 8 chips
+            "b": {"version": "v4", "topology": "2x2x4"},  # 4 hosts, 16 chips
+        },
+        "smoketest": {"multislice": True},
+    })
+    job_a = plan.instance('kubernetes_job_v1.tpu_smoketest["a"]')
+    job_b = plan.instance('kubernetes_job_v1.tpu_smoketest["b"]')
+
+    def envmap(job):
+        return {e["name"]: e["value"]
+                for e in job.attrs["spec"][0]["template"][0]["spec"][0]
+                ["container"][0]["env"]}
+
+    env_a, env_b = envmap(job_a), envmap(job_b)
+    # world facts span both slices
+    for env in (env_a, env_b):
+        assert env["TPU_SMOKETEST_EXPECTED_DEVICES"] == "24"
+        assert env["TPU_SMOKETEST_HOSTS"] == "6"
+        assert env["TPU_SMOKETEST_SLICES"] == "2"
+        # every pod dials slice 0 ("a", lexicographically first) pod 0
+        assert env["TPU_SMOKETEST_COORDINATOR"].startswith(
+            "tpu-demo-tpu-smoketest-a-0.")
+    # process ids: slice "a" owns hosts [0,2), slice "b" hosts [2,6)
+    assert env_a["TPU_SMOKETEST_PROCESS_BASE"] == "0"
+    assert env_b["TPU_SMOKETEST_PROCESS_BASE"] == "2"
+    # libtpu DCN transport wiring, one slice id each, shared coordinator
+    assert env_a["MEGASCALE_NUM_SLICES"] == "2"
+    assert env_a["MEGASCALE_SLICE_ID"] == "0"
+    assert env_b["MEGASCALE_SLICE_ID"] == "1"
+    assert env_a["MEGASCALE_COORDINATOR_ADDRESS"] == \
+        env_b["MEGASCALE_COORDINATOR_ADDRESS"]
+    # per-slice completions, one pod per host
+    assert job_a.attrs["spec"][0]["completions"] == 2
+    assert job_b.attrs["spec"][0]["completions"] == 4
 
 
 def test_gpu_passthrough_mode(tpu_mod):
@@ -229,7 +271,7 @@ def test_smoketest_without_runtime_layer(tpu_mod):
     addrs = set(plan.instances)
     assert "kubernetes_namespace_v1.tpu_runtime[0]" in addrs
     assert not any(a.startswith("helm_release") for a in addrs)
-    assert "kubernetes_job_v1.tpu_smoketest[0]" in addrs
+    assert 'kubernetes_job_v1.tpu_smoketest["default"]' in addrs
 
 
 def test_runtime_values_yaml_not_set(tpu_mod):
