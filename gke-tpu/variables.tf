@@ -194,7 +194,7 @@ variable "tpu_runtime" {
     enabled   = optional(bool, true)
     namespace = optional(string, "tpu-runtime")
     image     = optional(string, "python:3.12-slim")
-    jax_image = optional(string, "us-docker.pkg.dev/cloud-tpu-images/jax-stable-stack/tpu:latest")
+    jax_image = optional(string, "us-docker.pkg.dev/cloud-tpu-images/jax-stable-stack/tpu:jax0.4.37-rev1")
   })
   default = {}
 }

@@ -27,6 +27,7 @@ import sys
 from .destroy import simulate_destroy
 from .docs import check_readme, generate_docs
 from .fmt import check_text, format_text
+from .lockfile import LockfileError, check_lockfile, write_lockfile
 from .module import load_module
 from .plan import PlanError, load_tfvars, render, simulate_plan
 from .state import State, apply_plan, diff, migrate_state
@@ -184,6 +185,24 @@ def cmd_fmt(args) -> int:
     return 1 if (args.check and dirty) else 0
 
 
+def cmd_lock(args) -> int:
+    findings = []
+    for d in args.dirs:
+        try:
+            if args.check:
+                findings.extend(check_lockfile(d))
+            else:
+                print(f"wrote {write_lockfile(d)}")
+        except (LockfileError, ValueError) as ex:
+            findings.append(f"{d}: {ex}")
+    for f in findings:
+        print(f)
+    if args.check:
+        print(f"{'Success! ' if not findings else ''}"
+              f"{len(findings)} lockfile finding(s).")
+    return 1 if findings else 0
+
+
 def cmd_docs(args) -> int:
     if args.check:
         ok = check_readme(args.dir)
@@ -229,6 +248,11 @@ def main(argv: list[str] | None = None) -> int:
     d.add_argument("dir")
     d.add_argument("-check", action="store_true")
     d.set_defaults(fn=cmd_docs)
+
+    lk = sub.add_parser("lock")
+    lk.add_argument("dirs", nargs="+")
+    lk.add_argument("-check", action="store_true")
+    lk.set_defaults(fn=cmd_lock)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,253 @@
+"""Dependency lockfile support — tfsim's `.terraform.lock.hcl` surface.
+
+The reference commits a lockfile per root module — 6 files pinning 13
+provider selections (``/root/reference/gke/.terraform.lock.hcl:1``, SURVEY
+§4 "Determinism") — so that every `terraform init` resolves the exact same
+plugin builds. This repo's CI has no registry access, so the terraform
+binary can never produce those files here; instead tfsim owns the same
+artifact:
+
+* ``generate_lockfile`` renders a `.terraform.lock.hcl` that pins the exact
+  version *selection* for every provider required anywhere in a root
+  module's tree (walking local ``source = "../../"`` module calls the way
+  `terraform init` does). Version selections are what make `init`
+  deterministic; the ``hashes`` entries are per-platform checksums that
+  only a networked ``terraform providers lock`` can compute, and terraform
+  fills them in on first networked init without changing the selection.
+* ``check_lockfile`` is the CI gate: the committed lockfile must exist,
+  cover every required provider, pin a version that satisfies every
+  constraint in the module tree, and carry no stale extra providers.
+
+Selections default to ``CERTIFIED_PROVIDERS`` — the certified-versions row
+of the support matrix in the repo README (reference analogue:
+``/root/reference/README.md:25-28``).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+
+from . import ast as A
+from .module import Module, load_module
+from .parser import parse_hcl
+
+# The certified provider selections (support matrix, README.md). Exact
+# released versions chosen from each module's `~>` line; bump these with a
+# CHANGELOG entry when re-certifying.
+CERTIFIED_PROVIDERS: dict[str, str] = {
+    "hashicorp/google": "6.8.0",
+    "hashicorp/google-beta": "6.8.0",
+    "hashicorp/kubernetes": "2.32.0",
+    "hashicorp/helm": "2.15.0",
+    "hashicorp/random": "3.6.0",
+}
+
+REGISTRY = "registry.terraform.io"
+LOCKFILE = ".terraform.lock.hcl"
+
+HEADER = """\
+# This file is maintained automatically by "terraform init".
+# Manual edits may be lost in future updates.
+#
+# Version selections generated offline by `tfsim lock` from the certified
+# provider table (see README support matrix); `hashes` are per-platform
+# registry checksums that the first networked `terraform init` (or
+# `terraform providers lock -platform=...`) records without altering the
+# selections below. CI checks selections against every versions.tf
+# constraint in the module tree (tests/test_lockfile.py).
+"""
+
+
+class LockfileError(ValueError):
+    pass
+
+
+@dataclass
+class LockEntry:
+    address: str                 # registry.terraform.io/hashicorp/google
+    version: str
+    constraints: str | None
+    hashes: list[str] = field(default_factory=list)
+
+
+# --------------------------------------------------------------- versions
+
+def _ver(v: str) -> tuple[int, ...]:
+    parts = v.strip().split("-")[0].split(".")
+    if not all(p.isdigit() for p in parts):
+        raise LockfileError(f"unparsable version {v!r}")
+    return tuple(int(p) for p in parts)
+
+
+def _pad(v: tuple[int, ...], n: int = 3) -> tuple[int, ...]:
+    return v + (0,) * (n - len(v))
+
+
+def constraint_satisfied(version: str, constraint: str) -> bool:
+    """Terraform (go-version) constraint semantics: ``=``, ``!=``, ``>``,
+    ``>=``, ``<``, ``<=``, ``~>`` with comma-separated conjunction.
+    Partial versions zero-pad ("= 3.6" means exactly 3.6.0); the pessimistic
+    operator bounds above at the incremented second-to-last segment
+    ("~> 6.8" → >= 6.8.0, < 7.0.0; "~> 2.32.0" → >= 2.32.0, < 2.33.0;
+    "~> 6" → >= 6.0.0, < 7.0.0)."""
+    v = _ver(version)
+    for clause in constraint.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        m = re.match(r"^(~>|>=|<=|!=|[=><])?\s*([\d.]+)$", clause)
+        if not m:
+            raise LockfileError(f"unparsable constraint clause {clause!r}")
+        op, rhs = m.group(1) or "=", _ver(m.group(2))
+        n = max(len(v), len(rhs), 3)
+        vp, rp = _pad(v, n), _pad(rhs, n)
+        if op == "~>":
+            prefix = rhs[:-1] if len(rhs) > 1 else rhs
+            upper = prefix[:-1] + (prefix[-1] + 1,)
+            if not (vp >= rp and v < upper):
+                return False
+        elif op == "=":
+            if vp != rp:
+                return False
+        elif op == "!=":
+            if vp == rp:
+                return False
+        elif op == ">":
+            if not vp > rp:
+                return False
+        elif op == ">=":
+            if not vp >= rp:
+                return False
+        elif op == "<":
+            if not vp < rp:
+                return False
+        elif op == "<=":
+            if not vp <= rp:
+                return False
+    return True
+
+
+# ------------------------------------------------- requirements gathering
+
+def _local_module_dirs(mod: Module) -> list[str]:
+    dirs = []
+    for call in mod.module_calls.values():
+        src = call.body.attr("source")
+        if src and isinstance(src.expr, A.Literal) and \
+                str(src.expr.value).startswith((".", "/")):
+            dirs.append(os.path.normpath(
+                os.path.join(mod.path, str(src.expr.value))))
+    return dirs
+
+
+def gather_requirements(module_dir: str) -> dict[str, list[str]]:
+    """source address ("hashicorp/google") → constraint strings collected
+    from the root module and every local child module, recursively."""
+    reqs: dict[str, list[str]] = {}
+    seen: set[str] = set()
+    queue = [os.path.normpath(module_dir)]
+    while queue:
+        path = queue.pop()
+        if path in seen:
+            continue
+        seen.add(path)
+        mod = load_module(path)
+        for name, spec in mod.required_providers.items():
+            source = str(spec.get("source", f"hashicorp/{name}"))
+            constraint = spec.get("version")
+            lst = reqs.setdefault(source, [])
+            if constraint and constraint not in lst:
+                lst.append(str(constraint))
+        queue.extend(_local_module_dirs(mod))
+    return reqs
+
+
+# ------------------------------------------------------------ parse/render
+
+def parse_lockfile(text: str, filename: str = LOCKFILE) -> dict[str, LockEntry]:
+    body = parse_hcl(text, filename=filename)
+    entries: dict[str, LockEntry] = {}
+    for blk in body.blocks:
+        if blk.type != "provider" or len(blk.labels) != 1:
+            raise LockfileError(
+                f"{filename}:{blk.line}: unexpected block {blk.type!r}")
+        addr = blk.labels[0]
+        ver = blk.body.attr("version")
+        cons = blk.body.attr("constraints")
+        hashes_attr = blk.body.attr("hashes")
+        hashes: list[str] = []
+        if hashes_attr and isinstance(hashes_attr.expr, A.TupleExpr):
+            hashes = [str(e.value) for e in hashes_attr.expr.items
+                      if isinstance(e, A.Literal)]
+        if not (ver and isinstance(ver.expr, A.Literal)):
+            raise LockfileError(f"{filename}:{blk.line}: {addr} missing version")
+        entries[addr] = LockEntry(
+            address=addr,
+            version=str(ver.expr.value),
+            constraints=(str(cons.expr.value)
+                         if cons and isinstance(cons.expr, A.Literal) else None),
+            hashes=hashes,
+        )
+    return entries
+
+
+def generate_lockfile(module_dir: str,
+                      selections: dict[str, str] | None = None) -> str:
+    selections = selections or CERTIFIED_PROVIDERS
+    reqs = gather_requirements(module_dir)
+    out = [HEADER]
+    for source in sorted(reqs):
+        if source not in selections:
+            raise LockfileError(
+                f"no certified selection for provider {source!r} "
+                f"(required by {module_dir})")
+        version = selections[source]
+        for c in reqs[source]:
+            if not constraint_satisfied(version, c):
+                raise LockfileError(
+                    f"{source} selection {version} violates constraint {c!r}")
+        out.append(f'provider "{REGISTRY}/{source}" {{')
+        out.append(f'  version     = "{version}"')
+        if reqs[source]:
+            out.append(f'  constraints = "{", ".join(sorted(reqs[source]))}"')
+        out.append("}")
+        out.append("")
+    return "\n".join(out)
+
+
+# ------------------------------------------------------------------ check
+
+def check_lockfile(module_dir: str) -> list[str]:
+    """CI findings; empty list == lockfile present and consistent."""
+    findings: list[str] = []
+    path = os.path.join(module_dir, LOCKFILE)
+    if not os.path.exists(path):
+        return [f"{module_dir}: missing {LOCKFILE}"]
+    with open(path) as fh:
+        entries = parse_lockfile(fh.read(), filename=path)
+    reqs = gather_requirements(module_dir)
+    for source, constraints in sorted(reqs.items()):
+        addr = f"{REGISTRY}/{source}"
+        entry = entries.pop(addr, None)
+        if entry is None:
+            findings.append(f"{path}: required provider {source} not locked")
+            continue
+        for c in constraints:
+            if not constraint_satisfied(entry.version, c):
+                findings.append(
+                    f"{path}: {source} locked at {entry.version}, which "
+                    f"violates constraint {c!r}")
+    for addr in sorted(entries):
+        findings.append(f"{path}: stale lock entry {addr} (no longer required)")
+    return findings
+
+
+def write_lockfile(module_dir: str,
+                   selections: dict[str, str] | None = None) -> str:
+    path = os.path.join(module_dir, LOCKFILE)
+    text = generate_lockfile(module_dir, selections)
+    with open(path, "w") as fh:
+        fh.write(text)
+    return path
