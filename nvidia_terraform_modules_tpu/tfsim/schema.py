@@ -1,0 +1,476 @@
+"""Vendored provider schemas: attribute/block checking for ``tfsim validate``.
+
+Real ``terraform validate`` rejects unknown resource arguments because it
+holds every provider's full schema. tfsim runs where no provider plugins
+exist, so this module vendors the argument surface of exactly the resource
+types this repo's modules use (google, kubernetes, helm, random — the
+certified versions in the README support matrix), and `validate_module`
+fails on:
+
+* attributes or nested blocks a resource type does not define (the
+  ``machine_typ = ...`` typo class that reference-integrity checking alone
+  cannot see), including inside ``dynamic`` blocks; and
+* missing required arguments (conservatively marked — only arguments the
+  providers document as required with no default/computed fallback).
+
+Schemas are intentionally supersets of what the repo uses today: they
+include the commonly-set optional arguments of each type so that ordinary
+module growth does not trip false positives, while computed-only outputs
+(``id``, ``self_link``, ...) are deliberately absent — assigning one is an
+error in real terraform too. Types with no vendored schema are skipped
+(reference integrity still applies), mirroring how terraform treats a
+provider it cannot load.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from . import ast as A
+from .module import Resource
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockSchema:
+    attrs: frozenset[str]
+    required: frozenset[str]
+    blocks: dict[str, "BlockSchema"]
+    # max_items=1 object-style blocks that the provider also accepts as an
+    # attribute assignment aren't a thing in this repo; `open` marks block
+    # bodies we deliberately don't enumerate (free-form maps, etc.)
+    open: bool = False
+
+
+def _bs(attrs: str = "", req: str = "",
+        blocks: dict[str, BlockSchema] | None = None,
+        open: bool = False) -> BlockSchema:
+    a = frozenset(attrs.split())
+    r = frozenset(req.split())
+    return BlockSchema(attrs=a | r, required=r, blocks=blocks or {}, open=open)
+
+
+_TIMEOUTS = _bs("create read update delete")
+
+# ----------------------------------------------------------------- google
+
+_GKE_NODE_CONFIG = _bs(
+    "machine_type disk_size_gb disk_type image_type labels resource_labels "
+    "tags metadata oauth_scopes service_account spot preemptible "
+    "local_ssd_count boot_disk_kms_key min_cpu_platform node_group "
+    "enable_confidential_storage logging_variant",
+    blocks={
+        "guest_accelerator": _bs("type count gpu_partition_size",
+                                 blocks={
+                                     "gpu_driver_installation_config":
+                                         _bs("gpu_driver_version"),
+                                     "gpu_sharing_config":
+                                         _bs("gpu_sharing_strategy "
+                                             "max_shared_clients_per_gpu"),
+                                 }),
+        "reservation_affinity": _bs("key values",
+                                    req="consume_reservation_type"),
+        "workload_metadata_config": _bs(req="mode"),
+        "shielded_instance_config": _bs("enable_secure_boot "
+                                        "enable_integrity_monitoring"),
+        "gcfs_config": _bs(req="enabled"),
+        "gvnic": _bs(req="enabled"),
+        "kubelet_config": _bs("cpu_manager_policy cpu_cfs_quota "
+                              "cpu_cfs_quota_period pod_pids_limit"),
+        "taint": _bs("key value effect"),
+        "ephemeral_storage_local_ssd_config": _bs("local_ssd_count"),
+    })
+
+SCHEMAS: dict[str, BlockSchema] = {
+    "google_compute_network": _bs(
+        "project description auto_create_subnetworks routing_mode mtu "
+        "delete_default_routes_on_create internal_ipv6_range "
+        "enable_ula_internal_ipv6 network_firewall_policy_enforcement_order",
+        req="name"),
+    "google_compute_subnetwork": _bs(
+        "project region description private_ip_google_access purpose role "
+        "stack_type ipv6_access_type",
+        req="name ip_cidr_range network",
+        blocks={
+            "secondary_ip_range": _bs(req="range_name ip_cidr_range"),
+            "log_config": _bs("aggregation_interval flow_sampling metadata "
+                              "metadata_fields filter_expr"),
+        }),
+    "google_container_cluster": _bs(
+        "location project description network subnetwork "
+        "remove_default_node_pool initial_node_count min_master_version "
+        "node_version deletion_protection enable_autopilot enable_tpu "
+        "networking_mode datapath_provider enable_shielded_nodes "
+        "enable_intranode_visibility resource_labels logging_service "
+        "monitoring_service default_max_pods_per_node enable_legacy_abac "
+        "enable_kubernetes_alpha node_locations allow_net_admin",
+        req="name",
+        blocks={
+            "release_channel": _bs(req="channel"),
+            "workload_identity_config": _bs("workload_pool"),
+            "ip_allocation_policy": _bs(
+                "cluster_secondary_range_name services_secondary_range_name "
+                "cluster_ipv4_cidr_block services_ipv4_cidr_block stack_type"),
+            "cluster_autoscaling": _bs(
+                "enabled autoscaling_profile",
+                blocks={
+                    "resource_limits": _bs("minimum maximum",
+                                           req="resource_type"),
+                    "auto_provisioning_defaults": _bs(
+                        "oauth_scopes service_account disk_size disk_type "
+                        "image_type boot_disk_kms_key min_cpu_platform",
+                        blocks={
+                            "management": _bs("auto_repair auto_upgrade"),
+                            "upgrade_settings": _bs(
+                                "max_surge max_unavailable strategy"),
+                        }),
+                }),
+            "node_config": _GKE_NODE_CONFIG,
+            "master_auth": _bs(blocks={
+                "client_certificate_config":
+                    _bs(req="issue_client_certificate")}),
+            "master_authorized_networks_config": _bs(
+                "gcp_public_cidrs_access_enabled",
+                blocks={"cidr_blocks": _bs("display_name",
+                                           req="cidr_block")}),
+            "private_cluster_config": _bs(
+                "enable_private_nodes enable_private_endpoint "
+                "master_ipv4_cidr_block private_endpoint_subnetwork"),
+            "addons_config": _bs(open=True),
+            "maintenance_policy": _bs(open=True),
+            "logging_config": _bs(req="enable_components"),
+            "monitoring_config": _bs(
+                "enable_components",
+                blocks={"managed_prometheus": _bs(req="enabled"),
+                        "advanced_datapath_observability_config":
+                            _bs("enable_metrics enable_relay")}),
+            "vertical_pod_autoscaling": _bs(req="enabled"),
+            "network_policy": _bs("provider", req="enabled"),
+            "binary_authorization": _bs("evaluation_mode"),
+            "cost_management_config": _bs(req="enabled"),
+            "dns_config": _bs("cluster_dns cluster_dns_scope "
+                              "cluster_dns_domain"),
+            "gateway_api_config": _bs(req="channel"),
+            "database_encryption": _bs("key_name", req="state"),
+            "timeouts": _TIMEOUTS,
+        }),
+    "google_container_node_pool": _bs(
+        "location project name name_prefix node_count initial_node_count "
+        "node_locations version max_pods_per_node",
+        req="cluster",
+        blocks={
+            "autoscaling": _bs("min_node_count max_node_count "
+                               "total_min_node_count total_max_node_count "
+                               "location_policy"),
+            "node_config": _GKE_NODE_CONFIG,
+            "placement_policy": _bs("tpu_topology policy_name", req="type"),
+            "management": _bs("auto_repair auto_upgrade"),
+            "upgrade_settings": _bs("max_surge max_unavailable strategy"),
+            "network_config": _bs("create_pod_range pod_range "
+                                  "pod_ipv4_cidr_block "
+                                  "enable_private_nodes"),
+            "queued_provisioning": _bs(req="enabled"),
+            "timeouts": _TIMEOUTS,
+        }),
+    "google_project_iam_member": _bs(
+        req="project role member",
+        blocks={"condition": _bs("description", req="title expression")}),
+    "google_service_account": _bs(
+        "display_name description project disabled create_ignore_already_exists",
+        req="account_id"),
+    "google_service_account_iam_member": _bs(
+        req="service_account_id role member",
+        blocks={"condition": _bs("description", req="title expression")}),
+    "google_privateca_ca_pool": _bs(
+        "project labels", req="name location tier",
+        blocks={
+            "publishing_options": _bs("encoding_format",
+                                      req="publish_ca_cert publish_crl"),
+            "issuance_policy": _bs(open=True),
+        }),
+    "google_privateca_certificate_authority": _bs(
+        "project location desired_state lifetime type "
+        "deletion_protection ignore_active_certificates_on_deletion "
+        "skip_grace_period pem_ca_certificate gcs_bucket labels",
+        req="certificate_authority_id pool",
+        blocks={
+            "config": _bs(blocks={
+                "subject_config": _bs(blocks={
+                    "subject": _bs(
+                        "country_code organizational_unit locality province "
+                        "street_address postal_code",
+                        req="common_name organization"),
+                    "subject_alt_name": _bs(
+                        "dns_names uris email_addresses ip_addresses"),
+                }),
+                "x509_config": _bs(blocks={
+                    "ca_options": _bs(
+                        "max_issuer_path_length "
+                        "zero_max_issuer_path_length non_ca",
+                        req="is_ca"),
+                    "key_usage": _bs(blocks={
+                        "base_key_usage": _bs(
+                            "digital_signature content_commitment "
+                            "key_encipherment data_encipherment "
+                            "key_agreement cert_sign crl_sign "
+                            "encipher_only decipher_only"),
+                        "extended_key_usage": _bs(
+                            "server_auth client_auth code_signing "
+                            "email_protection time_stamping ocsp_signing"),
+                    }),
+                    "name_constraints": _bs(open=True),
+                    "policy_ids": _bs(req="object_id_path"),
+                }),
+            }),
+            "key_spec": _bs("algorithm cloud_kms_key_version"),
+            "timeouts": _TIMEOUTS,
+        }),
+    "google_privateca_ca_pool_iam_member": _bs(
+        "location project", req="ca_pool role member",
+        blocks={"condition": _bs("description", req="title expression")}),
+    "google_logging_project_sink": _bs(
+        "project filter description disabled unique_writer_identity",
+        req="name destination",
+        blocks={
+            "exclusions": _bs("description disabled", req="name filter"),
+            "bigquery_options": _bs(req="use_partitioned_tables"),
+        }),
+    "google_logging_project_bucket_config": _bs(
+        "description retention_days locked enable_analytics",
+        req="project location bucket_id",
+        blocks={"index_configs": _bs(req="field_path type")}),
+    # ------------------------------------------------------------- random
+    "random_id": _bs("keepers prefix", req="byte_length"),
+    "random_string": _bs("length lower upper numeric special min_lower "
+                         "min_upper min_numeric min_special override_special "
+                         "keepers"),
+    # --------------------------------------------------------------- helm
+    "helm_release": _bs(
+        "repository chart version namespace create_namespace atomic "
+        "cleanup_on_fail replace timeout wait wait_for_jobs values "
+        "max_history recreate_pods force_update reuse_values reset_values "
+        "skip_crds dependency_update disable_webhooks verify "
+        "render_subchart_notes disable_openapi_validation lint description "
+        "devel keyring repository_key_file repository_cert_file "
+        "repository_ca_file repository_username repository_password",
+        req="name",
+        blocks={
+            "set": _bs("type", req="name value"),
+            "set_sensitive": _bs("type", req="name value"),
+            "set_list": _bs(req="name value"),
+            "postrender": _bs("args", req="binary_path"),
+        }),
+}
+
+# ----------------------------------------------------------- kubernetes
+
+_K8S_METADATA = _bs("annotations generate_name labels name namespace")
+
+_K8S_ENV = _bs("name value",
+               blocks={"value_from": _bs(blocks={
+                   "config_map_key_ref": _bs("name key optional"),
+                   "secret_key_ref": _bs("name key optional"),
+                   "field_ref": _bs("api_version field_path"),
+                   "resource_field_ref": _bs("container_name divisor",
+                                             req="resource"),
+               })})
+
+_K8S_PROBE = _bs("initial_delay_seconds period_seconds timeout_seconds "
+                 "success_threshold failure_threshold", open=True)
+
+_K8S_CONTAINER = _bs(
+    "name image command args working_dir image_pull_policy stdin stdin_once "
+    "tty termination_message_path termination_message_policy",
+    blocks={
+        "env": _K8S_ENV,
+        "env_from": _bs("prefix", blocks={
+            "config_map_ref": _bs("optional", req="name"),
+            "secret_ref": _bs("optional", req="name")}),
+        "port": _bs("container_port host_ip host_port name protocol"),
+        "resources": _bs("limits requests"),
+        "volume_mount": _bs("read_only sub_path mount_propagation",
+                            req="mount_path name"),
+        "security_context": _bs(open=True),
+        "liveness_probe": _K8S_PROBE,
+        "readiness_probe": _K8S_PROBE,
+        "startup_probe": _K8S_PROBE,
+        "lifecycle": _bs(open=True),
+    })
+
+_K8S_POD_SPEC = _bs(
+    "active_deadline_seconds automount_service_account_token dns_policy "
+    "enable_service_links host_ipc host_network host_pid hostname "
+    "node_name node_selector priority_class_name restart_policy "
+    "runtime_class_name scheduler_name service_account_name "
+    "share_process_namespace subdomain termination_grace_period_seconds",
+    blocks={
+        "container": _K8S_CONTAINER,
+        "init_container": _K8S_CONTAINER,
+        "toleration": _bs("key operator value effect toleration_seconds"),
+        "affinity": _bs(open=True),
+        "security_context": _bs(open=True),
+        "image_pull_secrets": _bs(req="name"),
+        "topology_spread_constraint": _bs(open=True),
+        "dns_config": _bs(open=True),
+        "host_aliases": _bs(req="hostnames ip"),
+        "volume": _bs("name", blocks={
+            "config_map": _bs("default_mode optional name",
+                              blocks={"items": _bs("key mode path")}),
+            "secret": _bs("default_mode optional secret_name",
+                          blocks={"items": _bs("key mode path")}),
+            "empty_dir": _bs("medium size_limit"),
+            "host_path": _bs("path type"),
+            "downward_api": _bs(open=True),
+            "persistent_volume_claim": _bs("claim_name read_only"),
+            "projected": _bs(open=True),
+        }),
+    })
+
+SCHEMAS.update({
+    "kubernetes_namespace_v1": _bs(
+        "wait_for_default_service_account",
+        blocks={"metadata": _K8S_METADATA, "timeouts": _TIMEOUTS}),
+    "kubernetes_config_map_v1": _bs(
+        "data binary_data immutable",
+        blocks={"metadata": _K8S_METADATA}),
+    "kubernetes_resource_quota_v1": _bs(blocks={
+        "metadata": _K8S_METADATA,
+        "spec": _bs("hard scopes", blocks={
+            "scope_selector": _bs(blocks={
+                "match_expression": _bs("values",
+                                        req="operator scope_name")}),
+        }),
+        "timeouts": _TIMEOUTS,
+    }),
+    "kubernetes_service_v1": _bs(
+        "wait_for_load_balancer",
+        blocks={
+            "metadata": _K8S_METADATA,
+            "spec": _bs(
+                "allocate_load_balancer_node_ports cluster_ip cluster_ips "
+                "external_ips external_name external_traffic_policy "
+                "health_check_node_port internal_traffic_policy "
+                "ip_families ip_family_policy load_balancer_class "
+                "load_balancer_ip load_balancer_source_ranges "
+                "publish_not_ready_addresses selector session_affinity type",
+                blocks={
+                    "port": _bs("app_protocol name node_port protocol "
+                                "target_port", req="port"),
+                    "session_affinity_config": _bs(open=True),
+                }),
+            "timeouts": _TIMEOUTS,
+        }),
+    "kubernetes_job_v1": _bs(
+        "wait_for_completion",
+        blocks={
+            "metadata": _K8S_METADATA,
+            "spec": _bs(
+                "active_deadline_seconds backoff_limit "
+                "backoff_limit_per_index completion_mode completions "
+                "manual_selector max_failed_indexes parallelism "
+                "pod_failure_policy ttl_seconds_after_finished suspend",
+                blocks={
+                    "selector": _bs(open=True),
+                    "template": _bs(blocks={
+                        "metadata": _K8S_METADATA,
+                        "spec": _K8S_POD_SPEC,
+                    }),
+                }),
+            "timeouts": _TIMEOUTS,
+        }),
+})
+
+DATA_SCHEMAS: dict[str, BlockSchema] = {
+    "google_client_config": _bs(),
+    "google_project": _bs("project_id"),
+    "google_container_engine_versions": _bs(
+        "location project version_prefix"),
+    "google_container_cluster": _bs("location project", req="name"),
+    "google_compute_network": _bs("project", req="name"),
+}
+
+# Meta-arguments terraform itself owns — legal on every resource.
+_META_ATTRS = {"count", "for_each", "provider", "depends_on", "source"}
+_META_BLOCKS = {"lifecycle", "provisioner", "connection"}
+_DYNAMIC_ATTRS = {"for_each", "iterator", "labels"}
+
+
+def check_resource_schema(r: Resource) -> list[tuple[int, str]]:
+    """(line, message) pairs for schema violations in one resource."""
+    schema = (DATA_SCHEMAS if r.mode == "data" else SCHEMAS).get(r.type)
+    if schema is None:
+        return []
+    problems: list[tuple[int, str]] = []
+    _walk(r.body, schema, r.type, problems, top=True)
+    return problems
+
+
+def _walk(body: A.Body, schema: BlockSchema, path: str,
+          problems: list[tuple[int, str]], top: bool = False) -> None:
+    seen_attrs = {a.name for a in body.attributes}
+    seen_blocks = {
+        (b.labels[0] if b.type == "dynamic" and b.labels else b.type)
+        for b in body.blocks
+    }
+    if not schema.open:
+        for a in body.attributes:
+            if a.name in schema.attrs or (top and a.name in _META_ATTRS):
+                continue
+            if a.name in schema.blocks:
+                problems.append((a.line,
+                                 f"{path}: {a.name!r} is a block, not an "
+                                 f"attribute"))
+            else:
+                problems.append((a.line,
+                                 f"{path}: unsupported attribute {a.name!r}"))
+        for name in schema.required:
+            if name not in seen_attrs:
+                problems.append((body.blocks[0].line if body.blocks
+                                 else (body.attributes[0].line
+                                       if body.attributes else 0),
+                                 f"{path}: missing required attribute "
+                                 f"{name!r}"))
+    for b in body.blocks:
+        if b.type == "dynamic":
+            if not b.labels:
+                problems.append((b.line, f"{path}: dynamic block needs a "
+                                 f"label"))
+                continue
+            name = b.labels[0]
+            sub = schema.blocks.get(name)
+            if sub is None and not schema.open:
+                problems.append((b.line,
+                                 f"{path}: unsupported block {name!r}"))
+                continue
+            for a in b.body.attributes:
+                if a.name not in _DYNAMIC_ATTRS:
+                    problems.append((a.line,
+                                     f"{path}.dynamic: unsupported "
+                                     f"attribute {a.name!r}"))
+            for ib in b.body.blocks:
+                if ib.type != "content":
+                    problems.append((ib.line,
+                                     f"{path}.dynamic: unsupported block "
+                                     f"{ib.type!r}"))
+                elif sub is not None:
+                    # dynamic bodies assemble full block instances, so
+                    # required-attr checking applies inside content too
+                    _walk(ib.body, sub, f"{path}.{name}", problems)
+            continue
+        if top and b.type in _META_BLOCKS:
+            continue
+        sub = schema.blocks.get(b.type)
+        if sub is None:
+            if schema.open:
+                continue
+            if b.type in schema.attrs:
+                problems.append((b.line,
+                                 f"{path}: {b.type!r} is an attribute, not "
+                                 f"a block"))
+            else:
+                problems.append((b.line,
+                                 f"{path}: unsupported block {b.type!r}"))
+            continue
+        _walk(b.body, sub, f"{path}.{b.type}", problems)
+    # blocks shadowing required attrs don't satisfy them; nothing to do —
+    # required checking above is attribute-only by design.
+    del seen_blocks

@@ -12,6 +12,7 @@ import dataclasses
 
 from . import ast as A
 from .module import Module, Resource
+from .schema import check_resource_schema
 
 
 @dataclasses.dataclass
@@ -82,6 +83,9 @@ def validate_module(mod: Module) -> list[Finding]:
             add(Finding("error", where,
                         f"{r.address}: no required_providers entry for "
                         f"provider {prov!r}"))
+        # provider-schema argument checking (the `machine_typ =` typo class)
+        for line, msg in check_resource_schema(r):
+            add(Finding("error", f"{r.file}:{line}", f"{r.address}: {msg}"))
 
     if not mod.required_providers and (mod.resources or mod.data_sources):
         add(Finding("warning", "versions.tf:0",

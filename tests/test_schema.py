@@ -1,0 +1,193 @@
+"""Provider-schema argument checking in tfsim validate (the offline analogue
+of terraform's provider-schema layer; closes the `machine_typ = ...` typo
+class the round-1 validate could not see — VERDICT.md item 6).
+"""
+
+import os
+
+import pytest
+
+from nvidia_terraform_modules_tpu.tfsim import load_module, validate_module
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+VERSIONS = """
+terraform {
+  required_version = ">= 1.5.0"
+  required_providers {
+    google = { source = "hashicorp/google", version = "~> 6.8" }
+    kubernetes = { source = "hashicorp/kubernetes", version = "~> 2.32" }
+    helm = { source = "hashicorp/helm", version = "~> 2.15" }
+  }
+}
+"""
+
+
+def _validate(tmp_path, main_tf: str):
+    (tmp_path / "main.tf").write_text(VERSIONS + main_tf)
+    return validate_module(load_module(str(tmp_path)))
+
+
+def _errors(findings):
+    return [str(f) for f in findings if f.severity == "error"]
+
+
+def test_attribute_typo_fails_validate(tmp_path):
+    errs = _errors(_validate(tmp_path, """
+resource "google_container_node_pool" "p" {
+  cluster     = "c"
+  node_count  = 1
+  node_config {
+    machine_typ = "ct5lp-hightpu-4t"
+  }
+}
+"""))
+    assert any("unsupported attribute 'machine_typ'" in e for e in errs), errs
+
+
+def test_unknown_block_fails_validate(tmp_path):
+    errs = _errors(_validate(tmp_path, """
+resource "google_container_node_pool" "p" {
+  cluster    = "c"
+  node_count = 1
+  node_confg {
+    machine_type = "ct5lp-hightpu-4t"
+  }
+}
+"""))
+    assert any("unsupported block 'node_confg'" in e for e in errs), errs
+
+
+def test_block_used_as_attribute_diagnosed(tmp_path):
+    errs = _errors(_validate(tmp_path, """
+resource "google_container_cluster" "c" {
+  name            = "x"
+  release_channel = "RAPID"
+}
+"""))
+    assert any("'release_channel' is a block, not an attribute" in e
+               for e in errs), errs
+
+
+def test_attribute_used_as_block_diagnosed(tmp_path):
+    errs = _errors(_validate(tmp_path, """
+resource "google_container_cluster" "c" {
+  name = "x"
+  deletion_protection {
+    enabled = true
+  }
+}
+"""))
+    assert any("'deletion_protection' is an attribute, not a block" in e
+               for e in errs), errs
+
+
+def test_missing_required_attribute(tmp_path):
+    errs = _errors(_validate(tmp_path, """
+resource "google_container_node_pool" "p" {
+  name       = "pool"
+  node_count = 1
+}
+"""))
+    assert any("missing required attribute 'cluster'" in e for e in errs), errs
+
+
+def test_typo_inside_dynamic_block_content(tmp_path):
+    errs = _errors(_validate(tmp_path, """
+resource "kubernetes_job_v1" "j" {
+  metadata {
+    name = "j"
+  }
+  spec {
+    template {
+      metadata {}
+      spec {
+        container {
+          name  = "c"
+          image = "i"
+          dynamic "env" {
+            for_each = { A = "1" }
+            content {
+              name  = env.key
+              valeu = env.value
+            }
+          }
+        }
+      }
+    }
+  }
+}
+"""))
+    assert any("unsupported attribute 'valeu'" in e for e in errs), errs
+
+
+def test_deep_nested_typo(tmp_path):
+    errs = _errors(_validate(tmp_path, """
+resource "kubernetes_job_v1" "j" {
+  metadata {
+    name = "j"
+  }
+  spec {
+    template {
+      metadata {}
+      spec {
+        container {
+          name  = "c"
+          image = "i"
+          volume_mount {
+            name       = "v"
+            mount_pth  = "/opt"
+          }
+        }
+      }
+    }
+  }
+}
+"""))
+    assert any("unsupported attribute 'mount_pth'" in e for e in errs), errs
+    assert any("missing required attribute 'mount_path'" in e
+               for e in errs), errs
+
+
+def test_meta_arguments_always_allowed(tmp_path):
+    findings = _validate(tmp_path, """
+resource "google_service_account" "sa" {
+  count      = 1
+  account_id = "x"
+  depends_on = [google_service_account.other]
+
+  lifecycle {
+    prevent_destroy = true
+  }
+}
+
+resource "google_service_account" "other" {
+  account_id = "y"
+}
+""")
+    assert _errors(findings) == []
+
+
+def test_unknown_resource_type_skips_schema(tmp_path):
+    """No vendored schema → reference integrity still applies, schema
+    silently skipped (terraform-without-that-provider behavior)."""
+    (tmp_path / "main.tf").write_text("""
+terraform {
+  required_version = ">= 1.5.0"
+  required_providers {
+    google = { source = "hashicorp/google", version = "~> 6.8" }
+  }
+}
+resource "google_storage_bucket" "b" {
+  name          = "x"
+  made_up_field = true
+}
+""")
+    assert _errors(validate_module(load_module(str(tmp_path)))) == []
+
+
+@pytest.mark.parametrize("moddir", [
+    "gke", "gke-tpu", "gke/examples/cnpack", "gke-tpu/examples/cnpack"])
+def test_repo_modules_pass_schema_check(moddir):
+    findings = validate_module(load_module(os.path.join(ROOT, moddir)))
+    assert [str(f) for f in findings] == []
