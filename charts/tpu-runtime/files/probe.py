@@ -1,0 +1,203 @@
+"""TPU node health probe — the resident half of the runtime layer.
+
+Replaces the GPU Operator's node-status role (DCGM + device-plugin health,
+/root/reference/gke/main.tf:195-213) the TPU-native way: libtpu and the TPU
+device plugin ship with the GKE node image, so the probe's job is not to
+install anything but to *watch* the device surface and export what it sees
+where the rest of the cluster can act on it:
+
+* a ``TPUHealthy`` node condition, patched onto this pod's node via the
+  Kubernetes API (strategic-merge on /status — conditions merge by type),
+  which autoscalers, descheduler policies, and alerting rules can consume;
+* Prometheus gauges on an HTTP endpoint (``/metrics``) for scraping by GKE
+  Managed Prometheus (PodMonitoring template in this chart) or any agent;
+* one JSON line per cycle on stdout for `kubectl logs` debugging.
+
+Deliberately does NOT claim google.com/tpu resources or import jax:
+claiming chips would steal them from workloads, and touching them through
+libtpu would conflict with the exclusive runtime lock. The deep end-to-end
+check (psum over claimed chips) is the smoke-test Job's role.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import ssl
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+def env(name: str, default: str) -> str:
+    return os.environ.get(name) or default
+
+
+def probe_devices(dev_dir: str = "/host-dev",
+                  tmp_dir: str = "/host-tmp",
+                  min_chips: int = 1) -> dict:
+    """One health observation from the node's device surface."""
+    chips = sorted(
+        glob.glob(os.path.join(dev_dir, "accel*")) +
+        glob.glob(os.path.join(dev_dir, "vfio", "[0-9]*")))
+    return {
+        "probe": "tpu-health",
+        "ok": len(chips) >= min_chips,
+        "device_files": len(chips),
+        "in_use": os.path.exists(os.path.join(tmp_dir, "libtpu_lockfile")),
+        "node": os.environ.get("NODE_NAME"),
+    }
+
+
+def condition_body(result: dict, condition_type: str,
+                   now: str | None = None,
+                   transition_time: str | None = None) -> dict:
+    """Strategic-merge /status patch body; conditions merge by `type`.
+
+    ``lastTransitionTime`` must only advance when the status flips (kubelet
+    / node-problem-detector semantics — consumers key dwell time off it);
+    callers pass the remembered flip time via ``transition_time``, and only
+    a genuinely new observation (or the first one after probe start) omits
+    it."""
+    now = now or time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    healthy = bool(result["ok"])
+    return {
+        "status": {
+            "conditions": [{
+                "type": condition_type,
+                "status": "True" if healthy else "False",
+                "reason": "TPUDevicesPresent" if healthy else "TPUDevicesMissing",
+                "message": (f"{result['device_files']} TPU device file(s); "
+                            f"in_use={result['in_use']}"),
+                "lastHeartbeatTime": now,
+                "lastTransitionTime": transition_time or now,
+            }]
+        }
+    }
+
+
+def patch_node_condition(result: dict,
+                         node: str,
+                         condition_type: str = "TPUHealthy",
+                         api_base: str | None = None,
+                         token_path: str = f"{SA_DIR}/token",
+                         ca_path: str = f"{SA_DIR}/ca.crt",
+                         transition_time: str | None = None) -> int:
+    """PATCH the node's status condition. Returns the HTTP status code;
+    raises nothing (health export must never crash the probe loop)."""
+    api_base = api_base or "https://" + env(
+        "KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+    url = f"{api_base}/api/v1/nodes/{node}/status"
+    body = json.dumps(condition_body(
+        result, condition_type, transition_time=transition_time)).encode()
+    req = urllib.request.Request(url, data=body, method="PATCH", headers={
+        "Content-Type": "application/strategic-merge-patch+json",
+        "Accept": "application/json",
+    })
+    try:
+        with open(token_path) as fh:
+            req.add_header("Authorization", f"Bearer {fh.read().strip()}")
+    except OSError:
+        pass  # outside a pod (tests hit plain http)
+    ctx = None
+    if url.startswith("https"):
+        ctx = ssl.create_default_context(
+            cafile=ca_path if os.path.exists(ca_path) else None)
+    try:
+        with urllib.request.urlopen(req, context=ctx, timeout=10) as resp:
+            return resp.status
+    except urllib.error.HTTPError as ex:
+        print(json.dumps({"probe": "tpu-health", "patch_error": ex.code,
+                          "node": node}), flush=True)
+        return ex.code
+    except (urllib.error.URLError, OSError) as ex:
+        print(json.dumps({"probe": "tpu-health",
+                          "patch_error": str(ex), "node": node}), flush=True)
+        return 0
+
+
+def render_metrics(result: dict) -> str:
+    """Prometheus text exposition of the latest observation."""
+    lines = []
+    for name, help_, value in [
+        ("tpu_healthprobe_ok",
+         "1 if the node exposes at least min_chips TPU device files",
+         int(bool(result["ok"]))),
+        ("tpu_healthprobe_device_files",
+         "Number of TPU device files visible on the node",
+         result["device_files"]),
+        ("tpu_healthprobe_in_use",
+         "1 if a libtpu lockfile indicates the chips are claimed",
+         int(bool(result["in_use"]))),
+    ]:
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {value}")
+    return "\n".join(lines) + "\n"
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    latest: dict = {"ok": False, "device_files": 0, "in_use": False}
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        if self.path not in ("/metrics", "/healthz"):
+            self.send_error(404)
+            return
+        body = render_metrics(type(self).latest).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # quiet: stdout is the JSON channel
+        pass
+
+
+def serve_metrics(port: int) -> ThreadingHTTPServer:
+    server = ThreadingHTTPServer(("", port), _MetricsHandler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
+
+
+def main() -> None:
+    interval = int(env("PROBE_INTERVAL_SECONDS", "300"))
+    min_chips = int(env("PROBE_MIN_CHIPS", "1"))
+    condition = env("PROBE_CONDITION_TYPE", "TPUHealthy")
+    patch_enabled = env("PROBE_PATCH_NODE_CONDITION", "true") == "true"
+    metrics_port = int(env("PROBE_METRICS_PORT", "9100"))
+    node = os.environ.get("NODE_NAME", "")
+    if metrics_port:
+        serve_metrics(metrics_port)
+    dev_dir = env("PROBE_DEV_DIR", "/host-dev")
+    tmp_dir = env("PROBE_TMP_DIR", "/host-tmp")
+    # in-memory flip tracking: a pod restart resets it, which at worst
+    # re-stamps lastTransitionTime once — the steady-state heartbeat never
+    # advances it unless the status actually changes
+    last_status: bool | None = None
+    transition_time: str | None = None
+    while True:
+        result = probe_devices(dev_dir=dev_dir, tmp_dir=tmp_dir,
+                               min_chips=min_chips)
+        _MetricsHandler.latest = result
+        if last_status != bool(result["ok"]):
+            last_status = bool(result["ok"])
+            transition_time = time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        if patch_enabled and node:
+            result["condition_patched"] = patch_node_condition(
+                result, node, condition,
+                transition_time=transition_time) in (200, 201)
+        print(json.dumps(result), flush=True)
+        if env("PROBE_ONCE", "") == "true":
+            return
+        time.sleep(interval)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,6 +1,10 @@
-{{- define "tpu-runtime.labels" -}}
-app.kubernetes.io/name: tpu-runtime
+{{- define "tpu-runtime.sharedLabels" -}}
 app.kubernetes.io/instance: {{ .Release.Name }}
 app.kubernetes.io/managed-by: {{ .Release.Service }}
 app.kubernetes.io/part-of: tpu-terraform-modules
+{{- end }}
+
+{{- define "tpu-runtime.labels" -}}
+app.kubernetes.io/name: tpu-runtime
+{{ include "tpu-runtime.sharedLabels" . }}
 {{- end }}

@@ -65,4 +65,10 @@ module "tpu_cluster" {
     enabled = true
     level   = "probes"
   }
+
+  # scrape the health-probe gauges with GKE Managed Prometheus — the
+  # monitoring identity in gcp-prometheus.tf writes them upstream
+  tpu_runtime = {
+    pod_monitoring = true
+  }
 }

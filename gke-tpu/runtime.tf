@@ -56,6 +56,11 @@ resource "helm_release" "tpu_runtime" {
       tpu = {
         nodeSelectors = join(",", distinct([for s in local.tpu_slice : s.node_selector]))
       }
+      probe = {
+        metrics = {
+          podMonitoring = var.tpu_runtime.pod_monitoring
+        }
+      }
     })
   ]
 

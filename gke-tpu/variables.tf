@@ -195,6 +195,10 @@ variable "tpu_runtime" {
     namespace = optional(string, "tpu-runtime")
     image     = optional(string, "python:3.12-slim")
     jax_image = optional(string, "us-docker.pkg.dev/cloud-tpu-images/jax-stable-stack/tpu:jax0.4.37-rev1")
+    # emit a GKE Managed Prometheus PodMonitoring for the health-probe
+    # gauges (tpu_healthprobe_*); needs the monitoring.googleapis.com CRDs,
+    # which managed collection installs — the cnpack example turns this on
+    pod_monitoring = optional(bool, false)
   })
   default = {}
 }

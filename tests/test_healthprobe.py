@@ -1,0 +1,219 @@
+"""The health probe is real (VERDICT item 4): device observation, node
+condition export via the Kubernetes API, and Prometheus gauges — exercised
+directly from the chart's files/probe.py, plus render-level assertions that
+the DaemonSet actually wires the script, identity, and scrape surface.
+
+Reference capability replaced: the GPU Operator's DCGM / node-status role
+(/root/reference/gke/main.tf:195-213).
+"""
+
+import http.server
+import importlib.util
+import json
+import os
+import threading
+import urllib.request
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHART = os.path.join(ROOT, "charts", "tpu-runtime")
+
+
+def _load_probe():
+    spec = importlib.util.spec_from_file_location(
+        "tpu_probe", os.path.join(CHART, "files", "probe.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+probe = _load_probe()
+
+
+# ------------------------------------------------------------ observation
+
+def test_probe_devices_counts_accel_and_vfio(tmp_path):
+    dev = tmp_path / "dev"
+    (dev / "vfio").mkdir(parents=True)
+    (dev / "accel0").touch()
+    (dev / "accel1").touch()
+    (dev / "vfio" / "0").touch()
+    (dev / "vfio" / "vfio").touch()   # control node: not a chip
+    (tmp_path / "tmp").mkdir()
+    r = probe.probe_devices(str(dev), str(tmp_path / "tmp"), min_chips=3)
+    assert r["device_files"] == 3
+    assert r["ok"] is True
+    assert r["in_use"] is False
+
+
+def test_probe_devices_unhealthy_and_in_use(tmp_path):
+    (tmp_path / "dev").mkdir()
+    (tmp_path / "tmp").mkdir()
+    (tmp_path / "tmp" / "libtpu_lockfile").touch()
+    r = probe.probe_devices(str(tmp_path / "dev"), str(tmp_path / "tmp"))
+    assert r["ok"] is False
+    assert r["in_use"] is True
+
+
+# -------------------------------------------------------- node condition
+
+def test_condition_body_merges_by_type():
+    body = probe.condition_body(
+        {"ok": True, "device_files": 4, "in_use": False},
+        "TPUHealthy", now="2026-07-29T00:00:00Z")
+    (cond,) = body["status"]["conditions"]
+    assert cond["type"] == "TPUHealthy"
+    assert cond["status"] == "True"
+    assert cond["reason"] == "TPUDevicesPresent"
+    assert "4 TPU device file(s)" in cond["message"]
+    assert cond["lastHeartbeatTime"] == "2026-07-29T00:00:00Z"
+
+
+def test_condition_body_preserves_transition_time_on_heartbeat():
+    """lastTransitionTime only advances on a status flip (kubelet/NPD
+    semantics) — heartbeats carry the remembered flip time."""
+    body = probe.condition_body(
+        {"ok": True, "device_files": 4, "in_use": False},
+        "TPUHealthy", now="2026-07-29T00:05:00Z",
+        transition_time="2026-07-29T00:00:00Z")
+    (cond,) = body["status"]["conditions"]
+    assert cond["lastHeartbeatTime"] == "2026-07-29T00:05:00Z"
+    assert cond["lastTransitionTime"] == "2026-07-29T00:00:00Z"
+
+
+def test_patch_node_condition_hits_status_subresource(tmp_path):
+    seen = {}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_PATCH(self):
+            seen["path"] = self.path
+            seen["content_type"] = self.headers["Content-Type"]
+            seen["auth"] = self.headers.get("Authorization")
+            length = int(self.headers["Content-Length"])
+            seen["body"] = json.loads(self.rfile.read(length))
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def log_message(self, *a):
+            pass
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    token = tmp_path / "token"
+    token.write_text("sekret\n")
+    try:
+        code = probe.patch_node_condition(
+            {"ok": False, "device_files": 0, "in_use": False},
+            node="gke-tpu-node-7",
+            condition_type="TPUHealthy",
+            api_base=f"http://127.0.0.1:{server.server_address[1]}",
+            token_path=str(token))
+    finally:
+        server.shutdown()
+    assert code == 200
+    assert seen["path"] == "/api/v1/nodes/gke-tpu-node-7/status"
+    assert seen["content_type"] == "application/strategic-merge-patch+json"
+    assert seen["auth"] == "Bearer sekret"
+    (cond,) = seen["body"]["status"]["conditions"]
+    assert (cond["type"], cond["status"]) == ("TPUHealthy", "False")
+    assert cond["reason"] == "TPUDevicesMissing"
+
+
+def test_patch_failure_never_raises():
+    code = probe.patch_node_condition(
+        {"ok": True, "device_files": 1, "in_use": False},
+        node="n", api_base="http://127.0.0.1:1",   # nothing listens
+        token_path="/nonexistent")
+    assert code == 0
+
+
+# -------------------------------------------------------------- metrics
+
+def test_metrics_endpoint_serves_gauges():
+    server = probe.serve_metrics(0)
+    probe._MetricsHandler.latest = {
+        "ok": True, "device_files": 4, "in_use": True}
+    try:
+        port = server.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5) as resp:
+            text = resp.read().decode()
+            assert resp.headers["Content-Type"].startswith("text/plain")
+    finally:
+        server.shutdown()
+    assert "tpu_healthprobe_ok 1" in text
+    assert "tpu_healthprobe_device_files 4" in text
+    assert "tpu_healthprobe_in_use 1" in text
+    assert "# TYPE tpu_healthprobe_ok gauge" in text
+
+
+# ------------------------------------------------------- chart wiring
+
+def _tmpl(name: str) -> str:
+    with open(os.path.join(CHART, "templates", name)) as fh:
+        return fh.read()
+
+
+def test_daemonset_runs_shipped_script_with_identity():
+    ds = _tmpl("healthprobe-daemonset.yaml")
+    assert 'command: ["python", "/opt/probe/probe.py"]' in ds
+    assert "serviceAccountName: {{ .Release.Name }}-healthprobe" in ds
+    assert "-healthprobe-script" in ds           # configmap volume
+    assert "PROBE_PATCH_NODE_CONDITION" in ds
+    assert "containerPort: {{ .Values.probe.metrics.port }}" in ds
+
+
+def test_rbac_grants_only_node_status_patch():
+    rbac = _tmpl("healthprobe-rbac.yaml")
+    assert '"nodes/status"' in rbac
+    assert '"patch"' in rbac
+    # nothing broader: no wildcard verbs/resources, no reads, no writes
+    for forbidden in ('"*"', "secrets", '"get"', '"list"', '"update"',
+                      '"create"', '"delete"'):
+        assert forbidden not in rbac, forbidden
+
+
+def test_daemonset_pod_labels_do_not_collide_with_selector():
+    """The shared-labels helper must not re-emit app.kubernetes.io/name in
+    the pod template — last-key-wins would break the selector match."""
+    ds = _tmpl("healthprobe-daemonset.yaml")
+    pod_tmpl = ds[ds.index("template:"):]
+    assert "tpu-runtime.sharedLabels" in pod_tmpl
+    assert 'app.kubernetes.io/name: tpu-runtime-healthprobe' in pod_tmpl
+    assert "tpu-runtime.labels" not in pod_tmpl
+    helpers = _tmpl("_helpers.tpl")
+    shared = helpers.split('define "tpu-runtime.sharedLabels"')[1].split(
+        "{{- end }}")[0]
+    assert "app.kubernetes.io/name" not in shared
+
+
+def test_daemonset_rolls_on_script_change():
+    ds = _tmpl("healthprobe-daemonset.yaml")
+    assert 'checksum/probe-script: {{ .Files.Get "files/probe.py" | sha256sum }}' in ds
+
+
+def test_script_configmap_ships_the_probe_file():
+    cm = _tmpl("healthprobe-script.yaml")
+    assert '.Files.Get "files/probe.py"' in cm
+
+
+def test_podmonitoring_gated_and_scrapes_metrics_port():
+    pm = _tmpl("healthprobe-podmonitoring.yaml")
+    assert "PodMonitoring" in pm
+    assert ".Values.probe.metrics.podMonitoring" in pm
+    assert "port: metrics" in pm
+
+
+def test_module_passes_podmonitoring_value_through():
+    from nvidia_terraform_modules_tpu.tfsim import simulate_plan
+    plan = simulate_plan(os.path.join(ROOT, "gke-tpu"), {
+        "project_id": "p", "cluster_name": "c",
+        "tpu_runtime": {"pod_monitoring": True},
+        "smoketest": {"enabled": False},
+    })
+    rel = plan.instance("helm_release.tpu_runtime[0]")
+    vals = json.loads(rel.attrs["values"][0])
+    assert vals["probe"]["metrics"]["podMonitoring"] is True
