@@ -105,38 +105,63 @@ def main() -> int:
     # (real multi-slice); contiguous grouping otherwise (process-major
     # enumeration puts each slice's hosts together).
     slices = int(os.environ.get("TPU_SMOKETEST_SLICES", "1"))
-    if slices > 1 and ok and n % slices == 0:
-        if all(getattr(d, "slice_index", None) is not None for d in devices):
-            devs = sorted(devices, key=lambda d: (d.slice_index, d.id))
-        else:
-            devs = list(devices)
-        per = n // slices
-        mesh2 = Mesh(
-            np.asarray(devs).reshape(slices, per), ("slice", "x"))
-
-        @jax.jit
-        @functools.partial(
-            jax.shard_map, mesh=mesh2, in_specs=(), out_specs=P("slice", "x"))
-        def dcn_psum():
-            return jax.lax.psum(jnp.ones((1, 256), jnp.float32), "slice")
-
-        shards = dcn_psum().addressable_shards
-        out["dcn_psum_ok"] = bool(all(
-            np.allclose(np.asarray(s.data), float(slices)) for s in shards))
+    if slices > 1:
         out["slices"] = slices
-        ok = ok and out["dcn_psum_ok"]
+        if n % slices != 0:
+            # a bad slice config must FAIL the contract, not silently skip
+            # the one check that proves DCN (matches the package runner's
+            # plan_multislice ValueError policy)
+            out["slices_error"] = (
+                f"{n} devices do not divide into {slices} slices")
+            out["dcn_psum_ok"] = False
+            ok = False
+        elif ok:
+            if all(getattr(d, "slice_index", None) is not None
+                   for d in devices):
+                devs = sorted(devices, key=lambda d: (d.slice_index, d.id))
+            else:
+                devs = list(devices)
+            per = n // slices
+            mesh2 = Mesh(
+                np.asarray(devs).reshape(slices, per), ("slice", "x"))
 
-    # 2. collective probes over the same ring
+            @jax.jit
+            @functools.partial(
+                jax.shard_map, mesh=mesh2, in_specs=(),
+                out_specs=P("slice", "x"))
+            def dcn_psum():
+                return jax.lax.psum(jnp.ones((1, 256), jnp.float32), "slice")
+
+            shards = dcn_psum().addressable_shards
+            out["dcn_psum_ok"] = bool(all(
+                np.allclose(np.asarray(s.data), float(slices))
+                for s in shards))
+            ok = ok and out["dcn_psum_ok"]
+
+    # 2. collective probes over the same ring — correctness plus a measured
+    # bandwidth figure per host in the Job log (operators grep the JSON the
+    # way the reference's runbooks grep `kubectl get po`)
+    def timed(fn, nbytes):
+        # warm-up must SYNCHRONIZE (dispatch is async — an un-awaited warm
+        # call would still be executing inside the timed region) so the
+        # figure is transport, not compile or queueing
+        jax.block_until_ready(fn())
+        t = time.perf_counter()
+        r = jax.block_until_ready(fn())
+        dt = max(time.perf_counter() - t, 1e-9)
+        return r, round(nbytes / dt / (1 << 30), 3)
+
     if level in ("probes", "burnin") and ok and n > 1:
         @jax.jit
         @shard
         def ring_hop():
             i = jax.lax.axis_index("x").astype(jnp.float32)
-            payload = jnp.full((256,), 0.0, jnp.float32) + i
+            payload = jnp.full((1 << 16,), 0.0, jnp.float32) + i
             return jax.lax.ppermute(
                 payload, "x", [(j, (j + 1) % n) for j in range(n)])
 
-        hop = local_values(ring_hop()).reshape(-1, 256)
+        hop_arr, out["ring_gibps"] = timed(ring_hop, n * (1 << 16) * 4)
+        hop = local_values(hop_arr).reshape(-1, 1 << 16)
         # this process's shards hold positions [idx*k, (idx+1)*k) of the ring
         k = hop.shape[0]
         mine = (np.arange(idx * k, (idx + 1) * k, dtype=np.float32) - 1) % n
@@ -146,12 +171,14 @@ def main() -> int:
         @shard
         def gather():
             i = jax.lax.axis_index("x").astype(jnp.float32)
-            g = jax.lax.all_gather(jnp.full((64,), i, jnp.float32), "x")
+            g = jax.lax.all_gather(jnp.full((1 << 14,), i, jnp.float32), "x")
             # every position sees every contribution; re-shard the sum so
             # out_specs stays P("x")
             return jnp.sum(g, axis=0)
 
-        g = local_values(gather())
+        g_arr, out["all_gather_gibps"] = timed(
+            gather, n * (n - 1) * (1 << 14) * 4)
+        g = local_values(g_arr)
         expect = sum(range(n))  # 0+1+...+(n-1) at every element
         out["all_gather_ok"] = bool(np.allclose(g, float(expect)))
         ok = ok and out["ring_ok"] and out["all_gather_ok"]

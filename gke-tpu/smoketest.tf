@@ -53,6 +53,14 @@ locals {
   }
   smoke_ns   = local.smoketest_enabled ? kubernetes_namespace_v1.tpu_runtime[0].metadata[0].name : var.tpu_runtime.namespace
   smoke_name = "${var.cluster_name}-tpu-smoketest"
+  # Two distinct rendezvous planes, two ports: jax.distributed's coordinator
+  # (gRPC, default 8476 — the payload appends it when the env value has no
+  # port) and libtpu's MEGASCALE DCN transport bootstrap (8080, libtpu's
+  # default). Both are declared on the headless Service and the container
+  # for documentation/policy tooling; headless DNS resolves the pod A-record
+  # either way, so the declarations are about intent, not reachability.
+  smoke_coordinator_port = 8476
+  smoke_megascale_port   = 8080
   # jax.distributed coordinator: slice 0, pod 0 (indexed-Job hostname
   # "<job-name>-<index>" under the headless service's subdomain)
   smoke_coordinator = (
@@ -94,7 +102,11 @@ resource "kubernetes_service_v1" "smoketest_coordinator" {
     }
     port {
       name = "coordinator"
-      port = 8476
+      port = local.smoke_coordinator_port
+    }
+    port {
+      name = "megascale"
+      port = local.smoke_megascale_port
     }
   }
 
@@ -176,12 +188,21 @@ resource "kubernetes_job_v1" "tpu_smoketest" {
             for_each = length(local.smoke_slice_order) > 1 ? {
               MEGASCALE_NUM_SLICES          = tostring(length(local.smoke_slice_order))
               MEGASCALE_SLICE_ID            = tostring(local.smoke_slice_id[each.key])
-              MEGASCALE_COORDINATOR_ADDRESS = "${local.smoke_coordinator}:8080"
+              MEGASCALE_COORDINATOR_ADDRESS = "${local.smoke_coordinator}:${local.smoke_megascale_port}"
             } : {}
             content {
               name  = env.key
               value = env.value
             }
+          }
+
+          port {
+            name           = "coordinator"
+            container_port = local.smoke_coordinator_port
+          }
+          port {
+            name           = "megascale"
+            container_port = local.smoke_megascale_port
           }
 
           resources {
@@ -212,7 +233,11 @@ resource "kubernetes_job_v1" "tpu_smoketest" {
   wait_for_completion = true
 
   timeouts {
-    create = "${var.smoketest.timeout_seconds}s"
+    # scale the gate with WORLD size, not this slice's size: every pod in
+    # every slice must schedule + pull the JAX image before
+    # jax.distributed.initialize can return anywhere, so a small slice's
+    # Job waits on the largest slice's rollout too
+    create = "${var.smoketest.timeout_seconds + var.smoketest.timeout_per_host_seconds * local.smoke_total_hosts}s"
   }
 
   depends_on = [

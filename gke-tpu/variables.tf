@@ -217,11 +217,14 @@ variable "smoketest" {
     Levels: psum | probes | burnin.
   EOT
   type = object({
-    enabled         = optional(bool, true)
-    target_slice    = optional(string, "default")
-    multislice      = optional(bool, false)
-    level           = optional(string, "probes")
-    timeout_seconds = optional(number, 1200)
+    enabled      = optional(bool, true)
+    target_slice = optional(string, "default")
+    multislice   = optional(bool, false)
+    level        = optional(string, "probes")
+    # apply-gate budget: timeout_seconds base + per_host × slice hosts
+    # (every extra host is another pod that must schedule and pull images)
+    timeout_seconds          = optional(number, 1200)
+    timeout_per_host_seconds = optional(number, 60)
   })
   default = {}
 }

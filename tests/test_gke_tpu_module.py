@@ -110,9 +110,17 @@ def test_smoketest_job_wiring(tpu_mod):
         "tpu-demo-tpu-smoketest-default-0.")
     assert container["resources"][0]["requests"]["google.com/tpu"] == 4
     assert job.attrs["wait_for_completion"] is True
-    # headless coordinator service
+    # both rendezvous planes declared on the container: jax.distributed
+    # coordinator (8476) and libtpu MEGASCALE bootstrap (8080)
+    ports = {p["name"]: p["container_port"] for p in container["port"]}
+    assert ports == {"coordinator": 8476, "megascale": 8080}
+    # apply-gate timeout scales with slice hosts (2 hosts here)
+    assert job.attrs["timeouts"][0]["create"] == "1320s"
+    # headless coordinator service declares the same two ports
     svc = plan.instance("kubernetes_service_v1.smoketest_coordinator[0]")
     assert svc.attrs["spec"][0]["cluster_ip"] == "None"
+    svc_ports = {p["name"]: p["port"] for p in svc.attrs["spec"][0]["port"]}
+    assert svc_ports == {"coordinator": 8476, "megascale": 8080}
 
 
 def test_smoketest_script_shipped_via_configmap(tpu_mod):
@@ -176,6 +184,11 @@ def test_multislice_smoketest_wiring(tpu_mod):
     assert env_b["MEGASCALE_SLICE_ID"] == "1"
     assert env_a["MEGASCALE_COORDINATOR_ADDRESS"] == \
         env_b["MEGASCALE_COORDINATOR_ADDRESS"]
+    assert env_a["MEGASCALE_COORDINATOR_ADDRESS"].endswith(":8080")
+    # apply-gate budget scales with the WORLD (6 hosts): every slice's Job
+    # blocks on the whole world forming, so both get the same budget
+    assert job_a.attrs["timeouts"][0]["create"] == "1560s"
+    assert job_b.attrs["timeouts"][0]["create"] == "1560s"
     # per-slice completions, one pod per host
     assert job_a.attrs["spec"][0]["completions"] == 2
     assert job_b.attrs["spec"][0]["completions"] == 4
