@@ -45,6 +45,8 @@ class BurnInConfig:
     # "ring":  keep the sequence sharded on sp; K/V blocks rotate over the ICI
     #          ring (ops.ring_attention) — exact, O(S/sp) resident memory, the
     #          long-context path the slice's placement policy exists for.
+    #          Per-block tile math runs the pallas flash kernel (ring × flash
+    #          composition), so each visiting block gets fused VMEM tiles too.
     # "flash": fused pallas kernel (ops.flash_attention) on the gathered
     #          sequence — the [S,S] score matrix never touches HBM.
     attn: str = "dense"

@@ -76,6 +76,25 @@ def _causal_live(qi, ki, *, causal, block_q, block_k):
 
 # ---------------------------------------------------------------- forward
 
+def _online_softmax_step(q_ref, k_ref, v_ref, qi, ki, m_scr, l_scr, acc_scr,
+                         *, scale, causal, block_q, block_k):
+    """ONE (q-block × k-block) fold of the flash recurrence, updating the
+    VMEM scratch state in place. The single definition of the numerically
+    sensitive update — shared by the normalising forward and the partial
+    (ring) forward so their numerics can never drift."""
+    s = _tile_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+                     block_q=block_q, block_k=block_k)
+    m_prev, l_prev = m_scr[:], l_scr[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = _masked_exp(s, m_new)
+    corr = jnp.exp(m_prev - m_new)
+    l_scr[:] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                  # [bq, d]
+    m_scr[:] = m_new
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale: float, causal: bool,
                 block_q: int, block_k: int):
@@ -91,19 +110,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     @pl.when(_causal_live(qi, ki, causal=causal, block_q=block_q,
                           block_k=block_k))
     def _compute():
-        s = _tile_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
-                         block_q=block_q, block_k=block_k)
-        m_prev, l_prev = m_scr[:], l_scr[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = _masked_exp(s, m_new)
-        corr = jnp.exp(m_prev - m_new)
-        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)              # [bq, d]
-        acc_scr[:] = acc_scr[:] * corr + pv
-        m_scr[:] = m_new
-        l_scr[:] = l_new
+        _online_softmax_step(q_ref, k_ref, v_ref, qi, ki,
+                             m_scr, l_scr, acc_scr, scale=scale,
+                             causal=causal, block_q=block_q, block_k=block_k)
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -144,6 +153,81 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
         interpret=interpret,
     )(q, k, v)
     return o, lse
+
+
+# -------------------------------------------------- partial forward (ring)
+
+def _fwd_partial_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                        m_scr, l_scr, acc_scr, *, scale: float, causal: bool,
+                        block_q: int, block_k: int):
+    """Forward WITHOUT the final normalisation: emits the raw online-softmax
+    state (unnormalised accumulator, running max, running sum) so an outer
+    fold — ring attention's per-shard combine — can merge blocks exactly."""
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(_causal_live(qi, ki, causal=causal, block_q=block_q,
+                          block_k=block_k))
+    def _compute():
+        _online_softmax_step(q_ref, k_ref, v_ref, qi, ki,
+                             m_scr, l_scr, acc_scr, scale=scale,
+                             causal=causal, block_q=block_q, block_k=block_k)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        o_ref[0] = acc_scr[:]
+        m_ref[0] = m_scr[:]
+        l_ref[0] = l_scr[:]
+
+
+def flash_partial(q, k, v, *, scale: float, causal: bool,
+                  block_q: int, block_k: int, interpret: bool):
+    """One flash sweep of ``q``×(``k``,``v``) in ``[bh, s, d]`` layout,
+    returning the UNNORMALISED state ``(o_acc f32, m f32, l f32)`` with
+    shapes ``[bh, sq, d], [bh, sq, 1], [bh, sq, 1]``.
+
+    ``k``/``v`` may have a different sequence length than ``q`` (ring
+    attention feeds one visiting K/V block per call); ``causal`` masks in
+    LOCAL positions, which is exactly right for the ring's diagonal block
+    (q and k share the same global offset there) and unused for its
+    fully-visible blocks.
+    """
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    kernel = functools.partial(
+        _fwd_partial_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, sq // block_q, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
 
 
 # ------------------------------------------------------------- backward
@@ -233,18 +317,17 @@ def _flash_bhsd_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     return o, (q, k, v, o, lse)
 
 
-def _flash_bhsd_bwd(scale, causal, block_q, block_k, interpret, res, do):
-    q, k, v, o, lse = res
-    bh, s, d = q.shape
-    nq, nk = s // block_q, s // block_k
-    # delta = rowsum(dO ⊙ O): a cheap fused XLA reduction, computed once
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1, keepdims=True)                     # [bh, s, 1]
-
-    dq = pl.pallas_call(
+def flash_dq(q, k, v, do, lse, delta, *, scale, causal, block_q, block_k,
+             interpret, out_dtype=None):
+    """dQ for ``q``×(``k``,``v``) in ``[bh, s, d]`` layout; reusable by the
+    ring backward (per visiting K/V block, f32 out for cross-step
+    accumulation) and the monolithic VJP below."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    return pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k),
-        grid=(bh, nq, nk),
+        grid=(bh, sq // block_q, sk // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
@@ -254,15 +337,21 @@ def _flash_bhsd_bwd(scale, causal, block_q, block_k, interpret, res, do):
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), out_dtype or q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
-    dk, dv = pl.pallas_call(
+
+def flash_dkv(q, k, v, do, lse, delta, *, scale, causal, block_q, block_k,
+              interpret, out_dtype=None):
+    """(dK, dV) in ``[bh, s, d]`` layout; see ``flash_dq``."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    return pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k),
-        grid=(bh, nk, nq),
+        grid=(bh, sk // block_k, sq // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -276,13 +365,24 @@ def _flash_bhsd_bwd(scale, causal, block_q, block_k, interpret, res, do):
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), out_dtype or k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), out_dtype or v.dtype),
         ],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
+
+
+def _flash_bhsd_bwd(scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    # delta = rowsum(dO ⊙ O): a cheap fused XLA reduction, computed once
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)                     # [bh, s, 1]
+    kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+              interpret=interpret)
+    dq = flash_dq(q, k, v, do, lse, delta, **kw)
+    dk, dv = flash_dkv(q, k, v, do, lse, delta, **kw)
     return dq, dk, dv
 
 
@@ -290,18 +390,25 @@ _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 
 
 def _fit_block(s: int, want: int | None) -> int:
-    """Largest divisor of ``s`` ≤ ``want``; ``None`` picks a size by S.
+    """Largest divisor of ``s`` ≤ ``want`` that is a multiple of 8; ``None``
+    picks a size by S.
 
     Measured on v5e: 128-blocks win at short S (grid overhead amortises
     poorly), 512-blocks win at long S (fewer, fatter MXU tiles) — crossover
-    around S/8.
+    around S/8. Candidates step down in units of 8 (the f32 sublane) so a
+    non-tileable divisor like 125 (S=250) — which compiles under CPU
+    interpret but real-TPU pallas rejects or badly pads — can never be
+    picked; sequences with no 8-multiple divisor get the ValueError path in
+    ``flash_attention`` ("pad the sequence") instead.
     """
     if want is None:
         want = min(512, max(128, s // 8))
-    b = min(want, s)
-    while s % b:
-        b -= 1
-    return b
+    if s <= 8:
+        return s  # tiny test shapes; interpret mode only
+    b = min(want - want % 8, s - s % 8)
+    while b >= 8 and s % b:
+        b -= 8
+    return b if b >= 8 else 0
 
 
 def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
@@ -323,6 +430,13 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
         scale = 1.0 / (d ** 0.5)
     if interpret is None:
         interpret = _on_interpret_platform()
+    if not interpret and (block_q % 8 or block_k % 8):
+        # tiny s <= 8 shapes pass _fit_block for interpret-mode tests, but
+        # real-TPU mosaic rejects sub-sublane blocks — fail with the
+        # actionable error instead of a raw compile failure
+        raise ValueError(
+            f"blocks ({block_q}, {block_k}) are not 8-multiples; real-TPU "
+            f"pallas needs sublane-aligned blocks — pad the sequence")
 
     def to_bhsd(t):
         return t.transpose(0, 2, 1, 3).reshape(b * h, s, d)

@@ -83,6 +83,25 @@ def test_flash_rejects_untileable_seq():
         flash_attention(q, k, v, block_q=32, block_k=32)
 
 
+def test_fit_block_only_returns_sublane_multiples():
+    """ADVICE round-1: block sizes must be 8-multiples — odd divisors like
+    125 (S=250) pass CPU interpret but real-TPU pallas rejects them."""
+    from nvidia_terraform_modules_tpu.ops.flash_attention import _fit_block
+    assert _fit_block(192, None) == 96          # not 64? 96 divides and is 8k
+    assert _fit_block(250, None) == 0           # 125 must NOT be picked
+    assert _fit_block(4096, None) == 512
+    assert _fit_block(48, 32) == 24             # 24 = 3×8, divides 48
+    assert _fit_block(8, None) == 8
+    assert _fit_block(4, None) == 4             # tiny interpret-only shapes
+    for s in (128, 192, 256, 384, 512, 1024, 4096):
+        b = _fit_block(s, None)
+        assert b % 8 == 0 and s % b == 0
+    # S=250 now takes the explicit pad-the-sequence error path
+    q, k, v = _qkv(s=250)
+    with pytest.raises(ValueError, match="pad the sequence"):
+        flash_attention(q, k, v)
+
+
 def test_burnin_flash_matches_dense_forward_unsharded():
     base = dict(vocab=64, d_model=32, n_heads=2, d_ff=64, n_layers=2,
                 seq_len=16, batch=4, dtype=jnp.float32)

@@ -46,6 +46,55 @@ def test_ring_matches_dense(jax8, dp, sp, tp, causal):
     assert jnp.max(jnp.abs(out - ref)) < 1e-5
 
 
+@pytest.mark.parametrize("impl", ["dense", "flash"])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_impls_match_dense_at_tile_scale(jax8, impl, causal):
+    """Both per-block tile paths, at shapes where the flash path actually
+    tiles (s_local = 64 → 8-multiple blocks): VERDICT round-1 item 8 —
+    ring composed with the pallas flash kernel must stay exact."""
+    q, k, v = _qkv(b=2, s=256, h=2, d=16)
+    mesh = _mesh(jax8, 1, 4, 2)
+    ref = dense_reference_attention(q, k, v, causal=causal)
+    out = ring_self_attention(q, k, v, mesh, causal=causal, impl=impl)
+    assert jnp.max(jnp.abs(out - ref)) < 2e-5
+
+
+@pytest.mark.parametrize("impl", ["dense", "flash"])
+def test_ring_impl_gradients_match_dense(jax8, impl):
+    q, k, v = _qkv(b=2, s=128, h=2, d=16)
+    mesh = _mesh(jax8, 1, 4, 1)
+
+    def f_ring(q, k, v):
+        return jnp.sum(jnp.square(
+            ring_self_attention(q, k, v, mesh, impl=impl)))
+
+    def f_ref(q, k, v):
+        return jnp.sum(jnp.square(dense_reference_attention(q, k, v)))
+
+    g_ring = jax.grad(f_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        assert jnp.max(jnp.abs(a - b)) < 1e-3
+
+
+def test_ring_invalid_impl_rejected(jax8):
+    with pytest.raises(ValueError, match="unknown ring impl"):
+        ring_self_attention(*_qkv(), _mesh(jax8, 1, 2, 1), impl="cuda")
+
+
+def test_ring_auto_impl_falls_back_to_dense_on_untileable_shards(jax8):
+    """s=100 over sp=4 → s_loc=25, no 8-multiple divisor: the default impl
+    must fall back to the dense ring (round-1 behavior) instead of raising,
+    while explicit impl='flash' still raises the actionable error."""
+    q, k, v = _qkv(s=100)
+    mesh = _mesh(jax8, 1, 4, 1)
+    ref = dense_reference_attention(q, k, v)
+    out = ring_self_attention(q, k, v, mesh)
+    assert jnp.max(jnp.abs(out - ref)) < 1e-5
+    with pytest.raises(ValueError, match="pad the sequence"):
+        ring_self_attention(q, k, v, mesh, impl="flash")
+
+
 def test_ring_gradients_match_dense(jax8):
     q, k, v = _qkv()
     mesh = _mesh(jax8, 2, 2, 2)
