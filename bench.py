@@ -41,22 +41,30 @@ def main() -> None:
 
     on_tpu = jax.devices()[0].platform == "tpu"
     mm = matmul_probe(n=4096 if on_tpu else 512, iters=8 if on_tpu else 2)
-    hbm = hbm_probe(mib=256 if on_tpu else 32, iters=8 if on_tpu else 2)
+    hbm = hbm_probe(mib=512 if on_tpu else 32, iters=8 if on_tpu else 2,
+                    mode="read")
+    hbm_triad = hbm_probe(mib=512 if on_tpu else 32,
+                          iters=8 if on_tpu else 2, mode="triad")
 
-    # single-chip burn-in train-step throughput (tokens/s) on a mid-size config
+    # workload-level number: train-step MFU at long context on the flash
+    # path (VERDICT round-1 item 2) — achieved model FLOP/s over the chip's
+    # bf16 peak, on a config big enough for the matmuls to dominate
     from nvidia_terraform_modules_tpu.models import (
         BurnInConfig,
         init_params,
         make_train_step,
         synthetic_batch,
+        train_step_flops,
     )
+    from nvidia_terraform_modules_tpu.utils.device import device_spec
     import jax.numpy as jnp
 
     cfg = (
-        # dense attention: at S=512 XLA's fused op beats the pallas kernel
-        # (flash wins from ~2k context — measured separately below)
-        BurnInConfig(vocab=8192, d_model=512, n_heads=8, d_ff=2048, n_layers=4,
-                     seq_len=512, batch=16)
+        # head_dim 128 fills the MXU lane width inside the flash kernel;
+        # d=2048 projections/MLP dominate the FLOPs. Measured on v5e
+        # (2026-07 sweep): 0.65 MFU here vs 0.29 at d=1024/head_dim=64.
+        BurnInConfig(vocab=8192, d_model=2048, n_heads=16, d_ff=8192,
+                     n_layers=8, seq_len=4096, batch=2, attn="flash")
         if on_tpu
         else BurnInConfig(vocab=256, d_model=64, n_heads=4, d_ff=128,
                           n_layers=2, seq_len=32, batch=4, dtype=jnp.float32)
@@ -73,7 +81,10 @@ def main() -> None:
     for _ in range(iters):
         params, loss = step(params, batch)
     sync(loss)  # d2h readback: the only reliable barrier on tunnelled backends
-    tokens_per_s = cfg.batch * cfg.seq_len * iters / (time.perf_counter() - t_step)
+    step_seconds = (time.perf_counter() - t_step) / iters
+    tokens_per_s = cfg.batch * cfg.seq_len / step_seconds
+    mfu = (train_step_flops(cfg) / step_seconds) / (
+        device_spec().bf16_tflops * 1e12)
 
     # long-context attention: pallas flash kernel vs XLA dense at S=4096 —
     # the regime ring/flash attention exist for (O(S²) HBM traffic dominates)
@@ -124,8 +135,13 @@ def main() -> None:
         "matmul_tflops": round(mm["tflops"], 2),
         "matmul_roofline": round(mm["roofline_fraction"], 3),
         "hbm_gibps": round(hbm["gibps"], 1),
+        "hbm_roofline": round(hbm["roofline_fraction"], 3),
+        "hbm_triad_gibps": round(hbm_triad["gibps"], 1),
+        "hbm_triad_roofline": round(hbm_triad["roofline_fraction"], 3),
         "burnin_tokens_per_s": round(tokens_per_s, 1),
         "burnin_attn": cfg.attn,
+        "burnin_seq_len": cfg.seq_len,
+        "burnin_mfu": round(mfu, 3),
         **longctx,
     }
     print(json.dumps(line), flush=True)

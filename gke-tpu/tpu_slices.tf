@@ -66,6 +66,7 @@ locals {
   # outputs all see zero TPU capacity instead of phantom slices
   tpu_slice = {
     for name, s in local.tpu_enabled ? var.tpu_slices : {} : name => {
+      name           = coalesce(s.name, "${var.cluster_name}-${name}")
       version        = s.version
       topology       = s.topology
       node_selector  = local.tpu_generations[s.version].node_selector
@@ -86,7 +87,7 @@ locals {
 resource "google_container_node_pool" "tpu_slice" {
   for_each = local.tpu_slice
 
-  name     = "${var.cluster_name}-${each.key}"
+  name     = each.value.name
   project  = var.project_id
   cluster  = google_container_cluster.this.name
   location = local.cluster_location
@@ -110,7 +111,10 @@ resource "google_container_node_pool" "tpu_slice" {
     spot         = each.value.spot
 
     labels = merge(each.value.labels, {
-      "tpu-slice"   = each.key
+      # the stable pool identity, NOT the map key: node_config.labels
+      # changes force pool replacement, so a map-key refactor (moved{} +
+      # name override) must not show up here
+      "tpu-slice"   = each.value.name
       "tpu-version" = each.value.version
     })
 

@@ -115,6 +115,10 @@ variable "tpu_slices" {
     disk_size_gb       = optional(number, 100)
     disk_type          = optional(string, "pd-balanced")
     labels             = optional(map(string), {})
+    # cloud node-pool name override (default "<cluster>-<map key>"): lets a
+    # map-key refactor keep the deployed pool's name, so a `moved` block
+    # makes the rename a true no-op instead of a pool re-create
+    name = optional(string)
   }))
   default = {
     default = {}

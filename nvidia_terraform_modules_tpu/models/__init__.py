@@ -7,4 +7,5 @@ from .burnin import (  # noqa: F401
     loss_fn,
     make_train_step,
     synthetic_batch,
+    train_step_flops,
 )

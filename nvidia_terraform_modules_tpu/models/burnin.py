@@ -181,6 +181,26 @@ def forward(params, tokens, cfg: BurnInConfig, rules: ShardingRules | None = Non
     return act(logits, "sp", None)
 
 
+def train_step_flops(cfg: BurnInConfig) -> float:
+    """Model FLOPs for ONE train step (fwd + bwd), for MFU accounting.
+
+    Counts useful matmul FLOPs only (the MFU convention): projections,
+    attention contractions, MLP, and the weight-tied head; backward = 2×
+    forward. Causal attention counts the ~half of the score/PV work that
+    is unmasked — the flash kernel's block-sparse skip means masked tiles
+    genuinely cost nothing, so billing them would inflate MFU.
+    """
+    b, s, d, dff, v = (cfg.batch, cfg.seq_len, cfg.d_model, cfg.d_ff,
+                       cfg.vocab)
+    per_layer = (
+        8.0 * b * s * d * d          # q, k, v, o projections (2·BSd² each)
+        + 2.0 * b * s * s * d        # QKᵀ + PV, causal-effective (½ of 4BS²d)
+        + 4.0 * b * s * d * dff      # up + down projections
+    )
+    fwd = cfg.n_layers * per_layer + 2.0 * b * s * d * v  # + tied head
+    return 3.0 * fwd                 # bwd ≈ 2× fwd
+
+
 def loss_fn(params, batch, cfg: BurnInConfig, rules: ShardingRules | None = None):
     tokens, targets = batch
     logits = forward(params, tokens, cfg, rules).astype(jnp.float32)
