@@ -26,4 +26,12 @@ from .module import Module, load_module  # noqa: F401
 from .validate import validate_module, Finding  # noqa: F401
 from .plan import simulate_plan, Plan, PlanError  # noqa: F401
 from .destroy import simulate_destroy, DestroyPlan, DestroyHazard  # noqa: F401
-from .state import State, Diff, apply_plan, diff, migrate_state  # noqa: F401
+from .state import (  # noqa: F401
+    State,
+    Diff,
+    apply_plan,
+    diff,
+    migrate_state,
+    state_mv,
+    state_rm,
+)

@@ -10,6 +10,9 @@ terraform binary in CI, so tfsim ships the same verbs offline::
         -var cluster_name=c [-state terraform.tfstate.json] [-json]
     python -m nvidia_terraform_modules_tpu.tfsim apply gke-tpu ... -state f
     python -m nvidia_terraform_modules_tpu.tfsim destroy gke-tpu ...
+    python -m nvidia_terraform_modules_tpu.tfsim output -state f [NAME] [-json]
+    python -m nvidia_terraform_modules_tpu.tfsim state list|show|rm|mv ... -state f
+    python -m nvidia_terraform_modules_tpu.tfsim graph gke-tpu -var ...
     python -m nvidia_terraform_modules_tpu.tfsim fmt -check gke-tpu gke
     python -m nvidia_terraform_modules_tpu.tfsim docs -check gke-tpu
 
@@ -29,8 +32,15 @@ from .docs import check_readme, generate_docs
 from .fmt import check_text, format_text
 from .lockfile import LockfileError, check_lockfile, write_lockfile
 from .module import load_module
-from .plan import PlanError, load_tfvars, render, simulate_plan
-from .state import State, apply_plan, diff, migrate_state
+from .plan import PlanError, load_tfvars, render, simulate_plan, to_dot
+from .state import (
+    State,
+    apply_plan,
+    diff,
+    migrate_state,
+    state_mv,
+    state_rm,
+)
 from .validate import validate_module
 
 
@@ -139,6 +149,107 @@ def cmd_apply(args) -> int:
     return 0
 
 
+def cmd_output(args) -> int:
+    """``terraform output``: read applied outputs from the statefile.
+
+    The reference's CNPack handoff is exactly this verb — ``terraform
+    output`` values pasted into the ``NvidiaPlatform`` YAML
+    (``/root/reference/eks/examples/cnpack/Readme.md:49-94``). Terraform
+    semantics: the list view masks sensitive values; naming an output (or
+    ``-json``) reveals them.
+    """
+    state = _load_state(args.state)
+    if state is None:
+        print(f"Error: no state at {args.state!r} — apply first",
+              file=sys.stderr)
+        return 1
+    if args.name:
+        if args.name not in state.outputs:
+            print(f"Error: output {args.name!r} not found in state",
+                  file=sys.stderr)
+            return 1
+        print(json.dumps(state.outputs[args.name]["value"], sort_keys=True))
+        return 0
+    if args.json:
+        print(json.dumps(state.outputs, indent=2, sort_keys=True))
+        return 0
+    for name in sorted(state.outputs):
+        o = state.outputs[name]
+        shown = "<sensitive>" if o["sensitive"] else \
+            json.dumps(o["value"], sort_keys=True)
+        print(f"{name} = {shown}")
+    return 0
+
+
+def cmd_graph(args) -> int:
+    try:
+        print(to_dot(simulate_plan(load_module(args.dir),
+                                   _gather_vars(args))), end="")
+    except (PlanError, ValueError) as ex:
+        print(f"Error: {ex}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_state(args) -> int:
+    """``terraform state list|show|rm|mv`` against the simulated statefile.
+
+    ``rm`` exists because the reference *requires* it operationally: GKE
+    teardown runbook step ``terraform state rm
+    kubernetes_namespace_v1.gpu-operator`` (``/root/reference/gke/README.md:59``).
+    """
+    wanted = {"list": 0, "show": 1, "mv": 2}
+    n = len(args.address)
+    if args.subcmd in wanted and n != wanted[args.subcmd] or \
+            (args.subcmd == "rm" and n == 0):
+        print(f"Error: state {args.subcmd} takes "
+              f"{wanted.get(args.subcmd, '1+')} address argument(s), "
+              f"got {n}", file=sys.stderr)
+        return 2
+    state = _load_state(args.state)
+    if state is None:
+        print(f"Error: no state at {args.state!r}", file=sys.stderr)
+        return 1
+
+    def save(new_state: State) -> None:
+        with open(args.state, "w") as fh:
+            fh.write(new_state.to_json())
+
+    try:
+        if args.subcmd == "list":
+            for addr in sorted(state.resources):
+                print(addr)
+            return 0
+        if args.subcmd == "show":
+            if args.address[0] not in state.resources:
+                print(f"Error: {args.address[0]!r} not in state",
+                      file=sys.stderr)
+                return 1
+            print(json.dumps(state.resources[args.address[0]], indent=2,
+                             sort_keys=True))
+            return 0
+        if args.subcmd == "rm":
+            new_state, removed = state_rm(state, args.address)
+            save(new_state)
+            for addr in removed:
+                print(f"Removed {addr}")
+            print(f"Successfully removed {len(removed)} resource "
+                  f"instance(s).")
+            return 0
+        if args.subcmd == "mv":
+            src, dst = args.address
+            new_state, renames = state_mv(state, src, dst)
+            save(new_state)
+            for old, new in renames:
+                print(f'Move "{old}" to "{new}"')
+            print(f"Successfully moved {len(renames)} object(s).")
+            return 0
+    except ValueError as ex:
+        print(f"Error: {ex}", file=sys.stderr)
+        return 1
+    raise SystemExit(f"unknown state subcommand {args.subcmd!r}")
+
+
 def cmd_destroy(args) -> int:
     try:
         d = simulate_destroy(args.dir, _gather_vars(args))
@@ -238,6 +349,19 @@ def main(argv: list[str] | None = None) -> int:
     c.add_argument("-show-noop", action="store_true")
     add_module_cmd("apply", cmd_apply, state=True)
     add_module_cmd("destroy", cmd_destroy)
+    add_module_cmd("graph", cmd_graph)
+
+    o = sub.add_parser("output")
+    o.add_argument("name", nargs="?", default=None)
+    o.add_argument("-state", required=True)
+    o.add_argument("-json", action="store_true")
+    o.set_defaults(fn=cmd_output)
+
+    st = sub.add_parser("state")
+    st.add_argument("subcmd", choices=["list", "show", "rm", "mv"])
+    st.add_argument("address", nargs="*")
+    st.add_argument("-state", required=True)
+    st.set_defaults(fn=cmd_state)
 
     f = sub.add_parser("fmt")
     f.add_argument("paths", nargs="+")

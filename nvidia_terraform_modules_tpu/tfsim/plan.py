@@ -46,6 +46,7 @@ class Plan:
     order: list[str]                             # topological apply order
     child_plans: dict[str, "Plan"] = dataclasses.field(default_factory=dict)
     check_failures: list[str] = dataclasses.field(default_factory=list)
+    sensitive_outputs: set[str] = dataclasses.field(default_factory=set)
 
     def instance(self, address: str) -> PlannedInstance:
         return self.instances[address]
@@ -339,6 +340,8 @@ def simulate_plan(
         module_path=module.path, instances=instances, outputs=outputs,
         edges=edges, order=order, child_plans=child_plans,
         check_failures=check_failures,
+        sensitive_outputs={n for n, o in module.outputs.items()
+                           if o.sensitive},
     )
 
 
@@ -537,6 +540,22 @@ def _toposort(deps: dict[str, set[str]]) -> list[str]:
     for n in sorted(deps):
         visit(n, [])
     return order
+
+
+def to_dot(plan: Plan) -> str:
+    """Render the dependency DAG as GraphViz DOT (``terraform graph``).
+
+    Edges point from a node to what it depends on, matching terraform's
+    drawing direction; nodes with no edges still appear so the graph is a
+    complete inventory of the plan.
+    """
+    lines = ["digraph {", "  rankdir = \"RL\";"]
+    for addr in plan.order:
+        lines.append(f'  "{addr}";')
+    for frm, to in sorted(plan.edges):
+        lines.append(f'  "{frm}" -> "{to}";')
+    lines.append("}")
+    return "\n".join(lines) + "\n"
 
 
 def render(value: Any) -> Any:

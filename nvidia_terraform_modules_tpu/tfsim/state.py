@@ -29,20 +29,31 @@ COMPUTED_STR = "<computed>"
 
 @dataclasses.dataclass
 class State:
-    """Applied resource attributes by address — the checkpoint artifact."""
+    """Applied resource attributes by address — the checkpoint artifact.
+
+    ``outputs`` mirrors the real tfstate shape (``{"name": {"value": …,
+    "sensitive": bool}}``): the reference's CNPack workflow reads applied
+    outputs with ``terraform output`` and pastes them into the platform
+    config (``/root/reference/eks/examples/cnpack/Readme.md:49-94``), so the
+    simulator's statefile must carry them too (``tfsim output``).
+    """
 
     resources: dict[str, Any] = dataclasses.field(default_factory=dict)
     serial: int = 0
+    outputs: dict[str, dict[str, Any]] = dataclasses.field(
+        default_factory=dict)
 
     def to_json(self) -> str:
         return json.dumps(
-            {"serial": self.serial, "resources": self.resources},
+            {"serial": self.serial, "resources": self.resources,
+             "outputs": self.outputs},
             indent=2, sort_keys=True)
 
     @classmethod
     def from_json(cls, text: str) -> "State":
         raw = json.loads(text)
-        return cls(resources=raw["resources"], serial=raw["serial"])
+        return cls(resources=raw["resources"], serial=raw["serial"],
+                   outputs=raw.get("outputs", {}))
 
 
 @dataclasses.dataclass
@@ -152,6 +163,29 @@ def _moved_addr(expr) -> str | None:
     return out
 
 
+def _matching_addrs(resources: dict[str, Any], addr: str) -> list[str]:
+    """State entries covered by ``addr``: the exact node/instance, instances
+    of the node (``addr[...]``), or children of a module (``addr....``) —
+    never a mere name prefix (``module.a`` must not match ``module.ab``)."""
+    return sorted(a for a in resources
+                  if a == addr or a.startswith(addr + "[") or
+                  a.startswith(addr + "."))
+
+
+def _move(resources: dict[str, Any], frm: str, to: str,
+          label: str) -> list[tuple[str, str]]:
+    """Rename every state entry under ``frm`` to live under ``to``."""
+    renames: list[tuple[str, str]] = []
+    for addr in _matching_addrs(resources, frm):
+        new = to + addr[len(frm):]
+        if new in resources:
+            raise ValueError(
+                f"{label}: target {new!r} already exists in state")
+        resources[new] = resources.pop(addr)
+        renames.append((addr, new))
+    return renames
+
+
 def migrate_state(state: State, module) -> tuple[State, list[tuple[str, str]]]:
     """Honour ``moved {}`` blocks: rename state addresses, no destroy/create.
 
@@ -170,20 +204,56 @@ def migrate_state(state: State, module) -> tuple[State, list[tuple[str, str]]]:
         to = _moved_addr(to_attr.expr) if to_attr is not None else None
         if frm is None or to is None:
             continue
-        for addr in list(resources):
-            # exact node/instance, an instance of the node, or a child of a
-            # moved module — never a mere name prefix (module.a vs module.ab)
-            if addr == frm or addr.startswith(frm + "[") or \
-                    addr.startswith(frm + "."):
-                new = to + addr[len(frm):]
-                if new in resources:
-                    raise ValueError(
-                        f"moved: target {new!r} already exists in state")
-                resources[new] = resources.pop(addr)
-                renames.append((addr, new))
+        renames.extend(_move(resources, frm, to, "moved"))
     if not renames:
         return state, []
-    return State(resources=resources, serial=state.serial + 1), renames
+    return State(resources=resources, serial=state.serial + 1,
+                 outputs=state.outputs), renames
+
+
+def state_rm(state: State, addrs: list[str]) -> tuple[State, list[str]]:
+    """``terraform state rm``: forget resources without destroying them.
+
+    The reference *documents this as a required runbook step*: the GKE
+    teardown needs ``terraform state rm kubernetes_namespace_v1.gpu-operator``
+    before ``destroy`` because the namespace can't be deleted once the
+    cluster is gone (``/root/reference/gke/README.md:59``,
+    ``/root/reference/gke/examples/cnpack/README.md:27``). Our module designs
+    that wart out with destroy ordering (``gke/operator.tf:10-16``), but the
+    simulator still ships the verb so the runbook itself is testable.
+
+    Each address may name a resource (all instances follow), one instance,
+    or a whole module. Raises ``ValueError`` if an address matches nothing
+    (terraform: "Invalid target address").
+    """
+    resources = dict(state.resources)
+    removed: list[str] = []
+    for addr in addrs:
+        hits = _matching_addrs(resources, addr)
+        if not hits:
+            raise ValueError(
+                f"state rm: no resource in state matches {addr!r}")
+        for a in hits:
+            del resources[a]
+            removed.append(a)
+    return State(resources=resources, serial=state.serial + 1,
+                 outputs=state.outputs), removed
+
+
+def state_mv(state: State, src: str,
+             dst: str) -> tuple[State, list[tuple[str, str]]]:
+    """``terraform state mv``: the imperative twin of a ``moved {}`` block.
+
+    Same matching/rename semantics as :func:`migrate_state`, driven from the
+    CLI instead of config. Raises ``ValueError`` when ``src`` matches nothing
+    or any destination address already exists.
+    """
+    resources = dict(state.resources)
+    renames = _move(resources, src, dst, "state mv")
+    if not renames:
+        raise ValueError(f"state mv: no resource in state matches {src!r}")
+    return State(resources=resources, serial=state.serial + 1,
+                 outputs=state.outputs), renames
 
 
 def apply_plan(plan: Plan, state: State | None = None) -> State:
@@ -202,4 +272,9 @@ def apply_plan(plan: Plan, state: State | None = None) -> State:
     for addr in d.by_action("create") + d.by_action("update"):
         resources[addr] = planned[addr]
     serial = (state.serial if state else 0) + (0 if d.is_noop else 1)
-    return State(resources=resources, serial=serial)
+    outputs = {
+        name: {"value": render(value),
+               "sensitive": name in plan.sensitive_outputs}
+        for name, value in plan.outputs.items()
+    }
+    return State(resources=resources, serial=serial, outputs=outputs)

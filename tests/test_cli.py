@@ -159,3 +159,113 @@ def test_var_file(tmp_path, capsys):
     vf.write_text('project_id = "p"\ncluster_name = "c"\n')
     assert main(["plan", GKE_TPU, "-var-file", str(vf)]) == 0
     assert "Plan: 10 to add" in capsys.readouterr().out
+
+
+def test_output_list_masks_sensitive(tmp_path, capsys):
+    state = str(tmp_path / "s.json")
+    assert main(["apply", GKE_TPU, "-state", state] + VARS) == 0
+    capsys.readouterr()
+    assert main(["output", "-state", state]) == 0
+    out = capsys.readouterr().out
+    assert 'cluster_name = "c"' in out
+    assert "cluster_ca_certificate = <sensitive>" in out
+
+
+def test_output_by_name_reveals_and_json(tmp_path, capsys):
+    state = str(tmp_path / "s.json")
+    assert main(["apply", GKE_TPU, "-state", state] + VARS) == 0
+    capsys.readouterr()
+    # naming an output reveals it (terraform semantics)
+    assert main(["output", "-state", state, "cluster_ca_certificate"]) == 0
+    assert "<sensitive>" not in capsys.readouterr().out
+    assert main(["output", "-state", state, "-json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["cluster_name"] == {"value": "c", "sensitive": False}
+
+
+def test_output_errors(tmp_path, capsys):
+    state = str(tmp_path / "s.json")
+    assert main(["output", "-state", state]) == 1
+    assert "apply first" in capsys.readouterr().err
+    assert main(["apply", GKE_TPU, "-state", state] + VARS) == 0
+    capsys.readouterr()
+    assert main(["output", "-state", state, "nope"]) == 1
+    assert "not found" in capsys.readouterr().err
+
+
+def test_state_list_show_rm_mv(tmp_path, capsys):
+    state = str(tmp_path / "s.json")
+    assert main(["apply", GKE_TPU, "-state", state] + VARS) == 0
+    capsys.readouterr()
+
+    assert main(["state", "list", "-state", state]) == 0
+    listing = capsys.readouterr().out.splitlines()
+    assert "google_container_cluster.this" in listing
+
+    assert main(["state", "show", "google_container_cluster.this",
+                 "-state", state]) == 0
+    shown = json.loads(capsys.readouterr().out)
+    assert shown["name"] == "c"
+
+    assert main(["state", "mv",
+                 'google_container_node_pool.tpu_slice["default"]',
+                 'google_container_node_pool.tpu_slice["primary"]',
+                 "-state", state]) == 0
+    assert "Successfully moved 1 object(s)." in capsys.readouterr().out
+
+    assert main(["state", "rm", "google_container_node_pool.tpu_slice",
+                 "-state", state]) == 0
+    assert "Successfully removed 1 resource" in capsys.readouterr().out
+    # the file itself advanced: list no longer shows the pool
+    assert main(["state", "list", "-state", state]) == 0
+    assert "tpu_slice" not in capsys.readouterr().out
+
+
+def test_state_rm_then_plan_recreates(tmp_path, capsys):
+    """The runbook flow end-to-end through the CLI: rm → plan shows create."""
+    state = str(tmp_path / "s.json")
+    assert main(["apply", GKE_TPU, "-state", state] + VARS) == 0
+    assert main(["state", "rm", "kubernetes_namespace_v1.tpu_runtime",
+                 "-state", state]) == 0
+    capsys.readouterr()
+    assert main(["plan", GKE_TPU, "-state", state] + VARS) == 0
+    out = capsys.readouterr().out
+    assert "+ kubernetes_namespace_v1.tpu_runtime" in out
+    assert "Plan: 1 to add, 0 to change, 0 to destroy." in out
+
+
+def test_state_errors(tmp_path, capsys):
+    state = str(tmp_path / "s.json")
+    assert main(["state", "list", "-state", state]) == 1
+    capsys.readouterr()
+    assert main(["apply", GKE_TPU, "-state", state] + VARS) == 0
+    capsys.readouterr()
+    assert main(["state", "rm", "nope.nope", "-state", state]) == 1
+    assert "no resource in state" in capsys.readouterr().err
+    assert main(["state", "show", "nope.nope", "-state", state]) == 1
+    assert "not in state" in capsys.readouterr().err
+
+
+def test_graph_dot(capsys):
+    assert main(["graph", GKE_TPU] + VARS) == 0
+    dot = capsys.readouterr().out
+    assert dot.startswith("digraph {")
+    assert dot.rstrip().endswith("}")
+    # the runtime helm release depends on the namespace it installs into
+    assert '"helm_release.tpu_runtime" -> ' \
+        '"kubernetes_namespace_v1.tpu_runtime";' in dot
+    # every planned node appears, even leaves
+    assert '"google_compute_network.vpc";' in dot
+
+
+def test_graph_error_exit(tmp_path, capsys):
+    assert main(["graph", GKE_TPU]) == 1
+    assert "project_id" in capsys.readouterr().err
+
+
+def test_state_usage_errors(tmp_path, capsys):
+    state = str(tmp_path / "s.json")
+    assert main(["state", "show", "-state", state]) == 2
+    assert main(["state", "mv", "a.b", "-state", state]) == 2
+    assert main(["state", "rm", "-state", state]) == 2
+    assert "address argument" in capsys.readouterr().err

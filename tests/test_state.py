@@ -299,3 +299,83 @@ def test_incremental_apply_converges():
     state3 = apply_plan(_plan(), state2)
     assert 'google_container_node_pool.tpu_slice["b"]' not in state3.resources
     assert diff(_plan(), state3).is_noop
+
+
+# ---------------------------------------------------- state surgery (rm/mv)
+
+def test_state_rm_whole_resource_and_replan_recreates():
+    """``state rm`` forgets but doesn't destroy: the orphaned resource
+    re-plans as a create (terraform's documented semantics)."""
+    from nvidia_terraform_modules_tpu.tfsim import state_rm
+
+    state = apply_plan(_plan())
+    new, removed = state_rm(state, ["google_container_node_pool.tpu_slice"])
+    assert removed == ['google_container_node_pool.tpu_slice["default"]']
+    assert new.serial == state.serial + 1
+    d = diff(_plan(), new)
+    assert d.actions['google_container_node_pool.tpu_slice["default"]'] == \
+        "create"
+
+
+def test_state_rm_unknown_address_raises():
+    import pytest
+
+    from nvidia_terraform_modules_tpu.tfsim import state_rm
+
+    with pytest.raises(ValueError, match="no resource in state"):
+        state_rm(apply_plan(_plan()), ["google_compute_network.nope"])
+
+
+def test_state_rm_runbook_parity():
+    """The reference's GKE teardown runbook (gke/README.md:59): state rm the
+    operator namespace, then destroy proceeds without touching it."""
+    from nvidia_terraform_modules_tpu.tfsim import state_rm
+
+    plan = simulate_plan(os.path.join(ROOT, "gke"),
+                         {"project_id": "p", "cluster_name": "c"})
+    state = apply_plan(plan)
+    ns = "kubernetes_namespace_v1.gpu_operator[0]"
+    assert ns in state.resources
+    new, removed = state_rm(state, ["kubernetes_namespace_v1.gpu_operator"])
+    assert removed == [ns]
+    assert ns not in new.resources
+    # remaining teardown surface no longer includes the namespace
+    assert all(not a.startswith("kubernetes_namespace_v1.")
+               for a in new.resources)
+
+
+def test_state_mv_is_imperative_moved_block():
+    from nvidia_terraform_modules_tpu.tfsim import state_mv
+
+    state = apply_plan(_plan())
+    new, renames = state_mv(
+        state, 'google_container_node_pool.tpu_slice["default"]',
+        'google_container_node_pool.tpu_slice["primary"]')
+    assert renames == [('google_container_node_pool.tpu_slice["default"]',
+                        'google_container_node_pool.tpu_slice["primary"]')]
+    assert 'google_container_node_pool.tpu_slice["primary"]' in new.resources
+
+
+def test_state_mv_target_exists_raises():
+    import pytest
+
+    from nvidia_terraform_modules_tpu.tfsim import state_mv
+
+    state = apply_plan(_plan())
+    with pytest.raises(ValueError, match="already exists"):
+        state_mv(state, "google_container_cluster.this",
+                 "google_container_cluster.this")
+
+
+def test_outputs_recorded_in_state_with_sensitivity():
+    state = apply_plan(_plan())
+    assert state.outputs["cluster_name"] == {
+        "value": "demo", "sensitive": False}
+    assert state.outputs["cluster_ca_certificate"]["sensitive"] is True
+    # round-trips through the statefile JSON
+    again = State.from_json(state.to_json())
+    assert again.outputs == state.outputs
+    # pre-outputs statefiles (older serial format) still load
+    legacy = State.from_json(
+        '{"serial": 3, "resources": {}}')
+    assert legacy.outputs == {}
