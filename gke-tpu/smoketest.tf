@@ -61,6 +61,14 @@ locals {
   # either way, so the declarations are about intent, not reachability.
   smoke_coordinator_port = 8476
   smoke_megascale_port   = 8080
+  # one budget for both gates: terraform's wait_for_completion timeout AND
+  # the Job's own in-cluster deadline. Scales with WORLD size, not slice
+  # size: every pod in every slice must schedule + pull the JAX image
+  # before jax.distributed.initialize can return anywhere.
+  smoke_deadline_s = (
+    var.smoketest.timeout_seconds +
+    var.smoketest.timeout_per_host_seconds * local.smoke_total_hosts
+  )
   # jax.distributed coordinator: slice 0, pod 0 (indexed-Job hostname
   # "<job-name>-<index>" under the headless service's subdomain)
   smoke_coordinator = (
@@ -68,6 +76,17 @@ locals {
     ? "${local.smoke_name}-${local.smoke_slice_order[0]}-0.${local.smoke_name}.${local.smoke_ns}.svc"
     : ""
   )
+}
+
+# advisory, not provable at plan time (the claim is bring-your-own): a
+# multi-host world mounting one PVC from several nodes needs ReadWriteMany
+check "checkpoint_pvc_needs_rwx" {
+  assert {
+    condition = (
+      var.smoketest.checkpoint_pvc == null || local.smoke_total_hosts <= 1
+    )
+    error_message = "smoketest.checkpoint_pvc is mounted by every smoke-test pod across ${local.smoke_total_hosts} hosts: the claim must be ReadWriteMany (e.g. Filestore CSI) — a ReadWriteOnce GCE-PD claim deadlocks all but the first pod."
+  }
 }
 
 resource "kubernetes_config_map_v1" "smoketest_script" {
@@ -128,7 +147,36 @@ resource "kubernetes_job_v1" "tpu_smoketest" {
     completions     = each.value.hosts
     parallelism     = each.value.hosts
     completion_mode = "Indexed"
-    backoff_limit   = 2
+    # the in-cluster retry window must not outlive the apply gate: with the
+    # disruption-exempt failure policy below, an unbounded Job on contested
+    # spot capacity would keep recreating pods and claiming TPU quota long
+    # after wait_for_completion has timed the apply out
+    active_deadline_seconds = local.smoke_deadline_s
+    # with resume enabled the Job must survive repeated spot preemptions —
+    # one preemption fails ALL of a slice's pods at once (coordinator and
+    # collective peers die together), so a small fixed budget would burn
+    # out on the first event and the checkpoint would never be read
+    backoff_limit = coalesce(
+      var.smoketest.backoff_limit,
+      var.smoketest.checkpoint_dir != null ? 10 : 2
+    )
+
+    # don't bill spot/maintenance evictions against the retry budget at
+    # all: a DisruptionTarget pod failure is capacity churn, not a test
+    # failure (kubernetes 1.26+ API surface, same as the certified GKE
+    # channel in README.md's support matrix)
+    dynamic "pod_failure_policy" {
+      for_each = var.smoketest.checkpoint_dir != null ? [1] : []
+      content {
+        rule {
+          action = "Ignore"
+          on_pod_condition {
+            status = "True"
+            type   = "DisruptionTarget"
+          }
+        }
+      }
+    }
 
     template {
       metadata {
@@ -156,7 +204,7 @@ resource "kubernetes_job_v1" "tpu_smoketest" {
         container {
           name    = "smoketest"
           image   = var.tpu_runtime.jax_image
-          command = ["python", "/opt/smoketest/tpu_smoketest.py"]
+          command = var.smoketest.command
 
           env {
             name  = "TPU_SMOKETEST_EXPECTED_DEVICES"
@@ -181,6 +229,16 @@ resource "kubernetes_job_v1" "tpu_smoketest" {
           env {
             name  = "TPU_SMOKETEST_COORDINATOR"
             value = local.smoke_coordinator
+          }
+
+          # spot-slice resume: preempted burn-in pods restart from their
+          # last checkpoint instead of step 0
+          dynamic "env" {
+            for_each = var.smoketest.checkpoint_dir != null ? [1] : []
+            content {
+              name  = "TPU_SMOKETEST_CHECKPOINT_DIR"
+              value = var.smoketest.checkpoint_dir
+            }
           }
 
           # libtpu's DCN transport for cross-slice collectives
@@ -218,12 +276,31 @@ resource "kubernetes_job_v1" "tpu_smoketest" {
             name       = "script"
             mount_path = "/opt/smoketest"
           }
+
+          # durable resume state for local checkpoint paths (gs:// needs none)
+          dynamic "volume_mount" {
+            for_each = var.smoketest.checkpoint_pvc != null ? [1] : []
+            content {
+              name       = "checkpoint"
+              mount_path = var.smoketest.checkpoint_dir
+            }
+          }
         }
 
         volume {
           name = "script"
           config_map {
             name = kubernetes_config_map_v1.smoketest_script[0].metadata[0].name
+          }
+        }
+
+        dynamic "volume" {
+          for_each = var.smoketest.checkpoint_pvc != null ? [1] : []
+          content {
+            name = "checkpoint"
+            persistent_volume_claim {
+              claim_name = var.smoketest.checkpoint_pvc
+            }
           }
         }
       }
@@ -233,11 +310,7 @@ resource "kubernetes_job_v1" "tpu_smoketest" {
   wait_for_completion = true
 
   timeouts {
-    # scale the gate with WORLD size, not this slice's size: every pod in
-    # every slice must schedule + pull the JAX image before
-    # jax.distributed.initialize can return anywhere, so a small slice's
-    # Job waits on the largest slice's rollout too
-    create = "${var.smoketest.timeout_seconds + var.smoketest.timeout_per_host_seconds * local.smoke_total_hosts}s"
+    create = "${local.smoke_deadline_s}s"
   }
 
   depends_on = [

@@ -229,6 +229,62 @@ variable "smoketest" {
     # (every extra host is another pod that must schedule and pull images)
     timeout_seconds          = optional(number, 1200)
     timeout_per_host_seconds = optional(number, 60)
+    # pod entrypoint; override to run the installable package (e.g.
+    # ["python", "-m", "nvidia_terraform_modules_tpu.smoketest"]) from a
+    # package-bearing image instead of the bundled single-file payload
+    command = optional(list(string), ["python", "/opt/smoketest/tpu_smoketest.py"])
+    # Job retry budget; null = 10 when checkpointing (a slice preemption
+    # fails every pod at once, so resume needs headroom), else 2
+    backoff_limit = optional(number)
+    # burn-in checkpoint/resume path for preempted pods (spot slices): an
+    # absolute local path backed by checkpoint_pvc (a PersistentVolumeClaim
+    # mounted there so state survives pod replacement), or a gs:// prefix
+    # with a custom command running the package (orbax backend, Workload
+    # Identity) — the bundled payload cannot write remote URIs.
+    # checkpoint_pvc MUST be ReadWriteMany (e.g. Filestore CSI) whenever
+    # the validated slice(s) span more than one host: every pod mounts the
+    # same claim from a different node, and a ReadWriteOnce GCE-PD claim
+    # deadlocks all but the first pod in ContainerCreating.
+    checkpoint_dir = optional(string)
+    checkpoint_pvc = optional(string)
   })
   default = {}
+
+  validation {
+    # a local checkpoint path on ephemeral pod storage would silently never
+    # resume (a replacement pod gets a fresh filesystem): require the PVC,
+    # and an absolute path (kubernetes rejects relative mountPath at apply)
+    condition = (
+      var.smoketest.checkpoint_dir == null ||
+      startswith(var.smoketest.checkpoint_dir, "gs://") || (
+        startswith(var.smoketest.checkpoint_dir, "/") &&
+        var.smoketest.checkpoint_pvc != null
+      )
+    )
+    error_message = "smoketest.checkpoint_dir must be a gs:// prefix or an ABSOLUTE local path with smoketest.checkpoint_pvc (a PersistentVolumeClaim name) so checkpoints survive pod replacement."
+  }
+
+  validation {
+    # a PVC cannot be mounted at a gs:// URI (and is meaningless without a
+    # checkpoint_dir to mount it at)
+    condition = (
+      var.smoketest.checkpoint_pvc == null || (
+        var.smoketest.checkpoint_dir != null &&
+        !startswith(var.smoketest.checkpoint_dir, "gs://")
+      )
+    )
+    error_message = "smoketest.checkpoint_pvc requires a non-gs:// smoketest.checkpoint_dir to mount at."
+  }
+
+  validation {
+    # the default bundled payload is dependency-free and fails loudly on
+    # remote URIs: gs:// checkpointing needs the installable package, so
+    # require a non-default command (a package-bearing image) with it
+    condition = (
+      var.smoketest.checkpoint_dir == null ||
+      !startswith(var.smoketest.checkpoint_dir, "gs://") ||
+      var.smoketest.command != tolist(["python", "/opt/smoketest/tpu_smoketest.py"])
+    )
+    error_message = "a gs:// smoketest.checkpoint_dir needs smoketest.command overridden to run the installable package (orbax backend); the bundled payload cannot write remote URIs."
+  }
 }

@@ -9,3 +9,10 @@ from .burnin import (  # noqa: F401
     synthetic_batch,
     train_step_flops,
 )
+from .checkpoint import (  # noqa: F401
+    Checkpointer,
+    clear_checkpoints,
+    latest_step,
+    restore_checkpoint,
+    save_checkpoint,
+)

@@ -131,22 +131,80 @@ def run_smoketest(
             ok &= pr["ok"]
 
     if level == "burnin" and ok:
-        from ..models import BurnInConfig, init_params, make_train_step, synthetic_batch
+        from ..models import (
+            BurnInConfig,
+            Checkpointer,
+            init_params,
+            make_train_step,
+            synthetic_batch,
+        )
 
         mesh = ms_mesh if ms_mesh is not None else build_mesh(plan_mesh(n_dev))
         rules = make_rules(mesh)
         data_shards = mesh.shape["dp"] * mesh.shape.get("slice", 1)
         cfg = BurnInConfig(batch=max(8, 2 * data_shards))
-        params = init_params(jax.random.PRNGKey(0), cfg, rules)
-        step = make_train_step(cfg, rules)
-        batch = synthetic_batch(jax.random.PRNGKey(1), cfg, rules)
-        losses = []
-        for _ in range(5):
-            params, loss = step(params, batch)
-            losses.append(float(loss))
-        checks["burnin_first_loss"] = round(losses[0], 4)
-        checks["burnin_last_loss"] = round(losses[-1], 4)
-        checks["burnin_ok"] = losses[-1] < losses[0]
-        ok &= checks["burnin_ok"]
+
+        # preemption resume: a spot slice's Job pod restarts mid-burn-in and
+        # must continue from its last checkpoint, not start over (the module
+        # provisions spot slices first-class — gke-tpu/tpu_slices.tf; the
+        # Job wires a PVC mount or gs:// prefix via smoketest.checkpoint_dir).
+        # Every step checkpoints; a SUCCESSFUL run clears the directory so
+        # the next fresh Job starts at step 0 instead of inheriting a
+        # finished run's count. Checkpoint I/O failure fails the suite
+        # through the JSON contract (never a bare traceback): a broken
+        # resume path on spot capacity is an operational bug.
+        ckpt_dir = e.get("TPU_SMOKETEST_CHECKPOINT_DIR")
+        ckpt = Checkpointer(ckpt_dir) if ckpt_dir else None
+        global_step = 0
+        params = None
+        try:
+            if ckpt is not None:
+                try:
+                    restored = ckpt.restore(cfg, rules)
+                except Exception as exc:  # orbax raises many types;
+                    #                       the JSON contract > the type
+                    checks["burnin_checkpoint_ok"] = False
+                    checks["checkpoint_error"] = f"restore: {exc}"
+                    return SmokeResult(
+                        False, checks, time.perf_counter() - t0)
+                if restored is not None:
+                    params, global_step, _meta = restored
+                    checks["burnin_resumed_step"] = global_step
+            if params is None:
+                params = init_params(jax.random.PRNGKey(0), cfg, rules)
+            step = make_train_step(cfg, rules)
+            batch = synthetic_batch(jax.random.PRNGKey(1), cfg, rules)
+            losses = []
+            for _ in range(5):
+                params, loss = step(params, batch)
+                losses.append(float(loss))
+                global_step += 1
+                if ckpt is not None:
+                    try:
+                        ckpt.save(global_step, params,
+                                  meta={"last_loss": losses[-1]})
+                    except Exception as exc:
+                        checks["burnin_checkpoint_ok"] = False
+                        checks["checkpoint_error"] = f"save: {exc}"
+                        ok = False
+                        break
+            if ckpt is not None and ok:
+                checks["burnin_checkpoint_saved"] = global_step
+            checks["burnin_first_loss"] = round(losses[0], 4)
+            checks["burnin_last_loss"] = round(losses[-1], 4)
+            checks["burnin_step"] = global_step
+            checks["burnin_ok"] = (
+                len(losses) == 5 and losses[-1] < losses[0])
+            ok &= checks["burnin_ok"]
+            if ckpt is not None and ok:
+                try:
+                    checks["burnin_checkpoint_cleared"] = ckpt.clear()
+                except Exception as exc:
+                    checks["burnin_checkpoint_ok"] = False
+                    checks["checkpoint_error"] = f"clear: {exc}"
+                    ok = False
+        finally:
+            if ckpt is not None:
+                ckpt.close()
 
     return SmokeResult(bool(ok), checks, time.perf_counter() - t0)

@@ -367,9 +367,16 @@ SCHEMAS.update({
                 "active_deadline_seconds backoff_limit "
                 "backoff_limit_per_index completion_mode completions "
                 "manual_selector max_failed_indexes parallelism "
-                "pod_failure_policy ttl_seconds_after_finished suspend",
+                "ttl_seconds_after_finished suspend",
                 blocks={
                     "selector": _bs(open=True),
+                    "pod_failure_policy": _bs(blocks={
+                        "rule": _bs("action", blocks={
+                            "on_pod_condition": _bs("status type"),
+                            "on_exit_codes": _bs(
+                                "container_name operator values"),
+                        }),
+                    }),
                     "template": _bs(blocks={
                         "metadata": _K8S_METADATA,
                         "spec": _K8S_POD_SPEC,

@@ -304,3 +304,115 @@ def test_runtime_values_yaml_not_set(tpu_mod):
     sels = set(vals["tpu"]["nodeSelectors"].split(","))
     assert sels == {"tpu-v4-podslice", "tpu-v5-lite-podslice"}
     assert "set" not in rel.attrs
+
+
+def test_smoketest_checkpoint_env(tpu_mod):
+    """smoketest.checkpoint_dir wires the resume env var AND a durable
+    mount; absent by default (no silent half-configured spot-resume path)."""
+    plan = simulate_plan(tpu_mod, dict(BASE))
+    job = plan.instance('kubernetes_job_v1.tpu_smoketest["default"]')
+    pod = job.attrs["spec"][0]["template"][0]["spec"][0]
+    env = {e["name"]: e["value"] for e in pod["container"][0]["env"]}
+    assert "TPU_SMOKETEST_CHECKPOINT_DIR" not in env
+    assert all(v.get("persistent_volume_claim") is None
+               for v in pod["volume"])
+
+    # local path: env + PVC volume mounted at the checkpoint path
+    plan = simulate_plan(tpu_mod, {
+        **BASE,
+        "smoketest": {"checkpoint_dir": "/ckpt",
+                      "checkpoint_pvc": "smoketest-ckpt"}})
+    job = plan.instance('kubernetes_job_v1.tpu_smoketest["default"]')
+    pod = job.attrs["spec"][0]["template"][0]["spec"][0]
+    env = {e["name"]: e["value"] for e in pod["container"][0]["env"]}
+    assert env["TPU_SMOKETEST_CHECKPOINT_DIR"] == "/ckpt"
+    mounts = {m["name"]: m["mount_path"]
+              for m in pod["container"][0]["volume_mount"]}
+    assert mounts["checkpoint"] == "/ckpt"
+    claims = [v["persistent_volume_claim"][0]["claim_name"]
+              for v in pod["volume"] if v.get("persistent_volume_claim")]
+    assert claims == ["smoketest-ckpt"]
+    # a multi-host world on one PVC needs RWX — advisory check fires
+    assert any("ReadWriteMany" in f for f in plan.check_failures)
+
+
+def test_smoketest_backoff_and_disruption_policy(tpu_mod):
+    """Resume must survive spot churn: checkpointing raises the default
+    retry budget and exempts DisruptionTarget evictions from it entirely;
+    the plain path keeps the tight budget and no policy."""
+    plan = simulate_plan(tpu_mod, dict(BASE))
+    spec = plan.instance(
+        'kubernetes_job_v1.tpu_smoketest["default"]').attrs["spec"][0]
+    assert spec["backoff_limit"] == 2
+    assert "pod_failure_policy" not in spec
+
+    plan = simulate_plan(tpu_mod, {
+        **BASE,
+        "smoketest": {"checkpoint_dir": "/ckpt",
+                      "checkpoint_pvc": "smoketest-ckpt"}})
+    spec = plan.instance(
+        'kubernetes_job_v1.tpu_smoketest["default"]').attrs["spec"][0]
+    assert spec["backoff_limit"] == 10
+    rule = spec["pod_failure_policy"][0]["rule"][0]
+    assert rule["action"] == "Ignore"
+    assert rule["on_pod_condition"][0]["type"] == "DisruptionTarget"
+
+    # explicit override wins over both defaults
+    plan = simulate_plan(tpu_mod, {
+        **BASE,
+        "smoketest": {"checkpoint_dir": "/ckpt",
+                      "checkpoint_pvc": "smoketest-ckpt",
+                      "backoff_limit": 4}})
+    spec = plan.instance(
+        'kubernetes_job_v1.tpu_smoketest["default"]').attrs["spec"][0]
+    assert spec["backoff_limit"] == 4
+
+    # gs:// needs no PVC (orbax/tensorstore writes object storage directly)
+    # but DOES need a package-bearing image's command — the bundled payload
+    # cannot write remote URIs
+    plan = simulate_plan(tpu_mod, {
+        **BASE,
+        "smoketest": {
+            "checkpoint_dir": "gs://bkt/ckpt",
+            "command": ["python", "-m",
+                        "nvidia_terraform_modules_tpu.smoketest"]}})
+    job = plan.instance('kubernetes_job_v1.tpu_smoketest["default"]')
+    container = job.attrs["spec"][0]["template"][0]["spec"][0]["container"][0]
+    env = {e["name"]: e["value"] for e in container["env"]}
+    assert env["TPU_SMOKETEST_CHECKPOINT_DIR"] == "gs://bkt/ckpt"
+    assert container["command"] == [
+        "python", "-m", "nvidia_terraform_modules_tpu.smoketest"]
+
+
+def test_smoketest_checkpoint_validations(tpu_mod):
+    """Misconfigurations that would silently never resume must fail at
+    plan time: local path without PVC, relative path, gs:// with a PVC,
+    gs:// with the bundled payload (which cannot write remote URIs)."""
+    import pytest
+
+    from nvidia_terraform_modules_tpu.tfsim import PlanError
+
+    with pytest.raises(PlanError, match="checkpoint_pvc"):
+        simulate_plan(tpu_mod, {
+            **BASE, "smoketest": {"checkpoint_dir": "/ckpt"}})
+    with pytest.raises(PlanError, match="ABSOLUTE"):
+        simulate_plan(tpu_mod, {
+            **BASE, "smoketest": {"checkpoint_dir": "ckpt",
+                                  "checkpoint_pvc": "pvc"}})
+    with pytest.raises(PlanError, match="non-gs"):
+        simulate_plan(tpu_mod, {
+            **BASE, "smoketest": {"checkpoint_dir": "gs://bkt/x",
+                                  "checkpoint_pvc": "pvc"}})
+    with pytest.raises(PlanError, match="bundled payload"):
+        simulate_plan(tpu_mod, {
+            **BASE, "smoketest": {"checkpoint_dir": "gs://bkt/x"}})
+
+
+def test_smoketest_deadline_matches_apply_gate(tpu_mod):
+    """The Job's in-cluster deadline equals the wait_for_completion budget:
+    a timed-out apply must not leave an immortal Job burning spot quota."""
+    plan = simulate_plan(tpu_mod, dict(BASE))
+    job = plan.instance('kubernetes_job_v1.tpu_smoketest["default"]')
+    deadline = job.attrs["spec"][0]["active_deadline_seconds"]
+    assert deadline == 1320  # 1200 + 60 × 2 hosts
+    assert job.attrs["timeouts"][0]["create"] == f"{deadline}s"

@@ -146,3 +146,67 @@ def test_package_runner_two_hosts(tmp_path):
         assert verdict["ok"] is True
         assert verdict["devices"] == 8
         assert verdict["psum_participants"] == 8
+
+
+@pytest.mark.slow
+def test_standalone_script_burnin_resume(tmp_path):
+    """Spot-preemption contract for the bundled payload: a checkpoint left
+    by a preempted attempt resumes the global step; success clears it so a
+    later fresh Job starts at 0; a corrupt file fails via JSON, not a
+    traceback."""
+    import numpy as np
+
+    script = os.path.join(ROOT, "gke-tpu", "scripts", "tpu_smoketest.py")
+    env = dict(os.environ)
+    env.update(
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        JAX_PLATFORMS="cpu",
+        TPU_SMOKETEST_LEVEL="burnin",
+        TPU_SMOKETEST_CHECKPOINT_DIR=str(tmp_path),
+    )
+    ckpt = tmp_path / "burnin_p0.npz"
+
+    def attempt(expect_rc=0):
+        p = subprocess.run(
+            [sys.executable, "-c", BOOTSTRAP.format(script=script)],
+            env=env, cwd=ROOT, capture_output=True, text=True, timeout=240)
+        assert p.returncode == expect_rc, p.stdout + p.stderr[-2000:]
+        return _verdict(p.stdout)
+
+    # fresh run: per-step saves, then cleared on success
+    first = attempt()
+    assert first["ok"] and first["burnin_step"] == 5
+    assert first["burnin_checkpoint_saved"] == 5
+    assert first["burnin_checkpoint_cleared"] == 1
+    assert "burnin_resumed_step" not in first
+    assert not ckpt.exists()
+
+    # preempted run left a checkpoint behind → resume continues the count;
+    # an orphaned mid-save tmp file (preemption between savez and replace)
+    # must be swept, not accumulate on the PVC
+    rng = np.random.default_rng(0)
+    np.savez(ckpt, w=rng.normal(size=(256, 256)).astype(np.float32), step=3)
+    orphan = tmp_path / "burnin_p0.npz.tmp.npz"
+    orphan.write_bytes(b"half-written")
+    second = attempt()
+    assert second["ok"]
+    assert second["burnin_resumed_step"] == 3
+    assert second["burnin_step"] == 8
+    assert not ckpt.exists()
+    assert not orphan.exists()
+
+    # corrupt checkpoint: JSON verdict with the error, exit 1, no traceback
+    ckpt.write_bytes(b"not a zipfile")
+    bad = attempt(expect_rc=1)
+    assert bad["ok"] is False
+    assert bad["burnin_checkpoint_ok"] is False
+    assert "restore" in bad["checkpoint_error"]
+    ckpt.unlink()
+
+    # remote URI: the bundle must refuse loudly (it would otherwise write
+    # to a literal local ./gs:/… directory on ephemeral disk)
+    env["TPU_SMOKETEST_CHECKPOINT_DIR"] = "gs://bkt/ckpt"
+    remote = attempt(expect_rc=1)
+    assert remote["ok"] is False
+    assert remote["burnin_checkpoint_ok"] is False
+    assert "remote URI" in remote["checkpoint_error"]
