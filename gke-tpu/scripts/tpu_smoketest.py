@@ -224,7 +224,17 @@ def main() -> int:
                     os.remove(ckpt_path + ".tmp.npz")
                 if os.path.exists(ckpt_path):
                     data = np.load(ckpt_path)
-                    w = jnp.asarray(data["w"])
+                    w_loaded = data["w"]
+                    # a stale file from a different script revision loads
+                    # cleanly but would crash the jitted step with a bare
+                    # shape TypeError — keep it inside the JSON contract
+                    if w_loaded.shape != w.shape or \
+                            w_loaded.dtype != w.dtype:
+                        raise ValueError(
+                            f"stale checkpoint: w is "
+                            f"{w_loaded.dtype}{w_loaded.shape}, expected "
+                            f"{w.dtype}{tuple(w.shape)}")
+                    w = jnp.asarray(w_loaded)
                     global_step = int(data["step"])
                     out["burnin_resumed_step"] = global_step
         except Exception as exc:

@@ -153,11 +153,23 @@ class Checkpointer:
         Called after a run *succeeds*: the burn-in is validated, resume
         state is no longer needed, and leaving it behind would make the
         next fresh Job silently continue a finished run's step count.
+
+        Multi-host discipline: ``mgr.delete`` is collective (it contains a
+        global-process barrier), so every process must issue the same
+        delete sequence. Each process snapshots the step list, then a
+        barrier ensures all snapshots happened *before* any deletion
+        mutates the shared directory — without it, a process listing late
+        would see fewer steps, skip a delete, and leave its peers hanging
+        in orbax's barrier until the coordination timeout.
         """
         if _no_checkpoint_possible(self.directory):
             return 0
         mgr = self._manager()
         steps = list(mgr.all_steps())
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("checkpointer_clear_snapshot")
         for s in steps:
             mgr.delete(s)
         return len(steps)

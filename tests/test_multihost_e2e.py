@@ -129,15 +129,20 @@ def test_standalone_script_bad_slice_config_fails():
         assert "slices_error" in verdict
 
 
-@pytest.mark.slow
-def test_package_runner_two_hosts(tmp_path):
-    # drive the installable package runner the same way
+def _pkg_runner(tmp_path):
     runner = tmp_path / "run_pkg.py"
     runner.write_text(
-        "import sys, os; sys.path.insert(0, r'%s')\n"
+        "import sys; sys.path.insert(0, r'%s')\n"
         "from nvidia_terraform_modules_tpu.smoketest.__main__ import main\n"
         "sys.exit(main())\n" % ROOT
     )
+    return str(runner)
+
+
+@pytest.mark.slow
+def test_package_runner_two_hosts(tmp_path):
+    # drive the installable package runner the same way
+    runner = _pkg_runner(tmp_path)
     results = _run_pair(str(runner), {"TPU_SMOKETEST_LEVEL": "psum"}, port=8492)
     for rc, out, err in results:
         assert rc == 0, f"stdout={out!r}\nstderr={err[-2000:]!r}"
@@ -203,6 +208,15 @@ def test_standalone_script_burnin_resume(tmp_path):
     assert "restore" in bad["checkpoint_error"]
     ckpt.unlink()
 
+    # stale checkpoint from a different script revision (wrong shape):
+    # loads cleanly, so shape validation must catch it inside the contract
+    np.savez(ckpt, w=rng.normal(size=(128, 128)).astype(np.float32), step=2)
+    stale = attempt(expect_rc=1)
+    assert stale["ok"] is False
+    assert stale["burnin_checkpoint_ok"] is False
+    assert "stale checkpoint" in stale["checkpoint_error"]
+    ckpt.unlink()
+
     # remote URI: the bundle must refuse loudly (it would otherwise write
     # to a literal local ./gs:/… directory on ephemeral disk)
     env["TPU_SMOKETEST_CHECKPOINT_DIR"] = "gs://bkt/ckpt"
@@ -210,3 +224,66 @@ def test_standalone_script_burnin_resume(tmp_path):
     assert remote["ok"] is False
     assert remote["burnin_checkpoint_ok"] is False
     assert "remote URI" in remote["checkpoint_error"]
+
+
+# a 2-process "preempted attempt": jax.distributed world that collectively
+# saves a step-3 checkpoint and exits WITHOUT clearing — exactly the state a
+# preemption leaves behind for the next Job attempt to resume from
+_SEED_SCRIPT = """
+import os, sys
+sys.path.insert(0, r'%s')
+from nvidia_terraform_modules_tpu.parallel import (
+    build_mesh, make_rules, maybe_initialize_distributed, plan_mesh)
+maybe_initialize_distributed(os.environ)
+import jax
+from nvidia_terraform_modules_tpu.models import (
+    BurnInConfig, Checkpointer, init_params)
+rules = make_rules(build_mesh(plan_mesh(len(jax.devices()))))
+cfg = BurnInConfig(batch=8)
+with Checkpointer(os.environ["TPU_SMOKETEST_CHECKPOINT_DIR"]) as c:
+    c.save(3, init_params(jax.random.PRNGKey(0), cfg, rules))
+print('{"seeded": 3}')
+""" % ROOT
+
+
+@pytest.mark.slow
+def test_package_runner_burnin_checkpoint_two_hosts(tmp_path):
+    """The orbax path in a real 2-process jax.distributed world: a fresh
+    pair saves collectively (each host writes only its shards) and clears
+    on success; a second pair resumes from a collectively-seeded step-3
+    checkpoint and continues the count."""
+    runner = _pkg_runner(tmp_path)
+    ckpt = tmp_path / "ckpt"
+    env = {"TPU_SMOKETEST_LEVEL": "burnin",
+           "TPU_SMOKETEST_CHECKPOINT_DIR": str(ckpt)}
+
+    # fresh pair: per-step collective saves, cleared on success
+    results = _run_pair(runner, env, port=8495)
+    for rc, out, err in results:
+        assert rc == 0, f"stdout={out!r}\nstderr={err[-2000:]!r}"
+        verdict = _verdict(out)
+        assert verdict["ok"] is True
+        assert verdict["burnin_step"] == 5
+        assert verdict["burnin_checkpoint_saved"] == 5
+        assert "burnin_resumed_step" not in verdict
+    # clear() snapshots the step list on every process BEFORE any delete
+    # (lockstep barrier), so both report the full retained count: 2 steps
+    # (max_to_keep=2 after 5 per-step saves), and the directory is empty
+    cleared = {_verdict(out)["process_id"]: _verdict(out).get(
+        "burnin_checkpoint_cleared") for _, out, _ in results}
+    assert cleared == {0: 2, 1: 2}
+    assert not ckpt.exists() or not any(
+        p.is_dir() and p.name.isdigit() for p in ckpt.iterdir())
+
+    # preempted pair left a step-3 checkpoint → the next pair resumes it
+    seed = tmp_path / "seed_ckpt.py"
+    seed.write_text(_SEED_SCRIPT)
+    for rc, out, err in _run_pair(str(seed), env, port=8496):
+        assert rc == 0, f"stdout={out!r}\nstderr={err[-2000:]!r}"
+    results = _run_pair(runner, env, port=8497)
+    for rc, out, err in results:
+        assert rc == 0, f"stdout={out!r}\nstderr={err[-2000:]!r}"
+        verdict = _verdict(out)
+        assert verdict["ok"] is True
+        assert verdict["burnin_resumed_step"] == 3
+        assert verdict["burnin_step"] == 8
