@@ -3,6 +3,18 @@
 # (/root/reference/eks/examples/cnpack/Readme.md:49-94), plus the TPU metric
 # names GKE exports for the provisioned slice.
 
+locals {
+  # single source for values that appear both as standalone outputs and
+  # inside the rendered platform_config — one edit point, no desync
+  prometheus_ksa_annotation = "iam.gke.io/gcp-service-account: ${google_service_account.prometheus.email}"
+  tpu_metric_types = [
+    "kubernetes.io/node/accelerator/duty_cycle",
+    "kubernetes.io/node/accelerator/memory_used",
+    "kubernetes.io/node/accelerator/memory_total",
+    "kubernetes.io/container/accelerator/tensorcore_utilization",
+  ]
+}
+
 output "cluster_name" {
   description = "Name of the TPU cluster."
   value       = module.tpu_cluster.cluster_name
@@ -15,7 +27,7 @@ output "prometheus_service_account_email" {
 
 output "prometheus_ksa_annotation" {
   description = "Ready-to-paste Workload Identity annotation for the monitoring KSA."
-  value       = "iam.gke.io/gcp-service-account: ${google_service_account.prometheus.email}"
+  value       = local.prometheus_ksa_annotation
 }
 
 output "monitoring_namespace" {
@@ -30,12 +42,7 @@ output "tpu_slices" {
 
 output "tpu_metric_types" {
   description = "GKE system metrics exported for TPU nodes; use in dashboards/alerts."
-  value = [
-    "kubernetes.io/node/accelerator/duty_cycle",
-    "kubernetes.io/node/accelerator/memory_used",
-    "kubernetes.io/node/accelerator/memory_total",
-    "kubernetes.io/container/accelerator/tensorcore_utilization",
-  ]
+  value       = local.tpu_metric_types
 }
 
 output "ca_pool" {
@@ -61,4 +68,58 @@ output "fluentbit_service_account_email" {
 output "log_bucket" {
   description = "Dedicated Cloud Logging bucket receiving cluster logs."
   value       = var.fluentbit_enabled ? google_logging_project_bucket_config.cnpack[0].bucket_id : null
+}
+
+# ------------------------------------------------------------------ handoff
+# The reference ends with a HUMAN step: copy ~10 terraform outputs into an
+# NvidiaPlatform YAML and feed it to the external `cnpack` binary
+# (/root/reference/eks/examples/cnpack/Readme.md:49-105). Render the whole
+# installer config instead — `terraform output -raw platform_config_yaml`
+# is the entire handoff, no transcription errors possible.
+
+locals {
+  platform_config = {
+    apiVersion = "tpu.nvidia-terraform-modules/v1"
+    kind       = "TpuPlatform"
+    metadata = {
+      name = module.tpu_cluster.cluster_name
+    }
+    spec = {
+      cluster = {
+        name     = module.tpu_cluster.cluster_name
+        location = module.tpu_cluster.cluster_location
+        project  = var.project_id
+      }
+      monitoring = {
+        namespace           = local.monitoring_namespace
+        serviceAccountEmail = google_service_account.prometheus.email
+        ksaAnnotation       = local.prometheus_ksa_annotation
+        tpuMetricTypes      = local.tpu_metric_types
+      }
+      certManager = var.private_ca_enabled ? {
+        casIssuer = {
+          caPool              = google_privateca_ca_pool.cnpack[0].name
+          caResourceName      = google_privateca_certificate_authority.cnpack[0].id
+          serviceAccountEmail = google_service_account.cas_issuer[0].email
+        }
+      } : null
+      logging = var.fluentbit_enabled ? {
+        fluentbit = {
+          serviceAccountEmail = google_service_account.fluentbit[0].email
+          logBucket           = google_logging_project_bucket_config.cnpack[0].bucket_id
+        }
+      } : null
+      slices = module.tpu_cluster.tpu_slices
+    }
+  }
+}
+
+output "platform_config" {
+  description = "Structured platform installer config (the automated NvidiaPlatform handoff)."
+  value       = local.platform_config
+}
+
+output "platform_config_yaml" {
+  description = "Same config rendered for the installer: terraform output -raw platform_config_yaml > platform.yaml"
+  value       = yamlencode(local.platform_config)
 }

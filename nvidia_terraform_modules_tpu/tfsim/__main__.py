@@ -34,6 +34,7 @@ from .lockfile import LockfileError, check_lockfile, write_lockfile
 from .module import load_module
 from .plan import PlanError, load_tfvars, render, simulate_plan, to_dot
 from .state import (
+    COMPUTED_STR,
     State,
     apply_plan,
     diff,
@@ -168,8 +169,32 @@ def cmd_output(args) -> int:
             print(f"Error: output {args.name!r} not found in state",
                   file=sys.stderr)
             return 1
-        print(json.dumps(state.outputs[args.name]["value"], sort_keys=True))
+        value = state.outputs[args.name]["value"]
+        if args.raw:
+            # terraform semantics: -raw prints the bare string for piping
+            # (`output -raw platform_config_yaml > platform.yaml`) and
+            # refuses non-string values. The simulator's computed
+            # placeholder must refuse too — piping "<computed>" into
+            # platform.yaml would be silent garbage
+            if value == COMPUTED_STR:
+                print(f"Error: output {args.name!r} is provider-computed "
+                      f"(known after a real apply); the simulator cannot "
+                      f"render it", file=sys.stderr)
+                return 1
+            if not isinstance(value, (str, int, float, bool)):
+                print(f"Error: -raw requires a string/number/bool output, "
+                      f"{args.name!r} is {type(value).__name__}",
+                      file=sys.stderr)
+                return 1
+            # no trailing newline, matching `terraform output -raw`
+            sys.stdout.write(
+                value if isinstance(value, str) else json.dumps(value))
+            return 0
+        print(json.dumps(value, sort_keys=True))
         return 0
+    if args.raw:
+        print("Error: -raw requires an output NAME", file=sys.stderr)
+        return 1
     if args.json:
         print(json.dumps(state.outputs, indent=2, sort_keys=True))
         return 0
@@ -355,6 +380,7 @@ def main(argv: list[str] | None = None) -> int:
     o.add_argument("name", nargs="?", default=None)
     o.add_argument("-state", required=True)
     o.add_argument("-json", action="store_true")
+    o.add_argument("-raw", action="store_true")
     o.set_defaults(fn=cmd_output)
 
     st = sub.add_parser("state")

@@ -29,7 +29,38 @@ def _fn_cidrsubnet(prefix: str, newbits: int, netnum: int) -> str:
     return str(subnets[int(netnum)])
 
 
-def _fn_format(fmt: str, *args: Any) -> str:
+def _join(sep: str, items: list) -> Any:
+    """join() with terraform's unknown propagation: a computed element
+    anywhere makes the whole string computed — otherwise the _Computed
+    repr would be baked into a "known" plan value."""
+    from .eval import COMPUTED, is_computed  # lazy: eval imports functions
+
+    if is_computed(items):
+        return COMPUTED
+    return sep.join(_to_string(x) for x in items)
+
+
+def _encode_json(v: Any):
+    """jsonencode/yamlencode with terraform's unknown propagation: a
+    computed value ANYWHERE in the structure makes the whole encoding
+    computed at plan time (the encoder can't leave a hole mid-string).
+    ``_eval_Call`` only short-circuits top-level COMPUTED args, so the
+    deep check lives here."""
+    from .eval import COMPUTED, is_computed  # lazy: eval imports functions
+
+    if is_computed(v):
+        return COMPUTED
+    return json.dumps(v, separators=(",", ":"))
+
+
+def _fn_format(fmt: str, *args: Any) -> Any:
+    from .eval import COMPUTED, is_computed  # lazy: eval imports functions
+
+    if any(is_computed(a) for a in args):
+        # a computed value nested in a container arg (%v of a list) would
+        # otherwise bake the _Computed repr into a "known" string;
+        # top-level COMPUTED args are short-circuited by _eval_Call
+        return COMPUTED
     out, ai = [], 0
     i = 0
     while i < len(fmt):
@@ -156,9 +187,9 @@ FUNCTIONS: dict[str, Any] = {
     "endswith": lambda s, suf: str(s).endswith(suf),
     "flatten": lambda l: _flatten(l),
     "format": _fn_format,
-    "join": lambda sep, l: sep.join(_to_string(x) for x in l),
+    "join": lambda sep, l: _join(sep, l),
     "jsondecode": json.loads,
-    "jsonencode": lambda v: json.dumps(v, separators=(",", ":")),
+    "jsonencode": lambda v: _encode_json(v),
     "keys": lambda m: sorted(m.keys()),
     "length": len,
     "lower": lambda s: str(s).lower(),
@@ -196,7 +227,7 @@ FUNCTIONS: dict[str, Any] = {
     "values": lambda m: [m[k] for k in sorted(m.keys())],
     # JSON is a subset of YAML; emitting it keeps tfsim dependency-free and
     # Helm/K8s consumers parse it identically
-    "yamlencode": lambda v: json.dumps(v, separators=(",", ":")),
+    "yamlencode": lambda v: _encode_json(v),  # JSON ⊂ YAML: valid either way
     "yamldecode": json.loads,
     "zipmap": lambda ks, vs: dict(zip(ks, vs)),
 }

@@ -269,3 +269,27 @@ def test_state_usage_errors(tmp_path, capsys):
     assert main(["state", "mv", "a.b", "-state", state]) == 2
     assert main(["state", "rm", "-state", state]) == 2
     assert "address argument" in capsys.readouterr().err
+
+
+def test_output_raw(tmp_path, capsys):
+    """-raw prints the bare string for piping (the platform.yaml handoff)
+    and refuses structured values, terraform-style."""
+    state = str(tmp_path / "s.json")
+    assert main(["apply", GKE_TPU, "-state", state] + VARS) == 0
+    capsys.readouterr()
+    assert main(["output", "-state", state, "-raw", "cluster_name"]) == 0
+    assert capsys.readouterr().out == "c"   # bare, no newline (terraform -raw)
+    assert main(["output", "-state", state, "-raw", "tpu_slices"]) == 1
+    assert "-raw requires" in capsys.readouterr().err
+    assert main(["output", "-state", state, "-raw"]) == 1
+    assert "requires an output NAME" in capsys.readouterr().err
+
+
+def test_output_raw_refuses_computed(tmp_path, capsys):
+    """Piping '<computed>' into platform.yaml would be silent garbage."""
+    state = str(tmp_path / "s.json")
+    assert main(["apply", GKE_TPU, "-state", state] + VARS) == 0
+    capsys.readouterr()
+    assert main(["output", "-state", state, "-raw",
+                 "latest_version_per_channel"]) == 1
+    assert "known after a real apply" in capsys.readouterr().err

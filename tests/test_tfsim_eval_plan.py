@@ -200,3 +200,46 @@ def test_tfvars_loading(tmp_path):
     p.write_text('name = "x"\nzones = ["a", "b"]\ncount_map = { tpu = 4 }\n')
     assert load_tfvars(str(p)) == {
         "name": "x", "zones": ["a", "b"], "count_map": {"tpu": 4}}
+
+
+def test_string_builders_propagate_unknown(tmp_path):
+    """join/jsonencode/yamlencode over a structure with a computed leaf
+    yield COMPUTED, terraform-style — never a string with the _Computed
+    repr baked in."""
+    import textwrap
+
+    from nvidia_terraform_modules_tpu.tfsim import simulate_plan
+    from nvidia_terraform_modules_tpu.tfsim.eval import is_computed
+
+    (tmp_path / "main.tf").write_text(textwrap.dedent("""
+        resource "google_compute_network" "n" {
+          name = "x"
+        }
+
+        output "joined" {
+          value = join(",", ["a", google_compute_network.n.id])
+        }
+
+        output "encoded" {
+          value = jsonencode({ nested = { id = google_compute_network.n.id } })
+        }
+
+        output "yaml" {
+          value = yamlencode([google_compute_network.n.id])
+        }
+
+        output "known_join" {
+          value = join("-", ["a", "b"])
+        }
+
+        output "formatted" {
+          value = format("pools: %v", [google_compute_network.n.id])
+        }
+    """))
+    plan = simulate_plan(str(tmp_path), {})
+    assert is_computed(plan.outputs["joined"])
+    assert is_computed(plan.outputs["encoded"])
+    assert is_computed(plan.outputs["yaml"])
+    assert is_computed(plan.outputs["formatted"])
+    assert plan.outputs["known_join"] == "a-b"
+    assert "<computed>" not in str(plan.outputs["known_join"])
