@@ -409,4 +409,17 @@ def main(argv: list[str] | None = None) -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        code = main()
+        # flush INSIDE the try: with block-buffered stdout the EPIPE often
+        # only surfaces at flush time — deferring it to interpreter
+        # shutdown would escape this handler
+        sys.stdout.flush()
+    except BrokenPipeError:
+        # the downstream consumer (`tfsim output ... | head`) closed the
+        # pipe — shell convention, not an error worth a traceback. Redirect
+        # stdout to devnull so interpreter shutdown doesn't re-raise on
+        # flush, and exit 141 (128 + SIGPIPE) like a signal-killed process.
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(141)
+    sys.exit(code)

@@ -293,3 +293,26 @@ def test_output_raw_refuses_computed(tmp_path, capsys):
     assert main(["output", "-state", state, "-raw",
                  "latest_version_per_channel"]) == 1
     assert "known after a real apply" in capsys.readouterr().err
+
+
+def test_cli_survives_broken_pipe(tmp_path):
+    """`tfsim output | head` must exit 141 (SIGPIPE convention), never
+    traceback — the handoff pipeline pipes these commands routinely.
+    PYTHONUNBUFFERED forces write-through stdout so the EPIPE
+    deterministically fires (block-buffered small output would fit the
+    pipe buffer and never trip); PIPESTATUS reads tfsim's own exit code
+    rather than head's."""
+    import subprocess
+    import sys as _sys
+
+    state = str(tmp_path / "s.json")
+    assert main(["apply", GKE_TPU, "-state", state] + VARS) == 0
+    p = subprocess.run(
+        ["bash", "-c",
+         f"{_sys.executable} -m nvidia_terraform_modules_tpu.tfsim output "
+         f"-state {state} | head -c 5; exit ${{PIPESTATUS[0]}}"],
+        capture_output=True, text=True,
+        env={**os.environ, "PYTHONUNBUFFERED": "1"},
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert "Traceback" not in p.stderr, p.stderr
+    assert p.returncode == 141, (p.returncode, p.stderr)
