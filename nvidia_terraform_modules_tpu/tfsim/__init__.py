@@ -24,13 +24,19 @@ style gate.
 from .parser import parse_hcl, HclParseError  # noqa: F401
 from .module import Module, load_module  # noqa: F401
 from .validate import validate_module, Finding  # noqa: F401
-from .plan import simulate_plan, Plan, PlanError  # noqa: F401
+from .plan import (  # noqa: F401
+    Plan,
+    PlanError,
+    select_targets,
+    simulate_plan,
+)
 from .destroy import simulate_destroy, DestroyPlan, DestroyHazard  # noqa: F401
 from .state import (  # noqa: F401
     State,
     Diff,
     apply_plan,
     diff,
+    import_resource,
     migrate_state,
     state_mv,
     state_rm,

@@ -7,8 +7,9 @@ terraform binary in CI, so tfsim ships the same verbs offline::
 
     python -m nvidia_terraform_modules_tpu.tfsim validate gke-tpu
     python -m nvidia_terraform_modules_tpu.tfsim plan gke-tpu -var project_id=p \
-        -var cluster_name=c [-state terraform.tfstate.json] [-json]
-    python -m nvidia_terraform_modules_tpu.tfsim apply gke-tpu ... -state f
+        -var cluster_name=c [-state terraform.tfstate.json] [-json] [-target ADDR]
+    python -m nvidia_terraform_modules_tpu.tfsim apply gke-tpu ... -state f [-target ADDR]
+    python -m nvidia_terraform_modules_tpu.tfsim import gke-tpu ADDR ID -state f ...
     python -m nvidia_terraform_modules_tpu.tfsim destroy gke-tpu ...
     python -m nvidia_terraform_modules_tpu.tfsim output -state f [NAME] [-json]
     python -m nvidia_terraform_modules_tpu.tfsim state list|show|rm|mv ... -state f
@@ -38,6 +39,7 @@ from .state import (
     State,
     apply_plan,
     diff,
+    import_resource,
     migrate_state,
     state_mv,
     state_rm,
@@ -98,10 +100,10 @@ def _plan_against_state(args):
 def cmd_plan(args) -> int:
     try:
         plan, prior = _plan_against_state(args)
+        d = diff(plan, prior, getattr(args, "target", None))
     except (PlanError, ValueError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
         return 1
-    d = diff(plan, prior)
     if args.json:
         print(json.dumps({
             "actions": d.actions,
@@ -134,11 +136,12 @@ def cmd_plan(args) -> int:
 def cmd_apply(args) -> int:
     try:
         plan, prior = _plan_against_state(args)
+        targets = getattr(args, "target", None)
+        d = diff(plan, prior, targets)
+        state = apply_plan(plan, prior, targets, d=d)
     except (PlanError, ValueError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
         return 1
-    d = diff(plan, prior)
-    state = apply_plan(plan, prior)
     if args.state:
         with open(args.state, "w") as fh:
             fh.write(state.to_json())
@@ -275,6 +278,27 @@ def cmd_state(args) -> int:
     raise SystemExit(f"unknown state subcommand {args.subcmd!r}")
 
 
+def cmd_import(args) -> int:
+    """``terraform import DIR ADDR ID``: adopt a live resource into state."""
+    if not args.state:
+        print("Error: import requires -state (the file to adopt into)",
+              file=sys.stderr)
+        return 2
+    try:
+        # same path as plan/apply — including moved{} migration: importing
+        # a rename destination against un-migrated state would wedge the
+        # statefile at the next plan ("destination already exists")
+        plan, prior = _plan_against_state(args)
+        state = import_resource(prior, plan, args.address, args.id)
+    except (PlanError, ValueError) as ex:
+        print(f"Error: {ex}", file=sys.stderr)
+        return 1
+    with open(args.state, "w") as fh:
+        fh.write(state.to_json())
+    print(f"{args.address}: Import prepared. Resource written to state.")
+    return 0
+
+
 def cmd_destroy(args) -> int:
     try:
         d = simulate_destroy(args.dir, _gather_vars(args))
@@ -372,9 +396,14 @@ def main(argv: list[str] | None = None) -> int:
     c = add_module_cmd("plan", cmd_plan, state=True)
     c.add_argument("-json", action="store_true")
     c.add_argument("-show-noop", action="store_true")
-    add_module_cmd("apply", cmd_apply, state=True)
+    c.add_argument("-target", action="append", dest="target")
+    a = add_module_cmd("apply", cmd_apply, state=True)
+    a.add_argument("-target", action="append", dest="target")
     add_module_cmd("destroy", cmd_destroy)
     add_module_cmd("graph", cmd_graph)
+    imp = add_module_cmd("import", cmd_import, state=True)
+    imp.add_argument("address")
+    imp.add_argument("id")
 
     o = sub.add_parser("output")
     o.add_argument("name", nargs="?", default=None)

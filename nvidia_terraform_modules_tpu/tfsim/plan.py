@@ -542,6 +542,130 @@ def _toposort(deps: dict[str, set[str]]) -> list[str]:
     return order
 
 
+def instance_node(iaddr: str) -> str:
+    """Instance address → its graph node (``module.x.res[0]`` → ``module.x``,
+    ``type.name["k"]`` → ``type.name``)."""
+    if iaddr.startswith("module."):
+        return ".".join(iaddr.split(".")[:2]).split("[")[0]
+    return iaddr.split("[")[0]
+
+
+def select_targets(plan: Plan, targets: list[str],
+                   instances=None) -> set[str]:
+    """Instance addresses covered by ``-target`` flags, terraform-style.
+
+    Each target names a node (``google_x.y``, ``module.m``) or a single
+    instance (``google_x.y["k"]``); the selection is that target plus the
+    transitive closure of everything it depends on. Dependencies are
+    node-level (matching terraform: a depended-on resource is included
+    whole), while a bracketed leaf target keeps only its own instance.
+    ``instances`` widens the candidate universe beyond the plan's own
+    (the diff passes planned ∪ prior so targeted deletes of
+    removed-from-config instances select too). Raises :class:`PlanError`
+    for a target matching nothing in the configuration.
+    """
+    universe = plan.instances if instances is None else instances
+
+    kept: set[str] = set()
+    for t in targets:
+        selected = _select_one(plan, t, universe, "")
+        if "[" in t and not any(_under(i, t) for i in universe):
+            # a bracketed key that matches no live instance is a typo —
+            # erroring beats silently applying only the dependency
+            # closure. (An unbracketed target of a count=0/empty-for_each
+            # resource is legal and simply selects nothing, matching
+            # terraform; config-existence is checked in _select_one.)
+            raise PlanError(
+                f"target {t!r} matches no resource instance in the "
+                f"configuration or state")
+        kept |= selected
+    return kept
+
+
+def _under(iaddr: str, t: str) -> bool:
+    """iaddr is the target itself, an instance of it, or inside it."""
+    return iaddr == t or iaddr.startswith(t + "[") or \
+        iaddr.startswith(t + ".")
+
+
+def _select_one(plan: Plan, t: str, universe, prefix: str) -> set[str]:
+    """Instances selected by ONE target, relative to ``plan``.
+
+    ``t`` is the target path relative to this plan; ``prefix`` maps this
+    plan's addresses back into the root universe (``"module.m."`` when
+    recursing). Dependency closure runs over this plan's edges; a target
+    that descends into a local child module recurses so in-module
+    dependencies are honoured too.
+    """
+    deps: dict[str, set[str]] = {}
+    for frm, to in plan.edges:
+        deps.setdefault(frm, set()).add(to)
+
+    node = instance_node(t)
+    if node not in plan.order:
+        # fully removed from config: terraform still plans a targeted
+        # destroy for the state-only addresses (the universe carries
+        # prior state when called from diff)
+        prior_hits = {i for i in universe if _under(i, prefix + t)}
+        if not prior_hits:
+            raise PlanError(
+                f"target {prefix + t!r} matches no resource in the "
+                f"configuration or state")
+        return prior_hits
+
+    closure: set[str] = set()
+    work = [node]
+    while work:
+        n = work.pop()
+        if n in closure:
+            continue
+        closure.add(n)
+        work.extend(deps.get(n, ()))
+
+    kept: set[str] = set()
+    for iaddr in universe:
+        rel = iaddr[len(prefix):] if iaddr.startswith(prefix) else None
+        if rel is None:
+            continue
+        inode = instance_node(rel)
+        if inode not in closure:
+            continue
+        if inode == node and t != node:
+            # target is more specific than its node: a bracketed instance
+            # keeps only itself; a module-inner path recurses below
+            continue
+        kept.add(iaddr)
+
+    if t != node and node.startswith("module."):
+        # descend: module.m.google_x.y selects that resource plus its
+        # dependencies WITHIN the child module (child edges), not the
+        # module's unrelated resources. On an expanded module
+        # (count/for_each), module.m[0].res targets one instance and the
+        # index-less module.m.res targets the resource in EVERY instance
+        # (terraform's accepted all-instances form).
+        matched = False
+        for key, child in plan.child_plans.items():
+            if instance_node(key) != node:
+                continue
+            if t.startswith(key + "."):
+                inner = t[len(key) + 1:]
+            elif key != node and t.startswith(node + ".") and \
+                    not t.startswith(node + "["):
+                inner = t[len(node) + 1:]
+            else:
+                continue
+            kept |= _select_one(child, inner, universe, prefix + key + ".")
+            matched = True
+        if not matched:
+            # module.m[0] as a whole, or a registry-stub module with no
+            # child plan: the whole subtree
+            kept |= {i for i in universe if _under(i, prefix + t)}
+    elif t != node:
+        # bracketed resource instance (res["k"]): just that subtree
+        kept |= {i for i in universe if _under(i, prefix + t)}
+    return kept
+
+
 def to_dot(plan: Plan) -> str:
     """Render the dependency DAG as GraphViz DOT (``terraform graph``).
 

@@ -116,10 +116,27 @@ def _rendered_instances(plan: Plan) -> dict[str, Any]:
             if not _is_data(addr)}
 
 
-def diff(plan: Plan, state: State | None) -> Diff:
-    """What ``terraform apply`` would do to ``state`` to realise ``plan``."""
+def diff(plan: Plan, state: State | None,
+         targets: list[str] | None = None) -> Diff:
+    """What ``terraform apply`` would do to ``state`` to realise ``plan``.
+
+    With ``targets``, only the targeted instances (plus their dependency
+    closure — see :func:`..plan.select_targets`) appear in the diff;
+    everything else is left exactly as-is, matching ``terraform plan
+    -target``'s surgical scope (including skipping deletes of
+    non-targeted state entries).
+    """
+    from .plan import select_targets
+
     planned = _rendered_instances(plan)
     prior = dict(state.resources) if state else {}
+    keep = None
+    if targets:
+        # universe includes prior-only addresses so a targeted resource
+        # whose instance left the config still diffs as a delete
+        keep = select_targets(plan, targets,
+                              set(planned) | set(prior))
+        planned = {a: v for a, v in planned.items() if a in keep}
     actions: dict[str, str] = {}
     changed: dict[str, list[str]] = {}
     for addr, attrs in planned.items():
@@ -136,7 +153,7 @@ def diff(plan: Plan, state: State | None) -> Diff:
         else:
             actions[addr] = "no-op"
     for addr in prior:
-        if addr not in planned:
+        if addr not in planned and (keep is None or addr in keep):
             actions[addr] = "delete"
     return Diff(actions=actions, changed_keys=changed)
 
@@ -256,15 +273,59 @@ def state_mv(state: State, src: str,
                  outputs=state.outputs), renames
 
 
-def apply_plan(plan: Plan, state: State | None = None) -> State:
+def import_resource(state: State | None, plan: Plan, addr: str,
+                    resource_id: str) -> State:
+    """``terraform import``: adopt an existing cloud resource into state.
+
+    Terraform 1.x requires a matching configuration block before import;
+    the simulator enforces the same and seeds the state entry from the
+    planned attributes (the provider would fill the real ones), with the
+    operator-supplied ``resource_id`` as ``id`` — so the follow-up plan is
+    a no-op, exactly the healthy import-then-plan cycle. Raises
+    ``ValueError`` when the address is already tracked or has no
+    configuration.
+    """
+    state = state or State()
+    if _is_data(addr):
+        raise ValueError(
+            f"import: {addr!r} is a data source — data is read every "
+            f"plan, never imported (terraform semantics)")
+    if addr in state.resources:
+        raise ValueError(f"import: {addr!r} already managed in state")
+    if addr not in plan.instances:
+        instances = sorted(a for a in plan.instances
+                           if a.startswith(addr + "["))
+        if instances:
+            raise ValueError(
+                f"import: {addr!r} uses count/for_each — import one "
+                f"instance: {', '.join(instances)}")
+        raise ValueError(
+            f"import: {addr!r} has no configuration block — write the "
+            f"resource first (terraform 1.x import semantics)")
+    attrs = render(dict(plan.instance(addr).attrs))
+    attrs["id"] = resource_id
+    resources = dict(state.resources)
+    resources[addr] = attrs
+    return State(resources=resources, serial=state.serial + 1,
+                 outputs=state.outputs)
+
+
+def apply_plan(plan: Plan, state: State | None = None,
+               targets: list[str] | None = None, *,
+               d: Diff | None = None) -> State:
     """Advance ``state`` to ``plan``: the simulated ``terraform apply``.
 
     Computed attributes keep their ``<computed>`` marker in state — the
     simulator has no providers to fill them, and :func:`diff` treats them as
     provider-owned either way. Deleted addresses drop out; the serial bumps
     iff anything changed (terraform's own behaviour for state versioning).
+    With ``targets``, only the targeted diff is applied; untargeted state
+    entries survive untouched (terraform's ``apply -target``). Pass a
+    precomputed ``d`` (for the same plan/state/targets) to skip the second
+    diff walk.
     """
-    d = diff(plan, state)
+    if d is None:
+        d = diff(plan, state, targets)
     resources = dict(state.resources) if state else {}
     for addr in d.by_action("delete"):
         resources.pop(addr, None)
@@ -272,9 +333,16 @@ def apply_plan(plan: Plan, state: State | None = None) -> State:
     for addr in d.by_action("create") + d.by_action("update"):
         resources[addr] = planned[addr]
     serial = (state.serial if state else 0) + (0 if d.is_noop else 1)
-    outputs = {
-        name: {"value": render(value),
-               "sensitive": name in plan.sensitive_outputs}
-        for name, value in plan.outputs.items()
-    }
+    if targets:
+        # outputs are evaluated against the FULL plan, which includes
+        # untargeted changes that were not applied — recording them would
+        # make `tfsim output` claim values the infrastructure doesn't
+        # have. Keep the prior outputs; the next full apply refreshes them.
+        outputs = dict(state.outputs) if state else {}
+    else:
+        outputs = {
+            name: {"value": render(value),
+                   "sensitive": name in plan.sensitive_outputs}
+            for name, value in plan.outputs.items()
+        }
     return State(resources=resources, serial=serial, outputs=outputs)

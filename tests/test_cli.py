@@ -316,3 +316,56 @@ def test_cli_survives_broken_pipe(tmp_path):
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     assert "Traceback" not in p.stderr, p.stderr
     assert p.returncode == 141, (p.returncode, p.stderr)
+
+
+def test_plan_and_apply_target(tmp_path, capsys):
+    """-target scopes plan/apply to the target's dependency closure; a
+    follow-up full apply picks up the rest."""
+    state = str(tmp_path / "s.json")
+    assert main(["plan", GKE_TPU, "-target", "google_compute_network.vpc"]
+                + VARS) == 0
+    out = capsys.readouterr().out
+    assert "Plan: 1 to add" in out
+    assert main(["apply", GKE_TPU, "-state", state, "-target",
+                 "google_compute_network.vpc"] + VARS) == 0
+    assert "Apply complete: 1 added" in capsys.readouterr().out
+    assert main(["plan", GKE_TPU, "-state", state] + VARS) == 0
+    assert "Plan: 9 to add, 0 to change, 0 to destroy." in \
+        capsys.readouterr().out
+    assert main(["plan", GKE_TPU, "-target", "nope.nope"] + VARS) == 1
+    assert "matches no resource" in capsys.readouterr().err
+
+
+def test_import_cli(tmp_path, capsys):
+    state = str(tmp_path / "s.json")
+    assert main(["import", GKE_TPU, "google_compute_network.vpc[0]",
+                 "projects/p/global/networks/c-net", "-state", state]
+                + VARS) == 0
+    assert "Import prepared" in capsys.readouterr().out
+    assert main(["plan", GKE_TPU, "-state", state] + VARS) == 0
+    out = capsys.readouterr().out
+    assert "Plan: 9 to add, 0 to change, 0 to destroy." in out
+    # re-import of a managed address refuses
+    assert main(["import", GKE_TPU, "google_compute_network.vpc[0]", "x",
+                 "-state", state] + VARS) == 1
+    assert "already managed" in capsys.readouterr().err
+
+
+def test_import_respects_moved_blocks(tmp_path, capsys):
+    """import must migrate moved{} first or the statefile wedges at the
+    next plan (destination already exists)."""
+    state = str(tmp_path / "s.json")
+    (tmp_path / "main.tf").write_text(
+        'resource "google_compute_network" "old" {\n  name = "x"\n}\n')
+    assert main(["apply", str(tmp_path), "-state", state]) == 0
+    (tmp_path / "main.tf").write_text(
+        'resource "google_compute_network" "new" {\n  name = "x"\n}\n\n'
+        'moved {\n  from = google_compute_network.old\n'
+        '  to   = google_compute_network.new\n}\n')
+    capsys.readouterr()
+    # importing the rename destination: migration happens first, so the
+    # address is already managed — refused instead of wedging the file
+    assert main(["import", str(tmp_path), "google_compute_network.new",
+                 "some-id", "-state", state]) == 1
+    assert "already managed" in capsys.readouterr().err
+    assert main(["plan", str(tmp_path), "-state", state]) == 0

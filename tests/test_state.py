@@ -379,3 +379,276 @@ def test_outputs_recorded_in_state_with_sensitivity():
     legacy = State.from_json(
         '{"serial": 3, "resources": {}}')
     assert legacy.outputs == {}
+
+
+# ------------------------------------------------------------ -target/import
+
+def test_target_scopes_plan_to_dependency_closure():
+    """-target on the smoketest Job pulls in its dependency closure (pool,
+    cluster, namespace, configmap, service...) but nothing else."""
+    from nvidia_terraform_modules_tpu.tfsim import select_targets
+
+    plan = _plan()
+    kept = select_targets(plan, ["kubernetes_job_v1.tpu_smoketest"])
+    assert 'kubernetes_job_v1.tpu_smoketest["default"]' in kept
+    assert 'google_container_node_pool.tpu_slice["default"]' in kept
+    assert "google_container_cluster.this" in kept
+    # the runtime helm release is NOT a dependency of the Job
+    assert not any(a.startswith("helm_release.") for a in kept)
+
+
+def test_target_unknown_raises():
+    import pytest
+
+    from nvidia_terraform_modules_tpu.tfsim import PlanError, select_targets
+
+    with pytest.raises(PlanError, match="matches no resource"):
+        select_targets(_plan(), ["google_compute_network.nope"])
+
+
+def test_targeted_diff_and_apply_leave_rest_untouched():
+    plan = _plan()
+    d = diff(plan, None, targets=["google_compute_network.vpc"])
+    assert set(d.actions) == {"google_compute_network.vpc[0]"}
+    state = apply_plan(plan, None, targets=["google_compute_network.vpc"])
+    assert set(state.resources) == {"google_compute_network.vpc[0]"}
+    # untargeted deletes are skipped: full apply then targeted apply of a
+    # config without the slice must NOT delete the slice pool
+    full = apply_plan(_plan())
+    d2 = diff(_plan(), full, targets=["google_compute_network.vpc"])
+    assert d2.is_noop
+    partial = apply_plan(_plan(), full, targets=["google_compute_network.vpc"])
+    assert 'google_container_node_pool.tpu_slice["default"]' in \
+        partial.resources
+
+
+def test_targeted_instance_keeps_only_that_instance():
+    from nvidia_terraform_modules_tpu.tfsim import select_targets
+
+    plan = _plan({"tpu_slices": {"default": {}, "b": {"topology": "2x2",
+                                                      "version": "v5e"}}})
+    kept = select_targets(
+        plan, ['google_container_node_pool.tpu_slice["b"]'])
+    assert 'google_container_node_pool.tpu_slice["b"]' in kept
+    assert 'google_container_node_pool.tpu_slice["default"]' not in kept
+    assert "google_container_cluster.this" in kept  # dependency, whole node
+
+
+def test_import_adopts_and_replans_noop():
+    from nvidia_terraform_modules_tpu.tfsim import import_resource
+
+    plan = _plan()
+    state = import_resource(None, plan, "google_compute_network.vpc[0]",
+                            "projects/p/global/networks/demo-net")
+    assert state.resources["google_compute_network.vpc[0]"]["id"] == \
+        "projects/p/global/networks/demo-net"
+    d = diff(plan, state)
+    assert d.actions["google_compute_network.vpc[0]"] == "no-op"
+
+
+def test_import_errors():
+    import pytest
+
+    from nvidia_terraform_modules_tpu.tfsim import import_resource
+
+    plan = _plan()
+    state = apply_plan(plan)
+    with pytest.raises(ValueError, match="already managed"):
+        import_resource(state, plan, "google_compute_network.vpc[0]", "x")
+    with pytest.raises(ValueError, match="no configuration block"):
+        import_resource(None, plan, "google_compute_network.other", "x")
+
+
+def test_targeted_delete_of_removed_instance():
+    """A targeted resource whose instance left the config still diffs as
+    a delete — but ONLY when targeted."""
+    full = apply_plan(_plan({"tpu_slices": {"default": {}, "b": {
+        "topology": "2x2", "version": "v5e"}}}))
+    shrunk = _plan()   # "b" removed from config
+    d = diff(shrunk, full,
+             targets=["google_container_node_pool.tpu_slice"])
+    assert d.actions['google_container_node_pool.tpu_slice["b"]'] == "delete"
+    # untargeted plan of an unrelated resource must not touch "b"
+    d2 = diff(shrunk, full, targets=["google_compute_network.vpc"])
+    assert 'google_container_node_pool.tpu_slice["b"]' not in d2.actions
+
+
+def test_target_module_inner_resource_selects_only_that_subtree(tmp_path):
+    """-target module.m.res.name must NOT expand to the whole module."""
+    import textwrap
+
+    from nvidia_terraform_modules_tpu.tfsim import select_targets
+
+    (tmp_path / "child").mkdir()
+    (tmp_path / "child" / "main.tf").write_text(textwrap.dedent("""
+        resource "google_compute_network" "vpc" {
+          name = "n"
+        }
+
+        resource "google_compute_firewall" "fw" {
+          name = "f"
+        }
+    """))
+    (tmp_path / "main.tf").write_text(
+        'module "net" {\n  source = "./child"\n}\n')
+    plan = simulate_plan(str(tmp_path), {})
+    kept = select_targets(plan, ["module.net.google_compute_network.vpc"])
+    assert "module.net.google_compute_network.vpc" in kept
+    assert "module.net.google_compute_firewall.fw" not in kept
+    # whole-module target still takes everything
+    kept = select_targets(plan, ["module.net"])
+    assert "module.net.google_compute_firewall.fw" in kept
+
+
+def test_targeted_destroy_of_fully_removed_resource(tmp_path):
+    """Removing a whole resource block then -targeting it plans its
+    destroy (terraform's targeted-destroy workflow), not an error."""
+    import textwrap
+
+    (tmp_path / "main.tf").write_text(textwrap.dedent("""
+        resource "google_compute_network" "a" {
+          name = "a"
+        }
+
+        resource "google_compute_firewall" "b" {
+          name = "b"
+        }
+    """))
+    prior = apply_plan(simulate_plan(str(tmp_path), {}))
+    (tmp_path / "main.tf").write_text(
+        'resource "google_compute_network" "a" {\n  name = "a"\n}\n')
+    shrunk = simulate_plan(str(tmp_path), {})
+    d = diff(shrunk, prior, targets=["google_compute_firewall.b"])
+    assert d.actions == {"google_compute_firewall.b": "delete"}
+    # a target matching neither config nor state still errors
+    import pytest
+
+    from nvidia_terraform_modules_tpu.tfsim import PlanError
+    with pytest.raises(PlanError, match="configuration or state"):
+        diff(shrunk, prior, targets=["google_compute_firewall.nope"])
+
+
+def test_import_rejects_data_source_and_names_instances():
+    import pytest
+
+    from nvidia_terraform_modules_tpu.tfsim import import_resource
+
+    plan = _plan()
+    with pytest.raises(ValueError, match="data source"):
+        import_resource(None, plan, "data.google_client_config.current", "x")
+    with pytest.raises(ValueError, match=r"vpc\[0\]"):
+        import_resource(None, plan, "google_compute_network.vpc", "x")
+
+
+def test_target_typod_instance_key_errors():
+    import pytest
+
+    from nvidia_terraform_modules_tpu.tfsim import PlanError, select_targets
+
+    with pytest.raises(PlanError, match="matches no resource instance"):
+        select_targets(_plan(),
+                       ['google_container_node_pool.tpu_slice["typo"]'])
+
+
+def test_target_module_inner_includes_in_module_deps(tmp_path):
+    """module.m.res target pulls res's dependencies INSIDE the module."""
+    import textwrap
+
+    from nvidia_terraform_modules_tpu.tfsim import select_targets
+
+    (tmp_path / "child").mkdir()
+    (tmp_path / "child" / "main.tf").write_text(textwrap.dedent("""
+        resource "google_compute_network" "net" {
+          name = "n"
+        }
+
+        resource "google_compute_subnetwork" "sub" {
+          network = google_compute_network.net.id
+        }
+
+        resource "google_compute_firewall" "unrelated" {
+          name = "f"
+        }
+    """))
+    (tmp_path / "main.tf").write_text(
+        'module "m" {\n  source = "./child"\n}\n')
+    plan = simulate_plan(str(tmp_path), {})
+    kept = select_targets(plan, ["module.m.google_compute_subnetwork.sub"])
+    assert "module.m.google_compute_subnetwork.sub" in kept
+    assert "module.m.google_compute_network.net" in kept   # in-module dep
+    assert "module.m.google_compute_firewall.unrelated" not in kept
+
+
+def test_target_counted_module_instance_includes_in_module_deps(tmp_path):
+    import textwrap
+
+    from nvidia_terraform_modules_tpu.tfsim import select_targets
+
+    (tmp_path / "child").mkdir()
+    (tmp_path / "child" / "main.tf").write_text(textwrap.dedent("""
+        resource "google_compute_network" "net" {
+          name = "n"
+        }
+
+        resource "google_compute_subnetwork" "sub" {
+          network = google_compute_network.net.id
+        }
+    """))
+    (tmp_path / "main.tf").write_text(
+        'module "m" {\n  source = "./child"\n  count = 1\n}\n')
+    plan = simulate_plan(str(tmp_path), {})
+    kept = select_targets(
+        plan, ["module.m[0].google_compute_subnetwork.sub"])
+    assert "module.m[0].google_compute_subnetwork.sub" in kept
+    assert "module.m[0].google_compute_network.net" in kept
+
+
+def test_target_count_zero_resource_is_legal():
+    """Targeting a conditional resource with the flag off selects nothing
+    (terraform accepts it); the vpc is count-gated via network.create."""
+    from nvidia_terraform_modules_tpu.tfsim import select_targets
+
+    plan = _plan({"network": {"create": False,
+                              "network_name": "shared",
+                              "subnetwork_name": "shared-sub"}})
+    kept = select_targets(plan, ["google_compute_network.vpc"])
+    assert kept == set()
+
+
+def test_targeted_apply_keeps_prior_outputs():
+    """Outputs evaluated from the full plan may reflect unapplied,
+    untargeted changes — a targeted apply must not record them."""
+    full = apply_plan(_plan())
+    assert full.outputs["cluster_name"]["value"] == "demo"
+    renamed = simulate_plan(
+        os.path.join(ROOT, "gke-tpu"),
+        {"project_id": "proj-x", "cluster_name": "other"})
+    partial = apply_plan(renamed, full,
+                         targets=["google_compute_network.vpc"])
+    assert partial.outputs["cluster_name"]["value"] == "demo"
+
+
+def test_target_indexless_resource_in_counted_module(tmp_path):
+    """module.m.res on a counted module targets res in EVERY instance
+    (terraform's all-instances form) — never silently nothing."""
+    import textwrap
+
+    from nvidia_terraform_modules_tpu.tfsim import select_targets
+
+    (tmp_path / "child").mkdir()
+    (tmp_path / "child" / "main.tf").write_text(textwrap.dedent("""
+        resource "google_compute_network" "net" {
+          name = "n"
+        }
+
+        resource "google_compute_firewall" "other" {
+          name = "f"
+        }
+    """))
+    (tmp_path / "main.tf").write_text(
+        'module "m" {\n  source = "./child"\n  count = 2\n}\n')
+    plan = simulate_plan(str(tmp_path), {})
+    kept = select_targets(plan, ["module.m.google_compute_network.net"])
+    assert "module.m[0].google_compute_network.net" in kept
+    assert "module.m[1].google_compute_network.net" in kept
+    assert not any("firewall" in a for a in kept)
