@@ -4,10 +4,16 @@ from .burnin import (  # noqa: F401
     BurnInConfig,
     init_params,
     forward,
+    forward_and_aux,
     loss_fn,
     make_train_step,
     synthetic_batch,
     train_step_flops,
+)
+from .moe import (  # noqa: F401
+    expert_capacity,
+    init_moe_params,
+    moe_layer,
 )
 from .checkpoint import (  # noqa: F401
     Checkpointer,

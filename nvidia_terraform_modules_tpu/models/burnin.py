@@ -50,11 +50,20 @@ class BurnInConfig:
     # "flash": fused pallas kernel (ops.flash_attention) on the gathered
     #          sequence — the [S,S] score matrix never touches HBM.
     attn: str = "dense"
+    # n_experts > 0 swaps each block's dense FFN for a Switch-style top-1
+    # MoE (models/moe.py): experts shard over the mesh's ep axis, the
+    # dispatch/combine einsums lower to all-to-alls, and the Switch
+    # load-balance loss joins the training objective.
+    n_experts: int = 0
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
 
     def __post_init__(self):
         if self.attn not in ("dense", "ring", "flash"):
             raise ValueError(
                 f"unknown attn impl {self.attn!r}; use dense|ring|flash")
+        if self.n_experts < 0:
+            raise ValueError(f"n_experts must be >= 0, got {self.n_experts}")
 
     @property
     def head_dim(self) -> int:
@@ -79,19 +88,23 @@ def init_params(rng, cfg: BurnInConfig, rules: ShardingRules | None = None):
         "layers": [],
     }
     for i in range(cfg.n_layers):
-        lk = jax.random.split(keys[2 + i], 6)
-        params["layers"].append(
-            {
-                "attn_norm": jnp.ones((cfg.d_model,), dtype=cfg.dtype),
-                "wq": dense(lk[0], (cfg.d_model, cfg.d_model)),
-                "wk": dense(lk[1], (cfg.d_model, cfg.d_model)),
-                "wv": dense(lk[2], (cfg.d_model, cfg.d_model)),
-                "wo": dense(lk[3], (cfg.d_model, cfg.d_model)),
-                "mlp_norm": jnp.ones((cfg.d_model,), dtype=cfg.dtype),
-                "up": dense(lk[4], (cfg.d_model, cfg.d_ff)),
-                "down": dense(lk[5], (cfg.d_ff, cfg.d_model)),
-            }
-        )
+        lk = jax.random.split(keys[2 + i], 7)
+        layer = {
+            "attn_norm": jnp.ones((cfg.d_model,), dtype=cfg.dtype),
+            "wq": dense(lk[0], (cfg.d_model, cfg.d_model)),
+            "wk": dense(lk[1], (cfg.d_model, cfg.d_model)),
+            "wv": dense(lk[2], (cfg.d_model, cfg.d_model)),
+            "wo": dense(lk[3], (cfg.d_model, cfg.d_model)),
+            "mlp_norm": jnp.ones((cfg.d_model,), dtype=cfg.dtype),
+        }
+        if cfg.n_experts > 0:
+            from .moe import init_moe_params
+
+            layer["moe"] = init_moe_params(lk[6], cfg)
+        else:
+            layer["up"] = dense(lk[4], (cfg.d_model, cfg.d_ff))
+            layer["down"] = dense(lk[5], (cfg.d_ff, cfg.d_model))
+        params["layers"].append(layer)
     if rules is not None:
         params = shard_params(params, rules)
     return params
@@ -114,6 +127,13 @@ def shard_params(params, rules: ShardingRules):
 
 def forward(params, tokens, cfg: BurnInConfig, rules: ShardingRules | None = None):
     """Decoder-only forward pass → logits [batch, seq, vocab]."""
+    return forward_and_aux(params, tokens, cfg, rules)[0]
+
+
+def forward_and_aux(params, tokens, cfg: BurnInConfig,
+                    rules: ShardingRules | None = None):
+    """Forward pass returning ``(logits, aux_loss)`` — aux is the summed
+    Switch load-balance loss over MoE layers (0.0 for the dense model)."""
 
     def act(x, *rest):
         """Constrain an activation: batch over the data axes, then ``rest``.
@@ -129,6 +149,7 @@ def forward(params, tokens, cfg: BurnInConfig, rules: ShardingRules | None = Non
     # sequence-parallel resident layout between blocks
     x = act(x, "sp", None)
 
+    aux = jnp.float32(0.0)
     use_ring = cfg.attn == "ring" and rules is not None
     for layer in params["layers"]:
         h = _rmsnorm(x, layer["attn_norm"])
@@ -171,14 +192,22 @@ def forward(params, tokens, cfg: BurnInConfig, rules: ShardingRules | None = Non
         x = x + act(attn @ layer["wo"], "sp", None)
 
         h = _rmsnorm(x, layer["mlp_norm"])
-        h = act(h, None, None)
-        h = jax.nn.gelu((h @ layer["up"]).astype(jnp.float32)).astype(cfg.dtype)
-        h = act(h, None, "tp")
-        x = x + act(h @ layer["down"], "sp", None)
+        if cfg.n_experts > 0:
+            from .moe import moe_layer
+
+            h = act(h, None, None)   # gather sequence: routing is per-token
+            out, layer_aux = moe_layer(h, layer["moe"], cfg, rules)
+            aux = aux + layer_aux
+            x = x + act(out, "sp", None)
+        else:
+            h = act(h, None, None)
+            h = jax.nn.gelu((h @ layer["up"]).astype(jnp.float32)).astype(cfg.dtype)
+            h = act(h, None, "tp")
+            x = x + act(h @ layer["down"], "sp", None)
 
     x = _rmsnorm(x, params["out_norm"])
     logits = x @ params["embed"].T                    # weight-tied head
-    return act(logits, "sp", None)
+    return act(logits, "sp", None), aux
 
 
 def train_step_flops(cfg: BurnInConfig) -> float:
@@ -195,6 +224,10 @@ def train_step_flops(cfg: BurnInConfig) -> float:
     per_layer = (
         8.0 * b * s * d * d          # q, k, v, o projections (2·BSd² each)
         + 2.0 * b * s * s * d        # QKᵀ + PV, causal-effective (½ of 4BS²d)
+        # FFN: with top-1 MoE each token still passes through exactly one
+        # expert's up+down, so the per-token model FLOPs match dense;
+        # dispatch/combine einsums are routing overhead, deliberately not
+        # billed (billing overhead would inflate MFU)
         + 4.0 * b * s * d * dff      # up + down projections
     )
     fwd = cfg.n_layers * per_layer + 2.0 * b * s * d * v  # + tied head
@@ -203,10 +236,11 @@ def train_step_flops(cfg: BurnInConfig) -> float:
 
 def loss_fn(params, batch, cfg: BurnInConfig, rules: ShardingRules | None = None):
     tokens, targets = batch
-    logits = forward(params, tokens, cfg, rules).astype(jnp.float32)
+    logits, aux = forward_and_aux(params, tokens, cfg, rules)
+    logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
-    return jnp.mean(nll)
+    return jnp.mean(nll) + cfg.aux_loss_weight * aux
 
 
 def synthetic_batch(rng, cfg: BurnInConfig, rules: ShardingRules | None = None):

@@ -1,0 +1,120 @@
+"""Switch-style top-1 Mixture-of-Experts layer, expert-parallel over ``ep``.
+
+The reference provisions the fabric and never runs a workload on it
+(SURVEY §2.6); our validation workload exists to prove the fabric carries
+real parallelism. The dense burn-in transformer already exercises dp
+(gradient psum), tp (all-gather / reduce-scatter), and sp (ring
+collectives); this layer adds the remaining first-class axis: **ep**,
+whose signature collective is the all-to-all token shuffle between
+data-sharded activations and expert-sharded FFN weights.
+
+TPU-first design (GShard/Switch dispatch, not a CUDA-style scatter):
+
+- **static shapes**: every token picks its top-1 expert, but routing is
+  materialised as dense one-hot dispatch/combine tensors of fixed shape
+  ``[tokens, experts, capacity]`` — no data-dependent shapes, so the whole
+  layer jits into one XLA program and tiles onto the MXU;
+- **capacity factor**: each expert processes at most
+  ``ceil(tokens/experts · capacity_factor)`` tokens; overflow tokens are
+  dropped (their residual path carries them) — the standard Switch
+  trade that keeps the einsums static;
+- **sharding does the communication**: expert weights shard over
+  ``ep`` (and their FFN dim over ``tp``); constraining the dispatched
+  activations to ``P("ep", …)`` makes XLA lower the dispatch/combine
+  einsums to all-to-alls over ICI — no hand-written collective;
+- **load-balance auxiliary loss** (Switch eq. 4): mean expert load ×
+  mean router probability × E, differentiable pressure toward uniform
+  routing, returned for the train loss to add.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def expert_capacity(tokens: int, n_experts: int,
+                    capacity_factor: float) -> int:
+    """Per-expert token slots; multiple of 8 so the [E, C, D] expert batch
+    tiles cleanly onto TPU sublanes."""
+    cap = math.ceil(tokens / n_experts * capacity_factor)
+    return max(8, math.ceil(cap / 8) * 8)
+
+
+def init_moe_params(rng, cfg) -> dict[str, Any]:
+    """Router + stacked expert FFN weights ([E, ...] leading expert dim)."""
+    kr, ku, kd = jax.random.split(rng, 3)
+
+    def dense(key, shape, scale=0.02):
+        return (jax.random.normal(key, shape, dtype=jnp.float32)
+                * scale).astype(cfg.dtype)
+
+    return {
+        # router stays f32: tiny, and routing decisions are
+        # precision-sensitive (bf16 logit ties flip expert choice)
+        "router": jax.random.normal(
+            kr, (cfg.d_model, cfg.n_experts), dtype=jnp.float32) * 0.02,
+        "experts_up": dense(ku, (cfg.n_experts, cfg.d_model, cfg.d_ff)),
+        "experts_down": dense(kd, (cfg.n_experts, cfg.d_ff, cfg.d_model)),
+    }
+
+
+def moe_layer(x, params, cfg, rules=None):
+    """Top-1 MoE FFN. ``x`` is [B, S, D]; returns ([B, S, D], aux_loss).
+
+    Dispatch/combine follow GShard: a dense [T, E, C] one-hot tensor
+    routes tokens into per-expert batches and back. With ``rules`` on an
+    ``ep`` mesh, the expert batch is constrained to ``P("ep", …)`` so XLA
+    inserts the all-to-all; unsharded it is a plain pair of einsums.
+    """
+    B, S, D = x.shape
+    E = cfg.n_experts
+    T = B * S
+    C = expert_capacity(T, E, cfg.capacity_factor)
+
+    tokens = x.reshape(T, D)
+    logits = tokens.astype(jnp.float32) @ params["router"]     # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                        # [T]
+    gate = jnp.max(probs, axis=-1)                             # [T]
+
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)      # [T, E]
+    # position of each token within its expert's batch (exclusive cumsum
+    # along the token dim — deterministic first-come-first-served).
+    # int32 cumsum: f32 would lose integer exactness past 2^24 tokens and
+    # silently collapse distinct tokens into one capacity slot
+    oh_i = onehot.astype(jnp.int32)
+    pos = jnp.cumsum(oh_i, axis=0) * oh_i - oh_i               # [T, E]
+    within = ((pos < C) & (oh_i == 1)).astype(jnp.float32)
+    pos_oh = jax.nn.one_hot(pos, C, dtype=jnp.float32)         # [T, E, C]
+    dispatch = pos_oh * within[..., None]                      # [T, E, C]
+    combine = dispatch * gate[:, None, None]
+
+    def ep(t, spec):
+        if rules is None:
+            return t
+        return jax.lax.with_sharding_constraint(t, rules.shard(spec))
+
+    # dispatch: token-sharded [T, D] → expert-sharded [E, C, D]
+    # (all-to-all over ep when experts are sharded there)
+    xin = jnp.einsum("tec,td->ecd", dispatch.astype(cfg.dtype),
+                     tokens)
+    xin = ep(xin, rules.moe_act if rules else None)
+    h = jnp.einsum("ecd,edf->ecf", xin, params["experts_up"])
+    h = jax.nn.gelu(h.astype(jnp.float32)).astype(cfg.dtype)
+    h = ep(h, rules.moe_hidden if rules else None)
+    xout = jnp.einsum("ecf,efd->ecd", h, params["experts_down"])
+    xout = ep(xout, rules.moe_act if rules else None)
+    # combine: back to token-sharded [T, D]
+    out = jnp.einsum("tec,ecd->td", combine.astype(cfg.dtype), xout)
+
+    # Switch load-balance loss: E · Σ_e load_e · prob_e (minimised at
+    # uniform routing). Computed over ALL tokens, including dropped ones.
+    load = jnp.mean(onehot, axis=0)                            # [E]
+    prob = jnp.mean(probs, axis=0)                             # [E]
+    aux = E * jnp.sum(load * prob)
+
+    return out.reshape(B, S, D), aux

@@ -7,6 +7,8 @@ visible devices into a logical mesh with named axes:
 - ``dp``  — data parallel (gradient psum rides ICI)
 - ``tp``  — tensor/model parallel (activations all-gather / reduce-scatter)
 - ``sp``  — sequence/context parallel (ring collectives for long context)
+- ``ep``  — expert parallel (MoE dispatch/combine all-to-alls), present
+  only when requested (``ep > 1``) so dense workloads keep 3-axis meshes
 
 The planner keeps ``tp`` innermost so tensor-parallel collectives map onto the
 fastest ICI dimension, mirroring the scaling-book recipe: pick a mesh, annotate
@@ -40,28 +42,41 @@ def plan_mesh(
     *,
     tp: int | None = None,
     sp: int = 1,
-    axis_names: Sequence[str] = ("dp", "sp", "tp"),
+    ep: int = 1,
+    axis_names: Sequence[str] | None = None,
 ) -> MeshPlan:
-    """Choose a (dp, sp, tp) factorisation of ``n_devices``.
+    """Choose a (dp[, ep], sp, tp) factorisation of ``n_devices``.
 
     ``tp`` defaults to the largest power of two ≤ 4 dividing the device count —
     small enough that a v5e-8 slice still has a data axis, large enough to
-    exercise tensor-parallel collectives.
+    exercise tensor-parallel collectives. ``ep > 1`` inserts an expert
+    axis between dp and sp (axes ``("dp", "ep", "sp", "tp")``) — MoE
+    dispatch all-to-alls then ride the same ICI ring the data axis uses,
+    while dense workloads keep the 3-axis mesh unchanged.
     """
     if n_devices < 1:
         raise ValueError(f"n_devices must be >= 1, got {n_devices}")
-    if n_devices % sp != 0:
-        raise ValueError(f"sp = {sp} does not divide device count {n_devices}")
+    if ep < 1 or n_devices % (sp * ep) != 0:
+        raise ValueError(
+            f"ep*sp = {ep}*{sp} does not divide device count {n_devices}")
     if tp is None:
         tp = 1
-        while tp < 4 and n_devices % (tp * 2 * sp) == 0:
+        while tp < 4 and n_devices % (tp * 2 * sp * ep) == 0:
             tp *= 2
-    if n_devices % (tp * sp) != 0:
+    if n_devices % (tp * sp * ep) != 0:
         raise ValueError(
-            f"tp*sp = {tp}*{sp} does not divide device count {n_devices}"
+            f"tp*sp*ep = {tp}*{sp}*{ep} does not divide device count "
+            f"{n_devices}"
         )
-    dp = n_devices // (tp * sp)
-    return MeshPlan(tuple(axis_names), (dp, sp, tp))
+    dp = n_devices // (tp * sp * ep)
+    shape = (dp, ep, sp, tp) if ep > 1 else (dp, sp, tp)
+    names = tuple(axis_names) if axis_names is not None else (
+        ("dp", "ep", "sp", "tp") if ep > 1 else ("dp", "sp", "tp"))
+    if len(names) != len(shape):
+        raise ValueError(
+            f"axis_names {names} has {len(names)} names for a "
+            f"{len(shape)}-axis mesh {shape} (ep > 1 adds an axis)")
+    return MeshPlan(names, shape)
 
 
 def build_mesh(plan: MeshPlan | None = None, *, devices=None):

@@ -22,13 +22,19 @@ class ShardingRules:
     """PartitionSpecs for each logical tensor role in the burn-in model."""
 
     mesh: Mesh
-    # mesh axes carrying the batch dimension: ("dp",), or ("slice", "dp")
+    # mesh axes carrying the batch dimension: ("dp",), ("slice", "dp"),
+    # or ("dp", "ep") — expert parallelism borrows the data axis for the
+    # dense parts of the model (GShard layout)
     data: tuple[str, ...] = ("dp",)
     embed: P = P(None, "tp")               # [vocab, d_model]
     attn_qkv: P = P(None, "tp")            # [d_model, heads*head_dim] col-parallel
     attn_out: P = P("tp", None)            # [heads*head_dim, d_model] row-parallel
     mlp_up: P = P(None, "tp")              # [d_model, d_ff] col-parallel
     mlp_down: P = P("tp", None)            # [d_ff, d_model] row-parallel
+    moe_up: P = P("ep", None, "tp")        # [E, d_model, d_ff] expert-sharded
+    moe_down: P = P("ep", "tp", None)      # [E, d_ff, d_model]
+    moe_act: P = P("ep", None, None)       # [E, capacity, D] expert batches
+    moe_hidden: P = P("ep", None, "tp")    # [E, capacity, d_ff]
     replicated: P = P()
 
     @property
@@ -49,6 +55,13 @@ class ShardingRules:
     def param_sharding(self, path: tuple[str, ...]) -> NamedSharding:
         """Sharding for a parameter by its pytree path (leaf names)."""
         name = "/".join(str(p) for p in path)
+        # expert tensors first: "experts_up" would otherwise match "up"
+        if "experts_up" in name:
+            return self.shard(self.moe_up)
+        if "experts_down" in name:
+            return self.shard(self.moe_down)
+        if "router" in name:
+            return self.shard(self.replicated)
         if "embed" in name:
             return self.shard(self.embed)
         if "wq" in name or "wk" in name or "wv" in name or "up" in name or "gate" in name:
@@ -59,5 +72,14 @@ class ShardingRules:
 
 
 def make_rules(mesh: Mesh) -> ShardingRules:
-    data = ("slice", "dp") if "slice" in mesh.axis_names else ("dp",)
-    return ShardingRules(mesh=mesh, data=data)
+    data: tuple[str, ...] = (
+        ("slice",) if "slice" in mesh.axis_names else ())
+    data += ("dp",)
+    if "ep" in mesh.axis_names:
+        return ShardingRules(mesh=mesh, data=data + ("ep",))
+    # no expert axis: MoE tensors replicate their expert dim, so the same
+    # model still runs (tp-sharded FFN dims, dp-sharded tokens)
+    return ShardingRules(
+        mesh=mesh, data=data,
+        moe_up=P(None, None, "tp"), moe_down=P(None, "tp", None),
+        moe_act=P(), moe_hidden=P(None, None, "tp"))
