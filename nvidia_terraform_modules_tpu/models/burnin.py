@@ -29,6 +29,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..ops.flash_attention import flash_attention
 from ..ops.ring_attention import dense_reference_attention, ring_self_attention
 from ..parallel.sharding import ShardingRules
+from ..utils.layers import dense_init
+from ..utils.layers import rmsnorm as _rmsnorm
 
 
 @dataclasses.dataclass(frozen=True)
@@ -70,17 +72,13 @@ class BurnInConfig:
         return self.d_model // self.n_heads
 
 
-def _rmsnorm(x, scale):
-    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
-    return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
-
 
 def init_params(rng, cfg: BurnInConfig, rules: ShardingRules | None = None):
     """Initialise parameters; if ``rules`` given, place them sharded."""
     keys = jax.random.split(rng, 2 + cfg.n_layers)
 
-    def dense(key, shape, scale=0.02):
-        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(cfg.dtype)
+    def dense(key, shape):
+        return dense_init(key, shape, cfg.dtype)
 
     params: dict[str, Any] = {
         "embed": dense(keys[0], (cfg.vocab, cfg.d_model)),

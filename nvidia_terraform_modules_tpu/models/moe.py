@@ -35,6 +35,8 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from ..utils.layers import dense_init
+
 
 def expert_capacity(tokens: int, n_experts: int,
                     capacity_factor: float) -> int:
@@ -48,9 +50,8 @@ def init_moe_params(rng, cfg) -> dict[str, Any]:
     """Router + stacked expert FFN weights ([E, ...] leading expert dim)."""
     kr, ku, kd = jax.random.split(rng, 3)
 
-    def dense(key, shape, scale=0.02):
-        return (jax.random.normal(key, shape, dtype=jnp.float32)
-                * scale).astype(cfg.dtype)
+    def dense(key, shape):
+        return dense_init(key, shape, cfg.dtype)
 
     return {
         # router stays f32: tiny, and routing decisions are

@@ -22,3 +22,10 @@ from .collectives import (  # noqa: F401
     ring_permute_probe,
 )
 from .multihost import job_env_from_environ, maybe_initialize_distributed  # noqa: F401
+from .pipeline import (  # noqa: F401
+    PipelineConfig,
+    init_pipeline_params,
+    make_pipeline_train_step,
+    pipeline_loss_fn,
+    stack_sharding,
+)

@@ -1,0 +1,23 @@
+"""Shared layer primitives for the validation workloads.
+
+One definition of RMSNorm and the init scale, imported by both the
+burn-in transformer (``models/burnin.py``) and the pipeline model
+(``parallel/pipeline.py``) — the pipeline mirrors the burn-in block, and
+a norm/init tweak must not silently diverge the two. Lives in ``utils``
+(a leaf package) so neither side imports the other.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rmsnorm(x, scale):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
+
+
+def dense_init(key, shape, dtype, scale: float = 0.02):
+    return (jax.random.normal(key, shape, dtype=jnp.float32)
+            * scale).astype(dtype)
