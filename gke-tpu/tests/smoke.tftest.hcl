@@ -1,0 +1,117 @@
+# Native-format test suite for the gke-tpu module, run by `tfsim test`
+# (offline analogue of `terraform test`). Covers the BASELINE.json target
+# configs the way tests/test_gke_tpu_module.py does from Python — these
+# run blocks are the terraform-idiomatic face of the same golden plans.
+
+variables {
+  project_id   = "test-project"
+  cluster_name = "tpu-test"
+}
+
+# BASELINE config 3 is the module default: one v5e 2x4 multi-host slice.
+run "default_v5e8" {
+  command = plan
+
+  assert {
+    condition     = output.tpu_slices["default"].machine_type == "ct5lp-hightpu-4t"
+    error_message = "v5e 2x4 must derive the 4-chip host type"
+  }
+  assert {
+    condition     = output.tpu_slices["default"].hosts == 2
+    error_message = "v5e 2x4 is a 2-host slice"
+  }
+  assert {
+    condition     = output.total_tpu_chips == 8
+    error_message = "default fleet should expose 8 chips"
+  }
+  assert {
+    condition     = google_container_node_pool.tpu_slice["default"].node_count == 2
+    error_message = "slice pools are atomic: node_count must equal hosts"
+  }
+  assert {
+    condition     = google_container_node_pool.tpu_slice["default"].placement_policy[0].tpu_topology == "2x4"
+    error_message = "multi-host slices need COMPACT placement with the slice topology"
+  }
+  assert {
+    condition     = kubernetes_job_v1.tpu_smoketest["default"].spec[0].completions == 2
+    error_message = "smoketest Job runs one indexed pod per slice host"
+  }
+  assert {
+    condition     = kubernetes_job_v1.tpu_smoketest["default"].wait_for_completion == true
+    error_message = "apply must gate on smoketest completion (the north-star metric)"
+  }
+}
+
+# BASELINE config 2: single-host v5e-1 — no placement policy, no coordinator
+# choreography needed.
+run "single_host_v5e1" {
+  command = plan
+
+  variables {
+    tpu_slices = {
+      default = { version = "v5e", topology = "1x1" }
+    }
+  }
+
+  assert {
+    condition     = output.tpu_slices["default"].machine_type == "ct5lp-hightpu-1t"
+    error_message = "v5e 1x1 is the single-chip host type"
+  }
+  assert {
+    condition     = output.tpu_slices["default"].multi_host == false
+    error_message = "1x1 must not be multi-host"
+  }
+  assert {
+    condition     = !contains(keys(google_container_node_pool.tpu_slice["default"]), "placement_policy")
+    error_message = "single-host slices must not set a placement policy"
+  }
+}
+
+# BASELINE config 5: v4 pod slice under node-auto-provisioning, spot.
+run "v4_pod_slice_nap" {
+  command = plan
+
+  variables {
+    tpu_slices = {
+      train = { version = "v4", topology = "2x2x4", spot = true }
+    }
+    node_auto_provisioning = {
+      enabled = true
+      resource_limits = [
+        { resource_type = "tpu-v4-podslice-chips", maximum = 64 },
+      ]
+    }
+    smoketest = { enabled = false }
+  }
+
+  assert {
+    condition     = google_container_node_pool.tpu_slice["train"].node_config[0].machine_type == "ct4p-hightpu-4t"
+    error_message = "v4 2x2x4 must derive the ct4p 4-chip host type"
+  }
+  assert {
+    condition     = google_container_node_pool.tpu_slice["train"].node_config[0].spot == true
+    error_message = "spot flag must reach the node config"
+  }
+  assert {
+    condition     = google_container_cluster.this.cluster_autoscaling[0].resource_limits[0].resource_type == "tpu-v4-podslice-chips"
+    error_message = "NAP resource limits must pass through to cluster_autoscaling"
+  }
+  assert {
+    condition     = length(kubernetes_job_v1.tpu_smoketest) == 0
+    error_message = "disabling the smoketest must plan no Job"
+  }
+}
+
+# The negative path: spot and reservation are mutually exclusive per slice
+# (variable validation), so the plan itself must fail.
+run "spot_reservation_conflict" {
+  command = plan
+
+  variables {
+    tpu_slices = {
+      bad = { spot = true, reservation = "my-resv" }
+    }
+  }
+
+  expect_failures = [var.tpu_slices]
+}

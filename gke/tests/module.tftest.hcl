@@ -1,0 +1,53 @@
+# Native-format test suite for the gke (GPU-parity) module, run by
+# `tfsim test`. Mirrors the reference module's capability surface: cluster +
+# CPU/GPU pools + GPU Operator helm release (/root/reference/gke/main.tf),
+# exercised as offline golden plans.
+
+variables {
+  project_id   = "test-project"
+  cluster_name = "gpu-test"
+}
+
+run "defaults" {
+  command = plan
+
+  assert {
+    condition     = google_container_cluster.this.remove_default_node_pool == true
+    error_message = "the default node pool must be removed (reference gke/main.tf:45)"
+  }
+  assert {
+    condition     = google_container_node_pool.gpu[0].node_config[0].guest_accelerator[0].count == 1
+    error_message = "default GPU pool carries one accelerator per node"
+  }
+  assert {
+    condition     = helm_release.gpu_operator[0].atomic == true
+    error_message = "operator install must be atomic (self-healing apply)"
+  }
+  assert {
+    condition     = output.cluster_name == var.cluster_name
+    error_message = "cluster name must round-trip to the output"
+  }
+}
+
+# BASELINE config 1: CPU-only cluster — no GPU pool, no operator install.
+run "cpu_only" {
+  command = plan
+
+  variables {
+    gpu_pool     = { enabled = false }
+    gpu_operator = { enabled = false }
+  }
+
+  assert {
+    condition     = length(google_container_node_pool.gpu) == 0
+    error_message = "gpu_pool.enabled = false must plan no GPU pool"
+  }
+  assert {
+    condition     = length(helm_release.gpu_operator) == 0
+    error_message = "operator disabled must plan no helm release"
+  }
+  assert {
+    condition     = length(kubernetes_namespace_v1.gpu_operator) == 0
+    error_message = "operator disabled must plan no namespace"
+  }
+}

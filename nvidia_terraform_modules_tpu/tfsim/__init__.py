@@ -31,6 +31,13 @@ from .plan import (  # noqa: F401
     simulate_plan,
 )
 from .destroy import simulate_destroy, DestroyPlan, DestroyHazard  # noqa: F401
+from .test import (  # noqa: F401
+    FileResult,
+    RunResult,
+    discover_test_files,
+    format_results,
+    run_tests,
+)
 from .state import (  # noqa: F401
     State,
     Diff,

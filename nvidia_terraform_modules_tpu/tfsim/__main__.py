@@ -14,6 +14,7 @@ terraform binary in CI, so tfsim ships the same verbs offline::
     python -m nvidia_terraform_modules_tpu.tfsim output -state f [NAME] [-json]
     python -m nvidia_terraform_modules_tpu.tfsim state list|show|rm|mv ... -state f
     python -m nvidia_terraform_modules_tpu.tfsim graph gke-tpu -var ...
+    python -m nvidia_terraform_modules_tpu.tfsim test gke-tpu [-filter F]
     python -m nvidia_terraform_modules_tpu.tfsim fmt -check gke-tpu gke
     python -m nvidia_terraform_modules_tpu.tfsim docs -check gke-tpu
 
@@ -44,6 +45,7 @@ from .state import (
     state_mv,
     state_rm,
 )
+from .test import format_results, run_tests
 from .validate import validate_module
 
 
@@ -363,6 +365,22 @@ def cmd_lock(args) -> int:
     return 1 if findings else 0
 
 
+def cmd_test(args) -> int:
+    """``terraform test``: run the module's ``*.tftest.hcl`` suites offline."""
+    try:
+        results = run_tests(args.dir, _gather_vars(args),
+                            filter_paths=args.filter)
+    except Exception as ex:  # module load / tfvars errors
+        print(f"Error: {ex}", file=sys.stderr)
+        return 1
+    if not results:
+        print(f"Error: no .tftest.hcl files under {args.dir!r}",
+              file=sys.stderr)
+        return 1
+    print(format_results(results))
+    return 0 if all(r.ok for r in results) else 1
+
+
 def cmd_docs(args) -> int:
     if args.check:
         ok = check_readme(args.dir)
@@ -417,6 +435,9 @@ def main(argv: list[str] | None = None) -> int:
     st.add_argument("address", nargs="*")
     st.add_argument("-state", required=True)
     st.set_defaults(fn=cmd_state)
+
+    t = add_module_cmd("test", cmd_test)
+    t.add_argument("-filter", action="append", dest="filter")
 
     f = sub.add_parser("fmt")
     f.add_argument("paths", nargs="+")

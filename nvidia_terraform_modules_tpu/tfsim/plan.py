@@ -47,6 +47,9 @@ class Plan:
     child_plans: dict[str, "Plan"] = dataclasses.field(default_factory=dict)
     check_failures: list[str] = dataclasses.field(default_factory=list)
     sensitive_outputs: set[str] = dataclasses.field(default_factory=set)
+    # effective variable values (tfvars merged over declaration defaults,
+    # optional() object attributes filled) — what var.* resolved to
+    variables: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def instance(self, address: str) -> PlannedInstance:
         return self.instances[address]
@@ -342,6 +345,7 @@ def simulate_plan(
         check_failures=check_failures,
         sensitive_outputs={n for n, o in module.outputs.items()
                            if o.sensitive},
+        variables=variables,
     )
 
 

@@ -1,0 +1,383 @@
+"""``tfsim test`` — offline analogue of terraform's native test framework.
+
+The reference repo has **no automated tests at all** (SURVEY §4:
+``/root/reference/CONTRIBUTING.md:56`` — "no CI/CD process in place yet …
+adequate testing … manually"). Modern terraform's answer is the ``.tftest.hcl``
+framework (``terraform test``): run blocks that plan/apply the module with
+fixture variables and assert on the planned values. tfsim ships the same
+surface so module test suites live next to the HCL they cover and run in CI
+with no cloud and no terraform binary:
+
+    tests/*.tftest.hcl              # discovered under the module dir
+    variables { ... }               # file-level fixture values
+    run "name" {
+      command = plan                # or apply (default)
+      variables { ... }             # run-level overrides
+      assert {
+        condition     = <expr over resources / data / output.* / var.*>
+        error_message = "..."
+      }
+      expect_failures = [var.x, check.y]   # the negative-path form
+    }
+
+Semantics mirrored from terraform: variable precedence is run block >
+file block > CLI ``-var``/``-var-file``; runs execute in file order and an
+``apply`` run's outputs are visible to later runs as ``run.<name>.<output>``;
+``check`` block failures fail a run unless listed in ``expect_failures``;
+a failed run does not stop the file (remaining runs still execute).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Any
+
+from . import ast as A
+from .eval import COMPUTED, EvalError, Scope, evaluate
+from .module import Module, load_module
+from .parser import HclParseError, parse_hcl
+from .plan import Plan, PlanError, simulate_plan
+from .state import State, apply_plan
+
+
+@dataclasses.dataclass
+class RunResult:
+    name: str
+    command: str                       # "plan" | "apply"
+    status: str                        # "pass" | "fail" | "error"
+    failures: list[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "pass"
+
+
+@dataclasses.dataclass
+class FileResult:
+    path: str
+    runs: list[RunResult] = dataclasses.field(default_factory=list)
+    error: str | None = None           # file-level parse/shape error
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None and all(r.ok for r in self.runs)
+
+
+def discover_test_files(module_dir: str) -> list[str]:
+    """``*.tftest.hcl`` directly in the module dir or its ``tests/`` subdir."""
+    out = []
+    for sub in ("", "tests"):
+        d = os.path.join(module_dir, sub) if sub else module_dir
+        if not os.path.isdir(d):
+            continue
+        out.extend(sorted(
+            os.path.join(d, f) for f in os.listdir(d)
+            if f.endswith(".tftest.hcl")))
+    return out
+
+
+def run_tests(module_dir: str, cli_vars: dict[str, Any] | None = None,
+              filter_paths: list[str] | None = None) -> list[FileResult]:
+    module = load_module(module_dir)
+    files = discover_test_files(module_dir)
+    if filter_paths:
+        wanted = {os.path.normpath(p) for p in filter_paths}
+        files = [f for f in files
+                 if os.path.normpath(f) in wanted or
+                 os.path.basename(f) in {os.path.basename(w) for w in wanted}]
+    return [run_test_file(module, f, cli_vars or {}) for f in files]
+
+
+def run_test_file(module: Module, path: str,
+                  cli_vars: dict[str, Any]) -> FileResult:
+    result = FileResult(path=path)
+    try:
+        with open(path) as fh:
+            body = parse_hcl(fh.read(), filename=path)
+    except (HclParseError, OSError) as ex:
+        result.error = str(ex)
+        return result
+
+    # CLI vars feed every run but only where the module declares the name —
+    # terraform's own behaviour (undeclared CLI vars warn, they don't error)
+    cli_vars = {k: v for k, v in cli_vars.items() if k in module.variables}
+
+    file_vars: dict[str, Any] = {}
+    run_outputs: dict[str, dict[str, Any]] = {}  # run name → plan outputs
+    state: State | None = None                   # rolls forward across applies
+    base = Scope()
+
+    for attr in body.attributes:
+        result.error = (f"{path}:{attr.line}: top-level attribute "
+                        f"{attr.name!r} not allowed in a test file")
+        return result
+
+    # file-level variables apply to EVERY run, wherever the block sits in
+    # the file (terraform semantics) — collect them before executing any run
+    for blk in body.blocks_of("variables"):
+        for attr in blk.body.attributes:
+            file_vars[attr.name] = evaluate(attr.expr, base)
+
+    runs_seen: set[str] = set()
+    for blk in body.blocks:
+        if blk.type == "variables":
+            continue
+        if blk.type == "provider":
+            continue                   # accepted and ignored: no real providers
+        if blk.type != "run":
+            result.error = (f"{path}:{blk.line}: unsupported block "
+                            f"{blk.type!r} in a test file")
+            return result
+        name = blk.labels[0] if blk.labels else f"<line {blk.line}>"
+        if name in runs_seen:
+            result.error = f"{path}:{blk.line}: duplicate run {name!r}"
+            return result
+        runs_seen.add(name)
+        rr, state = _execute_run(module, path, blk, name, cli_vars,
+                                 file_vars, run_outputs, state)
+        result.runs.append(rr)
+    return result
+
+
+def _execute_run(module: Module, path: str, blk: A.Block, name: str,
+                 cli_vars: dict, file_vars: dict,
+                 run_outputs: dict[str, dict[str, Any]],
+                 state: State | None) -> tuple[RunResult, State | None]:
+    # ---- run-level config ------------------------------------------------
+    command = "apply"
+    cmd_attr = blk.body.attr("command")
+    if cmd_attr is not None:
+        command = _bare_word(cmd_attr.expr)
+        if command not in ("plan", "apply"):
+            return RunResult(name, str(command), "error", [
+                f"{path}:{cmd_attr.line}: command must be plan or apply"]), \
+                state
+    rr = RunResult(name, command, "pass")
+
+    if blk.body.blocks_of("module"):
+        rr.status = "error"
+        rr.failures.append(
+            f"{path}:{blk.line}: run-level module {{ source = … }} blocks "
+            f"are not supported by tfsim (test the module directly)")
+        return rr, state
+
+    # run-level variables may read earlier runs' outputs (run.<name>.<out>)
+    # and the vars below them in the precedence chain (CLI < file)
+    var_scope = Scope(variables={**cli_vars, **file_vars})
+    var_scope.bindings["run"] = run_outputs
+    run_vars: dict[str, Any] = {}
+    for vblk in blk.body.blocks_of("variables"):
+        for attr in vblk.body.attributes:
+            try:
+                run_vars[attr.name] = evaluate(attr.expr, var_scope)
+            except EvalError as ex:
+                rr.status = "error"
+                rr.failures.append(f"{path}:{attr.line}: variables: {ex}")
+                return rr, state
+    merged = {**cli_vars, **file_vars, **run_vars}
+
+    expected = _expect_failures(blk)
+
+    # ---- plan ------------------------------------------------------------
+    try:
+        plan = simulate_plan(module, merged)
+    except (PlanError, EvalError) as ex:
+        matched = _match_expected_failure(str(ex), expected)
+        if matched:
+            expected.discard(matched)
+            if expected:
+                rr.status = "fail"
+                rr.failures.append(
+                    f"expected failures did not all occur: "
+                    f"{sorted(expected)} (plan stopped at: {ex})")
+            return rr, state
+        rr.status = "error" if not expected else "fail"
+        rr.failures.append(f"plan failed: {ex}")
+        return rr, state
+
+    # check-block failures fail the run unless expected (terraform test
+    # treats checks as assertions inside the module under test)
+    for failure in plan.check_failures:
+        m = re.match(r"check '([^']+)'", failure)
+        addr = f"check.{m.group(1)}" if m else None
+        if addr in expected:
+            expected.discard(addr)
+        else:
+            rr.status = "fail"
+            rr.failures.append(failure)
+    if expected:
+        rr.status = "fail"
+        rr.failures.append(
+            f"expected failures did not occur: {sorted(expected)}")
+
+    # ---- asserts ---------------------------------------------------------
+    # plan.variables carries the EFFECTIVE values (declaration defaults and
+    # optional() fills included), so `var.x == 2` holds for a default too
+    scope = _assert_scope(plan, plan.variables, run_outputs)
+    for ab in blk.body.blocks_of("assert"):
+        cond = ab.body.attr("condition")
+        if cond is None:
+            rr.status = "error"
+            rr.failures.append(
+                f"{path}:{ab.line}: assert without condition")
+            continue
+        try:
+            ok = evaluate(cond.expr, scope)
+        except EvalError as ex:
+            rr.status = "fail"
+            rr.failures.append(f"{path}:{cond.line}: condition error: {ex}")
+            continue
+        if ok is COMPUTED:
+            rr.status = "fail"
+            rr.failures.append(
+                f"{path}:{cond.line}: condition depends on a value only "
+                f"known after a real apply")
+            continue
+        if not ok:
+            msg_attr = ab.body.attr("error_message")
+            msg = ""
+            if msg_attr is not None:
+                try:
+                    msg = evaluate(msg_attr.expr, scope)
+                except EvalError:
+                    msg = "<error_message failed to evaluate>"
+            rr.status = "fail"
+            rr.failures.append(f"{path}:{ab.line}: {msg or 'assert failed'}")
+
+    # ---- apply: advance the rolling state, expose outputs to later runs --
+    if rr.ok:
+        if command == "apply":
+            try:
+                state = apply_plan(plan, state)
+            except ValueError as ex:       # defensive: diff/apply edge cases
+                rr.status = "error"
+                rr.failures.append(f"apply failed: {ex}")
+                return rr, state
+        run_outputs[name] = dict(plan.outputs)
+    return rr, state
+
+
+def _bare_word(expr: A.Expr) -> str:
+    """``command = plan`` parses as a bare traversal; unwrap to its word."""
+    if isinstance(expr, A.Traversal) and not expr.ops:
+        return expr.root
+    if isinstance(expr, A.Literal) and isinstance(expr.value, str):
+        return expr.value
+    return "<invalid>"
+
+
+def _expect_failures(blk: A.Block) -> set[str]:
+    attr = blk.body.attr("expect_failures")
+    if attr is None or not isinstance(attr.expr, A.TupleExpr):
+        return set()
+    out = set()
+    for item in attr.expr.items:
+        if isinstance(item, A.Traversal):
+            out.add(item.path_str())
+    return out
+
+
+def _match_expected_failure(message: str, expected: set[str]) -> str | None:
+    """The expect_failures entry a PlanError corresponds to, if any.
+
+    Variable validation failures carry the variable name
+    (``variable 'x' validation failed: …`` — plan.py); that is the one
+    checkable object whose failure aborts a plan.
+    """
+    m = re.search(r"variable '([^']+)' validation failed", message)
+    if m and f"var.{m.group(1)}" in expected:
+        return f"var.{m.group(1)}"
+    return None
+
+
+_ADDR_RE = re.compile(
+    r"^(?P<type>[\w-]+)\.(?P<name>[\w-]+)"
+    r"(?:\[(?:\"(?P<key>[^\"]*)\"|(?P<idx>\d+))\])?$")
+
+
+def _assert_scope(plan: Plan, variables: dict[str, Any],
+                  run_outputs: dict[str, dict[str, Any]]) -> Scope:
+    """Name resolution for assert conditions.
+
+    Rebuilds the resource/data tables from the planned instances (count →
+    list, for_each → dict, plain → attrs — the same shapes the planner
+    registers while evaluating the module), wires child-module outputs under
+    ``module.*``, the module's own outputs under ``output.*``, and earlier
+    runs under ``run.*``.
+    """
+    resources: dict[str, dict[str, Any]] = {}
+    data: dict[str, dict[str, Any]] = {}
+
+    # seed every planned node so a count=0 / empty-for_each resource still
+    # resolves (terraform: an empty tuple, so `length(x) == 0` asserts work)
+    for addr in plan.order:
+        if addr.startswith("module."):
+            continue
+        is_data = addr.startswith("data.")
+        m = _ADDR_RE.match(addr[5:] if is_data else addr)
+        if m is not None:
+            (data if is_data else resources).setdefault(
+                m.group("type"), {}).setdefault(m.group("name"), [])
+
+    for addr, inst in plan.instances.items():
+        if addr.startswith("module."):
+            continue
+        is_data = addr.startswith("data.")
+        m = _ADDR_RE.match(addr[5:] if is_data else addr)
+        if m is None:
+            continue
+        table = data if is_data else resources
+        slot = table.setdefault(m.group("type"), {})
+        if m.group("key") is not None:
+            if not isinstance(slot.get(m.group("name")), dict):
+                slot[m.group("name")] = {}     # replace the seeded []
+            slot[m.group("name")][m.group("key")] = inst.attrs
+        elif m.group("idx") is not None:
+            lst = slot.setdefault(m.group("name"), [])
+            lst.insert(int(m.group("idx")), inst.attrs)
+        else:
+            slot[m.group("name")] = inst.attrs
+
+    modules: dict[str, Any] = {}
+    for key, child in plan.child_plans.items():
+        m = re.match(r'^module\.([\w-]+)(?:\[(?:"([^"]*)"|(\d+))\])?$', key)
+        if m is None:
+            continue
+        name, fkey, idx = m.group(1), m.group(2), m.group(3)
+        if fkey is not None:
+            modules.setdefault(name, {})[fkey] = dict(child.outputs)
+        elif idx is not None:
+            modules.setdefault(name, []).insert(int(idx), dict(child.outputs))
+        else:
+            modules[name] = dict(child.outputs)
+
+    scope = Scope(variables=dict(variables), resources=resources, data=data,
+                  modules=modules)
+    scope.bindings["output"] = dict(plan.outputs)
+    scope.bindings["run"] = run_outputs
+    return scope
+
+
+def format_results(results: list[FileResult]) -> str:
+    """terraform-test-shaped report; one line per run, summary at the end."""
+    lines: list[str] = []
+    passed = failed = 0
+    for fr in results:
+        lines.append(f"{fr.path}... {'pass' if fr.ok else 'fail'}")
+        if fr.error:
+            failed += 1
+            lines.append(f"  error: {fr.error}")
+            continue
+        for rr in fr.runs:
+            lines.append(f'  run "{rr.name}"... {rr.status}')
+            if rr.ok:
+                passed += 1
+            else:
+                failed += 1
+            for f in rr.failures:
+                lines.append(f"    {f}")
+    verdict = "Success!" if failed == 0 else "Failure!"
+    lines.append(f"{verdict} {passed} passed, {failed} failed.")
+    return "\n".join(lines)

@@ -1,0 +1,371 @@
+"""``tfsim test`` — the .tftest.hcl native test framework, offline.
+
+The reference has no automated tests at all (SURVEY §4); this build goes the
+other way and ships terraform's modern test framework itself. These tests
+drive the verb against synthetic modules (semantics: asserts, run chaining,
+expect_failures, check blocks, apply-state threading) and then run the two
+suites shipped with the real modules.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from nvidia_terraform_modules_tpu.tfsim import run_tests
+from nvidia_terraform_modules_tpu.tfsim.__main__ import main
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def mini_module(tmp_path):
+    """A module with a validated variable, a check block, and an output."""
+    (tmp_path / "main.tf").write_text(textwrap.dedent("""\
+        variable "size" {
+          type    = number
+          default = 2
+          validation {
+            condition     = var.size > 0
+            error_message = "size must be positive."
+          }
+        }
+        variable "flag" {
+          type    = bool
+          default = true
+        }
+        resource "google_compute_network" "net" {
+          count = var.flag ? 1 : 0
+          name  = "net-${var.size}"
+        }
+        resource "google_compute_subnetwork" "sub" {
+          for_each      = var.flag ? { a = "10.0.0.0/24" } : {}
+          name          = each.key
+          ip_cidr_range = each.value
+        }
+        output "net_name" {
+          value = var.flag ? google_compute_network.net[0].name : "none"
+        }
+        check "size_is_even" {
+          assert {
+            condition     = var.size % 2 == 0
+            error_message = "size should be even."
+          }
+        }
+        """))
+    return tmp_path
+
+
+def _write_test(mod, text, name="main.tftest.hcl"):
+    d = mod / "tests"
+    d.mkdir(exist_ok=True)
+    (d / name).write_text(textwrap.dedent(text))
+
+
+def test_passing_asserts_and_resource_refs(mini_module):
+    _write_test(mini_module, """\
+        run "defaults" {
+          command = plan
+          assert {
+            condition     = google_compute_network.net[0].name == "net-2"
+            error_message = "interpolated name"
+          }
+          assert {
+            condition     = google_compute_subnetwork.sub["a"].ip_cidr_range == "10.0.0.0/24"
+            error_message = "for_each instance visible"
+          }
+          assert {
+            condition     = output.net_name == "net-2"
+            error_message = "output visible"
+          }
+        }
+        """)
+    (fr,) = run_tests(str(mini_module))
+    assert fr.ok, [r.failures for r in fr.runs]
+
+
+def test_failing_assert_reports_error_message(mini_module):
+    _write_test(mini_module, """\
+        run "bad" {
+          command = plan
+          assert {
+            condition     = output.net_name == "wrong"
+            error_message = "net_name mismatch: ${output.net_name}"
+          }
+        }
+        """)
+    (fr,) = run_tests(str(mini_module))
+    assert not fr.ok
+    assert fr.runs[0].status == "fail"
+    assert "net_name mismatch: net-2" in fr.runs[0].failures[0]
+
+
+def test_variable_precedence_run_over_file_over_cli(mini_module):
+    _write_test(mini_module, """\
+        variables {
+          size = 4
+        }
+        run "file_level" {
+          command = plan
+          assert {
+            condition     = var.size == 4
+            error_message = "file-level variables beat CLI vars"
+          }
+        }
+        run "run_level" {
+          command = plan
+          variables {
+            size = 6
+          }
+          assert {
+            condition     = google_compute_network.net[0].name == "net-6"
+            error_message = "run-level variables beat file-level"
+          }
+        }
+        """)
+    (fr,) = run_tests(str(mini_module), cli_vars={"size": 8, "undeclared": 1})
+    assert fr.ok, [r.failures for r in fr.runs]
+
+
+def test_run_outputs_chain_into_later_runs(mini_module):
+    _write_test(mini_module, """\
+        run "setup" {
+          variables {
+            size = 4
+          }
+        }
+        run "uses_setup" {
+          command = plan
+          variables {
+            size = 4
+          }
+          assert {
+            condition     = run.setup.net_name == "net-4"
+            error_message = "earlier run outputs must be addressable"
+          }
+        }
+        """)
+    (fr,) = run_tests(str(mini_module))
+    assert fr.ok, [r.failures for r in fr.runs]
+    assert fr.runs[0].command == "apply"   # terraform's default command
+
+
+def test_expect_failures_variable_validation(mini_module):
+    _write_test(mini_module, """\
+        run "negative" {
+          command = plan
+          variables {
+            size = -1
+          }
+          expect_failures = [var.size]
+        }
+        """)
+    (fr,) = run_tests(str(mini_module))
+    assert fr.ok, [r.failures for r in fr.runs]
+
+
+def test_unexpected_plan_failure_is_error(mini_module):
+    _write_test(mini_module, """\
+        run "boom" {
+          command = plan
+          variables {
+            size = -1
+          }
+        }
+        """)
+    (fr,) = run_tests(str(mini_module))
+    assert fr.runs[0].status == "error"
+    assert "validation failed" in fr.runs[0].failures[0]
+
+
+def test_expected_failure_that_does_not_occur_fails(mini_module):
+    _write_test(mini_module, """\
+        run "nothing_wrong" {
+          command = plan
+          variables {
+            size = 2
+          }
+          expect_failures = [var.size]
+        }
+        """)
+    (fr,) = run_tests(str(mini_module))
+    assert fr.runs[0].status == "fail"
+    assert "did not occur" in " ".join(fr.runs[0].failures)
+
+
+def test_check_block_fails_run_unless_expected(mini_module):
+    _write_test(mini_module, """\
+        run "odd_size_fails_check" {
+          command = plan
+          variables {
+            size = 3
+          }
+        }
+        run "odd_size_expected" {
+          command = plan
+          variables {
+            size = 3
+          }
+          expect_failures = [check.size_is_even]
+        }
+        """)
+    (fr,) = run_tests(str(mini_module))
+    assert fr.runs[0].status == "fail"
+    assert "size should be even" in fr.runs[0].failures[0]
+    assert fr.runs[1].status == "pass", fr.runs[1].failures
+
+
+def test_count_zero_resource_resolves_to_empty(mini_module):
+    _write_test(mini_module, """\
+        run "disabled" {
+          command = plan
+          variables {
+            flag = false
+          }
+          assert {
+            condition     = length(google_compute_network.net) == 0
+            error_message = "count=0 resolves to an empty tuple"
+          }
+          assert {
+            condition     = length(google_compute_subnetwork.sub) == 0
+            error_message = "empty for_each resolves to empty"
+          }
+        }
+        """)
+    (fr,) = run_tests(str(mini_module))
+    assert fr.ok, [r.failures for r in fr.runs]
+
+
+def test_computed_condition_fails_with_clear_message(mini_module):
+    _write_test(mini_module, """\
+        run "computed" {
+          command = plan
+          assert {
+            condition     = google_compute_network.net[0].id != ""
+            error_message = "ids are provider-computed"
+          }
+        }
+        """)
+    (fr,) = run_tests(str(mini_module))
+    assert fr.runs[0].status == "fail"
+    assert "known after a real apply" in fr.runs[0].failures[0]
+
+
+def test_unsupported_block_is_file_error(mini_module):
+    _write_test(mini_module, """\
+        mock_provider "google" {}
+        run "x" {
+          command = plan
+        }
+        """)
+    (fr,) = run_tests(str(mini_module))
+    assert not fr.ok
+    assert "mock_provider" in fr.error
+
+
+def test_assert_sees_declaration_defaults(mini_module):
+    """terraform resolves var.* from the effective set, defaults included."""
+    _write_test(mini_module, """\
+        run "defaults_visible" {
+          command = plan
+          assert {
+            condition     = var.size == 2
+            error_message = "declaration default must be visible to asserts"
+          }
+          assert {
+            condition     = var.flag == true
+            error_message = "unset bool default must be visible too"
+          }
+        }
+        """)
+    (fr,) = run_tests(str(mini_module))
+    assert fr.ok, [r.failures for r in fr.runs]
+
+
+def test_file_variables_block_applies_regardless_of_position(mini_module):
+    """A variables block below a run still feeds that run (terraform)."""
+    _write_test(mini_module, """\
+        run "first" {
+          command = plan
+          assert {
+            condition     = var.size == 4
+            error_message = "file-level variables apply to earlier runs too"
+          }
+        }
+        variables {
+          size = 4
+        }
+        """)
+    (fr,) = run_tests(str(mini_module))
+    assert fr.ok, [r.failures for r in fr.runs]
+
+
+def test_run_variables_can_reference_cli_vars(mini_module):
+    _write_test(mini_module, """\
+        run "derived" {
+          command = plan
+          variables {
+            size = var.size + 1
+          }
+          assert {
+            condition     = google_compute_network.net[0].name == "net-10"
+            error_message = "run-level expressions must see CLI vars"
+          }
+        }
+        """)
+    (fr,) = run_tests(str(mini_module), cli_vars={"size": 9})
+    assert fr.ok, [r.failures for r in fr.runs]
+
+
+# ---- CLI ------------------------------------------------------------------
+
+def test_cli_runs_shipped_suites(capsys):
+    assert main(["test", os.path.join(ROOT, "gke-tpu")]) == 0
+    out = capsys.readouterr().out
+    assert 'run "default_v5e8"... pass' in out
+    assert 'run "spot_reservation_conflict"... pass' in out
+    assert "Success!" in out
+
+    assert main(["test", os.path.join(ROOT, "gke")]) == 0
+    out = capsys.readouterr().out
+    assert 'run "cpu_only"... pass' in out
+
+
+def test_cli_exit_one_on_failure(mini_module, capsys):
+    _write_test(mini_module, """\
+        run "bad" {
+          command = plan
+          assert {
+            condition     = var.size == 99
+            error_message = "will not hold"
+          }
+        }
+        """)
+    assert main(["test", str(mini_module)]) == 1
+    out = capsys.readouterr().out
+    assert "Failure! 0 passed, 1 failed." in out
+
+
+def test_cli_filter_selects_file(mini_module, capsys):
+    _write_test(mini_module, """\
+        run "a" {
+          command = plan
+        }
+        """, name="a.tftest.hcl")
+    _write_test(mini_module, """\
+        run "b" {
+          command = plan
+          assert {
+            condition     = false
+            error_message = "never run when filtered out"
+          }
+        }
+        """, name="b.tftest.hcl")
+    assert main(["test", str(mini_module), "-filter", "a.tftest.hcl"]) == 0
+    assert 'run "a"... pass' in capsys.readouterr().out
+
+
+def test_cli_no_test_files_errors(tmp_path, capsys):
+    (tmp_path / "main.tf").write_text('locals {\n  a = 1\n}\n')
+    assert main(["test", str(tmp_path)]) == 1
+    assert "no .tftest.hcl" in capsys.readouterr().err
