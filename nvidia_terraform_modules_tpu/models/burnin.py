@@ -28,6 +28,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops.flash_attention import flash_attention
 from ..ops.ring_attention import dense_reference_attention, ring_self_attention
+from ..ops.ulysses_attention import ulysses_self_attention
 from ..parallel.sharding import ShardingRules
 from ..utils.layers import dense_init
 from ..utils.layers import rmsnorm as _rmsnorm
@@ -43,14 +44,19 @@ class BurnInConfig:
     seq_len: int = 128
     batch: int = 8
     dtype: Any = jnp.bfloat16
-    # "dense": gather the sequence, O(S²) attention sharded over heads (tp).
-    # "ring":  keep the sequence sharded on sp; K/V blocks rotate over the ICI
-    #          ring (ops.ring_attention) — exact, O(S/sp) resident memory, the
-    #          long-context path the slice's placement policy exists for.
-    #          Per-block tile math runs the pallas flash kernel (ring × flash
-    #          composition), so each visiting block gets fused VMEM tiles too.
-    # "flash": fused pallas kernel (ops.flash_attention) on the gathered
-    #          sequence — the [S,S] score matrix never touches HBM.
+    # "dense":   gather the sequence, O(S²) attention sharded over heads (tp).
+    # "ring":    keep the sequence sharded on sp; K/V blocks rotate over the
+    #            ICI ring (ops.ring_attention) — exact, O(S/sp) resident
+    #            memory, the long-context path the slice's placement policy
+    #            exists for. Per-block tile math runs the pallas flash kernel
+    #            (ring × flash composition).
+    # "ulysses": keep the sequence sharded on sp; one all-to-all scatters
+    #            heads / gathers sequence, local fused attention runs at full
+    #            sequence length on H/(sp·tp) heads, a mirror all-to-all
+    #            restores the layout (ops.ulysses_attention) — two
+    #            collectives total vs the ring's n-1 hops.
+    # "flash":   fused pallas kernel (ops.flash_attention) on the gathered
+    #            sequence — the [S,S] score matrix never touches HBM.
     attn: str = "dense"
     # n_experts > 0 swaps each block's dense FFN for a Switch-style top-1
     # MoE (models/moe.py): experts shard over the mesh's ep axis, the
@@ -61,9 +67,10 @@ class BurnInConfig:
     aux_loss_weight: float = 0.01
 
     def __post_init__(self):
-        if self.attn not in ("dense", "ring", "flash"):
+        if self.attn not in ("dense", "ring", "ulysses", "flash"):
             raise ValueError(
-                f"unknown attn impl {self.attn!r}; use dense|ring|flash")
+                f"unknown attn impl {self.attn!r}; "
+                f"use dense|ring|ulysses|flash")
         if self.n_experts < 0:
             raise ValueError(f"n_experts must be >= 0, got {self.n_experts}")
 
@@ -149,10 +156,12 @@ def forward_and_aux(params, tokens, cfg: BurnInConfig,
 
     aux = jnp.float32(0.0)
     use_ring = cfg.attn == "ring" and rules is not None
+    use_ulysses = cfg.attn == "ulysses" and rules is not None
     for layer in params["layers"]:
         h = _rmsnorm(x, layer["attn_norm"])
-        if use_ring:
-            # sequence stays sharded on sp; only K/V blocks travel (ICI ring)
+        if use_ring or use_ulysses:
+            # sequence stays sharded on sp; either K/V blocks travel (ring)
+            # or one all-to-all each way re-shards seq ↔ heads (ulysses)
             h = act(h, "sp", None)
             seq_dims = ("sp", "tp", None)
         else:
@@ -171,6 +180,10 @@ def forward_and_aux(params, tokens, cfg: BurnInConfig,
         q, k, v = split(q), split(k), split(v)
         if use_ring:
             attn = ring_self_attention(
+                q, k, v, rules.mesh, causal=True, spec=seq_spec
+            )
+        elif use_ulysses:
+            attn = ulysses_self_attention(
                 q, k, v, rules.mesh, causal=True, spec=seq_spec
             )
         elif cfg.attn == "flash":

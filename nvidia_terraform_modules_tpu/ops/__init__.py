@@ -1,4 +1,4 @@
-"""Hardware micro-probes and TPU-first compute ops (ring attention)."""
+"""Hardware micro-probes and TPU-first compute ops (ring/Ulysses attention)."""
 
 from .flash_attention import flash_attention  # noqa: F401
 from .probes import hbm_probe, matmul_probe  # noqa: F401
@@ -6,4 +6,8 @@ from .ring_attention import (  # noqa: F401
     dense_reference_attention,
     ring_attention_kernel,
     ring_self_attention,
+)
+from .ulysses_attention import (  # noqa: F401
+    ulysses_attention_kernel,
+    ulysses_self_attention,
 )

@@ -444,3 +444,17 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
     o = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), scale, causal,
                     block_q, block_k, interpret)
     return o.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def pick_impl(impl: str | None, seq_len: int, what: str) -> str:
+    """Shared flash/dense tile-math selection for the sharded attention
+    wrappers (ring, Ulysses). ``impl=None`` picks "flash" when ``seq_len``
+    (the length the LOCAL attention problem runs at) tiles into 8-multiple
+    blocks, "dense" otherwise — so shapes that worked pre-flash keep
+    working; an explicit impl is validated and passed through."""
+    if impl not in (None, "dense", "flash"):
+        raise ValueError(f"unknown {what} impl {impl!r}; use dense|flash")
+    if impl is not None:
+        return impl
+    return "flash" if (seq_len <= 8 and _on_interpret_platform()) or \
+        _fit_block(seq_len, None) >= 8 else "dense"

@@ -34,6 +34,7 @@ from .flash_attention import (
     flash_dkv,
     flash_dq,
     flash_partial,
+    pick_impl,
 )
 
 NEG_INF = -1e30  # finite ­"-inf": avoids NaN from (-inf) - (-inf) in the update
@@ -319,12 +320,8 @@ def ring_self_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
     flash when the local shard length tiles into 8-multiple blocks, dense
     otherwise, so shapes that worked in round 1 keep working.
     """
-    if impl not in (None, "dense", "flash"):
-        raise ValueError(f"unknown ring impl {impl!r}; use dense|flash")
-    if impl is None:
-        s_loc = q.shape[1] // mesh.shape[axis_name]
-        impl = "flash" if (s_loc <= 8 and _on_interpret_platform()) or \
-            _fit_block(s_loc, None) >= 8 else "dense"
+    # the ring's local problem runs at the SHARD length (K/V blocks visit)
+    impl = pick_impl(impl, q.shape[1] // mesh.shape[axis_name], "ring")
     kern = ring_attention_kernel if impl == "dense" else \
         ring_flash_attention_kernel
     kernel = functools.partial(

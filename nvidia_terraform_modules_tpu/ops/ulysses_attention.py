@@ -1,0 +1,127 @@
+"""Ulysses attention: all-to-all sequence parallelism over the ``sp`` axis.
+
+The second of the two canonical long-context layouts (the first, ring
+attention, is ``ops/ring_attention.py``). Where the ring keeps *heads* local
+and rotates K/V blocks neighbour-to-neighbour (n-1 ICI hops, compute/comm
+overlapped), Ulysses re-shards *once* each way: an all-to-all swaps the
+sequence shard for a head shard, every device then holds the FULL sequence
+for ``H/sp`` heads and runs ordinary fused attention locally, and a second
+all-to-all swaps back. Two collectives total, each moving ``(sp-1)/sp`` of
+the activations — cheaper than the ring when ``sp`` is small relative to the
+per-step compute, and it composes with the pallas flash kernel for free
+because the local problem IS plain full-sequence attention.
+
+The reference framework has no sequence dimension (SURVEY §5 — an IaC repo);
+its long-context analogue is "scale the slice". These two ops are the
+workload-side story for the slices the ``gke-tpu`` module provisions: ring
+rides the COMPACT-placement ICI ring, Ulysses rides the same fabric's
+all-to-all bandwidth.
+
+TPU-first notes:
+- ``jax.lax.all_to_all(tiled=True)`` inside ``shard_map`` lowers straight to
+  the XLA AllToAll HLO on ICI; both directions are one fused collective, and
+  autodiff transposes an all-to-all into the mirror all-to-all, so the
+  backward pass needs no custom VJP.
+- head-count divisibility (``H_local % sp == 0``) is the layout's one hard
+  constraint; checked eagerly with a clear error naming the axis sizes.
+- the local attention reuses ``flash_attention`` (fused pallas tiles) when
+  the shapes tile onto the MXU, dense XLA einsum otherwise — the same
+  impl-selection contract as ``ring_self_attention``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .flash_attention import flash_attention, pick_impl
+from .ring_attention import dense_reference_attention
+
+
+def ulysses_attention_kernel(q, k, v, *, axis_name: str, causal: bool = True,
+                             scale: float | None = None, impl: str = "dense",
+                             interpret: bool | None = None):
+    """Per-shard Ulysses body; call inside ``shard_map``.
+
+    Args:
+      q, k, v: local shards ``[B, S_local, H_local, D]`` — sequence sharded
+        over ``axis_name``, heads possibly sharded over a tensor axis by the
+        caller's spec.
+      axis_name: mesh axis carrying the sequence shards.
+      causal: causal masking in global positions (exact: after the first
+        all-to-all every device holds the full sequence, so the local mask
+        IS the global mask).
+      impl: local attention tile math — "flash" (pallas) or "dense".
+
+    Returns ``[B, S_local, H_local, D]`` in ``q.dtype``.
+    """
+    sp = jax.lax.axis_size(axis_name)
+    b, s_loc, h_loc, d = q.shape
+    if h_loc % sp:
+        raise ValueError(
+            f"Ulysses needs local head count divisible by the sequence axis: "
+            f"{h_loc} heads per shard vs {axis_name}={sp} (global heads must "
+            f"be a multiple of sp × tp)")
+
+    def seq_to_heads(t):
+        # [3, B, S/sp, H, D] → [3, B, S, H/sp, D]: scatter heads, gather
+        # sequence — q/k/v ride ONE stacked collective (2 per layer total
+        # with the output's mirror, as the module docstring promises)
+        return jax.lax.all_to_all(t, axis_name, split_axis=3, concat_axis=2,
+                                  tiled=True)
+
+    def heads_to_seq(t):
+        # [B, S, H/sp, D] → [B, S/sp, H, D]: the mirror all-to-all
+        return jax.lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    if sp > 1:
+        q, k, v = seq_to_heads(jnp.stack((q, k, v)))
+    if impl == "flash":
+        out = flash_attention(q, k, v, causal=causal, scale=scale,
+                              interpret=interpret)
+    else:
+        out = dense_reference_attention(q, k, v, causal=causal, scale=scale)
+    if sp > 1:
+        out = heads_to_seq(out)
+    return out
+
+
+def ulysses_self_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
+                           axis_name: str = "sp",
+                           spec: P = P("dp", "sp", "tp", None),
+                           scale: float | None = None,
+                           impl: str | None = None):
+    """shard_map wrapper: exact attention with sequence sharded on ``axis_name``
+    via head-scatter/sequence-gather all-to-alls (DeepSpeed-Ulysses layout).
+
+    ``q, k, v`` are global arrays ``[B, S, H, D]``; ``spec`` maps (batch → dp,
+    sequence → sp, heads → tp). ``impl`` picks the local tile math the same
+    way ``ring_self_attention`` does: ``"flash"``, ``"dense"``, or ``None``
+    (flash when the FULL sequence tiles into 8-multiple blocks — after the
+    all-to-all the local problem has global sequence length).
+    """
+    sp = mesh.shape[axis_name]
+    heads = q.shape[2]
+    tp_axes = spec[2]
+    tp = 1
+    if tp_axes is not None:
+        for ax in ([tp_axes] if isinstance(tp_axes, str) else tp_axes):
+            tp *= mesh.shape[ax]
+    if heads % (sp * tp):
+        raise ValueError(
+            f"Ulysses layout needs heads divisible by sp×tp: "
+            f"{heads} heads vs sp={sp} × tp={tp}")
+    # local attention runs at GLOBAL sequence length (post all-to-all)
+    impl = pick_impl(impl, q.shape[1], "ulysses")
+    kernel = functools.partial(
+        ulysses_attention_kernel, axis_name=axis_name, causal=causal,
+        scale=scale, impl=impl,
+    )
+    return jax.shard_map(
+        kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
