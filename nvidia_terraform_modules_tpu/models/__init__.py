@@ -15,6 +15,13 @@ from .moe import (  # noqa: F401
     init_moe_params,
     moe_layer,
 )
+from .optimizer import (  # noqa: F401
+    AdamWConfig,
+    adamw_update,
+    init_opt_state,
+    make_adamw_train_step,
+    opt_state_shardings,
+)
 from .checkpoint import (  # noqa: F401
     Checkpointer,
     clear_checkpoints,

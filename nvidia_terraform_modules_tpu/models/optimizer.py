@@ -1,0 +1,163 @@
+"""AdamW with ZeRO-1 style optimizer-state sharding over the data axes.
+
+The burn-in's default SGD step is deliberately state-free (compile-fast on a
+cold slice — ``burnin.make_train_step``). Real training carries optimizer
+moments, and on TPU the idiomatic ZeRO-1 is *declarative*: give the moment
+tensors a sharding that partitions them over the data-parallel axes and let
+XLA's SPMD partitioner derive the communication — each dp rank updates only
+its shard of ``mu``/``nu`` (the grad arrives via the reduce-scatter half of
+the gradient psum) and the parameter delta is all-gathered back to the
+replicated parameters. That is exactly the ZeRO-1 reduce-scatter/all-gather
+schedule, with zero hand-written collectives (no NCCL analogue — SURVEY §2.6).
+
+The optimizer state pytree deliberately mirrors the params pytree
+(``{"step", "mu", "nu"}`` with params-shaped moments) instead of optax's
+nested named-tuples, so the sharding derivation is one ``jax.tree.map`` over
+``(params, param_shardings)`` — no path surgery. ``tests/test_optimizer.py``
+cross-checks the math against ``optax.adamw`` leaf by leaf.
+
+Moments are kept in f32 even for bf16 params (master-statistics convention);
+the extra HBM is the thing ZeRO-1 shards away: per chip the moment footprint
+is ``2 × |params| × 4 bytes / dp``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel.sharding import ShardingRules
+from .burnin import BurnInConfig, init_params, loss_fn, param_shardings
+
+
+@dataclasses.dataclass(frozen=True)
+class AdamWConfig:
+    lr: float = 1e-3
+    b1: float = 0.9
+    b2: float = 0.999
+    eps: float = 1e-8
+    weight_decay: float = 0.01
+
+
+def init_opt_state(params) -> dict[str, Any]:
+    """Zero moments, params-shaped, f32; step counter for bias correction."""
+    f32_zeros = lambda p: jnp.zeros(p.shape, jnp.float32)  # noqa: E731
+    return {
+        "step": jnp.zeros((), jnp.int32),
+        "mu": jax.tree.map(f32_zeros, params),
+        "nu": jax.tree.map(f32_zeros, params),
+    }
+
+
+def _zero1_sharding(leaf, ns: NamedSharding, rules: ShardingRules):
+    """Moment sharding for one param: the param's own spec, plus the first
+    still-replicated, evenly-divisible dimension sharded over the data axes.
+
+    Data axes the param already uses are skipped — on an ep mesh
+    (``data=("dp","ep")``) expert tensors are sharded over ``ep`` for the
+    params themselves, so their moments partition over the remaining
+    ``("dp",)`` only (a mesh axis may appear once per spec). Falls back to
+    the param's own sharding when no dimension divides (e.g. norm scales of
+    odd length) — correctness never depends on the partitioning.
+    """
+    mesh = rules.mesh
+    spec = tuple(ns.spec) + (None,) * (leaf.ndim - len(ns.spec))
+    used: set[str] = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        used.update([entry] if isinstance(entry, str) else entry)
+    axes = tuple(ax for ax in rules.data if ax not in used)
+    dp = 1
+    for ax in axes:
+        dp *= mesh.shape[ax]
+    if dp > 1:
+        for i, (dim, s) in enumerate(zip(leaf.shape, spec)):
+            if s is None and dim % dp == 0 and dim >= dp:
+                spec = spec[:i] + (axes,) + spec[i + 1:]
+                break
+    return NamedSharding(mesh, P(*spec))
+
+
+def opt_state_shardings(abstract_params, rules: ShardingRules):
+    """NamedSharding pytree matching ``init_opt_state(params)``."""
+    ps = param_shardings(abstract_params, rules)
+    moments = jax.tree.map(
+        lambda leaf, ns: _zero1_sharding(leaf, ns, rules),
+        abstract_params, ps)
+    return {
+        "step": NamedSharding(rules.mesh, P()),
+        "mu": moments,
+        "nu": moments,
+    }
+
+
+def adamw_update(params, grads, state, opt: AdamWConfig):
+    """One AdamW step; moments in f32, decoupled weight decay, bias-corrected.
+
+    Pure function of (params, grads, state) — everything jit-traceable, so
+    the caller's shardings fully determine the ZeRO-1 partitioning.
+    """
+    step = state["step"] + 1
+    t = step.astype(jnp.float32)
+    c1 = 1.0 - opt.b1 ** t
+    c2 = 1.0 - opt.b2 ** t
+
+    def upd(p, g, m, v):
+        g = g.astype(jnp.float32)
+        m = opt.b1 * m + (1.0 - opt.b1) * g
+        v = opt.b2 * v + (1.0 - opt.b2) * jnp.square(g)
+        delta = (m / c1) / (jnp.sqrt(v / c2) + opt.eps)
+        delta = delta + opt.weight_decay * p.astype(jnp.float32)
+        return (p.astype(jnp.float32) - opt.lr * delta).astype(p.dtype), m, v
+
+    out = jax.tree.map(upd, params, grads, state["mu"], state["nu"])
+    new_params = jax.tree.map(lambda o: o[0], out,
+                              is_leaf=lambda x: isinstance(x, tuple))
+    mu = jax.tree.map(lambda o: o[1], out,
+                      is_leaf=lambda x: isinstance(x, tuple))
+    nu = jax.tree.map(lambda o: o[2], out,
+                      is_leaf=lambda x: isinstance(x, tuple))
+    return new_params, {"step": step, "mu": mu, "nu": nu}
+
+
+def make_adamw_train_step(cfg: BurnInConfig,
+                          rules: ShardingRules | None = None,
+                          opt: AdamWConfig | None = None):
+    """Jitted AdamW train step with ZeRO-1 state shardings.
+
+    Returns ``(init_state_fn, step_fn)``:
+    ``step_fn(params, opt_state, batch) → (params, opt_state, loss)``.
+    With ``rules``, params/batch keep the burn-in shardings, the moments get
+    the dp-partitioned ZeRO-1 shardings, and both are pinned as jit
+    in/out shardings so the partitioner cannot silently replicate them.
+    """
+    opt = opt or AdamWConfig()
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, rules)
+        params, opt_state = adamw_update(params, grads, opt_state, opt)
+        return params, opt_state, loss
+
+    if rules is None:
+        return init_opt_state, jax.jit(step)
+
+    abstract_params = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg))
+    ps = param_shardings(abstract_params, rules)
+    ss = opt_state_shardings(abstract_params, rules)
+
+    def init_state(params):
+        return jax.jit(init_opt_state, out_shardings=ss)(params)
+
+    batch_s = rules.shard(rules.act(None))
+    step_fn = jax.jit(
+        step,
+        in_shardings=(ps, ss, (batch_s, batch_s)),
+        out_shardings=(ps, ss, NamedSharding(rules.mesh, P())),
+    )
+    return init_state, step_fn
