@@ -17,6 +17,7 @@ from .moe import (  # noqa: F401
 )
 from .optimizer import (  # noqa: F401
     AdamWConfig,
+    abstract_train_state,
     adamw_update,
     init_opt_state,
     make_adamw_train_step,

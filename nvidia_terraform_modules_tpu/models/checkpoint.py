@@ -124,6 +124,27 @@ class Checkpointer:
         shards directly — the resume path costs one HBM-resident copy,
         same as init. Returns None when no checkpoint exists.
         """
+        abstract = jax.eval_shape(
+            lambda: init_params(jax.random.PRNGKey(0), cfg))
+        if rules is not None:
+            shardings = param_shardings(abstract, rules)
+            abstract = jax.tree.map(
+                lambda a, s: jax.ShapeDtypeStruct(
+                    a.shape, a.dtype, sharding=s),
+                abstract, shardings)
+        return self.restore_tree(abstract, step)
+
+    def restore_tree(self, abstract: Any, step: int | None = None,
+                     ) -> tuple[Any, int, dict[str, Any]] | None:
+        """Restore an arbitrary pytree saved with :meth:`save`.
+
+        ``abstract`` is a ``jax.ShapeDtypeStruct`` pytree (shardings
+        included) describing the target placement — the generalisation of
+        :meth:`restore` for trees that aren't bare burn-in params, e.g. the
+        AdamW train state ``{"params": …, "opt": …}`` whose moments carry
+        ZeRO-1 shardings (``models/optimizer.py``). Returns
+        ``(tree, step, meta)`` or None when no checkpoint exists.
+        """
         import orbax.checkpoint as ocp
 
         if _no_checkpoint_possible(self.directory):
@@ -133,14 +154,6 @@ class Checkpointer:
             step = mgr.latest_step()
         if step is None:
             return None
-        abstract = jax.eval_shape(
-            lambda: init_params(jax.random.PRNGKey(0), cfg))
-        if rules is not None:
-            shardings = param_shardings(abstract, rules)
-            abstract = jax.tree.map(
-                lambda a, s: jax.ShapeDtypeStruct(
-                    a.shape, a.dtype, sharding=s),
-                abstract, shardings)
         restored = mgr.restore(step, args=ocp.args.Composite(
             params=ocp.args.StandardRestore(abstract),
             meta=ocp.args.JsonRestore(),

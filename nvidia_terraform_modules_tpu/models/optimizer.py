@@ -125,6 +125,32 @@ def adamw_update(params, grads, state, opt: AdamWConfig):
     return new_params, {"step": step, "mu": mu, "nu": nu}
 
 
+def abstract_train_state(cfg: BurnInConfig,
+                         rules: ShardingRules | None = None):
+    """ShapeDtypeStruct pytree for ``{"params", "opt"}`` with shardings.
+
+    The placement contract for checkpoint restore
+    (``Checkpointer.restore_tree``): params carry the burn-in shardings,
+    moments carry the ZeRO-1 shardings, so a resumed spot Job lands every
+    shard directly on the mesh — no host gather, no resharding step.
+    """
+    abstract_params = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg))
+    abstract_opt = jax.eval_shape(init_opt_state, abstract_params)
+    if rules is None:
+        return {"params": abstract_params, "opt": abstract_opt}
+    ps = param_shardings(abstract_params, rules)
+    ss = opt_state_shardings(abstract_params, rules)
+
+    def place(a, s):
+        return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s)
+
+    return {
+        "params": jax.tree.map(place, abstract_params, ps),
+        "opt": jax.tree.map(place, abstract_opt, ss),
+    }
+
+
 def make_adamw_train_step(cfg: BurnInConfig,
                           rules: ShardingRules | None = None,
                           opt: AdamWConfig | None = None):
