@@ -15,6 +15,9 @@ terraform binary in CI, so tfsim ships the same verbs offline::
     python -m nvidia_terraform_modules_tpu.tfsim state list|show|rm|mv ... -state f
     python -m nvidia_terraform_modules_tpu.tfsim graph gke-tpu -var ...
     python -m nvidia_terraform_modules_tpu.tfsim test gke-tpu [-filter F]
+    python -m nvidia_terraform_modules_tpu.tfsim workspace new gke-tpu staging
+    python -m nvidia_terraform_modules_tpu.tfsim console gke-tpu -var ... \
+        -e 'local.slice_fleet' [-e EXPR ...]   # or expressions on stdin
     python -m nvidia_terraform_modules_tpu.tfsim fmt -check gke-tpu gke
     python -m nvidia_terraform_modules_tpu.tfsim docs -check gke-tpu
 
@@ -45,8 +48,20 @@ from .state import (
     state_mv,
     state_rm,
 )
+from .console import ConsoleError, build_scope, eval_expression
 from .test import format_results, run_tests
 from .validate import validate_module
+from .workspace import (
+    WorkspaceError,
+    current_workspace,
+    delete_workspace,
+    list_workspaces,
+    new_workspace,
+    resolve_state_path,
+    select_workspace,
+    workspace_state_path,
+    workspaces_enabled,
+)
 
 
 def _parse_var(kv: str):
@@ -86,22 +101,54 @@ def cmd_validate(args) -> int:
     return 1 if errors else 0
 
 
+def _workspace_of(args) -> str:
+    """Effective workspace: -workspace flag > selected > default.
+
+    A ``-workspace`` name must already exist (terraform refuses unknown
+    names) — otherwise a typo would silently fork state into a fresh empty
+    workspace instead of erroring.
+    """
+    ws = getattr(args, "workspace", None)
+    if ws:
+        if ws not in list_workspaces(args.dir):
+            raise WorkspaceError(
+                f'workspace "{ws}" does not exist — create it with '
+                f'`workspace new {ws}`')
+        return ws
+    if workspaces_enabled(args.dir):
+        return current_workspace(args.dir)
+    return "default"
+
+
+def _write_state(path: str, state: State) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as fh:
+        fh.write(state.to_json())
+
+
 def _plan_against_state(args):
-    """(plan, prior-state-after-moved-migration) for plan/apply verbs."""
+    """(plan, prior-state, state-path) for plan/apply/import verbs.
+
+    The state path honours workspaces: explicit ``-state`` wins, else the
+    selected workspace's ``terraform.tfstate.d`` file (opt-in — only once a
+    workspace verb has been used in the dir).
+    """
     mod = load_module(args.dir)
-    plan = simulate_plan(mod, _gather_vars(args))
-    prior = _load_state(args.state)
+    plan = simulate_plan(mod, _gather_vars(args), workspace=_workspace_of(args))
+    state_path = resolve_state_path(args.dir, args.state,
+                                    getattr(args, "workspace", None))
+    prior = _load_state(state_path)
     if prior is not None:
         prior, renames = migrate_state(prior, mod)
         for old, new in renames:
             # stderr: diagnostics must not corrupt `plan -json` stdout
             print(f"  moved: {old} -> {new}", file=sys.stderr)
-    return plan, prior
+    return plan, prior, state_path
 
 
 def cmd_plan(args) -> int:
     try:
-        plan, prior = _plan_against_state(args)
+        plan, prior, _ = _plan_against_state(args)
         d = diff(plan, prior, getattr(args, "target", None))
     except (PlanError, ValueError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
@@ -137,16 +184,15 @@ def cmd_plan(args) -> int:
 
 def cmd_apply(args) -> int:
     try:
-        plan, prior = _plan_against_state(args)
+        plan, prior, state_path = _plan_against_state(args)
         targets = getattr(args, "target", None)
         d = diff(plan, prior, targets)
         state = apply_plan(plan, prior, targets, d=d)
     except (PlanError, ValueError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
         return 1
-    if args.state:
-        with open(args.state, "w") as fh:
-            fh.write(state.to_json())
+    if state_path:
+        _write_state(state_path, state)
     for failure in plan.check_failures:
         print(f"Warning: {failure}", file=sys.stderr)
     print(d.summary().replace("Plan:", "Apply complete:")
@@ -164,9 +210,19 @@ def cmd_output(args) -> int:
     semantics: the list view masks sensitive values; naming an output (or
     ``-json``) reveals them.
     """
-    state = _load_state(args.state)
+    if not args.state and not args.dir:
+        print("Error: output needs -state FILE or -dir MODULE_DIR "
+              "(workspace-resolved)", file=sys.stderr)
+        return 2
+    try:
+        state_path = args.state or workspace_state_path(
+            args.dir, _workspace_of(args))
+    except WorkspaceError as ex:
+        print(f"Error: {ex}", file=sys.stderr)
+        return 1
+    state = _load_state(state_path)
     if state is None:
-        print(f"Error: no state at {args.state!r} — apply first",
+        print(f"Error: no state at {state_path!r} — apply first",
               file=sys.stderr)
         return 1
     if args.name:
@@ -242,8 +298,7 @@ def cmd_state(args) -> int:
         return 1
 
     def save(new_state: State) -> None:
-        with open(args.state, "w") as fh:
-            fh.write(new_state.to_json())
+        _write_state(args.state, new_state)
 
     try:
         if args.subcmd == "list":
@@ -282,21 +337,20 @@ def cmd_state(args) -> int:
 
 def cmd_import(args) -> int:
     """``terraform import DIR ADDR ID``: adopt a live resource into state."""
-    if not args.state:
-        print("Error: import requires -state (the file to adopt into)",
-              file=sys.stderr)
-        return 2
     try:
         # same path as plan/apply — including moved{} migration: importing
         # a rename destination against un-migrated state would wedge the
         # statefile at the next plan ("destination already exists")
-        plan, prior = _plan_against_state(args)
+        plan, prior, state_path = _plan_against_state(args)
+        if not state_path:
+            print("Error: import requires -state (or a selected workspace) "
+                  "to adopt into", file=sys.stderr)
+            return 2
         state = import_resource(prior, plan, args.address, args.id)
     except (PlanError, ValueError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
         return 1
-    with open(args.state, "w") as fh:
-        fh.write(state.to_json())
+    _write_state(state_path, state)
     print(f"{args.address}: Import prepared. Resource written to state.")
     return 0
 
@@ -365,6 +419,69 @@ def cmd_lock(args) -> int:
     return 1 if findings else 0
 
 
+def cmd_workspace(args) -> int:
+    """``terraform workspace list|new|select|show|delete`` per module dir."""
+    n = len(args.name)
+    needs_name = args.subcmd in ("new", "select", "delete")
+    if needs_name != (n == 1):
+        print(f"Error: workspace {args.subcmd} takes "
+              f"{'exactly one name' if needs_name else 'no arguments'}",
+              file=sys.stderr)
+        return 2
+    try:
+        if args.subcmd == "list":
+            cur = current_workspace(args.dir)
+            for name in list_workspaces(args.dir):
+                print(f"{'*' if name == cur else ' '} {name}")
+        elif args.subcmd == "show":
+            print(current_workspace(args.dir))
+        elif args.subcmd == "new":
+            new_workspace(args.dir, args.name[0])
+            print(f'Created and switched to workspace "{args.name[0]}"!')
+        elif args.subcmd == "select":
+            select_workspace(args.dir, args.name[0])
+            print(f'Switched to workspace "{args.name[0]}".')
+        elif args.subcmd == "delete":
+            delete_workspace(args.dir, args.name[0], force=args.force)
+            print(f'Deleted workspace "{args.name[0]}"!')
+    except WorkspaceError as ex:
+        print(f"Error: {ex}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_console(args) -> int:
+    """``terraform console``: evaluate expressions against the planned module.
+
+    ``-e EXPR`` (repeatable) evaluates and exits; otherwise expressions are
+    read line-by-line from stdin (blank lines and ``#`` comments skipped).
+    Each value prints as one JSON line; an error prints to stderr and makes
+    the exit code 1, but later expressions still run (REPL semantics).
+    """
+    try:
+        ws = _workspace_of(args)
+        mod = load_module(args.dir)
+        plan = simulate_plan(mod, _gather_vars(args), workspace=ws)
+    except (PlanError, ValueError) as ex:
+        print(f"Error: {ex}", file=sys.stderr)
+        return 1
+    scope = build_scope(mod, plan, workspace=ws)
+    lines = args.expr if args.expr else (
+        line for line in sys.stdin.read().splitlines())
+    rc = 0
+    for line in lines:
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            print(json.dumps(render(eval_expression(line, scope)),
+                             sort_keys=True))
+        except ConsoleError as ex:
+            print(f"Error: {ex}", file=sys.stderr)
+            rc = 1
+    return rc
+
+
 def cmd_test(args) -> int:
     """``terraform test``: run the module's ``*.tftest.hcl`` suites offline."""
     try:
@@ -415,17 +532,34 @@ def main(argv: list[str] | None = None) -> int:
     c.add_argument("-json", action="store_true")
     c.add_argument("-show-noop", action="store_true")
     c.add_argument("-target", action="append", dest="target")
+    c.add_argument("-workspace", default=None)
     a = add_module_cmd("apply", cmd_apply, state=True)
     a.add_argument("-target", action="append", dest="target")
+    a.add_argument("-workspace", default=None)
     add_module_cmd("destroy", cmd_destroy)
     add_module_cmd("graph", cmd_graph)
     imp = add_module_cmd("import", cmd_import, state=True)
     imp.add_argument("address")
     imp.add_argument("id")
+    imp.add_argument("-workspace", default=None)
+
+    ws = sub.add_parser("workspace")
+    ws.add_argument("subcmd",
+                    choices=["list", "new", "select", "show", "delete"])
+    ws.add_argument("dir")
+    ws.add_argument("name", nargs="*")
+    ws.add_argument("-force", action="store_true")
+    ws.set_defaults(fn=cmd_workspace)
+
+    con = add_module_cmd("console", cmd_console)
+    con.add_argument("-e", action="append", dest="expr")
+    con.add_argument("-workspace", default=None)
 
     o = sub.add_parser("output")
     o.add_argument("name", nargs="?", default=None)
-    o.add_argument("-state", required=True)
+    o.add_argument("-state", default=None)
+    o.add_argument("-dir", default=None)
+    o.add_argument("-workspace", default=None)
     o.add_argument("-json", action="store_true")
     o.add_argument("-raw", action="store_true")
     o.set_defaults(fn=cmd_output)

@@ -1,0 +1,55 @@
+"""``tfsim console`` — evaluate HCL expressions against a planned module.
+
+Terraform's ``console`` is the operator's probe into a configuration: it
+resolves ``var.*`` / ``local.*`` / resource attributes / functions the same
+way plan does, which the reference's README-driven workflow leans on for
+debugging variable wiring. tfsim ships the same verb offline: the module is
+planned once (so resource attributes carry their plan-time values, computed
+ones render as ``<computed>``), then each expression is parsed and evaluated
+in that scope.
+
+Values print as JSON (tfsim's canonical rendering — ``plan -json`` uses the
+same), not terraform's HCL-ish syntax; sensitive outputs are NOT masked here,
+matching ``terraform console``'s behaviour of resolving raw values.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .eval import EvalError, Scope, evaluate
+from .module import Module
+from .parser import HclParseError, parse_hcl
+from .plan import LazyLocals, Plan, plan_eval_scope
+
+
+class ConsoleError(ValueError):
+    pass
+
+
+def build_scope(module: Module, plan: Plan,
+                workspace: str = "default") -> Scope:
+    """Evaluation scope with vars, locals, planned resources, and outputs."""
+    scope = plan_eval_scope(plan, plan.variables)
+    scope.locals = LazyLocals(module.locals, scope)
+    scope.path_module = module.path
+    scope.workspace = workspace
+    return scope
+
+
+def parse_expression(text: str):
+    """Parse one HCL expression (console input line) into an AST."""
+    try:
+        body = parse_hcl(f"__console = {text.strip()}", filename="<console>")
+    except HclParseError as ex:
+        raise ConsoleError(str(ex))
+    if len(body.attributes) != 1 or body.blocks:
+        raise ConsoleError(f"not a single expression: {text.strip()!r}")
+    return body.attributes[0].expr
+
+
+def eval_expression(text: str, scope: Scope) -> Any:
+    try:
+        return evaluate(parse_expression(text), scope)
+    except EvalError as ex:
+        raise ConsoleError(str(ex))

@@ -66,6 +66,7 @@ class Scope:
         each: Any = None,
         count_index: int | None = None,
         path_module: str = ".",
+        workspace: str = "default",
     ):
         self.variables = variables or {}
         self.locals = locals_ or {}
@@ -75,12 +76,14 @@ class Scope:
         self.each = each
         self.count_index = count_index
         self.path_module = path_module
+        self.workspace = workspace
         self.bindings: dict[str, Any] = {}  # for-expression vars
 
     def child_bindings(self, **kw: Any) -> "Scope":
         s = Scope(
             self.variables, self.locals, self.resources, self.data,
             self.modules, self.each, self.count_index, self.path_module,
+            self.workspace,
         )
         s.bindings = {**self.bindings, **kw}
         return s
@@ -191,7 +194,7 @@ class _Evaluator:
         if root == "path":
             return {"module": s.path_module, "root": s.path_module, "cwd": "."}, e.ops
         if root == "terraform":
-            return {"workspace": "default"}, e.ops
+            return {"workspace": s.workspace}, e.ops
         if root == "data":
             if not e.ops or e.ops[0][0] != "attr":
                 raise EvalError("data reference needs a type")

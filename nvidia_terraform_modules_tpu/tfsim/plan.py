@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import re
 from typing import Any, Optional
 
 from . import ast as A
@@ -223,6 +224,7 @@ def simulate_plan(
     module: Module | str,
     tfvars: dict[str, Any] | None = None,
     *,
+    workspace: str = "default",
     _depth: int = 0,
 ) -> Plan:
     if isinstance(module, str):
@@ -246,7 +248,8 @@ def simulate_plan(
     if tfvars:
         raise PlanError(f"unknown tfvars: {sorted(tfvars)}")
 
-    scope = Scope(variables=variables, path_module=module.path)
+    scope = Scope(variables=variables, path_module=module.path,
+                  workspace=workspace)
 
     # variable validation blocks (condition + error_message)
     for name, var in module.variables.items():
@@ -413,7 +416,8 @@ def _plan_resource(addr: str, r: Resource, scope: Scope,
         vals = []
         for i in range(n):
             sub = Scope(scope.variables, scope.locals, scope.resources,
-                        scope.data, scope.modules, None, i, scope.path_module)
+                        scope.data, scope.modules, None, i, scope.path_module,
+                        scope.workspace)
             sub.bindings = dict(scope.bindings)
             attrs = _eval_body(r.body, sub, top_level=True)
             attrs.setdefault("id", COMPUTED)
@@ -433,7 +437,8 @@ def _plan_resource(addr: str, r: Resource, scope: Scope,
         for k, v in items:
             sub = Scope(scope.variables, scope.locals, scope.resources,
                         scope.data, scope.modules,
-                        {"key": k, "value": v}, None, scope.path_module)
+                        {"key": k, "value": v}, None, scope.path_module,
+                        scope.workspace)
             sub.bindings = dict(scope.bindings)
             attrs = _eval_body(r.body, sub, top_level=True)
             attrs.setdefault("id", COMPUTED)
@@ -477,7 +482,8 @@ def _plan_module_call(addr: str, mc, parent: Module, scope: Scope,
         expansions = []
         for i in range(int(n)):
             sub = Scope(scope.variables, scope.locals, scope.resources,
-                        scope.data, scope.modules, None, i, scope.path_module)
+                        scope.data, scope.modules, None, i, scope.path_module,
+                        scope.workspace)
             sub.bindings = dict(scope.bindings)
             expansions.append((f"[{i}]", sub))
     elif foreach_attr is not None:
@@ -490,7 +496,7 @@ def _plan_module_call(addr: str, mc, parent: Module, scope: Scope,
         for k, v in items:
             sub = Scope(scope.variables, scope.locals, scope.resources,
                         scope.data, scope.modules, {"key": k, "value": v},
-                        None, scope.path_module)
+                        None, scope.path_module, scope.workspace)
             sub.bindings = dict(scope.bindings)
             expansions.append((f'["{k}"]', sub))
     else:
@@ -505,7 +511,8 @@ def _plan_module_call(addr: str, mc, parent: Module, scope: Scope,
             args[attr.name] = evaluate(attr.expr, sub_scope)
         if src and (src.startswith("./") or src.startswith("../")):
             child_path = os.path.normpath(os.path.join(parent.path, src))
-            child_plan = simulate_plan(child_path, args, _depth=depth + 1)
+            child_plan = simulate_plan(child_path, args, _depth=depth + 1,
+                                       workspace=sub_scope.workspace)
             if child_plans is not None:
                 child_plans[f"{addr}{suffix}"] = child_plan
             for iaddr, inst in child_plan.instances.items():
@@ -668,6 +675,76 @@ def _select_one(plan: Plan, t: str, universe, prefix: str) -> set[str]:
         # bracketed resource instance (res["k"]): just that subtree
         kept |= {i for i in universe if _under(i, prefix + t)}
     return kept
+
+
+_ADDR_RE = re.compile(
+    r"^(?P<type>[\w-]+)\.(?P<name>[\w-]+)"
+    r"(?:\[(?:\"(?P<key>[^\"]*)\"|(?P<idx>\d+))\])?$")
+
+
+def plan_eval_scope(plan: Plan, variables: dict[str, Any],
+                    run_outputs: dict[str, dict[str, Any]] | None = None,
+                    ) -> Scope:
+    """Name resolution over a completed plan (asserts, console).
+
+    Rebuilds the resource/data tables from the planned instances (count →
+    list, for_each → dict, plain → attrs — the same shapes the planner
+    registers while evaluating the module), wires child-module outputs under
+    ``module.*``, the module's own outputs under ``output.*``, and earlier
+    runs under ``run.*``.
+    """
+    resources: dict[str, dict[str, Any]] = {}
+    data: dict[str, dict[str, Any]] = {}
+
+    # seed every planned node so a count=0 / empty-for_each resource still
+    # resolves (terraform: an empty tuple, so `length(x) == 0` asserts work)
+    for addr in plan.order:
+        if addr.startswith("module."):
+            continue
+        is_data = addr.startswith("data.")
+        m = _ADDR_RE.match(addr[5:] if is_data else addr)
+        if m is not None:
+            (data if is_data else resources).setdefault(
+                m.group("type"), {}).setdefault(m.group("name"), [])
+
+    for addr, inst in plan.instances.items():
+        if addr.startswith("module."):
+            continue
+        is_data = addr.startswith("data.")
+        m = _ADDR_RE.match(addr[5:] if is_data else addr)
+        if m is None:
+            continue
+        table = data if is_data else resources
+        slot = table.setdefault(m.group("type"), {})
+        if m.group("key") is not None:
+            if not isinstance(slot.get(m.group("name")), dict):
+                slot[m.group("name")] = {}     # replace the seeded []
+            slot[m.group("name")][m.group("key")] = inst.attrs
+        elif m.group("idx") is not None:
+            lst = slot.setdefault(m.group("name"), [])
+            lst.insert(int(m.group("idx")), inst.attrs)
+        else:
+            slot[m.group("name")] = inst.attrs
+
+    modules: dict[str, Any] = {}
+    for key, child in plan.child_plans.items():
+        m = re.match(r'^module\.([\w-]+)(?:\[(?:"([^"]*)"|(\d+))\])?$', key)
+        if m is None:
+            continue
+        name, fkey, idx = m.group(1), m.group(2), m.group(3)
+        if fkey is not None:
+            modules.setdefault(name, {})[fkey] = dict(child.outputs)
+        elif idx is not None:
+            modules.setdefault(name, []).insert(int(idx), dict(child.outputs))
+        else:
+            modules[name] = dict(child.outputs)
+
+    scope = Scope(variables=dict(variables), resources=resources, data=data,
+                  modules=modules)
+    scope.bindings["output"] = dict(plan.outputs)
+    scope.bindings["run"] = run_outputs or {}
+    return scope
+
 
 
 def to_dot(plan: Plan) -> str:

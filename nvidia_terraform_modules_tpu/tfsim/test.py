@@ -38,7 +38,7 @@ from . import ast as A
 from .eval import COMPUTED, EvalError, Scope, evaluate
 from .module import Module, load_module
 from .parser import HclParseError, parse_hcl
-from .plan import Plan, PlanError, simulate_plan
+from .plan import Plan, PlanError, plan_eval_scope, simulate_plan
 from .state import State, apply_plan
 
 
@@ -215,7 +215,7 @@ def _execute_run(module: Module, path: str, blk: A.Block, name: str,
     # ---- asserts ---------------------------------------------------------
     # plan.variables carries the EFFECTIVE values (declaration defaults and
     # optional() fills included), so `var.x == 2` holds for a default too
-    scope = _assert_scope(plan, plan.variables, run_outputs)
+    scope = plan_eval_scope(plan, plan.variables, run_outputs)
     for ab in blk.body.blocks_of("assert"):
         cond = ab.body.attr("condition")
         if cond is None:
@@ -290,74 +290,6 @@ def _match_expected_failure(message: str, expected: set[str]) -> str | None:
     if m and f"var.{m.group(1)}" in expected:
         return f"var.{m.group(1)}"
     return None
-
-
-_ADDR_RE = re.compile(
-    r"^(?P<type>[\w-]+)\.(?P<name>[\w-]+)"
-    r"(?:\[(?:\"(?P<key>[^\"]*)\"|(?P<idx>\d+))\])?$")
-
-
-def _assert_scope(plan: Plan, variables: dict[str, Any],
-                  run_outputs: dict[str, dict[str, Any]]) -> Scope:
-    """Name resolution for assert conditions.
-
-    Rebuilds the resource/data tables from the planned instances (count →
-    list, for_each → dict, plain → attrs — the same shapes the planner
-    registers while evaluating the module), wires child-module outputs under
-    ``module.*``, the module's own outputs under ``output.*``, and earlier
-    runs under ``run.*``.
-    """
-    resources: dict[str, dict[str, Any]] = {}
-    data: dict[str, dict[str, Any]] = {}
-
-    # seed every planned node so a count=0 / empty-for_each resource still
-    # resolves (terraform: an empty tuple, so `length(x) == 0` asserts work)
-    for addr in plan.order:
-        if addr.startswith("module."):
-            continue
-        is_data = addr.startswith("data.")
-        m = _ADDR_RE.match(addr[5:] if is_data else addr)
-        if m is not None:
-            (data if is_data else resources).setdefault(
-                m.group("type"), {}).setdefault(m.group("name"), [])
-
-    for addr, inst in plan.instances.items():
-        if addr.startswith("module."):
-            continue
-        is_data = addr.startswith("data.")
-        m = _ADDR_RE.match(addr[5:] if is_data else addr)
-        if m is None:
-            continue
-        table = data if is_data else resources
-        slot = table.setdefault(m.group("type"), {})
-        if m.group("key") is not None:
-            if not isinstance(slot.get(m.group("name")), dict):
-                slot[m.group("name")] = {}     # replace the seeded []
-            slot[m.group("name")][m.group("key")] = inst.attrs
-        elif m.group("idx") is not None:
-            lst = slot.setdefault(m.group("name"), [])
-            lst.insert(int(m.group("idx")), inst.attrs)
-        else:
-            slot[m.group("name")] = inst.attrs
-
-    modules: dict[str, Any] = {}
-    for key, child in plan.child_plans.items():
-        m = re.match(r'^module\.([\w-]+)(?:\[(?:"([^"]*)"|(\d+))\])?$', key)
-        if m is None:
-            continue
-        name, fkey, idx = m.group(1), m.group(2), m.group(3)
-        if fkey is not None:
-            modules.setdefault(name, {})[fkey] = dict(child.outputs)
-        elif idx is not None:
-            modules.setdefault(name, []).insert(int(idx), dict(child.outputs))
-        else:
-            modules[name] = dict(child.outputs)
-
-    scope = Scope(variables=dict(variables), resources=resources, data=data,
-                  modules=modules)
-    scope.bindings["output"] = dict(plan.outputs)
-    scope.bindings["run"] = run_outputs
-    return scope
 
 
 def format_results(results: list[FileResult]) -> str:

@@ -1,0 +1,148 @@
+"""Workspaces: named state environments per module dir (terraform-shaped).
+
+Terraform workspaces let one configuration hold several independent states
+(``terraform workspace new staging`` → state moves to
+``terraform.tfstate.d/staging/``; the selection lives in
+``.terraform/environment``). The reference's README leans on exactly this
+"one module, many deployments" pattern via separate tfvars files
+(``/root/reference/README.md:43-79``); workspaces are the CLI-native face of
+it, and ``terraform.workspace`` is referenceable from HCL (e.g. per-env
+cluster names).
+
+tfsim mirrors the on-disk contract, adapted to its explicit-state model:
+
+- the selection lives in ``<dir>/.tfsim/environment`` (analogue of
+  ``.terraform/environment`` — also outside version control);
+- per-workspace state: ``<dir>/terraform.tfstate.json`` for ``default``,
+  ``<dir>/terraform.tfstate.d/<name>/terraform.tfstate.json`` otherwise
+  (terraform's exact layout, with tfsim's ``.json`` statefile suffix);
+- state-path resolution is OPT-IN: ``plan``/``apply``/``output`` only derive
+  a state path from the workspace when the module dir has an environment
+  file (i.e. a workspace verb has been used there) and no explicit
+  ``-state`` was passed — so existing explicit-state workflows and CI runs
+  are untouched.
+"""
+
+from __future__ import annotations
+
+import os
+
+DEFAULT = "default"
+_STATE_FILE = "terraform.tfstate.json"
+
+
+class WorkspaceError(ValueError):
+    pass
+
+
+def _env_file(module_dir: str) -> str:
+    return os.path.join(module_dir, ".tfsim", "environment")
+
+
+def _state_dir(module_dir: str) -> str:
+    return os.path.join(module_dir, "terraform.tfstate.d")
+
+
+def workspaces_enabled(module_dir: str) -> bool:
+    """True once any workspace verb has run in this module dir."""
+    return os.path.exists(_env_file(module_dir))
+
+
+def current_workspace(module_dir: str) -> str:
+    try:
+        with open(_env_file(module_dir)) as fh:
+            name = fh.read().strip()
+        return name or DEFAULT
+    except OSError:
+        return DEFAULT
+
+
+def list_workspaces(module_dir: str) -> list[str]:
+    """All known workspaces: ``default`` plus every state subdirectory."""
+    names = {DEFAULT}
+    d = _state_dir(module_dir)
+    if os.path.isdir(d):
+        names.update(n for n in os.listdir(d)
+                     if os.path.isdir(os.path.join(d, n)))
+    return sorted(names)
+
+
+def workspace_state_path(module_dir: str, name: str | None = None) -> str:
+    """The statefile a workspace owns (terraform.tfstate.d layout)."""
+    name = name or current_workspace(module_dir)
+    if name == DEFAULT:
+        return os.path.join(module_dir, _STATE_FILE)
+    return os.path.join(_state_dir(module_dir), name, _STATE_FILE)
+
+
+def resolve_state_path(module_dir: str, explicit: str | None,
+                       workspace: str | None = None) -> str | None:
+    """State path for a plan/apply/output invocation.
+
+    Explicit ``-state`` always wins; otherwise the workspace's statefile —
+    but only when workspaces are enabled for the dir (opt-in, see module
+    docstring). Returns None to mean "no state" (the legacy behaviour).
+    """
+    if explicit:
+        return explicit
+    if workspace or workspaces_enabled(module_dir):
+        return workspace_state_path(module_dir, workspace)
+    return None
+
+
+def _select(module_dir: str, name: str) -> None:
+    env = _env_file(module_dir)
+    os.makedirs(os.path.dirname(env), exist_ok=True)
+    with open(env, "w") as fh:
+        fh.write(name + "\n")
+
+
+def new_workspace(module_dir: str, name: str) -> None:
+    _check_name(name)
+    if name in list_workspaces(module_dir):
+        raise WorkspaceError(f'workspace "{name}" already exists')
+    if name != DEFAULT:
+        os.makedirs(os.path.join(_state_dir(module_dir), name), exist_ok=True)
+    _select(module_dir, name)
+
+
+def select_workspace(module_dir: str, name: str) -> None:
+    if name not in list_workspaces(module_dir):
+        raise WorkspaceError(
+            f'workspace "{name}" does not exist — create it with '
+            f'`workspace new {name}`')
+    _select(module_dir, name)
+
+
+def delete_workspace(module_dir: str, name: str, force: bool = False) -> None:
+    if name == DEFAULT:
+        raise WorkspaceError('the "default" workspace cannot be deleted')
+    if name == current_workspace(module_dir):
+        raise WorkspaceError(
+            f'workspace "{name}" is the current workspace — select another '
+            f'one first')
+    if name not in list_workspaces(module_dir):
+        raise WorkspaceError(f'workspace "{name}" does not exist')
+    state = workspace_state_path(module_dir, name)
+    if os.path.exists(state) and not force:
+        # terraform refuses to delete a non-empty workspace without -force
+        raise WorkspaceError(
+            f'workspace "{name}" still has state ({state}); re-run with '
+            f'-force to discard it')
+    try:
+        if os.path.exists(state):
+            os.remove(state)
+        d = os.path.join(_state_dir(module_dir), name)
+        if os.path.isdir(d):
+            os.rmdir(d)
+    except OSError as ex:
+        # e.g. stray files next to the statefile: keep the CLI's
+        # "Error: …" exit-1 contract instead of a traceback
+        raise WorkspaceError(
+            f'could not remove workspace "{name}": {ex}')
+
+
+def _check_name(name: str) -> None:
+    if not name or not all(c.isalnum() or c in "-_" for c in name):
+        raise WorkspaceError(
+            f"invalid workspace name {name!r}: use letters, digits, - and _")

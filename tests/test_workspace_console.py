@@ -1,0 +1,207 @@
+"""tfsim workspace + console verbs: per-env state, terraform.workspace, REPL.
+
+Workspaces give one configuration several independent states (the
+reference's "one module, many deployments" pattern, CLI-native); console is
+the operator's expression probe. Both must honour tfsim's opt-in contract:
+explicit ``-state`` workflows and existing CI runs see no behaviour change
+until a workspace verb is used in a module dir.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from nvidia_terraform_modules_tpu.tfsim.__main__ import main
+
+
+@pytest.fixture()
+def mod(tmp_path):
+    (tmp_path / "main.tf").write_text(textwrap.dedent("""\
+        variable "base" {
+          type    = string
+          default = "app"
+        }
+        locals {
+          name = "${var.base}-${terraform.workspace}"
+        }
+        resource "google_compute_network" "net" {
+          name = local.name
+        }
+        output "name" {
+          value = local.name
+        }
+        """))
+    return str(tmp_path)
+
+
+def _ws_state(mod, name):
+    return os.path.join(mod, "terraform.tfstate.d", name,
+                        "terraform.tfstate.json")
+
+
+# ---- workspaces -----------------------------------------------------------
+
+def test_workspace_lifecycle(mod, capsys):
+    assert main(["workspace", "list", mod]) == 0
+    assert capsys.readouterr().out.strip() == "* default"
+
+    assert main(["workspace", "new", mod, "staging"]) == 0
+    capsys.readouterr()
+    assert main(["workspace", "show", mod]) == 0
+    assert capsys.readouterr().out.strip() == "staging"
+
+    assert main(["workspace", "select", mod, "default"]) == 0
+    capsys.readouterr()
+    assert main(["workspace", "list", mod]) == 0
+    out = capsys.readouterr().out
+    assert "* default" in out and "  staging" in out
+
+
+def test_workspace_select_missing_errors(mod, capsys):
+    assert main(["workspace", "select", mod, "nope"]) == 1
+    assert "does not exist" in capsys.readouterr().err
+
+
+def test_workspace_new_duplicate_errors(mod, capsys):
+    assert main(["workspace", "new", mod, "dev"]) == 0
+    assert main(["workspace", "new", mod, "dev"]) == 1
+    assert "already exists" in capsys.readouterr().err
+
+
+def test_workspace_state_isolation_and_interpolation(mod, capsys):
+    """apply in each workspace writes its own statefile, and
+    terraform.workspace flows into the planned values."""
+    assert main(["workspace", "new", mod, "staging"]) == 0
+    assert main(["apply", mod]) == 0
+    assert os.path.exists(_ws_state(mod, "staging"))
+
+    assert main(["workspace", "select", mod, "default"]) == 0
+    assert main(["apply", mod]) == 0
+    assert os.path.exists(os.path.join(mod, "terraform.tfstate.json"))
+    capsys.readouterr()
+
+    assert main(["output", "-state", _ws_state(mod, "staging"), "name"]) == 0
+    assert json.loads(capsys.readouterr().out) == "app-staging"
+    assert main(["output", "-state",
+                 os.path.join(mod, "terraform.tfstate.json"), "name"]) == 0
+    assert json.loads(capsys.readouterr().out) == "app-default"
+
+
+def test_workspace_flag_overrides_selection(mod, capsys):
+    assert main(["workspace", "new", mod, "prod"]) == 0
+    assert main(["workspace", "select", mod, "default"]) == 0
+    capsys.readouterr()
+    assert main(["console", mod, "-workspace", "prod",
+                 "-e", "terraform.workspace"]) == 0
+    assert json.loads(capsys.readouterr().out) == "prod"
+
+
+def test_workspace_opt_in_no_state_written_without_verbs(mod):
+    """Until a workspace verb runs, apply keeps the legacy no-state mode."""
+    assert main(["apply", mod]) == 0
+    assert not os.path.exists(os.path.join(mod, "terraform.tfstate.json"))
+    assert not os.path.exists(os.path.join(mod, ".tfsim"))
+
+
+def test_workspace_delete_guards(mod, capsys):
+    assert main(["workspace", "new", mod, "tmp"]) == 0
+    # current workspace: refuse
+    assert main(["workspace", "delete", mod, "tmp"]) == 1
+    assert "current workspace" in capsys.readouterr().err
+    assert main(["workspace", "select", mod, "default"]) == 0
+    # default: refuse
+    assert main(["workspace", "delete", mod, "default"]) == 1
+    capsys.readouterr()
+    # non-empty: refuse without -force
+    assert main(["workspace", "select", mod, "tmp"]) == 0
+    assert main(["apply", mod]) == 0
+    assert main(["workspace", "select", mod, "default"]) == 0
+    capsys.readouterr()
+    assert main(["workspace", "delete", mod, "tmp"]) == 1
+    assert "-force" in capsys.readouterr().err
+    assert main(["workspace", "delete", mod, "tmp", "-force"]) == 0
+    assert not os.path.exists(os.path.dirname(_ws_state(mod, "tmp")))
+
+
+def test_workspace_plan_against_workspace_state_is_noop(mod, capsys):
+    assert main(["workspace", "new", mod, "dev"]) == 0
+    assert main(["apply", mod]) == 0
+    capsys.readouterr()
+    assert main(["plan", mod]) == 0
+    assert "Plan: 0 to add, 0 to change, 0 to destroy." in \
+        capsys.readouterr().out
+
+
+# ---- console --------------------------------------------------------------
+
+def test_console_expressions(mod, capsys):
+    assert main(["console", mod,
+                 "-e", "local.name",
+                 "-e", "upper(var.base)",
+                 "-e", "google_compute_network.net.name",
+                 "-e", "[for i in range(3) : i * 2]"]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert [json.loads(x) for x in lines] == [
+        "app-default", "APP", "app-default", [0, 2, 4]]
+
+
+def test_console_stdin(mod, capsys, monkeypatch):
+    import io
+
+    monkeypatch.setattr("sys.stdin",
+                        io.StringIO("# comment\n\nlocal.name\nvar.base\n"))
+    assert main(["console", mod]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert [json.loads(x) for x in lines] == ["app-default", "app"]
+
+
+def test_console_computed_renders_placeholder(mod, capsys):
+    assert main(["console", mod, "-e", "google_compute_network.net.id"]) == 0
+    assert json.loads(capsys.readouterr().out) == "<computed>"
+
+
+def test_console_error_continues_and_exits_one(mod, capsys):
+    assert main(["console", mod, "-e", "var.nope", "-e", "var.base"]) == 1
+    out = capsys.readouterr()
+    assert json.loads(out.out) == "app"      # later expressions still ran
+    assert "nope" in out.err
+
+
+def test_console_var_override(mod, capsys):
+    assert main(["console", mod, "-var", "base=svc",
+                 "-e", "local.name"]) == 0
+    assert json.loads(capsys.readouterr().out) == "svc-default"
+
+
+def test_workspace_flag_typo_refuses(mod, capsys):
+    """-workspace with an unknown name must error, not fork fresh state."""
+    assert main(["workspace", "new", mod, "prod"]) == 0
+    capsys.readouterr()
+    assert main(["apply", mod, "-workspace", "prdo"]) == 1
+    assert "does not exist" in capsys.readouterr().err
+    assert not os.path.exists(_ws_state(mod, "prdo"))
+
+
+def test_output_follows_workspace(mod, capsys):
+    assert main(["workspace", "new", mod, "stg"]) == 0
+    assert main(["apply", mod]) == 0
+    capsys.readouterr()
+    assert main(["output", "-dir", mod, "name"]) == 0
+    assert json.loads(capsys.readouterr().out) == "app-stg"
+    assert main(["output", "-dir", mod, "-workspace", "default", "name"]) == 1
+    assert "apply first" in capsys.readouterr().err
+    assert main(["output"]) == 2
+    assert "-state FILE or -dir" in capsys.readouterr().err
+
+
+def test_workspace_delete_stray_file_is_clean_error(mod, capsys):
+    assert main(["workspace", "new", mod, "tmp"]) == 0
+    assert main(["workspace", "select", mod, "default"]) == 0
+    stray = os.path.join(mod, "terraform.tfstate.d", "tmp", "notes.txt")
+    with open(stray, "w") as fh:
+        fh.write("stray")
+    capsys.readouterr()
+    assert main(["workspace", "delete", mod, "tmp", "-force"]) == 1
+    assert "could not remove" in capsys.readouterr().err
