@@ -86,6 +86,34 @@ def main() -> None:
     mfu = (train_step_flops(cfg) / step_seconds) / (
         device_spec().bf16_tflops * 1e12)
 
+    # serve-side: greedy KV-cache decode throughput (HBM-bound regime —
+    # weights + cache re-read every step; the serving counterpart of the
+    # train-step MFU above)
+    import dataclasses
+
+    from nvidia_terraform_modules_tpu.models import make_decoder
+
+    # same model as the burn-in MFU measurement (one source of truth for
+    # the flagship dims), decode-shaped: dense cached attention, batch 8
+    dec_cfg = dataclasses.replace(cfg, attn="dense",
+                                  batch=8 if on_tpu else cfg.batch)
+    prompt_len, n_new = (512, 64) if on_tpu else (8, 8)
+    dec_params = init_params(jax.random.PRNGKey(0), dec_cfg)
+    decoder = make_decoder(dec_cfg, n_new=n_new,
+                           max_len=prompt_len + n_new)
+    prompt = jax.random.randint(jax.random.PRNGKey(3),
+                                (dec_cfg.batch, prompt_len), 0,
+                                dec_cfg.vocab)
+    toks = decoder(dec_params, prompt)   # compile
+    sync(toks)
+    t_dec = time.perf_counter()
+    dec_iters = 3
+    for _ in range(dec_iters):
+        toks = decoder(dec_params, prompt)
+    sync(toks)
+    dec_seconds = (time.perf_counter() - t_dec) / dec_iters
+    decode_tokens_per_s = dec_cfg.batch * n_new / dec_seconds
+
     # long-context attention: pallas flash kernel vs XLA dense at S=4096 —
     # the regime ring/flash attention exist for (O(S²) HBM traffic dominates)
     longctx: dict[str, float] = {}
@@ -142,6 +170,9 @@ def main() -> None:
         "burnin_attn": cfg.attn,
         "burnin_seq_len": cfg.seq_len,
         "burnin_mfu": round(mfu, 3),
+        "decode_tokens_per_s": round(decode_tokens_per_s, 1),
+        "decode_batch": dec_cfg.batch,
+        "decode_prompt_len": prompt_len,
         **longctx,
     }
     print(json.dumps(line), flush=True)

@@ -15,6 +15,12 @@ from .moe import (  # noqa: F401
     init_moe_params,
     moe_layer,
 )
+from .decode import (  # noqa: F401
+    forward_cached,
+    greedy_decode,
+    init_cache,
+    make_decoder,
+)
 from .optimizer import (  # noqa: F401
     AdamWConfig,
     abstract_train_state,

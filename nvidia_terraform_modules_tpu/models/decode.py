@@ -1,0 +1,197 @@
+"""KV-cache autoregressive decoding for the burn-in transformer.
+
+The serve-side counterpart of the training burn-in: the ``gke-tpu``
+examples name slice pools "serve" next to "train", and a framework that
+validates a fresh slice should exercise the inference shape too — small
+batched matmuls against a growing context, the regime where HBM bandwidth
+(reading the weights and the cache every step), not MXU FLOPs, bounds
+throughput. ``bench.py`` reports ``decode_tokens_per_s`` from this path.
+
+TPU-first design:
+- **static shapes**: the cache is a fixed ``[B, S_max, H, D]`` buffer per
+  layer; each step writes one position with ``lax.dynamic_update_slice``
+  and attends over the full buffer under a position mask — no dynamic
+  shapes, so the whole generate loop compiles to one XLA program;
+- **one program**: prefill (full-prompt causal forward that fills the
+  cache) plus a ``lax.scan`` over decode steps, all under one ``jit``;
+- **sharded**: the cache shards like activations — batch over the data
+  axes, heads over ``tp`` (each device holds its heads' cache, matching
+  the Megatron-style projection sharding), so decode runs on the same
+  mesh the train step used with zero resharding.
+
+Exactness contract: greedy tokens from this path equal greedy tokens from
+repeatedly running the full ``burnin.forward`` on the growing sequence
+(``tests/test_decode.py``) — the cache is an optimisation, never a
+different model. MoE configs are rejected for now (routing a single token
+through the capacity machinery is a different serving problem).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.sharding import ShardingRules
+from ..utils.layers import rmsnorm as _rmsnorm
+from .burnin import BurnInConfig
+
+
+def _check_cfg(cfg: BurnInConfig) -> None:
+    if cfg.n_experts > 0:
+        raise ValueError(
+            "KV-cache decode supports the dense FFN only (MoE serving is a "
+            "separate problem: per-token routing without capacity batching)")
+    if cfg.attn != "dense":
+        # prefill materialises [B, H, T, S_max] f32 scores — fine at decode
+        # prompt lengths, an OOM trap at the long-context shapes the
+        # flash/ring/ulysses training paths exist for. Refuse loudly; a
+        # flash-prefill (chunked prompt through the pallas kernel) is the
+        # future fix. Serving a flash-trained model: decode with
+        # dataclasses.replace(cfg, attn="dense") — weights are identical.
+        raise ValueError(
+            f"KV-cache decode uses dense cached attention; cfg.attn="
+            f"{cfg.attn!r} implies prompt lengths where dense prefill "
+            f"would not fit — decode with replace(cfg, attn='dense') and "
+            f"short prompts, or wait for chunked flash prefill")
+
+
+def init_cache(cfg: BurnInConfig, batch: int, max_len: int,
+               rules: ShardingRules | None = None) -> dict[str, Any]:
+    """Zeroed KV cache: per layer ``[B, S_max, H, D]`` k/v buffers.
+
+    ``pos`` is the number of valid positions (python-int 0 at init,
+    traced i32 afterwards).
+    """
+    _check_cfg(cfg)
+    shape = (batch, max_len, cfg.n_heads, cfg.head_dim)
+    kv = {
+        "k": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
+        "v": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
+        "pos": jnp.zeros((), jnp.int32),
+    }
+    if rules is not None:
+        s = rules.shard(rules.act(None, "tp", None))
+        kv["k"] = [jax.device_put(x, s) for x in kv["k"]]
+        kv["v"] = [jax.device_put(x, s) for x in kv["v"]]
+    return kv
+
+
+def _cached_attention(q, k_cache, v_cache, q_pos, scale):
+    """Attention of ``q`` ``[B, T, H, D]`` over the full cache buffer.
+
+    ``q_pos`` ``[T]`` are the global positions of the query tokens; cache
+    slots at positions > q_pos are masked (causal over the cache, which
+    also hides the not-yet-written zero slots — they sit at positions
+    above ``pos`` by construction).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+                   preferred_element_type=jnp.float32) * scale
+    k_pos = jnp.arange(k_cache.shape[1])
+    mask = q_pos[:, None] >= k_pos[None, :]              # [T, S_max]
+    s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_cache.dtype), v_cache,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def forward_cached(params, tokens, cache, cfg: BurnInConfig,
+                   rules: ShardingRules | None = None):
+    """Forward ``tokens`` ``[B, T]`` starting at ``cache["pos"]``.
+
+    Writes the new K/V rows into the cache and returns
+    ``(logits [B, T, vocab], cache)``. ``T`` is the prompt length during
+    prefill and 1 during decode — same code path, so prefill and step
+    cannot diverge.
+    """
+    _check_cfg(cfg)
+
+    def act(x, *rest):
+        if rules is None:
+            return x
+        return jax.lax.with_sharding_constraint(x, rules.shard(rules.act(*rest)))
+
+    b, t = tokens.shape
+    pos0 = cache["pos"]
+    q_pos = pos0 + jnp.arange(t)
+    x = params["embed"][tokens]                           # [B, T, D]
+    x = act(x, None, None)
+    scale = 1.0 / (cfg.head_dim ** 0.5)
+
+    new_k, new_v = [], []
+    for layer, k_cache, v_cache in zip(params["layers"], cache["k"],
+                                       cache["v"]):
+        h = _rmsnorm(x, layer["attn_norm"])
+        q = h @ layer["wq"]
+        k = h @ layer["wk"]
+        v = h @ layer["wv"]
+
+        def split(tns):
+            tns = tns.reshape(b, t, cfg.n_heads, cfg.head_dim)
+            return act(tns, None, "tp", None)
+
+        q, k, v = split(q), split(k), split(v)
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos0, 0, 0))
+        new_k.append(k_cache)
+        new_v.append(v_cache)
+
+        attn = _cached_attention(q, k_cache, v_cache, q_pos, scale)
+        attn = attn.reshape(b, t, cfg.d_model)
+        x = x + act(attn @ layer["wo"], None, None)
+
+        h = _rmsnorm(x, layer["mlp_norm"])
+        h = jax.nn.gelu((h @ layer["up"]).astype(jnp.float32)).astype(cfg.dtype)
+        h = act(h, None, "tp")
+        x = x + act(h @ layer["down"], None, None)
+
+    x = _rmsnorm(x, params["out_norm"])
+    logits = x @ params["embed"].T
+    return act(logits, None, None), {
+        "k": new_k, "v": new_v, "pos": pos0 + t}
+
+
+def greedy_decode(params, prompt, n_new: int, cfg: BurnInConfig,
+                  rules: ShardingRules | None = None,
+                  max_len: int | None = None):
+    """Greedy generation: prefill the prompt, then ``n_new`` cached steps.
+
+    Returns generated tokens ``[B, n_new]``. Jittable end-to-end (the
+    decode loop is a ``lax.scan``); wrap in ``jax.jit`` with ``n_new`` and
+    shapes static for the compiled serving path.
+    """
+    b, t = prompt.shape
+    if max_len is None:
+        max_len = t + n_new
+    if t + n_new > max_len:
+        raise ValueError(f"prompt ({t}) + n_new ({n_new}) exceeds "
+                         f"max_len ({max_len})")
+    cache = init_cache(cfg, b, max_len, rules)
+    logits, cache = forward_cached(params, prompt, cache, cfg, rules)
+    first = jnp.argmax(logits[:, -1], axis=-1)            # [B]
+
+    def step(carry, _):
+        cache, tok = carry
+        logits, cache = forward_cached(params, tok[:, None], cache, cfg,
+                                       rules)
+        nxt = jnp.argmax(logits[:, -1], axis=-1)
+        return (cache, nxt), nxt
+
+    # n_new - 1 scan steps: token 1 comes from prefill's logits, each step
+    # consumes the previous token and emits the next — no forward whose
+    # output would be thrown away
+    (_, _), toks = jax.lax.scan(step, (cache, first), None,
+                                length=n_new - 1)
+    toks = jnp.concatenate([first[None], toks], axis=0)   # [n_new, B]
+    return jnp.swapaxes(toks, 0, 1)                       # [B, n_new]
+
+
+def make_decoder(cfg: BurnInConfig, rules: ShardingRules | None = None,
+                 n_new: int = 32, max_len: int | None = None):
+    """Compiled greedy decoder: ``decoder(params, prompt) → [B, n_new]``."""
+    fn = functools.partial(greedy_decode, n_new=n_new, cfg=cfg, rules=rules,
+                           max_len=max_len)
+    return jax.jit(fn)
