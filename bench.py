@@ -94,25 +94,38 @@ def main() -> None:
     from nvidia_terraform_modules_tpu.models import make_decoder
 
     # same model as the burn-in MFU measurement (one source of truth for
-    # the flagship dims), decode-shaped: dense cached attention, batch 8
+    # the flagship dims), decode-shaped: dense cached attention, batch 8.
+    # The trained weights are reused — attn/batch don't change parameter
+    # shapes, and a second full init would double weight HBM for no reason.
     dec_cfg = dataclasses.replace(cfg, attn="dense",
                                   batch=8 if on_tpu else cfg.batch)
     prompt_len, n_new = (512, 64) if on_tpu else (8, 8)
-    dec_params = init_params(jax.random.PRNGKey(0), dec_cfg)
-    decoder = make_decoder(dec_cfg, n_new=n_new,
-                           max_len=prompt_len + n_new)
+    dec_params = params
+    max_len = prompt_len + n_new
+    decoder = make_decoder(dec_cfg, n_new=n_new, max_len=max_len)
+    # prefill-only twin (n_new=1 → zero scan steps): subtracting its time
+    # isolates the HBM-bound per-step decode cost from the MXU-bound
+    # prompt forward, so decode_tokens_per_s measures what it claims
+    prefiller = make_decoder(dec_cfg, n_new=1, max_len=max_len)
     prompt = jax.random.randint(jax.random.PRNGKey(3),
                                 (dec_cfg.batch, prompt_len), 0,
                                 dec_cfg.vocab)
-    toks = decoder(dec_params, prompt)   # compile
-    sync(toks)
-    t_dec = time.perf_counter()
+    sync(decoder(dec_params, prompt))    # compile
+    sync(prefiller(dec_params, prompt))  # compile
     dec_iters = 3
+    t_dec = time.perf_counter()
     for _ in range(dec_iters):
         toks = decoder(dec_params, prompt)
     sync(toks)
-    dec_seconds = (time.perf_counter() - t_dec) / dec_iters
-    decode_tokens_per_s = dec_cfg.batch * n_new / dec_seconds
+    t_total = (time.perf_counter() - t_dec) / dec_iters
+    t_pre = time.perf_counter()
+    for _ in range(dec_iters):
+        toks = prefiller(dec_params, prompt)
+    sync(toks)
+    t_prefill = (time.perf_counter() - t_pre) / dec_iters
+    step_seconds_dec = max(t_total - t_prefill, 1e-9) / (n_new - 1)
+    decode_tokens_per_s = dec_cfg.batch / step_seconds_dec
+    prefill_tokens_per_s = dec_cfg.batch * prompt_len / max(t_prefill, 1e-9)
 
     # long-context attention: pallas flash kernel vs XLA dense at S=4096 —
     # the regime ring/flash attention exist for (O(S²) HBM traffic dominates)
@@ -171,6 +184,7 @@ def main() -> None:
         "burnin_seq_len": cfg.seq_len,
         "burnin_mfu": round(mfu, 3),
         "decode_tokens_per_s": round(decode_tokens_per_s, 1),
+        "prefill_tokens_per_s": round(prefill_tokens_per_s, 1),
         "decode_batch": dec_cfg.batch,
         "decode_prompt_len": prompt_len,
         **longctx,

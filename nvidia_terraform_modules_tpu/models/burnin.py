@@ -58,6 +58,13 @@ class BurnInConfig:
     # "flash":   fused pallas kernel (ops.flash_attention) on the gathered
     #            sequence — the [S,S] score matrix never touches HBM.
     attn: str = "dense"
+    # remat=True wraps each transformer block in jax.checkpoint: backward
+    # recomputes the block's activations from its input instead of keeping
+    # them resident, trading ~1/3 more FLOPs for O(n_layers×) less
+    # activation HBM — the standard TPU lever for longer context / bigger
+    # batch per chip (SURVEY: "use jax.checkpoint / rematerialisation to
+    # trade FLOPs for memory"). Gradients are exactly unchanged.
+    remat: bool = False
     # n_experts > 0 swaps each block's dense FFN for a Switch-style top-1
     # MoE (models/moe.py): experts shard over the mesh's ep axis, the
     # dispatch/combine einsums lower to all-to-alls, and the Switch
@@ -154,10 +161,10 @@ def forward_and_aux(params, tokens, cfg: BurnInConfig,
     # sequence-parallel resident layout between blocks
     x = act(x, "sp", None)
 
-    aux = jnp.float32(0.0)
     use_ring = cfg.attn == "ring" and rules is not None
     use_ulysses = cfg.attn == "ulysses" and rules is not None
-    for layer in params["layers"]:
+
+    def block(x, layer):
         h = _rmsnorm(x, layer["attn_norm"])
         if use_ring or use_ulysses:
             # sequence stays sharded on sp; either K/V blocks travel (ring)
@@ -208,13 +215,24 @@ def forward_and_aux(params, tokens, cfg: BurnInConfig,
 
             h = act(h, None, None)   # gather sequence: routing is per-token
             out, layer_aux = moe_layer(h, layer["moe"], cfg, rules)
-            aux = aux + layer_aux
             x = x + act(out, "sp", None)
         else:
+            layer_aux = jnp.float32(0.0)
             h = act(h, None, None)
             h = jax.nn.gelu((h @ layer["up"]).astype(jnp.float32)).astype(cfg.dtype)
             h = act(h, None, "tp")
             x = x + act(h @ layer["down"], "sp", None)
+        return x, layer_aux
+
+    if cfg.remat:
+        # recompute each block's activations in backward instead of keeping
+        # them resident — identical gradients, O(n_layers×) less HBM
+        block = jax.checkpoint(block)
+
+    aux = jnp.float32(0.0)
+    for layer in params["layers"]:
+        x, layer_aux = block(x, layer)
+        aux = aux + layer_aux
 
     x = _rmsnorm(x, params["out_norm"])
     logits = x @ params["embed"].T                    # weight-tied head

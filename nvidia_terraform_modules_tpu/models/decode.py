@@ -106,6 +106,12 @@ def forward_cached(params, tokens, cache, cfg: BurnInConfig,
     ``(logits [B, T, vocab], cache)``. ``T`` is the prompt length during
     prefill and 1 during decode — same code path, so prefill and step
     cannot diverge.
+
+    Precondition: ``cache["pos"] + T <= S_max``. The caller owns this
+    bound (``greedy_decode`` enforces it up front); past it,
+    ``dynamic_update_slice`` would clamp the start index and silently
+    overwrite the last cache rows — XLA has no traced-shape way to raise
+    here, which is why the guard must live at the Python level.
     """
     _check_cfg(cfg)
 
