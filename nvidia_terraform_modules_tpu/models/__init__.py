@@ -20,6 +20,7 @@ from .decode import (  # noqa: F401
     greedy_decode,
     init_cache,
     make_decoder,
+    sample_decode,
 )
 from .optimizer import (  # noqa: F401
     AdamWConfig,

@@ -19,10 +19,13 @@ TPU-first design:
   the Megatron-style projection sharding), so decode runs on the same
   mesh the train step used with zero resharding.
 
-Exactness contract: greedy tokens from this path equal greedy tokens from
-repeatedly running the full ``burnin.forward`` on the growing sequence
-(``tests/test_decode.py``) — the cache is an optimisation, never a
-different model. MoE configs are rejected for now (routing a single token
+Exactness contract: with the dense prefill (the default for
+dense-trained configs), greedy tokens from this path EQUAL greedy tokens
+from repeatedly running the full ``burnin.forward`` on the growing
+sequence (``tests/test_decode.py``) — the cache is an optimisation, never
+a different model. The flash prefill (default for long-context configs)
+matches within kernel float tolerance instead, the same numerics the
+config trained with. MoE configs are rejected for now (routing a single token
 through the capacity machinery is a different serving problem).
 """
 
@@ -44,18 +47,10 @@ def _check_cfg(cfg: BurnInConfig) -> None:
         raise ValueError(
             "KV-cache decode supports the dense FFN only (MoE serving is a "
             "separate problem: per-token routing without capacity batching)")
-    if cfg.attn != "dense":
-        # prefill materialises [B, H, T, S_max] f32 scores — fine at decode
-        # prompt lengths, an OOM trap at the long-context shapes the
-        # flash/ring/ulysses training paths exist for. Refuse loudly; a
-        # flash-prefill (chunked prompt through the pallas kernel) is the
-        # future fix. Serving a flash-trained model: decode with
-        # dataclasses.replace(cfg, attn="dense") — weights are identical.
-        raise ValueError(
-            f"KV-cache decode uses dense cached attention; cfg.attn="
-            f"{cfg.attn!r} implies prompt lengths where dense prefill "
-            f"would not fit — decode with replace(cfg, attn='dense') and "
-            f"short prompts, or wait for chunked flash prefill")
+    # any cfg.attn is servable: the config's attn names the TRAINING
+    # layout; decode uses its own cached attention, with the pallas flash
+    # kernel doing the prompt prefill whenever the length tiles (so the
+    # long-context configs don't hit a dense [B,H,T,S_max] score OOM)
 
 
 def init_cache(cfg: BurnInConfig, batch: int, max_len: int,
@@ -99,7 +94,8 @@ def _cached_attention(q, k_cache, v_cache, q_pos, scale):
 
 
 def forward_cached(params, tokens, cache, cfg: BurnInConfig,
-                   rules: ShardingRules | None = None):
+                   rules: ShardingRules | None = None, *,
+                   prefill_impl: str = "dense"):
     """Forward ``tokens`` ``[B, T]`` starting at ``cache["pos"]``.
 
     Writes the new K/V rows into the cache and returns
@@ -112,6 +108,13 @@ def forward_cached(params, tokens, cache, cfg: BurnInConfig,
     ``dynamic_update_slice`` would clamp the start index and silently
     overwrite the last cache rows — XLA has no traced-shape way to raise
     here, which is why the guard must live at the Python level.
+
+    ``prefill_impl="flash"`` runs the T>1 prompt attention through the
+    fused pallas kernel instead of masked scores over the full cache
+    buffer — the [T, S_max] score matrix never materialises. Valid ONLY
+    when ``cache["pos"] == 0`` (the prompt attends to nothing before
+    itself); ``pos`` is traced so this precondition is the caller's —
+    ``greedy_decode`` selects it exactly there.
     """
     _check_cfg(cfg)
 
@@ -145,7 +148,14 @@ def forward_cached(params, tokens, cache, cfg: BurnInConfig,
         new_k.append(k_cache)
         new_v.append(v_cache)
 
-        attn = _cached_attention(q, k_cache, v_cache, q_pos, scale)
+        if t > 1 and prefill_impl == "flash":
+            # prompt-only causal attention, fused tiles (pos == 0: the
+            # cache holds nothing the prompt shouldn't already see)
+            from ..ops.flash_attention import flash_attention
+
+            attn = flash_attention(q, k, v, causal=True, scale=scale)
+        else:
+            attn = _cached_attention(q, k_cache, v_cache, q_pos, scale)
         attn = attn.reshape(b, t, cfg.d_model)
         x = x + act(attn @ layer["wo"], None, None)
 
@@ -160,15 +170,35 @@ def forward_cached(params, tokens, cache, cfg: BurnInConfig,
         "k": new_k, "v": new_v, "pos": pos0 + t}
 
 
-def greedy_decode(params, prompt, n_new: int, cfg: BurnInConfig,
-                  rules: ShardingRules | None = None,
-                  max_len: int | None = None):
-    """Greedy generation: prefill the prompt, then ``n_new`` cached steps.
+def _select_prefill_impl(cfg: BurnInConfig, t: int, prefill: str) -> str:
+    """Resolve the prefill attention impl.
 
-    Returns generated tokens ``[B, n_new]``. Jittable end-to-end (the
-    decode loop is a ``lax.scan``); wrap in ``jax.jit`` with ``n_new`` and
-    shapes static for the compiled serving path.
+    ``"auto"`` matches the config's training layout: dense-trained models
+    prefill with the exact masked-cache path (preserving the bit-exactness
+    contract vs full re-forward), long-context models (flash/ring/ulysses)
+    prefill through the fused pallas kernel — dense scores would not fit
+    the prompt lengths those configs exist for, so a prompt that does NOT
+    tile into 8-multiple blocks is a loud error, not a silent dense
+    fallback into an OOM.
     """
+    from ..ops.flash_attention import pick_impl
+
+    if prefill not in ("auto", "dense", "flash"):
+        raise ValueError(f"unknown prefill {prefill!r}; use auto|dense|flash")
+    if prefill == "auto":
+        prefill = "dense" if cfg.attn == "dense" else "flash"
+    if prefill == "flash" and pick_impl(None, t, "prefill") != "flash":
+        raise ValueError(
+            f"prompt length {t} has no 8-multiple block divisor for the "
+            f"flash prefill — pad the prompt (dense prefill at this "
+            f"config's sequence lengths would materialise the full score "
+            f"matrix; pass prefill='dense' only if the prompt is short)")
+    return prefill
+
+
+def _generate(params, prompt, n_new, cfg, rules, max_len, pick_next,
+              prefill):
+    """Shared prefill + scan loop; ``pick_next(logits, rng) → token``."""
     b, t = prompt.shape
     if max_len is None:
         max_len = t + n_new
@@ -176,23 +206,79 @@ def greedy_decode(params, prompt, n_new: int, cfg: BurnInConfig,
         raise ValueError(f"prompt ({t}) + n_new ({n_new}) exceeds "
                          f"max_len ({max_len})")
     cache = init_cache(cfg, b, max_len, rules)
-    logits, cache = forward_cached(params, prompt, cache, cfg, rules)
-    first = jnp.argmax(logits[:, -1], axis=-1)            # [B]
+    logits, cache = forward_cached(
+        params, prompt, cache, cfg, rules,
+        prefill_impl=_select_prefill_impl(cfg, t, prefill))
+    if pick_next is None:
+        first = jnp.argmax(logits[:, -1], axis=-1)
+        keys = jnp.zeros((n_new - 1,), jnp.uint32)        # unused by step
+    else:
+        rng, pick = pick_next
+        all_keys = jax.random.split(rng, n_new)           # one per token
+        first = pick(logits[:, -1], all_keys[0])
+        keys = all_keys[1:]
 
-    def step(carry, _):
+    def step(carry, key):
         cache, tok = carry
         logits, cache = forward_cached(params, tok[:, None], cache, cfg,
                                        rules)
-        nxt = jnp.argmax(logits[:, -1], axis=-1)
+        nxt = jnp.argmax(logits[:, -1], axis=-1) if pick_next is None \
+            else pick_next[1](logits[:, -1], key)
         return (cache, nxt), nxt
 
     # n_new - 1 scan steps: token 1 comes from prefill's logits, each step
     # consumes the previous token and emits the next — no forward whose
     # output would be thrown away
-    (_, _), toks = jax.lax.scan(step, (cache, first), None,
-                                length=n_new - 1)
+    (_, _), toks = jax.lax.scan(step, (cache, first), keys)
     toks = jnp.concatenate([first[None], toks], axis=0)   # [n_new, B]
     return jnp.swapaxes(toks, 0, 1)                       # [B, n_new]
+
+
+def greedy_decode(params, prompt, n_new: int, cfg: BurnInConfig,
+                  rules: ShardingRules | None = None,
+                  max_len: int | None = None, prefill: str = "auto"):
+    """Greedy generation: prefill the prompt, then ``n_new`` cached steps.
+
+    Returns generated tokens ``[B, n_new]``. Jittable end-to-end (the
+    decode loop is a ``lax.scan``); wrap in ``jax.jit`` with ``n_new`` and
+    shapes static for the compiled serving path. ``prefill`` picks the
+    prompt attention impl (see ``_select_prefill_impl``): dense-trained
+    configs keep the bit-exact dense path, long-context configs prefill
+    through the flash kernel (matching their training numerics).
+    """
+    return _generate(params, prompt, n_new, cfg, rules, max_len, None,
+                     prefill)
+
+
+def sample_decode(params, prompt, n_new: int, cfg: BurnInConfig, rng,
+                  rules: ShardingRules | None = None,
+                  max_len: int | None = None,
+                  temperature: float = 1.0, top_k: int | None = None,
+                  prefill: str = "auto"):
+    """Temperature / top-k sampling over the same cached loop.
+
+    ``temperature`` scales logits before the categorical draw (→0 recovers
+    greedy); ``top_k`` keeps only the k highest logits per position
+    (``top_k=1`` IS greedy, exactly). One PRNG key per generated token,
+    split from ``rng`` — same key, same tokens, reproducible serving.
+    """
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    temperature = max(float(temperature), 1e-6)
+
+    def pick(logits, key):                                # [B, vocab] → [B]
+        logits = logits.astype(jnp.float32)
+        if top_k == 1:
+            return jnp.argmax(logits, axis=-1)            # no tie-break draw
+        if top_k is not None and top_k < logits.shape[-1]:
+            # O(V log k) per step (this runs inside the decode scan) —
+            # a full jnp.sort would be O(V log V) and copy the vocab
+            kth = jax.lax.top_k(logits, top_k)[0][:, -1][:, None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        return jax.random.categorical(key, logits / temperature, axis=-1)
+
+    return _generate(params, prompt, n_new, cfg, rules, max_len, (rng, pick),
+                     prefill)
 
 
 def make_decoder(cfg: BurnInConfig, rules: ShardingRules | None = None,
