@@ -2,6 +2,7 @@
 
 from .burnin import (  # noqa: F401
     BurnInConfig,
+    grad_accum,
     init_params,
     forward,
     forward_and_aux,

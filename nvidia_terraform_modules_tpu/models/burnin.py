@@ -282,16 +282,88 @@ def synthetic_batch(rng, cfg: BurnInConfig, rules: ShardingRules | None = None):
     return tokens, targets
 
 
-def make_train_step(cfg: BurnInConfig, rules: ShardingRules | None = None, lr: float = 1e-3):
+def grad_accum(fn, accum_steps: int, constrain=None):
+    """Microbatch a ``value_and_grad``-style function over the batch axis.
+
+    ``fn(params, batch) → (loss, grads)`` becomes a function that splits
+    the batch into ``accum_steps`` equal microbatches, runs them through a
+    ``lax.scan`` (ONE traced microbatch step, re-executed — compile time
+    and activation memory stay at microbatch size), and averages. Because
+    loss is a mean over examples, the averaged microbatch gradients equal
+    the full-batch gradients exactly — accumulation changes peak memory,
+    never the math.
+
+    ``constrain`` (optional) pins the sharding of the reshaped
+    ``[accum, micro, …]`` batch — on a mesh the SPMD partitioner needs the
+    explicit layout (microbatch dim over the data axes, accum dim
+    unsharded) to partition the scan's per-tick slice cleanly.
+    """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
+    def accumulated(params, batch):
+        b = batch[0].shape[0]
+        if b % accum_steps:
+            raise ValueError(
+                f"batch {b} not divisible by accum_steps {accum_steps}")
+        micro = jax.tree.map(
+            lambda x: x.reshape(accum_steps, b // accum_steps, *x.shape[1:]),
+            batch)
+        if constrain is not None:
+            micro = constrain(micro)
+
+        def one(carry, mb):
+            loss_sum, grad_sum = carry
+            loss, grads = fn(params, mb)
+            return (loss_sum + loss,
+                    jax.tree.map(jnp.add, grad_sum, grads)), None
+
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        (loss_sum, grad_sum), _ = jax.lax.scan(
+            one, (jnp.float32(0.0), zeros), micro)
+        inv = 1.0 / accum_steps
+        return loss_sum * inv, jax.tree.map(lambda g: g * inv, grad_sum)
+
+    return accumulated
+
+
+def _micro_constraint(rules: ShardingRules | None):
+    """Sharding pin for the microbatched ``[accum, micro, …]`` batch."""
+    if rules is None:
+        return None
+
+    def constrain(micro):
+        return jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(
+                x, rules.shard(P(None, rules.data))),
+            micro)
+
+    return constrain
+
+
+def make_train_step(cfg: BurnInConfig, rules: ShardingRules | None = None,
+                    lr: float = 1e-3, accum_steps: int = 1):
     """Build a jitted SGD train step with explicit in/out shardings.
 
     Plain SGD keeps the optimizer state-free, so the step's sharding story is
     entirely the parameter/activation story — ideal for a burn-in that must
     compile fast on a cold cluster. (Real training would swap in optax here.)
+
+    ``accum_steps > 1`` runs the batch as that many microbatches through
+    :func:`grad_accum` — same numbers (loss is a mean, so averaged
+    microbatch grads ARE the full-batch grads), 1/accum_steps the
+    activation memory, the lever when a batch doesn't fit next to the
+    model. Composes with ``cfg.remat`` (activations per microbatch AND per
+    layer drop out of residency).
     """
+    vg = jax.value_and_grad(functools.partial(loss_fn, cfg=cfg, rules=rules))
+    grads_of = vg
+    if accum_steps > 1:
+        grads_of = grad_accum(vg, accum_steps, _micro_constraint(rules))
 
     def step(params, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, rules)
+        loss, grads = grads_of(params, batch)
         params = jax.tree.map(lambda p, g: (p - lr * g.astype(p.dtype)), params, grads)
         return params, loss
 

@@ -188,11 +188,15 @@ def _select_prefill_impl(cfg: BurnInConfig, t: int, prefill: str) -> str:
     if prefill == "auto":
         prefill = "dense" if cfg.attn == "dense" else "flash"
     if prefill == "flash" and pick_impl(None, t, "prefill") != "flash":
+        # short prompts (t=1 especially — the flash branch never even
+        # fires below t=2) are memory-safe on the dense cached path; only
+        # LARGE non-tiling prompts are the OOM trap worth refusing
+        if t <= 512:
+            return "dense"
         raise ValueError(
             f"prompt length {t} has no 8-multiple block divisor for the "
             f"flash prefill — pad the prompt (dense prefill at this "
-            f"config's sequence lengths would materialise the full score "
-            f"matrix; pass prefill='dense' only if the prompt is short)")
+            f"length would materialise the full [T, S_max] score matrix)")
     return prefill
 
 

@@ -24,6 +24,7 @@ is ``2 × |params| × 4 bytes / dp``.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any
 
 import jax
@@ -31,7 +32,14 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel.sharding import ShardingRules
-from .burnin import BurnInConfig, init_params, loss_fn, param_shardings
+from .burnin import (
+    BurnInConfig,
+    _micro_constraint,
+    grad_accum,
+    init_params,
+    loss_fn,
+    param_shardings,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -153,7 +161,8 @@ def abstract_train_state(cfg: BurnInConfig,
 
 def make_adamw_train_step(cfg: BurnInConfig,
                           rules: ShardingRules | None = None,
-                          opt: AdamWConfig | None = None):
+                          opt: AdamWConfig | None = None,
+                          accum_steps: int = 1):
     """Jitted AdamW train step with ZeRO-1 state shardings.
 
     Returns ``(init_state_fn, step_fn)``:
@@ -161,11 +170,17 @@ def make_adamw_train_step(cfg: BurnInConfig,
     With ``rules``, params/batch keep the burn-in shardings, the moments get
     the dp-partitioned ZeRO-1 shardings, and both are pinned as jit
     in/out shardings so the partitioner cannot silently replicate them.
+    ``accum_steps > 1`` microbatches the gradient pass (``grad_accum``),
+    trading wall-clock for 1/accum_steps the activation memory.
     """
     opt = opt or AdamWConfig()
+    vg = jax.value_and_grad(functools.partial(loss_fn, cfg=cfg, rules=rules))
+    grads_of = vg
+    if accum_steps > 1:
+        grads_of = grad_accum(vg, accum_steps, _micro_constraint(rules))
 
     def step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, rules)
+        loss, grads = grads_of(params, batch)
         params, opt_state = adamw_update(params, grads, opt_state, opt)
         return params, opt_state, loss
 

@@ -169,16 +169,26 @@ def test_cache_is_tp_sharded_on_mesh(jax8):
     assert spec[2] == "tp"     # heads sharded over tp
 
 
-def test_long_context_nontiling_prompt_is_loud_error():
-    """A flash-trained config with a prompt that cannot tile must error,
-    not silently fall back to dense prefill (the OOM trap at its shapes)."""
+def test_long_context_nontiling_prompt_policy():
+    """Flash-config prompts that cannot tile: short ones fall back to the
+    memory-safe dense path (t=1 can never use flash anyway), LARGE ones
+    error loudly instead of materialising a [T, S_max] score matrix."""
+    from nvidia_terraform_modules_tpu.models.decode import (
+        _select_prefill_impl,
+    )
+
     cfg = BurnInConfig(**{**CFG, "attn": "flash"})
     params = init_params(jax.random.PRNGKey(0), cfg)
+    # short non-tiling prompt (100 = 2²·5²): silent dense fallback, runs
     prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 100), 0,
-                                cfg.vocab)  # 100 has no 8-multiple divisor
-    with pytest.raises(ValueError, match="pad the prompt"):
-        greedy_decode(params, prompt, 4, cfg, max_len=128)
-    # explicit dense prefill override still works for short prompts
-    toks = greedy_decode(params, prompt, 4, cfg, max_len=128,
-                         prefill="dense")
+                                cfg.vocab)
+    toks = greedy_decode(params, prompt, 4, cfg, max_len=128)
     assert toks.shape == (2, 4)
+    # single-token prompts must always be servable
+    one = jax.random.randint(jax.random.PRNGKey(1), (2, 1), 0, cfg.vocab)
+    assert greedy_decode(params, one, 4, cfg).shape == (2, 4)
+    # large non-tiling prompt (513 = 3³·19): loud error, not an OOM
+    with pytest.raises(ValueError, match="pad the prompt"):
+        _select_prefill_impl(cfg, 513, "auto")
+    # explicit dense is always allowed — the operator owns the memory call
+    assert _select_prefill_impl(cfg, 513, "dense") == "dense"
