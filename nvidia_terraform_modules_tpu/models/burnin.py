@@ -72,6 +72,10 @@ class BurnInConfig:
     n_experts: int = 0
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
+    # experts per token: 1 = Switch (top-1), 2 = GShard top-2 (gates
+    # renormalised over the selected pair; second choices drop first
+    # when an expert's capacity fills)
+    router_top_k: int = 1
 
     def __post_init__(self):
         if self.attn not in ("dense", "ring", "ulysses", "flash"):
@@ -80,6 +84,11 @@ class BurnInConfig:
                 f"use dense|ring|ulysses|flash")
         if self.n_experts < 0:
             raise ValueError(f"n_experts must be >= 0, got {self.n_experts}")
+        if self.router_top_k < 1 or (
+                self.n_experts and self.router_top_k > self.n_experts):
+            raise ValueError(
+                f"router_top_k must be in [1, n_experts], got "
+                f"{self.router_top_k} with {self.n_experts} experts")
 
     @property
     def head_dim(self) -> int:
@@ -253,11 +262,12 @@ def train_step_flops(cfg: BurnInConfig) -> float:
     per_layer = (
         8.0 * b * s * d * d          # q, k, v, o projections (2·BSd² each)
         + 2.0 * b * s * s * d        # QKᵀ + PV, causal-effective (½ of 4BS²d)
-        # FFN: with top-1 MoE each token still passes through exactly one
-        # expert's up+down, so the per-token model FLOPs match dense;
+        # FFN: a top-k MoE token passes through k experts' up+down (k=1 for
+        # dense and Switch), so the per-token FFN FLOPs scale by k;
         # dispatch/combine einsums are routing overhead, deliberately not
         # billed (billing overhead would inflate MFU)
-        + 4.0 * b * s * d * dff      # up + down projections
+        + 4.0 * b * s * d * dff * (
+            cfg.router_top_k if cfg.n_experts else 1)
     )
     fwd = cfg.n_layers * per_layer + 2.0 * b * s * d * v  # + tied head
     return 3.0 * fwd                 # bwd ≈ 2× fwd
@@ -290,8 +300,12 @@ def grad_accum(fn, accum_steps: int, constrain=None):
     ``lax.scan`` (ONE traced microbatch step, re-executed — compile time
     and activation memory stay at microbatch size), and averages. Because
     loss is a mean over examples, the averaged microbatch gradients equal
-    the full-batch gradients exactly — accumulation changes peak memory,
-    never the math.
+    the full-batch gradients exactly for the dense model — accumulation
+    changes peak memory, never the math. MoE configs are the documented
+    exception: the Switch aux loss is a product of per-batch means
+    (nonlinear in the batch) and expert capacity scales with the
+    microbatch token count, so accumulated MoE gradients are a close but
+    not bit-identical estimate of the full-batch ones.
 
     ``constrain`` (optional) pins the sharding of the reshaped
     ``[accum, micro, …]`` batch — on a mesh the SPMD partitioner needs the
@@ -342,6 +356,20 @@ def _micro_constraint(rules: ShardingRules | None):
     return constrain
 
 
+def make_grads_fn(cfg: BurnInConfig, rules: ShardingRules | None,
+                  accum_steps: int = 1):
+    """``(params, batch) → (loss, grads)`` — the gradient pass both train
+    steps (SGD here, AdamW in ``models/optimizer.py``) share, with
+    optional microbatch accumulation wired to the mesh's sharding pin.
+    """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    vg = jax.value_and_grad(functools.partial(loss_fn, cfg=cfg, rules=rules))
+    if accum_steps == 1:
+        return vg
+    return grad_accum(vg, accum_steps, _micro_constraint(rules))
+
+
 def make_train_step(cfg: BurnInConfig, rules: ShardingRules | None = None,
                     lr: float = 1e-3, accum_steps: int = 1):
     """Build a jitted SGD train step with explicit in/out shardings.
@@ -357,10 +385,7 @@ def make_train_step(cfg: BurnInConfig, rules: ShardingRules | None = None,
     model. Composes with ``cfg.remat`` (activations per microbatch AND per
     layer drop out of residency).
     """
-    vg = jax.value_and_grad(functools.partial(loss_fn, cfg=cfg, rules=rules))
-    grads_of = vg
-    if accum_steps > 1:
-        grads_of = grad_accum(vg, accum_steps, _micro_constraint(rules))
+    grads_of = make_grads_fn(cfg, rules, accum_steps)
 
     def step(params, batch):
         loss, grads = grads_of(params, batch)

@@ -185,13 +185,16 @@ def _select_prefill_impl(cfg: BurnInConfig, t: int, prefill: str) -> str:
 
     if prefill not in ("auto", "dense", "flash"):
         raise ValueError(f"unknown prefill {prefill!r}; use auto|dense|flash")
+    requested = prefill
     if prefill == "auto":
         prefill = "dense" if cfg.attn == "dense" else "flash"
     if prefill == "flash" and pick_impl(None, t, "prefill") != "flash":
-        # short prompts (t=1 especially — the flash branch never even
-        # fires below t=2) are memory-safe on the dense cached path; only
-        # LARGE non-tiling prompts are the OOM trap worth refusing
-        if t <= 512:
+        # auto-resolved flash on a SHORT non-tiling prompt (t=1 especially
+        # — the flash branch never even fires below t=2) falls back to the
+        # memory-safe dense path; an EXPLICIT prefill="flash" request, and
+        # any large prompt, errors loudly — never silently measure/serve a
+        # different kernel than the caller asked for
+        if requested == "auto" and t <= 512:
             return "dense"
         raise ValueError(
             f"prompt length {t} has no 8-multiple block divisor for the "

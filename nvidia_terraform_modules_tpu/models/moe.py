@@ -64,35 +64,55 @@ def init_moe_params(rng, cfg) -> dict[str, Any]:
 
 
 def moe_layer(x, params, cfg, rules=None):
-    """Top-1 MoE FFN. ``x`` is [B, S, D]; returns ([B, S, D], aux_loss).
+    """Top-k MoE FFN (k = ``cfg.router_top_k``); returns ([B,S,D], aux).
 
     Dispatch/combine follow GShard: a dense [T, E, C] one-hot tensor
     routes tokens into per-expert batches and back. With ``rules`` on an
     ``ep`` mesh, the expert batch is constrained to ``P("ep", …)`` so XLA
     inserts the all-to-all; unsharded it is a plain pair of einsums.
+
+    k=1 is Switch routing (gate = raw top probability — numerically
+    identical to the original top-1 layer); k>1 is GShard routing: gates
+    renormalised over the selected experts, and rank-r assignments claim
+    capacity slots AFTER every rank<r assignment (each expert's counter
+    is offset by the lower ranks' totals), so a full expert drops its
+    second-choice tokens first — the standard GShard priority.
     """
     B, S, D = x.shape
     E = cfg.n_experts
+    K = getattr(cfg, "router_top_k", 1)
     T = B * S
-    C = expert_capacity(T, E, cfg.capacity_factor)
+    # top-k makes K·T assignments, so capacity provisions K·T/E slots per
+    # expert (GShard's k-scaled capacity) — without the K factor, top-2
+    # under the default factor would drop ~37% of assignments at uniform
+    # load and quietly degrade toward top-1
+    C = expert_capacity(T * K, E, cfg.capacity_factor)
 
     tokens = x.reshape(T, D)
     logits = tokens.astype(jnp.float32) @ params["router"]     # [T, E]
     probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)                        # [T]
-    gate = jnp.max(probs, axis=-1)                             # [T]
+    top_p, top_e = jax.lax.top_k(probs, K)                     # [T, K]
+    if K > 1:
+        top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
 
-    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)      # [T, E]
-    # position of each token within its expert's batch (exclusive cumsum
-    # along the token dim — deterministic first-come-first-served).
+    onehot = jax.nn.one_hot(top_e[:, 0], E, dtype=jnp.float32)  # rank-0 [T,E]
+    dispatch = jnp.zeros((T, E, C), jnp.float32)
+    combine = jnp.zeros((T, E, C), jnp.float32)
     # int32 cumsum: f32 would lose integer exactness past 2^24 tokens and
     # silently collapse distinct tokens into one capacity slot
-    oh_i = onehot.astype(jnp.int32)
-    pos = jnp.cumsum(oh_i, axis=0) * oh_i - oh_i               # [T, E]
-    within = ((pos < C) & (oh_i == 1)).astype(jnp.float32)
-    pos_oh = jax.nn.one_hot(pos, C, dtype=jnp.float32)         # [T, E, C]
-    dispatch = pos_oh * within[..., None]                      # [T, E, C]
-    combine = dispatch * gate[:, None, None]
+    used = jnp.zeros((E,), jnp.int32)    # slots claimed by lower ranks
+    for r in range(K):
+        oh_i = jax.nn.one_hot(top_e[:, r], E, dtype=jnp.int32)  # [T, E]
+        # position within the expert batch: exclusive cumsum along the
+        # token dim (deterministic first-come-first-served), offset by the
+        # lower ranks' per-expert totals
+        pos = jnp.cumsum(oh_i, axis=0) * oh_i - oh_i + used[None] * oh_i
+        within = ((pos < C) & (oh_i == 1)).astype(jnp.float32)
+        pos_oh = jax.nn.one_hot(pos, C, dtype=jnp.float32)     # [T, E, C]
+        d_r = pos_oh * within[..., None]
+        dispatch = dispatch + d_r
+        combine = combine + d_r * top_p[:, r][:, None, None]
+        used = used + jnp.sum(oh_i, axis=0)
 
     def ep(t, spec):
         if rules is None:

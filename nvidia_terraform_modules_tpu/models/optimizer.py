@@ -24,7 +24,6 @@ is ``2 × |params| × 4 bytes / dp``.
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Any
 
 import jax
@@ -34,10 +33,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..parallel.sharding import ShardingRules
 from .burnin import (
     BurnInConfig,
-    _micro_constraint,
-    grad_accum,
     init_params,
-    loss_fn,
+    make_grads_fn,
     param_shardings,
 )
 
@@ -174,10 +171,7 @@ def make_adamw_train_step(cfg: BurnInConfig,
     trading wall-clock for 1/accum_steps the activation memory.
     """
     opt = opt or AdamWConfig()
-    vg = jax.value_and_grad(functools.partial(loss_fn, cfg=cfg, rules=rules))
-    grads_of = vg
-    if accum_steps > 1:
-        grads_of = grad_accum(vg, accum_steps, _micro_constraint(rules))
+    grads_of = make_grads_fn(cfg, rules, accum_steps)
 
     def step(params, opt_state, batch):
         loss, grads = grads_of(params, batch)
