@@ -89,6 +89,10 @@ class BurnInConfig:
             raise ValueError(
                 f"router_top_k must be in [1, n_experts], got "
                 f"{self.router_top_k} with {self.n_experts} experts")
+        if self.router_top_k > 1 and self.n_experts == 0:
+            raise ValueError(
+                f"router_top_k = {self.router_top_k} needs n_experts > 0 "
+                f"(a dense model has no router to take a top-k from)")
 
     @property
     def head_dim(self) -> int:

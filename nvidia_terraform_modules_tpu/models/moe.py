@@ -80,7 +80,7 @@ def moe_layer(x, params, cfg, rules=None):
     """
     B, S, D = x.shape
     E = cfg.n_experts
-    K = getattr(cfg, "router_top_k", 1)
+    K = cfg.router_top_k
     T = B * S
     # top-k makes K·T assignments, so capacity provisions K·T/E slots per
     # expert (GShard's k-scaled capacity) — without the K factor, top-2

@@ -23,10 +23,13 @@ pipeline design, not a port of a CUDA send/recv scheduler:
 
 The block inside a stage is a plain dense transformer block (attention +
 FFN). Pipeline composes with data parallelism (mesh ``("pp", "dp")``,
-gradients pmean over dp); tensor/sequence axes stay with the non-pipelined
-paths — mixing manual shard_map collectives with auto-sharded tp inside
-the same block would fight the compiler, and a v5e slice runs either
-regime well.
+gradients pmean over dp) AND with tensor parallelism (mesh
+``("pp", "dp", "tp")``): inside each stage, qkv/up are column-parallel
+and wo/down row-parallel over ``tp``, with one explicit ``psum`` after
+each row-parallel matmul — Megatron's schedule written manually, because
+the whole pipeline body is already a Manual (shard_map) region where the
+auto-sharding partitioner cannot reach. Sequence parallelism stays with
+the non-pipelined paths.
 """
 
 from __future__ import annotations
@@ -86,33 +89,63 @@ def init_pipeline_params(rng, cfg: PipelineConfig):
 
 
 
-def _block(layer, x, cfg: PipelineConfig):
+def _block(layer, x, cfg: PipelineConfig, tp: int = 1):
     """One dense transformer block; ``layer`` leaves have NO layer dim.
 
     Attention reuses ``dense_reference_attention`` (the same tested op the
     burn-in model's dense path calls) rather than re-deriving the math.
+
+    With ``tp > 1`` (inside a shard_map carrying a ``tp`` axis) the layer
+    leaves arrive ALREADY tp-sharded: wq/wk/wv/up hold their output
+    columns' shard (heads split H/tp), wo/down hold their input rows'
+    shard, and each row-parallel matmul's partial product is ``psum``'d
+    over ``tp`` — the Megatron schedule, written out because the Manual
+    region owns its collectives.
     """
     B, S, D = x.shape
+    heads = cfg.n_heads // tp
     h = _rmsnorm(x, layer["attn_norm"])
-    q = (h @ layer["wq"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
-    k = (h @ layer["wk"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
-    v = (h @ layer["wv"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
-    ctx = dense_reference_attention(q, k, v, causal=True).reshape(B, S, D)
-    x = x + ctx @ layer["wo"]
+    q = (h @ layer["wq"]).reshape(B, S, heads, cfg.head_dim)
+    k = (h @ layer["wk"]).reshape(B, S, heads, cfg.head_dim)
+    v = (h @ layer["wv"]).reshape(B, S, heads, cfg.head_dim)
+    ctx = dense_reference_attention(q, k, v, causal=True)
+    ctx = ctx.reshape(B, S, heads * cfg.head_dim)
+    attn_out = ctx @ layer["wo"]
+    if tp > 1:
+        attn_out = jax.lax.psum(attn_out, "tp")
+    x = x + attn_out
     h = _rmsnorm(x, layer["mlp_norm"])
     h = jax.nn.gelu((h @ layer["up"]).astype(jnp.float32)).astype(x.dtype)
-    return x + h @ layer["down"]
+    ffn_out = h @ layer["down"]
+    if tp > 1:
+        ffn_out = jax.lax.psum(ffn_out, "tp")
+    return x + ffn_out
 
 
-def _stage(stage_layers, x, cfg: PipelineConfig):
+def _stage(stage_layers, x, cfg: PipelineConfig, tp: int = 1):
     """Apply this stage's stacked layers in order (scan over the local
     layer dim — still one compiled loop, not unrolled python)."""
 
     def body(carry, layer):
-        return _block(layer, carry, cfg), None
+        return _block(layer, carry, cfg, tp), None
 
     out, _ = jax.lax.scan(body, x, stage_layers)
     return out
+
+
+def _layer_specs(tp: int):
+    """PartitionSpecs for the stacked layer dict: pp on the layer dim,
+    tp on the Megatron dim of each weight (none when tp == 1)."""
+    if tp == 1:
+        p = P("pp")
+        return {k: p for k in ("attn_norm", "wq", "wk", "wv", "wo",
+                               "mlp_norm", "up", "down")}
+    col, row = P("pp", None, "tp"), P("pp", "tp", None)
+    return {
+        "attn_norm": P("pp"), "mlp_norm": P("pp"),
+        "wq": col, "wk": col, "wv": col, "up": col,
+        "wo": row, "down": row,
+    }
 
 
 def pipeline_loss_fn(params, batch, cfg: PipelineConfig, mesh):
@@ -129,15 +162,20 @@ def pipeline_loss_fn(params, batch, cfg: PipelineConfig, mesh):
     # fail with named quantities, not a shard_map reshape error deep in jit
     if "pp" not in mesh.shape or "dp" not in mesh.shape:
         raise ValueError(
-            f"pipeline needs a ('pp', 'dp') mesh; got axes "
+            f"pipeline needs a ('pp', 'dp'[, 'tp']) mesh; got axes "
             f"{tuple(mesh.axis_names)} (use dp=1 for no data parallelism)")
     pp = mesh.shape["pp"]
     dp = mesh.shape["dp"]
+    tp = mesh.shape.get("tp", 1)
     M, mb, S = cfg.n_microbatches, cfg.microbatch, cfg.seq_len
     if cfg.n_layers % pp != 0:
         raise ValueError(
             f"n_layers = {cfg.n_layers} does not divide into pp = {pp} "
             f"stages")
+    if tp > 1 and (cfg.n_heads % tp or cfg.d_ff % tp or cfg.d_model % tp):
+        raise ValueError(
+            f"tp = {tp} must divide n_heads ({cfg.n_heads}), d_ff "
+            f"({cfg.d_ff}), and d_model ({cfg.d_model})")
     expected = M * mb * dp
     if batch[0].shape[0] != expected:
         raise ValueError(
@@ -146,7 +184,7 @@ def pipeline_loss_fn(params, batch, cfg: PipelineConfig, mesh):
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(P("pp"), P(), P(), P(None, "dp")),
+        in_specs=(_layer_specs(tp), P(), P(), P(None, "dp")),
         out_specs=P(),
         check_vma=False,
     )
@@ -167,7 +205,7 @@ def pipeline_loss_fn(params, batch, cfg: PipelineConfig, mesh):
             buf = carry                                  # [mb, S, D]
             feed = x0[jnp.clip(t, 0, M - 1)]
             inp = jnp.where(i == 0, feed, buf)
-            out = _stage(stage_layers, inp, cfg)
+            out = _stage(stage_layers, inp, cfg, tp)
             # last stage: LM head + NLL for its current microbatch
             h = _rmsnorm(out, out_norm)
             logits = (h @ embed.T).astype(jnp.float32)
@@ -197,12 +235,15 @@ def pipeline_loss_fn(params, batch, cfg: PipelineConfig, mesh):
 
 
 def stack_sharding(mesh, params):
-    """NamedShardings: layer stacks over ``pp``, embed/head replicated."""
+    """NamedShardings: layer stacks over ``pp`` (+ Megatron ``tp`` dims
+    when the mesh carries a tp axis), embed/head replicated."""
+    tp = mesh.shape.get("tp", 1)
+    specs = _layer_specs(tp)
     return {
         "embed": NamedSharding(mesh, P()),
         "out_norm": NamedSharding(mesh, P()),
-        "layers": jax.tree.map(
-            lambda _: NamedSharding(mesh, P("pp")), params["layers"]),
+        "layers": {k: NamedSharding(mesh, specs[k])
+                   for k in params["layers"]},
     }
 
 
