@@ -28,6 +28,7 @@ from .optimizer import (  # noqa: F401
     abstract_train_state,
     adamw_update,
     init_opt_state,
+    lr_at,
     make_adamw_train_step,
     opt_state_shardings,
 )

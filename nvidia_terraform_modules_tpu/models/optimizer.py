@@ -46,6 +46,30 @@ class AdamWConfig:
     b2: float = 0.999
     eps: float = 1e-8
     weight_decay: float = 0.01
+    # schedule: linear warmup 0 → lr over ``warmup_steps``, then cosine
+    # decay to ``lr · min_lr_ratio`` over ``decay_steps`` (constant at
+    # ``lr`` when decay_steps == 0, and past the end of the decay). The
+    # schedule is a pure function of the optimizer's own step counter, so
+    # it lives inside the jitted update — no per-step host interaction.
+    warmup_steps: int = 0
+    decay_steps: int = 0
+    min_lr_ratio: float = 0.0
+
+
+def lr_at(opt: AdamWConfig, step):
+    """Learning rate at (1-indexed, traced) ``step`` under the schedule."""
+    t = step.astype(jnp.float32) if hasattr(step, "astype") else float(step)
+    lr = jnp.float32(opt.lr)
+    if opt.warmup_steps > 0:
+        lr = lr * jnp.minimum(1.0, t / opt.warmup_steps)
+    if opt.decay_steps > 0:
+        frac = jnp.clip((t - opt.warmup_steps) / opt.decay_steps, 0.0, 1.0)
+        cos = 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+        floor = opt.min_lr_ratio
+        lr = jnp.where(
+            t <= opt.warmup_steps, lr,
+            opt.lr * (floor + (1.0 - floor) * cos))
+    return lr
 
 
 def init_opt_state(params) -> dict[str, Any]:
@@ -111,6 +135,7 @@ def adamw_update(params, grads, state, opt: AdamWConfig):
     t = step.astype(jnp.float32)
     c1 = 1.0 - opt.b1 ** t
     c2 = 1.0 - opt.b2 ** t
+    lr = lr_at(opt, step)
 
     def upd(p, g, m, v):
         g = g.astype(jnp.float32)
@@ -118,7 +143,7 @@ def adamw_update(params, grads, state, opt: AdamWConfig):
         v = opt.b2 * v + (1.0 - opt.b2) * jnp.square(g)
         delta = (m / c1) / (jnp.sqrt(v / c2) + opt.eps)
         delta = delta + opt.weight_decay * p.astype(jnp.float32)
-        return (p.astype(jnp.float32) - opt.lr * delta).astype(p.dtype), m, v
+        return (p.astype(jnp.float32) - lr * delta).astype(p.dtype), m, v
 
     out = jax.tree.map(upd, params, grads, state["mu"], state["nu"])
     new_params = jax.tree.map(lambda o: o[0], out,

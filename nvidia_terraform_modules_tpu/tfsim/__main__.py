@@ -74,10 +74,43 @@ def _parse_var(kv: str):
         return k, v               # bare string
 
 
+def _auto_var_files(module_dir: str | None) -> list[str]:
+    """terraform's implicit variable files, in its precedence order:
+    ``terraform.tfvars`` first, then ``*.auto.tfvars`` lexicographically."""
+    if not module_dir or not os.path.isdir(module_dir):
+        return []
+    out = []
+    base = os.path.join(module_dir, "terraform.tfvars")
+    if os.path.isfile(base):
+        out.append(base)
+    out.extend(sorted(
+        os.path.join(module_dir, f) for f in os.listdir(module_dir)
+        if f.endswith(".auto.tfvars")))
+    return out
+
+
+def _load_tfvars_file(path: str) -> dict:
+    """load_tfvars with errors normalised to :class:`PlanError`.
+
+    Parse errors are ``SyntaxError`` subclasses and eval errors are bare
+    ``ValueError``s; auto-loading means a broken ``terraform.tfvars`` now
+    reaches EVERY verb, so both must surface as the verbs' documented
+    ``Error: …`` diagnostic, never a traceback.
+    """
+    try:
+        return load_tfvars(path)
+    except (SyntaxError, ValueError) as ex:
+        raise PlanError(f"{path}: {ex}")
+
+
 def _gather_vars(args) -> dict:
+    # precedence (terraform): terraform.tfvars < *.auto.tfvars <
+    # -var-file (in order given) < -var
     tfvars: dict = {}
+    for f in _auto_var_files(getattr(args, "dir", None)):
+        tfvars.update(_load_tfvars_file(f))
     for f in args.var_file or []:
-        tfvars.update(load_tfvars(f))
+        tfvars.update(_load_tfvars_file(f))
     for kv in args.var or []:
         k, v = _parse_var(kv)
         tfvars[k] = v
@@ -358,7 +391,7 @@ def cmd_import(args) -> int:
 def cmd_destroy(args) -> int:
     try:
         d = simulate_destroy(args.dir, _gather_vars(args))
-    except PlanError as ex:
+    except (PlanError, ValueError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
         return 1
     for addr in d.order:

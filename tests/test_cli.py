@@ -305,12 +305,20 @@ def test_cli_survives_broken_pipe(tmp_path):
     import subprocess
     import sys as _sys
 
+    # an output larger than the 64 KiB pipe buffer makes the EPIPE
+    # deterministic: the writer MUST block after head exits, whatever the
+    # process scheduling — a small output could fit the buffer whole and
+    # race to rc 0 under load
+    (tmp_path / "main.tf").write_text(
+        'output "big" {\n'
+        '  value = join("", [for i in range(30000) : "xxxx"])\n'
+        '}\n')
     state = str(tmp_path / "s.json")
-    assert main(["apply", GKE_TPU, "-state", state] + VARS) == 0
+    assert main(["apply", str(tmp_path), "-state", state]) == 0
     p = subprocess.run(
         ["bash", "-c",
          f"{_sys.executable} -m nvidia_terraform_modules_tpu.tfsim output "
-         f"-state {state} | head -c 5; exit ${{PIPESTATUS[0]}}"],
+         f"-state {state} big | head -c 5; exit ${{PIPESTATUS[0]}}"],
         capture_output=True, text=True,
         env={**os.environ, "PYTHONUNBUFFERED": "1"},
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -369,3 +377,40 @@ def test_import_respects_moved_blocks(tmp_path, capsys):
                  "some-id", "-state", state]) == 1
     assert "already managed" in capsys.readouterr().err
     assert main(["plan", str(tmp_path), "-state", state]) == 0
+
+
+def test_auto_tfvars_loaded_in_terraform_order(tmp_path, capsys):
+    """terraform.tfvars then *.auto.tfvars auto-load from the module dir,
+    with -var-file and -var overriding in terraform's precedence order."""
+    (tmp_path / "main.tf").write_text(
+        'variable "a" {\n  type = string\n}\n'
+        'variable "b" {\n  type    = string\n  default = "unset"\n}\n'
+        'output "ab" {\n  value = "${var.a}/${var.b}"\n}\n')
+    (tmp_path / "terraform.tfvars").write_text('a = "base"\nb = "base"\n')
+    (tmp_path / "zz.auto.tfvars").write_text('b = "auto"\n')
+    assert main(["plan", str(tmp_path), "-json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["outputs"]["ab"] == "base/auto"
+    # explicit -var still wins over every file tier
+    assert main(["plan", str(tmp_path), "-json", "-var", "b=cli"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["outputs"]["ab"] == "base/cli"
+    # -var-file beats auto files, loses to -var
+    (tmp_path / "extra.tfvars").write_text('b = "file"\n')
+    assert main(["plan", str(tmp_path), "-json",
+                 "-var-file", str(tmp_path / "extra.tfvars")]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["outputs"]["ab"] == "base/file"
+
+
+def test_broken_auto_tfvars_is_clean_error(tmp_path, capsys):
+    """A malformed or mis-referencing terraform.tfvars now reaches every
+    verb via auto-loading — it must print the documented Error line,
+    never a traceback."""
+    (tmp_path / "main.tf").write_text('locals {\n  a = 1\n}\n')
+    (tmp_path / "terraform.tfvars").write_text("a = = broken\n")
+    assert main(["plan", str(tmp_path)]) == 1
+    assert "Error:" in capsys.readouterr().err
+    (tmp_path / "terraform.tfvars").write_text("a = var.missing\n")
+    assert main(["destroy", str(tmp_path)]) == 1
+    assert "Error:" in capsys.readouterr().err

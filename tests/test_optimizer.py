@@ -164,3 +164,55 @@ def test_unsharded_adamw_trains():
         params, state, loss = step(params, state, batch)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_lr_schedule_shape():
+    """Warmup ramps to peak, cosine decays to the floor, then holds."""
+    from nvidia_terraform_modules_tpu.models.optimizer import lr_at
+
+    opt = AdamWConfig(lr=1e-2, warmup_steps=10, decay_steps=100,
+                      min_lr_ratio=0.1)
+    lrs = [float(lr_at(opt, jnp.int32(t))) for t in range(1, 131)]
+    # monotone ramp over warmup, peak at the boundary
+    assert all(lrs[i] < lrs[i + 1] for i in range(8))
+    assert lrs[9] == pytest.approx(1e-2)
+    # strictly decaying through the cosine phase
+    assert all(lrs[i] > lrs[i + 1] for i in range(10, 109))
+    # floor reached at warmup+decay and held afterwards
+    assert lrs[109] == pytest.approx(1e-3, rel=1e-4)
+    assert lrs[129] == pytest.approx(1e-3, rel=1e-4)
+
+
+def test_lr_schedule_matches_optax():
+    """Cross-check against optax's warmup_cosine_decay_schedule (its
+    decay_steps counts FROM ZERO INCLUDING warmup; ours counts the decay
+    phase alone)."""
+    import optax
+
+    from nvidia_terraform_modules_tpu.models.optimizer import lr_at
+
+    opt = AdamWConfig(lr=3e-3, warmup_steps=5, decay_steps=50,
+                      min_lr_ratio=0.2)
+    sched = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=opt.lr, warmup_steps=opt.warmup_steps,
+        decay_steps=opt.warmup_steps + opt.decay_steps,
+        end_value=opt.lr * opt.min_lr_ratio)
+    for t in range(1, 60):
+        ours = float(lr_at(opt, jnp.int32(t)))
+        theirs = float(sched(t))
+        assert ours == pytest.approx(theirs, rel=1e-4, abs=1e-8), t
+
+
+def test_scheduled_adamw_trains():
+    cfg = BurnInConfig(vocab=64, d_model=32, n_heads=2, d_ff=64, n_layers=1,
+                       seq_len=16, batch=4)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    init_state, step = make_adamw_train_step(
+        cfg, opt=AdamWConfig(lr=1e-2, warmup_steps=3, decay_steps=20))
+    state = init_state(params)
+    batch = synthetic_batch(jax.random.PRNGKey(1), cfg)
+    losses = []
+    for _ in range(8):
+        params, state, loss = step(params, state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
