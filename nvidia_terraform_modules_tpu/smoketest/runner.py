@@ -196,6 +196,33 @@ def run_smoketest(
             checks["burnin_ok"] = (
                 len(losses) == 5 and losses[-1] < losses[0])
             ok &= checks["burnin_ok"]
+
+            # serve shape: a short greedy KV-cache decode on the trained
+            # weights — proves the inference path (prefill + cached scan,
+            # tp-sharded cache) on the same fresh slice, and that decode
+            # is self-consistent with the training forward (greedy tokens
+            # equal full re-forward argmax for the dense config)
+            if checks["burnin_ok"]:
+                from ..models import forward, greedy_decode
+
+                try:
+                    # full training batch rows: sized max(8, 2·data_shards)
+                    # above, so the prompt's batch dim always divides the
+                    # data sharding — a hardcoded small batch would crash
+                    # exactly on the larger slices this Job targets
+                    prompt = batch[0][:, :8]
+                    toks = jax.device_get(greedy_decode(
+                        params, prompt, 4, cfg, rules))
+                    logits = forward(params, prompt, cfg, rules)
+                    first_ref = jax.device_get(
+                        jax.numpy.argmax(logits[:, -1], axis=-1))
+                    checks["decode_ok"] = (
+                        toks.shape == (prompt.shape[0], 4)
+                        and bool((toks[:, 0] == first_ref).all()))
+                except Exception as exc:  # JSON contract > the type
+                    checks["decode_ok"] = False
+                    checks["decode_error"] = str(exc)
+                ok &= checks["decode_ok"]
             if ckpt is not None and ok:
                 try:
                     checks["burnin_checkpoint_cleared"] = ckpt.clear()

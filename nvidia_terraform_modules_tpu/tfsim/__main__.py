@@ -84,8 +84,9 @@ def _auto_var_files(module_dir: str | None) -> list[str]:
     if os.path.isfile(base):
         out.append(base)
     out.extend(sorted(
-        os.path.join(module_dir, f) for f in os.listdir(module_dir)
-        if f.endswith(".auto.tfvars")))
+        p for f in os.listdir(module_dir)
+        if f.endswith(".auto.tfvars") and
+        os.path.isfile(p := os.path.join(module_dir, f))))
     return out
 
 
@@ -99,7 +100,7 @@ def _load_tfvars_file(path: str) -> dict:
     """
     try:
         return load_tfvars(path)
-    except (SyntaxError, ValueError) as ex:
+    except (SyntaxError, ValueError, OSError) as ex:
         raise PlanError(f"{path}: {ex}")
 
 

@@ -39,3 +39,6 @@ def test_burnin_level(jax8):
     r = run_smoketest(level="burnin", env={})
     assert r.ok, r.checks
     assert r.checks["burnin_ok"]
+    # the serve shape validates alongside training: greedy KV-cache
+    # decode on the just-trained weights, self-consistent with forward()
+    assert r.checks["decode_ok"]
