@@ -1,0 +1,29 @@
+# This file is maintained automatically by "terraform init".
+# Manual edits may be lost in future updates.
+#
+# Version selections generated offline by `tfsim lock` from the certified
+# provider table (see README support matrix); `hashes` are per-platform
+# registry checksums that the first networked `terraform init` (or
+# `terraform providers lock -platform=...`) records without altering the
+# selections below. CI checks selections against every versions.tf
+# constraint in the module tree (tests/test_lockfile.py).
+
+provider "registry.terraform.io/hashicorp/google" {
+  version     = "6.8.0"
+  constraints = "~> 6.8"
+}
+
+provider "registry.terraform.io/hashicorp/google-beta" {
+  version     = "6.8.0"
+  constraints = "~> 6.8"
+}
+
+provider "registry.terraform.io/hashicorp/helm" {
+  version     = "2.15.0"
+  constraints = "~> 2.15"
+}
+
+provider "registry.terraform.io/hashicorp/kubernetes" {
+  version     = "2.32.0"
+  constraints = "~> 2.32"
+}

@@ -1,0 +1,89 @@
+# Multi-slice training fleet: two v5e slices joined over DCN.
+#
+# The reference never scales past one accelerator pool per cluster
+# (/root/reference/gke/main.tf:106-151 — a single GPU node pool); TPU's
+# scaling story is different in kind: a slice is the ICI-connected unit,
+# and the fleet grows by ADDING SLICES that talk over the data-center
+# network (DCN). This composition provisions two 8-chip v5e slices and
+# turns on the multislice smoke test: one indexed Job per slice, a shared
+# jax.distributed world across both, MEGASCALE env for libtpu's DCN
+# transport, and a cross-slice psum proving the DCN leg carries
+# collectives — `terraform apply` succeeds only if the WHOLE fleet
+# computes together (the workload side of parallel/multislice.py's
+# ("slice","dp","sp","tp") mesh).
+
+terraform {
+  required_version = ">= 1.5.0"
+
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = "~> 6.8"
+    }
+  }
+}
+
+variable "project_id" {
+  description = "GCP project to deploy into."
+  type        = string
+}
+
+variable "cluster_name" {
+  description = "Name for the multi-slice TPU cluster."
+  type        = string
+  default     = "tpu-multislice"
+}
+
+variable "region" {
+  description = "Region with v5e capacity."
+  type        = string
+  default     = "us-east5"
+}
+
+variable "node_zones" {
+  description = "Zone for both slices (DCN is intra-zone here; spread zones only with a reservation that spans them)."
+  type        = list(string)
+  default     = ["us-east5-b"]
+}
+
+variable "slice_topology" {
+  description = "ICI topology of EACH slice (2x4 = 8 chips, 2 hosts on v5e)."
+  type        = string
+  default     = "2x4"
+}
+
+variable "spot" {
+  description = "Run both slices on spot capacity. NOTE: this example's smoke test runs at level \"probes\" (seconds of work, retried on preemption via the Job's backoff budget); for long burn-ins on spot capacity wire smoketest.level = \"burnin\" plus checkpoint_dir/checkpoint_pvc in the module call so a preempted Job resumes instead of restarting."
+  type        = bool
+  default     = false
+}
+
+module "tpu_fleet" {
+  source = "../../"
+
+  project_id   = var.project_id
+  cluster_name = var.cluster_name
+  region       = var.region
+  node_zones   = var.node_zones
+
+  # two identical slices: the multislice smoke test requires equal
+  # topologies (one jax.distributed world needs a uniform per-slice shape)
+  tpu_slices = {
+    slice-0 = {
+      version  = "v5e"
+      topology = var.slice_topology
+      spot     = var.spot
+    }
+    slice-1 = {
+      version  = "v5e"
+      topology = var.slice_topology
+      spot     = var.spot
+    }
+  }
+
+  smoketest = {
+    enabled    = true
+    multislice = true
+    level      = "probes" # collectives within AND across slices
+  }
+}

@@ -1,0 +1,19 @@
+output "cluster_name" {
+  description = "Cluster carrying the multi-slice fleet."
+  value       = module.tpu_fleet.cluster_name
+}
+
+output "tpu_slices" {
+  description = "Derived facts per slice (machine type, hosts, chips, topology)."
+  value       = module.tpu_fleet.tpu_slices
+}
+
+output "total_tpu_chips" {
+  description = "Chips across the whole fleet (both slices)."
+  value       = module.tpu_fleet.total_tpu_chips
+}
+
+output "smoketest_job" {
+  description = "The multislice validation Job gating the apply."
+  value       = module.tpu_fleet.smoketest_job
+}

@@ -1,0 +1,35 @@
+# Native test suite for the multi-slice fleet composition.
+
+variables {
+  project_id = "test-project"
+}
+
+run "two_slices_one_world" {
+  command = plan
+
+  assert {
+    condition     = output.total_tpu_chips == 16
+    error_message = "two 2x4 v5e slices = 16 chips"
+  }
+  assert {
+    condition     = output.tpu_slices["slice-0"].hosts == 2
+    error_message = "each 2x4 slice has 2 hosts"
+  }
+  assert {
+    condition     = output.tpu_slices["slice-0"].machine_type == output.tpu_slices["slice-1"].machine_type
+    error_message = "a uniform world needs identical slice shapes"
+  }
+}
+
+run "wider_slices" {
+  command = plan
+
+  variables {
+    slice_topology = "4x4"
+  }
+
+  assert {
+    condition     = output.total_tpu_chips == 32
+    error_message = "two 4x4 slices = 32 chips"
+  }
+}

@@ -21,6 +21,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 @pytest.mark.parametrize("path", [
     "gke/examples/cnpack",
     "gke-tpu/examples/cnpack",
+    "gke-tpu/examples/multislice",
 ])
 def test_examples_validate_clean(path):
     findings = validate_module(load_module(os.path.join(ROOT, path)))
@@ -142,3 +143,30 @@ def test_tpu_example_platform_config_handoff():
     rendered = json.loads(FUNCTIONS["yamlencode"](
         cfg["spec"]["monitoring"]["tpuMetricTypes"]))
     assert len(rendered) == 4
+
+
+def test_multislice_example_plans_fleet():
+    """Two identical slices, one smoketest Job per slice, cross-slice env."""
+    plan = simulate_plan(os.path.join(ROOT, "gke-tpu/examples/multislice"),
+                         {"project_id": "p"})
+    assert plan.outputs["total_tpu_chips"] == 16
+    jobs = [a for a in plan.instances
+            if "kubernetes_job_v1.tpu_smoketest" in a]
+    assert len(jobs) == 2
+    job = plan.instance(
+        'module.tpu_fleet.kubernetes_job_v1.tpu_smoketest["slice-0"]')
+    env = {e["name"]: e["value"] for e in
+           job.attrs["spec"][0]["template"][0]["spec"][0]["container"][0]
+           ["env"]}
+    # the multislice world: 2 slices, 8 chips each, MEGASCALE DCN transport
+    assert env["TPU_SMOKETEST_SLICES"] == "2"
+    assert env["TPU_SMOKETEST_EXPECTED_DEVICES"] == "16"
+    assert "MEGASCALE_COORDINATOR_ADDRESS" in env
+
+
+def test_multislice_example_tftest_suite():
+    from nvidia_terraform_modules_tpu.tfsim import run_tests
+
+    results = run_tests(os.path.join(ROOT, "gke-tpu/examples/multislice"))
+    assert results and all(r.ok for r in results), [
+        (r.path, [(x.name, x.failures) for x in r.runs]) for r in results]
