@@ -404,14 +404,21 @@ def cmd_destroy(args) -> int:
 
 
 def _tf_files(paths: list[str]) -> list[str]:
+    """Formattable files: ``*.tf`` in each dir, plus ``*.tftest.hcl`` there
+    and in its ``tests/`` subdir (terraform fmt covers test files too)."""
     out = []
     for p in paths:
-        if os.path.isdir(p):
-            out.extend(sorted(
-                os.path.join(p, f) for f in os.listdir(p)
-                if f.endswith(".tf")))
-        else:
+        if not os.path.isdir(p):
             out.append(p)
+            continue
+        out.extend(sorted(
+            os.path.join(p, f) for f in os.listdir(p)
+            if f.endswith((".tf", ".tftest.hcl"))))
+        tests = os.path.join(p, "tests")
+        if os.path.isdir(tests):
+            out.extend(sorted(
+                os.path.join(tests, f) for f in os.listdir(tests)
+                if f.endswith(".tftest.hcl")))
     return out
 
 
@@ -532,6 +539,48 @@ def cmd_test(args) -> int:
     return 0 if all(r.ok for r in results) else 1
 
 
+def cmd_providers(args) -> int:
+    """``terraform providers``: the provider requirement tree.
+
+    Lists each module's ``required_providers`` pins and which child
+    modules (local-path calls) introduce which requirements — the
+    reference operators read this to know what ``terraform init`` will
+    pull (``/root/reference/gke/versions.tf:3-16``).
+    """
+    from .lockfile import local_module_calls
+
+    def show_reqs(mod, indent: str) -> None:
+        for name, spec in sorted(mod.required_providers.items()):
+            src = spec.get("source", f"hashicorp/{name}")
+            ver = spec.get("version", "(any version)")
+            print(f"{indent}provider[{src}] {ver}")
+
+    try:
+        root = load_module(args.dir)
+        print(f"Providers required by configuration ({args.dir}):")
+        show_reqs(root, "  ")
+        # recursive walk over local child modules (lockfile.py's source
+        # resolution — one definition of "local"); a broken or missing
+        # child is a LOUD error, matching terraform providers, never a
+        # silently shorter tree
+        seen = {os.path.normpath(args.dir)}
+        queue = [(f"module.{n}", d) for n, d in local_module_calls(root)]
+        while queue:
+            label, d = queue.pop(0)
+            if d in seen:
+                continue
+            seen.add(d)
+            child = load_module(d)
+            print(f"  {label} ({os.path.relpath(d, args.dir)}):")
+            show_reqs(child, "    ")
+            queue.extend((f"{label}.module.{n}", dd)
+                         for n, dd in local_module_calls(child))
+    except (ValueError, OSError) as ex:
+        print(f"Error: {ex}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_docs(args) -> int:
     if args.check:
         ok = check_readme(args.dir)
@@ -606,6 +655,10 @@ def main(argv: list[str] | None = None) -> int:
 
     t = add_module_cmd("test", cmd_test)
     t.add_argument("-filter", action="append", dest="filter")
+
+    pr = sub.add_parser("providers")
+    pr.add_argument("dir")
+    pr.set_defaults(fn=cmd_providers)
 
     f = sub.add_parser("fmt")
     f.add_argument("paths", nargs="+")

@@ -131,15 +131,22 @@ def constraint_satisfied(version: str, constraint: str) -> bool:
 
 # ------------------------------------------------- requirements gathering
 
-def _local_module_dirs(mod: Module) -> list[str]:
-    dirs = []
-    for call in mod.module_calls.values():
+def local_module_calls(mod: Module) -> list[tuple[str, str]]:
+    """``(call name, resolved dir)`` for every local-path module call —
+    the one definition of "local source" shared by lockfile requirement
+    gathering and the ``providers`` requirement tree."""
+    out = []
+    for name, call in sorted(mod.module_calls.items()):
         src = call.body.attr("source")
         if src and isinstance(src.expr, A.Literal) and \
                 str(src.expr.value).startswith((".", "/")):
-            dirs.append(os.path.normpath(
-                os.path.join(mod.path, str(src.expr.value))))
-    return dirs
+            out.append((name, os.path.normpath(
+                os.path.join(mod.path, str(src.expr.value)))))
+    return out
+
+
+def _local_module_dirs(mod: Module) -> list[str]:
+    return [d for _, d in local_module_calls(mod)]
 
 
 def gather_requirements(module_dir: str) -> dict[str, list[str]]:

@@ -414,3 +414,24 @@ def test_broken_auto_tfvars_is_clean_error(tmp_path, capsys):
     (tmp_path / "terraform.tfvars").write_text("a = var.missing\n")
     assert main(["destroy", str(tmp_path)]) == 1
     assert "Error:" in capsys.readouterr().err
+
+
+def test_providers_lists_requirement_tree(capsys):
+    assert main(["providers", os.path.join(ROOT, "gke-tpu", "examples",
+                                           "multislice")]) == 0
+    out = capsys.readouterr().out
+    assert "provider[hashicorp/google] ~> 6.8" in out
+    assert "module.tpu_fleet (../..):" in out
+    assert "provider[hashicorp/helm]" in out
+
+
+def test_providers_missing_dir_errors(capsys):
+    assert main(["providers", "/nonexistent-dir-xyz"]) == 1
+    assert "Error:" in capsys.readouterr().err
+
+
+def test_providers_broken_child_is_loud_error(tmp_path, capsys):
+    (tmp_path / "main.tf").write_text(
+        'module "child" {\n  source = "./missing"\n}\n')
+    assert main(["providers", str(tmp_path)]) == 1
+    assert "Error:" in capsys.readouterr().err

@@ -167,3 +167,23 @@ def test_comparison_ops_not_treated_as_attrs():
 def test_check_reports_line_numbers():
     diffs = check_text("locals {\n      a = 1\n}\n", "x.tf")
     assert diffs and diffs[0].path == "x.tf" and diffs[0].line == 2
+
+
+def test_fmt_covers_tftest_files(tmp_path, capsys):
+    """fmt -check on a module dir reaches its tests/*.tftest.hcl files
+    (terraform fmt formats test files too)."""
+    from nvidia_terraform_modules_tpu.tfsim.__main__ import main
+
+    (tmp_path / "main.tf").write_text('locals {\n  a = 1\n}\n')
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "t.tftest.hcl").write_text(
+        'run "x" {\n    command   =    plan\n}\n')   # mis-aligned
+    assert main(["fmt", "-check", str(tmp_path)]) == 1
+    assert "t.tftest.hcl" in capsys.readouterr().out
+    # rewrite mode fixes it in place
+    assert main(["fmt", str(tmp_path)]) == 0
+    capsys.readouterr()
+    assert main(["fmt", "-check", str(tmp_path)]) == 0
+    assert (tests / "t.tftest.hcl").read_text() == \
+        'run "x" {\n  command = plan\n}\n'
