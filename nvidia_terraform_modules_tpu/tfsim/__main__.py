@@ -562,18 +562,20 @@ def cmd_providers(args) -> int:
         # recursive walk over local child modules (lockfile.py's source
         # resolution — one definition of "local"); a broken or missing
         # child is a LOUD error, matching terraform providers, never a
-        # silently shorter tree
-        seen = {os.path.normpath(args.dir)}
-        queue = [(f"module.{n}", d) for n, d in local_module_calls(root)]
+        # silently shorter tree. Every CALL prints (two siblings sharing
+        # one source dir are two entries, as in terraform); the depth
+        # guard breaks source cycles, which terraform itself rejects.
+        queue = [(f"module.{n}", d, 1) for n, d in local_module_calls(root)]
         while queue:
-            label, d = queue.pop(0)
-            if d in seen:
-                continue
-            seen.add(d)
+            label, d, depth = queue.pop(0)
+            if depth > 8:
+                raise ValueError(
+                    f"{label}: module nesting deeper than 8 levels — "
+                    f"module source cycle?")
             child = load_module(d)
             print(f"  {label} ({os.path.relpath(d, args.dir)}):")
             show_reqs(child, "    ")
-            queue.extend((f"{label}.module.{n}", dd)
+            queue.extend((f"{label}.module.{n}", dd, depth + 1)
                          for n, dd in local_module_calls(child))
     except (ValueError, OSError) as ex:
         print(f"Error: {ex}", file=sys.stderr)

@@ -243,8 +243,8 @@ def simulate_plan(
             variables[name] = evaluate(var.default, base_scope)
         else:
             raise PlanError(f"required variable {name!r} not set")
-        variables[name] = _apply_type_defaults(
-            variables[name], var.type_expr, base_scope)
+        variables[name] = _convert_value(
+            variables[name], var.type_expr, base_scope, f"var.{name}")
     if tfvars:
         raise PlanError(f"unknown tfvars: {sorted(tfvars)}")
 
@@ -352,50 +352,119 @@ def simulate_plan(
     )
 
 
-def _apply_type_defaults(value: Any, type_expr, scope: Scope) -> Any:
-    """Fill ``optional(T, default)`` object attributes, Terraform-style.
+def _convert_value(value: Any, type_expr, scope: Scope, path: str) -> Any:
+    """ONE pass over the declared type: fill ``optional()`` defaults AND
+    coerce/check, terraform's convert semantics for the tfsim subset.
 
-    ``variable "x" { type = object({ a = optional(bool, true) }) }`` with
-    ``x = {}`` must evaluate ``var.x.a`` to ``true``. Handles nested objects
-    and ``list(object)`` / ``map(object)`` element types; non-constructor
-    types pass values through untouched.
+    - primitives inter-convert ("5" → 5 for number, bools/strings both
+      ways); number rejects inf/nan/underscore spellings like terraform;
+    - collections (list/set/map/tuple) convert element-wise;
+    - objects check every declared attribute: present values convert,
+      missing/null optional attributes take their declared default
+      (terraform 1.3+ semantics), missing required attributes and
+      UNDECLARED attributes fail the plan with the value's path;
+    - ``any`` / unknown constructors / computed values pass through.
+
+    One walker on purpose: a defaults pass and a separate coercion pass
+    over the same grammar drift apart (the type system's single source of
+    truth lives here).
     """
-    if type_expr is None or value is None or value is COMPUTED:
+    if type_expr is None or value is COMPUTED:
         return value
-    # unwrap optional(T, d) to its inner type
-    if isinstance(type_expr, A.Call) and type_expr.name == "optional" and type_expr.args:
-        return _apply_type_defaults(value, type_expr.args[0], scope)
-    if isinstance(type_expr, A.Call) and type_expr.name == "object" and type_expr.args:
-        spec = type_expr.args[0]
-        if not isinstance(spec, A.ObjectExpr) or not isinstance(value, dict):
-            return value
-        out = dict(value)
-        for item in spec.items:
-            if not isinstance(item.key, A.Literal):
-                continue
-            key = str(item.key.value)
-            t = item.value
-            if out.get(key) is not None:
-                out[key] = _apply_type_defaults(out[key], t, scope)
-            elif isinstance(t, A.Call) and t.name == "optional":
-                # Terraform 1.3+: both a missing attribute AND an explicit
-                # null take the optional() default
-                default = (
-                    evaluate(t.args[1], scope) if len(t.args) > 1 else None
-                )
-                out[key] = _apply_type_defaults(default, t.args[0], scope)
-            elif key in out:
+    # ---- primitive names ------------------------------------------------
+    if isinstance(type_expr, A.Traversal) and not type_expr.ops:
+        if value is None:
+            return None
+        t = type_expr.root
+        if t == "string":
+            if isinstance(value, str):
+                return value
+            if isinstance(value, bool):
+                return "true" if value else "false"
+            if isinstance(value, (int, float)):
+                return str(int(value)) if isinstance(value, float) and \
+                    value == int(value) else str(value)
+            raise PlanError(
+                f"{path}: cannot convert {type(value).__name__} to string")
+        if t == "number":
+            if isinstance(value, bool):
+                raise PlanError(f"{path}: cannot convert bool to number")
+            if isinstance(value, (int, float)):
+                return value
+            if isinstance(value, str):
+                # terraform's number syntax only — no inf/nan/underscores
+                if re.fullmatch(r"-?\d+", value.strip()):
+                    return int(value)
+                if re.fullmatch(r"-?\d*\.?\d+([eE][+-]?\d+)?",
+                                value.strip()):
+                    return float(value)
+            raise PlanError(f"{path}: cannot convert {value!r} to number")
+        if t == "bool":
+            if isinstance(value, bool):
+                return value
+            if isinstance(value, str) and value in ("true", "false"):
+                return value == "true"
+            raise PlanError(
+                f"{path}: cannot convert {type(value).__name__} to bool")
+        return value                         # any / unknown names
+    if not isinstance(type_expr, A.Call):
+        return value
+    name, targs = type_expr.name, type_expr.args
+    if name == "optional" and targs:
+        if value is None:
+            default = (evaluate(targs[1], scope) if len(targs) > 1 else None)
+            return _convert_value(default, targs[0], scope, path)
+        return _convert_value(value, targs[0], scope, path)
+    if value is None:
+        return None
+    if name in ("list", "set") and targs:
+        if not isinstance(value, (list, tuple)):
+            raise PlanError(
+                f"{path}: {name} required, got {type(value).__name__}")
+        return [_convert_value(v, targs[0], scope, f"{path}[{i}]")
+                for i, v in enumerate(value)]
+    if name == "map" and targs:
+        if not isinstance(value, dict):
+            raise PlanError(
+                f"{path}: map required, got {type(value).__name__}")
+        return {k: _convert_value(v, targs[0], scope, f"{path}[{k!r}]")
+                for k, v in value.items()}
+    if name == "tuple" and targs and isinstance(targs[0], A.TupleExpr):
+        items = targs[0].items
+        if not isinstance(value, (list, tuple)) or len(value) != len(items):
+            raise PlanError(f"{path}: tuple of {len(items)} required")
+        return [_convert_value(v, t, scope, f"{path}[{i}]")
+                for i, (v, t) in enumerate(zip(value, items))]
+    if name == "object" and targs and isinstance(targs[0], A.ObjectExpr):
+        if not isinstance(value, dict):
+            raise PlanError(
+                f"{path}: object required, got {type(value).__name__}")
+        spec: dict[str, Any] = {}
+        for it in targs[0].items:
+            if isinstance(it.key, A.Literal):
+                spec[str(it.key.value)] = it.value
+        extra = sorted(set(value) - set(spec))
+        if extra:
+            raise PlanError(
+                f"{path}: unexpected object attribute(s) "
+                f"{', '.join(extra)} (declared: {', '.join(sorted(spec))})")
+        out: dict[str, Any] = {}
+        for key, t in spec.items():
+            is_optional = isinstance(t, A.Call) and t.name == "optional"
+            if value.get(key) is not None:
+                out[key] = _convert_value(value[key], t, scope,
+                                          f"{path}.{key}")
+            elif is_optional:
+                # terraform 1.3+: missing AND explicit null both take the
+                # optional() default
+                out[key] = _convert_value(None, t, scope, f"{path}.{key}")
+            elif key in value:
                 out[key] = None  # explicit null on a non-optional attribute
             else:
-                raise PlanError(f"object value missing required attribute {key!r}")
+                raise PlanError(
+                    f"{path}: object value missing required attribute "
+                    f"{key!r}")
         return out
-    if isinstance(type_expr, A.Call) and type_expr.name in ("list", "set") and \
-            type_expr.args and isinstance(value, list):
-        return [_apply_type_defaults(v, type_expr.args[0], scope) for v in value]
-    if isinstance(type_expr, A.Call) and type_expr.name == "map" and \
-            type_expr.args and isinstance(value, dict):
-        return {k: _apply_type_defaults(v, type_expr.args[0], scope)
-                for k, v in value.items()}
     return value
 
 

@@ -435,3 +435,18 @@ def test_providers_broken_child_is_loud_error(tmp_path, capsys):
         'module "child" {\n  source = "./missing"\n}\n')
     assert main(["providers", str(tmp_path)]) == 1
     assert "Error:" in capsys.readouterr().err
+
+
+def test_providers_prints_sibling_calls_sharing_a_source(tmp_path, capsys):
+    (tmp_path / "child").mkdir()
+    (tmp_path / "main.tf").write_text(
+        'module "a" {\n  source = "./child"\n}\n'
+        'module "b" {\n  source = "./child"\n}\n')
+    (tmp_path / "child" / "main.tf").write_text(
+        'terraform {\n  required_providers {\n    google = {\n'
+        '      source  = "hashicorp/google"\n      version = "~> 6.8"\n'
+        '    }\n  }\n}\n')
+    assert main(["providers", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "module.a (child):" in out
+    assert "module.b (child):" in out

@@ -609,8 +609,8 @@ def test_target_count_zero_resource_is_legal():
     from nvidia_terraform_modules_tpu.tfsim import select_targets
 
     plan = _plan({"network": {"create": False,
-                              "network_name": "shared",
-                              "subnetwork_name": "shared-sub"}})
+                              "existing_network": "shared",
+                              "existing_subnetwork": "shared-sub"}})
     kept = select_targets(plan, ["google_compute_network.vpc"])
     assert kept == set()
 

@@ -243,3 +243,84 @@ def test_string_builders_propagate_unknown(tmp_path):
     assert is_computed(plan.outputs["formatted"])
     assert plan.outputs["known_join"] == "a-b"
     assert "<computed>" not in str(plan.outputs["known_join"])
+
+
+# ---- variable type checking / conversion (terraform's convert semantics) --
+
+def _typed_module(tmp_path, decl):
+    (tmp_path / "main.tf").write_text(
+        f'variable "x" {{\n  type = {decl}\n}}\n'
+        'output "x" {\n  value = var.x\n}\n')
+    return str(tmp_path)
+
+
+def test_var_primitive_coercion(tmp_path):
+    mod = _typed_module(tmp_path, "number")
+    assert simulate_plan(mod, {"x": "5"}).outputs["x"] == 5
+    assert simulate_plan(mod, {"x": 5.0}).outputs["x"] == 5.0
+    with pytest.raises(PlanError, match="cannot convert"):
+        simulate_plan(mod, {"x": "five"})
+    with pytest.raises(PlanError, match="cannot convert bool"):
+        simulate_plan(mod, {"x": True})
+
+
+def test_var_string_and_bool_coercion(tmp_path):
+    mod = _typed_module(tmp_path, "string")
+    assert simulate_plan(mod, {"x": 7}).outputs["x"] == "7"
+    assert simulate_plan(mod, {"x": True}).outputs["x"] == "true"
+    mod2 = _typed_module(tmp_path, "bool")
+    assert simulate_plan(mod2, {"x": "true"}).outputs["x"] is True
+    with pytest.raises(PlanError, match="to bool"):
+        simulate_plan(mod2, {"x": 3})
+
+
+def test_var_collection_coercion(tmp_path):
+    mod = _typed_module(tmp_path, "list(number)")
+    assert simulate_plan(mod, {"x": ["1", 2]}).outputs["x"] == [1, 2]
+    with pytest.raises(PlanError, match=r"x\[1\]"):
+        simulate_plan(mod, {"x": [1, "no"]})
+    with pytest.raises(PlanError, match="list required"):
+        simulate_plan(mod, {"x": "not-a-list"})
+    mod2 = _typed_module(tmp_path, "map(string)")
+    assert simulate_plan(mod2, {"x": {"a": 1}}).outputs["x"] == {"a": "1"}
+
+
+def test_var_object_rejects_undeclared_attributes(tmp_path):
+    """The typo class terraform catches and round-1 tfsim silently ate:
+    an object value with an attribute the type doesn't declare."""
+    mod = _typed_module(
+        tmp_path, "object({ machine_type = optional(string, \"n2\") })")
+    assert simulate_plan(mod, {"x": {}}).outputs["x"] == {
+        "machine_type": "n2"}
+    with pytest.raises(PlanError, match="unexpected object attribute"):
+        simulate_plan(mod, {"x": {"machine_typ": "oops"}})
+
+
+def test_var_nested_object_coercion(tmp_path):
+    mod = _typed_module(
+        tmp_path,
+        "map(object({ count = number, tags = optional(list(string), []) }))")
+    out = simulate_plan(
+        mod, {"x": {"a": {"count": "3"}}}).outputs["x"]
+    assert out == {"a": {"count": 3, "tags": []}}
+    with pytest.raises(PlanError, match=r"x\['a'\]\.count"):
+        simulate_plan(mod, {"x": {"a": {"count": "many"}}})
+
+
+def test_var_tuple_elements_get_optional_defaults(tmp_path):
+    """One convert pass means tuple elements fill optional() defaults too
+    (the two-walker design skipped defaults inside tuples)."""
+    mod = _typed_module(
+        tmp_path, 'tuple([object({ a = optional(string, "d") }), number])')
+    out = simulate_plan(mod, {"x": [{}, "3"]}).outputs["x"]
+    assert out == [{"a": "d"}, 3]
+    with pytest.raises(PlanError, match="tuple of 2 required"):
+        simulate_plan(mod, {"x": [{}]})
+
+
+def test_var_number_rejects_non_terraform_spellings(tmp_path):
+    mod = _typed_module(tmp_path, "number")
+    for bad in ("inf", "nan", "-inf", "1_0"):
+        with pytest.raises(PlanError, match="cannot convert"):
+            simulate_plan(mod, {"x": bad})
+    assert simulate_plan(mod, {"x": "-3.5e2"}).outputs["x"] == -350.0
