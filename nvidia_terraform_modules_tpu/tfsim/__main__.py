@@ -5,6 +5,7 @@ The reference's user interface is the ``terraform`` CLI itself
 ``terraform fmt``/``validate`` as the contribution gates). This build has no
 terraform binary in CI, so tfsim ships the same verbs offline::
 
+    python -m nvidia_terraform_modules_tpu.tfsim init gke-tpu [-check]
     python -m nvidia_terraform_modules_tpu.tfsim validate gke-tpu
     python -m nvidia_terraform_modules_tpu.tfsim plan gke-tpu -var project_id=p \
         -var cluster_name=c [-state terraform.tfstate.json] [-json] [-target ADDR]
@@ -30,6 +31,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 
 from .destroy import simulate_destroy
@@ -539,6 +541,68 @@ def cmd_test(args) -> int:
     return 0 if all(r.ok for r in results) else 1
 
 
+def cmd_init(args) -> int:
+    """``terraform init``, offline: the checks init performs that don't
+    need a registry — resolve every local module source (recursively),
+    check ``required_version`` floors against the simulated CLI version,
+    and write or verify the dependency lockfile from the certified
+    provider table (what ``terraform init`` records after plugin
+    selection; see ``tfsim/lockfile.py``).
+    """
+    from .lockfile import constraint_satisfied, local_module_calls
+
+    sim_version = "1.9.0"   # the terraform version tfsim simulates
+
+    try:
+        print(f"Initializing modules ({args.dir})...")
+        # every CALL prints (siblings sharing a source dir are separate
+        # entries, as in terraform init); loading and the version check
+        # dedup by dir. Each queue entry carries its ancestry chain of
+        # dirs, so a module-source cycle errors exactly when a dir
+        # reappears in its own chain — at any depth, never rejecting a
+        # legal deep tree.
+        loaded: dict = {}
+        queue = [(args.dir, "", (os.path.normpath(args.dir),))]
+        while queue:
+            d, label, chain = queue.pop(0)
+            d = os.path.normpath(d)
+            if label:
+                print(f"- {label} in {os.path.relpath(d, args.dir)}")
+            if d in chain[:-1]:
+                raise ValueError(
+                    f"module source cycle: "
+                    f"{' -> '.join(os.path.relpath(c, args.dir) or '.' for c in chain)}")
+            if d not in loaded:
+                mod = load_module(d)
+                if mod.required_version and not constraint_satisfied(
+                        sim_version, mod.required_version):
+                    print(f"Error: {d}: required_version "
+                          f"{mod.required_version!r} excludes the "
+                          f"simulated terraform {sim_version}",
+                          file=sys.stderr)
+                    return 1
+                loaded[d] = mod
+            queue.extend(
+                (dd, (f"{label}.{n}" if label else n),
+                 chain + (os.path.normpath(dd),))
+                for n, dd in local_module_calls(loaded[d]))
+        print("Initializing provider plugins (offline: certified table)...")
+        if args.check:
+            findings = check_lockfile(args.dir)
+            for f in findings:
+                print(f)
+            if findings:
+                return 1
+            print("Lock file is up to date.")
+        else:
+            print(f"wrote {write_lockfile(args.dir)}")
+        print("tfsim init complete (offline).")
+    except (LockfileError, ValueError, OSError) as ex:
+        print(f"Error: {ex}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_providers(args) -> int:
     """``terraform providers``: the provider requirement tree.
 
@@ -563,20 +627,25 @@ def cmd_providers(args) -> int:
         # resolution — one definition of "local"); a broken or missing
         # child is a LOUD error, matching terraform providers, never a
         # silently shorter tree. Every CALL prints (two siblings sharing
-        # one source dir are two entries, as in terraform); the depth
-        # guard breaks source cycles, which terraform itself rejects.
-        queue = [(f"module.{n}", d, 1) for n, d in local_module_calls(root)]
+        # one source dir are two entries, as in terraform); a dir
+        # reappearing in its own ancestry chain is an exact module-source
+        # cycle error at any depth.
+        rootd = os.path.normpath(args.dir)
+        queue = [(f"module.{n}", d, (rootd, os.path.normpath(d)))
+                 for n, d in local_module_calls(root)]
         while queue:
-            label, d, depth = queue.pop(0)
-            if depth > 8:
+            label, d, chain = queue.pop(0)
+            if os.path.normpath(d) in chain[:-1]:
                 raise ValueError(
-                    f"{label}: module nesting deeper than 8 levels — "
-                    f"module source cycle?")
+                    f"{label}: module source cycle: "
+                    f"{' -> '.join(os.path.relpath(c, args.dir) or '.' for c in chain)}")
             child = load_module(d)
             print(f"  {label} ({os.path.relpath(d, args.dir)}):")
             show_reqs(child, "    ")
-            queue.extend((f"{label}.module.{n}", dd, depth + 1)
-                         for n, dd in local_module_calls(child))
+            queue.extend(
+                (f"{label}.module.{n}", dd,
+                 chain + (os.path.normpath(dd),))
+                for n, dd in local_module_calls(child))
     except (ValueError, OSError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
         return 1
@@ -661,6 +730,11 @@ def main(argv: list[str] | None = None) -> int:
     pr = sub.add_parser("providers")
     pr.add_argument("dir")
     pr.set_defaults(fn=cmd_providers)
+
+    ini = sub.add_parser("init")
+    ini.add_argument("dir")
+    ini.add_argument("-check", action="store_true")
+    ini.set_defaults(fn=cmd_init)
 
     f = sub.add_parser("fmt")
     f.add_argument("paths", nargs="+")

@@ -11,6 +11,7 @@ simulated recursively; registry modules become fully-computed stubs.
 from __future__ import annotations
 
 import dataclasses
+import math
 import os
 import re
 from typing import Any, Optional
@@ -382,6 +383,9 @@ def _convert_value(value: Any, type_expr, scope: Scope, path: str) -> Any:
             if isinstance(value, bool):
                 return "true" if value else "false"
             if isinstance(value, (int, float)):
+                if isinstance(value, float) and not math.isfinite(value):
+                    raise PlanError(
+                        f"{path}: cannot convert {value!r} to string")
                 return str(int(value)) if isinstance(value, float) and \
                     value == int(value) else str(value)
             raise PlanError(
@@ -390,6 +394,11 @@ def _convert_value(value: Any, type_expr, scope: Scope, path: str) -> Any:
             if isinstance(value, bool):
                 raise PlanError(f"{path}: cannot convert bool to number")
             if isinstance(value, (int, float)):
+                # terraform numbers are finite decimals; json.loads lets
+                # Infinity/NaN through -var, reject them here
+                if isinstance(value, float) and not math.isfinite(value):
+                    raise PlanError(
+                        f"{path}: cannot convert {value!r} to number")
                 return value
             if isinstance(value, str):
                 # terraform's number syntax only — no inf/nan/underscores

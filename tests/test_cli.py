@@ -450,3 +450,47 @@ def test_providers_prints_sibling_calls_sharing_a_source(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "module.a (child):" in out
     assert "module.b (child):" in out
+
+
+def test_init_check_on_shipped_example(capsys):
+    assert main(["init", os.path.join(ROOT, "gke-tpu", "examples",
+                                      "multislice"), "-check"]) == 0
+    out = capsys.readouterr().out
+    assert "- tpu_fleet in" in out
+    assert "Lock file is up to date." in out
+
+
+def test_init_writes_lockfile_and_checks_version(tmp_path, capsys):
+    (tmp_path / "main.tf").write_text(
+        'terraform {\n  required_version = ">= 1.5.0"\n'
+        '  required_providers {\n    google = {\n'
+        '      source  = "hashicorp/google"\n      version = "~> 6.8"\n'
+        '    }\n  }\n}\n')
+    assert main(["init", str(tmp_path)]) == 0
+    assert (tmp_path / ".terraform.lock.hcl").exists()
+    capsys.readouterr()
+    assert main(["init", str(tmp_path), "-check"]) == 0
+    # a floor above the simulated CLI version refuses to init
+    (tmp_path / "main.tf").write_text(
+        'terraform {\n  required_version = ">= 99.0"\n}\n')
+    assert main(["init", str(tmp_path)]) == 1
+    assert "excludes the simulated terraform" in capsys.readouterr().err
+
+
+def test_init_prints_sibling_calls_and_detects_cycles(tmp_path, capsys):
+    (tmp_path / "child").mkdir()
+    (tmp_path / "main.tf").write_text(
+        'module "a" {\n  source = "./child"\n}\n'
+        'module "b" {\n  source = "./child"\n}\n')
+    (tmp_path / "child" / "main.tf").write_text('locals {\n  x = 1\n}\n')
+    assert main(["init", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "- a in child" in out and "- b in child" in out
+    # a real source cycle errors exactly, at any depth
+    (tmp_path / "child" / "main.tf").write_text(
+        'module "up" {\n  source = "../"\n}\n')
+    assert main(["init", str(tmp_path)]) == 1
+    assert "cycle" in capsys.readouterr().err
+    capsys.readouterr()
+    assert main(["providers", str(tmp_path)]) == 1
+    assert "cycle" in capsys.readouterr().err

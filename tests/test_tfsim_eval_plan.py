@@ -324,3 +324,15 @@ def test_var_number_rejects_non_terraform_spellings(tmp_path):
         with pytest.raises(PlanError, match="cannot convert"):
             simulate_plan(mod, {"x": bad})
     assert simulate_plan(mod, {"x": "-3.5e2"}).outputs["x"] == -350.0
+
+
+def test_var_nonfinite_floats_rejected(tmp_path):
+    """json.loads accepts Infinity/NaN via -var; terraform numbers are
+    finite decimals — both number and string targets must refuse."""
+    mod = _typed_module(tmp_path, "number")
+    for bad in (float("inf"), float("nan"), float("-inf")):
+        with pytest.raises(PlanError, match="cannot convert"):
+            simulate_plan(mod, {"x": bad})
+    mod2 = _typed_module(tmp_path, "string")
+    with pytest.raises(PlanError, match="cannot convert"):
+        simulate_plan(mod2, {"x": float("inf")})
