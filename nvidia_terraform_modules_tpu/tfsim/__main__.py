@@ -31,7 +31,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import re
 import sys
 
 from .destroy import simulate_destroy
@@ -549,43 +548,25 @@ def cmd_init(args) -> int:
     provider table (what ``terraform init`` records after plugin
     selection; see ``tfsim/lockfile.py``).
     """
-    from .lockfile import constraint_satisfied, local_module_calls
+    from .lockfile import constraint_satisfied, walk_module_tree
 
     sim_version = "1.9.0"   # the terraform version tfsim simulates
 
     try:
         print(f"Initializing modules ({args.dir})...")
-        # every CALL prints (siblings sharing a source dir are separate
-        # entries, as in terraform init); loading and the version check
-        # dedup by dir. Each queue entry carries its ancestry chain of
-        # dirs, so a module-source cycle errors exactly when a dir
-        # reappears in its own chain — at any depth, never rejecting a
-        # legal deep tree.
-        loaded: dict = {}
-        queue = [(args.dir, "", (os.path.normpath(args.dir),))]
-        while queue:
-            d, label, chain = queue.pop(0)
-            d = os.path.normpath(d)
+        checked: set = set()
+        for label, d, mod in walk_module_tree(args.dir):
             if label:
                 print(f"- {label} in {os.path.relpath(d, args.dir)}")
-            if d in chain[:-1]:
-                raise ValueError(
-                    f"module source cycle: "
-                    f"{' -> '.join(os.path.relpath(c, args.dir) or '.' for c in chain)}")
-            if d not in loaded:
-                mod = load_module(d)
-                if mod.required_version and not constraint_satisfied(
-                        sim_version, mod.required_version):
-                    print(f"Error: {d}: required_version "
-                          f"{mod.required_version!r} excludes the "
-                          f"simulated terraform {sim_version}",
-                          file=sys.stderr)
-                    return 1
-                loaded[d] = mod
-            queue.extend(
-                (dd, (f"{label}.{n}" if label else n),
-                 chain + (os.path.normpath(dd),))
-                for n, dd in local_module_calls(loaded[d]))
+            if d in checked:
+                continue
+            checked.add(d)
+            if mod.required_version and not constraint_satisfied(
+                    sim_version, mod.required_version):
+                print(f"Error: {d}: required_version "
+                      f"{mod.required_version!r} excludes the simulated "
+                      f"terraform {sim_version}", file=sys.stderr)
+                return 1
         print("Initializing provider plugins (offline: certified table)...")
         if args.check:
             findings = check_lockfile(args.dir)
@@ -611,7 +592,7 @@ def cmd_providers(args) -> int:
     reference operators read this to know what ``terraform init`` will
     pull (``/root/reference/gke/versions.tf:3-16``).
     """
-    from .lockfile import local_module_calls
+    from .lockfile import walk_module_tree
 
     def show_reqs(mod, indent: str) -> None:
         for name, spec in sorted(mod.required_providers.items()):
@@ -620,32 +601,17 @@ def cmd_providers(args) -> int:
             print(f"{indent}provider[{src}] {ver}")
 
     try:
-        root = load_module(args.dir)
-        print(f"Providers required by configuration ({args.dir}):")
-        show_reqs(root, "  ")
-        # recursive walk over local child modules (lockfile.py's source
-        # resolution — one definition of "local"); a broken or missing
-        # child is a LOUD error, matching terraform providers, never a
-        # silently shorter tree. Every CALL prints (two siblings sharing
-        # one source dir are two entries, as in terraform); a dir
-        # reappearing in its own ancestry chain is an exact module-source
-        # cycle error at any depth.
-        rootd = os.path.normpath(args.dir)
-        queue = [(f"module.{n}", d, (rootd, os.path.normpath(d)))
-                 for n, d in local_module_calls(root)]
-        while queue:
-            label, d, chain = queue.pop(0)
-            if os.path.normpath(d) in chain[:-1]:
-                raise ValueError(
-                    f"{label}: module source cycle: "
-                    f"{' -> '.join(os.path.relpath(c, args.dir) or '.' for c in chain)}")
-            child = load_module(d)
-            print(f"  {label} ({os.path.relpath(d, args.dir)}):")
+        # ONE pass over the shared walk_module_tree generator: the root
+        # yields first (label ""), then every CALL (siblings included);
+        # cycles and broken children error loudly, never a shorter tree
+        for label, d, child in walk_module_tree(args.dir):
+            if not label:
+                print(f"Providers required by configuration ({args.dir}):")
+                show_reqs(child, "  ")
+                continue
+            pretty = ".".join(f"module.{part}" for part in label.split("."))
+            print(f"  {pretty} ({os.path.relpath(d, args.dir)}):")
             show_reqs(child, "    ")
-            queue.extend(
-                (f"{label}.module.{n}", dd,
-                 chain + (os.path.normpath(dd),))
-                for n, dd in local_module_calls(child))
     except (ValueError, OSError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
         return 1

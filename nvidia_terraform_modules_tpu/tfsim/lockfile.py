@@ -149,6 +149,34 @@ def _local_module_dirs(mod: Module) -> list[str]:
     return [d for _, d in local_module_calls(mod)]
 
 
+def walk_module_tree(root_dir: str):
+    """Yield ``(label, dir, module)`` over the local module-call tree.
+
+    BFS from ``root_dir`` (label ""), every CALL yielded separately
+    (siblings sharing a source dir are distinct entries, as terraform
+    lists them); loading dedups by dir. A dir reappearing in its own
+    ancestry chain raises ``ValueError`` — exact module-source cycle
+    detection at any depth. One walker for every consumer (``init``,
+    ``providers``) so traversal semantics cannot drift.
+    """
+    loaded: dict = {}
+    queue = [(root_dir, "", (os.path.normpath(root_dir),))]
+    while queue:
+        d, label, chain = queue.pop(0)
+        d = os.path.normpath(d)
+        if d in chain[:-1]:
+            raise ValueError(
+                "module source cycle: " + " -> ".join(
+                    os.path.relpath(c, root_dir) or "." for c in chain))
+        if d not in loaded:
+            loaded[d] = load_module(d)
+        yield label, d, loaded[d]
+        queue.extend(
+            (dd, (f"{label}.{n}" if label else n),
+             chain + (os.path.normpath(dd),))
+            for n, dd in local_module_calls(loaded[d]))
+
+
 def gather_requirements(module_dir: str) -> dict[str, list[str]]:
     """source address ("hashicorp/google") → constraint strings collected
     from the root module and every local child module, recursively."""

@@ -8,3 +8,8 @@ from .device import (  # noqa: F401
     is_tpu,
 )
 from .timing import timed, median_time  # noqa: F401
+from .data import (  # noqa: F401
+    input_pipeline,
+    prefetch_to_device,
+    token_stream,
+)

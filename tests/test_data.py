@@ -1,0 +1,79 @@
+"""Input pipeline: determinism, prefetch transparency, sharded training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nvidia_terraform_modules_tpu.models import (
+    BurnInConfig,
+    init_params,
+    make_train_step,
+)
+from nvidia_terraform_modules_tpu.parallel import build_mesh, make_rules, plan_mesh
+from nvidia_terraform_modules_tpu.utils.data import (
+    input_pipeline,
+    prefetch_to_device,
+    token_stream,
+)
+
+CFG = BurnInConfig(vocab=64, d_model=32, n_heads=2, d_ff=64, n_layers=1,
+                   seq_len=16, batch=8, dtype=jnp.float32)
+
+
+def test_token_stream_bias_validated():
+    with pytest.raises(ValueError, match="bias"):
+        next(token_stream(CFG, bias="gaussian"))
+    # uniform mode keeps the synthetic_batch distribution available
+    t, _ = next(token_stream(CFG, bias="uniform"))
+    assert t.min() >= 0 and t.max() < CFG.vocab
+
+
+def test_token_stream_deterministic_and_varied():
+    a = token_stream(CFG, seed=3)
+    b = token_stream(CFG, seed=3)
+    c = token_stream(CFG, seed=4)
+    for _ in range(3):
+        ta, tb, tc = next(a), next(b), next(c)
+        assert np.array_equal(ta[0], tb[0])          # same seed, same data
+        assert not np.array_equal(ta[0], tc[0])      # different seed
+        # next-token contract: targets are tokens shifted by one
+        assert np.array_equal(ta[0][:, 1:], ta[1][:, :-1])
+    # successive batches differ (a stream, not one repeated batch)
+    s = token_stream(CFG, seed=0)
+    assert not np.array_equal(next(s)[0], next(s)[0])
+
+
+def test_prefetch_is_transparent():
+    """Prefetching must reorder NOTHING — same batches, same order."""
+    raw = token_stream(CFG, seed=7)
+    pre = prefetch_to_device(token_stream(CFG, seed=7), size=3)
+    for _ in range(6):
+        a, b = next(raw), next(pre)
+        assert np.array_equal(a[0], jax.device_get(b[0]))
+        assert np.array_equal(a[1], jax.device_get(b[1]))
+    with pytest.raises(ValueError, match="size"):
+        next(prefetch_to_device(token_stream(CFG), size=0))
+
+
+def test_prefetch_drains_finite_iterators():
+    batches = list(prefetch_to_device(iter([1, 2, 3]), size=8))
+    assert [int(jax.device_get(b)) for b in batches] == [1, 2, 3]
+
+
+def test_pipeline_trains_sharded(jax8):
+    mesh = build_mesh(plan_mesh(8, tp=2, sp=1))
+    rules = make_rules(mesh)
+    params = init_params(jax.random.PRNGKey(0), CFG, rules)
+    step = make_train_step(CFG, rules, lr=5e-2)
+    losses = []
+    stream = input_pipeline(CFG, rules, seed=1)
+    for _, batch in zip(range(10), stream):
+        # batches arrive committed with the step's expected sharding
+        assert batch[0].sharding.spec == rules.act(None)
+        params, loss = step(params, batch)
+        losses.append(float(loss))
+    # streaming fresh data each step: the model learns the Zipf
+    # marginal, so loss falls decisively below a uniform model's
+    # ln(64) ≈ 4.16 — not a single noisy first-vs-last comparison
+    assert losses[-1] < 4.0, losses
