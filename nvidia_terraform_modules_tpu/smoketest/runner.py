@@ -211,14 +211,16 @@ def run_smoketest(
                     # data sharding — a hardcoded small batch would crash
                     # exactly on the larger slices this Job targets
                     prompt = batch[0][:, :8]
-                    toks = jax.device_get(greedy_decode(
-                        params, prompt, 4, cfg, rules))
+                    toks = greedy_decode(params, prompt, 4, cfg, rules)
                     logits = forward(params, prompt, cfg, rules)
-                    first_ref = jax.device_get(
-                        jax.numpy.argmax(logits[:, -1], axis=-1))
+                    first_ref = jax.numpy.argmax(logits[:, -1], axis=-1)
+                    # reduce to a replicated SCALAR before fetching: in a
+                    # multi-host world the batch-sharded token array spans
+                    # non-addressable devices and device_get would throw
+                    match = jax.numpy.all(toks[:, 0] == first_ref)
                     checks["decode_ok"] = (
                         toks.shape == (prompt.shape[0], 4)
-                        and bool((toks[:, 0] == first_ref).all()))
+                        and bool(jax.device_get(match)))
                 except Exception as exc:  # JSON contract > the type
                     checks["decode_ok"] = False
                     checks["decode_error"] = str(exc)

@@ -61,24 +61,30 @@ def prefetch_to_device(batches: Iterator[Any], rules=None,
                        size: int = 2) -> Iterator[Any]:
     """Keep ``size`` batches in flight to the device ahead of the consumer.
 
-    Pytree-generic: every leaf is ``device_put`` (with the mesh's batch
-    sharding when ``rules`` is given — batch dim over the data axes,
-    matching the train step's ``in_shardings``). Because ``device_put``
-    is asynchronous, the window means batch ``i+1``'s host→device copy
-    runs while the step computes on batch ``i``.
+    Pytree-generic: every leaf is ``device_put`` — with ``rules``, each
+    leaf gets the batch sharding TRUNCATED to its own rank (batch dim
+    over the data axes, remaining dims replicated), so token arrays,
+    per-example lengths, and scalars all place correctly. Because
+    ``device_put`` is asynchronous, the window means batch ``i+1``'s
+    host→device copy runs while the step computes on batch ``i``.
     """
     import jax
+    from jax.sharding import PartitionSpec as P
 
     if size < 1:
         raise ValueError(f"prefetch size must be >= 1, got {size}")
-    sharding = None
-    if rules is not None:
-        sharding = rules.shard(rules.act(None))
+
+    def leaf_sharding(x):
+        if rules is None:
+            return None
+        ndim = getattr(x, "ndim", 0)
+        spec = ((rules.data,) + (None,) * (ndim - 1)) if ndim else ()
+        return rules.shard(P(*spec))
 
     def place(batch):
         return jax.tree.map(
-            lambda x: jax.device_put(x, sharding) if sharding is not None
-            else jax.device_put(x), batch)
+            lambda x: jax.device_put(x, leaf_sharding(x))
+            if rules is not None else jax.device_put(x), batch)
 
     window: collections.deque = collections.deque()
     for batch in batches:
@@ -90,6 +96,6 @@ def prefetch_to_device(batches: Iterator[Any], rules=None,
 
 
 def input_pipeline(cfg, rules=None, seed: int = 0,
-                   prefetch: int = 2) -> Iterator[Any]:
+                   prefetch: int = 2, bias: str = "zipf") -> Iterator[Any]:
     """``token_stream`` → ``prefetch_to_device``: the assembled pipeline."""
-    return prefetch_to_device(token_stream(cfg, seed), rules, prefetch)
+    return prefetch_to_device(token_stream(cfg, seed, bias), rules, prefetch)

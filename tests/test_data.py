@@ -77,3 +77,25 @@ def test_pipeline_trains_sharded(jax8):
     # marginal, so loss falls decisively below a uniform model's
     # ln(64) ≈ 4.16 — not a single noisy first-vs-last comparison
     assert losses[-1] < 4.0, losses
+
+
+def test_prefetch_truncates_spec_to_leaf_rank(jax8):
+    """Mixed-rank batches place cleanly: each leaf's spec is the batch
+    sharding truncated to its rank (scalars replicate)."""
+    mesh = build_mesh(plan_mesh(8, tp=2, sp=1))
+    rules = make_rules(mesh)
+    batches = iter([{"tokens": np.zeros((8, 16), np.int32),
+                     "lengths": np.full((8,), 16, np.int32),
+                     "step": np.int32(1)}])
+    (placed,) = list(prefetch_to_device(batches, rules))
+    assert placed["tokens"].sharding.spec == rules.act(None)
+    assert placed["lengths"].sharding.spec[0] == "dp"
+    assert placed["step"].sharding.spec == jax.sharding.PartitionSpec()
+
+
+def test_input_pipeline_forwards_bias():
+    from nvidia_terraform_modules_tpu.utils.data import input_pipeline
+
+    a = next(iter(input_pipeline(CFG, seed=3, bias="uniform", prefetch=1)))
+    b = next(token_stream(CFG, seed=3, bias="uniform"))
+    assert np.array_equal(jax.device_get(a[0]), b[0])
