@@ -127,6 +127,37 @@ def main() -> None:
     decode_tokens_per_s = dec_cfg.batch / step_seconds_dec
     prefill_tokens_per_s = dec_cfg.batch * prompt_len / max(t_prefill, 1e-9)
 
+    # weight-only int8 serving: same decode, weights int8-resident in HBM
+    # (the decode regime is weight-bandwidth-bound, so this is the lever)
+    from nvidia_terraform_modules_tpu.models import (
+        make_quantized_decoder,
+        quantize_tree,
+    )
+
+    qparams = quantize_tree(dec_params)
+    q_decoder = make_quantized_decoder(
+        dec_cfg, n_new=n_new, max_len=max_len,
+        dtype=dec_cfg.dtype)
+    # int8 prefill twin: the quantized program's own prefill cost —
+    # subtracting the bf16 twin's would fold the dequant/prefill delta
+    # into the per-step estimate and skew the side-by-side numbers
+    q_prefiller = make_quantized_decoder(
+        dec_cfg, n_new=1, max_len=max_len, dtype=dec_cfg.dtype)
+    sync(q_decoder(qparams, prompt))     # compile
+    sync(q_prefiller(qparams, prompt))   # compile
+    t_q = time.perf_counter()
+    for _ in range(dec_iters):
+        toks = q_decoder(qparams, prompt)
+    sync(toks)
+    t_q_total = (time.perf_counter() - t_q) / dec_iters
+    t_qp = time.perf_counter()
+    for _ in range(dec_iters):
+        toks = q_prefiller(qparams, prompt)
+    sync(toks)
+    t_q_prefill = (time.perf_counter() - t_qp) / dec_iters
+    q_step = max(t_q_total - t_q_prefill, 1e-9) / (n_new - 1)
+    decode_int8_tokens_per_s = dec_cfg.batch / q_step
+
     # long-context attention: pallas flash kernel vs XLA dense at S=4096 —
     # the regime ring/flash attention exist for (O(S²) HBM traffic dominates)
     longctx: dict[str, float] = {}
@@ -184,6 +215,7 @@ def main() -> None:
         "burnin_seq_len": cfg.seq_len,
         "burnin_mfu": round(mfu, 3),
         "decode_tokens_per_s": round(decode_tokens_per_s, 1),
+        "decode_int8_tokens_per_s": round(decode_int8_tokens_per_s, 1),
         "prefill_tokens_per_s": round(prefill_tokens_per_s, 1),
         "decode_batch": dec_cfg.batch,
         "decode_prompt_len": prompt_len,

@@ -23,6 +23,12 @@ from .decode import (  # noqa: F401
     make_decoder,
     sample_decode,
 )
+from .quantize import (  # noqa: F401
+    dequantize_tree,
+    make_quantized_decoder,
+    quantize_tree,
+    quantized_nbytes,
+)
 from .optimizer import (  # noqa: F401
     AdamWConfig,
     abstract_train_state,

@@ -1,0 +1,116 @@
+"""Weight-only int8 quantization for the serve path.
+
+Decode throughput on TPU is HBM-bound: every step re-reads the full weight
+set (``models/decode.py``). Weight-only int8 halves the RESIDENT weight
+footprint vs bf16 (4× vs f32) — the standard serving lever:
+
+- **per-output-channel symmetric scales**: each matmul weight ``[in, out]``
+  stores int8 values plus one f32 scale per output column — the finest
+  granularity that keeps the dequant a single multiply on the matmul's
+  output side;
+- **store int8, compute bf16**: weights live between calls as int8;
+  dequant runs inside the jitted decoder. Whether each decode step then
+  re-reads int8 (dequant re-fused per step) or a hoisted bf16 copy is
+  XLA's loop-invariant-materialisation call, which can differ by backend
+  and shape — so this module claims the storage win and the MEASURED
+  throughput (``bench.py`` reports int8 next to bf16), not a fusion
+  guarantee. Guaranteeing int8 reads per step would take a pallas
+  int8-operand matmul kernel (future work);
+- **norms and scales stay exact**: 1-D parameters (RMSNorm scales) are
+  tiny and precision-critical — they pass through unquantized.
+
+``quantize_tree`` / ``dequantize_tree`` are pytree-generic over the
+burn-in parameter layout; ``make_quantized_decoder`` compiles a greedy
+decoder whose weights stay int8-resident between calls.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.sharding import ShardingRules
+from .burnin import BurnInConfig
+from .decode import greedy_decode
+
+
+def quantize(w, axis: int = -1):
+    """Symmetric per-channel int8: ``(q int8, scale f32)`` with the scale
+    per slice along every axis EXCEPT ``axis``'s complement — i.e. one
+    scale per output channel for a ``[in, out]`` weight (axis=-1)."""
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=tuple(
+        i for i in range(w32.ndim) if i != (axis % w32.ndim)),
+        keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize(q, scale, dtype=jnp.bfloat16):
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def _is_quantizable(path_leaf, x) -> bool:
+    """Matmul weights only: ≥2-D. Norm scales (1-D) and scalars stay."""
+    return getattr(x, "ndim", 0) >= 2
+
+
+def quantize_tree(params) -> dict[str, Any]:
+    """Params pytree → ``{"q": …, "scale": …, "kept": …}``.
+
+    ``q``/``scale`` mirror the quantizable leaves (≥2-D); ``kept`` holds
+    the untouched leaves (norm scales) at their original paths, with
+    ``None`` placeholders keeping all three trees congruent.
+    """
+    # ONE traversal quantizes each leaf once; two cheap maps then split
+    # the (q, scale) pairs into congruent trees
+    pairs = jax.tree.map(
+        lambda x: quantize(x) if _is_quantizable(None, x) else None,
+        params)
+    is_pair = lambda x: x is None or isinstance(x, tuple)  # noqa: E731
+    q_tree = jax.tree.map(lambda p: None if p is None else p[0], pairs,
+                          is_leaf=is_pair)
+    s_tree = jax.tree.map(lambda p: None if p is None else p[1], pairs,
+                          is_leaf=is_pair)
+    kept = jax.tree.map(
+        lambda x: None if _is_quantizable(None, x) else x, params)
+    return {"q": q_tree, "scale": s_tree, "kept": kept}
+
+
+def dequantize_tree(qparams, dtype=jnp.bfloat16):
+    """Inverse of :func:`quantize_tree` — runs inside the jitted consumer,
+    so the stored weights stay int8 in HBM between calls."""
+
+    def leaf(q, scale, kept):
+        if q is None:
+            return kept
+        return dequantize(q, scale, dtype)
+
+    return jax.tree.map(
+        leaf, qparams["q"], qparams["scale"], qparams["kept"],
+        is_leaf=lambda x: x is None)
+
+
+def quantized_nbytes(qparams) -> int:
+    return sum(x.nbytes for x in jax.tree.leaves(qparams))
+
+
+def make_quantized_decoder(cfg: BurnInConfig,
+                           rules: ShardingRules | None = None,
+                           n_new: int = 32, max_len: int | None = None,
+                           dtype=jnp.bfloat16):
+    """Compiled greedy decoder over int8-resident weights:
+    ``decoder(qparams, prompt) → [B, n_new]``. Weights stay int8 between
+    calls; dequant runs inside the jit (see the module docstring for what
+    that does and does not guarantee about per-step HBM reads)."""
+
+    def decoder(qparams, prompt):
+        params = dequantize_tree(qparams, dtype)
+        return greedy_decode(params, prompt, n_new, cfg, rules,
+                             max_len=max_len)
+
+    return jax.jit(decoder)
