@@ -39,6 +39,12 @@ class BurnInConfig:
     vocab: int = 512
     d_model: int = 128
     n_heads: int = 4
+    # grouped-query attention: K/V project to this many heads (must divide
+    # n_heads); each KV head serves n_heads/n_kv_heads query heads. None =
+    # n_heads (plain MHA). The win is the DECODE cache — its size scales
+    # with n_kv_heads, and the cache is the other HBM consumer next to the
+    # weights in the serving loop (models/decode.py stores only KV heads).
+    n_kv_heads: int | None = None
     d_ff: int = 512
     n_layers: int = 2
     seq_len: int = 128
@@ -93,10 +99,20 @@ class BurnInConfig:
             raise ValueError(
                 f"router_top_k = {self.router_top_k} needs n_experts > 0 "
                 f"(a dense model has no router to take a top-k from)")
+        if self.n_kv_heads is not None and (
+                self.n_kv_heads < 1 or self.n_heads % self.n_kv_heads):
+            raise ValueError(
+                f"n_kv_heads = {self.n_kv_heads} must divide n_heads = "
+                f"{self.n_heads}")
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads if self.n_kv_heads is not None else \
+            self.n_heads
 
 
 
@@ -114,11 +130,12 @@ def init_params(rng, cfg: BurnInConfig, rules: ShardingRules | None = None):
     }
     for i in range(cfg.n_layers):
         lk = jax.random.split(keys[2 + i], 7)
+        kv_dim = cfg.kv_heads * cfg.head_dim   # < d_model under GQA
         layer = {
             "attn_norm": jnp.ones((cfg.d_model,), dtype=cfg.dtype),
             "wq": dense(lk[0], (cfg.d_model, cfg.d_model)),
-            "wk": dense(lk[1], (cfg.d_model, cfg.d_model)),
-            "wv": dense(lk[2], (cfg.d_model, cfg.d_model)),
+            "wk": dense(lk[1], (cfg.d_model, kv_dim)),
+            "wv": dense(lk[2], (cfg.d_model, kv_dim)),
             "wo": dense(lk[3], (cfg.d_model, cfg.d_model)),
             "mlp_norm": jnp.ones((cfg.d_model,), dtype=cfg.dtype),
         }
@@ -170,6 +187,15 @@ def forward_and_aux(params, tokens, cfg: BurnInConfig,
             return x
         return jax.lax.with_sharding_constraint(x, rules.shard(rules.act(*rest)))
 
+    if rules is not None:
+        tp = rules.mesh.shape.get("tp", 1)
+        if cfg.kv_heads % tp:
+            raise ValueError(
+                f"kv_heads = {cfg.kv_heads} must be divisible by the tp "
+                f"mesh axis ({tp}) — K/V heads shard over tp (MQA-style "
+                f"kv_heads=1 needs tp=1, or replicate K/V by raising "
+                f"n_kv_heads to the tp size)")
+
     x = params["embed"][tokens]                       # [B, S, D]
     # sequence-parallel resident layout between blocks
     x = act(x, "sp", None)
@@ -193,11 +219,19 @@ def forward_and_aux(params, tokens, cfg: BurnInConfig,
         k = h @ layer["wk"]
         v = h @ layer["wv"]
 
-        def split(t):
-            t = t.reshape(t.shape[0], t.shape[1], cfg.n_heads, cfg.head_dim)
+        def split(t, heads=cfg.n_heads):
+            t = t.reshape(t.shape[0], t.shape[1], heads, cfg.head_dim)
             return act(t, *seq_dims)
 
-        q, k, v = split(q), split(k), split(v)
+        q = split(q)
+        k, v = split(k, cfg.kv_heads), split(v, cfg.kv_heads)
+        if cfg.kv_heads != cfg.n_heads:
+            # GQA: broadcast each KV head to its query-head group; the
+            # attention impls below then see plain MHA shapes (the cache
+            # memory win lives in decode, which stores only KV heads)
+            rep = cfg.n_heads // cfg.kv_heads
+            k = act(jnp.repeat(k, rep, axis=2), *seq_dims)
+            v = act(jnp.repeat(v, rep, axis=2), *seq_dims)
         if use_ring:
             attn = ring_self_attention(
                 q, k, v, rules.mesh, causal=True, spec=seq_spec
@@ -263,8 +297,9 @@ def train_step_flops(cfg: BurnInConfig) -> float:
     """
     b, s, d, dff, v = (cfg.batch, cfg.seq_len, cfg.d_model, cfg.d_ff,
                        cfg.vocab)
+    kv_frac = cfg.kv_heads / cfg.n_heads   # GQA narrows the K/V projections
     per_layer = (
-        8.0 * b * s * d * d          # q, k, v, o projections (2·BSd² each)
+        (4.0 + 4.0 * kv_frac) * b * s * d * d   # q,o full + k,v at kv width
         + 2.0 * b * s * s * d        # QKᵀ + PV, causal-effective (½ of 4BS²d)
         # FFN: a top-k MoE token passes through k experts' up+down (k=1 for
         # dense and Switch), so the per-token FFN FLOPs scale by k;

@@ -61,7 +61,9 @@ def init_cache(cfg: BurnInConfig, batch: int, max_len: int,
     traced i32 afterwards).
     """
     _check_cfg(cfg)
-    shape = (batch, max_len, cfg.n_heads, cfg.head_dim)
+    # GQA: only KV heads are cached — the cache shrinks by
+    # n_heads/kv_heads, the point of grouped-query attention at serve time
+    shape = (batch, max_len, cfg.kv_heads, cfg.head_dim)
     kv = {
         "k": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
         "v": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
@@ -81,16 +83,25 @@ def _cached_attention(q, k_cache, v_cache, q_pos, scale):
     slots at positions > q_pos are masked (causal over the cache, which
     also hides the not-yet-written zero slots — they sit at positions
     above ``pos`` by construction).
+
+    GQA: the cache carries ``kv`` heads while ``q`` carries ``H = kv·rep``.
+    Queries are RESHAPED into their KV groups and contracted against the
+    un-repeated cache — the repeated-cache tensor the serving win exists
+    to avoid is never materialised.
     """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+    b, t, h, d = q.shape
+    kv = k_cache.shape[2]
+    rep = h // kv
+    qg = q.reshape(b, t, kv, rep, d)
+    s = jnp.einsum("btkgd,bskd->bkgts", qg, k_cache,
                    preferred_element_type=jnp.float32) * scale
     k_pos = jnp.arange(k_cache.shape[1])
     mask = q_pos[:, None] >= k_pos[None, :]              # [T, S_max]
-    s = jnp.where(mask[None, None], s, -1e30)
+    s = jnp.where(mask[None, None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_cache.dtype), v_cache,
+    out = jnp.einsum("bkgts,bskd->btkgd", p.astype(v_cache.dtype), v_cache,
                      preferred_element_type=jnp.float32)
-    return out.astype(q.dtype)
+    return out.reshape(b, t, h, d).astype(q.dtype)
 
 
 def forward_cached(params, tokens, cache, cfg: BurnInConfig,
@@ -138,11 +149,18 @@ def forward_cached(params, tokens, cache, cfg: BurnInConfig,
         k = h @ layer["wk"]
         v = h @ layer["wv"]
 
-        def split(tns):
-            tns = tns.reshape(b, t, cfg.n_heads, cfg.head_dim)
+        def split(tns, heads=cfg.n_heads):
+            tns = tns.reshape(b, t, heads, cfg.head_dim)
             return act(tns, None, "tp", None)
 
-        q, k, v = split(q), split(k), split(v)
+        q = split(q)
+        k, v = split(k, cfg.kv_heads), split(v, cfg.kv_heads)
+        rep = cfg.n_heads // cfg.kv_heads
+
+        def grow(tns):
+            """KV-group broadcast for the MHA-shaped flash kernel."""
+            return jnp.repeat(tns, rep, axis=2) if rep > 1 else tns
+
         k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos0, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos0, 0, 0))
         new_k.append(k_cache)
@@ -150,10 +168,14 @@ def forward_cached(params, tokens, cache, cfg: BurnInConfig,
 
         if t > 1 and prefill_impl == "flash":
             # prompt-only causal attention, fused tiles (pos == 0: the
-            # cache holds nothing the prompt shouldn't already see)
+            # cache holds nothing the prompt shouldn't already see). The
+            # pallas kernel is MHA-shaped, so prefill broadcasts K/V once
+            # (prompt-sized, one-time); the per-STEP path below contracts
+            # grouped queries against the un-repeated cache instead
             from ..ops.flash_attention import flash_attention
 
-            attn = flash_attention(q, k, v, causal=True, scale=scale)
+            attn = flash_attention(q, grow(k), grow(v), causal=True,
+                                   scale=scale)
         else:
             attn = _cached_attention(q, k_cache, v_cache, q_pos, scale)
         attn = attn.reshape(b, t, cfg.d_model)

@@ -159,3 +159,74 @@ def test_grad_accum_rejects_bad_split():
     step = make_train_step(cfg, accum_steps=4)
     with pytest.raises(ValueError, match="not divisible"):
         step(params, batch)
+
+
+def test_gqa_forward_and_training(jax8):
+    """GQA is a projection change, not a different attention: kv_heads ==
+    n_heads reproduces MHA shapes, smaller kv_heads trains sharded."""
+    import jax.numpy as jnp
+    import pytest
+
+    from nvidia_terraform_modules_tpu.parallel import (
+        build_mesh,
+        make_rules,
+        plan_mesh,
+    )
+
+    base = dict(vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2,
+                seq_len=16, batch=8, dtype=jnp.float32)
+    # explicit n_kv_heads == n_heads must equal the default exactly
+    p1 = init_params(jax.random.PRNGKey(0), BurnInConfig(**base))
+    p2 = init_params(jax.random.PRNGKey(0),
+                     BurnInConfig(**base, n_kv_heads=4))
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        assert jnp.array_equal(a, b)
+
+    cfg = BurnInConfig(**base, n_kv_heads=2)
+    assert cfg.kv_heads == 2
+    # K/V projections shrink with the KV head count
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    assert params["layers"][0]["wk"].shape == (32, 2 * cfg.head_dim)
+
+    mesh = build_mesh(plan_mesh(8, tp=2, sp=2))
+    rules = make_rules(mesh)
+    sp_params = init_params(jax.random.PRNGKey(0), cfg, rules)
+    step = make_train_step(cfg, rules, lr=5e-2)
+    batch = synthetic_batch(jax.random.PRNGKey(1), cfg, rules)
+    losses = []
+    for _ in range(6):
+        sp_params, loss = step(sp_params, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        BurnInConfig(**base, n_kv_heads=3)   # 3 does not divide 4
+
+
+def test_gqa_kv_heads_must_divide_tp(jax8):
+    import pytest
+
+    from nvidia_terraform_modules_tpu.parallel import (
+        build_mesh,
+        make_rules,
+        plan_mesh,
+    )
+
+    mesh = build_mesh(plan_mesh(8, tp=2, sp=1))
+    rules = make_rules(mesh)
+    cfg = BurnInConfig(vocab=64, d_model=32, n_heads=4, n_kv_heads=1,
+                       d_ff=64, n_layers=1, seq_len=16, batch=8)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens, _ = synthetic_batch(jax.random.PRNGKey(1), cfg)
+    with pytest.raises(ValueError, match="divisible by the tp"):
+        forward(params, tokens, cfg, rules)
+
+
+def test_gqa_flops_accounting():
+    from nvidia_terraform_modules_tpu.models import train_step_flops
+
+    base = dict(vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=1,
+                seq_len=16, batch=2)
+    mha = train_step_flops(BurnInConfig(**base))
+    gqa = train_step_flops(BurnInConfig(**base, n_kv_heads=1))
+    assert gqa < mha          # narrower K/V projections bill fewer FLOPs

@@ -192,3 +192,29 @@ def test_long_context_nontiling_prompt_policy():
         _select_prefill_impl(cfg, 513, "auto")
     # explicit dense is always allowed — the operator owns the memory call
     assert _select_prefill_impl(cfg, 513, "dense") == "dense"
+
+
+def test_gqa_cache_is_smaller_and_decode_exact():
+    """GQA: the cache stores only KV heads (n_heads/kv_heads smaller), and
+    greedy decode still EQUALS the full re-forward reference."""
+    cfg = BurnInConfig(**{**CFG, "n_heads": 4, "n_kv_heads": 2})
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    cache = init_cache(cfg, 2, 16)
+    assert cache["k"][0].shape == (2, 16, 2, cfg.head_dim)   # KV heads only
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, cfg.vocab)
+    ref = _reference_greedy(params, prompt, 8, cfg)
+    got = greedy_decode(params, prompt, 8, cfg)
+    assert jnp.array_equal(ref, got)
+
+
+def test_gqa_flash_prefill_close_to_dense():
+    cfg = BurnInConfig(**{**CFG, "n_heads": 4, "n_kv_heads": 1,
+                          "attn": "flash"})
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0,
+                                cfg.vocab)
+    d_logits, _ = forward_cached(params, prompt, init_cache(cfg, 2, 80),
+                                 cfg, prefill_impl="dense")
+    f_logits, _ = forward_cached(params, prompt, init_cache(cfg, 2, 80),
+                                 cfg, prefill_impl="flash")
+    assert jnp.max(jnp.abs(d_logits - f_logits)) < 2e-5
