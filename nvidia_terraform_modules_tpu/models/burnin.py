@@ -45,6 +45,12 @@ class BurnInConfig:
     # with n_kv_heads, and the cache is the other HBM consumer next to the
     # weights in the serving loop (models/decode.py stores only KV heads).
     n_kv_heads: int | None = None
+    # rotary position embeddings on q/k (head_dim must be even). Default
+    # False keeps the original NoPE model (causal masking alone carries
+    # order) — flip on for position-sensitive workloads. K is rotated
+    # BEFORE the decode cache write, so cached serving needs no rework.
+    rope: bool = False
+    rope_theta: float = 10000.0
     d_ff: int = 512
     n_layers: int = 2
     seq_len: int = 128
@@ -104,6 +110,9 @@ class BurnInConfig:
             raise ValueError(
                 f"n_kv_heads = {self.n_kv_heads} must divide n_heads = "
                 f"{self.n_heads}")
+        if self.rope and self.head_dim % 2:
+            raise ValueError(
+                f"rope needs an even head_dim, got {self.head_dim}")
 
     @property
     def head_dim(self) -> int:
@@ -114,6 +123,25 @@ class BurnInConfig:
         return self.n_kv_heads if self.n_kv_heads is not None else \
             self.n_heads
 
+
+
+def apply_rope(x, positions, theta: float = 10000.0):
+    """Rotary embedding on ``[B, T, H, D]`` at (possibly traced) positions.
+
+    Half-split convention: the head dim's two halves rotate as pairs.
+    Angles compute in f32 regardless of activation dtype (rope is
+    precision-sensitive at long context), output returns in ``x.dtype``.
+    """
+    d = x.shape[-1]
+    half = d // 2
+    freqs = jnp.exp(
+        -jnp.arange(0, half, dtype=jnp.float32) * (2.0 / d) * jnp.log(theta))
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [T, half]
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
 
 
 def init_params(rng, cfg: BurnInConfig, rules: ShardingRules | None = None):
@@ -187,15 +215,6 @@ def forward_and_aux(params, tokens, cfg: BurnInConfig,
             return x
         return jax.lax.with_sharding_constraint(x, rules.shard(rules.act(*rest)))
 
-    if rules is not None:
-        tp = rules.mesh.shape.get("tp", 1)
-        if cfg.kv_heads % tp:
-            raise ValueError(
-                f"kv_heads = {cfg.kv_heads} must be divisible by the tp "
-                f"mesh axis ({tp}) — K/V heads shard over tp (MQA-style "
-                f"kv_heads=1 needs tp=1, or replicate K/V by raising "
-                f"n_kv_heads to the tp size)")
-
     x = params["embed"][tokens]                       # [B, S, D]
     # sequence-parallel resident layout between blocks
     x = act(x, "sp", None)
@@ -225,6 +244,12 @@ def forward_and_aux(params, tokens, cfg: BurnInConfig,
 
         q = split(q)
         k, v = split(k, cfg.kv_heads), split(v, cfg.kv_heads)
+        if cfg.rope:
+            # global arrays here (sharding constraints distribute them),
+            # so positions are simply 0..S-1 for every attention layout
+            pos = jnp.arange(q.shape[1])
+            q = act(apply_rope(q, pos, cfg.rope_theta), *seq_dims)
+            k = act(apply_rope(k, pos, cfg.rope_theta), *seq_dims)
         if cfg.kv_heads != cfg.n_heads:
             # GQA: broadcast each KV head to its query-head group; the
             # attention impls below then see plain MHA shapes (the cache

@@ -70,7 +70,13 @@ def init_cache(cfg: BurnInConfig, batch: int, max_len: int,
         "pos": jnp.zeros((), jnp.int32),
     }
     if rules is not None:
-        s = rules.shard(rules.act(None, "tp", None))
+        # KV heads shard over tp when they divide it; otherwise (GQA/MQA
+        # with few KV heads) the head axis replicates — device_put, unlike
+        # in-jit constraints, refuses uneven sharding, and replicating a
+        # small KV cache across tp is the natural MQA layout anyway
+        tp = rules.mesh.shape.get("tp", 1)
+        head_axis = "tp" if cfg.kv_heads % tp == 0 else None
+        s = rules.shard(rules.act(None, head_axis, None))
         kv["k"] = [jax.device_put(x, s) for x in kv["k"]]
         kv["v"] = [jax.device_put(x, s) for x in kv["v"]]
     return kv
@@ -155,6 +161,14 @@ def forward_cached(params, tokens, cache, cfg: BurnInConfig,
 
         q = split(q)
         k, v = split(k, cfg.kv_heads), split(v, cfg.kv_heads)
+        if cfg.rope:
+            # rotate at GLOBAL positions (pos0 + local index, traced is
+            # fine); K is rotated before the cache write, so cached rows
+            # never need re-rotation at later steps
+            from .burnin import apply_rope
+
+            q = apply_rope(q, q_pos, cfg.rope_theta)
+            k = apply_rope(k, q_pos, cfg.rope_theta)
         rep = cfg.n_heads // cfg.kv_heads
 
         def grow(tns):

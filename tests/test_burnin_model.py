@@ -203,9 +203,11 @@ def test_gqa_forward_and_training(jax8):
         BurnInConfig(**base, n_kv_heads=3)   # 3 does not divide 4
 
 
-def test_gqa_kv_heads_must_divide_tp(jax8):
-    import pytest
-
+def test_mqa_cache_replicates_heads_when_tp_does_not_divide(jax8):
+    """MQA (kv_heads=1) on a tp=2 mesh: in-jit constraints pad unevenly,
+    but device_put refuses — the cache falls back to a replicated head
+    axis and sharded decode still works."""
+    from nvidia_terraform_modules_tpu.models import init_cache
     from nvidia_terraform_modules_tpu.parallel import (
         build_mesh,
         make_rules,
@@ -215,11 +217,17 @@ def test_gqa_kv_heads_must_divide_tp(jax8):
     mesh = build_mesh(plan_mesh(8, tp=2, sp=1))
     rules = make_rules(mesh)
     cfg = BurnInConfig(vocab=64, d_model=32, n_heads=4, n_kv_heads=1,
-                       d_ff=64, n_layers=1, seq_len=16, batch=8)
-    params = init_params(jax.random.PRNGKey(0), cfg)
-    tokens, _ = synthetic_batch(jax.random.PRNGKey(1), cfg)
-    with pytest.raises(ValueError, match="divisible by the tp"):
-        forward(params, tokens, cfg, rules)
+                       d_ff=64, n_layers=1, seq_len=16, batch=8,
+                       dtype=jnp.float32)
+    cache = init_cache(cfg, 8, 32, rules)
+    assert cache["k"][0].sharding.spec[2] is None      # heads replicated
+    from nvidia_terraform_modules_tpu.models import greedy_decode
+
+    params = init_params(jax.random.PRNGKey(0), cfg, rules)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (8, 6), 0, cfg.vocab)
+    toks = jax.jit(
+        lambda p, t: greedy_decode(p, t, 4, cfg, rules))(params, prompt)
+    assert toks.shape == (8, 4)
 
 
 def test_gqa_flops_accounting():
@@ -230,3 +238,48 @@ def test_gqa_flops_accounting():
     mha = train_step_flops(BurnInConfig(**base))
     gqa = train_step_flops(BurnInConfig(**base, n_kv_heads=1))
     assert gqa < mha          # narrower K/V projections bill fewer FLOPs
+
+
+def test_rope_position_sensitivity_and_training(jax8):
+    """RoPE makes the model order-aware beyond the causal mask, trains
+    sharded, and stays exact across attention layouts."""
+    import jax.numpy as jnp
+    import pytest
+
+    from nvidia_terraform_modules_tpu.parallel import (
+        build_mesh,
+        make_rules,
+        plan_mesh,
+    )
+
+    base = dict(vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2,
+                seq_len=16, batch=4, dtype=jnp.float32)
+    cfg = BurnInConfig(**base, rope=True)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    # two sequences sharing the same LAST 8 tokens but shifted history:
+    # a NoPE model's last-position logits see identical token multisets
+    # in different orders; RoPE must distinguish the arrangements
+    t = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab)
+    t_rolled = jnp.concatenate([t[:, 8:], t[:, :8]], axis=1)
+    la = forward(params, t, cfg)[:, -1]
+    lb = forward(params, t_rolled, cfg)[:, -1]
+    assert float(jnp.max(jnp.abs(la - lb))) > 1e-4
+
+    # rope + ring attention on the mesh matches unsharded dense exactly
+    mesh = build_mesh(plan_mesh(8, tp=2, sp=2))
+    rules = make_rules(mesh)
+    sp = init_params(jax.random.PRNGKey(0), cfg, rules)
+    ref = forward(params, t, cfg)
+    ring_cfg = BurnInConfig(**base, rope=True, attn="ring")
+    got = jax.jit(lambda p, x: forward(p, x, ring_cfg, rules))(sp, t)
+    assert float(jnp.max(jnp.abs(ref - got))) < 2e-5
+
+    step = make_train_step(ring_cfg, rules, lr=5e-2)
+    batch = synthetic_batch(jax.random.PRNGKey(1), ring_cfg, rules)
+    p2, l0 = step(sp, batch)
+    for _ in range(5):
+        p2, loss = step(p2, batch)
+    assert float(loss) < float(l0)
+
+    with pytest.raises(ValueError, match="even head_dim"):
+        BurnInConfig(vocab=64, d_model=12, n_heads=4, rope=True)

@@ -218,3 +218,14 @@ def test_gqa_flash_prefill_close_to_dense():
     f_logits, _ = forward_cached(params, prompt, init_cache(cfg, 2, 80),
                                  cfg, prefill_impl="flash")
     assert jnp.max(jnp.abs(d_logits - f_logits)) < 2e-5
+
+
+def test_rope_decode_matches_reference():
+    """Cached decode with RoPE (K rotated before the cache write) still
+    EQUALS the full re-forward reference, GQA included."""
+    cfg = BurnInConfig(**{**CFG, "rope": True, "n_kv_heads": 2})
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, cfg.vocab)
+    ref = _reference_greedy(params, prompt, 10, cfg)
+    got = greedy_decode(params, prompt, 10, cfg)
+    assert jnp.array_equal(ref, got), (ref, got)
