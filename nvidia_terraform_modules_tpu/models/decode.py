@@ -39,7 +39,7 @@ import jax.numpy as jnp
 
 from ..parallel.sharding import ShardingRules
 from ..utils.layers import rmsnorm as _rmsnorm
-from .burnin import BurnInConfig
+from .burnin import BurnInConfig, apply_rope
 
 
 def _check_cfg(cfg: BurnInConfig) -> None:
@@ -165,8 +165,6 @@ def forward_cached(params, tokens, cache, cfg: BurnInConfig,
             # rotate at GLOBAL positions (pos0 + local index, traced is
             # fine); K is rotated before the cache write, so cached rows
             # never need re-rotation at later steps
-            from .burnin import apply_rope
-
             q = apply_rope(q, q_pos, cfg.rope_theta)
             k = apply_rope(k, q_pos, cfg.rope_theta)
         rep = cfg.n_heads // cfg.kv_heads

@@ -228,6 +228,14 @@ def test_mqa_cache_replicates_heads_when_tp_does_not_divide(jax8):
     toks = jax.jit(
         lambda p, t: greedy_decode(p, t, 4, cfg, rules))(params, prompt)
     assert toks.shape == (8, 4)
+    # the sharded TRAINING path with non-dividing KV heads (uneven
+    # in-jit constraint, GSPMD pads) must keep working too
+    step = make_train_step(cfg, rules, lr=5e-2)
+    batch = synthetic_batch(jax.random.PRNGKey(1), cfg, rules)
+    p2, l0 = step(params, batch)
+    for _ in range(4):
+        p2, loss = step(p2, batch)
+    assert float(loss) < float(l0)
 
 
 def test_gqa_flops_accounting():
@@ -256,14 +264,26 @@ def test_rope_position_sensitivity_and_training(jax8):
                 seq_len=16, batch=4, dtype=jnp.float32)
     cfg = BurnInConfig(**base, rope=True)
     params = init_params(jax.random.PRNGKey(0), cfg)
-    # two sequences sharing the same LAST 8 tokens but shifted history:
-    # a NoPE model's last-position logits see identical token multisets
-    # in different orders; RoPE must distinguish the arrangements
-    t = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab)
-    t_rolled = jnp.concatenate([t[:, 8:], t[:, :8]], axis=1)
-    la = forward(params, t, cfg)[:, -1]
-    lb = forward(params, t_rolled, cfg)[:, -1]
-    assert float(jnp.max(jnp.abs(la - lb))) > 1e-4
+
+    # discriminating position test, single layer: keep the LAST query
+    # token fixed and permute only the history. A 1-layer causal NoPE
+    # model's last-position output is a content-weighted set function of
+    # the history (permutation-INVARIANT); RoPE must break the invariance
+    one = dict(base, n_layers=1)
+    t = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+    hist, last = t[:, :15], t[:, 15:]
+    t_a = jnp.concatenate([hist, last], axis=1)
+    t_b = jnp.concatenate([jnp.roll(hist, 5, axis=1), last], axis=1)
+    nope_cfg = BurnInConfig(**one)
+    nope_params = init_params(jax.random.PRNGKey(0), nope_cfg)
+    na = forward(nope_params, t_a, nope_cfg)[:, -1]
+    nb = forward(nope_params, t_b, nope_cfg)[:, -1]
+    assert float(jnp.max(jnp.abs(na - nb))) < 1e-5     # NoPE: invariant
+    rope_cfg = BurnInConfig(**one, rope=True)
+    rope_params = init_params(jax.random.PRNGKey(0), rope_cfg)
+    ra = forward(rope_params, t_a, rope_cfg)[:, -1]
+    rb = forward(rope_params, t_b, rope_cfg)[:, -1]
+    assert float(jnp.max(jnp.abs(ra - rb))) > 1e-4     # RoPE: sensitive
 
     # rope + ring attention on the mesh matches unsharded dense exactly
     mesh = build_mesh(plan_mesh(8, tp=2, sp=2))
