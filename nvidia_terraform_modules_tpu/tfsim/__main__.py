@@ -319,7 +319,7 @@ def cmd_state(args) -> int:
     teardown runbook step ``terraform state rm
     kubernetes_namespace_v1.gpu-operator`` (``/root/reference/gke/README.md:59``).
     """
-    wanted = {"list": 0, "show": 1, "mv": 2}
+    wanted = {"list": 0, "show": 1, "mv": 2, "pull": 0, "push": 0}
     n = len(args.address)
     if args.subcmd in wanted and n != wanted[args.subcmd] or \
             (args.subcmd == "rm" and n == 0):
@@ -327,10 +327,39 @@ def cmd_state(args) -> int:
               f"{wanted.get(args.subcmd, '1+')} address argument(s), "
               f"got {n}", file=sys.stderr)
         return 2
+    if args.subcmd == "push":
+        # terraform state push: stdin replaces the statefile, REFUSED when
+        # the incoming serial is behind the current one (lineage guard) —
+        # -force overrides, matching terraform
+        try:
+            incoming = State.from_json(sys.stdin.read())
+            if not isinstance(incoming.serial, int) or \
+                    not isinstance(incoming.resources, dict):
+                raise ValueError(
+                    f"serial must be an int and resources an object, got "
+                    f"serial={incoming.serial!r}")
+        except (ValueError, KeyError, TypeError) as ex:
+            # TypeError covers non-object JSON (e.g. a bare number) whose
+            # subscripting fails inside from_json
+            print(f"Error: invalid state on stdin: {ex}", file=sys.stderr)
+            return 1
+        current = _load_state(args.state)
+        if current is not None and incoming.serial < current.serial and \
+                not args.force:
+            print(f"Error: incoming serial {incoming.serial} is behind the "
+                  f"current serial {current.serial}; use -force to "
+                  f"overwrite", file=sys.stderr)
+            return 1
+        _write_state(args.state, incoming)
+        return 0
+
     state = _load_state(args.state)
     if state is None:
         print(f"Error: no state at {args.state!r}", file=sys.stderr)
         return 1
+    if args.subcmd == "pull":
+        print(state.to_json())
+        return 0
 
     def save(new_state: State) -> None:
         _write_state(args.state, new_state)
@@ -685,9 +714,11 @@ def main(argv: list[str] | None = None) -> int:
     o.set_defaults(fn=cmd_output)
 
     st = sub.add_parser("state")
-    st.add_argument("subcmd", choices=["list", "show", "rm", "mv"])
+    st.add_argument("subcmd",
+                    choices=["list", "show", "rm", "mv", "pull", "push"])
     st.add_argument("address", nargs="*")
     st.add_argument("-state", required=True)
+    st.add_argument("-force", action="store_true")
     st.set_defaults(fn=cmd_state)
 
     t = add_module_cmd("test", cmd_test)

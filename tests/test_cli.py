@@ -494,3 +494,47 @@ def test_init_prints_sibling_calls_and_detects_cycles(tmp_path, capsys):
     capsys.readouterr()
     assert main(["providers", str(tmp_path)]) == 1
     assert "cycle" in capsys.readouterr().err
+
+
+def test_state_pull_push_with_serial_guard(tmp_path, capsys, monkeypatch):
+    import io
+
+    state = str(tmp_path / "s.json")
+    assert main(["apply", GKE_TPU, "-state", state] + VARS) == 0
+    capsys.readouterr()
+    # pull: the raw statefile JSON on stdout
+    assert main(["state", "pull", "-state", state]) == 0
+    pulled = capsys.readouterr().out
+    assert json.loads(pulled)["serial"] >= 1
+    # push the same state back: same serial, accepted
+    monkeypatch.setattr("sys.stdin", io.StringIO(pulled))
+    assert main(["state", "push", "-state", state]) == 0
+    # a stale serial is refused without -force (lineage guard)
+    stale = json.loads(pulled)
+    stale["serial"] = 0
+    monkeypatch.setattr("sys.stdin", io.StringIO(json.dumps(stale)))
+    assert main(["state", "push", "-state", state]) == 1
+    assert "behind the current serial" in capsys.readouterr().err
+    monkeypatch.setattr("sys.stdin", io.StringIO(json.dumps(stale)))
+    assert main(["state", "push", "-state", state, "-force"]) == 0
+    capsys.readouterr()
+    assert main(["state", "pull", "-state", state]) == 0
+    assert json.loads(capsys.readouterr().out)["serial"] == 0
+    # garbage on stdin is a clean error
+    monkeypatch.setattr("sys.stdin", io.StringIO("not json"))
+    assert main(["state", "push", "-state", state]) == 1
+    assert "invalid state" in capsys.readouterr().err
+
+
+def test_state_push_rejects_malformed_payloads(tmp_path, capsys, monkeypatch):
+    import io
+
+    state = str(tmp_path / "s.json")
+    assert main(["apply", GKE_TPU, "-state", state] + VARS) == 0
+    capsys.readouterr()
+    for payload in ("123", '["x"]',
+                    '{"serial": "0", "resources": {}, "outputs": {}}',
+                    '{"serial": null, "resources": {}, "outputs": {}}'):
+        monkeypatch.setattr("sys.stdin", io.StringIO(payload))
+        assert main(["state", "push", "-state", state]) == 1, payload
+        assert "invalid state" in capsys.readouterr().err
