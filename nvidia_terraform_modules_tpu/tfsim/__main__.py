@@ -196,7 +196,7 @@ def cmd_plan(args) -> int:
             "check_failures": plan.check_failures,
         }, indent=2, sort_keys=True))
         return 0
-    marks = {"create": "+", "update": "~"}
+    marks = {"create": "+", "update": "~", "replace": "-/+"}
     for addr in plan.order:
         for iaddr in sorted(a for a in d.actions
                             if d.actions[a] != "delete" and (
@@ -332,24 +332,42 @@ def cmd_state(args) -> int:
         # the incoming serial is behind the current one (lineage guard) —
         # -force overrides, matching terraform
         try:
-            incoming = State.from_json(sys.stdin.read())
+            raw_text = sys.stdin.read()
+            incoming = State.from_json(raw_text)
             if not isinstance(incoming.serial, int) or \
-                    not isinstance(incoming.resources, dict):
+                    not isinstance(incoming.resources, dict) or \
+                    not isinstance(incoming.outputs, dict) or \
+                    not all(isinstance(a, str) for a in incoming.tainted):
                 raise ValueError(
-                    f"serial must be an int and resources an object, got "
-                    f"serial={incoming.serial!r}")
+                    "serial must be an int, resources/outputs objects, "
+                    "and tainted a list of addresses")
+            if not all(isinstance(v, dict) for v in
+                       incoming.outputs.values()):
+                raise ValueError(
+                    'outputs entries must be {"value": …, "sensitive": …} '
+                    "objects")
         except (ValueError, KeyError, TypeError) as ex:
             # TypeError covers non-object JSON (e.g. a bare number) whose
             # subscripting fails inside from_json
             print(f"Error: invalid state on stdin: {ex}", file=sys.stderr)
             return 1
+        # tainted arrives as a JSON list; from_json set()s it, but a bare
+        # STRING would also iterate — the isinstance(str) check above plus
+        # this re-parse guard keeps split-into-characters corruption out
         current = _load_state(args.state)
-        if current is not None and incoming.serial < current.serial and \
-                not args.force:
-            print(f"Error: incoming serial {incoming.serial} is behind the "
-                  f"current serial {current.serial}; use -force to "
-                  f"overwrite", file=sys.stderr)
-            return 1
+        if current is not None and not args.force:
+            # lineage guard: a push must advance the serial unless its
+            # content is identical (a lost-update race otherwise clobbers
+            # the other operator's same-serial edit silently)
+            if incoming.serial < current.serial or (
+                    incoming.serial == current.serial and
+                    incoming.to_json() != current.to_json()):
+                print(f"Error: incoming serial {incoming.serial} does not "
+                      f"advance the current serial {current.serial} (and "
+                      f"the content differs); pull, reconcile, and push a "
+                      f"higher serial — or use -force to overwrite",
+                      file=sys.stderr)
+                return 1
         _write_state(args.state, incoming)
         return 0
 
@@ -488,6 +506,37 @@ def cmd_lock(args) -> int:
         print(f"{'Success! ' if not findings else ''}"
               f"{len(findings)} lockfile finding(s).")
     return 1 if findings else 0
+
+
+def cmd_taint(args) -> int:
+    """``terraform taint|untaint``: force (or cancel forcing) recreation.
+
+    A tainted address diffs as ``replace`` (``-/+`` in plan output, counted
+    as one add and one destroy) regardless of config drift; the apply that
+    recreates it clears the mark — terraform's lifecycle exactly.
+    """
+    state = _load_state(args.state)
+    if state is None:
+        print(f"Error: no state at {args.state!r}", file=sys.stderr)
+        return 1
+    if args.address not in state.resources:
+        print(f"Error: {args.address!r} not in state", file=sys.stderr)
+        return 1
+    if args.untaint:
+        if args.address not in state.tainted:
+            print(f"Error: {args.address!r} is not tainted", file=sys.stderr)
+            return 1
+        state.tainted.discard(args.address)
+        verdict = "unmarked as tainted"
+    else:
+        state.tainted.add(args.address)
+        verdict = "marked as tainted"
+    # a taint IS a state mutation: bump the serial so the lineage guard
+    # protects it from being clobbered by a concurrent pre-taint push
+    state.serial += 1
+    _write_state(args.state, state)
+    print(f"Resource instance {args.address} has been {verdict}.")
+    return 0
 
 
 def cmd_workspace(args) -> int:
@@ -712,6 +761,12 @@ def main(argv: list[str] | None = None) -> int:
     o.add_argument("-json", action="store_true")
     o.add_argument("-raw", action="store_true")
     o.set_defaults(fn=cmd_output)
+
+    for name in ("taint", "untaint"):
+        tn = sub.add_parser(name)
+        tn.add_argument("address")
+        tn.add_argument("-state", required=True)
+        tn.set_defaults(fn=cmd_taint, untaint=(name == "untaint"))
 
     st = sub.add_parser("state")
     st.add_argument("subcmd",

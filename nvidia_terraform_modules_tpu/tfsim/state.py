@@ -42,18 +42,23 @@ class State:
     serial: int = 0
     outputs: dict[str, dict[str, Any]] = dataclasses.field(
         default_factory=dict)
+    # addresses marked for forced recreation (`terraform taint`); cleared
+    # by the apply that replaces them
+    tainted: set[str] = dataclasses.field(default_factory=set)
 
     def to_json(self) -> str:
-        return json.dumps(
-            {"serial": self.serial, "resources": self.resources,
-             "outputs": self.outputs},
-            indent=2, sort_keys=True)
+        payload = {"serial": self.serial, "resources": self.resources,
+                   "outputs": self.outputs}
+        if self.tainted:
+            payload["tainted"] = sorted(self.tainted)
+        return json.dumps(payload, indent=2, sort_keys=True)
 
     @classmethod
     def from_json(cls, text: str) -> "State":
         raw = json.loads(text)
         return cls(resources=raw["resources"], serial=raw["serial"],
-                   outputs=raw.get("outputs", {}))
+                   outputs=raw.get("outputs", {}),
+                   tainted=set(raw.get("tainted", [])))
 
 
 @dataclasses.dataclass
@@ -72,7 +77,9 @@ class Diff:
 
     def summary(self) -> str:
         c, u, d = (len(self.by_action(a)) for a in ("create", "update", "delete"))
-        return f"Plan: {c} to add, {u} to change, {d} to destroy."
+        r = len(self.by_action("replace"))
+        return (f"Plan: {c + r} to add, {u} to change, "
+                f"{d + r} to destroy.")
 
 
 _MISSING = object()   # key present in state but absent from the new plan
@@ -142,6 +149,11 @@ def diff(plan: Plan, state: State | None,
     for addr, attrs in planned.items():
         if addr not in prior:
             actions[addr] = "create"
+            continue
+        if state is not None and addr in state.tainted:
+            # terraform taint: force recreation regardless of config drift
+            # (checked BEFORE the deep attribute compare it would discard)
+            actions[addr] = "replace"
             continue
         keys = sorted(
             k for k in set(attrs) | set(prior[addr])
@@ -224,8 +236,10 @@ def migrate_state(state: State, module) -> tuple[State, list[tuple[str, str]]]:
         renames.extend(_move(resources, frm, to, "moved"))
     if not renames:
         return state, []
+    moved = dict(renames)
     return State(resources=resources, serial=state.serial + 1,
-                 outputs=state.outputs), renames
+                 outputs=state.outputs,
+                 tainted={moved.get(a, a) for a in state.tainted}), renames
 
 
 def state_rm(state: State, addrs: list[str]) -> tuple[State, list[str]]:
@@ -254,7 +268,8 @@ def state_rm(state: State, addrs: list[str]) -> tuple[State, list[str]]:
             del resources[a]
             removed.append(a)
     return State(resources=resources, serial=state.serial + 1,
-                 outputs=state.outputs), removed
+                 outputs=state.outputs,
+                 tainted=set(state.tainted) - set(removed)), removed
 
 
 def state_mv(state: State, src: str,
@@ -269,8 +284,10 @@ def state_mv(state: State, src: str,
     renames = _move(resources, src, dst, "state mv")
     if not renames:
         raise ValueError(f"state mv: no resource in state matches {src!r}")
+    moved = dict(renames)
     return State(resources=resources, serial=state.serial + 1,
-                 outputs=state.outputs), renames
+                 outputs=state.outputs,
+                 tainted={moved.get(a, a) for a in state.tainted}), renames
 
 
 def import_resource(state: State | None, plan: Plan, addr: str,
@@ -307,7 +324,7 @@ def import_resource(state: State | None, plan: Plan, addr: str,
     resources = dict(state.resources)
     resources[addr] = attrs
     return State(resources=resources, serial=state.serial + 1,
-                 outputs=state.outputs)
+                 outputs=state.outputs, tainted=set(state.tainted))
 
 
 def apply_plan(plan: Plan, state: State | None = None,
@@ -330,9 +347,12 @@ def apply_plan(plan: Plan, state: State | None = None,
     for addr in d.by_action("delete"):
         resources.pop(addr, None)
     planned = _rendered_instances(plan)
-    for addr in d.by_action("create") + d.by_action("update"):
+    replaced = d.by_action("replace")
+    for addr in d.by_action("create") + d.by_action("update") + replaced:
         resources[addr] = planned[addr]
     serial = (state.serial if state else 0) + (0 if d.is_noop else 1)
+    # the replace consumed the taint (terraform clears it on recreation)
+    tainted = (set(state.tainted) if state else set()) - set(replaced)
     if targets:
         # outputs are evaluated against the FULL plan, which includes
         # untargeted changes that were not applied — recording them would
@@ -345,4 +365,5 @@ def apply_plan(plan: Plan, state: State | None = None,
                    "sensitive": name in plan.sensitive_outputs}
             for name, value in plan.outputs.items()
         }
-    return State(resources=resources, serial=serial, outputs=outputs)
+    return State(resources=resources, serial=serial, outputs=outputs,
+                 tainted=tainted)

@@ -514,7 +514,13 @@ def test_state_pull_push_with_serial_guard(tmp_path, capsys, monkeypatch):
     stale["serial"] = 0
     monkeypatch.setattr("sys.stdin", io.StringIO(json.dumps(stale)))
     assert main(["state", "push", "-state", state]) == 1
-    assert "behind the current serial" in capsys.readouterr().err
+    assert "does not advance the current serial" in capsys.readouterr().err
+    # same-serial push with DIFFERENT content: the lost-update race, refused
+    racy = json.loads(pulled)
+    racy["resources"] = {}
+    monkeypatch.setattr("sys.stdin", io.StringIO(json.dumps(racy)))
+    assert main(["state", "push", "-state", state]) == 1
+    capsys.readouterr()
     monkeypatch.setattr("sys.stdin", io.StringIO(json.dumps(stale)))
     assert main(["state", "push", "-state", state, "-force"]) == 0
     capsys.readouterr()
@@ -538,3 +544,39 @@ def test_state_push_rejects_malformed_payloads(tmp_path, capsys, monkeypatch):
         monkeypatch.setattr("sys.stdin", io.StringIO(payload))
         assert main(["state", "push", "-state", state]) == 1, payload
         assert "invalid state" in capsys.readouterr().err
+
+
+def test_taint_untaint_replace_cycle(tmp_path, capsys):
+    state = str(tmp_path / "s.json")
+    (tmp_path / "main.tf").write_text(
+        'resource "google_compute_network" "n" {\n  name = "x"\n}\n')
+    assert main(["apply", str(tmp_path), "-state", state]) == 0
+    capsys.readouterr()
+    # untainted: no-op plan
+    assert main(["plan", str(tmp_path), "-state", state]) == 0
+    assert "0 to add, 0 to change, 0 to destroy" in capsys.readouterr().out
+    # taint → plan shows -/+ replace, counted add+destroy
+    assert main(["taint", "google_compute_network.n", "-state", state]) == 0
+    capsys.readouterr()
+    assert main(["plan", str(tmp_path), "-state", state]) == 0
+    out = capsys.readouterr().out
+    assert "-/+ google_compute_network.n" in out
+    assert "1 to add, 0 to change, 1 to destroy" in out
+    # apply recreates and clears the taint
+    assert main(["apply", str(tmp_path), "-state", state]) == 0
+    capsys.readouterr()
+    assert main(["plan", str(tmp_path), "-state", state]) == 0
+    assert "0 to add, 0 to change, 0 to destroy" in capsys.readouterr().out
+    # untaint flow + error paths
+    assert main(["taint", "google_compute_network.n", "-state", state]) == 0
+    assert main(["untaint", "google_compute_network.n",
+                 "-state", state]) == 0
+    capsys.readouterr()
+    assert main(["plan", str(tmp_path), "-state", state]) == 0
+    assert "0 to add" in capsys.readouterr().out
+    assert main(["untaint", "google_compute_network.n",
+                 "-state", state]) == 1
+    assert "not tainted" in capsys.readouterr().err
+    assert main(["taint", "google_compute_network.zzz",
+                 "-state", state]) == 1
+    assert "not in state" in capsys.readouterr().err
