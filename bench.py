@@ -1,4 +1,4 @@
-"""Benchmark: time-to-validated-accelerator, plus MXU/HBM roofline probes.
+"""Benchmark: time-to-validated-accelerator, plus MXU/HBM/workload metrics.
 
 The reference publishes no benchmark numbers (BASELINE.md). Its only
 quantitative operational claim is that the GPU Operator needs **~5 minutes**
@@ -9,68 +9,137 @@ Job payload that proves devices, collectives, and a sharded train step all work
 — is fully automated, so the headline metric is how long that validation takes
 on the chip: lower is better, baseline is the reference's 300 s manual wait.
 
-Prints ONE JSON line:
+Un-losable by construction (round-2 VERDICT item 1): a pure-stdlib
+orchestrator (no jax import in the parent) runs every metric section in its
+own subprocess with a hard timeout and bounded retries, so a hung or
+crashed TPU backend init — both observed failure modes of the tunnelled
+backend — costs only that section. Whatever happens, the process exits 0
+having printed ONE JSON line; failed sections appear in an ``"errors"``
+field instead of erasing the round's perf story. If the TPU backend is
+unreachable after retries, the sections re-run on CPU (tiny shapes) so the
+capture still proves the code paths, with ``"bench_platform": "cpu"`` and
+the backend error recorded.
+
+Numbers printed here are the artifact of record: package docstrings cite
+BENCH_r*.json entries, never the other way around.
+
+Final line fields:
   metric       accelerator_validation_seconds (lower is better)
   vs_baseline  300 / value  (×-faster than the reference's operator wait)
-plus secondary fields: achieved bf16 matmul TFLOP/s, HBM GiB/s, psum status.
-Runs on whatever ``jax.devices()`` exposes (one real TPU chip under the
-driver; the virtual CPU mesh during offline development).
+plus per-section fields (matmul/HBM rooflines, burn-in MFU, bf16 + int8
+decode throughput, long-context flash speedup) and ``errors``.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import signal
+import subprocess
+import sys
 import time
 
+_PROC_T0 = time.perf_counter()  # section semantics: import→verdict wallclock
 
 REFERENCE_OPERATOR_WAIT_S = 300.0  # /root/reference/gke/README.md:50 ("~5 min")
 
 
-def main() -> None:
+# --------------------------------------------------------------------------
+# metric sections — each runs in its own subprocess; prints ONE JSON line
+# --------------------------------------------------------------------------
+
+
+def _on_tpu() -> bool:
     import jax
 
-    t0 = time.perf_counter()
+    return jax.devices()[0].platform == "tpu"
 
-    from nvidia_terraform_modules_tpu.ops import hbm_probe, matmul_probe
+
+def _flagship_cfg():
+    """The flagship burn-in config (one source of truth for bench dims).
+
+    head_dim 128 fills the MXU lane width inside the flash kernel; the
+    d_model=2048 projections/MLP dominate the FLOPs so the measured MFU
+    reflects MXU utilisation, not attention overhead. (Numbers from prior
+    sweeps live in BENCH_r*.json, not here.)
+    """
+    import jax.numpy as jnp
+
+    from nvidia_terraform_modules_tpu.models import BurnInConfig
+
+    if _on_tpu():
+        return BurnInConfig(vocab=8192, d_model=2048, n_heads=16, d_ff=8192,
+                            n_layers=8, seq_len=4096, batch=2, attn="flash")
+    return BurnInConfig(vocab=256, d_model=64, n_heads=4, d_ff=128,
+                        n_layers=2, seq_len=32, batch=4, dtype=jnp.float32)
+
+
+def section_devinfo() -> dict:
+    import jax
+
+    devs = jax.devices()
+    return {
+        "devices": len(devs),
+        "platform": devs[0].platform,
+        "device_kind": devs[0].device_kind,
+    }
+
+
+def section_smoke() -> dict:
+    import jax
+
     from nvidia_terraform_modules_tpu.smoketest import run_smoketest
 
     n_dev = len(jax.devices())
     level = "burnin" if n_dev >= 2 else "psum"
     smoke = run_smoketest(level=level, env={})
-    validation_seconds = time.perf_counter() - t0  # import→verdict, the metric
+    # import→verdict: includes interpreter + jax + backend init, exactly the
+    # cost a fresh validation Job pod pays
+    validation_seconds = time.perf_counter() - _PROC_T0
+    return {
+        "accelerator_validation_seconds": round(validation_seconds, 2),
+        "smoke_ok": smoke.ok,
+        "smoke_level": level,
+        "devices": n_dev,
+        "device_kind": jax.devices()[0].device_kind,
+    }
 
-    on_tpu = jax.devices()[0].platform == "tpu"
+
+def section_probes() -> dict:
+    from nvidia_terraform_modules_tpu.ops import hbm_probe, matmul_probe
+
+    on_tpu = _on_tpu()
     mm = matmul_probe(n=4096 if on_tpu else 512, iters=8 if on_tpu else 2)
     hbm = hbm_probe(mib=512 if on_tpu else 32, iters=8 if on_tpu else 2,
                     mode="read")
     hbm_triad = hbm_probe(mib=512 if on_tpu else 32,
                           iters=8 if on_tpu else 2, mode="triad")
+    return {
+        "matmul_tflops": round(mm["tflops"], 2),
+        "matmul_roofline": round(mm["roofline_fraction"], 3),
+        "hbm_gibps": round(hbm["gibps"], 1),
+        "hbm_roofline": round(hbm["roofline_fraction"], 3),
+        "hbm_triad_gibps": round(hbm_triad["gibps"], 1),
+        "hbm_triad_roofline": round(hbm_triad["roofline_fraction"], 3),
+    }
 
-    # workload-level number: train-step MFU at long context on the flash
-    # path (VERDICT round-1 item 2) — achieved model FLOP/s over the chip's
-    # bf16 peak, on a config big enough for the matmuls to dominate
+
+def section_burnin() -> dict:
+    """Train-step MFU at long context on the flash path: achieved model
+    FLOP/s over the chip's bf16 peak, on a config big enough for the
+    matmuls to dominate."""
+    import jax
+
     from nvidia_terraform_modules_tpu.models import (
-        BurnInConfig,
         init_params,
         make_train_step,
         synthetic_batch,
         train_step_flops,
     )
     from nvidia_terraform_modules_tpu.utils.device import device_spec
-    import jax.numpy as jnp
-
-    cfg = (
-        # head_dim 128 fills the MXU lane width inside the flash kernel;
-        # d=2048 projections/MLP dominate the FLOPs. Measured on v5e
-        # (2026-07 sweep): 0.65 MFU here vs 0.29 at d=1024/head_dim=64.
-        BurnInConfig(vocab=8192, d_model=2048, n_heads=16, d_ff=8192,
-                     n_layers=8, seq_len=4096, batch=2, attn="flash")
-        if on_tpu
-        else BurnInConfig(vocab=256, d_model=64, n_heads=4, d_ff=128,
-                          n_layers=2, seq_len=32, batch=4, dtype=jnp.float32)
-    )
     from nvidia_terraform_modules_tpu.utils.timing import sync
 
+    cfg = _flagship_cfg()
     params = init_params(jax.random.PRNGKey(0), cfg)
     step = make_train_step(cfg)
     batch = synthetic_batch(jax.random.PRNGKey(1), cfg)
@@ -85,144 +154,347 @@ def main() -> None:
     tokens_per_s = cfg.batch * cfg.seq_len / step_seconds
     mfu = (train_step_flops(cfg) / step_seconds) / (
         device_spec().bf16_tflops * 1e12)
-
-    # serve-side: greedy KV-cache decode throughput (HBM-bound regime —
-    # weights + cache re-read every step; the serving counterpart of the
-    # train-step MFU above)
-    import dataclasses
-
-    from nvidia_terraform_modules_tpu.models import make_decoder
-
-    # same model as the burn-in MFU measurement (one source of truth for
-    # the flagship dims), decode-shaped: dense cached attention, batch 8.
-    # The trained weights are reused — attn/batch don't change parameter
-    # shapes, and a second full init would double weight HBM for no reason.
-    dec_cfg = dataclasses.replace(cfg, attn="dense",
-                                  batch=8 if on_tpu else cfg.batch)
-    prompt_len, n_new = (512, 64) if on_tpu else (8, 8)
-    dec_params = params
-    max_len = prompt_len + n_new
-    decoder = make_decoder(dec_cfg, n_new=n_new, max_len=max_len)
-    # prefill-only twin (n_new=1 → zero scan steps): subtracting its time
-    # isolates the HBM-bound per-step decode cost from the MXU-bound
-    # prompt forward, so decode_tokens_per_s measures what it claims
-    prefiller = make_decoder(dec_cfg, n_new=1, max_len=max_len)
-    prompt = jax.random.randint(jax.random.PRNGKey(3),
-                                (dec_cfg.batch, prompt_len), 0,
-                                dec_cfg.vocab)
-    sync(decoder(dec_params, prompt))    # compile
-    sync(prefiller(dec_params, prompt))  # compile
-    dec_iters = 3
-    t_dec = time.perf_counter()
-    for _ in range(dec_iters):
-        toks = decoder(dec_params, prompt)
-    sync(toks)
-    t_total = (time.perf_counter() - t_dec) / dec_iters
-    t_pre = time.perf_counter()
-    for _ in range(dec_iters):
-        toks = prefiller(dec_params, prompt)
-    sync(toks)
-    t_prefill = (time.perf_counter() - t_pre) / dec_iters
-    step_seconds_dec = max(t_total - t_prefill, 1e-9) / (n_new - 1)
-    decode_tokens_per_s = dec_cfg.batch / step_seconds_dec
-    prefill_tokens_per_s = dec_cfg.batch * prompt_len / max(t_prefill, 1e-9)
-
-    # weight-only int8 serving: same decode, weights int8-resident in HBM
-    # (the decode regime is weight-bandwidth-bound, so this is the lever)
-    from nvidia_terraform_modules_tpu.models import (
-        make_quantized_decoder,
-        quantize_tree,
-    )
-
-    qparams = quantize_tree(dec_params)
-    q_decoder = make_quantized_decoder(
-        dec_cfg, n_new=n_new, max_len=max_len,
-        dtype=dec_cfg.dtype)
-    # int8 prefill twin: the quantized program's own prefill cost —
-    # subtracting the bf16 twin's would fold the dequant/prefill delta
-    # into the per-step estimate and skew the side-by-side numbers
-    q_prefiller = make_quantized_decoder(
-        dec_cfg, n_new=1, max_len=max_len, dtype=dec_cfg.dtype)
-    sync(q_decoder(qparams, prompt))     # compile
-    sync(q_prefiller(qparams, prompt))   # compile
-    t_q = time.perf_counter()
-    for _ in range(dec_iters):
-        toks = q_decoder(qparams, prompt)
-    sync(toks)
-    t_q_total = (time.perf_counter() - t_q) / dec_iters
-    t_qp = time.perf_counter()
-    for _ in range(dec_iters):
-        toks = q_prefiller(qparams, prompt)
-    sync(toks)
-    t_q_prefill = (time.perf_counter() - t_qp) / dec_iters
-    q_step = max(t_q_total - t_q_prefill, 1e-9) / (n_new - 1)
-    decode_int8_tokens_per_s = dec_cfg.batch / q_step
-
-    # long-context attention: pallas flash kernel vs XLA dense at S=4096 —
-    # the regime ring/flash attention exist for (O(S²) HBM traffic dominates)
-    longctx: dict[str, float] = {}
-    if on_tpu:
-        from nvidia_terraform_modules_tpu.ops import flash_attention
-        from nvidia_terraform_modules_tpu.ops.ring_attention import (
-            dense_reference_attention,
-        )
-        from nvidia_terraform_modules_tpu.utils.timing import delta_time
-
-        S = 4096
-        ks = jax.random.split(jax.random.PRNGKey(2), 3)
-        q, k, v = (jax.random.normal(kk, (2, S, 8, 64), jnp.bfloat16)
-                   for kk in ks)
-
-        def make_chain(op):
-            def factory(length):
-                @jax.jit
-                def chain(q, k, v):
-                    def s(acc, _):
-                        return op(acc, k, v), None
-                    out, _ = jax.lax.scan(s, q, None, length=length)
-                    return out
-                return chain
-            return factory
-
-        t_flash = delta_time(make_chain(flash_attention), q, k, v,
-                             iters_lo=2, iters_hi=10)
-        t_dense = delta_time(make_chain(dense_reference_attention), q, k, v,
-                             iters_lo=2, iters_hi=10)
-        longctx = {
-            "longctx_s": S,
-            "longctx_flash_ms": round(t_flash * 1e3, 3),
-            "longctx_dense_ms": round(t_dense * 1e3, 3),
-            "longctx_flash_speedup": round(t_dense / t_flash, 2),
-        }
-
-    line = {
-        "metric": "accelerator_validation_seconds",
-        "value": round(validation_seconds, 2),
-        "unit": "s",
-        "vs_baseline": round(REFERENCE_OPERATOR_WAIT_S / validation_seconds, 2),
-        "total_seconds": round(time.perf_counter() - t0, 2),
-        "smoke_ok": smoke.ok,
-        "devices": n_dev,
-        "device_kind": jax.devices()[0].device_kind,
-        "matmul_tflops": round(mm["tflops"], 2),
-        "matmul_roofline": round(mm["roofline_fraction"], 3),
-        "hbm_gibps": round(hbm["gibps"], 1),
-        "hbm_roofline": round(hbm["roofline_fraction"], 3),
-        "hbm_triad_gibps": round(hbm_triad["gibps"], 1),
-        "hbm_triad_roofline": round(hbm_triad["roofline_fraction"], 3),
+    return {
         "burnin_tokens_per_s": round(tokens_per_s, 1),
         "burnin_attn": cfg.attn,
         "burnin_seq_len": cfg.seq_len,
         "burnin_mfu": round(mfu, 3),
-        "decode_tokens_per_s": round(decode_tokens_per_s, 1),
-        "decode_int8_tokens_per_s": round(decode_int8_tokens_per_s, 1),
-        "prefill_tokens_per_s": round(prefill_tokens_per_s, 1),
+    }
+
+
+def _decode_setup():
+    """Shared decode-bench scaffolding: flagship dims, decode-shaped.
+
+    Dense cached attention, batch 8 — the HBM-bound serving regime where
+    weights + KV cache are re-read every step. Fresh random weights: decode
+    throughput is shape-determined, not value-determined.
+    """
+    import dataclasses
+
+    import jax
+
+    from nvidia_terraform_modules_tpu.models import init_params
+
+    cfg = _flagship_cfg()
+    dec_cfg = dataclasses.replace(cfg, attn="dense",
+                                  batch=8 if _on_tpu() else cfg.batch)
+    prompt_len, n_new = (512, 64) if _on_tpu() else (8, 8)
+    params = init_params(jax.random.PRNGKey(0), dec_cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(3),
+                                (dec_cfg.batch, prompt_len), 0, dec_cfg.vocab)
+    return dec_cfg, params, prompt, prompt_len, n_new
+
+
+def _time_decode(decoder, prefiller, params, prompt, n_new: int):
+    """Decode-step seconds via the prefill-subtraction two-point method.
+
+    The prefill-only twin (n_new=1 → zero scan steps) isolates the
+    HBM-bound per-step decode cost from the MXU-bound prompt forward, so
+    tokens/s measures what it claims.
+    """
+    from nvidia_terraform_modules_tpu.utils.timing import sync
+
+    sync(decoder(params, prompt))    # compile
+    sync(prefiller(params, prompt))  # compile
+    iters = 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        toks = decoder(params, prompt)
+    sync(toks)
+    t_total = (time.perf_counter() - t0) / iters
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        toks = prefiller(params, prompt)
+    sync(toks)
+    t_prefill = (time.perf_counter() - t0) / iters
+    step_seconds = max(t_total - t_prefill, 1e-9) / (n_new - 1)
+    return step_seconds, t_prefill
+
+
+def section_decode() -> dict:
+    from nvidia_terraform_modules_tpu.models import make_decoder
+
+    dec_cfg, params, prompt, prompt_len, n_new = _decode_setup()
+    max_len = prompt_len + n_new
+    decoder = make_decoder(dec_cfg, n_new=n_new, max_len=max_len)
+    prefiller = make_decoder(dec_cfg, n_new=1, max_len=max_len)
+    step_s, t_prefill = _time_decode(decoder, prefiller, params, prompt, n_new)
+    return {
+        "decode_tokens_per_s": round(dec_cfg.batch / step_s, 1),
+        "prefill_tokens_per_s": round(
+            dec_cfg.batch * prompt_len / max(t_prefill, 1e-9), 1),
         "decode_batch": dec_cfg.batch,
         "decode_prompt_len": prompt_len,
-        **longctx,
     }
+
+
+def section_decode_int8() -> dict:
+    """Weight-only int8 serving: same decode, weights int8-resident in HBM
+    (the decode regime is weight-bandwidth-bound, so this is the lever)."""
+    from nvidia_terraform_modules_tpu.models import (
+        make_quantized_decoder,
+        quantize_params,
+    )
+
+    dec_cfg, params, prompt, prompt_len, n_new = _decode_setup()
+    max_len = prompt_len + n_new
+    qparams = quantize_params(params, dtype=dec_cfg.dtype)
+    q_decoder = make_quantized_decoder(dec_cfg, n_new=n_new, max_len=max_len,
+                                       dtype=dec_cfg.dtype)
+    # int8 prefill twin: the quantized program's own prefill cost —
+    # subtracting the bf16 twin's would fold the dequant/prefill delta into
+    # the per-step estimate and skew the side-by-side numbers
+    q_prefiller = make_quantized_decoder(dec_cfg, n_new=1, max_len=max_len,
+                                         dtype=dec_cfg.dtype)
+    step_s, _ = _time_decode(q_decoder, q_prefiller, qparams, prompt, n_new)
+    return {"decode_int8_tokens_per_s": round(dec_cfg.batch / step_s, 1)}
+
+
+def section_longctx() -> dict:
+    """Long-context attention: pallas flash kernel vs XLA dense at S=4096 —
+    the regime ring/flash attention exist for (O(S²) HBM traffic
+    dominates). TPU only; on CPU the pallas interpreter would measure the
+    interpreter, not the kernel."""
+    if not _on_tpu():
+        return {}
+    import jax
+    import jax.numpy as jnp
+
+    from nvidia_terraform_modules_tpu.ops import flash_attention
+    from nvidia_terraform_modules_tpu.ops.ring_attention import (
+        dense_reference_attention,
+    )
+    from nvidia_terraform_modules_tpu.utils.timing import delta_time
+
+    S = 4096
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q, k, v = (jax.random.normal(kk, (2, S, 8, 64), jnp.bfloat16)
+               for kk in ks)
+
+    def make_chain(op):
+        def factory(length):
+            @jax.jit
+            def chain(q, k, v):
+                def s(acc, _):
+                    return op(acc, k, v), None
+                out, _ = jax.lax.scan(s, q, None, length=length)
+                return out
+            return chain
+        return factory
+
+    t_flash = delta_time(make_chain(flash_attention), q, k, v,
+                         iters_lo=2, iters_hi=10)
+    t_dense = delta_time(make_chain(dense_reference_attention), q, k, v,
+                         iters_lo=2, iters_hi=10)
+    return {
+        "longctx_s": S,
+        "longctx_flash_ms": round(t_flash * 1e3, 3),
+        "longctx_dense_ms": round(t_dense * 1e3, 3),
+        "longctx_flash_speedup": round(t_dense / t_flash, 2),
+    }
+
+
+SECTIONS = {
+    "devinfo": section_devinfo,
+    "smoke": section_smoke,
+    "probes": section_probes,
+    "burnin": section_burnin,
+    "decode": section_decode,
+    "decode_int8": section_decode_int8,
+    "longctx": section_longctx,
+}
+
+# generous per-section budgets: first XLA compile of a big program is
+# 20-40 s on TPU and minutes are possible over the tunnel; a hang burns
+# only its own budget
+SECTION_TIMEOUT_S = {
+    "devinfo": 150,
+    "smoke": 600,
+    "probes": 420,
+    "burnin": 900,
+    "decode": 600,
+    "decode_int8": 600,
+    "longctx": 600,
+}
+
+
+# --------------------------------------------------------------------------
+# orchestrator — pure stdlib; never imports jax, never dies without JSON
+# --------------------------------------------------------------------------
+
+_CURRENT_CHILD: subprocess.Popen | None = None
+
+
+class _Terminated(Exception):
+    """Raised from the SIGTERM handler so `finally` still prints JSON."""
+
+
+def _on_sigterm(signum, frame):  # noqa: ARG001
+    _kill_current_child()
+    raise _Terminated(f"signal {signum}")
+
+
+def _kill_current_child() -> None:
+    proc = _CURRENT_CHILD
+    if proc is not None and proc.poll() is None:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+
+
+def _child_preexec() -> None:
+    """New session (so killpg hits only the child tree) + parent-death kill.
+
+    PR_SET_PDEATHSIG guarantees no section process outlives the
+    orchestrator: a leaked child holding the TPU tunnel grant wedges every
+    subsequent jax init machine-wide (observed after an external SIGKILL
+    of a prior run), so the kernel, not python, must own this cleanup.
+    """
+    os.setsid()
+    try:
+        import ctypes
+
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        libc.prctl(1, signal.SIGKILL)  # PR_SET_PDEATHSIG = 1
+    except Exception:  # noqa: BLE001 — best-effort; timeouts still apply
+        pass
+
+
+def _run_section(name: str, env: dict[str, str], timeout: float,
+                 attempts: int = 2,
+                 backoff_s: float = 5.0) -> tuple[dict | None, str | None]:
+    """Run one section in a subprocess. Returns (result, error)."""
+    global _CURRENT_CHILD
+    last_err = "unknown"
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(backoff_s * attempt)
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--section", name],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, preexec_fn=_child_preexec,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        _CURRENT_CHILD = proc
+        try:
+            out, err = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            # the TPU client spawns helper threads/children; kill the whole
+            # session group or the next section inherits a wedged backend
+            _kill_current_child()
+            proc.communicate()
+            last_err = f"timeout>{timeout}s"
+            continue
+        finally:
+            _CURRENT_CHILD = None
+        if proc.returncode == 0:
+            for line in reversed(out.strip().splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        return json.loads(line), None
+                    except json.JSONDecodeError:
+                        continue
+            last_err = "no JSON line in section output"
+        else:
+            tail = "; ".join(err.strip().splitlines()[-3:])[-400:]
+            last_err = f"rc={proc.returncode}: {tail}"
+    return None, last_err
+
+
+def _cpu_env(base_env: dict[str, str]) -> dict[str, str]:
+    """Env for the CPU fallback: force the CPU platform AND drop the axon
+    TPU-tunnel activation (``PALLAS_AXON_POOL_IPS`` makes sitecustomize
+    dial the relay at interpreter start, which hangs when the tunnel is
+    wedged — the exact failure the fallback exists for)."""
+    env = {k: v for k, v in base_env.items()
+           if k != "PALLAS_AXON_POOL_IPS" and not k.startswith("AXON_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _acquire_backend(base_env: dict[str, str]) -> tuple[dict[str, str], dict, str | None]:
+    """Probe the default backend; fall back to CPU if it won't come up.
+
+    Returns (env for sections, devinfo dict, backend error or None). TPU
+    init UNAVAILABLE is often transient, so probe 3× with backoff; the
+    observed hang mode makes the subprocess timeout the real defense.
+    """
+    info, err = _run_section("devinfo", base_env,
+                             SECTION_TIMEOUT_S["devinfo"], attempts=3,
+                             backoff_s=10.0)
+    if info is not None:
+        return base_env, info, None
+    cpu_env = _cpu_env(base_env)
+    info, cpu_err = _run_section("devinfo", cpu_env, 120, attempts=2)
+    if info is None:
+        return cpu_env, {"devices": 0, "platform": "none",
+                         "device_kind": "none"}, (
+            f"default backend: {err}; cpu fallback: {cpu_err}")
+    return cpu_env, info, f"default backend unavailable, ran on cpu: {err}"
+
+
+def main() -> None:
+    errors: dict[str, str] = {}
+    merged: dict = {}
+    env = dict(os.environ)
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    signal.signal(signal.SIGINT, _on_sigterm)
+    try:
+        env, devinfo, backend_err = _acquire_backend(env)
+        if backend_err:
+            errors["backend"] = backend_err
+        merged.update(devinfo)
+        bench_platform = devinfo.get("platform", "none")
+
+        for name in (n for n in SECTIONS if n != "devinfo"):
+            if bench_platform == "none":
+                errors[name] = "skipped: no backend"
+                continue
+            result, err = _run_section(name, env, SECTION_TIMEOUT_S[name])
+            if result is not None:
+                merged.update(result)
+            else:
+                errors[name] = err or "failed"
+    except _Terminated as exc:
+        errors["orchestrator"] = f"terminated early: {exc}"
+    except Exception as exc:  # noqa: BLE001 — the JSON line must still print
+        errors["orchestrator"] = f"{type(exc).__name__}: {exc}"
+    finally:
+        _kill_current_child()
+        # a signal landing during final assembly/print must not strand the
+        # run JSON-less — ignore TERM/INT for the last few milliseconds
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        signal.signal(signal.SIGINT, signal.SIG_IGN)
+
+    total = time.perf_counter() - _PROC_T0
+    value = merged.get("accelerator_validation_seconds")
+    if value is None:
+        # smoke never produced a verdict: report total wallclock so the
+        # headline stays numeric/parseable, flagged as a fallback
+        value = round(total, 2)
+        merged["headline_fallback"] = True
+        merged.setdefault("smoke_ok", False)
+    line = {
+        "metric": "accelerator_validation_seconds",
+        "value": value,
+        "unit": "s",
+        "vs_baseline": round(REFERENCE_OPERATOR_WAIT_S / max(value, 1e-9), 2),
+        "total_seconds": round(total, 2),
+        "bench_platform": merged.pop("platform", "none"),
+        **merged,
+    }
+    if errors:
+        line["errors"] = errors
     print(json.dumps(line), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) == 3 and sys.argv[1] == "--section":
+        name = sys.argv[2]
+        if name not in SECTIONS:
+            print(f"unknown section {name!r}", file=sys.stderr)
+            sys.exit(2)
+        print(json.dumps(SECTIONS[name]()), flush=True)
+    else:
+        main()

@@ -24,8 +24,10 @@ from .decode import (  # noqa: F401
     sample_decode,
 )
 from .quantize import (  # noqa: F401
+    QTensor,
     dequantize_tree,
     make_quantized_decoder,
+    quantize_params,
     quantize_tree,
     quantized_nbytes,
 )

@@ -8,20 +8,23 @@ footprint vs bf16 (4× vs f32) — the standard serving lever:
   stores int8 values plus one f32 scale per output column — the finest
   granularity that keeps the dequant a single multiply on the matmul's
   output side;
-- **store int8, compute bf16**: weights live between calls as int8;
-  dequant runs inside the jitted decoder. Whether each decode step then
-  re-reads int8 (dequant re-fused per step) or a hoisted bf16 copy is
-  XLA's loop-invariant-materialisation call, which can differ by backend
-  and shape — so this module claims the storage win and the MEASURED
-  throughput (``bench.py`` reports int8 next to bf16), not a fusion
-  guarantee. Guaranteeing int8 reads per step would take a pallas
-  int8-operand matmul kernel (future work);
+- **store int8, compute bf16, dequant per tile in-kernel**: weights live
+  as int8 and enter the decode program through :class:`QTensor`, whose
+  matmuls run the pallas int8-operand kernel
+  (``ops/int8_matmul.py``) — the int8→bf16 convert happens in VMEM
+  inside the kernel, so int8 is what crosses HBM every decode step.
+  XLA's loop-invariant-materialisation heuristic (which the previous
+  dequant-then-dot design left in charge, and which is free to hoist a
+  bf16 copy out of the decode scan) cannot hoist through a pallas_call;
 - **norms and scales stay exact**: 1-D parameters (RMSNorm scales) are
   tiny and precision-critical — they pass through unquantized.
 
-``quantize_tree`` / ``dequantize_tree`` are pytree-generic over the
-burn-in parameter layout; ``make_quantized_decoder`` compiles a greedy
-decoder whose weights stay int8-resident between calls.
+:class:`QTensor` duck-types the three ways the decode forward consumes a
+weight — ``h @ w`` (projections/MLP), ``w[tokens]`` (embedding gather),
+``x @ w.T`` (weight-tied head) — so ``models/decode.py`` runs unchanged
+over int8-resident params: quantization swaps the leaves, never the
+model code. ``quantize_params`` builds that tree;
+``quantize_tree`` / ``dequantize_tree`` remain the storage-level API.
 """
 
 from __future__ import annotations
@@ -35,6 +38,130 @@ import jax.numpy as jnp
 from ..parallel.sharding import ShardingRules
 from .burnin import BurnInConfig
 from .decode import greedy_decode
+
+
+@jax.tree_util.register_pytree_node_class
+class QTensor:
+    """Int8 weight + per-output-channel f32 scales, model-consumable.
+
+    Implements exactly the operator surface ``models/decode.py`` uses on a
+    weight, dispatching each to the fused int8 path:
+
+    - ``x @ qt``: pallas int8 matmul (``ops/int8_matmul.py``) when the
+      dims tile on TPU, inline-dequant ``dot_general`` otherwise;
+    - ``qt[idx]``: int8 row gather, dequantized after the gather (the
+      embedding lookup — B·T rows, negligible);
+    - ``qt.T``: a transposed *view* (no int8 copy); its matmul contracts
+      via ``transpose_rhs`` dot dimension numbers on the MXU.
+
+    ``scale_axis`` is the axis of ``q`` the scales index (the output
+    channel): 1 for ``[in, out]`` projections, 0 for the ``[vocab, d]``
+    embedding (per-row scales serve both the gather and the tied head,
+    where vocab IS the output channel).
+
+    Registered as a pytree (children: q, scale) so QTensor-leaved param
+    trees pass through ``jax.jit`` / ``tree.map`` like any array tree.
+    Deliberately does NOT define ``__jax_array__``: jax's binary-op
+    deferral then returns ``NotImplemented`` for ``array @ qtensor`` and
+    python falls through to ``__rmatmul__`` here.
+    """
+
+    def __init__(self, q, scale, *, scale_axis: int, dtype,
+                 transposed: bool = False):
+        self.q, self.scale = q, scale
+        self.scale_axis, self.transposed = scale_axis, transposed
+        self.dtype = jnp.dtype(dtype)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), (self.scale_axis, self.dtype,
+                                      self.transposed)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        scale_axis, dtype, transposed = aux
+        q, scale = children
+        return cls(q, scale, scale_axis=scale_axis, dtype=dtype,
+                   transposed=transposed)
+
+    @property
+    def shape(self):
+        s = self.q.shape
+        return s[::-1] if self.transposed else s
+
+    @property
+    def T(self):  # noqa: N802 — numpy's name
+        return QTensor(self.q, self.scale, scale_axis=self.scale_axis,
+                       dtype=self.dtype, transposed=not self.transposed)
+
+    def __getitem__(self, idx):
+        if self.transposed:
+            raise TypeError("gather on a transposed QTensor is not a "
+                            "model access pattern")
+        if self.scale_axis != 0:
+            raise TypeError("QTensor gather needs per-row scales "
+                            "(scale_axis=0, the embedding layout)")
+        return (self.q[idx].astype(jnp.float32)
+                * self.scale[idx][..., None]).astype(self.dtype)
+
+    def __rmatmul__(self, x):
+        from ..ops.int8_matmul import int8_matmul, int8_matmul_ref
+
+        lead, k_dim = x.shape[:-1], x.shape[-1]
+        x2 = x.reshape(-1, k_dim)
+        # the kernel applies scales to OUTPUT channels in its epilogue, so
+        # the scale axis must be the logical output axis: storage axis 1
+        # plain ([in, out] projections), storage axis 0 through a .T view
+        # (the [vocab, d] embedding as tied head). Those are the only two
+        # patterns the model has; anything else is a usage bug.
+        if self.scale_axis != (0 if self.transposed else 1):
+            raise TypeError(
+                "QTensor matmul with scales on the contraction axis is not "
+                "a model access pattern")
+        transpose_rhs = self.transposed
+        n = self.q.shape[self.scale_axis]
+        scale = self.scale.reshape(1, n)
+        k = self.q.shape[1 - self.scale_axis]
+        if k != k_dim:
+            raise ValueError(
+                f"contraction mismatch: x {x.shape} @ qtensor {self.shape}")
+        if _kernel_ok(k, n):
+            out = int8_matmul(x2, self.q, scale, transpose_rhs=transpose_rhs)
+        else:
+            out = int8_matmul_ref(x2, self.q, scale,
+                                  transpose_rhs=transpose_rhs)
+        return out.reshape(*lead, n)
+
+
+def _kernel_ok(k: int, n: int) -> bool:
+    """Use the pallas kernel iff on real TPU and the dims tile (the lane
+    axis needs 128-multiples; blocks are chosen inside the kernel)."""
+    import jax as _jax
+
+    return (_jax.devices()[0].platform == "tpu"
+            and k % 128 == 0 and n % 128 == 0)
+
+
+def quantize_params(params, dtype=jnp.bfloat16):
+    """Params pytree → same tree with matmul weights as QTensor leaves.
+
+    ≥2-D leaves quantize (per-output-channel scales: axis 1 for
+    ``[in, out]`` projections, axis 0 — per vocab row — for the
+    embedding, serving both the gather and the tied head); 1-D norm
+    scales pass through untouched. The result feeds the UNMODIFIED
+    decode forward: QTensor carries the quantization, the model code
+    never branches.
+    """
+
+    def leaf(path, x):
+        if getattr(x, "ndim", 0) < 2:
+            return x
+        is_embed = any("embed" in str(k) for k in path)
+        axis = 0 if is_embed else -1
+        q, s = quantize(x, axis=axis)
+        return QTensor(q, s.reshape(-1), scale_axis=axis % x.ndim,
+                       dtype=dtype)
+
+    return jax.tree_util.tree_map_with_path(leaf, params)
 
 
 def quantize(w, axis: int = -1):
@@ -104,13 +231,29 @@ def make_quantized_decoder(cfg: BurnInConfig,
                            n_new: int = 32, max_len: int | None = None,
                            dtype=jnp.bfloat16):
     """Compiled greedy decoder over int8-resident weights:
-    ``decoder(qparams, prompt) → [B, n_new]``. Weights stay int8 between
-    calls; dequant runs inside the jit (see the module docstring for what
-    that does and does not guarantee about per-step HBM reads)."""
+    ``decoder(qparams, prompt) → [B, n_new]`` with ``qparams`` from
+    :func:`quantize_params`. The decode program is the stock
+    ``greedy_decode`` — QTensor leaves route every weight matmul through
+    the fused int8 kernel, so int8 bytes cross HBM on every step.
+
+    ``dtype`` is the expected compute dtype and must MATCH the one the
+    QTensor leaves were built with (compute dtype is a property of the
+    params, set in :func:`quantize_params`) — a mismatch errors loudly
+    rather than silently computing in the params' dtype."""
+    expected = jnp.dtype(dtype)
+    jitted = jax.jit(
+        lambda qparams, prompt: greedy_decode(qparams, prompt, n_new, cfg,
+                                              rules, max_len=max_len))
 
     def decoder(qparams, prompt):
-        params = dequantize_tree(qparams, dtype)
-        return greedy_decode(params, prompt, n_new, cfg, rules,
-                             max_len=max_len)
+        for leaf in jax.tree.leaves(
+                qparams, is_leaf=lambda x: isinstance(x, QTensor)):
+            if isinstance(leaf, QTensor) and leaf.dtype != expected:
+                raise ValueError(
+                    f"decoder built for dtype {expected}, but qparams "
+                    f"carry {leaf.dtype} — rebuild with "
+                    f"quantize_params(params, dtype={expected})")
+            break
+        return jitted(qparams, prompt)
 
-    return jax.jit(decoder)
+    return decoder

@@ -1,6 +1,7 @@
 """Hardware micro-probes and TPU-first compute ops (ring/Ulysses attention)."""
 
 from .flash_attention import flash_attention  # noqa: F401
+from .int8_matmul import int8_matmul, int8_matmul_ref  # noqa: F401
 from .probes import hbm_probe, matmul_probe  # noqa: F401
 from .ring_attention import (  # noqa: F401
     dense_reference_attention,

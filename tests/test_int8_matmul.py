@@ -1,0 +1,132 @@
+"""Fused int8-weight matmul kernel + QTensor dispatch (interpret mode)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nvidia_terraform_modules_tpu.models.quantize import (
+    QTensor,
+    quantize,
+    quantize_params,
+)
+from nvidia_terraform_modules_tpu.ops.int8_matmul import (
+    int8_matmul,
+    int8_matmul_ref,
+)
+
+
+def _rand_q(key, shape):
+    return jax.random.randint(key, shape, -127, 128, jnp.int32).astype(
+        jnp.int8)
+
+
+@pytest.mark.parametrize("m", [8, 5])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_kernel_matches_reference(m, dtype):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    x = jax.random.normal(k1, (m, 256), dtype)
+    q = _rand_q(k2, (256, 384))
+    scale = jax.random.uniform(k3, (384,), jnp.float32, 0.01, 0.1)
+    got = int8_matmul(x, q, scale, interpret=True,
+                      block_m=128, block_n=128, block_k=128)
+    want = int8_matmul_ref(x, q, scale)
+    assert got.shape == (m, 384) and got.dtype == dtype
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=2e-2, atol=1e-2)
+
+
+def test_kernel_transpose_rhs_matches_reference():
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(1), 3)
+    x = jax.random.normal(k1, (8, 256), jnp.float32)
+    q = _rand_q(k2, (384, 256))                      # [N, K] storage
+    scale = jax.random.uniform(k3, (384,), jnp.float32, 0.01, 0.1)
+    got = int8_matmul(x, q, scale, transpose_rhs=True, interpret=True,
+                      block_m=128, block_n=128, block_k=128)
+    want = int8_matmul_ref(x, q, scale, transpose_rhs=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_kernel_multiblock_k_accumulates():
+    """K spanning several k-blocks exercises the scratch accumulator."""
+    k1, k2 = jax.random.split(jax.random.PRNGKey(2))
+    x = jax.random.normal(k1, (16, 512), jnp.float32)
+    q = _rand_q(k2, (512, 128))
+    scale = jnp.full((128,), 0.02, jnp.float32)
+    got = int8_matmul(x, q, scale, interpret=True,
+                      block_m=128, block_n=128, block_k=128)
+    want = int8_matmul_ref(x, q, scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_qtensor_matmul_matches_dequant():
+    w = jax.random.normal(jax.random.PRNGKey(3), (64, 96), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 7, 64), jnp.float32)
+    q, s = quantize(w)
+    qt = QTensor(q, s.reshape(-1), scale_axis=1, dtype=jnp.float32)
+    got = x @ qt
+    want = x @ (q.astype(jnp.float32) * s)
+    assert got.shape == (2, 7, 96)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_qtensor_tied_head_and_gather():
+    """The embedding's two roles: row gather and transposed tied head."""
+    emb = jax.random.normal(jax.random.PRNGKey(5), (50, 32), jnp.float32)
+    q, s = quantize(emb, axis=0)                     # per-row scales
+    qt = QTensor(q, s.reshape(-1), scale_axis=0, dtype=jnp.float32)
+    deq = q.astype(jnp.float32) * s                  # [50, 32]
+
+    idx = jnp.array([[3, 11], [0, 49]])
+    np.testing.assert_allclose(np.asarray(qt[idx]), np.asarray(deq[idx]),
+                               rtol=1e-6, atol=1e-6)
+    assert qt.T.shape == (32, 50)
+    x = jax.random.normal(jax.random.PRNGKey(6), (4, 32), jnp.float32)
+    np.testing.assert_allclose(np.asarray(x @ qt.T), np.asarray(x @ deq.T),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_qtensor_rejects_scale_on_contraction_axis():
+    q = _rand_q(jax.random.PRNGKey(7), (16, 24))
+    x = jnp.ones((2, 16))
+    qt = QTensor(q, jnp.ones((16,)), scale_axis=0, dtype=jnp.float32)
+    with pytest.raises(TypeError, match="contraction axis"):
+        _ = x @ qt                                   # per-row scales, untransposed
+    with pytest.raises(TypeError, match="transposed"):
+        _ = qt.T[jnp.array([0])]
+
+
+def test_qtensor_roundtrips_through_jit_and_tree():
+    """Pytree registration: QTensor params cross a jit boundary intact."""
+    w = jax.random.normal(jax.random.PRNGKey(8), (32, 48), jnp.float32)
+    q, s = quantize(w)
+    qt = QTensor(q, s.reshape(-1), scale_axis=1, dtype=jnp.float32)
+
+    @jax.jit
+    def f(x, qt):
+        return x @ qt
+
+    x = jnp.ones((3, 32))
+    np.testing.assert_allclose(np.asarray(f(x, qt)), np.asarray(x @ qt),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_quantize_params_layout():
+    from nvidia_terraform_modules_tpu.models import BurnInConfig, init_params
+
+    cfg = BurnInConfig(vocab=64, d_model=32, n_heads=2, d_ff=64, n_layers=1,
+                       seq_len=8, batch=2, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    qp = quantize_params(params, dtype=jnp.float32)
+    assert isinstance(qp["embed"], QTensor) and qp["embed"].scale_axis == 0
+    assert qp["embed"].scale.shape == (cfg.vocab,)
+    layer = qp["layers"][0]
+    assert isinstance(layer["wq"], QTensor) and layer["wq"].scale_axis == 1
+    # norm scales pass through bit-exact, unquantized
+    assert jnp.array_equal(qp["out_norm"], params["out_norm"])
+    assert jnp.array_equal(layer["attn_norm"],
+                           params["layers"][0]["attn_norm"])

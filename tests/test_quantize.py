@@ -15,6 +15,7 @@ from nvidia_terraform_modules_tpu.models.quantize import (
     dequantize_tree,
     make_quantized_decoder,
     quantize,
+    quantize_params,
     quantize_tree,
     quantized_nbytes,
 )
@@ -67,7 +68,7 @@ def test_quantized_decoder_runs_and_mostly_agrees():
     prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, CFG.vocab)
     full = greedy_decode(params, prompt, 8, CFG)
     decoder = make_quantized_decoder(CFG, n_new=8, dtype=jnp.float32)
-    q_toks = decoder(quantize_tree(params), prompt)
+    q_toks = decoder(quantize_params(params, dtype=jnp.float32), prompt)
     assert q_toks.shape == (2, 8)
     # greedy argmax under small logit perturbation: most tokens agree
     agree = float(np.mean(np.asarray(full) == np.asarray(q_toks)))
