@@ -8,7 +8,7 @@ JAX-capable image (see smoketest.tf).
 
 Env contract (injected by the gke-tpu module):
   TPU_SMOKETEST_EXPECTED_DEVICES  chips the whole world must expose
-  TPU_SMOKETEST_LEVEL             psum | probes | burnin
+  TPU_SMOKETEST_LEVEL             psum | probes | burnin | full
   TPU_SMOKETEST_HOSTS             TOTAL hosts in the world (all slices)
   TPU_SMOKETEST_PROCESS_BASE      this slice's host-index offset (0 default)
   TPU_SMOKETEST_SLICES            slice count; > 1 adds a cross-slice (DCN)
@@ -34,7 +34,7 @@ def main() -> int:
     out = {"ok": False}
 
     level = os.environ.get("TPU_SMOKETEST_LEVEL", "probes")
-    if level not in ("psum", "probes", "burnin"):
+    if level not in ("psum", "probes", "burnin", "full"):
         out["error"] = f"unknown level {level!r}"
         print(json.dumps(out), flush=True)
         return 2
@@ -151,7 +151,7 @@ def main() -> int:
         dt = max(time.perf_counter() - t, 1e-9)
         return r, round(nbytes / dt / (1 << 30), 3)
 
-    if level in ("probes", "burnin") and ok and n > 1:
+    if level in ("probes", "burnin", "full") and ok and n > 1:
         @jax.jit
         @shard
         def ring_hop():
@@ -183,6 +183,27 @@ def main() -> int:
         out["all_gather_ok"] = bool(np.allclose(g, float(expect)))
         ok = ok and out["ring_ok"] and out["all_gather_ok"]
 
+        # all-to-all — the MoE dispatch/combine collective: participant i
+        # fills row r with i·n + r; after the exchange row j must hold
+        # j·n + i (participant j's chunk addressed to i). Verified via a
+        # replicated error scalar (every process may fetch it).
+        @jax.jit
+        @functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=(), out_specs=P())
+        def alltoall_err():
+            i = jax.lax.axis_index("x").astype(jnp.float32)
+            r = jnp.arange(n, dtype=jnp.float32)[:, None]
+            payload = jnp.broadcast_to(i * n + r, (n, 1 << 12))
+            got = jax.lax.all_to_all(payload, "x", split_axis=0,
+                                     concat_axis=0, tiled=True)
+            want = jnp.arange(n, dtype=jnp.float32)[:, None] * n + i
+            return jax.lax.pmax(jnp.max(jnp.abs(got - want)), "x")
+
+        a2a_err, out["alltoall_gibps"] = timed(
+            alltoall_err, (n - 1) * (1 << 12) * 4 * n)
+        out["alltoall_ok"] = bool(float(np.asarray(a2a_err)) == 0.0)
+        ok = ok and out["alltoall_ok"]
+
     # 3. burn-in: a few bf16 matmul train steps must reduce a quadratic loss.
     # With TPU_SMOKETEST_CHECKPOINT_DIR set (spot slices: the pod may be
     # preempted and recreated; the Job mounts a PVC at that path), the
@@ -193,7 +214,7 @@ def main() -> int:
     # Job starts at step 0. Any checkpoint I/O failure — including a
     # corrupt/truncated file (BadZipFile/KeyError, not just OSError) —
     # fails the suite through the JSON contract, never a bare traceback.
-    if level == "burnin" and ok:
+    if level in ("burnin", "full") and ok:
         ckpt_dir = os.environ.get("TPU_SMOKETEST_CHECKPOINT_DIR")
         key = jax.random.PRNGKey(0)
         w = jax.random.normal(key, (256, 256), jnp.float32)
@@ -289,6 +310,122 @@ def main() -> int:
                 out["burnin_checkpoint_ok"] = False
                 out["checkpoint_error"] = f"clear: {exc}"
                 ok = False
+
+    # 4. full: the ep/pp fabric legs the dense burn-in never exercises —
+    # a capacity-routed MoE step whose dispatch/combine are real
+    # all_to_alls (one expert per chip), and a 2-stage pipeline step whose
+    # forward AND backward cross the stage ppermute. Both train
+    # loss-decreasing, so autodiff through the fabric is proven, not just
+    # transport. Single chip: skipped with an explicit marker (no fabric
+    # to prove), never a vacuous pass.
+    if level == "full" and ok:
+        if n < 2:
+            out["full_skipped"] = "ep/pp fabric needs >= 2 devices"
+        else:
+            d, hdim, t_loc, cap = 16, 32, 32, 96
+            E = n                           # one expert per device
+
+            def moe_loss(wr, w1, w2):
+                i = jax.lax.axis_index("x")
+                x = jnp.sin(jnp.arange(t_loc * d, dtype=jnp.float32)
+                            .reshape(t_loc, d) * 0.01 * (i + 1.0))
+                logits = x @ wr                         # [t, E]
+                gate = jax.nn.softmax(logits, axis=-1)
+                sel = jnp.argmax(logits, axis=-1)
+                onehot = jax.nn.one_hot(sel, E)         # [t, E]
+                pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot
+                keep = onehot * (pos < cap)
+                slot = jax.nn.one_hot(
+                    (pos * keep).astype(jnp.int32), cap) * keep[..., None]
+                disp = slot                              # [t, E, cap]
+                xs = jnp.einsum("tec,td->ecd", disp, x)  # [E, cap, d]
+                xs = jax.lax.all_to_all(xs, "x", split_axis=0,
+                                        concat_axis=0, tiled=True)
+                ys = jnp.tanh(xs @ w1[0]) @ w2[0]        # local expert
+                ys = jax.lax.all_to_all(ys, "x", split_axis=0,
+                                        concat_axis=0, tiled=True)
+                g = jnp.einsum("te,tec->tec", gate, disp)
+                y = jnp.einsum("tec,ecd->td", g, ys)
+                loss = jnp.mean(jnp.square(y - x))
+                return jax.lax.pmean(loss, "x")
+
+            @jax.jit
+            @functools.partial(
+                jax.shard_map, mesh=mesh,
+                in_specs=(P(), P("x"), P("x")), out_specs=(P(), P("x"),
+                                                           P("x"), P()))
+            def moe_step(wr, w1, w2):
+                loss, grads = jax.value_and_grad(moe_loss, (0, 1, 2))(
+                    wr, w1, w2)
+                grads = (jax.lax.pmean(grads[0], "x"),) + grads[1:]
+                new = [p - 0.5 * g for p, g in zip((wr, w1, w2), grads)]
+                return (*new, loss)
+
+            k = jax.random.PRNGKey(7)
+            wr = jax.random.normal(k, (d, E), jnp.float32) * 0.1
+            w1 = jax.random.normal(k, (E, d, hdim), jnp.float32) * 0.1
+            w2 = jax.random.normal(k, (E, hdim, d), jnp.float32) * 0.1
+            moe_losses = []
+            for _ in range(3):
+                wr, w1, w2, ml = moe_step(wr, w1, w2)
+                moe_losses.append(float(np.asarray(ml)))
+            out["moe_first_loss"] = round(moe_losses[0], 5)
+            out["moe_last_loss"] = round(moe_losses[-1], 5)
+            out["moe_ok"] = moe_losses[-1] < moe_losses[0]
+            ok = ok and out["moe_ok"]
+
+            if n % 2:
+                out["pipeline_skipped"] = f"{n} devices do not split 2 ways"
+            else:
+                if all(getattr(dv, "slice_index", None) is not None
+                       for dv in devices):
+                    pdevs = sorted(devices,
+                                   key=lambda dv: (dv.slice_index, dv.id))
+                else:
+                    pdevs = list(devices)
+                pmesh = Mesh(np.asarray(pdevs).reshape(2, n // 2),
+                             ("pp", "x"))
+                m, b = 4, 8
+
+                def pipe_loss(ws):
+                    s = jax.lax.axis_index("pp")
+                    j = jax.lax.axis_index("x").astype(jnp.float32)
+                    xs = jnp.sin(
+                        jnp.arange(m * b * d, dtype=jnp.float32)
+                        .reshape(m, b, d) * 0.01 * (j + 1.0))
+                    recv = jnp.zeros((b, d), jnp.float32)
+                    total = 0.0
+                    for t in range(m + 1):       # m microbatches + drain
+                        state = jnp.where(s == 0, xs[min(t, m - 1)], recv)
+                        h = jnp.tanh(state @ ws[0])
+                        done = (s == 1) & (1 <= t)
+                        total = total + jnp.where(
+                            done, jnp.mean(jnp.square(h)), 0.0)
+                        recv = jax.lax.ppermute(h, "pp", [(0, 1)])
+                    return jax.lax.psum(total, ("pp", "x")) / (
+                        m * pmesh.shape["x"])
+
+                @jax.jit
+                @functools.partial(
+                    jax.shard_map, mesh=pmesh, in_specs=(P("pp"),),
+                    out_specs=(P("pp"), P()))
+                def pipe_step(ws):
+                    loss, gw = jax.value_and_grad(pipe_loss)(ws)
+                    gw = jax.lax.pmean(gw, "x")   # data-parallel reduce
+                    return ws - 0.2 * gw, loss
+
+                pws = jax.random.normal(
+                    jax.random.PRNGKey(8), (2, d, d), jnp.float32) * 0.3
+                pws = jax.device_put(
+                    pws, jax.sharding.NamedSharding(pmesh, P("pp")))
+                pipe_losses = []
+                for _ in range(3):
+                    pws, pl = pipe_step(pws)
+                    pipe_losses.append(float(np.asarray(pl)))
+                out["pipeline_first_loss"] = round(pipe_losses[0], 5)
+                out["pipeline_last_loss"] = round(pipe_losses[-1], 5)
+                out["pipeline_ok"] = pipe_losses[-1] < pipe_losses[0]
+                ok = ok and out["pipeline_ok"]
 
     out["ok"] = bool(ok)
     out["seconds"] = round(time.perf_counter() - t0, 3)

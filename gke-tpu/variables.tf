@@ -218,7 +218,9 @@ variable "smoketest" {
     the tpu_slices key to validate; multislice = true instead validates ALL
     declared slices as one jax.distributed world (one Job per slice,
     MEGASCALE env for libtpu's DCN transport, plus a cross-slice psum).
-    Levels: psum | probes | burnin.
+    Levels: psum | probes | burnin | full (full adds the MoE all-to-all
+    dispatch leg and a 2-stage pipeline train step — the ep/pp fabric
+    paths the dense burn-in never exercises).
   EOT
   type = object({
     enabled      = optional(bool, true)
@@ -249,6 +251,13 @@ variable "smoketest" {
     checkpoint_pvc = optional(string)
   })
   default = {}
+
+  validation {
+    # the payload exits 2 on an unknown level, which would surface as an
+    # opaque Job failure mid-apply; catch the typo at plan time instead
+    condition     = contains(["psum", "probes", "burnin", "full"], var.smoketest.level)
+    error_message = "smoketest.level must be one of: psum, probes, burnin, full."
+  }
 
   validation {
     # a local checkpoint path on ephemeral pod storage would silently never

@@ -246,14 +246,20 @@ def make_quantized_decoder(cfg: BurnInConfig,
                                               rules, max_len=max_len))
 
     def decoder(qparams, prompt):
-        for leaf in jax.tree.leaves(
-                qparams, is_leaf=lambda x: isinstance(x, QTensor)):
-            if isinstance(leaf, QTensor) and leaf.dtype != expected:
+        qleaves = [leaf for leaf in jax.tree.leaves(
+            qparams, is_leaf=lambda x: isinstance(x, QTensor))
+            if isinstance(leaf, QTensor)]
+        if not qleaves:
+            raise ValueError(
+                "make_quantized_decoder expects a quantize_params tree "
+                "(QTensor weight leaves); got a tree with none — plain "
+                "params would silently serve at full precision")
+        for leaf in qleaves:
+            if leaf.dtype != expected:
                 raise ValueError(
                     f"decoder built for dtype {expected}, but qparams "
                     f"carry {leaf.dtype} — rebuild with "
                     f"quantize_params(params, dtype={expected})")
-            break
         return jitted(qparams, prompt)
 
     return decoder

@@ -61,18 +61,21 @@ def hbm_probe(mib: int = 512, iters: int = 8,
               mode: str = "read") -> dict[str, Any]:
     """Streaming bandwidth; returns achieved GiB/s and roofline fraction.
 
-    Two modes, because reads and writes do NOT roofline the same on v5e
-    (measured 2026-07, one chip, 256→512 MiB f32, two-point delta timing):
+    Two modes, because reads and writes do NOT roofline the same on v5e.
+    Current measured values live in the captured artifacts
+    (``BENCH_r*.json``: ``hbm_roofline`` / ``hbm_triad_roofline``), which
+    ``bench.py`` re-records every round — numbers here would go stale.
 
     * ``"read"`` (default, the roofline figure): a two-stream dot
-      (``Σ x·y``) — pure HBM reads feeding the VPU. Achieves ~723 GiB/s =
-      **0.95** of the 819 GB/s spec, so this is the number to alarm on.
-    * ``"triad"``: classic ``acc = acc·c + y`` (read 2, write 1). Every
-      variant tried — carry triad at 256/512 MiB (626/635), scaled copy
-      (604), buffer-swap add (281) — ceilings at ≈635 GiB/s ≈ 0.83 of
-      spec: the write stream pays read-modify-write in the memory
-      controller, so 0.83 IS the healthy triad roofline on this part, not
-      a probe artefact (round-1 VERDICT item 7 chased exactly this).
+      (``Σ x·y``) — pure HBM reads feeding the VPU, judged against the
+      full spec bandwidth; this is the number to alarm on.
+    * ``"triad"``: classic ``acc = acc·c + y`` (read 2, write 1). The
+      round-1 sweep (carry triad at 256/512 MiB, scaled copy,
+      buffer-swap add) showed every write-carrying variant ceilings at
+      ≈0.83 of spec on this part — the write stream pays
+      read-modify-write in the memory controller — so triad health is
+      judged against 0.83·spec, a measured hardware ceiling, not a probe
+      artefact (round-1 VERDICT item 7 chased exactly this).
     """
     n = mib * (1 << 20) // 4  # f32 elements
     x = jnp.ones((n,), dtype=jnp.float32)

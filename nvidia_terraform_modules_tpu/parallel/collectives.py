@@ -190,9 +190,50 @@ def ring_permute_probe(mesh: Mesh, axis: str = "sp", n_elems: int = 1 << 18) -> 
     return _run(mesh, verify, timed_step, P(axis), moved, n_dev)
 
 
+def all_to_all_probe(mesh: Mesh, axis: str = "ep", n_elems: int = 1 << 16) -> dict[str, Any]:
+    """All-to-all over ``axis`` — the MoE dispatch/combine collective.
+
+    Expert parallelism routes tokens with exactly this exchange
+    (``models/moe.py``'s dispatch/combine einsums lower to it), so a
+    slice sold as MoE-capable must prove the all-to-all path, not just
+    psum/all-gather. Each participant ``i`` fills row ``r`` of a local
+    ``[n, n_elems]`` payload with ``i·n + r`` (per-shard distinct, so
+    replication analysis can't fold the collective away); after the
+    exchange, row ``j`` must hold ``j·n + i`` — participant ``j``'s
+    chunk addressed to ``i`` — on every device.
+    """
+    n_dev = _axis_size(mesh, axis)
+
+    def contribution():
+        i = jax.lax.axis_index(axis).astype(jnp.float32)
+        r = jnp.arange(n_dev, dtype=jnp.float32)[:, None]
+        return jnp.broadcast_to(i * n_dev + r, (n_dev, n_elems))
+
+    def verify():
+        out = jax.lax.all_to_all(contribution(), axis, split_axis=0,
+                                 concat_axis=0, tiled=True)
+        i = jax.lax.axis_index(axis).astype(jnp.float32)
+        want = jnp.arange(n_dev, dtype=jnp.float32)[:, None] * n_dev + i
+        return _replicate(jnp.max(jnp.abs(out - want)), mesh)
+
+    def timed_step(carry):
+        i = jax.lax.axis_index(axis).astype(jnp.float32)
+        if carry is None:
+            return contribution()
+        # `+ i` keeps each hop's payload per-shard distinct and
+        # data-dependent on the previous exchange (see psum_probe)
+        return jax.lax.all_to_all(carry + i, axis, split_axis=0,
+                                  concat_axis=0, tiled=True)
+
+    # each participant ships (n-1)/n of its local array per hop
+    moved = (n_dev - 1) * n_elems * 4 * n_dev
+    return _run(mesh, verify, timed_step, P(axis), moved, n_dev)
+
+
 ALL_PROBES = {
     "psum": psum_probe,
     "all_gather": all_gather_probe,
     "reduce_scatter": reduce_scatter_probe,
     "ring_permute": ring_permute_probe,
+    "all_to_all": all_to_all_probe,
 }

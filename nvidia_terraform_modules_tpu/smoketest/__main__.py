@@ -4,7 +4,7 @@ This is the command the ``gke-tpu`` smoke-test Job container runs. Env
 contract (injected by the Job template in ``gke-tpu/smoketest.tf``):
 
 - ``TPU_SMOKETEST_EXPECTED_DEVICES`` — chips this host must see after init;
-- ``TPU_SMOKETEST_LEVEL`` — psum | probes | burnin;
+- ``TPU_SMOKETEST_LEVEL`` — psum | probes | burnin | full;
 - ``TPU_SMOKETEST_HOSTS`` / ``TPU_SMOKETEST_COORDINATOR`` /
   ``JOB_COMPLETION_INDEX`` — multi-host bootstrap (see parallel/multihost.py).
 """

@@ -34,3 +34,95 @@ def jax8():
 @pytest.fixture(scope="session")
 def repo_root():
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Fast/slow test profile (CONTRIBUTING: the edit loop runs `-m "not slow"`).
+#
+# Tests here measured >= 4 s on the CI CPU rig (`pytest --durations`) — the
+# gradient-equivalence, multi-step-training, and interpreter-mode pallas
+# suites. They are marked `slow` centrally so the fast profile stays under
+# two minutes; CI runs everything. Regenerate after perf-relevant test
+# changes with:
+#   pytest tests/ -q -m "not slow" --durations=0 | awk '$1+0>=4' ...
+# (test_manifest_is_fresh below fails loudly on renamed/deleted entries).
+SLOW_TESTS = frozenset({
+    "tests/test_burnin_model.py::test_loss_finite_unsharded",
+    "tests/test_burnin_model.py::test_sharded_matches_unsharded_forward",
+    "tests/test_decode.py::test_gqa_flash_prefill_close_to_dense",
+    "tests/test_decode.py::test_sampling_top_k_one_is_greedy",
+    "tests/test_burnin_model.py::test_gqa_forward_and_training",
+    "tests/test_moe.py::test_moe_train_step_decreases_loss_on_ep_mesh",
+    "tests/test_moe.py::test_tiny_capacity_drops_tokens_but_stays_finite",
+    "tests/test_ulysses_attention.py::test_ulysses_matches_dense",
+    "tests/test_checkpoint.py::test_resume_matches_uninterrupted_run",
+    "tests/test_checkpoint.py::test_roundtrip_unsharded",
+    "tests/test_decode.py::test_prefill_logits_match_forward",
+    "tests/test_decode.py::test_decode_step_count_and_shapes",
+    "tests/test_burnin_model.py::test_forward_shapes_unsharded",
+    "tests/test_burnin_model.py::test_grad_accum_matches_full_batch",
+    "tests/test_burnin_model.py::test_grad_accum_sharded_and_adamw",
+    "tests/test_burnin_model.py::test_mqa_cache_replicates_heads_when_tp_does_not_divide",
+    "tests/test_burnin_model.py::test_remat_is_gradient_exact",
+    "tests/test_burnin_model.py::test_remat_trains_sharded",
+    "tests/test_burnin_model.py::test_rope_position_sensitivity_and_training",
+    "tests/test_burnin_model.py::test_sharded_train_step_decreases_loss",
+    "tests/test_checkpoint.py::test_adamw_train_state_resume_bit_exact",
+    "tests/test_checkpoint.py::test_smoketest_job_resume_contract",
+    "tests/test_decode.py::test_compiled_decoder_matches_reference_on_mesh",
+    "tests/test_decode.py::test_flash_prefill_matches_dense_prefill",
+    "tests/test_decode.py::test_gqa_cache_is_smaller_and_decode_exact",
+    "tests/test_decode.py::test_greedy_decode_matches_reference",
+    "tests/test_decode.py::test_long_context_attn_configs_decode",
+    "tests/test_decode.py::test_long_context_nontiling_prompt_policy",
+    "tests/test_decode.py::test_rope_decode_matches_reference",
+    "tests/test_decode.py::test_sampling_reproducible_and_varied",
+    "tests/test_flash_attention.py::test_burnin_flash_train_step_decreases_loss",
+    "tests/test_flash_attention.py::test_flash_gradients_match_dense",
+    "tests/test_moe.py::test_moe_routes_to_multiple_experts",
+    "tests/test_moe.py::test_sharded_moe_matches_unsharded",
+    "tests/test_moe.py::test_single_expert_equals_dense_mlp",
+    "tests/test_moe.py::test_top2_matches_handrolled_reference",
+    "tests/test_moe.py::test_top2_trains_on_ep_mesh",
+    "tests/test_multislice.py::test_multislice_forward_matches_unsharded",
+    "tests/test_multislice.py::test_multislice_ring_attention_train",
+    "tests/test_multislice.py::test_multislice_train_step_decreases_loss",
+    "tests/test_multislice.py::test_smoketest_multislice_env",
+    "tests/test_optimizer.py::test_adamw_matches_optax",
+    "tests/test_optimizer.py::test_scheduled_adamw_trains",
+    "tests/test_optimizer.py::test_sharded_adamw_trains",
+    "tests/test_optimizer.py::test_sharded_adamw_trains_moe_on_ep_mesh",
+    "tests/test_optimizer.py::test_unsharded_adamw_trains",
+    "tests/test_pipeline.py::test_pipeline_gradients_match_reference",
+    "tests/test_pipeline.py::test_pipeline_matches_reference",
+    "tests/test_pipeline.py::test_pipeline_train_step_decreases_loss",
+    "tests/test_pipeline.py::test_pipeline_validates_config",
+    "tests/test_pipeline.py::test_pipeline_with_tp_gradients_match_reference",
+    "tests/test_pipeline.py::test_pipeline_with_tp_matches_reference",
+    "tests/test_pipeline.py::test_pipeline_with_tp_trains",
+    "tests/test_quantize.py::test_quantized_decoder_runs_and_mostly_agrees",
+    "tests/test_quantize.py::test_quantized_logits_close",
+    "tests/test_quantize.py::test_tree_roundtrip_keeps_norms_exact",
+    "tests/test_ring_attention.py::test_burnin_ring_matches_dense_forward",
+    "tests/test_ring_attention.py::test_burnin_ring_train_step_decreases_loss",
+    "tests/test_ring_attention.py::test_long_sequence_ring_memory_shape",
+    "tests/test_ring_attention.py::test_ring_auto_impl_falls_back_to_dense_on_untileable_shards",
+    "tests/test_ring_attention.py::test_ring_gradients_match_dense",
+    "tests/test_ring_attention.py::test_ring_impl_gradients_match_dense",
+    "tests/test_ring_attention.py::test_ring_impls_match_dense_at_tile_scale",
+    "tests/test_ring_attention.py::test_ring_jit_under_sharded_inputs",
+    "tests/test_ring_attention.py::test_ring_matches_dense",
+    "tests/test_smoketest.py::test_burnin_level",
+    "tests/test_ulysses_attention.py::test_burnin_ulysses_matches_dense_forward",
+    "tests/test_ulysses_attention.py::test_burnin_ulysses_train_step_decreases_loss",
+    "tests/test_ulysses_attention.py::test_ulysses_gradients_match_dense",
+    "tests/test_ulysses_attention.py::test_ulysses_impls_match_dense_at_tile_scale",
+    "tests/test_ulysses_attention.py::test_ulysses_jit_under_sharded_inputs",
+})
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        base = item.nodeid.split("[")[0]
+        if base in SLOW_TESTS:
+            item.add_marker(pytest.mark.slow)

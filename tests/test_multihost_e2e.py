@@ -287,3 +287,22 @@ def test_package_runner_burnin_checkpoint_two_hosts(tmp_path):
         assert verdict["ok"] is True
         assert verdict["burnin_resumed_step"] == 3
         assert verdict["burnin_step"] == 8
+
+
+@pytest.mark.slow
+def test_standalone_script_full_level_two_hosts():
+    """Level full across 2 processes: the MoE all-to-all dispatch leg and
+    the 2-stage pipeline step must run over the REAL process boundary —
+    the fabric proof the apply-gating Job sells (round-2 VERDICT item 3).
+    The pipeline's pp=2 split spans the two hosts (devices 0-3 vs 4-7)."""
+    script = os.path.join(ROOT, "gke-tpu", "scripts", "tpu_smoketest.py")
+    results = _run_pair(script, {"TPU_SMOKETEST_LEVEL": "full"}, port=8497)
+    for rc, out, err in results:
+        assert rc == 0, f"stdout={out!r}\nstderr={err[-2000:]!r}"
+        verdict = _verdict(out)
+        assert verdict["ok"] is True
+        assert verdict["alltoall_ok"] is True
+        assert verdict["alltoall_gibps"] > 0
+        assert verdict["moe_ok"] is True
+        assert verdict["pipeline_ok"] is True
+        assert verdict["burnin_ok"] is True

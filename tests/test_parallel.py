@@ -10,6 +10,7 @@ from nvidia_terraform_modules_tpu.parallel.collectives import (
     all_gather_probe,
     psum_probe,
     reduce_scatter_probe,
+    all_to_all_probe,
     ring_permute_probe,
 )
 
@@ -59,3 +60,18 @@ def test_ring_permute_probe(jax8):
     mesh = build_mesh(plan_mesh(8, tp=1, sp=1))
     r = ring_permute_probe(mesh, axis="dp", n_elems=64)
     assert r["ok"]
+
+
+def test_all_to_all_probe_on_ep_axis(jax8):
+    """The MoE dispatch collective, over a real expert axis."""
+    mesh = build_mesh(plan_mesh(8, ep=2, tp=2))
+    r = all_to_all_probe(mesh, axis="ep", n_elems=64)
+    assert r["ok"]
+    assert r["participants"] == 2
+
+
+def test_all_to_all_probe_all_devices(jax8):
+    mesh = build_mesh(plan_mesh(8, tp=1, sp=1))
+    r = all_to_all_probe(mesh, axis="dp", n_elems=64)
+    assert r["ok"]
+    assert r["participants"] == 8

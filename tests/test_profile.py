@@ -1,0 +1,14 @@
+"""The fast/slow split itself: the manifest must track real test names."""
+
+from conftest import SLOW_TESTS
+
+
+def test_manifest_is_fresh(request):
+    session = request.session
+    collected = {item.nodeid.split("[")[0] for item in session.items}
+    # under -m "not slow" the slow items are deselected before this runs,
+    # so only assert when the full suite was collected
+    if not any(n in collected for n in SLOW_TESTS):
+        return
+    stale = {n for n in SLOW_TESTS if n not in collected}
+    assert not stale, f"SLOW_TESTS names no longer collected: {sorted(stale)}"

@@ -2,6 +2,8 @@
 
 import json
 
+import pytest
+
 from nvidia_terraform_modules_tpu.smoketest import run_smoketest
 
 
@@ -42,3 +44,23 @@ def test_burnin_level(jax8):
     # the serve shape validates alongside training: greedy KV-cache
     # decode on the just-trained weights, self-consistent with forward()
     assert r.checks["decode_ok"]
+
+
+@pytest.mark.slow
+def test_full_level(jax8):
+    """The ep/pp fabric legs: all-to-all probe over a real ep axis, MoE
+    dispatch/combine training, and a 2-stage pipeline step (round-2
+    VERDICT item 3 — the two axes the dense burn-in never exercises)."""
+    r = run_smoketest(level="full", env={})
+    assert r.ok, r.checks
+    assert r.checks["all_to_all_ep_ok"]
+    assert r.checks["all_to_all_ep_gibps"] > 0
+    assert r.checks["moe_ok"]
+    assert r.checks["pipeline_ok"]
+    # full is a superset: the burn-in/decode contract still holds
+    assert r.checks["burnin_ok"] and r.checks["decode_ok"]
+
+
+def test_unknown_level_rejected(jax8):
+    with pytest.raises(ValueError, match="psum|probes|burnin|full"):
+        run_smoketest(level="nope", env={})
