@@ -27,5 +27,6 @@ from .pipeline import (  # noqa: F401
     init_pipeline_params,
     make_pipeline_train_step,
     pipeline_loss_fn,
+    pipeline_value_and_grad_1f1b,
     stack_sharding,
 )

@@ -21,6 +21,24 @@ pipeline design, not a port of a CUDA send/recv scheduler:
   ``jax.grad`` differentiates straight through the schedule — reverse
   ppermutes ARE the backward pipeline, no hand-written send/recv.
 
+Two schedules share the stage/head math:
+
+- **GPipe** (``pipeline_loss_fn`` + ``jax.grad``): all M forwards, then
+  autodiff's backward sweep. Simple, but reverse-mode saves every scan
+  tick's carry — peak activation memory grows with M (+ the [M, …]
+  embedded-input buffer).
+- **1F1B** (``pipeline_value_and_grad_1f1b``): one scan whose tick does
+  one forward AND one backward (double-clocked — each stage runs both
+  sub-steps per tick, validity-masked). Stage inputs wait in a ring
+  buffer of depth 2·pp−1 — sized by the fwd→bwd pipeline distance,
+  INDEPENDENT of M — and the backward sub-step re-derives its stage vjp
+  from the saved input (per-stage activation recompute, the standard
+  trade). Weight gradients accumulate across ticks in f32; the loss and
+  gradients equal the GPipe/unpipelined ones exactly (shared math, same
+  reduction order per microbatch), so the schedule changes memory and
+  overlap, never the model.
+
+
 The block inside a stage is a plain dense transformer block (attention +
 FFN). Pipeline composes with data parallelism (mesh ``("pp", "dp")``,
 gradients pmean over dp) AND with tensor parallelism (mesh
@@ -133,6 +151,43 @@ def _stage(stage_layers, x, cfg: PipelineConfig, tp: int = 1):
     return out
 
 
+def _head_loss(out, embed, out_norm, tgt):
+    """Final-stage LM head + mean NLL for one microbatch — the ONE
+    definition both schedules share, so their losses cannot drift."""
+    h = _rmsnorm(out, out_norm)
+    logits = (h @ embed.T).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.mean(-jnp.take_along_axis(
+        logp, tgt[..., None], axis=-1).squeeze(-1))
+
+
+def _validate_pipeline(cfg: PipelineConfig, mesh, batch):
+    """Shared config/mesh/batch checks → (pp, dp, tp). Named quantities,
+    not a shard_map reshape error deep in jit."""
+    if "pp" not in mesh.shape or "dp" not in mesh.shape:
+        raise ValueError(
+            f"pipeline needs a ('pp', 'dp'[, 'tp']) mesh; got axes "
+            f"{tuple(mesh.axis_names)} (use dp=1 for no data parallelism)")
+    pp = mesh.shape["pp"]
+    dp = mesh.shape["dp"]
+    tp = mesh.shape.get("tp", 1)
+    if cfg.n_layers % pp != 0:
+        raise ValueError(
+            f"n_layers = {cfg.n_layers} does not divide into pp = {pp} "
+            f"stages")
+    if tp > 1 and (cfg.n_heads % tp or cfg.d_ff % tp or cfg.d_model % tp):
+        raise ValueError(
+            f"tp = {tp} must divide n_heads ({cfg.n_heads}), d_ff "
+            f"({cfg.d_ff}), and d_model ({cfg.d_model})")
+    expected = cfg.n_microbatches * cfg.microbatch * dp
+    if batch[0].shape[0] != expected:
+        raise ValueError(
+            f"batch has {batch[0].shape[0]} rows; pipeline needs "
+            f"n_microbatches·microbatch·dp = {cfg.n_microbatches}·"
+            f"{cfg.microbatch}·{dp} = {expected}")
+    return pp, dp, tp
+
+
 def _layer_specs(tp: int):
     """PartitionSpecs for the stacked layer dict: pp on the layer dim,
     tp on the Megatron dim of each weight (none when tp == 1)."""
@@ -159,28 +214,8 @@ def pipeline_loss_fn(params, batch, cfg: PipelineConfig, mesh):
     NLL for valid ticks only. The scalar loss is psum'd over pp (only the
     last stage contributes) and pmean'd over dp.
     """
-    # fail with named quantities, not a shard_map reshape error deep in jit
-    if "pp" not in mesh.shape or "dp" not in mesh.shape:
-        raise ValueError(
-            f"pipeline needs a ('pp', 'dp'[, 'tp']) mesh; got axes "
-            f"{tuple(mesh.axis_names)} (use dp=1 for no data parallelism)")
-    pp = mesh.shape["pp"]
-    dp = mesh.shape["dp"]
-    tp = mesh.shape.get("tp", 1)
+    pp, dp, tp = _validate_pipeline(cfg, mesh, batch)
     M, mb, S = cfg.n_microbatches, cfg.microbatch, cfg.seq_len
-    if cfg.n_layers % pp != 0:
-        raise ValueError(
-            f"n_layers = {cfg.n_layers} does not divide into pp = {pp} "
-            f"stages")
-    if tp > 1 and (cfg.n_heads % tp or cfg.d_ff % tp or cfg.d_model % tp):
-        raise ValueError(
-            f"tp = {tp} must divide n_heads ({cfg.n_heads}), d_ff "
-            f"({cfg.d_ff}), and d_model ({cfg.d_model})")
-    expected = M * mb * dp
-    if batch[0].shape[0] != expected:
-        raise ValueError(
-            f"batch has {batch[0].shape[0]} rows; pipeline needs "
-            f"n_microbatches·microbatch·dp = {M}·{mb}·{dp} = {expected}")
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
@@ -207,16 +242,11 @@ def pipeline_loss_fn(params, batch, cfg: PipelineConfig, mesh):
             inp = jnp.where(i == 0, feed, buf)
             out = _stage(stage_layers, inp, cfg, tp)
             # last stage: LM head + NLL for its current microbatch
-            h = _rmsnorm(out, out_norm)
-            logits = (h @ embed.T).astype(jnp.float32)
-            logp = jax.nn.log_softmax(logits, axis=-1)
             mb_idx = jnp.clip(t - (pp - 1), 0, M - 1)
-            tgt = targets[mb_idx]
-            nll = -jnp.take_along_axis(
-                logp, tgt[..., None], axis=-1).squeeze(-1)
             valid = ((t - (pp - 1) >= 0) & (t - (pp - 1) < M) &
                      (i == pp - 1)).astype(jnp.float32)
-            loss_t = valid * jnp.mean(nll)
+            loss_t = valid * _head_loss(out, embed, out_norm,
+                                        targets[mb_idx])
             # hand the activation to the next stage (ring: the wrap-around
             # edge only ever carries drained garbage, masked above)
             nxt = jax.lax.ppermute(
@@ -247,13 +277,191 @@ def stack_sharding(mesh, params):
     }
 
 
-def make_pipeline_train_step(cfg: PipelineConfig, mesh, lr: float = 1e-3):
-    """Jitted SGD step over the pipelined loss; grads flow through the
-    reverse ppermutes (the backward pipeline autodiff derives)."""
+def pipeline_value_and_grad_1f1b(params, batch, cfg: PipelineConfig, mesh):
+    """1F1B: forward and backward interleaved in ONE scan → (loss, grads).
+
+    Why not ``jax.grad(pipeline_loss_fn)``: reverse-mode over the GPipe
+    scan saves every tick's carry — O(M) live activations per stage (plus
+    the [M, …] embedded-input buffer). Here the schedule OWNS its
+    backward: each tick runs one forward sub-step and one backward
+    sub-step (double-clocked; every stage does both, validity-masked, so
+    work stays uniform — the same masking-over-branching rule as GPipe).
+
+    Timing (stage ``i``, tick ``t``): forward of microbatch ``f = t - i``
+    (as GPipe); backward of microbatch ``b = t - 2(pp-1) + i`` — the
+    last stage's forward and backward of a microbatch coincide (its
+    head-loss vjp is consumed the tick it is produced), and each stage's
+    input cotangent arrives exactly one down-ppermute after the stage
+    above computed it. A stage input saved at tick ``f + i`` is consumed
+    at ``b + 2(pp-1) - i``: lifetime ``2(pp-1-i) < 2pp-1``, so a ring
+    buffer of depth ``R = 2·pp − 1`` — independent of M — replaces
+    autodiff's per-tick saves. The backward sub-step re-derives the
+    stage vjp from that saved input (activation recompute inside the
+    stage, the standard 1F1B trade: ~1/3 more stage FLOPs for O(M)→O(pp)
+    activation residency).
+
+    Gradient accounting: per-microbatch cotangent 1.0, f32 accumulators,
+    ``/M`` at the end — identical math to the mean-of-M losses GPipe
+    differentiates, so grads match the unpipelined reference exactly.
+    Embed gradients take both contributions (last stage's head vjp, stage
+    0's lookup scatter-add) and psum over pp; everything pmeans over dp.
+    Composes with tp like GPipe: the stage vjp differentiates the
+    explicit Megatron psums inside the Manual region.
+    """
+    pp, dp, tp = _validate_pipeline(cfg, mesh, batch)
+    M, mb, S = cfg.n_microbatches, cfg.microbatch, cfg.seq_len
+    R = 2 * pp - 1
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(_layer_specs(tp), P(), P(), P(None, "dp")),
+        out_specs=(P(), _layer_specs(tp), P(), P()),
+        check_vma=False,
+    )
+    def run(stage_layers, embed, out_norm, batch_shard):
+        i = jax.lax.axis_index("pp")
+        last = i == pp - 1
+        tokens = batch_shard[0].reshape(M, mb, S)
+        targets = batch_shard[1].reshape(M, mb, S)
+
+        def stage_fn(W, x):
+            return _stage(W, x, cfg, tp)
+
+        f32 = jnp.float32
+        acc0 = {
+            "dW": jax.tree.map(lambda w: jnp.zeros(w.shape, f32),
+                               stage_layers),
+            "d_embed": jnp.zeros(embed.shape, f32),
+            "d_onorm": jnp.zeros(out_norm.shape, f32),
+            "loss": f32(0.0),
+        }
+        carry0 = {
+            "fwd_recv": jnp.zeros((mb, S, cfg.d_model), cfg.dtype),
+            "bwd_recv": jnp.zeros((mb, S, cfg.d_model), cfg.dtype),
+            "buf": jnp.zeros((R, mb, S, cfg.d_model), cfg.dtype),
+            **acc0,
+        }
+
+        def tick(c, t):
+            f = t - i                       # fwd microbatch, as GPipe
+            b = t - 2 * (pp - 1) + i        # bwd microbatch
+            f_idx, b_idx = jnp.clip(f, 0, M - 1), jnp.clip(b, 0, M - 1)
+            valid_f = (f >= 0) & (f < M)
+            valid_b = (b >= 0) & (b < M)
+
+            # ---- forward sub-step (embed looked up per tick: no [M, …]
+            # input buffer — part of the memory win)
+            inp = jnp.where(i == 0, embed[tokens[f_idx]], c["fwd_recv"])
+            out = stage_fn(stage_layers, inp)
+
+            # head-loss + its vjp for THIS tick's microbatch; on the last
+            # stage b == f, so d_out is consumed immediately below
+            # Cotangent convention under tp (derived from psum's manual-
+            # mode transpose, which is psum): every cotangent of a
+            # tp-REPLICATED primal travels as a per-device SHARE summing
+            # to the true cotangent; cotangents of tp-sharded primals are
+            # locally true. Seeding 1/tp establishes it, psum transposes
+            # inside the stage vjp maintain it, and the share-convention
+            # accumulators are psum'd over tp once at the end. tp=1
+            # degenerates to seeds of 1 and no-op reductions.
+            loss_val, head_vjp = jax.vjp(
+                lambda o, e, n: _head_loss(o, e, n, targets[f_idx]),
+                out, embed, out_norm)
+            d_out_head, d_emb_h, d_on_h = head_vjp(f32(1.0 / tp))
+
+            # ---- ring buffer: write this tick's input, read the bwd
+            # microbatch's saved input (same slot on the last stage —
+            # write-then-read keeps that coincidence correct)
+            buf = jax.lax.dynamic_update_index_in_dim(
+                c["buf"], inp, jnp.mod(t, R), 0)
+            saved = jax.lax.dynamic_index_in_dim(
+                buf, jnp.mod(b_idx + i, R), 0, keepdims=False)
+
+            # ---- backward sub-step: re-derive the stage vjp from the
+            # saved input (activation recompute), pull the cotangent
+            d_out = jnp.where(last, d_out_head.astype(cfg.dtype),
+                              c["bwd_recv"])
+            _, stage_vjp = jax.vjp(stage_fn, stage_layers, saved)
+            # d_inp stays in share convention — it feeds the next vjp down
+            # (which expects shares) and the embed scatter (summed over tp
+            # with the accumulator); reducing it here would double-count
+            dW_t, d_inp = stage_vjp(d_out)
+
+            acc = {
+                "dW": jax.tree.map(
+                    lambda a, g: a + jnp.where(valid_b, g.astype(f32), 0.0),
+                    c["dW"], dW_t),
+                "d_embed": (
+                    c["d_embed"]
+                    + jnp.where(last & valid_f, d_emb_h.astype(f32), 0.0)
+                ).at[tokens[b_idx]].add(
+                    jnp.where((i == 0) & valid_b,
+                              d_inp.astype(f32), 0.0)),
+                "d_onorm": c["d_onorm"] + jnp.where(
+                    last & valid_f, d_on_h.astype(f32), 0.0),
+                "loss": c["loss"] + jnp.where(last & valid_f, loss_val, 0.0),
+            }
+            perm_up = [(j, (j + 1) % pp) for j in range(pp)]
+            perm_dn = [(j, (j - 1) % pp) for j in range(pp)]
+            return {
+                "fwd_recv": jax.lax.ppermute(out, "pp", perm_up),
+                "bwd_recv": jax.lax.ppermute(d_inp, "pp", perm_dn),
+                "buf": buf,
+                **acc,
+            }, None
+
+        final, _ = jax.lax.scan(tick, carry0, jnp.arange(M + 2 * (pp - 1)))
+        loss = jax.lax.pmean(
+            jax.lax.psum(final["loss"], "pp") / M, "dp")
+        dW = dict(final["dW"])
+        if tp > 1:
+            # share-convention accumulators: grads of tp-replicated params
+            # (norm scales here; embed/out_norm below fold it into their
+            # pp psum) sum their per-device shares to the true gradient.
+            # Col/row weights are tp-SHARDED: locally true, no reduction.
+            dW["attn_norm"] = jax.lax.psum(dW["attn_norm"], "tp")
+            dW["mlp_norm"] = jax.lax.psum(dW["mlp_norm"], "tp")
+        dW = jax.tree.map(lambda g: jax.lax.pmean(g / M, "dp"), dW)
+        rep_axes = ("pp", "tp") if tp > 1 else ("pp",)
+        d_embed = jax.lax.pmean(
+            jax.lax.psum(final["d_embed"], rep_axes) / M, "dp")
+        d_onorm = jax.lax.pmean(
+            jax.lax.psum(final["d_onorm"], rep_axes) / M, "dp")
+        return loss, dW, d_embed, d_onorm
+
+    loss, dW, d_embed, d_onorm = run(
+        params["layers"], params["embed"], params["out_norm"],
+        jnp.stack(batch))
+    return loss, {"embed": d_embed, "out_norm": d_onorm, "layers": dW}
+
+
+SCHEDULES = ("gpipe", "1f1b")
+
+
+def make_pipeline_train_step(cfg: PipelineConfig, mesh, lr: float = 1e-3,
+                             schedule: str = "gpipe"):
+    """Jitted SGD step over the pipelined loss.
+
+    ``schedule="gpipe"``: autodiff through the forward scan (grads flow
+    through the reverse ppermutes). ``schedule="1f1b"``: the interleaved
+    schedule of :func:`pipeline_value_and_grad_1f1b` — same loss, same
+    gradients, O(pp) instead of O(M) live activations per stage.
+    """
+    if schedule not in SCHEDULES:
+        raise ValueError(
+            f"unknown pipeline schedule {schedule!r}; use one of "
+            f"{SCHEDULES}")
+
+    if schedule == "gpipe":
+        def grads_of(params, batch):
+            return jax.value_and_grad(pipeline_loss_fn)(
+                params, batch, cfg, mesh)
+    else:
+        def grads_of(params, batch):
+            return pipeline_value_and_grad_1f1b(params, batch, cfg, mesh)
 
     def step(params, batch):
-        loss, grads = jax.value_and_grad(pipeline_loss_fn)(
-            params, batch, cfg, mesh)
+        loss, grads = grads_of(params, batch)
         params = jax.tree.map(
             lambda p, g: (p - lr * g.astype(p.dtype)), params, grads)
         return params, loss

@@ -296,7 +296,7 @@ def test_standalone_script_full_level_two_hosts():
     the fabric proof the apply-gating Job sells (round-2 VERDICT item 3).
     The pipeline's pp=2 split spans the two hosts (devices 0-3 vs 4-7)."""
     script = os.path.join(ROOT, "gke-tpu", "scripts", "tpu_smoketest.py")
-    results = _run_pair(script, {"TPU_SMOKETEST_LEVEL": "full"}, port=8497)
+    results = _run_pair(script, {"TPU_SMOKETEST_LEVEL": "full"}, port=8498)
     for rc, out, err in results:
         assert rc == 0, f"stdout={out!r}\nstderr={err[-2000:]!r}"
         verdict = _verdict(out)

@@ -172,3 +172,87 @@ def test_pipeline_tp_divisibility_validated(jax8):
     batch = _batch(jax.random.PRNGKey(1), cfg)
     with pytest.raises(ValueError, match="must divide n_heads"):
         pipeline_loss_fn(params, batch, cfg, mesh)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("shape,names", [
+    ((2, 1), ("pp", "dp")),
+    ((4, 2), ("pp", "dp")),
+    ((2, 1, 2), ("pp", "dp", "tp")),
+    ((2, 2, 2), ("pp", "dp", "tp")),
+])
+def test_1f1b_gradients_match_reference(jax8, shape, names):
+    """The interleaved schedule is invisible: loss AND grads equal the
+    layer-by-layer reference on every mesh shape, including the Megatron
+    tp composition (whose manual-mode cotangent shares the schedule must
+    account for explicitly — see pipeline_value_and_grad_1f1b)."""
+    import math
+
+    from nvidia_terraform_modules_tpu.parallel.pipeline import (
+        pipeline_value_and_grad_1f1b,
+    )
+
+    dp = dict(zip(names, shape)).get("dp", 1)
+    mesh = build_mesh(MeshPlan(names, shape),
+                      devices=jax.devices()[:math.prod(shape)])
+    params = init_pipeline_params(jax.random.PRNGKey(0), CFG)
+    batch = _batch(jax.random.PRNGKey(1), CFG, dp)
+
+    def ref(p, b):
+        toks = b[0].reshape(-1, CFG.microbatch, CFG.seq_len)
+        tgts = b[1].reshape(-1, CFG.microbatch, CFG.seq_len)
+        tot = 0.0
+        for m in range(toks.shape[0]):
+            tot = tot + reference_loss_fn(p, (toks[m], tgts[m]), CFG)
+        return tot / toks.shape[0]
+
+    l0, g0 = jax.value_and_grad(ref)(params, batch)
+    l1, g1 = jax.jit(
+        lambda p, b: pipeline_value_and_grad_1f1b(p, b, CFG, mesh)
+    )(_place(params, mesh), batch)
+    assert float(l1) == pytest.approx(float(l0), rel=1e-5)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g0)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_1f1b_trains(jax8):
+    mesh = _mesh(2, 2)
+    step = make_pipeline_train_step(CFG, mesh, lr=1e-2, schedule="1f1b")
+    params = _place(init_pipeline_params(jax.random.PRNGKey(0), CFG), mesh)
+    batch = _batch(jax.random.PRNGKey(1), CFG, dp=2)
+    losses = []
+    for _ in range(5):
+        params, loss = step(params, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+@pytest.mark.slow
+def test_1f1b_peak_memory_below_gpipe(jax8):
+    """The schedule's point: 1F1B's ring buffer is O(pp), GPipe's
+    autodiff saves are O(M) — at M >> pp the compiled temp allocation
+    must be several times smaller (round-2 VERDICT item 6)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, n_microbatches=16, seq_len=32,
+                              d_model=64, d_ff=128)
+    mesh = _mesh(2, 1)
+    params = _place(init_pipeline_params(jax.random.PRNGKey(0), cfg), mesh)
+    batch = _batch(jax.random.PRNGKey(1), cfg)
+    temps = {}
+    for sched in ("gpipe", "1f1b"):
+        step = make_pipeline_train_step(cfg, mesh, schedule=sched)
+        ma = step.lower(params, batch).compile().memory_analysis()
+        temp = getattr(ma, "temp_size_in_bytes", None)
+        if temp is None:
+            pytest.skip("backend reports no memory analysis")
+        temps[sched] = temp
+    assert temps["1f1b"] * 2 < temps["gpipe"], temps
+
+
+def test_unknown_schedule_rejected(jax8):
+    with pytest.raises(ValueError, match="schedule"):
+        make_pipeline_train_step(CFG, _mesh(2, 1), schedule="interleaved")
