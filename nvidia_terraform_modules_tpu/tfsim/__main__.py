@@ -8,8 +8,12 @@ terraform binary in CI, so tfsim ships the same verbs offline::
     python -m nvidia_terraform_modules_tpu.tfsim init gke-tpu [-check]
     python -m nvidia_terraform_modules_tpu.tfsim validate gke-tpu
     python -m nvidia_terraform_modules_tpu.tfsim plan gke-tpu -var project_id=p \
-        -var cluster_name=c [-state terraform.tfstate.json] [-json] [-target ADDR]
+        -var cluster_name=c [-state terraform.tfstate.json] [-json] [-target ADDR] \
+        [-out plan.tfplan] [-refresh-only]
     python -m nvidia_terraform_modules_tpu.tfsim apply gke-tpu ... -state f [-target ADDR]
+    python -m nvidia_terraform_modules_tpu.tfsim apply plan.tfplan   # saved-plan apply
+    python -m nvidia_terraform_modules_tpu.tfsim show plan.tfplan [-json]
+    python -m nvidia_terraform_modules_tpu.tfsim refresh gke-tpu ... -state f
     python -m nvidia_terraform_modules_tpu.tfsim import gke-tpu ADDR ID -state f ...
     python -m nvidia_terraform_modules_tpu.tfsim destroy gke-tpu ...
     python -m nvidia_terraform_modules_tpu.tfsim output -state f [NAME] [-json]
@@ -39,8 +43,18 @@ from .fmt import check_text, format_text
 from .lockfile import LockfileError, check_lockfile, write_lockfile
 from .module import load_module
 from .plan import PlanError, load_tfvars, render, simulate_plan, to_dot
+from .planfile import (
+    PlanFileError,
+    check_not_stale,
+    is_plan_file,
+    load_plan_file,
+    plan_file_payload,
+    plan_from_payload,
+    save_plan_file,
+)
 from .state import (
     COMPUTED_STR,
+    Diff,
     State,
     apply_plan,
     diff,
@@ -173,19 +187,90 @@ def _plan_against_state(args):
     state_path = resolve_state_path(args.dir, args.state,
                                     getattr(args, "workspace", None))
     prior = _load_state(state_path)
+    # the ON-DISK serial, before any in-memory moved{} migration: what a
+    # saved plan must be checked against at apply-file time (the apply
+    # also loads disk state first and migrates after its stale check)
+    disk_serial = prior.serial if prior is not None else None
     if prior is not None:
         prior, renames = migrate_state(prior, mod)
         for old, new in renames:
             # stderr: diagnostics must not corrupt `plan -json` stdout
             print(f"  moved: {old} -> {new}", file=sys.stderr)
-    return plan, prior, state_path
+    return plan, prior, state_path, disk_serial
+
+
+def _print_plan_marks(d, order, show_noop: bool) -> None:
+    """The human plan rendering, shared by ``plan`` and ``show FILE``."""
+    marks = {"create": "+", "update": "~", "replace": "-/+"}
+    for addr in order:
+        for iaddr in sorted(a for a in d.actions
+                            if d.actions[a] != "delete" and (
+                                a == addr or a.startswith(addr + "[") or
+                                a.startswith(addr + "."))):
+            act = d.actions[iaddr]
+            if act == "no-op" and not show_noop:
+                continue
+            line = f"  {marks.get(act, ' ')} {iaddr}"
+            if act == "update":
+                line += f"  ({', '.join(d.changed_keys[iaddr])})"
+            print(line)
+    for iaddr in d.by_action("delete"):
+        print(f"  - {iaddr}")
+
+
+def _refresh_only_report(plan, prior) -> tuple[int, "State"]:
+    """Drift view for ``-refresh-only``: what accepting provider reality
+    would change in state — refreshed outputs and orphaned addresses —
+    with ZERO resource actions proposed. Returns (n_changes, new_state).
+    """
+    from .state import refresh_state
+
+    new_state, changed_outputs, orphans = refresh_state(plan, prior)
+    for name in changed_outputs:
+        print(f"  ~ output.{name}")
+    for addr in orphans:
+        print(f"  ! {addr} (in state, not in configuration — a normal "
+              f"apply would destroy it)")
+    print(f"Refresh: {len(changed_outputs)} output(s) to update, "
+          f"{len(orphans)} orphaned address(es). No resource changes.")
+    return len(changed_outputs), new_state
+
+
+def _refresh_only_print(plan, prior, args) -> int:
+    """plan -refresh-only output: honours -json (machine consumers must
+    never receive the human drift rendering on stdout)."""
+    from .state import refresh_state
+
+    if getattr(args, "json", False):
+        _, changed_outputs, orphans = refresh_state(plan, prior)
+        print(json.dumps({"refresh_only": True,
+                          "changed_outputs": changed_outputs,
+                          "orphans": orphans}, indent=2, sort_keys=True))
+        return 0
+    _refresh_only_report(plan, prior)
+    return 0
 
 
 def cmd_plan(args) -> int:
     try:
-        plan, prior, _ = _plan_against_state(args)
+        plan, prior, state_path, disk_serial = _plan_against_state(args)
+        if getattr(args, "refresh_only", False):
+            if getattr(args, "out", None):
+                print("Error: -refresh-only cannot be saved with -out (a "
+                      "refresh accepts drift, it does not stage actions)",
+                      file=sys.stderr)
+                return 2
+            return _refresh_only_print(plan, prior, args)
         d = diff(plan, prior, getattr(args, "target", None))
-    except (PlanError, ValueError) as ex:
+        if getattr(args, "out", None):
+            save_plan_file(args.out, plan_file_payload(
+                plan, d, disk_serial, module_dir=os.path.abspath(args.dir),
+                workspace=_workspace_of(args),
+                targets=getattr(args, "target", None)))
+            print(f'Saved the plan to: {args.out}\n'
+                  f'To perform exactly these actions, run:\n'
+                  f'  tfsim apply {args.out}', file=sys.stderr)
+    except (PlanError, PlanFileError, ValueError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
         return 1
     if args.json:
@@ -196,34 +281,77 @@ def cmd_plan(args) -> int:
             "check_failures": plan.check_failures,
         }, indent=2, sort_keys=True))
         return 0
-    marks = {"create": "+", "update": "~", "replace": "-/+"}
-    for addr in plan.order:
-        for iaddr in sorted(a for a in d.actions
-                            if d.actions[a] != "delete" and (
-                                a == addr or a.startswith(addr + "[") or
-                                a.startswith(addr + "."))):
-            act = d.actions[iaddr]
-            if act == "no-op" and not args.show_noop:
-                continue
-            line = f"  {marks.get(act, ' ')} {iaddr}"
-            if act == "update":
-                line += f"  ({', '.join(d.changed_keys[iaddr])})"
-            print(line)
-    for iaddr in d.by_action("delete"):
-        print(f"  - {iaddr}")
+    _print_plan_marks(d, plan.order, args.show_noop)
     for failure in plan.check_failures:
         print(f"Warning: {failure}", file=sys.stderr)
     print(d.summary())
     return 0
 
 
+def _apply_saved_plan(args) -> int:
+    """``apply PLANFILE``: perform exactly the reviewed actions.
+
+    The module dir recorded in the file is re-read ONLY for state
+    migration (moved{} blocks); the plan content — instances, outputs,
+    order — comes from the file. Two guards make the review binding:
+    the state-serial stale check, and a re-diff that must reproduce the
+    saved actions exactly (a drifted module/moved{} set is an error, not
+    a silently different apply).
+    """
+    if args.var or args.var_file or getattr(args, "target", None):
+        print("Error: -var/-var-file/-target cannot be combined with a "
+              "saved plan file (the plan is already resolved)",
+              file=sys.stderr)
+        return 2
+    payload = load_plan_file(args.dir)
+    plan = plan_from_payload(payload)
+    state_path = resolve_state_path(
+        payload["module_dir"], args.state,
+        payload["workspace"] if payload["workspace"] != "default" else None)
+    prior = _load_state(state_path)
+    check_not_stale(payload, prior)
+    if prior is not None:
+        prior, renames = migrate_state(prior, load_module(payload["module_dir"]))
+        for old, new in renames:
+            print(f"  moved: {old} -> {new}", file=sys.stderr)
+    targets = payload["targets"] or None
+    d = diff(plan, prior, targets)
+    if d.actions != payload["actions"]:
+        drifted = sorted(set(d.actions.items())
+                         ^ set(payload["actions"].items()))
+        raise PlanFileError(
+            f"saved plan no longer matches a fresh diff against the same "
+            f"state serial (module or moved{{}} drift?): {drifted[:5]}")
+    state = apply_plan(plan, prior, targets, d=d)
+    if state_path:
+        _write_state(state_path, state)
+    for failure in plan.check_failures:
+        print(f"Warning: {failure}", file=sys.stderr)
+    print(d.summary().replace("Plan:", "Apply complete:")
+          .replace("to add", "added").replace("to change", "changed")
+          .replace("to destroy", "destroyed"))
+    return 0
+
+
 def cmd_apply(args) -> int:
     try:
-        plan, prior, state_path = _plan_against_state(args)
+        if os.path.isfile(args.dir):
+            if not is_plan_file(args.dir):
+                print(f"Error: {args.dir!r} is a file but not a tfsim plan "
+                      f"file (apply takes a module dir or a plan -out "
+                      f"file)", file=sys.stderr)
+                return 2
+            return _apply_saved_plan(args)
+        plan, prior, state_path, _serial = _plan_against_state(args)
+        if getattr(args, "refresh_only", False):
+            n, state = _refresh_only_report(plan, prior)
+            if state_path and n:
+                _write_state(state_path, state)
+            return 0
         targets = getattr(args, "target", None)
         d = diff(plan, prior, targets)
         state = apply_plan(plan, prior, targets, d=d)
-    except (PlanError, ValueError) as ex:
+    except (PlanError, PlanFileError, ValueError, OSError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
         return 1
     if state_path:
@@ -233,6 +361,66 @@ def cmd_apply(args) -> int:
     print(d.summary().replace("Plan:", "Apply complete:")
           .replace("to add", "added").replace("to change", "changed")
           .replace("to destroy", "destroyed"))
+    return 0
+
+
+def cmd_show(args) -> int:
+    """``tfsim show FILE``: render a saved plan (or a statefile) without
+    touching anything — the review half of the plan/apply contract."""
+    path = args.path
+    try:
+        if is_plan_file(path):
+            payload = load_plan_file(path)
+            if args.json:
+                print(json.dumps(payload, indent=2, sort_keys=True))
+                return 0
+            print(f"Saved plan for {payload['module_dir']} "
+                  f"(workspace {payload['workspace']!r}, against state "
+                  f"serial {payload['state_serial']}):")
+            d = Diff(actions=payload["actions"],
+                     changed_keys=payload["changed_keys"])
+            _print_plan_marks(d, payload["order"], show_noop=False)
+            print(d.summary())
+            return 0
+        try:
+            state = _load_state(path)
+        except (KeyError, TypeError, ValueError):
+            state = None
+        if state is None:
+            print(f"Error: {path!r} is neither a tfsim plan file nor a "
+                  f"statefile", file=sys.stderr)
+            return 1
+        if args.json:
+            print(state.to_json())
+            return 0
+        print(f"State serial {state.serial}: "
+              f"{len(state.resources)} resource(s), "
+              f"{len(state.outputs)} output(s)")
+        for addr in sorted(state.resources):
+            mark = " (tainted)" if addr in state.tainted else ""
+            print(f"  {addr}{mark}")
+        return 0
+    except (PlanFileError, ValueError) as ex:
+        print(f"Error: {ex}", file=sys.stderr)
+        return 1
+
+
+def cmd_refresh(args) -> int:
+    """``terraform refresh``: accept provider/drift reality into state
+    without proposing config changes. Offline that means re-rendering the
+    outputs block against the current state and reporting orphans."""
+    try:
+        plan, prior, state_path, _serial = _plan_against_state(args)
+        if prior is None:
+            print(f"Error: no state at {state_path!r} — nothing to refresh",
+                  file=sys.stderr)
+            return 1
+        n, state = _refresh_only_report(plan, prior)
+        if state_path and n:
+            _write_state(state_path, state)
+    except (PlanError, ValueError) as ex:
+        print(f"Error: {ex}", file=sys.stderr)
+        return 1
     return 0
 
 
@@ -423,7 +611,7 @@ def cmd_import(args) -> int:
         # same path as plan/apply — including moved{} migration: importing
         # a rename destination against un-migrated state would wedge the
         # statefile at the next plan ("destination already exists")
-        plan, prior, state_path = _plan_against_state(args)
+        plan, prior, state_path, _serial = _plan_against_state(args)
         if not state_path:
             print("Error: import requires -state (or a selected workspace) "
                   "to adopt into", file=sys.stderr)
@@ -731,9 +919,20 @@ def main(argv: list[str] | None = None) -> int:
     c.add_argument("-show-noop", action="store_true")
     c.add_argument("-target", action="append", dest="target")
     c.add_argument("-workspace", default=None)
+    c.add_argument("-out", default=None)
+    c.add_argument("-refresh-only", action="store_true", dest="refresh_only")
     a = add_module_cmd("apply", cmd_apply, state=True)
     a.add_argument("-target", action="append", dest="target")
     a.add_argument("-workspace", default=None)
+    a.add_argument("-refresh-only", action="store_true", dest="refresh_only")
+
+    sh = sub.add_parser("show")
+    sh.add_argument("path")
+    sh.add_argument("-json", action="store_true")
+    sh.set_defaults(fn=cmd_show)
+
+    rf = add_module_cmd("refresh", cmd_refresh, state=True)
+    rf.add_argument("-workspace", default=None)
     add_module_cmd("destroy", cmd_destroy)
     add_module_cmd("graph", cmd_graph)
     imp = add_module_cmd("import", cmd_import, state=True)

@@ -1,0 +1,133 @@
+"""Saved plan files: ``plan -out=FILE`` → ``show FILE`` → ``apply FILE``.
+
+The reference's documented operator flow is review-then-apply
+(``/root/reference/gke/README.md:45-49``: run ``terraform plan``, inspect,
+then ``terraform apply``). Real terraform makes that safe with plan files:
+what you apply is byte-for-byte what you reviewed, and a plan computed
+against stale state is refused ("saved plan is stale") instead of silently
+re-planning. tfsim implements the same contract offline:
+
+- the file records the fully-resolved plan (rendered instances, outputs,
+  apply order), the diff it showed the reviewer, the effective variables,
+  and the **serial of the state it was computed against**;
+- ``apply FILE`` re-loads the current state and refuses on serial drift —
+  terraform's stale-plan error — so the review can never be bypassed by a
+  concurrent apply;
+- ``show FILE`` renders the saved diff (or the raw JSON with ``-json``)
+  without touching state.
+
+The format is versioned JSON (``tfsim-plan/1``); forward-incompatible
+files are a clean error, not a KeyError.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from .plan import Plan, PlannedInstance, ResourceAttrs, render
+from .state import Diff, State
+
+PLAN_FORMAT = "tfsim-plan/1"
+
+
+class PlanFileError(ValueError):
+    pass
+
+
+def plan_file_payload(plan: Plan, d: Diff, disk_serial: int | None, *,
+                      module_dir: str, workspace: str,
+                      targets: list[str] | None) -> dict[str, Any]:
+    """The serializable record of a reviewed plan.
+
+    Instances are stored RENDERED (computed markers as strings) — the same
+    shape ``apply`` writes to state, so reconstruction round-trips.
+    ``disk_serial`` is the ON-DISK state serial (pre-``moved{}``
+    migration, which is in-memory and bumps nothing): both ends of the
+    stale check read the disk state before migrating, so the comparison
+    is like-for-like.
+    """
+    return {
+        "format": PLAN_FORMAT,
+        "module_dir": module_dir,
+        "workspace": workspace,
+        "targets": targets or [],
+        "variables": render(plan.variables),
+        # the stale-plan guard: what the diff was computed against
+        "state_serial": disk_serial,
+        "instances": {addr: render(dict(inst.attrs))
+                      for addr, inst in plan.instances.items()},
+        "outputs": render(plan.outputs),
+        "sensitive_outputs": sorted(plan.sensitive_outputs),
+        "order": plan.order,
+        "check_failures": plan.check_failures,
+        "actions": d.actions,
+        "changed_keys": d.changed_keys,
+    }
+
+
+def save_plan_file(path: str, payload: dict[str, Any]) -> None:
+    with open(path, "w") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def load_plan_file(path: str) -> dict[str, Any]:
+    try:
+        with open(path) as fh:
+            raw = json.load(fh)
+    except (OSError, json.JSONDecodeError) as ex:
+        raise PlanFileError(f"cannot read plan file {path!r}: {ex}") from ex
+    if not isinstance(raw, dict) or raw.get("format") != PLAN_FORMAT:
+        raise PlanFileError(
+            f"{path!r} is not a tfsim plan file (expected format "
+            f"{PLAN_FORMAT!r}, got {raw.get('format')!r})"
+        )
+    return raw
+
+
+def is_plan_file(path: str) -> bool:
+    """Sniff for apply's file-vs-module-dir positional.
+
+    Parses the whole file: plan files are small, and a prefix sniff is
+    wrong under ``sort_keys`` (the ``format`` key sorts after the
+    arbitrarily-large ``actions`` map)."""
+    try:
+        with open(path) as fh:
+            raw = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return False
+    return isinstance(raw, dict) and raw.get("format") == PLAN_FORMAT
+
+
+def plan_from_payload(payload: dict[str, Any]) -> Plan:
+    """Reconstruct a :class:`Plan` good enough for ``apply_plan``/``diff``.
+
+    Rendered attrs are what apply writes to state anyway (``render`` is
+    idempotent), so the reconstructed plan applies to the same state the
+    live plan would have produced.
+    """
+    return Plan(
+        module_path=payload["module_dir"],
+        instances={addr: PlannedInstance(addr, ResourceAttrs(attrs))
+                   for addr, attrs in payload["instances"].items()},
+        outputs=payload["outputs"],
+        edges=[],
+        order=payload["order"],
+        check_failures=payload["check_failures"],
+        sensitive_outputs=set(payload["sensitive_outputs"]),
+        variables=payload["variables"],
+    )
+
+
+def check_not_stale(payload: dict[str, Any], prior: State | None) -> None:
+    """Terraform's stale-plan contract: the state the plan was computed
+    against must be the state being applied to."""
+    saved = payload["state_serial"]
+    current = prior.serial if prior is not None else None
+    if saved != current:
+        raise PlanFileError(
+            f"saved plan is stale: it was computed against state serial "
+            f"{saved}, but the current state is serial {current} — "
+            f"run plan again and re-review"
+        )

@@ -327,6 +327,38 @@ def import_resource(state: State | None, plan: Plan, addr: str,
                  outputs=state.outputs, tainted=set(state.tainted))
 
 
+def refresh_state(plan: Plan, state: State | None
+                  ) -> tuple[State, list[str], list[str]]:
+    """``terraform refresh`` offline: re-render provider-readable facts
+    into state WITHOUT applying config changes.
+
+    The simulator has no cloud to poll, so "provider reality" is what the
+    plan can re-derive without touching resources: the ``output`` block
+    re-evaluated (outputs drift when the block or its inputs changed since
+    the last apply) and data sources re-read (they are never stored, so
+    re-reading is free). Resource attributes stay untouched — changing
+    them is ``apply``'s job. Returns ``(new_state, changed_output_names,
+    orphaned_addresses)``; the serial bumps iff outputs changed, and
+    orphans (state addresses gone from configuration — the thing a normal
+    apply would destroy) are reported, not removed.
+    """
+    if state is None:
+        return State(), [], []
+    fresh = {
+        name: {"value": render(value),
+               "sensitive": name in plan.sensitive_outputs}
+        for name, value in plan.outputs.items()
+    }
+    changed = sorted(
+        name for name in set(fresh) | set(state.outputs)
+        if fresh.get(name) != state.outputs.get(name))
+    orphans = sorted(set(state.resources) - set(_rendered_instances(plan)))
+    new_state = State(resources=dict(state.resources),
+                      serial=state.serial + (1 if changed else 0),
+                      outputs=fresh, tainted=set(state.tainted))
+    return new_state, changed, orphans
+
+
 def apply_plan(plan: Plan, state: State | None = None,
                targets: list[str] | None = None, *,
                d: Diff | None = None) -> State:

@@ -170,3 +170,30 @@ def test_multislice_example_tftest_suite():
     results = run_tests(os.path.join(ROOT, "gke-tpu/examples/multislice"))
     assert results and all(r.ok for r in results), [
         (r.path, [(x.name, x.failures) for x in r.runs]) for r in results]
+
+
+@pytest.mark.parametrize("path", [
+    "gke/examples/cnpack",
+    "gke-tpu/examples/cnpack",
+    "gke-tpu/examples/multislice",
+])
+def test_examples_apply_from_saved_plan(path, tmp_path, capsys):
+    """The documented operator flow, file-mediated: every example plans to
+    a file and applies FROM that file (what was reviewed is what runs) —
+    CI's version of the reference's plan-then-apply runbook
+    (/root/reference/gke/README.md:45-49)."""
+    from nvidia_terraform_modules_tpu.tfsim.__main__ import main
+
+    state = str(tmp_path / "s.json")
+    pfile = str(tmp_path / "p.tfplan")
+    mod = os.path.join(ROOT, path)
+    assert main(["plan", mod, "-state", state, "-out", pfile,
+                 "-var", "project_id=proj-ci"]) == 0
+    assert main(["apply", pfile, "-state", state]) == 0
+    out = capsys.readouterr().out
+    assert "Apply complete:" in out and " 0 destroyed" in out
+    # the applied state is exactly the reviewed plan: a re-plan is a no-op
+    assert main(["plan", mod, "-state", state,
+                 "-var", "project_id=proj-ci"]) == 0
+    assert "Plan: 0 to add, 0 to change, 0 to destroy." in \
+        capsys.readouterr().out
