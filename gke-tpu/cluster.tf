@@ -67,6 +67,25 @@ resource "google_container_cluster" "this" {
     workload_pool = "${var.project_id}.svc.id.goog"
   }
 
+  # CMEK secrets-at-rest (reference EKS parity — see security.tf); the
+  # provider default is Google-managed encryption, so the block only
+  # renders when the operator opted in
+  dynamic "database_encryption" {
+    for_each = var.database_encryption.enabled ? [1] : []
+    content {
+      state    = "ENCRYPTED"
+      key_name = local.secrets_kms_key
+    }
+  }
+
+  # Google Groups for RBAC (reference AKS admin-groups parity)
+  dynamic "authenticator_groups_config" {
+    for_each = var.authenticator_security_group == null ? [] : [var.authenticator_security_group]
+    content {
+      security_group = authenticator_groups_config.value
+    }
+  }
+
   dynamic "cluster_autoscaling" {
     for_each = var.node_auto_provisioning.enabled ? [1] : []
     content {
@@ -88,6 +107,11 @@ resource "google_container_cluster" "this" {
     update = "30m"
     delete = "45m"
   }
+
+  # CMEK needs the service-agent grant BEFORE control-plane creation —
+  # the key reference alone orders only against the key, and a cluster
+  # racing ahead of the IAM member fails with CloudKMS access denied
+  depends_on = [google_kms_crypto_key_iam_member.gke_agent]
 }
 
 resource "google_container_node_pool" "cpu" {

@@ -51,3 +51,72 @@ run "cpu_only" {
     error_message = "operator disabled must plan no namespace"
   }
 }
+
+# Control-plane security: CMEK secrets encryption (reference EKS
+# eks/main.tf:64-72 parity) and Google Groups RBAC (reference AKS
+# aks/main.tf:36-40 parity).
+run "secrets_encryption_creates_key_and_grant" {
+  command = plan
+
+  variables {
+    database_encryption          = { enabled = true }
+    authenticator_security_group = "gke-security-groups@example.com"
+  }
+
+  assert {
+    condition     = google_container_cluster.this.database_encryption[0].state == "ENCRYPTED"
+    error_message = "enabled CMEK must render an ENCRYPTED database_encryption block"
+  }
+  assert {
+    condition     = length(google_kms_key_ring.secrets) == 1 && length(google_kms_crypto_key.secrets) == 1
+    error_message = "no BYO key: the module must create keyring + crypto key"
+  }
+  assert {
+    condition     = google_kms_crypto_key.secrets[0].rotation_period == "7776000s"
+    error_message = "created key must rotate (reference enable_key_rotation parity)"
+  }
+  assert {
+    condition     = length(google_kms_crypto_key_iam_member.gke_agent) == 1
+    error_message = "the GKE service agent needs EncrypterDecrypter on the key"
+  }
+  assert {
+    condition     = google_container_cluster.this.authenticator_groups_config[0].security_group == "gke-security-groups@example.com"
+    error_message = "the RBAC umbrella group must reach the control plane"
+  }
+}
+
+run "secrets_encryption_byo_key" {
+  command = plan
+
+  variables {
+    database_encryption = {
+      enabled      = true
+      kms_key_name = "projects/p/locations/r/keyRings/kr/cryptoKeys/k"
+    }
+  }
+
+  assert {
+    condition     = length(google_kms_key_ring.secrets) == 0 && length(google_kms_crypto_key.secrets) == 0
+    error_message = "BYO key must not create module-owned KMS resources"
+  }
+  assert {
+    condition     = google_container_cluster.this.database_encryption[0].key_name == "projects/p/locations/r/keyRings/kr/cryptoKeys/k"
+    error_message = "the BYO key must reach the cluster block verbatim"
+  }
+}
+
+# An unrendered dynamic block reads as provider-computed in the simulator,
+# so "defaults off" is asserted through the countable module-owned
+# resources the feature would have created.
+run "security_defaults_off" {
+  command = plan
+
+  assert {
+    condition     = length(google_kms_key_ring.secrets) == 0 && length(google_kms_crypto_key.secrets) == 0
+    error_message = "no KMS resources unless encryption is enabled"
+  }
+  assert {
+    condition     = length(google_kms_crypto_key_iam_member.gke_agent) == 0
+    error_message = "no service-agent grant unless encryption is enabled"
+  }
+}

@@ -129,3 +129,43 @@ variable "gpu_operator" {
   })
   default = {}
 }
+
+# ----------------------------------------------------- control-plane security
+
+variable "database_encryption" {
+  description = <<-EOT
+    Application-layer encryption of Kubernetes secrets in etcd with a
+    Cloud KMS key (CMEK) — the GKE analogue of the reference EKS module's
+    KMS secret encryption (eks/main.tf:64-72). With enabled = true and no
+    kms_key_name, the module creates a keyring + key (rotation like the
+    reference's enable_key_rotation) and grants the GKE service agent
+    use of it; bring your own key via kms_key_name.
+  EOT
+  type = object({
+    enabled             = optional(bool, false)
+    kms_key_name        = optional(string)
+    key_rotation_period = optional(string, "7776000s") # 90 days
+  })
+  default = {}
+
+  validation {
+    condition     = var.database_encryption.enabled || var.database_encryption.kms_key_name == null
+    error_message = "database_encryption.kms_key_name without enabled = true would silently not encrypt — enable it or drop the key."
+  }
+}
+
+variable "authenticator_security_group" {
+  description = <<-EOT
+    Google Groups for RBAC: the gke-security-groups@<your-domain> umbrella
+    group wired into the control plane so RoleBindings can name Google
+    groups — the GKE analogue of AKS admin-group RBAC
+    (aks/main.tf:36-40). null leaves group authentication off.
+  EOT
+  type    = string
+  default = null
+
+  validation {
+    condition     = (var.authenticator_security_group == null || startswith(coalesce(var.authenticator_security_group, "-"), "gke-security-groups@"))
+    error_message = "GKE requires the umbrella group to be named gke-security-groups@<your-domain>."
+  }
+}

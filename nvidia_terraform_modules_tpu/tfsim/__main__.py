@@ -265,12 +265,12 @@ def cmd_plan(args) -> int:
         if getattr(args, "out", None):
             save_plan_file(args.out, plan_file_payload(
                 plan, d, disk_serial, module_dir=os.path.abspath(args.dir),
-                workspace=_workspace_of(args),
+                workspace=_workspace_of(args), state_path=state_path,
                 targets=getattr(args, "target", None)))
             print(f'Saved the plan to: {args.out}\n'
                   f'To perform exactly these actions, run:\n'
                   f'  tfsim apply {args.out}', file=sys.stderr)
-    except (PlanError, PlanFileError, ValueError) as ex:
+    except (PlanError, PlanFileError, ValueError, OSError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
         return 1
     if args.json:
@@ -298,16 +298,18 @@ def _apply_saved_plan(args) -> int:
     saved actions exactly (a drifted module/moved{} set is an error, not
     a silently different apply).
     """
-    if args.var or args.var_file or getattr(args, "target", None):
-        print("Error: -var/-var-file/-target cannot be combined with a "
-              "saved plan file (the plan is already resolved)",
-              file=sys.stderr)
+    if args.var or args.var_file or getattr(args, "target", None) or \
+            getattr(args, "refresh_only", False) or \
+            getattr(args, "workspace", None):
+        print("Error: -var/-var-file/-target/-refresh-only/-workspace "
+              "cannot be combined with a saved plan file (the plan is "
+              "already resolved and pinned to its state)", file=sys.stderr)
         return 2
     payload = load_plan_file(args.dir)
     plan = plan_from_payload(payload)
-    state_path = resolve_state_path(
-        payload["module_dir"], args.state,
-        payload["workspace"] if payload["workspace"] != "default" else None)
+    # explicit -state wins; otherwise the file's RECORDED resolution — the
+    # currently-selected workspace must not retarget a reviewed plan
+    state_path = args.state or payload["state_path"]
     prior = _load_state(state_path)
     check_not_stale(payload, prior)
     if prior is not None:
@@ -635,8 +637,13 @@ def cmd_destroy(args) -> int:
         print(f"  - {addr}")
     for h in d.hazards:
         print(f"HAZARD: {h.describe()}", file=sys.stderr)
-    print(f"Destroy: {len(d.order)} to destroy, {len(d.hazards)} hazard(s).")
-    return 1 if d.hazards else 0
+    for addr in d.refusals:
+        print(f"REFUSED: {addr} has lifecycle.prevent_destroy — terraform "
+              f"will not destroy it (edit the module or `state rm` it "
+              f"first)", file=sys.stderr)
+    print(f"Destroy: {len(d.order)} to destroy, {len(d.hazards)} hazard(s), "
+          f"{len(d.refusals)} refusal(s).")
+    return 1 if d.hazards or d.refusals else 0
 
 
 def _tf_files(paths: list[str]) -> list[str]:

@@ -55,10 +55,14 @@ class DestroyHazard:
 class DestroyPlan:
     order: list[str]            # destroy order over managed resource nodes
     hazards: list[DestroyHazard]
+    # addresses with lifecycle.prevent_destroy AND >=1 planned instance:
+    # real terraform hard-refuses the destroy until the operator edits the
+    # module or `state rm`s them, so the simulator must refuse too
+    refusals: list[str] = dataclasses.field(default_factory=list)
 
     @property
     def ok(self) -> bool:
-        return not self.hazards
+        return not self.hazards and not self.refusals
 
 
 def _transitive_deps(edges: list[tuple[str, str]]) -> dict[str, set[str]]:
@@ -84,6 +88,18 @@ def _transitive_deps(edges: list[tuple[str, str]]) -> dict[str, set[str]]:
     for n in set(direct) | {t for _, t in edges}:
         walk(n, set())
     return closed
+
+
+def _prevent_destroy(r: Resource) -> bool:
+    """Literal ``lifecycle { prevent_destroy = true }`` on a resource."""
+    for b in r.body.blocks:
+        if b.type != "lifecycle":
+            continue
+        a = b.body.attr("prevent_destroy")
+        if a is not None and isinstance(a.expr, A.Literal) and \
+                a.expr.value is True:
+            return True
+    return False
 
 
 def _provider_key(r: Resource) -> str:
@@ -130,7 +146,12 @@ def _analyze_module(module: Module, plan: Plan, *, prefix: str = "",
 
     closure = _transitive_deps(plan.edges)
     hazards: list[DestroyHazard] = []
+    refusals: list[str] = []
     for addr in managed:
+        if _prevent_destroy(module.resources[addr]) and any(
+                ia == addr or ia.startswith(addr + "[")
+                for ia in plan.instances):
+            refusals.append(prefix + addr)
         pkey = _provider_key(module.resources[addr])
         deps = closure.get(addr, set())
         missing: set[str] = set()
@@ -182,9 +203,10 @@ def _analyze_module(module: Module, plan: Plan, *, prefix: str = "",
                     module_cache=module_cache)
                 order.extend(child.order)
                 hazards.extend(child.hazards)
+                refusals.extend(child.refusals)
             continue
         order.append(prefix + addr)
-    return DestroyPlan(order=order, hazards=hazards)
+    return DestroyPlan(order=order, hazards=hazards, refusals=refusals)
 
 
 def simulate_destroy(

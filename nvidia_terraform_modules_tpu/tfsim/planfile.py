@@ -23,6 +23,7 @@ files are a clean error, not a KeyError.
 from __future__ import annotations
 
 import json
+import os
 from typing import Any
 
 from .plan import Plan, PlannedInstance, ResourceAttrs, render
@@ -37,6 +38,7 @@ class PlanFileError(ValueError):
 
 def plan_file_payload(plan: Plan, d: Diff, disk_serial: int | None, *,
                       module_dir: str, workspace: str,
+                      state_path: str | None,
                       targets: list[str] | None) -> dict[str, Any]:
     """The serializable record of a reviewed plan.
 
@@ -51,6 +53,12 @@ def plan_file_payload(plan: Plan, d: Diff, disk_serial: int | None, *,
         "format": PLAN_FORMAT,
         "module_dir": module_dir,
         "workspace": workspace,
+        # the RESOLVED statefile the plan was computed against (absolute;
+        # None = stateless legacy mode). apply FILE uses this verbatim —
+        # re-resolving through the currently-selected workspace could
+        # silently retarget the reviewed plan at a different statefile
+        "state_path": (os.path.abspath(state_path)
+                       if state_path is not None else None),
         "targets": targets or [],
         "variables": render(plan.variables),
         # the stale-plan guard: what the diff was computed against

@@ -107,6 +107,8 @@ SCHEMAS: dict[str, BlockSchema] = {
         blocks={
             "release_channel": _bs(req="channel"),
             "workload_identity_config": _bs("workload_pool"),
+            "database_encryption": _bs("key_name", req="state"),
+            "authenticator_groups_config": _bs(req="security_group"),
             "ip_allocation_policy": _bs(
                 "cluster_secondary_range_name services_secondary_range_name "
                 "cluster_ipv4_cidr_block services_ipv4_cidr_block stack_type"),
@@ -173,6 +175,15 @@ SCHEMAS: dict[str, BlockSchema] = {
         }),
     "google_project_iam_member": _bs(
         req="project role member",
+        blocks={"condition": _bs("description", req="title expression")}),
+    "google_kms_key_ring": _bs("project", req="name location"),
+    "google_kms_crypto_key": _bs(
+        "rotation_period purpose labels destroy_scheduled_duration "
+        "import_only skip_initial_version_creation",
+        req="name key_ring",
+        blocks={"version_template": _bs("algorithm protection_level")}),
+    "google_kms_crypto_key_iam_member": _bs(
+        req="crypto_key_id role member",
         blocks={"condition": _bs("description", req="title expression")}),
     "google_service_account": _bs(
         "display_name description project disabled create_ignore_already_exists",

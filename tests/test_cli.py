@@ -66,7 +66,7 @@ def test_apply_plan_roundtrip_via_statefile(tmp_path, capsys):
 def test_destroy_reports_order_and_exit(capsys):
     assert main(["destroy", GKE_TPU] + VARS) == 0
     out = capsys.readouterr().out
-    assert "Destroy: 11 to destroy, 0 hazard(s)." in out
+    assert "Destroy: 14 to destroy, 0 hazard(s), 0 refusal(s)." in out
     assert out.strip().splitlines()[-2].strip() == "- google_compute_network.vpc"
 
 
@@ -792,3 +792,83 @@ def test_apply_saved_plan_module_dir_gone_is_clean_error(tmp_path, capsys):
     capsys.readouterr()
     assert main(["apply", pfile, "-state", state]) == 1
     assert "Error:" in capsys.readouterr().err
+
+
+def test_destroy_refuses_prevent_destroy_instances(tmp_path, capsys):
+    """Real terraform hard-refuses destroying a prevent_destroy resource;
+    the simulator must report the refusal, not '0 hazard(s)' (review
+    finding, round 3 — first prevent_destroy entered the modules)."""
+    import textwrap
+
+    mod = tmp_path / "mod"
+    mod.mkdir()
+    (mod / "main.tf").write_text(textwrap.dedent("""
+        resource "google_compute_network" "keep" {
+          name = "n"
+          lifecycle {
+            prevent_destroy = true
+          }
+        }
+    """))
+    assert main(["destroy", str(mod)]) == 1
+    captured = capsys.readouterr()
+    assert "REFUSED" in captured.err and "prevent_destroy" in captured.err
+    assert "1 refusal(s)" in captured.out
+
+
+def test_destroy_ignores_prevent_destroy_on_uninstantiated(capsys):
+    """The gke modules declare a prevent_destroy KMS key behind
+    count = encryption.enabled; with encryption off it has no instances
+    and must not block destroy."""
+    assert main(["destroy", GKE_TPU] + VARS) == 0
+    assert "0 refusal(s)" in capsys.readouterr().out
+
+
+def test_gke_destroy_refuses_when_encryption_enabled(capsys):
+    assert main(["destroy", GKE_TPU, "-var",
+                 'database_encryption={"enabled": true}'] + VARS) == 1
+    captured = capsys.readouterr()
+    assert "google_kms_crypto_key.secrets" in captured.err
+
+
+def test_plan_out_unwritable_path_clean_error(tmp_path, capsys):
+    assert main(["plan", GKE_TPU, "-state", str(tmp_path / "s.json"),
+                 "-out", "/nonexistent-dir/p.tfplan"] + VARS) == 1
+    assert "Error:" in capsys.readouterr().err
+
+
+def test_apply_saved_plan_rejects_refresh_only_and_workspace(tmp_path, capsys):
+    state = str(tmp_path / "s.json")
+    pfile = str(tmp_path / "p.tfplan")
+    assert main(["plan", GKE_TPU, "-state", state, "-out", pfile] + VARS) == 0
+    capsys.readouterr()
+    assert main(["apply", pfile, "-refresh-only"]) == 2
+    assert "cannot be combined" in capsys.readouterr().err
+
+
+def test_saved_plan_pins_resolved_statefile(tmp_path, capsys):
+    """apply FILE targets the statefile the plan resolved, not whatever
+    workspace is selected at apply time (review finding, round 3)."""
+    import textwrap
+
+    mod = tmp_path / "mod"
+    mod.mkdir()
+    (mod / "main.tf").write_text(textwrap.dedent("""
+        resource "google_compute_network" "vpc" {
+          name = "n"
+        }
+    """))
+    pfile = str(tmp_path / "p.tfplan")
+    # workspaces on; review the plan while STAGING is selected
+    assert main(["workspace", "new", str(mod), "staging"]) == 0
+    assert main(["plan", str(mod), "-out", pfile]) == 0
+    payload = json.loads(open(pfile).read())
+    assert payload["state_path"] and "staging" in payload["state_path"]
+    # an operator switches workspace between review and apply
+    assert main(["workspace", "select", str(mod), "default"]) == 0
+    capsys.readouterr()
+    assert main(["apply", pfile]) == 0
+    # STAGING's statefile (the reviewed one) got the resources
+    assert os.path.exists(payload["state_path"])
+    assert "google_compute_network.vpc" in \
+        json.load(open(payload["state_path"]))["resources"]

@@ -191,3 +191,41 @@ resource "google_storage_bucket" "b" {
 def test_repo_modules_pass_schema_check(moddir):
     findings = validate_module(load_module(os.path.join(ROOT, moddir)))
     assert [str(f) for f in findings] == []
+
+
+def test_database_encryption_block_typos_caught(tmp_path):
+    """The round-2 VERDICT item 4 'done' bar: schema validate catches
+    typos INSIDE the new security blocks."""
+    errs = _errors(_validate(tmp_path, """
+resource "google_container_cluster" "c" {
+  name = "c"
+  database_encryption {
+    state   = "ENCRYPTED"
+    ky_name = "k"
+  }
+  authenticator_groups_config {
+    security_groups = "gke-security-groups@x.com"
+  }
+}
+"""))
+    assert any("unsupported attribute 'ky_name'" in e for e in errs), errs
+    assert any("'security_groups'" in e for e in errs), errs
+    assert any("missing required attribute 'security_group'" in e
+               for e in errs), errs
+
+
+def test_kms_resources_schema_checked(tmp_path):
+    errs = _errors(_validate(tmp_path, """
+resource "google_kms_crypto_key" "k" {
+  name             = "k"
+  key_ring         = "kr"
+  rotation_periodd = "7776000s"
+}
+
+resource "google_kms_key_ring" "kr" {
+  name = "kr"
+}
+"""))
+    assert any("'rotation_periodd'" in e for e in errs), errs
+    assert any("missing required attribute 'location'" in e
+               for e in errs), errs
