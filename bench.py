@@ -207,7 +207,12 @@ def _time_decode(decoder, prefiller, params, prompt, n_new: int):
         toks = prefiller(params, prompt)
     sync(toks)
     t_prefill = (time.perf_counter() - t0) / iters
-    step_seconds = max(t_total - t_prefill, 1e-9) / (n_new - 1)
+    step_seconds = (t_total - t_prefill) / (n_new - 1)
+    if step_seconds <= 0:
+        # jitter swamped the two-point subtraction (tiny CPU shapes):
+        # fall back to the bounded single-point estimate — conservative
+        # (includes prefill cost per step), never a nonsense huge rate
+        step_seconds = t_total / n_new
     return step_seconds, t_prefill
 
 
@@ -230,7 +235,13 @@ def section_decode() -> dict:
 
 def section_decode_int8() -> dict:
     """Weight-only int8 serving: same decode, weights int8-resident in HBM
-    (the decode regime is weight-bandwidth-bound, so this is the lever)."""
+    (the decode regime is weight-bandwidth-bound, so this is the lever).
+
+    Measures BOTH int8 paths so the pallas fusion's value is a captured
+    number: ``fused`` (int8 tiles dequantized in-kernel — int8 bytes per
+    step by construction) and ``unfused`` (whole-tree dequant inside the
+    jit — per-step traffic left to XLA's loop-invariant-materialisation
+    choice, the pre-kernel design)."""
     from nvidia_terraform_modules_tpu.models import (
         make_quantized_decoder,
         quantize_params,
@@ -239,15 +250,22 @@ def section_decode_int8() -> dict:
     dec_cfg, params, prompt, prompt_len, n_new = _decode_setup()
     max_len = prompt_len + n_new
     qparams = quantize_params(params, dtype=dec_cfg.dtype)
-    q_decoder = make_quantized_decoder(dec_cfg, n_new=n_new, max_len=max_len,
-                                       dtype=dec_cfg.dtype)
-    # int8 prefill twin: the quantized program's own prefill cost —
-    # subtracting the bf16 twin's would fold the dequant/prefill delta into
-    # the per-step estimate and skew the side-by-side numbers
-    q_prefiller = make_quantized_decoder(dec_cfg, n_new=1, max_len=max_len,
-                                         dtype=dec_cfg.dtype)
-    step_s, _ = _time_decode(q_decoder, q_prefiller, qparams, prompt, n_new)
-    return {"decode_int8_tokens_per_s": round(dec_cfg.batch / step_s, 1)}
+    out = {}
+    for key, fused in (("decode_int8_tokens_per_s", True),
+                       ("decode_int8_unfused_tokens_per_s", False)):
+        q_decoder = make_quantized_decoder(
+            dec_cfg, n_new=n_new, max_len=max_len, dtype=dec_cfg.dtype,
+            fused=fused)
+        # int8 prefill twin: the quantized program's own prefill cost —
+        # subtracting the bf16 twin's would fold the dequant/prefill delta
+        # into the per-step estimate and skew the side-by-side numbers
+        q_prefiller = make_quantized_decoder(
+            dec_cfg, n_new=1, max_len=max_len, dtype=dec_cfg.dtype,
+            fused=fused)
+        step_s, _ = _time_decode(q_decoder, q_prefiller, qparams, prompt,
+                                 n_new)
+        out[key] = round(dec_cfg.batch / step_s, 1)
+    return out
 
 
 def section_longctx() -> dict:

@@ -25,8 +25,9 @@ from repeatedly running the full ``burnin.forward`` on the growing
 sequence (``tests/test_decode.py``) — the cache is an optimisation, never
 a different model. The flash prefill (default for long-context configs)
 matches within kernel float tolerance instead, the same numerics the
-config trained with. MoE configs are rejected for now (routing a single token
-through the capacity machinery is a different serving problem).
+config trained with. MoE configs serve through training's routed layer at
+drop-free capacity (``models/moe.py``), so the exactness contract extends
+to them whenever the training-side capacity factor also avoids drops.
 """
 
 from __future__ import annotations
@@ -43,14 +44,58 @@ from .burnin import BurnInConfig, apply_rope
 
 
 def _check_cfg(cfg: BurnInConfig) -> None:
-    if cfg.n_experts > 0:
-        raise ValueError(
-            "KV-cache decode supports the dense FFN only (MoE serving is a "
-            "separate problem: per-token routing without capacity batching)")
     # any cfg.attn is servable: the config's attn names the TRAINING
     # layout; decode uses its own cached attention, with the pallas flash
     # kernel doing the prompt prefill whenever the length tiles (so the
-    # long-context configs don't hit a dense [B,H,T,S_max] score OOM)
+    # long-context configs don't hit a dense [B,H,T,S_max] score OOM).
+    # MoE configs serve through the same routed layer as training, at
+    # DROP-FREE capacity (models/moe.py: capacity drops are a training
+    # trade; at serve time they would make routing depend on batch size
+    # and break the cached == full-re-forward exactness contract)
+    del cfg
+
+
+_MOE_PREFILL_CHUNK = 128   # tokens per routed chunk along the seq dim
+
+
+def _moe_ffn(h, layer, cfg: BurnInConfig, rules):
+    """Routed FFN for the serve path: training's moe_layer at drop-free
+    capacity. Routing is per-token and position-independent, so cached
+    decode and full re-forward route identically. The ep constraint only
+    applies when the serving mesh actually has an expert axis.
+
+    Long prompts are routed in fixed chunks along the sequence: the
+    GShard dispatch tensor is ``[T, E, C]`` and drop-free C grows with T,
+    so one-shot prefill routing would be O(T²) HBM — the dense blow-up
+    the flash prefill exists to avoid. With drop-free capacity, routing
+    is independent per token, so chunking changes memory, never results
+    (padding tokens get slots of their own and are sliced away)."""
+    from .moe import drop_free_capacity, moe_layer
+
+    b, t, d = h.shape
+    moe_rules = rules if (rules is not None
+                          and rules.mesh.shape.get("ep", 1) > 1) else None
+
+    def routed(x):
+        bb, tt, _ = x.shape
+        out, _aux = moe_layer(
+            x, layer["moe"], cfg, moe_rules,
+            capacity=drop_free_capacity(bb * tt * cfg.router_top_k))
+        return out
+
+    if t <= _MOE_PREFILL_CHUNK:
+        return routed(h)
+    n = -(-t // _MOE_PREFILL_CHUNK)
+    pad = n * _MOE_PREFILL_CHUNK - t
+    hp = jnp.pad(h, ((0, 0), (0, pad), (0, 0))) if pad else h
+    chunks = hp.reshape(b, n, _MOE_PREFILL_CHUNK, d).swapaxes(0, 1)
+
+    def body(_, xc):
+        return None, routed(xc)
+
+    _, outs = jax.lax.scan(body, None, chunks)
+    out = outs.swapaxes(0, 1).reshape(b, n * _MOE_PREFILL_CHUNK, d)
+    return out[:, :t]
 
 
 def init_cache(cfg: BurnInConfig, batch: int, max_len: int,
@@ -194,9 +239,12 @@ def forward_cached(params, tokens, cache, cfg: BurnInConfig,
         x = x + act(attn @ layer["wo"], None, None)
 
         h = _rmsnorm(x, layer["mlp_norm"])
-        h = jax.nn.gelu((h @ layer["up"]).astype(jnp.float32)).astype(cfg.dtype)
-        h = act(h, None, "tp")
-        x = x + act(h @ layer["down"], None, None)
+        if cfg.n_experts > 0:
+            x = x + act(_moe_ffn(h, layer, cfg, rules), None, None)
+        else:
+            h = jax.nn.gelu((h @ layer["up"]).astype(jnp.float32)).astype(cfg.dtype)
+            h = act(h, None, "tp")
+            x = x + act(h @ layer["down"], None, None)
 
     x = _rmsnorm(x, params["out_norm"])
     logits = x @ params["embed"].T

@@ -46,6 +46,18 @@ def expert_capacity(tokens: int, n_experts: int,
     return max(8, math.ceil(cap / 8) * 8)
 
 
+def drop_free_capacity(assignments: int) -> int:
+    """Capacity at which NO assignment can overflow (worst case: every
+    token routes to one expert). The SERVING capacity: capacity drops are
+    a training-time load-balancing trade, but a dropped token at decode
+    silently changes the model — and drop behaviour depends on the total
+    token count, which would break the cached-decode ==
+    full-re-forward exactness contract (capacity grows with sequence
+    length, so a prefill-dropped token could fit in the longer full
+    forward)."""
+    return max(8, math.ceil(assignments / 8) * 8)
+
+
 def init_moe_params(rng, cfg) -> dict[str, Any]:
     """Router + stacked expert FFN weights ([E, ...] leading expert dim)."""
     kr, ku, kd = jax.random.split(rng, 3)
@@ -63,7 +75,7 @@ def init_moe_params(rng, cfg) -> dict[str, Any]:
     }
 
 
-def moe_layer(x, params, cfg, rules=None):
+def moe_layer(x, params, cfg, rules=None, *, capacity: int | None = None):
     """Top-k MoE FFN (k = ``cfg.router_top_k``); returns ([B,S,D], aux).
 
     Dispatch/combine follow GShard: a dense [T, E, C] one-hot tensor
@@ -77,6 +89,10 @@ def moe_layer(x, params, cfg, rules=None):
     capacity slots AFTER every rank<r assignment (each expert's counter
     is offset by the lower ranks' totals), so a full expert drops its
     second-choice tokens first — the standard GShard priority.
+
+    ``capacity`` overrides the factor-derived per-expert slot count —
+    the serving path passes :func:`drop_free_capacity` so routing never
+    depends on how many tokens happen to share the batch.
     """
     B, S, D = x.shape
     E = cfg.n_experts
@@ -86,7 +102,8 @@ def moe_layer(x, params, cfg, rules=None):
     # expert (GShard's k-scaled capacity) — without the K factor, top-2
     # under the default factor would drop ~37% of assignments at uniform
     # load and quietly degrade toward top-1
-    C = expert_capacity(T * K, E, cfg.capacity_factor)
+    C = capacity if capacity is not None else \
+        expert_capacity(T * K, E, cfg.capacity_factor)
 
     tokens = x.reshape(T, D)
     logits = tokens.astype(jnp.float32) @ params["router"]     # [T, E]

@@ -93,6 +93,12 @@ class QTensor:
         return QTensor(self.q, self.scale, scale_axis=self.scale_axis,
                        dtype=self.dtype, transposed=not self.transposed)
 
+    def dequantize(self):
+        """Dense tensor in STORAGE orientation — the one definition of
+        int8→dense both the unfused serve path and any tooling share."""
+        shape = (-1, 1) if self.scale_axis == 0 else (1, -1)
+        return dequantize(self.q, self.scale.reshape(shape), self.dtype)
+
     def __getitem__(self, idx):
         if self.transposed:
             raise TypeError("gather on a transposed QTensor is not a "
@@ -153,7 +159,13 @@ def quantize_params(params, dtype=jnp.bfloat16):
     """
 
     def leaf(path, x):
-        if getattr(x, "ndim", 0) < 2:
+        # matmul (@-consumed) weights are exactly the 2-D leaves; the MoE
+        # router stays f32 (tiny, and routing decisions are
+        # precision-sensitive), and 3-D expert stacks stay dense — their
+        # einsum consumers don't route through QTensor (an int8 expert
+        # einsum kernel is a separate lever)
+        if getattr(x, "ndim", 0) != 2 or any(
+                "router" in str(k) for k in path):
             return x
         is_embed = any("embed" in str(k) for k in path)
         axis = 0 if is_embed else -1
@@ -229,21 +241,35 @@ def quantized_nbytes(qparams) -> int:
 def make_quantized_decoder(cfg: BurnInConfig,
                            rules: ShardingRules | None = None,
                            n_new: int = 32, max_len: int | None = None,
-                           dtype=jnp.bfloat16):
+                           dtype=jnp.bfloat16, fused: bool = True):
     """Compiled greedy decoder over int8-resident weights:
     ``decoder(qparams, prompt) → [B, n_new]`` with ``qparams`` from
     :func:`quantize_params`. The decode program is the stock
     ``greedy_decode`` — QTensor leaves route every weight matmul through
     the fused int8 kernel, so int8 bytes cross HBM on every step.
 
+    ``fused=False`` instead dequantizes the whole tree at the top of the
+    jit (the pre-kernel design) and leaves per-step weight traffic to
+    XLA's loop-invariant-materialisation choice — kept so ``bench.py``
+    can measure the fusion win as a number, not a claim.
+
     ``dtype`` is the expected compute dtype and must MATCH the one the
     QTensor leaves were built with (compute dtype is a property of the
     params, set in :func:`quantize_params`) — a mismatch errors loudly
     rather than silently computing in the params' dtype."""
     expected = jnp.dtype(dtype)
-    jitted = jax.jit(
-        lambda qparams, prompt: greedy_decode(qparams, prompt, n_new, cfg,
-                                              rules, max_len=max_len))
+    if fused:
+        def run(qparams, prompt):
+            return greedy_decode(qparams, prompt, n_new, cfg, rules,
+                                 max_len=max_len)
+    else:
+        def run(qparams, prompt):
+            params = jax.tree.map(
+                lambda x: x.dequantize() if isinstance(x, QTensor) else x,
+                qparams, is_leaf=lambda x: isinstance(x, QTensor))
+            return greedy_decode(params, prompt, n_new, cfg, rules,
+                                 max_len=max_len)
+    jitted = jax.jit(run)
 
     def decoder(qparams, prompt):
         qleaves = [leaf for leaf in jax.tree.leaves(

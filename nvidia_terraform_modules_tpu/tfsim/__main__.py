@@ -9,7 +9,7 @@ terraform binary in CI, so tfsim ships the same verbs offline::
     python -m nvidia_terraform_modules_tpu.tfsim validate gke-tpu
     python -m nvidia_terraform_modules_tpu.tfsim plan gke-tpu -var project_id=p \
         -var cluster_name=c [-state terraform.tfstate.json] [-json] [-target ADDR] \
-        [-out plan.tfplan] [-refresh-only]
+        [-out plan.tfplan] [-refresh-only] [-destroy]
     python -m nvidia_terraform_modules_tpu.tfsim apply gke-tpu ... -state f [-target ADDR]
     python -m nvidia_terraform_modules_tpu.tfsim apply plan.tfplan   # saved-plan apply
     python -m nvidia_terraform_modules_tpu.tfsim show plan.tfplan [-json]
@@ -251,17 +251,76 @@ def _refresh_only_print(plan, prior, args) -> int:
     return 0
 
 
+def _resource_block_for(mod, addr: str, cache: dict):
+    """Resource block for a (possibly ``module.``-prefixed) state address,
+    descending local child modules the way state addresses nest."""
+    while addr.startswith("module."):
+        parts = addr.split(".", 2)
+        if len(parts) < 3:
+            return None
+        name, addr = parts[1].split("[")[0], parts[2]
+        mc = mod.module_calls.get(name)
+        src_attr = mc.body.attr("source") if mc is not None else None
+        src_val = getattr(getattr(src_attr, "expr", None), "value", None)
+        if not isinstance(src_val, str):
+            return None
+        child_path = os.path.normpath(os.path.join(mod.path, src_val))
+        if child_path not in cache:
+            try:
+                cache[child_path] = load_module(child_path)
+            except Exception:  # noqa: BLE001 — missing child: no refusal info
+                return None
+        mod = cache[child_path]
+    return mod.resources.get(addr.split("[")[0])
+
+
+def _destroy_plan_of(plan, prior, module_dir: str):
+    """``plan -destroy``: the state-driven teardown plan (terraform's
+    ``apply -destroy`` flow, distinct from the config-driven ``destroy``
+    verb's hazard analysis): an empty desired config diffed against
+    state plans exactly the deletes. Refuses when a to-be-deleted
+    address — at any module depth — carries ``lifecycle.prevent_destroy``
+    in current config, the same hard stop real terraform gives."""
+    from .destroy import _prevent_destroy
+    from .plan import Plan as _Plan
+
+    if prior is None or not prior.resources:
+        raise PlanError("nothing to destroy: state is empty")
+    empty = _Plan(module_path=plan.module_path, instances={}, outputs={},
+                  edges=[], order=[], variables=plan.variables)
+    mod = load_module(module_dir)
+    cache: dict = {}
+    protected = sorted(
+        addr for addr in prior.resources
+        if (r := _resource_block_for(mod, addr, cache)) is not None
+        and _prevent_destroy(r))
+    if protected:
+        raise PlanError(
+            f"cannot plan a destroy of {', '.join(protected)}: "
+            f"lifecycle.prevent_destroy is set (edit the module or "
+            f"`state rm` them first)")
+    return empty, diff(empty, prior)
+
+
 def cmd_plan(args) -> int:
     try:
         plan, prior, state_path, disk_serial = _plan_against_state(args)
         if getattr(args, "refresh_only", False):
-            if getattr(args, "out", None):
-                print("Error: -refresh-only cannot be saved with -out (a "
-                      "refresh accepts drift, it does not stage actions)",
-                      file=sys.stderr)
+            if getattr(args, "out", None) or getattr(args, "destroy", False):
+                print("Error: -refresh-only cannot be combined with -out/"
+                      "-destroy (a refresh accepts drift, it does not "
+                      "stage actions)", file=sys.stderr)
                 return 2
             return _refresh_only_print(plan, prior, args)
-        d = diff(plan, prior, getattr(args, "target", None))
+        if getattr(args, "destroy", False):
+            if getattr(args, "target", None):
+                print("Error: -destroy -target is not supported — destroy "
+                      "everything via the saved plan, or surgically with "
+                      "`state rm` + apply", file=sys.stderr)
+                return 2
+            plan, d = _destroy_plan_of(plan, prior, args.dir)
+        else:
+            d = diff(plan, prior, getattr(args, "target", None))
         if getattr(args, "out", None):
             save_plan_file(args.out, plan_file_payload(
                 plan, d, disk_serial, module_dir=os.path.abspath(args.dir),
@@ -928,6 +987,7 @@ def main(argv: list[str] | None = None) -> int:
     c.add_argument("-workspace", default=None)
     c.add_argument("-out", default=None)
     c.add_argument("-refresh-only", action="store_true", dest="refresh_only")
+    c.add_argument("-destroy", action="store_true", dest="destroy")
     a = add_module_cmd("apply", cmd_apply, state=True)
     a.add_argument("-target", action="append", dest="target")
     a.add_argument("-workspace", default=None)

@@ -31,6 +31,14 @@ from .state import Diff, State
 
 PLAN_FORMAT = "tfsim-plan/1"
 
+# every key apply/show dereferences: absence is a clean PlanFileError at
+# load time (the documented contract), never a KeyError mid-apply
+_REQUIRED_KEYS = frozenset({
+    "module_dir", "workspace", "state_path", "targets", "variables",
+    "state_serial", "instances", "outputs", "sensitive_outputs", "order",
+    "check_failures", "actions", "changed_keys",
+})
+
 
 class PlanFileError(ValueError):
     pass
@@ -91,6 +99,11 @@ def load_plan_file(path: str) -> dict[str, Any]:
             f"{path!r} is not a tfsim plan file (expected format "
             f"{PLAN_FORMAT!r}, got {raw.get('format')!r})"
         )
+    missing = _REQUIRED_KEYS - set(raw)
+    if missing:
+        raise PlanFileError(
+            f"{path!r} is missing plan-file keys {sorted(missing)} — "
+            f"written by an older tfsim? re-run plan -out")
     return raw
 
 

@@ -872,3 +872,89 @@ def test_saved_plan_pins_resolved_statefile(tmp_path, capsys):
     assert os.path.exists(payload["state_path"])
     assert "google_compute_network.vpc" in \
         json.load(open(payload["state_path"]))["resources"]
+
+
+def test_plan_destroy_to_saved_file_roundtrip(tmp_path, capsys):
+    """terraform's state-driven teardown flow: plan -destroy -out FILE →
+    apply FILE empties the state through the same reviewed-plan contract."""
+    state = str(tmp_path / "s.json")
+    pfile = str(tmp_path / "d.tfplan")
+    assert main(["apply", GKE_TPU, "-state", state] + VARS) == 0
+    capsys.readouterr()
+    assert main(["plan", GKE_TPU, "-state", state, "-destroy",
+                 "-out", pfile] + VARS) == 0
+    out = capsys.readouterr().out
+    assert "- google_container_cluster.this" in out
+    assert "10 to destroy." in out
+    assert main(["apply", pfile, "-state", state]) == 0
+    assert "10 destroyed" in capsys.readouterr().out
+    assert json.load(open(state))["resources"] == {}
+
+
+def test_plan_destroy_empty_state_errors(tmp_path, capsys):
+    state = str(tmp_path / "s.json")
+    assert main(["plan", GKE_TPU, "-state", state, "-destroy"] + VARS) == 1
+    assert "nothing to destroy" in capsys.readouterr().err
+
+
+def test_plan_destroy_refuses_prevent_destroy(tmp_path, capsys):
+    import textwrap
+
+    mod = tmp_path / "mod"
+    mod.mkdir()
+    (mod / "main.tf").write_text(textwrap.dedent("""
+        resource "google_compute_network" "keep" {
+          name = "n"
+          lifecycle {
+            prevent_destroy = true
+          }
+        }
+    """))
+    state = str(tmp_path / "s.json")
+    assert main(["apply", str(mod), "-state", state]) == 0
+    capsys.readouterr()
+    assert main(["plan", str(mod), "-state", state, "-destroy"]) == 1
+    assert "prevent_destroy" in capsys.readouterr().err
+
+
+def test_plan_destroy_refuses_child_module_prevent_destroy(tmp_path, capsys):
+    import textwrap
+
+    child = tmp_path / "child"
+    child.mkdir()
+    (child / "main.tf").write_text(textwrap.dedent("""
+        resource "google_compute_network" "keep" {
+          name = "n"
+          lifecycle {
+            prevent_destroy = true
+          }
+        }
+    """))
+    mod = tmp_path / "mod"
+    mod.mkdir()
+    (mod / "main.tf").write_text(textwrap.dedent("""
+        module "sec" {
+          source = "../child"
+        }
+    """))
+    state = str(tmp_path / "s.json")
+    assert main(["apply", str(mod), "-state", state]) == 0
+    capsys.readouterr()
+    assert main(["plan", str(mod), "-state", state, "-destroy"]) == 1
+    err = capsys.readouterr().err
+    assert "prevent_destroy" in err and "module.sec" in err
+
+
+def test_plan_destroy_rejects_target(capsys):
+    assert main(["plan", GKE_TPU, "-destroy", "-target",
+                 "google_compute_network.vpc"] + VARS) == 2
+    assert "-destroy -target" in capsys.readouterr().err
+
+
+def test_old_plan_file_missing_keys_clean_error(tmp_path, capsys):
+    old = tmp_path / "old.tfplan"
+    old.write_text(json.dumps({"format": "tfsim-plan/1",
+                               "module_dir": "/x"}))
+    assert main(["apply", str(old)]) == 1
+    err = capsys.readouterr().err
+    assert "missing plan-file keys" in err

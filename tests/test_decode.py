@@ -6,6 +6,7 @@ forward on the growing sequence, unsharded and on the 8-device mesh.
 """
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 import pytest
 
@@ -86,15 +87,64 @@ def test_decode_step_count_and_shapes():
     assert toks.dtype in (jnp.int32, jnp.int64)
 
 
-def test_decode_rejects_overflow_and_moe():
+def test_decode_rejects_overflow():
     cfg = BurnInConfig(**CFG)
     params = init_params(jax.random.PRNGKey(0), cfg)
     prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab)
     with pytest.raises(ValueError, match="exceeds"):
         greedy_decode(params, prompt, 16, cfg, max_len=16)
-    moe_cfg = BurnInConfig(**{**CFG, "n_experts": 4})
-    with pytest.raises(ValueError, match="dense FFN only"):
-        init_cache(moe_cfg, 2, 16)
+
+
+@pytest.mark.slow
+def test_moe_greedy_decode_matches_reference():
+    """MoE serving exactness: with a training capacity factor that avoids
+    drops (>= n_experts), cached MoE decode equals the full re-forward
+    token by token — routing is per-token, and the serve path's
+    drop-free capacity makes it independent of sequence length."""
+    cfg = BurnInConfig(**{**CFG, "n_experts": 4, "capacity_factor": 4.0})
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, cfg.vocab)
+    toks = greedy_decode(params, prompt, 6, cfg)
+    seq = prompt
+    for step in range(6):
+        logits = forward(params, seq, cfg)
+        nxt = jnp.argmax(logits[:, -1], axis=-1)
+        assert np.array_equal(np.asarray(nxt), np.asarray(toks[:, step])), \
+            f"step {step}"
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+
+
+@pytest.mark.slow
+def test_moe_top2_decode_runs_and_matches():
+    cfg = BurnInConfig(**{**CFG, "n_experts": 4, "router_top_k": 2,
+                          "capacity_factor": 8.0})
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 5), 0, cfg.vocab)
+    toks = greedy_decode(params, prompt, 4, cfg)
+    logits = forward(params, prompt, cfg)
+    first = jnp.argmax(logits[:, -1], axis=-1)
+    assert np.array_equal(np.asarray(first), np.asarray(toks[:, 0]))
+
+
+@pytest.mark.slow
+def test_moe_decode_on_ep_mesh_matches_unsharded(jax8):
+    from nvidia_terraform_modules_tpu.parallel import (
+        build_mesh,
+        make_rules,
+        plan_mesh,
+    )
+
+    cfg = BurnInConfig(**{**CFG, "n_experts": 2, "capacity_factor": 2.0,
+                          "batch": 4})
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (4, 6), 0, cfg.vocab)
+    want = greedy_decode(params, prompt, 5, cfg)
+    rules = make_rules(build_mesh(plan_mesh(8, ep=2, tp=2)))
+    from nvidia_terraform_modules_tpu.models.burnin import shard_params
+
+    sharded = shard_params(params, rules)
+    got = greedy_decode(sharded, prompt, 5, cfg, rules)
+    assert np.array_equal(np.asarray(want), np.asarray(got))
 
 
 def test_long_context_attn_configs_decode():
@@ -229,3 +279,22 @@ def test_rope_decode_matches_reference():
     ref = _reference_greedy(params, prompt, 10, cfg)
     got = greedy_decode(params, prompt, 10, cfg)
     assert jnp.array_equal(ref, got), (ref, got)
+
+
+@pytest.mark.slow
+def test_moe_chunked_prefill_matches_unchunked():
+    """Prompts longer than the routing chunk take the scan path; with
+    drop-free capacity, chunking must change memory only, never tokens."""
+    import nvidia_terraform_modules_tpu.models.decode as dec
+
+    cfg = BurnInConfig(**{**CFG, "n_experts": 4, "capacity_factor": 4.0,
+                          "seq_len": 256})
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    # 150 tokens: crosses one chunk boundary AND exercises the padding
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 150), 0,
+                                cfg.vocab)
+    assert prompt.shape[1] > dec._MOE_PREFILL_CHUNK
+    cache = init_cache(cfg, 2, 160)
+    logits, _ = forward_cached(params, prompt, cache, cfg)
+    ref = forward(params, prompt, cfg)
+    assert float(jnp.max(jnp.abs(logits - ref))) < 1e-4

@@ -73,3 +73,18 @@ def test_quantized_decoder_runs_and_mostly_agrees():
     # greedy argmax under small logit perturbation: most tokens agree
     agree = float(np.mean(np.asarray(full) == np.asarray(q_toks)))
     assert agree >= 0.5, (full, q_toks)
+
+
+def test_unfused_decoder_matches_fused():
+    """The bench's fused-vs-unfused comparison is apples-to-apples: both
+    paths consume the same quantize_params tree and emit the same
+    tokens (the schedule of dequantization changes HBM traffic, never
+    the math)."""
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, CFG.vocab)
+    qp = quantize_params(params, dtype=jnp.float32)
+    fused = make_quantized_decoder(CFG, n_new=8, dtype=jnp.float32)
+    unfused = make_quantized_decoder(CFG, n_new=8, dtype=jnp.float32,
+                                     fused=False)
+    assert np.array_equal(np.asarray(fused(qp, prompt)),
+                          np.asarray(unfused(qp, prompt)))
