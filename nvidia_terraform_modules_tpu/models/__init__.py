@@ -23,6 +23,10 @@ from .decode import (  # noqa: F401
     make_decoder,
     sample_decode,
 )
+from .speculative import (  # noqa: F401
+    make_speculative_decoder,
+    speculative_greedy_decode,
+)
 from .quantize import (  # noqa: F401
     QTensor,
     dequantize_tree,

@@ -1,0 +1,164 @@
+"""Prompt-lookup speculative decoding: draft free tokens, verify in one pass.
+
+Greedy decode runs one HBM-bound forward per token (``models/decode.py``).
+Speculation converts some of that serial chain into parallel verification:
+draft ``k`` candidate tokens cheaply, run ONE cached forward over all of
+them (the same weights-read cost as a single step — the decode regime is
+weight-bandwidth-bound, so verifying k+1 positions costs ~one step), and
+accept the longest prefix the model itself would have produced.
+
+The draft source here is **prompt lookup** (n-gram continuation): find the
+most recent earlier occurrence of the current bigram in the generated
+context and propose the tokens that followed it. No draft model, no extra
+weights — the lever targets the structured/repetitive decoding real
+serving sees (code, retrieval-augmented text, templated output); on
+incompressible token streams acceptance just drops toward zero and the
+loop degrades to ~plain greedy decode, never below it by more than the
+(k)-position verification overhead.
+
+**Exactness guarantee**: output EQUALS ``greedy_decode`` token for token,
+whatever the drafts are — acceptance tests argmax equality position by
+position, and the first mismatch is replaced by the verifier's own argmax
+(which is exactly the token plain greedy would have emitted). The cache
+rolls back by resetting ``pos`` only: rows past ``pos`` are causally
+masked out of every later attention and are overwritten in place when
+real decoding reaches them (``lax.dynamic_update_slice`` at the same
+offsets), so no buffer surgery is needed.
+
+TPU-first shape discipline: the whole generate loop is ONE
+``lax.while_loop`` with static shapes — a fixed ``[1, max_len]`` context
+buffer, ``k`` static, every verification a ``[1, k+1]`` cached forward —
+so speculation compiles once like everything else. Batch is 1 by design:
+speculation is a LATENCY lever, and per-row acceptance divergence under
+batching would force per-row cache offsets (a different design).
+
+Reference analogue: none — the reference provisions serving
+infrastructure and never touches model bytes (SURVEY §2.6).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.sharding import ShardingRules
+from .burnin import BurnInConfig
+from .decode import _select_prefill_impl, forward_cached, init_cache
+
+
+def _ngram_draft(ctx, cur_len, k: int, vocab: int):
+    """Draft ``k`` tokens by bigram lookup in ``ctx [L]`` (valid ``cur_len``).
+
+    Finds the LATEST position ``i < cur_len - 2`` with
+    ``ctx[i:i+2] == ctx[cur_len-2:cur_len]`` and proposes
+    ``ctx[i+2 : i+2+k]``. No match → repeat the last token (a draft that
+    will usually be rejected — correctness never depends on draft
+    quality). All static shapes; runs inside the while_loop."""
+    L = ctx.shape[0]
+    idx = jnp.arange(L)
+    a = ctx
+    b = jnp.roll(ctx, -1)                       # b[i] = ctx[i+1]
+    suf0 = ctx[jnp.maximum(cur_len - 2, 0)]
+    suf1 = ctx[jnp.maximum(cur_len - 1, 0)]
+    match = (a == suf0) & (b == suf1) & (idx + 2 < cur_len)
+    pos = jnp.max(jnp.where(match, idx, -1))
+    start = jnp.where(pos >= 0, pos + 2, jnp.maximum(cur_len - 1, 0))
+    gather = jnp.clip(start + jnp.arange(k), 0, L - 1)
+    return jnp.clip(ctx[gather], 0, vocab - 1)
+
+
+def speculative_greedy_decode(params, prompt, n_new: int,
+                              cfg: BurnInConfig,
+                              rules: ShardingRules | None = None,
+                              k: int = 4, max_len: int | None = None,
+                              prefill: str = "auto"):
+    """Greedy generation via prompt-lookup speculation.
+
+    Returns ``(tokens [1, n_new], steps)`` where ``steps`` is the number
+    of verification forwards actually run — ``n_new / steps`` is the
+    realised speedup factor over plain greedy (≈1 on incompressible
+    streams, up to ``k+1`` on perfectly predictable ones). Tokens are
+    EXACTLY ``greedy_decode``'s. Jittable end-to-end; batch must be 1.
+    """
+    if prompt.shape[0] != 1:
+        raise ValueError(
+            f"speculative decode is a latency lever: batch must be 1, got "
+            f"{prompt.shape[0]} (use greedy_decode for throughput batching)")
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    t0 = prompt.shape[1]
+    if max_len is None:
+        max_len = t0 + n_new + k          # k slots of verification headroom
+    if t0 + n_new + k > max_len:
+        raise ValueError(
+            f"prompt ({t0}) + n_new ({n_new}) + k ({k}) exceeds max_len "
+            f"({max_len}) — speculation writes up to k draft rows past the "
+            f"accepted position")
+
+    cache = init_cache(cfg, 1, max_len, rules)
+    logits, cache = forward_cached(
+        params, prompt, cache, cfg, rules,
+        prefill_impl=_select_prefill_impl(cfg, t0, prefill))
+    first = jnp.argmax(logits[:, -1], axis=-1)           # [1]
+
+    ctx0 = jnp.zeros((max_len,), prompt.dtype).at[:t0].set(prompt[0])
+    ctx0 = ctx0.at[t0].set(first[0])
+
+    state = {
+        "cache": cache,
+        "ctx": ctx0,                    # prompt + generated, flat [max_len]
+        "n_out": jnp.int32(1),          # tokens generated so far
+        "steps": jnp.int32(0),          # verification forwards run
+    }
+
+    def cond(s):
+        return s["n_out"] < n_new
+
+    def body(s):
+        cur = t0 + s["n_out"]           # valid context length
+        last = s["ctx"][cur - 1]
+        draft = _ngram_draft(s["ctx"], cur, k, cfg.vocab)     # [k]
+        block = jnp.concatenate([last[None], draft])[None]    # [1, k+1]
+        logits, cache = forward_cached(params, block, s["cache"], cfg,
+                                       rules)
+        preds = jnp.argmax(logits[0], axis=-1)                # [k+1]
+        # position j's prediction continues draft[j-1]; accept while the
+        # draft agrees with the model's own argmax chain
+        agree = draft == preds[:-1]
+        n_acc = jnp.argmin(jnp.concatenate(
+            [agree, jnp.array([False])]).astype(jnp.int32))   # 0..k
+        # the model emits n_acc accepted drafts PLUS its own next token
+        # (the correction at the first mismatch, or the continuation when
+        # everything agreed) — capped so we never exceed n_new
+        emit = jnp.minimum(n_acc + 1, n_new - s["n_out"])
+        new_toks = jnp.concatenate([draft, jnp.zeros((1,), draft.dtype)])
+        new_toks = new_toks.at[n_acc].set(preds[n_acc])       # [k+1]
+        keep = jnp.arange(k + 1) < emit
+        upd = jax.lax.dynamic_slice_in_dim(s["ctx"], cur, k + 1)
+        upd = jnp.where(keep, new_toks, upd)
+        ctx = jax.lax.dynamic_update_slice_in_dim(s["ctx"], upd, cur, 0)
+        # roll back: pos is the next input's position = count of stored
+        # rows. The new un-forwarded last token sits at ctx[cur+emit-1],
+        # so valid rows are [0, cur+emit-1); stale speculative rows
+        # beyond are causally masked and later overwritten in place
+        cache = dict(cache)
+        cache["pos"] = cur + emit - 1
+        return {"cache": cache, "ctx": ctx,
+                "n_out": s["n_out"] + emit, "steps": s["steps"] + 1}
+
+    final = jax.lax.while_loop(cond, body, state)
+    toks = jax.lax.dynamic_slice_in_dim(final["ctx"], t0, n_new)
+    return toks[None], final["steps"]
+
+
+def make_speculative_decoder(cfg: BurnInConfig,
+                             rules: ShardingRules | None = None,
+                             n_new: int = 32, k: int = 4,
+                             max_len: int | None = None):
+    """Compiled speculative greedy decoder:
+    ``decoder(params, prompt) → (tokens [1, n_new], steps)``."""
+    fn = functools.partial(speculative_greedy_decode, n_new=n_new, cfg=cfg,
+                           rules=rules, k=k, max_len=max_len)
+    return jax.jit(fn)
