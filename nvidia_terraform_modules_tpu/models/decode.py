@@ -343,20 +343,29 @@ def sample_decode(params, prompt, n_new: int, cfg: BurnInConfig, rng,
                   rules: ShardingRules | None = None,
                   max_len: int | None = None,
                   temperature: float = 1.0, top_k: int | None = None,
+                  top_p: float | None = None,
                   prefill: str = "auto"):
-    """Temperature / top-k sampling over the same cached loop.
+    """Temperature / top-k / nucleus (top-p) sampling over the cached loop.
 
-    ``temperature`` scales logits before the categorical draw (→0 recovers
-    greedy); ``top_k`` keeps only the k highest logits per position
-    (``top_k=1`` IS greedy, exactly). One PRNG key per generated token,
-    split from ``rng`` — same key, same tokens, reproducible serving.
+    ``temperature`` scales logits (→0 recovers greedy); ``top_k`` keeps
+    only the k highest logits per position (``top_k=1`` IS greedy,
+    exactly); ``top_p`` keeps the smallest prefix of the
+    probability-sorted vocab whose mass reaches p (nucleus sampling —
+    the standard lever when the tail, not the rank cutoff, is what
+    should adapt per step). Filters compose in the mainstream
+    (HF/vLLM) order: temperature FIRST, then top-k, then top-p over the
+    tempered distribution — so ported sampling settings mean what they
+    meant elsewhere. One PRNG key per generated token, split from
+    ``rng`` — same key, same tokens, reproducible serving.
     """
     if top_k is not None and top_k < 1:
         raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     temperature = max(float(temperature), 1e-6)
 
     def pick(logits, key):                                # [B, vocab] → [B]
-        logits = logits.astype(jnp.float32)
+        logits = logits.astype(jnp.float32) / temperature
         if top_k == 1:
             return jnp.argmax(logits, axis=-1)            # no tie-break draw
         if top_k is not None and top_k < logits.shape[-1]:
@@ -364,7 +373,21 @@ def sample_decode(params, prompt, n_new: int, cfg: BurnInConfig, rng,
             # a full jnp.sort would be O(V log V) and copy the vocab
             kth = jax.lax.top_k(logits, top_k)[0][:, -1][:, None]
             logits = jnp.where(logits < kth, -jnp.inf, logits)
-        return jax.random.categorical(key, logits / temperature, axis=-1)
+        if top_p is not None and top_p < 1.0:
+            # nucleus over the tempered post-top-k distribution: keep
+            # ranks whose EXCLUSIVE prefix mass is < p (the first token
+            # always survives; the one crossing p is included, matching
+            # the standard formulation), scatter back by rank. One
+            # argsort drives both the sorted view and the rank map.
+            order = jnp.argsort(-logits, axis=-1)
+            sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            prefix = jnp.cumsum(probs, axis=-1) - probs   # exclusive
+            keep_sorted = prefix < top_p                  # [B, V] by rank
+            rank = jnp.argsort(order, axis=-1)
+            keep = jnp.take_along_axis(keep_sorted, rank, axis=-1)
+            logits = jnp.where(keep, logits, -jnp.inf)
+        return jax.random.categorical(key, logits, axis=-1)
 
     return _generate(params, prompt, n_new, cfg, rules, max_len, (rng, pick),
                      prefill)

@@ -298,3 +298,44 @@ def test_moe_chunked_prefill_matches_unchunked():
     logits, _ = forward_cached(params, prompt, cache, cfg)
     ref = forward(params, prompt, cfg)
     assert float(jnp.max(jnp.abs(logits - ref))) < 1e-4
+
+
+def test_sampling_top_p_tiny_keeps_argmax_only():
+    """top_p small enough keeps exactly the argmax token (the first
+    sorted token always survives nucleus filtering), so sampling becomes
+    greedy — the top-p analogue of the top_k=1 contract."""
+    from nvidia_terraform_modules_tpu.models import sample_decode
+
+    cfg = BurnInConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, cfg.vocab)
+    greedy = greedy_decode(params, prompt, 8, cfg)
+    nucleus = sample_decode(params, prompt, 8, cfg, jax.random.PRNGKey(7),
+                            top_p=1e-6, temperature=5.0)
+    assert jnp.array_equal(greedy, nucleus)
+
+
+def test_sampling_top_p_one_is_plain_sampling():
+    from nvidia_terraform_modules_tpu.models import sample_decode
+
+    cfg = BurnInConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, cfg.vocab)
+    a = sample_decode(params, prompt, 8, cfg, jax.random.PRNGKey(9))
+    b = sample_decode(params, prompt, 8, cfg, jax.random.PRNGKey(9),
+                      top_p=1.0)
+    assert jnp.array_equal(a, b)
+
+
+def test_sampling_top_p_validation():
+    from nvidia_terraform_modules_tpu.models import sample_decode
+
+    cfg = BurnInConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="top_p"):
+        sample_decode(params, prompt, 4, cfg, jax.random.PRNGKey(0),
+                      top_p=0.0)
+    with pytest.raises(ValueError, match="top_p"):
+        sample_decode(params, prompt, 4, cfg, jax.random.PRNGKey(0),
+                      top_p=1.5)
