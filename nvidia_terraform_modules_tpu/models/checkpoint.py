@@ -64,9 +64,21 @@ class Checkpointer:
     :meth:`close`.
     """
 
-    def __init__(self, directory: str, max_to_keep: int = 2):
+    def __init__(self, directory: str, max_to_keep: int = 2,
+                 async_save: bool = False):
+        """``async_save=True`` makes :meth:`save` return after the device
+        arrays are snapshotted, with serialization/commit running behind
+        the next training steps — the standard TPU lever for hiding
+        checkpoint I/O (orbax writes from a host copy, so training may
+        mutate params immediately). The commit point moves to
+        :meth:`flush` / :meth:`close` / the next ``save`` (orbax
+        serializes overlapping saves). The smoke-test Job keeps the
+        blocking default: it may be preempted right after a step, and an
+        uncommitted async write racing pod teardown would lose the step.
+        """
         self.directory = directory
         self._max_to_keep = max_to_keep
+        self._async = async_save
         self._mgr = None
 
     def _manager(self):
@@ -88,21 +100,35 @@ class Checkpointer:
 
     def close(self) -> None:
         if self._mgr is not None:
+            # commit any in-flight async save before tearing down — a
+            # close that dropped a scheduled write would silently lose
+            # the run's last step
+            self._mgr.wait_until_finished()
             self._mgr.close()
             self._mgr = None
+
+    def flush(self) -> None:
+        """Block until every scheduled (async) save has committed."""
+        if self._mgr is not None:
+            self._mgr.wait_until_finished()
 
     def latest_step(self) -> int | None:
         if _no_checkpoint_possible(self.directory):
             return None
+        # reads must not observe a scheduled-but-uncommitted async step
+        # (the manager's cache lists it before the commit lands)
+        self.flush()
         return self._manager().latest_step()
 
     def save(self, step: int, params: Any,
              meta: dict[str, Any] | None = None) -> None:
-        """Blocking, atomic save of ``params`` (+ JSON ``meta``).
+        """Atomic save of ``params`` (+ JSON ``meta``).
 
-        Blocking on purpose: the smoke-test Job may be preempted right
-        after a step, and an async write racing pod teardown would lose
-        the commit.
+        Blocking by default (the smoke-test Job may be preempted right
+        after a step, and an uncommitted write racing pod teardown would
+        lose the commit); with ``async_save=True`` the commit overlaps
+        subsequent compute and lands at the next save/:meth:`flush`/
+        :meth:`close`.
         """
         import orbax.checkpoint as ocp
 
@@ -111,7 +137,8 @@ class Checkpointer:
             params=ocp.args.StandardSave(params),
             meta=ocp.args.JsonSave(meta or {}),
         ))
-        mgr.wait_until_finished()
+        if not self._async:
+            mgr.wait_until_finished()
 
     def restore(self, cfg: BurnInConfig, rules=None,
                 step: int | None = None,
@@ -149,6 +176,7 @@ class Checkpointer:
 
         if _no_checkpoint_possible(self.directory):
             return None
+        self.flush()   # never restore a step whose commit hasn't landed
         mgr = self._manager()
         if step is None:
             step = mgr.latest_step()
@@ -177,6 +205,9 @@ class Checkpointer:
         """
         if _no_checkpoint_possible(self.directory):
             return 0
+        # an uncommitted async save racing the delete could re-land its
+        # step AFTER the directory sweep — commit everything first
+        self.flush()
         mgr = self._manager()
         steps = list(mgr.all_steps())
         if jax.process_count() > 1:

@@ -220,3 +220,78 @@ def test_adamw_train_state_resume_bit_exact(jax8, tmp_path):
         assert jnp.array_equal(a, b), "resumed params diverged"
     for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(s2)):
         assert jnp.array_equal(a, b), "resumed optimizer state diverged"
+
+
+def test_async_save_roundtrips_and_flushes(tmp_path):
+    """async_save overlaps the commit with later compute; flush/close are
+    the commit points and a fresh reader sees every step after them."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nvidia_terraform_modules_tpu.models import (
+        BurnInConfig,
+        Checkpointer,
+        init_params,
+    )
+
+    cfg = BurnInConfig(vocab=32, d_model=16, n_heads=2, d_ff=32, n_layers=1,
+                       seq_len=8, batch=2, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    d = str(tmp_path / "ck")
+    with Checkpointer(d, async_save=True) as ck:
+        ck.save(1, params, meta={"tag": "a"})
+        bumped = jax.tree.map(lambda x: x + 1.0, params)
+        ck.save(2, bumped, meta={"tag": "b"})
+        ck.flush()
+        assert ck.latest_step() == 2
+    with Checkpointer(d) as reader:
+        restored, step, meta = reader.restore(cfg)
+        assert step == 2 and meta["tag"] == "b"
+        for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(bumped)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_async_close_commits_pending_save(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from nvidia_terraform_modules_tpu.models import (
+        BurnInConfig,
+        Checkpointer,
+        init_params,
+    )
+
+    cfg = BurnInConfig(vocab=32, d_model=16, n_heads=2, d_ff=32, n_layers=1,
+                       seq_len=8, batch=2, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    d = str(tmp_path / "ck")
+    ck = Checkpointer(d, async_save=True)
+    ck.save(7, params)
+    ck.close()                       # must commit, not drop, the write
+    with Checkpointer(d) as reader:
+        assert reader.latest_step() == 7
+
+
+def test_async_clear_commits_then_removes_everything(tmp_path):
+    """clear() must flush in-flight async saves first — an uncommitted
+    write racing the delete could re-land its step after the sweep."""
+    import jax
+    import jax.numpy as jnp
+
+    from nvidia_terraform_modules_tpu.models import (
+        BurnInConfig,
+        Checkpointer,
+        init_params,
+    )
+
+    cfg = BurnInConfig(vocab=32, d_model=16, n_heads=2, d_ff=32, n_layers=1,
+                       seq_len=8, batch=2, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    d = str(tmp_path / "ck")
+    with Checkpointer(d, async_save=True) as ck:
+        ck.save(1, params)
+        ck.save(2, params)
+        assert ck.clear() == 2       # no flush() by the caller: clear owns it
+    with Checkpointer(d) as reader:
+        assert reader.latest_step() is None
