@@ -377,19 +377,22 @@ def sample_decode(params, prompt, n_new: int, cfg: BurnInConfig, rng,
             # nucleus over the tempered post-top-k distribution: keep
             # ranks whose EXCLUSIVE prefix mass is < p (the first token
             # always survives; the one crossing p is included, matching
-            # the standard formulation). ONE sort derives the nucleus
-            # size and its cutoff LOGIT; filtering by value needs no
-            # rank scatter-back (exact ties at the cutoff all survive —
-            # they carry identical probability, so the sampled
-            # distribution is unchanged). This runs inside the decode
-            # scan: the second argsort + gather would double the
-            # per-token vocab traffic.
-            sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+            # the standard formulation). RANK-based, not value-based: a
+            # logit tied with the boundary but ranked past it must NOT
+            # survive — admitting it would grow the nucleus and shift
+            # every kept token's renormalised probability. One argsort +
+            # one O(V) scatter (put_along_axis) restores original
+            # positions; this runs inside the decode scan, where a
+            # second argsort would double the per-token vocab traffic.
+            order = jnp.argsort(-logits, axis=-1)
+            sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
             probs = jax.nn.softmax(sorted_logits, axis=-1)
             prefix = jnp.cumsum(probs, axis=-1) - probs   # exclusive
-            n_keep = jnp.sum(prefix < top_p, axis=-1, keepdims=True)
-            cutoff = jnp.take_along_axis(sorted_logits, n_keep - 1, axis=-1)
-            logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
+            keep_sorted = prefix < top_p                  # [B, V] by rank
+            keep = jnp.put_along_axis(
+                jnp.zeros(logits.shape, bool), order, keep_sorted,
+                axis=-1, inplace=False)
+            logits = jnp.where(keep, logits, -jnp.inf)
         return jax.random.categorical(key, logits, axis=-1)
 
     return _generate(params, prompt, n_new, cfg, rules, max_len, (rng, pick),

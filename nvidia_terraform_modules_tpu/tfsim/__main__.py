@@ -6,7 +6,7 @@ The reference's user interface is the ``terraform`` CLI itself
 terraform binary in CI, so tfsim ships the same verbs offline::
 
     python -m nvidia_terraform_modules_tpu.tfsim init gke-tpu [-check]
-    python -m nvidia_terraform_modules_tpu.tfsim validate gke-tpu
+    python -m nvidia_terraform_modules_tpu.tfsim validate gke-tpu [-json]
     python -m nvidia_terraform_modules_tpu.tfsim plan gke-tpu -var project_id=p \
         -var cluster_name=c [-state terraform.tfstate.json] [-json] [-target ADDR] \
         [-out plan.tfplan] [-refresh-only] [-destroy]
@@ -140,11 +140,38 @@ def _load_state(path: str | None) -> State | None:
     return None
 
 
+def _diag_json(f) -> dict:
+    """One `validate -json` diagnostic. Terraform omits `range` when a
+    diagnostic has no real source position; our synthetic module-level
+    findings carry line 0 (1-based consumers like GitHub annotations
+    reject it), so those keep the filename but drop the start."""
+    d = {"severity": f.severity, "summary": f.message}
+    if ":" in f.where:
+        fname, line = f.where.rsplit(":", 1)
+        d["range"] = {"filename": fname}
+        if int(line) >= 1:
+            d["range"]["start"] = {"line": int(line)}
+    else:
+        d["range"] = {"filename": f.where}
+    return d
+
+
 def cmd_validate(args) -> int:
     findings = validate_module(load_module(args.dir))
+    errors = [f for f in findings if f.severity == "error"]
+    if getattr(args, "json", False):
+        # terraform's `validate -json` diagnostics shape, so machine
+        # consumers (CI annotators, editors) parse both tools alike
+        print(json.dumps({
+            "format_version": "1.0",
+            "valid": not errors,
+            "error_count": len(errors),
+            "warning_count": len(findings) - len(errors),
+            "diagnostics": [_diag_json(f) for f in findings],
+        }, indent=2, sort_keys=True))
+        return 1 if errors else 0
     for f in findings:
         print(f)
-    errors = [f for f in findings if f.severity == "error"]
     print(f"{'Success! ' if not errors else ''}{len(findings)} finding(s), "
           f"{len(errors)} error(s).")
     return 1 if errors else 0
@@ -978,6 +1005,7 @@ def main(argv: list[str] | None = None) -> int:
 
     v = sub.add_parser("validate")
     v.add_argument("dir")
+    v.add_argument("-json", action="store_true")
     v.set_defaults(fn=cmd_validate)
 
     c = add_module_cmd("plan", cmd_plan, state=True)

@@ -958,3 +958,36 @@ def test_old_plan_file_missing_keys_clean_error(tmp_path, capsys):
     assert main(["apply", str(old)]) == 1
     err = capsys.readouterr().err
     assert "missing plan-file keys" in err
+
+
+def test_validate_json_clean_and_dirty(tmp_path, capsys):
+    """terraform's `validate -json` diagnostics shape: valid flag, counts,
+    per-diagnostic severity/summary/range."""
+    assert main(["validate", GKE_TPU, "-json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["valid"] is True and payload["error_count"] == 0
+
+    (tmp_path / "main.tf").write_text(
+        'resource "google_compute_network" "n" {\n  name = var.missing\n}\n')
+    assert main(["validate", str(tmp_path), "-json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["valid"] is False and payload["error_count"] >= 1
+    errors = [d for d in payload["diagnostics"] if d["severity"] == "error"]
+    assert errors and any("missing" in d["summary"] for d in errors)
+    diag = errors[0]
+    assert diag["range"]["filename"].endswith("main.tf")
+    assert diag["range"]["start"]["line"] >= 1
+
+
+def test_validate_json_omits_zero_line_ranges(tmp_path, capsys):
+    """Synthetic module-level findings (versions.tf:0) must not emit a
+    0 line — 1-based consumers (GitHub annotations) reject it."""
+    (tmp_path / "main.tf").write_text(
+        'resource "google_compute_network" "n" {\n  name = "x"\n}\n')
+    # no versions.tf: validate emits module-level pin warnings at line 0
+    main(["validate", str(tmp_path), "-json"])
+    payload = json.loads(capsys.readouterr().out)
+    for d in payload["diagnostics"]:
+        start = d.get("range", {}).get("start")
+        if start is not None:
+            assert start["line"] >= 1, d
