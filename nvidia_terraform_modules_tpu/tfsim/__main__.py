@@ -142,17 +142,18 @@ def _load_state(path: str | None) -> State | None:
 
 def _diag_json(f) -> dict:
     """One `validate -json` diagnostic. Terraform omits `range` when a
-    diagnostic has no real source position; our synthetic module-level
-    findings carry line 0 (1-based consumers like GitHub annotations
-    reject it), so those keep the filename but drop the start."""
+    diagnostic has no real source position; our synthetic locations —
+    pseudo-filenames like ``locals`` (no .tf/.hcl suffix) and line 0 in
+    a 1-based scheme — would make a CI annotator (the consumer this
+    format exists for) emit rejected/misplaced annotations, so a
+    non-source filename drops the range and line 0 drops the start."""
     d = {"severity": f.severity, "summary": f.message}
-    if ":" in f.where:
-        fname, line = f.where.rsplit(":", 1)
-        d["range"] = {"filename": fname}
-        if int(line) >= 1:
-            d["range"]["start"] = {"line": int(line)}
-    else:
-        d["range"] = {"filename": f.where}
+    fname, _, line = f.where.rpartition(":")
+    if not fname or not fname.endswith((".tf", ".tfvars", ".hcl")):
+        return d
+    d["range"] = {"filename": fname}
+    if line.isdigit() and int(line) >= 1:
+        d["range"]["start"] = {"line": int(line)}
     return d
 
 

@@ -991,3 +991,18 @@ def test_validate_json_omits_zero_line_ranges(tmp_path, capsys):
         start = d.get("range", {}).get("start")
         if start is not None:
             assert start["line"] >= 1, d
+
+
+def test_validate_json_drops_pseudo_filename_ranges(tmp_path, capsys):
+    """Synthetic locations like 'locals' (not a source file) must carry
+    no range at all — an annotator would misplace them."""
+    (tmp_path / "main.tf").write_text(
+        'locals {\n  derived = var.nope\n}\n\n'
+        'resource "google_compute_network" "n" {\n  name = local.derived\n}\n')
+    main(["validate", str(tmp_path), "-json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["valid"] is False
+    for d in payload["diagnostics"]:
+        rng = d.get("range")
+        if rng is not None:
+            assert rng["filename"].endswith((".tf", ".tfvars", ".hcl")), d
