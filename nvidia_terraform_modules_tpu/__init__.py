@@ -21,4 +21,4 @@ We replace those runbooks with executable code:
   ``/root/reference/CONTRIBUTING.md:56``).
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"

@@ -1,0 +1,86 @@
+"""The bench capture's un-losable contract (round-2 VERDICT item 1).
+
+The orchestrator is the artifact generator of record: whatever happens to
+the backend or any metric section, `python bench.py` must exit 0 having
+printed ONE parseable JSON line. These tests drive the real subprocess
+machinery — section dispatch, timeout kill, error capture — and one full
+end-to-end run on the CPU path.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(ROOT, "bench.py")
+
+
+def _cpu_env():
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS" and not k.startswith("AXON_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _bench_mod():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_under_test", BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_run_section_reports_unknown_section():
+    bench = _bench_mod()
+    result, err = bench._run_section("nope", _cpu_env(), timeout=60,
+                                     attempts=1)
+    assert result is None
+    assert "rc=2" in err
+
+
+def test_run_section_timeout_kills_and_reports():
+    """A hung section must burn only its own budget and come back as a
+    timeout error — the failure mode that erased round 2's capture."""
+    bench = _bench_mod()
+    result, err = bench._run_section("devinfo", _cpu_env(), timeout=0.05,
+                                     attempts=1)
+    assert result is None
+    assert "timeout" in err
+
+
+def test_run_section_devinfo_roundtrip():
+    bench = _bench_mod()
+    result, err = bench._run_section("devinfo", _cpu_env(), timeout=120,
+                                     attempts=1)
+    assert err is None, err
+    assert result["platform"] == "cpu" and result["devices"] >= 1
+
+
+def test_section_registry_and_timeouts_agree():
+    """Every section must carry a budget — a missing entry would KeyError
+    mid-capture, exactly the un-losable contract's failure mode."""
+    bench = _bench_mod()
+    assert set(bench.SECTIONS) == set(bench.SECTION_TIMEOUT_S)
+
+
+@pytest.mark.slow
+def test_full_capture_emits_single_json_line_rc0():
+    proc = subprocess.run(
+        [sys.executable, BENCH], env=_cpu_env(), cwd=ROOT,
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    payload = json.loads(lines[0])
+    assert payload["metric"] == "accelerator_validation_seconds"
+    assert payload["value"] > 0
+    assert payload["bench_platform"] == "cpu"
+    assert payload["smoke_ok"] is True
+    for key in ("burnin_mfu", "decode_tokens_per_s",
+                "decode_int8_tokens_per_s", "decode_spec_tokens_per_s",
+                "hbm_roofline"):
+        assert key in payload, key
