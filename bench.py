@@ -91,7 +91,10 @@ def section_smoke() -> dict:
     from nvidia_terraform_modules_tpu.smoketest import run_smoketest
 
     n_dev = len(jax.devices())
-    level = "burnin" if n_dev >= 2 else "psum"
+    # burn-in (train steps + the greedy-decode serve check) needs no second
+    # chip — a 1-device capture must still validate train + serve end-to-end,
+    # not just psum; the collective probes inside skip 1-sized axes themselves
+    level = "burnin"
     smoke = run_smoketest(level=level, env={})
     # import→verdict: includes interpreter + jax + backend init, exactly the
     # cost a fresh validation Job pod pays
@@ -100,6 +103,8 @@ def section_smoke() -> dict:
         "accelerator_validation_seconds": round(validation_seconds, 2),
         "smoke_ok": smoke.ok,
         "smoke_level": level,
+        "smoke_train_ok": smoke.checks.get("burnin_ok"),
+        "smoke_serve_ok": smoke.checks.get("decode_ok"),
         "devices": n_dev,
         "device_kind": jax.devices()[0].device_kind,
     }
@@ -251,6 +256,11 @@ def section_decode_int8() -> dict:
     max_len = prompt_len + n_new
     qparams = quantize_params(params, dtype=dec_cfg.dtype)
     out = {}
+    if not _on_tpu():
+        # off-TPU the fused path runs under the pallas INTERPRETER — the
+        # number measures the interpreter, not the kernel, and fused <
+        # unfused is the expected inversion, not a regression
+        out["decode_int8_interpret_mode"] = True
     for key, fused in (("decode_int8_tokens_per_s", True),
                        ("decode_int8_unfused_tokens_per_s", False)):
         q_decoder = make_quantized_decoder(
@@ -475,6 +485,77 @@ def _run_section(name: str, env: dict[str, str], timeout: float,
     return None, last_err
 
 
+def _grant_holder_sweep() -> dict | None:
+    """Detect — and, for orphans, kill — stale axon grant-holder processes.
+
+    The rig has ONE TPU chip behind the axon tunnel, claimed exclusively at
+    backend init; a python process whose parent died keeps the grant forever
+    and every later jax init blocks machine-wide (the documented wedge in
+    `.claude/skills/verify/SKILL.md`). Probing before clearing such a holder
+    guarantees a false CPU fallback, so this runs first. Only ORPHANS
+    (ppid 1) are killed — nothing owns them; live-parented candidates are
+    reported but left alone (they may be a legitimate concurrent run whose
+    grant will clear).
+    """
+    me = os.getpid()
+    ancestors: set[int] = set()
+    pid = me
+    for _ in range(64):  # walk to init; bound it against /proc races
+        try:
+            with open(f"/proc/{pid}/stat") as fh:
+                ppid = int(fh.read().rsplit(")", 1)[1].split()[1])
+        except (OSError, ValueError, IndexError):
+            break
+        ancestors.add(pid)
+        if ppid <= 1:
+            break
+        pid = ppid
+    found: list[dict] = []
+    killed: list[int] = []
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit() or int(entry) in ancestors:
+            continue
+        pid = int(entry)
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as fh:
+                cmd = (fh.read().replace(b"\0", b" ")
+                       .decode(errors="replace").strip())
+            if "python" not in cmd:
+                continue
+            with open(f"/proc/{pid}/environ", "rb") as fh:
+                has_axon = b"PALLAS_AXON_POOL_IPS=" in fh.read()
+            if not has_axon:
+                continue
+            with open(f"/proc/{pid}/stat") as fh:
+                ppid = int(fh.read().rsplit(")", 1)[1].split()[1])
+            try:
+                with open(f"/proc/{pid}/wchan") as fh:
+                    wchan = fh.read().strip()
+            except OSError:
+                wchan = "?"
+        except (OSError, ValueError, IndexError):
+            continue  # raced exit mid-read, or not ours to inspect
+        found.append({"pid": pid, "ppid": ppid, "wchan": wchan,
+                      "cmd": cmd[:120]})
+        # kill ONLY the documented wedge signature: an orphan (parent
+        # died) parked in the claim-polling sleep. Reparenting to init
+        # alone is not staleness — a deliberately nohup'd live run also
+        # has ppid 1, but it would be computing or blocked on the
+        # tunnel's IO, not spinning hrtimer_nanosleep.
+        if ppid == 1 and wchan == "hrtimer_nanosleep":
+            try:
+                os.kill(pid, signal.SIGKILL)
+                killed.append(pid)
+            except OSError:
+                pass
+    if not found:
+        return None
+    if killed:
+        # a freshly killed holder's grant takes a while to expire server-side
+        time.sleep(20)
+    return {"candidates": found, "killed": killed}
+
+
 def _cpu_env(base_env: dict[str, str]) -> dict[str, str]:
     """Env for the CPU fallback: force the CPU platform AND drop the axon
     TPU-tunnel activation (``PALLAS_AXON_POOL_IPS`` makes sitecustomize
@@ -489,13 +570,18 @@ def _cpu_env(base_env: dict[str, str]) -> dict[str, str]:
 def _acquire_backend(base_env: dict[str, str]) -> tuple[dict[str, str], dict, str | None]:
     """Probe the default backend; fall back to CPU if it won't come up.
 
-    Returns (env for sections, devinfo dict, backend error or None). TPU
-    init UNAVAILABLE is often transient, so probe 3× with backoff; the
-    observed hang mode makes the subprocess timeout the real defense.
+    Returns (env for sections, devinfo dict, backend error or None). The
+    first probe gets 420 s: first backend init through the tunnel "is slow
+    (minutes)" by the repo's own verify recipe, so a short first budget
+    guarantees a false fallback on a cold tunnel. TPU init UNAVAILABLE is
+    also often transient, so two more 180 s attempts follow with backoff;
+    the observed hang mode makes the subprocess timeout the real defense.
     """
-    info, err = _run_section("devinfo", base_env,
-                             SECTION_TIMEOUT_S["devinfo"], attempts=3,
-                             backoff_s=10.0)
+    info, err = _run_section("devinfo", base_env, 420, attempts=1)
+    if info is None:
+        info, err2 = _run_section("devinfo", base_env, 180, attempts=2,
+                                  backoff_s=15.0)
+        err = f"{err}; retries: {err2}" if info is None else None
     if info is not None:
         return base_env, info, None
     cpu_env = _cpu_env(base_env)
@@ -507,28 +593,60 @@ def _acquire_backend(base_env: dict[str, str]) -> tuple[dict[str, str], dict, st
     return cpu_env, info, f"default backend unavailable, ran on cpu: {err}"
 
 
+def _run_all_sections(env: dict[str, str], merged: dict,
+                      errors: dict[str, str]) -> None:
+    """Run every metric section into ``merged``; errors keyed by section."""
+    for name in (n for n in SECTIONS if n != "devinfo"):
+        result, err = _run_section(name, env, SECTION_TIMEOUT_S[name])
+        if result is not None:
+            merged.update(result)
+            errors.pop(name, None)
+        else:
+            errors[name] = err or "failed"
+
+
 def main() -> None:
     errors: dict[str, str] = {}
     merged: dict = {}
     env = dict(os.environ)
+    base_env = dict(env)
     signal.signal(signal.SIGTERM, _on_sigterm)
     signal.signal(signal.SIGINT, _on_sigterm)
     try:
+        sweep = _grant_holder_sweep()
+        if sweep is not None:
+            merged["grant_holder_sweep"] = sweep
         env, devinfo, backend_err = _acquire_backend(env)
         if backend_err:
             errors["backend"] = backend_err
         merged.update(devinfo)
         bench_platform = devinfo.get("platform", "none")
 
-        for name in (n for n in SECTIONS if n != "devinfo"):
-            if bench_platform == "none":
+        if bench_platform == "none":
+            for name in (n for n in SECTIONS if n != "devinfo"):
                 errors[name] = "skipped: no backend"
-                continue
-            result, err = _run_section(name, env, SECTION_TIMEOUT_S[name])
-            if result is not None:
-                merged.update(result)
-            else:
-                errors[name] = err or "failed"
+        else:
+            _run_all_sections(env, merged, errors)
+
+        # A tunnel that recovered while the CPU fallback ran (~minutes)
+        # must not yield a CPU-only artifact: re-probe the default backend
+        # once, and if the chip is up, re-capture every headline section on
+        # it — the TPU numbers supersede, the CPU pass stays as provenance.
+        if backend_err and bench_platform != "tpu":
+            info, _ = _run_section("devinfo", base_env, 300, attempts=1)
+            if info is not None and info.get("platform") == "tpu":
+                merged["cpu_fallback_results"] = {
+                    k: v for k, v in merged.items()
+                    if isinstance(v, (int, float, bool, str))}
+                merged["cpu_fallback_superseded"] = True
+                errors["backend_initial"] = errors.pop("backend")
+                # fallback-pass section errors become provenance too: the
+                # canonical keys must reflect the TPU pass only, or a
+                # fully successful re-capture still reads as failed
+                for name in [n for n in errors if n in SECTIONS]:
+                    errors[f"{name}_cpu_fallback"] = errors.pop(name)
+                merged.update(info)
+                _run_all_sections(base_env, merged, errors)
     except _Terminated as exc:
         errors["orchestrator"] = f"terminated early: {exc}"
     except Exception as exc:  # noqa: BLE001 — the JSON line must still print
@@ -548,13 +666,27 @@ def main() -> None:
         value = round(total, 2)
         merged["headline_fallback"] = True
         merged.setdefault("smoke_ok", False)
+    bench_platform = merged.pop("platform", "none")
+    if bench_platform != "tpu":
+        # tiny-shape off-chip capture: make every number that can read as
+        # a hardware regression self-describing (round-3 verdict item 3)
+        expectations = {}
+        if "spec_speedup" in merged:
+            expectations["spec_speedup"] = (
+                "tiny CPU shapes: verification forward ~= k+1 plain steps, "
+                "<1 expected; the lever is weight-HBM-bound decode on chip")
+        if "decode_int8_tokens_per_s" in merged:
+            expectations["decode_int8_tokens_per_s"] = (
+                "pallas interpret mode: fused < unfused expected off-TPU")
+        if expectations:
+            merged["cpu_fallback_expectations"] = expectations
     line = {
         "metric": "accelerator_validation_seconds",
         "value": value,
         "unit": "s",
         "vs_baseline": round(REFERENCE_OPERATOR_WAIT_S / max(value, 1e-9), 2),
         "total_seconds": round(total, 2),
-        "bench_platform": merged.pop("platform", "none"),
+        "bench_platform": bench_platform,
         **merged,
     }
     if errors:

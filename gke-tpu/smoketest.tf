@@ -26,12 +26,26 @@
 
 locals {
   smoketest_enabled = local.tpu_enabled && var.smoketest.enabled
+  # target resolution: the named key if declared; otherwise, when exactly
+  # one slice exists, that slice (so renaming the sole slice never breaks
+  # the default target). A genuine mismatch against a multi-slice fleet
+  # must fail the PLAN with a message naming the bad key — the synthetic
+  # index below carries it into the error.
+  smoke_target = (
+    contains(keys(local.tpu_slice), var.smoketest.target_slice)
+    ? var.smoketest.target_slice
+    : (
+      length(local.tpu_slice) == 1
+      ? one(keys(local.tpu_slice))
+      : "smoketest.target_slice '${var.smoketest.target_slice}' is not a declared tpu_slices key"
+    )
+  )
   smoke_slices = (
     local.smoketest_enabled
     ? (
       var.smoketest.multislice
       ? local.tpu_slice
-      : { (var.smoketest.target_slice) = local.tpu_slice[var.smoketest.target_slice] }
+      : { (local.smoke_target) = local.tpu_slice[local.smoke_target] }
     )
     : {}
   )

@@ -102,6 +102,102 @@ run "v4_pod_slice_nap" {
   }
 }
 
+# v5p multi-host: 2x2x2 = 8 chips on fixed 4-chip hosts → a 2-host slice
+# with COMPACT placement (the generation's machine prefix differs from
+# v5e's; this run pins the whole derivation chain for v5p).
+run "v5p_multi_host" {
+  command = plan
+
+  variables {
+    tpu_slices = {
+      train = { version = "v5p", topology = "2x2x2" }
+    }
+  }
+
+  assert {
+    condition     = output.tpu_slices["train"].machine_type == "ct5p-hightpu-4t"
+    error_message = "v5p 2x2x2 must derive the ct5p 4-chip host type"
+  }
+  assert {
+    condition     = output.tpu_slices["train"].hosts == 2 && output.tpu_slices["train"].total_chips == 8
+    error_message = "v5p 2x2x2 is 8 chips across 2 hosts"
+  }
+  assert {
+    condition     = output.tpu_slices["train"].multi_host == true
+    error_message = "a 2-host v5p slice is multi-host"
+  }
+  assert {
+    condition     = google_container_node_pool.tpu_slice["train"].placement_policy[0].type == "COMPACT"
+    error_message = "multi-host v5p needs COMPACT placement"
+  }
+  assert {
+    condition     = google_container_node_pool.tpu_slice["train"].placement_policy[0].tpu_topology == "2x2x2"
+    error_message = "placement must carry the slice topology"
+  }
+  assert {
+    condition     = kubernetes_job_v1.tpu_smoketest["train"].spec[0].completions == 2
+    error_message = "v5p smoketest Job must run one indexed pod per host"
+  }
+}
+
+# v6e-8 single-host: prefer_single_host packs 2x4 = 8 chips onto ONE
+# ct6e-standard-8t host — no placement policy, no multi-host choreography.
+run "v6e_prefer_single_host" {
+  command = plan
+
+  variables {
+    tpu_slices = {
+      serve = { version = "v6e", topology = "2x4", prefer_single_host = true }
+    }
+  }
+
+  assert {
+    condition     = output.tpu_slices["serve"].machine_type == "ct6e-standard-8t"
+    error_message = "v6e 2x4 with prefer_single_host must pack onto the 8-chip host"
+  }
+  assert {
+    condition     = output.tpu_slices["serve"].hosts == 1 && output.tpu_slices["serve"].total_chips == 8
+    error_message = "prefer_single_host packs all 8 chips on one host"
+  }
+  assert {
+    condition     = output.tpu_slices["serve"].multi_host == false
+    error_message = "an 8t-packed v6e slice is single-host"
+  }
+  assert {
+    condition     = !contains(keys(google_container_node_pool.tpu_slice["serve"]), "placement_policy")
+    error_message = "single-host v6e must not set a placement policy"
+  }
+  assert {
+    condition     = output.tpu_slices["serve"].node_selectors["cloud.google.com/gke-tpu-accelerator"] == "tpu-v6e-slice"
+    error_message = "v6e pools must carry the v6e node selector"
+  }
+}
+
+# The same v6e topology WITHOUT prefer_single_host must fall back to the
+# multi-host 4t layout — the packing is opt-in.
+run "v6e_default_multi_host" {
+  command = plan
+
+  variables {
+    tpu_slices = {
+      serve = { version = "v6e", topology = "2x4" }
+    }
+  }
+
+  assert {
+    condition     = output.tpu_slices["serve"].machine_type == "ct6e-standard-4t"
+    error_message = "v6e 2x4 without packing must use the 4-chip host type"
+  }
+  assert {
+    condition     = output.tpu_slices["serve"].hosts == 2 && output.tpu_slices["serve"].multi_host == true
+    error_message = "unpacked v6e 2x4 is a 2-host slice"
+  }
+  assert {
+    condition     = google_container_node_pool.tpu_slice["serve"].placement_policy[0].tpu_topology == "2x4"
+    error_message = "unpacked v6e needs COMPACT placement with the topology"
+  }
+}
+
 # The negative path: spot and reservation are mutually exclusive per slice
 # (variable validation), so the plan itself must fail.
 run "spot_reservation_conflict" {

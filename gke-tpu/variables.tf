@@ -215,7 +215,9 @@ variable "smoketest" {
     proves the slice runs collectives). Runs one pod per slice host as an
     indexed Job with a headless service for jax.distributed bootstrap;
     wait_for_completion makes apply block on the result. target_slice names
-    the tpu_slices key to validate; multislice = true instead validates ALL
+    the tpu_slices key to validate (when exactly one slice is declared it
+    is targeted regardless, so renaming the sole slice never breaks the
+    default); multislice = true instead validates ALL
     declared slices as one jax.distributed world (one Job per slice,
     MEGASCALE env for libtpu's DCN transport, plus a cross-slice psum).
     Levels: psum | probes | burnin | full (full adds the MoE all-to-all

@@ -78,9 +78,12 @@ def _moe_ffn(h, layer, cfg: BurnInConfig, rules):
 
     def routed(x):
         bb, tt, _ = x.shape
+        # worst-case per-EXPERT load is the token count: a token's top-k
+        # experts are distinct, so it contributes at most one assignment
+        # to any single expert — scaling by k would only widen [T, E, C]
         out, _aux = moe_layer(
             x, layer["moe"], cfg, moe_rules,
-            capacity=drop_free_capacity(bb * tt * cfg.router_top_k))
+            capacity=drop_free_capacity(bb * tt))
         return out
 
     if t <= _MOE_PREFILL_CHUNK:

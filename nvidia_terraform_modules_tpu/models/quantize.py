@@ -163,11 +163,14 @@ def quantize_params(params, dtype=jnp.bfloat16):
         # router stays f32 (tiny, and routing decisions are
         # precision-sensitive), and 3-D expert stacks stay dense — their
         # einsum consumers don't route through QTensor (an int8 expert
-        # einsum kernel is a separate lever)
-        if getattr(x, "ndim", 0) != 2 or any(
-                "router" in str(k) for k in path):
+        # einsum kernel is a separate lever). Classification is by the
+        # leaf's EXACT key name — a substring match would silently
+        # mis-quantize any future param whose name merely contains
+        # "router"/"embed"
+        name = getattr(path[-1], "key", None) if path else None
+        if getattr(x, "ndim", 0) != 2 or name == "router":
             return x
-        is_embed = any("embed" in str(k) for k in path)
+        is_embed = name == "embed"
         axis = 0 if is_embed else -1
         q, s = quantize(x, axis=axis)
         return QTensor(q, s.reshape(-1), scale_axis=axis % x.ndim,

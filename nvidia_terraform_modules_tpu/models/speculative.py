@@ -16,10 +16,15 @@ incompressible token streams acceptance just drops toward zero and the
 loop degrades to ~plain greedy decode, never below it by more than the
 (k)-position verification overhead.
 
-**Exactness guarantee**: output EQUALS ``greedy_decode`` token for token,
-whatever the drafts are — acceptance tests argmax equality position by
-position, and the first mismatch is replaced by the verifier's own argmax
-(which is exactly the token plain greedy would have emitted). The cache
+**Exactness guarantee**: output EQUALS ``greedy_decode`` token for token
+*up to backend matmul-tiling numerics*, whatever the drafts are —
+acceptance tests argmax equality position by position, and the first
+mismatch is replaced by the verifier's own argmax (the token plain greedy
+would have emitted given equal logits). The acceptance logic itself is
+exact; the caveat is that the ``[1, k+1]`` verification forward can tile
+its matmuls differently from greedy's ``T=1`` step path, so on bf16 TPU a
+near-tie argmax may resolve differently (verified bit-exact on CPU f32 in
+``tests/test_speculative.py``). The cache
 rolls back by resetting ``pos`` only: rows past ``pos`` are causally
 masked out of every later attention and are overwritten in place when
 real decoding reaches them (``lax.dynamic_update_slice`` at the same

@@ -18,6 +18,7 @@ terraform binary in CI, so tfsim ships the same verbs offline::
     python -m nvidia_terraform_modules_tpu.tfsim destroy gke-tpu ...
     python -m nvidia_terraform_modules_tpu.tfsim output -state f [NAME] [-json]
     python -m nvidia_terraform_modules_tpu.tfsim state list|show|rm|mv ... -state f
+    python -m nvidia_terraform_modules_tpu.tfsim force-unlock LOCK_ID -state f
     python -m nvidia_terraform_modules_tpu.tfsim graph gke-tpu -var ...
     python -m nvidia_terraform_modules_tpu.tfsim test gke-tpu [-filter F]
     python -m nvidia_terraform_modules_tpu.tfsim workspace new gke-tpu staging
@@ -28,19 +29,32 @@ terraform binary in CI, so tfsim ships the same verbs offline::
 
 Exit codes follow the terraform convention: 0 success / no diffs, 1 findings
 (validation errors, fmt diffs, destroy hazards), 2 usage errors.
+
+State-touching verbs (plan/apply/refresh/import/taint/untaint/state
+rm|mv|push) take terraform's state lock for the duration — ``-lock=false``
+opts out, ``-lock-timeout=10s`` waits for a contender, ``force-unlock``
+breaks a crashed run's lock by ID (``tfsim/locking.py``). A module may
+declare ``terraform { backend "gcs" { bucket = … prefix = … } }``; tfsim
+resolves it to a shared simulated bucket (``$TFSIM_GCS_ROOT``) so the
+remote-state workflow the reference recommends
+(``/root/reference/README.md:89-91``) is representable offline.
 """
 
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import sys
+
+_UNRESOLVED = object()  # sentinel: "derive the state path yourself"
 
 from .destroy import simulate_destroy
 from .docs import check_readme, generate_docs
 from .fmt import check_text, format_text
 from .lockfile import LockfileError, check_lockfile, write_lockfile
+from .locking import LockError
 from .module import load_module
 from .plan import PlanError, load_tfvars, render, simulate_plan, to_dot
 from .planfile import (
@@ -158,7 +172,22 @@ def _diag_json(f) -> dict:
 
 
 def cmd_validate(args) -> int:
-    findings = validate_module(load_module(args.dir))
+    try:
+        mod = load_module(args.dir)
+    except ValueError as ex:
+        # a module that doesn't load IS a validation failure (terraform
+        # validate reports HCL/config errors as diagnostics, not crashes)
+        if getattr(args, "json", False):
+            print(json.dumps({
+                "format_version": "1.0", "valid": False, "error_count": 1,
+                "warning_count": 0,
+                "diagnostics": [{"severity": "error", "summary": str(ex)}],
+            }, indent=2, sort_keys=True))
+        else:
+            print(f"Error: {ex}", file=sys.stderr)
+            print("1 finding(s), 1 error(s).")
+        return 1
+    findings = validate_module(mod)
     errors = [f for f in findings if f.severity == "error"]
     if getattr(args, "json", False):
         # terraform's `validate -json` diagnostics shape, so machine
@@ -203,17 +232,62 @@ def _write_state(path: str, state: State) -> None:
         fh.write(state.to_json())
 
 
-def _plan_against_state(args):
+def _parse_duration(s: str) -> float:
+    """Terraform-style duration (``10s``, ``1m``, ``500ms``) → seconds."""
+    s = (s or "0s").strip()
+    try:
+        if s.endswith("ms"):
+            return float(s[:-2]) / 1000.0
+        if s.endswith("s"):
+            return float(s[:-1])
+        if s.endswith("m"):
+            return float(s[:-1]) * 60.0
+        return float(s)
+    except ValueError:
+        raise ValueError(
+            f"invalid -lock-timeout {s!r}: use a duration like 10s or 1m"
+        ) from None
+
+
+@contextlib.contextmanager
+def _state_lock(args, state_path: str | None, operation: str):
+    """Hold the state lock across a state-touching verb.
+
+    Terraform locks the backend for every operation that could write
+    state and holds it from first read to last write; ``-lock=false``
+    opts out, ``-lock-timeout`` waits for a contender to finish. A
+    ``None`` path (stateless invocation) needs no lock.
+    """
+    if not state_path or getattr(args, "lock", "true") == "false":
+        yield
+        return
+    from .locking import acquire_lock, release_lock
+
+    info = acquire_lock(
+        state_path, operation,
+        timeout_s=_parse_duration(getattr(args, "lock_timeout", "0s")))
+    try:
+        yield
+    finally:
+        release_lock(info)
+
+
+def _plan_against_state(args, mod=None, state_path=_UNRESOLVED):
     """(plan, prior-state, state-path) for plan/apply/import verbs.
 
-    The state path honours workspaces: explicit ``-state`` wins, else the
-    selected workspace's ``terraform.tfstate.d`` file (opt-in — only once a
-    workspace verb has been used in the dir).
+    The state path honours workspaces: explicit ``-state`` wins, else a
+    declared ``backend`` block, else the selected workspace's
+    ``terraform.tfstate.d`` file (opt-in — only once a workspace verb has
+    been used in the dir). Callers that must lock BEFORE the state read
+    pass a preloaded ``mod``/``state_path`` from :func:`_resolve_paths`.
     """
-    mod = load_module(args.dir)
+    if mod is None:
+        mod = load_module(args.dir)
     plan = simulate_plan(mod, _gather_vars(args), workspace=_workspace_of(args))
-    state_path = resolve_state_path(args.dir, args.state,
-                                    getattr(args, "workspace", None))
+    if state_path is _UNRESOLVED:
+        state_path = resolve_state_path(args.dir, args.state,
+                                        getattr(args, "workspace", None),
+                                        backend=mod.backend)
     prior = _load_state(state_path)
     # the ON-DISK serial, before any in-memory moved{} migration: what a
     # saved plan must be checked against at apply-file time (the apply
@@ -290,14 +364,24 @@ def _resource_block_for(mod, addr: str, cache: dict):
         mc = mod.module_calls.get(name)
         src_attr = mc.body.attr("source") if mc is not None else None
         src_val = getattr(getattr(src_attr, "expr", None), "value", None)
-        if not isinstance(src_val, str):
+        if not isinstance(src_val, str) or not (
+                src_val.startswith("./") or src_val.startswith("../")):
+            # registry-source child: a fully-computed stub in the plan
+            # (plan.py), so there is no local config to read refusals from
             return None
         child_path = os.path.normpath(os.path.join(mod.path, src_val))
         if child_path not in cache:
             try:
                 cache[child_path] = load_module(child_path)
-            except Exception:  # noqa: BLE001 — missing child: no refusal info
-                return None
+            except Exception as exc:  # noqa: BLE001 — surface, never skip
+                # a LOCAL child that fails to load must NOT silently
+                # disable its resources' lifecycle.prevent_destroy
+                # refusals — a safety check may not degrade to "allow"
+                # on error
+                raise PlanError(
+                    f"cannot evaluate lifecycle.prevent_destroy for "
+                    f"{addr!r}: child module {child_path!r} failed to "
+                    f"load: {exc}") from exc
         mod = cache[child_path]
     return mod.resources.get(addr.split("[")[0])
 
@@ -330,33 +414,54 @@ def _destroy_plan_of(plan, prior, module_dir: str):
     return empty, diff(empty, prior)
 
 
+def _resolve_paths(args):
+    """(module, state-path) ahead of locking: the lock must be taken
+    before the first state read, and resolving the path needs the
+    module's ``backend`` block."""
+    mod = load_module(args.dir)
+    # validate -workspace BEFORE the path is used for anything: acquiring
+    # a lock creates parent directories, which would make a typo'd
+    # workspace spring into existence instead of refusing
+    _workspace_of(args)
+    state_path = resolve_state_path(args.dir, args.state,
+                                    getattr(args, "workspace", None),
+                                    backend=mod.backend)
+    return mod, state_path
+
+
 def cmd_plan(args) -> int:
     try:
-        plan, prior, state_path, disk_serial = _plan_against_state(args)
-        if getattr(args, "refresh_only", False):
-            if getattr(args, "out", None) or getattr(args, "destroy", False):
-                print("Error: -refresh-only cannot be combined with -out/"
-                      "-destroy (a refresh accepts drift, it does not "
-                      "stage actions)", file=sys.stderr)
-                return 2
-            return _refresh_only_print(plan, prior, args)
-        if getattr(args, "destroy", False):
-            if getattr(args, "target", None):
-                print("Error: -destroy -target is not supported — destroy "
-                      "everything via the saved plan, or surgically with "
-                      "`state rm` + apply", file=sys.stderr)
-                return 2
-            plan, d = _destroy_plan_of(plan, prior, args.dir)
-        else:
-            d = diff(plan, prior, getattr(args, "target", None))
-        if getattr(args, "out", None):
-            save_plan_file(args.out, plan_file_payload(
-                plan, d, disk_serial, module_dir=os.path.abspath(args.dir),
-                workspace=_workspace_of(args), state_path=state_path,
-                targets=getattr(args, "target", None)))
-            print(f'Saved the plan to: {args.out}\n'
-                  f'To perform exactly these actions, run:\n'
-                  f'  tfsim apply {args.out}', file=sys.stderr)
+        mod, state_path = _resolve_paths(args)
+        with _state_lock(args, state_path, "OperationTypePlan"):
+            plan, prior, state_path, disk_serial = _plan_against_state(
+                args, mod, state_path)
+            if getattr(args, "refresh_only", False):
+                if getattr(args, "out", None) or getattr(args, "destroy",
+                                                         False):
+                    print("Error: -refresh-only cannot be combined with "
+                          "-out/-destroy (a refresh accepts drift, it "
+                          "does not stage actions)", file=sys.stderr)
+                    return 2
+                return _refresh_only_print(plan, prior, args)
+            if getattr(args, "destroy", False):
+                if getattr(args, "target", None):
+                    print("Error: -destroy -target is not supported — "
+                          "destroy everything via the saved plan, or "
+                          "surgically with `state rm` + apply",
+                          file=sys.stderr)
+                    return 2
+                plan, d = _destroy_plan_of(plan, prior, args.dir)
+            else:
+                d = diff(plan, prior, getattr(args, "target", None))
+            if getattr(args, "out", None):
+                save_plan_file(args.out, plan_file_payload(
+                    plan, d, disk_serial,
+                    module_dir=os.path.abspath(args.dir),
+                    workspace=_workspace_of(args), state_path=state_path,
+                    targets=getattr(args, "target", None)))
+                print(f'Saved the plan to: {args.out}\n'
+                      f'To perform exactly these actions, run:\n'
+                      f'  tfsim apply {args.out}', file=sys.stderr)
     except (PlanError, PlanFileError, ValueError, OSError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
         return 1
@@ -397,23 +502,26 @@ def _apply_saved_plan(args) -> int:
     # explicit -state wins; otherwise the file's RECORDED resolution — the
     # currently-selected workspace must not retarget a reviewed plan
     state_path = args.state or payload["state_path"]
-    prior = _load_state(state_path)
-    check_not_stale(payload, prior)
-    if prior is not None:
-        prior, renames = migrate_state(prior, load_module(payload["module_dir"]))
-        for old, new in renames:
-            print(f"  moved: {old} -> {new}", file=sys.stderr)
-    targets = payload["targets"] or None
-    d = diff(plan, prior, targets)
-    if d.actions != payload["actions"]:
-        drifted = sorted(set(d.actions.items())
-                         ^ set(payload["actions"].items()))
-        raise PlanFileError(
-            f"saved plan no longer matches a fresh diff against the same "
-            f"state serial (module or moved{{}} drift?): {drifted[:5]}")
-    state = apply_plan(plan, prior, targets, d=d)
-    if state_path:
-        _write_state(state_path, state)
+    with _state_lock(args, state_path, "OperationTypeApply"):
+        prior = _load_state(state_path)
+        check_not_stale(payload, prior)
+        if prior is not None:
+            prior, renames = migrate_state(
+                prior, load_module(payload["module_dir"]))
+            for old, new in renames:
+                print(f"  moved: {old} -> {new}", file=sys.stderr)
+        targets = payload["targets"] or None
+        d = diff(plan, prior, targets)
+        if d.actions != payload["actions"]:
+            drifted = sorted(set(d.actions.items())
+                             ^ set(payload["actions"].items()))
+            raise PlanFileError(
+                f"saved plan no longer matches a fresh diff against the "
+                f"same state serial (module or moved{{}} drift?): "
+                f"{drifted[:5]}")
+        state = apply_plan(plan, prior, targets, d=d)
+        if state_path:
+            _write_state(state_path, state)
     for failure in plan.check_failures:
         print(f"Warning: {failure}", file=sys.stderr)
     print(d.summary().replace("Plan:", "Apply complete:")
@@ -431,20 +539,23 @@ def cmd_apply(args) -> int:
                       f"file)", file=sys.stderr)
                 return 2
             return _apply_saved_plan(args)
-        plan, prior, state_path, _serial = _plan_against_state(args)
-        if getattr(args, "refresh_only", False):
-            n, state = _refresh_only_report(plan, prior)
-            if state_path and n:
+        mod, state_path = _resolve_paths(args)
+        with _state_lock(args, state_path, "OperationTypeApply"):
+            plan, prior, state_path, _serial = _plan_against_state(
+                args, mod, state_path)
+            if getattr(args, "refresh_only", False):
+                n, state = _refresh_only_report(plan, prior)
+                if state_path and n:
+                    _write_state(state_path, state)
+                return 0
+            targets = getattr(args, "target", None)
+            d = diff(plan, prior, targets)
+            state = apply_plan(plan, prior, targets, d=d)
+            if state_path:
                 _write_state(state_path, state)
-            return 0
-        targets = getattr(args, "target", None)
-        d = diff(plan, prior, targets)
-        state = apply_plan(plan, prior, targets, d=d)
     except (PlanError, PlanFileError, ValueError, OSError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
         return 1
-    if state_path:
-        _write_state(state_path, state)
     for failure in plan.check_failures:
         print(f"Warning: {failure}", file=sys.stderr)
     print(d.summary().replace("Plan:", "Apply complete:")
@@ -499,14 +610,17 @@ def cmd_refresh(args) -> int:
     without proposing config changes. Offline that means re-rendering the
     outputs block against the current state and reporting orphans."""
     try:
-        plan, prior, state_path, _serial = _plan_against_state(args)
-        if prior is None:
-            print(f"Error: no state at {state_path!r} — nothing to refresh",
-                  file=sys.stderr)
-            return 1
-        n, state = _refresh_only_report(plan, prior)
-        if state_path and n:
-            _write_state(state_path, state)
+        mod, state_path = _resolve_paths(args)
+        with _state_lock(args, state_path, "OperationTypeRefresh"):
+            plan, prior, state_path, _serial = _plan_against_state(
+                args, mod, state_path)
+            if prior is None:
+                print(f"Error: no state at {state_path!r} — nothing to "
+                      f"refresh", file=sys.stderr)
+                return 1
+            n, state = _refresh_only_report(plan, prior)
+            if state_path and n:
+                _write_state(state_path, state)
     except (PlanError, ValueError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
         return 1
@@ -527,9 +641,16 @@ def cmd_output(args) -> int:
               "(workspace-resolved)", file=sys.stderr)
         return 2
     try:
-        state_path = args.state or workspace_state_path(
-            args.dir, _workspace_of(args))
-    except WorkspaceError as ex:
+        state_path = args.state
+        if not state_path:
+            # -dir resolution honours a declared backend block the same
+            # way plan/apply do, then falls back to the workspace file
+            backend = load_module(args.dir).backend
+            state_path = resolve_state_path(
+                args.dir, None, getattr(args, "workspace", None),
+                backend=backend) or workspace_state_path(
+                    args.dir, _workspace_of(args))
+    except (WorkspaceError, ValueError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
         return 1
     state = _load_state(state_path)
@@ -604,6 +725,19 @@ def cmd_state(args) -> int:
               f"{wanted.get(args.subcmd, '1+')} address argument(s), "
               f"got {n}", file=sys.stderr)
         return 2
+    # rm/mv/push rewrite the statefile — terraform locks exactly these
+    # (list/show/pull are read-only and stay lock-free)
+    mutating = args.subcmd in ("rm", "mv", "push")
+    try:
+        with _state_lock(args, args.state if mutating else None,
+                         f"OperationType{args.subcmd.capitalize()}"):
+            return _cmd_state_locked(args)
+    except ValueError as ex:  # LockError + bad -lock-timeout durations
+        print(f"Error: {ex}", file=sys.stderr)
+        return 1
+
+
+def _cmd_state_locked(args) -> int:
     if args.subcmd == "push":
         # terraform state push: stdin replaces the statefile, REFUSED when
         # the incoming serial is behind the current one (lineage guard) —
@@ -694,22 +828,59 @@ def cmd_state(args) -> int:
     raise SystemExit(f"unknown state subcommand {args.subcmd!r}")
 
 
+def cmd_force_unlock(args) -> int:
+    """``terraform force-unlock ID``: break a stuck state lock.
+
+    Requires the holder's lock ID (printed in the contention error) — the
+    interlock proving the operator inspected the holder before breaking
+    it. The state path comes from ``-state`` or a module dir's
+    backend/workspace resolution, same as plan/apply.
+    """
+    from .locking import force_unlock
+
+    try:
+        if args.state:
+            state_path = args.state
+        elif args.dir:
+            _mod, state_path = _resolve_paths(args)
+            if state_path is None:
+                print(f"Error: {args.dir!r} resolves no statefile (no "
+                      f"backend/workspace) — pass -state", file=sys.stderr)
+                return 2
+        else:
+            print("Error: force-unlock needs -state FILE or -dir "
+                  "MODULE_DIR", file=sys.stderr)
+            return 2
+        holder = force_unlock(state_path, args.lock_id)
+    except ValueError as ex:
+        print(f"Error: {ex}", file=sys.stderr)
+        return 1
+    print(f"tfsim state has been successfully unlocked!\n\n"
+          f"The state has been unlocked, and tfsim commands should now "
+          f"be able to obtain a new lock on the state. (Broken lock was "
+          f"held by {holder.who}, {holder.operation}.)")
+    return 0
+
+
 def cmd_import(args) -> int:
     """``terraform import DIR ADDR ID``: adopt a live resource into state."""
     try:
         # same path as plan/apply — including moved{} migration: importing
         # a rename destination against un-migrated state would wedge the
         # statefile at the next plan ("destination already exists")
-        plan, prior, state_path, _serial = _plan_against_state(args)
+        mod, state_path = _resolve_paths(args)
         if not state_path:
             print("Error: import requires -state (or a selected workspace) "
                   "to adopt into", file=sys.stderr)
             return 2
-        state = import_resource(prior, plan, args.address, args.id)
-    except (PlanError, ValueError) as ex:
+        with _state_lock(args, state_path, "OperationTypeImport"):
+            plan, prior, state_path, _serial = _plan_against_state(
+                args, mod, state_path)
+            state = import_resource(prior, plan, args.address, args.id)
+            _write_state(state_path, state)
+    except (PlanError, ValueError, OSError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
         return 1
-    _write_state(state_path, state)
     print(f"{args.address}: Import prepared. Resource written to state.")
     return 0
 
@@ -797,6 +968,15 @@ def cmd_taint(args) -> int:
     as one add and one destroy) regardless of config drift; the apply that
     recreates it clears the mark — terraform's lifecycle exactly.
     """
+    try:
+        with _state_lock(args, args.state, "OperationTypeTaint"):
+            return _cmd_taint_locked(args)
+    except ValueError as ex:
+        print(f"Error: {ex}", file=sys.stderr)
+        return 1
+
+
+def _cmd_taint_locked(args) -> int:
     state = _load_state(args.state)
     if state is None:
         print(f"Error: no state at {args.state!r}", file=sys.stderr)
@@ -913,6 +1093,14 @@ def cmd_init(args) -> int:
     sim_version = "1.9.0"   # the terraform version tfsim simulates
 
     try:
+        # backend first, as real init does ("Initializing the backend...")
+        root_backend = load_module(args.dir).backend
+        if root_backend is not None:
+            from .workspace import backend_state_path
+
+            print(f'Initializing the backend ("{root_backend.type}")...')
+            print(f"- state resolves to "
+                  f"{backend_state_path(args.dir, root_backend)}")
         print(f"Initializing modules ({args.dir})...")
         checked: set = set()
         for label, d, mod in walk_module_tree(args.dir):
@@ -994,6 +1182,12 @@ def main(argv: list[str] | None = None) -> int:
         formatter_class=argparse.RawDescriptionHelpFormatter)
     sub = p.add_subparsers(dest="cmd", required=True)
 
+    def add_lock_args(c):
+        # terraform's flags verbatim: -lock=false opts out of state
+        # locking, -lock-timeout=10s waits for a contender to finish
+        c.add_argument("-lock", default="true", choices=["true", "false"])
+        c.add_argument("-lock-timeout", default="0s", dest="lock_timeout")
+
     def add_module_cmd(name, fn, state=False):
         c = sub.add_parser(name)
         c.add_argument("dir")
@@ -1001,6 +1195,7 @@ def main(argv: list[str] | None = None) -> int:
         c.add_argument("-var-file", action="append", dest="var_file")
         if state:
             c.add_argument("-state", default=None)
+            add_lock_args(c)
         c.set_defaults(fn=fn)
         return c
 
@@ -1061,6 +1256,7 @@ def main(argv: list[str] | None = None) -> int:
         tn = sub.add_parser(name)
         tn.add_argument("address")
         tn.add_argument("-state", required=True)
+        add_lock_args(tn)
         tn.set_defaults(fn=cmd_taint, untaint=(name == "untaint"))
 
     st = sub.add_parser("state")
@@ -1069,7 +1265,14 @@ def main(argv: list[str] | None = None) -> int:
     st.add_argument("address", nargs="*")
     st.add_argument("-state", required=True)
     st.add_argument("-force", action="store_true")
+    add_lock_args(st)
     st.set_defaults(fn=cmd_state)
+
+    fu = sub.add_parser("force-unlock")
+    fu.add_argument("lock_id")
+    fu.add_argument("-state", default=None)
+    fu.add_argument("-dir", default=None)
+    fu.set_defaults(fn=cmd_force_unlock)
 
     t = add_module_cmd("test", cmd_test)
     t.add_argument("-filter", action="append", dest="filter")

@@ -1,0 +1,170 @@
+"""State locking: terraform's shared-state concurrency guard, simulated.
+
+The reference explicitly recommends remote state for shared use
+(``/root/reference/README.md:89-91``, ``/root/reference/eks/README.md:48-49``);
+what makes sharing *safe* in real terraform is the state lock every
+state-touching operation takes on the backend, the lock-holder error a
+contender gets, and ``terraform force-unlock <ID>`` for breaking a lock a
+crashed run left behind. tfsim mirrors that mechanism for its file states:
+
+- a sidecar ``<state>.lock.info`` JSON (the field shape terraform's local
+  backend writes to ``.terraform.tfstate.lock.info``), created with
+  ``O_CREAT | O_EXCL`` so acquisition is atomic on any local/NFS-ish
+  filesystem;
+- contention raises :class:`LockError` carrying the holder's
+  :class:`LockInfo`, rendered in terraform's "Error acquiring the state
+  lock" shape by the CLI;
+- ``-lock-timeout`` retry loop and ``-lock=false`` opt-out, same flags;
+- ``force-unlock`` gated on the lock ID — a stale lock (dead holder) is
+  *never* auto-broken, exactly terraform's stance: the operator must
+  confirm the holder is gone and break it by ID.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import getpass
+import json
+import os
+import socket
+import time
+import uuid
+
+from .. import __version__
+
+
+@dataclasses.dataclass
+class LockInfo:
+    """The lock sidecar's payload — terraform's LockInfo field names."""
+
+    id: str
+    operation: str
+    who: str
+    created: str
+    path: str
+    info: str = ""
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "ID": self.id, "Operation": self.operation, "Info": self.info,
+            "Who": self.who, "Version": __version__,
+            "Created": self.created, "Path": self.path,
+        }, indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "LockInfo":
+        raw = json.loads(text)
+        return cls(id=raw["ID"], operation=raw.get("Operation", "?"),
+                   who=raw.get("Who", "?"), created=raw.get("Created", "?"),
+                   path=raw.get("Path", "?"), info=raw.get("Info", ""))
+
+    def describe(self) -> str:
+        """The indented block terraform prints under "Lock Info:"."""
+        return (f"  ID:        {self.id}\n"
+                f"  Path:      {self.path}\n"
+                f"  Operation: {self.operation}\n"
+                f"  Who:       {self.who}\n"
+                f"  Created:   {self.created}")
+
+
+class LockError(ValueError):  # ValueError: the CLI's "Error: …" rc-1 family
+    def __init__(self, message: str, holder: LockInfo | None = None):
+        super().__init__(message)
+        self.holder = holder
+
+
+def lock_path(state_path: str) -> str:
+    return state_path + ".lock.info"
+
+
+def _holder(state_path: str) -> LockInfo | None:
+    try:
+        with open(lock_path(state_path)) as fh:
+            return LockInfo.from_json(fh.read())
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError, KeyError):
+        # unreadable/corrupt sidecar: still a lock — refuse with a stub
+        # holder rather than silently proceeding into a shared write
+        return LockInfo(id="<unreadable>", operation="?", who="?",
+                        created="?", path=state_path)
+
+
+def acquire_lock(state_path: str, operation: str,
+                 timeout_s: float = 0.0) -> LockInfo:
+    """Take the state lock or raise :class:`LockError` with holder info.
+
+    ``timeout_s`` > 0 retries until the deadline (terraform's
+    ``-lock-timeout``); 0 fails on first contention. The sidecar is
+    created atomically (``O_CREAT|O_EXCL``) so two contenders can never
+    both win, and the directory is created on demand so a fresh backend
+    path locks as well as an existing one.
+    """
+    info = LockInfo(
+        id=str(uuid.uuid4()), operation=operation,
+        who=f"{getpass.getuser()}@{socket.gethostname()}",
+        created=datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        path=state_path)
+    parent = os.path.dirname(os.path.abspath(state_path))
+    os.makedirs(parent, exist_ok=True)
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            fd = os.open(lock_path(state_path),
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+        except FileExistsError:
+            if time.monotonic() < deadline:
+                time.sleep(0.2)
+                continue
+            holder = _holder(state_path)
+            if holder is None:
+                # holder vanished between O_EXCL failing and the read —
+                # the lock was just released; take it on the next spin
+                continue
+            raise LockError(
+                "Error acquiring the state lock\n\n"
+                "Error message: resource temporarily unavailable\n"
+                "Lock Info:\n" + holder.describe() + "\n\n"
+                "tfsim acquires a state lock to protect the state from "
+                "being written\nby multiple users at the same time. "
+                "Please resolve the issue above and try\nagain. If the "
+                "lock is stale (its holder crashed), break it with:\n"
+                f"  tfsim force-unlock -state {state_path} {holder.id}",
+                holder=holder) from None
+        with os.fdopen(fd, "w") as fh:
+            fh.write(info.to_json())
+        return info
+
+
+def release_lock(info: LockInfo) -> None:
+    """Drop the lock — only if the sidecar still carries OUR id.
+
+    After a ``force-unlock`` + re-acquire by another operator, the
+    original process must not remove the new holder's lock on exit.
+    """
+    holder = _holder(info.path)
+    if holder is not None and holder.id == info.id:
+        try:
+            os.remove(lock_path(info.path))
+        except OSError:
+            pass
+
+
+def force_unlock(state_path: str, lock_id: str) -> LockInfo:
+    """``terraform force-unlock``: break a (stale) lock by its ID.
+
+    The ID requirement is the safety interlock: it proves the operator
+    read the holder info (and so had the chance to check the holder is
+    really dead) instead of blindly clearing contention.
+    """
+    holder = _holder(state_path)
+    if holder is None:
+        raise LockError(
+            f"failed to unlock state: no lock is held on {state_path!r}")
+    if holder.id != lock_id:
+        raise LockError(
+            f"failed to unlock state: lock id {lock_id!r} does not match "
+            f"the existing lock:\n" + holder.describe(), holder=holder)
+    os.remove(lock_path(state_path))
+    return holder

@@ -66,6 +66,22 @@ class Provider:
 
 
 @dataclasses.dataclass
+class Backend:
+    """A ``terraform { backend "TYPE" { … } }`` declaration.
+
+    Terraform forbids variables/references in backend config (it is read
+    before any evaluation context exists), so ``config`` holds only the
+    literal attributes; the loader rejects anything else with terraform's
+    own "Variables may not be used here" stance.
+    """
+
+    type: str
+    config: dict[str, Any]
+    file: str
+    line: int
+
+
+@dataclasses.dataclass
 class Module:
     path: str
     variables: dict[str, Variable]
@@ -80,6 +96,7 @@ class Module:
     files: dict[str, A.Body]
     moved: list[A.Block] = dataclasses.field(default_factory=list)
     checks: list[A.Block] = dataclasses.field(default_factory=list)
+    backend: Optional[Backend] = None
 
     def resource(self, type_: str, name: str) -> Resource:
         return self.resources[f"{type_}.{name}"]
@@ -255,6 +272,27 @@ def _ingest(mod: Module, blk: A.Block, fname: str) -> None:
                         if isinstance(item.key, A.Literal) and isinstance(item.value, A.Literal):
                             spec[str(item.key.value)] = item.value.value
                 mod.required_providers[attr.name] = spec
+        for bk in blk.body.blocks_of("backend"):
+            if mod.backend is not None:
+                raise ModuleLoadError(
+                    f"{full}:{bk.line}: duplicate backend block — a "
+                    f"configuration can only declare one backend")
+            if not bk.labels:
+                raise ModuleLoadError(
+                    f"{full}:{bk.line}: backend block needs a type label "
+                    f'(e.g. backend "gcs")')
+            config: dict[str, Any] = {}
+            for attr in bk.body.attributes:
+                if not isinstance(attr.expr, A.Literal):
+                    # terraform reads backend config before any eval
+                    # context exists: "Variables may not be used here."
+                    raise ModuleLoadError(
+                        f"{full}:{attr.line}: backend {attr.name!r} must "
+                        f"be a literal — variables may not be used in "
+                        f"backend configuration (terraform semantics)")
+                config[attr.name] = attr.expr.value
+            mod.backend = Backend(type=bk.labels[0], config=config,
+                                  file=fname, line=bk.line)
     elif blk.type == "moved":
         mod.moved.append(blk)
     elif blk.type == "check":

@@ -75,16 +75,57 @@ def workspace_state_path(module_dir: str, name: str | None = None) -> str:
     return os.path.join(_state_dir(module_dir), name, _STATE_FILE)
 
 
+def backend_state_path(module_dir: str, backend,
+                       workspace: str | None = None) -> str:
+    """Statefile for a ``terraform { backend "…" }`` declaration.
+
+    The reference recommends remote state for shared use
+    (``/root/reference/README.md:89-91``) but never configures it; tfsim
+    makes the workflow representable offline. The ``gcs`` backend maps
+    the bucket to a local directory tree — ``$TFSIM_GCS_ROOT`` (so two
+    checkouts can genuinely share one "bucket", the multi-operator
+    story) or ``<dir>/.terraform/gcs-sim`` by default — laid out the way
+    the real backend lays out objects: ``<prefix>/<workspace>.tfstate``.
+    The ``local`` backend honours its ``path`` attribute. Anything else
+    is declared-but-unsimulated: a clean error says to pass ``-state``.
+    """
+    name = workspace or (current_workspace(module_dir)
+                         if workspaces_enabled(module_dir) else DEFAULT)
+    if backend.type == "gcs":
+        bucket = backend.config.get("bucket")
+        if not isinstance(bucket, str) or not bucket:
+            raise WorkspaceError(
+                'backend "gcs" requires a literal `bucket` attribute')
+        root = os.environ.get("TFSIM_GCS_ROOT") or os.path.join(
+            module_dir, ".terraform", "gcs-sim")
+        prefix = str(backend.config.get("prefix", "")).strip("/")
+        parts = [root, bucket] + ([prefix] if prefix else [])
+        return os.path.join(*parts, f"{name}.tfstate.json")
+    if backend.type == "local":
+        if name != DEFAULT:
+            return os.path.join(_state_dir(module_dir), name, _STATE_FILE)
+        return os.path.join(module_dir,
+                            str(backend.config.get("path",
+                                                   "terraform.tfstate")))
+    raise WorkspaceError(
+        f'backend "{backend.type}" is not simulated by tfsim (gcs and '
+        f"local are) — pass -state to choose the statefile explicitly")
+
+
 def resolve_state_path(module_dir: str, explicit: str | None,
-                       workspace: str | None = None) -> str | None:
+                       workspace: str | None = None,
+                       backend=None) -> str | None:
     """State path for a plan/apply/output invocation.
 
-    Explicit ``-state`` always wins; otherwise the workspace's statefile —
-    but only when workspaces are enabled for the dir (opt-in, see module
-    docstring). Returns None to mean "no state" (the legacy behaviour).
+    Explicit ``-state`` always wins; then a declared ``backend`` block;
+    then the workspace's statefile — but only when workspaces are enabled
+    for the dir (opt-in, see module docstring). Returns None to mean "no
+    state" (the legacy behaviour).
     """
     if explicit:
         return explicit
+    if backend is not None:
+        return backend_state_path(module_dir, backend, workspace)
     if workspace or workspaces_enabled(module_dir):
         return workspace_state_path(module_dir, workspace)
     return None

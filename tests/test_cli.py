@@ -945,6 +945,43 @@ def test_plan_destroy_refuses_child_module_prevent_destroy(tmp_path, capsys):
     assert "prevent_destroy" in err and "module.sec" in err
 
 
+def test_resource_block_for_broken_child_raises(tmp_path):
+    """A local child that fails to load must surface a PlanError, not
+    silently disable its resources' prevent_destroy refusals (advisor
+    finding, round 3: a safety check may not degrade to 'allow' on
+    error). Registry-source children stay None — they are plan stubs
+    with no local config to read refusals from."""
+    import textwrap
+
+    import pytest
+
+    from nvidia_terraform_modules_tpu.tfsim.__main__ import (
+        _resource_block_for,
+    )
+    from nvidia_terraform_modules_tpu.tfsim.module import load_module
+    from nvidia_terraform_modules_tpu.tfsim.plan import PlanError
+
+    child = tmp_path / "child"
+    child.mkdir()
+    (child / "main.tf").write_text('resource "null_resource" {{{ broken')
+    mod_dir = tmp_path / "mod"
+    mod_dir.mkdir()
+    (mod_dir / "main.tf").write_text(textwrap.dedent("""
+        module "sec" {
+          source = "../child"
+        }
+        module "reg" {
+          source = "registry/vpc/google"
+        }
+    """))
+    mod = load_module(str(mod_dir))
+    with pytest.raises(PlanError, match="prevent_destroy"):
+        _resource_block_for(
+            mod, "module.sec.google_compute_network.keep", {})
+    assert _resource_block_for(
+        mod, "module.reg.google_compute_network.keep", {}) is None
+
+
 def test_plan_destroy_rejects_target(capsys):
     assert main(["plan", GKE_TPU, "-destroy", "-target",
                  "google_compute_network.vpc"] + VARS) == 2

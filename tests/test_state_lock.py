@@ -1,0 +1,424 @@
+"""State locking + remote-backend simulation (round-3 VERDICT item 5).
+
+Terraform's shared-state story — the piece the reference recommends but
+never configures (``/root/reference/README.md:89-91``) — is: a backend
+block names where state lives, every state-touching verb takes a lock
+there, contention fails with the holder's lock info, and ``force-unlock``
+breaks a crashed run's lock by ID. These tests drive that lifecycle
+through ``main(argv)`` plus the :mod:`tfsim.locking` API directly.
+"""
+
+import json
+import os
+import textwrap
+import threading
+import time
+
+import pytest
+
+from nvidia_terraform_modules_tpu.tfsim.__main__ import main
+from nvidia_terraform_modules_tpu.tfsim.locking import (
+    LockError,
+    LockInfo,
+    acquire_lock,
+    force_unlock,
+    lock_path,
+    release_lock,
+)
+
+
+@pytest.fixture
+def mod(tmp_path):
+    d = tmp_path / "mod"
+    d.mkdir()
+    (d / "main.tf").write_text(textwrap.dedent("""
+        resource "google_compute_network" "vpc" {
+          name = "n"
+        }
+    """))
+    return str(d)
+
+
+def _state(tmp_path) -> str:
+    return str(tmp_path / "s.json")
+
+
+# ---------------------------------------------------------------- locking API
+
+
+def test_acquire_release_roundtrip(tmp_path):
+    s = _state(tmp_path)
+    info = acquire_lock(s, "OperationTypeApply")
+    assert os.path.exists(lock_path(s))
+    held = LockInfo.from_json(open(lock_path(s)).read())
+    assert held.id == info.id and held.operation == "OperationTypeApply"
+    release_lock(info)
+    assert not os.path.exists(lock_path(s))
+
+
+def test_contention_raises_with_holder(tmp_path):
+    s = _state(tmp_path)
+    info = acquire_lock(s, "OperationTypeApply")
+    with pytest.raises(LockError) as exc:
+        acquire_lock(s, "OperationTypePlan")
+    assert exc.value.holder.id == info.id
+    assert "Error acquiring the state lock" in str(exc.value)
+    assert info.id in str(exc.value)  # the break-glass recipe names the ID
+
+
+def test_release_respects_new_holder(tmp_path):
+    """After force-unlock + re-acquire by someone else, the original
+    process's release must NOT remove the new holder's lock."""
+    s = _state(tmp_path)
+    mine = acquire_lock(s, "OperationTypeApply")
+    force_unlock(s, mine.id)
+    theirs = acquire_lock(s, "OperationTypeApply")
+    release_lock(mine)                      # stale release: must no-op
+    assert os.path.exists(lock_path(s))
+    release_lock(theirs)
+    assert not os.path.exists(lock_path(s))
+
+
+def test_lock_timeout_waits_for_release(tmp_path):
+    s = _state(tmp_path)
+    info = acquire_lock(s, "OperationTypeApply")
+    t = threading.Timer(0.5, release_lock, args=(info,))
+    t.start()
+    try:
+        got = acquire_lock(s, "OperationTypePlan", timeout_s=5.0)
+    finally:
+        t.join()
+    release_lock(got)
+
+
+def test_force_unlock_id_interlock(tmp_path):
+    s = _state(tmp_path)
+    info = acquire_lock(s, "OperationTypeApply")
+    with pytest.raises(LockError, match="does not match"):
+        force_unlock(s, "not-the-id")
+    assert force_unlock(s, info.id).id == info.id
+    with pytest.raises(LockError, match="no lock is held"):
+        force_unlock(s, info.id)
+
+
+def test_corrupt_lock_sidecar_still_refuses(tmp_path):
+    """An unreadable sidecar is still a lock — refusing is the safe
+    degradation; the stub holder id is surfaced for force-unlock."""
+    s = _state(tmp_path)
+    with open(lock_path(s), "w") as fh:
+        fh.write("not json{")
+    with pytest.raises(LockError) as exc:
+        acquire_lock(s, "OperationTypeApply")
+    assert exc.value.holder.id == "<unreadable>"
+
+
+# ---------------------------------------------------------------- CLI verbs
+
+
+def test_apply_refused_under_contention(mod, tmp_path, capsys):
+    s = _state(tmp_path)
+    info = acquire_lock(s, "OperationTypeApply")
+    assert main(["apply", mod, "-state", s]) == 1
+    err = capsys.readouterr().err
+    assert "Error acquiring the state lock" in err and info.id in err
+    release_lock(info)
+
+
+def test_stale_lock_refuses_then_force_unlock_breaks(mod, tmp_path, capsys):
+    """A crashed run's lock (holder long dead) must STILL refuse — tfsim,
+    like terraform, never auto-breaks — until force-unlock by ID."""
+    s = _state(tmp_path)
+    stale = LockInfo(id="11111111-2222-3333-4444-555555555555",
+                     operation="OperationTypeApply", who="ghost@nowhere",
+                     created="2001-01-01T00:00:00+00:00", path=s)
+    with open(lock_path(s), "w") as fh:
+        fh.write(stale.to_json())
+    assert main(["apply", mod, "-state", s]) == 1
+    assert "ghost@nowhere" in capsys.readouterr().err
+    assert main(["force-unlock", stale.id, "-state", s]) == 0
+    assert "successfully unlocked" in capsys.readouterr().out
+    assert main(["apply", mod, "-state", s]) == 0
+    assert "Apply complete" in capsys.readouterr().out
+
+
+def test_lock_false_opts_out(mod, tmp_path, capsys):
+    s = _state(tmp_path)
+    info = acquire_lock(s, "OperationTypeApply")
+    assert main(["apply", mod, "-state", s, "-lock=false"]) == 0
+    assert "Apply complete" in capsys.readouterr().out
+    release_lock(info)
+
+
+def test_lock_timeout_flag_rides_out_contender(mod, tmp_path, capsys):
+    s = _state(tmp_path)
+    info = acquire_lock(s, "OperationTypeApply")
+    t = threading.Timer(0.5, release_lock, args=(info,))
+    t.start()
+    try:
+        assert main(["apply", mod, "-state", s, "-lock-timeout=10s"]) == 0
+    finally:
+        t.join()
+    assert "Apply complete" in capsys.readouterr().out
+    assert not os.path.exists(lock_path(s))  # released after the verb
+
+
+def test_invalid_lock_timeout_is_clean_error(mod, tmp_path, capsys):
+    assert main(["apply", mod, "-state", _state(tmp_path),
+                 "-lock-timeout=soon"]) == 1
+    assert "invalid -lock-timeout" in capsys.readouterr().err
+
+
+def test_invalid_lock_timeout_clean_on_state_verbs(mod, tmp_path, capsys):
+    """state rm/mv/push route through their own wrapper — a bad duration
+    must be the same rc-1 error there, not a traceback (review finding)."""
+    s = _state(tmp_path)
+    assert main(["apply", mod, "-state", s]) == 0
+    capsys.readouterr()
+    assert main(["state", "rm", "google_compute_network.vpc", "-state", s,
+                 "-lock-timeout=soon"]) == 1
+    assert "invalid -lock-timeout" in capsys.readouterr().err
+
+
+def test_verbs_release_lock_on_success_and_error(mod, tmp_path, capsys):
+    s = _state(tmp_path)
+    assert main(["apply", mod, "-state", s]) == 0
+    assert not os.path.exists(lock_path(s))
+    assert main(["plan", mod, "-state", s]) == 0
+    assert not os.path.exists(lock_path(s))
+    assert main(["taint", "google_compute_network.vpc", "-state", s]) == 0
+    assert not os.path.exists(lock_path(s))
+    # error path: a failing verb must not leak the lock
+    assert main(["taint", "google_compute_network.nope", "-state", s]) == 1
+    assert not os.path.exists(lock_path(s))
+    capsys.readouterr()
+
+
+def test_state_rm_locks_and_releases(mod, tmp_path, capsys):
+    s = _state(tmp_path)
+    assert main(["apply", mod, "-state", s]) == 0
+    info = acquire_lock(s, "OperationTypeRm")
+    assert main(["state", "rm", "google_compute_network.vpc",
+                 "-state", s]) == 1
+    assert "state lock" in capsys.readouterr().err
+    release_lock(info)
+    assert main(["state", "rm", "google_compute_network.vpc",
+                 "-state", s]) == 0
+    assert not os.path.exists(lock_path(s))
+    capsys.readouterr()
+
+
+def test_state_pull_needs_no_lock(mod, tmp_path, capsys):
+    s = _state(tmp_path)
+    assert main(["apply", mod, "-state", s]) == 0
+    capsys.readouterr()
+    info = acquire_lock(s, "OperationTypeApply")
+    assert main(["state", "pull", "-state", s]) == 0  # read-only: no lock
+    assert "google_compute_network.vpc" in capsys.readouterr().out
+    release_lock(info)
+
+
+# ---------------------------------------------------------------- backend
+
+
+def _backend_mod(tmp_path, name="mod", prefix='prefix = "clusters/dev"'):
+    d = tmp_path / name
+    d.mkdir()
+    (d / "main.tf").write_text(textwrap.dedent(f"""
+        terraform {{
+          backend "gcs" {{
+            bucket = "shared-tfstate"
+            {prefix}
+          }}
+        }}
+        resource "google_compute_network" "vpc" {{
+          name = "n"
+        }}
+    """))
+    return str(d)
+
+
+def test_gcs_backend_resolves_and_applies(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("TFSIM_GCS_ROOT", str(tmp_path / "gcs"))
+    mod = _backend_mod(tmp_path)
+    assert main(["apply", mod]) == 0
+    expected = (tmp_path / "gcs" / "shared-tfstate" / "clusters" / "dev" /
+                "default.tfstate.json")
+    assert expected.exists()
+    state = json.loads(expected.read_text())
+    assert "google_compute_network.vpc" in state["resources"]
+    capsys.readouterr()
+
+
+def test_gcs_backend_shared_between_checkouts(tmp_path, monkeypatch, capsys):
+    """Two checkouts declaring the same bucket/prefix share ONE state —
+    the multi-operator story remote state exists for."""
+    monkeypatch.setenv("TFSIM_GCS_ROOT", str(tmp_path / "gcs"))
+    a = _backend_mod(tmp_path, "checkout_a")
+    b = _backend_mod(tmp_path, "checkout_b")
+    assert main(["apply", a]) == 0
+    capsys.readouterr()
+    assert main(["plan", b]) == 0
+    # checkout B sees A's applied state: the re-plan is a no-op
+    assert "0 to add, 0 to change, 0 to destroy" in capsys.readouterr().out
+
+
+def test_gcs_backend_lock_contends_across_checkouts(tmp_path, monkeypatch,
+                                                    capsys):
+    monkeypatch.setenv("TFSIM_GCS_ROOT", str(tmp_path / "gcs"))
+    a = _backend_mod(tmp_path, "checkout_a")
+    b = _backend_mod(tmp_path, "checkout_b")
+    assert main(["apply", a]) == 0
+    capsys.readouterr()
+    shared = str(tmp_path / "gcs" / "shared-tfstate" / "clusters" / "dev" /
+                 "default.tfstate.json")
+    info = acquire_lock(shared, "OperationTypeApply")
+    assert main(["apply", b]) == 1
+    assert "state lock" in capsys.readouterr().err
+    release_lock(info)
+
+
+def test_explicit_state_overrides_backend(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("TFSIM_GCS_ROOT", str(tmp_path / "gcs"))
+    mod = _backend_mod(tmp_path)
+    s = str(tmp_path / "explicit.json")
+    assert main(["apply", mod, "-state", s]) == 0
+    assert os.path.exists(s)
+    assert not (tmp_path / "gcs").exists()
+    capsys.readouterr()
+
+
+def test_backend_workspaces_map_to_objects(tmp_path, monkeypatch, capsys):
+    """Workspaces land as sibling <ws>.tfstate objects under the prefix —
+    the real gcs backend's layout."""
+    monkeypatch.setenv("TFSIM_GCS_ROOT", str(tmp_path / "gcs"))
+    mod = _backend_mod(tmp_path)
+    assert main(["workspace", "new", mod, "staging"]) == 0
+    assert main(["apply", mod]) == 0
+    capsys.readouterr()
+    d = tmp_path / "gcs" / "shared-tfstate" / "clusters" / "dev"
+    assert (d / "staging.tfstate.json").exists()
+    assert not (d / "default.tfstate.json").exists()
+
+
+def test_backend_output_reads_backend_state(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("TFSIM_GCS_ROOT", str(tmp_path / "gcs"))
+    d = tmp_path / "mod"
+    d.mkdir()
+    (d / "main.tf").write_text(textwrap.dedent("""
+        terraform {
+          backend "gcs" {
+            bucket = "shared-tfstate"
+          }
+        }
+        resource "google_compute_network" "vpc" {
+          name = "n"
+        }
+        output "vpc_name" {
+          value = google_compute_network.vpc.name
+        }
+    """))
+    assert main(["apply", str(d)]) == 0
+    capsys.readouterr()
+    assert main(["output", "-dir", str(d), "vpc_name"]) == 0
+    assert "n" in capsys.readouterr().out
+
+
+def test_backend_variables_rejected(tmp_path, capsys):
+    """Terraform: 'Variables may not be used here' — backend config is
+    read before any evaluation context exists."""
+    d = tmp_path / "mod"
+    d.mkdir()
+    (d / "main.tf").write_text(textwrap.dedent("""
+        variable "bucket" {
+          type    = string
+          default = "b"
+        }
+        terraform {
+          backend "gcs" {
+            bucket = var.bucket
+          }
+        }
+    """))
+    assert main(["validate", str(d)]) == 1
+    out = capsys.readouterr()
+    assert "literal" in out.err + out.out
+
+
+def test_backend_gcs_missing_bucket_errors(tmp_path, capsys):
+    d = tmp_path / "mod"
+    d.mkdir()
+    (d / "main.tf").write_text(textwrap.dedent("""
+        terraform {
+          backend "gcs" {}
+        }
+        resource "google_compute_network" "vpc" {
+          name = "n"
+        }
+    """))
+    assert main(["apply", str(d)]) == 1
+    assert "bucket" in capsys.readouterr().err
+
+
+def test_backend_unsupported_type_clean_error(tmp_path, capsys):
+    d = tmp_path / "mod"
+    d.mkdir()
+    (d / "main.tf").write_text(textwrap.dedent("""
+        terraform {
+          backend "s3" {
+            bucket = "b"
+          }
+        }
+        resource "google_compute_network" "vpc" {
+          name = "n"
+        }
+    """))
+    assert main(["apply", str(d)]) == 1
+    err = capsys.readouterr().err
+    assert "not simulated" in err and "-state" in err
+    # the escape hatch works
+    assert main(["apply", str(d), "-state",
+                 str(tmp_path / "s.json")]) == 0
+    capsys.readouterr()
+
+
+def test_duplicate_backend_rejected(tmp_path, capsys):
+    d = tmp_path / "mod"
+    d.mkdir()
+    (d / "main.tf").write_text(textwrap.dedent("""
+        terraform {
+          backend "gcs" {
+            bucket = "a"
+          }
+          backend "local" {}
+        }
+    """))
+    assert main(["validate", str(d)]) == 1
+    out = capsys.readouterr()
+    assert "duplicate backend" in out.err + out.out
+
+
+def test_local_backend_path_attr(tmp_path, capsys):
+    d = tmp_path / "mod"
+    d.mkdir()
+    (d / "main.tf").write_text(textwrap.dedent("""
+        terraform {
+          backend "local" {
+            path = "my.tfstate.json"
+          }
+        }
+        resource "google_compute_network" "vpc" {
+          name = "n"
+        }
+    """))
+    assert main(["apply", str(d)]) == 0
+    assert (d / "my.tfstate.json").exists()
+    capsys.readouterr()
+
+
+def test_init_reports_backend(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("TFSIM_GCS_ROOT", str(tmp_path / "gcs"))
+    mod = _backend_mod(tmp_path)
+    assert main(["init", mod]) == 0
+    out = capsys.readouterr().out
+    assert 'Initializing the backend ("gcs")' in out
+    assert "shared-tfstate" in out
