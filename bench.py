@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Benchmark: time-to-validated-accelerator, plus MXU/HBM/workload metrics.
 
 The reference publishes no benchmark numbers (BASELINE.md). Its only

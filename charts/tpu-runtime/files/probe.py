@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """TPU node health probe — the resident half of the runtime layer.
 
 Replaces the GPU Operator's node-status role (DCGM + device-plugin health,

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # Network, control plane, and CPU pool for the TPU cluster.
 #
 # Same L1-L3 capability as the gke/ sibling (VPC toggle, zonal/regional

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # Private certificate authority for in-cluster TLS.
 #
 # Capability parity with the reference's AWS Private CA composition

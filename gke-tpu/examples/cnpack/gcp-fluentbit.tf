@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # Log shipping for the platform's Fluent Bit DaemonSet.
 #
 # Capability parity with /root/reference/eks/examples/cnpack/aws-fluentbit.tf:9-27

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # Managed-Prometheus identity for the in-cluster metrics agent.
 #
 # Capability parity with /root/reference/gke/examples/cnpack/gcp-prometheus.tf:7-45:

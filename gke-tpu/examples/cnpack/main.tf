@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # CNPack-style observability composition on a TPU slice (BASELINE config 4).
 #
 # Capability parity with the reference's examples/cnpack compositions

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # Values the operator pastes into the platform installer config — the same
 # handoff shape as the reference's CNPack flow
 # (/root/reference/eks/examples/cnpack/Readme.md:49-94), plus the TPU metric

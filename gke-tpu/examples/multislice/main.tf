@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # Multi-slice training fleet: two v5e slices joined over DCN.
 #
 # The reference never scales past one accelerator pool per cluster

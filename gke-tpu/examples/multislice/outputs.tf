@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 output "cluster_name" {
   description = "Cluster carrying the multi-slice fleet."
   value       = module.tpu_fleet.cluster_name

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # Cluster-auth wiring: kubernetes + helm providers against the cluster
 # created in this same apply (token auth, no local-exec, no kubeconfig
 # mutation — the reference's cleanest of three bootstrap variants, adopted

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # TPU runtime layer (L4): the TPU-native replacement for the GPU Operator.
 #
 # On GKE TPU node pools the driver-equivalent (libtpu) and the TPU device

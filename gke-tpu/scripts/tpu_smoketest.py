@@ -1,4 +1,6 @@
 #!/usr/bin/env python3
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Self-contained in-cluster TPU smoke test (single-file Job payload).
 
 This is the deployable bundle of nvidia_terraform_modules_tpu.smoketest: the

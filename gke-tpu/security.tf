@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # Control-plane security (L2): secrets-at-rest CMEK + group-based RBAC.
 #
 # Capability parity with the two reference features that had no GKE

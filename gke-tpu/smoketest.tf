@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # The JAX psum smoke-test Job: `terraform apply` is the integration test.
 #
 # North star (BASELINE.json): after apply, a Job runs jax.devices() and a

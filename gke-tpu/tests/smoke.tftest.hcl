@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # Native-format test suite for the gke-tpu module, run by `tfsim test`
 # (offline analogue of `terraform test`). Covers the BASELINE.json target
 # configs the way tests/test_gke_tpu_module.py does from Python — these

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # TPU slice node pools — the heart of the module.
 #
 # TPU-native accelerator provisioning has no reference precedent: where a GPU

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # Input surface of the flagship TPU GKE module.
 #
 # Same module shape as gke/ (variables-as-API), with the accelerator layer

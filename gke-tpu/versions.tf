@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # Toolchain and provider pins for the TPU GKE module.
 #
 # TPU node pools, placement policies, and the TPU device plugin need current

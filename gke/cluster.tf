@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # GKE control plane (L2) and version discovery.
 #
 # Capability parity with google_container_cluster.holoscan

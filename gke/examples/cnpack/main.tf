@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # CNPack-style observability composition on the GPU-parity module.
 #
 # Capability parity with /root/reference/gke/examples/cnpack/: wraps the root

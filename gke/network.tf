@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # Optional dedicated VPC + subnet (L1 in the survey layer map).
 #
 # Capability parity: reference creates holoscan-vpc / holoscan-subnet gated on

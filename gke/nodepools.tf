@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # Node pools (L3): one general-purpose CPU pool, one GPU pool.
 #
 # Capability parity with google_container_node_pool.cpu_nodes / gpu_nodes

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # Accelerator enablement (L4): NVIDIA GPU Operator via Helm.
 #
 # Capability parity with /root/reference/gke/main.tf:156-213: dedicated

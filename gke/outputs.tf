@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # Output surface (composition API for examples/ and sibling modules).
 #
 # Capability parity with the reference's 10 outputs

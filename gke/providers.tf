@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # Cluster-auth wiring (L5): point the kubernetes and helm providers at the
 # cluster created in this same apply.
 #

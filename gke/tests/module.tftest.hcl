@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # Native-format test suite for the gke (GPU-parity) module, run by
 # `tfsim test`. Mirrors the reference module's capability surface: cluster +
 # CPU/GPU pools + GPU Operator helm release (/root/reference/gke/main.tf),

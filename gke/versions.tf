@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 # Toolchain and provider pins for the GPU-parity GKE module.
 #
 # Capability parity: reference pins google 4.27 / google-beta 4.57 / helm 2.x

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """nvidia_terraform_modules_tpu — TPU-native cluster-validation & IaC-test library.
 
 This package is the *runtime* half of the tpu-terraform-modules framework. The

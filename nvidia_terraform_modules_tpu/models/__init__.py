@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Burn-in workloads run on freshly provisioned slices."""
 
 from .burnin import (  # noqa: F401

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Burn-in transformer: the training workload a new slice must survive.
 
 The reference framework proves a cluster works by installing the GPU Operator

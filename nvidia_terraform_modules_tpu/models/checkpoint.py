@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Checkpoint/resume for the burn-in workload (orbax, sharded, multi-host).
 
 Why this exists: the ``gke-tpu`` module makes *preemptible* TPU slices a

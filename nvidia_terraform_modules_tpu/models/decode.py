@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """KV-cache autoregressive decoding for the burn-in transformer.
 
 The serve-side counterpart of the training burn-in: the ``gke-tpu``

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Switch-style top-1 Mixture-of-Experts layer, expert-parallel over ``ep``.
 
 The reference provisions the fabric and never runs a workload on it

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """AdamW with ZeRO-1 style optimizer-state sharding over the data axes.
 
 The burn-in's default SGD step is deliberately state-free (compile-fast on a

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Weight-only int8 quantization for the serve path.
 
 Decode throughput on TPU is HBM-bound: every step re-reads the full weight

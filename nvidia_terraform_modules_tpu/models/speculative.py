@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Prompt-lookup speculative decoding: draft free tokens, verify in one pass.
 
 Greedy decode runs one HBM-bound forward per token (``models/decode.py``).

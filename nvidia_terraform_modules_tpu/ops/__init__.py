@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Hardware micro-probes and TPU-first compute ops (ring/Ulysses attention)."""
 
 from .flash_attention import flash_attention  # noqa: F401

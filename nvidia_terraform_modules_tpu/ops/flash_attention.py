@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Pallas TPU flash attention: fused, tiled, O(S) memory, custom VJP.
 
 The hot op of the burn-in workload (and of any transformer a provisioned slice

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Pallas TPU int8-weight matmul: dequant fused into the MXU contraction.
 
 The serving decode loop is weight-bandwidth-bound: every step re-reads the

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """MXU and HBM micro-probes.
 
 The reference's only hardware validation is "wait ~5 minutes, then kubectl get

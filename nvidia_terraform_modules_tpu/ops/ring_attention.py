@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Ring attention: exact long-context attention over the ``sp`` mesh axis.
 
 The reference framework has no sequence dimension at all (SURVEY §5 — it is an

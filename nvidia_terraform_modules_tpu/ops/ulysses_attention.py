@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Ulysses attention: all-to-all sequence parallelism over the ``sp`` axis.
 
 The second of the two canonical long-context layouts (the first, ring

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Mesh construction, sharding rules, collective probes, multi-host bootstrap.
 
 The reference provisions the *fabric* (node-to-node security-group rules,

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Collective micro-probes: correctness + achieved ICI bandwidth.
 
 These are the executable replacement for the reference's manual "is the fabric

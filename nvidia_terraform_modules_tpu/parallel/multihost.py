@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Multi-host bootstrap for GKE indexed Jobs / JobSets.
 
 A multi-host TPU slice (e.g. v5e-8 as 2× ``ct5lp-hightpu-4t`` hosts) schedules

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Multi-slice meshes: data parallelism over DCN, model axes inside ICI.
 
 A single TPU slice gets its fast interconnect (ICI) from the ``gke-tpu``

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """GPipe-style pipeline parallelism over a ``pp`` mesh axis.
 
 Completes the parallelism portfolio the provisioned fabric must carry

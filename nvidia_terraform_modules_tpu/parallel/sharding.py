@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Named sharding rules for the burn-in workload.
 
 Logical array dimensions map onto mesh axes once, here, and every model /

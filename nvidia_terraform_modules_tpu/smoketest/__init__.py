@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """In-cluster TPU validation: the executable replacement for manual runbooks."""
 
 from .runner import SmokeResult, run_smoketest  # noqa: F401

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """CLI entry: ``python -m nvidia_terraform_modules_tpu.smoketest``.
 
 This is the command the ``gke-tpu`` smoke-test Job container runs. Env

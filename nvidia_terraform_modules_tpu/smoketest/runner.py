@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """The psum smoke test + optional deeper burn-in.
 
 North-star behaviour (BASELINE.json): after ``terraform apply`` on ``gke-tpu``,

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """tfsim — an offline Terraform module validator and plan simulator.
 
 Why this exists: the reference repo has **no automated tests at all**

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """tfsim CLI — the operator surface, shaped like terraform's (SURVEY L7).
 
 The reference's user interface is the ``terraform`` CLI itself

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """``tfsim console`` — evaluate HCL expressions against a planned module.
 
 Terraform's ``console`` is the operator's probe into a configuration: it

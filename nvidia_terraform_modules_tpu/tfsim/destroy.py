@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Destroy simulation: teardown order + provider-dependency hazard analysis.
 
 The reference's documented teardown bug (SURVEY §3.4): destroying ``gke/``

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """README generation: the offline stand-in for ``terraform-docs``.
 
 The reference's contributor workflow regenerates each module README's API

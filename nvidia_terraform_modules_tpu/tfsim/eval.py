@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Expression evaluator with Terraform-style unknown-value propagation.
 
 Anything not derivable at plan time (provider-computed attributes like a

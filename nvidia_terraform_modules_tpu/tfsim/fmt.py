@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Canonical HCL formatting: the offline stand-in for ``terraform fmt``.
 
 The reference's pre-checkin gate is ``terraform fmt`` run by hand

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """The Terraform function stdlib subset tfsim evaluates.
 
 Only functions actually used by modules in this repo (plus close neighbours)

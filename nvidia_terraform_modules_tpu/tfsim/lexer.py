@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """HCL2 lexer: source text → token stream.
 
 Covers the token inventory used by real-world Terraform modules: identifiers,

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Dependency lockfile support — tfsim's `.terraform.lock.hcl` surface.
 
 The reference commits a lockfile per root module — 6 files pinning 13

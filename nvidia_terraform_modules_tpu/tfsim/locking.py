@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """State locking: terraform's shared-state concurrency guard, simulated.
 
 The reference explicitly recommends remote state for shared use

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Recursive-descent parser: tokens → Body / expression AST."""
 
 from __future__ import annotations

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Plan simulation: evaluate a module against tfvars, offline.
 
 Produces the set of resource instances a ``terraform plan`` would create —

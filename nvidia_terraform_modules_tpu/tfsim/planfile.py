@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Saved plan files: ``plan -out=FILE`` → ``show FILE`` → ``apply FILE``.
 
 The reference's documented operator flow is review-then-apply

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Vendored provider schemas: attribute/block checking for ``tfsim validate``.
 
 Real ``terraform validate`` rejects unknown resource arguments because it

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """State simulation: apply, persist, re-plan, diff — terraform's checkpoint.
 
 SURVEY §5 maps the reference's checkpoint/resume story onto Terraform state:

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """``tfsim test`` — offline analogue of terraform's native test framework.
 
 The reference repo has **no automated tests at all** (SURVEY §4:

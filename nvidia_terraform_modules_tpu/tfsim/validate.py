@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Static validation: the offline stand-in for ``terraform validate``.
 
 Checks reference integrity (every ``var.``/``local.``/resource/data reference

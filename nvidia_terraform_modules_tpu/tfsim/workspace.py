@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Workspaces: named state environments per module dir (terraform-shaped).
 
 Terraform workspaces let one configuration hold several independent states

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Host-side input pipeline: streaming batches with device prefetch.
 
 The burn-in workloads train on one fixed synthetic batch (right for a

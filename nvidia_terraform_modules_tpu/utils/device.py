@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Device discovery and per-chip peak specs.
 
 The reference framework's notion of "what accelerator am I on" is a Terraform

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Shared layer primitives for the validation workloads.
 
 One definition of RMSNorm and the init scale, imported by both the

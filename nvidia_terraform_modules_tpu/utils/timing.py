@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Timing helpers for device-side work.
 
 ``jax.block_until_ready`` is not a reliable barrier on every backend (the

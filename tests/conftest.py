@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Test rig: force an 8-device virtual CPU platform BEFORE jax initialises.
 
 This mirrors the SURVEY §4 implication: the reference tests nothing without a

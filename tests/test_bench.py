@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """The bench capture's un-losable contract (round-2 VERDICT item 1).
 
 The orchestrator is the artifact generator of record: whatever happens to

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Checkpoint/resume of the burn-in workload (spot-slice preemption story).
 
 The gke-tpu module provisions preemptible slices first-class; a preempted

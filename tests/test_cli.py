@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """tfsim CLI: the terraform-shaped operator surface (SURVEY L7), offline.
 
 Each verb is exercised through main(argv) — same code path as

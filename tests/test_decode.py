@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """KV-cache decode: exactness vs full re-forward, sharding, serving shape.
 
 The cache is an optimisation, never a different model: greedy tokens from

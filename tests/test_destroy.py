@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Destroy simulation: teardown order + the reference's `state rm` wart.
 
 SURVEY §3.4: the reference requires `terraform state rm` of the operator

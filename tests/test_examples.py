@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Golden-plan tests for the examples/cnpack compositions.
 
 These exercise tfsim's recursive module simulation: the example root modules

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Pallas flash attention: exactness vs dense, grads, burn-in integration.
 
 Runs in pallas interpret mode on the virtual CPU mesh (the kernel's TPU

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Golden-plan tests for the gke/ (GPU-parity) module via tfsim.
 
 The offline analogue of `terraform validate` + plan-fixture testing

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Golden-plan tests for the flagship gke-tpu/ module via tfsim.
 
 Locks down the module's core logic — deriving machine type, hosts-per-slice,

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """The health probe is real (VERDICT item 4): device observation, node
 condition export via the Kubernetes API, and Prometheus gauges — exercised
 directly from the chart's files/probe.py, plus render-level assertions that

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Fused int8-weight matmul kernel + QTensor dispatch (interpret mode)."""
 
 import jax

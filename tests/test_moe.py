@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Expert-parallel MoE: routing correctness, sharded equivalence, training.
 
 The ep axis is the fourth first-class parallelism axis the provisioned

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """True multi-process e2e: the smoke-test payloads under jax.distributed.
 
 Spawns two processes (4 virtual CPU devices each) that form one 8-device

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Multi-slice (DCN) meshes: planning, device grouping, hierarchical training.
 
 The virtual 8-device CPU rig stands in for 2×v5e-4 (or 4×v5e-2) multi-slice

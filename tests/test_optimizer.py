@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """AdamW + ZeRO-1 state sharding: math vs optax, partitioning, training.
 
 The burn-in's SGD step is state-free by design; this is the stateful path a

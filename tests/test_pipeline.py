@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Pipeline parallelism: schedule equivalence, backward flow, composition.
 
 The GPipe scan-and-ppermute schedule must be invisible: the pipelined

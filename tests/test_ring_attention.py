@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Ring attention: exactness vs dense reference, grads, burn-in integration.
 
 The reference has no long-context story at all (SURVEY §5); ours is ring

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Provider-schema argument checking in tfsim validate (the offline analogue
 of terraform's provider-schema layer; closes the `machine_typ = ...` typo
 class the round-1 validate could not see — VERDICT.md item 6).

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """State lifecycle: apply → checkpoint → re-plan → diff (SURVEY §5).
 
 The reference's checkpoint/resume story is "terraform state is the

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Golden statefiles + the slice-pool-rename migration (VERDICT r1 item 9).
 
 Two layers of protection:

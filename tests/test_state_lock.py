@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """State locking + remote-backend simulation (round-3 VERDICT item 5).
 
 Terraform's shared-state story — the piece the reference recommends but

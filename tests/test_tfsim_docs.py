@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Tests for tfsim.docs — the ``terraform-docs`` stand-in.
 
 The reference regenerates README API tables with terraform-docs

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Tests for tfsim.fmt — the ``terraform fmt`` stand-in.
 
 The reference's pre-checkin gate is ``terraform fmt`` run manually

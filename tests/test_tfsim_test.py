@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """``tfsim test`` — the .tftest.hcl native test framework, offline.
 
 The reference has no automated tests at all (SURVEY §4); this build goes the

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """Ulysses (all-to-all) sequence parallelism: exactness, grads, burn-in.
 
 The second long-context layout next to ring attention (SURVEY §5): one

@@ -1,3 +1,5 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
 """tfsim workspace + console verbs: per-env state, terraform.workspace, REPL.
 
 Workspaces give one configuration several independent states (the
