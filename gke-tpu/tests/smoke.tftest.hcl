@@ -200,6 +200,53 @@ run "v6e_default_multi_host" {
   }
 }
 
+# Queued provisioning (DWS flex-start): the pool starts empty and GKE
+# scales it to the whole slice atomically when capacity arrives — the
+# realistic acquisition path when no reservation is held.
+run "queued_provisioning_slice" {
+  command = plan
+
+  variables {
+    tpu_slices = {
+      train = { version = "v5p", topology = "2x2x2", queued_provisioning = true }
+    }
+  }
+
+  assert {
+    condition     = google_container_node_pool.tpu_slice["train"].queued_provisioning[0].enabled == true
+    error_message = "queued_provisioning flag must reach the pool block"
+  }
+  assert {
+    condition     = google_container_node_pool.tpu_slice["train"].initial_node_count == 0
+    error_message = "a queued pool must start empty (DWS scales it up)"
+  }
+  assert {
+    condition     = google_container_node_pool.tpu_slice["train"].autoscaling[0].total_max_node_count == 2
+    error_message = "DWS autoscaling ceiling must be the slice's host count"
+  }
+  assert {
+    condition     = google_container_node_pool.tpu_slice["train"].autoscaling[0].location_policy == "ANY"
+    error_message = "queued pools use location policy ANY per the DWS recipe"
+  }
+  assert {
+    condition     = !contains(keys(google_container_node_pool.tpu_slice["train"]), "node_count")
+    error_message = "queued pools must not pin node_count (DWS owns the size)"
+  }
+}
+
+# A queued slice cannot also be spot/reserved — it IS the capacity mode.
+run "queued_provisioning_conflicts" {
+  command = plan
+
+  variables {
+    tpu_slices = {
+      bad = { queued_provisioning = true, spot = true }
+    }
+  }
+
+  expect_failures = [var.tpu_slices]
+}
+
 # The negative path: spot and reservation are mutually exclusive per slice
 # (variable validation), so the plan itself must fail.
 run "spot_reservation_conflict" {

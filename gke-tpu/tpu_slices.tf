@@ -79,6 +79,7 @@ locals {
       machine_type   = "${local.tpu_generations[s.version].machine}-${local.tpu_chips_per_host[name]}t"
       spot           = s.spot
       reservation    = s.reservation
+      queued         = s.queued_provisioning
       disk_size_gb   = s.disk_size_gb
       disk_type      = s.disk_type
       labels         = s.labels
@@ -95,8 +96,32 @@ resource "google_container_node_pool" "tpu_slice" {
   location = local.cluster_location
 
   # a multi-host slice is one atomic unit: exactly `hosts` nodes, scheduled
-  # together on one ICI mesh — no per-node autoscaling
-  node_count = each.value.hosts
+  # together on one ICI mesh — no per-node autoscaling. Under queued
+  # provisioning (DWS flex-start) the pool instead STARTS empty and GKE
+  # scales it to the full slice only when it can place every host at once
+  # (the gcloud recipe: total autoscaling 0→hosts, location policy ANY) —
+  # so apply returns immediately and the smoketest Job, which tolerates
+  # unschedulable pods until its timeout, becomes the capacity-arrival
+  # gate; size smoketest.timeout_seconds to your queue patience or
+  # disable it and watch the ProvisioningRequest instead.
+  node_count         = each.value.queued ? null : each.value.hosts
+  initial_node_count = each.value.queued ? 0 : null
+
+  dynamic "autoscaling" {
+    for_each = each.value.queued ? [1] : []
+    content {
+      total_min_node_count = 0
+      total_max_node_count = each.value.hosts
+      location_policy      = "ANY"
+    }
+  }
+
+  dynamic "queued_provisioning" {
+    for_each = each.value.queued ? [1] : []
+    content {
+      enabled = true
+    }
+  }
 
   dynamic "placement_policy" {
     for_each = each.value.multi_host ? [each.value.topology] : []

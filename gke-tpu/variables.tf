@@ -106,17 +106,29 @@ variable "tpu_slices" {
     prefer_single_host packs an 8-chip v5e/v6e topology onto one
     ct5lp-hightpu-8t host instead of 2×4t (no ICI placement policy needed);
     leave false to exercise the multi-host path.
-    spot and reservation select the capacity type (mutually exclusive).
+
+    Capacity acquisition — at most one of:
+      spot                — preemptible capacity, cheapest, can vanish
+      reservation         — a SPECIFIC_RESERVATION you already hold
+      queued_provisioning — Dynamic Workload Scheduler flex-start: the
+                            pool request QUEUES until GKE can place the
+                            whole slice atomically, then runs it to
+                            completion. This is how real TPU capacity is
+                            usually obtained when you hold no
+                            reservation: unlike spot it cannot be
+                            preempted mid-run, unlike on-demand it does
+                            not fail on stockout — it waits.
   EOT
   type = map(object({
-    version            = optional(string, "v5e")
-    topology           = optional(string, "2x4")
-    prefer_single_host = optional(bool, false)
-    spot               = optional(bool, false)
-    reservation        = optional(string)
-    disk_size_gb       = optional(number, 100)
-    disk_type          = optional(string, "pd-balanced")
-    labels             = optional(map(string), {})
+    version             = optional(string, "v5e")
+    topology            = optional(string, "2x4")
+    prefer_single_host  = optional(bool, false)
+    spot                = optional(bool, false)
+    reservation         = optional(string)
+    queued_provisioning = optional(bool, false)
+    disk_size_gb        = optional(number, 100)
+    disk_type           = optional(string, "pd-balanced")
+    labels              = optional(map(string), {})
     # cloud node-pool name override (default "<cluster>-<map key>"): lets a
     # map-key refactor keep the deployed pool's name, so a `moved` block
     # makes the rename a true no-op instead of a pool re-create
@@ -147,6 +159,14 @@ variable "tpu_slices" {
       for s in values(var.tpu_slices) : !(s.spot && s.reservation != null)
     ])
     error_message = "tpu_slices[*]: spot and reservation are mutually exclusive (the GCE API rejects both; fail at plan, not 20 minutes into apply)."
+  }
+
+  validation {
+    condition = alltrue([
+      for s in values(var.tpu_slices) :
+      !(s.queued_provisioning && (s.spot || s.reservation != null))
+    ])
+    error_message = "tpu_slices[*]: queued_provisioning is its own capacity-acquisition mode — it cannot combine with spot or reservation."
   }
 }
 

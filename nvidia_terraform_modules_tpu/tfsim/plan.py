@@ -188,7 +188,13 @@ def _eval_body(body: A.Body, scope: Scope, top_level: bool = False) -> ResourceA
         # (e.g. guest_accelerator { count = 2 })
         if top_level and attr.name in _META_ATTRS:
             continue
-        out[attr.name] = evaluate(attr.expr, scope)
+        value = evaluate(attr.expr, scope)
+        if value is None:
+            # terraform semantics: assigning null to an argument is the
+            # same as omitting it — the conditional-omission idiom
+            # (`x = cond ? v : null`) must not leave a null in the plan
+            continue
+        out[attr.name] = value
     for blk in body.blocks:
         if top_level and blk.type in _META_BLOCKS:
             continue
