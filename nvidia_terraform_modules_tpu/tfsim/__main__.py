@@ -11,7 +11,7 @@ terraform binary in CI, so tfsim ships the same verbs offline::
     python -m nvidia_terraform_modules_tpu.tfsim validate gke-tpu [-json]
     python -m nvidia_terraform_modules_tpu.tfsim plan gke-tpu -var project_id=p \
         -var cluster_name=c [-state terraform.tfstate.json] [-json] [-target ADDR] \
-        [-out plan.tfplan] [-refresh-only] [-destroy]
+        [-replace ADDR] [-out plan.tfplan] [-refresh-only] [-destroy]
     python -m nvidia_terraform_modules_tpu.tfsim apply gke-tpu ... -state f [-target ADDR]
     python -m nvidia_terraform_modules_tpu.tfsim apply plan.tfplan   # saved-plan apply
     python -m nvidia_terraform_modules_tpu.tfsim show plan.tfplan [-json]
@@ -51,6 +51,10 @@ import os
 import sys
 
 _UNRESOLVED = object()  # sentinel: "derive the state path yourself"
+
+# the terraform release whose semantics tfsim simulates (required_version
+# constraints are checked against this; `version` prints it)
+SIM_TERRAFORM_VERSION = "1.9.0"
 
 from .destroy import simulate_destroy
 from .docs import check_readme, generate_docs
@@ -438,29 +442,34 @@ def cmd_plan(args) -> int:
             plan, prior, state_path, disk_serial = _plan_against_state(
                 args, mod, state_path)
             if getattr(args, "refresh_only", False):
-                if getattr(args, "out", None) or getattr(args, "destroy",
-                                                         False):
+                if getattr(args, "out", None) or \
+                        getattr(args, "destroy", False) or \
+                        getattr(args, "replace", None):
                     print("Error: -refresh-only cannot be combined with "
-                          "-out/-destroy (a refresh accepts drift, it "
-                          "does not stage actions)", file=sys.stderr)
+                          "-out/-destroy/-replace (a refresh accepts "
+                          "drift, it does not stage actions)",
+                          file=sys.stderr)
                     return 2
                 return _refresh_only_print(plan, prior, args)
             if getattr(args, "destroy", False):
-                if getattr(args, "target", None):
-                    print("Error: -destroy -target is not supported — "
-                          "destroy everything via the saved plan, or "
-                          "surgically with `state rm` + apply",
+                if getattr(args, "target", None) or \
+                        getattr(args, "replace", None):
+                    print("Error: -destroy cannot combine with -target/"
+                          "-replace — destroy everything via the saved "
+                          "plan, or surgically with `state rm` + apply",
                           file=sys.stderr)
                     return 2
                 plan, d = _destroy_plan_of(plan, prior, args.dir)
             else:
-                d = diff(plan, prior, getattr(args, "target", None))
+                d = diff(plan, prior, getattr(args, "target", None),
+                         getattr(args, "replace", None))
             if getattr(args, "out", None):
                 save_plan_file(args.out, plan_file_payload(
                     plan, d, disk_serial,
                     module_dir=os.path.abspath(args.dir),
                     workspace=_workspace_of(args), state_path=state_path,
-                    targets=getattr(args, "target", None)))
+                    targets=getattr(args, "target", None),
+                    replace=getattr(args, "replace", None)))
                 print(f'Saved the plan to: {args.out}\n'
                       f'To perform exactly these actions, run:\n'
                       f'  tfsim apply {args.out}', file=sys.stderr)
@@ -493,11 +502,13 @@ def _apply_saved_plan(args) -> int:
     a silently different apply).
     """
     if args.var or args.var_file or getattr(args, "target", None) or \
+            getattr(args, "replace", None) or \
             getattr(args, "refresh_only", False) or \
             getattr(args, "workspace", None):
-        print("Error: -var/-var-file/-target/-refresh-only/-workspace "
-              "cannot be combined with a saved plan file (the plan is "
-              "already resolved and pinned to its state)", file=sys.stderr)
+        print("Error: -var/-var-file/-target/-replace/-refresh-only/"
+              "-workspace cannot be combined with a saved plan file (the "
+              "plan is already resolved and pinned to its state)",
+              file=sys.stderr)
         return 2
     payload = load_plan_file(args.dir)
     plan = plan_from_payload(payload)
@@ -513,7 +524,8 @@ def _apply_saved_plan(args) -> int:
             for old, new in renames:
                 print(f"  moved: {old} -> {new}", file=sys.stderr)
         targets = payload["targets"] or None
-        d = diff(plan, prior, targets)
+        # .get: replace postdates the plan-file format; older files omit it
+        d = diff(plan, prior, targets, payload.get("replace") or None)
         if d.actions != payload["actions"]:
             drifted = sorted(set(d.actions.items())
                              ^ set(payload["actions"].items()))
@@ -546,12 +558,17 @@ def cmd_apply(args) -> int:
             plan, prior, state_path, _serial = _plan_against_state(
                 args, mod, state_path)
             if getattr(args, "refresh_only", False):
+                if getattr(args, "replace", None):
+                    print("Error: -refresh-only cannot be combined with "
+                          "-replace (a refresh accepts drift, it does "
+                          "not stage actions)", file=sys.stderr)
+                    return 2
                 n, state = _refresh_only_report(plan, prior)
                 if state_path and n:
                     _write_state(state_path, state)
                 return 0
             targets = getattr(args, "target", None)
-            d = diff(plan, prior, targets)
+            d = diff(plan, prior, targets, getattr(args, "replace", None))
             state = apply_plan(plan, prior, targets, d=d)
             if state_path:
                 _write_state(state_path, state)
@@ -830,6 +847,23 @@ def _cmd_state_locked(args) -> int:
     raise SystemExit(f"unknown state subcommand {args.subcmd!r}")
 
 
+def cmd_version(args) -> int:
+    """``terraform version``: what the toolchain pins actually mean here.
+
+    Prints the tfsim release, the terraform semantics it simulates, and
+    the certified provider selections (the reference's support matrix,
+    ``/root/reference/README.md:25-28``, as a live command).
+    """
+    from .. import __version__
+    from .lockfile import CERTIFIED_PROVIDERS
+
+    print(f"tfsim v{__version__} (simulating Terraform "
+          f"v{SIM_TERRAFORM_VERSION} semantics)")
+    for source, version in sorted(CERTIFIED_PROVIDERS.items()):
+        print(f"+ provider registry.terraform.io/{source} v{version}")
+    return 0
+
+
 def cmd_force_unlock(args) -> int:
     """``terraform force-unlock ID``: break a stuck state lock.
 
@@ -1092,7 +1126,7 @@ def cmd_init(args) -> int:
     """
     from .lockfile import constraint_satisfied, walk_module_tree
 
-    sim_version = "1.9.0"   # the terraform version tfsim simulates
+    sim_version = SIM_TERRAFORM_VERSION
 
     try:
         # backend first, as real init does ("Initializing the backend...")
@@ -1210,12 +1244,14 @@ def main(argv: list[str] | None = None) -> int:
     c.add_argument("-json", action="store_true")
     c.add_argument("-show-noop", action="store_true")
     c.add_argument("-target", action="append", dest="target")
+    c.add_argument("-replace", action="append", dest="replace")
     c.add_argument("-workspace", default=None)
     c.add_argument("-out", default=None)
     c.add_argument("-refresh-only", action="store_true", dest="refresh_only")
     c.add_argument("-destroy", action="store_true", dest="destroy")
     a = add_module_cmd("apply", cmd_apply, state=True)
     a.add_argument("-target", action="append", dest="target")
+    a.add_argument("-replace", action="append", dest="replace")
     a.add_argument("-workspace", default=None)
     a.add_argument("-refresh-only", action="store_true", dest="refresh_only")
 
@@ -1269,6 +1305,9 @@ def main(argv: list[str] | None = None) -> int:
     st.add_argument("-force", action="store_true")
     add_lock_args(st)
     st.set_defaults(fn=cmd_state)
+
+    vv = sub.add_parser("version")
+    vv.set_defaults(fn=cmd_version)
 
     fu = sub.add_parser("force-unlock")
     fu.add_argument("lock_id")

@@ -49,7 +49,8 @@ class PlanFileError(ValueError):
 def plan_file_payload(plan: Plan, d: Diff, disk_serial: int | None, *,
                       module_dir: str, workspace: str,
                       state_path: str | None,
-                      targets: list[str] | None) -> dict[str, Any]:
+                      targets: list[str] | None,
+                      replace: list[str] | None = None) -> dict[str, Any]:
     """The serializable record of a reviewed plan.
 
     Instances are stored RENDERED (computed markers as strings) — the same
@@ -70,6 +71,9 @@ def plan_file_payload(plan: Plan, d: Diff, disk_serial: int | None, *,
         "state_path": (os.path.abspath(state_path)
                        if state_path is not None else None),
         "targets": targets or [],
+        # forced recreations (-replace): the apply-file re-diff must force
+        # the same instances or the saved "replace" actions read as drift
+        "replace": replace or [],
         "variables": render(plan.variables),
         # the stale-plan guard: what the diff was computed against
         "state_serial": disk_serial,

@@ -126,25 +126,42 @@ def _rendered_instances(plan: Plan) -> dict[str, Any]:
 
 
 def diff(plan: Plan, state: State | None,
-         targets: list[str] | None = None) -> Diff:
+         targets: list[str] | None = None,
+         replace: list[str] | None = None) -> Diff:
     """What ``terraform apply`` would do to ``state`` to realise ``plan``.
 
     With ``targets``, only the targeted instances (plus their dependency
     closure — see :func:`..plan.select_targets`) appear in the diff;
     everything else is left exactly as-is, matching ``terraform plan
     -target``'s surgical scope (including skipping deletes of
-    non-targeted state entries).
+    non-targeted state entries). ``replace`` forces recreation of the
+    named instances (``terraform plan/apply -replace=ADDR``, the modern
+    stateless successor to ``taint``); an address with no instance in
+    the plan is an error, matching terraform's refusal.
     """
     from .plan import select_targets
 
     planned = _rendered_instances(plan)
     prior = dict(state.resources) if state else {}
+    for addr in replace or []:
+        if addr not in planned:
+            raise ValueError(
+                f"-replace: no resource instance {addr!r} in the plan "
+                f"(the address must name a managed instance in the "
+                f"current configuration)")
     keep = None
     if targets:
         # universe includes prior-only addresses so a targeted resource
         # whose instance left the config still diffs as a delete
         keep = select_targets(plan, targets,
                               set(planned) | set(prior))
+        for addr in replace or []:
+            if addr not in keep:
+                # terraform: a -replace address the -target scope excludes
+                # is an error, not a silent no-op
+                raise ValueError(
+                    f"-replace: instance {addr!r} is not covered by the "
+                    f"given -target selection")
         planned = {a: v for a, v in planned.items() if a in keep}
     actions: dict[str, str] = {}
     changed: dict[str, list[str]] = {}
@@ -152,9 +169,11 @@ def diff(plan: Plan, state: State | None,
         if addr not in prior:
             actions[addr] = "create"
             continue
-        if state is not None and addr in state.tainted:
-            # terraform taint: force recreation regardless of config drift
-            # (checked BEFORE the deep attribute compare it would discard)
+        if (state is not None and addr in state.tainted) or (
+                replace and addr in replace):
+            # terraform taint / -replace: force recreation regardless of
+            # config drift (checked BEFORE the deep attribute compare it
+            # would discard)
             actions[addr] = "replace"
             continue
         keys = sorted(

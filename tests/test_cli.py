@@ -584,6 +584,89 @@ def test_taint_untaint_replace_cycle(tmp_path, capsys):
     assert "not in state" in capsys.readouterr().err
 
 
+def test_replace_flag_forces_recreation(tmp_path, capsys):
+    """terraform's -replace=ADDR: the stateless successor to taint —
+    plan shows -/+, apply recreates, no taint mark survives."""
+    state = str(tmp_path / "s.json")
+    (tmp_path / "main.tf").write_text(
+        'resource "google_compute_network" "n" {\n  name = "x"\n}\n')
+    assert main(["apply", str(tmp_path), "-state", state]) == 0
+    capsys.readouterr()
+    assert main(["plan", str(tmp_path), "-state", state,
+                 "-replace", "google_compute_network.n"]) == 0
+    out = capsys.readouterr().out
+    assert "-/+ google_compute_network.n" in out
+    assert "1 to add, 0 to change, 1 to destroy" in out
+    # apply -replace recreates (serial bumps) and leaves no sticky mark
+    serial0 = json.load(open(state))["serial"]
+    assert main(["apply", str(tmp_path), "-state", state,
+                 "-replace", "google_compute_network.n"]) == 0
+    assert json.load(open(state))["serial"] == serial0 + 1
+    capsys.readouterr()
+    assert main(["plan", str(tmp_path), "-state", state]) == 0
+    assert "0 to add, 0 to change, 0 to destroy" in capsys.readouterr().out
+    # unknown address: terraform refuses
+    assert main(["plan", str(tmp_path), "-state", state,
+                 "-replace", "google_compute_network.zzz"]) == 1
+    assert "no resource instance" in capsys.readouterr().err
+    # -destroy -replace is a usage error like -destroy -target
+    assert main(["plan", str(tmp_path), "-state", state, "-destroy",
+                 "-replace", "google_compute_network.n"]) == 2
+    capsys.readouterr()
+
+
+def test_replace_flag_rides_saved_plans(tmp_path, capsys):
+    """-replace recorded in plan -out must survive the apply-FILE
+    re-diff (otherwise the saved replace actions read as drift)."""
+    state = str(tmp_path / "s.json")
+    pfile = str(tmp_path / "p.tfplan")
+    (tmp_path / "main.tf").write_text(
+        'resource "google_compute_network" "n" {\n  name = "x"\n}\n')
+    assert main(["apply", str(tmp_path), "-state", state]) == 0
+    capsys.readouterr()
+    assert main(["plan", str(tmp_path), "-state", state, "-out", pfile,
+                 "-replace", "google_compute_network.n"]) == 0
+    capsys.readouterr()
+    assert main(["apply", pfile]) == 0
+    out = capsys.readouterr().out
+    assert "1 added, 0 changed, 1 destroyed" in out
+
+
+def test_replace_flag_interactions_rejected(tmp_path, capsys):
+    """-replace must be rejected (never silently dropped) wherever it
+    cannot be honoured: saved-plan apply, -refresh-only, and a -target
+    scope that excludes the replaced address (review findings)."""
+    state = str(tmp_path / "s.json")
+    pfile = str(tmp_path / "p.tfplan")
+    (tmp_path / "main.tf").write_text(
+        'resource "google_compute_network" "a" {\n  name = "x"\n}\n'
+        'resource "google_compute_subnetwork" "b" {\n  name = "y"\n}\n')
+    assert main(["apply", str(tmp_path), "-state", state]) == 0
+    assert main(["plan", str(tmp_path), "-state", state,
+                 "-out", pfile]) == 0
+    capsys.readouterr()
+    assert main(["apply", pfile, "-replace",
+                 "google_compute_network.a"]) == 2
+    assert "-replace" in capsys.readouterr().err
+    assert main(["plan", str(tmp_path), "-state", state, "-refresh-only",
+                 "-replace", "google_compute_network.a"]) == 2
+    assert "-refresh-only" in capsys.readouterr().err
+    assert main(["apply", str(tmp_path), "-state", state, "-refresh-only",
+                 "-replace", "google_compute_network.a"]) == 2
+    assert "-refresh-only" in capsys.readouterr().err
+    assert main(["plan", str(tmp_path), "-state", state,
+                 "-target", "google_compute_subnetwork.b",
+                 "-replace", "google_compute_network.a"]) == 1
+    assert "not covered by the given -target" in capsys.readouterr().err
+
+
+def test_version_verb(capsys):
+    assert main(["version"]) == 0
+    out = capsys.readouterr().out
+    assert "tfsim v" in out and "Terraform v" in out
+    assert "registry.terraform.io/hashicorp/google v" in out
+
+
 # ---------------------------------------------------------------- saved plans
 
 
@@ -987,7 +1070,7 @@ def test_resource_block_for_broken_child_raises(tmp_path):
 def test_plan_destroy_rejects_target(capsys):
     assert main(["plan", GKE_TPU, "-destroy", "-target",
                  "google_compute_network.vpc"] + VARS) == 2
-    assert "-destroy -target" in capsys.readouterr().err
+    assert "-destroy cannot combine with -target" in capsys.readouterr().err
 
 
 def test_old_plan_file_missing_keys_clean_error(tmp_path, capsys):
