@@ -76,6 +76,7 @@ from .state import (
     COMPUTED_STR,
     Diff,
     State,
+    adopt_config_imports,
     apply_plan,
     diff,
     import_resource,
@@ -233,6 +234,16 @@ def _workspace_of(args) -> str:
 
 
 def _write_state(path: str, state: State) -> None:
+    if not state.lineage:
+        # mint the lineage at first write (terraform's rule: a UUID born
+        # with the statefile, preserved forever); a legacy file on disk
+        # donates its lineage — or is upgraded if it never had one. Pure
+        # state functions never mint (golden tests stay deterministic).
+        import uuid
+
+        existing = _load_state(path)
+        state.lineage = (existing.lineage if existing and existing.lineage
+                         else str(uuid.uuid4()))
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     with open(path, "w") as fh:
         fh.write(state.to_json())
@@ -279,13 +290,19 @@ def _state_lock(args, state_path: str | None, operation: str):
 
 
 def _plan_against_state(args, mod=None, state_path=_UNRESOLVED):
-    """(plan, prior-state, state-path) for plan/apply/import verbs.
+    """(plan, prior-state, state-path, disk-serial, adopted-imports).
 
     The state path honours workspaces: explicit ``-state`` wins, else a
     declared ``backend`` block, else the selected workspace's
     ``terraform.tfstate.d`` file (opt-in — only once a workspace verb has
     been used in the dir). Callers that must lock BEFORE the state read
     pass a preloaded ``mod``/``state_path`` from :func:`_resolve_paths`.
+
+    ``import {}`` blocks adopt into the in-memory prior ONLY in normal
+    plan/apply mode — terraform ignores them in refresh-only and destroy
+    modes (a refresh accepts drift and a destroy must not conjure
+    resources it never managed), and those verbs see adoption disabled
+    via the args flags.
     """
     if mod is None:
         mod = load_module(args.dir)
@@ -304,7 +321,15 @@ def _plan_against_state(args, mod=None, state_path=_UNRESOLVED):
         for old, new in renames:
             # stderr: diagnostics must not corrupt `plan -json` stdout
             print(f"  moved: {old} -> {new}", file=sys.stderr)
-    return plan, prior, state_path, disk_serial
+    adopted: list[tuple[str, str]] = []
+    import_mode = not (getattr(args, "refresh_only", False)
+                       or getattr(args, "destroy", False)
+                       or args.fn is cmd_refresh)
+    if mod.imports and import_mode:
+        prior, adopted = adopt_config_imports(mod, plan, prior)
+        for addr, rid in adopted:
+            print(f"  import: {addr} (id={rid})", file=sys.stderr)
+    return plan, prior, state_path, disk_serial, adopted
 
 
 def _print_plan_marks(d, order, show_noop: bool) -> None:
@@ -341,12 +366,15 @@ def _refresh_only_report(plan, prior) -> tuple[int, "State"]:
               f"apply would destroy it)")
     print(f"Refresh: {len(changed_outputs)} output(s) to update, "
           f"{len(orphans)} orphaned address(es). No resource changes.")
-    return len(changed_outputs), new_state
+    # orphans count as DRIFT (exit-code consumers) but not as state
+    # changes (only refreshed outputs rewrite the file)
+    return len(changed_outputs) + len(orphans), new_state
 
 
 def _refresh_only_print(plan, prior, args) -> int:
     """plan -refresh-only output: honours -json (machine consumers must
-    never receive the human drift rendering on stdout)."""
+    never receive the human drift rendering on stdout) and
+    -detailed-exitcode (drift is "changes present": exit 2)."""
     from .state import refresh_state
 
     if getattr(args, "json", False):
@@ -354,9 +382,10 @@ def _refresh_only_print(plan, prior, args) -> int:
         print(json.dumps({"refresh_only": True,
                           "changed_outputs": changed_outputs,
                           "orphans": orphans}, indent=2, sort_keys=True))
-        return 0
-    _refresh_only_report(plan, prior)
-    return 0
+        n = len(changed_outputs) + len(orphans)
+    else:
+        n, _state = _refresh_only_report(plan, prior)
+    return 2 if (getattr(args, "detailed_exitcode", False) and n) else 0
 
 
 def _resource_block_for(mod, addr: str, cache: dict):
@@ -439,8 +468,8 @@ def cmd_plan(args) -> int:
     try:
         mod, state_path = _resolve_paths(args)
         with _state_lock(args, state_path, "OperationTypePlan"):
-            plan, prior, state_path, disk_serial = _plan_against_state(
-                args, mod, state_path)
+            (plan, prior, state_path, disk_serial,
+             adopted) = _plan_against_state(args, mod, state_path)
             if getattr(args, "refresh_only", False):
                 if getattr(args, "out", None) or \
                         getattr(args, "destroy", False) or \
@@ -469,13 +498,22 @@ def cmd_plan(args) -> int:
                     module_dir=os.path.abspath(args.dir),
                     workspace=_workspace_of(args), state_path=state_path,
                     targets=getattr(args, "target", None),
-                    replace=getattr(args, "replace", None)))
+                    replace=getattr(args, "replace", None),
+                    imports=adopted))
                 print(f'Saved the plan to: {args.out}\n'
                       f'To perform exactly these actions, run:\n'
                       f'  tfsim apply {args.out}', file=sys.stderr)
     except (PlanError, PlanFileError, ValueError, OSError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
         return 1
+    # terraform's CI contract: -detailed-exitcode makes a clean no-op
+    # plan distinguishable from one with pending changes (0 = no
+    # changes, 2 = changes present, 1 = error as usual). A pending
+    # config-driven import IS a change — it reads as a no-op in the
+    # diff only because adoption already happened in-memory, but apply
+    # is still needed to persist it.
+    rc = 2 if (getattr(args, "detailed_exitcode", False)
+               and not (d.is_noop and not adopted)) else 0
     if args.json:
         print(json.dumps({
             "actions": d.actions,
@@ -483,12 +521,12 @@ def cmd_plan(args) -> int:
             "outputs": render(plan.outputs),
             "check_failures": plan.check_failures,
         }, indent=2, sort_keys=True))
-        return 0
+        return rc
     _print_plan_marks(d, plan.order, args.show_noop)
     for failure in plan.check_failures:
         print(f"Warning: {failure}", file=sys.stderr)
     print(d.summary())
-    return 0
+    return rc
 
 
 def _apply_saved_plan(args) -> int:
@@ -523,6 +561,13 @@ def _apply_saved_plan(args) -> int:
                 prior, load_module(payload["module_dir"]))
             for old, new in renames:
                 print(f"  moved: {old} -> {new}", file=sys.stderr)
+        # replay the RECORDED plan-time adoptions (never re-derive from
+        # the module's import blocks: a destroy-mode plan adopted
+        # nothing, and the stale-serial guard pins the prior state, so
+        # replay reproduces the reviewed diff exactly)
+        for addr, rid in payload.get("imports") or []:
+            prior = import_resource(prior, plan, addr, rid)
+            print(f"  import: {addr} (id={rid})", file=sys.stderr)
         targets = payload["targets"] or None
         # .get: replace postdates the plan-file format; older files omit it
         d = diff(plan, prior, targets, payload.get("replace") or None)
@@ -555,8 +600,8 @@ def cmd_apply(args) -> int:
             return _apply_saved_plan(args)
         mod, state_path = _resolve_paths(args)
         with _state_lock(args, state_path, "OperationTypeApply"):
-            plan, prior, state_path, _serial = _plan_against_state(
-                args, mod, state_path)
+            (plan, prior, state_path, _serial,
+             _adopted) = _plan_against_state(args, mod, state_path)
             if getattr(args, "refresh_only", False):
                 if getattr(args, "replace", None):
                     print("Error: -refresh-only cannot be combined with "
@@ -631,8 +676,8 @@ def cmd_refresh(args) -> int:
     try:
         mod, state_path = _resolve_paths(args)
         with _state_lock(args, state_path, "OperationTypeRefresh"):
-            plan, prior, state_path, _serial = _plan_against_state(
-                args, mod, state_path)
+            (plan, prior, state_path, _serial,
+             _adopted) = _plan_against_state(args, mod, state_path)
             if prior is None:
                 print(f"Error: no state at {state_path!r} — nothing to "
                       f"refresh", file=sys.stderr)
@@ -786,7 +831,19 @@ def _cmd_state_locked(args) -> int:
         # this re-parse guard keeps split-into-characters corruption out
         current = _load_state(args.state)
         if current is not None and not args.force:
-            # lineage guard: a push must advance the serial unless its
+            # lineage guard #1: two states born from different histories
+            # are never serial-comparable — refuse the cross-lineage
+            # overwrite outright (terraform's "lineage mismatch")
+            if current.lineage and incoming.lineage and \
+                    incoming.lineage != current.lineage:
+                print(f"Error: lineage mismatch: the incoming state "
+                      f"(lineage {incoming.lineage}) was not updated "
+                      f"from the current state (lineage "
+                      f"{current.lineage}); pushing it would replace a "
+                      f"different history — use -force to overwrite",
+                      file=sys.stderr)
+                return 1
+            # lineage guard #2: a push must advance the serial unless its
             # content is identical (a lost-update race otherwise clobbers
             # the other operator's same-serial edit silently)
             if incoming.serial < current.serial or (
@@ -910,8 +967,8 @@ def cmd_import(args) -> int:
                   "to adopt into", file=sys.stderr)
             return 2
         with _state_lock(args, state_path, "OperationTypeImport"):
-            plan, prior, state_path, _serial = _plan_against_state(
-                args, mod, state_path)
+            (plan, prior, state_path, _serial,
+             _adopted) = _plan_against_state(args, mod, state_path)
             state = import_resource(prior, plan, args.address, args.id)
             _write_state(state_path, state)
     except (PlanError, ValueError, OSError) as ex:
@@ -1249,6 +1306,8 @@ def main(argv: list[str] | None = None) -> int:
     c.add_argument("-out", default=None)
     c.add_argument("-refresh-only", action="store_true", dest="refresh_only")
     c.add_argument("-destroy", action="store_true", dest="destroy")
+    c.add_argument("-detailed-exitcode", action="store_true",
+                   dest="detailed_exitcode")
     a = add_module_cmd("apply", cmd_apply, state=True)
     a.add_argument("-target", action="append", dest="target")
     a.add_argument("-replace", action="append", dest="replace")

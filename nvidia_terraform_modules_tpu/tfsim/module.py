@@ -99,6 +99,7 @@ class Module:
     moved: list[A.Block] = dataclasses.field(default_factory=list)
     checks: list[A.Block] = dataclasses.field(default_factory=list)
     backend: Optional[Backend] = None
+    imports: list[A.Block] = dataclasses.field(default_factory=list)
 
     def resource(self, type_: str, name: str) -> Resource:
         return self.resources[f"{type_}.{name}"]
@@ -297,6 +298,11 @@ def _ingest(mod: Module, blk: A.Block, fname: str) -> None:
                                   file=fname, line=bk.line)
     elif blk.type == "moved":
         mod.moved.append(blk)
+    elif blk.type == "import":
+        # config-driven import (terraform 1.5+): `import { to = a.b
+        # id = "…" }` — adoption becomes part of the reviewed plan
+        # instead of an out-of-band CLI step
+        mod.imports.append(blk)
     elif blk.type == "check":
         mod.checks.append(blk)
     else:

@@ -50,7 +50,8 @@ def plan_file_payload(plan: Plan, d: Diff, disk_serial: int | None, *,
                       module_dir: str, workspace: str,
                       state_path: str | None,
                       targets: list[str] | None,
-                      replace: list[str] | None = None) -> dict[str, Any]:
+                      replace: list[str] | None = None,
+                      imports: list | None = None) -> dict[str, Any]:
     """The serializable record of a reviewed plan.
 
     Instances are stored RENDERED (computed markers as strings) — the same
@@ -74,6 +75,11 @@ def plan_file_payload(plan: Plan, d: Diff, disk_serial: int | None, *,
         # forced recreations (-replace): the apply-file re-diff must force
         # the same instances or the saved "replace" actions read as drift
         "replace": replace or [],
+        # config-driven imports ADOPTED at plan time: the apply-file
+        # re-diff replays exactly these (never re-derives from module
+        # imports — a destroy-mode plan adopts nothing, and replay keeps
+        # the reviewed actions byte-identical)
+        "imports": imports or [],
         "variables": render(plan.variables),
         # the stale-plan guard: what the diff was computed against
         "state_serial": disk_serial,

@@ -47,12 +47,21 @@ class State:
     # addresses marked for forced recreation (`terraform taint`); cleared
     # by the apply that replaces them
     tainted: set[str] = dataclasses.field(default_factory=set)
+    # terraform's lineage: a UUID minted when a statefile is first
+    # written and preserved forever after, so two states born from
+    # different histories can never be confused for serial-comparable
+    # versions of ONE history ("" = legacy statefile, checked nowhere).
+    # The CLI mints it at write time (pure functions stay deterministic
+    # for golden tests); `state push` refuses a cross-lineage overwrite.
+    lineage: str = ""
 
     def to_json(self) -> str:
         payload = {"serial": self.serial, "resources": self.resources,
                    "outputs": self.outputs}
         if self.tainted:
             payload["tainted"] = sorted(self.tainted)
+        if self.lineage:
+            payload["lineage"] = self.lineage
         return json.dumps(payload, indent=2, sort_keys=True)
 
     @classmethod
@@ -60,7 +69,8 @@ class State:
         raw = json.loads(text)
         return cls(resources=raw["resources"], serial=raw["serial"],
                    outputs=raw.get("outputs", {}),
-                   tainted=set(raw.get("tainted", [])))
+                   tainted=set(raw.get("tainted", [])),
+                   lineage=raw.get("lineage", ""))
 
 
 @dataclasses.dataclass
@@ -259,7 +269,7 @@ def migrate_state(state: State, module) -> tuple[State, list[tuple[str, str]]]:
         return state, []
     moved = dict(renames)
     return State(resources=resources, serial=state.serial + 1,
-                 outputs=state.outputs,
+                 outputs=state.outputs, lineage=state.lineage,
                  tainted={moved.get(a, a) for a in state.tainted}), renames
 
 
@@ -289,7 +299,7 @@ def state_rm(state: State, addrs: list[str]) -> tuple[State, list[str]]:
             del resources[a]
             removed.append(a)
     return State(resources=resources, serial=state.serial + 1,
-                 outputs=state.outputs,
+                 outputs=state.outputs, lineage=state.lineage,
                  tainted=set(state.tainted) - set(removed)), removed
 
 
@@ -307,7 +317,7 @@ def state_mv(state: State, src: str,
         raise ValueError(f"state mv: no resource in state matches {src!r}")
     moved = dict(renames)
     return State(resources=resources, serial=state.serial + 1,
-                 outputs=state.outputs,
+                 outputs=state.outputs, lineage=state.lineage,
                  tainted={moved.get(a, a) for a in state.tainted}), renames
 
 
@@ -345,7 +355,50 @@ def import_resource(state: State | None, plan: Plan, addr: str,
     resources = dict(state.resources)
     resources[addr] = attrs
     return State(resources=resources, serial=state.serial + 1,
-                 outputs=state.outputs, tainted=set(state.tainted))
+                 outputs=state.outputs, tainted=set(state.tainted),
+                 lineage=state.lineage)
+
+
+def adopt_config_imports(module, plan: Plan, state: State | None
+                         ) -> tuple[State | None, list[tuple[str, str]]]:
+    """Honour ``import {}`` blocks (terraform 1.5+ config-driven import).
+
+    Each ``import { to = a.b  id = "…" }`` adopts the named instance into
+    state through :func:`import_resource`, making adoption part of the
+    reviewed plan instead of an out-of-band CLI step. Idempotent exactly
+    like terraform's: a ``to`` already managed is skipped, so the block
+    can stay in config after the import lands. ``to`` must be a concrete
+    address; ``id`` must be a literal string (tfsim has no evaluation
+    context this early, and terraform itself resolves it pre-plan).
+    """
+    from . import ast as A
+
+    adopted: list[tuple[str, str]] = []
+    seen: set[str] = set()
+    for blk in getattr(module, "imports", []):
+        to_attr, id_attr = blk.body.attr("to"), blk.body.attr("id")
+        to = _moved_addr(to_attr.expr) if to_attr is not None else None
+        if to is None:
+            raise ValueError(
+                "import block needs a concrete `to` resource address")
+        if to in seen:
+            # terraform rejects duplicate import targets outright — the
+            # already-managed skip below must not silently swallow a
+            # second block carrying a DIFFERENT id
+            raise ValueError(
+                f"duplicate import block for {to}: each resource "
+                f"instance can only be imported once")
+        seen.add(to)
+        id_expr = getattr(id_attr, "expr", None)
+        if not (isinstance(id_expr, A.Literal)
+                and isinstance(id_expr.value, str)):
+            raise ValueError(
+                f"import {to}: `id` must be a literal string")
+        if state is not None and to in state.resources:
+            continue  # already managed: the block is a no-op, not an error
+        state = import_resource(state, plan, to, id_expr.value)
+        adopted.append((to, id_expr.value))
+    return state, adopted
 
 
 def refresh_state(plan: Plan, state: State | None
@@ -376,7 +429,8 @@ def refresh_state(plan: Plan, state: State | None
     orphans = sorted(set(state.resources) - set(_rendered_instances(plan)))
     new_state = State(resources=dict(state.resources),
                       serial=state.serial + (1 if changed else 0),
-                      outputs=fresh, tainted=set(state.tainted))
+                      outputs=fresh, tainted=set(state.tainted),
+                      lineage=state.lineage)
     return new_state, changed, orphans
 
 
@@ -419,4 +473,5 @@ def apply_plan(plan: Plan, state: State | None = None,
             for name, value in plan.outputs.items()
         }
     return State(resources=resources, serial=serial, outputs=outputs,
-                 tainted=tainted)
+                 tainted=tainted,
+                 lineage=state.lineage if state else "")

@@ -660,6 +660,105 @@ def test_replace_flag_interactions_rejected(tmp_path, capsys):
     assert "not covered by the given -target" in capsys.readouterr().err
 
 
+def test_config_driven_import_block(tmp_path, capsys):
+    """terraform 1.5+ `import {}` blocks: adoption is part of the plan —
+    plan reports the import and no create, apply persists it with the
+    operator-supplied id, and the block is idempotent on re-apply."""
+    state = str(tmp_path / "s.json")
+    (tmp_path / "main.tf").write_text(
+        'import {\n  to = google_compute_network.n\n  id = "proj/net-1"\n}\n'
+        'resource "google_compute_network" "n" {\n  name = "x"\n}\n')
+    assert main(["plan", str(tmp_path), "-state", state]) == 0
+    out = capsys.readouterr()
+    assert "import: google_compute_network.n (id=proj/net-1)" in out.err
+    assert "0 to add, 0 to change, 0 to destroy" in out.out
+    assert main(["apply", str(tmp_path), "-state", state]) == 0
+    capsys.readouterr()
+    st = json.load(open(state))
+    assert st["resources"]["google_compute_network.n"]["id"] == "proj/net-1"
+    # idempotent: the block stays in config, the next apply is a no-op
+    assert main(["apply", str(tmp_path), "-state", state]) == 0
+    assert "0 added, 0 changed, 0 destroyed" in capsys.readouterr().out
+    assert json.load(open(state))["serial"] == st["serial"]
+
+
+def test_config_driven_import_rides_saved_plans(tmp_path, capsys):
+    state = str(tmp_path / "s.json")
+    pfile = str(tmp_path / "p.tfplan")
+    (tmp_path / "main.tf").write_text(
+        'import {\n  to = google_compute_network.n\n  id = "net-9"\n}\n'
+        'resource "google_compute_network" "n" {\n  name = "x"\n}\n')
+    assert main(["plan", str(tmp_path), "-state", state,
+                 "-out", pfile]) == 0
+    capsys.readouterr()
+    assert main(["apply", pfile, "-state", state]) == 0
+    capsys.readouterr()
+    assert json.load(open(state))["resources"][
+        "google_compute_network.n"]["id"] == "net-9"
+
+
+def test_import_blocks_ignored_in_refresh_and_destroy(tmp_path, capsys):
+    """terraform ignores import{} in refresh-only/destroy modes: refresh
+    must still say 'nothing to refresh' on empty state, destroy-mode
+    plans must not conjure never-managed resources, and
+    -detailed-exitcode must report an import-only plan as changes
+    (review findings)."""
+    state = str(tmp_path / "s.json")
+    (tmp_path / "main.tf").write_text(
+        'import {\n  to = google_compute_network.n\n  id = "net-1"\n}\n'
+        'resource "google_compute_network" "n" {\n  name = "x"\n}\n')
+    # refresh on empty state: the import must not manufacture a prior
+    assert main(["refresh", str(tmp_path), "-state", state]) == 1
+    assert "nothing to refresh" in capsys.readouterr().err
+    assert not os.path.exists(state)
+    # destroy-mode plan on empty state: likewise nothing to destroy
+    assert main(["plan", str(tmp_path), "-state", state, "-destroy"]) == 1
+    assert "nothing to destroy" in capsys.readouterr().err
+    # an import-only plan IS a pending change for -detailed-exitcode
+    assert main(["plan", str(tmp_path), "-state", state,
+                 "-detailed-exitcode"]) == 2
+    capsys.readouterr()
+    assert main(["apply", str(tmp_path), "-state", state]) == 0
+    capsys.readouterr()
+    assert main(["plan", str(tmp_path), "-state", state,
+                 "-detailed-exitcode"]) == 0
+    capsys.readouterr()
+    # a destroy-mode SAVED plan must replay cleanly (no adoption at
+    # either end), and -refresh-only drift honours -detailed-exitcode
+    pfile = str(tmp_path / "d.tfplan")
+    assert main(["plan", str(tmp_path), "-state", state, "-destroy",
+                 "-out", pfile]) == 0
+    capsys.readouterr()
+    assert main(["apply", pfile, "-state", state]) == 0
+    capsys.readouterr()
+
+
+def test_duplicate_import_blocks_rejected(tmp_path, capsys):
+    state = str(tmp_path / "s.json")
+    (tmp_path / "main.tf").write_text(
+        'import {\n  to = google_compute_network.n\n  id = "net-1"\n}\n'
+        'import {\n  to = google_compute_network.n\n  id = "net-OTHER"\n}\n'
+        'resource "google_compute_network" "n" {\n  name = "x"\n}\n')
+    assert main(["plan", str(tmp_path), "-state", state]) == 1
+    assert "duplicate import block" in capsys.readouterr().err
+
+
+def test_config_driven_import_errors(tmp_path, capsys):
+    state = str(tmp_path / "s.json")
+    # no matching configuration block
+    (tmp_path / "main.tf").write_text(
+        'import {\n  to = google_compute_network.n\n  id = "net-9"\n}\n')
+    assert main(["plan", str(tmp_path), "-state", state]) == 1
+    assert "no configuration block" in capsys.readouterr().err
+    # non-literal id
+    (tmp_path / "main.tf").write_text(
+        'variable "i" {\n  type = string\n  default = "z"\n}\n'
+        'import {\n  to = google_compute_network.n\n  id = var.i\n}\n'
+        'resource "google_compute_network" "n" {\n  name = "x"\n}\n')
+    assert main(["plan", str(tmp_path), "-state", state]) == 1
+    assert "literal string" in capsys.readouterr().err
+
+
 def test_version_verb(capsys):
     assert main(["version"]) == 0
     out = capsys.readouterr().out

@@ -219,6 +219,56 @@ def test_state_pull_needs_no_lock(mod, tmp_path, capsys):
     release_lock(info)
 
 
+# ---------------------------------------------------------------- lineage
+
+
+def test_lineage_minted_once_and_preserved(mod, tmp_path, capsys):
+    """First write mints a UUID lineage; every later mutation (apply,
+    taint, state rm) carries it forward unchanged."""
+    s = _state(tmp_path)
+    assert main(["apply", mod, "-state", s]) == 0
+    lineage = json.loads(open(s).read())["lineage"]
+    assert len(lineage) == 36
+    assert main(["taint", "google_compute_network.vpc", "-state", s]) == 0
+    assert main(["apply", mod, "-state", s]) == 0
+    assert json.loads(open(s).read())["lineage"] == lineage
+    assert main(["state", "rm", "google_compute_network.vpc",
+                 "-state", s]) == 0
+    assert json.loads(open(s).read())["lineage"] == lineage
+    capsys.readouterr()
+
+
+def test_push_refuses_cross_lineage(mod, tmp_path, capsys, monkeypatch):
+    """A state from a DIFFERENT history (other lineage) must not replace
+    this one even with a higher serial — terraform's lineage mismatch."""
+    import io
+
+    s = _state(tmp_path)
+    assert main(["apply", mod, "-state", s]) == 0
+    capsys.readouterr()
+    foreign = json.loads(open(s).read())
+    foreign["lineage"] = "00000000-0000-0000-0000-000000000000"
+    foreign["serial"] += 10
+    monkeypatch.setattr("sys.stdin", io.StringIO(json.dumps(foreign)))
+    assert main(["state", "push", "-state", s]) == 1
+    assert "lineage mismatch" in capsys.readouterr().err
+    # -force is the escape hatch, as in terraform
+    monkeypatch.setattr("sys.stdin", io.StringIO(json.dumps(foreign)))
+    assert main(["state", "push", "-state", s, "-force"]) == 0
+    assert json.loads(open(s).read())["lineage"] == foreign["lineage"]
+
+
+def test_plan_detailed_exitcode(mod, tmp_path, capsys):
+    """terraform's CI contract: 2 = changes pending, 0 = no-op."""
+    s = _state(tmp_path)
+    assert main(["plan", mod, "-state", s, "-detailed-exitcode"]) == 2
+    capsys.readouterr()
+    assert main(["apply", mod, "-state", s]) == 0
+    capsys.readouterr()
+    assert main(["plan", mod, "-state", s, "-detailed-exitcode"]) == 0
+    capsys.readouterr()
+
+
 # ---------------------------------------------------------------- backend
 
 
