@@ -19,8 +19,11 @@ terraform binary in CI, so tfsim ships the same verbs offline::
     python -m nvidia_terraform_modules_tpu.tfsim import gke-tpu ADDR ID -state f ...
     python -m nvidia_terraform_modules_tpu.tfsim destroy gke-tpu ...
     python -m nvidia_terraform_modules_tpu.tfsim output -state f [NAME] [-json]
-    python -m nvidia_terraform_modules_tpu.tfsim state list|show|rm|mv ... -state f
-    python -m nvidia_terraform_modules_tpu.tfsim force-unlock LOCK_ID -state f
+    python -m nvidia_terraform_modules_tpu.tfsim state list|show|rm|mv ... \
+        (-state f | -dir MODULE)      # -dir resolves backend/workspace
+    python -m nvidia_terraform_modules_tpu.tfsim taint|untaint ADDR (-state f | -dir MODULE)
+    python -m nvidia_terraform_modules_tpu.tfsim force-unlock LOCK_ID (-state f | -dir MODULE)
+    python -m nvidia_terraform_modules_tpu.tfsim version
     python -m nvidia_terraform_modules_tpu.tfsim graph gke-tpu -var ...
     python -m nvidia_terraform_modules_tpu.tfsim test gke-tpu [-filter F]
     python -m nvidia_terraform_modules_tpu.tfsim workspace new gke-tpu staging
@@ -774,6 +777,29 @@ def cmd_graph(args) -> int:
     return 0
 
 
+def _statefile_of(args) -> str | None:
+    """Statefile for state-surgery verbs: explicit ``-state`` wins, else
+    ``-dir MODULE`` resolves through the module's backend/workspace the
+    way plan/apply do (terraform's state verbs need no flag at all in a
+    configured directory — this is that ergonomic, made explicit).
+    ``-workspace`` is validated whenever given, never silently dropped.
+    Returns None only when neither flag was passed."""
+    ws = getattr(args, "workspace", None)
+    d = getattr(args, "dir", None)
+    if ws and not d:
+        raise ValueError(
+            "-workspace needs -dir MODULE_DIR to resolve against")
+    if d:
+        # _resolve_paths validates -workspace and honours explicit -state
+        _mod, state_path = _resolve_paths(args)
+        if state_path is None:
+            raise ValueError(
+                f"{d!r} resolves no statefile (no backend/workspace) — "
+                f"pass -state")
+        return state_path
+    return getattr(args, "state", None)
+
+
 def cmd_state(args) -> int:
     """``terraform state list|show|rm|mv`` against the simulated statefile.
 
@@ -781,6 +807,15 @@ def cmd_state(args) -> int:
     teardown runbook step ``terraform state rm
     kubernetes_namespace_v1.gpu-operator`` (``/root/reference/gke/README.md:59``).
     """
+    try:
+        args.state = _statefile_of(args)
+    except (ValueError, OSError) as ex:  # OSError: -dir that won't load
+        print(f"Error: {ex}", file=sys.stderr)
+        return 1
+    if not args.state:
+        print("Error: state needs -state FILE or -dir MODULE_DIR "
+              "(backend/workspace-resolved)", file=sys.stderr)
+        return 2
     wanted = {"list": 0, "show": 1, "mv": 2, "pull": 0, "push": 0}
     n = len(args.address)
     if args.subcmd in wanted and n != wanted[args.subcmd] or \
@@ -932,20 +967,13 @@ def cmd_force_unlock(args) -> int:
     from .locking import force_unlock
 
     try:
-        if args.state:
-            state_path = args.state
-        elif args.dir:
-            _mod, state_path = _resolve_paths(args)
-            if state_path is None:
-                print(f"Error: {args.dir!r} resolves no statefile (no "
-                      f"backend/workspace) — pass -state", file=sys.stderr)
-                return 2
-        else:
+        state_path = _statefile_of(args)
+        if not state_path:
             print("Error: force-unlock needs -state FILE or -dir "
                   "MODULE_DIR", file=sys.stderr)
             return 2
         holder = force_unlock(state_path, args.lock_id)
-    except ValueError as ex:
+    except (ValueError, OSError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
         return 1
     print(f"tfsim state has been successfully unlocked!\n\n"
@@ -1062,9 +1090,14 @@ def cmd_taint(args) -> int:
     recreates it clears the mark — terraform's lifecycle exactly.
     """
     try:
+        args.state = _statefile_of(args)
+        if not args.state:
+            print("Error: taint needs -state FILE or -dir MODULE_DIR "
+                  "(backend/workspace-resolved)", file=sys.stderr)
+            return 2
         with _state_lock(args, args.state, "OperationTypeTaint"):
             return _cmd_taint_locked(args)
-    except ValueError as ex:
+    except (ValueError, OSError) as ex:  # OSError: -dir that won't load
         print(f"Error: {ex}", file=sys.stderr)
         return 1
 
@@ -1352,7 +1385,9 @@ def main(argv: list[str] | None = None) -> int:
     for name in ("taint", "untaint"):
         tn = sub.add_parser(name)
         tn.add_argument("address")
-        tn.add_argument("-state", required=True)
+        tn.add_argument("-state", default=None)
+        tn.add_argument("-dir", default=None)
+        tn.add_argument("-workspace", default=None)
         add_lock_args(tn)
         tn.set_defaults(fn=cmd_taint, untaint=(name == "untaint"))
 
@@ -1360,7 +1395,9 @@ def main(argv: list[str] | None = None) -> int:
     st.add_argument("subcmd",
                     choices=["list", "show", "rm", "mv", "pull", "push"])
     st.add_argument("address", nargs="*")
-    st.add_argument("-state", required=True)
+    st.add_argument("-state", default=None)
+    st.add_argument("-dir", default=None)
+    st.add_argument("-workspace", default=None)
     st.add_argument("-force", action="store_true")
     add_lock_args(st)
     st.set_defaults(fn=cmd_state)

@@ -467,6 +467,41 @@ def test_local_backend_path_attr(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_state_verbs_resolve_dir_through_backend(tmp_path, monkeypatch,
+                                                 capsys):
+    """state/taint work from the module dir alone when a backend is
+    declared — terraform's own ergonomics for state surgery."""
+    monkeypatch.setenv("TFSIM_GCS_ROOT", str(tmp_path / "gcs"))
+    mod = _backend_mod(tmp_path)
+    assert main(["apply", mod]) == 0
+    capsys.readouterr()
+    assert main(["state", "list", "-dir", mod]) == 0
+    assert "google_compute_network.vpc" in capsys.readouterr().out
+    assert main(["taint", "google_compute_network.vpc", "-dir", mod]) == 0
+    capsys.readouterr()
+    assert main(["plan", mod]) == 0
+    assert "-/+ google_compute_network.vpc" in capsys.readouterr().out
+    assert main(["state", "list"]) == 2
+    assert "-state FILE or -dir" in capsys.readouterr().err
+    assert main(["taint", "x.y"]) == 2
+    capsys.readouterr()
+    # error hygiene (review findings): a bad -dir is an Error line, a
+    # dir resolving nothing says so, a typo'd/-dir-less -workspace
+    # refuses instead of being silently dropped
+    assert main(["state", "list", "-dir", str(tmp_path / "nope")]) == 1
+    assert "Error:" in capsys.readouterr().err
+    bare = tmp_path / "bare"
+    bare.mkdir()
+    (bare / "main.tf").write_text(
+        'resource "google_compute_network" "x" {\n  name = "n"\n}\n')
+    assert main(["state", "list", "-dir", str(bare)]) == 1
+    assert "resolves no statefile" in capsys.readouterr().err
+    assert main(["state", "list", "-dir", mod, "-workspace", "typo"]) == 1
+    assert "does not exist" in capsys.readouterr().err
+    assert main(["taint", "x.y", "-state", "f", "-workspace", "w"]) == 1
+    assert "-workspace needs -dir" in capsys.readouterr().err
+
+
 def test_init_reports_backend(tmp_path, monkeypatch, capsys):
     monkeypatch.setenv("TFSIM_GCS_ROOT", str(tmp_path / "gcs"))
     mod = _backend_mod(tmp_path)
