@@ -263,17 +263,21 @@ def section_decode_int8() -> dict:
         # number measures the interpreter, not the kernel, and fused <
         # unfused is the expected inversion, not a regression
         out["decode_int8_interpret_mode"] = True
-    for key, fused in (("decode_int8_tokens_per_s", True),
-                       ("decode_int8_unfused_tokens_per_s", False)):
+    # third variant: the FULL int8 serving stack — int8 weight bytes AND
+    # int8 KV-cache bytes per step (the two HBM reads bounding decode)
+    for key, fused, cache_dtype in (
+            ("decode_int8_tokens_per_s", True, "bf16"),
+            ("decode_int8_unfused_tokens_per_s", False, "bf16"),
+            ("decode_int8_kvcache_tokens_per_s", True, "int8")):
         q_decoder = make_quantized_decoder(
             dec_cfg, n_new=n_new, max_len=max_len, dtype=dec_cfg.dtype,
-            fused=fused)
+            fused=fused, cache_dtype=cache_dtype)
         # int8 prefill twin: the quantized program's own prefill cost —
         # subtracting the bf16 twin's would fold the dequant/prefill delta
         # into the per-step estimate and skew the side-by-side numbers
         q_prefiller = make_quantized_decoder(
             dec_cfg, n_new=1, max_len=max_len, dtype=dec_cfg.dtype,
-            fused=fused)
+            fused=fused, cache_dtype=cache_dtype)
         step_s, _ = _time_decode(q_decoder, q_prefiller, qparams, prompt,
                                  n_new)
         out[key] = round(dec_cfg.batch / step_s, 1)
@@ -679,7 +683,8 @@ def main() -> None:
                 "<1 expected; the lever is weight-HBM-bound decode on chip")
         if "decode_int8_tokens_per_s" in merged:
             expectations["decode_int8_tokens_per_s"] = (
-                "pallas interpret mode: fused < unfused expected off-TPU")
+                "pallas interpret mode: fused (and fused+int8-cache) < "
+                "unfused expected off-TPU")
         if expectations:
             merged["cpu_fallback_expectations"] = expectations
     line = {

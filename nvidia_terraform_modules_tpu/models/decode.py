@@ -104,21 +104,42 @@ def _moe_ffn(h, layer, cfg: BurnInConfig, rules):
 
 
 def init_cache(cfg: BurnInConfig, batch: int, max_len: int,
-               rules: ShardingRules | None = None) -> dict[str, Any]:
+               rules: ShardingRules | None = None, *,
+               cache_dtype: str = "bf16") -> dict[str, Any]:
     """Zeroed KV cache: per layer ``[B, S_max, H, D]`` k/v buffers.
 
     ``pos`` is the number of valid positions (python-int 0 at init,
     traced i32 afterwards).
+
+    ``cache_dtype="int8"`` stores K/V rows as symmetric per-vector int8
+    with an f32 scale per cached vector (``k_scale``/``v_scale``
+    ``[B, S_max, H]``) — the cache is the OTHER per-step HBM read next to
+    the weights in the decode loop, and int8 halves its bytes (the scale
+    sidecar adds 4/head_dim). Rows are quantised at write time and
+    dequantised on read; XLA fuses the dequant into the attention
+    contraction's read stream. Lossy by construction: the decode ==
+    full-re-forward exactness contract holds only for the default bf16
+    cache (tests pin the int8 path's agreement instead).
     """
     _check_cfg(cfg)
+    if cache_dtype not in ("bf16", "int8"):
+        raise ValueError(
+            f"unknown cache_dtype {cache_dtype!r}: use bf16|int8")
     # GQA: only KV heads are cached — the cache shrinks by
     # n_heads/kv_heads, the point of grouped-query attention at serve time
     shape = (batch, max_len, cfg.kv_heads, cfg.head_dim)
+    quant = cache_dtype == "int8"
+    buf_dtype = jnp.int8 if quant else cfg.dtype
     kv = {
-        "k": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
-        "v": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
+        "k": [jnp.zeros(shape, buf_dtype) for _ in range(cfg.n_layers)],
+        "v": [jnp.zeros(shape, buf_dtype) for _ in range(cfg.n_layers)],
         "pos": jnp.zeros((), jnp.int32),
     }
+    if quant:
+        kv["k_scale"] = [jnp.zeros(shape[:3], jnp.float32)
+                         for _ in range(cfg.n_layers)]
+        kv["v_scale"] = [jnp.zeros(shape[:3], jnp.float32)
+                         for _ in range(cfg.n_layers)]
     if rules is not None:
         # KV heads shard over tp when they divide it; otherwise (GQA/MQA
         # with few KV heads) the head axis replicates — device_put, unlike
@@ -129,10 +150,26 @@ def init_cache(cfg: BurnInConfig, batch: int, max_len: int,
         s = rules.shard(rules.act(None, head_axis, None))
         kv["k"] = [jax.device_put(x, s) for x in kv["k"]]
         kv["v"] = [jax.device_put(x, s) for x in kv["v"]]
+        if quant:
+            # scales ride the cache's own sharding minus the head dim
+            s3 = rules.shard(rules.act(None, head_axis))
+            kv["k_scale"] = [jax.device_put(x, s3) for x in kv["k_scale"]]
+            kv["v_scale"] = [jax.device_put(x, s3) for x in kv["v_scale"]]
     return kv
 
 
-def _cached_attention(q, k_cache, v_cache, q_pos, scale):
+def quantize_kv(x):
+    """Per-vector symmetric int8 for cache rows: ``[..., D]`` →
+    ``(q int8, scale f32 [...])`` with ``|dequant - x| <= scale/2``."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(x32 / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def _cached_attention(q, k_cache, v_cache, q_pos, scale,
+                      k_scale=None, v_scale=None):
     """Attention of ``q`` ``[B, T, H, D]`` over the full cache buffer.
 
     ``q_pos`` ``[T]`` are the global positions of the query tokens; cache
@@ -144,8 +181,18 @@ def _cached_attention(q, k_cache, v_cache, q_pos, scale):
     Queries are RESHAPED into their KV groups and contracted against the
     un-repeated cache — the repeated-cache tensor the serving win exists
     to avoid is never materialised.
+
+    With ``k_scale``/``v_scale`` the buffers are int8 and dequantised here
+    — after the (1-byte) HBM read, which is the point. Dequant lands in
+    the COMPUTE dtype (int8→bf16 is exact; accumulation is pinned to f32
+    by ``preferred_element_type`` either way): an f32 dequant would make
+    any XLA-materialised operand temporary 4 bytes/element — double the
+    bf16 cache this path exists to halve.
     """
     b, t, h, d = q.shape
+    if k_scale is not None:
+        k_cache = k_cache.astype(q.dtype) * k_scale[..., None].astype(q.dtype)
+        v_cache = v_cache.astype(q.dtype) * v_scale[..., None].astype(q.dtype)
     kv = k_cache.shape[2]
     rep = h // kv
     qg = q.reshape(b, t, kv, rep, d)
@@ -197,9 +244,11 @@ def forward_cached(params, tokens, cache, cfg: BurnInConfig,
     x = act(x, None, None)
     scale = 1.0 / (cfg.head_dim ** 0.5)
 
+    quant = "k_scale" in cache
     new_k, new_v = [], []
-    for layer, k_cache, v_cache in zip(params["layers"], cache["k"],
-                                       cache["v"]):
+    new_ks, new_vs = [], []
+    for li, (layer, k_cache, v_cache) in enumerate(
+            zip(params["layers"], cache["k"], cache["v"])):
         h = _rmsnorm(x, layer["attn_norm"])
         q = h @ layer["wq"]
         k = h @ layer["wk"]
@@ -223,8 +272,22 @@ def forward_cached(params, tokens, cache, cfg: BurnInConfig,
             """KV-group broadcast for the MHA-shaped flash kernel."""
             return jnp.repeat(tns, rep, axis=2) if rep > 1 else tns
 
-        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos0, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos0, 0, 0))
+        k_scale = v_scale = None
+        if quant:
+            # write path: quantise the fresh rows; the cache never holds
+            # bf16 — int8 bytes are what cross HBM on every later step
+            k_w, k_s = quantize_kv(k)
+            v_w, v_s = quantize_kv(v)
+            k_scale = jax.lax.dynamic_update_slice(
+                cache["k_scale"][li], k_s, (0, pos0, 0))
+            v_scale = jax.lax.dynamic_update_slice(
+                cache["v_scale"][li], v_s, (0, pos0, 0))
+            new_ks.append(k_scale)
+            new_vs.append(v_scale)
+        else:
+            k_w, v_w = k, v
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k_w, (0, pos0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v_w, (0, pos0, 0, 0))
         new_k.append(k_cache)
         new_v.append(v_cache)
 
@@ -233,13 +296,25 @@ def forward_cached(params, tokens, cache, cfg: BurnInConfig,
             # cache holds nothing the prompt shouldn't already see). The
             # pallas kernel is MHA-shaped, so prefill broadcasts K/V once
             # (prompt-sized, one-time); the per-STEP path below contracts
-            # grouped queries against the un-repeated cache instead
+            # grouped queries against the un-repeated cache instead.
+            # Unquantised k/v on purpose: the prompt's own attention pays
+            # no cache read, so prefill numerics stay full-precision even
+            # under an int8 cache
             from ..ops.flash_attention import flash_attention
 
             attn = flash_attention(q, grow(k), grow(v), causal=True,
                                    scale=scale)
+        elif t > 1 and prefill_impl == "dense" and quant:
+            # pure prefill over an int8 cache: attend over the
+            # just-computed FULL-PRECISION k/v (causally masked) so
+            # prefill numerics match the flash branch — only later steps
+            # read the quantised rows. Same pos==0 precondition as the
+            # flash prefill; mid-stream t>1 forwards (speculative
+            # verification) pass prefill_impl="cached" instead.
+            attn = _cached_attention(q, k, v, q_pos, scale)
         else:
-            attn = _cached_attention(q, k_cache, v_cache, q_pos, scale)
+            attn = _cached_attention(q, k_cache, v_cache, q_pos, scale,
+                                     k_scale, v_scale)
         attn = attn.reshape(b, t, cfg.d_model)
         x = x + act(attn @ layer["wo"], None, None)
 
@@ -253,8 +328,11 @@ def forward_cached(params, tokens, cache, cfg: BurnInConfig,
 
     x = _rmsnorm(x, params["out_norm"])
     logits = x @ params["embed"].T
-    return act(logits, None, None), {
-        "k": new_k, "v": new_v, "pos": pos0 + t}
+    new_cache: dict[str, Any] = {"k": new_k, "v": new_v, "pos": pos0 + t}
+    if quant:
+        new_cache["k_scale"] = new_ks
+        new_cache["v_scale"] = new_vs
+    return act(logits, None, None), new_cache
 
 
 def _select_prefill_impl(cfg: BurnInConfig, t: int, prefill: str) -> str:
@@ -291,7 +369,7 @@ def _select_prefill_impl(cfg: BurnInConfig, t: int, prefill: str) -> str:
 
 
 def _generate(params, prompt, n_new, cfg, rules, max_len, pick_next,
-              prefill):
+              prefill, cache_dtype="bf16"):
     """Shared prefill + scan loop; ``pick_next(logits, rng) → token``."""
     b, t = prompt.shape
     if max_len is None:
@@ -299,7 +377,7 @@ def _generate(params, prompt, n_new, cfg, rules, max_len, pick_next,
     if t + n_new > max_len:
         raise ValueError(f"prompt ({t}) + n_new ({n_new}) exceeds "
                          f"max_len ({max_len})")
-    cache = init_cache(cfg, b, max_len, rules)
+    cache = init_cache(cfg, b, max_len, rules, cache_dtype=cache_dtype)
     logits, cache = forward_cached(
         params, prompt, cache, cfg, rules,
         prefill_impl=_select_prefill_impl(cfg, t, prefill))
@@ -330,7 +408,8 @@ def _generate(params, prompt, n_new, cfg, rules, max_len, pick_next,
 
 def greedy_decode(params, prompt, n_new: int, cfg: BurnInConfig,
                   rules: ShardingRules | None = None,
-                  max_len: int | None = None, prefill: str = "auto"):
+                  max_len: int | None = None, prefill: str = "auto",
+                  cache_dtype: str = "bf16"):
     """Greedy generation: prefill the prompt, then ``n_new`` cached steps.
 
     Returns generated tokens ``[B, n_new]``. Jittable end-to-end (the
@@ -341,7 +420,7 @@ def greedy_decode(params, prompt, n_new: int, cfg: BurnInConfig,
     through the flash kernel (matching their training numerics).
     """
     return _generate(params, prompt, n_new, cfg, rules, max_len, None,
-                     prefill)
+                     prefill, cache_dtype)
 
 
 def sample_decode(params, prompt, n_new: int, cfg: BurnInConfig, rng,
@@ -349,7 +428,7 @@ def sample_decode(params, prompt, n_new: int, cfg: BurnInConfig, rng,
                   max_len: int | None = None,
                   temperature: float = 1.0, top_k: int | None = None,
                   top_p: float | None = None,
-                  prefill: str = "auto"):
+                  prefill: str = "auto", cache_dtype: str = "bf16"):
     """Temperature / top-k / nucleus (top-p) sampling over the cached loop.
 
     ``temperature`` scales logits (→0 recovers greedy); ``top_k`` keeps
@@ -401,12 +480,13 @@ def sample_decode(params, prompt, n_new: int, cfg: BurnInConfig, rng,
         return jax.random.categorical(key, logits, axis=-1)
 
     return _generate(params, prompt, n_new, cfg, rules, max_len, (rng, pick),
-                     prefill)
+                     prefill, cache_dtype)
 
 
 def make_decoder(cfg: BurnInConfig, rules: ShardingRules | None = None,
-                 n_new: int = 32, max_len: int | None = None):
+                 n_new: int = 32, max_len: int | None = None,
+                 cache_dtype: str = "bf16"):
     """Compiled greedy decoder: ``decoder(params, prompt) → [B, n_new]``."""
     fn = functools.partial(greedy_decode, n_new=n_new, cfg=cfg, rules=rules,
-                           max_len=max_len)
+                           max_len=max_len, cache_dtype=cache_dtype)
     return jax.jit(fn)

@@ -246,7 +246,8 @@ def quantized_nbytes(qparams) -> int:
 def make_quantized_decoder(cfg: BurnInConfig,
                            rules: ShardingRules | None = None,
                            n_new: int = 32, max_len: int | None = None,
-                           dtype=jnp.bfloat16, fused: bool = True):
+                           dtype=jnp.bfloat16, fused: bool = True,
+                           cache_dtype: str = "bf16"):
     """Compiled greedy decoder over int8-resident weights:
     ``decoder(qparams, prompt) → [B, n_new]`` with ``qparams`` from
     :func:`quantize_params`. The decode program is the stock
@@ -261,19 +262,24 @@ def make_quantized_decoder(cfg: BurnInConfig,
     ``dtype`` is the expected compute dtype and must MATCH the one the
     QTensor leaves were built with (compute dtype is a property of the
     params, set in :func:`quantize_params`) — a mismatch errors loudly
-    rather than silently computing in the params' dtype."""
+    rather than silently computing in the params' dtype.
+
+    ``cache_dtype="int8"`` additionally quantises the KV cache
+    (``decode.init_cache``) — the full int8 serving stack: int8 weight
+    bytes AND int8 cache bytes per step, the two HBM reads that bound
+    decode throughput."""
     expected = jnp.dtype(dtype)
     if fused:
         def run(qparams, prompt):
             return greedy_decode(qparams, prompt, n_new, cfg, rules,
-                                 max_len=max_len)
+                                 max_len=max_len, cache_dtype=cache_dtype)
     else:
         def run(qparams, prompt):
             params = jax.tree.map(
                 lambda x: x.dequantize() if isinstance(x, QTensor) else x,
                 qparams, is_leaf=lambda x: isinstance(x, QTensor))
             return greedy_decode(params, prompt, n_new, cfg, rules,
-                                 max_len=max_len)
+                                 max_len=max_len, cache_dtype=cache_dtype)
     jitted = jax.jit(run)
 
     def decoder(qparams, prompt):

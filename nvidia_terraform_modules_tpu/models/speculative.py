@@ -128,8 +128,12 @@ def speculative_greedy_decode(params, prompt, n_new: int,
         last = s["ctx"][cur - 1]
         draft = _ngram_draft(s["ctx"], cur, k, cfg.vocab)     # [k]
         block = jnp.concatenate([last[None], draft])[None]    # [1, k+1]
+        # "cached": a mid-stream t>1 forward — the verification block
+        # must attend over the cache buffer, never be mistaken for a
+        # pos-0 prefill (which under an int8 cache reroutes to the
+        # local full-precision k/v)
         logits, cache = forward_cached(params, block, s["cache"], cfg,
-                                       rules)
+                                       rules, prefill_impl="cached")
         preds = jnp.argmax(logits[0], axis=-1)                # [k+1]
         # position j's prediction continues draft[j-1]; accept while the
         # draft agrees with the model's own argmax chain

@@ -341,3 +341,127 @@ def test_sampling_top_p_validation():
     with pytest.raises(ValueError, match="top_p"):
         sample_decode(params, prompt, 4, cfg, jax.random.PRNGKey(0),
                       top_p=1.5)
+
+
+# ---------------------------------------------------------------- int8 cache
+
+
+def test_quantize_kv_roundtrip_bound():
+    """Per-vector symmetric int8: |dequant - x| <= scale (one rounding
+    step), scale = amax/127 per cached vector."""
+    from nvidia_terraform_modules_tpu.models import quantize_kv
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 3, 16), jnp.float32)
+    q, s = quantize_kv(x)
+    assert q.dtype == jnp.int8 and s.shape == (2, 5, 3)
+    deq = q.astype(jnp.float32) * s[..., None]
+    amax = jnp.max(jnp.abs(x), axis=-1)
+    assert jnp.allclose(s, amax / 127.0)
+    assert float(jnp.max(jnp.abs(deq - x) - s[..., None])) <= 1e-6
+
+
+def test_int8_cache_structure_and_dtypes():
+    # GQA config on purpose: the scale sidecar is per KV head (the cache
+    # only stores KV heads), not per query head
+    cfg = BurnInConfig(**{**CFG, "n_kv_heads": 2})
+    cache = init_cache(cfg, 2, 24, cache_dtype="int8")
+    assert cache["k"][0].dtype == jnp.int8
+    assert cache["k"][0].shape == (2, 24, cfg.kv_heads, cfg.head_dim)
+    assert cache["v_scale"][0].shape == (2, 24, cfg.kv_heads)
+    with pytest.raises(ValueError, match="cache_dtype"):
+        init_cache(cfg, 2, 24, cache_dtype="fp8")
+
+
+def test_int8_cache_decode_tracks_exact_path():
+    """The int8 cache is lossy but must stay CLOSE: same first token
+    (prefill logits dominated by full-precision math) and high token
+    agreement with the bf16-cache decode on the same weights. All
+    deterministic: fixed seeds, CPU f32."""
+    cfg = BurnInConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab)
+    exact = greedy_decode(params, prompt, 12, cfg)
+    quant = greedy_decode(params, prompt, 12, cfg, cache_dtype="int8")
+    assert quant.shape == exact.shape
+    agreement = float(jnp.mean((exact == quant).astype(jnp.float32)))
+    assert jnp.array_equal(exact[:, 0], quant[:, 0])
+    assert agreement >= 0.75, f"int8 cache agreement {agreement}"
+
+
+def test_int8_cache_prefill_is_full_precision():
+    """The pos-0 prefill must NOT read quantised rows: its logits equal
+    the bf16-cache prefill's bit for bit (only decode STEPS pay the
+    quantisation noise)."""
+    cfg = BurnInConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab)
+    exact_logits, _ = forward_cached(
+        params, prompt, init_cache(cfg, 2, 16), cfg)
+    quant_logits, qcache = forward_cached(
+        params, prompt, init_cache(cfg, 2, 16, cache_dtype="int8"), cfg)
+    assert jnp.array_equal(exact_logits, quant_logits)
+    # ...while the cache rows themselves ARE quantised for later steps
+    assert qcache["k"][0].dtype == jnp.int8
+
+
+def test_int8_cache_gqa_decode():
+    """GQA + int8 cache: grouped-query contraction over dequantised
+    buffers — runs, tracks the exact path, sidecar shaped per KV head."""
+    cfg = BurnInConfig(**{**CFG, "n_kv_heads": 2})
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab)
+    exact = greedy_decode(params, prompt, 12, cfg)
+    quant = greedy_decode(params, prompt, 12, cfg, cache_dtype="int8")
+    assert jnp.array_equal(exact[:, 0], quant[:, 0])
+    agreement = float(jnp.mean((exact == quant).astype(jnp.float32)))
+    assert agreement >= 0.75, f"GQA int8 cache agreement {agreement}"
+
+
+def test_int8_cache_speculative_still_exact():
+    """Speculative decoding's t>1 verification forwards are mid-stream
+    ("cached"), not prefills — with the default bf16 cache the exactness
+    guarantee must survive the new prefill routing."""
+    from nvidia_terraform_modules_tpu.models import (
+        speculative_greedy_decode,
+    )
+
+    cfg = BurnInConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    span = jax.random.randint(jax.random.PRNGKey(3), (1, 4), 0, cfg.vocab)
+    prompt = jnp.tile(span, (1, 3))
+    toks, _steps = speculative_greedy_decode(params, prompt, 10, cfg, k=3)
+    ref = greedy_decode(params, prompt, 10, cfg)
+    assert jnp.array_equal(toks, ref)
+
+
+def test_int8_cache_on_mesh(jax8):
+    """int8 cache + tp-sharded heads: the scale sidecar must shard with
+    the cache and the compiled decoder must run on the mesh."""
+    mesh = build_mesh(plan_mesh(8, tp=2, sp=1))
+    rules = make_rules(mesh)
+    cfg = BurnInConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg, rules)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0, cfg.vocab)
+    dec = make_decoder(cfg, rules, n_new=6, max_len=16, cache_dtype="int8")
+    toks = dec(params, prompt)
+    assert toks.shape == (4, 6)
+    ref = make_decoder(cfg, None, n_new=6, max_len=16, cache_dtype="int8")(
+        jax.device_get(params), jax.device_get(prompt))
+    assert jnp.array_equal(jax.device_get(toks), ref)
+
+
+def test_int8_cache_full_int8_stack():
+    """int8 weights (fused kernel) + int8 cache compose."""
+    from nvidia_terraform_modules_tpu.models import (
+        make_quantized_decoder,
+        quantize_params,
+    )
+
+    cfg = BurnInConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    qparams = quantize_params(params, dtype=cfg.dtype)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab)
+    dec = make_quantized_decoder(cfg, n_new=6, max_len=16, dtype=cfg.dtype,
+                                 cache_dtype="int8")
+    toks = dec(qparams, prompt)
+    assert toks.shape == (2, 6)
