@@ -11,7 +11,8 @@ terraform binary in CI, so tfsim ships the same verbs offline::
     python -m nvidia_terraform_modules_tpu.tfsim validate gke-tpu [-json]
     python -m nvidia_terraform_modules_tpu.tfsim plan gke-tpu -var project_id=p \
         -var cluster_name=c [-state terraform.tfstate.json] [-json] [-target ADDR] \
-        [-replace ADDR] [-out plan.tfplan] [-refresh-only] [-destroy]
+        [-replace ADDR] [-out plan.tfplan] [-refresh-only] [-destroy] \
+        [-detailed-exitcode] [-generate-config-out generated.tf]
     python -m nvidia_terraform_modules_tpu.tfsim apply gke-tpu ... -state f [-target ADDR]
     python -m nvidia_terraform_modules_tpu.tfsim apply plan.tfplan   # saved-plan apply
     python -m nvidia_terraform_modules_tpu.tfsim show plan.tfplan [-json]
@@ -248,6 +249,14 @@ def _write_state(path: str, state: State) -> None:
         state.lineage = (existing.lineage if existing and existing.lineage
                          else str(uuid.uuid4()))
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    if os.path.exists(path):
+        # terraform's local backend keeps the PREVIOUS state as .backup
+        # on every write — the recovery artifact for a bad apply/surgery
+        # (restore: `cp x.backup x` or `state push -force < x.backup`)
+        with open(path) as fh:
+            previous = fh.read()
+        with open(path + ".backup", "w") as fh:
+            fh.write(previous)
     with open(path, "w") as fh:
         fh.write(state.to_json())
 
@@ -324,15 +333,19 @@ def _plan_against_state(args, mod=None, state_path=_UNRESOLVED):
         for old, new in renames:
             # stderr: diagnostics must not corrupt `plan -json` stdout
             print(f"  moved: {old} -> {new}", file=sys.stderr)
-    adopted: list[tuple[str, str]] = []
+    imports_info = {"adopted": [], "missing": []}
     import_mode = not (getattr(args, "refresh_only", False)
                        or getattr(args, "destroy", False)
                        or args.fn is cmd_refresh)
     if mod.imports and import_mode:
-        prior, adopted = adopt_config_imports(mod, plan, prior)
+        prior, adopted, missing = adopt_config_imports(
+            mod, plan, prior,
+            collect_missing=bool(getattr(args, "generate_config_out",
+                                         None)))
+        imports_info = {"adopted": adopted, "missing": missing}
         for addr, rid in adopted:
             print(f"  import: {addr} (id={rid})", file=sys.stderr)
-    return plan, prior, state_path, disk_serial, adopted
+    return plan, prior, state_path, disk_serial, imports_info
 
 
 def _print_plan_marks(d, order, show_noop: bool) -> None:
@@ -472,7 +485,28 @@ def cmd_plan(args) -> int:
         mod, state_path = _resolve_paths(args)
         with _state_lock(args, state_path, "OperationTypePlan"):
             (plan, prior, state_path, disk_serial,
-             adopted) = _plan_against_state(args, mod, state_path)
+             imports_info) = _plan_against_state(args, mod, state_path)
+            adopted = imports_info["adopted"]
+            if getattr(args, "generate_config_out", None) and \
+                    imports_info["missing"]:
+                from .schema import skeleton_hcl
+
+                if os.path.exists(args.generate_config_out):
+                    # terraform refuses an existing path — regenerating
+                    # would clobber the operator's hand-filled TODOs
+                    print(f"Error: -generate-config-out "
+                          f"{args.generate_config_out!r} already exists "
+                          f"— move or remove it first", file=sys.stderr)
+                    return 1
+                with open(args.generate_config_out, "w") as fh:
+                    for addr, rid in imports_info["missing"]:
+                        fh.write(skeleton_hcl(addr, rid))
+                print(f"Config generation: "
+                      f"{len(imports_info['missing'])} skeleton block(s) "
+                      f"written to {args.generate_config_out} — review "
+                      f"every TODO, move the file into the module, then "
+                      f"plan again to stage the import(s).",
+                      file=sys.stderr)
             if getattr(args, "refresh_only", False):
                 if getattr(args, "out", None) or \
                         getattr(args, "destroy", False) or \
@@ -516,7 +550,8 @@ def cmd_plan(args) -> int:
     # diff only because adoption already happened in-memory, but apply
     # is still needed to persist it.
     rc = 2 if (getattr(args, "detailed_exitcode", False)
-               and not (d.is_noop and not adopted)) else 0
+               and not (d.is_noop and not adopted
+                        and not imports_info["missing"])) else 0
     if args.json:
         print(json.dumps({
             "actions": d.actions,
@@ -1341,6 +1376,8 @@ def main(argv: list[str] | None = None) -> int:
     c.add_argument("-destroy", action="store_true", dest="destroy")
     c.add_argument("-detailed-exitcode", action="store_true",
                    dest="detailed_exitcode")
+    c.add_argument("-generate-config-out", default=None,
+                   dest="generate_config_out")
     a = add_module_cmd("apply", cmd_apply, state=True)
     a.add_argument("-target", action="append", dest="target")
     a.add_argument("-replace", action="append", dest="replace")

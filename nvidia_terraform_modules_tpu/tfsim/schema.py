@@ -494,3 +494,40 @@ def _walk(body: A.Body, schema: BlockSchema, path: str,
     # blocks shadowing required attrs don't satisfy them; nothing to do —
     # required checking above is attribute-only by design.
     del seen_blocks
+
+
+def skeleton_hcl(addr: str, resource_id: str) -> str:
+    """Generated-config skeleton for an ``import {}`` target without
+    configuration (``plan -generate-config-out``, terraform 1.5).
+
+    Real terraform fills attribute values from the provider's read of the
+    imported resource; offline there is nothing to read, so required
+    arguments (per the vendored schema) are emitted as TODO placeholders
+    — the generated file is a reviewed starting point, exactly the
+    workflow terraform documents for its own (experimental) generator.
+    """
+    parts = addr.split(".")
+    if len(parts) != 2:
+        return (f"# tfsim could not generate config for {addr!r} "
+                f"(id={resource_id!r}): only top-level type.name import "
+                f"targets are generatable\n\n")
+    rtype, name = parts
+    lines = [
+        f"# __generated__ by tfsim from import of {addr} "
+        f'(id = "{resource_id}")',
+        "# Review every TODO before planning again.",
+        f'resource "{rtype}" "{name}" {{',
+    ]
+    schema = SCHEMAS.get(rtype)
+    if schema is None:
+        lines.append("  # no vendored schema for this type — fill in the "
+                     "arguments by hand")
+    else:
+        # required top-level arguments only: nested blocks are optional
+        # on every vendored type, and emitting them would suggest the
+        # imported resource necessarily has them
+        for attr in sorted(schema.required):
+            lines.append(f'  {attr} = null # TODO: value of the imported '
+                         f"resource's {attr}")
+    lines.append("}")
+    return "\n".join(lines) + "\n\n"

@@ -359,8 +359,10 @@ def import_resource(state: State | None, plan: Plan, addr: str,
                  lineage=state.lineage)
 
 
-def adopt_config_imports(module, plan: Plan, state: State | None
-                         ) -> tuple[State | None, list[tuple[str, str]]]:
+def adopt_config_imports(module, plan: Plan, state: State | None, *,
+                         collect_missing: bool = False
+                         ) -> tuple[State | None, list[tuple[str, str]],
+                                    list[tuple[str, str]]]:
     """Honour ``import {}`` blocks (terraform 1.5+ config-driven import).
 
     Each ``import { to = a.b  id = "…" }`` adopts the named instance into
@@ -370,10 +372,16 @@ def adopt_config_imports(module, plan: Plan, state: State | None
     can stay in config after the import lands. ``to`` must be a concrete
     address; ``id`` must be a literal string (tfsim has no evaluation
     context this early, and terraform itself resolves it pre-plan).
+
+    Returns ``(state, adopted, missing_config)``. A target with no
+    configuration block errors — unless ``collect_missing``, which
+    instead reports it in the third element for
+    ``plan -generate-config-out`` to generate a skeleton for.
     """
     from . import ast as A
 
     adopted: list[tuple[str, str]] = []
+    missing: list[tuple[str, str]] = []
     seen: set[str] = set()
     for blk in getattr(module, "imports", []):
         to_attr, id_attr = blk.body.attr("to"), blk.body.attr("id")
@@ -396,9 +404,26 @@ def adopt_config_imports(module, plan: Plan, state: State | None
                 f"import {to}: `id` must be a literal string")
         if state is not None and to in state.resources:
             continue  # already managed: the block is a no-op, not an error
+        if _is_data(to):
+            # same refusal import_resource gives — checked HERE so the
+            # collect_missing branch cannot swallow it into a skeleton
+            raise ValueError(
+                f"import: {to!r} is a data source — data is read every "
+                f"plan, never imported (terraform semantics)")
+        if collect_missing and to not in plan.instances and not any(
+                a.startswith(to + "[") for a in plan.instances):
+            if "[" in to:
+                # terraform refuses config generation for count/for_each
+                # instances — one block cannot represent an indexed set
+                raise ValueError(
+                    f"import {to}: config generation is not supported "
+                    f"for count/for_each instances — write the resource "
+                    f"block by hand")
+            missing.append((to, id_expr.value))
+            continue
         state = import_resource(state, plan, to, id_expr.value)
         adopted.append((to, id_expr.value))
-    return state, adopted
+    return state, adopted, missing
 
 
 def refresh_state(plan: Plan, state: State | None

@@ -733,6 +733,63 @@ def test_import_blocks_ignored_in_refresh_and_destroy(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_generate_config_out_for_unconfigured_import(tmp_path, capsys):
+    """plan -generate-config-out (terraform 1.5): an import target with
+    no configuration gets a schema-derived skeleton instead of an error;
+    moving the file into the module makes the next plan stage the
+    import for real."""
+    state = str(tmp_path / "s.json")
+    gen = str(tmp_path / "generated.tf")
+    (tmp_path / "main.tf").write_text(
+        'import {\n  to = google_compute_network.n\n  id = "net-1"\n}\n')
+    assert main(["plan", str(tmp_path), "-state", state,
+                 "-generate-config-out", gen]) == 0
+    err = capsys.readouterr().err
+    assert "skeleton block(s) written" in err
+    text = open(gen).read()
+    assert 'resource "google_compute_network" "n"' in text
+    assert "__generated__" in text and "name = null" in text
+    # the operator fills the TODOs and drops the file into the module:
+    # the very next plan stages (adopts) the import
+    (tmp_path / "generated.tf").write_text(
+        text.replace("name = null # TODO: value of the imported "
+                     "resource's name", 'name = "imported-net"'))
+    assert main(["apply", str(tmp_path), "-state", state]) == 0
+    capsys.readouterr()
+    st = json.load(open(state))
+    assert st["resources"]["google_compute_network.n"]["id"] == "net-1"
+
+
+def test_generate_config_out_guards(tmp_path, capsys):
+    """Review findings: an existing out-file refuses (never clobber
+    hand-filled TODOs), pending generation is a change for
+    -detailed-exitcode, and data/indexed targets error in both modes."""
+    state = str(tmp_path / "s.json")
+    # the out-file lives OUTSIDE the module dir (the operator hasn't
+    # moved it in yet), so re-plans keep seeing the target unconfigured
+    mod = tmp_path / "mod"
+    mod.mkdir()
+    gen = str(tmp_path / "generated.tf")
+    (mod / "main.tf").write_text(
+        'import {\n  to = google_compute_network.n\n  id = "net-1"\n}\n')
+    assert main(["plan", str(mod), "-state", state,
+                 "-generate-config-out", gen, "-detailed-exitcode"]) == 2
+    capsys.readouterr()
+    assert main(["plan", str(mod), "-state", state,
+                 "-generate-config-out", gen]) == 1
+    assert "already exists" in capsys.readouterr().err
+    (mod / "main.tf").write_text(
+        'import {\n  to = data.google_client_config.c\n  id = "x"\n}\n')
+    assert main(["plan", str(mod), "-state", state,
+                 "-generate-config-out", str(tmp_path / "g2.tf")]) == 1
+    assert "data source" in capsys.readouterr().err
+    (mod / "main.tf").write_text(
+        'import {\n  to = google_compute_network.n[0]\n  id = "x"\n}\n')
+    assert main(["plan", str(mod), "-state", state,
+                 "-generate-config-out", str(tmp_path / "g3.tf")]) == 1
+    assert "count/for_each" in capsys.readouterr().err
+
+
 def test_duplicate_import_blocks_rejected(tmp_path, capsys):
     state = str(tmp_path / "s.json")
     (tmp_path / "main.tf").write_text(

@@ -219,6 +219,19 @@ def test_state_pull_needs_no_lock(mod, tmp_path, capsys):
     release_lock(info)
 
 
+def test_state_backup_written_on_every_write(mod, tmp_path, capsys):
+    """terraform's local backend keeps the previous state as .backup —
+    the recovery artifact for a bad apply or state surgery."""
+    s = _state(tmp_path)
+    assert main(["apply", mod, "-state", s]) == 0
+    assert not os.path.exists(s + ".backup")  # first write: no previous
+    serial1 = json.loads(open(s).read())["serial"]
+    assert main(["taint", "google_compute_network.vpc", "-state", s]) == 0
+    backup = json.loads(open(s + ".backup").read())
+    assert backup["serial"] == serial1 and "tainted" not in backup
+    capsys.readouterr()
+
+
 # ---------------------------------------------------------------- lineage
 
 
