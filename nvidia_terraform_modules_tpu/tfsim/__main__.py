@@ -13,7 +13,8 @@ terraform binary in CI, so tfsim ships the same verbs offline::
         -var cluster_name=c [-state terraform.tfstate.json] [-json] [-target ADDR] \
         [-replace ADDR] [-out plan.tfplan] [-refresh-only] [-destroy] \
         [-detailed-exitcode] [-generate-config-out generated.tf]
-    python -m nvidia_terraform_modules_tpu.tfsim apply gke-tpu ... -state f [-target ADDR]
+    python -m nvidia_terraform_modules_tpu.tfsim apply gke-tpu ... -state f \
+        [-target ADDR] [-replace ADDR] [-refresh-only] [-destroy]
     python -m nvidia_terraform_modules_tpu.tfsim apply plan.tfplan   # saved-plan apply
     python -m nvidia_terraform_modules_tpu.tfsim show plan.tfplan [-json]
     python -m nvidia_terraform_modules_tpu.tfsim refresh gke-tpu ... -state f
@@ -465,6 +466,18 @@ def _destroy_plan_of(plan, prior, module_dir: str):
     return empty, diff(empty, prior)
 
 
+def _reject_destroy_combinations(args) -> bool:
+    """Shared -destroy flag-combination guard for plan and apply: a
+    destroy is everything-or-nothing; surgical scope comes from
+    `state rm` + apply instead. Returns True (and prints) on misuse."""
+    if getattr(args, "target", None) or getattr(args, "replace", None):
+        print("Error: -destroy cannot combine with -target/-replace — "
+              "destroy everything, or remove entries surgically with "
+              "`state rm` + apply", file=sys.stderr)
+        return True
+    return False
+
+
 def _resolve_paths(args):
     """(module, state-path) ahead of locking: the lock must be taken
     before the first state read, and resolving the path needs the
@@ -518,12 +531,7 @@ def cmd_plan(args) -> int:
                     return 2
                 return _refresh_only_print(plan, prior, args)
             if getattr(args, "destroy", False):
-                if getattr(args, "target", None) or \
-                        getattr(args, "replace", None):
-                    print("Error: -destroy cannot combine with -target/"
-                          "-replace — destroy everything via the saved "
-                          "plan, or surgically with `state rm` + apply",
-                          file=sys.stderr)
+                if _reject_destroy_combinations(args):
                     return 2
                 plan, d = _destroy_plan_of(plan, prior, args.dir)
             else:
@@ -580,10 +588,12 @@ def _apply_saved_plan(args) -> int:
     if args.var or args.var_file or getattr(args, "target", None) or \
             getattr(args, "replace", None) or \
             getattr(args, "refresh_only", False) or \
+            getattr(args, "destroy", False) or \
             getattr(args, "workspace", None):
         print("Error: -var/-var-file/-target/-replace/-refresh-only/"
-              "-workspace cannot be combined with a saved plan file (the "
-              "plan is already resolved and pinned to its state)",
+              "-destroy/-workspace cannot be combined with a saved plan "
+              "file (the plan is already resolved and pinned to its "
+              "state — a destroy plan comes from `plan -destroy -out`)",
               file=sys.stderr)
         return 2
     payload = load_plan_file(args.dir)
@@ -641,18 +651,31 @@ def cmd_apply(args) -> int:
             (plan, prior, state_path, _serial,
              _adopted) = _plan_against_state(args, mod, state_path)
             if getattr(args, "refresh_only", False):
-                if getattr(args, "replace", None):
+                if getattr(args, "replace", None) or \
+                        getattr(args, "destroy", False):
                     print("Error: -refresh-only cannot be combined with "
-                          "-replace (a refresh accepts drift, it does "
-                          "not stage actions)", file=sys.stderr)
+                          "-replace/-destroy (a refresh accepts drift, "
+                          "it does not stage actions)", file=sys.stderr)
                     return 2
                 n, state = _refresh_only_report(plan, prior)
                 if state_path and n:
                     _write_state(state_path, state)
                 return 0
-            targets = getattr(args, "target", None)
-            d = diff(plan, prior, targets, getattr(args, "replace", None))
-            state = apply_plan(plan, prior, targets, d=d)
+            if getattr(args, "destroy", False):
+                # terraform's `apply -destroy` (== `terraform destroy`
+                # once approved): the state-driven teardown, behind the
+                # same prevent_destroy refusals as `plan -destroy`. The
+                # config-level `destroy` verb stays the dry-run hazard
+                # analysis.
+                if _reject_destroy_combinations(args):
+                    return 2
+                plan, d = _destroy_plan_of(plan, prior, args.dir)
+            else:
+                targets = getattr(args, "target", None)
+                d = diff(plan, prior, targets,
+                         getattr(args, "replace", None))
+            state = apply_plan(plan, prior,
+                               getattr(args, "target", None), d=d)
             if state_path:
                 _write_state(state_path, state)
     except (PlanError, PlanFileError, ValueError, OSError) as ex:
@@ -1383,6 +1406,7 @@ def main(argv: list[str] | None = None) -> int:
     a.add_argument("-replace", action="append", dest="replace")
     a.add_argument("-workspace", default=None)
     a.add_argument("-refresh-only", action="store_true", dest="refresh_only")
+    a.add_argument("-destroy", action="store_true", dest="destroy")
 
     sh = sub.add_parser("show")
     sh.add_argument("path")

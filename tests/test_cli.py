@@ -816,6 +816,51 @@ def test_config_driven_import_errors(tmp_path, capsys):
     assert "literal string" in capsys.readouterr().err
 
 
+def test_apply_destroy_tears_down_state(tmp_path, capsys):
+    """terraform's `apply -destroy` (the real teardown path, distinct
+    from the config-level `destroy` hazard dry-run): deletes everything
+    from state, honours prevent_destroy, rejects -target/-replace and
+    saved-plan combination."""
+    state = str(tmp_path / "s.json")
+    (tmp_path / "main.tf").write_text(
+        'resource "google_compute_network" "n" {\n  name = "x"\n}\n'
+        'resource "google_compute_subnetwork" "s" {\n  name = "y"\n}\n')
+    assert main(["apply", str(tmp_path), "-state", state]) == 0
+    capsys.readouterr()
+    assert main(["apply", str(tmp_path), "-state", state, "-destroy",
+                 "-target", "google_compute_network.n"]) == 2
+    capsys.readouterr()
+    assert main(["apply", str(tmp_path), "-state", state, "-destroy",
+                 "-refresh-only"]) == 2
+    assert "-refresh-only" in capsys.readouterr().err
+    assert main(["apply", str(tmp_path), "-state", state, "-destroy"]) == 0
+    out = capsys.readouterr().out
+    assert "2 destroyed" in out
+    assert json.load(open(state))["resources"] == {}
+    # empty state: nothing to destroy is an error, like plan -destroy
+    assert main(["apply", str(tmp_path), "-state", state, "-destroy"]) == 1
+    assert "nothing to destroy" in capsys.readouterr().err
+    # prevent_destroy refuses the teardown outright
+    (tmp_path / "main.tf").write_text(
+        'resource "google_compute_network" "n" {\n  name = "x"\n'
+        '  lifecycle {\n    prevent_destroy = true\n  }\n}\n')
+    assert main(["apply", str(tmp_path), "-state", state]) == 0
+    capsys.readouterr()
+    assert main(["apply", str(tmp_path), "-state", state, "-destroy"]) == 1
+    assert "prevent_destroy" in capsys.readouterr().err
+    assert "google_compute_network.n" in json.load(
+        open(state))["resources"]
+    # saved plan + -destroy is a usage error
+    pfile = str(tmp_path / "p.tfplan")
+    (tmp_path / "main.tf").write_text(
+        'resource "google_compute_network" "n" {\n  name = "x"\n}\n')
+    assert main(["plan", str(tmp_path), "-state", state,
+                 "-out", pfile]) == 0
+    capsys.readouterr()
+    assert main(["apply", pfile, "-destroy"]) == 2
+    capsys.readouterr()
+
+
 def test_version_verb(capsys):
     assert main(["version"]) == 0
     out = capsys.readouterr().out
