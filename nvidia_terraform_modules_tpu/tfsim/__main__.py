@@ -561,12 +561,19 @@ def cmd_plan(args) -> int:
                and not (d.is_noop and not adopted
                         and not imports_info["missing"])) else 0
     if args.json:
-        print(json.dumps({
+        payload = {
             "actions": d.actions,
             "changed_keys": d.changed_keys,
             "outputs": render(plan.outputs),
             "check_failures": plan.check_failures,
-        }, indent=2, sort_keys=True))
+        }
+        if imports_info["adopted"]:
+            # machine consumers see staged config-driven imports the way
+            # the human sees the stderr `import:` lines
+            payload["imports"] = [
+                {"to": addr, "id": rid}
+                for addr, rid in imports_info["adopted"]]
+        print(json.dumps(payload, indent=2, sort_keys=True))
         return rc
     _print_plan_marks(d, plan.order, args.show_noop)
     for failure in plan.check_failures:

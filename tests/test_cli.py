@@ -760,6 +760,17 @@ def test_generate_config_out_for_unconfigured_import(tmp_path, capsys):
     assert st["resources"]["google_compute_network.n"]["id"] == "net-1"
 
 
+def test_plan_json_reports_imports(tmp_path, capsys):
+    state = str(tmp_path / "s.json")
+    (tmp_path / "main.tf").write_text(
+        'import {\n  to = google_compute_network.n\n  id = "net-1"\n}\n'
+        'resource "google_compute_network" "n" {\n  name = "x"\n}\n')
+    assert main(["plan", str(tmp_path), "-state", state, "-json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["imports"] == [
+        {"to": "google_compute_network.n", "id": "net-1"}]
+
+
 def test_generate_config_out_guards(tmp_path, capsys):
     """Review findings: an existing out-file refuses (never clobber
     hand-filled TODOs), pending generation is a change for
