@@ -284,6 +284,39 @@ def section_decode_int8() -> dict:
     return out
 
 
+def section_decode_moe() -> dict:
+    """MoE serving throughput: the routed FFN at drop-free capacity in
+    the cached decode loop (models/moe.py dispatch/combine einsums).
+    Same decode regime and two-point method as section_decode, so the
+    dense number alongside is the apples-to-apples baseline."""
+    import dataclasses
+
+    import jax
+
+    from nvidia_terraform_modules_tpu.models import init_params, make_decoder
+
+    cfg = _flagship_cfg()
+    moe_cfg = dataclasses.replace(
+        cfg, attn="dense", batch=8 if _on_tpu() else cfg.batch,
+        n_experts=8 if _on_tpu() else 4,
+        # top-1 Switch: the serving-side default; d_ff stays flagship so
+        # per-token FLOPs match the dense twin (experts add WEIGHT bytes)
+        router_top_k=1)
+    prompt_len, n_new = (512, 64) if _on_tpu() else (8, 8)
+    params = init_params(jax.random.PRNGKey(0), moe_cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(3),
+                                (moe_cfg.batch, prompt_len), 0,
+                                moe_cfg.vocab)
+    max_len = prompt_len + n_new
+    decoder = make_decoder(moe_cfg, n_new=n_new, max_len=max_len)
+    prefiller = make_decoder(moe_cfg, n_new=1, max_len=max_len)
+    step_s, _ = _time_decode(decoder, prefiller, params, prompt, n_new)
+    return {
+        "decode_moe_tokens_per_s": round(moe_cfg.batch / step_s, 1),
+        "decode_moe_experts": moe_cfg.n_experts,
+    }
+
+
 def section_decode_spec() -> dict:
     """Prompt-lookup speculative decoding at batch 1 — the serving
     LATENCY lever: drafts verified k+1-at-a-time for ~one step's weight
@@ -388,6 +421,7 @@ SECTIONS = {
     "burnin": section_burnin,
     "decode": section_decode,
     "decode_int8": section_decode_int8,
+    "decode_moe": section_decode_moe,
     "decode_spec": section_decode_spec,
     "longctx": section_longctx,
 }
@@ -402,6 +436,7 @@ SECTION_TIMEOUT_S = {
     "burnin": 900,
     "decode": 600,
     "decode_int8": 600,
+    "decode_moe": 600,
     "decode_spec": 600,
     "longctx": 600,
 }

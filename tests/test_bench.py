@@ -86,6 +86,8 @@ def test_full_capture_emits_single_json_line_rc0():
     assert payload["bench_platform"] == "cpu"
     assert payload["smoke_ok"] is True
     for key in ("burnin_mfu", "decode_tokens_per_s",
-                "decode_int8_tokens_per_s", "decode_spec_tokens_per_s",
+                "decode_int8_tokens_per_s",
+                "decode_int8_kvcache_tokens_per_s",
+                "decode_moe_tokens_per_s", "decode_spec_tokens_per_s",
                 "hbm_roofline"):
         assert key in payload, key
