@@ -1,0 +1,193 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Slot-based continuous batching: the serving-engine loop.
+
+``greedy_decode`` serves ONE batch whose requests start and stop
+together. Real serving traffic doesn't: requests arrive with different
+prompt lengths and leave after different generation lengths, and a
+static batch wastes every slot that finished early. The standard answer
+(vLLM/TGI-style continuous batching, re-thought for TPU static shapes)
+is a fixed pool of SLOTS:
+
+- the KV cache is one ``[slots, S_max, kv, D]`` buffer per layer — a
+  slot's region is recycled the moment its request completes;
+- every decode step advances ALL slots in one compiled program (a
+  ``vmap`` of the single-row cached forward, so each slot carries its
+  OWN position — the per-row ``pos`` is exactly what distinguishes this
+  from ``greedy_decode``'s single shared position);
+- prefills run at the request's exact prompt length and are scattered
+  into the slot's cache region; admission is host-side bookkeeping
+  between compiled steps (the host owns WHICH request sits in a slot,
+  the device owns the math — no data-dependent shapes anywhere).
+
+Exactness contract: each request's tokens EQUAL ``greedy_decode`` run
+alone on that request (same weights, same prompt) — batching and slot
+recycling are scheduling, never a different model. This mirrors the
+cached-vs-full-re-forward contract in ``models/decode.py`` and is pinned
+by ``tests/test_serving.py``, including schedules where requests share
+steps with neighbours that joined mid-flight.
+
+Efficiency notes (TPU): the vmapped row step lowers to the same batched
+GEMMs as a ``[slots, 1]`` decode forward — weights are broadcast, not
+copied. Finished-and-empty slots still compute (the bubble every static
+engine pays); admission cost is one exact-length prefill compile per
+DISTINCT prompt length, so production callers should pad prompts into a
+few length buckets — the loop itself does not care.
+
+Reference analogue: none — the reference provisions serving
+infrastructure (node pools, runtime DaemonSets) and never touches model
+bytes (SURVEY §2.6); this module is the workload the ``serve``-named
+slice pools exist to run.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import deque
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.sharding import ShardingRules
+from .burnin import BurnInConfig
+from .decode import forward_cached, init_cache
+
+
+def _stacked_cache(cfg: BurnInConfig, slots: int, max_len: int):
+    """One pooled cache: every per-layer leaf gains a leading slot dim;
+    ``pos`` becomes per-slot ``[slots]``."""
+    row = init_cache(cfg, 1, max_len)
+    stacked = jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (slots,) + x.shape), row)
+    stacked["pos"] = jnp.zeros((slots,), jnp.int32)
+    return stacked
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))
+def _insert_row(row_cache, stacked, slot):
+    """Scatter a freshly prefilled row cache into the pool at ``slot``
+    (a traced index: one compile serves every slot)."""
+    new = jax.tree.map(lambda big, one: big.at[slot].set(one),
+                       {k: v for k, v in stacked.items() if k != "pos"},
+                       {k: v for k, v in row_cache.items() if k != "pos"})
+    new["pos"] = stacked["pos"].at[slot].set(row_cache["pos"])
+    return new
+
+
+def make_serve_step(params, cfg: BurnInConfig):
+    """Compiled all-slots decode step: ``(tokens [slots], cache) →
+    (next tokens [slots], cache)`` with per-slot positions. The pooled
+    cache is DONATED — the step updates it in place rather than paying
+    a full-pool copy per token (the bandwidth a slot engine exists to
+    save)."""
+
+    def row(tok, cache):
+        logits, cache = forward_cached(params, tok[None, None], cache, cfg,
+                                       prefill_impl="cached")
+        return jnp.argmax(logits[0, -1], axis=-1), cache
+
+    vrow = jax.vmap(row)
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def step(tokens, stacked):
+        nxt, new = vrow(tokens, stacked)
+        return nxt, new
+
+    return step
+
+
+def make_prefill(params, cfg: BurnInConfig, max_len: int):
+    """Exact-length prompt prefill → ``(first token, row cache)``.
+
+    One compile per distinct prompt length (jit cache keyed on shape);
+    bucket prompts upstream if that matters for your traffic. The
+    prefill attention impl resolves the same way ``greedy_decode``'s
+    does (``_select_prefill_impl``): dense-trained configs keep the
+    bit-exact dense path, long-context configs (flash/ring/ulysses) go
+    through the fused kernel — dense scores at their prompt lengths are
+    exactly the OOM that impl exists to avoid, and the engine's
+    equality contract is against ``greedy_decode`` with the SAME
+    resolution.
+    """
+    from .decode import _select_prefill_impl
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def prefill(prompt, impl):                             # [1, L]
+        cache = init_cache(cfg, 1, max_len)
+        logits, cache = forward_cached(params, prompt, cache, cfg,
+                                       prefill_impl=impl)
+        return jnp.argmax(logits[0, -1], axis=-1), cache
+
+    def run(prompt):
+        impl = _select_prefill_impl(cfg, int(prompt.shape[-1]), "auto")
+        return prefill(prompt, impl)
+
+    return run
+
+
+def serve(params, prompts: Sequence[Any], n_new: int, cfg: BurnInConfig,
+          *, slots: int = 4, max_len: int | None = None,
+          rules: ShardingRules | None = None) -> list[Any]:
+    """Serve ``prompts`` (each ``[L_i]``) with continuous batching.
+
+    Returns one ``[n_new]`` token array per prompt, in request order.
+    ``slots`` bounds device-resident concurrency; requests beyond it
+    queue and take over slots as earlier requests finish — the recycling
+    that distinguishes this loop from a static batch. ``rules`` is
+    accepted for API symmetry; the pooled cache currently computes
+    replicated (shard the slot dim over dp in a follow-up).
+    """
+    del rules
+    if not prompts:
+        return []
+    if n_new < 1:
+        raise ValueError(f"n_new must be >= 1, got {n_new}")
+    if max_len is None:
+        max_len = max(int(p.shape[-1]) for p in prompts) + n_new
+    for p in prompts:
+        if int(p.shape[-1]) + n_new > max_len:
+            raise ValueError(
+                f"prompt ({int(p.shape[-1])}) + n_new ({n_new}) exceeds "
+                f"max_len ({max_len})")
+    if slots < 1:
+        raise ValueError(f"slots must be >= 1, got {slots}")
+
+    prefill = make_prefill(params, cfg, max_len)
+    step = make_serve_step(params, cfg)
+
+    stacked = _stacked_cache(cfg, slots, max_len)
+    tokens = jnp.zeros((slots,), jnp.int32)
+    queue = deque(enumerate(prompts))
+    active: dict[int, int] = {}                  # slot → request index
+    out: dict[int, list] = {}
+
+    def retire_done():
+        for slot, req in list(active.items()):
+            if len(out[req]) >= n_new:
+                del active[slot]                 # slot recycles next admission
+
+    while queue or active:
+        # admission: every free slot takes the next queued request
+        for slot in range(slots):
+            if slot in active or not queue:
+                continue
+            req, prompt = queue.popleft()
+            first, row_cache = prefill(jnp.asarray(prompt)[None, :])
+            stacked = _insert_row(row_cache, stacked, slot)
+            tokens = tokens.at[slot].set(first)
+            active[slot] = req
+            out[req] = [first]
+        # a request the prefill token already satisfied (n_new == 1)
+        # must retire BEFORE the step, or it would collect an extra token
+        retire_done()
+        if not active:
+            continue
+        # one compiled step advances every slot (idle slots compute too —
+        # the static-shape bubble; their tokens are simply never read)
+        tokens, stacked = step(tokens, stacked)
+        for slot, req in list(active.items()):
+            out[req].append(tokens[slot])
+        retire_done()
+
+    return [jnp.stack(out[i]) for i in range(len(prompts))]
