@@ -49,6 +49,13 @@ def repo_root():
 #   pytest tests/ -q -m "not slow" --durations=0 | awk '$1+0>=4' ...
 # (test_manifest_is_fresh below fails loudly on renamed/deleted entries).
 SLOW_TESTS = frozenset({
+    "tests/test_serving.py::test_serve_matches_per_request_greedy_with_recycling",
+    "tests/test_serving.py::test_serve_moe_config",
+    "tests/test_serving.py::test_serve_flash_config_matches_its_own_greedy",
+    "tests/test_serving.py::test_serve_rope_config",
+    "tests/test_decode.py::test_int8_cache_speculative_still_exact",
+    "tests/test_decode.py::test_int8_cache_gqa_decode",
+    "tests/test_decode.py::test_int8_cache_on_mesh",
     "tests/test_burnin_model.py::test_loss_finite_unsharded",
     "tests/test_burnin_model.py::test_sharded_matches_unsharded_forward",
     "tests/test_decode.py::test_gqa_flash_prefill_close_to_dense",
