@@ -54,13 +54,41 @@ from .burnin import BurnInConfig
 from .decode import forward_cached, init_cache
 
 
-def _stacked_cache(cfg: BurnInConfig, slots: int, max_len: int):
+def _stacked_cache(cfg: BurnInConfig, slots: int, max_len: int,
+                   rules: ShardingRules | None = None):
     """One pooled cache: every per-layer leaf gains a leading slot dim;
-    ``pos`` becomes per-slot ``[slots]``."""
+    ``pos`` becomes per-slot ``[slots]``.
+
+    With ``rules`` the SLOT dim shards over the data axes (each device
+    group owns a subset of the pool — requests are data parallelism at
+    serve time) and KV heads over ``tp`` when they divide it, matching
+    ``init_cache``'s single-batch layout.
+    """
     row = init_cache(cfg, 1, max_len)
     stacked = jax.tree.map(
         lambda x: jnp.broadcast_to(x, (slots,) + x.shape), row)
     stacked["pos"] = jnp.zeros((slots,), jnp.int32)
+    if rules is not None:
+        data_shards = 1
+        for a in rules.data:
+            data_shards *= rules.mesh.shape.get(a, 1)
+        if slots % data_shards:
+            raise ValueError(
+                f"slots ({slots}) must divide over the data axes "
+                f"({data_shards} shards) — pad the pool")
+        tp = rules.mesh.shape.get("tp", 1)
+        head_axis = "tp" if cfg.kv_heads % tp == 0 else None
+        # k/v leaves are [slots, 1, S_max, kv, D] (the row's batch dim
+        # rides along); the leading SLOT dim takes the batch sharding,
+        # KV heads take tp — rules.act's implicit first axis set is
+        # exactly the slot dim here
+        s5 = rules.shard(rules.act(None, None, head_axis, None))
+        s1 = rules.shard(rules.act())
+        stacked = {
+            "k": [jax.device_put(x, s5) for x in stacked["k"]],
+            "v": [jax.device_put(x, s5) for x in stacked["v"]],
+            "pos": jax.device_put(stacked["pos"], s1),
+        }
     return stacked
 
 
@@ -134,11 +162,12 @@ def serve(params, prompts: Sequence[Any], n_new: int, cfg: BurnInConfig,
     Returns one ``[n_new]`` token array per prompt, in request order.
     ``slots`` bounds device-resident concurrency; requests beyond it
     queue and take over slots as earlier requests finish — the recycling
-    that distinguishes this loop from a static batch. ``rules`` is
-    accepted for API symmetry; the pooled cache currently computes
-    replicated (shard the slot dim over dp in a follow-up).
+    that distinguishes this loop from a static batch. With ``rules`` the
+    pool itself shards: slots over the data axes (requests ARE the data
+    parallelism at serve time), KV heads and the weight matmuls over
+    ``tp`` — the engine runs on the same mesh the train step used.
+    ``slots`` must divide the data-axis shard count.
     """
-    del rules
     if not prompts:
         return []
     if n_new < 1:
@@ -156,7 +185,7 @@ def serve(params, prompts: Sequence[Any], n_new: int, cfg: BurnInConfig,
     prefill = make_prefill(params, cfg, max_len)
     step = make_serve_step(params, cfg)
 
-    stacked = _stacked_cache(cfg, slots, max_len)
+    stacked = _stacked_cache(cfg, slots, max_len, rules)
     tokens = jnp.zeros((slots,), jnp.int32)
     queue = deque(enumerate(prompts))
     active: dict[int, int] = {}                  # slot → request index

@@ -114,6 +114,32 @@ def test_serve_flash_config_matches_its_own_greedy():
         assert jnp.array_equal(g, w)
 
 
+def test_serve_on_mesh_matches_unsharded(jax8):
+    """The pool shards over the mesh (slots on dp, heads/weights on tp)
+    and the engine's tokens still equal the unsharded run's exactly."""
+    from nvidia_terraform_modules_tpu.parallel import (
+        build_mesh,
+        make_rules,
+        plan_mesh,
+    )
+
+    mesh = build_mesh(plan_mesh(8, tp=2, sp=1))
+    rules = make_rules(mesh)
+    cfg = BurnInConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg, rules)
+    prompts = [jax.random.randint(jax.random.PRNGKey(i), (4 + 2 * (i % 2),),
+                                  0, cfg.vocab) for i in range(6)]
+    got = serve(params, prompts, 4, cfg, slots=4, rules=rules)
+    host_params = jax.tree.map(jnp.asarray, jax.device_get(params))
+    want = [greedy_decode(host_params, jnp.asarray(p)[None, :], 4,
+                          cfg)[0] for p in prompts]
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert jnp.array_equal(jax.device_get(g), w), f"request {i}"
+    # an indivisible pool is a clean error, not a device_put crash
+    with pytest.raises(ValueError, match="divide"):
+        serve(params, prompts, 4, cfg, slots=3, rules=rules)
+
+
 def test_serve_validation():
     cfg, params, prompts = _setup(n_prompts=2)
     with pytest.raises(ValueError, match="slots"):
