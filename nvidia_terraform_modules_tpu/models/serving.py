@@ -55,16 +55,18 @@ from .decode import forward_cached, init_cache
 
 
 def _stacked_cache(cfg: BurnInConfig, slots: int, max_len: int,
-                   rules: ShardingRules | None = None):
+                   rules: ShardingRules | None = None,
+                   cache_dtype: str = "bf16"):
     """One pooled cache: every per-layer leaf gains a leading slot dim;
     ``pos`` becomes per-slot ``[slots]``.
 
     With ``rules`` the SLOT dim shards over the data axes (each device
     group owns a subset of the pool — requests are data parallelism at
     serve time) and KV heads over ``tp`` when they divide it, matching
-    ``init_cache``'s single-batch layout.
+    ``init_cache``'s single-batch layout. ``cache_dtype="int8"`` pools
+    the quantised layout (int8 buffers + f32 scale sidecars).
     """
-    row = init_cache(cfg, 1, max_len)
+    row = init_cache(cfg, 1, max_len, cache_dtype=cache_dtype)
     stacked = jax.tree.map(
         lambda x: jnp.broadcast_to(x, (slots,) + x.shape), row)
     stacked["pos"] = jnp.zeros((slots,), jnp.int32)
@@ -81,14 +83,19 @@ def _stacked_cache(cfg: BurnInConfig, slots: int, max_len: int,
         # k/v leaves are [slots, 1, S_max, kv, D] (the row's batch dim
         # rides along); the leading SLOT dim takes the batch sharding,
         # KV heads take tp — rules.act's implicit first axis set is
-        # exactly the slot dim here
+        # exactly the slot dim here. Scale sidecars drop the head dim.
         s5 = rules.shard(rules.act(None, None, head_axis, None))
+        s4 = rules.shard(rules.act(None, None, head_axis))
         s1 = rules.shard(rules.act())
-        stacked = {
+        sharded = {
             "k": [jax.device_put(x, s5) for x in stacked["k"]],
             "v": [jax.device_put(x, s5) for x in stacked["v"]],
             "pos": jax.device_put(stacked["pos"], s1),
         }
+        for key in ("k_scale", "v_scale"):
+            if key in stacked:
+                sharded[key] = [jax.device_put(x, s4) for x in stacked[key]]
+        stacked = sharded
     return stacked
 
 
@@ -125,7 +132,8 @@ def make_serve_step(params, cfg: BurnInConfig):
     return step
 
 
-def make_prefill(params, cfg: BurnInConfig, max_len: int):
+def make_prefill(params, cfg: BurnInConfig, max_len: int,
+                 cache_dtype: str = "bf16"):
     """Exact-length prompt prefill → ``(first token, row cache)``.
 
     One compile per distinct prompt length (jit cache keyed on shape);
@@ -142,7 +150,7 @@ def make_prefill(params, cfg: BurnInConfig, max_len: int):
 
     @functools.partial(jax.jit, static_argnums=(1,))
     def prefill(prompt, impl):                             # [1, L]
-        cache = init_cache(cfg, 1, max_len)
+        cache = init_cache(cfg, 1, max_len, cache_dtype=cache_dtype)
         logits, cache = forward_cached(params, prompt, cache, cfg,
                                        prefill_impl=impl)
         return jnp.argmax(logits[0, -1], axis=-1), cache
@@ -156,7 +164,8 @@ def make_prefill(params, cfg: BurnInConfig, max_len: int):
 
 def serve(params, prompts: Sequence[Any], n_new: int, cfg: BurnInConfig,
           *, slots: int = 4, max_len: int | None = None,
-          rules: ShardingRules | None = None) -> list[Any]:
+          rules: ShardingRules | None = None,
+          cache_dtype: str = "bf16") -> list[Any]:
     """Serve ``prompts`` (each ``[L_i]``) with continuous batching.
 
     Returns one ``[n_new]`` token array per prompt, in request order.
@@ -182,10 +191,10 @@ def serve(params, prompts: Sequence[Any], n_new: int, cfg: BurnInConfig,
     if slots < 1:
         raise ValueError(f"slots must be >= 1, got {slots}")
 
-    prefill = make_prefill(params, cfg, max_len)
+    prefill = make_prefill(params, cfg, max_len, cache_dtype)
     step = make_serve_step(params, cfg)
 
-    stacked = _stacked_cache(cfg, slots, max_len, rules)
+    stacked = _stacked_cache(cfg, slots, max_len, rules, cache_dtype)
     tokens = jnp.zeros((slots,), jnp.int32)
     queue = deque(enumerate(prompts))
     active: dict[int, int] = {}                  # slot → request index

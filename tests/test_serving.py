@@ -140,6 +140,19 @@ def test_serve_on_mesh_matches_unsharded(jax8):
         serve(params, prompts, 4, cfg, slots=3, rules=rules)
 
 
+def test_serve_int8_cache_matches_solo_int8_decode():
+    """The full int8 serving stack composes with batching: the engine
+    quantises the same rows at the same positions as a solo int8-cache
+    greedy decode, so tokens are IDENTICAL (int8-vs-int8 — this is
+    exact, unlike int8-vs-bf16)."""
+    cfg, params, prompts = _setup(n_prompts=4)
+    got = serve(params, prompts, 5, cfg, slots=2, cache_dtype="int8")
+    want = [greedy_decode(params, p[None, :], 5, cfg,
+                          cache_dtype="int8")[0] for p in prompts]
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert jnp.array_equal(g, w), f"request {i} diverged"
+
+
 def test_serve_validation():
     cfg, params, prompts = _setup(n_prompts=2)
     with pytest.raises(ValueError, match="slots"):
