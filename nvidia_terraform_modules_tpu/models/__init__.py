@@ -26,7 +26,7 @@ from .decode import (  # noqa: F401
     quantize_kv,
     sample_decode,
 )
-from .serving import serve  # noqa: F401
+from .serving import make_serve_engine, serve  # noqa: F401
 from .speculative import (  # noqa: F401
     make_speculative_decoder,
     speculative_greedy_decode,

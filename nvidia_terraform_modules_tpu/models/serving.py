@@ -66,10 +66,11 @@ def _stacked_cache(cfg: BurnInConfig, slots: int, max_len: int,
     ``init_cache``'s single-batch layout. ``cache_dtype="int8"`` pools
     the quantised layout (int8 buffers + f32 scale sidecars).
     """
-    row = init_cache(cfg, 1, max_len, cache_dtype=cache_dtype)
-    stacked = jax.tree.map(
-        lambda x: jnp.broadcast_to(x, (slots,) + x.shape), row)
-    stacked["pos"] = jnp.zeros((slots,), jnp.int32)
+    if cache_dtype not in ("bf16", "int8"):
+        raise ValueError(
+            f"unknown cache_dtype {cache_dtype!r}: use bf16|int8")
+    quant = cache_dtype == "int8"
+    s5 = s4 = s1 = None
     if rules is not None:
         data_shards = 1
         for a in rules.data:
@@ -87,15 +88,28 @@ def _stacked_cache(cfg: BurnInConfig, slots: int, max_len: int,
         s5 = rules.shard(rules.act(None, None, head_axis, None))
         s4 = rules.shard(rules.act(None, None, head_axis))
         s1 = rules.shard(rules.act())
-        sharded = {
-            "k": [jax.device_put(x, s5) for x in stacked["k"]],
-            "v": [jax.device_put(x, s5) for x in stacked["v"]],
-            "pos": jax.device_put(stacked["pos"], s1),
-        }
-        for key in ("k_scale", "v_scale"):
-            if key in stacked:
-                sharded[key] = [jax.device_put(x, s4) for x in stacked[key]]
-        stacked = sharded
+
+    def zeros(shape, dtype, sharding):
+        if sharding is None:
+            return jnp.zeros(shape, dtype)
+        # materialise DIRECTLY into the sharded layout: an eager zeros +
+        # device_put would first commit the whole replicated pool on one
+        # device — the transient OOM sharding the pool exists to avoid
+        return jax.jit(lambda: jnp.zeros(shape, dtype),
+                       out_shardings=sharding)()
+
+    kv_shape = (slots, 1, max_len, cfg.kv_heads, cfg.head_dim)
+    buf_dtype = jnp.int8 if quant else cfg.dtype
+    stacked: dict[str, Any] = {
+        "k": [zeros(kv_shape, buf_dtype, s5) for _ in range(cfg.n_layers)],
+        "v": [zeros(kv_shape, buf_dtype, s5) for _ in range(cfg.n_layers)],
+        "pos": zeros((slots,), jnp.int32, s1),
+    }
+    if quant:
+        stacked["k_scale"] = [zeros(kv_shape[:4], jnp.float32, s4)
+                              for _ in range(cfg.n_layers)]
+        stacked["v_scale"] = [zeros(kv_shape[:4], jnp.float32, s4)
+                              for _ in range(cfg.n_layers)]
     return stacked
 
 
@@ -162,6 +176,71 @@ def make_prefill(params, cfg: BurnInConfig, max_len: int,
     return run
 
 
+def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
+                      cache_dtype: str = "bf16"):
+    """Reusable engine: compile once, run many schedules.
+
+    The compiled pieces (per-bucket prefills, the all-slots step) live in
+    the returned closure — repeated calls (and warm-up passes) share
+    them, where calling :func:`serve` repeatedly would rebuild fresh jit
+    wrappers and recompile every time.
+    """
+    prefill = make_prefill(params, cfg, max_len, cache_dtype)
+    step = make_serve_step(params, cfg)
+
+    def run(prompts: Sequence[Any], n_new: int, *, slots: int = 4,
+            rules: ShardingRules | None = None) -> list[Any]:
+        if not prompts:
+            return []
+        if n_new < 1:
+            raise ValueError(f"n_new must be >= 1, got {n_new}")
+        for p in prompts:
+            if int(p.shape[-1]) + n_new > max_len:
+                raise ValueError(
+                    f"prompt ({int(p.shape[-1])}) + n_new ({n_new}) "
+                    f"exceeds max_len ({max_len})")
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+
+        stacked = _stacked_cache(cfg, slots, max_len, rules, cache_dtype)
+        tokens = jnp.zeros((slots,), jnp.int32)
+        queue = deque(enumerate(prompts))
+        active: dict[int, int] = {}              # slot → request index
+        out: dict[int, list] = {}
+
+        def retire_done():
+            for slot, req in list(active.items()):
+                if len(out[req]) >= n_new:
+                    del active[slot]             # slot recycles next wave
+
+        while queue or active:
+            # admission: every free slot takes the next queued request
+            for slot in range(slots):
+                if slot in active or not queue:
+                    continue
+                req, prompt = queue.popleft()
+                first, row_cache = prefill(jnp.asarray(prompt)[None, :])
+                stacked = _insert_row(row_cache, stacked, slot)
+                tokens = tokens.at[slot].set(first)
+                active[slot] = req
+                out[req] = [first]
+            # a request the prefill token already satisfied (n_new == 1)
+            # must retire BEFORE the step, or it collects an extra token
+            retire_done()
+            if not active:
+                continue
+            # one compiled step advances every slot (idle slots compute
+            # too — the static-shape bubble; their tokens are never read)
+            tokens, stacked = step(tokens, stacked)
+            for slot, req in list(active.items()):
+                out[req].append(tokens[slot])
+            retire_done()
+
+        return [jnp.stack(out[i]) for i in range(len(prompts))]
+
+    return run
+
+
 def serve(params, prompts: Sequence[Any], n_new: int, cfg: BurnInConfig,
           *, slots: int = 4, max_len: int | None = None,
           rules: ShardingRules | None = None,
@@ -174,58 +253,16 @@ def serve(params, prompts: Sequence[Any], n_new: int, cfg: BurnInConfig,
     that distinguishes this loop from a static batch. With ``rules`` the
     pool itself shards: slots over the data axes (requests ARE the data
     parallelism at serve time), KV heads and the weight matmuls over
-    ``tp`` — the engine runs on the same mesh the train step used.
+    ``tp`` — the engine runs on the same mesh the train step used, and
     ``slots`` must divide the data-axis shard count.
+
+    One-shot convenience over :func:`make_serve_engine` — callers timing
+    or re-running schedules should build the engine once instead.
     """
     if not prompts:
         return []
-    if n_new < 1:
-        raise ValueError(f"n_new must be >= 1, got {n_new}")
     if max_len is None:
         max_len = max(int(p.shape[-1]) for p in prompts) + n_new
-    for p in prompts:
-        if int(p.shape[-1]) + n_new > max_len:
-            raise ValueError(
-                f"prompt ({int(p.shape[-1])}) + n_new ({n_new}) exceeds "
-                f"max_len ({max_len})")
-    if slots < 1:
-        raise ValueError(f"slots must be >= 1, got {slots}")
-
-    prefill = make_prefill(params, cfg, max_len, cache_dtype)
-    step = make_serve_step(params, cfg)
-
-    stacked = _stacked_cache(cfg, slots, max_len, rules, cache_dtype)
-    tokens = jnp.zeros((slots,), jnp.int32)
-    queue = deque(enumerate(prompts))
-    active: dict[int, int] = {}                  # slot → request index
-    out: dict[int, list] = {}
-
-    def retire_done():
-        for slot, req in list(active.items()):
-            if len(out[req]) >= n_new:
-                del active[slot]                 # slot recycles next admission
-
-    while queue or active:
-        # admission: every free slot takes the next queued request
-        for slot in range(slots):
-            if slot in active or not queue:
-                continue
-            req, prompt = queue.popleft()
-            first, row_cache = prefill(jnp.asarray(prompt)[None, :])
-            stacked = _insert_row(row_cache, stacked, slot)
-            tokens = tokens.at[slot].set(first)
-            active[slot] = req
-            out[req] = [first]
-        # a request the prefill token already satisfied (n_new == 1)
-        # must retire BEFORE the step, or it would collect an extra token
-        retire_done()
-        if not active:
-            continue
-        # one compiled step advances every slot (idle slots compute too —
-        # the static-shape bubble; their tokens are simply never read)
-        tokens, stacked = step(tokens, stacked)
-        for slot, req in list(active.items()):
-            out[req].append(tokens[slot])
-        retire_done()
-
-    return [jnp.stack(out[i]) for i in range(len(prompts))]
+    engine = make_serve_engine(params, cfg, max_len=max_len,
+                               cache_dtype=cache_dtype)
+    return engine(prompts, n_new, slots=slots, rules=rules)

@@ -153,6 +153,23 @@ def test_serve_int8_cache_matches_solo_int8_decode():
         assert jnp.array_equal(g, w), f"request {i} diverged"
 
 
+def test_engine_reuse_matches_serve():
+    """make_serve_engine: one compiled engine runs many schedules (the
+    warm-up contract bench.py relies on) with results identical to the
+    one-shot serve()."""
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+
+    cfg, params, prompts = _setup(n_prompts=4)
+    engine = make_serve_engine(params, cfg, max_len=16)
+    first = engine(prompts[:2], 3, slots=2)
+    again = engine(prompts, 3, slots=2)          # reused closures
+    via_serve = serve(params, prompts, 3, cfg, slots=2, max_len=16)
+    for g, w in zip(again, via_serve):
+        assert jnp.array_equal(g, w)
+    for g, w in zip(first, via_serve[:2]):
+        assert jnp.array_equal(g, w)
+
+
 def test_serve_validation():
     cfg, params, prompts = _setup(n_prompts=2)
     with pytest.raises(ValueError, match="slots"):
