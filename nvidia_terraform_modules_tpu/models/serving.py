@@ -177,16 +177,47 @@ def make_prefill(params, cfg: BurnInConfig, max_len: int,
 
 
 def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
-                      cache_dtype: str = "bf16"):
+                      cache_dtype: str = "bf16", prefix=None):
     """Reusable engine: compile once, run many schedules.
 
     The compiled pieces (per-bucket prefills, the all-slots step) live in
     the returned closure — repeated calls (and warm-up passes) share
     them, where calling :func:`serve` repeatedly would rebuild fresh jit
     wrappers and recompile every time.
+
+    ``prefix`` (a ``[L_p]`` token array) enables PREFIX CACHING: the
+    shared prefix — a system prompt, few-shot scaffold, RAG preamble —
+    prefills ONCE into a template row cache here, and every admission
+    starts from a copy, paying only its own suffix's prefill. Results
+    equal decoding ``concat(prefix, prompt)`` from scratch: the suffix
+    forward runs the same mid-stream cached path a decode step uses,
+    just wider.
     """
     prefill = make_prefill(params, cfg, max_len, cache_dtype)
     step = make_serve_step(params, cfg)
+    template = None
+    prefix_len = 0
+    if prefix is not None:
+        prefix = jnp.asarray(prefix)
+        prefix_len = int(prefix.shape[-1])
+        if prefix_len >= max_len:
+            raise ValueError(
+                f"prefix ({prefix_len}) must leave room under max_len "
+                f"({max_len})")
+        _first, template = prefill(prefix[None, :])
+
+        @jax.jit
+        def suffix_fill(suffix, cache):          # [1, L_s], template copy
+            logits, cache = forward_cached(params, suffix, cache, cfg,
+                                           prefill_impl="cached")
+            return jnp.argmax(logits[0, -1], axis=-1), cache
+
+    def admit(prompt):
+        """(first token, row cache) for one request, via the template
+        when a prefix is cached."""
+        if template is None:
+            return prefill(prompt[None, :])
+        return suffix_fill(prompt[None, :], template)
 
     def run(prompts: Sequence[Any], n_new: int, *, slots: int = 4,
             rules: ShardingRules | None = None) -> list[Any]:
@@ -195,10 +226,11 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         if n_new < 1:
             raise ValueError(f"n_new must be >= 1, got {n_new}")
         for p in prompts:
-            if int(p.shape[-1]) + n_new > max_len:
+            if prefix_len + int(p.shape[-1]) + n_new > max_len:
                 raise ValueError(
-                    f"prompt ({int(p.shape[-1])}) + n_new ({n_new}) "
-                    f"exceeds max_len ({max_len})")
+                    f"prefix ({prefix_len}) + prompt "
+                    f"({int(p.shape[-1])}) + n_new ({n_new}) exceeds "
+                    f"max_len ({max_len})")
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
 
@@ -219,7 +251,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 if slot in active or not queue:
                     continue
                 req, prompt = queue.popleft()
-                first, row_cache = prefill(jnp.asarray(prompt)[None, :])
+                first, row_cache = admit(jnp.asarray(prompt))
                 stacked = _insert_row(row_cache, stacked, slot)
                 tokens = tokens.at[slot].set(first)
                 active[slot] = req

@@ -170,6 +170,37 @@ def test_engine_reuse_matches_serve():
         assert jnp.array_equal(g, w)
 
 
+def test_prefix_caching_matches_full_decode():
+    """Prefix caching: the shared prefix prefills once; every request's
+    tokens still equal greedy decode over concat(prefix, prompt) — the
+    template copy plus suffix fill is a layout trick, not a different
+    model. Recycling exercised (4 requests, 2 slots)."""
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+
+    cfg, params, prompts = _setup(n_prompts=4)
+    prefix = jax.random.randint(jax.random.PRNGKey(42), (6,), 0, cfg.vocab)
+    engine = make_serve_engine(params, cfg, max_len=32, prefix=prefix)
+    got = engine(prompts, 5, slots=2)
+    want = [greedy_decode(params,
+                          jnp.concatenate([prefix, p])[None, :], 5,
+                          cfg, max_len=32)[0] for p in prompts]
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert jnp.array_equal(g, w), f"request {i} diverged"
+
+
+def test_prefix_caching_validation():
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+
+    cfg, params, prompts = _setup(n_prompts=2)
+    with pytest.raises(ValueError, match="prefix"):
+        make_serve_engine(params, cfg, max_len=8,
+                          prefix=jnp.zeros((8,), jnp.int32))
+    engine = make_serve_engine(params, cfg, max_len=16,
+                               prefix=jnp.zeros((6,), jnp.int32))
+    with pytest.raises(ValueError, match="prefix"):
+        engine(prompts, 8, slots=2)   # 6 + len + 8 > 16
+
+
 def test_serve_validation():
     cfg, params, prompts = _setup(n_prompts=2)
     with pytest.raises(ValueError, match="slots"):
