@@ -232,6 +232,29 @@ def test_state_backup_written_on_every_write(mod, tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_multiprocess_contention_one_winner(mod, tmp_path):
+    """Two real `tfsim apply` PROCESSES racing for one statefile: with a
+    lock-timeout both must eventually succeed exactly once each (the
+    loser waits, then applies over the winner's state as a no-op) — and
+    the statefile ends at serial 1 with no lock left behind."""
+    import subprocess
+    import sys
+
+    s = _state(tmp_path)
+    cmd = [sys.executable, "-m", "nvidia_terraform_modules_tpu.tfsim",
+           "apply", mod, "-state", s, "-lock-timeout=30s"]
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    procs = [subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+             for _ in range(2)]
+    outs = [p.communicate(timeout=120) for p in procs]
+    assert all(p.returncode == 0 for p in procs), [o[1] for o in outs]
+    assert all("Apply complete" in o[0] for o in outs)
+    assert json.loads(open(s).read())["serial"] == 1
+    assert not os.path.exists(lock_path(s))
+
+
 # ---------------------------------------------------------------- lineage
 
 
