@@ -220,7 +220,8 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         return suffix_fill(prompt[None, :], template)
 
     def run(prompts: Sequence[Any], n_new: int, *, slots: int = 4,
-            rules: ShardingRules | None = None) -> list[Any]:
+            rules: ShardingRules | None = None,
+            eos_id: int | None = None) -> list[Any]:
         if not prompts:
             return []
         if n_new < 1:
@@ -240,9 +241,19 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         active: dict[int, int] = {}              # slot → request index
         out: dict[int, list] = {}
 
+        def finished(req) -> bool:
+            # a request ends at n_new tokens, or at its first eos_id —
+            # eos is what makes generation lengths VARIABLE, the whole
+            # reason slots recycle at different times in real traffic.
+            # The int() is a per-request device→host sync each step;
+            # without eos_id the loop never syncs until the final stack.
+            if len(out[req]) >= n_new:
+                return True
+            return eos_id is not None and int(out[req][-1]) == eos_id
+
         def retire_done():
             for slot, req in list(active.items()):
-                if len(out[req]) >= n_new:
+                if finished(req):
                     del active[slot]             # slot recycles next wave
 
         while queue or active:
@@ -256,8 +267,9 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 tokens = tokens.at[slot].set(first)
                 active[slot] = req
                 out[req] = [first]
-            # a request the prefill token already satisfied (n_new == 1)
-            # must retire BEFORE the step, or it collects an extra token
+            # a request the prefill token already satisfied (n_new == 1
+            # or an immediate eos) must retire BEFORE the step, or it
+            # collects an extra token
             retire_done()
             if not active:
                 continue
@@ -276,7 +288,8 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
 def serve(params, prompts: Sequence[Any], n_new: int, cfg: BurnInConfig,
           *, slots: int = 4, max_len: int | None = None,
           rules: ShardingRules | None = None,
-          cache_dtype: str = "bf16") -> list[Any]:
+          cache_dtype: str = "bf16",
+          eos_id: int | None = None) -> list[Any]:
     """Serve ``prompts`` (each ``[L_i]``) with continuous batching.
 
     Returns one ``[n_new]`` token array per prompt, in request order.
@@ -297,4 +310,4 @@ def serve(params, prompts: Sequence[Any], n_new: int, cfg: BurnInConfig,
         max_len = max(int(p.shape[-1]) for p in prompts) + n_new
     engine = make_serve_engine(params, cfg, max_len=max_len,
                                cache_dtype=cache_dtype)
-    return engine(prompts, n_new, slots=slots, rules=rules)
+    return engine(prompts, n_new, slots=slots, rules=rules, eos_id=eos_id)
