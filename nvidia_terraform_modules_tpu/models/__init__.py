@@ -23,6 +23,7 @@ from .decode import (  # noqa: F401
     greedy_decode,
     init_cache,
     make_decoder,
+    make_sampler,
     quantize_kv,
     sample_decode,
 )

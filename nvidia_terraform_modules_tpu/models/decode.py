@@ -442,6 +442,19 @@ def sample_decode(params, prompt, n_new: int, cfg: BurnInConfig, rng,
     meant elsewhere. One PRNG key per generated token, split from
     ``rng`` — same key, same tokens, reproducible serving.
     """
+    pick = make_sampler(temperature=temperature, top_k=top_k, top_p=top_p)
+    return _generate(params, prompt, n_new, cfg, rules, max_len, (rng, pick),
+                     prefill, cache_dtype)
+
+
+def make_sampler(temperature: float = 1.0, top_k: int | None = None,
+                 top_p: float | None = None):
+    """Build the ``pick(logits [B, V], key) → [B]`` sampling function.
+
+    The shared sampling core for :func:`sample_decode` and the serving
+    engine (``models/serving.py``): temperature → top-k → top-p in the
+    mainstream order, ``top_k=1`` recovering greedy exactly.
+    """
     if top_k is not None and top_k < 1:
         raise ValueError(f"top_k must be >= 1, got {top_k}")
     if top_p is not None and not 0.0 < top_p <= 1.0:
@@ -479,8 +492,7 @@ def sample_decode(params, prompt, n_new: int, cfg: BurnInConfig, rng,
             logits = jnp.where(keep, logits, -jnp.inf)
         return jax.random.categorical(key, logits, axis=-1)
 
-    return _generate(params, prompt, n_new, cfg, rules, max_len, (rng, pick),
-                     prefill, cache_dtype)
+    return pick
 
 
 def make_decoder(cfg: BurnInConfig, rules: ShardingRules | None = None,

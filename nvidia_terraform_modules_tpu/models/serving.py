@@ -124,30 +124,49 @@ def _insert_row(row_cache, stacked, slot):
     return new
 
 
-def make_serve_step(params, cfg: BurnInConfig):
-    """Compiled all-slots decode step: ``(tokens [slots], cache) →
-    (next tokens [slots], cache)`` with per-slot positions. The pooled
-    cache is DONATED — the step updates it in place rather than paying
-    a full-pool copy per token (the bandwidth a slot engine exists to
-    save)."""
+def make_serve_step(params, cfg: BurnInConfig, sampler=None):
+    """Compiled all-slots decode step with per-slot positions. The
+    pooled cache is DONATED — the step updates it in place rather than
+    paying a full-pool copy per token (the bandwidth a slot engine
+    exists to save).
 
-    def row(tok, cache):
+    Greedy (``sampler=None``): ``(tokens [slots], cache) → (next,
+    cache)``. Sampled: ``(tokens, keys [slots, 2], cache) → ...`` —
+    one PRNG key per slot per step, supplied by the engine so token
+    randomness is keyed to (request, position), never to the schedule.
+    """
+
+    def row(tok, key, cache):
         logits, cache = forward_cached(params, tok[None, None], cache, cfg,
                                        prefill_impl="cached")
-        return jnp.argmax(logits[0, -1], axis=-1), cache
+        if sampler is None:
+            return jnp.argmax(logits[0, -1], axis=-1), cache
+        return sampler(logits[:, -1], key)[0], cache
 
     vrow = jax.vmap(row)
 
-    @functools.partial(jax.jit, donate_argnums=(1,))
-    def step(tokens, stacked):
-        nxt, new = vrow(tokens, stacked)
-        return nxt, new
+    if sampler is None:
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def step(tokens, stacked):
+            dummy = jnp.zeros((tokens.shape[0], 2), jnp.uint32)
+            return vrow(tokens, dummy, stacked)
 
-    return step
+        return step
+
+    @functools.partial(jax.jit, donate_argnums=(4,))
+    def sampled_step(tokens, req_ids, positions, rng, stacked):
+        # key = fold_in(fold_in(rng, request), position), derived INSIDE
+        # the compiled step: one dispatch per step regardless of slot
+        # count, and typed or legacy rng keys both work
+        keys = jax.vmap(lambda r, p: jax.random.fold_in(
+            jax.random.fold_in(rng, r), p))(req_ids, positions)
+        return vrow(tokens, keys, stacked)
+
+    return sampled_step
 
 
 def make_prefill(params, cfg: BurnInConfig, max_len: int,
-                 cache_dtype: str = "bf16"):
+                 cache_dtype: str = "bf16", sampler=None):
     """Exact-length prompt prefill → ``(first token, row cache)``.
 
     One compile per distinct prompt length (jit cache keyed on shape);
@@ -158,26 +177,31 @@ def make_prefill(params, cfg: BurnInConfig, max_len: int,
     through the fused kernel — dense scores at their prompt lengths are
     exactly the OOM that impl exists to avoid, and the engine's
     equality contract is against ``greedy_decode`` with the SAME
-    resolution.
+    resolution. ``sampler`` picks the first token instead of argmax.
     """
     from .decode import _select_prefill_impl
 
     @functools.partial(jax.jit, static_argnums=(1,))
-    def prefill(prompt, impl):                             # [1, L]
+    def prefill(prompt, impl, key):                        # [1, L]
         cache = init_cache(cfg, 1, max_len, cache_dtype=cache_dtype)
         logits, cache = forward_cached(params, prompt, cache, cfg,
                                        prefill_impl=impl)
-        return jnp.argmax(logits[0, -1], axis=-1), cache
+        if sampler is None:
+            return jnp.argmax(logits[0, -1], axis=-1), cache
+        return sampler(logits[:, -1], key)[0], cache
 
-    def run(prompt):
+    def run(prompt, key=None):
         impl = _select_prefill_impl(cfg, int(prompt.shape[-1]), "auto")
-        return prefill(prompt, impl)
+        if key is None:
+            key = jnp.zeros((2,), jnp.uint32)
+        return prefill(prompt, impl, key)
 
     return run
 
 
 def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
-                      cache_dtype: str = "bf16", prefix=None):
+                      cache_dtype: str = "bf16", prefix=None,
+                      sampler=None):
     """Reusable engine: compile once, run many schedules.
 
     The compiled pieces (per-bucket prefills, the all-slots step) live in
@@ -192,9 +216,16 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
     equal decoding ``concat(prefix, prompt)`` from scratch: the suffix
     forward runs the same mid-stream cached path a decode step uses,
     just wider.
+
+    ``sampler`` (from :func:`..decode.make_sampler`) switches the engine
+    from greedy to sampled generation; ``run`` then requires ``rng``.
+    Every token's key is derived from (request index, token position) —
+    NEVER from the schedule — so the same ``rng`` yields the same tokens
+    whatever the slot count or admission order (``sampler`` built with
+    ``top_k=1`` reproduces the greedy engine exactly).
     """
-    prefill = make_prefill(params, cfg, max_len, cache_dtype)
-    step = make_serve_step(params, cfg)
+    prefill = make_prefill(params, cfg, max_len, cache_dtype, sampler)
+    step = make_serve_step(params, cfg, sampler)
     template = None
     prefix_len = 0
     if prefix is not None:
@@ -204,28 +235,45 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             raise ValueError(
                 f"prefix ({prefix_len}) must leave room under max_len "
                 f"({max_len})")
-        _first, template = prefill(prefix[None, :])
+        # the template never emits a token, so greedy-vs-sampled does
+        # not matter — a greedy engine reuses its shared prefill (and
+        # its jit cache); only a sampled engine builds a greedy twin
+        template_prefill = (prefill if sampler is None else
+                            make_prefill(params, cfg, max_len,
+                                         cache_dtype))
+        _first, template = template_prefill(prefix[None, :])
 
         @jax.jit
-        def suffix_fill(suffix, cache):          # [1, L_s], template copy
+        def suffix_fill(suffix, cache, key):     # [1, L_s], template copy
             logits, cache = forward_cached(params, suffix, cache, cfg,
                                            prefill_impl="cached")
-            return jnp.argmax(logits[0, -1], axis=-1), cache
+            if sampler is None:
+                return jnp.argmax(logits[0, -1], axis=-1), cache
+            return sampler(logits[:, -1], key)[0], cache
 
-    def admit(prompt):
+    def admit(prompt, key):
         """(first token, row cache) for one request, via the template
         when a prefix is cached."""
+        if key is None:
+            key = jnp.zeros((2,), jnp.uint32)
         if template is None:
-            return prefill(prompt[None, :])
-        return suffix_fill(prompt[None, :], template)
+            return prefill(prompt[None, :], key)
+        return suffix_fill(prompt[None, :], template, key)
 
     def run(prompts: Sequence[Any], n_new: int, *, slots: int = 4,
             rules: ShardingRules | None = None,
-            eos_id: int | None = None) -> list[Any]:
+            eos_id: int | None = None, rng=None) -> list[Any]:
         if not prompts:
             return []
+        if sampler is not None and rng is None:
+            raise ValueError("a sampled engine needs rng (a PRNG key)")
         if n_new < 1:
             raise ValueError(f"n_new must be >= 1, got {n_new}")
+
+        def key_for(req: int, idx: int):
+            # keyed to (request, position): the schedule — slot count,
+            # admission order, neighbours — can never change a token
+            return jax.random.fold_in(jax.random.fold_in(rng, req), idx)
         for p in prompts:
             if prefix_len + int(p.shape[-1]) + n_new > max_len:
                 raise ValueError(
@@ -262,7 +310,9 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 if slot in active or not queue:
                     continue
                 req, prompt = queue.popleft()
-                first, row_cache = admit(jnp.asarray(prompt))
+                first, row_cache = admit(
+                    jnp.asarray(prompt),
+                    key_for(req, 0) if sampler is not None else None)
                 stacked = _insert_row(row_cache, stacked, slot)
                 tokens = tokens.at[slot].set(first)
                 active[slot] = req
@@ -275,7 +325,18 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 continue
             # one compiled step advances every slot (idle slots compute
             # too — the static-shape bubble; their tokens are never read)
-            tokens, stacked = step(tokens, stacked)
+            if sampler is None:
+                tokens, stacked = step(tokens, stacked)
+            else:
+                # idle slots get a dead (request-id == len(prompts)) key
+                # — valid to derive, never read
+                reqs = jnp.asarray(
+                    [active.get(s, len(prompts)) for s in range(slots)],
+                    jnp.int32)
+                poss = jnp.asarray(
+                    [len(out[active[s]]) if s in active else 0
+                     for s in range(slots)], jnp.int32)
+                tokens, stacked = step(tokens, reqs, poss, rng, stacked)
             for slot, req in list(active.items()):
                 out[req].append(tokens[slot])
             retire_done()

@@ -57,6 +57,7 @@ SLOW_TESTS = frozenset({
     "tests/test_serving.py::test_serve_int8_cache_matches_solo_int8_decode",
     "tests/test_serving.py::test_prefix_caching_matches_full_decode",
     "tests/test_serving.py::test_eos_early_stopping_variable_lengths",
+    "tests/test_serving.py::test_sampled_engine_contracts",
     "tests/test_decode.py::test_int8_cache_speculative_still_exact",
     "tests/test_decode.py::test_int8_cache_gqa_decode",
     "tests/test_decode.py::test_int8_cache_on_mesh",

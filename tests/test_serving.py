@@ -228,6 +228,50 @@ def test_eos_early_stopping_variable_lengths():
         assert jnp.array_equal(g, w), f"request {i} diverged"
 
 
+def test_sampled_engine_contracts():
+    """Sampling in the engine: top_k=1 reproduces greedy exactly; token
+    randomness is keyed to (request, position) so the SCHEDULE cannot
+    change tokens (slots=1 == slots=3 under one rng); same rng → same
+    tokens; and a sampled engine without rng refuses."""
+    from nvidia_terraform_modules_tpu.models import (
+        make_sampler,
+        make_serve_engine,
+    )
+
+    cfg, params, prompts = _setup(n_prompts=4)
+    rng = jax.random.PRNGKey(7)
+
+    greedy_engine = make_serve_engine(params, cfg, max_len=16)
+    k1_engine = make_serve_engine(params, cfg, max_len=16,
+                                  sampler=make_sampler(top_k=1))
+    for g, w in zip(k1_engine(prompts, 5, slots=2, rng=rng),
+                    greedy_engine(prompts, 5, slots=2)):
+        assert jnp.array_equal(g, w)
+
+    hot = make_serve_engine(params, cfg, max_len=16,
+                            sampler=make_sampler(temperature=5.0))
+    few = hot(prompts, 5, slots=1, rng=rng)
+    many = hot(prompts, 5, slots=3, rng=rng)
+    for g, w in zip(few, many):
+        assert jnp.array_equal(g, w), "schedule changed sampled tokens"
+    again = hot(prompts, 5, slots=3, rng=rng)
+    for g, w in zip(many, again):
+        assert jnp.array_equal(g, w)
+    # hot sampling actually diverges from greedy (vocab 64, temp 5)
+    assert any(not jnp.array_equal(g, w)
+               for g, w in zip(many, greedy_engine(prompts, 5, slots=2)))
+
+    # new-style typed keys work too (fold_in happens inside the step),
+    # with the same schedule-independence
+    t1 = hot(prompts, 5, slots=2, rng=jax.random.key(7))
+    t2 = hot(prompts, 5, slots=4, rng=jax.random.key(7))
+    for g, w in zip(t1, t2):
+        assert jnp.array_equal(g, w)
+
+    with pytest.raises(ValueError, match="rng"):
+        hot(prompts, 5, slots=2)
+
+
 def test_serve_validation():
     cfg, params, prompts = _setup(n_prompts=2)
     with pytest.raises(ValueError, match="slots"):
