@@ -201,7 +201,7 @@ def make_prefill(params, cfg: BurnInConfig, max_len: int,
 
 def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                       cache_dtype: str = "bf16", prefix=None,
-                      sampler=None):
+                      sampler=None, prefill_chunk: int | None = None):
     """Reusable engine: compile once, run many schedules.
 
     The compiled pieces (per-bucket prefills, the all-slots step) live in
@@ -223,9 +223,44 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
     NEVER from the schedule — so the same ``rng`` yields the same tokens
     whatever the slot count or admission order (``sampler`` built with
     ``top_k=1`` reproduces the greedy engine exactly).
+
+    ``prefill_chunk`` switches admission to CHUNKED PREFILL (vLLM's
+    lever, re-thought for XLA's compile model): the prompt is padded to
+    a multiple of the chunk and prefilled through ONE compiled ``[1, C]``
+    cached forward, however long the prompt — exact-length admission
+    compiles once per DISTINCT length, chunked admission compiles once
+    per ENGINE. Pad rows land in the cache but are unreachable: cached
+    attention masks ``k_pos > q_pos`` and ``pos`` resets to the true
+    length after admission, so decode writes overwrite them in order.
+    Peak prefill score memory drops from ``[T, S_max]`` to
+    ``[C, S_max]`` — chunked admission is also how a long-context
+    engine avoids the dense-prefill OOM without the flash kernel's
+    8-multiple tiling constraint. Exact for bf16 caches (same masked
+    attention set per token, chunking is a scheduling choice); under an
+    ``int8`` cache every token attends fully-quantised history (the
+    one-shot prefill attends its own prompt at full precision), so
+    results are chunk-size-INVARIANT but can differ from unchunked
+    int8 admission within quantisation noise.
     """
+    if prefill_chunk is not None and prefill_chunk < 1:
+        raise ValueError(
+            f"prefill_chunk must be >= 1, got {prefill_chunk}")
     prefill = make_prefill(params, cfg, max_len, cache_dtype, sampler)
     step = make_serve_step(params, cfg, sampler)
+
+    chunk_fill = None
+    if prefill_chunk is not None:
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def chunk_fill(chunk, last_idx, cache, key):       # [1, C]
+            # mid-stream cached forward: masks by position, so the pad
+            # tail of the final chunk never leaks into real tokens'
+            # attention; last_idx (traced) picks the true last token's
+            # logits — one compile serves every chunk of every prompt
+            logits, cache = forward_cached(params, chunk, cache, cfg,
+                                           prefill_impl="cached")
+            if sampler is None:
+                return jnp.argmax(logits[0, last_idx], axis=-1), cache
+            return sampler(logits[:, last_idx], key)[0], cache
     template = None
     prefix_len = 0
     if prefix is not None:
@@ -256,9 +291,49 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         when a prefix is cached."""
         if key is None:
             key = jnp.zeros((2,), jnp.uint32)
+        if prefill_chunk is not None:
+            return admit_chunked(prompt, key)
         if template is None:
             return prefill(prompt[None, :], key)
         return suffix_fill(prompt[None, :], template, key)
+
+    def _check_chunk_bound(length: int) -> int:
+        n = -(-length // prefill_chunk)
+        if prefix_len + n * prefill_chunk > max_len:
+            # the padded tail would dynamic_update_slice past the buffer
+            # end, where XLA CLAMPS the start index and silently
+            # overwrites the last cache rows — refuse loudly instead
+            raise ValueError(
+                f"chunked prefill pads the prompt ({length}) to "
+                f"{n * prefill_chunk} rows, which after the prefix "
+                f"({prefix_len}) exceeds max_len ({max_len}) — raise "
+                f"max_len to >= {prefix_len + n * prefill_chunk} or "
+                f"shrink prefill_chunk")
+        return n
+
+    def admit_chunked(prompt, key):
+        c = prefill_chunk
+        length = int(prompt.shape[-1])
+        n = _check_chunk_bound(length)
+        if template is None:
+            cache = init_cache(cfg, 1, max_len, cache_dtype=cache_dtype)
+        else:
+            # one whole-cache copy, then every chunk donates it forward
+            cache = jax.tree.map(lambda x: x.copy(), template)
+        pad = n * c - length
+        padded = jnp.pad(prompt, (0, pad)) if pad else prompt
+        tok = None
+        for i in range(n):
+            # only the FINAL chunk's token (at the true last index) is
+            # kept; earlier chunks' argmax/sample output is never read
+            last = length - 1 - i * c if i == n - 1 else c - 1
+            tok, cache = chunk_fill(padded[None, i * c:(i + 1) * c],
+                                    jnp.int32(last), cache, key)
+        # rewind pos past the pad rows: the next decode write lands at
+        # the true length, reclaiming them one step at a time; rows
+        # beyond pos stay masked (k_pos > q_pos) until overwritten
+        cache["pos"] = jnp.asarray(prefix_len + length, jnp.int32)
+        return tok, cache
 
     def run(prompts: Sequence[Any], n_new: int, *, slots: int = 4,
             rules: ShardingRules | None = None,
@@ -280,6 +355,11 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                     f"prefix ({prefix_len}) + prompt "
                     f"({int(p.shape[-1])}) + n_new ({n_new}) exceeds "
                     f"max_len ({max_len})")
+            if prefill_chunk is not None:
+                # every prompt must fit PADDED, checked before any work:
+                # an admission-time refusal mid-schedule would discard
+                # already-finished requests' outputs
+                _check_chunk_bound(int(p.shape[-1]))
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
 
@@ -350,7 +430,8 @@ def serve(params, prompts: Sequence[Any], n_new: int, cfg: BurnInConfig,
           *, slots: int = 4, max_len: int | None = None,
           rules: ShardingRules | None = None,
           cache_dtype: str = "bf16",
-          eos_id: int | None = None) -> list[Any]:
+          eos_id: int | None = None,
+          prefill_chunk: int | None = None) -> list[Any]:
     """Serve ``prompts`` (each ``[L_i]``) with continuous batching.
 
     Returns one ``[n_new]`` token array per prompt, in request order.
@@ -360,7 +441,9 @@ def serve(params, prompts: Sequence[Any], n_new: int, cfg: BurnInConfig,
     pool itself shards: slots over the data axes (requests ARE the data
     parallelism at serve time), KV heads and the weight matmuls over
     ``tp`` — the engine runs on the same mesh the train step used, and
-    ``slots`` must divide the data-axis shard count.
+    ``slots`` must divide the data-axis shard count. ``prefill_chunk``
+    admits through the single-compile chunked prefill (see
+    :func:`make_serve_engine`).
 
     One-shot convenience over :func:`make_serve_engine` — callers timing
     or re-running schedules should build the engine once instead.
@@ -368,7 +451,12 @@ def serve(params, prompts: Sequence[Any], n_new: int, cfg: BurnInConfig,
     if not prompts:
         return []
     if max_len is None:
-        max_len = max(int(p.shape[-1]) for p in prompts) + n_new
+        longest = max(int(p.shape[-1]) for p in prompts)
+        if prefill_chunk:
+            # leave room for the padded tail of the longest prompt
+            longest = -(-longest // prefill_chunk) * prefill_chunk
+        max_len = longest + n_new
     engine = make_serve_engine(params, cfg, max_len=max_len,
-                               cache_dtype=cache_dtype)
+                               cache_dtype=cache_dtype,
+                               prefill_chunk=prefill_chunk)
     return engine(prompts, n_new, slots=slots, rules=rules, eos_id=eos_id)

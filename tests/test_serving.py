@@ -272,6 +272,115 @@ def test_sampled_engine_contracts():
         hot(prompts, 5, slots=2)
 
 
+def test_chunked_prefill_matches_unchunked():
+    """Chunked admission is a scheduling choice: every request's tokens
+    equal its solo greedy decode, across chunk sizes that divide, split,
+    and exceed the prompt lengths (4/6/8 here) — including a final chunk
+    that is pure padding past the true last token."""
+    cfg, params, prompts = _setup(n_prompts=5)
+    want = _reference(params, prompts, 5, cfg)
+    for chunk in (1, 3, 4, 16):
+        got = serve(params, prompts, 5, cfg, slots=2, prefill_chunk=chunk)
+        for i, (g, w) in enumerate(zip(got, want)):
+            assert jnp.array_equal(g, w), f"chunk={chunk} request {i}"
+
+
+def test_chunked_prefill_rope_positions():
+    """Pad rows are rotated at pad positions and then rewound — rope
+    must see the TRUE positions for every kept token."""
+    cfg, params, prompts = _setup(n_prompts=3, rope=True)
+    got = serve(params, prompts, 5, cfg, slots=2, prefill_chunk=3)
+    want = _reference(params, prompts, 5, cfg)
+    for g, w in zip(got, want):
+        assert jnp.array_equal(g, w)
+
+
+def test_chunked_prefill_with_prefix_caching():
+    """Chunked suffix admission composes with the prefix template: the
+    chunks run mid-stream (pos starts at the prefix length) and results
+    still equal decoding concat(prefix, prompt) from scratch."""
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+
+    cfg, params, prompts = _setup(n_prompts=4)
+    prefix = jax.random.randint(jax.random.PRNGKey(42), (6,), 0, cfg.vocab)
+    engine = make_serve_engine(params, cfg, max_len=32, prefix=prefix,
+                               prefill_chunk=4)
+    got = engine(prompts, 5, slots=2)
+    want = [greedy_decode(params,
+                          jnp.concatenate([prefix, p])[None, :], 5,
+                          cfg, max_len=32)[0] for p in prompts]
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert jnp.array_equal(g, w), f"request {i} diverged"
+
+
+def test_chunked_prefill_int8_chunk_size_invariant():
+    """Under an int8 cache every token attends fully-quantised history
+    whatever the chunk size — so chunked results are chunk-size
+    INVARIANT (C=2 == C=5 == C=1, bit for bit), even though they may
+    differ from unchunked admission within quantisation noise."""
+    cfg, params, prompts = _setup(n_prompts=3)
+    runs = [serve(params, prompts, 5, cfg, slots=2, cache_dtype="int8",
+                  prefill_chunk=c) for c in (1, 2, 5)]
+    for other in runs[1:]:
+        for g, w in zip(runs[0], other):
+            assert jnp.array_equal(g, w)
+
+
+def test_chunked_prefill_sampled_schedule_independent():
+    """A sampled chunked engine keys tokens to (request, position) like
+    the unchunked one — same rng, any chunking, same tokens."""
+    from nvidia_terraform_modules_tpu.models import (
+        make_sampler,
+        make_serve_engine,
+    )
+
+    cfg, params, prompts = _setup(n_prompts=3)
+    rng = jax.random.PRNGKey(11)
+    hot = make_serve_engine(params, cfg, max_len=16,
+                            sampler=make_sampler(temperature=5.0))
+    chunked = make_serve_engine(params, cfg, max_len=16,
+                                sampler=make_sampler(temperature=5.0),
+                                prefill_chunk=3)
+    for g, w in zip(chunked(prompts, 5, slots=2, rng=rng),
+                    hot(prompts, 5, slots=3, rng=rng)):
+        assert jnp.array_equal(g, w)
+
+
+def test_chunked_prefill_flash_config_exact_vs_dense():
+    """For long-context configs chunked admission REPLACES the flash
+    prefill (peak score memory [C, S_max], no 8-multiple tiling
+    constraint) with math exactly equal to the dense prefill."""
+    cfg = BurnInConfig(**{**CFG, "attn": "flash"})
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompts = [jax.random.randint(jax.random.PRNGKey(i), (7 + i,), 0,
+                                  cfg.vocab) for i in range(3)]
+    got = serve(params, prompts, 4, cfg, slots=2, prefill_chunk=4)
+    want = [greedy_decode(params, p[None, :], 4, cfg, prefill="dense")[0]
+            for p in prompts]
+    for g, w in zip(got, want):
+        assert jnp.array_equal(g, w)
+
+
+def test_chunked_prefill_validation():
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+
+    cfg, params, prompts = _setup(n_prompts=2)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        make_serve_engine(params, cfg, max_len=16, prefill_chunk=0)
+    # padded tail would clamp past the buffer end — refused loudly,
+    # never a silent overwrite of the last cache rows; the refusal is
+    # UPFRONT (before any prompt is admitted), so a late infeasible
+    # prompt cannot discard earlier requests' finished outputs
+    engine = make_serve_engine(params, cfg, max_len=7, prefill_chunk=8)
+    with pytest.raises(ValueError, match="chunked prefill"):
+        engine(prompts, 1, slots=2)
+    tight = make_serve_engine(params, cfg, max_len=7, prefill_chunk=4)
+    feasible = jnp.zeros((4,), jnp.int32)      # pads to 4 <= 7: fine
+    infeasible = jnp.zeros((6,), jnp.int32)    # 6+1 <= 7 but pads to 8
+    with pytest.raises(ValueError, match="chunked prefill"):
+        tight([feasible, infeasible], 1, slots=1)
+
+
 def test_serve_validation():
     cfg, params, prompts = _setup(n_prompts=2)
     with pytest.raises(ValueError, match="slots"):
