@@ -124,6 +124,21 @@ def _insert_row(row_cache, stacked, slot):
     return new
 
 
+def _make_pick(sampler):
+    """The greedy-vs-sampled token pick shared by every admission and
+    step path: ``pick(logits [1, T, V], idx, key) → token`` — argmax at
+    ``idx`` when greedy, the sampler over that position otherwise. One
+    definition so the admission paths and the decode step can never
+    diverge on the pick contract."""
+    if sampler is None:
+        def pick(logits, idx, key):                    # noqa: ARG001
+            return jnp.argmax(logits[0, idx], axis=-1)
+    else:
+        def pick(logits, idx, key):
+            return sampler(logits[:, idx], key)[0]
+    return pick
+
+
 def make_serve_step(params, cfg: BurnInConfig, sampler=None):
     """Compiled all-slots decode step with per-slot positions. The
     pooled cache is DONATED — the step updates it in place rather than
@@ -135,13 +150,12 @@ def make_serve_step(params, cfg: BurnInConfig, sampler=None):
     one PRNG key per slot per step, supplied by the engine so token
     randomness is keyed to (request, position), never to the schedule.
     """
+    pick = _make_pick(sampler)
 
     def row(tok, key, cache):
         logits, cache = forward_cached(params, tok[None, None], cache, cfg,
                                        prefill_impl="cached")
-        if sampler is None:
-            return jnp.argmax(logits[0, -1], axis=-1), cache
-        return sampler(logits[:, -1], key)[0], cache
+        return pick(logits, -1, key), cache
 
     vrow = jax.vmap(row)
 
@@ -165,6 +179,73 @@ def make_serve_step(params, cfg: BurnInConfig, sampler=None):
     return sampled_step
 
 
+def make_spec_step(params, cfg: BurnInConfig, k: int):
+    """Compiled all-slots SPECULATIVE step: prompt-lookup drafts + one
+    ``[1, k+1]`` verification forward per slot, vmapped over the pool.
+
+    Extends ``speculative_greedy_decode``'s single-request loop
+    (``models/speculative.py``) to continuous batching: each slot
+    drafts ``k`` tokens by bigram lookup in its OWN context row,
+    verifies them in one cached forward at its OWN position, and
+    accepts the longest prefix matching the model's argmax chain —
+    per-slot acceptance counts diverge freely because the rollback is
+    per-row ``pos`` arithmetic, never buffer surgery (rejected draft
+    rows stay position-masked until real decode writes reclaim them,
+    the same mechanism chunked prefill uses for pad rows).
+
+    Step signature (all donated except the two scalars):
+    ``(ctx [slots, Lc], cur [slots], n_out [slots], n_new, eos_id,
+    stacked) → (ctx, cur, n_out, done [slots] bool, stacked)`` where
+    ``ctx`` rows hold prefix+prompt+generated tokens, ``cur`` the valid
+    length, ``n_out`` tokens generated; ``eos_id < 0`` disables eos.
+    Emission per slot is capped at ``n_new - n_out`` FIRST, then
+    truncated at the first eos inside the capped window — so ``done``
+    can never fire on an eos the cap already excluded.
+    """
+    from .speculative import _ngram_draft
+
+    def row(ctx_row, cur, n_done, n_new, eos_id, cache):
+        last = ctx_row[cur - 1]
+        draft = _ngram_draft(ctx_row, cur, k, cfg.vocab)          # [k]
+        block = jnp.concatenate([last[None], draft])[None]        # [1,k+1]
+        # "cached": a mid-stream t>1 forward attending over the cache
+        # buffer at this slot's own position
+        logits, cache = forward_cached(params, block, cache, cfg,
+                                       prefill_impl="cached")
+        preds = jnp.argmax(logits[0], axis=-1)                    # [k+1]
+        agree = draft == preds[:-1]
+        n_acc = jnp.argmin(jnp.concatenate(
+            [agree, jnp.array([False])]).astype(jnp.int32))       # 0..k
+        # accepted drafts + the model's own next token (correction at
+        # the first mismatch, continuation when all agreed)
+        new_toks = jnp.concatenate([draft, jnp.zeros((1,), draft.dtype)])
+        new_toks = new_toks.at[n_acc].set(preds[n_acc])
+        idx = jnp.arange(k + 1)
+        emit = jnp.clip(n_acc + 1, 0, jnp.maximum(n_new - n_done, 0))
+        is_eos = (new_toks == eos_id) & (eos_id >= 0) & (idx < emit)
+        hit = jnp.any(is_eos)
+        emit = jnp.where(hit, jnp.argmax(is_eos) + 1, emit)
+        keep = idx < emit
+        upd = jax.lax.dynamic_slice_in_dim(ctx_row, cur, k + 1)
+        upd = jnp.where(keep, new_toks, upd)
+        ctx_row = jax.lax.dynamic_update_slice_in_dim(ctx_row, upd, cur, 0)
+        # rollback by pos arithmetic: valid forwarded rows are exactly
+        # the context minus the one new un-forwarded last token
+        cache = dict(cache)
+        cache["pos"] = cur + emit - 1
+        n_done = n_done + emit
+        done = (n_done >= n_new) | hit
+        return ctx_row, cur + emit, n_done, done, cache
+
+    vrow = jax.vmap(row, in_axes=(0, 0, 0, None, None, 0))
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 5))
+    def step(ctx, cur, n_out, n_new, eos_id, stacked):
+        return vrow(ctx, cur, n_out, n_new, eos_id, stacked)
+
+    return step
+
+
 def make_prefill(params, cfg: BurnInConfig, max_len: int,
                  cache_dtype: str = "bf16", sampler=None):
     """Exact-length prompt prefill → ``(first token, row cache)``.
@@ -181,14 +262,14 @@ def make_prefill(params, cfg: BurnInConfig, max_len: int,
     """
     from .decode import _select_prefill_impl
 
+    pick = _make_pick(sampler)
+
     @functools.partial(jax.jit, static_argnums=(1,))
     def prefill(prompt, impl, key):                        # [1, L]
         cache = init_cache(cfg, 1, max_len, cache_dtype=cache_dtype)
         logits, cache = forward_cached(params, prompt, cache, cfg,
                                        prefill_impl=impl)
-        if sampler is None:
-            return jnp.argmax(logits[0, -1], axis=-1), cache
-        return sampler(logits[:, -1], key)[0], cache
+        return pick(logits, -1, key), cache
 
     def run(prompt, key=None):
         impl = _select_prefill_impl(cfg, int(prompt.shape[-1]), "auto")
@@ -201,7 +282,8 @@ def make_prefill(params, cfg: BurnInConfig, max_len: int,
 
 def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                       cache_dtype: str = "bf16", prefix=None,
-                      sampler=None, prefill_chunk: int | None = None):
+                      sampler=None, prefill_chunk: int | None = None,
+                      spec_k: int | None = None):
     """Reusable engine: compile once, run many schedules.
 
     The compiled pieces (per-bucket prefills, the all-slots step) live in
@@ -241,12 +323,41 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
     one-shot prefill attends its own prompt at full precision), so
     results are chunk-size-INVARIANT but can differ from unchunked
     int8 admission within quantisation noise.
+
+    ``spec_k`` turns on SPECULATIVE continuous batching (greedy only):
+    every step drafts ``k`` tokens per slot by prompt lookup in that
+    slot's own context and verifies them in one ``[1, k+1]`` cached
+    forward (see :func:`make_spec_step`) — in the weight-bandwidth-
+    bound decode regime a verification step costs ~one plain step but
+    can emit up to ``k+1`` tokens. Tokens equal the greedy engine's *up
+    to backend matmul-tiling numerics* (the ``models/speculative.py``
+    contract extended per-slot: acceptance tests the model's own argmax
+    chain exactly, but the ``[1, k+1]`` verification forward can tile
+    its matmuls differently from the ``T=1`` step path, so a bf16
+    near-tie argmax may resolve differently on TPU; bit-exact on CPU
+    f32, where the tests pin it). Costs:
+    ``max_len`` must leave ``spec_k`` rows of verification headroom
+    past each request's last token, and the engine syncs two small
+    ``[slots]`` vectors per step to retire finished requests. After
+    each call ``engine.last_stats`` reports realised acceptance
+    (``generated / slot_steps`` ≥ 1 is the speedup lever vs the plain
+    engine's one token per slot-step).
     """
     if prefill_chunk is not None and prefill_chunk < 1:
         raise ValueError(
             f"prefill_chunk must be >= 1, got {prefill_chunk}")
+    if spec_k is not None:
+        if spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        if sampler is not None:
+            raise ValueError(
+                "speculative serving is greedy-only: acceptance tests "
+                "the model's argmax chain — drop sampler or spec_k")
+    pick = _make_pick(sampler)
     prefill = make_prefill(params, cfg, max_len, cache_dtype, sampler)
     step = make_serve_step(params, cfg, sampler)
+    spec_step = (make_spec_step(params, cfg, spec_k)
+                 if spec_k is not None else None)
 
     chunk_fill = None
     if prefill_chunk is not None:
@@ -258,9 +369,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             # logits — one compile serves every chunk of every prompt
             logits, cache = forward_cached(params, chunk, cache, cfg,
                                            prefill_impl="cached")
-            if sampler is None:
-                return jnp.argmax(logits[0, last_idx], axis=-1), cache
-            return sampler(logits[:, last_idx], key)[0], cache
+            return pick(logits, last_idx, key), cache
     template = None
     prefix_len = 0
     if prefix is not None:
@@ -282,9 +391,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         def suffix_fill(suffix, cache, key):     # [1, L_s], template copy
             logits, cache = forward_cached(params, suffix, cache, cfg,
                                            prefill_impl="cached")
-            if sampler is None:
-                return jnp.argmax(logits[0, -1], axis=-1), cache
-            return sampler(logits[:, -1], key)[0], cache
+            return pick(logits, -1, key), cache
 
     def admit(prompt, key):
         """(first token, row cache) for one request, via the template
@@ -335,6 +442,84 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         cache["pos"] = jnp.asarray(prefix_len + length, jnp.int32)
         return tok, cache
 
+    def run_spec(prompts, n_new, slots, rules, eos_id):
+        """Speculative schedule: same admission/retire bookkeeping as
+        the plain loop, but outputs live in a device-side context
+        buffer (the draft source) and each step can emit up to
+        ``spec_k + 1`` tokens per slot. Two ``[slots]`` vectors sync
+        per step — the price of host-side retirement under per-slot
+        variable emission."""
+        # reset on entry: a failed run must not leave a prior run's
+        # stats for an error-catching caller to misattribute
+        run.last_stats = None
+        stacked = _stacked_cache(cfg, slots, max_len, rules, cache_dtype)
+        # + k + 1 slack: the verification window is sliced at cur even
+        # when a request is one token from done
+        ctxbuf = jnp.zeros((slots, max_len + spec_k + 1), jnp.int32)
+        cur = jnp.zeros((slots,), jnp.int32)
+        n_out = jnp.zeros((slots,), jnp.int32)
+        queue = deque(enumerate(prompts))
+        active: dict[int, int] = {}
+        start_of: dict[int, int] = {}            # req → first output idx
+        out: dict[int, Any] = {}
+        slot_steps = 0
+        generated = 0
+        admitted = 0                   # prefill-emitted (non-step) tokens
+
+        while queue or active:
+            for slot in range(slots):
+                if slot in active or not queue:
+                    continue
+                req, prompt = queue.popleft()
+                prompt = jnp.asarray(prompt)
+                first, row_cache = admit(prompt, None)
+                stacked = _insert_row(row_cache, stacked, slot)
+                length = int(prompt.shape[-1])
+                start_of[req] = prefix_len + length
+                row = jnp.zeros((ctxbuf.shape[1],), jnp.int32)
+                if prefix is not None:
+                    row = row.at[:prefix_len].set(prefix)
+                row = row.at[prefix_len:prefix_len + length].set(prompt)
+                row = row.at[prefix_len + length].set(first)
+                ctxbuf = ctxbuf.at[slot].set(row)
+                cur = cur.at[slot].set(prefix_len + length + 1)
+                n_out = n_out.at[slot].set(1)
+                generated += 1
+                admitted += 1
+                # the prefill token may already satisfy the request
+                if n_new == 1 or (eos_id is not None
+                                  and int(first) == eos_id):
+                    out[req] = first[None]
+                    continue
+                active[slot] = req
+            if not active:
+                continue
+            ctxbuf, cur, n_out, done, stacked = spec_step(
+                ctxbuf, cur, n_out, jnp.int32(n_new),
+                jnp.int32(-1 if eos_id is None else eos_id), stacked)
+            slot_steps += len(active)
+            # one batched transfer: two separate device_gets would pay
+            # the host round trip twice in the per-step hot loop
+            done_h, n_out_h = jax.device_get((done, n_out))
+            for slot, req in list(active.items()):
+                if bool(done_h[slot]):
+                    n = int(n_out_h[slot])
+                    start = start_of[req]
+                    out[req] = ctxbuf[slot, start:start + n]
+                    generated += n - 1           # first counted at admit
+                    del active[slot]
+        # accepted_per_step excludes admission tokens: it is tokens per
+        # VERIFICATION slot-step, so zero draft acceptance reads exactly
+        # 1.0 (the plain engine's rate), never above it
+        run.last_stats = {
+            "slot_steps": slot_steps,
+            "generated": generated,
+            "accepted_per_step": (round((generated - admitted)
+                                        / slot_steps, 3)
+                                  if slot_steps else None),
+        }
+        return [out[i] for i in range(len(prompts))]
+
     def run(prompts: Sequence[Any], n_new: int, *, slots: int = 4,
             rules: ShardingRules | None = None,
             eos_id: int | None = None, rng=None) -> list[Any]:
@@ -349,12 +534,15 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             # keyed to (request, position): the schedule — slot count,
             # admission order, neighbours — can never change a token
             return jax.random.fold_in(jax.random.fold_in(rng, req), idx)
+        headroom = 0 if spec_k is None else spec_k
         for p in prompts:
-            if prefix_len + int(p.shape[-1]) + n_new > max_len:
+            if prefix_len + int(p.shape[-1]) + n_new + headroom > max_len:
                 raise ValueError(
                     f"prefix ({prefix_len}) + prompt "
-                    f"({int(p.shape[-1])}) + n_new ({n_new}) exceeds "
-                    f"max_len ({max_len})")
+                    f"({int(p.shape[-1])}) + n_new ({n_new})"
+                    + (f" + spec_k ({spec_k}) verification headroom"
+                       if headroom else "")
+                    + f" exceeds max_len ({max_len})")
             if prefill_chunk is not None:
                 # every prompt must fit PADDED, checked before any work:
                 # an admission-time refusal mid-schedule would discard
@@ -362,6 +550,8 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 _check_chunk_bound(int(p.shape[-1]))
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        if spec_k is not None:
+            return run_spec(prompts, n_new, slots, rules, eos_id)
 
         stacked = _stacked_cache(cfg, slots, max_len, rules, cache_dtype)
         tokens = jnp.zeros((slots,), jnp.int32)
@@ -423,6 +613,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
 
         return [jnp.stack(out[i]) for i in range(len(prompts))]
 
+    run.last_stats = None          # set by speculative runs
     return run
 
 
@@ -431,7 +622,8 @@ def serve(params, prompts: Sequence[Any], n_new: int, cfg: BurnInConfig,
           rules: ShardingRules | None = None,
           cache_dtype: str = "bf16",
           eos_id: int | None = None,
-          prefill_chunk: int | None = None) -> list[Any]:
+          prefill_chunk: int | None = None,
+          spec_k: int | None = None) -> list[Any]:
     """Serve ``prompts`` (each ``[L_i]``) with continuous batching.
 
     Returns one ``[n_new]`` token array per prompt, in request order.
@@ -442,7 +634,8 @@ def serve(params, prompts: Sequence[Any], n_new: int, cfg: BurnInConfig,
     parallelism at serve time), KV heads and the weight matmuls over
     ``tp`` — the engine runs on the same mesh the train step used, and
     ``slots`` must divide the data-axis shard count. ``prefill_chunk``
-    admits through the single-compile chunked prefill (see
+    admits through the single-compile chunked prefill; ``spec_k`` serves
+    through speculative continuous batching (see
     :func:`make_serve_engine`).
 
     One-shot convenience over :func:`make_serve_engine` — callers timing
@@ -455,8 +648,9 @@ def serve(params, prompts: Sequence[Any], n_new: int, cfg: BurnInConfig,
         if prefill_chunk:
             # leave room for the padded tail of the longest prompt
             longest = -(-longest // prefill_chunk) * prefill_chunk
-        max_len = longest + n_new
+        max_len = longest + n_new + (spec_k or 0)
     engine = make_serve_engine(params, cfg, max_len=max_len,
                                cache_dtype=cache_dtype,
-                               prefill_chunk=prefill_chunk)
+                               prefill_chunk=prefill_chunk,
+                               spec_k=spec_k)
     return engine(prompts, n_new, slots=slots, rules=rules, eos_id=eos_id)

@@ -381,6 +381,118 @@ def test_chunked_prefill_validation():
         tight([feasible, infeasible], 1, slots=1)
 
 
+def test_spec_serving_matches_plain_engine():
+    """Speculative continuous batching is still just greedy: every
+    request's tokens equal its solo greedy decode across recycling
+    schedules (5 requests, 2 slots) and slot counts, whatever the
+    per-slot acceptance pattern."""
+    cfg, params, prompts = _setup(n_prompts=5)
+    want = _reference(params, prompts, 6, cfg)
+    for slots in (1, 2, 4):
+        got = serve(params, prompts, 6, cfg, slots=slots, spec_k=3)
+        for i, (g, w) in enumerate(zip(got, want)):
+            assert jnp.array_equal(g, w), f"slots={slots} request {i}"
+
+
+def test_spec_serving_accepts_on_repetitive_prompts():
+    """On a repetitive token stream prompt lookup must actually win:
+    accepted tokens per slot-step > 1 (the speedup lever), with tokens
+    still exactly greedy."""
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+
+    cfg = BurnInConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    # strongly periodic prompts: the bigram continuation is usually right
+    prompts = [jnp.asarray(([3, 7, 11] * 4)[:10 + i], jnp.int32)
+               for i in range(3)]
+    engine = make_serve_engine(params, cfg, max_len=64, spec_k=4)
+    got = engine(prompts, 8, slots=2)
+    want = [greedy_decode(params, p[None, :], 8, cfg, max_len=64)[0]
+            for p in prompts]
+    for g, w in zip(got, want):
+        assert jnp.array_equal(g, w)
+    stats = engine.last_stats
+    assert stats is not None and stats["generated"] == 24
+    # accepted_per_step excludes admission tokens, so zero acceptance
+    # reads exactly 1.0 — on streams this regular SOME draft must be
+    # accepted, pushing it strictly above the plain engine's rate
+    assert stats["accepted_per_step"] > 1.0, stats
+
+
+def test_spec_serving_eos_early_stopping():
+    """EOS inside an accepted block truncates the request there — the
+    schedule-level contract matches the plain engine's eos semantics."""
+    cfg, params, prompts = _setup(n_prompts=4)
+    n_new = 8
+    full = _reference(params, prompts, n_new, cfg)
+    eos = int(full[0][2])                       # fires mid-stream
+
+    def truncate(seq):
+        keep = []
+        for t in seq:
+            keep.append(t)
+            if int(t) == eos:
+                break
+        return jnp.stack(keep)
+
+    want = [truncate(f) for f in full]
+    got = serve(params, prompts, n_new, cfg, slots=2, eos_id=eos,
+                spec_k=3)
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert jnp.array_equal(g, w), f"request {i} diverged"
+
+
+def test_spec_serving_composes_with_prefix_and_chunking():
+    """Speculation + prefix caching + chunked admission in one engine:
+    tokens equal greedy over concat(prefix, prompt)."""
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+
+    cfg, params, prompts = _setup(n_prompts=3)
+    prefix = jax.random.randint(jax.random.PRNGKey(42), (6,), 0, cfg.vocab)
+    engine = make_serve_engine(params, cfg, max_len=40, prefix=prefix,
+                               prefill_chunk=4, spec_k=3)
+    got = engine(prompts, 5, slots=2)
+    want = [greedy_decode(params,
+                          jnp.concatenate([prefix, p])[None, :], 5,
+                          cfg, max_len=40)[0] for p in prompts]
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert jnp.array_equal(g, w), f"request {i} diverged"
+
+
+def test_spec_serving_int8_matches_plain_int8_engine():
+    """Under an int8 cache the verification block reads the same
+    quantised rows a plain int8 engine would read step by step, so
+    spec-int8 tokens EQUAL plain-int8 tokens exactly."""
+    cfg, params, prompts = _setup(n_prompts=3)
+    got = serve(params, prompts, 5, cfg, slots=2, cache_dtype="int8",
+                spec_k=3)
+    want = serve(params, prompts, 5, cfg, slots=2, cache_dtype="int8")
+    for g, w in zip(got, want):
+        assert jnp.array_equal(g, w)
+
+
+def test_spec_serving_n_new_one_and_validation():
+    from nvidia_terraform_modules_tpu.models import (
+        make_sampler,
+        make_serve_engine,
+    )
+
+    cfg, params, prompts = _setup(n_prompts=3)
+    got = serve(params, prompts, 1, cfg, slots=2, spec_k=3)
+    want = _reference(params, prompts, 1, cfg)
+    for g, w in zip(got, want):
+        assert g.shape == (1,) and jnp.array_equal(g, w)
+    with pytest.raises(ValueError, match="spec_k"):
+        make_serve_engine(params, cfg, max_len=16, spec_k=0)
+    with pytest.raises(ValueError, match="greedy-only"):
+        make_serve_engine(params, cfg, max_len=16, spec_k=2,
+                          sampler=make_sampler(temperature=2.0))
+    # verification headroom is part of the upfront feasibility check
+    engine = make_serve_engine(params, cfg, max_len=12, spec_k=4)
+    with pytest.raises(ValueError, match="headroom"):
+        engine(prompts, 4, slots=2)             # 6 + 4 + 4 > 12
+
+
 def test_serve_validation():
     cfg, params, prompts = _setup(n_prompts=2)
     with pytest.raises(ValueError, match="slots"):
