@@ -1,6 +1,7 @@
 # SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
 # SPDX-License-Identifier: Apache-2.0
-"""Utility helpers: device discovery, peak-spec tables, timing."""
+"""Utility helpers: device discovery, peak-spec tables, timing,
+trace capture."""
 
 from .device import (  # noqa: F401
     DeviceSpec,
@@ -10,6 +11,12 @@ from .device import (  # noqa: F401
     is_tpu,
 )
 from .timing import timed, median_time  # noqa: F401
+from .profiling import (  # noqa: F401
+    annotate,
+    device_trace,
+    trace_artifacts,
+    trace_once,
+)
 from .data import (  # noqa: F401
     input_pipeline,
     prefetch_to_device,

@@ -1,0 +1,112 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Device trace capture: the profiling tier above ``utils/timing``.
+
+``timing`` answers "how long" (wall-clock medians with tunnel-safe
+sync); this module answers "WHY" — it captures XLA device traces
+(per-kernel timelines, HLO op names, memory allocations) through
+``jax.profiler``, viewable in TensorBoard's profile plugin or Perfetto.
+On TPU the trace includes per-core step breakdowns — the tool for
+finding whether a slow step is MXU-bound, HBM-bound, or host-stalled,
+which a scalar seconds number cannot say.
+
+Reference analogue: none — SURVEY §5 records the reference has no
+tracing/profiling beyond resource timeouts; this is build-side depth
+the TPU workload tier needs (BASELINE targets are roofline fractions,
+and roofline claims should be checkable against a real trace).
+
+Usage::
+
+    from nvidia_terraform_modules_tpu.utils import device_trace, annotate
+
+    with device_trace("/tmp/trace"):            # one capture window
+        with annotate("train_step"):            # named timeline region
+            out = step(params, batch)
+        sync(out)                               # capture real execution
+
+The capture window must contain the device SYNC, not just the dispatch
+— an async dispatch that outlives the window records as a host stub
+with no device activity (the same pitfall ``timing.sync`` exists for).
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Any, Callable, Iterator
+
+from .timing import sync
+
+
+@contextmanager
+def device_trace(log_dir: str, *, host_tracer_level: int = 2,
+                 python_tracer_level: int = 0) -> Iterator[str]:
+    """Capture a ``jax.profiler`` trace of the enclosed block.
+
+    Writes a TensorBoard-profile/Perfetto trace under ``log_dir``
+    (created if needed) and yields that path. ``host_tracer_level``
+    controls host-side instrumentation detail (0 disables);
+    ``python_tracer_level`` > 0 additionally records the Python stack
+    (costly — leave off for kernel work). Nesting is refused by jax
+    itself (one active trace per process).
+    """
+    import jax
+
+    os.makedirs(log_dir, exist_ok=True)
+    opts = jax.profiler.ProfileOptions()
+    opts.host_tracer_level = host_tracer_level
+    opts.python_tracer_level = python_tracer_level
+    jax.profiler.start_trace(
+        log_dir,
+        create_perfetto_link=False,
+        create_perfetto_trace=True,
+        profiler_options=opts)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region on the trace timeline (``jax.profiler``'s
+    ``TraceAnnotation``): dispatches issued inside the block — and
+    their device kernels — group under ``name`` in the viewer. Cheap
+    enough to leave in production code; a no-op when no trace is
+    active."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def trace_once(fn: Callable[..., Any], *args: Any, log_dir: str,
+               warmup: int = 1, **kwargs: Any) -> tuple[Any, str]:
+    """Capture one SYNCED call of ``fn`` → ``(out, trace_dir)``.
+
+    ``warmup`` untimed calls first keep XLA compilation out of the
+    capture (a first-call trace is 99% compiler, which hides the
+    steady-state kernels being diagnosed). The traced call is synced
+    inside the window via ``timing.sync`` so device execution — not
+    just dispatch — lands in the capture.
+    """
+    for _ in range(warmup):
+        sync(fn(*args, **kwargs))
+    with device_trace(log_dir) as path:
+        with annotate(getattr(fn, "__name__", "traced_fn")):
+            out = fn(*args, **kwargs)
+        sync(out)
+    return out, path
+
+
+def trace_artifacts(log_dir: str) -> list[str]:
+    """Paths of trace files produced under ``log_dir`` (the
+    ``plugins/profile/<run>/`` layout TensorBoard expects). Empty means
+    the capture recorded nothing — usually a window that missed the
+    sync."""
+    found: list[str] = []
+    for root, _dirs, files in os.walk(log_dir):
+        found.extend(os.path.join(root, f) for f in files
+                     if f.endswith((".xplane.pb", ".perfetto-trace",
+                                    ".json.gz")))
+    return sorted(found)

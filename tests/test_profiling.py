@@ -1,0 +1,62 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Trace capture (utils/profiling): real jax.profiler traces land on
+disk, annotations nest, and the capture window includes execution.
+
+These run on the CPU backend — the profiler machinery is
+backend-independent (the TPU capture adds device planes but the same
+artifact layout), so CI pins the contract the chip run relies on.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from nvidia_terraform_modules_tpu.utils import (
+    annotate,
+    device_trace,
+    trace_artifacts,
+    trace_once,
+)
+
+
+def test_device_trace_writes_artifacts(tmp_path):
+    log_dir = str(tmp_path / "trace")
+
+    @jax.jit
+    def f(x):
+        return (x @ x.T).sum()
+
+    x = jnp.ones((64, 64), jnp.float32)
+    with device_trace(log_dir) as path:
+        with annotate("matmul_region"):
+            out = f(x)
+        jax.block_until_ready(out)
+    assert path == log_dir
+    arts = trace_artifacts(log_dir)
+    assert arts, "trace capture produced no artifacts"
+    # TensorBoard profile layout: plugins/profile/<run>/...
+    assert any("plugins" in a for a in arts)
+
+
+def test_trace_once_returns_result_and_artifacts(tmp_path):
+    log_dir = str(tmp_path / "once")
+
+    @jax.jit
+    def g(x):
+        return jnp.tanh(x).sum()
+
+    out, path = trace_once(g, jnp.ones((128,), jnp.float32),
+                           log_dir=log_dir)
+    assert jnp.allclose(out, jnp.tanh(1.0) * 128)
+    assert trace_artifacts(path), "no artifacts from traced call"
+
+
+def test_trace_artifacts_empty_dir(tmp_path):
+    assert trace_artifacts(str(tmp_path)) == []
+
+
+def test_annotate_is_noop_without_trace():
+    # cheap enough for production paths: must work with no active trace
+    with annotate("idle"):
+        x = jnp.arange(4).sum()
+    assert int(x) == 6
