@@ -475,12 +475,13 @@ SECTIONS = {
 
 # generous per-section budgets: first XLA compile of a big program is
 # 20-40 s on TPU and minutes are possible over the tunnel; a hang burns
-# only its own budget
+# only its own budget. smoke/burnin compile MANY programs (train ladder,
+# decode ladder, flagship flash train step) — observed >600 s cold
 SECTION_TIMEOUT_S = {
     "devinfo": 150,
-    "smoke": 600,
+    "smoke": 900,
     "probes": 420,
-    "burnin": 900,
+    "burnin": 1500,
     "decode": 600,
     "decode_int8": 600,
     "decode_moe": 600,
@@ -533,15 +534,39 @@ def _child_preexec() -> None:
         pass
 
 
+# wall-clock of the last SIGKILLed axon-active section child: a killed
+# child's chip grant expires server-side only after minutes (verify
+# recipe: "if a TPU run was killed, wait several minutes before
+# retrying"), and a fresh attempt started into that window stalls in the
+# claim-poll loop until its own budget burns — the observed cascade is
+# one timeout poisoning every later section's FIRST attempt
+_LAST_AXON_KILL: float | None = None
+_GRANT_RECOVERY_S = 150.0
+
+
+def _await_grant_recovery(env: dict[str, str]) -> None:
+    """Before launching an axon-active child, sit out the grant-expiry
+    window left by a previously killed one (no-op on the CPU path and
+    when nothing was killed)."""
+    if _LAST_AXON_KILL is None or "PALLAS_AXON_POOL_IPS" not in env:
+        return
+    remaining = _GRANT_RECOVERY_S - (time.time() - _LAST_AXON_KILL)
+    if remaining > 0:
+        print(f"bench: waiting {remaining:.0f}s for the killed child's "
+              f"chip grant to expire", file=sys.stderr)
+        time.sleep(remaining)
+
+
 def _run_section(name: str, env: dict[str, str], timeout: float,
                  attempts: int = 2,
                  backoff_s: float = 5.0) -> tuple[dict | None, str | None]:
     """Run one section in a subprocess. Returns (result, error)."""
-    global _CURRENT_CHILD
+    global _CURRENT_CHILD, _LAST_AXON_KILL
     last_err = "unknown"
     for attempt in range(attempts):
         if attempt:
             time.sleep(backoff_s * attempt)
+        _await_grant_recovery(env)
         proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--section", name],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
@@ -555,6 +580,8 @@ def _run_section(name: str, env: dict[str, str], timeout: float,
             # session group or the next section inherits a wedged backend
             _kill_current_child()
             proc.communicate()
+            if "PALLAS_AXON_POOL_IPS" in env:
+                _LAST_AXON_KILL = time.time()
             last_err = f"timeout>{timeout}s"
             continue
         finally:
