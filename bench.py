@@ -409,10 +409,40 @@ def section_serve() -> dict:
     outs = engine(prompts, n_new, slots=slots)
     jax.block_until_ready(outs)
     dt = _time.perf_counter() - t0
+
+    # speculative engine on TEMPLATED traffic — the structured/repetitive
+    # regime prompt lookup targets (code, RAG, templated output). Same
+    # length buckets as above so the plain baseline reuses its compiled
+    # prefills; the spec engine adds its own prefill + verification-step
+    # compiles (warmed before timing).
+    import jax.numpy as jnp
+
+    period = jnp.asarray([3, 7, 11, 5], jnp.int32)
+    spec_prompts = [
+        jnp.tile(period, lens[i % 2] // 4 + 1)[:lens[i % 2]]
+        for i in range(n_req)
+    ]
+    spec_k = 4
+    spec = make_serve_engine(params, srv_cfg, max_len=max_len + spec_k,
+                             spec_k=spec_k)
+    jax.block_until_ready(spec([spec_prompts[0], spec_prompts[1]], 2,
+                               slots=slots))
+    t0 = _time.perf_counter()
+    jax.block_until_ready(spec(spec_prompts, n_new, slots=slots))
+    spec_dt = _time.perf_counter() - t0
+    accept = (spec.last_stats or {}).get("accepted_per_step")
+    t0 = _time.perf_counter()
+    jax.block_until_ready(engine(spec_prompts, n_new, slots=slots))
+    plain_dt = _time.perf_counter() - t0
+
     return {
         "serve_tokens_per_s": round(n_req * n_new / dt, 1),
         "serve_requests": n_req,
         "serve_slots": slots,
+        "serve_spec_tokens_per_s": round(n_req * n_new / spec_dt, 1),
+        "serve_spec_plain_tokens_per_s": round(n_req * n_new / plain_dt, 1),
+        "serve_spec_speedup": round(plain_dt / spec_dt, 2),
+        "serve_spec_accept_per_step": accept,
     }
 
 
@@ -486,7 +516,9 @@ SECTION_TIMEOUT_S = {
     "decode_int8": 600,
     "decode_moe": 600,
     "decode_spec": 600,
-    "serve": 600,
+    # serve compiles two engines (plain + speculative: per-bucket
+    # prefills, step, verification step) — the many-compiles budget
+    "serve": 900,
     "longctx": 600,
 }
 
@@ -667,8 +699,12 @@ def _grant_holder_sweep() -> dict | None:
     if not found:
         return None
     if killed:
-        # a freshly killed holder's grant takes a while to expire server-side
-        time.sleep(20)
+        # a freshly killed holder's grant expires server-side on the same
+        # clock as a killed section child: stamp the shared recovery
+        # mechanism and let _await_grant_recovery apply the wait lazily,
+        # right before the next axon-active launch
+        global _LAST_AXON_KILL
+        _LAST_AXON_KILL = time.time()
     return {"candidates": found, "killed": killed}
 
 
@@ -800,6 +836,11 @@ def main() -> None:
                 "engine number includes per-step host admission; at tiny "
                 "CPU shapes host dispatch dominates — compare against "
                 "decode_tokens_per_s on chip only")
+        if "serve_spec_speedup" in merged:
+            expectations["serve_spec_speedup"] = (
+                "tiny CPU shapes: per-slot [1,k+1] verification ~= k+1 "
+                "plain steps, <1 expected; acceptance (reported) is the "
+                "chip lever")
         if expectations:
             merged["cpu_fallback_expectations"] = expectations
     line = {
