@@ -49,6 +49,14 @@ def repo_root():
 #   pytest tests/ -q -m "not slow" --durations=0 | awk '$1+0>=4' ...
 # (test_manifest_is_fresh below fails loudly on renamed/deleted entries).
 SLOW_TESTS = frozenset({
+    "tests/test_serving.py::test_spec_serving_matches_plain_engine",
+    "tests/test_serving.py::test_spec_serving_accepts_on_repetitive_prompts",
+    "tests/test_serving.py::test_spec_serving_composes_with_prefix_and_chunking",
+    "tests/test_serving.py::test_spec_serving_eos_early_stopping",
+    "tests/test_serving.py::test_spec_serving_int8_matches_plain_int8_engine",
+    "tests/test_serving.py::test_chunked_prefill_matches_unchunked",
+    "tests/test_serving.py::test_chunked_prefill_with_prefix_caching",
+    "tests/test_serving.py::test_chunked_prefill_flash_config_exact_vs_dense",
     "tests/test_serving.py::test_serve_matches_per_request_greedy_with_recycling",
     "tests/test_serving.py::test_serve_moe_config",
     "tests/test_serving.py::test_serve_flash_config_matches_its_own_greedy",
