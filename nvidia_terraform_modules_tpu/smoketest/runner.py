@@ -344,4 +344,48 @@ def _run_full_level(checks: dict[str, Any], n_dev: int) -> bool:
         checks["pipeline_ok"] = False
         checks["pipeline_error"] = str(exc)
     ok &= checks["pipeline_ok"]
+
+    # --- serving engine: slot-based continuous batching on the mesh —
+    # the runtime the "serve"-named slice pools exist to run
+    # (models/serving.py) proves out on the same fresh slice the train
+    # legs validated. First tokens are compared against a BATCH-1
+    # training forward per request — the same [1, plen] matmul shapes
+    # the engine's row prefill runs, so the comparison carries only
+    # decode_ok's residual near-tie risk, not a cross-batch-shape
+    # tiling difference — and only FIRST tokens are compared (one
+    # near-tie chance per request; later tokens would compound it).
+    # The schedule runs 2x more requests than slots so slot recycling
+    # actually happens. No eos is passed: the loop then never syncs
+    # per step, which keeps it multi-controller-safe.
+    try:
+        from ..models import forward, make_serve_engine
+
+        s_mesh = build_mesh(plan_mesh(n_dev))
+        s_rules = make_rules(s_mesh)
+        data_shards = s_mesh.shape["dp"]
+        scfg = BurnInConfig(batch=max(2, data_shards))
+        sparams = init_params(jax.random.PRNGKey(6), scfg, s_rules)
+        slots = data_shards
+        n_req, plen, n_new = 2 * slots, 8, 4
+        prompts_mat = jax.random.randint(
+            jax.random.PRNGKey(7), (n_req, plen), 0, scfg.vocab)
+        engine = make_serve_engine(sparams, scfg, max_len=plen + n_new)
+        outs = engine([prompts_mat[i] for i in range(n_req)], n_new,
+                      slots=slots, rules=s_rules)
+        ref_first = jax.numpy.stack([
+            jax.numpy.argmax(
+                forward(sparams, prompts_mat[i:i + 1], scfg)[0, -1],
+                axis=-1)
+            for i in range(n_req)])
+        firsts = jax.numpy.stack([o[0] for o in outs])
+        match = jax.numpy.all(firsts == ref_first)
+        checks["serving_requests"] = n_req
+        checks["serving_slots"] = slots
+        checks["serving_ok"] = (
+            all(o.shape == (n_new,) for o in outs)
+            and bool(jax.device_get(match)))
+    except Exception as exc:  # noqa: BLE001
+        checks["serving_ok"] = False
+        checks["serving_error"] = str(exc)
+    ok &= checks["serving_ok"]
     return ok

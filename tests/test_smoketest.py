@@ -59,6 +59,11 @@ def test_full_level(jax8):
     assert r.checks["all_to_all_ep_gibps"] > 0
     assert r.checks["moe_ok"]
     assert r.checks["pipeline_ok"]
+    # the serving-engine leg: continuous batching over the mesh with
+    # recycling (2x requests vs slots), first tokens self-consistent
+    # with the training forward
+    assert r.checks["serving_ok"]
+    assert r.checks["serving_requests"] == 2 * r.checks["serving_slots"]
     # full is a superset: the burn-in/decode contract still holds
     assert r.checks["burnin_ok"] and r.checks["decode_ok"]
 
