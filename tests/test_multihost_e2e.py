@@ -156,6 +156,29 @@ def test_package_runner_two_hosts(tmp_path):
 
 
 @pytest.mark.slow
+def test_package_runner_full_level_two_hosts(tmp_path):
+    """Level full on the PACKAGE runner across 2 processes: the serving
+    engine's host-side admission/recycling loop must run identically on
+    every controller (no per-step sync without eos) while the pool
+    shards over the global mesh — the multi-controller contract the
+    in-cluster Job relies on. Also pins the ep/pp fabric keys the
+    bundled-script full test covers, for the package path."""
+    runner = _pkg_runner(tmp_path)
+    results = _run_pair(str(runner), {"TPU_SMOKETEST_LEVEL": "full"},
+                        port=8499)
+    for rc, out, err in results:
+        assert rc == 0, f"stdout={out!r}\nstderr={err[-2000:]!r}"
+        verdict = _verdict(out)
+        assert verdict["ok"] is True
+        assert verdict["serving_ok"] is True
+        assert verdict["serving_requests"] == 2 * verdict["serving_slots"]
+        assert verdict["all_to_all_ep_ok"] is True
+        assert verdict["moe_ok"] is True
+        assert verdict["pipeline_ok"] is True
+        assert verdict["burnin_ok"] is True and verdict["decode_ok"] is True
+
+
+@pytest.mark.slow
 def test_standalone_script_burnin_resume(tmp_path):
     """Spot-preemption contract for the bundled payload: a checkpoint left
     by a preempted attempt resumes the global step; success clears it so a
