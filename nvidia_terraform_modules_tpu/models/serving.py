@@ -152,31 +152,39 @@ def make_serve_step(params, cfg: BurnInConfig, sampler=None):
     """
     pick = _make_pick(sampler)
 
-    def row(tok, key, cache):
-        logits, cache = forward_cached(params, tok[None, None], cache, cfg,
+    # params enter every compiled function as a runtime ARGUMENT, never a
+    # closure: a closed-over array tree lowers as module constants, and at
+    # flagship size that embeds the full weight set (hundreds of MB) into
+    # each program — observed as multi-minute serve compiles on TPU before
+    # the serve section ever ran a step (BENCH_tpu_capture_r04 serve
+    # timeout). Passing the tree costs nothing: the buffers are already
+    # device-resident.
+    def row(p, tok, key, cache):
+        logits, cache = forward_cached(p, tok[None, None], cache, cfg,
                                        prefill_impl="cached")
         return pick(logits, -1, key), cache
 
-    vrow = jax.vmap(row)
+    vrow = jax.vmap(row, in_axes=(None, 0, 0, 0))
 
     if sampler is None:
-        @functools.partial(jax.jit, donate_argnums=(1,))
-        def step(tokens, stacked):
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def step(p, tokens, stacked):
             dummy = jnp.zeros((tokens.shape[0], 2), jnp.uint32)
-            return vrow(tokens, dummy, stacked)
+            return vrow(p, tokens, dummy, stacked)
 
-        return step
+        return lambda tokens, stacked: step(params, tokens, stacked)
 
-    @functools.partial(jax.jit, donate_argnums=(4,))
-    def sampled_step(tokens, req_ids, positions, rng, stacked):
+    @functools.partial(jax.jit, donate_argnums=(5,))
+    def sampled_step(p, tokens, req_ids, positions, rng, stacked):
         # key = fold_in(fold_in(rng, request), position), derived INSIDE
         # the compiled step: one dispatch per step regardless of slot
         # count, and typed or legacy rng keys both work
-        keys = jax.vmap(lambda r, p: jax.random.fold_in(
-            jax.random.fold_in(rng, r), p))(req_ids, positions)
-        return vrow(tokens, keys, stacked)
+        keys = jax.vmap(lambda r, pos: jax.random.fold_in(
+            jax.random.fold_in(rng, r), pos))(req_ids, positions)
+        return vrow(p, tokens, keys, stacked)
 
-    return sampled_step
+    return lambda tokens, req_ids, positions, rng, stacked: sampled_step(
+        params, tokens, req_ids, positions, rng, stacked)
 
 
 def make_spec_step(params, cfg: BurnInConfig, k: int):
@@ -204,13 +212,13 @@ def make_spec_step(params, cfg: BurnInConfig, k: int):
     """
     from .speculative import _ngram_draft
 
-    def row(ctx_row, cur, n_done, n_new, eos_id, cache):
+    def row(p, ctx_row, cur, n_done, n_new, eos_id, cache):
         last = ctx_row[cur - 1]
         draft = _ngram_draft(ctx_row, cur, k, cfg.vocab)          # [k]
         block = jnp.concatenate([last[None], draft])[None]        # [1,k+1]
         # "cached": a mid-stream t>1 forward attending over the cache
         # buffer at this slot's own position
-        logits, cache = forward_cached(params, block, cache, cfg,
+        logits, cache = forward_cached(p, block, cache, cfg,
                                        prefill_impl="cached")
         preds = jnp.argmax(logits[0], axis=-1)                    # [k+1]
         agree = draft == preds[:-1]
@@ -237,13 +245,15 @@ def make_spec_step(params, cfg: BurnInConfig, k: int):
         done = (n_done >= n_new) | hit
         return ctx_row, cur + emit, n_done, done, cache
 
-    vrow = jax.vmap(row, in_axes=(0, 0, 0, None, None, 0))
+    vrow = jax.vmap(row, in_axes=(None, 0, 0, 0, None, None, 0))
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 5))
-    def step(ctx, cur, n_out, n_new, eos_id, stacked):
-        return vrow(ctx, cur, n_out, n_new, eos_id, stacked)
+    # params as argument, not closure — see make_serve_step
+    @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 6))
+    def step(p, ctx, cur, n_out, n_new, eos_id, stacked):
+        return vrow(p, ctx, cur, n_out, n_new, eos_id, stacked)
 
-    return step
+    return lambda ctx, cur, n_out, n_new, eos_id, stacked: step(
+        params, ctx, cur, n_out, n_new, eos_id, stacked)
 
 
 def make_prefill(params, cfg: BurnInConfig, max_len: int,
@@ -264,10 +274,11 @@ def make_prefill(params, cfg: BurnInConfig, max_len: int,
 
     pick = _make_pick(sampler)
 
-    @functools.partial(jax.jit, static_argnums=(1,))
-    def prefill(prompt, impl, key):                        # [1, L]
+    # params as argument, not closure — see make_serve_step
+    @functools.partial(jax.jit, static_argnums=(2,))
+    def prefill(p, prompt, impl, key):                     # [1, L]
         cache = init_cache(cfg, 1, max_len, cache_dtype=cache_dtype)
-        logits, cache = forward_cached(params, prompt, cache, cfg,
+        logits, cache = forward_cached(p, prompt, cache, cfg,
                                        prefill_impl=impl)
         return pick(logits, -1, key), cache
 
@@ -275,7 +286,7 @@ def make_prefill(params, cfg: BurnInConfig, max_len: int,
         impl = _select_prefill_impl(cfg, int(prompt.shape[-1]), "auto")
         if key is None:
             key = jnp.zeros((2,), jnp.uint32)
-        return prefill(prompt, impl, key)
+        return prefill(params, prompt, impl, key)
 
     return run
 
@@ -361,15 +372,19 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
 
     chunk_fill = None
     if prefill_chunk is not None:
-        @functools.partial(jax.jit, donate_argnums=(2,))
-        def chunk_fill(chunk, last_idx, cache, key):       # [1, C]
+        # params as argument, not closure — see make_serve_step
+        @functools.partial(jax.jit, donate_argnums=(3,))
+        def _chunk_fill(p, chunk, last_idx, cache, key):   # [1, C]
             # mid-stream cached forward: masks by position, so the pad
             # tail of the final chunk never leaks into real tokens'
             # attention; last_idx (traced) picks the true last token's
             # logits — one compile serves every chunk of every prompt
-            logits, cache = forward_cached(params, chunk, cache, cfg,
+            logits, cache = forward_cached(p, chunk, cache, cfg,
                                            prefill_impl="cached")
             return pick(logits, last_idx, key), cache
+
+        def chunk_fill(chunk, last_idx, cache, key):
+            return _chunk_fill(params, chunk, last_idx, cache, key)
     template = None
     prefix_len = 0
     if prefix is not None:
@@ -387,11 +402,15 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                                          cache_dtype))
         _first, template = template_prefill(prefix[None, :])
 
+        # params as argument, not closure — see make_serve_step
         @jax.jit
-        def suffix_fill(suffix, cache, key):     # [1, L_s], template copy
-            logits, cache = forward_cached(params, suffix, cache, cfg,
+        def _suffix_fill(p, suffix, cache, key):  # [1, L_s], template copy
+            logits, cache = forward_cached(p, suffix, cache, cfg,
                                            prefill_impl="cached")
             return pick(logits, -1, key), cache
+
+        def suffix_fill(suffix, cache, key):
+            return _suffix_fill(params, suffix, cache, key)
 
     def admit(prompt, key):
         """(first token, row cache) for one request, via the template
