@@ -398,16 +398,33 @@ def section_serve() -> dict:
         for i in range(n_req)
     ]
     max_len = max(lens) + n_new
+    import jax.numpy as jnp
+
+    # provable barrier over EVERY output: the tunnelled backend acks
+    # dispatch in block_until_ready without waiting for execution
+    # (utils/timing.py), and the plain engine's schedule is fully
+    # async — a d2h read that depends on all outputs is the only
+    # honest end of the clock. ONE jitted reduction (compiled in the
+    # warm passes) so the barrier itself adds a single dispatch to the
+    # timed window, not two eager ops per output
+    last_of = jax.jit(lambda outs: jnp.stack([o[-1] for o in outs]))
+
+    def sync_outs(outs):
+        jax.device_get(last_of(outs))
+
     # ONE engine: its closures hold the compiled prefills (one per
-    # bucket) and the step, so the warm pass genuinely warms the timed
+    # bucket) and the step, so the warm passes genuinely warm the timed
     # pass (fresh serve() calls would rebuild jit wrappers and
-    # recompile inside the clock)
+    # recompile inside the clock). Two warm passes: the tiny one pays
+    # the compiles, the full-roster one runs every executable past the
+    # backend's slow first executions (~10 × 40 ms per fresh program on
+    # the tunnelled chip) so the clock sees steady state
     engine = make_serve_engine(params, srv_cfg, max_len=max_len)
-    warm = engine([prompts[0], prompts[1]], 2, slots=slots)
-    jax.block_until_ready(warm)
+    sync_outs(engine([prompts[0], prompts[1]], 2, slots=slots))
+    sync_outs(engine(prompts, n_new, slots=slots))
     t0 = _time.perf_counter()
     outs = engine(prompts, n_new, slots=slots)
-    jax.block_until_ready(outs)
+    sync_outs(outs)
     dt = _time.perf_counter() - t0
 
     # speculative engine on TEMPLATED traffic — the structured/repetitive
@@ -415,8 +432,6 @@ def section_serve() -> dict:
     # length buckets as above so the plain baseline reuses its compiled
     # prefills; the spec engine adds its own prefill + verification-step
     # compiles (warmed before timing).
-    import jax.numpy as jnp
-
     period = jnp.asarray([3, 7, 11, 5], jnp.int32)
     spec_prompts = [
         jnp.tile(period, lens[i % 2] // 4 + 1)[:lens[i % 2]]
@@ -425,10 +440,10 @@ def section_serve() -> dict:
     spec_k = 4
     spec = make_serve_engine(params, srv_cfg, max_len=max_len + spec_k,
                              spec_k=spec_k)
-    jax.block_until_ready(spec([spec_prompts[0], spec_prompts[1]], 2,
-                               slots=slots))
+    sync_outs(spec([spec_prompts[0], spec_prompts[1]], 2, slots=slots))
+    sync_outs(spec(spec_prompts, n_new, slots=slots))
     t0 = _time.perf_counter()
-    jax.block_until_ready(spec(spec_prompts, n_new, slots=slots))
+    sync_outs(spec(spec_prompts, n_new, slots=slots))
     spec_dt = _time.perf_counter() - t0
     accept = (spec.last_stats or {}).get("accepted_per_step")
 

@@ -201,14 +201,19 @@ def make_spec_step(params, cfg: BurnInConfig, k: int):
     rows stay position-masked until real decode writes reclaim them,
     the same mechanism chunked prefill uses for pad rows).
 
-    Step signature (all donated except the two scalars):
+    Step signature (``ctx``/``cur``/``n_out``/``stacked`` donated):
     ``(ctx [slots, Lc], cur [slots], n_out [slots], n_new, eos_id,
-    stacked) → (ctx, cur, n_out, done [slots] bool, stacked)`` where
-    ``ctx`` rows hold prefix+prompt+generated tokens, ``cur`` the valid
-    length, ``n_out`` tokens generated; ``eos_id < 0`` disables eos.
-    Emission per slot is capped at ``n_new - n_out`` FIRST, then
-    truncated at the first eos inside the capped window — so ``done``
-    can never fire on an eos the cap already excluded.
+    active [slots] bool, stop_count, stacked) → (ctx, cur, n_out,
+    fin [slots] bool, steps, stacked)`` where ``ctx`` rows hold
+    prefix+prompt+generated tokens, ``cur`` the valid length, ``n_out``
+    tokens generated; ``eos_id < 0`` disables eos. The step is a
+    device-resident MULTI-step: it loops until ``stop_count`` of the
+    ``active`` slots have finished (``fin``), freezing each finished
+    slot's state at the step it completed, and returns ``steps``, the
+    number of unfrozen-active slot-steps it ran (the stats
+    denominator). Emission per slot is capped at ``n_new - n_out``
+    FIRST, then truncated at the first eos inside the capped window —
+    so a slot can never finish on an eos the cap already excluded.
     """
     from .speculative import _ngram_draft
 
@@ -247,13 +252,47 @@ def make_spec_step(params, cfg: BurnInConfig, k: int):
 
     vrow = jax.vmap(row, in_axes=(None, 0, 0, 0, None, None, 0))
 
-    # params as argument, not closure — see make_serve_step
-    @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 6))
-    def step(p, ctx, cur, n_out, n_new, eos_id, stacked):
-        return vrow(p, ctx, cur, n_out, n_new, eos_id, stacked)
+    # Device-resident MULTI-step: the host loop's only job is retirement
+    # and admission, but a per-token host round-trip costs a full
+    # dispatch RTT (~90 ms through the tunnelled backend — observed to
+    # turn a 2× speculative win into a 16× loss). So the compiled step
+    # advances EVERY slot repeatedly inside a while_loop and returns
+    # only when ``stop_count`` active slots have finished — one sync per
+    # retirement wave, not per verification step. Slots that finish
+    # early are FROZEN (ctx/cur/n_out held at the step they first
+    # completed) so the host retires exactly the state the per-step
+    # design would have produced: eos overruns never accumulate, and
+    # the emission cap keeps every active slot terminating, bounding
+    # the loop. Frozen slots still burn a forward per iteration — a
+    # few ms of MXU time traded against a 90 ms RTT per avoided sync.
+    # params as argument, not closure — see make_serve_step.
+    @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 8))
+    def step(p, ctx, cur, n_out, n_new, eos_id, active, stop_count,
+             stacked):
+        def cond(s):
+            _, _, _, fin, _, _ = s
+            return jnp.sum(fin & active) < stop_count
 
-    return lambda ctx, cur, n_out, n_new, eos_id, stacked: step(
-        params, ctx, cur, n_out, n_new, eos_id, stacked)
+        def body(s):
+            ctx, cur, n_out, fin, steps, stacked = s
+            nctx, ncur, nn_out, done, nstacked = vrow(
+                p, ctx, cur, n_out, n_new, eos_id, stacked)
+            ctx = jnp.where(fin[:, None], ctx, nctx)
+            cur = jnp.where(fin, cur, ncur)
+            n_out = jnp.where(fin, n_out, nn_out)
+            # count BEFORE updating fin: a slot's finishing step is a
+            # real verification step; frozen iterations are not
+            steps = steps + jnp.sum(active & ~fin)
+            fin = fin | (done & active)
+            return ctx, cur, n_out, fin, steps, nstacked
+
+        fin0 = jnp.zeros(active.shape, bool)
+        s = (ctx, cur, n_out, fin0, jnp.int32(0), stacked)
+        return jax.lax.while_loop(cond, body, s)
+
+    return lambda ctx, cur, n_out, n_new, eos_id, active, stop_count, \
+        stacked: step(params, ctx, cur, n_out, n_new, eos_id, active,
+                      stop_count, stacked)
 
 
 def make_prefill(params, cfg: BurnInConfig, max_len: int,
@@ -348,8 +387,9 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
     near-tie argmax may resolve differently on TPU; bit-exact on CPU
     f32, where the tests pin it). Costs:
     ``max_len`` must leave ``spec_k`` rows of verification headroom
-    past each request's last token, and the engine syncs two small
-    ``[slots]`` vectors per step to retire finished requests. After
+    past each request's last token, and the engine reads three small
+    vectors back once per retirement WAVE (the compiled multi-step
+    loops on device until a slot must recycle). After
     each call ``engine.last_stats`` reports realised acceptance
     (``generated / slot_steps`` ≥ 1 is the speedup lever vs the plain
     engine's one token per slot-step).
@@ -461,13 +501,32 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         cache["pos"] = jnp.asarray(prefix_len + length, jnp.int32)
         return tok, cache
 
+    # one dispatch per speculative admission (compiled per prompt-length
+    # bucket): building the context row with eager .at[] ops cost ~7
+    # device round trips per request through the tunnelled backend.
+    # ``prefix`` is a closure constant here deliberately — it is a short
+    # token vector, not a weight tree.
+    @functools.partial(jax.jit, donate_argnums=(3, 4, 5))
+    def _spec_admit_row(prompt, first, slot, ctxbuf, cur, n_out):
+        length = prompt.shape[-1]
+        row = jnp.zeros((ctxbuf.shape[1],), jnp.int32)
+        if prefix is not None:
+            row = row.at[:prefix_len].set(prefix)
+        row = jax.lax.dynamic_update_slice(row, prompt, (prefix_len,))
+        row = row.at[prefix_len + length].set(first)
+        return (ctxbuf.at[slot].set(row),
+                cur.at[slot].set(prefix_len + length + 1),
+                n_out.at[slot].set(1))
+
     def run_spec(prompts, n_new, slots, rules, eos_id):
         """Speculative schedule: same admission/retire bookkeeping as
         the plain loop, but outputs live in a device-side context
         buffer (the draft source) and each step can emit up to
-        ``spec_k + 1`` tokens per slot. Two ``[slots]`` vectors sync
-        per step — the price of host-side retirement under per-slot
-        variable emission."""
+        ``spec_k + 1`` tokens per slot. The host syncs once per
+        RETIREMENT WAVE, not per step: the compiled multi-step loops
+        on device until enough slots finish (one, when requests are
+        queued and a slot should recycle promptly; all active, when
+        the queue is empty and nothing is waiting to admit)."""
         # reset on entry: a failed run must not leave a prior run's
         # stats for an error-catching caller to misattribute
         run.last_stats = None
@@ -484,6 +543,10 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         slot_steps = 0
         generated = 0
         admitted = 0                   # prefill-emitted (non-step) tokens
+        # loop-invariant scalars hoisted: re-creating them per wave would
+        # ship two h2d constants per retirement wave for nothing
+        n_new_dev = jnp.int32(n_new)
+        eos_dev = jnp.int32(-1 if eos_id is None else eos_id)
 
         while queue or active:
             for slot in range(slots):
@@ -495,14 +558,8 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 stacked = _insert_row(row_cache, stacked, slot)
                 length = int(prompt.shape[-1])
                 start_of[req] = prefix_len + length
-                row = jnp.zeros((ctxbuf.shape[1],), jnp.int32)
-                if prefix is not None:
-                    row = row.at[:prefix_len].set(prefix)
-                row = row.at[prefix_len:prefix_len + length].set(prompt)
-                row = row.at[prefix_len + length].set(first)
-                ctxbuf = ctxbuf.at[slot].set(row)
-                cur = cur.at[slot].set(prefix_len + length + 1)
-                n_out = n_out.at[slot].set(1)
+                ctxbuf, cur, n_out = _spec_admit_row(
+                    prompt, first, jnp.int32(slot), ctxbuf, cur, n_out)
                 generated += 1
                 admitted += 1
                 # the prefill token may already satisfy the request
@@ -513,15 +570,26 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 active[slot] = req
             if not active:
                 continue
-            ctxbuf, cur, n_out, done, stacked = spec_step(
-                ctxbuf, cur, n_out, jnp.int32(n_new),
-                jnp.int32(-1 if eos_id is None else eos_id), stacked)
-            slot_steps += len(active)
-            # one batched transfer: two separate device_gets would pay
-            # the host round trip twice in the per-step hot loop
-            done_h, n_out_h = jax.device_get((done, n_out))
+            active_mask = jnp.asarray(
+                [s in active for s in range(slots)])
+            # wave size follows the admission backlog: with a deep queue
+            # the next admissions arrive as a batch anyway, so drain as
+            # many slots as there are requests waiting (one sync per
+            # admission WAVE); a single queued request still gets the
+            # first free slot (stop=1), and an empty queue runs every
+            # active slot to completion — nothing is waiting to admit
+            stop = (min(len(active), max(1, len(queue)))
+                    if queue else len(active))
+            ctxbuf, cur, n_out, fin, steps_inc, stacked = spec_step(
+                ctxbuf, cur, n_out, n_new_dev, eos_dev,
+                active_mask, jnp.int32(stop), stacked)
+            # one batched transfer: separate device_gets would pay the
+            # host round trip repeatedly in the per-wave hot loop
+            fin_h, n_out_h, steps_h = jax.device_get(
+                (fin, n_out, steps_inc))
+            slot_steps += int(steps_h)
             for slot, req in list(active.items()):
-                if bool(done_h[slot]):
+                if bool(fin_h[slot]):
                     n = int(n_out_h[slot])
                     start = start_of[req]
                     out[req] = ctxbuf[slot, start:start + n]
@@ -576,23 +644,20 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         tokens = jnp.zeros((slots,), jnp.int32)
         queue = deque(enumerate(prompts))
         active: dict[int, int] = {}              # slot → request index
-        out: dict[int, list] = {}
+        firsts: dict[int, Any] = {}              # req → prefill token
+        span: dict[int, tuple] = {}              # req → (slot, start wave)
+        count: dict[int, int] = {}               # req → tokens so far
+        done_at: dict[int, int] = {}             # req → final token count
+        hist: list = []          # one [slots] token vector per step wave
 
-        def finished(req) -> bool:
-            # a request ends at n_new tokens, or at its first eos_id —
-            # eos is what makes generation lengths VARIABLE, the whole
-            # reason slots recycle at different times in real traffic.
-            # The int() is a per-request device→host sync each step;
-            # without eos_id the loop never syncs until the final stack.
-            if len(out[req]) >= n_new:
-                return True
-            return eos_id is not None and int(out[req][-1]) == eos_id
-
-        def retire_done():
-            for slot, req in list(active.items()):
-                if finished(req):
-                    del active[slot]             # slot recycles next wave
-
+        # Host bookkeeping is integer-only: the loop keeps whole [slots]
+        # token vectors per wave and assembles outputs AFTER the
+        # schedule in O(requests) device ops. Per-slot host slicing
+        # inside the wave loop (the previous design) cost ~active
+        # dispatches per step — observed to dominate serve wall-clock
+        # through the tunnelled backend's per-op latency. Without
+        # eos_id the schedule is fully async end to end; eos makes
+        # lengths variable and costs ONE [slots] readback per wave.
         while queue or active:
             # admission: every free slot takes the next queued request
             for slot in range(slots):
@@ -604,12 +669,16 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                     key_for(req, 0) if sampler is not None else None)
                 stacked = _insert_row(row_cache, stacked, slot)
                 tokens = tokens.at[slot].set(first)
+                firsts[req] = first
+                span[req] = (slot, len(hist))
+                count[req] = 1
+                # a request the prefill token already satisfied must
+                # retire BEFORE any step, or it collects an extra token
+                if n_new == 1 or (eos_id is not None
+                                  and int(first) == eos_id):
+                    done_at[req] = 1
+                    continue
                 active[slot] = req
-                out[req] = [first]
-            # a request the prefill token already satisfied (n_new == 1
-            # or an immediate eos) must retire BEFORE the step, or it
-            # collects an extra token
-            retire_done()
             if not active:
                 continue
             # one compiled step advances every slot (idle slots compute
@@ -623,14 +692,30 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                     [active.get(s, len(prompts)) for s in range(slots)],
                     jnp.int32)
                 poss = jnp.asarray(
-                    [len(out[active[s]]) if s in active else 0
+                    [count[active[s]] if s in active else 0
                      for s in range(slots)], jnp.int32)
                 tokens, stacked = step(tokens, reqs, poss, rng, stacked)
+            hist.append(tokens)
+            tok_h = jax.device_get(tokens) if eos_id is not None else None
             for slot, req in list(active.items()):
-                out[req].append(tokens[slot])
-            retire_done()
+                count[req] += 1
+                if count[req] >= n_new or (
+                        tok_h is not None and int(tok_h[slot]) == eos_id):
+                    done_at[req] = count[req]
+                    del active[slot]             # slot recycles next wave
 
-        return [jnp.stack(out[i]) for i in range(len(prompts))]
+        waves = jnp.stack(hist) if hist else None      # [W, slots]
+        outs = []
+        for req in range(len(prompts)):
+            n, (slot, sw) = done_at[req], span[req]
+            if n == 1:
+                outs.append(firsts[req][None])
+            else:
+                # the n-1 step waves while req held its slot are exactly
+                # hist[sw : sw+n-1] — one emission per active wave
+                outs.append(jnp.concatenate(
+                    [firsts[req][None], waves[sw:sw + n - 1, slot]]))
+        return outs
 
     run.last_stats = None          # set by speculative runs
     return run
