@@ -201,8 +201,14 @@ def _time_decode(decoder, prefiller, params, prompt, n_new: int):
     """
     from nvidia_terraform_modules_tpu.utils.timing import sync
 
-    sync(decoder(params, prompt))    # compile
-    sync(prefiller(params, prompt))  # compile
+    # compile, then run past the backend's slow first executions of a
+    # fresh program (~handful of slow execs observed on the tunnelled
+    # chip) — without this, whichever variant a section measures FIRST
+    # eats the warm-up and reads as a regression (the round-3 fused-int8
+    # "pessimization" was exactly this artifact)
+    for _ in range(4):
+        sync(decoder(params, prompt))
+        sync(prefiller(params, prompt))
     iters = 3
     t0 = time.perf_counter()
     for _ in range(iters):
