@@ -125,10 +125,11 @@ def init_cache(cfg: BurnInConfig, batch: int, max_len: int,
     if cache_dtype not in ("bf16", "int8"):
         raise ValueError(
             f"unknown cache_dtype {cache_dtype!r}: use bf16|int8")
+    quant = cache_dtype == "int8"
+    max_len = cache_rows(max_len, cache_dtype)
     # GQA: only KV heads are cached — the cache shrinks by
     # n_heads/kv_heads, the point of grouped-query attention at serve time
     shape = (batch, max_len, cfg.kv_heads, cfg.head_dim)
-    quant = cache_dtype == "int8"
     buf_dtype = jnp.int8 if quant else cfg.dtype
     kv = {
         "k": [jnp.zeros(shape, buf_dtype) for _ in range(cfg.n_layers)],
@@ -158,6 +159,22 @@ def init_cache(cfg: BurnInConfig, batch: int, max_len: int,
     return kv
 
 
+def cache_rows(max_len: int, cache_dtype: str) -> int:
+    """Buffer row count for a cache of logical length ``max_len``.
+
+    int8 caches round up to the pallas decode kernel's 256-row block
+    grain: the kernel tiles S exactly (a ragged tail block would CLAMP
+    its start and silently read earlier rows under the mask), and rows
+    past the caller's ``max_len`` sit above ``pos`` forever —
+    position-masked, never written, a few MB next to the bandwidth they
+    unlock. Every cache constructor (``init_cache``, the serving pool)
+    must agree on this number, which is why it is one function.
+    """
+    if cache_dtype == "int8":
+        return -(-max_len // 256) * 256
+    return max_len
+
+
 def quantize_kv(x):
     """Per-vector symmetric int8 for cache rows: ``[..., D]`` →
     ``(q int8, scale f32 [...])`` with ``|dequant - x| <= scale/2``."""
@@ -166,6 +183,13 @@ def quantize_kv(x):
     scale = jnp.maximum(amax, 1e-12) / 127.0
     q = jnp.clip(jnp.round(x32 / scale[..., None]), -127, 127)
     return q.astype(jnp.int8), scale
+
+
+# Test hook: the kernel branch below is gated on real TPU, so its call-site
+# wiring (q slicing, pos broadcast, output reshape) would otherwise be
+# unreachable in CPU CI. Tests flip this to route through the kernel in
+# interpret mode (tests/test_decode_attention.py::test_cached_attention_gate).
+_FORCE_DECODE_KERNEL = False
 
 
 def _cached_attention(q, k_cache, v_cache, q_pos, scale,
@@ -182,27 +206,56 @@ def _cached_attention(q, k_cache, v_cache, q_pos, scale,
     un-repeated cache — the repeated-cache tensor the serving win exists
     to avoid is never materialised.
 
-    With ``k_scale``/``v_scale`` the buffers are int8 and dequantised here
-    — after the (1-byte) HBM read, which is the point. Dequant lands in
-    the COMPUTE dtype (int8→bf16 is exact; accumulation is pinned to f32
-    by ``preferred_element_type`` either way): an f32 dequant would make
-    any XLA-materialised operand temporary 4 bytes/element — double the
-    bf16 cache this path exists to halve.
+    With ``k_scale``/``v_scale`` the buffers are int8, and the scales are
+    applied AFTER the contractions, never to the cache operand itself:
+    ``q·(k_q·s_k) = (q·k_q)·s_k`` per cached vector, and
+    ``Σ_s p_s·(v_q·s_v)_s = Σ_s (p_s·s_v,s)·v_q_s`` — the scale folds
+    into the scores / probabilities, which are [.., S] and tiny next to
+    the [.., S, D] cache. Scaling the cache before the dot (the naive
+    form) hands XLA an elementwise-times-int8 operand it materialises as
+    a full compute-dtype copy of the cache — read 1 byte, write 2, read
+    2: WORSE than a bf16 cache (measured 1534 vs 2135 tok/s at
+    [8, 3584+] rows). After the restructure only int8 cache bytes cross
+    HBM; the convert-in-dot is XLA operand fusion's easy case.
     """
     b, t, h, d = q.shape
-    if k_scale is not None:
-        k_cache = k_cache.astype(q.dtype) * k_scale[..., None].astype(q.dtype)
-        v_cache = v_cache.astype(q.dtype) * v_scale[..., None].astype(q.dtype)
+    if (k_scale is not None and t == 1 and d % 128 == 0
+            and (_FORCE_DECODE_KERNEL
+                 or jax.devices()[0].platform == "tpu")):
+        # the T=1 int8 step is the long-context hot path: the pallas
+        # flash-decode kernel guarantees int8 cache bytes per step (XLA
+        # materialises converted operands at long S even with the
+        # scale-after-dot form below — measured parity instead of the
+        # ~1.7× byte win). Positions are batch-uniform here (q_pos[0]);
+        # the per-row generality lives in the kernel's pos argument.
+        from ..ops.decode_attention import int8_kv_decode_attention
+
+        out = int8_kv_decode_attention(
+            q[:, 0], k_cache, k_scale, v_cache, v_scale,
+            jnp.broadcast_to(q_pos[0], (b,)), scale=scale)
+        return out[:, None]
     kv = k_cache.shape[2]
     rep = h // kv
     qg = q.reshape(b, t, kv, rep, d)
-    s = jnp.einsum("btkgd,bskd->bkgts", qg, k_cache,
+    if k_scale is not None:
+        k_op = k_cache.astype(q.dtype)                   # fuses into dot
+    else:
+        k_op = k_cache
+    s = jnp.einsum("btkgd,bskd->bkgts", qg, k_op,
                    preferred_element_type=jnp.float32) * scale
+    if k_scale is not None:
+        # [B, S, KV] → [B, KV, 1, 1, S]: one multiply on the score tensor
+        s = s * k_scale.transpose(0, 2, 1)[:, :, None, None, :]
     k_pos = jnp.arange(k_cache.shape[1])
     mask = q_pos[:, None] >= k_pos[None, :]              # [T, S_max]
     s = jnp.where(mask[None, None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bkgts,bskd->btkgd", p.astype(v_cache.dtype), v_cache,
+    if v_scale is not None:
+        p = p * v_scale.transpose(0, 2, 1)[:, :, None, None, :]
+        v_op = v_cache.astype(q.dtype)
+    else:
+        v_op = v_cache
+    out = jnp.einsum("bkgts,bskd->btkgd", p.astype(q.dtype), v_op,
                      preferred_element_type=jnp.float32)
     return out.reshape(b, t, h, d).astype(q.dtype)
 

@@ -51,7 +51,7 @@ import jax.numpy as jnp
 
 from ..parallel.sharding import ShardingRules
 from .burnin import BurnInConfig
-from .decode import forward_cached, init_cache
+from .decode import cache_rows, forward_cached, init_cache
 
 
 def _stacked_cache(cfg: BurnInConfig, slots: int, max_len: int,
@@ -98,7 +98,8 @@ def _stacked_cache(cfg: BurnInConfig, slots: int, max_len: int,
         return jax.jit(lambda: jnp.zeros(shape, dtype),
                        out_shardings=sharding)()
 
-    kv_shape = (slots, 1, max_len, cfg.kv_heads, cfg.head_dim)
+    kv_shape = (slots, 1, cache_rows(max_len, cache_dtype),
+                cfg.kv_heads, cfg.head_dim)
     buf_dtype = jnp.int8 if quant else cfg.dtype
     stacked: dict[str, Any] = {
         "k": [zeros(kv_shape, buf_dtype, s5) for _ in range(cfg.n_layers)],

@@ -1,0 +1,195 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Pallas TPU decode attention over an int8 KV cache: flash-decode with
+in-kernel dequant, so int8 cache bytes are ALL that cross HBM per step.
+
+The long-context serving step is KV-cache-bandwidth-bound: at [8, 3584+]
+rows the bf16 cache is ~2.4 GB read per token while the (int8) weights
+are 0.4 GB (``models/decode.py``). Quantising the cache to int8 halves
+those bytes — but only if int8 is what actually crosses HBM. The jnp
+path gets partway there by applying the scales AFTER the contractions
+(``_cached_attention``), yet XLA still materialises converted operands
+at long S (measured: int8 KV 2185 tok/s vs bf16 2132 at S=3616 — parity,
+not the ~1.7× the byte math promises). This kernel removes the choice,
+exactly as ``ops/int8_matmul.py`` does for the weights: cache tiles load
+as int8 into VMEM, the int8→bf16 convert happens right before each MXU
+dot, and the per-vector scales fold into the scores / probabilities —
+``q·(k_q·s_k) = (q·k_q)·s_k`` and ``Σ_s p_s·(v_q·s_v)_s =
+Σ_s (p_s·s_v,s)·v_q_s`` — which are [.., S] and tiny next to the
+[.., S, D] cache.
+
+Shape discipline (flash-decode recurrence, same VMEM model as
+``ops/flash_attention.py``):
+
+- grid (B, KV heads, S-blocks); the S sweep is innermost so the f32
+  online-softmax state (m, l, acc) lives in VMEM scratch across it;
+- the query is ONE token per batch row ([B, H, D], T=1 — the decode
+  step; prefill and [1, k+1] verification keep the jnp path);
+- GQA: queries reshape to [KV, rep, D] groups and contract against the
+  un-repeated cache — scores are [rep, block_s] per tile;
+- per-row positions: ``pos [B]`` (int32, SMEM) masks keys at
+  ``s > pos`` — per-slot positions of the continuous-batching pool come
+  for free; S-blocks entirely past ``pos`` are SKIPPED with ``pl.when``
+  (no FLOPs, no DMA use), which also skips the ragged tail past S and
+  keeps the first block always-live so the running max never sees a
+  fully-dead update (the exp(-inf - -inf) NaN).
+
+Reference analogue: none — the reference provisions serving infra and
+never touches model bytes (``/root/reference/gke/README.md:50``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _kernel(pos_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
+            m_scr, l_scr, acc_scr, *, scale, block_s, s_total, kv, rep):
+    """One (batch row, S-block) tile: every KV head of the block.
+
+    The cache tile keeps its native [block_s, KV, D] layout (a head-major
+    relayout would cost a full-cache transpose per step in HBM); the
+    per-head [rep, D]×[block_s, D] dots are tiny, but the op is
+    cache-bandwidth-bound so MXU utilisation is irrelevant — what
+    matters is that the tile is DMA'd once, as int8. Head slicing
+    happens on the LANE axis (reshape to [block_s, KV·D], 128-multiple
+    column slices), which Mosaic handles natively; per-head scores stack
+    to [KV·rep, block_s] so the online-softmax state update stays one
+    vectorised operation."""
+    bi, si, ns = pl.program_id(0), pl.program_id(1), pl.num_programs(1)
+
+    @pl.when(si == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    pos = pos_ref[bi]
+    d = k_ref.shape[-1]
+    hq = kv * rep
+
+    def _per_head(xt):
+        # [KV, bs] f32 (pre-transposed by the wrapper — an in-kernel
+        # sublane↔lane transpose per tile was the kernel's single
+        # biggest cost) → [KV·rep, bs]: sublane-repeat per query group
+        return jnp.broadcast_to(xt[:, None, :],
+                                (kv, rep, block_s)).reshape(hq, block_s)
+
+    # the whole block is dead iff its first key is past this row's
+    # position (pos < S always, so this also kills the ragged tail)
+    @pl.when(si * block_s <= pos)
+    def _live():
+        # q arrives BLOCK-DIAGONAL [KV·rep, KV·D] (built per step in the
+        # wrapper — 64 KB): one MXU dot computes every head's scores
+        # against the tile in its native [bs, KV·D] layout, no per-head
+        # loop, no head-major cache transpose
+        qbd = q_ref[0]
+        k2 = k_ref[0].astype(qbd.dtype).reshape(block_s, kv * d)
+        v2 = v_ref[0].astype(qbd.dtype).reshape(block_s, kv * d)
+        s = jax.lax.dot_general(
+            qbd, k2, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [KV·rep, bs]
+        s = s * _per_head(ks_ref[0])                      # fold k scales
+        s_idx = si * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where((s_idx <= pos) & (s_idx < s_total), s, NEG_INF)
+
+        m_prev, l_prev = m_scr[:], l_scr[:]               # [KV·rep, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = (p * _per_head(vs_ref[0])).astype(qbd.dtype)  # fold v scales
+        # one dot against the whole tile computes every (query-head ×
+        # value-head) pair; the diagonal band — each query head with ITS
+        # value head — is selected with a static one-hot reduce
+        full = jax.lax.dot_general(
+            pv, v2, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [KV·rep, KV·D]
+        f3 = full.reshape(hq, kv, d)
+        rowk = jax.lax.broadcasted_iota(jnp.int32, (hq, kv), 0) // rep
+        colk = jax.lax.broadcasted_iota(jnp.int32, (hq, kv), 1)
+        sel = (rowk == colk).astype(jnp.float32)[:, :, None]
+        acc_scr[:] = acc_scr[:] * alpha + jnp.sum(f3 * sel, axis=1)
+        m_scr[:] = m_new
+
+    @pl.when(si == ns - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:] / l_scr[:]).astype(
+            o_ref.dtype).reshape(o_ref.shape[1:])
+
+
+def int8_kv_decode_attention(q, k_cache, k_scale, v_cache, v_scale, pos,
+                             *, scale: float, block_s: int = 1024,
+                             interpret: bool | None = None):
+    """One decode step of attention over an int8 cache.
+
+    ``q [B, H, D]`` (compute dtype) attends over ``k_cache``/``v_cache``
+    ``[B, S, KV, D]`` int8 with per-vector f32 ``k_scale``/``v_scale``
+    ``[B, S, KV]``; ``pos [B]`` int32 gives each row's query position
+    (keys at ``s <= pos`` participate). Returns ``[B, H, D]`` in
+    ``q.dtype``. ``H`` must be a multiple of ``KV``; ``D`` a lane
+    multiple (128).
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    b, h, d = q.shape
+    _, s_total, kv, _ = k_cache.shape
+    rep = h // kv
+    qg = q.reshape(b, kv, rep, d)
+    pos = jnp.asarray(pos, jnp.int32).reshape(b)
+    # S must tile EXACTLY: a ragged tail block would clamp its start
+    # index and silently read earlier rows under the mask. init_cache
+    # rounds int8 buffers to a 256-row grain; shrink to a divisor for
+    # smaller/odd buffers and refuse when none exists.
+    block_s = next(
+        (bs for bs in (min(block_s, s_total), 256, 128, 64, 32, 16, 8)
+         if bs % 8 == 0 and s_total % bs == 0), 0)
+    if not block_s:
+        raise ValueError(
+            f"cache rows ({s_total}) need an 8-multiple block divisor "
+            f"for the int8 decode kernel (init_cache rounds to 256)")
+    ns = s_total // block_s
+
+    # block-diagonal query: row k·rep+g carries head (k, g) in the d-band
+    # of KV head k, so ONE dot against the [bs, KV·D]-shaped cache tile
+    # contracts every head exactly (64 KB of h2d per step — negligible)
+    eye = jnp.eye(kv, dtype=q.dtype)
+    qbd = (qg[:, :, :, None, :] * eye[None, :, None, :, None]).reshape(
+        b, kv * rep, kv * d)
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=scale, block_s=block_s,
+                          s_total=s_total, kv=kv, rep=rep),
+        grid=(b, ns),
+        in_specs=[
+            # whole [B] vector in SMEM (rank-1 blocks must span the
+            # array on TPU); the kernel indexes it by program_id(0)
+            pl.BlockSpec((b,), lambda bi, si: (0,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, kv * rep, kv * d), lambda bi, si: (bi, 0, 0)),
+            pl.BlockSpec((1, block_s, kv, d), lambda bi, si: (bi, si, 0, 0)),
+            pl.BlockSpec((1, kv, block_s), lambda bi, si: (bi, 0, si)),
+            pl.BlockSpec((1, block_s, kv, d), lambda bi, si: (bi, si, 0, 0)),
+            pl.BlockSpec((1, kv, block_s), lambda bi, si: (bi, 0, si)),
+        ],
+        out_specs=pl.BlockSpec((1, kv * rep, d), lambda bi, si: (bi, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, kv * rep, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((kv * rep, 1), jnp.float32),  # running max m
+            pltpu.VMEM((kv * rep, 1), jnp.float32),  # running normaliser l
+            pltpu.VMEM((kv * rep, d), jnp.float32),  # output accumulator
+        ],
+        interpret=interpret,
+    )(pos, qbd, k_cache,
+      jnp.asarray(k_scale, jnp.float32).swapaxes(1, 2), v_cache,
+      jnp.asarray(v_scale, jnp.float32).swapaxes(1, 2))
+    return out.reshape(b, h, d)

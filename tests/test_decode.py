@@ -361,13 +361,19 @@ def test_quantize_kv_roundtrip_bound():
 
 
 def test_int8_cache_structure_and_dtypes():
+    from nvidia_terraform_modules_tpu.models.decode import cache_rows
+
     # GQA config on purpose: the scale sidecar is per KV head (the cache
     # only stores KV heads), not per query head
     cfg = BurnInConfig(**{**CFG, "n_kv_heads": 2})
     cache = init_cache(cfg, 2, 24, cache_dtype="int8")
     assert cache["k"][0].dtype == jnp.int8
-    assert cache["k"][0].shape == (2, 24, cfg.kv_heads, cfg.head_dim)
-    assert cache["v_scale"][0].shape == (2, 24, cfg.kv_heads)
+    # int8 buffers round rows up to the decode kernel's 256-row grain
+    # (cache_rows); the extra rows sit above pos, masked forever
+    rows = cache_rows(24, "int8")
+    assert rows == 256
+    assert cache["k"][0].shape == (2, rows, cfg.kv_heads, cfg.head_dim)
+    assert cache["v_scale"][0].shape == (2, rows, cfg.kv_heads)
     with pytest.raises(ValueError, match="cache_dtype"):
         init_cache(cfg, 2, 24, cache_dtype="fp8")
 

@@ -1,0 +1,123 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""int8-KV flash-decode kernel vs the dequantise-then-attend oracle.
+
+The kernel (``ops/decode_attention.py``) runs in interpret mode here;
+the oracle is the jnp scale-after-dot path it replaces on TPU
+(``models/decode.py::_cached_attention``). Exactness expectations are
+fp-tolerance, not bit equality: the kernel's online softmax re-orders
+the reduction.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nvidia_terraform_modules_tpu.models.decode import quantize_kv
+from nvidia_terraform_modules_tpu.ops.decode_attention import (
+    int8_kv_decode_attention,
+)
+
+
+def _oracle(q, k8, ks, v8, vs, pos, scale):
+    b, h, d = q.shape
+    kv = k8.shape[2]
+    k = k8.astype(jnp.float32) * ks[..., None]
+    v = v8.astype(jnp.float32) * vs[..., None]
+    qg = q.astype(jnp.float32).reshape(b, kv, h // kv, d)
+    s = jnp.einsum("bkgd,bskd->bkgs", qg, k) * scale
+    mask = jnp.arange(k.shape[1])[None] <= pos[:, None]      # [B, S]
+    s = jnp.where(mask[:, None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgs,bskd->bkgd", p, v)
+    return out.reshape(b, h, d)
+
+
+def _setup(b, s, h, kv, d, key=0):
+    ks = jax.random.split(jax.random.PRNGKey(key), 4)
+    q = jax.random.normal(ks[0], (b, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, kv, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, kv, d), jnp.float32)
+    k8, k_s = quantize_kv(k)
+    v8, v_s = quantize_kv(v)
+    pos = jax.random.randint(ks[3], (b,), 0, s)
+    return q, k8, k_s, v8, v_s, pos
+
+
+@pytest.mark.parametrize("h,kv", [(4, 4), (8, 2)])
+def test_matches_oracle_mha_and_gqa(h, kv):
+    q, k8, ks, v8, vs, pos = _setup(3, 64, h, kv, 128)
+    got = int8_kv_decode_attention(q, k8, ks, v8, vs, pos,
+                                   scale=128 ** -0.5, block_s=32,
+                                   interpret=True)
+    want = _oracle(q, k8, ks, v8, vs, pos, 128 ** -0.5)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_odd_row_count_shrinks_block_to_divisor():
+    # S=72 has no 32-divisor; the kernel must shrink to 8 (72 = 8×9)
+    # rather than run a ragged tail block (whose clamped start would
+    # silently read earlier rows under the mask)
+    q, k8, ks, v8, vs, _ = _setup(2, 72, 4, 4, 128, key=1)
+    pos = jnp.asarray([71, 70], jnp.int32)      # live keys reach the tail
+    got = int8_kv_decode_attention(q, k8, ks, v8, vs, pos,
+                                   scale=128 ** -0.5, block_s=32,
+                                   interpret=True)
+    want = _oracle(q, k8, ks, v8, vs, pos, 128 ** -0.5)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_indivisible_row_count_refuses():
+    q, k8, ks, v8, vs, pos = _setup(1, 12, 4, 4, 128, key=4)
+    with pytest.raises(ValueError, match="block divisor"):
+        int8_kv_decode_attention(q, k8, ks, v8, vs, pos,
+                                 scale=128 ** -0.5, interpret=True)
+
+
+def test_early_positions_skip_dead_blocks():
+    # pos=0: only the first key participates; later blocks are skipped
+    q, k8, ks, v8, vs, _ = _setup(2, 96, 4, 4, 128, key=2)
+    pos = jnp.asarray([0, 5], jnp.int32)
+    got = int8_kv_decode_attention(q, k8, ks, v8, vs, pos,
+                                   scale=128 ** -0.5, block_s=32,
+                                   interpret=True)
+    want = _oracle(q, k8, ks, v8, vs, pos, 128 ** -0.5)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_vmap_composes():
+    # the serve engine vmaps single-row attention over the slot pool
+    q, k8, ks, v8, vs, pos = _setup(4, 48, 4, 4, 128, key=3)
+    f = lambda qq, kk, kss, vv, vss, pp: int8_kv_decode_attention(
+        qq[None], kk[None], kss[None], vv[None], vss[None], pp[None],
+        scale=128 ** -0.5, block_s=16, interpret=True)[0]
+    got = jax.vmap(f)(q, k8, ks, v8, vs, pos)
+    want = _oracle(q, k8, ks, v8, vs, pos, 128 ** -0.5)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_cached_attention_gate_routes_through_kernel():
+    """The TPU-only dispatch glue in _cached_attention (q slicing, pos
+    broadcast, output reshape) must stay testable off-chip: force the
+    gate and pin greedy int8 decode against the jnp path's tokens."""
+    from nvidia_terraform_modules_tpu.models import (
+        BurnInConfig,
+        greedy_decode,
+        init_params,
+    )
+    from nvidia_terraform_modules_tpu.models import decode as decode_mod
+
+    cfg = BurnInConfig(vocab=64, d_model=256, n_heads=2, d_ff=64,
+                       n_layers=2, seq_len=16, batch=2,
+                       dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0,
+                                cfg.vocab)
+    want = greedy_decode(params, prompt, 6, cfg, cache_dtype="int8")
+    decode_mod._FORCE_DECODE_KERNEL = True
+    try:
+        got = greedy_decode(params, prompt, 6, cfg, cache_dtype="int8")
+    finally:
+        decode_mod._FORCE_DECODE_KERNEL = False
+    assert jnp.array_equal(want, got), (want, got)
