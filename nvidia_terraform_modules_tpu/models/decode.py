@@ -218,15 +218,8 @@ def _cached_attention(q, k_cache, v_cache, q_pos, scale,
     [8, 3584+] rows). After the restructure only int8 cache bytes cross
     HBM; the convert-in-dot is XLA operand fusion's easy case.
     """
-    from jax._src.interpreters.batching import BatchTracer
-
     b, t, h, d = q.shape
     if (k_scale is not None and t == 1 and d % 128 == 0
-            # under vmap (the serve engine's slot pool) pallas batching
-            # prepends a dim to the rank-1 SMEM pos block, which the TPU
-            # lowering rejects — batched callers keep the jnp path until
-            # the kernel grows native pool support
-            and not isinstance(q, BatchTracer)
             and (_FORCE_DECODE_KERNEL
                  or jax.devices()[0].platform == "tpu")):
         # the T=1 int8 step is the long-context hot path: the pallas

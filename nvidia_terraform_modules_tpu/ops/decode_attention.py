@@ -27,7 +27,8 @@ Shape discipline (flash-decode recurrence, same VMEM model as
   step; prefill and [1, k+1] verification keep the jnp path);
 - GQA: queries reshape to [KV, rep, D] groups and contract against the
   un-repeated cache — scores are [rep, block_s] per tile;
-- per-row positions: ``pos [B]`` (int32, SMEM) masks keys at
+- per-row positions: ``pos [B]`` (int32, broadcast to a lane-wide
+  VMEM operand — vmap-safe) masks keys at
   ``s > pos`` — per-slot positions of the continuous-batching pool come
   for free; S-blocks entirely past ``pos`` are SKIPPED with ``pl.when``
   (no FLOPs, no DMA use), which also skips the ragged tail past S and
@@ -63,7 +64,7 @@ def _kernel(pos_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
     column slices), which Mosaic handles natively; per-head scores stack
     to [KV·rep, block_s] so the online-softmax state update stays one
     vectorised operation."""
-    bi, si, ns = pl.program_id(0), pl.program_id(1), pl.num_programs(1)
+    si, ns = pl.program_id(1), pl.num_programs(1)
 
     @pl.when(si == 0)
     def _init():
@@ -71,7 +72,7 @@ def _kernel(pos_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    pos = pos_ref[bi]
+    pos = pos_ref[0, 0, 0]
     d = k_ref.shape[-1]
     hq = kv * rep
 
@@ -171,10 +172,12 @@ def int8_kv_decode_attention(q, k_cache, k_scale, v_cache, v_scale, pos,
                           s_total=s_total, kv=kv, rep=rep),
         grid=(b, ns),
         in_specs=[
-            # whole [B] vector in SMEM (rank-1 blocks must span the
-            # array on TPU); the kernel indexes it by program_id(0)
-            pl.BlockSpec((b,), lambda bi, si: (0,),
-                         memory_space=pltpu.SMEM),
+            # per-row position as a [B, 1, 128] VMEM operand: the block's
+            # trailing (1, 128) dims equal the array's, which stays legal
+            # for ANY batch — including the extra leading dim jax.vmap
+            # prepends when the serving pool batches this call (a rank-1
+            # SMEM block breaks exactly there)
+            pl.BlockSpec((1, 1, 128), lambda bi, si: (bi, 0, 0)),
             pl.BlockSpec((1, kv * rep, kv * d), lambda bi, si: (bi, 0, 0)),
             pl.BlockSpec((1, block_s, kv, d), lambda bi, si: (bi, si, 0, 0)),
             pl.BlockSpec((1, kv, block_s), lambda bi, si: (bi, 0, si)),
@@ -189,7 +192,7 @@ def int8_kv_decode_attention(q, k_cache, k_scale, v_cache, v_scale, pos,
             pltpu.VMEM((kv * rep, d), jnp.float32),  # output accumulator
         ],
         interpret=interpret,
-    )(pos, qbd, k_cache,
+    )(jnp.broadcast_to(pos[:, None, None], (b, 1, 128)), qbd, k_cache,
       jnp.asarray(k_scale, jnp.float32).swapaxes(1, 2), v_cache,
       jnp.asarray(v_scale, jnp.float32).swapaxes(1, 2))
     return out.reshape(b, h, d)
