@@ -482,6 +482,20 @@ def section_serve() -> dict:
     spec_dt = _time.perf_counter() - t0
     accept = (spec.last_stats or {}).get("accepted_per_step")
 
+    # the full QUANTIZED engine: int8 weights + int8 KV pool (the
+    # pallas decode kernel under the slot vmap) — the end-to-end number
+    # for the int8 serving stack, vs the per-step decode_int8 section
+    from nvidia_terraform_modules_tpu.models import quantize_params
+
+    qparams = quantize_params(params, dtype=srv_cfg.dtype)
+    q_engine = make_serve_engine(qparams, srv_cfg, max_len=max_len,
+                                 cache_dtype="int8")
+    sync_outs(q_engine([prompts[0], prompts[1]], 2, slots=slots))
+    sync_outs(q_engine(prompts, n_new, slots=slots))
+    t0 = _time.perf_counter()
+    sync_outs(q_engine(prompts, n_new, slots=slots))
+    int8_dt = _time.perf_counter() - t0
+
     # the plain baseline is the FIRST timed pass: greedy serve cost is
     # content-independent at fixed length buckets/slots/n_new, so
     # re-timing it on the templated prompts would just repeat dt
@@ -489,6 +503,7 @@ def section_serve() -> dict:
         "serve_tokens_per_s": round(n_req * n_new / dt, 1),
         "serve_requests": n_req,
         "serve_slots": slots,
+        "serve_int8_tokens_per_s": round(n_req * n_new / int8_dt, 1),
         "serve_spec_tokens_per_s": round(n_req * n_new / spec_dt, 1),
         "serve_spec_speedup": round(dt / spec_dt, 2),
         "serve_spec_accept_per_step": accept,

@@ -112,7 +112,7 @@ class QTensor:
                 * self.scale[idx][..., None]).astype(self.dtype)
 
     def __rmatmul__(self, x):
-        from ..ops.int8_matmul import int8_matmul, int8_matmul_ref
+        from ..ops.int8_matmul import int8_matmul_ref
 
         lead, k_dim = x.shape[:-1], x.shape[-1]
         x2 = x.reshape(-1, k_dim)
@@ -132,20 +132,54 @@ class QTensor:
         if k != k_dim:
             raise ValueError(
                 f"contraction mismatch: x {x.shape} @ qtensor {self.shape}")
-        if _kernel_ok(k, n):
-            out = int8_matmul(x2, self.q, scale, transpose_rhs=transpose_rhs)
+        # the kernel path is vmap-safe via a custom_vmap rule: a batched
+        # call (the serve engine's slot pool) collapses the vmap axis
+        # into M and streams the weights ONCE, instead of pallas
+        # batching re-fetching the same tiles per instance
+        if _kernel_ok(x2.shape[0], k, n):
+            out = _kernel_mm(transpose_rhs)(x2, self.q, scale)
         else:
             out = int8_matmul_ref(x2, self.q, scale,
                                   transpose_rhs=transpose_rhs)
         return out.reshape(*lead, n)
 
 
-def _kernel_ok(k: int, n: int) -> bool:
-    """Use the pallas kernel iff on real TPU and the dims tile (the lane
-    axis needs 128-multiples; blocks are chosen inside the kernel)."""
+_KERNEL_MM: dict[bool, Any] = {}
+
+
+def _kernel_mm(transpose_rhs: bool):
+    """Batch-collapsing kernel wrapper, one per transpose flag (cached so
+    the custom_vmap identity — and its jit cache — is stable)."""
+    if transpose_rhs not in _KERNEL_MM:
+        import functools as _ft
+
+        from ..ops.int8_matmul import (
+            int8_matmul,
+            int8_matmul_ref,
+            make_batch_collapsing,
+        )
+
+        _KERNEL_MM[transpose_rhs] = make_batch_collapsing(
+            _ft.partial(int8_matmul, transpose_rhs=transpose_rhs),
+            _ft.partial(int8_matmul_ref, transpose_rhs=transpose_rhs))
+    return _KERNEL_MM[transpose_rhs]
+
+
+def _kernel_ok(m: int, k: int, n: int) -> bool:
+    """Use the pallas kernel iff on real TPU, the dims tile (the lane
+    axis needs 128-multiples; blocks are chosen inside the kernel), and
+    the matmul is in the skinny weight-bandwidth-bound regime the kernel
+    exists for (decode steps, speculative verification). At prefill
+    widths (M in the hundreds) the contraction is compute-bound, XLA's
+    native MXU scheduling wins, and the one-off dequant amortises over
+    every row — measured on-chip: the int8 serve engine's admissions ran
+    ~2x slower through the kernel. The M threshold is a PROXY for
+    prefill-vs-decode: a decode batch above 64 rows would also take the
+    XLA path (conservative — unmeasured territory, and at those widths
+    the per-step dequant amortises 64+ ways anyway)."""
     import jax as _jax
 
-    return (_jax.devices()[0].platform == "tpu"
+    return (m <= 64 and _jax.devices()[0].platform == "tpu"
             and k % 128 == 0 and n % 128 == 0)
 
 
