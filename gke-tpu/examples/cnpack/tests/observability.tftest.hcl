@@ -1,0 +1,87 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+# Semantic pins for the TPU observability composition: the Workload
+# Identity chains (prometheus, fluentbit, CAS issuer) and the private-CA
+# chain shape. These are the values the platform installer consumes — a
+# renamed KSA or pool breaks the handoff with no plan-time error, which
+# is exactly what these asserts exist to catch.
+
+variables {
+  project_id = "test-project"
+}
+
+run "prometheus_identity" {
+  command = plan
+
+  assert {
+    condition     = google_service_account_iam_member.wi_binding.member == "serviceAccount:test-project.svc.id.goog[tpu-monitoring/tpu-prometheus]"
+    error_message = "WI member must bind the tpu-monitoring/tpu-prometheus KSA in the target project"
+  }
+  assert {
+    condition     = google_service_account_iam_member.wi_binding.role == "roles/iam.workloadIdentityUser"
+    error_message = "the KSA impersonates via roles/iam.workloadIdentityUser"
+  }
+  assert {
+    condition     = google_project_iam_member.metric_writer.role == "roles/monitoring.metricWriter"
+    error_message = "the GSA needs metricWriter to remote-write into Managed Prometheus"
+  }
+  assert {
+    condition     = output.monitoring_namespace == "tpu-monitoring"
+    error_message = "the namespace output must match the WI binding's namespace"
+  }
+}
+
+run "cas_chain" {
+  command = plan
+
+  assert {
+    condition     = google_privateca_ca_pool.cnpack[0].name == "tpu-cnpack-ca-pool"
+    error_message = "CAS pool name is derived from cluster_name — the issuer spec references it"
+  }
+  assert {
+    condition     = google_privateca_certificate_authority.cnpack[0].type == "SELF_SIGNED"
+    error_message = "the root CA must be self-signed (it heads the chain)"
+  }
+  assert {
+    condition     = google_privateca_certificate_authority.cnpack[0].lifetime == "31536000s"
+    error_message = "root validity pinned at 1 year (reference aws-pca.tf:36-39 parity)"
+  }
+  assert {
+    condition     = google_service_account_iam_member.cas_issuer_wi[0].member == "serviceAccount:test-project.svc.id.goog[cert-manager/google-cas-issuer]"
+    error_message = "the CAS issuer runs as cert-manager/google-cas-issuer"
+  }
+  assert {
+    condition     = google_privateca_ca_pool_iam_member.cas_issuer_requester[0].role == "roles/privateca.certificateRequester"
+    error_message = "issuing rights are certificateRequester scoped to the pool"
+  }
+}
+
+run "fluentbit_identity" {
+  command = plan
+
+  assert {
+    condition     = google_service_account_iam_member.fluentbit_wi[0].member == "serviceAccount:test-project.svc.id.goog[tpu-monitoring/tpu-fluentbit]"
+    error_message = "Fluent Bit's KSA binding must target tpu-monitoring/tpu-fluentbit"
+  }
+  assert {
+    condition     = google_project_iam_member.fluentbit_log_writer[0].role == "roles/logging.logWriter"
+    error_message = "the log shipper writes via roles/logging.logWriter"
+  }
+}
+
+run "private_ca_disabled_prunes_chain" {
+  command = plan
+
+  variables {
+    private_ca_enabled = false
+  }
+
+  assert {
+    condition     = length(google_privateca_ca_pool.cnpack) == 0
+    error_message = "private_ca_enabled = false must provision no CAS pool"
+  }
+  assert {
+    condition     = output.ca_pool == null
+    error_message = "ca_pool output must be null when the CA is disabled"
+  }
+}

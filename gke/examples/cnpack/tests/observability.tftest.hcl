@@ -1,0 +1,30 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+# Semantic pins for the observability composition: the Workload Identity
+# chain the monitoring stack depends on (the values most likely to rot
+# silently — a renamed namespace/KSA breaks scraping with no plan error).
+
+variables {
+  project_id = "test-project"
+}
+
+run "workload_identity_chain" {
+  command = plan
+
+  assert {
+    condition     = google_service_account_iam_member.wi_binding.member == "serviceAccount:test-project.svc.id.goog[nvidia-monitoring/nvidia-prometheus]"
+    error_message = "WI member must bind the nvidia-monitoring/nvidia-prometheus KSA in the target project"
+  }
+  assert {
+    condition     = google_service_account_iam_member.wi_binding.role == "roles/iam.workloadIdentityUser"
+    error_message = "the KSA impersonates via roles/iam.workloadIdentityUser"
+  }
+  assert {
+    condition     = google_project_iam_member.metric_writer.role == "roles/monitoring.metricWriter"
+    error_message = "the GSA needs metricWriter to remote-write into Managed Prometheus"
+  }
+  assert {
+    condition     = output.monitoring_namespace == "nvidia-monitoring"
+    error_message = "the namespace output must match the WI binding's namespace"
+  }
+}

@@ -193,7 +193,7 @@ _FORCE_DECODE_KERNEL = False
 
 
 def _cached_attention(q, k_cache, v_cache, q_pos, scale,
-                      k_scale=None, v_scale=None):
+                      k_scale=None, v_scale=None, int8_kernel=True):
     """Attention of ``q`` ``[B, T, H, D]`` over the full cache buffer.
 
     ``q_pos`` ``[T]`` are the global positions of the query tokens; cache
@@ -219,7 +219,14 @@ def _cached_attention(q, k_cache, v_cache, q_pos, scale,
     HBM; the convert-in-dot is XLA operand fusion's easy case.
     """
     b, t, h, d = q.shape
+    # kernel gate: ``int8_kernel=False`` when the cache operands may be
+    # mesh-sharded (a pallas_call on sharded inputs inside jit without
+    # shard_map can fail to lower or silently gather the pool — the
+    # caller that knows the sharding owns the flag); the 8-multiple
+    # check falls hand-built odd buffers (S=12) through to the jnp path
+    # the kernel's block tiling would refuse at trace time
     if (k_scale is not None and t == 1 and d % 128 == 0
+            and int8_kernel and k_cache.shape[1] % 8 == 0
             and (_FORCE_DECODE_KERNEL
                  or jax.devices()[0].platform == "tpu")):
         # the T=1 int8 step is the long-context hot path: the pallas
@@ -262,7 +269,7 @@ def _cached_attention(q, k_cache, v_cache, q_pos, scale,
 
 def forward_cached(params, tokens, cache, cfg: BurnInConfig,
                    rules: ShardingRules | None = None, *,
-                   prefill_impl: str = "dense"):
+                   prefill_impl: str = "dense", int8_kernel: bool = True):
     """Forward ``tokens`` ``[B, T]`` starting at ``cache["pos"]``.
 
     Writes the new K/V rows into the cache and returns
@@ -275,6 +282,13 @@ def forward_cached(params, tokens, cache, cfg: BurnInConfig,
     ``dynamic_update_slice`` would clamp the start index and silently
     overwrite the last cache rows — XLA has no traced-shape way to raise
     here, which is why the guard must live at the Python level.
+
+    ``int8_kernel=False`` keeps the T=1 int8-cache step on the jnp path
+    even on TPU — required when the CACHE operands are mesh-sharded by a
+    caller this function cannot see (the serving pool: ``rules`` here is
+    None while the stacked cache is sharded). With ``rules`` set the
+    kernel is disabled automatically: the sharded solo-decode cache is
+    the same hazard.
 
     ``prefill_impl="flash"`` runs the T>1 prompt attention through the
     fused pallas kernel instead of masked scores over the full cache
@@ -367,7 +381,9 @@ def forward_cached(params, tokens, cache, cfg: BurnInConfig,
             attn = _cached_attention(q, k, v, q_pos, scale)
         else:
             attn = _cached_attention(q, k_cache, v_cache, q_pos, scale,
-                                     k_scale, v_scale)
+                                     k_scale, v_scale,
+                                     int8_kernel=int8_kernel
+                                     and rules is None)
         attn = attn.reshape(b, t, cfg.d_model)
         x = x + act(attn @ layer["wo"], None, None)
 

@@ -133,9 +133,11 @@ class QTensor:
             raise ValueError(
                 f"contraction mismatch: x {x.shape} @ qtensor {self.shape}")
         # the kernel path is vmap-safe via a custom_vmap rule: a batched
-        # call (the serve engine's slot pool) collapses the vmap axis
-        # into M and streams the weights ONCE, instead of pallas
-        # batching re-fetching the same tiles per instance
+        # call (the serve engine's slot pool) routes to the ref
+        # dequant-dot, which XLA schedules with ONE weight stream —
+        # measured faster than both pallas vmap-batching (per-instance
+        # tile re-fetch) and collapsing the vmap axis into M (see
+        # ops/int8_matmul.with_ref_batching)
         if _kernel_ok(x2.shape[0], k, n):
             out = _kernel_mm(transpose_rhs)(x2, self.q, scale)
         else:
@@ -156,10 +158,10 @@ def _kernel_mm(transpose_rhs: bool):
         from ..ops.int8_matmul import (
             int8_matmul,
             int8_matmul_ref,
-            make_batch_collapsing,
+            with_ref_batching,
         )
 
-        _KERNEL_MM[transpose_rhs] = make_batch_collapsing(
+        _KERNEL_MM[transpose_rhs] = with_ref_batching(
             _ft.partial(int8_matmul, transpose_rhs=transpose_rhs),
             _ft.partial(int8_matmul_ref, transpose_rhs=transpose_rhs))
     return _KERNEL_MM[transpose_rhs]

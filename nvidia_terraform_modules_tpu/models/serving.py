@@ -140,11 +140,17 @@ def _make_pick(sampler):
     return pick
 
 
-def make_serve_step(params, cfg: BurnInConfig, sampler=None):
+def make_serve_step(params, cfg: BurnInConfig, sampler=None, *,
+                    int8_kernel: bool = True):
     """Compiled all-slots decode step with per-slot positions. The
     pooled cache is DONATED — the step updates it in place rather than
     paying a full-pool copy per token (the bandwidth a slot engine
     exists to save).
+
+    ``int8_kernel=False`` keeps an int8 pool's attention on the jnp
+    path: the engine passes it whenever the pool is mesh-sharded
+    (``rules``), where a pallas_call on sharded operands inside jit is
+    not a supported lowering (see ``forward_cached``).
 
     Greedy (``sampler=None``): ``(tokens [slots], cache) → (next,
     cache)``. Sampled: ``(tokens, keys [slots, 2], cache) → ...`` —
@@ -162,7 +168,8 @@ def make_serve_step(params, cfg: BurnInConfig, sampler=None):
     # device-resident.
     def row(p, tok, key, cache):
         logits, cache = forward_cached(p, tok[None, None], cache, cfg,
-                                       prefill_impl="cached")
+                                       prefill_impl="cached",
+                                       int8_kernel=int8_kernel)
         return pick(logits, -1, key), cache
 
     vrow = jax.vmap(row, in_axes=(None, 0, 0, 0))
@@ -276,11 +283,23 @@ def make_spec_step(params, cfg: BurnInConfig, k: int):
 
         def body(s):
             ctx, cur, n_out, fin, steps, stacked = s
+            # frozen = finished OR never-active: an inactive slot's
+            # stale ctx/cur must not keep growing across iterations
+            # (cur would drift toward the buffer end and lean on
+            # dynamic_update_slice clamping for safety) — freeze it
+            # exactly like a finished slot; admission re-seeds both
+            frozen = fin | ~active
             nctx, ncur, nn_out, done, nstacked = vrow(
                 p, ctx, cur, n_out, n_new, eos_id, stacked)
-            ctx = jnp.where(fin[:, None], ctx, nctx)
-            cur = jnp.where(fin, cur, ncur)
-            n_out = jnp.where(fin, n_out, nn_out)
+            ctx = jnp.where(frozen[:, None], ctx, nctx)
+            cur = jnp.where(frozen, cur, ncur)
+            n_out = jnp.where(frozen, n_out, nn_out)
+            # the cache's per-slot pos freezes too (cheap [slots] mask);
+            # the k/v buffer writes a frozen slot's forward produced are
+            # idempotent re-writes of the same rows (inputs frozen) and
+            # are fully overwritten at the slot's next admission
+            nstacked["pos"] = jnp.where(frozen, stacked["pos"],
+                                        nstacked["pos"])
             # count BEFORE updating fin: a slot's finishing step is a
             # real verification step; frozen iterations are not
             steps = steps + jnp.sum(active & ~fin)
@@ -375,6 +394,16 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
     results are chunk-size-INVARIANT but can differ from unchunked
     int8 admission within quantisation noise.
 
+    Int8-weight params (``quantize_params`` trees with QTensor leaves)
+    serve through a PREFILL/DECODE PHASE SPLIT: admissions run from a
+    dequantised compute-dtype copy built once here (prompt-width
+    matmuls are compute-bound, where dequant-dot loses to a plain
+    matmul), decode/verification steps from the int8 tree (weight-
+    bandwidth-bound, where int8 HBM bytes win). Costs one extra
+    weight-set residency (int8 + bf16 = 3 bytes/weight); tokens equal
+    the all-int8 engine exactly at f32 compute dtype and within one
+    bf16 weight-rounding otherwise.
+
     ``spec_k`` turns on SPECULATIVE continuous batching (greedy only):
     every step drafts ``k`` tokens per slot by prompt lookup in that
     slot's own context and verifies them in one ``[1, k+1]`` cached
@@ -406,8 +435,44 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 "speculative serving is greedy-only: acceptance tests "
                 "the model's argmax chain — drop sampler or spec_k")
     pick = _make_pick(sampler)
-    prefill = make_prefill(params, cfg, max_len, cache_dtype, sampler)
-    step = make_serve_step(params, cfg, sampler)
+    from .quantize import QTensor
+
+    def _is_q(x):
+        return isinstance(x, QTensor)
+
+    prefill_params = params
+    if any(_is_q(x) for x in jax.tree.leaves(params, is_leaf=_is_q)):
+        # PREFILL/DECODE PHASE SPLIT for int8-weight params: admission
+        # is compute-bound (prompt-width matmuls route past the M<=64
+        # kernel gate to XLA's dequant-dot, which is SLOWER than a bf16
+        # matmul — measured 0.72-0.90x end-to-end, BENCH_r04), while
+        # decode steps are weight-bandwidth-bound (int8 bytes win). So
+        # the engine dequantises ONCE at build into a resident compute-
+        # dtype tree and serves every admission path (prefill, chunked
+        # prefill, prefix/suffix fill) from it; decode and verification
+        # steps keep the int8 tree. Residency cost: int8 + bf16 copies
+        # = 3 bytes/weight vs pure bf16's 2 — the throughput trade the
+        # split exists for. Numerics: admission logits now come from
+        # dequant-rounded compute-dtype weights instead of the in-dot
+        # f32 dequant — identical when compute dtype is f32 (CPU tests
+        # pin engine tokens == solo quantized decode there), within
+        # one bf16 rounding of the weight product on TPU.
+        prefill_params = jax.tree.map(
+            lambda x: x.dequantize() if _is_q(x) else x, params,
+            is_leaf=_is_q)
+    prefill = make_prefill(prefill_params, cfg, max_len, cache_dtype,
+                           sampler)
+    # the all-slots step is built per int8-kernel flag on first use: a
+    # mesh-sharded int8 pool must keep the jnp attention path (pallas on
+    # sharded operands — see make_serve_step), and only run() sees rules
+    _steps: dict[bool, Any] = {}
+
+    def step_for(int8_kernel: bool):
+        if int8_kernel not in _steps:
+            _steps[int8_kernel] = make_serve_step(
+                params, cfg, sampler, int8_kernel=int8_kernel)
+        return _steps[int8_kernel]
+
     spec_step = (make_spec_step(params, cfg, spec_k)
                  if spec_k is not None else None)
 
@@ -425,7 +490,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             return pick(logits, last_idx, key), cache
 
         def chunk_fill(chunk, last_idx, cache, key):
-            return _chunk_fill(params, chunk, last_idx, cache, key)
+            return _chunk_fill(prefill_params, chunk, last_idx, cache, key)
     template = None
     prefix_len = 0
     if prefix is not None:
@@ -439,7 +504,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         # not matter — a greedy engine reuses its shared prefill (and
         # its jit cache); only a sampled engine builds a greedy twin
         template_prefill = (prefill if sampler is None else
-                            make_prefill(params, cfg, max_len,
+                            make_prefill(prefill_params, cfg, max_len,
                                          cache_dtype))
         _first, template = template_prefill(prefix[None, :])
 
@@ -451,7 +516,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             return pick(logits, -1, key), cache
 
         def suffix_fill(suffix, cache, key):
-            return _suffix_fill(params, suffix, cache, key)
+            return _suffix_fill(prefill_params, suffix, cache, key)
 
     def admit(prompt, key):
         """(first token, row cache) for one request, via the template
@@ -641,6 +706,9 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         if spec_k is not None:
             return run_spec(prompts, n_new, slots, rules, eos_id)
 
+        # the pallas int8-pool attention only when the pool is
+        # UNSHARDED; a mesh pool keeps the jnp path (see make_serve_step)
+        step = step_for(cache_dtype != "int8" or rules is None)
         stacked = _stacked_cache(cfg, slots, max_len, rules, cache_dtype)
         tokens = jnp.zeros((slots,), jnp.int32)
         queue = deque(enumerate(prompts))

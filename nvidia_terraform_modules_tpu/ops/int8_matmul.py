@@ -154,7 +154,7 @@ def _round_up(v: int, mult: int) -> int:
     return (v + mult - 1) // mult * mult
 
 
-def make_batch_collapsing(kernel_fn, ref_fn):
+def with_ref_batching(kernel_fn, ref_fn):
     """Wrap ``kernel_fn(x, w, scale)`` so ``jax.vmap`` stays efficient.
 
     A vmapped ``pallas_call`` adds a grid dimension whose index maps

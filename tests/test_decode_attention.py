@@ -121,3 +121,51 @@ def test_cached_attention_gate_routes_through_kernel():
     finally:
         decode_mod._FORCE_DECODE_KERNEL = False
     assert jnp.array_equal(want, got), (want, got)
+
+
+def test_cached_attention_gate_falls_back_on_odd_rows():
+    """A hand-built int8 cache whose row count has no 8-multiple block
+    divisor (S=12) must fall through the forced gate to the jnp path —
+    the kernel's trace-time ValueError is for direct callers only."""
+    from nvidia_terraform_modules_tpu.models import decode as decode_mod
+    from nvidia_terraform_modules_tpu.models.decode import (
+        _cached_attention,
+    )
+
+    b, s, kv, d = 2, 12, 4, 128
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(ks[0], (b, 1, kv, d), jnp.float32)
+    k8, k_s = quantize_kv(jax.random.normal(ks[1], (b, s, kv, d)))
+    v8, v_s = quantize_kv(jax.random.normal(ks[2], (b, s, kv, d)))
+    q_pos = jnp.asarray([s - 1], jnp.int32)
+    want = _cached_attention(q, k8, v8, q_pos, d ** -0.5, k_s, v_s)
+    decode_mod._FORCE_DECODE_KERNEL = True
+    try:
+        got = _cached_attention(q, k8, v8, q_pos, d ** -0.5, k_s, v_s)
+    finally:
+        decode_mod._FORCE_DECODE_KERNEL = False
+    assert jnp.array_equal(got, want)
+
+
+def test_cached_attention_gate_respects_int8_kernel_flag():
+    """int8_kernel=False keeps the jnp path even when the forced gate
+    would otherwise fire (the mesh-sharded-pool escape hatch)."""
+    from nvidia_terraform_modules_tpu.models import decode as decode_mod
+    from nvidia_terraform_modules_tpu.models.decode import (
+        _cached_attention,
+    )
+
+    b, s, kv, d = 2, 32, 4, 128
+    ks = jax.random.split(jax.random.PRNGKey(6), 3)
+    q = jax.random.normal(ks[0], (b, 1, kv, d), jnp.float32)
+    k8, k_s = quantize_kv(jax.random.normal(ks[1], (b, s, kv, d)))
+    v8, v_s = quantize_kv(jax.random.normal(ks[2], (b, s, kv, d)))
+    q_pos = jnp.asarray([s - 1], jnp.int32)
+    want = _cached_attention(q, k8, v8, q_pos, d ** -0.5, k_s, v_s)
+    decode_mod._FORCE_DECODE_KERNEL = True
+    try:
+        got = _cached_attention(q, k8, v8, q_pos, d ** -0.5, k_s, v_s,
+                                int8_kernel=False)
+    finally:
+        decode_mod._FORCE_DECODE_KERNEL = False
+    assert jnp.array_equal(got, want)

@@ -501,3 +501,64 @@ def test_serve_validation():
         serve(params, prompts, 4, cfg, slots=2, max_len=6)
     with pytest.raises(ValueError, match="n_new"):
         serve(params, prompts, 0, cfg)
+
+
+def test_serve_int8_weights_phase_split_matches_solo_quantized():
+    """Int8-weight params serve through the prefill/decode phase split
+    (admission from the dequantised tree, steps from the int8 tree) —
+    which must be scheduling, never a different model: at f32 compute
+    dtype the dequantised copy reproduces the in-dot dequant exactly,
+    so engine tokens EQUAL solo quantized greedy decode, for both cache
+    dtypes and through chunked admission."""
+    from nvidia_terraform_modules_tpu.models import quantize_params
+
+    cfg, params, prompts = _setup(n_prompts=4)
+    qparams = quantize_params(params, dtype=jnp.float32)
+    for cache_dtype in ("bf16", "int8"):
+        got = serve(qparams, prompts, 5, cfg, slots=2,
+                    cache_dtype=cache_dtype)
+        want = [greedy_decode(qparams, p[None, :], 5, cfg,
+                              cache_dtype=cache_dtype)[0]
+                for p in prompts]
+        for i, (g, w) in enumerate(zip(got, want)):
+            assert jnp.array_equal(g, w), f"{cache_dtype} request {i}"
+    # chunked admission runs from the dequantised tree too (chunk_fill)
+    got = serve(qparams, prompts, 5, cfg, slots=2, prefill_chunk=4)
+    want = [greedy_decode(qparams, p[None, :], 5, cfg)[0]
+            for p in prompts]
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert jnp.array_equal(g, w), f"chunked request {i}"
+
+
+def test_serve_int8_pool_on_mesh_keeps_jnp_path(jax8):
+    """A mesh-sharded int8 pool must take the jnp attention path even
+    where the pallas decode kernel would otherwise fire (the kernel on
+    sharded operands inside jit is not a supported lowering): with the
+    kernel gate forced on, a sharded-pool serve still runs and still
+    matches solo int8-cache decodes."""
+    from nvidia_terraform_modules_tpu.models import init_params
+    from nvidia_terraform_modules_tpu.models import decode as decode_mod
+    from nvidia_terraform_modules_tpu.parallel import (
+        build_mesh,
+        make_rules,
+        plan_mesh,
+    )
+
+    mesh = build_mesh(plan_mesh(8, tp=2, sp=1))
+    rules = make_rules(mesh)
+    cfg = BurnInConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg, rules)
+    prompts = [jax.random.randint(jax.random.PRNGKey(i),
+                                  (4 + 2 * (i % 2),), 0, cfg.vocab)
+               for i in range(6)]
+    decode_mod._FORCE_DECODE_KERNEL = True
+    try:
+        got = serve(params, prompts, 4, cfg, slots=4, rules=rules,
+                    cache_dtype="int8")
+    finally:
+        decode_mod._FORCE_DECODE_KERNEL = False
+    host_params = jax.tree.map(jnp.asarray, jax.device_get(params))
+    want = [greedy_decode(host_params, jnp.asarray(p)[None, :], 4, cfg,
+                          cache_dtype="int8")[0] for p in prompts]
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert jnp.array_equal(jax.device_get(g), w), f"request {i}"
