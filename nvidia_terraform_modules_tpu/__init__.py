@@ -23,4 +23,4 @@ We replace those runbooks with executable code:
   ``/root/reference/CONTRIBUTING.md:56``).
 """
 
-__version__ = "0.6.0"
+__version__ = "0.7.0"

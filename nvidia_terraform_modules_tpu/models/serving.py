@@ -423,6 +423,28 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
     each call ``engine.last_stats`` reports realised acceptance
     (``generated / slot_steps`` ≥ 1 is the speedup lever vs the plain
     engine's one token per slot-step).
+
+    **When speculation pays — the retirement regime.** Per accepted
+    token the device math wins (a verification iteration costs ~one
+    plain step — traced at 1.17 vs ~1.1 ms on v5e — and emits ~1.9
+    tokens at 1.9 acceptance), but the ENGINE comparison is decided by
+    retirement synchronisation, not FLOPs. Measured (bench
+    ``serve_spec`` section; see README *Measured performance*):
+
+    - **eos traffic** (production serving — variable-length outputs):
+      the speculative loop checks eos ON DEVICE and reads back once
+      per retirement wave, where the plain loop needs token values per
+      wave — spec wins decisively even against the plain engine's
+      batched-check mode (``eos_check_every``).
+    - **fixed-n_new traffic, no eos**: the plain loop retires by COUNT
+      — fully async, zero mid-schedule readbacks — while spec still
+      syncs once per retirement wave; on a high-readback-latency
+      backend (this repo's tunnelled chip: ~65 ms per pipeline flush)
+      that overhead eats the accept-rate win at most occupancies.
+
+    Use ``spec_k`` for eos/structured traffic; on fixed-length
+    benchmark-style traffic prefer the plain engine, or shrink
+    ``spec_k`` as occupancy grows (smaller verification width).
     """
     if prefill_chunk is not None and prefill_chunk < 1:
         raise ValueError(
@@ -675,9 +697,13 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
 
     def run(prompts: Sequence[Any], n_new: int, *, slots: int = 4,
             rules: ShardingRules | None = None,
-            eos_id: int | None = None, rng=None) -> list[Any]:
+            eos_id: int | None = None, rng=None,
+            eos_check_every: int = 1) -> list[Any]:
         if not prompts:
             return []
+        if eos_check_every < 1:
+            raise ValueError(
+                f"eos_check_every must be >= 1, got {eos_check_every}")
         if sampler is not None and rng is None:
             raise ValueError("a sampled engine needs rng (a PRNG key)")
         if n_new < 1:
@@ -726,7 +752,20 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         # dispatches per step — observed to dominate serve wall-clock
         # through the tunnelled backend's per-op latency. Without
         # eos_id the schedule is fully async end to end; eos makes
-        # lengths variable and costs ONE [slots] readback per wave.
+        # lengths variable and costs a readback — by default ONE
+        # [slots] vector per wave, but a readback that must wait on
+        # freshly dispatched work pays the backend's full pipeline-
+        # flush RTT (~65 ms through the tunnelled chip vs ~0.02 ms for
+        # a resident value), so ``eos_check_every=W`` batches the
+        # check: one [W, slots] readback per W waves. Retirement then
+        # LAGS an eos by up to W-1 waves (the slot computes ignored
+        # tokens before recycling — bubble, never wrongness: outputs
+        # are truncated at the first eos either way), trading a bounded
+        # bubble for 1/W of the flushes. The first-token eos check
+        # rides the same schedule: eager (one host int per admission)
+        # at W=1, caught by the periodic scan/assembly truncation at
+        # W>1.
+        eos_pending = 0                  # waves since the last eos scan
         while queue or active:
             # admission: every free slot takes the next queued request
             for slot in range(slots):
@@ -744,6 +783,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 # a request the prefill token already satisfied must
                 # retire BEFORE any step, or it collects an extra token
                 if n_new == 1 or (eos_id is not None
+                                  and eos_check_every == 1
                                   and int(first) == eos_id):
                     done_at[req] = 1
                     continue
@@ -765,13 +805,37 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                      for s in range(slots)], jnp.int32)
                 tokens, stacked = step(tokens, reqs, poss, rng, stacked)
             hist.append(tokens)
-            tok_h = jax.device_get(tokens) if eos_id is not None else None
             for slot, req in list(active.items()):
                 count[req] += 1
-                if count[req] >= n_new or (
-                        tok_h is not None and int(tok_h[slot]) == eos_id):
+                if count[req] >= n_new:
                     done_at[req] = count[req]
                     del active[slot]             # slot recycles next wave
+            if eos_id is not None:
+                eos_pending += 1
+                if eos_check_every == 1:
+                    tok_h = jax.device_get(hist[-1])
+                    eos_pending = 0
+                    for slot, req in list(active.items()):
+                        if int(tok_h[slot]) == eos_id:
+                            done_at[req] = count[req]
+                            del active[slot]
+                elif eos_pending >= eos_check_every:
+                    # one flush per W waves: scan the batched window for
+                    # each active request's FIRST eos (only rows since
+                    # its admission belong to it) — done_at stays exact,
+                    # only the retirement is late
+                    block = jax.device_get(
+                        jnp.stack(hist[-eos_pending:]))   # [W, slots]
+                    base = len(hist) - eos_pending
+                    eos_pending = 0
+                    for slot, req in list(active.items()):
+                        sw = span[req][1]
+                        for j in range(block.shape[0]):
+                            h = base + j
+                            if h >= sw and int(block[j, slot]) == eos_id:
+                                done_at[req] = h - sw + 2
+                                del active[slot]
+                                break
 
         waves = jnp.stack(hist) if hist else None      # [W, slots]
         outs = []
@@ -784,6 +848,18 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 # hist[sw : sw+n-1] — one emission per active wave
                 outs.append(jnp.concatenate(
                     [firsts[req][None], waves[sw:sw + n - 1, slot]]))
+        if eos_id is not None and eos_check_every > 1:
+            # lagged scheduling can retire by count cap before a scan
+            # saw an eos (and never sees first-token eos at all) —
+            # truncation at the first eos restores the exact W=1
+            # semantics; it runs on host ints, zero extra flushes
+            cut = []
+            for o in outs:
+                toks = [int(t) for t in jax.device_get(o)]
+                n = next((i + 1 for i, t in enumerate(toks)
+                          if t == eos_id), len(toks))
+                cut.append(o[:n])
+            outs = cut
         return outs
 
     run.last_stats = None          # set by speculative runs
@@ -795,6 +871,7 @@ def serve(params, prompts: Sequence[Any], n_new: int, cfg: BurnInConfig,
           rules: ShardingRules | None = None,
           cache_dtype: str = "bf16",
           eos_id: int | None = None,
+          eos_check_every: int = 1,
           prefill_chunk: int | None = None,
           spec_k: int | None = None) -> list[Any]:
     """Serve ``prompts`` (each ``[L_i]``) with continuous batching.
@@ -811,6 +888,15 @@ def serve(params, prompts: Sequence[Any], n_new: int, cfg: BurnInConfig,
     through speculative continuous batching (see
     :func:`make_serve_engine`).
 
+    ``eos_check_every=W`` batches eos retirement readbacks: one
+    ``[W, slots]`` transfer per ``W`` waves instead of one ``[slots]``
+    per wave. On backends where a readback that waits on fresh work
+    pays a large pipeline-flush RTT (~65 ms through this repo's
+    tunnelled chip) the per-wave check serialises the whole schedule;
+    batching restores the async pipeline at the cost of slots
+    recycling up to ``W-1`` waves late. Outputs are EXACT either way —
+    truncation at the first eos is recomputed at assembly.
+
     One-shot convenience over :func:`make_serve_engine` — callers timing
     or re-running schedules should build the engine once instead.
     """
@@ -826,4 +912,10 @@ def serve(params, prompts: Sequence[Any], n_new: int, cfg: BurnInConfig,
                                cache_dtype=cache_dtype,
                                prefill_chunk=prefill_chunk,
                                spec_k=spec_k)
-    return engine(prompts, n_new, slots=slots, rules=rules, eos_id=eos_id)
+    if spec_k is not None:
+        # the speculative loop already batches retirement readbacks
+        # per wave; eos_check_every applies to the plain loop only
+        return engine(prompts, n_new, slots=slots, rules=rules,
+                      eos_id=eos_id)
+    return engine(prompts, n_new, slots=slots, rules=rules, eos_id=eos_id,
+                  eos_check_every=eos_check_every)

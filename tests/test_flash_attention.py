@@ -91,7 +91,9 @@ def test_fit_block_only_returns_sublane_multiples():
     from nvidia_terraform_modules_tpu.ops.flash_attention import _fit_block
     assert _fit_block(192, None) == 96          # not 64? 96 divides and is 8k
     assert _fit_block(250, None) == 0           # 125 must NOT be picked
-    assert _fit_block(4096, None) == 512
+    # None default is min(1024, max(128, S/4)) — the measured v5e q-block
+    # rule (1024x1024 runs S=4096 2x faster than the old 512 default)
+    assert _fit_block(4096, None) == 1024
     assert _fit_block(48, 32) == 24             # 24 = 3×8, divides 48
     assert _fit_block(8, None) == 8
     assert _fit_block(4, None) == 4             # tiny interpret-only shapes

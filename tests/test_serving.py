@@ -562,3 +562,34 @@ def test_serve_int8_pool_on_mesh_keeps_jnp_path(jax8):
                           cache_dtype="int8")[0] for p in prompts]
     for i, (g, w) in enumerate(zip(got, want)):
         assert jnp.array_equal(jax.device_get(g), w), f"request {i}"
+
+
+def test_eos_lagged_checks_match_per_wave_checks():
+    """eos_check_every=W batches the retirement readback; tokens must
+    EQUAL the per-wave (W=1) engine's on every schedule — late
+    retirement is a scheduling lag, never different output. Includes a
+    first-token-eos request (w=1's eager admission check vs the lagged
+    assembly truncation) and deep recycling (5 requests, 2 slots)."""
+    cfg, params, prompts = _setup(n_prompts=5)
+    n_new = 8
+    full = _reference(params, prompts, n_new, cfg)
+    candidates = [int(t) for f in full for t in f[:-1]]
+    eos = candidates[0]
+    want = serve(params, prompts, n_new, cfg, slots=2, eos_id=eos)
+    assert any(len(w) < n_new for w in want)     # eos actually fires
+    for w_every in (2, 3, 8):
+        got = serve(params, prompts, n_new, cfg, slots=2, eos_id=eos,
+                    eos_check_every=w_every)
+        for i, (g, w) in enumerate(zip(got, want)):
+            assert jnp.array_equal(g, w), (
+                f"W={w_every} request {i} diverged")
+    # first-token eos: reference output whose very first token is eos
+    first_eos = int(full[0][0])
+    got = serve(params, prompts, n_new, cfg, slots=2, eos_id=first_eos,
+                eos_check_every=4)
+    want = serve(params, prompts, n_new, cfg, slots=2, eos_id=first_eos)
+    for g, w in zip(got, want):
+        assert jnp.array_equal(g, w)
+    with pytest.raises(ValueError, match="eos_check_every"):
+        serve(params, prompts, 4, cfg, slots=2, eos_id=eos,
+              eos_check_every=0)
