@@ -704,6 +704,14 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         if eos_check_every < 1:
             raise ValueError(
                 f"eos_check_every must be >= 1, got {eos_check_every}")
+        if spec_k is not None and eos_check_every != 1:
+            # the speculative loop already batches retirement readbacks
+            # per wave on device; silently dropping the knob would let a
+            # caller believe batching was applied where it is built in
+            raise ValueError(
+                "eos_check_every applies to the plain engine only — the "
+                "speculative loop checks eos on device and reads back "
+                "once per retirement wave already")
         if sampler is not None and rng is None:
             raise ValueError("a sampled engine needs rng (a PRNG key)")
         if n_new < 1:
@@ -912,10 +920,5 @@ def serve(params, prompts: Sequence[Any], n_new: int, cfg: BurnInConfig,
                                cache_dtype=cache_dtype,
                                prefill_chunk=prefill_chunk,
                                spec_k=spec_k)
-    if spec_k is not None:
-        # the speculative loop already batches retirement readbacks
-        # per wave; eos_check_every applies to the plain loop only
-        return engine(prompts, n_new, slots=slots, rules=rules,
-                      eos_id=eos_id)
     return engine(prompts, n_new, slots=slots, rules=rules, eos_id=eos_id,
                   eos_check_every=eos_check_every)

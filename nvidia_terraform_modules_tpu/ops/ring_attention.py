@@ -286,6 +286,15 @@ def ring_flash_attention_kernel(q, k, v, *, axis_name: str,
     b, s_loc, h, d = q.shape
     if scale is None:
         scale = 1.0 / (d ** 0.5)
+    if block_q is None or block_k is None:
+        # keep the ring kernel's ORIGINAL default (512-cap, S/8 rule):
+        # the fatter flash_attention defaults were swept on-chip for the
+        # monolithic kernel only, and the ring sweep holds extra
+        # rotating K/V buffers resident — retune it with its own
+        # measurement, not by inheritance
+        want = min(512, max(128, s_loc // 8))
+        block_q = want if block_q is None else block_q
+        block_k = want if block_k is None else block_k
     block_q = _fit_block(s_loc, block_q)
     block_k = _fit_block(s_loc, block_k)
     if s_loc > 8 and (block_q < 8 or block_k < 8):

@@ -593,3 +593,13 @@ def test_eos_lagged_checks_match_per_wave_checks():
     with pytest.raises(ValueError, match="eos_check_every"):
         serve(params, prompts, 4, cfg, slots=2, eos_id=eos,
               eos_check_every=0)
+
+
+def test_spec_engine_refuses_eos_check_every():
+    """The speculative loop batches retirement readbacks on device
+    already — a spec engine must refuse the plain-loop knob rather
+    than silently drop it."""
+    cfg, params, prompts = _setup(n_prompts=2)
+    with pytest.raises(ValueError, match="eos_check_every"):
+        serve(params, prompts, 4, cfg, slots=2, spec_k=2, eos_id=1,
+              eos_check_every=4)
