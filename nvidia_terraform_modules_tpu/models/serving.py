@@ -377,11 +377,13 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
     ``top_k=1`` reproduces the greedy engine exactly).
 
     ``prefill_chunk`` switches admission to CHUNKED PREFILL (vLLM's
-    lever, re-thought for XLA's compile model): the prompt is padded to
-    a multiple of the chunk and prefilled through ONE compiled ``[1, C]``
-    cached forward, however long the prompt — exact-length admission
-    compiles once per DISTINCT length, chunked admission compiles once
-    per ENGINE. Pad rows land in the cache but are unreachable: cached
+    lever, re-thought for XLA's compile model): the prompt is padded
+    into a ``[1, MC, C]`` chunk buffer and prefilled by ONE compiled
+    dispatch — a ``fori_loop`` (traced trip count) of ``[1, C]`` cached
+    forwards — however long the prompt. Exact-length admission compiles
+    once per DISTINCT length; chunked admission compiles once per
+    ENGINE and costs one dispatch per admission.
+    Pad rows land in the cache but are unreachable: cached
     attention masks ``k_pos > q_pos`` and ``pos`` resets to the true
     length after admission, so decode writes overwrite them in order.
     Peak prefill score memory drops from ``[T, S_max]`` to
@@ -500,19 +502,39 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
 
     chunk_fill = None
     if prefill_chunk is not None:
-        # params as argument, not closure — see make_serve_step
-        @functools.partial(jax.jit, donate_argnums=(3,))
-        def _chunk_fill(p, chunk, last_idx, cache, key):   # [1, C]
-            # mid-stream cached forward: masks by position, so the pad
-            # tail of the final chunk never leaks into real tokens'
-            # attention; last_idx (traced) picks the true last token's
-            # logits — one compile serves every chunk of every prompt
-            logits, cache = forward_cached(p, chunk, cache, cfg,
-                                           prefill_impl="cached")
-            return pick(logits, last_idx, key), cache
+        # The whole chunk sweep is ONE compiled dispatch: a fori_loop
+        # with a TRACED trip count walks the [1, MC, C] padded prompt;
+        # each iteration is the same mid-stream cached forward a
+        # per-chunk jit call used to be (masks by position, so the pad
+        # tail never leaks into real tokens' attention) — identical
+        # math in identical order, but admission costs one dispatch
+        # instead of one per chunk (measured: ~12 per-chunk dispatches
+        # per 3k prompt left chunked admission 3-4× behind flash
+        # admission through the tunnelled backend's per-dispatch
+        # latency). Still one compile per ENGINE: MC is static from
+        # max_len; the live-chunk count and last-token offset are
+        # runtime values. params as argument, not closure — see
+        # make_serve_step
+        @functools.partial(jax.jit, donate_argnums=(4,))
+        def _chunk_fill(p, chunks, n, last_idx, cache, key):
+            # chunks [1, MC, C]; n = live chunks; last_idx = the true
+            # last token's offset within chunk n-1
+            def body(i, carry):
+                row, cache = carry
+                logits, cache = forward_cached(
+                    p, chunks[:, i], cache, cfg, prefill_impl="cached")
+                # keep only the FINAL live chunk's last-token logits;
+                # dead trailing chunks never run (fori_loop bound is n)
+                row = jnp.where(i == n - 1, logits[0, last_idx], row)
+                return row, cache
 
-        def chunk_fill(chunk, last_idx, cache, key):
-            return _chunk_fill(prefill_params, chunk, last_idx, cache, key)
+            row0 = jnp.zeros((cfg.vocab,), cfg.dtype)
+            row, cache = jax.lax.fori_loop(0, n, body, (row0, cache))
+            return pick(row[None, None], 0, key), cache
+
+        def chunk_fill(chunks, n, last_idx, cache, key):
+            return _chunk_fill(prefill_params, chunks, n, last_idx,
+                               cache, key)
     template = None
     prefix_len = 0
     if prefix is not None:
@@ -572,17 +594,15 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         if template is None:
             cache = init_cache(cfg, 1, max_len, cache_dtype=cache_dtype)
         else:
-            # one whole-cache copy, then every chunk donates it forward
+            # one whole-cache copy; the sweep donates it forward
             cache = jax.tree.map(lambda x: x.copy(), template)
-        pad = n * c - length
-        padded = jnp.pad(prompt, (0, pad)) if pad else prompt
-        tok = None
-        for i in range(n):
-            # only the FINAL chunk's token (at the true last index) is
-            # kept; earlier chunks' argmax/sample output is never read
-            last = length - 1 - i * c if i == n - 1 else c - 1
-            tok, cache = chunk_fill(padded[None, i * c:(i + 1) * c],
-                                    jnp.int32(last), cache, key)
+        # ONE [1, MC, C] buffer per admission (static shape → one
+        # compile per engine); trailing dead chunks are never executed
+        mc = max(1, (max_len - prefix_len) // c)
+        padded = jnp.zeros((mc * c,), jnp.int32).at[:length].set(prompt)
+        tok, cache = chunk_fill(padded.reshape(1, mc, c), jnp.int32(n),
+                                jnp.int32(length - 1 - (n - 1) * c),
+                                cache, key)
         # rewind pos past the pad rows: the next decode write lands at
         # the true length, reclaiming them one step at a time; rows
         # beyond pos stay masked (k_pos > q_pos) until overwritten
@@ -723,6 +743,12 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             return jax.random.fold_in(jax.random.fold_in(rng, req), idx)
         headroom = 0 if spec_k is None else spec_k
         for p in prompts:
+            if int(p.shape[-1]) < 1:
+                # a zero-length prompt has no last token to continue
+                # from — refuse loudly (the chunked sweep would
+                # otherwise run zero chunks and emit plausible-looking
+                # garbage from the zero-initialised logits row)
+                raise ValueError("prompts must have at least one token")
             if prefix_len + int(p.shape[-1]) + n_new + headroom > max_len:
                 raise ValueError(
                     f"prefix ({prefix_len}) + prompt "

@@ -603,3 +603,14 @@ def test_spec_engine_refuses_eos_check_every():
     with pytest.raises(ValueError, match="eos_check_every"):
         serve(params, prompts, 4, cfg, slots=2, spec_k=2, eos_id=1,
               eos_check_every=4)
+
+
+def test_empty_prompt_refused():
+    """A zero-length prompt must fail loudly on every admission path
+    (the chunked sweep would otherwise emit garbage from a zero-run
+    fori_loop)."""
+    cfg, params, _ = _setup(n_prompts=1)
+    empty = [jnp.zeros((0,), jnp.int32)]
+    for kw in ({}, {"prefill_chunk": 4}, {"spec_k": 2}):
+        with pytest.raises(ValueError, match="at least one token"):
+            serve(params, empty, 3, cfg, slots=1, **kw)
