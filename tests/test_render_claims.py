@@ -1,0 +1,68 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""The claims pipeline guards the docs; this guards the pipeline.
+
+tools/render_claims.py is a CI gate (README's Measured-performance
+table must re-render byte-identically from the newest committed
+capture) — a regression here silently un-gates every published number.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(ROOT, "tools", "render_claims.py")
+
+
+def _mod():
+    spec = importlib.util.spec_from_file_location("render_claims", TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_check_passes_against_committed_artifact():
+    """The committed README block must match a fresh render — the exact
+    assertion CI makes."""
+    proc = subprocess.run([sys.executable, TOOL, "--check"], cwd=ROOT,
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_newest_artifact_picks_highest_round():
+    mod = _mod()
+    newest = os.path.basename(mod.newest_artifact())
+    rounds = [int(f.split("_r")[-1].split(".")[0])
+              for f in os.listdir(ROOT)
+              if f.startswith("BENCH_tpu_capture_r")]
+    assert newest == f"BENCH_tpu_capture_r{max(rounds):02d}.json" or \
+        newest == f"BENCH_tpu_capture_r{max(rounds)}.json"
+
+
+def test_render_skips_absent_fields_and_formats_minmax(tmp_path):
+    mod = _mod()
+    art = tmp_path / "BENCH_tpu_capture_r99.json"
+    art.write_text(json.dumps({
+        "device_kind": "TPU v5 lite", "bench_platform": "tpu",
+        "burnin_mfu": 0.7, "burnin_mfu_minmax": [0.69, 0.71],
+    }))
+    block = mod.render(str(art))
+    assert "0.700" in block and "0.690 – 0.710" in block
+    # absent metrics leave no row behind
+    assert "Decode, bf16" not in block
+    assert block.startswith(mod.BEGIN) and block.endswith(mod.END)
+
+
+def test_splice_requires_markers():
+    mod = _mod()
+    with pytest.raises(SystemExit, match="markers"):
+        mod.splice("no markers here", "block")
+    out = mod.splice(f"head\n{mod.BEGIN}\nold\n{mod.END}\ntail",
+                     f"{mod.BEGIN}\nnew\n{mod.END}")
+    assert "new" in out and "old" not in out
+    assert out.startswith("head") and out.endswith("tail")
