@@ -26,6 +26,7 @@ style gate.
 from .parser import parse_hcl, HclParseError  # noqa: F401
 from .module import Module, load_module  # noqa: F401
 from .validate import validate_module, Finding  # noqa: F401
+from .lint.engine import list_rules, run_lint  # noqa: F401
 from .plan import (  # noqa: F401
     Plan,
     PlanError,

@@ -9,6 +9,9 @@ terraform binary in CI, so tfsim ships the same verbs offline::
 
     python -m nvidia_terraform_modules_tpu.tfsim init gke-tpu [-check]
     python -m nvidia_terraform_modules_tpu.tfsim validate gke-tpu [-json]
+    python -m nvidia_terraform_modules_tpu.tfsim lint gke-tpu [-json|-sarif] \
+        [-severity RULE=LEVEL ...] [-rules]   # TPU-semantic / dead-code /
+        # deprecation analyses; exit 0 clean, 1 warnings, 2 errors
     python -m nvidia_terraform_modules_tpu.tfsim plan gke-tpu -var project_id=p \
         -var cluster_name=c [-state terraform.tfstate.json] [-json] [-target ADDR] \
         [-replace ADDR] [-out plan.tfplan] [-refresh-only] [-destroy] \
@@ -166,20 +169,30 @@ def _load_state(path: str | None) -> State | None:
     return None
 
 
+def _source_location(f) -> tuple[str, int] | None:
+    """``(file, line)`` when a finding points at a real source artifact,
+    else None. THE location filter for every machine-readable surface
+    (validate -json, lint -json, lint -sarif): synthetic locations —
+    pseudo-filenames like ``locals`` (no source suffix) and empty wheres
+    — would make a CI annotator emit rejected/misplaced annotations.
+    Line 0 (module-level findings in a 1-based scheme) means file-only."""
+    fname = f.file
+    if not fname or not fname.endswith((".tf", ".tfvars", ".hcl",
+                                        ".example")):
+        return None
+    return fname, f.line
+
+
 def _diag_json(f) -> dict:
     """One `validate -json` diagnostic. Terraform omits `range` when a
-    diagnostic has no real source position; our synthetic locations —
-    pseudo-filenames like ``locals`` (no .tf/.hcl suffix) and line 0 in
-    a 1-based scheme — would make a CI annotator (the consumer this
-    format exists for) emit rejected/misplaced annotations, so a
-    non-source filename drops the range and line 0 drops the start."""
+    diagnostic has no real source position."""
     d = {"severity": f.severity, "summary": f.message}
-    fname, _, line = f.where.rpartition(":")
-    if not fname or not fname.endswith((".tf", ".tfvars", ".hcl")):
+    loc = _source_location(f)
+    if loc is None:
         return d
-    d["range"] = {"filename": fname}
-    if line.isdigit() and int(line) >= 1:
-        d["range"]["start"] = {"line": int(line)}
+    d["range"] = {"filename": loc[0]}
+    if loc[1] >= 1:
+        d["range"]["start"] = {"line": loc[1]}
     return d
 
 
@@ -217,6 +230,109 @@ def cmd_validate(args) -> int:
     print(f"{'Success! ' if not errors else ''}{len(findings)} finding(s), "
           f"{len(errors)} error(s).")
     return 1 if errors else 0
+
+
+def _lint_finding_json(f) -> dict:
+    d = {"rule": f.rule, "severity": f.severity, "where": f.where,
+         "message": f.message}
+    loc = _source_location(f)
+    if loc is not None:
+        d["file"] = loc[0]
+        if loc[1] >= 1:
+            d["line"] = loc[1]
+    return d
+
+
+def _lint_sarif(findings, rules) -> dict:
+    """Minimal SARIF 2.1.0 — the format CI annotators and code-scanning
+    UIs ingest natively; ``info`` maps to SARIF's ``note`` level."""
+    level = {"error": "error", "warning": "warning", "info": "note"}
+    results = []
+    for f in findings:
+        r = {"ruleId": f.rule, "level": level.get(f.severity, "warning"),
+             "message": {"text": f.message}}
+        loc = _source_location(f)
+        if loc is not None:
+            region = {"startLine": loc[1]} if loc[1] >= 1 else {}
+            r["locations"] = [{"physicalLocation": {
+                "artifactLocation": {"uri": loc[0]},
+                **({"region": region} if region else {}),
+            }}]
+        results.append(r)
+    return {
+        "version": "2.1.0",
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tfsim-lint",
+                "rules": [{
+                    "id": r.id,
+                    "shortDescription": {"text": r.summary},
+                    "defaultConfiguration": {
+                        "level": level.get(r.severity, "warning")},
+                } for r in rules],
+            }},
+            "results": results,
+        }],
+    }
+
+
+def cmd_lint(args) -> int:
+    """``tfsim lint DIR``: the analyses above the ``validate`` floor.
+
+    Exit codes are severity-based: 0 = clean (info findings never fail a
+    build), 1 = warnings, 2 = errors. ``-severity rule=level`` overrides
+    a rule's severity (level ``off`` disables it); ``# tfsim:ignore
+    rule-id`` in the HCL suppresses a single finding in place.
+    """
+    from .lint.engine import Finding, exit_code, list_rules, run_lint
+
+    if getattr(args, "rules", False):
+        for r in list_rules():
+            print(f"{r.id:28} {r.severity:8} {r.family:12} {r.summary}")
+        return 0
+    try:
+        overrides: dict[str, str] = {}
+        for kv in args.severity or []:
+            if "=" not in kv:
+                # same diagnostic path as an unknown rule id / bad level
+                # (run_lint raises): every -severity error must reach the
+                # requested output format, not bypass it on stderr
+                raise ValueError(
+                    f"-severity expects RULE=LEVEL, got {kv!r}")
+            rid, _, level = kv.partition("=")
+            overrides[rid.strip()] = level.strip()
+        findings = run_lint(args.dir, overrides=overrides)
+    except (SyntaxError, ValueError, OSError) as ex:
+        # SyntaxError: HclParseError/HclLexError subclass it, and a module
+        # that does not parse must still be a diagnostic, not a traceback
+        # an unloadable module (or a bad -severity) IS a lint failure,
+        # reported as a diagnostic in every output format, never a crash
+        findings = [Finding("error", "", str(ex), rule="core-load")]
+    counts = {s: sum(1 for f in findings if f.severity == s)
+              for s in ("error", "warning", "info")}
+    rc = exit_code(findings)
+    if getattr(args, "sarif", False):
+        print(json.dumps(_lint_sarif(findings, list_rules()), indent=2,
+                         sort_keys=True))
+        return rc
+    if getattr(args, "json", False):
+        print(json.dumps({
+            "format_version": "1.0",
+            "clean": rc == 0,
+            "error_count": counts["error"],
+            "warning_count": counts["warning"],
+            "info_count": counts["info"],
+            "findings": [_lint_finding_json(f) for f in findings],
+        }, indent=2, sort_keys=True))
+        return rc
+    for f in findings:
+        where = f"{f.where}: " if f.where else ""
+        print(f"{where}{f.severity}: {f.message} [{f.rule}]")
+    print(f"{'Success! ' if rc == 0 else ''}{len(findings)} finding(s): "
+          f"{counts['error']} error(s), {counts['warning']} warning(s), "
+          f"{counts['info']} info.")
+    return rc
 
 
 def _workspace_of(args) -> str:
@@ -1394,6 +1510,16 @@ def main(argv: list[str] | None = None) -> int:
     v.add_argument("dir")
     v.add_argument("-json", action="store_true")
     v.set_defaults(fn=cmd_validate)
+
+    li = sub.add_parser("lint")
+    li.add_argument("dir", nargs="?", default=".")
+    li.add_argument("-json", action="store_true")
+    li.add_argument("-sarif", action="store_true")
+    li.add_argument("-severity", action="append", dest="severity",
+                    metavar="RULE=LEVEL")
+    li.add_argument("-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    li.set_defaults(fn=cmd_lint)
 
     c = add_module_cmd("plan", cmd_plan, state=True)
     c.add_argument("-json", action="store_true")

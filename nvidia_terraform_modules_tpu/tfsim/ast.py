@@ -170,8 +170,10 @@ def scoped_traversals(node, bound: frozenset = frozenset()):
     The single source of truth for scope-aware AST walking, shared by the
     validator (reference checking) and the planner (dependency extraction):
     for-expression variables and ``dynamic`` block iterators are tracked as
-    bound names; ``lifecycle`` blocks are skipped (their ``ignore_changes``
-    entries are attribute names, not references).
+    bound names; ``lifecycle`` attributes are skipped (their
+    ``ignore_changes`` entries are attribute names, not references) but
+    ``precondition``/``postcondition`` bodies are real expressions and are
+    walked.
     """
     if isinstance(node, ForExpr):
         names = {node.value_var} | ({node.key_var} if node.key_var else set())
@@ -183,6 +185,9 @@ def scoped_traversals(node, bound: frozenset = frozenset()):
         return
     if isinstance(node, Block):
         if node.type == "lifecycle":
+            for b in node.body.blocks:
+                if b.type in ("precondition", "postcondition"):
+                    yield from scoped_traversals(b.body, bound)
             return
         if node.type == "dynamic" and node.labels:
             iterator = node.labels[0]

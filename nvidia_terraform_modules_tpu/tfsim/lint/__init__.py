@@ -1,0 +1,19 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""tfsim lint — pluggable static analysis above the ``validate`` floor.
+
+See ``README.md`` in this directory for the rule catalog. Rule modules
+are imported lazily by the engine (``validate`` imports ``engine`` for
+the shared :class:`Finding`, and the core rules import validate back —
+an eager package import would be a cycle).
+"""
+
+from .engine import (  # noqa: F401
+    Finding,
+    LintContext,
+    Rule,
+    RULES,
+    exit_code,
+    list_rules,
+    run_lint,
+)

@@ -1,0 +1,326 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""The lint rule engine: registry, severity overrides, suppressions.
+
+``tfsim validate`` reproduces the floor the reference enforces
+(``terraform validate`` + conventions); the lint layer is everything
+*above* that floor — the pre-flight analyses that catch a misconfigured
+TPU slice before a multi-hour apply burns quota. This module owns the
+machinery only; the analyses live in the ``rules_*`` modules:
+
+* :class:`Finding` — the one diagnostic record shared by lint AND
+  ``validate`` (which imports it from here, so both surfaces render and
+  serialise identically);
+* :class:`Rule` + the :func:`rule` decorator — the registry. Each rule
+  has a stable id, a family (``tpu`` / ``dead-code`` / ``deprecation`` /
+  ``core``), a default severity, and a check callable;
+* per-rule severity overrides (``-severity rule=level``, level ``off``
+  disables a rule);
+* suppression comments: a ``# tfsim:ignore rule-id[,rule-id]`` comment
+  suppresses matching findings on its own line, or — when the comment
+  stands alone — on the line directly below;
+* :func:`run_lint` — load, run every enabled rule, filter, sort.
+
+Severities order ``error > warning > info``; the CLI exit code is 2 with
+any error, 1 with only warnings, 0 otherwise (info never fails a build).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Callable, Iterable, Optional
+
+from ..module import Module, load_module
+from ..parser import parse_hcl
+
+SEVERITIES = ("error", "warning", "info")
+
+
+@dataclasses.dataclass
+class Finding:
+    severity: str   # "error" | "warning" | "info"
+    where: str      # file:line
+    message: str
+    rule: str = ""  # stable rule id ("" for pre-lint validate callers)
+
+    def __str__(self) -> str:
+        # validate's historical rendering, unchanged: the lint CLI formats
+        # findings itself (file-first, rule-id suffix) for CI annotators
+        return f"{self.severity}: {self.where}: {self.message}"
+
+    @property
+    def file(self) -> str:
+        return self.where.rpartition(":")[0]
+
+    @property
+    def line(self) -> int:
+        tail = self.where.rpartition(":")[2]
+        return int(tail) if tail.isdigit() else 0
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str        # default; overridable per run
+    family: str          # "tpu" | "dead-code" | "deprecation" | "core"
+    summary: str
+    check: Callable[["LintContext"], Iterable]
+
+
+RULES: dict[str, Rule] = {}
+
+
+def rule(id: str, *, severity: str, family: str, summary: str):
+    """Register a rule. The check yields ``(where, message)`` pairs —
+    stamped with the rule's severity — or full :class:`Finding`s when a
+    single rule emits mixed severities (the validate bridge)."""
+    if severity not in SEVERITIES:
+        raise ValueError(f"rule {id!r}: bad default severity {severity!r}")
+
+    def deco(fn):
+        if id in RULES:
+            raise ValueError(f"duplicate rule id {id!r}")
+        RULES[id] = Rule(id=id, severity=severity, family=family,
+                         summary=summary, check=fn)
+        return fn
+    return deco
+
+
+def _ensure_rules_loaded() -> None:
+    """Import the rule modules exactly once (lazy: ``validate`` imports
+    this module for :class:`Finding`, and the core rules import validate
+    back — eager loading would be a cycle)."""
+    from . import rules_core, rules_deadcode, rules_deprecation, rules_tpu  # noqa: F401
+
+
+# --------------------------------------------------------------- context
+
+class LintContext:
+    """Everything a rule may need, computed once per run.
+
+    Rules are read-only consumers: the module object, raw file texts
+    (suppression comments, tfvars), parsed tfvars bodies, loaded local
+    child modules, and the cached ``validate_module`` findings.
+    """
+
+    def __init__(self, path: str, mod: Optional[Module] = None):
+        self.path = path
+        self.mod = mod if mod is not None else load_module(path)
+        self._texts: dict[str, str] = {}
+        self._tfvars: Optional[list] = None
+        self.tfvars_errors: list[Finding] = []
+        self._children: Optional[dict] = None
+        self._validate: Optional[list] = None
+        self._requirements: Optional[dict] = None
+
+    # ---- raw sources ------------------------------------------------
+    def lintable_files(self) -> list[str]:
+        """Bare filenames lint looks at: every parsed ``.tf`` file plus
+        tfvars variants and the dependency lockfile."""
+        names = list(self.mod.files)
+        for f in sorted(os.listdir(self.path)):
+            if f.endswith((".tfvars", ".tfvars.example", ".auto.tfvars")) \
+                    or f == ".terraform.lock.hcl":
+                if os.path.isfile(os.path.join(self.path, f)):
+                    names.append(f)
+        return names
+
+    def text(self, fname: str) -> str:
+        if fname not in self._texts:
+            with open(os.path.join(self.path, fname)) as fh:
+                self._texts[fname] = fh.read()
+        return self._texts[fname]
+
+    def tfvars_bodies(self):
+        """``(fname, Body)`` for each variable-definitions file. The
+        ``.example`` file ships in-repo as documentation — drifted keys
+        there mislead every operator who copies it, so it is linted.
+
+        A file that does not parse is contained, not fatal: it lands in
+        :attr:`tfvars_errors` (surfaced by the ``core-load`` rule) and the
+        other rules keep their findings — a broken docs-only ``.example``
+        must never suppress a real TPU misconfiguration."""
+        if self._tfvars is None:
+            self._tfvars = []
+            for f in self.lintable_files():
+                if f.endswith((".tfvars", ".tfvars.example")):
+                    try:
+                        self._tfvars.append(
+                            (f, parse_hcl(self.text(f), filename=f)))
+                    except SyntaxError as ex:
+                        # HclParseError/HclLexError subclass SyntaxError;
+                        # their message already leads with "file:line: "
+                        m = re.match(r"^(.+?:\d+):\s*(.*)$", str(ex),
+                                     re.DOTALL)
+                        where, msg = (m.group(1), m.group(2)) if m \
+                            else (f"{f}:0", str(ex))
+                        self.tfvars_errors.append(
+                            Finding("error", where, msg, rule="core-load"))
+        return self._tfvars
+
+    # ---- cross-module -----------------------------------------------
+    def child_modules(self) -> dict[str, Optional[Module]]:
+        """call name → loaded child Module for local-path module calls
+        (None when the child fails to load — validate owns that error)."""
+        if self._children is None:
+            from ..lockfile import local_module_calls
+
+            self._children = {}
+            for name, d in local_module_calls(self.mod):
+                try:
+                    self._children[name] = load_module(d)
+                except (SyntaxError, ValueError, OSError):
+                    # SyntaxError covers HclParseError/HclLexError: a child
+                    # that does not even parse degrades to None like any
+                    # other unloadable child
+                    self._children[name] = None
+        return self._children
+
+    def requirements(self) -> dict:
+        """provider source → constraints over the whole local module tree
+        (``gather_requirements`` BFS-loads every child from disk — shared
+        here so rules don't each re-walk the tree)."""
+        if self._requirements is None:
+            from ..lockfile import gather_requirements
+
+            self._requirements = gather_requirements(self.path)
+        return self._requirements
+
+    # ---- validate bridge --------------------------------------------
+    def validate_findings(self) -> list[Finding]:
+        if self._validate is None:
+            from ..validate import validate_module
+
+            self._validate = validate_module(self.mod)
+        return self._validate
+
+    # ---- literal resolution -----------------------------------------
+    def resolve_literal(self, expr):
+        """Best-effort static value of an expression: literals, and
+        ``var.x`` traversals whose variable has a literal default (the
+        cross-file hop that lets TPU rules see through
+        ``topology = var.slice_topology``). Returns None when unknown."""
+        from .. import ast as A
+
+        if isinstance(expr, A.Literal):
+            return expr.value
+        if isinstance(expr, A.Template) and len(expr.parts) == 1 and \
+                isinstance(expr.parts[0], str):
+            return expr.parts[0]
+        if isinstance(expr, A.Traversal) and expr.root == "var" and \
+                len(expr.ops) == 1 and expr.ops[0][0] == "attr":
+            v = self.mod.variables.get(expr.ops[0][1])
+            if v is not None and isinstance(v.default, A.Literal):
+                return v.default.value
+        return None
+
+
+# ----------------------------------------------------------- suppression
+
+_IGNORE_RE = re.compile(r"#\s*tfsim:ignore[:]?\s+([A-Za-z0-9_*,\- ]+)")
+
+
+def _ignore_ids(tail: str) -> set:
+    """The suppressed rule ids in an ignore comment's tail.
+
+    The id list ends at the first token that is not a registered rule id
+    (or ``*``): free prose after the list — "tfsim:ignore unused-variable
+    until the v2 API lands" — must never suppress extra rules just
+    because a rule id happens to be an ordinary word ("core-ref",
+    "unused-local") someone typed in an explanation.
+    """
+    ids: set = set()
+    for tok in re.split(r"[,\s]+", tail.strip()):
+        if not tok:
+            continue
+        if tok != "*" and tok not in RULES:
+            break
+        ids.add(tok)
+    return ids
+
+
+def collect_suppressions(ctx: LintContext) -> dict[tuple[str, int], set]:
+    """(fname, line) → rule-ids suppressed there.
+
+    A trailing comment covers its own line; a standalone comment line
+    covers the next line (the idiomatic "annotate the finding above it"
+    placement). ``*`` suppresses every rule at that location.
+    """
+    out: dict[tuple[str, int], set] = {}
+    for fname in ctx.lintable_files():
+        try:
+            lines = ctx.text(fname).splitlines()
+        except OSError:
+            continue
+        for i, raw in enumerate(lines, start=1):
+            m = _IGNORE_RE.search(raw)
+            if not m:
+                continue
+            ids = _ignore_ids(m.group(1))
+            if not ids:
+                continue
+            target = i + 1 if raw.lstrip().startswith("#") else i
+            out.setdefault((fname, target), set()).update(ids)
+    return out
+
+
+# ------------------------------------------------------------------ run
+
+def list_rules() -> list[Rule]:
+    _ensure_rules_loaded()
+    return sorted(RULES.values(), key=lambda r: (r.family, r.id))
+
+
+def run_lint(path: str, mod: Optional[Module] = None,
+             overrides: Optional[dict[str, str]] = None) -> list[Finding]:
+    """Run every enabled rule over the module at ``path``.
+
+    ``overrides`` maps rule id → severity (or ``"off"`` to disable).
+    Returns findings sorted by (file, line, rule), suppressions applied.
+    """
+    _ensure_rules_loaded()
+    overrides = overrides or {}
+    for rid, level in overrides.items():
+        if level not in SEVERITIES and level != "off":
+            raise ValueError(f"-severity {rid}={level}: level must be one "
+                             f"of {', '.join(SEVERITIES)} or off")
+        if rid not in RULES:
+            raise ValueError(f"-severity {rid}: unknown rule id (see "
+                             f"`tfsim lint -rules` for the catalog)")
+    ctx = LintContext(path, mod)
+    suppressed = collect_suppressions(ctx)
+    findings: list[Finding] = []
+    for r in list_rules():
+        if overrides.get(r.id) == "off":
+            continue
+        for item in r.check(ctx):
+            if isinstance(item, Finding):
+                f = item
+                f.rule = f.rule or r.id
+            else:
+                where, message = item
+                f = Finding(r.severity, where, message, rule=r.id)
+            eff = overrides.get(f.rule)
+            if eff == "off":
+                continue
+            if eff is not None:
+                f.severity = eff
+            ids = suppressed.get((f.file, f.line), ())
+            if f.rule in ids or "*" in ids:
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.file, f.line, f.rule, f.message))
+    return findings
+
+
+def exit_code(findings: Iterable[Finding]) -> int:
+    """Severity-based exit code: 2 = errors, 1 = warnings only, 0 = clean
+    (info findings never fail a build)."""
+    severities = {f.severity for f in findings}
+    if "error" in severities:
+        return 2
+    if "warning" in severities:
+        return 1
+    return 0

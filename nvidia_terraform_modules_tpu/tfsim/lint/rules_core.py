@@ -1,0 +1,68 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Core rules: the ``tfsim validate`` checks, bridged into the engine.
+
+``validate_module`` predates the lint layer and keeps its own API (it is
+the offline ``terraform validate``, run by the validate verb and a dozen
+tests). Registering each of its finding families as a first-class rule
+makes ``tfsim lint`` a strict superset of ``tfsim validate`` — same
+diagnostics, now severity-overridable and suppressible like any other
+rule. ``validate.py`` stamps every finding with one of these ids.
+"""
+
+from __future__ import annotations
+
+from .engine import LintContext, rule
+
+_CORE = [
+    ("core-ref", "error",
+     "reference to an undeclared variable/local/resource/data/module"),
+    ("core-schema", "error",
+     "argument or block the provider schema does not define (or a "
+     "missing required one)"),
+    ("core-provider", "error",
+     "resource's provider has no required_providers entry"),
+    ("core-exclusive", "error",
+     "count and for_each set on the same resource"),
+    ("core-source", "error",
+     "module call without source / output without value"),
+    ("core-style", "warning",
+     "variable or output missing description/type (terraform-docs gate)"),
+    ("core-pins", "warning",
+     "module declares no required_providers / required_version"),
+]
+
+
+def _make(rule_id: str):
+    def check(ctx: LintContext):
+        for f in ctx.validate_findings():
+            if f.rule == rule_id:
+                yield f
+    return check
+
+
+for _id, _sev, _summary in _CORE:
+    rule(_id, severity=_sev, family="core", summary=_summary)(_make(_id))
+
+
+@rule("core-load", severity="error", family="core",
+      summary="source file that does not parse (the lint CLI also stamps "
+              "whole-module load failures with this id)")
+def check_load_errors(ctx: LintContext):
+    ctx.tfvars_bodies()  # populate tfvars_errors
+    yield from ctx.tfvars_errors
+
+
+@rule("core-unbridged", severity="error", family="core",
+      summary="validate finding with no dedicated core rule (safety net)")
+def check_unbridged(ctx: LintContext):
+    """The superset guarantee, enforced: if validate ever stamps a rule
+    id the table above doesn't list (or none at all), the finding must
+    still surface through lint — silently dropping it would let a CI
+    gate on ``tfsim lint`` pass a config ``tfsim validate`` rejects.
+    Findings keep their original severity and id; only unstamped ones
+    get this rule's."""
+    known = {i for i, _, _ in _CORE}
+    for f in ctx.validate_findings():
+        if f.rule not in known:
+            yield f

@@ -1,0 +1,198 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Dead-code and drift lint rules.
+
+Unused declarations are how module APIs rot: a variable nobody reads
+still demands a value from every caller, a stale tfvars key silently
+does nothing, and a lockfile pinning a provider nobody requires makes
+`init` drift invisible. Each rule here answers "is this declaration
+load-bearing?" from the module's own reference graph.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .. import ast as A
+from .engine import LintContext, rule
+
+
+def _uses(ctx: LintContext):
+    """Reference sets, computed once: var names, local names, data
+    (type, name) pairs, and ``module.<call>.<output>`` pairs — split so
+    a variable referenced ONLY by its own validation block still counts
+    as unused (the validation dies with the variable)."""
+    cached = getattr(ctx, "_deadcode_uses", None)
+    if cached is not None:
+        return cached
+    var_uses: dict[str, set] = {}   # var name -> referencing contexts
+    local_uses: set = set()
+    data_uses: set = set()
+    module_uses: set = set()
+
+    def record(node, context: str):
+        for t, bound in A.scoped_traversals(node):
+            if t.root in bound:
+                continue
+            if t.root == "var" and t.ops and t.ops[0][0] == "attr":
+                var_uses.setdefault(t.ops[0][1], set()).add(context)
+            elif t.root == "local" and t.ops and t.ops[0][0] == "attr":
+                local_uses.add(t.ops[0][1])
+            elif t.root == "data" and len(t.ops) >= 2 and \
+                    t.ops[0][0] == "attr" and t.ops[1][0] == "attr":
+                data_uses.add((t.ops[0][1], t.ops[1][1]))
+            elif t.root == "module" and t.ops and t.ops[0][0] == "attr":
+                call = t.ops[0][1]
+                out = next((op[1] for op in t.ops[1:] if op[0] == "attr"),
+                           None)
+                module_uses.add((call, out))
+
+    for body in ctx.mod.files.values():
+        for blk in body.blocks:
+            if blk.type == "variable" and blk.labels:
+                record(blk.body, f"variable:{blk.labels[0]}")
+            else:
+                record(blk, "config")
+    cached = (var_uses, local_uses, data_uses, module_uses)
+    ctx._deadcode_uses = cached
+    return cached
+
+
+@rule("unused-variable", severity="warning", family="dead-code",
+      summary="variable is declared but never referenced")
+def check_unused_variable(ctx: LintContext):
+    var_uses, _, _, _ = _uses(ctx)
+    for v in ctx.mod.variables.values():
+        contexts = var_uses.get(v.name, set())
+        if contexts - {f"variable:{v.name}"}:
+            continue
+        yield (f"{v.file}:{v.line}",
+               f"variable {v.name!r} is never used — callers must still "
+               f"satisfy it; remove it or wire it in")
+
+
+def _local_sites(ctx: LintContext) -> dict[str, tuple[str, int]]:
+    """local name → (file, line) of its definition (the Module model
+    flattens locals and drops positions; recover them from the ASTs)."""
+    sites: dict[str, tuple[str, int]] = {}
+    for fname, body in ctx.mod.files.items():
+        for blk in body.blocks:
+            if blk.type != "locals":
+                continue
+            for attr in blk.body.attributes:
+                sites.setdefault(attr.name, (fname, attr.line))
+    return sites
+
+
+@rule("unused-local", severity="warning", family="dead-code",
+      summary="local value is declared but never referenced")
+def check_unused_local(ctx: LintContext):
+    _, local_uses, _, _ = _uses(ctx)
+    sites = _local_sites(ctx)
+    for name in ctx.mod.locals:
+        if name in local_uses:
+            continue
+        fname, line = sites.get(name, ("locals", 0))
+        yield (f"{fname}:{line}", f"local.{name} is never used")
+
+
+@rule("unreferenced-data-source", severity="warning", family="dead-code",
+      summary="data source is declared but never read")
+def check_unreferenced_data(ctx: LintContext):
+    _, _, data_uses, _ = _uses(ctx)
+    for r in ctx.mod.data_sources.values():
+        if (r.type, r.name) in data_uses:
+            continue
+        yield (f"{r.file}:{r.line}",
+               f"{r.address} is never read — it still performs a live "
+               f"API call every plan")
+
+
+@rule("unknown-module-output", severity="error", family="dead-code",
+      summary="reference to an output the child module does not declare")
+def check_unknown_module_output(ctx: LintContext):
+    _, _, _, module_uses = _uses(ctx)
+    children = ctx.child_modules()
+    # attribute each bad reference to every site that makes it; cheap
+    # re-walk keyed by the (call, output) pairs that are actually bad
+    bad = set()
+    for call, out in module_uses:
+        child = children.get(call)
+        if child is None or out is None:
+            continue
+        if out not in child.outputs:
+            bad.add((call, out))
+    if not bad:
+        return
+    for fname, body in ctx.mod.files.items():
+        for t, bound in A.scoped_traversals(body):
+            if t.root != "module" or t.root in bound or not t.ops or \
+                    t.ops[0][0] != "attr":
+                continue
+            call = t.ops[0][1]
+            out = next((op[1] for op in t.ops[1:] if op[0] == "attr"), None)
+            if (call, out) in bad:
+                child = children[call]
+                yield (f"{fname}:{t.line}",
+                       f"module.{call} declares no output {out!r} "
+                       f"(child module at "
+                       f"{os.path.relpath(child.path, ctx.path)})")
+
+
+@rule("unused-module-output", severity="info", family="dead-code",
+      summary="child module output never read by this configuration")
+def check_unused_module_output(ctx: LintContext):
+    """Info-severity by design: a library module's outputs serve EVERY
+    caller, so only the composition root can know an output is globally
+    dead. The finding points at the call site so a root-config owner can
+    prune the child's API deliberately."""
+    _, _, _, module_uses = _uses(ctx)
+    read = {(call, out) for call, out in module_uses}
+    for name, child in ctx.child_modules().items():
+        if child is None:
+            continue
+        mc = ctx.mod.module_calls[name]
+        unread = [o for o in sorted(child.outputs)
+                  if (name, o) not in read and (name, None) not in read]
+        for o in unread:
+            yield (f"{mc.file}:{mc.line}",
+                   f"output {o!r} of module.{name} is never read by this "
+                   f"configuration")
+
+
+@rule("tfvars-unknown-key", severity="warning", family="dead-code",
+      summary="tfvars key has no matching variable declaration")
+def check_tfvars_keys(ctx: LintContext):
+    for fname, body in ctx.tfvars_bodies():
+        for attr in body.attributes:
+            if attr.name not in ctx.mod.variables:
+                yield (f"{fname}:{attr.line}",
+                       f"tfvars key {attr.name!r} matches no declared "
+                       f"variable — terraform ignores it silently")
+
+
+@rule("lockfile-stale-provider", severity="warning", family="dead-code",
+      summary="dependency lockfile pins a provider the module tree no "
+              "longer requires")
+def check_lockfile_stale(ctx: LintContext):
+    from ..lockfile import REGISTRY
+    from ..parser import parse_hcl
+
+    lock = ".terraform.lock.hcl"
+    if not os.path.isfile(os.path.join(ctx.path, lock)):
+        return
+    try:
+        body = parse_hcl(ctx.text(lock), filename=lock)
+        reqs = ctx.requirements()
+    except (SyntaxError, ValueError, OSError):
+        # SyntaxError: HclParseError/HclLexError subclass it
+        return  # a broken lockfile/tree is init -check's finding, not ours
+    for blk in body.blocks:
+        if blk.type != "provider" or len(blk.labels) != 1:
+            continue
+        addr = blk.labels[0]
+        source = addr.removeprefix(f"{REGISTRY}/")
+        if source not in reqs:
+            yield (f"{lock}:{blk.line}",
+                   f"locked provider {addr} is required by no module in "
+                   f"the tree — regenerate with `tfsim init`")

@@ -1,0 +1,76 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Deprecation and version-pinning lint rules.
+
+Fed by the ``deprecated`` metadata on the vendored provider schemas
+(:mod:`..schema`): arguments the certified providers still accept but
+have replaced, each with a concrete migration hint. Pinning rules keep
+the reference's support-matrix discipline: a ``>=``-only provider
+constraint floats to whatever the registry serves next init.
+"""
+
+from __future__ import annotations
+
+from .engine import LintContext, rule
+
+
+@rule("deprecated-argument", severity="warning", family="deprecation",
+      summary="argument is deprecated by the certified provider version")
+def check_deprecated_arguments(ctx: LintContext):
+    from ..schema import check_deprecated_args
+
+    for r in list(ctx.mod.resources.values()) + \
+            list(ctx.mod.data_sources.values()):
+        for line, arg, hint in check_deprecated_args(r):
+            yield (f"{r.file}:{line}",
+                   f"{r.address}: {arg!r} is deprecated — {hint}")
+
+
+# constraint operators that bound a version from below only
+_LOWER_ONLY = {">", ">=", "!="}
+
+
+def _is_pinned(constraint: str) -> bool:
+    """True when at least one clause bounds the selection from above
+    (``~>``, ``=``, ``<``, ``<=``). Unparsable clauses count as pinned —
+    the lockfile checker owns malformed-constraint findings."""
+    from ..lockfile import parse_constraint_clause
+
+    for clause in constraint.split(","):
+        if not clause.strip():
+            continue
+        parsed = parse_constraint_clause(clause)
+        if parsed is None or parsed[0] not in _LOWER_ONLY:
+            return True
+    return False
+
+
+@rule("unpinned-provider", severity="warning", family="deprecation",
+      summary="required_providers constraint has no upper bound")
+def check_unpinned_providers(ctx: LintContext):
+    """``required_version`` is exempt on purpose: modules SHOULD give
+    terraform core a floor, but a floating provider selection changes
+    what ``init`` installs under CI between runs — pin with ``~>``."""
+    if not ctx.mod.required_providers:
+        return
+    # Module drops block positions; recover each entry's line from the AST
+    lines: dict[str, tuple[str, int]] = {}
+    for fname, body in ctx.mod.files.items():
+        for blk in body.blocks:
+            if blk.type != "terraform":
+                continue
+            for rp in blk.body.blocks_of("required_providers"):
+                for attr in rp.body.attributes:
+                    lines.setdefault(attr.name, (fname, attr.line))
+    for name, spec in sorted(ctx.mod.required_providers.items()):
+        fname, line = lines.get(name, ("versions.tf", 0))
+        constraint = spec.get("version")
+        if constraint is None:
+            yield (f"{fname}:{line}",
+                   f"provider {name!r} has no version constraint — any "
+                   f"release satisfies it; pin with ~>")
+        elif not _is_pinned(str(constraint)):
+            yield (f"{fname}:{line}",
+                   f"provider {name!r} constraint {constraint!r} has no "
+                   f"upper bound — the selection floats across majors; "
+                   f"pin with ~>")

@@ -1,0 +1,345 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""TPU-semantic lint rules.
+
+The class of mistake these catch is the expensive one: a slice declaration
+whose (version, topology) pair the TPU control plane will reject — or
+accept and then never schedule — surfaces today only hours into a real
+``terraform apply``. The rules cross-check every statically-visible slice
+declaration (``tpu_slices`` in module calls, tfvars files, and variable
+defaults) and every literal TPU node pool against the vendored generation
+facts in :mod:`tpu_facts`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .. import ast as A
+from . import tpu_facts as T
+from .engine import Finding, LintContext, rule
+
+
+@dataclasses.dataclass
+class SliceDecl:
+    """One statically-visible TPU slice declaration."""
+
+    fname: str
+    line: int
+    name: str
+    version: object        # resolved literal or None
+    topology: object
+    prefer_single_host: object
+    origin: str            # "tfvars" | "module call" | "variable default"
+
+
+def _object_items(expr):
+    if isinstance(expr, A.ObjectExpr):
+        for item in expr.items:
+            if isinstance(item.key, A.Literal):
+                yield str(item.key.value), item.value, item
+    return
+
+
+def _optional_defaults(var) -> dict:
+    """Per-field ``optional(type, default)`` literals from a variable's
+    ``map(object({…}))`` type. The shipped module declares slice shape
+    exactly this way — an entry ``{}`` inherits ``version = "v5e"``,
+    ``topology = "2x4"`` from the type, so those defaults must be
+    checkable too, not a blind spot."""
+    if var is None:
+        return {}
+    e = var.type_expr
+    while isinstance(e, A.Call) and e.name in ("map", "list", "set") \
+            and e.args:
+        e = e.args[0]
+    if not (isinstance(e, A.Call) and e.name == "object" and e.args):
+        return {}
+    out = {}
+    for name, value, _ in _object_items(e.args[0]):
+        if isinstance(value, A.Call) and value.name == "optional" and \
+                len(value.args) == 2 and isinstance(value.args[1], A.Literal):
+            out[name] = value.args[1].value
+    return out
+
+
+def _decls_from_object(ctx, fname, expr, origin, defaults=None):
+    defaults = defaults or {}
+
+    def field(fields, key):
+        # an absent field inherits the variable type's optional() default;
+        # a present-but-unresolvable one (e.g. a var reference) stays None
+        if key not in fields:
+            return defaults.get(key)
+        return ctx.resolve_literal(fields[key])
+
+    out = []
+    for name, value, item in _object_items(expr):
+        fields = {k: v for k, v, _ in _object_items(value)}
+        if not isinstance(value, A.ObjectExpr):
+            continue
+        out.append(SliceDecl(
+            fname=fname,
+            line=item.line or value.line,
+            name=name,
+            version=field(fields, "version"),
+            topology=field(fields, "topology"),
+            prefer_single_host=field(fields, "prefer_single_host"),
+            origin=origin,
+        ))
+    return out
+
+
+def slice_declarations(ctx: LintContext) -> list[SliceDecl]:
+    """Every ``tpu_slices = { … }`` object the linter can see statically:
+    module-call arguments, tfvars(.example) files, and the declaring
+    variable's own default."""
+    if getattr(ctx, "_slice_decls", None) is not None:
+        return ctx._slice_decls
+    decls: list[SliceDecl] = []
+    own_defaults = _optional_defaults(ctx.mod.variables.get("tpu_slices"))
+    for mc in ctx.mod.module_calls.values():
+        a = mc.body.attr("tpu_slices")
+        if a is None:
+            continue
+        child = ctx.child_modules().get(mc.name)
+        child_defaults = _optional_defaults(
+            child.variables.get("tpu_slices") if child else None)
+        decls.extend(_decls_from_object(
+            ctx, mc.file, a.expr, f"module {mc.name!r} call",
+            defaults=child_defaults))
+    for fname, body in ctx.tfvars_bodies():
+        a = body.attr("tpu_slices")
+        if a is not None:
+            decls.extend(_decls_from_object(ctx, fname, a.expr, "tfvars",
+                                            defaults=own_defaults))
+    v = ctx.mod.variables.get("tpu_slices")
+    if v is not None and v.default is not None:
+        decls.extend(_decls_from_object(
+            ctx, v.file, v.default, "variable default",
+            defaults=own_defaults))
+    ctx._slice_decls = decls
+    return decls
+
+
+@rule("tpu-unknown-version", severity="error", family="tpu",
+      summary="tpu_slices entry names a TPU generation that does not exist")
+def check_unknown_version(ctx: LintContext):
+    for d in slice_declarations(ctx):
+        if isinstance(d.version, str) and d.version not in T.GENERATIONS:
+            yield (f"{d.fname}:{d.line}",
+                   f"tpu_slices[{d.name!r}] ({d.origin}): version "
+                   f"{d.version!r} is not a known TPU generation "
+                   f"(known: {', '.join(T.GENERATIONS)})")
+
+
+@rule("tpu-invalid-topology", severity="error", family="tpu",
+      summary="(version, topology) pair is not a provisionable TPU slice")
+def check_invalid_topology(ctx: LintContext):
+    for d in slice_declarations(ctx):
+        if not isinstance(d.version, str) or not isinstance(d.topology, str):
+            continue
+        if d.version not in T.GENERATIONS:
+            continue  # tpu-unknown-version owns that finding
+        err = T.topology_error(d.version, d.topology)
+        if err:
+            yield (f"{d.fname}:{d.line}",
+                   f"tpu_slices[{d.name!r}] ({d.origin}): {err}")
+
+
+@rule("tpu-singlehost-packing", severity="warning", family="tpu",
+      summary="prefer_single_host set where it cannot take effect")
+def check_singlehost_packing(ctx: LintContext):
+    for d in slice_declarations(ctx):
+        if d.prefer_single_host is not True:
+            continue
+        if not isinstance(d.version, str) or d.version not in T.GENERATIONS:
+            continue
+        where = f"{d.fname}:{d.line}"
+        if d.version not in T.SINGLE_HOST_PACK:
+            yield (where,
+                   f"tpu_slices[{d.name!r}] ({d.origin}): "
+                   f"prefer_single_host has no effect on {d.version} — "
+                   f"pod slices are always "
+                   f"{T.CHIPS_PER_HOST[d.version]} chips per host")
+            continue
+        if not isinstance(d.topology, str):
+            continue
+        chips = T.chips_of(d.topology)
+        if chips is not None and chips != 8:
+            yield (where,
+                   f"tpu_slices[{d.name!r}] ({d.origin}): "
+                   f"prefer_single_host has no effect on a {chips}-chip "
+                   f"topology — only 8-chip {d.version} slices can pack "
+                   f"onto one {T.MACHINE_PREFIX[d.version]}-8t host")
+
+
+@rule("tpu-generation-facts", severity="error", family="tpu",
+      summary="a tpu_generations fact table disagrees with the vendored "
+              "TPU facts")
+def check_generation_facts(ctx: LintContext):
+    """The module's own per-generation table is config too: a typo'd
+    node selector or machine prefix provisions pools no workload ever
+    schedules onto."""
+    expected = {
+        "node_selector": T.NODE_SELECTOR,
+        "machine": T.MACHINE_PREFIX,
+        "chips_per_host": T.CHIPS_PER_HOST,
+    }
+    for fname, body in ctx.mod.files.items():
+        for blk in body.blocks:
+            if blk.type != "locals":
+                continue
+            attr = blk.body.attr("tpu_generations")
+            if attr is None or not isinstance(attr.expr, A.ObjectExpr):
+                continue
+            for gen, value, item in _object_items(attr.expr):
+                where = f"{fname}:{item.line or attr.line}"
+                if gen not in T.GENERATIONS:
+                    yield (where,
+                           f"tpu_generations[{gen!r}]: not a known TPU "
+                           f"generation (known: {', '.join(T.GENERATIONS)})")
+                    continue
+                for key, fvalue, fitem in _object_items(value):
+                    want = expected.get(key, {}).get(gen)
+                    if want is None:
+                        continue
+                    got = ctx.resolve_literal(fvalue)
+                    if got is not None and got != want:
+                        yield (f"{fname}:{fitem.line or item.line}",
+                               f"tpu_generations[{gen!r}].{key} is "
+                               f"{got!r}, but {gen} uses {want!r}")
+
+
+def _literal(ctx, attr):
+    return None if attr is None else ctx.resolve_literal(attr.expr)
+
+
+def _placement_blocks(body):
+    """placement_policy blocks, static or dynamic."""
+    out = []
+    for b in body.blocks:
+        if b.type == "placement_policy":
+            out.append((b, b.body))
+        elif b.type == "dynamic" and b.labels and \
+                b.labels[0] == "placement_policy":
+            for content in b.body.blocks_of("content"):
+                out.append((b, content.body))
+            if not b.body.blocks_of("content"):
+                out.append((b, None))
+    return out
+
+
+@rule("tpu-chip-arithmetic", severity="error", family="tpu",
+      summary="node pool host/chip arithmetic does not factor "
+              "(node_count × machine suffix ≠ topology chips)")
+def check_pool_arithmetic(ctx: LintContext):
+    for r in ctx.mod.resources.values():
+        if r.type != "google_container_node_pool":
+            continue
+        ncs = r.body.blocks_of("node_config")
+        if not ncs:
+            continue
+        mt = _literal(ctx, ncs[0].body.attr("machine_type"))
+        if not isinstance(mt, str):
+            continue
+        parsed = T.parse_machine_type(mt)
+        if parsed is None:
+            continue
+        gen, host_chips = parsed
+        where = f"{r.file}:{r.line}"
+        if not T.valid_host_chips(gen, host_chips):
+            ok = (T.SINGLE_HOST_PACK.get(gen)
+                  or (T.CHIPS_PER_HOST[gen],))
+            yield (where,
+                   f"{r.address}: machine type {mt!r} packs {host_chips} "
+                   f"chips on a host, but {gen} hosts carry "
+                   f"{', '.join(str(c) for c in ok)}")
+            continue
+        # topology from an attached placement policy, when literal
+        topology = None
+        for _blk, pbody in _placement_blocks(r.body):
+            if pbody is not None:
+                topology = _literal(ctx, pbody.attr("tpu_topology")) \
+                    or topology
+        if not isinstance(topology, str):
+            continue
+        if T.topology_error(gen, topology):
+            yield (where,
+                   f"{r.address}: placement_policy.tpu_topology "
+                   f"{topology!r}: {T.topology_error(gen, topology)}")
+            continue
+        chips = T.chips_of(topology)
+        if chips and gen in T.SINGLE_HOST_PACK and \
+                chips > host_chips and host_chips != T.CHIPS_PER_HOST[gen]:
+            yield (where,
+                   f"{r.address}: machine type {mt!r} is single-host "
+                   f"packing, but topology {topology!r} is {chips} chips "
+                   f"— multi-host {gen} slices use "
+                   f"{T.MACHINE_PREFIX[gen]}-{T.CHIPS_PER_HOST[gen]}t")
+            continue
+        node_count = _literal(ctx, r.body.attr("node_count"))
+        if chips and isinstance(node_count, int):
+            hosts = max(1, chips // host_chips)
+            if node_count != hosts:
+                yield (where,
+                       f"{r.address}: node_count = {node_count}, but "
+                       f"topology {topology!r} on {host_chips}-chip "
+                       f"{mt!r} hosts is exactly {hosts} host(s) — a "
+                       f"slice is atomic, the pool must match it")
+
+
+@rule("tpu-multihost-placement", severity="error", family="tpu",
+      summary="multi-host TPU pool without a COMPACT placement policy")
+def check_multihost_placement(ctx: LintContext):
+    """A multi-host slice is one ICI mesh: without
+    ``placement_policy { type = "COMPACT" tpu_topology = … }`` GKE
+    scatters the hosts and the slice never assembles.
+
+    A non-COMPACT placement type on a TPU pool is a definitive error.
+    ``node_count > 1`` with NO placement policy is only a *warning*: the
+    pool may legitimately be N independent single-host slices — and on
+    machines that exist only via single-host packing (1t/8t, see
+    :data:`tpu_facts.SINGLE_HOST_PACK`) that is the ONLY reading, so
+    those are skipped entirely (a pre-flight check must never
+    false-positive a valid fleet into a blocked apply)."""
+    for r in ctx.mod.resources.values():
+        if r.type != "google_container_node_pool":
+            continue
+        ncs = r.body.blocks_of("node_config")
+        if not ncs:
+            continue
+        mt = _literal(ctx, ncs[0].body.attr("machine_type"))
+        if not isinstance(mt, str):
+            continue
+        parsed = T.parse_machine_type(mt)
+        if parsed is None:
+            continue
+        gen, host_chips = parsed
+        where = f"{r.file}:{r.line}"
+        placements = _placement_blocks(r.body)
+        for blk, pbody in placements:
+            if pbody is None:
+                continue
+            ptype = _literal(ctx, pbody.attr("type"))
+            if isinstance(ptype, str) and ptype != "COMPACT":
+                yield (f"{r.file}:{blk.line}",
+                       f"{r.address}: TPU placement_policy type is "
+                       f"{ptype!r} — multi-host TPU slices require "
+                       f"\"COMPACT\" (one ICI mesh)")
+        if placements:
+            continue
+        if host_chips != T.CHIPS_PER_HOST[gen]:
+            # 1t/8t machines exist only via single-host packing: each
+            # node is its own whole slice, any node_count is valid
+            continue
+        node_count = _literal(ctx, r.body.attr("node_count"))
+        if isinstance(node_count, int) and node_count > 1:
+            yield Finding(
+                "warning", where,
+                f"{r.address}: {node_count} hosts of TPU machine "
+                f"{mt!r} with no placement_policy — if this pool is one "
+                f"multi-host slice it needs placement_policy {{ type = "
+                f"\"COMPACT\" tpu_topology = … }} or the hosts never "
+                f"form one ICI mesh (independent single-host slices can "
+                f"ignore this)")

@@ -1,0 +1,143 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Vendored TPU generation facts — the linter's source of truth.
+
+The same per-generation table ``gke-tpu/tpu_slices.tf`` derives machine
+types from, held independently so the linter can cross-check HCL against
+it (a drifted ``tpu_generations`` local is itself a finding). Topology
+sets follow the GKE TPU docs:
+
+* v5e / v6e are 2-D (``AxB``) with a closed set of supported shapes;
+  single-host pools may pack 1, 4, or 8 chips on one host
+  (``ct5lp-hightpu-{1,4,8}t`` / ``ct6e-standard-{1,4,8}t``).
+* v4 / v5p are 3-D (``AxBxC``) pod slices, always 4 chips per host.
+  The full shape catalogue is large and grows with capacity SKUs, so
+  the linter validates structure conservatively (dims from the
+  documented increments, chips divisible by hosts) rather than pinning
+  a closed set — a pre-flight check must never false-positive a valid
+  slice into a blocked apply.
+"""
+
+from __future__ import annotations
+
+GENERATIONS = ("v4", "v5e", "v5p", "v6e")
+
+NODE_SELECTOR = {
+    "v4": "tpu-v4-podslice",
+    "v5e": "tpu-v5-lite-podslice",
+    "v5p": "tpu-v5p-slice",
+    "v6e": "tpu-v6e-slice",
+}
+
+MACHINE_PREFIX = {
+    "v4": "ct4p-hightpu",
+    "v5e": "ct5lp-hightpu",
+    "v5p": "ct5p-hightpu",
+    "v6e": "ct6e-standard",
+}
+
+# multi-host chips per VM host (every generation lands on 4)
+CHIPS_PER_HOST = {"v4": 4, "v5e": 4, "v5p": 4, "v6e": 4}
+
+# chip counts a v5e/v6e SINGLE host can pack (machine-type suffix "<n>t")
+SINGLE_HOST_PACK = {"v5e": (1, 4, 8), "v6e": (1, 4, 8)}
+
+# topology dimensionality per generation
+DIMS = {"v4": 3, "v5e": 2, "v5p": 3, "v6e": 2}
+
+# closed supported shape sets for the 2-D generations (GKE docs)
+TOPOLOGIES_2D = {
+    "1x1", "2x2", "2x4", "4x4", "4x8", "8x8", "8x16", "16x16",
+}
+
+# documented per-dimension increments for 3-D pod slices
+DIMS_3D = (1, 2, 4, 8, 12, 16, 20)
+
+# largest chip count per generation (v4: 8960-chip v5p is the ceiling of
+# the family; used only to reject absurd topologies, not to meter quota)
+MAX_CHIPS = {"v4": 4096, "v5e": 256, "v5p": 8960, "v6e": 256}
+
+
+def parse_topology(topology: str) -> list[int] | None:
+    """``"2x4"`` → ``[2, 4]``; None when not of the ``AxB[xC]`` form."""
+    parts = topology.split("x")
+    if not (2 <= len(parts) <= 3):
+        return None
+    try:
+        dims = [int(p) for p in parts]
+    except ValueError:
+        return None
+    if any(d < 1 for d in dims):
+        return None
+    return dims
+
+
+def chips_of(topology: str) -> int | None:
+    dims = parse_topology(topology)
+    if dims is None:
+        return None
+    n = 1
+    for d in dims:
+        n *= d
+    return n
+
+
+def topology_error(version: str, topology: str) -> str | None:
+    """Why (version, topology) is invalid — None when the pair is fine."""
+    if version not in GENERATIONS:
+        return (f"{version!r} is not a known TPU generation "
+                f"(known: {', '.join(GENERATIONS)})")
+    dims = parse_topology(topology)
+    if dims is None:
+        return (f"topology {topology!r} is malformed — expected "
+                f"\"AxB\" or \"AxBxC\" with positive integer dims")
+    want = DIMS[version]
+    if len(dims) != want:
+        return (f"{version} slices use {want}-D topologies "
+                f"({'AxB' if want == 2 else 'AxBxC'}), got {topology!r}")
+    chips = 1
+    for d in dims:
+        chips *= d
+    if chips > MAX_CHIPS[version]:
+        return (f"topology {topology!r} is {chips} chips — above the "
+                f"{MAX_CHIPS[version]}-chip ceiling of {version}")
+    if want == 2:
+        if topology not in TOPOLOGIES_2D:
+            return (f"{topology!r} is not a supported {version} topology "
+                    f"(supported: {', '.join(sorted(TOPOLOGIES_2D, key=chips_of))})")
+        return None
+    # 3-D: structural checks (conservative superset, see module docstring)
+    bad = [d for d in dims if d not in DIMS_3D]
+    if bad:
+        return (f"topology {topology!r}: dimension {bad[0]} is not a "
+                f"{version} increment (allowed: "
+                f"{', '.join(str(d) for d in DIMS_3D)})")
+    if chips % CHIPS_PER_HOST[version] != 0:
+        return (f"topology {topology!r} is {chips} chips, which does not "
+                f"factor into {CHIPS_PER_HOST[version]}-chip hosts")
+    return None
+
+
+_SUFFIX_GEN = {"ct4p": "v4", "ct5lp": "v5e", "ct5p": "v5p", "ct6e": "v6e"}
+
+
+def parse_machine_type(machine_type: str) -> tuple[str, int] | None:
+    """``"ct5lp-hightpu-4t"`` → ``("v5e", 4)``; None for non-TPU machines
+    or TPU machines whose family/class combination does not exist."""
+    import re
+
+    m = re.match(r"^(ct4p|ct5lp|ct5p|ct6e)-(hightpu|standard)-(\d+)t$",
+                 machine_type)
+    if not m:
+        return None
+    gen = _SUFFIX_GEN[m.group(1)]
+    if MACHINE_PREFIX[gen] != f"{m.group(1)}-{m.group(2)}":
+        return None
+    return gen, int(m.group(3))
+
+
+def valid_host_chips(version: str, chips: int) -> bool:
+    """Can one host of ``version`` carry ``chips`` chips?"""
+    if version in SINGLE_HOST_PACK:
+        return chips in SINGLE_HOST_PACK[version]
+    return chips == CHIPS_PER_HOST[version]

@@ -87,6 +87,20 @@ def _pad(v: tuple[int, ...], n: int = 3) -> tuple[int, ...]:
     return v + (0,) * (n - len(v))
 
 
+_CLAUSE_RE = re.compile(r"^(~>|>=|<=|!=|[=><])?\s*([\d.]+)$")
+
+
+def parse_constraint_clause(clause: str):
+    """``(op, version-string)`` for one constraint clause, ``None`` when
+    it does not parse; a bare version means ``=``. The ONE copy of the
+    clause grammar — the lint pinning rule consumes it too, so the two
+    surfaces can never drift."""
+    m = _CLAUSE_RE.match(clause.strip())
+    if m is None:
+        return None
+    return (m.group(1) or "="), m.group(2)
+
+
 def constraint_satisfied(version: str, constraint: str) -> bool:
     """Terraform (go-version) constraint semantics: ``=``, ``!=``, ``>``,
     ``>=``, ``<``, ``<=``, ``~>`` with comma-separated conjunction.
@@ -99,10 +113,10 @@ def constraint_satisfied(version: str, constraint: str) -> bool:
         clause = clause.strip()
         if not clause:
             continue
-        m = re.match(r"^(~>|>=|<=|!=|[=><])?\s*([\d.]+)$", clause)
-        if not m:
+        parsed = parse_constraint_clause(clause)
+        if parsed is None:
             raise LockfileError(f"unparsable constraint clause {clause!r}")
-        op, rhs = m.group(1) or "=", _ver(m.group(2))
+        op, rhs = parsed[0], _ver(parsed[1])
         n = max(len(v), len(rhs), 3)
         vp, rp = _pad(v, n), _pad(rhs, n)
         if op == "~>":

@@ -274,6 +274,11 @@ def _ingest(mod: Module, blk: A.Block, fname: str) -> None:
                     for item in attr.expr.items:
                         if isinstance(item.key, A.Literal) and isinstance(item.value, A.Literal):
                             spec[str(item.key.value)] = item.value.value
+                elif isinstance(attr.expr, A.Literal) and \
+                        isinstance(attr.expr.value, str):
+                    # legacy shorthand: google = "~> 5.0" is a bare
+                    # version constraint (terraform 0.12 form)
+                    spec["version"] = attr.expr.value
                 mod.required_providers[attr.name] = spec
         for bk in blk.body.blocks_of("backend"):
             if mod.backend is not None:

@@ -41,14 +41,21 @@ class BlockSchema:
     # attribute assignment aren't a thing in this repo; `open` marks block
     # bodies we deliberately don't enumerate (free-form maps, etc.)
     open: bool = False
+    # arguments the certified provider version still ACCEPTS but has
+    # deprecated: name → migration hint. Deprecated args stay in `attrs`
+    # (validate passes), and `tfsim lint` surfaces them with the hint
+    deprecated: dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 def _bs(attrs: str = "", req: str = "",
         blocks: dict[str, BlockSchema] | None = None,
-        open: bool = False) -> BlockSchema:
+        open: bool = False,
+        deprecated: dict[str, str] | None = None) -> BlockSchema:
     a = frozenset(attrs.split())
     r = frozenset(req.split())
-    return BlockSchema(attrs=a | r, required=r, blocks=blocks or {}, open=open)
+    d = deprecated or {}
+    return BlockSchema(attrs=a | r | frozenset(d), required=r,
+                       blocks=blocks or {}, open=open, deprecated=d)
 
 
 _TIMEOUTS = _bs("create read update delete")
@@ -102,10 +109,19 @@ SCHEMAS: dict[str, BlockSchema] = {
         "remove_default_node_pool initial_node_count min_master_version "
         "node_version deletion_protection enable_autopilot enable_tpu "
         "networking_mode datapath_provider enable_shielded_nodes "
-        "enable_intranode_visibility resource_labels logging_service "
-        "monitoring_service default_max_pods_per_node enable_legacy_abac "
+        "enable_intranode_visibility resource_labels "
+        "default_max_pods_per_node enable_legacy_abac "
         "enable_kubernetes_alpha node_locations allow_net_admin",
         req="name",
+        # NOT deprecated here: enable_binary_authorization — the google
+        # provider REMOVED it in v5.0 (binary_authorization block), so at
+        # the certified 6.8.0 it must stay an unknown-argument error
+        deprecated={
+            "logging_service":
+                "use the logging_config block (enable_components)",
+            "monitoring_service":
+                "use the monitoring_config block (enable_components)",
+        },
         blocks={
             "release_channel": _bs(req="channel"),
             "workload_identity_config": _bs("workload_pool"),
@@ -255,17 +271,25 @@ SCHEMAS: dict[str, BlockSchema] = {
     "random_id": _bs("keepers prefix", req="byte_length"),
     "random_string": _bs("length lower upper numeric special min_lower "
                          "min_upper min_numeric min_special override_special "
-                         "keepers"),
+                         "keepers",
+                         deprecated={
+                             "number": "renamed to 'numeric' in random "
+                                       "provider 3.x",
+                         }),
     # --------------------------------------------------------------- helm
     "helm_release": _bs(
         "repository chart version namespace create_namespace atomic "
         "cleanup_on_fail replace timeout wait wait_for_jobs values "
-        "max_history recreate_pods force_update reuse_values reset_values "
+        "max_history force_update reuse_values reset_values "
         "skip_crds dependency_update disable_webhooks verify "
         "render_subchart_notes disable_openapi_validation lint description "
         "devel keyring repository_key_file repository_cert_file "
         "repository_ca_file repository_username repository_password",
         req="name",
+        deprecated={
+            "recreate_pods": "superseded by atomic/cleanup_on_fail upgrade "
+                             "semantics in helm provider 2.x",
+        },
         blocks={
             "set": _bs("type", req="name value"),
             "set_sensitive": _bs("type", req="name value"),
@@ -425,7 +449,15 @@ def check_resource_schema(r: Resource) -> list[tuple[int, str]]:
 
 
 def _walk(body: A.Body, schema: BlockSchema, path: str,
-          problems: list[tuple[int, str]], top: bool = False) -> None:
+          problems: list[tuple[int, str]], top: bool = False,
+          visit=None) -> None:
+    """THE schema-aware body walker: reports violations into ``problems``
+    and, when ``visit`` is given, calls ``visit(body, schema, path)`` on
+    every schema-resolvable body (root, nested blocks, dynamic content) —
+    so other per-argument analyses (deprecation) ride the same descent
+    instead of re-implementing it."""
+    if visit is not None:
+        visit(body, schema, path)
     seen_attrs = {a.name for a in body.attributes}
     seen_blocks = {
         (b.labels[0] if b.type == "dynamic" and b.labels else b.type)
@@ -474,7 +506,8 @@ def _walk(body: A.Body, schema: BlockSchema, path: str,
                 elif sub is not None:
                     # dynamic bodies assemble full block instances, so
                     # required-attr checking applies inside content too
-                    _walk(ib.body, sub, f"{path}.{name}", problems)
+                    _walk(ib.body, sub, f"{path}.{name}", problems,
+                          visit=visit)
             continue
         if top and b.type in _META_BLOCKS:
             continue
@@ -490,10 +523,29 @@ def _walk(body: A.Body, schema: BlockSchema, path: str,
                 problems.append((b.line,
                                  f"{path}: unsupported block {b.type!r}"))
             continue
-        _walk(b.body, sub, f"{path}.{b.type}", problems)
+        _walk(b.body, sub, f"{path}.{b.type}", problems, visit=visit)
     # blocks shadowing required attrs don't satisfy them; nothing to do —
     # required checking above is attribute-only by design.
     del seen_blocks
+
+
+def check_deprecated_args(r: Resource) -> list[tuple[int, str, str]]:
+    """(line, argument path, migration hint) for each deprecated argument
+    assigned anywhere in one resource — the lint layer's feed (validate
+    stays green on deprecated-but-accepted arguments by design)."""
+    schema = (DATA_SCHEMAS if r.mode == "data" else SCHEMAS).get(r.type)
+    if schema is None:
+        return []
+    found: list[tuple[int, str, str]] = []
+
+    def visit(body: A.Body, sub: BlockSchema, path: str) -> None:
+        for a in body.attributes:
+            hint = sub.deprecated.get(a.name)
+            if hint is not None:
+                found.append((a.line, f"{path}.{a.name}", hint))
+
+    _walk(r.body, schema, r.type, [], top=True, visit=visit)
+    return found
 
 
 def skeleton_hcl(addr: str, resource_id: str) -> str:

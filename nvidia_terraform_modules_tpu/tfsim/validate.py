@@ -10,27 +10,26 @@ outputs — cf. terraform-docs-generated READMEs, ``/root/reference/CONTRIBUTING
 
 from __future__ import annotations
 
-import dataclasses
-
 from . import ast as A
+# Finding lives in the lint engine now — ONE diagnostic record for the
+# whole static-analysis stack (validate findings carry lint rule ids, so
+# `tfsim lint` bridges them in as suppressible, overridable core-* rules)
+from .lint.engine import Finding  # noqa: F401  (re-exported API)
 from .module import Module, Resource
 from .schema import check_resource_schema
-
-
-@dataclasses.dataclass
-class Finding:
-    severity: str   # "error" | "warning"
-    where: str      # file:line
-    message: str
-
-    def __str__(self) -> str:
-        return f"{self.severity}: {self.where}: {self.message}"
 
 
 _BUILTIN_ROOTS = {"var", "local", "data", "module", "each", "count", "path",
                   "terraform", "self"}
 
-# resource-type prefix → acceptable provider local names
+# resource-type prefix → acceptable provider local names. `google-beta`
+# has no prefix of its own (beta resources share the `google_` namespace,
+# so no rtype ever splits to a dashed prefix); a resource OPTS INTO it
+# with the `provider = google-beta` meta-argument, which
+# `_explicit_provider` resolves ahead of this prefix map — so a
+# google-beta-only module passes, and a module that uses the meta-argument
+# without requiring google-beta fails, instead of both leaning on the
+# fuzzy two-name set below.
 _PROVIDER_OF_PREFIX = {
     "google": {"google", "google-beta"},
     "kubernetes": {"kubernetes"},
@@ -45,6 +44,30 @@ _PROVIDER_OF_PREFIX = {
 
 def _provider_for_type(rtype: str) -> str:
     return rtype.split("_", 1)[0]
+
+
+def _explicit_provider(r: Resource) -> str | None:
+    """Local provider name from a ``provider = google-beta`` (or
+    ``provider = google.alias``) meta-argument; None when defaulted."""
+    a = r.body.attr("provider")
+    if a is not None and isinstance(a.expr, A.Traversal):
+        return a.expr.root
+    return None
+
+
+def _pins_where(mod: Module) -> str:
+    """Anchor for the module-level pin findings. The ``terraform`` block,
+    when one exists, is a real suppressible file:line; otherwise the first
+    source file at line 0 — a location the CLI's range filters render
+    without a line number but whose artifact at least exists (a synthetic
+    ``versions.tf`` URI would point SARIF ingestors at a missing file)."""
+    for fname in sorted(mod.files):
+        for blk in mod.files[fname].blocks:
+            if blk.type == "terraform":
+                return f"{fname}:{blk.line}"
+    if mod.files:
+        return f"{min(mod.files)}:0"
+    return "versions.tf:0"
 
 
 def validate_module(mod: Module) -> list[Finding]:
@@ -63,44 +86,81 @@ def validate_module(mod: Module) -> list[Finding]:
     for v in mod.variables.values():
         where = f"{v.file}:{v.line}"
         if not v.description:
-            add(Finding("warning", where, f"variable {v.name!r} has no description"))
+            add(Finding("warning", where,
+                        f"variable {v.name!r} has no description",
+                        rule="core-style"))
         if v.type is None:
-            add(Finding("warning", where, f"variable {v.name!r} has no type"))
+            add(Finding("warning", where, f"variable {v.name!r} has no type",
+                        rule="core-style"))
     for o in mod.outputs.values():
         where = f"{o.file}:{o.line}"
         if not o.description:
-            add(Finding("warning", where, f"output {o.name!r} has no description"))
+            add(Finding("warning", where,
+                        f"output {o.name!r} has no description",
+                        rule="core-style"))
         if o.expr is None:
-            add(Finding("error", where, f"output {o.name!r} has no value"))
+            add(Finding("error", where, f"output {o.name!r} has no value",
+                        rule="core-source"))
 
     # ---- resource-level checks ---------------------------------------
     for r in list(mod.resources.values()) + list(mod.data_sources.values()):
         where = f"{r.file}:{r.line}"
         if r.body.attr("count") is not None and r.body.attr("for_each") is not None:
             add(Finding("error", where,
-                        f"{r.address}: both count and for_each set"))
+                        f"{r.address}: both count and for_each set",
+                        rule="core-exclusive"))
+        explicit = _explicit_provider(r)
         prov = _provider_for_type(r.type)
         accepted = _PROVIDER_OF_PREFIX.get(prov, {prov})
-        if mod.required_providers and not (accepted & set(mod.required_providers)):
-            add(Finding("error", where,
-                        f"{r.address}: no required_providers entry for "
-                        f"provider {prov!r}"))
+        if explicit is not None and mod.required_providers:
+            # the meta-argument names an exact local provider — require
+            # THAT entry, not anything the type prefix would accept...
+            if explicit not in mod.required_providers:
+                add(Finding("error", where,
+                            f"{r.address}: no required_providers entry "
+                            f"for provider {explicit!r} (named by its "
+                            f"provider meta-argument)",
+                            rule="core-provider"))
+            else:
+                # ...but the entry must actually provide this resource
+                # type: its source suffix (or, sourceless, its local
+                # name) has to match the prefix — `provider = kubernetes`
+                # on a google_* resource is init-time nonsense
+                src = str(mod.required_providers[explicit]
+                          .get("source", "") or "")
+                if (src.rpartition("/")[2] or explicit) not in accepted:
+                    add(Finding("error", where,
+                                f"{r.address}: provider meta-argument "
+                                f"names {explicit!r} (source "
+                                f"{src or explicit!r}), which does not "
+                                f"provide {prov}_* resources",
+                                rule="core-provider"))
+        elif explicit is None:
+            if mod.required_providers and \
+                    not (accepted & set(mod.required_providers)):
+                add(Finding("error", where,
+                            f"{r.address}: no required_providers entry for "
+                            f"provider {prov!r}", rule="core-provider"))
         # provider-schema argument checking (the `machine_typ =` typo class)
         for line, msg in check_resource_schema(r):
-            add(Finding("error", f"{r.file}:{line}", f"{r.address}: {msg}"))
+            add(Finding("error", f"{r.file}:{line}", f"{r.address}: {msg}",
+                        rule="core-schema"))
 
+    pins_where = _pins_where(mod)
     if not mod.required_providers and (mod.resources or mod.data_sources):
-        add(Finding("warning", "versions.tf:0",
-                    "module declares no required_providers"))
+        add(Finding("warning", pins_where,
+                    "module declares no required_providers",
+                    rule="core-pins"))
     if mod.required_version is None and (mod.resources or mod.data_sources):
-        add(Finding("warning", "versions.tf:0",
-                    "module declares no required_version"))
+        add(Finding("warning", pins_where,
+                    "module declares no required_version", rule="core-pins"))
 
     # ---- module calls ------------------------------------------------
     for mc in mod.module_calls.values():
         if mc.body.attr("source") is None:
             add(Finding("error", f"{mc.file}:{mc.line}",
-                        f"module {mc.name!r} has no source"))
+                        f"module {mc.name!r} has no source",
+                        rule="core-source"))
 
     # ---- reference integrity ----------------------------------------
     def check_refs(body_or_expr, file: str):
@@ -111,8 +171,14 @@ def validate_module(mod: Module) -> list[Finding]:
 
     for r in list(mod.resources.values()) + list(mod.data_sources.values()):
         check_refs(r.body, r.file)
-    for name, expr in mod.locals.items():
-        check_refs(expr, "locals")
+    # locals from the file ASTs, not the flattened mod.locals dict — the
+    # dict drops filenames, and a "locals:NN" pseudo-location can neither
+    # be suppressed (# tfsim:ignore keys on the real file) nor annotated
+    # by a CI ingestor (the file doesn't exist)
+    for fname, body in mod.files.items():
+        for blk in body.blocks:
+            if blk.type == "locals":
+                check_refs(blk.body, fname)
     for o in mod.outputs.values():
         if o.expr is not None:
             check_refs(o.expr, o.file)
@@ -120,6 +186,15 @@ def validate_module(mod: Module) -> list[Finding]:
         check_refs(mc.body, mc.file)
     for p in mod.providers:
         check_refs(p.body, p.file)
+    # variable blocks' own bodies: a default referencing an undeclared
+    # name and a validation condition against a typo'd variable both used
+    # to sail through (the blocks were never walked). Type exprs stay
+    # unwalked — their bare idents are type keywords, not references.
+    for v in mod.variables.values():
+        if v.default is not None:
+            check_refs(v.default, v.file)
+        for vb in v.validations:
+            check_refs(vb.body, v.file)
 
     return findings
 
@@ -133,36 +208,41 @@ def _check_traversal(t: A.Traversal, file, mod, resources_by_type,
     if root == "var":
         if t.ops and t.ops[0][0] == "attr" and t.ops[0][1] not in mod.variables:
             add(Finding("error", line,
-                        f"reference to undeclared variable var.{t.ops[0][1]}"))
+                        f"reference to undeclared variable var.{t.ops[0][1]}",
+                        rule="core-ref"))
         return
     if root == "local":
         if t.ops and t.ops[0][0] == "attr" and t.ops[0][1] not in mod.locals:
             add(Finding("error", line,
-                        f"reference to undeclared local local.{t.ops[0][1]}"))
+                        f"reference to undeclared local local.{t.ops[0][1]}",
+                        rule="core-ref"))
         return
     if root == "data":
         if len(t.ops) >= 2 and t.ops[0][0] == "attr" and t.ops[1][0] == "attr":
             dtype, dname = t.ops[0][1], t.ops[1][1]
             if dtype not in data_types or dname not in data_types[dtype]:
                 add(Finding("error", line,
-                            f"reference to undeclared data.{dtype}.{dname}"))
+                            f"reference to undeclared data.{dtype}.{dname}",
+                            rule="core-ref"))
         return
     if root == "module":
         if t.ops and t.ops[0][0] == "attr" and t.ops[0][1] not in mod.module_calls:
             add(Finding("error", line,
-                        f"reference to undeclared module.{t.ops[0][1]}"))
+                        f"reference to undeclared module.{t.ops[0][1]}",
+                        rule="core-ref"))
         return
     if root in _BUILTIN_ROOTS:
         return
     if root in resources_by_type:
         if t.ops and t.ops[0][0] == "attr" and t.ops[0][1] not in resources_by_type[root]:
             add(Finding("error", line,
-                        f"reference to undeclared resource {root}.{t.ops[0][1]}"))
+                        f"reference to undeclared resource {root}.{t.ops[0][1]}",
+                        rule="core-ref"))
         return
     if "_" in root:
         add(Finding("error", line,
                     f"reference to undeclared resource type {root!r} "
-                    f"({t.path_str()})"))
+                    f"({t.path_str()})", rule="core-ref"))
     # bare single identifiers that are neither builtins nor resource types are
     # type keywords (string, number, bool, any, ...) or iterator names handled
     # by `bound`; type keywords only appear inside variable type exprs, which

@@ -1,0 +1,1492 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""tfsim lint: the pluggable rule engine and its three analysis families.
+
+Covers the engine machinery (registry, severity overrides, ``tfsim:ignore``
+suppressions, severity-based exit codes), the TPU-semantic rules against
+the vendored generation facts, the dead-code/drift rules, the
+deprecation/pinning rules, and the CLI text/JSON/SARIF surfaces.
+
+The tier-1 anchor: the shipped ``gke-tpu/`` tree (module + both examples)
+must lint clean — new HCL that introduces a finding fails here, not in a
+user's pre-apply run.
+"""
+
+import json
+import os
+
+import pytest
+
+from nvidia_terraform_modules_tpu.tfsim.__main__ import main
+from nvidia_terraform_modules_tpu.tfsim.lint import (
+    Finding,
+    exit_code,
+    list_rules,
+    run_lint,
+)
+from nvidia_terraform_modules_tpu.tfsim.lint import tpu_facts as T
+from nvidia_terraform_modules_tpu.tfsim.module import load_module
+from nvidia_terraform_modules_tpu.tfsim.validate import validate_module
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GKE_TPU = os.path.join(ROOT, "gke-tpu")
+
+# a pinned terraform{} preamble so fixture findings are only the ones a
+# test plants (no core-pins / unpinned-provider noise)
+PREAMBLE = """\
+terraform {
+  required_version = ">= 1.5.0"
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = "~> 5.0"
+    }
+  }
+}
+"""
+
+
+def write_mod(tmp_path, body, fname="main.tf", preamble=True):
+    (tmp_path / fname).write_text((PREAMBLE if preamble else "") + body)
+    return str(tmp_path)
+
+
+def by_rule(findings, rule_id):
+    return [f for f in findings if f.rule == rule_id]
+
+
+# ===================================================================== tier-1
+# The shipped HCL stays lint-clean: error/warning findings in gke-tpu/ or
+# its examples are a regression (info findings are advisory by design).
+
+@pytest.mark.parametrize("rel", [
+    "gke-tpu",
+    os.path.join("gke-tpu", "examples", "multislice"),
+    os.path.join("gke-tpu", "examples", "cnpack"),
+])
+def test_shipped_hcl_lints_clean(rel):
+    path = os.path.join(ROOT, rel)
+    findings = run_lint(path)
+    noisy = [f for f in findings if f.severity in ("error", "warning")]
+    assert noisy == [], [str(f) for f in noisy]
+    assert main(["lint", path]) == 0
+
+
+def test_gke_module_lints_clean():
+    assert main(["lint", os.path.join(ROOT, "gke")]) == 0
+
+
+# ==================================================================== engine
+
+def test_rule_catalog_families_and_defaults():
+    rules = {r.id: r for r in list_rules()}
+    # one family per analysis axis of the ISSUE, plus the validate bridge
+    assert {r.family for r in rules.values()} == {
+        "core", "tpu", "dead-code", "deprecation"}
+    assert rules["tpu-invalid-topology"].severity == "error"
+    assert rules["unused-variable"].severity == "warning"
+    assert rules["deprecated-argument"].severity == "warning"
+    assert rules["unused-module-output"].severity == "info"
+    # every validate finding family is bridged as a core-* rule, plus
+    # the safety net for ids the table doesn't know
+    assert {i for i in rules if i.startswith("core-")} == {
+        "core-ref", "core-schema", "core-provider", "core-exclusive",
+        "core-source", "core-style", "core-pins", "core-load",
+        "core-unbridged"}
+
+
+def test_exit_code_ladder():
+    assert exit_code([]) == 0
+    assert exit_code([Finding("info", "a.tf:1", "x")]) == 0
+    assert exit_code([Finding("warning", "a.tf:1", "x")]) == 1
+    assert exit_code([Finding("info", "a.tf:1", "x"),
+                      Finding("warning", "a.tf:2", "y"),
+                      Finding("error", "a.tf:3", "z")]) == 2
+
+
+def test_findings_sorted_by_location(tmp_path):
+    write_mod(tmp_path, """
+variable "zz_unused" {
+  description = "d"
+  type        = string
+}
+
+variable "aa_unused" {
+  description = "d"
+  type        = string
+}
+""")
+    found = by_rule(run_lint(str(tmp_path)), "unused-variable")
+    assert [f.line for f in found] == sorted(f.line for f in found)
+
+
+def test_severity_override_promotes_and_disables(tmp_path):
+    path = write_mod(tmp_path, """
+variable "unused" {
+  description = "d"
+  type        = string
+}
+""")
+    base = by_rule(run_lint(path), "unused-variable")
+    assert [f.severity for f in base] == ["warning"]
+    promoted = run_lint(path, overrides={"unused-variable": "error"})
+    assert by_rule(promoted, "unused-variable")[0].severity == "error"
+    off = run_lint(path, overrides={"unused-variable": "off"})
+    assert by_rule(off, "unused-variable") == []
+
+
+def test_severity_override_validates_rule_and_level(tmp_path):
+    path = write_mod(tmp_path, "")
+    with pytest.raises(ValueError, match="unknown rule id"):
+        run_lint(path, overrides={"no-such-rule": "error"})
+    with pytest.raises(ValueError, match="level must be one of"):
+        run_lint(path, overrides={"unused-variable": "loud"})
+
+
+def test_suppression_trailing_comment(tmp_path):
+    path = write_mod(tmp_path, """
+variable "unused" {  # tfsim:ignore unused-variable
+  description = "d"
+  type        = string
+}
+""")
+    assert by_rule(run_lint(path), "unused-variable") == []
+
+
+def test_suppression_standalone_comment_covers_next_line(tmp_path):
+    path = write_mod(tmp_path, """
+# tfsim:ignore unused-variable
+variable "unused" {
+  description = "d"
+  type        = string
+}
+""")
+    assert by_rule(run_lint(path), "unused-variable") == []
+
+
+def test_suppression_wildcard_and_wrong_id(tmp_path):
+    path = write_mod(tmp_path, """
+variable "a" {  # tfsim:ignore *
+  description = "d"
+  type        = string
+}
+
+variable "b" {  # tfsim:ignore tpu-invalid-topology
+  description = "d"
+  type        = string
+}
+""")
+    found = by_rule(run_lint(path), "unused-variable")
+    # the wildcard silences 'a'; the mismatched id does NOT silence 'b'
+    assert ["'b'" in f.message for f in found] == [True]
+
+
+def test_suppression_prose_tail_does_not_suppress_extra_rules(tmp_path):
+    """The id list ends at the first non-rule token: an explanation that
+    happens to CONTAIN a rule id ("core-ref") must not suppress it."""
+    path = write_mod(tmp_path, """
+# tfsim:ignore unused-variable and also fix the core-ref here later
+variable "orphan" {
+  description = "d"
+  type        = string
+  default     = bogus_type.thing.id
+}
+""")
+    findings = run_lint(path)
+    assert by_rule(findings, "unused-variable") == []      # listed → gone
+    assert len(by_rule(findings, "core-ref")) == 1         # prose → kept
+
+
+# ================================================================= tpu rules
+
+def _slices_fixture(tmp_path, entries, where="default"):
+    """A module declaring tpu_slices via variable default / tfvars /
+    module-call argument, per ``where``."""
+    obj = "{\n" + "\n".join(
+        f'    {name} = {{ version = "{v}" topology = "{t}"'
+        + (f" prefer_single_host = {str(p).lower()}" if p is not None else "")
+        + " }"
+        for name, (v, t, p) in entries.items()) + "\n  }"
+    if where == "default":
+        body = f"""
+variable "tpu_slices" {{
+  description = "slices"
+  type        = any
+  default = {obj}
+}}
+
+output "echo" {{
+  description = "keep the variable used"
+  value       = var.tpu_slices
+}}
+"""
+        return write_mod(tmp_path, body)
+    if where == "tfvars":
+        (tmp_path / "terraform.tfvars").write_text(f"tpu_slices = {obj}\n")
+        return write_mod(tmp_path, """
+variable "tpu_slices" {
+  description = "slices"
+  type        = any
+}
+
+output "echo" {
+  description = "keep the variable used"
+  value       = var.tpu_slices
+}
+""")
+    raise AssertionError(where)
+
+
+def test_invalid_topology_pair_flagged_with_location(tmp_path):
+    path = _slices_fixture(tmp_path, {"bad": ("v5e", "3x7", None)})
+    found = by_rule(run_lint(path), "tpu-invalid-topology")
+    assert len(found) == 1
+    f = found[0]
+    assert f.severity == "error"
+    assert f.file == "main.tf" and f.line > 0
+    assert "'bad'" in f.message and "3x7" in f.message
+    # acceptance: the CLI exits non-zero on it
+    assert main(["lint", path]) == 2
+
+
+def test_invalid_topology_in_tfvars(tmp_path):
+    path = _slices_fixture(tmp_path, {"bad": ("v4", "2x2", None)},
+                           where="tfvars")
+    found = by_rule(run_lint(path), "tpu-invalid-topology")
+    assert len(found) == 1
+    assert found[0].file == "terraform.tfvars"
+    assert "3-D" in found[0].message
+
+
+def test_invalid_topology_in_module_call(tmp_path):
+    child = tmp_path / "child"
+    child.mkdir()
+    (child / "main.tf").write_text("""
+variable "tpu_slices" {
+  description = "slices"
+  type        = any
+  default     = {}
+}
+""")
+    path = write_mod(tmp_path, """
+module "fleet" {
+  source = "./child"
+  tpu_slices = {
+    big = { version = "v5p" topology = "3x4x4" }
+  }
+}
+""")
+    found = by_rule(run_lint(path), "tpu-invalid-topology")
+    assert len(found) == 1
+    assert "module 'fleet' call" in found[0].message
+    assert "3 is not a v5p increment" in found[0].message
+
+
+def test_unknown_generation_owns_the_finding(tmp_path):
+    path = _slices_fixture(tmp_path, {"bad": ("v9x", "2x2", None)})
+    findings = run_lint(path)
+    assert len(by_rule(findings, "tpu-unknown-version")) == 1
+    # no double-report from the topology rule
+    assert by_rule(findings, "tpu-invalid-topology") == []
+
+
+def test_topology_resolved_through_variable_default(tmp_path):
+    path = write_mod(tmp_path, """
+variable "shape" {
+  description = "ICI topology"
+  type        = string
+  default     = "5x5"
+}
+
+variable "tpu_slices" {
+  description = "slices"
+  type        = any
+  default = {
+    main = { version = "v6e" topology = var.shape }
+  }
+}
+
+output "echo" {
+  description = "keep used"
+  value       = [var.tpu_slices, var.shape]
+}
+""")
+    found = by_rule(run_lint(path), "tpu-invalid-topology")
+    assert len(found) == 1 and "5x5" in found[0].message
+
+
+def test_topology_inherited_from_optional_type_default(tmp_path):
+    """An entry ``{}`` inherits (version, topology) from the variable's
+    ``optional(type, default)`` declarations — the shipped module's
+    idiom — so a bad type-level default is NOT a blind spot."""
+    path = write_mod(tmp_path, """
+variable "tpu_slices" {
+  description = "slices"
+  type = map(object({
+    version  = optional(string, "v5e")
+    topology = optional(string, "3x7")
+  }))
+  default = {
+    inherits = {}
+  }
+}
+
+output "echo" {
+  description = "keep used"
+  value       = var.tpu_slices
+}
+""")
+    found = by_rule(run_lint(path), "tpu-invalid-topology")
+    assert len(found) == 1
+    assert "'inherits'" in found[0].message and "3x7" in found[0].message
+
+
+def test_explicit_field_overrides_optional_default(tmp_path):
+    path = write_mod(tmp_path, """
+variable "tpu_slices" {
+  description = "slices"
+  type = map(object({
+    version  = optional(string, "v5e")
+    topology = optional(string, "3x7")
+  }))
+  default = {
+    fixed = { topology = "2x4" }
+  }
+}
+
+output "echo" {
+  description = "keep used"
+  value       = var.tpu_slices
+}
+""")
+    assert by_rule(run_lint(path), "tpu-invalid-topology") == []
+
+
+def test_module_call_inherits_child_optional_defaults(tmp_path):
+    child = tmp_path / "child"
+    child.mkdir()
+    (child / "main.tf").write_text("""
+variable "tpu_slices" {
+  description = "slices"
+  type = map(object({
+    version  = optional(string, "v4")
+    topology = optional(string, "2x2x2")
+  }))
+  default = {}
+}
+""")
+    path = write_mod(tmp_path, """
+module "fleet" {
+  source = "./child"
+  tpu_slices = {
+    flat = { topology = "4x4" }
+  }
+}
+""")
+    found = by_rule(run_lint(path), "tpu-invalid-topology")
+    # inherited version v4 is 3-D; the explicit 2-D topology is invalid
+    assert len(found) == 1 and "3-D" in found[0].message
+
+
+def test_single_host_packing_warnings(tmp_path):
+    path = _slices_fixture(tmp_path, {
+        "pod": ("v4", "2x2x2", True),       # packing impossible on v4
+        "wide": ("v5e", "4x4", True),       # 16 chips never fit one host
+        "ok": ("v5e", "2x4", True),         # 8 chips pack onto ct5lp-8t
+    })
+    found = by_rule(run_lint(path), "tpu-singlehost-packing")
+    msgs = " | ".join(f.message for f in found)
+    assert len(found) == 2
+    assert "'pod'" in msgs and "'wide'" in msgs and "'ok'" not in msgs
+
+
+def test_generation_facts_drift_detected(tmp_path):
+    path = write_mod(tmp_path, """
+locals {
+  tpu_generations = {
+    v5e = {
+      node_selector  = "tpu-v5-lite-podslice"
+      machine        = "ct5lp-hightpu"
+      chips_per_host = 8
+    }
+    v9z = {
+      node_selector = "tpu-v9z-slice"
+    }
+  }
+}
+
+output "echo" {
+  description = "keep used"
+  value       = local.tpu_generations
+}
+""")
+    found = by_rule(run_lint(path), "tpu-generation-facts")
+    msgs = " | ".join(f.message for f in found)
+    assert "chips_per_host" in msgs and "v9z" in msgs
+    assert len(found) == 2
+
+
+def test_pool_chip_arithmetic_host_count(tmp_path):
+    path = write_mod(tmp_path, """
+resource "google_container_node_pool" "slice" {
+  name       = "slice"
+  node_count = 3
+
+  placement_policy {
+    type         = "COMPACT"
+    tpu_topology = "2x4"
+  }
+
+  node_config {
+    machine_type = "ct5lp-hightpu-4t"
+  }
+}
+""")
+    found = by_rule(run_lint(path), "tpu-chip-arithmetic")
+    assert len(found) == 1
+    assert "node_count = 3" in found[0].message
+    assert "2 host(s)" in found[0].message
+
+
+def test_pool_single_host_machine_with_multihost_topology(tmp_path):
+    path = write_mod(tmp_path, """
+resource "google_container_node_pool" "slice" {
+  name = "slice"
+
+  placement_policy {
+    type         = "COMPACT"
+    tpu_topology = "4x4"
+  }
+
+  node_config {
+    machine_type = "ct5lp-hightpu-8t"
+  }
+}
+""")
+    found = by_rule(run_lint(path), "tpu-chip-arithmetic")
+    assert len(found) == 1
+    assert "single-host packing" in found[0].message
+
+
+def test_pool_impossible_host_chips(tmp_path):
+    path = write_mod(tmp_path, """
+resource "google_container_node_pool" "slice" {
+  name = "slice"
+
+  node_config {
+    machine_type = "ct4p-hightpu-8t"
+  }
+}
+""")
+    found = by_rule(run_lint(path), "tpu-chip-arithmetic")
+    assert len(found) == 1 and "4" in found[0].message
+
+
+def test_multihost_pool_requires_compact_placement(tmp_path):
+    path = write_mod(tmp_path, """
+resource "google_container_node_pool" "bare" {
+  name       = "bare"
+  node_count = 4
+
+  node_config {
+    machine_type = "ct5p-hightpu-4t"
+  }
+}
+
+resource "google_container_node_pool" "spread" {
+  name       = "spread"
+  node_count = 2
+
+  placement_policy {
+    type         = "SPREAD"
+    tpu_topology = "2x2x1"
+  }
+
+  node_config {
+    machine_type = "ct4p-hightpu-4t"
+  }
+}
+""")
+    found = by_rule(run_lint(path), "tpu-multihost-placement")
+    assert len(found) == 2
+    # no-placement on a 4-chip-host machine is ambiguous (could be N
+    # independent single-host slices) → warning; a non-COMPACT placement
+    # type on a TPU pool is definitively wrong → error
+    by_msg = {("SPREAD" in f.message): f for f in found}
+    assert by_msg[True].severity == "error"
+    assert by_msg[False].severity == "warning"
+    assert "no placement_policy" in by_msg[False].message
+
+
+def test_single_host_machine_fleet_is_not_flagged(tmp_path):
+    """node_count > 1 of an 8t machine is N independent single-host
+    slices — the only reading tpu_facts permits — never an error."""
+    path = write_mod(tmp_path, """
+resource "google_container_node_pool" "fleet" {
+  name       = "fleet"
+  node_count = 3
+
+  node_config {
+    machine_type = "ct5lp-hightpu-8t"
+  }
+}
+""")
+    assert by_rule(run_lint(path), "tpu-multihost-placement") == []
+
+
+def test_tpu_facts_tables_agree_with_module():
+    """The vendored facts and gke-tpu's own tpu_generations local must
+    agree — the drift rule depends on the facts being right."""
+    mod = load_module(GKE_TPU)
+    import nvidia_terraform_modules_tpu.tfsim.eval as E
+    gens = E.evaluate(mod.locals["tpu_generations"], E.Scope())
+    assert set(gens) == set(T.GENERATIONS)
+    for gen, facts in gens.items():
+        assert facts["node_selector"] == T.NODE_SELECTOR[gen]
+        assert facts["machine"] == T.MACHINE_PREFIX[gen]
+        assert facts["chips_per_host"] == T.CHIPS_PER_HOST[gen]
+
+
+@pytest.mark.parametrize("version,topology,ok", [
+    ("v5e", "2x4", True),
+    ("v5e", "16x16", True),
+    ("v5e", "3x7", False),       # not in the closed 2-D set
+    ("v5e", "2x2x2", False),     # wrong dimensionality
+    ("v6e", "4x8", True),
+    ("v4", "2x2x4", True),
+    ("v4", "4x4", False),        # v4 is 3-D
+    ("v4", "2x3x4", False),      # 3 is not a documented increment
+    ("v5p", "8x8x16", True),
+    ("v5p", "16x20x20", False),  # 6400 chips > 8960? no — fits; adjust below
+    ("v4", "16x16x20", False),   # 5120 chips above the 4096 v4 ceiling
+    ("v5e", "1x0", False),       # malformed dims
+])
+def test_topology_error_table(version, topology, ok):
+    if (version, topology) == ("v5p", "16x20x20"):
+        # 6400 chips is within the v5p ceiling — expected valid
+        assert T.topology_error(version, topology) is None
+        return
+    err = T.topology_error(version, topology)
+    assert (err is None) == ok, err
+
+
+# ============================================================ dead-code rules
+
+def test_unused_variable_flagged_with_location(tmp_path):
+    path = write_mod(tmp_path, """
+variable "used" {
+  description = "d"
+  type        = string
+  default     = "x"
+}
+
+variable "orphan" {
+  description = "d"
+  type        = string
+}
+
+output "echo" {
+  description = "d"
+  value       = var.used
+}
+""")
+    found = by_rule(run_lint(path), "unused-variable")
+    assert len(found) == 1
+    f = found[0]
+    assert "'orphan'" in f.message
+    assert f.file == "main.tf" and f.line > 0
+    # acceptance: warnings exit 1
+    assert main(["lint", path]) == 1
+
+
+def test_variable_used_only_by_own_validation_is_unused(tmp_path):
+    path = write_mod(tmp_path, """
+variable "self_checked" {
+  description = "d"
+  type        = number
+
+  validation {
+    condition     = var.self_checked > 0
+    error_message = "must be positive"
+  }
+}
+""")
+    found = by_rule(run_lint(path), "unused-variable")
+    assert len(found) == 1 and "'self_checked'" in found[0].message
+
+
+def test_variable_used_by_another_validation_counts_as_used(tmp_path):
+    path = write_mod(tmp_path, """
+variable "limit" {
+  description = "d"
+  type        = number
+  default     = 8
+}
+
+variable "count_of" {
+  description = "d"
+  type        = number
+  default     = 4
+
+  validation {
+    condition     = var.count_of <= var.limit
+    error_message = "too many"
+  }
+}
+
+output "echo" {
+  description = "d"
+  value       = var.count_of
+}
+""")
+    assert by_rule(run_lint(path), "unused-variable") == []
+
+
+def test_unused_local_and_data_source(tmp_path):
+    path = write_mod(tmp_path, """
+locals {
+  live = "a"
+  dead = "b"
+}
+
+data "google_client_config" "current" {}
+
+output "echo" {
+  description = "d"
+  value       = local.live
+}
+""")
+    findings = run_lint(path)
+    locals_found = by_rule(findings, "unused-local")
+    assert len(locals_found) == 1 and "local.dead" in locals_found[0].message
+    data_found = by_rule(findings, "unreferenced-data-source")
+    assert len(data_found) == 1
+    assert "data.google_client_config.current" in data_found[0].message
+
+
+def test_tfvars_unknown_key_and_example_variant(tmp_path):
+    (tmp_path / "terraform.tfvars").write_text('ghost = "x"\n')
+    (tmp_path / "terraform.tfvars.example").write_text(
+        'declared = "x"\nstale_example = "y"\n')
+    path = write_mod(tmp_path, """
+variable "declared" {
+  description = "d"
+  type        = string
+}
+
+output "echo" {
+  description = "d"
+  value       = var.declared
+}
+""")
+    found = by_rule(run_lint(path), "tfvars-unknown-key")
+    assert {(f.file, f.message.split("'")[1]) for f in found} == {
+        ("terraform.tfvars", "ghost"),
+        ("terraform.tfvars.example", "stale_example"),
+    }
+
+
+def test_broken_tfvars_contained_not_fatal(tmp_path):
+    """A tfvars file that does not parse is ONE located core-load
+    finding — it must never abort the run and eat every other rule's
+    output (a broken docs-only .example would otherwise mask a real
+    TPU misconfiguration)."""
+    path = _slices_fixture(tmp_path, {"bad": ("v5e", "3x7", None)})
+    (tmp_path / "terraform.tfvars.example").write_text("not hcl ][\n")
+    findings = run_lint(path)
+    loads = by_rule(findings, "core-load")
+    assert len(loads) == 1
+    assert loads[0].file == "terraform.tfvars.example"
+    assert len(by_rule(findings, "tpu-invalid-topology")) == 1
+
+
+def test_lockfile_stale_provider(tmp_path):
+    (tmp_path / ".terraform.lock.hcl").write_text("""
+provider "registry.terraform.io/hashicorp/google" {
+  version     = "5.1.0"
+  constraints = "~> 5.0"
+}
+
+provider "registry.terraform.io/hashicorp/vault" {
+  version = "3.0.0"
+}
+""")
+    path = write_mod(tmp_path, """
+resource "google_compute_network" "n" {
+  name = "n"
+}
+""")
+    found = by_rule(run_lint(path), "lockfile-stale-provider")
+    assert len(found) == 1
+    assert "hashicorp/vault" in found[0].message
+    assert found[0].file == ".terraform.lock.hcl"
+
+
+def test_module_output_rules(tmp_path):
+    child = tmp_path / "child"
+    child.mkdir()
+    (child / "main.tf").write_text("""
+output "endpoint" {
+  description = "d"
+  value       = "e"
+}
+
+output "spare" {
+  description = "d"
+  value       = "s"
+}
+""")
+    path = write_mod(tmp_path, """
+module "svc" {
+  source = "./child"
+}
+
+output "ep" {
+  description = "d"
+  value       = module.svc.endpoint
+}
+
+output "bad" {
+  description = "d"
+  value       = module.svc.no_such_output
+}
+""")
+    findings = run_lint(path)
+    unknown = by_rule(findings, "unknown-module-output")
+    assert len(unknown) == 1
+    assert unknown[0].severity == "error"
+    assert "'no_such_output'" in unknown[0].message
+    unused = by_rule(findings, "unused-module-output")
+    assert ["'spare'" in f.message for f in unused] == [True]
+    assert unused[0].severity == "info"
+
+
+# ========================================================== deprecation rules
+
+def test_deprecated_argument_flagged_with_location(tmp_path):
+    path = write_mod(tmp_path, """
+resource "google_container_cluster" "c" {
+  name            = "c"
+  logging_service = "logging.googleapis.com/kubernetes"
+}
+""")
+    found = by_rule(run_lint(path), "deprecated-argument")
+    assert len(found) == 1
+    f = found[0]
+    assert f.severity == "warning"
+    assert f.file == "main.tf" and f.line > 0
+    assert "logging_service" in f.message
+    assert "logging_config" in f.message           # the migration hint
+    # acceptance: the CLI exits non-zero on it
+    assert main(["lint", path]) == 1
+
+
+def test_deprecated_argument_random_and_helm(tmp_path):
+    (tmp_path / "main.tf").write_text("""
+terraform {
+  required_version = ">= 1.5.0"
+  required_providers {
+    random = {
+      source  = "hashicorp/random"
+      version = "~> 3.0"
+    }
+    helm = {
+      source  = "hashicorp/helm"
+      version = "~> 2.0"
+    }
+  }
+}
+
+resource "random_string" "s" {
+  length = 8
+  number = true
+}
+
+resource "helm_release" "r" {
+  name          = "svc"
+  chart         = "svc"
+  recreate_pods = true
+}
+""")
+    found = by_rule(run_lint(str(tmp_path)), "deprecated-argument")
+    msgs = " | ".join(f.message for f in found)
+    assert "'random_string.number'" in msgs and "numeric" in msgs
+    assert "'helm_release.recreate_pods'" in msgs
+    assert len(found) == 2
+
+
+def test_deprecated_argument_inside_nested_and_dynamic_blocks(
+        tmp_path, monkeypatch):
+    """The deprecation check rides _walk's descent — static nested blocks
+    AND dynamic content bodies (no shipped schema deprecates a nested
+    arg yet, so a synthetic one proves the plumbing)."""
+    import nvidia_terraform_modules_tpu.tfsim.schema as S
+
+    fake = S._bs("name", blocks={
+        "tuning": S._bs("level", deprecated={"knob": "use level"}),
+    })
+    monkeypatch.setitem(S.SCHEMAS, "fake_widget", fake)
+    (tmp_path / "main.tf").write_text("""
+resource "fake_widget" "w" {
+  name = "w"
+
+  tuning {
+    knob = 1
+  }
+
+  dynamic "tuning" {
+    for_each = [1]
+    content {
+      knob = 2
+    }
+  }
+}
+""")
+    mod = load_module(str(tmp_path))
+    r = mod.resources["fake_widget.w"]
+    found = S.check_deprecated_args(r)
+    assert [(line, arg) for line, arg, _ in found] == [
+        (6, "fake_widget.tuning.knob"),
+        (12, "fake_widget.tuning.knob"),
+    ]
+
+
+def test_deprecated_args_schema_stays_valid(tmp_path):
+    """Deprecated arguments still VALIDATE (the provider accepts them) —
+    only lint warns. The two layers must not disagree."""
+    path = write_mod(tmp_path, """
+resource "google_container_cluster" "c" {
+  name            = "c"
+  logging_service = "logging.googleapis.com/kubernetes"
+}
+""")
+    mod = load_module(path)
+    errors = [f for f in validate_module(mod) if f.severity == "error"]
+    assert errors == [], [str(f) for f in errors]
+
+
+@pytest.mark.parametrize("constraint,pinned", [
+    ("~> 5.0", True),
+    ("= 5.1.0", True),
+    ("5.1.0", True),             # bare version means exact
+    (">= 4.0, < 6.0", True),     # bounded above by the second clause
+    (">= 4.0", False),
+    ("> 4.0", False),
+    (">= 4.0, != 4.5.0", False),  # != does not bound from above
+])
+def test_unpinned_provider_constraints(tmp_path, constraint, pinned):
+    (tmp_path / "main.tf").write_text("""
+terraform {
+  required_version = ">= 1.5.0"
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = "%s"
+    }
+  }
+}
+
+resource "google_compute_network" "n" {
+  name = "n"
+}
+""" % constraint)
+    found = by_rule(run_lint(str(tmp_path)), "unpinned-provider")
+    assert (found == []) == pinned, [str(f) for f in found]
+    if not pinned:
+        assert "no upper bound" in found[0].message
+
+
+def test_provider_without_constraint_warns(tmp_path):
+    (tmp_path / "main.tf").write_text("""
+terraform {
+  required_version = ">= 1.5.0"
+  required_providers {
+    google = {
+      source = "hashicorp/google"
+    }
+  }
+}
+
+resource "google_compute_network" "n" {
+  name = "n"
+}
+""")
+    found = by_rule(run_lint(str(tmp_path)), "unpinned-provider")
+    assert len(found) == 1
+    assert "no version constraint" in found[0].message
+
+
+def test_string_form_required_providers_entry(tmp_path):
+    """The terraform 0.12 shorthand `google = "~> 5.0"` IS a version
+    constraint — it must not read as 'no version constraint', and an
+    unpinned shorthand still warns."""
+    (tmp_path / "main.tf").write_text("""
+terraform {
+  required_version = ">= 1.5.0"
+  required_providers {
+    google     = "~> 5.0"
+    kubernetes = ">= 2.0"
+  }
+}
+
+resource "google_compute_network" "n" {
+  name = "n"
+}
+""")
+    found = by_rule(run_lint(str(tmp_path)), "unpinned-provider")
+    assert len(found) == 1
+    assert "'kubernetes'" in found[0].message
+    assert "no upper bound" in found[0].message
+
+
+# ====================================================== validate bridge (core)
+
+def test_core_rules_bridge_validate_findings(tmp_path):
+    path = write_mod(tmp_path, """
+resource "google_compute_network" "n" {
+  name = var.missing
+}
+""")
+    findings = run_lint(path)
+    core = by_rule(findings, "core-ref")
+    assert len(core) == 1 and "var.missing" in core[0].message
+    # bridged findings obey engine machinery: suppression...
+    (tmp_path / "main.tf").write_text(PREAMBLE + """
+resource "google_compute_network" "n" {
+  name = var.missing  # tfsim:ignore core-ref
+}
+""")
+    assert by_rule(run_lint(path), "core-ref") == []
+    # ...and severity overrides
+    (tmp_path / "main.tf").write_text(PREAMBLE + """
+resource "google_compute_network" "n" {
+  name = var.missing
+}
+""")
+    demoted = run_lint(path, overrides={"core-ref": "info"})
+    assert by_rule(demoted, "core-ref")[0].severity == "info"
+    assert exit_code(demoted) == 0
+
+
+def test_validate_findings_carry_rule_ids():
+    mod = load_module(GKE_TPU)
+    for f in validate_module(mod):
+        assert f.rule.startswith("core-"), str(f)
+
+
+def test_unlisted_validate_rule_id_still_surfaces(tmp_path, monkeypatch):
+    """The superset guarantee: a validate finding stamped with a rule id
+    the bridge table doesn't list (or none) must surface through lint,
+    not vanish — else a lint CI gate passes what validate rejects."""
+    import nvidia_terraform_modules_tpu.tfsim.validate as V
+
+    real = V.validate_module
+
+    def fake(mod):
+        return real(mod) + [
+            Finding("error", "main.tf:1", "future-family finding",
+                    rule="core-futuristic"),
+            Finding("error", "main.tf:2", "unstamped finding"),
+        ]
+
+    monkeypatch.setattr(V, "validate_module", fake)
+    path = write_mod(tmp_path, "")
+    findings = run_lint(path)
+    stamped = {(f.rule, f.message) for f in findings}
+    assert ("core-futuristic", "future-family finding") in stamped
+    assert ("core-unbridged", "unstamped finding") in stamped
+
+
+# ================================================================ CLI surface
+
+def test_cli_text_output_format(tmp_path, capsys):
+    path = write_mod(tmp_path, """
+variable "orphan" {
+  description = "d"
+  type        = string
+}
+""")
+    assert main(["lint", path]) == 1
+    out = capsys.readouterr().out
+    assert "main.tf:" in out and "[unused-variable]" in out
+    assert "1 warning(s)" in out
+
+
+def test_cli_json_output(tmp_path, capsys):
+    path = _slices_fixture(tmp_path, {"bad": ("v5e", "3x7", None)})
+    assert main(["lint", path, "-json"]) == 2
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["clean"] is False
+    assert payload["error_count"] == 1
+    [f] = payload["findings"]
+    assert f["rule"] == "tpu-invalid-topology"
+    assert f["file"] == "main.tf" and f["line"] > 0
+
+
+def test_cli_sarif_output(tmp_path, capsys):
+    path = write_mod(tmp_path, """
+variable "orphan" {
+  description = "d"
+  type        = string
+}
+""")
+    assert main(["lint", path, "-sarif"]) == 1
+    sarif = json.loads(capsys.readouterr().out)
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "tfsim-lint"
+    assert {r["id"] for r in driver["rules"]} >= {
+        "tpu-invalid-topology", "unused-variable", "deprecated-argument"}
+    [res] = run["results"]
+    assert res["ruleId"] == "unused-variable"
+    assert res["level"] == "warning"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "main.tf"
+    assert loc["region"]["startLine"] > 0
+
+
+def test_cli_rules_catalog(capsys):
+    assert main(["lint", "-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("tpu-invalid-topology", "unused-variable",
+                "deprecated-argument", "core-ref"):
+        assert rid in out
+
+
+def test_cli_severity_flags(tmp_path, capsys):
+    path = write_mod(tmp_path, """
+variable "orphan" {
+  description = "d"
+  type        = string
+}
+""")
+    assert main(["lint", path, "-severity", "unused-variable=error"]) == 2
+    capsys.readouterr()
+    assert main(["lint", path, "-severity", "unused-variable=off"]) == 0
+    capsys.readouterr()
+    # bad flag shapes are diagnostics, not tracebacks — and they reach
+    # the requested output format (a CI step parsing -json must get a
+    # JSON document, not an empty stdout and a stderr note)
+    assert main(["lint", path, "-severity", "nonsense"]) == 2
+    assert "RULE=LEVEL" in capsys.readouterr().out
+    assert main(["lint", path, "-severity", "no-such=error"]) == 2
+    assert "unknown rule id" in capsys.readouterr().out
+    assert main(["lint", path, "-json", "-severity", "nonsense"]) == 2
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["error_count"] == 1
+    assert payload["findings"][0]["rule"] == "core-load"
+    assert "RULE=LEVEL" in payload["findings"][0]["message"]
+
+
+def test_cli_unloadable_module_is_a_finding(tmp_path, capsys):
+    assert main(["lint", str(tmp_path / "nope")]) == 2
+    out = capsys.readouterr().out
+    assert "[core-load]" in out
+
+
+def test_cli_unparsable_hcl_is_a_finding_not_a_traceback(tmp_path, capsys):
+    """HclParseError/HclLexError subclass SyntaxError, not ValueError —
+    a module that does not parse must still honor the 'diagnostic in
+    every output format, never a crash' contract."""
+    (tmp_path / "main.tf").write_text('resource "google_compute_network" {\n')
+    assert main(["lint", str(tmp_path)]) == 2
+    assert "[core-load]" in capsys.readouterr().out
+    (tmp_path / "main.tf").write_text('x = 1\n')
+    (tmp_path / "terraform.tfvars").write_text("x = = broken\n")
+    assert main(["lint", str(tmp_path)]) == 2
+    assert "[core-load]" in capsys.readouterr().out
+
+
+def test_unparsable_child_module_degrades_to_unloadable(tmp_path):
+    child = tmp_path / "child"
+    child.mkdir()
+    (child / "main.tf").write_text('output "x" {{{ broken\n')
+    path = write_mod(tmp_path, """
+module "c" {
+  source = "./child"
+}
+
+output "echo" {
+  description = "d"
+  value       = module.c.x
+}
+""")
+    # no crash: the child is treated as unloadable (child-dependent rules
+    # skip it) and the rest of the run still reports
+    findings = run_lint(path)
+    assert by_rule(findings, "unknown-module-output") == []
+
+
+def test_malformed_lockfile_is_skipped_not_fatal(tmp_path):
+    (tmp_path / ".terraform.lock.hcl").write_text('provider "bad {{{\n')
+    path = write_mod(tmp_path, """
+variable "orphan" {
+  description = "d"
+  type        = string
+}
+""")
+    findings = run_lint(path)
+    assert by_rule(findings, "lockfile-stale-provider") == []
+    # the rest of the run still reports
+    assert len(by_rule(findings, "unused-variable")) == 1
+
+
+def test_lint_is_superset_of_validate():
+    """Every validate finding surfaces through lint with the same text."""
+    mod = load_module(GKE_TPU)
+    vmsgs = {(f.where, f.message) for f in validate_module(mod)}
+    lmsgs = {(f.where, f.message) for f in run_lint(GKE_TPU, mod=mod)}
+    assert vmsgs <= lmsgs
+
+
+# ===================================== satellite: validate traversal coverage
+
+def test_validate_walks_variable_defaults(tmp_path):
+    path = write_mod(tmp_path, """
+variable "derived" {
+  description = "d"
+  type        = string
+  default     = local.missing_base
+}
+
+output "echo" {
+  description = "d"
+  value       = var.derived
+}
+""")
+    mod = load_module(path)
+    errs = [str(f) for f in validate_module(mod) if f.severity == "error"]
+    assert any("local.missing_base" in e for e in errs), errs
+
+
+def test_validate_walks_validation_blocks(tmp_path):
+    path = write_mod(tmp_path, """
+variable "n" {
+  description = "d"
+  type        = number
+  default     = 1
+
+  validation {
+    condition     = var.typo_name > 0
+    error_message = "bad"
+  }
+}
+
+output "echo" {
+  description = "d"
+  value       = var.n
+}
+""")
+    mod = load_module(path)
+    errs = [str(f) for f in validate_module(mod) if f.severity == "error"]
+    assert any("var.typo_name" in e for e in errs), errs
+
+
+def test_validate_type_exprs_not_walked(tmp_path):
+    """Type keywords (string, number, object(...)) are not references."""
+    path = write_mod(tmp_path, """
+variable "shaped" {
+  description = "d"
+  type = object({
+    name  = string
+    count = number
+  })
+  default = null
+}
+
+output "echo" {
+  description = "d"
+  value       = var.shaped
+}
+""")
+    mod = load_module(path)
+    assert [f for f in validate_module(mod) if f.severity == "error"] == []
+
+
+def test_traversal_each_value_in_foreach_resource(tmp_path):
+    path = write_mod(tmp_path, """
+variable "nets" {
+  description = "d"
+  type        = map(string)
+  default     = { a = "10.0.0.0/24" }
+}
+
+resource "google_compute_network" "n" {
+  for_each = var.nets
+  name     = each.key
+}
+
+output "cidrs" {
+  description = "d"
+  value       = { for k, v in var.nets : k => v }
+}
+""")
+    mod = load_module(path)
+    assert [f for f in validate_module(mod) if f.severity == "error"] == []
+
+
+def test_traversal_self_reference_allowed(tmp_path):
+    path = write_mod(tmp_path, """
+resource "google_compute_network" "n" {
+  name = "n"
+
+  lifecycle {
+    ignore_changes = [name]
+  }
+}
+
+output "self_like" {
+  description = "self is a builtin root everywhere tfsim walks"
+  value       = google_compute_network.n.name
+}
+""")
+    mod = load_module(path)
+    assert [f for f in validate_module(mod) if f.severity == "error"] == []
+
+
+def test_traversal_splat_resolves_and_flags(tmp_path):
+    path = write_mod(tmp_path, """
+resource "google_compute_network" "n" {
+  count = 2
+  name  = "n"
+}
+
+output "ids" {
+  description = "d"
+  value       = google_compute_network.n[*].name
+}
+
+output "ghost" {
+  description = "d"
+  value       = google_compute_network.ghost[*].name
+}
+""")
+    mod = load_module(path)
+    errs = [str(f) for f in validate_module(mod) if f.severity == "error"]
+    assert len(errs) == 1 and "google_compute_network.ghost" in errs[0]
+
+
+def test_traversal_bound_iterator_shadowing(tmp_path):
+    path = write_mod(tmp_path, """
+variable "rules" {
+  description = "d"
+  type        = list(object({ port = number }))
+  default     = []
+}
+
+output "ports" {
+  description = "an iterator that LOOKS like a resource type is bound"
+  value       = [for fw_rule in var.rules : fw_rule.port]
+}
+""")
+    mod = load_module(path)
+    assert [f for f in validate_module(mod) if f.severity == "error"] == []
+    # the same root unbound IS flagged
+    (tmp_path / "main.tf").write_text(PREAMBLE + """
+output "ports" {
+  description = "d"
+  value       = fw_rule.port
+}
+""")
+    mod = load_module(str(tmp_path))
+    errs = [str(f) for f in validate_module(mod) if f.severity == "error"]
+    assert len(errs) == 1 and "fw_rule" in errs[0]
+
+
+def test_traversal_dynamic_block_iterator(tmp_path):
+    path = write_mod(tmp_path, """
+variable "pools" {
+  description = "d"
+  type        = list(string)
+  default     = []
+}
+
+resource "google_container_node_pool" "p" {
+  name    = "p"
+  cluster = "c"
+
+  dynamic "placement_policy" {
+    for_each = var.pools
+    iterator = pol
+    content {
+      type = pol.value
+    }
+  }
+}
+""")
+    mod = load_module(path)
+    assert [f for f in validate_module(mod) if f.severity == "error"] == []
+
+
+def test_lifecycle_precondition_references_count_as_used(tmp_path):
+    """Precondition/postcondition bodies are real expressions — a variable
+    read only there is used, even though lifecycle's own attributes
+    (ignore_changes) hold attribute names and stay unwalked."""
+    path = write_mod(tmp_path, """
+variable "min_nodes" {
+  description = "floor"
+  type        = number
+  default     = 1
+}
+
+resource "google_compute_network" "n" {
+  name = "n"
+
+  lifecycle {
+    ignore_changes = [name]
+    precondition {
+      condition     = var.min_nodes > 0
+      error_message = "need at least one node"
+    }
+  }
+}
+""")
+    assert by_rule(run_lint(path), "unused-variable") == []
+
+
+def test_lifecycle_precondition_undeclared_ref_flagged(tmp_path):
+    path = write_mod(tmp_path, """
+resource "google_compute_network" "n" {
+  name = "n"
+
+  lifecycle {
+    precondition {
+      condition     = var.nope > 0
+      error_message = "bad"
+    }
+  }
+}
+""")
+    errs = by_rule(run_lint(path), "core-ref")
+    assert len(errs) == 1 and "var.nope" in errs[0].message
+
+
+# ========================================= core-pins anchoring (real location)
+
+def test_core_pins_anchor_at_terraform_block(tmp_path):
+    """Pin findings anchor at the real terraform{} block — a precise
+    file:line that # tfsim:ignore can hit in place."""
+    path = write_mod(tmp_path, """\
+terraform {
+  required_version = ">= 1.5.0"
+}
+
+resource "google_compute_network" "n" {
+  name = "n"
+}
+""", preamble=False)
+    pins = by_rule(run_lint(path), "core-pins")
+    assert len(pins) == 1 and "required_providers" in pins[0].message
+    assert pins[0].file == "main.tf" and pins[0].line == 1
+    # and the anchor takes an in-place suppression
+    (tmp_path / "main.tf").write_text(
+        (tmp_path / "main.tf").read_text().replace(
+            "terraform {", "terraform {  # tfsim:ignore core-pins"))
+    assert by_rule(run_lint(path), "core-pins") == []
+
+
+def test_core_pins_sarif_never_points_at_missing_file(tmp_path, capsys):
+    """A module with no terraform{} block anchors pin findings at a file
+    that exists — SARIF must never emit an artifact URI for a synthetic
+    versions.tf nobody shipped."""
+    write_mod(tmp_path, """
+resource "google_compute_network" "n" {
+  name = "n"
+}
+""", preamble=False)
+    main(["lint", str(tmp_path), "-sarif"])
+    sarif = json.loads(capsys.readouterr().out)
+    results = sarif["runs"][0]["results"]
+    assert any(r["ruleId"] == "core-pins" for r in results)
+    for r in results:
+        for loc in r.get("locations", []):
+            uri = loc["physicalLocation"]["artifactLocation"]["uri"]
+            assert (tmp_path / uri).exists(), uri
+
+
+# ============================================== satellite: google-beta provider
+
+def test_google_beta_only_module_passes(tmp_path):
+    (tmp_path / "main.tf").write_text("""
+terraform {
+  required_version = ">= 1.5.0"
+  required_providers {
+    google-beta = {
+      source  = "hashicorp/google-beta"
+      version = "~> 5.0"
+    }
+  }
+}
+
+resource "google_compute_network" "n" {
+  provider = google-beta
+  name     = "n"
+}
+""")
+    mod = load_module(str(tmp_path))
+    errs = [str(f) for f in validate_module(mod) if f.severity == "error"]
+    assert errs == [], errs
+
+
+def test_explicit_beta_provider_requires_its_entry(tmp_path):
+    """provider = google-beta with only `google` required is an error —
+    init would never install the beta provider the resource names."""
+    path = write_mod(tmp_path, """
+resource "google_compute_network" "n" {
+  provider = google-beta
+  name     = "n"
+}
+""")
+    mod = load_module(path)
+    errs = [str(f) for f in validate_module(mod) if f.severity == "error"]
+    assert len(errs) == 1 and "google-beta" in errs[0]
+
+
+def test_explicit_provider_wrong_source_flagged(tmp_path):
+    """A provider meta-argument naming a DECLARED provider that cannot
+    provide the resource type must not suppress the provider check —
+    `provider = kubernetes` on a google_* resource is init-time
+    nonsense even though kubernetes is in required_providers."""
+    (tmp_path / "main.tf").write_text("""
+terraform {
+  required_version = ">= 1.5.0"
+  required_providers {
+    kubernetes = {
+      source  = "hashicorp/kubernetes"
+      version = "~> 2.32"
+    }
+  }
+}
+
+resource "google_compute_network" "n" {
+  provider = kubernetes
+  name     = "n"
+}
+""")
+    mod = load_module(str(tmp_path))
+    errs = [str(f) for f in validate_module(mod) if f.severity == "error"]
+    assert len(errs) == 1 and "does not provide google_*" in errs[0]
+
+
+def test_explicit_provider_custom_local_name_passes(tmp_path):
+    """A custom local name is fine when its SOURCE provides the type."""
+    (tmp_path / "main.tf").write_text("""
+terraform {
+  required_version = ">= 1.5.0"
+  required_providers {
+    gcp = {
+      source  = "hashicorp/google"
+      version = "~> 5.0"
+    }
+  }
+}
+
+resource "google_compute_network" "n" {
+  provider = gcp
+  name     = "n"
+}
+""")
+    mod = load_module(str(tmp_path))
+    errs = [str(f) for f in validate_module(mod) if f.severity == "error"]
+    assert errs == [], errs
