@@ -147,5 +147,6 @@ resource "google_container_node_pool" "cpu" {
   timeouts {
     create = "30m"
     update = "20m"
+    delete = "30m"
   }
 }

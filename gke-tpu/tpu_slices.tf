@@ -161,9 +161,15 @@ resource "google_container_node_pool" "tpu_slice" {
     }
   }
 
+  # TPU capacity is the scarce resource: creation can sit behind
+  # stockouts/preemption churn far longer than a CPU pool (45m create),
+  # and a wedged delete must not hang a teardown forever (45m delete) —
+  # the fault-injecting apply (`-fault-profile`) retries transient API
+  # errors with capped backoff only within these budgets.
   timeouts {
     create = "45m"
     update = "30m"
+    delete = "45m"
   }
 }
 
@@ -205,5 +211,6 @@ resource "google_container_node_pool" "gpu" {
   timeouts {
     create = "30m"
     update = "20m"
+    delete = "30m"
   }
 }

@@ -17,8 +17,16 @@ terraform binary in CI, so tfsim ships the same verbs offline::
         [-replace ADDR] [-out plan.tfplan] [-refresh-only] [-destroy] \
         [-detailed-exitcode] [-generate-config-out generated.tf]
     python -m nvidia_terraform_modules_tpu.tfsim apply gke-tpu ... -state f \
-        [-target ADDR] [-replace ADDR] [-refresh-only] [-destroy]
+        [-target ADDR] [-replace ADDR] [-refresh-only] [-destroy] \
+        [-fault-profile faults.json] [-fault-seed N]   # deterministic fault
+        # injection: stockout/quota/429/5xx/preemption/crash mid-apply,
+        # retry+backoff honoring timeouts{}, partial state + taint on
+        # terminal failure, errored.tfstate when the state write fails
     python -m nvidia_terraform_modules_tpu.tfsim apply plan.tfplan   # saved-plan apply
+    python -m nvidia_terraform_modules_tpu.tfsim chaos gke-tpu -var ... \
+        [-seeds 8] [-fault-profile faults.json] [-json]   # sweep fault
+        # seeds, assert interrupted applies re-converge and destroys
+        # stay clean (the convergence gate for a module)
     python -m nvidia_terraform_modules_tpu.tfsim show plan.tfplan [-json]
     python -m nvidia_terraform_modules_tpu.tfsim refresh gke-tpu ... -state f
     python -m nvidia_terraform_modules_tpu.tfsim import gke-tpu ADDR ID -state f ...
@@ -66,6 +74,7 @@ SIM_TERRAFORM_VERSION = "1.9.0"
 
 from .destroy import simulate_destroy
 from .docs import check_readme, generate_docs
+from .faults import SimulatedCrash, StateWriteFault
 from .fmt import check_text, format_text
 from .lockfile import LockfileError, check_lockfile, write_lockfile
 from .locking import LockError
@@ -379,20 +388,12 @@ def _write_state(path: str, state: State) -> None:
 
 
 def _parse_duration(s: str) -> float:
-    """Terraform-style duration (``10s``, ``1m``, ``500ms``) → seconds."""
-    s = (s or "0s").strip()
-    try:
-        if s.endswith("ms"):
-            return float(s[:-2]) / 1000.0
-        if s.endswith("s"):
-            return float(s[:-1])
-        if s.endswith("m"):
-            return float(s[:-1]) * 60.0
-        return float(s)
-    except ValueError:
-        raise ValueError(
-            f"invalid -lock-timeout {s!r}: use a duration like 10s or 1m"
-        ) from None
+    """``-lock-timeout`` duration → seconds, via THE shared terraform
+    duration parser (``tfsim/faults/control_plane.py``) so the grammar
+    here and in ``timeouts {}`` blocks can never drift apart."""
+    from .faults import parse_duration
+
+    return parse_duration(s or "0s", what="-lock-timeout")
 
 
 @contextlib.contextmanager
@@ -414,7 +415,15 @@ def _state_lock(args, state_path: str | None, operation: str):
         timeout_s=_parse_duration(getattr(args, "lock_timeout", "0s")))
     try:
         yield
-    finally:
+    except SimulatedCrash:
+        # a fault-injected process kill: a dead process releases nothing,
+        # so the lock is deliberately LEFT BEHIND — exactly the stale-lock
+        # artifact `force-unlock <ID>` exists to break
+        raise
+    except BaseException:
+        release_lock(info)
+        raise
+    else:
         release_lock(info)
 
 
@@ -698,6 +707,84 @@ def cmd_plan(args) -> int:
     return rc
 
 
+def _control_plane_of(args):
+    """The fault-injecting control plane for this run, or None (no
+    ``-fault-profile`` → the original atomic apply path, untouched)."""
+    # -fault-seed without a profile is refused (rc 2) by cmd_apply's
+    # pre-check before any path reaches here
+    profile = getattr(args, "fault_profile", None)
+    if not profile:
+        return None
+    from .faults import ControlPlane, load_profile
+
+    return ControlPlane(load_profile(profile),
+                        seed=getattr(args, "fault_seed", None) or 0)
+
+
+def errored_state_path(state_path: str) -> str:
+    """Where an apply that cannot write its state drops the snapshot —
+    ``errored.tfstate`` beside the statefile, terraform's convention."""
+    return os.path.join(os.path.dirname(os.path.abspath(state_path)),
+                        "errored.tfstate")
+
+
+def _apply_with_faults(cp, plan, prior, d, targets, state_path) -> int:
+    """The fault-injected apply: stepwise engine + state persistence.
+
+    Terminal failure persists the partial state (half-created resource
+    tainted) and exits 1 with a resume message; a state-write fault
+    dumps ``errored.tfstate`` instead; a crash persists partial state
+    and re-raises :class:`SimulatedCrash` so ``_state_lock`` leaves the
+    lock behind. Returns 0 when every operation (retries included)
+    succeeded — the caller prints the normal apply summary.
+    """
+    from .faults import run_apply
+
+    def log(msg: str) -> None:
+        print(msg, file=sys.stderr)
+
+    try:
+        outcome = run_apply(plan, prior, cp, targets, d=d, log=log)
+    except SimulatedCrash as ex:
+        if state_path and ex.outcome.mutated:
+            _write_state(state_path, ex.outcome.state)
+        raise
+    if outcome.failure is not None:
+        # surfaced BEFORE the state-write check: when both land (a
+        # terminal op failure AND a failed write of the partial state),
+        # the operator must see both diagnostics, not just the second
+        print(f"Error: apply interrupted: {outcome.failure.message}",
+              file=sys.stderr)
+    try:
+        cp.check_state_write()
+    except StateWriteFault as ex:
+        if state_path:
+            errored = errored_state_path(state_path)
+            with open(errored, "w") as fh:
+                fh.write(outcome.state.to_json() + "\n")
+            print(f"Error: {ex}\n"
+                  f"The state this apply produced was saved to "
+                  f"{errored!r}. Recover it with:\n"
+                  f"  tfsim state push -state {state_path} < {errored}\n"
+                  f"then run apply again to converge.", file=sys.stderr)
+        else:
+            print(f"Error: {ex}", file=sys.stderr)
+        return 1
+    if state_path and (outcome.mutated or not os.path.exists(state_path)):
+        _write_state(state_path, outcome.state)
+    if outcome.failure is not None:
+        f = outcome.failure
+        tainted = f.address in outcome.state.tainted
+        print(f"State saved: {len(outcome.completed)} completed "
+              f"operation(s) persisted"
+              + (f"; {f.address} is tainted and will be replaced"
+                 if tainted else "")
+              + ". Run apply again to resume — already-created "
+                "resources are never recreated.", file=sys.stderr)
+        return 1
+    return 0
+
+
 def _apply_saved_plan(args) -> int:
     """``apply PLANFILE``: perform exactly the reviewed actions.
 
@@ -719,6 +806,7 @@ def _apply_saved_plan(args) -> int:
               "state — a destroy plan comes from `plan -destroy -out`)",
               file=sys.stderr)
         return 2
+    cp = _control_plane_of(args)
     payload = load_plan_file(args.dir)
     plan = plan_from_payload(payload)
     # explicit -state wins; otherwise the file's RECORDED resolution — the
@@ -749,9 +837,15 @@ def _apply_saved_plan(args) -> int:
                 f"saved plan no longer matches a fresh diff against the "
                 f"same state serial (module or moved{{}} drift?): "
                 f"{drifted[:5]}")
-        state = apply_plan(plan, prior, targets, d=d)
-        if state_path:
-            _write_state(state_path, state)
+        if cp is None:
+            state = apply_plan(plan, prior, targets, d=d)
+            if state_path:
+                _write_state(state_path, state)
+        else:
+            rc = _apply_with_faults(cp, plan, prior, d, targets,
+                                    state_path)
+            if rc:
+                return rc
     for failure in plan.check_failures:
         print(f"Warning: {failure}", file=sys.stderr)
     print(d.summary().replace("Plan:", "Apply complete:")
@@ -761,6 +855,14 @@ def _apply_saved_plan(args) -> int:
 
 
 def cmd_apply(args) -> int:
+    if getattr(args, "fault_seed", None) is not None and \
+            not getattr(args, "fault_profile", None):
+        # flag misuse is the rc-2 family, like every other bad
+        # combination this verb refuses (checked here so both the
+        # module-dir and saved-plan paths get the same refusal)
+        print("Error: -fault-seed needs -fault-profile FILE (the seed "
+              "draws from the profile)", file=sys.stderr)
+        return 2
     try:
         if os.path.isfile(args.dir):
             if not is_plan_file(args.dir):
@@ -769,6 +871,7 @@ def cmd_apply(args) -> int:
                       f"file)", file=sys.stderr)
                 return 2
             return _apply_saved_plan(args)
+        cp = _control_plane_of(args)
         mod, state_path = _resolve_paths(args)
         with _state_lock(args, state_path, "OperationTypeApply"):
             (plan, prior, state_path, _serial,
@@ -779,6 +882,12 @@ def cmd_apply(args) -> int:
                     print("Error: -refresh-only cannot be combined with "
                           "-replace/-destroy (a refresh accepts drift, "
                           "it does not stage actions)", file=sys.stderr)
+                    return 2
+                if cp is not None:
+                    print("Error: -fault-profile cannot be combined with "
+                          "-refresh-only (a refresh performs no resource "
+                          "operations to inject faults into)",
+                          file=sys.stderr)
                     return 2
                 n, state = _refresh_only_report(plan, prior)
                 if state_path and n:
@@ -797,10 +906,20 @@ def cmd_apply(args) -> int:
                 targets = getattr(args, "target", None)
                 d = diff(plan, prior, targets,
                          getattr(args, "replace", None))
-            state = apply_plan(plan, prior,
-                               getattr(args, "target", None), d=d)
-            if state_path:
-                _write_state(state_path, state)
+            if cp is None:
+                state = apply_plan(plan, prior,
+                                   getattr(args, "target", None), d=d)
+                if state_path:
+                    _write_state(state_path, state)
+            else:
+                rc = _apply_with_faults(cp, plan, prior, d,
+                                        getattr(args, "target", None),
+                                        state_path)
+                if rc:
+                    return rc
+    except SimulatedCrash as ex:
+        print(f"Error: {ex}", file=sys.stderr)
+        return 1
     except (PlanError, PlanFileError, ValueError, OSError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
         return 1
@@ -810,6 +929,59 @@ def cmd_apply(args) -> int:
           .replace("to add", "added").replace("to change", "changed")
           .replace("to destroy", "destroyed"))
     return 0
+
+
+def cmd_chaos(args) -> int:
+    """``tfsim chaos DIR``: the convergence gate for a module.
+
+    Sweeps ``-seeds`` fault seeds (profile: ``-fault-profile`` or the
+    built-in chaos mix) over the module in throwaway sandboxes, driving
+    the real CLI end-to-end, and asserts the invariants: an interrupted
+    apply leaves state from which a fault-free re-apply reaches exactly
+    the planned state (no orphans, no duplicate creates, no lingering
+    taint), crash-left locks break by ID, ``errored.tfstate`` pushes
+    back, and a destroy from any interrupted state empties it.
+    """
+    from .faults import run_chaos
+
+    try:
+        if args.seeds < 1:
+            raise ValueError("-seeds must be >= 1")
+        tfvars = _gather_vars(args)
+        var_argv: list[str] = []
+        for f in args.var_file or []:
+            var_argv += ["-var-file", f]
+        for kv in args.var or []:
+            var_argv += ["-var", kv]
+        results = run_chaos(
+            main, args.dir, tfvars, var_argv, seeds=args.seeds,
+            profile_path=getattr(args, "fault_profile", None),
+            log=None if args.json else print)
+    except (PlanError, ValueError, OSError) as ex:
+        print(f"Error: {ex}", file=sys.stderr)
+        return 1
+    bad = [r for r in results if not r.ok]
+    interrupted = sum(1 for r in results if r.interrupted)
+    crashed = sum(1 for r in results if r.crashed)
+    errored = sum(1 for r in results if r.errored_state)
+    if args.json:
+        print(json.dumps({
+            "seeds": [{
+                "seed": r.seed, "ok": r.ok, "interrupted": r.interrupted,
+                "crashed": r.crashed, "errored_state": r.errored_state,
+                "recovery": r.recovery, "violations": r.violations,
+            } for r in results],
+            "converged": len(results) - len(bad),
+            "total": len(results),
+        }, indent=2, sort_keys=True))
+    else:
+        print(f"chaos: {len(results) - len(bad)}/{len(results)} seed(s) "
+              f"converged ({interrupted} interrupted, {crashed} crash(es), "
+              f"{errored} errored.tfstate)")
+    for r in bad:
+        print(f"--- seed {r.seed} violated: {'; '.join(r.violations)}\n"
+              f"{r.transcript}", file=sys.stderr)
+    return 1 if bad else 0
 
 
 def cmd_show(args) -> int:
@@ -1540,6 +1712,13 @@ def main(argv: list[str] | None = None) -> int:
     a.add_argument("-workspace", default=None)
     a.add_argument("-refresh-only", action="store_true", dest="refresh_only")
     a.add_argument("-destroy", action="store_true", dest="destroy")
+    a.add_argument("-fault-profile", default=None, dest="fault_profile")
+    a.add_argument("-fault-seed", type=int, default=None, dest="fault_seed")
+
+    ch = add_module_cmd("chaos", cmd_chaos)
+    ch.add_argument("-seeds", type=int, default=8)
+    ch.add_argument("-fault-profile", default=None, dest="fault_profile")
+    ch.add_argument("-json", action="store_true")
 
     sh = sub.add_parser("show")
     sh.add_argument("path")

@@ -1,0 +1,43 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Fault injection for the simulated apply/destroy path.
+
+The reference workflow's most common real-world failure is not a bad
+config (`tfsim lint` catches those) but a *mid-apply* fault: TPU
+stockouts, quota exhaustion, API 429/5xx, spot preemption, even the
+state write itself failing. This package simulates that class of
+failure deterministically so the recovery story — retries, partial
+state, taint, ``errored.tfstate``, ``force-unlock``, resumable
+re-apply — is testable offline:
+
+- :mod:`profile` — the fault profile: which faults land where, drawn
+  from a seeded RNG (``-fault-profile FILE -fault-seed N``);
+- :mod:`control_plane` — the simulated cloud control plane: every
+  resource operation becomes a lifecycle with retryable vs terminal
+  error classes, capped exponential backoff, and per-operation
+  ``timeouts {}`` budgets on a simulated clock (no real sleeps);
+- :mod:`apply` — the stepwise apply engine: walks the diff in
+  dependency order, persists every completed operation, taints the
+  half-created resource on terminal failure;
+- :mod:`chaos` — the ``tfsim chaos`` harness: sweeps N seeds over a
+  module and asserts the convergence invariants.
+"""
+
+from .control_plane import (  # noqa: F401
+    ControlPlane,
+    CrashSignal,
+    FaultError,
+    RetryPolicy,
+    SimClock,
+    StateWriteFault,
+    TerminalFault,
+    parse_duration,
+)
+from .profile import (  # noqa: F401
+    DEFAULT_CHAOS_PROFILE,
+    FaultProfile,
+    FaultSpec,
+    load_profile,
+)
+from .apply import ApplyOutcome, OpFailure, SimulatedCrash, run_apply  # noqa: F401
+from .chaos import SeedResult, run_chaos  # noqa: F401

@@ -1,0 +1,202 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""The stepwise apply engine: one operation at a time, fault-aware.
+
+``apply_plan`` (:mod:`..state`) realises a diff atomically — correct,
+but it cannot fail halfway. This engine walks the same diff as the
+sequence of operations a real ``terraform apply`` performs (deletes in
+reverse dependency order, then creates/updates/replaces in dependency
+order), runs each through the :class:`..faults.control_plane.ControlPlane`,
+and on terminal failure does what terraform does:
+
+- every already-completed operation is **persisted** to the returned
+  state (no orphans: a created resource is never forgotten);
+- a half-created resource (preemption or timeout mid-create) is
+  recorded **tainted**, so the next apply replaces it instead of
+  creating a duplicate;
+- the remaining operations are simply not performed — a second apply
+  plans exactly the leftover work and converges.
+
+When every operation succeeds the engine returns ``apply_plan``'s own
+result, so a profile that injects nothing is bit-identical to the
+atomic path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..plan import Plan, instance_apply_order
+from ..state import Diff, State, apply_plan, diff, rendered_instances
+from .control_plane import (
+    DEFAULT_TIMEOUT_S,
+    ControlPlane,
+    CrashSignal,
+    FaultError,
+    TerminalFault,
+    parse_duration,
+)
+from .profile import PARTIAL_CREATE
+
+
+class SimulatedCrash(FaultError):
+    """The profile killed the apply process. Carries the partial
+    :class:`ApplyOutcome` so the CLI can persist completed work before
+    "dying" — and, unlike every other failure, the state **lock is left
+    behind** (a crashed process releases nothing), so the recovery
+    playbook's ``force-unlock`` step is exercised too."""
+
+    def __init__(self, outcome: "ApplyOutcome"):
+        super().__init__(
+            "simulated crash: apply died mid-run (the state lock, if "
+            "held, was left behind — break it with `tfsim force-unlock`)")
+        self.outcome = outcome
+
+
+@dataclasses.dataclass
+class OpFailure:
+    """The terminal failure that interrupted an apply."""
+
+    address: str
+    op: str            # create | update | delete
+    kind: str          # fault kind ("timeout" for an exhausted budget)
+    message: str
+    attempts: int
+
+
+@dataclasses.dataclass
+class ApplyOutcome:
+    state: State
+    failure: OpFailure | None = None
+    crashed: bool = False
+    completed: list = dataclasses.field(default_factory=list)  # (addr, op)
+    mutated: bool = False    # state differs from prior → worth persisting
+
+    @property
+    def ok(self) -> bool:
+        return self.failure is None and not self.crashed
+
+
+def _timeouts_of(attrs) -> dict:
+    """The resource's rendered ``timeouts {}`` block, if any. Blocks
+    evaluate to a list of one object; tolerate both shapes."""
+    t = (attrs or {}).get("timeouts")
+    if isinstance(t, list) and t and isinstance(t[0], dict):
+        return t[0]
+    return t if isinstance(t, dict) else {}
+
+
+def operation_timeout_s(op: str, planned_attrs, prior_attrs=None) -> float:
+    """The ``timeouts {}`` budget for one operation, in simulated
+    seconds. Deletes of resources gone from config take the budget the
+    *applied* attributes carry (the config block that created them);
+    anything undeclared gets the provider default."""
+    spec = _timeouts_of(planned_attrs) or _timeouts_of(prior_attrs)
+    raw = spec.get(op)
+    if isinstance(raw, str) and raw.strip():
+        budget = parse_duration(raw, what=f"timeouts.{op}")
+        if budget <= 0:
+            raise ValueError(
+                f"invalid timeouts.{op} duration {raw!r}: an operation "
+                f"budget must be positive")
+        return budget
+    return DEFAULT_TIMEOUT_S
+
+
+def _operations(plan: Plan, d: Diff) -> list[tuple[str, str]]:
+    """The diff as an ordered operation list: deletes first in reverse
+    dependency order (terraform tears down leaves before roots), then
+    creates/updates in dependency order, a replace expanding to its
+    delete + create pair (destroy-before-create default)."""
+    ops: list[tuple[str, str]] = []
+    for addr in reversed(instance_apply_order(plan, d.by_action("delete"))):
+        ops.append((addr, "delete"))
+    changes = (d.by_action("create") + d.by_action("update") +
+               d.by_action("replace"))
+    for addr in instance_apply_order(plan, changes):
+        act = d.actions[addr]
+        if act == "replace":
+            ops.append((addr, "delete"))
+            ops.append((addr, "create"))
+        else:
+            ops.append((addr, act))
+    return ops
+
+
+def _partial_state(prior: State | None, planned: dict,
+                   completed: list[tuple[str, str]],
+                   taint: str | None = None) -> tuple[State, bool]:
+    """The state an interrupted apply persists: prior advanced by every
+    completed operation, plus the optionally-tainted half-created
+    resource. Returns ``(state, mutated)``."""
+    resources = dict(prior.resources) if prior else {}
+    tainted = set(prior.tainted) if prior else set()
+    for addr, op in completed:
+        if op == "delete":
+            resources.pop(addr, None)
+            tainted.discard(addr)
+        else:
+            resources[addr] = planned[addr]
+            tainted.discard(addr)   # a completed replace consumed the taint
+    if taint is not None:
+        resources[taint] = planned[taint]
+        tainted.add(taint)
+    mutated = (resources != (dict(prior.resources) if prior else {}) or
+               tainted != (set(prior.tainted) if prior else set()))
+    serial = (prior.serial if prior else 0) + (1 if mutated else 0)
+    # outputs are NOT refreshed: the plan did not complete, and claiming
+    # its outputs would hand the operator values the infrastructure
+    # doesn't have (the converging re-apply refreshes them)
+    return State(resources=resources, serial=serial,
+                 outputs=dict(prior.outputs) if prior else {},
+                 tainted=tainted,
+                 lineage=prior.lineage if prior else ""), mutated
+
+
+def run_apply(plan: Plan, prior: State | None, cp: ControlPlane,
+              targets: list[str] | None = None,
+              d: Diff | None = None, log=None) -> ApplyOutcome:
+    """Apply ``plan`` over ``prior`` one operation at a time.
+
+    Returns an :class:`ApplyOutcome`; raises :class:`SimulatedCrash`
+    (carrying the partial outcome) when the profile kills the process.
+    On full success the returned state comes from :func:`..state.apply_plan`
+    — the fault layer adds no drift to the happy path.
+    """
+    if d is None:
+        d = diff(plan, prior, targets)
+    planned = rendered_instances(plan)
+    prior_res = prior.resources if prior else {}
+    ops = _operations(plan, d)
+    # validate EVERY timeouts{} budget before the first operation runs:
+    # a malformed duration must fail the apply up front (state untouched),
+    # never halfway through — that would orphan the completed work
+    timeouts: dict[tuple[str, str], float] = {}
+    for addr, op in ops:
+        try:
+            timeouts[(addr, op)] = operation_timeout_s(
+                op, planned.get(addr), prior_res.get(addr))
+        except ValueError as ex:
+            raise ValueError(f"{addr}: {ex}") from None
+    completed: list[tuple[str, str]] = []
+    for addr, op in ops:
+        try:
+            cp.run_operation(addr, op, timeouts[addr, op], log=log)
+        except CrashSignal:
+            state, mutated = _partial_state(prior, planned, completed)
+            raise SimulatedCrash(ApplyOutcome(
+                state=state, crashed=True, completed=completed,
+                mutated=mutated)) from None
+        except TerminalFault as ex:
+            taint = addr if (op == "create" and
+                             ex.kind in PARTIAL_CREATE) else None
+            state, mutated = _partial_state(prior, planned, completed,
+                                            taint=taint)
+            return ApplyOutcome(
+                state=state,
+                failure=OpFailure(address=addr, op=op, kind=ex.kind,
+                                  message=str(ex), attempts=ex.attempts),
+                completed=completed, mutated=mutated)
+        completed.append((addr, op))
+    return ApplyOutcome(state=apply_plan(plan, prior, targets, d=d),
+                        completed=completed, mutated=not d.is_noop)

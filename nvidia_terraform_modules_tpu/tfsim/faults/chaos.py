@@ -1,0 +1,237 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""``tfsim chaos``: sweep fault seeds over a module, assert convergence.
+
+For each seed the harness runs the full operator playbook in a throwaway
+sandbox, end-to-end through the real CLI (the same code paths a human
+drives), and asserts the convergence invariants the recovery story
+promises:
+
+1. **apply** with the fault profile (seeded). A clean run must already
+   match the planned state.
+2. If the run was interrupted: break a leftover crash lock by ID
+   (``force-unlock``), push a leftover ``errored.tfstate`` back
+   (``state push``), then **re-apply fault-free** — which must exit 0
+   and land exactly the planned state: no orphans, no duplicate
+   creates, no lingering taint.
+3. From the *interrupted* state, a fault-free ``apply -destroy`` must
+   leave empty state — interruption never wedges teardown.
+
+Any violated invariant fails the sweep (exit 1) with the seed's
+transcript, making ``tfsim chaos -seeds 8 MODULE`` a standing CI gate
+for the module's crash-consistency.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import io
+import json
+import os
+import sys
+import tempfile
+
+from ..plan import simulate_plan
+from ..state import State, apply_plan
+from .profile import DEFAULT_CHAOS_PROFILE, load_profile
+
+
+@dataclasses.dataclass
+class SeedResult:
+    seed: int
+    interrupted: bool = False
+    crashed: bool = False
+    errored_state: bool = False
+    recovery: list = dataclasses.field(default_factory=list)  # steps taken
+    violations: list = dataclasses.field(default_factory=list)
+    transcript: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def summary(self) -> str:
+        if not self.interrupted:
+            how = "clean apply"
+        else:
+            bits = ["interrupted"]
+            if self.crashed:
+                bits.append("crash")
+            if self.errored_state:
+                bits.append("errored.tfstate")
+            how = "+".join(bits)
+        verdict = "converged" if self.ok else \
+            "; ".join(self.violations)
+        tail = f" ({', '.join(self.recovery)})" if self.recovery else ""
+        return f"seed {self.seed}: {how} — {verdict}{tail}"
+
+
+def _run_cli(cli, argv: list[str], stdin_text: str | None = None
+             ) -> tuple[int, str]:
+    """Run one CLI invocation, capturing stdout+stderr (and feeding
+    stdin for ``state push``)."""
+    buf = io.StringIO()
+    old_stdin = sys.stdin
+    try:
+        if stdin_text is not None:
+            sys.stdin = io.StringIO(stdin_text)
+        with contextlib.redirect_stdout(buf), \
+                contextlib.redirect_stderr(buf):
+            rc = cli(argv)
+    finally:
+        sys.stdin = old_stdin
+    return rc, buf.getvalue()
+
+
+def _load(path: str) -> State | None:
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        return State.from_json(fh.read())
+
+
+def _check_converged(res: SeedResult, state: State | None,
+                     expected: State) -> None:
+    if state is None:
+        res.violations.append("no state after recovery")
+        return
+    if state.resources != expected.resources:
+        missing = sorted(set(expected.resources) - set(state.resources))
+        extra = sorted(set(state.resources) - set(expected.resources))
+        drift = sorted(a for a in set(state.resources) &
+                       set(expected.resources)
+                       if state.resources[a] != expected.resources[a])
+        res.violations.append(
+            f"state does not match plan after re-apply "
+            f"(missing={missing} extra={extra} drifted={drift})")
+    if state.tainted:
+        res.violations.append(
+            f"taint survived convergence: {sorted(state.tainted)}")
+    if state.outputs != expected.outputs:
+        res.violations.append("outputs drifted from the planned outputs")
+
+
+def run_one_seed(cli, module_dir: str, var_argv: list[str],
+                 profile_path: str, seed: int,
+                 expected: State) -> SeedResult:
+    """The full interrupt-recover-converge-destroy cycle for one seed."""
+    from ..locking import lock_path, read_holder
+
+    res = SeedResult(seed=seed)
+    lines: list[str] = []
+    with tempfile.TemporaryDirectory(prefix=f"tfsim-chaos-{seed}-") as tmp:
+        spath = os.path.join(tmp, "terraform.tfstate.json")
+        errored = os.path.join(tmp, "errored.tfstate")
+
+        rc, out = _run_cli(cli, ["apply", module_dir, *var_argv,
+                                 "-state", spath,
+                                 "-fault-profile", profile_path,
+                                 "-fault-seed", str(seed)])
+        lines.append(out)
+        res.interrupted = rc != 0
+        if rc not in (0, 1):
+            res.violations.append(f"faulted apply exited {rc} (usage error)")
+
+        # ---- recovery playbook (only after an interruption) ----------
+        if os.path.exists(lock_path(spath)):
+            res.crashed = True
+            holder = read_holder(spath)
+            rc, out = _run_cli(cli, ["force-unlock", holder.id,
+                                     "-state", spath])
+            lines.append(out)
+            if rc != 0:
+                res.violations.append(
+                    "force-unlock by ID failed on a crash-left lock")
+            res.recovery.append("lock broken by ID")
+
+        if os.path.exists(errored):
+            res.errored_state = True
+            with open(errored) as fh:
+                text = fh.read()
+            rc, out = _run_cli(cli, ["state", "push", "-state", spath],
+                               stdin_text=text)
+            lines.append(out)
+            if rc != 0:
+                res.violations.append("state push of errored.tfstate failed")
+            res.recovery.append("errored.tfstate pushed")
+
+        # snapshot the interrupted state for the destroy invariant —
+        # AFTER the lock break (teardown needs the lock too) and AFTER
+        # the errored.tfstate push: for a state-write fault the pushed
+        # file IS the interrupted state, and snapshotting earlier would
+        # silently skip the invariant for exactly that failure class
+        interrupted_json = None
+        if res.interrupted and os.path.exists(spath):
+            with open(spath) as fh:
+                interrupted_json = fh.read()
+
+        if res.interrupted:
+            rc, out = _run_cli(cli, ["apply", module_dir, *var_argv,
+                                     "-state", spath])
+            lines.append(out)
+            if rc != 0:
+                res.violations.append(f"fault-free re-apply exited {rc}")
+            res.recovery.append("re-applied")
+
+        _check_converged(res, _load(spath), expected)
+
+        # ---- destroy-after-interruption invariant --------------------
+        if interrupted_json is not None:
+            snap = State.from_json(interrupted_json)
+            if snap.resources:
+                dpath = os.path.join(tmp, "interrupted.tfstate.json")
+                with open(dpath, "w") as fh:
+                    fh.write(interrupted_json)
+                rc, out = _run_cli(cli, ["apply", module_dir, *var_argv,
+                                         "-state", dpath, "-destroy"])
+                lines.append(out)
+                final = _load(dpath)
+                if rc != 0:
+                    res.violations.append(
+                        f"destroy from interrupted state exited {rc}")
+                elif final is None or final.resources:
+                    left = sorted(final.resources) if final else "<none>"
+                    res.violations.append(
+                        f"destroy from interrupted state left "
+                        f"resources: {left}")
+                else:
+                    res.recovery.append("destroy from interruption clean")
+    res.transcript = "".join(lines)
+    return res
+
+
+def run_chaos(cli, module_dir: str, tfvars: dict, var_argv: list[str],
+              seeds: int, profile_path: str | None = None,
+              log=None) -> list[SeedResult]:
+    """Sweep ``seeds`` fault seeds over ``module_dir``; returns one
+    :class:`SeedResult` per seed. ``cli`` is the tfsim ``main`` callable
+    (injected to avoid an import cycle); ``var_argv`` is the raw
+    ``-var``/``-var-file`` argv to forward to each CLI run, ``tfvars``
+    the same variables resolved, for computing the expected state."""
+    plan = simulate_plan(module_dir, tfvars)
+    expected = apply_plan(plan, None)
+
+    if profile_path is not None:
+        # fail fast on a bad profile — otherwise every seeded apply dies
+        # on it and the sweep misreads the failures as interruptions
+        load_profile(profile_path)
+    own_profile = None
+    if profile_path is None:
+        own_profile = tempfile.NamedTemporaryFile(
+            "w", suffix=".fault.json", delete=False)
+        json.dump(DEFAULT_CHAOS_PROFILE, own_profile)
+        own_profile.close()
+        profile_path = own_profile.name
+    try:
+        results = []
+        for seed in range(seeds):
+            res = run_one_seed(cli, module_dir, var_argv, profile_path,
+                               seed, expected)
+            if log:
+                log(res.summary())
+            results.append(res)
+        return results
+    finally:
+        if own_profile is not None:
+            os.unlink(own_profile.name)

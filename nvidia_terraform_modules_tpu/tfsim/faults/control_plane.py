@@ -1,0 +1,195 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""The simulated cloud control plane: operations, retries, timeouts.
+
+Every resource operation (create/update/delete) runs through
+:meth:`ControlPlane.run_operation`, which mirrors the google provider's
+retry semantics:
+
+- **retryable** errors (429, transient 5xx) retry with capped
+  exponential backoff (1s → ×2 → cap 30s, the provider's defaults);
+- **terminal** errors (stockout, quota, preemption) fail the operation
+  on first occurrence;
+- every attempt and backoff consumes **simulated** time on
+  :class:`SimClock` (no real sleeps — a 45m timeout budget costs
+  microseconds of wall clock), and a retry that would overrun the
+  operation's ``timeouts {}`` budget becomes the terminal ``timeout``
+  fault ("context deadline exceeded"), exactly where real applies die
+  when capacity flaps for longer than the configured window.
+
+The first attempt always runs: the timeout budget bounds *retrying*,
+so a profile that injects nothing behaves identically to no profile
+at all — the acceptance bar for the whole fault layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+
+from .profile import KINDS, RETRYABLE, FaultProfile
+
+
+class FaultError(Exception):
+    """Base for fault-layer signals (deliberately NOT ValueError: the
+    CLI's generic ``Error:`` handler must not swallow them)."""
+
+
+class TerminalFault(FaultError):
+    """An operation failed for good: the apply stops here."""
+
+    def __init__(self, kind: str, address: str, op: str, attempts: int,
+                 message: str):
+        super().__init__(message)
+        self.kind = kind
+        self.address = address
+        self.op = op
+        self.attempts = attempts
+
+
+class CrashSignal(FaultError):
+    """Raised by the control plane when the profile injects ``crash``;
+    the apply engine converts it into :class:`..apply.SimulatedCrash`
+    carrying the partial state."""
+
+    def __init__(self, address: str, op: str):
+        super().__init__(f"simulated crash during {address} {op}")
+        self.address = address
+        self.op = op
+
+
+class StateWriteFault(FaultError):
+    """The state write itself failed — the CLI emits ``errored.tfstate``."""
+
+
+def parse_duration(s: str, what: str = "timeout") -> float:
+    """Terraform-style duration (``45m``, ``10s``, ``500ms``) → seconds.
+
+    THE duration parser — ``-lock-timeout`` delegates here too, so the
+    grammar cannot drift between surfaces. Negative durations are always
+    a config error; zero is the caller's call (a 0s lock-timeout means
+    "fail on first contention", a 0s operation timeout means nothing)."""
+    raw = (s or "").strip()
+    try:
+        if raw.endswith("ms"):
+            v = float(raw[:-2]) / 1000.0
+        elif raw.endswith("s"):
+            v = float(raw[:-1])
+        elif raw.endswith("m"):
+            v = float(raw[:-1]) * 60.0
+        elif raw.endswith("h"):
+            v = float(raw[:-1]) * 3600.0
+        else:
+            v = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"invalid {what} duration {s!r}: use a terraform duration "
+            f"like 45m, 10s or 500ms") from None
+    if v < 0:
+        raise ValueError(f"invalid {what} duration {s!r}: must not be "
+                         f"negative")
+    return v
+
+
+def format_duration(seconds: float) -> str:
+    if seconds >= 60 and seconds % 60 == 0:
+        return f"{int(seconds // 60)}m"
+    if seconds == int(seconds):
+        return f"{int(seconds)}s"
+    return f"{seconds:g}s"
+
+
+class SimClock:
+    """Monotonic simulated time; operations advance it, nothing sleeps."""
+
+    def __init__(self) -> None:
+        self.now = 0.0
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Capped exponential backoff, the google provider's shape."""
+
+    initial_s: float = 1.0
+    multiplier: float = 2.0
+    cap_s: float = 30.0
+
+
+# simulated cost of one operation attempt (the control-plane round trip
+# a create/update/delete takes before succeeding or erroring)
+OP_DURATION_S = 30.0
+
+# budget when the resource declares no timeouts{} block — the google
+# provider's common default for long-running GKE operations
+DEFAULT_TIMEOUT_S = 30 * 60.0
+
+
+class ControlPlane:
+    """One apply's view of the cloud: seeded faults + simulated time.
+
+    A ``ControlPlane`` is single-use: the profile's injection budgets
+    and the RNG stream belong to one apply/destroy run.
+    """
+
+    def __init__(self, profile: FaultProfile, seed: int = 0,
+                 policy: RetryPolicy | None = None,
+                 op_duration_s: float = OP_DURATION_S):
+        self.profile = profile
+        self.profile.reset()
+        self.rng = random.Random(seed)
+        self.seed = seed
+        self.policy = policy or RetryPolicy()
+        self.op_duration_s = op_duration_s
+        self.clock = SimClock()
+        self.retries = 0     # total retried attempts, for reporting
+
+    def describe(self, kind: str, address: str) -> str:
+        return f"{address}: {KINDS.get(kind, kind)} ({kind})"
+
+    def run_operation(self, address: str, op: str, timeout_s: float,
+                      log=None) -> int:
+        """Run one resource operation; returns the attempt count on
+        success, raises :class:`TerminalFault` / :class:`CrashSignal`."""
+        start = self.clock.now
+        backoff = self.policy.initial_s
+        attempt = 0
+        while True:
+            attempt += 1
+            self.clock.advance(self.op_duration_s)
+            kind = self.profile.draw_operation_fault(address, op, self.rng)
+            if kind is None:
+                return attempt
+            if kind == "crash":
+                raise CrashSignal(address, op)
+            if kind not in RETRYABLE:
+                raise TerminalFault(
+                    kind, address, op, attempt,
+                    f"{self.describe(kind, address)} — {op} failed after "
+                    f"{attempt} attempt(s)")
+            elapsed = self.clock.now - start
+            if elapsed + backoff + self.op_duration_s > timeout_s:
+                # the next attempt cannot finish inside the timeouts{}
+                # budget: terraform's "context deadline exceeded"
+                raise TerminalFault(
+                    "timeout", address, op, attempt,
+                    f"{address}: {op} timed out after "
+                    f"{format_duration(elapsed)} (timeout "
+                    f"{format_duration(timeout_s)}; last error: {kind})")
+            if log:
+                log(f"  retry: {address} {op} attempt {attempt} hit "
+                    f"{kind}; backing off {format_duration(backoff)}")
+            self.retries += 1
+            self.clock.advance(backoff)
+            backoff = min(backoff * self.policy.multiplier,
+                          self.policy.cap_s)
+
+    def check_state_write(self) -> None:
+        """Raise :class:`StateWriteFault` when the profile injects a
+        state-write failure (drawn once per write attempt)."""
+        if self.profile.draw_state_write_fault(self.rng):
+            raise StateWriteFault(
+                "failed to persist state to the backend "
+                "(state-write-failed)")

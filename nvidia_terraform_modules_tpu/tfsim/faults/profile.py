@@ -1,0 +1,198 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Fault profiles: which faults land on which operations, deterministically.
+
+A profile is a JSON file (``-fault-profile FILE``) listing fault specs::
+
+    {"faults": [
+      {"fault": "api-429", "resource": "google_container_node_pool.*",
+       "op": "create", "prob": 0.5, "max": 2},
+      {"fault": "tpu-stockout", "op": "create", "max": 1},
+      {"fault": "state-write-failed", "prob": 0.2}
+    ]}
+
+Each spec matches operations by resource-address glob (``resource``,
+default ``*``) and operation kind (``op``: ``create`` / ``update`` /
+``delete`` / ``any``), fires with probability ``prob`` (default 1.0)
+drawn from the seeded RNG (``-fault-seed N``), and injects at most
+``max`` times per apply (default 1; retryable faults usually want a
+small budget so the retry loop eventually wins).
+
+Fault kinds mirror the failure classes the google provider actually
+surfaces on TPU capacity:
+
+==================== ========= ==============================================
+kind                 class     semantics
+==================== ========= ==============================================
+``api-429``          retryable rate limit; capped exponential backoff
+``api-500``          retryable transient server error; same backoff
+``tpu-stockout``     terminal  no capacity for the slice; nothing created
+``quota-exceeded``   terminal  project quota; nothing created
+``preempted``        terminal  spot capacity created, then reclaimed —
+                               the resource lands in state **tainted**
+``state-write-failed`` special the state write itself fails; the CLI
+                               emits ``errored.tfstate`` instead
+``crash``            special   the process dies mid-apply: completed work
+                               is persisted, the state **lock is left
+                               behind** (break it with ``force-unlock``)
+==================== ========= ==============================================
+
+A retryable fault that never clears within the operation's ``timeouts``
+budget becomes the terminal pseudo-kind ``timeout`` ("context deadline
+exceeded"), which — like ``preempted`` — leaves the half-created
+resource tainted: the provider may have partially provisioned it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import json
+import random
+
+RETRYABLE = {
+    "api-429": "API rate limit exceeded (HTTP 429)",
+    "api-500": "transient API server error (HTTP 500)",
+}
+TERMINAL = {
+    "tpu-stockout": "TPU capacity stockout: no slice capacity available "
+                    "in the location",
+    "quota-exceeded": "quota exceeded for the project "
+                      "(compute.googleapis.com)",
+    "preempted": "spot/preemptible capacity was reclaimed during creation",
+}
+SPECIAL = {
+    "state-write-failed": "the state write failed",
+    "crash": "the apply process died mid-run",
+}
+KINDS = {**RETRYABLE, **TERMINAL, **SPECIAL}
+
+# terminal create-failures after which the provider may have partially
+# provisioned the resource: recorded in state AND tainted, so the next
+# apply replaces instead of duplicating (terraform's own stance)
+PARTIAL_CREATE = {"preempted", "timeout"}
+
+OPS = ("create", "update", "delete")
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One fault rule: kind + where it lands + how often."""
+
+    kind: str
+    resource: str = "*"     # address glob (fnmatch)
+    op: str = "any"         # create | update | delete | any
+    prob: float = 1.0       # per-draw probability (seeded RNG)
+    max: int = 1            # injection budget per apply
+    injected: int = 0       # runtime counter (not part of the file format)
+
+    def matches(self, address: str, op: str) -> bool:
+        return (self.op in ("any", op) and
+                fnmatch.fnmatchcase(address, self.resource))
+
+    def draw(self, rng: random.Random) -> bool:
+        """Consume one RNG draw; True when this spec fires (and has
+        budget left). The draw happens even at prob 1.0 so the RNG
+        stream — and therefore every downstream decision — depends only
+        on the seed and the deterministic operation order."""
+        if self.injected >= self.max:
+            return False
+        if rng.random() >= self.prob:
+            return False
+        self.injected += 1
+        return True
+
+
+@dataclasses.dataclass
+class FaultProfile:
+    specs: list[FaultSpec]
+
+    def draw_operation_fault(self, address: str, op: str,
+                             rng: random.Random) -> str | None:
+        """The fault kind (if any) injected into one operation attempt.
+        Specs are consulted in file order; the first that fires wins."""
+        for spec in self.specs:
+            if spec.kind == "state-write-failed":
+                continue   # drawn at state-write time, not per operation
+            if spec.matches(address, op) and spec.draw(rng):
+                return spec.kind
+        return None
+
+    def draw_state_write_fault(self, rng: random.Random) -> bool:
+        return any(spec.draw(rng) for spec in self.specs
+                   if spec.kind == "state-write-failed")
+
+    def reset(self) -> None:
+        for spec in self.specs:
+            spec.injected = 0
+
+
+def _spec_from_raw(raw: dict, where: str) -> FaultSpec:
+    if not isinstance(raw, dict):
+        raise ValueError(f"{where}: each fault spec must be an object")
+    kind = raw.get("fault")
+    if kind not in KINDS:
+        raise ValueError(
+            f"{where}: unknown fault kind {kind!r} "
+            f"(known: {', '.join(sorted(KINDS))})")
+    op = raw.get("op", "any")
+    if op not in OPS and op != "any":
+        raise ValueError(
+            f"{where}: op must be one of {', '.join(OPS)} or \"any\", "
+            f"got {op!r}")
+    resource = raw.get("resource", "*")
+    if not isinstance(resource, str):
+        raise ValueError(f"{where}: resource must be a glob string")
+    prob = raw.get("prob", 1.0)
+    if not isinstance(prob, (int, float)) or not 0.0 <= prob <= 1.0:
+        raise ValueError(f"{where}: prob must be a number in [0, 1]")
+    mx = raw.get("max", 1)
+    if not isinstance(mx, int) or mx < 1:
+        raise ValueError(f"{where}: max must be a positive integer")
+    extra = set(raw) - {"fault", "resource", "op", "prob", "max"}
+    if extra:
+        raise ValueError(
+            f"{where}: unknown key(s) {', '.join(sorted(extra))}")
+    return FaultSpec(kind=kind, resource=resource,
+                     op=op, prob=float(prob), max=mx)
+
+
+def profile_from_dict(raw, where: str = "fault profile") -> FaultProfile:
+    if not isinstance(raw, dict) or not isinstance(raw.get("faults"), list):
+        raise ValueError(
+            f'{where}: expected {{"faults": [ … ]}} at the top level')
+    return FaultProfile(specs=[
+        _spec_from_raw(s, f"{where}: faults[{i}]")
+        for i, s in enumerate(raw["faults"])
+    ])
+
+
+def load_profile(path: str) -> FaultProfile:
+    """Load and validate a fault-profile JSON file."""
+    try:
+        with open(path) as fh:
+            raw = json.load(fh)
+    except (OSError, json.JSONDecodeError) as ex:
+        raise ValueError(f"cannot read fault profile {path!r}: {ex}") from ex
+    return profile_from_dict(raw, where=path)
+
+
+# The built-in chaos mix: every failure class the issue names, with
+# probabilities tuned so an 8-seed sweep reliably exercises clean
+# applies, retried-then-converged applies, terminal interruptions,
+# state-write failures, and crashes.
+DEFAULT_CHAOS_PROFILE: dict = {
+    "faults": [
+        {"fault": "api-429", "op": "create", "prob": 0.25, "max": 2},
+        {"fault": "api-500", "op": "any", "prob": 0.10, "max": 2},
+        {"fault": "tpu-stockout",
+         "resource": "google_container_node_pool.*",
+         "op": "create", "prob": 0.20, "max": 1},
+        {"fault": "quota-exceeded", "op": "create", "prob": 0.10, "max": 1},
+        {"fault": "preempted",
+         "resource": "google_container_node_pool.*",
+         "op": "create", "prob": 0.15, "max": 1},
+        {"fault": "state-write-failed", "prob": 0.10, "max": 1},
+        {"fault": "crash", "prob": 0.10, "max": 1},
+    ],
+}

@@ -289,6 +289,50 @@ def check_pool_arithmetic(ctx: LintContext):
                        f"slice is atomic, the pool must match it")
 
 
+@rule("tpu-spot-no-recovery", severity="warning", family="tpu",
+      summary="spot/preemptible TPU pool with no timeouts block or "
+              "lifecycle guard")
+def check_spot_no_recovery(ctx: LintContext):
+    """Preemptible TPU capacity is exactly where mid-apply faults land:
+    a spot slice can be reclaimed while the pool is still creating, and
+    the retry loop then runs until the operation's ``timeouts`` budget —
+    the *provider default* budget if the config declares none, which is
+    rarely what an operator sizing for TPU stockout churn wants. A pool
+    that opts into preemptible capacity without a ``timeouts {}`` block
+    or a ``lifecycle {}`` guard (``create_before_destroy`` keeps serving
+    capacity while the replacement assembles) has no recovery posture at
+    all."""
+    for r in ctx.mod.resources.values():
+        if r.type != "google_container_node_pool":
+            continue
+        ncs = r.body.blocks_of("node_config")
+        if not ncs:
+            continue
+        spot = _literal(ctx, ncs[0].body.attr("spot"))
+        preemptible = _literal(ctx, ncs[0].body.attr("preemptible"))
+        if spot is not True and preemptible is not True:
+            continue
+        mt = _literal(ctx, ncs[0].body.attr("machine_type"))
+        is_tpu = isinstance(mt, str) and T.parse_machine_type(mt) is not None
+        if not is_tpu:
+            # a COMPACT policy with tpu_topology marks a TPU pool even
+            # when the machine type is not statically resolvable
+            is_tpu = any(
+                pbody is not None and pbody.attr("tpu_topology") is not None
+                for _blk, pbody in _placement_blocks(r.body))
+        if not is_tpu:
+            continue
+        if r.body.blocks_of("timeouts") or r.body.blocks_of("lifecycle"):
+            continue
+        flag = "spot" if spot is True else "preemptible"
+        yield (f"{r.file}:{r.line}",
+               f"{r.address}: {flag} TPU capacity with no timeouts block "
+               f"or lifecycle guard — preemption lands mid-apply; declare "
+               f"timeouts {{ create/delete }} sized to your capacity "
+               f"churn (and consider lifecycle.create_before_destroy) so "
+               f"an interrupted apply resumes instead of wedging")
+
+
 @rule("tpu-multihost-placement", severity="error", family="tpu",
       summary="multi-host TPU pool without a COMPACT placement policy")
 def check_multihost_placement(ctx: LintContext):

@@ -93,6 +93,16 @@ def _holder(state_path: str) -> LockInfo | None:
                         created="?", path=state_path)
 
 
+def read_holder(state_path: str) -> LockInfo | None:
+    """The current lock holder's info, or None when unlocked.
+
+    Public for the recovery tooling: the chaos harness (and an operator
+    scripting the playbook) reads the holder of a lock a fault-killed
+    apply left behind, confirms the holder is gone, and breaks it by ID
+    via :func:`force_unlock`."""
+    return _holder(state_path)
+
+
 def acquire_lock(state_path: str, operation: str,
                  timeout_s: float = 0.0) -> LockInfo:
     """Take the state lock or raise :class:`LockError` with holder info.

@@ -647,6 +647,18 @@ def instance_node(iaddr: str) -> str:
     return iaddr.split("[")[0]
 
 
+def instance_apply_order(plan: Plan, addrs) -> list[str]:
+    """Deterministic apply order for instance addresses: the plan's
+    topological node order, instances sorted within a node, addresses
+    whose node left the configuration (state-only deletes) last. The
+    stepwise fault-injecting apply performs operations in exactly this
+    sequence, so a given ``-fault-seed`` always lands its faults on the
+    same operations."""
+    rank = {n: i for i, n in enumerate(plan.order)}
+    return sorted(addrs, key=lambda a: (
+        rank.get(instance_node(a), len(rank)), a))
+
+
 def select_targets(plan: Plan, targets: list[str],
                    instances=None) -> set[str]:
     """Instance addresses covered by ``-target`` flags, terraform-style.

@@ -162,5 +162,7 @@ def check_not_stale(payload: dict[str, Any], prior: State | None) -> None:
         raise PlanFileError(
             f"saved plan is stale: it was computed against state serial "
             f"{saved}, but the current state is serial {current} — "
-            f"run plan again and re-review"
+            f"run plan again and re-review (an interrupted or partially "
+            f"failed apply advances the serial too: re-plan against the "
+            f"recovered state, never re-apply the old file)"
         )

@@ -127,9 +127,14 @@ def _is_data(addr: str) -> bool:
     return addr.startswith("data.")
 
 
-def _rendered_instances(plan: Plan) -> dict[str, Any]:
-    # data sources are read every run, never tracked — terraform counts
-    # neither their reads nor their disappearance as plan actions
+def rendered_instances(plan: Plan) -> dict[str, Any]:
+    """Address → rendered attrs for every *tracked* instance of ``plan``.
+
+    Data sources are read every run, never tracked — terraform counts
+    neither their reads nor their disappearance as plan actions. Public:
+    the stepwise fault-injecting apply (``tfsim/faults/apply.py``) walks
+    exactly this map one operation at a time.
+    """
     return {addr: render(dict(inst.attrs))
             for addr, inst in plan.instances.items()
             if not _is_data(addr)}
@@ -151,7 +156,7 @@ def diff(plan: Plan, state: State | None,
     """
     from .plan import select_targets
 
-    planned = _rendered_instances(plan)
+    planned = rendered_instances(plan)
     prior = dict(state.resources) if state else {}
     for addr in replace or []:
         if addr not in planned:
@@ -451,7 +456,7 @@ def refresh_state(plan: Plan, state: State | None
     changed = sorted(
         name for name in set(fresh) | set(state.outputs)
         if fresh.get(name) != state.outputs.get(name))
-    orphans = sorted(set(state.resources) - set(_rendered_instances(plan)))
+    orphans = sorted(set(state.resources) - set(rendered_instances(plan)))
     new_state = State(resources=dict(state.resources),
                       serial=state.serial + (1 if changed else 0),
                       outputs=fresh, tainted=set(state.tainted),
@@ -478,7 +483,7 @@ def apply_plan(plan: Plan, state: State | None = None,
     resources = dict(state.resources) if state else {}
     for addr in d.by_action("delete"):
         resources.pop(addr, None)
-    planned = _rendered_instances(plan)
+    planned = rendered_instances(plan)
     replaced = d.by_action("replace")
     for addr in d.by_action("create") + d.by_action("update") + replaced:
         resources[addr] = planned[addr]

@@ -1,0 +1,484 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Fault-injecting control plane: retries, partial apply, chaos (ISSUE 2).
+
+Drives the ``-fault-profile``/``-fault-seed`` apply path and the
+``tfsim chaos`` harness end-to-end through ``main(argv)``:
+
+- retryable faults (429/5xx) retry with backoff and converge;
+- terminal faults (stockout/quota) persist every already-created
+  resource and resume without duplicate creates;
+- preemption/timeout mid-create taints the half-created resource;
+- a state-write fault emits ``errored.tfstate`` that ``state push``
+  recovers (satellite: round-trip);
+- a crash leaves the state lock behind, breakable by ID with
+  ``force-unlock`` (satellite: regression);
+- the chaos sweep over ``gke-tpu`` is a standing tier-1 gate
+  (satellite: CI wiring);
+- a profile that injects nothing matches the atomic apply exactly.
+"""
+
+import io
+import json
+import os
+import sys
+
+import pytest
+
+from nvidia_terraform_modules_tpu.tfsim.__main__ import main
+from nvidia_terraform_modules_tpu.tfsim.faults import (
+    ControlPlane,
+    FaultProfile,
+    FaultSpec,
+    load_profile,
+)
+from nvidia_terraform_modules_tpu.tfsim.locking import lock_path, read_holder
+from nvidia_terraform_modules_tpu.tfsim.state import State
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GKE_TPU = os.path.join(ROOT, "gke-tpu")
+
+MOD_HCL = """
+resource "google_compute_network" "vpc" {
+  name = "net"
+}
+
+resource "google_container_cluster" "this" {
+  name    = "c"
+  network = google_compute_network.vpc.name
+
+  timeouts {
+    create = "45m"
+    delete = "45m"
+  }
+}
+
+resource "google_container_node_pool" "tpu" {
+  name    = "tpu"
+  cluster = google_container_cluster.this.name
+
+  timeouts {
+    create = "40s"
+  }
+}
+"""
+
+
+@pytest.fixture
+def mod(tmp_path):
+    d = tmp_path / "mod"
+    d.mkdir()
+    (d / "main.tf").write_text(MOD_HCL)
+    return str(d)
+
+
+def profile_file(tmp_path, *specs) -> str:
+    p = tmp_path / "faults.json"
+    p.write_text(json.dumps({"faults": list(specs)}))
+    return str(p)
+
+
+def load_state(path) -> State:
+    with open(path) as fh:
+        return State.from_json(fh.read())
+
+
+def assert_same_but_lineage(a: State, b: State) -> None:
+    assert a.resources == b.resources
+    assert a.outputs == b.outputs
+    assert a.tainted == b.tainted
+    assert a.serial == b.serial
+
+
+def apply_argv(mod, spath, *extra):
+    return ["apply", mod, "-state", str(spath), *extra]
+
+
+# ------------------------------------------------------------- profile layer
+
+def test_profile_validation_errors(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"faults": [{"fault": "volcano"}]}')
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        load_profile(str(bad))
+    bad.write_text('{"faults": [{"fault": "api-429", "op": "sideways"}]}')
+    with pytest.raises(ValueError, match="op must be one of"):
+        load_profile(str(bad))
+    bad.write_text('{"faults": [{"fault": "api-429", "prob": 7}]}')
+    with pytest.raises(ValueError, match="prob"):
+        load_profile(str(bad))
+    bad.write_text('{"faults": {}}')
+    with pytest.raises(ValueError, match="faults"):
+        load_profile(str(bad))
+    bad.write_text('{"faults": [{"fault": "api-429", "banana": 1}]}')
+    with pytest.raises(ValueError, match="unknown key"):
+        load_profile(str(bad))
+
+
+def test_spec_matching_and_budget():
+    spec = FaultSpec(kind="api-429",
+                     resource="google_container_node_pool.*", op="create")
+    assert spec.matches('google_container_node_pool.tpu["a"]', "create")
+    assert not spec.matches("google_compute_network.vpc", "create")
+    assert not spec.matches("google_container_node_pool.tpu", "delete")
+    import random
+
+    rng = random.Random(0)
+    assert spec.draw(rng)        # budget 1 …
+    assert not spec.draw(rng)    # … exhausted
+
+
+def test_retry_backoff_is_capped_and_timeout_terminal():
+    # an endless 429 storm must become terraform's deadline error, with
+    # backoff capped on the way (1 → 2 → 4 → … → 30 → 30)
+    from nvidia_terraform_modules_tpu.tfsim.faults import TerminalFault
+
+    cp = ControlPlane(FaultProfile(specs=[
+        FaultSpec(kind="api-429", max=10_000)]), seed=0)
+    with pytest.raises(TerminalFault) as ex:
+        cp.run_operation("google_container_node_pool.tpu", "create",
+                         timeout_s=600.0)
+    assert ex.value.kind == "timeout"
+    assert "timed out" in str(ex.value)
+    assert cp.retries > 3
+    assert cp.clock.now <= 600.0 + cp.op_duration_s
+
+
+# ----------------------------------------------------- the acceptance anchor
+
+def test_empty_profile_matches_atomic_apply(tmp_path, mod):
+    """A fault profile that injects nothing lands the exact state the
+    plain (atomic) apply produces — the fault layer adds zero drift."""
+    pfile = profile_file(tmp_path)   # {"faults": []}
+    plain, faulted = tmp_path / "plain.json", tmp_path / "faulted.json"
+    assert main(apply_argv(mod, plain)) == 0
+    assert main(apply_argv(mod, faulted, "-fault-profile", pfile,
+                           "-fault-seed", "7")) == 0
+    assert_same_but_lineage(load_state(plain), load_state(faulted))
+
+
+def test_fault_seed_requires_profile(tmp_path, mod, capsys):
+    # flag misuse is the rc-2 family, like every other refused combination
+    rc = main(apply_argv(mod, tmp_path / "s.json", "-fault-seed", "3"))
+    assert rc == 2
+    assert "-fault-seed needs -fault-profile" in capsys.readouterr().err
+
+
+def test_bad_timeouts_duration_fails_before_any_operation(tmp_path,
+                                                          capsys):
+    """A malformed timeouts{} duration must fail the faulted apply up
+    front — never halfway through, which would orphan completed work."""
+    d = tmp_path / "badmod"
+    d.mkdir()
+    (d / "main.tf").write_text("""
+resource "google_compute_network" "vpc" {
+  name = "net"
+}
+
+resource "google_container_cluster" "this" {
+  name    = "c"
+  network = google_compute_network.vpc.name
+
+  timeouts {
+    create = "bogus"
+  }
+}
+""")
+    pfile = profile_file(tmp_path)
+    spath = tmp_path / "s.json"
+    assert main(apply_argv(str(d), spath, "-fault-profile", pfile)) == 1
+    err = capsys.readouterr().err
+    assert "google_container_cluster.this" in err and "bogus" in err
+    # nothing ran, nothing was created, no state was written
+    assert not spath.exists()
+
+
+# ------------------------------------------------------------ failure modes
+
+def test_retryable_fault_retries_then_converges(tmp_path, mod, capsys):
+    pfile = profile_file(
+        tmp_path, {"fault": "api-429", "op": "create", "max": 2})
+    spath = tmp_path / "s.json"
+    assert main(apply_argv(mod, spath, "-fault-profile", pfile)) == 0
+    err = capsys.readouterr().err
+    assert "retry:" in err and "api-429" in err and "backing off" in err
+    plain = tmp_path / "plain.json"
+    assert main(apply_argv(mod, plain)) == 0
+    assert_same_but_lineage(load_state(plain), load_state(spath))
+
+
+def test_stockout_persists_partial_state_and_resumes(tmp_path, mod, capsys):
+    pfile = profile_file(tmp_path, {
+        "fault": "tpu-stockout", "resource": "google_container_node_pool.*",
+        "op": "create"})
+    spath = tmp_path / "s.json"
+    assert main(apply_argv(mod, spath, "-fault-profile", pfile)) == 1
+    err = capsys.readouterr().err
+    assert "tpu-stockout" in err and "Run apply again to resume" in err
+    partial = load_state(spath)
+    # dependency order: network and cluster created BEFORE the pool
+    # faulted, and both were persisted; the pool is absent, not tainted
+    # (stockout creates nothing)
+    assert set(partial.resources) == {"google_compute_network.vpc",
+                                      "google_container_cluster.this"}
+    assert partial.tainted == set()
+    # resume: ONE create left, no duplicate creates of the survivors
+    assert main(apply_argv(mod, spath)) == 0
+    out = capsys.readouterr().out
+    assert "Apply complete: 1 added, 0 changed, 0 destroyed." in out
+    assert set(load_state(spath).resources) == {
+        "google_compute_network.vpc", "google_container_cluster.this",
+        "google_container_node_pool.tpu"}
+
+
+def test_preempted_taints_half_created_resource(tmp_path, mod, capsys):
+    pfile = profile_file(tmp_path, {
+        "fault": "preempted", "resource": "google_container_node_pool.*",
+        "op": "create"})
+    spath = tmp_path / "s.json"
+    assert main(apply_argv(mod, spath, "-fault-profile", pfile)) == 1
+    err = capsys.readouterr().err
+    assert "is tainted and will be replaced" in err
+    partial = load_state(spath)
+    assert partial.tainted == {"google_container_node_pool.tpu"}
+    assert "google_container_node_pool.tpu" in partial.resources
+    # the re-apply REPLACES the tainted pool (one add + one destroy),
+    # creates nothing else, and clears the taint
+    assert main(apply_argv(mod, spath)) == 0
+    assert "Apply complete: 1 added, 0 changed, 1 destroyed." in \
+        capsys.readouterr().out
+    final = load_state(spath)
+    assert final.tainted == set()
+    assert len(final.resources) == 3
+
+
+def test_timeout_exhaustion_honors_timeouts_block(tmp_path, mod, capsys):
+    # the pool's config declares create = "40s": a 429 storm longer than
+    # that budget is terraform's deadline error, and the maybe-created
+    # resource is tainted
+    pfile = profile_file(tmp_path, {
+        "fault": "api-429", "resource": "google_container_node_pool.*",
+        "op": "create", "max": 100})
+    spath = tmp_path / "s.json"
+    assert main(apply_argv(mod, spath, "-fault-profile", pfile)) == 1
+    err = capsys.readouterr().err
+    assert "timed out" in err and "40s" in err
+    assert load_state(spath).tainted == {"google_container_node_pool.tpu"}
+    assert main(apply_argv(mod, spath)) == 0
+
+
+def test_same_seed_same_outcome(tmp_path, mod, capsys):
+    pfile = profile_file(
+        tmp_path,
+        {"fault": "api-500", "op": "any", "prob": 0.3, "max": 2},
+        {"fault": "quota-exceeded", "op": "create", "prob": 0.4})
+    outs = []
+    for run in ("a", "b"):
+        spath = tmp_path / f"{run}.json"
+        rc = main(apply_argv(mod, spath, "-fault-profile", pfile,
+                             "-fault-seed", "5"))
+        cap = capsys.readouterr()
+        outs.append((rc, cap.out, cap.err,
+                     load_state(spath).resources if spath.exists() else None))
+    assert outs[0] == outs[1]
+
+
+# ------------------------------------------- errored.tfstate (satellite 4)
+
+def test_errored_tfstate_roundtrip(tmp_path, mod, capsys):
+    pfile = profile_file(tmp_path, {"fault": "state-write-failed"})
+    spath = tmp_path / "s.json"
+    assert main(apply_argv(mod, spath, "-fault-profile", pfile)) == 1
+    err = capsys.readouterr().err
+    assert "errored.tfstate" in err and "state push" in err
+    errored = tmp_path / "errored.tfstate"
+    assert errored.exists()
+    assert not spath.exists()        # the write is what failed
+    # every resource the apply created is in the errored snapshot — the
+    # whole point: nothing the cloud now has is lost
+    snap = load_state(errored)
+    assert len(snap.resources) == 3
+    # push it back, exactly the documented playbook
+    old_stdin = sys.stdin
+    try:
+        sys.stdin = io.StringIO(errored.read_text())
+        assert main(["state", "push", "-state", str(spath)]) == 0
+    finally:
+        sys.stdin = old_stdin
+    # re-apply converges as a no-op: state and reality already agree
+    assert main(apply_argv(mod, spath)) == 0
+    assert "Apply complete: 0 added, 0 changed, 0 destroyed." in \
+        capsys.readouterr().out
+    plain = tmp_path / "plain.json"
+    assert main(apply_argv(mod, plain)) == 0
+    assert load_state(plain).resources == load_state(spath).resources
+
+
+# ------------------------------------- crashed-apply lock (satellite 3)
+
+def test_crash_leaves_lock_breakable_by_id(tmp_path, mod, capsys):
+    pfile = profile_file(tmp_path, {"fault": "crash", "op": "create"})
+    spath = tmp_path / "s.json"
+    assert main(apply_argv(mod, spath, "-fault-profile", pfile)) == 1
+    assert "simulated crash" in capsys.readouterr().err
+    # the crash left the lock behind — a plain re-apply hits contention
+    assert os.path.exists(lock_path(str(spath)))
+    assert main(apply_argv(mod, spath)) == 1
+    err = capsys.readouterr().err
+    assert "Error acquiring the state lock" in err
+    assert "force-unlock" in err
+    # the regression under test: the fault-killed apply's lock is
+    # breakable by its ID, and the next apply then converges
+    holder = read_holder(str(spath))
+    assert holder is not None
+    assert main(["force-unlock", holder.id, "-state", str(spath)]) == 0
+    assert not os.path.exists(lock_path(str(spath)))
+    assert main(apply_argv(mod, spath)) == 0
+    assert len(load_state(spath).resources) == 3
+    assert load_state(spath).tainted == set()
+
+
+# ------------------------------------------------- saved-plan apply parity
+
+def test_saved_plan_apply_with_faults_then_stale_guard(tmp_path, mod,
+                                                       capsys):
+    spath, planfile = tmp_path / "s.json", tmp_path / "p.tfplan"
+    assert main(["plan", mod, "-state", str(spath), "-out",
+                 str(planfile)]) == 0
+    pfile = profile_file(tmp_path, {
+        "fault": "quota-exceeded", "resource": "google_container_*",
+        "op": "create"})
+    capsys.readouterr()
+    assert main(["apply", str(planfile), "-fault-profile", pfile]) == 1
+    assert "quota-exceeded" in capsys.readouterr().err
+    # the interrupted apply advanced the serial: the reviewed plan is now
+    # stale and must be refused, not half-re-applied
+    assert main(["apply", str(planfile)]) == 1
+    assert "saved plan is stale" in capsys.readouterr().err
+    # fresh plan → apply converges
+    assert main(["plan", mod, "-state", str(spath), "-out",
+                 str(planfile) + "2"]) == 0
+    assert main(["apply", str(planfile) + "2"]) == 0
+    assert len(load_state(spath).resources) == 3
+
+
+# ------------------------------------------------------- chaos (satellite 6)
+
+def test_chaos_sweep_small_module_json(tmp_path, mod):
+    import contextlib
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(["chaos", mod, "-seeds", "4", "-json"])
+    assert rc == 0
+    payload = json.loads(buf.getvalue())
+    assert payload["total"] == 4 and payload["converged"] == 4
+    assert all(s["ok"] for s in payload["seeds"])
+
+
+def test_chaos_sweep_gke_tpu_converges(capsys):
+    """The acceptance bar: 8 seeded interrupted applies over the
+    flagship module all leave state from which a second apply converges
+    to plan, and teardown from any interruption stays clean."""
+    rc = main(["chaos", GKE_TPU, "-var", "project_id=chaos-proj",
+               "-var", "cluster_name=chaos", "-seeds", "8"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "8/8 seed(s) converged" in out
+
+
+def test_chaos_refuses_bad_args(tmp_path, mod, capsys):
+    assert main(["chaos", mod, "-seeds", "0"]) == 1
+    assert "-seeds" in capsys.readouterr().err
+    missing = tmp_path / "nope.json"
+    assert main(["chaos", mod, "-fault-profile", str(missing)]) == 1
+    assert "cannot read fault profile" in capsys.readouterr().err
+
+
+# ------------------------------------------- lint rule (satellite 2)
+
+SPOT_POOL = """
+resource "google_container_cluster" "c" {
+  name = "c"
+}
+
+resource "google_container_node_pool" "spot_tpu" {
+  name       = "p"
+  cluster    = google_container_cluster.c.name
+  node_count = 1
+
+  node_config {
+    machine_type = "ct5lp-hightpu-4t"
+    spot         = true
+  }
+%s}
+"""
+
+
+def _lint(path):
+    from nvidia_terraform_modules_tpu.tfsim.lint import run_lint
+
+    return [f for f in run_lint(path) if f.rule == "tpu-spot-no-recovery"]
+
+
+def _write(tmp_path, body):
+    d = tmp_path / "lintmod"
+    d.mkdir(exist_ok=True)
+    (d / "main.tf").write_text(body)
+    return str(d)
+
+
+def test_spot_no_recovery_warns(tmp_path):
+    findings = _lint(_write(tmp_path, SPOT_POOL % ""))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.severity == "warning"
+    assert "spot TPU capacity" in f.message and "timeouts" in f.message
+
+
+def test_spot_no_recovery_silenced_by_timeouts_or_lifecycle(tmp_path):
+    with_timeouts = SPOT_POOL % (
+        "\n  timeouts {\n    create = \"45m\"\n    delete = \"45m\"\n  }\n")
+    assert _lint(_write(tmp_path, with_timeouts)) == []
+    with_lifecycle = SPOT_POOL % (
+        "\n  lifecycle {\n    create_before_destroy = true\n  }\n")
+    assert _lint(_write(tmp_path, with_lifecycle)) == []
+
+
+def test_spot_no_recovery_ignores_non_tpu_and_on_demand(tmp_path):
+    on_demand = SPOT_POOL % ""
+    assert _lint(_write(tmp_path, on_demand.replace(
+        "spot         = true", "spot         = false"))) == []
+    non_tpu = SPOT_POOL % ""
+    assert _lint(_write(tmp_path, non_tpu.replace(
+        "ct5lp-hightpu-4t", "n2-standard-8"))) == []
+
+
+def test_spot_no_recovery_fires_on_preemptible_with_tpu_placement(tmp_path):
+    body = """
+resource "google_container_cluster" "c" {
+  name = "c"
+}
+
+resource "google_container_node_pool" "spot_tpu" {
+  name    = "p"
+  cluster = google_container_cluster.c.name
+
+  placement_policy {
+    type         = "COMPACT"
+    tpu_topology = "2x4"
+  }
+
+  node_config {
+    machine_type = var.machine
+    preemptible  = true
+  }
+}
+
+variable "machine" {
+  type = string
+}
+"""
+    findings = _lint(_write(tmp_path, body))
+    assert len(findings) == 1
+    assert "preemptible TPU capacity" in findings[0].message
