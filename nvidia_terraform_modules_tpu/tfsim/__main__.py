@@ -18,15 +18,21 @@ terraform binary in CI, so tfsim ships the same verbs offline::
         [-detailed-exitcode] [-generate-config-out generated.tf]
     python -m nvidia_terraform_modules_tpu.tfsim apply gke-tpu ... -state f \
         [-target ADDR] [-replace ADDR] [-refresh-only] [-destroy] \
-        [-fault-profile faults.json] [-fault-seed N]   # deterministic fault
-        # injection: stockout/quota/429/5xx/preemption/crash mid-apply,
-        # retry+backoff honoring timeouts{}, partial state + taint on
-        # terminal failure, errored.tfstate when the state write fails
+        [-fault-profile faults.json] [-fault-seed N] \
+        [-parallelism 10]   # deterministic fault injection:
+        # stockout/quota/429/5xx/preemption/crash mid-apply, retry+backoff
+        # honoring timeouts{}, graph-parallel scheduling of up to
+        # -parallelism N concurrent operations with terraform's failure
+        # isolation (independent branches finish, only a failed node's
+        # dependents are skipped), partial state + taint on terminal
+        # failure, errored.tfstate when the state write fails
     python -m nvidia_terraform_modules_tpu.tfsim apply plan.tfplan   # saved-plan apply
     python -m nvidia_terraform_modules_tpu.tfsim chaos gke-tpu -var ... \
-        [-seeds 8] [-fault-profile faults.json] [-json]   # sweep fault
-        # seeds, assert interrupted applies re-converge and destroys
-        # stay clean (the convergence gate for a module)
+        [-seeds 8] [-parallelism 1,4,10] [-fault-profile faults.json] \
+        [-json]   # sweep fault seeds × parallelism levels, assert
+        # interrupted applies re-converge (empty re-plan), destroys stay
+        # clean, and the schedule is dependency-safe, capped, and skips
+        # exactly the failure closure (the convergence gate for a module)
     python -m nvidia_terraform_modules_tpu.tfsim show plan.tfplan [-json]
     python -m nvidia_terraform_modules_tpu.tfsim refresh gke-tpu ... -state f
     python -m nvidia_terraform_modules_tpu.tfsim import gke-tpu ADDR ID -state f ...
@@ -37,7 +43,9 @@ terraform binary in CI, so tfsim ships the same verbs offline::
     python -m nvidia_terraform_modules_tpu.tfsim taint|untaint ADDR (-state f | -dir MODULE)
     python -m nvidia_terraform_modules_tpu.tfsim force-unlock LOCK_ID (-state f | -dir MODULE)
     python -m nvidia_terraform_modules_tpu.tfsim version
-    python -m nvidia_terraform_modules_tpu.tfsim graph gke-tpu -var ...
+    python -m nvidia_terraform_modules_tpu.tfsim graph gke-tpu -var ... \
+        [-cycles]   # on a dependency cycle, render the full cycle path
+        # as a red DOT subgraph instead of only the arrow-joined message
     python -m nvidia_terraform_modules_tpu.tfsim test gke-tpu [-filter F]
     python -m nvidia_terraform_modules_tpu.tfsim workspace new gke-tpu staging
     python -m nvidia_terraform_modules_tpu.tfsim console gke-tpu -var ... \
@@ -74,7 +82,7 @@ SIM_TERRAFORM_VERSION = "1.9.0"
 
 from .destroy import simulate_destroy
 from .docs import check_readme, generate_docs
-from .faults import SimulatedCrash, StateWriteFault
+from .faults import DEFAULT_PARALLELISM, SimulatedCrash, StateWriteFault
 from .fmt import check_text, format_text
 from .lockfile import LockfileError, check_lockfile, write_lockfile
 from .locking import LockError
@@ -728,13 +736,19 @@ def errored_state_path(state_path: str) -> str:
                         "errored.tfstate")
 
 
-def _apply_with_faults(cp, plan, prior, d, targets, state_path) -> int:
-    """The fault-injected apply: stepwise engine + state persistence.
+def _apply_with_faults(cp, plan, prior, d, targets, state_path,
+                       parallelism: int = DEFAULT_PARALLELISM) -> int:
+    """The fault-injected apply: graph-parallel engine + persistence.
 
-    Terminal failure persists the partial state (half-created resource
-    tainted) and exits 1 with a resume message; a state-write fault
-    dumps ``errored.tfstate`` instead; a crash persists partial state
-    and re-raises :class:`SimulatedCrash` so ``_state_lock`` leaves the
+    The engine dispatches up to ``parallelism`` operations concurrently
+    on the simulated clock and applies terraform's failure isolation: a
+    terminal fault fails its operation, skips the transitive dependents
+    (each reported as ``<addr>: skipped — dependency <failed addr>
+    errored``), and lets independent branches finish; everything
+    completed is persisted (half-created resources tainted) and the
+    apply exits 1 with a resume message. A state-write fault dumps
+    ``errored.tfstate`` instead; a crash persists partial state and
+    re-raises :class:`SimulatedCrash` so ``_state_lock`` leaves the
     lock behind. Returns 0 when every operation (retries included)
     succeeded — the caller prints the normal apply summary.
     """
@@ -744,17 +758,19 @@ def _apply_with_faults(cp, plan, prior, d, targets, state_path) -> int:
         print(msg, file=sys.stderr)
 
     try:
-        outcome = run_apply(plan, prior, cp, targets, d=d, log=log)
+        outcome = run_apply(plan, prior, cp, targets, d=d, log=log,
+                            parallelism=parallelism)
     except SimulatedCrash as ex:
         if state_path and ex.outcome.mutated:
             _write_state(state_path, ex.outcome.state)
         raise
-    if outcome.failure is not None:
-        # surfaced BEFORE the state-write check: when both land (a
-        # terminal op failure AND a failed write of the partial state),
-        # the operator must see both diagnostics, not just the second
-        print(f"Error: apply interrupted: {outcome.failure.message}",
-              file=sys.stderr)
+    # surfaced BEFORE the state-write check: when both land (terminal
+    # op failures AND a failed write of the partial state), the
+    # operator must see every diagnostic, not just the last
+    for f in outcome.failures:
+        print(f"Error: apply interrupted: {f.message}", file=sys.stderr)
+    for s in outcome.skipped:
+        print(s.describe(), file=sys.stderr)
     try:
         cp.check_state_write()
     except StateWriteFault as ex:
@@ -772,15 +788,21 @@ def _apply_with_faults(cp, plan, prior, d, targets, state_path) -> int:
         return 1
     if state_path and (outcome.mutated or not os.path.exists(state_path)):
         _write_state(state_path, outcome.state)
-    if outcome.failure is not None:
-        f = outcome.failure
-        tainted = f.address in outcome.state.tainted
-        print(f"State saved: {len(outcome.completed)} completed "
-              f"operation(s) persisted"
-              + (f"; {f.address} is tainted and will be replaced"
-                 if tainted else "")
-              + ". Run apply again to resume — already-created "
-                "resources are never recreated.", file=sys.stderr)
+    if outcome.failures:
+        tainted = sorted({f.address for f in outcome.failures}
+                         & outcome.state.tainted)
+        msg = (f"State saved: {len(outcome.completed)} completed "
+               f"operation(s) persisted")
+        if tainted:
+            msg += (f"; {', '.join(tainted)} "
+                    f"{'is' if len(tainted) == 1 else 'are'} tainted "
+                    f"and will be replaced")
+        if outcome.skipped:
+            msg += (f"; {len(outcome.skipped)} dependent operation(s) "
+                    f"skipped")
+        msg += (". Run apply again to resume — already-created "
+                "resources are never recreated.")
+        print(msg, file=sys.stderr)
         return 1
     return 0
 
@@ -843,7 +865,8 @@ def _apply_saved_plan(args) -> int:
                 _write_state(state_path, state)
         else:
             rc = _apply_with_faults(cp, plan, prior, d, targets,
-                                    state_path)
+                                    state_path,
+                                    parallelism=args.parallelism)
             if rc:
                 return rc
     for failure in plan.check_failures:
@@ -862,6 +885,9 @@ def cmd_apply(args) -> int:
         # module-dir and saved-plan paths get the same refusal)
         print("Error: -fault-seed needs -fault-profile FILE (the seed "
               "draws from the profile)", file=sys.stderr)
+        return 2
+    if getattr(args, "parallelism", DEFAULT_PARALLELISM) < 1:
+        print("Error: -parallelism must be at least 1", file=sys.stderr)
         return 2
     try:
         if os.path.isfile(args.dir):
@@ -914,10 +940,21 @@ def cmd_apply(args) -> int:
             else:
                 rc = _apply_with_faults(cp, plan, prior, d,
                                         getattr(args, "target", None),
-                                        state_path)
+                                        state_path,
+                                        parallelism=args.parallelism)
                 if rc:
                     return rc
     except SimulatedCrash as ex:
+        # the crash may have followed terminal failures on OTHER
+        # branches (impossible serially, routine in a parallel walk):
+        # those diagnostics died with the process's stderr buffer, so
+        # report them here — the operator must see every failure, not
+        # just the crash
+        for f in ex.outcome.failures:
+            print(f"Error: apply interrupted: {f.message}",
+                  file=sys.stderr)
+        for s in ex.outcome.skipped:
+            print(s.describe(), file=sys.stderr)
         print(f"Error: {ex}", file=sys.stderr)
         return 1
     except (PlanError, PlanFileError, ValueError, OSError) as ex:
@@ -931,22 +968,46 @@ def cmd_apply(args) -> int:
     return 0
 
 
+def _parse_parallelism_levels(raw: str) -> list[int]:
+    """``-parallelism "1,4,10"`` → the sweep's worker-pool sizes."""
+    levels: list[int] = []
+    for part in str(raw).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            levels.append(int(part))
+        except ValueError:
+            raise ValueError(
+                f"-parallelism expects a comma-separated list of "
+                f"integers (e.g. 1,4,10), got {raw!r}") from None
+    if not levels or any(p < 1 for p in levels):
+        raise ValueError(
+            f"-parallelism levels must all be >= 1, got {raw!r}")
+    return levels
+
+
 def cmd_chaos(args) -> int:
     """``tfsim chaos DIR``: the convergence gate for a module.
 
-    Sweeps ``-seeds`` fault seeds (profile: ``-fault-profile`` or the
-    built-in chaos mix) over the module in throwaway sandboxes, driving
-    the real CLI end-to-end, and asserts the invariants: an interrupted
-    apply leaves state from which a fault-free re-apply reaches exactly
-    the planned state (no orphans, no duplicate creates, no lingering
-    taint), crash-left locks break by ID, ``errored.tfstate`` pushes
-    back, and a destroy from any interrupted state empties it.
+    Sweeps ``-seeds`` fault seeds × ``-parallelism`` levels (profile:
+    ``-fault-profile`` or the built-in chaos mix) over the module in
+    throwaway sandboxes, driving the real CLI end-to-end, and asserts
+    the invariants: an interrupted apply leaves state from which a
+    fault-free re-apply reaches exactly the planned state (no orphans,
+    no duplicate creates, no lingering taint) and an empty follow-up
+    plan; crash-left locks break by ID; ``errored.tfstate`` pushes
+    back; a destroy from any interrupted state empties it; and the
+    schedule itself is sound — dependency-order safe, capped at the
+    parallelism level, skipping exactly the failure closure,
+    deterministic per (seed, parallelism).
     """
     from .faults import run_chaos
 
     try:
         if args.seeds < 1:
             raise ValueError("-seeds must be >= 1")
+        levels = _parse_parallelism_levels(args.parallelism)
         tfvars = _gather_vars(args)
         var_argv: list[str] = []
         for f in args.var_file or []:
@@ -956,6 +1017,7 @@ def cmd_chaos(args) -> int:
         results = run_chaos(
             main, args.dir, tfvars, var_argv, seeds=args.seeds,
             profile_path=getattr(args, "fault_profile", None),
+            parallelism_levels=levels,
             log=None if args.json else print)
     except (PlanError, ValueError, OSError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
@@ -964,22 +1026,27 @@ def cmd_chaos(args) -> int:
     interrupted = sum(1 for r in results if r.interrupted)
     crashed = sum(1 for r in results if r.crashed)
     errored = sum(1 for r in results if r.errored_state)
+    skipped = sum(r.skipped for r in results)
     if args.json:
         print(json.dumps({
-            "seeds": [{
-                "seed": r.seed, "ok": r.ok, "interrupted": r.interrupted,
-                "crashed": r.crashed, "errored_state": r.errored_state,
-                "recovery": r.recovery, "violations": r.violations,
-            } for r in results],
+            # one record per (seed, parallelism) run: seed, parallelism,
+            # failure op/kind, skipped count, converged bool — the
+            # machine-readable face of summary()
+            "runs": [r.record() for r in results],
+            "parallelism_levels": levels,
+            "seeds": args.seeds,
             "converged": len(results) - len(bad),
             "total": len(results),
         }, indent=2, sort_keys=True))
     else:
-        print(f"chaos: {len(results) - len(bad)}/{len(results)} seed(s) "
-              f"converged ({interrupted} interrupted, {crashed} crash(es), "
-              f"{errored} errored.tfstate)")
+        print(f"chaos: {len(results) - len(bad)}/{len(results)} run(s) "
+              f"converged over parallelism "
+              f"{{{', '.join(str(p) for p in levels)}}} "
+              f"({interrupted} interrupted, {crashed} crash(es), "
+              f"{errored} errored.tfstate, {skipped} skipped op(s))")
     for r in bad:
-        print(f"--- seed {r.seed} violated: {'; '.join(r.violations)}\n"
+        print(f"--- seed {r.seed} ×{r.parallelism} violated: "
+              f"{'; '.join(r.violations)}\n"
               f"{r.transcript}", file=sys.stderr)
     return 1 if bad else 0
 
@@ -1121,9 +1188,19 @@ def cmd_output(args) -> int:
 
 
 def cmd_graph(args) -> int:
+    from .plan import CycleError, cycle_to_dot
+
     try:
         print(to_dot(simulate_plan(load_module(args.dir),
                                    _gather_vars(args))), end="")
+    except CycleError as ex:
+        if getattr(args, "cycles", False):
+            # -cycles: the full cycle path as a DOT subgraph highlight
+            # (paste into the graph rendering to SEE the loop), not
+            # just the arrow-joined message
+            print(cycle_to_dot(ex.cycle), end="")
+        print(f"Error: {ex}", file=sys.stderr)
+        return 1
     except (PlanError, ValueError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
         return 1
@@ -1714,10 +1791,17 @@ def main(argv: list[str] | None = None) -> int:
     a.add_argument("-destroy", action="store_true", dest="destroy")
     a.add_argument("-fault-profile", default=None, dest="fault_profile")
     a.add_argument("-fault-seed", type=int, default=None, dest="fault_seed")
+    # terraform's concurrency knob: up to N resource operations at a
+    # time in the fault-injected (graph-parallel) apply; 1 = the
+    # historical serial engine, byte-for-byte
+    a.add_argument("-parallelism", type=int, default=DEFAULT_PARALLELISM,
+                   dest="parallelism")
 
     ch = add_module_cmd("chaos", cmd_chaos)
     ch.add_argument("-seeds", type=int, default=8)
     ch.add_argument("-fault-profile", default=None, dest="fault_profile")
+    ch.add_argument("-parallelism", default="1,4,10", dest="parallelism",
+                    metavar="N[,N...]")
     ch.add_argument("-json", action="store_true")
 
     sh = sub.add_parser("show")
@@ -1728,7 +1812,8 @@ def main(argv: list[str] | None = None) -> int:
     rf = add_module_cmd("refresh", cmd_refresh, state=True)
     rf.add_argument("-workspace", default=None)
     add_module_cmd("destroy", cmd_destroy)
-    add_module_cmd("graph", cmd_graph)
+    gr = add_module_cmd("graph", cmd_graph)
+    gr.add_argument("-cycles", action="store_true", dest="cycles")
     imp = add_module_cmd("import", cmd_import, state=True)
     imp.add_argument("address")
     imp.add_argument("id")

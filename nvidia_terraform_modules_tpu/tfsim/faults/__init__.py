@@ -16,17 +16,22 @@ re-apply — is testable offline:
   resource operation becomes a lifecycle with retryable vs terminal
   error classes, capped exponential backoff, and per-operation
   ``timeouts {}`` budgets on a simulated clock (no real sleeps);
-- :mod:`apply` — the stepwise apply engine: walks the diff in
-  dependency order, persists every completed operation, taints the
-  half-created resource on terminal failure;
-- :mod:`chaos` — the ``tfsim chaos`` harness: sweeps N seeds over a
-  module and asserts the convergence invariants.
+- :mod:`apply` — the graph-parallel apply engine: schedules the diff's
+  per-instance operation DAG with up to ``-parallelism N`` concurrent
+  operations on the simulated clock (deterministic event-heap
+  arbitration), persists every completed operation, taints half-created
+  resources, and — terraform's failure isolation — skips only a failed
+  operation's transitive dependents while independent branches finish;
+- :mod:`chaos` — the ``tfsim chaos`` harness: sweeps seeds ×
+  parallelism levels over a module and asserts the convergence and
+  scheduling invariants.
 """
 
 from .control_plane import (  # noqa: F401
     ControlPlane,
     CrashSignal,
     FaultError,
+    OpRun,
     RetryPolicy,
     SimClock,
     StateWriteFault,
@@ -39,5 +44,14 @@ from .profile import (  # noqa: F401
     FaultSpec,
     load_profile,
 )
-from .apply import ApplyOutcome, OpFailure, SimulatedCrash, run_apply  # noqa: F401
+from .apply import (  # noqa: F401
+    DEFAULT_PARALLELISM,
+    ApplyOutcome,
+    OpFailure,
+    OpTrace,
+    SimulatedCrash,
+    SkippedOp,
+    operation_schedule,
+    run_apply,
+)
 from .chaos import SeedResult, run_chaos  # noqa: F401

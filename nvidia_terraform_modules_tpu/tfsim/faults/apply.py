@@ -1,21 +1,35 @@
 # SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
 # SPDX-License-Identifier: Apache-2.0
-"""The stepwise apply engine: one operation at a time, fault-aware.
+"""The graph-parallel apply engine: terraform's walk, fault-aware.
 
 ``apply_plan`` (:mod:`..state`) realises a diff atomically — correct,
-but it cannot fail halfway. This engine walks the same diff as the
-sequence of operations a real ``terraform apply`` performs (deletes in
-reverse dependency order, then creates/updates/replaces in dependency
-order), runs each through the :class:`..faults.control_plane.ControlPlane`,
-and on terminal failure does what terraform does:
+but it cannot fail halfway and it cannot race. Real ``terraform apply``
+walks the resource graph with up to ``-parallelism N`` (default 10)
+concurrent operations, and when one fails terminally it does NOT abort
+the world: independent branches run to completion, only the failed
+node's transitive dependents are *skipped*. This engine reproduces that
+walk deterministically:
 
-- every already-completed operation is **persisted** to the returned
-  state (no orphans: a created resource is never forgotten);
-- a half-created resource (preemption or timeout mid-create) is
-  recorded **tainted**, so the next apply replaces it instead of
-  creating a duplicate;
-- the remaining operations are simply not performed — a second apply
-  plans exactly the leftover work and converges.
+- the diff becomes a per-instance operation DAG
+  (:func:`operation_schedule`): creates/updates in dependency order,
+  deletes in reverse-edge order, a replace expanding to its
+  delete → create pair (destroy-before-create default);
+- up to ``parallelism`` ready operations run concurrently on the
+  :class:`..control_plane.ControlPlane`'s **simulated clock**. Dispatch
+  order is the serial priority order, completions are arbitrated on an
+  event heap with a deterministic tie-break — identical
+  ``(-fault-seed, -parallelism)`` ⇒ identical interleaving, and
+  ``-parallelism 1`` reproduces the historical serial engine exactly
+  (same RNG stream, same operation order, same output);
+- terraform's failure isolation: a terminal fault marks the operation
+  failed, its transitive dependents become **skipped** (reported as
+  ``"<addr>: skipped — dependency <failed addr> errored"``), every
+  completed operation is **persisted** to the returned state, and a
+  half-created resource (preemption/timeout mid-create) is recorded
+  **tainted** so the next apply replaces it instead of duplicating it;
+- a ``crash`` kills the process at its event time: operations still in
+  flight report nothing (neither completed nor tainted), exactly like
+  the crashing operation itself.
 
 When every operation succeeds the engine returns ``apply_plan``'s own
 result, so a profile that injects nothing is bit-identical to the
@@ -25,8 +39,9 @@ atomic path.
 from __future__ import annotations
 
 import dataclasses
+import heapq
 
-from ..plan import Plan, instance_apply_order
+from ..plan import Plan, instance_apply_order, instance_dependencies
 from ..state import Diff, State, apply_plan, diff, rendered_instances
 from .control_plane import (
     DEFAULT_TIMEOUT_S,
@@ -37,6 +52,9 @@ from .control_plane import (
     parse_duration,
 )
 from .profile import PARTIAL_CREATE
+
+# terraform's own default for `-parallelism`
+DEFAULT_PARALLELISM = 10
 
 
 class SimulatedCrash(FaultError):
@@ -55,7 +73,8 @@ class SimulatedCrash(FaultError):
 
 @dataclasses.dataclass
 class OpFailure:
-    """The terminal failure that interrupted an apply."""
+    """One terminal failure in an apply (there can now be several: a
+    fault on each independent branch)."""
 
     address: str
     op: str            # create | update | delete
@@ -65,16 +84,51 @@ class OpFailure:
 
 
 @dataclasses.dataclass
+class SkippedOp:
+    """An operation never attempted because a dependency errored."""
+
+    address: str
+    op: str
+    blamed: str     # the failed address whose error cascaded here
+
+    def describe(self) -> str:
+        return (f"{self.address}: skipped — dependency {self.blamed} "
+                f"errored")
+
+
+@dataclasses.dataclass
+class OpTrace:
+    """One operation's scheduled execution, for invariant checking
+    (the chaos harness asserts dependency-order safety, skipped-closure
+    exactness, and the concurrency cap from this record)."""
+
+    address: str
+    op: str
+    start_s: float
+    finish_s: float
+    status: str            # ok | failed | skipped | crashed | abandoned
+    blamed: str | None = None    # for skipped: the errored address
+
+
+@dataclasses.dataclass
 class ApplyOutcome:
     state: State
-    failure: OpFailure | None = None
     crashed: bool = False
     completed: list = dataclasses.field(default_factory=list)  # (addr, op)
     mutated: bool = False    # state differs from prior → worth persisting
+    failures: list = dataclasses.field(default_factory=list)   # [OpFailure]
+    skipped: list = dataclasses.field(default_factory=list)    # [SkippedOp]
+    trace: list = dataclasses.field(default_factory=list)      # [OpTrace]
+
+    @property
+    def failure(self) -> OpFailure | None:
+        """The first terminal failure — the serial engine's single
+        slot, kept for callers that predate graph-parallel apply."""
+        return self.failures[0] if self.failures else None
 
     @property
     def ok(self) -> bool:
-        return self.failure is None and not self.crashed
+        return not self.failures and not self.crashed
 
 
 def _timeouts_of(attrs) -> dict:
@@ -103,32 +157,73 @@ def operation_timeout_s(op: str, planned_attrs, prior_attrs=None) -> float:
     return DEFAULT_TIMEOUT_S
 
 
-def _operations(plan: Plan, d: Diff) -> list[tuple[str, str]]:
-    """The diff as an ordered operation list: deletes first in reverse
-    dependency order (terraform tears down leaves before roots), then
-    creates/updates in dependency order, a replace expanding to its
-    delete + create pair (destroy-before-create default)."""
+def operation_schedule(plan: Plan, d: Diff
+                       ) -> tuple[list[tuple[str, str]], list[set[int]]]:
+    """The apply schedule for a diff: ``(ops, deps)``.
+
+    ``ops`` is the serial priority order — ``-parallelism 1`` executes
+    exactly this sequence, higher parallelism dispatches ready
+    operations in this order: EVERY delete first (plain deletes and
+    the destroy half of each replace) in reverse dependency order
+    (terraform tears down leaves before roots), then creates/updates
+    in dependency order, a replace's create where the serial engine
+    ran it.
+
+    ``deps[i]`` is the set of op indices that must complete before
+    ``ops[i]`` may start:
+
+    - a create/update waits for the realising operation of every
+      address it transitively depends on in the plan graph;
+    - a replace's create waits for its own delete (destroy-before-
+      create default);
+    - a delete — plain or replace — waits for the deletes of the
+      addresses that *depend on* it: reverse-edge direction, so a
+      replaced resource is never destroyed while a dependent's delete
+      is still pending;
+    - addresses only in state (node gone from config) carry no edges —
+      the simulated statefile records no dependency information, so
+      they schedule freely (and deterministically: see
+      :func:`..plan.instance_apply_order`'s stable state-only rank).
+
+    Every edge points to a lower index (``ops`` is a linearisation of
+    this DAG), which downstream closure walks rely on. Public so the
+    chaos harness can assert the scheduler's dependency-order safety
+    and skipped-closure exactness against the same ground truth the
+    engine runs on.
+    """
+    delete_addrs = d.by_action("delete") + d.by_action("replace")
+    change_addrs = (d.by_action("create") + d.by_action("update") +
+                    d.by_action("replace"))
+    rev = instance_dependencies(plan, delete_addrs)
+    fwd = instance_dependencies(plan, change_addrs)
     ops: list[tuple[str, str]] = []
-    for addr in reversed(instance_apply_order(plan, d.by_action("delete"))):
+    for addr in reversed(instance_apply_order(plan, delete_addrs,
+                                              deps=rev)):
         ops.append((addr, "delete"))
-    changes = (d.by_action("create") + d.by_action("update") +
-               d.by_action("replace"))
-    for addr in instance_apply_order(plan, changes):
+    for addr in instance_apply_order(plan, change_addrs, deps=fwd):
         act = d.actions[addr]
-        if act == "replace":
-            ops.append((addr, "delete"))
-            ops.append((addr, "create"))
-        else:
-            ops.append((addr, act))
-    return ops
+        ops.append((addr, "create" if act == "replace" else act))
+    delete_idx = {a: i for i, (a, op) in enumerate(ops)
+                  if op == "delete"}
+    final_idx = {a: i for i, (a, op) in enumerate(ops)
+                 if op != "delete"}    # the op that realises an address
+    deps: list[set[int]] = [set() for _ in ops]
+    for addr, wants in fwd.items():
+        deps[final_idx[addr]] |= {final_idx[b] for b in wants}
+        if addr in delete_idx:    # replace: destroy-before-create
+            deps[final_idx[addr]].add(delete_idx[addr])
+    for addr, wants in rev.items():
+        for b in wants:     # addr depends on b ⇒ delete addr BEFORE b
+            deps[delete_idx[b]].add(delete_idx[addr])
+    return ops, deps
 
 
 def _partial_state(prior: State | None, planned: dict,
                    completed: list[tuple[str, str]],
-                   taint: str | None = None) -> tuple[State, bool]:
+                   taints=()) -> tuple[State, bool]:
     """The state an interrupted apply persists: prior advanced by every
-    completed operation, plus the optionally-tainted half-created
-    resource. Returns ``(state, mutated)``."""
+    completed operation, plus the tainted half-created resources.
+    Returns ``(state, mutated)``."""
     resources = dict(prior.resources) if prior else {}
     tainted = set(prior.tainted) if prior else set()
     for addr, op in completed:
@@ -138,9 +233,9 @@ def _partial_state(prior: State | None, planned: dict,
         else:
             resources[addr] = planned[addr]
             tainted.discard(addr)   # a completed replace consumed the taint
-    if taint is not None:
-        resources[taint] = planned[taint]
-        tainted.add(taint)
+    for addr in taints:
+        resources[addr] = planned[addr]
+        tainted.add(addr)
     mutated = (resources != (dict(prior.resources) if prior else {}) or
                tainted != (set(prior.tainted) if prior else set()))
     serial = (prior.serial if prior else 0) + (1 if mutated else 0)
@@ -155,19 +250,29 @@ def _partial_state(prior: State | None, planned: dict,
 
 def run_apply(plan: Plan, prior: State | None, cp: ControlPlane,
               targets: list[str] | None = None,
-              d: Diff | None = None, log=None) -> ApplyOutcome:
-    """Apply ``plan`` over ``prior`` one operation at a time.
+              d: Diff | None = None, log=None,
+              parallelism: int = DEFAULT_PARALLELISM) -> ApplyOutcome:
+    """Apply ``plan`` over ``prior``, up to ``parallelism`` operations
+    at a time on the simulated clock.
 
     Returns an :class:`ApplyOutcome`; raises :class:`SimulatedCrash`
     (carrying the partial outcome) when the profile kills the process.
     On full success the returned state comes from :func:`..state.apply_plan`
     — the fault layer adds no drift to the happy path.
+
+    Determinism: ready operations dispatch in serial priority order
+    (consuming the profile's RNG stream at dispatch), completions pop
+    off an event heap keyed ``(finish time, dispatch sequence)`` — so
+    the whole interleaving is a pure function of
+    ``(profile, seed, parallelism)``.
     """
+    if parallelism < 1:
+        raise ValueError("parallelism must be >= 1")
     if d is None:
         d = diff(plan, prior, targets)
     planned = rendered_instances(plan)
     prior_res = prior.resources if prior else {}
-    ops = _operations(plan, d)
+    ops, deps = operation_schedule(plan, d)
     # validate EVERY timeouts{} budget before the first operation runs:
     # a malformed duration must fail the apply up front (state untouched),
     # never halfway through — that would orphan the completed work
@@ -178,25 +283,105 @@ def run_apply(plan: Plan, prior: State | None, cp: ControlPlane,
                 op, planned.get(addr), prior_res.get(addr))
         except ValueError as ex:
             raise ValueError(f"{addr}: {ex}") from None
+
     completed: list[tuple[str, str]] = []
-    for addr, op in ops:
-        try:
-            cp.run_operation(addr, op, timeouts[addr, op], log=log)
-        except CrashSignal:
-            state, mutated = _partial_state(prior, planned, completed)
+    failures: list[OpFailure] = []
+    skipped: list[SkippedOp] = []
+    trace: list[OpTrace] = []
+    taints: set[str] = set()
+    state_of = ["pending"] * len(ops)
+    waiting = [set(s) for s in deps]
+    dependents: list[list[int]] = [[] for _ in ops]
+    for i, s in enumerate(deps):
+        for j in s:
+            dependents[j].append(i)
+
+    ready = [i for i in range(len(ops)) if not waiting[i]]
+    heapq.heapify(ready)
+    # in-flight completions: (finish time, dispatch seq, op index, OpRun)
+    events: list = []
+    started: dict[int, float] = {}
+    now = cp.clock.now
+    seq = 0
+
+    def skip_dependents(root: int, blamed: str) -> None:
+        hit: list[int] = []
+        stack = [root]
+        while stack:
+            for dep in dependents[stack.pop()]:
+                if state_of[dep] == "pending":
+                    state_of[dep] = "skipped"
+                    hit.append(dep)
+                    stack.append(dep)
+        for k in sorted(hit):
+            a, o = ops[k]
+            skipped.append(SkippedOp(a, o, blamed))
+            trace.append(OpTrace(a, o, now, now, "skipped", blamed))
+
+    while True:
+        # dispatch every ready op the worker pool can hold, in serial
+        # priority order — THE deterministic arbitration point: the
+        # profile's RNG draws happen here, in dispatch order
+        while ready and len(events) < parallelism:
+            i = heapq.heappop(ready)
+            if state_of[i] != "pending":
+                continue    # skipped while queued (defensive: a skip
+                            # can only cascade through dependency
+                            # edges, which a ready op has none left of)
+            addr, op = ops[i]
+            run = cp.start_operation(addr, op, timeouts[addr, op], log=log)
+            state_of[i] = "running"
+            started[i] = now
+            heapq.heappush(events, (now + run.duration_s, seq, i, run))
+            seq += 1
+        if not events:
+            break
+        finish, _, i, run = heapq.heappop(events)
+        now = max(now, finish)
+        cp.clock.now = max(cp.clock.now, finish)
+        cp.retries += run.retried
+        addr, op = ops[i]
+        if run.crashed:
+            # the process dies HERE: operations still in flight never
+            # report back — neither completed nor tainted, exactly like
+            # the crashing operation itself
+            trace.append(OpTrace(addr, op, started[i], finish, "crashed"))
+            for _t, _s, j, _r in sorted(events):
+                a2, o2 = ops[j]
+                trace.append(OpTrace(a2, o2, started[j], now, "abandoned"))
+            state, mutated = _partial_state(prior, planned, completed,
+                                            taints)
             raise SimulatedCrash(ApplyOutcome(
                 state=state, crashed=True, completed=completed,
-                mutated=mutated)) from None
-        except TerminalFault as ex:
-            taint = addr if (op == "create" and
-                             ex.kind in PARTIAL_CREATE) else None
-            state, mutated = _partial_state(prior, planned, completed,
-                                            taint=taint)
-            return ApplyOutcome(
-                state=state,
-                failure=OpFailure(address=addr, op=op, kind=ex.kind,
-                                  message=str(ex), attempts=ex.attempts),
-                completed=completed, mutated=mutated)
+                mutated=mutated, failures=failures, skipped=skipped,
+                trace=trace)) from None
+        if run.error is not None:
+            ex = run.error
+            state_of[i] = "failed"
+            if op == "create" and ex.kind in PARTIAL_CREATE:
+                taints.add(addr)
+            failures.append(OpFailure(
+                address=addr, op=op, kind=ex.kind, message=str(ex),
+                attempts=ex.attempts))
+            trace.append(OpTrace(addr, op, started[i], finish, "failed"))
+            skip_dependents(i, addr)
+            continue
+        state_of[i] = "done"
         completed.append((addr, op))
+        trace.append(OpTrace(addr, op, started[i], finish, "ok"))
+        for dep in dependents[i]:
+            if state_of[dep] != "pending":
+                continue
+            pending = waiting[dep]
+            pending.discard(i)
+            if not pending:
+                heapq.heappush(ready, dep)
+
+    if failures:
+        state, mutated = _partial_state(prior, planned, completed, taints)
+        return ApplyOutcome(state=state, failures=failures,
+                            completed=completed, mutated=mutated,
+                            skipped=skipped, trace=trace)
     return ApplyOutcome(state=apply_plan(plan, prior, targets, d=d),
-                        completed=completed, mutated=not d.is_noop)
+                        completed=completed, mutated=not d.is_noop,
+                        trace=trace)

@@ -1,25 +1,36 @@
 # SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
 # SPDX-License-Identifier: Apache-2.0
-"""``tfsim chaos``: sweep fault seeds over a module, assert convergence.
+"""``tfsim chaos``: sweep fault seeds × parallelism, assert convergence.
 
-For each seed the harness runs the full operator playbook in a throwaway
-sandbox, end-to-end through the real CLI (the same code paths a human
-drives), and asserts the convergence invariants the recovery story
-promises:
+For each (seed, parallelism) pair the harness runs the full operator
+playbook in a throwaway sandbox, end-to-end through the real CLI (the
+same code paths a human drives), and asserts the invariants the
+recovery story promises:
 
-1. **apply** with the fault profile (seeded). A clean run must already
-   match the planned state.
+1. **apply** with the fault profile (seeded, at ``-parallelism N``).
+   A clean run must already match the planned state.
 2. If the run was interrupted: break a leftover crash lock by ID
    (``force-unlock``), push a leftover ``errored.tfstate`` back
    (``state push``), then **re-apply fault-free** — which must exit 0
    and land exactly the planned state: no orphans, no duplicate
-   creates, no lingering taint.
+   creates, no lingering taint — and a follow-up
+   ``plan -detailed-exitcode`` must report an **empty plan**.
 3. From the *interrupted* state, a fault-free ``apply -destroy`` must
    leave empty state — interruption never wedges teardown.
+4. **Scheduling invariants**, asserted against a deterministic replay
+   of the same (profile, seed, parallelism) through the engine (the
+   replay IS the CLI run — that determinism is itself invariant 4a,
+   checked by replaying twice): no operation ever starts before every
+   operation it depends on completed; never more than ``parallelism``
+   operations in flight; and the skipped set equals the exact
+   transitive dependent-closure of the terminal failures.
 
-Any violated invariant fails the sweep (exit 1) with the seed's
-transcript, making ``tfsim chaos -seeds 8 MODULE`` a standing CI gate
-for the module's crash-consistency.
+Because every (seed, parallelism) run must converge to the SAME
+expected state, the sweep also proves serial/parallel final-state
+equivalence. Any violated invariant fails the sweep (exit 1) with the
+run's transcript, making ``tfsim chaos -seeds 8 -parallelism 1,4,10
+MODULE`` a standing CI gate for the module's crash-consistency under
+realistic concurrency.
 """
 
 from __future__ import annotations
@@ -33,16 +44,22 @@ import sys
 import tempfile
 
 from ..plan import simulate_plan
-from ..state import State, apply_plan
+from ..state import State, apply_plan, diff
 from .profile import DEFAULT_CHAOS_PROFILE, load_profile
+
+DEFAULT_PARALLELISM_LEVELS = (1, 4, 10)
 
 
 @dataclasses.dataclass
 class SeedResult:
     seed: int
+    parallelism: int = 1
     interrupted: bool = False
     crashed: bool = False
     errored_state: bool = False
+    failure_op: str | None = None    # "<address>:<op>" of the first failure
+    failure_kind: str | None = None
+    skipped: int = 0                 # dependent operations skipped
     recovery: list = dataclasses.field(default_factory=list)  # steps taken
     violations: list = dataclasses.field(default_factory=list)
     transcript: str = ""
@@ -51,20 +68,41 @@ class SeedResult:
     def ok(self) -> bool:
         return not self.violations
 
+    def record(self) -> dict:
+        """The machine-readable per-run record (``chaos -json``)."""
+        return {
+            "seed": self.seed,
+            "parallelism": self.parallelism,
+            "interrupted": self.interrupted,
+            "crashed": self.crashed,
+            "errored_state": self.errored_state,
+            "failure_op": self.failure_op,
+            "failure_kind": self.failure_kind,
+            "skipped": self.skipped,
+            "converged": self.ok,
+            "recovery": self.recovery,
+            "violations": self.violations,
+        }
+
     def summary(self) -> str:
         if not self.interrupted:
             how = "clean apply"
         else:
             bits = ["interrupted"]
+            if self.failure_kind:
+                bits.append(self.failure_kind)
             if self.crashed:
                 bits.append("crash")
             if self.errored_state:
                 bits.append("errored.tfstate")
+            if self.skipped:
+                bits.append(f"{self.skipped} skipped")
             how = "+".join(bits)
         verdict = "converged" if self.ok else \
             "; ".join(self.violations)
         tail = f" ({', '.join(self.recovery)})" if self.recovery else ""
-        return f"seed {self.seed}: {how} — {verdict}{tail}"
+        return (f"seed {self.seed} ×{self.parallelism}: {how} — "
+                f"{verdict}{tail}")
 
 
 def _run_cli(cli, argv: list[str], stdin_text: str | None = None
@@ -112,26 +150,127 @@ def _check_converged(res: SeedResult, state: State | None,
         res.violations.append("outputs drifted from the planned outputs")
 
 
+def _replay(plan, profile, seed: int, parallelism: int):
+    """Re-run the seeded apply through the engine, no sandbox. The
+    scheduler is a pure function of (profile, seed, parallelism), so
+    this reproduces the CLI run exactly — and hands back the trace the
+    CLI cannot surface."""
+    from .apply import SimulatedCrash, run_apply
+    from .control_plane import ControlPlane
+
+    cp = ControlPlane(profile, seed=seed)
+    try:
+        return run_apply(plan, None, cp, parallelism=parallelism)
+    except SimulatedCrash as ex:
+        return ex.outcome
+
+
+def _check_schedule(res: SeedResult, plan, outcome,
+                    parallelism: int) -> None:
+    """The scheduling invariants, from the replayed engine trace."""
+    from .apply import operation_schedule
+
+    ops, deps = operation_schedule(plan, diff(plan, None))
+    info = {(t.address, t.op): t for t in outcome.trace}
+    ran = {"ok", "failed", "crashed", "abandoned"}
+
+    # 1. dependency-order safety: nothing starts before its deps finish
+    for i, key in enumerate(ops):
+        t = info.get(key)
+        if t is None or t.status not in ran:
+            continue
+        for j in deps[i]:
+            dt = info.get(ops[j])
+            if dt is None or dt.status != "ok":
+                res.violations.append(
+                    f"{key[0]} {key[1]} ran although dependency "
+                    f"{ops[j][0]} {ops[j][1]} never completed")
+            elif dt.finish_s - t.start_s > 1e-9:
+                res.violations.append(
+                    f"{key[0]} {key[1]} started at {t.start_s:g}s, before "
+                    f"dependency {ops[j][0]} finished at {dt.finish_s:g}s")
+
+    # 2. the -parallelism cap held at every instant
+    marks: list[tuple[float, int]] = []
+    for t in info.values():
+        if t.status in ran:
+            marks.append((t.start_s, 1))
+            marks.append((t.finish_s, -1))
+    marks.sort()             # at equal times the -1 frees a slot first
+    live = peak = 0
+    for _, delta in marks:
+        live += delta
+        peak = max(peak, live)
+    if peak > parallelism:
+        res.violations.append(
+            f"{peak} operations ran concurrently (parallelism "
+            f"{parallelism})")
+
+    # 3. skipped set == the exact transitive closure of the failures
+    #    (meaningless after a crash: pending work is abandoned, not
+    #    skipped)
+    if not outcome.crashed:
+        failed = {i for i, key in enumerate(ops)
+                  if (t := info.get(key)) is not None
+                  and t.status == "failed"}
+        expected: set[int] = set()
+        for i in range(len(ops)):
+            if i not in failed and any(j in failed or j in expected
+                                       for j in deps[i]):
+                expected.add(i)
+        want = {ops[i] for i in expected}
+        got = {(s.address, s.op) for s in outcome.skipped}
+        if want != got:
+            res.violations.append(
+                f"skipped set is not the failure closure (missing="
+                f"{sorted(want - got)} extra={sorted(got - want)})")
+
+
 def run_one_seed(cli, module_dir: str, var_argv: list[str],
-                 profile_path: str, seed: int,
-                 expected: State) -> SeedResult:
-    """The full interrupt-recover-converge-destroy cycle for one seed."""
+                 profile_path: str, seed: int, expected: State,
+                 plan=None, profile=None,
+                 parallelism: int = 1) -> SeedResult:
+    """The full interrupt-recover-converge-destroy cycle for one
+    (seed, parallelism) pair."""
     from ..locking import lock_path, read_holder
 
-    res = SeedResult(seed=seed)
+    res = SeedResult(seed=seed, parallelism=parallelism)
     lines: list[str] = []
+
+    # ---- engine replay: scheduling invariants + per-run record ------
+    if plan is not None and profile is not None:
+        outcome = _replay(plan, profile, seed, parallelism)
+        again = _replay(plan, profile, seed, parallelism)
+        if outcome.trace != again.trace:
+            res.violations.append(
+                "nondeterministic schedule: two replays of the same "
+                "(seed, parallelism) diverged")
+        _check_schedule(res, plan, outcome, parallelism)
+        if outcome.failures:
+            first = outcome.failures[0]
+            res.failure_op = f"{first.address}:{first.op}"
+            res.failure_kind = first.kind
+        res.skipped = len(outcome.skipped)
+    else:
+        outcome = None
+
     with tempfile.TemporaryDirectory(prefix=f"tfsim-chaos-{seed}-") as tmp:
         spath = os.path.join(tmp, "terraform.tfstate.json")
         errored = os.path.join(tmp, "errored.tfstate")
 
         rc, out = _run_cli(cli, ["apply", module_dir, *var_argv,
                                  "-state", spath,
+                                 "-parallelism", str(parallelism),
                                  "-fault-profile", profile_path,
                                  "-fault-seed", str(seed)])
         lines.append(out)
         res.interrupted = rc != 0
         if rc not in (0, 1):
             res.violations.append(f"faulted apply exited {rc} (usage error)")
+        if outcome is not None and not outcome.ok and rc == 0:
+            res.violations.append(
+                "engine replay reports failures but the CLI apply "
+                "exited 0")
 
         # ---- recovery playbook (only after an interruption) ----------
         if os.path.exists(lock_path(spath)):
@@ -168,13 +307,25 @@ def run_one_seed(cli, module_dir: str, var_argv: list[str],
 
         if res.interrupted:
             rc, out = _run_cli(cli, ["apply", module_dir, *var_argv,
-                                     "-state", spath])
+                                     "-state", spath,
+                                     "-parallelism", str(parallelism)])
             lines.append(out)
             if rc != 0:
                 res.violations.append(f"fault-free re-apply exited {rc}")
             res.recovery.append("re-applied")
 
         _check_converged(res, _load(spath), expected)
+
+        # a converged state must also read back as an EMPTY plan — the
+        # operator-visible form of "nothing left to do"
+        rc, out = _run_cli(cli, ["plan", module_dir, *var_argv,
+                                 "-state", spath, "-detailed-exitcode"])
+        lines.append(out)
+        if rc != 0:
+            res.violations.append(
+                f"plan after convergence is not empty (exit {rc})")
+        elif res.interrupted:
+            res.recovery.append("re-plan empty")
 
         # ---- destroy-after-interruption invariant --------------------
         if interrupted_json is not None:
@@ -184,7 +335,8 @@ def run_one_seed(cli, module_dir: str, var_argv: list[str],
                 with open(dpath, "w") as fh:
                     fh.write(interrupted_json)
                 rc, out = _run_cli(cli, ["apply", module_dir, *var_argv,
-                                         "-state", dpath, "-destroy"])
+                                         "-state", dpath, "-destroy",
+                                         "-parallelism", str(parallelism)])
                 lines.append(out)
                 final = _load(dpath)
                 if rc != 0:
@@ -203,10 +355,12 @@ def run_one_seed(cli, module_dir: str, var_argv: list[str],
 
 def run_chaos(cli, module_dir: str, tfvars: dict, var_argv: list[str],
               seeds: int, profile_path: str | None = None,
+              parallelism_levels=DEFAULT_PARALLELISM_LEVELS,
               log=None) -> list[SeedResult]:
-    """Sweep ``seeds`` fault seeds over ``module_dir``; returns one
-    :class:`SeedResult` per seed. ``cli`` is the tfsim ``main`` callable
-    (injected to avoid an import cycle); ``var_argv`` is the raw
+    """Sweep ``seeds`` fault seeds × ``parallelism_levels`` over
+    ``module_dir``; returns one :class:`SeedResult` per (seed,
+    parallelism) run. ``cli`` is the tfsim ``main`` callable (injected
+    to avoid an import cycle); ``var_argv`` is the raw
     ``-var``/``-var-file`` argv to forward to each CLI run, ``tfvars``
     the same variables resolved, for computing the expected state."""
     plan = simulate_plan(module_dir, tfvars)
@@ -224,13 +378,17 @@ def run_chaos(cli, module_dir: str, tfvars: dict, var_argv: list[str],
         own_profile.close()
         profile_path = own_profile.name
     try:
+        profile = load_profile(profile_path)   # the replay's own copy
         results = []
-        for seed in range(seeds):
-            res = run_one_seed(cli, module_dir, var_argv, profile_path,
-                               seed, expected)
-            if log:
-                log(res.summary())
-            results.append(res)
+        for parallelism in parallelism_levels:
+            for seed in range(seeds):
+                res = run_one_seed(cli, module_dir, var_argv, profile_path,
+                                   seed, expected, plan=plan,
+                                   profile=profile,
+                                   parallelism=parallelism)
+                if log:
+                    log(res.summary())
+                results.append(res)
         return results
     finally:
         if own_profile is not None:

@@ -127,6 +127,33 @@ OP_DURATION_S = 30.0
 DEFAULT_TIMEOUT_S = 30 * 60.0
 
 
+@dataclasses.dataclass
+class OpRun:
+    """One operation's fully-simulated execution.
+
+    Every RNG draw happens when the run is built (dispatch time), so a
+    (seed, dispatch-order) pair completely determines the outcome; the
+    *caller* decides when ``duration_s`` elapses and on whose timeline.
+    The serial path spends it on the shared clock immediately; the
+    graph-parallel scheduler turns it into a completion event on its
+    event heap, which is how concurrent operations each get charged
+    only their own elapsed time against their own ``timeouts {}``
+    budget.
+    """
+
+    address: str
+    op: str
+    attempts: int
+    duration_s: float
+    retried: int = 0
+    # TerminalFault / CrashSignal when the operation did not succeed
+    error: FaultError | None = None
+
+    @property
+    def crashed(self) -> bool:
+        return isinstance(self.error, CrashSignal)
+
+
 class ControlPlane:
     """One apply's view of the cloud: seeded faults + simulated time.
 
@@ -149,42 +176,66 @@ class ControlPlane:
     def describe(self, kind: str, address: str) -> str:
         return f"{address}: {KINDS.get(kind, kind)} ({kind})"
 
-    def run_operation(self, address: str, op: str, timeout_s: float,
-                      log=None) -> int:
-        """Run one resource operation; returns the attempt count on
-        success, raises :class:`TerminalFault` / :class:`CrashSignal`."""
-        start = self.clock.now
+    def start_operation(self, address: str, op: str, timeout_s: float,
+                        log=None) -> OpRun:
+        """Simulate one resource operation without spending its time.
+
+        All fault draws happen here, now, against the shared RNG
+        stream; the returned :class:`OpRun` carries the outcome and the
+        total simulated duration. ``timeout_s`` is charged against the
+        operation's OWN elapsed time only — two operations running
+        concurrently never bill each other's attempts to their budgets.
+        """
+        elapsed = 0.0
         backoff = self.policy.initial_s
         attempt = 0
+        retried = 0
         while True:
             attempt += 1
-            self.clock.advance(self.op_duration_s)
+            elapsed += self.op_duration_s
             kind = self.profile.draw_operation_fault(address, op, self.rng)
             if kind is None:
-                return attempt
+                return OpRun(address, op, attempt, elapsed, retried)
             if kind == "crash":
-                raise CrashSignal(address, op)
+                return OpRun(address, op, attempt, elapsed, retried,
+                             error=CrashSignal(address, op))
             if kind not in RETRYABLE:
-                raise TerminalFault(
-                    kind, address, op, attempt,
-                    f"{self.describe(kind, address)} — {op} failed after "
-                    f"{attempt} attempt(s)")
-            elapsed = self.clock.now - start
+                return OpRun(address, op, attempt, elapsed, retried,
+                             error=TerminalFault(
+                                 kind, address, op, attempt,
+                                 f"{self.describe(kind, address)} — {op} "
+                                 f"failed after {attempt} attempt(s)"))
             if elapsed + backoff + self.op_duration_s > timeout_s:
                 # the next attempt cannot finish inside the timeouts{}
                 # budget: terraform's "context deadline exceeded"
-                raise TerminalFault(
-                    "timeout", address, op, attempt,
-                    f"{address}: {op} timed out after "
-                    f"{format_duration(elapsed)} (timeout "
-                    f"{format_duration(timeout_s)}; last error: {kind})")
+                return OpRun(address, op, attempt, elapsed, retried,
+                             error=TerminalFault(
+                                 "timeout", address, op, attempt,
+                                 f"{address}: {op} timed out after "
+                                 f"{format_duration(elapsed)} (timeout "
+                                 f"{format_duration(timeout_s)}; last "
+                                 f"error: {kind})"))
             if log:
                 log(f"  retry: {address} {op} attempt {attempt} hit "
                     f"{kind}; backing off {format_duration(backoff)}")
-            self.retries += 1
-            self.clock.advance(backoff)
+            retried += 1
+            elapsed += backoff
             backoff = min(backoff * self.policy.multiplier,
                           self.policy.cap_s)
+
+    def run_operation(self, address: str, op: str, timeout_s: float,
+                      log=None) -> int:
+        """Run one resource operation to completion on the shared
+        clock; returns the attempt count on success, raises
+        :class:`TerminalFault` / :class:`CrashSignal`. (The serial
+        convenience over :meth:`start_operation` — the graph-parallel
+        scheduler consumes :class:`OpRun` events directly.)"""
+        run = self.start_operation(address, op, timeout_s, log=log)
+        self.clock.advance(run.duration_s)
+        self.retries += run.retried
+        if run.error is not None:
+            raise run.error
+        return run.attempts
 
     def check_state_write(self) -> None:
         """Raise :class:`StateWriteFault` when the profile injects a

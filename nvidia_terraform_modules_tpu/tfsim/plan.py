@@ -28,6 +28,16 @@ class PlanError(ValueError):
     pass
 
 
+class CycleError(PlanError):
+    """A dependency cycle in the resource graph. ``cycle`` is the full
+    node path (first element repeated last), so renderers — ``tfsim
+    graph -cycles`` — can draw the loop instead of just naming it."""
+
+    def __init__(self, cycle: list[str]):
+        super().__init__("dependency cycle: " + " → ".join(cycle))
+        self.cycle = cycle
+
+
 class ResourceAttrs(dict):
     """Attribute map of a planned resource: unset keys are computed-at-apply."""
 
@@ -626,8 +636,7 @@ def _toposort(deps: dict[str, set[str]]) -> list[str]:
         if st == 2:
             return
         if st == 1:
-            cycle = chain[chain.index(n):] + [n]
-            raise PlanError("dependency cycle: " + " → ".join(cycle))
+            raise CycleError(chain[chain.index(n):] + [n])
         state[n] = 1
         for d in sorted(deps.get(n, ())):
             visit(d, chain + [n])
@@ -647,16 +656,127 @@ def instance_node(iaddr: str) -> str:
     return iaddr.split("[")[0]
 
 
-def instance_apply_order(plan: Plan, addrs) -> list[str]:
-    """Deterministic apply order for instance addresses: the plan's
-    topological node order, instances sorted within a node, addresses
-    whose node left the configuration (state-only deletes) last. The
+def _node_closure(plan: Plan) -> dict[str, set[str]]:
+    """Node → every node it transitively depends on, over ``plan.edges``."""
+    deps: dict[str, set[str]] = {}
+    for frm, to in plan.edges:
+        deps.setdefault(frm, set()).add(to)
+    closure: dict[str, set[str]] = {}
+
+    def visit(n: str) -> set[str]:
+        got = closure.get(n)
+        if got is not None:
+            return got
+        closure[n] = set()      # cycle guard; plan graphs are acyclic
+        out: set[str] = set()
+        for dep in deps.get(n, ()):
+            out.add(dep)
+            out |= visit(dep)
+        closure[n] = out
+        return out
+
+    for n in plan.order:
+        visit(n)
+    return closure
+
+
+def instance_dependencies(plan: Plan, addrs) -> dict[str, set[str]]:
+    """Instance-level dependency edges among ``addrs``.
+
+    ``out[a]`` is the subset of ``addrs`` that ``a`` depends on. Edges
+    come from the *transitive* node closure, so an intermediate node
+    with no operation of its own (a no-op, a data source, a node absent
+    from ``addrs``) still gates its endpoints — the property the
+    graph-parallel apply scheduler needs ("no operation starts before
+    everything it depends on completed"). Instances that live inside
+    the same child-module call are resolved against that child plan's
+    own edges (node-level ``plan.edges`` collapses a whole module call
+    to one node and would read its internals as mutually independent);
+    instances of *different* expansions of one module call stay
+    independent, matching terraform's per-instance subgraphs.
+
+    Addresses whose node the plan does not know (present only in
+    state) get no edges: the simulated statefile records no dependency
+    information, so they schedule freely.
+    """
+    addrs = list(addrs)
+    out: dict[str, set[str]] = {a: set() for a in addrs}
+    closure = _node_closure(plan)
+    by_node: dict[str, list[str]] = {}
+    for a in addrs:
+        by_node.setdefault(instance_node(a), []).append(a)
+    for n1, instances in by_node.items():
+        cl = closure.get(n1)
+        if not cl:
+            continue
+        for n2, dep_instances in by_node.items():
+            if n2 == n1 or n2 not in cl:
+                continue
+            for a in instances:
+                out[a].update(dep_instances)
+    # module-internal edges, per child-module instance
+    for key, child in plan.child_plans.items():
+        prefix = key + "."
+        inner = {a[len(prefix):]: a for a in addrs if a.startswith(prefix)}
+        if len(inner) < 2:
+            continue
+        for iaddr, ideps in instance_dependencies(child, inner).items():
+            out[inner[iaddr]].update(inner[dep] for dep in ideps)
+    return out
+
+
+def instance_apply_order(plan: Plan, addrs, deps=None) -> list[str]:
+    """Deterministic apply order for instance addresses.
+
+    A topological linearisation of :func:`instance_dependencies`,
+    tie-broken by the plan's node rank and then the address — so for a
+    flat module it reproduces the historical (rank, address) sort
+    exactly, while module-internal edges are honoured where a plain
+    sort would violate them. State-only addresses (present in state,
+    absent from the plan graph) take a **stable rank**: strictly after
+    every planned node, ordered by bare address — delete ordering can
+    never drift between runs however the plan around them changes. The
     stepwise fault-injecting apply performs operations in exactly this
-    sequence, so a given ``-fault-seed`` always lands its faults on the
-    same operations."""
+    sequence at ``-parallelism 1``, so a given ``-fault-seed`` always
+    lands its faults on the same operations.
+
+    ``deps`` (a precomputed ``instance_dependencies(plan, addrs)``) is
+    accepted so a caller that needs the edge map anyway — the apply
+    scheduler — doesn't pay for the closure twice."""
+    import heapq
+
+    addrs = list(addrs)
     rank = {n: i for i, n in enumerate(plan.order)}
-    return sorted(addrs, key=lambda a: (
-        rank.get(instance_node(a), len(rank)), a))
+
+    def key(a: str):
+        node = instance_node(a)
+        # state-only addresses sort in their own band (1, addr): the
+        # rank is a function of the address alone, nothing else
+        return (0, rank[node], a) if node in rank else (1, a)
+
+    if deps is None:
+        deps = instance_dependencies(plan, addrs)
+    waiting = {a: set(ds) for a, ds in deps.items()}
+    dependents: dict[str, list[str]] = {}
+    for a, ds in deps.items():
+        for dep in ds:
+            dependents.setdefault(dep, []).append(a)
+    heap = [key(a) for a in addrs if not waiting[a]]
+    heapq.heapify(heap)
+    out: list[str] = []
+    while heap:
+        a = heapq.heappop(heap)[-1]
+        out.append(a)
+        for dep in dependents.get(a, ()):
+            pending = waiting[dep]
+            pending.discard(a)
+            if not pending:
+                heapq.heappush(heap, key(dep))
+    if len(out) != len(addrs):     # unreachable on acyclic plans —
+        raise PlanError(           # but never silently drop operations
+            "internal: instance dependency cycle among " +
+            ", ".join(sorted(set(addrs) - set(out))))
+    return out
 
 
 def select_targets(plan: Plan, targets: list[str],
@@ -858,6 +978,23 @@ def to_dot(plan: Plan) -> str:
     for frm, to in sorted(plan.edges):
         lines.append(f'  "{frm}" -> "{to}";')
     lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+def cycle_to_dot(cycle: list[str]) -> str:
+    """Render a dependency cycle (:class:`CycleError` payload) as a DOT
+    subgraph highlight — ``tfsim graph -cycles``. Edges keep
+    :func:`to_dot`'s direction (node → what it depends on); the whole
+    loop is red so it pops out of any surrounding graph drawing."""
+    lines = ["digraph {", "  rankdir = \"RL\";",
+             "  subgraph cluster_cycle {",
+             "    label = \"dependency cycle\";",
+             "    color = \"red\";"]
+    for addr in cycle[:-1]:
+        lines.append(f'    "{addr}" [color = "red"];')
+    for frm, to in zip(cycle, cycle[1:]):
+        lines.append(f'    "{frm}" -> "{to}" [color = "red"];')
+    lines += ["  }", "}"]
     return "\n".join(lines) + "\n"
 
 

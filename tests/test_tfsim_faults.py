@@ -13,9 +13,15 @@ Drives the ``-fault-profile``/``-fault-seed`` apply path and the
   recovers (satellite: round-trip);
 - a crash leaves the state lock behind, breakable by ID with
   ``force-unlock`` (satellite: regression);
-- the chaos sweep over ``gke-tpu`` is a standing tier-1 gate
-  (satellite: CI wiring);
+- the chaos sweep over ``gke-tpu`` is a standing tier-1 gate — since
+  ISSUE 3 a seeds × parallelism matrix: the serial 8-seed subset plus
+  one parallel seed stay tier-1, the full {1, 4, 10} sweep is
+  slow-marked (satellite: CI wiring);
 - a profile that injects nothing matches the atomic apply exactly.
+
+The graph-parallel scheduler itself (failure isolation, instance-level
+edges, deadline fairness under concurrency, ``graph -cycles``) is
+covered in ``tests/test_tfsim_parallel_apply.py``.
 """
 
 import io
@@ -365,26 +371,68 @@ def test_saved_plan_apply_with_faults_then_stale_guard(tmp_path, mod,
 # ------------------------------------------------------- chaos (satellite 6)
 
 def test_chaos_sweep_small_module_json(tmp_path, mod):
+    """``chaos -json``: one machine-readable record per (seed,
+    parallelism) run — seed, parallelism, failure op/kind, skipped
+    count, converged bool (PR 3 satellite)."""
     import contextlib
 
     buf = io.StringIO()
     with contextlib.redirect_stdout(buf):
-        rc = main(["chaos", mod, "-seeds", "4", "-json"])
+        rc = main(["chaos", mod, "-seeds", "4", "-parallelism", "1,4",
+                   "-json"])
     assert rc == 0
     payload = json.loads(buf.getvalue())
-    assert payload["total"] == 4 and payload["converged"] == 4
-    assert all(s["ok"] for s in payload["seeds"])
+    assert payload["total"] == 8 and payload["converged"] == 8
+    assert payload["parallelism_levels"] == [1, 4]
+    assert {r["parallelism"] for r in payload["runs"]} == {1, 4}
+    assert {r["seed"] for r in payload["runs"]} == {0, 1, 2, 3}
+    for r in payload["runs"]:
+        assert r["converged"] is True
+        assert isinstance(r["skipped"], int)
+        assert ("failure_op" in r) and ("failure_kind" in r)
+        if r["failure_op"] is not None:
+            addr, _, op = r["failure_op"].partition(":")
+            assert addr and op in ("create", "update", "delete")
 
 
 def test_chaos_sweep_gke_tpu_converges(capsys):
-    """The acceptance bar: 8 seeded interrupted applies over the
+    """The tier-1 acceptance bar: 8 seeded interrupted applies over the
     flagship module all leave state from which a second apply converges
-    to plan, and teardown from any interruption stays clean."""
+    to plan (empty re-plan), and teardown from any interruption stays
+    clean. Serial subset — the full seeds × parallelism matrix is the
+    slow-marked test below."""
     rc = main(["chaos", GKE_TPU, "-var", "project_id=chaos-proj",
-               "-var", "cluster_name=chaos", "-seeds", "8"])
+               "-var", "cluster_name=chaos", "-seeds", "8",
+               "-parallelism", "1"])
     out = capsys.readouterr().out
     assert rc == 0, out
-    assert "8/8 seed(s) converged" in out
+    assert "8/8 run(s) converged" in out
+
+
+def test_chaos_gke_tpu_one_parallel_seed(capsys):
+    """Keep one genuinely parallel seed in tier-1: the default
+    terraform parallelism (10) over the flagship module, scheduling
+    invariants and all."""
+    rc = main(["chaos", GKE_TPU, "-var", "project_id=chaos-proj",
+               "-var", "cluster_name=chaos", "-seeds", "1",
+               "-parallelism", "10"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "1/1 run(s) converged" in out
+
+
+@pytest.mark.slow
+def test_chaos_sweep_gke_tpu_full_matrix(capsys):
+    """The full seeds × parallelism {1, 4, 10} sweep — every
+    interleaving class the scheduler can produce over the flagship
+    module. Slow-marked so tier-1 stays inside its timeout budget
+    (PR 3 satellite); CI runs it."""
+    rc = main(["chaos", GKE_TPU, "-var", "project_id=chaos-proj",
+               "-var", "cluster_name=chaos", "-seeds", "8",
+               "-parallelism", "1,4,10"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "24/24 run(s) converged" in out
 
 
 def test_chaos_refuses_bad_args(tmp_path, mod, capsys):
@@ -393,6 +441,10 @@ def test_chaos_refuses_bad_args(tmp_path, mod, capsys):
     missing = tmp_path / "nope.json"
     assert main(["chaos", mod, "-fault-profile", str(missing)]) == 1
     assert "cannot read fault profile" in capsys.readouterr().err
+    assert main(["chaos", mod, "-parallelism", "0"]) == 1
+    assert "-parallelism" in capsys.readouterr().err
+    assert main(["chaos", mod, "-parallelism", "banana"]) == 1
+    assert "comma-separated" in capsys.readouterr().err
 
 
 # ------------------------------------------- lint rule (satellite 2)

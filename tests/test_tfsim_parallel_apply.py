@@ -1,0 +1,591 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Graph-parallel apply: deterministic concurrent scheduler (ISSUE 3).
+
+The tentpole invariants, at unit and CLI level:
+
+- instance-level dependency edges (transitive node closure, module
+  internals resolved against child plans);
+- `instance_apply_order` is a dependency-true topological order with
+  the historical (rank, address) tie-break, and state-only addresses
+  take a stable rank (satellite: regression);
+- terraform failure isolation: independent branches finish, exactly
+  the transitive dependents skip (with the root failure blamed);
+- deletes schedule in reverse-edge direction;
+- a replace's create waits for its own delete;
+- concurrency charges each operation only its OWN elapsed time
+  against its `timeouts {}` budget (satellite: deadline fairness);
+- a crash abandons in-flight work: neither completed nor tainted;
+- determinism per (seed, parallelism) and final-state equivalence
+  across parallelism levels;
+- `tfsim graph -cycles` renders the full cycle path as a DOT
+  subgraph highlight (satellite).
+"""
+
+import io
+import json
+import os
+
+import pytest
+
+from nvidia_terraform_modules_tpu.tfsim.__main__ import main
+from nvidia_terraform_modules_tpu.tfsim.faults import (
+    ControlPlane,
+    FaultProfile,
+    FaultSpec,
+    SimulatedCrash,
+    run_apply,
+)
+from nvidia_terraform_modules_tpu.tfsim.plan import (
+    instance_apply_order,
+    instance_dependencies,
+    simulate_plan,
+)
+from nvidia_terraform_modules_tpu.tfsim.state import State, apply_plan
+
+# a diamond with an independent branch: vpc → cluster → {a, b} pools,
+# and a KMS chain (ring → key) that shares nothing with the cluster
+DIAMOND_HCL = """
+resource "google_compute_network" "vpc" {
+  name = "net"
+}
+
+resource "google_container_cluster" "this" {
+  name    = "c"
+  network = google_compute_network.vpc.name
+}
+
+resource "google_container_node_pool" "a" {
+  name    = "a"
+  cluster = google_container_cluster.this.name
+}
+
+resource "google_container_node_pool" "b" {
+  name    = "b"
+  cluster = google_container_cluster.this.name
+}
+
+resource "google_kms_key_ring" "ring" {
+  name = "r"
+}
+
+resource "google_kms_crypto_key" "key" {
+  key_ring = google_kms_key_ring.ring.id
+}
+"""
+
+
+@pytest.fixture
+def diamond(tmp_path):
+    d = tmp_path / "diamond"
+    d.mkdir()
+    (d / "main.tf").write_text(DIAMOND_HCL)
+    return str(d)
+
+
+def profile_file(tmp_path, *specs) -> str:
+    p = tmp_path / "faults.json"
+    p.write_text(json.dumps({"faults": list(specs)}))
+    return str(p)
+
+
+def load_state(path) -> State:
+    with open(path) as fh:
+        return State.from_json(fh.read())
+
+
+def run_cli(argv):
+    import contextlib
+
+    out, err = io.StringIO(), io.StringIO()
+    with contextlib.redirect_stdout(out), contextlib.redirect_stderr(err):
+        rc = main(argv)
+    return rc, out.getvalue(), err.getvalue()
+
+
+def engine_apply(module_dir, specs=(), seed=0, parallelism=10,
+                 prior=None, tfvars=None):
+    """Run the engine directly; returns (outcome, control_plane) —
+    crashes are caught and their partial outcome returned."""
+    plan = simulate_plan(module_dir, tfvars or {})
+    cp = ControlPlane(FaultProfile(specs=[FaultSpec(**s) for s in specs]),
+                      seed=seed)
+    try:
+        return run_apply(plan, prior, cp, parallelism=parallelism), cp
+    except SimulatedCrash as ex:
+        return ex.outcome, cp
+
+
+def trace_by_key(outcome):
+    return {(t.address, t.op): t for t in outcome.trace}
+
+
+# ------------------------------------------------- instance-level edges
+
+def test_instance_dependencies_transitive_gating(diamond):
+    """A no-op intermediate (cluster omitted from the operation set)
+    must still gate its endpoints: the pool depends on the vpc."""
+    plan = simulate_plan(diamond, {})
+    deps = instance_dependencies(plan, [
+        "google_container_node_pool.a", "google_compute_network.vpc"])
+    assert deps["google_container_node_pool.a"] == {
+        "google_compute_network.vpc"}
+    assert deps["google_compute_network.vpc"] == set()
+
+
+def test_instance_dependencies_independent_branches(diamond):
+    plan = simulate_plan(diamond, {})
+    deps = instance_dependencies(plan, list(plan.instances))
+    assert deps["google_kms_crypto_key.key"] == {
+        "google_kms_key_ring.ring"}
+    # nothing in the KMS chain depends on the cluster branch or back
+    cluster_branch = {"google_compute_network.vpc",
+                      "google_container_cluster.this",
+                      "google_container_node_pool.a",
+                      "google_container_node_pool.b"}
+    assert not deps["google_kms_crypto_key.key"] & cluster_branch
+    assert not deps["google_container_cluster.this"] & {
+        "google_kms_key_ring.ring", "google_kms_crypto_key.key"}
+
+
+def test_instance_dependencies_module_internal_edges(tmp_path):
+    """Node-level edges collapse a child module to one node; the
+    instance edges must come from the child plan so module internals
+    are not read as mutually independent."""
+    child = tmp_path / "child"
+    child.mkdir()
+    (child / "main.tf").write_text("""
+variable "name" {
+  type = string
+}
+
+resource "google_compute_network" "z_net" {
+  name = var.name
+}
+
+resource "google_container_cluster" "a_cluster" {
+  name    = var.name
+  network = google_compute_network.z_net.name
+}
+
+output "cluster" {
+  value = google_container_cluster.a_cluster.name
+}
+""")
+    parent = tmp_path / "parent"
+    parent.mkdir()
+    (parent / "main.tf").write_text("""
+module "env" {
+  source = "./child"
+  name   = "x"
+}
+""")
+    os.rename(str(child), str(parent / "child"))
+    plan = simulate_plan(str(parent), {})
+    addrs = list(plan.instances)
+    deps = instance_dependencies(plan, addrs)
+    assert deps["module.env.google_container_cluster.a_cluster"] == {
+        "module.env.google_compute_network.z_net"}
+    # ...and the order honours it even though the address sort alone
+    # would put a_cluster first
+    order = instance_apply_order(plan, addrs)
+    assert order.index("module.env.google_compute_network.z_net") < \
+        order.index("module.env.google_container_cluster.a_cluster")
+
+
+# ------------------------------------- stable state-only rank (satellite)
+
+def test_state_only_addresses_stable_rank(diamond):
+    """Addresses present only in state (node gone from config) take a
+    stable rank: strictly after every planned node, ordered by bare
+    address — delete ordering can never drift between runs."""
+    plan = simulate_plan(diamond, {})
+    addrs = ["zzz_gone.a", "aaa_gone.b[0]",
+             "google_container_cluster.this", "google_compute_network.vpc"]
+    order = instance_apply_order(plan, addrs)
+    assert order == ["google_compute_network.vpc",
+                     "google_container_cluster.this",
+                     "aaa_gone.b[0]", "zzz_gone.a"]
+    # input permutation must not change the result
+    assert instance_apply_order(plan, list(reversed(addrs))) == order
+
+
+def test_flat_module_order_matches_historical_sort(diamond):
+    """For a flat module the topological linearisation reproduces the
+    historical (node rank, address) sort exactly — the serial fault
+    stream depends on it."""
+    plan = simulate_plan(diamond, {})
+    addrs = [a for a in plan.instances]
+    rank = {n: i for i, n in enumerate(plan.order)}
+    legacy = sorted(addrs, key=lambda a: (
+        rank.get(a.split("[")[0], len(rank)), a))
+    assert instance_apply_order(plan, addrs) == legacy
+
+
+# ------------------------------------------------- failure isolation
+
+def test_independent_branches_finish_and_closure_skips(tmp_path, diamond):
+    """Terminal fault on the cluster: the KMS branch runs to completion
+    and is persisted; exactly the cluster's transitive dependents skip,
+    each blaming the errored address."""
+    pfile = profile_file(tmp_path, {
+        "fault": "tpu-stockout", "resource": "google_container_cluster.*",
+        "op": "create"})
+    spath = tmp_path / "s.json"
+    rc, out, err = run_cli(["apply", diamond, "-state", str(spath),
+                            "-fault-profile", pfile, "-fault-seed", "0",
+                            "-parallelism", "4"])
+    assert rc == 1
+    assert ("google_container_node_pool.a: skipped — dependency "
+            "google_container_cluster.this errored") in err
+    assert ("google_container_node_pool.b: skipped — dependency "
+            "google_container_cluster.this errored") in err
+    assert "2 dependent operation(s) skipped" in err
+    st = load_state(spath)
+    assert set(st.resources) == {"google_compute_network.vpc",
+                                 "google_kms_key_ring.ring",
+                                 "google_kms_crypto_key.key"}
+    # resume: only the failed node and its dependents are left
+    rc, out, err = run_cli(["apply", diamond, "-state", str(spath)])
+    assert rc == 0
+    assert "Apply complete: 3 added, 0 changed, 0 destroyed." in out
+
+
+def test_skip_blames_the_root_failure_through_intermediates(tmp_path,
+                                                            diamond):
+    """Fail the DEEPEST dependency (vpc): the pools skip through the
+    skipped cluster, still blaming the address that actually errored."""
+    outcome, _cp = engine_apply(diamond, specs=[
+        {"kind": "quota-exceeded",
+         "resource": "google_compute_network.vpc", "op": "create"}])
+    assert [f.address for f in outcome.failures] == [
+        "google_compute_network.vpc"]
+    skips = {s.address: s.blamed for s in outcome.skipped}
+    assert skips == {
+        "google_container_cluster.this": "google_compute_network.vpc",
+        "google_container_node_pool.a": "google_compute_network.vpc",
+        "google_container_node_pool.b": "google_compute_network.vpc",
+    }
+    # the independent branch completed regardless
+    done = {a for a, _op in outcome.completed}
+    assert {"google_kms_key_ring.ring",
+            "google_kms_crypto_key.key"} <= done
+
+
+def test_multiple_independent_failures_are_all_reported(tmp_path, diamond):
+    """One terminal fault per branch: both failures surface, both
+    persist what completed before them."""
+    outcome, _cp = engine_apply(diamond, specs=[
+        {"kind": "tpu-stockout",
+         "resource": "google_container_cluster.*", "op": "create"},
+        {"kind": "quota-exceeded",
+         "resource": "google_kms_crypto_key.*", "op": "create"}])
+    assert {f.address for f in outcome.failures} == {
+        "google_container_cluster.this", "google_kms_crypto_key.key"}
+    assert {s.address for s in outcome.skipped} == {
+        "google_container_node_pool.a", "google_container_node_pool.b"}
+    assert {a for a, _op in outcome.completed} == {
+        "google_compute_network.vpc", "google_kms_key_ring.ring"}
+
+
+# --------------------------------------------------- schedule shape
+
+def test_no_op_starts_before_dependency_completes(diamond):
+    outcome, _cp = engine_apply(diamond, parallelism=10)
+    t = trace_by_key(outcome)
+    for before, after in [
+        (("google_compute_network.vpc", "create"),
+         ("google_container_cluster.this", "create")),
+        (("google_container_cluster.this", "create"),
+         ("google_container_node_pool.a", "create")),
+        (("google_kms_key_ring.ring", "create"),
+         ("google_kms_crypto_key.key", "create")),
+    ]:
+        assert t[before].finish_s <= t[after].start_s + 1e-9
+    # genuinely parallel: both roots started at t=0
+    assert t[("google_compute_network.vpc", "create")].start_s == 0.0
+    assert t[("google_kms_key_ring.ring", "create")].start_s == 0.0
+
+
+def test_deletes_run_in_reverse_edge_direction(tmp_path):
+    """Shrinking count on a dependent pair: the pool instance's delete
+    must FINISH before its cluster instance's delete starts, even at
+    full parallelism."""
+    d = tmp_path / "countmod"
+    d.mkdir()
+    (d / "main.tf").write_text("""
+variable "n" {
+  type    = number
+  default = 2
+}
+
+resource "google_container_cluster" "c" {
+  count = var.n
+  name  = "c${count.index}"
+}
+
+resource "google_container_node_pool" "p" {
+  count   = var.n
+  name    = "p${count.index}"
+  cluster = google_container_cluster.c[0].name
+}
+""")
+    plan2 = simulate_plan(str(d), {"n": 2})
+    prior = apply_plan(plan2, None)
+    plan1 = simulate_plan(str(d), {"n": 1})
+    cp = ControlPlane(FaultProfile(specs=[]), seed=0)
+    outcome = run_apply(plan1, prior, cp, parallelism=10)
+    assert outcome.ok
+    t = trace_by_key(outcome)
+    pool = t[("google_container_node_pool.p[1]", "delete")]
+    cluster = t[("google_container_cluster.c[1]", "delete")]
+    assert pool.finish_s <= cluster.start_s + 1e-9
+
+
+def test_replace_delete_waits_for_dependent_deletes(tmp_path):
+    """Review regression: a replaced resource must not be destroyed
+    while a dependent instance's delete is still pending — the
+    replace's destroy half takes reverse edges like any other
+    delete."""
+    d = tmp_path / "repmod"
+    d.mkdir()
+    (d / "main.tf").write_text("""
+variable "n" {
+  type    = number
+  default = 2
+}
+
+resource "google_compute_network" "r" {
+  name = "net"
+}
+
+resource "google_container_cluster" "x" {
+  count   = var.n
+  name    = "x${count.index}"
+  network = google_compute_network.r.name
+}
+""")
+    prior = apply_plan(simulate_plan(str(d), {"n": 2}), None)
+    prior.tainted.add("google_compute_network.r")      # replace r …
+    plan = simulate_plan(str(d), {"n": 1})             # … and shrink x
+    cp = ControlPlane(FaultProfile(specs=[]), seed=0)
+    outcome = run_apply(plan, prior, cp, parallelism=10)
+    assert outcome.ok
+    t = trace_by_key(outcome)
+    dep_delete = t[("google_container_cluster.x[1]", "delete")]
+    r_delete = t[("google_compute_network.r", "delete")]
+    r_create = t[("google_compute_network.r", "create")]
+    assert dep_delete.finish_s <= r_delete.start_s + 1e-9
+    assert r_delete.finish_s <= r_create.start_s + 1e-9
+
+
+def test_replace_create_waits_for_its_delete(diamond):
+    plan = simulate_plan(diamond, {})
+    prior = apply_plan(plan, None)
+    prior.tainted.add("google_container_cluster.this")
+    cp = ControlPlane(FaultProfile(specs=[]), seed=0)
+    outcome = run_apply(plan, prior, cp, parallelism=10)
+    assert outcome.ok
+    t = trace_by_key(outcome)
+    dele = t[("google_container_cluster.this", "delete")]
+    crea = t[("google_container_cluster.this", "create")]
+    assert dele.finish_s <= crea.start_s + 1e-9
+
+
+# ---------------------------------- concurrency & budgets (satellite)
+
+TWO_SLOW_HCL = """
+resource "google_compute_network" "a" {
+  name = "a"
+
+  timeouts {
+    create = "70s"
+  }
+}
+
+resource "google_compute_network" "b" {
+  name = "b"
+
+  timeouts {
+    create = "70s"
+  }
+}
+"""
+
+RETRY_BOTH = [
+    {"kind": "api-429", "resource": "google_compute_network.a",
+     "op": "create", "max": 1},
+    {"kind": "api-429", "resource": "google_compute_network.b",
+     "op": "create", "max": 1},
+]
+
+
+@pytest.fixture
+def two_slow(tmp_path):
+    d = tmp_path / "twoslow"
+    d.mkdir()
+    (d / "main.tf").write_text(TWO_SLOW_HCL)
+    return str(d)
+
+
+def test_concurrent_ops_charge_only_their_own_elapsed_time(two_slow):
+    """Two slow creates (30s attempt + 1s backoff + 30s retry = 61s
+    each, budget 70s) on the shared simulated clock: concurrently each
+    stays inside its own budget and the pair takes 61s of wall clock —
+    charging either one the pair's combined time would blow its
+    deadline."""
+    outcome, cp = engine_apply(two_slow, specs=RETRY_BOTH, parallelism=2)
+    assert outcome.ok, [f.message for f in outcome.failures]
+    assert cp.clock.now == pytest.approx(61.0)
+    t = trace_by_key(outcome)
+    assert t[("google_compute_network.a", "create")].start_s == 0.0
+    assert t[("google_compute_network.b", "create")].start_s == 0.0
+    # serially the SAME budgets still hold per-operation (wall clock is
+    # the sum, each op's charge is unchanged)
+    outcome, cp = engine_apply(two_slow, specs=RETRY_BOTH, parallelism=1)
+    assert outcome.ok
+    assert cp.clock.now == pytest.approx(122.0)
+
+
+def test_start_operation_budget_ignores_global_clock():
+    cp = ControlPlane(FaultProfile(specs=[
+        FaultSpec(kind="api-429", max=1)]), seed=0)
+    cp.clock.advance(10_000.0)   # someone else's elapsed time
+    run = cp.start_operation("google_compute_network.a", "create", 70.0)
+    assert run.error is None
+    assert run.duration_s == pytest.approx(61.0)
+
+
+# ------------------------------------------------ crash semantics
+
+def test_crash_abandons_in_flight_operations(two_slow, tmp_path):
+    """A crash kills the process at its event time: the op still in
+    flight reports nothing — neither completed nor tainted."""
+    outcome, _cp = engine_apply(two_slow, specs=[
+        {"kind": "crash", "resource": "google_compute_network.a",
+         "op": "create"}], parallelism=2)
+    assert outcome.crashed
+    assert outcome.completed == []
+    statuses = {(t.address, t.op): t.status for t in outcome.trace}
+    assert statuses[("google_compute_network.a", "create")] == "crashed"
+    assert statuses[("google_compute_network.b", "create")] == "abandoned"
+    assert outcome.state.resources == {}
+    assert outcome.state.tainted == set()
+
+
+def test_crash_reports_earlier_branch_failures(tmp_path, diamond):
+    """Review regression: a crash that lands AFTER a terminal failure
+    on another branch must not swallow that failure's (or its skips')
+    diagnostics — impossible serially, routine in a parallel walk."""
+    pfile = profile_file(
+        tmp_path,
+        {"fault": "tpu-stockout", "resource": "google_kms_key_ring.*",
+         "op": "create"},
+        {"fault": "crash", "resource": "google_container_cluster.*",
+         "op": "create"})
+    spath = tmp_path / "s.json"
+    rc, _out, err = run_cli(["apply", diamond, "-state", str(spath),
+                             "-fault-profile", pfile, "-fault-seed", "0",
+                             "-parallelism", "4"])
+    assert rc == 1
+    assert "simulated crash" in err
+    assert "tpu-stockout" in err and "apply interrupted" in err
+    assert ("google_kms_crypto_key.key: skipped — dependency "
+            "google_kms_key_ring.ring errored") in err
+    # completed work was still persisted before the "process died"
+    assert "google_compute_network.vpc" in load_state(spath).resources
+
+
+# ------------------------------------- determinism & equivalence
+
+def test_same_seed_same_parallelism_same_everything(tmp_path, diamond):
+    pfile = profile_file(
+        tmp_path,
+        {"fault": "api-500", "op": "any", "prob": 0.3, "max": 2},
+        {"fault": "quota-exceeded", "op": "create", "prob": 0.4})
+    outs = []
+    for run in ("x", "y"):
+        spath = tmp_path / f"{run}.json"
+        rc, out, err = run_cli(["apply", diamond, "-state", str(spath),
+                                "-fault-profile", pfile,
+                                "-fault-seed", "5", "-parallelism", "4"])
+        outs.append((rc, out, err,
+                     load_state(spath).resources
+                     if spath.exists() else None))
+    assert outs[0] == outs[1]
+
+
+def test_fault_free_state_equivalent_across_parallelism(tmp_path,
+                                                        diamond):
+    """Serial and parallel runs land the same final state (the empty
+    profile also proves -parallelism adds zero drift to the happy
+    path's output)."""
+    pfile = profile_file(tmp_path)     # {"faults": []}
+    rc, plain_out, _ = run_cli(["apply", diamond, "-state",
+                                str(tmp_path / "plain.json")])
+    assert rc == 0
+    states, outputs = [], []
+    for p in (1, 4, 10):
+        spath = tmp_path / f"p{p}.json"
+        rc, out, _err = run_cli(["apply", diamond, "-state", str(spath),
+                                 "-fault-profile", pfile,
+                                 "-parallelism", str(p)])
+        assert rc == 0
+        outputs.append(out)
+        states.append(load_state(spath))
+    assert outputs[0] == plain_out       # byte-for-byte at parallelism 1
+    assert outputs[0] == outputs[1] == outputs[2]
+    base = load_state(tmp_path / "plain.json")
+    for st in states:
+        assert st.resources == base.resources
+        assert st.outputs == base.outputs
+        assert st.tainted == base.tainted
+        assert st.serial == base.serial
+
+
+def test_parallelism_flag_validation(diamond, tmp_path, capsys):
+    rc, _out, err = run_cli(["apply", diamond, "-state",
+                             str(tmp_path / "s.json"),
+                             "-parallelism", "0"])
+    assert rc == 2
+    assert "-parallelism must be at least 1" in err
+
+
+# --------------------------------------- graph -cycles (satellite)
+
+CYCLE_HCL = """
+resource "google_compute_network" "x" {
+  name = google_compute_subnetwork.y.name
+}
+
+resource "google_compute_subnetwork" "y" {
+  name = google_compute_network.x.name
+}
+"""
+
+
+def test_graph_cycles_renders_dot_subgraph(tmp_path):
+    d = tmp_path / "cyclic"
+    d.mkdir()
+    (d / "main.tf").write_text(CYCLE_HCL)
+    rc, out, err = run_cli(["graph", str(d)])
+    assert rc == 1
+    assert "dependency cycle" in err and out == ""
+    rc, out, err = run_cli(["graph", str(d), "-cycles"])
+    assert rc == 1
+    assert "dependency cycle" in err
+    assert "subgraph cluster_cycle" in out
+    assert '"google_compute_network.x" [color = "red"];' in out
+    assert '"google_compute_subnetwork.y" [color = "red"];' in out
+    # the loop closes: both directed edges appear
+    assert ('"google_compute_network.x" -> "google_compute_subnetwork.y"'
+            in out)
+    assert ('"google_compute_subnetwork.y" -> "google_compute_network.x"'
+            in out)
+
+
+def test_graph_without_cycle_unaffected_by_flag(diamond):
+    rc, out, err = run_cli(["graph", diamond, "-cycles"])
+    assert rc == 0
+    assert out.startswith("digraph {")
+    assert "cluster_cycle" not in out
