@@ -773,6 +773,63 @@ def section_longctx() -> dict:
     }
 
 
+def section_flash_bwd() -> dict:
+    """Per-layer flash BACKWARD time at the flagship per-layer shape
+    ``[2, 4096, 16, 128]``, fused single-pass vs split two-kernel — the
+    round-over-round tracker for the PR-4 kernel rewrite, so the backward
+    win is a committed number instead of something inferred from
+    ``burnin_mfu``. Timed with the in-jit ``lax.scan`` chain via
+    ``utils/timing.delta_time``: PROFILE_r05 showed an eagerly dispatched
+    per-call clock overstates ms-scale kernels ~6× through the tunnelled
+    backend's dispatch+flush latency. Off-TPU the same chain runs tiny
+    shapes under the pallas interpreter so the code path stays proven
+    (see ``cpu_fallback_expectations``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from nvidia_terraform_modules_tpu.ops import flash_attention
+    from nvidia_terraform_modules_tpu.utils.timing import delta_time
+
+    on = _on_tpu()
+    b, s, h, d = (2, 4096, 16, 128) if on else (2, 64, 2, 16)
+    dtype = jnp.bfloat16 if on else jnp.float32
+    ks = jax.random.split(jax.random.PRNGKey(5), 4)
+    q, k, v, do = (jax.random.normal(kk, (b, s, h, d), dtype) for kk in ks)
+
+    def make_chain(mode):
+        def factory(length):
+            @jax.jit
+            def chain(q, k, v, do):
+                # one forward (residuals), then a scan chaining BACKWARD
+                # calls only: dq feeds the next iteration's cotangent, so
+                # each scan tick is exactly one per-layer flash backward
+                _, vjp_fn = jax.vjp(
+                    lambda q_, k_, v_: flash_attention(
+                        q_, k_, v_, causal=True, backward=mode), q, k, v)
+
+                def step(carry, _):
+                    dq, _, _ = vjp_fn(carry)
+                    return dq, None
+
+                out, _ = jax.lax.scan(step, do, None, length=length)
+                return out
+            return chain
+        return factory
+
+    t_fused = delta_time(make_chain("fused"), q, k, v, do,
+                         iters_lo=2, iters_hi=10)
+    t_split = delta_time(make_chain("split"), q, k, v, do,
+                         iters_lo=2, iters_hi=10)
+    return {
+        "flash_bwd_shape": [b, s, h, d],
+        "flash_bwd_ms": round(t_fused * 1e3, 3),
+        "flash_bwd_split_ms": round(t_split * 1e3, 3),
+        # >1 means the fused single-pass beats the split pair (chip only;
+        # interpret mode measures the interpreter)
+        "flash_bwd_fused_vs_split": round(t_split / max(t_fused, 1e-12), 2),
+    }
+
+
 SECTIONS = {
     "devinfo": section_devinfo,
     "smoke": section_smoke,
@@ -786,6 +843,7 @@ SECTIONS = {
     "serve_spec": section_serve_spec,
     "serve_flash": section_serve_flash,
     "longctx": section_longctx,
+    "flash_bwd": section_flash_bwd,
 }
 
 # generous per-section budgets: first XLA compile of a big program is
@@ -811,6 +869,7 @@ SECTION_TIMEOUT_S = {
     "serve_spec": 1500,
     "serve_flash": 1500,
     "longctx": 600,
+    "flash_bwd": 600,
 }
 
 
@@ -1162,6 +1221,13 @@ def main() -> None:
             expectations["serve_int8_vs_bf16"] = (
                 "pallas interpret mode + tiny shapes: the int8 engine "
                 "ratio is meaningful on chip only")
+        if "flash_bwd_fused_vs_split" in merged:
+            expectations["flash_bwd_fused_vs_split"] = (
+                "pallas interpret mode: both backward paths run the "
+                "interpreter at tiny shapes, so the ratio measures "
+                "interpreter step counts, not kernels — the fused path's "
+                "MXU/VMEM win (P/dS once per tile, pipelined epilogue) is "
+                "chip-only and must not be asserted off-TPU")
         if expectations:
             merged["cpu_fallback_expectations"] = expectations
     line = {

@@ -48,7 +48,48 @@ def main() -> int:
 
     import jax
 
+    if not hasattr(jax, "shard_map"):
+        # older jax ships shard_map only under experimental (pre top-level
+        # promotion); alias it so the bundle stays a zero-dependency file
+        # that runs on either image generation
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        jax.shard_map = _shard_map
+
     if hosts > 1:
+        # older jax defaults CPU cross-process collectives to "none"
+        # (every multi-process CPU computation then fails); newer jax
+        # defaults to gloo and may drop the knob entirely. Older jax
+        # exposes the value only via config._read()/config.values, so an
+        # operator's explicit choice (e.g. mpi) is read through whichever
+        # surface exists before gloo is selected.
+        current = None
+        for read in (
+                lambda: jax.config._read(
+                    "jax_cpu_collectives_implementation"),
+                lambda: jax.config.values[
+                    "jax_cpu_collectives_implementation"],
+                lambda: getattr(jax.config,
+                                "jax_cpu_collectives_implementation")):
+            try:
+                current = read()
+                break
+            except Exception:
+                continue
+        if current in (None, "none"):
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except (AttributeError, ValueError):
+                try:  # oldest surface: the Flag object on xla_bridge
+                    from jax._src import xla_bridge as _xb
+
+                    flag = getattr(_xb, "CPU_COLLECTIVES_IMPLEMENTATION",
+                                   None)
+                    if flag is not None and flag.value in (None, "none"):
+                        flag._set("gloo")
+                except Exception:
+                    pass
         coord = os.environ["TPU_SMOKETEST_COORDINATOR"]
         if ":" not in coord:
             coord = f"{coord}:8476"

@@ -32,6 +32,7 @@ from ..ops.flash_attention import flash_attention
 from ..ops.ring_attention import dense_reference_attention, ring_self_attention
 from ..ops.ulysses_attention import ulysses_self_attention
 from ..parallel.sharding import ShardingRules
+from ..utils.compat import shard_map
 from ..utils.layers import dense_init
 from ..utils.layers import rmsnorm as _rmsnorm
 
@@ -72,6 +73,15 @@ class BurnInConfig:
     # "flash":   fused pallas kernel (ops.flash_attention) on the gathered
     #            sequence — the [S,S] score matrix never touches HBM.
     attn: str = "dense"
+    # backward-kernel selection for the pallas flash paths ("flash" and the
+    # ring sweep's per-block tile math): "fused" (default) runs the
+    # single-pass backward — one pallas kernel emitting dq/dk/dv with P/dS
+    # materialised once per tile; "split" keeps the historical dq + dkv
+    # two-kernel design for A/B timing and differential testing. Applies
+    # wherever the pallas flash kernel runs the tile math: "flash", the
+    # ring sweep's per-block math, and ulysses' post-all-to-all local
+    # attention; the dense impl's backward is XLA's transpose.
+    flash_backward: str = "fused"
     # remat=True wraps each transformer block in jax.checkpoint: backward
     # recomputes the block's activations from its input instead of keeping
     # them resident, trading ~1/3 more FLOPs for O(n_layers×) less
@@ -96,6 +106,10 @@ class BurnInConfig:
             raise ValueError(
                 f"unknown attn impl {self.attn!r}; "
                 f"use dense|ring|ulysses|flash")
+        if self.flash_backward not in ("fused", "split"):
+            raise ValueError(
+                f"unknown flash_backward impl {self.flash_backward!r}; "
+                f"use fused|split")
         if self.n_experts < 0:
             raise ValueError(f"n_experts must be >= 0, got {self.n_experts}")
         if self.router_top_k < 1 or (
@@ -261,20 +275,23 @@ def forward_and_aux(params, tokens, cfg: BurnInConfig,
             v = act(jnp.repeat(v, rep, axis=2), *seq_dims)
         if use_ring:
             attn = ring_self_attention(
-                q, k, v, rules.mesh, causal=True, spec=seq_spec
+                q, k, v, rules.mesh, causal=True, spec=seq_spec,
+                backward=cfg.flash_backward
             )
         elif use_ulysses:
             attn = ulysses_self_attention(
-                q, k, v, rules.mesh, causal=True, spec=seq_spec
+                q, k, v, rules.mesh, causal=True, spec=seq_spec,
+                backward=cfg.flash_backward
             )
         elif cfg.attn == "flash":
-            fa = functools.partial(flash_attention, causal=True)
+            fa = functools.partial(flash_attention, causal=True,
+                                   backward=cfg.flash_backward)
             if rules is None:
                 attn = fa(q, k, v)
             else:
                 # pallas_call is a per-device program: shard_map it so each
                 # device runs the kernel on its (batch, head) shards
-                attn = jax.shard_map(
+                attn = shard_map(
                     fa, mesh=rules.mesh, in_specs=(seq_spec,) * 3,
                     out_specs=seq_spec, check_vma=False,
                 )(q, k, v)

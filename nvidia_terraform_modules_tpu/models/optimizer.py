@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel.sharding import ShardingRules
+from ..utils.compat import pspec_axes
 from .burnin import (
     BurnInConfig,
     init_params,
@@ -109,7 +110,7 @@ def _zero1_sharding(leaf, ns: NamedSharding, rules: ShardingRules):
     if dp > 1:
         for i, (dim, s) in enumerate(zip(leaf.shape, spec)):
             if s is None and dim % dp == 0 and dim >= dp:
-                spec = spec[:i] + (axes,) + spec[i + 1:]
+                spec = spec[:i] + (pspec_axes(axes),) + spec[i + 1:]
                 break
     return NamedSharding(mesh, P(*spec))
 

@@ -17,9 +17,49 @@ flash-attention recurrence, written for the MXU/VMEM model of the pallas guide
 - causal masking is block-sparse: k-blocks strictly above the diagonal are
   skipped with ``pl.when`` (no FLOPs, no mask materialisation);
 - the backward pass recomputes P = exp(S - L) per tile from the saved
-  logsumexp L (flash-style rematerialisation: trade FLOPs for HBM) in two
-  kernels — dq, and (dk, dv) — matching the split the forward's tiling
-  induces.
+  logsumexp L (flash-style rematerialisation: trade FLOPs for HBM).
+
+Backward: fused single-pass (default) vs split
+----------------------------------------------
+
+Two selectable backward implementations, ``backward="fused"|"split"``:
+
+- ``"split"`` (the historical design): two kernels — dq, then (dk, dv) —
+  each sweeping the full (q-block × k-block) grid and each calling
+  ``_bwd_tile``, so the tile scores P and dS are rematerialised TWICE per
+  tile. PROFILE_r05 priced this double rematerialisation (plus the f32
+  epilogue traffic) as the bulk of the ~0.11 MFU between the measured 0.698
+  ``burnin_mfu`` and the config's ~0.81 hardware ceiling.
+- ``"fused"`` (default): ONE ``pallas_call`` sweeping the grid
+  ``(bh, q-blocks, k-blocks)`` once, computing P/dS once per tile and
+  emitting all three gradients. Accumulation scheme:
+
+  * **dq** accumulates across the K dimension in a ``[block_q, d]`` f32
+    VMEM scratch over the inner k sweep (k innermost, exactly like the
+    forward) and is cast + written once per q-block at ``ki == nk-1``;
+  * **dk/dv** accumulate across the Q dimension in full-K-length
+    ``[nk, block_k, d]`` f32 VMEM scratches that persist across the whole
+    grid sweep (each (qi, ki) tile adds into slice ``ki``), and each
+    k-block's slice is cast + written during the LAST q-row sweep
+    (``qi == nq-1``, where every k-block is causally live);
+  * the f32 epilogue is thereby pipelined: dk/dv output blocks rotate
+    every grid step, so pallas's double-buffered output pipeline overlaps
+    each tile's accumulator cast/write-back DMA with the next tile's MXU
+    dots instead of serialising a whole-array epilogue after the sweep —
+    the "double-buffered epilogue" PROFILE_r05 called for;
+  * causally dead tiles are skipped via the shared ``_causal_live``
+    predicate, same as the forward.
+
+  The full-length dk/dv scratch costs ``2 · S_k · d · 4`` bytes of VMEM
+  (4 MiB at the flagship S=4096, d=128 — comfortably inside the ~16 MiB
+  budget next to the ~1.5 MiB of double-buffered block windows); very long
+  K at wide d would need a k-sharded outer loop, which ring attention
+  already provides.
+
+``"split"`` stays in-tree so A/B timing (``bench.py: flash_bwd_*``) and the
+fused-vs-split differential oracle (tests/test_flash_attention.py) both keep
+running; a lowering-regression test pins fused to exactly one backward
+``pallas_call`` so a silent fallback can never masquerade as a perf win.
 
 CPU runs (tests, the virtual-mesh rig) use ``interpret=True`` automatically.
 """
@@ -304,16 +344,74 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def _fused_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, dq_scr, dk_scr, dv_scr, *,
+                      scale: float, causal: bool, block_q: int, block_k: int):
+    """Single-pass backward: dq, dk, dv from ONE sweep of the (qi, ki) grid.
+
+    P/dS are materialised once per tile and feed all three accumulators.
+    dq lives in a per-q-block scratch across the inner k sweep; dk/dv live
+    in full-K-length scratches across the outer q sweep (slice ``ki`` per
+    tile) and each k-block is emitted on the last q row, so every output
+    block's cast/write-back overlaps the next tile's dots via the output
+    pipeline's double buffering (see the module docstring).
+    """
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nq, nk = pl.num_programs(1), pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init_dq():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    @pl.when(jnp.logical_and(qi == 0, ki == 0))
+    def _init_dkv():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(_causal_live(qi, ki, causal=causal, block_q=block_q,
+                          block_k=block_k))
+    def _compute():
+        p, ds, do = _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                              qi, ki, scale=scale, causal=causal,
+                              block_q=block_q, block_k=block_k)
+        # dQ += dS K: folded over the inner k sweep, like the forward's o
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dV[ki] += Pᵀ dO, dK[ki] += dSᵀ Q: folded over the outer q sweep
+        dv_scr[ki] = dv_scr[ki] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_scr[ki] = dk_scr[ki] + jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _emit_dq():
+        dq_ref[0] = (dq_scr[:] * scale).astype(dq_ref.dtype)
+
+    # every k-block is live on the last q row (causal or not), so the full
+    # accumulation for slice ki is complete exactly when (nq-1, ki) runs;
+    # earlier rows' write-backs of this rotating block are dead stores the
+    # final row overwrites — the price of letting the pipeline overlap them
+    @pl.when(qi == nq - 1)
+    def _emit_dkv():
+        dk_ref[0] = (dk_scr[ki] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[ki].astype(dv_ref.dtype)
+
+
 # ------------------------------------------------------ public wrapper
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_bhsd(q, k, v, scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_bhsd(q, k, v, scale, causal, block_q, block_k, interpret,
+                backward):
     o, _ = _fwd(q, k, v, scale=scale, causal=causal,
                 block_q=block_q, block_k=block_k, interpret=interpret)
     return o
 
 
-def _flash_bhsd_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_bhsd_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                    backward):
     o, lse = _fwd(q, k, v, scale=scale, causal=causal,
                   block_q=block_q, block_k=block_k, interpret=interpret)
     return o, (q, k, v, o, lse)
@@ -376,16 +474,80 @@ def flash_dkv(q, k, v, do, lse, delta, *, scale, causal, block_q, block_k,
     )(q, k, v, do, lse, delta)
 
 
-def _flash_bhsd_bwd(scale, causal, block_q, block_k, interpret, res, do):
-    q, k, v, o, lse = res
+def flash_dqdkv(q, k, v, do, lse, delta, *, scale, causal, block_q, block_k,
+                interpret, out_dtype=None):
+    """(dQ, dK, dV) from the fused single-pass kernel, ``[bh, s, d]`` layout.
+
+    One ``pallas_call``: P/dS once per tile instead of the split path's
+    twice; see ``_fused_bwd_kernel``. Reusable by the ring backward (per
+    visiting K/V block, f32 out for cross-step accumulation) and the
+    monolithic VJP below.
+    """
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nk = sk // block_k
+    return pl.pallas_call(
+        functools.partial(_fused_bwd_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, sq // block_q, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), out_dtype or q.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), out_dtype or k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), out_dtype or v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),       # dq accumulator
+            pltpu.VMEM((nk, block_k, d), jnp.float32),   # dk, full K length
+            pltpu.VMEM((nk, block_k, d), jnp.float32),   # dv, full K length
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+
+def flash_backward(q, k, v, o, do, lse, *, scale, causal, block_q, block_k,
+                   interpret, backward: str = "fused", out_dtype=None):
+    """Full flash backward — delta reduction + the selected kernel path.
+
+    The one entry point both the monolithic VJP and callers that hold their
+    own residuals use; ``backward`` picks ``"fused"`` (single pass) or
+    ``"split"`` (dq then dkv, the historical two-kernel design).
+    """
     # delta = rowsum(dO ⊙ O): a cheap fused XLA reduction, computed once
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)                     # [bh, s, 1]
+    if backward not in ("fused", "split"):
+        # validate here too, not only in flash_attention: a typo falling
+        # through to the split kernels would be a silent de-optimisation
+        raise ValueError(
+            f"unknown backward impl {backward!r}; use fused|split")
     kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-              interpret=interpret)
+              interpret=interpret, out_dtype=out_dtype)
+    if backward == "fused":
+        return flash_dqdkv(q, k, v, do, lse, delta, **kw)
     dq = flash_dq(q, k, v, do, lse, delta, **kw)
     dk, dv = flash_dkv(q, k, v, do, lse, delta, **kw)
     return dq, dk, dv
+
+
+def _flash_bhsd_bwd(scale, causal, block_q, block_k, interpret, backward,
+                    res, do):
+    q, k, v, o, lse = res
+    return flash_backward(q, k, v, o, do, lse, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          interpret=interpret, backward=backward)
 
 
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
@@ -421,15 +583,23 @@ def _fit_block(s: int, want: int | None) -> int:
 
 def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
                     block_q: int | None = None, block_k: int | None = None,
-                    interpret: bool | None = None):
+                    interpret: bool | None = None,
+                    backward: str = "fused"):
     """Fused flash attention on ``[B, S, H, D]`` inputs (burn-in layout).
 
     Blocks default to a measured size heuristic and shrink to the largest
     divisor of S ≤ the requested size, so any sequence length works; sizes
     that leave no MXU-tileable divisor (< 8 for an S > 8) are rejected.
-    Returns ``[B, S, H, D]`` in the input dtype.
+    ``backward`` selects the VJP kernels: ``"fused"`` (default; one
+    single-pass pallas kernel, P/dS once per tile) or ``"split"`` (the
+    historical dq + dkv two-kernel path, kept for A/B timing and the
+    differential-correctness oracle). Returns ``[B, S, H, D]`` in the
+    input dtype.
     """
     b, s, h, d = q.shape
+    if backward not in ("fused", "split"):
+        raise ValueError(
+            f"unknown backward impl {backward!r}; use fused|split")
     if block_k is None:
         # K blocks default wider than q blocks (S/2 vs S/4, cap 1024):
         # each K tile is DMA'd once per q-block sweep, so fatter K tiles
@@ -456,7 +626,7 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
         return t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
     o = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), scale, causal,
-                    block_q, block_k, interpret)
+                    block_q, block_k, interpret, backward)
     return o.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 

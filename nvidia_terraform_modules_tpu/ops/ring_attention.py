@@ -30,11 +30,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..utils.compat import axis_size, shard_map
 from .flash_attention import (
     _fit_block,
     _on_interpret_platform,
     flash_dkv,
     flash_dq,
+    flash_dqdkv,
     flash_partial,
     pick_impl,
 )
@@ -68,7 +70,7 @@ def ring_attention_kernel(q, k, v, *, axis_name: str, causal: bool = True,
 
     Returns the attention output ``[B, S_local, H, D]`` in ``q.dtype``.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
     if scale is None:
@@ -138,7 +140,7 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale, block_q, block_k,
     positions: a visiting block is diagonal (src == me → local causal mask
     inside the kernel), fully visible (src < me → no mask), or fully masked
     (src > me → skipped, no FLOPs)."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
     bh, s_loc, d = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -190,30 +192,33 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale, block_q, block_k,
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _ring_flash(q, k, v, axis_name, causal, scale, block_q, block_k,
-                interpret):
+                interpret, backward):
     out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale,
                                   block_q, block_k, interpret)
     return out
 
 
 def _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k,
-                    interpret):
+                    interpret, backward):
     out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale,
                                     block_q, block_k, interpret)
     return out, (q, k, v, out, lse)
 
 
 def _ring_flash_bwd(axis_name, causal, scale, block_q, block_k, interpret,
-                    res, do):
+                    backward, res, do):
     """Backward ring sweep: K/V blocks make the same rotation; their dK/dV
     accumulators travel WITH them (one extra hop at the end returns each
     block's gradient to its owner — n hops total vs the forward's n-1).
     P is rematerialised per tile from the saved global logsumexp, so every
-    per-block call uses the final normaliser (standard flash backward)."""
+    per-block call uses the final normaliser (standard flash backward).
+    ``backward`` reuses the monolithic kernel selection per visiting block:
+    ``"fused"`` runs ONE single-pass kernel per block (P/dS once per tile),
+    ``"split"`` the historical dq + dkv pair."""
     q, k, v, out, lse = res
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
     bh, s_loc, d = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -224,6 +229,9 @@ def _ring_flash_bwd(axis_name, causal, scale, block_q, block_k, interpret,
 
     def block_grads(k_blk, v_blk, src):
         def grads(is_causal):
+            if backward == "fused":
+                return flash_dqdkv(q, k_blk, v_blk, do, lse, delta,
+                                   causal=is_causal, **kw)
             dq_t = flash_dq(q, k_blk, v_blk, do, lse, delta,
                             causal=is_causal, **kw)
             dk_t, dv_t = flash_dkv(q, k_blk, v_blk, do, lse, delta,
@@ -275,15 +283,21 @@ def ring_flash_attention_kernel(q, k, v, *, axis_name: str,
                                 scale: float | None = None,
                                 block_q: int | None = None,
                                 block_k: int | None = None,
-                                interpret: bool | None = None):
+                                interpret: bool | None = None,
+                                backward: str = "fused"):
     """Per-shard ring attention with the pallas flash kernel doing the tile
     math; call inside ``shard_map``. Same contract as
     ``ring_attention_kernel`` — ``[B, S_local, H, D]`` shards, exact,
     differentiable — but each visiting K/V block is consumed by one fused
     flash sweep (VMEM-resident accumulators, block-sparse causal skip)
     instead of blockwise dense math, so long-context multi-chip gets both
-    O(S/sp) residency AND fused tiles (VERDICT round-1, item 8)."""
+    O(S/sp) residency AND fused tiles (VERDICT round-1, item 8).
+    ``backward`` picks the per-block backward kernels ("fused" single-pass
+    default, "split" the two-kernel path — see ops/flash_attention.py)."""
     b, s_loc, h, d = q.shape
+    if backward not in ("fused", "split"):
+        raise ValueError(
+            f"unknown backward impl {backward!r}; use fused|split")
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     if block_q is None or block_k is None:
@@ -312,7 +326,7 @@ def ring_flash_attention_kernel(q, k, v, *, axis_name: str,
         return t.transpose(0, 2, 1, 3).reshape(b * h, s_loc, d)
 
     out = _ring_flash(to_bhsd(q), to_bhsd(k), to_bhsd(v), axis_name, causal,
-                      scale, block_q, block_k, interpret)
+                      scale, block_q, block_k, interpret, backward)
     return out.reshape(b, h, s_loc, d).transpose(0, 2, 1, 3)
 
 
@@ -320,7 +334,8 @@ def ring_self_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
                         axis_name: str = "sp",
                         spec: P = P("dp", "sp", "tp", None),
                         scale: float | None = None,
-                        impl: str | None = None):
+                        impl: str | None = None,
+                        backward: str = "fused"):
     """shard_map wrapper: exact attention with sequence sharded on ``axis_name``.
 
     ``q, k, v`` are global arrays ``[B, S, H, D]``; ``spec`` maps (batch → dp,
@@ -329,16 +344,21 @@ def ring_self_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
     ``"flash"`` (fused pallas sweeps), ``"dense"`` (blockwise XLA einsum, the
     round-1 path, kept as the numerics reference), or ``None`` (default) —
     flash when the local shard length tiles into 8-multiple blocks, dense
-    otherwise, so shapes that worked in round 1 keep working.
+    otherwise, so shapes that worked in round 1 keep working. ``backward``
+    selects the flash path's backward kernels (fused|split; ignored by the
+    dense impl, whose backward is XLA's transpose).
     """
     # the ring's local problem runs at the SHARD length (K/V blocks visit)
     impl = pick_impl(impl, q.shape[1] // mesh.shape[axis_name], "ring")
-    kern = ring_attention_kernel if impl == "dense" else \
-        ring_flash_attention_kernel
-    kernel = functools.partial(
-        kern, axis_name=axis_name, causal=causal, scale=scale
-    )
-    return jax.shard_map(
+    if impl == "dense":
+        kernel = functools.partial(
+            ring_attention_kernel, axis_name=axis_name, causal=causal,
+            scale=scale)
+    else:
+        kernel = functools.partial(
+            ring_flash_attention_kernel, axis_name=axis_name, causal=causal,
+            scale=scale, backward=backward)
+    return shard_map(
         kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )(q, k, v)

@@ -39,13 +39,15 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..utils.compat import axis_size, shard_map
 from .flash_attention import flash_attention, pick_impl
 from .ring_attention import dense_reference_attention
 
 
 def ulysses_attention_kernel(q, k, v, *, axis_name: str, causal: bool = True,
                              scale: float | None = None, impl: str = "dense",
-                             interpret: bool | None = None):
+                             interpret: bool | None = None,
+                             backward: str = "fused"):
     """Per-shard Ulysses body; call inside ``shard_map``.
 
     Args:
@@ -57,10 +59,12 @@ def ulysses_attention_kernel(q, k, v, *, axis_name: str, causal: bool = True,
         all-to-all every device holds the full sequence, so the local mask
         IS the global mask).
       impl: local attention tile math — "flash" (pallas) or "dense".
+      backward: the flash impl's backward kernels ("fused" single-pass
+        default, "split" — see ops/flash_attention.py); unused by dense.
 
     Returns ``[B, S_local, H_local, D]`` in ``q.dtype``.
     """
-    sp = jax.lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     b, s_loc, h_loc, d = q.shape
     if h_loc % sp:
         raise ValueError(
@@ -84,7 +88,7 @@ def ulysses_attention_kernel(q, k, v, *, axis_name: str, causal: bool = True,
         q, k, v = seq_to_heads(jnp.stack((q, k, v)))
     if impl == "flash":
         out = flash_attention(q, k, v, causal=causal, scale=scale,
-                              interpret=interpret)
+                              interpret=interpret, backward=backward)
     else:
         out = dense_reference_attention(q, k, v, causal=causal, scale=scale)
     if sp > 1:
@@ -96,7 +100,8 @@ def ulysses_self_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
                            axis_name: str = "sp",
                            spec: P = P("dp", "sp", "tp", None),
                            scale: float | None = None,
-                           impl: str | None = None):
+                           impl: str | None = None,
+                           backward: str = "fused"):
     """shard_map wrapper: exact attention with sequence sharded on ``axis_name``
     via head-scatter/sequence-gather all-to-alls (DeepSpeed-Ulysses layout).
 
@@ -104,7 +109,8 @@ def ulysses_self_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
     sequence → sp, heads → tp). ``impl`` picks the local tile math the same
     way ``ring_self_attention`` does: ``"flash"``, ``"dense"``, or ``None``
     (flash when the FULL sequence tiles into 8-multiple blocks — after the
-    all-to-all the local problem has global sequence length).
+    all-to-all the local problem has global sequence length); ``backward``
+    picks the flash impl's backward kernels (fused|split).
     """
     sp = mesh.shape[axis_name]
     heads = q.shape[2]
@@ -121,9 +127,9 @@ def ulysses_self_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
     impl = pick_impl(impl, q.shape[1], "ulysses")
     kernel = functools.partial(
         ulysses_attention_kernel, axis_name=axis_name, causal=causal,
-        scale=scale, impl=impl,
+        scale=scale, impl=impl, backward=backward,
     )
-    return jax.shard_map(
+    return shard_map(
         kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )(q, k, v)

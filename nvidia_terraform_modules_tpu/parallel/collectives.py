@@ -26,9 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..utils.compat import shard_map  # noqa: F401 — re-exported
 from ..utils.timing import delta_time
-
-shard_map = jax.shard_map
 
 
 def _axis_size(mesh: Mesh, axis: str) -> int:

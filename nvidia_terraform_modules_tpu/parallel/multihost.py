@@ -85,6 +85,9 @@ def maybe_initialize_distributed(env: dict[str, str] | None = None) -> JobEnv | 
         return None
     import jax
 
+    from ..utils.compat import ensure_multiprocess_cpu_collectives
+
+    ensure_multiprocess_cpu_collectives()
     timeout = int(e.get("TPU_SMOKETEST_INIT_TIMEOUT", "300"))
     jax.distributed.initialize(
         coordinator_address=job.coordinator_address,

@@ -63,6 +63,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops.ring_attention import dense_reference_attention
+from ..utils.compat import shard_map
 from ..utils.layers import dense_init
 from ..utils.layers import rmsnorm as _rmsnorm
 
@@ -220,7 +221,7 @@ def pipeline_loss_fn(params, batch, cfg: PipelineConfig, mesh):
     M, mb, S = cfg.n_microbatches, cfg.microbatch, cfg.seq_len
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(_layer_specs(tp), P(), P(), P(None, "dp")),
         out_specs=P(),
         check_vma=False,
@@ -315,7 +316,7 @@ def pipeline_value_and_grad_1f1b(params, batch, cfg: PipelineConfig, mesh):
     R = 2 * pp - 1
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(_layer_specs(tp), P(), P(), P(None, "dp")),
         out_specs=(P(), _layer_specs(tp), P(), P()),
         check_vma=False,

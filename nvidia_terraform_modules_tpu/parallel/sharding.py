@@ -18,6 +18,8 @@ import dataclasses
 
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils.compat import pspec_axes
+
 
 @dataclasses.dataclass(frozen=True)
 class ShardingRules:
@@ -41,15 +43,15 @@ class ShardingRules:
 
     @property
     def batch(self) -> P:                  # [batch, ...]
-        return P(self.data)
+        return P(pspec_axes(self.data))
 
     @property
     def batch_seq(self) -> P:              # sequence-parallel activations
-        return P(self.data, "sp")
+        return P(pspec_axes(self.data), "sp")
 
     def act(self, *rest) -> P:
         """Activation spec: batch over the data axes, then ``rest`` dims."""
-        return P(self.data, *rest)
+        return P(pspec_axes(self.data), *rest)
 
     def shard(self, spec: P) -> NamedSharding:
         return NamedSharding(self.mesh, spec)

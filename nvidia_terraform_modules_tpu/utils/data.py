@@ -29,6 +29,8 @@ from typing import Any, Iterator
 
 import numpy as np
 
+from .compat import pspec_axes
+
 
 def token_stream(cfg, seed: int = 0,
                  bias: str = "zipf") -> Iterator[tuple[np.ndarray, np.ndarray]]:
@@ -80,7 +82,8 @@ def prefetch_to_device(batches: Iterator[Any], rules=None,
         if rules is None:
             return None
         ndim = getattr(x, "ndim", 0)
-        spec = ((rules.data,) + (None,) * (ndim - 1)) if ndim else ()
+        spec = ((pspec_axes(rules.data),) + (None,) * (ndim - 1)) \
+            if ndim else ()
         return rules.shard(P(*spec))
 
     def place(batch):

@@ -53,14 +53,22 @@ def device_trace(log_dir: str, *, host_tracer_level: int = 2,
     import jax
 
     os.makedirs(log_dir, exist_ok=True)
-    opts = jax.profiler.ProfileOptions()
-    opts.host_tracer_level = host_tracer_level
-    opts.python_tracer_level = python_tracer_level
-    jax.profiler.start_trace(
-        log_dir,
-        create_perfetto_link=False,
-        create_perfetto_trace=True,
-        profiler_options=opts)
+    if hasattr(jax.profiler, "ProfileOptions"):
+        opts = jax.profiler.ProfileOptions()
+        opts.host_tracer_level = host_tracer_level
+        opts.python_tracer_level = python_tracer_level
+        jax.profiler.start_trace(
+            log_dir,
+            create_perfetto_link=False,
+            create_perfetto_trace=True,
+            profiler_options=opts)
+    else:
+        # older jax has no ProfileOptions; trace with its defaults rather
+        # than refusing to trace at all
+        jax.profiler.start_trace(
+            log_dir,
+            create_perfetto_link=False,
+            create_perfetto_trace=True)
     try:
         yield log_dir
     finally:

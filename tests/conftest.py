@@ -101,6 +101,10 @@ SLOW_TESTS = frozenset({
     "tests/test_decode.py::test_sampling_reproducible_and_varied",
     "tests/test_flash_attention.py::test_burnin_flash_train_step_decreases_loss",
     "tests/test_flash_attention.py::test_flash_gradients_match_dense",
+    # full fused-backward parity sweep (block shapes × backward modes ×
+    # causal × dtype, 12 interpreter-mode grad computations); one fused
+    # seed stays tier-1 as test_fused_backward_tier1_seed
+    "tests/test_flash_attention.py::test_fused_backward_parity_matrix",
     "tests/test_moe.py::test_moe_routes_to_multiple_experts",
     "tests/test_moe.py::test_sharded_moe_matches_unsharded",
     "tests/test_moe.py::test_single_expert_equals_dense_mlp",

@@ -106,6 +106,116 @@ def test_fit_block_only_returns_sublane_multiples():
         flash_attention(q, k, v)
 
 
+# ------------------------------------------------- fused backward (PR 4)
+
+def _grads(fn, q, k, v):
+    """(dq, dk, dv) of the scalar loss sum(fn(q,k,v)²)."""
+    return jax.grad(
+        lambda q_, k_, v_: jnp.sum(
+            jnp.square(fn(q_, k_, v_).astype(jnp.float32))),
+        argnums=(0, 1, 2))(q, k, v)
+
+
+# square blocks, rectangular blocks, and an autoshrink shape (S=48 with
+# requested 32 → blocks shrink to the non-power-of-two divisor 24)
+_BWD_BLOCK_CASES = [
+    ("square", 64, 16, 16),
+    ("rect", 64, 16, 32),
+    ("autoshrink", 48, 32, 32),
+]
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("case", _BWD_BLOCK_CASES, ids=lambda c: c[0])
+def test_fused_backward_parity_matrix(case, causal, dtype):
+    """The differential-correctness oracle for the single-pass backward:
+    fused vs dense ``jax.grad`` reference AND fused vs split, across
+    causal × non-causal, square × rectangular blocks, f32 × bf16, and an
+    autoshrink (non-divisible S) shape — interpret mode on CPU. The full
+    matrix is slow-marked; test_fused_backward_tier1_seed keeps one seed
+    in the fast profile."""
+    _, s, bq, bk = case
+    q, k, v = _qkv(s=s, dtype=dtype)
+
+    def flash(mode):
+        return lambda q_, k_, v_: flash_attention(
+            q_, k_, v_, causal=causal, block_q=bq, block_k=bk,
+            backward=mode)
+
+    g_fused = _grads(flash("fused"), q, k, v)
+    g_split = _grads(flash("split"), q, k, v)
+    g_dense = _grads(
+        lambda q_, k_, v_: dense_reference_attention(q_, k_, v_,
+                                                     causal=causal),
+        q, k, v)
+    # fused and split share _bwd_tile and accumulate in the same order, so
+    # interpret mode should agree to f32 rounding; dense is the analytic
+    # reference with a dtype-dependent tolerance
+    tol_split = 1e-6 if dtype == jnp.float32 else 1e-2
+    tol_dense = 1e-4 if dtype == jnp.float32 else 0.15
+    for gf, gs, gd in zip(g_fused, g_split, g_dense):
+        assert jnp.max(jnp.abs(gf - gs)) < tol_split
+        assert jnp.max(jnp.abs(gf - gd)) < tol_dense
+
+
+def test_fused_backward_tier1_seed():
+    """One fused interpret-mode seed of the parity matrix stays tier-1
+    (causal, square blocks, f32) so the default backward path is gated on
+    every fast run without paying for the full sweep."""
+    q, k, v = _qkv(s=32)
+
+    def flash(mode):
+        return lambda q_, k_, v_: flash_attention(
+            q_, k_, v_, block_q=16, block_k=16, backward=mode)
+
+    g_fused = _grads(flash("fused"), q, k, v)
+    g_split = _grads(flash("split"), q, k, v)
+    g_dense = _grads(dense_reference_attention, q, k, v)
+    for gf, gs, gd in zip(g_fused, g_split, g_dense):
+        assert jnp.max(jnp.abs(gf - gs)) < 1e-6
+        assert jnp.max(jnp.abs(gf - gd)) < 1e-4
+
+
+def test_backward_knob_validated():
+    q, k, v = _qkv(s=16)
+    with pytest.raises(ValueError, match="fused|split"):
+        flash_attention(q, k, v, backward="bogus")
+    with pytest.raises(ValueError, match="flash_backward"):
+        BurnInConfig(flash_backward="bogus")
+
+
+def _count_pallas_calls(jaxpr) -> int:
+    """Recursively count pallas_call eqns in a (Closed)Jaxpr."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    total = 0
+    for eqn in inner.eqns:
+        if eqn.primitive.name == "pallas_call":
+            total += 1
+        for val in eqn.params.values():
+            for sub in (val if isinstance(val, (list, tuple)) else (val,)):
+                if hasattr(sub, "eqns") or hasattr(sub, "jaxpr"):
+                    total += _count_pallas_calls(sub)
+    return total
+
+
+@pytest.mark.parametrize("backward,expected", [("fused", 1), ("split", 2)])
+def test_backward_lowering_pallas_call_count(backward, expected):
+    """Lowering regression: the fused path must stage exactly ONE backward
+    pallas_call (and split exactly two) — a silent fallback to the split
+    kernels can never masquerade as a perf win. Counted on the vjp
+    function's jaxpr, which contains only the backward (the forward ran
+    eagerly; its residuals are constants)."""
+    q, k, v = _qkv(s=32)
+    _, vjp_fn = jax.vjp(
+        lambda q_, k_, v_: flash_attention(q_, k_, v_, block_q=16,
+                                           block_k=16, backward=backward),
+        q, k, v)
+    jaxpr = jax.make_jaxpr(vjp_fn)(jnp.ones_like(q))
+    assert _count_pallas_calls(jaxpr) == expected
+
+
 def test_burnin_flash_matches_dense_forward_unsharded():
     base = dict(vocab=64, d_model=32, n_heads=2, d_ff=64, n_layers=2,
                 seq_len=16, batch=4, dtype=jnp.float32)
