@@ -205,6 +205,12 @@ resource "kubernetes_job_v1" "tpu_smoketest" {
       spec {
         subdomain      = local.smoke_name
         restart_policy = "Never"
+        # preemption drain window: SIGTERM → this many seconds → SIGKILL.
+        # The supervised loop uses TPU_SMOKETEST_GRACE_SECONDS (half of
+        # it, wired below) to finish the in-flight step and commit an
+        # emergency checkpoint; see "Preemption & resume runbook" in
+        # README.md and the tpu-spot-no-grace lint rule.
+        termination_grace_period_seconds = var.smoketest.grace_period_seconds
 
         node_selector = {
           "cloud.google.com/gke-tpu-accelerator" = each.value.node_selector
@@ -248,12 +254,17 @@ resource "kubernetes_job_v1" "tpu_smoketest" {
           }
 
           # spot-slice resume: preempted burn-in pods restart from their
-          # last checkpoint instead of step 0
+          # last checkpoint instead of step 0, with an emergency-save
+          # budget of half the pod's termination grace (the other half
+          # is drain + teardown headroom)
           dynamic "env" {
-            for_each = var.smoketest.checkpoint_dir != null ? [1] : []
+            for_each = var.smoketest.checkpoint_dir != null ? {
+              TPU_SMOKETEST_CHECKPOINT_DIR = var.smoketest.checkpoint_dir
+              TPU_SMOKETEST_GRACE_SECONDS  = tostring(floor(var.smoketest.grace_period_seconds / 2))
+            } : {}
             content {
-              name  = "TPU_SMOKETEST_CHECKPOINT_DIR"
-              value = var.smoketest.checkpoint_dir
+              name  = env.key
+              value = env.value
             }
           }
 

@@ -273,6 +273,13 @@ variable "smoketest" {
     # deadlocks all but the first pod in ContainerCreating.
     checkpoint_dir = optional(string)
     checkpoint_pvc = optional(string)
+    # pod termination grace on preemption/reclaim: kubernetes waits this
+    # long between SIGTERM and SIGKILL. The supervised loop drains the
+    # in-flight step and commits an emergency checkpoint inside the
+    # TPU_SMOKETEST_GRACE_SECONDS budget (wired to half this value so
+    # the drain itself has headroom) — keep >= 60; the
+    # tpu-spot-no-grace lint rule flags spot TPU workloads below that.
+    grace_period_seconds = optional(number, 120)
   })
   default = {}
 
@@ -295,6 +302,13 @@ variable "smoketest" {
       )
     )
     error_message = "smoketest.checkpoint_dir must be a gs:// prefix or an ABSOLUTE local path with smoketest.checkpoint_pvc (a PersistentVolumeClaim name) so checkpoints survive pod replacement."
+  }
+
+  validation {
+    # kubernetes' 30s default equals the default emergency-checkpoint
+    # budget with zero drain headroom — the tpu-spot-no-grace floor
+    condition     = var.smoketest.grace_period_seconds >= 60
+    error_message = "smoketest.grace_period_seconds must be >= 60: the SIGTERM drain plus the emergency checkpoint (TPU_SMOKETEST_GRACE_SECONDS = grace/2) needs real headroom before kubernetes escalates to SIGKILL."
   }
 
   validation {

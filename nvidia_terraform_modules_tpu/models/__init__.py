@@ -50,9 +50,20 @@ from .optimizer import (  # noqa: F401
     opt_state_shardings,
 )
 from .checkpoint import (  # noqa: F401
+    CheckpointError,
     Checkpointer,
+    CorruptCheckpointError,
     clear_checkpoints,
     latest_step,
     restore_checkpoint,
     save_checkpoint,
+)
+from .resilience import (  # noqa: F401
+    Heartbeat,
+    HeartbeatMonitor,
+    PeerFailure,
+    PreemptionGuard,
+    ResilienceConfig,
+    SupervisedLoop,
+    resilience_from_env,
 )

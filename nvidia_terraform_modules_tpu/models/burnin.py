@@ -16,6 +16,19 @@ Design notes (TPU-first):
 - ``with_sharding_constraint`` pins activation layouts; XLA inserts the
   collectives (no hand-written NCCL analogue);
 - static shapes everywhere; the step is one compiled XLA program.
+
+Running it to survive preemption: :class:`BurnInConfig` deliberately
+carries only *model/math* knobs — everything about surviving a spot
+reclaim (the SIGTERM drain + emergency-checkpoint grace budget,
+heartbeat liveness, checkpoint cadence) lives in the supervised runtime
+(``models/resilience.py`` ``ResilienceConfig``, env-driven:
+``TPU_SMOKETEST_GRACE_SECONDS``, ``TPU_HEARTBEAT_INTERVAL_S`` /
+``TPU_HEARTBEAT_TIMEOUT_S``), which wraps the train step built here —
+see ``smoketest/runner.py`` (the burn-in Job leg), ``smoketest/chaos.py``
+(the kill-and-resume gate), and the "Preemption & resume runbook" in
+``gke-tpu/README.md``. Keeping the split strict means a resumed run's
+jitted step is byte-identical to the uninterrupted one — the property
+the chaos harness's bit-exact resume invariant rests on.
 """
 
 from __future__ import annotations

@@ -1,0 +1,452 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""The preemption-tolerant training runtime: supervision around the loop.
+
+PRs 2–3 taught the *infrastructure* simulator to survive failure
+(fault-injecting control plane, failure-isolating parallel apply); this
+module is the same posture for the *workload* the clusters exist to run.
+Podracer (Hessel et al., 2021) and the Maple line in PAPERS.md treat
+preemption-tolerant, supervised workers as the precondition for cheap
+large-scale TPU training — on spot slices (``gke-tpu/tpu_slices.tf``)
+the preemption notice is routine, not exceptional. Three mechanisms:
+
+- :class:`PreemptionGuard` — a SIGTERM/preemption-notice handler that
+  *drains* instead of dying: the in-flight train step completes, an
+  emergency checkpoint commits inside a configurable grace budget
+  (``ResilienceConfig.grace_seconds``, sized against the pod's
+  ``termination_grace_period_seconds`` — the ``tpu-spot-no-grace`` lint
+  rule cross-checks the two), and the process exits with a *restartable*
+  code instead of losing the step;
+- :class:`Heartbeat` + :class:`HeartbeatMonitor` — per-process liveness
+  files next to the checkpoints. A peer that dies inside a collective
+  leaves everyone else blocked in gloo/ICI forever; the monitor converts
+  that indefinite hang into a bounded, **classified** failure
+  (:class:`PeerFailure` written to disk, exit ``EXIT_PEER_DEAD``) that a
+  supervisor restarts;
+- capped exponential backoff with jitter (``utils/retry.py`` — the
+  ``tfsim`` control-plane policy shape) around distributed init
+  (``parallel/multihost.py``) and restore-time reads
+  (``models/checkpoint.py``), so transient infrastructure noise costs
+  milliseconds, not attempts.
+
+:class:`SupervisedLoop` composes the three around any ``step_fn`` — the
+burn-in smoke test and the chaos harness's training worker both run
+through it, so the kill-and-resume invariants the harness asserts are
+properties of the same code path production uses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from ..utils.retry import RetryPolicy
+
+# process exit codes a supervisor can classify without parsing logs:
+# preempted-and-drained (restart me, my checkpoint is committed) vs
+# peer-dead (restart the world; one of us stopped heartbeating)
+EXIT_PREEMPTED = 75    # EX_TEMPFAIL: transient, retry the job
+EXIT_PEER_DEAD = 76    # EX_PROTOCOL: the collective world is broken
+
+_HEARTBEAT_DIR = "heartbeats"
+
+
+@dataclasses.dataclass(frozen=True)
+class ResilienceConfig:
+    """Knobs for the supervised loop (env-overridable, see
+    :func:`resilience_from_env`; operational guidance in
+    ``gke-tpu/README.md`` "Preemption & resume runbook")."""
+
+    # emergency-checkpoint budget after the preemption notice: the drain
+    # (finish the in-flight step) plus the final save must fit here. Size
+    # the pod's termination_grace_period_seconds ABOVE this value.
+    grace_seconds: float = 30.0
+    # liveness: how often each process stamps its heartbeat file, and how
+    # stale a previously-seen peer heartbeat may grow before the hang is
+    # classified as a dead peer. The timeout must exceed the longest
+    # legitimate silent stretch (one train step + one checkpoint save).
+    heartbeat_interval_s: float = 2.0
+    heartbeat_timeout_s: float = 60.0
+    # distributed init / restore-read retry shapes (control-plane mirror)
+    init_policy: RetryPolicy = RetryPolicy(
+        initial_s=1.0, multiplier=2.0, cap_s=30.0, max_attempts=3)
+
+    def __post_init__(self):
+        if self.grace_seconds <= 0:
+            raise ValueError(
+                f"grace_seconds must be > 0, got {self.grace_seconds}")
+        if self.heartbeat_timeout_s <= self.heartbeat_interval_s:
+            raise ValueError(
+                f"heartbeat_timeout_s ({self.heartbeat_timeout_s}) must "
+                f"exceed heartbeat_interval_s "
+                f"({self.heartbeat_interval_s}) — a timeout inside the "
+                f"stamping interval declares every live peer dead")
+
+
+def resilience_from_env(env: Optional[dict] = None) -> ResilienceConfig:
+    """Build the config from the Job env (all optional):
+
+    - ``TPU_SMOKETEST_GRACE_SECONDS`` — emergency-checkpoint budget;
+    - ``TPU_HEARTBEAT_INTERVAL_S`` / ``TPU_HEARTBEAT_TIMEOUT_S`` —
+      liveness stamping/staleness.
+    """
+    e = os.environ if env is None else env
+    kw: dict[str, Any] = {}
+    if "TPU_SMOKETEST_GRACE_SECONDS" in e:
+        kw["grace_seconds"] = float(e["TPU_SMOKETEST_GRACE_SECONDS"])
+    if "TPU_HEARTBEAT_INTERVAL_S" in e:
+        kw["heartbeat_interval_s"] = float(e["TPU_HEARTBEAT_INTERVAL_S"])
+    if "TPU_HEARTBEAT_TIMEOUT_S" in e:
+        kw["heartbeat_timeout_s"] = float(e["TPU_HEARTBEAT_TIMEOUT_S"])
+    return ResilienceConfig(**kw)
+
+
+# ------------------------------------------------------------- preemption
+
+
+class PreemptionGuard:
+    """Convert SIGTERM into a drain request with a grace deadline.
+
+    Use as a context manager around the train loop. The handler only
+    sets state — the *loop* decides when to stop (after the in-flight
+    step), which is the whole point: a mid-step kill loses the step, a
+    drained stop commits it. Installing from a non-main thread (pytest
+    workers, library use) degrades to an inert guard (``installed`` is
+    False) rather than crashing — signals are a main-thread facility.
+
+    A second SIGTERM while draining is left to the default disposition
+    of the *restored* handler on exit; inside the guard it is absorbed
+    (Kubernetes repeats the signal; repeats must not kill the drain).
+    """
+
+    def __init__(self, grace_seconds: float = 30.0,
+                 signals: tuple = (signal.SIGTERM,)):
+        self.grace_seconds = grace_seconds
+        self._signals = signals
+        self._previous: dict = {}
+        self.installed = False
+        self._preempted_at: Optional[float] = None
+
+    def __enter__(self) -> "PreemptionGuard":
+        try:
+            for sig in self._signals:
+                self._previous[sig] = signal.signal(sig, self._on_signal)
+            self.installed = True
+        except ValueError:   # not the main thread
+            self._previous.clear()
+            self.installed = False
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+        self._previous.clear()
+        self.installed = False
+
+    def _on_signal(self, signum, frame) -> None:  # noqa: ARG002
+        if self._preempted_at is None:
+            self._preempted_at = time.monotonic()
+
+    @property
+    def preempted(self) -> bool:
+        return self._preempted_at is not None
+
+    @property
+    def remaining_s(self) -> float:
+        """Grace budget left for the emergency checkpoint (0 when not
+        preempted — callers gate on :attr:`preempted` first)."""
+        if self._preempted_at is None:
+            return 0.0
+        used = time.monotonic() - self._preempted_at
+        return max(0.0, self.grace_seconds - used)
+
+
+# -------------------------------------------------------------- liveness
+
+
+class PeerFailure(Exception):
+    """A peer stopped heartbeating: the collective world is broken.
+
+    Carries enough to classify the failure without logs: which process,
+    how stale, and at which step it was last seen alive.
+    """
+
+    def __init__(self, process: int, age_s: float, last_step: int):
+        super().__init__(
+            f"peer process {process} last heartbeat {age_s:.1f}s ago "
+            f"(at step {last_step}) — classifying the collective hang "
+            f"as a dead peer")
+        self.process = process
+        self.age_s = age_s
+        self.last_step = last_step
+
+
+class Heartbeat:
+    """Per-process liveness file, stamped on every step and on a timer.
+
+    The timer thread covers long silent stretches (compile, big
+    collective) so a *slow* step is distinguishable from a *dead*
+    process; :meth:`beat` stamps synchronously with the current step so
+    a supervisor can also read training progress from the same file.
+    """
+
+    def __init__(self, directory: str, process_id: int,
+                 interval_s: float = 2.0):
+        self.path = os.path.join(directory, _HEARTBEAT_DIR,
+                                 f"p{process_id:05d}.json")
+        self.process_id = process_id
+        self.interval_s = interval_s
+        self._step = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Heartbeat":
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        self.beat(0)
+        self._thread = threading.Thread(
+            target=self._run, name="heartbeat", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._stamp()
+
+    def beat(self, step: int) -> None:
+        self._step = step
+        self._stamp()
+
+    def _stamp(self) -> None:
+        tmp = f"{self.path}.tmp"
+        try:
+            with open(tmp, "w") as fh:
+                json.dump({"process": self.process_id, "step": self._step,
+                           "time": time.time(), "pid": os.getpid()}, fh)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass   # liveness is best-effort; the monitor handles absence
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "Heartbeat":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+class HeartbeatMonitor:
+    """Watch every peer's heartbeat file; classify the dead ones.
+
+    A peer only *arms* once a heartbeat stamped AFTER this monitor was
+    born has been seen: a pod that never scheduled is the init timeout's
+    failure, not a liveness one, and a stale file surviving a pod
+    replacement on the shared checkpoint PVC must not let a resumed
+    world re-classify a merely *slow-to-restart* peer as dead (the peer
+    keeps stamping once alive, so it arms on the next check). After
+    arming, a heartbeat older than ``timeout_s`` is a
+    :class:`PeerFailure`. :meth:`watch` runs the check on a background
+    thread and invokes ``on_dead`` — the supervised loop's callback
+    writes the classification next to the checkpoints and exits
+    ``EXIT_PEER_DEAD``, bounding what would otherwise be an indefinite
+    gloo/ICI collective hang.
+    """
+
+    def __init__(self, directory: str, num_processes: int,
+                 timeout_s: float = 60.0, self_id: Optional[int] = None):
+        self.directory = os.path.join(directory, _HEARTBEAT_DIR)
+        self.num_processes = num_processes
+        self.timeout_s = timeout_s
+        self.self_id = self_id
+        self._born = time.time()
+        self._armed: dict[int, dict] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def read(self) -> dict[int, dict]:
+        """Current heartbeat payloads by process id (missing = absent)."""
+        out: dict[int, dict] = {}
+        for k in range(self.num_processes):
+            path = os.path.join(self.directory, f"p{k:05d}.json")
+            try:
+                with open(path) as fh:
+                    out[k] = json.load(fh)
+            except (OSError, json.JSONDecodeError):
+                continue
+        return out
+
+    def check(self, now: Optional[float] = None) -> list[PeerFailure]:
+        """Dead peers as classified failures (empty = everyone lives)."""
+        now = time.time() if now is None else now
+        for k, payload in self.read().items():
+            # arm only on a heartbeat from THIS attempt's lifetime;
+            # once armed, always track the latest payload
+            if k in self._armed or payload.get("time", 0.0) >= self._born:
+                self._armed[k] = payload
+        failures = []
+        for k, last in self._armed.items():
+            if k == self.self_id:
+                continue
+            age = now - last.get("time", 0.0)
+            if age > self.timeout_s:
+                failures.append(
+                    PeerFailure(k, age, int(last.get("step", 0))))
+        return failures
+
+    def watch(self, on_dead: Callable[[PeerFailure], None],
+              interval_s: float = 1.0) -> "HeartbeatMonitor":
+        def run():
+            while not self._stop.wait(interval_s):
+                failures = self.check()
+                if failures:
+                    on_dead(failures[0])
+                    return
+        self._thread = threading.Thread(
+            target=run, name="heartbeat-monitor", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+# ------------------------------------------------------- supervised loop
+
+
+@dataclasses.dataclass
+class LoopOutcome:
+    """What the supervised loop did: ``completed`` (reached
+    ``total_steps``) or ``preempted`` (drained + emergency checkpoint).
+    ``resumed_from`` is the restored step (None for a fresh start)."""
+
+    status: str
+    step: int
+    resumed_from: Optional[int]
+    emergency_saved: bool = False
+
+
+class SupervisedLoop:
+    """Drive ``step_fn`` to ``total_steps`` under full supervision.
+
+    One object owns the composition: restore-or-init, per-step
+    checkpoints every ``save_every`` steps, heartbeats, the SIGTERM
+    drain with an emergency checkpoint inside the grace budget, and the
+    dead-peer monitor. The burn-in smoke test and the chaos harness's
+    worker both run through here — the harness's kill-and-resume
+    invariants hold for the production path because they ARE the
+    production path.
+    """
+
+    def __init__(self, ckpt, cfg: ResilienceConfig, *,
+                 total_steps: int, save_every: int = 1,
+                 process_id: int = 0, num_processes: int = 1,
+                 heartbeat_dir: Optional[str] = None,
+                 on_peer_dead: Optional[Callable] = None):
+        if save_every < 1:
+            raise ValueError(f"save_every must be >= 1, got {save_every}")
+        self.ckpt = ckpt
+        self.cfg = cfg
+        self.total_steps = total_steps
+        self.save_every = save_every
+        self.process_id = process_id
+        self.num_processes = num_processes
+        self.heartbeat_dir = heartbeat_dir
+        self.on_peer_dead = on_peer_dead
+
+    # the default dead-peer action: leave a classification on disk where
+    # the supervisor (and the next attempt) can read it, then exit with
+    # the protocol code — never hang in the collective
+    def _default_peer_dead(self, failure: PeerFailure) -> None:
+        if self.heartbeat_dir:
+            try:
+                with open(os.path.join(
+                        self.heartbeat_dir,
+                        f"peer_failure_p{self.process_id:05d}.json"),
+                        "w") as fh:
+                    json.dump({"process": failure.process,
+                               "age_s": round(failure.age_s, 1),
+                               "last_step": failure.last_step,
+                               "observed_by": self.process_id}, fh)
+            except OSError:
+                pass
+        os._exit(EXIT_PEER_DEAD)
+
+    def run(self, state: Any, step_fn: Callable[[Any, int], Any],
+            start_step: int = 0,
+            resumed_from: Optional[int] = None,
+            meta: Optional[Callable[[int, Any], dict]] = None,
+            ) -> tuple[Any, LoopOutcome]:
+        """Run from ``start_step`` (exclusive) to ``total_steps``.
+
+        ``step_fn(state, step) -> state`` is one train step (1-indexed
+        ``step``). Returns the final state and a :class:`LoopOutcome`;
+        on preemption the caller decides the exit path (the module-level
+        workers exit ``EXIT_PREEMPTED``).
+        """
+        hb = None
+        monitor = None
+        step = start_step
+        emergency_saved = False
+        try:
+            if self.heartbeat_dir and self.num_processes >= 1:
+                hb = Heartbeat(self.heartbeat_dir, self.process_id,
+                               self.cfg.heartbeat_interval_s).start()
+                hb.beat(step)
+            if self.heartbeat_dir and self.num_processes > 1:
+                monitor = HeartbeatMonitor(
+                    self.heartbeat_dir, self.num_processes,
+                    timeout_s=self.cfg.heartbeat_timeout_s,
+                    self_id=self.process_id,
+                ).watch(self.on_peer_dead or self._default_peer_dead)
+            with PreemptionGuard(self.cfg.grace_seconds) as guard:
+                while step < self.total_steps:
+                    state = step_fn(state, step + 1)
+                    step += 1
+                    if hb is not None:
+                        hb.beat(step)
+                    saved_this_step = False
+                    if self.ckpt is not None and (
+                            step % self.save_every == 0 or
+                            step == self.total_steps):
+                        self.ckpt.save(
+                            step, state,
+                            meta=meta(step, state) if meta else
+                            {"step": step})
+                        saved_this_step = True
+                    if guard.preempted and step < self.total_steps:
+                        # drained: the in-flight step finished. Commit an
+                        # emergency checkpoint inside the grace budget so
+                        # the restart loses nothing — flush first so a
+                        # pending async save cannot race the final one.
+                        if self.ckpt is not None and not saved_this_step:
+                            self.ckpt.save(
+                                step, state,
+                                meta=meta(step, state) if meta else
+                                {"step": step, "emergency": True})
+                            emergency_saved = True
+                        if self.ckpt is not None:
+                            self.ckpt.flush()
+                        return state, LoopOutcome(
+                            "preempted", step, resumed_from,
+                            emergency_saved)
+                if self.ckpt is not None:
+                    self.ckpt.flush()
+                return state, LoopOutcome(
+                    "completed", step, resumed_from, emergency_saved)
+        finally:
+            if monitor is not None:
+                monitor.stop()
+            if hb is not None:
+                hb.stop()

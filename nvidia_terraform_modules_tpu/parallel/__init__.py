@@ -23,7 +23,11 @@ from .collectives import (  # noqa: F401
     reduce_scatter_probe,
     ring_permute_probe,
 )
-from .multihost import job_env_from_environ, maybe_initialize_distributed  # noqa: F401
+from .multihost import (  # noqa: F401
+    DistributedInitError,
+    job_env_from_environ,
+    maybe_initialize_distributed,
+)
 from .pipeline import (  # noqa: F401
     PipelineConfig,
     init_pipeline_params,

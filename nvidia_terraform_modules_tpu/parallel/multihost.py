@@ -19,9 +19,22 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import sys
 
+from ..utils.retry import RetryPolicy
 
 COORDINATOR_PORT = 8476
+
+
+class DistributedInitError(RuntimeError):
+    """``jax.distributed.initialize`` could not assemble the world.
+
+    Raised after the bounded retry budget with a diagnostic naming every
+    fact an operator needs (who we are, who we dialled, how long we
+    waited) — the alternative is the stock behaviour this replaces: a
+    half-scheduled multi-host Job hanging until something *outside* the
+    process kills it.
+    """
 
 
 @dataclasses.dataclass(frozen=True)
@@ -74,10 +87,18 @@ def job_env_from_environ(env: dict[str, str] | None = None) -> JobEnv | None:
 def maybe_initialize_distributed(env: dict[str, str] | None = None) -> JobEnv | None:
     """Call ``jax.distributed.initialize`` iff running under a multi-host Job.
 
-    ``TPU_SMOKETEST_INIT_TIMEOUT`` (seconds, default 300) bounds how long we
-    wait for the rest of the slice — a half-scheduled multi-host Job should
-    fail the smoke test, not hang it (the failure mode the reference's
-    plan-time node gate at ``/root/reference/eks/main.tf:186`` papers over).
+    Bounded and classified, never hanging: ``TPU_SMOKETEST_INIT_TIMEOUT``
+    (seconds, default 300) is the TOTAL budget for assembling the world.
+    Non-coordinators first run a TCP pre-flight against the coordinator
+    (capped at ``TPU_SMOKETEST_INIT_PREFLIGHT``, default 60s, never more
+    than half the budget) with capped exponential backoff + jitter — the
+    ``tfsim/faults/control_plane.py`` retry shape via ``utils/retry.py``
+    — raising :class:`DistributedInitError` with a full diagnostic when
+    pod 0 is unreachable (previously the process sat inside the client
+    until an outer ``timeout -k`` killed the suite — the failure mode
+    the reference's plan-time node gate papers over). The remainder of
+    the budget bounds the registration barrier itself, which covers the
+    coordinator-is-up-but-a-peer-never-arrives case.
     """
     e = os.environ if env is None else env
     job = job_env_from_environ(env)
@@ -89,10 +110,81 @@ def maybe_initialize_distributed(env: dict[str, str] | None = None) -> JobEnv | 
 
     ensure_multiprocess_cpu_collectives()
     timeout = int(e.get("TPU_SMOKETEST_INIT_TIMEOUT", "300"))
+    preflight_budget = min(
+        timeout / 2.0,
+        float(e.get("TPU_SMOKETEST_INIT_PREFLIGHT", "60")))
+    remaining = timeout
+    if not job.is_coordinator:
+        remaining -= _preflight_coordinator(job, preflight_budget)
+    # intent on the record BEFORE the blocking call: jax's C++ client
+    # LOG(FATAL)s (uncatchable) when a peer misses the registration
+    # barrier, so this line is the diagnostic a post-mortem reads next
+    # to the abort message
+    print(
+        f"smoketest: joining jax.distributed world as process "
+        f"{job.process_id}/{job.num_processes} via "
+        f"{job.coordinator_address} (timeout {int(remaining)}s)",
+        file=sys.stderr, flush=True)
     jax.distributed.initialize(
         coordinator_address=job.coordinator_address,
         num_processes=job.num_processes,
         process_id=job.process_id,
-        initialization_timeout=timeout,
+        initialization_timeout=max(1, int(remaining)),
     )
     return job
+
+
+def _preflight_coordinator(job: JobEnv, budget_s: float) -> float:
+    """Bounded, classified wait for the coordinator to be dialable.
+
+    ``jax.distributed.initialize``'s registration failure path is a C++
+    ``LOG(FATAL)`` — no Python exception ever surfaces, so any retry or
+    diagnostic must happen BEFORE handing control to the client. A plain
+    TCP connect probe with capped exponential backoff + jitter (the
+    ``tfsim`` control-plane policy shape, via ``utils/retry.py``) covers
+    the common never-assembles case — pod 0 unscheduled, headless-
+    Service DNS not propagated, a typo'd coordinator address — with a
+    :class:`DistributedInitError` naming every relevant fact, instead of
+    a silent hang until the outer harness timeout. Returns seconds
+    spent, so the caller can hand the remainder of the budget to the
+    real initialize (whose own barrier then bounds the peer-missing
+    case)."""
+    import random
+    import socket
+    import time as _time
+
+    host, _, port = job.coordinator_address.rpartition(":")
+    t0 = _time.monotonic()
+    deadline = t0 + budget_s
+    # unbounded attempts under a HARD wall-clock deadline: each connect's
+    # timeout is clamped to the time left, so the pre-flight can never
+    # overspend its budget into the registration barrier's share
+    delays = RetryPolicy(initial_s=1.0, multiplier=2.0, cap_s=15.0,
+                         max_attempts=10_000).delays(random.Random())
+    attempt = 0
+    last: Exception | None = None
+    while True:
+        remaining = deadline - _time.monotonic()
+        if remaining <= 0:
+            break
+        attempt += 1
+        try:
+            with socket.create_connection((host, int(port)),
+                                          timeout=min(5.0, remaining)):
+                return _time.monotonic() - t0
+        except OSError as exc:
+            last = exc
+        delay = next(delays, 0.0)
+        if _time.monotonic() + delay >= deadline:
+            break
+        _time.sleep(delay)
+    raise DistributedInitError(
+        f"multi-host world never assembled: process "
+        f"{job.process_id}/{job.num_processes} could not reach the "
+        f"coordinator at {job.coordinator_address} after {attempt} "
+        f"attempt(s) over {_time.monotonic() - t0:.0f}s (pre-flight "
+        f"budget {budget_s:.0f}s). Check that pod 0 of the indexed Job "
+        f"scheduled (kubectl get pods -l smoketest-group), that the "
+        f"headless Service resolves its hostname, and that "
+        f"TPU_SMOKETEST_HOSTS matches the Job's completions. Last "
+        f"error: {last}")

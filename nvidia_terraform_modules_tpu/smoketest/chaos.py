@@ -1,0 +1,532 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Kill-and-resume chaos harness: the training-stack mirror of ``tfsim chaos``.
+
+``tfsim chaos`` proves the *infrastructure* converges under seeded
+faults; this harness proves the *workload* does. A supervisor launches
+the real supervised training job (1 or 2 ``jax.distributed`` processes
+over gloo on CPU — the same choreography as the gke-tpu indexed Job),
+kills workers with SIGTERM or SIGKILL at a seeded step, restarts them,
+and asserts the **exact-resume invariants**:
+
+- the resumed run's final params AND optimizer state match an
+  uninterrupted run of the same seed bit-for-bit (well inside the ulp
+  tolerance the gate demands — CPU replays of identical XLA programs
+  from identical restored bytes are exact);
+- the step count is exact: every kill-and-restart sequence executes the
+  configured total, never one more or one fewer;
+- no quarantined checkpoint is ever restored (each attempt journals
+  what it resumed from and what sat in quarantine);
+- repeated kill-at-step-k replays are deterministic: same case, fresh
+  directory → identical resume steps and identical final digests.
+
+Determinism discipline: the kill is **self-delivered** — the supervisor
+arms ``TPU_CHAOS_KILL_AT_STEP``/``TPU_CHAOS_KILL_SIGNAL`` and the worker
+raises the signal against itself at the exact step boundary (SIGTERM
+before the step, so the drain must complete it; SIGKILL before the
+step, so the last commit is the previous step). A supervisor-side kill
+races the step clock and would make "kill at step k" unreplayable; a
+self-delivered one is the same OS-level death with a deterministic
+timestamp. The supervisor still reads heartbeat files for progress and
+enforces a hard wall-clock bound per attempt, and restarts on ANY
+non-zero exit — including the classified ``EXIT_PREEMPTED`` (drained),
+``EXIT_PEER_DEAD`` (the heartbeat monitor converted a collective hang),
+and checkpoint rendezvous timeouts — so the restart loop itself is the
+retry policy.
+
+CLI::
+
+    python -m nvidia_terraform_modules_tpu.smoketest.chaos \\
+        -seeds 3 -steps 8 -kill-steps 2,5 -signals SIGTERM,SIGKILL
+
+Tests: ``tests/test_chaos_resume.py`` (one seeded case tier-1, the full
+matrix slow — mirroring the chaos-gate layering of
+``tests/test_tfsim_faults.py``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import hashlib
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+RESUME_JOURNAL = "resume_log.jsonl"
+
+# the worker's training shape: tiny on purpose (the invariants are about
+# the checkpoint/signal/restart machinery, not the model), f32 so CPU
+# replays are exact, batch sized for up to 4-way data sharding
+_CHAOS_MODEL = dict(vocab=64, d_model=32, n_heads=2, d_ff=64, n_layers=1,
+                    seq_len=16, batch=8)
+
+
+class ChaosInvariantError(AssertionError):
+    """An exact-resume invariant failed; the message names which."""
+
+
+# ================================================================= worker
+
+
+def _digest(tree) -> str:
+    """sha256 over this process's addressable shard bytes, in a
+    deterministic (leaf path, shard index) order — comparable across
+    runs with the same process layout."""
+    import jax
+    import numpy as np
+
+    h = hashlib.sha256()
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        h.update(jax.tree_util.keystr(path).encode())
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            recs = []
+            for s in shards:
+                key = tuple((sl.start or 0, sl.stop) for sl in s.index)
+                recs.append((key, np.array(s.data)))
+            seen = set()
+            for key, arr in sorted(recs, key=lambda r: r[0]):
+                if key in seen:
+                    continue
+                seen.add(key)
+                h.update(repr(key).encode())
+                h.update(np.ascontiguousarray(arr).tobytes())
+        else:
+            h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()
+
+
+def worker_main(env: Optional[dict] = None) -> int:
+    """One supervised training worker (the chaos harness's payload).
+
+    Env contract (all ``TPU_CHAOS_*`` set by the supervisor; the
+    standard ``TPU_SMOKETEST_*`` multi-host vars come along unchanged):
+
+    - ``TPU_CHAOS_CKPT_DIR`` — checkpoint + heartbeat directory;
+    - ``TPU_CHAOS_TOTAL_STEPS`` / ``TPU_CHAOS_SAVE_EVERY`` /
+      ``TPU_CHAOS_SEED`` — the training run;
+    - ``TPU_CHAOS_KILL_AT_STEP`` / ``TPU_CHAOS_KILL_SIGNAL`` /
+      ``TPU_CHAOS_KILL_PROCESS`` — the armed self-kill (first attempt
+      only: ``TPU_CHAOS_ATTEMPT`` gates it);
+
+    Exits 0 on completion (final JSON line carries step + digests),
+    ``EXIT_PREEMPTED`` after a SIGTERM drain + emergency checkpoint.
+    """
+    e = dict(os.environ if env is None else env)
+    from ..models import (
+        AdamWConfig,
+        BurnInConfig,
+        Checkpointer,
+        SupervisedLoop,
+        abstract_train_state,
+        init_params,
+        make_adamw_train_step,
+        resilience_from_env,
+        synthetic_batch,
+    )
+    from ..models.resilience import EXIT_PREEMPTED
+    from ..parallel import (
+        build_mesh,
+        make_rules,
+        maybe_initialize_distributed,
+        plan_mesh,
+    )
+
+    job = maybe_initialize_distributed(e)
+    import jax
+    import jax.numpy as jnp
+
+    pid = job.process_id if job else 0
+    nprocs = job.num_processes if job else 1
+    seed = int(e.get("TPU_CHAOS_SEED", "0"))
+    total = int(e.get("TPU_CHAOS_TOTAL_STEPS", "8"))
+    save_every = int(e.get("TPU_CHAOS_SAVE_EVERY", "1"))
+    ckpt_dir = e["TPU_CHAOS_CKPT_DIR"]
+    kill_step = int(e.get("TPU_CHAOS_KILL_AT_STEP", "0"))
+    kill_signal = e.get("TPU_CHAOS_KILL_SIGNAL", "")
+    kill_process = e.get("TPU_CHAOS_KILL_PROCESS", "")
+    attempt = int(e.get("TPU_CHAOS_ATTEMPT", "0"))
+
+    cfg = BurnInConfig(dtype=jnp.float32, **_CHAOS_MODEL)
+    rules = make_rules(build_mesh(plan_mesh(len(jax.devices()))))
+    init_state, adamw_step = make_adamw_train_step(
+        cfg, rules, AdamWConfig(lr=1e-2))
+    batch = synthetic_batch(jax.random.PRNGKey(seed + 1), cfg, rules)
+
+    rcfg = resilience_from_env(e)
+    os.makedirs(ckpt_dir, exist_ok=True)
+    ckpt = Checkpointer(ckpt_dir, max_to_keep=4)
+    restored = ckpt.restore_tree(abstract_train_state(cfg, rules))
+    quarantined = ckpt.quarantined()
+    if restored is not None:
+        state, start_step, _meta = restored
+        resumed_from: Optional[int] = start_step
+    else:
+        params = init_params(jax.random.PRNGKey(seed), cfg, rules)
+        state = {"params": params, "opt": init_state(params)}
+        start_step, resumed_from = 0, None
+    # the journal the supervisor audits: what this attempt resumed from
+    # and what sat in quarantine at that moment (invariant: disjoint)
+    with open(os.path.join(ckpt_dir, RESUME_JOURNAL), "a") as fh:
+        fh.write(json.dumps({
+            "attempt": attempt, "process": pid,
+            "resumed_from": resumed_from, "quarantined": quarantined,
+        }) + "\n")
+
+    armed = (attempt == 0 and kill_step > start_step and
+             kill_signal and kill_process in ("", str(pid)))
+
+    def step_fn(st, step_no):
+        if armed and step_no == kill_step:
+            # the deterministic kill point: SIGTERM right BEFORE the
+            # step (the drain must complete it — the step is never
+            # lost); SIGKILL right before it (instant death; the last
+            # commit is step k-1)
+            os.kill(os.getpid(), getattr(signal, kill_signal))
+        p, s, _loss = adamw_step(st["params"], st["opt"], batch)
+        return {"params": p, "opt": s}
+
+    loop = SupervisedLoop(
+        ckpt, rcfg, total_steps=total, save_every=save_every,
+        process_id=pid, num_processes=nprocs, heartbeat_dir=ckpt_dir)
+    try:
+        state, outcome = loop.run(state, step_fn, start_step=start_step,
+                                  resumed_from=resumed_from)
+    finally:
+        ckpt.close()
+    verdict = {
+        "status": outcome.status,
+        "step": outcome.step,
+        "process": pid,
+        "num_processes": nprocs,
+        "resumed_from": resumed_from,
+        "quarantined": quarantined,
+        "emergency_saved": outcome.emergency_saved,
+    }
+    if outcome.status == "completed":
+        verdict["digest"] = _digest(state)
+    print(json.dumps(verdict), flush=True)
+    return 0 if outcome.status == "completed" else EXIT_PREEMPTED
+
+
+# ============================================================== supervisor
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosCase:
+    """One seeded (signal, kill-step) scenario."""
+
+    seed: int
+    kill_signal: str          # "SIGTERM" | "SIGKILL" | "" (no kill)
+    kill_step: int = 0
+    nprocs: int = 1
+    total_steps: int = 6
+    save_every: int = 1
+    kill_scope: str = "world"  # "world" | "one" (process 1 only)
+
+    def __post_init__(self):
+        if self.kill_signal not in ("", "SIGTERM", "SIGKILL"):
+            raise ValueError(f"unknown signal {self.kill_signal!r}")
+        if self.kill_scope not in ("world", "one"):
+            raise ValueError(f"unknown kill scope {self.kill_scope!r}")
+        if self.kill_scope == "one" and self.nprocs < 2:
+            raise ValueError("kill_scope='one' needs nprocs >= 2")
+
+
+_BOOTSTRAP = (
+    "import jax, sys;"
+    "jax.config.update('jax_platforms', 'cpu');"
+    "sys.path.insert(0, {root!r});"
+    "from nvidia_terraform_modules_tpu.smoketest.chaos import worker_main;"
+    "sys.exit(worker_main())"
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class Supervisor:
+    """Launch, observe, kill-arm, and restart the training world.
+
+    The restart loop treats EVERY non-zero exit as restartable — the
+    classified drain (75), the classified dead-peer (76), a raw SIGKILL
+    death, a checkpoint rendezvous timeout — because that is exactly the
+    Job controller's contract on GKE (``backoff_limit`` with the
+    disruption-exempt pod failure policy). A hard per-attempt wall-clock
+    bound converts any genuine hang into a failed attempt.
+    """
+
+    def __init__(self, case: ChaosCase, ckpt_dir: str,
+                 devices_per_proc: int = 2, max_restarts: int = 4,
+                 attempt_timeout_s: float = 240.0,
+                 on_restart=None):
+        self.case = case
+        self.ckpt_dir = ckpt_dir
+        self.devices_per_proc = devices_per_proc
+        self.max_restarts = max_restarts
+        self.attempt_timeout_s = attempt_timeout_s
+        # test hook: runs before each RESTART attempt (attempt >= 1) —
+        # the chaos tests use it to corrupt the newest checkpoint between
+        # death and resume, proving the quarantine path end to end
+        self.on_restart = on_restart
+
+    def _env(self, proc_id: int, attempt: int, port: int) -> dict:
+        c = self.case
+        env = dict(os.environ)
+        env.update(
+            XLA_FLAGS="--xla_force_host_platform_device_count="
+                      f"{self.devices_per_proc}",
+            JAX_PLATFORMS="cpu",
+            TPU_CHAOS_CKPT_DIR=self.ckpt_dir,
+            TPU_CHAOS_TOTAL_STEPS=str(c.total_steps),
+            TPU_CHAOS_SAVE_EVERY=str(c.save_every),
+            TPU_CHAOS_SEED=str(c.seed),
+            TPU_CHAOS_ATTEMPT=str(attempt),
+            # tight-but-safe supervision: heartbeats keep stamping from a
+            # timer thread during compiles, so staleness == death
+            TPU_HEARTBEAT_INTERVAL_S="0.5",
+            TPU_HEARTBEAT_TIMEOUT_S="8",
+            TPU_SMOKETEST_GRACE_SECONDS="60",
+            TPU_CHECKPOINT_SYNC_TIMEOUT_S="20",
+        )
+        if attempt == 0 and c.kill_signal:
+            env.update(
+                TPU_CHAOS_KILL_AT_STEP=str(c.kill_step),
+                TPU_CHAOS_KILL_SIGNAL=c.kill_signal,
+                TPU_CHAOS_KILL_PROCESS="1" if c.kill_scope == "one"
+                else "",
+            )
+        if c.nprocs > 1:
+            env.update(
+                TPU_SMOKETEST_HOSTS=str(c.nprocs),
+                JOB_COMPLETION_INDEX=str(proc_id),
+                TPU_SMOKETEST_COORDINATOR=f"localhost:{port}",
+                TPU_SMOKETEST_INIT_TIMEOUT="60",
+            )
+        return env
+
+    def _launch(self, attempt: int) -> list[subprocess.Popen]:
+        # liveness state belongs to ONE attempt: a dead worker's stale
+        # heartbeat surviving into the restart would let a peer's monitor
+        # re-classify it dead before it stamps its first beat
+        hbdir = os.path.join(self.ckpt_dir, "heartbeats")
+        if os.path.isdir(hbdir):
+            for name in os.listdir(hbdir):
+                with contextlib.suppress(OSError):
+                    os.remove(os.path.join(hbdir, name))
+        port = _free_port()
+        return [
+            subprocess.Popen(
+                [sys.executable, "-c",
+                 _BOOTSTRAP.format(root=_REPO_ROOT)],
+                env=self._env(i, attempt, port), cwd=_REPO_ROOT,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+            for i in range(self.case.nprocs)
+        ]
+
+    def run_to_completion(self) -> dict:
+        """Attempt/restart until every process completes; returns the
+        case report (final verdicts, per-attempt exits, journal)."""
+        attempts: list[dict] = []
+        for attempt in range(self.max_restarts + 1):
+            if attempt and self.on_restart is not None:
+                self.on_restart(attempt)
+            procs = self._launch(attempt)
+            results = []
+            deadline = time.monotonic() + self.attempt_timeout_s
+            hung = False
+            for p in procs:
+                budget = max(1.0, deadline - time.monotonic())
+                try:
+                    out, err = p.communicate(timeout=budget)
+                except subprocess.TimeoutExpired:
+                    hung = True
+                    p.kill()
+                    out, err = p.communicate()
+                results.append((p.returncode, out, err))
+            attempts.append({
+                "attempt": attempt,
+                "hung": hung,
+                "exits": [rc for rc, _, _ in results],
+            })
+            if hung:
+                raise ChaosInvariantError(
+                    f"attempt {attempt} exceeded the "
+                    f"{self.attempt_timeout_s:.0f}s wall-clock bound — "
+                    f"supervision failed to convert a hang into a "
+                    f"classified exit; stderr tails: "
+                    f"{[err[-500:] for _, _, err in results]}")
+            if all(rc == 0 for rc, _, _ in results):
+                return {
+                    "verdicts": [_last_json(out) for _, out, _ in results],
+                    "attempts": attempts,
+                    "journal": self._journal(),
+                    "quarantined": self._quarantined(),
+                }
+        raise ChaosInvariantError(
+            f"case {self.case} did not complete within "
+            f"{self.max_restarts + 1} attempts: {attempts}")
+
+    def _journal(self) -> list[dict]:
+        path = os.path.join(self.ckpt_dir, RESUME_JOURNAL)
+        if not os.path.isfile(path):
+            return []
+        with open(path) as fh:
+            return [json.loads(line) for line in fh if line.strip()]
+
+    def _quarantined(self) -> list[str]:
+        qdir = os.path.join(self.ckpt_dir, "quarantine")
+        return sorted(os.listdir(qdir)) if os.path.isdir(qdir) else []
+
+
+def _last_json(out: str) -> dict:
+    lines = [ln for ln in out.splitlines() if ln.startswith("{")]
+    if not lines:
+        raise ChaosInvariantError(f"worker emitted no JSON verdict: "
+                                  f"{out[-500:]!r}")
+    return json.loads(lines[-1])
+
+
+# ============================================================ invariants
+
+
+def run_case(case: ChaosCase, workdir: str,
+             devices_per_proc: int = 2) -> dict:
+    """Run one seeded case end to end and assert every invariant.
+
+    Three runs share nothing but the seed: an uninterrupted baseline, the
+    killed-and-resumed run, and a replay of the killed run in a fresh
+    directory. Raises :class:`ChaosInvariantError` on any violation;
+    returns the full report for logging.
+    """
+    def run(tag: str, c: ChaosCase) -> dict:
+        d = os.path.join(workdir, tag)
+        os.makedirs(d, exist_ok=True)
+        return Supervisor(c, d, devices_per_proc=devices_per_proc
+                          ).run_to_completion()
+
+    baseline = run("baseline", dataclasses.replace(
+        case, kill_signal="", kill_step=0))
+    killed = run("killed", case)
+    replay = run("replay", case)
+
+    def digests(report: dict) -> dict[int, str]:
+        return {v["process"]: v["digest"] for v in report["verdicts"]}
+
+    def steps(report: dict) -> set[int]:
+        return {v["step"] for v in report["verdicts"]}
+
+    # exact step count, everywhere
+    for tag, rep in (("baseline", baseline), ("killed", killed),
+                     ("replay", replay)):
+        if steps(rep) != {case.total_steps}:
+            raise ChaosInvariantError(
+                f"{tag}: final step {steps(rep)} != configured "
+                f"{case.total_steps}")
+
+    # bit-exact final params + opt state vs the uninterrupted run
+    if digests(killed) != digests(baseline):
+        raise ChaosInvariantError(
+            f"killed run diverged from uninterrupted baseline: "
+            f"{digests(killed)} vs {digests(baseline)}")
+
+    # no quarantined checkpoint is ever restored
+    for rep in (baseline, killed, replay):
+        for entry in rep["journal"]:
+            resumed = entry.get("resumed_from")
+            if resumed is None:
+                continue
+            bad = [q for q in entry.get("quarantined", [])
+                   if q.startswith(f"step_{resumed:08d}")]
+            if bad:
+                raise ChaosInvariantError(
+                    f"attempt {entry['attempt']} restored step {resumed} "
+                    f"which sits in quarantine: {bad}")
+
+    # deterministic replay: identical resume trajectory AND final bytes
+    def trajectory(report: dict) -> list:
+        return sorted(
+            (e["attempt"], e["process"], e["resumed_from"])
+            for e in report["journal"])
+
+    if trajectory(replay) != trajectory(killed):
+        raise ChaosInvariantError(
+            f"replay resume trajectory diverged: {trajectory(replay)} "
+            f"vs {trajectory(killed)}")
+    if digests(replay) != digests(killed):
+        raise ChaosInvariantError(
+            f"replay final digests diverged: {digests(replay)} vs "
+            f"{digests(killed)}")
+
+    kills = 1 if case.kill_signal else 0
+    return {
+        "case": dataclasses.asdict(case),
+        "attempts": {"baseline": len(baseline["attempts"]),
+                     "killed": len(killed["attempts"]),
+                     "replay": len(replay["attempts"])},
+        "kills": kills,
+        "digest": sorted(digests(killed).items()),
+        "quarantined": killed["quarantined"],
+        "converged": True,
+    }
+
+
+# ===================================================================== CLI
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m nvidia_terraform_modules_tpu.smoketest.chaos",
+        description="kill-and-resume chaos sweep over the supervised "
+                    "training runtime")
+    ap.add_argument("-seeds", type=int, default=2)
+    ap.add_argument("-steps", type=int, default=6)
+    ap.add_argument("-kill-steps", default="2,4", dest="kill_steps")
+    ap.add_argument("-signals", default="SIGTERM,SIGKILL")
+    ap.add_argument("-nprocs", type=int, default=1, choices=(1, 2))
+    ap.add_argument("-save-every", type=int, default=1, dest="save_every")
+    ap.add_argument("-json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    cases = [
+        ChaosCase(seed=s, kill_signal=sig, kill_step=k,
+                  nprocs=args.nprocs, total_steps=args.steps,
+                  save_every=args.save_every)
+        for s in range(args.seeds)
+        for sig in args.signals.split(",")
+        for k in (int(x) for x in args.kill_steps.split(","))
+    ]
+    ok = 0
+    for case in cases:
+        with tempfile.TemporaryDirectory(prefix="chaos_") as workdir:
+            report = run_case(case, workdir)
+        ok += 1
+        if args.as_json:
+            print(json.dumps(report), flush=True)
+        else:
+            print(f"chaos: seed={case.seed} {case.kill_signal}@"
+                  f"{case.kill_step} nprocs={case.nprocs}: exact resume "
+                  f"ok ({report['attempts']['killed']} attempt(s))",
+                  flush=True)
+    print(f"chaos: {ok}/{len(cases)} case(s) resumed exactly", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -144,9 +144,12 @@ def run_smoketest(
     if level in ("burnin", "full") and ok:
         from ..models import (
             BurnInConfig,
+            CheckpointError,
             Checkpointer,
+            SupervisedLoop,
             init_params,
             make_train_step,
+            resilience_from_env,
             synthetic_batch,
         )
 
@@ -159,25 +162,37 @@ def run_smoketest(
         # must continue from its last checkpoint, not start over (the module
         # provisions spot slices first-class — gke-tpu/tpu_slices.tf; the
         # Job wires a PVC mount or gs:// prefix via smoketest.checkpoint_dir).
-        # Every step checkpoints; a SUCCESSFUL run clears the directory so
-        # the next fresh Job starts at step 0 instead of inheriting a
-        # finished run's count. Checkpoint I/O failure fails the suite
-        # through the JSON contract (never a bare traceback): a broken
-        # resume path on spot capacity is an operational bug.
+        # The loop runs SUPERVISED (models/resilience.py): every step
+        # checkpoints durably, a SIGTERM/preemption notice drains the
+        # in-flight step and commits an emergency checkpoint inside the
+        # grace budget (TPU_SMOKETEST_GRACE_SECONDS), heartbeat files
+        # turn a dead peer's collective hang into a classified failure,
+        # and a corrupt/truncated checkpoint is quarantined (reported in
+        # checkpoint_quarantined) with restore falling back to the
+        # newest valid step. A SUCCESSFUL run clears the directory so
+        # the next fresh Job starts at step 0. Checkpoint I/O failure
+        # still fails the suite through the JSON contract (never a bare
+        # traceback): a broken resume path on spot capacity is an
+        # operational bug.
         ckpt_dir = e.get("TPU_SMOKETEST_CHECKPOINT_DIR")
         ckpt = Checkpointer(ckpt_dir) if ckpt_dir else None
+        rcfg = resilience_from_env(e)
         global_step = 0
         params = None
         try:
             if ckpt is not None:
                 try:
                     restored = ckpt.restore(cfg, rules)
-                except Exception as exc:  # orbax raises many types;
-                    #                       the JSON contract > the type
+                except Exception as exc:  # storage-level failures only:
+                    #  corruption falls back inside restore; the JSON
+                    #  contract > the exception type
                     checks["burnin_checkpoint_ok"] = False
                     checks["checkpoint_error"] = f"restore: {exc}"
                     return SmokeResult(
                         False, checks, time.perf_counter() - t0)
+                quarantined = ckpt.quarantined()
+                if quarantined:
+                    checks["checkpoint_quarantined"] = len(quarantined)
                 if restored is not None:
                     params, global_step, _meta = restored
                     checks["burnin_resumed_step"] = global_step
@@ -186,19 +201,48 @@ def run_smoketest(
             step = make_train_step(cfg, rules)
             batch = synthetic_batch(jax.random.PRNGKey(1), cfg, rules)
             losses = []
-            for _ in range(5):
-                params, loss = step(params, batch)
+
+            def one_step(p, _step_no):
+                p, loss = step(p, batch)
                 losses.append(float(loss))
-                global_step += 1
-                if ckpt is not None:
-                    try:
-                        ckpt.save(global_step, params,
-                                  meta={"last_loss": losses[-1]})
-                    except Exception as exc:
-                        checks["burnin_checkpoint_ok"] = False
-                        checks["checkpoint_error"] = f"save: {exc}"
-                        ok = False
-                        break
+                return p
+
+            # gs://… checkpoint prefixes have no filesystem for
+            # heartbeat files — checkpoint.py owns the predicate
+            from ..models.checkpoint import _is_remote
+
+            loop = SupervisedLoop(
+                ckpt, rcfg,
+                total_steps=global_step + 5,
+                process_id=job.process_id if job else 0,
+                num_processes=job.num_processes if job else 1,
+                heartbeat_dir=ckpt_dir if ckpt_dir and
+                not _is_remote(ckpt_dir) else None,
+            )
+            try:
+                params, outcome = loop.run(
+                    params, one_step, start_step=global_step,
+                    meta=lambda s, _p: {"last_loss": losses[-1]})
+            except (CheckpointError, OSError) as exc:
+                # storage-layer failures only (unwritable PVC, bounded
+                # rendezvous timeout): a broken resume path is an
+                # operational bug, reported as such. Train-step/XLA
+                # errors propagate — blaming them on the checkpoint
+                # engine would send the operator down the wrong path.
+                checks["burnin_checkpoint_ok"] = False
+                checks["checkpoint_error"] = f"save: {exc}"
+                checks["burnin_step"] = global_step + len(losses)
+                return SmokeResult(False, checks, time.perf_counter() - t0)
+            if outcome is not None:
+                global_step = outcome.step
+                if outcome.status == "preempted":
+                    # drained + emergency checkpoint committed: the Job
+                    # controller restarts the pod and the next attempt
+                    # resumes — report the classified state, not success
+                    checks["burnin_preempted"] = global_step
+                    checks["burnin_ok"] = False
+                    return SmokeResult(
+                        False, checks, time.perf_counter() - t0)
             if ckpt is not None and ok:
                 checks["burnin_checkpoint_saved"] = global_step
             checks["burnin_first_loss"] = round(losses[0], 4)

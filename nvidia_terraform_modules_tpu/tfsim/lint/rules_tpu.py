@@ -31,6 +31,7 @@ class SliceDecl:
     topology: object
     prefer_single_host: object
     origin: str            # "tfvars" | "module call" | "variable default"
+    spot: object = None    # resolved literal or None
 
 
 def _object_items(expr):
@@ -86,6 +87,7 @@ def _decls_from_object(ctx, fname, expr, origin, defaults=None):
             topology=field(fields, "topology"),
             prefer_single_host=field(fields, "prefer_single_host"),
             origin=origin,
+            spot=field(fields, "spot"),
         ))
     return out
 
@@ -289,19 +291,9 @@ def check_pool_arithmetic(ctx: LintContext):
                        f"slice is atomic, the pool must match it")
 
 
-@rule("tpu-spot-no-recovery", severity="warning", family="tpu",
-      summary="spot/preemptible TPU pool with no timeouts block or "
-              "lifecycle guard")
-def check_spot_no_recovery(ctx: LintContext):
-    """Preemptible TPU capacity is exactly where mid-apply faults land:
-    a spot slice can be reclaimed while the pool is still creating, and
-    the retry loop then runs until the operation's ``timeouts`` budget —
-    the *provider default* budget if the config declares none, which is
-    rarely what an operator sizing for TPU stockout churn wants. A pool
-    that opts into preemptible capacity without a ``timeouts {}`` block
-    or a ``lifecycle {}`` guard (``create_before_destroy`` keeps serving
-    capacity while the replacement assembles) has no recovery posture at
-    all."""
+def _spot_tpu_pools(ctx: LintContext):
+    """``(resource, "spot"|"preemptible")`` for every node pool that
+    statically opts into preemptible TPU capacity."""
     for r in ctx.mod.resources.values():
         if r.type != "google_container_node_pool":
             continue
@@ -320,17 +312,142 @@ def check_spot_no_recovery(ctx: LintContext):
             is_tpu = any(
                 pbody is not None and pbody.attr("tpu_topology") is not None
                 for _blk, pbody in _placement_blocks(r.body))
-        if not is_tpu:
-            continue
+        if is_tpu:
+            yield r, ("spot" if spot is True else "preemptible")
+
+
+@rule("tpu-spot-no-recovery", severity="warning", family="tpu",
+      summary="spot/preemptible TPU pool with no timeouts block or "
+              "lifecycle guard")
+def check_spot_no_recovery(ctx: LintContext):
+    """Preemptible TPU capacity is exactly where mid-apply faults land:
+    a spot slice can be reclaimed while the pool is still creating, and
+    the retry loop then runs until the operation's ``timeouts`` budget —
+    the *provider default* budget if the config declares none, which is
+    rarely what an operator sizing for TPU stockout churn wants. A pool
+    that opts into preemptible capacity without a ``timeouts {}`` block
+    or a ``lifecycle {}`` guard (``create_before_destroy`` keeps serving
+    capacity while the replacement assembles) has no recovery posture at
+    all. (The *workload*-side counterpart is ``tpu-spot-no-grace``: the
+    pods on these pools need a termination grace period big enough for
+    the emergency-checkpoint drain.)"""
+    for r, flag in _spot_tpu_pools(ctx):
         if r.body.blocks_of("timeouts") or r.body.blocks_of("lifecycle"):
             continue
-        flag = "spot" if spot is True else "preemptible"
         yield (f"{r.file}:{r.line}",
                f"{r.address}: {flag} TPU capacity with no timeouts block "
                f"or lifecycle guard — preemption lands mid-apply; declare "
                f"timeouts {{ create/delete }} sized to your capacity "
                f"churn (and consider lifecycle.create_before_destroy) so "
                f"an interrupted apply resumes instead of wedging")
+
+
+# the kubernetes workload types carrying a pod template (hops from the
+# resource's spec block down to the POD spec), plus the bare pod
+_POD_TEMPLATE_TYPES = {
+    "kubernetes_job_v1": ("template",),
+    "kubernetes_cron_job_v1": ("job_template", "template"),
+    "kubernetes_deployment_v1": ("template",),
+    "kubernetes_stateful_set_v1": ("template",),
+    "kubernetes_daemon_set_v1": ("template",),
+    "kubernetes_pod_v1": (),
+}
+
+# the floor for spot TPU workloads: kubernetes' default 30s equals the
+# default emergency-checkpoint budget (ResilienceConfig.grace_seconds)
+# with ZERO headroom for the drain itself — require real headroom
+_GRACE_FLOOR_S = 60
+
+
+def _pod_specs(r):
+    for spec in r.body.blocks_of("spec"):
+        body = spec.body
+        for hop in _POD_TEMPLATE_TYPES[r.type]:
+            tmpl = body.blocks_of(hop)
+            if not tmpl:
+                body = None
+                break
+            inner = tmpl[0].body.blocks_of("spec")
+            if not inner:
+                body = None
+                break
+            body = inner[0].body
+        if body is not None:
+            yield body
+
+
+def _schedules_on_tpu(ctx: LintContext, pod) -> bool:
+    sel = pod.attr("node_selector")
+    if sel is not None and isinstance(sel.expr, A.ObjectExpr):
+        for key, _value, _item in _object_items(sel.expr):
+            if key.startswith("cloud.google.com/gke-tpu"):
+                return True
+    for tol in pod.blocks_of("toleration"):
+        if _literal(ctx, tol.body.attr("key")) == "google.com/tpu":
+            return True
+    for c in pod.blocks_of("container"):
+        for res in c.body.blocks_of("resources"):
+            for which in ("requests", "limits"):
+                a = res.body.attr(which)
+                if a is not None and isinstance(a.expr, A.ObjectExpr):
+                    for key, _value, _item in _object_items(a.expr):
+                        if key == "google.com/tpu":
+                            return True
+    return False
+
+
+@rule("tpu-spot-no-grace", severity="warning", family="tpu",
+      summary="TPU workload on spot capacity without a termination "
+              "grace period covering the emergency-checkpoint budget")
+def check_spot_no_grace(ctx: LintContext):
+    """The pool-side recovery posture (``tpu-spot-no-recovery``) has a
+    workload-side twin: when a spot slice is reclaimed, Kubernetes
+    SIGTERMs every pod and waits ``termination_grace_period_seconds``
+    (default **30s**) before SIGKILL. The supervised train loop
+    (``models/resilience.py``) uses that window to drain the in-flight
+    step and commit an emergency checkpoint — 30s is exactly the default
+    emergency budget (``TPU_SMOKETEST_GRACE_SECONDS``) with zero drain
+    headroom, so a pod spec that leaves the default (or sets less than
+    ~2× the budget) loses the step it was promised to keep. Fires only
+    when the module statically provisions spot/preemptible TPU capacity
+    AND a kubernetes workload schedules onto TPU nodes."""
+    spot_origin = None
+    for r, flag in _spot_tpu_pools(ctx):
+        spot_origin = f"{r.address} ({flag})"
+        break
+    if spot_origin is None:
+        for d in slice_declarations(ctx):
+            if d.spot is True:
+                spot_origin = f"tpu_slices[{d.name!r}] ({d.origin}, spot)"
+                break
+    if spot_origin is None:
+        return
+    for r in ctx.mod.resources.values():
+        if r.type not in _POD_TEMPLATE_TYPES:
+            continue
+        for pod in _pod_specs(r):
+            if not _schedules_on_tpu(ctx, pod):
+                continue
+            attr = pod.attr("termination_grace_period_seconds")
+            if attr is None:
+                yield (f"{r.file}:{r.line}",
+                       f"{r.address}: schedules onto TPU nodes while "
+                       f"{spot_origin} provisions preemptible capacity, "
+                       f"but declares no termination_grace_period_seconds "
+                       f"— the kubernetes default (30s) equals the "
+                       f"default emergency-checkpoint budget with no "
+                       f"drain headroom; set >= {_GRACE_FLOOR_S}s, above "
+                       f"TPU_SMOKETEST_GRACE_SECONDS")
+                continue
+            grace = ctx.resolve_literal(attr.expr)
+            if isinstance(grace, (int, float)) and grace < _GRACE_FLOOR_S:
+                yield (f"{r.file}:{attr.line or r.line}",
+                       f"{r.address}: termination_grace_period_seconds = "
+                       f"{grace:g} is below the {_GRACE_FLOOR_S}s floor "
+                       f"for spot TPU workloads ({spot_origin}) — the "
+                       f"SIGTERM drain plus the emergency checkpoint "
+                       f"(TPU_SMOKETEST_GRACE_SECONDS, default 30s) "
+                       f"needs the full window")
 
 
 @rule("tpu-multihost-placement", severity="error", family="tpu",

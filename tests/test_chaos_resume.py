@@ -1,0 +1,174 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Kill-and-resume chaos gate: the training-stack mirror of the
+``tfsim chaos`` convergence gate in tests/test_tfsim_faults.py, layered
+the same way — ONE seeded kill-and-resume case plus the checkpoint-
+corruption path stay tier-1; the full seeds × signal × kill-step × world
+matrix (including the 2-process gloo worlds and the dead-peer
+classification) is slow-marked.
+
+Every case asserts the exact-resume invariants inside
+``smoketest.chaos.run_case``: final params/opt-state bit-match an
+uninterrupted run (comfortably inside the ulp-tolerance bar), the step
+count is exact, no quarantined checkpoint is ever restored, and repeated
+kill-at-step-k replays are deterministic.
+"""
+
+import glob
+import os
+
+import pytest
+
+from nvidia_terraform_modules_tpu.smoketest.chaos import (
+    ChaosCase,
+    ChaosInvariantError,
+    Supervisor,
+    run_case,
+)
+
+
+def test_chaos_case_validation():
+    with pytest.raises(ValueError):
+        ChaosCase(seed=0, kill_signal="SIGSTOP")
+    with pytest.raises(ValueError):
+        ChaosCase(seed=0, kill_signal="SIGKILL", kill_scope="one", nprocs=1)
+    with pytest.raises(ValueError):
+        ChaosCase(seed=0, kill_signal="SIGKILL", kill_scope="some")
+
+
+def test_seeded_sigkill_resume_exact_tier1(tmp_path):
+    """THE acceptance gate, tier-1: a seeded SIGKILL at step 3 of 6, the
+    supervisor restarts, and the resumed run reaches the uninterrupted
+    run's final params/opt-state exactly, with exact step count and a
+    deterministic replay."""
+    report = run_case(
+        ChaosCase(seed=0, kill_signal="SIGKILL", kill_step=3,
+                  nprocs=1, total_steps=6),
+        str(tmp_path))
+    assert report["converged"] is True
+    assert report["attempts"]["killed"] == 2   # death + one resume
+    assert report["attempts"]["baseline"] == 1
+    assert report["quarantined"] == []         # clean kill: no bad bytes
+
+
+def test_corrupted_newest_checkpoint_quarantined_on_resume_tier1(tmp_path):
+    """Tier-1 corruption leg of the gate: the checkpoint that would be
+    resumed is truncated between death and restart. The engine must
+    quarantine it, resume from the step before, and STILL reach the
+    uninterrupted run's final state — and the journal must prove the
+    quarantined step was never restored."""
+    case = ChaosCase(seed=1, kill_signal="SIGKILL", kill_step=4,
+                     nprocs=1, total_steps=6)
+    baseline_dir = tmp_path / "baseline"
+    killed_dir = tmp_path / "killed"
+    baseline = Supervisor(
+        ChaosCase(seed=1, kill_signal="", nprocs=1, total_steps=6),
+        str(baseline_dir)).run_to_completion()
+
+    def corrupt_newest(attempt):
+        if attempt != 1:
+            return
+        shards = sorted(glob.glob(
+            str(killed_dir / "step_*" / "shards_p*.bin")))
+        newest = shards[-1]
+        with open(newest, "r+b") as fh:
+            fh.truncate(8)
+
+    killed = Supervisor(case, str(killed_dir),
+                        on_restart=corrupt_newest).run_to_completion()
+
+    # exact final state despite losing the newest checkpoint to rot
+    assert {v["digest"] for v in killed["verdicts"]} == \
+        {v["digest"] for v in baseline["verdicts"]}
+    assert {v["step"] for v in killed["verdicts"]} == {6}
+    # step 3 (the newest commit at death) was quarantined, resume came
+    # from step 2, and no journal entry ever restored a quarantined step
+    assert any(q.startswith("step_00000003") for q in killed["quarantined"])
+    resumes = [e["resumed_from"] for e in killed["journal"]
+               if e["attempt"] == 1]
+    assert resumes == [2]
+    for entry in killed["journal"]:
+        r = entry.get("resumed_from")
+        if r is not None:
+            assert not any(
+                q.startswith(f"step_{r:08d}")
+                for q in entry.get("quarantined", []))
+
+
+def test_invariant_violation_is_loud(tmp_path):
+    """The gate must FAIL when the invariant fails: a case whose killed
+    run cannot complete inside the restart budget raises, it does not
+    return a green report."""
+    case = ChaosCase(seed=0, kill_signal="SIGKILL", kill_step=1,
+                     nprocs=1, total_steps=3)
+    sup = Supervisor(case, str(tmp_path), max_restarts=0)
+    with pytest.raises(ChaosInvariantError):
+        sup.run_to_completion()
+
+
+# ----------------------------------------------------------- slow matrix
+
+_MATRIX = [
+    ChaosCase(seed=s, kill_signal=sig, kill_step=k, nprocs=1,
+              total_steps=6)
+    for s in (0, 1)
+    for sig in ("SIGTERM", "SIGKILL")
+    for k in (2, 5)
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "case", _MATRIX,
+    ids=[f"seed{c.seed}-{c.kill_signal}@{c.kill_step}" for c in _MATRIX])
+def test_kill_matrix_single_process(case, tmp_path):
+    report = run_case(case, str(tmp_path))
+    assert report["converged"] is True
+
+
+@pytest.mark.slow
+def test_sigterm_drain_with_sparse_saves(tmp_path):
+    """save_every=3 + SIGTERM at a non-multiple step: the drain's
+    emergency checkpoint carries the killed step, so the resume loses
+    nothing even between cadence saves."""
+    report = run_case(
+        ChaosCase(seed=4, kill_signal="SIGTERM", kill_step=4,
+                  nprocs=1, total_steps=6, save_every=3),
+        str(tmp_path))
+    assert report["converged"] is True
+    assert report["attempts"]["killed"] == 2
+
+
+@pytest.mark.slow
+def test_two_process_world_sigterm(tmp_path):
+    """The 2-process gloo world: a whole-slice preemption (both workers
+    SIGTERMed at the same step — exactly how GKE reclaims a spot slice)
+    drains, emergency-saves collectively, and resumes exactly."""
+    report = run_case(
+        ChaosCase(seed=2, kill_signal="SIGTERM", kill_step=3,
+                  nprocs=2, total_steps=6),
+        str(tmp_path))
+    assert report["converged"] is True
+
+
+@pytest.mark.slow
+def test_two_process_sigkill_one_peer_dead_classified(tmp_path):
+    """Kill ONE worker of two with SIGKILL: the survivor's heartbeat
+    monitor must convert its collective hang into the classified
+    EXIT_PEER_DEAD (never an indefinite gloo wait), and the restarted
+    world must still resume exactly."""
+    report = run_case(
+        ChaosCase(seed=3, kill_signal="SIGKILL", kill_step=3,
+                  nprocs=2, total_steps=6, kill_scope="one"),
+        str(tmp_path))
+    assert report["converged"] is True
+
+
+@pytest.mark.slow
+def test_chaos_cli_smoke(tmp_path):
+    """The CLI sweep drives the same gate (1 seed × 1 signal × 1 step
+    to keep the smoke cheap)."""
+    from nvidia_terraform_modules_tpu.smoketest.chaos import main
+
+    assert main(["-seeds", "1", "-steps", "5", "-kill-steps", "2",
+                 "-signals", "SIGKILL"]) == 0

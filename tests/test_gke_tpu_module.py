@@ -418,3 +418,32 @@ def test_smoketest_deadline_matches_apply_gate(tpu_mod):
     deadline = job.attrs["spec"][0]["active_deadline_seconds"]
     assert deadline == 1320  # 1200 + 60 × 2 hosts
     assert job.attrs["timeouts"][0]["create"] == f"{deadline}s"
+
+
+def test_smoketest_grace_period_wiring(tpu_mod):
+    """Preemption drain wiring: the pod declares the termination grace
+    window, checkpointing additionally wires the emergency-save budget
+    (half the grace — drain headroom) into the payload env, and the
+    plan-time validation rejects a window below the tpu-spot-no-grace
+    floor."""
+    plan = simulate_plan(tpu_mod, dict(BASE))
+    job = plan.instance('kubernetes_job_v1.tpu_smoketest["default"]')
+    pod = job.attrs["spec"][0]["template"][0]["spec"][0]
+    assert pod["termination_grace_period_seconds"] == 120
+    env = {e["name"]: e["value"] for e in pod["container"][0]["env"]}
+    assert "TPU_SMOKETEST_GRACE_SECONDS" not in env   # no resume state
+
+    plan = simulate_plan(tpu_mod, {
+        **BASE,
+        "smoketest": {"checkpoint_dir": "/ckpt",
+                      "checkpoint_pvc": "smoketest-ckpt",
+                      "grace_period_seconds": 300}})
+    job = plan.instance('kubernetes_job_v1.tpu_smoketest["default"]')
+    pod = job.attrs["spec"][0]["template"][0]["spec"][0]
+    assert pod["termination_grace_period_seconds"] == 300
+    env = {e["name"]: e["value"] for e in pod["container"][0]["env"]}
+    assert env["TPU_SMOKETEST_GRACE_SECONDS"] == "150"
+
+    with pytest.raises(PlanError, match="grace_period_seconds"):
+        simulate_plan(tpu_mod, {
+            **BASE, "smoketest": {"grace_period_seconds": 30}})

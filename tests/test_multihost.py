@@ -51,3 +51,34 @@ def test_tpu_worker_hostnames_fallback():
 def test_missing_coordinator_raises():
     with pytest.raises(RuntimeError):
         job_env_from_environ({"TPU_SMOKETEST_HOSTS": "2"})
+
+
+def test_unreachable_coordinator_is_bounded_and_classified():
+    """A peer that can never reach pod 0 must fail as a classified
+    DistributedInitError inside the init budget — not sit inside jax's
+    client until the outer suite timeout kills it. In-process safe: the
+    pre-flight TCP probe fails before jax.distributed is ever touched."""
+    import time
+
+    from nvidia_terraform_modules_tpu.parallel import DistributedInitError
+    from nvidia_terraform_modules_tpu.parallel.multihost import (
+        maybe_initialize_distributed,
+    )
+
+    env = {
+        "TPU_SMOKETEST_HOSTS": "2",
+        "JOB_COMPLETION_INDEX": "1",
+        # a port nothing listens on: connection refused, immediately
+        "TPU_SMOKETEST_COORDINATOR": "localhost:9",
+        "TPU_SMOKETEST_INIT_TIMEOUT": "20",
+        "TPU_SMOKETEST_INIT_PREFLIGHT": "6",
+    }
+    t0 = time.monotonic()
+    with pytest.raises(DistributedInitError) as ei:
+        maybe_initialize_distributed(env)
+    assert time.monotonic() - t0 < 20
+    msg = str(ei.value)
+    assert "process 1/2" in msg
+    assert "localhost:9" in msg
+    assert "attempt(s)" in msg          # the retry policy ran
+    assert "headless Service" in msg    # operator-actionable diagnostic

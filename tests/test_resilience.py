@@ -1,0 +1,326 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""The preemption-tolerant runtime's building blocks (models/resilience.py,
+utils/retry.py): retry policy shapes, the SIGTERM drain, heartbeat
+liveness classification, and the supervised loop's checkpoint cadence.
+The end-to-end kill-and-resume story lives in tests/test_chaos_resume.py;
+these tests pin each mechanism in isolation so a harness failure there
+points at composition, not primitives.
+"""
+
+import json
+import os
+import random
+import signal
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nvidia_terraform_modules_tpu.models import (
+    Checkpointer,
+    Heartbeat,
+    HeartbeatMonitor,
+    PeerFailure,
+    PreemptionGuard,
+    ResilienceConfig,
+    SupervisedLoop,
+    resilience_from_env,
+)
+from nvidia_terraform_modules_tpu.utils.retry import (
+    RetriesExhausted,
+    RetryPolicy,
+    retry_call,
+)
+
+# ================================================================== retry
+
+
+def test_retry_policy_deterministic_schedule_without_jitter():
+    """jitter=False reproduces the tfsim control-plane shape exactly:
+    1 → 2 → 4 → … capped at cap_s."""
+    p = RetryPolicy(initial_s=1.0, multiplier=2.0, cap_s=5.0,
+                    max_attempts=6, jitter=False)
+    assert list(p.delays()) == [1.0, 2.0, 4.0, 5.0, 5.0]
+
+
+def test_retry_policy_jitter_bounded_and_seedable():
+    p = RetryPolicy(initial_s=2.0, multiplier=2.0, cap_s=6.0,
+                    max_attempts=5, jitter=True)
+    a = list(p.delays(random.Random(7)))
+    b = list(p.delays(random.Random(7)))
+    assert a == b                       # seedable
+    caps = [2.0, 4.0, 6.0, 6.0]
+    assert all(0.0 <= d <= cap for d, cap in zip(a, caps))
+
+
+def test_retry_call_retries_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    slept = []
+    out = retry_call(flaky,
+                     policy=RetryPolicy(max_attempts=3, jitter=False,
+                                        initial_s=0.01, cap_s=0.02),
+                     retryable=(OSError,), sleep=slept.append)
+    assert out == "ok" and len(calls) == 3 and len(slept) == 2
+
+
+def test_retry_call_exhaustion_is_classified():
+    with pytest.raises(RetriesExhausted) as ei:
+        retry_call(lambda: (_ for _ in ()).throw(OSError("gone")),
+                   policy=RetryPolicy(max_attempts=2, jitter=False,
+                                      initial_s=0.0),
+                   what="read manifest", retryable=(OSError,),
+                   sleep=lambda _s: None)
+    assert ei.value.attempts == 2
+    assert "read manifest" in str(ei.value)
+    assert isinstance(ei.value.last, OSError)
+
+
+def test_retry_call_terminal_errors_fail_fast():
+    """Non-retryable exceptions must propagate on the FIRST attempt —
+    the retryable-vs-terminal split the simulator enforces."""
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise ValueError("terminal")
+
+    with pytest.raises(ValueError):
+        retry_call(boom, policy=RetryPolicy(max_attempts=5),
+                   retryable=(OSError,), sleep=lambda _s: None)
+    assert len(calls) == 1
+
+
+# ============================================================== preemption
+
+
+def test_preemption_guard_drains_not_dies():
+    """SIGTERM inside the guard sets the flag (the loop drains); the
+    previous disposition comes back on exit."""
+    before = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard(grace_seconds=30.0) as guard:
+        assert guard.installed and not guard.preempted
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert guard.preempted
+        assert 0.0 < guard.remaining_s <= 30.0
+        # a repeated notice (kubernetes re-signals) must not reset the
+        # deadline or kill the drain
+        first_remaining = guard.remaining_s
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert guard.preempted
+        assert guard.remaining_s <= first_remaining + 1e-3
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_preemption_guard_remaining_budget_decays():
+    with PreemptionGuard(grace_seconds=0.2) as guard:
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(0.25)
+        assert guard.remaining_s == 0.0
+
+
+# ================================================================ liveness
+
+
+def test_heartbeat_stamps_step_and_monitor_reads_it(tmp_path):
+    hb = Heartbeat(str(tmp_path), process_id=1, interval_s=0.05)
+    with hb:
+        hb.beat(7)
+        mon = HeartbeatMonitor(str(tmp_path), num_processes=2,
+                               timeout_s=5.0, self_id=0)
+        seen = mon.read()
+        assert seen[1]["step"] == 7 and seen[1]["pid"] == os.getpid()
+        assert mon.check() == []        # fresh heartbeat: everyone lives
+
+
+def test_monitor_classifies_stale_peer(tmp_path):
+    """A peer whose heartbeat goes stale AFTER being seen alive is a
+    classified PeerFailure carrying process id, staleness, and last-seen
+    step — the bounded replacement for an indefinite collective hang."""
+    hbdir = tmp_path / "heartbeats"
+    hbdir.mkdir()
+    beat = hbdir / "p00001.json"
+    mon = HeartbeatMonitor(str(tmp_path), num_processes=2,
+                           timeout_s=10.0, self_id=0)
+    beat.write_text(json.dumps(
+        {"process": 1, "step": 41, "time": time.time()}))
+    assert mon.check() == []           # alive: armed, not classified
+    beat.write_text(json.dumps(        # the peer dies; its clock stops
+        {"process": 1, "step": 41, "time": time.time() - 120.0}))
+    failures = mon.check()
+    assert len(failures) == 1
+    f = failures[0]
+    assert isinstance(f, PeerFailure)
+    assert f.process == 1 and f.last_step == 41 and f.age_s > 100
+    assert "dead peer" in str(f)
+
+
+def test_monitor_ignores_heartbeats_from_a_previous_attempt(tmp_path):
+    """A stale heartbeat file surviving pod replacement on the shared
+    checkpoint PVC must NOT classify a slow-to-restart peer as dead —
+    only heartbeats stamped within this monitor's lifetime arm."""
+    hbdir = tmp_path / "heartbeats"
+    hbdir.mkdir()
+    (hbdir / "p00001.json").write_text(json.dumps(
+        {"process": 1, "step": 41, "time": time.time() - 300.0}))
+    mon = HeartbeatMonitor(str(tmp_path), num_processes=2,
+                           timeout_s=10.0, self_id=0)
+    assert mon.check() == []           # pre-existing file: never armed
+    # the peer finally comes up and stamps: arms, lives
+    (hbdir / "p00001.json").write_text(json.dumps(
+        {"process": 1, "step": 41, "time": time.time()}))
+    assert mon.check() == []
+
+
+def test_monitor_never_arms_absent_peers(tmp_path):
+    """A peer that never heartbeat is the INIT timeout's failure, not a
+    liveness one — absent files must not classify as dead."""
+    mon = HeartbeatMonitor(str(tmp_path), num_processes=4, timeout_s=0.01,
+                           self_id=0)
+    assert mon.check() == []
+
+
+def test_monitor_excludes_self(tmp_path):
+    hbdir = tmp_path / "heartbeats"
+    hbdir.mkdir()
+    mon = HeartbeatMonitor(str(tmp_path), num_processes=1, timeout_s=1.0,
+                           self_id=0)
+    (hbdir / "p00000.json").write_text(json.dumps(
+        {"process": 0, "step": 1, "time": time.time()}))
+    assert mon.check() == []           # armed…
+    (hbdir / "p00000.json").write_text(json.dumps(
+        {"process": 0, "step": 1, "time": time.time() - 999.0}))
+    assert mon.check() == []           # …but self is never classified
+
+
+def test_monitor_watch_invokes_callback(tmp_path):
+    hbdir = tmp_path / "heartbeats"
+    hbdir.mkdir()
+    got = []
+    mon = HeartbeatMonitor(str(tmp_path), num_processes=2, timeout_s=1.0,
+                           self_id=0)
+    # seen alive within the monitor's lifetime, then the clock stops
+    (hbdir / "p00001.json").write_text(json.dumps(
+        {"process": 1, "step": 3, "time": time.time()}))
+    assert mon.check() == []
+    (hbdir / "p00001.json").write_text(json.dumps(
+        {"process": 1, "step": 3, "time": time.time() - 60.0}))
+    mon.watch(got.append, interval_s=0.02)
+    try:
+        deadline = time.monotonic() + 5.0
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.02)
+    finally:
+        mon.stop()
+    assert got and got[0].process == 1
+
+
+# ================================================================== config
+
+
+def test_resilience_config_from_env_and_validation():
+    cfg = resilience_from_env({
+        "TPU_SMOKETEST_GRACE_SECONDS": "12.5",
+        "TPU_HEARTBEAT_INTERVAL_S": "0.5",
+        "TPU_HEARTBEAT_TIMEOUT_S": "9",
+    })
+    assert cfg.grace_seconds == 12.5
+    assert cfg.heartbeat_interval_s == 0.5
+    assert cfg.heartbeat_timeout_s == 9.0
+    assert resilience_from_env({}).grace_seconds == 30.0
+    with pytest.raises(ValueError):
+        ResilienceConfig(grace_seconds=0)
+    with pytest.raises(ValueError):
+        ResilienceConfig(heartbeat_interval_s=5.0, heartbeat_timeout_s=2.0)
+
+
+# ========================================================= supervised loop
+
+
+def _counting_step():
+    trail = []
+
+    def step_fn(state, step):
+        trail.append(step)
+        return state + 1
+
+    return trail, step_fn
+
+
+def test_supervised_loop_completes_and_checkpoints(tmp_path):
+    trail, step_fn = _counting_step()
+    with Checkpointer(str(tmp_path), max_to_keep=3) as ckpt:
+        loop = SupervisedLoop(ckpt, ResilienceConfig(), total_steps=4,
+                              heartbeat_dir=str(tmp_path))
+        state, outcome = loop.run(jnp.float32(0.0), step_fn)
+        assert outcome.status == "completed" and outcome.step == 4
+        assert trail == [1, 2, 3, 4]
+        assert float(state) == 4.0
+        assert ckpt.latest_step() == 4
+        # heartbeat carries the final step for the supervisor to read
+        mon = HeartbeatMonitor(str(tmp_path), num_processes=1)
+        assert mon.read()[0]["step"] == 4
+
+
+def test_supervised_loop_save_every_and_final_step(tmp_path):
+    _trail, step_fn = _counting_step()
+    with Checkpointer(str(tmp_path), max_to_keep=8) as ckpt:
+        loop = SupervisedLoop(ckpt, ResilienceConfig(), total_steps=5,
+                              save_every=2)
+        _state, outcome = loop.run(jnp.float32(0.0), step_fn)
+        assert outcome.status == "completed"
+        # cadence steps 2 and 4, plus the final step 5 always commits
+        assert ckpt.all_steps() == [2, 4, 5]
+
+
+def test_supervised_loop_drains_and_emergency_saves(tmp_path):
+    """SIGTERM mid-run: the in-flight step completes, an emergency
+    checkpoint commits at the drained step (not a save_every multiple),
+    and the outcome is classified 'preempted'."""
+    def step_fn(state, step):
+        if step == 3:
+            os.kill(os.getpid(), signal.SIGTERM)   # preemption notice
+        return state + 1
+
+    with Checkpointer(str(tmp_path), max_to_keep=8) as ckpt:
+        loop = SupervisedLoop(ckpt, ResilienceConfig(grace_seconds=20.0),
+                              total_steps=10, save_every=5)
+        state, outcome = loop.run(jnp.float32(0.0), step_fn)
+        assert outcome.status == "preempted"
+        assert outcome.step == 3 and outcome.emergency_saved
+        assert float(state) == 3.0                 # the step was DRAINED
+        assert ckpt.latest_step() == 3             # …and committed
+
+    # the restart resumes exactly where the drain stopped
+    with Checkpointer(str(tmp_path)) as ckpt:
+        abstract = jax.ShapeDtypeStruct((), jnp.float32)
+        tree, step, _meta = ckpt.restore_tree(abstract)
+        assert step == 3 and float(tree) == 3.0
+
+
+def test_supervised_loop_without_checkpointer(tmp_path):
+    trail, step_fn = _counting_step()
+    loop = SupervisedLoop(None, ResilienceConfig(), total_steps=3)
+    _state, outcome = loop.run(jnp.float32(0.0), step_fn)
+    assert outcome.status == "completed" and trail == [1, 2, 3]
+
+
+def test_supervised_loop_resume_contract(tmp_path):
+    """start_step/resumed_from flow through: a resumed loop runs only the
+    remaining steps and reports where it came from."""
+    trail, step_fn = _counting_step()
+    with Checkpointer(str(tmp_path)) as ckpt:
+        loop = SupervisedLoop(ckpt, ResilienceConfig(), total_steps=6)
+        _state, outcome = loop.run(jnp.float32(2.0), step_fn,
+                                   start_step=2, resumed_from=2)
+        assert outcome.status == "completed"
+        assert outcome.step == 6 and outcome.resumed_from == 2
+        assert trail == [3, 4, 5, 6]

@@ -534,3 +534,136 @@ variable "machine" {
     findings = _lint(_write(tmp_path, body))
     assert len(findings) == 1
     assert "preemptible TPU capacity" in findings[0].message
+
+
+# --------------------------------- workload-grace lint rule (PR 5 satellite)
+
+_TPU_JOB = """
+resource "kubernetes_job_v1" "work" {
+  metadata {
+    name = "burnin"
+  }
+  spec {
+    template {
+      metadata {
+        labels = { app = "burnin" }
+      }
+      spec {
+        %s
+        node_selector = {
+          "cloud.google.com/gke-tpu-accelerator" = "tpu-v5-lite-podslice"
+          "cloud.google.com/gke-tpu-topology"    = "2x4"
+        }
+        container {
+          name  = "train"
+          image = "jax:latest"
+        }
+      }
+    }
+  }
+}
+"""
+
+
+def _lint_grace(path):
+    from nvidia_terraform_modules_tpu.tfsim.lint import run_lint
+
+    return [f for f in run_lint(path) if f.rule == "tpu-spot-no-grace"]
+
+
+def test_spot_no_grace_fires_on_missing_grace_period(tmp_path):
+    """Spot TPU pool + TPU-scheduling Job with the kubernetes default
+    grace (30s): exactly the emergency budget, zero drain headroom."""
+    body = (SPOT_POOL % "") + (_TPU_JOB % "")
+    findings = _lint_grace(_write(tmp_path, body))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.severity == "warning"
+    assert "termination_grace_period_seconds" in f.message
+    assert "TPU_SMOKETEST_GRACE_SECONDS" in f.message
+    assert "spot" in f.message
+
+
+def test_spot_no_grace_fires_on_short_grace(tmp_path):
+    body = (SPOT_POOL % "") + (
+        _TPU_JOB % "termination_grace_period_seconds = 30")
+    findings = _lint_grace(_write(tmp_path, body))
+    assert len(findings) == 1
+    assert "below the 60s floor" in findings[0].message
+
+
+def test_spot_no_grace_satisfied_by_adequate_grace(tmp_path):
+    body = (SPOT_POOL % "") + (
+        _TPU_JOB % "termination_grace_period_seconds = 120")
+    assert _lint_grace(_write(tmp_path, body)) == []
+
+
+def test_spot_no_grace_silent_without_spot_capacity(tmp_path):
+    """No preemptible capacity declared anywhere → the workload's grace
+    period is its own business."""
+    on_demand = (SPOT_POOL % "").replace(
+        "spot         = true", "spot         = false")
+    assert _lint_grace(_write(tmp_path, on_demand + (_TPU_JOB % ""))) == []
+
+
+def test_spot_no_grace_ignores_non_tpu_workloads(tmp_path):
+    cpu_job = (_TPU_JOB % "").replace(
+        '"cloud.google.com/gke-tpu-accelerator" = "tpu-v5-lite-podslice"\n',
+        "").replace(
+        '"cloud.google.com/gke-tpu-topology"    = "2x4"\n', "")
+    assert _lint_grace(_write(tmp_path, (SPOT_POOL % "") + cpu_job)) == []
+
+
+def test_spot_no_grace_triggered_by_spot_slice_declaration(tmp_path):
+    """The premise also holds through tpu_slices declarations (tfvars,
+    defaults) — the shipped module's spot flag lives there, not on a
+    literal pool resource."""
+    body = """
+variable "tpu_slices" {
+  description = "slices"
+  type        = any
+  default = {
+    cheap = { version = "v5e" topology = "2x4" spot = true }
+  }
+}
+
+output "echo" {
+  description = "keep used"
+  value       = var.tpu_slices
+}
+""" + (_TPU_JOB % "")
+    findings = _lint_grace(_write(tmp_path, body))
+    assert len(findings) == 1
+    assert "tpu_slices['cheap']" in findings[0].message
+
+
+def test_spot_no_grace_detects_tpu_via_toleration_and_resources(tmp_path):
+    """TPU targeting without a node_selector: the google.com/tpu
+    toleration or resource request marks the pod just as well."""
+    job = """
+resource "kubernetes_job_v1" "work" {
+  metadata {
+    name = "burnin"
+  }
+  spec {
+    template {
+      metadata {
+        labels = { app = "burnin" }
+      }
+      spec {
+        toleration {
+          key      = "google.com/tpu"
+          operator = "Exists"
+          effect   = "NoSchedule"
+        }
+        container {
+          name  = "train"
+          image = "jax:latest"
+        }
+      }
+    }
+  }
+}
+"""
+    findings = _lint_grace(_write(tmp_path, (SPOT_POOL % "") + job))
+    assert len(findings) == 1
