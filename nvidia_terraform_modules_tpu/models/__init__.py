@@ -53,17 +53,23 @@ from .checkpoint import (  # noqa: F401
     CheckpointError,
     Checkpointer,
     CorruptCheckpointError,
+    MissingStepError,
     clear_checkpoints,
     latest_step,
     restore_checkpoint,
     save_checkpoint,
 )
 from .resilience import (  # noqa: F401
+    ElasticConfig,
+    ElasticWorldError,
     Heartbeat,
     HeartbeatMonitor,
     PeerFailure,
     PreemptionGuard,
     ResilienceConfig,
     SupervisedLoop,
+    classify_exit,
+    elastic_from_env,
+    plan_world_size,
     resilience_from_env,
 )

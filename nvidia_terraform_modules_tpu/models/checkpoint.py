@@ -38,7 +38,24 @@ commit protocol, not of library behaviour:
   train step doesn't stall on I/O; :meth:`flush`/:meth:`close` are the
   commit barriers and re-raise any background failure;
 - **step-numbered + run-scoped**: exactly as before — the global step
-  survives restarts, and a successful run calls :meth:`clear`.
+  survives restarts, and a successful run calls :meth:`clear`;
+- **elastic (re-sharding) restore**: the manifest records every shard's
+  global bounds, so a checkpoint written by an N-host world restores
+  into an M-host mesh with a *different* sharding (M < N after a spot
+  reclaim shrinks the fleet, M > N when capacity returns). Restore
+  streams leaf by leaf and shard by shard: for each target shard of the
+  run's ``NamedSharding`` it reads only the intersecting byte ranges
+  (``seek`` + ranged read, crc32-verified per record), so peak host
+  memory is bounded by one leaf's working set — never the whole
+  checkpoint, never a gather through one host. Corrupt records on the
+  read path still classify, quarantine, and fall back exactly like the
+  shape-preserving path; in a **multi-process** world restore first
+  verifies every record (still streamed one at a time) so all peers
+  reach the same valid/quarantine verdict — the partial-read fast path
+  is single-process-only, because a verdict that depends on *which*
+  ranges a host needs would let peers resume from different steps.
+  :meth:`Checkpointer.stored_world` reports the writing world's
+  process count for the resume journal.
 
 Restore-time reads retry transient I/O with capped exponential backoff
 and jitter (``utils/retry.py`` — the workload-side mirror of the
@@ -114,6 +131,12 @@ class CorruptCheckpointError(CheckpointError):
                          f"{reason}")
         self.step = step
         self.reason = reason
+
+
+class MissingStepError(CheckpointError):
+    """An explicitly requested step is not in the committed namespace —
+    deterministic (retention pruned it or it never existed), so retry
+    layers must NOT hammer it like a transient rendezvous failure."""
 
 
 def _is_remote(directory: str) -> bool:
@@ -412,12 +435,14 @@ class _LocalStore:
             shutil.rmtree(doomed, ignore_errors=True)
 
     # ---- verify / quarantine ---------------------------------------
-    def load_verified(self, step: int) -> tuple[dict, dict[str, list]]:
-        """Read + verify one committed step.
+    def read_manifest(self, step: int) -> tuple[dict, dict]:
+        """Read + header-verify one committed step's ``(meta, manifest)``.
 
-        Returns ``(meta, {leaf path: [(bounds, np array), …]})``; raises
-        :class:`CorruptCheckpointError` with a classified reason on any
-        truncation, checksum mismatch, or missing shard file.
+        Shard *data* is deliberately not read here — the streaming
+        restore pulls only the byte ranges the target sharding needs
+        (see :class:`_RecordReader`). Raises
+        :class:`CorruptCheckpointError` on an unreadable or mismatched
+        manifest.
         """
         stepdir = os.path.join(self.root, _step_dirname(step))
 
@@ -439,33 +464,11 @@ class _LocalStore:
                 step, f"manifest format/step mismatch "
                       f"(format={manifest.get('format')}, "
                       f"step={manifest.get('step')})")
-        files: dict[str, bytes] = {}
-        leaves: dict[str, list] = {}
-        for rec in manifest.get("leaves", []):
-            fname = rec["file"]
-            if fname not in files:
-                try:
-                    files[fname] = read(os.path.join(stepdir, fname))
-                except Exception as exc:  # noqa: BLE001
-                    raise CorruptCheckpointError(
-                        step, f"missing/unreadable shard file {fname} "
-                              f"({exc})") from exc
-            raw = files[fname][rec["offset"]:rec["offset"] + rec["nbytes"]]
-            if len(raw) != rec["nbytes"]:
-                raise CorruptCheckpointError(
-                    step, f"shard file {fname} truncated at offset "
-                          f"{rec['offset']} (wanted {rec['nbytes']} bytes "
-                          f"for {rec['path']})")
-            if (zlib.crc32(raw) & 0xFFFFFFFF) != rec["crc32"]:
-                raise CorruptCheckpointError(
-                    step, f"crc32 mismatch in {fname} for {rec['path']} "
-                          f"{rec['bounds']}")
-            arr = np.frombuffer(raw, dtype=_np_dtype(rec["dtype"]))
-            span = [b - a for a, b in rec["bounds"]]
-            arr = arr.reshape(span)
-            leaves.setdefault(rec["path"], []).append(
-                (rec["bounds"], tuple(rec["shape"]), rec["dtype"], arr))
-        return meta, leaves
+        return meta, manifest
+
+    def record_reader(self, step: int) -> "_RecordReader":
+        return _RecordReader(
+            os.path.join(self.root, _step_dirname(step)), step)
 
     def quarantine(self, step: int, reason: str) -> None:
         """Move a failed step out of the committed namespace for good.
@@ -529,56 +532,195 @@ def _merge_parts(parts: list[dict]) -> list[dict]:
     return records
 
 
-# -------------------------------------------------------------- assembly
+# ------------------------------------------- streaming (elastic) assembly
 
 
-def _assemble_leaf(path: str, abstract, records,
-                   step: int):
-    """One leaf from its verified shard records, placed per ``abstract``."""
+class _RecordReader:
+    """Ranged, verified reads of individual shard records.
+
+    The elastic restore path's I/O layer: one persistent handle per shard
+    file, ``seek`` + ranged read per record (retried via ``_READ_RETRY``),
+    length- and crc32-checked so corruption classifies per record — a
+    process restoring into an M-host mesh reads only the byte ranges its
+    own target shards intersect, never whole files.
+    """
+
+    def __init__(self, stepdir: str, step: int):
+        self.stepdir = stepdir
+        self.step = step
+        self._handles: dict[str, Any] = {}
+
+    def close(self) -> None:
+        for fh in self._handles.values():
+            with contextlib.suppress(OSError):
+                fh.close()
+        self._handles.clear()
+
+    def __enter__(self) -> "_RecordReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def read(self, rec: dict) -> np.ndarray:
+        fname = rec["file"]
+        fh = self._handles.get(fname)
+        if fh is None:
+            try:
+                fh = retry_call(
+                    lambda: open(os.path.join(self.stepdir, fname), "rb"),
+                    policy=_READ_RETRY, what=f"open {fname}",
+                    retryable=(OSError,))
+            except Exception as exc:  # noqa: BLE001 — classified
+                raise CorruptCheckpointError(
+                    self.step, f"missing/unreadable shard file {fname} "
+                               f"({exc})") from exc
+            self._handles[fname] = fh
+
+        def ranged():
+            fh.seek(rec["offset"])
+            return fh.read(rec["nbytes"])
+
+        try:
+            raw = retry_call(
+                ranged, policy=_READ_RETRY,
+                what=f"read {fname}[{rec['offset']}:+{rec['nbytes']}]",
+                retryable=(OSError,))
+        except Exception as exc:  # noqa: BLE001 — classified: a ranged
+            # read that stays broken past the retry budget (bad block,
+            # vanished mount) must quarantine-and-fall-back like any
+            # other unreadable shard, not crash the restore attempt
+            raise CorruptCheckpointError(
+                self.step, f"unreadable shard range "
+                           f"{fname}[{rec['offset']}:+{rec['nbytes']}] "
+                           f"for {rec['path']} ({exc})") from exc
+        if len(raw) != rec["nbytes"]:
+            raise CorruptCheckpointError(
+                self.step, f"shard file {fname} truncated at offset "
+                           f"{rec['offset']} (wanted {rec['nbytes']} bytes "
+                           f"for {rec['path']})")
+        if (zlib.crc32(raw) & 0xFFFFFFFF) != rec["crc32"]:
+            raise CorruptCheckpointError(
+                self.step, f"crc32 mismatch in {fname} for {rec['path']} "
+                           f"{rec['bounds']}")
+        arr = np.frombuffer(raw, dtype=_np_dtype(rec["dtype"]))
+        return arr.reshape([b - a for a, b in rec["bounds"]])
+
+
+def _intersect_bounds(a, b) -> Optional[list[tuple[int, int]]]:
+    """Per-dim overlap of two explicit bounds lists, or None if disjoint."""
+    out = []
+    for (a0, a1), (b0, b1) in zip(a, b):
+        lo, hi = max(a0, b0), min(a1, b1)
+        if lo >= hi:
+            return None
+        out.append((lo, hi))
+    return out
+
+
+def _volume(bounds) -> int:
+    n = 1
+    for a, b in bounds:
+        n *= b - a
+    return n
+
+
+def _unique_records(path: str, abstract, records, step: int) -> list[dict]:
+    """Validate a leaf's manifest records against the run's expectation
+    and reduce them to one record per distinct shard bounds.
+
+    Replicated leaves are stored once per *writing* process — identical
+    bounds in different part files — so dedup keeps the first copy (the
+    rest are never read). Coverage is judged by arithmetic before any
+    data I/O: unique bounds come from a sharding's device index map, a
+    disjoint partition of the leaf, so their volumes must sum to the
+    leaf exactly — short means a writer died before its part was
+    recorded, long means overlapping records.
+    """
     shape = tuple(abstract.shape)
     dtype = np.dtype(abstract.dtype)
-    stored_shapes = {s for _, s, _, _ in records}
+    stored_shapes = {tuple(rec["shape"]) for rec in records}
     if stored_shapes != {shape}:
         raise CorruptCheckpointError(
             step, f"stale checkpoint: leaf {path} has shape "
                   f"{sorted(stored_shapes)} on disk but the run expects "
                   f"{shape}")
-    full = np.empty(shape, dtype=_np_dtype(records[0][2]))
-    # coverage by arithmetic, not a full-shape mask: unique shard bounds
-    # are a disjoint partition of the leaf (they come from a sharding's
-    # device index map), so their volumes must sum to the leaf exactly —
-    # short means a writer died before its part was recorded, long means
-    # overlapping records
-    unique_bounds = set()
-    volume = 0
-    for bounds, _, _, arr in records:
-        full[_index_slices(bounds)] = arr
-        key = tuple(map(tuple, bounds))
-        if key not in unique_bounds:
-            unique_bounds.add(key)
-            n = 1
-            for a, b in bounds:
-                n *= b - a
-            volume += n
-    size = 1
-    for d in shape:
-        size *= d
+    stored_dtypes = {rec["dtype"] for rec in records}
+    if any(_np_dtype(d) != dtype for d in stored_dtypes):
+        raise CorruptCheckpointError(
+            step, f"stale checkpoint: leaf {path} stored as "
+                  f"{sorted(stored_dtypes)}, run expects {dtype.name}")
+    unique: dict[tuple, dict] = {}
+    for rec in records:
+        unique.setdefault(tuple(map(tuple, rec["bounds"])), rec)
+    volume = sum(_volume(rec["bounds"]) for rec in unique.values())
+    size = _volume([(0, d) for d in shape])
     if volume != size:
         raise CorruptCheckpointError(
             step, f"partial checkpoint: leaf {path} shard records cover "
                   f"{volume} of {size} elements (a writer died before "
                   f"its part was recorded, or records overlap)")
-    if full.dtype != dtype:
-        raise CorruptCheckpointError(
-            step, f"stale checkpoint: leaf {path} stored as "
-                  f"{full.dtype.name}, run expects {dtype.name}")
-    sharding = getattr(abstract, "sharding", None)
-    if sharding is not None:
-        return jax.make_array_from_callback(
-            shape, sharding, lambda idx: full[idx])
-    import jax.numpy as jnp
+    return list(unique.values())
 
-    return jnp.asarray(full)
+
+def _assemble_leaf(path: str, abstract, records, step: int,
+                   reader: _RecordReader):
+    """One leaf, streamed from its shard records onto the target placement.
+
+    The re-sharding core: the stored bounds partition the leaf along the
+    *writing* world's sharding, the target ``NamedSharding`` partitions
+    it along the *restoring* world's — generally neither a refinement of
+    the other (N→M with misaligned boundaries). Each addressable target
+    shard is assembled from the intersecting stored records only, read
+    as verified byte ranges; a per-leaf cache bounds re-reads when one
+    record feeds several target shards and is dropped with the leaf, so
+    peak host memory stays at one leaf's working set.
+    """
+    shape = tuple(abstract.shape)
+    unique = _unique_records(path, abstract, records, step)
+    sharding = getattr(abstract, "sharding", None)
+    if sharding is None:
+        full = np.empty(shape, dtype=np.dtype(abstract.dtype))
+        for rec in unique:
+            full[_index_slices(rec["bounds"])] = reader.read(rec)
+        import jax.numpy as jnp
+
+        return jnp.asarray(full)
+
+    record_cache: dict[tuple, np.ndarray] = {}
+    shard_cache: dict[tuple, np.ndarray] = {}
+
+    def target_shard(idx):
+        bounds = _normalize_index(idx, shape)
+        key = tuple(map(tuple, bounds))
+        if key in shard_cache:   # replicated target shards read once
+            return shard_cache[key]
+        out = np.empty([b - a for a, b in bounds],
+                       dtype=np.dtype(abstract.dtype))
+        filled = 0
+        for rec in unique:
+            inter = _intersect_bounds(rec["bounds"], bounds)
+            if inter is None:
+                continue
+            rkey = tuple(map(tuple, rec["bounds"]))
+            arr = record_cache.get(rkey)
+            if arr is None:
+                arr = record_cache[rkey] = reader.read(rec)
+            dst = tuple(slice(lo - t0, hi - t0)
+                        for (lo, hi), (t0, _t1) in zip(inter, bounds))
+            src = tuple(slice(lo - r0, hi - r0)
+                        for (lo, hi), (r0, _r1) in zip(inter,
+                                                       rec["bounds"]))
+            out[dst] = arr[src]
+            filled += _volume(inter)
+        if filled != out.size:
+            raise CorruptCheckpointError(
+                step, f"partial checkpoint: leaf {path} target shard "
+                      f"{key} assembled {filled} of {out.size} elements")
+        shard_cache[key] = out
+        return out
+
+    return jax.make_array_from_callback(shape, sharding, target_shard)
 
 
 # ------------------------------------------------------------ async writer
@@ -782,7 +924,7 @@ class Checkpointer:
             return self._remote.restore_tree(abstract, step)
         if step is not None:
             if step not in self._store.committed_steps():
-                raise CheckpointError(
+                raise MissingStepError(
                     f"checkpoint step {step} does not exist in "
                     f"{self.directory} (committed: "
                     f"{self._store.committed_steps() or 'none'})")
@@ -800,7 +942,13 @@ class Checkpointer:
 
     def _load(self, abstract: Any, step: int,
               ) -> tuple[Any, int, dict[str, Any]]:
-        meta, stored = self._store.load_verified(step)
+        """Streamed, re-sharding load: leaf by leaf, target shard by
+        target shard — the stored world size and sharding never have to
+        match the restoring run's (elastic resume)."""
+        meta, manifest = self._store.read_manifest(step)
+        stored: dict[str, list] = {}
+        for rec in manifest.get("leaves", []):
+            stored.setdefault(rec["path"], []).append(rec)
         pairs, treedef = _leaf_paths(abstract)
         want = {path for path, _ in pairs}
         have = set(stored)
@@ -810,12 +958,37 @@ class Checkpointer:
             raise CorruptCheckpointError(
                 step, f"stale checkpoint: leaf set mismatch "
                       f"(missing {missing}, unexpected {extra})")
-        leaves = [
-            _assemble_leaf(path, a, stored[path], step)
-            for path, a in pairs
-        ]
+        with self._store.record_reader(step) as reader:
+            if _world()[1] > 1:
+                # multi-host: every process must reach the SAME
+                # valid/quarantine verdict, or peers could resume from
+                # different steps (split-brain) when corruption touches
+                # only some hosts' target ranges. Verify every record
+                # (streamed, one at a time — memory stays bounded)
+                # before any assembly; single-process worlds keep the
+                # partial-read fast path, having no peer to disagree
+                # with.
+                for rec in manifest.get("leaves", []):
+                    reader.read(rec)
+            leaves = [
+                _assemble_leaf(path, a, stored[path], step, reader)
+                for path, a in pairs
+            ]
         return (jax.tree_util.tree_unflatten(treedef, leaves), step,
                 dict(meta or {}))
+
+    def stored_world(self, step: int) -> Optional[int]:
+        """Process count of the world that WROTE ``step`` (local engine;
+        None for remote backends) — the resume journal's evidence that a
+        re-sharding restore crossed world sizes."""
+        if self._remote is not None or \
+                _no_checkpoint_possible(self.directory):
+            return None
+        try:
+            _meta, manifest = self._store.read_manifest(step)
+        except CorruptCheckpointError:
+            return None
+        return manifest.get("nprocs")
 
     # ---- clear ------------------------------------------------------
     def clear(self) -> int:

@@ -33,6 +33,27 @@ the preemption notice is routine, not exceptional. Three mechanisms:
 burn-in smoke test and the chaos harness's training worker both run
 through it, so the kill-and-resume invariants the harness asserts are
 properties of the same code path production uses.
+
+**Elastic worlds.** PR 5's supervision was shape-preserving: a
+classified ``EXIT_PEER_DEAD`` restarted the *same* N-host world, so a
+spot fleet that shrank from N to N-1 hosts simply died N-1 restarts
+later. This revision makes the world a variable (Podracer's decoupled,
+slice-granular scaling): :class:`ElasticConfig` carries the floor
+(``TPU_ELASTIC_MIN_WORLD``) and grow-back posture
+(``TPU_ELASTIC_GROW_BACK``), :func:`plan_world_size` is the one
+re-forming decision — on a dead peer the supervisor relaunches the
+*survivors* as a smaller world (bounded distributed init with the new
+process set, a fresh mesh over the remaining devices, and an elastic
+**re-sharding** restore of the N-host checkpoint —
+``models/checkpoint.py`` streams each parameter against the new
+``NamedSharding``), and when capacity returns the next restart grows
+the world back the same way. :func:`classify_exit` maps the process
+exit codes to those decisions without parsing logs. The restore phase
+itself is retried under ``ResilienceConfig.restore_policy``: a peer
+that is merely *slow to restart* surfaces as a classified checkpoint
+rendezvous timeout, which must cost a backoff-spaced retry — not an
+immediate escalation that burns a restart attempt (the
+``EXIT_PEER_DEAD``-during-restore fix).
 """
 
 from __future__ import annotations
@@ -45,15 +66,40 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
-from ..utils.retry import RetryPolicy
+from ..utils.retry import RetryPolicy, retry_call
 
 # process exit codes a supervisor can classify without parsing logs:
 # preempted-and-drained (restart me, my checkpoint is committed) vs
-# peer-dead (restart the world; one of us stopped heartbeating)
+# peer-dead (restart the world; one of us stopped heartbeating) vs
+# elastic-paused (a reduced world yielded because capacity returned —
+# restart me at the grown world size)
 EXIT_PREEMPTED = 75    # EX_TEMPFAIL: transient, retry the job
 EXIT_PEER_DEAD = 76    # EX_PROTOCOL: the collective world is broken
+EXIT_ELASTIC_PAUSE = 77  # EX_NOPERM+: yielded for a world-size change
 
 _HEARTBEAT_DIR = "heartbeats"
+
+
+def classify_exit(returncode: int) -> str:
+    """Map a worker's exit code to the supervisor's restart decision.
+
+    ``completed`` — done, don't restart. ``preempted`` — drained with a
+    committed checkpoint; restart at the same world size. ``peer_dead``
+    — the collective world broke; re-form it from the *survivors*
+    (:func:`plan_world_size`). ``elastic_pause`` — a reduced world
+    yielded at a step boundary so the supervisor can grow the world
+    back. ``error`` — everything else (raw SIGKILL death shows up here
+    as a negative returncode); restartable, same world.
+    """
+    if returncode == 0:
+        return "completed"
+    if returncode == EXIT_PREEMPTED:
+        return "preempted"
+    if returncode == EXIT_PEER_DEAD:
+        return "peer_dead"
+    if returncode == EXIT_ELASTIC_PAUSE:
+        return "elastic_pause"
+    return "error"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -75,6 +121,12 @@ class ResilienceConfig:
     # distributed init / restore-read retry shapes (control-plane mirror)
     init_policy: RetryPolicy = RetryPolicy(
         initial_s=1.0, multiplier=2.0, cap_s=30.0, max_attempts=3)
+    # restore-phase retries: a classified checkpoint failure during
+    # RESTORE (rendezvous timeout — peer-dead territory, but the peer is
+    # usually just slow to restart) retries with backoff before it
+    # escalates; a corrupt step is terminal here (quarantine handles it)
+    restore_policy: RetryPolicy = RetryPolicy(
+        initial_s=0.5, multiplier=2.0, cap_s=10.0, max_attempts=4)
 
     def __post_init__(self):
         if self.grace_seconds <= 0:
@@ -104,6 +156,85 @@ def resilience_from_env(env: Optional[dict] = None) -> ResilienceConfig:
     if "TPU_HEARTBEAT_TIMEOUT_S" in e:
         kw["heartbeat_timeout_s"] = float(e["TPU_HEARTBEAT_TIMEOUT_S"])
     return ResilienceConfig(**kw)
+
+
+# --------------------------------------------------------- elastic worlds
+
+
+class ElasticWorldError(RuntimeError):
+    """The surviving process set is below the elastic floor — no world
+    size satisfies ``TPU_ELASTIC_MIN_WORLD``, so the job must escalate
+    instead of limping on a world too small to make progress."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticConfig:
+    """Elastic-resume posture: how far the world may shrink, and whether
+    it grows back when capacity returns.
+
+    ``desired_world`` is the fleet's full size (the Job's completions /
+    slice count × hosts); ``min_world`` is the floor below which
+    continuing is worse than waiting (throughput, or a batch that no
+    longer shards). Env knobs: ``TPU_ELASTIC_MIN_WORLD``,
+    ``TPU_ELASTIC_GROW_BACK`` (see :func:`elastic_from_env` and the
+    "Preemption & resume runbook" in ``gke-tpu/README.md``).
+    """
+
+    desired_world: int = 1
+    min_world: int = 1
+    grow_back: bool = True
+
+    def __post_init__(self):
+        if self.desired_world < 1:
+            raise ValueError(
+                f"desired_world must be >= 1, got {self.desired_world}")
+        if not 1 <= self.min_world <= self.desired_world:
+            raise ValueError(
+                f"min_world must be in [1, desired_world="
+                f"{self.desired_world}], got {self.min_world}")
+
+
+def elastic_from_env(desired_world: int,
+                     env: Optional[dict] = None) -> ElasticConfig:
+    """Build the elastic posture from the Job env (all optional):
+
+    - ``TPU_ELASTIC_MIN_WORLD`` — smallest world worth running
+      (default 1: train on the last survivor rather than die);
+    - ``TPU_ELASTIC_GROW_BACK`` — ``0`` pins a shrunken world until the
+      run ends (default ``1``: re-expand as capacity returns).
+    """
+    e = os.environ if env is None else env
+    kw: dict[str, Any] = {"desired_world": desired_world}
+    if "TPU_ELASTIC_MIN_WORLD" in e:
+        kw["min_world"] = int(e["TPU_ELASTIC_MIN_WORLD"])
+    if "TPU_ELASTIC_GROW_BACK" in e:
+        kw["grow_back"] = e["TPU_ELASTIC_GROW_BACK"] not in (
+            "0", "false", "False", "")
+    return ElasticConfig(**kw)
+
+
+def plan_world_size(alive: int, cfg: ElasticConfig,
+                    current: Optional[int] = None) -> int:
+    """The one elastic decision: the world size to (re-)form next.
+
+    ``alive`` is how many processes can join the next attempt (survivors
+    after a dead peer, or the full fleet once capacity returned);
+    ``current`` is the world size of the attempt that just ended (None
+    for the first). Shrink follows the survivors immediately; growth
+    only happens when ``grow_back`` allows it — a fleet pinned small by
+    policy re-forms at ``current`` even when more capacity shows up.
+    Raises :class:`ElasticWorldError` below the floor.
+    """
+    if alive < cfg.min_world:
+        raise ElasticWorldError(
+            f"only {alive} process(es) can join the next world — below "
+            f"the elastic floor TPU_ELASTIC_MIN_WORLD={cfg.min_world} "
+            f"(desired {cfg.desired_world}); escalating instead of "
+            f"limping")
+    target = min(alive, cfg.desired_world)
+    if current is not None and target > current and not cfg.grow_back:
+        return current
+    return target
 
 
 # ------------------------------------------------------------- preemption
@@ -364,6 +495,33 @@ class SupervisedLoop:
         self.num_processes = num_processes
         self.heartbeat_dir = heartbeat_dir
         self.on_peer_dead = on_peer_dead
+
+    def restore(self, abstract: Any, step: Optional[int] = None):
+        """Restore ``abstract`` through the restart policy fix: a
+        *classified, transient* checkpoint failure during restore — the
+        rendezvous timeout a peer killed mid-restart leaves behind, the
+        same hang the heartbeat monitor classifies ``EXIT_PEER_DEAD``
+        during training — is retried with backoff
+        (``cfg.restore_policy``) instead of escalated immediately, so a
+        slow-to-reschedule peer costs seconds, not a whole restart
+        attempt. Corrupt steps stay terminal here: quarantine-and-
+        fallback inside ``restore_tree`` already owns that path, and a
+        :class:`CorruptCheckpointError` that still escapes (an explicit
+        ``step=``) must not be hammered, and neither must the
+        deterministic missing-explicit-step error."""
+        from .checkpoint import (
+            CheckpointError,
+            CorruptCheckpointError,
+            MissingStepError,
+        )
+
+        return retry_call(
+            lambda: self.ckpt.restore_tree(abstract, step),
+            policy=self.cfg.restore_policy,
+            what="checkpoint restore",
+            retryable=(CheckpointError, OSError),
+            giveup=lambda exc: isinstance(
+                exc, (CorruptCheckpointError, MissingStepError)))
 
     # the default dead-peer action: leave a classification on disk where
     # the supervisor (and the next attempt) can read it, then exit with

@@ -14,11 +14,14 @@ from .multislice import (  # noqa: F401
     build_multislice_mesh,
     dcn_slice_count,
     group_devices_by_slice,
+    plan_elastic_multislice,
     plan_multislice,
 )
 from .sharding import ShardingRules, make_rules  # noqa: F401
 from .collectives import (  # noqa: F401
     all_gather_probe,
+    hierarchical_psum,
+    hierarchical_psum_probe,
     psum_probe,
     reduce_scatter_probe,
     ring_permute_probe,

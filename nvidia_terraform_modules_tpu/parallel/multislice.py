@@ -49,6 +49,43 @@ def plan_multislice(
     return MeshPlan(("slice",) + per.axis_names, (n_slices,) + per.shape)
 
 
+def plan_elastic_multislice(
+    n_devices: int,
+    preferred_slices: int,
+    *,
+    tp: int | None = None,
+    sp: int = 1,
+) -> MeshPlan:
+    """The mesh planner for a world whose size *changed* between resumes.
+
+    An elastic restart cannot assume the configured slice count still
+    matches the fleet: a spot reclaim may have taken whole slices (or
+    hosts of one), and the re-formed world has whatever devices the
+    survivors contribute. This picks the **largest feasible slice count
+    ≤ preferred** that evenly divides the surviving device count and
+    factorises (``plan_multislice``), degrading to a single-slice plan
+    when nothing larger fits — so the 4-axis ``("slice", …)`` mesh (and
+    therefore the sharding rules' ``("slice", "dp")`` data axes and the
+    hierarchical-psum call sites) stay *structurally identical* across
+    every world size, only the axis sizes re-trace. Growth is the same
+    call with the returned capacity's device count.
+    """
+    if preferred_slices < 1:
+        raise ValueError(
+            f"preferred_slices must be >= 1, got {preferred_slices}")
+    last_err: Exception | None = None
+    for s in range(min(preferred_slices, n_devices), 0, -1):
+        if n_devices % s:
+            continue
+        try:
+            return plan_multislice(n_devices, s, tp=tp, sp=sp)
+        except ValueError as exc:   # per-slice factorisation infeasible
+            last_err = exc
+    raise ValueError(
+        f"no slice count in [1, {preferred_slices}] factorises "
+        f"{n_devices} devices (tp={tp}, sp={sp}): {last_err}")
+
+
 def group_devices_by_slice(devices: Sequence, n_slices: int) -> list[list]:
     """Order devices slice-major: real ``slice_index`` if present, else chunks.
 

@@ -34,14 +34,28 @@ non-zero exit — including the classified ``EXIT_PREEMPTED`` (drained),
 and checkpoint rendezvous timeouts — so the restart loop itself is the
 retry policy.
 
+``-elastic`` arms the shape-shifting leg instead: a one-peer kill in a
+2-process world, and the supervisor re-forms the *survivor* as a
+1-process world (which elastic-restores the 2-process checkpoint —
+``models/checkpoint.py`` re-shards it against the smaller mesh), runs
+it to a deterministic pause step, then grows back to 2 processes for
+the rest of the run. :func:`run_elastic_case` asserts the elastic
+invariants: the shrunken segment bit-matches a *fresh* 1-process
+restore of the same checkpoint, the grown world finishes at the exact
+configured step, the journal shows the re-shard crossing world sizes
+both ways, no quarantined step is restored, and the whole world
+sequence replays deterministically from the seed.
+
 CLI::
 
     python -m nvidia_terraform_modules_tpu.smoketest.chaos \\
         -seeds 3 -steps 8 -kill-steps 2,5 -signals SIGTERM,SIGKILL
+    python -m nvidia_terraform_modules_tpu.smoketest.chaos \\
+        -seeds 1 -steps 6 -kill-steps 3 -signals SIGKILL -elastic
 
-Tests: ``tests/test_chaos_resume.py`` (one seeded case tier-1, the full
-matrix slow — mirroring the chaos-gate layering of
-``tests/test_tfsim_faults.py``).
+Tests: ``tests/test_chaos_resume.py`` (one seeded case + one seeded
+elastic case tier-1, the full matrices slow — mirroring the chaos-gate
+layering of ``tests/test_tfsim_faults.py``).
 """
 
 from __future__ import annotations
@@ -119,9 +133,20 @@ def worker_main(env: Optional[dict] = None) -> int:
     - ``TPU_CHAOS_KILL_AT_STEP`` / ``TPU_CHAOS_KILL_SIGNAL`` /
       ``TPU_CHAOS_KILL_PROCESS`` — the armed self-kill (first attempt
       only: ``TPU_CHAOS_ATTEMPT`` gates it);
+    - ``TPU_CHAOS_STOP_AT_STEP`` — the elastic pause point: a *reduced*
+      world runs to this step boundary, commits, and yields with the
+      classified ``EXIT_ELASTIC_PAUSE`` so the supervisor can grow the
+      world back (deterministic stand-in for "capacity returned").
+
+    The restore path is the full elastic machinery: the checkpoint on
+    disk may have been written by a *different* world size (the dead
+    peer's world, or the reduced world the grow-back resumes from) —
+    ``SupervisedLoop.restore`` re-shards it onto this world's mesh,
+    retrying classified-transient failures with backoff.
 
     Exits 0 on completion (final JSON line carries step + digests),
-    ``EXIT_PREEMPTED`` after a SIGTERM drain + emergency checkpoint.
+    ``EXIT_ELASTIC_PAUSE`` at the elastic pause, ``EXIT_PREEMPTED``
+    after a SIGTERM drain + emergency checkpoint.
     """
     e = dict(os.environ if env is None else env)
     from ..models import (
@@ -135,7 +160,7 @@ def worker_main(env: Optional[dict] = None) -> int:
         resilience_from_env,
         synthetic_batch,
     )
-    from ..models.resilience import EXIT_PREEMPTED
+    from ..models.resilience import EXIT_ELASTIC_PAUSE, EXIT_PREEMPTED
     from ..parallel import (
         build_mesh,
         make_rules,
@@ -157,6 +182,7 @@ def worker_main(env: Optional[dict] = None) -> int:
     kill_signal = e.get("TPU_CHAOS_KILL_SIGNAL", "")
     kill_process = e.get("TPU_CHAOS_KILL_PROCESS", "")
     attempt = int(e.get("TPU_CHAOS_ATTEMPT", "0"))
+    stop_at = int(e.get("TPU_CHAOS_STOP_AT_STEP", "0"))
 
     cfg = BurnInConfig(dtype=jnp.float32, **_CHAOS_MODEL)
     rules = make_rules(build_mesh(plan_mesh(len(jax.devices()))))
@@ -167,21 +193,33 @@ def worker_main(env: Optional[dict] = None) -> int:
     rcfg = resilience_from_env(e)
     os.makedirs(ckpt_dir, exist_ok=True)
     ckpt = Checkpointer(ckpt_dir, max_to_keep=4)
-    restored = ckpt.restore_tree(abstract_train_state(cfg, rules))
+    # a reduced world pauses at the stop step; anything beyond it is the
+    # grown-back world's work
+    loop_total = min(total, stop_at) if stop_at else total
+    loop = SupervisedLoop(
+        ckpt, rcfg, total_steps=loop_total, save_every=save_every,
+        process_id=pid, num_processes=nprocs, heartbeat_dir=ckpt_dir)
+    # restore through the supervised retry policy: a rendezvous timeout
+    # left by a peer killed mid-restart costs backoff, not the attempt
+    restored = loop.restore(abstract_train_state(cfg, rules))
     quarantined = ckpt.quarantined()
     if restored is not None:
         state, start_step, _meta = restored
         resumed_from: Optional[int] = start_step
+        stored_world = ckpt.stored_world(start_step)
     else:
         params = init_params(jax.random.PRNGKey(seed), cfg, rules)
         state = {"params": params, "opt": init_state(params)}
-        start_step, resumed_from = 0, None
-    # the journal the supervisor audits: what this attempt resumed from
-    # and what sat in quarantine at that moment (invariant: disjoint)
+        start_step, resumed_from, stored_world = 0, None, None
+    # the journal the supervisor audits: what this attempt resumed from,
+    # at which world size (elastic re-shard evidence: stored_world is the
+    # WRITING world's size), and what sat in quarantine (invariant:
+    # disjoint from the resumed step)
     with open(os.path.join(ckpt_dir, RESUME_JOURNAL), "a") as fh:
         fh.write(json.dumps({
-            "attempt": attempt, "process": pid,
-            "resumed_from": resumed_from, "quarantined": quarantined,
+            "attempt": attempt, "process": pid, "world": nprocs,
+            "resumed_from": resumed_from, "stored_world": stored_world,
+            "quarantined": quarantined,
         }) + "\n")
 
     armed = (attempt == 0 and kill_step > start_step and
@@ -197,26 +235,27 @@ def worker_main(env: Optional[dict] = None) -> int:
         p, s, _loss = adamw_step(st["params"], st["opt"], batch)
         return {"params": p, "opt": s}
 
-    loop = SupervisedLoop(
-        ckpt, rcfg, total_steps=total, save_every=save_every,
-        process_id=pid, num_processes=nprocs, heartbeat_dir=ckpt_dir)
     try:
         state, outcome = loop.run(state, step_fn, start_step=start_step,
                                   resumed_from=resumed_from)
     finally:
         ckpt.close()
+    paused = outcome.status == "completed" and loop_total < total
     verdict = {
-        "status": outcome.status,
+        "status": "paused" if paused else outcome.status,
         "step": outcome.step,
         "process": pid,
         "num_processes": nprocs,
         "resumed_from": resumed_from,
+        "stored_world": stored_world,
         "quarantined": quarantined,
         "emergency_saved": outcome.emergency_saved,
     }
     if outcome.status == "completed":
         verdict["digest"] = _digest(state)
     print(json.dumps(verdict), flush=True)
+    if paused:
+        return EXIT_ELASTIC_PAUSE
     return 0 if outcome.status == "completed" else EXIT_PREEMPTED
 
 
@@ -225,7 +264,16 @@ def worker_main(env: Optional[dict] = None) -> int:
 
 @dataclasses.dataclass(frozen=True)
 class ChaosCase:
-    """One seeded (signal, kill-step) scenario."""
+    """One seeded (signal, kill-step) scenario.
+
+    ``elastic=True`` (needs ``kill_scope="one"``) changes the restart
+    policy from shape-preserving to shape-shifting: after the one-peer
+    death the supervisor re-forms the *survivors* as a smaller world
+    (which elastic-restores the bigger world's checkpoint), runs it to a
+    deterministic pause step (``pause_step``), then grows back to the
+    full world for the rest of the run — the spot-fleet
+    shrink/continue/grow-back cycle, replayable from the seed.
+    """
 
     seed: int
     kill_signal: str          # "SIGTERM" | "SIGKILL" | "" (no kill)
@@ -234,6 +282,7 @@ class ChaosCase:
     total_steps: int = 6
     save_every: int = 1
     kill_scope: str = "world"  # "world" | "one" (process 1 only)
+    elastic: bool = False      # shrink to the survivors, then grow back
 
     def __post_init__(self):
         if self.kill_signal not in ("", "SIGTERM", "SIGKILL"):
@@ -242,6 +291,32 @@ class ChaosCase:
             raise ValueError(f"unknown kill scope {self.kill_scope!r}")
         if self.kill_scope == "one" and self.nprocs < 2:
             raise ValueError("kill_scope='one' needs nprocs >= 2")
+        if self.elastic:
+            if self.kill_scope != "one" or not self.kill_signal:
+                raise ValueError(
+                    "elastic cases need an armed one-peer kill "
+                    "(kill_scope='one'): a whole-world kill leaves no "
+                    "survivors to re-form")
+            if self.total_steps < self.kill_step + 2:
+                raise ValueError(
+                    f"elastic case needs total_steps >= kill_step + 2 "
+                    f"(pause at {self.kill_step + 1}, grow back after), "
+                    f"got total={self.total_steps} kill={self.kill_step}")
+            if self.kill_step <= self.save_every:
+                raise ValueError(
+                    f"elastic case needs kill_step > save_every so at "
+                    f"least one checkpoint commits before the peer dies "
+                    f"(the shrunken world must RE-SHARD the full "
+                    f"world's checkpoint, not start fresh), got "
+                    f"kill={self.kill_step} save_every={self.save_every}")
+
+    @property
+    def pause_step(self) -> int:
+        """Where the reduced world yields for grow-back: one step past
+        the kill — late enough that the shrunken world provably trained
+        (resume is at most ``kill_step``), early enough that the grown
+        world still has steps to run."""
+        return self.kill_step + 1
 
 
 _BOOTSTRAP = (
@@ -263,11 +338,21 @@ class Supervisor:
     """Launch, observe, kill-arm, and restart the training world.
 
     The restart loop treats EVERY non-zero exit as restartable — the
-    classified drain (75), the classified dead-peer (76), a raw SIGKILL
-    death, a checkpoint rendezvous timeout — because that is exactly the
-    Job controller's contract on GKE (``backoff_limit`` with the
-    disruption-exempt pod failure policy). A hard per-attempt wall-clock
-    bound converts any genuine hang into a failed attempt.
+    classified drain (75), the classified dead-peer (76), the elastic
+    pause (77), a raw SIGKILL death, a checkpoint rendezvous timeout —
+    because that is exactly the Job controller's contract on GKE
+    (``backoff_limit`` with the disruption-exempt pod failure policy).
+    A hard per-attempt wall-clock bound converts any genuine hang into
+    a failed attempt.
+
+    For an elastic case the restart is additionally **shape-shifting**:
+    the next attempt's world size comes from
+    ``models.resilience.plan_world_size`` over the classified exits —
+    a dead peer re-forms the survivors as a smaller world (bounded
+    distributed init with the new process set, elastic re-sharding
+    restore inside the worker), the classified pause grows it back when
+    "capacity returns". The schedule is a pure function of the exit
+    codes, so seed replays re-form identical world sequences.
     """
 
     def __init__(self, case: ChaosCase, ckpt_dir: str,
@@ -284,7 +369,8 @@ class Supervisor:
         # death and resume, proving the quarantine path end to end
         self.on_restart = on_restart
 
-    def _env(self, proc_id: int, attempt: int, port: int) -> dict:
+    def _env(self, proc_id: int, attempt: int, port: int,
+             world: int, stop_at: int) -> dict:
         c = self.case
         env = dict(os.environ)
         env.update(
@@ -303,6 +389,8 @@ class Supervisor:
             TPU_SMOKETEST_GRACE_SECONDS="60",
             TPU_CHECKPOINT_SYNC_TIMEOUT_S="20",
         )
+        if stop_at:
+            env["TPU_CHAOS_STOP_AT_STEP"] = str(stop_at)
         if attempt == 0 and c.kill_signal:
             env.update(
                 TPU_CHAOS_KILL_AT_STEP=str(c.kill_step),
@@ -310,16 +398,17 @@ class Supervisor:
                 TPU_CHAOS_KILL_PROCESS="1" if c.kill_scope == "one"
                 else "",
             )
-        if c.nprocs > 1:
+        if world > 1:
             env.update(
-                TPU_SMOKETEST_HOSTS=str(c.nprocs),
+                TPU_SMOKETEST_HOSTS=str(world),
                 JOB_COMPLETION_INDEX=str(proc_id),
                 TPU_SMOKETEST_COORDINATOR=f"localhost:{port}",
                 TPU_SMOKETEST_INIT_TIMEOUT="60",
             )
         return env
 
-    def _launch(self, attempt: int) -> list[subprocess.Popen]:
+    def _launch(self, attempt: int, world: int,
+                stop_at: int) -> list[subprocess.Popen]:
         # liveness state belongs to ONE attempt: a dead worker's stale
         # heartbeat surviving into the restart would let a peer's monitor
         # re-classify it dead before it stamps its first beat
@@ -333,19 +422,61 @@ class Supervisor:
             subprocess.Popen(
                 [sys.executable, "-c",
                  _BOOTSTRAP.format(root=_REPO_ROOT)],
-                env=self._env(i, attempt, port), cwd=_REPO_ROOT,
+                env=self._env(i, attempt, port, world, stop_at),
+                cwd=_REPO_ROOT,
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
-            for i in range(self.case.nprocs)
+            for i in range(world)
         ]
+
+    def _plan_attempt(self, last_exits: Optional[list[int]],
+                      current_world: int) -> tuple[int, int]:
+        """Next attempt's ``(world size, stop-at step)`` from the last
+        attempt's classified exits — the elastic restart policy.
+
+        Non-elastic cases always re-form the configured world (PR 5's
+        shape-preserving behaviour, byte-for-byte). Elastic: evidence
+        that a peer is *gone* — the survivor's classified
+        ``EXIT_PEER_DEAD``, or a signal death (negative returncode) —
+        re-forms the survivors; the classified pause re-forms the full
+        world ("capacity returned"); any other failure (a corruption
+        retry, a transient init timeout — positive exit codes with
+        every peer alive) keeps the current shape and simply retries.
+        A reduced world always carries the pause step so growth has a
+        deterministic boundary.
+        """
+        from ..models.resilience import (
+            classify_exit,
+            elastic_from_env,
+            plan_world_size,
+        )
+
+        c = self.case
+        if not c.elastic or last_exits is None:
+            return c.nprocs, 0
+        ecfg = elastic_from_env(c.nprocs)
+        statuses = [classify_exit(rc) for rc in last_exits]
+        peer_gone = "peer_dead" in statuses or any(
+            rc < 0 for rc in last_exits)
+        world = current_world
+        if "elastic_pause" in statuses:
+            world = plan_world_size(c.nprocs, ecfg, current=current_world)
+        elif current_world == c.nprocs and peer_gone:
+            world = plan_world_size(c.nprocs - 1, ecfg,
+                                    current=current_world)
+        return world, (c.pause_step if world < c.nprocs else 0)
 
     def run_to_completion(self) -> dict:
         """Attempt/restart until every process completes; returns the
-        case report (final verdicts, per-attempt exits, journal)."""
+        case report (final verdicts, per-attempt exits + worlds +
+        interim verdicts, journal)."""
         attempts: list[dict] = []
+        last_exits: Optional[list[int]] = None
+        world = self.case.nprocs
         for attempt in range(self.max_restarts + 1):
+            world, stop_at = self._plan_attempt(last_exits, world)
             if attempt and self.on_restart is not None:
                 self.on_restart(attempt)
-            procs = self._launch(attempt)
+            procs = self._launch(attempt, world, stop_at)
             results = []
             deadline = time.monotonic() + self.attempt_timeout_s
             hung = False
@@ -358,10 +489,16 @@ class Supervisor:
                     p.kill()
                     out, err = p.communicate()
                 results.append((p.returncode, out, err))
+            last_exits = [rc for rc, _, _ in results]
             attempts.append({
                 "attempt": attempt,
+                "world": world,
+                "stop_at": stop_at,
                 "hung": hung,
-                "exits": [rc for rc, _, _ in results],
+                "exits": last_exits,
+                # interim verdicts (paused workers emit one too) — the
+                # elastic invariants audit the reduced world's digest
+                "verdicts": [_maybe_json(out) for _, out, _ in results],
             })
             if hung:
                 raise ChaosInvariantError(
@@ -401,6 +538,15 @@ def _last_json(out: str) -> dict:
     return json.loads(lines[-1])
 
 
+def _maybe_json(out: str) -> Optional[dict]:
+    """A worker killed mid-flight emits no verdict — that is data, not
+    an error, for the per-attempt record."""
+    try:
+        return _last_json(out)
+    except (ChaosInvariantError, json.JSONDecodeError):
+        return None
+
+
 # ============================================================ invariants
 
 
@@ -411,8 +557,13 @@ def run_case(case: ChaosCase, workdir: str,
     Three runs share nothing but the seed: an uninterrupted baseline, the
     killed-and-resumed run, and a replay of the killed run in a fresh
     directory. Raises :class:`ChaosInvariantError` on any violation;
-    returns the full report for logging.
+    returns the full report for logging. Elastic cases dispatch to
+    :func:`run_elastic_case` (a different invariant set: the world
+    changes shape mid-run, so "bit-match the uninterrupted baseline"
+    is replaced by the shrink-reference equivalence).
     """
+    if case.elastic:
+        return run_elastic_case(case, workdir, devices_per_proc)
     def run(tag: str, c: ChaosCase) -> dict:
         d = os.path.join(workdir, tag)
         os.makedirs(d, exist_ok=True)
@@ -446,16 +597,7 @@ def run_case(case: ChaosCase, workdir: str,
 
     # no quarantined checkpoint is ever restored
     for rep in (baseline, killed, replay):
-        for entry in rep["journal"]:
-            resumed = entry.get("resumed_from")
-            if resumed is None:
-                continue
-            bad = [q for q in entry.get("quarantined", [])
-                   if q.startswith(f"step_{resumed:08d}")]
-            if bad:
-                raise ChaosInvariantError(
-                    f"attempt {entry['attempt']} restored step {resumed} "
-                    f"which sits in quarantine: {bad}")
+        _assert_no_quarantined_resume(rep)
 
     # deterministic replay: identical resume trajectory AND final bytes
     def trajectory(report: dict) -> list:
@@ -485,6 +627,207 @@ def run_case(case: ChaosCase, workdir: str,
     }
 
 
+def _assert_no_quarantined_resume(report: dict) -> None:
+    for entry in report["journal"]:
+        resumed = entry.get("resumed_from")
+        if resumed is None:
+            continue
+        bad = [q for q in entry.get("quarantined", [])
+               if q.startswith(f"step_{resumed:08d}")]
+        if bad:
+            raise ChaosInvariantError(
+                f"attempt {entry['attempt']} restored step {resumed} "
+                f"which sits in quarantine: {bad}")
+
+
+def run_elastic_case(case: ChaosCase, workdir: str,
+                     devices_per_proc: int = 2) -> dict:
+    """The elastic gate: kill one peer, CONTINUE smaller, grow back.
+
+    Four runs, and what each proves:
+
+    1. **killed** — the elastic supervisor run. Attempt 0 arms the
+       one-peer kill; the survivor's heartbeat monitor classifies the
+       hang; the supervisor re-forms the survivors as a ``nprocs-1``
+       world which elastic-restores the full world's checkpoint
+       (re-sharding N→M), trains to ``case.pause_step``, and yields with
+       the classified pause; the grown-back full world re-shards the
+       reduced world's checkpoint (M→N) and finishes. The moment before
+       the shrunken world launches, the checkpoint directory is
+       snapshotted (``on_restart``).
+    2. **shrink reference** — a FRESH ``nprocs-1`` world started from
+       that snapshot, run to the same pause step. Its final params/opt
+       must bit-match the shrunken segment's pause digest: the elastic
+       resume is exactly "a fresh smaller world restoring the same
+       checkpoint", nothing leaked from the dead world.
+    3. **replay** — the whole elastic run again in a fresh directory:
+       identical world sequence, resume trajectory, pause digest, and
+       final digests (seed replay of the elastic leg is deterministic).
+
+    Plus the standing invariants: exact final step count at the full
+    world size, re-shard evidence in the journal (``stored_world``
+    crosses the world sizes both ways), and no quarantined checkpoint
+    ever restored.
+    """
+    import shutil
+
+    if not case.elastic:
+        raise ValueError("run_elastic_case needs an elastic ChaosCase")
+    reduced = case.nprocs - 1
+
+    def run_killed(tag: str, take_snapshot: bool) -> tuple[dict, str]:
+        d = os.path.join(workdir, tag)
+        snap = os.path.join(workdir, f"{tag}_shrink_ref")
+        os.makedirs(d, exist_ok=True)
+
+        def snapshot(attempt):
+            # freeze the checkpoint exactly as the dead world left it,
+            # the instant before the survivors re-form — the shrink
+            # reference restores from THIS copy
+            if attempt == 1 and not os.path.isdir(snap):
+                os.makedirs(snap)
+                for name in os.listdir(d):
+                    if name.startswith("step_"):
+                        shutil.copytree(os.path.join(d, name),
+                                        os.path.join(snap, name))
+
+        report = Supervisor(
+            case, d, devices_per_proc=devices_per_proc,
+            on_restart=snapshot if take_snapshot else None,
+        ).run_to_completion()
+        return report, snap
+
+    killed, snap_dir = run_killed("killed", take_snapshot=True)
+    # the replay leg audits determinism only — no reference run reads a
+    # snapshot of it, so don't pay the copytree
+    replay, _ = run_killed("replay", take_snapshot=False)
+
+    def shrink_attempt(report: dict) -> dict:
+        reduced_attempts = [a for a in report["attempts"] if a["stop_at"]]
+        if not reduced_attempts:
+            raise ChaosInvariantError(
+                "elastic case never re-formed a reduced world — the "
+                "one-peer death did not shrink the fleet")
+        a = reduced_attempts[0]
+        if a["world"] != reduced:
+            raise ChaosInvariantError(
+                f"reduced world has size {a['world']}, expected the "
+                f"{reduced} survivor(s)")
+        paused = [v for v in a["verdicts"]
+                  if v and v.get("status") == "paused"]
+        if len(paused) != reduced:
+            raise ChaosInvariantError(
+                f"reduced world: {len(paused)} paused verdict(s), "
+                f"expected {reduced}: {a['verdicts']}")
+        for v in paused:
+            if v["step"] != case.pause_step:
+                raise ChaosInvariantError(
+                    f"reduced world paused at step {v['step']}, not the "
+                    f"deterministic {case.pause_step}")
+            if v.get("stored_world") != case.nprocs:
+                raise ChaosInvariantError(
+                    f"reduced world resumed a checkpoint written by "
+                    f"world {v.get('stored_world')}, expected the dead "
+                    f"{case.nprocs}-process world (no re-shard happened)")
+        return a
+
+    shrink = shrink_attempt(killed)
+
+    # 2. the shrink reference: a fresh reduced world from the snapshot
+    ref_case = dataclasses.replace(
+        case, kill_signal="", kill_step=0, kill_scope="world",
+        elastic=False, nprocs=reduced, total_steps=case.pause_step)
+    ref = Supervisor(ref_case, snap_dir,
+                     devices_per_proc=devices_per_proc
+                     ).run_to_completion()
+
+    def by_process(verdicts) -> dict[int, str]:
+        return {v["process"]: v["digest"] for v in verdicts}
+
+    shrink_digests = by_process(
+        [v for v in shrink["verdicts"] if v and v.get("status") == "paused"])
+    ref_digests = by_process(ref["verdicts"])
+    if shrink_digests != ref_digests:
+        raise ChaosInvariantError(
+            f"the shrunken world diverged from a fresh {reduced}-process "
+            f"restore of the same checkpoint: {shrink_digests} vs "
+            f"{ref_digests}")
+    if {v["resumed_from"] for v in ref["verdicts"]} != \
+            {v["resumed_from"] for v in shrink["verdicts"] if v}:
+        raise ChaosInvariantError(
+            "shrink reference resumed from a different step than the "
+            "elastic shrink")
+
+    # 3. grow-back: the final world is the full one, exact step count,
+    # and its restore re-sharded the REDUCED world's checkpoint (M→N)
+    for rep, tag in ((killed, "killed"), (replay, "replay")):
+        for v in rep["verdicts"]:
+            if v["step"] != case.total_steps:
+                raise ChaosInvariantError(
+                    f"{tag}: final step {v['step']} != configured "
+                    f"{case.total_steps}")
+            if v["num_processes"] != case.nprocs:
+                raise ChaosInvariantError(
+                    f"{tag}: finished at world size {v['num_processes']}, "
+                    f"never grew back to {case.nprocs}")
+        grow_attempts = [a for a in rep["attempts"]
+                         if a["world"] == case.nprocs and a["attempt"] > 0]
+        if not grow_attempts:
+            raise ChaosInvariantError(f"{tag}: no grow-back attempt ran")
+        grow_no = grow_attempts[0]["attempt"]
+        grow_entries = [e for e in rep["journal"]
+                        if e["attempt"] == grow_no]
+        for e in grow_entries:
+            if e.get("stored_world") != reduced or \
+                    e.get("resumed_from") != case.pause_step:
+                raise ChaosInvariantError(
+                    f"{tag}: grow-back resumed step "
+                    f"{e.get('resumed_from')} written by world "
+                    f"{e.get('stored_world')}; expected step "
+                    f"{case.pause_step} from the {reduced}-process world")
+        _assert_no_quarantined_resume(rep)
+
+    # 4. deterministic replay: identical world sequence, trajectory,
+    # pause digest, final bytes
+    def worlds(report: dict) -> list:
+        return [(a["attempt"], a["world"], a["stop_at"])
+                for a in report["attempts"]]
+
+    def trajectory(report: dict) -> list:
+        return sorted(
+            (e["attempt"], e["process"], e["world"], e["resumed_from"])
+            for e in report["journal"])
+
+    if worlds(replay) != worlds(killed):
+        raise ChaosInvariantError(
+            f"replay world sequence diverged: {worlds(replay)} vs "
+            f"{worlds(killed)}")
+    if trajectory(replay) != trajectory(killed):
+        raise ChaosInvariantError(
+            f"replay resume trajectory diverged: {trajectory(replay)} "
+            f"vs {trajectory(killed)}")
+    if by_process(killed["verdicts"]) != by_process(replay["verdicts"]):
+        raise ChaosInvariantError(
+            f"replay final digests diverged: "
+            f"{by_process(replay['verdicts'])} vs "
+            f"{by_process(killed['verdicts'])}")
+    if by_process([v for v in shrink_attempt(replay)["verdicts"]
+                   if v and v.get("status") == "paused"]) != shrink_digests:
+        raise ChaosInvariantError("replay pause digests diverged")
+
+    return {
+        "case": dataclasses.asdict(case),
+        "attempts": {"killed": len(killed["attempts"]),
+                     "shrink_ref": len(ref["attempts"]),
+                     "replay": len(replay["attempts"])},
+        "worlds": worlds(killed),
+        "pause_digest": sorted(shrink_digests.items()),
+        "digest": sorted(by_process(killed["verdicts"]).items()),
+        "quarantined": killed["quarantined"],
+        "converged": True,
+    }
+
+
 # ===================================================================== CLI
 
 
@@ -501,13 +844,20 @@ def main(argv: Optional[list[str]] = None) -> int:
     ap.add_argument("-signals", default="SIGTERM,SIGKILL")
     ap.add_argument("-nprocs", type=int, default=1, choices=(1, 2))
     ap.add_argument("-save-every", type=int, default=1, dest="save_every")
+    ap.add_argument("-elastic", action="store_true",
+                    help="one-peer kills with shape-shifting resume: "
+                         "continue at the surviving world size, then "
+                         "grow back (forces nprocs=2, kill_scope=one)")
     ap.add_argument("-json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
 
+    nprocs = 2 if args.elastic else args.nprocs
     cases = [
         ChaosCase(seed=s, kill_signal=sig, kill_step=k,
-                  nprocs=args.nprocs, total_steps=args.steps,
-                  save_every=args.save_every)
+                  nprocs=nprocs, total_steps=args.steps,
+                  save_every=args.save_every,
+                  kill_scope="one" if args.elastic else "world",
+                  elastic=args.elastic)
         for s in range(args.seeds)
         for sig in args.signals.split(",")
         for k in (int(x) for x in args.kill_steps.split(","))

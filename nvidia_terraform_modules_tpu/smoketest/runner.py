@@ -125,6 +125,15 @@ def run_smoketest(
         checks["dcn_psum_ok"] = r["ok"]
         checks["dcn_psum_participants"] = r["participants"]
         ok &= r["ok"]
+        # the hierarchy leg: ICI reduce-scatter → DCN psum on the 1/k
+        # chunk → ICI all-gather — the gradient path an elastic resume
+        # re-traces whenever the slice count changes
+        from ..parallel.collectives import hierarchical_psum_probe
+
+        r = hierarchical_psum_probe(ms_mesh, n_elems=1 << 14)
+        checks["hier_psum_ok"] = r["ok"]
+        checks["hier_psum_participants"] = r["participants"]
+        ok &= r["ok"]
 
     if level in ("probes", "burnin", "full") and ok:
         mesh = ms_mesh if ms_mesh is not None else build_mesh(plan_mesh(n_dev))

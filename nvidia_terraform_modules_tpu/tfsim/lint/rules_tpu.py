@@ -32,6 +32,7 @@ class SliceDecl:
     prefer_single_host: object
     origin: str            # "tfvars" | "module call" | "variable default"
     spot: object = None    # resolved literal or None
+    queued: object = None  # queued_provisioning, resolved literal or None
 
 
 def _object_items(expr):
@@ -88,6 +89,7 @@ def _decls_from_object(ctx, fname, expr, origin, defaults=None):
             prefer_single_host=field(fields, "prefer_single_host"),
             origin=origin,
             spot=field(fields, "spot"),
+            queued=field(fields, "queued_provisioning"),
         ))
     return out
 
@@ -95,31 +97,13 @@ def _decls_from_object(ctx, fname, expr, origin, defaults=None):
 def slice_declarations(ctx: LintContext) -> list[SliceDecl]:
     """Every ``tpu_slices = { … }`` object the linter can see statically:
     module-call arguments, tfvars(.example) files, and the declaring
-    variable's own default."""
+    variable's own default. The flat view over :func:`_slice_containers`
+    — ONE traversal serves both the per-slice rules and the
+    per-container elasticity rule."""
     if getattr(ctx, "_slice_decls", None) is not None:
         return ctx._slice_decls
-    decls: list[SliceDecl] = []
-    own_defaults = _optional_defaults(ctx.mod.variables.get("tpu_slices"))
-    for mc in ctx.mod.module_calls.values():
-        a = mc.body.attr("tpu_slices")
-        if a is None:
-            continue
-        child = ctx.child_modules().get(mc.name)
-        child_defaults = _optional_defaults(
-            child.variables.get("tpu_slices") if child else None)
-        decls.extend(_decls_from_object(
-            ctx, mc.file, a.expr, f"module {mc.name!r} call",
-            defaults=child_defaults))
-    for fname, body in ctx.tfvars_bodies():
-        a = body.attr("tpu_slices")
-        if a is not None:
-            decls.extend(_decls_from_object(ctx, fname, a.expr, "tfvars",
-                                            defaults=own_defaults))
-    v = ctx.mod.variables.get("tpu_slices")
-    if v is not None and v.default is not None:
-        decls.extend(_decls_from_object(
-            ctx, v.file, v.default, "variable default",
-            defaults=own_defaults))
+    decls = [d for _fname, _nap, ds, _origin in _slice_containers(ctx)
+             for d in ds]
     ctx._slice_decls = decls
     return decls
 
@@ -410,7 +394,9 @@ def check_spot_no_grace(ctx: LintContext):
     headroom, so a pod spec that leaves the default (or sets less than
     ~2× the budget) loses the step it was promised to keep. Fires only
     when the module statically provisions spot/preemptible TPU capacity
-    AND a kubernetes workload schedules onto TPU nodes."""
+    AND a kubernetes workload schedules onto TPU nodes. (For *multislice*
+    spot fleets the fleet-level twin is ``tpu-multislice-no-elastic``:
+    grace saves the step, an autoscaler range saves the fleet.)"""
     spot_origin = None
     for r, flag in _spot_tpu_pools(ctx):
         spot_origin = f"{r.address} ({flag})"
@@ -448,6 +434,128 @@ def check_spot_no_grace(ctx: LintContext):
                        f"SIGTERM drain plus the emergency checkpoint "
                        f"(TPU_SMOKETEST_GRACE_SECONDS, default 30s) "
                        f"needs the full window")
+
+
+def _slice_containers(ctx: LintContext):
+    """Every place a whole ``tpu_slices`` map is declared — as
+    ``(fname, nap_expr, [SliceDecl, …], origin)`` — with the
+    ``node_auto_provisioning`` expression that travels WITH that map:
+    the sibling argument for module calls and tfvars, the module's own
+    ``node_auto_provisioning`` variable default for the variable-default
+    container. Reuses :func:`_decls_from_object` so ``optional()``
+    default inheritance has exactly one implementation."""
+    def nap_of(body):
+        a = body.attr("node_auto_provisioning") if body else None
+        return a.expr if a is not None else None
+
+    for mc in ctx.mod.module_calls.values():
+        a = mc.body.attr("tpu_slices")
+        if a is None:
+            continue
+        child = ctx.child_modules().get(mc.name)
+        defaults = _optional_defaults(
+            child.variables.get("tpu_slices") if child else None)
+        # an absent NAP argument inherits the child's own variable
+        # default, exactly like the slice fields inherit optional()s
+        child_nap = child.variables.get("node_auto_provisioning") \
+            if child else None
+        yield (mc.file,
+               nap_of(mc.body) if mc.body.attr("node_auto_provisioning")
+               is not None else
+               (child_nap.default if child_nap is not None else None),
+               _decls_from_object(ctx, mc.file, a.expr,
+                                  f"module {mc.name!r} call",
+                                  defaults=defaults),
+               f"module {mc.name!r} call")
+    own_defaults = _optional_defaults(ctx.mod.variables.get("tpu_slices"))
+    own_nap = ctx.mod.variables.get("node_auto_provisioning")
+    own_nap_expr = own_nap.default if own_nap is not None else None
+    for fname, body in ctx.tfvars_bodies():
+        a = body.attr("tpu_slices")
+        if a is not None:
+            yield (fname, nap_of(body) or own_nap_expr,
+                   _decls_from_object(ctx, fname, a.expr, "tfvars",
+                                      defaults=own_defaults),
+                   "tfvars")
+    v = ctx.mod.variables.get("tpu_slices")
+    if v is not None and v.default is not None:
+        yield (v.file, own_nap_expr,
+               _decls_from_object(ctx, v.file, v.default,
+                                  "variable default",
+                                  defaults=own_defaults),
+               "variable default")
+
+
+def _nap_grants_tpu_range(ctx: LintContext, expr) -> bool:
+    """True when a ``node_auto_provisioning`` expression statically
+    enables NAP **with a TPU resource range** — the autoscaler posture
+    that lets a reclaimed slice's capacity come back without a human
+    apply. ``enabled = true`` alone is not enough: NAP only provisions
+    what ``resource_limits`` allows, so without a ``tpu-…-chips`` entry
+    the fleet still cannot grow back. A ``resource_limits`` that is not
+    statically a list (a var reference) gets the benefit of the doubt —
+    pre-flight lint must not false-positive a config it cannot see."""
+    if not isinstance(expr, A.ObjectExpr):
+        return False
+    fields = {k: v for k, v, _ in _object_items(expr)}
+    if "enabled" not in fields or \
+            ctx.resolve_literal(fields["enabled"]) is not True:
+        return False
+    limits = fields.get("resource_limits")
+    if limits is None:
+        return False
+    if not isinstance(limits, A.TupleExpr):
+        return True   # statically opaque: assume the operator sized it
+    for item in limits.items:
+        if not isinstance(item, A.ObjectExpr):
+            continue
+        entry = {k: v for k, v, _ in _object_items(item)}
+        rtype = ctx.resolve_literal(entry.get("resource_type")) \
+            if "resource_type" in entry else None
+        if isinstance(rtype, str) and "tpu" in rtype:
+            return True
+    return False
+
+
+@rule("tpu-multislice-no-elastic", severity="warning", family="tpu",
+      summary="spot multislice fleet with a pinned slice count and no "
+              "autoscaler range or queued grow-back path")
+def check_multislice_no_elastic(ctx: LintContext):
+    """A multislice fleet (≥ 2 ``tpu_slices`` entries) on spot capacity
+    WILL shrink — preemption reclaims whole slices, and the elastic
+    runtime (``models/resilience.py``, ``TPU_ELASTIC_MIN_WORLD``) keeps
+    training on the survivors — but only the *infrastructure* can grow
+    the fleet back. A config that pins the slice count (a fixed
+    ``tpu_slices`` map declares exactly N pools of exactly ``hosts``
+    nodes each) while enabling spot, with ``node_auto_provisioning``
+    disabled and no ``queued_provisioning`` slice, has no grow-back path
+    at all: the world shrinks monotonically until it hits the elastic
+    floor and the job dies anyway — the autoscaling the spot discount
+    was supposed to buy never happens. The third leg of the spot
+    tripod: ``tpu-spot-no-recovery`` is the pool's retry posture,
+    ``tpu-spot-no-grace`` saves the *step*, this rule saves the
+    *fleet*."""
+    for fname, nap_expr, slices, origin in _slice_containers(ctx):
+        if len(slices) < 2:
+            continue
+        spot = [s for s in slices if s.spot is True]
+        if not spot:
+            continue
+        if any(s.queued is True for s in slices):
+            continue   # DWS flex-start slices ARE a grow-back path
+        if _nap_grants_tpu_range(ctx, nap_expr):
+            continue
+        first = spot[0]
+        yield (f"{fname}:{first.line}",
+               f"tpu_slices[{first.name!r}] ({origin}): {len(spot)} of "
+               f"{len(slices)} slices are spot but the slice count is "
+               f"pinned with no autoscaler range — a reclaimed slice "
+               f"shrinks the training world and nothing grows it back "
+               f"(elastic resume only keeps the survivors alive, down to "
+               f"TPU_ELASTIC_MIN_WORLD); enable node_auto_provisioning "
+               f"with a TPU resource_limits range, or make one slice "
+               f"queued_provisioning so returned capacity rejoins the "
+               f"fleet")
 
 
 @rule("tpu-multihost-placement", severity="error", family="tpu",

@@ -71,6 +71,7 @@ class RetriesExhausted(Exception):
 def retry_call(fn: Callable, *, policy: RetryPolicy,
                what: str = "operation",
                retryable: tuple = (Exception,),
+               giveup: Optional[Callable[[BaseException], bool]] = None,
                rng: Optional[random.Random] = None,
                sleep: Callable[[float], None] = time.sleep,
                log: Optional[Callable[[str], None]] = None):
@@ -78,10 +79,14 @@ def retry_call(fn: Callable, *, policy: RetryPolicy,
 
     Only ``retryable`` exceptions are retried; anything else propagates
     immediately (terminal faults must fail fast, exactly like the
-    simulator's retryable-vs-terminal split). When the budget runs out
-    the last error is wrapped in :class:`RetriesExhausted` so callers
-    can report a *classified*, attempt-counted failure instead of the
-    bare final exception.
+    simulator's retryable-vs-terminal split). ``giveup`` refines the
+    split *within* a retryable type: an exception it returns True for
+    propagates untouched — the lever for exception hierarchies where a
+    subtype is terminal (a corrupt checkpoint inside the transient
+    checkpoint-error family). When the budget runs out the last error
+    is wrapped in :class:`RetriesExhausted` so callers can report a
+    *classified*, attempt-counted failure instead of the bare final
+    exception.
     """
     t0 = time.monotonic()
     delays = policy.delays(rng)
@@ -91,6 +96,8 @@ def retry_call(fn: Callable, *, policy: RetryPolicy,
         try:
             return fn()
         except retryable as exc:  # noqa: PERF203 — retry loop by design
+            if giveup is not None and giveup(exc):
+                raise
             delay = next(delays, None)
             if delay is None:
                 raise RetriesExhausted(

@@ -2,10 +2,11 @@
 # SPDX-License-Identifier: Apache-2.0
 """Kill-and-resume chaos gate: the training-stack mirror of the
 ``tfsim chaos`` convergence gate in tests/test_tfsim_faults.py, layered
-the same way — ONE seeded kill-and-resume case plus the checkpoint-
-corruption path stay tier-1; the full seeds × signal × kill-step × world
-matrix (including the 2-process gloo worlds and the dead-peer
-classification) is slow-marked.
+the same way — ONE seeded kill-and-resume case, ONE seeded *elastic*
+(shrink/continue/grow-back) case, plus the checkpoint-corruption path
+stay tier-1; the full seeds × signal × kill-step × world matrix
+(including the 2-process gloo worlds, the dead-peer classification, and
+the elastic shrink/grow matrix) is slow-marked.
 
 Every case asserts the exact-resume invariants inside
 ``smoketest.chaos.run_case``: final params/opt-state bit-match an
@@ -14,6 +15,7 @@ count is exact, no quarantined checkpoint is ever restored, and repeated
 kill-at-step-k replays are deterministic.
 """
 
+import dataclasses
 import glob
 import os
 
@@ -34,6 +36,78 @@ def test_chaos_case_validation():
         ChaosCase(seed=0, kill_signal="SIGKILL", kill_scope="one", nprocs=1)
     with pytest.raises(ValueError):
         ChaosCase(seed=0, kill_signal="SIGKILL", kill_scope="some")
+
+
+def test_elastic_case_validation():
+    # elastic needs an armed ONE-peer kill (a whole-world kill leaves no
+    # survivors) and room to pause before the configured total
+    with pytest.raises(ValueError, match="one-peer"):
+        ChaosCase(seed=0, kill_signal="SIGKILL", kill_step=3, nprocs=2,
+                  total_steps=6, elastic=True)
+    with pytest.raises(ValueError, match="one-peer"):
+        ChaosCase(seed=0, kill_signal="", nprocs=2, kill_scope="one",
+                  elastic=True)
+    with pytest.raises(ValueError, match="total_steps"):
+        ChaosCase(seed=0, kill_signal="SIGKILL", kill_step=4, nprocs=2,
+                  total_steps=5, kill_scope="one", elastic=True)
+    # a kill before the first commit would leave nothing to re-shard —
+    # reject the config up front, not as a misleading invariant failure
+    with pytest.raises(ValueError, match="save_every"):
+        ChaosCase(seed=0, kill_signal="SIGKILL", kill_step=1, nprocs=2,
+                  total_steps=6, kill_scope="one", elastic=True)
+    with pytest.raises(ValueError, match="save_every"):
+        ChaosCase(seed=0, kill_signal="SIGKILL", kill_step=2, nprocs=2,
+                  total_steps=6, save_every=2, kill_scope="one",
+                  elastic=True)
+    ok = ChaosCase(seed=0, kill_signal="SIGKILL", kill_step=3, nprocs=2,
+                   total_steps=6, kill_scope="one", elastic=True)
+    assert ok.pause_step == 4
+
+
+def test_elastic_restart_schedule_is_evidence_driven(tmp_path):
+    """The shrink decision needs evidence a peer is GONE — the
+    survivor's classified EXIT_PEER_DEAD or a signal death. Transient
+    failures with every peer alive (positive exit codes: a corruption
+    retry, an init timeout) keep the current shape; the classified
+    pause grows back."""
+    from nvidia_terraform_modules_tpu.models.resilience import (
+        EXIT_ELASTIC_PAUSE,
+        EXIT_PEER_DEAD,
+    )
+
+    case = ChaosCase(seed=0, kill_signal="SIGKILL", kill_step=3, nprocs=2,
+                     total_steps=6, kill_scope="one", elastic=True)
+    sup = Supervisor(case, str(tmp_path))
+    assert sup._plan_attempt(None, 2) == (2, 0)            # attempt 0
+    assert sup._plan_attempt([-9, EXIT_PEER_DEAD], 2) == (1, 4)  # kill
+    assert sup._plan_attempt([EXIT_PEER_DEAD], 2) == (1, 4)
+    assert sup._plan_attempt([1, 1], 2) == (2, 0)          # transient
+    assert sup._plan_attempt([1], 1) == (1, 4)             # stay reduced
+    assert sup._plan_attempt([EXIT_ELASTIC_PAUSE], 1) == (2, 0)  # grow
+    # non-elastic: always the configured shape
+    plain = Supervisor(dataclasses.replace(
+        case, elastic=False, kill_scope="world"), str(tmp_path))
+    assert plain._plan_attempt([-9], 1) == (2, 0)
+
+
+def test_elastic_one_peer_kill_shrinks_then_grows_back_tier1(tmp_path):
+    """THE elastic acceptance gate, tier-1: a seeded one-peer SIGKILL in
+    a 2-process gloo world. The survivor classifies the dead peer, the
+    supervisor re-forms a 1-process world that elastic-restores the
+    2-process checkpoint and CONTINUES (its pause-step params bit-match
+    a fresh 1-process restore from the same checkpoint — asserted inside
+    run_elastic_case), then grows back to 2 processes with the exact
+    step count, no quarantined checkpoint restored, and a deterministic
+    seed replay of the whole elastic leg."""
+    report = run_case(
+        ChaosCase(seed=0, kill_signal="SIGKILL", kill_step=3, nprocs=2,
+                  total_steps=6, kill_scope="one", elastic=True),
+        str(tmp_path))
+    assert report["converged"] is True
+    # the world sequence: full → survivors (paused at kill+1) → full
+    assert [(w, s) for _, w, s in report["worlds"]] == \
+        [(2, 0), (1, 4), (2, 0)]
+    assert report["quarantined"] == []   # clean kill: no bad bytes
 
 
 def test_seeded_sigkill_resume_exact_tier1(tmp_path):
@@ -172,3 +246,63 @@ def test_chaos_cli_smoke(tmp_path):
 
     assert main(["-seeds", "1", "-steps", "5", "-kill-steps", "2",
                  "-signals", "SIGKILL"]) == 0
+
+
+# ------------------------------------------- slow elastic shrink/grow matrix
+
+_ELASTIC_MATRIX = [
+    ChaosCase(seed=s, kill_signal=sig, kill_step=k, nprocs=2,
+              total_steps=7, kill_scope="one", elastic=True)
+    for s, sig, k in (
+        (0, "SIGTERM", 2),
+        (0, "SIGTERM", 4),
+        (0, "SIGKILL", 2),
+        (0, "SIGKILL", 4),
+        (1, "SIGKILL", 3),
+    )
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "case", _ELASTIC_MATRIX,
+    ids=[f"seed{c.seed}-{c.kill_signal}@{c.kill_step}"
+         for c in _ELASTIC_MATRIX])
+def test_elastic_matrix_two_process(case, tmp_path):
+    """The full shrink/continue/grow-back matrix: both signals (SIGTERM
+    drains the killed step, SIGKILL loses it), early and late kills,
+    a second seed — every case must shrink to 1, bit-match the fresh
+    shrink reference, grow back to 2, and replay deterministically."""
+    report = run_case(case, str(tmp_path))
+    assert report["converged"] is True
+    assert [w for _, w, _ in report["worlds"]] == [2, 1, 2]
+
+
+@pytest.mark.slow
+def test_elastic_min_world_floor_escalates(tmp_path):
+    """TPU_ELASTIC_MIN_WORLD above the survivor count must refuse to
+    re-form a too-small world — the supervisor escalates loudly instead
+    of limping below the floor."""
+    import os
+
+    from nvidia_terraform_modules_tpu.models.resilience import (
+        ElasticWorldError,
+    )
+
+    case = ChaosCase(seed=0, kill_signal="SIGKILL", kill_step=3, nprocs=2,
+                     total_steps=6, kill_scope="one", elastic=True)
+    os.environ["TPU_ELASTIC_MIN_WORLD"] = "2"
+    try:
+        with pytest.raises(ElasticWorldError):
+            Supervisor(case, str(tmp_path)).run_to_completion()
+    finally:
+        del os.environ["TPU_ELASTIC_MIN_WORLD"]
+
+
+@pytest.mark.slow
+def test_chaos_cli_elastic_smoke(tmp_path):
+    """-elastic drives the shrink/grow gate through the CLI."""
+    from nvidia_terraform_modules_tpu.smoketest.chaos import main
+
+    assert main(["-seeds", "1", "-steps", "6", "-kill-steps", "3",
+                 "-signals", "SIGKILL", "-elastic"]) == 0

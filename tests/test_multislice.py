@@ -160,3 +160,115 @@ def test_smoketest_multislice_env(jax8):
     assert res.checks["dcn_psum_ok"]
     assert res.checks["dcn_psum_participants"] == 2
     assert res.checks["mesh"]["slice"] == 2
+
+
+# --------------------------------------------- elastic worlds over DCN
+# (the elastic-multislice tentpole: the slice count is a variable — the
+# hierarchical psum and the mesh planner both re-trace to whatever
+# topology the resumed world actually has)
+
+
+def _hier_sum(mesh, x):
+    """Run hierarchical_psum over a replicated input inside shard_map."""
+    import functools
+
+    from nvidia_terraform_modules_tpu.parallel import hierarchical_psum
+    from nvidia_terraform_modules_tpu.utils.compat import shard_map
+
+    def kernel():
+        i = jnp.float32(0.0)
+        for a in ("slice", "dp"):
+            if a in mesh.axis_names:
+                i = i * mesh.shape[a] + \
+                    jax.lax.axis_index(a).astype(jnp.float32)
+        return hierarchical_psum(x + i, mesh)
+
+    # check_vma=False: replication of the RS→AR→AG composition is real
+    # but not statically inferrable (same situation as the pallas calls)
+    return jax.jit(functools.partial(
+        shard_map, mesh=mesh, in_specs=(), out_specs=P(),
+        check_vma=False)(kernel))()
+
+
+def _expected(mesh, x):
+    import numpy as np
+
+    m = 1
+    for a in ("slice", "dp"):
+        if a in mesh.axis_names:
+            m *= mesh.shape[a]
+    return m * np.asarray(x) + m * (m - 1) / 2
+
+
+def test_hierarchical_psum_matches_flat_sum(jax8):
+    """RS(ICI) → AR(DCN on 1/k) → AG(ICI) must equal the flat psum over
+    (slice × dp) — including the padding path (element count not
+    divisible by the inner degree)."""
+    import numpy as np
+
+    mesh = build_multislice_mesh(plan_multislice(8, 2, tp=2))  # dp=2
+    for shape in ((8,), (5, 3)):   # 15 elements: pad for k=2
+        x = jnp.arange(float(np.prod(shape))).reshape(shape)
+        out = _hier_sum(mesh, x)
+        np.testing.assert_allclose(np.asarray(out), _expected(mesh, x),
+                                   rtol=1e-6)
+
+
+def test_hierarchical_psum_tolerates_missing_or_unit_slice_axis(jax8):
+    """The elastic contract: after a shrink the re-formed mesh may have
+    slice == 1 (or no slice axis at all) — the same call degrades to the
+    plain ICI psum instead of tracing a dead DCN stage."""
+    import numpy as np
+
+    from nvidia_terraform_modules_tpu.parallel import (
+        build_mesh,
+        plan_elastic_multislice,
+        plan_mesh,
+    )
+
+    x = jnp.arange(6.0)
+    # slice axis of size 1 (the degenerate multislice plan)
+    m1 = build_multislice_mesh(plan_elastic_multislice(8, 1, tp=2))
+    np.testing.assert_allclose(np.asarray(_hier_sum(m1, x)),
+                               _expected(m1, x), rtol=1e-6)
+    # no slice axis at all (a plain single-slice mesh)
+    m2 = build_mesh(plan_mesh(8, tp=2))
+    np.testing.assert_allclose(np.asarray(_hier_sum(m2, x)),
+                               _expected(m2, x), rtol=1e-6)
+
+
+def test_hierarchical_psum_probe_on_multislice_mesh(jax8):
+    from nvidia_terraform_modules_tpu.parallel import (
+        hierarchical_psum_probe,
+    )
+
+    mesh = build_multislice_mesh(plan_multislice(8, 2, tp=2))
+    r = hierarchical_psum_probe(mesh, n_elems=1 << 10)
+    assert r["ok"], r
+    assert r["participants"] == 4          # 2 slices × dp 2
+    assert r["dcn_bytes"] > 0 and r["ici_bytes"] > r["dcn_bytes"]
+
+
+def test_plan_elastic_multislice_shrinks_to_feasible_slice_count():
+    from nvidia_terraform_modules_tpu.parallel import (
+        plan_elastic_multislice,
+    )
+
+    # full fleet: preferred count fits
+    assert plan_elastic_multislice(8, 2, tp=2).shape[0] == 2
+    # a whole slice died: 4 devices still form 2 slices of 2
+    assert plan_elastic_multislice(4, 2, tp=1).shape[0] == 2
+    # odd survivor count: 6 devices, preferred 4 → 3 slices of 2
+    assert plan_elastic_multislice(6, 4, tp=1).shape[0] == 3
+    # last survivor: degenerate but still slice-shaped
+    p = plan_elastic_multislice(1, 2)
+    assert p.axis_names[0] == "slice" and p.shape[0] == 1
+    with pytest.raises(ValueError):
+        plan_elastic_multislice(8, 0)
+
+
+def test_smoketest_reports_hierarchical_psum(jax8):
+    res = run_smoketest(level="psum", env={"TPU_SMOKETEST_SLICES": "2"})
+    assert res.ok
+    assert res.checks["hier_psum_ok"]
+    assert res.checks["hier_psum_participants"] == 2

@@ -324,3 +324,173 @@ def test_supervised_loop_resume_contract(tmp_path):
         assert outcome.status == "completed"
         assert outcome.step == 6 and outcome.resumed_from == 2
         assert trail == [3, 4, 5, 6]
+
+
+# ------------------------------------------------------- elastic worlds
+# (shape-shifting resume: the world size is a variable, not a constant)
+
+
+def test_elastic_config_validation_and_env():
+    from nvidia_terraform_modules_tpu.models import (
+        ElasticConfig,
+        elastic_from_env,
+    )
+
+    cfg = elastic_from_env(4, env={})
+    assert cfg == ElasticConfig(desired_world=4, min_world=1,
+                                grow_back=True)
+    cfg = elastic_from_env(4, env={"TPU_ELASTIC_MIN_WORLD": "2",
+                                   "TPU_ELASTIC_GROW_BACK": "0"})
+    assert cfg.min_world == 2 and cfg.grow_back is False
+    with pytest.raises(ValueError):
+        ElasticConfig(desired_world=2, min_world=3)
+    with pytest.raises(ValueError):
+        ElasticConfig(desired_world=0)
+    with pytest.raises(ValueError):
+        ElasticConfig(desired_world=2, min_world=0)
+
+
+def test_plan_world_size_shrinks_grows_and_floors():
+    from nvidia_terraform_modules_tpu.models import (
+        ElasticConfig,
+        ElasticWorldError,
+        plan_world_size,
+    )
+
+    cfg = ElasticConfig(desired_world=4, min_world=2)
+    assert plan_world_size(3, cfg, current=4) == 3      # shrink
+    assert plan_world_size(2, cfg, current=3) == 2      # to the floor
+    assert plan_world_size(4, cfg, current=2) == 4      # capacity back
+    assert plan_world_size(9, cfg, current=4) == 4      # never overgrow
+    with pytest.raises(ElasticWorldError):
+        plan_world_size(1, cfg, current=2)              # below the floor
+    pinned = ElasticConfig(desired_world=4, min_world=1, grow_back=False)
+    assert plan_world_size(4, pinned, current=2) == 2   # growth pinned
+    assert plan_world_size(1, pinned, current=2) == 1   # shrink still ok
+
+
+def test_classify_exit_maps_the_protocol_codes():
+    from nvidia_terraform_modules_tpu.models import classify_exit
+    from nvidia_terraform_modules_tpu.models.resilience import (
+        EXIT_ELASTIC_PAUSE,
+        EXIT_PEER_DEAD,
+        EXIT_PREEMPTED,
+    )
+
+    assert classify_exit(0) == "completed"
+    assert classify_exit(EXIT_PREEMPTED) == "preempted"
+    assert classify_exit(EXIT_PEER_DEAD) == "peer_dead"
+    assert classify_exit(EXIT_ELASTIC_PAUSE) == "elastic_pause"
+    assert classify_exit(-9) == "error"    # raw SIGKILL death
+    assert classify_exit(1) == "error"
+
+
+def test_supervised_loop_restore_retries_transient_then_succeeds():
+    """The restart-policy fix: a classified transient checkpoint failure
+    during RESTORE (rendezvous timeout — a peer slow to restart) costs
+    backoff-spaced retries, not the attempt."""
+    from nvidia_terraform_modules_tpu.models import (
+        ResilienceConfig,
+        SupervisedLoop,
+    )
+    from nvidia_terraform_modules_tpu.models.checkpoint import (
+        CheckpointError,
+    )
+    from nvidia_terraform_modules_tpu.utils.retry import RetryPolicy
+
+    calls = []
+
+    class FlakyCkpt:
+        def restore_tree(self, abstract, step=None):
+            calls.append(step)
+            if len(calls) < 3:
+                raise CheckpointError("checkpoint rendezvous timed out")
+            return ({"w": 1}, 7, {})
+
+    cfg = ResilienceConfig(restore_policy=RetryPolicy(
+        initial_s=0.001, multiplier=2.0, cap_s=0.002, max_attempts=4,
+        jitter=False))
+    loop = SupervisedLoop(FlakyCkpt(), cfg, total_steps=1)
+    assert loop.restore(object()) == ({"w": 1}, 7, {})
+    assert len(calls) == 3
+
+
+def test_supervised_loop_restore_corrupt_is_terminal():
+    """A corrupt step must NOT be hammered: quarantine-and-fallback owns
+    that path, and an explicit-step corruption escalates immediately."""
+    from nvidia_terraform_modules_tpu.models import (
+        ResilienceConfig,
+        SupervisedLoop,
+    )
+    from nvidia_terraform_modules_tpu.models.checkpoint import (
+        CorruptCheckpointError,
+    )
+    from nvidia_terraform_modules_tpu.utils.retry import RetryPolicy
+
+    calls = []
+
+    class CorruptCkpt:
+        def restore_tree(self, abstract, step=None):
+            calls.append(step)
+            raise CorruptCheckpointError(3, "crc32 mismatch")
+
+    cfg = ResilienceConfig(restore_policy=RetryPolicy(
+        initial_s=0.001, cap_s=0.002, max_attempts=5, jitter=False))
+    loop = SupervisedLoop(CorruptCkpt(), cfg, total_steps=1)
+    with pytest.raises(CorruptCheckpointError):
+        loop.restore(object(), step=3)
+    assert len(calls) == 1
+
+
+def test_supervised_loop_restore_missing_explicit_step_is_terminal():
+    """An explicitly requested step that retention pruned is a
+    deterministic outcome — surface it immediately, never burn the
+    backoff budget on it."""
+    from nvidia_terraform_modules_tpu.models import (
+        MissingStepError,
+        ResilienceConfig,
+        SupervisedLoop,
+    )
+    from nvidia_terraform_modules_tpu.utils.retry import RetryPolicy
+
+    calls = []
+
+    class PrunedCkpt:
+        def restore_tree(self, abstract, step=None):
+            calls.append(step)
+            raise MissingStepError(f"checkpoint step {step} does not "
+                                   f"exist")
+
+    cfg = ResilienceConfig(restore_policy=RetryPolicy(
+        initial_s=0.001, cap_s=0.002, max_attempts=5, jitter=False))
+    loop = SupervisedLoop(PrunedCkpt(), cfg, total_steps=1)
+    with pytest.raises(MissingStepError):
+        loop.restore(object(), step=9)
+    assert calls == [9]
+
+
+def test_retry_call_giveup_predicate_overrides_retryable():
+    from nvidia_terraform_modules_tpu.utils.retry import (
+        RetryPolicy,
+        retry_call,
+    )
+
+    class Transient(RuntimeError):
+        pass
+
+    class Terminal(Transient):
+        pass
+
+    attempts = []
+
+    def fn():
+        attempts.append(1)
+        raise Terminal("no point retrying")
+
+    with pytest.raises(Terminal):
+        retry_call(fn, policy=RetryPolicy(initial_s=0.001, cap_s=0.002,
+                                          max_attempts=5, jitter=False),
+                   retryable=(Transient,),
+                   giveup=lambda e: isinstance(e, Terminal),
+                   sleep=lambda s: None)
+    assert len(attempts) == 1

@@ -667,3 +667,237 @@ resource "kubernetes_job_v1" "work" {
 """
     findings = _lint_grace(_write(tmp_path, (SPOT_POOL % "") + job))
     assert len(findings) == 1
+
+
+# ---------------------------------------------------- multislice elasticity
+# (`tpu-multislice-no-elastic`: a spot multislice fleet with a pinned
+# slice count has no grow-back path — the fleet-level leg of the spot
+# tripod next to tpu-spot-no-recovery / tpu-spot-no-grace)
+
+_FLEET = """
+variable "tpu_slices" {
+  description = "slices"
+  type        = any
+  default = {
+%s
+  }
+}
+
+output "echo" {
+  description = "keep used"
+  value       = var.tpu_slices
+}
+%s
+"""
+
+_TWO_SPOT = """    slice-0 = { version = "v5e" topology = "2x4" spot = true }
+    slice-1 = { version = "v5e" topology = "2x4" spot = true }"""
+
+
+def _lint_elastic(path):
+    from nvidia_terraform_modules_tpu.tfsim.lint import run_lint
+
+    return [f for f in run_lint(path)
+            if f.rule == "tpu-multislice-no-elastic"]
+
+
+def test_multislice_no_elastic_fires_on_pinned_spot_fleet(tmp_path):
+    findings = _lint_elastic(_write(tmp_path, _FLEET % (_TWO_SPOT, "")))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.severity == "warning"
+    assert "2 of 2 slices are spot" in f.message
+    assert "TPU_ELASTIC_MIN_WORLD" in f.message
+    assert "node_auto_provisioning" in f.message
+
+
+def test_multislice_no_elastic_silent_on_single_slice(tmp_path):
+    one = '    only = { version = "v5e" topology = "2x4" spot = true }'
+    assert _lint_elastic(_write(tmp_path, _FLEET % (one, ""))) == []
+
+
+def test_multislice_no_elastic_silent_without_spot(tmp_path):
+    on_demand = _TWO_SPOT.replace("spot = true", "spot = false")
+    assert _lint_elastic(_write(tmp_path, _FLEET % (on_demand, ""))) == []
+
+
+def test_multislice_no_elastic_satisfied_by_queued_slice(tmp_path):
+    """A DWS flex-start slice IS the grow-back path: returned capacity
+    rejoins the fleet without a human apply."""
+    fleet = (_TWO_SPOT + "\n    growback = { version = \"v5e\" "
+             "topology = \"2x4\" queued_provisioning = true }")
+    assert _lint_elastic(_write(tmp_path, _FLEET % (fleet, ""))) == []
+
+
+def test_multislice_no_elastic_satisfied_by_nap_in_module_call(tmp_path):
+    """node_auto_provisioning = { enabled = true } next to the slice map
+    (the gke-tpu call shape) grants the autoscaler range."""
+    d = tmp_path / "caller"
+    (d / "fleet").mkdir(parents=True)
+    (d / "fleet" / "main.tf").write_text("""
+variable "tpu_slices" {
+  description = "slices"
+  type        = any
+  default     = {}
+}
+
+variable "node_auto_provisioning" {
+  description = "nap"
+  type        = any
+  default     = {}
+}
+
+output "echo" {
+  description = "keep used"
+  value       = [var.tpu_slices, var.node_auto_provisioning]
+}
+""")
+    call = """
+module "fleet" {
+  source = "./fleet"
+
+  tpu_slices = {
+    slice-0 = { version = "v5e" topology = "2x4" spot = true }
+    slice-1 = { version = "v5e" topology = "2x4" spot = true }
+  }
+%s
+}
+"""
+    (d / "main.tf").write_text(call % "")
+    pinned = _lint_elastic(str(d))
+    assert len(pinned) == 1 and "module 'fleet' call" in pinned[0].message
+    (d / "main.tf").write_text(call % (
+        "  node_auto_provisioning = {\n    enabled = true\n"
+        "    resource_limits = [{ resource_type = "
+        "\"tpu-v5-lite-podslice-chips\" maximum = 32 }]\n  }\n"))
+    assert _lint_elastic(str(d)) == []
+    # enabled alone is NOT a grow-back path: NAP only provisions what
+    # resource_limits allows, and a CPU-only range cannot re-add slices
+    (d / "main.tf").write_text(call % (
+        "  node_auto_provisioning = {\n    enabled = true\n  }\n"))
+    assert len(_lint_elastic(str(d))) == 1
+    (d / "main.tf").write_text(call % (
+        "  node_auto_provisioning = {\n    enabled = true\n"
+        "    resource_limits = [{ resource_type = \"cpu\" "
+        "maximum = 64 }]\n  }\n"))
+    assert len(_lint_elastic(str(d))) == 1
+
+
+def test_multislice_no_elastic_child_nap_default_counts(tmp_path):
+    """A module call that leaves node_auto_provisioning unset inherits
+    the CHILD module's variable default — a child that defaults NAP on
+    with a TPU range must not be flagged."""
+    d = tmp_path / "caller"
+    (d / "fleet").mkdir(parents=True)
+    (d / "fleet" / "main.tf").write_text("""
+variable "tpu_slices" {
+  description = "slices"
+  type        = any
+  default     = {}
+}
+
+variable "node_auto_provisioning" {
+  description = "nap"
+  type        = any
+  default = {
+    enabled = true
+    resource_limits = [{ resource_type = "tpu-v5-lite-podslice-chips" maximum = 32 }]
+  }
+}
+
+output "echo" {
+  description = "keep used"
+  value       = [var.tpu_slices, var.node_auto_provisioning]
+}
+""")
+    (d / "main.tf").write_text("""
+module "fleet" {
+  source = "./fleet"
+
+  tpu_slices = {
+    slice-0 = { version = "v5e" topology = "2x4" spot = true }
+    slice-1 = { version = "v5e" topology = "2x4" spot = true }
+  }
+}
+""")
+    assert _lint_elastic(str(d)) == []
+    # an EXPLICIT NAP argument on the call overrides the child default
+    (d / "main.tf").write_text("""
+module "fleet" {
+  source = "./fleet"
+
+  tpu_slices = {
+    slice-0 = { version = "v5e" topology = "2x4" spot = true }
+    slice-1 = { version = "v5e" topology = "2x4" spot = true }
+  }
+  node_auto_provisioning = {
+    enabled = false
+  }
+}
+""")
+    assert len(_lint_elastic(str(d))) == 1
+
+
+def test_multislice_no_elastic_nap_variable_default_counts(tmp_path):
+    """A module whose own node_auto_provisioning variable DEFAULT grants
+    the TPU range must not be flagged for its tpu_slices variable
+    default — the two defaults travel together."""
+    d = tmp_path / "lintmod"
+    d.mkdir(exist_ok=True)
+    body = """
+variable "tpu_slices" {
+  description = "slices"
+  type        = any
+  default = {
+    slice-0 = { version = "v5e" topology = "2x4" spot = true }
+    slice-1 = { version = "v5e" topology = "2x4" spot = true }
+  }
+}
+
+variable "node_auto_provisioning" {
+  description = "nap"
+  type        = any
+  default = {
+    enabled = true
+    resource_limits = [{ resource_type = "tpu-v5-lite-podslice-chips" maximum = 32 }]
+  }
+}
+
+output "echo" {
+  description = "keep used"
+  value       = [var.tpu_slices, var.node_auto_provisioning]
+}
+"""
+    (d / "main.tf").write_text(body)
+    assert _lint_elastic(str(d)) == []
+    # drop the TPU entry from the range: the warning comes back
+    (d / "main.tf").write_text(body.replace(
+        'resource_type = "tpu-v5-lite-podslice-chips"',
+        'resource_type = "cpu"'))
+    assert len(_lint_elastic(str(d))) == 1
+
+
+def test_multislice_no_elastic_fires_from_tfvars(tmp_path):
+    d = tmp_path / "lintmod"
+    d.mkdir(exist_ok=True)
+    (d / "main.tf").write_text("""
+variable "tpu_slices" {
+  description = "slices"
+  type        = any
+  default     = {}
+}
+
+output "echo" {
+  description = "keep used"
+  value       = var.tpu_slices
+}
+""")
+    (d / "fleet.auto.tfvars").write_text("""
+tpu_slices = {
+  a = { version = "v5e" topology = "2x4" spot = true }
+  b = { version = "v5e" topology = "2x4" spot = true }
+}
+""")
+    findings = _lint_elastic(str(d))
+    assert len(findings) == 1
+    assert "tfvars" in findings[0].message
