@@ -968,6 +968,74 @@ def section_elastic() -> dict:
         shutil.rmtree(grow_root, ignore_errors=True)
 
 
+def section_telemetry() -> dict:
+    """Telemetry-plane cost: instrumented-vs-bare burn-in step overhead
+    (the `telemetry_overhead_frac` the <2% tier-1 gate pins on the CPU
+    burn-in config) and export latency. The instrumented loop pays one
+    clock read, one histogram record, two gauge sets, and one flushed
+    JSONL span write per step; both variants sync per step (the burn-in
+    loop's own behaviour), so the fraction isolates the telemetry cost,
+    not a sync-policy difference."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from nvidia_terraform_modules_tpu.models import (
+        init_params,
+        instrument_step,
+        make_train_step,
+        synthetic_batch,
+    )
+    from nvidia_terraform_modules_tpu.telemetry import Registry
+    from nvidia_terraform_modules_tpu.utils.timing import sync
+
+    cfg = _flagship_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    step = make_train_step(cfg)
+    batch = synthetic_batch(jax.random.PRNGKey(1), cfg)
+    iters = 10
+
+    def window(fn, state):
+        loss = None
+        for _ in range(iters):
+            state["p"], loss = fn(state["p"], batch)
+            sync(loss)              # per-step sync: the burn-in loop's shape
+        return loss
+
+    root = tempfile.mkdtemp(prefix="bench_telemetry_")
+    try:
+        reg = Registry(root)
+        inst = instrument_step(step, cfg, reg, sync=False)
+        # warm both variants past compile + the backend's slow first execs
+        window(step, {"p": params})
+        window(inst, {"p": params})
+        t_bare = [t / iters for t in _repeat_timed(
+            lambda: window(step, {"p": params}))]
+        t_inst = [t / iters for t in _repeat_timed(
+            lambda: window(inst, {"p": params}))]
+        overhead = _median(t_inst) / max(_median(t_bare), 1e-12) - 1.0
+        t0 = time.perf_counter()
+        reg.export()
+        export_ms = (time.perf_counter() - t0) * 1e3
+        # the wrapper ran with sync=False (the window syncs), so its
+        # histogram holds DISPATCH latency — honest step percentiles
+        # here are the window medians, not the histogram, and the
+        # section deliberately reports only what it measured
+        return {
+            "telemetry_overhead_frac": round(overhead, 4),
+            "telemetry_overhead_frac_minmax": [
+                round(min(t_inst) / max(t_bare) - 1.0, 4),
+                round(max(t_inst) / min(t_bare) - 1.0, 4)],
+            "telemetry_export_ms": round(export_ms, 3),
+            "telemetry_step_ms": round(_median(t_inst) * 1e3, 3),
+            "telemetry_steps_recorded":
+                reg.histogram("train_step_ms").count,
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 SECTIONS = {
     "devinfo": section_devinfo,
     "smoke": section_smoke,
@@ -984,6 +1052,7 @@ SECTIONS = {
     "flash_bwd": section_flash_bwd,
     "checkpoint": section_checkpoint,
     "elastic": section_elastic,
+    "telemetry": section_telemetry,
 }
 
 # generous per-section budgets: first XLA compile of a big program is
@@ -1016,6 +1085,8 @@ SECTION_TIMEOUT_S = {
     # same I/O profile as checkpoint plus the per-record ranged reads of
     # three restore ladders (same-world, shrink, grow)
     "elastic": 600,
+    # one train-step compile + two timed step windows + a file export
+    "telemetry": 600,
 }
 
 
@@ -1382,6 +1453,13 @@ def main() -> None:
                 "the re-shard premium and the partial-read win are "
                 "meaningful on chip against PVC/gcs where the bytes "
                 "dominate")
+        if "telemetry_overhead_frac" in merged:
+            expectations["telemetry_overhead_frac"] = (
+                "tiny CPU steps (sub-ms): the fixed per-step record + "
+                "flushed JSONL write reads as a larger fraction than on "
+                "chip, where steps are ms-scale — the <2% gate is pinned "
+                "tier-1 on the CPU burn-in config (default shapes), not "
+                "this tiny-shape capture")
         if "ckpt_async_overlap_ratio" in merged:
             expectations["ckpt_async_overlap_ratio"] = (
                 "tiny CPU shapes on local tmpfs: the save is microseconds "

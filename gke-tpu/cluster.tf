@@ -88,6 +88,18 @@ resource "google_container_cluster" "this" {
     }
   }
 
+  # observability floor for a TPU fleet: system metrics + Google Managed
+  # Prometheus, so the smoketest/runtime telemetry (TPU_TELEMETRY_DIR
+  # textfiles, tpu_healthprobe_* gauges via PodMonitoring) has a scrape
+  # pipeline. The tpu-no-monitoring lint rule keeps this block honest.
+  monitoring_config {
+    enable_components = var.monitoring.enable_components
+
+    managed_prometheus {
+      enabled = var.monitoring.managed_prometheus
+    }
+  }
+
   dynamic "cluster_autoscaling" {
     for_each = var.node_auto_provisioning.enabled ? [1] : []
     content {

@@ -268,6 +268,18 @@ resource "kubernetes_job_v1" "tpu_smoketest" {
             }
           }
 
+          # telemetry plane: the package runner exports trace.json /
+          # metrics.prom / summary.txt here (README "Observability")
+          dynamic "env" {
+            for_each = var.smoketest.telemetry_dir != null ? {
+              TPU_TELEMETRY_DIR = var.smoketest.telemetry_dir
+            } : {}
+            content {
+              name  = env.key
+              value = env.value
+            }
+          }
+
           # libtpu's DCN transport for cross-slice collectives
           dynamic "env" {
             for_each = length(local.smoke_slice_order) > 1 ? {

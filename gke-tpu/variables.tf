@@ -206,6 +206,25 @@ variable "node_auto_provisioning" {
   default = {}
 }
 
+# ------------------------------------------------------------ observability
+
+variable "monitoring" {
+  description = <<-EOT
+    Cluster observability wiring. TPU fleets on spot capacity churn by
+    design (preemption, elastic resume), and the workload telemetry plane
+    (TPU_TELEMETRY_DIR Prometheus textfiles, the runtime health-probe
+    gauges) needs managed collection to land anywhere — so Google Managed
+    Prometheus is ON by default and the tpu-no-monitoring lint rule warns
+    when a TPU cluster disables it. enable_components feeds
+    monitoring_config.enable_components (system metrics).
+  EOT
+  type = object({
+    enable_components  = optional(list(string), ["SYSTEM_COMPONENTS"])
+    managed_prometheus = optional(bool, true)
+  })
+  default = {}
+}
+
 # ------------------------------------------------------------ runtime layer
 
 variable "tpu_runtime" {
@@ -280,6 +299,13 @@ variable "smoketest" {
     # the drain itself has headroom) — keep >= 60; the
     # tpu-spot-no-grace lint rule flags spot TPU workloads below that.
     grace_period_seconds = optional(number, 120)
+    # telemetry plane: sets TPU_TELEMETRY_DIR in the smoketest pods, so
+    # the package runner exports a Perfetto trace.json, a Prometheus
+    # metrics.prom textfile, and summary.txt there (see the
+    # "Observability" section in README.md). Point it at the checkpoint
+    # PVC mount (or any pod-visible path you collect) — the bundled
+    # single-file payload ignores it; the installable package honours it.
+    telemetry_dir = optional(string)
   })
   default = {}
 

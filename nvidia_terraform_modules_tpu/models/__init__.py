@@ -6,6 +6,7 @@ from .burnin import (  # noqa: F401
     BurnInConfig,
     grad_accum,
     init_params,
+    instrument_step,
     forward,
     forward_and_aux,
     loss_fn,

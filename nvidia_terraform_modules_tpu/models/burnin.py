@@ -466,6 +466,66 @@ def make_grads_fn(cfg: BurnInConfig, rules: ShardingRules | None,
     return grad_accum(vg, accum_steps, _micro_constraint(rules))
 
 
+def instrument_step(step, cfg: BurnInConfig, telemetry=None, *,
+                    rules: ShardingRules | None = None,
+                    sync: bool = True):
+    """Wrap a compiled train step with per-step telemetry.
+
+    Records a ``train_step_ms`` latency histogram (exact p50/p90/p99 in
+    the Prometheus dump), live ``train_tokens_per_s`` and ``train_mfu``
+    gauges, and one ``train_step`` span per call into the telemetry
+    plane (``telemetry/``). ``sync=True`` (default) reads one output
+    element back per step so the clock covers device execution, not just
+    dispatch — the burn-in loop already syncs per step via
+    ``float(loss)``, so the extra read is nearly free there; pass
+    ``sync=False`` for callers that pipeline steps and sync themselves.
+
+    Pass the step's ``rules`` whenever the step is SHARDED: MFU is
+    achieved model FLOP/s over the **aggregate** peak of the devices
+    doing the work, so the gauge divides by the mesh size — without it,
+    an 8-device step would read 8× the true MFU. ``rules=None`` means a
+    single-device (unsharded) step.
+
+    With telemetry disabled (the default — no ``TPU_TELEMETRY_DIR``, no
+    injected registry) the ORIGINAL ``step`` is returned unchanged: the
+    disabled path costs one attribute check here and nothing per step.
+    ``step`` may be any callable whose output ``utils.timing.sync`` can
+    barrier on (the SGD step, the AdamW step, a chaos worker's wrapper).
+    """
+    from ..telemetry import get_registry
+
+    reg = telemetry if telemetry is not None else get_registry()
+    if not reg.enabled:
+        return step
+    from ..utils.device import device_spec
+    from ..utils.timing import sync as _sync
+
+    hist = reg.histogram("train_step_ms")
+    steps_c = reg.counter("train_steps")
+    toks_g = reg.gauge("train_tokens_per_s")
+    mfu_g = reg.gauge("train_mfu")
+    flops = train_step_flops(cfg)
+    tokens = cfg.batch * cfg.seq_len
+    n_dev = rules.mesh.size if rules is not None else 1
+    peak = device_spec().bf16_tflops * 1e12 * n_dev
+
+    def instrumented(*args):
+        t0 = reg.clock()
+        out = step(*args)
+        if sync:
+            _sync(out)
+        t1 = reg.clock()
+        dt = max(t1 - t0, 1e-9)
+        hist.record(dt * 1e3)
+        steps_c.inc()
+        toks_g.set(tokens / dt)
+        mfu_g.set(flops / dt / peak)
+        reg.emit_span("train_step", t0, t1, step_ms=round(dt * 1e3, 3))
+        return out
+
+    return instrumented
+
+
 def make_train_step(cfg: BurnInConfig, rules: ShardingRules | None = None,
                     lr: float = 1e-3, accum_steps: int = 1):
     """Build a jitted SGD train step with explicit in/out shardings.

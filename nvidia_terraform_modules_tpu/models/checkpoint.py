@@ -792,7 +792,8 @@ class Checkpointer:
 
     def __init__(self, directory: str, max_to_keep: int = 2,
                  async_save: bool = False,
-                 sync_timeout_s: Optional[float] = None):
+                 sync_timeout_s: Optional[float] = None,
+                 telemetry=None):
         """``async_save=True`` makes :meth:`save` return after the
         device arrays are snapshotted to host, with serialization and
         the atomic commit running behind the next training steps — the
@@ -804,11 +805,23 @@ class Checkpointer:
         self.directory = directory
         self._max_to_keep = max_to_keep
         self._async = async_save
+        # explicit injection wins; otherwise the process registry (the
+        # NULL no-op unless TPU_TELEMETRY_DIR enabled it) — spans cover
+        # save/restore/verify/re-shard, counters cover saves/quarantines
+        self._telemetry = telemetry
         self._writer: Optional[_AsyncWriter] = None
         self._remote = _RemoteOrbax(directory, max_to_keep) \
             if _is_remote(directory) else None
         self._store = None if self._remote is not None else _LocalStore(
             _root(directory), max_to_keep, sync_timeout_s)
+
+    @property
+    def _reg(self):
+        if self._telemetry is not None:
+            return self._telemetry
+        from ..telemetry import get_registry
+
+        return get_registry()
 
     # ---- lifecycle --------------------------------------------------
     def __enter__(self) -> "Checkpointer":
@@ -868,18 +881,37 @@ class Checkpointer:
         overlaps subsequent compute and lands at the next
         save/:meth:`flush`/:meth:`close`.
         """
-        if self._remote is not None:
-            self._remote.save(step, params, meta, wait=not self._async)
-            return
-        pairs, _ = _leaf_paths(params)
-        snapshot = [(path, _snapshot_leaf(leaf)) for path, leaf in pairs]
-        if not self._async:
-            self._store.save(step, snapshot, meta or {})
-            return
-        if self._writer is None:
-            self._writer = _AsyncWriter()
-        store, m = self._store, dict(meta or {})
-        self._writer.submit(lambda: store.save(step, snapshot, m))
+        # one code path whatever the telemetry state: checkpoints are
+        # per-save, not per-step, so the NULL registry's no-op span is
+        # the right tool here (the once-per-call-site enabled guard is
+        # for the hot loops). The caller-visible save span covers host
+        # snapshot (+ the commit when blocking); an async commit gets
+        # its own span from the writer thread, so the timeline shows
+        # what the train step PAID vs what the background writer hid.
+        reg = self._reg
+        with reg.span("checkpoint_save", step=step,
+                      asynchronous=self._async,
+                      backend="orbax" if self._remote else "local"):
+            reg.counter("checkpoint_saves").inc()
+            if self._remote is not None:
+                self._remote.save(step, params, meta,
+                                  wait=not self._async)
+                return
+            pairs, _ = _leaf_paths(params)
+            snapshot = [(path, _snapshot_leaf(leaf))
+                        for path, leaf in pairs]
+            if not self._async:
+                self._store.save(step, snapshot, meta or {})
+                return
+            if self._writer is None:
+                self._writer = _AsyncWriter()
+            store, m = self._store, dict(meta or {})
+
+            def job():
+                with reg.span("checkpoint_commit", step=step):
+                    store.save(step, snapshot, m)
+
+            self._writer.submit(job)
 
     # ---- restore ----------------------------------------------------
     def restore(self, cfg: BurnInConfig, rules=None,
@@ -922,6 +954,13 @@ class Checkpointer:
             return None
         if self._remote is not None:
             return self._remote.restore_tree(abstract, step)
+        with self._reg.span("checkpoint_restore") as sp:
+            out = self._restore_local(abstract, step)
+            sp.args["step"] = out[1] if out is not None else None
+            return out
+
+    def _restore_local(self, abstract: Any, step: Optional[int],
+                       ) -> Optional[tuple[Any, int, dict[str, Any]]]:
         if step is not None:
             if step not in self._store.committed_steps():
                 raise MissingStepError(
@@ -937,6 +976,9 @@ class Checkpointer:
                     "checkpoint step %d failed verification (%s); "
                     "quarantining and falling back to the previous step",
                     candidate, exc.reason)
+                self._reg.counter("checkpoint_quarantined").inc()
+                self._reg.event("checkpoint.quarantine", step=candidate,
+                                reason=exc.reason)
                 self._store.quarantine(candidate, exc.reason)
         return None
 
@@ -958,6 +1000,7 @@ class Checkpointer:
             raise CorruptCheckpointError(
                 step, f"stale checkpoint: leaf set mismatch "
                       f"(missing {missing}, unexpected {extra})")
+        reg = self._reg
         with self._store.record_reader(step) as reader:
             if _world()[1] > 1:
                 # multi-host: every process must reach the SAME
@@ -968,12 +1011,23 @@ class Checkpointer:
                 # before any assembly; single-process worlds keep the
                 # partial-read fast path, having no peer to disagree
                 # with.
-                for rec in manifest.get("leaves", []):
-                    reader.read(rec)
-            leaves = [
-                _assemble_leaf(path, a, stored[path], step, reader)
-                for path, a in pairs
-            ]
+                with reg.span("checkpoint_verify", step=step) as sp:
+                    for rec in manifest.get("leaves", []):
+                        reader.read(rec)
+                    sp.args["records"] = len(manifest.get("leaves", []))
+            # the assembly phase IS the re-shard when the writing world
+            # differs from ours — name it so the timeline says whether a
+            # restore crossed world sizes
+            stored_world = manifest.get("nprocs")
+            name = ("checkpoint_reshard"
+                    if stored_world not in (None, _world()[1])
+                    else "checkpoint_assemble")
+            with reg.span(name, step=step, stored_world=stored_world,
+                          world=_world()[1]):
+                leaves = [
+                    _assemble_leaf(path, a, stored[path], step, reader)
+                    for path, a in pairs
+                ]
         return (jax.tree_util.tree_unflatten(treedef, leaves), step,
                 dict(meta or {}))
 

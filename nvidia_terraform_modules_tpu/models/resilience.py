@@ -393,11 +393,17 @@ class HeartbeatMonitor:
     """
 
     def __init__(self, directory: str, num_processes: int,
-                 timeout_s: float = 60.0, self_id: Optional[int] = None):
+                 timeout_s: float = 60.0, self_id: Optional[int] = None,
+                 telemetry=None):
         self.directory = os.path.join(directory, _HEARTBEAT_DIR)
         self.num_processes = num_processes
         self.timeout_s = timeout_s
         self.self_id = self_id
+        if telemetry is None:
+            from ..telemetry import get_registry
+
+            telemetry = get_registry()
+        self._telemetry = telemetry
         self._born = time.time()
         self._armed: dict[int, dict] = {}
         self._stop = threading.Event()
@@ -424,13 +430,19 @@ class HeartbeatMonitor:
             if k in self._armed or payload.get("time", 0.0) >= self._born:
                 self._armed[k] = payload
         failures = []
+        worst = 0.0
         for k, last in self._armed.items():
             if k == self.self_id:
                 continue
             age = now - last.get("time", 0.0)
+            worst = max(worst, age)
             if age > self.timeout_s:
                 failures.append(
                     PeerFailure(k, age, int(last.get("step", 0))))
+        if self._telemetry.enabled:
+            # the liveness headroom dashboarded: how stale the WORST
+            # armed peer heartbeat is right now (0 = nothing armed yet)
+            self._telemetry.gauge("heartbeat_lag_s").set(worst)
         return failures
 
     def watch(self, on_dead: Callable[[PeerFailure], None],
@@ -484,7 +496,8 @@ class SupervisedLoop:
                  total_steps: int, save_every: int = 1,
                  process_id: int = 0, num_processes: int = 1,
                  heartbeat_dir: Optional[str] = None,
-                 on_peer_dead: Optional[Callable] = None):
+                 on_peer_dead: Optional[Callable] = None,
+                 telemetry=None):
         if save_every < 1:
             raise ValueError(f"save_every must be >= 1, got {save_every}")
         self.ckpt = ckpt
@@ -495,6 +508,11 @@ class SupervisedLoop:
         self.num_processes = num_processes
         self.heartbeat_dir = heartbeat_dir
         self.on_peer_dead = on_peer_dead
+        if telemetry is None:
+            from ..telemetry import get_registry
+
+            telemetry = get_registry()
+        self.telemetry = telemetry
 
     def restore(self, abstract: Any, step: Optional[int] = None):
         """Restore ``abstract`` through the restart policy fix: a
@@ -527,6 +545,15 @@ class SupervisedLoop:
     # the supervisor (and the next attempt) can read it, then exit with
     # the protocol code — never hang in the collective
     def _default_peer_dead(self, failure: PeerFailure) -> None:
+        if self.telemetry.enabled:
+            # the event layer flushes per record, so the classification
+            # is on the timeline before os._exit skips every atexit hook
+            self.telemetry.counter("supervisor_exit_peer_dead").inc()
+            self.telemetry.event(
+                "supervisor.exit", status="peer_dead",
+                dead_process=failure.process,
+                age_s=round(failure.age_s, 1),
+                observed_by=self.process_id)
         if self.heartbeat_dir:
             try:
                 with open(os.path.join(
@@ -557,6 +584,17 @@ class SupervisedLoop:
         monitor = None
         step = start_step
         emergency_saved = False
+        reg = self.telemetry
+        if reg.enabled:
+            reg.counter("supervisor_runs").inc()
+            if resumed_from is not None:
+                # this attempt is a RESTART: it resumed a prior attempt's
+                # checkpoint — the counter a fleet dashboard alarms on
+                reg.counter("supervisor_restart_attempts").inc()
+                reg.event("supervisor.restart",
+                          resumed_from=resumed_from,
+                          process=self.process_id,
+                          world=self.num_processes)
         try:
             if self.heartbeat_dir and self.num_processes >= 1:
                 hb = Heartbeat(self.heartbeat_dir, self.process_id,
@@ -566,7 +604,7 @@ class SupervisedLoop:
                 monitor = HeartbeatMonitor(
                     self.heartbeat_dir, self.num_processes,
                     timeout_s=self.cfg.heartbeat_timeout_s,
-                    self_id=self.process_id,
+                    self_id=self.process_id, telemetry=reg,
                 ).watch(self.on_peer_dead or self._default_peer_dead)
             with PreemptionGuard(self.cfg.grace_seconds) as guard:
                 while step < self.total_steps:
@@ -596,11 +634,20 @@ class SupervisedLoop:
                             emergency_saved = True
                         if self.ckpt is not None:
                             self.ckpt.flush()
+                        if reg.enabled:
+                            reg.counter("supervisor_exit_preempted").inc()
+                            reg.event("supervisor.exit",
+                                      status="preempted", step=step,
+                                      emergency_saved=emergency_saved,
+                                      process=self.process_id)
                         return state, LoopOutcome(
                             "preempted", step, resumed_from,
                             emergency_saved)
                 if self.ckpt is not None:
                     self.ckpt.flush()
+                if reg.enabled:
+                    reg.event("supervisor.exit", status="completed",
+                              step=step, process=self.process_id)
                 return state, LoopOutcome(
                     "completed", step, resumed_from, emergency_saved)
         finally:

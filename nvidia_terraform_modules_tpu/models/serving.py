@@ -353,7 +353,7 @@ def make_prefill(params, cfg: BurnInConfig, max_len: int,
 def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                       cache_dtype: str = "bf16", prefix=None,
                       sampler=None, prefill_chunk: int | None = None,
-                      spec_k: int | None = None):
+                      spec_k: int | None = None, telemetry=None):
     """Reusable engine: compile once, run many schedules.
 
     The compiled pieces (per-bucket prefills, the all-slots step) live in
@@ -447,6 +447,17 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
     Use ``spec_k`` for eos/structured traffic; on fixed-length
     benchmark-style traffic prefer the plain engine, or shrink
     ``spec_k`` as occupancy grows (smaller verification width).
+
+    ``telemetry`` injects a telemetry registry (default: the process
+    registry — the no-op unless ``TPU_TELEMETRY_DIR`` is set). When
+    enabled, every admission emits a ``serve_prefill`` span and every
+    retirement a ``serve_request`` span (admission → retirement — the
+    p50/p99 request-latency record in ``serve_request_ms``), with
+    generated-token and — for speculative engines — accepted-draft-token
+    counters. Spans clock the host's view of the schedule: on an async
+    backend the admission span covers dispatch, and the request span
+    closes at retirement, which for the plain no-eos loop is the wave
+    the host RETIRED the slot, not device completion.
     """
     if prefill_chunk is not None and prefill_chunk < 1:
         raise ValueError(
@@ -458,6 +469,9 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             raise ValueError(
                 "speculative serving is greedy-only: acceptance tests "
                 "the model's argmax chain — drop sampler or spec_k")
+    from ..telemetry import get_registry
+
+    reg = telemetry if telemetry is not None else get_registry()
     pick = _make_pick(sampler)
     from .quantize import QTensor
 
@@ -562,7 +576,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         def suffix_fill(suffix, cache, key):
             return _suffix_fill(prefill_params, suffix, cache, key)
 
-    def admit(prompt, key):
+    def _admit(prompt, key):
         """(first token, row cache) for one request, via the template
         when a prefix is cached."""
         if key is None:
@@ -572,6 +586,17 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         if template is None:
             return prefill(prompt[None, :], key)
         return suffix_fill(prompt[None, :], template, key)
+
+    if reg.enabled:
+        def admit(prompt, key):
+            t0 = reg.clock()
+            out = _admit(prompt, key)
+            reg.emit_span("serve_prefill", t0, reg.clock(),
+                          prompt_len=int(prompt.shape[-1]))
+            reg.counter("serve_admissions").inc()
+            return out
+    else:
+        admit = _admit
 
     def _check_chunk_bound(length: int) -> int:
         n = -(-length // prefill_chunk)
@@ -608,6 +633,21 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         # beyond pos stay masked (k_pos > q_pos) until overwritten
         cache["pos"] = jnp.asarray(prefix_len + length, jnp.int32)
         return tok, cache
+
+    def _note_admit(admit_ts, req):
+        if reg.enabled:
+            admit_ts[req] = reg.clock()
+
+    def _note_retire(admit_ts, req, ntok):
+        """One ``serve_request`` span per retired request (admission →
+        retirement: the request-latency record) + the token counter."""
+        if reg.enabled and req in admit_ts:
+            t0 = admit_ts.pop(req)
+            t1 = reg.clock()
+            reg.emit_span("serve_request", t0, t1, request=req,
+                          tokens=int(ntok))
+            reg.histogram("serve_request_ms").record((t1 - t0) * 1e3)
+            reg.counter("serve_generated_tokens").inc(int(ntok))
 
     # one dispatch per speculative admission (compiled per prompt-length
     # bucket): building the context row with eager .at[] ops cost ~7
@@ -648,6 +688,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         active: dict[int, int] = {}
         start_of: dict[int, int] = {}            # req → first output idx
         out: dict[int, Any] = {}
+        admit_ts: dict[int, float] = {}
         slot_steps = 0
         generated = 0
         admitted = 0                   # prefill-emitted (non-step) tokens
@@ -662,6 +703,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                     continue
                 req, prompt = queue.popleft()
                 prompt = jnp.asarray(prompt)
+                _note_admit(admit_ts, req)
                 first, row_cache = admit(prompt, None)
                 stacked = _insert_row(row_cache, stacked, slot)
                 length = int(prompt.shape[-1])
@@ -674,6 +716,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 if n_new == 1 or (eos_id is not None
                                   and int(first) == eos_id):
                     out[req] = first[None]
+                    _note_retire(admit_ts, req, 1)
                     continue
                 active[slot] = req
             if not active:
@@ -702,7 +745,16 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                     start = start_of[req]
                     out[req] = ctxbuf[slot, start:start + n]
                     generated += n - 1           # first counted at admit
+                    _note_retire(admit_ts, req, n)
                     del active[slot]
+        if reg.enabled:
+            # each verification slot-step emits exactly one model token
+            # plus its accepted drafts, so the drafts the speculation
+            # actually bought are the step-emitted tokens beyond one per
+            # step — the counter the spec_k knob is tuned against
+            reg.counter("serve_accepted_draft_tokens").inc(
+                max(0, (generated - admitted) - slot_steps))
+            reg.counter("serve_verify_slot_steps").inc(slot_steps)
         # accepted_per_step excludes admission tokens: it is tokens per
         # VERIFICATION slot-step, so zero draft acceptance reads exactly
         # 1.0 (the plain engine's rate), never above it
@@ -777,6 +829,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         span: dict[int, tuple] = {}              # req → (slot, start wave)
         count: dict[int, int] = {}               # req → tokens so far
         done_at: dict[int, int] = {}             # req → final token count
+        admit_ts: dict[int, float] = {}
         hist: list = []          # one [slots] token vector per step wave
 
         # Host bookkeeping is integer-only: the loop keeps whole [slots]
@@ -806,6 +859,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 if slot in active or not queue:
                     continue
                 req, prompt = queue.popleft()
+                _note_admit(admit_ts, req)
                 first, row_cache = admit(
                     jnp.asarray(prompt),
                     key_for(req, 0) if sampler is not None else None)
@@ -820,6 +874,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                                   and eos_check_every == 1
                                   and int(first) == eos_id):
                     done_at[req] = 1
+                    _note_retire(admit_ts, req, 1)
                     continue
                 active[slot] = req
             if not active:
@@ -843,6 +898,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 count[req] += 1
                 if count[req] >= n_new:
                     done_at[req] = count[req]
+                    _note_retire(admit_ts, req, count[req])
                     del active[slot]             # slot recycles next wave
             if eos_id is not None:
                 eos_pending += 1
@@ -852,6 +908,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                     for slot, req in list(active.items()):
                         if int(tok_h[slot]) == eos_id:
                             done_at[req] = count[req]
+                            _note_retire(admit_ts, req, count[req])
                             del active[slot]
                 elif eos_pending >= eos_check_every:
                     # one flush per W waves: scan the batched window for
@@ -868,6 +925,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                             h = base + j
                             if h >= sw and int(block[j, slot]) == eos_id:
                                 done_at[req] = h - sw + 2
+                                _note_retire(admit_ts, req, done_at[req])
                                 del active[slot]
                                 break
 

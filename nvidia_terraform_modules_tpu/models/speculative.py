@@ -167,9 +167,38 @@ def speculative_greedy_decode(params, prompt, n_new: int,
 def make_speculative_decoder(cfg: BurnInConfig,
                              rules: ShardingRules | None = None,
                              n_new: int = 32, k: int = 4,
-                             max_len: int | None = None):
+                             max_len: int | None = None,
+                             telemetry=None):
     """Compiled speculative greedy decoder:
-    ``decoder(params, prompt) → (tokens [1, n_new], steps)``."""
-    fn = functools.partial(speculative_greedy_decode, n_new=n_new, cfg=cfg,
-                           rules=rules, k=k, max_len=max_len)
-    return jax.jit(fn)
+    ``decoder(params, prompt) → (tokens [1, n_new], steps)``.
+
+    With telemetry enabled (``telemetry=`` injection or
+    ``TPU_TELEMETRY_DIR``) each call emits a ``spec_decode`` span and
+    counts accepted draft tokens: every verification step emits exactly
+    one model token plus its accepted drafts, so ``n_new - steps`` IS
+    the draft-token count speculation bought. The read of ``steps``
+    syncs the call — instrumentation trades the async tail for the
+    number; the disabled path returns the bare jitted callable.
+    """
+    fn = jax.jit(functools.partial(
+        speculative_greedy_decode, n_new=n_new, cfg=cfg, rules=rules,
+        k=k, max_len=max_len))
+    from ..telemetry import get_registry
+
+    reg = telemetry if telemetry is not None else get_registry()
+    if not reg.enabled:
+        return fn
+
+    def instrumented(params, prompt):
+        t0 = reg.clock()
+        toks, steps = fn(params, prompt)
+        steps_i = int(steps)            # d2h read: the honest span end
+        t1 = reg.clock()
+        reg.emit_span("spec_decode", t0, t1, n_new=n_new,
+                      verify_steps=steps_i)
+        reg.counter("spec_verify_steps").inc(steps_i)
+        reg.counter("spec_accepted_draft_tokens").inc(
+            max(0, n_new - steps_i))
+        return toks, steps
+
+    return instrumented

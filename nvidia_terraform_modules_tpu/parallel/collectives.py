@@ -275,9 +275,16 @@ def hierarchical_psum(x, mesh: Mesh, slice_axis: str = "slice",
     pad = (-n) % k
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
-    chunk = jax.lax.psum_scatter(flat, inner, tiled=True)   # ICI
-    chunk = jax.lax.psum(chunk, slice_axis)                 # DCN, 1/k data
-    flat = jax.lax.all_gather(chunk, inner, tiled=True)     # ICI
+    # named phases: zero runtime cost (trace-time only), but the XLA
+    # device trace (utils/profiling.device_trace) groups each phase's
+    # kernels under these names — correlating with the host-side
+    # hier_psum_* telemetry spans the probe emits, by name
+    with jax.named_scope("hier_psum_ici_reduce_scatter"):
+        chunk = jax.lax.psum_scatter(flat, inner, tiled=True)   # ICI
+    with jax.named_scope("hier_psum_dcn_psum"):
+        chunk = jax.lax.psum(chunk, slice_axis)             # DCN, 1/k data
+    with jax.named_scope("hier_psum_ici_all_gather"):
+        flat = jax.lax.all_gather(chunk, inner, tiled=True)     # ICI
     if pad:
         flat = flat[:n]
     return flat.reshape(shape)
@@ -335,7 +342,22 @@ def hierarchical_psum_probe(mesh: Mesh, slice_axis: str = "slice",
     ici = 2 * (k - 1) / k * data if k > 1 else 0.0
     dcn = 2 * (s - 1) / s * (data / max(k, 1)) if s > 1 else 0.0
     moved = (ici + dcn) or 2 * (m - 1) / m * data
-    out = _run(mesh, verify, timed_step, P(pspec_axes(axes)), moved, m)
+    from ..telemetry import get_registry
+
+    reg = get_registry()
+    if reg.enabled:
+        # the host-side ICI-vs-DCN phase record: one span for the probe
+        # with the phase byte split in args; the per-phase device kernels
+        # correlate by the hier_psum_* named_scope names inside the trace
+        with reg.span("hier_psum_probe", participants=m,
+                      ici_bytes=ici, dcn_bytes=dcn,
+                      slices=s, inner=k):
+            out = _run(mesh, verify, timed_step, P(pspec_axes(axes)),
+                       moved, m)
+        reg.gauge("hier_psum_gibps").set(
+            moved / max(out["seconds"], 1e-9) / (1 << 30))
+    else:
+        out = _run(mesh, verify, timed_step, P(pspec_axes(axes)), moved, m)
     out["ici_bytes"] = ici
     out["dcn_bytes"] = dcn
     return out

@@ -188,6 +188,11 @@ def worker_main(env: Optional[dict] = None) -> int:
     rules = make_rules(build_mesh(plan_mesh(len(jax.devices()))))
     init_state, adamw_step = make_adamw_train_step(
         cfg, rules, AdamWConfig(lr=1e-2))
+    # per-step spans/histogram for the kill-and-resume timeline (no-op
+    # unless TPU_TELEMETRY_DIR is set in the supervisor's environment)
+    from ..models.burnin import instrument_step
+
+    adamw_step = instrument_step(adamw_step, cfg, rules=rules)
     batch = synthetic_batch(jax.random.PRNGKey(seed + 1), cfg, rules)
 
     rcfg = resilience_from_env(e)
@@ -214,13 +219,27 @@ def worker_main(env: Optional[dict] = None) -> int:
     # the journal the supervisor audits: what this attempt resumed from,
     # at which world size (elastic re-shard evidence: stored_world is the
     # WRITING world's size), and what sat in quarantine (invariant:
-    # disjoint from the resumed step)
-    with open(os.path.join(ckpt_dir, RESUME_JOURNAL), "a") as fh:
-        fh.write(json.dumps({
-            "attempt": attempt, "process": pid, "world": nprocs,
-            "resumed_from": resumed_from, "stored_world": stored_world,
-            "quarantined": quarantined,
-        }) + "\n")
+    # disjoint from the resumed step). Emitted through the telemetry
+    # EVENT layer — same records as before, now on the one schema every
+    # producer shares, so an elastic-resume journal and a tfsim chaos
+    # sweep merge into one timeline (telemetry/export.py reads any
+    # *.jsonl sharing the envelope).
+    from ..telemetry import EventLog, get_registry
+
+    record = dict(attempt=attempt, process=pid, world=nprocs,
+                  resumed_from=resumed_from, stored_world=stored_world,
+                  quarantined=quarantined)
+    journal = EventLog(os.path.join(ckpt_dir, RESUME_JOURNAL),
+                       process=pid)
+    journal.event("chaos.resume", **record)
+    journal.close()
+    # mirror the record onto the telemetry timeline too: the journal
+    # lives in the (often throwaway) checkpoint dir, but the exported
+    # trace must carry the restart markers wherever TPU_TELEMETRY_DIR
+    # points — same event, same schema, second destination
+    reg = get_registry()
+    if reg.enabled:
+        reg.event("chaos.resume", **record)
 
     armed = (attempt == 0 and kill_step > start_step and
              kill_signal and kill_process in ("", str(pid)))
@@ -469,6 +488,9 @@ class Supervisor:
         """Attempt/restart until every process completes; returns the
         case report (final verdicts, per-attempt exits + worlds +
         interim verdicts, journal)."""
+        from ..telemetry import get_registry
+
+        reg = get_registry()
         attempts: list[dict] = []
         last_exits: Optional[list[int]] = None
         world = self.case.nprocs
@@ -476,6 +498,13 @@ class Supervisor:
             world, stop_at = self._plan_attempt(last_exits, world)
             if attempt and self.on_restart is not None:
                 self.on_restart(attempt)
+            if reg.enabled and attempt:
+                # the supervisor-restart marker on the one timeline:
+                # which attempt, at what (possibly re-formed) world size
+                reg.event("supervisor.restart_attempt", attempt=attempt,
+                          world=world, stop_at=stop_at,
+                          last_exits=last_exits)
+            t_attempt = reg.clock() if reg.enabled else 0.0
             procs = self._launch(attempt, world, stop_at)
             results = []
             deadline = time.monotonic() + self.attempt_timeout_s
@@ -490,6 +519,10 @@ class Supervisor:
                     out, err = p.communicate()
                 results.append((p.returncode, out, err))
             last_exits = [rc for rc, _, _ in results]
+            if reg.enabled:
+                reg.emit_span("supervisor_attempt", t_attempt,
+                              reg.clock(), attempt=attempt, world=world,
+                              exits=last_exits, stop_at=stop_at)
             attempts.append({
                 "attempt": attempt,
                 "world": world,
@@ -519,11 +552,21 @@ class Supervisor:
             f"{self.max_restarts + 1} attempts: {attempts}")
 
     def _journal(self) -> list[dict]:
+        """The resume records, extracted from the telemetry-schema
+        journal: each line is a ``chaos.resume`` event whose ``args``
+        carry exactly the record the invariants audit."""
         path = os.path.join(self.ckpt_dir, RESUME_JOURNAL)
         if not os.path.isfile(path):
             return []
+        out = []
         with open(path) as fh:
-            return [json.loads(line) for line in fh if line.strip()]
+            for line in fh:
+                if not line.strip():
+                    continue
+                rec = json.loads(line)
+                if rec.get("name") == "chaos.resume":
+                    out.append(rec["args"])
+        return out
 
     def _quarantined(self) -> list[str]:
         qdir = os.path.join(self.ckpt_dir, "quarantine")
@@ -875,6 +918,17 @@ def main(argv: Optional[list[str]] = None) -> int:
                   f"ok ({report['attempts']['killed']} attempt(s))",
                   flush=True)
     print(f"chaos: {ok}/{len(cases)} case(s) resumed exactly", flush=True)
+    from ..telemetry import get_registry
+
+    reg = get_registry()
+    if reg.enabled:
+        # the kill-and-resume timeline: worker train-step/checkpoint
+        # spans (the workers inherit TPU_TELEMETRY_DIR) + the
+        # supervisor's attempt/restart spans, merged into one trace
+        reg.gauge("chaos_case_attainment").set(ok / max(len(cases), 1))
+        paths = reg.export()
+        print(f"chaos: telemetry exported to {paths['trace']}",
+              file=sys.stderr)
     return 0
 
 

@@ -50,6 +50,34 @@ def run_smoketest(
     level: str = "probes",
     env: dict[str, str] | None = None,
 ) -> SmokeResult:
+    """Run the validation suite (telemetry-exporting wrapper).
+
+    With ``TPU_TELEMETRY_DIR`` set (or a registry injected via
+    ``telemetry.set_registry``) every instrumented layer the suite
+    drives — per-step train latency/MFU, checkpoint save/restore,
+    supervisor events — lands in the telemetry plane, and the artifacts
+    (Perfetto ``trace.json``, Prometheus ``metrics.prom``,
+    ``summary.txt``) are exported after the suite finishes, whatever its
+    verdict; their paths ride the JSON contract under ``"telemetry"``.
+    """
+    from ..telemetry import get_registry
+
+    result = _run_smoketest(expected_devices, level, env)
+    reg = get_registry()
+    if reg.enabled:
+        try:
+            result.checks["telemetry"] = reg.export()
+        except (OSError, ValueError) as exc:
+            # observability must never fail the validation verdict
+            result.checks["telemetry_error"] = str(exc)
+    return result
+
+
+def _run_smoketest(
+    expected_devices: int | None = None,
+    level: str = "probes",
+    env: dict[str, str] | None = None,
+) -> SmokeResult:
     """Run the validation suite.
 
     ``level`` ∈ {"psum", "probes", "burnin", "full"} — each a superset of
@@ -207,7 +235,14 @@ def run_smoketest(
                     checks["burnin_resumed_step"] = global_step
             if params is None:
                 params = init_params(jax.random.PRNGKey(0), cfg, rules)
-            step = make_train_step(cfg, rules)
+            # per-step latency histogram + live tokens/s + MFU gauges
+            # land in the telemetry plane (no-op unless enabled); the
+            # loop below syncs per step via float(loss) anyway, so the
+            # instrumented sync costs nothing extra here
+            from ..models.burnin import instrument_step
+
+            step = instrument_step(make_train_step(cfg, rules), cfg,
+                                   rules=rules)
             batch = synthetic_batch(jax.random.PRNGKey(1), cfg, rules)
             losses = []
 

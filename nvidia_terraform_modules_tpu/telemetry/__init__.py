@@ -1,0 +1,101 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Unified telemetry plane: spans, metrics, and one trace timeline from
+kernel to fleet.
+
+Before this package, every subsystem invented its own reporting: the
+smoketest's burn-in JSON, the chaos harness's resume journal, tfsim's
+``ApplyOutcome.trace``, ``utils/timing``'s medians, and the one-off
+profiling write-ups. This package is the one substrate they all emit
+into — and the measurement layer the serving-engine and fleet-simulator
+roadmap directions are gated on (p50/p99 request latency, MFU, SLO
+attainment need a plane to land in).
+
+Three layers:
+
+- **Instruments** (:class:`Counter`, :class:`Gauge`,
+  :class:`Histogram`): process-local, thread-safe, with exact
+  p50/p90/p99 order-statistic quantiles on the histograms
+  (``telemetry/core.py``).
+- **Events**: nestable wall-clock :meth:`Registry.span` contexts and
+  point :meth:`Registry.event`\\ s, written as structured JSONL — one
+  schema whatever the producer. The clock is injectable, so tfsim's
+  *simulated* per-op spans and the training runtime's *real* spans are
+  the same record type (``clock: "sim"`` vs ``"real"``) and merge.
+- **Exporters** (``telemetry/export.py``): a Chrome-trace/Perfetto JSON
+  timeline (train steps, checkpoint commits, collective phases,
+  supervisor restarts, and tfsim apply ops — one lane per parallelism
+  slot), a Prometheus text exposition (histogram buckets plus
+  ``_p50/_p90/_p99`` gauges), and a terminal summary table.
+
+**Off by default, near-zero when off.** :func:`get_registry` returns the
+shared :data:`NULL` no-op registry unless ``TPU_TELEMETRY_DIR`` is set
+or a caller injects a :class:`Registry` via :func:`set_registry` (or the
+``telemetry=`` parameter the instrumented layers accept). Hot paths
+check ``registry.enabled`` once per call site; the null registry's
+instruments and span context are shared singletons, so the disabled
+path allocates nothing and emits nothing — pinned by
+``tests/test_telemetry.py``.
+
+Instrumented layers (all emit here when enabled):
+
+====================================  =====================================
+``models/burnin.instrument_step``     per-step latency histogram
+                                      (``train_step_ms``), live
+                                      ``train_tokens_per_s`` /
+                                      ``train_mfu`` gauges, one span per
+                                      step
+``models/checkpoint.Checkpointer``    ``checkpoint_save`` /
+                                      ``checkpoint_restore`` /
+                                      ``checkpoint_verify`` /
+                                      ``checkpoint_reshard`` spans,
+                                      save/quarantine counters
+``models/resilience``                 ``heartbeat_lag_s`` gauge,
+                                      classified-exit and restart-attempt
+                                      counters on ``SupervisedLoop``
+``models/serving`` / ``speculative``  per-request ``serve_prefill`` /
+                                      ``serve_request`` spans, generated-
+                                      and accepted-draft-token counters
+``parallel/collectives``              ``hierarchical_psum`` ICI-vs-DCN
+                                      phase spans (probe side) +
+                                      ``jax.named_scope`` phase names in
+                                      the traced collective
+``tfsim/faults``                      per-op apply spans on the simulated
+                                      clock (lane = parallelism slot),
+                                      chaos SLO-attainment summary
+``smoketest/chaos``                   the resume journal (same schema) and
+                                      supervisor attempt/restart spans
+====================================  =====================================
+
+Quick start::
+
+    TPU_TELEMETRY_DIR=/tmp/telemetry python -m \\
+        nvidia_terraform_modules_tpu.smoketest -level burnin
+    # → /tmp/telemetry/trace.json     (open in https://ui.perfetto.dev)
+    #   /tmp/telemetry/metrics.prom   (Prometheus textfile scrape)
+    #   /tmp/telemetry/summary.txt
+
+Operational wiring (enabling the dir on the smoketest Job, scraping the
+textfile, reading an elastic chaos run's timeline) is documented in
+``gke-tpu/README.md`` § Observability.
+"""
+
+from .core import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    Counter,
+    EventLog,
+    Gauge,
+    Histogram,
+    NULL,
+    NullRegistry,
+    Registry,
+    get_registry,
+    set_registry,
+)
+from .export import (  # noqa: F401
+    chrome_trace,
+    export_all,
+    prometheus_text,
+    read_events,
+    summary_table,
+)

@@ -1022,6 +1022,20 @@ def cmd_chaos(args) -> int:
     except (PlanError, ValueError, OSError) as ex:
         print(f"Error: {ex}", file=sys.stderr)
         return 1
+    from ..telemetry import get_registry
+
+    reg = get_registry()
+    if reg.enabled:
+        # per-op simulated-clock spans + SLO attainment were emitted
+        # during the sweep; land the artifacts (Perfetto timeline,
+        # Prometheus text, summary) next to the event stream
+        try:
+            paths = reg.export()
+            print(f"chaos: telemetry exported to {paths['trace']}",
+                  file=sys.stderr)
+        except OSError as ex:
+            print(f"chaos: telemetry export failed: {ex}",
+                  file=sys.stderr)
     bad = [r for r in results if not r.ok]
     interrupted = sum(1 for r in results if r.interrupted)
     crashed = sum(1 for r in results if r.crashed)

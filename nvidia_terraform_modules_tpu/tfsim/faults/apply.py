@@ -385,3 +385,64 @@ def run_apply(plan: Plan, prior: State | None, cp: ControlPlane,
     return ApplyOutcome(state=apply_plan(plan, prior, targets, d=d),
                         completed=completed, mutated=not d.is_noop,
                         trace=trace)
+
+
+def assign_lanes(trace: list[OpTrace]) -> dict[int, int]:
+    """Greedy interval partitioning of the executed operations onto the
+    smallest number of lanes — the rendering of ``-parallelism``: with
+    the engine's concurrency cap intact, lane count never exceeds the
+    parallelism level, so each lane IS one worker slot of the schedule.
+    Returns ``{id(op_trace): lane}``; deterministic for a given trace
+    (sorted by start, finish, address — the same total order for every
+    replay of a (seed, parallelism) pair).
+    """
+    import heapq as _hq
+
+    ran = [t for t in trace
+           if t.status in ("ok", "failed", "crashed", "abandoned")]
+    busy: list[tuple[float, int]] = []      # (finish, lane)
+    free: list[int] = []
+    lanes: dict[int, int] = {}
+    n = 0
+    for t in sorted(ran, key=lambda t: (t.start_s, t.finish_s, t.address)):
+        while busy and busy[0][0] <= t.start_s + 1e-9:
+            _, lane = _hq.heappop(busy)
+            _hq.heappush(free, lane)
+        if free:
+            lane = _hq.heappop(free)
+        else:
+            lane = n
+            n += 1
+        lanes[id(t)] = lane
+        _hq.heappush(busy, (t.finish_s, lane))
+    return lanes
+
+
+def emit_apply_telemetry(outcome: ApplyOutcome, telemetry=None, *,
+                         run: str | None = None) -> None:
+    """Emit an apply's operation trace as telemetry spans on the
+    **simulated clock** (``clock: "sim"``), one lane per parallelism
+    slot, so a seeded ``tfsim chaos`` run renders in Perfetto exactly
+    like a real training timeline — the fleet end of the one-timeline
+    contract. Skipped operations (never started: their dependency
+    errored) land as instant events at their decision time. ``run``
+    labels the trace's process lane group (e.g. ``"seed3x4"``) so
+    sweeps don't interleave. No-op when telemetry is disabled.
+    """
+    from ...telemetry import get_registry
+
+    reg = telemetry if telemetry is not None else get_registry()
+    if not reg.enabled:
+        return
+    lanes = assign_lanes(outcome.trace)
+    pid = run if run is not None else "tfsim-apply"
+    op_s = reg.histogram("tfsim_apply_op_s")
+    for t in outcome.trace:
+        if t.status == "skipped":
+            reg.event(f"{t.address} {t.op} skipped", ts=t.start_s,
+                      pid=pid, clock="sim", blamed=t.blamed)
+            continue
+        op_s.record(t.finish_s - t.start_s)
+        reg.emit_span(f"{t.address} {t.op}", t.start_s, t.finish_s,
+                      lane=lanes[id(t)], pid=pid, clock="sim",
+                      status=t.status)

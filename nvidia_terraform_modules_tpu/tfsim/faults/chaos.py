@@ -245,6 +245,12 @@ def run_one_seed(cli, module_dir: str, var_argv: list[str],
             res.violations.append(
                 "nondeterministic schedule: two replays of the same "
                 "(seed, parallelism) diverged")
+        # the replayed trace IS the CLI run (determinism is invariant
+        # 4a): emit it as simulated-clock spans, one lane per
+        # parallelism slot, labelled per run so sweeps don't interleave
+        from .apply import emit_apply_telemetry
+
+        emit_apply_telemetry(outcome, run=f"seed{seed}x{parallelism}")
         _check_schedule(res, plan, outcome, parallelism)
         if outcome.failures:
             first = outcome.failures[0]
@@ -389,7 +395,30 @@ def run_chaos(cli, module_dir: str, tfvars: dict, var_argv: list[str],
                 if log:
                     log(res.summary())
                 results.append(res)
+        _emit_chaos_telemetry(results)
         return results
     finally:
         if own_profile is not None:
             os.unlink(own_profile.name)
+
+
+def _emit_chaos_telemetry(results: list[SeedResult]) -> None:
+    """SLO-style attainment summary of a chaos sweep: every (seed,
+    parallelism) run is one "request" whose SLO is *convergence*, so
+    ``tfsim_chaos_attainment`` reads exactly like a serving
+    availability number — plus one structured event per run (the
+    ``chaos -json`` record, now on the shared schema, merge-compatible
+    with the training harness's resume journal)."""
+    from ...telemetry import get_registry
+
+    reg = get_registry()
+    if not reg.enabled or not results:
+        return
+    converged = sum(1 for r in results if r.ok)
+    reg.counter("tfsim_chaos_runs").inc(len(results))
+    reg.counter("tfsim_chaos_converged").inc(converged)
+    reg.counter("tfsim_chaos_interrupted").inc(
+        sum(1 for r in results if r.interrupted))
+    reg.gauge("tfsim_chaos_attainment").set(converged / len(results))
+    for r in results:
+        reg.event("tfsim.chaos.run", **r.record())

@@ -396,7 +396,10 @@ def check_spot_no_grace(ctx: LintContext):
     when the module statically provisions spot/preemptible TPU capacity
     AND a kubernetes workload schedules onto TPU nodes. (For *multislice*
     spot fleets the fleet-level twin is ``tpu-multislice-no-elastic``:
-    grace saves the step, an autoscaler range saves the fleet.)"""
+    grace saves the step, an autoscaler range saves the fleet — and
+    ``tpu-no-monitoring`` is the observability leg: the same spot churn
+    that makes grace mandatory makes its incidents undiagnosable
+    without a metrics pipeline.)"""
     spot_origin = None
     for r, flag in _spot_tpu_pools(ctx):
         spot_origin = f"{r.address} ({flag})"
@@ -556,6 +559,108 @@ def check_multislice_no_elastic(ctx: LintContext):
                f"with a TPU resource_limits range, or make one slice "
                f"queued_provisioning so returned capacity rejoins the "
                f"fleet")
+
+
+def _named_blocks(body, name: str):
+    """``name`` blocks of a body, static or ``dynamic`` (content bodies;
+    a contentless dynamic yields None like ``_placement_blocks``)."""
+    out = []
+    for b in body.blocks:
+        if b.type == name:
+            out.append(b.body)
+        elif b.type == "dynamic" and b.labels and b.labels[0] == name:
+            contents = b.body.blocks_of("content")
+            out.extend(c.body for c in contents)
+            if not contents:
+                out.append(None)
+    return out
+
+
+def _has_tpu_capacity(ctx: LintContext) -> bool:
+    """Any statically-visible TPU capacity: a slice declaration or a
+    literal TPU node pool (by machine type or a tpu_topology placement)."""
+    if slice_declarations(ctx):
+        return True
+    for r in ctx.mod.resources.values():
+        if r.type != "google_container_node_pool":
+            continue
+        ncs = r.body.blocks_of("node_config")
+        mt = _literal(ctx, ncs[0].body.attr("machine_type")) if ncs else None
+        if isinstance(mt, str) and T.parse_machine_type(mt) is not None:
+            return True
+        if any(p is not None and p.attr("tpu_topology") is not None
+               for _b, p in _placement_blocks(r.body)):
+            return True
+    return False
+
+
+@rule("tpu-no-monitoring", severity="warning", family="tpu",
+      summary="TPU cluster with cluster monitoring / managed Prometheus "
+              "left disabled or declared-but-unwired")
+def check_no_monitoring(ctx: LintContext):
+    """A TPU fleet is exactly the capacity you cannot debug blind: spot
+    slices churn (``tpu-spot-no-grace``'s premise), elastic resume
+    changes the world size under the job, and the workload's own
+    telemetry (the ``TPU_TELEMETRY_DIR`` Prometheus textfile, the
+    runtime health-probe gauges) needs a scrape pipeline to land in.
+    A ``google_container_cluster`` provisioned next to TPU node pools
+    with no ``monitoring_config`` — or with
+    ``managed_prometheus { enabled = false }`` — ships a fleet whose
+    first preemption incident is investigated with ``kubectl logs``
+    archaeology. The *declared-but-unwired* variant is the sneaky one: a
+    ``monitoring``/``prometheus`` variable exists in the module's API,
+    reviewers see it and assume observability is on, but no cluster
+    block ever reads it."""
+    if not _has_tpu_capacity(ctx):
+        return
+    # module-API variables that look like monitoring knobs, for the
+    # declared-but-unwired diagnosis
+    knobs = sorted(n for n in ctx.mod.variables
+                   if "monitoring" in n or "prometheus" in n)
+    for r in ctx.mod.resources.values():
+        if r.type != "google_container_cluster":
+            continue
+        where = f"{r.file}:{r.line}"
+        mcs = [b for b in _named_blocks(r.body, "monitoring_config")
+               if b is not None]
+        if not mcs:
+            if knobs:
+                yield (where,
+                       f"{r.address}: provisions TPU capacity with no "
+                       f"monitoring_config block, while variable(s) "
+                       f"{', '.join(repr(k) for k in knobs)} are declared "
+                       f"but never wired into one — reviewers will "
+                       f"assume observability is on; add "
+                       f"monitoring_config {{ managed_prometheus {{ "
+                       f"enabled = … }} }} reading them")
+            else:
+                yield (where,
+                       f"{r.address}: provisions TPU capacity with "
+                       f"cluster monitoring left at defaults (no "
+                       f"monitoring_config block) — spot churn, elastic "
+                       f"resume, and the workload's Prometheus textfile "
+                       f"telemetry all need managed collection; declare "
+                       f"monitoring_config {{ managed_prometheus {{ "
+                       f"enabled = true }} }}")
+            continue
+        for mc in mcs:
+            for mp in _named_blocks(mc, "managed_prometheus"):
+                if mp is None:
+                    continue
+                attr = mp.attr("enabled")
+                enabled = _literal(ctx, attr)
+                # unresolvable (a var reference) gets the benefit of the
+                # doubt — pre-flight lint must not false-positive what
+                # it cannot see
+                if enabled is False:
+                    line = attr.line if attr is not None and attr.line \
+                        else r.line
+                    yield (f"{r.file}:{line}",
+                           f"{r.address}: managed_prometheus is "
+                           f"explicitly disabled on a TPU cluster — the "
+                           f"fleet's step-latency/MFU/SLO metrics have "
+                           f"nowhere to land; enable it or wire an "
+                           f"external scrape")
 
 
 @rule("tpu-multihost-placement", severity="error", family="tpu",

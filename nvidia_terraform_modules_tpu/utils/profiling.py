@@ -76,16 +76,31 @@ def device_trace(log_dir: str, *, host_tracer_level: int = 2,
 
 
 @contextmanager
-def annotate(name: str) -> Iterator[None]:
+def annotate(name: str, telemetry=None) -> Iterator[None]:
     """Named region on the trace timeline (``jax.profiler``'s
     ``TraceAnnotation``): dispatches issued inside the block — and
     their device kernels — group under ``name`` in the viewer. Cheap
     enough to leave in production code; a no-op when no trace is
-    active."""
+    active.
+
+    When the telemetry plane is active (``TPU_TELEMETRY_DIR`` or an
+    injected registry), the same ``name`` is ALSO emitted as a host-side
+    telemetry span — so an XLA device trace and the telemetry timeline
+    correlate region-for-region by name (the ``device_trace`` capture
+    shows the kernels, the telemetry span shows where that region sits
+    among checkpoints, restarts, and serve requests).
+    """
     import jax
 
-    with jax.profiler.TraceAnnotation(name):
-        yield
+    from ..telemetry import get_registry
+
+    reg = telemetry if telemetry is not None else get_registry()
+    if reg.enabled:
+        with reg.span(name), jax.profiler.TraceAnnotation(name):
+            yield
+    else:
+        with jax.profiler.TraceAnnotation(name):
+            yield
 
 
 def trace_once(fn: Callable[..., Any], *args: Any, log_dir: str,
@@ -111,10 +126,17 @@ def trace_artifacts(log_dir: str) -> list[str]:
     """Paths of trace files produced under ``log_dir`` (the
     ``plugins/profile/<run>/`` layout TensorBoard expects). Empty means
     the capture recorded nothing — usually a window that missed the
-    sync."""
+    sync.
+
+    Deterministically sorted by path *components*, independent of
+    ``os.walk``'s directory enumeration order: callers golden-test and
+    diff these lists, and a flat string sort is separator-dependent
+    (``a-b/`` vs ``a/b`` order flips with the platform separator).
+    """
     found: list[str] = []
-    for root, _dirs, files in os.walk(log_dir):
-        found.extend(os.path.join(root, f) for f in files
+    for root, dirs, files in os.walk(log_dir):
+        dirs.sort()   # deterministic descent, platform-independent
+        found.extend(os.path.join(root, f) for f in sorted(files)
                      if f.endswith((".xplane.pb", ".perfetto-trace",
                                     ".json.gz")))
-    return sorted(found)
+    return sorted(found, key=lambda p: p.split(os.sep))

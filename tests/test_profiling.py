@@ -60,3 +60,41 @@ def test_annotate_is_noop_without_trace():
     with annotate("idle"):
         x = jnp.arange(4).sum()
     assert int(x) == 6
+
+
+def test_annotate_forwards_name_into_span_layer(tmp_path):
+    """With a live registry, the XLA-trace annotation name ALSO lands as
+    a host telemetry span — device traces and the telemetry timeline
+    correlate by name."""
+    from nvidia_terraform_modules_tpu.telemetry import Registry
+
+    reg = Registry(str(tmp_path))
+    with annotate("train_step", telemetry=reg):
+        jnp.arange(4).sum()
+    spans = [e for e in reg.events if e["kind"] == "span"]
+    assert [e["name"] for e in spans] == ["train_step"]
+
+
+def test_annotate_disabled_registry_emits_nothing():
+    from nvidia_terraform_modules_tpu.telemetry import NULL
+
+    with annotate("quiet", telemetry=NULL):
+        pass
+    assert NULL.events == []
+
+
+def test_trace_artifacts_sorted_by_path_components(tmp_path):
+    """Deterministic component-wise order, independent of os.walk
+    enumeration and of separator-vs-sibling string quirks
+    (``a-b`` sorts after ``a/b`` component-wise, before it stringwise)."""
+    for rel in ("a-b/x.xplane.pb", "a/b/y.xplane.pb", "a/z.perfetto-trace",
+                "a/b/a.json.gz", "ignored/readme.txt"):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_bytes(b"")
+    arts = trace_artifacts(str(tmp_path))
+    rels = [a[len(str(tmp_path)) + 1:] for a in arts]
+    assert rels == ["a/b/a.json.gz", "a/b/y.xplane.pb",
+                    "a/z.perfetto-trace", "a-b/x.xplane.pb"]
+    # and stable across repeated scans
+    assert trace_artifacts(str(tmp_path)) == arts

@@ -534,6 +534,98 @@ resource "google_container_node_pool" "fleet" {
     assert by_rule(run_lint(path), "tpu-multihost-placement") == []
 
 
+_TPU_POOL = """
+resource "google_container_node_pool" "slice" {
+  name    = "slice"
+  cluster = "c"
+
+  node_config {
+    machine_type = "ct5lp-hightpu-4t"
+  }
+}
+"""
+
+
+def test_no_monitoring_fires_on_tpu_cluster_without_monitoring(tmp_path):
+    path = write_mod(tmp_path, _TPU_POOL + """
+resource "google_container_cluster" "this" {
+  name = "c"
+}
+""")
+    found = by_rule(run_lint(path), "tpu-no-monitoring")
+    assert len(found) == 1
+    assert "no monitoring_config block" in found[0].message
+    assert "managed_prometheus" in found[0].message
+
+
+def test_no_monitoring_flags_explicitly_disabled_prometheus(tmp_path):
+    path = write_mod(tmp_path, _TPU_POOL + """
+resource "google_container_cluster" "this" {
+  name = "c"
+
+  monitoring_config {
+    enable_components = ["SYSTEM_COMPONENTS"]
+
+    managed_prometheus {
+      enabled = false
+    }
+  }
+}
+""")
+    found = by_rule(run_lint(path), "tpu-no-monitoring")
+    assert len(found) == 1
+    assert "explicitly disabled" in found[0].message
+
+
+def test_no_monitoring_names_declared_but_unwired_variable(tmp_path):
+    path = write_mod(tmp_path, _TPU_POOL + """
+variable "enable_managed_prometheus" {
+  description = "Observability toggle nobody wired in."
+  type        = bool
+  default     = true
+}
+
+resource "google_container_cluster" "this" {
+  name = "c"
+}
+""")
+    found = by_rule(run_lint(path), "tpu-no-monitoring")
+    assert len(found) == 1
+    assert "declared but never wired" in found[0].message
+    assert "enable_managed_prometheus" in found[0].message
+
+
+def test_no_monitoring_clean_when_enabled_or_unresolvable(tmp_path):
+    path = write_mod(tmp_path, _TPU_POOL + """
+variable "mp" {
+  description = "Managed prometheus toggle."
+  type        = bool
+  default     = true
+}
+
+resource "google_container_cluster" "this" {
+  name = "c"
+
+  monitoring_config {
+    managed_prometheus {
+      enabled = var.mp
+    }
+  }
+}
+""")
+    assert by_rule(run_lint(path), "tpu-no-monitoring") == []
+
+
+def test_no_monitoring_silent_without_tpu_capacity(tmp_path):
+    # a CPU-only cluster is not this rule's business
+    path = write_mod(tmp_path, """
+resource "google_container_cluster" "this" {
+  name = "plain"
+}
+""")
+    assert by_rule(run_lint(path), "tpu-no-monitoring") == []
+
+
 def test_tpu_facts_tables_agree_with_module():
     """The vendored facts and gke-tpu's own tpu_generations local must
     agree — the drift rule depends on the facts being right."""
