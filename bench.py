@@ -729,6 +729,161 @@ def section_serve_flash() -> dict:
     return out
 
 
+def section_serve_engine() -> dict:
+    """The continuous-batching engine under a SEEDED POISSON ARRIVAL
+    TRACE (``utils/traffic.py`` — the same generator the tfsim fleet
+    simulator consumes, so one seed names one workload across both):
+    ragged prompt lengths AND ragged per-request generation budgets
+    (the deterministic stand-in for eos-variable outputs), requests
+    arriving over time, KV held in the paged block pool.
+
+    Reports sustained tokens/s, p50/p99 request latency, and the KV
+    block high-water mark against the dense ``[slots, max_len]``
+    reservation — plus the scheduler headline: continuous batching
+    (per-request retirement + immediate slot refill) vs the SAME
+    engine in ``static_batching`` mode (run-to-completion: admission
+    only when the pool is idle, early finishers idle until the batch
+    drains). Identical compiled steps and dispatch pattern on both
+    sides, so the ratio isolates the SCHEDULER — it is meaningful on
+    CPU too (the win is wave count, not hardware). A telemetry-
+    overhead leg times the same schedule with the serve gauges/spans
+    enabled."""
+    import dataclasses
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from nvidia_terraform_modules_tpu.models import (
+        BurnInConfig,
+        init_params,
+    )
+    from nvidia_terraform_modules_tpu.models.serving import (
+        make_serve_engine,
+    )
+    from nvidia_terraform_modules_tpu.telemetry import Registry
+    from nvidia_terraform_modules_tpu.utils.traffic import (
+        poisson_trace,
+        ragged_lengths,
+        trace_summary,
+    )
+
+    on = _on_tpu()
+    if on:
+        srv_cfg = dataclasses.replace(_flagship_cfg(), attn="dense")
+    else:
+        # big enough that a decode wave's compute dominates host
+        # dispatch — the scheduling ratio (waves saved) must show in
+        # wall-clock, not drown in per-wave Python overhead
+        srv_cfg = BurnInConfig(vocab=2048, d_model=256, n_heads=4,
+                               d_ff=1024, n_layers=2, seq_len=64,
+                               batch=4, dtype=jnp.float32, attn="dense")
+    seed = 0
+    n_req, slots = (16, 8) if on else (12, 4)
+    plo, phi = (128, 512) if on else (4, 16)
+    # LONG-TAILED generation budgets (exponential around the mean, the
+    # shape eos-variable outputs have): the tail request is what makes
+    # run-to-completion idle whole batches
+    nlo, nhi, nmean = (8, 192, 48.0) if on else (2, 48, 12.0)
+    kv_block = 16 if on else 4
+    lens = ragged_lengths(n_req, seed, lo=plo, hi=phi)
+    n_news = ragged_lengths(n_req, seed + 1, lo=nlo, hi=nhi, mean=nmean)
+    # arrivals compressed to a busy window scaled to the platform's
+    # serve time: the sustained number is throughput under backlog
+    # with real queueing, not under idle gaps
+    rate = n_req / (2.0 if on else 0.05)
+    arrivals = poisson_trace(rate, n_req, seed)
+    params = init_params(jax.random.PRNGKey(0), srv_cfg)
+    prompts = [
+        jax.random.randint(jax.random.PRNGKey(i), (lens[i],), 0,
+                           srv_cfg.vocab)
+        for i in range(n_req)
+    ]
+    max_len = max(L + n for L, n in zip(lens, n_news))
+    total_tokens = sum(n_news)
+    sync_outs = _serve_sync(jax, jnp)
+
+    engine = make_serve_engine(params, srv_cfg, max_len=max_len,
+                               kv_block=kv_block)
+    # compile (every distinct prompt length) + two full warm passes per
+    # schedule variant
+    sync_outs(engine(prompts, n_news, slots=slots))
+    sync_outs(engine(prompts, n_news, slots=slots))
+    sync_outs(engine(prompts, n_news, slots=slots, arrivals=arrivals))
+    sync_outs(engine(prompts, n_news, slots=slots,
+                     static_batching=True))
+
+    t_cont = _repeat_timed(lambda: sync_outs(
+        engine(prompts, n_news, slots=slots, arrivals=arrivals)))
+    stats = engine.last_stats
+    # saturated (no arrival gaps): the apples-to-apples clock for the
+    # run-to-completion comparison — and the DETERMINISTIC schedule,
+    # so waves and block accounting come from here (under arrivals,
+    # which requests overlap depends on wall-clock and the peak
+    # wobbles run to run)
+    t_sat = _repeat_timed(lambda: sync_outs(
+        engine(prompts, n_news, slots=slots)))
+    sat_stats = engine.last_stats
+    sat_waves = sat_stats["waves"]
+    t_rtc = _repeat_timed(lambda: sync_outs(
+        engine(prompts, n_news, slots=slots, static_batching=True)))
+    rtc_stats = engine.last_stats
+
+    # telemetry-overhead leg: same saturated schedule, serve gauges +
+    # spans + JSONL writes on
+    root = tempfile.mkdtemp(prefix="bench_serve_tel_")
+    try:
+        # identical pool geometry to the bare engine — anything else
+        # would attribute attention/pool differences to telemetry
+        inst = make_serve_engine(params, srv_cfg, max_len=max_len,
+                                 kv_block=kv_block,
+                                 telemetry=Registry(root))
+        sync_outs(inst(prompts, n_news, slots=slots))
+        sync_outs(inst(prompts, n_news, slots=slots))
+        t_inst = _repeat_timed(lambda: sync_outs(
+            inst(prompts, n_news, slots=slots)))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    kv = sat_stats["kv"]
+    lat = stats["latency_ms"]
+    out = {
+        "serve_engine_requests": n_req,
+        "serve_engine_slots": slots,
+        "serve_engine_trace": {"kind": "poisson", "seed": seed,
+                               "rate": rate,
+                               **trace_summary(arrivals)},
+        "serve_engine_total_tokens": total_tokens,
+        **_rate_fields("serve_engine_tokens_per_s", total_tokens,
+                       t_cont),
+        **_rate_fields("serve_engine_saturated_tokens_per_s",
+                       total_tokens, t_sat),
+        **_rate_fields("serve_engine_rtc_tokens_per_s", total_tokens,
+                       t_rtc),
+        # the regression marker this round retires: per-request
+        # retirement + immediate refill must beat run-to-completion on
+        # ragged workloads at >= 2 slots — same compiled steps, the
+        # ratio is pure scheduling (see the wave counts alongside)
+        "serve_engine_vs_rtc_speedup": round(
+            _median(t_rtc) / max(_median(t_sat), 1e-12), 2),
+        "serve_engine_rtc_waves": rtc_stats["waves"],
+        "serve_engine_p50_ms": lat["p50"],
+        "serve_engine_p99_ms": lat["p99"],
+        "serve_engine_kv_block": kv["block_size"],
+        "serve_engine_kv_blocks": kv["num_blocks"],
+        "serve_engine_kv_peak_blocks": kv["high_water"],
+        # paged high-water rows vs the dense [slots, max_len]
+        # reservation: < 1 is HBM the paging handed back
+        "serve_engine_kv_utilisation": kv["utilisation"],
+        "serve_engine_kv_mean_utilisation": kv["mean_utilisation"],
+        "serve_engine_waves": sat_waves,
+        "serve_engine_telemetry_overhead_frac": round(
+            _median(t_inst) / max(_median(t_sat), 1e-12) - 1.0, 4),
+    }
+    return out
+
+
 def section_longctx() -> dict:
     """Long-context attention: pallas flash kernel vs XLA dense at S=4096 —
     the regime ring/flash attention exist for (O(S²) HBM traffic
@@ -1048,6 +1203,7 @@ SECTIONS = {
     "serve": section_serve,
     "serve_spec": section_serve_spec,
     "serve_flash": section_serve_flash,
+    "serve_engine": section_serve_engine,
     "longctx": section_longctx,
     "flash_bwd": section_flash_bwd,
     "checkpoint": section_checkpoint,
@@ -1077,6 +1233,7 @@ SECTION_TIMEOUT_S = {
     "serve": 1500,
     "serve_spec": 1500,
     "serve_flash": 1500,
+    "serve_engine": 1500,
     "longctx": 600,
     "flash_bwd": 600,
     # host-side I/O only (no XLA programs beyond init), but the flagship
@@ -1426,9 +1583,30 @@ def main() -> None:
                 "unfused expected off-TPU")
         if "serve_tokens_per_s" in merged:
             expectations["serve_tokens_per_s"] = (
-                "engine number includes per-step host admission; at tiny "
-                "CPU shapes host dispatch dominates — compare against "
+                "engine number includes per-wave host admission and the "
+                "paged pool's gather/scatter; at tiny CPU shapes host "
+                "dispatch dominates — compare against "
                 "decode_tokens_per_s on chip only")
+        if "serve_engine_vs_rtc_speedup" in merged:
+            expectations["serve_engine_vs_rtc_speedup"] = (
+                "meaningful ON CPU TOO: the win is scheduling (fewer "
+                "total waves — retired slots refill instead of idling "
+                "until the batch drains), not hardware; expected > 1 at "
+                ">= 2 slots on ragged workloads. Absolute tokens/s is "
+                "still chip-only.")
+        if "serve_engine_telemetry_overhead_frac" in merged:
+            expectations["serve_engine_telemetry_overhead_frac"] = (
+                "tiny CPU waves (~ms): the flushed per-admission/"
+                "retirement span writes read as a larger fraction than "
+                "on chip, where waves and runs are longer — the <2% "
+                "gate is pinned tier-1 with the decomposed per-op "
+                "measurement in tests/test_bench.py, not this capture")
+        if "serve_engine_p99_ms" in merged:
+            expectations["serve_engine_p99_ms"] = (
+                "tiny CPU shapes: latency is host dispatch + queueing "
+                "under the compressed arrival trace, not model time — "
+                "the p50/p99 SHAPE (queueing under bursts) is the "
+                "portable signal, the milliseconds are not")
         if "serve_spec_speedup" in merged:
             expectations["serve_spec_speedup"] = (
                 "tiny CPU shapes: per-slot [1,k+1] verification ~= k+1 "

@@ -21,12 +21,19 @@ from .moe import (  # noqa: F401
 )
 from .decode import (  # noqa: F401
     forward_cached,
+    forward_paged,
     greedy_decode,
     init_cache,
     make_decoder,
     make_sampler,
     quantize_kv,
     sample_decode,
+)
+from .paging import (  # noqa: F401
+    BlockAllocator,
+    blocks_for_rows,
+    init_paged_cache,
+    paged_pool_spec,
 )
 from .serving import make_serve_engine, serve  # noqa: F401
 from .speculative import (  # noqa: F401

@@ -157,17 +157,24 @@ class BurnInConfig:
 def apply_rope(x, positions, theta: float = 10000.0):
     """Rotary embedding on ``[B, T, H, D]`` at (possibly traced) positions.
 
-    Half-split convention: the head dim's two halves rotate as pairs.
-    Angles compute in f32 regardless of activation dtype (rope is
-    precision-sensitive at long context), output returns in ``x.dtype``.
+    ``positions`` is ``[T]`` (shared across the batch — training and
+    solo decode) or ``[B, T]`` (per-row — the paged serving pool, where
+    every slot sits at its own depth). Half-split convention: the head
+    dim's two halves rotate as pairs. Angles compute in f32 regardless
+    of activation dtype (rope is precision-sensitive at long context),
+    output returns in ``x.dtype``.
     """
     d = x.shape[-1]
     half = d // 2
     freqs = jnp.exp(
         -jnp.arange(0, half, dtype=jnp.float32) * (2.0 / d) * jnp.log(theta))
-    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [T, half]
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    ang = positions.astype(jnp.float32)[..., None] * freqs  # [..., T, half]
+    if positions.ndim == 1:
+        cos = jnp.cos(ang)[None, :, None, :]
+        sin = jnp.sin(ang)[None, :, None, :]
+    else:
+        cos = jnp.cos(ang)[:, :, None, :]
+        sin = jnp.sin(ang)[:, :, None, :]
     x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)

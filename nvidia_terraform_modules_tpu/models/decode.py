@@ -196,7 +196,9 @@ def _cached_attention(q, k_cache, v_cache, q_pos, scale,
                       k_scale=None, v_scale=None, int8_kernel=True):
     """Attention of ``q`` ``[B, T, H, D]`` over the full cache buffer.
 
-    ``q_pos`` ``[T]`` are the global positions of the query tokens; cache
+    ``q_pos`` ``[T]`` (shared across the batch) or ``[B, T]`` (per-row —
+    the paged serving pool's gathered caches, where every slot sits at
+    its own depth) are the global positions of the query tokens; cache
     slots at positions > q_pos are masked (causal over the cache, which
     also hides the not-yet-written zero slots — they sit at positions
     above ``pos`` by construction).
@@ -237,9 +239,11 @@ def _cached_attention(q, k_cache, v_cache, q_pos, scale,
         # the per-row generality lives in the kernel's pos argument.
         from ..ops.decode_attention import int8_kv_decode_attention
 
+        pos_b = (jnp.broadcast_to(q_pos[0], (b,)) if q_pos.ndim == 1
+                 else q_pos[:, 0])
         out = int8_kv_decode_attention(
             q[:, 0], k_cache, k_scale, v_cache, v_scale,
-            jnp.broadcast_to(q_pos[0], (b,)), scale=scale)
+            pos_b, scale=scale)
         return out[:, None]
     kv = k_cache.shape[2]
     rep = h // kv
@@ -254,8 +258,13 @@ def _cached_attention(q, k_cache, v_cache, q_pos, scale,
         # [B, S, KV] → [B, KV, 1, 1, S]: one multiply on the score tensor
         s = s * k_scale.transpose(0, 2, 1)[:, :, None, None, :]
     k_pos = jnp.arange(k_cache.shape[1])
-    mask = q_pos[:, None] >= k_pos[None, :]              # [T, S_max]
-    s = jnp.where(mask[None, None, None], s, -1e30)
+    if q_pos.ndim == 1:
+        mask = q_pos[:, None] >= k_pos[None, :]          # [T, S_max]
+        mask = mask[None, None, None]
+    else:
+        mask = q_pos[:, :, None] >= k_pos[None, None, :]  # [B, T, S_max]
+        mask = mask[:, None, None]
+    s = jnp.where(mask, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     if v_scale is not None:
         p = p * v_scale.transpose(0, 2, 1)[:, :, None, None, :]
@@ -265,6 +274,107 @@ def _cached_attention(q, k_cache, v_cache, q_pos, scale,
     out = jnp.einsum("bkgts,bskd->btkgd", p.astype(q.dtype), v_op,
                      preferred_element_type=jnp.float32)
     return out.reshape(b, t, h, d).astype(q.dtype)
+
+
+def _transformer_body(params, tokens, cfg: BurnInConfig, q_pos, store,
+                      attend, rules: ShardingRules | None = None):
+    """The cached-transformer trunk shared by every KV storage layout.
+
+    ``forward_cached`` (dense ``[B, S_max]`` buffers) and
+    ``forward_paged`` (block/paged physical pool) differ ONLY in how
+    fresh K/V rows are written and how the attention context is read —
+    everything else (projections, rope at ``q_pos``, residuals, MoE/MLP,
+    the final norm + tied unembedding) is this one function, so the two
+    layouts can never drift numerically. Per layer: ``store(li, k, v) →
+    handle`` writes the fresh rows into the layout's storage;
+    ``attend(li, q, k, v, handle) → [B, T, H, D]`` computes attention
+    (from the local rows during a pure prefill, from the stored context
+    otherwise). ``q_pos`` is ``[T]`` or ``[B, T]`` and feeds rope
+    directly, so per-row positions cost nothing extra.
+    """
+    def act(x, *rest):
+        if rules is None:
+            return x
+        return jax.lax.with_sharding_constraint(x, rules.shard(rules.act(*rest)))
+
+    b, t = tokens.shape
+    x = params["embed"][tokens]                           # [B, T, D]
+    x = act(x, None, None)
+    for li, layer in enumerate(params["layers"]):
+        h = _rmsnorm(x, layer["attn_norm"])
+        q = h @ layer["wq"]
+        k = h @ layer["wk"]
+        v = h @ layer["wv"]
+
+        def split(tns, heads=cfg.n_heads):
+            tns = tns.reshape(b, t, heads, cfg.head_dim)
+            return act(tns, None, "tp", None)
+
+        q = split(q)
+        k, v = split(k, cfg.kv_heads), split(v, cfg.kv_heads)
+        if cfg.rope:
+            # rotate at GLOBAL positions (traced is fine); K is rotated
+            # before the cache write, so cached rows never need
+            # re-rotation at later steps
+            q = apply_rope(q, q_pos, cfg.rope_theta)
+            k = apply_rope(k, q_pos, cfg.rope_theta)
+        handle = store(li, k, v)
+        attn = attend(li, q, k, v, handle)
+        attn = attn.reshape(b, t, cfg.d_model)
+        x = x + act(attn @ layer["wo"], None, None)
+
+        h = _rmsnorm(x, layer["mlp_norm"])
+        if cfg.n_experts > 0:
+            x = x + act(_moe_ffn(h, layer, cfg, rules), None, None)
+        else:
+            h = jax.nn.gelu((h @ layer["up"]).astype(jnp.float32)).astype(cfg.dtype)
+            h = act(h, None, "tp")
+            x = x + act(h @ layer["down"], None, None)
+
+    x = _rmsnorm(x, params["out_norm"])
+    logits = x @ params["embed"].T
+    return act(logits, None, None)
+
+
+def _prompt_attention(q, k, v, q_pos, scale, cfg: BurnInConfig,
+                      prefill_impl: str, quant: bool):
+    """The pos==0 PROMPT attention branches shared by both cache
+    layouts' attend adapters (``None`` → the caller attends over its
+    stored context instead):
+
+    - ``"flash"`` (t>1): prompt-only causal attention, fused tiles
+      (the cache holds nothing the prompt shouldn't already see). The
+      pallas kernel is MHA-shaped, so prefill broadcasts K/V once
+      (prompt-sized, one-time); the per-STEP cached path contracts
+      grouped queries against the un-repeated cache instead.
+      Unquantised k/v on purpose: the prompt's own attention pays no
+      cache read, so prefill numerics stay full-precision even under
+      an int8 cache.
+    - ``"dense"`` + int8 cache (t>1): pure prefill attends the
+      just-computed FULL-PRECISION k/v (causally masked) so prefill
+      numerics match the flash branch — only later steps read the
+      quantised rows. Same pos==0 precondition; mid-stream t>1
+      forwards (speculative verification) pass ``"cached"`` instead.
+
+    One definition so the dense-buffer and paged layouts can never
+    drift on the prompt path — the same no-drift goal
+    :func:`_transformer_body` serves for the trunk.
+    """
+    t = q.shape[1]
+    rep = cfg.n_heads // cfg.kv_heads
+
+    def grow(tns):
+        """KV-group broadcast for the MHA-shaped flash kernel."""
+        return jnp.repeat(tns, rep, axis=2) if rep > 1 else tns
+
+    if t > 1 and prefill_impl == "flash":
+        from ..ops.flash_attention import flash_attention
+
+        return flash_attention(q, grow(k), grow(v), causal=True,
+                               scale=scale)
+    if t > 1 and prefill_impl == "dense" and quant:
+        return _cached_attention(q, k, v, q_pos, scale)
+    return None
 
 
 def forward_cached(params, tokens, cache, cfg: BurnInConfig,
@@ -298,47 +408,15 @@ def forward_cached(params, tokens, cache, cfg: BurnInConfig,
     ``greedy_decode`` selects it exactly there.
     """
     _check_cfg(cfg)
-
-    def act(x, *rest):
-        if rules is None:
-            return x
-        return jax.lax.with_sharding_constraint(x, rules.shard(rules.act(*rest)))
-
     b, t = tokens.shape
     pos0 = cache["pos"]
     q_pos = pos0 + jnp.arange(t)
-    x = params["embed"][tokens]                           # [B, T, D]
-    x = act(x, None, None)
     scale = 1.0 / (cfg.head_dim ** 0.5)
-
     quant = "k_scale" in cache
     new_k, new_v = [], []
     new_ks, new_vs = [], []
-    for li, (layer, k_cache, v_cache) in enumerate(
-            zip(params["layers"], cache["k"], cache["v"])):
-        h = _rmsnorm(x, layer["attn_norm"])
-        q = h @ layer["wq"]
-        k = h @ layer["wk"]
-        v = h @ layer["wv"]
 
-        def split(tns, heads=cfg.n_heads):
-            tns = tns.reshape(b, t, heads, cfg.head_dim)
-            return act(tns, None, "tp", None)
-
-        q = split(q)
-        k, v = split(k, cfg.kv_heads), split(v, cfg.kv_heads)
-        if cfg.rope:
-            # rotate at GLOBAL positions (pos0 + local index, traced is
-            # fine); K is rotated before the cache write, so cached rows
-            # never need re-rotation at later steps
-            q = apply_rope(q, q_pos, cfg.rope_theta)
-            k = apply_rope(k, q_pos, cfg.rope_theta)
-        rep = cfg.n_heads // cfg.kv_heads
-
-        def grow(tns):
-            """KV-group broadcast for the MHA-shaped flash kernel."""
-            return jnp.repeat(tns, rep, axis=2) if rep > 1 else tns
-
+    def store(li, k, v):
         k_scale = v_scale = None
         if quant:
             # write path: quantise the fresh rows; the cache never holds
@@ -353,55 +431,142 @@ def forward_cached(params, tokens, cache, cfg: BurnInConfig,
             new_vs.append(v_scale)
         else:
             k_w, v_w = k, v
-        k_cache = jax.lax.dynamic_update_slice(k_cache, k_w, (0, pos0, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(v_cache, v_w, (0, pos0, 0, 0))
+        k_cache = jax.lax.dynamic_update_slice(
+            cache["k"][li], k_w, (0, pos0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            cache["v"][li], v_w, (0, pos0, 0, 0))
         new_k.append(k_cache)
         new_v.append(v_cache)
+        return k_cache, v_cache, k_scale, v_scale
 
-        if t > 1 and prefill_impl == "flash":
-            # prompt-only causal attention, fused tiles (pos == 0: the
-            # cache holds nothing the prompt shouldn't already see). The
-            # pallas kernel is MHA-shaped, so prefill broadcasts K/V once
-            # (prompt-sized, one-time); the per-STEP path below contracts
-            # grouped queries against the un-repeated cache instead.
-            # Unquantised k/v on purpose: the prompt's own attention pays
-            # no cache read, so prefill numerics stay full-precision even
-            # under an int8 cache
-            from ..ops.flash_attention import flash_attention
+    def attend(li, q, k, v, handle):
+        k_cache, v_cache, k_scale, v_scale = handle
+        attn = _prompt_attention(q, k, v, q_pos, scale, cfg,
+                                 prefill_impl, quant)
+        if attn is not None:
+            return attn
+        return _cached_attention(q, k_cache, v_cache, q_pos, scale,
+                                 k_scale, v_scale,
+                                 int8_kernel=int8_kernel
+                                 and rules is None)
 
-            attn = flash_attention(q, grow(k), grow(v), causal=True,
-                                   scale=scale)
-        elif t > 1 and prefill_impl == "dense" and quant:
-            # pure prefill over an int8 cache: attend over the
-            # just-computed FULL-PRECISION k/v (causally masked) so
-            # prefill numerics match the flash branch — only later steps
-            # read the quantised rows. Same pos==0 precondition as the
-            # flash prefill; mid-stream t>1 forwards (speculative
-            # verification) pass prefill_impl="cached" instead.
-            attn = _cached_attention(q, k, v, q_pos, scale)
-        else:
-            attn = _cached_attention(q, k_cache, v_cache, q_pos, scale,
-                                     k_scale, v_scale,
-                                     int8_kernel=int8_kernel
-                                     and rules is None)
-        attn = attn.reshape(b, t, cfg.d_model)
-        x = x + act(attn @ layer["wo"], None, None)
-
-        h = _rmsnorm(x, layer["mlp_norm"])
-        if cfg.n_experts > 0:
-            x = x + act(_moe_ffn(h, layer, cfg, rules), None, None)
-        else:
-            h = jax.nn.gelu((h @ layer["up"]).astype(jnp.float32)).astype(cfg.dtype)
-            h = act(h, None, "tp")
-            x = x + act(h @ layer["down"], None, None)
-
-    x = _rmsnorm(x, params["out_norm"])
-    logits = x @ params["embed"].T
+    logits = _transformer_body(params, tokens, cfg, q_pos, store, attend,
+                               rules)
     new_cache: dict[str, Any] = {"k": new_k, "v": new_v, "pos": pos0 + t}
     if quant:
         new_cache["k_scale"] = new_ks
         new_cache["v_scale"] = new_vs
-    return act(logits, None, None), new_cache
+    return logits, new_cache
+
+
+def forward_paged(params, tokens, cache, cfg: BurnInConfig,
+                  rules: ShardingRules | None = None, *,
+                  prefill_impl: str = "cached", active=None,
+                  int8_kernel: bool = True):
+    """Forward ``tokens`` ``[B, T]`` through a BLOCK/PAGED KV cache.
+
+    The paged twin of :func:`forward_cached` (same
+    :func:`_transformer_body` trunk, so the math cannot drift): the
+    physical store is one ``[num_blocks, block_size, kv, D]`` buffer per
+    layer shared by every row, ``cache["block_tables"]`` ``[B, NT]``
+    maps each row's logical block index to a physical block, and
+    ``cache["pos"]`` is PER-ROW ``[B]`` — every slot sits at its own
+    depth, which is what lets one compiled step advance a whole
+    continuous-batching pool (``models/serving.py``).
+
+    Write path: the fresh rows scatter to ``(table[pos // bs], pos %
+    bs)`` — one scatter per layer, disjoint across live rows because
+    the allocator (``models/paging.py``) never shares a block. Read
+    path: the logical view gathers ``k_phys[block_tables]`` →
+    ``[B, NT·bs, kv, D]`` and runs the SAME masked
+    :func:`_cached_attention` the dense buffer uses (rows past each
+    row's ``pos`` are position-masked, so recycled-block garbage is
+    unreachable); the int8-KV scale sidecars gather alongside and keep
+    the scale-after-dot contraction — and, gathered into a contiguous
+    buffer, the T=1 pallas decode kernel gate still applies on TPU.
+
+    ``active`` ``[B]`` bool (default all-true) fences DEAD rows: an
+    idle or retired slot's writes are rerouted to reserved physical
+    block 0 (the garbage block) and its ``pos`` freezes — without the
+    reroute, a retired slot still computing in the static batch would
+    scribble over blocks the allocator already recycled to another
+    request. ``prefill_impl`` resolves as in :func:`forward_cached`
+    (``"flash"``/``"dense"`` are pos==0 prompt paths; mid-stream t>1
+    forwards pass ``"cached"``).
+
+    ``rules`` applies the trunk's activation sharding constraints
+    (batch = the slot pool over the data axes, heads over ``tp``) —
+    the serving engine passes it for the all-slots decode/verification
+    steps on a mesh, where the batch dim is the validated
+    slots-divide-data-shards pool; the one-row admission forwards run
+    unconstrained (a size-1 batch has nothing to shard) exactly as the
+    dense engine's admission always did. Callers passing ``rules``
+    should also pass ``int8_kernel=False`` (pallas on sharded operands
+    — same hazard as :func:`forward_cached`).
+
+    Precondition (the caller's, as ever): each active row's
+    ``pos + T`` stays within its ALLOCATED rows — the engine sizes every
+    admission's block grant for prompt + generation up front.
+    """
+    _check_cfg(cfg)
+    b, t = tokens.shape
+    tables = cache["block_tables"]                        # [B, NT]
+    nt = tables.shape[1]
+    bs = cache["k"][0].shape[1]
+    pos0 = cache["pos"]                                   # [B]
+    q_pos = pos0[:, None] + jnp.arange(t)[None, :]        # [B, T]
+    scale = 1.0 / (cfg.head_dim ** 0.5)
+    quant = "k_scale" in cache
+    if active is None:
+        active = jnp.ones((b,), bool)
+    blk = jnp.clip(q_pos // bs, 0, nt - 1)
+    pb = jnp.take_along_axis(tables, blk, axis=1)         # [B, T] physical
+    pb = jnp.where(active[:, None], pb, 0)                # dead → garbage
+    pr = q_pos % bs
+    new_k, new_v = [], []
+    new_ks, new_vs = [], []
+
+    def store(li, k, v):
+        if quant:
+            k_w, k_s = quantize_kv(k)
+            v_w, v_s = quantize_kv(v)
+            new_ks.append(cache["k_scale"][li].at[pb, pr].set(k_s))
+            new_vs.append(cache["v_scale"][li].at[pb, pr].set(v_s))
+        else:
+            k_w, v_w = k, v
+        new_k.append(cache["k"][li].at[pb, pr].set(k_w))
+        new_v.append(cache["v"][li].at[pb, pr].set(v_w))
+        return li
+
+    def attend(li, q, k, v, handle):
+        del handle
+        attn = _prompt_attention(q, k, v, q_pos, scale, cfg,
+                                 prefill_impl, quant)
+        if attn is not None:
+            return attn
+        kv_shape = (b, nt * bs, cfg.kv_heads, cfg.head_dim)
+        k_log = new_k[li][tables].reshape(kv_shape)
+        v_log = new_v[li][tables].reshape(kv_shape)
+        ks_log = vs_log = None
+        if quant:
+            ks_log = new_ks[li][tables].reshape(kv_shape[:3])
+            vs_log = new_vs[li][tables].reshape(kv_shape[:3])
+        # same guard depth as forward_cached: a mesh-sharded pool keeps
+        # the jnp path whatever the caller's kernel flag says
+        return _cached_attention(q, k_log, v_log, q_pos, scale,
+                                 ks_log, vs_log,
+                                 int8_kernel=int8_kernel
+                                 and rules is None)
+
+    logits = _transformer_body(params, tokens, cfg, q_pos, store, attend,
+                               rules)
+    new_cache = dict(cache)
+    new_cache.update(k=new_k, v=new_v,
+                     pos=jnp.where(active, pos0 + t, pos0))
+    if quant:
+        new_cache["k_scale"] = new_ks
+        new_cache["v_scale"] = new_vs
+    return logits, new_cache
 
 
 def _select_prefill_impl(cfg: BurnInConfig, t: int, prefill: str) -> str:

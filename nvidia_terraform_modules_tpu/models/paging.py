@@ -1,0 +1,193 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Block/paged KV-cache allocation for the continuous-batching engine.
+
+The dense serving pool reserved ``max_len`` cache rows per slot for the
+whole life of the engine — a request generating 12 tokens from an
+8-token prompt held the same HBM as one filling the window. With ragged
+real traffic (variable prompt AND output lengths) most of that
+reservation is dead rows. The paged design (vLLM's PagedAttention,
+re-thought for XLA static shapes) splits the physical cache into
+fixed-size BLOCKS:
+
+- the physical store is one ``[num_blocks, block_size, kv_heads, D]``
+  buffer per layer, shared by every request;
+- each request owns a **block table** — the logical→physical mapping —
+  and exactly ``ceil(rows_needed / block_size)`` blocks, so internal
+  fragmentation is bounded by ``block_size - 1`` rows per request;
+- blocks return to a host-side free list the moment the request
+  retires, and the next admission reuses them — the recycling that lets
+  a fixed pool serve an unbounded request stream.
+
+Division of labour (the same host/device split the serving engine
+already lives by): the **host** owns WHICH blocks belong to which
+request (:class:`BlockAllocator` — plain integers, no device traffic),
+the **device** owns the math — block tables and per-slot positions are
+small int32 arrays threaded through ``decode.forward_paged``, whose
+gather/scatter path reads and writes physical rows through them with no
+data-dependent shapes anywhere.
+
+Block 0 is RESERVED as the garbage block: idle and retired slots'
+writes are routed there (their table rows may point at blocks already
+recycled to another request — without the reroute a retired slot's
+still-computing forward would corrupt the new owner's cache).
+
+``tests/test_paging.py`` pins the allocator invariants (no double
+alloc, free-list recycling, exhaustion, the fragmentation bound) and
+``tests/test_serving.py`` the end-to-end exactness of paged serving
+against solo decode.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .burnin import BurnInConfig
+from .decode import cache_rows
+
+
+def blocks_for_rows(rows: int, block_size: int) -> int:
+    """Blocks needed to hold ``rows`` cache rows (0 rows → 0 blocks)."""
+    if rows < 0:
+        raise ValueError(f"rows must be >= 0, got {rows}")
+    return -(-rows // block_size)
+
+
+class BlockAllocator:
+    """Host-side free-list allocator over ``num_blocks`` physical blocks.
+
+    Block 0 (more generally ``reserved`` leading blocks) is never handed
+    out — it is the garbage block dead slots write into. ``alloc`` is
+    all-or-nothing (a request needs its whole table before admission);
+    ``free`` returns blocks for reuse in LIFO order, so a retire→admit
+    pair tends to reuse hot blocks. Exhaustion returns ``None`` — the
+    scheduler's signal to hold the request in the admission queue until
+    a retirement frees capacity (admission control, not an error).
+    """
+
+    def __init__(self, num_blocks: int, *, reserved: int = 1):
+        if num_blocks <= reserved:
+            raise ValueError(
+                f"num_blocks ({num_blocks}) must exceed the reserved "
+                f"garbage block count ({reserved})")
+        self.num_blocks = num_blocks
+        self.reserved = reserved
+        self._free = list(range(num_blocks - 1, reserved - 1, -1))
+        self._owned: set[int] = set()
+        self.high_water = 0
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return len(self._owned)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """``n`` blocks or ``None`` (never a partial grant)."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        if n > len(self._free):
+            return None
+        blocks = [self._free.pop() for _ in range(n)]
+        self._owned.update(blocks)
+        self.high_water = max(self.high_water, len(self._owned))
+        return blocks
+
+    def free(self, blocks) -> None:
+        for b in blocks:
+            if b not in self._owned:
+                raise ValueError(
+                    f"block {b} is not allocated (double free, a "
+                    f"reserved block, or a foreign id)")
+            self._owned.remove(b)
+            self._free.append(b)
+
+    def stats(self) -> dict[str, int]:
+        return {
+            "num_blocks": self.num_blocks,
+            "reserved": self.reserved,
+            "in_use": self.in_use,
+            "free": self.free_blocks,
+            "high_water": self.high_water,
+        }
+
+
+def paged_pool_spec(cfg: BurnInConfig, max_len: int, block_size: int,
+                    cache_dtype: str = "bf16") -> dict[str, int]:
+    """Static pool geometry shared by every constructor and the engine.
+
+    ``rows`` is :func:`..decode.cache_rows`'s buffer length for
+    ``max_len`` (int8 keeps its 256-row kernel grain), ``tables`` the
+    per-slot block-table width, sized so the gathered logical cache
+    spans at least ``rows`` — every position a request can legally
+    occupy has a table entry, and the logical width stays static.
+    """
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    rows = cache_rows(max_len, cache_dtype)
+    tables = blocks_for_rows(rows, block_size)
+    return {"rows": rows, "tables": tables, "block_size": block_size,
+            "logical_rows": tables * block_size}
+
+
+def init_paged_cache(cfg: BurnInConfig, slots: int, max_len: int, *,
+                     block_size: int, num_blocks: int,
+                     rules=None, cache_dtype: str = "bf16") -> dict[str, Any]:
+    """Zeroed paged pool + per-slot tables and positions.
+
+    Layout (per layer): ``k``/``v`` ``[num_blocks, block_size, kv, D]``;
+    int8 caches add ``k_scale``/``v_scale`` ``[num_blocks, block_size,
+    kv]`` sidecars. ``block_tables`` is ``[slots, tables]`` int32 —
+    all-zero at init, i.e. every slot points at the garbage block until
+    its first admission — and ``pos`` ``[slots]`` int32.
+
+    With ``rules`` the KV-head axis shards over ``tp`` when it divides;
+    the block axis replicates (blocks are assigned dynamically, so a
+    block-sharded pool would turn every gather into a cross-shard
+    shuffle). The paged pool's HBM story is the block COUNT — sized to
+    live rows, not ``slots × max_len`` — so replication across the data
+    groups still undercuts the dense pool whenever occupancy is ragged.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if cache_dtype not in ("bf16", "int8"):
+        raise ValueError(
+            f"unknown cache_dtype {cache_dtype!r}: use bf16|int8")
+    spec = paged_pool_spec(cfg, max_len, block_size, cache_dtype)
+    quant = cache_dtype == "int8"
+    s4 = s3 = None
+    if rules is not None:
+        from jax.sharding import PartitionSpec as P
+
+        tp = rules.mesh.shape.get("tp", 1)
+        head_axis = "tp" if cfg.kv_heads % tp == 0 else None
+        # the BLOCK axis replicates (blocks are assigned dynamically);
+        # only the KV-head axis shards, matching init_cache's layout
+        s4 = rules.shard(P(None, None, head_axis, None))
+        s3 = rules.shard(P(None, None, head_axis))
+
+    def zeros(shape, dtype, sharding):
+        if sharding is None:
+            return jnp.zeros(shape, dtype)
+        # materialise DIRECTLY into the sharded layout (one transient
+        # replicated pool on one device is the OOM the sharding avoids)
+        return jax.jit(lambda: jnp.zeros(shape, dtype),
+                       out_shardings=sharding)()
+
+    kv_shape = (num_blocks, block_size, cfg.kv_heads, cfg.head_dim)
+    buf_dtype = jnp.int8 if quant else cfg.dtype
+    pool: dict[str, Any] = {
+        "k": [zeros(kv_shape, buf_dtype, s4) for _ in range(cfg.n_layers)],
+        "v": [zeros(kv_shape, buf_dtype, s4) for _ in range(cfg.n_layers)],
+        "block_tables": jnp.zeros((slots, spec["tables"]), jnp.int32),
+        "pos": jnp.zeros((slots,), jnp.int32),
+    }
+    if quant:
+        pool["k_scale"] = [zeros(kv_shape[:3], jnp.float32, s3)
+                           for _ in range(cfg.n_layers)]
+        pool["v_scale"] = [zeros(kv_shape[:3], jnp.float32, s3)
+                           for _ in range(cfg.n_layers)]
+    return pool

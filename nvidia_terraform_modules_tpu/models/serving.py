@@ -1,134 +1,98 @@
 # SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
 # SPDX-License-Identifier: Apache-2.0
-"""Slot-based continuous batching: the serving-engine loop.
+"""Continuous-batching serve engine on a block/paged KV cache.
 
 ``greedy_decode`` serves ONE batch whose requests start and stop
-together. Real serving traffic doesn't: requests arrive with different
-prompt lengths and leave after different generation lengths, and a
-static batch wastes every slot that finished early. The standard answer
-(vLLM/TGI-style continuous batching, re-thought for TPU static shapes)
-is a fixed pool of SLOTS:
+together. Real serving traffic doesn't: requests ARRIVE over time with
+different prompt lengths and LEAVE after different generation lengths.
+This module is the scheduler between those two worlds — the vLLM-style
+continuous-batching engine, re-thought for TPU static shapes:
 
-- the KV cache is one ``[slots, S_max, kv, D]`` buffer per layer — a
-  slot's region is recycled the moment its request completes;
-- every decode step advances ALL slots in one compiled program (a
-  ``vmap`` of the single-row cached forward, so each slot carries its
-  OWN position — the per-row ``pos`` is exactly what distinguishes this
-  from ``greedy_decode``'s single shared position);
-- prefills run at the request's exact prompt length and are scattered
-  into the slot's cache region; admission is host-side bookkeeping
-  between compiled steps (the host owns WHICH request sits in a slot,
-  the device owns the math — no data-dependent shapes anywhere).
+- **admission queue**: requests join in-flight decode at step (wave)
+  boundaries the moment a slot AND enough KV blocks are free; an
+  optional per-request arrival time (from ``utils/traffic.py``'s seeded
+  Poisson/diurnal traces) gates admission, so the engine serves a load
+  model, not just a ready-made batch;
+- **paged KV cache** (``models/paging.py`` + ``decode.forward_paged``):
+  the physical cache is fixed-size blocks shared by every request; each
+  admission allocates exactly the blocks its prompt + generation budget
+  needs, and retirement returns them to the free list — ragged sequence
+  lengths stop reserving ``max_len`` HBM per slot, and a bounded pool
+  (``kv_blocks``) turns into admission control instead of an OOM;
+- **per-request EOS retirement**: a finished request's blocks free and
+  its slot re-admits immediately — the freed capacity is what lets a
+  fixed pool beat run-to-completion batching on ragged-EOS traffic
+  (``bench.py section_serve_engine`` pins the comparison);
+- **chunked-prefill/decode interleaving** (``prefill_chunk``): a long
+  prompt admits one ``[1, C]`` chunk per wave while every active slot
+  keeps decoding between chunks — long prompts stop stalling the decode
+  batch for their whole prefill;
+- **per-request ``n_new``**: a sequence of generation budgets makes
+  ragged OUTPUT lengths first-class (the bench's deterministic ragged
+  workload), with the same per-request retirement.
 
-Exactness contract: each request's tokens EQUAL ``greedy_decode`` run
-alone on that request (same weights, same prompt) — batching and slot
-recycling are scheduling, never a different model. This mirrors the
-cached-vs-full-re-forward contract in ``models/decode.py`` and is pinned
-by ``tests/test_serving.py``, including schedules where requests share
-steps with neighbours that joined mid-flight.
+Every decode wave advances ALL busy slots in ONE compiled program — a
+batched ``[slots, 1]`` cached forward over the paged pool with per-slot
+positions and block tables; admission is host-side bookkeeping between
+compiled steps (the host owns WHICH request sits in a slot and WHICH
+physical blocks it holds, the device owns the math — no data-dependent
+shapes anywhere). Dead slots keep computing (the static-shape bubble)
+but their cache writes are fenced to the reserved garbage block, so a
+retired slot can never scribble over blocks already recycled to a new
+request.
 
-Efficiency notes (TPU): the vmapped row step lowers to the same batched
-GEMMs as a ``[slots, 1]`` decode forward — weights are broadcast, not
-copied. Finished-and-empty slots still compute (the bubble every static
-engine pays); admission cost is one exact-length prefill compile per
-DISTINCT prompt length, so production callers should pad prompts into a
-few length buckets — the loop itself does not care.
+Exactness contract (unchanged from the dense-pool engine, pinned by
+``tests/test_serving.py``): each request's tokens EQUAL ``greedy_decode``
+run alone on that request — batching, paging, slot recycling, arrival
+schedules and chunk interleaving are scheduling, never a different
+model. Speculative (``spec_k``) and int8-KV paths keep their contracts
+on paged storage: the verification forward reads the same gathered
+rows a plain paged step would, so spec-vs-plain equality survives
+occupancy > 1.
+
+Telemetry (PR 7 plane): ``serve_queue_depth`` / ``serve_slot_occupancy``
+/ ``kv_blocks_in_use`` gauges per wave, a ``serve_prefill`` span per
+admission and a ``serve_request`` span per retirement carrying
+``queue_wait_ms`` / ``prefill_ms`` / ``decode_steps`` — the
+p50/p99 request-latency record lands in ``serve_request_ms``.
 
 Reference analogue: none — the reference provisions serving
 infrastructure (node pools, runtime DaemonSets) and never touches model
-bytes (SURVEY §2.6); this module is the workload the ``serve``-named
+bytes (SURVEY §2.6); this engine is the workload the ``serve``-named
 slice pools exist to run.
 """
 
 from __future__ import annotations
 
 import functools
+import time
 from collections import deque
 from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..parallel.sharding import ShardingRules
 from .burnin import BurnInConfig
-from .decode import cache_rows, forward_cached, init_cache
+from .decode import forward_paged
+from .paging import BlockAllocator, blocks_for_rows, paged_pool_spec
 
 
-def _stacked_cache(cfg: BurnInConfig, slots: int, max_len: int,
-                   rules: ShardingRules | None = None,
-                   cache_dtype: str = "bf16"):
-    """One pooled cache: every per-layer leaf gains a leading slot dim;
-    ``pos`` becomes per-slot ``[slots]``.
-
-    With ``rules`` the SLOT dim shards over the data axes (each device
-    group owns a subset of the pool — requests are data parallelism at
-    serve time) and KV heads over ``tp`` when they divide it, matching
-    ``init_cache``'s single-batch layout. ``cache_dtype="int8"`` pools
-    the quantised layout (int8 buffers + f32 scale sidecars).
-    """
-    if cache_dtype not in ("bf16", "int8"):
-        raise ValueError(
-            f"unknown cache_dtype {cache_dtype!r}: use bf16|int8")
-    quant = cache_dtype == "int8"
-    s5 = s4 = s1 = None
-    if rules is not None:
-        data_shards = 1
-        for a in rules.data:
-            data_shards *= rules.mesh.shape.get(a, 1)
-        if slots % data_shards:
-            raise ValueError(
-                f"slots ({slots}) must divide over the data axes "
-                f"({data_shards} shards) — pad the pool")
-        tp = rules.mesh.shape.get("tp", 1)
-        head_axis = "tp" if cfg.kv_heads % tp == 0 else None
-        # k/v leaves are [slots, 1, S_max, kv, D] (the row's batch dim
-        # rides along); the leading SLOT dim takes the batch sharding,
-        # KV heads take tp — rules.act's implicit first axis set is
-        # exactly the slot dim here. Scale sidecars drop the head dim.
-        s5 = rules.shard(rules.act(None, None, head_axis, None))
-        s4 = rules.shard(rules.act(None, None, head_axis))
-        s1 = rules.shard(rules.act())
-
-    def zeros(shape, dtype, sharding):
-        if sharding is None:
-            return jnp.zeros(shape, dtype)
-        # materialise DIRECTLY into the sharded layout: an eager zeros +
-        # device_put would first commit the whole replicated pool on one
-        # device — the transient OOM sharding the pool exists to avoid
-        return jax.jit(lambda: jnp.zeros(shape, dtype),
-                       out_shardings=sharding)()
-
-    kv_shape = (slots, 1, cache_rows(max_len, cache_dtype),
-                cfg.kv_heads, cfg.head_dim)
-    buf_dtype = jnp.int8 if quant else cfg.dtype
-    stacked: dict[str, Any] = {
-        "k": [zeros(kv_shape, buf_dtype, s5) for _ in range(cfg.n_layers)],
-        "v": [zeros(kv_shape, buf_dtype, s5) for _ in range(cfg.n_layers)],
-        "pos": zeros((slots,), jnp.int32, s1),
-    }
-    if quant:
-        stacked["k_scale"] = [zeros(kv_shape[:4], jnp.float32, s4)
-                              for _ in range(cfg.n_layers)]
-        stacked["v_scale"] = [zeros(kv_shape[:4], jnp.float32, s4)
-                              for _ in range(cfg.n_layers)]
-    return stacked
-
-
-@functools.partial(jax.jit, donate_argnums=(1,))
-def _insert_row(row_cache, stacked, slot):
-    """Scatter a freshly prefilled row cache into the pool at ``slot``
-    (a traced index: one compile serves every slot)."""
-    new = jax.tree.map(lambda big, one: big.at[slot].set(one),
-                       {k: v for k, v in stacked.items() if k != "pos"},
-                       {k: v for k, v in row_cache.items() if k != "pos"})
-    new["pos"] = stacked["pos"].at[slot].set(row_cache["pos"])
-    return new
+def _request_key(rng, req, pos):
+    """THE sampled-token key contract, in one place: key =
+    ``fold_in(fold_in(rng, request), position)``. Used by the
+    admission path (host-side, first token) and inside the compiled
+    sampled step (vmapped, every wave) — one definition so the two
+    sites can never diverge on what keys tokens, which is what makes
+    sampled output schedule-invariant."""
+    return jax.random.fold_in(jax.random.fold_in(rng, req), pos)
 
 
 def _make_pick(sampler):
-    """The greedy-vs-sampled token pick shared by every admission and
-    step path: ``pick(logits [1, T, V], idx, key) → token`` — argmax at
-    ``idx`` when greedy, the sampler over that position otherwise. One
+    """The greedy-vs-sampled token pick shared by every admission path:
+    ``pick(logits [1, T, V], idx, key) → token`` — argmax at ``idx``
+    when greedy, the sampler over that position otherwise. One
     definition so the admission paths and the decode step can never
     diverge on the pick contract."""
     if sampler is None:
@@ -141,24 +105,27 @@ def _make_pick(sampler):
 
 
 def make_serve_step(params, cfg: BurnInConfig, sampler=None, *,
-                    int8_kernel: bool = True):
-    """Compiled all-slots decode step with per-slot positions. The
-    pooled cache is DONATED — the step updates it in place rather than
-    paying a full-pool copy per token (the bandwidth a slot engine
-    exists to save).
+                    int8_kernel: bool = True,
+                    rules: ShardingRules | None = None):
+    """Compiled all-slots decode step over the PAGED pool: one batched
+    ``[slots, 1]`` cached forward (``decode.forward_paged``) with
+    per-slot positions and block tables. The pool is DONATED — the step
+    updates the physical blocks in place rather than paying a full-pool
+    copy per token (the bandwidth a slot engine exists to save).
+    ``active`` fences dead slots' writes to the garbage block and
+    freezes their positions.
 
     ``int8_kernel=False`` keeps an int8 pool's attention on the jnp
     path: the engine passes it whenever the pool is mesh-sharded
     (``rules``), where a pallas_call on sharded operands inside jit is
-    not a supported lowering (see ``forward_cached``).
+    not a supported lowering (see ``forward_paged``).
 
-    Greedy (``sampler=None``): ``(tokens [slots], cache) → (next,
-    cache)``. Sampled: ``(tokens, keys [slots, 2], cache) → ...`` —
-    one PRNG key per slot per step, supplied by the engine so token
-    randomness is keyed to (request, position), never to the schedule.
+    Greedy (``sampler=None``): ``(tokens [slots], active, pool) →
+    (next, pool)``. Sampled: ``(tokens, active, req_ids, positions,
+    rng, pool) → ...`` — one PRNG key per slot per step, derived INSIDE
+    the compiled step from (request, position) so token randomness is
+    keyed to the request stream, never to the schedule.
     """
-    pick = _make_pick(sampler)
-
     # params enter every compiled function as a runtime ARGUMENT, never a
     # closure: a closed-over array tree lowers as module constants, and at
     # flagship size that embeds the full weight set (hundreds of MB) into
@@ -166,83 +133,80 @@ def make_serve_step(params, cfg: BurnInConfig, sampler=None, *,
     # the serve section ever ran a step (BENCH_tpu_capture_r04 serve
     # timeout). Passing the tree costs nothing: the buffers are already
     # device-resident.
-    def row(p, tok, key, cache):
-        logits, cache = forward_cached(p, tok[None, None], cache, cfg,
-                                       prefill_impl="cached",
-                                       int8_kernel=int8_kernel)
-        return pick(logits, -1, key), cache
-
-    vrow = jax.vmap(row, in_axes=(None, 0, 0, 0))
-
     if sampler is None:
-        @functools.partial(jax.jit, donate_argnums=(2,))
-        def step(p, tokens, stacked):
-            dummy = jnp.zeros((tokens.shape[0], 2), jnp.uint32)
-            return vrow(p, tokens, dummy, stacked)
+        @functools.partial(jax.jit, donate_argnums=(3,))
+        def step(p, tokens, active, pool):
+            logits, pool = forward_paged(p, tokens[:, None], pool, cfg,
+                                         rules, prefill_impl="cached",
+                                         active=active,
+                                         int8_kernel=int8_kernel)
+            return jnp.argmax(logits[:, -1], axis=-1), pool
 
-        return lambda tokens, stacked: step(params, tokens, stacked)
+        return lambda tokens, active, pool: step(params, tokens, active,
+                                                 pool)
 
-    @functools.partial(jax.jit, donate_argnums=(5,))
-    def sampled_step(p, tokens, req_ids, positions, rng, stacked):
-        # key = fold_in(fold_in(rng, request), position), derived INSIDE
-        # the compiled step: one dispatch per step regardless of slot
-        # count, and typed or legacy rng keys both work
-        keys = jax.vmap(lambda r, pos: jax.random.fold_in(
-            jax.random.fold_in(rng, r), pos))(req_ids, positions)
-        return vrow(p, tokens, keys, stacked)
+    @functools.partial(jax.jit, donate_argnums=(6,))
+    def sampled_step(p, tokens, active, req_ids, positions, rng, pool):
+        logits, pool = forward_paged(p, tokens[:, None], pool, cfg,
+                                     rules, prefill_impl="cached",
+                                     active=active,
+                                     int8_kernel=int8_kernel)
+        # keys derived INSIDE the compiled step (one dispatch per step
+        # regardless of slot count; typed or legacy rng keys both work)
+        # from the shared (request, position) contract
+        keys = jax.vmap(lambda r, pos: _request_key(rng, r, pos))(
+            req_ids, positions)
+        toks = jax.vmap(lambda row, kk: sampler(row[None], kk)[0])(
+            logits[:, -1], keys)
+        return toks, pool
 
-    return lambda tokens, req_ids, positions, rng, stacked: sampled_step(
-        params, tokens, req_ids, positions, rng, stacked)
+    return lambda tokens, active, req_ids, positions, rng, pool: \
+        sampled_step(params, tokens, active, req_ids, positions, rng, pool)
 
 
-def make_spec_step(params, cfg: BurnInConfig, k: int):
-    """Compiled all-slots SPECULATIVE step: prompt-lookup drafts + one
-    ``[1, k+1]`` verification forward per slot, vmapped over the pool.
+def make_spec_step(params, cfg: BurnInConfig, k: int, *,
+                   int8_kernel: bool = True,
+                   rules: ShardingRules | None = None):
+    """Compiled all-slots SPECULATIVE step on the paged pool:
+    prompt-lookup drafts + ONE batched ``[slots, k+1]`` verification
+    forward per iteration.
 
     Extends ``speculative_greedy_decode``'s single-request loop
-    (``models/speculative.py``) to continuous batching: each slot
-    drafts ``k`` tokens by bigram lookup in its OWN context row,
-    verifies them in one cached forward at its OWN position, and
-    accepts the longest prefix matching the model's argmax chain —
-    per-slot acceptance counts diverge freely because the rollback is
-    per-row ``pos`` arithmetic, never buffer surgery (rejected draft
-    rows stay position-masked until real decode writes reclaim them,
-    the same mechanism chunked prefill uses for pad rows).
+    (``models/speculative.py`` — the acceptance core ``accept_drafts``
+    is literally shared) to continuous batching: each slot drafts ``k``
+    tokens by bigram lookup in its OWN context row, verifies them at
+    its OWN position through the paged gather path, and accepts the
+    longest prefix matching the model's argmax chain. Rollback is
+    per-slot ``pos`` arithmetic, never buffer surgery: rejected draft
+    rows stay position-masked in the slot's blocks until real writes
+    reclaim them.
 
-    Step signature (``ctx``/``cur``/``n_out``/``stacked`` donated):
-    ``(ctx [slots, Lc], cur [slots], n_out [slots], n_new, eos_id,
-    active [slots] bool, stop_count, stacked) → (ctx, cur, n_out,
-    fin [slots] bool, steps, stacked)`` where ``ctx`` rows hold
+    Step signature (``ctx``/``cur``/``n_out``/``pool`` donated):
+    ``(ctx [slots, Lc], cur [slots], n_out [slots], n_new [slots],
+    eos_id, active [slots] bool, stop_count, pool) → (ctx, cur, n_out,
+    fin [slots] bool, steps [slots], pool)`` where ``ctx`` rows hold
     prefix+prompt+generated tokens, ``cur`` the valid length, ``n_out``
-    tokens generated; ``eos_id < 0`` disables eos. The step is a
-    device-resident MULTI-step: it loops until ``stop_count`` of the
-    ``active`` slots have finished (``fin``), freezing each finished
-    slot's state at the step it completed, and returns ``steps``, the
-    number of unfrozen-active slot-steps it ran (the stats
-    denominator). Emission per slot is capped at ``n_new - n_out``
-    FIRST, then truncated at the first eos inside the capped window —
-    so a slot can never finish on an eos the cap already excluded.
+    tokens generated, ``n_new`` the PER-SLOT generation budget;
+    ``eos_id < 0`` disables eos. The step is a device-resident
+    MULTI-step: it loops until ``stop_count`` of the ``active`` slots
+    have finished (``fin``), freezing each finished slot's state at the
+    step it completed, and returns ``steps``, the PER-SLOT count of
+    unfrozen-active verification steps it ran (summed: the stats
+    denominator; per slot: each request's decode_steps). Emission
+    per slot is capped at ``n_new - n_out`` FIRST, then truncated at
+    the first eos inside the capped window — so a slot can never finish
+    on an eos the cap already excluded. Frozen slots still compute a
+    forward per iteration, but their writes are fenced to the garbage
+    block and their ``pos`` frozen — a few ms of MXU time traded
+    against a ~90 ms host round trip per avoided sync (the measured
+    dispatch RTT through the tunnelled backend).
     """
-    from .speculative import _ngram_draft
+    from .speculative import _ngram_draft, accept_drafts
 
-    def row(p, ctx_row, cur, n_done, n_new, eos_id, cache):
-        last = ctx_row[cur - 1]
-        draft = _ngram_draft(ctx_row, cur, k, cfg.vocab)          # [k]
-        block = jnp.concatenate([last[None], draft])[None]        # [1,k+1]
-        # "cached": a mid-stream t>1 forward attending over the cache
-        # buffer at this slot's own position
-        logits, cache = forward_cached(p, block, cache, cfg,
-                                       prefill_impl="cached")
-        preds = jnp.argmax(logits[0], axis=-1)                    # [k+1]
-        agree = draft == preds[:-1]
-        n_acc = jnp.argmin(jnp.concatenate(
-            [agree, jnp.array([False])]).astype(jnp.int32))       # 0..k
-        # accepted drafts + the model's own next token (correction at
-        # the first mismatch, continuation when all agreed)
-        new_toks = jnp.concatenate([draft, jnp.zeros((1,), draft.dtype)])
-        new_toks = new_toks.at[n_acc].set(preds[n_acc])
+    def row_accept(ctx_row, cur, n_done, draft, preds, n_new_row, eos_id):
+        new_toks, n_acc = accept_drafts(draft, preds)         # [k+1]
         idx = jnp.arange(k + 1)
-        emit = jnp.clip(n_acc + 1, 0, jnp.maximum(n_new - n_done, 0))
+        emit = jnp.clip(n_acc + 1, 0, jnp.maximum(n_new_row - n_done, 0))
         is_eos = (new_toks == eos_id) & (eos_id >= 0) & (idx < emit)
         hit = jnp.any(is_eos)
         emit = jnp.where(hit, jnp.argmax(is_eos) + 1, emit)
@@ -250,218 +214,154 @@ def make_spec_step(params, cfg: BurnInConfig, k: int):
         upd = jax.lax.dynamic_slice_in_dim(ctx_row, cur, k + 1)
         upd = jnp.where(keep, new_toks, upd)
         ctx_row = jax.lax.dynamic_update_slice_in_dim(ctx_row, upd, cur, 0)
-        # rollback by pos arithmetic: valid forwarded rows are exactly
-        # the context minus the one new un-forwarded last token
-        cache = dict(cache)
-        cache["pos"] = cur + emit - 1
         n_done = n_done + emit
-        done = (n_done >= n_new) | hit
-        return ctx_row, cur + emit, n_done, done, cache
+        done = (n_done >= n_new_row) | hit
+        return ctx_row, cur + emit, n_done, done
 
-    vrow = jax.vmap(row, in_axes=(None, 0, 0, 0, None, None, 0))
+    vaccept = jax.vmap(row_accept, in_axes=(0, 0, 0, 0, 0, 0, None))
+    vdraft = jax.vmap(lambda c, cu: _ngram_draft(c, cu, k, cfg.vocab))
 
-    # Device-resident MULTI-step: the host loop's only job is retirement
-    # and admission, but a per-token host round-trip costs a full
-    # dispatch RTT (~90 ms through the tunnelled backend — observed to
-    # turn a 2× speculative win into a 16× loss). So the compiled step
-    # advances EVERY slot repeatedly inside a while_loop and returns
-    # only when ``stop_count`` active slots have finished — one sync per
-    # retirement wave, not per verification step. Slots that finish
-    # early are FROZEN (ctx/cur/n_out held at the step they first
-    # completed) so the host retires exactly the state the per-step
-    # design would have produced: eos overruns never accumulate, and
-    # the emission cap keeps every active slot terminating, bounding
-    # the loop. Frozen slots still burn a forward per iteration — a
-    # few ms of MXU time traded against a 90 ms RTT per avoided sync.
-    # params as argument, not closure — see make_serve_step.
+    # params as argument, not closure — see make_serve_step
     @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 8))
-    def step(p, ctx, cur, n_out, n_new, eos_id, active, stop_count,
-             stacked):
+    def step(p, ctx, cur, n_out, n_new, eos_id, active, stop_count, pool):
         def cond(s):
             _, _, _, fin, _, _ = s
             return jnp.sum(fin & active) < stop_count
 
         def body(s):
-            ctx, cur, n_out, fin, steps, stacked = s
-            # frozen = finished OR never-active: an inactive slot's
-            # stale ctx/cur must not keep growing across iterations
-            # (cur would drift toward the buffer end and lean on
-            # dynamic_update_slice clamping for safety) — freeze it
-            # exactly like a finished slot; admission re-seeds both
+            ctx, cur, n_out, fin, steps, pool = s
+            # frozen = finished OR never-active: a frozen slot's writes
+            # are fenced to the garbage block (forward_paged's active
+            # mask) and its ctx/cur/pos held, so its stale state can
+            # never drift or corrupt a recycled block
             frozen = fin | ~active
-            nctx, ncur, nn_out, done, nstacked = vrow(
-                p, ctx, cur, n_out, n_new, eos_id, stacked)
+            last = jnp.take_along_axis(
+                ctx, jnp.maximum(cur - 1, 0)[:, None], axis=1)  # [S, 1]
+            draft = vdraft(ctx, cur)                            # [S, k]
+            block = jnp.concatenate([last, draft], axis=1)      # [S, k+1]
+            # "cached": a mid-stream t>1 forward attending over each
+            # slot's gathered blocks at its own position
+            logits, npool = forward_paged(p, block, pool, cfg, rules,
+                                          prefill_impl="cached",
+                                          active=~frozen,
+                                          int8_kernel=int8_kernel)
+            preds = jnp.argmax(logits, axis=-1)                 # [S, k+1]
+            nctx, ncur, nn_out, done = vaccept(ctx, cur, n_out, draft,
+                                               preds, n_new, eos_id)
             ctx = jnp.where(frozen[:, None], ctx, nctx)
             cur = jnp.where(frozen, cur, ncur)
             n_out = jnp.where(frozen, n_out, nn_out)
-            # the cache's per-slot pos freezes too (cheap [slots] mask);
-            # the k/v buffer writes a frozen slot's forward produced are
-            # idempotent re-writes of the same rows (inputs frozen) and
-            # are fully overwritten at the slot's next admission
-            nstacked["pos"] = jnp.where(frozen, stacked["pos"],
-                                        nstacked["pos"])
+            # rollback by pos arithmetic: valid forwarded rows are
+            # exactly the context minus the one new un-forwarded last
+            # token; frozen slots keep the pos forward_paged froze
+            npool = dict(npool)
+            npool["pos"] = jnp.where(frozen, pool["pos"], ncur - 1)
             # count BEFORE updating fin: a slot's finishing step is a
-            # real verification step; frozen iterations are not
-            steps = steps + jnp.sum(active & ~fin)
+            # real verification step; frozen iterations are not.
+            # Per-SLOT so the host can attribute steps to requests
+            steps = steps + (active & ~fin).astype(jnp.int32)
             fin = fin | (done & active)
-            return ctx, cur, n_out, fin, steps, nstacked
+            return ctx, cur, n_out, fin, steps, npool
 
         fin0 = jnp.zeros(active.shape, bool)
-        s = (ctx, cur, n_out, fin0, jnp.int32(0), stacked)
+        s = (ctx, cur, n_out, fin0,
+             jnp.zeros(active.shape, jnp.int32), pool)
         return jax.lax.while_loop(cond, body, s)
 
     return lambda ctx, cur, n_out, n_new, eos_id, active, stop_count, \
-        stacked: step(params, ctx, cur, n_out, n_new, eos_id, active,
-                      stop_count, stacked)
-
-
-def make_prefill(params, cfg: BurnInConfig, max_len: int,
-                 cache_dtype: str = "bf16", sampler=None):
-    """Exact-length prompt prefill → ``(first token, row cache)``.
-
-    One compile per distinct prompt length (jit cache keyed on shape);
-    bucket prompts upstream if that matters for your traffic. The
-    prefill attention impl resolves the same way ``greedy_decode``'s
-    does (``_select_prefill_impl``): dense-trained configs keep the
-    bit-exact dense path, long-context configs (flash/ring/ulysses) go
-    through the fused kernel — dense scores at their prompt lengths are
-    exactly the OOM that impl exists to avoid, and the engine's
-    equality contract is against ``greedy_decode`` with the SAME
-    resolution. ``sampler`` picks the first token instead of argmax.
-    """
-    from .decode import _select_prefill_impl
-
-    pick = _make_pick(sampler)
-
-    # params as argument, not closure — see make_serve_step
-    @functools.partial(jax.jit, static_argnums=(2,))
-    def prefill(p, prompt, impl, key):                     # [1, L]
-        cache = init_cache(cfg, 1, max_len, cache_dtype=cache_dtype)
-        logits, cache = forward_cached(p, prompt, cache, cfg,
-                                       prefill_impl=impl)
-        return pick(logits, -1, key), cache
-
-    def run(prompt, key=None):
-        impl = _select_prefill_impl(cfg, int(prompt.shape[-1]), "auto")
-        if key is None:
-            key = jnp.zeros((2,), jnp.uint32)
-        return prefill(params, prompt, impl, key)
-
-    return run
+        pool: step(params, ctx, cur, n_out, n_new, eos_id, active,
+                   stop_count, pool)
 
 
 def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                       cache_dtype: str = "bf16", prefix=None,
                       sampler=None, prefill_chunk: int | None = None,
-                      spec_k: int | None = None, telemetry=None):
+                      spec_k: int | None = None, telemetry=None,
+                      kv_block: int = 16):
     """Reusable engine: compile once, run many schedules.
 
-    The compiled pieces (per-bucket prefills, the all-slots step) live in
-    the returned closure — repeated calls (and warm-up passes) share
-    them, where calling :func:`serve` repeatedly would rebuild fresh jit
-    wrappers and recompile every time.
+    The compiled pieces (per-bucket admissions, the all-slots paged
+    step) live in the returned closure — repeated calls (and warm-up
+    passes) share them. The KV cache underneath is PAGED
+    (``kv_block``-row blocks; ``models/paging.py``): every run builds a
+    physical pool of ``kv_blocks`` blocks (default: full provisioning —
+    one table's worth per slot, the dense-equivalent capacity at which
+    admission never blocks on memory), admissions allocate exactly the
+    blocks their prompt + generation budget needs, and retirements
+    recycle them. Pass a smaller ``kv_blocks`` to ``run`` to cap KV HBM
+    — the queue then holds requests until blocks free (admission
+    control), and ``run.last_stats["kv"]`` reports the realised
+    high-water mark against the dense reservation.
 
-    ``prefix`` (a ``[L_p]`` token array) enables PREFIX CACHING: the
-    shared prefix — a system prompt, few-shot scaffold, RAG preamble —
-    prefills ONCE into a template row cache here, and every admission
-    starts from a copy, paying only its own suffix's prefill. Results
-    equal decoding ``concat(prefix, prompt)`` from scratch: the suffix
-    forward runs the same mid-stream cached path a decode step uses,
-    just wider.
+    ``prefix`` (a ``[L_p]`` token array) enables PREFIX CACHING, now
+    with physical BLOCK SHARING: the shared prefix prefills once per
+    run into its own blocks; every admission's table points at the full
+    prefix blocks directly (zero copies) and copies only the one
+    partial tail block (``prefix_len % kv_block`` rows). Results equal
+    decoding ``concat(prefix, prompt)`` from scratch.
 
-    ``sampler`` (from :func:`..decode.make_sampler`) switches the engine
-    from greedy to sampled generation; ``run`` then requires ``rng``.
-    Every token's key is derived from (request index, token position) —
-    NEVER from the schedule — so the same ``rng`` yields the same tokens
-    whatever the slot count or admission order (``sampler`` built with
-    ``top_k=1`` reproduces the greedy engine exactly).
+    ``sampler`` (from :func:`..decode.make_sampler`) switches the
+    engine from greedy to sampled generation; ``run`` then requires
+    ``rng``. Every token's key is derived from (request index, token
+    position) — NEVER from the schedule — so the same ``rng`` yields
+    the same tokens whatever the slot count, arrival pattern or
+    admission order (``sampler`` built with ``top_k=1`` reproduces the
+    greedy engine exactly).
 
-    ``prefill_chunk`` switches admission to CHUNKED PREFILL (vLLM's
-    lever, re-thought for XLA's compile model): the prompt is padded
-    into a ``[1, MC, C]`` chunk buffer and prefilled by ONE compiled
-    dispatch — a ``fori_loop`` (traced trip count) of ``[1, C]`` cached
-    forwards — however long the prompt. Exact-length admission compiles
-    once per DISTINCT length; chunked admission compiles once per
-    ENGINE and costs one dispatch per admission.
-    Pad rows land in the cache but are unreachable: cached
-    attention masks ``k_pos > q_pos`` and ``pos`` resets to the true
-    length after admission, so decode writes overwrite them in order.
+    ``prefill_chunk`` switches admission to CHUNKED PREFILL, now
+    INTERLEAVED with decode: the prompt admits one ``[1, C]`` chunk per
+    engine wave while every active slot keeps decoding between chunks —
+    a long prompt no longer stalls the whole decode batch for its full
+    prefill (the stall was the cost of the old one-dispatch sweep).
     Peak prefill score memory drops from ``[T, S_max]`` to
-    ``[C, S_max]`` — chunked admission is also how a long-context
-    engine avoids the dense-prefill OOM without the flash kernel's
-    8-multiple tiling constraint. Exact for bf16 caches (same masked
-    attention set per token, chunking is a scheduling choice); under an
-    ``int8`` cache every token attends fully-quantised history (the
-    one-shot prefill attends its own prompt at full precision), so
-    results are chunk-size-INVARIANT but can differ from unchunked
-    int8 admission within quantisation noise.
+    ``[C, S_max]`` as before. Exact for bf16 caches; under an ``int8``
+    cache every token attends fully-quantised history, so results are
+    chunk-size-INVARIANT but can differ from unchunked int8 admission
+    within quantisation noise.
 
     Int8-weight params (``quantize_params`` trees with QTensor leaves)
-    serve through a PREFILL/DECODE PHASE SPLIT: admissions run from a
+    serve through the PREFILL/DECODE PHASE SPLIT: admissions run from a
     dequantised compute-dtype copy built once here (prompt-width
-    matmuls are compute-bound, where dequant-dot loses to a plain
-    matmul), decode/verification steps from the int8 tree (weight-
-    bandwidth-bound, where int8 HBM bytes win). Costs one extra
-    weight-set residency (int8 + bf16 = 3 bytes/weight); tokens equal
-    the all-int8 engine exactly at f32 compute dtype and within one
-    bf16 weight-rounding otherwise.
+    matmuls are compute-bound), decode/verification steps from the int8
+    tree (weight-bandwidth-bound). Tokens equal the all-int8 engine
+    exactly at f32 compute dtype and within one bf16 weight-rounding
+    otherwise.
 
-    ``spec_k`` turns on SPECULATIVE continuous batching (greedy only):
-    every step drafts ``k`` tokens per slot by prompt lookup in that
-    slot's own context and verifies them in one ``[1, k+1]`` cached
-    forward (see :func:`make_spec_step`) — in the weight-bandwidth-
-    bound decode regime a verification step costs ~one plain step but
-    can emit up to ``k+1`` tokens. Tokens equal the greedy engine's *up
-    to backend matmul-tiling numerics* (the ``models/speculative.py``
-    contract extended per-slot: acceptance tests the model's own argmax
-    chain exactly, but the ``[1, k+1]`` verification forward can tile
-    its matmuls differently from the ``T=1`` step path, so a bf16
-    near-tie argmax may resolve differently on TPU; bit-exact on CPU
-    f32, where the tests pin it). Costs:
-    ``max_len`` must leave ``spec_k`` rows of verification headroom
-    past each request's last token, and the engine reads three small
-    vectors back once per retirement WAVE (the compiled multi-step
-    loops on device until a slot must recycle). After
-    each call ``engine.last_stats`` reports realised acceptance
-    (``generated / slot_steps`` ≥ 1 is the speedup lever vs the plain
-    engine's one token per slot-step).
-
-    **When speculation pays — the retirement regime.** Per accepted
-    token the device math wins (a verification iteration costs ~one
-    plain step — traced at 1.17 vs ~1.1 ms on v5e — and emits ~1.9
-    tokens at 1.9 acceptance), but the ENGINE comparison is decided by
-    retirement synchronisation, not FLOPs. Measured (bench
-    ``serve_spec`` section; see README *Measured performance*):
-
-    - **eos traffic** (production serving — variable-length outputs):
-      the speculative loop checks eos ON DEVICE and reads back once
-      per retirement wave, where the plain loop needs token values per
-      wave — spec wins decisively even against the plain engine's
-      batched-check mode (``eos_check_every``).
-    - **fixed-n_new traffic, no eos**: the plain loop retires by COUNT
-      — fully async, zero mid-schedule readbacks — while spec still
-      syncs once per retirement wave; on a high-readback-latency
-      backend (this repo's tunnelled chip: ~65 ms per pipeline flush)
-      that overhead eats the accept-rate win at most occupancies.
-
-    Use ``spec_k`` for eos/structured traffic; on fixed-length
-    benchmark-style traffic prefer the plain engine, or shrink
-    ``spec_k`` as occupancy grows (smaller verification width).
+    ``spec_k`` turns on SPECULATIVE continuous batching (greedy only)
+    on the paged pool: every step drafts ``k`` tokens per slot by
+    prompt lookup in that slot's own context and verifies them in one
+    batched ``[slots, k+1]`` forward through the same gather path the
+    plain step reads (see :func:`make_spec_step`) — so the acceptance
+    win survives occupancy > 1 on exactly the storage the plain engine
+    uses. ``max_len`` must leave ``spec_k`` rows of verification
+    headroom past each request's last token. After each call
+    ``engine.last_stats`` reports realised acceptance
+    (``accepted_per_step`` ≥ 1 is the speedup lever). Use ``spec_k``
+    for eos/structured traffic; on fixed-length no-eos traffic the
+    plain loop's count-based retirement is fully async and usually
+    wins (see the bench ``serve_spec`` sweep).
 
     ``telemetry`` injects a telemetry registry (default: the process
     registry — the no-op unless ``TPU_TELEMETRY_DIR`` is set). When
-    enabled, every admission emits a ``serve_prefill`` span and every
+    enabled, every admission emits a ``serve_prefill`` span, every
     retirement a ``serve_request`` span (admission → retirement — the
-    p50/p99 request-latency record in ``serve_request_ms``), with
-    generated-token and — for speculative engines — accepted-draft-token
-    counters. Spans clock the host's view of the schedule: on an async
-    backend the admission span covers dispatch, and the request span
-    closes at retirement, which for the plain no-eos loop is the wave
-    the host RETIRED the slot, not device completion.
+    p50/p99 request-latency record in ``serve_request_ms``) carrying
+    ``queue_wait_ms`` / ``prefill_ms`` / ``decode_steps``, and every
+    wave sets the ``serve_queue_depth`` / ``serve_slot_occupancy`` /
+    ``kv_blocks_in_use`` gauges. Spans clock the host's view of the
+    schedule: on an async backend the admission span covers dispatch,
+    and the request span closes at the wave the host RETIRED the slot,
+    not device completion. Under ``eos_check_every > 1`` a span's
+    ``tokens`` counts the SCHEDULED tokens at retirement, which can
+    exceed the emitted output by the lag window when a count-cap
+    retirement precedes the scan that would have seen an earlier eos
+    (``run.last_stats["generated"]`` reports emitted tokens exactly).
     """
     if prefill_chunk is not None and prefill_chunk < 1:
         raise ValueError(
             f"prefill_chunk must be >= 1, got {prefill_chunk}")
+    if kv_block < 1:
+        raise ValueError(f"kv_block must be >= 1, got {kv_block}")
     if spec_k is not None:
         if spec_k < 1:
             raise ValueError(f"spec_k must be >= 1, got {spec_k}")
@@ -486,71 +386,22 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         # matmul — measured 0.72-0.90x end-to-end, BENCH_r04), while
         # decode steps are weight-bandwidth-bound (int8 bytes win). So
         # the engine dequantises ONCE at build into a resident compute-
-        # dtype tree and serves every admission path (prefill, chunked
-        # prefill, prefix/suffix fill) from it; decode and verification
-        # steps keep the int8 tree. Residency cost: int8 + bf16 copies
-        # = 3 bytes/weight vs pure bf16's 2 — the throughput trade the
-        # split exists for. Numerics: admission logits now come from
-        # dequant-rounded compute-dtype weights instead of the in-dot
-        # f32 dequant — identical when compute dtype is f32 (CPU tests
-        # pin engine tokens == solo quantized decode there), within
-        # one bf16 rounding of the weight product on TPU.
+        # dtype tree and serves every admission path from it; decode and
+        # verification steps keep the int8 tree. Residency cost: int8 +
+        # bf16 copies = 3 bytes/weight vs pure bf16's 2.
         prefill_params = jax.tree.map(
             lambda x: x.dequantize() if _is_q(x) else x, params,
             is_leaf=_is_q)
-    prefill = make_prefill(prefill_params, cfg, max_len, cache_dtype,
-                           sampler)
-    # the all-slots step is built per int8-kernel flag on first use: a
-    # mesh-sharded int8 pool must keep the jnp attention path (pallas on
-    # sharded operands — see make_serve_step), and only run() sees rules
-    _steps: dict[bool, Any] = {}
 
-    def step_for(int8_kernel: bool):
-        if int8_kernel not in _steps:
-            _steps[int8_kernel] = make_serve_step(
-                params, cfg, sampler, int8_kernel=int8_kernel)
-        return _steps[int8_kernel]
+    geom = paged_pool_spec(cfg, max_len, kv_block, cache_dtype)
+    bs = kv_block
+    nt = geom["tables"]
+    quant = cache_dtype == "int8"
+    pool_keys = ("k", "v") + (("k_scale", "v_scale") if quant else ())
 
-    spec_step = (make_spec_step(params, cfg, spec_k)
-                 if spec_k is not None else None)
-
-    chunk_fill = None
-    if prefill_chunk is not None:
-        # The whole chunk sweep is ONE compiled dispatch: a fori_loop
-        # with a TRACED trip count walks the [1, MC, C] padded prompt;
-        # each iteration is the same mid-stream cached forward a
-        # per-chunk jit call used to be (masks by position, so the pad
-        # tail never leaks into real tokens' attention) — identical
-        # math in identical order, but admission costs one dispatch
-        # instead of one per chunk (measured: ~12 per-chunk dispatches
-        # per 3k prompt left chunked admission 3-4× behind flash
-        # admission through the tunnelled backend's per-dispatch
-        # latency). Still one compile per ENGINE: MC is static from
-        # max_len; the live-chunk count and last-token offset are
-        # runtime values. params as argument, not closure — see
-        # make_serve_step
-        @functools.partial(jax.jit, donate_argnums=(4,))
-        def _chunk_fill(p, chunks, n, last_idx, cache, key):
-            # chunks [1, MC, C]; n = live chunks; last_idx = the true
-            # last token's offset within chunk n-1
-            def body(i, carry):
-                row, cache = carry
-                logits, cache = forward_cached(
-                    p, chunks[:, i], cache, cfg, prefill_impl="cached")
-                # keep only the FINAL live chunk's last-token logits;
-                # dead trailing chunks never run (fori_loop bound is n)
-                row = jnp.where(i == n - 1, logits[0, last_idx], row)
-                return row, cache
-
-            row0 = jnp.zeros((cfg.vocab,), cfg.dtype)
-            row, cache = jax.lax.fori_loop(0, n, body, (row0, cache))
-            return pick(row[None, None], 0, key), cache
-
-        def chunk_fill(chunks, n, last_idx, cache, key):
-            return _chunk_fill(prefill_params, chunks, n, last_idx,
-                               cache, key)
-    template = None
     prefix_len = 0
+    prefix_full_blocks = 0                 # whole blocks shared read-only
+    prefix_tail_rows = 0                   # rows copied per admission
     if prefix is not None:
         prefix = jnp.asarray(prefix)
         prefix_len = int(prefix.shape[-1])
@@ -558,102 +409,142 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             raise ValueError(
                 f"prefix ({prefix_len}) must leave room under max_len "
                 f"({max_len})")
-        # the template never emits a token, so greedy-vs-sampled does
-        # not matter — a greedy engine reuses its shared prefill (and
-        # its jit cache); only a sampled engine builds a greedy twin
-        template_prefill = (prefill if sampler is None else
-                            make_prefill(prefill_params, cfg, max_len,
-                                         cache_dtype))
-        _first, template = template_prefill(prefix[None, :])
+        prefix_full_blocks = prefix_len // bs
+        prefix_tail_rows = prefix_len % bs
 
-        # params as argument, not closure — see make_serve_step
-        @jax.jit
-        def _suffix_fill(p, suffix, cache, key):  # [1, L_s], template copy
-            logits, cache = forward_cached(p, suffix, cache, cfg,
-                                           prefill_impl="cached")
-            return pick(logits, -1, key), cache
+    # ---------------------------------------------------------- jits
+    # shared helpers for the one-row (per-slot) view of the pool
 
-        def suffix_fill(suffix, cache, key):
-            return _suffix_fill(prefill_params, suffix, cache, key)
+    def _sub1(pool, tables, slot, start):
+        return dict(pool, block_tables=tables[slot][None],
+                    pos=jnp.full((1,), start, jnp.int32))
 
-    def _admit(prompt, key):
-        """(first token, row cache) for one request, via the template
-        when a prefix is cached."""
-        if key is None:
-            key = jnp.zeros((2,), jnp.uint32)
-        if prefill_chunk is not None:
-            return admit_chunked(prompt, key)
-        if template is None:
-            return prefill(prompt[None, :], key)
-        return suffix_fill(prompt[None, :], template, key)
+    def _merge(pool, sub, tables, slot):
+        out = dict(pool)
+        for key_ in pool_keys:
+            out[key_] = sub[key_]
+        out["block_tables"] = tables
+        out["pos"] = pool["pos"].at[slot].set(sub["pos"][0])
+        return out
 
-    if reg.enabled:
-        def admit(prompt, key):
-            t0 = reg.clock()
-            out = _admit(prompt, key)
-            reg.emit_span("serve_prefill", t0, reg.clock(),
-                          prompt_len=int(prompt.shape[-1]))
-            reg.counter("serve_admissions").inc()
-            return out
-    else:
-        admit = _admit
+    def _tail_copy(pool, src, dst):
+        """Copy the prefix's partial tail block into the admission's
+        first own block — the only per-admission prefix bytes; full
+        prefix blocks are shared read-only across every request."""
+        out = dict(pool)
+        for key_ in pool_keys:
+            out[key_] = [buf.at[dst].set(buf[src]) for buf in pool[key_]]
+        return out
 
-    def _check_chunk_bound(length: int) -> int:
-        n = -(-length // prefill_chunk)
-        if prefix_len + n * prefill_chunk > max_len:
-            # the padded tail would dynamic_update_slice past the buffer
-            # end, where XLA CLAMPS the start index and silently
-            # overwrites the last cache rows — refuse loudly instead
-            raise ValueError(
-                f"chunked prefill pads the prompt ({length}) to "
-                f"{n * prefill_chunk} rows, which after the prefix "
-                f"({prefix_len}) exceeds max_len ({max_len}) — raise "
-                f"max_len to >= {prefix_len + n * prefill_chunk} or "
-                f"shrink prefill_chunk")
-        return n
+    @functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(7,))
+    def _admit_full(p, prompt, impl, slot, row, key, tail, pool):
+        """One dispatch per admission: set the slot's table row and
+        start position, copy the prefix tail block (when configured),
+        prefill the prompt through the slot's blocks, pick the first
+        token. ``tail`` is ``(src, dst)`` physical block ids."""
+        tables = pool["block_tables"].at[slot].set(row)
+        if prefix_tail_rows:
+            pool = _tail_copy(pool, tail[0], tail[1])
+        sub = _sub1(pool, tables, slot, prefix_len)
+        # int8_kernel OFF on every admission path: these jits compile
+        # once per engine but run against pools a later run() may have
+        # mesh-sharded (the pallas-on-sharded-operands hazard fires at
+        # t==1 — single-token prompts, C=1 chunks), and admission is a
+        # one-shot dispatch, not the bandwidth-bound wave loop the
+        # kernel exists for
+        logits, sub = forward_paged(p, prompt, sub, cfg,
+                                    prefill_impl=impl,
+                                    int8_kernel=False)
+        return pick(logits, -1, key), _merge(pool, sub, tables, slot)
 
-    def admit_chunked(prompt, key):
-        c = prefill_chunk
-        length = int(prompt.shape[-1])
-        n = _check_chunk_bound(length)
-        if template is None:
-            cache = init_cache(cfg, 1, max_len, cache_dtype=cache_dtype)
-        else:
-            # one whole-cache copy; the sweep donates it forward
-            cache = jax.tree.map(lambda x: x.copy(), template)
-        # ONE [1, MC, C] buffer per admission (static shape → one
-        # compile per engine); trailing dead chunks are never executed
-        mc = max(1, (max_len - prefix_len) // c)
-        padded = jnp.zeros((mc * c,), jnp.int32).at[:length].set(prompt)
-        tok, cache = chunk_fill(padded.reshape(1, mc, c), jnp.int32(n),
-                                jnp.int32(length - 1 - (n - 1) * c),
-                                cache, key)
-        # rewind pos past the pad rows: the next decode write lands at
-        # the true length, reclaiming them one step at a time; rows
-        # beyond pos stay masked (k_pos > q_pos) until overwritten
-        cache["pos"] = jnp.asarray(prefix_len + length, jnp.int32)
-        return tok, cache
+    @functools.partial(jax.jit, donate_argnums=(3,))
+    def _admit_table(slot, row, tail, pool):
+        """Chunked admission's setup dispatch: table row + start pos +
+        prefix tail copy; the chunks then stream via ``_chunk_step``."""
+        tables = pool["block_tables"].at[slot].set(row)
+        if prefix_tail_rows:
+            pool = _tail_copy(pool, tail[0], tail[1])
+        out = dict(pool)
+        out["block_tables"] = tables
+        out["pos"] = pool["pos"].at[slot].set(prefix_len)
+        return out
 
-    def _note_admit(admit_ts, req):
-        if reg.enabled:
-            admit_ts[req] = reg.clock()
+    @functools.partial(jax.jit, donate_argnums=(4,))
+    def _chunk_sweep(p, chunks, n, last_idx, pool, slot, key, true_pos):
+        """ONE-dispatch chunked admission (the speculative loop's
+        variant — its device multi-step has no per-wave host boundary
+        to interleave chunks into, and per-chunk dispatches measured
+        3-4x slower through the tunnelled backend's dispatch latency):
+        a fori_loop with a TRACED trip count walks the ``[1, MC, C]``
+        padded prompt; dead trailing chunks never run. Same math in
+        the same order as the interleaved path — both are
+        ``forward_paged`` at the slot's running position."""
+        tables = pool["block_tables"]
 
-    def _note_retire(admit_ts, req, ntok):
-        """One ``serve_request`` span per retired request (admission →
-        retirement: the request-latency record) + the token counter."""
-        if reg.enabled and req in admit_ts:
-            t0 = admit_ts.pop(req)
-            t1 = reg.clock()
-            reg.emit_span("serve_request", t0, t1, request=req,
-                          tokens=int(ntok))
-            reg.histogram("serve_request_ms").record((t1 - t0) * 1e3)
-            reg.counter("serve_generated_tokens").inc(int(ntok))
+        def body(i, carry):
+            row, pool = carry
+            sub = _sub1(pool, tables, slot, pool["pos"][slot])
+            logits, sub = forward_paged(p, chunks[:, i], sub, cfg,
+                                        prefill_impl="cached",
+                                        int8_kernel=False)
+            pool = _merge(pool, sub, tables, slot)
+            # keep only the FINAL live chunk's last-token logits
+            row = jnp.where(i == n - 1, logits[0, last_idx], row)
+            return row, pool
 
-    # one dispatch per speculative admission (compiled per prompt-length
-    # bucket): building the context row with eager .at[] ops cost ~7
-    # device round trips per request through the tunnelled backend.
-    # ``prefix`` is a closure constant here deliberately — it is a short
-    # token vector, not a weight tree.
+        row0 = jnp.zeros((cfg.vocab,), cfg.dtype)
+        row, pool = jax.lax.fori_loop(0, n, body, (row0, pool))
+        out = dict(pool)
+        out["pos"] = pool["pos"].at[slot].set(true_pos)
+        return pick(row[None, None], 0, key), out
+
+    @functools.partial(jax.jit, donate_argnums=(3,))
+    def _chunk_step(p, chunk, slot, pool):
+        """One ``[1, C]`` prefill chunk at the slot's current position —
+        the unit the engine interleaves with decode waves. Pad rows in
+        the final chunk land in the cache but are unreachable: cached
+        attention masks ``k_pos > q_pos`` and ``pos`` rewinds to the
+        true length at finish, so decode writes overwrite them in
+        order."""
+        tables = pool["block_tables"]
+        sub = _sub1(pool, tables, slot, pool["pos"][slot])
+        logits, sub = forward_paged(p, chunk, sub, cfg,
+                                    prefill_impl="cached",
+                                    int8_kernel=False)  # see _admit_full
+        return logits[0], _merge(pool, sub, tables, slot)
+
+    @functools.partial(jax.jit, donate_argnums=(4,))
+    def _chunk_finish(logits_c, last_idx, key, slot, pool, true_pos):
+        """Final-chunk epilogue: rewind ``pos`` past the pad rows and
+        pick the first token from the last TRUE position's logits."""
+        out = dict(pool)
+        out["pos"] = pool["pos"].at[slot].set(true_pos)
+        return pick(logits_c[None], last_idx, key), out
+
+    @functools.partial(jax.jit, donate_argnums=(3,))
+    def _prefix_fill(p, prefix_toks, row, pool):
+        """Prefill the shared prefix once per run into its own blocks
+        (no slot involved — the table row is passed directly)."""
+        sub = dict(pool, block_tables=row[None],
+                   pos=jnp.zeros((1,), jnp.int32))
+        impl = _prefix_impl
+        _logits, sub = forward_paged(p, prefix_toks, sub, cfg,
+                                     prefill_impl=impl,
+                                     int8_kernel=False)  # see _admit_full
+        out = dict(pool)
+        for key_ in pool_keys:
+            out[key_] = sub[key_]
+        return out
+
+    if prefix is not None:
+        from .decode import _select_prefill_impl
+
+        _prefix_impl = _select_prefill_impl(cfg, prefix_len, "auto")
+
+    # one dispatch per speculative admission: building the context row
+    # with eager .at[] ops cost ~7 device round trips per request
+    # through the tunnelled backend. ``prefix`` is a closure constant
+    # here deliberately — it is a short token vector, not a weight tree.
     @functools.partial(jax.jit, donate_argnums=(3, 4, 5))
     def _spec_admit_row(prompt, first, slot, ctxbuf, cur, n_out):
         length = prompt.shape[-1]
@@ -666,7 +557,310 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 cur.at[slot].set(prefix_len + length + 1),
                 n_out.at[slot].set(1))
 
-    def run_spec(prompts, n_new, slots, rules, eos_id):
+    # the all-slots steps are built per int8-kernel flag on first use: a
+    # mesh-sharded int8 pool must keep the jnp attention path (pallas on
+    # sharded operands — see make_serve_step), and only run() sees rules
+    _steps: dict[tuple, Any] = {}
+
+    def step_for(kind: str, int8_kernel: bool, rules):
+        # ONE cached step per (kind, kernel-flag): a different rules
+        # object rebuilds that slot (recompile) rather than growing a
+        # keyed-by-id cache without bound — callers alternating rules
+        # objects pay compiles, never leak them. The entry keeps the
+        # rules reference so its id stays valid while cached.
+        key_ = (kind, int8_kernel)
+        rid = None if rules is None else id(rules)
+        ent = _steps.get(key_)
+        if ent is None or ent[0] != rid:
+            if kind == "spec":
+                step = make_spec_step(params, cfg, spec_k,
+                                      int8_kernel=int8_kernel,
+                                      rules=rules)
+            else:
+                step = make_serve_step(params, cfg, sampler,
+                                       int8_kernel=int8_kernel,
+                                       rules=rules)
+            _steps[key_] = (rid, step, rules)
+        return _steps[key_][1]
+
+    # ------------------------------------------------------ admission
+
+    def _check_chunk_bound(length: int) -> int:
+        n = -(-length // prefill_chunk)
+        if prefix_len + n * prefill_chunk > max_len:
+            # the padded tail would index past the table, where the
+            # clipped block lookup would silently overwrite the last
+            # cache rows — refuse loudly instead
+            raise ValueError(
+                f"chunked prefill pads the prompt ({length}) to "
+                f"{n * prefill_chunk} rows, which after the prefix "
+                f"({prefix_len}) exceeds max_len ({max_len}) — raise "
+                f"max_len to >= {prefix_len + n * prefill_chunk} or "
+                f"shrink prefill_chunk")
+        return n
+
+    def _rows_needed(length: int, n_new_i: int, headroom: int) -> int:
+        rows = prefix_len + length + n_new_i + headroom
+        if prefill_chunk is not None:
+            padded = prefix_len + _check_chunk_bound(length) * prefill_chunk
+            rows = max(rows, padded)
+        return min(rows, geom["rows"])
+
+    class _Run:
+        """Per-run scheduler state: the paged pool + allocator + the
+        host-side request bookkeeping (one instance per ``run`` call —
+        the compiled pieces above are engine-lifetime)."""
+
+        def __init__(self, slots, rules, kv_blocks, headroom,
+                     n_new_of, prompts):
+            from .paging import init_paged_cache
+
+            self.slots = slots
+            self.headroom = headroom
+            self.n_new_of = n_new_of
+            need_prefix = (prefix_full_blocks
+                           + (1 if prefix_tail_rows else 0))
+            if kv_blocks is None:
+                kv_blocks = 1 + need_prefix + slots * nt
+            worst = max(
+                blocks_for_rows(
+                    _rows_needed(int(p.shape[-1]), n_new_of[i], headroom)
+                    - prefix_full_blocks * bs, bs)
+                for i, p in enumerate(prompts))
+            if kv_blocks < 1 + need_prefix + worst:
+                raise ValueError(
+                    f"kv_blocks ({kv_blocks}) cannot hold the largest "
+                    f"request ({worst} blocks of {bs} rows"
+                    + (f" + {need_prefix} prefix blocks" if need_prefix
+                       else "")
+                    + " + the reserved garbage block) — the queue would "
+                    "deadlock; raise kv_blocks")
+            self.kv_blocks = kv_blocks
+            self.alloc = BlockAllocator(kv_blocks)
+            self.pool = init_paged_cache(
+                cfg, slots, max_len, block_size=bs, num_blocks=kv_blocks,
+                rules=rules, cache_dtype=cache_dtype)
+            self.owned: dict[int, list[int]] = {}     # req → blocks
+            self.prefix_blocks: list[int] = []
+            self.tail_src = 0
+            self.in_use_sum = 0                       # per-wave samples
+            self.in_use_n = 0
+            if prefix is not None:
+                blocks = self.alloc.alloc(need_prefix)
+                assert blocks is not None            # sized above
+                self.prefix_blocks = blocks
+                row = np.zeros((nt,), np.int32)
+                row[:need_prefix] = blocks
+                if prefix_tail_rows:
+                    self.tail_src = blocks[-1]
+                self.pool = _prefix_fill(prefill_params, prefix[None, :],
+                                         jnp.asarray(row), self.pool)
+
+        def admit_blocks(self, req: int, length: int):
+            """Allocate the request's blocks; None = hold in queue."""
+            rows = _rows_needed(length, self.n_new_of[req], self.headroom)
+            own_rows = rows - prefix_full_blocks * bs
+            blocks = self.alloc.alloc(blocks_for_rows(own_rows, bs))
+            if blocks is None:
+                return None
+            self.owned[req] = blocks
+            row = np.zeros((nt,), np.int32)
+            shared = self.prefix_blocks[:prefix_full_blocks]
+            row[:prefix_full_blocks] = shared
+            row[prefix_full_blocks:prefix_full_blocks + len(blocks)] = \
+                blocks
+            tail = jnp.asarray(
+                [self.tail_src, blocks[0] if blocks else 0], jnp.int32)
+            return jnp.asarray(row), tail
+
+        def retire_blocks(self, req: int) -> None:
+            self.alloc.free(self.owned.pop(req))
+
+        def sample(self) -> None:
+            """One per-wave occupancy sample (host ints — runs whether
+            or not telemetry is on; feeds the mean-utilisation stat)."""
+            self.in_use_sum += self.alloc.in_use
+            self.in_use_n += 1
+
+        def kv_stats(self) -> dict:
+            s = self.alloc.stats()
+            dense = self.slots * geom["rows"]
+            mean_blocks = (self.in_use_sum / self.in_use_n
+                           if self.in_use_n else 0.0)
+            return {
+                **s,
+                "block_size": bs,
+                "peak_rows": s["high_water"] * bs,
+                # what the dense [slots, max_len] pool would have
+                # RESERVED for the same schedule — the paging win
+                "dense_rows": dense,
+                # peak: the pool the engine actually NEEDED; mean: the
+                # live rows over the schedule (ragged retirement keeps
+                # it well under the peak)
+                "utilisation": round(s["high_water"] * bs
+                                     / max(dense, 1), 4),
+                "mean_utilisation": round(mean_blocks * bs
+                                          / max(dense, 1), 4),
+            }
+
+    # -------------------------------------------------------- telemetry
+
+    if reg.enabled:
+        # handles resolved once (a per-wave gauge() call would pay a
+        # lock + dict lookup three times per wave for nothing)
+        _g_queue = reg.gauge("serve_queue_depth")
+        _g_occ = reg.gauge("serve_slot_occupancy")
+        _g_kv = reg.gauge("kv_blocks_in_use")
+
+    def _gauges(rstate: _Run, waiting: int, busy: int):
+        if reg.enabled:
+            _g_queue.set(waiting)
+            _g_occ.set(busy / rstate.slots)
+            _g_kv.set(rstate.alloc.in_use)
+
+    def _note_admit(meta, req, wait_s):
+        # every telemetry timestamp below comes from the REGISTRY clock
+        # (never mixed with time.monotonic durations): an injected
+        # simulated clock must yield spans in its own domain, or the
+        # merged Chrome-trace timeline garbles. The host-stats latency
+        # list stays monotonic-based, separately.
+        m = {"admit": time.monotonic(),
+             "queue_wait_ms": round(wait_s * 1e3, 3), "prefill_ms": 0.0}
+        if reg.enabled:
+            m["admit_clk"] = reg.clock()
+            reg.counter("serve_admissions").inc()
+        meta[req] = m
+
+    def _note_prefill(meta, req, start_clk, prompt_len, chunks=None):
+        """``start_clk`` is ``reg.clock()`` captured before the
+        admission dispatch (None when telemetry is disabled)."""
+        if reg.enabled:
+            t1 = reg.clock()
+            meta[req]["prefill_ms"] += round((t1 - start_clk) * 1e3, 3)
+            args = {"prompt_len": prompt_len}
+            if chunks is not None:
+                args["chunks"] = chunks
+            reg.emit_span("serve_prefill", start_clk, t1, **args)
+
+    def _clk():
+        return reg.clock() if reg.enabled else None
+
+    def _note_retire(meta, latencies, req, ntok, decode_steps):
+        """One ``serve_request`` span per retired request (admission →
+        retirement: the request-latency record) + the token counter."""
+        m = meta.pop(req, None)
+        if m is None:
+            return
+        latencies.append((time.monotonic() - m["admit"]) * 1e3)
+        if reg.enabled:
+            t1 = reg.clock()
+            t0 = m.get("admit_clk", t1)
+            reg.emit_span("serve_request", t0, t1, request=req,
+                          tokens=int(ntok),
+                          queue_wait_ms=m["queue_wait_ms"],
+                          prefill_ms=round(m["prefill_ms"], 3),
+                          decode_steps=int(decode_steps))
+            reg.histogram("serve_request_ms").record((t1 - t0) * 1e3)
+            reg.counter("serve_generated_tokens").inc(int(ntok))
+
+    # ------------------------------------------------------------- run
+
+    def _admit_one(rstate: _Run, slot: int, req: int, prompt, key,
+                   meta, wait_s):
+        """Full (non-chunked) admission: one compiled dispatch."""
+        from .decode import _select_prefill_impl
+
+        length = int(prompt.shape[-1])
+        got = rstate.admit_blocks(req, length)
+        if got is None:
+            return None
+        row, tail = got
+        impl = ("cached" if prefix is not None else
+                _select_prefill_impl(cfg, length, "auto"))
+        _note_admit(meta, req, wait_s)
+        if key is None:
+            key = jnp.zeros((2,), jnp.uint32)
+        t0c = _clk()
+        first, rstate.pool = _admit_full(
+            prefill_params, prompt[None, :], impl, jnp.int32(slot), row,
+            key, tail, rstate.pool)
+        _note_prefill(meta, req, t0c, length)
+        return first
+
+    def _chunk_split(prompt, length: int):
+        """Pad-to-C chunking shared by the sync (spec) and interleaved
+        (plain) admission paths: the chunk list, the true last token's
+        offset within the final chunk, and the post-rewind position —
+        ONE definition of the finish arithmetic, so the two paths can
+        never disagree on which logit picks the first token."""
+        c = prefill_chunk
+        nc = _check_chunk_bound(length)
+        padded = jnp.zeros((nc * c,), jnp.int32).at[:length].set(prompt)
+        chunks = [padded[i * c:(i + 1) * c][None] for i in range(nc)]
+        return (chunks, jnp.int32(length - 1 - (nc - 1) * c),
+                jnp.int32(prefix_len + length))
+
+    def _admit_chunked_sync(rstate: _Run, slot: int, req: int, prompt,
+                            key, meta, wait_s):
+        """Chunked admission WITHOUT interleaving, as ONE compiled
+        dispatch (``_chunk_sweep``): keeps chunked admission's memory
+        ceiling (``[C, S_max]`` scores) and one-compile-per-engine
+        property without paying a host dispatch per chunk."""
+        length = int(prompt.shape[-1])
+        got = rstate.admit_blocks(req, length)
+        if got is None:
+            return None
+        row, tail = got
+        _note_admit(meta, req, wait_s)
+        t0c = _clk()
+        rstate.pool = _admit_table(jnp.int32(slot), row, tail,
+                                   rstate.pool)
+        chunks, last_idx, true_pos = _chunk_split(prompt, length)
+        c = prefill_chunk
+        # ONE [1, MC, C] buffer per admission (static shape → one
+        # compile per engine); trailing dead chunks never execute
+        mc = max(1, (max_len - prefix_len) // c)
+        buf = jnp.zeros((1, mc, c), jnp.int32)
+        buf = buf.at[0, :len(chunks)].set(
+            jnp.concatenate(chunks, axis=0))
+        if key is None:
+            key = jnp.zeros((2,), jnp.uint32)
+        first, rstate.pool = _chunk_sweep(
+            prefill_params, buf, jnp.int32(len(chunks)), last_idx,
+            rstate.pool, jnp.int32(slot), key, true_pos)
+        _note_prefill(meta, req, t0c, length, chunks=len(chunks))
+        return first
+
+    def _arrived(arrivals, t0, req) -> bool:
+        return arrivals is None or \
+            arrivals[req] <= time.monotonic() - t0
+
+    def _queue_wait(arrivals, t0, req) -> float:
+        """Queue wait vs the request's arrival (t0 when no trace): a
+        request held for slots or KV blocks reports its real wait,
+        never a hardwired zero. One definition for both loops so the
+        spec and plain engines cannot diverge on wait accounting."""
+        return max(0.0, time.monotonic() - t0
+                   - (arrivals[req] if arrivals is not None else 0.0))
+
+    def _waiting(queue, arrivals, t0) -> int:
+        """Arrived-but-unadmitted count, one clock read per wave — a
+        per-request time.monotonic() in the hot wave loop would pay
+        O(queue) syscalls for a gauge."""
+        if arrivals is None:
+            return len(queue)
+        now = time.monotonic() - t0
+        return sum(1 for r, _ in queue if arrivals[r] <= now)
+
+    def _sleep_until_arrival(arrivals, queue, t0):
+        """Nothing to compute and the head request hasn't arrived:
+        sleep the gap instead of spinning."""
+        wait = arrivals[queue[0][0]] - (time.monotonic() - t0)
+        if wait > 0:
+            time.sleep(wait)
+
+    def run_spec(prompts, n_new_of, slots, rules, eos_id, arrivals,
+                 kv_blocks):
         """Speculative schedule: same admission/retire bookkeeping as
         the plain loop, but outputs live in a device-side context
         buffer (the draft source) and each step can emit up to
@@ -675,10 +869,9 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         on device until enough slots finish (one, when requests are
         queued and a slot should recycle promptly; all active, when
         the queue is empty and nothing is waiting to admit)."""
-        # reset on entry: a failed run must not leave a prior run's
-        # stats for an error-catching caller to misattribute
-        run.last_stats = None
-        stacked = _stacked_cache(cfg, slots, max_len, rules, cache_dtype)
+        rstate = _Run(slots, rules, kv_blocks, spec_k, n_new_of, prompts)
+        spec_step = step_for("spec", cache_dtype != "int8"
+                             or rules is None, rules)
         # + k + 1 slack: the verification window is sliced at cur even
         # when a request is one token from done
         ctxbuf = jnp.zeros((slots, max_len + spec_k + 1), jnp.int32)
@@ -688,24 +881,35 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         active: dict[int, int] = {}
         start_of: dict[int, int] = {}            # req → first output idx
         out: dict[int, Any] = {}
-        admit_ts: dict[int, float] = {}
+        meta: dict[int, dict] = {}
+        latencies: list[float] = []
+        req_steps: dict[int, int] = {}           # req → its slot-steps
         slot_steps = 0
+        host_waves = 0                 # retirement waves (host syncs)
         generated = 0
         admitted = 0                   # prefill-emitted (non-step) tokens
-        # loop-invariant scalars hoisted: re-creating them per wave would
-        # ship two h2d constants per retirement wave for nothing
-        n_new_dev = jnp.int32(n_new)
         eos_dev = jnp.int32(-1 if eos_id is None else eos_id)
+        t0 = time.monotonic()
+
+        def arrived(req):
+            return _arrived(arrivals, t0, req)
 
         while queue or active:
             for slot in range(slots):
                 if slot in active or not queue:
                     continue
-                req, prompt = queue.popleft()
+                req, prompt = queue[0]
+                if not arrived(req):
+                    break
                 prompt = jnp.asarray(prompt)
-                _note_admit(admit_ts, req)
-                first, row_cache = admit(prompt, None)
-                stacked = _insert_row(row_cache, stacked, slot)
+                wait_s = _queue_wait(arrivals, t0, req)
+                admit = (_admit_chunked_sync if prefill_chunk is not None
+                         else _admit_one)
+                first = admit(rstate, slot, req, prompt, None,
+                              meta, wait_s)
+                if first is None:
+                    break                        # blocks exhausted: hold
+                queue.popleft()
                 length = int(prompt.shape[-1])
                 start_of[req] = prefix_len + length
                 ctxbuf, cur, n_out = _spec_admit_row(
@@ -713,40 +917,62 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 generated += 1
                 admitted += 1
                 # the prefill token may already satisfy the request
-                if n_new == 1 or (eos_id is not None
-                                  and int(first) == eos_id):
+                if n_new_of[req] == 1 or (eos_id is not None
+                                          and int(first) == eos_id):
                     out[req] = first[None]
-                    _note_retire(admit_ts, req, 1)
+                    rstate.retire_blocks(req)
+                    _note_retire(meta, latencies, req, 1, 0)
                     continue
                 active[slot] = req
+            waiting = _waiting(queue, arrivals, t0)
+            rstate.sample()
+            _gauges(rstate, waiting, len(active))
             if not active:
+                if queue:
+                    if arrivals is not None and not arrived(queue[0][0]):
+                        _sleep_until_arrival(arrivals, queue, t0)
+                    # else: blocks exhausted with nothing active cannot
+                    # happen — capacity for the largest single request
+                    # is validated up front
                 continue
             active_mask = jnp.asarray(
                 [s in active for s in range(slots)])
+            n_new_dev = jnp.asarray(
+                [n_new_of[active[s]] if s in active else 0
+                 for s in range(slots)], jnp.int32)
             # wave size follows the admission backlog: with a deep queue
             # the next admissions arrive as a batch anyway, so drain as
             # many slots as there are requests waiting (one sync per
             # admission WAVE); a single queued request still gets the
             # first free slot (stop=1), and an empty queue runs every
             # active slot to completion — nothing is waiting to admit
-            stop = (min(len(active), max(1, len(queue)))
+            stop = (min(len(active), max(1, waiting))
                     if queue else len(active))
-            ctxbuf, cur, n_out, fin, steps_inc, stacked = spec_step(
+            ctxbuf, cur, n_out, fin, steps_inc, rstate.pool = spec_step(
                 ctxbuf, cur, n_out, n_new_dev, eos_dev,
-                active_mask, jnp.int32(stop), stacked)
+                active_mask, jnp.int32(stop), rstate.pool)
             # one batched transfer: separate device_gets would pay the
             # host round trip repeatedly in the per-wave hot loop
             fin_h, n_out_h, steps_h = jax.device_get(
                 (fin, n_out, steps_inc))
-            slot_steps += int(steps_h)
+            slot_steps += int(steps_h.sum())
+            host_waves += 1
+            # per-slot step counts attribute to the request holding the
+            # slot — each retirement's decode_steps is ITS verification
+            # steps, not the engine-wide counter
+            for slot, req in active.items():
+                req_steps[req] = req_steps.get(req, 0) + int(steps_h[slot])
             for slot, req in list(active.items()):
                 if bool(fin_h[slot]):
                     n = int(n_out_h[slot])
                     start = start_of[req]
                     out[req] = ctxbuf[slot, start:start + n]
                     generated += n - 1           # first counted at admit
-                    _note_retire(admit_ts, req, n)
+                    rstate.retire_blocks(req)
+                    _note_retire(meta, latencies, req, n,
+                                 req_steps.get(req, 0))
                     del active[slot]
+        _gauges(rstate, 0, 0)
         if reg.enabled:
             # each verification slot-step emits exactly one model token
             # plus its accepted drafts, so the drafts the speculation
@@ -758,20 +984,56 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         # accepted_per_step excludes admission tokens: it is tokens per
         # VERIFICATION slot-step, so zero draft acceptance reads exactly
         # 1.0 (the plain engine's rate), never above it
-        run.last_stats = {
+        # waves = host retirement waves (the sync count), matching the
+        # plain loop's semantics; verification work is slot_steps
+        run.last_stats = _stats(len(prompts), generated, host_waves,
+                                latencies, rstate)
+        run.last_stats.update({
             "slot_steps": slot_steps,
-            "generated": generated,
             "accepted_per_step": (round((generated - admitted)
                                         / slot_steps, 3)
                                   if slot_steps else None),
-        }
+        })
         return [out[i] for i in range(len(prompts))]
 
-    def run(prompts: Sequence[Any], n_new: int, *, slots: int = 4,
+    def _stats(n_req, generated, waves, latencies, rstate):
+        lat = sorted(latencies)
+
+        def q(p):
+            return (round(lat[min(len(lat) - 1,
+                                  int(p * len(lat)))], 3)
+                    if lat else None)
+
+        return {
+            "requests": n_req,
+            "generated": generated,
+            "waves": waves,
+            "latency_ms": {"p50": q(0.5), "p99": q(0.99),
+                           "max": round(lat[-1], 3) if lat else None},
+            "kv": rstate.kv_stats(),
+        }
+
+    def run(prompts: Sequence[Any], n_new, *, slots: int = 4,
             rules: ShardingRules | None = None,
             eos_id: int | None = None, rng=None,
-            eos_check_every: int = 1) -> list[Any]:
+            eos_check_every: int = 1, arrivals=None,
+            kv_blocks: int | None = None,
+            static_batching: bool = False) -> list[Any]:
+        # reset on entry: a failed run must not leave a prior run's
+        # stats for an error-catching caller to misattribute
+        run.last_stats = None
         if not prompts:
+            # same stats schema as every other path — a caller reading
+            # last_stats["kv"]["utilisation"] after any run must never
+            # KeyError on the degenerate schedule
+            run.last_stats = {
+                "requests": 0, "generated": 0, "waves": 0,
+                "latency_ms": {"p50": None, "p99": None, "max": None},
+                "kv": {"num_blocks": 0, "reserved": 0, "in_use": 0,
+                       "free": 0, "high_water": 0, "block_size": bs,
+                       "peak_rows": 0, "dense_rows": 0,
+                       "utilisation": 0.0, "mean_utilisation": 0.0},
+            }
             return []
         if eos_check_every < 1:
             raise ValueError(
@@ -786,25 +1048,40 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 "once per retirement wave already")
         if sampler is not None and rng is None:
             raise ValueError("a sampled engine needs rng (a PRNG key)")
-        if n_new < 1:
-            raise ValueError(f"n_new must be >= 1, got {n_new}")
+        if isinstance(n_new, int):
+            n_new_of = [n_new] * len(prompts)
+        else:
+            n_new_of = [int(n) for n in n_new]
+            if len(n_new_of) != len(prompts):
+                raise ValueError(
+                    f"per-request n_new has {len(n_new_of)} entries for "
+                    f"{len(prompts)} prompts")
+        for n in n_new_of:
+            if n < 1:
+                raise ValueError(f"n_new must be >= 1, got {n}")
+        if arrivals is not None:
+            arrivals = [float(a) for a in arrivals]
+            if len(arrivals) != len(prompts):
+                raise ValueError(
+                    f"arrivals has {len(arrivals)} entries for "
+                    f"{len(prompts)} prompts")
 
         def key_for(req: int, idx: int):
-            # keyed to (request, position): the schedule — slot count,
-            # admission order, neighbours — can never change a token
-            return jax.random.fold_in(jax.random.fold_in(rng, req), idx)
+            # keyed to (request, position) via the one shared contract:
+            # the schedule — slot count, admission order, neighbours —
+            # can never change a token
+            return _request_key(rng, req, idx)
         headroom = 0 if spec_k is None else spec_k
-        for p in prompts:
+        for i, p in enumerate(prompts):
             if int(p.shape[-1]) < 1:
                 # a zero-length prompt has no last token to continue
-                # from — refuse loudly (the chunked sweep would
-                # otherwise run zero chunks and emit plausible-looking
-                # garbage from the zero-initialised logits row)
+                # from — refuse loudly
                 raise ValueError("prompts must have at least one token")
-            if prefix_len + int(p.shape[-1]) + n_new + headroom > max_len:
+            if prefix_len + int(p.shape[-1]) + n_new_of[i] + headroom \
+                    > max_len:
                 raise ValueError(
                     f"prefix ({prefix_len}) + prompt "
-                    f"({int(p.shape[-1])}) + n_new ({n_new})"
+                    f"({int(p.shape[-1])}) + n_new ({n_new_of[i]})"
                     + (f" + spec_k ({spec_k}) verification headroom"
                        if headroom else "")
                     + f" exceeds max_len ({max_len})")
@@ -815,13 +1092,28 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 _check_chunk_bound(int(p.shape[-1]))
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        if rules is not None:
+            data_shards = 1
+            for a in rules.data:
+                data_shards *= rules.mesh.shape.get(a, 1)
+            if slots % data_shards:
+                # the wave batch IS the data-parallel dim at serve time
+                raise ValueError(
+                    f"slots ({slots}) must divide over the data axes "
+                    f"({data_shards} shards) — pad the pool")
+        if static_batching and spec_k is not None:
+            raise ValueError(
+                "static_batching is the plain loop's run-to-completion "
+                "A/B baseline — drop spec_k to use it")
         if spec_k is not None:
-            return run_spec(prompts, n_new, slots, rules, eos_id)
+            return run_spec(prompts, n_new_of, slots, rules, eos_id,
+                            arrivals, kv_blocks)
 
         # the pallas int8-pool attention only when the pool is
         # UNSHARDED; a mesh pool keeps the jnp path (see make_serve_step)
-        step = step_for(cache_dtype != "int8" or rules is None)
-        stacked = _stacked_cache(cfg, slots, max_len, rules, cache_dtype)
+        step = step_for("plain", cache_dtype != "int8" or rules is None,
+                        rules)
+        rstate = _Run(slots, rules, kv_blocks, 0, n_new_of, prompts)
         tokens = jnp.zeros((slots,), jnp.int32)
         queue = deque(enumerate(prompts))
         active: dict[int, int] = {}              # slot → request index
@@ -829,60 +1121,145 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         span: dict[int, tuple] = {}              # req → (slot, start wave)
         count: dict[int, int] = {}               # req → tokens so far
         done_at: dict[int, int] = {}             # req → final token count
-        admit_ts: dict[int, float] = {}
+        meta: dict[int, dict] = {}
+        latencies: list[float] = []
+        # chunked-prefill interleaving state: slot → in-flight admission
+        filling: dict[int, dict] = {}
+        mask_key: list = [None, None]    # active-set key → device mask
         hist: list = []          # one [slots] token vector per step wave
+        t0 = time.monotonic()
+
+        def arrived(req):
+            return _arrived(arrivals, t0, req)
+
+        def activate(slot, req, first):
+            """First-token bookkeeping shared by both admission paths."""
+            nonlocal tokens
+            tokens = tokens.at[slot].set(first)
+            firsts[req] = first
+            span[req] = (slot, len(hist))
+            count[req] = 1
+            # a request the prefill token already satisfied must retire
+            # BEFORE any step, or it collects an extra token
+            if n_new_of[req] == 1 or (eos_id is not None
+                                      and eos_check_every == 1
+                                      and int(first) == eos_id):
+                done_at[req] = 1
+                rstate.retire_blocks(req)
+                _note_retire(meta, latencies, req, 1, 0)
+                return
+            active[slot] = req
 
         # Host bookkeeping is integer-only: the loop keeps whole [slots]
         # token vectors per wave and assembles outputs AFTER the
-        # schedule in O(requests) device ops. Per-slot host slicing
-        # inside the wave loop (the previous design) cost ~active
-        # dispatches per step — observed to dominate serve wall-clock
-        # through the tunnelled backend's per-op latency. Without
-        # eos_id the schedule is fully async end to end; eos makes
-        # lengths variable and costs a readback — by default ONE
-        # [slots] vector per wave, but a readback that must wait on
-        # freshly dispatched work pays the backend's full pipeline-
-        # flush RTT (~65 ms through the tunnelled chip vs ~0.02 ms for
-        # a resident value), so ``eos_check_every=W`` batches the
-        # check: one [W, slots] readback per W waves. Retirement then
-        # LAGS an eos by up to W-1 waves (the slot computes ignored
-        # tokens before recycling — bubble, never wrongness: outputs
-        # are truncated at the first eos either way), trading a bounded
-        # bubble for 1/W of the flushes. The first-token eos check
-        # rides the same schedule: eager (one host int per admission)
-        # at W=1, caught by the periodic scan/assembly truncation at
-        # W>1.
+        # schedule in O(requests) device ops. Without eos_id the
+        # schedule is fully async end to end; eos makes lengths variable
+        # and costs a readback — by default ONE [slots] vector per wave,
+        # but a readback that must wait on freshly dispatched work pays
+        # the backend's full pipeline-flush RTT (~65 ms through the
+        # tunnelled chip vs ~0.02 ms for a resident value), so
+        # ``eos_check_every=W`` batches the check: one [W, slots]
+        # readback per W waves. Retirement then LAGS an eos by up to W-1
+        # waves (the slot computes ignored tokens before recycling —
+        # bubble, never wrongness: outputs are truncated at the first
+        # eos either way), trading a bounded bubble for 1/W of the
+        # flushes. The first-token eos check rides the same schedule:
+        # eager (one host int per admission) at W=1, caught by the
+        # periodic scan/assembly truncation at W>1.
         eos_pending = 0                  # waves since the last eos scan
-        while queue or active:
-            # admission: every free slot takes the next queued request
+        while queue or active or filling:
+            # admission: every free slot takes the next ARRIVED queued
+            # request whose block grant fits; FIFO — the head blocks
+            # (fairness over utilisation; document, don't starve).
+            # ``static_batching`` is the RUN-TO-COMPLETION A/B baseline
+            # (bench.py section_serve_engine): admission only when the
+            # engine is fully idle, so early finishers idle until the
+            # whole resident batch drains — identical compiled steps
+            # and dispatch pattern, different SCHEDULER, which is
+            # exactly the variable the comparison isolates
+            admit_ok = not static_batching or (not active and not filling)
             for slot in range(slots):
-                if slot in active or not queue:
+                if not admit_ok or slot in active or slot in filling \
+                        or not queue:
                     continue
-                req, prompt = queue.popleft()
-                _note_admit(admit_ts, req)
-                first, row_cache = admit(
-                    jnp.asarray(prompt),
-                    key_for(req, 0) if sampler is not None else None)
-                stacked = _insert_row(row_cache, stacked, slot)
-                tokens = tokens.at[slot].set(first)
-                firsts[req] = first
-                span[req] = (slot, len(hist))
-                count[req] = 1
-                # a request the prefill token already satisfied must
-                # retire BEFORE any step, or it collects an extra token
-                if n_new == 1 or (eos_id is not None
-                                  and eos_check_every == 1
-                                  and int(first) == eos_id):
-                    done_at[req] = 1
-                    _note_retire(admit_ts, req, 1)
-                    continue
-                active[slot] = req
+                req, prompt = queue[0]
+                if not arrived(req):
+                    break
+                prompt = jnp.asarray(prompt)
+                key = key_for(req, 0) if sampler is not None else None
+                wait_s = _queue_wait(arrivals, t0, req)
+                if prefill_chunk is None:
+                    first = _admit_one(rstate, slot, req, prompt, key,
+                                       meta, wait_s)
+                    if first is None:
+                        break                    # blocks exhausted: hold
+                    queue.popleft()
+                    activate(slot, req, first)
+                else:
+                    length = int(prompt.shape[-1])
+                    got = rstate.admit_blocks(req, length)
+                    if got is None:
+                        break
+                    row, tail = got
+                    queue.popleft()
+                    _note_admit(meta, req, wait_s)
+                    rstate.pool = _admit_table(jnp.int32(slot), row,
+                                               tail, rstate.pool)
+                    chunks, last_idx, true_pos = _chunk_split(prompt,
+                                                              length)
+                    filling[slot] = {
+                        "req": req, "key": key, "len": length,
+                        "chunks": chunks, "last_idx": last_idx,
+                        "true_pos": true_pos,
+                        # span start: the prefill span of an INTERLEAVED
+                        # admission covers the decode waves riding
+                        # between its chunks (the host's honest view)
+                        "next": 0, "clk0": _clk(),
+                    }
+            # chunked-prefill/decode interleaving: ONE chunk per filling
+            # slot per wave — active slots keep decoding in between, so
+            # a long prompt's admission no longer stalls the batch
+            for slot in list(filling):
+                f = filling[slot]
+                logits_c, rstate.pool = _chunk_step(
+                    prefill_params, f["chunks"][f["next"]],
+                    jnp.int32(slot), rstate.pool)
+                f["next"] += 1
+                if f["next"] == len(f["chunks"]):
+                    key = f["key"]
+                    if key is None:
+                        key = jnp.zeros((2,), jnp.uint32)
+                    first, rstate.pool = _chunk_finish(
+                        logits_c, f["last_idx"], key, jnp.int32(slot),
+                        rstate.pool, f["true_pos"])
+                    req = f["req"]
+                    del filling[slot]
+                    _note_prefill(meta, req, f["clk0"], f["len"],
+                                  chunks=f["next"])
+                    activate(slot, req, first)
+            waiting = _waiting(queue, arrivals, t0)
+            rstate.sample()
+            _gauges(rstate, waiting, len(active) + len(filling))
             if not active:
+                if not filling and queue and arrivals is not None \
+                        and not arrived(queue[0][0]):
+                    _sleep_until_arrival(arrivals, queue, t0)
                 continue
             # one compiled step advances every slot (idle slots compute
-            # too — the static-shape bubble; their tokens are never read)
+            # too — the static-shape bubble; their writes are fenced to
+            # the garbage block and their tokens are never read). The
+            # mask array is rebuilt only when membership changes —
+            # re-shipping an identical h2d constant every wave of a
+            # long fixed-budget stretch buys nothing
+            key_ = tuple(sorted(active))
+            if key_ != mask_key[0]:
+                mask_key[0] = key_
+                mask_key[1] = jnp.asarray(
+                    [s in active for s in range(slots)])
+            active_mask = mask_key[1]
             if sampler is None:
-                tokens, stacked = step(tokens, stacked)
+                tokens, rstate.pool = step(tokens, active_mask,
+                                           rstate.pool)
             else:
                 # idle slots get a dead (request-id == len(prompts)) key
                 # — valid to derive, never read
@@ -892,13 +1269,16 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 poss = jnp.asarray(
                     [count[active[s]] if s in active else 0
                      for s in range(slots)], jnp.int32)
-                tokens, stacked = step(tokens, reqs, poss, rng, stacked)
+                tokens, rstate.pool = step(tokens, active_mask, reqs,
+                                           poss, rng, rstate.pool)
             hist.append(tokens)
             for slot, req in list(active.items()):
                 count[req] += 1
-                if count[req] >= n_new:
+                if count[req] >= n_new_of[req]:
                     done_at[req] = count[req]
-                    _note_retire(admit_ts, req, count[req])
+                    rstate.retire_blocks(req)
+                    _note_retire(meta, latencies, req, count[req],
+                                 count[req] - 1)
                     del active[slot]             # slot recycles next wave
             if eos_id is not None:
                 eos_pending += 1
@@ -908,7 +1288,9 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                     for slot, req in list(active.items()):
                         if int(tok_h[slot]) == eos_id:
                             done_at[req] = count[req]
-                            _note_retire(admit_ts, req, count[req])
+                            rstate.retire_blocks(req)
+                            _note_retire(meta, latencies, req,
+                                         count[req], count[req] - 1)
                             del active[slot]
                 elif eos_pending >= eos_check_every:
                     # one flush per W waves: scan the batched window for
@@ -925,9 +1307,13 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                             h = base + j
                             if h >= sw and int(block[j, slot]) == eos_id:
                                 done_at[req] = h - sw + 2
-                                _note_retire(admit_ts, req, done_at[req])
+                                rstate.retire_blocks(req)
+                                _note_retire(meta, latencies, req,
+                                             done_at[req],
+                                             done_at[req] - 1)
                                 del active[slot]
                                 break
+        _gauges(rstate, 0, 0)
 
         waves = jnp.stack(hist) if hist else None      # [W, slots]
         outs = []
@@ -952,42 +1338,55 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                           if t == eos_id), len(toks))
                 cut.append(o[:n])
             outs = cut
+        # generated counts EMITTED tokens (post-truncation output
+        # lengths): under lagged eos checks a count-cap retirement can
+        # precede the scan that would have seen an earlier eos, and
+        # done_at would overcount the discarded tail. The per-request
+        # telemetry spans, emitted live at retirement, record the
+        # SCHEDULED token count in that case — the same bounded bubble
+        # the eos_check_every docs describe.
+        run.last_stats = _stats(
+            len(prompts), sum(int(o.shape[0]) for o in outs), len(hist),
+            latencies, rstate)
         return outs
 
-    run.last_stats = None          # set by speculative runs
+    run.last_stats = None
     return run
 
 
-def serve(params, prompts: Sequence[Any], n_new: int, cfg: BurnInConfig,
+def serve(params, prompts: Sequence[Any], n_new, cfg: BurnInConfig,
           *, slots: int = 4, max_len: int | None = None,
           rules: ShardingRules | None = None,
           cache_dtype: str = "bf16",
           eos_id: int | None = None,
           eos_check_every: int = 1,
           prefill_chunk: int | None = None,
-          spec_k: int | None = None) -> list[Any]:
+          spec_k: int | None = None,
+          kv_block: int = 16,
+          kv_blocks: int | None = None,
+          arrivals=None,
+          static_batching: bool = False) -> list[Any]:
     """Serve ``prompts`` (each ``[L_i]``) with continuous batching.
 
-    Returns one ``[n_new]`` token array per prompt, in request order.
-    ``slots`` bounds device-resident concurrency; requests beyond it
-    queue and take over slots as earlier requests finish — the recycling
-    that distinguishes this loop from a static batch. With ``rules`` the
-    pool itself shards: slots over the data axes (requests ARE the data
-    parallelism at serve time), KV heads and the weight matmuls over
-    ``tp`` — the engine runs on the same mesh the train step used, and
-    ``slots`` must divide the data-axis shard count. ``prefill_chunk``
-    admits through the single-compile chunked prefill; ``spec_k`` serves
-    through speculative continuous batching (see
-    :func:`make_serve_engine`).
+    Returns one token array per prompt, in request order (``[n_new]``
+    each, shorter when ``eos_id`` fires; ``n_new`` may be a per-request
+    sequence). ``slots`` bounds device-resident concurrency; requests
+    beyond it queue and take over slots as earlier requests finish. The
+    KV cache is PAGED: ``kv_block``-row blocks allocated per admission
+    and recycled at retirement (``models/paging.py``); ``kv_blocks``
+    caps the physical pool (default: full provisioning), turning KV HBM
+    pressure into queueing instead of an OOM. ``arrivals`` (seconds,
+    per request — e.g. a ``utils/traffic.py`` trace) gates admission so
+    the engine serves a load model. With ``rules`` the pool shards KV
+    heads over ``tp`` and the engine runs on the training mesh;
+    ``slots`` must divide the data-axis shard count.
 
     ``eos_check_every=W`` batches eos retirement readbacks: one
     ``[W, slots]`` transfer per ``W`` waves instead of one ``[slots]``
-    per wave. On backends where a readback that waits on fresh work
-    pays a large pipeline-flush RTT (~65 ms through this repo's
-    tunnelled chip) the per-wave check serialises the whole schedule;
-    batching restores the async pipeline at the cost of slots
-    recycling up to ``W-1`` waves late. Outputs are EXACT either way —
-    truncation at the first eos is recomputed at assembly.
+    per wave — slots recycle up to ``W-1`` waves late, outputs are
+    EXACT either way. ``prefill_chunk`` admits through chunk-per-wave
+    interleaved prefill; ``spec_k`` serves through speculative
+    continuous batching (see :func:`make_serve_engine`).
 
     One-shot convenience over :func:`make_serve_engine` — callers timing
     or re-running schedules should build the engine once instead.
@@ -995,14 +1394,16 @@ def serve(params, prompts: Sequence[Any], n_new: int, cfg: BurnInConfig,
     if not prompts:
         return []
     if max_len is None:
+        n_max = n_new if isinstance(n_new, int) else max(n_new)
         longest = max(int(p.shape[-1]) for p in prompts)
         if prefill_chunk:
             # leave room for the padded tail of the longest prompt
             longest = -(-longest // prefill_chunk) * prefill_chunk
-        max_len = longest + n_new + (spec_k or 0)
+        max_len = longest + n_max + (spec_k or 0)
     engine = make_serve_engine(params, cfg, max_len=max_len,
                                cache_dtype=cache_dtype,
                                prefill_chunk=prefill_chunk,
-                               spec_k=spec_k)
+                               spec_k=spec_k, kv_block=kv_block)
     return engine(prompts, n_new, slots=slots, rules=rules, eos_id=eos_id,
-                  eos_check_every=eos_check_every)
+                  eos_check_every=eos_check_every, kv_blocks=kv_blocks,
+                  arrivals=arrivals, static_batching=static_batching)

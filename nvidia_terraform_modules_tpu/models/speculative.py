@@ -76,6 +76,25 @@ def _ngram_draft(ctx, cur_len, k: int, vocab: int):
     return jnp.clip(ctx[gather], 0, vocab - 1)
 
 
+def accept_drafts(draft, preds):
+    """The speculation acceptance core, shared by the solo loop below
+    and the serving engine's per-slot verification step
+    (``models/serving.py``): accept the longest prefix of ``draft``
+    ``[k]`` agreeing with the model's own argmax chain ``preds``
+    ``[k+1]``, and splice the model's next token (the correction at the
+    first mismatch, the continuation when everything agreed) in behind
+    it. Returns ``(new_toks [k+1], n_acc)`` — callers apply their own
+    emission cap (n_new budget, eos windows). One definition so the
+    solo and continuous-batching paths can never diverge on what
+    "accepted" means."""
+    agree = draft == preds[:-1]
+    n_acc = jnp.argmin(jnp.concatenate(
+        [agree, jnp.array([False])]).astype(jnp.int32))   # 0..k
+    new_toks = jnp.concatenate([draft, jnp.zeros((1,), draft.dtype)])
+    new_toks = new_toks.at[n_acc].set(preds[n_acc])
+    return new_toks, n_acc
+
+
 def speculative_greedy_decode(params, prompt, n_new: int,
                               cfg: BurnInConfig,
                               rules: ShardingRules | None = None,
@@ -136,16 +155,11 @@ def speculative_greedy_decode(params, prompt, n_new: int,
                                        rules, prefill_impl="cached")
         preds = jnp.argmax(logits[0], axis=-1)                # [k+1]
         # position j's prediction continues draft[j-1]; accept while the
-        # draft agrees with the model's own argmax chain
-        agree = draft == preds[:-1]
-        n_acc = jnp.argmin(jnp.concatenate(
-            [agree, jnp.array([False])]).astype(jnp.int32))   # 0..k
-        # the model emits n_acc accepted drafts PLUS its own next token
-        # (the correction at the first mismatch, or the continuation when
-        # everything agreed) — capped so we never exceed n_new
+        # draft agrees with the model's own argmax chain — the model
+        # emits n_acc accepted drafts PLUS its own next token, capped so
+        # we never exceed n_new
+        new_toks, n_acc = accept_drafts(draft, preds)         # [k+1]
         emit = jnp.minimum(n_acc + 1, n_new - s["n_out"])
-        new_toks = jnp.concatenate([draft, jnp.zeros((1,), draft.dtype)])
-        new_toks = new_toks.at[n_acc].set(preds[n_acc])       # [k+1]
         keep = jnp.arange(k + 1) < emit
         upd = jax.lax.dynamic_slice_in_dim(s["ctx"], cur, k + 1)
         upd = jnp.where(keep, new_toks, upd)

@@ -324,6 +324,49 @@ def _run_smoketest(
                     checks["decode_ok"] = False
                     checks["decode_error"] = str(exc)
                 ok &= checks["decode_ok"]
+
+            # continuous-batching serve engine: the paged-KV scheduler
+            # (models/serving.py) on a recycling schedule (5 requests
+            # through 2 slots, ragged lengths) must bit-match solo
+            # greedy decode per request — proves the serving runtime,
+            # block allocation/recycling included, on the same fresh
+            # slice. Tiny, unsharded and process-local on purpose: no
+            # collectives, so every host validates independently and
+            # the check is multi-controller-safe at any world size.
+            if checks.get("decode_ok"):
+                try:
+                    from ..models import greedy_decode
+                    from ..models.serving import make_serve_engine
+
+                    ecfg = BurnInConfig(
+                        vocab=128, d_model=32, n_heads=4, d_ff=64,
+                        n_layers=2, seq_len=16, batch=2,
+                        dtype=jax.numpy.float32)
+                    eparams = init_params(jax.random.PRNGKey(8), ecfg)
+                    eprompts = [
+                        jax.random.randint(jax.random.PRNGKey(20 + i),
+                                           (4 + (i % 3) * 2,), 0,
+                                           ecfg.vocab)
+                        for i in range(5)
+                    ]
+                    engine = make_serve_engine(eparams, ecfg,
+                                               max_len=16, kv_block=4)
+                    outs = engine(eprompts, 6, slots=2)
+                    match = all(
+                        bool(jax.device_get(jax.numpy.array_equal(
+                            o, greedy_decode(eparams, p[None, :], 6,
+                                             ecfg)[0])))
+                        for o, p in zip(outs, eprompts))
+                    kv = engine.last_stats["kv"]
+                    checks["serve_engine_ok"] = match
+                    checks["serve_engine_kv_peak_blocks"] = \
+                        kv["high_water"]
+                    checks["serve_engine_kv_utilisation"] = \
+                        kv["utilisation"]
+                except Exception as exc:  # JSON contract > the type
+                    checks["serve_engine_ok"] = False
+                    checks["serve_engine_error"] = str(exc)
+                ok &= checks["serve_engine_ok"]
             if ckpt is not None and ok:
                 try:
                     checks["burnin_checkpoint_cleared"] = ckpt.clear()
@@ -472,6 +515,8 @@ def _run_full_level(checks: dict[str, Any], n_dev: int) -> bool:
         checks["serving_ok"] = (
             all(o.shape == (n_new,) for o in outs)
             and bool(jax.device_get(match)))
+        checks["serving_kv_utilisation"] = \
+            engine.last_stats["kv"]["utilisation"]
     except Exception as exc:  # noqa: BLE001
         checks["serving_ok"] = False
         checks["serving_error"] = str(exc)

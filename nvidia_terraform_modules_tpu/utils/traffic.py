@@ -1,0 +1,195 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Seeded arrival-trace generation: the shared demand model.
+
+ONE load model drives both sides of the serving story: the REAL engine
+(``models/serving.py`` admits requests at these arrival times;
+``bench.py section_serve_engine`` reports sustained tokens/s and
+p50/p99 latency under them) and the SIMULATED fleet (ROADMAP item 4's
+tfsim capacity digital twin resizes node pools against the same
+traces). That is why this module is stdlib-only and deterministic: no
+jax import (tfsim and the bench orchestrator must be able to load it
+for free), and one ``(kind, seed, params)`` tuple always yields one
+byte-identical trace (``tests/test_traffic.py`` property-tests the
+determinism), so a simulator run and a bench capture labelled with the
+same seed saw the SAME users.
+
+Processes:
+
+- :func:`poisson_trace` — homogeneous Poisson arrivals (exponential
+  inter-arrival gaps), the memoryless baseline of serving load.
+- :func:`diurnal_trace` — inhomogeneous Poisson via Lewis-Shedler
+  thinning against a sinusoidal day curve: rate swings between
+  ``base_rate·(1−amplitude)`` and ``base_rate·(1+amplitude)`` over
+  ``period`` seconds — the millions-of-users daily tide.
+- :func:`spike_trace` — a baseline process plus seeded burst windows at
+  ``spike_rate`` (launch moments, retry storms) — the stockout-shaped
+  traffic tfsim's fault profiles care about.
+- :func:`make_trace` — the string-keyed front door the CLI-ish callers
+  (bench sections, future ``tfsim chaos`` demand flags) use.
+
+Traces are plain ``list[float]`` of arrival offsets in seconds,
+ascending from 0. :func:`ragged_lengths` rides along for the matching
+per-request prompt/output-length draws — ragged lengths are the whole
+reason the paged KV cache exists, so the workload generator owns them.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Sequence
+
+
+def _rng(seed, salt: str = "traffic") -> random.Random:
+    # a dedicated Random per trace (the global PRNG would couple traces
+    # to call order), seeded by STRING — random's version-2 str seeding
+    # is sha512-based and cross-process deterministic, where hash(tuple)
+    # would be PYTHONHASHSEED-salted and break the one-seed-one-trace
+    # contract between a bench child process and a tfsim run
+    return random.Random(f"{salt}-{seed}")
+
+
+def poisson_trace(rate: float, n: int, seed: int = 0) -> list[float]:
+    """``n`` homogeneous Poisson arrivals at ``rate`` requests/second.
+
+    Exponential gaps drawn from a seed-local PRNG; same ``(rate, n,
+    seed)`` → same trace, independent of call order or platform.
+    """
+    if rate <= 0:
+        raise ValueError(f"rate must be > 0, got {rate}")
+    if n < 0:
+        raise ValueError(f"n must be >= 0, got {n}")
+    r = _rng(seed)
+    t = 0.0
+    out = []
+    for _ in range(n):
+        t += r.expovariate(rate)
+        out.append(t)
+    return out
+
+
+def diurnal_rate(t: float, base_rate: float, amplitude: float,
+                 period: float, phase: float = 0.0) -> float:
+    """Instantaneous rate of the diurnal curve at time ``t`` (seconds):
+    ``base·(1 + amplitude·sin(2π(t/period + phase)))``, floored at 0."""
+    return max(0.0, base_rate * (
+        1.0 + amplitude * math.sin(2.0 * math.pi * (t / period + phase))))
+
+
+def diurnal_trace(base_rate: float, n: int, seed: int = 0, *,
+                  amplitude: float = 0.5, period: float = 86400.0,
+                  phase: float = 0.0) -> list[float]:
+    """``n`` arrivals from an inhomogeneous Poisson process whose rate
+    follows :func:`diurnal_rate` — Lewis-Shedler thinning against the
+    peak rate, so the trace is exact for the curve, not a step
+    approximation. ``amplitude`` in [0, 1): 0 degrades to
+    :func:`poisson_trace`'s homogeneous process (different draws, same
+    law)."""
+    if not 0.0 <= amplitude < 1.0:
+        raise ValueError(f"amplitude must be in [0, 1), got {amplitude}")
+    if period <= 0:
+        raise ValueError(f"period must be > 0, got {period}")
+    if base_rate <= 0:
+        raise ValueError(f"base_rate must be > 0, got {base_rate}")
+    r = _rng(seed)
+    peak = base_rate * (1.0 + amplitude)
+    t = 0.0
+    out: list[float] = []
+    while len(out) < n:
+        t += r.expovariate(peak)
+        if r.random() * peak <= diurnal_rate(t, base_rate, amplitude,
+                                             period, phase):
+            out.append(t)
+    return out
+
+
+def spike_trace(base_rate: float, n: int, seed: int = 0, *,
+                spike_rate: float | None = None,
+                spike_every: float = 60.0,
+                spike_duration: float = 5.0) -> list[float]:
+    """Baseline Poisson arrivals plus periodic burst windows: every
+    ``spike_every`` seconds the rate jumps to ``spike_rate`` (default
+    ``10·base_rate``) for ``spike_duration`` seconds — thinning again,
+    so bursts are exact. The launch-day / retry-storm shape."""
+    if spike_rate is None:
+        spike_rate = 10.0 * base_rate
+    if base_rate <= 0 or spike_rate <= 0:
+        raise ValueError("rates must be > 0")
+    if spike_every <= 0 or spike_duration <= 0:
+        raise ValueError("spike_every and spike_duration must be > 0")
+    r = _rng(seed)
+    peak = max(base_rate, spike_rate)
+    t = 0.0
+    out: list[float] = []
+    while len(out) < n:
+        t += r.expovariate(peak)
+        in_spike = (t % spike_every) < spike_duration
+        rate = spike_rate if in_spike else base_rate
+        if r.random() * peak <= rate:
+            out.append(t)
+    return out
+
+
+_KINDS = {
+    "poisson": lambda rate, n, seed, kw: poisson_trace(rate, n, seed),
+    "diurnal": lambda rate, n, seed, kw: diurnal_trace(rate, n, seed,
+                                                       **kw),
+    "spike": lambda rate, n, seed, kw: spike_trace(rate, n, seed, **kw),
+}
+
+
+def make_trace(kind: str, rate: float, n: int, seed: int = 0,
+               **kw) -> list[float]:
+    """String-keyed trace constructor: ``kind`` ∈ ``poisson | diurnal |
+    spike``; extra keywords go to the process (``amplitude``/``period``
+    for diurnal, ``spike_rate``/``spike_every``/``spike_duration`` for
+    spike). The one entry point bench sections and tfsim share."""
+    if kind not in _KINDS:
+        raise ValueError(
+            f"unknown trace kind {kind!r}: use {' | '.join(_KINDS)}")
+    return _KINDS[kind](rate, n, seed, kw)
+
+
+def ragged_lengths(n: int, seed: int = 0, *, lo: int = 1, hi: int = 64,
+                   mean: float | None = None) -> list[int]:
+    """``n`` seeded request lengths in ``[lo, hi]`` — long-tailed
+    (``lo`` + exponential, clamped at ``hi``), the shape real
+    prompt/output lengths have, with the pre-clamp distribution mean at
+    ``mean`` (default the range midpoint) — the exponential's own mean
+    is ``mean - lo``, so the parameter names the realised label, not an
+    offset. The deterministic source of the RAGGEDNESS the paged KV
+    cache and per-request retirement exist for: bench and tfsim draw
+    the same lengths for the same seed."""
+    if not 1 <= lo <= hi:
+        raise ValueError(f"need 1 <= lo <= hi, got lo={lo} hi={hi}")
+    if hi == lo:
+        return [lo] * n                 # constant-length workload
+    if mean is None:
+        mean = (lo + hi) / 2.0
+    if mean <= lo:
+        raise ValueError(f"mean must exceed lo ({lo}), got {mean}")
+    r = _rng(seed, salt="lengths")
+    scale = mean - lo
+    return [max(lo, min(hi, lo + int(r.expovariate(1.0 / scale))))
+            for _ in range(n)]
+
+
+def trace_summary(times: Sequence[float]) -> dict[str, float]:
+    """Host-side sanity stats for a trace (bench provenance fields):
+    count, horizon, realised mean rate, max burst in any 1 s window."""
+    times = sorted(times)
+    n = len(times)
+    horizon = times[-1] if times else 0.0
+    burst = 0
+    j = 0
+    for i in range(n):
+        while times[i] - times[j] > 1.0:
+            j += 1
+        burst = max(burst, i - j + 1)
+    return {
+        "count": n,
+        "horizon_s": round(horizon, 3),
+        "mean_rate": round(n / horizon, 3) if horizon > 0 else float(n),
+        "max_burst_1s": burst,
+    }

@@ -66,6 +66,11 @@ SLOW_TESTS = frozenset({
     "tests/test_serving.py::test_prefix_caching_matches_full_decode",
     "tests/test_serving.py::test_eos_early_stopping_variable_lengths",
     "tests/test_serving.py::test_sampled_engine_contracts",
+    # paged-engine matrix sweeps: one seeded Poisson case stays tier-1
+    # (test_continuous_poisson_trace_bit_matches_solo_tier1)
+    "tests/test_serving.py::test_continuous_arrival_matrix_bit_matches_solo",
+    "tests/test_serving.py::test_spec_paged_occupancy_two_plus_reports_kv",
+    "tests/test_paging.py::test_forward_paged_rope_per_row_positions",
     "tests/test_decode.py::test_int8_cache_speculative_still_exact",
     "tests/test_decode.py::test_int8_cache_gqa_decode",
     "tests/test_decode.py::test_int8_cache_on_mesh",

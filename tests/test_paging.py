@@ -1,0 +1,278 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Paged KV cache: allocator invariants and the paged forward path.
+
+The allocator (models/paging.py) is host-side bookkeeping the whole
+engine's correctness leans on: a double-granted block would let two
+requests scribble over each other's cache rows. These tests pin the
+free-list invariants (no double alloc, all-or-nothing grants, LIFO
+recycling, the fragmentation bound) and the paged forward's equivalence
+against the dense cache layout (``forward_paged`` vs ``forward_cached``
+on the same tokens — the layer-level version of the engine-level
+bit-match contract in test_serving.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nvidia_terraform_modules_tpu.models import BurnInConfig, init_params
+from nvidia_terraform_modules_tpu.models.paging import (
+    BlockAllocator,
+    blocks_for_rows,
+    init_paged_cache,
+    paged_pool_spec,
+)
+
+CFG = dict(vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2,
+           seq_len=16, batch=2, dtype=jnp.float32)
+
+
+# ------------------------------------------------------------- allocator
+
+
+def test_alloc_is_all_or_nothing_and_exhaustion_returns_none():
+    a = BlockAllocator(6)                       # 1 reserved + 5 usable
+    got = a.alloc(3)
+    assert got is not None and len(got) == 3
+    assert a.in_use == 3 and a.free_blocks == 2
+    # a grant larger than the remaining free list is REFUSED whole —
+    # a partial grant would admit a request that cannot finish
+    assert a.alloc(3) is None
+    assert a.in_use == 3 and a.free_blocks == 2   # nothing leaked
+    assert a.alloc(2) is not None
+    assert a.free_blocks == 0
+
+
+def test_block_zero_is_never_granted():
+    """Block 0 is the garbage block dead slots write into — handing it
+    out would let an idle slot corrupt a live request."""
+    a = BlockAllocator(5)
+    got = a.alloc(4)
+    assert got is not None and 0 not in got
+    assert a.alloc(1) is None                   # pool exhausted at 4
+
+
+def test_free_recycles_and_double_free_is_loud():
+    a = BlockAllocator(4)
+    got = a.alloc(3)
+    a.free(got[:2])
+    assert a.free_blocks == 2 and a.in_use == 1
+    again = a.alloc(2)
+    assert sorted(again) == sorted(got[:2])     # recycled, not leaked
+    with pytest.raises(ValueError, match="not allocated"):
+        a.free(got[:1] + got[:1])               # second free of same id
+    with pytest.raises(ValueError, match="not allocated"):
+        a.free([0])                             # the reserved block
+
+
+def test_high_water_tracks_peak_not_current():
+    a = BlockAllocator(8)
+    g1 = a.alloc(5)
+    a.free(g1[:4])
+    a.alloc(2)
+    assert a.in_use == 3
+    assert a.high_water == 5
+    assert a.stats()["high_water"] == 5
+
+
+def test_fragmentation_bound_blocks_for_rows():
+    """Internal fragmentation is bounded by block_size - 1 rows per
+    request: the block count never over-allocates by a whole block."""
+    for bs in (1, 4, 16):
+        for rows in (0, 1, bs - 1, bs, bs + 1, 5 * bs + 3):
+            n = blocks_for_rows(rows, bs)
+            assert n * bs >= rows
+            assert n * bs - rows < bs or rows == 0
+    with pytest.raises(ValueError, match="rows"):
+        blocks_for_rows(-1, 4)
+
+
+def test_allocator_validates_construction():
+    with pytest.raises(ValueError, match="exceed"):
+        BlockAllocator(1)                       # nothing beyond reserved
+    with pytest.raises(ValueError, match="allocate"):
+        BlockAllocator(4).alloc(-1)
+
+
+# ---------------------------------------------------------- pool + spec
+
+
+def test_paged_pool_spec_matches_cache_rows():
+    from nvidia_terraform_modules_tpu.models.decode import cache_rows
+
+    cfg = BurnInConfig(**CFG)
+    spec = paged_pool_spec(cfg, 20, 8)
+    assert spec["rows"] == 20
+    assert spec["tables"] == 3                  # ceil(20 / 8)
+    assert spec["logical_rows"] == 24
+    # int8 keeps the 256-row kernel grain through the paged geometry
+    spec8 = paged_pool_spec(cfg, 20, 8, "int8")
+    assert spec8["rows"] == cache_rows(20, "int8") == 256
+    assert spec8["tables"] * 8 >= 256
+    with pytest.raises(ValueError, match="block_size"):
+        paged_pool_spec(cfg, 20, 0)
+
+
+def test_init_paged_cache_layout():
+    cfg = BurnInConfig(**CFG)
+    pool = init_paged_cache(cfg, 3, 20, block_size=8, num_blocks=7)
+    assert len(pool["k"]) == cfg.n_layers
+    assert pool["k"][0].shape == (7, 8, cfg.kv_heads, cfg.head_dim)
+    assert pool["block_tables"].shape == (3, 3)
+    assert pool["pos"].shape == (3,)
+    q = init_paged_cache(cfg, 2, 16, block_size=8, num_blocks=5,
+                         cache_dtype="int8")
+    assert q["k"][0].dtype == jnp.int8
+    assert q["k_scale"][0].shape == (5, 8, cfg.kv_heads)
+    with pytest.raises(ValueError, match="cache_dtype"):
+        init_paged_cache(cfg, 2, 16, block_size=8, num_blocks=5,
+                         cache_dtype="fp8")
+
+
+# ------------------------------------------------- paged forward parity
+
+
+def _paged_setup(cache_dtype="bf16", bs=4, **over):
+    from nvidia_terraform_modules_tpu.models.decode import forward_cached
+    from nvidia_terraform_modules_tpu.models import init_cache
+
+    cfg = BurnInConfig(**{**CFG, **over})
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params, forward_cached, init_cache
+
+
+def test_forward_paged_matches_forward_cached_prefill_and_steps():
+    """The layer-level contract under the engine: a prefill + decode
+    steps through scattered, non-contiguous physical blocks produce
+    logits identical to the dense cache buffer."""
+    from nvidia_terraform_modules_tpu.models.decode import forward_paged
+
+    cfg, params, forward_cached, init_cache = _paged_setup()
+    max_len, bs = 16, 4
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 6), 0,
+                                cfg.vocab)
+    dense = init_cache(cfg, 1, max_len)
+    d_logits, dense = forward_cached(params, prompt, dense, cfg)
+
+    pool = init_paged_cache(cfg, 1, max_len, block_size=bs, num_blocks=9)
+    # deliberately NON-CONTIGUOUS, out-of-order physical blocks: the
+    # table, not adjacency, must carry the logical order
+    pool["block_tables"] = jnp.asarray([[7, 2, 5, 3]], jnp.int32)
+    p_logits, pool = forward_paged(params, prompt, pool, cfg,
+                                   prefill_impl="dense")
+    assert jnp.allclose(d_logits, p_logits, atol=0, rtol=0)
+
+    tok = jnp.argmax(d_logits[:, -1], axis=-1)
+    for _ in range(4):
+        d_logits, dense = forward_cached(params, tok[:, None], dense, cfg)
+        p_logits, pool = forward_paged(params, tok[:, None], pool, cfg)
+        assert jnp.array_equal(d_logits, p_logits)
+        tok = jnp.argmax(d_logits[:, -1], axis=-1)
+    assert int(pool["pos"][0]) == int(dense["pos"])
+
+
+def test_forward_paged_rope_per_row_positions():
+    """Two rows at DIFFERENT depths in one batched step: per-row pos
+    feeds rope and the mask, and each row matches its own solo run."""
+    from nvidia_terraform_modules_tpu.models.decode import (
+        forward_cached,
+        forward_paged,
+    )
+    from nvidia_terraform_modules_tpu.models import init_cache
+
+    cfg = BurnInConfig(**{**CFG, "rope": True})
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    bs, max_len = 4, 12
+    lens = (3, 7)
+    solo_caches, solo_toks = [], []
+    for i, L in enumerate(lens):
+        prompt = jax.random.randint(jax.random.PRNGKey(i), (1, L), 0,
+                                    cfg.vocab)
+        c = init_cache(cfg, 1, max_len)
+        lg, c = forward_cached(params, prompt, c, cfg)
+        solo_caches.append(c)
+        solo_toks.append(jnp.argmax(lg[:, -1], axis=-1))
+
+    pool = init_paged_cache(cfg, 2, max_len, block_size=bs, num_blocks=9)
+    pool["block_tables"] = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+    for i, L in enumerate(lens):
+        prompt = jax.random.randint(jax.random.PRNGKey(i), (1, L), 0,
+                                    cfg.vocab)
+        sub = dict(pool, block_tables=pool["block_tables"][i][None],
+                   pos=jnp.zeros((1,), jnp.int32))
+        _lg, sub = forward_paged(params, prompt, sub, cfg,
+                                 prefill_impl="dense")
+        pool = dict(pool, k=sub["k"], v=sub["v"])
+    pool["pos"] = jnp.asarray(lens, jnp.int32)
+
+    toks = jnp.concatenate(solo_toks)
+    for _ in range(3):
+        lg, pool = forward_paged(params, toks[:, None], pool, cfg)
+        nxt = jnp.argmax(lg[:, -1], axis=-1)
+        for i in range(2):
+            s_lg, solo_caches[i] = forward_cached(
+                params, solo_toks[i][:, None], solo_caches[i], cfg)
+            solo_toks[i] = jnp.argmax(s_lg[:, -1], axis=-1)
+            assert jnp.array_equal(nxt[i], solo_toks[i][0]), \
+                "batched per-row decode diverged from solo"
+        toks = nxt
+
+
+def test_forward_paged_active_mask_fences_writes_to_garbage():
+    """A dead slot's writes must land in block 0 and its pos freeze —
+    the fence that keeps a retired slot from corrupting blocks already
+    recycled to another request."""
+    from nvidia_terraform_modules_tpu.models.decode import forward_paged
+
+    cfg = BurnInConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    pool = init_paged_cache(cfg, 2, 8, block_size=4, num_blocks=4)
+    # slot 1 (dead) points at the SAME blocks as slot 0 (live): without
+    # the fence its write would corrupt slot 0's rows
+    pool["block_tables"] = jnp.asarray([[1, 2], [1, 2]], jnp.int32)
+    pool["pos"] = jnp.asarray([3, 3], jnp.int32)
+    before_k = pool["k"][0]
+    toks = jnp.asarray([5, 9], jnp.int32)
+    active = jnp.asarray([True, False])
+    _lg, pool = forward_paged(params, toks[:, None], pool, cfg,
+                              active=active)
+    assert int(pool["pos"][0]) == 4 and int(pool["pos"][1]) == 3
+    # block 0 (garbage) took the dead slot's row; blocks 1/2 changed
+    # only at the live slot's write row
+    assert not jnp.array_equal(pool["k"][0][0], before_k[0])
+    live_row_changed = not jnp.array_equal(pool["k"][0][1], before_k[1])
+    assert live_row_changed
+
+
+def test_forward_paged_int8_scales_ride_the_tables():
+    """Int8 paged storage: quantised rows and their scale sidecars
+    gather through the same tables; results equal the dense int8
+    cache's bit for bit."""
+    from nvidia_terraform_modules_tpu.models.decode import (
+        forward_cached,
+        forward_paged,
+    )
+    from nvidia_terraform_modules_tpu.models import init_cache
+
+    cfg = BurnInConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 5), 0,
+                                cfg.vocab)
+    dense = init_cache(cfg, 1, 12, cache_dtype="int8")
+    d_lg, dense = forward_cached(params, prompt, dense, cfg)
+    pool = init_paged_cache(cfg, 1, 12, block_size=4, num_blocks=70,
+                            cache_dtype="int8")
+    nt = pool["block_tables"].shape[1]
+    # scattered tables across the (256-row-grained) int8 pool
+    pool["block_tables"] = (jnp.arange(nt, dtype=jnp.int32)[None] * 2
+                            + 1)
+    p_lg, pool = forward_paged(params, prompt, pool, cfg,
+                               prefill_impl="dense")
+    assert jnp.array_equal(d_lg, p_lg)
+    tok = jnp.argmax(d_lg[:, -1], axis=-1)
+    for _ in range(3):
+        d_lg, dense = forward_cached(params, tok[:, None], dense, cfg)
+        p_lg, pool = forward_paged(params, tok[:, None], pool, cfg)
+        assert jnp.array_equal(d_lg, p_lg)
+        tok = jnp.argmax(d_lg[:, -1], axis=-1)

@@ -605,6 +605,197 @@ def test_spec_engine_refuses_eos_check_every():
               eos_check_every=4)
 
 
+def test_kv_block_pool_admission_control_still_exact():
+    """A TIGHT kv_blocks pool (room for ~one request beyond the
+    garbage block) turns memory pressure into queueing: requests wait
+    for retirements to free blocks instead of OOMing — and every
+    output still bit-matches solo decode. The allocator must end the
+    run empty (every grant returned)."""
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+
+    cfg, params, prompts = _setup(n_prompts=5)
+    engine = make_serve_engine(params, cfg, max_len=16, kv_block=4)
+    want = _reference(params, prompts, 5, cfg)
+    # rows/request <= 13 -> <= 4 blocks of 4; 5 blocks + garbage lets
+    # at most ~one request hold blocks at a time
+    got = engine(prompts, 5, slots=2, kv_blocks=6)
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert jnp.array_equal(g, w), f"request {i} diverged"
+    kv = engine.last_stats["kv"]
+    assert kv["num_blocks"] == 6
+    assert kv["high_water"] <= 5
+    assert kv["in_use"] == 0                     # everything returned
+    # a pool that cannot hold the LARGEST request refuses up front
+    # (the queue would deadlock), never hangs
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="kv_blocks"):
+        engine(prompts, 5, slots=2, kv_blocks=3)
+
+
+def test_arrival_trace_gated_admission_matches_all_at_once():
+    """Admission gated by a seeded Poisson arrival trace is pure
+    scheduling: outputs equal the all-at-once run bit for bit, whatever
+    the arrival pattern (the exactness contract extended to the load
+    model)."""
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+    from nvidia_terraform_modules_tpu.utils.traffic import poisson_trace
+
+    cfg, params, prompts = _setup(n_prompts=5)
+    engine = make_serve_engine(params, cfg, max_len=16)
+    want = engine(prompts, 5, slots=2)
+    # compressed trace (~20 ms horizon): arrivals land mid-schedule
+    arrivals = [t / 50.0 for t in poisson_trace(5.0, 5, seed=2)]
+    got = engine(prompts, 5, slots=2, arrivals=arrivals)
+    for g, w in zip(got, want):
+        assert jnp.array_equal(g, w)
+    with pytest.raises(ValueError, match="arrivals"):
+        engine(prompts, 5, slots=2, arrivals=[0.0])
+
+
+def test_per_request_n_new_ragged_budgets():
+    """Per-request generation budgets (the deterministic stand-in for
+    eos-ragged outputs): each request stops at ITS budget, slots
+    recycle early, and every request's tokens are the solo run's
+    prefix."""
+    cfg, params, prompts = _setup(n_prompts=5)
+    budgets = [2, 7, 1, 5, 3]
+    want = _reference(params, prompts, max(budgets), cfg)
+    got = serve(params, prompts, budgets, cfg, slots=2)
+    for i, (g, w, n) in enumerate(zip(got, want, budgets)):
+        assert g.shape == (n,), f"request {i} budget ignored"
+        assert jnp.array_equal(g, w[:n]), f"request {i} diverged"
+    with pytest.raises(ValueError, match="entries"):
+        serve(params, prompts, [2, 3], cfg, slots=2)
+
+
+def test_static_batching_is_run_to_completion_with_same_outputs():
+    """``static_batching`` (the bench's A/B baseline) admits only into
+    an idle pool — identical outputs, strictly more waves on ragged
+    budgets (the bubble continuous batching exists to recycle)."""
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+
+    cfg, params, prompts = _setup(n_prompts=5)
+    budgets = [2, 8, 1, 6, 3]
+    engine = make_serve_engine(params, cfg, max_len=16)
+    cont = engine(prompts, budgets, slots=2)
+    cont_waves = engine.last_stats["waves"]
+    static = engine(prompts, budgets, slots=2, static_batching=True)
+    static_waves = engine.last_stats["waves"]
+    for g, w in zip(static, cont):
+        assert jnp.array_equal(g, w)
+    assert static_waves > cont_waves, (
+        f"run-to-completion ({static_waves} waves) should idle more "
+        f"than continuous ({cont_waves}) on ragged budgets")
+    with pytest.raises(ValueError, match="static_batching"):
+        serve(params, prompts, 4, cfg, slots=2, spec_k=2,
+              static_batching=True)
+
+
+def test_continuous_poisson_trace_bit_matches_solo_tier1():
+    """THE tier-1 scheduler-correctness gate: one seeded Poisson
+    arrival trace + ragged budgets + a tight block pool, outputs
+    bit-match single-request decode for every request (bf16-free CPU
+    f32 — the exact contract; the full seed x slots x pool matrix is
+    slow-marked below)."""
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+    from nvidia_terraform_modules_tpu.utils.traffic import (
+        poisson_trace,
+        ragged_lengths,
+    )
+
+    cfg, params, _ = _setup(n_prompts=0)
+    seed = 0
+    lens = ragged_lengths(6, seed, lo=3, hi=8)
+    budgets = ragged_lengths(6, seed + 1, lo=1, hi=6)
+    prompts = [jax.random.randint(jax.random.PRNGKey(10 + i), (L,), 0,
+                                  cfg.vocab) for i, L in enumerate(lens)]
+    arrivals = [t / 100.0 for t in poisson_trace(10.0, 6, seed)]
+    max_len = max(L + n for L, n in zip(lens, budgets))
+    engine = make_serve_engine(params, cfg, max_len=max_len, kv_block=4)
+    got = engine(prompts, budgets, slots=2, arrivals=arrivals,
+                 kv_blocks=8)
+    for i, (g, p, n) in enumerate(zip(got, prompts, budgets)):
+        want = greedy_decode(params, p[None, :], n, cfg,
+                             max_len=max_len)[0]
+        assert jnp.array_equal(g, want), f"request {i} diverged"
+    assert engine.last_stats["kv"]["in_use"] == 0
+
+
+def test_continuous_arrival_matrix_bit_matches_solo():
+    """Slow full matrix behind the tier-1 case: seeds x slots x pool
+    caps x arrival traces, every request bit-matching its solo decode
+    — the schedule space where a paging/scheduling bug would hide."""
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+    from nvidia_terraform_modules_tpu.utils.traffic import (
+        poisson_trace,
+        ragged_lengths,
+    )
+
+    for seed in (1, 2):
+        cfg, params, _ = _setup(n_prompts=0, seed=seed)
+        lens = ragged_lengths(7, seed, lo=3, hi=8)
+        budgets = ragged_lengths(7, seed + 1, lo=1, hi=7)
+        prompts = [jax.random.randint(jax.random.PRNGKey(30 + i), (L,),
+                                      0, cfg.vocab)
+                   for i, L in enumerate(lens)]
+        max_len = max(L + n for L, n in zip(lens, budgets))
+        solos = [greedy_decode(params, p[None, :], n, cfg,
+                               max_len=max_len)[0]
+                 for p, n in zip(prompts, budgets)]
+        engine = make_serve_engine(params, cfg, max_len=max_len,
+                                   kv_block=4)
+        for slots, kv_blocks, with_arrivals in (
+                (1, None, False), (2, 9, True), (3, None, True),
+                (2, None, False)):
+            arrivals = ([t / 100.0 for t in
+                         poisson_trace(20.0, 7, seed + slots)]
+                        if with_arrivals else None)
+            got = engine(prompts, budgets, slots=slots,
+                         arrivals=arrivals, kv_blocks=kv_blocks)
+            for i, (g, w) in enumerate(zip(got, solos)):
+                assert jnp.array_equal(g, w), (
+                    f"seed={seed} slots={slots} kv={kv_blocks} "
+                    f"request {i}")
+
+
+def test_spec_paged_occupancy_two_plus_reports_kv():
+    """Speculative decode at occupancy >= 2 on the PAGED cache: tokens
+    exactly greedy, verification reads riding the same block tables,
+    and the run reports paging + acceptance stats together."""
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+
+    cfg = BurnInConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompts = [jnp.asarray(([3, 7, 11] * 5)[:10 + i], jnp.int32)
+               for i in range(5)]
+    engine = make_serve_engine(params, cfg, max_len=64, spec_k=3,
+                               kv_block=8)
+    got = engine(prompts, 8, slots=3)
+    want = [greedy_decode(params, p[None, :], 8, cfg, max_len=64)[0]
+            for p in prompts]
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert jnp.array_equal(g, w), f"request {i}"
+    stats = engine.last_stats
+    assert stats["kv"]["in_use"] == 0
+    assert stats["kv"]["high_water"] >= 1
+    assert stats["accepted_per_step"] is not None
+
+
+def test_last_stats_reports_latency_and_waves():
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+
+    cfg, params, prompts = _setup(n_prompts=4)
+    engine = make_serve_engine(params, cfg, max_len=16)
+    engine(prompts, 4, slots=2)
+    st = engine.last_stats
+    assert st["requests"] == 4
+    assert st["generated"] == 16
+    assert st["waves"] >= 3
+    assert st["latency_ms"]["p50"] is not None
+    assert st["latency_ms"]["p99"] >= st["latency_ms"]["p50"]
+    assert 0 < st["kv"]["utilisation"]
+
+
 def test_empty_prompt_refused():
     """A zero-length prompt must fail loudly on every admission path
     (the chunked sweep would otherwise emit garbage from a zero-run

@@ -409,6 +409,96 @@ def test_serve_engine_emits_request_spans(jax8, tmp_path):
     assert reg.histogram("serve_request_ms").count == 3
 
 
+def test_serve_engine_gauges_and_span_args_export(jax8, tmp_path):
+    """The serve telemetry satellite: queue-depth / slot-occupancy /
+    kv-blocks gauges land in the Prometheus exposition, and every
+    ``serve_request`` span carries the latency breakdown
+    (queue_wait_ms, prefill_ms, decode_steps) into the trace args."""
+    import jax
+
+    from nvidia_terraform_modules_tpu.models import (
+        BurnInConfig,
+        init_params,
+    )
+    from nvidia_terraform_modules_tpu.models.serving import (
+        make_serve_engine,
+    )
+    from nvidia_terraform_modules_tpu.telemetry.export import chrome_trace
+
+    cfg = BurnInConfig(vocab=64, d_model=32, n_heads=2, d_ff=64,
+                       n_layers=1, seq_len=16, batch=2)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    reg = Registry(str(tmp_path))
+    engine = make_serve_engine(params, cfg, max_len=12, kv_block=4,
+                               telemetry=reg)
+    prompts = [jax.random.randint(jax.random.PRNGKey(i), (4,), 0, 64)
+               for i in range(4)]
+    engine(prompts, 4, slots=2)
+
+    # gauges exist, carry sane final values, and export through the
+    # standard Prometheus path (no serve-special exposition code)
+    assert reg.gauge("serve_queue_depth").value == 0     # drained
+    assert reg.gauge("serve_slot_occupancy").value == 0.0
+    assert reg.gauge("kv_blocks_in_use").value == 0.0    # all freed
+    prom = reg.prometheus_text()
+    for line in ("# TYPE serve_queue_depth gauge",
+                 "# TYPE serve_slot_occupancy gauge",
+                 "# TYPE kv_blocks_in_use gauge",
+                 "# TYPE serve_request_ms histogram"):
+        assert line in prom, line
+
+    spans = [e for e in reg.events
+             if e["kind"] == "span" and e["name"] == "serve_request"]
+    assert len(spans) == 4
+    for s in spans:
+        args = s["args"]
+        assert set(args) >= {"request", "tokens", "queue_wait_ms",
+                             "prefill_ms", "decode_steps"}
+        assert args["tokens"] == 4
+        assert args["decode_steps"] == 3         # first token + 3 waves
+        assert args["prefill_ms"] > 0
+        assert args["queue_wait_ms"] >= 0
+        # the span duration covers the prefill it reports
+        assert s["dur"] * 1e3 >= args["prefill_ms"]
+    # spans survive the Chrome-trace export with args intact
+    xs = [e for e in chrome_trace(reg.events)["traceEvents"]
+          if e["ph"] == "X" and e["name"] == "serve_request"]
+    assert len(xs) == 4 and all("decode_steps" in e["args"] for e in xs)
+
+
+def test_spec_engine_decode_steps_are_per_request(jax8, tmp_path):
+    """The speculative engine attributes verification slot-steps to the
+    REQUEST that ran them: each retirement's ``decode_steps`` is its
+    own count (not the engine-wide counter), and the per-request
+    counts partition the ``serve_verify_slot_steps`` total exactly."""
+    import jax
+
+    from nvidia_terraform_modules_tpu.models import (
+        BurnInConfig,
+        init_params,
+    )
+    from nvidia_terraform_modules_tpu.models.serving import (
+        make_serve_engine,
+    )
+
+    cfg = BurnInConfig(vocab=64, d_model=32, n_heads=2, d_ff=64,
+                       n_layers=1, seq_len=16, batch=2)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    reg = Registry(str(tmp_path))
+    engine = make_serve_engine(params, cfg, max_len=24, spec_k=2,
+                               telemetry=reg)
+    prompts = [jax.random.randint(jax.random.PRNGKey(i), (4,), 0, 64)
+               for i in range(4)]
+    engine(prompts, 6, slots=2)
+    spans = [e for e in reg.events
+             if e["kind"] == "span" and e["name"] == "serve_request"]
+    assert len(spans) == 4
+    per_req = [s["args"]["decode_steps"] for s in spans]
+    total = reg.counter("serve_verify_slot_steps").value
+    assert sum(per_req) == total > 0
+    assert all(0 < d < total for d in per_req) or len(per_req) == 1
+
+
 def test_tfsim_apply_spans_on_sim_clock_one_lane_per_slot(tmp_path):
     """A replayed graph-parallel apply renders one lane per worker slot,
     on the simulated clock, and never more lanes than -parallelism."""

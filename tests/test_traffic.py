@@ -1,0 +1,114 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Arrival-trace generator: determinism, process shapes, shared use.
+
+The one-seed-one-trace property is the module's reason to exist (the
+tfsim fleet simulator and bench.py's serve section must see the SAME
+users for the same seed, across processes), so it is property-tested
+here — including in a SUBPROCESS with a different PYTHONHASHSEED, the
+failure mode a hash-based seed would have.
+"""
+
+import math
+import subprocess
+import sys
+
+import pytest
+
+from nvidia_terraform_modules_tpu.utils.traffic import (
+    diurnal_rate,
+    diurnal_trace,
+    make_trace,
+    poisson_trace,
+    ragged_lengths,
+    spike_trace,
+    trace_summary,
+)
+
+
+def test_one_seed_one_trace_across_kinds():
+    for kind, kw in (("poisson", {}),
+                     ("diurnal", {"amplitude": 0.7, "period": 20.0}),
+                     ("spike", {"spike_every": 5.0,
+                                "spike_duration": 1.0})):
+        a = make_trace(kind, 8.0, 40, seed=3, **kw)
+        b = make_trace(kind, 8.0, 40, seed=3, **kw)
+        c = make_trace(kind, 8.0, 40, seed=4, **kw)
+        assert a == b, kind
+        assert a != c, kind                     # the seed matters
+        assert len(a) == 40
+        assert all(x < y for x, y in zip(a, a[1:])), kind  # ascending
+
+
+def test_traces_survive_hash_randomisation():
+    """Same seed in a subprocess with a different PYTHONHASHSEED must
+    yield the same trace — the cross-process contract bench children
+    and tfsim runs rely on."""
+    code = ("from nvidia_terraform_modules_tpu.utils.traffic import "
+            "poisson_trace, ragged_lengths\n"
+            "print(repr(poisson_trace(5.0, 5, seed=7)))\n"
+            "print(repr(ragged_lengths(5, seed=7)))\n")
+    outs = []
+    for hashseed in ("0", "12345"):
+        p = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            text=True, env={"PYTHONHASHSEED": hashseed, "PATH": "/usr/bin:/bin"},
+            check=True)
+        outs.append(p.stdout)
+    assert outs[0] == outs[1]
+    assert repr(poisson_trace(5.0, 5, seed=7)) in outs[0]
+
+
+def test_poisson_mean_rate_converges():
+    t = poisson_trace(10.0, 4000, seed=1)
+    s = trace_summary(t)
+    assert s["count"] == 4000
+    assert 9.0 < s["mean_rate"] < 11.0          # LLN at 4k samples
+
+
+def test_diurnal_rate_curve_and_trace_modulation():
+    assert diurnal_rate(0.0, 10.0, 0.5, 100.0) == pytest.approx(10.0)
+    assert diurnal_rate(25.0, 10.0, 0.5, 100.0) == pytest.approx(15.0)
+    assert diurnal_rate(75.0, 10.0, 0.5, 100.0) == pytest.approx(5.0)
+    # arrivals concentrate in the high-rate half of each period
+    t = diurnal_trace(10.0, 3000, seed=2, amplitude=0.9, period=10.0)
+    phase = [x % 10.0 for x in t]
+    first_half = sum(1 for p in phase if p < 5.0)
+    assert first_half > 0.6 * len(phase)        # peak is sin>0 half
+    with pytest.raises(ValueError, match="amplitude"):
+        diurnal_trace(10.0, 5, amplitude=1.0)
+
+
+def test_spike_trace_bursts_cluster_in_windows():
+    t = spike_trace(2.0, 2000, seed=3, spike_rate=40.0,
+                    spike_every=10.0, spike_duration=1.0)
+    in_spike = sum(1 for x in t if (x % 10.0) < 1.0)
+    # spike windows are 10% of the time but ~20/22 of the rate mass
+    assert in_spike > 0.6 * len(t)
+    assert trace_summary(t)["max_burst_1s"] >= 10
+
+
+def test_ragged_lengths_bounds_and_determinism():
+    ls = ragged_lengths(500, seed=9, lo=2, hi=32, mean=8.0)
+    assert ls == ragged_lengths(500, seed=9, lo=2, hi=32, mean=8.0)
+    assert all(2 <= x <= 32 for x in ls)
+    assert len(set(ls)) > 5                     # actually ragged
+    m = sum(ls) / len(ls)
+    assert 4.0 < m < 14.0                       # clamped-exp around 8+2
+    with pytest.raises(ValueError, match="lo"):
+        ragged_lengths(3, lo=0)
+
+
+def test_make_trace_rejects_unknown_kind_and_bad_rate():
+    with pytest.raises(ValueError, match="unknown trace kind"):
+        make_trace("weibull", 1.0, 3)
+    with pytest.raises(ValueError, match="rate"):
+        poisson_trace(0.0, 3)
+
+
+def test_trace_summary_empty_and_burst():
+    assert trace_summary([])["count"] == 0
+    s = trace_summary([0.0, 0.1, 0.2, 5.0])
+    assert s["max_burst_1s"] == 3
+    assert s["horizon_s"] == 5.0
+    assert math.isclose(s["mean_rate"], 4 / 5.0, rel_tol=1e-6)
