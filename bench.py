@@ -929,20 +929,28 @@ def section_longctx() -> dict:
 
 
 def section_flash_bwd() -> dict:
-    """Per-layer flash BACKWARD time at the flagship per-layer shape
-    ``[2, 4096, 16, 128]``, fused single-pass vs split two-kernel — the
-    round-over-round tracker for the PR-4 kernel rewrite, so the backward
-    win is a committed number instead of something inferred from
-    ``burnin_mfu``. Timed with the in-jit ``lax.scan`` chain via
-    ``utils/timing.delta_time``: PROFILE_r05 showed an eagerly dispatched
-    per-call clock overstates ms-scale kernels ~6× through the tunnelled
-    backend's dispatch+flush latency. Off-TPU the same chain runs tiny
-    shapes under the pallas interpreter so the code path stays proven
-    (see ``cpu_fallback_expectations``)."""
+    """Per-layer flash kernel times at the flagship per-layer shape
+    ``[2, 4096, 16, 128]``: fused single-pass vs split two-kernel backward
+    (the PR-4 tracker) and the software-PIPELINED kernels vs the serial
+    baseline, forward and backward (the PR-9 tracker — the lever for
+    ``burnin_mfu ≥ 0.78``), plus the splash mask's block skip fraction at
+    the shipping tiling. Each pipeline mode runs its own autoshrink
+    defaults (what actually ships: pipelined halves the K block to hold
+    two sub-tiles in the same VMEM plan). Timed with the in-jit
+    ``lax.scan`` chain via ``utils/timing.delta_time``: PROFILE_r05
+    showed an eagerly dispatched per-call clock overstates ms-scale
+    kernels ~6× through the tunnelled backend's dispatch+flush latency.
+    Off-TPU the same chain runs tiny shapes under the pallas interpreter
+    so the code path stays proven (see ``cpu_fallback_expectations``)."""
     import jax
     import jax.numpy as jnp
 
-    from nvidia_terraform_modules_tpu.ops import flash_attention
+    from nvidia_terraform_modules_tpu.ops import (
+        MaskSpec,
+        auto_blocks,
+        flash_attention,
+        splash_stats,
+    )
     from nvidia_terraform_modules_tpu.utils.timing import delta_time
 
     on = _on_tpu()
@@ -951,7 +959,20 @@ def section_flash_bwd() -> dict:
     ks = jax.random.split(jax.random.PRNGKey(5), 4)
     q, k, v, do = (jax.random.normal(kk, (b, s, h, d), dtype) for kk in ks)
 
-    def make_chain(mode):
+    def make_fwd_chain(pipeline):
+        def factory(length):
+            @jax.jit
+            def chain(q, k, v):
+                def step(acc, _):
+                    return flash_attention(acc, k, v, causal=True,
+                                           pipeline=pipeline), None
+
+                out, _ = jax.lax.scan(step, q, None, length=length)
+                return out
+            return chain
+        return factory
+
+    def make_chain(mode, pipeline="auto"):
         def factory(length):
             @jax.jit
             def chain(q, k, v, do):
@@ -960,7 +981,8 @@ def section_flash_bwd() -> dict:
                 # each scan tick is exactly one per-layer flash backward
                 _, vjp_fn = jax.vjp(
                     lambda q_, k_, v_: flash_attention(
-                        q_, k_, v_, causal=True, backward=mode), q, k, v)
+                        q_, k_, v_, causal=True, backward=mode,
+                        pipeline=pipeline), q, k, v)
 
                 def step(carry, _):
                     dq, _, _ = vjp_fn(carry)
@@ -971,10 +993,28 @@ def section_flash_bwd() -> dict:
             return chain
         return factory
 
-    t_fused = delta_time(make_chain("fused"), q, k, v, do,
-                         iters_lo=2, iters_hi=10)
+    # pipelined vs serial A/B, each at its own autoshrink defaults; the
+    # pipelined measurement doubles as the shipping default (pipeline=
+    # "auto" resolves to "on" at both bench shapes — timing "auto"
+    # separately would compile and run the identical chain twice)
+    t_bwd_pipe = delta_time(make_chain("fused", "on"), q, k, v, do,
+                            iters_lo=2, iters_hi=10)
+    t_bwd_base = delta_time(make_chain("fused", "off"), q, k, v, do,
+                            iters_lo=2, iters_hi=10)
+    t_fused = t_bwd_pipe
     t_split = delta_time(make_chain("split"), q, k, v, do,
                          iters_lo=2, iters_hi=10)
+    t_fwd_pipe = delta_time(make_fwd_chain("on"), q, k, v,
+                            iters_lo=2, iters_hi=10)
+    t_fwd_base = delta_time(make_fwd_chain("off"), q, k, v,
+                            iters_lo=2, iters_hi=10)
+    # splash stats are host-side numpy over the liveness map — report the
+    # FLAGSHIP tiling on every platform, not the tiny CPU fallback shape
+    # (whose single q block has no dead tiles to skip)
+    fs, fd = 4096, 128
+    bq, bk, piped = auto_blocks(fs, fd, jnp.dtype(jnp.bfloat16).itemsize,
+                                pipe=True)
+    stats = splash_stats(MaskSpec("causal"), fs, fs, bq, bk)
     return {
         "flash_bwd_shape": [b, s, h, d],
         "flash_bwd_ms": round(t_fused * 1e3, 3),
@@ -982,6 +1022,19 @@ def section_flash_bwd() -> dict:
         # >1 means the fused single-pass beats the split pair (chip only;
         # interpret mode measures the interpreter)
         "flash_bwd_fused_vs_split": round(t_split / max(t_fused, 1e-12), 2),
+        "flash_fwd_ms": round(t_fwd_pipe * 1e3, 3),
+        # >1 means the software pipeline beats the serial kernels at each
+        # mode's shipping blocks (chip only; the interpreter runs the same
+        # sub-tile folds serially either way)
+        "flash_fwd_pipelined_vs_base": round(
+            t_fwd_base / max(t_fwd_pipe, 1e-12), 2),
+        "flash_bwd_pipelined_vs_base": round(
+            t_bwd_base / max(t_bwd_pipe, 1e-12), 2),
+        # causal splash map at the pipelined tiling: the fraction of
+        # (q-block, k-block) tiles skipped outright — deterministic, so
+        # meaningful on CPU too
+        "flash_splash_skip_frac": stats["skip_frac"],
+        "flash_pipeline_blocks": [bq, bk, bool(piped)],
     }
 
 
@@ -1621,8 +1674,22 @@ def main() -> None:
                 "pallas interpret mode: both backward paths run the "
                 "interpreter at tiny shapes, so the ratio measures "
                 "interpreter step counts, not kernels — the fused path's "
-                "MXU/VMEM win (P/dS once per tile, pipelined epilogue) is "
-                "chip-only and must not be asserted off-TPU")
+                "MXU/VMEM win (P/dS once per tile, software-pipelined "
+                "sub-tile pairs, double-buffered epilogue) is chip-only "
+                "and must not be asserted off-TPU")
+        if "flash_fwd_pipelined_vs_base" in merged:
+            expectations["flash_fwd_pipelined_vs_base"] = (
+                "pallas interpret mode: the software pipeline is a mosaic "
+                "SCHEDULING property (VPU softmax of sub-tile i "
+                "overlapping the MXU dots of i+1); the interpreter runs "
+                "the same folds serially either way, so ~1 is expected "
+                "off-TPU — the >1 target is chip-only, tracked against "
+                "the burnin_mfu >= 0.78 goal")
+        if "flash_bwd_pipelined_vs_base" in merged:
+            expectations["flash_bwd_pipelined_vs_base"] = (
+                "same interpret-mode caveat as flash_fwd_pipelined_vs_base"
+                " — both backward pipeline modes run identical sub-tile "
+                "folds under the interpreter; chip-only signal")
         if "reshard_restore_ms" in merged:
             expectations["reshard_restore_ms"] = (
                 "tiny CPU shapes on local disk (often a 1-device world, "

@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..ops.flash_attention import flash_attention
+from ..ops.flash_attention import MaskSpec, flash_attention, mask_live_frac
 from ..ops.ring_attention import dense_reference_attention, ring_self_attention
 from ..ops.ulysses_attention import ulysses_self_attention
 from ..parallel.sharding import ShardingRules
@@ -95,6 +95,30 @@ class BurnInConfig:
     # ring sweep's per-block math, and ulysses' post-all-to-all local
     # attention; the dense impl's backward is XLA's transpose.
     flash_backward: str = "fused"
+    # software-pipelined flash kernels (ops/flash_attention.py): "auto"
+    # (default) runs the paired-sub-tile kernels — the online-softmax VPU
+    # work of sub-tile i overlapping the MXU dots of sub-tile i+1 —
+    # whenever the K tiling has an even number of blocks; "on" demands
+    # them (ValueError if the shape can't tile evenly), "off" pins the
+    # serial kernels (the A/B baseline and the bit-match reference the
+    # smoke test's flash_pipeline_ok check compares against). Applies to
+    # the same paths as flash_backward.
+    flash_pipeline: str = "auto"
+    # sliding-window causal attention: keep only the last N tokens visible
+    # (q - k < N). None = full causal. The flash path compiles it to a
+    # block-sparse splash mask (dead tiles skipped in forward AND
+    # backward); the dense path applies the same mask through XLA, so the
+    # two impls stay differentially testable. Only "flash" and "dense"
+    # support it — the sharded ring/ulysses layouts would need the window
+    # threaded through their shard masks (future mask-spec work).
+    flash_window: int | None = None
+    # explicit flash tile sizes (None = the VMEM-budget autoshrink in
+    # ops/flash_attention.py::auto_blocks). The chip-tuning lever the
+    # "Kernel tuning" runbook in gke-tpu/README.md drives; also what the
+    # smoke test's flash_pipeline_ok check uses to hold blocks equal
+    # across its pipelined/unpipelined A/B.
+    flash_block_q: int | None = None
+    flash_block_k: int | None = None
     # remat=True wraps each transformer block in jax.checkpoint: backward
     # recomputes the block's activations from its input instead of keeping
     # them resident, trading ~1/3 more FLOPs for O(n_layers×) less
@@ -123,6 +147,23 @@ class BurnInConfig:
             raise ValueError(
                 f"unknown flash_backward impl {self.flash_backward!r}; "
                 f"use fused|split")
+        if self.flash_pipeline not in ("auto", "on", "off"):
+            raise ValueError(
+                f"unknown flash_pipeline mode {self.flash_pipeline!r}; "
+                f"use auto|on|off")
+        if self.flash_window is not None:
+            if self.flash_window < 1:
+                raise ValueError(
+                    f"flash_window must be >= 1, got {self.flash_window}")
+            if self.attn not in ("flash", "dense"):
+                raise ValueError(
+                    f"flash_window needs attn='flash' or 'dense', got "
+                    f"{self.attn!r} (the sharded ring/ulysses masks don't "
+                    f"carry a window yet)")
+        for name in ("flash_block_q", "flash_block_k"):
+            blk = getattr(self, name)
+            if blk is not None and blk < 1:
+                raise ValueError(f"{name} must be >= 1, got {blk}")
         if self.n_experts < 0:
             raise ValueError(f"n_experts must be >= 0, got {self.n_experts}")
         if self.router_top_k < 1 or (
@@ -296,16 +337,22 @@ def forward_and_aux(params, tokens, cfg: BurnInConfig,
         if use_ring:
             attn = ring_self_attention(
                 q, k, v, rules.mesh, causal=True, spec=seq_spec,
-                backward=cfg.flash_backward
+                backward=cfg.flash_backward, pipeline=cfg.flash_pipeline,
+                block_q=cfg.flash_block_q, block_k=cfg.flash_block_k
             )
         elif use_ulysses:
             attn = ulysses_self_attention(
                 q, k, v, rules.mesh, causal=True, spec=seq_spec,
-                backward=cfg.flash_backward
+                backward=cfg.flash_backward, pipeline=cfg.flash_pipeline,
+                block_q=cfg.flash_block_q, block_k=cfg.flash_block_k
             )
         elif cfg.attn == "flash":
-            fa = functools.partial(flash_attention, causal=True,
-                                   backward=cfg.flash_backward)
+            fa = functools.partial(
+                flash_attention, causal=True,
+                backward=cfg.flash_backward, pipeline=cfg.flash_pipeline,
+                block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+                mask=(MaskSpec("window", cfg.flash_window)
+                      if cfg.flash_window is not None else None))
             if rules is None:
                 attn = fa(q, k, v)
             else:
@@ -316,7 +363,8 @@ def forward_and_aux(params, tokens, cfg: BurnInConfig,
                     out_specs=seq_spec, check_vma=False,
                 )(q, k, v)
         else:
-            attn = dense_reference_attention(q, k, v, causal=True)
+            attn = dense_reference_attention(q, k, v, causal=True,
+                                             window=cfg.flash_window)
         attn = attn.reshape(attn.shape[0], attn.shape[1], cfg.d_model)
         x = x + act(attn @ layer["wo"], "sp", None)
 
@@ -355,16 +403,20 @@ def train_step_flops(cfg: BurnInConfig) -> float:
 
     Counts useful matmul FLOPs only (the MFU convention): projections,
     attention contractions, MLP, and the weight-tied head; backward = 2×
-    forward. Causal attention counts the ~half of the score/PV work that
-    is unmasked — the flash kernel's block-sparse skip means masked tiles
-    genuinely cost nothing, so billing them would inflate MFU.
+    forward. Masked attention counts only the unmasked fraction of the
+    score/PV work (½ causal, less for a sliding window) — the flash
+    kernel's splash block-sparse skip means masked tiles genuinely cost
+    nothing, so billing them would inflate MFU.
     """
     b, s, d, dff, v = (cfg.batch, cfg.seq_len, cfg.d_model, cfg.d_ff,
                        cfg.vocab)
     kv_frac = cfg.kv_heads / cfg.n_heads   # GQA narrows the K/V projections
+    live = mask_live_frac(
+        MaskSpec("window", cfg.flash_window)
+        if cfg.flash_window is not None else MaskSpec("causal"), s)
     per_layer = (
         (4.0 + 4.0 * kv_frac) * b * s * d * d   # q,o full + k,v at kv width
-        + 2.0 * b * s * s * d        # QKᵀ + PV, causal-effective (½ of 4BS²d)
+        + 4.0 * live * b * s * s * d   # QKᵀ + PV at the mask's live frac
         # FFN: a top-k MoE token passes through k experts' up+down (k=1 for
         # dense and Switch), so the per-token FFN FLOPs scale by k;
         # dispatch/combine einsums are routing overhead, deliberately not
@@ -473,9 +525,78 @@ def make_grads_fn(cfg: BurnInConfig, rules: ShardingRules | None,
     return grad_accum(vg, accum_steps, _micro_constraint(rules))
 
 
+def _flash_kernel_probe(cfg: BurnInConfig, reg) -> None:
+    """One-shot per-kernel flash timing probe for the telemetry plane.
+
+    Times ONE per-layer flash forward and one fused backward at the
+    config's attention shape with the in-jit ``lax.scan`` chain
+    (``utils/timing.delta_time`` — PROFILE_r05's evidence standard: an
+    eagerly dispatched per-call clock overstates ms-scale kernels ~6×),
+    then records ``flash_fwd_ms``/``flash_bwd_ms`` histograms and
+    ``flash_fwd_mxu_frac``/``flash_bwd_mxu_frac`` gauges — achieved
+    matmul FLOP/s over one device's bf16 peak, billing only mask-live
+    tiles (2 tile dots forward; backward per the selected kernels: the
+    fused path runs 5 per tile — score remat + dP + the three gradient
+    dots — the split path 7, rematerialising scores and dP in each of
+    its two kernels). These are the kernel-level numbers the next
+    PROFILE round tracks, captured live instead of via a manual sweep.
+    """
+    from ..utils.device import device_spec
+    from ..utils.timing import delta_time
+
+    b, s, h, dh = cfg.batch, cfg.seq_len, cfg.n_heads, cfg.head_dim
+    ks = jax.random.split(jax.random.PRNGKey(17), 4)
+    q, k, v, do = (jax.random.normal(kk, (b, s, h, dh), cfg.dtype)
+                   for kk in ks)
+    spec = (MaskSpec("window", cfg.flash_window)
+            if cfg.flash_window is not None else MaskSpec("causal"))
+    fa = functools.partial(
+        flash_attention, causal=True, backward=cfg.flash_backward,
+        pipeline=cfg.flash_pipeline, block_q=cfg.flash_block_q,
+        block_k=cfg.flash_block_k,
+        mask=spec if cfg.flash_window is not None else None)
+
+    def fwd_chain(length):
+        @jax.jit
+        def chain(q, k, v):
+            def tick(acc, _):
+                return fa(acc, k, v), None
+            out, _ = jax.lax.scan(tick, q, None, length=length)
+            return out
+        return chain
+
+    def bwd_chain(length):
+        @jax.jit
+        def chain(q, k, v, do):
+            _, vjp_fn = jax.vjp(lambda q_, k_, v_: fa(q_, k_, v_), q, k, v)
+
+            def tick(carry, _):
+                dq, _, _ = vjp_fn(carry)
+                return dq, None
+
+            out, _ = jax.lax.scan(tick, do, None, length=length)
+            return out
+        return chain
+
+    t_fwd = delta_time(fwd_chain, q, k, v, iters_lo=1, iters_hi=3,
+                       samples=1)
+    t_bwd = delta_time(bwd_chain, q, k, v, do, iters_lo=1, iters_hi=3,
+                       samples=1)
+    peak = device_spec().bf16_tflops * 1e12
+    flops_fwd = 4.0 * mask_live_frac(spec, s) * b * h * s * s * dh
+    bwd_dots = 2.5 if cfg.flash_backward == "fused" else 3.5  # ×fwd's 2
+    reg.histogram("flash_fwd_ms").record(t_fwd * 1e3)
+    reg.histogram("flash_bwd_ms").record(t_bwd * 1e3)
+    reg.gauge("flash_fwd_mxu_frac").set(
+        flops_fwd / max(t_fwd, 1e-12) / peak)
+    reg.gauge("flash_bwd_mxu_frac").set(
+        bwd_dots * flops_fwd / max(t_bwd, 1e-12) / peak)
+
+
 def instrument_step(step, cfg: BurnInConfig, telemetry=None, *,
                     rules: ShardingRules | None = None,
-                    sync: bool = True):
+                    sync: bool = True,
+                    kernel_probe: bool | None = None):
     """Wrap a compiled train step with per-step telemetry.
 
     Records a ``train_step_ms`` latency histogram (exact p50/p90/p99 in
@@ -486,6 +607,15 @@ def instrument_step(step, cfg: BurnInConfig, telemetry=None, *,
     dispatch — the burn-in loop already syncs per step via
     ``float(loss)``, so the extra read is nearly free there; pass
     ``sync=False`` for callers that pipeline steps and sync themselves.
+
+    ``kernel_probe`` adds the one-shot per-kernel flash probe
+    (:func:`_flash_kernel_probe`: ``flash_fwd_ms``/``flash_bwd_ms``
+    histograms + MXU-fraction gauges) before the FIRST instrumented step
+    — ``None`` (default) probes exactly when ``cfg.attn == "flash"``,
+    ``False`` never, ``True`` demands it (ValueError on non-flash
+    configs, whose steps don't run the monolithic kernels the probe
+    times). The probe costs a few kernel launches once per run and
+    nothing per step.
 
     Pass the step's ``rules`` whenever the step is SHARDED: MFU is
     achieved model FLOP/s over the **aggregate** peak of the devices
@@ -501,12 +631,18 @@ def instrument_step(step, cfg: BurnInConfig, telemetry=None, *,
     """
     from ..telemetry import get_registry
 
+    if kernel_probe and cfg.attn != "flash":
+        raise ValueError(
+            f"kernel_probe=True needs attn='flash', got {cfg.attn!r} — "
+            f"the probe times the monolithic flash kernels the step runs")
     reg = telemetry if telemetry is not None else get_registry()
     if not reg.enabled:
         return step
     from ..utils.device import device_spec
     from ..utils.timing import sync as _sync
 
+    probe = cfg.attn == "flash" if kernel_probe is None else kernel_probe
+    probe_state = {"done": False}
     hist = reg.histogram("train_step_ms")
     steps_c = reg.counter("train_steps")
     toks_g = reg.gauge("train_tokens_per_s")
@@ -517,6 +653,11 @@ def instrument_step(step, cfg: BurnInConfig, telemetry=None, *,
     peak = device_spec().bf16_tflops * 1e12 * n_dev
 
     def instrumented(*args):
+        if probe and not probe_state["done"]:
+            # before t0 on purpose: the probe's kernel launches must not
+            # pollute the first step's train_step_ms sample
+            probe_state["done"] = True
+            _flash_kernel_probe(cfg, reg)
         t0 = reg.clock()
         out = step(*args)
         if sync:

@@ -2,7 +2,14 @@
 # SPDX-License-Identifier: Apache-2.0
 """Hardware micro-probes and TPU-first compute ops (ring/Ulysses attention)."""
 
-from .flash_attention import flash_attention  # noqa: F401
+from .flash_attention import (  # noqa: F401
+    MaskSpec,
+    auto_blocks,
+    flash_attention,
+    flash_vmem_bytes,
+    mask_live_frac,
+    splash_stats,
+)
 from .int8_matmul import int8_matmul, int8_matmul_ref  # noqa: F401
 from .probes import hbm_probe, matmul_probe  # noqa: F401
 from .ring_attention import (  # noqa: F401

@@ -1,6 +1,6 @@
 # SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
 # SPDX-License-Identifier: Apache-2.0
-"""Pallas TPU flash attention: fused, tiled, O(S) memory, custom VJP.
+"""Pallas TPU flash attention: pipelined, block-sparse, O(S) memory, custom VJP.
 
 The hot op of the burn-in workload (and of any transformer a provisioned slice
 will run) is attention. XLA already fuses elementwise chains into the matmuls;
@@ -9,15 +9,66 @@ matrix never materialises in HBM. That is this kernel's job — the classic
 flash-attention recurrence, written for the MXU/VMEM model of the pallas guide
 (`/opt/skills/guides/pallas_guide.md`):
 
-- grid (batch·heads, q-blocks, k-blocks); k innermost so the f32 accumulators
+- grid (batch·heads, q-blocks, k-steps); k innermost so the f32 accumulators
   (o, m, l) live in VMEM scratch across the k sweep;
 - block matmuls run in the input dtype on the MXU (bf16 in production) with
   ``preferred_element_type=f32`` accumulation; the online softmax runs on the
   VPU in f32;
-- causal masking is block-sparse: k-blocks strictly above the diagonal are
-  skipped with ``pl.when`` (no FLOPs, no mask materialisation);
+- masking is block-sparse ("splash"): a precomputed per-(q-block, k-block)
+  liveness map rides into the kernel as a tiny SMEM input and dead tiles are
+  skipped with ``pl.when`` (no FLOPs, no mask materialisation) — in the
+  forward AND in both backward paths;
 - the backward pass recomputes P = exp(S - L) per tile from the saved
   logsumexp L (flash-style rematerialisation: trade FLOPs for HBM).
+
+Software pipeline (``pipeline="auto"|"on"|"off"``)
+--------------------------------------------------
+
+PROFILE_r05 priced the post-retune ceiling: the flash kernels ran at ~0.40
+MXU fraction because the online-softmax VPU work (rowmax, exp, rescale) of
+tile *i* serialised against the MXU dots of tile *i+1*. The pipelined kernels
+break that serialisation structurally: each k grid step consumes a PAIR of
+k sub-tiles whose score dots are issued back-to-back **before** either
+sub-tile's VPU fold, so Mosaic can keep the MXU busy on sub-tile *i+1*'s
+QKᵀ while the VPU folds sub-tile *i* (and the doubled K/V block window gives
+the DMA pipeline the same lookahead). The fold itself is arithmetically
+IDENTICAL to the unpipelined kernel's — same sub-tile order, same ops — so
+``pipeline="on"`` bit-matches ``pipeline="off"`` at equal block sizes; the
+smoke test (``flash_pipeline_ok``) and a tier-1 lowering pin keep that
+property honest. ``"auto"`` (default) pipelines whenever the K tiling has an
+even number of blocks.
+
+A fully-masked sub-tile folds as an exact identity (corr = 1, Σp = 0), which
+is what lets the pipelined kernel fold a dead half of a half-live pair and
+still bit-match the unpipelined kernel that skipped it outright.
+
+VMEM-budget autoshrink
+----------------------
+
+Default block sizes are no longer a table: ``auto_blocks`` picks the q block
+by the measured v5e rule (``min(1024, max(128, S/4))``) and then the WIDEST
+K block whose deterministic VMEM plan (double-buffered block windows +
+scratch accumulators + in-flight f32 score tiles, ``flash_vmem_bytes``) fits
+``FLASH_VMEM_BUDGET`` (16 MiB/core). The plan reproduces the measured
+round-5 defaults (S=4096, d=128 → 1024×1024 unpipelined; 2048-wide tiles
+rejected exactly as they failed to compile on chip) and computes wider K for
+narrow heads (d=64 → 2048) instead of capping at the table's 1024. The
+pipelined kernels hold two K sub-tiles in flight, so the same budget lands
+them at half the K width (S=4096, d=128 → 1024×512 pairs) — identical bytes
+streamed per step, double the lookahead.
+
+Splash masking (``mask=``)
+--------------------------
+
+``MaskSpec`` generalises the old causal-only block skip: ``"causal"``,
+``"full"``, or ``("window", W)`` (sliding causal window) compile to a
+per-(q-block, k-block) liveness map — DEAD tiles are skipped in forward and
+backward, PARTIAL tiles apply the element mask, FULL tiles fold unmasked
+(the element mask is still applied to them, which is a bitwise no-op, so
+causal numerics are unchanged from the pre-splash kernels). The map is a
+host-side numpy constant (``block_liveness``) threaded through the
+``custom_vjp``; ``splash_stats`` reports the dead/partial/full tile split
+for bench capture (``flash_splash_skip_frac``).
 
 Backward: fused single-pass (default) vs split
 ----------------------------------------------
@@ -27,75 +78,203 @@ Two selectable backward implementations, ``backward="fused"|"split"``:
 - ``"split"`` (the historical design): two kernels — dq, then (dk, dv) —
   each sweeping the full (q-block × k-block) grid and each calling
   ``_bwd_tile``, so the tile scores P and dS are rematerialised TWICE per
-  tile. PROFILE_r05 priced this double rematerialisation (plus the f32
-  epilogue traffic) as the bulk of the ~0.11 MFU between the measured 0.698
-  ``burnin_mfu`` and the config's ~0.81 hardware ceiling.
-- ``"fused"`` (default): ONE ``pallas_call`` sweeping the grid
-  ``(bh, q-blocks, k-blocks)`` once, computing P/dS once per tile and
-  emitting all three gradients. Accumulation scheme:
+  tile. Kept for A/B timing and the differential oracle; never pipelined.
+- ``"fused"`` (default): ONE ``pallas_call`` sweeping the grid once,
+  computing P/dS once per tile and emitting all three gradients:
 
   * **dq** accumulates across the K dimension in a ``[block_q, d]`` f32
-    VMEM scratch over the inner k sweep (k innermost, exactly like the
-    forward) and is cast + written once per q-block at ``ki == nk-1``;
+    VMEM scratch over the inner k sweep and is cast + written once per
+    q-block at the last k step;
   * **dk/dv** accumulate across the Q dimension in full-K-length
     ``[nk, block_k, d]`` f32 VMEM scratches that persist across the whole
-    grid sweep (each (qi, ki) tile adds into slice ``ki``), and each
-    k-block's slice is cast + written during the LAST q-row sweep
-    (``qi == nq-1``, where every k-block is causally live);
-  * the f32 epilogue is thereby pipelined: dk/dv output blocks rotate
-    every grid step, so pallas's double-buffered output pipeline overlaps
-    each tile's accumulator cast/write-back DMA with the next tile's MXU
-    dots instead of serialising a whole-array epilogue after the sweep —
-    the "double-buffered epilogue" PROFILE_r05 called for;
-  * causally dead tiles are skipped via the shared ``_causal_live``
-    predicate, same as the forward.
+    grid sweep, and each k-block's slice is cast + written during the LAST
+    q row, so every output block's cast/write-back DMA overlaps the next
+    tile's dots via pallas's double-buffered output pipeline;
+  * with ``pipeline`` on, each grid step processes a k sub-tile PAIR with
+    all four MXU front dots (two QKᵀ, two dO·Vᵀ) hoisted ahead of the VPU
+    dS work — the same overlap story as the forward;
+  * dead tiles are skipped via the splash liveness map.
 
   The full-length dk/dv scratch costs ``2 · S_k · d · 4`` bytes of VMEM
-  (4 MiB at the flagship S=4096, d=128 — comfortably inside the ~16 MiB
-  budget next to the ~1.5 MiB of double-buffered block windows); very long
-  K at wide d would need a k-sharded outer loop, which ring attention
-  already provides.
+  (4 MiB at the flagship S=4096, d=128); very long K at wide d would need a
+  k-sharded outer loop, which ring attention already provides — and the
+  ring's per-visiting-block backward reuses these kernels (pipelined fused
+  by default), so the S≫4096 flagship composes both.
 
-``"split"`` stays in-tree so A/B timing (``bench.py: flash_bwd_*``) and the
-fused-vs-split differential oracle (tests/test_flash_attention.py) both keep
-running; a lowering-regression test pins fused to exactly one backward
-``pallas_call`` so a silent fallback can never masquerade as a perf win.
+A lowering-regression test pins the backward ``pallas_call`` count AND the
+pipelined grid shape, so a silent fallback to the split or unpipelined path
+can never masquerade as a perf win.
 
 CPU runs (tests, the virtual-mesh rig) use ``interpret=True`` automatically.
+Chip-capture protocol for retunes: see "Kernel tuning" in
+``gke-tpu/README.md``.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+
+# block-liveness classes in the splash map
+MASK_DEAD = 0      # no live element: tile skipped, zero FLOPs
+MASK_PARTIAL = 1   # straddles the mask edge: element mask applies
+MASK_FULL = 2      # every element live
+
+# per-core VMEM the kernels may plan against (v5e/v4 class); the autoshrink
+# rejects block shapes whose deterministic plan exceeds it
+FLASH_VMEM_BUDGET = 16 * 1024 * 1024
+K_BLOCK_CAP = 2048
 
 
 def _on_interpret_platform() -> bool:
     return jax.devices()[0].platform != "tpu"
 
 
-def _tile_scores(q_ref, k_ref, qi, ki, *, scale, causal, block_q, block_k):
-    """Scaled, causally-masked f32 scores for one (q-block × k-block) tile.
+# ------------------------------------------------------------- mask specs
 
-    Shared by the forward and both backward kernels so masking/precision can
-    never drift between them. The matmul keeps the input dtype on the MXU and
-    accumulates f32; the scale is applied to the f32 scores.
+@dataclasses.dataclass(frozen=True)
+class MaskSpec:
+    """Static attention-mask description, hashable so it can thread through
+    ``custom_vjp`` nondiff args and the liveness-map cache.
+
+    kind: ``"causal"`` (q ≥ k), ``"full"`` (no mask), or ``"window"``
+    (sliding causal window: q ≥ k and q - k < window).
+    """
+
+    kind: str = "causal"
+    window: int | None = None
+
+    def __post_init__(self):
+        if self.kind not in ("causal", "full", "window"):
+            raise ValueError(
+                f"unknown mask kind {self.kind!r}; use causal|full|window")
+        if self.kind == "window":
+            if self.window is None or self.window < 1:
+                raise ValueError(
+                    f"window mask needs window >= 1, got {self.window}")
+        elif self.window is not None:
+            raise ValueError(f"mask kind {self.kind!r} takes no window")
+
+
+def as_mask_spec(mask, causal: bool = True) -> MaskSpec:
+    """Normalise the public ``mask=`` argument: ``None`` defers to the
+    ``causal`` flag; a string names a kind; ``("window", W)`` and
+    ``MaskSpec`` pass through validated."""
+    if mask is None:
+        return MaskSpec("causal" if causal else "full")
+    if isinstance(mask, MaskSpec):
+        return mask
+    if isinstance(mask, str):
+        return MaskSpec(mask)
+    if isinstance(mask, tuple) and len(mask) == 2 and mask[0] == "window":
+        return MaskSpec("window", int(mask[1]))
+    raise ValueError(
+        f"unknown mask {mask!r}; use None, 'causal'|'full', ('window', W) "
+        f"or a MaskSpec")
+
+
+@functools.lru_cache(maxsize=256)
+def block_liveness(spec: MaskSpec, nq: int, nk: int,
+                   block_q: int, block_k: int) -> np.ndarray:
+    """Per-(q-block, k-block) liveness map — the splash mask.
+
+    Generalises the old ``_causal_live`` arithmetic predicate to any static
+    mask spec: ``[nq, nk] int32`` of MASK_DEAD / MASK_PARTIAL / MASK_FULL,
+    computed host-side once per (spec, tiling) and fed to the kernels as an
+    SMEM input so every grid step reads its class with one scalar load.
+    """
+    if spec.kind == "full":
+        live = np.full((nq, nk), MASK_FULL, np.int32)
+    else:
+        qlo = np.arange(nq, dtype=np.int64)[:, None] * block_q
+        qhi = qlo + block_q - 1
+        klo = np.arange(nk, dtype=np.int64)[None, :] * block_k
+        khi = klo + block_k - 1
+        dead = klo > qhi                      # strictly above the diagonal
+        full = khi <= qlo                     # wholly at-or-below it
+        if spec.kind == "window":
+            w = spec.window
+            dead |= khi < qlo - (w - 1)       # wholly older than the window
+            full &= (qhi - klo) <= (w - 1)    # newest q still sees oldest k
+        live = np.where(dead, MASK_DEAD,
+                        np.where(full, MASK_FULL, MASK_PARTIAL)).astype(
+                            np.int32)
+    live.setflags(write=False)
+    return live
+
+
+def _liveness_for_grid(spec: MaskSpec, nq: int, nk: int, block_q: int,
+                       block_k: int, pipe: bool) -> jnp.ndarray:
+    """Liveness as the kernel grid sees it: per sub-tile normally, collapsed
+    to per-PAIR (max of the two halves) for the pipelined kernels."""
+    live = block_liveness(spec, nq, nk, block_q, block_k)
+    if pipe:
+        live = live.reshape(nq, nk // 2, 2).max(axis=-1)
+    return jnp.asarray(live)
+
+
+def splash_stats(spec: MaskSpec, s_q: int, s_k: int,
+                 block_q: int, block_k: int) -> dict:
+    """Dead/partial/full tile split of the splash map at a tiling — the
+    bench-capture number (``flash_splash_skip_frac`` = dead / total)."""
+    live = block_liveness(spec, s_q // block_q, s_k // block_k,
+                          block_q, block_k)
+    total = live.size
+    dead = int((live == MASK_DEAD).sum())
+    return {
+        "total": total,
+        "dead": dead,
+        "partial": int((live == MASK_PARTIAL).sum()),
+        "full": int((live == MASK_FULL).sum()),
+        "skip_frac": round(dead / max(total, 1), 4),
+    }
+
+
+def mask_live_frac(spec: MaskSpec, s: int) -> float:
+    """Fraction of the [S, S] score matrix the mask keeps live — the FLOP
+    billing factor for MFU accounting. Causal keeps the historical 0.5
+    convention (``train_step_flops`` billed S²/2 long before splash)."""
+    if spec.kind == "full":
+        return 1.0
+    if spec.kind == "causal":
+        return 0.5
+    w = min(spec.window, s)
+    live = w * (w + 1) // 2 + (s - w) * w
+    return live / float(s * s)
+
+
+# ------------------------------------------------------------ tile math
+
+def _tile_scores(q, k, qi, ki, *, scale, spec: MaskSpec,
+                 block_q, block_k):
+    """Scaled, mask-applied f32 scores for one (q-block × k-block) tile.
+
+    Shared by the forward and both backward paths so masking/precision can
+    never drift between them. The matmul keeps the input dtype on the MXU
+    and accumulates f32; the scale is applied to the f32 scores. The element
+    mask is applied to every non-full-kind tile (a bitwise no-op on fully
+    live tiles), so PARTIAL vs FULL never changes the traced code.
     """
     s = jax.lax.dot_general(
-        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale          # [bq, bk]
-    if causal:
+    if spec.kind != "full":
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        keep = q_pos >= k_pos
+        if spec.kind == "window":
+            keep = jnp.logical_and(keep, q_pos - k_pos < spec.window)
+        s = jnp.where(keep, s, NEG_INF)
     return s
 
 
@@ -105,79 +284,118 @@ def _masked_exp(s, ref):
     return jnp.where(s <= NEG_INF / 2, 0.0, p)
 
 
-def _causal_live(qi, ki, *, causal, block_q, block_k):
-    """Python-level predicate: does block (qi, ki) intersect the causal mask?
-
-    Evaluated on traced grid ids → returns a traced bool for ``pl.when``;
-    k-blocks strictly above the diagonal are skipped entirely.
-    """
-    if not causal:
-        return True
-    return ki * block_k <= qi * block_q + block_q - 1
-
-
 # ---------------------------------------------------------------- forward
 
-def _online_softmax_step(q_ref, k_ref, v_ref, qi, ki, m_scr, l_scr, acc_scr,
-                         *, scale, causal, block_q, block_k):
-    """ONE (q-block × k-block) fold of the flash recurrence, updating the
-    VMEM scratch state in place. The single definition of the numerically
-    sensitive update — shared by the normalising forward and the partial
-    (ring) forward so their numerics can never drift."""
-    s = _tile_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
-                     block_q=block_q, block_k=block_k)
+def _fold_scores(s, v, m_scr, l_scr, acc_scr):
+    """ONE online-softmax fold of precomputed scores ``s`` against values
+    ``v``, updating the VMEM scratch state in place. The single definition
+    of the numerically sensitive update — shared by the normalising forward,
+    the partial (ring) forward, and both pipeline modes, so their numerics
+    can never drift. Folding a fully-masked tile is a bitwise identity
+    (corr = 1, Σp = 0), which is what makes the pipelined kernels' identity
+    folds of dead pair-halves exact."""
     m_prev, l_prev = m_scr[:], l_scr[:]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
     p = _masked_exp(s, m_new)
     corr = jnp.exp(m_prev - m_new)
     l_scr[:] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
     acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)                  # [bq, d]
     m_scr[:] = m_new
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale: float, causal: bool,
-                block_q: int, block_k: int):
-    qi, ki = pl.program_id(1), pl.program_id(2)
-    nk = pl.num_programs(2)
+def _fwd_sweep(live_ref, q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, *,
+               scale, spec, block_q, block_k, pipe):
+    """Init + fold(s) for one forward grid step, shared by the normalising
+    and partial kernels. With ``pipe`` the K/V window holds a sub-tile PAIR
+    and both score dots are issued before either fold — the software
+    pipeline: the MXU runs sub-tile i+1's QKᵀ while the VPU folds i."""
+    qi, kj = pl.program_id(1), pl.program_id(2)
 
-    @pl.when(ki == 0)
+    @pl.when(kj == 0)
     def _init():
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    @pl.when(_causal_live(qi, ki, causal=causal, block_q=block_q,
-                          block_k=block_k))
+    @pl.when(live_ref[0, 0] != MASK_DEAD)
     def _compute():
-        _online_softmax_step(q_ref, k_ref, v_ref, qi, ki,
-                             m_scr, l_scr, acc_scr, scale=scale,
-                             causal=causal, block_q=block_q, block_k=block_k)
+        q = q_ref[0]
+        if not pipe:
+            s = _tile_scores(q, k_ref[0], qi, kj, scale=scale, spec=spec,
+                             block_q=block_q, block_k=block_k)
+            _fold_scores(s, v_ref[0], m_scr, l_scr, acc_scr)
+        else:
+            k0, k1 = k_ref[0, :block_k], k_ref[0, block_k:]
+            # both MXU dots issue BEFORE either sub-tile's VPU fold
+            s0 = _tile_scores(q, k0, qi, 2 * kj, scale=scale, spec=spec,
+                              block_q=block_q, block_k=block_k)
+            s1 = _tile_scores(q, k1, qi, 2 * kj + 1, scale=scale, spec=spec,
+                              block_q=block_q, block_k=block_k)
+            _fold_scores(s0, v_ref[0, :block_k], m_scr, l_scr, acc_scr)
+            _fold_scores(s1, v_ref[0, block_k:], m_scr, l_scr, acc_scr)
 
-    @pl.when(ki == nk - 1)
+
+def _fwd_kernel(live_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale: float, spec: MaskSpec,
+                block_q: int, block_k: int, pipe: bool):
+    _fwd_sweep(live_ref, q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+               scale=scale, spec=spec, block_q=block_q, block_k=block_k,
+               pipe=pipe)
+
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
     def _finalize():
         l = jnp.maximum(l_scr[:], 1e-30)
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
         lse_ref[0] = m_scr[:] + jnp.log(l)
 
 
-def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+def _fwd_partial_kernel(live_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                        m_scr, l_scr, acc_scr, *, scale: float,
+                        spec: MaskSpec, block_q: int, block_k: int,
+                        pipe: bool):
+    """Forward WITHOUT the final normalisation: emits the raw online-softmax
+    state (unnormalised accumulator, running max, running sum) so an outer
+    fold — ring attention's per-shard combine — can merge blocks exactly."""
+    _fwd_sweep(live_ref, q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+               scale=scale, spec=spec, block_q=block_q, block_k=block_k,
+               pipe=pipe)
+
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    def _finalize():
+        o_ref[0] = acc_scr[:]
+        m_ref[0] = m_scr[:]
+        l_ref[0] = l_scr[:]
+
+
+def _fwd_in_specs(d, block_q, block_k, pipe):
+    """Input specs shared by both forward kernels: splash map in SMEM, then
+    q / k / v block windows (K/V doubled when pipelined)."""
+    kw = 2 * block_k if pipe else block_k
+    return [
+        pl.BlockSpec((1, 1), lambda b, i, j: (i, j),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, kw, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, kw, d), lambda b, i, j: (b, j, 0)),
+    ]
+
+
+def _fwd(q, k, v, *, scale, spec, block_q, block_k, pipe, interpret):
     bh, s, d = q.shape
-    nq, nk = s // block_q, s // block_k
+    sk = k.shape[1]
+    nq, nk = s // block_q, sk // block_k
+    if pipe:
+        assert nk % 2 == 0, "pipelined forward needs an even K tiling"
+    live = _liveness_for_grid(spec, nq, nk, block_q, block_k, pipe)
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k)
-    grid = (bh, nq, nk)
+        _fwd_kernel, scale=scale, spec=spec,
+        block_q=block_q, block_k=block_k, pipe=pipe)
     o, lse = pl.pallas_call(
         kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        grid=(bh, nq, nk // 2 if pipe else nk),
+        in_specs=_fwd_in_specs(d, block_q, block_k, pipe),
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -193,43 +411,15 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_q, d), jnp.float32),   # output accumulator
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(live, q, k, v)
     return o, lse
 
 
 # -------------------------------------------------- partial forward (ring)
 
-def _fwd_partial_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
-                        m_scr, l_scr, acc_scr, *, scale: float, causal: bool,
-                        block_q: int, block_k: int):
-    """Forward WITHOUT the final normalisation: emits the raw online-softmax
-    state (unnormalised accumulator, running max, running sum) so an outer
-    fold — ring attention's per-shard combine — can merge blocks exactly."""
-    qi, ki = pl.program_id(1), pl.program_id(2)
-    nk = pl.num_programs(2)
-
-    @pl.when(ki == 0)
-    def _init():
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
-
-    @pl.when(_causal_live(qi, ki, causal=causal, block_q=block_q,
-                          block_k=block_k))
-    def _compute():
-        _online_softmax_step(q_ref, k_ref, v_ref, qi, ki,
-                             m_scr, l_scr, acc_scr, scale=scale,
-                             causal=causal, block_q=block_q, block_k=block_k)
-
-    @pl.when(ki == nk - 1)
-    def _finalize():
-        o_ref[0] = acc_scr[:]
-        m_ref[0] = m_scr[:]
-        l_ref[0] = l_scr[:]
-
-
 def flash_partial(q, k, v, *, scale: float, causal: bool,
-                  block_q: int, block_k: int, interpret: bool):
+                  block_q: int, block_k: int, interpret: bool,
+                  mask=None, pipeline: bool = False):
     """One flash sweep of ``q``×(``k``,``v``) in ``[bh, s, d]`` layout,
     returning the UNNORMALISED state ``(o_acc f32, m f32, l f32)`` with
     shapes ``[bh, sq, d], [bh, sq, 1], [bh, sq, 1]``.
@@ -238,21 +428,23 @@ def flash_partial(q, k, v, *, scale: float, causal: bool,
     attention feeds one visiting K/V block per call); ``causal`` masks in
     LOCAL positions, which is exactly right for the ring's diagonal block
     (q and k share the same global offset there) and unused for its
-    fully-visible blocks.
+    fully-visible blocks. ``pipeline`` runs the paired-sub-tile kernel
+    (requires an even K tiling).
     """
     bh, sq, d = q.shape
     sk = k.shape[1]
+    spec = as_mask_spec(mask, causal)
+    nq, nk = sq // block_q, sk // block_k
+    if pipeline:
+        assert nk % 2 == 0, "pipelined flash_partial needs an even K tiling"
+    live = _liveness_for_grid(spec, nq, nk, block_q, block_k, pipeline)
     kernel = functools.partial(
-        _fwd_partial_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k)
+        _fwd_partial_kernel, scale=scale, spec=spec,
+        block_q=block_q, block_k=block_k, pipe=pipeline)
     return pl.pallas_call(
         kernel,
-        grid=(bh, sq // block_q, sk // block_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        grid=(bh, nq, nk // 2 if pipeline else nk),
+        in_specs=_fwd_in_specs(d, block_q, block_k, pipeline),
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -269,26 +461,25 @@ def flash_partial(q, k, v, *, scale: float, causal: bool,
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(live, q, k, v)
 
 
 # ------------------------------------------------------------- backward
 
-def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki, *,
-              scale, causal, block_q, block_k):
-    """Rematerialised P and dS for one tile (shared by dq and dk/dv)."""
-    s = _tile_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+def _bwd_tile(q, k, v, do, lse, delta, qi, ki, *,
+              scale, spec, block_q, block_k):
+    """Rematerialised P and dS for one tile (shared by the split kernels)."""
+    s = _tile_scores(q, k, qi, ki, scale=scale, spec=spec,
                      block_q=block_q, block_k=block_k)
-    p = _masked_exp(s, lse_ref[0])                           # [bq, bk]
-    do = do_ref[0]
-    dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
+    p = _masked_exp(s, lse)                                  # [bq, bk]
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-    ds = p * (dp - delta_ref[0])                             # [bq, bk] f32
-    return p, ds, do
+    ds = p * (dp - delta)                                    # [bq, bk] f32
+    return p, ds
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               acc_scr, *, scale: float, causal: bool,
+def _dq_kernel(live_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, acc_scr, *, scale: float, spec: MaskSpec,
                block_q: int, block_k: int):
     qi, ki = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
@@ -297,12 +488,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    @pl.when(_causal_live(qi, ki, causal=causal, block_q=block_q,
-                          block_k=block_k))
+    @pl.when(live_ref[0, 0] != MASK_DEAD)
     def _compute():
-        _, ds, _ = _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                             qi, ki, scale=scale, causal=causal,
-                             block_q=block_q, block_k=block_k)
+        _, ds = _bwd_tile(q_ref[0], k_ref[0], v_ref[0], do_ref[0],
+                          lse_ref[0], delta_ref[0], qi, ki, scale=scale,
+                          spec=spec, block_q=block_q, block_k=block_k)
         acc_scr[:] = acc_scr[:] + jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -312,9 +502,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = (acc_scr[:] * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dkv_kernel(live_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
-                causal: bool, block_q: int, block_k: int):
+                spec: MaskSpec, block_q: int, block_k: int):
     ki, qi = pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
 
@@ -323,12 +513,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    @pl.when(_causal_live(qi, ki, causal=causal, block_q=block_q,
-                          block_k=block_k))
+    @pl.when(live_ref[0, 0] != MASK_DEAD)
     def _compute():
-        p, ds, do = _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                              qi, ki, scale=scale, causal=causal,
-                              block_q=block_q, block_k=block_k)
+        do = do_ref[0]
+        p, ds = _bwd_tile(q_ref[0], k_ref[0], v_ref[0], do,
+                          lse_ref[0], delta_ref[0], qi, ki, scale=scale,
+                          spec=spec, block_q=block_q, block_k=block_k)
         # dV += Pᵀ dO
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -341,94 +531,146 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(qi == nq - 1)
     def _finalize():
         dk_ref[0] = (dk_scr[:] * scale).astype(dk_ref.dtype)
-        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+        dv_ref[0] = (dv_scr[:]).astype(dv_ref.dtype)
 
 
-def _fused_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, dk_ref, dv_ref, dq_scr, dk_scr, dv_scr, *,
-                      scale: float, causal: bool, block_q: int, block_k: int):
-    """Single-pass backward: dq, dk, dv from ONE sweep of the (qi, ki) grid.
+def _fused_sub_tile(s, dp, do, q, k, lse, delta, ki, dq_scr, dk_scr, dv_scr):
+    """VPU dS + the three gradient accumulations for one sub-tile of the
+    fused backward, given the (possibly hoisted) MXU front dots ``s``/``dp``.
+    A fully-masked sub-tile contributes exact zeros (P = 0 ⇒ dS = 0), so
+    folding it is a bitwise identity on every accumulator."""
+    p = _masked_exp(s, lse)
+    ds = p * (dp - delta)
+    # dQ += dS K: folded over the inner k sweep, like the forward's o
+    dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    # dV[ki] += Pᵀ dO, dK[ki] += dSᵀ Q: folded over the outer q sweep
+    dv_scr[ki] = dv_scr[ki] + jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dk_scr[ki] = dk_scr[ki] + jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _fused_bwd_kernel(live_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                      delta_ref, dq_ref, dk_ref, dv_ref,
+                      dq_scr, dk_scr, dv_scr, *, scale: float,
+                      spec: MaskSpec, block_q: int, block_k: int,
+                      pipe: bool):
+    """Single-pass backward: dq, dk, dv from ONE sweep of the (qi, kj) grid.
 
     P/dS are materialised once per tile and feed all three accumulators.
     dq lives in a per-q-block scratch across the inner k sweep; dk/dv live
     in full-K-length scratches across the outer q sweep (slice ``ki`` per
-    tile) and each k-block is emitted on the last q row, so every output
+    sub-tile) and each k-block is emitted on the last q row, so every output
     block's cast/write-back overlaps the next tile's dots via the output
-    pipeline's double buffering (see the module docstring).
+    pipeline's double buffering. With ``pipe`` each grid step consumes a k
+    sub-tile PAIR with all four MXU front dots hoisted ahead of the VPU dS
+    work (see the module docstring).
     """
-    qi, ki = pl.program_id(1), pl.program_id(2)
-    nq, nk = pl.num_programs(1), pl.num_programs(2)
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    nq, nkg = pl.num_programs(1), pl.num_programs(2)
 
-    @pl.when(ki == 0)
+    @pl.when(kj == 0)
     def _init_dq():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    @pl.when(jnp.logical_and(qi == 0, ki == 0))
+    @pl.when(jnp.logical_and(qi == 0, kj == 0))
     def _init_dkv():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    @pl.when(_causal_live(qi, ki, causal=causal, block_q=block_q,
-                          block_k=block_k))
+    @pl.when(live_ref[0, 0] != MASK_DEAD)
     def _compute():
-        p, ds, do = _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                              qi, ki, scale=scale, causal=causal,
+        q, do = q_ref[0], do_ref[0]
+        lse, delta = lse_ref[0], delta_ref[0]
+        if not pipe:
+            k, v = k_ref[0], v_ref[0]
+            s = _tile_scores(q, k, qi, kj, scale=scale, spec=spec,
+                             block_q=block_q, block_k=block_k)
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            _fused_sub_tile(s, dp, do, q, k, lse, delta, kj,
+                            dq_scr, dk_scr, dv_scr)
+        else:
+            k0, k1 = k_ref[0, :block_k], k_ref[0, block_k:]
+            v0, v1 = v_ref[0, :block_k], v_ref[0, block_k:]
+            # all four MXU front dots issue BEFORE either sub-tile's VPU
+            # dS work — the backward half of the software pipeline
+            s0 = _tile_scores(q, k0, qi, 2 * kj, scale=scale, spec=spec,
                               block_q=block_q, block_k=block_k)
-        # dQ += dS K: folded over the inner k sweep, like the forward's o
-        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
-            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        # dV[ki] += Pᵀ dO, dK[ki] += dSᵀ Q: folded over the outer q sweep
-        dv_scr[ki] = dv_scr[ki] + jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dk_scr[ki] = dk_scr[ki] + jax.lax.dot_general(
-            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            s1 = _tile_scores(q, k1, qi, 2 * kj + 1, scale=scale, spec=spec,
+                              block_q=block_q, block_k=block_k)
+            dp0 = jax.lax.dot_general(do, v0, (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+            dp1 = jax.lax.dot_general(do, v1, (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+            _fused_sub_tile(s0, dp0, do, q, k0, lse, delta, 2 * kj,
+                            dq_scr, dk_scr, dv_scr)
+            _fused_sub_tile(s1, dp1, do, q, k1, lse, delta, 2 * kj + 1,
+                            dq_scr, dk_scr, dv_scr)
 
-    @pl.when(ki == nk - 1)
+    @pl.when(kj == nkg - 1)
     def _emit_dq():
         dq_ref[0] = (dq_scr[:] * scale).astype(dq_ref.dtype)
 
-    # every k-block is live on the last q row (causal or not), so the full
-    # accumulation for slice ki is complete exactly when (nq-1, ki) runs;
-    # earlier rows' write-backs of this rotating block are dead stores the
-    # final row overwrites — the price of letting the pipeline overlap them
+    # the full accumulation for each k slice is complete once the last q row
+    # has run; earlier rows' write-backs of the rotating output block are
+    # dead stores the final row overwrites — the price of letting the
+    # pipeline overlap them. (Emission is unconditional on liveness: a
+    # dead (last-row, k) tile still owns its slice's write-back.)
     @pl.when(qi == nq - 1)
     def _emit_dkv():
-        dk_ref[0] = (dk_scr[ki] * scale).astype(dk_ref.dtype)
-        dv_ref[0] = dv_scr[ki].astype(dv_ref.dtype)
+        if not pipe:
+            dk_ref[0] = (dk_scr[kj] * scale).astype(dk_ref.dtype)
+            dv_ref[0] = dv_scr[kj].astype(dv_ref.dtype)
+        else:
+            dk_ref[0, :block_k] = (dk_scr[2 * kj] * scale).astype(
+                dk_ref.dtype)
+            dk_ref[0, block_k:] = (dk_scr[2 * kj + 1] * scale).astype(
+                dk_ref.dtype)
+            dv_ref[0, :block_k] = dv_scr[2 * kj].astype(dv_ref.dtype)
+            dv_ref[0, block_k:] = dv_scr[2 * kj + 1].astype(dv_ref.dtype)
 
 
 # ------------------------------------------------------ public wrapper
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_bhsd(q, k, v, scale, causal, block_q, block_k, interpret,
-                backward):
-    o, _ = _fwd(q, k, v, scale=scale, causal=causal,
-                block_q=block_q, block_k=block_k, interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_bhsd(q, k, v, scale, spec, block_q, block_k, interpret,
+                backward, pipe):
+    o, _ = _fwd(q, k, v, scale=scale, spec=spec,
+                block_q=block_q, block_k=block_k, pipe=pipe,
+                interpret=interpret)
     return o
 
 
-def _flash_bhsd_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
-                    backward):
-    o, lse = _fwd(q, k, v, scale=scale, causal=causal,
-                  block_q=block_q, block_k=block_k, interpret=interpret)
+def _flash_bhsd_fwd(q, k, v, scale, spec, block_q, block_k, interpret,
+                    backward, pipe):
+    o, lse = _fwd(q, k, v, scale=scale, spec=spec,
+                  block_q=block_q, block_k=block_k, pipe=pipe,
+                  interpret=interpret)
     return o, (q, k, v, o, lse)
 
 
 def flash_dq(q, k, v, do, lse, delta, *, scale, causal, block_q, block_k,
-             interpret, out_dtype=None):
+             interpret, mask=None, out_dtype=None):
     """dQ for ``q``×(``k``,``v``) in ``[bh, s, d]`` layout; reusable by the
     ring backward (per visiting K/V block, f32 out for cross-step
     accumulation) and the monolithic VJP below."""
     bh, sq, d = q.shape
     sk = k.shape[1]
+    spec = as_mask_spec(mask, causal)
+    nq, nk = sq // block_q, sk // block_k
+    live = _liveness_for_grid(spec, nq, nk, block_q, block_k, False)
     return pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal,
+        functools.partial(_dq_kernel, scale=scale, spec=spec,
                           block_q=block_q, block_k=block_k),
-        grid=(bh, sq // block_q, sk // block_k),
+        grid=(bh, nq, nk),
         in_specs=[
+            pl.BlockSpec((1, 1), lambda b, i, j: (i, j),
+                         memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
@@ -440,19 +682,24 @@ def flash_dq(q, k, v, do, lse, delta, *, scale, causal, block_q, block_k,
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), out_dtype or q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(live, q, k, v, do, lse, delta)
 
 
 def flash_dkv(q, k, v, do, lse, delta, *, scale, causal, block_q, block_k,
-              interpret, out_dtype=None):
+              interpret, mask=None, out_dtype=None):
     """(dK, dV) in ``[bh, s, d]`` layout; see ``flash_dq``."""
     bh, sq, d = q.shape
     sk = k.shape[1]
+    spec = as_mask_spec(mask, causal)
+    nq, nk = sq // block_q, sk // block_k
+    live = _liveness_for_grid(spec, nq, nk, block_q, block_k, False)
     return pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+        functools.partial(_dkv_kernel, scale=scale, spec=spec,
                           block_q=block_q, block_k=block_k),
-        grid=(bh, sk // block_k, sq // block_q),
+        grid=(bh, nk, nq),
         in_specs=[
+            pl.BlockSpec((1, 1), lambda b, j, i: (i, j),
+                         memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -471,37 +718,46 @@ def flash_dkv(q, k, v, do, lse, delta, *, scale, causal, block_q, block_k,
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(live, q, k, v, do, lse, delta)
 
 
 def flash_dqdkv(q, k, v, do, lse, delta, *, scale, causal, block_q, block_k,
-                interpret, out_dtype=None):
+                interpret, mask=None, pipeline: bool = False,
+                out_dtype=None):
     """(dQ, dK, dV) from the fused single-pass kernel, ``[bh, s, d]`` layout.
 
     One ``pallas_call``: P/dS once per tile instead of the split path's
-    twice; see ``_fused_bwd_kernel``. Reusable by the ring backward (per
-    visiting K/V block, f32 out for cross-step accumulation) and the
-    monolithic VJP below.
+    twice; see ``_fused_bwd_kernel``. ``pipeline`` runs the paired-sub-tile
+    software-pipelined body (requires an even K tiling). Reusable by the
+    ring backward (per visiting K/V block, f32 out for cross-step
+    accumulation) and the monolithic VJP below.
     """
     bh, sq, d = q.shape
     sk = k.shape[1]
-    nk = sk // block_k
+    spec = as_mask_spec(mask, causal)
+    nq, nk = sq // block_q, sk // block_k
+    if pipeline:
+        assert nk % 2 == 0, "pipelined flash_dqdkv needs an even K tiling"
+    live = _liveness_for_grid(spec, nq, nk, block_q, block_k, pipeline)
+    kw = 2 * block_k if pipeline else block_k
     return pl.pallas_call(
-        functools.partial(_fused_bwd_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
-        grid=(bh, sq // block_q, nk),
+        functools.partial(_fused_bwd_kernel, scale=scale, spec=spec,
+                          block_q=block_q, block_k=block_k, pipe=pipeline),
+        grid=(bh, nq, nk // 2 if pipeline else nk),
         in_specs=[
+            pl.BlockSpec((1, 1), lambda b, i, j: (i, j),
+                         memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, kw, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, kw, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, kw, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, kw, d), lambda b, i, j: (b, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), out_dtype or q.dtype),
@@ -514,16 +770,18 @@ def flash_dqdkv(q, k, v, do, lse, delta, *, scale, causal, block_q, block_k,
             pltpu.VMEM((nk, block_k, d), jnp.float32),   # dv, full K length
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(live, q, k, v, do, lse, delta)
 
 
-def flash_backward(q, k, v, o, do, lse, *, scale, causal, block_q, block_k,
-                   interpret, backward: str = "fused", out_dtype=None):
+def flash_backward(q, k, v, o, do, lse, *, scale, causal=True, block_q,
+                   block_k, interpret, backward: str = "fused",
+                   mask=None, pipeline: bool = False, out_dtype=None):
     """Full flash backward — delta reduction + the selected kernel path.
 
     The one entry point both the monolithic VJP and callers that hold their
-    own residuals use; ``backward`` picks ``"fused"`` (single pass) or
-    ``"split"`` (dq then dkv, the historical two-kernel design).
+    own residuals use; ``backward`` picks ``"fused"`` (single pass,
+    optionally pipelined) or ``"split"`` (dq then dkv, the historical
+    two-kernel design — never pipelined).
     """
     # delta = rowsum(dO ⊙ O): a cheap fused XLA reduction, computed once
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
@@ -533,25 +791,28 @@ def flash_backward(q, k, v, o, do, lse, *, scale, causal, block_q, block_k,
         # through to the split kernels would be a silent de-optimisation
         raise ValueError(
             f"unknown backward impl {backward!r}; use fused|split")
-    kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-              interpret=interpret, out_dtype=out_dtype)
+    kw = dict(scale=scale, causal=causal, mask=mask, block_q=block_q,
+              block_k=block_k, interpret=interpret, out_dtype=out_dtype)
     if backward == "fused":
-        return flash_dqdkv(q, k, v, do, lse, delta, **kw)
+        return flash_dqdkv(q, k, v, do, lse, delta, pipeline=pipeline, **kw)
     dq = flash_dq(q, k, v, do, lse, delta, **kw)
     dk, dv = flash_dkv(q, k, v, do, lse, delta, **kw)
     return dq, dk, dv
 
 
-def _flash_bhsd_bwd(scale, causal, block_q, block_k, interpret, backward,
-                    res, do):
+def _flash_bhsd_bwd(scale, spec, block_q, block_k, interpret, backward,
+                    pipe, res, do):
     q, k, v, o, lse = res
-    return flash_backward(q, k, v, o, do, lse, scale=scale, causal=causal,
+    return flash_backward(q, k, v, o, do, lse, scale=scale, mask=spec,
                           block_q=block_q, block_k=block_k,
-                          interpret=interpret, backward=backward)
+                          interpret=interpret, backward=backward,
+                          pipeline=pipe)
 
 
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 
+
+# ------------------------------------------------- block-size selection
 
 def _fit_block(s: int, want: int | None) -> int:
     """Largest divisor of ``s`` ≤ ``want`` that is a multiple of 8; ``None``
@@ -563,8 +824,8 @@ def _fit_block(s: int, want: int | None) -> int:
     0.40 vs 0.21 MXU fraction) and the backward 1.4× (3.64 vs 5.17 ms);
     at S=2048 the 512×1024 shape wins; 2048-blocks fail to compile
     (VMEM). The None default is therefore ``min(1024, max(128, S/4))``
-    — the q-block rule; ``flash_attention`` widens the K default to
-    ``S/2`` (K tiles amortise across the q sweep). Candidates step down
+    — the q-block rule; the K default is budget-computed by
+    ``auto_blocks`` (widest K whose VMEM plan fits). Candidates step down
     in units of 8 (the f32 sublane) so a non-tileable divisor like 125
     (S=250) — which compiles under CPU interpret but real-TPU pallas
     rejects or badly pads — can never be picked; sequences with no
@@ -581,32 +842,162 @@ def _fit_block(s: int, want: int | None) -> int:
     return b if b >= 8 else 0
 
 
+def flash_fwd_vmem_bytes(block_q: int, block_k: int, d: int, itemsize: int,
+                         *, pipe: bool) -> int:
+    """Deterministic VMEM plan of the forward kernel at a block shape:
+    double-buffered block windows (K/V doubled under the pipeline), the
+    f32 scratch accumulators, and the in-flight f32 score tiles (two when
+    pipelined — the hoisted dot is the pipeline's footprint cost)."""
+    kw = (2 if pipe else 1) * block_k
+    win = (2 * block_q * d * itemsize          # q in
+           + 2 * kw * d * itemsize * 2         # k, v in
+           + 2 * block_q * d * itemsize        # o out
+           + 2 * block_q * 4)                  # lse out
+    scr = 2 * block_q * 4 + block_q * d * 4    # m, l, o accumulator
+    tiles = (2 if pipe else 1) * block_q * block_k * 4
+    return win + scr + tiles
+
+
+def flash_bwd_vmem_bytes(block_q: int, block_k: int, s_k: int, d: int,
+                         itemsize: int, *, pipe: bool) -> int:
+    """VMEM plan of the fused backward — the binding kernel of a train
+    step: adds the dO/dQ/dK/dV windows and the full-K-length f32 dk/dv
+    scratches (``2·S_k·d·4`` bytes) to the forward's costs."""
+    kw = (2 if pipe else 1) * block_k
+    win = (2 * block_q * d * itemsize * 3      # q, do in; dq out
+           + 2 * kw * d * itemsize * 4         # k, v in; dk, dv out
+           + 2 * block_q * 4 * 2)              # lse, delta in
+    scr = block_q * d * 4 + 2 * s_k * d * 4    # dq acc + full-K dk/dv
+    tiles = (2 if pipe else 1) * block_q * block_k * 4
+    return win + scr + tiles
+
+
+def flash_vmem_bytes(block_q: int, block_k: int, s_k: int, d: int,
+                     itemsize: int, *, pipe: bool) -> int:
+    """Worst-kernel VMEM plan for a train step at a block shape."""
+    return max(
+        flash_fwd_vmem_bytes(block_q, block_k, d, itemsize, pipe=pipe),
+        flash_bwd_vmem_bytes(block_q, block_k, s_k, d, itemsize, pipe=pipe))
+
+
+def auto_blocks(s: int, d: int, itemsize: int, *, pipe: bool,
+                want_q: int | None = None,
+                budget: int | None = None) -> tuple[int, int, bool]:
+    """VMEM-budget-aware default block selection → (block_q, block_k,
+    pipelined).
+
+    block_q follows the measured v5e q rule (``_fit_block(s, None)``);
+    block_k is the WIDEST 8-multiple divisor of S ≤ ``K_BLOCK_CAP`` with at
+    least two K blocks whose ``flash_vmem_bytes`` plan fits the budget —
+    the old ``S/2``-cap-1024 table entry becomes a computed consequence.
+    With ``pipe`` only even K tilings qualify (the kernel consumes sub-tile
+    pairs); if none fits, the selection retries unpipelined and reports
+    ``pipelined=False`` so ``pipeline="auto"`` degrades instead of failing.
+    """
+    budget = FLASH_VMEM_BUDGET if budget is None else budget
+    if s <= 8:
+        return _fit_block(s, want_q), s, False
+    bq0 = _fit_block(s, want_q)
+    if bq0 < 8:
+        return bq0, 0, False      # no tileable divisor: caller raises
+    k_top = min(s // 2, K_BLOCK_CAP)
+    k_top -= k_top % 8            # candidates must stay sublane-aligned
+    k_cands = [b for b in range(k_top, 7, -8) if s % b == 0]
+    if not k_cands:
+        return bq0, 0, False
+    q_cands = ([bq0] if want_q is not None else
+               [b for b in range(bq0, 7, -8) if s % b == 0])
+    for bq in q_cands:
+        for bk in k_cands:
+            if pipe and (s // bk) % 2:
+                continue
+            if flash_vmem_bytes(bq, bk, s, d, itemsize,
+                                pipe=pipe) <= budget:
+                return bq, bk, pipe
+    if pipe:
+        # no even-nk tiling fits: degrade to the unpipelined selection
+        bq, bk, _ = auto_blocks(s, d, itemsize, pipe=False, want_q=want_q,
+                                budget=budget)
+        return bq, bk, False
+    # nothing fits the budget (pathological d): smallest legal blocks
+    return q_cands[-1], k_cands[-1], False
+
+
+def _resolve_pipeline(pipeline: str, s: int, block_k: int, *,
+                      block_q: int = 0, d: int = 0, itemsize: int = 0,
+                      s_k: int | None = None) -> bool:
+    """Feasibility of the paired-sub-tile kernels at FITTED explicit blocks.
+
+    ``"auto"`` additionally requires the PIPELINED VMEM plan to fit the
+    budget (the doubled K/V window is not free: 1024×1024 explicit blocks
+    at S=4096, d=128 fit serial but overflow pipelined — auto must degrade
+    to serial there, exactly like ``auto_blocks`` would). ``"on"`` is an
+    explicit operator demand and only enforces the structural even-tiling
+    requirement — the budget is a planning model, and block sweeps need to
+    be able to probe past it deliberately.
+    """
+    if pipeline == "off":
+        return False
+    nk = (s // block_k) if block_k else 0
+    feasible = s > 8 and block_k >= 8 and nk >= 2 and nk % 2 == 0
+    if pipeline == "on":
+        if not feasible:
+            raise ValueError(
+                f"pipeline='on' needs an even number of K blocks (>= 2); "
+                f"block_k={block_k} gives {nk} over seq len {s} — pass an "
+                f"even-tiling block_k or pad the sequence")
+        return True
+    if feasible and block_q and d and itemsize:
+        feasible = flash_vmem_bytes(
+            block_q, block_k, s_k if s_k is not None else s, d, itemsize,
+            pipe=True) <= FLASH_VMEM_BUDGET
+    return feasible
+
+
 def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
                     block_q: int | None = None, block_k: int | None = None,
                     interpret: bool | None = None,
-                    backward: str = "fused"):
+                    backward: str = "fused",
+                    pipeline: str = "auto",
+                    mask=None):
     """Fused flash attention on ``[B, S, H, D]`` inputs (burn-in layout).
 
-    Blocks default to a measured size heuristic and shrink to the largest
-    divisor of S ≤ the requested size, so any sequence length works; sizes
-    that leave no MXU-tileable divisor (< 8 for an S > 8) are rejected.
-    ``backward`` selects the VJP kernels: ``"fused"`` (default; one
-    single-pass pallas kernel, P/dS once per tile) or ``"split"`` (the
+    Blocks default to the VMEM-budget selection (``auto_blocks``) and shrink
+    to the largest divisor of S ≤ the requested size, so any sequence length
+    works; sizes that leave no MXU-tileable divisor (< 8 for an S > 8) are
+    rejected. ``backward`` selects the VJP kernels: ``"fused"`` (default;
+    one single-pass pallas kernel, P/dS once per tile) or ``"split"`` (the
     historical dq + dkv two-kernel path, kept for A/B timing and the
-    differential-correctness oracle). Returns ``[B, S, H, D]`` in the
-    input dtype.
+    differential-correctness oracle). ``pipeline`` selects the
+    software-pipelined paired-sub-tile kernels: ``"auto"`` (default; on
+    whenever the K tiling has an even number of blocks), ``"on"`` (raise if
+    infeasible), ``"off"`` — on/off bit-match at equal block sizes. ``mask``
+    is a splash mask spec (``None`` defers to ``causal``; ``"causal"``,
+    ``"full"``, ``("window", W)`` or a :class:`MaskSpec`): dead blocks are
+    skipped at block granularity in forward and backward. Returns
+    ``[B, S, H, D]`` in the input dtype.
     """
     b, s, h, d = q.shape
     if backward not in ("fused", "split"):
         raise ValueError(
             f"unknown backward impl {backward!r}; use fused|split")
+    if pipeline not in ("auto", "on", "off"):
+        raise ValueError(
+            f"unknown pipeline mode {pipeline!r}; use auto|on|off")
+    spec = as_mask_spec(mask, causal)
+    itemsize = jnp.dtype(q.dtype).itemsize
     if block_k is None:
-        # K blocks default wider than q blocks (S/2 vs S/4, cap 1024):
-        # each K tile is DMA'd once per q-block sweep, so fatter K tiles
-        # amortise better — measured best at S=2048 (512×1024) and tied
-        # at S=4096 (1024×1024); see _fit_block
-        block_k = min(1024, max(128, s // 2))
-    block_q, block_k = _fit_block(s, block_q), _fit_block(s, block_k)
+        want_pipe = pipeline != "off"
+        block_q, block_k, pipe = auto_blocks(
+            s, d, itemsize, pipe=want_pipe, want_q=block_q)
+        if pipeline == "on" and not pipe:
+            raise ValueError(
+                f"pipeline='on': seq len {s} has no even K tiling inside "
+                f"the VMEM budget — pass block_k explicitly or pad")
+    else:
+        block_q, block_k = _fit_block(s, block_q), _fit_block(s, block_k)
+        pipe = _resolve_pipeline(pipeline, s, block_k, block_q=block_q,
+                                 d=d, itemsize=itemsize)
     if s > 8 and (block_q < 8 or block_k < 8):
         raise ValueError(
             f"seq len {s} has no block divisor in [8, 128]; pad the sequence")
@@ -625,8 +1016,8 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
     def to_bhsd(t):
         return t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
-    o = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), scale, causal,
-                    block_q, block_k, interpret, backward)
+    o = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), scale, spec,
+                    block_q, block_k, interpret, backward, pipe)
     return o.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 

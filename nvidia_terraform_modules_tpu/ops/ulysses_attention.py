@@ -47,7 +47,10 @@ from .ring_attention import dense_reference_attention
 def ulysses_attention_kernel(q, k, v, *, axis_name: str, causal: bool = True,
                              scale: float | None = None, impl: str = "dense",
                              interpret: bool | None = None,
-                             backward: str = "fused"):
+                             backward: str = "fused",
+                             pipeline: str = "auto",
+                             block_q: int | None = None,
+                             block_k: int | None = None):
     """Per-shard Ulysses body; call inside ``shard_map``.
 
     Args:
@@ -61,6 +64,10 @@ def ulysses_attention_kernel(q, k, v, *, axis_name: str, causal: bool = True,
       impl: local attention tile math — "flash" (pallas) or "dense".
       backward: the flash impl's backward kernels ("fused" single-pass
         default, "split" — see ops/flash_attention.py); unused by dense.
+      pipeline: the flash impl's software-pipelined sweeps (auto|on|off —
+        see ops/flash_attention.py); unused by dense.
+      block_q, block_k: explicit flash tile sizes (None = the VMEM-budget
+        autoshrink) for chip sweeps; unused by dense.
 
     Returns ``[B, S_local, H_local, D]`` in ``q.dtype``.
     """
@@ -88,7 +95,9 @@ def ulysses_attention_kernel(q, k, v, *, axis_name: str, causal: bool = True,
         q, k, v = seq_to_heads(jnp.stack((q, k, v)))
     if impl == "flash":
         out = flash_attention(q, k, v, causal=causal, scale=scale,
-                              interpret=interpret, backward=backward)
+                              interpret=interpret, backward=backward,
+                              pipeline=pipeline, block_q=block_q,
+                              block_k=block_k)
     else:
         out = dense_reference_attention(q, k, v, causal=causal, scale=scale)
     if sp > 1:
@@ -101,7 +110,10 @@ def ulysses_self_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
                            spec: P = P("dp", "sp", "tp", None),
                            scale: float | None = None,
                            impl: str | None = None,
-                           backward: str = "fused"):
+                           backward: str = "fused",
+                           pipeline: str = "auto",
+                           block_q: int | None = None,
+                           block_k: int | None = None):
     """shard_map wrapper: exact attention with sequence sharded on ``axis_name``
     via head-scatter/sequence-gather all-to-alls (DeepSpeed-Ulysses layout).
 
@@ -110,7 +122,9 @@ def ulysses_self_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
     way ``ring_self_attention`` does: ``"flash"``, ``"dense"``, or ``None``
     (flash when the FULL sequence tiles into 8-multiple blocks — after the
     all-to-all the local problem has global sequence length); ``backward``
-    picks the flash impl's backward kernels (fused|split).
+    picks the flash impl's backward kernels (fused|split), ``pipeline``
+    its software-pipelined sweeps (auto|on|off), and ``block_q``/``block_k``
+    override its tile sizes for chip tuning.
     """
     sp = mesh.shape[axis_name]
     heads = q.shape[2]
@@ -127,7 +141,8 @@ def ulysses_self_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
     impl = pick_impl(impl, q.shape[1], "ulysses")
     kernel = functools.partial(
         ulysses_attention_kernel, axis_name=axis_name, causal=causal,
-        scale=scale, impl=impl, backward=backward,
+        scale=scale, impl=impl, backward=backward, pipeline=pipeline,
+        block_q=block_q, block_k=block_k,
     )
     return shard_map(
         kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

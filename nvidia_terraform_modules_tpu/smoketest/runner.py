@@ -367,6 +367,42 @@ def _run_smoketest(
                     checks["serve_engine_ok"] = False
                     checks["serve_engine_error"] = str(exc)
                 ok &= checks["serve_engine_ok"]
+
+            # flash pipeline gate: the software-pipelined kernels
+            # (ops/flash_attention.py, pipeline="on") are contractually a
+            # SCHEDULING change — same sub-tile folds, same arithmetic —
+            # so a few train steps of a tiny flash config must BIT-match
+            # the unpipelined kernels at equal block sizes, on this
+            # slice's real lowering. Gates the kernel rewrite on chip
+            # before a long burn-in trusts it. Tiny, unsharded and
+            # process-local on purpose (no collectives — every host
+            # validates independently at any world size).
+            if checks["burnin_ok"]:
+                try:
+                    base = dict(vocab=64, d_model=32, n_heads=2, d_ff=64,
+                                n_layers=2, seq_len=32, batch=4,
+                                dtype=jax.numpy.float32, attn="flash",
+                                flash_block_q=16, flash_block_k=8)
+                    runs = {}
+                    for mode in ("on", "off"):
+                        pcfg = BurnInConfig(**base, flash_pipeline=mode)
+                        pparams = init_params(jax.random.PRNGKey(9), pcfg)
+                        pstep = make_train_step(pcfg)
+                        pbatch = synthetic_batch(jax.random.PRNGKey(10),
+                                                 pcfg)
+                        for _ in range(2):
+                            pparams, ploss = pstep(pparams, pbatch)
+                        runs[mode] = (pparams, ploss)
+                    leaves_on = jax.tree.leaves(runs["on"])
+                    leaves_off = jax.tree.leaves(runs["off"])
+                    bit_match = all(
+                        bool(jax.device_get(jax.numpy.array_equal(a, b)))
+                        for a, b in zip(leaves_on, leaves_off))
+                    checks["flash_pipeline_ok"] = bit_match
+                except Exception as exc:  # JSON contract > the type
+                    checks["flash_pipeline_ok"] = False
+                    checks["flash_pipeline_error"] = str(exc)
+                ok &= checks["flash_pipeline_ok"]
             if ckpt is not None and ok:
                 try:
                     checks["burnin_checkpoint_cleared"] = ckpt.clear()

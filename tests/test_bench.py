@@ -61,6 +61,28 @@ def test_run_section_devinfo_roundtrip():
     assert result["platform"] == "cpu" and result["devices"] >= 1
 
 
+def test_section_flash_bwd_schema_and_splash_frac():
+    """Tier-1 gate on the flash kernel section: runs green on CPU with the
+    full PR-9 schema (fused/split AND pipelined/serial ratios, the splash
+    skip fraction) and the deterministic parts carry their pinned values —
+    the skip fraction is host-side map arithmetic at the flagship tiling,
+    identical on every platform, and the pipelined autoshrink must report
+    the measured v5e blocks (1024×512, pipelined)."""
+    bench = _bench_mod()
+    result, err = bench._run_section("flash_bwd", _cpu_env(), timeout=300,
+                                     attempts=1)
+    assert err is None, err
+    for key in ("flash_bwd_ms", "flash_bwd_split_ms",
+                "flash_bwd_fused_vs_split", "flash_fwd_ms",
+                "flash_fwd_pipelined_vs_base",
+                "flash_bwd_pipelined_vs_base", "flash_splash_skip_frac",
+                "flash_pipeline_blocks"):
+        assert key in result, key
+    assert result["flash_splash_skip_frac"] == 0.375
+    assert result["flash_pipeline_blocks"] == [1024, 512, True]
+    assert result["flash_bwd_ms"] > 0 and result["flash_fwd_ms"] > 0
+
+
 def test_section_registry_and_timeouts_agree():
     """Every section must carry a budget — a missing entry would KeyError
     mid-capture, exactly the un-losable contract's failure mode."""
@@ -215,6 +237,8 @@ def test_full_capture_emits_single_json_line_rc0():
                 "decode_int8_kvcache_tokens_per_s",
                 "decode_moe_tokens_per_s", "decode_spec_tokens_per_s",
                 "hbm_roofline", "flash_bwd_ms", "flash_bwd_fused_vs_split",
+                "flash_fwd_pipelined_vs_base", "flash_bwd_pipelined_vs_base",
+                "flash_splash_skip_frac",
                 "ckpt_save_ms", "ckpt_restore_ms",
                 "ckpt_async_overlap_ratio",
                 "telemetry_overhead_frac", "telemetry_export_ms",
@@ -234,6 +258,16 @@ def test_full_capture_emits_single_json_line_rc0():
     # the kernels — the capture must say so next to the number
     assert "flash_bwd_fused_vs_split" in payload.get(
         "cpu_fallback_expectations", {})
+    # same for the pipelined/serial ratios: the software pipeline is a
+    # mosaic scheduling property, invisible to the interpreter
+    assert "flash_fwd_pipelined_vs_base" in payload.get(
+        "cpu_fallback_expectations", {})
+    assert "flash_bwd_pipelined_vs_base" in payload.get(
+        "cpu_fallback_expectations", {})
+    # the splash skip fraction is host-side map arithmetic at the
+    # FLAGSHIP tiling — deterministic on every platform, so assert the
+    # causal value itself (dead tiles / total at the pipelined blocks)
+    assert payload["flash_splash_skip_frac"] == 0.375
     # likewise the checkpoint overlap ratio: tiny local-disk saves make
     # the hidden fraction a fixed-cost artifact off-chip
     assert "ckpt_async_overlap_ratio" in payload.get(

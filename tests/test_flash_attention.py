@@ -255,3 +255,353 @@ def test_burnin_flash_train_step_decreases_loss(jax8):
         params, loss = step(params, batch)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+# ------------------------------------------- pipelined + splash (PR 9)
+
+from nvidia_terraform_modules_tpu.ops.flash_attention import (  # noqa: E402
+    MASK_DEAD,
+    MASK_FULL,
+    MASK_PARTIAL,
+    FLASH_VMEM_BUDGET,
+    MaskSpec,
+    as_mask_spec,
+    auto_blocks,
+    block_liveness,
+    flash_vmem_bytes,
+    mask_live_frac,
+    splash_stats,
+)
+
+
+def test_pipelined_bitmatches_unpipelined_tier1():
+    """The pipeline's core contract, gated on every fast run: at equal
+    block sizes the paired-sub-tile kernels fold the SAME sub-tiles in the
+    SAME order with the same ops, so forward AND fused gradients BIT-match
+    the serial kernels — the property flash_pipeline_ok re-checks on the
+    chip's real lowering."""
+    q, k, v = _qkv(s=64)
+
+    def flash(pipeline):
+        return lambda q_, k_, v_: flash_attention(
+            q_, k_, v_, block_q=16, block_k=16, pipeline=pipeline)
+
+    o_on = flash("on")(q, k, v)
+    o_off = flash("off")(q, k, v)
+    assert jnp.array_equal(o_on, o_off)
+    for g_on, g_off in zip(_grads(flash("on"), q, k, v),
+                           _grads(flash("off"), q, k, v)):
+        assert jnp.array_equal(g_on, g_off)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("case", _BWD_BLOCK_CASES, ids=lambda c: c[0])
+def test_pipelined_parity_matrix(case, causal, dtype):
+    """Differential oracle for the software-pipelined kernels: pipelined
+    vs dense ``jax.grad`` reference AND pipelined vs the PR-4 fused
+    (serial) kernels, across causal × non-causal, square × rectangular
+    blocks, f32 × bf16, and an autoshrink shape. The serial comparison is
+    BITWISE — the pipeline is a scheduling change, never an arithmetic
+    one; tier-1 keeps one seed via
+    test_pipelined_bitmatches_unpipelined_tier1."""
+    _, s, bq, bk = case
+    q, k, v = _qkv(s=s, dtype=dtype)
+
+    def flash(pipeline):
+        return lambda q_, k_, v_: flash_attention(
+            q_, k_, v_, causal=causal, block_q=bq, block_k=bk,
+            pipeline=pipeline)
+
+    assert jnp.array_equal(flash("on")(q, k, v), flash("off")(q, k, v))
+    g_pipe = _grads(flash("on"), q, k, v)
+    g_base = _grads(flash("off"), q, k, v)
+    g_dense = _grads(
+        lambda q_, k_, v_: dense_reference_attention(q_, k_, v_,
+                                                     causal=causal),
+        q, k, v)
+    tol_dense = 1e-4 if dtype == jnp.float32 else 0.15
+    for gp, gb, gd in zip(g_pipe, g_base, g_dense):
+        assert jnp.array_equal(gp, gb)
+        assert jnp.max(jnp.abs(gp - gd)) < tol_dense
+
+
+def test_pipeline_knob_validated():
+    q, k, v = _qkv(s=64)
+    with pytest.raises(ValueError, match="auto|on|off"):
+        flash_attention(q, k, v, pipeline="bogus")
+    # block_k = whole sequence -> one K block: "on" must refuse loudly
+    with pytest.raises(ValueError, match="even number of K blocks"):
+        flash_attention(q, k, v, block_q=16, block_k=64, pipeline="on")
+    with pytest.raises(ValueError, match="flash_pipeline"):
+        BurnInConfig(flash_pipeline="bogus")
+
+
+def test_pipeline_auto_degrades_on_odd_tiling():
+    """pipeline='auto' with an odd K tiling must fall back to the serial
+    kernels silently (same numbers), never raise."""
+    q, k, v = _qkv(s=48)
+    out = flash_attention(q, k, v, block_q=16, block_k=48)  # nk = 1
+    ref = dense_reference_attention(q, k, v)
+    assert jnp.max(jnp.abs(out - ref)) < 1e-5
+
+
+def _pallas_eqns(jaxpr):
+    """Recursively collect pallas_call eqns from a (Closed)Jaxpr."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    out = []
+    for eqn in inner.eqns:
+        if eqn.primitive.name == "pallas_call":
+            out.append(eqn)
+        for val in eqn.params.values():
+            for sub in (val if isinstance(val, (list, tuple)) else (val,)):
+                if hasattr(sub, "eqns") or hasattr(sub, "jaxpr"):
+                    out.extend(_pallas_eqns(sub))
+    return out
+
+
+@pytest.mark.parametrize("pipeline,k_steps", [("on", 2), ("off", 4)])
+def test_pipeline_lowering_grid_pin(pipeline, k_steps):
+    """Lowering regression: the pipelined fused backward must stage ONE
+    pallas_call whose k grid dimension iterates sub-tile PAIRS (nk/2), the
+    serial one the full nk — a silent fallback to the unpipelined path
+    (or an extra kernel) fails tier-1 here, exactly like the fused/split
+    pin above."""
+    q, k, v = _qkv(s=64)
+    _, vjp_fn = jax.vjp(
+        lambda q_, k_, v_: flash_attention(q_, k_, v_, block_q=16,
+                                           block_k=16, pipeline=pipeline),
+        q, k, v)
+    eqns = _pallas_eqns(jax.make_jaxpr(vjp_fn)(jnp.ones_like(q)))
+    assert len(eqns) == 1
+    assert eqns[0].params["grid_mapping"].grid[-1] == k_steps
+    # forward: same pairing on the same grid axis
+    fwd_eqns = _pallas_eqns(jax.make_jaxpr(
+        lambda q_, k_, v_: flash_attention(q_, k_, v_, block_q=16,
+                                           block_k=16,
+                                           pipeline=pipeline))(q, k, v))
+    assert len(fwd_eqns) == 1
+    assert fwd_eqns[0].params["grid_mapping"].grid[-1] == k_steps
+
+
+def test_splash_lowering_stays_pallas():
+    """A splash (window) mask must lower to the SAME single pallas kernels
+    as causal — same grid, liveness riding as data — never fall back to a
+    dense XLA attention or a per-mask kernel zoo."""
+    q, k, v = _qkv(s=64)
+
+    def run(mask):
+        fn = lambda q_, k_, v_: flash_attention(  # noqa: E731
+            q_, k_, v_, block_q=16, block_k=16, mask=mask)
+        fwd = _pallas_eqns(jax.make_jaxpr(fn)(q, k, v))
+        _, vjp_fn = jax.vjp(fn, q, k, v)
+        bwd = _pallas_eqns(jax.make_jaxpr(vjp_fn)(jnp.ones_like(q)))
+        return fwd, bwd
+
+    fwd_w, bwd_w = run(("window", 24))
+    fwd_c, bwd_c = run(None)
+    assert len(fwd_w) == 1 and len(bwd_w) == 1
+    assert (fwd_w[0].params["grid_mapping"].grid
+            == fwd_c[0].params["grid_mapping"].grid)
+    assert (bwd_w[0].params["grid_mapping"].grid
+            == bwd_c[0].params["grid_mapping"].grid)
+
+
+# ------------------------------------------------------- splash masks
+
+def test_window_mask_matches_dense_masked():
+    """Block-sparse window attention vs the dense-masked XLA reference:
+    forward and gradients, window straddling block boundaries."""
+    q, k, v = _qkv(s=64)
+    w = 24
+
+    def flash(q_, k_, v_):
+        return flash_attention(q_, k_, v_, block_q=16, block_k=16,
+                               mask=("window", w))
+
+    def dense(q_, k_, v_):
+        return dense_reference_attention(q_, k_, v_, window=w)
+
+    assert jnp.max(jnp.abs(flash(q, k, v) - dense(q, k, v))) < 1e-5
+    for gf, gd in zip(_grads(flash, q, k, v), _grads(dense, q, k, v)):
+        assert jnp.max(jnp.abs(gf - gd)) < 1e-4
+
+
+def test_window_covering_seq_bitmatches_causal():
+    """window >= S keeps every causal element live: the splash map and the
+    kernels must produce BIT-identical outputs to plain causal."""
+    q, k, v = _qkv(s=64)
+    o_w = flash_attention(q, k, v, block_q=16, block_k=16,
+                          mask=("window", 64))
+    o_c = flash_attention(q, k, v, block_q=16, block_k=16)
+    assert jnp.array_equal(o_w, o_c)
+
+
+def test_window_composes_with_pipeline_and_split():
+    """Splash masking threads through every backward path: pipelined
+    fused, serial fused, and the historical split kernels agree."""
+    q, k, v = _qkv(s=64)
+
+    def flash(backward, pipeline):
+        return lambda q_, k_, v_: flash_attention(
+            q_, k_, v_, block_q=16, block_k=16, mask=("window", 20),
+            backward=backward, pipeline=pipeline)
+
+    g_pipe = _grads(flash("fused", "on"), q, k, v)
+    g_base = _grads(flash("fused", "off"), q, k, v)
+    g_split = _grads(flash("split", "off"), q, k, v)
+    for gp, gb, gs in zip(g_pipe, g_base, g_split):
+        assert jnp.array_equal(gp, gb)
+        assert jnp.max(jnp.abs(gp - gs)) < 1e-6
+
+
+def test_block_liveness_matches_elementwise_brute_force():
+    """The splash map generalises _causal_live: every (q-block, k-block)
+    class must equal the brute-force elementwise reduction of the mask
+    predicate over the tile."""
+    import numpy as np
+
+    for spec in (MaskSpec("causal"), MaskSpec("full"),
+                 MaskSpec("window", 5), MaskSpec("window", 16),
+                 MaskSpec("window", 37)):
+        for bq, bk in ((8, 8), (8, 16), (16, 8)):
+            s = 64
+            nq, nk = s // bq, s // bk
+            live = block_liveness(spec, nq, nk, bq, bk)
+            qp = np.arange(s)[:, None]
+            kp = np.arange(s)[None, :]
+            if spec.kind == "full":
+                keep = np.ones((s, s), bool)
+            else:
+                keep = qp >= kp
+                if spec.kind == "window":
+                    keep &= qp - kp < spec.window
+            for i in range(nq):
+                for j in range(nk):
+                    tile = keep[i * bq:(i + 1) * bq, j * bk:(j + 1) * bk]
+                    want = (MASK_FULL if tile.all() else
+                            MASK_DEAD if not tile.any() else MASK_PARTIAL)
+                    assert live[i, j] == want, (spec, i, j)
+
+
+def test_splash_stats_and_live_frac():
+    st = splash_stats(MaskSpec("causal"), 64, 64, 16, 16)
+    assert st["total"] == 16 and st["dead"] == 6
+    assert st["skip_frac"] == 0.375
+    # a tight window kills strictly more tiles than causal
+    st_w = splash_stats(MaskSpec("window", 8), 64, 64, 16, 16)
+    assert st_w["dead"] > st["dead"]
+    assert mask_live_frac(MaskSpec("causal"), 64) == 0.5
+    assert mask_live_frac(MaskSpec("full"), 64) == 1.0
+    assert 0 < mask_live_frac(MaskSpec("window", 8), 64) < 0.25
+
+
+def test_mask_spec_validated():
+    with pytest.raises(ValueError, match="causal|full|window"):
+        MaskSpec("diagonal")
+    with pytest.raises(ValueError, match="window >= 1"):
+        MaskSpec("window")
+    with pytest.raises(ValueError, match="takes no window"):
+        MaskSpec("causal", 8)
+    with pytest.raises(ValueError, match="unknown mask"):
+        as_mask_spec(42)
+    assert as_mask_spec(None, causal=False) == MaskSpec("full")
+    assert as_mask_spec(("window", 8)) == MaskSpec("window", 8)
+    q, k, v = _qkv(s=16)
+    with pytest.raises(ValueError, match="flash_window"):
+        BurnInConfig(flash_window=0)
+    with pytest.raises(ValueError, match="window masking implies causal"):
+        dense_reference_attention(q, k, v, causal=False, window=4)
+
+
+# ------------------------------------------------- VMEM-budget autoshrink
+
+def test_auto_blocks_reproduces_measured_v5e_defaults():
+    """The budget computation must land exactly on the round-5 measured
+    defaults at the flagship shapes (bf16, itemsize 2): the table became a
+    consequence, not an input."""
+    assert auto_blocks(4096, 128, 2, pipe=False) == (1024, 1024, False)
+    assert auto_blocks(2048, 128, 2, pipe=False) == (512, 1024, False)
+    # the pipelined kernels hold two K sub-tiles in flight: same budget,
+    # half the K width at the flagship
+    assert auto_blocks(4096, 128, 2, pipe=True) == (1024, 512, True)
+    # narrow heads leave VMEM headroom the old cap-1024 table wasted
+    assert auto_blocks(4096, 64, 2, pipe=False) == (1024, 2048, False)
+
+
+def test_auto_blocks_rejects_what_failed_on_chip():
+    """PROFILE_r05: 2048-wide tiles at d=128 failed to compile (VMEM).
+    The plan must price them over budget so they can never be selected."""
+    assert flash_vmem_bytes(1024, 2048, 4096, 128, 2,
+                            pipe=False) > FLASH_VMEM_BUDGET
+    assert flash_vmem_bytes(2048, 1024, 4096, 128, 2,
+                            pipe=False) > FLASH_VMEM_BUDGET
+    # and the selected defaults must fit, forward and backward
+    for pipe in (False, True):
+        bq, bk, _ = auto_blocks(4096, 128, 2, pipe=pipe)
+        assert flash_vmem_bytes(bq, bk, 4096, 128, 2,
+                                pipe=pipe) <= FLASH_VMEM_BUDGET
+
+
+def test_explicit_blocks_auto_pipeline_respects_vmem_budget():
+    """pipeline='auto' with EXPLICIT blocks must degrade to serial when
+    the doubled pipelined K/V window would overflow the VMEM plan — the
+    round-5 shipping blocks (1024×1024 at S=4096, d=128, bf16) fit serial
+    but not pipelined, and auto silently pipelining them would hand the
+    chip exactly the tile class PROFILE_r05 saw fail to compile. An
+    explicit pipeline='on' remains an operator override (block sweeps
+    probe past the planning model deliberately)."""
+    from nvidia_terraform_modules_tpu.ops.flash_attention import (
+        _resolve_pipeline,
+    )
+
+    kw = dict(block_q=1024, d=128, itemsize=2)
+    assert not _resolve_pipeline("auto", 4096, 1024, **kw)
+    assert _resolve_pipeline("auto", 4096, 512, **kw)
+    assert _resolve_pipeline("on", 4096, 1024, **kw)
+
+
+def test_auto_blocks_only_returns_sublane_multiples():
+    """Same ADVICE round-1 property _fit_block carries: every candidate the
+    budget chooser can select must be an 8-multiple divisor — S=24 would
+    otherwise offer 12 (= S/2), which CPU interpret accepts and real-TPU
+    pallas rejects."""
+    for s in (24, 40, 48, 56, 64, 120, 192, 256, 1024, 4096):
+        for pipe in (False, True):
+            bq, bk, _ = auto_blocks(s, 16, 4, pipe=pipe)
+            assert bq % 8 == 0 and s % bq == 0, (s, pipe, bq)
+            assert bk % 8 == 0 and s % bk == 0, (s, pipe, bk)
+
+
+def test_auto_blocks_tiny_and_untileable_shapes():
+    assert auto_blocks(8, 16, 4, pipe=True) == (8, 8, False)
+    bq, bk, pipe = auto_blocks(250, 16, 4, pipe=True)
+    assert bk == 0 and not pipe          # no 8-multiple divisor: caller raises
+    q, k, v = _qkv(s=250)
+    with pytest.raises(ValueError, match="pad the sequence"):
+        flash_attention(q, k, v)
+
+
+def test_default_blocks_auto_path_end_to_end():
+    """No explicit blocks anywhere: the budget path must pick a legal
+    tiling and match dense (auto pipeline on the even tiling it picks)."""
+    q, k, v = _qkv(s=256)
+    out = flash_attention(q, k, v)
+    ref = dense_reference_attention(q, k, v)
+    assert jnp.max(jnp.abs(out - ref)) < 1e-5
+
+
+def test_burnin_window_flash_matches_dense():
+    """Model-level splash: a windowed flash config is a pure mask change —
+    same logits as the dense path applying the same window through XLA."""
+    base = dict(vocab=64, d_model=32, n_heads=2, d_ff=64, n_layers=2,
+                seq_len=16, batch=4, dtype=jnp.float32, flash_window=6)
+    cfg_d = BurnInConfig(**base, attn="dense")
+    cfg_f = BurnInConfig(**base, attn="flash")
+    params = init_params(jax.random.PRNGKey(0), cfg_d)
+    tokens, _ = synthetic_batch(jax.random.PRNGKey(1), cfg_d)
+    dense = forward(params, tokens, cfg_d)
+    flash = forward(params, tokens, cfg_f)
+    assert jnp.max(jnp.abs(dense - flash)) < 1e-5

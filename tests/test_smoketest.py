@@ -46,6 +46,10 @@ def test_burnin_level(jax8):
     # the serve shape validates alongside training: greedy KV-cache
     # decode on the just-trained weights, self-consistent with forward()
     assert r.checks["decode_ok"]
+    # the kernel-rewrite gate: pipelined flash train steps BIT-match the
+    # unpipelined kernels at equal blocks on this backend's real lowering
+    # (ops/flash_attention.py's scheduling-only contract)
+    assert r.checks["flash_pipeline_ok"]
 
 
 @pytest.mark.slow

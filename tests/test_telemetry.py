@@ -602,3 +602,52 @@ def test_instrumented_burnin_step_overhead_under_2pct(tmp_path, jax8):
     assert frac < 0.02, (
         f"telemetry adds {overhead_s*1e6:.0f} µs/step against a "
         f"{bare_s*1e3:.2f} ms bare burn-in step = {frac:.2%} overhead")
+
+
+def test_instrument_step_flash_kernel_probe(tmp_path, jax8):
+    """The per-kernel satellite: a flash config's FIRST instrumented step
+    triggers the one-shot in-jit lax.scan probe — flash_fwd_ms /
+    flash_bwd_ms histograms get exactly ONE sample (never re-probed on
+    later steps) and the MXU-fraction gauges land in the Prometheus
+    exposition; non-flash configs never pay for it."""
+    import jax
+    import jax.numpy as jnp
+
+    from nvidia_terraform_modules_tpu.models import (
+        BurnInConfig,
+        init_params,
+        instrument_step,
+        make_train_step,
+        synthetic_batch,
+    )
+
+    cfg = BurnInConfig(vocab=64, d_model=32, n_heads=2, d_ff=64,
+                       n_layers=1, seq_len=16, batch=4,
+                       dtype=jnp.float32, attn="flash")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    reg = Registry(str(tmp_path))
+    step = instrument_step(make_train_step(cfg), cfg, reg)
+    batch = synthetic_batch(jax.random.PRNGKey(1), cfg)
+    for _ in range(3):
+        params, _loss = step(params, batch)
+    assert reg.histogram("flash_fwd_ms").count == 1
+    assert reg.histogram("flash_bwd_ms").count == 1
+    assert reg.gauge("flash_fwd_mxu_frac").value > 0
+    assert reg.gauge("flash_bwd_mxu_frac").value > 0
+    text = prometheus_text(reg)
+    assert "flash_fwd_mxu_frac" in text and "flash_bwd_ms" in text
+    # the probe must not have polluted the step clock's sample count
+    assert reg.histogram("train_step_ms").count == 3
+
+    # a dense config records NO flash instruments (and kernel_probe=True
+    # on one is a loud error, not a silent skip)
+    reg2 = Registry(str(tmp_path / "dense"))
+    dcfg = BurnInConfig(vocab=64, d_model=32, n_heads=2, d_ff=64,
+                        n_layers=1, seq_len=16, batch=4)
+    dstep = instrument_step(make_train_step(dcfg), dcfg, reg2)
+    dstep(init_params(jax.random.PRNGKey(0), dcfg),
+          synthetic_batch(jax.random.PRNGKey(1), dcfg))
+    assert reg2.histogram("flash_fwd_ms").count == 0
+    with pytest.raises(ValueError, match="kernel_probe"):
+        instrument_step(make_train_step(dcfg), dcfg, reg2,
+                        kernel_probe=True)

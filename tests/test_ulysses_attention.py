@@ -143,3 +143,20 @@ def test_ulysses_unsharded_config_falls_back_to_dense():
     tokens, _ = synthetic_batch(jax.random.PRNGKey(1), cfg)
     logits = forward(params, tokens, cfg)
     assert logits.shape == (4, 16, 64)
+
+
+def test_ulysses_pipelined_bitmatches_unpipelined(jax8):
+    """Ulysses' post-all-to-all local attention runs the same pipelined
+    flash kernels (PR 9): pipeline='on' must bit-match 'off' through the
+    all-to-all sandwich (the default auto blocks give the global-S local
+    problem an even K tiling either way)."""
+    q, k, v = _qkv(b=2, s=256, h=8, d=16)
+    mesh = _mesh(jax8, 1, 4, 2)
+
+    def run(pipeline):
+        return ulysses_self_attention(q, k, v, mesh, impl="flash",
+                                      pipeline=pipeline)
+
+    assert jnp.array_equal(run("on"), run("off"))
+    ref = dense_reference_attention(q, k, v)
+    assert jnp.max(jnp.abs(run("on") - ref)) < 2e-5
