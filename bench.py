@@ -766,6 +766,7 @@ def section_serve_engine() -> dict:
     from nvidia_terraform_modules_tpu.utils.traffic import (
         poisson_trace,
         ragged_lengths,
+        shared_prefix_prompts,
         trace_summary,
     )
 
@@ -846,6 +847,73 @@ def section_serve_engine() -> dict:
     finally:
         shutil.rmtree(root, ignore_errors=True)
 
+    # ---- scheduler levers (PR 10): Zipf shared-prefix workload through
+    # the sharing + lazy-growth engine vs the unshared baseline (bit-
+    # match is REPORTED so the artifact itself carries the gate), sjf
+    # vs fifo on a seeded bimodal-budget trace (wave-clock turnaround —
+    # deterministic, meaningful on CPU), and the admitted-concurrency
+    # gain lazy growth buys at a tight kv_blocks cap
+    import random as _random
+
+    sp_pairs = shared_prefix_prompts(
+        n_req, seed, n_templates=3, template_len=4 * kv_block,
+        suffix_lo=plo, suffix_hi=phi, vocab=srv_cfg.vocab)
+    sp_prompts = [jnp.asarray(toks, jnp.int32) for _t, toks in sp_pairs]
+    sp_budgets = ragged_lengths(n_req, seed + 2, lo=nlo, hi=nhi,
+                                mean=nmean)
+    sp_max_len = max(int(p.shape[-1]) + n
+                     for p, n in zip(sp_prompts, sp_budgets))
+    base_eng = make_serve_engine(params, srv_cfg, max_len=sp_max_len,
+                                 kv_block=kv_block)
+    base_outs = base_eng(sp_prompts, sp_budgets, slots=slots)
+    sync_outs(base_outs)
+    lever_eng = make_serve_engine(params, srv_cfg, max_len=sp_max_len,
+                                  kv_block=kv_block, share_prefix=True,
+                                  lazy_growth=True)
+    sync_outs(lever_eng(sp_prompts, sp_budgets, slots=slots))  # warm
+    lever_outs = lever_eng(sp_prompts, sp_budgets, slots=slots)
+    sync_outs(lever_outs)
+    lever_stats = lever_eng.last_stats
+    sp_bitmatch = all(
+        bool(jax.device_get(jnp.array_equal(a, b)))
+        for a, b in zip(lever_outs, base_outs))
+
+    # lazy admit gain: mean live (block-holding) requests per wave at
+    # the SAME tight pool cap, lazy / eager — eager reserves each
+    # request's full budget up front, lazy only its prompt + 1
+    # (sp_max_len IS the worst single request's rows)
+    tight = 1 + -(-sp_max_len // kv_block) + 2
+    eager_tight = make_serve_engine(params, srv_cfg, max_len=sp_max_len,
+                                    kv_block=kv_block)
+    sync_outs(eager_tight(sp_prompts, sp_budgets, slots=slots,
+                          kv_blocks=tight))
+    eager_live = eager_tight.last_stats["sched"]["mean_live_requests"]
+    lazy_tight = make_serve_engine(params, srv_cfg, max_len=sp_max_len,
+                                   kv_block=kv_block, lazy_growth=True)
+    lazy_outs = lazy_tight(sp_prompts, sp_budgets, slots=slots,
+                           kv_blocks=tight)
+    sync_outs(lazy_outs)
+    lazy_stats = lazy_tight.last_stats
+    lazy_bitmatch = all(
+        bool(jax.device_get(jnp.array_equal(a, b)))
+        for a, b in zip(lazy_outs, base_outs))
+
+    # sjf vs fifo: seeded BIMODAL budgets (mostly-short, a few long —
+    # the mix where shortest-job-first repairs mean wait) on the ragged
+    # prompts, compared by deterministic wave-clock turnaround
+    _r = _random.Random(f"bimodal-{seed}")
+    bi_budgets = [nhi if _r.random() < 0.25 else nlo
+                  for _ in range(n_req)]
+    bi_max_len = max(lens[i] + bi_budgets[i] for i in range(n_req))
+    fifo_eng = make_serve_engine(params, srv_cfg, max_len=bi_max_len,
+                                 kv_block=kv_block, policy="fifo")
+    sync_outs(fifo_eng(prompts, bi_budgets, slots=slots))
+    fifo_sched = fifo_eng.last_stats["sched"]
+    sjf_eng = make_serve_engine(params, srv_cfg, max_len=bi_max_len,
+                                kv_block=kv_block, policy="sjf")
+    sync_outs(sjf_eng(prompts, bi_budgets, slots=slots))
+    sjf_sched = sjf_eng.last_stats["sched"]
+
     kv = sat_stats["kv"]
     lat = stats["latency_ms"]
     out = {
@@ -880,6 +948,35 @@ def section_serve_engine() -> dict:
         "serve_engine_waves": sat_waves,
         "serve_engine_telemetry_overhead_frac": round(
             _median(t_inst) / max(_median(t_sat), 1e-12) - 1.0, 4),
+        # scheduler levers (PR 10) — the Zipf shared-prefix workload's
+        # provenance + the three lever headlines, bit-match gates
+        # included so the artifact carries its own contract
+        "serve_prefix_templates": 3,
+        "serve_prefix_hit_frac": lever_stats["prefix"]["hit_frac"],
+        "serve_prefix_hit_blocks": lever_stats["prefix"]["hit_blocks"],
+        "serve_prefill_tokens_saved":
+            lever_stats["prefix"]["tokens_saved"],
+        "serve_prefix_bitmatch": sp_bitmatch,
+        "serve_lazy_bitmatch": lazy_bitmatch,
+        "serve_lazy_kv_blocks_cap": tight,
+        "serve_lazy_blocks_grown": lazy_stats["kv"]["blocks_grown_lazy"],
+        # admitted-concurrency ratio at the same tight cap (>= 1: lazy
+        # granting admits at least as many live requests per wave)
+        "serve_lazy_admit_gain": round(
+            lazy_stats["sched"]["mean_live_requests"]
+            / max(eager_live, 1e-9), 3),
+        # wave-clock turnaround, fifo / sjf (> 1: sjf improves both the
+        # median and the mean wait on the bimodal-budget trace)
+        "serve_sjf_vs_fifo_p50": round(
+            fifo_sched["p50_turnaround_waves"]
+            / max(sjf_sched["p50_turnaround_waves"], 1e-9), 3),
+        "serve_sjf_vs_fifo_mean": round(
+            fifo_sched["mean_turnaround_waves"]
+            / max(sjf_sched["mean_turnaround_waves"], 1e-9), 3),
+        "serve_engine_kv_blocks_logical":
+            lever_stats["kv"]["kv_blocks_logical"],
+        "serve_engine_kv_blocks_physical":
+            lever_stats["kv"]["kv_blocks_physical"],
     }
     return out
 
@@ -1660,6 +1757,25 @@ def main() -> None:
                 "under the compressed arrival trace, not model time — "
                 "the p50/p99 SHAPE (queueing under bursts) is the "
                 "portable signal, the milliseconds are not")
+        if "serve_sjf_vs_fifo_p50" in merged:
+            expectations["serve_sjf_vs_fifo_p50"] = (
+                "meaningful ON CPU TOO: measured in deterministic "
+                "wave-clock turnaround (admission-to-retirement waves), "
+                "not wall time — expected > 1 on the seeded bimodal "
+                "budgets wherever queue depth exceeds the slot count")
+        if "serve_lazy_admit_gain" in merged:
+            expectations["serve_lazy_admit_gain"] = (
+                "meaningful ON CPU TOO: admitted-concurrency ratio at a "
+                "fixed tight kv_blocks cap is pure scheduling (lazy "
+                "grants prompt+1 blocks vs the full budget up front); "
+                "expected >= 1, rising with the budget tail")
+        if "serve_prefix_hit_frac" in merged:
+            expectations["serve_prefix_hit_frac"] = (
+                "meaningful ON CPU TOO: the hit fraction is host-side "
+                "block accounting on the seeded Zipf template workload; "
+                "the prefill COMPUTE saved (serve_prefill_tokens_saved "
+                "tokens) prices in on chip, where prompt-width matmuls "
+                "dominate admission")
         if "serve_spec_speedup" in merged:
             expectations["serve_spec_speedup"] = (
                 "tiny CPU shapes: per-slot [1,k+1] verification ~= k+1 "

@@ -31,7 +31,9 @@ from .decode import (  # noqa: F401
 )
 from .paging import (  # noqa: F401
     BlockAllocator,
+    PrefixIndex,
     blocks_for_rows,
+    chain_chunks,
     init_paged_cache,
     paged_pool_spec,
 )

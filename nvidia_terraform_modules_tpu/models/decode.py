@@ -505,8 +505,14 @@ def forward_paged(params, tokens, cache, cfg: BurnInConfig,
     — same hazard as :func:`forward_cached`).
 
     Precondition (the caller's, as ever): each active row's
-    ``pos + T`` stays within its ALLOCATED rows — the engine sizes every
-    admission's block grant for prompt + generation up front.
+    ``pos + T`` stays within its ALLOCATED rows. Under the serving
+    engine's eager grants that is sized at admission for prompt +
+    generation; under LAZY growth the engine grows the slot's table
+    row (one ``.at[slot, idx].set(block)`` dispatch per crossing)
+    BEFORE any wave whose write position enters an ungranted entry —
+    an ungranted entry still holds the init-time 0 and a write through
+    it would land in the garbage block, silently losing the row, which
+    is why the growth check stalls the slot rather than stepping it.
     """
     _check_cfg(cfg)
     b, t = tokens.shape

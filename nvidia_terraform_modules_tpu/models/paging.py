@@ -32,15 +32,30 @@ writes are routed there (their table rows may point at blocks already
 recycled to another request — without the reroute a retired slot's
 still-computing forward would corrupt the new owner's cache).
 
+Blocks are REFCOUNTED: :meth:`BlockAllocator.alloc` hands a block out
+at refcount 1, :meth:`BlockAllocator.share` maps an already-allocated
+block into another request's table (refcount++), and
+:meth:`BlockAllocator.free` only returns a block to the free list when
+the LAST reference drops — the mechanism that lets a popular prompt
+prefix live ONCE in HBM while any number of concurrent requests read
+it. :class:`PrefixIndex` is the host-side lookup that finds those
+shareable blocks: block-aligned token-hash chains → physical block
+ids, holding one reference per indexed block so a retained prefix
+survives its writer's retirement, with an LRU cap on
+retained-but-unreferenced blocks.
+
 ``tests/test_paging.py`` pins the allocator invariants (no double
-alloc, free-list recycling, exhaustion, the fragmentation bound) and
+alloc, free-list recycling, exhaustion, the fragmentation bound,
+refcount free-at-zero, LRU eviction safety) and
 ``tests/test_serving.py`` the end-to-end exactness of paged serving
 against solo decode.
 """
 
 from __future__ import annotations
 
-from typing import Any
+import hashlib
+from collections import OrderedDict
+from typing import Any, Sequence
 
 from .burnin import BurnInConfig
 from .decode import cache_rows
@@ -73,7 +88,7 @@ class BlockAllocator:
         self.num_blocks = num_blocks
         self.reserved = reserved
         self._free = list(range(num_blocks - 1, reserved - 1, -1))
-        self._owned: set[int] = set()
+        self._ref: dict[int, int] = {}           # block → reference count
         self.high_water = 0
 
     @property
@@ -82,27 +97,56 @@ class BlockAllocator:
 
     @property
     def in_use(self) -> int:
-        return len(self._owned)
+        """PHYSICAL blocks allocated — each counted once, however many
+        tables reference it (the HBM bill)."""
+        return len(self._ref)
+
+    @property
+    def refs_total(self) -> int:
+        """LOGICAL block references — what the same tables would cost
+        WITHOUT sharing (``refs_total - in_use`` is the sharing win)."""
+        return sum(self._ref.values())
+
+    def refcount(self, block: int) -> int:
+        return self._ref.get(block, 0)
 
     def alloc(self, n: int) -> list[int] | None:
-        """``n`` blocks or ``None`` (never a partial grant)."""
+        """``n`` blocks or ``None`` (never a partial grant); each block
+        starts at refcount 1 (the caller's reference)."""
         if n < 0:
             raise ValueError(f"cannot allocate {n} blocks")
         if n > len(self._free):
             return None
         blocks = [self._free.pop() for _ in range(n)]
-        self._owned.update(blocks)
-        self.high_water = max(self.high_water, len(self._owned))
+        for b in blocks:
+            self._ref[b] = 1
+        self.high_water = max(self.high_water, len(self._ref))
         return blocks
 
-    def free(self, blocks) -> None:
+    def share(self, blocks: Sequence[int]) -> None:
+        """Add one reference to each (already-allocated) block — the
+        physical bytes stay where they are, another table maps them."""
         for b in blocks:
-            if b not in self._owned:
+            if b not in self._ref:
+                raise ValueError(
+                    f"block {b} is not allocated — only a live block "
+                    f"can be shared into another table")
+        for b in blocks:
+            self._ref[b] += 1
+
+    def free(self, blocks) -> None:
+        """Drop one reference per block; a block returns to the free
+        list only when its LAST reference drops. Freeing an unallocated
+        block is loud (double free / reserved / foreign id)."""
+        for b in blocks:
+            if b not in self._ref:
                 raise ValueError(
                     f"block {b} is not allocated (double free, a "
                     f"reserved block, or a foreign id)")
-            self._owned.remove(b)
-            self._free.append(b)
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                del self._ref[b]
+                self._free.append(b)
 
     def stats(self) -> dict[str, int]:
         return {
@@ -111,7 +155,191 @@ class BlockAllocator:
             "in_use": self.in_use,
             "free": self.free_blocks,
             "high_water": self.high_water,
+            "refs_total": self.refs_total,
         }
+
+
+def chain_chunks(tokens: Sequence[int], block_size: int,
+                 offset: int = 0) -> list[tuple[int, ...]]:
+    """Split ``tokens`` into the FULL block-grid chunks of a request's
+    own blocks.
+
+    ``offset`` is the number of leading rows of the first own block
+    already occupied by non-prompt content identical across requests
+    (the template prefix's copied tail rows), so the first chunk covers
+    ``block_size - offset`` tokens and every later chunk ``block_size``.
+    Only chunks whose block is COMPLETELY covered by ``tokens`` are
+    returned — a partial tail block is never shareable (its remaining
+    rows differ per request).
+    """
+    if not 0 <= offset < block_size:
+        raise ValueError(
+            f"offset must be in [0, block_size), got {offset}")
+    out: list[tuple[int, ...]] = []
+    start, width = 0, block_size - offset
+    while start + width <= len(tokens):
+        out.append(tuple(int(t) for t in tokens[start:start + width]))
+        start += width
+        width = block_size
+    return out
+
+
+def chunk_tokens_covered(k: int, block_size: int, offset: int = 0) -> int:
+    """Prompt tokens covered by the first ``k`` full own-block chunks —
+    the prefill-start offset after sharing ``k`` blocks (0 for k=0)."""
+    return 0 if k == 0 else k * block_size - offset
+
+
+class PrefixIndex:
+    """Host-side prefix lookup: block-aligned token-hash chains →
+    physical blocks, holding ONE allocator reference per indexed block.
+
+    The chain key of a request's ``i``-th full own block is
+    ``H(key_{i-1}, tokens_i)`` (blake2b over the raw token bytes), so a
+    key names the ENTIRE token history up to and including that block —
+    two requests produce the same key iff their prompts agree on every
+    row the block holds and on everything before it, which (positions
+    being engine-constant) is exactly when the cached K/V content is
+    identical. Hash collisions are nevertheless never trusted with
+    correctness: each entry stores its token chunk and a match compares
+    tokens outright.
+
+    Because the index holds its own reference, an indexed block can
+    never be recycled under a reader: a writer's retirement decrements
+    its reference but the content stays resident ("recently retired")
+    until the LRU cap on retained-but-UNREFERENCED blocks (refcount 1 —
+    the index's own) evicts it. Entries are touched leaf-first on a
+    match so eviction takes chain suffixes before the prefixes that
+    reach them; evicting an interior entry cascades to its descendants
+    (unreachable entries must not keep holding references).
+    """
+
+    def __init__(self, alloc: BlockAllocator, capacity: int):
+        if capacity < 0:
+            raise ValueError(f"capacity must be >= 0, got {capacity}")
+        self.alloc = alloc
+        self.capacity = capacity
+        # key → (block, token-chunk, parent key) in LRU order
+        self._entries: "OrderedDict[bytes, tuple[int, tuple, bytes | None]]" = OrderedDict()
+        self._children: dict[bytes, set[bytes]] = {}
+        self.hit_blocks = 0
+        self.lookups = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def retained_unreferenced(self) -> list[bytes]:
+        """Indexed blocks no table references (refcount 1 = ours only),
+        in LRU order — the eviction candidates the cap bounds."""
+        return [k for k, (b, _t, _p) in self._entries.items()
+                if self.alloc.refcount(b) == 1]
+
+    @staticmethod
+    def _key(parent: bytes | None, chunk: tuple) -> bytes:
+        h = hashlib.blake2b(parent or b"root", digest_size=16)
+        h.update(",".join(str(t) for t in chunk).encode())
+        return h.digest()
+
+    def match(self, chunks: Sequence[tuple]) -> list[int]:
+        """Longest indexed chain prefix of ``chunks`` → its physical
+        blocks (with one reference ADDED to each via ``share`` — the
+        caller maps them into a table and frees them at retirement like
+        any owned block). Matched entries are touched most-recent,
+        leaf-first."""
+        self.lookups += 1
+        blocks: list[int] = []
+        keys: list[bytes] = []
+        parent: bytes | None = None
+        for chunk in chunks:
+            key = self._key(parent, chunk)
+            ent = self._entries.get(key)
+            if ent is None or ent[1] != chunk:
+                break
+            blocks.append(ent[0])
+            keys.append(key)
+            parent = key
+        for key in reversed(keys):               # leaf ends most recent
+            self._entries.move_to_end(key)
+        if blocks:
+            self.alloc.share(blocks)
+            self.hit_blocks += len(blocks)
+        return blocks
+
+    def register(self, chunks: Sequence[tuple],
+                 blocks: Sequence[int]) -> None:
+        """Index ``blocks[i]`` as holding ``chunks[i]`` (a prefilled
+        request's full own blocks, in chain order). Already-indexed
+        chain nodes are skipped (the donor matched them); new entries
+        take one reference each."""
+        if len(chunks) != len(blocks):
+            raise ValueError(
+                f"{len(chunks)} chunks for {len(blocks)} blocks")
+        parent: bytes | None = None
+        for chunk, block in zip(chunks, blocks):
+            key = self._key(parent, chunk)
+            ent = self._entries.get(key)
+            if ent is None:
+                self.alloc.share([block])
+                self._entries[key] = (block, chunk, parent)
+                if parent is not None:
+                    self._children.setdefault(parent, set()).add(key)
+            self._entries.move_to_end(key)
+            parent = key
+
+    def _evict(self, key: bytes) -> int:
+        """Drop ``key`` and every descendant entry (unreachable once
+        the parent is gone), freeing the index's reference on each.
+        Returns the number of entries evicted."""
+        n = 0
+        stack = [key]
+        while stack:
+            k = stack.pop()
+            ent = self._entries.pop(k, None)
+            if ent is None:
+                continue
+            block, _chunk, parent = ent
+            self.alloc.free([block])
+            if parent is not None and parent in self._children:
+                self._children[parent].discard(k)
+            stack.extend(self._children.pop(k, ()))
+            n += 1
+        return n
+
+    def trim(self) -> int:
+        """Enforce the LRU cap: evict least-recently-used
+        retained-but-unreferenced entries (NEVER a block a live table
+        still references) until at most ``capacity`` remain. Returns
+        evicted entry count."""
+        n = 0
+        while True:
+            cands = self.retained_unreferenced
+            if len(cands) <= self.capacity:
+                return n
+            n += self._evict(cands[0])
+
+    def reclaim(self, n: int) -> int:
+        """Evict up to ``n`` retained-but-unreferenced entries NOW
+        (allocation pressure: a block a new admission needs beats a
+        retained prefix, whatever the cap says). Returns the number of
+        entries evicted — 0 means nothing was reclaimable and the
+        caller should queue."""
+        freed = 0
+        while freed < n:
+            cands = self.retained_unreferenced
+            if not cands:
+                break
+            freed += self._evict(cands[0])
+        return freed
+
+    def release(self) -> int:
+        """Drop every entry (end of a run: the pool is being torn
+        down). Returns evicted entry count."""
+        n = 0
+        while self._entries:
+            n += self._evict(next(iter(self._entries)))
+        self._children.clear()
+        return n
 
 
 def paged_pool_spec(cfg: BurnInConfig, max_len: int, block_size: int,

@@ -31,6 +31,33 @@ continuous-batching engine, re-thought for TPU static shapes:
   ragged OUTPUT lengths first-class (the bench's deterministic ragged
   workload), with the same per-request retirement.
 
+Three SCHEDULER LEVERS (each independently toggleable; all defaults
+off, reproducing the baseline engine exactly):
+
+- **cross-request prefix sharing** (``share_prefix=True``): the block
+  allocator grows per-block refcounts and a host-side
+  :class:`..paging.PrefixIndex` of block-aligned token-hash chains, so
+  an admission whose prompt shares full leading blocks with any live or
+  recently retired request maps those PHYSICAL blocks into its table
+  (refcount++) and prefills only from the first unshared token — the
+  popular template's KV lives once in HBM and its prefill compute is
+  paid once, not per request;
+- **policy admission** (``policy="fifo"|"sjf"|"priority"``):
+  shortest-job-first on the known prompt length + ``n_new`` budget, or
+  a priority lane fed per-request (``run(..., priorities=)``), both
+  under a configurable ``aging`` bound (waves waited, after which a
+  request jumps the policy order) so starvation is impossible;
+- **lazy block growth** (``lazy_growth=True``): admission grants only
+  the prompt's blocks plus one decode block; the wave loop grows each
+  slot's table as its position crosses block boundaries, so eos-heavy
+  traffic stops reserving its worst-case budget and the same
+  ``kv_blocks`` pool admits measurably more concurrent requests. A
+  growth that finds the pool empty STALLS the slot (its writes stay
+  fenced, its position frozen) until a retirement frees a block; if
+  every live request is stalled the youngest is preempted back to the
+  queue (its deterministic tokens regenerate identically on
+  re-admission — scheduling, never different output).
+
 Every decode wave advances ALL busy slots in ONE compiled program — a
 batched ``[slots, 1]`` cached forward over the paged pool with per-slot
 positions and block tables; admission is host-side bookkeeping between
@@ -66,7 +93,6 @@ from __future__ import annotations
 
 import functools
 import time
-from collections import deque
 from typing import Any, Sequence
 
 import jax
@@ -76,7 +102,108 @@ import numpy as np
 from ..parallel.sharding import ShardingRules
 from .burnin import BurnInConfig
 from .decode import forward_paged
-from .paging import BlockAllocator, blocks_for_rows, paged_pool_spec
+from .paging import (
+    BlockAllocator,
+    PrefixIndex,
+    blocks_for_rows,
+    chain_chunks,
+    chunk_tokens_covered,
+    paged_pool_spec,
+)
+
+_POLICIES = ("fifo", "sjf", "priority")
+_DEFAULT_AGING = 512                   # waves; bounds starvation by default
+
+
+class _Sched:
+    """Host-side admission ORDER: which pending request the engine
+    should try to admit next. ``fifo`` is strict arrival order with
+    head-of-line blocking (the baseline engine's exact semantics);
+    ``sjf`` picks the shortest known job (prompt length + ``n_new``
+    budget) among ARRIVED requests; ``priority`` picks the highest
+    caller-supplied priority. Both non-fifo policies run under an
+    aging bound: a request that has waited ``aging`` waves past its
+    arrival jumps to the front (FIFO among the aged), so no job starves
+    behind an endless stream of policy-preferred ones. Whatever the
+    policy, a candidate whose block grant does not fit HOLDS admission
+    for the wave (no skip-ahead — deterministic, and a big job cannot
+    be starved for memory by smaller ones slipping past it)."""
+
+    def __init__(self, prompts, n_new_of, policy, aging, priorities,
+                 arrivals, t0):
+        self.pending = list(range(len(prompts)))   # arrival order
+        self.prompts = prompts
+        self.cost = [int(p.shape[-1]) + n_new_of[i]
+                     for i, p in enumerate(prompts)]
+        self.policy = policy
+        self.aging = aging
+        self.prio = priorities
+        self.arrivals = arrivals
+        self.t0 = t0
+        self.age = [0] * len(prompts)              # waves arrived-unadmitted
+
+    def __len__(self):
+        return len(self.pending)
+
+    def _now(self):
+        """ONE clock read per scan — a per-request time.monotonic() in
+        the hot wave loop would pay O(pending) syscalls per wave."""
+        return None if self.arrivals is None else \
+            time.monotonic() - self.t0
+
+    def _arrived(self, req, now):
+        return self.arrivals is None or self.arrivals[req] <= now
+
+    def candidate(self):
+        """Next request to try admitting, or None (empty / not arrived)."""
+        if not self.pending:
+            return None
+        now = self._now()
+        if self.policy == "fifo":
+            head = self.pending[0]
+            return head if self._arrived(head, now) else None
+        arrived = [r for r in self.pending if self._arrived(r, now)]
+        if not arrived:
+            return None
+        aged = [r for r in arrived if self.age[r] >= self.aging]
+        if aged:
+            return aged[0]                         # FIFO among the aged
+        if self.policy == "sjf":
+            return min(arrived, key=lambda r: (self.cost[r], r))
+        return min(arrived, key=lambda r: (-self.prio[r], r))
+
+    def pop(self, req):
+        self.pending.remove(req)
+
+    def requeue(self, req):
+        """Re-insert a preempted request at its arrival-order position
+        (age preserved — a preemption must not reset its aging)."""
+        import bisect
+
+        bisect.insort(self.pending, req)
+
+    def tick(self):
+        """One wave passed: age every arrived-but-unadmitted request."""
+        now = self._now()
+        for r in self.pending:
+            if self._arrived(r, now):
+                self.age[r] += 1
+
+    def waiting(self):
+        """Arrived-but-unadmitted count (one clock read)."""
+        if self.arrivals is None:
+            return len(self.pending)
+        now = self._now()
+        return sum(1 for r in self.pending if self.arrivals[r] <= now)
+
+    def next_arrival(self):
+        """The request whose arrival unblocks admission (the sleep
+        target when nothing is computable): fifo blocks on its HEAD —
+        a later-but-earlier-arriving request cannot jump it — while
+        the other policies unblock on the earliest arrival."""
+        if self.arrivals is None or self.policy == "fifo":
+            return self.pending[0]
+        return min(self.pending, key=lambda r: self.arrivals[r])
 
 
 def _request_key(rng, req, pos):
@@ -277,7 +404,11 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                       cache_dtype: str = "bf16", prefix=None,
                       sampler=None, prefill_chunk: int | None = None,
                       spec_k: int | None = None, telemetry=None,
-                      kv_block: int = 16):
+                      kv_block: int = 16, policy: str = "fifo",
+                      aging: int | None = None,
+                      share_prefix: bool = False,
+                      lazy_growth: bool = False,
+                      prefix_keep_blocks: int = 64):
     """Reusable engine: compile once, run many schedules.
 
     The compiled pieces (per-bucket admissions, the all-slots paged
@@ -341,6 +472,33 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
     plain loop's count-based retirement is fully async and usually
     wins (see the bench ``serve_spec`` sweep).
 
+    ``policy`` picks the ADMISSION ORDER (``"fifo"`` — strict arrival
+    order, the baseline engine bit for bit; ``"sjf"`` — shortest job
+    first on prompt length + budget; ``"priority"`` — per-request
+    priorities via ``run(..., priorities=)``), with ``aging`` (waves; a
+    non-fifo default of 512 bounds starvation) promoting any
+    request that has waited past the bound. ``share_prefix`` turns on
+    CROSS-REQUEST prefix-block sharing through a refcounted
+    :class:`..paging.PrefixIndex`: an admission whose prompt shares
+    full leading ``kv_block``-aligned blocks with a live or recently
+    retired request maps those physical blocks (refcount++) and
+    prefills only the unshared tail — ``prefix_keep_blocks`` caps the
+    retained-but-unreferenced blocks the index holds past their
+    writer's retirement (LRU). Shared-tail prefill runs the exact
+    cached path, so on dense-attn configs outputs stay bitwise equal
+    to the unshared engine; flash-attn configs resolve like chunked
+    prefill (exact-dense suffix math). ``lazy_growth`` grants only the
+    prompt's blocks plus one decode block at admission and grows each
+    slot's table per wave as its position crosses block boundaries —
+    the same ``kv_blocks`` cap then admits more concurrent requests on
+    eos-heavy/short-output traffic, at the cost of a possible
+    mid-flight STALL (and, if every live request stalls, a preemption
+    — outputs are schedule-invariant either way). ``share_prefix`` and
+    ``lazy_growth`` compose with chunked prefill but not with
+    ``spec_k`` (the speculative loop's device-resident multi-step has
+    no per-wave boundary to grow or share at — refused loudly);
+    ``lazy_growth`` requires ``eos_check_every == 1``.
+
     ``telemetry`` injects a telemetry registry (default: the process
     registry — the no-op unless ``TPU_TELEMETRY_DIR`` is set). When
     enabled, every admission emits a ``serve_prefill`` span, every
@@ -369,6 +527,21 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             raise ValueError(
                 "speculative serving is greedy-only: acceptance tests "
                 "the model's argmax chain — drop sampler or spec_k")
+        if share_prefix or lazy_growth:
+            raise ValueError(
+                "share_prefix/lazy_growth need the plain loop's "
+                "per-wave host boundary to map shared blocks and grow "
+                "tables at — the speculative multi-step runs on device "
+                "until retirement; drop spec_k or the lever")
+    if policy not in _POLICIES:
+        raise ValueError(
+            f"unknown policy {policy!r}: use {' | '.join(_POLICIES)}")
+    if aging is not None and aging < 1:
+        raise ValueError(f"aging must be >= 1 waves, got {aging}")
+    aging = _DEFAULT_AGING if aging is None else aging
+    if prefix_keep_blocks < 0:
+        raise ValueError(
+            f"prefix_keep_blocks must be >= 0, got {prefix_keep_blocks}")
     from ..telemetry import get_registry
 
     reg = telemetry if telemetry is not None else get_registry()
@@ -436,16 +609,18 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             out[key_] = [buf.at[dst].set(buf[src]) for buf in pool[key_]]
         return out
 
-    @functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(7,))
-    def _admit_full(p, prompt, impl, slot, row, key, tail, pool):
+    @functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(8,))
+    def _admit_full(p, prompt, impl, slot, row, key, tail, start, pool):
         """One dispatch per admission: set the slot's table row and
         start position, copy the prefix tail block (when configured),
         prefill the prompt through the slot's blocks, pick the first
-        token. ``tail`` is ``(src, dst)`` physical block ids."""
+        token. ``tail`` is ``(src, dst)`` physical block ids; ``start``
+        is the first position the prompt (or, under cross-request
+        sharing, its unshared suffix) prefills at."""
         tables = pool["block_tables"].at[slot].set(row)
         if prefix_tail_rows:
             pool = _tail_copy(pool, tail[0], tail[1])
-        sub = _sub1(pool, tables, slot, prefix_len)
+        sub = _sub1(pool, tables, slot, start)
         # int8_kernel OFF on every admission path: these jits compile
         # once per engine but run against pools a later run() may have
         # mesh-sharded (the pallas-on-sharded-operands hazard fires at
@@ -457,8 +632,8 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                                     int8_kernel=False)
         return pick(logits, -1, key), _merge(pool, sub, tables, slot)
 
-    @functools.partial(jax.jit, donate_argnums=(3,))
-    def _admit_table(slot, row, tail, pool):
+    @functools.partial(jax.jit, donate_argnums=(4,))
+    def _admit_table(slot, row, tail, start, pool):
         """Chunked admission's setup dispatch: table row + start pos +
         prefix tail copy; the chunks then stream via ``_chunk_step``."""
         tables = pool["block_tables"].at[slot].set(row)
@@ -466,7 +641,16 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             pool = _tail_copy(pool, tail[0], tail[1])
         out = dict(pool)
         out["block_tables"] = tables
-        out["pos"] = pool["pos"].at[slot].set(prefix_len)
+        out["pos"] = pool["pos"].at[slot].set(start)
+        return out
+
+    @functools.partial(jax.jit, donate_argnums=(3,))
+    def _grow_table(slot, idx, block, pool):
+        """Lazy growth's one-entry table write: map physical ``block``
+        at the slot's next logical index. One tiny dispatch per growth
+        event — once per ``kv_block`` generated tokens per slot."""
+        out = dict(pool)
+        out["block_tables"] = pool["block_tables"].at[slot, idx].set(block)
         return out
 
     @functools.partial(jax.jit, donate_argnums=(4,))
@@ -585,17 +769,18 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
 
     # ------------------------------------------------------ admission
 
-    def _check_chunk_bound(length: int) -> int:
+    def _check_chunk_bound(length: int, start: int | None = None) -> int:
+        start = prefix_len if start is None else start
         n = -(-length // prefill_chunk)
-        if prefix_len + n * prefill_chunk > max_len:
+        if start + n * prefill_chunk > max_len:
             # the padded tail would index past the table, where the
             # clipped block lookup would silently overwrite the last
             # cache rows — refuse loudly instead
             raise ValueError(
                 f"chunked prefill pads the prompt ({length}) to "
-                f"{n * prefill_chunk} rows, which after the prefix "
-                f"({prefix_len}) exceeds max_len ({max_len}) — raise "
-                f"max_len to >= {prefix_len + n * prefill_chunk} or "
+                f"{n * prefill_chunk} rows, which after the start "
+                f"position ({start}) exceeds max_len ({max_len}) — "
+                f"raise max_len to >= {start + n * prefill_chunk} or "
                 f"shrink prefill_chunk")
         return n
 
@@ -622,6 +807,10 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                            + (1 if prefix_tail_rows else 0))
             if kv_blocks is None:
                 kv_blocks = 1 + need_prefix + slots * nt
+            # feasibility is always the FULL budget, lazy growth or
+            # not: a request that ends up alone in the pool (the
+            # preemption fallback's terminal state) must be able to
+            # grow to its worst case
             worst = max(
                 blocks_for_rows(
                     _rows_needed(int(p.shape[-1]), n_new_of[i], headroom)
@@ -637,6 +826,8 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                     "deadlock; raise kv_blocks")
             self.kv_blocks = kv_blocks
             self.alloc = BlockAllocator(kv_blocks)
+            self.index = (PrefixIndex(self.alloc, prefix_keep_blocks)
+                          if share_prefix else None)
             self.pool = init_paged_cache(
                 cfg, slots, max_len, block_size=bs, num_blocks=kv_blocks,
                 rules=rules, cache_dtype=cache_dtype)
@@ -645,6 +836,19 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             self.tail_src = 0
             self.in_use_sum = 0                       # per-wave samples
             self.in_use_n = 0
+            self.logical: dict[int, int] = {}         # req → table blocks
+            self.logical_now = 0
+            self.logical_peak = 0
+            self.logical_sum = 0
+            self.live_sum = 0
+            self.grown_lazy = 0
+            self.preempted = 0
+            self.admit_wave: dict[int, int] = {}
+            self.retire_wave: dict[int, int] = {}
+            self.prefix_stats = {"hit_blocks": 0, "lookups": 0,
+                                 "prompt_blocks": 0, "tokens_saved": 0}
+            self._toks: dict[int, list] = {}          # host prompt cache
+            self._row_np: dict[int, Any] = {}
             if prefix is not None:
                 blocks = self.alloc.alloc(need_prefix)
                 assert blocks is not None            # sized above
@@ -656,31 +860,144 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 self.pool = _prefix_fill(prefill_params, prefix[None, :],
                                          jnp.asarray(row), self.pool)
 
-        def admit_blocks(self, req: int, length: int):
-            """Allocate the request's blocks; None = hold in queue."""
-            rows = _rows_needed(length, self.n_new_of[req], self.headroom)
-            own_rows = rows - prefix_full_blocks * bs
-            blocks = self.alloc.alloc(blocks_for_rows(own_rows, bs))
+        def admit_blocks(self, req: int, prompt, length: int):
+            """Allocate the request's blocks, sharing any indexed full
+            leading prefix blocks first (refcount++ — read-only for
+            this request); None = hold in queue. Returns ``(row, tail,
+            start, shared_tokens, entries)`` where ``start`` is the
+            prefill start position and ``entries`` the table entries
+            granted so far (the lazy-growth watermark)."""
+            shared: list[int] = []
+            cov = 0
+            n_chunks = 0
+            if self.index is not None:
+                toks = self._toks.get(req)
+                if toks is None:
+                    toks = [int(t) for t in np.asarray(prompt)]
+                    self._toks[req] = toks
+                chunks = chain_chunks(toks, bs, prefix_tail_rows)
+                # at least one prompt token must remain to forward —
+                # its logits pick the first generated token
+                while chunks and chunk_tokens_covered(
+                        len(chunks), bs, prefix_tail_rows) > length - 1:
+                    chunks.pop()
+                n_chunks = len(chunks)
+                shared = self.index.match(chunks)
+                cov = chunk_tokens_covered(len(shared), bs,
+                                           prefix_tail_rows)
+                if prefill_chunk is not None:
+                    # the PADDED unshared suffix must stay within the
+                    # table — un-share blocks until it fits
+                    while shared and (prefix_len + cov + -(-(
+                            length - cov) // prefill_chunk)
+                            * prefill_chunk) > max_len:
+                        self.alloc.free([shared.pop()])
+                        cov = chunk_tokens_covered(len(shared), bs,
+                                                   prefix_tail_rows)
+            k = len(shared)
+            budget = prefix_len + length + self.n_new_of[req] \
+                + self.headroom
+            grant = (prefix_len + length + 1) if lazy_growth else budget
+            if prefill_chunk is not None:
+                padded_end = prefix_len + cov + -(-(
+                    length - cov) // prefill_chunk) * prefill_chunk
+                grant = max(grant, padded_end)
+            grant = min(grant, geom["rows"])
+            own_rows = grant - prefix_full_blocks * bs - k * bs
+            blocks = self._alloc_reclaiming(blocks_for_rows(own_rows, bs))
             if blocks is None:
+                if shared:
+                    self.alloc.free(shared)          # undo the shares
                 return None
-            self.owned[req] = blocks
+            # stats count ADMISSIONS, not probes: a request held for
+            # blocks re-matches every wave, and billing each failed
+            # attempt would skew hit_frac low by the wait length
+            if self.index is not None:
+                self.prefix_stats["lookups"] += 1
+                self.prefix_stats["prompt_blocks"] += n_chunks
+                self.prefix_stats["hit_blocks"] += k
+                self.prefix_stats["tokens_saved"] += cov
+            self.owned[req] = shared + blocks
             row = np.zeros((nt,), np.int32)
-            shared = self.prefix_blocks[:prefix_full_blocks]
-            row[:prefix_full_blocks] = shared
-            row[prefix_full_blocks:prefix_full_blocks + len(blocks)] = \
-                blocks
+            row[:prefix_full_blocks] = \
+                self.prefix_blocks[:prefix_full_blocks]
+            row[prefix_full_blocks:prefix_full_blocks + k] = shared
+            row[prefix_full_blocks + k:
+                prefix_full_blocks + k + len(blocks)] = blocks
+            # the template tail copy applies only when no shared block
+            # already carries those rows (k == 0)
             tail = jnp.asarray(
-                [self.tail_src, blocks[0] if blocks else 0], jnp.int32)
-            return jnp.asarray(row), tail
+                [self.tail_src if k == 0 else 0,
+                 blocks[0] if k == 0 else 0], jnp.int32)
+            entries = prefix_full_blocks + k + len(blocks)
+            self.logical[req] = entries         # every table-mapped block
+            self.logical_now += self.logical[req]
+            self.logical_peak = max(self.logical_peak, self.logical_now)
+            self._row_np[req] = row
+            return (jnp.asarray(row), tail, prefix_len + cov, cov,
+                    entries)
+
+        def register_prefix(self, req: int) -> None:
+            """Index the request's prefilled FULL prompt blocks so
+            later admissions can share them (no-op when sharing is
+            off). Skips chain nodes the donor itself matched."""
+            if self.index is None:
+                return
+            chunks = chain_chunks(self._toks[req], bs, prefix_tail_rows)
+            row = self._row_np[req]
+            self.index.register(
+                chunks, [int(row[prefix_full_blocks + j])
+                         for j in range(len(chunks))])
+
+        def _alloc_reclaiming(self, n: int):
+            """``alloc`` that EVICTS retained-but-unreferenced prefix
+            blocks under allocation pressure before giving up — a
+            retained prefix must never starve a new admission into
+            permanent queueing at a tight ``kv_blocks`` cap."""
+            blocks = self.alloc.alloc(n)
+            while blocks is None and self.index is not None:
+                if not self.index.reclaim(n - self.alloc.free_blocks):
+                    return None
+                blocks = self.alloc.alloc(n)
+            return blocks
+
+        def grow_block(self, req: int) -> int | None:
+            """One more block for a lazily-granted request (None: pool
+            empty — the caller stalls the slot)."""
+            b = self._alloc_reclaiming(1)
+            if b is None:
+                return None
+            self.owned[req].append(b[0])
+            self.logical[req] += 1
+            self.logical_now += 1
+            self.logical_peak = max(self.logical_peak, self.logical_now)
+            self.grown_lazy += 1
+            return b[0]
 
         def retire_blocks(self, req: int) -> None:
             self.alloc.free(self.owned.pop(req))
+            self.logical_now -= self.logical.pop(req)
+            self._toks.pop(req, None)
+            self._row_np.pop(req, None)
+            if self.index is not None:
+                # drop retained-but-unreferenced prefix blocks past the
+                # LRU cap now that this request's references are gone
+                self.index.trim()
 
-        def sample(self) -> None:
+        def close(self) -> None:
+            """End of run: release the prefix index's retained blocks
+            so the pool drains to empty (the leak check's invariant)."""
+            if self.index is not None:
+                self.index.release()
+
+        def sample(self, live: int = 0) -> None:
             """One per-wave occupancy sample (host ints — runs whether
-            or not telemetry is on; feeds the mean-utilisation stat)."""
+            or not telemetry is on; feeds the mean-utilisation and
+            admitted-concurrency stats)."""
             self.in_use_sum += self.alloc.in_use
             self.in_use_n += 1
+            self.logical_sum += self.logical_now
+            self.live_sum += live
 
         def kv_stats(self) -> dict:
             s = self.alloc.stats()
@@ -694,13 +1011,46 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 # what the dense [slots, max_len] pool would have
                 # RESERVED for the same schedule — the paging win
                 "dense_rows": dense,
-                # peak: the pool the engine actually NEEDED; mean: the
-                # live rows over the schedule (ragged retirement keeps
-                # it well under the peak)
+                # peak/mean bill PHYSICAL blocks — a refcounted shared
+                # block counts once, however many tables map it; the
+                # logical twin (what the same tables would cost
+                # unshared) rides alongside so the sharing win is
+                # visible in the same record
                 "utilisation": round(s["high_water"] * bs
                                      / max(dense, 1), 4),
                 "mean_utilisation": round(mean_blocks * bs
                                           / max(dense, 1), 4),
+                "kv_blocks_physical": s["high_water"],
+                "kv_blocks_logical": self.logical_peak,
+                "mean_logical_blocks": round(
+                    self.logical_sum / max(self.in_use_n, 1), 3),
+                "blocks_grown_lazy": self.grown_lazy,
+            }
+
+        def sched_stats(self) -> dict:
+            rw = sorted(self.retire_wave.values())
+            aw = sorted(self.admit_wave.values())
+
+            def mean(xs):
+                return round(sum(xs) / len(xs), 3) if xs else None
+
+            return {
+                "policy": policy,
+                "preempted": self.preempted,
+                # wave-clock scheduling metrics (deterministic for
+                # saturated schedules): admit wave = the wait the
+                # admission policy imposed, turnaround = retire wave
+                "mean_admit_wave": mean(aw),
+                "mean_turnaround_waves": mean(rw),
+                "p50_turnaround_waves": (rw[len(rw) // 2] if rw
+                                         else None),
+                "mean_live_requests": round(
+                    self.live_sum / max(self.in_use_n, 1), 3),
+                # per-request admit waves: aggregate means are
+                # permutation-invariant at slots=1, so starvation (and
+                # the aging bound repairing it) is only visible on the
+                # individual request's wait
+                "admit_wave_of": dict(self.admit_wave),
             }
 
     # -------------------------------------------------------- telemetry
@@ -711,12 +1061,22 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         _g_queue = reg.gauge("serve_queue_depth")
         _g_occ = reg.gauge("serve_slot_occupancy")
         _g_kv = reg.gauge("kv_blocks_in_use")
+        _g_hit = reg.gauge("prefix_hit_blocks")
+        _g_hitf = reg.gauge("prefix_hit_frac")
+        _g_lazy = reg.gauge("blocks_grown_lazy")
 
     def _gauges(rstate: _Run, waiting: int, busy: int):
         if reg.enabled:
             _g_queue.set(waiting)
             _g_occ.set(busy / rstate.slots)
             _g_kv.set(rstate.alloc.in_use)
+            if share_prefix:
+                ps = rstate.prefix_stats
+                _g_hit.set(ps["hit_blocks"])
+                _g_hitf.set(round(ps["hit_blocks"]
+                                  / max(ps["prompt_blocks"], 1), 4))
+            if lazy_growth:
+                _g_lazy.set(rstate.grown_lazy)
 
     def _note_admit(meta, req, wait_s):
         # every telemetry timestamp below comes from the REGISTRY clock
@@ -767,54 +1127,64 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
 
     def _admit_one(rstate: _Run, slot: int, req: int, prompt, key,
                    meta, wait_s):
-        """Full (non-chunked) admission: one compiled dispatch."""
+        """Full (non-chunked) admission: one compiled dispatch. Under
+        cross-request sharing only the UNSHARED suffix is forwarded —
+        the shared span's prefill compute is skipped entirely. Returns
+        ``(first_token, granted_entries)`` or None (blocks exhausted)."""
         from .decode import _select_prefill_impl
 
         length = int(prompt.shape[-1])
-        got = rstate.admit_blocks(req, length)
+        got = rstate.admit_blocks(req, prompt, length)
         if got is None:
             return None
-        row, tail = got
-        impl = ("cached" if prefix is not None else
+        row, tail, start, cov, entries = got
+        suffix = prompt[cov:] if cov else prompt
+        impl = ("cached" if (prefix is not None or cov) else
                 _select_prefill_impl(cfg, length, "auto"))
         _note_admit(meta, req, wait_s)
         if key is None:
             key = jnp.zeros((2,), jnp.uint32)
         t0c = _clk()
         first, rstate.pool = _admit_full(
-            prefill_params, prompt[None, :], impl, jnp.int32(slot), row,
-            key, tail, rstate.pool)
+            prefill_params, suffix[None, :], impl, jnp.int32(slot), row,
+            key, tail, jnp.int32(start), rstate.pool)
+        rstate.register_prefix(req)
         _note_prefill(meta, req, t0c, length)
-        return first
+        return first, entries
 
-    def _chunk_split(prompt, length: int):
+    def _chunk_split(prompt, length: int, start: int | None = None):
         """Pad-to-C chunking shared by the sync (spec) and interleaved
         (plain) admission paths: the chunk list, the true last token's
         offset within the final chunk, and the post-rewind position —
         ONE definition of the finish arithmetic, so the two paths can
-        never disagree on which logit picks the first token."""
+        never disagree on which logit picks the first token. ``prompt``
+        is the tokens actually prefilled (the unshared suffix under
+        cross-request sharing) and ``start`` their first position."""
+        start = prefix_len if start is None else start
         c = prefill_chunk
-        nc = _check_chunk_bound(length)
+        nc = _check_chunk_bound(length, start)
         padded = jnp.zeros((nc * c,), jnp.int32).at[:length].set(prompt)
         chunks = [padded[i * c:(i + 1) * c][None] for i in range(nc)]
         return (chunks, jnp.int32(length - 1 - (nc - 1) * c),
-                jnp.int32(prefix_len + length))
+                jnp.int32(start + length))
 
     def _admit_chunked_sync(rstate: _Run, slot: int, req: int, prompt,
                             key, meta, wait_s):
         """Chunked admission WITHOUT interleaving, as ONE compiled
         dispatch (``_chunk_sweep``): keeps chunked admission's memory
         ceiling (``[C, S_max]`` scores) and one-compile-per-engine
-        property without paying a host dispatch per chunk."""
+        property without paying a host dispatch per chunk. Spec-loop
+        only — the levers that would change its block grant are
+        refused with ``spec_k`` at engine build."""
         length = int(prompt.shape[-1])
-        got = rstate.admit_blocks(req, length)
+        got = rstate.admit_blocks(req, prompt, length)
         if got is None:
             return None
-        row, tail = got
+        row, tail, start, _cov, entries = got
         _note_admit(meta, req, wait_s)
         t0c = _clk()
         rstate.pool = _admit_table(jnp.int32(slot), row, tail,
-                                   rstate.pool)
+                                   jnp.int32(start), rstate.pool)
         chunks, last_idx, true_pos = _chunk_split(prompt, length)
         c = prefill_chunk
         # ONE [1, MC, C] buffer per admission (static shape → one
@@ -829,11 +1199,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             prefill_params, buf, jnp.int32(len(chunks)), last_idx,
             rstate.pool, jnp.int32(slot), key, true_pos)
         _note_prefill(meta, req, t0c, length, chunks=len(chunks))
-        return first
-
-    def _arrived(arrivals, t0, req) -> bool:
-        return arrivals is None or \
-            arrivals[req] <= time.monotonic() - t0
+        return first, entries
 
     def _queue_wait(arrivals, t0, req) -> float:
         """Queue wait vs the request's arrival (t0 when no trace): a
@@ -843,24 +1209,15 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         return max(0.0, time.monotonic() - t0
                    - (arrivals[req] if arrivals is not None else 0.0))
 
-    def _waiting(queue, arrivals, t0) -> int:
-        """Arrived-but-unadmitted count, one clock read per wave — a
-        per-request time.monotonic() in the hot wave loop would pay
-        O(queue) syscalls for a gauge."""
-        if arrivals is None:
-            return len(queue)
-        now = time.monotonic() - t0
-        return sum(1 for r, _ in queue if arrivals[r] <= now)
-
-    def _sleep_until_arrival(arrivals, queue, t0):
-        """Nothing to compute and the head request hasn't arrived:
-        sleep the gap instead of spinning."""
-        wait = arrivals[queue[0][0]] - (time.monotonic() - t0)
+    def _sleep_until_arrival(arrivals, sched, t0):
+        """Nothing to compute and no pending request has arrived:
+        sleep the gap to the earliest arrival instead of spinning."""
+        wait = arrivals[sched.next_arrival()] - (time.monotonic() - t0)
         if wait > 0:
             time.sleep(wait)
 
     def run_spec(prompts, n_new_of, slots, rules, eos_id, arrivals,
-                 kv_blocks):
+                 kv_blocks, priorities):
         """Speculative schedule: same admission/retire bookkeeping as
         the plain loop, but outputs live in a device-side context
         buffer (the draft source) and each step can emit up to
@@ -877,7 +1234,8 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         ctxbuf = jnp.zeros((slots, max_len + spec_k + 1), jnp.int32)
         cur = jnp.zeros((slots,), jnp.int32)
         n_out = jnp.zeros((slots,), jnp.int32)
-        queue = deque(enumerate(prompts))
+        sched = _Sched(prompts, n_new_of, policy, aging, priorities,
+                       arrivals, time.monotonic())
         active: dict[int, int] = {}
         start_of: dict[int, int] = {}            # req → first output idx
         out: dict[int, Any] = {}
@@ -889,27 +1247,26 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         generated = 0
         admitted = 0                   # prefill-emitted (non-step) tokens
         eos_dev = jnp.int32(-1 if eos_id is None else eos_id)
-        t0 = time.monotonic()
+        t0 = sched.t0
 
-        def arrived(req):
-            return _arrived(arrivals, t0, req)
-
-        while queue or active:
+        while len(sched) or active:
             for slot in range(slots):
-                if slot in active or not queue:
+                if slot in active or not len(sched):
                     continue
-                req, prompt = queue[0]
-                if not arrived(req):
-                    break
-                prompt = jnp.asarray(prompt)
+                req = sched.candidate()
+                if req is None:
+                    break                        # nothing arrived yet
+                prompt = jnp.asarray(prompts[req])
                 wait_s = _queue_wait(arrivals, t0, req)
                 admit = (_admit_chunked_sync if prefill_chunk is not None
                          else _admit_one)
-                first = admit(rstate, slot, req, prompt, None,
-                              meta, wait_s)
-                if first is None:
+                got = admit(rstate, slot, req, prompt, None,
+                            meta, wait_s)
+                if got is None:
                     break                        # blocks exhausted: hold
-                queue.popleft()
+                first, _entries = got
+                sched.pop(req)
+                rstate.admit_wave[req] = host_waves
                 length = int(prompt.shape[-1])
                 start_of[req] = prefix_len + length
                 ctxbuf, cur, n_out = _spec_admit_row(
@@ -920,17 +1277,21 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 if n_new_of[req] == 1 or (eos_id is not None
                                           and int(first) == eos_id):
                     out[req] = first[None]
+                    rstate.retire_wave[req] = host_waves
                     rstate.retire_blocks(req)
                     _note_retire(meta, latencies, req, 1, 0)
                     continue
                 active[slot] = req
-            waiting = _waiting(queue, arrivals, t0)
-            rstate.sample()
+            waiting = sched.waiting()
+            sched.tick()
+            rstate.sample(live=len(active))
             _gauges(rstate, waiting, len(active))
             if not active:
-                if queue:
-                    if arrivals is not None and not arrived(queue[0][0]):
-                        _sleep_until_arrival(arrivals, queue, t0)
+                if len(sched):
+                    if arrivals is not None and sched.candidate() is None:
+                        # nothing admissible until the blocking request
+                        # arrives (fifo: the head; else: the earliest)
+                        _sleep_until_arrival(arrivals, sched, t0)
                     # else: blocks exhausted with nothing active cannot
                     # happen — capacity for the largest single request
                     # is validated up front
@@ -947,7 +1308,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             # first free slot (stop=1), and an empty queue runs every
             # active slot to completion — nothing is waiting to admit
             stop = (min(len(active), max(1, waiting))
-                    if queue else len(active))
+                    if len(sched) else len(active))
             ctxbuf, cur, n_out, fin, steps_inc, rstate.pool = spec_step(
                 ctxbuf, cur, n_out, n_new_dev, eos_dev,
                 active_mask, jnp.int32(stop), rstate.pool)
@@ -968,10 +1329,12 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                     start = start_of[req]
                     out[req] = ctxbuf[slot, start:start + n]
                     generated += n - 1           # first counted at admit
+                    rstate.retire_wave[req] = host_waves
                     rstate.retire_blocks(req)
                     _note_retire(meta, latencies, req, n,
                                  req_steps.get(req, 0))
                     del active[slot]
+        rstate.close()
         _gauges(rstate, 0, 0)
         if reg.enabled:
             # each verification slot-step emits exactly one model token
@@ -1004,6 +1367,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                                   int(p * len(lat)))], 3)
                     if lat else None)
 
+        ps = rstate.prefix_stats
         return {
             "requests": n_req,
             "generated": generated,
@@ -1011,6 +1375,15 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             "latency_ms": {"p50": q(0.5), "p99": q(0.99),
                            "max": round(lat[-1], 3) if lat else None},
             "kv": rstate.kv_stats(),
+            "sched": rstate.sched_stats(),
+            "prefix": {
+                "enabled": share_prefix,
+                "hit_blocks": ps["hit_blocks"],
+                "hit_frac": round(ps["hit_blocks"]
+                                  / max(ps["prompt_blocks"], 1), 4),
+                "tokens_saved": ps["tokens_saved"],
+                "lookups": ps["lookups"],
+            },
         }
 
     def run(prompts: Sequence[Any], n_new, *, slots: int = 4,
@@ -1018,7 +1391,8 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             eos_id: int | None = None, rng=None,
             eos_check_every: int = 1, arrivals=None,
             kv_blocks: int | None = None,
-            static_batching: bool = False) -> list[Any]:
+            static_batching: bool = False,
+            priorities=None) -> list[Any]:
         # reset on entry: a failed run must not leave a prior run's
         # stats for an error-catching caller to misattribute
         run.last_stats = None
@@ -1030,9 +1404,22 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 "requests": 0, "generated": 0, "waves": 0,
                 "latency_ms": {"p50": None, "p99": None, "max": None},
                 "kv": {"num_blocks": 0, "reserved": 0, "in_use": 0,
-                       "free": 0, "high_water": 0, "block_size": bs,
+                       "free": 0, "high_water": 0, "refs_total": 0,
+                       "block_size": bs,
                        "peak_rows": 0, "dense_rows": 0,
-                       "utilisation": 0.0, "mean_utilisation": 0.0},
+                       "utilisation": 0.0, "mean_utilisation": 0.0,
+                       "kv_blocks_physical": 0, "kv_blocks_logical": 0,
+                       "mean_logical_blocks": 0.0,
+                       "blocks_grown_lazy": 0},
+                "sched": {"policy": policy, "preempted": 0,
+                          "mean_admit_wave": None,
+                          "mean_turnaround_waves": None,
+                          "p50_turnaround_waves": None,
+                          "mean_live_requests": 0.0,
+                          "admit_wave_of": {}},
+                "prefix": {"enabled": share_prefix, "hit_blocks": 0,
+                           "hit_frac": 0.0, "tokens_saved": 0,
+                           "lookups": 0},
             }
             return []
         if eos_check_every < 1:
@@ -1065,6 +1452,26 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 raise ValueError(
                     f"arrivals has {len(arrivals)} entries for "
                     f"{len(prompts)} prompts")
+        if priorities is not None:
+            if policy != "priority":
+                raise ValueError(
+                    f"priorities only apply to policy='priority' "
+                    f"(engine built with {policy!r})")
+            priorities = [float(p_) for p_ in priorities]
+            if len(priorities) != len(prompts):
+                raise ValueError(
+                    f"priorities has {len(priorities)} entries for "
+                    f"{len(prompts)} prompts")
+        elif policy == "priority":
+            # no lane supplied: every request equal — arrival order
+            # under the aging bound
+            priorities = [0.0] * len(prompts)
+        if lazy_growth and eos_check_every != 1:
+            raise ValueError(
+                "lazy_growth needs per-wave retirement accounting "
+                "(eos_check_every=1): the lagged scan's wave→token "
+                "mapping assumes uninterrupted slot tenancy, which a "
+                "growth stall breaks")
 
         def key_for(req: int, idx: int):
             # keyed to (request, position) via the one shared contract:
@@ -1107,7 +1514,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 "A/B baseline — drop spec_k to use it")
         if spec_k is not None:
             return run_spec(prompts, n_new_of, slots, rules, eos_id,
-                            arrivals, kv_blocks)
+                            arrivals, kv_blocks, priorities)
 
         # the pallas int8-pool attention only when the pool is
         # UNSHARDED; a mesh pool keeps the jnp path (see make_serve_step)
@@ -1115,7 +1522,9 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                         rules)
         rstate = _Run(slots, rules, kv_blocks, 0, n_new_of, prompts)
         tokens = jnp.zeros((slots,), jnp.int32)
-        queue = deque(enumerate(prompts))
+        sched = _Sched(prompts, n_new_of, policy, aging, priorities,
+                       arrivals, time.monotonic())
+        lens_of = [int(jnp.asarray(p).shape[-1]) for p in prompts]
         active: dict[int, int] = {}              # slot → request index
         firsts: dict[int, Any] = {}              # req → prefill token
         span: dict[int, tuple] = {}              # req → (slot, start wave)
@@ -1125,30 +1534,67 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         latencies: list[float] = []
         # chunked-prefill interleaving state: slot → in-flight admission
         filling: dict[int, dict] = {}
+        # lazy-growth state: granted table entries per slot; stalled
+        # slots (growth found the pool empty) with their saved token;
+        # fragmented requests' per-wave indices (a stall breaks the
+        # contiguous hist span the fast assembly path slices)
+        granted: dict[int, int] = {}
+        stalled: dict[int, tuple] = {}           # slot → (req, token)
+        frag: dict[int, list] = {}               # req → active wave idxs
+        admit_seq: dict[int, int] = {}           # req → admission order
+        admit_counter = [0]                      # monotone: re-admission
+        #                                          must read as YOUNGER
         mask_key: list = [None, None]    # active-set key → device mask
         hist: list = []          # one [slots] token vector per step wave
-        t0 = time.monotonic()
+        t0 = sched.t0
 
-        def arrived(req):
-            return _arrived(arrivals, t0, req)
+        def retire(req, ntok, steps):
+            done_at[req] = ntok
+            rstate.retire_wave[req] = len(hist)
+            rstate.retire_blocks(req)
+            _note_retire(meta, latencies, req, ntok, steps)
 
-        def activate(slot, req, first):
+        def activate(slot, req, first, entries):
             """First-token bookkeeping shared by both admission paths."""
             nonlocal tokens
             tokens = tokens.at[slot].set(first)
             firsts[req] = first
             span[req] = (slot, len(hist))
             count[req] = 1
+            granted[slot] = entries
+            rstate.admit_wave[req] = len(hist)
+            admit_seq[req] = admit_counter[0]
+            admit_counter[0] += 1
             # a request the prefill token already satisfied must retire
             # BEFORE any step, or it collects an extra token
             if n_new_of[req] == 1 or (eos_id is not None
                                       and eos_check_every == 1
                                       and int(first) == eos_id):
-                done_at[req] = 1
-                rstate.retire_blocks(req)
-                _note_retire(meta, latencies, req, 1, 0)
+                retire(req, 1, 0)
                 return
             active[slot] = req
+
+        def mark_frag(req):
+            """Convert a request to fragmented assembly: its step waves
+            so far are the contiguous span from admission."""
+            if req not in frag:
+                sw = span[req][1]
+                frag[req] = list(range(sw, sw + count[req] - 1))
+
+        def try_grow(slot, req) -> bool:
+            """Ensure the slot's next write position has a granted
+            block; grow by one when it crosses. False = pool empty."""
+            nxt = prefix_len + lens_of[req] + count[req] - 1
+            if nxt // bs < granted[slot]:
+                return True
+            b = rstate.grow_block(req)
+            if b is None:
+                return False
+            rstate.pool = _grow_table(
+                jnp.int32(slot), jnp.int32(granted[slot]), jnp.int32(b),
+                rstate.pool)
+            granted[slot] += 1
+            return True
 
         # Host bookkeeping is integer-only: the loop keeps whole [slots]
         # token vectors per wave and assembles outputs AFTER the
@@ -1167,50 +1613,69 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         # eager (one host int per admission) at W=1, caught by the
         # periodic scan/assembly truncation at W>1.
         eos_pending = 0                  # waves since the last eos scan
-        while queue or active or filling:
-            # admission: every free slot takes the next ARRIVED queued
-            # request whose block grant fits; FIFO — the head blocks
-            # (fairness over utilisation; document, don't starve).
+        while len(sched) or active or filling or stalled:
+            if lazy_growth and stalled:
+                # resume stalled slots BEFORE admission: freed blocks
+                # must reach the oldest stalled request first, or a
+                # preempted request's re-admission could re-grab them
+                # every cycle and starve the stalled one forever (the
+                # livelock the preemption exists to break). Restores
+                # each slot's last real token — the step overwrites
+                # every row, active or not.
+                for slot in list(stalled):
+                    req, tok = stalled[slot]
+                    if try_grow(slot, req):
+                        tokens = tokens.at[slot].set(tok)
+                        active[slot] = req
+                        del stalled[slot]
+            # admission: every free slot takes the POLICY's next ARRIVED
+            # request whose block grant fits; the candidate blocks
+            # (fairness over utilisation; document, don't starve — and
+            # the aging bound keeps non-fifo policies starvation-free).
             # ``static_batching`` is the RUN-TO-COMPLETION A/B baseline
             # (bench.py section_serve_engine): admission only when the
             # engine is fully idle, so early finishers idle until the
             # whole resident batch drains — identical compiled steps
             # and dispatch pattern, different SCHEDULER, which is
             # exactly the variable the comparison isolates
-            admit_ok = not static_batching or (not active and not filling)
+            admit_ok = not static_batching or (not active and not filling
+                                               and not stalled)
             for slot in range(slots):
                 if not admit_ok or slot in active or slot in filling \
-                        or not queue:
+                        or slot in stalled or not len(sched):
                     continue
-                req, prompt = queue[0]
-                if not arrived(req):
-                    break
-                prompt = jnp.asarray(prompt)
+                req = sched.candidate()
+                if req is None:
+                    break                        # nothing arrived yet
+                prompt = jnp.asarray(prompts[req])
                 key = key_for(req, 0) if sampler is not None else None
                 wait_s = _queue_wait(arrivals, t0, req)
                 if prefill_chunk is None:
-                    first = _admit_one(rstate, slot, req, prompt, key,
-                                       meta, wait_s)
-                    if first is None:
+                    got = _admit_one(rstate, slot, req, prompt, key,
+                                     meta, wait_s)
+                    if got is None:
                         break                    # blocks exhausted: hold
-                    queue.popleft()
-                    activate(slot, req, first)
+                    first, entries = got
+                    sched.pop(req)
+                    activate(slot, req, first, entries)
                 else:
                     length = int(prompt.shape[-1])
-                    got = rstate.admit_blocks(req, length)
+                    got = rstate.admit_blocks(req, prompt, length)
                     if got is None:
                         break
-                    row, tail = got
-                    queue.popleft()
+                    row, tail, start, cov, entries = got
+                    sched.pop(req)
                     _note_admit(meta, req, wait_s)
                     rstate.pool = _admit_table(jnp.int32(slot), row,
-                                               tail, rstate.pool)
-                    chunks, last_idx, true_pos = _chunk_split(prompt,
-                                                              length)
+                                               tail, jnp.int32(start),
+                                               rstate.pool)
+                    suffix = prompt[cov:] if cov else prompt
+                    chunks, last_idx, true_pos = _chunk_split(
+                        suffix, length - cov, start)
                     filling[slot] = {
                         "req": req, "key": key, "len": length,
                         "chunks": chunks, "last_idx": last_idx,
-                        "true_pos": true_pos,
+                        "true_pos": true_pos, "entries": entries,
                         # span start: the prefill span of an INTERLEAVED
                         # admission covers the decode waves riding
                         # between its chunks (the host's honest view)
@@ -1236,14 +1701,48 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                     del filling[slot]
                     _note_prefill(meta, req, f["clk0"], f["len"],
                                   chunks=f["next"])
-                    activate(slot, req, first)
-            waiting = _waiting(queue, arrivals, t0)
-            rstate.sample()
-            _gauges(rstate, waiting, len(active) + len(filling))
+                    rstate.register_prefix(req)
+                    activate(slot, req, first, f["entries"])
+            if lazy_growth:
+                # grow any active slot whose next write crosses into an
+                # ungranted table entry, stalling it when the pool is
+                # dry (writes fenced, position frozen, token saved: a
+                # bounded bubble, never different output)
+                for slot, req in list(active.items()):
+                    if not try_grow(slot, req):
+                        mark_frag(req)
+                        stalled[slot] = (req, tokens[slot])
+                        del active[slot]
+            waiting = sched.waiting()
+            sched.tick()
+            busy = len(active) + len(filling) + len(stalled)
+            rstate.sample(live=busy)
+            _gauges(rstate, waiting, busy)
             if not active:
-                if not filling and queue and arrivals is not None \
-                        and not arrived(queue[0][0]):
-                    _sleep_until_arrival(arrivals, queue, t0)
+                if stalled and not filling:
+                    # every live request is stalled on block growth and
+                    # nothing else can free capacity: preempt the
+                    # YOUNGEST back to the queue (its blocks free, its
+                    # tokens regenerate identically on re-admission —
+                    # greedy and (request, position)-keyed sampling are
+                    # both schedule-invariant)
+                    slot = max(stalled,
+                               key=lambda s: admit_seq[stalled[s][0]])
+                    req, _tok = stalled.pop(slot)
+                    rstate.preempted += 1
+                    rstate.retire_blocks(req)    # frees; index retains
+                    sched.requeue(req)
+                    del count[req], span[req]
+                    firsts.pop(req, None)
+                    frag.pop(req, None)
+                    meta.pop(req, None)
+                    granted.pop(slot, None)
+                    continue
+                if not filling and len(sched) and arrivals is not None \
+                        and sched.candidate() is None:
+                    # nothing admissible until the blocking request
+                    # arrives (fifo: the head; else: the earliest)
+                    _sleep_until_arrival(arrivals, sched, t0)
                 continue
             # one compiled step advances every slot (idle slots compute
             # too — the static-shape bubble; their writes are fenced to
@@ -1272,13 +1771,13 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 tokens, rstate.pool = step(tokens, active_mask, reqs,
                                            poss, rng, rstate.pool)
             hist.append(tokens)
+            for slot, req in active.items():
+                if req in frag:                  # stalled-ever requests
+                    frag[req].append(len(hist) - 1)
             for slot, req in list(active.items()):
                 count[req] += 1
                 if count[req] >= n_new_of[req]:
-                    done_at[req] = count[req]
-                    rstate.retire_blocks(req)
-                    _note_retire(meta, latencies, req, count[req],
-                                 count[req] - 1)
+                    retire(req, count[req], count[req] - 1)
                     del active[slot]             # slot recycles next wave
             if eos_id is not None:
                 eos_pending += 1
@@ -1287,10 +1786,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                     eos_pending = 0
                     for slot, req in list(active.items()):
                         if int(tok_h[slot]) == eos_id:
-                            done_at[req] = count[req]
-                            rstate.retire_blocks(req)
-                            _note_retire(meta, latencies, req,
-                                         count[req], count[req] - 1)
+                            retire(req, count[req], count[req] - 1)
                             del active[slot]
                 elif eos_pending >= eos_check_every:
                     # one flush per W waves: scan the batched window for
@@ -1306,13 +1802,10 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                         for j in range(block.shape[0]):
                             h = base + j
                             if h >= sw and int(block[j, slot]) == eos_id:
-                                done_at[req] = h - sw + 2
-                                rstate.retire_blocks(req)
-                                _note_retire(meta, latencies, req,
-                                             done_at[req],
-                                             done_at[req] - 1)
+                                retire(req, h - sw + 2, h - sw + 1)
                                 del active[slot]
                                 break
+        rstate.close()
         _gauges(rstate, 0, 0)
 
         waves = jnp.stack(hist) if hist else None      # [W, slots]
@@ -1321,6 +1814,13 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             n, (slot, sw) = done_at[req], span[req]
             if n == 1:
                 outs.append(firsts[req][None])
+            elif req in frag:
+                # a growth stall fragmented this request's tenancy: its
+                # emissions are the recorded active waves, not a
+                # contiguous slice
+                idx = jnp.asarray(frag[req][:n - 1], jnp.int32)
+                outs.append(jnp.concatenate(
+                    [firsts[req][None], waves[idx, slot]]))
             else:
                 # the n-1 step waves while req held its slot are exactly
                 # hist[sw : sw+n-1] — one emission per active wave

@@ -368,6 +368,58 @@ def _run_smoketest(
                     checks["serve_engine_error"] = str(exc)
                 ok &= checks["serve_engine_ok"]
 
+            # serve scheduler levers: cross-request prefix sharing +
+            # lazy block growth are contractually SCHEDULING — shared
+            # blocks and per-wave table growth must not change a single
+            # token — so a tiny shared-prefix workload through the
+            # lever engine must BIT-match the baseline engine (and
+            # policy="fifo" must BE the baseline), on this slice's real
+            # lowering. Mirrors flash_pipeline_ok: gate the scheduler
+            # rewrite on chip before a serving job trusts it. Tiny,
+            # unsharded, process-local (no collectives — every host
+            # validates independently at any world size).
+            if checks.get("serve_engine_ok"):
+                try:
+                    from ..models.serving import make_serve_engine
+                    from ..utils.traffic import shared_prefix_prompts
+
+                    scfg = BurnInConfig(
+                        vocab=128, d_model=32, n_heads=4, d_ff=64,
+                        n_layers=2, seq_len=16, batch=2,
+                        dtype=jax.numpy.float32)
+                    sparams = init_params(jax.random.PRNGKey(11), scfg)
+                    pairs = shared_prefix_prompts(
+                        5, seed=0, n_templates=2, template_len=9,
+                        suffix_lo=1, suffix_hi=4, vocab=scfg.vocab)
+                    sprompts = [jax.numpy.asarray(p, jax.numpy.int32)
+                                for _t, p in pairs]
+                    sbudgets = [2, 5, 1, 4, 3]
+                    sml = max(int(p.shape[-1]) + n
+                              for p, n in zip(sprompts, sbudgets))
+                    base = make_serve_engine(sparams, scfg, max_len=sml,
+                                             kv_block=4, policy="fifo")
+                    b_outs = base(sprompts, sbudgets, slots=2)
+                    lever = make_serve_engine(sparams, scfg, max_len=sml,
+                                              kv_block=4,
+                                              share_prefix=True,
+                                              lazy_growth=True)
+                    l_outs = lever(sprompts, sbudgets, slots=2)
+                    match = all(
+                        bool(jax.device_get(jax.numpy.array_equal(a, b)))
+                        for a, b in zip(l_outs, b_outs))
+                    st = lever.last_stats
+                    checks["serve_sched_ok"] = (
+                        match and st["prefix"]["hit_blocks"] > 0
+                        and st["kv"]["in_use"] == 0)
+                    checks["serve_sched_prefix_hit_blocks"] = \
+                        st["prefix"]["hit_blocks"]
+                    checks["serve_sched_blocks_grown_lazy"] = \
+                        st["kv"]["blocks_grown_lazy"]
+                except Exception as exc:  # JSON contract > the type
+                    checks["serve_sched_ok"] = False
+                    checks["serve_sched_error"] = str(exc)
+                ok &= checks["serve_sched_ok"]
+
             # flash pipeline gate: the software-pipelined kernels
             # (ops/flash_attention.py, pipeline="on") are contractually a
             # SCHEDULING change — same sub-tile folds, same arithmetic —

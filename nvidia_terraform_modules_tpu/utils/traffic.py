@@ -31,7 +31,10 @@ Processes:
 Traces are plain ``list[float]`` of arrival offsets in seconds,
 ascending from 0. :func:`ragged_lengths` rides along for the matching
 per-request prompt/output-length draws — ragged lengths are the whole
-reason the paged KV cache exists, so the workload generator owns them.
+reason the paged KV cache exists, so the workload generator owns them —
+and :func:`shared_prefix_prompts` for Zipf-popularity template
+workloads, the shared-leading-span shape the serving engine's
+cross-request prefix sharing exists for.
 """
 
 from __future__ import annotations
@@ -173,6 +176,64 @@ def ragged_lengths(n: int, seed: int = 0, *, lo: int = 1, hi: int = 64,
     scale = mean - lo
     return [max(lo, min(hi, lo + int(r.expovariate(1.0 / scale))))
             for _ in range(n)]
+
+
+def shared_prefix_prompts(n: int, seed: int = 0, *,
+                          n_templates: int = 4, zipf_s: float = 1.2,
+                          template_len: int = 32, suffix_lo: int = 1,
+                          suffix_hi: int = 16,
+                          vocab: int = 256) -> list[tuple[int, list[int]]]:
+    """``n`` seeded ``(template_id, prompt)`` pairs for prefix-reuse
+    workloads: a pool of ``n_templates`` fixed token templates with
+    ZIPF popularity (template rank ``r`` drawn ∝ ``1 / r**zipf_s`` —
+    the few-hot-prompts shape real serving traffic has: system prompts,
+    few-shot preambles, popular documents), each request appending a
+    seeded ragged suffix of ``suffix_lo..suffix_hi`` fresh tokens.
+
+    The shared span is the whole reason the serving engine's
+    cross-request prefix sharing exists, so the workload generator owns
+    it the way :func:`ragged_lengths` owns raggedness: stdlib-only,
+    STRING-seeded (cross-process deterministic — same seed, same
+    templates, same draws, whatever PYTHONHASHSEED says), one
+    ``(n, seed, params)`` tuple → one byte-identical workload for
+    bench, tests and the tfsim fleet simulator alike.
+    """
+    if n < 0:
+        raise ValueError(f"n must be >= 0, got {n}")
+    if n_templates < 1:
+        raise ValueError(f"n_templates must be >= 1, got {n_templates}")
+    if template_len < 1:
+        raise ValueError(f"template_len must be >= 1, got {template_len}")
+    if not 1 <= suffix_lo <= suffix_hi:
+        raise ValueError(
+            f"need 1 <= suffix_lo <= suffix_hi, got "
+            f"lo={suffix_lo} hi={suffix_hi}")
+    if vocab < 2:
+        raise ValueError(f"vocab must be >= 2, got {vocab}")
+    if zipf_s <= 0:
+        raise ValueError(f"zipf_s must be > 0, got {zipf_s}")
+    r = _rng(seed, salt="prefix")
+    templates = [[r.randrange(vocab) for _ in range(template_len)]
+                 for _ in range(n_templates)]
+    weights = [1.0 / (rank + 1) ** zipf_s for rank in range(n_templates)]
+    total = sum(weights)
+    cum = []
+    acc = 0.0
+    for w in weights:
+        acc += w / total
+        cum.append(acc)
+    # float rounding can leave cum[-1] a hair under 1.0 while random()
+    # reaches 1 - 2**-53 — pin the last boundary so the draw can never
+    # fall off the end of the table
+    cum[-1] = 1.0
+    out: list[tuple[int, list[int]]] = []
+    for _ in range(n):
+        u = r.random()
+        tid = next(i for i, c in enumerate(cum) if u <= c)
+        suffix = [r.randrange(vocab)
+                  for _ in range(r.randint(suffix_lo, suffix_hi))]
+        out.append((tid, templates[tid] + suffix))
+    return out
 
 
 def trace_summary(times: Sequence[float]) -> dict[str, float]:

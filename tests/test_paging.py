@@ -19,7 +19,10 @@ import pytest
 from nvidia_terraform_modules_tpu.models import BurnInConfig, init_params
 from nvidia_terraform_modules_tpu.models.paging import (
     BlockAllocator,
+    PrefixIndex,
     blocks_for_rows,
+    chain_chunks,
+    chunk_tokens_covered,
     init_paged_cache,
     paged_pool_spec,
 )
@@ -93,6 +96,149 @@ def test_allocator_validates_construction():
         BlockAllocator(1)                       # nothing beyond reserved
     with pytest.raises(ValueError, match="allocate"):
         BlockAllocator(4).alloc(-1)
+
+
+# -------------------------------------------------- refcounts + sharing
+
+
+def test_share_adds_reference_and_free_only_frees_at_zero():
+    """The cross-request sharing contract: a shared block survives its
+    first free (refcount 2 → 1) and only returns to the free list at
+    zero — freeing past zero is as loud as any double free."""
+    a = BlockAllocator(5)
+    got = a.alloc(2)
+    a.share(got)                                # refcount 2 each
+    assert a.refcount(got[0]) == 2
+    assert a.in_use == 2 and a.refs_total == 4
+    a.free(got)                                 # writer retires
+    assert a.in_use == 2                        # still resident
+    assert a.free_blocks == 2
+    a.free(got)                                 # last reader retires
+    assert a.in_use == 0 and a.free_blocks == 4
+    with pytest.raises(ValueError, match="not allocated"):
+        a.free(got[:1])                         # past zero: loud
+    # sharing an unallocated (or reserved) block is refused whole
+    with pytest.raises(ValueError, match="not allocated"):
+        a.share([0])
+    b = a.alloc(1)
+    with pytest.raises(ValueError, match="not allocated"):
+        a.share(b + [4] if b[0] != 4 else b + [3])
+
+
+def test_refcounted_pool_returns_to_initial_free_count():
+    """Leak check at the allocator level: an admit/share/retire sweep
+    in any interleaving ends with every block back on the free list."""
+    a = BlockAllocator(9)
+    initial = a.free_blocks
+    g1 = a.alloc(3)
+    g2 = a.alloc(2)
+    a.share(g1)                                 # a second table maps g1
+    a.free(g1)
+    a.free(g2)
+    a.share(g1[:1])                             # third ref mid-life
+    a.free(g1)
+    a.free(g1[:1])
+    assert a.in_use == 0 and a.refs_total == 0
+    assert a.free_blocks == initial
+
+
+def _index_pool(n=12, cap=2):
+    a = BlockAllocator(n)
+    return a, PrefixIndex(a, cap)
+
+
+def test_prefix_index_match_register_roundtrip():
+    """Register a donor's chain, match it back: full-chain hit shares
+    the SAME physical blocks (refcount++), a diverging suffix stops the
+    walk at the divergence, a cold index misses entirely."""
+    a, idx = _index_pool()
+    chunks = chain_chunks(list(range(12)), 4)
+    assert chunks == [(0, 1, 2, 3), (4, 5, 6, 7), (8, 9, 10, 11)]
+    donor = a.alloc(3)
+    assert idx.match(chunks) == []              # cold: miss
+    idx.register(chunks, donor)
+    assert a.refcount(donor[0]) == 2            # donor + index
+    got = idx.match(chunks)
+    assert got == donor
+    assert a.refcount(donor[0]) == 3            # + the new sharer
+    # a prompt diverging after one block shares exactly one block
+    div = chain_chunks([0, 1, 2, 3, 9, 9, 9, 9], 4)
+    assert idx.match(div) == donor[:1]
+    # the chain key covers HISTORY: same second chunk behind a
+    # different first chunk must not match the donor's second block
+    other = chain_chunks([7, 7, 7, 7, 4, 5, 6, 7], 4)
+    assert idx.match(other) == []
+
+
+def test_prefix_index_offset_grid_matches_template_tail():
+    """With a template-prefix tail offset the first own-block chunk is
+    short (block_size - offset tokens) and the grids must agree between
+    register and match."""
+    toks = list(range(10))
+    chunks = chain_chunks(toks, 4, offset=2)    # first chunk 2 tokens
+    assert chunks == [(0, 1), (2, 3, 4, 5), (6, 7, 8, 9)]
+    assert chunk_tokens_covered(0, 4, 2) == 0
+    assert chunk_tokens_covered(1, 4, 2) == 2
+    assert chunk_tokens_covered(3, 4, 2) == 10
+    a, idx = _index_pool()
+    donor = a.alloc(3)
+    idx.register(chunks, donor)
+    assert idx.match(chunks) == donor
+    with pytest.raises(ValueError, match="offset"):
+        chain_chunks(toks, 4, offset=4)
+
+
+def test_prefix_index_lru_eviction_never_evicts_referenced_blocks():
+    """The LRU cap applies to retained-but-UNREFERENCED blocks only: a
+    block a live table still references (refcount > 1) survives any
+    trim; once the reader retires the cap evicts oldest-first."""
+    a, idx = _index_pool(cap=1)
+    d1 = a.alloc(2)
+    idx.register(chain_chunks(list(range(8)), 4), d1)
+    d2 = a.alloc(1)
+    idx.register(chain_chunks([9, 9, 9, 9], 4), d2)
+    # a reader shares d1's chain → refcount 3 on those blocks
+    shared = idx.match(chain_chunks(list(range(8)), 4))
+    assert shared == d1
+    # both donors retire; d1 still read-referenced
+    a.free(d1)
+    a.free(d2)
+    evicted = idx.trim()                        # cap=1: d2's lone entry
+    assert evicted >= 0
+    assert all(a.refcount(b) >= 2 for b in d1)  # reader + index: kept
+    # reader retires → d1's blocks become retained-but-unreferenced
+    a.free(shared)
+    idx.trim()
+    assert len(idx.retained_unreferenced) <= 1
+    assert a.in_use == len(idx)                 # only indexed blocks
+    idx.release()
+    assert a.in_use == 0 and len(idx) == 0      # pool fully drained
+
+
+def test_prefix_index_eviction_cascades_to_descendants():
+    """Evicting an interior chain entry must evict its descendants too
+    (unreachable entries holding references would leak blocks)."""
+    a, idx = _index_pool(cap=0)
+    donor = a.alloc(3)
+    idx.register(chain_chunks(list(range(12)), 4), donor)
+    a.free(donor)                               # all retained now
+    idx.trim()                                  # cap 0: evict all
+    assert len(idx) == 0
+    assert a.in_use == 0
+
+
+def test_prefix_index_reclaim_under_allocation_pressure():
+    """reclaim(n) evicts retained blocks on demand — the path that
+    keeps a retained prefix from starving a new admission at a tight
+    pool cap — and reports 0 when nothing is evictable."""
+    a, idx = _index_pool(n=5, cap=8)            # 4 usable
+    donor = a.alloc(3)
+    idx.register(chain_chunks(list(range(12)), 4), donor)
+    a.free(donor)                               # 3 retained, 1 free
+    assert a.alloc(4) is None                   # pressure
+    assert idx.reclaim(3) == 3
+    assert a.alloc(4) is not None
+    assert idx.reclaim(1) == 0                  # nothing retained left
 
 
 # ---------------------------------------------------------- pool + spec

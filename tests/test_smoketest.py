@@ -50,6 +50,12 @@ def test_burnin_level(jax8):
     # unpipelined kernels at equal blocks on this backend's real lowering
     # (ops/flash_attention.py's scheduling-only contract)
     assert r.checks["flash_pipeline_ok"]
+    # the scheduler-lever gate: shared-prefix + lazy-growth serving
+    # BIT-matches the baseline engine on a shared-prefix workload
+    # (models/serving.py's scheduling-only contract), with the levers
+    # demonstrably engaged (blocks actually shared)
+    assert r.checks["serve_sched_ok"]
+    assert r.checks["serve_sched_prefix_hit_blocks"] > 0
 
 
 @pytest.mark.slow

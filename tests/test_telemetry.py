@@ -466,6 +466,52 @@ def test_serve_engine_gauges_and_span_args_export(jax8, tmp_path):
     assert len(xs) == 4 and all("decode_steps" in e["args"] for e in xs)
 
 
+def test_serve_scheduler_lever_gauges_export(jax8, tmp_path):
+    """PR 10's scheduler-lever gauges: ``prefix_hit_blocks`` /
+    ``prefix_hit_frac`` / ``blocks_grown_lazy`` carry the run's
+    cumulative values and land in the Prometheus exposition through
+    the standard path — golden-covered like the PR 8 serve gauges."""
+    import jax
+
+    from nvidia_terraform_modules_tpu.models import (
+        BurnInConfig,
+        init_params,
+    )
+    from nvidia_terraform_modules_tpu.models.serving import (
+        make_serve_engine,
+    )
+
+    cfg = BurnInConfig(vocab=64, d_model=32, n_heads=2, d_ff=64,
+                       n_layers=1, seq_len=16, batch=2)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    reg = Registry(str(tmp_path))
+    # two 8-token templates over kv_block=4 → two shareable full
+    # blocks per prompt; lazy growth on a generous pool still grows
+    # (admission grants prompt + 1 only)
+    tmpl = [jax.random.randint(jax.random.PRNGKey(80 + i), (8,), 0, 64)
+            for i in range(2)]
+    prompts = [jax.numpy.concatenate(
+        [tmpl[i % 2],
+         jax.random.randint(jax.random.PRNGKey(40 + i), (1 + i % 2,),
+                            0, 64)]) for i in range(4)]
+    engine = make_serve_engine(params, cfg, max_len=16, kv_block=4,
+                               share_prefix=True, lazy_growth=True,
+                               telemetry=reg)
+    engine(prompts, 5, slots=2)
+    st = engine.last_stats
+    assert reg.gauge("prefix_hit_blocks").value \
+        == st["prefix"]["hit_blocks"] > 0
+    assert reg.gauge("prefix_hit_frac").value \
+        == st["prefix"]["hit_frac"] > 0
+    assert reg.gauge("blocks_grown_lazy").value \
+        == st["kv"]["blocks_grown_lazy"] > 0
+    prom = reg.prometheus_text()
+    for line in ("# TYPE prefix_hit_blocks gauge",
+                 "# TYPE prefix_hit_frac gauge",
+                 "# TYPE blocks_grown_lazy gauge"):
+        assert line in prom, line
+
+
 def test_spec_engine_decode_steps_are_per_request(jax8, tmp_path):
     """The speculative engine attributes verification slot-steps to the
     REQUEST that ran them: each retirement's ``decode_steps`` is its

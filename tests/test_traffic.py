@@ -21,6 +21,7 @@ from nvidia_terraform_modules_tpu.utils.traffic import (
     make_trace,
     poisson_trace,
     ragged_lengths,
+    shared_prefix_prompts,
     spike_trace,
     trace_summary,
 )
@@ -97,6 +98,64 @@ def test_ragged_lengths_bounds_and_determinism():
     assert 4.0 < m < 14.0                       # clamped-exp around 8+2
     with pytest.raises(ValueError, match="lo"):
         ragged_lengths(3, lo=0)
+
+
+def test_shared_prefix_prompts_zipf_pool_shape_and_determinism():
+    """The prefix-reuse workload generator: (template_id, prompt)
+    pairs whose prompts literally share the template's leading span,
+    Zipf-popular (rank 0 drawn most), ragged unique suffixes, and the
+    one-seed-one-workload property the other generators keep."""
+    pairs = shared_prefix_prompts(200, seed=5, n_templates=4,
+                                  template_len=8, suffix_lo=1,
+                                  suffix_hi=6, vocab=32)
+    assert pairs == shared_prefix_prompts(200, seed=5, n_templates=4,
+                                          template_len=8, suffix_lo=1,
+                                          suffix_hi=6, vocab=32)
+    assert pairs != shared_prefix_prompts(200, seed=6, n_templates=4,
+                                          template_len=8, suffix_lo=1,
+                                          suffix_hi=6, vocab=32)
+    assert len(pairs) == 200
+    by_tid: dict = {}
+    for tid, prompt in pairs:
+        assert 0 <= tid < 4
+        assert 9 <= len(prompt) <= 14          # template + suffix
+        by_tid.setdefault(tid, []).append(prompt)
+    # prompts of one template agree on the full template span
+    for tid, prompts in by_tid.items():
+        head = prompts[0][:8]
+        assert all(p[:8] == head for p in prompts)
+    # Zipf popularity: rank 0 strictly most popular at 200 draws
+    counts = {tid: len(ps) for tid, ps in by_tid.items()}
+    assert counts[0] == max(counts.values())
+    assert counts[0] > 200 / 4                 # above uniform
+    with pytest.raises(ValueError, match="n_templates"):
+        shared_prefix_prompts(3, n_templates=0)
+    with pytest.raises(ValueError, match="suffix_lo"):
+        shared_prefix_prompts(3, suffix_lo=0)
+    with pytest.raises(ValueError, match="zipf_s"):
+        shared_prefix_prompts(3, zipf_s=0.0)
+
+
+def test_shared_prefix_prompts_survive_hash_randomisation():
+    """Cross-process determinism under a different PYTHONHASHSEED —
+    the same property the arrival traces pin, so a bench child and a
+    tfsim run see the SAME template pool for the same seed."""
+    code = ("from nvidia_terraform_modules_tpu.utils.traffic import "
+            "shared_prefix_prompts\n"
+            "print(repr(shared_prefix_prompts(6, seed=3, n_templates=2,"
+            " template_len=4, suffix_lo=1, suffix_hi=3, vocab=16)))\n")
+    outs = []
+    for hashseed in ("0", "4242"):
+        p = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            text=True,
+            env={"PYTHONHASHSEED": hashseed, "PATH": "/usr/bin:/bin"},
+            check=True)
+        outs.append(p.stdout)
+    assert outs[0] == outs[1]
+    assert repr(shared_prefix_prompts(
+        6, seed=3, n_templates=2, template_len=4, suffix_lo=1,
+        suffix_hi=3, vocab=16)) in outs[0]
 
 
 def test_make_trace_rejects_unknown_kind_and_bad_rate():
