@@ -185,11 +185,12 @@ def quantize_kv(x):
     return q.astype(jnp.int8), scale
 
 
-# Test hook: the kernel branch below is gated on real TPU, so its call-site
-# wiring (q slicing, pos broadcast, output reshape) would otherwise be
-# unreachable in CPU CI. Tests flip this to route through the kernel in
-# interpret mode (tests/test_decode_attention.py::test_cached_attention_gate).
-_FORCE_DECODE_KERNEL = False
+# Test hooks: the kernel branches below are gated on real TPU, so their
+# call-site wiring (q slicing, pos broadcast, output reshape) would
+# otherwise be unreachable in CPU CI. Tests flip these to route through
+# the kernels in interpret mode (tests/test_decode_attention.py).
+_FORCE_DECODE_KERNEL = False          # the contiguous int8 T=1 kernel
+_FORCE_PAGED_KERNEL = False           # forward_paged's "auto" resolution
 
 
 def _cached_attention(q, k_cache, v_cache, q_pos, scale,
@@ -459,10 +460,49 @@ def forward_cached(params, tokens, cache, cfg: BurnInConfig,
     return logits, new_cache
 
 
+def _paged_kernel_on(paged_kernel: str, t: int, bs: int, d: int,
+                     rules) -> bool:
+    """Resolve ``forward_paged``'s read-path dispatch for a T-token
+    step. ``"auto"`` takes the pallas paged kernel exactly when it is
+    the proven win: the T=1 decode step (prefill and ``[B, k+1]``
+    verification keep the jnp path — their q width amortises the
+    gather), an UNSHARDED pool (a pallas_call on mesh-sharded operands
+    inside jit is not a supported lowering — the same hazard
+    ``int8_kernel`` guards), lane-aligned geometry (``D % 128``,
+    ``block_size % 8`` — Mosaic's tiling grain), on real TPU (the
+    interpreter would be slower than the gather it replaces).
+    ``"on"`` forces the kernel for the T=1 step wherever it can trace
+    (tests run it in interpret mode on CPU); ``"off"`` keeps the
+    gather path — the bit-for-bit reference the kernel is gated
+    against."""
+    if paged_kernel not in ("auto", "on", "off"):
+        raise ValueError(f"unknown paged_kernel {paged_kernel!r}: "
+                         f"use auto|on|off")
+    if paged_kernel == "off" or t != 1:
+        return False
+    if paged_kernel == "on":
+        return True
+    return (rules is None and d % 128 == 0 and bs % 8 == 0
+            and (_FORCE_PAGED_KERNEL
+                 or jax.devices()[0].platform == "tpu"))
+
+
+def _gather_logical(buf, tables, rows: int):
+    """The logical-view gather — ``buf[tables]`` flattened to ``rows``
+    logical rows — shared by every fallback read of the paged cache
+    (K, V and both scale sidecars ride the same tables). This is the
+    REFERENCE path the paged kernel supersedes: one expression so the
+    four reads cannot drift, and so the lowering pin in
+    ``tests/test_decode_attention.py`` has exactly one shape to
+    assert absent."""
+    shp = (tables.shape[0], rows) + buf.shape[2:]
+    return buf[tables].reshape(shp)
+
+
 def forward_paged(params, tokens, cache, cfg: BurnInConfig,
                   rules: ShardingRules | None = None, *,
                   prefill_impl: str = "cached", active=None,
-                  int8_kernel: bool = True):
+                  int8_kernel: bool = True, paged_kernel: str = "auto"):
     """Forward ``tokens`` ``[B, T]`` through a BLOCK/PAGED KV cache.
 
     The paged twin of :func:`forward_cached` (same
@@ -476,21 +516,42 @@ def forward_paged(params, tokens, cache, cfg: BurnInConfig,
 
     Write path: the fresh rows scatter to ``(table[pos // bs], pos %
     bs)`` — one scatter per layer, disjoint across live rows because
-    the allocator (``models/paging.py``) never shares a block. Read
-    path: the logical view gathers ``k_phys[block_tables]`` →
-    ``[B, NT·bs, kv, D]`` and runs the SAME masked
+    the allocator (``models/paging.py``) never shares a block.
+
+    Read path, T=1 decode (the serve engine's wave step): the pallas
+    PAGED kernel (``ops/decode_attention.paged_decode_attention``)
+    attends straight through the block tables — the table is a
+    scalar-prefetch SMEM input and each live block is DMA'd from the
+    physical pool inside the grid, so per-wave cache traffic scales
+    with LIVE tokens, not pool size. Dead blocks (past a row's ``pos``
+    — recycled garbage included) are skipped; int8 scale sidecars ride
+    the same tables with in-kernel dequant. ``paged_kernel=
+    "auto"|"on"|"off"`` picks the dispatch (see
+    :func:`_paged_kernel_on`; ``"auto"`` = kernel on TPU for the T=1
+    unsharded lane-aligned step).
+
+    Read path, reference (``paged_kernel="off"``, prefill, multi-token
+    verification, sharded pools): the logical view gathers
+    ``k_phys[block_tables]`` → ``[B, NT·bs, kv, D]``
+    (:func:`_gather_logical`) and runs the SAME masked
     :func:`_cached_attention` the dense buffer uses (rows past each
     row's ``pos`` are position-masked, so recycled-block garbage is
-    unreachable); the int8-KV scale sidecars gather alongside and keep
-    the scale-after-dot contraction — and, gathered into a contiguous
-    buffer, the T=1 pallas decode kernel gate still applies on TPU.
+    unreachable); the scale sidecars gather alongside and keep the
+    scale-after-dot contraction — and, gathered into a contiguous
+    buffer, the T=1 int8 decode-kernel gate still applies on TPU. The
+    kernel path is bit-match gated against this gather path
+    (``tests/test_decode_attention.py``, smoketest ``paged_decode_ok``)
+    — the gather is the semantics, the kernel is the bandwidth.
 
     ``active`` ``[B]`` bool (default all-true) fences DEAD rows: an
     idle or retired slot's writes are rerouted to reserved physical
     block 0 (the garbage block) and its ``pos`` freezes — without the
     reroute, a retired slot still computing in the static batch would
     scribble over blocks the allocator already recycled to another
-    request. ``prefill_impl`` resolves as in :func:`forward_cached`
+    request. Reads need no fence on either path: a frozen row's
+    position mask (kernel liveness ≡ gather mask) already hides
+    everything past its ``pos``, and its output is never consumed.
+    ``prefill_impl`` resolves as in :func:`forward_cached`
     (``"flash"``/``"dense"`` are pos==0 prompt paths; mid-stream t>1
     forwards pass ``"cached"``).
 
@@ -523,6 +584,8 @@ def forward_paged(params, tokens, cache, cfg: BurnInConfig,
     q_pos = pos0[:, None] + jnp.arange(t)[None, :]        # [B, T]
     scale = 1.0 / (cfg.head_dim ** 0.5)
     quant = "k_scale" in cache
+    kernel_on = _paged_kernel_on(paged_kernel, t, bs, cfg.head_dim,
+                                 rules)
     if active is None:
         active = jnp.ones((b,), bool)
     blk = jnp.clip(q_pos // bs, 0, nt - 1)
@@ -550,13 +613,27 @@ def forward_paged(params, tokens, cache, cfg: BurnInConfig,
                                  prefill_impl, quant)
         if attn is not None:
             return attn
-        kv_shape = (b, nt * bs, cfg.kv_heads, cfg.head_dim)
-        k_log = new_k[li][tables].reshape(kv_shape)
-        v_log = new_v[li][tables].reshape(kv_shape)
+        if kernel_on:
+            # block-table-native read: no logical view, no gather —
+            # the kernel fetches live blocks straight from the
+            # (post-store) pool through the tables; a frozen row's
+            # reads are identical to the gather path's (same tables,
+            # same frozen pos — only WRITES are fenced, above)
+            from ..ops.decode_attention import paged_decode_attention
+
+            out = paged_decode_attention(
+                q[:, 0], new_k[li], new_v[li], tables, pos0,
+                scale=scale,
+                k_scale=new_ks[li] if quant else None,
+                v_scale=new_vs[li] if quant else None)
+            return out[:, None]
+        rows = nt * bs
+        k_log = _gather_logical(new_k[li], tables, rows)
+        v_log = _gather_logical(new_v[li], tables, rows)
         ks_log = vs_log = None
         if quant:
-            ks_log = new_ks[li][tables].reshape(kv_shape[:3])
-            vs_log = new_vs[li][tables].reshape(kv_shape[:3])
+            ks_log = _gather_logical(new_ks[li], tables, rows)
+            vs_log = _gather_logical(new_vs[li], tables, rows)
         # same guard depth as forward_cached: a mesh-sharded pool keeps
         # the jnp path whatever the caller's kernel flag says
         return _cached_attention(q, k_log, v_log, q_pos, scale,

@@ -63,7 +63,14 @@ batched ``[slots, 1]`` cached forward over the paged pool with per-slot
 positions and block tables; admission is host-side bookkeeping between
 compiled steps (the host owns WHICH request sits in a slot and WHICH
 physical blocks it holds, the device owns the math — no data-dependent
-shapes anywhere). Dead slots keep computing (the static-shape bubble)
+shapes anywhere). On TPU the wave step reads the cache through the
+BLOCK-TABLE-NATIVE pallas decode kernel
+(``ops/decode_attention.paged_decode_attention``, the
+``paged_kernel`` lever): live blocks are DMA'd straight from the
+physical pool inside the kernel grid, so per-wave cache traffic
+scales with live tokens — the jnp ``k_phys[tables]`` logical-view
+gather (which scales with POOL size) stays as the bit-match-gated
+reference path. Dead slots keep computing (the static-shape bubble)
 but their cache writes are fenced to the reserved garbage block, so a
 retired slot can never scribble over blocks already recycled to a new
 request.
@@ -232,7 +239,7 @@ def _make_pick(sampler):
 
 
 def make_serve_step(params, cfg: BurnInConfig, sampler=None, *,
-                    int8_kernel: bool = True,
+                    int8_kernel: bool = True, paged_kernel: str = "auto",
                     rules: ShardingRules | None = None):
     """Compiled all-slots decode step over the PAGED pool: one batched
     ``[slots, 1]`` cached forward (``decode.forward_paged``) with
@@ -242,10 +249,16 @@ def make_serve_step(params, cfg: BurnInConfig, sampler=None, *,
     ``active`` fences dead slots' writes to the garbage block and
     freezes their positions.
 
-    ``int8_kernel=False`` keeps an int8 pool's attention on the jnp
-    path: the engine passes it whenever the pool is mesh-sharded
-    (``rules``), where a pallas_call on sharded operands inside jit is
-    not a supported lowering (see ``forward_paged``).
+    ``paged_kernel`` picks the T=1 read path (``forward_paged``):
+    ``"auto"`` takes the block-table-native pallas kernel on TPU — the
+    wave step is THE gather-tax hot path, one kernel per layer per
+    wave — while ``"off"`` keeps the jnp gather reference the kernel
+    is bit-match gated against. ``int8_kernel=False`` keeps an int8
+    pool's attention on the jnp path: the engine passes it whenever
+    the pool is mesh-sharded (``rules``), where a pallas_call on
+    sharded operands inside jit is not a supported lowering (see
+    ``forward_paged``) — the engine demotes ``paged_kernel`` to
+    ``"off"`` under ``rules`` for exactly the same reason.
 
     Greedy (``sampler=None``): ``(tokens [slots], active, pool) →
     (next, pool)``. Sampled: ``(tokens, active, req_ids, positions,
@@ -266,7 +279,8 @@ def make_serve_step(params, cfg: BurnInConfig, sampler=None, *,
             logits, pool = forward_paged(p, tokens[:, None], pool, cfg,
                                          rules, prefill_impl="cached",
                                          active=active,
-                                         int8_kernel=int8_kernel)
+                                         int8_kernel=int8_kernel,
+                                         paged_kernel=paged_kernel)
             return jnp.argmax(logits[:, -1], axis=-1), pool
 
         return lambda tokens, active, pool: step(params, tokens, active,
@@ -277,7 +291,8 @@ def make_serve_step(params, cfg: BurnInConfig, sampler=None, *,
         logits, pool = forward_paged(p, tokens[:, None], pool, cfg,
                                      rules, prefill_impl="cached",
                                      active=active,
-                                     int8_kernel=int8_kernel)
+                                     int8_kernel=int8_kernel,
+                                     paged_kernel=paged_kernel)
         # keys derived INSIDE the compiled step (one dispatch per step
         # regardless of slot count; typed or legacy rng keys both work)
         # from the shared (request, position) contract
@@ -292,7 +307,7 @@ def make_serve_step(params, cfg: BurnInConfig, sampler=None, *,
 
 
 def make_spec_step(params, cfg: BurnInConfig, k: int, *,
-                   int8_kernel: bool = True,
+                   int8_kernel: bool = True, paged_kernel: str = "auto",
                    rules: ShardingRules | None = None):
     """Compiled all-slots SPECULATIVE step on the paged pool:
     prompt-lookup drafts + ONE batched ``[slots, k+1]`` verification
@@ -302,7 +317,7 @@ def make_spec_step(params, cfg: BurnInConfig, k: int, *,
     (``models/speculative.py`` — the acceptance core ``accept_drafts``
     is literally shared) to continuous batching: each slot drafts ``k``
     tokens by bigram lookup in its OWN context row, verifies them at
-    its OWN position through the paged gather path, and accepts the
+    its OWN position through the paged read path, and accepts the
     longest prefix matching the model's argmax chain. Rollback is
     per-slot ``pos`` arithmetic, never buffer surgery: rejected draft
     rows stay position-masked in the slot's blocks until real writes
@@ -310,8 +325,9 @@ def make_spec_step(params, cfg: BurnInConfig, k: int, *,
 
     Step signature (``ctx``/``cur``/``n_out``/``pool`` donated):
     ``(ctx [slots, Lc], cur [slots], n_out [slots], n_new [slots],
-    eos_id, active [slots] bool, stop_count, pool) → (ctx, cur, n_out,
-    fin [slots] bool, steps [slots], pool)`` where ``ctx`` rows hold
+    eos_id, active [slots] bool, stop_count, granted_rows [slots],
+    pool) → (ctx, cur, n_out, fin [slots] bool, steps [slots],
+    need_grow [slots] bool, pool)`` where ``ctx`` rows hold
     prefix+prompt+generated tokens, ``cur`` the valid length, ``n_out``
     tokens generated, ``n_new`` the PER-SLOT generation budget;
     ``eos_id < 0`` disables eos. The step is a device-resident
@@ -322,11 +338,25 @@ def make_spec_step(params, cfg: BurnInConfig, k: int, *,
     denominator; per slot: each request's decode_steps). Emission
     per slot is capped at ``n_new - n_out`` FIRST, then truncated at
     the first eos inside the capped window — so a slot can never finish
-    on an eos the cap already excluded. Frozen slots still compute a
-    forward per iteration, but their writes are fenced to the garbage
-    block and their ``pos`` frozen — a few ms of MXU time traded
-    against a ~90 ms host round trip per avoided sync (the measured
-    dispatch RTT through the tunnelled backend).
+    on an eos the cap already excluded.
+
+    ``granted_rows`` is the PER-K-TOKEN GROWTH BOUNDARY that lets
+    ``spec_k`` compose with ``lazy_growth``: a verification at ``pos``
+    writes rows ``pos..pos+k``, so a slot whose granted rows (table
+    entries × block_size) don't cover ``pos + k + 1`` is GROWTH-
+    BLOCKED — frozen for the iteration (writes fenced, state held)
+    exactly like a finished slot, and reported in ``need_grow`` so the
+    host can grant blocks at the next wave boundary. When every
+    unfinished active slot is growth-blocked the loop EXITS early
+    (whatever ``stop_count`` says — nothing on device can make
+    progress), returning control to the host-side allocator. Eagerly
+    granted engines pass the full logical row count and the machinery
+    compiles to the PR 8 behaviour bit for bit (``blocked`` is
+    constant-false). Frozen slots still compute a forward per
+    iteration, but their writes are fenced to the garbage block and
+    their ``pos`` frozen — a few ms of MXU time traded against a
+    ~90 ms host round trip per avoided sync (the measured dispatch RTT
+    through the tunnelled backend).
     """
     from .speculative import _ngram_draft, accept_drafts
 
@@ -349,29 +379,40 @@ def make_spec_step(params, cfg: BurnInConfig, k: int, *,
     vdraft = jax.vmap(lambda c, cu: _ngram_draft(c, cu, k, cfg.vocab))
 
     # params as argument, not closure — see make_serve_step
-    @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 8))
-    def step(p, ctx, cur, n_out, n_new, eos_id, active, stop_count, pool):
+    @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 9))
+    def step(p, ctx, cur, n_out, n_new, eos_id, active, stop_count,
+             granted_rows, pool):
+        def blocked_of(pool, fin):
+            # the next verification writes pos..pos+k — a slot whose
+            # grant doesn't cover them must wait for the host
+            return (pool["pos"] + (k + 1) > granted_rows) & active & ~fin
+
         def cond(s):
-            _, _, _, fin, _, _ = s
-            return jnp.sum(fin & active) < stop_count
+            _, _, _, fin, _, pool = s
+            runnable = active & ~fin & ~blocked_of(pool, fin)
+            return (jnp.sum(fin & active) < stop_count) & jnp.any(runnable)
 
         def body(s):
             ctx, cur, n_out, fin, steps, pool = s
-            # frozen = finished OR never-active: a frozen slot's writes
-            # are fenced to the garbage block (forward_paged's active
-            # mask) and its ctx/cur/pos held, so its stale state can
-            # never drift or corrupt a recycled block
-            frozen = fin | ~active
+            # frozen = finished, never-active, OR growth-blocked: a
+            # frozen slot's writes are fenced to the garbage block
+            # (forward_paged's active mask) and its ctx/cur/pos held,
+            # so its stale state can never drift or corrupt a recycled
+            # (or ungranted) block
+            blocked = blocked_of(pool, fin)
+            frozen = fin | ~active | blocked
             last = jnp.take_along_axis(
                 ctx, jnp.maximum(cur - 1, 0)[:, None], axis=1)  # [S, 1]
             draft = vdraft(ctx, cur)                            # [S, k]
             block = jnp.concatenate([last, draft], axis=1)      # [S, k+1]
             # "cached": a mid-stream t>1 forward attending over each
-            # slot's gathered blocks at its own position
+            # slot's blocks at its own position (T=k+1, so the read
+            # stays on the reference gather path — see forward_paged)
             logits, npool = forward_paged(p, block, pool, cfg, rules,
                                           prefill_impl="cached",
                                           active=~frozen,
-                                          int8_kernel=int8_kernel)
+                                          int8_kernel=int8_kernel,
+                                          paged_kernel=paged_kernel)
             preds = jnp.argmax(logits, axis=-1)                 # [S, k+1]
             nctx, ncur, nn_out, done = vaccept(ctx, cur, n_out, draft,
                                                preds, n_new, eos_id)
@@ -386,18 +427,20 @@ def make_spec_step(params, cfg: BurnInConfig, k: int, *,
             # count BEFORE updating fin: a slot's finishing step is a
             # real verification step; frozen iterations are not.
             # Per-SLOT so the host can attribute steps to requests
-            steps = steps + (active & ~fin).astype(jnp.int32)
-            fin = fin | (done & active)
+            steps = steps + (active & ~fin & ~blocked).astype(jnp.int32)
+            fin = fin | (done & active & ~blocked)
             return ctx, cur, n_out, fin, steps, npool
 
         fin0 = jnp.zeros(active.shape, bool)
         s = (ctx, cur, n_out, fin0,
              jnp.zeros(active.shape, jnp.int32), pool)
-        return jax.lax.while_loop(cond, body, s)
+        ctx, cur, n_out, fin, steps, pool = jax.lax.while_loop(
+            cond, body, s)
+        return ctx, cur, n_out, fin, steps, blocked_of(pool, fin), pool
 
     return lambda ctx, cur, n_out, n_new, eos_id, active, stop_count, \
-        pool: step(params, ctx, cur, n_out, n_new, eos_id, active,
-                   stop_count, pool)
+        granted_rows, pool: step(params, ctx, cur, n_out, n_new, eos_id,
+                                 active, stop_count, granted_rows, pool)
 
 
 def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
@@ -408,7 +451,8 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                       aging: int | None = None,
                       share_prefix: bool = False,
                       lazy_growth: bool = False,
-                      prefix_keep_blocks: int = 64):
+                      prefix_keep_blocks: int = 64,
+                      paged_kernel: str = "auto"):
     """Reusable engine: compile once, run many schedules.
 
     The compiled pieces (per-bucket admissions, the all-slots paged
@@ -493,11 +537,28 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
     the same ``kv_blocks`` cap then admits more concurrent requests on
     eos-heavy/short-output traffic, at the cost of a possible
     mid-flight STALL (and, if every live request stalls, a preemption
-    — outputs are schedule-invariant either way). ``share_prefix`` and
-    ``lazy_growth`` compose with chunked prefill but not with
-    ``spec_k`` (the speculative loop's device-resident multi-step has
-    no per-wave boundary to grow or share at — refused loudly);
-    ``lazy_growth`` requires ``eos_check_every == 1``.
+    — outputs are schedule-invariant either way). Both levers compose
+    with chunked prefill AND with ``spec_k``: a speculative admission
+    shares its full leading prompt blocks and prefills only the
+    unshared suffix like any other, and the device-resident multi-step
+    has a PER-K-TOKEN growth boundary — a lazily-granted slot whose
+    next ``[k+1]``-row verification window would cross into an
+    ungranted table entry freezes on device and hands control back to
+    the host, which grants ``spec_k + 1`` more rows of blocks (or
+    stalls / preempts, exactly as the plain loop does) before
+    re-entering (see :func:`make_spec_step`). ``lazy_growth`` requires
+    ``eos_check_every == 1`` on the plain loop.
+
+    ``paged_kernel`` (``"auto"|"on"|"off"``) picks the wave step's T=1
+    read path: ``"auto"`` routes decode attention through the
+    block-table-native pallas kernel on TPU — no per-wave
+    ``[slots, NT·bs, kv, D]`` logical-view gather, cache reads scale
+    with live tokens — falling back to the jnp gather on CPU, sharded
+    pools, or non-lane-aligned geometry; ``"off"`` keeps the gather
+    reference everywhere (the bit-match baseline); ``"on"`` forces the
+    kernel (interpret mode off-TPU — the CI/bench gate). Admission and
+    verification forwards always use the gather path (their q width
+    amortises it).
 
     ``telemetry`` injects a telemetry registry (default: the process
     registry — the no-op unless ``TPU_TELEMETRY_DIR`` is set). When
@@ -527,12 +588,9 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             raise ValueError(
                 "speculative serving is greedy-only: acceptance tests "
                 "the model's argmax chain — drop sampler or spec_k")
-        if share_prefix or lazy_growth:
-            raise ValueError(
-                "share_prefix/lazy_growth need the plain loop's "
-                "per-wave host boundary to map shared blocks and grow "
-                "tables at — the speculative multi-step runs on device "
-                "until retirement; drop spec_k or the lever")
+    if paged_kernel not in ("auto", "on", "off"):
+        raise ValueError(f"unknown paged_kernel {paged_kernel!r}: "
+                         f"use auto|on|off")
     if policy not in _POLICIES:
         raise ValueError(
             f"unknown policy {policy!r}: use {' | '.join(_POLICIES)}")
@@ -621,15 +679,16 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         if prefix_tail_rows:
             pool = _tail_copy(pool, tail[0], tail[1])
         sub = _sub1(pool, tables, slot, start)
-        # int8_kernel OFF on every admission path: these jits compile
-        # once per engine but run against pools a later run() may have
-        # mesh-sharded (the pallas-on-sharded-operands hazard fires at
-        # t==1 — single-token prompts, C=1 chunks), and admission is a
-        # one-shot dispatch, not the bandwidth-bound wave loop the
-        # kernel exists for
+        # int8_kernel and paged_kernel OFF on every admission path:
+        # these jits compile once per engine but run against pools a
+        # later run() may have mesh-sharded (the pallas-on-sharded-
+        # operands hazard fires at t==1 — single-token prompts, C=1
+        # chunks), and admission is a one-shot dispatch, not the
+        # bandwidth-bound wave loop the kernels exist for
         logits, sub = forward_paged(p, prompt, sub, cfg,
                                     prefill_impl=impl,
-                                    int8_kernel=False)
+                                    int8_kernel=False,
+                                    paged_kernel="off")
         return pick(logits, -1, key), _merge(pool, sub, tables, slot)
 
     @functools.partial(jax.jit, donate_argnums=(4,))
@@ -670,7 +729,8 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             sub = _sub1(pool, tables, slot, pool["pos"][slot])
             logits, sub = forward_paged(p, chunks[:, i], sub, cfg,
                                         prefill_impl="cached",
-                                        int8_kernel=False)
+                                        int8_kernel=False,
+                                        paged_kernel="off")
             pool = _merge(pool, sub, tables, slot)
             # keep only the FINAL live chunk's last-token logits
             row = jnp.where(i == n - 1, logits[0, last_idx], row)
@@ -694,7 +754,8 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         sub = _sub1(pool, tables, slot, pool["pos"][slot])
         logits, sub = forward_paged(p, chunk, sub, cfg,
                                     prefill_impl="cached",
-                                    int8_kernel=False)  # see _admit_full
+                                    int8_kernel=False,   # see _admit_full
+                                    paged_kernel="off")
         return logits[0], _merge(pool, sub, tables, slot)
 
     @functools.partial(jax.jit, donate_argnums=(4,))
@@ -714,7 +775,8 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         impl = _prefix_impl
         _logits, sub = forward_paged(p, prefix_toks, sub, cfg,
                                      prefill_impl=impl,
-                                     int8_kernel=False)  # see _admit_full
+                                     int8_kernel=False,  # see _admit_full
+                                     paged_kernel="off")
         out = dict(pool)
         for key_ in pool_keys:
             out[key_] = sub[key_]
@@ -747,23 +809,26 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
     _steps: dict[tuple, Any] = {}
 
     def step_for(kind: str, int8_kernel: bool, rules):
-        # ONE cached step per (kind, kernel-flag): a different rules
+        # ONE cached step per (kind, kernel-flags): a different rules
         # object rebuilds that slot (recompile) rather than growing a
         # keyed-by-id cache without bound — callers alternating rules
         # objects pay compiles, never leak them. The entry keeps the
-        # rules reference so its id stays valid while cached.
-        key_ = (kind, int8_kernel)
+        # rules reference so its id stays valid while cached. The
+        # paged kernel demotes to the gather path under rules exactly
+        # like the int8 kernel (pallas on sharded operands).
+        pk = paged_kernel if rules is None else "off"
+        key_ = (kind, int8_kernel, pk)
         rid = None if rules is None else id(rules)
         ent = _steps.get(key_)
         if ent is None or ent[0] != rid:
             if kind == "spec":
                 step = make_spec_step(params, cfg, spec_k,
                                       int8_kernel=int8_kernel,
-                                      rules=rules)
+                                      paged_kernel=pk, rules=rules)
             else:
                 step = make_serve_step(params, cfg, sampler,
                                        int8_kernel=int8_kernel,
-                                       rules=rules)
+                                       paged_kernel=pk, rules=rules)
             _steps[key_] = (rid, step, rules)
         return _steps[key_][1]
 
@@ -897,7 +962,12 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             k = len(shared)
             budget = prefix_len + length + self.n_new_of[req] \
                 + self.headroom
-            grant = (prefix_len + length + 1) if lazy_growth else budget
+            # lazy grant covers the first write window: one decode row
+            # for the plain loop, the k+1-row verification window for
+            # the speculative loop (headroom == spec_k) — the per-
+            # k-token growth boundary that lets spec compose with lazy
+            grant = (prefix_len + length + 1 + self.headroom) \
+                if lazy_growth else budget
             if prefill_chunk is not None:
                 padded_end = prefix_len + cov + -(-(
                     length - cov) // prefill_chunk) * prefill_chunk
@@ -1064,6 +1134,12 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         _g_hit = reg.gauge("prefix_hit_blocks")
         _g_hitf = reg.gauge("prefix_hit_frac")
         _g_lazy = reg.gauge("blocks_grown_lazy")
+        # per-wave decode time: the paged-kernel lever's live signal
+        # (the gather path scales with pool size, the kernel with live
+        # tokens — watch this drop when paged_kernel engages). Honest
+        # wall time whenever the wave ends in a readback (eos checks,
+        # the spec multi-step); dispatch time on fully-async schedules.
+        _g_paged = reg.gauge("paged_decode_ms")
 
     def _gauges(rstate: _Run, waiting: int, busy: int):
         if reg.enabled:
@@ -1174,18 +1250,22 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         dispatch (``_chunk_sweep``): keeps chunked admission's memory
         ceiling (``[C, S_max]`` scores) and one-compile-per-engine
         property without paying a host dispatch per chunk. Spec-loop
-        only — the levers that would change its block grant are
-        refused with ``spec_k`` at engine build."""
+        only. Under cross-request sharing only the UNSHARED suffix is
+        chunked and swept — the shared span's blocks are mapped
+        read-only and its prefill compute skipped, exactly like the
+        interleaved path."""
         length = int(prompt.shape[-1])
         got = rstate.admit_blocks(req, prompt, length)
         if got is None:
             return None
-        row, tail, start, _cov, entries = got
+        row, tail, start, cov, entries = got
         _note_admit(meta, req, wait_s)
         t0c = _clk()
         rstate.pool = _admit_table(jnp.int32(slot), row, tail,
                                    jnp.int32(start), rstate.pool)
-        chunks, last_idx, true_pos = _chunk_split(prompt, length)
+        suffix = prompt[cov:] if cov else prompt
+        chunks, last_idx, true_pos = _chunk_split(suffix, length - cov,
+                                                  start)
         c = prefill_chunk
         # ONE [1, MC, C] buffer per admission (static shape → one
         # compile per engine); trailing dead chunks never execute
@@ -1198,6 +1278,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         first, rstate.pool = _chunk_sweep(
             prefill_params, buf, jnp.int32(len(chunks)), last_idx,
             rstate.pool, jnp.int32(slot), key, true_pos)
+        rstate.register_prefix(req)
         _note_prefill(meta, req, t0c, length, chunks=len(chunks))
         return first, entries
 
@@ -1225,7 +1306,13 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         RETIREMENT WAVE, not per step: the compiled multi-step loops
         on device until enough slots finish (one, when requests are
         queued and a slot should recycle promptly; all active, when
-        the queue is empty and nothing is waiting to admit)."""
+        the queue is empty and nothing is waiting to admit) — or, under
+        ``lazy_growth``, until every unfinished slot hits its granted-
+        rows boundary, at which point the host grants ``spec_k + 1``
+        more rows of blocks per blocked slot and re-enters. A grant
+        the pool cannot cover STALLS the slot (state frozen on device
+        exactly like a finished slot's); all-stalled preempts the
+        YOUNGEST back to the queue, mirroring the plain loop."""
         rstate = _Run(slots, rules, kv_blocks, spec_k, n_new_of, prompts)
         spec_step = step_for("spec", cache_dtype != "int8"
                              or rules is None, rules)
@@ -1242,6 +1329,16 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         meta: dict[int, dict] = {}
         latencies: list[float] = []
         req_steps: dict[int, int] = {}           # req → its slot-steps
+        # lazy-growth state (all no-ops when the lever is off): granted
+        # table entries per slot, host mirror of each slot's device pos
+        # (the growth target is pos + k + 1), stalled slots, admission
+        # order (preemption takes the youngest)
+        granted: dict[int, int] = {}
+        pos_h_of: dict[int, int] = {}
+        stalled: dict[int, int] = {}             # slot → req
+        admit_seq: dict[int, int] = {}
+        admit_counter = [0]
+        full_rows = jnp.full((slots,), nt * bs, jnp.int32)
         slot_steps = 0
         host_waves = 0                 # retirement waves (host syncs)
         generated = 0
@@ -1249,9 +1346,31 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         eos_dev = jnp.int32(-1 if eos_id is None else eos_id)
         t0 = sched.t0
 
-        while len(sched) or active:
+        def grow_to(slot: int, req: int, target_rows: int) -> bool:
+            """Grant blocks until the slot's table covers
+            ``target_rows`` (False: pool dry — the caller stalls)."""
+            while granted[slot] * bs < target_rows:
+                b_ = rstate.grow_block(req)
+                if b_ is None:
+                    return False
+                rstate.pool = _grow_table(
+                    jnp.int32(slot), jnp.int32(granted[slot]),
+                    jnp.int32(b_), rstate.pool)
+                granted[slot] += 1
+            return True
+
+        while len(sched) or active or stalled:
+            if lazy_growth and stalled:
+                # resume stalled slots BEFORE admission — freed blocks
+                # must reach the oldest stalled request first (the
+                # plain loop's livelock-breaking order)
+                for slot in list(stalled):
+                    req = stalled[slot]
+                    if grow_to(slot, req, pos_h_of[slot] + spec_k + 1):
+                        active[slot] = req
+                        del stalled[slot]
             for slot in range(slots):
-                if slot in active or not len(sched):
+                if slot in active or slot in stalled or not len(sched):
                     continue
                 req = sched.candidate()
                 if req is None:
@@ -1264,11 +1383,15 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                             meta, wait_s)
                 if got is None:
                     break                        # blocks exhausted: hold
-                first, _entries = got
+                first, entries = got
                 sched.pop(req)
                 rstate.admit_wave[req] = host_waves
+                admit_seq[req] = admit_counter[0]
+                admit_counter[0] += 1
                 length = int(prompt.shape[-1])
                 start_of[req] = prefix_len + length
+                granted[slot] = entries
+                pos_h_of[slot] = prefix_len + length
                 ctxbuf, cur, n_out = _spec_admit_row(
                     prompt, first, jnp.int32(slot), ctxbuf, cur, n_out)
                 generated += 1
@@ -1284,9 +1407,28 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 active[slot] = req
             waiting = sched.waiting()
             sched.tick()
-            rstate.sample(live=len(active))
-            _gauges(rstate, waiting, len(active))
+            rstate.sample(live=len(active) + len(stalled))
+            _gauges(rstate, waiting, len(active) + len(stalled))
             if not active:
+                if lazy_growth and stalled:
+                    # every live request is stalled on block growth:
+                    # preempt the YOUNGEST back to the queue (its
+                    # blocks free; greedy tokens regenerate
+                    # identically on re-admission)
+                    slot = max(stalled, key=lambda s: admit_seq[stalled[s]])
+                    req = stalled.pop(slot)
+                    rstate.preempted += 1
+                    rstate.retire_blocks(req)    # frees; index retains
+                    sched.requeue(req)
+                    meta.pop(req, None)
+                    start_of.pop(req, None)
+                    granted.pop(slot, None)
+                    # step accounting restarts with the re-admission —
+                    # the retirement span's decode_steps must describe
+                    # the run that produced the output, matching the
+                    # plain loop's count/span reset on preemption
+                    req_steps.pop(req, None)
+                    continue
                 if len(sched):
                     if arrivals is not None and sched.candidate() is None:
                         # nothing admissible until the blocking request
@@ -1301,6 +1443,9 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             n_new_dev = jnp.asarray(
                 [n_new_of[active[s]] if s in active else 0
                  for s in range(slots)], jnp.int32)
+            granted_rows = (jnp.asarray(
+                [granted.get(s, 0) * bs for s in range(slots)],
+                jnp.int32) if lazy_growth else full_rows)
             # wave size follows the admission backlog: with a deep queue
             # the next admissions arrive as a batch anyway, so drain as
             # many slots as there are requests waiting (one sync per
@@ -1309,13 +1454,20 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             # active slot to completion — nothing is waiting to admit
             stop = (min(len(active), max(1, waiting))
                     if len(sched) else len(active))
-            ctxbuf, cur, n_out, fin, steps_inc, rstate.pool = spec_step(
+            tw0 = time.monotonic() if reg.enabled else 0.0
+            (ctxbuf, cur, n_out, fin, steps_inc, need_grow,
+             rstate.pool) = spec_step(
                 ctxbuf, cur, n_out, n_new_dev, eos_dev,
-                active_mask, jnp.int32(stop), rstate.pool)
+                active_mask, jnp.int32(stop), granted_rows, rstate.pool)
             # one batched transfer: separate device_gets would pay the
             # host round trip repeatedly in the per-wave hot loop
-            fin_h, n_out_h, steps_h = jax.device_get(
-                (fin, n_out, steps_inc))
+            fin_h, n_out_h, steps_h, need_h, pos_h = jax.device_get(
+                (fin, n_out, steps_inc, need_grow, rstate.pool["pos"]))
+            if reg.enabled:
+                # the spec "wave" is the whole device-resident multi-
+                # step; the readback above syncs it, so this is honest
+                # wall time, not dispatch time
+                _g_paged.set(round((time.monotonic() - tw0) * 1e3, 3))
             slot_steps += int(steps_h.sum())
             host_waves += 1
             # per-slot step counts attribute to the request holding the
@@ -1323,6 +1475,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             # steps, not the engine-wide counter
             for slot, req in active.items():
                 req_steps[req] = req_steps.get(req, 0) + int(steps_h[slot])
+                pos_h_of[slot] = int(pos_h[slot])
             for slot, req in list(active.items()):
                 if bool(fin_h[slot]):
                     n = int(n_out_h[slot])
@@ -1334,6 +1487,16 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                     _note_retire(meta, latencies, req, n,
                                  req_steps.get(req, 0))
                     del active[slot]
+            if lazy_growth:
+                # growth AFTER retirements: a slot at its boundary must
+                # see the blocks this very wave's finishers freed
+                for slot, req in list(active.items()):
+                    if bool(need_h[slot]) and not grow_to(
+                            slot, req, pos_h_of[slot] + spec_k + 1):
+                        # pool dry: stall until a retirement frees
+                        # blocks (state frozen on device meanwhile)
+                        stalled[slot] = req
+                        del active[slot]
         rstate.close()
         _gauges(rstate, 0, 0)
         if reg.enabled:
@@ -1756,6 +1919,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 mask_key[1] = jnp.asarray(
                     [s in active for s in range(slots)])
             active_mask = mask_key[1]
+            tw0 = time.monotonic() if reg.enabled else 0.0
             if sampler is None:
                 tokens, rstate.pool = step(tokens, active_mask,
                                            rstate.pool)
@@ -1805,6 +1969,10 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                                 retire(req, h - sw + 2, h - sw + 1)
                                 del active[slot]
                                 break
+            if reg.enabled:
+                # see the handle comment: wall time when the wave ended
+                # in an eos readback, dispatch time otherwise
+                _g_paged.set(round((time.monotonic() - tw0) * 1e3, 3))
         rstate.close()
         _gauges(rstate, 0, 0)
 

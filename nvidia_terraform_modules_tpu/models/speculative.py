@@ -37,7 +37,12 @@ TPU-first shape discipline: the whole generate loop is ONE
 buffer, ``k`` static, every verification a ``[1, k+1]`` cached forward —
 so speculation compiles once like everything else. Batch is 1 by design:
 speculation is a LATENCY lever, and per-row acceptance divergence under
-batching would force per-row cache offsets (a different design).
+batching would force per-row cache offsets (a different design). The
+THROUGHPUT variant lives in ``models/serving.py::make_spec_step``: the
+same :func:`accept_drafts` core batched over the paged slot pool, with
+per-slot positions carrying the cache offsets this loop avoids and a
+per-k-token growth boundary so it composes with the engine's lazy block
+growth and cross-request prefix sharing.
 
 Reference analogue: none — the reference provisions serving
 infrastructure and never touches model bytes (SURVEY §2.6).

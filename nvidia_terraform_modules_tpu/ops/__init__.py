@@ -2,6 +2,11 @@
 # SPDX-License-Identifier: Apache-2.0
 """Hardware micro-probes and TPU-first compute ops (ring/Ulysses attention)."""
 
+from .decode_attention import (  # noqa: F401
+    int8_kv_decode_attention,
+    kv_decode_attention,
+    paged_decode_attention,
+)
 from .flash_attention import (  # noqa: F401
     MaskSpec,
     auto_blocks,

@@ -1,39 +1,65 @@
 # SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
 # SPDX-License-Identifier: Apache-2.0
-"""Pallas TPU decode attention over an int8 KV cache: flash-decode with
-in-kernel dequant, so int8 cache bytes are ALL that cross HBM per step.
+"""Pallas TPU decode attention: flash-decode over a contiguous KV cache
+(optionally int8 with in-kernel dequant) and over the BLOCK/PAGED pool
+with the block table folded into the kernel's DMA schedule.
 
 The long-context serving step is KV-cache-bandwidth-bound: at [8, 3584+]
 rows the bf16 cache is ~2.4 GB read per token while the (int8) weights
-are 0.4 GB (``models/decode.py``). Quantising the cache to int8 halves
-those bytes — but only if int8 is what actually crosses HBM. The jnp
-path gets partway there by applying the scales AFTER the contractions
-(``_cached_attention``), yet XLA still materialises converted operands
-at long S (measured: int8 KV 2185 tok/s vs bf16 2132 at S=3616 — parity,
-not the ~1.7× the byte math promises). This kernel removes the choice,
-exactly as ``ops/int8_matmul.py`` does for the weights: cache tiles load
-as int8 into VMEM, the int8→bf16 convert happens right before each MXU
-dot, and the per-vector scales fold into the scores / probabilities —
-``q·(k_q·s_k) = (q·k_q)·s_k`` and ``Σ_s p_s·(v_q·s_v)_s =
-Σ_s (p_s·s_v,s)·v_q_s`` — which are [.., S] and tiny next to the
-[.., S, D] cache.
+are 0.4 GB (``models/decode.py``). Two levers live here:
+
+1. **int8 cache bytes** (:func:`int8_kv_decode_attention`): quantising
+   the cache halves the bytes — but only if int8 is what actually
+   crosses HBM. The jnp path applies the scales AFTER the contractions
+   (``_cached_attention``), yet XLA still materialises converted
+   operands at long S (measured: int8 KV 2185 tok/s vs bf16 2132 at
+   S=3616 — parity, not the ~1.7× the byte math promises). The kernel
+   removes the choice: cache tiles load as int8 into VMEM, the
+   int8→bf16 convert happens right before each MXU dot, and the
+   per-vector scales fold into the scores / probabilities —
+   ``q·(k_q·s_k) = (q·k_q)·s_k`` and ``Σ_s p_s·(v_q·s_v)_s =
+   Σ_s (p_s·s_v,s)·v_q_s`` — which are [.., S] and tiny next to the
+   [.., S, D] cache.
+
+2. **the paged-gather tax** (:func:`paged_decode_attention`): the serve
+   engine's pool is ``[num_blocks, block_size, kv, D]`` physical blocks
+   indexed by per-row block tables (``models/paging.py``), and the jnp
+   read path materialises the logical view ``k_phys[tables] →
+   [B, NT·bs, kv, D]`` every wave — HBM traffic that scales with POOL
+   size, not live tokens (vLLM's PagedAttention exists to avoid exactly
+   this). Here the block table is a SCALAR-PREFETCH (SMEM) input and
+   the grid's S sweep walks TABLE ENTRIES: each step's K/V tile is
+   DMA'd straight from its physical block (the BlockSpec index map
+   reads the table), so per-wave cache traffic is the LIVE blocks.
+   Dead entries — past a row's ``pos``, or a retired slot's recycled
+   blocks — are aliased to reserved garbage block 0 in the index map
+   (consecutive identical indices: pallas skips the re-fetch) and their
+   folds skipped with ``pl.when``, the same liveness discipline as the
+   splash maps in ``ops/flash_attention.py``.
+
+Both kernels share ONE per-tile online-softmax fold (``_tile_fold``) —
+the paged and contiguous variants are the same arithmetic in the same
+order at equal tile sizes, differing only in where tiles are DMA'd
+from, so ``paged == contiguous-on-the-gathered-view`` holds BITWISE
+(``tests/test_decode_attention.py`` pins it per dtype). Against the
+jnp gather path the usual flash caveat applies: the online softmax
+re-orders the reduction, so parity is fp-tolerance, not bit equality.
 
 Shape discipline (flash-decode recurrence, same VMEM model as
 ``ops/flash_attention.py``):
 
-- grid (B, KV heads, S-blocks); the S sweep is innermost so the f32
-  online-softmax state (m, l, acc) lives in VMEM scratch across it;
+- grid (B, S-blocks) — table entries for the paged kernel; the S sweep
+  is innermost so the f32 online-softmax state (m, l, acc) lives in
+  VMEM scratch across it;
 - the query is ONE token per batch row ([B, H, D], T=1 — the decode
-  step; prefill and [1, k+1] verification keep the jnp path);
+  step; prefill and [B, k+1] verification keep the jnp path);
 - GQA: queries reshape to [KV, rep, D] groups and contract against the
   un-repeated cache — scores are [rep, block_s] per tile;
-- per-row positions: ``pos [B]`` (int32, broadcast to a lane-wide
-  VMEM operand — vmap-safe) masks keys at
-  ``s > pos`` — per-slot positions of the continuous-batching pool come
-  for free; S-blocks entirely past ``pos`` are SKIPPED with ``pl.when``
-  (no FLOPs, no DMA use), which also skips the ragged tail past S and
-  keeps the first block always-live so the running max never sees a
-  fully-dead update (the exp(-inf - -inf) NaN).
+- per-row positions mask keys at ``s > pos`` — the per-slot positions
+  of the continuous-batching pool come for free; blocks entirely past
+  ``pos`` are SKIPPED with ``pl.when`` (no FLOPs, no DMA use), which
+  also keeps the first block always-live so the running max never sees
+  a fully-dead update (the exp(-inf - -inf) NaN).
 
 Reference analogue: none — the reference provisions serving infra and
 never touches model bytes (``/root/reference/gke/README.md:50``).
@@ -51,19 +77,92 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _kernel(pos_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
-            m_scr, l_scr, acc_scr, *, scale, block_s, s_total, kv, rep):
-    """One (batch row, S-block) tile: every KV head of the block.
+def _per_head(xt, kv, rep, block_s):
+    # [KV, bs] f32 → [KV·rep, bs]: sublane-repeat per query group
+    return jnp.broadcast_to(xt[:, None, :],
+                            (kv, rep, block_s)).reshape(kv * rep, block_s)
+
+
+def _tile_fold(qbd, k2, v2, ks_t, vs_t, start, pos, s_total,
+               m_scr, l_scr, acc_scr, *, scale, kv, rep, block_s):
+    """ONE S-tile's online-softmax fold — the shared arithmetic of the
+    contiguous and paged kernels. Because both call exactly this, in
+    the same tile order at equal ``block_s``, the paged kernel is
+    BITWISE the contiguous kernel run on the gathered logical view:
+    the block-table indirection changes addresses, never bits.
+
+    ``qbd`` is the block-diagonal query [KV·rep, KV·D] (one MXU dot
+    computes every head's scores against the tile in its native
+    [bs, KV·D] layout — no per-head loop, no head-major cache
+    transpose); ``k2``/``v2`` the tile reshaped to [bs, KV·D] in
+    compute dtype; ``ks_t``/``vs_t`` the per-vector scales as
+    [KV, bs] f32 (``None`` for unquantised caches — the fold skips
+    the two scale multiplies entirely); ``start`` the tile's first
+    logical position.
+    """
+    hq = kv * rep
+    d = k2.shape[-1] // kv
+    s = jax.lax.dot_general(
+        qbd, k2, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale       # [KV·rep, bs]
+    if ks_t is not None:
+        s = s * _per_head(ks_t, kv, rep, block_s)         # fold k scales
+    s_idx = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where((s_idx <= pos) & (s_idx < s_total), s, NEG_INF)
+
+    m_prev, l_prev = m_scr[:], l_scr[:]                   # [KV·rep, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    alpha = jnp.exp(m_prev - m_new)
+    l_scr[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+    if vs_t is not None:
+        pv = (p * _per_head(vs_t, kv, rep, block_s)).astype(qbd.dtype)
+    else:
+        pv = p.astype(qbd.dtype)
+    # one dot against the whole tile computes every (query-head ×
+    # value-head) pair; the diagonal band — each query head with ITS
+    # value head — is selected with a static one-hot reduce
+    full = jax.lax.dot_general(
+        pv, v2, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)               # [KV·rep, KV·D]
+    f3 = full.reshape(hq, kv, d)
+    rowk = jax.lax.broadcasted_iota(jnp.int32, (hq, kv), 0) // rep
+    colk = jax.lax.broadcasted_iota(jnp.int32, (hq, kv), 1)
+    sel = (rowk == colk).astype(jnp.float32)[:, :, None]
+    acc_scr[:] = acc_scr[:] * alpha + jnp.sum(f3 * sel, axis=1)
+    m_scr[:] = m_new
+
+
+def _block_diag_q(q, kv, rep, d):
+    """Block-diagonal query: row ``k·rep+g`` carries head (k, g) in the
+    d-band of KV head k, so ONE dot against the [bs, KV·D]-shaped cache
+    tile contracts every head exactly (64 KB of h2d per step)."""
+    b = q.shape[0]
+    qg = q.reshape(b, kv, rep, d)
+    eye = jnp.eye(kv, dtype=q.dtype)
+    return (qg[:, :, :, None, :] * eye[None, :, None, :, None]).reshape(
+        b, kv * rep, kv * d)
+
+
+def _kernel(pos_ref, q_ref, *rest, scale, block_s, s_total, kv, rep,
+            quant):
+    """One (batch row, S-block) tile of the CONTIGUOUS-cache kernel:
+    every KV head of the block.
 
     The cache tile keeps its native [block_s, KV, D] layout (a head-major
     relayout would cost a full-cache transpose per step in HBM); the
     per-head [rep, D]×[block_s, D] dots are tiny, but the op is
     cache-bandwidth-bound so MXU utilisation is irrelevant — what
-    matters is that the tile is DMA'd once, as int8. Head slicing
-    happens on the LANE axis (reshape to [block_s, KV·D], 128-multiple
-    column slices), which Mosaic handles natively; per-head scores stack
-    to [KV·rep, block_s] so the online-softmax state update stays one
-    vectorised operation."""
+    matters is that the tile is DMA'd once, at its storage width. Head
+    slicing happens on the LANE axis (reshape to [block_s, KV·D],
+    128-multiple column slices), which Mosaic handles natively; the
+    fold itself is :func:`_tile_fold`."""
+    if quant:
+        k_ref, ks_ref, v_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = rest
+        ks_ref = vs_ref = None
     si, ns = pl.program_id(1), pl.num_programs(1)
 
     @pl.when(si == 0)
@@ -74,53 +173,19 @@ def _kernel(pos_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
 
     pos = pos_ref[0, 0, 0]
     d = k_ref.shape[-1]
-    hq = kv * rep
-
-    def _per_head(xt):
-        # [KV, bs] f32 (pre-transposed by the wrapper — an in-kernel
-        # sublane↔lane transpose per tile was the kernel's single
-        # biggest cost) → [KV·rep, bs]: sublane-repeat per query group
-        return jnp.broadcast_to(xt[:, None, :],
-                                (kv, rep, block_s)).reshape(hq, block_s)
 
     # the whole block is dead iff its first key is past this row's
     # position (pos < S always, so this also kills the ragged tail)
     @pl.when(si * block_s <= pos)
     def _live():
-        # q arrives BLOCK-DIAGONAL [KV·rep, KV·D] (built per step in the
-        # wrapper — 64 KB): one MXU dot computes every head's scores
-        # against the tile in its native [bs, KV·D] layout, no per-head
-        # loop, no head-major cache transpose
         qbd = q_ref[0]
         k2 = k_ref[0].astype(qbd.dtype).reshape(block_s, kv * d)
         v2 = v_ref[0].astype(qbd.dtype).reshape(block_s, kv * d)
-        s = jax.lax.dot_general(
-            qbd, k2, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [KV·rep, bs]
-        s = s * _per_head(ks_ref[0])                      # fold k scales
-        s_idx = si * block_s + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1)
-        s = jnp.where((s_idx <= pos) & (s_idx < s_total), s, NEG_INF)
-
-        m_prev, l_prev = m_scr[:], l_scr[:]               # [KV·rep, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
-        alpha = jnp.exp(m_prev - m_new)
-        l_scr[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
-        pv = (p * _per_head(vs_ref[0])).astype(qbd.dtype)  # fold v scales
-        # one dot against the whole tile computes every (query-head ×
-        # value-head) pair; the diagonal band — each query head with ITS
-        # value head — is selected with a static one-hot reduce
-        full = jax.lax.dot_general(
-            pv, v2, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)           # [KV·rep, KV·D]
-        f3 = full.reshape(hq, kv, d)
-        rowk = jax.lax.broadcasted_iota(jnp.int32, (hq, kv), 0) // rep
-        colk = jax.lax.broadcasted_iota(jnp.int32, (hq, kv), 1)
-        sel = (rowk == colk).astype(jnp.float32)[:, :, None]
-        acc_scr[:] = acc_scr[:] * alpha + jnp.sum(f3 * sel, axis=1)
-        m_scr[:] = m_new
+        _tile_fold(qbd, k2, v2,
+                   None if ks_ref is None else ks_ref[0],
+                   None if vs_ref is None else vs_ref[0],
+                   si * block_s, pos, s_total, m_scr, l_scr, acc_scr,
+                   scale=scale, kv=kv, rep=rep, block_s=block_s)
 
     @pl.when(si == ns - 1)
     def _finalize():
@@ -128,24 +193,26 @@ def _kernel(pos_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
             o_ref.dtype).reshape(o_ref.shape[1:])
 
 
-def int8_kv_decode_attention(q, k_cache, k_scale, v_cache, v_scale, pos,
-                             *, scale: float, block_s: int = 1024,
-                             interpret: bool | None = None):
-    """One decode step of attention over an int8 cache.
+def kv_decode_attention(q, k_cache, v_cache, pos, *, scale: float,
+                        k_scale=None, v_scale=None, block_s: int = 1024,
+                        interpret: bool | None = None):
+    """One decode step of attention over a CONTIGUOUS cache.
 
     ``q [B, H, D]`` (compute dtype) attends over ``k_cache``/``v_cache``
-    ``[B, S, KV, D]`` int8 with per-vector f32 ``k_scale``/``v_scale``
-    ``[B, S, KV]``; ``pos [B]`` int32 gives each row's query position
-    (keys at ``s <= pos`` participate). Returns ``[B, H, D]`` in
-    ``q.dtype``. ``H`` must be a multiple of ``KV``; ``D`` a lane
-    multiple (128).
+    ``[B, S, KV, D]``; ``pos [B]`` int32 gives each row's query position
+    (keys at ``s <= pos`` participate). With ``k_scale``/``v_scale``
+    ``[B, S, KV]`` f32 the buffers are int8 and dequantise in-kernel
+    (scale-after-dot). Returns ``[B, H, D]`` in ``q.dtype``. ``H`` must
+    be a multiple of ``KV``; ``D`` a lane multiple (128) on chip.
     """
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together")
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
+    quant = k_scale is not None
     b, h, d = q.shape
     _, s_total, kv, _ = k_cache.shape
     rep = h // kv
-    qg = q.reshape(b, kv, rep, d)
     pos = jnp.asarray(pos, jnp.int32).reshape(b)
     # S must tile EXACTLY: a ragged tail block would clamp its start
     # index and silently read earlier rows under the mask. init_cache
@@ -157,33 +224,39 @@ def int8_kv_decode_attention(q, k_cache, k_scale, v_cache, v_scale, pos,
     if not block_s:
         raise ValueError(
             f"cache rows ({s_total}) need an 8-multiple block divisor "
-            f"for the int8 decode kernel (init_cache rounds to 256)")
+            f"for the decode kernel (init_cache rounds int8 to 256)")
     ns = s_total // block_s
 
-    # block-diagonal query: row k·rep+g carries head (k, g) in the d-band
-    # of KV head k, so ONE dot against the [bs, KV·D]-shaped cache tile
-    # contracts every head exactly (64 KB of h2d per step — negligible)
-    eye = jnp.eye(kv, dtype=q.dtype)
-    qbd = (qg[:, :, :, None, :] * eye[None, :, None, :, None]).reshape(
-        b, kv * rep, kv * d)
+    qbd = _block_diag_q(q, kv, rep, d)
+    in_specs = [
+        # per-row position as a [B, 1, 128] VMEM operand: the block's
+        # trailing (1, 128) dims equal the array's, which stays legal
+        # for ANY batch — including the extra leading dim jax.vmap
+        # prepends when a caller batches this call (a rank-1 SMEM
+        # block breaks exactly there)
+        pl.BlockSpec((1, 1, 128), lambda bi, si: (bi, 0, 0)),
+        pl.BlockSpec((1, kv * rep, kv * d), lambda bi, si: (bi, 0, 0)),
+        pl.BlockSpec((1, block_s, kv, d), lambda bi, si: (bi, si, 0, 0)),
+    ]
+    args = [jnp.broadcast_to(pos[:, None, None], (b, 1, 128)), qbd,
+            k_cache]
+    if quant:
+        in_specs.append(
+            pl.BlockSpec((1, kv, block_s), lambda bi, si: (bi, 0, si)))
+        args.append(jnp.asarray(k_scale, jnp.float32).swapaxes(1, 2))
+    in_specs.append(
+        pl.BlockSpec((1, block_s, kv, d), lambda bi, si: (bi, si, 0, 0)))
+    args.append(v_cache)
+    if quant:
+        in_specs.append(
+            pl.BlockSpec((1, kv, block_s), lambda bi, si: (bi, 0, si)))
+        args.append(jnp.asarray(v_scale, jnp.float32).swapaxes(1, 2))
 
     out = pl.pallas_call(
         functools.partial(_kernel, scale=scale, block_s=block_s,
-                          s_total=s_total, kv=kv, rep=rep),
+                          s_total=s_total, kv=kv, rep=rep, quant=quant),
         grid=(b, ns),
-        in_specs=[
-            # per-row position as a [B, 1, 128] VMEM operand: the block's
-            # trailing (1, 128) dims equal the array's, which stays legal
-            # for ANY batch — including the extra leading dim jax.vmap
-            # prepends when the serving pool batches this call (a rank-1
-            # SMEM block breaks exactly there)
-            pl.BlockSpec((1, 1, 128), lambda bi, si: (bi, 0, 0)),
-            pl.BlockSpec((1, kv * rep, kv * d), lambda bi, si: (bi, 0, 0)),
-            pl.BlockSpec((1, block_s, kv, d), lambda bi, si: (bi, si, 0, 0)),
-            pl.BlockSpec((1, kv, block_s), lambda bi, si: (bi, 0, si)),
-            pl.BlockSpec((1, block_s, kv, d), lambda bi, si: (bi, si, 0, 0)),
-            pl.BlockSpec((1, kv, block_s), lambda bi, si: (bi, 0, si)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, kv * rep, d), lambda bi, si: (bi, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((b, kv * rep, d), q.dtype),
         scratch_shapes=[
@@ -192,7 +265,164 @@ def int8_kv_decode_attention(q, k_cache, k_scale, v_cache, v_scale, pos,
             pltpu.VMEM((kv * rep, d), jnp.float32),  # output accumulator
         ],
         interpret=interpret,
-    )(jnp.broadcast_to(pos[:, None, None], (b, 1, 128)), qbd, k_cache,
-      jnp.asarray(k_scale, jnp.float32).swapaxes(1, 2), v_cache,
-      jnp.asarray(v_scale, jnp.float32).swapaxes(1, 2))
+    )(*args)
+    return out.reshape(b, h, d)
+
+
+def int8_kv_decode_attention(q, k_cache, k_scale, v_cache, v_scale, pos,
+                             *, scale: float, block_s: int = 1024,
+                             interpret: bool | None = None):
+    """One decode step over an int8 cache — the historical entry point,
+    now :func:`kv_decode_attention` with the scale sidecars required."""
+    return kv_decode_attention(q, k_cache, v_cache, pos, scale=scale,
+                               k_scale=k_scale, v_scale=v_scale,
+                               block_s=block_s, interpret=interpret)
+
+
+def _paged_kernel(tables_ref, pos_ref, q_ref, *rest, scale, bs, nt, kv,
+                  rep, quant):
+    """One (batch row, table entry) tile of the PAGED kernel.
+
+    ``tables_ref``/``pos_ref`` are scalar-prefetch SMEM inputs — the
+    BlockSpec index maps already used them to aim each step's K/V DMA
+    at the entry's physical block, so the body only needs the liveness
+    test and the shared fold. The scale sidecars arrive in the pool's
+    native [bs, KV] layout and transpose IN-KERNEL to the fold's
+    [KV, bs]: a tiny per-tile relayout, against which the contiguous
+    wrapper's whole-cache [B, S, KV] → [B, KV, S] swap would be a
+    full-pool materialisation per wave — the exact traffic this kernel
+    exists to kill. Values are identical either way, so bitwise parity
+    with the contiguous fold is unaffected."""
+    if quant:
+        k_ref, ks_ref, v_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = rest
+        ks_ref = vs_ref = None
+    bi, ti = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(ti == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    pos = pos_ref[bi]
+    d = k_ref.shape[-1]
+
+    # dead entries (first key past this row's pos — recycled garbage
+    # included) fold nothing; their DMA was aliased to block 0 by the
+    # index map, so they also move no fresh bytes
+    @pl.when(ti * bs <= pos)
+    def _live():
+        qbd = q_ref[0]
+        k2 = k_ref[0].astype(qbd.dtype).reshape(bs, kv * d)
+        v2 = v_ref[0].astype(qbd.dtype).reshape(bs, kv * d)
+        ks_t = None if ks_ref is None else ks_ref[0].T
+        vs_t = None if vs_ref is None else vs_ref[0].T
+        _tile_fold(qbd, k2, v2, ks_t, vs_t, ti * bs, pos, nt * bs,
+                   m_scr, l_scr, acc_scr, scale=scale, kv=kv, rep=rep,
+                   block_s=bs)
+
+    @pl.when(ti == nt - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:] / l_scr[:]).astype(
+            o_ref.dtype).reshape(o_ref.shape[1:])
+
+
+def paged_decode_attention(q, k_pool, v_pool, tables, pos, *,
+                           scale: float, k_scale=None, v_scale=None,
+                           interpret: bool | None = None):
+    """One decode step of attention THROUGH the block tables — no
+    logical-view gather.
+
+    ``q [B, H, D]`` attends over the physical pool ``k_pool``/``v_pool``
+    ``[num_blocks, block_size, KV, D]`` via ``tables [B, NT]`` int32
+    (each row's logical block i lives at physical block
+    ``tables[b, i]``) and per-row ``pos [B]`` int32 (keys at logical
+    ``s <= pos`` participate — which also fences recycled-block
+    garbage and frozen retired slots, exactly as the gather path's
+    position mask does). Int8 pools pass ``k_scale``/``v_scale``
+    ``[num_blocks, block_size, KV]`` f32 sidecars riding the same
+    tables, dequantised in-kernel (scale-after-dot). Returns
+    ``[B, H, D]`` in ``q.dtype``.
+
+    The table and positions are SCALAR-PREFETCH inputs: pallas reads
+    them in SMEM before the grid runs, so each (row, entry) step's K/V
+    BlockSpec index map can aim the tile DMA at ``tables[b, i]``
+    directly — per-step HBM traffic is the row's LIVE blocks, not the
+    ``NT·bs``-row logical view the jnp path materialises. Dead entries
+    alias to reserved garbage block 0 (consecutive repeats of one
+    index: pallas skips the re-fetch) and skip their folds.
+
+    On chip ``D`` must be a lane multiple (128) and ``block_size`` a
+    sublane multiple (8); interpret mode (the CPU test path) takes any
+    shape. Equal tile contents in equal order make this BITWISE
+    :func:`kv_decode_attention` over the gathered view at
+    ``block_s=block_size`` — pinned per dtype in
+    ``tests/test_decode_attention.py``.
+    """
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together")
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    quant = k_scale is not None
+    b, h, d = q.shape
+    _nb, bs, kv, _ = k_pool.shape
+    nt = tables.shape[1]
+    rep = h // kv
+    if h % kv:
+        raise ValueError(f"q heads ({h}) must be a multiple of the "
+                         f"pool's kv heads ({kv})")
+    if not interpret and (d % 128 or bs % 8):
+        raise ValueError(
+            f"paged decode kernel on chip needs head_dim % 128 == 0 "
+            f"(got {d}) and block_size % 8 == 0 (got {bs}) — use a "
+            f"lane-aligned head_dim and kv_block, or the gather path")
+    tables = jnp.asarray(tables, jnp.int32)
+    pos = jnp.asarray(pos, jnp.int32).reshape(b)
+    qbd = _block_diag_q(q, kv, rep, d)
+
+    def pool_map(bi, ti, tr, pr):
+        # live → the entry's physical block; dead → garbage block 0
+        # (repeated index: no re-fetch). The liveness test MUST equal
+        # the kernel's pl.when, or a folded tile could hold the wrong
+        # block's bytes.
+        return (jnp.where(ti * bs <= pr[bi], tr[bi, ti], 0), 0, 0, 0)
+
+    def scale_map(bi, ti, tr, pr):
+        return (jnp.where(ti * bs <= pr[bi], tr[bi, ti], 0), 0, 0)
+
+    def row_map(bi, ti, tr, pr):
+        return (bi, 0, 0)
+
+    in_specs = [pl.BlockSpec((1, kv * rep, kv * d), row_map),
+                pl.BlockSpec((1, bs, kv, d), pool_map)]
+    args = [qbd, k_pool]
+    if quant:
+        in_specs.append(pl.BlockSpec((1, bs, kv), scale_map))
+        args.append(jnp.asarray(k_scale, jnp.float32))
+    in_specs.append(pl.BlockSpec((1, bs, kv, d), pool_map))
+    args.append(v_pool)
+    if quant:
+        in_specs.append(pl.BlockSpec((1, bs, kv), scale_map))
+        args.append(jnp.asarray(v_scale, jnp.float32))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nt),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, kv * rep, d), row_map),
+        scratch_shapes=[
+            pltpu.VMEM((kv * rep, 1), jnp.float32),  # running max m
+            pltpu.VMEM((kv * rep, 1), jnp.float32),  # running normaliser l
+            pltpu.VMEM((kv * rep, d), jnp.float32),  # output accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, scale=scale, bs=bs, nt=nt,
+                          kv=kv, rep=rep, quant=quant),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kv * rep, d), q.dtype),
+        interpret=interpret,
+    )(tables, pos, *args)
     return out.reshape(b, h, d)

@@ -420,6 +420,48 @@ def _run_smoketest(
                     checks["serve_sched_error"] = str(exc)
                 ok &= checks["serve_sched_ok"]
 
+            # paged decode kernel gate: the block-table-native pallas
+            # wave step (decode.forward_paged paged_kernel="on") is
+            # contractually a READ-PATH change — same tables, same
+            # liveness mask, no logical-view gather — so one
+            # shared-prefix serving wave through the kernel engine
+            # must BIT-match the gather engine's tokens on this
+            # slice's real lowering. Mirrors flash_pipeline_ok: gate
+            # the kernel rewrite on chip before a serving job trusts
+            # it. Tiny, unsharded, process-local (no collectives —
+            # every host validates independently at any world size).
+            if checks.get("serve_sched_ok"):
+                try:
+                    from ..models.serving import make_serve_engine
+                    from ..utils.traffic import shared_prefix_prompts
+
+                    kcfg = BurnInConfig(
+                        vocab=128, d_model=32, n_heads=4, d_ff=64,
+                        n_layers=2, seq_len=16, batch=2,
+                        dtype=jax.numpy.float32)
+                    kparams = init_params(jax.random.PRNGKey(12), kcfg)
+                    kpairs = shared_prefix_prompts(
+                        4, seed=1, n_templates=2, template_len=9,
+                        suffix_lo=1, suffix_hi=4, vocab=kcfg.vocab)
+                    kprompts = [jax.numpy.asarray(p, jax.numpy.int32)
+                                for _t, p in kpairs]
+                    kbudgets = [3, 5, 2, 4]
+                    kml = max(int(p.shape[-1]) + n
+                              for p, n in zip(kprompts, kbudgets))
+                    outs = {}
+                    for mode in ("off", "on"):
+                        eng = make_serve_engine(
+                            kparams, kcfg, max_len=kml, kv_block=8,
+                            share_prefix=True, paged_kernel=mode)
+                        outs[mode] = eng(kprompts, kbudgets, slots=2)
+                    checks["paged_decode_ok"] = all(
+                        bool(jax.device_get(jax.numpy.array_equal(a, b)))
+                        for a, b in zip(outs["on"], outs["off"]))
+                except Exception as exc:  # JSON contract > the type
+                    checks["paged_decode_ok"] = False
+                    checks["paged_decode_error"] = str(exc)
+                ok &= checks["paged_decode_ok"]
+
             # flash pipeline gate: the software-pipelined kernels
             # (ops/flash_attention.py, pipeline="on") are contractually a
             # SCHEDULING change — same sub-tile folds, same arithmetic —

@@ -120,7 +120,10 @@ def test_section_serve_engine_schema_and_seeded_workload():
                 "serve_lazy_blocks_grown", "serve_sjf_vs_fifo_p50",
                 "serve_sjf_vs_fifo_mean",
                 "serve_engine_kv_blocks_logical",
-                "serve_engine_kv_blocks_physical"):
+                "serve_engine_kv_blocks_physical",
+                "serve_paged_decode_ms", "serve_gather_decode_ms",
+                "serve_paged_kernel_vs_gather",
+                "decode_gather_bytes_saved"):
         assert key in out, key
     assert out["serve_engine_slots"] >= 2
     # the regression marker this section retires: per-request
@@ -151,6 +154,15 @@ def test_section_serve_engine_schema_and_seeded_workload():
     # only positivity is platform-stable here
     assert out["serve_engine_kv_blocks_logical"] > 0
     assert out["serve_engine_kv_blocks_physical"] > 0
+    # PR 11 paged-kernel leg: both read paths timed to something
+    # positive, and the static byte estimate shows the gather tax the
+    # kernel removes (provisioned tables ≫ live depth at this shape) —
+    # the timing RATIO is chip-only (cpu_fallback_expectations)
+    assert out["serve_paged_decode_ms"] > 0
+    assert out["serve_gather_decode_ms"] > 0
+    assert out["serve_paged_kernel_vs_gather"] > 0
+    assert out["decode_gather_bytes_saved"] > 0
+    assert out["serve_paged_table_rows"] > out["serve_paged_depth_rows"]
     tr = out["serve_engine_trace"]
     want = trace_summary(poisson_trace(tr["rate"],
                                        out["serve_engine_requests"],
@@ -177,7 +189,10 @@ def test_section_serve_engine_deterministic_across_runs():
                 "serve_prefix_hit_frac", "serve_prefix_hit_blocks",
                 "serve_prefill_tokens_saved", "serve_lazy_admit_gain",
                 "serve_lazy_blocks_grown", "serve_sjf_vs_fifo_p50",
-                "serve_sjf_vs_fifo_mean"):
+                "serve_sjf_vs_fifo_mean",
+                # the gather-tax byte estimate is static geometry
+                "decode_gather_bytes_saved", "serve_paged_depth_rows",
+                "serve_paged_table_rows"):
         assert a[key] == b[key], key
 
 

@@ -1,12 +1,22 @@
 # SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
 # SPDX-License-Identifier: Apache-2.0
-"""int8-KV flash-decode kernel vs the dequantise-then-attend oracle.
+"""Decode-attention kernels vs their jnp oracles, and the paged kernel
+vs the gather path it supersedes.
 
-The kernel (``ops/decode_attention.py``) runs in interpret mode here;
-the oracle is the jnp scale-after-dot path it replaces on TPU
-(``models/decode.py::_cached_attention``). Exactness expectations are
-fp-tolerance, not bit equality: the kernel's online softmax re-orders
-the reduction.
+The kernels (``ops/decode_attention.py``) run in interpret mode here.
+Two distinct exactness bars, deliberately:
+
+- vs the jnp paths (``_cached_attention`` / the ``forward_paged``
+  gather): fp-tolerance, not bit equality — the online softmax
+  re-orders the reduction;
+- PAGED kernel vs the CONTIGUOUS kernel on the gathered logical view
+  at equal tile size: BITWISE for f32/bf16 — both run the one shared
+  ``_tile_fold`` over identical tile contents in identical order, so
+  the block-table indirection must change addresses, never bits. The
+  int8 sidecar fold is tight-tolerance instead: the paged kernel
+  transposes scale tiles in-kernel (the contiguous wrapper pre-
+  transposes — chip-tuned), and XLA may fuse the scale multiply
+  differently around it (~1 ulp observed).
 """
 
 import jax
@@ -17,6 +27,8 @@ import pytest
 from nvidia_terraform_modules_tpu.models.decode import quantize_kv
 from nvidia_terraform_modules_tpu.ops.decode_attention import (
     int8_kv_decode_attention,
+    kv_decode_attention,
+    paged_decode_attention,
 )
 
 
@@ -169,3 +181,202 @@ def test_cached_attention_gate_respects_int8_kernel_flag():
     finally:
         decode_mod._FORCE_DECODE_KERNEL = False
     assert jnp.array_equal(got, want)
+
+
+# ------------------------------------------------- paged decode kernel
+
+
+def _paged_setup(b, h, kv, d, nb, bs, nt, key=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(key), 4)
+    q = jax.random.normal(ks[0], (b, h, d), dtype)
+    k_pool = jax.random.normal(ks[1], (nb, bs, kv, d), dtype)
+    v_pool = jax.random.normal(ks[2], (nb, bs, kv, d), dtype)
+    # out-of-order, non-contiguous physical blocks (never reserved 0)
+    perm = jax.random.permutation(ks[3], jnp.arange(1, nb))
+    tables = perm[:b * nt].reshape(b, nt).astype(jnp.int32)
+    return q, k_pool, v_pool, tables
+
+
+def _gathered(pool, tables):
+    b, nt = tables.shape
+    return pool[tables].reshape((b, nt * pool.shape[1]) + pool.shape[2:])
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_paged_kernel_bitwise_vs_contiguous_on_gathered_view(dtype):
+    """THE paged-kernel contract: at equal tile size the block-table
+    indirection is bitwise invisible — the paged kernel equals the
+    contiguous kernel run on the materialised logical view, per dtype,
+    across ragged per-row positions (pos=0 single-live-block included).
+    """
+    b, h, kv, d, nb, bs, nt = 3, 8, 2, 128, 16, 16, 4
+    q, kp, vp, tables = _paged_setup(b, h, kv, d, nb, bs, nt,
+                                     dtype=dtype)
+    pos = jnp.asarray([nt * bs - 1, 17, 0], jnp.int32)
+    got = paged_decode_attention(q, kp, vp, tables, pos,
+                                 scale=d ** -0.5, interpret=True)
+    want = kv_decode_attention(q, _gathered(kp, tables),
+                               _gathered(vp, tables), pos,
+                               scale=d ** -0.5, block_s=bs,
+                               interpret=True)
+    assert jnp.array_equal(got, want), (
+        f"{dtype} paged vs gathered-contiguous diverged: "
+        f"{jnp.abs(got.astype(jnp.float32) - want.astype(jnp.float32)).max()}")
+
+
+def test_paged_kernel_int8_sidecars_tight_tol_vs_contiguous():
+    """Int8 pools: the scale sidecars ride the same tables with
+    in-kernel dequant. The paged scale tiles transpose in-kernel (the
+    contiguous wrapper pre-transposes), so XLA may fuse the scale
+    multiply differently — tight tolerance, not bits."""
+    b, h, kv, d, nb, bs, nt = 3, 8, 2, 128, 16, 16, 4
+    q, kp, vp, tables = _paged_setup(b, h, kv, d, nb, bs, nt, key=1)
+    k8, ks = quantize_kv(kp)
+    v8, vs = quantize_kv(vp)
+    pos = jnp.asarray([nt * bs - 1, 21, 5], jnp.int32)
+    got = paged_decode_attention(q, k8, v8, tables, pos,
+                                 scale=d ** -0.5, k_scale=ks,
+                                 v_scale=vs, interpret=True)
+    want = kv_decode_attention(q, _gathered(k8, tables),
+                               _gathered(v8, tables), pos,
+                               scale=d ** -0.5,
+                               k_scale=_gathered(ks, tables),
+                               v_scale=_gathered(vs, tables),
+                               block_s=bs, interpret=True)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("h,kv", [(4, 4), (8, 2), (4, 1)])
+def test_paged_kernel_matches_jnp_gather_oracle(h, kv):
+    """MHA, GQA and MQA against the dense-softmax oracle over the
+    gathered view — the forward_paged gather path's math."""
+    b, d, nb, bs, nt = 2, 128, 12, 8, 3
+    q, kp, vp, tables = _paged_setup(b, h, kv, d, nb, bs, nt, key=2)
+    pos = jnp.asarray([nt * bs - 2, 9], jnp.int32)
+    got = paged_decode_attention(q, kp, vp, tables, pos,
+                                 scale=d ** -0.5, interpret=True)
+    kg, vg = _gathered(kp, tables), _gathered(vp, tables)
+    ones = jnp.ones(kg.shape[:3])
+    want = _oracle(q, kg, ones, vg, ones, pos, d ** -0.5)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_paged_kernel_dead_blocks_and_garbage_are_unreachable():
+    """Recycled-block garbage must be bitwise invisible: scribbling
+    over (a) every block not referenced by a live table entry and
+    (b) every in-block row past each row's pos changes nothing — the
+    exact fencing contract the serve engine's retirement relies on."""
+    b, h, kv, d, nb, bs, nt = 2, 4, 2, 128, 10, 8, 3
+    q, kp, vp, tables = _paged_setup(b, h, kv, d, nb, bs, nt, key=3)
+    pos = jnp.asarray([11, 4], jnp.int32)
+    base = paged_decode_attention(q, kp, vp, tables, pos,
+                                  scale=d ** -0.5, interpret=True)
+    # the permutation setup maps every (row, entry) to a DISTINCT
+    # physical block, so per block the reachable rows are exactly the
+    # one referencing row's live span — poison everything else
+    kp2, vp2 = kp, vp
+    referenced = set()
+    for r in range(b):
+        for i in range(nt):
+            blk = int(tables[r, i])
+            referenced.add(blk)
+            live_rows = min(max(int(pos[r]) - i * bs + 1, 0), bs)
+            if live_rows < bs:
+                dead = jnp.arange(bs) >= live_rows
+                kp2 = kp2.at[blk].set(jnp.where(dead[:, None, None],
+                                                1e4, kp2[blk]))
+                vp2 = vp2.at[blk].set(jnp.where(dead[:, None, None],
+                                                1e4, vp2[blk]))
+    for blk in set(range(nb)) - referenced:      # recycled elsewhere
+        kp2 = kp2.at[blk].set(1e4)
+        vp2 = vp2.at[blk].set(1e4)
+    got = paged_decode_attention(q, kp2, vp2, tables, pos,
+                                 scale=d ** -0.5, interpret=True)
+    assert jnp.array_equal(got, base)
+
+
+def test_paged_kernel_validation():
+    q = jnp.zeros((2, 3, 128))                  # 3 heads over 2 kv
+    kp = vp = jnp.zeros((4, 8, 2, 128))
+    t = jnp.zeros((2, 2), jnp.int32)
+    pos = jnp.zeros((2,), jnp.int32)
+    with pytest.raises(ValueError, match="multiple"):
+        paged_decode_attention(q, kp, vp, t, pos, scale=1.0,
+                               interpret=True)
+    with pytest.raises(ValueError, match="together"):
+        paged_decode_attention(jnp.zeros((2, 4, 128)), kp, vp, t, pos,
+                               scale=1.0, k_scale=jnp.zeros((4, 8, 2)),
+                               interpret=True)
+
+
+# ---------------------------------------------------- lowering pins
+
+
+def _all_eqns(jaxpr, out=None):
+    """Recursively collect eqns from a (Closed)Jaxpr (PR 9 pin style)."""
+    if out is None:
+        out = []
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in inner.eqns:
+        out.append(eqn)
+        for sub in eqn.params.values():
+            subs = sub if isinstance(sub, (list, tuple)) else (sub,)
+            for s in subs:
+                if hasattr(s, "eqns") or hasattr(s, "jaxpr"):
+                    _all_eqns(s, out)
+    return out
+
+
+def _paged_forward_fixture(cache_dtype="bf16"):
+    from nvidia_terraform_modules_tpu.models import (
+        BurnInConfig,
+        init_params,
+    )
+    from nvidia_terraform_modules_tpu.models.paging import (
+        init_paged_cache,
+    )
+
+    cfg = BurnInConfig(vocab=64, d_model=256, n_heads=2, d_ff=64,
+                       n_layers=2, seq_len=16, batch=2,
+                       dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    pool = init_paged_cache(cfg, 2, 16, block_size=8, num_blocks=9,
+                            cache_dtype=cache_dtype)
+    pool["block_tables"] = jnp.asarray([[7, 2], [1, 5]], jnp.int32)
+    pool["pos"] = jnp.asarray([5, 3], jnp.int32)
+    return cfg, params, pool
+
+
+@pytest.mark.parametrize("cache_dtype", ["bf16", "int8"])
+def test_forward_paged_kernel_lowering_no_logical_gather(cache_dtype):
+    """The de-paging pin: with ``paged_kernel="on"`` the T=1 step's
+    jaxpr contains one pallas_call per layer and NO gather whose
+    output is the ``[B, NT, bs, kv, D]`` logical view — a silent fall
+    back to the gather path (re-introducing HBM traffic that scales
+    with pool size) fails tier-1. The "off" side proves the detector
+    sees the gathers it is meant to ban."""
+    from nvidia_terraform_modules_tpu.models.decode import forward_paged
+
+    cfg, params, pool = _paged_forward_fixture(cache_dtype)
+    toks = jnp.zeros((2, 1), jnp.int32)
+    b, nt = pool["block_tables"].shape
+    bs = pool["k"][0].shape[1]
+    view_elems = b * nt * bs * cfg.kv_heads * cfg.head_dim
+
+    def eqns_for(mode):
+        fn = lambda t, p: forward_paged(params, t, p, cfg,
+                                        paged_kernel=mode)[0]
+        return _all_eqns(jax.make_jaxpr(fn)(toks, pool))
+
+    on = eqns_for("on")
+    n_pallas = sum(e.primitive.name == "pallas_call" for e in on)
+    assert n_pallas == cfg.n_layers, n_pallas
+
+    def view_gathers(eqns):
+        return [e for e in eqns if e.primitive.name == "gather"
+                and int(np.prod(e.outvars[0].aval.shape)) == view_elems]
+
+    assert not view_gathers(on), view_gathers(on)
+    off = eqns_for("off")
+    assert view_gathers(off), "detector lost the reference gathers"
+    assert not any(e.primitive.name == "pallas_call" for e in off)

@@ -422,3 +422,146 @@ def test_forward_paged_int8_scales_ride_the_tables():
         p_lg, pool = forward_paged(params, tok[:, None], pool, cfg)
         assert jnp.array_equal(d_lg, p_lg)
         tok = jnp.argmax(d_lg[:, -1], axis=-1)
+
+
+# ------------------------------------------- paged decode kernel path
+
+
+def test_forward_paged_kernel_matches_gather_path_tier1():
+    """forward_paged(paged_kernel="on") vs the jnp gather reference on
+    the SAME pool: a prefill + decode steps over out-of-order blocks,
+    bf16 pool — logits within kernel float tolerance, argmax chain
+    identical. The kernel is pure read-path: pools stay bitwise equal
+    on both sides (the scatter write path is untouched)."""
+    from nvidia_terraform_modules_tpu.models.decode import forward_paged
+
+    cfg, params, forward_cached, init_cache = _paged_setup()
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 5), 0,
+                                cfg.vocab)
+    pools = {}
+    for mode in ("off", "on"):
+        pool = init_paged_cache(cfg, 2, 16, block_size=8, num_blocks=9)
+        pool["block_tables"] = jnp.asarray([[7, 2], [1, 5]], jnp.int32)
+        lg, pool = forward_paged(params, prompt, pool, cfg,
+                                 prefill_impl="dense")
+        tok = jnp.argmax(lg[:, -1], axis=-1)
+        toks = [tok]
+        for _ in range(4):
+            lg, pool = forward_paged(params, tok[:, None], pool, cfg,
+                                     paged_kernel=mode)
+            tok = jnp.argmax(lg[:, -1], axis=-1)
+            toks.append(tok)
+        pools[mode] = (pool, jnp.stack(toks), lg)
+    assert jnp.array_equal(pools["on"][1], pools["off"][1])
+    assert jnp.allclose(pools["on"][2], pools["off"][2],
+                        rtol=2e-5, atol=2e-5)
+    # the scatter write path is untouched: layer 0's fresh K rows (whose
+    # inputs are path-independent embeddings) stay bitwise equal; deeper
+    # layers' writes ride the residual stream and differ only within
+    # the read-path tolerance
+    assert jnp.array_equal(pools["on"][0]["k"][0], pools["off"][0]["k"][0])
+
+
+def test_forward_paged_kernel_int8_and_ragged_pos_tier1():
+    """Int8 pool + per-row ragged depths through the kernel: scale
+    sidecars ride the tables with in-kernel dequant, per-row pos feeds
+    the liveness mask, and the argmax chain equals the gather path's."""
+    from nvidia_terraform_modules_tpu.models.decode import forward_paged
+
+    cfg, params, forward_cached, init_cache = _paged_setup()
+    pool0 = init_paged_cache(cfg, 2, 16, block_size=8, num_blocks=70,
+                             cache_dtype="int8")
+    nt = pool0["block_tables"].shape[1]
+    pool0["block_tables"] = (jnp.arange(2 * nt, dtype=jnp.int32)
+                             .reshape(2, nt) * 2 + 1)
+    # two rows prefilled to DIFFERENT depths (ragged pos)
+    for i, L in enumerate((3, 6)):
+        prompt = jax.random.randint(jax.random.PRNGKey(i), (1, L), 0,
+                                    cfg.vocab)
+        sub = dict(pool0, block_tables=pool0["block_tables"][i][None],
+                   pos=jnp.zeros((1,), jnp.int32))
+        _lg, sub = forward_paged(params, prompt, sub, cfg,
+                                 prefill_impl="dense")
+        pool0 = dict(pool0, k=sub["k"], v=sub["v"],
+                     k_scale=sub["k_scale"], v_scale=sub["v_scale"])
+    pool0["pos"] = jnp.asarray([3, 6], jnp.int32)
+    tok = jnp.asarray([5, 9], jnp.int32)
+    outs = {}
+    for mode in ("off", "on"):
+        pool = dict(pool0, k=list(pool0["k"]), v=list(pool0["v"]),
+                    k_scale=list(pool0["k_scale"]),
+                    v_scale=list(pool0["v_scale"]))
+        chain = []
+        t = tok
+        for _ in range(3):
+            lg, pool = forward_paged(params, t[:, None], pool, cfg,
+                                     paged_kernel=mode)
+            t = jnp.argmax(lg[:, -1], axis=-1)
+            chain.append(t)
+        outs[mode] = jnp.stack(chain)
+    assert jnp.array_equal(outs["on"], outs["off"])
+
+
+def test_forward_paged_kernel_active_fence_and_recycled_garbage():
+    """A dead slot under the kernel path: writes fenced to garbage
+    block 0, pos frozen, and the LIVE slot's output is bitwise
+    invariant to scribbling over the dead slot's recycled blocks —
+    the retirement-safety contract on the kernel read path."""
+    from nvidia_terraform_modules_tpu.models.decode import forward_paged
+
+    cfg = BurnInConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    pool = init_paged_cache(cfg, 2, 16, block_size=8, num_blocks=9)
+    pool["block_tables"] = jnp.asarray([[3, 4], [5, 6]], jnp.int32)
+    pool["pos"] = jnp.asarray([6, 6], jnp.int32)
+    toks = jnp.asarray([5, 9], jnp.int32)
+    active = jnp.asarray([True, False])
+    lg, npool = forward_paged(params, toks[:, None], pool, cfg,
+                              active=active, paged_kernel="on")
+    assert int(npool["pos"][0]) == 7 and int(npool["pos"][1]) == 6
+    # scribble over the dead slot's blocks (as a recycling admission
+    # would) — the live row's logits must not move a bit
+    pool2 = dict(pool, k=[k.at[5].set(7.0).at[6].set(7.0)
+                          for k in pool["k"]],
+                 v=[v.at[5].set(7.0).at[6].set(7.0)
+                    for v in pool["v"]])
+    lg2, _ = forward_paged(params, toks[:, None], pool2, cfg,
+                           active=active, paged_kernel="on")
+    assert jnp.array_equal(lg[0], lg2[0])
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("cache_dtype", ["bf16", "int8"])
+@pytest.mark.parametrize("bs,gqa", [(4, False), (8, True), (16, True)])
+def test_forward_paged_kernel_parity_matrix(cache_dtype, bs, gqa):
+    """Kernel-vs-gather across block sizes (incl. bs=4 — below the
+    chip sublane grain, interpret-only), GQA, both cache dtypes."""
+    from nvidia_terraform_modules_tpu.models.decode import forward_paged
+
+    over = {"n_heads": 4, "n_kv_heads": 2} if gqa else {}
+    cfg, params, _fc, _ic = _paged_setup(**over)
+    rows = 256 if cache_dtype == "int8" else 32
+    nb = rows // bs * 2 + 3
+    pool0 = init_paged_cache(cfg, 2, rows, block_size=bs, num_blocks=nb,
+                             cache_dtype=cache_dtype)
+    nt = pool0["block_tables"].shape[1]
+    pool0["block_tables"] = jnp.stack(
+        [jnp.arange(nt, dtype=jnp.int32) * 2 + 1,
+         jnp.arange(nt, dtype=jnp.int32) * 2 + 2])
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 7), 0,
+                                cfg.vocab)
+    _lg, pool0 = forward_paged(params, prompt, pool0, cfg,
+                               prefill_impl="dense")
+    tok = jnp.argmax(_lg[:, -1], axis=-1)
+    chains = {}
+    for mode in ("off", "on"):
+        pool = {k: (list(v) if isinstance(v, list) else v)
+                for k, v in pool0.items()}
+        t, chain = tok, []
+        for _ in range(4):
+            lg, pool = forward_paged(params, t[:, None], pool, cfg,
+                                     paged_kernel=mode)
+            t = jnp.argmax(lg[:, -1], axis=-1)
+            chain.append(t)
+        chains[mode] = jnp.stack(chain)
+    assert jnp.array_equal(chains["on"], chains["off"])

@@ -1147,12 +1147,6 @@ def test_lazy_growth_with_eos_and_lever_validation():
         assert jnp.array_equal(g, w)
     with pytest.raises(ValueError, match="lazy_growth"):
         eng(prompts, n_new, slots=2, eos_id=eos, eos_check_every=4)
-    with pytest.raises(ValueError, match="spec_k|lever"):
-        make_serve_engine(params, cfg, max_len=16, spec_k=2,
-                          share_prefix=True)
-    with pytest.raises(ValueError, match="spec_k|lever"):
-        make_serve_engine(params, cfg, max_len=16, spec_k=2,
-                          lazy_growth=True)
 
 
 def test_all_three_levers_compose_bit_exactly():
@@ -1189,3 +1183,106 @@ def test_empty_prompt_refused():
     for kw in ({}, {"prefill_chunk": 4}, {"spec_k": 2}):
         with pytest.raises(ValueError, match="at least one token"):
             serve(params, empty, 3, cfg, slots=1, **kw)
+
+
+# --------------------------- spec decode on the lever engine (PR 11)
+
+
+def test_spec_composes_with_share_prefix_and_lazy_growth():
+    """The two former refusals, closed: a speculative engine with
+    cross-request prefix sharing AND lazy block growth bit-matches the
+    plain spec engine and solo greedy on the template workload, with
+    both levers demonstrably engaged and the pool drained."""
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+
+    cfg, params, _ = _setup(n_prompts=0)
+    prompts = _template_prompts(cfg)
+    budgets = [3, 6, 2, 5, 4, 3]
+    max_len = max(int(p.shape[-1]) + n for p, n in zip(prompts, budgets))
+    k = 2
+    plain_spec = make_serve_engine(params, cfg, max_len=max_len + k,
+                                   kv_block=4, spec_k=k)
+    want = plain_spec(prompts, budgets, slots=2)
+    lever = make_serve_engine(params, cfg, max_len=max_len + k,
+                              kv_block=4, spec_k=k, share_prefix=True,
+                              lazy_growth=True)
+    got = lever(prompts, budgets, slots=2)
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert jnp.array_equal(g, w), f"request {i} diverged"
+        solo = greedy_decode(params, prompts[i][None, :], budgets[i],
+                             cfg, max_len=max_len + k)[0]
+        assert jnp.array_equal(g, solo), f"request {i} != solo"
+    st = lever.last_stats
+    assert st["prefix"]["hit_blocks"] > 0
+    assert st["kv"]["blocks_grown_lazy"] > 0
+    assert st["kv"]["in_use"] == 0
+    assert st["accepted_per_step"] is not None
+
+
+def test_spec_lazy_growth_tight_pool_stalls_and_preempts():
+    """spec_k + lazy_growth at a kv_blocks cap barely above the worst
+    single request: growth stalls (and, if every live request stalls,
+    youngest-preemption) must reschedule, never change tokens."""
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+
+    cfg, params, prompts = _setup(n_prompts=5)
+    n_new, k = 6, 2
+    want = serve(params, prompts, n_new, cfg, slots=2, spec_k=k)
+    worst = max(int(p.shape[-1]) for p in prompts) + n_new + k
+    tight = 1 + -(-worst // 4) + 1
+    lazy = make_serve_engine(params, cfg, max_len=16 + k, kv_block=4,
+                             spec_k=k, lazy_growth=True)
+    got = lazy(prompts, n_new, slots=2, kv_blocks=tight)
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert jnp.array_equal(g, w), f"request {i} diverged"
+    st = lazy.last_stats
+    assert st["kv"]["blocks_grown_lazy"] > 0
+    # at this (deterministic, wave-clock) schedule the pool runs dry
+    # with every live request stalled — the youngest-preemption path
+    # runs, and preempted requests regenerate identical tokens
+    assert st["sched"]["preempted"] > 0
+    assert st["kv"]["in_use"] == 0
+
+
+def test_spec_share_prefix_with_chunked_prefill():
+    """The chunked-sync spec admission under sharing prefills ONLY the
+    unshared suffix (the donor's blocks map read-only) — tokens equal
+    the unshared spec engine's."""
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+
+    cfg, params, _ = _setup(n_prompts=0)
+    prompts = _template_prompts(cfg)
+    budgets = [3, 5, 2, 4, 3, 2]
+    max_len = 20
+    k = 2
+    plain_spec = make_serve_engine(params, cfg, max_len=max_len,
+                                   kv_block=4, spec_k=k,
+                                   prefill_chunk=4)
+    want = plain_spec(prompts, budgets, slots=2)
+    lever = make_serve_engine(params, cfg, max_len=max_len, kv_block=4,
+                              spec_k=k, prefill_chunk=4,
+                              share_prefix=True)
+    got = lever(prompts, budgets, slots=2)
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert jnp.array_equal(g, w), f"request {i} diverged"
+    assert lever.last_stats["prefix"]["hit_blocks"] > 0
+
+
+def test_serve_engine_paged_kernel_bitmatches_gather_engine():
+    """paged_kernel="on" (the block-table-native pallas wave step, in
+    interpret mode here) must reproduce the gather engine's tokens on
+    a recycling schedule — the engine-level twin of the op-level
+    bitwise gate, and the wiring proof for the TPU auto path."""
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+
+    cfg, params, prompts = _setup()
+    base = make_serve_engine(params, cfg, max_len=16, kv_block=8,
+                             paged_kernel="off")
+    want = base(prompts, 6, slots=2)
+    kern = make_serve_engine(params, cfg, max_len=16, kv_block=8,
+                             paged_kernel="on")
+    got = kern(prompts, 6, slots=2)
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert jnp.array_equal(g, w), f"request {i} diverged"
+    with pytest.raises(ValueError, match="paged_kernel"):
+        make_serve_engine(params, cfg, max_len=16, paged_kernel="hbm")

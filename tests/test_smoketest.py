@@ -56,6 +56,10 @@ def test_burnin_level(jax8):
     # demonstrably engaged (blocks actually shared)
     assert r.checks["serve_sched_ok"]
     assert r.checks["serve_sched_prefix_hit_blocks"] > 0
+    # the paged-kernel gate: the block-table-native pallas wave step
+    # bit-matches the gather engine's tokens on one shared-prefix
+    # wave, on this backend's real lowering (read-path-only contract)
+    assert r.checks["paged_decode_ok"]
 
 
 @pytest.mark.slow

@@ -440,10 +440,15 @@ def test_serve_engine_gauges_and_span_args_export(jax8, tmp_path):
     assert reg.gauge("serve_queue_depth").value == 0     # drained
     assert reg.gauge("serve_slot_occupancy").value == 0.0
     assert reg.gauge("kv_blocks_in_use").value == 0.0    # all freed
+    # the per-wave decode-time gauge (PR 11's paged-kernel signal):
+    # set every wave from the host clock, so the final value is the
+    # last wave's — positive on any schedule that stepped
+    assert reg.gauge("paged_decode_ms").value > 0
     prom = reg.prometheus_text()
     for line in ("# TYPE serve_queue_depth gauge",
                  "# TYPE serve_slot_occupancy gauge",
                  "# TYPE kv_blocks_in_use gauge",
+                 "# TYPE paged_decode_ms gauge",
                  "# TYPE serve_request_ms histogram"):
         assert line in prom, line
 
