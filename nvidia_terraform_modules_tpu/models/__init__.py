@@ -34,10 +34,18 @@ from .paging import (  # noqa: F401
     PrefixIndex,
     blocks_for_rows,
     chain_chunks,
+    export_block_rows,
+    import_block_rows,
     init_paged_cache,
     paged_pool_spec,
+    pool_transfer_keys,
 )
-from .serving import make_serve_engine, serve  # noqa: F401
+from .serving import (  # noqa: F401
+    AdmissionSource,
+    make_serve_engine,
+    serve,
+)
+from .fleet import make_fleet  # noqa: F401
 from .speculative import (  # noqa: F401
     make_speculative_decoder,
     speculative_greedy_decode,

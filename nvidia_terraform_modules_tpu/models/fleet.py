@@ -1,0 +1,678 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Fleet router: prefix-affinity multi-engine serving with SLO-aware
+shedding and disaggregated prefill/decode.
+
+One ``make_serve_engine`` is one chip's worth of traffic; the north
+star is millions of users, which means a FLEET layer above the engine
+(ROADMAP item 2). This module is that layer: ``N`` engine replicas —
+threads on CPU, one engine per slice on chip — behind a router that
+owns WHICH replica serves WHICH request and WHEN, driving each replica
+through the engine's injectable :class:`..serving.AdmissionSource`
+seam (never through private state):
+
+- **Cache-affinity routing.** Each prompt's routing key is the head of
+  its block-aligned ``PrefixIndex`` token-hash chain (the SAME
+  ``H(root, first-kv_block-tokens)`` key the engine's prefix index
+  matches on), consistent-hashed onto a virtual-node ring — so prompts
+  sharing a template land on the replica that already holds that
+  template's KV blocks, and the per-replica ``share_prefix`` index
+  turns fleet-level placement into physical block reuse. The
+  Gemma-on-TPU serving comparison (PAPERS.md) attributes its
+  throughput wins to exactly this KV-reuse-aware placement layer. A
+  LOAD-BALANCE OVERRIDE (``affinity_queue_bound``) reroutes to the
+  least-loaded replica when the affinity target's predicted backlog at
+  the request's arrival exceeds the bound — affinity must never become
+  a hot-template hotspot.
+
+- **SLO-aware admission.** Per-request deadlines (seconds from
+  arrival; ``utils/traffic.slo_deadlines`` generates them from the
+  same seeds as the arrival trace) drive LOAD SHEDDING at routing
+  time: the router keeps a deterministic virtual clock per replica
+  (predicted start = max(arrival, replica busy-until), predicted
+  service = ``est_token_s × budget``) and sheds any request whose
+  predicted completion would blow its deadline — admission control as
+  a pure function of the trace, so shed decisions replay identically
+  run to run (the bench determinism gate). Shed requests return
+  ``None`` and are billed in ``last_stats["fleet"]``.
+
+- **Cross-replica work stealing.** While replicas run, the router
+  monitors queue depths: when one queue backs up (≥ 2 pending) while
+  another sits empty, the backed-up queue's TAIL request moves over —
+  tail-only so the head a replica may be mid-admitting is never taken.
+  Tokens are schedule-invariant (the engine's exactness contract), so
+  a steal can re-place a request freely; only placement stats change.
+
+- **Disaggregated prefill/decode** (``disaggregate=True``).
+  Podracer-style role split (PAPERS.md): ``prefill_workers`` replicas
+  run prefill ONLY (the engine's ``prefill_session`` — compute-bound
+  prompt-width matmuls, prefix sharing ACROSS requests per worker),
+  and hand each finished prompt's KV to a decode worker with the PAGED
+  BLOCK as the transfer unit (``paging.export_block_rows`` →
+  ``kv_import`` admission → ``paging.import_block_rows``): an explicit
+  pool-to-pool copy on CPU, and exactly the seam an ICI/DCN block
+  transfer slots into on chip. Decode workers are
+  bandwidth-bound wave loops that never pay a prefill. Routing
+  affinity applies to the PREFILL side (that is where the prefix index
+  lives); handoffs go to the least-loaded decode queue.
+
+Exactness contract (the house gate, pinned in ``tests/test_fleet.py``):
+the router is SCHEDULING, never a different model. A 1-replica fleet
+bit-matches the bare engine per request; N-replica greedy outputs
+bit-match solo decode whatever the placement, steals or preemptions;
+disaggregated bit-matches colocated. Telemetry: one ``fleet_route``
+span per request (args carry the chosen replica) on the SAME registry
+the engines emit their ``serve_prefill``/``serve_request`` spans into,
+so router and engine stitch on one Chrome-trace timeline;
+``fleet_queue_depth``/``fleet_affinity_hit_frac`` gauges and
+``fleet_shed_total``/``fleet_steal_total`` counters ride alongside.
+
+Reference analogue: none — the reference provisions the node pools a
+fleet like this runs on (SURVEY §2.6); this is the router those
+``serve``-named slice pools front.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import random
+import threading
+import time
+from typing import Any, Sequence
+
+import numpy as np
+
+from .burnin import BurnInConfig
+from .paging import PrefixIndex, chain_chunks
+from .serving import AdmissionSource, make_serve_engine
+
+_ROUTINGS = ("affinity", "random")
+
+
+def _blake_int(data: bytes) -> int:
+    return int.from_bytes(
+        hashlib.blake2b(data, digest_size=8).digest(), "big")
+
+
+def affinity_key(tokens, block_size: int) -> bytes:
+    """A prompt's routing key: the head of its block-aligned token-hash
+    chain — ``PrefixIndex``'s OWN key for the first full ``block_size``
+    chunk, so two prompts get the same routing key exactly when the
+    engine's prefix index could share their first block. Prompts
+    shorter than one block have nothing shareable; they key on their
+    whole token string (spreading them is free)."""
+    toks = [int(t) for t in np.asarray(tokens).reshape(-1)]
+    chunks = chain_chunks(toks, block_size)
+    if chunks:
+        return PrefixIndex._key(None, chunks[0])
+    return hashlib.blake2b(
+        ("short:" + ",".join(str(t) for t in toks)).encode(),
+        digest_size=16).digest()
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes: each target owns
+    ``vnodes`` seeded points on a 64-bit ring; a key routes to the
+    first point clockwise. Adding/removing a replica moves only
+    ~1/N of the keyspace — the property that keeps template→replica
+    placement (and therefore each replica's warm prefix index) stable
+    across fleet resizes."""
+
+    def __init__(self, n_targets: int, vnodes: int = 16):
+        if n_targets < 1:
+            raise ValueError(f"need >= 1 target, got {n_targets}")
+        pts = sorted(
+            (_blake_int(f"fleet-target-{t}-vnode-{v}".encode()), t)
+            for t in range(n_targets) for v in range(vnodes))
+        self._points = [p for p, _ in pts]
+        self._targets = [t for _, t in pts]
+
+    def target(self, key: bytes) -> int:
+        i = bisect.bisect_right(self._points, _blake_int(key)) \
+            % len(self._points)
+        return self._targets[i]
+
+
+class _FleetQueue(AdmissionSource):
+    """One replica's admission stream, owned by the ROUTER: thread-safe
+    (the serving engine polls from its run thread; the router primes,
+    steals and closes from the monitor thread), arrival-ordered, with
+    optional per-request kv-import payloads (the disaggregated
+    handoff). ``exhausted()`` is closed-AND-empty — an open-but-empty
+    queue keeps its engine's wave loop alive (``idle_wait`` polling)
+    so a steal or a late handoff can still land."""
+
+    def __init__(self, t0: float, poll_s: float, on_retire):
+        self._lock = threading.Lock()
+        self._pending: list[int] = []            # arrival-ascending
+        self._arrival: dict[int, float] = {}
+        self._payload: dict[int, Any] = {}
+        self._closed = False
+        self._claimed: int | None = None         # candidate in flight
+        self.t0 = t0
+        self.poll_s = poll_s
+        self._on_retire = on_retire
+        self.admitted = 0
+
+    def _insort(self, req: int) -> None:
+        bisect.insort(self._pending, req,
+                      key=lambda r: (self._arrival[r], r))
+
+    # ---- router-facing -------------------------------------------
+    def add(self, req: int, arrival: float = 0.0, payload=None) -> None:
+        with self._lock:
+            self._arrival[req] = arrival
+            if payload is not None:
+                self._payload[req] = payload
+            self._insort(req)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def steal_tail(self):
+        """Remove and return ``(req, arrival, payload)`` for the
+        LATEST-arrival pending request — only when ≥ 2 are pending and
+        the tail is not the CLAIMED candidate (the one the replica may
+        be mid-admitting between its ``candidate()`` and ``pop()``;
+        normally the head, but a handoff ``add`` landing an
+        earlier-arrival entry in the meantime can demote it to the
+        tail — stealing it then would double-place the request and
+        blow up the admitting engine's ``pop``)."""
+        with self._lock:
+            if len(self._pending) < 2 \
+                    or self._pending[-1] == self._claimed:
+                return None
+            req = self._pending.pop()
+            return (req, self._arrival[req],
+                    self._payload.pop(req, None))
+
+    # ---- engine-facing (AdmissionSource) -------------------------
+    def candidate(self):
+        now = time.monotonic() - self.t0
+        with self._lock:
+            if not self._pending:
+                self._claimed = None
+                return None
+            head = self._pending[0]
+            if self._arrival[head] > now:
+                self._claimed = None
+                return None
+            # claim under the SAME lock the steal monitor takes: from
+            # here until pop()/the next candidate(), the monitor will
+            # not steal this request (a stale claim — admission held
+            # for blocks — just shields one request until the next
+            # poll of candidate(), never loses one)
+            self._claimed = head
+            return head
+
+    def pop(self, req) -> None:
+        with self._lock:
+            self._pending.remove(req)
+            if self._claimed == req:
+                self._claimed = None
+            self.admitted += 1
+
+    def requeue(self, req) -> None:
+        with self._lock:
+            self._insort(req)
+
+    def waiting(self) -> int:
+        now = time.monotonic() - self.t0
+        with self._lock:
+            return sum(1 for r in self._pending
+                       if self._arrival[r] <= now)
+
+    def exhausted(self) -> bool:
+        with self._lock:
+            return self._closed and not self._pending
+
+    def idle_wait(self) -> None:
+        now = time.monotonic() - self.t0
+        with self._lock:
+            nxt = (self._arrival[self._pending[0]]
+                   if self._pending else None)
+        if nxt is not None and nxt > now:
+            time.sleep(min(nxt - now, self.poll_s))
+        else:
+            time.sleep(self.poll_s)
+
+    def wait_s(self, req) -> float:
+        return max(0.0, time.monotonic() - self.t0
+                   - self._arrival.get(req, 0.0))
+
+    def kv_import(self, req):
+        return self._payload.get(req)
+
+    def retired(self, req, tokens: int) -> None:
+        with self._lock:
+            self._payload.pop(req, None)
+        self._on_retire(req, tokens)
+
+
+def _take_next(q: _FleetQueue):
+    """Blocking pull for the prefill-worker loop (the decode side's
+    engine loop does its own polling through the interface)."""
+    while True:
+        req = q.candidate()
+        if req is not None:
+            q.pop(req)
+            return req
+        if q.exhausted():
+            return None
+        q.idle_wait()
+
+
+def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
+               replicas: int = 2, routing: str = "affinity",
+               affinity_queue_bound: int | None = None,
+               disaggregate: bool = False, prefill_workers: int = 1,
+               steal: bool = True, steal_poll_s: float = 0.002,
+               est_token_s: float | None = None,
+               telemetry=None, route_seed: int = 0,
+               **engine_kw):
+    """Build the fleet: ``replicas`` serve engines behind the router.
+
+    Returns ``fleet(prompts, n_new, *, slots=4, eos_id=None, rng=None,
+    arrivals=None, deadlines=None, kv_blocks=None) → list`` — one
+    token array per request in request order, ``None`` where the SLO
+    admission shed. After each call ``fleet.last_stats`` carries the
+    engines' per-replica stats (``"replica_stats"``) plus the router's
+    own ``"fleet"`` record: per-replica request counts / occupancy /
+    waves / KV peaks, the affinity hit fraction realised by the
+    replicas' prefix indexes, shed and steal counts, and deadline
+    attainment (fraction of served deadline-carrying requests that
+    finished inside their deadline, wall clock).
+
+    ``routing="affinity"`` (default) consistent-hashes each prompt's
+    first-block token-hash chain key onto the replica ring (see
+    :func:`affinity_key`); ``"random"`` places seeded-uniformly — the
+    A/B baseline ``bench.py section_serve_fleet`` compares hit
+    fractions against. ``affinity_queue_bound`` caps how deep an
+    affinity target's predicted backlog may grow before the router
+    overrides to the least-loaded replica.
+
+    ``deadlines`` (per request, seconds from arrival) turn on SLO
+    admission: the router's deterministic virtual clock predicts each
+    request's completion (service ≈ ``est_token_s`` × its ``n_new``
+    budget — calibrate ``est_token_s`` from a measured run; it is
+    required when deadlines are given) and SHEDS requests whose
+    prediction blows the deadline, before any device work.
+
+    ``disaggregate=True`` splits the ``replicas`` into
+    ``prefill_workers`` prefill-only workers and the rest decode-only
+    workers: prefill workers run ``prefill_session`` loops (affinity
+    routing applies to THEM — the prefix index lives with prefill) and
+    hand finished prompts' KV blocks to the least-loaded decode
+    worker's queue as ``kv_import`` payloads. Greedy only (the handoff
+    carries a picked first token).
+
+    ``**engine_kw`` passes through to every ``make_serve_engine``
+    (``kv_block``, ``share_prefix``, ``cache_dtype``, ``lazy_growth``,
+    ``paged_kernel``, ``sampler``, …). Note an engine driven through an
+    injected admission source never consults its own ``policy`` — the
+    router IS the policy. The fleet's telemetry registry (``telemetry=``,
+    default the process registry) is shared with every engine, so
+    ``fleet_route`` spans and the engines' serve spans land on ONE
+    timeline.
+    """
+    if replicas < 1:
+        raise ValueError(f"replicas must be >= 1, got {replicas}")
+    if routing not in _ROUTINGS:
+        raise ValueError(f"unknown routing {routing!r}: "
+                         f"use {' | '.join(_ROUTINGS)}")
+    if affinity_queue_bound is not None and affinity_queue_bound < 1:
+        raise ValueError(f"affinity_queue_bound must be >= 1, got "
+                         f"{affinity_queue_bound}")
+    if est_token_s is not None and est_token_s <= 0:
+        raise ValueError(f"est_token_s must be > 0, got {est_token_s}")
+    if disaggregate:
+        if replicas < 2:
+            raise ValueError(
+                "disaggregate=True needs >= 2 replicas (at least one "
+                "prefill worker AND one decode worker)")
+        if not 1 <= prefill_workers <= replicas - 1:
+            raise ValueError(
+                f"prefill_workers must be in [1, replicas-1] = "
+                f"[1, {replicas - 1}], got {prefill_workers}")
+        if engine_kw.get("sampler") is not None:
+            raise ValueError(
+                "disaggregated serving is greedy-only: the prefill "
+                "handoff carries a greedily picked first token")
+        for k in ("spec_k", "prefix", "prefill_chunk"):
+            if engine_kw.get(k) is not None:
+                raise ValueError(
+                    f"disaggregate=True does not compose with {k} "
+                    f"(see prefill_session)")
+    from ..telemetry import get_registry
+
+    reg = telemetry if telemetry is not None else get_registry()
+    kv_block = engine_kw.get("kv_block", 16)
+    n_pre = prefill_workers if disaggregate else 0
+    n_dec = replicas - n_pre
+    # every engine shares the fleet's registry so router + engine spans
+    # stitch on one timeline; engines are separate objects on purpose —
+    # separate pools, separate step caches, no cross-thread state
+    dec_engines = [make_serve_engine(params, cfg, max_len=max_len,
+                                     telemetry=reg, **engine_kw)
+                   for _ in range(n_dec)]
+    pre_engines = [make_serve_engine(params, cfg, max_len=max_len,
+                                     telemetry=reg, **engine_kw)
+                   for _ in range(n_pre)]
+    ring = HashRing(n_pre if disaggregate else n_dec)
+    if reg.enabled:
+        _g_depth = reg.gauge("fleet_queue_depth")
+        _g_hitf = reg.gauge("fleet_affinity_hit_frac")
+        _c_shed = reg.counter("fleet_shed_total")
+        _c_steal = reg.counter("fleet_steal_total")
+
+    def _plan(prompts, budgets, arrivals, deadlines):
+        """Deterministic routing + shed plan — a pure function of the
+        trace (prompt tokens, arrivals, budgets, deadlines) and the
+        route seed, so shed fractions and placements replay exactly.
+        The virtual clock models each TARGET as a serial server at
+        ``est_token_s`` per budgeted token: coarse on purpose — it is
+        admission control (shed what cannot possibly meet its
+        deadline), not a simulator; work stealing repairs what the
+        model mispredicts."""
+        n_targets = n_pre if disaggregate else n_dec
+        rnd = random.Random(f"fleet-route-{route_seed}")
+        busy_until = [0.0] * n_targets
+        finishes: list[list[float]] = [[] for _ in range(n_targets)]
+        plan = []                        # (req, target, by_affinity)
+        shed = []
+        for req in range(len(prompts)):
+            a = arrivals[req] if arrivals is not None else 0.0
+            if routing == "affinity":
+                t_aff = ring.target(affinity_key(prompts[req], kv_block))
+            else:
+                t_aff = rnd.randrange(n_targets)
+            t, by_aff = t_aff, routing == "affinity"
+            if affinity_queue_bound is not None:
+                backlog = sum(1 for f in finishes[t_aff] if f > a)
+                if backlog >= affinity_queue_bound:
+                    t = min(range(n_targets),
+                            key=lambda j: (max(busy_until[j], a), j))
+                    by_aff = by_aff and t == t_aff
+            start = max(a, busy_until[t])
+            finish = start + (est_token_s or 0.0) * budgets[req]
+            if deadlines is not None and finish - a > deadlines[req]:
+                shed.append(req)
+                continue
+            busy_until[t] = finish
+            finishes[t].append(finish)
+            plan.append((req, t, by_aff))
+        return plan, shed
+
+    def fleet(prompts: Sequence[Any], n_new, *, slots: int = 4,
+              eos_id: int | None = None, rng=None, arrivals=None,
+              deadlines=None, kv_blocks: int | None = None) -> list:
+        fleet.last_stats = None
+        n = len(prompts)
+        if n == 0:
+            return []
+        budgets = ([n_new] * n if isinstance(n_new, int)
+                   else [int(x) for x in n_new])
+        if len(budgets) != n:
+            raise ValueError(
+                f"per-request n_new has {len(budgets)} entries for "
+                f"{n} prompts")
+        if arrivals is not None:
+            arrivals = [float(a) for a in arrivals]
+            if len(arrivals) != n:
+                raise ValueError(
+                    f"arrivals has {len(arrivals)} entries for "
+                    f"{n} prompts")
+        if deadlines is not None:
+            deadlines = [float(d) for d in deadlines]
+            if len(deadlines) != n:
+                raise ValueError(
+                    f"deadlines has {len(deadlines)} entries for "
+                    f"{n} prompts")
+            if est_token_s is None:
+                raise ValueError(
+                    "SLO shedding needs est_token_s (predicted "
+                    "service per budgeted token) — calibrate it from "
+                    "a measured run of this config")
+
+        plan, shed = _plan(prompts, budgets, arrivals, deadlines)
+        t0 = time.monotonic()
+        retire_at: dict[int, float] = {}
+        retire_tok: dict[int, int] = {}
+        r_lock = threading.Lock()
+
+        def on_retire(req, tokens):
+            with r_lock:
+                retire_at[req] = time.monotonic() - t0
+                retire_tok[req] = tokens
+
+        dec_queues = [_FleetQueue(t0, steal_poll_s, on_retire)
+                      for _ in range(n_dec)]
+        pre_queues = [_FleetQueue(t0, steal_poll_s, on_retire)
+                      for _ in range(n_pre)]
+        routed_to: dict[int, str] = {}
+        by_aff_n = 0
+        for req, t, by_aff in plan:
+            a = arrivals[req] if arrivals is not None else 0.0
+            label = (f"prefill-{t}" if disaggregate else f"replica-{t}")
+            (pre_queues if disaggregate else dec_queues)[t].add(req, a)
+            routed_to[req] = label
+            by_aff_n += by_aff
+            if reg.enabled:
+                tc = reg.clock()
+                reg.emit_span("fleet_route", tc, tc, request=req,
+                              replica=label, affinity=bool(by_aff),
+                              shed=False)
+        for req in shed:
+            if reg.enabled:
+                tc = reg.clock()
+                reg.emit_span("fleet_route", tc, tc, request=req,
+                              replica=None, affinity=False, shed=True)
+        if reg.enabled and shed:
+            _c_shed.inc(len(shed))
+        for q in pre_queues:
+            q.close()                    # routing is final for prefill
+
+        sessions: list[Any] = [None] * n_pre
+        results: list[Any] = [None] * n_dec
+        errors: list[tuple] = []
+        stolen = [0]
+
+        def _abort_all():
+            for q in pre_queues + dec_queues:
+                q.close()
+
+        def dec_worker(i):
+            try:
+                results[i] = dec_engines[i](
+                    prompts, budgets, slots=slots, eos_id=eos_id,
+                    rng=rng, kv_blocks=kv_blocks,
+                    admission=dec_queues[i])
+            except Exception as exc:     # noqa: BLE001 — re-raised below
+                errors.append((f"decode-{i}", exc))
+                _abort_all()
+
+        def pre_worker(i):
+            try:
+                sessions[i] = pre_engines[i].prefill_session()
+                while True:
+                    req = _take_next(pre_queues[i])
+                    if req is None:
+                        break
+                    payload = sessions[i].prefill(prompts[req])
+                    # least-loaded decode queue (tie → lowest index):
+                    # decode placement is free — the payload carries
+                    # everything, affinity already paid off at prefill
+                    j = min(range(n_dec),
+                            key=lambda d: (dec_queues[d].pending_count(),
+                                           d))
+                    a = (arrivals[req] if arrivals is not None else 0.0)
+                    dec_queues[j].add(req, a, payload)
+                    if reg.enabled:
+                        tc = reg.clock()
+                        reg.emit_span("fleet_route", tc, tc,
+                                      request=req,
+                                      replica=f"decode-{j}",
+                                      affinity=False, shed=False,
+                                      handoff=True)
+            except Exception as exc:     # noqa: BLE001 — re-raised below
+                errors.append((f"prefill-{i}", exc))
+                _abort_all()
+            finally:
+                if sessions[i] is not None:
+                    sessions[i].close()
+
+        pre_threads = [threading.Thread(target=pre_worker, args=(i,),
+                                        daemon=True)
+                       for i in range(n_pre)]
+        dec_threads = [threading.Thread(target=dec_worker, args=(i,),
+                                        daemon=True)
+                       for i in range(n_dec)]
+        for th in pre_threads + dec_threads:
+            th.start()
+
+        # ---- the router's monitor loop (this thread): queue-depth
+        # gauge, work stealing, and closure once no add can ever come
+        while any(th.is_alive() for th in dec_threads):
+            depths = [q.pending_count() for q in dec_queues]
+            if reg.enabled:
+                _g_depth.set(sum(depths)
+                             + sum(q.pending_count()
+                                   for q in pre_queues))
+            adds_done = not any(th.is_alive() for th in pre_threads)
+            if adds_done and sum(depths) == 0:
+                for q in dec_queues:
+                    q.close()
+                break
+            if steal and n_dec > 1:
+                receivers = [i for i, d in enumerate(depths) if d == 0]
+                donor = max(range(n_dec), key=lambda i: depths[i])
+                if receivers and depths[donor] >= 2 \
+                        and donor not in receivers:
+                    got = dec_queues[donor].steal_tail()
+                    if got is not None:
+                        req, a, payload = got
+                        dec_queues[receivers[0]].add(req, a, payload)
+                        routed_to[req] = f"stolen->{receivers[0]}"
+                        stolen[0] += 1
+                        if reg.enabled:
+                            _c_steal.inc()
+            time.sleep(steal_poll_s)
+        for th in pre_threads + dec_threads:
+            th.join()
+        if errors:
+            where, exc = errors[0]
+            raise RuntimeError(
+                f"fleet worker {where} failed: {exc}") from exc
+
+        merged: dict[int, Any] = {}
+        for r in results:
+            merged.update(r or {})
+        missing = set(range(n)) - set(shed) - set(merged)
+        if missing:
+            # a lost request is a router bug, never silent truncation
+            raise RuntimeError(
+                f"fleet lost requests {sorted(missing)} — served "
+                f"{len(merged)}, shed {len(shed)} of {n}")
+
+        # ---- stats -----------------------------------------------
+        per_replica = []
+        hit_b = prompt_b = saved = 0
+        for i, e in enumerate(dec_engines):
+            st = e.last_stats
+            per_replica.append({
+                "role": "decode", "replica": f"decode-{i}"
+                if disaggregate else f"replica-{i}",
+                "requests": st["requests"], "waves": st["waves"],
+                "occupancy": st["sched"]["mean_live_requests"],
+                "kv_peak_blocks": st["kv"]["high_water"],
+                "preempted": st["sched"]["preempted"],
+            })
+            hit_b += st["prefix"]["hit_blocks"]
+            prompt_b += st["prefix"]["prompt_blocks"]
+            saved += st["prefix"]["tokens_saved"]
+        for i, s in enumerate(sessions):
+            if s is None:
+                continue
+            per_replica.append({
+                "role": "prefill", "replica": f"prefill-{i}",
+                "requests": s.stats["requests"], "waves": None,
+                "occupancy": None, "kv_peak_blocks": s.alloc.high_water,
+                "preempted": 0,
+            })
+            hit_b += s.stats["hit_blocks"]
+            prompt_b += s.stats["prompt_blocks"]
+            saved += s.stats["tokens_saved"]
+        hit_frac = round(hit_b / max(prompt_b, 1), 4)
+
+        met = with_dl = 0
+        goodput_tokens = 0
+        lat_ms: list[float] = []         # arrival → completion, per req
+        for req in merged:
+            tok = retire_tok.get(req, int(merged[req].shape[0]))
+            a = arrivals[req] if arrivals is not None else 0.0
+            done = retire_at.get(req)
+            if done is not None:
+                lat_ms.append(max(0.0, done - a) * 1e3)
+            if deadlines is None:
+                goodput_tokens += tok
+                continue
+            with_dl += 1
+            ok = (done if done is not None else float("inf")) - a \
+                <= deadlines[req]
+            met += ok
+            if ok:
+                goodput_tokens += tok
+        lat_ms.sort()
+
+        def _q(p):
+            return (round(lat_ms[min(len(lat_ms) - 1,
+                                     int(p * len(lat_ms)))], 3)
+                    if lat_ms else None)
+        if reg.enabled:
+            _g_hitf.set(hit_frac)
+            _g_depth.set(0)
+
+        fleet.last_stats = {
+            "fleet": {
+                "replicas": replicas,
+                "mode": ("disaggregated" if disaggregate
+                         else "colocated"),
+                "prefill_workers": n_pre,
+                "routing": routing,
+                "requests": n,
+                "served": len(merged),
+                "shed": len(shed),
+                "shed_requests": sorted(shed),
+                "stolen": stolen[0],
+                "affinity_routed_frac": round(
+                    by_aff_n / max(len(plan), 1), 4),
+                "affinity_hit_blocks": hit_b,
+                "affinity_hit_frac": hit_frac,
+                "prefill_tokens_saved": saved,
+                "deadline_attainment": (round(met / with_dl, 4)
+                                        if with_dl else None),
+                "goodput_tokens": goodput_tokens,
+                # arrival → completion (the user's clock: router queue
+                # time INCLUDED, unlike the per-engine latency record
+                # which starts at admission)
+                "latency_ms": {"p50": _q(0.5), "p99": _q(0.99),
+                               "max": (round(lat_ms[-1], 3)
+                                       if lat_ms else None)},
+                "per_replica": per_replica,
+                "routed_to": routed_to,
+            },
+            "replica_stats": [e.last_stats for e in dec_engines],
+        }
+        out: list[Any] = [None] * n
+        for req, toks in merged.items():
+            out[req] = toks
+        return out
+
+    fleet.last_stats = None
+    return fleet

@@ -44,11 +44,17 @@ ids, holding one reference per indexed block so a retained prefix
 survives its writer's retirement, with an LRU cap on
 retained-but-unreferenced blocks.
 
+The paged block is also the fleet's TRANSFER UNIT:
+:func:`export_block_rows` / :func:`import_block_rows` copy whole
+blocks' physical content between two pools (the prefill→decode handoff
+of ``models/fleet.py``'s disaggregated mode — an explicit device copy
+on CPU, the seam an ICI/DCN transfer slots into on chip).
+
 ``tests/test_paging.py`` pins the allocator invariants (no double
 alloc, free-list recycling, exhaustion, the fragmentation bound,
-refcount free-at-zero, LRU eviction safety) and
-``tests/test_serving.py`` the end-to-end exactness of paged serving
-against solo decode.
+refcount free-at-zero, LRU eviction safety, cross-pool transfer
+roundtrips) and ``tests/test_serving.py`` the end-to-end exactness of
+paged serving against solo decode.
 """
 
 from __future__ import annotations
@@ -340,6 +346,116 @@ class PrefixIndex:
             n += self._evict(next(iter(self._entries)))
         self._children.clear()
         return n
+
+
+_POOL_KEYS = ("k", "v", "k_scale", "v_scale")
+
+_XFER_JITS: dict[str, Any] = {}
+
+
+def _xfer_jits() -> dict[str, Any]:
+    """Module-level jit singletons for the cross-pool transfer pair —
+    built lazily (this module stays importable without paying jax) and
+    cached so repeated transfers of the same block count reuse one
+    compiled program."""
+    if not _XFER_JITS:
+        import functools
+
+        import jax
+
+        @jax.jit
+        def export_fn(bufs, ids):
+            return [b[ids] for b in bufs]
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def import_fn(bufs, ids, payload):
+            return [b.at[ids].set(p) for b, p in zip(bufs, payload)]
+
+        _XFER_JITS["export"] = export_fn
+        _XFER_JITS["import"] = import_fn
+    return _XFER_JITS
+
+
+def pool_transfer_keys(pool: dict) -> list[str]:
+    """The pool entries a block transfer moves: the per-layer physical
+    buffers (k/v, plus int8 scale sidecars when present) — never the
+    per-slot ``block_tables``/``pos``, which are the RECEIVER's own
+    bookkeeping."""
+    return [k for k in _POOL_KEYS if k in pool]
+
+
+def export_block_rows(pool: dict, block_ids: Sequence[int]) -> dict:
+    """Copy the physical content of ``block_ids`` out of ``pool``:
+    ``{key: [per-layer [n, block_size, ...] arrays]}`` in block-id
+    order, every transferable key in one dispatch.
+
+    This is the prefill→decode handoff's transfer unit (ROADMAP
+    direction 2 / Podracer's role split): a prefill worker exports the
+    blocks its finished prompt occupies and a DIFFERENT pool imports
+    them via :func:`import_block_rows` — an explicit device copy on
+    CPU, and exactly the seam where an ICI/DCN block transfer slots in
+    on chip (the payload is already the wire format: whole blocks, no
+    row surgery). Rows past the request's position inside the last
+    block ride along as unreachable garbage on both sides.
+    """
+    import jax.numpy as jnp
+
+    ids = jnp.asarray(list(block_ids), jnp.int32)
+    if ids.ndim != 1 or ids.shape[0] < 1:
+        raise ValueError("export_block_rows needs >= 1 block id")
+    keys = pool_transfer_keys(pool)
+    bufs = [b for k in keys for b in pool[k]]
+    outs = _xfer_jits()["export"](bufs, ids)
+    n_layers = len(pool["k"])
+    payload: dict[str, Any] = {}
+    i = 0
+    for k in keys:
+        payload[k] = list(outs[i:i + n_layers])
+        i += n_layers
+    return payload
+
+
+def import_block_rows(pool: dict, block_ids: Sequence[int],
+                      payload: dict) -> dict:
+    """Write :func:`export_block_rows` ``payload`` into ``pool`` at
+    ``block_ids`` (the receiver's own allocated blocks — transfer never
+    implies the same physical ids on both sides). Returns a NEW pool
+    dict; the physical buffers are DONATED (updated in place when XLA
+    can), so callers must rebind their pool reference, exactly like the
+    engine's wave step. Importing into a reserved block is refused
+    loudly — scribbling the garbage block would corrupt every fenced
+    write in flight."""
+    import jax.numpy as jnp
+
+    ids_h = [int(b) for b in block_ids]
+    if any(b < 1 for b in ids_h):
+        raise ValueError(
+            f"cannot import into reserved block(s) {sorted(set(b for b in ids_h if b < 1))} "
+            f"— block 0 is the garbage block every fenced write targets")
+    keys = pool_transfer_keys(pool)
+    if sorted(payload) != sorted(keys):
+        raise ValueError(
+            f"payload keys {sorted(payload)} do not match the pool's "
+            f"transferable keys {sorted(keys)} (cache_dtype mismatch "
+            f"between the exporting and importing pools?)")
+    n = len(ids_h)
+    for k in keys:
+        for buf in payload[k]:
+            if int(buf.shape[0]) != n:
+                raise ValueError(
+                    f"payload[{k!r}] carries {int(buf.shape[0])} blocks "
+                    f"for {n} block ids")
+    ids = jnp.asarray(ids_h, jnp.int32)
+    bufs = [b for k in keys for b in pool[k]]
+    pl = [b for k in keys for b in payload[k]]
+    outs = _xfer_jits()["import"](bufs, ids, pl)
+    n_layers = len(pool["k"])
+    out = dict(pool)
+    i = 0
+    for k in keys:
+        out[k] = list(outs[i:i + n_layers])
+        i += n_layers
+    return out
 
 
 def paged_pool_spec(cfg: BurnInConfig, max_len: int, block_size: int,

@@ -90,6 +90,16 @@ admission and a ``serve_request`` span per retirement carrying
 ``queue_wait_ms`` / ``prefill_ms`` / ``decode_steps`` — the
 p50/p99 request-latency record lands in ``serve_request_ms``.
 
+The FLEET seam (PR 12): the admission/queue head is an injectable
+interface — ``run(..., admission=)`` takes any
+:class:`AdmissionSource` and serves exactly what it yields (results
+keyed by request index), which is how ``models/fleet.py`` drives N
+replica engines, steals work between their queues mid-run, and feeds
+decode workers prefilled KV through ``kv_import`` payloads built by
+:func:`make_serve_engine`'s ``prefill_session`` (the disaggregated
+prefill→decode handoff; ``models/paging.py``'s block transfer pair
+moves the bytes).
+
 Reference analogue: none — the reference provisions serving
 infrastructure (node pools, runtime DaemonSets) and never touches model
 bytes (SURVEY §2.6); this engine is the workload the ``serve``-named
@@ -122,7 +132,91 @@ _POLICIES = ("fifo", "sjf", "priority")
 _DEFAULT_AGING = 512                   # waves; bounds starvation by default
 
 
-class _Sched:
+class AdmissionSource:
+    """The engine's admission/queue head as an INJECTABLE interface.
+
+    ``run(..., admission=source)`` hands WHICH request to admit next —
+    and WHEN — to the caller: the engine polls ``candidate()`` at every
+    wave boundary, ``pop``s what it admits, ``requeue``s what a lazy-
+    growth preemption returns, and keeps its wave loop alive until
+    ``exhausted()`` says no candidate will ever come again. This is the
+    seam the fleet router (``models/fleet.py``) drives its replicas
+    through — dynamic cross-replica work stealing is just the router
+    mutating a replica's source between waves — with no reaching into
+    engine-private state and no test-hook monkeypatching. The built-in
+    :class:`_Sched` (policy admission over a fixed prompt list with
+    optional arrival gating) implements the same interface, so the
+    injected and default paths run the identical engine loop.
+
+    Contract for implementers (thread-safety is the implementer's
+    problem — the engine calls from its own run thread, a router may
+    mutate from another):
+
+    - ``candidate()`` → the request index to try admitting next, or
+      ``None`` (empty, or nothing has arrived yet). The engine may call
+      it several times per wave; a candidate whose block grant does not
+      fit is HELD (the engine stops admitting for the wave without
+      popping it).
+    - ``pop(req)`` — the engine admitted ``req``.
+    - ``requeue(req)`` — a preempted request goes back (its output must
+      regenerate on re-admission; the engine guarantees tokens are
+      schedule-invariant).
+    - ``tick()`` — one wave passed (aging hooks).
+    - ``waiting()`` → how many requests are admissible now (queue-depth
+      stat + the spec loop's wave sizing).
+    - ``exhausted()`` → True only when the source will NEVER yield
+      another candidate (empty AND closed); the engine's run loop exits
+      when exhausted with nothing in flight.
+    - ``idle_wait()`` — nothing admissible and nothing computing: block
+      briefly (until the next arrival, a router poll interval, …)
+      instead of spinning.
+    - ``wait_s(req)`` → the queue wait to bill for ``req`` at admission
+      (seconds).
+    - ``kv_import(req)`` → ``None`` for a normal admission, or a
+      prefill→decode handoff payload (see ``prefill_session``): the
+      engine then allocates blocks, IMPORTS the payload's prefilled KV
+      rows via ``paging.import_block_rows`` and starts decoding at the
+      payload's position — no prefill compute on this engine. The
+      payload stays the source's to keep until retirement (a preempted
+      import re-imports on re-admission).
+    - ``retired(req, tokens)`` — completion notification at the wave
+      the engine retired ``req`` (SLO attainment clocks stop here).
+    """
+
+    def candidate(self):
+        raise NotImplementedError
+
+    def pop(self, req):
+        raise NotImplementedError
+
+    def requeue(self, req):
+        raise NotImplementedError
+
+    def tick(self):
+        pass
+
+    def waiting(self) -> int:
+        return 0
+
+    def exhausted(self) -> bool:
+        raise NotImplementedError
+
+    def idle_wait(self) -> None:
+        pass
+
+    def wait_s(self, req) -> float:
+        return 0.0
+
+    def kv_import(self, req):
+        return None
+
+    def retired(self, req, tokens: int) -> None:
+        """The engine retired ``req`` after emitting ``tokens`` tokens
+        — the router's completion signal (SLO attainment clocks stop
+        here, steal heuristics see the slot free up). Default: no-op."""
+
+
+class _Sched(AdmissionSource):
     """Host-side admission ORDER: which pending request the engine
     should try to admit next. ``fifo`` is strict arrival order with
     head-of-line blocking (the baseline engine's exact semantics);
@@ -211,6 +305,33 @@ class _Sched:
         if self.arrivals is None or self.policy == "fifo":
             return self.pending[0]
         return min(self.pending, key=lambda r: self.arrivals[r])
+
+    def exhausted(self) -> bool:
+        """A fixed prompt list never grows: empty IS exhausted."""
+        return not self.pending
+
+    def idle_wait(self) -> None:
+        """Nothing to compute and no pending request has arrived:
+        sleep the gap to the blocking arrival instead of spinning.
+        (Without an arrival trace every pending request is admissible,
+        so this is never reached — blocks exhausted with nothing
+        active cannot happen; single-request capacity is validated up
+        front.)"""
+        if self.arrivals is None or not self.pending:
+            return
+        wait = self.arrivals[self.next_arrival()] \
+            - (time.monotonic() - self.t0)
+        if wait > 0:
+            time.sleep(wait)
+
+    def wait_s(self, req) -> float:
+        """Queue wait vs the request's arrival (t0 when no trace): a
+        request held for slots or KV blocks reports its real wait,
+        never a hardwired zero. One definition for both loops so the
+        spec and plain engines cannot diverge on wait accounting."""
+        return max(0.0, time.monotonic() - self.t0
+                   - (self.arrivals[req]
+                      if self.arrivals is not None else 0.0))
 
 
 def _request_key(rng, req, pos):
@@ -925,17 +1046,22 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 self.pool = _prefix_fill(prefill_params, prefix[None, :],
                                          jnp.asarray(row), self.pool)
 
-        def admit_blocks(self, req: int, prompt, length: int):
+        def admit_blocks(self, req: int, prompt, length: int, *,
+                         share: bool = True):
             """Allocate the request's blocks, sharing any indexed full
             leading prefix blocks first (refcount++ — read-only for
             this request); None = hold in queue. Returns ``(row, tail,
             start, shared_tokens, entries)`` where ``start`` is the
             prefill start position and ``entries`` the table entries
-            granted so far (the lazy-growth watermark)."""
+            granted so far (the lazy-growth watermark). ``share=False``
+            skips the prefix index entirely (imported admissions: their
+            rows arrive as bytes from another pool, so matching would
+            skip an import that must happen and registering would index
+            blocks this engine never hashed)."""
             shared: list[int] = []
             cov = 0
             n_chunks = 0
-            if self.index is not None:
+            if share and self.index is not None:
                 toks = self._toks.get(req)
                 if toks is None:
                     toks = [int(t) for t in np.asarray(prompt)]
@@ -982,7 +1108,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             # stats count ADMISSIONS, not probes: a request held for
             # blocks re-matches every wave, and billing each failed
             # attempt would skew hit_frac low by the wait length
-            if self.index is not None:
+            if share and self.index is not None:
                 self.prefix_stats["lookups"] += 1
                 self.prefix_stats["prompt_blocks"] += n_chunks
                 self.prefix_stats["hit_blocks"] += k
@@ -1228,6 +1354,49 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         _note_prefill(meta, req, t0c, length)
         return first, entries
 
+    def _admit_imported(rstate: _Run, slot: int, req: int, prompt,
+                        payload, meta, wait_s):
+        """Admission from a prefill→decode HANDOFF payload (built by
+        another engine's ``prefill_session``): allocate the full block
+        grant like any admission, but instead of prefilling, IMPORT the
+        payload's prefilled KV blocks into this pool
+        (``paging.import_block_rows`` — the explicit cross-pool copy)
+        and start decoding from the payload's first token at its
+        position. No prefix sharing on either side of an import: the
+        rows arrive as bytes, not as tokens this engine hashed.
+        Returns ``(first_token, granted_entries)`` or None (blocks
+        exhausted — the source keeps the payload for the retry)."""
+        from .paging import import_block_rows
+
+        if prefix is not None:
+            raise ValueError(
+                "imported admissions need an engine without a template "
+                "prefix= — the payload's rows start at position 0")
+        if sampler is not None:
+            raise ValueError(
+                "imported admissions are greedy-only: the handoff "
+                "payload's first token was picked by the (greedy) "
+                "prefill worker")
+        length = int(prompt.shape[-1])
+        if int(payload["n_tokens"]) != length:
+            raise ValueError(
+                f"handoff payload covers {payload['n_tokens']} tokens "
+                f"for a {length}-token prompt — foreign payload?")
+        got = rstate.admit_blocks(req, prompt, length, share=False)
+        if got is None:
+            return None
+        row, tail, start, _cov, entries = got
+        _note_admit(meta, req, wait_s)
+        # table + pos first (pos = the payload's prefilled length), then
+        # the block copy: ceil(length/bs) whole blocks, garbage tail
+        # rows unreachable behind pos exactly as after a local prefill
+        rstate.pool = _admit_table(jnp.int32(slot), row, tail,
+                                   jnp.int32(length), rstate.pool)
+        nb = blocks_for_rows(length, bs)
+        rstate.pool = import_block_rows(
+            rstate.pool, rstate.owned[req][:nb], payload["blocks"])
+        return payload["first"], entries
+
     def _chunk_split(prompt, length: int, start: int | None = None):
         """Pad-to-C chunking shared by the sync (spec) and interleaved
         (plain) admission paths: the chunk list, the true last token's
@@ -1282,21 +1451,6 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         _note_prefill(meta, req, t0c, length, chunks=len(chunks))
         return first, entries
 
-    def _queue_wait(arrivals, t0, req) -> float:
-        """Queue wait vs the request's arrival (t0 when no trace): a
-        request held for slots or KV blocks reports its real wait,
-        never a hardwired zero. One definition for both loops so the
-        spec and plain engines cannot diverge on wait accounting."""
-        return max(0.0, time.monotonic() - t0
-                   - (arrivals[req] if arrivals is not None else 0.0))
-
-    def _sleep_until_arrival(arrivals, sched, t0):
-        """Nothing to compute and no pending request has arrived:
-        sleep the gap to the earliest arrival instead of spinning."""
-        wait = arrivals[sched.next_arrival()] - (time.monotonic() - t0)
-        if wait > 0:
-            time.sleep(wait)
-
     def run_spec(prompts, n_new_of, slots, rules, eos_id, arrivals,
                  kv_blocks, priorities):
         """Speculative schedule: same admission/retire bookkeeping as
@@ -1344,7 +1498,6 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         generated = 0
         admitted = 0                   # prefill-emitted (non-step) tokens
         eos_dev = jnp.int32(-1 if eos_id is None else eos_id)
-        t0 = sched.t0
 
         def grow_to(slot: int, req: int, target_rows: int) -> bool:
             """Grant blocks until the slot's table covers
@@ -1376,7 +1529,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 if req is None:
                     break                        # nothing arrived yet
                 prompt = jnp.asarray(prompts[req])
-                wait_s = _queue_wait(arrivals, t0, req)
+                wait_s = sched.wait_s(req)
                 admit = (_admit_chunked_sync if prefill_chunk is not None
                          else _admit_one)
                 got = admit(rstate, slot, req, prompt, None,
@@ -1429,14 +1582,13 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                     # plain loop's count/span reset on preemption
                     req_steps.pop(req, None)
                     continue
-                if len(sched):
-                    if arrivals is not None and sched.candidate() is None:
-                        # nothing admissible until the blocking request
-                        # arrives (fifo: the head; else: the earliest)
-                        _sleep_until_arrival(arrivals, sched, t0)
-                    # else: blocks exhausted with nothing active cannot
-                    # happen — capacity for the largest single request
+                if len(sched) and sched.candidate() is None:
+                    # nothing admissible until the blocking request
+                    # arrives (fifo: the head; else: the earliest) —
+                    # blocks exhausted with nothing active cannot
+                    # happen; capacity for the largest single request
                     # is validated up front
+                    sched.idle_wait()
                 continue
             active_mask = jnp.asarray(
                 [s in active for s in range(slots)])
@@ -1542,6 +1694,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             "prefix": {
                 "enabled": share_prefix,
                 "hit_blocks": ps["hit_blocks"],
+                "prompt_blocks": ps["prompt_blocks"],
                 "hit_frac": round(ps["hit_blocks"]
                                   / max(ps["prompt_blocks"], 1), 4),
                 "tokens_saved": ps["tokens_saved"],
@@ -1555,10 +1708,29 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             eos_check_every: int = 1, arrivals=None,
             kv_blocks: int | None = None,
             static_batching: bool = False,
-            priorities=None) -> list[Any]:
+            priorities=None, admission=None):
         # reset on entry: a failed run must not leave a prior run's
         # stats for an error-catching caller to misattribute
         run.last_stats = None
+        if admission is not None:
+            # an injected AdmissionSource OWNS order, timing and the
+            # kv-import decision — the knobs that overlap it must be
+            # absent, not silently ignored
+            if arrivals is not None:
+                raise ValueError(
+                    "admission= owns arrival gating — drop arrivals")
+            if static_batching:
+                raise ValueError(
+                    "admission= replaces the engine's scheduler; "
+                    "static_batching configures the built-in one")
+            if priorities is not None:
+                raise ValueError(
+                    "admission= replaces the engine's policy order; "
+                    "priorities configure the built-in one")
+            if spec_k is not None:
+                raise ValueError(
+                    "external admission drives the plain wave loop "
+                    "only — drop spec_k")
         if not prompts:
             # same stats schema as every other path — a caller reading
             # last_stats["kv"]["utilisation"] after any run must never
@@ -1581,10 +1753,10 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                           "mean_live_requests": 0.0,
                           "admit_wave_of": {}},
                 "prefix": {"enabled": share_prefix, "hit_blocks": 0,
-                           "hit_frac": 0.0, "tokens_saved": 0,
-                           "lookups": 0},
+                           "prompt_blocks": 0, "hit_frac": 0.0,
+                           "tokens_saved": 0, "lookups": 0},
             }
-            return []
+            return {} if admission is not None else []
         if eos_check_every < 1:
             raise ValueError(
                 f"eos_check_every must be >= 1, got {eos_check_every}")
@@ -1685,8 +1857,9 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                         rules)
         rstate = _Run(slots, rules, kv_blocks, 0, n_new_of, prompts)
         tokens = jnp.zeros((slots,), jnp.int32)
-        sched = _Sched(prompts, n_new_of, policy, aging, priorities,
-                       arrivals, time.monotonic())
+        sched = (admission if admission is not None
+                 else _Sched(prompts, n_new_of, policy, aging,
+                             priorities, arrivals, time.monotonic()))
         lens_of = [int(jnp.asarray(p).shape[-1]) for p in prompts]
         active: dict[int, int] = {}              # slot → request index
         firsts: dict[int, Any] = {}              # req → prefill token
@@ -1709,13 +1882,13 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         #                                          must read as YOUNGER
         mask_key: list = [None, None]    # active-set key → device mask
         hist: list = []          # one [slots] token vector per step wave
-        t0 = sched.t0
 
         def retire(req, ntok, steps):
             done_at[req] = ntok
             rstate.retire_wave[req] = len(hist)
             rstate.retire_blocks(req)
             _note_retire(meta, latencies, req, ntok, steps)
+            sched.retired(req, ntok)
 
         def activate(slot, req, first, entries):
             """First-token bookkeeping shared by both admission paths."""
@@ -1776,7 +1949,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         # eager (one host int per admission) at W=1, caught by the
         # periodic scan/assembly truncation at W>1.
         eos_pending = 0                  # waves since the last eos scan
-        while len(sched) or active or filling or stalled:
+        while not sched.exhausted() or active or filling or stalled:
             if lazy_growth and stalled:
                 # resume stalled slots BEFORE admission: freed blocks
                 # must reach the oldest stalled request first, or a
@@ -1805,15 +1978,29 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                                                and not stalled)
             for slot in range(slots):
                 if not admit_ok or slot in active or slot in filling \
-                        or slot in stalled or not len(sched):
+                        or slot in stalled:
                     continue
                 req = sched.candidate()
                 if req is None:
-                    break                        # nothing arrived yet
+                    break               # empty, or nothing arrived yet
                 prompt = jnp.asarray(prompts[req])
                 key = key_for(req, 0) if sampler is not None else None
-                wait_s = _queue_wait(arrivals, t0, req)
-                if prefill_chunk is None:
+                wait_s = sched.wait_s(req)
+                payload = sched.kv_import(req)
+                if payload is not None:
+                    # prefill→decode handoff: another engine prefilled
+                    # this request's KV; allocate blocks, import the
+                    # rows, start decoding at the payload's position —
+                    # zero prefill compute here (models/fleet.py's
+                    # disaggregated mode)
+                    got = _admit_imported(rstate, slot, req, prompt,
+                                          payload, meta, wait_s)
+                    if got is None:
+                        break                    # blocks exhausted: hold
+                    first, entries = got
+                    sched.pop(req)
+                    activate(slot, req, first, entries)
+                elif prefill_chunk is None:
                     got = _admit_one(rstate, slot, req, prompt, key,
                                      meta, wait_s)
                     if got is None:
@@ -1901,11 +2088,13 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                     meta.pop(req, None)
                     granted.pop(slot, None)
                     continue
-                if not filling and len(sched) and arrivals is not None \
+                if not filling and not sched.exhausted() \
                         and sched.candidate() is None:
                     # nothing admissible until the blocking request
-                    # arrives (fifo: the head; else: the earliest)
-                    _sleep_until_arrival(arrivals, sched, t0)
+                    # arrives (fifo: the head; else: the earliest) —
+                    # or, under an injected source, until the router
+                    # adds/steals work or closes the stream
+                    sched.idle_wait()
                 continue
             # one compiled step advances every slot (idle slots compute
             # too — the static-shape bubble; their writes are fenced to
@@ -1977,35 +2166,37 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         _gauges(rstate, 0, 0)
 
         waves = jnp.stack(hist) if hist else None      # [W, slots]
-        outs = []
-        for req in range(len(prompts)):
+        # with an injected admission source only the requests IT
+        # yielded were served — assemble those, return a dict keyed by
+        # request index (the router merges replicas' dicts)
+        served = sorted(done_at)
+        outs: dict[int, Any] = {}
+        for req in served:
             n, (slot, sw) = done_at[req], span[req]
             if n == 1:
-                outs.append(firsts[req][None])
+                outs[req] = firsts[req][None]
             elif req in frag:
                 # a growth stall fragmented this request's tenancy: its
                 # emissions are the recorded active waves, not a
                 # contiguous slice
                 idx = jnp.asarray(frag[req][:n - 1], jnp.int32)
-                outs.append(jnp.concatenate(
-                    [firsts[req][None], waves[idx, slot]]))
+                outs[req] = jnp.concatenate(
+                    [firsts[req][None], waves[idx, slot]])
             else:
                 # the n-1 step waves while req held its slot are exactly
                 # hist[sw : sw+n-1] — one emission per active wave
-                outs.append(jnp.concatenate(
-                    [firsts[req][None], waves[sw:sw + n - 1, slot]]))
+                outs[req] = jnp.concatenate(
+                    [firsts[req][None], waves[sw:sw + n - 1, slot]])
         if eos_id is not None and eos_check_every > 1:
             # lagged scheduling can retire by count cap before a scan
             # saw an eos (and never sees first-token eos at all) —
             # truncation at the first eos restores the exact W=1
             # semantics; it runs on host ints, zero extra flushes
-            cut = []
-            for o in outs:
+            for req, o in outs.items():
                 toks = [int(t) for t in jax.device_get(o)]
                 n = next((i + 1 for i, t in enumerate(toks)
                           if t == eos_id), len(toks))
-                cut.append(o[:n])
-            outs = cut
+                outs[req] = o[:n]
         # generated counts EMITTED tokens (post-truncation output
         # lengths): under lagged eos checks a count-cap retirement can
         # precede the scan that would have seen an earlier eos, and
@@ -2014,11 +2205,157 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         # SCHEDULED token count in that case — the same bounded bubble
         # the eos_check_every docs describe.
         run.last_stats = _stats(
-            len(prompts), sum(int(o.shape[0]) for o in outs), len(hist),
-            latencies, rstate)
-        return outs
+            len(served), sum(int(o.shape[0]) for o in outs.values()),
+            len(hist), latencies, rstate)
+        if admission is not None:
+            return outs
+        return [outs[i] for i in range(len(prompts))]
+
+    class _PrefillSession:
+        """PREFILL-WORKER state for the disaggregated fleet
+        (``models/fleet.py``): a slots=1 paged pool that prefills one
+        prompt per call and exports the finished blocks as a handoff
+        payload (``paging.export_block_rows``) for a decode engine's
+        ``kv_import`` admission — the Podracer role split with the
+        paged block as the transfer unit. Prefix sharing (an engine
+        built with ``share_prefix=True``) works ACROSS calls: the
+        session's index retains popular template blocks up to
+        ``prefix_keep_blocks``, so a repeated template prefills once
+        per worker and later requests only pay the export copy."""
+
+        def __init__(self, kv_blocks: int | None):
+            from .paging import init_paged_cache
+
+            if kv_blocks is None:
+                kv_blocks = 1 + nt + (prefix_keep_blocks
+                                      if share_prefix else 0)
+            if kv_blocks < 1 + nt:
+                raise ValueError(
+                    f"prefill session needs >= {1 + nt} blocks (one "
+                    f"full table + the garbage block), got {kv_blocks}")
+            self.alloc = BlockAllocator(kv_blocks)
+            self.index = (PrefixIndex(self.alloc, prefix_keep_blocks)
+                          if share_prefix else None)
+            self.pool = init_paged_cache(
+                cfg, 1, max_len, block_size=bs, num_blocks=kv_blocks,
+                cache_dtype=cache_dtype)
+            self.stats = {"requests": 0, "hit_blocks": 0,
+                          "prompt_blocks": 0, "tokens_saved": 0}
+
+        def _alloc_reclaiming(self, n: int) -> list[int]:
+            blocks = self.alloc.alloc(n)
+            while blocks is None and self.index is not None:
+                if not self.index.reclaim(n - self.alloc.free_blocks):
+                    break
+                blocks = self.alloc.alloc(n)
+            if blocks is None:
+                # sized for a full table at construction, so only a
+                # caller-shrunk pool can get here — loud, not a hold
+                # (there is no queue to hold in; the router owns one)
+                raise ValueError(
+                    f"prefill session pool exhausted allocating {n} "
+                    f"blocks — raise its kv_blocks")
+            return blocks
+
+        def prefill(self, prompt):
+            """Prefill ``prompt`` (``[L]`` tokens) and return the
+            handoff payload ``{"first": token, "n_tokens": L,
+            "blocks": export_block_rows(...)}``: whole ``kv_block``
+            blocks covering rows ``0..L-1`` (tail rows inside the last
+            block ride along unreachable behind the importer's pos),
+            plus the greedily-picked first token. Exactly the math a
+            colocated admission runs — same prefill impl selection,
+            same unshared-suffix start — so a decode engine importing
+            the payload continues bit-identically."""
+            from .decode import _select_prefill_impl
+            from .paging import export_block_rows
+
+            prompt = jnp.asarray(prompt)
+            length = int(prompt.shape[-1])
+            if length < 1:
+                raise ValueError("prompts must have at least one token")
+            if length >= max_len:
+                raise ValueError(
+                    f"prompt ({length}) must leave room for at least "
+                    f"one generated token under max_len ({max_len})")
+            shared: list[int] = []
+            cov = 0
+            chunks: list = []
+            if self.index is not None:
+                toks = [int(t) for t in np.asarray(prompt)]
+                chunks = chain_chunks(toks, bs)
+                # one prompt token must remain to forward — its logits
+                # pick the first generated token
+                while chunks and chunk_tokens_covered(
+                        len(chunks), bs) > length - 1:
+                    chunks.pop()
+                shared = self.index.match(chunks)
+                cov = chunk_tokens_covered(len(shared), bs)
+            k = len(shared)
+            own = self._alloc_reclaiming(
+                blocks_for_rows(length - k * bs, bs))
+            row = np.zeros((nt,), np.int32)
+            row[:k] = shared
+            row[k:k + len(own)] = own
+            impl = ("cached" if cov
+                    else _select_prefill_impl(cfg, length, "auto"))
+            suffix = prompt[cov:] if cov else prompt
+            t0c = _clk()
+            first, self.pool = _admit_full(
+                prefill_params, suffix[None, :], impl, jnp.int32(0),
+                jnp.asarray(row), jnp.zeros((2,), jnp.uint32),
+                jnp.zeros((2,), jnp.int32), jnp.int32(cov), self.pool)
+            if self.index is not None:
+                self.index.register(
+                    chunks, [int(row[j]) for j in range(len(chunks))])
+                self.stats["hit_blocks"] += k
+                self.stats["prompt_blocks"] += len(chunks)
+                self.stats["tokens_saved"] += cov
+            self.stats["requests"] += 1
+            if reg.enabled:
+                reg.emit_span("serve_prefill", t0c, reg.clock(),
+                              prompt_len=length, handoff=True)
+            nb = blocks_for_rows(length, bs)
+            payload = {
+                "first": first, "n_tokens": length,
+                "blocks": export_block_rows(
+                    self.pool, [int(row[j]) for j in range(nb)]),
+            }
+            # this request's references drop; registered template
+            # blocks stay resident through the index's own refs (LRU
+            # capped) for the next same-template prefill
+            self.alloc.free(shared + own)
+            if self.index is not None:
+                self.index.trim()
+            return payload
+
+        def close(self) -> None:
+            if self.index is not None:
+                self.index.release()
+
+    def prefill_session(*, kv_blocks: int | None = None):
+        """Open a prefill-worker session (see :class:`_PrefillSession`).
+        Greedy engines without a template ``prefix``/``prefill_chunk``/
+        ``spec_k`` only: the handoff payload carries one greedily
+        picked first token and rows starting at position 0."""
+        if sampler is not None:
+            raise ValueError("prefill sessions are greedy-only — the "
+                             "payload's first token has no rng lane")
+        if spec_k is not None:
+            raise ValueError("prefill sessions prefill and hand off — "
+                             "spec_k belongs to the decode engine")
+        if prefix is not None:
+            raise ValueError("prefill sessions need prefix=None: the "
+                             "payload's rows must start at position 0")
+        if prefill_chunk is not None:
+            raise ValueError(
+                "prefill sessions use the one-dispatch prefill — "
+                "prefill_chunk's interleaving needs the wave loop; "
+                "build the prefill-worker engine without it")
+        return _PrefillSession(kv_blocks)
 
     run.last_stats = None
+    run.prefill_session = prefill_session
     return run
 
 

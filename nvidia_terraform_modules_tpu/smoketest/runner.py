@@ -462,6 +462,66 @@ def _run_smoketest(
                     checks["paged_decode_error"] = str(exc)
                 ok &= checks["paged_decode_ok"]
 
+            # fleet router gate: the multi-engine router
+            # (models/fleet.py) is contractually SCHEDULING — affinity
+            # placement, per-replica queues and the thread-per-replica
+            # execution must not change a single token — so a 2-replica
+            # affinity fleet on a shared-prefix wave must BIT-match the
+            # single-engine baseline, on this slice's real lowering,
+            # with the router demonstrably routing (every request
+            # placed by affinity) and both pools drained. Mirrors
+            # serve_sched_ok: gate the fleet layer on chip before a
+            # serving job trusts it. Tiny, process-local (replica
+            # threads, no collectives — every host validates
+            # independently at any world size).
+            if checks.get("paged_decode_ok"):
+                try:
+                    from ..models.fleet import make_fleet
+                    from ..models.serving import make_serve_engine
+                    from ..utils.traffic import shared_prefix_prompts
+
+                    fcfg = BurnInConfig(
+                        vocab=128, d_model=32, n_heads=4, d_ff=64,
+                        n_layers=2, seq_len=16, batch=2,
+                        dtype=jax.numpy.float32)
+                    fparams = init_params(jax.random.PRNGKey(13), fcfg)
+                    fpairs = shared_prefix_prompts(
+                        6, seed=2, n_templates=2, template_len=8,
+                        suffix_lo=1, suffix_hi=4, vocab=fcfg.vocab)
+                    fprompts = [jax.numpy.asarray(p, jax.numpy.int32)
+                                for _t, p in fpairs]
+                    fbudgets = [3, 5, 2, 4, 3, 2]
+                    fml = max(int(p.shape[-1]) + n
+                              for p, n in zip(fprompts, fbudgets))
+                    base = make_serve_engine(fparams, fcfg, max_len=fml,
+                                             kv_block=4,
+                                             share_prefix=True)
+                    b_outs = base(fprompts, fbudgets, slots=2)
+                    fleet = make_fleet(fparams, fcfg, max_len=fml,
+                                       replicas=2, kv_block=4,
+                                       share_prefix=True, steal=False)
+                    f_outs = fleet(fprompts, fbudgets, slots=2)
+                    match = all(
+                        o is not None
+                        and bool(jax.device_get(
+                            jax.numpy.array_equal(o, b)))
+                        for o, b in zip(f_outs, b_outs))
+                    fst = fleet.last_stats["fleet"]
+                    drained = all(
+                        rs["kv"]["in_use"] == 0
+                        for rs in fleet.last_stats["replica_stats"])
+                    checks["serve_fleet_ok"] = (
+                        match and fst["shed"] == 0
+                        and fst["affinity_routed_frac"] == 1.0
+                        and drained)
+                    checks["serve_fleet_hit_blocks"] = \
+                        fst["affinity_hit_blocks"]
+                    checks["serve_fleet_replicas"] = fst["replicas"]
+                except Exception as exc:  # JSON contract > the type
+                    checks["serve_fleet_ok"] = False
+                    checks["serve_fleet_error"] = str(exc)
+                ok &= checks["serve_fleet_ok"]
+
             # flash pipeline gate: the software-pipelined kernels
             # (ops/flash_attention.py, pipeline="on") are contractually a
             # SCHEDULING change — same sub-tile folds, same arithmetic —

@@ -56,6 +56,15 @@ Instrumented layers (all emit here when enabled):
 ``models/serving`` / ``speculative``  per-request ``serve_prefill`` /
                                       ``serve_request`` spans, generated-
                                       and accepted-draft-token counters
+``models/fleet``                      one ``fleet_route`` span per request
+                                      (args: chosen replica, affinity,
+                                      shed) on the SAME registry the
+                                      engines emit into — router→engine
+                                      stitches on one timeline;
+                                      ``fleet_queue_depth`` /
+                                      ``fleet_affinity_hit_frac`` gauges,
+                                      ``fleet_shed_total`` /
+                                      ``fleet_steal_total`` counters
 ``parallel/collectives``              ``hierarchical_psum`` ICI-vs-DCN
                                       phase spans (probe side) +
                                       ``jax.named_scope`` phase names in

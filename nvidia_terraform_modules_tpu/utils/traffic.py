@@ -34,7 +34,10 @@ per-request prompt/output-length draws — ragged lengths are the whole
 reason the paged KV cache exists, so the workload generator owns them —
 and :func:`shared_prefix_prompts` for Zipf-popularity template
 workloads, the shared-leading-span shape the serving engine's
-cross-request prefix sharing exists for.
+cross-request prefix sharing exists for. :func:`slo_deadlines` closes
+the loop on the demand side: per-request latency deadlines
+(work-proportional, seeded slack) that the fleet router's SLO-aware
+admission sheds against and bills attainment with.
 """
 
 from __future__ import annotations
@@ -234,6 +237,41 @@ def shared_prefix_prompts(n: int, seed: int = 0, *,
                   for _ in range(r.randint(suffix_lo, suffix_hi))]
         out.append((tid, templates[tid] + suffix))
     return out
+
+
+def slo_deadlines(budgets: Sequence[int], seed: int = 0, *,
+                  base_s: float = 0.05, per_token_s: float = 0.01,
+                  jitter: float = 0.25) -> list[float]:
+    """Per-request SLO deadlines (seconds from each request's ARRIVAL)
+    for a trace whose generation budgets are ``budgets``: deadline_i =
+    ``(base_s + per_token_s * budgets[i]) * u_i`` with ``u_i`` drawn
+    uniformly from ``[1 - jitter, 1 + jitter]`` — work-proportional
+    (a 200-token answer is allowed longer than a 5-token one, the shape
+    real latency SLOs have) with seeded per-request slack so identical
+    budgets still exercise distinct deadlines.
+
+    The fleet router's admission control (``models/fleet.py``) sheds a
+    request when its predicted queue wait would blow this bound, and
+    ``last_stats["fleet"]["deadline_attainment"]`` bills the realised
+    outcome against the same numbers — so the deadline generator lives
+    here with the arrival/length generators: stdlib-only, STRING-seeded
+    (cross-process deterministic whatever PYTHONHASHSEED says), one
+    ``(budgets, seed, params)`` tuple → one byte-identical deadline
+    vector for bench, tests and the tfsim fleet twin alike.
+    """
+    if base_s <= 0 or per_token_s < 0:
+        raise ValueError(
+            f"need base_s > 0 and per_token_s >= 0, got "
+            f"base_s={base_s} per_token_s={per_token_s}")
+    if not 0.0 <= jitter < 1.0:
+        raise ValueError(f"jitter must be in [0, 1), got {jitter}")
+    for b in budgets:
+        if b < 1:
+            raise ValueError(f"budgets must be >= 1, got {b}")
+    r = _rng(seed, salt="slo")
+    return [(base_s + per_token_s * int(b))
+            * (1.0 + jitter * (2.0 * r.random() - 1.0))
+            for b in budgets]
 
 
 def trace_summary(times: Sequence[float]) -> dict[str, float]:

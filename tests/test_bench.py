@@ -170,6 +170,65 @@ def test_section_serve_engine_schema_and_seeded_workload():
     assert {k: tr[k] for k in want} == want
 
 
+def test_section_serve_fleet_schema_and_affinity_gate():
+    """Tier-1 gate on the fleet section (PR 12): runs green on CPU
+    with the full schema, outputs bit-match solo decode, affinity
+    routing STRICTLY beats random placement on prefix hit fraction
+    (the ISSUE 12 acceptance bar), and the SLO admission sheds a
+    deterministic strict subset of the seeded trace."""
+    bench = _bench_mod()
+    out = bench.section_serve_fleet()
+    for key in ("serve_fleet_replicas", "serve_fleet_requests",
+                "serve_fleet_trace",
+                "serve_fleet_affinity_hit_frac",
+                "serve_fleet_random_hit_frac",
+                "serve_fleet_affinity_vs_random",
+                "serve_fleet_affinity_routed_frac",
+                "serve_fleet_prefill_tokens_saved",
+                "serve_fleet_bitmatch",
+                "serve_fleet_goodput", "serve_fleet_shed_frac",
+                "serve_fleet_attainment", "serve_fleet_est_token_s",
+                "serve_fleet_p50_under_spike",
+                "serve_fleet_p99_under_spike",
+                "serve_fleet_spike_stolen"):
+        assert key in out, key
+    assert out["serve_fleet_bitmatch"] is True
+    # affinity routing must STRICTLY raise the hit fraction over
+    # random placement on the Zipf template trace
+    assert out["serve_fleet_affinity_hit_frac"] \
+        > out["serve_fleet_random_hit_frac"], out
+    assert out["serve_fleet_affinity_vs_random"] > 1.0
+    assert out["serve_fleet_affinity_hit_frac"] > 0
+    assert out["serve_fleet_prefill_tokens_saved"] > 0
+    # the shed fraction is a strict subset: the SLO admission dropped
+    # something (the trace is sized to overload the virtual clock) but
+    # never everything
+    assert 0 < out["serve_fleet_shed_frac"] < 1, out
+    assert out["serve_fleet_goodput"] > 0
+    assert out["serve_fleet_p99_under_spike"] \
+        >= out["serve_fleet_p50_under_spike"] > 0
+
+
+@pytest.mark.slow
+def test_section_serve_fleet_deterministic_across_runs():
+    """The seed-determined fleet fields replay exactly: placement,
+    hit fractions, the shed set and the trace provenance — only the
+    clocks (goodput, spike latency, steal counts) may differ."""
+    bench = _bench_mod()
+    a = bench.section_serve_fleet()
+    b = bench.section_serve_fleet()
+    for key in ("serve_fleet_replicas", "serve_fleet_requests",
+                "serve_fleet_trace",
+                "serve_fleet_affinity_hit_frac",
+                "serve_fleet_random_hit_frac",
+                "serve_fleet_affinity_vs_random",
+                "serve_fleet_affinity_routed_frac",
+                "serve_fleet_prefill_tokens_saved",
+                "serve_fleet_bitmatch", "serve_fleet_shed_frac",
+                "serve_fleet_est_token_s"):
+        assert a[key] == b[key], key
+
+
 @pytest.mark.slow
 def test_section_serve_engine_deterministic_across_runs():
     """Two runs of the section agree on every seed-determined field
@@ -295,8 +354,21 @@ def test_full_capture_emits_single_json_line_rc0():
                 "serve_engine_p99_ms",
                 "serve_engine_kv_utilisation",
                 "serve_prefix_hit_frac", "serve_prefill_tokens_saved",
-                "serve_lazy_admit_gain", "serve_sjf_vs_fifo_p50"):
+                "serve_lazy_admit_gain", "serve_sjf_vs_fifo_p50",
+                "serve_fleet_goodput", "serve_fleet_shed_frac",
+                "serve_fleet_affinity_vs_random",
+                "serve_fleet_p99_under_spike"):
         assert key in payload, key
+    # the fleet's affinity win and shed set are deterministic
+    # host-side accounting — the capture must carry the acceptance
+    # bar (affinity strictly beats random) and its meaningful-on-CPU
+    # notes
+    assert payload["serve_fleet_affinity_vs_random"] > 1.0
+    assert payload["serve_fleet_bitmatch"] is True
+    assert "serve_fleet_affinity_vs_random" in payload.get(
+        "cpu_fallback_expectations", {})
+    assert "serve_fleet_shed_frac" in payload.get(
+        "cpu_fallback_expectations", {})
     # the scheduler speedup is meaningful on CPU (wave counts, not
     # hardware) — the capture must say so next to the number, and the
     # acceptance bar (continuous beats run-to-completion at >= 2

@@ -23,8 +23,11 @@ from nvidia_terraform_modules_tpu.models.paging import (
     blocks_for_rows,
     chain_chunks,
     chunk_tokens_covered,
+    export_block_rows,
+    import_block_rows,
     init_paged_cache,
     paged_pool_spec,
+    pool_transfer_keys,
 )
 
 CFG = dict(vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2,
@@ -274,6 +277,83 @@ def test_init_paged_cache_layout():
     with pytest.raises(ValueError, match="cache_dtype"):
         init_paged_cache(cfg, 2, 16, block_size=8, num_blocks=5,
                          cache_dtype="fp8")
+
+
+# ------------------------------------------- cross-pool block transfer
+
+
+def _fill_pool(pool, seed=0):
+    """Seeded non-zero content in every transferable buffer."""
+    out = dict(pool)
+    for j, key in enumerate(pool_transfer_keys(pool)):
+        out[key] = [
+            jax.random.normal(jax.random.PRNGKey(seed + 17 * j + li),
+                              buf.shape).astype(buf.dtype)
+            if buf.dtype != jnp.int8 else
+            jax.random.randint(jax.random.PRNGKey(seed + 17 * j + li),
+                               buf.shape, -128, 128).astype(jnp.int8)
+            for li, buf in enumerate(pool[key])
+        ]
+    return out
+
+
+def test_export_import_block_rows_roundtrip_between_pools():
+    """The prefill→decode transfer unit: blocks exported from one pool
+    land byte-identical in ANOTHER pool at DIFFERENT physical ids, and
+    untouched destination blocks keep their bytes."""
+    cfg = BurnInConfig(**CFG)
+    src = _fill_pool(init_paged_cache(cfg, 2, 24, block_size=4,
+                                      num_blocks=9), seed=1)
+    dst = _fill_pool(init_paged_cache(cfg, 2, 24, block_size=4,
+                                      num_blocks=9), seed=2)
+    before = {k: [jnp.array(b) for b in dst[k]]
+              for k in pool_transfer_keys(dst)}
+    payload = export_block_rows(src, [3, 5, 1])
+    dst2 = import_block_rows(dst, [7, 2, 8], payload)
+    for key in pool_transfer_keys(src):
+        for li in range(cfg.n_layers):
+            for s_b, d_b in zip((3, 5, 1), (7, 2, 8)):
+                assert jnp.array_equal(src[key][li][s_b],
+                                       dst2[key][li][d_b]), (key, li)
+            # a block the import never named keeps its bytes
+            assert jnp.array_equal(dst2[key][li][4], before[key][li][4])
+    # tables/pos are the receiver's own bookkeeping — untouched
+    assert jnp.array_equal(dst2["block_tables"], dst["block_tables"])
+    assert jnp.array_equal(dst2["pos"], dst["pos"])
+
+
+def test_export_import_block_rows_int8_sidecars_ride_along():
+    cfg = BurnInConfig(**CFG)
+    src = _fill_pool(init_paged_cache(cfg, 1, 16, block_size=4,
+                                      num_blocks=6, cache_dtype="int8"),
+                     seed=3)
+    dst = init_paged_cache(cfg, 1, 16, block_size=4, num_blocks=6,
+                           cache_dtype="int8")
+    payload = export_block_rows(src, [2, 4])
+    assert sorted(payload) == ["k", "k_scale", "v", "v_scale"]
+    dst2 = import_block_rows(dst, [1, 3], payload)
+    for key in ("k", "v", "k_scale", "v_scale"):
+        for li in range(cfg.n_layers):
+            assert jnp.array_equal(src[key][li][2], dst2[key][li][1])
+            assert jnp.array_equal(src[key][li][4], dst2[key][li][3])
+
+
+def test_import_block_rows_validation_is_loud():
+    """Garbage-block imports, key mismatches (bf16 payload into an
+    int8 pool) and block-count mismatches must refuse, not scribble."""
+    cfg = BurnInConfig(**CFG)
+    bf = init_paged_cache(cfg, 1, 16, block_size=4, num_blocks=6)
+    q = init_paged_cache(cfg, 1, 16, block_size=4, num_blocks=6,
+                         cache_dtype="int8")
+    payload = export_block_rows(bf, [2, 3])
+    with pytest.raises(ValueError, match="reserved block"):
+        import_block_rows(bf, [0, 1], payload)
+    with pytest.raises(ValueError, match="transferable keys"):
+        import_block_rows(q, [1, 2], payload)
+    with pytest.raises(ValueError, match="block ids"):
+        import_block_rows(bf, [1, 2, 3], payload)
+    with pytest.raises(ValueError, match=">= 1 block id"):
+        export_block_rows(bf, [])
 
 
 # ------------------------------------------------- paged forward parity

@@ -1286,3 +1286,175 @@ def test_serve_engine_paged_kernel_bitmatches_gather_engine():
         assert jnp.array_equal(g, w), f"request {i} diverged"
     with pytest.raises(ValueError, match="paged_kernel"):
         make_serve_engine(params, cfg, max_len=16, paged_kernel="hbm")
+
+
+# --------------------------------- injectable admission (PR 12 seam)
+
+
+def test_external_admission_source_bit_matches_and_returns_dict():
+    """The fleet seam: run(admission=source) serves exactly the
+    requests the source yields, in the source's order, returns a dict
+    keyed by request index, and every token still equals solo greedy —
+    order and timing are the source's, the math is the engine's."""
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+    from nvidia_terraform_modules_tpu.models.serving import (
+        AdmissionSource,
+    )
+
+    class Reversed(AdmissionSource):
+        def __init__(self, reqs):
+            self.pending = list(reqs)
+
+        def candidate(self):
+            return self.pending[-1] if self.pending else None
+
+        def pop(self, req):
+            self.pending.remove(req)
+
+        def requeue(self, req):
+            self.pending.append(req)
+
+        def waiting(self):
+            return len(self.pending)
+
+        def exhausted(self):
+            return not self.pending
+
+    cfg, params, prompts = _setup()
+    engine = make_serve_engine(params, cfg, max_len=16, kv_block=4)
+    # serve only a subset, in reversed order
+    got = engine(prompts, 6, slots=2, admission=Reversed([0, 2, 4]))
+    assert sorted(got) == [0, 2, 4]
+    want = _reference(params, prompts, 6, cfg)
+    for req, toks in got.items():
+        assert jnp.array_equal(toks, want[req]), f"request {req}"
+    st = engine.last_stats
+    assert st["requests"] == 3
+    assert st["kv"]["in_use"] == 0                  # pool drained
+
+
+def test_external_admission_rejects_overlapping_knobs():
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+    from nvidia_terraform_modules_tpu.models.serving import (
+        AdmissionSource,
+    )
+
+    cfg, params, prompts = _setup(n_prompts=2)
+    src = AdmissionSource()
+    engine = make_serve_engine(params, cfg, max_len=16)
+    with pytest.raises(ValueError, match="arrival"):
+        engine(prompts, 4, admission=src, arrivals=[0.0, 0.0])
+    with pytest.raises(ValueError, match="static_batching"):
+        engine(prompts, 4, admission=src, static_batching=True)
+    with pytest.raises(ValueError, match="priorities"):
+        engine(prompts, 4, admission=src, priorities=[1.0, 2.0])
+    spec = make_serve_engine(params, cfg, max_len=24, spec_k=2)
+    with pytest.raises(ValueError, match="spec_k"):
+        spec(prompts, 4, admission=src)
+
+
+def test_prefill_session_handoff_import_bit_matches_colocated():
+    """The disaggregation seam end to end at the serving layer: engine
+    A prefills and exports (prefill_session), engine B imports via a
+    kv_import admission source and decodes — tokens bit-match the
+    colocated engine AND solo greedy, and B's pool drains."""
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+    from nvidia_terraform_modules_tpu.models.serving import (
+        AdmissionSource,
+    )
+
+    class Handoff(AdmissionSource):
+        def __init__(self, payloads):
+            self.payloads = payloads              # req → payload
+            self.pending = sorted(payloads)
+
+        def candidate(self):
+            return self.pending[0] if self.pending else None
+
+        def pop(self, req):
+            self.pending.remove(req)
+
+        def requeue(self, req):
+            self.pending.insert(0, req)
+
+        def waiting(self):
+            return len(self.pending)
+
+        def exhausted(self):
+            return not self.pending
+
+        def kv_import(self, req):
+            return self.payloads[req]
+
+    cfg, params, prompts = _setup()
+    pre = make_serve_engine(params, cfg, max_len=16, kv_block=4)
+    session = pre.prefill_session()
+    payloads = {i: session.prefill(p) for i, p in enumerate(prompts)}
+    session.close()
+    dec = make_serve_engine(params, cfg, max_len=16, kv_block=4)
+    got = dec(prompts, 6, slots=2, admission=Handoff(payloads))
+    colo = make_serve_engine(params, cfg, max_len=16, kv_block=4)
+    want_colo = colo(prompts, 6, slots=2)
+    want_solo = _reference(params, prompts, 6, cfg)
+    for req in range(len(prompts)):
+        assert jnp.array_equal(got[req], want_colo[req]), req
+        assert jnp.array_equal(got[req], want_solo[req]), req
+    assert dec.last_stats["kv"]["in_use"] == 0
+
+
+def test_prefill_session_shares_templates_across_calls():
+    """A share_prefix prefill worker pays a popular template's prefill
+    once: the second same-template call matches the retained blocks
+    and prefills only the suffix — and the handoff payload still
+    decodes bit-identically to solo."""
+    from nvidia_terraform_modules_tpu.models import (
+        greedy_decode,
+        make_serve_engine,
+    )
+
+    cfg, params, _ = _setup()
+    tmpl = jax.random.randint(jax.random.PRNGKey(33), (8,), 0,
+                              cfg.vocab)
+    prompts = [jnp.concatenate(
+        [tmpl, jax.random.randint(jax.random.PRNGKey(50 + i),
+                                  (1 + i,), 0, cfg.vocab)])
+        for i in range(3)]
+    eng = make_serve_engine(params, cfg, max_len=16, kv_block=4,
+                            share_prefix=True)
+    session = eng.prefill_session()
+    payloads = [session.prefill(p) for p in prompts]
+    assert session.stats["hit_blocks"] > 0          # template reused
+    assert session.stats["tokens_saved"] > 0
+    session.close()
+    assert session.alloc.in_use == 0                # fully released
+    for i, p in enumerate(prompts):
+        want = greedy_decode(params, p[None, :], 1, cfg)[0]
+        assert jnp.array_equal(
+            jnp.asarray(payloads[i]["first"])[None], want), i
+
+
+def test_prefill_session_validation():
+    from nvidia_terraform_modules_tpu.models import (
+        make_sampler,
+        make_serve_engine,
+    )
+
+    cfg, params, prompts = _setup(n_prompts=1)
+    sampled = make_serve_engine(params, cfg, max_len=16,
+                                sampler=make_sampler(top_k=2))
+    with pytest.raises(ValueError, match="greedy-only"):
+        sampled.prefill_session()
+    spec = make_serve_engine(params, cfg, max_len=24, spec_k=2)
+    with pytest.raises(ValueError, match="spec_k"):
+        spec.prefill_session()
+    chunked = make_serve_engine(params, cfg, max_len=16,
+                                prefill_chunk=4)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        chunked.prefill_session()
+    plain = make_serve_engine(params, cfg, max_len=16)
+    session = plain.prefill_session()
+    with pytest.raises(ValueError, match="at least one token"):
+        session.prefill(jnp.zeros((0,), jnp.int32))
+    with pytest.raises(ValueError, match="max_len"):
+        session.prefill(jnp.zeros((16,), jnp.int32))
+    session.close()

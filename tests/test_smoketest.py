@@ -60,6 +60,12 @@ def test_burnin_level(jax8):
     # bit-matches the gather engine's tokens on one shared-prefix
     # wave, on this backend's real lowering (read-path-only contract)
     assert r.checks["paged_decode_ok"]
+    # the fleet-router gate: a 2-replica affinity fleet bit-matches
+    # the single-engine baseline on a shared-prefix wave — placement,
+    # per-replica queues and replica threads are scheduling, never a
+    # different model (models/fleet.py's contract)
+    assert r.checks["serve_fleet_ok"]
+    assert r.checks["serve_fleet_replicas"] == 2
 
 
 @pytest.mark.slow

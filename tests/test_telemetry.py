@@ -702,3 +702,99 @@ def test_instrument_step_flash_kernel_probe(tmp_path, jax8):
     with pytest.raises(ValueError, match="kernel_probe"):
         instrument_step(make_train_step(dcfg), dcfg, reg2,
                         kernel_probe=True)
+
+
+def test_fleet_route_spans_gauges_and_engine_stitch(jax8, tmp_path):
+    """PR 12's fleet telemetry: one ``fleet_route`` span per request
+    whose args carry the chosen replica, the queue-depth/affinity
+    gauges and shed/steal counters land in the Prometheus exposition,
+    and — because the router shares its registry with every engine —
+    router spans and the engines' ``serve_request`` spans stitch onto
+    ONE Chrome-trace timeline."""
+    import jax
+
+    from nvidia_terraform_modules_tpu.models import (
+        BurnInConfig,
+        init_params,
+        make_fleet,
+    )
+
+    cfg = BurnInConfig(vocab=64, d_model=32, n_heads=2, d_ff=64,
+                       n_layers=1, seq_len=16, batch=2)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    reg = Registry(str(tmp_path))
+    fleet = make_fleet(params, cfg, max_len=12, replicas=2, kv_block=4,
+                       telemetry=reg, steal=False)
+    prompts = [jax.random.randint(jax.random.PRNGKey(i), (4,), 0, 64)
+               for i in range(4)]
+    outs = fleet(prompts, 4, slots=2)
+    assert all(o is not None for o in outs)
+
+    routes = [e for e in reg.events
+              if e["kind"] == "span" and e["name"] == "fleet_route"]
+    assert len(routes) == 4
+    for s in routes:
+        assert s["args"]["replica"] in ("replica-0", "replica-1")
+        assert s["args"]["shed"] is False
+        assert "affinity" in s["args"]
+    # the routed replica matches where the engine actually served it
+    routed = fleet.last_stats["fleet"]["routed_to"]
+    assert {s["args"]["request"]: s["args"]["replica"]
+            for s in routes} == routed
+
+    # engine spans share the registry: the stitch the timeline needs
+    serve_spans = [e for e in reg.events
+                   if e["kind"] == "span"
+                   and e["name"] == "serve_request"]
+    assert len(serve_spans) == 4
+    prom = reg.prometheus_text()
+    for line in ("# TYPE fleet_queue_depth gauge",
+                 "# TYPE fleet_affinity_hit_frac gauge"):
+        assert line in prom, line
+    assert reg.gauge("fleet_queue_depth").value == 0     # drained
+    xs = chrome_trace(reg.events)["traceEvents"]
+    names = {e["name"] for e in xs if e["ph"] == "X"}
+    assert {"fleet_route", "serve_prefill", "serve_request"} <= names
+
+
+def test_fleet_shed_and_steal_counters_export(jax8, tmp_path):
+    """The shed counter bills the SLO admission's drops; the steal
+    counter bills cross-replica moves — both through the standard
+    counter exposition, with shed routes marked in span args."""
+    import jax
+
+    from nvidia_terraform_modules_tpu.models import (
+        BurnInConfig,
+        init_params,
+        make_fleet,
+    )
+    from nvidia_terraform_modules_tpu.utils.traffic import (
+        poisson_trace,
+        slo_deadlines,
+    )
+
+    cfg = BurnInConfig(vocab=64, d_model=32, n_heads=2, d_ff=64,
+                       n_layers=1, seq_len=16, batch=2)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    reg = Registry(str(tmp_path))
+    fleet = make_fleet(params, cfg, max_len=12, replicas=1, kv_block=4,
+                       telemetry=reg, est_token_s=0.02)
+    prompts = [jax.random.randint(jax.random.PRNGKey(i), (4,), 0, 64)
+               for i in range(6)]
+    budgets = [6] * 6
+    arrivals = poisson_trace(500.0, 6, seed=4)
+    deadlines = slo_deadlines(budgets, seed=5, base_s=0.08,
+                              per_token_s=0.01, jitter=0.2)
+    fleet(prompts, budgets, slots=2, arrivals=arrivals,
+          deadlines=deadlines)
+    st = fleet.last_stats["fleet"]
+    assert st["shed"] > 0
+    assert reg.counter("fleet_shed_total").value == st["shed"]
+    shed_spans = [e for e in reg.events
+                  if e["kind"] == "span" and e["name"] == "fleet_route"
+                  and e["args"]["shed"]]
+    assert len(shed_spans) == st["shed"]
+    assert all(s["args"]["replica"] is None for s in shed_spans)
+    prom = reg.prometheus_text()
+    assert "# TYPE fleet_shed_total counter" in prom
+    assert f"fleet_shed_total {st['shed']}" in prom

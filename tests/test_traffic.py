@@ -22,6 +22,7 @@ from nvidia_terraform_modules_tpu.utils.traffic import (
     poisson_trace,
     ragged_lengths,
     shared_prefix_prompts,
+    slo_deadlines,
     spike_trace,
     trace_summary,
 )
@@ -156,6 +157,54 @@ def test_shared_prefix_prompts_survive_hash_randomisation():
     assert repr(shared_prefix_prompts(
         6, seed=3, n_templates=2, template_len=4, suffix_lo=1,
         suffix_hi=3, vocab=16)) in outs[0]
+
+
+def test_slo_deadlines_work_proportional_and_deterministic():
+    """The PR 12 deadline generator: seeded, work-proportional (bigger
+    budget → later deadline at zero jitter), jitter bounded, and the
+    one-seed-one-vector property every generator here keeps."""
+    budgets = [4, 4, 32, 8, 64]
+    a = slo_deadlines(budgets, seed=7, base_s=0.1, per_token_s=0.01,
+                      jitter=0.2)
+    assert a == slo_deadlines(budgets, seed=7, base_s=0.1,
+                              per_token_s=0.01, jitter=0.2)
+    assert a != slo_deadlines(budgets, seed=8, base_s=0.1,
+                              per_token_s=0.01, jitter=0.2)
+    # every deadline inside its jitter band around base + per_token*b
+    for d, b in zip(a, budgets):
+        centre = 0.1 + 0.01 * b
+        assert 0.8 * centre - 1e-12 <= d <= 1.2 * centre + 1e-12
+    # zero jitter: exactly work-proportional, identical budgets equal
+    z = slo_deadlines(budgets, seed=7, base_s=0.1, per_token_s=0.01,
+                      jitter=0.0)
+    assert z[0] == z[1] and z[4] > z[2] > z[3] > z[0]
+    with pytest.raises(ValueError, match="base_s"):
+        slo_deadlines([1], base_s=0.0)
+    with pytest.raises(ValueError, match="jitter"):
+        slo_deadlines([1], jitter=1.0)
+    with pytest.raises(ValueError, match="budgets"):
+        slo_deadlines([0])
+
+
+def test_slo_deadlines_survive_hash_randomisation():
+    """Cross-process determinism under a different PYTHONHASHSEED —
+    the property every traffic generator pins, extended to the PR 12
+    deadline vector (the fleet's shed decisions replay from it)."""
+    code = ("from nvidia_terraform_modules_tpu.utils.traffic import "
+            "slo_deadlines\n"
+            "print(repr(slo_deadlines([2, 9, 5], seed=11,"
+            " base_s=0.05, per_token_s=0.02, jitter=0.3)))\n")
+    outs = []
+    for hashseed in ("0", "777"):
+        p = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            text=True,
+            env={"PYTHONHASHSEED": hashseed, "PATH": "/usr/bin:/bin"},
+            check=True)
+        outs.append(p.stdout)
+    assert outs[0] == outs[1]
+    assert repr(slo_deadlines([2, 9, 5], seed=11, base_s=0.05,
+                              per_token_s=0.02, jitter=0.3)) in outs[0]
 
 
 def test_make_trace_rejects_unknown_kind_and_bad_rate():
