@@ -1,7 +1,9 @@
 # SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
 # SPDX-License-Identifier: Apache-2.0
 """Fleet router: prefix-affinity multi-engine serving with SLO-aware
-shedding and disaggregated prefill/decode.
+shedding, disaggregated prefill/decode — and a chaos-hardened fault
+plane (replica fault injection, deterministic redrive, degraded-mode
+routing).
 
 One ``make_serve_engine`` is one chip's worth of traffic; the north
 star is millions of users, which means a FLEET layer above the engine
@@ -56,35 +58,97 @@ seam (never through private state):
   affinity applies to the PREFILL side (that is where the prefix index
   lives); handoffs go to the least-loaded decode queue.
 
+- **The fault plane** (``faults=``, defaults OFF — a fleet built
+  without a profile reproduces the fault-free router byte for byte).
+  The training stack earned its resilience story in PRs 5–6
+  (classified exits, kill-and-resume chaos gate, elastic worlds);
+  this is the serving twin, because the spot/preemptible slice pools
+  the module provisions vanish mid-flight as a matter of routine:
+
+  * **Seeded injection** — :class:`FleetFaultProfile` (string-seeded,
+    mirroring ``tfsim/faults`` and ``smoketest/chaos.py``) schedules
+    replica kills, prefill-worker kills, slow-replica stalls, planned
+    drains and handoff corruption on the fleet's deterministic
+    arrival clock: identical ``(seed, profile)`` ⇒ identical failure
+    schedule. A kill is delivered AT A POLL BOUNDARY — the admission
+    source raises :class:`ReplicaKilled` out of the replica's own
+    wave loop (the ``AdmissionSource`` fault seam), the same
+    step-boundary determinism discipline as the chaos harness's
+    self-delivered signals.
+  * **Deterministic redrive** — a replica health monitor in the
+    router loop (the classified-liveness shape of
+    ``resilience.HeartbeatMonitor``: armed poll-stamps, staleness
+    vs a timeout, dead-vs-slow told apart) declares the replica
+    down, removes it from the :class:`HashRing` (consistent hashing
+    bounds the keyspace that moves — pinned in ``tests/test_fleet``)
+    and REDRIVES its queued and in-flight requests to survivors by
+    re-admission from the original prompt. That recovery is CORRECT,
+    not best-effort: greedy and (request, position)-keyed sampled
+    tokens are schedule-invariant (PR 10's contract), so a redriven
+    request's output bit-matches the undisturbed run; requests
+    already completed on survivors are deduped by request key and
+    never re-run. Lost prefix-index blocks simply re-warm through
+    normal admission (a hit-fraction dip, billed, never wrongness).
+    Disaggregated handoffs carry a crc; a corrupt import is a
+    CLASSIFIED, retryable failure (``utils/retry``) that re-runs the
+    prefill — never silent garbage entering a decode pool.
+  * **Degraded mode** — SLO shedding and the affinity queue bound
+    recompute against SURVIVING capacity: the routing plan folds the
+    profile's capacity schedule into its virtual clock (a killed
+    target takes no arrivals after its death; its unfinished virtual
+    work re-places on survivors and re-checks deadlines), so the
+    shed set stays a pure function of (trace, capacity schedule). A
+    flapping replica trips a circuit breaker — quarantined as a
+    steal/redrive target for ``quarantine_polls`` after it resumes
+    polling. A planned ``drain_replica`` stops admission through the
+    engine's ``draining()`` hook, moves the still-queued requests to
+    survivors, and lets in-flight work finish — removal without
+    recomputation; a drained prefill worker hands off its resident
+    prefilled blocks before exit.
+
+  The chaos gate (``tests/test_fleet_chaos.py``) pins it: under a
+  seeded one-replica kill every unshed request completes with
+  solo-greedy-bit-exact tokens, nothing is lost or duplicated, and
+  the shed set replays exactly.
+
 Exactness contract (the house gate, pinned in ``tests/test_fleet.py``):
 the router is SCHEDULING, never a different model. A 1-replica fleet
 bit-matches the bare engine per request; N-replica greedy outputs
-bit-match solo decode whatever the placement, steals or preemptions;
-disaggregated bit-matches colocated. Telemetry: one ``fleet_route``
-span per request (args carry the chosen replica) on the SAME registry
-the engines emit their ``serve_prefill``/``serve_request`` spans into,
-so router and engine stitch on one Chrome-trace timeline;
-``fleet_queue_depth``/``fleet_affinity_hit_frac`` gauges and
-``fleet_shed_total``/``fleet_steal_total`` counters ride alongside.
+bit-match solo decode whatever the placement, steals, preemptions,
+kills or drains; disaggregated bit-matches colocated. Telemetry: one
+``fleet_route`` span per request (args carry the chosen replica) on the
+SAME registry the engines emit their ``serve_prefill``/``serve_request``
+spans into, so router and engine stitch on one Chrome-trace timeline;
+``fleet_queue_depth``/``fleet_affinity_hit_frac`` gauges,
+``fleet_shed_total``/``fleet_steal_total`` counters, and the fault
+plane's ``fleet_replica_down``/``fleet_redrive_total``/
+``fleet_circuit_open_total`` counters plus a ``fleet_degraded`` span
+covering every interval the fleet ran below nominal capacity.
 
 Reference analogue: none — the reference provisions the node pools a
 fleet like this runs on (SURVEY §2.6); this is the router those
-``serve``-named slice pools front.
+``serve``-named slice pools front, and the fault plane is the runtime
+twin of the pool-side spot posture lint rules
+(``tpu-spot-serving-no-headroom`` et al.).
 """
 
 from __future__ import annotations
 
 import bisect
+import dataclasses
 import hashlib
 import random
 import threading
 import time
+import zlib
 from typing import Any, Sequence
 
 import numpy as np
 
+from ..utils.retry import RetryPolicy, retry_call
 from .burnin import BurnInConfig
-from .paging import PrefixIndex, chain_chunks
+from .resilience import LivenessBreaker
+from .paging import PrefixIndex, chain_chunks, transfer_crc
 from .serving import AdmissionSource, make_serve_engine
 
 _ROUTINGS = ("affinity", "random")
@@ -117,21 +181,246 @@ class HashRing:
     first point clockwise. Adding/removing a replica moves only
     ~1/N of the keyspace — the property that keeps template→replica
     placement (and therefore each replica's warm prefix index) stable
-    across fleet resizes."""
+    across fleet resizes AND across replica deaths: :meth:`remove`
+    (a dead/drained replica leaving) moves ONLY the removed target's
+    keyspace onto survivors, and :meth:`add`-ing it back restores the
+    original assignment exactly (removal symmetry, pinned in
+    ``tests/test_fleet.py``)."""
 
     def __init__(self, n_targets: int, vnodes: int = 16):
         if n_targets < 1:
             raise ValueError(f"need >= 1 target, got {n_targets}")
+        self.vnodes = vnodes
+        self._members: set[int] = set(range(n_targets))
+        self._rebuild()
+
+    def _rebuild(self) -> None:
         pts = sorted(
             (_blake_int(f"fleet-target-{t}-vnode-{v}".encode()), t)
-            for t in range(n_targets) for v in range(vnodes))
+            for t in self._members for v in range(self.vnodes))
         self._points = [p for p, _ in pts]
         self._targets = [t for _, t in pts]
+
+    def add(self, target: int) -> None:
+        """(Re-)join ``target``: only the keyspace its own vnode points
+        cover moves back to it — every other assignment is untouched."""
+        if target in self._members:
+            raise ValueError(f"target {target} already on the ring")
+        self._members.add(target)
+        self._rebuild()
+
+    def remove(self, target: int) -> None:
+        """Take ``target`` off the ring (death or planned drain): its
+        keyspace redistributes onto the survivors' existing points and
+        nothing else moves."""
+        if target not in self._members:
+            raise ValueError(f"target {target} is not on the ring")
+        if len(self._members) == 1:
+            raise ValueError("cannot remove the last ring target")
+        self._members.remove(target)
+        self._rebuild()
+
+    def targets(self) -> set[int]:
+        return set(self._members)
 
     def target(self, key: bytes) -> int:
         i = bisect.bisect_right(self._points, _blake_int(key)) \
             % len(self._points)
         return self._targets[i]
+
+
+# ------------------------------------------------------------ fault plane
+
+
+class ReplicaKilled(RuntimeError):
+    """Fault-injected replica death: raised out of the replica's own
+    admission-source poll (the ``AdmissionSource`` fault seam), so the
+    replica's wave loop dies mid-run exactly like the process would —
+    partially decoded outputs lost and all. The router's monitor
+    classifies the death and redrives; nothing above the fleet ever
+    sees this exception."""
+
+    def __init__(self, label: str, at_s: float):
+        super().__init__(
+            f"{label} killed by fault injection at t={at_s:.3f}s")
+        self.label = label
+        self.at_s = at_s
+
+
+class HandoffCorruptError(RuntimeError):
+    """A disaggregated prefill→decode payload failed its crc — the
+    classified, RETRYABLE transfer failure (``utils/retry``): the
+    handoff re-runs from prefill rather than importing garbage."""
+
+
+_FAULT_KINDS = (
+    "kill_replica",      # kill a decode replica mid-wave (poll boundary)
+    "kill_prefill",      # kill a prefill worker (disaggregated only)
+    "slow_replica",      # stall a decode replica's waves (trips the breaker)
+    "drain_replica",     # planned removal of a decode replica (no recompute)
+    "drain_prefill",     # planned removal of a prefill worker
+    "corrupt_handoff",   # corrupt a prefill worker's nth handoff payload
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetFault:
+    """One scheduled fault. ``target`` is the role-relative replica
+    index (decode index for ``*_replica``/``slow_replica``, prefill
+    index for ``*_prefill``/``corrupt_handoff``); ``None`` draws it
+    from the profile's seeded RNG at resolve time. ``at_s`` is the
+    trigger on the fleet's deterministic clock (seconds since the call
+    started — the same clock the arrival trace gates on). Kills and
+    drains land at the replica's next poll boundary past ``at_s``;
+    ``slow_replica`` stalls ``waves`` waves by ``stall_s`` each from
+    ``at_s``; ``corrupt_handoff`` corrupts the worker's ``nth``
+    handoff payload (per-worker handoffs are serial, so the nth is
+    deterministic)."""
+
+    kind: str
+    target: int | None = None
+    at_s: float = 0.0
+    stall_s: float = 0.0
+    waves: int = 4
+    nth: int = 1
+
+    def __post_init__(self):
+        if self.kind not in _FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}: "
+                f"use {' | '.join(_FAULT_KINDS)}")
+        if self.target is not None and self.target < 0:
+            raise ValueError(f"target must be >= 0, got {self.target}")
+        if self.at_s < 0:
+            raise ValueError(f"at_s must be >= 0, got {self.at_s}")
+        if self.kind == "slow_replica":
+            if self.stall_s <= 0:
+                raise ValueError(
+                    "slow_replica needs stall_s > 0 (the per-wave stall)")
+            if self.waves < 1:
+                raise ValueError(
+                    f"slow_replica needs waves >= 1, got {self.waves}")
+        if self.kind == "corrupt_handoff" and self.nth < 1:
+            raise ValueError(f"nth must be >= 1, got {self.nth}")
+
+
+class FleetFaultProfile:
+    """A seeded fault schedule for the serving fleet — string-seeded
+    and replayable, the ``tfsim/faults`` / ``smoketest/chaos.py``
+    determinism discipline: every unresolved target draws from ONE
+    seeded stream in spec order, so identical ``(seed, faults)``
+    resolve to the identical failure schedule on any fleet shape.
+
+    Pass to ``make_fleet(..., faults=profile)``. ``resolve`` is called
+    once at build time and validates the schedule against the fleet
+    shape (a kill matrix may never take the last replica of a role —
+    the fleet must always keep a redrive target)."""
+
+    def __init__(self, faults: Sequence[FleetFault],
+                 seed: str | int = 0):
+        faults = tuple(faults)
+        for i, f in enumerate(faults):
+            if not isinstance(f, FleetFault):
+                raise ValueError(
+                    f"faults[{i}] must be a FleetFault, got {type(f)}")
+        self.faults = faults
+        self.seed = str(seed)
+
+    def resolve(self, n_dec: int, n_pre: int) -> dict:
+        """Draw seeded targets and validate against the fleet shape.
+        Returns the concrete schedule the router wires into queues:
+        ``kills_dec``/``drains_dec``/``kills_pre``/``drains_pre``
+        (target → at_s), ``slow_dec`` (target → (at_s, stall_s,
+        waves)) and ``corrupt`` (prefill target → nth handoff)."""
+        rnd = random.Random(f"fleet-fault-{self.seed}")
+        out: dict[str, dict] = {
+            "kills_dec": {}, "drains_dec": {},
+            "kills_pre": {}, "drains_pre": {},
+            "slow_dec": {}, "corrupt": {},
+        }
+        for i, f in enumerate(self.faults):
+            pre_side = f.kind in ("kill_prefill", "drain_prefill",
+                                  "corrupt_handoff")
+            pool = n_pre if pre_side else n_dec
+            # one draw per spec whatever the targeting, so the stream —
+            # and every later seeded decision — depends only on the
+            # seed and the spec order (FaultSpec.draw's discipline)
+            drawn = rnd.randrange(max(pool, 1))
+            if pre_side and pool == 0:
+                raise ValueError(
+                    f"faults[{i}] ({f.kind}) needs disaggregate=True "
+                    f"(there are no prefill workers to target)")
+            t = f.target if f.target is not None else drawn
+            if t >= pool:
+                raise ValueError(
+                    f"faults[{i}] ({f.kind}) targets replica {t} but "
+                    f"the role has only {pool}")
+            key = {"kill_replica": "kills_dec",
+                   "drain_replica": "drains_dec",
+                   "kill_prefill": "kills_pre",
+                   "drain_prefill": "drains_pre",
+                   "slow_replica": "slow_dec",
+                   "corrupt_handoff": "corrupt"}[f.kind]
+            if f.kind == "slow_replica":
+                if t in out["slow_dec"]:
+                    raise ValueError(
+                        f"faults[{i}]: duplicate slow_replica on {t}")
+                out["slow_dec"][t] = (f.at_s, f.stall_s, f.waves)
+            elif f.kind == "corrupt_handoff":
+                if t in out["corrupt"]:
+                    raise ValueError(
+                        f"faults[{i}]: duplicate corrupt_handoff on {t}")
+                out["corrupt"][t] = f.nth
+            else:
+                side = "pre" if pre_side else "dec"
+                if t in out[f"kills_{side}"] \
+                        or t in out[f"drains_{side}"]:
+                    raise ValueError(
+                        f"faults[{i}]: replica {t} already scheduled "
+                        f"to die/drain")
+                out[key][t] = f.at_s
+        gone_dec = set(out["kills_dec"]) | set(out["drains_dec"])
+        if gone_dec and len(gone_dec) >= n_dec:
+            raise ValueError(
+                f"the fault schedule removes all {n_dec} decode "
+                f"replica(s) — the fleet must keep >= 1 survivor to "
+                f"redrive onto")
+        gone_pre = set(out["kills_pre"]) | set(out["drains_pre"])
+        if gone_pre and len(gone_pre) >= n_pre:
+            raise ValueError(
+                f"the fault schedule removes all {n_pre} prefill "
+                f"worker(s) — redrives need a surviving prefill side")
+        return out
+
+
+def _payload_crc(payload: dict) -> int:
+    """crc32 over a handoff payload's wire content: the request-level
+    envelope (token count + picked first token) chained onto
+    :func:`..paging.transfer_crc` — the paged transfer layer's own
+    integrity primitive — over the block buffers."""
+    crc = zlib.crc32(str(int(payload["n_tokens"])).encode())
+    crc = zlib.crc32(np.asarray(payload["first"]).tobytes(), crc)
+    return zlib.crc32(
+        transfer_crc(payload["blocks"]).to_bytes(4, "big"), crc)
+
+
+def _corrupt_payload(payload: dict) -> dict:
+    """Flip one element of the first transferred block buffer — the
+    wire corruption the crc check exists to catch. Returns a shallow
+    copy; the clean retry re-exports from the prefill pool."""
+    blocks = {k: list(v) for k, v in payload["blocks"].items()}
+    k0 = sorted(blocks)[0]
+    buf = blocks[k0][0]
+    blocks[k0][0] = buf.at[(0,) * buf.ndim].add(
+        np.ones((), np.asarray(buf).dtype))
+    return dict(payload, blocks=blocks)
+
+
+# the handoff retry shape: corruption is detected instantly (crc), so
+# backoff is nominal — the budget is what matters (a transfer that
+# corrupts every attempt is a real failure and must escalate)
+_HANDOFF_RETRY = RetryPolicy(initial_s=0.001, multiplier=2.0,
+                             cap_s=0.01, max_attempts=3, jitter=False)
 
 
 class _FleetQueue(AdmissionSource):
@@ -141,9 +430,19 @@ class _FleetQueue(AdmissionSource):
     optional per-request kv-import payloads (the disaggregated
     handoff). ``exhausted()`` is closed-AND-empty — an open-but-empty
     queue keeps its engine's wave loop alive (``idle_wait`` polling)
-    so a steal or a late handoff can still land."""
+    so a steal or a late handoff can still land.
 
-    def __init__(self, t0: float, poll_s: float, on_retire):
+    The queue is also the replica's FAULT SEAM: every engine-facing
+    poll stamps ``last_poll`` (the health monitor's liveness signal —
+    the armed-staleness shape of ``resilience.HeartbeatMonitor``), an
+    armed kill raises :class:`ReplicaKilled` at the first poll past
+    its trigger (a deterministic poll-boundary death), a slow fault
+    stalls ``tick()`` (the per-wave hook), and ``set_draining`` stops
+    admission for a planned removal while in-flight work finishes."""
+
+    def __init__(self, t0: float, poll_s: float, on_retire, *,
+                 label: str = "", kill_at: float | None = None,
+                 stall: tuple | None = None):
         self._lock = threading.Lock()
         self._pending: list[int] = []            # arrival-ascending
         self._arrival: dict[int, float] = {}
@@ -154,6 +453,41 @@ class _FleetQueue(AdmissionSource):
         self.poll_s = poll_s
         self._on_retire = on_retire
         self.admitted = 0
+        self.label = label
+        self.dead = False
+        self.killed_at: float | None = None
+        self._kill_at = kill_at
+        self._stall = stall                      # (at_s, stall_s, waves)
+        self._stalled = 0
+        self._draining = False
+        self._popped: set[int] = set()
+        self.last_poll = time.monotonic()
+        # flips once the replica has COMPLETED its first unit of work
+        # (a decode wave / a prefill handoff): until then poll gaps
+        # are jit compiles, not sickness, and the health monitor must
+        # not bill them as circuit-opens
+        self.work_done = False
+
+    def _pulse(self) -> float:
+        """Heartbeat + kill trigger, on every engine-facing poll: the
+        kill lands at a poll/wave boundary — the same step-boundary
+        determinism as the chaos harness's self-delivered signals."""
+        now = time.monotonic()
+        self.last_poll = now
+        rel = now - self.t0
+        if self._kill_at is not None and rel >= self._kill_at:
+            with self._lock:
+                # re-check under the lock: a concurrent disarm() means
+                # the run already ended — once disarm returns, no kill
+                # can fire, so the close-out never loses a late race
+                armed = self._kill_at is not None
+                if armed:
+                    self.dead = True
+                    if self.killed_at is None:
+                        self.killed_at = rel
+            if armed:
+                raise ReplicaKilled(self.label, rel)
+        return rel
 
     def _insort(self, req: int) -> None:
         bisect.insort(self._pending, req,
@@ -170,6 +504,47 @@ class _FleetQueue(AdmissionSource):
     def close(self) -> None:
         with self._lock:
             self._closed = True
+
+    def disarm(self) -> None:
+        """Clear armed faults: the run ended before they could fire
+        (a kill scheduled past the last retirement is a no-op, not a
+        late loss of already-assembled outputs)."""
+        with self._lock:
+            self._kill_at = None
+            self._stall = None
+
+    def set_draining(self) -> None:
+        """Planned removal: stop yielding candidates (and tell the
+        engine through its ``draining()`` hook); in-flight work
+        finishes, the router sweeps the still-pending requests."""
+        with self._lock:
+            self._draining = True
+
+    def drain_pending(self):
+        """Remove and return every pending ``(req, arrival, payload)``
+        except a mid-claim candidate (the engine may be between
+        ``candidate()`` and ``pop()`` — that one finishes here).
+        Repeat on later polls until :meth:`pending_count` is 0."""
+        with self._lock:
+            moved = [(r, self._arrival[r], self._payload.pop(r, None))
+                     for r in self._pending if r != self._claimed]
+            self._pending = [r for r in self._pending
+                             if r == self._claimed]
+            return moved
+
+    def take_lost(self):
+        """Everything a dead replica takes with it: the still-pending
+        ``(req, arrival, payload)`` entries AND the admitted request
+        ids (``popped``) whose outputs died inside the engine's run
+        state. Closes the stream — nothing lands here again."""
+        with self._lock:
+            pend = [(r, self._arrival[r], self._payload.pop(r, None))
+                    for r in self._pending]
+            self._pending.clear()
+            popped = sorted(self._popped)
+            self._popped.clear()
+            self._closed = True
+            return pend, popped
 
     def pending_count(self) -> int:
         with self._lock:
@@ -194,9 +569,10 @@ class _FleetQueue(AdmissionSource):
 
     # ---- engine-facing (AdmissionSource) -------------------------
     def candidate(self):
+        self._pulse()
         now = time.monotonic() - self.t0
         with self._lock:
-            if not self._pending:
+            if self._draining or not self._pending:
                 self._claimed = None
                 return None
             head = self._pending[0]
@@ -212,15 +588,41 @@ class _FleetQueue(AdmissionSource):
             return head
 
     def pop(self, req) -> None:
+        # an admission is proof of life: stamp the heartbeat so the
+        # stale-window the health monitor sees during the following
+        # (possibly long) prefill starts at the prefill, not at the
+        # last wave poll — ``health_timeout_s`` still must be sized
+        # above the worst-case single prefill/wave time to keep a
+        # merely-busy replica out of the circuit breaker
+        self.last_poll = time.monotonic()
         with self._lock:
             self._pending.remove(req)
             if self._claimed == req:
                 self._claimed = None
+            self._popped.add(req)
             self.admitted += 1
 
     def requeue(self, req) -> None:
         with self._lock:
             self._insort(req)
+            # back in the queue: a later kill must not count it lost
+            # twice (once as pending, once as admitted)
+            self._popped.discard(req)
+
+    def tick(self) -> None:
+        """Per-wave hook: heartbeat + the slow-replica stall (the
+        fault the circuit breaker exists for — the stall makes the
+        heartbeat stale, which is exactly how a sick replica looks)."""
+        self._pulse()
+        self.work_done = True        # the engine finished a wave
+        st = self._stall
+        if st is not None and self._stalled < st[2] \
+                and time.monotonic() - self.t0 >= st[0]:
+            self._stalled += 1
+            time.sleep(st[1])
+
+    def draining(self) -> bool:
+        return self._draining
 
     def waiting(self) -> int:
         now = time.monotonic() - self.t0
@@ -229,10 +631,12 @@ class _FleetQueue(AdmissionSource):
                        if self._arrival[r] <= now)
 
     def exhausted(self) -> bool:
+        self._pulse()
         with self._lock:
             return self._closed and not self._pending
 
     def idle_wait(self) -> None:
+        self._pulse()
         now = time.monotonic() - self.t0
         with self._lock:
             nxt = (self._arrival[self._pending[0]]
@@ -257,7 +661,9 @@ class _FleetQueue(AdmissionSource):
 
 def _take_next(q: _FleetQueue):
     """Blocking pull for the prefill-worker loop (the decode side's
-    engine loop does its own polling through the interface)."""
+    engine loop does its own polling through the interface). A
+    draining queue stops yielding (candidate returns None) and returns
+    None once the router closes it — the worker's graceful exit."""
     while True:
         req = q.candidate()
         if req is not None:
@@ -275,6 +681,9 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                steal: bool = True, steal_poll_s: float = 0.002,
                est_token_s: float | None = None,
                telemetry=None, route_seed: int = 0,
+               faults: FleetFaultProfile | None = None,
+               health_timeout_s: float = 0.25,
+               quarantine_polls: int = 16,
                **engine_kw):
     """Build the fleet: ``replicas`` serve engines behind the router.
 
@@ -282,12 +691,15 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
     arrivals=None, deadlines=None, kv_blocks=None) → list`` — one
     token array per request in request order, ``None`` where the SLO
     admission shed. After each call ``fleet.last_stats`` carries the
-    engines' per-replica stats (``"replica_stats"``) plus the router's
-    own ``"fleet"`` record: per-replica request counts / occupancy /
-    waves / KV peaks, the affinity hit fraction realised by the
-    replicas' prefix indexes, shed and steal counts, and deadline
-    attainment (fraction of served deadline-carrying requests that
-    finished inside their deadline, wall clock).
+    engines' per-replica stats (``"replica_stats"``; ``None`` for a
+    replica a fault killed mid-run) plus the router's own ``"fleet"``
+    record: per-replica request counts / occupancy / waves / KV peaks,
+    the affinity hit fraction realised by the replicas' prefix
+    indexes, shed and steal counts, deadline attainment (fraction of
+    served deadline-carrying requests that finished inside their
+    deadline, wall clock), and — when a fault profile is armed — the
+    ``"faults"`` record (replicas down, redriven requests, drains,
+    circuit-breaker opens, handoff retries).
 
     ``routing="affinity"`` (default) consistent-hashes each prompt's
     first-block token-hash chain key onto the replica ring (see
@@ -302,7 +714,12 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
     request's completion (service ≈ ``est_token_s`` × its ``n_new``
     budget — calibrate ``est_token_s`` from a measured run; it is
     required when deadlines are given) and SHEDS requests whose
-    prediction blows the deadline, before any device work.
+    prediction blows the deadline, before any device work. With a
+    fault profile the same clock folds in the CAPACITY SCHEDULE —
+    arrivals after a scheduled kill route around the victim, the
+    victim's unfinished virtual work re-places on survivors and
+    re-checks its deadlines — so the shed set is a pure function of
+    (trace, capacity schedule) and replays exactly.
 
     ``disaggregate=True`` splits the ``replicas`` into
     ``prefill_workers`` prefill-only workers and the rest decode-only
@@ -311,6 +728,18 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
     hand finished prompts' KV blocks to the least-loaded decode
     worker's queue as ``kv_import`` payloads. Greedy only (the handoff
     carries a picked first token).
+
+    ``faults`` arms the FAULT PLANE (defaults off — ``None``
+    reproduces the fault-free fleet byte for byte): a seeded
+    :class:`FleetFaultProfile` of replica kills, prefill kills, slow
+    stalls, planned drains and handoff corruption, resolved against
+    this fleet shape at build time. The router then runs the recovery
+    runtime: health-monitored liveness, ring removal, deterministic
+    redrive of a dead replica's queued AND in-flight requests to
+    survivors (bit-exact — tokens are schedule-invariant), crc-checked
+    handoffs with classified retry, and a circuit breaker that
+    quarantines a flapping replica for ``quarantine_polls`` monitor
+    polls after its poll-stamp goes staler than ``health_timeout_s``.
 
     ``**engine_kw`` passes through to every ``make_serve_engine``
     (``kv_block``, ``share_prefix``, ``cache_dtype``, ``lazy_growth``,
@@ -331,6 +760,15 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                          f"{affinity_queue_bound}")
     if est_token_s is not None and est_token_s <= 0:
         raise ValueError(f"est_token_s must be > 0, got {est_token_s}")
+    if health_timeout_s <= 0:
+        raise ValueError(
+            f"health_timeout_s must be > 0, got {health_timeout_s}")
+    if quarantine_polls < 1:
+        raise ValueError(
+            f"quarantine_polls must be >= 1, got {quarantine_polls}")
+    if faults is not None and not isinstance(faults, FleetFaultProfile):
+        raise ValueError(
+            f"faults must be a FleetFaultProfile, got {type(faults)}")
     if disaggregate:
         if replicas < 2:
             raise ValueError(
@@ -355,6 +793,20 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
     kv_block = engine_kw.get("kv_block", 16)
     n_pre = prefill_workers if disaggregate else 0
     n_dec = replicas - n_pre
+    resolved = faults.resolve(n_dec, n_pre) if faults is not None \
+        else None
+    # the capacity schedule the PLAN's virtual clock degrades against:
+    # kills and drains of the ROUTING-side targets (prefill workers
+    # when disaggregated, decode replicas otherwise), time-ordered
+    if resolved is not None:
+        side = ("pre" if disaggregate else "dec")
+        route_events = sorted(
+            [(ts, t, "kill")
+             for t, ts in resolved[f"kills_{side}"].items()]
+            + [(ts, t, "drain")
+               for t, ts in resolved[f"drains_{side}"].items()])
+    else:
+        route_events = []
     # every engine shares the fleet's registry so router + engine spans
     # stitch on one timeline; engines are separate objects on purpose —
     # separate pools, separate step caches, no cross-thread state
@@ -364,50 +816,112 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
     pre_engines = [make_serve_engine(params, cfg, max_len=max_len,
                                      telemetry=reg, **engine_kw)
                    for _ in range(n_pre)]
-    ring = HashRing(n_pre if disaggregate else n_dec)
     if reg.enabled:
         _g_depth = reg.gauge("fleet_queue_depth")
         _g_hitf = reg.gauge("fleet_affinity_hit_frac")
         _c_shed = reg.counter("fleet_shed_total")
         _c_steal = reg.counter("fleet_steal_total")
+        _c_down = reg.counter("fleet_replica_down")
+        _c_redrive = reg.counter("fleet_redrive_total")
+        _c_circuit = reg.counter("fleet_circuit_open_total")
 
     def _plan(prompts, budgets, arrivals, deadlines):
         """Deterministic routing + shed plan — a pure function of the
-        trace (prompt tokens, arrivals, budgets, deadlines) and the
-        route seed, so shed fractions and placements replay exactly.
-        The virtual clock models each TARGET as a serial server at
-        ``est_token_s`` per budgeted token: coarse on purpose — it is
-        admission control (shed what cannot possibly meet its
-        deadline), not a simulator; work stealing repairs what the
-        model mispredicts."""
+        trace (prompt tokens, arrivals, budgets, deadlines), the route
+        seed AND the fault profile's capacity schedule, so shed
+        fractions and placements replay exactly. The virtual clock
+        models each TARGET as a serial server at ``est_token_s`` per
+        budgeted token: coarse on purpose — it is admission control
+        (shed what cannot possibly meet its deadline), not a
+        simulator; work stealing repairs what the model mispredicts.
+        Under a fault schedule the clock DEGRADES: a killed target
+        takes no arrivals past its death and its unfinished virtual
+        work re-places on the least-loaded survivor at the kill time
+        (service restarts — the partial decode dies with the replica;
+        a drain keeps what it already started and moves only the
+        still-queued), with deadlines re-checked against the
+        surviving capacity."""
         n_targets = n_pre if disaggregate else n_dec
         rnd = random.Random(f"fleet-route-{route_seed}")
+        ring_plan = HashRing(n_targets)
         busy_until = [0.0] * n_targets
         finishes: list[list[float]] = [[] for _ in range(n_targets)]
-        plan = []                        # (req, target, by_affinity)
-        shed = []
+        live_jobs: list[list[list]] = [[] for _ in range(n_targets)]
+        placed: dict[int, tuple[int, bool]] = {}
+        shed: list[int] = []
+        dead_plan: set[int] = set()
+        ev = list(route_events)
+
+        def arr(req):
+            return arrivals[req] if arrivals is not None else 0.0
+
+        def svc(req):
+            return (est_token_s or 0.0) * budgets[req]
+
+        def least_loaded(ready):
+            return min((j for j in range(n_targets)
+                        if j not in dead_plan),
+                       key=lambda j: (max(busy_until[j], ready), j))
+
+        def replace(req, ready):
+            # a fault victim re-places on the least-loaded survivor at
+            # the fault time; the deadline re-check against SURVIVING
+            # capacity is the degraded-mode shed recompute
+            t = least_loaded(ready)
+            start = max(arr(req), ready, busy_until[t])
+            finish = start + svc(req)
+            if deadlines is not None and finish - arr(req) \
+                    > deadlines[req]:
+                placed.pop(req, None)
+                shed.append(req)
+                return
+            busy_until[t] = finish
+            finishes[t].append(finish)
+            live_jobs[t].append([req, start, finish])
+
+        def advance(now):
+            while ev and ev[0][0] <= now:
+                ts, t, kind = ev.pop(0)
+                if t in dead_plan:
+                    continue
+                dead_plan.add(t)
+                ring_plan.remove(t)
+                victims = [j for j in live_jobs[t]
+                           if (j[2] > ts if kind == "kill"
+                               else j[1] > ts)]
+                live_jobs[t] = []
+                for req, _s, _f in sorted(victims,
+                                          key=lambda j: (j[1], j[0])):
+                    replace(req, ts)
+
         for req in range(len(prompts)):
-            a = arrivals[req] if arrivals is not None else 0.0
+            a = arr(req)
+            advance(a)
             if routing == "affinity":
-                t_aff = ring.target(affinity_key(prompts[req], kv_block))
+                t_aff = ring_plan.target(
+                    affinity_key(prompts[req], kv_block))
             else:
                 t_aff = rnd.randrange(n_targets)
+                if t_aff in dead_plan:
+                    t_aff = least_loaded(a)
             t, by_aff = t_aff, routing == "affinity"
             if affinity_queue_bound is not None:
                 backlog = sum(1 for f in finishes[t_aff] if f > a)
                 if backlog >= affinity_queue_bound:
-                    t = min(range(n_targets),
-                            key=lambda j: (max(busy_until[j], a), j))
+                    t = least_loaded(a)
                     by_aff = by_aff and t == t_aff
             start = max(a, busy_until[t])
-            finish = start + (est_token_s or 0.0) * budgets[req]
+            finish = start + svc(req)
             if deadlines is not None and finish - a > deadlines[req]:
                 shed.append(req)
                 continue
             busy_until[t] = finish
             finishes[t].append(finish)
-            plan.append((req, t, by_aff))
-        return plan, shed
+            live_jobs[t].append([req, start, finish])
+            placed[req] = (t, by_aff)
+        advance(float("inf"))
+        plan = [(req, *placed[req]) for req in sorted(placed)]
+        return plan, sorted(shed)
 
     def fleet(prompts: Sequence[Any], n_new, *, slots: int = 4,
               eos_id: int | None = None, rng=None, arrivals=None,
@@ -441,24 +955,47 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                     "a measured run of this config")
 
         plan, shed = _plan(prompts, budgets, arrivals, deadlines)
+        n_planned = len(plan)
+        fault_on = resolved is not None
         t0 = time.monotonic()
         retire_at: dict[int, float] = {}
         retire_tok: dict[int, int] = {}
+        retired_by: dict[int, str] = {}
         r_lock = threading.Lock()
 
-        def on_retire(req, tokens):
-            with r_lock:
-                retire_at[req] = time.monotonic() - t0
-                retire_tok[req] = tokens
+        def arr_of(req):
+            return arrivals[req] if arrivals is not None else 0.0
 
-        dec_queues = [_FleetQueue(t0, steal_poll_s, on_retire)
-                      for _ in range(n_dec)]
-        pre_queues = [_FleetQueue(t0, steal_poll_s, on_retire)
-                      for _ in range(n_pre)]
+        def make_on_retire(label):
+            def on_retire(req, tokens):
+                with r_lock:
+                    retire_at[req] = time.monotonic() - t0
+                    retire_tok[req] = tokens
+                    retired_by[req] = label
+            return on_retire
+
+        def q_for(role, i, label):
+            kill_at = stall = None
+            if fault_on:
+                if role == "dec":
+                    kill_at = resolved["kills_dec"].get(i)
+                    stall = resolved["slow_dec"].get(i)
+                else:
+                    kill_at = resolved["kills_pre"].get(i)
+            return _FleetQueue(t0, steal_poll_s, make_on_retire(label),
+                               label=label, kill_at=kill_at,
+                               stall=stall)
+
+        dec_queues = [q_for("dec", i,
+                            f"decode-{i}" if disaggregate
+                            else f"replica-{i}")
+                      for i in range(n_dec)]
+        pre_queues = [q_for("pre", i, f"prefill-{i}")
+                      for i in range(n_pre)]
         routed_to: dict[int, str] = {}
         by_aff_n = 0
         for req, t, by_aff in plan:
-            a = arrivals[req] if arrivals is not None else 0.0
+            a = arr_of(req)
             label = (f"prefill-{t}" if disaggregate else f"replica-{t}")
             (pre_queues if disaggregate else dec_queues)[t].add(req, a)
             routed_to[req] = label
@@ -475,13 +1012,19 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                               replica=None, affinity=False, shed=True)
         if reg.enabled and shed:
             _c_shed.inc(len(shed))
-        for q in pre_queues:
-            q.close()                    # routing is final for prefill
+        if not fault_on:
+            for q in pre_queues:
+                q.close()                # routing is final for prefill
+        # under a fault schedule the prefill side stays OPEN: a decode
+        # death redrives its admitted requests back through prefill,
+        # and a prefill death redistributes its queue — the router
+        # closes everything once every planned request has retired
 
         sessions: list[Any] = [None] * n_pre
         results: list[Any] = [None] * n_dec
         errors: list[tuple] = []
         stolen = [0]
+        handoff_retries = [0]
 
         def _abort_all():
             for q in pre_queues + dec_queues:
@@ -493,25 +1036,77 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                     prompts, budgets, slots=slots, eos_id=eos_id,
                     rng=rng, kv_blocks=kv_blocks,
                     admission=dec_queues[i])
+            except ReplicaKilled:
+                # the queue's dead flag (set at the raise, before the
+                # stack unwound) is the monitor's signal — nothing else
+                # to do here; the replica is simply gone
+                pass
             except Exception as exc:     # noqa: BLE001 — re-raised below
                 errors.append((f"decode-{i}", exc))
                 _abort_all()
 
+        def _transfer(i, req, corrupt_nth, served):
+            """One prefill→decode handoff. Under the fault plane the
+            payload is crc-stamped at export and re-checked at the
+            import side of the wire; a mismatch is the CLASSIFIED
+            retryable failure (re-run the prefill — idempotent, the
+            worker's prefix index makes the repeat cheap), never a
+            silent import of garbage rows."""
+            served[0] += 1
+            nth = served[0]
+            state = {"attempt": 0}
+
+            def attempt():
+                state["attempt"] += 1
+                payload = sessions[i].prefill(prompts[req])
+                if corrupt_nth != nth:
+                    # in the simulation the injector is the only
+                    # corruption source — a handoff with none
+                    # scheduled skips both crc passes (the hot path)
+                    return payload
+                crc = _payload_crc(payload)
+                wire = payload
+                if state["attempt"] == 1:
+                    wire = _corrupt_payload(payload)
+                if _payload_crc(wire) != crc:
+                    handoff_retries[0] += 1
+                    raise HandoffCorruptError(
+                        f"prefill-{i} handoff for request {req} "
+                        f"failed its crc — retrying from prefill")
+                return wire
+
+            if not fault_on:
+                return attempt()
+            return retry_call(attempt, policy=_HANDOFF_RETRY,
+                              what=f"prefill-{i} handoff",
+                              retryable=(HandoffCorruptError,))
+
         def pre_worker(i):
+            corrupt_nth = (resolved["corrupt"].get(i)
+                           if fault_on else None)
+            served = [0]
             try:
                 sessions[i] = pre_engines[i].prefill_session()
                 while True:
                     req = _take_next(pre_queues[i])
                     if req is None:
                         break
-                    payload = sessions[i].prefill(prompts[req])
+                    payload = _transfer(i, req, corrupt_nth, served)
+                    pre_queues[i].work_done = True
                     # least-loaded decode queue (tie → lowest index):
                     # decode placement is free — the payload carries
-                    # everything, affinity already paid off at prefill
-                    j = min(range(n_dec),
+                    # everything, affinity already paid off at prefill.
+                    # A dead OR draining decode never takes a handoff:
+                    # a draining queue admits nothing, so a payload
+                    # parked there would outlive its close and hang
+                    # the run (the router's done-leak sweep is the
+                    # backstop for the set_draining race)
+                    j = min((d for d in range(n_dec)
+                             if not dec_queues[d].dead
+                             and not dec_queues[d].draining()),
                             key=lambda d: (dec_queues[d].pending_count(),
                                            d))
-                    a = (arrivals[req] if arrivals is not None else 0.0)
+                    a = arr_of(req)
                     dec_queues[j].add(req, a, payload)
                     if reg.enabled:
                         tc = reg.clock()
@@ -520,6 +1115,8 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                                       replica=f"decode-{j}",
                                       affinity=False, shed=False,
                                       handoff=True)
+            except ReplicaKilled:
+                pass                     # see dec_worker
             except Exception as exc:     # noqa: BLE001 — re-raised below
                 errors.append((f"prefill-{i}", exc))
                 _abort_all()
@@ -528,70 +1125,358 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                     sessions[i].close()
 
         pre_threads = [threading.Thread(target=pre_worker, args=(i,),
-                                        daemon=True)
+                                        daemon=True,
+                                        name=f"fleet-pre-{i}")
                        for i in range(n_pre)]
         dec_threads = [threading.Thread(target=dec_worker, args=(i,),
-                                        daemon=True)
+                                        daemon=True,
+                                        name=f"fleet-dec-{i}")
                        for i in range(n_dec)]
         for th in pre_threads + dec_threads:
             th.start()
 
-        # ---- the router's monitor loop (this thread): queue-depth
-        # gauge, work stealing, and closure once no add can ever come
-        while any(th.is_alive() for th in dec_threads):
-            depths = [q.pending_count() for q in dec_queues]
-            if reg.enabled:
-                _g_depth.set(sum(depths)
-                             + sum(q.pending_count()
-                                   for q in pre_queues))
-            adds_done = not any(th.is_alive() for th in pre_threads)
-            if adds_done and sum(depths) == 0:
-                for q in dec_queues:
+        # ---- the fault-plane recovery runtime (all state router-side;
+        # every structure below stays empty on the fault-free path)
+        ring_run = (HashRing(n_pre if disaggregate else n_dec)
+                    if fault_on else None)
+        down_seen: set[tuple[str, int]] = set()
+        redriven: list[int] = []
+        killed_labels: list[str] = []
+        drained_labels: list[str] = []
+        drain_state: dict[tuple[str, int], str] = {}
+        drain_specs = (
+            [("dec", t, ts)
+             for t, ts in resolved["drains_dec"].items()]
+            + [("pre", t, ts)
+               for t, ts in resolved["drains_pre"].items()]
+        ) if fault_on else []
+        breaker = LivenessBreaker(
+            quarantine_polls,
+            on_open=((lambda _key: _c_circuit.inc())
+                     if reg.enabled else None)) if fault_on else None
+        degraded = [False]
+        degraded_clk = [None]
+        closed_out = [False]
+
+        def _mark_degraded():
+            degraded[0] = True
+            if reg.enabled and degraded_clk[0] is None:
+                degraded_clk[0] = reg.clock()
+
+        def _health_ok(role, i):
+            return breaker is None or breaker.healthy((role, i))
+
+        def _avail(role, i):
+            q = (dec_queues if role == "dec" else pre_queues)[i]
+            return not q.dead and drain_state.get((role, i)) \
+                not in ("draining", "done")
+
+        def _pick(role, req):
+            """A redrive target: the affinity ring's pick when it is
+            healthy, else the least-loaded healthy survivor (falling
+            back to any live one — a fully-quarantined fleet still
+            beats a dropped request)."""
+            queues = dec_queues if role == "dec" else pre_queues
+            nn = n_dec if role == "dec" else n_pre
+            cands = [j for j in range(nn) if _avail(role, j)]
+            healthy = [j for j in cands if _health_ok(role, j)] or cands
+            ring_side = ("pre" if disaggregate else "dec")
+            if routing == "affinity" and role == ring_side:
+                t = ring_run.target(affinity_key(prompts[req],
+                                                 kv_block))
+                if t in healthy:
+                    return t
+            return min(healthy,
+                       key=lambda j: (queues[j].pending_count(), j))
+
+        def _redrive(role, lost, why):
+            for req, a, payload in lost:
+                if disaggregate:
+                    if role == "dec" and payload is not None:
+                        # the handoff payload survived (never
+                        # imported): re-place it on a live decode
+                        # queue directly — no recompute at all
+                        j = _pick("dec", req)
+                        dec_queues[j].add(req, a, payload)
+                        lbl = f"decode-{j}"
+                    else:
+                        # re-admission from the original prompt: back
+                        # through a surviving prefill worker (prefix
+                        # index re-warms through normal admission)
+                        j = _pick("pre", req)
+                        pre_queues[j].add(req, a)
+                        lbl = f"prefill-{j}"
+                else:
+                    j = _pick("dec", req)
+                    dec_queues[j].add(req, a)
+                    lbl = f"replica-{j}"
+                routed_to[req] = f"{why}->{lbl}"
+                redriven.append(req)
+                if reg.enabled:
+                    _c_redrive.inc()
+                    tc = reg.clock()
+                    reg.emit_span("fleet_route", tc, tc, request=req,
+                                  replica=lbl, affinity=False,
+                                  shed=False, redrive=True)
+
+        def _ring_remove(role, i):
+            if ring_run is None:
+                return
+            if role == ("pre" if disaggregate else "dec"):
+                if i in ring_run.targets() \
+                        and len(ring_run.targets()) > 1:
+                    ring_run.remove(i)
+
+        def _process_downs():
+            for role, queues, nn in (("dec", dec_queues, n_dec),
+                                     ("pre", pre_queues, n_pre)):
+                for i in range(nn):
+                    q = queues[i]
+                    if not q.dead:
+                        continue
+                    if (role, i) in down_seen:
+                        # the kill-vs-handoff race's backstop (twin of
+                        # the drain "done" sweep): a prefill worker
+                        # that picked this decode queue just before
+                        # the kill lands its add after take_lost —
+                        # sweep the leak to a survivor instead of
+                        # stranding it in a closed dead queue
+                        if q.pending_count():
+                            late, _ = q.take_lost()
+                            _redrive(role, late, "redrive")
+                        continue
+                    down_seen.add((role, i))
+                    killed_labels.append(q.label)
+                    pend, popped = q.take_lost()
+                    if role == "pre":
+                        # a popped prefill request was already handed
+                        # off (the worker holds no own-queue poll
+                        # between pop and handoff) — only the queue
+                        # dies with the worker
+                        popped = []
+                    # retirements that died with the replica: their
+                    # outputs lived in the dead engine's run state and
+                    # were never returned — un-account them so the
+                    # redrive (and the closure condition) see the truth
+                    with r_lock:
+                        for req in [r for r, lab in retired_by.items()
+                                    if lab == q.label]:
+                            retired_by.pop(req)
+                            retire_at.pop(req, None)
+                            retire_tok.pop(req, None)
+                    _ring_remove(role, i)
+                    _mark_degraded()
+                    if reg.enabled:
+                        _c_down.inc()
+                    lost = pend + [(r, arr_of(r), None) for r in popped]
+                    _redrive(role, lost, "redrive")
+
+        def _process_drains(rel_now):
+            if closed_out[0]:
+                return
+            for role, i, at in drain_specs:
+                key = (role, i)
+                q = (dec_queues if role == "dec" else pre_queues)[i]
+                st = drain_state.get(key, "armed")
+                if q.dead:
+                    continue
+                if st == "done":
+                    # the set_draining race's backstop: a handoff that
+                    # picked this queue just before the drain flipped
+                    # lands after the close — sweep it to a survivor
+                    # instead of letting it outlive the closed queue
+                    leak = q.drain_pending()
+                    if leak:
+                        _redrive(role, leak, "drained")
+                    continue
+                if st == "armed":
+                    if rel_now < at:
+                        continue
+                    q.set_draining()
+                    drain_state[key] = "draining"
+                    _ring_remove(role, i)
+                    _mark_degraded()
+                moved = q.drain_pending()
+                if moved:
+                    _redrive(role, moved, "drained")
+                if q.pending_count() == 0:
                     q.close()
-                break
-            if steal and n_dec > 1:
-                receivers = [i for i, d in enumerate(depths) if d == 0]
-                donor = max(range(n_dec), key=lambda i: depths[i])
-                if receivers and depths[donor] >= 2 \
-                        and donor not in receivers:
-                    got = dec_queues[donor].steal_tail()
-                    if got is not None:
-                        req, a, payload = got
-                        dec_queues[receivers[0]].add(req, a, payload)
-                        routed_to[req] = f"stolen->{receivers[0]}"
-                        stolen[0] += 1
-                        if reg.enabled:
-                            _c_steal.inc()
-            time.sleep(steal_poll_s)
-        for th in pre_threads + dec_threads:
-            th.join()
+                    drain_state[key] = "done"
+                    drained_labels.append(q.label)
+
+        def _check_health():
+            """The classified-liveness pass: one
+            ``resilience.LivenessBreaker`` observation per live replica
+            — a queue whose poll-stamp went stale past
+            ``health_timeout_s`` is SUSPECT (the circuit opens, billed
+            through the breaker's ``on_open`` hook) and the replica
+            stops receiving steals/redrives; a fresh stamp starts the
+            quarantine countdown, and only ``quarantine_polls`` clean
+            polls later does it re-enter. Death is classified
+            separately (the thread exits with ReplicaKilled) — slow
+            and dead are never conflated."""
+            now = time.monotonic()
+            for role, queues, threads, nn in (
+                    ("dec", dec_queues, dec_threads, n_dec),
+                    ("pre", pre_queues, pre_threads, n_pre)):
+                for i in range(nn):
+                    q = queues[i]
+                    if q.dead or not threads[i].is_alive() \
+                            or not q.work_done:
+                        # a replica that has not completed its first
+                        # wave/handoff yet is COMPILING, not sick —
+                        # billing the cold start as a circuit-open
+                        # would make every fault-armed call flag its
+                        # healthy replicas once
+                        continue
+                    breaker.observe(
+                        (role, i), now - q.last_poll > health_timeout_s)
+
+        def _all_retired():
+            with r_lock:
+                return len(retire_at) >= n_planned
+
+        def _pending_downs():
+            return fault_on and any(
+                qq.dead and (role, j) not in down_seen
+                for role, qs, nn in (("dec", dec_queues, n_dec),
+                                     ("pre", pre_queues, n_pre))
+                for j, qq in enumerate(qs[:nn]))
+
+        # ---- the router's monitor loop (this thread): queue-depth
+        # gauge, work stealing, fault recovery, and closure once no
+        # add can ever come. An exception anywhere in this loop —
+        # including the steal path — closes every queue and re-raises
+        # AFTER the worker threads are joined: the failure propagates
+        # to the caller instead of silently stranding replicas waiting
+        # on a closure that will never come.
+        try:
+            while True:
+                if fault_on:
+                    _process_downs()
+                    _process_drains(time.monotonic() - t0)
+                    _check_health()
+                depths = [q.pending_count() for q in dec_queues]
+                if reg.enabled:
+                    _g_depth.set(sum(depths)
+                                 + sum(q.pending_count()
+                                       for q in pre_queues))
+                if not fault_on:
+                    adds_done = not any(th.is_alive()
+                                        for th in pre_threads)
+                    if adds_done and sum(depths) == 0:
+                        for q in dec_queues:
+                            q.close()
+                        break
+                elif not closed_out[0] and _all_retired() \
+                        and not _pending_downs():
+                    # end of run: DISARM first (a kill scheduled past
+                    # the last retirement is "the run ended before the
+                    # fault"), then close everything so workers exit.
+                    # A kill that fired DURING the disarm sweep (after
+                    # its queue's last retirement, before its own
+                    # disarm) died holding assembled outputs — skip
+                    # the close this pass so the next _process_downs
+                    # redrives onto still-open survivors, and close on
+                    # a later pass once the downs have settled
+                    for q in pre_queues + dec_queues:
+                        q.disarm()
+                    if not _pending_downs():
+                        for q in pre_queues + dec_queues:
+                            q.close()
+                        closed_out[0] = True
+                if steal and n_dec > 1:
+                    receivers = [i for i, d in enumerate(depths)
+                                 if d == 0 and _avail("dec", i)
+                                 and _health_ok("dec", i)
+                                 and dec_threads[i].is_alive()]
+                    donors = [i for i in range(n_dec)
+                              if _avail("dec", i)]
+                    if receivers and donors:
+                        donor = max(donors, key=lambda i: depths[i])
+                        if depths[donor] >= 2 \
+                                and donor not in receivers:
+                            got = dec_queues[donor].steal_tail()
+                            if got is not None:
+                                req, a, payload = got
+                                dec_queues[receivers[0]].add(
+                                    req, a, payload)
+                                routed_to[req] = \
+                                    f"stolen->{receivers[0]}"
+                                stolen[0] += 1
+                                if reg.enabled:
+                                    _c_steal.inc()
+                if not any(th.is_alive() for th in dec_threads) \
+                        and not _pending_downs():
+                    break
+                time.sleep(steal_poll_s)
+        except BaseException:
+            # the monitor failed: release every replica (closed queues
+            # end their wave loops), join below, and let the error
+            # reach the caller — never a silent strand
+            _abort_all()
+            raise
+        finally:
+            for th in pre_threads + dec_threads:
+                th.join()
+        if fault_on:
+            _process_downs()             # a death racing the exit
         if errors:
             where, exc = errors[0]
             raise RuntimeError(
                 f"fleet worker {where} failed: {exc}") from exc
 
         merged: dict[int, Any] = {}
+        dup: set[int] = set()
         for r in results:
-            merged.update(r or {})
+            for k, v in (r or {}).items():
+                if k in merged:
+                    dup.add(k)
+                else:
+                    merged[k] = v
+        if dup:
+            # a double-served request is a router bug (the redrive
+            # dedupe failed), never something to paper over by merging
+            raise RuntimeError(
+                f"fleet served requests {sorted(dup)} more than once")
         missing = set(range(n)) - set(shed) - set(merged)
         if missing:
             # a lost request is a router bug, never silent truncation
             raise RuntimeError(
                 f"fleet lost requests {sorted(missing)} — served "
                 f"{len(merged)}, shed {len(shed)} of {n}")
+        if fault_on and degraded[0] and reg.enabled \
+                and degraded_clk[0] is not None:
+            # one span covering the whole below-nominal-capacity
+            # interval — the dashboard's "the fleet is degraded" bar
+            reg.emit_span("fleet_degraded", degraded_clk[0],
+                          reg.clock(), nominal=replicas,
+                          replicas_down=len(killed_labels),
+                          drained=len(drained_labels))
 
         # ---- stats -----------------------------------------------
         per_replica = []
         hit_b = prompt_b = saved = 0
         for i, e in enumerate(dec_engines):
             st = e.last_stats
+            label = (f"decode-{i}" if disaggregate else f"replica-{i}")
+            if st is None:
+                # killed mid-run: the engine never assembled stats —
+                # report the death, never a KeyError
+                per_replica.append({
+                    "role": "decode", "replica": label,
+                    "requests": 0, "waves": None, "occupancy": None,
+                    "kv_peak_blocks": None, "preempted": 0,
+                    "dead": True,
+                })
+                continue
             per_replica.append({
-                "role": "decode", "replica": f"decode-{i}"
-                if disaggregate else f"replica-{i}",
+                "role": "decode", "replica": label,
                 "requests": st["requests"], "waves": st["waves"],
                 "occupancy": st["sched"]["mean_live_requests"],
                 "kv_peak_blocks": st["kv"]["high_water"],
                 "preempted": st["sched"]["preempted"],
+                "dead": dec_queues[i].dead,
             })
             hit_b += st["prefix"]["hit_blocks"]
             prompt_b += st["prefix"]["prompt_blocks"]
@@ -603,7 +1488,7 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                 "role": "prefill", "replica": f"prefill-{i}",
                 "requests": s.stats["requests"], "waves": None,
                 "occupancy": None, "kv_peak_blocks": s.alloc.high_water,
-                "preempted": 0,
+                "preempted": 0, "dead": pre_queues[i].dead,
             })
             hit_b += s.stats["hit_blocks"]
             prompt_b += s.stats["prompt_blocks"]
@@ -615,7 +1500,7 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
         lat_ms: list[float] = []         # arrival → completion, per req
         for req in merged:
             tok = retire_tok.get(req, int(merged[req].shape[0]))
-            a = arrivals[req] if arrivals is not None else 0.0
+            a = arr_of(req)
             done = retire_at.get(req)
             if done is not None:
                 lat_ms.append(max(0.0, done - a) * 1e3)
@@ -666,6 +1551,17 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                                        if lat_ms else None)},
                 "per_replica": per_replica,
                 "routed_to": routed_to,
+                "faults": (None if not fault_on else {
+                    "profile_seed": faults.seed,
+                    "replica_down": len(killed_labels),
+                    "killed": sorted(killed_labels),
+                    "redriven": len(redriven),
+                    "redriven_requests": sorted(set(redriven)),
+                    "drained": sorted(drained_labels),
+                    "circuit_open": breaker.opens,
+                    "handoff_retries": handoff_retries[0],
+                    "degraded": degraded[0],
+                }),
             },
             "replica_stats": [e.last_stats for e in dec_engines],
         }

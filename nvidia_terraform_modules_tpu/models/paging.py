@@ -60,6 +60,7 @@ paged serving against solo decode.
 from __future__ import annotations
 
 import hashlib
+import zlib
 from collections import OrderedDict
 from typing import Any, Sequence
 
@@ -413,6 +414,27 @@ def export_block_rows(pool: dict, block_ids: Sequence[int]) -> dict:
         payload[k] = list(outs[i:i + n_layers])
         i += n_layers
     return payload
+
+
+def transfer_crc(payload: dict) -> int:
+    """crc32 over an :func:`export_block_rows` payload's wire content —
+    buffers in key-sorted, layer order, so the checksum is a pure
+    function of the transferred bytes on both sides of the wire.
+
+    This is the paged transfer's integrity primitive: a cross-pool copy
+    is exactly the seam where an ICI/DCN hop slots in on chip, and a
+    hop can corrupt. The fleet's disaggregated prefill→decode handoff
+    stamps every payload with this crc at export and re-checks it at
+    the import side (``models/fleet.py``); a mismatch is a CLASSIFIED,
+    retryable transfer failure (re-run the prefill), never a silent
+    import of garbage rows into a decode pool."""
+    import numpy as np
+
+    crc = 0
+    for k in sorted(payload):
+        for buf in payload[k]:
+            crc = zlib.crc32(np.asarray(buf).tobytes(), crc)
+    return crc
 
 
 def import_block_rows(pool: dict, block_ids: Sequence[int],

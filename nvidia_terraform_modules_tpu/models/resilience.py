@@ -34,6 +34,13 @@ burn-in smoke test and the chaos harness's training worker both run
 through it, so the kill-and-resume invariants the harness asserts are
 properties of the same code path production uses.
 
+**The serving twin.** PR 13 gives the serving fleet the same posture:
+:class:`LivenessBreaker` factors the classified-liveness state machine
+(stale ⇒ circuit opens, fresh ⇒ a bounded quarantine before re-entry —
+slow and dead never conflated) out into a reusable, thread-free form;
+``models/fleet.py`` runs it over replica queue poll-stamps to quarantine
+flapping replicas while dead ones are redriven.
+
 **Elastic worlds.** PR 5's supervision was shape-preserving: a
 classified ``EXIT_PEER_DEAD`` restarted the *same* N-host world, so a
 spot fleet that shrank from N to N-1 hosts simply died N-1 restarts
@@ -463,6 +470,76 @@ class HeartbeatMonitor:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+
+
+class LivenessBreaker:
+    """Classified-liveness circuit breaker: the staleness→quarantine
+    state machine shared by everything that watches poll stamps.
+
+    :class:`HeartbeatMonitor` classifies a peer as DEAD when its stamp
+    goes stale past a timeout; this is the milder classification next
+    to it — a target that is *alive but sick* (stale, then stamping
+    again). Slow and dead must never be conflated: dead means redrive
+    the work somewhere else, sick means stop SENDING new work until the
+    target proves itself. Each key runs ``ok → suspect`` on a stale
+    observation (the circuit OPENS — billed via ``on_open``),
+    ``suspect → quarantine`` on the first fresh one, and only
+    ``quarantine_polls`` consecutive fresh observations later does it
+    re-enter ``ok``; flapping (stale again mid-quarantine) re-opens and
+    restarts the sentence. The serving fleet's health monitor
+    (``models/fleet.py``) runs one of these over its replica queues'
+    poll stamps — a quarantined replica keeps serving what it already
+    has but receives no steals or redrives.
+
+    Pure state machine on purpose: no threads, no clocks, no files —
+    the caller decides what "stale" means (heartbeat age, poll-stamp
+    age, missed acks) and when to observe, so it is testable and
+    reusable as-is.
+    """
+
+    def __init__(self, quarantine_polls: int = 16,
+                 on_open: Optional[Callable[[object], None]] = None):
+        if quarantine_polls < 1:
+            raise ValueError(
+                f"quarantine_polls must be >= 1, got {quarantine_polls}")
+        self.quarantine_polls = quarantine_polls
+        self._on_open = on_open
+        self._state: dict = {}
+        self.opens = 0
+
+    def _open(self, key) -> None:
+        self.opens += 1
+        if self._on_open is not None:
+            self._on_open(key)
+
+    def observe(self, key, stale: bool) -> str:
+        """Feed one liveness observation for ``key``; returns the new
+        state (``"ok"`` | ``"suspect"`` | ``"quarantine"``)."""
+        st = self._state.setdefault(key, ["ok", 0])
+        if st[0] == "ok":
+            if stale:
+                st[0] = "suspect"
+                self._open(key)
+        elif st[0] == "suspect":
+            if not stale:
+                st[0] = "quarantine"
+                st[1] = self.quarantine_polls
+        else:                            # quarantine
+            if stale:                    # flapped again: re-open
+                st[0] = "suspect"
+                self._open(key)
+            else:
+                st[1] -= 1
+                if st[1] <= 0:
+                    st[0] = "ok"
+        return st[0]
+
+    def state(self, key) -> str:
+        return self._state.get(key, ["ok"])[0]
+
+    def healthy(self, key) -> bool:
+        """True when the circuit for ``key`` is closed (``"ok"``)."""
+        return self.state(key) == "ok"
 
 
 # ------------------------------------------------------- supervised loop

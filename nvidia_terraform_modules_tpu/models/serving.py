@@ -181,6 +181,27 @@ class AdmissionSource:
       import re-imports on re-admission).
     - ``retired(req, tokens)`` — completion notification at the wave
       the engine retired ``req`` (SLO attainment clocks stop here).
+    - ``draining()`` → True when the source's owner wants this engine
+      to STOP ADMITTING while finishing everything already in flight
+      (the planned-removal drain hook): the engine skips admission for
+      the wave even if a candidate is mid-claim, keeps stepping its
+      active slots to retirement, and exits once the source closes.
+      Default False — the built-in scheduler never drains.
+
+    FAULT SEAM (the serving chaos plane, ``models/fleet.py``): the
+    engine deliberately does NOT catch exceptions from these hooks — an
+    implementation that raises from ``candidate()``/``tick()`` kills
+    the run mid-wave exactly like the process dying would, with the
+    partially-decoded outputs lost (they are assembled only at the end
+    of ``run``). That raise-at-a-poll-boundary is how the fleet's
+    seeded fault injection simulates a replica death deterministically
+    (the same step-boundary discipline as ``smoketest/chaos.py``'s
+    self-delivered kills); recovery — redriving the dead replica's
+    requests to survivors — is the ROUTER's job, correct because
+    tokens are schedule-invariant. A planned drain is the graceful
+    twin: ``draining()`` flips True (admission stops), the owner
+    removes the still-pending requests, the queue closes, and the
+    engine retires its in-flight work normally — nothing recomputed.
     """
 
     def candidate(self):
@@ -214,6 +235,11 @@ class AdmissionSource:
         """The engine retired ``req`` after emitting ``tokens`` tokens
         — the router's completion signal (SLO attainment clocks stop
         here, steal heuristics see the slot free up). Default: no-op."""
+
+    def draining(self) -> bool:
+        """True = stop admitting, finish in-flight work (the planned
+        drain hook — see the class docstring). Default: never."""
+        return False
 
 
 class _Sched(AdmissionSource):
@@ -1976,6 +2002,11 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             # exactly the variable the comparison isolates
             admit_ok = not static_batching or (not active and not filling
                                                and not stalled)
+            # the drain hook: an injected source whose owner is removing
+            # this replica stops NEW admissions here while the active
+            # slots below keep stepping to retirement (nothing is
+            # cancelled mid-decode — drain never recomputes)
+            admit_ok = admit_ok and not sched.draining()
             for slot in range(slots):
                 if not admit_ok or slot in active or slot in filling \
                         or slot in stalled:

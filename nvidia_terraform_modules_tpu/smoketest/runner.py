@@ -522,6 +522,61 @@ def _run_smoketest(
                     checks["serve_fleet_error"] = str(exc)
                 ok &= checks["serve_fleet_ok"]
 
+            # fleet chaos gate (PR 13): the fault plane's burn-in leg.
+            # A 3-replica fleet with a SEEDED mid-wave replica kill
+            # must still bit-match the single-engine baseline on EVERY
+            # completed request — the health monitor declares the
+            # victim dead, its queued and in-flight requests redrive
+            # to survivors by re-admission (tokens are schedule-
+            # invariant, so recovery is exact, not best-effort), and
+            # the survivors' pools drain to zero. This is the serving
+            # twin of the training chaos gate (smoketest/chaos.py):
+            # gate the recovery runtime on this slice's real lowering
+            # before a preemptible serving pool trusts it. Reuses the
+            # serve_fleet wave + baseline above.
+            if checks.get("serve_fleet_ok"):
+                try:
+                    from ..models.fleet import (
+                        FleetFault,
+                        FleetFaultProfile,
+                        HashRing,
+                        affinity_key,
+                    )
+
+                    # kill the replica the FIRST prompt routes to — a
+                    # target guaranteed to own work on this wave
+                    victim = HashRing(3).target(
+                        affinity_key(fprompts[0], 4))
+                    chaos = make_fleet(
+                        fparams, fcfg, max_len=fml, replicas=3,
+                        kv_block=4, share_prefix=True, steal=False,
+                        faults=FleetFaultProfile(
+                            [FleetFault("kill_replica", target=victim,
+                                        at_s=0.05)],
+                            seed=0))
+                    c_outs = chaos(fprompts, fbudgets, slots=2)
+                    c_match = all(
+                        o is not None
+                        and bool(jax.device_get(
+                            jax.numpy.array_equal(o, b)))
+                        for o, b in zip(c_outs, b_outs))
+                    cst = chaos.last_stats["fleet"]
+                    c_drained = all(
+                        rs["kv"]["in_use"] == 0
+                        for rs in chaos.last_stats["replica_stats"]
+                        if rs is not None)
+                    checks["fleet_chaos_ok"] = (
+                        c_match and cst["served"] == len(fprompts)
+                        and cst["shed"] == 0
+                        and cst["faults"]["replica_down"] == 1
+                        and c_drained)
+                    checks["fleet_chaos_redriven"] = \
+                        cst["faults"]["redriven"]
+                except Exception as exc:  # JSON contract > the type
+                    checks["fleet_chaos_ok"] = False
+                    checks["fleet_chaos_error"] = str(exc)
+                ok &= checks["fleet_chaos_ok"]
+
             # flash pipeline gate: the software-pipelined kernels
             # (ops/flash_attention.py, pipeline="on") are contractually a
             # SCHEDULING change — same sub-tile folds, same arithmetic —

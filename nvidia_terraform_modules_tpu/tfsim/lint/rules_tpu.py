@@ -14,6 +14,7 @@ facts in :mod:`tpu_facts`.
 from __future__ import annotations
 
 import dataclasses
+import re
 
 from .. import ast as A
 from . import tpu_facts as T
@@ -396,7 +397,9 @@ def check_spot_no_grace(ctx: LintContext):
     when the module statically provisions spot/preemptible TPU capacity
     AND a kubernetes workload schedules onto TPU nodes. (For *multislice*
     spot fleets the fleet-level twin is ``tpu-multislice-no-elastic``:
-    grace saves the step, an autoscaler range saves the fleet — and
+    grace saves the step, an autoscaler range saves the fleet — for
+    *serving* pools the twin is ``tpu-spot-serving-no-headroom``: grace
+    saves the step, failover headroom saves the traffic — and
     ``tpu-no-monitoring`` is the observability leg: the same spot churn
     that makes grace mandatory makes its incidents undiagnosable
     without a metrics pipeline.)"""
@@ -437,6 +440,97 @@ def check_spot_no_grace(ctx: LintContext):
                        f"SIGTERM drain plus the emergency checkpoint "
                        f"(TPU_SMOKETEST_GRACE_SECONDS, default 30s) "
                        f"needs the full window")
+
+
+# naming/label tokens that mark a node pool as SERVING-shaped — the
+# fleet router's capacity, where a preempted node means live traffic
+# has to fail over NOW, not a training step to resume later
+_SERVING_TOKENS = ("serve", "serving", "inference", "infer")
+
+
+def _serving_shaped(ctx: LintContext, r) -> str | None:
+    """The evidence a pool is serving-shaped, or None: a serving token
+    in its terraform name, its ``name`` attribute, or a ``node_config``
+    label key/value (``role = "serving"`` and friends)."""
+    hay = [r.name]
+    lit = _literal(ctx, r.body.attr("name"))
+    if isinstance(lit, str):
+        hay.append(lit)
+    for nc in r.body.blocks_of("node_config"):
+        la = nc.body.attr("labels")
+        if la is not None and isinstance(la.expr, A.ObjectExpr):
+            for key, value, _item in _object_items(la.expr):
+                hay.append(key)
+                v = ctx.resolve_literal(value)
+                if isinstance(v, str):
+                    hay.append(v)
+    for h in hay:
+        # whole-token match, not substring: "reserved"/"preserve"
+        # contain "serve" but are not serving-shaped names
+        toks = re.split(r"[^a-z0-9]+", h.lower())
+        if any(t in _SERVING_TOKENS for t in toks):
+            return h
+    return None
+
+
+@rule("tpu-spot-serving-no-headroom", severity="warning", family="tpu",
+      summary="serving-shaped spot TPU pool with max_count == "
+              "min_count — no failover headroom when a replica is "
+              "reclaimed")
+def check_spot_serving_no_headroom(ctx: LintContext):
+    """The SERVING leg of the spot posture tripod
+    (``tpu-spot-no-grace`` saves the training *step*,
+    ``tpu-multislice-no-elastic`` saves the training *fleet* — this
+    rule saves the *traffic*). The serving fault plane
+    (``models/fleet.py``) survives a reclaimed replica by redriving
+    its requests to survivors and re-shedding against the SURVIVING
+    capacity — correctness is kept, but goodput drops to N−1 and stays
+    there until the infrastructure replaces the node. A serving-shaped
+    pool (``serve``/``inference`` in its name or node labels) on spot
+    capacity whose autoscaler range is pinned — ``max_node_count ==
+    min_node_count``, or no ``autoscaling`` block at all — has no
+    failover headroom: every preemption is a permanent capacity loss
+    the runtime can only answer with load shedding
+    (``fleet_shed_total`` rises, the ``fleet_degraded`` span never
+    closes). Give the autoscaler room above the floor so reclaimed
+    serving capacity comes back without a human apply."""
+    for r, flag in _spot_tpu_pools(ctx):
+        shaped = _serving_shaped(ctx, r)
+        if shaped is None:
+            continue
+        where = f"{r.file}:{r.line}"
+        autos = [b for b in _named_blocks(r.body, "autoscaling")
+                 if b is not None]
+        if not autos:
+            yield (where,
+                   f"{r.address}: serving-shaped ({shaped!r}) {flag} "
+                   f"TPU pool with no autoscaling block — the node "
+                   f"count is pinned, so a reclaimed node is a "
+                   f"permanent capacity loss the fleet router can only "
+                   f"shed against (degraded mode, fleet_replica_down/"
+                   f"fleet_shed_total); declare autoscaling with "
+                   f"max_node_count above min_node_count so failover "
+                   f"capacity comes back without a human apply (the "
+                   f"workload-side twin of tpu-spot-no-grace)")
+            continue
+        for b in autos:
+            for lo_k, hi_k in (
+                    ("min_node_count", "max_node_count"),
+                    ("total_min_node_count", "total_max_node_count")):
+                lo = _literal(ctx, b.attr(lo_k))
+                hi = _literal(ctx, b.attr(hi_k))
+                if isinstance(lo, (int, float)) \
+                        and isinstance(hi, (int, float)) and lo == hi:
+                    yield (where,
+                           f"{r.address}: serving-shaped ({shaped!r}) "
+                           f"{flag} TPU pool pins {hi_k} == {lo_k} "
+                           f"({lo:g}) — no failover headroom: a "
+                           f"reclaimed node leaves the serving fleet "
+                           f"at N−1 with nothing to grow back into, "
+                           f"and the runtime's only lever is load "
+                           f"shedding; set {hi_k} above {lo_k} (the "
+                           f"serving twin of tpu-spot-no-grace's "
+                           f"drain-budget posture)")
 
 
 def _slice_containers(ctx: LintContext):

@@ -38,6 +38,10 @@ cross-request prefix sharing exists for. :func:`slo_deadlines` closes
 the loop on the demand side: per-request latency deadlines
 (work-proportional, seeded slack) that the fleet router's SLO-aware
 admission sheds against and bills attainment with.
+:func:`fault_times` is the SUPPLY-side twin: seeded mid-trace instants
+where the serving fault plane (``models/fleet.py``) schedules replica
+kills, so a chaos bench and its undisturbed baseline are labelled by
+the same seeds end to end.
 """
 
 from __future__ import annotations
@@ -272,6 +276,33 @@ def slo_deadlines(budgets: Sequence[int], seed: int = 0, *,
     return [(base_s + per_token_s * int(b))
             * (1.0 + jitter * (2.0 * r.random() - 1.0))
             for b in budgets]
+
+
+def fault_times(times: Sequence[float], n: int = 1, seed: int = 0, *,
+                lo: float = 0.25, hi: float = 0.75) -> list[float]:
+    """``n`` seeded fault instants strictly INSIDE an arrival trace —
+    uniform draws over the ``[lo, hi]`` fraction of the trace's horizon,
+    sorted ascending. The mid-trace kill schedule for the serving fault
+    plane (``models/fleet.py``'s :class:`FleetFaultProfile`): bench's
+    redrive leg, the smoketest's ``fleet_chaos_ok`` burn-in and the
+    chaos-gate matrix all need kills that land while requests are still
+    in flight — not before the first arrival (a trivial re-route) and
+    not after the last retirement (a no-op) — from the SAME one-seed-
+    one-schedule contract as every generator here: stdlib-only,
+    STRING-seeded, byte-identical across processes whatever
+    PYTHONHASHSEED says.
+    """
+    if n < 0:
+        raise ValueError(f"n must be >= 0, got {n}")
+    if not times:
+        raise ValueError("fault_times needs a non-empty arrival trace")
+    if not 0.0 <= lo <= hi <= 1.0:
+        raise ValueError(
+            f"need 0 <= lo <= hi <= 1, got lo={lo} hi={hi}")
+    horizon = max(times)
+    r = _rng(seed, salt="fault")
+    return sorted(horizon * (lo + (hi - lo) * r.random())
+                  for _ in range(n))
 
 
 def trace_summary(times: Sequence[float]) -> dict[str, float]:

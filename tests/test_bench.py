@@ -190,7 +190,15 @@ def test_section_serve_fleet_schema_and_affinity_gate():
                 "serve_fleet_attainment", "serve_fleet_est_token_s",
                 "serve_fleet_p50_under_spike",
                 "serve_fleet_p99_under_spike",
-                "serve_fleet_spike_stolen"):
+                "serve_fleet_spike_stolen",
+                "serve_fleet_kill_at_s", "serve_fleet_redrive_p99",
+                "serve_fleet_undisturbed_p99",
+                "serve_fleet_redrive_p99_vs_undisturbed",
+                "serve_fleet_replica_down", "serve_fleet_redriven",
+                "serve_fleet_degraded_goodput",
+                "serve_fleet_degraded_goodput_minmax",
+                "serve_fleet_degraded_shed_frac",
+                "serve_fleet_degraded_attainment"):
         assert key in out, key
     assert out["serve_fleet_bitmatch"] is True
     # affinity routing must STRICTLY raise the hit fraction over
@@ -207,6 +215,18 @@ def test_section_serve_fleet_schema_and_affinity_gate():
     assert out["serve_fleet_goodput"] > 0
     assert out["serve_fleet_p99_under_spike"] \
         >= out["serve_fleet_p50_under_spike"] > 0
+    # fault-plane legs (PR 13): the seeded kill actually fired, every
+    # unshed request still completed (the fleet raises on loss), and
+    # the kill instant is strictly inside the trace horizon
+    assert out["serve_fleet_replica_down"] == 1
+    assert out["serve_fleet_redrive_p99"] > 0
+    assert out["serve_fleet_undisturbed_p99"] > 0
+    assert out["serve_fleet_redrive_p99_vs_undisturbed"] > 0
+    assert 0 < out["serve_fleet_kill_at_s"]
+    # degraded capacity: the N−1 virtual clock sheds at least as hard
+    # as the nominal one, deterministically, and goodput stays positive
+    assert out["serve_fleet_degraded_goodput"] > 0
+    assert 0 < out["serve_fleet_degraded_shed_frac"] < 1, out
 
 
 @pytest.mark.slow
@@ -225,7 +245,11 @@ def test_section_serve_fleet_deterministic_across_runs():
                 "serve_fleet_affinity_routed_frac",
                 "serve_fleet_prefill_tokens_saved",
                 "serve_fleet_bitmatch", "serve_fleet_shed_frac",
-                "serve_fleet_est_token_s"):
+                "serve_fleet_est_token_s",
+                # the fault plane's seed-determined fields: the kill
+                # instant, that it fired, and the N−1 shed set
+                "serve_fleet_kill_at_s", "serve_fleet_replica_down",
+                "serve_fleet_degraded_shed_frac"):
         assert a[key] == b[key], key
 
 

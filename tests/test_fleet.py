@@ -363,3 +363,44 @@ def test_fleet_consistent_hash_ring_stability():
     assert affinity_key([1, 2, 3, 4, 9], 4) \
         == affinity_key([1, 2, 3, 4, 7, 7], 4)
     assert affinity_key([1, 2], 4) != affinity_key([1, 3], 4)
+
+
+def test_fleet_hash_ring_removal_symmetry():
+    """The PR 13 recovery pin, mirror of the PR 12 grow pin: removing
+    a replica (death or planned drain) moves ONLY the removed target's
+    keyspace — every key it did not own keeps its assignment — and
+    re-adding it restores the original assignment EXACTLY, for every
+    member of a 4-target ring. This is what makes redrive placement
+    (and the warm prefix indexes behind it) stable across a kill."""
+    from nvidia_terraform_modules_tpu.models.fleet import (
+        HashRing,
+        affinity_key,
+    )
+
+    keys = [affinity_key(list(range(i, i + 8)), 4) for i in range(128)]
+    base = HashRing(4)
+    before = {k: base.target(k) for k in keys}
+    for victim in range(4):
+        ring = HashRing(4)
+        ring.remove(victim)
+        assert ring.targets() == {0, 1, 2, 3} - {victim}
+        moved = 0
+        for k in keys:
+            t = ring.target(k)
+            assert t != victim
+            if before[k] == victim:
+                moved += 1              # victim keyspace must move
+            else:
+                # a survivor's key NEVER moves on a removal
+                assert t == before[k], (victim, before[k], t)
+        assert moved == sum(1 for v in before.values() if v == victim)
+        ring.add(victim)
+        assert {k: ring.target(k) for k in keys} == before
+    # guard rails: the last target is irremovable, double ops are loud
+    solo = HashRing(1)
+    with pytest.raises(ValueError, match="last ring target"):
+        solo.remove(0)
+    with pytest.raises(ValueError, match="not on the ring"):
+        HashRing(2).remove(5)
+    with pytest.raises(ValueError, match="already on the ring"):
+        HashRing(2).add(1)

@@ -1,0 +1,441 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""The serving chaos gate: a replica kill loses ZERO unshed requests,
+bit-exactly.
+
+PR 5–6 proved the training stack's resilience with a kill-and-resume
+harness whose invariants are exact (resumed params bit-match the
+uninterrupted run); this is the serving twin (``models/fleet.py``'s
+fault plane). The invariants these tests pin:
+
+- **Bit-exact recovery.** Under a seeded mid-run replica kill, every
+  unshed request completes with tokens equal to its UNDISTURBED solo
+  greedy decode — redrive is re-admission from the original prompt,
+  and tokens are schedule-invariant (PR 10's contract), so recovery is
+  correctness-preserving, not best-effort. The fleet itself enforces
+  no-loss/no-duplication loudly (a missing or double-served request
+  raises), so a green run IS the no-loss certificate.
+- **Defaults-off.** An EMPTY fault profile reproduces the fault-free
+  fleet byte for byte — the fault plane is a seam, never a behaviour
+  change.
+- **Planned drain never recomputes.** A drained replica finishes its
+  in-flight work; only its still-queued requests move.
+- **Slow ≠ dead.** A stalling replica trips the circuit breaker
+  (``resilience.LivenessBreaker``) and is quarantined as a
+  steal/redrive target, while its outputs stay exact; nothing is
+  redriven for mere slowness.
+- **Corrupt handoffs are classified.** A disaggregated prefill→decode
+  payload that fails its crc retries from prefill (``utils/retry``)
+  and the decode still bit-matches — never silent garbage.
+- **Degraded-mode shedding replays.** With deadlines armed, the shed
+  set under a capacity schedule is a pure function of (trace, capacity
+  schedule) — two fleets with the same (seed, profile) shed the same
+  requests.
+
+One seeded kill case is tier-1; the kill matrix (seeds × kill times ×
+colocated/disaggregated) is slow-marked, the chaos-suite convention
+since PR 5.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nvidia_terraform_modules_tpu.models import (
+    BurnInConfig,
+    greedy_decode,
+    init_params,
+    make_fleet,
+)
+from nvidia_terraform_modules_tpu.models.fleet import (
+    FleetFault,
+    FleetFaultProfile,
+    HashRing,
+    affinity_key,
+)
+from nvidia_terraform_modules_tpu.utils.traffic import (
+    fault_times,
+    poisson_trace,
+    slo_deadlines,
+)
+
+CFG = dict(vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2,
+           seq_len=16, batch=2, dtype=jnp.float32)
+
+
+@functools.lru_cache(maxsize=None)
+def _setup(n=8):
+    """One shared template → affinity concentrates every request on ONE
+    replica (the ring target of the template's first-block key), so a
+    kill of that replica is guaranteed to have work to redrive."""
+    cfg = BurnInConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tmpl = jax.random.randint(jax.random.PRNGKey(3), (4,), 0, cfg.vocab)
+    prompts = tuple(jnp.concatenate(
+        [tmpl, jax.random.randint(jax.random.PRNGKey(40 + i),
+                                  (1 + i % 3,), 0, cfg.vocab)])
+        for i in range(n))
+    return cfg, params, prompts
+
+
+def _solo(params, prompts, n_new, cfg):
+    return [greedy_decode(params, p[None, :], n_new, cfg,
+                          max_len=16)[0] for p in prompts]
+
+
+def _assert_all_equal(outs, want, label=""):
+    for i, (g, w) in enumerate(zip(outs, want)):
+        assert g is not None, f"{label} request {i} unserved"
+        assert jnp.array_equal(g, w), f"{label} request {i} diverged"
+
+
+def _victim(prompts, n_targets, kv_block=4):
+    """The replica the shared template routes to — the deterministic
+    kill target that is guaranteed to own the whole queue."""
+    return HashRing(n_targets).target(affinity_key(prompts[0], kv_block))
+
+
+def test_fleet_chaos_one_replica_kill_redrives_bit_exact_tier1():
+    """THE chaos gate (ISSUE 13 acceptance): a 3-replica fleet with a
+    seeded mid-run kill of the loaded replica serves EVERY request with
+    solo-greedy-bit-exact tokens — the dead replica's queued and
+    in-flight requests redrive to survivors, completed-elsewhere work
+    is never re-run (the fleet raises on duplicates), and a replay of
+    the same (seed, profile) reproduces the outputs exactly."""
+    cfg, params, prompts = _setup()
+    want = _solo(params, prompts, 6, cfg)
+    victim = _victim(prompts, 3)
+    profile = FleetFaultProfile(
+        [FleetFault("kill_replica", target=victim, at_s=0.05)], seed=0)
+    fleet = make_fleet(params, cfg, max_len=16, replicas=3, kv_block=4,
+                       faults=profile, steal=False)
+    got = fleet(prompts, 6, slots=2)
+    _assert_all_equal(got, want, "after kill:")
+    st = fleet.last_stats["fleet"]
+    assert st["served"] == len(prompts) and st["shed"] == 0
+    fr = st["faults"]
+    assert fr["replica_down"] == 1
+    assert fr["killed"] == [f"replica-{victim}"]
+    assert fr["redriven"] >= 1
+    assert fr["degraded"] is True and fr["drained"] == []
+    assert fr["profile_seed"] == "0"
+    # the dead replica is reported, never a KeyError on its missing
+    # engine stats
+    dead = [r for r in st["per_replica"] if r["dead"]]
+    assert [r["replica"] for r in dead] == [f"replica-{victim}"]
+    assert fleet.last_stats["replica_stats"][victim] is None
+    # survivors drained their pools (redriven blocks freed at retire)
+    for i, rs in enumerate(fleet.last_stats["replica_stats"]):
+        if rs is not None:
+            assert rs["kv"]["in_use"] == 0
+    # replay: identical (seed, profile) ⇒ identical outputs, again
+    # through a kill — the fault plane is deterministic end to end
+    again = fleet(prompts, 6, slots=2)
+    _assert_all_equal(again, want, "replay:")
+    assert fleet.last_stats["fleet"]["faults"]["replica_down"] == 1
+
+
+def test_fleet_chaos_empty_profile_reproduces_fault_free_fleet():
+    """Defaults-off, pinned: an armed-but-empty profile byte-matches
+    the ``faults=None`` fleet — same tokens, same placements, same
+    (absent) shed set — and bills an all-zero fault record. The fault
+    plane is a seam, not a behaviour change."""
+    cfg, params, prompts = _setup()
+    base = make_fleet(params, cfg, max_len=16, replicas=2, kv_block=4,
+                      steal=False)
+    want = base(prompts, 5, slots=2)
+    bst = base.last_stats["fleet"]
+    assert bst["faults"] is None
+    armed = make_fleet(params, cfg, max_len=16, replicas=2, kv_block=4,
+                       faults=FleetFaultProfile([], seed=7), steal=False)
+    got = armed(prompts, 5, slots=2)
+    _assert_all_equal(got, want, "empty profile:")
+    ast = armed.last_stats["fleet"]
+    assert ast["routed_to"] == bst["routed_to"]
+    assert ast["shed_requests"] == bst["shed_requests"] == []
+    fr = ast["faults"]
+    assert fr["replica_down"] == 0 and fr["redriven"] == 0
+    assert fr["drained"] == [] and fr["killed"] == []
+    assert fr["handoff_retries"] == 0 and fr["degraded"] is False
+
+
+def test_fleet_chaos_planned_drain_finishes_in_flight_work():
+    """A planned ``drain_replica`` is removal WITHOUT recomputation:
+    the drained replica stops admitting, finishes what it already
+    started (it is never marked dead), only its still-queued requests
+    move to survivors, and every output stays solo-exact."""
+    cfg, params, prompts = _setup()
+    want = _solo(params, prompts, 6, cfg)
+    victim = _victim(prompts, 2)
+    profile = FleetFaultProfile(
+        [FleetFault("drain_replica", target=victim, at_s=0.05)], seed=1)
+    fleet = make_fleet(params, cfg, max_len=16, replicas=2, kv_block=4,
+                       faults=profile, steal=False)
+    got = fleet(prompts, 6, slots=2)
+    _assert_all_equal(got, want, "after drain:")
+    st = fleet.last_stats["fleet"]
+    fr = st["faults"]
+    assert st["served"] == len(prompts) and st["shed"] == 0
+    assert fr["drained"] == [f"replica-{victim}"]
+    assert fr["replica_down"] == 0 and fr["killed"] == []
+    assert fr["redriven"] >= 1 and fr["degraded"] is True
+    # the drained replica FINISHED its in-flight work — it reports
+    # stats (alive), served at least one request, and moved the rest
+    by_label = {r["replica"]: r for r in st["per_replica"]}
+    v = by_label[f"replica-{victim}"]
+    assert v["dead"] is False and v["requests"] >= 1
+    assert by_label[f"replica-{1 - victim}"]["requests"] >= 1
+    moved = [w for r, w in st["routed_to"].items()
+             if w.startswith("drained->")]
+    assert len(moved) == fr["redriven"] >= 1
+
+
+def test_fleet_chaos_slow_replica_trips_breaker_stays_exact():
+    """Slow ≠ dead: a replica stalling past ``health_timeout_s`` opens
+    the circuit breaker (billed in the fault record) and is quarantined
+    as a steal/redrive target — but nothing is redriven for slowness,
+    no capacity is lost, and the outputs still bit-match solo."""
+    cfg, params, prompts = _setup()
+    want = _solo(params, prompts, 6, cfg)
+    victim = _victim(prompts, 2)
+    profile = FleetFaultProfile(
+        [FleetFault("slow_replica", target=victim, at_s=0.0,
+                    stall_s=0.12, waves=3)], seed=2)
+    fleet = make_fleet(params, cfg, max_len=16, replicas=2, kv_block=4,
+                       faults=profile, steal=True, steal_poll_s=0.001,
+                       health_timeout_s=0.04, quarantine_polls=4)
+    got = fleet(prompts, 6, slots=2)
+    _assert_all_equal(got, want, "slow replica:")
+    st = fleet.last_stats["fleet"]
+    fr = st["faults"]
+    assert st["served"] == len(prompts)
+    assert fr["circuit_open"] >= 1
+    assert fr["replica_down"] == 0 and fr["killed"] == []
+    assert fr["degraded"] is False          # sick, not gone
+
+
+def test_fleet_chaos_corrupt_handoff_retries_from_prefill():
+    """The disaggregated transfer's integrity leg: a corrupted
+    prefill→decode payload fails its crc (``paging.transfer_crc``), is
+    classified RETRYABLE, re-runs the prefill, and the decode output
+    still bit-matches — never silent garbage in a decode pool."""
+    cfg, params, prompts = _setup()
+    want = _solo(params, prompts, 5, cfg)
+    profile = FleetFaultProfile(
+        [FleetFault("corrupt_handoff", target=0, nth=2)], seed=3)
+    fleet = make_fleet(params, cfg, max_len=16, replicas=2, kv_block=4,
+                       share_prefix=True, disaggregate=True,
+                       prefill_workers=1, faults=profile, steal=False)
+    got = fleet(prompts, 5, slots=2)
+    _assert_all_equal(got, want, "corrupt handoff:")
+    st = fleet.last_stats["fleet"]
+    fr = st["faults"]
+    assert st["served"] == len(prompts)
+    assert fr["handoff_retries"] == 1
+    assert fr["replica_down"] == 0 and fr["redriven"] == 0
+    # pools drained on both sides of the wire
+    for rs in fleet.last_stats["replica_stats"]:
+        assert rs["kv"]["in_use"] == 0
+
+
+def test_fleet_chaos_shed_set_deterministic_under_capacity_schedule():
+    """Degraded-mode admission: with deadlines armed and a kill in the
+    schedule, the shed set is a pure function of (trace, capacity
+    schedule) — two independently built fleets with the same (seed,
+    profile) shed the SAME requests, unshed requests complete
+    solo-exact, and shed positions return None."""
+    cfg, params, prompts = _setup()
+    n = len(prompts)
+    arrivals = poisson_trace(500.0, n, seed=4)
+    budgets = [6] * n
+    deadlines = slo_deadlines(budgets, seed=5, base_s=0.2,
+                              per_token_s=0.02, jitter=0.2)
+    kill_at = fault_times(arrivals, 1, seed=6, lo=0.4, hi=0.6)[0]
+    want = _solo(params, prompts, 6, cfg)
+
+    def run():
+        profile = FleetFaultProfile(
+            [FleetFault("kill_replica", target=None, at_s=kill_at)],
+            seed=8)
+        fleet = make_fleet(params, cfg, max_len=16, replicas=2,
+                           kv_block=4, est_token_s=0.02,
+                           faults=profile, steal=False)
+        got = fleet(prompts, budgets, slots=2, arrivals=arrivals,
+                    deadlines=deadlines)
+        return got, fleet.last_stats["fleet"]
+
+    got_a, st_a = run()
+    got_b, st_b = run()
+    assert st_a["shed_requests"] == st_b["shed_requests"]
+    # the degraded virtual clock actually bit: the N-replica capacity
+    # minus the scheduled victim sheds a strict, non-total subset
+    assert 0 < st_a["shed"] < n, st_a
+    for req in range(n):
+        if req in st_a["shed_requests"]:
+            assert got_a[req] is None and got_b[req] is None
+        else:
+            assert jnp.array_equal(got_a[req], want[req]), req
+            assert jnp.array_equal(got_b[req], want[req]), req
+    assert st_a["served"] + st_a["shed"] == n
+
+
+def test_fleet_fault_profile_validation_is_loud():
+    """Schedule mistakes are build-time errors, never mid-run
+    surprises: bad kinds/params, role mismatches, out-of-range and
+    doubly-scheduled targets, and schedules that would remove a whole
+    role (the fleet must always keep a redrive target)."""
+    cfg, params, _ = _setup()
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FleetFault("explode")
+    with pytest.raises(ValueError, match="stall_s"):
+        FleetFault("slow_replica")
+    with pytest.raises(ValueError, match="waves"):
+        FleetFault("slow_replica", stall_s=0.1, waves=0)
+    with pytest.raises(ValueError, match="nth"):
+        FleetFault("corrupt_handoff", nth=0)
+    with pytest.raises(ValueError, match="at_s"):
+        FleetFault("kill_replica", at_s=-1.0)
+    with pytest.raises(ValueError, match="target"):
+        FleetFault("kill_replica", target=-1)
+    with pytest.raises(ValueError, match="FleetFault"):
+        FleetFaultProfile(["kill_replica"])
+    with pytest.raises(ValueError, match="FleetFaultProfile"):
+        make_fleet(params, cfg, max_len=16, replicas=2, faults=object())
+    with pytest.raises(ValueError, match="health_timeout_s"):
+        make_fleet(params, cfg, max_len=16, replicas=2,
+                   health_timeout_s=0.0)
+    with pytest.raises(ValueError, match="quarantine_polls"):
+        make_fleet(params, cfg, max_len=16, replicas=2,
+                   quarantine_polls=0)
+    # role/shape validation happens at build time, against THIS fleet
+    with pytest.raises(ValueError, match="disaggregate=True"):
+        make_fleet(params, cfg, max_len=16, replicas=2,
+                   faults=FleetFaultProfile(
+                       [FleetFault("kill_prefill", target=0)]))
+    with pytest.raises(ValueError, match="only 2"):
+        make_fleet(params, cfg, max_len=16, replicas=2,
+                   faults=FleetFaultProfile(
+                       [FleetFault("kill_replica", target=5)]))
+    with pytest.raises(ValueError, match="already scheduled"):
+        make_fleet(params, cfg, max_len=16, replicas=2,
+                   faults=FleetFaultProfile(
+                       [FleetFault("kill_replica", target=0),
+                        FleetFault("drain_replica", target=0)]))
+    with pytest.raises(ValueError, match="survivor"):
+        make_fleet(params, cfg, max_len=16, replicas=2,
+                   faults=FleetFaultProfile(
+                       [FleetFault("kill_replica", target=0),
+                        FleetFault("kill_replica", target=1)]))
+    with pytest.raises(ValueError, match="surviving prefill"):
+        make_fleet(params, cfg, max_len=16, replicas=2,
+                   disaggregate=True,
+                   faults=FleetFaultProfile(
+                       [FleetFault("kill_prefill", target=0)]))
+    with pytest.raises(ValueError, match="duplicate slow_replica"):
+        FleetFaultProfile(
+            [FleetFault("slow_replica", target=0, stall_s=0.1),
+             FleetFault("slow_replica", target=0, stall_s=0.2)]
+        ).resolve(2, 0)
+
+
+def test_fleet_fault_profile_seeded_resolution_replays():
+    """``target=None`` draws from ONE string-seeded stream in spec
+    order: identical (seed, faults) resolve to the identical schedule
+    (subprocess-deterministic like every generator in utils/traffic),
+    different seeds may differ, and every draw happens whether or not
+    the spec pinned its target (stream position is spec-order only)."""
+    faults = [FleetFault("kill_replica", at_s=0.1),
+              FleetFault("slow_replica", at_s=0.2, stall_s=0.05)]
+    a = FleetFaultProfile(faults, seed="chaos").resolve(4, 0)
+    b = FleetFaultProfile(faults, seed="chaos").resolve(4, 0)
+    assert a == b
+    assert list(a["kills_dec"]) and list(a["slow_dec"])
+    # pinning an EARLIER spec's target must not shift a LATER spec's
+    # seeded draw (one draw per spec, whatever the targeting)
+    kill_t = list(a["kills_dec"])[0]
+    pinned = FleetFaultProfile(
+        [FleetFault("kill_replica", target=kill_t, at_s=0.1),
+         FleetFault("slow_replica", at_s=0.2, stall_s=0.05)],
+        seed="chaos").resolve(4, 0)
+    assert pinned["slow_dec"] == a["slow_dec"]
+
+
+@pytest.mark.slow
+def test_fleet_chaos_kill_matrix():
+    """The seeded kill matrix (slow; one case stays tier-1): seeds ×
+    kill times × colocated/disaggregated topologies, every cell
+    asserting the full gate — all requests served, solo-bit-exact,
+    exactly one replica down, nothing lost or duplicated (the fleet
+    raises on either)."""
+    cfg, params, prompts = _setup()
+    want = _solo(params, prompts, 5, cfg)
+    cases = []
+    for seed in (0, 1, 2):
+        for frac in (0.1, 0.5):
+            cases.append(("colocated", seed, frac, "kill_replica"))
+    cases += [("disaggregated", 0, 0.3, "kill_replica"),
+              ("disaggregated", 1, 0.3, "kill_prefill")]
+    for mode, seed, frac, kind in cases:
+        label = f"{mode}/seed={seed}/frac={frac}/{kind}"
+        at_s = 0.02 + frac * 0.3
+        profile = FleetFaultProfile(
+            [FleetFault(kind, target=None, at_s=at_s)], seed=seed)
+        if mode == "colocated":
+            fleet = make_fleet(params, cfg, max_len=16, replicas=3,
+                               kv_block=4, faults=profile, steal=True,
+                               steal_poll_s=0.001)
+        else:
+            fleet = make_fleet(params, cfg, max_len=16, replicas=4,
+                               kv_block=4, share_prefix=True,
+                               disaggregate=True, prefill_workers=2,
+                               faults=profile, steal=False)
+        got = fleet(prompts, 5, slots=2)
+        _assert_all_equal(got, want, label)
+        st = fleet.last_stats["fleet"]
+        fr = st["faults"]
+        assert st["served"] == len(prompts), label
+        assert fr["replica_down"] == 1, (label, fr)
+        assert fr["degraded"] is True, label
+        role = "prefill" if kind == "kill_prefill" else \
+            ("decode" if mode == "disaggregated" else "replica")
+        assert fr["killed"][0].startswith(role), (label, fr)
+
+
+def test_fleet_monitor_failure_propagates_and_joins_workers(
+        tmp_path, monkeypatch):
+    """The steal/monitor-loop bugfix (ISSUE 13 satellite): an exception
+    anywhere in the router's monitor loop — here, the queue-depth gauge
+    backend exploding — must CLOSE every replica queue, JOIN every
+    worker thread, and propagate to the caller. The PR 12 loop let it
+    escape before closure, stranding replicas polling open queues
+    forever."""
+    import threading
+
+    from nvidia_terraform_modules_tpu.telemetry import Registry
+    from nvidia_terraform_modules_tpu.telemetry.core import Gauge
+
+    cfg, params, prompts = _setup()
+    reg = Registry(str(tmp_path))
+    fleet = make_fleet(params, cfg, max_len=16, replicas=2, kv_block=4,
+                       telemetry=reg, steal=True, steal_poll_s=0.001)
+    orig = Gauge.set
+
+    def boom(self, v):
+        # only the ROUTER's monitor loop runs outside fleet-* threads;
+        # the engines' own gauge writes must keep working so the
+        # failure is unambiguously the monitor's
+        if not threading.current_thread().name.startswith("fleet-"):
+            raise RuntimeError("telemetry backend exploded")
+        return orig(self, v)
+
+    monkeypatch.setattr(Gauge, "set", boom)
+    with pytest.raises(RuntimeError, match="telemetry backend exploded"):
+        fleet(prompts, 5, slots=2)
+    monkeypatch.setattr(Gauge, "set", orig)
+    # every replica thread was joined on the failure path — nothing
+    # is left polling a queue that will never close
+    stranded = [t.name for t in threading.enumerate()
+                if t.name.startswith("fleet-")]
+    assert stranded == []

@@ -338,6 +338,40 @@ def test_export_import_block_rows_int8_sidecars_ride_along():
             assert jnp.array_equal(src[key][li][4], dst2[key][li][3])
 
 
+def test_transfer_crc_detects_corruption_and_survives_the_wire():
+    """The transfer integrity primitive (PR 13's fault plane): the crc
+    is a pure function of the payload bytes — identical exports agree,
+    a round trip through import and re-export preserves it, and a
+    single flipped element anywhere in any buffer changes it. This is
+    what lets the fleet's disaggregated handoff classify a corrupt
+    import as a retryable transfer failure instead of silently decoding
+    from garbage rows."""
+    from nvidia_terraform_modules_tpu.models.paging import transfer_crc
+
+    cfg = BurnInConfig(**CFG)
+    src = _fill_pool(init_paged_cache(cfg, 2, 24, block_size=4,
+                                      num_blocks=9), seed=4)
+    dst = _fill_pool(init_paged_cache(cfg, 2, 24, block_size=4,
+                                      num_blocks=9), seed=5)
+    payload = export_block_rows(src, [3, 5, 1])
+    crc = transfer_crc(payload)
+    assert crc == transfer_crc(export_block_rows(src, [3, 5, 1]))
+    # the crc follows the BYTES: re-exporting from the importing pool's
+    # own block ids reproduces it (transfer moved, never changed)
+    dst2 = import_block_rows(dst, [7, 2, 8], payload)
+    assert transfer_crc(export_block_rows(dst2, [7, 2, 8])) == crc
+    # one flipped element in one buffer of one key is detected
+    key = pool_transfer_keys(src)[0]
+    bent = {k: list(v) for k, v in payload.items()}
+    buf = bent[key][0]
+    bent[key][0] = buf.at[(0,) * buf.ndim].add(
+        jnp.ones((), buf.dtype))
+    assert transfer_crc(bent) != crc
+    # block ORDER is content: the same blocks in a different order are
+    # a different wire payload
+    assert transfer_crc(export_block_rows(src, [1, 5, 3])) != crc
+
+
 def test_import_block_rows_validation_is_loud():
     """Garbage-block imports, key mismatches (bf16 payload into an
     int8 pool) and block-count mismatches must refuse, not scribble."""

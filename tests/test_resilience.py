@@ -494,3 +494,47 @@ def test_retry_call_giveup_predicate_overrides_retryable():
                    giveup=lambda e: isinstance(e, Terminal),
                    sleep=lambda s: None)
     assert len(attempts) == 1
+
+
+def test_liveness_breaker_state_machine_and_quarantine():
+    """The serving-side liveness classification (PR 13): a stale
+    observation opens the circuit (billed once per open, via the hook),
+    a fresh one starts the quarantine countdown, and only
+    ``quarantine_polls`` consecutive clean polls re-admit — flapping
+    mid-quarantine re-opens and restarts the sentence. Per-key state:
+    one sick replica never poisons another's circuit."""
+    from nvidia_terraform_modules_tpu.models.resilience import (
+        LivenessBreaker,
+    )
+
+    opened = []
+    b = LivenessBreaker(quarantine_polls=3, on_open=opened.append)
+    assert b.healthy("a") and b.state("a") == "ok"
+    # fresh polls keep the circuit closed, no opens billed
+    assert b.observe("a", False) == "ok"
+    assert b.opens == 0 and opened == []
+    # stale → suspect: ONE open, steals/redrives stop landing here
+    assert b.observe("a", True) == "suspect"
+    assert b.opens == 1 and opened == ["a"]
+    # still stale → still suspect, not billed again
+    assert b.observe("a", True) == "suspect"
+    assert b.opens == 1
+    # fresh → quarantine, and the sentence must be served in full
+    assert b.observe("a", False) == "quarantine"
+    assert not b.healthy("a")
+    assert b.observe("a", False) == "quarantine"
+    # flap mid-quarantine: re-open (billed) and restart the sentence
+    assert b.observe("a", True) == "suspect"
+    assert b.opens == 2 and opened == ["a", "a"]
+    assert b.observe("a", False) == "quarantine"
+    assert b.observe("a", False) == "quarantine"
+    assert b.observe("a", False) == "quarantine"
+    assert b.observe("a", False) == "ok"
+    assert b.healthy("a")
+    # keys are independent
+    assert b.healthy("b")
+    b.observe("b", True)
+    assert not b.healthy("b") and b.healthy("a")
+    assert b.opens == 3
+    with pytest.raises(ValueError, match="quarantine_polls"):
+        LivenessBreaker(quarantine_polls=0)

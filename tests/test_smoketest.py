@@ -66,6 +66,16 @@ def test_burnin_level(jax8):
     # different model (models/fleet.py's contract)
     assert r.checks["serve_fleet_ok"]
     assert r.checks["serve_fleet_replicas"] == 2
+    # the fleet CHAOS gate (PR 13): a 3-replica fleet with a seeded
+    # mid-wave replica kill still bit-matches the single-engine
+    # baseline on every completed request — deterministic redrive is
+    # exact recovery, not best-effort — with the survivors' pools
+    # drained and the death billed in the fault record
+    assert r.checks["fleet_chaos_ok"]
+    # the gate requires replica_down == 1, and the victim is pinned to
+    # the replica owning the first prompt's work — a fired kill always
+    # leaves at least that request to redrive
+    assert r.checks["fleet_chaos_redriven"] >= 1
 
 
 @pytest.mark.slow

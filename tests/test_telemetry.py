@@ -798,3 +798,93 @@ def test_fleet_shed_and_steal_counters_export(jax8, tmp_path):
     prom = reg.prometheus_text()
     assert "# TYPE fleet_shed_total counter" in prom
     assert f"fleet_shed_total {st['shed']}" in prom
+
+
+def test_fleet_fault_counters_degraded_span_and_redrive_marks(
+        jax8, tmp_path):
+    """PR 13's fault-plane telemetry, golden-tested on one registry:
+    a seeded replica kill bills ``fleet_replica_down`` and
+    ``fleet_redrive_total`` through the standard counter exposition,
+    the redriven requests' ``fleet_route`` spans carry
+    ``redrive=True``, and ONE ``fleet_degraded`` span covers the
+    below-nominal-capacity interval — stitched on the SAME timeline as
+    the router and engine spans so the dashboard's degraded bar lines
+    up with the serve spans it explains."""
+    import jax
+    import jax.numpy as jnp
+
+    from nvidia_terraform_modules_tpu.models import (
+        BurnInConfig,
+        init_params,
+        make_fleet,
+    )
+    from nvidia_terraform_modules_tpu.models.fleet import (
+        FleetFault,
+        FleetFaultProfile,
+        HashRing,
+        affinity_key,
+    )
+
+    cfg = BurnInConfig(vocab=64, d_model=32, n_heads=2, d_ff=64,
+                       n_layers=1, seq_len=16, batch=2)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    # one shared template → the ring target owns every request, so the
+    # seeded kill of that target is guaranteed to redrive
+    tmpl = jax.random.randint(jax.random.PRNGKey(3), (4,), 0, 64)
+    prompts = [jnp.concatenate(
+        [tmpl, jax.random.randint(jax.random.PRNGKey(50 + i),
+                                  (1 + i % 2,), 0, 64)])
+        for i in range(6)]
+    victim = HashRing(3).target(affinity_key(prompts[0], 4))
+    reg = Registry(str(tmp_path))
+    fleet = make_fleet(
+        params, cfg, max_len=12, replicas=3, kv_block=4, telemetry=reg,
+        steal=False,
+        faults=FleetFaultProfile(
+            [FleetFault("kill_replica", target=victim, at_s=0.05)],
+            seed=0))
+    outs = fleet(prompts, 5, slots=2)
+    assert all(o is not None for o in outs)
+    fr = fleet.last_stats["fleet"]["faults"]
+    assert fr["replica_down"] == 1 and fr["redriven"] >= 1
+
+    # counters: billed once per event, exported in prometheus text
+    assert reg.counter("fleet_replica_down").value == 1
+    assert reg.counter("fleet_redrive_total").value == fr["redriven"]
+    prom = reg.prometheus_text()
+    for line in ("# TYPE fleet_replica_down counter",
+                 "fleet_replica_down 1",
+                 "# TYPE fleet_redrive_total counter",
+                 "# TYPE fleet_circuit_open_total counter"):
+        assert line in prom, line
+
+    # redriven requests are re-routed with redrive=True span marks
+    redrives = [e for e in reg.events
+                if e["kind"] == "span" and e["name"] == "fleet_route"
+                and e["args"].get("redrive")]
+    assert len(redrives) == fr["redriven"]
+    assert all(s["args"]["replica"] != f"replica-{victim}"
+               for s in redrives)
+
+    # ONE degraded span covering the kill→completion interval, on the
+    # same timeline as the route/serve spans
+    degraded = [e for e in reg.events
+                if e["kind"] == "span" and e["name"] == "fleet_degraded"]
+    assert len(degraded) == 1
+    d = degraded[0]
+    assert d["args"] == {"nominal": 3, "replicas_down": 1, "drained": 0}
+    assert d["dur"] > 0
+    xs = chrome_trace(reg.events)["traceEvents"]
+    names = {e["name"] for e in xs if e["ph"] == "X"}
+    assert {"fleet_degraded", "fleet_route", "serve_request"} <= names
+
+    # a fault-free fleet on a fresh registry keeps the fault
+    # instruments at zero and emits NO degraded span
+    reg2 = Registry(str(tmp_path / "clean"))
+    quiet = make_fleet(params, cfg, max_len=12, replicas=2, kv_block=4,
+                       telemetry=reg2, steal=False)
+    quiet(prompts, 4, slots=2)
+    assert reg2.counter("fleet_replica_down").value == 0
+    assert reg2.counter("fleet_redrive_total").value == 0
+    assert not [e for e in reg2.events
+                if e["kind"] == "span" and e["name"] == "fleet_degraded"]

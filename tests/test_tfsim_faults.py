@@ -901,3 +901,96 @@ tpu_slices = {
     findings = _lint_elastic(str(d))
     assert len(findings) == 1
     assert "tfvars" in findings[0].message
+
+
+# -------------------------------------------- serving failover headroom
+# (`tpu-spot-serving-no-headroom`: the SERVING leg of the spot tripod —
+# a serving-shaped spot pool pinned at max_count == min_count leaves the
+# fleet router's degraded mode with nothing to fail over into)
+
+_SERVE_POOL = """
+resource "google_container_cluster" "c" {
+  name = "c"
+}
+
+resource "google_container_node_pool" "pool_a" {
+  name    = "%s"
+  cluster = google_container_cluster.c.name
+
+  node_config {
+    machine_type = "ct5lp-hightpu-4t"
+    spot         = true
+%s  }
+%s}
+"""
+
+
+def _lint_headroom(path):
+    from nvidia_terraform_modules_tpu.tfsim.lint import run_lint
+
+    return [f for f in run_lint(path)
+            if f.rule == "tpu-spot-serving-no-headroom"]
+
+
+def test_serving_no_headroom_fires_on_pinned_autoscaler(tmp_path):
+    """Serving-named spot TPU pool with min == max: no failover
+    headroom — the exact shape the rule exists for."""
+    auto = ("\n  autoscaling {\n    min_node_count = 2\n"
+            "    max_node_count = 2\n  }\n")
+    body = _SERVE_POOL % ("serve-v5e", "", auto)
+    findings = _lint_headroom(_write(tmp_path, body))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.severity == "warning"
+    assert "max_node_count == min_node_count" in f.message
+    assert "no failover headroom" in f.message
+    assert "tpu-spot-no-grace" in f.message
+
+
+def test_serving_no_headroom_fires_without_autoscaling_block(tmp_path):
+    """A pinned node_count with NO autoscaling block is the same
+    posture (min == max == node_count), diagnosed as such."""
+    body = _SERVE_POOL % ("serve-v5e", "", "")
+    findings = _lint_headroom(_write(tmp_path, body))
+    assert len(findings) == 1
+    assert "no autoscaling block" in findings[0].message
+
+
+def test_serving_no_headroom_fires_on_pinned_total_range(tmp_path):
+    auto = ("\n  autoscaling {\n    total_min_node_count = 4\n"
+            "    total_max_node_count = 4\n  }\n")
+    findings = _lint_headroom(_write(
+        tmp_path, _SERVE_POOL % ("serve-v5e", "", auto)))
+    assert len(findings) == 1
+    assert "total_max_node_count" in findings[0].message
+
+
+def test_serving_no_headroom_satisfied_by_real_range(tmp_path):
+    auto = ("\n  autoscaling {\n    min_node_count = 2\n"
+            "    max_node_count = 4\n  }\n")
+    assert _lint_headroom(_write(
+        tmp_path, _SERVE_POOL % ("serve-v5e", "", auto))) == []
+
+
+def test_serving_no_headroom_detects_shape_via_labels(tmp_path):
+    """A neutrally named pool whose node labels say serving is still
+    serving-shaped — the label is how the fleet selector finds it."""
+    labels = "    labels = { role = \"serving\" }\n"
+    body = _SERVE_POOL % ("pool-a", labels, "")
+    findings = _lint_headroom(_write(tmp_path, body))
+    assert len(findings) == 1
+    assert "'serving'" in findings[0].message
+
+
+def test_serving_no_headroom_silent_on_training_and_on_demand(tmp_path):
+    """Not serving-shaped → silent (training pools answer preemption
+    with checkpoints, not failover); serving but on-demand → silent
+    (no preemption premise)."""
+    train = _SERVE_POOL % ("train-v5e", "", "")
+    assert _lint_headroom(_write(tmp_path, train)) == []
+    on_demand = (_SERVE_POOL % ("serve-v5e", "", "")).replace(
+        "spot         = true", "spot         = false")
+    assert _lint_headroom(_write(tmp_path, on_demand)) == []
+    non_tpu = (_SERVE_POOL % ("serve-pool", "", "")).replace(
+        "ct5lp-hightpu-4t", "n2-standard-8")
+    assert _lint_headroom(_write(tmp_path, non_tpu)) == []

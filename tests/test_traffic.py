@@ -220,3 +220,52 @@ def test_trace_summary_empty_and_burst():
     assert s["max_burst_1s"] == 3
     assert s["horizon_s"] == 5.0
     assert math.isclose(s["mean_rate"], 4 / 5.0, rel_tol=1e-6)
+
+
+def test_fault_times_mid_trace_seeded_and_sorted():
+    """The PR 13 kill-schedule generator: instants land strictly inside
+    the [lo, hi] fraction of the trace horizon (mid-trace — never
+    before the first arrival's routing, never after the run), sorted,
+    and one (trace, n, seed) tuple yields one vector."""
+    from nvidia_terraform_modules_tpu.utils.traffic import fault_times
+
+    trace = poisson_trace(5.0, 20, seed=3)
+    horizon = max(trace)
+    a = fault_times(trace, 4, seed=9)
+    assert a == fault_times(trace, 4, seed=9)
+    assert a != fault_times(trace, 4, seed=10)
+    assert a == sorted(a) and len(a) == 4
+    for t in a:
+        assert 0.25 * horizon <= t <= 0.75 * horizon
+    tight = fault_times(trace, 2, seed=9, lo=0.5, hi=0.5)
+    assert tight == [0.5 * horizon] * 2
+    assert fault_times(trace, 0, seed=1) == []
+    with pytest.raises(ValueError, match="non-empty"):
+        fault_times([], 1)
+    with pytest.raises(ValueError, match="lo"):
+        fault_times(trace, 1, lo=0.8, hi=0.2)
+    with pytest.raises(ValueError, match="n must"):
+        fault_times(trace, -1)
+
+
+def test_fault_times_survive_hash_randomisation():
+    """Cross-process determinism under a different PYTHONHASHSEED —
+    the chaos gate's kill schedule must replay in a bench child
+    process exactly like every other generator here."""
+    from nvidia_terraform_modules_tpu.utils.traffic import fault_times
+
+    code = ("from nvidia_terraform_modules_tpu.utils.traffic import "
+            "fault_times, poisson_trace\n"
+            "print(repr(fault_times(poisson_trace(4.0, 12, seed=2),"
+            " 3, seed=5)))\n")
+    outs = []
+    for hashseed in ("0", "424242"):
+        p = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            text=True,
+            env={"PYTHONHASHSEED": hashseed, "PATH": "/usr/bin:/bin"},
+            check=True)
+        outs.append(p.stdout)
+    assert outs[0] == outs[1]
+    assert repr(fault_times(poisson_trace(4.0, 12, seed=2), 3,
+                            seed=5)) in outs[0]
