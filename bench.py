@@ -1028,6 +1028,51 @@ def section_serve_engine() -> dict:
     sync_outs(sjf_eng(prompts, bi_budgets, slots=slots))
     sjf_sched = sjf_eng.last_stats["sched"]
 
+    # ---- tiered KV cache (ISSUE 14): the host-RAM spill tier on an
+    # OVERSIZED-template Zipf trace — working_set_blocks sizes the
+    # template pool to provably overflow prefix_keep_blocks, so the
+    # device cap alone CANNOT retain the working set and the no-spill
+    # engine re-prefills every evicted template; the spilling engine
+    # recovers them through the host tier. Hit fractions are host-side
+    # block accounting on the saturated (deterministic) schedule, the
+    # bit-match gate rides in the artifact, and the strict hit-frac
+    # gain is the headline the gke-tpu runbook's sizing guidance reads.
+    spill_keep = 4 * (4 if on else 1)            # one template's blocks
+    spill_ws = 6 * spill_keep                    # 6 templates' worth
+    hs_pairs = shared_prefix_prompts(
+        n_req, seed + 3, template_len=4 * kv_block, suffix_lo=plo,
+        suffix_hi=phi, vocab=srv_cfg.vocab,
+        working_set_blocks=spill_ws, block_size=kv_block)
+    hs_prompts = [jnp.asarray(toks, jnp.int32)
+                  for _t, toks in hs_pairs]
+    hs_budgets = ragged_lengths(n_req, seed + 4, lo=nlo, hi=nhi,
+                                mean=nmean)
+    hs_max_len = max(int(p.shape[-1]) + n
+                     for p, n in zip(hs_prompts, hs_budgets))
+    # tight cap: room for the live slots' worst requests + change, so
+    # allocation pressure ALSO drives reclaim through the spill path
+    hs_tight = 1 + slots * -(-hs_max_len // kv_block) + 4
+    nospill = make_serve_engine(params, srv_cfg, max_len=hs_max_len,
+                                kv_block=kv_block, share_prefix=True,
+                                prefix_keep_blocks=spill_keep)
+    ns_outs = nospill(hs_prompts, hs_budgets, slots=slots,
+                      kv_blocks=hs_tight)
+    sync_outs(ns_outs)
+    ns_stats = nospill.last_stats
+    spill_eng = make_serve_engine(params, srv_cfg, max_len=hs_max_len,
+                                  kv_block=kv_block, share_prefix=True,
+                                  prefix_keep_blocks=spill_keep,
+                                  host_spill=True,
+                                  host_blocks=2 * spill_ws)
+    hs_outs = spill_eng(hs_prompts, hs_budgets, slots=slots,
+                        kv_blocks=hs_tight)
+    sync_outs(hs_outs)
+    hs_stats = spill_eng.last_stats
+    hs_bitmatch = all(
+        bool(jax.device_get(jnp.array_equal(a, b)))
+        for a, b in zip(hs_outs, ns_outs))
+    hs_spill = hs_stats["prefix"]["spill"]
+
     kv = sat_stats["kv"]
     lat = stats["latency_ms"]
     out = {
@@ -1105,6 +1150,28 @@ def section_serve_engine() -> dict:
         # moving per wave (the materialised K+V logical view minus the
         # live blocks, all layers) — deterministic, platform-portable
         "decode_gather_bytes_saved": int(pk_bytes_saved),
+        # tiered KV cache (ISSUE 14): the oversized-template Zipf
+        # trace's provenance + the spill headlines. hit_frac at the
+        # SAME tight kv_blocks cap and keep cap, spill vs no-spill —
+        # the gain is the retained working set the host tier bought
+        # back; tokens_saved is the prefill compute the swapped-in
+        # chains avoided beyond the device-resident prefix; swap_ms
+        # the host→device staging bill the async double buffer hides
+        "serve_spill_working_set_blocks": spill_ws,
+        "serve_spill_keep_blocks": spill_keep,
+        "serve_spill_kv_blocks_cap": hs_tight,
+        "serve_spill_hit_frac": hs_stats["prefix"]["hit_frac"],
+        "serve_spill_nospill_hit_frac":
+            ns_stats["prefix"]["hit_frac"],
+        "serve_spill_hit_gain": round(
+            hs_stats["prefix"]["hit_frac"]
+            / max(ns_stats["prefix"]["hit_frac"], 1e-9), 3),
+        "serve_spill_tokens_saved": hs_spill["swap_tokens_saved"],
+        "serve_spill_swap_ms": hs_spill["swap_ms"],
+        "serve_spill_swapins": hs_spill["swapins"],
+        "serve_spill_spilled_blocks": hs_spill["spilled_blocks"],
+        "serve_spill_host_hit_frac": hs_spill["host_hit_frac"],
+        "serve_spill_bitmatch": hs_bitmatch,
     }
     return out
 
@@ -2171,6 +2238,18 @@ def main() -> None:
                 "the prefill COMPUTE saved (serve_prefill_tokens_saved "
                 "tokens) prices in on chip, where prompt-width matmuls "
                 "dominate admission")
+        if "serve_spill_hit_frac" in merged:
+            expectations["serve_spill_hit_frac"] = (
+                "meaningful ON CPU TOO: spill vs no-spill hit "
+                "fractions are host-side block accounting on the "
+                "seeded oversized-template Zipf trace through a "
+                "saturated (deterministic) schedule; the strict gain "
+                "is the retained working set the host tier bought "
+                "back. serve_spill_swap_ms is a real host→device "
+                "staging cost here, but its RATIO to prefill prices "
+                "in on chip, where the avoided prompt-width matmuls "
+                "dominate (a v5e host stages from 48-384 GB of RAM "
+                "next to 16 GB of HBM per chip).")
         if "serve_fleet_affinity_vs_random" in merged:
             expectations["serve_fleet_affinity_vs_random"] = (
                 "meaningful ON CPU TOO: hit fractions are host-side "

@@ -46,6 +46,11 @@ from .serving import (  # noqa: F401
     serve,
 )
 from .fleet import make_fleet  # noqa: F401
+from .hostkv import (  # noqa: F401
+    HostBlockPool,
+    HostSpillCorruptError,
+    IndexSpill,
+)
 from .speculative import (  # noqa: F401
     make_speculative_decoder,
     speculative_greedy_decode,

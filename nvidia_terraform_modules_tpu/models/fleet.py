@@ -787,6 +787,19 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                 raise ValueError(
                     f"disaggregate=True does not compose with {k} "
                     f"(see prefill_session)")
+        if engine_kw.get("host_spill"):
+            # a kv_import handoff exports DEVICE rows — a host-spilled
+            # chain has none, so a donation from it would ship whatever
+            # garbage now sits in the recycled device blocks; refuse
+            # the combination outright (prefill_session enforces the
+            # same engine-side) rather than silently corrupt a decode
+            # pool downstream
+            raise ValueError(
+                "disaggregate=True does not compose with host_spill — "
+                "the prefill→decode handoff donates device-resident "
+                "blocks and a spilled chain has no device rows to "
+                "export; run the tiered KV cache on colocated "
+                "replicas (see prefill_session)")
     from ..telemetry import get_registry
 
     reg = telemetry if telemetry is not None else get_registry()
@@ -1457,6 +1470,11 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
         # ---- stats -----------------------------------------------
         per_replica = []
         hit_b = prompt_b = saved = 0
+        spill_agg = {"spilled_blocks": 0, "host_hit_blocks": 0,
+                     "swapins": 0, "swapped_blocks": 0, "swap_ms": 0.0,
+                     "swap_tokens_saved": 0, "spill_dropped": 0,
+                     "corrupt_dropped": 0}
+        spill_on = bool(engine_kw.get("host_spill"))
         for i, e in enumerate(dec_engines):
             st = e.last_stats
             label = (f"decode-{i}" if disaggregate else f"replica-{i}")
@@ -1470,14 +1488,24 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                     "dead": True,
                 })
                 continue
-            per_replica.append({
+            rec = {
                 "role": "decode", "replica": label,
                 "requests": st["requests"], "waves": st["waves"],
                 "occupancy": st["sched"]["mean_live_requests"],
                 "kv_peak_blocks": st["kv"]["high_water"],
                 "preempted": st["sched"]["preempted"],
                 "dead": dec_queues[i].dead,
-            })
+            }
+            sp = st["prefix"].get("spill")
+            if spill_on and sp is not None:
+                # the tiered-KV split, per replica AND fleet-summed:
+                # each replica spills into its OWN host pool (the tier
+                # is replica-local, like its prefix index), so the
+                # aggregate is a plain sum
+                rec["spill"] = {k: sp[k] for k in spill_agg}
+                for k in spill_agg:
+                    spill_agg[k] += sp[k]
+            per_replica.append(rec)
             hit_b += st["prefix"]["hit_blocks"]
             prompt_b += st["prefix"]["prompt_blocks"]
             saved += st["prefix"]["tokens_saved"]
@@ -1551,6 +1579,12 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                                        if lat_ms else None)},
                 "per_replica": per_replica,
                 "routed_to": routed_to,
+                # fleet-summed tiered-KV traffic (None when the spill
+                # tier is off — its absence must not read as "no
+                # spills happened")
+                "spill": ({**spill_agg,
+                           "swap_ms": round(spill_agg["swap_ms"], 3)}
+                          if spill_on else None),
                 "faults": (None if not fault_on else {
                     "profile_seed": faults.seed,
                     "replica_down": len(killed_labels),

@@ -1,0 +1,286 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Host-RAM block tier for the paged KV cache — the spill side of the
+tiered prefix index.
+
+``prefix_keep_blocks`` caps what the :class:`..paging.PrefixIndex` may
+retain at what the HBM pool spares, so the serve engine's prefix hit
+fraction is bounded by device memory even though a fleet's Zipf-head
+template working set is host-sized, not HBM-sized (the TPU-serving
+comparison papers make host↔HBM staging the decisive serving lever on
+TPU hosts — a v5e host carries 48-384 GB of RAM next to 16 GB of HBM
+per chip). This module is the second tier: a pinned host-side block
+pool (:class:`HostBlockPool`) the index SPILLS evicted chains into
+instead of dropping them, and swaps back in on a later prefix hit.
+
+Division of labour mirrors the device pool exactly:
+
+- the **pool** owns bytes — numpy-backed ``[host_blocks, block_size,
+  kv, D]`` arrays per layer (int8 scale sidecars ride along), one
+  free-list allocator (:class:`..paging.BlockAllocator` at refcount 1 —
+  a host block has exactly one owner, its index entry);
+- the **index** owns which chain holds which host block (the
+  ``tier="host"`` entries in ``PrefixIndex``);
+- the **engine** owns the swap schedule — when a prefix hit lands on a
+  spilled chain, admission allocates fresh device blocks and imports
+  the host rows through ``paging.import_block_rows``, double-buffered
+  against the wave loop via :meth:`HostBlockPool.stage`.
+
+Integrity is the checkpoint engine's crc discipline applied to the
+block transfer wire format: every spilled block is stamped with
+``paging.transfer_crc`` over its single-block payload at store time and
+re-verified at load — RAM is not ECC-trustworthy at fleet scale, a bad
+row silently decoded into a popular template would corrupt EVERY
+request that hits it, so a mismatch raises the CLASSIFIED
+:class:`HostSpillCorruptError` (the engine drops the chain and
+prefills from tokens — slow, never wrong), exactly like a corrupt
+checkpoint record quarantines instead of restoring.
+
+``tests/test_paging.py`` pins the spill→swap-in roundtrip bitwise per
+cache dtype, the corruption path, and the exhaustion fallback;
+``tests/test_serving.py`` the engine-level bit-match (spill on == spill
+off) across the scheduler-lever matrix.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+from .burnin import BurnInConfig
+from .paging import BlockAllocator, transfer_crc
+
+
+class HostSpillCorruptError(RuntimeError):
+    """A spilled block's bytes no longer match their store-time crc —
+    a CLASSIFIED integrity failure (like ``CorruptCheckpointError``):
+    the caller must drop the chain and recompute from tokens, never
+    decode from the corrupt rows."""
+
+
+class HostBlockPool:
+    """Pinned host-side block pool: the spill target behind the prefix
+    index.
+
+    Layout matches the device pool's transferable keys exactly —
+    per-layer ``k``/``v`` ``[host_blocks, block_size, kv, D]`` numpy
+    arrays (plus ``k_scale``/``v_scale`` ``[host_blocks, block_size,
+    kv]`` float32 sidecars for int8 caches) — so a spill is
+    ``paging.export_block_rows`` landing in host rows and a swap-in is
+    the same payload handed back to ``paging.import_block_rows``: the
+    round trip is memcpy-bitwise per dtype, never a re-quantisation.
+
+    Each stored block is crc-stamped (``paging.transfer_crc`` over its
+    single-block payload) and verified at :meth:`load`/:meth:`stage`;
+    a mismatch raises :class:`HostSpillCorruptError` loudly.
+
+    :meth:`store` is all-or-nothing like the device allocator: host
+    exhaustion returns ``None`` and the caller falls back to a plain
+    drop (a lost retained prefix costs a re-prefill, never
+    correctness). :meth:`stage` is the async half of the engine's
+    double-buffered swap-in: it snapshots and verifies the rows NOW
+    (so a later free/reuse of the host block cannot race the reader)
+    and moves the host→device transfer onto a worker thread, so the
+    wave loop's decode dispatch overlaps the next admission's swap-in.
+    """
+
+    def __init__(self, cfg: BurnInConfig, host_blocks: int, *,
+                 block_size: int, cache_dtype: str = "bf16"):
+        if host_blocks < 1:
+            raise ValueError(
+                f"host_blocks must be >= 1, got {host_blocks}")
+        if block_size < 1:
+            raise ValueError(
+                f"block_size must be >= 1, got {block_size}")
+        if cache_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"unknown cache_dtype {cache_dtype!r}: use bf16|int8")
+        self.host_blocks = host_blocks
+        self.block_size = block_size
+        self.cache_dtype = cache_dtype
+        quant = cache_dtype == "int8"
+        kv_shape = (host_blocks, block_size, cfg.kv_heads, cfg.head_dim)
+        buf_dtype = np.dtype("int8") if quant else np.dtype(cfg.dtype)
+        self._bufs: dict[str, list[np.ndarray]] = {
+            "k": [np.zeros(kv_shape, buf_dtype)
+                  for _ in range(cfg.n_layers)],
+            "v": [np.zeros(kv_shape, buf_dtype)
+                  for _ in range(cfg.n_layers)],
+        }
+        if quant:
+            self._bufs["k_scale"] = [
+                np.zeros(kv_shape[:3], np.float32)
+                for _ in range(cfg.n_layers)]
+            self._bufs["v_scale"] = [
+                np.zeros(kv_shape[:3], np.float32)
+                for _ in range(cfg.n_layers)]
+        # reserved=0: there is no garbage block on the host side — no
+        # device writes ever target these rows, so every id is real
+        self._alloc = BlockAllocator(host_blocks, reserved=0)
+        self._crc: dict[int, int] = {}
+        self._pool: Any = None          # lazy ThreadPoolExecutor
+        self.stored_blocks = 0          # cumulative spill traffic
+        self.loaded_blocks = 0
+
+    def reset(self) -> None:
+        """Fresh run over the SAME buffers: new allocator, cleared crc
+        stamps, zeroed traffic counters. The engine builds the pool
+        ONCE at ``make_serve_engine`` time (the big numpy allocation
+        happens at build, not mid-serving) and resets it per run —
+        rows need no re-zeroing, a block is only readable once a new
+        store stamps it."""
+        self._alloc = BlockAllocator(self.host_blocks, reserved=0)
+        self._crc.clear()
+        self.stored_blocks = 0
+        self.loaded_blocks = 0
+
+    # ------------------------------------------------------- accounting
+
+    @property
+    def in_use(self) -> int:
+        return self._alloc.in_use
+
+    @property
+    def free_blocks(self) -> int:
+        return self._alloc.free_blocks
+
+    @property
+    def high_water(self) -> int:
+        return self._alloc.high_water
+
+    def stats(self) -> dict[str, int]:
+        return {
+            "host_blocks": self.host_blocks,
+            "in_use": self.in_use,
+            "free": self.free_blocks,
+            "high_water": self.high_water,
+            "stored_blocks": self.stored_blocks,
+            "loaded_blocks": self.loaded_blocks,
+        }
+
+    # ------------------------------------------------------- store side
+
+    def _block_payload(self, hid: int) -> dict[str, list[np.ndarray]]:
+        """The single-block payload view of host block ``hid`` — the
+        same wire format ``export_block_rows`` produces, so one crc
+        definition (``paging.transfer_crc``) covers both sides."""
+        return {k: [buf[hid:hid + 1] for buf in bufs]
+                for k, bufs in self._bufs.items()}
+
+    def store(self, pool: dict, dev_blocks: Sequence[int]) -> list[int] | None:
+        """Copy the physical content of ``dev_blocks`` out of the
+        device ``pool`` into host rows: returns the host block ids (one
+        per device block, in order), or ``None`` when the host pool
+        cannot hold them all (all-or-nothing — the caller drops the
+        chain instead). Each row is crc-stamped at store time."""
+        from .paging import export_block_rows, pool_transfer_keys
+
+        dev_blocks = list(dev_blocks)
+        if not dev_blocks:
+            return []
+        keys = pool_transfer_keys(pool)
+        if sorted(keys) != sorted(self._bufs):
+            raise ValueError(
+                f"device pool carries keys {sorted(keys)}, host pool "
+                f"was built for {sorted(self._bufs)} (cache_dtype "
+                f"mismatch between the tiers?)")
+        hids = self._alloc.alloc(len(dev_blocks))
+        if hids is None:
+            return None
+        payload = export_block_rows(pool, dev_blocks)
+        # one readback for the whole chain (the spill's device→host
+        # hop), then ONE fancy-index write per (key, layer) — this
+        # runs inside trim()/reclaim() on the wave loop, so the copy
+        # must be vectorised, not a per-row Python loop
+        idx = np.asarray(hids)
+        for k in self._bufs:
+            for buf, src in zip(self._bufs[k], payload[k]):
+                buf[idx] = np.asarray(src)
+        for hid in hids:
+            self._crc[hid] = transfer_crc(self._block_payload(hid))
+        self.stored_blocks += len(hids)
+        return hids
+
+    def free(self, host_ids: Sequence[int]) -> None:
+        for hid in host_ids:
+            self._crc.pop(int(hid), None)
+        self._alloc.free(list(host_ids))
+
+    # -------------------------------------------------------- load side
+
+    def _verify(self, hid: int) -> None:
+        want = self._crc.get(hid)
+        if want is None:
+            raise ValueError(
+                f"host block {hid} holds no spilled content — foreign "
+                f"or already-freed id")
+        got = transfer_crc(self._block_payload(hid))
+        if got != want:
+            raise HostSpillCorruptError(
+                f"host block {hid} failed its crc re-check "
+                f"(stored {want:#010x}, read {got:#010x}) — host RAM "
+                f"corruption; drop the chain and prefill from tokens, "
+                f"never decode these rows")
+
+    def load(self, host_ids: Sequence[int]) -> dict[str, list[np.ndarray]]:
+        """The swap-in payload for ``host_ids``: crc-verified rows in
+        ``export_block_rows``'s wire format, ready for
+        ``paging.import_block_rows`` into freshly granted device
+        blocks. Raises :class:`HostSpillCorruptError` on a bad row."""
+        hids = [int(h) for h in host_ids]
+        for hid in hids:
+            self._verify(hid)
+        self.loaded_blocks += len(hids)
+        return {k: [np.stack([buf[h] for h in hids])
+                    for buf in bufs]
+                for k, bufs in self._bufs.items()}
+
+    def stage(self, host_ids: Sequence[int]):
+        """The ASYNC half of the double-buffered swap-in: snapshot and
+        crc-verify the rows now (immune to a later free/overwrite of
+        the host block), then push the host→device transfer onto the
+        worker thread so it overlaps the wave loop's decode dispatch.
+        Returns a future whose ``result()`` is a device-resident
+        payload for ``import_block_rows``; a crc failure raises
+        :class:`HostSpillCorruptError` from the snapshot, before any
+        thread is involved."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        payload = self.load(host_ids)            # snapshot + verify NOW
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="hostkv-swap")
+
+        def to_device():
+            import jax
+
+            return {k: [jax.device_put(b) for b in bufs]
+                    for k, bufs in payload.items()}
+
+        return self._pool.submit(to_device)
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+
+class IndexSpill:
+    """The duck-typed spill adapter ``PrefixIndex`` drives: binds a
+    :class:`HostBlockPool` to the engine's LIVE device pool reference
+    (the wave loop rebinds ``pool`` every dispatch, so the adapter
+    reads it through a callable, never a snapshot). Kept tiny on
+    purpose — ``paging.py`` stays importable without this module, the
+    index only sees ``store(dev_blocks) → host_ids|None`` and
+    ``free(host_ids)``."""
+
+    def __init__(self, host: HostBlockPool, pool_ref):
+        self.host = host
+        self._pool_ref = pool_ref
+
+    def store(self, dev_blocks: Sequence[int]) -> list[int] | None:
+        return self.host.store(self._pool_ref(), dev_blocks)
+
+    def free(self, host_ids: Sequence[int]) -> None:
+        self.host.free(host_ids)

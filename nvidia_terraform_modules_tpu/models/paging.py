@@ -219,28 +219,70 @@ class PrefixIndex:
     match so eviction takes chain suffixes before the prefixes that
     reach them; evicting an interior entry cascades to its descendants
     (unreachable entries must not keep holding references).
+
+    TIERED (the host-RAM spill, ``models/hostkv.py``): with a ``spill``
+    adapter (``store(dev_blocks) → host_ids | None`` + ``free``), an
+    eviction COPIES the chain's blocks host-side instead of dropping
+    them — the entry stays in the index at ``tier="host"`` with its
+    device reference released, so the retained working set is bounded
+    by host RAM, not ``capacity``. Eviction spills the candidate AND
+    its whole descendant subtree (an interior entry's readers always
+    reference every ancestor, so an unreferenced interior implies an
+    unreferenced subtree — pinned by the same refcount argument the
+    LRU-safety test makes), which keeps the invariant the match walk
+    relies on: a host entry never has a device-tier descendant, so
+    every matched chain is a device prefix followed by a host tail.
+    Host-pool exhaustion falls back to the plain drop (correctness
+    never depends on the spill). A later match returns the host tail
+    via :meth:`match_tiered`; the engine grants fresh device blocks,
+    imports the rows and :meth:`promote`\\ s the entries back to
+    device tier.
     """
 
-    def __init__(self, alloc: BlockAllocator, capacity: int):
+    def __init__(self, alloc: BlockAllocator, capacity: int, *,
+                 spill=None):
         if capacity < 0:
             raise ValueError(f"capacity must be >= 0, got {capacity}")
         self.alloc = alloc
         self.capacity = capacity
-        # key → (block, token-chunk, parent key) in LRU order
-        self._entries: "OrderedDict[bytes, tuple[int, tuple, bytes | None]]" = OrderedDict()
+        self.spill = spill
+        # key → (block, token-chunk, parent key, tier) in LRU order;
+        # tier "dev": block is a device block id carrying one allocator
+        # reference; tier "host": block is a host-pool id (no device
+        # reference — the bytes live in the spill adapter's pool)
+        self._entries: "OrderedDict[bytes, tuple[int, tuple, bytes | None, str]]" = OrderedDict()
         self._children: dict[bytes, set[bytes]] = {}
         self.hit_blocks = 0
         self.lookups = 0
+        self.spilled_blocks = 0        # cumulative entries spilled
+        self.spill_dropped = 0         # evictions the full host pool
+        #                                demoted to plain drops
+        self.host_hit_blocks = 0       # host-tier entries matched
+        # why the last reclaim() returned 0 (None after a fruitful
+        # one): "live" = device-tier entries exist but every one is
+        # still table-referenced; "empty" = nothing device-resident to
+        # reclaim at all — the distinction the spill tier's admission
+        # control needs (live: wait for retirements; empty: the pool
+        # pressure is real allocations, queue)
+        self.reclaim_blocked: str | None = None
 
     def __len__(self) -> int:
         return len(self._entries)
 
     @property
     def retained_unreferenced(self) -> list[bytes]:
-        """Indexed blocks no table references (refcount 1 = ours only),
-        in LRU order — the eviction candidates the cap bounds."""
-        return [k for k, (b, _t, _p) in self._entries.items()
-                if self.alloc.refcount(b) == 1]
+        """DEVICE-tier indexed blocks no table references (refcount 1 =
+        ours only), in LRU order — the eviction candidates the cap
+        bounds. Host-tier entries hold no device blocks, so they are
+        never candidates."""
+        return [k for k, (b, _t, _p, tier) in self._entries.items()
+                if tier == "dev" and self.alloc.refcount(b) == 1]
+
+    @property
+    def host_tier(self) -> list[bytes]:
+        """Spilled entries (host-resident chains), in LRU order."""
+        return [k for k, (_b, _t, _p, tier) in self._entries.items()
+                if tier == "host"]
 
     @staticmethod
     def _key(parent: bytes | None, chunk: tuple) -> bytes:
@@ -249,36 +291,108 @@ class PrefixIndex:
         return h.digest()
 
     def match(self, chunks: Sequence[tuple]) -> list[int]:
-        """Longest indexed chain prefix of ``chunks`` → its physical
-        blocks (with one reference ADDED to each via ``share`` — the
-        caller maps them into a table and frees them at retirement like
-        any owned block). Matched entries are touched most-recent,
-        leaf-first."""
-        self.lookups += 1
-        blocks: list[int] = []
-        keys: list[bytes] = []
+        """Longest DEVICE-RESIDENT indexed chain prefix of ``chunks`` →
+        its physical blocks (with one reference ADDED to each via
+        ``share`` — the caller maps them into a table and frees them at
+        retirement like any owned block). Matched entries are touched
+        most-recent, leaf-first. A spilled (host-tier) entry ends the
+        walk — callers that can swap in use :meth:`match_tiered`."""
+        dev, _host = self.match_tiered(chunks, host=False)
+        return dev
+
+    def _walk(self, chunks: Sequence[tuple]
+              ) -> tuple[list[tuple[bytes, int]],
+                         list[tuple[bytes, int]]]:
+        """The tier-aware chain walk — ONE definition, so the
+        admission match and the prefetch probe can never disagree on
+        the chain they name. Pure lookup: NO references, NO LRU touch,
+        NO stats. Returns ``(dev, tail)`` as ``(key, id)`` pairs: the
+        device-resident prefix, then the spilled continuation."""
+        dev: list[tuple[bytes, int]] = []
+        tail: list[tuple[bytes, int]] = []
         parent: bytes | None = None
         for chunk in chunks:
             key = self._key(parent, chunk)
             ent = self._entries.get(key)
             if ent is None or ent[1] != chunk:
                 break
-            blocks.append(ent[0])
-            keys.append(key)
+            if ent[3] == "host":
+                tail.append((key, ent[0]))
+            elif tail:
+                # defensive: the spill invariant (no device entry below
+                # a host one) makes this unreachable — never extend a
+                # mixed sandwich
+                break
+            else:
+                dev.append((key, ent[0]))
             parent = key
+        return dev, tail
+
+    def match_tiered(self, chunks: Sequence[tuple], *,
+                     host: bool = True) -> tuple[list[int],
+                                                 list[tuple[bytes, int]]]:
+        """The tier-aware match: ``(dev_blocks, host_tail)`` where
+        ``dev_blocks`` is the device-resident chain prefix (shared —
+        one reference added each, exactly like :meth:`match`) and
+        ``host_tail`` the spilled continuation as ``(key, host_id)``
+        pairs, deepest last. Host entries take NO references here — the
+        caller that decides to swap them in allocates device blocks,
+        imports the rows and calls :meth:`promote`; a caller that
+        cannot (blocks exhausted) just walks away, nothing to undo."""
+        self.lookups += 1
+        dev, tail = self._walk(chunks)
+        if not host:
+            tail = []
+        blocks = [b for _k, b in dev]
+        keys = [k for k, _b in dev] + [k for k, _h in tail]
         for key in reversed(keys):               # leaf ends most recent
             self._entries.move_to_end(key)
         if blocks:
             self.alloc.share(blocks)
             self.hit_blocks += len(blocks)
-        return blocks
+        self.host_hit_blocks += len(tail)
+        return blocks, tail
+
+    def peek_host_tail(self, chunks: Sequence[tuple]
+                       ) -> list[tuple[bytes, int]]:
+        """Read-only probe of the spilled continuation a
+        :meth:`match_tiered` of ``chunks`` would return — NO references
+        taken, NO LRU touch, NO stats: the wave loop's swap-in
+        PREFETCH uses it to stage the next admission's host rows while
+        the current wave decodes, and a probe must never perturb the
+        schedule-invariant eviction order."""
+        return self._walk(chunks)[1]
+
+    def promote(self, keys: Sequence[bytes],
+                blocks: Sequence[int]) -> None:
+        """Re-register swapped-in entries as DEVICE-resident:
+        ``blocks[i]`` (a freshly granted device block whose rows the
+        caller just imported) replaces ``keys[i]``'s host id — the
+        index takes one reference (``share``) like any registration and
+        frees the host copy. Keys must be host-tier, in chain order."""
+        if len(keys) != len(blocks):
+            raise ValueError(f"{len(keys)} keys for {len(blocks)} blocks")
+        for key, block in zip(keys, blocks):
+            ent = self._entries.get(key)
+            if ent is None or ent[3] != "host":
+                raise ValueError(
+                    "promote() takes host-tier entries — the chain "
+                    "moved under the caller (evicted or already "
+                    "promoted); re-match before swapping in")
+            self.alloc.share([block])
+            if self.spill is not None:
+                self.spill.free([ent[0]])
+            self._entries[key] = (block, ent[1], ent[2], "dev")
 
     def register(self, chunks: Sequence[tuple],
                  blocks: Sequence[int]) -> None:
         """Index ``blocks[i]`` as holding ``chunks[i]`` (a prefilled
         request's full own blocks, in chain order). Already-indexed
-        chain nodes are skipped (the donor matched them); new entries
-        take one reference each."""
+        device-tier chain nodes are skipped (the donor matched them);
+        new entries take one reference each. A HOST-tier node the donor
+        re-prefilled (it was capped out of the match, or diverged past
+        the cap) PROMOTES in place: the donor's device block replaces
+        the host copy — fresher bytes, identical content."""
         if len(chunks) != len(blocks):
             raise ValueError(
                 f"{len(chunks)} chunks for {len(blocks)} blocks")
@@ -288,16 +402,22 @@ class PrefixIndex:
             ent = self._entries.get(key)
             if ent is None:
                 self.alloc.share([block])
-                self._entries[key] = (block, chunk, parent)
+                self._entries[key] = (block, chunk, parent, "dev")
                 if parent is not None:
                     self._children.setdefault(parent, set()).add(key)
+            elif ent[3] == "host":
+                self.alloc.share([block])
+                if self.spill is not None:
+                    self.spill.free([ent[0]])
+                self._entries[key] = (block, chunk, parent, "dev")
             self._entries.move_to_end(key)
             parent = key
 
-    def _evict(self, key: bytes) -> int:
-        """Drop ``key`` and every descendant entry (unreachable once
-        the parent is gone), freeing the index's reference on each.
-        Returns the number of entries evicted."""
+    def _drop(self, key: bytes) -> int:
+        """Plain drop of ``key`` and every descendant entry
+        (unreachable once the parent is gone), releasing the index's
+        device reference or host copy on each. Returns the number of
+        entries dropped."""
         n = 0
         stack = [key]
         while stack:
@@ -305,19 +425,72 @@ class PrefixIndex:
             ent = self._entries.pop(k, None)
             if ent is None:
                 continue
-            block, _chunk, parent = ent
-            self.alloc.free([block])
+            block, _chunk, parent, tier = ent
+            if tier == "dev":
+                self.alloc.free([block])
+            elif self.spill is not None:
+                self.spill.free([block])
             if parent is not None and parent in self._children:
                 self._children[parent].discard(k)
             stack.extend(self._children.pop(k, ()))
             n += 1
         return n
 
+    def discard(self, key: bytes) -> int:
+        """Drop ``key`` and its whole subtree unconditionally — device
+        references freed, host copies released, NO spill. The
+        quarantine path: a spilled chain whose rows failed their crc
+        re-check must leave the index entirely (the engine prefills
+        from tokens), never re-spill the suspect bytes."""
+        return self._drop(key)
+
+    def _evict(self, key: bytes) -> int:
+        """Evict ``key``: SPILL its device-tier subtree host-side when
+        a spill adapter is wired (entries stay indexed at
+        ``tier="host"``, device references released), falling back to
+        :meth:`_drop` when the host pool cannot hold the whole subtree
+        (all-or-nothing — a half-spilled chain would strand the tail).
+        Returns device-tier entries released either way."""
+        if self.spill is None:
+            return self._drop(key)
+        # collect the device-tier subtree in chain (parent-first) order
+        sub: list[bytes] = []
+        stack = [key]
+        while stack:
+            k = stack.pop()
+            ent = self._entries.get(k)
+            if ent is None:
+                continue
+            if ent[3] == "dev":
+                sub.append(k)
+            stack.extend(self._children.get(k, ()))
+        if not sub:
+            return 0
+        dev_blocks = [self._entries[k][0] for k in sub]
+        hids = self.spill.store(dev_blocks)
+        if hids is None:
+            # host pool exhausted: the eviction still must free device
+            # blocks — plain drop, loudly billed. Return the DEVICE
+            # count, not _drop's entry count: the subtree may carry
+            # previously spilled host-tier descendants whose removal
+            # frees no device block, and reclaim()'s callers budget
+            # against device blocks released
+            self.spill_dropped += len(sub)
+            self._drop(key)
+            return len(sub)
+        for k, hid in zip(sub, hids):
+            block, chunk, parent, _tier = self._entries[k]
+            self.alloc.free([block])
+            self._entries[k] = (hid, chunk, parent, "host")
+        self.spilled_blocks += len(sub)
+        return len(sub)
+
     def trim(self) -> int:
         """Enforce the LRU cap: evict least-recently-used
         retained-but-unreferenced entries (NEVER a block a live table
-        still references) until at most ``capacity`` remain. Returns
-        evicted entry count."""
+        still references) until at most ``capacity`` remain — spilling
+        them host-side when the tier is wired. Returns evicted entry
+        count."""
         n = 0
         while True:
             cands = self.retained_unreferenced
@@ -329,22 +502,32 @@ class PrefixIndex:
         """Evict up to ``n`` retained-but-unreferenced entries NOW
         (allocation pressure: a block a new admission needs beats a
         retained prefix, whatever the cap says). Returns the number of
-        entries evicted — 0 means nothing was reclaimable and the
-        caller should queue."""
+        device blocks released — 0 means nothing was reclaimable and
+        the caller should queue, with :attr:`reclaim_blocked` saying
+        WHY ("live": retained chains exist but live tables still
+        reference every one; "empty": nothing device-resident is
+        retained at all)."""
         freed = 0
         while freed < n:
             cands = self.retained_unreferenced
             if not cands:
                 break
             freed += self._evict(cands[0])
+        if freed == 0:
+            self.reclaim_blocked = (
+                "live" if any(tier == "dev" for _b, _t, _p, tier
+                              in self._entries.values()) else "empty")
+        else:
+            self.reclaim_blocked = None
         return freed
 
     def release(self) -> int:
-        """Drop every entry (end of a run: the pool is being torn
-        down). Returns evicted entry count."""
+        """Drop every entry — device references freed, host copies
+        released (end of a run: both tiers tear down with the pool).
+        Returns evicted entry count."""
         n = 0
         while self._entries:
-            n += self._evict(next(iter(self._entries)))
+            n += self._drop(next(iter(self._entries)))
         self._children.clear()
         return n
 

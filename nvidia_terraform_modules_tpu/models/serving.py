@@ -58,6 +58,15 @@ off, reproducing the baseline engine exactly):
   queue (its deterministic tokens regenerate identically on
   re-admission — scheduling, never different output).
 
+A fourth lever TIERS the prefix index itself (``host_spill=True``,
+``models/hostkv.py``): LRU evictions spill chains into a pinned
+host-RAM block pool instead of dropping them and a later hit swaps the
+rows back in (async double-buffered against the wave loop,
+crc-verified), so the retained template working set is bounded by host
+RAM, not ``prefix_keep_blocks`` — the host-as-backing-store pattern
+the TPU-serving comparison papers make the decisive lever on hosts
+carrying 48-384 GB of RAM next to 16 GB of HBM per chip.
+
 Every decode wave advances ALL busy slots in ONE compiled program — a
 batched ``[slots, 1]`` cached forward over the paged pool with per-slot
 positions and block tables; admission is host-side bookkeeping between
@@ -599,7 +608,10 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                       share_prefix: bool = False,
                       lazy_growth: bool = False,
                       prefix_keep_blocks: int = 64,
-                      paged_kernel: str = "auto"):
+                      paged_kernel: str = "auto",
+                      host_spill: bool = False,
+                      host_blocks: int | None = None,
+                      host_swap: str = "async"):
     """Reusable engine: compile once, run many schedules.
 
     The compiled pieces (per-bucket admissions, the all-slots paged
@@ -696,6 +708,26 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
     re-entering (see :func:`make_spec_step`). ``lazy_growth`` requires
     ``eos_check_every == 1`` on the plain loop.
 
+    ``host_spill`` (requires ``share_prefix``) turns on the TIERED KV
+    cache (``models/hostkv.py``): the prefix index's LRU evictions
+    COPY a chain's blocks into a pinned host-RAM pool of
+    ``host_blocks`` blocks (default ``max(4·prefix_keep_blocks, 64)``
+    — the host tier exists because the template working set dwarfs
+    the device cap, so it defaults strictly larger) instead of
+    dropping them, and a later prefix hit against a spilled chain
+    swaps the rows back in through fresh device blocks
+    (crc-verified; a corrupt row is a CLASSIFIED drop — the request
+    re-prefills from tokens, never decodes garbage). ``host_swap``
+    picks the swap-in schedule: ``"async"`` (default) stages the next
+    queued admission's host rows on a worker thread so the
+    host→device copy overlaps the current wave's decode dispatch;
+    ``"sync"`` loads at admission — identical bytes either way (the
+    bit-match gate pins both), so the knob is purely a latency lever.
+    Spilling composes with every scheduler lever (sharing refcounts,
+    ``lazy_growth``, chunked prefill, ``spec_k``, the fleet) because
+    the swap restores the exact exported bytes; ``last_stats
+    ["prefix"]["spill"]`` carries the spill/hit/swap-latency split.
+
     ``paged_kernel`` (``"auto"|"on"|"off"``) picks the wave step's T=1
     read path: ``"auto"`` routes decode attention through the
     block-table-native pallas kernel on TPU — no per-wave
@@ -747,6 +779,21 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
     if prefix_keep_blocks < 0:
         raise ValueError(
             f"prefix_keep_blocks must be >= 0, got {prefix_keep_blocks}")
+    if host_swap not in ("async", "sync"):
+        raise ValueError(
+            f"unknown host_swap {host_swap!r}: use async|sync")
+    if host_blocks is not None and host_blocks < 1:
+        raise ValueError(f"host_blocks must be >= 1, got {host_blocks}")
+    if host_spill and not share_prefix:
+        raise ValueError(
+            "host_spill is the prefix index's second tier — enable "
+            "share_prefix=True alongside it (there is nothing to spill "
+            "without an index)")
+    if host_blocks is None:
+        # default: room for several keep-caps' worth of templates — the
+        # host tier exists precisely because the working set dwarfs the
+        # device cap, so it must default strictly larger
+        host_blocks = max(4 * prefix_keep_blocks, 64)
     from ..telemetry import get_registry
 
     reg = telemetry if telemetry is not None else get_registry()
@@ -776,6 +823,17 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
     nt = geom["tables"]
     quant = cache_dtype == "int8"
     pool_keys = ("k", "v") + (("k_scale", "v_scale") if quant else ())
+
+    # the host tier's pool is built ONCE here — the big numpy
+    # allocation happens at engine build (an oversized host_blocks
+    # surfaces at construction, not mid-serving) and each run resets
+    # the allocator/crc state over the same buffers
+    host_pool = None
+    if host_spill:
+        from .hostkv import HostBlockPool
+
+        host_pool = HostBlockPool(cfg, host_blocks, block_size=bs,
+                                  cache_dtype=cache_dtype)
 
     prefix_len = 0
     prefix_full_blocks = 0                 # whole blocks shared read-only
@@ -1038,8 +1096,26 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                     "deadlock; raise kv_blocks")
             self.kv_blocks = kv_blocks
             self.alloc = BlockAllocator(kv_blocks)
-            self.index = (PrefixIndex(self.alloc, prefix_keep_blocks)
+            # tiered prefix index (models/hostkv.py): evictions spill
+            # to the pinned host pool instead of dropping; the adapter
+            # reads the LIVE pool through a closure because the wave
+            # loop rebinds self.pool every dispatch
+            self.host = host_pool
+            spill = None
+            if self.host is not None:
+                from .hostkv import IndexSpill
+
+                self.host.reset()
+                spill = IndexSpill(self.host, lambda: self.pool)
+            self.index = (PrefixIndex(self.alloc, prefix_keep_blocks,
+                                      spill=spill)
                           if share_prefix else None)
+            # async swap-in staging (host_swap="async"): at most one
+            # prefetched chain, keyed by its exact (key, host_id) tail
+            # so a chain that moved under the prefetch falls back to
+            # the synchronous load — identical bytes either way
+            self._staged_sig: tuple | None = None
+            self._staged_fut = None
             self.pool = init_paged_cache(
                 cfg, slots, max_len, block_size=bs, num_blocks=kv_blocks,
                 rules=rules, cache_dtype=cache_dtype)
@@ -1058,7 +1134,20 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             self.admit_wave: dict[int, int] = {}
             self.retire_wave: dict[int, int] = {}
             self.prefix_stats = {"hit_blocks": 0, "lookups": 0,
-                                 "prompt_blocks": 0, "tokens_saved": 0}
+                                 "prompt_blocks": 0, "tokens_saved": 0,
+                                 # tiered-KV split: blocks served from
+                                 # the host tier (swapped in on a hit
+                                 # against a spilled chain), the swap
+                                 # traffic/latency, classified corrupt
+                                 # drops, and why reclaim() came back
+                                 # empty-handed (live vs empty — the
+                                 # satellite distinction)
+                                 "host_hit_blocks": 0, "swapins": 0,
+                                 "swapped_blocks": 0, "swap_ms": 0.0,
+                                 "swap_tokens_saved": 0,
+                                 "corrupt_dropped": 0,
+                                 "reclaim_blocked_live": 0,
+                                 "reclaim_blocked_empty": 0}
             self._toks: dict[int, list] = {}          # host prompt cache
             self._row_np: dict[int, Any] = {}
             if prefix is not None:
@@ -1087,19 +1176,23 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             shared: list[int] = []
             cov = 0
             n_chunks = 0
+            dev_k = 0
             if share and self.index is not None:
-                toks = self._toks.get(req)
-                if toks is None:
-                    toks = [int(t) for t in np.asarray(prompt)]
-                    self._toks[req] = toks
-                chunks = chain_chunks(toks, bs, prefix_tail_rows)
-                # at least one prompt token must remain to forward —
-                # its logits pick the first generated token
-                while chunks and chunk_tokens_covered(
-                        len(chunks), bs, prefix_tail_rows) > length - 1:
-                    chunks.pop()
+                chunks = self._chunks_for(req, prompt, length)
                 n_chunks = len(chunks)
-                shared = self.index.match(chunks)
+                if self.host is not None:
+                    # tiered match: the device-resident prefix is
+                    # shared like any match; a spilled continuation is
+                    # swapped back in (fresh device blocks + row
+                    # import + promote), extending the hit — or left
+                    # host-side when the pool cannot spare the blocks
+                    shared, tail = self.index.match_tiered(chunks)
+                    dev_k = len(shared)
+                    if tail:
+                        shared = shared + self._swap_in(tail)
+                else:
+                    shared = self.index.match(chunks)
+                    dev_k = len(shared)
                 cov = chunk_tokens_covered(len(shared), bs,
                                            prefix_tail_rows)
                 if prefill_chunk is not None:
@@ -1139,6 +1232,15 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 self.prefix_stats["prompt_blocks"] += n_chunks
                 self.prefix_stats["hit_blocks"] += k
                 self.prefix_stats["tokens_saved"] += cov
+                host_k = max(0, k - dev_k)
+                if host_k:
+                    # the tier split: hits the HBM cap alone would have
+                    # missed, and the prefill tokens the host tier
+                    # saved beyond the device-resident prefix
+                    self.prefix_stats["host_hit_blocks"] += host_k
+                    self.prefix_stats["swap_tokens_saved"] += (
+                        cov - chunk_tokens_covered(dev_k, bs,
+                                                   prefix_tail_rows))
             self.owned[req] = shared + blocks
             row = np.zeros((nt,), np.int32)
             row[:prefix_full_blocks] = \
@@ -1159,6 +1261,95 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             return (jnp.asarray(row), tail, prefix_len + cov, cov,
                     entries)
 
+        def _chunks_for(self, req: int, prompt, length: int) -> list:
+            """The prompt's candidate chain chunks for the prefix
+            index — at least one prompt token must remain to forward
+            (its logits pick the first generated token). ONE
+            definition, so the admission match and the async swap-in
+            PREFETCH can never disagree on the chain they name."""
+            toks = self._toks.get(req)
+            if toks is None:
+                toks = [int(t) for t in np.asarray(prompt)]
+                self._toks[req] = toks
+            chunks = chain_chunks(toks, bs, prefix_tail_rows)
+            while chunks and chunk_tokens_covered(
+                    len(chunks), bs, prefix_tail_rows) > length - 1:
+                chunks.pop()
+            return chunks
+
+        def _swap_in(self, tail: list) -> list[int]:
+            """Swap a spilled chain continuation back to the device
+            tier: grant fresh device blocks, import the host rows
+            (``paging.import_block_rows`` — the staged async payload
+            when the prefetch matched, the synchronous crc-verified
+            load otherwise; identical bytes either way, which is what
+            the bit-match gate pins) and ``promote`` the entries.
+            Returns the now-device-resident blocks carrying this
+            request's reference, exactly like matched shared blocks —
+            or ``[]`` when the device pool cannot spare the grant (the
+            chain stays host-resident, nothing to undo) or the rows
+            failed their crc (classified: the chain is DROPPED and the
+            request prefills from tokens — slow, never wrong)."""
+            from .hostkv import HostSpillCorruptError
+            from .paging import import_block_rows
+
+            keys = [key for key, _hid in tail]
+            blocks = self._alloc_reclaiming(len(tail))
+            if blocks is None:
+                return []
+            sig = tuple(tail)
+            staged = None
+            if self._staged_sig == sig and self._staged_fut is not None:
+                # consume the prefetch; on a mismatch LEAVE it staged —
+                # it belongs to a different queued request whose
+                # admission may still claim it this wave (the sig keys
+                # content, so a stale entry can never serve wrong
+                # bytes, only be replaced by the next prefetch)
+                staged = self._staged_fut
+                self._staged_sig, self._staged_fut = None, None
+            t0 = time.monotonic()
+            try:
+                payload = (staged.result() if staged is not None
+                           else self.host.load([h for _k, h in tail]))
+            except HostSpillCorruptError:
+                self.alloc.free(blocks)
+                self.index.discard(keys[0])      # quarantine the chain
+                self.prefix_stats["corrupt_dropped"] += 1
+                return []
+            self.pool = import_block_rows(self.pool, blocks, payload)
+            self.index.promote(keys, blocks)
+            self.prefix_stats["swapins"] += 1
+            self.prefix_stats["swapped_blocks"] += len(blocks)
+            self.prefix_stats["swap_ms"] += (time.monotonic() - t0) * 1e3
+            return blocks
+
+        def prefetch_swap(self, req: int, prompt) -> None:
+            """The double-buffering half (``host_swap="async"``): probe
+            the NEXT admission's spilled continuation read-only
+            (``peek_host_tail`` — no references, no LRU touch) and
+            stage its host rows on the pool's worker thread, so the
+            host→device copy overlaps this wave's decode dispatch. A
+            chain that moves between prefetch and admission misses the
+            signature and falls back to the synchronous path."""
+            if self.host is None or self.index is None:
+                return
+            from .hostkv import HostSpillCorruptError
+
+            tail = self.index.peek_host_tail(
+                self._chunks_for(req, prompt, int(prompt.shape[-1])))
+            if not tail:
+                return
+            sig = tuple(tail)
+            if self._staged_sig == sig:
+                return                           # already in flight
+            try:
+                fut = self.host.stage([h for _k, h in tail])
+            except HostSpillCorruptError:
+                # the admission's synchronous load re-detects this and
+                # runs the classified drop — never stage garbage
+                return
+            self._staged_sig, self._staged_fut = sig, fut
+
         def register_prefix(self, req: int) -> None:
             """Index the request's prefilled FULL prompt blocks so
             later admissions can share them (no-op when sharing is
@@ -1175,10 +1366,16 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             """``alloc`` that EVICTS retained-but-unreferenced prefix
             blocks under allocation pressure before giving up — a
             retained prefix must never starve a new admission into
-            permanent queueing at a tight ``kv_blocks`` cap."""
+            permanent queueing at a tight ``kv_blocks`` cap. A
+            fruitless reclaim is billed by WHY (live-referenced vs
+            nothing retained), the distinction the spill tier's
+            admission control reads."""
             blocks = self.alloc.alloc(n)
             while blocks is None and self.index is not None:
                 if not self.index.reclaim(n - self.alloc.free_blocks):
+                    why = self.index.reclaim_blocked
+                    if why is not None:
+                        self.prefix_stats[f"reclaim_blocked_{why}"] += 1
                     return None
                 blocks = self.alloc.alloc(n)
             return blocks
@@ -1208,9 +1405,14 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
 
         def close(self) -> None:
             """End of run: release the prefix index's retained blocks
-            so the pool drains to empty (the leak check's invariant)."""
+            so the pool drains to empty (the leak check's invariant —
+            BOTH tiers: release frees host copies too), and shut the
+            swap worker down."""
             if self.index is not None:
                 self.index.release()
+            self._staged_sig, self._staged_fut = None, None
+            if self.host is not None:
+                self.host.close()
 
         def sample(self, live: int = 0) -> None:
             """One per-wave occupancy sample (host ints — runs whether
@@ -1286,6 +1488,14 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         _g_hit = reg.gauge("prefix_hit_blocks")
         _g_hitf = reg.gauge("prefix_hit_frac")
         _g_lazy = reg.gauge("blocks_grown_lazy")
+        # tiered-KV gauges (host_spill): cumulative blocks spilled to
+        # the host tier, swap-in latency spent, and the fraction of
+        # prompt blocks the HOST tier served (hits the HBM cap alone
+        # would have missed) — the dashboard triple the gke-tpu
+        # runbook's sizing guidance reads
+        _g_spill = reg.gauge("prefix_spilled_blocks")
+        _g_swapms = reg.gauge("prefix_swapin_ms")
+        _g_hosthitf = reg.gauge("prefix_host_hit_frac")
         # per-wave decode time: the paged-kernel lever's live signal
         # (the gather path scales with pool size, the kernel with live
         # tokens — watch this drop when paged_kernel engages). Honest
@@ -1303,8 +1513,29 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 _g_hit.set(ps["hit_blocks"])
                 _g_hitf.set(round(ps["hit_blocks"]
                                   / max(ps["prompt_blocks"], 1), 4))
+                if host_spill:
+                    _g_spill.set(rstate.index.spilled_blocks)
+                    _g_swapms.set(round(ps["swap_ms"], 3))
+                    _g_hosthitf.set(round(ps["host_hit_blocks"]
+                                          / max(ps["prompt_blocks"], 1),
+                                          4))
             if lazy_growth:
                 _g_lazy.set(rstate.grown_lazy)
+
+    def _prefetch_next(rstate, sched, prompts):
+        """Between admission and dispatch: stage the NEXT queued
+        request's spilled rows so the host→device swap overlaps this
+        wave's decode (the double buffer of the tiered KV cache). A
+        no-op unless the engine spills, the swap mode is async, and
+        the next candidate's chain has a host tail; read-only against
+        the scheduler (``candidate()`` is a peek) and the index."""
+        if not host_spill or host_swap != "async":
+            return
+        if sched.exhausted():
+            return
+        req = sched.candidate()
+        if req is not None:
+            rstate.prefetch_swap(req, prompts[req])
 
     def _note_admit(meta, req, wait_s):
         # every telemetry timestamp below comes from the REGISTRY clock
@@ -1588,6 +1819,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             sched.tick()
             rstate.sample(live=len(active) + len(stalled))
             _gauges(rstate, waiting, len(active) + len(stalled))
+            _prefetch_next(rstate, sched, prompts)
             if not active:
                 if lazy_growth and stalled:
                     # every live request is stalled on block growth:
@@ -1709,6 +1941,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                     if lat else None)
 
         ps = rstate.prefix_stats
+        idx, host = rstate.index, rstate.host
         return {
             "requests": n_req,
             "generated": generated,
@@ -1725,6 +1958,40 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                                   / max(ps["prompt_blocks"], 1), 4),
                 "tokens_saved": ps["tokens_saved"],
                 "lookups": ps["lookups"],
+                # why fruitless reclaims came back empty-handed (the
+                # 0-return disambiguation the spill tier needs):
+                # "live" = retained chains exist but every one is
+                # table-referenced, "empty" = nothing device-resident
+                # retained at all
+                "reclaim_blocked": {
+                    "live": ps["reclaim_blocked_live"],
+                    "empty": ps["reclaim_blocked_empty"],
+                },
+                # the tiered-KV split: spill traffic, host-tier hits
+                # (blocks the HBM cap alone would have re-prefilled),
+                # swap-in latency/volume, and the classified drops
+                "spill": {
+                    "enabled": host is not None,
+                    "host_blocks": (host.host_blocks
+                                    if host is not None else 0),
+                    "spilled_blocks": (idx.spilled_blocks
+                                       if idx is not None else 0),
+                    "spill_dropped": (idx.spill_dropped
+                                      if idx is not None else 0),
+                    "host_hit_blocks": ps["host_hit_blocks"],
+                    "host_hit_frac": round(
+                        ps["host_hit_blocks"]
+                        / max(ps["prompt_blocks"], 1), 4),
+                    "swapins": ps["swapins"],
+                    "swapped_blocks": ps["swapped_blocks"],
+                    "swap_ms": round(ps["swap_ms"], 3),
+                    "swap_tokens_saved": ps["swap_tokens_saved"],
+                    "corrupt_dropped": ps["corrupt_dropped"],
+                    "host_in_use": (host.in_use
+                                    if host is not None else 0),
+                    "host_high_water": (host.high_water
+                                        if host is not None else 0),
+                },
             },
         }
 
@@ -1780,7 +2047,22 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                           "admit_wave_of": {}},
                 "prefix": {"enabled": share_prefix, "hit_blocks": 0,
                            "prompt_blocks": 0, "hit_frac": 0.0,
-                           "tokens_saved": 0, "lookups": 0},
+                           "tokens_saved": 0, "lookups": 0,
+                           "reclaim_blocked": {"live": 0, "empty": 0},
+                           "spill": {"enabled": host_spill,
+                                     "host_blocks": (host_blocks
+                                                     if host_spill
+                                                     else 0),
+                                     "spilled_blocks": 0,
+                                     "spill_dropped": 0,
+                                     "host_hit_blocks": 0,
+                                     "host_hit_frac": 0.0,
+                                     "swapins": 0, "swapped_blocks": 0,
+                                     "swap_ms": 0.0,
+                                     "swap_tokens_saved": 0,
+                                     "corrupt_dropped": 0,
+                                     "host_in_use": 0,
+                                     "host_high_water": 0}},
             }
             return {} if admission is not None else []
         if eos_check_every < 1:
@@ -2099,6 +2381,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             busy = len(active) + len(filling) + len(stalled)
             rstate.sample(live=busy)
             _gauges(rstate, waiting, busy)
+            _prefetch_next(rstate, sched, prompts)
             if not active:
                 if stalled and not filling:
                     # every live request is stalled on block growth and
@@ -2383,6 +2666,18 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 "prefill sessions use the one-dispatch prefill — "
                 "prefill_chunk's interleaving needs the wave loop; "
                 "build the prefill-worker engine without it")
+        if host_spill:
+            # a handoff payload is export_block_rows over DEVICE rows;
+            # a spilled chain's bytes live host-side, so its donation
+            # would export whatever garbage now sits in the recycled
+            # device blocks — refuse the combination outright rather
+            # than silently corrupt a decode pool downstream
+            raise ValueError(
+                "prefill sessions hand off device-resident blocks — a "
+                "host-spilled chain has no device rows to export, so "
+                "host_spill does not compose with kv_import donation; "
+                "build the prefill-worker engine without host_spill "
+                "(decode-side engines may still spill)")
         return _PrefillSession(kv_blocks)
 
     run.last_stats = None

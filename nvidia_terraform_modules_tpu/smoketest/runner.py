@@ -577,6 +577,69 @@ def _run_smoketest(
                     checks["fleet_chaos_error"] = str(exc)
                 ok &= checks["fleet_chaos_ok"]
 
+            # tiered-KV gate (ISSUE 14): the host-RAM spill tier
+            # (models/hostkv.py behind the prefix index) is
+            # contractually a CACHING change — a spilled chain swapped
+            # back in restores the exact exported bytes — so a
+            # tight-kv_blocks spilling engine on a template wave that
+            # OVERFLOWS the device keep-cap must BIT-match the
+            # unconstrained no-spill baseline, with ≥ 1 swap-in
+            # actually observed (a wave that never crossed the tier
+            # proves nothing) and BOTH pools drained. Gates the
+            # host↔HBM staging path on this host's real allocator/
+            # transfer lowering before a serving job trusts it. Tiny,
+            # process-local (one engine, no collectives).
+            if checks.get("serve_sched_ok"):
+                try:
+                    from ..models.serving import make_serve_engine
+                    from ..utils.traffic import shared_prefix_prompts
+
+                    vcfg = BurnInConfig(
+                        vocab=128, d_model=32, n_heads=4, d_ff=64,
+                        n_layers=2, seq_len=16, batch=2,
+                        dtype=jax.numpy.float32)
+                    vparams = init_params(jax.random.PRNGKey(14), vcfg)
+                    # working_set_blocks > prefix_keep_blocks=0: every
+                    # retirement evicts, so sequential repeats MUST
+                    # come back through the host tier
+                    vpairs = shared_prefix_prompts(
+                        6, seed=3, template_len=8, suffix_lo=1,
+                        suffix_hi=4, vocab=vcfg.vocab,
+                        working_set_blocks=4, block_size=4)
+                    vprompts = [jax.numpy.asarray(p, jax.numpy.int32)
+                                for _t, p in vpairs]
+                    vbudgets = [3, 4, 2, 4, 3, 2]
+                    vml = max(int(p.shape[-1]) + n
+                              for p, n in zip(vprompts, vbudgets))
+                    vbase = make_serve_engine(vparams, vcfg,
+                                              max_len=vml, kv_block=4)
+                    v_outs = vbase(vprompts, vbudgets, slots=1)
+                    vtight = 1 + -(-vml // 4) + 2
+                    spill = make_serve_engine(
+                        vparams, vcfg, max_len=vml, kv_block=4,
+                        share_prefix=True, prefix_keep_blocks=0,
+                        host_spill=True)
+                    s_outs = spill(vprompts, vbudgets, slots=1,
+                                   kv_blocks=vtight)
+                    s_match = all(
+                        bool(jax.device_get(
+                            jax.numpy.array_equal(a, b)))
+                        for a, b in zip(s_outs, v_outs))
+                    sp = spill.last_stats["prefix"]["spill"]
+                    checks["kv_spill_ok"] = (
+                        s_match and sp["swapins"] >= 1
+                        and sp["spilled_blocks"] > 0
+                        and sp["corrupt_dropped"] == 0
+                        and spill.last_stats["kv"]["in_use"] == 0
+                        and sp["host_in_use"] == 0)
+                    checks["kv_spill_swapins"] = sp["swapins"]
+                    checks["kv_spill_spilled_blocks"] = \
+                        sp["spilled_blocks"]
+                except Exception as exc:  # JSON contract > the type
+                    checks["kv_spill_ok"] = False
+                    checks["kv_spill_error"] = str(exc)
+                ok &= checks["kv_spill_ok"]
+
             # flash pipeline gate: the software-pipelined kernels
             # (ops/flash_attention.py, pipeline="on") are contractually a
             # SCHEDULING change — same sub-tile folds, same arithmetic —

@@ -493,7 +493,10 @@ def check_spot_serving_no_headroom(ctx: LintContext):
     the runtime can only answer with load shedding
     (``fleet_shed_total`` rises, the ``fleet_degraded`` span never
     closes). Give the autoscaler room above the floor so reclaimed
-    serving capacity comes back without a human apply."""
+    serving capacity comes back without a human apply. (The sibling
+    sizing rule for serving pools is ``tpu-serving-no-host-ram``:
+    headroom saves the traffic when a NODE dies, host RAM saves the
+    prefix working set when the HBM pool is the bottleneck.)"""
     for r, flag in _spot_tpu_pools(ctx):
         shaped = _serving_shaped(ctx, r)
         if shaped is None:
@@ -531,6 +534,99 @@ def check_spot_serving_no_headroom(ctx: LintContext):
                            f"shedding; set {hi_k} above {lo_k} (the "
                            f"serving twin of tpu-spot-no-grace's "
                            f"drain-budget posture)")
+
+
+# identifier shapes that mark the tiered-KV host-spill lever as wired
+# into a deployment: the serve engine's own knobs (host_spill= /
+# host_blocks= on make_serve_engine) and the env-var spellings a pod
+# spec would carry them through
+_HOST_SPILL_RE = re.compile(
+    r"host[_-]?spill|host[_-]?blocks|kv[_-]?spill", re.IGNORECASE)
+
+
+def _host_spill_wiring(ctx: LintContext) -> str | None:
+    """The first evidence that this module wires the tiered-KV host
+    spill into its workloads, or None: a ``host_spill``/``host_blocks``
+    -style variable in the module API, a module-call argument of that
+    shape, or a pod env var carrying the knob to the runtime."""
+    for name, v in ctx.mod.variables.items():
+        if _HOST_SPILL_RE.search(name):
+            return f'variable "{name}"'
+    for mc in ctx.mod.module_calls.values():
+        for a in mc.body.attributes:
+            if _HOST_SPILL_RE.search(a.name):
+                return f'module "{mc.name}" argument "{a.name}"'
+    for r in ctx.mod.resources.values():
+        for node in A.walk(r.body):
+            if not (isinstance(node, A.Block) and node.type == "env"):
+                continue
+            na = node.body.attr("name")
+            val = ctx.resolve_literal(na.expr) if na is not None else None
+            if isinstance(val, str) and _HOST_SPILL_RE.search(val):
+                return f'{r.address} env "{val}"'
+    return None
+
+
+@rule("tpu-serving-no-host-ram", severity="warning", family="tpu",
+      summary="serving pool wires the tiered-KV host spill but its "
+              "machine type's host RAM is the family minimum — "
+              "nothing to spill into")
+def check_serving_no_host_ram(ctx: LintContext):
+    """The SIZING leg of the serving posture
+    (``tpu-spot-serving-no-headroom`` saves the traffic when a NODE
+    dies — this rule saves the prefix working set when HBM is the
+    bottleneck). The tiered KV cache (``models/hostkv.py``,
+    ``host_spill=`` on the serve engine) turns HBM into a cache over a
+    HOST-RAM-sized prefix index: its whole premise is that a TPU host
+    carries an order of magnitude more RAM than HBM (a v5e-4t host:
+    192 GB of RAM next to 64 GB of HBM). The 1-chip single-host
+    machines are the family's host-RAM FLOOR (``ct5lp-hightpu-1t``:
+    48 GB, ``ct6e-standard-1t``: 44 GB) — after the runtime, weights
+    staging and the OS, there is almost nothing left for
+    ``host_blocks``, so a spill tier wired onto such a pool thrashes
+    (``prefix_swapin_ms`` rises, ``prefix_host_hit_frac`` stays low —
+    see the "Tiered KV cache runbook" in ``gke-tpu/README.md`` for
+    the sizing arithmetic) or OOMs the host. Fires only when BOTH
+    sides are statically visible: a serving-shaped TPU pool on a
+    floor-class machine AND host-spill wiring (a ``host_spill``/
+    ``host_blocks``-style variable, module argument, or pod env var)
+    in the same module."""
+    wiring = _host_spill_wiring(ctx)
+    if wiring is None:
+        return
+    for r in ctx.mod.resources.values():
+        if r.type != "google_container_node_pool":
+            continue
+        shaped = _serving_shaped(ctx, r)
+        if shaped is None:
+            continue
+        ncs = r.body.blocks_of("node_config")
+        if not ncs:
+            continue
+        mt = _literal(ctx, ncs[0].body.attr("machine_type"))
+        if not isinstance(mt, str):
+            continue
+        parsed = T.parse_machine_type(mt)
+        if parsed is None:
+            continue
+        gen, chips = parsed
+        if not T.host_memory_is_family_floor(gen, chips):
+            continue
+        gb = T.host_memory_gb(gen, chips)
+        biggest = max(
+            (b for (g, _c), b in T.HOST_MEMORY_GB.items() if g == gen))
+        yield (f"{r.file}:{r.line}",
+               f"{r.address}: serving-shaped ({shaped!r}) pool wires "
+               f"the tiered-KV host spill ({wiring}) onto "
+               f"{mt} — {gb} GB of host RAM is {gen}'s family "
+               f"minimum, so the spill tier has almost nothing to "
+               f"grow into after the runtime's own footprint; use a "
+               f"larger host class (up to {biggest} GB on {gen}) or "
+               f"drop host_spill on this pool (watch "
+               f"prefix_swapin_ms / prefix_host_hit_frac — the "
+               f"sizing arithmetic is in the gke-tpu README's tiered-"
+               f"KV runbook; the failover twin is "
+               f"tpu-spot-serving-no-headroom)")
 
 
 def _slice_containers(ctx: LintContext):

@@ -118,6 +118,36 @@ def topology_error(version: str, topology: str) -> str | None:
     return None
 
 
+# host RAM (GB) per (generation, chips-per-host) machine class — the
+# serving host's OTHER memory, next to HBM (GKE TPU machine shapes).
+# This is what the tiered KV cache (models/hostkv.py, host_spill=) has
+# to live in: the 1-chip v5e/v6e single-host machines are the family
+# FLOOR, host RAM at the TPU minimum, so a host-spill serving pool on
+# one has almost nothing to spill into after the runtime's own
+# footprint (see the "Tiered KV cache runbook", gke-tpu/README.md).
+HOST_MEMORY_GB = {
+    ("v4", 4): 407,
+    ("v5e", 1): 48, ("v5e", 4): 192, ("v5e", 8): 384,
+    ("v5p", 4): 448,
+    ("v6e", 1): 44, ("v6e", 4): 180, ("v6e", 8): 360,
+}
+
+
+def host_memory_gb(version: str, chips: int) -> int | None:
+    """Host RAM of one ``(generation, chips-per-host)`` machine, GB."""
+    return HOST_MEMORY_GB.get((version, chips))
+
+
+def host_memory_is_family_floor(version: str, chips: int) -> bool:
+    """Is this machine class the MINIMUM-host-RAM shape of a family
+    that offers larger hosts? (v4/v5p have one class each — nothing
+    bigger to move to inside the family, so they are never a floor.)"""
+    sizes = [gb for (gen, _c), gb in HOST_MEMORY_GB.items()
+             if gen == version]
+    gb = host_memory_gb(version, chips)
+    return (gb is not None and len(sizes) > 1 and gb == min(sizes))
+
+
 _SUFFIX_GEN = {"ct4p": "v4", "ct5lp": "v5e", "ct5p": "v5p", "ct6e": "v6e"}
 
 

@@ -188,8 +188,10 @@ def ragged_lengths(n: int, seed: int = 0, *, lo: int = 1, hi: int = 64,
 def shared_prefix_prompts(n: int, seed: int = 0, *,
                           n_templates: int = 4, zipf_s: float = 1.2,
                           template_len: int = 32, suffix_lo: int = 1,
-                          suffix_hi: int = 16,
-                          vocab: int = 256) -> list[tuple[int, list[int]]]:
+                          suffix_hi: int = 16, vocab: int = 256,
+                          working_set_blocks: int | None = None,
+                          block_size: int = 16,
+                          ) -> list[tuple[int, list[int]]]:
     """``n`` seeded ``(template_id, prompt)`` pairs for prefix-reuse
     workloads: a pool of ``n_templates`` fixed token templates with
     ZIPF popularity (template rank ``r`` drawn ∝ ``1 / r**zipf_s`` —
@@ -204,9 +206,39 @@ def shared_prefix_prompts(n: int, seed: int = 0, *,
     templates, same draws, whatever PYTHONHASHSEED says), one
     ``(n, seed, params)`` tuple → one byte-identical workload for
     bench, tests and the tfsim fleet simulator alike.
+
+    ``working_set_blocks`` sizes the pool IN KV BLOCKS instead of
+    template count: ``n_templates`` is derived as the smallest pool
+    whose full-block footprint (``n_templates · (template_len //
+    block_size)`` blocks of ``block_size`` tokens — the spans the
+    engine's prefix index can actually chain) reaches it. The tiered-KV
+    bench drives this knob to a value ABOVE the engine's
+    ``prefix_keep_blocks`` so the device cap provably cannot retain the
+    template working set and the host spill tier has real work —
+    ``template_len`` must then hold at least one full block
+    (``template_len >= block_size``), or no template would ever enter
+    the index. Derivation is part of the seeded parameter tuple like
+    everything else here: one ``(working_set_blocks, block_size)``
+    pair → one pool, byte-identical across processes.
     """
     if n < 0:
         raise ValueError(f"n must be >= 0, got {n}")
+    if working_set_blocks is not None:
+        if working_set_blocks < 1:
+            raise ValueError(
+                f"working_set_blocks must be >= 1, got "
+                f"{working_set_blocks}")
+        if block_size < 1:
+            raise ValueError(
+                f"block_size must be >= 1, got {block_size}")
+        if template_len < block_size:
+            raise ValueError(
+                f"working_set_blocks sizes the pool in FULL kv blocks "
+                f"— template_len ({template_len}) must hold at least "
+                f"one block_size ({block_size}) span, or no template "
+                f"ever enters the prefix index")
+        per_template = template_len // block_size
+        n_templates = -(-working_set_blocks // per_template)
     if n_templates < 1:
         raise ValueError(f"n_templates must be >= 1, got {n_templates}")
     if template_len < 1:

@@ -123,7 +123,13 @@ def test_section_serve_engine_schema_and_seeded_workload():
                 "serve_engine_kv_blocks_physical",
                 "serve_paged_decode_ms", "serve_gather_decode_ms",
                 "serve_paged_kernel_vs_gather",
-                "decode_gather_bytes_saved"):
+                "decode_gather_bytes_saved",
+                "serve_spill_working_set_blocks",
+                "serve_spill_keep_blocks", "serve_spill_hit_frac",
+                "serve_spill_nospill_hit_frac", "serve_spill_hit_gain",
+                "serve_spill_tokens_saved", "serve_spill_swap_ms",
+                "serve_spill_swapins", "serve_spill_spilled_blocks",
+                "serve_spill_bitmatch"):
         assert key in out, key
     assert out["serve_engine_slots"] >= 2
     # the regression marker this section retires: per-request
@@ -163,6 +169,21 @@ def test_section_serve_engine_schema_and_seeded_workload():
     assert out["serve_paged_kernel_vs_gather"] > 0
     assert out["decode_gather_bytes_saved"] > 0
     assert out["serve_paged_table_rows"] > out["serve_paged_depth_rows"]
+    # ISSUE 14 tiered-KV gate: on the oversized-template Zipf trace
+    # (working set provably above the keep cap) the spilling engine's
+    # hit fraction at the tight kv_blocks cap is STRICTLY above the
+    # no-spill baseline at the same caps, the swap path actually ran,
+    # it saved real prefill tokens, and outputs bit-match
+    assert out["serve_spill_working_set_blocks"] \
+        > out["serve_spill_keep_blocks"]
+    assert out["serve_spill_hit_frac"] \
+        > out["serve_spill_nospill_hit_frac"], out
+    assert out["serve_spill_hit_gain"] > 1.0, out
+    assert out["serve_spill_swapins"] >= 1, out
+    assert out["serve_spill_spilled_blocks"] > 0
+    assert out["serve_spill_tokens_saved"] > 0, out
+    assert out["serve_spill_swap_ms"] >= 0
+    assert out["serve_spill_bitmatch"] is True
     tr = out["serve_engine_trace"]
     want = trace_summary(poisson_trace(tr["rate"],
                                        out["serve_engine_requests"],
@@ -275,7 +296,16 @@ def test_section_serve_engine_deterministic_across_runs():
                 "serve_sjf_vs_fifo_mean",
                 # the gather-tax byte estimate is static geometry
                 "decode_gather_bytes_saved", "serve_paged_depth_rows",
-                "serve_paged_table_rows"):
+                "serve_paged_table_rows",
+                # the tiered-KV legs are block accounting on the
+                # saturated schedule — seed-determined end to end
+                # (swap_ms is a wall clock and excluded)
+                "serve_spill_working_set_blocks",
+                "serve_spill_keep_blocks", "serve_spill_kv_blocks_cap",
+                "serve_spill_hit_frac", "serve_spill_nospill_hit_frac",
+                "serve_spill_hit_gain", "serve_spill_tokens_saved",
+                "serve_spill_swapins", "serve_spill_spilled_blocks",
+                "serve_spill_host_hit_frac", "serve_spill_bitmatch"):
         assert a[key] == b[key], key
 
 

@@ -679,3 +679,245 @@ def test_forward_paged_kernel_parity_matrix(cache_dtype, bs, gqa):
             chain.append(t)
         chains[mode] = jnp.stack(chain)
     assert jnp.array_equal(chains["on"], chains["off"])
+
+
+# ------------------------------------------------- tiered host spill
+
+
+def _tiered_setup(*, host_blocks=8, cap=2, num_blocks=12,
+                  cache_dtype="bf16", bs=4, seed=7):
+    """A device pool with seeded content, its allocator, a host spill
+    pool and the tiered index binding them — the engine's wiring
+    (serving.py builds exactly this) in miniature."""
+    from nvidia_terraform_modules_tpu.models.hostkv import (
+        HostBlockPool,
+        IndexSpill,
+    )
+
+    cfg = BurnInConfig(**CFG)
+    pool = _fill_pool(init_paged_cache(cfg, 2, 24, block_size=bs,
+                                       num_blocks=num_blocks,
+                                       cache_dtype=cache_dtype),
+                      seed=seed)
+    a = BlockAllocator(num_blocks)
+    host = HostBlockPool(cfg, host_blocks, block_size=bs,
+                         cache_dtype=cache_dtype)
+    idx = PrefixIndex(a, cap, spill=IndexSpill(host, lambda: pool))
+    return cfg, pool, a, host, idx
+
+
+@pytest.mark.parametrize("cache_dtype", ["bf16", "int8"])
+def test_tiered_spill_swapin_roundtrip_bitwise(cache_dtype):
+    """The tier contract end to end: an evicted chain's blocks land
+    host-side, the chain stays indexed at tier="host", and a later
+    swap-in through fresh device blocks reproduces every transferable
+    buffer BITWISE (int8 scale sidecars included) — a spill is a move,
+    never a re-quantisation. Both tiers drain to empty at release."""
+    cfg, pool, a, host, idx = _tiered_setup(cache_dtype=cache_dtype,
+                                            cap=0)
+    chunks = chain_chunks(list(range(12)), 4)
+    donor = a.alloc(3)
+    idx.register(chunks, donor)
+    before = export_block_rows(pool, donor)
+    a.free(donor)                               # retained only
+    assert idx.trim() == 3                      # cap 0: spill the chain
+    assert len(idx.host_tier) == 3 and len(idx) == 3
+    assert a.in_use == 0                        # device refs released
+    assert host.in_use == 3 and host.stored_blocks == 3
+    assert idx.spilled_blocks == 3
+    # the plain match stops at the host tier; the tiered match names
+    # the spilled continuation without taking references
+    assert idx.match(chunks) == []
+    dev, tail = idx.match_tiered(chunks)
+    assert dev == [] and len(tail) == 3
+    assert a.refs_total == 0
+    # swap in: fresh device blocks + row import + promote
+    fresh = a.alloc(3)
+    payload = host.load([h for _k, h in tail])
+    pool2 = import_block_rows(pool, fresh, payload)
+    idx.promote([k for k, _h in tail], fresh)
+    assert host.in_use == 0 and host.loaded_blocks == 3
+    assert idx.host_tier == []
+    after = export_block_rows(pool2, fresh)
+    for key in pool_transfer_keys(pool):
+        for li in range(cfg.n_layers):
+            assert jnp.array_equal(before[key][li],
+                                   after[key][li]), (key, li)
+    # device-resident again: a match shares the fresh blocks
+    assert idx.match(chunks) == fresh
+    a.free(fresh)                               # the swapper's refs
+    a.free(fresh)                               # the matcher's refs
+    idx.release()
+    assert a.in_use == 0 and a.refs_total == 0
+    assert host.in_use == 0
+
+
+def test_tiered_crc_corruption_is_loud_and_classified():
+    """Host RAM is not trustworthy at fleet scale: a spilled row whose
+    bytes moved under the crc must raise the CLASSIFIED
+    HostSpillCorruptError from load AND stage — never hand back
+    garbage — and the quarantine path (discard) removes the chain from
+    both tiers so the request re-prefills from tokens."""
+    from nvidia_terraform_modules_tpu.models.hostkv import (
+        HostSpillCorruptError,
+    )
+
+    cfg, pool, a, host, idx = _tiered_setup(cap=0)
+    chunks = chain_chunks(list(range(8)), 4)
+    donor = a.alloc(2)
+    idx.register(chunks, donor)
+    a.free(donor)
+    idx.trim()
+    tail = idx.peek_host_tail(chunks)
+    (k1, h1), (_k2, h2) = tail
+    host._bufs["k"][0][h2, 0, 0, 0] += 1        # one flipped element
+    with pytest.raises(HostSpillCorruptError, match="crc"):
+        host.load([h2])
+    with pytest.raises(HostSpillCorruptError, match="crc"):
+        host.stage([h2])                        # verified BEFORE async
+    host.load([h1])                             # intact row still loads
+    idx.discard(k1)                             # quarantine the chain
+    assert len(idx) == 0 and host.in_use == 0
+    with pytest.raises(ValueError, match="foreign"):
+        host.load([h1])                         # freed id: loud, not 0s
+
+
+def test_tiered_lru_never_spills_a_live_referenced_chain():
+    """The LRU-safety invariant crosses tiers unchanged: a chain a
+    live table still references (refcount > 1) is never an eviction
+    candidate, so it can neither be dropped NOR spilled — its blocks
+    must keep serving device-side reads in place."""
+    cfg, pool, a, host, idx = _tiered_setup(cap=0)
+    c1 = chain_chunks(list(range(8)), 4)
+    d1 = a.alloc(2)
+    idx.register(c1, d1)
+    c2 = chain_chunks([9, 9, 9, 9], 4)
+    d2 = a.alloc(1)
+    idx.register(c2, d2)
+    reader = idx.match(c1)                      # live reference on c1
+    a.free(d1)
+    a.free(d2)
+    idx.trim()                                  # cap 0
+    assert len(idx.host_tier) == 1              # only c2 spilled
+    assert all(a.refcount(b) >= 2 for b in d1)  # c1 stayed device-side
+    assert idx.match(c1) == d1                  # still a device hit
+    a.free(d1)                                  # that match's refs
+    a.free(reader)                              # the reader retires
+    idx.trim()                                  # NOW c1 is spillable
+    assert len(idx.host_tier) == 3
+    assert a.in_use == 0
+    idx.release()
+    assert host.in_use == 0 and a.refs_total == 0
+
+
+def test_tiered_host_exhaustion_falls_back_to_plain_drop():
+    """All-or-nothing spill: a chain the host pool cannot hold WHOLE
+    is dropped like the untiered index would (device blocks still
+    freed — eviction's job), billed in spill_dropped; a later chain
+    that fits still spills."""
+    cfg, pool, a, host, idx = _tiered_setup(host_blocks=2, cap=0)
+    ca = chain_chunks([5, 5, 5, 5], 4)          # 1 block: fits
+    da = a.alloc(1)
+    idx.register(ca, da)
+    cb = chain_chunks(list(range(12)), 4)       # 3 blocks: cannot fit
+    db = a.alloc(3)
+    idx.register(cb, db)
+    a.free(da)
+    a.free(db)
+    assert idx.trim() == 4                      # every device ref gone
+    assert a.in_use == 0
+    assert len(idx.host_tier) == 1              # ca spilled…
+    assert idx.spilled_blocks == 1
+    assert idx.spill_dropped == 3               # …cb dropped, billed
+    assert host.in_use == 1 and host.stored_blocks == 1
+    assert idx.match_tiered(cb) == ([], [])     # gone from the index
+    assert len(idx.peek_host_tail(ca)) == 1     # still reachable
+    idx.release()
+    assert host.in_use == 0
+
+
+def test_tiered_refcount_leak_sweep_across_tiers():
+    """The allocator-level leak check extended across tiers: an
+    admit/share/spill/swap-in/retire sweep in mixed interleavings ends
+    with every device block back on the free list, zero outstanding
+    references, and the host pool empty."""
+    cfg, pool, a, host, idx = _tiered_setup(cap=1, num_blocks=14)
+    initial = a.free_blocks
+    c1 = chain_chunks(list(range(12)), 4)
+    d1 = a.alloc(3)
+    idx.register(c1, d1)
+    c2 = chain_chunks([7] * 8, 4)
+    d2 = a.alloc(2)
+    idx.register(c2, d2)
+    shared = idx.match(c1)                      # live reader on c1
+    a.free(d1)
+    a.free(d2)
+    idx.trim()                                  # cap 1: c2 spills
+    assert len(idx.host_tier) >= 1
+    a.free(shared)
+    assert idx.reclaim(8) > 0                   # pressure: c1 spills
+    assert a.in_use == 0
+    # swap c1 back in, touch it, retire
+    dev, tail = idx.match_tiered(c1)
+    assert dev == [] and len(tail) == 3
+    fresh = a.alloc(len(tail))
+    pool = import_block_rows(pool, fresh,
+                             host.load([h for _k, h in tail]))
+    idx.promote([k for k, _h in tail], fresh)
+    a.free(fresh)                               # the admission retires
+    # a re-registration over a host-tier chain promotes in place
+    dup = a.alloc(2)
+    idx.register(c2, dup)
+    assert idx.host_tier == []
+    a.free(dup)
+    idx.release()
+    assert a.in_use == 0 and a.refs_total == 0
+    assert a.free_blocks == initial
+    assert host.in_use == 0
+
+
+def test_tiered_peek_is_read_only_and_promote_validates():
+    """peek_host_tail must not perturb anything the schedule depends
+    on (no refs, no LRU touch, no stats) — it is the async prefetch's
+    probe; promote refuses mismatched lengths and non-host keys so a
+    chain that moved under a staged swap fails loudly."""
+    cfg, pool, a, host, idx = _tiered_setup(cap=0)
+    chunks = chain_chunks(list(range(8)), 4)
+    donor = a.alloc(2)
+    idx.register(chunks, donor)
+    a.free(donor)
+    idx.trim()
+    lookups, host_hits = idx.lookups, idx.host_hit_blocks
+    order = list(idx._entries)
+    tail = idx.peek_host_tail(chunks)
+    assert len(tail) == 2
+    assert idx.lookups == lookups                 # no stats…
+    assert idx.host_hit_blocks == host_hits
+    assert list(idx._entries) == order            # …no LRU touch
+    assert a.refs_total == 0                      # …no references
+    with pytest.raises(ValueError, match="keys"):
+        idx.promote([tail[0][0]], [])
+    fresh = a.alloc(2)
+    idx.promote([k for k, _h in tail], fresh)
+    with pytest.raises(ValueError, match="host-tier"):
+        idx.promote([tail[0][0]], [fresh[0]])     # already promoted
+    a.free(fresh)
+    idx.release()
+    assert a.in_use == 0 and host.in_use == 0
+
+
+def test_reclaim_blocked_reports_why_zero():
+    """The satellite fix: a 0 return from reclaim() now says WHY —
+    "live" (retained chains exist but every one is table-referenced)
+    vs "empty" (nothing device-resident retained at all) — the
+    distinction the spill tier's admission control reads."""
+    a, idx = _index_pool(n=5, cap=8)
+    donor = a.alloc(3)
+    idx.register(chain_chunks(list(range(12)), 4), donor)
+    assert idx.reclaim(2) == 0                  # donor still holds refs
+    assert idx.reclaim_blocked == "live"
+    a.free(donor)
+    assert idx.reclaim(3) == 3
+    assert idx.reclaim_blocked is None          # fruitful: cleared
+    assert idx.reclaim(1) == 0
+    assert idx.reclaim_blocked == "empty"

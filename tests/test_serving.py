@@ -1458,3 +1458,240 @@ def test_prefill_session_validation():
     with pytest.raises(ValueError, match="max_len"):
         session.prefill(jnp.zeros((16,), jnp.int32))
     session.close()
+
+
+# ------------------------------------------ tiered KV cache (host spill)
+
+
+def _spill_engines(cfg, params, max_len, **both):
+    """A (baseline, spilling) engine pair differing ONLY in the tier:
+    both share the prefix index, the spilling one evicts into the host
+    pool. prefix_keep_blocks=0 makes every retirement an eviction, so
+    the spill path runs constantly — the hardest schedule for the
+    bit-match gate."""
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+
+    base = make_serve_engine(params, cfg, max_len=max_len, kv_block=4,
+                             share_prefix=True, prefix_keep_blocks=0,
+                             **both)
+    tier = make_serve_engine(params, cfg, max_len=max_len, kv_block=4,
+                             share_prefix=True, prefix_keep_blocks=0,
+                             host_spill=True, **both)
+    return base, tier
+
+
+def test_host_spill_bit_matches_no_spill_solo_tier1():
+    """THE tiered-KV gate: with every retirement an eviction
+    (keep=0), the spilling engine's outputs are bitwise identical to
+    the no-spill engine AND solo greedy, at slots=1 (sequential —
+    every repeat template re-hits THROUGH the host tier, the async
+    double buffer engaged) and slots=2 (concurrent), with real spill
+    traffic billed and both pools drained."""
+    cfg, params, _ = _setup(n_prompts=0)
+    prompts = _template_prompts(cfg)
+    budgets = [3, 4, 2, 4, 3, 2]
+    max_len = max(int(p.shape[-1]) + n for p, n in zip(prompts, budgets))
+    base, tier = _spill_engines(cfg, params, max_len)
+    for slots in (1, 2):
+        want = base(prompts, budgets, slots=slots)
+        got = tier(prompts, budgets, slots=slots)
+        for i, (g, w) in enumerate(zip(got, want)):
+            assert jnp.array_equal(g, w), f"slots={slots} req {i}"
+            if slots == 1:
+                # solo-greedy anchor once — the slots=2 leg is covered
+                # by the (already solo-anchored) baseline bit-match
+                solo = greedy_decode(params, prompts[i][None, :],
+                                     budgets[i], cfg,
+                                     max_len=max_len)[0]
+                assert jnp.array_equal(g, solo), f"solo {i}"
+        st = tier.last_stats
+        sp = st["prefix"]["spill"]
+        assert sp["enabled"] and sp["spilled_blocks"] > 0
+        if slots == 1:
+            # sequential repeats MUST come back through the host tier
+            assert sp["swapins"] > 0 and sp["host_hit_blocks"] > 0
+            assert sp["swap_tokens_saved"] > 0
+            assert sp["swap_ms"] >= 0.0
+        assert sp["corrupt_dropped"] == 0
+        assert st["kv"]["in_use"] == 0              # device drained
+        assert sp["host_in_use"] == 0               # host drained
+        assert sp["host_high_water"] > 0            # …but was used
+
+
+def test_host_spill_sync_swap_matches_async():
+    """host_swap is a latency lever, never a content lever: the
+    synchronous swap-in path produces the same bytes the async
+    double-buffered path does (the fallback the bit-match gate pins)."""
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+
+    cfg, params, _ = _setup(n_prompts=0)
+    prompts = _template_prompts(cfg)
+    budgets = [3, 4, 2, 4, 3, 2]
+    max_len = max(int(p.shape[-1]) + n for p, n in zip(prompts, budgets))
+    _base, tier = _spill_engines(cfg, params, max_len)
+    want = tier(prompts, budgets, slots=1)
+    sync = make_serve_engine(params, cfg, max_len=max_len, kv_block=4,
+                             share_prefix=True, prefix_keep_blocks=0,
+                             host_spill=True, host_swap="sync")
+    got = sync(prompts, budgets, slots=1)
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert jnp.array_equal(g, w), f"request {i} diverged"
+    assert sync.last_stats["prefix"]["spill"]["swapins"] > 0
+
+
+def test_host_spill_sampled_schedule_invariant():
+    """Sampled engines: (request, position)-keyed draws over
+    swapped-in blocks equal the no-spill engine draw for draw."""
+    from nvidia_terraform_modules_tpu.models import (
+        make_sampler,
+        make_serve_engine,
+    )
+
+    cfg, params, _ = _setup(n_prompts=0)
+    prompts = _template_prompts(cfg)
+    rng = jax.random.PRNGKey(7)
+    max_len = max(int(p.shape[-1]) for p in prompts) + 5
+    base, tier = _spill_engines(cfg, params, max_len,
+                                sampler=make_sampler(temperature=5.0))
+    want = base(prompts, 5, slots=1, rng=rng)
+    got = tier(prompts, 5, slots=1, rng=rng)
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert jnp.array_equal(g, w), f"request {i} diverged"
+    assert tier.last_stats["prefix"]["spill"]["swapins"] > 0
+
+
+def test_host_spill_composes_with_chunked_prefill():
+    """Chunked interleaved admission over swapped-in chains: the chunk
+    sweep starts past the swap-restored coverage and outputs still
+    bit-match the no-spill chunked engine."""
+    cfg, params, _ = _setup(n_prompts=0)
+    prompts = _template_prompts(cfg)
+    budgets = [3, 4, 2, 4, 3, 2]
+    max_len = max(int(p.shape[-1]) + n
+                  for p, n in zip(prompts, budgets)) + 4
+    base, tier = _spill_engines(cfg, params, max_len, prefill_chunk=3)
+    want = base(prompts, budgets, slots=2)
+    got = tier(prompts, budgets, slots=2)
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert jnp.array_equal(g, w), f"request {i} diverged"
+    assert tier.last_stats["prefix"]["spill"]["spilled_blocks"] > 0
+
+
+def test_host_spill_composes_with_lazy_growth_tight_pool():
+    """Allocation pressure at a tight kv_blocks cap drives reclaim()
+    straight through the spill path (evictions fund new admissions by
+    COPYING chains host-side) — outputs still bit-match the loose
+    no-spill engine, and the fruitless-reclaim split (live vs empty)
+    is billed instead of an ambiguous zero."""
+    cfg, params, _ = _setup(n_prompts=0)
+    prompts = _template_prompts(cfg)
+    budgets = [3, 6, 2, 5, 4, 3]
+    max_len = max(int(p.shape[-1]) + n for p, n in zip(prompts, budgets))
+    base, tier = _spill_engines(cfg, params, max_len, lazy_growth=True)
+    want = base(prompts, budgets, slots=2)
+    tight = 1 + -(-max_len // 4) + 2
+    got = tier(prompts, budgets, slots=2, kv_blocks=tight)
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert jnp.array_equal(g, w), f"request {i} diverged"
+    st = tier.last_stats
+    assert st["prefix"]["spill"]["spilled_blocks"] > 0
+    assert st["kv"]["in_use"] == 0
+    rb = st["prefix"]["reclaim_blocked"]
+    assert set(rb) == {"live", "empty"}
+    assert rb["live"] >= 0 and rb["empty"] >= 0
+
+
+def test_host_spill_composes_with_spec_k():
+    """Speculative decode over swapped-in chains: the spec engine with
+    the host tier bit-matches the plain spec engine — growth
+    boundaries land identically whether the prefix came from HBM or
+    back from host RAM."""
+    cfg, params, _ = _setup(n_prompts=0)
+    prompts = _template_prompts(cfg)
+    budgets = [3, 6, 2, 5, 4, 3]
+    max_len = max(int(p.shape[-1]) + n for p, n in zip(prompts, budgets))
+    k = 2
+    base, tier = _spill_engines(cfg, params, max_len + k, spec_k=k)
+    want = base(prompts, budgets, slots=2)
+    got = tier(prompts, budgets, slots=2)
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert jnp.array_equal(g, w), f"request {i} diverged"
+    st = tier.last_stats
+    assert st["prefix"]["spill"]["spilled_blocks"] > 0
+    assert st["accepted_per_step"] is not None
+    assert st["kv"]["in_use"] == 0
+
+
+def test_host_spill_fleet_redrive_leg():
+    """The fleet leg: spilling replicas behind the router survive a
+    seeded replica kill with every request solo-bit-exact (redrive
+    re-admits from prompts — a spilled chain on the dead replica is
+    just a colder cache, never wrong bytes), and the router aggregates
+    the per-replica spill split. Disaggregated mode REFUSES host_spill
+    outright (a spilled chain has no device rows to donate)."""
+    from nvidia_terraform_modules_tpu.models import make_fleet
+    from nvidia_terraform_modules_tpu.models.fleet import (
+        FleetFault,
+        FleetFaultProfile,
+        HashRing,
+        affinity_key,
+    )
+
+    cfg, params, _ = _setup(n_prompts=0)
+    prompts = _template_prompts(cfg)
+    budgets = 5
+    want = [greedy_decode(params, p[None, :], budgets, cfg,
+                          max_len=20)[0] for p in prompts]
+    victim = HashRing(3).target(affinity_key(prompts[0], 4))
+    profile = FleetFaultProfile(
+        [FleetFault("kill_replica", target=victim, at_s=0.05)], seed=0)
+    fleet = make_fleet(params, cfg, max_len=20, replicas=3, kv_block=4,
+                       share_prefix=True, prefix_keep_blocks=0,
+                       host_spill=True, faults=profile, steal=False)
+    got = fleet(prompts, budgets, slots=2)
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert g is not None and jnp.array_equal(g, w), f"req {i}"
+    st = fleet.last_stats["fleet"]
+    assert st["faults"]["replica_down"] == 1
+    agg = st["spill"]
+    assert agg is not None and agg["spilled_blocks"] > 0
+    # per-replica split sums to the aggregate (dead replica excluded —
+    # it never assembled stats)
+    live = [r["spill"] for r in st["per_replica"]
+            if not r["dead"] and "spill" in r]
+    assert live and all(
+        agg[k] == sum(s[k] for s in live)
+        for k in ("spilled_blocks", "swapins", "host_hit_blocks"))
+    with pytest.raises(ValueError, match="host_spill"):
+        make_fleet(params, cfg, max_len=20, replicas=3,
+                   disaggregate=True, share_prefix=True,
+                   host_spill=True)
+
+
+def test_host_spill_validation_and_defaults_off():
+    """The lever is defaults-off and loud: host_spill without
+    share_prefix refuses (nothing to spill without an index), bad
+    host_blocks / host_swap refuse, and a plain engine's stats record
+    bills the tier as disabled."""
+    from nvidia_terraform_modules_tpu.models import make_serve_engine
+
+    cfg, params, prompts = _setup(n_prompts=2)
+    with pytest.raises(ValueError, match="share_prefix"):
+        make_serve_engine(params, cfg, max_len=16, host_spill=True)
+    with pytest.raises(ValueError, match="host_blocks"):
+        make_serve_engine(params, cfg, max_len=16, share_prefix=True,
+                          host_spill=True, host_blocks=0)
+    with pytest.raises(ValueError, match="host_swap"):
+        make_serve_engine(params, cfg, max_len=16, share_prefix=True,
+                          host_spill=True, host_swap="eager")
+    eng = make_serve_engine(params, cfg, max_len=16, kv_block=4,
+                            share_prefix=True)
+    eng(prompts, 3, slots=2)
+    sp = eng.last_stats["prefix"]["spill"]
+    assert sp["enabled"] is False
+    assert sp["spilled_blocks"] == 0 and sp["swapins"] == 0
+    # prefill sessions refuse the tier engine-side too
+    spill_eng = make_serve_engine(params, cfg, max_len=16, kv_block=4,
+                                  share_prefix=True, host_spill=True)
+    with pytest.raises(ValueError, match="host_spill"):
+        spill_eng.prefill_session(kv_blocks=32)

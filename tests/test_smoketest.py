@@ -76,6 +76,14 @@ def test_burnin_level(jax8):
     # the replica owning the first prompt's work — a fired kill always
     # leaves at least that request to redrive
     assert r.checks["fleet_chaos_redriven"] >= 1
+    # the tiered-KV gate (ISSUE 14): a tight-kv_blocks engine spilling
+    # into the host tier bit-matches the unconstrained no-spill
+    # baseline on a template wave that overflows the device keep-cap,
+    # with the tier demonstrably crossed (≥ 1 swap-in) and both pools
+    # drained — host↔HBM staging is caching, never different tokens
+    assert r.checks["kv_spill_ok"]
+    assert r.checks["kv_spill_swapins"] >= 1
+    assert r.checks["kv_spill_spilled_blocks"] > 0
 
 
 @pytest.mark.slow

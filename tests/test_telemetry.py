@@ -888,3 +888,50 @@ def test_fleet_fault_counters_degraded_span_and_redrive_marks(
     assert reg2.counter("fleet_redrive_total").value == 0
     assert not [e for e in reg2.events
                 if e["kind"] == "span" and e["name"] == "fleet_degraded"]
+
+
+def test_tiered_kv_spill_gauges_export(jax8, tmp_path):
+    """ISSUE 14's tiered-KV gauges: ``prefix_spilled_blocks`` /
+    ``prefix_swapin_ms`` / ``prefix_host_hit_frac`` carry the run's
+    cumulative spill traffic, agree with ``last_stats``'s spill
+    record, and land in the Prometheus exposition through the
+    standard path — golden-covered like the PR 10 lever gauges."""
+    import jax
+
+    from nvidia_terraform_modules_tpu.models import (
+        BurnInConfig,
+        init_params,
+    )
+    from nvidia_terraform_modules_tpu.models.serving import (
+        make_serve_engine,
+    )
+
+    cfg = BurnInConfig(vocab=64, d_model=32, n_heads=2, d_ff=64,
+                       n_layers=1, seq_len=16, batch=2)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    reg = Registry(str(tmp_path))
+    # two 8-token templates over kv_block=4, slots=1 + keep=0: every
+    # retirement spills, every repeat swaps back in through the host
+    # tier — real traffic on every gauge
+    tmpl = [jax.random.randint(jax.random.PRNGKey(80 + i), (8,), 0, 64)
+            for i in range(2)]
+    prompts = [jax.numpy.concatenate(
+        [tmpl[i % 2],
+         jax.random.randint(jax.random.PRNGKey(40 + i), (1 + i % 2,),
+                            0, 64)]) for i in range(6)]
+    engine = make_serve_engine(params, cfg, max_len=16, kv_block=4,
+                               share_prefix=True, prefix_keep_blocks=0,
+                               host_spill=True, telemetry=reg)
+    engine(prompts, 4, slots=1)
+    sp = engine.last_stats["prefix"]["spill"]
+    assert sp["spilled_blocks"] > 0 and sp["swapins"] > 0
+    assert reg.gauge("prefix_spilled_blocks").value \
+        == sp["spilled_blocks"]
+    assert reg.gauge("prefix_swapin_ms").value == sp["swap_ms"] >= 0
+    assert reg.gauge("prefix_host_hit_frac").value \
+        == sp["host_hit_frac"] > 0
+    prom = reg.prometheus_text()
+    for line in ("# TYPE prefix_spilled_blocks gauge",
+                 "# TYPE prefix_swapin_ms gauge",
+                 "# TYPE prefix_host_hit_frac gauge"):
+        assert line in prom, line

@@ -994,3 +994,100 @@ def test_serving_no_headroom_silent_on_training_and_on_demand(tmp_path):
     non_tpu = (_SERVE_POOL % ("serve-pool", "", "")).replace(
         "ct5lp-hightpu-4t", "n2-standard-8")
     assert _lint_headroom(_write(tmp_path, non_tpu)) == []
+
+
+# ------------------------------------------------ tiered-KV host sizing
+# (`tpu-serving-no-host-ram`: a serving pool that wires the host-spill
+# KV tier onto a family-minimum host-RAM machine has nothing to spill
+# into — the sizing twin of the failover-headroom rule above)
+
+_SPILL_POOL = """
+variable "%s" {
+  type    = bool
+  default = true
+}
+
+resource "google_container_cluster" "c" {
+  name = "c"
+}
+
+resource "google_container_node_pool" "pool_a" {
+  name    = "%s"
+  cluster = google_container_cluster.c.name
+
+  node_config {
+    machine_type = "%s"
+%s  }
+}
+"""
+
+
+def _lint_host_ram(path):
+    from nvidia_terraform_modules_tpu.tfsim.lint import run_lint
+
+    return [f for f in run_lint(path)
+            if f.rule == "tpu-serving-no-host-ram"]
+
+
+def test_serving_no_host_ram_fires_on_floor_machine(tmp_path):
+    """Serving-named pool on the 48 GB v5e floor machine with a
+    host_spill variable in the module API — the exact mis-sizing the
+    rule exists for, with the remedy and the runbook in the message."""
+    body = _SPILL_POOL % ("host_spill", "serve-v5e",
+                          "ct5lp-hightpu-1t", "")
+    findings = _lint_host_ram(_write(tmp_path, body))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.severity == "warning"
+    assert "48 GB" in f.message and "family" in f.message
+    assert 'variable "host_spill"' in f.message
+    assert "tpu-spot-serving-no-headroom" in f.message
+    assert "prefix_swapin_ms" in f.message
+
+
+def test_serving_no_host_ram_fires_via_env_and_labels(tmp_path):
+    """The wiring can be a pod env var and the serving shape a node
+    label — both are how a real deployment carries the knob; v6e's
+    44 GB floor machine is flagged the same way."""
+    body = (_SPILL_POOL % ("other", "pool-a", "ct6e-standard-1t",
+                           "    labels = { role = \"inference\" }\n")
+            ) + """
+resource "kubernetes_deployment" "srv" {
+  spec {
+    template {
+      spec {
+        container {
+          image = "serve:latest"
+          env {
+            name  = "KV_HOST_BLOCKS"
+            value = "4096"
+          }
+        }
+      }
+    }
+  }
+}
+"""
+    findings = _lint_host_ram(_write(tmp_path, body))
+    assert len(findings) == 1
+    assert "44 GB" in findings[0].message
+    assert 'env "KV_HOST_BLOCKS"' in findings[0].message
+
+
+def test_serving_no_host_ram_silent_without_wiring_or_floor(tmp_path):
+    """All three legs must hold: no host-spill wiring → silent (the
+    machine is merely small); a 4t machine (192 GB) → silent (real
+    host RAM to spill into); training-shaped → silent (no prefix
+    index to spill); v4's single-class 407 GB host → silent (nothing
+    bigger in the family to move to)."""
+    no_wiring = _SPILL_POOL % ("flag", "serve-v5e",
+                               "ct5lp-hightpu-1t", "")
+    assert _lint_host_ram(_write(tmp_path, no_wiring)) == []
+    big_host = _SPILL_POOL % ("host_spill", "serve-v5e",
+                              "ct5lp-hightpu-4t", "")
+    assert _lint_host_ram(_write(tmp_path, big_host)) == []
+    training = _SPILL_POOL % ("host_spill", "train-v5e",
+                              "ct5lp-hightpu-1t", "")
+    assert _lint_host_ram(_write(tmp_path, training)) == []
+    v4 = _SPILL_POOL % ("host_spill", "serve-v4", "ct4p-hightpu-4t", "")
+    assert _lint_host_ram(_write(tmp_path, v4)) == []

@@ -137,6 +137,34 @@ def test_shared_prefix_prompts_zipf_pool_shape_and_determinism():
         shared_prefix_prompts(3, zipf_s=0.0)
 
 
+def test_shared_prefix_prompts_working_set_blocks_knob():
+    """The tiered-KV sizing knob: working_set_blocks derives the
+    smallest template pool whose FULL-BLOCK footprint reaches the
+    target, so a bench can provably overflow prefix_keep_blocks; the
+    derived pool is deterministic and the derivation is exact."""
+    # 8-token templates at block_size=4 → 2 full blocks each; a
+    # 7-block working set needs ceil(7/2) = 4 templates
+    pairs = shared_prefix_prompts(300, seed=5, template_len=8,
+                                  suffix_lo=1, suffix_hi=3, vocab=32,
+                                  working_set_blocks=7, block_size=4)
+    tids = {tid for tid, _p in pairs}
+    assert tids == {0, 1, 2, 3}
+    footprint = len(tids) * (8 // 4)
+    assert footprint >= 7
+    # explicit n_templates is overridden by the derivation — the knob
+    # names the working set, not the pool
+    assert pairs == shared_prefix_prompts(
+        300, seed=5, n_templates=99, template_len=8, suffix_lo=1,
+        suffix_hi=3, vocab=32, working_set_blocks=7, block_size=4)
+    with pytest.raises(ValueError, match="working_set_blocks"):
+        shared_prefix_prompts(3, working_set_blocks=0)
+    with pytest.raises(ValueError, match="block_size"):
+        shared_prefix_prompts(3, working_set_blocks=4, block_size=0)
+    with pytest.raises(ValueError, match="FULL"):
+        shared_prefix_prompts(3, working_set_blocks=4, template_len=3,
+                              block_size=4)
+
+
 def test_shared_prefix_prompts_survive_hash_randomisation():
     """Cross-process determinism under a different PYTHONHASHSEED —
     the same property the arrival traces pin, so a bench child and a
@@ -144,7 +172,10 @@ def test_shared_prefix_prompts_survive_hash_randomisation():
     code = ("from nvidia_terraform_modules_tpu.utils.traffic import "
             "shared_prefix_prompts\n"
             "print(repr(shared_prefix_prompts(6, seed=3, n_templates=2,"
-            " template_len=4, suffix_lo=1, suffix_hi=3, vocab=16)))\n")
+            " template_len=4, suffix_lo=1, suffix_hi=3, vocab=16)))\n"
+            "print(repr(shared_prefix_prompts(6, seed=3,"
+            " template_len=8, suffix_lo=1, suffix_hi=3, vocab=16,"
+            " working_set_blocks=5, block_size=4)))\n")
     outs = []
     for hashseed in ("0", "4242"):
         p = subprocess.run(
@@ -157,6 +188,9 @@ def test_shared_prefix_prompts_survive_hash_randomisation():
     assert repr(shared_prefix_prompts(
         6, seed=3, n_templates=2, template_len=4, suffix_lo=1,
         suffix_hi=3, vocab=16)) in outs[0]
+    assert repr(shared_prefix_prompts(
+        6, seed=3, template_len=8, suffix_lo=1, suffix_hi=3, vocab=16,
+        working_set_blocks=5, block_size=4)) in outs[0]
 
 
 def test_slo_deadlines_work_proportional_and_deterministic():
