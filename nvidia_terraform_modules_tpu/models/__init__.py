@@ -34,6 +34,7 @@ from .paging import (  # noqa: F401
     PrefixIndex,
     blocks_for_rows,
     chain_chunks,
+    chain_key,
     export_block_rows,
     import_block_rows,
     init_paged_cache,
@@ -45,11 +46,12 @@ from .serving import (  # noqa: F401
     make_serve_engine,
     serve,
 )
-from .fleet import make_fleet  # noqa: F401
+from .fleet import AutoscalePolicy, make_fleet  # noqa: F401
 from .hostkv import (  # noqa: F401
     HostBlockPool,
     HostSpillCorruptError,
     IndexSpill,
+    WarmChainStore,
 )
 from .speculative import (  # noqa: F401
     make_speculative_decoder,

@@ -111,6 +111,30 @@ seam (never through private state):
   solo-greedy-bit-exact tokens, nothing is lost or duplicated, and
   the shed set replays exactly.
 
+- **The elastic plane** (``autoscale=``, defaults OFF — ISSUE 15).
+  The fleet's SIZE becomes a runtime variable: a string-seeded
+  :class:`AutoscalePolicy` (min/max replica bounds mirroring the
+  gke-tpu node-pool autoscaling variables, queue-depth and
+  deadline-slack triggers, a cooldown) is evaluated on the routing
+  plan's virtual clock, emitting a deterministic scale schedule
+  executed at monitor-poll boundaries exactly like fault kills. A
+  scale-UP is a WARM JOIN: the joiner's engine spawns under
+  ``utils/retry`` backoff (a spawn failing every attempt is
+  classified dead — its planned requests redrive), enters the ring
+  (add symmetry — only its own keyspace moves back), and with the
+  tiered prefix index armed inherits its keyspace share of the
+  fleet-shared :class:`~.hostkv.WarmChainStore` host-side
+  (``PrefixIndex.seed_host``; the first matching admission swaps in
+  through the ordinary crc-verified tiered path). A scale-DOWN
+  reuses the planned-drain machinery, and the drained replica
+  PUBLISHES its retained chains into the store for successors
+  (``PrefixIndex.export_chains`` — read-only against eviction
+  accounting, so a drain can never double-bill ``spill_dropped``).
+  Faults COMPOSE with scaling (kill-during-bring-up,
+  drain-racing-kill, churn storms — all bit-exact), and a policy
+  that emits no events reproduces the fixed-size fleet byte for
+  byte (``tests/test_fleet_scale.py``; smoketest ``fleet_scale_ok``).
+
 Exactness contract (the house gate, pinned in ``tests/test_fleet.py``):
 the router is SCHEDULING, never a different model. A 1-replica fleet
 bit-matches the bare engine per request; N-replica greedy outputs
@@ -123,7 +147,10 @@ spans into, so router and engine stitch on one Chrome-trace timeline;
 ``fleet_shed_total``/``fleet_steal_total`` counters, and the fault
 plane's ``fleet_replica_down``/``fleet_redrive_total``/
 ``fleet_circuit_open_total`` counters plus a ``fleet_degraded`` span
-covering every interval the fleet ran below nominal capacity.
+covering every interval the fleet ran below nominal capacity; the
+elastic plane adds a ``fleet_size`` gauge,
+``fleet_scale_up_total``/``fleet_scale_down_total`` counters and one
+``fleet_scale`` span per executed event (trigger + replica + warm).
 
 Reference analogue: none — the reference provisions the node pools a
 fleet like this runs on (SURVEY §2.6); this is the router those
@@ -229,6 +256,86 @@ class HashRing:
         return self._targets[i]
 
 
+# --------------------------------------------------------- elastic plane
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalePolicy:
+    """The fleet's deterministic autoscaler: a string-seeded,
+    virtual-clock scale policy mirroring the reference module's
+    node-pool autoscaling variables (``min_node_count`` /
+    ``max_node_count`` on the gke-tpu slice pools — the knobs the
+    ``tpu-serving-autoscaler-unused`` lint rule checks are actually
+    consumed; this is the runtime that consumes them).
+
+    The policy is evaluated on the ROUTER's deterministic virtual clock
+    inside the routing plan — at every arrival (the plan's admission
+    tick, taken AFTER the arrival lands: the arrival is load too, so
+    an idle fleet at t=0 never scales below a burst already in the
+    door) it compares the mean per-replica backlog (queued-but-
+    unfinished virtual jobs, the same backlog the
+    ``affinity_queue_bound`` override reads) against the two
+    thresholds:
+
+    - ``up_backlog``: mean backlog at or above this (and live count
+      below ``max_replicas``) joins a NEW replica — trigger
+      ``"backlog"``. With deadlines armed, an arrival that would be
+      SHED on the surviving capacity also scales up first when
+      ``deadline_slack`` is on and head-room remains — trigger
+      ``"deadline_slack"`` (capacity is cheaper than a blown SLO).
+    - ``down_backlog``: mean backlog at or below this (and live count
+      above ``min_replicas``) DRAINS the least-loaded live replica —
+      trigger ``"low_load"``; ties draw from the policy's seeded
+      stream (one draw per down event, spec-order discipline like
+      ``FleetFaultProfile``).
+
+    ``cooldown_s`` (virtual seconds) spaces events so a noisy trace
+    cannot thrash the ring. Because the schedule is a pure function of
+    (policy, seed, trace, ``est_token_s``, fault capacity schedule),
+    identical inputs emit identical scale events — the determinism
+    gate ``tests/test_fleet_scale.py`` pins — and the events execute at
+    admission-poll boundaries exactly like ``FleetFaultProfile`` kills:
+    an UP spawns a warm replica at the first monitor poll past its
+    timestamp, a DOWN reuses the planned-drain machinery
+    (``AdmissionSource.draining()``)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    up_backlog: float = 3.0
+    down_backlog: float = 0.5
+    cooldown_s: float = 0.05
+    deadline_slack: bool = True
+    seed: str | int = 0
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {self.min_replicas}")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"max_replicas ({self.max_replicas}) must be >= "
+                f"min_replicas ({self.min_replicas})")
+        if self.up_backlog <= self.down_backlog:
+            raise ValueError(
+                f"up_backlog ({self.up_backlog}) must exceed "
+                f"down_backlog ({self.down_backlog}) — equal or "
+                f"inverted thresholds oscillate")
+        if self.down_backlog < 0:
+            raise ValueError(
+                f"down_backlog must be >= 0, got {self.down_backlog}")
+        if self.cooldown_s < 0:
+            raise ValueError(
+                f"cooldown_s must be >= 0, got {self.cooldown_s}")
+
+
+# a joining replica's spawn (engine build + thread start) retried with
+# backoff: a transient build failure must cost a retry, never the ring
+# its joiner; a spawn that fails every attempt is a real failure — the
+# target is classified dead and its planned requests redrive
+_SPAWN_RETRY = RetryPolicy(initial_s=0.002, multiplier=2.0,
+                           cap_s=0.05, max_attempts=3, jitter=False)
+
+
 # ------------------------------------------------------------ fault plane
 
 
@@ -326,12 +433,22 @@ class FleetFaultProfile:
         self.faults = faults
         self.seed = str(seed)
 
-    def resolve(self, n_dec: int, n_pre: int) -> dict:
+    def resolve(self, n_dec: int, n_pre: int, *,
+                elastic_dec: bool = False) -> dict:
         """Draw seeded targets and validate against the fleet shape.
         Returns the concrete schedule the router wires into queues:
         ``kills_dec``/``drains_dec``/``kills_pre``/``drains_pre``
         (target → at_s), ``slow_dec`` (target → (at_s, stall_s,
-        waves)) and ``corrupt`` (prefill target → nth handoff)."""
+        waves)) and ``corrupt`` (prefill target → nth handoff).
+
+        ``elastic_dec`` (the autoscaled fleet): decode-side EXPLICIT
+        targets may name replicas beyond ``n_dec`` — scale-up joiners
+        whose ids only exist once the routing plan realises the scale
+        schedule (a kill aimed at a joiner is the kill-during-bring-up
+        case) — so their upper bound and the all-replicas-removed check
+        are deferred to the per-call validation against the realised
+        fleet; seeded draws still come from the BASE range, keeping the
+        stream independent of the trace."""
         rnd = random.Random(f"fleet-fault-{self.seed}")
         out: dict[str, dict] = {
             "kills_dec": {}, "drains_dec": {},
@@ -351,7 +468,7 @@ class FleetFaultProfile:
                     f"faults[{i}] ({f.kind}) needs disaggregate=True "
                     f"(there are no prefill workers to target)")
             t = f.target if f.target is not None else drawn
-            if t >= pool:
+            if t >= pool and not (elastic_dec and not pre_side):
                 raise ValueError(
                     f"faults[{i}] ({f.kind}) targets replica {t} but "
                     f"the role has only {pool}")
@@ -380,7 +497,7 @@ class FleetFaultProfile:
                         f"to die/drain")
                 out[key][t] = f.at_s
         gone_dec = set(out["kills_dec"]) | set(out["drains_dec"])
-        if gone_dec and len(gone_dec) >= n_dec:
+        if gone_dec and len(gone_dec) >= n_dec and not elastic_dec:
             raise ValueError(
                 f"the fault schedule removes all {n_dec} decode "
                 f"replica(s) — the fleet must keep >= 1 survivor to "
@@ -442,8 +559,14 @@ class _FleetQueue(AdmissionSource):
 
     def __init__(self, t0: float, poll_s: float, on_retire, *,
                  label: str = "", kill_at: float | None = None,
-                 stall: tuple | None = None):
+                 stall: tuple | None = None, sink=None):
         self._lock = threading.Lock()
+        # elastic-fleet seams: warm bring-up chains the router primes
+        # before the spawn (consumed once by the engine's run start)
+        # and the fleet-shared drain sink retained chains publish into
+        # at close (see AdmissionSource.warm_chains / chain_sink)
+        self._warm: list | None = None
+        self._sink = sink
         self._pending: list[int] = []            # arrival-ascending
         self._arrival: dict[int, float] = {}
         self._payload: dict[int, Any] = {}
@@ -653,6 +776,20 @@ class _FleetQueue(AdmissionSource):
     def kv_import(self, req):
         return self._payload.get(req)
 
+    def set_warm(self, chains) -> None:
+        with self._lock:
+            self._warm = chains
+
+    def warm_chains(self):
+        """One-shot: the engine consumes the primed bring-up chains at
+        run start (a second run through the same queue starts cold)."""
+        with self._lock:
+            warm, self._warm = self._warm, None
+            return warm
+
+    def chain_sink(self):
+        return self._sink
+
     def retired(self, req, tokens: int) -> None:
         with self._lock:
             self._payload.pop(req, None)
@@ -684,6 +821,9 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                faults: FleetFaultProfile | None = None,
                health_timeout_s: float = 0.25,
                quarantine_polls: int = 16,
+               autoscale: AutoscalePolicy | None = None,
+               warm_join: bool = True,
+               warm_blocks: int | None = None,
                **engine_kw):
     """Build the fleet: ``replicas`` serve engines behind the router.
 
@@ -741,6 +881,34 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
     quarantines a flapping replica for ``quarantine_polls`` monitor
     polls after its poll-stamp goes staler than ``health_timeout_s``.
 
+    ``autoscale`` arms the ELASTIC CONTROL LOOP (colocated fleets; a
+    :class:`AutoscalePolicy`, requires ``est_token_s``): the routing
+    plan evaluates the policy on its deterministic virtual clock and
+    emits a seeded scale schedule — ``replicas`` becomes the INITIAL
+    size, bounded by the policy's ``min_replicas``/``max_replicas``
+    (the gke-tpu node-pool autoscaling variables' runtime twin). A
+    scale-UP is a WARM JOIN executed at the next monitor poll past its
+    timestamp: the replica's engine is spawned (``utils/retry`` backoff
+    — a spawn that fails every attempt classifies the target dead and
+    its planned requests redrive), the target joins the
+    :class:`HashRing` (add symmetry: only its own keyspace moves back),
+    and — when the engines run ``share_prefix`` + ``host_spill`` and
+    ``warm_join`` is on — bring-up seeds the joiner's HOST tier with
+    its keyspace share of the fleet-shared
+    :class:`~.hostkv.WarmChainStore` (``warm_blocks`` rows, default
+    ``max(4·prefix_keep_blocks, 64)``), so the Zipf-head working set is
+    inherited instead of re-prefilled; the first matching admission
+    swaps each chain in through the ordinary crc-verified tiered path.
+    A scale-DOWN reuses the planned-drain machinery
+    (``AdmissionSource.draining()``): in-flight work finishes, queued
+    work moves, and the drained replica PUBLISHES its retained chains
+    into the store for successors. Faults compose: kills and drains
+    fold into the same capacity schedule the plan degrades against
+    (kill-during-bring-up, drain-racing-kill and ``fault_times``-driven
+    churn storms all complete every non-shed request bit-exactly —
+    ``tests/test_fleet_scale.py``), and a policy that emits no events
+    reproduces the fixed-size fleet byte for byte.
+
     ``**engine_kw`` passes through to every ``make_serve_engine``
     (``kv_block``, ``share_prefix``, ``cache_dtype``, ``lazy_growth``,
     ``paged_kernel``, ``sampler``, …). Note an engine driven through an
@@ -769,6 +937,30 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
     if faults is not None and not isinstance(faults, FleetFaultProfile):
         raise ValueError(
             f"faults must be a FleetFaultProfile, got {type(faults)}")
+    if autoscale is not None:
+        if not isinstance(autoscale, AutoscalePolicy):
+            raise ValueError(
+                f"autoscale must be an AutoscalePolicy, got "
+                f"{type(autoscale)}")
+        if disaggregate:
+            raise ValueError(
+                "autoscale applies to colocated fleets — the elastic "
+                "ring is the decode ring; run disaggregated pools at "
+                "fixed size (scale the colocated fleet instead)")
+        if est_token_s is None:
+            raise ValueError(
+                "autoscale needs est_token_s — the policy's virtual "
+                "clock predicts backlog as est_token_s × budget, "
+                "exactly like SLO shedding")
+        if not (autoscale.min_replicas <= replicas
+                <= autoscale.max_replicas):
+            raise ValueError(
+                f"replicas ({replicas}) must start inside the "
+                f"autoscale bounds [{autoscale.min_replicas}, "
+                f"{autoscale.max_replicas}]")
+    if warm_blocks is not None and warm_blocks < 1:
+        raise ValueError(
+            f"warm_blocks must be >= 1, got {warm_blocks}")
     if disaggregate:
         if replicas < 2:
             raise ValueError(
@@ -806,29 +998,55 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
     kv_block = engine_kw.get("kv_block", 16)
     n_pre = prefill_workers if disaggregate else 0
     n_dec = replicas - n_pre
-    resolved = faults.resolve(n_dec, n_pre) if faults is not None \
-        else None
-    # the capacity schedule the PLAN's virtual clock degrades against:
-    # kills and drains of the ROUTING-side targets (prefill workers
-    # when disaggregated, decode replicas otherwise), time-ordered
-    if resolved is not None:
+    scale_on = autoscale is not None
+    # fault resolution: a FIXED-size fleet validates at build time (the
+    # shape is known); an elastic fleet defers to call time — explicit
+    # targets may name scale-up joiners whose ids only exist once the
+    # routing plan realises the scale schedule for a given trace
+    resolved = (faults.resolve(n_dec, n_pre)
+                if faults is not None and not scale_on else None)
+
+    def _route_events(res):
+        """The capacity schedule the PLAN's virtual clock degrades
+        against: kills and drains of the ROUTING-side targets (prefill
+        workers when disaggregated, decode replicas otherwise),
+        time-ordered."""
+        if res is None:
+            return []
         side = ("pre" if disaggregate else "dec")
-        route_events = sorted(
+        return sorted(
             [(ts, t, "kill")
-             for t, ts in resolved[f"kills_{side}"].items()]
+             for t, ts in res[f"kills_{side}"].items()]
             + [(ts, t, "drain")
-               for t, ts in resolved[f"drains_{side}"].items()])
-    else:
-        route_events = []
+               for t, ts in res[f"drains_{side}"].items()])
     # every engine shares the fleet's registry so router + engine spans
     # stitch on one timeline; engines are separate objects on purpose —
-    # separate pools, separate step caches, no cross-thread state
+    # separate pools, separate step caches, no cross-thread state.
+    # dec_engines holds the BASE replicas; scale-up joiners append at
+    # spawn time (built once, reused across calls)
     dec_engines = [make_serve_engine(params, cfg, max_len=max_len,
                                      telemetry=reg, **engine_kw)
                    for _ in range(n_dec)]
     pre_engines = [make_serve_engine(params, cfg, max_len=max_len,
                                      telemetry=reg, **engine_kw)
                    for _ in range(n_pre)]
+    # the fleet-shared warm store (state-migration transport): replicas
+    # publish retained prefix chains at close/drain, scale-up joiners
+    # take their keyspace share at bring-up. Persistent across calls —
+    # the working set outlives any one trace. Only meaningful when the
+    # engines run the tiered prefix index under affinity routing.
+    warm_store = None
+    warm_on = (scale_on and warm_join and routing == "affinity"
+               and bool(engine_kw.get("share_prefix"))
+               and bool(engine_kw.get("host_spill")))
+    if warm_on:
+        from .hostkv import WarmChainStore
+
+        wb = (warm_blocks if warm_blocks is not None
+              else max(4 * engine_kw.get("prefix_keep_blocks", 64), 64))
+        warm_store = WarmChainStore(
+            cfg, wb, block_size=kv_block,
+            cache_dtype=engine_kw.get("cache_dtype", "bf16"))
     if reg.enabled:
         _g_depth = reg.gauge("fleet_queue_depth")
         _g_hitf = reg.gauge("fleet_affinity_hit_frac")
@@ -837,33 +1055,50 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
         _c_down = reg.counter("fleet_replica_down")
         _c_redrive = reg.counter("fleet_redrive_total")
         _c_circuit = reg.counter("fleet_circuit_open_total")
+        _g_size = reg.gauge("fleet_size")
+        _c_scale_up = reg.counter("fleet_scale_up_total")
+        _c_scale_down = reg.counter("fleet_scale_down_total")
 
-    def _plan(prompts, budgets, arrivals, deadlines):
-        """Deterministic routing + shed plan — a pure function of the
-        trace (prompt tokens, arrivals, budgets, deadlines), the route
-        seed AND the fault profile's capacity schedule, so shed
-        fractions and placements replay exactly. The virtual clock
-        models each TARGET as a serial server at ``est_token_s`` per
-        budgeted token: coarse on purpose — it is admission control
-        (shed what cannot possibly meet its deadline), not a
-        simulator; work stealing repairs what the model mispredicts.
-        Under a fault schedule the clock DEGRADES: a killed target
-        takes no arrivals past its death and its unfinished virtual
-        work re-places on the least-loaded survivor at the kill time
-        (service restarts — the partial decode dies with the replica;
-        a drain keeps what it already started and moves only the
-        still-queued), with deadlines re-checked against the
-        surviving capacity."""
-        n_targets = n_pre if disaggregate else n_dec
+    def _plan(prompts, budgets, arrivals, deadlines, route_events):
+        """Deterministic routing + shed + SCALE plan — a pure function
+        of the trace (prompt tokens, arrivals, budgets, deadlines),
+        the route seed, the fault profile's capacity schedule AND the
+        autoscale policy, so shed fractions, placements and scale
+        events replay exactly. The virtual clock models each TARGET as
+        a serial server at ``est_token_s`` per budgeted token: coarse
+        on purpose — it is admission control (shed what cannot
+        possibly meet its deadline), not a simulator; work stealing
+        repairs what the model mispredicts. Under a fault schedule the
+        clock DEGRADES: a killed target takes no arrivals past its
+        death and its unfinished virtual work re-places on the
+        least-loaded survivor at the kill time (service restarts — the
+        partial decode dies with the replica; a drain keeps what it
+        already started and moves only the still-queued), with
+        deadlines re-checked against the surviving capacity. Under an
+        autoscale policy the clock also GROWS: every arrival is a
+        policy tick (see :class:`AutoscalePolicy`) that may join a
+        fresh target (ids are incarnation-unique — a drained id never
+        reuses, so ``max_replicas`` bounds CONCURRENT capacity) or
+        drain the least-loaded one; faults compose — a kill shrinks
+        live capacity and the very next tick may scale back up (the
+        preemption-churn loop), and a fault aimed at a not-yet-joined
+        target defers to its join (kill-during-bring-up)."""
+        n0 = n_pre if disaggregate else n_dec
         rnd = random.Random(f"fleet-route-{route_seed}")
-        ring_plan = HashRing(n_targets)
-        busy_until = [0.0] * n_targets
-        finishes: list[list[float]] = [[] for _ in range(n_targets)]
-        live_jobs: list[list[list]] = [[] for _ in range(n_targets)]
+        ring_plan = HashRing(n0)
+        busy_until = [0.0] * n0
+        finishes: list[list[float]] = [[] for _ in range(n0)]
+        live_jobs: list[list[list]] = [[] for _ in range(n0)]
+        live: set[int] = set(range(n0))
         placed: dict[int, tuple[int, bool]] = {}
         shed: list[int] = []
         dead_plan: set[int] = set()
-        ev = list(route_events)
+        ev = sorted(route_events)
+        pending_ev: dict[int, list[tuple[float, str]]] = {}
+        scale_events: list[dict] = []
+        last_scale = [float("-inf")]
+        rnd_scale = (random.Random(f"fleet-scale-{autoscale.seed}")
+                     if scale_on else None)
 
         def arr(req):
             return arrivals[req] if arrivals is not None else 0.0
@@ -872,14 +1107,21 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
             return (est_token_s or 0.0) * budgets[req]
 
         def least_loaded(ready):
-            return min((j for j in range(n_targets)
-                        if j not in dead_plan),
+            if not live:
+                raise ValueError(
+                    "the capacity schedule removed every live replica "
+                    "mid-trace — keep >= 1 survivor (or raise "
+                    "max_replicas so the policy can rejoin)")
+            return min((j for j in live),
                        key=lambda j: (max(busy_until[j], ready), j))
 
+        def backlog(j, now):
+            return sum(1 for f in finishes[j] if f > now)
+
         def replace(req, ready):
-            # a fault victim re-places on the least-loaded survivor at
-            # the fault time; the deadline re-check against SURVIVING
-            # capacity is the degraded-mode shed recompute
+            # a fault/drain victim re-places on the least-loaded
+            # survivor at the event time; the deadline re-check against
+            # SURVIVING capacity is the degraded-mode shed recompute
             t = least_loaded(ready)
             start = max(arr(req), ready, busy_until[t])
             finish = start + svc(req)
@@ -892,49 +1134,135 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
             finishes[t].append(finish)
             live_jobs[t].append([req, start, finish])
 
+        def take_down(t, ts, kind):
+            """A target leaves (kill / fault drain / scale-down): a
+            kill loses even started work (it restarts on survivors), a
+            drain keeps what it started and moves only the queued."""
+            if t in dead_plan:
+                return
+            dead_plan.add(t)
+            live.discard(t)
+            if t in ring_plan.targets() \
+                    and len(ring_plan.targets()) > 1:
+                ring_plan.remove(t)
+            victims = [j for j in live_jobs[t]
+                       if (j[2] > ts if kind == "kill"
+                           else j[1] > ts)]
+            live_jobs[t] = []
+            for req, _s, _f in sorted(victims,
+                                      key=lambda j: (j[1], j[0])):
+                replace(req, ts)
+
         def advance(now):
             while ev and ev[0][0] <= now:
                 ts, t, kind = ev.pop(0)
-                if t in dead_plan:
+                if t >= len(busy_until):
+                    # a fault aimed at a scale-up joiner that has not
+                    # joined yet: defer to its join (the kill-during-
+                    # bring-up case)
+                    pending_ev.setdefault(t, []).append((ts, kind))
                     continue
-                dead_plan.add(t)
-                ring_plan.remove(t)
-                victims = [j for j in live_jobs[t]
-                           if (j[2] > ts if kind == "kill"
-                               else j[1] > ts)]
-                live_jobs[t] = []
-                for req, _s, _f in sorted(victims,
-                                          key=lambda j: (j[1], j[0])):
-                    replace(req, ts)
+                take_down(t, ts, kind)
+
+        def join(a, trigger):
+            t = len(busy_until)
+            busy_until.append(0.0)
+            finishes.append([])
+            live_jobs.append([])
+            live.add(t)
+            ring_plan.add(t)
+            scale_events.append({"ts": a, "kind": "up", "target": t,
+                                 "trigger": trigger})
+            last_scale[0] = a
+            for ts, kind in sorted(pending_ev.pop(t, [])):
+                if ts <= a:
+                    take_down(t, a, kind)    # dies during bring-up
+                else:
+                    bisect.insort(ev, (ts, t, kind))
+            return t
+
+        def can_up(a):
+            return (scale_on and len(live) < autoscale.max_replicas
+                    and a - last_scale[0] >= autoscale.cooldown_s)
+
+        def eval_policy(a):
+            """One policy tick per arrival (the plan's admission-poll
+            boundary): queue-depth thresholds against the mean
+            per-live-target virtual backlog."""
+            if not scale_on \
+                    or a - last_scale[0] < autoscale.cooldown_s \
+                    or not live:
+                return
+            # one backlog scan per tick, reused by mean/min/ties
+            b = {j: backlog(j, a) for j in live}
+            nlive = len(b)
+            mean_b = sum(b.values()) / nlive
+            if nlive < autoscale.max_replicas \
+                    and mean_b >= autoscale.up_backlog:
+                join(a, "backlog")
+            elif nlive > autoscale.min_replicas \
+                    and mean_b <= autoscale.down_backlog:
+                # drain the least-loaded live target; ties draw from
+                # the policy's seeded stream (one draw per down event)
+                min_b = min(b.values())
+                ties = sorted(j for j in b if b[j] == min_b)
+                t = ties[rnd_scale.randrange(len(ties))]
+                take_down(t, a, "drain")
+                scale_events.append({"ts": a, "kind": "down",
+                                     "target": t,
+                                     "trigger": "low_load"})
+                last_scale[0] = a
 
         for req in range(len(prompts)):
             a = arr(req)
             advance(a)
+            aff_ok = routing == "affinity"
             if routing == "affinity":
                 t_aff = ring_plan.target(
                     affinity_key(prompts[req], kv_block))
-            else:
-                t_aff = rnd.randrange(n_targets)
-                if t_aff in dead_plan:
+                if t_aff not in live:
+                    # elastic churn can leave the ring's LAST entry a
+                    # dead target (a ring never empties) — the plan
+                    # falls back least-loaded, billed as non-affinity
                     t_aff = least_loaded(a)
-            t, by_aff = t_aff, routing == "affinity"
+                    aff_ok = False
+            else:
+                t_aff = rnd.randrange(len(busy_until))
+                if t_aff not in live:
+                    t_aff = least_loaded(a)
+            t, by_aff = t_aff, aff_ok
             if affinity_queue_bound is not None:
-                backlog = sum(1 for f in finishes[t_aff] if f > a)
-                if backlog >= affinity_queue_bound:
+                backlog_t = sum(1 for f in finishes[t_aff] if f > a)
+                if backlog_t >= affinity_queue_bound:
                     t = least_loaded(a)
                     by_aff = by_aff and t == t_aff
             start = max(a, busy_until[t])
             finish = start + svc(req)
             if deadlines is not None and finish - a > deadlines[req]:
-                shed.append(req)
-                continue
+                if scale_on and autoscale.deadline_slack and can_up(a):
+                    # deadline-slack trigger: capacity is cheaper than
+                    # a blown SLO — join first, re-place on the
+                    # least-loaded survivor, and shed only if even
+                    # fresh capacity cannot make the deadline
+                    join(a, "deadline_slack")
+                    t, by_aff = least_loaded(a), False
+                    start = max(a, busy_until[t])
+                    finish = start + svc(req)
+                if finish - a > deadlines[req]:
+                    shed.append(req)
+                    eval_policy(a)
+                    continue
             busy_until[t] = finish
             finishes[t].append(finish)
             live_jobs[t].append([req, start, finish])
             placed[req] = (t, by_aff)
+            # the policy ticks AFTER the arrival lands — the arrival
+            # is load too, so an empty fleet at t=0 never scales down
+            # below a burst that is already in the door
+            eval_policy(a)
         advance(float("inf"))
         plan = [(req, *placed[req]) for req in sorted(placed)]
-        return plan, sorted(shed)
+        return plan, sorted(shed), scale_events, len(busy_until)
 
     def fleet(prompts: Sequence[Any], n_new, *, slots: int = 4,
               eos_id: int | None = None, rng=None, arrivals=None,
@@ -967,9 +1295,40 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                     "service per budgeted token) — calibrate it from "
                     "a measured run of this config")
 
-        plan, shed = _plan(prompts, budgets, arrivals, deadlines)
+        # elastic fleets resolve faults per call (explicit targets may
+        # name joiners the plan realises below); fixed fleets reuse the
+        # build-time resolution byte for byte
+        resolved_call = (faults.resolve(n_dec, n_pre, elastic_dec=True)
+                         if scale_on and faults is not None
+                         else resolved)
+        plan, shed, scale_events, n_total = _plan(
+            prompts, budgets, arrivals, deadlines,
+            _route_events(resolved_call))
+        if scale_on and resolved_call is not None:
+            targeted = (set(resolved_call["kills_dec"])
+                        | set(resolved_call["drains_dec"])
+                        | set(resolved_call["slow_dec"]))
+            bad = sorted(t for t in targeted if t >= n_total)
+            if bad:
+                raise ValueError(
+                    f"fault schedule targets decode replica(s) {bad} "
+                    f"but this call realises only {n_total} (base "
+                    f"{n_dec} + {n_total - n_dec} scale-up joiner(s))")
+            gone = (set(resolved_call["kills_dec"])
+                    | set(resolved_call["drains_dec"]))
+            if gone and len(gone) >= n_total:
+                raise ValueError(
+                    f"the fault schedule removes all {n_total} decode "
+                    f"replica(s) this call realises — the fleet must "
+                    f"keep >= 1 survivor to redrive onto")
+        n_dec_run = n_total if scale_on else n_dec
+        scale_ups = sorted((e for e in scale_events
+                            if e["kind"] == "up"),
+                           key=lambda e: (e["ts"], e["target"]))
+        scale_downs = [e for e in scale_events if e["kind"] == "down"]
         n_planned = len(plan)
-        fault_on = resolved is not None
+        fault_on = resolved_call is not None
+        managed = fault_on or scale_on
         t0 = time.monotonic()
         retire_at: dict[int, float] = {}
         retire_tok: dict[int, int] = {}
@@ -991,18 +1350,22 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
             kill_at = stall = None
             if fault_on:
                 if role == "dec":
-                    kill_at = resolved["kills_dec"].get(i)
-                    stall = resolved["slow_dec"].get(i)
+                    kill_at = resolved_call["kills_dec"].get(i)
+                    stall = resolved_call["slow_dec"].get(i)
                 else:
-                    kill_at = resolved["kills_pre"].get(i)
+                    kill_at = resolved_call["kills_pre"].get(i)
             return _FleetQueue(t0, steal_poll_s, make_on_retire(label),
                                label=label, kill_at=kill_at,
-                               stall=stall)
+                               stall=stall, sink=warm_store)
 
+        # queues exist for EVERY target the plan realises — a scale-up
+        # joiner's planned requests queue from t0 and wait for the
+        # spawn (arming its kill/stall faults at construction keeps the
+        # poll-boundary delivery identical for joiners)
         dec_queues = [q_for("dec", i,
                             f"decode-{i}" if disaggregate
                             else f"replica-{i}")
-                      for i in range(n_dec)]
+                      for i in range(n_dec_run)]
         pre_queues = [q_for("pre", i, f"prefill-{i}")
                       for i in range(n_pre)]
         routed_to: dict[int, str] = {}
@@ -1034,7 +1397,7 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
         # closes everything once every planned request has retired
 
         sessions: list[Any] = [None] * n_pre
-        results: list[Any] = [None] * n_dec
+        results: list[Any] = [None] * n_dec_run
         errors: list[tuple] = []
         stolen = [0]
         handoff_retries = [0]
@@ -1095,7 +1458,7 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                               retryable=(HandoffCorruptError,))
 
         def pre_worker(i):
-            corrupt_nth = (resolved["corrupt"].get(i)
+            corrupt_nth = (resolved_call["corrupt"].get(i)
                            if fault_on else None)
             served = [0]
             try:
@@ -1141,35 +1504,119 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                                         daemon=True,
                                         name=f"fleet-pre-{i}")
                        for i in range(n_pre)]
-        dec_threads = [threading.Thread(target=dec_worker, args=(i,),
-                                        daemon=True,
-                                        name=f"fleet-dec-{i}")
-                       for i in range(n_dec)]
-        for th in pre_threads + dec_threads:
+        # base replicas start NOW; scale-up joiners spawn when the
+        # monitor loop reaches their event timestamp (poll-boundary
+        # execution, like fault kills)
+        dec_threads: list[Any] = \
+            [threading.Thread(target=dec_worker, args=(i,),
+                              daemon=True, name=f"fleet-dec-{i}")
+             for i in range(n_dec)] + [None] * (n_dec_run - n_dec)
+        for th in pre_threads + dec_threads[:n_dec]:
             th.start()
+        spawned: set[int] = set(range(n_dec))
 
-        # ---- the fault-plane recovery runtime (all state router-side;
-        # every structure below stays empty on the fault-free path)
+        # ---- the fault-plane + elastic recovery runtime (all state
+        # router-side; every structure below stays empty on the
+        # fault-free fixed-size path)
         ring_run = (HashRing(n_pre if disaggregate else n_dec)
-                    if fault_on else None)
+                    if managed else None)
         down_seen: set[tuple[str, int]] = set()
         redriven: list[int] = []
         killed_labels: list[str] = []
         drained_labels: list[str] = []
+        scaled_down_labels: list[str] = []
         drain_state: dict[tuple[str, int], str] = {}
-        drain_specs = (
-            [("dec", t, ts)
-             for t, ts in resolved["drains_dec"].items()]
-            + [("pre", t, ts)
-               for t, ts in resolved["drains_pre"].items()]
-        ) if fault_on else []
+        drain_why: dict[tuple[str, int], str] = {}
+        drain_specs = ((
+            [("dec", t, ts, "fault")
+             for t, ts in resolved_call["drains_dec"].items()]
+            + [("pre", t, ts, "fault")
+               for t, ts in resolved_call["drains_pre"].items()]
+        ) if fault_on else []) + \
+            [("dec", e["target"], e["ts"], "scale")
+             for e in scale_downs]
         breaker = LivenessBreaker(
             quarantine_polls,
             on_open=((lambda _key: _c_circuit.inc())
-                     if reg.enabled else None)) if fault_on else None
+                     if reg.enabled else None)) if managed else None
         degraded = [False]
         degraded_clk = [None]
         closed_out = [False]
+        up_idx = [0]
+        live_size = [n_dec]
+        spawn_retries = [0]
+        spawn_failures = [0]
+        warm_joins = [0]
+        cold_joins = [0]
+        warm_chains_primed = [0]
+
+        def _set_size():
+            if reg.enabled and scale_on:
+                _g_size.set(live_size[0])
+
+        def _spawn_dec(ev_):
+            """Execute one scale-UP at a monitor poll boundary: build
+            (or reuse) the joiner's engine under ``utils/retry``
+            backoff, add it to the run ring (add symmetry — only its
+            own keyspace moves back), prime its warm bring-up chains
+            from the fleet store, and start the replica thread. A
+            spawn that fails every attempt classifies the target DEAD
+            — its planned requests redrive to survivors like any
+            replica death, never a hang. The joiner enters the health
+            monitor's breaker like any replica (its compile window is
+            excused via ``work_done``), so a flapping joiner is
+            quarantined as a steal/redrive target instead of
+            thrashing the ring."""
+            i, trigger = ev_["target"], ev_["trigger"]
+            q = dec_queues[i]
+            attempts = [0]
+
+            def build():
+                attempts[0] += 1
+                while len(dec_engines) <= i:
+                    dec_engines.append(None)
+                if dec_engines[i] is None:
+                    dec_engines[i] = make_serve_engine(
+                        params, cfg, max_len=max_len, telemetry=reg,
+                        **engine_kw)
+                return dec_engines[i]
+
+            clk0 = reg.clock() if reg.enabled else None
+            try:
+                retry_call(build, policy=_SPAWN_RETRY,
+                           what=f"{q.label} spawn",
+                           retryable=(Exception,))
+            except Exception:            # noqa: BLE001 — classified
+                spawn_retries[0] += max(attempts[0] - 1, 0)
+                spawn_failures[0] += 1
+                q.dead = True            # _process_downs redrives
+                return
+            spawn_retries[0] += attempts[0] - 1
+            if ring_run is not None and i not in ring_run.targets():
+                ring_run.add(i)
+            chains = (warm_store.take(
+                lambda root: ring_run.target(root) == i)
+                if warm_store is not None else [])
+            if chains:
+                q.set_warm(chains)
+                warm_joins[0] += 1
+                warm_chains_primed[0] += len(chains)
+            else:
+                cold_joins[0] += 1
+            th = threading.Thread(target=dec_worker, args=(i,),
+                                  daemon=True, name=f"fleet-dec-{i}")
+            dec_threads[i] = th
+            th.start()
+            spawned.add(i)
+            live_size[0] += 1
+            if reg.enabled:
+                _c_scale_up.inc()
+                tc = reg.clock()
+                reg.emit_span("fleet_scale",
+                              clk0 if clk0 is not None else tc, tc,
+                              kind="up", replica=q.label,
+                              trigger=trigger, warm=bool(chains))
+            _set_size()
 
         def _mark_degraded():
             degraded[0] = True
@@ -1190,8 +1637,27 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
             back to any live one — a fully-quarantined fleet still
             beats a dropped request)."""
             queues = dec_queues if role == "dec" else pre_queues
-            nn = n_dec if role == "dec" else n_pre
-            cands = [j for j in range(nn) if _avail(role, j)]
+            cands = [j for j in range(len(queues))
+                     if _avail(role, j)
+                     and (role != "dec" or j in spawned)]
+            if not cands and role == "dec":
+                # every spawned replica is down but a joiner's spawn is
+                # still pending: park the redrive on its queue — the
+                # joiner serves it once up (planned placements already
+                # wait there the same way)
+                cands = [j for j in range(len(queues))
+                         if _avail(role, j)]
+            if not cands:
+                # classified, never a bare min()-of-empty: reachable
+                # only when a fault schedule plus spawn failures
+                # removed the last survivor (the per-call validation
+                # counts a PLANNED joiner as a survivor — a joiner
+                # whose spawn then fails every retry was that count)
+                raise RuntimeError(
+                    f"no live {role} replica to redrive onto — every "
+                    f"candidate is dead or draining (the fault "
+                    f"schedule plus failed spawns removed the last "
+                    f"survivor; keep >= 1 spawnable replica)")
             healthy = [j for j in cands if _health_ok(role, j)] or cands
             ring_side = ("pre" if disaggregate else "dec")
             if routing == "affinity" and role == ring_side:
@@ -1241,7 +1707,7 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                     ring_run.remove(i)
 
         def _process_downs():
-            for role, queues, nn in (("dec", dec_queues, n_dec),
+            for role, queues, nn in (("dec", dec_queues, n_dec_run),
                                      ("pre", pre_queues, n_pre)):
                 for i in range(nn):
                     q = queues[i]
@@ -1260,6 +1726,12 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                         continue
                     down_seen.add((role, i))
                     killed_labels.append(q.label)
+                    if role == "dec" and i in spawned:
+                        # the fleet_size gauge is the LIVE count: a
+                        # killed replica leaves it like a drained one
+                        # (a failed spawn never entered it)
+                        live_size[0] -= 1
+                        _set_size()
                     pend, popped = q.take_lost()
                     if role == "pre":
                         # a popped prefill request was already handed
@@ -1287,11 +1759,18 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
         def _process_drains(rel_now):
             if closed_out[0]:
                 return
-            for role, i, at in drain_specs:
+            for role, i, at, why in drain_specs:
                 key = (role, i)
                 q = (dec_queues if role == "dec" else pre_queues)[i]
                 st = drain_state.get(key, "armed")
                 if q.dead:
+                    continue
+                if role == "dec" and i not in spawned:
+                    # drain-racing-kill on a joiner that never made it
+                    # up (spawn failed → dead, handled above) or whose
+                    # spawn is still pending this poll: the spawn runs
+                    # FIRST each iteration, so a live joiner is always
+                    # in ``spawned`` before its drain arms
                     continue
                 if st == "done":
                     # the set_draining race's backstop: a handoff that
@@ -1307,15 +1786,38 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                         continue
                     q.set_draining()
                     drain_state[key] = "draining"
+                    # a fault drain and a scale-down can target the
+                    # SAME replica (drain-racing-drain): one queue,
+                    # one drain — the spec that ARMED it owns the
+                    # completion accounting, whichever spec entry
+                    # happens to poll the finished queue first
+                    drain_why[key] = why
                     _ring_remove(role, i)
-                    _mark_degraded()
+                    if why == "fault":
+                        # a SCALE down is planned capacity management,
+                        # never degradation
+                        _mark_degraded()
                 moved = q.drain_pending()
                 if moved:
                     _redrive(role, moved, "drained")
                 if q.pending_count() == 0:
                     q.close()
                     drain_state[key] = "done"
-                    drained_labels.append(q.label)
+                    if role == "dec" and i in spawned:
+                        # the fleet_size gauge is the LIVE count:
+                        # fault and scale drains both shrink it
+                        live_size[0] -= 1
+                        _set_size()
+                    if drain_why[key] == "scale":
+                        scaled_down_labels.append(q.label)
+                        if reg.enabled:
+                            _c_scale_down.inc()
+                            tc = reg.clock()
+                            reg.emit_span("fleet_scale", tc, tc,
+                                          kind="down", replica=q.label,
+                                          trigger="low_load")
+                    else:
+                        drained_labels.append(q.label)
 
         def _check_health():
             """The classified-liveness pass: one
@@ -1330,11 +1832,12 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
             and dead are never conflated."""
             now = time.monotonic()
             for role, queues, threads, nn in (
-                    ("dec", dec_queues, dec_threads, n_dec),
+                    ("dec", dec_queues, dec_threads, n_dec_run),
                     ("pre", pre_queues, pre_threads, n_pre)):
                 for i in range(nn):
                     q = queues[i]
-                    if q.dead or not threads[i].is_alive() \
+                    if threads[i] is None or q.dead \
+                            or not threads[i].is_alive() \
                             or not q.work_done:
                         # a replica that has not completed its first
                         # wave/handoff yet is COMPILING, not sick —
@@ -1350,9 +1853,9 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                 return len(retire_at) >= n_planned
 
         def _pending_downs():
-            return fault_on and any(
+            return managed and any(
                 qq.dead and (role, j) not in down_seen
-                for role, qs, nn in (("dec", dec_queues, n_dec),
+                for role, qs, nn in (("dec", dec_queues, n_dec_run),
                                      ("pre", pre_queues, n_pre))
                 for j, qq in enumerate(qs[:nn]))
 
@@ -1363,9 +1866,18 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
         # AFTER the worker threads are joined: the failure propagates
         # to the caller instead of silently stranding replicas waiting
         # on a closure that will never come.
+        _set_size()
         try:
             while True:
-                if fault_on:
+                # scale-UPs execute FIRST each poll (a joiner is always
+                # spawned before its own drain/kill can arm — the plan
+                # orders join ts strictly before any event on the id)
+                rel_now = time.monotonic() - t0
+                while up_idx[0] < len(scale_ups) \
+                        and scale_ups[up_idx[0]]["ts"] <= rel_now:
+                    _spawn_dec(scale_ups[up_idx[0]])
+                    up_idx[0] += 1
+                if managed:
                     _process_downs()
                     _process_drains(time.monotonic() - t0)
                     _check_health()
@@ -1374,7 +1886,7 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                     _g_depth.set(sum(depths)
                                  + sum(q.pending_count()
                                        for q in pre_queues))
-                if not fault_on:
+                if not managed:
                     adds_done = not any(th.is_alive()
                                         for th in pre_threads)
                     if adds_done and sum(depths) == 0:
@@ -1394,16 +1906,22 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                     # a later pass once the downs have settled
                     for q in pre_queues + dec_queues:
                         q.disarm()
+                    # scale events past the last retirement are "the
+                    # run ended before the event" — disarmed exactly
+                    # like a late kill
+                    up_idx[0] = len(scale_ups)
                     if not _pending_downs():
                         for q in pre_queues + dec_queues:
                             q.close()
                         closed_out[0] = True
-                if steal and n_dec > 1:
+                if steal and n_dec_run > 1:
                     receivers = [i for i, d in enumerate(depths)
-                                 if d == 0 and _avail("dec", i)
+                                 if d == 0 and i in spawned
+                                 and _avail("dec", i)
                                  and _health_ok("dec", i)
+                                 and dec_threads[i] is not None
                                  and dec_threads[i].is_alive()]
-                    donors = [i for i in range(n_dec)
+                    donors = [i for i in range(n_dec_run)
                               if _avail("dec", i)]
                     if receivers and donors:
                         donor = max(donors, key=lambda i: depths[i])
@@ -1419,8 +1937,10 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                                 stolen[0] += 1
                                 if reg.enabled:
                                     _c_steal.inc()
-                if not any(th.is_alive() for th in dec_threads) \
-                        and not _pending_downs():
+                if not any(th is not None and th.is_alive()
+                           for th in dec_threads) \
+                        and not _pending_downs() \
+                        and up_idx[0] >= len(scale_ups):
                     break
                 time.sleep(steal_poll_s)
         except BaseException:
@@ -1431,8 +1951,9 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
             raise
         finally:
             for th in pre_threads + dec_threads:
-                th.join()
-        if fault_on:
+                if th is not None:
+                    th.join()
+        if managed:
             _process_downs()             # a death racing the exit
         if errors:
             where, exc = errors[0]
@@ -1475,9 +1996,21 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                      "swap_tokens_saved": 0, "spill_dropped": 0,
                      "corrupt_dropped": 0}
         spill_on = bool(engine_kw.get("host_spill"))
-        for i, e in enumerate(dec_engines):
-            st = e.last_stats
+        for i in range(n_dec_run):
+            e = dec_engines[i] if i < len(dec_engines) else None
             label = (f"decode-{i}" if disaggregate else f"replica-{i}")
+            if i not in spawned or e is None:
+                # a scale-up joiner whose spawn never executed (the
+                # run ended first, or every attempt failed): no engine
+                # ran, so there are no stats to read
+                per_replica.append({
+                    "role": "decode", "replica": label,
+                    "requests": 0, "waves": None, "occupancy": None,
+                    "kv_peak_blocks": None, "preempted": 0,
+                    "dead": dec_queues[i].dead, "spawned": False,
+                })
+                continue
+            st = e.last_stats
             if st is None:
                 # killed mid-run: the engine never assembled stats —
                 # report the death, never a KeyError
@@ -1596,8 +2129,40 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                     "handoff_retries": handoff_retries[0],
                     "degraded": degraded[0],
                 }),
+                # the elastic control loop's ledger (None on a fixed
+                # fleet — absence must not read as "no scaling ran")
+                "scale": (None if not scale_on else {
+                    "policy_seed": str(autoscale.seed),
+                    "initial": n_dec,
+                    "final_live": live_size[0],
+                    "min": autoscale.min_replicas,
+                    "max": autoscale.max_replicas,
+                    "events": scale_events,
+                    "ups_planned": len(scale_ups),
+                    "ups_executed": len(spawned) - n_dec,
+                    # executed drains only — a planned down whose
+                    # target was KILLED first never ran (the kill
+                    # path already accounted the capacity loss), so
+                    # counter == downs == len(scaled_down) holds even
+                    # under drain-racing-kill
+                    "downs": len(scaled_down_labels),
+                    "downs_planned": len(scale_downs),
+                    "warm_joins": warm_joins[0],
+                    "cold_joins": cold_joins[0],
+                    "warm_chains_primed": warm_chains_primed[0],
+                    "spawn_retries": spawn_retries[0],
+                    "spawn_failures": spawn_failures[0],
+                    "scaled_down": sorted(scaled_down_labels),
+                    "warm_store": (warm_store.stats()
+                                   if warm_store is not None
+                                   else None),
+                }),
             },
-            "replica_stats": [e.last_stats for e in dec_engines],
+            "replica_stats": [
+                (dec_engines[i].last_stats
+                 if i in spawned and i < len(dec_engines)
+                 and dec_engines[i] is not None else None)
+                for i in range(n_dec_run)],
         }
         out: list[Any] = [None] * n
         for req, toks in merged.items():

@@ -44,6 +44,7 @@ off) across the scheduler-lever matrix.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Any, Sequence
 
 import numpy as np
@@ -185,10 +186,35 @@ class HostBlockPool:
                 f"device pool carries keys {sorted(keys)}, host pool "
                 f"was built for {sorted(self._bufs)} (cache_dtype "
                 f"mismatch between the tiers?)")
-        hids = self._alloc.alloc(len(dev_blocks))
+        if self.free_blocks < len(dev_blocks):
+            # capacity check BEFORE the device→host readback: this
+            # runs inside trim()/reclaim() on the wave loop, and a
+            # full pool must refuse the spill with zero device
+            # traffic (alloc is all-or-nothing, so this is exact)
+            return None
+        return self.adopt(export_block_rows(pool, dev_blocks))
+
+    def adopt(self, payload: dict) -> list[int] | None:
+        """Store an already-exported wire payload (numpy or device
+        arrays in ``export_block_rows``'s format, ``n`` blocks per
+        buffer) into host rows — the direct-ingest half :meth:`store`
+        routes through, and the door the fleet's warm-bring-up
+        migration uses (a chain published by one replica adopts into
+        another replica's pool, or into the fleet-shared
+        :class:`WarmChainStore`, without ever touching a device pool).
+        All-or-nothing like :meth:`store`; rows crc-stamp at adopt
+        time."""
+        if sorted(payload) != sorted(self._bufs):
+            raise ValueError(
+                f"payload carries keys {sorted(payload)}, host pool "
+                f"was built for {sorted(self._bufs)} (cache_dtype "
+                f"mismatch between the tiers?)")
+        n = int(np.asarray(payload["k"][0]).shape[0])
+        if n == 0:
+            return []
+        hids = self._alloc.alloc(n)
         if hids is None:
             return None
-        payload = export_block_rows(pool, dev_blocks)
         # one readback for the whole chain (the spill's device→host
         # hop), then ONE fancy-index write per (key, layer) — this
         # runs inside trim()/reclaim() on the wave loop, so the copy
@@ -264,6 +290,180 @@ class HostBlockPool:
         if self._pool is not None:
             self._pool.shutdown(wait=True)
             self._pool = None
+
+
+class WarmChainStore:
+    """FLEET-SHARED host tier for warm replica bring-up: chain-keyed
+    prefix chains in one :class:`HostBlockPool`, published by replicas
+    at drain/close time and taken by joining replicas at spawn time
+    (the elastic fleet's state-migration transport, ``models/fleet.py``).
+
+    The per-replica spill tier answers "my HBM cap is smaller than my
+    working set"; this store answers "a replica that did not exist a
+    second ago should not cold-start": a draining (scaled-down) replica
+    publishes its retained prefix chains here (``PrefixIndex.
+    export_chains`` → :meth:`publish`), and a scale-up's bring-up takes
+    the chains whose ROOT key the post-join ring assigns to the joiner
+    (:meth:`take`) and seeds them host-side into the fresh replica's
+    index (``PrefixIndex.seed_host``) — so the Zipf-head template
+    working set survives replica churn instead of re-prefilling from
+    tokens on every join.
+
+    Chains are filed by their LEAF chain key (``paging.chain_key``) and
+    kept LRU, but rows are stored PER CHAIN NODE with refcounts —
+    chains sharing a template prefix share its rows, so a popular
+    template with many divergent suffixes costs its node count, never
+    node-count × leaf-count. Every row rides the pool's crc
+    discipline, so a take re-verifies at load and a corrupt chain is
+    DROPPED loudly (billed, never migrated). Thread-safe: replicas
+    publish from their run threads, the router takes from its monitor
+    thread. A take COPIES — the store keeps its rows, so any number
+    of joiners can inherit the same head."""
+
+    def __init__(self, cfg: BurnInConfig, host_blocks: int, *,
+                 block_size: int, cache_dtype: str = "bf16"):
+        import threading
+
+        self.pool = HostBlockPool(cfg, host_blocks,
+                                  block_size=block_size,
+                                  cache_dtype=cache_dtype)
+        self._lock = threading.Lock()
+        # leaf chain key → chunks tuple, LRU order; rows are filed
+        # PER CHAIN NODE (``_rows``: node chain key → [host id,
+        # refcount]) so chains sharing a template prefix share its
+        # rows — a Zipf-head template with L divergent suffix leaves
+        # costs ~B+L rows, never B×L (the blow-up would evict other
+        # templates' heads exactly when templates are popular)
+        self._chains: "OrderedDict[bytes, tuple]" = OrderedDict()
+        self._rows: dict[bytes, list] = {}
+        self.published_chains = 0       # chains newly stored
+        self.store_full_drops = 0       # publishes the full pool refused
+        self.corrupt_dropped = 0        # takes that failed their crc
+        self.taken_chains = 0           # chains handed to joiners
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._chains)
+
+    @staticmethod
+    def _node_keys(chunks) -> list:
+        from .paging import chain_key
+
+        return [chain_key(chunks, k) for k in range(1, len(chunks) + 1)]
+
+    def _drop_chain(self, leaf) -> None:
+        """Unfile one chain (lock held): decrement every node's ref,
+        free rows no surviving chain references."""
+        chunks = self._chains.pop(leaf)
+        for nk in self._node_keys(chunks):
+            row = self._rows[nk]
+            row[1] -= 1
+            if row[1] == 0:
+                self.pool.free([row[0]])
+                del self._rows[nk]
+
+    def publish(self, chains: Sequence[tuple]) -> int:
+        """Store ``(chunks, payload)`` chains (``payload`` in
+        ``export_block_rows`` wire format covering the whole chain),
+        given HOTTEST-first (``PrefixIndex.export_chains``' MRU
+        order). A chain already filed under the same leaf key
+        refreshes its LRU slot — content is identical by the key's
+        construction, so re-storing would only burn pool rows. Under
+        capacity pressure a chain evicts UNUSED LRU chains and is
+        dropped (billed) if it still does not fit — publishing is
+        best-effort by design, correctness never depends on it. The
+        batch is INSERTED coldest-first so the OrderedDict's eviction
+        front holds the cold tail and the popular head survives the
+        squeeze (the retention promise the runbook makes); a chain
+        bigger than the whole pool is refused up front, never allowed
+        to evict everything and then fail anyway. Returns chains
+        newly stored."""
+        stored = 0
+        with self._lock:
+            for chunks, payload in reversed(list(chains)):
+                chunks = tuple(tuple(c) for c in chunks)
+                if not chunks:
+                    continue
+                node_keys = self._node_keys(chunks)
+                leaf = node_keys[-1]
+                if leaf in self._chains:
+                    self._chains.move_to_end(leaf)
+                    continue
+                while True:
+                    # recomputed per attempt: evicting an LRU chain
+                    # may free a PREFIX node this chain shares, so the
+                    # missing set is only valid until the next drop
+                    missing = [i for i, nk in enumerate(node_keys)
+                               if nk not in self._rows]
+                    if len(missing) > self.pool.host_blocks:
+                        hids = None          # bigger than the pool
+                        break
+                    if not missing:
+                        hids = []            # fully shared already
+                        break
+                    sliced = {k: [np.asarray(b)[missing] for b in bufs]
+                              for k, bufs in payload.items()}
+                    hids = self.pool.adopt(sliced)
+                    if hids is not None or not self._chains:
+                        break
+                    self._drop_chain(next(iter(self._chains)))
+                if hids is None:
+                    self.store_full_drops += 1
+                    continue
+                for i, hid in zip(missing, hids):
+                    self._rows[node_keys[i]] = [int(hid), 0]
+                for nk in node_keys:
+                    self._rows[nk][1] += 1
+                self._chains[leaf] = chunks
+                self.published_chains += 1
+                stored += 1
+        return stored
+
+    def take(self, owns) -> list[tuple[tuple, dict]]:
+        """The joiner's share: every stored chain whose ROOT key
+        satisfies ``owns(root_key)`` (the router passes the post-join
+        ring's assignment), as ``(chunks, payload)`` records ready for
+        ``HostBlockPool.adopt`` + ``PrefixIndex.seed_host`` on the
+        joining replica. Rows are crc-verified at load; a corrupt
+        chain is discarded from the store and billed, never handed
+        out. Chains are returned sorted by key (publish order is
+        thread-timing; the joiner's seeding order must not be) and
+        stay in the store — takes copy."""
+        out: list[tuple[tuple, dict]] = []
+        with self._lock:
+            for key in sorted(self._chains):
+                chunks = self._chains[key]
+                node_keys = self._node_keys(chunks)
+                if not owns(node_keys[0]):
+                    continue
+                hids = [self._rows[nk][0] for nk in node_keys]
+                try:
+                    payload = self.pool.load(hids)
+                except HostSpillCorruptError:
+                    self._drop_chain(key)
+                    self.corrupt_dropped += 1
+                    continue
+                self._chains.move_to_end(key)
+                out.append((chunks, payload))
+                self.taken_chains += 1
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            while self._chains:
+                self._drop_chain(next(iter(self._chains)))
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "chains": len(self._chains),
+                "blocks_in_use": self.pool.in_use,
+                "host_blocks": self.pool.host_blocks,
+                "published_chains": self.published_chains,
+                "taken_chains": self.taken_chains,
+                "store_full_drops": self.store_full_drops,
+                "corrupt_dropped": self.corrupt_dropped,
+            }
 
 
 class IndexSpill:

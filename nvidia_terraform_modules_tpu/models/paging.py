@@ -197,6 +197,24 @@ def chunk_tokens_covered(k: int, block_size: int, offset: int = 0) -> int:
     return 0 if k == 0 else k * block_size - offset
 
 
+def chain_key(chunks: Sequence[tuple], upto: int | None = None) -> bytes:
+    """The :class:`PrefixIndex` chain key of ``chunks[:upto]`` — the
+    key naming the ENTIRE token history through that chunk. One
+    definition shared by the index, the fleet's routing
+    (``fleet.affinity_key`` keys on ``chain_key(chunks, 1)``) and the
+    warm-bring-up store (``hostkv.WarmChainStore`` files spilled chains
+    under their root/leaf keys), so placement, matching and migration
+    can never disagree on a chain's name."""
+    if upto is None:
+        upto = len(chunks)
+    if upto < 1:
+        raise ValueError("chain_key needs >= 1 chunk")
+    parent: bytes | None = None
+    for chunk in chunks[:upto]:
+        parent = PrefixIndex._key(parent, chunk)
+    return parent
+
+
 class PrefixIndex:
     """Host-side prefix lookup: block-aligned token-hash chains →
     physical blocks, holding ONE allocator reference per indexed block.
@@ -412,6 +430,74 @@ class PrefixIndex:
                 self._entries[key] = (block, chunk, parent, "dev")
             self._entries.move_to_end(key)
             parent = key
+
+    def seed_host(self, chunks: Sequence[tuple],
+                  host_ids: Sequence[int]) -> int:
+        """WARM BRING-UP seeding (the elastic fleet's host-tier prefix
+        migration): register ``chunks[i]`` as a HOST-tier entry holding
+        ``host_ids[i]`` — rows the caller already adopted into this
+        index's spill pool (``HostBlockPool.adopt``). A fresh replica
+        seeded this way starts with the popular-prefix working set
+        host-resident, and the FIRST admission that matches a seeded
+        chain swaps it in through the ordinary tiered path
+        (:meth:`match_tiered` → crc-verified load → :meth:`promote`) —
+        no new read machinery, so the warm join inherits the bit-match
+        and quarantine discipline of the spill tier. Chain nodes
+        already indexed (either tier) keep their existing entry and the
+        duplicate adopted row is released back to the spill pool.
+        Returns the number of NEW host-tier entries seeded."""
+        if self.spill is None:
+            raise ValueError(
+                "seed_host needs a spill adapter — the seeded entries "
+                "live in the host tier")
+        if len(chunks) != len(host_ids):
+            raise ValueError(
+                f"{len(chunks)} chunks for {len(host_ids)} host ids")
+        seeded = 0
+        parent: bytes | None = None
+        for chunk, hid in zip(chunks, host_ids):
+            key = self._key(parent, chunk)
+            ent = self._entries.get(key)
+            if ent is None:
+                self._entries[key] = (int(hid), chunk, parent, "host")
+                if parent is not None:
+                    self._children.setdefault(parent, set()).add(key)
+                seeded += 1
+            else:
+                # already indexed (a prior seed, or this replica's own
+                # traffic got there first): the duplicate row goes back
+                self.spill.free([int(hid)])
+            self._entries.move_to_end(key)
+            parent = key
+        return seeded
+
+    def export_chains(self) -> list[tuple[list[tuple],
+                                          list[tuple[str, int]]]]:
+        """Every maximal indexed chain, root-first per chain and
+        most-recently-used LEAF first across chains: ``(chunks,
+        [(tier, id), …])`` where ``id`` is a device block
+        (``tier="dev"``) or a host-pool row (``tier="host"``).
+        Read-only — no references, no LRU touch: the drain/close-time
+        PUBLISH walk (the elastic fleet copies these chains into its
+        shared :class:`~.hostkv.WarmChainStore` so successors inherit
+        the working set). MRU-first ordering means a capacity-limited
+        sink keeps the popular head and drops the cold tail."""
+        out: list[tuple[list[tuple], list[tuple[str, int]]]] = []
+        for leaf in reversed(self._entries):
+            if self._children.get(leaf):
+                continue
+            chunks: list[tuple] = []
+            ids: list[tuple[str, int]] = []
+            k: bytes | None = leaf
+            while k is not None:
+                block, chunk, parent, tier = self._entries[k]
+                chunks.append(chunk)
+                ids.append((tier, block))
+                k = parent
+            chunks.reverse()
+            ids.reverse()
+            out.append((chunks, ids))
+        return out
 
     def _drop(self, key: bytes) -> int:
         """Plain drop of ``key`` and every descendant entry

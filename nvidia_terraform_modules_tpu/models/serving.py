@@ -250,6 +250,31 @@ class AdmissionSource:
         drain hook — see the class docstring). Default: never."""
         return False
 
+    def warm_chains(self):
+        """WARM BRING-UP (the elastic fleet's host-tier prefix
+        migration): ``None``/empty for a cold start, or a list of
+        ``(chunks, payload)`` prefix chains (``paging.chain_chunks``
+        chunk tuples + ``export_block_rows``-format rows) the engine
+        seeds HOST-side into its prefix index before the first
+        admission (``HostBlockPool.adopt`` + ``PrefixIndex.seed_host``)
+        — a scale-up replica then inherits the popular-template working
+        set and the first matching admission swaps each chain in
+        through the ordinary crc-verified tiered path. Consulted once
+        per run, only on engines built with ``share_prefix`` +
+        ``host_spill`` (no host tier ⇒ chains are dropped, billed in
+        ``last_stats["prefix"]["warm"]``). Default: cold."""
+        return None
+
+    def chain_sink(self):
+        """The drain-time PUBLISH sink (``None`` = discard, the
+        default): an object with ``publish(chains) → stored`` (e.g.
+        ``hostkv.WarmChainStore``) that receives the prefix index's
+        retained chains at the END of the run, before the pool is
+        released — how a scaled-down replica's warm state outlives it
+        for successors to inherit. Publishing is read-only against the
+        index and best-effort: correctness never depends on it."""
+        return None
+
 
 class _Sched(AdmissionSource):
     """Host-side admission ORDER: which pending request the engine
@@ -1147,7 +1172,15 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                                  "swap_tokens_saved": 0,
                                  "corrupt_dropped": 0,
                                  "reclaim_blocked_live": 0,
-                                 "reclaim_blocked_empty": 0}
+                                 "reclaim_blocked_empty": 0,
+                                 # elastic-fleet state migration: warm
+                                 # chains seeded at bring-up (adopted
+                                 # host-side + indexed), seeds the host
+                                 # pool refused, and retained chains
+                                 # published to a drain sink at close
+                                 "warm_chains": 0, "warm_blocks": 0,
+                                 "warm_dropped": 0,
+                                 "published_chains": 0}
             self._toks: dict[int, list] = {}          # host prompt cache
             self._row_np: dict[int, Any] = {}
             if prefix is not None:
@@ -1403,11 +1436,88 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 # LRU cap now that this request's references are gone
                 self.index.trim()
 
-        def close(self) -> None:
-            """End of run: release the prefix index's retained blocks
-            so the pool drains to empty (the leak check's invariant —
-            BOTH tiers: release frees host copies too), and shut the
-            swap worker down."""
+        def seed_warm(self, chains) -> None:
+            """WARM BRING-UP: adopt ``(chunks, payload)`` chains into
+            the HOST tier and index them (``PrefixIndex.seed_host``)
+            before the first admission — the joining replica's
+            inheritance of the fleet's popular-prefix working set. A
+            chain the host pool cannot hold (or an engine with no host
+            tier at all) is dropped and billed — a cold chain costs a
+            re-prefill, never correctness. The seeded rows swap in
+            through the ordinary crc-verified tiered admission path, so
+            a corrupt migrated chain quarantines exactly like a corrupt
+            spill."""
+            ps = self.prefix_stats
+            for chunks, payload in chains:
+                if self.host is None or self.index is None:
+                    ps["warm_dropped"] += 1
+                    continue
+                hids = self.host.adopt(payload)
+                if hids is None:
+                    ps["warm_dropped"] += 1
+                    continue
+                seeded = self.index.seed_host(chunks, hids)
+                ps["warm_chains"] += 1
+                ps["warm_blocks"] += seeded
+
+        def publish_chains(self, sink) -> None:
+            """Drain-time PUBLISH: copy every retained indexed chain
+            (device tier exported from the live pool, host tier loaded
+            crc-verified) into ``sink`` — how a drained/finishing
+            replica's warm state reaches the fleet-shared store for
+            successors to inherit. Read-only against the index: no
+            references move, no eviction runs, and in particular
+            ``spill_dropped`` is NEVER billed here — a publish the sink
+            refuses is the SINK's accounting (``store_full_drops``),
+            not a spill drop, so a drain racing a pressure reclaim can
+            never double-count the eviction (regression-pinned in
+            tests/test_paging.py)."""
+            from .hostkv import HostSpillCorruptError
+            from .paging import export_block_rows
+
+            if self.index is None:
+                return
+            chains = []
+            for chunks, ids in self.index.export_chains():
+                dev = [b for t, b in ids if t == "dev"]
+                hst = [b for t, b in ids if t == "host"]
+                parts = []
+                if dev:
+                    pay = export_block_rows(self.pool, dev)
+                    parts.append({k: [np.asarray(b) for b in bufs]
+                                  for k, bufs in pay.items()})
+                if hst:
+                    try:
+                        parts.append(self.host.load(hst))
+                    except HostSpillCorruptError:
+                        # quarantine discipline: suspect bytes never
+                        # migrate — drop the chain from the publish
+                        self.prefix_stats["corrupt_dropped"] += 1
+                        continue
+                if not parts:
+                    continue
+                if len(parts) == 1:
+                    payload = parts[0]
+                else:
+                    payload = {
+                        k: [np.concatenate([np.asarray(a),
+                                            np.asarray(b)])
+                            for a, b in zip(parts[0][k], parts[1][k])]
+                        for k in parts[0]}
+                chains.append((chunks, payload))
+            if chains:
+                self.prefix_stats["published_chains"] += \
+                    sink.publish(chains)
+
+        def close(self, sink=None) -> None:
+            """End of run: publish retained chains to the drain sink
+            (when one is wired — BEFORE release tears the tiers down),
+            release the prefix index's retained blocks so the pool
+            drains to empty (the leak check's invariant — BOTH tiers:
+            release frees host copies too), and shut the swap worker
+            down."""
+            if sink is not None and self.index is not None:
+                self.publish_chains(sink)
             if self.index is not None:
                 self.index.release()
             self._staged_sig, self._staged_fut = None, None
@@ -1992,6 +2102,16 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                     "host_high_water": (host.high_water
                                         if host is not None else 0),
                 },
+                # elastic-fleet state migration (zeros outside a
+                # scale event): bring-up chains seeded from the warm
+                # store vs dropped, and retained chains published to
+                # the drain sink at close
+                "warm": {
+                    "seeded_chains": ps["warm_chains"],
+                    "seeded_blocks": ps["warm_blocks"],
+                    "seed_dropped": ps["warm_dropped"],
+                    "published_chains": ps["published_chains"],
+                },
             },
         }
 
@@ -2062,7 +2182,11 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                                      "swap_tokens_saved": 0,
                                      "corrupt_dropped": 0,
                                      "host_in_use": 0,
-                                     "host_high_water": 0}},
+                                     "host_high_water": 0},
+                           "warm": {"seeded_chains": 0,
+                                    "seeded_blocks": 0,
+                                    "seed_dropped": 0,
+                                    "published_chains": 0}},
             }
             return {} if admission is not None else []
         if eos_check_every < 1:
@@ -2168,6 +2292,14 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         sched = (admission if admission is not None
                  else _Sched(prompts, n_new_of, policy, aging,
                              priorities, arrivals, time.monotonic()))
+        if admission is not None:
+            # elastic-fleet seams (both optional, getattr so a minimal
+            # AdmissionSource implementation stays valid): warm
+            # bring-up chains seed the host tier BEFORE any admission,
+            # and the drain sink receives retained chains at close
+            warm = getattr(sched, "warm_chains", lambda: None)()
+            if warm:
+                rstate.seed_warm(warm)
         lens_of = [int(jnp.asarray(p).shape[-1]) for p in prompts]
         active: dict[int, int] = {}              # slot → request index
         firsts: dict[int, Any] = {}              # req → prefill token
@@ -2476,7 +2608,9 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 # see the handle comment: wall time when the wave ended
                 # in an eos readback, dispatch time otherwise
                 _g_paged.set(round((time.monotonic() - tw0) * 1e3, 3))
-        rstate.close()
+        sink = (getattr(sched, "chain_sink", lambda: None)()
+                if admission is not None else None)
+        rstate.close(sink=sink)
         _gauges(rstate, 0, 0)
 
         waves = jnp.stack(hist) if hist else None      # [W, slots]

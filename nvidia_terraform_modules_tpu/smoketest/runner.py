@@ -640,6 +640,95 @@ def _run_smoketest(
                     checks["kv_spill_error"] = str(exc)
                 ok &= checks["kv_spill_ok"]
 
+            # elastic-fleet gate (ISSUE 15): the autoscaler is
+            # contractually a PLACEMENT change — replicas joining and
+            # draining at runtime move work, never bits — so a seeded
+            # scale-up→churn→scale-down run (a burst joins a replica,
+            # the sparse tail drains the base one, which publishes its
+            # working set) must BIT-match the single-engine baseline,
+            # and a SECOND identical run must replay the same schedule
+            # with the joiner inheriting the published chains WARM
+            # (host-tier seeds converting to real prefix hits). Gates
+            # warm bring-up on this slice's real lowering before a
+            # preemptible serving pool rides the autoscaler. Reuses
+            # the fleet gate's config; tiny, process-local.
+            if checks.get("fleet_chaos_ok"):
+                try:
+                    from ..models.fleet import AutoscalePolicy
+
+                    spairs = shared_prefix_prompts(
+                        12, seed=6, n_templates=4, template_len=8,
+                        suffix_lo=1, suffix_hi=4, vocab=fcfg.vocab)
+                    sprompts = [jax.numpy.asarray(p, jax.numpy.int32)
+                                for _t, p in spairs]
+                    sbudgets = [3, 4, 2, 4, 3, 2, 4, 3, 2, 3, 4, 2]
+                    sml = max(int(p.shape[-1]) + n
+                              for p, n in zip(sprompts, sbudgets))
+                    sbase = make_serve_engine(fparams, fcfg,
+                                              max_len=sml, kv_block=4,
+                                              share_prefix=True)
+                    sb_outs = sbase(sprompts, sbudgets, slots=2)
+                    sarr = [0.0] * 8 + [0.5 + 0.25 * i
+                                        for i in range(4)]
+                    elastic = make_fleet(
+                        fparams, fcfg, max_len=sml, replicas=1,
+                        kv_block=4, share_prefix=True, host_spill=True,
+                        host_blocks=64, prefix_keep_blocks=16,
+                        est_token_s=0.02, steal=False,
+                        autoscale=AutoscalePolicy(
+                            min_replicas=1, max_replicas=3,
+                            up_backlog=2.0, down_backlog=0.5,
+                            cooldown_s=0.05, seed=0))
+                    rounds = []
+                    for _ in range(2):
+                        e_outs = elastic(sprompts, sbudgets, slots=2,
+                                         arrivals=sarr)
+                        est = elastic.last_stats["fleet"]
+                        reps = elastic.last_stats["replica_stats"]
+                        rounds.append({
+                            "match": all(
+                                o is not None
+                                and bool(jax.device_get(
+                                    jax.numpy.array_equal(o, b)))
+                                for o, b in zip(e_outs, sb_outs)),
+                            "scale": est["scale"],
+                            "drained": all(
+                                rs["kv"]["in_use"] == 0
+                                and rs["prefix"]["spill"]
+                                ["host_in_use"] == 0
+                                for rs in reps if rs is not None),
+                            "joiner_hits": sum(
+                                rs["prefix"]["hit_blocks"]
+                                for i, rs in enumerate(reps)
+                                if rs is not None
+                                and i >= est["scale"]["initial"]),
+                            "warm_blocks": sum(
+                                rs["prefix"]["warm"]["seeded_blocks"]
+                                for rs in reps if rs is not None),
+                        })
+                    r1, r2 = rounds
+                    checks["fleet_scale_ok"] = (
+                        r1["match"] and r2["match"]
+                        and r1["drained"] and r2["drained"]
+                        and r1["scale"]["ups_executed"] >= 1
+                        and r1["scale"]["downs"] >= 1
+                        # same trace ⇒ same schedule, replayed
+                        and r2["scale"]["events"]
+                        == r1["scale"]["events"]
+                        # round 2's joiner inherited WARM and the
+                        # seeds converted to real prefix hits
+                        and r2["scale"]["warm_joins"] >= 1
+                        and r2["warm_blocks"] >= 1
+                        and r2["joiner_hits"] > 0)
+                    checks["fleet_scale_warm_blocks"] = \
+                        r2["warm_blocks"]
+                    checks["fleet_scale_joiner_hits"] = \
+                        r2["joiner_hits"]
+                except Exception as exc:  # JSON contract > the type
+                    checks["fleet_scale_ok"] = False
+                    checks["fleet_scale_error"] = str(exc)
+                ok &= checks["fleet_scale_ok"]
+
             # flash pipeline gate: the software-pipelined kernels
             # (ops/flash_attention.py, pipeline="on") are contractually a
             # SCHEDULING change — same sub-tile folds, same arithmetic —

@@ -62,9 +62,15 @@ Instrumented layers (all emit here when enabled):
                                       engines emit into — router→engine
                                       stitches on one timeline;
                                       ``fleet_queue_depth`` /
-                                      ``fleet_affinity_hit_frac`` gauges,
+                                      ``fleet_affinity_hit_frac`` /
+                                      ``fleet_size`` gauges,
                                       ``fleet_shed_total`` /
-                                      ``fleet_steal_total`` counters
+                                      ``fleet_steal_total`` /
+                                      ``fleet_scale_up_total`` /
+                                      ``fleet_scale_down_total``
+                                      counters, one ``fleet_scale`` span
+                                      per executed scale event (args:
+                                      trigger, replica, warm)
 ``parallel/collectives``              ``hierarchical_psum`` ICI-vs-DCN
                                       phase spans (probe side) +
                                       ``jax.named_scope`` phase names in

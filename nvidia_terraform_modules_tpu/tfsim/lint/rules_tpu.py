@@ -496,7 +496,9 @@ def check_spot_serving_no_headroom(ctx: LintContext):
     serving capacity comes back without a human apply. (The sibling
     sizing rule for serving pools is ``tpu-serving-no-host-ram``:
     headroom saves the traffic when a NODE dies, host RAM saves the
-    prefix working set when the HBM pool is the bottleneck.)"""
+    prefix working set when the HBM pool is the bottleneck. The
+    INVERSE rule is ``tpu-serving-autoscaler-unused``: headroom that
+    exists but that no workload consumes is spend, not safety.)"""
     for r, flag in _spot_tpu_pools(ctx):
         shaped = _serving_shaped(ctx, r)
         if shaped is None:
@@ -627,6 +629,117 @@ def check_serving_no_host_ram(ctx: LintContext):
                f"sizing arithmetic is in the gke-tpu README's tiered-"
                f"KV runbook; the failover twin is "
                f"tpu-spot-serving-no-headroom)")
+
+
+# identifier shapes that mark the serving runtime's ELASTIC control
+# loop as wired into a deployment: the fleet's own knobs (autoscale= /
+# min_replicas / max_replicas on make_fleet's AutoscalePolicy) and the
+# env-var spellings a pod spec would carry them through. Deliberately
+# NOT a bare "autoscal" prefix: a variable like
+# "autoscaling_max_node_count" that only parameterizes the pool's own
+# autoscaling block is the INFRA side of the range — counting it as
+# runtime wiring would silence the rule on exactly the
+# declared-but-unconsumed modules it targets ("autoscaling" has no
+# 'e', so the plain "autoscale" spelling — the runtime knob's — can
+# never match it, while autoscale_policy / FLEET_AUTOSCALE_ENABLED do)
+_AUTOSCALE_RE = re.compile(
+    r"autoscale|(min|max)[_-]?replicas|replica[_-]?(min|max)|"
+    r"fleet[_-]?(min|max|size)", re.IGNORECASE)
+
+
+def _autoscale_wiring(ctx: LintContext) -> str | None:
+    """The first evidence that this module wires the serving
+    autoscaler's bounds into its workloads, or None: an ``autoscale``/
+    ``min_replicas``/``max_replicas``-style variable in the module
+    API, a module-call argument of that shape, or a pod env var
+    carrying the bounds to the runtime."""
+    for name, v in ctx.mod.variables.items():
+        if _AUTOSCALE_RE.search(name):
+            return f'variable "{name}"'
+    for mc in ctx.mod.module_calls.values():
+        for a in mc.body.attributes:
+            if _AUTOSCALE_RE.search(a.name):
+                return f'module "{mc.name}" argument "{a.name}"'
+    for r in ctx.mod.resources.values():
+        for node in A.walk(r.body):
+            if not (isinstance(node, A.Block) and node.type == "env"):
+                continue
+            na = node.body.attr("name")
+            val = ctx.resolve_literal(na.expr) if na is not None else None
+            if isinstance(val, str) and _AUTOSCALE_RE.search(val):
+                return f'{r.address} env "{val}"'
+    return None
+
+
+@rule("tpu-serving-autoscaler-unused", severity="warning", family="tpu",
+      summary="serving-shaped TPU pool declares autoscaling headroom "
+              "(max above min) that no workload consumes — capacity "
+              "the fixed-size serving fleet will never join")
+def check_serving_autoscaler_unused(ctx: LintContext):
+    """The INVERSE of ``tpu-spot-serving-no-headroom``: that rule
+    fires when a serving pool has NO headroom to fail over into; this
+    one fires when the headroom exists but NOTHING consumes it. A
+    serving-shaped TPU pool declaring ``max_node_count`` above
+    ``min_node_count`` pays for an autoscaler range — but the serving
+    runtime's fleet is FIXED-size unless its elastic control loop is
+    armed (``make_fleet(autoscale=AutoscalePolicy(min_replicas=…,
+    max_replicas=…))``, the runtime twin of exactly these node-pool
+    variables — see the "Elastic fleet runbook" in
+    ``gke-tpu/README.md``). With no autoscale wiring statically
+    visible in the module (an ``autoscale``/``min_replicas``-style
+    variable, module argument, or pod env var), a scale-up provisions
+    nodes no replica ever joins — the node autoscaler grows the bill,
+    ``fleet_size`` stays flat — and a scale-down reclaims capacity the
+    router was never told to drain first. Either wire the bounds into
+    the serving runtime so joins are warm and drains are planned, or
+    pin the pool (``max == min``) and let
+    ``tpu-spot-serving-no-headroom`` arbitrate whether THAT is safe."""
+    wiring = _autoscale_wiring(ctx)
+    if wiring is not None:
+        return
+    for r in ctx.mod.resources.values():
+        if r.type != "google_container_node_pool":
+            continue
+        shaped = _serving_shaped(ctx, r)
+        if shaped is None:
+            continue
+        ncs = r.body.blocks_of("node_config")
+        mt = _literal(ctx, ncs[0].body.attr("machine_type")) \
+            if ncs else None
+        is_tpu = isinstance(mt, str) \
+            and T.parse_machine_type(mt) is not None
+        if not is_tpu:
+            is_tpu = any(
+                pbody is not None
+                and pbody.attr("tpu_topology") is not None
+                for _blk, pbody in _placement_blocks(r.body))
+        if not is_tpu:
+            continue
+        for b in _named_blocks(r.body, "autoscaling"):
+            if b is None:
+                continue
+            for lo_k, hi_k in (
+                    ("min_node_count", "max_node_count"),
+                    ("total_min_node_count", "total_max_node_count")):
+                lo = _literal(ctx, b.attr(lo_k))
+                hi = _literal(ctx, b.attr(hi_k))
+                if isinstance(lo, (int, float)) \
+                        and isinstance(hi, (int, float)) and hi > lo:
+                    yield (f"{r.file}:{r.line}",
+                           f"{r.address}: serving-shaped ({shaped!r}) "
+                           f"TPU pool declares {hi_k} = {hi:g} above "
+                           f"{lo_k} = {lo:g} but nothing in this "
+                           f"module consumes the bounds — the serving "
+                           f"fleet stays fixed-size, so scaled-up "
+                           f"nodes sit idle (fleet_size never moves) "
+                           f"and scale-downs reclaim replicas the "
+                           f"router never drained; wire the bounds "
+                           f"into the runtime (make_fleet autoscale=, "
+                           f"min_replicas/max_replicas mirroring "
+                           f"{lo_k}/{hi_k} — the gke-tpu README's "
+                           f"elastic-fleet runbook) or pin the pool "
+                           f"and let tpu-spot-serving-no-headroom "
+                           f"judge the pinning")
 
 
 def _slice_containers(ctx: LintContext):

@@ -49,6 +49,21 @@ def repo_root():
 #   pytest tests/ -q -m "not slow" --durations=0 | awk '$1+0>=4' ...
 # (test_manifest_is_fresh below fails loudly on renamed/deleted entries).
 SLOW_TESTS = frozenset({
+    # ISSUE 15 tier-1 budget audit: the suite re-measured at 944 s
+    # against the 870 s timeout (the CI rig runs ~15% slower than the
+    # PR 14 measurement), so the three heaviest entries move to the
+    # slow profile. Each keeps tier-1 coverage of its subsystem:
+    # elastic resume keeps the seeded SIGKILL + corrupted-newest
+    # tier-1 cases (the 2-proc gloo world shrink/grow case alone cost
+    # 217 s); checkpoint corruption keeps the on-resume quarantine
+    # tier-1 case; the ring pipeline keeps the fused-vs-dense sharded
+    # parity pair and the flash-level bitwise pipeline pins.
+    "tests/test_chaos_resume.py::"
+    "test_elastic_one_peer_kill_shrinks_then_grows_back_tier1",
+    "tests/test_checkpoint.py::"
+    "test_smoketest_corrupt_checkpoint_quarantined_not_fatal",
+    "tests/test_ring_attention.py::"
+    "test_ring_pipelined_bitmatches_unpipelined",
     "tests/test_serving.py::test_spec_serving_matches_plain_engine",
     "tests/test_serving.py::test_spec_serving_accepts_on_repetitive_prompts",
     "tests/test_serving.py::test_spec_serving_composes_with_prefix_and_chunking",

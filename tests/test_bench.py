@@ -219,7 +219,17 @@ def test_section_serve_fleet_schema_and_affinity_gate():
                 "serve_fleet_degraded_goodput",
                 "serve_fleet_degraded_goodput_minmax",
                 "serve_fleet_degraded_shed_frac",
-                "serve_fleet_degraded_attainment"):
+                "serve_fleet_degraded_attainment",
+                "serve_fleet_autoscale_warm_hit_frac",
+                "serve_fleet_autoscale_cold_hit_frac",
+                "serve_fleet_autoscale_warm_vs_cold",
+                "serve_fleet_autoscale_ups",
+                "serve_fleet_autoscale_warm_joins",
+                "serve_fleet_autoscale_warm_chains",
+                "serve_fleet_autoscale_p99_under_spike",
+                "serve_fleet_fixed_min_p99_under_spike",
+                "serve_fleet_autoscale_vs_fixed_min_p99",
+                "serve_fleet_autoscale_spike_ups"):
         assert key in out, key
     assert out["serve_fleet_bitmatch"] is True
     # affinity routing must STRICTLY raise the hit fraction over
@@ -248,6 +258,19 @@ def test_section_serve_fleet_schema_and_affinity_gate():
     # as the nominal one, deterministically, and goodput stays positive
     assert out["serve_fleet_degraded_goodput"] > 0
     assert 0 < out["serve_fleet_degraded_shed_frac"] < 1, out
+    # elastic autoscaler (ISSUE 15): the policy actually scaled (the
+    # node-pool bounds are consumed), the warm joiners inherited real
+    # chains, and warm-join hit frac STRICTLY beats cold-join on the
+    # identical trace — the migration win itself, portable to CPU
+    assert out["serve_fleet_autoscale_ups"] >= 1
+    assert out["serve_fleet_autoscale_warm_joins"] >= 1
+    assert out["serve_fleet_autoscale_warm_chains"] >= 1
+    assert out["serve_fleet_autoscale_warm_hit_frac"] \
+        > out["serve_fleet_autoscale_cold_hit_frac"], out
+    assert out["serve_fleet_autoscale_warm_vs_cold"] > 1.0
+    assert out["serve_fleet_autoscale_spike_ups"] >= 1
+    assert out["serve_fleet_autoscale_p99_under_spike"] > 0
+    assert out["serve_fleet_fixed_min_p99_under_spike"] > 0
 
 
 @pytest.mark.slow
@@ -270,7 +293,17 @@ def test_section_serve_fleet_deterministic_across_runs():
                 # the fault plane's seed-determined fields: the kill
                 # instant, that it fired, and the N−1 shed set
                 "serve_fleet_kill_at_s", "serve_fleet_replica_down",
-                "serve_fleet_degraded_shed_frac"):
+                "serve_fleet_degraded_shed_frac",
+                # the elastic plane's seed-determined fields: the
+                # scale schedule and the warm-inheritance accounting
+                # (the p99 legs are wall clocks and excluded)
+                "serve_fleet_autoscale_warm_hit_frac",
+                "serve_fleet_autoscale_cold_hit_frac",
+                "serve_fleet_autoscale_warm_vs_cold",
+                "serve_fleet_autoscale_ups",
+                "serve_fleet_autoscale_warm_joins",
+                "serve_fleet_autoscale_warm_chains",
+                "serve_fleet_autoscale_spike_ups"):
         assert a[key] == b[key], key
 
 

@@ -1,0 +1,590 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""The elastic-fleet gate: deterministic autoscaling, warm bring-up,
+and churn-composed chaos — every non-shed request bit-exact.
+
+PR 13 proved the fleet survives UNPLANNED replica death; this suite
+pins the PLANNED side (ISSUE 15): replicas joining and draining at
+runtime under a deterministic load-driven policy, with the PR 14 host
+tier repurposed as the warm-state migration transport. The invariants:
+
+- **Defaults-off, twice over.** A fleet with no ``autoscale=`` is the
+  PR 13 fleet (its whole suite still passes), and an ARMED policy
+  whose thresholds never fire reproduces the fixed-size fleet's
+  outputs, placements and shed set exactly — the elastic plane is a
+  seam, never a behaviour change.
+- **Bit-exact scaling.** An autoscaled run serves every request with
+  tokens equal to its undisturbed solo greedy decode — scale-up
+  joiners and scale-down drains move WORK, never bits (tokens are
+  schedule-invariant, PR 10's contract).
+- **Deterministic schedule.** (seed, policy, trace) ⇒ identical scale
+  events: the policy is evaluated on the routing plan's virtual clock,
+  so two runs of the same trace scale identically, like
+  ``FleetFaultProfile`` kills.
+- **Warm join beats cold start.** A joiner whose keyspace share is in
+  the fleet's ``WarmChainStore`` seeds its HOST tier at bring-up and
+  the first matching admissions swap those chains in crc-verified —
+  billed in ``last_stats`` so a cold join is visible, never silent.
+- **Faults compose with scaling.** Kill-during-bring-up (a fault
+  aimed at a joiner id), drain-racing-kill, and join/leave churn all
+  complete every non-shed request bit-exactly; a spawn that fails
+  every retry is CLASSIFIED dead and its planned requests redrive.
+
+One seeded scale-up case and one seeded churn-with-faults case are
+tier-1; the matrix and the failure-injection legs are slow-marked
+(the chaos-suite convention since PR 5; tier-1 budget audit, ISSUE 15).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nvidia_terraform_modules_tpu.models import (
+    AutoscalePolicy,
+    BurnInConfig,
+    WarmChainStore,
+    greedy_decode,
+    init_params,
+    make_fleet,
+)
+from nvidia_terraform_modules_tpu.models.fleet import (
+    FleetFault,
+    FleetFaultProfile,
+    HashRing,
+    affinity_key,
+)
+from nvidia_terraform_modules_tpu.models.paging import chain_chunks, chain_key
+
+CFG = dict(vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2,
+           seq_len=16, batch=2, dtype=jnp.float32)
+
+
+@functools.lru_cache(maxsize=None)
+def _setup(n=12, templates=4):
+    """A multi-template workload: distinct first-block keys spread the
+    keyspace across ring targets, so scale events move real shares and
+    a joiner's warm take is non-trivially owned."""
+    cfg = BurnInConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tmpls = [jax.random.randint(jax.random.PRNGKey(3 + t), (4,), 0,
+                                cfg.vocab) for t in range(templates)]
+    prompts = tuple(jnp.concatenate(
+        [tmpls[i % templates],
+         jax.random.randint(jax.random.PRNGKey(40 + i), (1 + i % 3,), 0,
+                            cfg.vocab)])
+        for i in range(n))
+    return cfg, params, prompts
+
+
+@functools.lru_cache(maxsize=None)
+def _want(n=12, templates=4, n_new=6):
+    cfg, params, prompts = _setup(n, templates)
+    return [greedy_decode(params, p[None, :], n_new, cfg,
+                          max_len=16)[0] for p in prompts]
+
+
+def _assert_all_equal(outs, want, label=""):
+    for i, (g, w) in enumerate(zip(outs, want)):
+        assert g is not None, f"{label} request {i} unserved"
+        assert jnp.array_equal(g, w), f"{label} request {i} diverged"
+
+
+# --------------------------------------------------------- policy plane
+
+
+def test_autoscale_policy_validation():
+    """The policy rejects shapes that cannot express a sane schedule:
+    inverted bounds, oscillating thresholds, negative knobs — loudly
+    at construction, like every config object in this repo."""
+    AutoscalePolicy()                            # defaults are valid
+    with pytest.raises(ValueError, match="min_replicas"):
+        AutoscalePolicy(min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        AutoscalePolicy(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match="oscillate"):
+        AutoscalePolicy(up_backlog=1.0, down_backlog=1.0)
+    with pytest.raises(ValueError, match="down_backlog"):
+        AutoscalePolicy(down_backlog=-0.5, up_backlog=1.0)
+    with pytest.raises(ValueError, match="cooldown"):
+        AutoscalePolicy(cooldown_s=-1.0)
+
+
+def test_make_fleet_autoscale_validation():
+    """The fleet-level contract: a policy needs ``est_token_s`` (its
+    virtual clock), refuses disaggregation (the elastic ring is the
+    decode ring), and the initial size must sit inside the bounds."""
+    cfg, params, _ = _setup()
+    pol = AutoscalePolicy(min_replicas=2, max_replicas=4)
+    with pytest.raises(ValueError, match="est_token_s"):
+        make_fleet(params, cfg, max_len=16, replicas=2, autoscale=pol)
+    with pytest.raises(ValueError, match="bounds"):
+        make_fleet(params, cfg, max_len=16, replicas=1,
+                   est_token_s=0.01, autoscale=pol)
+    with pytest.raises(ValueError, match="AutoscalePolicy"):
+        make_fleet(params, cfg, max_len=16, replicas=2,
+                   est_token_s=0.01, autoscale="yes")
+    with pytest.raises(ValueError, match="colocated"):
+        make_fleet(params, cfg, max_len=16, replicas=3,
+                   est_token_s=0.01, disaggregate=True,
+                   prefill_workers=1,
+                   autoscale=AutoscalePolicy(min_replicas=1,
+                                             max_replicas=4))
+    with pytest.raises(ValueError, match="warm_blocks"):
+        make_fleet(params, cfg, max_len=16, replicas=2,
+                   est_token_s=0.01,
+                   autoscale=AutoscalePolicy(min_replicas=1,
+                                             max_replicas=4),
+                   warm_blocks=0)
+
+
+def test_hash_ring_add_after_remove_restores_assignment():
+    """The flapping-joiner pin (ISSUE 15 satellite): remove a replica
+    and re-ADD it, and every key routes exactly as before the flap —
+    the add-side twin of PR 13's removal-symmetry pin, the property
+    that makes a rejoining replica inherit its OWN old keyspace (and
+    therefore its own warm working set), not a reshuffled one."""
+    ring = HashRing(4)
+    keys = [affinity_key(np.arange(i, i + 6), 4) for i in range(64)]
+    before = [ring.target(k) for k in keys]
+    ring.remove(2)
+    during = [ring.target(k) for k in keys]
+    # only the removed target's keyspace moved
+    for b, d in zip(before, during):
+        assert b == d or b == 2
+    ring.add(2)
+    after = [ring.target(k) for k in keys]
+    assert after == before
+
+
+# ----------------------------------------------------- warm chain store
+
+
+def _chain_payload(cfg, host, n_blocks, seed=0):
+    """A wire-format payload of ``n_blocks`` random rows matching
+    ``host``'s buffers — what ``export_block_rows`` would produce."""
+    rng = np.random.default_rng(seed)
+    out = {}
+    for key, bufs in host.pool._bufs.items():
+        out[key] = [rng.standard_normal(
+            (n_blocks,) + buf.shape[1:]).astype(buf.dtype)
+            for buf in bufs]
+    return out
+
+
+def test_warm_chain_store_publish_take_roundtrip():
+    """The migration transport's core contract: published chains come
+    back bitwise from ``take`` for the owner the ring assigns, takes
+    COPY (two joiners can inherit the same head), and a re-publish of
+    the same leaf key refreshes instead of burning rows."""
+    cfg = BurnInConfig(**CFG)
+    store = WarmChainStore(cfg, 8, block_size=4)
+    chunks = tuple(tuple(c) for c in chain_chunks(list(range(8)), 4))
+    payload = _chain_payload(cfg, store, 2, seed=1)
+    assert store.publish([(chunks, payload)]) == 1
+    assert store.publish([(chunks, payload)]) == 0     # refresh, no rows
+    assert len(store) == 1 and store.pool.in_use == 2
+    root = chain_key(chunks, 1)
+    assert store.take(lambda r: r != root) == []       # not my share
+    got = store.take(lambda r: r == root)
+    got2 = store.take(lambda r: r == root)             # takes copy
+    for out in (got, got2):
+        assert len(out) == 1
+        ch, pay = out[0]
+        assert ch == chunks
+        for key in payload:
+            for a, b in zip(payload[key], pay[key]):
+                assert np.array_equal(np.asarray(a), np.asarray(b))
+    st = store.stats()
+    assert st["taken_chains"] == 2 and st["published_chains"] == 1
+    store.clear()
+    assert store.pool.in_use == 0
+
+
+def test_warm_chain_store_capacity_keeps_hot_head_drops_cold_tail():
+    """Publishing is best-effort by design, and the squeeze keeps the
+    POPULAR HEAD: a batch arrives hottest-first (export_chains' MRU
+    order), so under capacity pressure the COLD TAIL is what evicts
+    and drops — and a chain bigger than the whole pool is refused up
+    front (billed), never allowed to evict everything and then fail
+    anyway. The store never blocks or raises."""
+    cfg = BurnInConfig(**CFG)
+    store = WarmChainStore(cfg, 2, block_size=4)
+    hot = tuple(tuple(c) for c in chain_chunks([1] * 4, 4))
+    mid = tuple(tuple(c) for c in chain_chunks([2] * 4, 4))
+    cold = tuple(tuple(c) for c in chain_chunks([3] * 4, 4))
+    # 3 one-block chains, hottest first, into a 2-block pool: every
+    # adopt lands (cold first by reverse insert, then mid, then hot
+    # evicting cold) — but the SURVIVORS are the hot head
+    assert store.publish(
+        [(hot, _chain_payload(cfg, store, 1, 1)),
+         (mid, _chain_payload(cfg, store, 1, 2)),
+         (cold, _chain_payload(cfg, store, 1, 3))]) == 3
+    from nvidia_terraform_modules_tpu.models.paging import chain_key
+    with store._lock:
+        kept = set(store._chains)
+    assert kept == {chain_key(hot), chain_key(mid)}
+    assert store.pool.in_use == 2
+    # a chain bigger than the WHOLE pool: refused up front, billed,
+    # and the stored head is untouched
+    big = tuple(tuple(c) for c in chain_chunks(list(range(12)), 4))
+    assert store.publish([(big, _chain_payload(cfg, store, 3, 4))]) == 0
+    assert store.stats()["store_full_drops"] == 1
+    assert len(store) == 2 and store.pool.in_use == 2
+    store.clear()
+    assert store.pool.in_use == 0
+
+
+def test_warm_chain_store_dedups_shared_template_prefix():
+    """The Zipf-head economics the store exists for: chains sharing a
+    template prefix share its ROWS (per-node refcounts), so a popular
+    template with L divergent suffixes costs ~B+L rows, never B×L —
+    and dropping one leaf frees only the unshared suffix row while
+    the shared head keeps serving the surviving chains."""
+    cfg = BurnInConfig(**CFG)
+    store = WarmChainStore(cfg, 8, block_size=4)
+    tmpl = [7] * 4                                # 1 shared block
+    chains = []
+    for sfx in (1, 2, 3):
+        chunks = tuple(tuple(c)
+                       for c in chain_chunks(tmpl + [sfx] * 4, 4))
+        chains.append((chunks, _chain_payload(cfg, store, 2, sfx)))
+    assert store.publish(chains) == 3
+    # 3 chains × 2 blocks each, but the template row is shared:
+    # 1 shared head + 3 suffix rows
+    assert len(store) == 3 and store.pool.in_use == 4
+    got = store.take(lambda r: True)
+    assert len(got) == 3
+    for (chunks, pay), (chunks0, _p) in zip(sorted(got), sorted(chains)):
+        assert np.asarray(pay["k"][0]).shape[0] == 2
+    with store._lock:
+        store._drop_chain(next(iter(store._chains)))
+    assert store.pool.in_use == 3                 # suffix row freed,
+    store.clear()                                 # head row retained
+    assert store.pool.in_use == 0
+
+
+def test_warm_chain_store_corrupt_chain_never_migrates():
+    """Host RAM is not trustworthy at fleet scale: a stored chain
+    whose bytes moved under the crc is DROPPED at take (billed in
+    ``corrupt_dropped``) — quarantine discipline, suspect bytes never
+    reach a joiner's pool."""
+    cfg = BurnInConfig(**CFG)
+    store = WarmChainStore(cfg, 4, block_size=4)
+    chunks = tuple(tuple(c) for c in chain_chunks(list(range(4)), 4))
+    store.publish([(chunks, _chain_payload(cfg, store, 1, 4))])
+    hid = next(iter(store._rows.values()))[0]
+    store.pool._bufs["k"][0][hid, 0, 0, 0] += 1
+    assert store.take(lambda r: True) == []
+    st = store.stats()
+    assert st["corrupt_dropped"] == 1 and st["chains"] == 0
+    assert store.pool.in_use == 0                  # rows released
+
+
+# ------------------------------------------------------ tier-1 gates
+
+
+def test_fleet_no_scale_event_schedule_matches_fixed_fleet_tier1():
+    """THE defaults-off acceptance gate (ISSUE 15): an armed policy
+    whose thresholds never fire — and whose bounds pin the size —
+    reproduces the PR 13 fixed fleet byte for byte: same tokens, same
+    placements, same (empty) shed set, and an all-zero scale ledger."""
+    cfg, params, prompts = _setup()
+    want = _want()
+    base = make_fleet(params, cfg, max_len=16, replicas=2, kv_block=4,
+                      est_token_s=0.01, steal=False)
+    got_base = base(prompts, 6, slots=2)
+    _assert_all_equal(got_base, want, "fixed:")
+    bst = base.last_stats["fleet"]
+    assert bst["scale"] is None
+    pol = AutoscalePolicy(min_replicas=2, max_replicas=4,
+                          up_backlog=1e9, down_backlog=0.0, seed=3)
+    elastic = make_fleet(params, cfg, max_len=16, replicas=2,
+                         kv_block=4, est_token_s=0.01, steal=False,
+                         autoscale=pol)
+    got = elastic(prompts, 6, slots=2)
+    _assert_all_equal(got, want, "no-event policy:")
+    est = elastic.last_stats["fleet"]
+    assert est["routed_to"] == bst["routed_to"]
+    assert est["shed_requests"] == bst["shed_requests"] == []
+    sc = est["scale"]
+    assert sc["events"] == [] and sc["ups_planned"] == 0
+    assert sc["downs"] == 0 and sc["final_live"] == sc["initial"] == 2
+    assert sc["warm_joins"] == 0 and sc["spawn_failures"] == 0
+
+
+def test_fleet_scale_up_warm_inherit_bit_exact_tier1():
+    """THE seeded scale-up gate (ISSUE 15 acceptance): a 1-replica
+    fleet under a backlog burst joins replicas up to ``max_replicas``
+    at admission-poll boundaries, every request bit-matches its solo
+    greedy decode (the fixed-size fleet's own gate — so autoscaled ==
+    fixed per request, transitively), the scale schedule replays
+    identically, and a SECOND run's joiners inherit the published
+    working set warm: host-tier chains seeded at bring-up, swapped in
+    through the crc-verified tiered path, billed as prefix hits."""
+    cfg, params, prompts = _setup(n=18, templates=6)
+    want = _want(n=18, templates=6)
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=3,
+                          up_backlog=2.0, down_backlog=0.25,
+                          cooldown_s=0.0, seed=0)
+    fleet = make_fleet(params, cfg, max_len=16, replicas=1, kv_block=4,
+                       est_token_s=0.01, autoscale=pol, steal=False,
+                       share_prefix=True, host_spill=True,
+                       host_blocks=64, prefix_keep_blocks=16)
+    got = fleet(prompts, 6, slots=2)
+    _assert_all_equal(got, want, "scale-up:")
+    st = fleet.last_stats["fleet"]
+    sc = st["scale"]
+    assert st["served"] == len(prompts) and st["shed"] == 0
+    assert sc["ups_executed"] == sc["ups_planned"] == 2
+    assert sc["final_live"] == 3 and sc["spawn_failures"] == 0
+    assert [e["trigger"] for e in sc["events"]
+            if e["kind"] == "up"] == ["backlog", "backlog"]
+    events1 = sc["events"]
+    # every replica drained its pool (the leak invariant crosses the
+    # elastic plane unchanged)
+    for rs in fleet.last_stats["replica_stats"]:
+        if rs is not None:
+            assert rs["kv"]["in_use"] == 0
+    # the run's close published the retained working set fleet-wide
+    assert sc["warm_store"]["chains"] > 0
+    # round 2: same trace ⇒ same schedule (determinism), and the
+    # joiners now take their keyspace share WARM from the store
+    got2 = fleet(prompts, 6, slots=2)
+    _assert_all_equal(got2, want, "scale-up round 2:")
+    sc2 = fleet.last_stats["fleet"]["scale"]
+    assert sc2["events"] == events1
+    assert sc2["warm_joins"] >= 1 and sc2["warm_chains_primed"] >= 1
+    warm = [rs["prefix"]["warm"]
+            for rs in fleet.last_stats["replica_stats"] if rs]
+    assert sum(w["seeded_chains"] for w in warm) >= 1
+    assert sum(w["seeded_blocks"] for w in warm) >= 1
+    # the seeded chains were HIT through the tiered swap-in path —
+    # warm bring-up converts to real prefix hits, not just bytes
+    spill = fleet.last_stats["fleet"]["spill"]
+    assert spill["host_hit_blocks"] >= 1
+
+
+def test_fleet_scale_churn_with_faults_bit_exact_tier1():
+    """THE seeded churn gate (ISSUE 15 acceptance): burst → idle →
+    burst arrivals drive join/drain churn while a fault profile lands
+    BOTH hard compositions — a kill aimed at a not-yet-joined replica
+    (kill-during-bring-up) and a drain racing it on the base replica
+    (drain-racing-kill) — and every request still completes bit-exact,
+    with the whole (policy, profile, trace) triple replaying
+    identically."""
+    cfg, params, prompts = _setup(n=20)
+    want = _want(n=20)
+    # burst → sparse → burst → sparse: joins under both bursts, policy
+    # drains in both gaps, while the profile drains base replica 0 and
+    # kills joiner 2 during its bring-up window
+    arrivals = tuple([0.0] * 6 + [0.6 + 0.05 * i for i in range(4)]
+                     + [1.4 + 0.03 * i for i in range(5)]
+                     + [2.2 + 0.2 * i for i in range(5)])
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=4,
+                          up_backlog=2.0, down_backlog=0.4,
+                          cooldown_s=0.05, seed=0)
+    profile = FleetFaultProfile(
+        [FleetFault("drain_replica", target=0, at_s=0.05),
+         FleetFault("kill_replica", target=2, at_s=0.06)], seed=1)
+    fleet = make_fleet(params, cfg, max_len=16, replicas=2, kv_block=4,
+                       est_token_s=0.02, autoscale=pol, faults=profile,
+                       steal=False)
+    got = fleet(prompts, 6, slots=2, arrivals=arrivals)
+    _assert_all_equal(got, want, "churn:")
+    st = fleet.last_stats["fleet"]
+    sc, fr = st["scale"], st["faults"]
+    assert st["served"] == len(prompts) and st["shed"] == 0
+    # replica 2 is a JOINER (base size 2): the kill could only land
+    # during/after its bring-up — the composition the gate exists for
+    assert fr["killed"] == ["replica-2"]
+    assert fr["drained"] == ["replica-0"]
+    assert sc["ups_executed"] >= 2 and sc["downs"] >= 1
+    assert len(sc["scaled_down"]) == sc["downs"]
+    assert fr["redriven"] >= 1
+    # replay: the full composed schedule is deterministic
+    got2 = fleet(prompts, 6, slots=2, arrivals=arrivals)
+    _assert_all_equal(got2, want, "churn replay:")
+    st2 = fleet.last_stats["fleet"]
+    assert st2["scale"]["events"] == sc["events"]
+    assert st2["faults"]["killed"] == fr["killed"]
+
+
+# ------------------------------------------------------- slow matrix
+
+
+@pytest.mark.slow
+def test_fleet_autoscaled_equals_fixed_fleet_per_request():
+    """The direct form of the undisturbed-trace acceptance gate: the
+    autoscaled fleet's per-request outputs equal the FIXED fleet's on
+    the same trace (not just solo — the two fleets are compared to
+    each other), shed sets included."""
+    cfg, params, prompts = _setup()
+    fixed = make_fleet(params, cfg, max_len=16, replicas=3, kv_block=4,
+                       est_token_s=0.01, steal=False)
+    got_fixed = fixed(prompts, 6, slots=2)
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=3,
+                          up_backlog=2.0, down_backlog=0.25,
+                          cooldown_s=0.0, seed=0)
+    elastic = make_fleet(params, cfg, max_len=16, replicas=1,
+                         kv_block=4, est_token_s=0.01, autoscale=pol,
+                         steal=False)
+    got = elastic(prompts, 6, slots=2)
+    assert elastic.last_stats["fleet"]["scale"]["ups_executed"] >= 1
+    for i, (g, w) in enumerate(zip(got, got_fixed)):
+        assert (g is None) == (w is None), f"shed set diverged at {i}"
+        if g is not None:
+            assert jnp.array_equal(g, w), f"request {i} diverged"
+
+
+@pytest.mark.slow
+def test_fleet_spawn_failure_is_classified_and_redrives():
+    """A joiner whose engine build fails EVERY retry is classified
+    dead — its planned requests redrive to survivors (bit-exact), the
+    failure and its retries are billed, and the run completes instead
+    of hanging on a replica that never came up."""
+    import nvidia_terraform_modules_tpu.models.fleet as fleet_mod
+
+    cfg, params, prompts = _setup()
+    want = _want()
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=2,
+                          up_backlog=2.0, down_backlog=0.25,
+                          cooldown_s=0.0, seed=0)
+    fleet = make_fleet(params, cfg, max_len=16, replicas=1, kv_block=4,
+                       est_token_s=0.01, autoscale=pol, steal=False)
+    real = fleet_mod.make_serve_engine
+    fleet_mod.make_serve_engine = _always_fails
+    try:
+        got = fleet(prompts, 6, slots=2)
+    finally:
+        fleet_mod.make_serve_engine = real
+    _assert_all_equal(got, want, "spawn failure:")
+    st = fleet.last_stats["fleet"]
+    sc = st["scale"]
+    assert sc["ups_planned"] >= 1 and sc["ups_executed"] == 0
+    assert sc["spawn_failures"] >= 1 and sc["spawn_retries"] >= 1
+    assert st["served"] == len(prompts)
+    # the dead joiner is visible, its planned requests were redriven
+    dead = [r for r in st["per_replica"] if r.get("spawned") is False]
+    assert len(dead) >= 1 and all(r["dead"] for r in dead)
+
+
+def _always_fails(*a, **k):
+    raise RuntimeError("injected spawn failure")
+
+
+@pytest.mark.slow
+def test_fleet_spawn_transient_failure_retries_then_joins():
+    """The retry half of the spawn contract: a build that fails once
+    and then succeeds costs a billed retry, never the ring its joiner
+    — the fleet still scales up and serves bit-exactly."""
+    import nvidia_terraform_modules_tpu.models.fleet as fleet_mod
+
+    cfg, params, prompts = _setup()
+    want = _want()
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=2,
+                          up_backlog=2.0, down_backlog=0.25,
+                          cooldown_s=0.0, seed=0)
+    fleet = make_fleet(params, cfg, max_len=16, replicas=1, kv_block=4,
+                       est_token_s=0.01, autoscale=pol, steal=False)
+    real = fleet_mod.make_serve_engine
+    state = {"n": 0}
+
+    def flaky(*a, **k):
+        state["n"] += 1
+        if state["n"] == 1:
+            raise RuntimeError("transient build failure")
+        return real(*a, **k)
+
+    fleet_mod.make_serve_engine = flaky
+    try:
+        got = fleet(prompts, 6, slots=2)
+    finally:
+        fleet_mod.make_serve_engine = real
+    _assert_all_equal(got, want, "flaky spawn:")
+    sc = fleet.last_stats["fleet"]["scale"]
+    assert sc["ups_executed"] >= 1
+    assert sc["spawn_retries"] >= 1 and sc["spawn_failures"] == 0
+
+
+@pytest.mark.slow
+def test_fleet_scale_down_drains_and_publishes():
+    """A scale-down is a PLANNED drain: the drained replica finishes
+    its in-flight work (never marked dead), its queued work moves, the
+    fleet-size ledger shrinks, and its retained chains land in the
+    warm store for successors — billed in ``published_chains``."""
+    cfg, params, prompts = _setup(n=16)
+    want = _want(n=16)
+    arrivals = tuple([0.0] * 6 + [0.8 + 0.1 * i for i in range(10)])
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=3,
+                          up_backlog=2.0, down_backlog=0.5,
+                          cooldown_s=0.05, seed=0)
+    fleet = make_fleet(params, cfg, max_len=16, replicas=2, kv_block=4,
+                       est_token_s=0.02, autoscale=pol, steal=False,
+                       share_prefix=True, host_spill=True,
+                       host_blocks=64, prefix_keep_blocks=16)
+    got = fleet(prompts, 6, slots=2, arrivals=arrivals)
+    _assert_all_equal(got, want, "scale-down:")
+    st = fleet.last_stats["fleet"]
+    sc = st["scale"]
+    assert sc["downs"] >= 1 and len(sc["scaled_down"]) >= 1
+    assert sc["final_live"] < sc["initial"] + sc["ups_executed"]
+    # a scale-down is not degradation: no faults armed, so no fault
+    # record at all — and the drained replica reports stats (alive)
+    assert st["faults"] is None
+    by_label = {r["replica"]: r for r in st["per_replica"]}
+    for lbl in sc["scaled_down"]:
+        assert by_label[lbl]["dead"] is False
+    assert sc["warm_store"]["chains"] >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fleet_scale_churn_matrix(seed):
+    """Preemption-churn storms across seeds: seeded join/leave churn
+    from ``fault_times``-style bursty arrivals composes with a seeded
+    kill, and every seed's every request stays bit-exact."""
+    from nvidia_terraform_modules_tpu.utils.traffic import (
+        fault_times,
+        poisson_trace,
+    )
+
+    cfg, params, prompts = _setup(n=16)
+    want = _want(n=16)
+    arrivals = tuple(poisson_trace(30.0, len(prompts),
+                                   seed=f"churn-{seed}"))
+    kill_at = fault_times(arrivals, 1, seed=f"churn-kill-{seed}")[0]
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=3,
+                          up_backlog=2.0, down_backlog=0.4,
+                          cooldown_s=0.03, seed=seed)
+    profile = FleetFaultProfile(
+        [FleetFault("kill_replica", target=1, at_s=kill_at)],
+        seed=seed)
+    fleet = make_fleet(params, cfg, max_len=16, replicas=1, kv_block=4,
+                       est_token_s=0.02, autoscale=pol, faults=profile,
+                       steal=False)
+    got = fleet(prompts, 6, slots=2, arrivals=arrivals)
+    _assert_all_equal(got, want, f"churn seed {seed}:")
+    st = fleet.last_stats["fleet"]
+    assert st["served"] == len(prompts) and st["shed"] == 0
+
+
+@pytest.mark.slow
+def test_fleet_elastic_fault_target_beyond_realised_fleet_raises():
+    """Per-call validation (the elastic twin of resolve-time shape
+    checks): a fault aimed at a replica id the realised fleet never
+    reaches — the policy joined fewer than the target needs — is a
+    loud error naming the realised size, never a silently unfired
+    fault."""
+    cfg, params, prompts = _setup()
+    pol = AutoscalePolicy(min_replicas=2, max_replicas=4,
+                          up_backlog=1e9, down_backlog=0.0, seed=0)
+    profile = FleetFaultProfile(
+        [FleetFault("kill_replica", target=3, at_s=0.05)], seed=0)
+    fleet = make_fleet(params, cfg, max_len=16, replicas=2, kv_block=4,
+                       est_token_s=0.01, autoscale=pol, faults=profile,
+                       steal=False)
+    with pytest.raises(ValueError, match="realises only 2"):
+        fleet(prompts, 6, slots=2)

@@ -14,6 +14,7 @@ bit-match contract in test_serving.py).
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from nvidia_terraform_modules_tpu.models import BurnInConfig, init_params
@@ -921,3 +922,171 @@ def test_reclaim_blocked_reports_why_zero():
     assert idx.reclaim_blocked is None          # fruitful: cleared
     assert idx.reclaim(1) == 0
     assert idx.reclaim_blocked == "empty"
+
+
+# --------------------------------------- elastic-fleet state migration
+
+
+def test_chain_key_names_whole_history_and_matches_index():
+    """``chain_key`` is THE chain name — one definition shared by the
+    index, the fleet's routing and the warm store: the key of
+    ``chunks[:k]`` equals the index's own internal key for that node,
+    prefix-dependent (same chunk under a different parent gets a
+    different key), and ``upto=1`` is the routing root."""
+    from nvidia_terraform_modules_tpu.models.fleet import affinity_key
+    from nvidia_terraform_modules_tpu.models.paging import chain_key
+
+    toks = list(range(12))
+    chunks = chain_chunks(toks, 4)
+    a, idx = _index_pool()
+    donor = a.alloc(3)
+    idx.register(chunks, donor)
+    # the index filed each node under exactly chain_key(chunks, k)
+    for k in range(1, len(chunks) + 1):
+        assert chain_key(chunks, k) in idx._entries
+    # the routing root is the same key the fleet routes on
+    assert chain_key(chunks, 1) == affinity_key(jnp.asarray(toks), 4)
+    # prefix dependence: the same chunk at another depth renames
+    assert chain_key([chunks[1]]) != chain_key(chunks, 2)
+    with pytest.raises(ValueError, match=">= 1"):
+        chain_key(chunks, 0)
+
+
+def test_export_chains_read_only_mru_first_both_tiers():
+    """The drain-time PUBLISH walk: every maximal chain comes back
+    root-first with its (tier, id) pairs, most-recently-used leaf
+    first across chains — and the walk takes no references, moves no
+    LRU order, and never touches a counter (the publish path must be
+    invisible to eviction accounting)."""
+    cfg, pool, a, host, idx = _tiered_setup(cap=0)
+    ca = chain_chunks(list(range(8)), 4)         # 2 blocks
+    da = a.alloc(2)
+    idx.register(ca, da)
+    a.free(da)
+    idx.trim()                                   # cap 0: whole chain spills
+    # swap ONLY the root back in: a genuinely mixed-tier chain
+    _dev, tail = idx.match_tiered(ca)
+    (k_root, h_root), _leaf = tail
+    fresh = a.alloc(1)
+    import_block_rows(pool, fresh, host.load([h_root]))
+    idx.promote([k_root], fresh)
+    cb = chain_chunks([7, 7, 7, 7], 4)           # fresh device chain
+    db = a.alloc(1)
+    idx.register(cb, db)
+    refs0, in_use0 = a.refs_total, a.in_use
+    order0 = list(idx._entries)
+    out = idx.export_chains()
+    # MRU leaf first: cb registered last, so it leads
+    assert [c for c, _ids in out] == [cb, ca]
+    tiers = {tuple(map(tuple, c)): [t for t, _b in ids]
+             for c, ids in out}
+    assert tiers[tuple(map(tuple, ca))] == ["dev", "host"]
+    assert tiers[tuple(map(tuple, cb))] == ["dev"]
+    # read-only: no refs, no LRU churn, no counters
+    assert (a.refs_total, a.in_use) == (refs0, in_use0)
+    assert list(idx._entries) == order0
+    assert idx.spill_dropped == 0 and idx.spilled_blocks == 2
+    idx.release()
+
+
+def test_seed_host_indexes_adopted_rows_and_swaps_in_tiered():
+    """WARM BRING-UP end to end at the paging layer: rows adopted into
+    the host pool and seeded via ``seed_host`` are host-tier entries
+    that the ordinary tiered match swaps in bitwise — a joining
+    replica's inherited working set rides the EXISTING crc-verified
+    path, no new read machinery."""
+    cfg, pool, a, host, idx = _tiered_setup(cap=4)
+    chunks = chain_chunks(list(range(8)), 4)
+    donor = a.alloc(2)
+    idx.register(chunks, donor)
+    before = export_block_rows(pool, donor)
+    stored = host.store(pool, donor)
+    payload = host.load(stored)                  # wire-format copy
+    host.free(stored)
+    a.free(donor)
+    idx.release()                                # the "old" replica dies
+    assert a.in_use == 0 and host.in_use == 0
+
+    # the joiner: fresh index, adopt + seed
+    idx2 = PrefixIndex(a, 4, spill=idx.spill)
+    hids = host.adopt(payload)
+    assert idx2.seed_host(chunks, hids) == 2
+    assert len(idx2.host_tier) == 2
+    dev, tail = idx2.match_tiered(chunks)
+    assert dev == [] and len(tail) == 2
+    fresh = a.alloc(2)
+    got = host.load([h for _k, h in tail])
+    pool2 = import_block_rows(pool, fresh, got)
+    idx2.promote([k for k, _h in tail], fresh)
+    after = export_block_rows(pool2, fresh)
+    for key in pool_transfer_keys(pool):
+        for li in range(cfg.n_layers):
+            assert jnp.array_equal(before[key][li], after[key][li])
+    a.free(fresh)
+    idx2.release()
+    assert a.in_use == 0 and host.in_use == 0
+
+
+def test_seed_host_dedups_against_existing_entries_and_validates():
+    """A seeded chain node already indexed (a prior seed, or the
+    joiner's own traffic got there first) keeps the existing entry and
+    the duplicate adopted row goes BACK to the pool — seeding can
+    never leak host rows or fork a chain. Shape errors are loud."""
+    cfg, pool, a, host, idx = _tiered_setup(cap=4)
+    chunks = chain_chunks(list(range(8)), 4)
+    payload = {k: [np.asarray(b)[:2] for b in bufs]
+               for k, bufs in host._bufs.items()}
+    h1 = host.adopt(payload)
+    assert idx.seed_host(chunks, h1) == 2
+    h2 = host.adopt(payload)
+    assert idx.seed_host(chunks, h2) == 0        # all dups
+    assert host.in_use == 2                      # dup rows released
+    with pytest.raises(ValueError, match="2 chunks for 1"):
+        idx.seed_host(chunks, [0])
+    bare = PrefixIndex(a, 4)                     # no spill adapter
+    with pytest.raises(ValueError, match="spill"):
+        bare.seed_host(chunks, [0, 1])
+    idx.release()
+    assert host.in_use == 0
+
+
+def test_drain_publish_never_double_counts_spill_dropped():
+    """THE ISSUE 15 regression pin: a drain that publishes retained
+    chains while a pressure reclaim has already billed its drops must
+    not bill ``spill_dropped`` again — the publish walk is read-only
+    (a refused publish is the SINK's accounting, ``store_full_drops``),
+    so eviction drops are counted exactly once however the drain and
+    the reclaim interleave."""
+    from nvidia_terraform_modules_tpu.models.hostkv import WarmChainStore
+
+    cfg, pool, a, host, idx = _tiered_setup(host_blocks=2, cap=0)
+    ca = chain_chunks([5] * 8, 4)                # 2 blocks: spills
+    da = a.alloc(2)
+    idx.register(ca, da)
+    cb = chain_chunks(list(range(12)), 4)        # 3 blocks: dropped
+    db = a.alloc(3)
+    idx.register(cb, db)
+    a.free(da)
+    a.free(db)
+    # the in-flight pressure reclaim: spills ca, drops cb (billed ONCE)
+    assert idx.reclaim(5) == 5
+    assert idx.spill_dropped == 3
+    # the racing drain publishes what survived — into a store too
+    # SMALL to ever take it, the worst case for double-billing
+    store = WarmChainStore(cfg, 1, block_size=4)
+    chains = []
+    for chunks, ids in idx.export_chains():
+        hst = [b for t, b in ids if t == "host"]
+        chains.append((chunks, host.load(hst)))
+    stored = store.publish(chains)
+    # the full store refused it — billed in the SINK's ledger only;
+    # the eviction counter never moved
+    assert stored == 0
+    assert store.stats()["store_full_drops"] == 1
+    assert idx.spill_dropped == 3                # pinned: no recount
+    # and a store WITH room takes it without touching the counter
+    roomy = WarmChainStore(cfg, 4, block_size=4)
+    assert roomy.publish(chains) == 1
+    assert idx.spill_dropped == 3
+    idx.release()
+    assert host.in_use == 0

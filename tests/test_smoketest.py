@@ -84,6 +84,14 @@ def test_burnin_level(jax8):
     assert r.checks["kv_spill_ok"]
     assert r.checks["kv_spill_swapins"] >= 1
     assert r.checks["kv_spill_spilled_blocks"] > 0
+    # the elastic-fleet gate (ISSUE 15): a seeded scale-up→churn→
+    # scale-down run bit-matches the single-engine baseline twice
+    # over, the schedule replays identically, and the second run's
+    # joiner inherits the published working set WARM — host-tier
+    # seeds converting to real prefix hits, both tiers drained
+    assert r.checks["fleet_scale_ok"]
+    assert r.checks["fleet_scale_warm_blocks"] >= 1
+    assert r.checks["fleet_scale_joiner_hits"] > 0
 
 
 @pytest.mark.slow

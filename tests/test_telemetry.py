@@ -935,3 +935,89 @@ def test_tiered_kv_spill_gauges_export(jax8, tmp_path):
                  "# TYPE prefix_swapin_ms gauge",
                  "# TYPE prefix_host_hit_frac gauge"):
         assert line in prom, line
+
+
+def test_fleet_scale_gauge_counters_and_span_export(jax8, tmp_path):
+    """ISSUE 15's elastic-fleet telemetry, golden-tested on one
+    registry: the ``fleet_size`` gauge tracks the live replica count
+    through a scale-up → scale-down run, every executed event bills
+    ``fleet_scale_up_total``/``fleet_scale_down_total`` exactly once,
+    and each event emits a ``fleet_scale`` span whose args carry the
+    trigger and the replica id — stitched on the SAME timeline as the
+    route/serve spans. A fixed-size fleet on a fresh registry keeps
+    every scale instrument silent."""
+    import jax
+    import jax.numpy as jnp
+
+    from nvidia_terraform_modules_tpu.models import (
+        AutoscalePolicy,
+        BurnInConfig,
+        init_params,
+        make_fleet,
+    )
+
+    cfg = BurnInConfig(vocab=64, d_model=32, n_heads=2, d_ff=64,
+                       n_layers=1, seq_len=16, batch=2)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tmpls = [jax.random.randint(jax.random.PRNGKey(3 + t), (4,), 0, 64)
+             for t in range(3)]
+    prompts = [jnp.concatenate(
+        [tmpls[i % 3], jax.random.randint(jax.random.PRNGKey(50 + i),
+                                          (1 + i % 2,), 0, 64)])
+        for i in range(12)]
+    # burst then sparse tail: joins under the burst, a policy drain in
+    # the tail — both sides of the ledger exercised in one run
+    arrivals = [0.0] * 8 + [0.8 + 0.2 * i for i in range(4)]
+    reg = Registry(str(tmp_path))
+    fleet = make_fleet(
+        params, cfg, max_len=12, replicas=2, kv_block=4, telemetry=reg,
+        steal=False, est_token_s=0.02,
+        autoscale=AutoscalePolicy(min_replicas=1, max_replicas=4,
+                                  up_backlog=2.0, down_backlog=0.4,
+                                  cooldown_s=0.05, seed=0))
+    outs = fleet(prompts, 5, slots=2, arrivals=arrivals)
+    assert all(o is not None for o in outs)
+    sc = fleet.last_stats["fleet"]["scale"]
+    assert sc["ups_executed"] >= 1 and sc["downs"] >= 1
+
+    # the gauge ends at the final live size; counters bill per event
+    assert reg.gauge("fleet_size").value == sc["final_live"]
+    assert reg.counter("fleet_scale_up_total").value \
+        == sc["ups_executed"]
+    assert reg.counter("fleet_scale_down_total").value == sc["downs"]
+    prom = reg.prometheus_text()
+    for line in ("# TYPE fleet_size gauge",
+                 f"fleet_size {sc['final_live']}",
+                 "# TYPE fleet_scale_up_total counter",
+                 f"fleet_scale_up_total {sc['ups_executed']}",
+                 "# TYPE fleet_scale_down_total counter",
+                 f"fleet_scale_down_total {sc['downs']}"):
+        assert line in prom, line
+
+    # one fleet_scale span per executed event, args = trigger + id
+    spans = [e for e in reg.events
+             if e["kind"] == "span" and e["name"] == "fleet_scale"]
+    ups = [s for s in spans if s["args"]["kind"] == "up"]
+    downs = [s for s in spans if s["args"]["kind"] == "down"]
+    assert len(ups) == sc["ups_executed"]
+    assert len(downs) == sc["downs"]
+    for s in ups:
+        assert s["args"]["trigger"] in ("backlog", "deadline_slack")
+        assert s["args"]["replica"].startswith("replica-")
+        assert "warm" in s["args"]
+    for s in downs:
+        assert s["args"]["trigger"] == "low_load"
+        assert s["args"]["replica"] in sc["scaled_down"]
+    xs = chrome_trace(reg.events)["traceEvents"]
+    names = {e["name"] for e in xs if e["ph"] == "X"}
+    assert {"fleet_scale", "fleet_route", "serve_request"} <= names
+
+    # a fixed fleet on a fresh registry: every scale instrument silent
+    reg2 = Registry(str(tmp_path / "fixed"))
+    quiet = make_fleet(params, cfg, max_len=12, replicas=2, kv_block=4,
+                       telemetry=reg2, steal=False)
+    quiet(prompts, 4, slots=2)
+    assert reg2.counter("fleet_scale_up_total").value == 0
+    assert reg2.counter("fleet_scale_down_total").value == 0
+    assert not [e for e in reg2.events
+                if e["kind"] == "span" and e["name"] == "fleet_scale"]

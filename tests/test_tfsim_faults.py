@@ -1091,3 +1091,118 @@ def test_serving_no_host_ram_silent_without_wiring_or_floor(tmp_path):
     assert _lint_host_ram(_write(tmp_path, training)) == []
     v4 = _SPILL_POOL % ("host_spill", "serve-v4", "ct4p-hightpu-4t", "")
     assert _lint_host_ram(_write(tmp_path, v4)) == []
+
+
+# -------------------------------------- unused serving autoscaler range
+# (`tpu-serving-autoscaler-unused`: the INVERSE of the headroom rule —
+# a serving pool declaring autoscaler bounds that no workload consumes
+# pays for capacity the fixed-size fleet never joins)
+
+_ELASTIC_POOL = """
+%s
+resource "google_container_cluster" "c" {
+  name = "c"
+}
+
+resource "google_container_node_pool" "pool_a" {
+  name    = "%s"
+  cluster = google_container_cluster.c.name
+
+  autoscaling {
+    min_node_count = %d
+    max_node_count = %d
+  }
+
+  node_config {
+    machine_type = "ct5lp-hightpu-4t"
+  }
+}
+"""
+
+
+def _lint_autoscaler_unused(path):
+    from nvidia_terraform_modules_tpu.tfsim.lint import run_lint
+
+    return [f for f in run_lint(path)
+            if f.rule == "tpu-serving-autoscaler-unused"]
+
+
+def test_serving_autoscaler_unused_fires_without_wiring(tmp_path):
+    """Serving-named TPU pool with real headroom (1→4) and no
+    autoscale wiring anywhere in the module — the exact declared-but-
+    unconsumed shape the rule exists for, with the runtime remedy
+    (make_fleet autoscale=) and the runbook in the message."""
+    body = _ELASTIC_POOL % ("", "serve-v5e", 1, 4)
+    findings = _lint_autoscaler_unused(_write(tmp_path, body))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.severity == "warning"
+    assert "max_node_count = 4" in f.message
+    assert "min_node_count = 1" in f.message
+    assert "autoscale=" in f.message
+    assert "tpu-spot-serving-no-headroom" in f.message
+    assert "fleet_size" in f.message
+
+
+def test_serving_autoscaler_unused_fires_despite_infra_range_vars(
+        tmp_path):
+    """The pool's OWN range parameterization is the infra side, not a
+    consumer: a module whose only 'autoscaling'-shaped name is the
+    variable feeding the autoscaling block itself still fires — else
+    the rule would silence on exactly the declared-but-unconsumed
+    modules it targets."""
+    body = _ELASTIC_POOL % (
+        'variable "autoscaling_max_node_count" {\n'
+        '  type    = number\n  default = 4\n}\n',
+        "serve-v5e", 1, 4)
+    findings = _lint_autoscaler_unused(_write(tmp_path, body))
+    assert len(findings) == 1
+
+
+def test_serving_autoscaler_unused_silent_when_wired(tmp_path):
+    """Any statically visible consumer silences the rule: a
+    min/max_replicas-style variable in the module API, or a pod env
+    var carrying the bounds to the serving runtime."""
+    wired_var = _ELASTIC_POOL % (
+        'variable "fleet_max_replicas" {\n'
+        '  type    = number\n  default = 4\n}\n',
+        "serve-v5e", 1, 4)
+    assert _lint_autoscaler_unused(_write(tmp_path, wired_var)) == []
+    wired_policy = _ELASTIC_POOL % (
+        'variable "autoscale_policy" {\n'
+        '  type    = string\n  default = "backlog"\n}\n',
+        "serve-v5e", 1, 4)
+    assert _lint_autoscaler_unused(_write(tmp_path, wired_policy)) == []
+    wired_env = (_ELASTIC_POOL % ("", "serve-v5e", 1, 4)) + """
+resource "kubernetes_deployment" "srv" {
+  spec {
+    template {
+      spec {
+        container {
+          image = "serve:latest"
+          env {
+            name  = "TPU_FLEET_MAX_REPLICAS"
+            value = "4"
+          }
+        }
+      }
+    }
+  }
+}
+"""
+    assert _lint_autoscaler_unused(_write(tmp_path, wired_env)) == []
+
+
+def test_serving_autoscaler_unused_silent_without_shape_or_range(
+        tmp_path):
+    """The other legs: a training-shaped pool → silent (no serving
+    fleet to consume bounds); a PINNED range (min == max) → silent
+    (that posture is `tpu-spot-serving-no-headroom`'s call); a
+    non-TPU machine type → silent (not this family's rule)."""
+    training = _ELASTIC_POOL % ("", "train-v5e", 1, 4)
+    assert _lint_autoscaler_unused(_write(tmp_path, training)) == []
+    pinned = _ELASTIC_POOL % ("", "serve-v5e", 2, 2)
+    assert _lint_autoscaler_unused(_write(tmp_path, pinned)) == []
+    non_tpu = (_ELASTIC_POOL % ("", "serve-cpu", 1, 4)).replace(
+        "ct5lp-hightpu-4t", "n2-standard-8")
+    assert _lint_autoscaler_unused(_write(tmp_path, non_tpu)) == []
