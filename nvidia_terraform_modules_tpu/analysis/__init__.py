@@ -1,0 +1,22 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Static analysis for the repo's two languages, on one rule engine.
+
+* :mod:`.core` — the language-agnostic machinery (Finding, Registry,
+  severity overrides, suppressions, exit codes, JSON/SARIF), shared by
+  ``tfsim lint`` (HCL) and ``graftlint`` (Python);
+* :mod:`.graftlint` + :mod:`.rules_graft` — the runtime-convention
+  rule pack over this package's JAX serving stack;
+* :mod:`.lockgraph` — static lock-acquisition-order graph + cycles;
+* :mod:`.lockwatch` — the runtime lock-order watchdog chaos tests arm.
+
+``python -m nvidia_terraform_modules_tpu.analysis`` is the CLI.
+
+This module imports no heavy dependencies (no jax, no numpy): the
+smoketest preflight and the tfsim CLI both pull it in before any
+device exists.
+"""
+
+from .core import SEVERITIES, Finding, Registry, Rule, exit_code  # noqa: F401
+from .graftlint import list_rules, run_graftlint  # noqa: F401
+from .pysrc import PyContext  # noqa: F401

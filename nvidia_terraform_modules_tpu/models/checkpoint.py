@@ -168,6 +168,7 @@ def _parse_step(name: str) -> Optional[int]:
 def _world() -> tuple[int, int]:
     try:
         return jax.process_index(), jax.process_count()
+    # graftlint: ignore[graft-silent-except] — backend probe by design
     except Exception:  # pre-init / no backend: single-process semantics
         return 0, 1
 
@@ -497,6 +498,9 @@ class _LocalStore:
         age-gated so an in-flight save on a peer is never swept."""
         if not os.path.isdir(self.root):
             return
+        # ages are computed against filesystem mtimes; epoch time is
+        # the only clock comparable to them
+        # graftlint: ignore[graft-wallclock-nondeterminism] — mtime ages
         now = time.time()
         for name in os.listdir(self.root):
             if not name.startswith(_TMP_PREFIX):

@@ -351,7 +351,7 @@ class WarmChainStore:
 
         return [chain_key(chunks, k) for k in range(1, len(chunks) + 1)]
 
-    def _drop_chain(self, leaf) -> None:
+    def _drop_chain_locked(self, leaf) -> None:
         """Unfile one chain (lock held): decrement every node's ref,
         free rows no surviving chain references."""
         chunks = self._chains.pop(leaf)
@@ -406,7 +406,7 @@ class WarmChainStore:
                     hids = self.pool.adopt(sliced)
                     if hids is not None or not self._chains:
                         break
-                    self._drop_chain(next(iter(self._chains)))
+                    self._drop_chain_locked(next(iter(self._chains)))
                 if hids is None:
                     self.store_full_drops += 1
                     continue
@@ -440,7 +440,7 @@ class WarmChainStore:
                 try:
                     payload = self.pool.load(hids)
                 except HostSpillCorruptError:
-                    self._drop_chain(key)
+                    self._drop_chain_locked(key)
                     self.corrupt_dropped += 1
                     continue
                 self._chains.move_to_end(key)
@@ -451,7 +451,7 @@ class WarmChainStore:
     def clear(self) -> None:
         with self._lock:
             while self._chains:
-                self._drop_chain(next(iter(self._chains)))
+                self._drop_chain_locked(next(iter(self._chains)))
 
     def stats(self) -> dict:
         with self._lock:

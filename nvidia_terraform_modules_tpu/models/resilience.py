@@ -334,11 +334,14 @@ class Heartbeat:
     """
 
     def __init__(self, directory: str, process_id: int,
-                 interval_s: float = 2.0):
+                 interval_s: float = 2.0, clock=time.time):
         self.path = os.path.join(directory, _HEARTBEAT_DIR,
                                  f"p{process_id:05d}.json")
         self.process_id = process_id
         self.interval_s = interval_s
+        # epoch clock, injected: stamps are compared across PROCESSES,
+        # so the default must be wallclock — tests inject a virtual one
+        self._clock = clock
         self._step = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -364,7 +367,7 @@ class Heartbeat:
         try:
             with open(tmp, "w") as fh:
                 json.dump({"process": self.process_id, "step": self._step,
-                           "time": time.time(), "pid": os.getpid()}, fh)
+                           "time": self._clock(), "pid": os.getpid()}, fh)
             os.replace(tmp, self.path)
         except OSError:
             pass   # liveness is best-effort; the monitor handles absence
@@ -401,7 +404,7 @@ class HeartbeatMonitor:
 
     def __init__(self, directory: str, num_processes: int,
                  timeout_s: float = 60.0, self_id: Optional[int] = None,
-                 telemetry=None):
+                 telemetry=None, clock=time.time):
         self.directory = os.path.join(directory, _HEARTBEAT_DIR)
         self.num_processes = num_processes
         self.timeout_s = timeout_s
@@ -411,7 +414,10 @@ class HeartbeatMonitor:
 
             telemetry = get_registry()
         self._telemetry = telemetry
-        self._born = time.time()
+        # epoch clock, injected: ages are computed against peer stamps
+        # written by Heartbeat with the same default
+        self._clock = clock
+        self._born = self._clock()
         self._armed: dict[int, dict] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -430,7 +436,7 @@ class HeartbeatMonitor:
 
     def check(self, now: Optional[float] = None) -> list[PeerFailure]:
         """Dead peers as classified failures (empty = everyone lives)."""
-        now = time.time() if now is None else now
+        now = self._clock() if now is None else now
         for k, payload in self.read().items():
             # arm only on a heartbeat from THIS attempt's lifetime;
             # once armed, always track the latest payload

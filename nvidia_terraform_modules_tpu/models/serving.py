@@ -1981,6 +1981,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 active_mask, jnp.int32(stop), granted_rows, rstate.pool)
             # one batched transfer: separate device_gets would pay the
             # host round trip repeatedly in the per-wave hot loop
+            # graftlint: ignore[graft-host-sync-in-loop] — wave boundary
             fin_h, n_out_h, steps_h, need_h, pos_h = jax.device_get(
                 (fin, n_out, steps_inc, need_grow, rstate.pool["pos"]))
             if reg.enabled:
@@ -2581,6 +2582,8 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             if eos_id is not None:
                 eos_pending += 1
                 if eos_check_every == 1:
+                    # exact per-wave eos retirement is this mode's contract
+                    # graftlint: ignore[graft-host-sync-in-loop] — exact eos
                     tok_h = jax.device_get(hist[-1])
                     eos_pending = 0
                     for slot, req in list(active.items()):
@@ -2592,6 +2595,9 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                     # each active request's FIRST eos (only rows since
                     # its admission belong to it) — done_at stays exact,
                     # only the retirement is late
+                    # one flush per W waves is the amortised sync this
+                    # batching exists to provide
+                    # graftlint: ignore[graft-host-sync-in-loop] — amortised
                     block = jax.device_get(
                         jnp.stack(hist[-eos_pending:]))   # [W, slots]
                     base = len(hist) - eos_pending

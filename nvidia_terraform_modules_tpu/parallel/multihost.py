@@ -159,8 +159,11 @@ def _preflight_coordinator(job: JobEnv, budget_s: float) -> float:
     # unbounded attempts under a HARD wall-clock deadline: each connect's
     # timeout is clamped to the time left, so the pre-flight can never
     # overspend its budget into the registration barrier's share
+    # string-seeded jitter: deterministic per target, decorrelated
+    # across targets (each host/port pair walks its own backoff stream)
     delays = RetryPolicy(initial_s=1.0, multiplier=2.0, cap_s=15.0,
-                         max_attempts=10_000).delays(random.Random())
+                         max_attempts=10_000).delays(
+                             random.Random(f"preflight-{host}:{port}"))
     attempt = 0
     last: Exception | None = None
     while True:

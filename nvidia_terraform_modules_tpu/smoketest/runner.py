@@ -97,6 +97,29 @@ def _run_smoketest(
     checks: dict[str, Any] = {"level": level}
     ok = True
 
+    # preflight: graftlint over the installed runtime package, BEFORE
+    # any device/backend touch — an ERROR-severity convention violation
+    # (unseeded RNG, host sync in a wave loop, lock-order cycle) refuses
+    # the chip session outright instead of burning quota to find it
+    from ..analysis import run_graftlint
+
+    # TPU_SMOKETEST_LINT_DIR redirects the scan (tests point it at a
+    # synthetic tree; operators can point it at a vendored overlay)
+    pkg_dir = e.get("TPU_SMOKETEST_LINT_DIR") or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    try:
+        lint_errors = [str(f) for f in run_graftlint(pkg_dir)
+                       if f.severity == "error"]
+    except (OSError, ValueError) as exc:
+        # an unreadable tree must not block a chip session by itself
+        lint_errors = []
+        checks["lint_runtime_error"] = str(exc)
+    checks["lint_runtime_ok"] = not lint_errors
+    if lint_errors:
+        checks["lint_runtime_findings"] = lint_errors
+        return SmokeResult(ok=False, checks=checks,
+                           seconds=time.perf_counter() - t0)
+
     from ..parallel import (
         build_mesh,
         make_rules,

@@ -186,18 +186,19 @@ def _load_state(path: str | None) -> State | None:
     return None
 
 
+# the location filter for every machine-readable surface (validate
+# -json, lint -json, lint -sarif): only real HCL artifacts get file/line
+# annotations — synthetic locations (pseudo-filenames like ``locals``,
+# empty wheres) would make a CI annotator emit rejected/misplaced ones.
+# The machinery is the shared analysis core's; this module binds the
+# HCL suffix set, exactly as the graftlint CLI binds ``.py``.
+_HCL_SUFFIXES = (".tf", ".tfvars", ".hcl", ".example")
+
+
 def _source_location(f) -> tuple[str, int] | None:
-    """``(file, line)`` when a finding points at a real source artifact,
-    else None. THE location filter for every machine-readable surface
-    (validate -json, lint -json, lint -sarif): synthetic locations —
-    pseudo-filenames like ``locals`` (no source suffix) and empty wheres
-    — would make a CI annotator emit rejected/misplaced annotations.
-    Line 0 (module-level findings in a 1-based scheme) means file-only."""
-    fname = f.file
-    if not fname or not fname.endswith((".tf", ".tfvars", ".hcl",
-                                        ".example")):
-        return None
-    return fname, f.line
+    from ..analysis.core import source_location
+
+    return source_location(f, _HCL_SUFFIXES)
 
 
 def _diag_json(f) -> dict:
@@ -250,48 +251,15 @@ def cmd_validate(args) -> int:
 
 
 def _lint_finding_json(f) -> dict:
-    d = {"rule": f.rule, "severity": f.severity, "where": f.where,
-         "message": f.message}
-    loc = _source_location(f)
-    if loc is not None:
-        d["file"] = loc[0]
-        if loc[1] >= 1:
-            d["line"] = loc[1]
-    return d
+    from ..analysis.core import finding_json
+
+    return finding_json(f, _HCL_SUFFIXES)
 
 
 def _lint_sarif(findings, rules) -> dict:
-    """Minimal SARIF 2.1.0 — the format CI annotators and code-scanning
-    UIs ingest natively; ``info`` maps to SARIF's ``note`` level."""
-    level = {"error": "error", "warning": "warning", "info": "note"}
-    results = []
-    for f in findings:
-        r = {"ruleId": f.rule, "level": level.get(f.severity, "warning"),
-             "message": {"text": f.message}}
-        loc = _source_location(f)
-        if loc is not None:
-            region = {"startLine": loc[1]} if loc[1] >= 1 else {}
-            r["locations"] = [{"physicalLocation": {
-                "artifactLocation": {"uri": loc[0]},
-                **({"region": region} if region else {}),
-            }}]
-        results.append(r)
-    return {
-        "version": "2.1.0",
-        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
-        "runs": [{
-            "tool": {"driver": {
-                "name": "tfsim-lint",
-                "rules": [{
-                    "id": r.id,
-                    "shortDescription": {"text": r.summary},
-                    "defaultConfiguration": {
-                        "level": level.get(r.severity, "warning")},
-                } for r in rules],
-            }},
-            "results": results,
-        }],
-    }
+    from ..analysis.core import sarif_report
+
+    return sarif_report(findings, rules, "tfsim-lint", _HCL_SUFFIXES)
 
 
 def cmd_lint(args) -> int:
@@ -334,14 +302,10 @@ def cmd_lint(args) -> int:
                          sort_keys=True))
         return rc
     if getattr(args, "json", False):
-        print(json.dumps({
-            "format_version": "1.0",
-            "clean": rc == 0,
-            "error_count": counts["error"],
-            "warning_count": counts["warning"],
-            "info_count": counts["info"],
-            "findings": [_lint_finding_json(f) for f in findings],
-        }, indent=2, sort_keys=True))
+        from ..analysis.core import findings_json
+
+        print(json.dumps(findings_json(findings, _HCL_SUFFIXES),
+                         indent=2, sort_keys=True))
         return rc
     for f in findings:
         where = f"{f.where}: " if f.where else ""

@@ -5,89 +5,62 @@
 ``tfsim validate`` reproduces the floor the reference enforces
 (``terraform validate`` + conventions); the lint layer is everything
 *above* that floor — the pre-flight analyses that catch a misconfigured
-TPU slice before a multi-hour apply burns quota. This module owns the
-machinery only; the analyses live in the ``rules_*`` modules:
+TPU slice before a multi-hour apply burns quota.
 
-* :class:`Finding` — the one diagnostic record shared by lint AND
-  ``validate`` (which imports it from here, so both surfaces render and
-  serialise identically);
-* :class:`Rule` + the :func:`rule` decorator — the registry. Each rule
-  has a stable id, a family (``tpu`` / ``dead-code`` / ``deprecation`` /
-  ``core``), a default severity, and a check callable;
-* per-rule severity overrides (``-severity rule=level``, level ``off``
-  disables a rule);
-* suppression comments: a ``# tfsim:ignore rule-id[,rule-id]`` comment
-  suppresses matching findings on its own line, or — when the comment
-  stands alone — on the line directly below;
+The MACHINERY — :class:`Finding`, the rule registry, severity
+overrides, suppression comments, exit codes, the JSON/SARIF surfaces —
+is language-agnostic and lives in :mod:`nvidia_terraform_modules_tpu.
+analysis.core`, shared with the Python-side ``graftlint`` pack; this
+module binds it to HCL (same public API as before the factor-out, byte
+for byte) and owns what IS HCL-specific:
+
+* :class:`LintContext` — the per-run module view rules consume: the
+  parsed module, raw file texts, tfvars bodies, loaded local child
+  modules, and the cached ``validate_module`` findings;
+* the ``# tfsim:ignore rule-id[,rule-id]`` suppression marker;
 * :func:`run_lint` — load, run every enabled rule, filter, sort.
 
-Severities order ``error > warning > info``; the CLI exit code is 2 with
-any error, 1 with only warnings, 0 otherwise (info never fails a build).
+The analyses live in the ``rules_*`` modules. Severities order
+``error > warning > info``; the CLI exit code is 2 with any error, 1
+with only warnings, 0 otherwise (info never fails a build).
 """
 
 from __future__ import annotations
 
-import dataclasses
 import os
 import re
-from typing import Callable, Iterable, Optional
+from typing import Optional
 
+from ...analysis.core import (  # noqa: F401  (re-exported shared API)
+    SEVERITIES,
+    Finding,
+    Registry,
+    Rule,
+    exit_code,
+    ignore_ids,
+    scan_suppressions,
+)
 from ..module import Module, load_module
 from ..parser import parse_hcl
 
-SEVERITIES = ("error", "warning", "info")
+_REGISTRY = Registry(
+    "tfsim-lint",
+    catalog_hint="(see `tfsim lint -rules` for the catalog)")
 
-
-@dataclasses.dataclass
-class Finding:
-    severity: str   # "error" | "warning" | "info"
-    where: str      # file:line
-    message: str
-    rule: str = ""  # stable rule id ("" for pre-lint validate callers)
-
-    def __str__(self) -> str:
-        # validate's historical rendering, unchanged: the lint CLI formats
-        # findings itself (file-first, rule-id suffix) for CI annotators
-        return f"{self.severity}: {self.where}: {self.message}"
-
-    @property
-    def file(self) -> str:
-        return self.where.rpartition(":")[0]
-
-    @property
-    def line(self) -> int:
-        tail = self.where.rpartition(":")[2]
-        return int(tail) if tail.isdigit() else 0
-
-
-@dataclasses.dataclass(frozen=True)
-class Rule:
-    id: str
-    severity: str        # default; overridable per run
-    family: str          # "tpu" | "dead-code" | "deprecation" | "core"
-    summary: str
-    check: Callable[["LintContext"], Iterable]
-
-
-RULES: dict[str, Rule] = {}
+# the module-level dict rules_* and tests address directly — THE registry
+# storage, not a copy (the shared Registry mutates this very mapping)
+RULES: dict[str, Rule] = _REGISTRY.rules
 
 
 def rule(id: str, *, severity: str, family: str, summary: str):
     """Register a rule. The check yields ``(where, message)`` pairs —
     stamped with the rule's severity — or full :class:`Finding`s when a
     single rule emits mixed severities (the validate bridge)."""
-    if severity not in SEVERITIES:
-        raise ValueError(f"rule {id!r}: bad default severity {severity!r}")
-
-    def deco(fn):
-        if id in RULES:
-            raise ValueError(f"duplicate rule id {id!r}")
-        RULES[id] = Rule(id=id, severity=severity, family=family,
-                         summary=summary, check=fn)
-        return fn
-    return deco
+    return _REGISTRY.rule(id, severity=severity, family=family,
+                          summary=summary)
 
 
+@_REGISTRY.loader
 def _ensure_rules_loaded() -> None:
     """Import the rule modules exactly once (lazy: ``validate`` imports
     this module for :class:`Finding`, and the core rules import validate
@@ -223,54 +196,31 @@ _IGNORE_RE = re.compile(r"#\s*tfsim:ignore[:]?\s+([A-Za-z0-9_*,\- ]+)")
 
 
 def _ignore_ids(tail: str) -> set:
-    """The suppressed rule ids in an ignore comment's tail.
-
-    The id list ends at the first token that is not a registered rule id
-    (or ``*``): free prose after the list — "tfsim:ignore unused-variable
-    until the v2 API lands" — must never suppress extra rules just
-    because a rule id happens to be an ordinary word ("core-ref",
-    "unused-local") someone typed in an explanation.
-    """
-    ids: set = set()
-    for tok in re.split(r"[,\s]+", tail.strip()):
-        if not tok:
-            continue
-        if tok != "*" and tok not in RULES:
-            break
-        ids.add(tok)
-    return ids
+    """The suppressed rule ids in an ignore comment's tail (shared
+    semantics: the id list ends at the first non-rule-id token, so free
+    prose after the list never suppresses extra rules)."""
+    return ignore_ids(tail, RULES)
 
 
 def collect_suppressions(ctx: LintContext) -> dict[tuple[str, int], set]:
-    """(fname, line) → rule-ids suppressed there.
+    """(fname, line) → rule-ids suppressed there (shared semantics: a
+    trailing comment covers its own line, a standalone comment line the
+    next line, ``*`` everything at that location)."""
 
-    A trailing comment covers its own line; a standalone comment line
-    covers the next line (the idiomatic "annotate the finding above it"
-    placement). ``*`` suppresses every rule at that location.
-    """
-    out: dict[tuple[str, int], set] = {}
-    for fname in ctx.lintable_files():
-        try:
-            lines = ctx.text(fname).splitlines()
-        except OSError:
-            continue
-        for i, raw in enumerate(lines, start=1):
-            m = _IGNORE_RE.search(raw)
-            if not m:
+    def files():
+        for fname in ctx.lintable_files():
+            try:
+                yield fname, ctx.text(fname)
+            except OSError:
                 continue
-            ids = _ignore_ids(m.group(1))
-            if not ids:
-                continue
-            target = i + 1 if raw.lstrip().startswith("#") else i
-            out.setdefault((fname, target), set()).update(ids)
-    return out
+
+    return scan_suppressions(files(), _IGNORE_RE, RULES)
 
 
 # ------------------------------------------------------------------ run
 
 def list_rules() -> list[Rule]:
-    _ensure_rules_loaded()
-    return sorted(RULES.values(), key=lambda r: (r.family, r.id))
+    return _REGISTRY.list()
 
 
 def run_lint(path: str, mod: Optional[Module] = None,
@@ -280,47 +230,9 @@ def run_lint(path: str, mod: Optional[Module] = None,
     ``overrides`` maps rule id → severity (or ``"off"`` to disable).
     Returns findings sorted by (file, line, rule), suppressions applied.
     """
-    _ensure_rules_loaded()
     overrides = overrides or {}
-    for rid, level in overrides.items():
-        if level not in SEVERITIES and level != "off":
-            raise ValueError(f"-severity {rid}={level}: level must be one "
-                             f"of {', '.join(SEVERITIES)} or off")
-        if rid not in RULES:
-            raise ValueError(f"-severity {rid}: unknown rule id (see "
-                             f"`tfsim lint -rules` for the catalog)")
+    # overrides are validated before the module loads: a bad -severity
+    # flag is the same diagnostic with or without a loadable module
+    _REGISTRY.check_overrides(overrides)
     ctx = LintContext(path, mod)
-    suppressed = collect_suppressions(ctx)
-    findings: list[Finding] = []
-    for r in list_rules():
-        if overrides.get(r.id) == "off":
-            continue
-        for item in r.check(ctx):
-            if isinstance(item, Finding):
-                f = item
-                f.rule = f.rule or r.id
-            else:
-                where, message = item
-                f = Finding(r.severity, where, message, rule=r.id)
-            eff = overrides.get(f.rule)
-            if eff == "off":
-                continue
-            if eff is not None:
-                f.severity = eff
-            ids = suppressed.get((f.file, f.line), ())
-            if f.rule in ids or "*" in ids:
-                continue
-            findings.append(f)
-    findings.sort(key=lambda f: (f.file, f.line, f.rule, f.message))
-    return findings
-
-
-def exit_code(findings: Iterable[Finding]) -> int:
-    """Severity-based exit code: 2 = errors, 1 = warnings only, 0 = clean
-    (info findings never fail a build)."""
-    severities = {f.severity for f in findings}
-    if "error" in severities:
-        return 2
-    if "warning" in severities:
-        return 1
-    return 0
+    return _REGISTRY.run(ctx, overrides, collect_suppressions(ctx))

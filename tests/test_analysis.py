@@ -1,0 +1,846 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""graftlint — the runtime-aware static-analysis pass (tier-1 gate).
+
+Four layers:
+
+1. Per-rule contracts: every ``graft-*`` rule has a positive test (the
+   violation idiom is caught) and a negative test (the clean idiom the
+   runtime actually uses passes). All pure-AST — no jax needed.
+2. The shared engine through the Python front-end: severity overrides,
+   ``off``, suppression comment semantics (trailing / standalone /
+   wildcard), CLI exit codes, and the bad ``-severity`` diagnostic.
+3. The concurrency layer: static lock-order graph (cycles, Condition
+   aliasing, cross-file method resolution) and the runtime lock-order
+   watchdog (edge recording, cycle verdicts, lock-held sleeps, clean
+   factory restore).
+4. The package gate: ``run_graftlint`` over the real package must be
+   CLEAN, with every inline suppression counted, capped at 10, and
+   carrying a reason string — plus the combined HCL+Python golden that
+   pins the unified Finding schema across both rule packs.
+"""
+
+import json
+import os
+import textwrap
+import threading
+import time
+
+import pytest
+
+from nvidia_terraform_modules_tpu.analysis import (
+    Finding,
+    PyContext,
+    exit_code,
+    list_rules,
+    run_graftlint,
+)
+from nvidia_terraform_modules_tpu.analysis import lockwatch
+from nvidia_terraform_modules_tpu.analysis.__main__ import main as graft_main
+from nvidia_terraform_modules_tpu.analysis.core import (
+    findings_json,
+    sarif_report,
+)
+from nvidia_terraform_modules_tpu.analysis.graftlint import RULES
+from nvidia_terraform_modules_tpu.analysis.lockgraph import build_lock_graph
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")
+PKG = os.path.join(ROOT, "nvidia_terraform_modules_tpu")
+
+# the two CLIs' suffix bindings, combined for the unified-schema golden
+_SUFFIXES = (".py", ".tf", ".tfvars", ".hcl", ".example")
+
+
+def lint(tmp_path, files, overrides=None):
+    """Write a synthetic tree under tmp and graftlint it; findings carry
+    tmp-relative wheres like ``src/mod.py:3``."""
+    root = tmp_path / "src"
+    for rel, body in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body))
+    return run_graftlint(str(root), rel_to=str(tmp_path),
+                         overrides=overrides)
+
+
+def hit(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ===================================================== rule: unseeded-rng
+
+def test_unseeded_rng_positive(tmp_path):
+    fs = lint(tmp_path, {"rng.py": """\
+        import random
+        import numpy as np
+
+        def draw():
+            r = random.Random()
+            g = np.random.default_rng(42)
+            h = random.Random(hash("salt"))
+            random.seed(0)
+            x = np.random.normal()
+            return r.random() + g.random() + h.random() + x
+        """})
+    msgs = [f.message for f in hit(fs, "graft-unseeded-rng")]
+    assert any("seedless random.Random()" in m for m in msgs)
+    assert any("integer-literal seed" in m for m in msgs)
+    assert any("PYTHONHASHSEED" in m for m in msgs)
+    assert any("reseeds the shared global RNG" in m for m in msgs)
+    assert any("draws from the shared global RNG" in m for m in msgs)
+    assert all(f.severity == "error" for f in hit(fs, "graft-unseeded-rng"))
+
+
+def test_unseeded_rng_negative(tmp_path):
+    # the string-seeded convention the runtime uses everywhere
+    fs = lint(tmp_path, {"rng.py": """\
+        import random
+        import numpy as np
+
+        def draw(salt, seed):
+            r = random.Random(f"{salt}-{seed}")
+            g = np.random.default_rng(derive(salt))
+            return r.random() + g.random()
+
+        def derive(salt):
+            return len(salt)
+        """})
+    assert hit(fs, "graft-unseeded-rng") == []
+
+
+def test_unseeded_rng_resolves_import_aliases(tmp_path):
+    fs = lint(tmp_path, {"rng.py": """\
+        from random import Random
+
+        R = Random()
+        """})
+    assert len(hit(fs, "graft-unseeded-rng")) == 1
+    assert fs[0].where == "src/rng.py:3"
+
+
+# ============================================== rule: host-sync-in-loop
+
+def test_host_sync_in_traced_body_positive(tmp_path):
+    fs = lint(tmp_path, {"step.py": """\
+        import jax
+
+        @jax.jit
+        def bad(x):
+            return x.item()
+
+        def scan_bad(xs):
+            def body(c, x):
+                return c, float(x)
+            return jax.lax.scan(body, 0, xs)
+        """})
+    found = hit(fs, "graft-host-sync-in-loop")
+    assert any(".item()" in f.message and "traced" in f.message
+               for f in found)
+    assert any("float()" in f.message and "traced" in f.message
+               for f in found)
+
+
+def test_host_sync_in_wave_loop_positive(tmp_path):
+    fs = lint(tmp_path, {"wave.py": """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(s):
+            return s
+
+        def run(xs):
+            out = []
+            for x in xs:
+                s = step(x)
+                out.append(np.asarray(s))
+            return out
+        """})
+    found = hit(fs, "graft-host-sync-in-loop")
+    assert len(found) == 1
+    assert "wave loop driving a jitted step" in found[0].message
+
+
+def test_host_sync_negative(tmp_path):
+    # sync AFTER the loop, float() casts on host, loops with no jitted
+    # step — all clean
+    fs = lint(tmp_path, {"wave.py": """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(s):
+            return s
+
+        def run(xs):
+            acc = None
+            for x in xs:
+                acc = step(x)
+                loss = float(len(xs))
+            return np.asarray(acc)
+
+        def plain(items):
+            return [i.item() for i in items]
+        """})
+    assert hit(fs, "graft-host-sync-in-loop") == []
+
+
+# ===================================================== rule: wallclock
+
+def test_wallclock_positive(tmp_path):
+    fs = lint(tmp_path, {"engine.py": """\
+        import time
+
+        def stamp():
+            return time.time()
+
+        def tick():
+            return time.monotonic()
+        """})
+    found = hit(fs, "graft-wallclock-nondeterminism")
+    assert len(found) == 2
+    assert all("allowlist" in f.message for f in found)
+    assert all(f.severity == "warning" for f in found)
+
+
+def test_wallclock_allowlists(tmp_path):
+    # telemetry/ owns the clock; models/fleet.py may use INTERVAL clocks
+    # (real poll deadlines) but never epoch clocks
+    fs = lint(tmp_path, {
+        "telemetry/clock.py": """\
+            import time
+
+            def now():
+                return time.time()
+            """,
+        "models/fleet.py": """\
+            import time
+
+            def deadline():
+                return time.monotonic() + 1.0
+
+            def stamp():
+                return time.time()
+            """})
+    found = hit(fs, "graft-wallclock-nondeterminism")
+    assert len(found) == 1
+    assert found[0].where == "src/models/fleet.py:7"
+    assert "time.time" in found[0].message
+
+
+def test_wallclock_default_arg_and_traced_flagged_everywhere(tmp_path):
+    # even inside the telemetry allowlist: a default-arg clock is frozen
+    # at import, a traced clock is baked into the jaxpr
+    fs = lint(tmp_path, {"telemetry/clock.py": """\
+        import time
+        import jax
+
+        def log(t=time.time()):
+            return t
+
+        @jax.jit
+        def traced(x):
+            return x + time.time()
+        """})
+    msgs = [f.message for f in hit(fs, "graft-wallclock-nondeterminism")]
+    assert len(msgs) == 2
+    assert any("default-argument" in m for m in msgs)
+    assert any("trace-time constant" in m for m in msgs)
+
+
+def test_wallclock_reference_not_call_is_clean(tmp_path):
+    # clock INJECTION (`clock=time.time` as a default callable) is the
+    # fixed idiom — passing the function is not reading the clock
+    fs = lint(tmp_path, {"hb.py": """\
+        import time
+
+        class Heartbeat:
+            def __init__(self, clock=time.time):
+                self._clock = clock
+        """})
+    assert hit(fs, "graft-wallclock-nondeterminism") == []
+
+
+# ================================================== rule: silent-except
+
+def test_silent_except_positive(tmp_path):
+    fs = lint(tmp_path, {"errs.py": """\
+        def a():
+            try:
+                work()
+            except:
+                pass
+
+        def b():
+            try:
+                work()
+            except Exception:
+                pass
+
+        def c():
+            try:
+                work()
+            except (ValueError, Exception) as e:
+                pass
+        """})
+    found = hit(fs, "graft-silent-except")
+    assert len(found) == 3
+    assert any("bare except" in f.message for f in found)
+    assert sum("swallows the error" in f.message for f in found) == 2
+
+
+def test_silent_except_negative(tmp_path):
+    fs = lint(tmp_path, {"errs.py": """\
+        class Classified(RuntimeError):
+            pass
+
+        def a():
+            try:
+                work()
+            except ValueError:
+                pass
+
+        def b():
+            try:
+                work()
+            except Exception as e:
+                raise Classified(str(e)) from e
+
+        def c(log):
+            try:
+                work()
+            except Exception as e:
+                log.warning("probe failed: %s", e)
+
+        def d():
+            try:
+                work()
+            except Exception:  # noqa: BLE001
+                pass
+        """})
+    assert hit(fs, "graft-silent-except") == []
+
+
+# ========================================== rule: unlocked-shared-state
+
+def test_unlocked_shared_state_positive(tmp_path):
+    fs = lint(tmp_path, {"box.py": """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []
+
+            def add(self, x):
+                with self._lock:
+                    self.items.append(x)
+
+            def drop(self):
+                self.items = []
+        """})
+    found = hit(fs, "graft-unlocked-shared-state")
+    assert len(found) == 1
+    assert found[0].where == "src/box.py:13"
+    assert "races" in found[0].message
+
+
+def test_unlocked_shared_state_negative(tmp_path):
+    # __init__ writes, *_locked helpers, attrs never locked anywhere,
+    # and fully locked classes are all clean
+    fs = lint(tmp_path, {"box.py": """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []
+                self.stats = 0
+
+            def add(self, x):
+                with self._lock:
+                    self.items.append(x)
+
+            def _drop_chain_locked(self):
+                self.items = []
+
+            def bump(self):
+                self.stats += 1
+        """})
+    assert hit(fs, "graft-unlocked-shared-state") == []
+
+
+# ================================================= rule: donated-reuse
+
+def test_donated_reuse_positive(tmp_path):
+    fs = lint(tmp_path, {"don.py": """\
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(buf, x):
+            return buf + x
+
+        def bad(buf, xs):
+            out = step(buf, xs)
+            return out + buf.sum()
+        """})
+    found = hit(fs, "graft-donated-reuse")
+    assert len(found) == 1
+    assert "donated to step()" in found[0].message
+    assert found[0].where == "src/don.py:10"
+
+
+def test_donated_reuse_loop_carry_positive(tmp_path):
+    # donated on iteration N, read again at the top of iteration N+1 —
+    # the back-edge pass catches what a straight-line scan misses
+    fs = lint(tmp_path, {"don.py": """\
+        import jax
+
+        def step_impl(buf, x):
+            return buf + x
+
+        step = jax.jit(step_impl, donate_argnums=0)
+
+        def worker(buf, xs):
+            acc = None
+            for x in xs:
+                acc = step(buf, x)
+            return acc
+        """})
+    found = hit(fs, "graft-donated-reuse")
+    assert len(found) == 1
+
+
+def test_donated_reuse_negative(tmp_path):
+    # the rebind idiom — `buf = step(buf, x)` — is exactly what
+    # donate_argnums is for
+    fs = lint(tmp_path, {"don.py": """\
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(buf, x):
+            return buf + x
+
+        def ok(buf, xs):
+            for x in xs:
+                buf = step(buf, x)
+            return buf
+
+        def also_ok(buf, x):
+            out = step(buf, x)
+            return out
+        """})
+    assert hit(fs, "graft-donated-reuse") == []
+
+
+# ==================================================== rule: lock-cycle
+
+def test_lock_cycle_positive(tmp_path):
+    fs = lint(tmp_path, {"locks.py": """\
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def ab():
+            with A:
+                with B:
+                    pass
+
+        def ba():
+            with B:
+                with A:
+                    pass
+        """})
+    found = hit(fs, "graft-lock-cycle")
+    assert len(found) == 1
+    assert "lock-order cycle" in found[0].message
+    assert "global" in found[0].message
+
+
+def test_lock_cycle_cross_file_interprocedural(tmp_path):
+    # P holds its lock and calls into Q (another file), which takes its
+    # own lock — and vice versa: the may-acquire fixpoint closes the loop
+    fs = lint(tmp_path, {
+        "p.py": """\
+            import threading
+
+            class P:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def call_q(self, q):
+                    with self._lock:
+                        q.q_work()
+
+                def p_work(self):
+                    with self._lock:
+                        pass
+            """,
+        "q.py": """\
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def call_p(self, p):
+                    with self._lock:
+                        p.p_work()
+
+                def q_work(self):
+                    with self._lock:
+                        pass
+            """})
+    found = hit(fs, "graft-lock-cycle")
+    assert len(found) == 1
+    assert "P._lock" in found[0].message and "Q._lock" in found[0].message
+
+
+def test_lock_cycle_negative_consistent_order(tmp_path):
+    fs = lint(tmp_path, {"locks.py": """\
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def ab():
+            with A:
+                with B:
+                    pass
+
+        def ab2():
+            with A:
+                with B:
+                    pass
+        """})
+    assert hit(fs, "graft-lock-cycle") == []
+
+
+def test_lockgraph_condition_aliases_its_lock(tmp_path):
+    # Condition(self._lock) IS that lock: re-entering through the cv
+    # while holding the lock must not fabricate a two-node cycle
+    root = tmp_path / "src"
+    root.mkdir()
+    (root / "cv.py").write_text(textwrap.dedent("""\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+
+            def put(self, x):
+                with self._cv:
+                    self._notify()
+
+            def _notify(self):
+                with self._lock:
+                    pass
+        """))
+    g = build_lock_graph(PyContext(str(root), rel_to=str(tmp_path)))
+    assert g.nodes == {"src/cv.py::C._lock"}
+    assert g.cycles() == []
+
+
+# ======================================================= rule: load
+
+def test_graft_load_surfaces_syntax_errors(tmp_path):
+    fs = lint(tmp_path, {
+        "broken.py": "def f(:\n",
+        "ok.py": "import random\nR = random.Random()\n",
+    })
+    assert len(hit(fs, "graft-load")) == 1
+    assert hit(fs, "graft-load")[0].severity == "error"
+    # the parse failure must not drop the other file's findings
+    assert len(hit(fs, "graft-unseeded-rng")) == 1
+
+
+# ======================================== engine: suppressions/overrides
+
+def test_suppression_trailing_and_standalone(tmp_path):
+    fs = lint(tmp_path, {"s.py": """\
+        import random
+
+        A = random.Random()  # graftlint: ignore[graft-unseeded-rng] — why
+        # graftlint: ignore[graft-unseeded-rng] — reason above the line
+        B = random.Random()
+        C = random.Random()
+        """})
+    found = hit(fs, "graft-unseeded-rng")
+    assert len(found) == 1
+    assert found[0].where == "src/s.py:6"
+
+
+def test_suppression_standalone_covers_next_line_only(tmp_path):
+    # a reason comment BETWEEN the marker and the code breaks coverage —
+    # the marker must sit directly above the flagged line
+    fs = lint(tmp_path, {"s.py": """\
+        import random
+
+        # graftlint: ignore[graft-unseeded-rng] — detached
+        # ... marker no longer adjacent ...
+        A = random.Random()
+        """})
+    assert len(hit(fs, "graft-unseeded-rng")) == 1
+
+
+def test_suppression_wildcard(tmp_path):
+    fs = lint(tmp_path, {"s.py": """\
+        import random
+        import time
+
+        def f():
+            return random.Random(), time.time()  # graftlint: ignore[*]
+        """})
+    assert fs == []
+
+
+def test_severity_overrides_and_off(tmp_path):
+    files = {"s.py": "import random\nR = random.Random()\n"}
+    assert lint(tmp_path, files,
+                overrides={"graft-unseeded-rng": "info"}
+                )[0].severity == "info"
+    assert lint(tmp_path, files,
+                overrides={"graft-unseeded-rng": "off"}) == []
+    with pytest.raises(ValueError, match="unknown rule id"):
+        lint(tmp_path, files, overrides={"nope": "error"})
+    with pytest.raises(ValueError, match="level must be one of"):
+        lint(tmp_path, files, overrides={"graft-unseeded-rng": "loud"})
+
+
+def test_rule_catalog(tmp_path):
+    ids = {r.id for r in list_rules()}
+    assert ids == {
+        "graft-load", "graft-unseeded-rng", "graft-host-sync-in-loop",
+        "graft-wallclock-nondeterminism", "graft-silent-except",
+        "graft-unlocked-shared-state", "graft-donated-reuse",
+        "graft-lock-cycle",
+    }
+    # disjoint from the HCL pack: one engine, two registries
+    from nvidia_terraform_modules_tpu.tfsim.lint import engine as hcl
+    hcl_ids = {r.id for r in hcl.list_rules()}
+    assert ids.isdisjoint(hcl_ids)
+    assert hcl.Finding is Finding  # the unified schema IS one class
+
+
+# ============================================================= the CLI
+
+def _cli(tmp_path, files, argv_tail=()):
+    root = tmp_path / "cli"
+    root.mkdir(exist_ok=True)
+    for rel, body in files.items():
+        (root / rel).write_text(textwrap.dedent(body))
+    return graft_main([str(root), *argv_tail])
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    assert _cli(tmp_path, {"a.py": "import random\nR = random.Random()\n"
+                           }) == 2
+    assert _cli(tmp_path, {"a.py": "import time\nT = time.time()\n"}) == 1
+    assert _cli(tmp_path, {"a.py": "X = 1\n"}) == 0
+    out = capsys.readouterr().out
+    assert "Success! 0 finding(s)" in out
+
+
+def test_cli_json_and_sarif(tmp_path, capsys):
+    rc = _cli(tmp_path, {"a.py": "import random\nR = random.Random()\n"},
+              ["-json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 2
+    assert doc["clean"] is False and doc["error_count"] == 1
+    assert doc["findings"][0]["rule"] == "graft-unseeded-rng"
+    assert doc["findings"][0]["file"] == "cli/a.py"
+    rc = _cli(tmp_path, {"a.py": "import random\nR = random.Random()\n"},
+              ["-sarif"])
+    sarif = json.loads(capsys.readouterr().out)
+    assert sarif["version"] == "2.1.0"
+    results = sarif["runs"][0]["results"]
+    assert results[0]["ruleId"] == "graft-unseeded-rng"
+    assert results[0]["level"] == "error"
+
+
+def test_cli_bad_severity_is_a_diagnostic(tmp_path, capsys):
+    rc = _cli(tmp_path, {"a.py": "X = 1\n"}, ["-severity", "nope=error"])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "unknown rule id" in out and "-rules" in out
+
+
+def test_cli_rules_listing(capsys):
+    assert graft_main(["-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in RULES:
+        assert rid in out
+
+
+# ===================================================== lockwatch (runtime)
+
+def test_lockwatch_records_edges_and_cycles():
+    with lockwatch.armed() as watch:
+        a = threading.Lock()
+        b = threading.Lock()
+    # the watch keeps observing after the window closes
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert watch.acquisitions == 4
+    assert len(watch.lock_names) == 2
+    cycles = watch.cycles()
+    assert cycles, "opposite-order acquisition must report a cycle"
+    assert watch.report()["cycles"]
+
+
+def test_lockwatch_clean_order_no_cycle():
+    with lockwatch.armed() as watch:
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        with a:
+            with b:
+                pass
+    assert watch.cycles() == []
+    assert list(watch.edges) == [tuple(sorted(watch.lock_names))] or \
+        len(watch.edges) == 1
+
+
+def test_lockwatch_flags_sleep_under_lock():
+    with lockwatch.armed() as watch:
+        lk = threading.Lock()
+        with lk:
+            time.sleep(0)
+        time.sleep(0)  # not held — must not be flagged
+    held = watch.held_sleeps
+    assert len(held) == 1
+    lock_name, sleep_site, count = held[0]
+    assert count == 1
+    assert "test_analysis.py" in lock_name
+    assert "test_analysis.py" in sleep_site
+
+
+def test_lockwatch_out_of_order_release():
+    # handoff patterns release out of LIFO order; the held-stack must
+    # not drift and poison later edges
+    with lockwatch.armed() as watch:
+        a = threading.Lock()
+        b = threading.Lock()
+        a.acquire()
+        b.acquire()
+        a.release()
+        b.release()
+        with a:
+            with b:
+                pass
+    assert watch.cycles() == []
+
+
+def test_lockwatch_restores_factories_and_sleep():
+    orig_lock, orig_rlock, orig_sleep = \
+        threading.Lock, threading.RLock, time.sleep
+    with lockwatch.armed():
+        assert threading.Lock is not orig_lock
+        assert time.sleep is not orig_sleep
+    assert threading.Lock is orig_lock
+    assert threading.RLock is orig_rlock
+    assert time.sleep is orig_sleep
+
+
+def test_lockwatch_condition_compat():
+    # Condition borrows _release_save/_acquire_restore/_is_owned from
+    # the wrapped lock via __getattr__; Event.wait must work while armed
+    with lockwatch.armed() as watch:
+        ev = threading.Event()
+        cv = threading.Condition()
+        with cv:
+            cv.notify_all()
+    ev.set()
+    assert ev.wait(timeout=1.0)
+    assert watch.cycles() == []
+
+
+# ================================================== the package gate
+
+def test_package_is_graftlint_clean():
+    """THE gate: the shipped package scans clean — zero findings, every
+    violation either fixed or suppressed with an inline reason."""
+    assert run_graftlint(PKG) == []
+
+
+def test_suppression_budget_and_reasons():
+    subs = PyContext(PKG).count_suppressions()
+    assert 0 < len(subs) <= 10, \
+        f"{len(subs)} inline suppressions (cap is 10): {subs}"
+    for fname, line, reason in subs:
+        assert reason, (f"{fname}:{line}: suppression carries no reason "
+                        f"string after the bracket")
+
+
+def test_exit_code_shared_semantics():
+    mk = lambda sev: Finding(sev, "x.py:1", "m", rule="r")
+    assert exit_code([mk("error"), mk("warning")]) == 2
+    assert exit_code([mk("warning"), mk("info")]) == 1
+    assert exit_code([mk("info")]) == 0
+    assert exit_code([]) == 0
+
+
+# =============================================== combined-schema golden
+
+def _check_golden(name, text):
+    path = os.path.join(GOLDEN, name)
+    if os.environ.get("GOLDEN_UPDATE"):
+        with open(path, "w") as fh:
+            fh.write(text)
+    with open(path) as fh:
+        assert fh.read() == text, \
+            f"{name} drifted — regenerate intentionally with GOLDEN_UPDATE=1"
+
+
+def test_combined_hcl_python_golden(tmp_path):
+    """One run, both rule packs, one document: an HCL finding and a
+    Python finding render through the SAME json/sarif serializers —
+    the unified Finding schema is the contract CI parses."""
+    from nvidia_terraform_modules_tpu.tfsim.lint import engine as hcl
+
+    mod = tmp_path / "hclmod"
+    mod.mkdir()
+    (mod / "main.tf").write_text(
+        'terraform {\n'
+        '  required_version = ">= 1.5.0"\n'
+        '  required_providers {\n'
+        '    google = { source = "hashicorp/google", version = "~> 5.0" }\n'
+        '  }\n'
+        '}\n'
+        '\n'
+        'variable "unused_thing" {\n'
+        '  description = "never wired in"\n'
+        '  type        = number\n'
+        '  default     = 1\n'
+        '}\n')
+    pyroot = tmp_path / "graftpkg"
+    pyroot.mkdir()
+    (pyroot / "rng.py").write_text(
+        "import random\n\nR = random.Random()\n")
+
+    hcl_findings = hcl.run_lint(str(mod))
+    py_findings = run_graftlint(str(pyroot), rel_to=str(tmp_path))
+    assert [f.rule for f in hcl_findings] == ["unused-variable"]
+    assert [f.rule for f in py_findings] == ["graft-unseeded-rng"]
+
+    combined = sorted(hcl_findings + py_findings,
+                      key=lambda f: (f.file, f.line, f.rule, f.message))
+    doc = findings_json(combined, _SUFFIXES)
+    sarif = sarif_report(combined, hcl.list_rules() + list_rules(),
+                         "unified-lint", _SUFFIXES)
+    assert doc["error_count"] == 1 and doc["warning_count"] == 1
+    _check_golden("combined_lint.json",
+                  json.dumps(doc, indent=2, sort_keys=True) + "\n")
+    _check_golden("combined_lint.sarif",
+                  json.dumps(sarif, indent=2, sort_keys=True) + "\n")

@@ -439,3 +439,38 @@ def test_fleet_monitor_failure_propagates_and_joins_workers(
     stranded = [t.name for t in threading.enumerate()
                 if t.name.startswith("fleet-")]
     assert stranded == []
+
+
+def test_fleet_chaos_kill_redrive_under_lockwatch_tier1():
+    """ISSUE 16 satellite: the seeded kill+redrive chaos case runs with
+    the runtime lock-order watchdog armed — every lock the fleet stack
+    creates is instrumented, and the run must produce ZERO ordering
+    cycles and ZERO lock-held blocking polls (time.sleep while holding
+    any runtime lock), on top of staying bit-exact through the kill."""
+    from nvidia_terraform_modules_tpu.analysis import lockwatch
+
+    cfg, params, prompts = _setup()
+    want = _solo(params, prompts, 6, cfg)
+    victim = _victim(prompts, 3)
+    profile = FleetFaultProfile(
+        [FleetFault("kill_replica", target=victim, at_s=0.05)], seed=0)
+    with lockwatch.armed() as watch:
+        fleet = make_fleet(params, cfg, max_len=16, replicas=3,
+                           kv_block=4, faults=profile, steal=False)
+        got = fleet(prompts, 6, slots=2)
+    _assert_all_equal(got, want, "under lockwatch:")
+    assert fleet.last_stats["fleet"]["faults"]["replica_down"] == 1
+
+    pkg = "nvidia_terraform_modules_tpu/"
+    # the watchdog really observed the runtime's locks, not a no-op arm
+    runtime_locks = [n for n in watch.lock_names if n.startswith(pkg)]
+    assert runtime_locks, "no runtime locks observed under the watchdog"
+    assert watch.acquisitions > 0
+    # zero ordering cycles among runtime locks (jax/stdlib internals
+    # created inside the window are outside the contract)
+    cycles = [c for c in watch.cycles()
+              if any(n.startswith(pkg) for n in c)]
+    assert cycles == [], f"lock-order cycles under chaos: {cycles}"
+    # zero blocking polls while holding any runtime lock
+    held = [h for h in watch.held_sleeps if h[0].startswith(pkg)]
+    assert held == [], f"time.sleep while holding a lock: {held}"

@@ -260,7 +260,7 @@ def test_warm_chain_store_dedups_shared_template_prefix():
     for (chunks, pay), (chunks0, _p) in zip(sorted(got), sorted(chains)):
         assert np.asarray(pay["k"][0]).shape[0] == 2
     with store._lock:
-        store._drop_chain(next(iter(store._chains)))
+        store._drop_chain_locked(next(iter(store._chains)))
     assert store.pool.in_use == 3                 # suffix row freed,
     store.clear()                                 # head row retained
     assert store.pool.in_use == 0

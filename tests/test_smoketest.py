@@ -15,6 +15,25 @@ def test_psum_level(jax8):
     assert r.checks["psum_ok"]
     assert r.checks["psum_participants"] == 8
     assert r.checks["device_count_ok"]
+    # graftlint preflight ran (and passed) before the mesh came up
+    assert r.checks["lint_runtime_ok"] is True
+
+
+def test_lint_preflight_blocks_chip_session(jax8, tmp_path):
+    """An ERROR-severity graftlint finding refuses the session before
+    any backend work: lint_runtime_ok=False, ok=False, and none of the
+    device checks are present in the result."""
+    bad = tmp_path / "pkg"
+    bad.mkdir()
+    (bad / "mod.py").write_text(
+        "import random\n\ndef draw():\n    return random.Random().random()\n")
+    r = run_smoketest(level="psum",
+                      env={"TPU_SMOKETEST_LINT_DIR": str(bad)})
+    assert r.ok is False
+    assert r.checks["lint_runtime_ok"] is False
+    assert any("seedless random.Random()" in m
+               for m in r.checks["lint_runtime_findings"])
+    assert "devices" not in r.checks  # refused before backend touch
 
 
 def test_device_count_mismatch_fails(jax8):

@@ -1582,3 +1582,37 @@ resource "google_compute_network" "n" {
     mod = load_module(str(tmp_path))
     errs = [str(f) for f in validate_module(mod) if f.severity == "error"]
     assert errs == [], errs
+
+
+# ------------------------------------------------------------------
+# the engine-refactor pin: lint output over the REAL modules is golden
+# ------------------------------------------------------------------
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")
+
+_GOLDEN_LINT_DIRS = {
+    "gke-tpu": "gke-tpu",
+    "gke-tpu/examples/multislice": "gke-tpu_examples_multislice",
+    "gke-tpu/examples/cnpack": "gke-tpu_examples_cnpack",
+}
+_GOLDEN_LINT_FMTS = {"txt": (), "json": ("-json",), "sarif": ("-sarif",)}
+
+
+@pytest.mark.parametrize("rel_dir,slug", sorted(_GOLDEN_LINT_DIRS.items()))
+@pytest.mark.parametrize("ext,flags", sorted(_GOLDEN_LINT_FMTS.items()))
+def test_lint_output_is_golden(rel_dir, slug, ext, flags, capsys):
+    """Byte-identical lint output over the flagship module and both
+    examples, in all three formats. The committed goldens were captured
+    BEFORE the rule engine moved into analysis/core.py — any drift in a
+    finding, an ordering, or a serializer detail shows up here as a
+    diff at review time. Regenerate intentionally with
+    ``GOLDEN_UPDATE=1 python -m pytest tests/test_tfsim_lint.py``."""
+    main(["lint", os.path.join(ROOT, rel_dir), *flags])
+    out = capsys.readouterr().out
+    path = os.path.join(GOLDEN, f"tfsim_lint_{slug}.{ext}")
+    if os.environ.get("GOLDEN_UPDATE"):
+        with open(path, "w") as fh:
+            fh.write(out)
+    with open(path) as fh:
+        assert fh.read() == out, \
+            f"lint output for {rel_dir} ({ext}) drifted from the golden"
