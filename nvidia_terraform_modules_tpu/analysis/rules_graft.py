@@ -278,6 +278,7 @@ _WALLCLOCK_ALLOW = (
 _INTERVAL_ALLOW = _WALLCLOCK_ALLOW + (
     "models/fleet.py", "models/serving.py", "models/hostkv.py",
     "models/resilience.py", "models/checkpoint.py",
+    "models/transport.py",
 )
 
 
@@ -631,3 +632,67 @@ def _scan_donations(fname: str, body: list, donators: dict,
             for h in getattr(stmt, "handlers", []) or []:
                 yield from _scan_donations(fname, h.body, donators,
                                            dict(dead))
+
+
+# ---------------------------------------------------------- unbounded recv
+
+# the serving runtime: the files where a blocking receive or join can
+# wedge a router, a replica, or the caller's fleet join — every wait
+# there must be bounded (the transport seam's FrameChannel discipline)
+_RECV_SCOPE = (
+    "models/fleet.py", "models/serving.py", "models/transport.py",
+    "models/hostkv.py", "models/resilience.py",
+)
+# receive-shaped methods that block forever without a timeout
+_RECV_METHODS = {"get", "recv", "recv_bytes", "accept"}
+# the bounded-receive idiom: a function that polls (or sets a socket
+# timeout on) the connection before reading has bounded its own wait —
+# FrameChannel.recv's poll-then-recv_bytes shape
+_RECV_GUARDS = {"poll", "settimeout"}
+
+
+@rule("graft-unbounded-recv", severity="error", family="liveness",
+      summary="serving-runtime recv/join must carry a timeout")
+def check_unbounded_recv(ctx: PyContext):
+    """A socket/pipe/queue receive or a thread/process join without a
+    timeout inside the serving runtime is a latent hang: a dead peer
+    (a SIGKILLed replica process, a wedged worker) then blocks the
+    router forever instead of raising a classified, retryable error.
+    Flags zero-argument ``.join()`` and timeout-less
+    ``.get()``/``.recv()``/``.recv_bytes()``/``.accept()`` in the
+    serving-runtime files, except receives in a function that bounds
+    its own wait with ``.poll(...)``/``.settimeout(...)`` first."""
+    for fname, _tree in ctx.trees():
+        if not any(frag in fname for frag in _RECV_SCOPE):
+            continue
+        for fn in ctx.nodes(fname):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            guarded = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in _RECV_GUARDS
+                for n in walk_scope(fn))
+            for n in walk_scope(fn):
+                if not (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)):
+                    continue
+                attr = n.func.attr
+                where = f"{fname}:{n.lineno}"
+                if attr == "join" and not n.args and not n.keywords:
+                    yield (where,
+                           "unbounded .join() in the serving runtime — "
+                           "a wedged worker hangs the caller forever; "
+                           "join with a timeout and classify the "
+                           "stragglers (fleet joins raise "
+                           "FleetWorkerHung)")
+                elif attr in _RECV_METHODS and not n.args \
+                        and not any(k.arg == "timeout"
+                                    for k in n.keywords) \
+                        and not guarded:
+                    yield (where,
+                           f"unbounded .{attr}() in the serving "
+                           f"runtime — a dead peer blocks this wait "
+                           f"forever; pass a timeout (or poll the "
+                           f"connection first) and raise the "
+                           f"classified transport error on expiry")

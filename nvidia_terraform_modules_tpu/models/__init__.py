@@ -46,7 +46,24 @@ from .serving import (  # noqa: F401
     make_serve_engine,
     serve,
 )
-from .fleet import AutoscalePolicy, make_fleet  # noqa: F401
+from .fleet import (  # noqa: F401
+    AutoscalePolicy,
+    FleetWorkerHung,
+    make_fleet,
+)
+from .transport import (  # noqa: F401
+    FrameChannel,
+    InProcTransport,
+    MultiProcTransport,
+    Transport,
+    TransportCorruptFrame,
+    TransportDead,
+    TransportError,
+    TransportProtocolError,
+    TransportTimeout,
+    pack_frame,
+    unpack_frame,
+)
 from .hostkv import (  # noqa: F401
     HostBlockPool,
     HostSpillCorruptError,

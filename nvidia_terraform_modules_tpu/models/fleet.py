@@ -176,7 +176,8 @@ from ..utils.retry import RetryPolicy, retry_call
 from .burnin import BurnInConfig
 from .resilience import LivenessBreaker
 from .paging import PrefixIndex, chain_chunks, transfer_crc
-from .serving import AdmissionSource, make_serve_engine
+from .serving import AdmissionSource
+from .transport import InProcTransport, MultiProcTransport, Transport
 
 _ROUTINGS = ("affinity", "random")
 
@@ -358,6 +359,27 @@ class HandoffCorruptError(RuntimeError):
     """A disaggregated prefill→decode payload failed its crc — the
     classified, RETRYABLE transfer failure (``utils/retry``): the
     handoff re-runs from prefill rather than importing garbage."""
+
+
+class FleetWorkerHung(RuntimeError):
+    """A fleet worker failed to join within ``join_timeout_s`` — the
+    classified, LOUD form of what used to be an unbounded join at the
+    end of every fleet call. A wedged replica (a stuck process, a
+    thread blocked outside its queue) must never hang the caller:
+    process workers are ``SIGKILL``\\ ed on the way out, thread
+    workers are abandoned (they are daemons), and the hang is
+    reported with every hung worker named. Raise ``join_timeout_s``
+    if the workload legitimately runs longer than the budget."""
+
+    def __init__(self, workers: Sequence[str], timeout_s: float):
+        super().__init__(
+            f"fleet worker(s) {', '.join(workers)} failed to join "
+            f"within join_timeout_s={timeout_s:.1f}s — classified "
+            f"HUNG (process workers SIGKILLed, thread workers "
+            f"abandoned); raise join_timeout_s if the workload "
+            f"legitimately runs longer")
+        self.workers = list(workers)
+        self.timeout_s = timeout_s
 
 
 _FAULT_KINDS = (
@@ -824,6 +846,8 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                autoscale: AutoscalePolicy | None = None,
                warm_join: bool = True,
                warm_blocks: int | None = None,
+               transport: str | Transport = "inproc",
+               join_timeout_s: float = 600.0,
                **engine_kw):
     """Build the fleet: ``replicas`` serve engines behind the router.
 
@@ -909,6 +933,23 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
     ``tests/test_fleet_scale.py``), and a policy that emits no events
     reproduces the fixed-size fleet byte for byte.
 
+    ``transport`` selects the router↔replica wire (see
+    ``models/transport.py``): ``"inproc"`` (default) runs replicas as
+    threads polling the router's queues directly — bit-for-bit the
+    pre-seam fleet; ``"multiproc"`` runs each decode replica as a
+    REAL spawned subprocess speaking length-prefixed crc-verified
+    frames over an OS pipe, which makes a ``kill_replica`` fault an
+    actual ``SIGKILL`` at the identical poll boundary (and an
+    unexpected child crash a classified death with redrive). A
+    ``Transport`` INSTANCE may be passed and shared across
+    ``make_fleet`` calls — an unchanged configuration keeps warm
+    engines/child processes, amortising spawns and compiles.
+    Multi-proc v1 refuses ``disaggregate``, ``autoscale`` and
+    per-call ``rng`` (greedy only). ``join_timeout_s`` bounds every
+    worker join at the end of a call — a wedged worker raises
+    :class:`FleetWorkerHung` (process workers SIGKILLed) instead of
+    hanging the caller.
+
     ``**engine_kw`` passes through to every ``make_serve_engine``
     (``kv_block``, ``share_prefix``, ``cache_dtype``, ``lazy_growth``,
     ``paged_kernel``, ``sampler``, …). Note an engine driven through an
@@ -961,6 +1002,36 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
     if warm_blocks is not None and warm_blocks < 1:
         raise ValueError(
             f"warm_blocks must be >= 1, got {warm_blocks}")
+    if join_timeout_s <= 0:
+        raise ValueError(
+            f"join_timeout_s must be > 0, got {join_timeout_s}")
+    if isinstance(transport, str):
+        if transport == "inproc":
+            tr: Transport = InProcTransport()
+        elif transport == "multiproc":
+            tr = MultiProcTransport()
+        else:
+            raise ValueError(
+                f"unknown transport {transport!r}: use 'inproc' | "
+                f"'multiproc' | a Transport instance")
+    elif isinstance(transport, Transport):
+        tr = transport
+    else:
+        raise ValueError(
+            f"transport must be 'inproc', 'multiproc' or a "
+            f"Transport instance, got {type(transport)}")
+    if tr.process_isolated:
+        if disaggregate:
+            raise ValueError(
+                "the multiproc transport does not compose with "
+                "disaggregate in v1 — the prefill→decode handoff "
+                "stays in-proc (see models/transport.py)")
+        if autoscale is not None:
+            raise ValueError(
+                "the multiproc transport does not compose with "
+                "autoscale in v1 — warm bring-up migrates host-tier "
+                "KV through shared memory, which does not cross a "
+                "process boundary (see models/transport.py)")
     if disaggregate:
         if replicas < 2:
             raise ValueError(
@@ -1019,17 +1090,15 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
              for t, ts in res[f"kills_{side}"].items()]
             + [(ts, t, "drain")
                for t, ts in res[f"drains_{side}"].items()])
-    # every engine shares the fleet's registry so router + engine spans
-    # stitch on one timeline; engines are separate objects on purpose —
-    # separate pools, separate step caches, no cross-thread state.
-    # dec_engines holds the BASE replicas; scale-up joiners append at
-    # spawn time (built once, reused across calls)
-    dec_engines = [make_serve_engine(params, cfg, max_len=max_len,
-                                     telemetry=reg, **engine_kw)
-                   for _ in range(n_dec)]
-    pre_engines = [make_serve_engine(params, cfg, max_len=max_len,
-                                     telemetry=reg, **engine_kw)
-                   for _ in range(n_pre)]
+    # the transport owns engine construction and replica execution:
+    # in-proc builds every engine eagerly here (registry shared so
+    # router + engine spans stitch on one timeline; scale-up joiners
+    # build lazily through ensure_engine), multi-proc defers to child
+    # bring-up at the first launch — children persist across calls,
+    # so compiles amortise exactly like warm in-proc engines
+    tr.configure(params=params, cfg=cfg, max_len=max_len,
+                 engine_kw=engine_kw, registry=reg, n_dec=n_dec,
+                 n_pre=n_pre)
     # the fleet-shared warm store (state-migration transport): replicas
     # publish retained prefix chains at close/drain, scale-up joiners
     # take their keyspace share at bring-up. Persistent across calls —
@@ -1294,6 +1363,11 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                     "SLO shedding needs est_token_s (predicted "
                     "service per budgeted token) — calibrate it from "
                     "a measured run of this config")
+        if tr.process_isolated and rng is not None:
+            raise ValueError(
+                "the multiproc transport is greedy-only in v1 — a "
+                "device PRNG key does not cross a process boundary; "
+                "pass rng=None or use the in-proc transport")
 
         # elastic fleets resolve faults per call (explicit targets may
         # name joiners the plan realises below); fixed fleets reuse the
@@ -1328,7 +1402,11 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
         scale_downs = [e for e in scale_events if e["kind"] == "down"]
         n_planned = len(plan)
         fault_on = resolved_call is not None
-        managed = fault_on or scale_on
+        # a process-isolated replica can die for real (crash, OOM
+        # kill) even with no fault profile armed — the recovery
+        # runtime always runs so an unexpected death redrives instead
+        # of stranding requests
+        managed = fault_on or scale_on or tr.process_isolated
         t0 = time.monotonic()
         retire_at: dict[int, float] = {}
         retire_tok: dict[int, int] = {}
@@ -1397,7 +1475,6 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
         # closes everything once every planned request has retired
 
         sessions: list[Any] = [None] * n_pre
-        results: list[Any] = [None] * n_dec_run
         errors: list[tuple] = []
         stolen = [0]
         handoff_retries = [0]
@@ -1406,20 +1483,16 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
             for q in pre_queues + dec_queues:
                 q.close()
 
-        def dec_worker(i):
-            try:
-                results[i] = dec_engines[i](
-                    prompts, budgets, slots=slots, eos_id=eos_id,
-                    rng=rng, kv_blocks=kv_blocks,
-                    admission=dec_queues[i])
-            except ReplicaKilled:
-                # the queue's dead flag (set at the raise, before the
-                # stack unwound) is the monitor's signal — nothing else
-                # to do here; the replica is simply gone
-                pass
-            except Exception as exc:     # noqa: BLE001 — re-raised below
-                errors.append((f"decode-{i}", exc))
-                _abort_all()
+        # one replica run's inputs, handed to the transport: in-proc
+        # passes them straight into the engine on a thread (the
+        # pre-seam dec_worker, byte for byte); multi-proc ships them
+        # to the replica process in the RUN frame
+        run_kw = dict(prompts=prompts, budgets=budgets, slots=slots,
+                      eos_id=eos_id, rng=rng, kv_blocks=kv_blocks)
+
+        def _on_dec_error(label, exc):
+            errors.append((label, exc))
+            _abort_all()
 
         def _transfer(i, req, corrupt_nth, served):
             """One prefill→decode handoff. Under the fault plane the
@@ -1462,7 +1535,7 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                            if fault_on else None)
             served = [0]
             try:
-                sessions[i] = pre_engines[i].prefill_session()
+                sessions[i] = tr.prefill_engine(i).prefill_session()
                 while True:
                     req = _take_next(pre_queues[i])
                     if req is None:
@@ -1504,15 +1577,17 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                                         daemon=True,
                                         name=f"fleet-pre-{i}")
                        for i in range(n_pre)]
-        # base replicas start NOW; scale-up joiners spawn when the
-        # monitor loop reaches their event timestamp (poll-boundary
-        # execution, like fault kills)
-        dec_threads: list[Any] = \
-            [threading.Thread(target=dec_worker, args=(i,),
-                              daemon=True, name=f"fleet-dec-{i}")
-             for i in range(n_dec)] + [None] * (n_dec_run - n_dec)
-        for th in pre_threads + dec_threads[:n_dec]:
+        for th in pre_threads:
             th.start()
+        # base replicas launch NOW (through the transport — a thread
+        # in-proc, a RUN frame to a warm-or-spawned child process
+        # multi-proc); scale-up joiners launch when the monitor loop
+        # reaches their event timestamp (poll-boundary execution,
+        # like fault kills)
+        dec_handles: list[Any] = [None] * n_dec_run
+        for i in range(n_dec):
+            dec_handles[i] = tr.launch_decode(
+                i, dec_queues[i], run_kw, on_error=_on_dec_error)
         spawned: set[int] = set(range(n_dec))
 
         # ---- the fault-plane + elastic recovery runtime (all state
@@ -1573,13 +1648,7 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
 
             def build():
                 attempts[0] += 1
-                while len(dec_engines) <= i:
-                    dec_engines.append(None)
-                if dec_engines[i] is None:
-                    dec_engines[i] = make_serve_engine(
-                        params, cfg, max_len=max_len, telemetry=reg,
-                        **engine_kw)
-                return dec_engines[i]
+                return tr.ensure_engine(i)
 
             clk0 = reg.clock() if reg.enabled else None
             try:
@@ -1603,10 +1672,8 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                 warm_chains_primed[0] += len(chains)
             else:
                 cold_joins[0] += 1
-            th = threading.Thread(target=dec_worker, args=(i,),
-                                  daemon=True, name=f"fleet-dec-{i}")
-            dec_threads[i] = th
-            th.start()
+            dec_handles[i] = tr.launch_decode(
+                i, dec_queues[i], run_kw, on_error=_on_dec_error)
             spawned.add(i)
             live_size[0] += 1
             if reg.enabled:
@@ -1828,16 +1895,19 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
             stops receiving steals/redrives; a fresh stamp starts the
             quarantine countdown, and only ``quarantine_polls`` clean
             polls later does it re-enter. Death is classified
-            separately (the thread exits with ReplicaKilled) — slow
-            and dead are never conflated."""
+            separately (the worker exits with ReplicaKilled — or the
+            replica process is SIGKILLed) — slow and dead are never
+            conflated. Through the multi-proc transport the poll
+            stamps land when poll FRAMES arrive, so the breaker
+            observes real heartbeat lag over the wire."""
             now = time.monotonic()
-            for role, queues, threads, nn in (
-                    ("dec", dec_queues, dec_threads, n_dec_run),
+            for role, queues, workers, nn in (
+                    ("dec", dec_queues, dec_handles, n_dec_run),
                     ("pre", pre_queues, pre_threads, n_pre)):
                 for i in range(nn):
                     q = queues[i]
-                    if threads[i] is None or q.dead \
-                            or not threads[i].is_alive() \
+                    if workers[i] is None or q.dead \
+                            or not workers[i].is_alive() \
                             or not q.work_done:
                         # a replica that has not completed its first
                         # wave/handoff yet is COMPILING, not sick —
@@ -1867,6 +1937,7 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
         # to the caller instead of silently stranding replicas waiting
         # on a closure that will never come.
         _set_size()
+        hung_workers: list[str] = []
         try:
             while True:
                 # scale-UPs execute FIRST each poll (a joiner is always
@@ -1919,8 +1990,8 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                                  if d == 0 and i in spawned
                                  and _avail("dec", i)
                                  and _health_ok("dec", i)
-                                 and dec_threads[i] is not None
-                                 and dec_threads[i].is_alive()]
+                                 and dec_handles[i] is not None
+                                 and dec_handles[i].is_alive()]
                     donors = [i for i in range(n_dec_run)
                               if _avail("dec", i)]
                     if receivers and donors:
@@ -1937,22 +2008,39 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                                 stolen[0] += 1
                                 if reg.enabled:
                                     _c_steal.inc()
-                if not any(th is not None and th.is_alive()
-                           for th in dec_threads) \
+                if not any(h is not None and h.is_alive()
+                           for h in dec_handles) \
                         and not _pending_downs() \
                         and up_idx[0] >= len(scale_ups):
                     break
                 time.sleep(steal_poll_s)
         except BaseException:
             # the monitor failed: release every replica (closed queues
-            # end their wave loops), join below, and let the error
+            # end their wave loops — a process replica sees the close
+            # at its next poll frame), join below, and let the error
             # reach the caller — never a silent strand
             _abort_all()
             raise
         finally:
-            for th in pre_threads + dec_threads:
-                if th is not None:
-                    th.join()
+            # BOUNDED joins: a wedged worker (a stuck replica
+            # process, a thread blocked outside its queue) must never
+            # hang the caller — after the shared budget expires it is
+            # classified hung, killed where the transport can (a real
+            # process always can — SIGKILL), and reported loudly
+            # below via FleetWorkerHung
+            deadline = time.monotonic() + join_timeout_s
+            for i, th in enumerate(pre_threads):
+                th.join(max(0.0, deadline - time.monotonic()))
+                if th.is_alive():
+                    hung_workers.append(f"prefill-{i}")
+            for h in dec_handles:
+                if h is None:
+                    continue
+                if not h.join(max(0.0, deadline - time.monotonic())):
+                    hung_workers.append(h.label)
+                    h.kill()
+        if hung_workers:
+            raise FleetWorkerHung(hung_workers, join_timeout_s)
         if managed:
             _process_downs()             # a death racing the exit
         if errors:
@@ -1962,7 +2050,8 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
 
         merged: dict[int, Any] = {}
         dup: set[int] = set()
-        for r in results:
+        for h in dec_handles:
+            r = h.result() if h is not None else None
             for k, v in (r or {}).items():
                 if k in merged:
                     dup.add(k)
@@ -1997,9 +2086,9 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                      "corrupt_dropped": 0}
         spill_on = bool(engine_kw.get("host_spill"))
         for i in range(n_dec_run):
-            e = dec_engines[i] if i < len(dec_engines) else None
+            h = dec_handles[i]
             label = (f"decode-{i}" if disaggregate else f"replica-{i}")
-            if i not in spawned or e is None:
+            if i not in spawned or h is None:
                 # a scale-up joiner whose spawn never executed (the
                 # run ended first, or every attempt failed): no engine
                 # ran, so there are no stats to read
@@ -2010,10 +2099,12 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                     "dead": dec_queues[i].dead, "spawned": False,
                 })
                 continue
-            st = e.last_stats
+            st = h.stats()
             if st is None:
-                # killed mid-run: the engine never assembled stats —
-                # report the death, never a KeyError
+                # killed mid-run (thread unwound, or the replica
+                # process SIGKILLed before its DONE frame): the
+                # engine never assembled stats — report the death,
+                # never a KeyError
                 per_replica.append({
                     "role": "decode", "replica": label,
                     "requests": 0, "waves": None, "occupancy": None,
@@ -2159,9 +2250,9 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                 }),
             },
             "replica_stats": [
-                (dec_engines[i].last_stats
-                 if i in spawned and i < len(dec_engines)
-                 and dec_engines[i] is not None else None)
+                (dec_handles[i].stats()
+                 if i in spawned and dec_handles[i] is not None
+                 else None)
                 for i in range(n_dec_run)],
         }
         out: list[Any] = [None] * n
@@ -2170,4 +2261,9 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
         return out
 
     fleet.last_stats = None
+    # the transport is part of the fleet's public surface: a shared
+    # instance is how callers keep replica processes warm across
+    # make_fleet calls, and close() is how they reap them
+    fleet.transport = tr
+    fleet.close = tr.close
     return fleet

@@ -48,7 +48,12 @@ The paged block is also the fleet's TRANSFER UNIT:
 :func:`export_block_rows` / :func:`import_block_rows` copy whole
 blocks' physical content between two pools (the prefill→decode handoff
 of ``models/fleet.py``'s disaggregated mode — an explicit device copy
-on CPU, the seam an ICI/DCN transfer slots into on chip).
+on CPU, the seam an ICI/DCN transfer slots into on chip). The fleet's
+``Transport`` layer (``models/transport.py``) ships the SAME exported
+rows across a process boundary: ``encode_block_payload`` stamps the
+export with ``transfer_crc`` before pickling and the importer
+re-verifies after, so a block handoff is end-to-end checked whether it
+crosses a function call, a pipe, or (eventually) DCN.
 
 ``tests/test_paging.py`` pins the allocator invariants (no double
 alloc, free-list recycling, exhaustion, the fragmentation bound,

@@ -107,7 +107,12 @@ replica engines, steals work between their queues mid-run, and feeds
 decode workers prefilled KV through ``kv_import`` payloads built by
 :func:`make_serve_engine`'s ``prefill_session`` (the disaggregated
 prefill→decode handoff; ``models/paging.py``'s block transfer pair
-moves the bytes).
+moves the bytes). The interface is also the fleet's PROCESS seam
+(PR 17): ``models/transport.py``'s multi-proc replicas run this very
+engine in a child process against an :class:`AdmissionSource` proxy
+whose every call is a crc-framed RPC to the router — the engine never
+learns whether its queue lives in-thread or across a pipe, which is
+what keeps in-proc and multi-proc fleets bit-identical.
 
 Reference analogue: none — the reference provisions serving
 infrastructure (node pools, runtime DaemonSets) and never touches model

@@ -1,0 +1,985 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""The fleet's router↔replica transport seam: one interface, two wires.
+
+``models/fleet.py`` owns every routing decision — admission queues,
+steal, redrive, drains, health — and until this module existed it also
+owned the assumption that a replica is a *thread*: a kill was an
+exception raised at a poll boundary, a simulation of failure rather
+than failure. This module extracts the communication layer behind a
+:class:`Transport` interface so the router no longer knows what a
+replica IS:
+
+- :class:`InProcTransport` — today's wire: the serve engine runs on a
+  daemon thread polling the router's ``_FleetQueue`` directly.
+  Bit-for-bit identical to the pre-seam fleet (the 13 fleet bit-match
+  gates in ``tests/test_fleet*.py`` pin it).
+- :class:`MultiProcTransport` — replicas as REAL processes: each
+  replica is a spawned subprocess running its own serve engine; every
+  ``AdmissionSource`` poll crosses the process boundary as a
+  length-prefixed, crc32-verified, sequence-numbered frame over an OS
+  pipe (:func:`pack_frame`/:func:`unpack_frame`), with bounded
+  send/recv timeouts everywhere (``graft-unbounded-recv`` is the lint
+  rule this module's poll-guard idiom satisfies). A scheduled
+  ``kill_replica`` fault becomes an actual ``SIGKILL`` of the replica
+  process, delivered at the identical admission-poll boundary the
+  in-proc fault seam uses — so the chaos gates rerun against real
+  process death and stay bit-exact (tokens are schedule-invariant;
+  redrive is exactly-once).
+
+Design invariants the bit-match rests on:
+
+- **All router state stays router-side.** The ``_FleetQueue`` lives in
+  the parent in BOTH transports; the multi-proc replica drives it
+  through an RPC proxy (:class:`_RPCAdmission`), one strict
+  request/reply frame pair per poll, served by a parent-side handler
+  thread (:class:`_ProcHandle`) that calls the real queue methods.
+  Routing, steal, redrive and shed therefore execute identically.
+- **Classified transport errors.** :class:`TransportTimeout` is the
+  TRANSIENT class (the reply may still come — the receiver re-waits
+  under a ``utils/retry`` capped-backoff policy; requests are never
+  re-SENT, polls are not idempotent); :class:`TransportDead` (peer
+  EOF / process gone) and :class:`TransportProtocolError` /
+  :class:`TransportCorruptFrame` (truncation, out-of-order delivery,
+  crc mismatch) are TERMINAL — the replica is classified dead, the
+  router's ordinary ``take_lost``→redrive machinery recovers, and a
+  replica that exhausts its reply budget exits with
+  ``resilience.EXIT_PEER_DEAD`` so the supervisor-side classification
+  (``resilience.classify_exit``) reads the truth.
+- **Real liveness.** ``_FleetQueue.last_poll`` stamps land when the
+  poll frame ARRIVES, so ``resilience.LivenessBreaker`` inside the
+  fleet's health monitor observes real heartbeat lag over the wire,
+  not same-address-space stamps.
+
+Paged-block handoff payloads reuse the paging layer's own wire
+integrity primitive: :func:`encode_block_payload` /
+:func:`decode_block_payload` stamp and re-verify
+``paging.transfer_crc`` over the exported block rows
+(``paging.export_block_rows`` → wire → ``paging.import_block_rows``),
+so a corrupt frame is loud on the decode side of the wire exactly like
+the in-proc disaggregated handoff.
+
+v1 scope (CPU; ROADMAP item 2's v5e ICI/DCN impl is a third
+``Transport`` on this seam): the multi-proc fleet refuses
+``disaggregate`` (the prefill→decode handoff stays in-proc),
+``autoscale`` (warm bring-up migrates host-tier state through shared
+memory), and per-call ``rng``/``sampler`` (greedy decode only — a
+device PRNG key does not cross a process boundary); ``host_spill`` is
+engine-internal and composes fine. Telemetry:
+``transport_bytes_total``/``transport_frames_total`` count every frame
+through the parent side of each pipe, ``transport_rtt_ms`` records
+the replica-measured poll round-trip and ``transport_retries_total``
+the classified reply retries (see :class:`TransportMetrics`).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import signal
+import struct
+import threading
+import time
+import zlib
+from typing import Any, Callable
+
+import numpy as np
+
+from ..utils.retry import RetryPolicy, RetriesExhausted, retry_call
+from .resilience import EXIT_PEER_DEAD
+from .serving import AdmissionSource, make_serve_engine
+
+# ------------------------------------------------------ classified errors
+
+
+class TransportError(RuntimeError):
+    """Base of the transport fault taxonomy. ``transient`` is the
+    retry classification: True means the condition can resolve by
+    waiting (route through ``utils/retry``), False means the peer or
+    the stream is unrecoverable (classify the replica dead and
+    redrive)."""
+
+    transient = False
+
+
+class TransportTimeout(TransportError):
+    """Bounded recv expired with no frame — TRANSIENT: the peer may
+    merely be busy (a replica mid-compile, a router mid-steal). The
+    receiver re-waits under capped backoff; it never re-sends
+    (admission polls are not idempotent)."""
+
+    transient = True
+
+
+class TransportDead(TransportError):
+    """The peer is gone — EOF, closed pipe, or a dead process behind
+    the frame stream. TERMINAL: the router classifies the replica dead
+    and its work redrives; a replica seeing this exits
+    ``EXIT_PEER_DEAD``."""
+
+
+class TransportProtocolError(TransportError):
+    """The frame stream itself is broken — bad magic, a truncated
+    frame, or out-of-order delivery (sequence mismatch). TERMINAL and
+    LOUD: a desynchronised stream must never be resynchronised by
+    guesswork."""
+
+
+class TransportCorruptFrame(TransportProtocolError):
+    """A frame's payload failed its crc32 — wire corruption. TERMINAL
+    at the stream level (the in-proc disaggregated handoff retries
+    from prefill instead, through its own ``HandoffCorruptError``
+    seam)."""
+
+
+# the replica-side reply wait: one bounded recv per attempt, capped
+# backoff between attempts, then the replica classifies the ROUTER
+# dead and exits EXIT_PEER_DEAD (never a silent hang — the satellite
+# bugfix's contract)
+_REPLY_RETRY = RetryPolicy(initial_s=0.05, multiplier=2.0, cap_s=1.0,
+                           max_attempts=4, jitter=False)
+
+# replica process bring-up (spawn + READY handshake): a transient
+# spawn failure costs a retry, a spawn that fails every attempt is a
+# real failure — the target classifies dead and its planned requests
+# redrive (the _SPAWN_RETRY discipline, process-sized backoff)
+_SPAWN_PROC_RETRY = RetryPolicy(initial_s=0.1, multiplier=2.0, cap_s=1.0,
+                                max_attempts=3, jitter=False)
+
+
+# ------------------------------------------------------------ frame codec
+
+# length-prefixed + crc-verified + sequence-numbered: magic, payload
+# length, crc32(payload), then the 64-bit per-direction sequence number
+_MAGIC = b"GFT1"
+_HEADER = struct.Struct(">4sIIQ")
+
+
+def pack_frame(seq: int, payload: bytes) -> bytes:
+    """One wire frame: ``magic | len | crc32 | seq | payload``. The
+    length makes truncation detectable, the crc makes corruption loud,
+    and the sequence number makes reordered delivery refusable."""
+    return _HEADER.pack(_MAGIC, len(payload),
+                        zlib.crc32(payload), seq) + payload
+
+
+def unpack_frame(frame: bytes, *, expect_seq: int | None = None) -> bytes:
+    """Verify and strip one frame's header; returns the payload.
+
+    Every failure is classified and loud: a short or length-mismatched
+    frame raises :class:`TransportProtocolError` (truncated), a crc
+    mismatch raises :class:`TransportCorruptFrame`, and a sequence
+    number other than ``expect_seq`` raises
+    :class:`TransportProtocolError` (out-of-order delivery refused —
+    the stream is desynchronised, not repairable)."""
+    if len(frame) < _HEADER.size:
+        raise TransportProtocolError(
+            f"truncated frame: {len(frame)} byte(s) is shorter than "
+            f"the {_HEADER.size}-byte header")
+    magic, length, crc, seq = _HEADER.unpack_from(frame)
+    if magic != _MAGIC:
+        raise TransportProtocolError(
+            f"bad frame magic {magic!r} (want {_MAGIC!r}) — the "
+            f"stream is desynchronised or not a transport frame")
+    payload = frame[_HEADER.size:]
+    if len(payload) != length:
+        raise TransportProtocolError(
+            f"truncated frame: header promises {length} payload "
+            f"byte(s), got {len(payload)}")
+    if zlib.crc32(payload) != crc:
+        raise TransportCorruptFrame(
+            f"frame {seq} failed its crc32 — payload corrupted on "
+            f"the wire")
+    if expect_seq is not None and seq != expect_seq:
+        raise TransportProtocolError(
+            f"out-of-order frame: got seq {seq}, expected "
+            f"{expect_seq} — refusing to resynchronise a broken "
+            f"stream")
+    return payload
+
+
+class TransportMetrics:
+    """The transport's instruments on the fleet's shared registry:
+    ``transport_bytes_total``/``transport_frames_total`` (every frame
+    through the parent side of a channel, both directions),
+    ``transport_rtt_ms`` (replica-measured poll round-trips, sampled)
+    and ``transport_retries_total`` (classified reply retries). A
+    disabled registry costs nothing (no-op instruments)."""
+
+    def __init__(self, registry=None):
+        self.enabled = registry is not None and registry.enabled
+        if self.enabled:
+            self._bytes = registry.counter("transport_bytes_total")
+            self._frames = registry.counter("transport_frames_total")
+            self._retries = registry.counter("transport_retries_total")
+            self._rtt = registry.histogram("transport_rtt_ms")
+
+    def frame(self, nbytes: int) -> None:
+        if self.enabled:
+            self._bytes.inc(nbytes)
+            self._frames.inc()
+
+    def retries(self, n: int) -> None:
+        if self.enabled and n:
+            self._retries.inc(n)
+
+    def rtt_ms(self, samples) -> None:
+        if self.enabled:
+            for s in samples:
+                self._rtt.record(float(s))
+
+
+class FrameChannel:
+    """One side of a framed duplex stream over a
+    ``multiprocessing.connection.Connection``: every message is
+    pickled, wrapped by :func:`pack_frame` with this side's
+    monotonically increasing send sequence, and every receive is
+    BOUNDED — ``recv`` polls the connection up to ``timeout`` seconds
+    (:class:`TransportTimeout` on expiry; ``None`` means one
+    ``poll_s``-bounded slice, still never an unbounded block) before
+    reading, then verifies length/crc/sequence via
+    :func:`unpack_frame`. Single-owner by design: exactly one thread
+    sends and one thread receives on each side (the fleet serialises
+    calls per replica), so the sequence counters need no lock."""
+
+    poll_s = 0.25
+
+    def __init__(self, conn, *, metrics: TransportMetrics | None = None,
+                 label: str = ""):
+        self._conn = conn
+        self._metrics = metrics
+        self.label = label
+        self._send_seq = 0
+        self._recv_seq = 0
+
+    def send(self, obj) -> None:
+        frame = pack_frame(self._send_seq,
+                           pickle.dumps(obj, pickle.HIGHEST_PROTOCOL))
+        try:
+            self._conn.send_bytes(frame)
+        except (BrokenPipeError, EOFError, OSError) as exc:
+            raise TransportDead(
+                f"{self.label}: peer closed while sending frame "
+                f"{self._send_seq}: {exc}") from exc
+        self._send_seq += 1
+        if self._metrics is not None:
+            self._metrics.frame(len(frame))
+
+    def recv(self, timeout: float | None):
+        """Bounded receive: ``timeout`` seconds (``None`` → one
+        ``poll_s`` slice). :class:`TransportTimeout` when nothing
+        arrived, :class:`TransportDead` on EOF, the
+        :func:`unpack_frame` classification on a bad frame."""
+        budget = self.poll_s if timeout is None else timeout
+        try:
+            if not self._conn.poll(budget):
+                raise TransportTimeout(
+                    f"{self.label}: no frame within {budget:.3f}s "
+                    f"(waiting for seq {self._recv_seq})")
+            frame = self._conn.recv_bytes()
+        except (BrokenPipeError, EOFError, OSError) as exc:
+            raise TransportDead(
+                f"{self.label}: peer closed the stream at seq "
+                f"{self._recv_seq}: {exc}") from exc
+        payload = unpack_frame(frame, expect_seq=self._recv_seq)
+        self._recv_seq += 1
+        if self._metrics is not None:
+            self._metrics.frame(len(frame))
+        return pickle.loads(payload)
+
+    def close(self) -> None:
+        try:
+            self._conn.close()
+        except OSError:
+            pass  # already closed by the peer — closing is idempotent
+
+
+# -------------------------------------------- paged-block payload codec
+
+
+def encode_block_payload(payload: dict) -> dict:
+    """Flatten a ``paging.export_block_rows`` payload for the wire and
+    stamp it with ``paging.transfer_crc`` — the paged transfer layer's
+    own integrity primitive, chained crc32 over the key-sorted,
+    layer-ordered buffers. The decode side re-derives the crc from the
+    rebuilt arrays, so corruption anywhere between export and import
+    is loud (:class:`TransportCorruptFrame`), never silently imported
+    garbage rows."""
+    from .paging import transfer_crc
+
+    keys = sorted(payload)
+    bufs = [np.asarray(b) for k in keys for b in payload[k]]
+    return {
+        "keys": keys,
+        "layers": [len(payload[k]) for k in keys],
+        "shapes": [b.shape for b in bufs],
+        "dtypes": [b.dtype.str for b in bufs],
+        "data": [b.tobytes() for b in bufs],
+        "crc": transfer_crc(payload),
+    }
+
+
+def decode_block_payload(wire: dict) -> dict:
+    """Rebuild the block payload and verify its ``transfer_crc``
+    stamp; raises :class:`TransportCorruptFrame` on mismatch."""
+    from .paging import transfer_crc
+
+    bufs = [np.frombuffer(d, dtype=np.dtype(dt)).reshape(sh)
+            for d, dt, sh in zip(wire["data"], wire["dtypes"],
+                                 wire["shapes"])]
+    payload: dict = {}
+    at = 0
+    for k, n in zip(wire["keys"], wire["layers"]):
+        payload[k] = bufs[at:at + n]
+        at += n
+    got = transfer_crc(payload)
+    if got != wire["crc"]:
+        raise TransportCorruptFrame(
+            f"paged-block payload failed transfer_crc on the decode "
+            f"side of the wire: got {got:#010x}, stamped "
+            f"{wire['crc']:#010x}")
+    return payload
+
+
+# --------------------------------------------------------- the interface
+
+
+class Transport:
+    """How the router reaches its decode replicas. ``configure`` binds
+    a fleet shape (idempotent — an unchanged configuration keeps warm
+    replicas across ``make_fleet`` calls, which is how a shared
+    :class:`MultiProcTransport` amortises child spawns and compiles);
+    ``launch_decode`` starts one replica run and returns a
+    :class:`ReplicaHandle` the monitor polls instead of a raw thread.
+    ``process_isolated`` tells the fleet whether replica death is a
+    real possibility outside the fault plane (a crashed process) — the
+    fleet then always runs its managed recovery loop."""
+
+    name = "base"
+    process_isolated = False
+
+    def configure(self, *, params, cfg, max_len: int, engine_kw: dict,
+                  registry, n_dec: int, n_pre: int) -> None:
+        raise NotImplementedError
+
+    def ensure_engine(self, i: int):
+        """Build (or reuse) replica ``i``'s engine ahead of a
+        scale-up launch — the retryable unit ``_SPAWN_RETRY`` wraps."""
+        raise NotImplementedError
+
+    def prefill_engine(self, i: int):
+        """The disaggregated prefill side stays in-process in every
+        current transport (the handoff payload is the cross-boundary
+        object, not the worker)."""
+        raise NotImplementedError
+
+    def launch_decode(self, i: int, queue, run_kw: dict, *,
+                      on_error: Callable[[str, BaseException], None]
+                      ) -> "ReplicaHandle":
+        raise NotImplementedError
+
+    def close(self) -> None:
+        """Release replica resources (no-op in-proc; terminates child
+        processes multi-proc)."""
+
+
+class ReplicaHandle:
+    """One replica run in flight. ``is_alive`` / bounded ``join`` are
+    the monitor's liveness view; ``result``/``stats`` are read after
+    join; ``kill`` is the hard stop (SIGKILL for a process replica —
+    a thread replica cannot be killed, only abandoned)."""
+
+    label = "?"
+    error: BaseException | None = None
+
+    def is_alive(self) -> bool:
+        raise NotImplementedError
+
+    def join(self, timeout: float) -> bool:
+        """Bounded wait; True when the run finished inside
+        ``timeout`` (never an unbounded block — the satellite
+        bugfix's contract for fleet joins)."""
+        raise NotImplementedError
+
+    def result(self):
+        return None
+
+    def stats(self):
+        return None
+
+    def kill(self) -> None:
+        """Hard-stop the replica if the transport can (SIGKILL)."""
+
+
+# ------------------------------------------------------------ in-process
+
+
+class _ThreadHandle(ReplicaHandle):
+    """The in-proc replica: the engine runs on a daemon thread against
+    the router's queue directly — byte-for-byte the pre-seam fleet's
+    ``dec_worker``."""
+
+    def __init__(self, label: str, engine, queue, run_kw: dict,
+                 on_error) -> None:
+        self.label = label
+        self.error = None
+        self._result = None
+        self._engine = engine
+
+        def work():
+            try:
+                self._result = engine(
+                    run_kw["prompts"], run_kw["budgets"],
+                    slots=run_kw["slots"], eos_id=run_kw["eos_id"],
+                    rng=run_kw["rng"], kv_blocks=run_kw["kv_blocks"],
+                    admission=queue)
+            except Exception as exc:     # noqa: BLE001 — classified below
+                from .fleet import ReplicaKilled
+
+                if isinstance(exc, ReplicaKilled):
+                    # the queue's dead flag (set at the raise, before
+                    # the stack unwound) is the monitor's signal —
+                    # nothing else to do; the replica is simply gone
+                    return
+                self.error = exc
+                on_error(self.label, exc)
+
+        self._thread = threading.Thread(target=work, daemon=True,
+                                        name=f"fleet-{label}")
+        self._thread.start()
+
+    def is_alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def join(self, timeout: float) -> bool:
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    def result(self):
+        return self._result
+
+    def stats(self):
+        return self._engine.last_stats
+
+    def kill(self) -> None:
+        # a thread cannot be killed — the caller abandons it (daemon)
+        # after classifying it hung; only a process transport can do
+        # better, which is much of the point of having one
+        pass
+
+
+class InProcTransport(Transport):
+    """Today's fleet wire: engines in this process, replicas as
+    threads, the queue polled directly. The bit-match reference for
+    every other transport."""
+
+    name = "inproc"
+    process_isolated = False
+
+    def __init__(self):
+        self._key = None
+        self._registry = None
+        self.dec_engines: list = []
+        self.pre_engines: list = []
+
+    def configure(self, *, params, cfg, max_len, engine_kw, registry,
+                  n_dec, n_pre) -> None:
+        key = (id(params), cfg, max_len, tuple(sorted(
+            (k, repr(v)) for k, v in engine_kw.items())))
+        self._registry = registry
+        if key == self._key:
+            # unchanged config: keep warm engines (their step caches
+            # and prefix indexes), just grow to the new shape
+            while len(self.dec_engines) < n_dec:
+                self.dec_engines.append(self._build())
+            while len(self.pre_engines) < n_pre:
+                self.pre_engines.append(self._build())
+            return
+        self._key = key
+        self._params, self._cfg, self._max_len = params, cfg, max_len
+        self._engine_kw = dict(engine_kw)
+        # every engine shares the fleet's registry so router + engine
+        # spans stitch on one timeline; engines are separate objects on
+        # purpose — separate pools, separate step caches, no
+        # cross-thread state
+        self.dec_engines = [self._build() for _ in range(n_dec)]
+        self.pre_engines = [self._build() for _ in range(n_pre)]
+
+    def _build(self):
+        return make_serve_engine(self._params, self._cfg,
+                                 max_len=self._max_len,
+                                 telemetry=self._registry,
+                                 **self._engine_kw)
+
+    def ensure_engine(self, i: int):
+        while len(self.dec_engines) <= i:
+            self.dec_engines.append(None)
+        if self.dec_engines[i] is None:
+            self.dec_engines[i] = self._build()
+        return self.dec_engines[i]
+
+    def prefill_engine(self, i: int):
+        return self.pre_engines[i]
+
+    def launch_decode(self, i, queue, run_kw, *, on_error):
+        return _ThreadHandle(f"decode-{i}", self.dec_engines[i],
+                             queue, run_kw, on_error)
+
+    def close(self) -> None:
+        pass                             # nothing lives outside us
+
+
+# ---------------------------------------------------------- multi-process
+
+
+class _RPCAdmission(AdmissionSource):
+    """The replica-side proxy: every engine-facing admission poll
+    becomes one ``("REQ", method, args)`` frame to the router and one
+    bounded wait for its ``("REP", ...)``. A reply that times out is
+    re-WAITED under ``_REPLY_RETRY`` (never re-sent — polls are not
+    idempotent); an exhausted budget classifies the router dead and
+    the replica exits ``EXIT_PEER_DEAD``. Round-trips are measured
+    here (the replica's clock, both directions of real wire) and
+    shipped home in the DONE frame."""
+
+    _SAMPLE_CAP = 256
+
+    def __init__(self, chan: FrameChannel, reply_timeout_s: float):
+        self._chan = chan
+        self._reply_timeout_s = reply_timeout_s
+        self.rtt_ms: list[float] = []
+        self.retries = 0
+
+    def _call(self, method: str, *args):
+        t0 = time.monotonic()
+        self._chan.send(("REQ", method, args))
+
+        def _recv():
+            return self._chan.recv(self._reply_timeout_s)
+
+        def _note(_msg: str) -> None:
+            self.retries += 1
+
+        reply = retry_call(_recv, policy=_REPLY_RETRY,
+                           what=f"{self._chan.label} {method} reply",
+                           retryable=(TransportTimeout,), log=_note)
+        if len(self.rtt_ms) < self._SAMPLE_CAP:
+            self.rtt_ms.append((time.monotonic() - t0) * 1e3)
+        tag, payload = reply
+        if tag != "REP":
+            raise TransportProtocolError(
+                f"{self._chan.label}: expected a REP frame for "
+                f"{method}, got {tag!r}")
+        status, value = payload
+        if status == "EXC":
+            # the router-side queue method raised: surface it in the
+            # replica's engine exactly like the in-proc fault seam
+            # (the engine deliberately does not catch hook errors)
+            raise RuntimeError(
+                f"router-side {method}() failed: {value}")
+        return value
+
+    def candidate(self):
+        return self._call("candidate")
+
+    def pop(self, req) -> None:
+        self._call("pop", int(req))
+
+    def requeue(self, req) -> None:
+        self._call("requeue", int(req))
+
+    def tick(self) -> None:
+        self._call("tick")
+
+    def draining(self) -> bool:
+        return self._call("draining")
+
+    def waiting(self) -> int:
+        return self._call("waiting")
+
+    def exhausted(self) -> bool:
+        return self._call("exhausted")
+
+    def idle_wait(self) -> None:
+        self._call("idle_wait")
+
+    def wait_s(self, req) -> float:
+        return self._call("wait_s", int(req))
+
+    def kv_import(self, req):
+        wire = self._call("kv_import", int(req))
+        if wire is None:
+            return None
+        return dict(wire, blocks=decode_block_payload(wire["blocks"]))
+
+    def retired(self, req, tokens: int) -> None:
+        self._call("retired", int(req), int(tokens))
+
+    def warm_chains(self):
+        # the elastic warm bring-up plane is in-proc only in v1 (host
+        # KV chains migrate through shared state, not frames) — a
+        # multi-proc replica always starts cold
+        return None
+
+    def chain_sink(self):
+        return None
+
+
+def _replica_child_main(conn, index: int, params, cfg, max_len: int,
+                        engine_kw: dict, reply_timeout_s: float) -> None:
+    """The replica process: build the engine once, then serve RUN
+    frames until EXIT (children persist across fleet calls — compiles
+    amortise exactly like in-proc engines). Every recv is bounded; a
+    dead or desynchronised router stream exits ``EXIT_PEER_DEAD`` so
+    ``resilience.classify_exit`` reads a classified death, never a
+    hang."""
+    chan = FrameChannel(conn, label=f"replica-{index}/child")
+    engine = make_serve_engine(params, cfg, max_len=max_len,
+                               **engine_kw)
+    try:
+        chan.send(("READY", index, os.getpid()))
+        while True:
+            try:
+                # idle between fleet calls: wait patiently in bounded
+                # slices (poll_s) — EOF means the router is gone
+                msg = chan.recv(None)
+            except TransportTimeout:
+                continue
+            if msg[0] == "EXIT":
+                return
+            if msg[0] != "RUN":
+                raise TransportProtocolError(
+                    f"replica-{index}: unexpected frame {msg[0]!r} "
+                    f"while waiting for RUN")
+            run_kw = msg[1]
+            adm = _RPCAdmission(chan, reply_timeout_s)
+            try:
+                res = engine(run_kw["prompts"], run_kw["budgets"],
+                             slots=run_kw["slots"],
+                             eos_id=run_kw["eos_id"], rng=None,
+                             kv_blocks=run_kw["kv_blocks"],
+                             admission=adm)
+            except (TransportError, RetriesExhausted):
+                # the ROUTER side of the wire failed mid-run: that is
+                # a peer death, not an engine error — escalate to the
+                # classified exit below, never an ERR frame into a
+                # broken stream
+                raise
+            except Exception as exc:     # noqa: BLE001 — shipped home
+                chan.send(("ERR", type(exc).__name__, str(exc),
+                           adm.rtt_ms, adm.retries))
+                continue
+            out = {int(r): np.asarray(v) for r, v in res.items()}
+            chan.send(("DONE", out, engine.last_stats,
+                       adm.rtt_ms, adm.retries))
+    except (TransportError, RetriesExhausted):
+        # classified peer/stream death: the router is gone or the
+        # stream desynchronised — exit with the classified code
+        # (resilience.classify_exit → "peer_dead")
+        os._exit(EXIT_PEER_DEAD)
+    finally:
+        chan.close()
+
+
+class _ProcHandle(ReplicaHandle):
+    """One multi-proc replica run: the parent-side RPC handler. A
+    daemon thread sends the RUN frame, then serves the replica's
+    admission polls against the real router queue until DONE/ERR —
+    or until a poll raises ``ReplicaKilled``, at which point the
+    fault plane's kill becomes a REAL ``SIGKILL`` of the replica
+    process at the identical poll boundary. Unexpected child death
+    (EOF, crash, OOM-kill) classifies the replica dead through the
+    same ``queue.dead`` flag the in-proc fault seam sets, so the
+    router's redrive machinery recovers identically."""
+
+    poll_s = 0.05
+
+    def __init__(self, transport: "MultiProcTransport", i: int,
+                 proc, chan: FrameChannel, queue, run_kw: dict,
+                 on_error) -> None:
+        self.label = f"decode-{i}"
+        self.error = None
+        self._transport = transport
+        self._i = i
+        self._proc = proc
+        self._chan = chan
+        self._queue = queue
+        self._run_kw = run_kw
+        self._on_error = on_error
+        self._result = None
+        self._stats = None
+        self._killed = False
+        self._done = threading.Event()
+        self._thread = threading.Thread(
+            target=self._serve, daemon=True,
+            name=f"fleet-rpc-{self.label}")
+        self._thread.start()
+
+    def _serve(self) -> None:
+        from .fleet import ReplicaKilled
+
+        try:
+            try:
+                self._chan.send(("RUN", self._run_kw))
+                while True:
+                    try:
+                        msg = self._chan.recv(self.poll_s)
+                    except TransportTimeout:
+                        if not self._proc.is_alive():
+                            raise TransportDead(
+                                f"{self.label}: replica process "
+                                f"pid={self._proc.pid} died "
+                                f"(exitcode={self._proc.exitcode}) "
+                                f"mid-run") from None
+                        continue
+                    if msg[0] == "REQ":
+                        _, method, args = msg
+                        try:
+                            value = getattr(self._queue, method)(*args)
+                        except ReplicaKilled:
+                            # the fault plane fired at this poll
+                            # boundary: make it REAL — SIGKILL the
+                            # replica process (queue.dead is already
+                            # set by _pulse; the router redrives)
+                            self._sigkill()
+                            return
+                        except Exception as exc:  # noqa: BLE001 — shipped to replica
+                            self._chan.send(
+                                ("REP", ("EXC",
+                                         f"{type(exc).__name__}: "
+                                         f"{exc}")))
+                            continue
+                        if method == "kv_import" and value is not None:
+                            value = dict(
+                                value,
+                                first=np.asarray(value["first"]),
+                                blocks=encode_block_payload(
+                                    value["blocks"]))
+                        self._chan.send(("REP", ("OK", value)))
+                    elif msg[0] == "DONE":
+                        _, out, stats, rtt_ms, retries = msg
+                        self._result = out
+                        self._stats = stats
+                        self._transport.metrics.rtt_ms(rtt_ms)
+                        self._transport.metrics.retries(retries)
+                        return
+                    elif msg[0] == "ERR":
+                        _, tname, text, rtt_ms, retries = msg
+                        self._transport.metrics.rtt_ms(rtt_ms)
+                        self._transport.metrics.retries(retries)
+                        exc = RuntimeError(
+                            f"[replica process {tname}] {text}")
+                        self.error = exc
+                        self._on_error(self.label, exc)
+                        return
+                    else:
+                        raise TransportProtocolError(
+                            f"{self.label}: unexpected frame "
+                            f"{msg[0]!r} mid-run")
+            except (TransportDead, TransportProtocolError) as exc:
+                # terminal transport failure: classify the replica
+                # dead through the same flag the in-proc kill seam
+                # sets — the router's take_lost→redrive machinery
+                # recovers; never a hang, never a silent strand
+                self.error = exc
+                self._queue.dead = True
+                self._transport._discard_child(self._i)
+        finally:
+            self._done.set()
+
+    def _sigkill(self) -> None:
+        self._killed = True
+        try:
+            os.kill(self._proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, OSError):
+            pass                         # already gone — same outcome
+        self._proc.join(5.0)
+        self._transport._discard_child(self._i)
+
+    def is_alive(self) -> bool:
+        return not self._done.is_set()
+
+    def join(self, timeout: float) -> bool:
+        return self._done.wait(timeout)
+
+    def result(self):
+        return self._result
+
+    def stats(self):
+        return self._stats
+
+    def kill(self) -> None:
+        """Hard stop: SIGKILL the replica process (the hung-worker
+        escape hatch — a real process can always be reaped, which is
+        exactly what a thread replica cannot offer)."""
+        if self._proc.is_alive():
+            self._sigkill()
+        self._done.set()
+
+
+class MultiProcTransport(Transport):
+    """Replicas as real, persistent subprocesses (spawn context — a
+    forked JAX runtime deadlocks) connected by framed OS pipes. Every
+    ``launch_decode`` reuses the replica's warm child when it is
+    alive and respawns it when it is not (the call after a SIGKILL —
+    bring-up under ``utils/retry`` capped backoff). ``close()``
+    terminates the children; they are daemons, so an abandoned
+    transport cannot outlive the parent either."""
+
+    name = "multiproc"
+    process_isolated = True
+
+    def __init__(self, *, reply_timeout_s: float = 15.0,
+                 spawn_timeout_s: float = 180.0):
+        if reply_timeout_s <= 0:
+            raise ValueError(
+                f"reply_timeout_s must be > 0, got {reply_timeout_s}")
+        if spawn_timeout_s <= 0:
+            raise ValueError(
+                f"spawn_timeout_s must be > 0, got {spawn_timeout_s}")
+        self.reply_timeout_s = reply_timeout_s
+        self.spawn_timeout_s = spawn_timeout_s
+        self.metrics = TransportMetrics(None)
+        self._key = None
+        self._lock = threading.Lock()
+        self._children: dict[int, tuple] = {}     # i -> (proc, chan)
+        self._params_np = None
+
+    def configure(self, *, params, cfg, max_len, engine_kw, registry,
+                  n_dec, n_pre) -> None:
+        for k in ("sampler",):
+            if engine_kw.get(k) is not None:
+                raise ValueError(
+                    f"MultiProcTransport does not compose with {k} — "
+                    f"a sampler callable does not cross a process "
+                    f"boundary; multi-proc serving is greedy-only in "
+                    f"v1")
+        if n_pre:
+            raise ValueError(
+                "MultiProcTransport does not run disaggregated "
+                "prefill workers in v1 — the prefill→decode handoff "
+                "stays in-proc (see models/transport.py)")
+        key = (id(params), cfg, max_len, tuple(sorted(
+            (k, repr(v)) for k, v in engine_kw.items())))
+        self.metrics = TransportMetrics(registry)
+        if key == self._key:
+            return                       # keep warm children
+        self.close()
+        self._key = key
+        self._params, self._cfg, self._max_len = params, cfg, max_len
+        self._engine_kw = dict(engine_kw)
+        self._params_np = None           # re-snapshot lazily
+
+    def ensure_engine(self, i: int):
+        raise ValueError(
+            "MultiProcTransport does not autoscale in v1 — warm "
+            "bring-up migrates host-tier KV through shared memory, "
+            "which does not cross a process boundary; run elastic "
+            "fleets on InProcTransport")
+
+    def prefill_engine(self, i: int):
+        raise ValueError(
+            "MultiProcTransport has no in-process prefill engines "
+            "(disaggregate is refused at configure time)")
+
+    def _snapshot_params(self):
+        if self._params_np is None:
+            import jax
+
+            # one host snapshot per configure: the child rebuilds its
+            # own device arrays from these at engine build
+            self._params_np = jax.device_get(self._params)
+        return self._params_np
+
+    def _spawn(self, i: int):
+        """Bring up replica ``i``: spawn + READY handshake, the whole
+        unit retried under capped backoff (a transient spawn failure
+        costs a retry; exhaustion propagates and the fleet classifies
+        the replica dead — its planned requests redrive)."""
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
+        params_np = self._snapshot_params()
+
+        def bring_up():
+            parent_conn, child_conn = ctx.Pipe(duplex=True)
+            proc = ctx.Process(
+                target=_replica_child_main,
+                args=(child_conn, i, params_np, self._cfg,
+                      self._max_len, self._engine_kw,
+                      self.reply_timeout_s),
+                daemon=True, name=f"fleet-replica-{i}")
+            proc.start()
+            child_conn.close()
+            chan = FrameChannel(parent_conn, metrics=self.metrics,
+                                label=f"replica-{i}/router")
+            try:
+                msg = chan.recv(self.spawn_timeout_s)
+                if msg[0] != "READY" or msg[1] != i:
+                    raise TransportProtocolError(
+                        f"replica-{i}: bad READY handshake: {msg!r}")
+            except TransportError:
+                chan.close()
+                if proc.is_alive():
+                    proc.terminate()
+                proc.join(5.0)
+                raise
+            return proc, chan
+
+        return retry_call(bring_up, policy=_SPAWN_PROC_RETRY,
+                          what=f"replica-{i} process spawn",
+                          retryable=(TransportError,))
+
+    def _discard_child(self, i: int) -> None:
+        with self._lock:
+            child = self._children.pop(i, None)
+        if child is not None:
+            proc, chan = child
+            chan.close()
+            if proc.is_alive():
+                proc.terminate()
+            proc.join(5.0)
+
+    def launch_decode(self, i, queue, run_kw, *, on_error):
+        if run_kw.get("rng") is not None:
+            raise ValueError(
+                "MultiProcTransport is greedy-only in v1: a device "
+                "PRNG key does not cross a process boundary — pass "
+                "rng=None (or use InProcTransport)")
+        with self._lock:
+            child = self._children.get(i)
+        if child is not None and not child[0].is_alive():
+            # killed (or crashed) on a previous call: reap and respawn
+            self._discard_child(i)
+            child = None
+        if child is None:
+            child = self._spawn(i)
+            with self._lock:
+                self._children[i] = child
+        proc, chan = child
+        wire_kw = {
+            "prompts": [np.asarray(p) for p in run_kw["prompts"]],
+            "budgets": [int(b) for b in run_kw["budgets"]],
+            "slots": run_kw["slots"],
+            "eos_id": run_kw["eos_id"],
+            "kv_blocks": run_kw["kv_blocks"],
+        }
+        return _ProcHandle(self, i, proc, chan, queue, wire_kw,
+                           on_error)
+
+    def close(self) -> None:
+        with self._lock:
+            children, self._children = dict(self._children), {}
+        for proc, chan in children.values():
+            try:
+                chan.send(("EXIT",))
+            except TransportError:
+                pass                     # already dead — reap below
+            proc.join(2.0)
+            chan.close()
+            if proc.is_alive():
+                proc.terminate()
+                proc.join(2.0)
+            if proc.is_alive():
+                os.kill(proc.pid, signal.SIGKILL)
+                proc.join(2.0)

@@ -71,6 +71,17 @@ Instrumented layers (all emit here when enabled):
                                       counters, one ``fleet_scale`` span
                                       per executed scale event (args:
                                       trigger, replica, warm)
+``models/transport``                  ``transport_bytes_total`` /
+                                      ``transport_frames_total`` counters
+                                      (every frame through the router
+                                      side of each replica pipe, both
+                                      directions),
+                                      ``transport_rtt_ms`` histogram
+                                      (replica-measured admission-poll
+                                      round-trips),
+                                      ``transport_retries_total``
+                                      counter (classified transient
+                                      reply retries)
 ``parallel/collectives``              ``hierarchical_psum`` ICI-vs-DCN
                                       phase spans (probe side) +
                                       ``jax.named_scope`` phase names in

@@ -599,6 +599,68 @@ def test_suppression_wildcard(tmp_path):
     assert fs == []
 
 
+# ================================================== rule: unbounded-recv
+
+def test_unbounded_recv_positive(tmp_path):
+    """Timeout-less receives and zero-arg joins in the serving runtime
+    are latent hangs — each named, each at its line."""
+    fs = lint(tmp_path, {"models/transport.py": """\
+        def pump(conn, q, worker):
+            frame = conn.recv_bytes()
+            item = q.get()
+            worker.join()
+            return frame, item
+    """})
+    found = hit(fs, "graft-unbounded-recv")
+    assert len(found) == 3
+    assert all(f.severity == "error" for f in found)
+    wheres = sorted(f.where for f in found)
+    assert wheres == ["src/models/transport.py:2",
+                      "src/models/transport.py:3",
+                      "src/models/transport.py:4"]
+    msgs = " ".join(f.message for f in found)
+    assert ".recv_bytes()" in msgs and ".get()" in msgs \
+        and ".join()" in msgs
+
+
+def test_unbounded_recv_negative_bounded_and_guarded(tmp_path):
+    """The bounded idioms pass: explicit timeouts, the
+    poll-then-recv_bytes guard (FrameChannel.recv's shape), joins with
+    a budget, argful ``str.join``, and receives outside the
+    serving-runtime scope."""
+    fs = lint(tmp_path, {"models/fleet.py": """\
+        def bounded(conn, q, worker, parts):
+            item = q.get(timeout=1.0)
+            worker.join(5.0)
+            label = ",".join(parts)
+            return item, label
+
+        def guarded(conn, budget):
+            if not conn.poll(budget):
+                raise TimeoutError
+            return conn.recv_bytes()
+    """, "models/checkpoint.py": """\
+        def out_of_scope(q):
+            return q.get()
+    """})
+    assert hit(fs, "graft-unbounded-recv") == []
+
+
+def test_unbounded_recv_guard_is_per_function(tmp_path):
+    """A poll elsewhere in the file does not bless a different
+    function's unbounded receive — the guard is scope-local."""
+    fs = lint(tmp_path, {"models/serving.py": """\
+        def guarded(conn):
+            conn.poll(0.1)
+            return conn.recv_bytes()
+
+        def naked(other):
+            return other.recv_bytes()
+    """})
+    found = hit(fs, "graft-unbounded-recv")
+    assert [f.where for f in found] == ["src/models/serving.py:6"]
+
+
 def test_severity_overrides_and_off(tmp_path):
     files = {"s.py": "import random\nR = random.Random()\n"}
     assert lint(tmp_path, files,
@@ -618,7 +680,7 @@ def test_rule_catalog(tmp_path):
         "graft-load", "graft-unseeded-rng", "graft-host-sync-in-loop",
         "graft-wallclock-nondeterminism", "graft-silent-except",
         "graft-unlocked-shared-state", "graft-donated-reuse",
-        "graft-lock-cycle",
+        "graft-lock-cycle", "graft-unbounded-recv",
     }
     # disjoint from the HCL pack: one engine, two registries
     from nvidia_terraform_modules_tpu.tfsim.lint import engine as hcl

@@ -308,6 +308,88 @@ def test_section_serve_fleet_deterministic_across_runs():
 
 
 @pytest.mark.slow
+def test_section_serve_fleet_transport_schema_and_gates():
+    """Gate on the transport section (ISSUE 17): full schema, the
+    multi-proc fleet's outputs bit-match the in-proc reference on the
+    saturated Zipf trace, real wire bytes moved, and the seeded
+    SIGKILL actually killed a process whose requests redrove (the
+    fleet raises on loss, so completion is implied by returning).
+    Slow-marked: the section spawns real replica processes that each
+    cold-compile their own engine."""
+    bench = _bench_mod()
+    out = bench.section_serve_fleet_transport()
+    for key in ("serve_fleet_transport_replicas",
+                "serve_fleet_transport_requests",
+                "serve_fleet_transport_tokens",
+                "serve_fleet_transport_trace",
+                "serve_fleet_transport_inproc_goodput",
+                "serve_fleet_transport_inproc_goodput_minmax",
+                "serve_fleet_transport_multiproc_goodput",
+                "serve_fleet_transport_multiproc_goodput_minmax",
+                "serve_fleet_transport_overhead",
+                "serve_fleet_transport_bitmatch",
+                "serve_fleet_transport_bytes_per_req",
+                "serve_fleet_transport_frames_per_req",
+                "serve_fleet_proc_kill_at_s",
+                "serve_fleet_proc_kill_redrive_p99",
+                "serve_fleet_proc_undisturbed_p99",
+                "serve_fleet_proc_kill_redrive_p99_vs_undisturbed",
+                "serve_fleet_proc_replica_down",
+                "serve_fleet_proc_redriven"):
+        assert key in out, key
+    # the transport moves bytes, never semantics (CPU run: the
+    # bit-match leg is None only on TPU, where children pin to the
+    # host backend)
+    assert out["serve_fleet_transport_bitmatch"] is True
+    assert out["serve_fleet_transport_inproc_goodput"] > 0
+    assert out["serve_fleet_transport_multiproc_goodput"] > 0
+    assert out["serve_fleet_transport_overhead"] > 0
+    # real frames crossed the pipes, and a request costs at least one
+    # admission RPC round-trip
+    assert out["serve_fleet_transport_bytes_per_req"] > 0
+    assert out["serve_fleet_transport_frames_per_req"] >= 2
+    # kill-for-real: the seeded SIGKILL fired strictly inside the
+    # trace, the dead replica's planned requests redrove, and both
+    # tails were measured
+    assert out["serve_fleet_proc_kill_at_s"] > 0
+    assert out["serve_fleet_proc_replica_down"] == 1
+    assert out["serve_fleet_proc_redriven"] >= 0
+    assert out["serve_fleet_proc_kill_redrive_p99"] > 0
+    assert out["serve_fleet_proc_undisturbed_p99"] > 0
+    assert out["serve_fleet_proc_kill_redrive_p99_vs_undisturbed"] > 0
+    from nvidia_terraform_modules_tpu.utils.traffic import (
+        poisson_trace,
+        trace_summary,
+    )
+
+    tr = out["serve_fleet_transport_trace"]
+    want = trace_summary(poisson_trace(
+        tr["rate"], out["serve_fleet_transport_requests"], tr["seed"]))
+    assert {k: tr[k] for k in want} == want
+
+
+@pytest.mark.slow
+def test_section_serve_fleet_transport_deterministic_across_runs():
+    """The seed-determined transport fields replay exactly: the
+    bit-match verdict, the kill instant and that the kill fired, and
+    the trace provenance. The wall clocks (goodputs, p99s) and the
+    wire counters (poll counts are timing-dependent) are excluded —
+    ``serve_fleet_proc_redriven`` too, since how many of the victim's
+    requests were still queued at the kill depends on real time."""
+    bench = _bench_mod()
+    a = bench.section_serve_fleet_transport()
+    b = bench.section_serve_fleet_transport()
+    for key in ("serve_fleet_transport_replicas",
+                "serve_fleet_transport_requests",
+                "serve_fleet_transport_tokens",
+                "serve_fleet_transport_trace",
+                "serve_fleet_transport_bitmatch",
+                "serve_fleet_proc_kill_at_s",
+                "serve_fleet_proc_replica_down"):
+        assert a[key] == b[key], key
+
+
+@pytest.mark.slow
 def test_section_serve_engine_deterministic_across_runs():
     """Two runs of the section agree on every seed-determined field
     (workload, wave counts, block accounting) — only the clocks may

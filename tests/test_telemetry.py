@@ -1021,3 +1021,73 @@ def test_fleet_scale_gauge_counters_and_span_export(jax8, tmp_path):
     assert reg2.counter("fleet_scale_down_total").value == 0
     assert not [e for e in reg2.events
                 if e["kind"] == "span" and e["name"] == "fleet_scale"]
+
+
+def test_transport_frame_and_rtt_instruments_export(tmp_path):
+    """The transport seam's four instruments, golden-tested at the
+    frame layer: ``transport_frames_total``/``transport_bytes_total``
+    count every frame through the metered (router) side of a channel —
+    both directions, bytes EXACT against a recomputation of the same
+    frames — ``transport_rtt_ms`` records the replica-measured poll
+    round-trips and ``transport_retries_total`` the classified reply
+    retries. A disabled registry costs nothing (no-op instruments)."""
+    import multiprocessing as mp
+    import pickle as _pickle
+
+    from nvidia_terraform_modules_tpu.models.transport import (
+        FrameChannel,
+        TransportMetrics,
+        pack_frame,
+    )
+
+    reg = Registry(str(tmp_path))
+    metrics = TransportMetrics(reg)
+    a, b = mp.Pipe(duplex=True)
+    router = FrameChannel(a, metrics=metrics, label="router")
+    replica = FrameChannel(b, label="replica")  # peer side unmetered
+    try:
+        sent = [("REQ", "candidate", ()), ("REQ", "pop", (3,)),
+                ("REQ", "retired", (3, 6))]
+        got_back = [("REP", ("OK", None)), ("REP", ("OK", True))]
+        for msg in sent:
+            router.send(msg)
+        for _ in sent:
+            assert replica.recv(1.0) in sent
+        for msg in got_back:
+            replica.send(msg)
+        for _ in got_back:
+            router.recv(1.0)
+
+        # bytes golden: the metered side saw exactly these frames
+        want_bytes = sum(
+            len(pack_frame(seq, _pickle.dumps(m, _pickle.HIGHEST_PROTOCOL)))
+            for seq, m in enumerate(sent))
+        want_bytes += sum(
+            len(pack_frame(seq, _pickle.dumps(m, _pickle.HIGHEST_PROTOCOL)))
+            for seq, m in enumerate(got_back))
+        assert reg.counter("transport_frames_total").value == 5
+        assert reg.counter("transport_bytes_total").value == want_bytes
+
+        metrics.rtt_ms([0.5, 1.25, 40.0])
+        metrics.retries(2)
+        metrics.retries(0)                   # zero retries: no count
+        hist = reg.histogram("transport_rtt_ms")
+        assert hist.count == 3
+        assert math.isclose(hist.sum, 41.75)
+        assert reg.counter("transport_retries_total").value == 2
+
+        prom = reg.prometheus_text()
+        assert "# TYPE transport_frames_total counter" in prom
+        assert "# TYPE transport_bytes_total counter" in prom
+        assert "# TYPE transport_retries_total counter" in prom
+        assert "transport_rtt_ms" in prom
+    finally:
+        router.close()
+        replica.close()
+
+    # disabled registry: the metrics object is inert end to end
+    off = TransportMetrics(None)
+    assert off.enabled is False
+    off.frame(128)
+    off.retries(5)
+    off.rtt_ms([1.0])

@@ -1,0 +1,396 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Transport seam: the wire is allowed to change, the tokens are not.
+
+The fleet's router↔replica communication lives behind
+``models/transport.py``'s :class:`Transport` interface. These tests pin
+the two halves of its contract:
+
+- **The frame layer is loud.** A corrupt frame, a truncated frame, an
+  out-of-order frame, a dead peer — each raises its own classified
+  error, and only :class:`TransportTimeout` is transient (re-WAIT under
+  ``utils/retry``, never re-send). Paged-block payloads re-verify
+  ``paging.transfer_crc`` on the decode side of the wire.
+- **Process isolation changes nothing observable.** A multi-proc fleet
+  (replicas as real spawned subprocesses, every admission poll a framed
+  RPC) bit-matches the in-proc fleet and solo greedy on the same seeded
+  shared-prefix trace — including through a REAL ``SIGKILL`` of a
+  replica process mid-run, after which the victim's requests redrive
+  exactly once (the fleet raises on duplicates; served == submitted
+  proves none stranded) and the next call respawns the child.
+"""
+
+import functools
+import multiprocessing as mp
+import pickle
+import signal
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nvidia_terraform_modules_tpu.models import (
+    BurnInConfig,
+    FrameChannel,
+    MultiProcTransport,
+    TransportCorruptFrame,
+    TransportDead,
+    TransportError,
+    TransportProtocolError,
+    TransportTimeout,
+    greedy_decode,
+    init_params,
+    make_fleet,
+    pack_frame,
+    unpack_frame,
+)
+from nvidia_terraform_modules_tpu.models.fleet import (
+    FleetFault,
+    FleetFaultProfile,
+    FleetWorkerHung,
+)
+from nvidia_terraform_modules_tpu.models.transport import (
+    decode_block_payload,
+    encode_block_payload,
+)
+from nvidia_terraform_modules_tpu.utils.retry import RetryPolicy, retry_call
+from nvidia_terraform_modules_tpu.utils.traffic import shared_prefix_prompts
+
+CFG = dict(vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2,
+           seq_len=32, batch=2, dtype=jnp.float32)
+
+
+@functools.lru_cache(maxsize=None)
+def _zipf_setup(n=10):
+    cfg = BurnInConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(2), cfg)
+    pairs = shared_prefix_prompts(n, seed=0, n_templates=3,
+                                  template_len=8, suffix_lo=1,
+                                  suffix_hi=4, vocab=cfg.vocab)
+    prompts = tuple(jnp.asarray(p, jnp.int32) for _t, p in pairs)
+    max_len = max(int(p.shape[-1]) for p in prompts) + 7
+    return cfg, params, prompts, max_len
+
+
+def _solo(params, prompts, n_new, cfg):
+    return [greedy_decode(params, p[None, :], n_new, cfg)[0]
+            for p in prompts]
+
+
+def _assert_all_equal(outs, want, label=""):
+    for i, (g, w) in enumerate(zip(outs, want)):
+        assert g is not None, f"{label} request {i} unserved"
+        assert jnp.array_equal(jnp.asarray(g), w), \
+            f"{label} request {i} diverged"
+
+
+# ------------------------------------------------------------ frame codec
+
+
+def test_transport_frame_roundtrip_and_sequencing():
+    """Frames roundtrip bytes exactly and carry their sequence; the
+    receive side can pin the expected sequence number."""
+    for seq, payload in [(0, b""), (1, b"x"), (7, bytes(range(256)) * 3)]:
+        frame = pack_frame(seq, payload)
+        assert unpack_frame(frame) == payload
+        assert unpack_frame(frame, expect_seq=seq) == payload
+
+
+def test_transport_corrupt_frame_is_loud():
+    """A flipped payload byte fails the frame crc32 — classified
+    :class:`TransportCorruptFrame`, terminal (transient=False), never
+    silently delivered garbage."""
+    frame = bytearray(pack_frame(3, b"paged-block-rows"))
+    frame[-1] ^= 0x40
+    with pytest.raises(TransportCorruptFrame, match="crc32"):
+        unpack_frame(bytes(frame), expect_seq=3)
+    assert TransportCorruptFrame.transient is False
+    assert issubclass(TransportCorruptFrame, TransportProtocolError)
+
+
+def test_transport_truncated_frame_is_loud():
+    """Both truncation shapes are refused: a frame shorter than the
+    header, and a header whose promised length exceeds the payload."""
+    frame = pack_frame(0, b"0123456789")
+    with pytest.raises(TransportProtocolError, match="truncated"):
+        unpack_frame(frame[:11])           # inside the header
+    with pytest.raises(TransportProtocolError, match="truncated"):
+        unpack_frame(frame[:-3])           # payload cut short
+    with pytest.raises(TransportProtocolError, match="magic"):
+        unpack_frame(b"XXXX" + frame[4:])  # not a transport frame
+
+
+def test_transport_out_of_order_frame_refused():
+    """A frame whose sequence number is not the expected one is refused
+    loudly — a desynchronised stream is never resynchronised by
+    guesswork."""
+    frame = pack_frame(5, b"late")
+    with pytest.raises(TransportProtocolError, match="out-of-order"):
+        unpack_frame(frame, expect_seq=4)
+
+
+def test_transport_error_taxonomy_classification():
+    """Only the timeout is transient; every stream-integrity failure
+    and peer death is terminal. ``utils/retry`` policies key off this
+    flag, so it is part of the wire contract."""
+    assert TransportTimeout.transient is True
+    assert TransportDead.transient is False
+    assert TransportProtocolError.transient is False
+    for klass in (TransportTimeout, TransportDead,
+                  TransportProtocolError, TransportCorruptFrame):
+        assert issubclass(klass, TransportError)
+
+
+def test_frame_channel_timeout_then_classified_retry_delivers_once():
+    """The reply-wait discipline: a bounded recv that expires raises
+    TRANSIENT :class:`TransportTimeout`; the caller re-WAITS under a
+    ``utils/retry`` policy (never re-sends) and the late reply is
+    delivered exactly once."""
+    a, b = mp.Pipe(duplex=True)
+    tx, rx = FrameChannel(a, label="tx"), FrameChannel(b, label="rx")
+    try:
+        with pytest.raises(TransportTimeout) as exc:
+            rx.recv(0.05)
+        assert exc.value.transient is True
+
+        # the peer replies late: the bounded re-wait (retry on the
+        # classified transient error only) picks it up exactly once
+        t = threading.Timer(0.15, tx.send, args=({"req": 4, "tok": 9},))
+        t.start()
+        attempts = []
+        got = retry_call(
+            lambda: rx.recv(0.05),
+            policy=RetryPolicy(initial_s=0.01, multiplier=2.0,
+                               cap_s=0.1, max_attempts=8, jitter=False),
+            what="late reply", retryable=(TransportTimeout,),
+            log=attempts.append)
+        t.join()
+        assert got == {"req": 4, "tok": 9}
+        assert attempts                      # it really did retry
+        with pytest.raises(TransportTimeout):
+            rx.recv(0.02)                    # delivered ONCE — queue empty
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_frame_channel_dead_peer_classified():
+    """EOF on the stream — the peer closed or died — is classified
+    :class:`TransportDead` on both recv and send."""
+    a, b = mp.Pipe(duplex=True)
+    tx, rx = FrameChannel(a, label="tx"), FrameChannel(b, label="rx")
+    tx.close()
+    with pytest.raises(TransportDead):
+        rx.recv(1.0)
+    with pytest.raises(TransportDead):
+        for _ in range(64):  # a closed pipe may buffer a write or two
+            rx.send("into the void")
+    rx.close()
+
+
+def test_frame_channel_refuses_reordered_wire_frames():
+    """Raw frames written out of order onto the pipe are refused at the
+    channel's sequence check, not delivered shuffled."""
+    a, b = mp.Pipe(duplex=True)
+    rx = FrameChannel(b, label="rx")
+    try:
+        # hand-craft the peer's frames and swap their order on the wire
+        a.send_bytes(pack_frame(1, pickle.dumps("second")))
+        a.send_bytes(pack_frame(0, pickle.dumps("first")))
+        with pytest.raises(TransportProtocolError, match="out-of-order"):
+            rx.recv(1.0)
+    finally:
+        a.close()
+        rx.close()
+
+
+def test_block_payload_codec_verifies_transfer_crc():
+    """Paged-block handoff payloads reuse ``paging.transfer_crc`` as
+    the wire integrity stamp: a clean payload roundtrips bit-exact, a
+    corrupted buffer is loud on the DECODE side of the wire."""
+    rng = np.random.default_rng(0)
+    payload = {
+        "k": [rng.standard_normal((2, 4, 8)).astype(np.float32)
+              for _ in range(3)],
+        "v": [rng.standard_normal((2, 4, 8)).astype(np.float32)
+              for _ in range(3)],
+    }
+    wire = encode_block_payload(payload)
+    back = decode_block_payload(pickle.loads(pickle.dumps(wire)))
+    assert sorted(back) == ["k", "v"]
+    for key in payload:
+        for got, want in zip(back[key], payload[key]):
+            assert np.array_equal(got, want)
+
+    corrupt = dict(wire)
+    buf = bytearray(corrupt["data"][0])
+    buf[5] ^= 0x01
+    corrupt["data"] = [bytes(buf)] + list(corrupt["data"][1:])
+    with pytest.raises(TransportCorruptFrame, match="transfer_crc"):
+        decode_block_payload(corrupt)
+
+
+# ------------------------------------------------- multi-proc fleet gates
+
+
+def test_fleet_worker_hung_classification():
+    """The bounded-join bugfix's loud failure mode carries WHICH
+    workers hung and the budget they blew."""
+    exc = FleetWorkerHung(["decode-1", "prefill-0"], 12.5)
+    assert exc.workers == ["decode-1", "prefill-0"]
+    assert exc.timeout_s == 12.5
+    assert "decode-1" in str(exc) and "12.5" in str(exc)
+    with pytest.raises(ValueError, match="join_timeout_s"):
+        cfg, params, prompts, max_len = _zipf_setup()
+        make_fleet(params, cfg, max_len=max_len, replicas=2,
+                   join_timeout_s=0.0)
+
+
+def test_fleet_multiproc_v1_refusals_are_loud():
+    """The multi-proc v1 scope boundary is explicit ValueErrors, not
+    silent degradation: no disaggregate, no autoscale, no sampler, no
+    per-call rng, and unknown transport names are refused."""
+    cfg, params, prompts, max_len = _zipf_setup()
+    with pytest.raises(ValueError, match="transport"):
+        make_fleet(params, cfg, max_len=max_len, replicas=2,
+                   transport="carrier-pigeon")
+    with pytest.raises(ValueError, match="disaggregate"):
+        make_fleet(params, cfg, max_len=max_len, replicas=2,
+                   transport="multiproc", disaggregate=True)
+    with pytest.raises(ValueError, match="autoscale|elastic"):
+        from nvidia_terraform_modules_tpu.models import AutoscalePolicy
+
+        make_fleet(params, cfg, max_len=max_len, replicas=2,
+                   transport="multiproc",
+                   autoscale=AutoscalePolicy(min_replicas=1,
+                                             max_replicas=3))
+    with pytest.raises(ValueError, match="sampler|greedy"):
+        make_fleet(params, cfg, max_len=max_len, replicas=2,
+                   transport="multiproc",
+                   sampler=dict(top_k=2, temperature=0.5))
+    fleet = make_fleet(params, cfg, max_len=max_len, replicas=2,
+                       transport="multiproc")
+    with pytest.raises(ValueError, match="greedy-only|rng"):
+        fleet(prompts, 5, slots=4, rng=jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="reply_timeout_s"):
+        MultiProcTransport(reply_timeout_s=0.0)
+    with pytest.raises(ValueError, match="spawn_timeout_s"):
+        MultiProcTransport(spawn_timeout_s=-1.0)
+
+
+def test_fleet_multiproc_bit_matches_inproc_and_solo_tier1():
+    """THE transport acceptance gate: the multi-proc fleet — replicas
+    as real spawned subprocesses, every admission poll a framed RPC —
+    serves the seeded shared-prefix trace with tokens bit-equal to the
+    in-proc fleet AND solo greedy. A second call on the same fleet
+    reuses the warm children (no respawn, no recompile) and matches
+    again."""
+    cfg, params, prompts, max_len = _zipf_setup()
+    want = _solo(params, prompts, 5, cfg)
+
+    fl_in = make_fleet(params, cfg, max_len=max_len, replicas=2,
+                       kv_block=4, share_prefix=True)
+    _assert_all_equal(fl_in(prompts, 5, slots=4), want, "inproc:")
+
+    fl_mp = make_fleet(params, cfg, max_len=max_len, replicas=2,
+                       kv_block=4, share_prefix=True,
+                       transport="multiproc", join_timeout_s=120.0)
+    tr = fl_mp.transport
+    try:
+        _assert_all_equal(fl_mp(prompts, 5, slots=4), want, "multiproc:")
+        st = fl_mp.last_stats["fleet"]
+        assert st["served"] == len(prompts) and st["shed"] == 0
+        pids = {i: child[0].pid for i, child in tr._children.items()}
+        assert sorted(pids) == [0, 1]      # two real replica processes
+
+        _assert_all_equal(fl_mp(prompts, 5, slots=4), want, "warm:")
+        warm_pids = {i: child[0].pid for i, child in tr._children.items()}
+        assert warm_pids == pids           # children persisted, warm
+    finally:
+        fl_mp.close()
+    assert tr._children == {}              # close() reaped every child
+
+
+def test_fleet_multiproc_real_sigkill_redrives_bit_exact_tier1():
+    """The kill-for-real chaos gate: a seeded ``kill_replica`` fault on
+    the multi-proc fleet delivers an actual SIGKILL to the replica
+    process at the admission-poll boundary. The victim's requests
+    redrive to the survivor exactly once — outputs bit-match the
+    undisturbed solo baseline, served == submitted (none stranded), and
+    the fleet's duplicate check makes double-serving a hard error. The
+    next call respawns the dead child."""
+    cfg, params, prompts, max_len = _zipf_setup()
+    want = _solo(params, prompts, 6, cfg)
+
+    tr = MultiProcTransport()
+    profile = FleetFaultProfile(
+        [FleetFault("kill_replica", target=0, at_s=0.05)], seed=0)
+    fleet = make_fleet(params, cfg, max_len=max_len, replicas=2,
+                       kv_block=4, share_prefix=True, steal=False,
+                       faults=profile, transport=tr,
+                       join_timeout_s=120.0)
+    try:
+        out = fleet(prompts, 6, slots=2)
+        st = fleet.last_stats["fleet"]
+        fr = st["faults"]
+        assert st["served"] == len(prompts) and st["shed"] == 0
+        assert fr["replica_down"] == 1
+        assert fr["killed"] == ["replica-0"]
+        assert fr["redriven"] >= 1
+        _assert_all_equal(out, want, "after SIGKILL:")
+
+        # the kill was REAL: replica-0's process is gone (reaped by the
+        # transport), only the survivor's child remains
+        assert sorted(tr._children) == [1]
+        survivor_pid = tr._children[1][0].pid
+
+        # replay: the next call RESPAWNS replica-0 (a new process),
+        # the armed profile kills it again at the same seeded step, and
+        # the outputs replay bit-exact — deterministic chaos through
+        # real process death; the survivor's child stays warm
+        _assert_all_equal(fleet(prompts, 6, slots=2), want, "respawn:")
+        st2 = fleet.last_stats["fleet"]
+        assert st2["served"] == len(prompts)
+        assert st2["faults"]["killed"] == ["replica-0"]
+        assert sorted(tr._children) == [1]
+        assert tr._children[1][0].pid == survivor_pid
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow
+def test_fleet_multiproc_seed_by_killstep_matrix_slow():
+    """Full chaos matrix: every (profile seed × kill step) cell serves
+    the whole trace bit-exact through a real SIGKILL. One shared
+    transport amortises child spawns across cells — each cell after the
+    first reuses the survivor and respawns only the victim. Kill steps
+    are strictly positive so the victim owns planned requests (an
+    ``at_s=0.0`` kill is routed around from t=0 — the victim may then
+    drain an empty queue and exit before its first pulse-ing poll,
+    making the kill a legitimate no-op)."""
+    cfg, params, prompts, max_len = _zipf_setup()
+    want = _solo(params, prompts, 6, cfg)
+    tr = MultiProcTransport()
+    try:
+        for seed in (0, 1):
+            for at_s in (0.02, 0.05, 0.15):
+                profile = FleetFaultProfile(
+                    [FleetFault("kill_replica", target=0, at_s=at_s)],
+                    seed=seed)
+                fleet = make_fleet(params, cfg, max_len=max_len,
+                                   replicas=2, kv_block=4,
+                                   share_prefix=True, steal=False,
+                                   faults=profile, transport=tr,
+                                   join_timeout_s=120.0)
+                out = fleet(prompts, 6, slots=2)
+                st = fleet.last_stats["fleet"]
+                label = f"seed={seed} at_s={at_s}:"
+                assert st["served"] == len(prompts), label
+                assert st["shed"] == 0, label
+                assert st["faults"]["killed"] == ["replica-0"], label
+                _assert_all_equal(out, want, label)
+    finally:
+        tr.close()
