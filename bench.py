@@ -1547,13 +1547,26 @@ def section_serve_fleet_transport() -> dict:
       ``SIGKILL`` (``utils/traffic.fault_times`` picks the instant),
       next to ``serve_fleet_proc_undisturbed_p99`` on the identical
       trace — the PR 13 redrive tail price, now with a process
-      actually dying (pipe EOF detection + respawn included).
+      actually dying (pipe EOF detection + respawn included);
+    - ``serve_fleet_proc_autoscale_warm_vs_cold`` (ISSUE 18): the
+      joiners' prefix hit fraction when a scale-up's warm bring-up
+      chains ship as crc-stamped frames over the pipe vs the same
+      join cold (``warm_join=False``) — host-side block accounting on
+      a deterministic schedule, so the gain is determinism-keyed;
+    - ``serve_fleet_proc_churn_redrive_p99``: the elastic process
+      fleet's tail under a seeded mid-trace ``SIGKILL`` vs the
+      undisturbed elastic fleet on the identical trace — scale-ups,
+      warm joins and the redrive all crossing real pipes.
 
     The replica children persist across fleet constructions (the
-    transport keys them on params/config), so the three multi-proc
-    legs share one spawn+compile. On TPU the children pin to the host
-    CPU backend (libtpu admits one client per chip) and the bit-match
-    leg is skipped — different backend numerics."""
+    transport keys them on params/config), so the fixed multi-proc
+    legs share one spawn+compile and the elastic legs (host-spill
+    engine config — a different child build) share another. On TPU
+    the children pin to the host CPU backend (libtpu admits one
+    client per chip) and the bit-match leg is skipped — different
+    backend numerics; the hit-fraction legs stay deterministic there
+    (``cpu_fallback_expectations``: block accounting does not depend
+    on the backend)."""
     import jax
     import jax.numpy as jnp
 
@@ -1676,8 +1689,87 @@ def section_serve_fleet_transport() -> dict:
                           arrivals=arrivals))
         kill_lat = kill_fleet.last_stats["fleet"]["latency_ms"]
         kill_faults = kill_fleet.last_stats["fleet"]["faults"]
+
+        # ---- elastic legs over PROCESSES (ISSUE 18): their own
+        # transport — the host-spill engine config differs from the
+        # fixed fleets' children above, so these legs share their own
+        # spawn+compile instead of churning the existing children
+        from nvidia_terraform_modules_tpu.models.fleet import (
+            AutoscalePolicy,
+        )
+
+        keep = 3 * 4                    # templates × blocks retained
+        as_kw = dict(max_len=max_len, replicas=1, kv_block=kv_block,
+                     share_prefix=True, host_spill=True,
+                     host_blocks=4 * keep, prefix_keep_blocks=keep,
+                     est_token_s=0.01)
+
+        def _as_pol():
+            return AutoscalePolicy(
+                min_replicas=1, max_replicas=replicas + 1,
+                up_backlog=2.0, down_backlog=0.25, cooldown_s=0.0,
+                seed=seed)
+
+        def _joiner_hit_frac(fl):
+            sc = fl.last_stats["fleet"]["scale"]
+            hb = pb = 0
+            for i, rs in enumerate(fl.last_stats["replica_stats"]):
+                if rs is None or i < sc["initial"]:
+                    continue
+                hb += rs["prefix"]["hit_blocks"]
+                pb += rs["prefix"]["prompt_blocks"]
+            return round(hb / max(pb, 1), 4)
+
+        tr2 = MultiProcTransport()
+
+        # warm vs cold join over the wire: run the trace twice per
+        # mode — the first run populates the fleet's WarmChainStore at
+        # close (publish_chains RPCs from the children), the second
+        # run's joiner inherits its keyspace share as crc-stamped
+        # chain frames (warm) or cold-starts (warm_join=False). Hit
+        # fractions are host-side block accounting on a deterministic
+        # schedule — determinism-keyed, unlike the wall clocks
+        warm_cold: dict[str, float] = {}
+        as_ledger: dict[str, dict] = {}
+        for mode, wj in (("warm", True), ("cold", False)):
+            fl = make_fleet(params, cfg, steal=False, warm_join=wj,
+                            autoscale=_as_pol(), transport=tr2,
+                            telemetry=reg, **as_kw)
+            synced(fl(prompts, budgets, slots=slots))    # populate
+            outs = fl(prompts, budgets, slots=slots)     # inherit
+            synced(outs)
+            warm_cold[mode] = _joiner_hit_frac(fl)
+            as_ledger[mode] = fl.last_stats["fleet"]["scale"]
+
+        # churn redrive tail: the elastic process fleet under a
+        # seeded mid-trace SIGKILL vs the undisturbed elastic fleet
+        # on the IDENTICAL trace — scale-ups, warm joins and the
+        # kill's redrive all crossing real pipes
+        churn_arrivals = poisson_trace(rate, n_req, seed + 4)
+        churn_kill_at = max(
+            fault_times(churn_arrivals, 1, seed + 5)[0], 0.05)
+        und2 = make_fleet(params, cfg, steal=True,
+                          autoscale=_as_pol(), transport=tr2,
+                          telemetry=reg, **as_kw)
+        synced(und2(prompts, budgets, slots=slots,
+                    arrivals=churn_arrivals))
+        churn_und_lat = und2.last_stats["fleet"]["latency_ms"]
+        churn_fleet = make_fleet(
+            params, cfg, steal=True, autoscale=_as_pol(),
+            transport=tr2, telemetry=reg,
+            faults=FleetFaultProfile(
+                [FleetFault("kill_replica", target=None,
+                            at_s=churn_kill_at)],
+                seed=seed),
+            **as_kw)
+        synced(churn_fleet(prompts, budgets, slots=slots,
+                           arrivals=churn_arrivals))
+        churn_lat = churn_fleet.last_stats["fleet"]["latency_ms"]
+        churn_faults = churn_fleet.last_stats["fleet"]["faults"]
     finally:
         tr.close()
+        if "tr2" in locals():
+            tr2.close()
         if on:
             if prev_plat is None:
                 os.environ.pop("JAX_PLATFORMS", None)
@@ -1712,6 +1804,27 @@ def section_serve_fleet_transport() -> dict:
             kill_lat["p99"] / max(und_lat["p99"], 1e-9), 3),
         "serve_fleet_proc_replica_down": kill_faults["replica_down"],
         "serve_fleet_proc_redriven": kill_faults["redriven"],
+        # elastic-over-processes legs: hit fractions and the scale
+        # ledger are deterministic schedules, the p99s are wall clocks
+        "serve_fleet_proc_autoscale_warm_hit_frac": warm_cold["warm"],
+        "serve_fleet_proc_autoscale_cold_hit_frac": warm_cold["cold"],
+        "serve_fleet_proc_autoscale_warm_vs_cold": round(
+            warm_cold["warm"] / max(warm_cold["cold"], 1e-9), 3),
+        "serve_fleet_proc_autoscale_ups":
+            as_ledger["warm"]["ups_executed"],
+        "serve_fleet_proc_autoscale_warm_joins":
+            as_ledger["warm"]["warm_joins"],
+        "serve_fleet_proc_churn_trace": {
+            "kind": "poisson", "seed": seed + 4,
+            "rate": rate, **trace_summary(churn_arrivals)},
+        "serve_fleet_proc_churn_kill_at_s": round(churn_kill_at, 4),
+        "serve_fleet_proc_churn_redrive_p99": churn_lat["p99"],
+        "serve_fleet_proc_churn_undisturbed_p99":
+            churn_und_lat["p99"],
+        "serve_fleet_proc_churn_redrive_p99_vs_undisturbed": round(
+            churn_lat["p99"] / max(churn_und_lat["p99"], 1e-9), 3),
+        "serve_fleet_proc_churn_replica_down":
+            churn_faults["replica_down"],
     }
 
 

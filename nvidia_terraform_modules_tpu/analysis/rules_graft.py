@@ -696,3 +696,88 @@ def check_unbounded_recv(ctx: PyContext):
                            f"forever; pass a timeout (or poll the "
                            f"connection first) and raise the "
                            f"classified transport error on expiry")
+
+
+# ---------------------------------------------------- spawn retry/classify
+
+# process-spawning constructors: a child whose bring-up can fail
+# TRANSIENTLY (fork/exec pressure, an interpreter that dies before the
+# handshake) and must therefore never be a bare call in the serving
+# runtime
+_SPAWN_CALLS = {"Process", "Popen"}
+# the classified-bring-up idiom: the spawn — or an ENCLOSING function;
+# transport's ``_spawn`` wraps the nested ``bring_up`` closure — runs
+# under ``utils/retry.retry_call``, whose policy bounds the attempts
+# and whose exhaustion raises the classified terminal error the fleet
+# converts to a DEAD target that redrives
+_SPAWN_GUARDS = {"retry_call"}
+
+
+def _function_chains(tree):
+    """Every function def paired with its enclosing-function chain
+    (outermost first, nested defs included) — the scope lineage a
+    guard search walks, so a closure handed to a retry wrapper one
+    level up still counts as guarded."""
+    out: list[tuple[ast.AST, list]] = []
+
+    def visit(node, chain):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append((child, chain))
+                visit(child, chain + [child])
+            else:
+                visit(child, chain)
+
+    visit(tree, [])
+    return out
+
+
+def _calls_guard(scope) -> bool:
+    for n in walk_scope(scope):
+        if isinstance(n, ast.Call):
+            callee = n.func
+            if isinstance(callee, ast.Name) and \
+                    callee.id in _SPAWN_GUARDS:
+                return True
+            if isinstance(callee, ast.Attribute) and \
+                    callee.attr in _SPAWN_GUARDS:
+                return True
+    return False
+
+
+@rule("graft-spawn-no-retry-classify", severity="error",
+      family="liveness",
+      summary="serving-runtime process spawns must retry then classify")
+def check_spawn_no_retry_classify(ctx: PyContext):
+    """A ``Process``/``Popen`` spawn in the serving runtime without a
+    classified retry path is a latent hang-or-crash: a transient
+    bring-up failure (fork pressure, a child that dies before its
+    handshake) either wedges the caller or escapes as an unclassified
+    exception, instead of retrying under a bounded policy and — on
+    exhaustion — raising the terminal classification the fleet turns
+    into a DEAD target whose requests redrive. Flags spawn-shaped
+    calls in the serving-runtime files whose enclosing function chain
+    never calls ``retry_call`` (the guard search walks ENCLOSING
+    functions: a nested ``bring_up`` closure handed to ``retry_call``
+    one level up is the blessed idiom)."""
+    for fname, tree in ctx.trees():
+        if not any(frag in fname for frag in _RECV_SCOPE):
+            continue
+        for fn, chain in _function_chains(tree):
+            if any(_calls_guard(s) for s in (*chain, fn)):
+                continue
+            for n in walk_scope(fn):
+                if not isinstance(n, ast.Call):
+                    continue
+                callee = n.func
+                name = callee.attr if isinstance(callee, ast.Attribute) \
+                    else callee.id if isinstance(callee, ast.Name) \
+                    else None
+                if name in _SPAWN_CALLS:
+                    yield (f"{fname}:{n.lineno}",
+                           f"bare {name}() spawn in the serving "
+                           f"runtime — a transient bring-up failure "
+                           f"crashes or wedges the caller; wrap the "
+                           f"spawn in utils/retry.retry_call with a "
+                           f"bounded policy and classify exhaustion "
+                           f"as the terminal (DEAD, redrive) error")

@@ -150,7 +150,9 @@ plane's ``fleet_replica_down``/``fleet_redrive_total``/
 covering every interval the fleet ran below nominal capacity; the
 elastic plane adds a ``fleet_size`` gauge,
 ``fleet_scale_up_total``/``fleet_scale_down_total`` counters and one
-``fleet_scale`` span per executed event (trigger + replica + warm).
+``fleet_scale`` span per executed event (trigger + replica + warm +
+transport, so a capture distinguishes thread joins from real process
+spawns).
 
 Reference analogue: none — the reference provisions the node pools a
 fleet like this runs on (SURVEY §2.6); this is the router those
@@ -944,8 +946,12 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
     ``Transport`` INSTANCE may be passed and shared across
     ``make_fleet`` calls — an unchanged configuration keeps warm
     engines/child processes, amortising spawns and compiles.
-    Multi-proc v1 refuses ``disaggregate``, ``autoscale`` and
-    per-call ``rng`` (greedy only). ``join_timeout_s`` bounds every
+    Multi-proc composes with everything in-proc does — autoscale
+    (warm joins ship crc-stamped chain frames over the pipes),
+    disaggregate (the handoff rides the ``kv_import`` RPC), samplers
+    (as spec dicts — a raw callable does not pickle) and per-call
+    ``rng`` (key data rides the RUN frame) — and bit-matches the
+    thread fleet on seeded traces. ``join_timeout_s`` bounds every
     worker join at the end of a call — a wedged worker raises
     :class:`FleetWorkerHung` (process workers SIGKILLed) instead of
     hanging the caller.
@@ -1020,18 +1026,6 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
         raise ValueError(
             f"transport must be 'inproc', 'multiproc' or a "
             f"Transport instance, got {type(transport)}")
-    if tr.process_isolated:
-        if disaggregate:
-            raise ValueError(
-                "the multiproc transport does not compose with "
-                "disaggregate in v1 — the prefill→decode handoff "
-                "stays in-proc (see models/transport.py)")
-        if autoscale is not None:
-            raise ValueError(
-                "the multiproc transport does not compose with "
-                "autoscale in v1 — warm bring-up migrates host-tier "
-                "KV through shared memory, which does not cross a "
-                "process boundary (see models/transport.py)")
     if disaggregate:
         if replicas < 2:
             raise ValueError(
@@ -1363,12 +1357,6 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                     "SLO shedding needs est_token_s (predicted "
                     "service per budgeted token) — calibrate it from "
                     "a measured run of this config")
-        if tr.process_isolated and rng is not None:
-            raise ValueError(
-                "the multiproc transport is greedy-only in v1 — a "
-                "device PRNG key does not cross a process boundary; "
-                "pass rng=None or use the in-proc transport")
-
         # elastic fleets resolve faults per call (explicit targets may
         # name joiners the plan realises below); fixed fleets reuse the
         # build-time resolution byte for byte
@@ -1682,7 +1670,8 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                 reg.emit_span("fleet_scale",
                               clk0 if clk0 is not None else tc, tc,
                               kind="up", replica=q.label,
-                              trigger=trigger, warm=bool(chains))
+                              trigger=trigger, warm=bool(chains),
+                              transport=tr.name)
             _set_size()
 
         def _mark_degraded():
@@ -1882,7 +1871,8 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                             tc = reg.clock()
                             reg.emit_span("fleet_scale", tc, tc,
                                           kind="down", replica=q.label,
-                                          trigger="low_load")
+                                          trigger="low_load",
+                                          transport=tr.name)
                     else:
                         drained_labels.append(q.label)
 

@@ -664,8 +664,11 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
     partial tail block (``prefix_len % kv_block`` rows). Results equal
     decoding ``concat(prefix, prompt)`` from scratch.
 
-    ``sampler`` (from :func:`..decode.make_sampler`) switches the
-    engine from greedy to sampled generation; ``run`` then requires
+    ``sampler`` (from :func:`..decode.make_sampler`, or the equivalent
+    SPEC dict of its kwargs — ``dict(temperature=0.7, top_k=40)`` —
+    normalised through ``make_sampler`` here, the picklable form a
+    process-isolated fleet transport ships to its children) switches
+    the engine from greedy to sampled generation; ``run`` then requires
     ``rng``. Every token's key is derived from (request index, token
     position) — NEVER from the schedule — so the same ``rng`` yields
     the same tokens whatever the slot count, arrival pattern or
@@ -785,6 +788,15 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
     retirement precedes the scan that would have seen an earlier eos
     (``run.last_stats["generated"]`` reports emitted tokens exactly).
     """
+    if isinstance(sampler, dict):
+        # a sampler SPEC (dict(temperature=, top_k=, top_p=)) instead
+        # of a callable: normalise through make_sampler here so the
+        # spec form builds the identical pick function on every side
+        # of a process boundary (a callable does not pickle — the
+        # multi-proc transport ships specs and each child lands here)
+        from .decode import make_sampler
+
+        sampler = make_sampler(**sampler)
     if prefill_chunk is not None and prefill_chunk < 1:
         raise ValueError(
             f"prefill_chunk must be >= 1, got {prefill_chunk}")

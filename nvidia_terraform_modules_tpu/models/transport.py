@@ -59,27 +59,64 @@ integrity primitive: :func:`encode_block_payload` /
 so a corrupt frame is loud on the decode side of the wire exactly like
 the in-proc disaggregated handoff.
 
-v1 scope (CPU; ROADMAP item 2's v5e ICI/DCN impl is a third
-``Transport`` on this seam): the multi-proc fleet refuses
-``disaggregate`` (the prefill→decode handoff stays in-proc),
-``autoscale`` (warm bring-up migrates host-tier state through shared
-memory), and per-call ``rng``/``sampler`` (greedy decode only — a
-device PRNG key does not cross a process boundary); ``host_spill`` is
-engine-internal and composes fine. Telemetry:
+Full compose scope (CPU; ROADMAP item 2's v5e ICI/DCN impl is a third
+``Transport`` on this seam): the multi-proc fleet accepts everything
+the in-proc fleet accepts —
+
+- ``autoscale``: a scale-up spawns a REAL child under
+  ``_SPAWN_PROC_RETRY`` (all-attempts spawn failure ⇒ the target is
+  classified dead and its planned requests redrive — never a hang),
+  and a warm join ships the joiner's keyspace share of the
+  fleet-shared ``WarmChainStore`` as crc-stamped chain frames over
+  the duplex pipe (:func:`encode_block_payload` per chain; a chain
+  that fails its ``transfer_crc`` on the child side is dropped and
+  billed in the engine's ``warm.seed_dropped`` — suspect bytes are
+  never imported), seeding the child's ``PrefixIndex.seed_host`` so
+  the ``warm`` stats bit-match the thread fleet. A scale-down drains
+  through the ordinary ``draining()`` RPC and the child publishes its
+  retained chains home (``publish_chains`` frames, crc-stamped the
+  same way) before its DONE frame.
+- ``disaggregate``: prefill workers stay PARENT-side (the handoff
+  payload is the cross-boundary object, not the worker — see
+  :meth:`Transport.prefill_engine`); the prefill→decode handoff rides
+  the existing ``kv_import`` RPC, crc-stamped at the parent and
+  re-verified in the child, with the ``HandoffCorruptError`` retry
+  discipline unchanged router-side.
+- ``sampler``/``rng``: a sampler crosses the boundary as a SPEC dict
+  (``dict(temperature=, top_k=, top_p=)`` — ``make_serve_engine``
+  normalises it through ``decode.make_sampler`` on both sides, so
+  in-proc and multi-proc build the identical pick function; a raw
+  callable is still refused, it does not pickle); the per-call PRNG
+  key ships as its host key data in the RUN frame and the child
+  rebuilds it — (request, position)-keyed sampling is
+  schedule-invariant by construction, so sampled tokens bit-match
+  the thread fleet and solo decode.
+
+A crashed parent never strands a child: every child runs a
+parent-pid watchdog (:func:`start_parent_watchdog`) that exits
+``EXIT_PEER_DEAD`` the moment it is reparented, and the transport
+registers an ``atexit`` close as the parent-side backstop — the
+orphan-reaper discipline for a parent that dies between spawn and
+registry insert. Telemetry:
 ``transport_bytes_total``/``transport_frames_total`` count every frame
 through the parent side of each pipe, ``transport_rtt_ms`` records
-the replica-measured poll round-trip and ``transport_retries_total``
-the classified reply retries (see :class:`TransportMetrics`).
+the replica-measured poll round-trip, ``transport_retries_total``
+the classified reply retries, ``transport_child_respawn_total`` each
+replacement of a dead child, and ``warm_chains_bytes_total`` the
+warm-chain bytes shipped over pipes in either direction (see
+:class:`TransportMetrics`).
 """
 
 from __future__ import annotations
 
+import atexit
 import os
 import pickle
 import signal
 import struct
 import threading
 import time
+import weakref
 import zlib
 from typing import Any, Callable
 
@@ -202,8 +239,12 @@ class TransportMetrics:
     """The transport's instruments on the fleet's shared registry:
     ``transport_bytes_total``/``transport_frames_total`` (every frame
     through the parent side of a channel, both directions),
-    ``transport_rtt_ms`` (replica-measured poll round-trips, sampled)
-    and ``transport_retries_total`` (classified reply retries). A
+    ``transport_rtt_ms`` (replica-measured poll round-trips, sampled),
+    ``transport_retries_total`` (classified reply retries),
+    ``transport_child_respawn_total`` (a dead child replaced by a
+    fresh spawn — the post-SIGKILL/crash recovery rate) and
+    ``warm_chains_bytes_total`` (warm-chain payload bytes shipped over
+    pipes, both the join seeding and the drain publish direction). A
     disabled registry costs nothing (no-op instruments)."""
 
     def __init__(self, registry=None):
@@ -213,6 +254,10 @@ class TransportMetrics:
             self._frames = registry.counter("transport_frames_total")
             self._retries = registry.counter("transport_retries_total")
             self._rtt = registry.histogram("transport_rtt_ms")
+            self._respawn = registry.counter(
+                "transport_child_respawn_total")
+            self._warm_bytes = registry.counter(
+                "warm_chains_bytes_total")
 
     def frame(self, nbytes: int) -> None:
         if self.enabled:
@@ -227,6 +272,14 @@ class TransportMetrics:
         if self.enabled:
             for s in samples:
                 self._rtt.record(float(s))
+
+    def respawn(self) -> None:
+        if self.enabled:
+            self._respawn.inc()
+
+    def warm_bytes(self, nbytes: int) -> None:
+        if self.enabled and nbytes:
+            self._warm_bytes.inc(nbytes)
 
 
 class FrameChannel:
@@ -339,6 +392,103 @@ def decode_block_payload(wire: dict) -> dict:
             f"side of the wire: got {got:#010x}, stamped "
             f"{wire['crc']:#010x}")
     return payload
+
+
+# ------------------------------------------- rng + warm-chain wire codecs
+
+
+def encode_rng(rng):
+    """A per-call PRNG key for the RUN frame: ships as its HOST key
+    data (a typed ``jax.random.key`` unwraps through ``key_data``, a
+    raw ``PRNGKey`` uint32 vector ships as-is) so the child rebuilds
+    an identical key — (request, position)-keyed sampling is
+    schedule-invariant, so the rebuilt key reproduces the thread
+    fleet's tokens bit for bit."""
+    if rng is None:
+        return None
+    import jax
+    import jax.numpy as jnp
+
+    arr = jnp.asarray(rng)
+    if jnp.issubdtype(arr.dtype, jax.dtypes.prng_key):
+        return {"kind": "typed",
+                "data": np.asarray(jax.random.key_data(arr))}
+    return {"kind": "raw", "data": np.asarray(arr)}
+
+
+def decode_rng(wire):
+    """Rebuild the per-call PRNG key from its RUN-frame encoding."""
+    if wire is None:
+        return None
+    import jax
+    import jax.numpy as jnp
+
+    if wire["kind"] == "typed":
+        return jax.random.wrap_key_data(jnp.asarray(wire["data"]))
+    return jnp.asarray(wire["data"])
+
+
+def encode_warm_chains(chains) -> list:
+    """Warm ``(chunks, payload)`` chains for the wire: each payload is
+    individually crc-stamped by :func:`encode_block_payload`, so the
+    receiving side verifies (and can drop) chains ONE AT A TIME — one
+    corrupt chain costs that chain, never the whole warm join."""
+    return [
+        (tuple(tuple(int(t) for t in c) for c in chunks),
+         encode_block_payload(payload))
+        for chunks, payload in chains]
+
+
+def decode_warm_chains(wire_chains) -> tuple[list, int]:
+    """Rebuild warm chains, verifying each payload's ``transfer_crc``;
+    a chain that fails is DROPPED and counted (suspect bytes are never
+    imported into a prefix index — the taker bills the drop in its
+    warm stats). Returns ``(chains, dropped)``."""
+    chains: list = []
+    dropped = 0
+    for chunks, enc in wire_chains:
+        try:
+            chains.append((chunks, decode_block_payload(enc)))
+        except TransportCorruptFrame:
+            dropped += 1
+    return chains, dropped
+
+
+def warm_chains_nbytes(wire_chains) -> int:
+    """Payload bytes in an encoded warm-chain batch (the
+    ``warm_chains_bytes_total`` unit — KV rows, not pickle framing)."""
+    return sum(len(d) for _, enc in wire_chains for d in enc["data"])
+
+
+# ------------------------------------------------- child-side orphan reaper
+
+
+def start_parent_watchdog(parent_pid: int, *, poll_s: float = 1.0,
+                          getppid=os.getppid,
+                          on_orphan: Callable[[], None] | None = None):
+    """The child-side half of the orphan-reaper contract: a daemon
+    thread that polls ``getppid()`` and fires ``on_orphan`` (default:
+    ``os._exit(EXIT_PEER_DEAD)``) the moment the child is reparented —
+    i.e. the parent died, even BETWEEN spawn and the transport's
+    registry insert, where no parent-side ``close()``/atexit hook can
+    know the child exists. Returns ``(thread, stop_event)``;
+    ``getppid``/``on_orphan`` are injectable so the regression test
+    can simulate a parent crash without killing the test runner."""
+    if on_orphan is None:
+        def on_orphan() -> None:
+            os._exit(EXIT_PEER_DEAD)
+    stop = threading.Event()
+
+    def watch() -> None:
+        while not stop.wait(poll_s):
+            if getppid() != parent_pid:
+                on_orphan()
+                return
+
+    thread = threading.Thread(target=watch, daemon=True,
+                              name="transport-parent-watchdog")
+    thread.start()
+    return thread, stop
 
 
 # --------------------------------------------------------- the interface
@@ -549,6 +699,7 @@ class _RPCAdmission(AdmissionSource):
         self._reply_timeout_s = reply_timeout_s
         self.rtt_ms: list[float] = []
         self.retries = 0
+        self.warm_dropped = 0            # wire-corrupt warm chains
 
     def _call(self, method: str, *args):
         t0 = time.monotonic()
@@ -616,23 +767,57 @@ class _RPCAdmission(AdmissionSource):
         self._call("retired", int(req), int(tokens))
 
     def warm_chains(self):
-        # the elastic warm bring-up plane is in-proc only in v1 (host
-        # KV chains migrate through shared state, not frames) — a
-        # multi-proc replica always starts cold
-        return None
+        """The elastic warm-join plane over the wire: the joiner's
+        keyspace share of the fleet's ``WarmChainStore`` arrives as
+        per-chain crc-stamped payloads; a chain that fails its
+        ``transfer_crc`` here is dropped and counted (``warm_dropped``
+        folds into the engine's ``warm.seed_dropped`` in the DONE
+        frame) — suspect bytes never reach ``seed_host``."""
+        wire = self._call("warm_chains")
+        if not wire:
+            return None
+        chains, dropped = decode_warm_chains(wire)
+        self.warm_dropped += dropped
+        return chains or None
 
     def chain_sink(self):
-        return None
+        """A drain/close-time publish target when the fleet runs a
+        ``WarmChainStore``: the store itself stays ROUTER-side (it
+        holds locks and a host pool — it does not pickle); the replica
+        gets a proxy whose ``publish`` ships crc-stamped chains home
+        through the ``publish_chains`` RPC."""
+        return _ChainSinkProxy(self) if self._call("chain_sink") else None
+
+
+class _ChainSinkProxy:
+    """The replica-side face of the router's ``WarmChainStore``: quacks
+    like the sink ``publish_chains`` expects (``publish(chains) →
+    stored``), encoding each retained chain with its own
+    ``transfer_crc`` stamp so the router side verifies before storing
+    — a drain never launders corrupt rows into the fleet-shared warm
+    tier."""
+
+    def __init__(self, adm: "_RPCAdmission"):
+        self._adm = adm
+
+    def publish(self, chains) -> int:
+        return self._adm._call("publish_chains",
+                               encode_warm_chains(chains))
 
 
 def _replica_child_main(conn, index: int, params, cfg, max_len: int,
-                        engine_kw: dict, reply_timeout_s: float) -> None:
+                        engine_kw: dict, reply_timeout_s: float,
+                        parent_pid: int | None = None) -> None:
     """The replica process: build the engine once, then serve RUN
     frames until EXIT (children persist across fleet calls — compiles
     amortise exactly like in-proc engines). Every recv is bounded; a
     dead or desynchronised router stream exits ``EXIT_PEER_DEAD`` so
     ``resilience.classify_exit`` reads a classified death, never a
-    hang."""
+    hang. The parent-pid watchdog starts BEFORE the engine build — a
+    parent that crashes mid-spawn (before its registry insert) still
+    reaps this child."""
+    if parent_pid is not None:
+        start_parent_watchdog(parent_pid)
     chan = FrameChannel(conn, label=f"replica-{index}/child")
     engine = make_serve_engine(params, cfg, max_len=max_len,
                                **engine_kw)
@@ -656,7 +841,8 @@ def _replica_child_main(conn, index: int, params, cfg, max_len: int,
             try:
                 res = engine(run_kw["prompts"], run_kw["budgets"],
                              slots=run_kw["slots"],
-                             eos_id=run_kw["eos_id"], rng=None,
+                             eos_id=run_kw["eos_id"],
+                             rng=decode_rng(run_kw.get("rng")),
                              kv_blocks=run_kw["kv_blocks"],
                              admission=adm)
             except (TransportError, RetriesExhausted):
@@ -670,7 +856,19 @@ def _replica_child_main(conn, index: int, params, cfg, max_len: int,
                            adm.rtt_ms, adm.retries))
                 continue
             out = {int(r): np.asarray(v) for r, v in res.items()}
-            chan.send(("DONE", out, engine.last_stats,
+            stats = engine.last_stats
+            if adm.warm_dropped:
+                # wire-corrupt warm chains never reached seed_warm, so
+                # the engine could not bill them — fold the drops into
+                # the warm stats here (0 in clean runs: bit-match with
+                # the thread fleet holds)
+                prefix = dict(stats.get("prefix") or {})
+                warm = dict(prefix.get("warm") or {})
+                warm["seed_dropped"] = (warm.get("seed_dropped", 0)
+                                        + adm.warm_dropped)
+                prefix["warm"] = warm
+                stats = dict(stats, prefix=prefix)
+            chan.send(("DONE", out, stats,
                        adm.rtt_ms, adm.retries))
     except (TransportError, RetriesExhausted):
         # classified peer/stream death: the router is gone or the
@@ -735,7 +933,18 @@ class _ProcHandle(ReplicaHandle):
                     if msg[0] == "REQ":
                         _, method, args = msg
                         try:
-                            value = getattr(self._queue, method)(*args)
+                            if method == "chain_sink":
+                                # the store itself stays router-side
+                                # (locks + host pool do not pickle):
+                                # the replica only learns whether a
+                                # sink exists and publishes over RPC
+                                value = (self._queue.chain_sink()
+                                         is not None)
+                            elif method == "publish_chains":
+                                value = self._publish(args[0])
+                            else:
+                                value = getattr(self._queue,
+                                                method)(*args)
                         except ReplicaKilled:
                             # the fault plane fired at this poll
                             # boundary: make it REAL — SIGKILL the
@@ -755,6 +964,10 @@ class _ProcHandle(ReplicaHandle):
                                 first=np.asarray(value["first"]),
                                 blocks=encode_block_payload(
                                     value["blocks"]))
+                        elif method == "warm_chains" and value:
+                            value = encode_warm_chains(value)
+                            self._transport.metrics.warm_bytes(
+                                warm_chains_nbytes(value))
                         self._chan.send(("REP", ("OK", value)))
                     elif msg[0] == "DONE":
                         _, out, stats, rtt_ms, retries = msg
@@ -787,6 +1000,19 @@ class _ProcHandle(ReplicaHandle):
         finally:
             self._done.set()
 
+    def _publish(self, wire_chains) -> int:
+        """The drain-side landing of ``publish_chains``: verify each
+        chain's ``transfer_crc`` before it touches the fleet-shared
+        store (a corrupt chain is dropped here, never stored), then
+        hand the survivors to the real sink."""
+        sink = self._queue.chain_sink()
+        if sink is None:
+            return 0
+        self._transport.metrics.warm_bytes(
+            warm_chains_nbytes(wire_chains))
+        chains, _dropped = decode_warm_chains(wire_chains)
+        return sink.publish(chains)
+
     def _sigkill(self) -> None:
         self._killed = True
         try:
@@ -817,14 +1043,28 @@ class _ProcHandle(ReplicaHandle):
         self._done.set()
 
 
+def _close_at_exit(ref) -> None:
+    """The atexit backstop behind a weakref: reap whatever children a
+    still-live transport knows about when the interpreter exits
+    without an explicit ``close()`` — without the weakref, the atexit
+    registry would pin every transport (and its children's pipes)
+    alive for the interpreter's whole lifetime."""
+    transport = ref()
+    if transport is not None:
+        transport.close()
+
+
 class MultiProcTransport(Transport):
     """Replicas as real, persistent subprocesses (spawn context — a
     forked JAX runtime deadlocks) connected by framed OS pipes. Every
     ``launch_decode`` reuses the replica's warm child when it is
     alive and respawns it when it is not (the call after a SIGKILL —
-    bring-up under ``utils/retry`` capped backoff). ``close()``
-    terminates the children; they are daemons, so an abandoned
-    transport cannot outlive the parent either."""
+    bring-up under ``utils/retry`` capped backoff, the respawn billed
+    on ``transport_child_respawn_total``). Orphan-reaper discipline is
+    two-sided: ``close()`` runs at interpreter exit through a weakref
+    atexit hook, and every child watches its parent pid and exits
+    ``EXIT_PEER_DEAD`` when reparented — a crashed parent strands no
+    child even if it died between spawn and registry insert."""
 
     name = "multiproc"
     process_isolated = True
@@ -844,43 +1084,70 @@ class MultiProcTransport(Transport):
         self._lock = threading.Lock()
         self._children: dict[int, tuple] = {}     # i -> (proc, chan)
         self._params_np = None
+        self._registry = None
+        self._atexit_registered = False
+        self.pre_engines: list = []
 
     def configure(self, *, params, cfg, max_len, engine_kw, registry,
                   n_dec, n_pre) -> None:
-        for k in ("sampler",):
-            if engine_kw.get(k) is not None:
-                raise ValueError(
-                    f"MultiProcTransport does not compose with {k} — "
-                    f"a sampler callable does not cross a process "
-                    f"boundary; multi-proc serving is greedy-only in "
-                    f"v1")
-        if n_pre:
+        sampler = engine_kw.get("sampler")
+        if sampler is not None and not isinstance(sampler, dict):
             raise ValueError(
-                "MultiProcTransport does not run disaggregated "
-                "prefill workers in v1 — the prefill→decode handoff "
-                "stays in-proc (see models/transport.py)")
+                "MultiProcTransport needs the sampler as a SPEC dict "
+                "(dict(temperature=..., top_k=..., top_p=...)) — a "
+                "raw sampler callable does not pickle across the "
+                "process boundary; make_serve_engine normalises the "
+                "spec through decode.make_sampler identically on both "
+                "sides")
         key = (id(params), cfg, max_len, tuple(sorted(
             (k, repr(v)) for k, v in engine_kw.items())))
         self.metrics = TransportMetrics(registry)
+        self._registry = registry
         if key == self._key:
-            return                       # keep warm children
+            # unchanged config: keep warm children (their compiles);
+            # just grow the parent-side prefill pool to the new shape
+            while len(self.pre_engines) < n_pre:
+                self.pre_engines.append(self._build_prefill())
+            return
         self.close()
         self._key = key
         self._params, self._cfg, self._max_len = params, cfg, max_len
         self._engine_kw = dict(engine_kw)
         self._params_np = None           # re-snapshot lazily
+        # disaggregated prefill workers stay PARENT-side in every
+        # current transport: the handoff payload (crc-stamped paged
+        # blocks riding the kv_import RPC) is the cross-boundary
+        # object, not the worker itself
+        self.pre_engines = [self._build_prefill() for _ in range(n_pre)]
+
+    def _build_prefill(self):
+        return make_serve_engine(self._params, self._cfg,
+                                 max_len=self._max_len,
+                                 telemetry=self._registry,
+                                 **self._engine_kw)
 
     def ensure_engine(self, i: int):
-        raise ValueError(
-            "MultiProcTransport does not autoscale in v1 — warm "
-            "bring-up migrates host-tier KV through shared memory, "
-            "which does not cross a process boundary; run elastic "
-            "fleets on InProcTransport")
+        """Bring up (or reuse) replica ``i``'s child ahead of a
+        scale-up launch: spawn + READY handshake under
+        ``_SPAWN_PROC_RETRY``. Exhaustion propagates — the fleet's
+        spawn discipline classifies the target dead and its planned
+        requests redrive; a scale-up NEVER hangs on a spawn that
+        cannot succeed."""
+        with self._lock:
+            child = self._children.get(i)
+        if child is not None:
+            if child[0].is_alive():
+                return child
+            # died since its last run (SIGKILL, crash): reap, respawn
+            self._discard_child(i)
+            self.metrics.respawn()
+        child = self._spawn(i)
+        with self._lock:
+            self._children[i] = child
+        return child
 
     def prefill_engine(self, i: int):
-        raise ValueError(
-            "MultiProcTransport has no in-process prefill engines "
-            "(disaggregate is refused at configure time)")
+        return self.pre_engines[i]
 
     def _snapshot_params(self):
         if self._params_np is None:
@@ -900,6 +1167,14 @@ class MultiProcTransport(Transport):
 
         ctx = mp.get_context("spawn")
         params_np = self._snapshot_params()
+        # the parent-side half of the orphan-reaper contract: close()
+        # at interpreter exit reaps every REGISTERED child; the
+        # child-side parent-pid watchdog (started before the engine
+        # build) covers the window between spawn and registry insert,
+        # where a parent crash would otherwise strand the child
+        if not self._atexit_registered:
+            self._atexit_registered = True
+            atexit.register(_close_at_exit, weakref.ref(self))
 
         def bring_up():
             parent_conn, child_conn = ctx.Pipe(duplex=True)
@@ -907,7 +1182,7 @@ class MultiProcTransport(Transport):
                 target=_replica_child_main,
                 args=(child_conn, i, params_np, self._cfg,
                       self._max_len, self._engine_kw,
-                      self.reply_timeout_s),
+                      self.reply_timeout_s, os.getpid()),
                 daemon=True, name=f"fleet-replica-{i}")
             proc.start()
             child_conn.close()
@@ -941,16 +1216,12 @@ class MultiProcTransport(Transport):
             proc.join(5.0)
 
     def launch_decode(self, i, queue, run_kw, *, on_error):
-        if run_kw.get("rng") is not None:
-            raise ValueError(
-                "MultiProcTransport is greedy-only in v1: a device "
-                "PRNG key does not cross a process boundary — pass "
-                "rng=None (or use InProcTransport)")
         with self._lock:
             child = self._children.get(i)
         if child is not None and not child[0].is_alive():
             # killed (or crashed) on a previous call: reap and respawn
             self._discard_child(i)
+            self.metrics.respawn()
             child = None
         if child is None:
             child = self._spawn(i)
@@ -962,6 +1233,7 @@ class MultiProcTransport(Transport):
             "budgets": [int(b) for b in run_kw["budgets"]],
             "slots": run_kw["slots"],
             "eos_id": run_kw["eos_id"],
+            "rng": encode_rng(run_kw.get("rng")),
             "kv_blocks": run_kw["kv_blocks"],
         }
         return _ProcHandle(self, i, proc, chan, queue, wire_kw,

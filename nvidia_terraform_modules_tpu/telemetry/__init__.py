@@ -70,7 +70,9 @@ Instrumented layers (all emit here when enabled):
                                       ``fleet_scale_down_total``
                                       counters, one ``fleet_scale`` span
                                       per executed scale event (args:
-                                      trigger, replica, warm)
+                                      trigger, replica, warm, transport
+                                      — a capture distinguishes thread
+                                      joins from real process spawns)
 ``models/transport``                  ``transport_bytes_total`` /
                                       ``transport_frames_total`` counters
                                       (every frame through the router
@@ -81,7 +83,15 @@ Instrumented layers (all emit here when enabled):
                                       round-trips),
                                       ``transport_retries_total``
                                       counter (classified transient
-                                      reply retries)
+                                      reply retries),
+                                      ``transport_child_respawn_total``
+                                      counter (dead children replaced
+                                      by a fresh spawn),
+                                      ``warm_chains_bytes_total``
+                                      counter (crc-stamped warm-chain
+                                      payload bytes over the pipes,
+                                      both join-prime and close-publish
+                                      directions)
 ``parallel/collectives``              ``hierarchical_psum`` ICI-vs-DCN
                                       phase spans (probe side) +
                                       ``jax.named_scope`` phase names in

@@ -661,6 +661,86 @@ def test_unbounded_recv_guard_is_per_function(tmp_path):
     assert [f.where for f in found] == ["src/models/serving.py:6"]
 
 
+# ========================================= rule: spawn-no-retry-classify
+
+def test_spawn_no_retry_classify_positive(tmp_path):
+    """A bare Process/Popen spawn in the serving runtime is flagged at
+    its line: a transient bring-up failure must classify, not crash."""
+    fs = lint(tmp_path, {"models/fleet.py": """\
+        import multiprocessing as mp
+        import subprocess
+
+        def naked_spawn(target):
+            proc = mp.Process(target=target)
+            proc.start()
+            return proc
+
+        def naked_exec(cmd):
+            return subprocess.Popen(cmd)
+    """})
+    found = hit(fs, "graft-spawn-no-retry-classify")
+    assert len(found) == 2
+    assert all(f.severity == "error" for f in found)
+    assert sorted(f.where for f in found) == \
+        ["src/models/fleet.py:10", "src/models/fleet.py:5"]
+    msgs = " ".join(f.message for f in found)
+    assert "Process()" in msgs and "Popen()" in msgs \
+        and "retry_call" in msgs
+
+
+def test_spawn_no_retry_classify_negative_guarded_and_scoped(tmp_path):
+    """The blessed idioms pass: a spawn under ``retry_call`` in the
+    SAME function, the transport shape — a nested ``bring_up`` closure
+    handed to ``retry_call`` one level up — and spawns outside the
+    serving-runtime scope."""
+    fs = lint(tmp_path, {"models/transport.py": """\
+        import multiprocessing as mp
+
+        from ..utils.retry import retry_call
+
+        def direct(target, policy):
+            return retry_call(lambda: mp.Process(target=target),
+                              policy=policy)
+
+        def nested(self, target, policy):
+            ctx = mp.get_context("spawn")
+
+            def bring_up():
+                proc = ctx.Process(target=target)
+                proc.start()
+                return proc
+
+            return retry_call(bring_up, policy=policy,
+                              retryable=(OSError,))
+    """, "smoketest/runner.py": """\
+        import subprocess
+
+        def out_of_scope(cmd):
+            return subprocess.Popen(cmd)
+    """})
+    assert hit(fs, "graft-spawn-no-retry-classify") == []
+
+
+def test_spawn_no_retry_classify_guard_is_chain_local(tmp_path):
+    """A ``retry_call`` in a SIBLING function does not bless another
+    function's bare spawn — the guard search walks enclosing
+    functions, never the whole file."""
+    fs = lint(tmp_path, {"models/serving.py": """\
+        import multiprocessing as mp
+
+        from ..utils.retry import retry_call
+
+        def guarded(target, policy):
+            return retry_call(lambda: mp.Process(target=target),
+                              policy=policy)
+
+        def naked(target):
+            return mp.Process(target=target)
+    """})
+    found = hit(fs, "graft-spawn-no-retry-classify")
+    assert [f.where for f in found] == ["src/models/serving.py:10"]
+
+
 def test_severity_overrides_and_off(tmp_path):
     files = {"s.py": "import random\nR = random.Random()\n"}
     assert lint(tmp_path, files,
@@ -681,6 +761,7 @@ def test_rule_catalog(tmp_path):
         "graft-wallclock-nondeterminism", "graft-silent-except",
         "graft-unlocked-shared-state", "graft-donated-reuse",
         "graft-lock-cycle", "graft-unbounded-recv",
+        "graft-spawn-no-retry-classify",
     }
     # disjoint from the HCL pack: one engine, two registries
     from nvidia_terraform_modules_tpu.tfsim.lint import engine as hcl

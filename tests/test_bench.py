@@ -335,7 +335,18 @@ def test_section_serve_fleet_transport_schema_and_gates():
                 "serve_fleet_proc_undisturbed_p99",
                 "serve_fleet_proc_kill_redrive_p99_vs_undisturbed",
                 "serve_fleet_proc_replica_down",
-                "serve_fleet_proc_redriven"):
+                "serve_fleet_proc_redriven",
+                "serve_fleet_proc_autoscale_warm_hit_frac",
+                "serve_fleet_proc_autoscale_cold_hit_frac",
+                "serve_fleet_proc_autoscale_warm_vs_cold",
+                "serve_fleet_proc_autoscale_ups",
+                "serve_fleet_proc_autoscale_warm_joins",
+                "serve_fleet_proc_churn_trace",
+                "serve_fleet_proc_churn_kill_at_s",
+                "serve_fleet_proc_churn_redrive_p99",
+                "serve_fleet_proc_churn_undisturbed_p99",
+                "serve_fleet_proc_churn_redrive_p99_vs_undisturbed",
+                "serve_fleet_proc_churn_replica_down"):
         assert key in out, key
     # the transport moves bytes, never semantics (CPU run: the
     # bit-match leg is None only on TPU, where children pin to the
@@ -357,6 +368,18 @@ def test_section_serve_fleet_transport_schema_and_gates():
     assert out["serve_fleet_proc_kill_redrive_p99"] > 0
     assert out["serve_fleet_proc_undisturbed_p99"] > 0
     assert out["serve_fleet_proc_kill_redrive_p99_vs_undisturbed"] > 0
+    # elastic over processes: the warm joiner actually inherited
+    # (chains over the pipe → real prefix hits) and the seeded churn
+    # kill actually took a process down
+    assert out["serve_fleet_proc_autoscale_ups"] >= 1
+    assert out["serve_fleet_proc_autoscale_warm_joins"] >= 1
+    assert out["serve_fleet_proc_autoscale_warm_hit_frac"] \
+        > out["serve_fleet_proc_autoscale_cold_hit_frac"]
+    assert out["serve_fleet_proc_autoscale_warm_vs_cold"] > 1
+    assert out["serve_fleet_proc_churn_kill_at_s"] > 0
+    assert out["serve_fleet_proc_churn_replica_down"] == 1
+    assert out["serve_fleet_proc_churn_redrive_p99"] > 0
+    assert out["serve_fleet_proc_churn_undisturbed_p99"] > 0
     from nvidia_terraform_modules_tpu.utils.traffic import (
         poisson_trace,
         trace_summary,
@@ -385,7 +408,18 @@ def test_section_serve_fleet_transport_deterministic_across_runs():
                 "serve_fleet_transport_trace",
                 "serve_fleet_transport_bitmatch",
                 "serve_fleet_proc_kill_at_s",
-                "serve_fleet_proc_replica_down"):
+                "serve_fleet_proc_replica_down",
+                # the elastic plane's seed-determined fields: hit
+                # fractions are block accounting on a deterministic
+                # schedule, the churn kill is trace-derived
+                "serve_fleet_proc_autoscale_warm_hit_frac",
+                "serve_fleet_proc_autoscale_cold_hit_frac",
+                "serve_fleet_proc_autoscale_warm_vs_cold",
+                "serve_fleet_proc_autoscale_ups",
+                "serve_fleet_proc_autoscale_warm_joins",
+                "serve_fleet_proc_churn_trace",
+                "serve_fleet_proc_churn_kill_at_s",
+                "serve_fleet_proc_churn_replica_down"):
         assert a[key] == b[key], key
 
 

@@ -45,6 +45,7 @@ import pytest
 from nvidia_terraform_modules_tpu.models import (
     AutoscalePolicy,
     BurnInConfig,
+    MultiProcTransport,
     WarmChainStore,
     greedy_decode,
     init_params,
@@ -412,6 +413,71 @@ def test_fleet_scale_churn_with_faults_bit_exact_tier1():
     assert st2["faults"]["killed"] == fr["killed"]
 
 
+def test_fleet_scale_up_proc_warm_inherit_bit_exact_tier1():
+    """THE proc-autoscale acceptance gate (ISSUE 18): the elastic
+    control loop runs UNCHANGED over real processes — a scale-up
+    spawns a real child, the joiner's keyspace share of the warm store
+    ships as crc-stamped chain frames over the pipe, and every request
+    bit-matches solo greedy AND the in-proc elastic fleet (same
+    events, same tokens). Round 2's joiner is WARM: chains seeded over
+    the wire convert to real host-tier prefix hits."""
+    cfg, params, prompts = _setup(n=18, templates=6)
+    want = _want(n=18, templates=6)
+
+    def _pol():
+        # the SAME policy as the in-proc warm-inherit gate above, so
+        # the two fleets' scale schedules are comparable event-for-
+        # event (and the joiners' union keyspace share is known to
+        # own stored roots)
+        return AutoscalePolicy(min_replicas=1, max_replicas=3,
+                               up_backlog=2.0, down_backlog=0.25,
+                               cooldown_s=0.0, seed=0)
+
+    kw = dict(max_len=16, replicas=1, kv_block=4, est_token_s=0.01,
+              steal=False, share_prefix=True, host_spill=True,
+              host_blocks=64, prefix_keep_blocks=16)
+    fl_in = make_fleet(params, cfg, autoscale=_pol(), **kw)
+    _assert_all_equal(fl_in(prompts, 6, slots=2), want, "inproc:")
+    events_in = fl_in.last_stats["fleet"]["scale"]["events"]
+
+    tr = MultiProcTransport()
+    fleet = make_fleet(params, cfg, autoscale=_pol(), transport=tr,
+                       join_timeout_s=240.0, **kw)
+    try:
+        got = fleet(prompts, 6, slots=2)
+        _assert_all_equal(got, want, "proc scale-up:")
+        st = fleet.last_stats["fleet"]
+        sc = st["scale"]
+        assert st["served"] == len(prompts) and st["shed"] == 0
+        assert sc["ups_executed"] == sc["ups_planned"] == 2
+        assert sc["spawn_failures"] == 0
+        # the scale SCHEDULE is transport-invariant (pure function of
+        # the trace), and every joiner is a real child process
+        assert sc["events"] == events_in
+        assert sorted(tr._children) == [0, 1, 2]
+        # run close published the retained working set over the wire
+        # (publish_chains RPC from each child)
+        assert sc["warm_store"]["chains"] > 0
+
+        # round 2: same trace ⇒ same schedule; the joiner now takes
+        # its share WARM — chain frames over the pipe, seeded into the
+        # child's host tier, swapped in as real prefix hits
+        got2 = fleet(prompts, 6, slots=2)
+        _assert_all_equal(got2, want, "proc scale-up round 2:")
+        sc2 = fleet.last_stats["fleet"]["scale"]
+        assert sc2["events"] == events_in
+        assert sc2["warm_joins"] >= 1 and sc2["warm_chains_primed"] >= 1
+        warm = [rs["prefix"]["warm"]
+                for rs in fleet.last_stats["replica_stats"] if rs]
+        assert sum(w["seeded_chains"] for w in warm) >= 1
+        assert sum(w["seeded_blocks"] for w in warm) >= 1
+        spill = fleet.last_stats["fleet"]["spill"]
+        assert spill["host_hit_blocks"] >= 1
+    finally:
+        fleet.close()
+    assert tr._children == {}
+
+
 # ------------------------------------------------------- slow matrix
 
 
@@ -588,3 +654,177 @@ def test_fleet_elastic_fault_target_beyond_realised_fleet_raises():
                        steal=False)
     with pytest.raises(ValueError, match="realises only 2"):
         fleet(prompts, 6, slots=2)
+
+
+# -------------------------------------------- slow matrix over processes
+
+
+@pytest.mark.slow
+def test_fleet_proc_kill_during_warm_join_discards_partial_seed_slow():
+    """SIGKILL-during-warm-join over real processes (ISSUE 18
+    acceptance): the joiner dies — for real — while (or right after)
+    its warm chains cross the pipe. The partial seed dies with the
+    child (the store's ``take`` copies, so fleet state is untouched),
+    its requests redrive to the survivor, zero strand / zero double
+    (served == submitted; the fleet's duplicate check makes
+    double-serving a hard error), and outputs bit-match undisturbed
+    solo decode. Round 1 arms the same kill cold (empty store), round
+    2 is the warm-join composition proper."""
+    cfg, params, prompts = _setup(n=18, templates=6)
+    want = _want(n=18, templates=6)
+    # the warm-inherit gate's policy: three members, and joiner 2's
+    # keyspace share is the one that owns stored roots — so target=2
+    # kills the WARM joiner specifically
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=3,
+                          up_backlog=2.0, down_backlog=0.25,
+                          cooldown_s=0.0, seed=0)
+    profile = FleetFaultProfile(
+        [FleetFault("kill_replica", target=2, at_s=0.05)], seed=0)
+    tr = MultiProcTransport()
+    fleet = make_fleet(params, cfg, max_len=16, replicas=1, kv_block=4,
+                       est_token_s=0.01, autoscale=pol, faults=profile,
+                       steal=False, share_prefix=True, host_spill=True,
+                       host_blocks=64, prefix_keep_blocks=16,
+                       transport=tr, join_timeout_s=240.0)
+    try:
+        got = fleet(prompts, 6, slots=2)
+        _assert_all_equal(got, want, "cold kill-join:")
+        st = fleet.last_stats["fleet"]
+        assert st["served"] == len(prompts) and st["shed"] == 0
+        assert st["faults"]["killed"] == ["replica-2"]
+        # the survivors' closes still published the working set
+        assert st["scale"]["warm_store"]["chains"] > 0
+
+        got2 = fleet(prompts, 6, slots=2)
+        _assert_all_equal(got2, want, "warm kill-join:")
+        st2 = fleet.last_stats["fleet"]
+        sc2 = st2["scale"]
+        assert st2["served"] == len(prompts) and st2["shed"] == 0
+        assert st2["faults"]["killed"] == ["replica-2"]
+        assert st2["faults"]["redriven"] >= 1
+        # the join WAS warm when the kill landed: chains were primed
+        # for the joiner, and losing it stranded nothing
+        assert sc2["warm_joins"] >= 1
+        assert sc2["warm_store"]["chains"] > 0
+    finally:
+        tr.close()
+
+
+@pytest.fixture(scope="module")
+def shared_proc_transport():
+    tr = MultiProcTransport()
+    yield tr
+    tr.close()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fleet_proc_churn_drain_racing_kill_matrix_slow(
+        seed, shared_proc_transport):
+    """The drain-racing-kill + kill-during-bring-up composition of the
+    tier-1 churn gate, rerun over REAL processes per profile seed: the
+    base replica drains while a joiner is killed during its bring-up
+    window — a real SIGKILL of a real child — and every request still
+    completes bit-exact. One shared transport amortises spawns across
+    seeds."""
+    cfg, params, prompts = _setup(n=20)
+    want = _want(n=20)
+    arrivals = tuple([0.0] * 6 + [0.6 + 0.05 * i for i in range(4)]
+                     + [1.4 + 0.03 * i for i in range(5)]
+                     + [2.2 + 0.2 * i for i in range(5)])
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=4,
+                          up_backlog=2.0, down_backlog=0.4,
+                          cooldown_s=0.05, seed=0)
+    profile = FleetFaultProfile(
+        [FleetFault("drain_replica", target=0, at_s=0.05),
+         FleetFault("kill_replica", target=2, at_s=0.06)], seed=seed)
+    tr = shared_proc_transport
+    fleet = make_fleet(params, cfg, max_len=16, replicas=2, kv_block=4,
+                       est_token_s=0.02, autoscale=pol, faults=profile,
+                       steal=False, transport=tr, join_timeout_s=240.0)
+    label = f"proc churn seed {seed}:"
+    got = fleet(prompts, 6, slots=2, arrivals=arrivals)
+    _assert_all_equal(got, want, label)
+    st = fleet.last_stats["fleet"]
+    assert st["served"] == len(prompts) and st["shed"] == 0, label
+    assert st["faults"]["killed"] == ["replica-2"], label
+    assert st["faults"]["drained"] == ["replica-0"], label
+    assert st["scale"]["ups_executed"] >= 2, label
+
+
+@pytest.mark.slow
+def test_fleet_proc_spawn_retry_exhaustion_classified_slow():
+    """Spawn-retry-exhaustion-during-churn over processes (ISSUE 18
+    acceptance): a joiner whose process spawn fails EVERY attempt is
+    classified dead — never a hang — its planned requests redrive to
+    the live children, the failure is billed, and outputs bit-match
+    solo. The base replica's child is brought up FIRST so only the
+    joiner's spawn path is poisoned."""
+    from nvidia_terraform_modules_tpu.models.transport import TransportDead
+
+    cfg, params, prompts = _setup()
+    want = _want()
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=2,
+                          up_backlog=2.0, down_backlog=0.25,
+                          cooldown_s=0.0, seed=0)
+    tr = MultiProcTransport()
+    fleet = make_fleet(params, cfg, max_len=16, replicas=1, kv_block=4,
+                       est_token_s=0.01, autoscale=pol, steal=False,
+                       transport=tr, join_timeout_s=240.0)
+    try:
+        tr.ensure_engine(0)              # base child up before poisoning
+        real_spawn = tr._spawn
+
+        def fail_spawn(i):
+            raise TransportDead(f"injected spawn failure replica-{i}")
+
+        tr._spawn = fail_spawn
+        try:
+            got = fleet(prompts, 6, slots=2)
+        finally:
+            tr._spawn = real_spawn
+        _assert_all_equal(got, want, "proc spawn exhaustion:")
+        st = fleet.last_stats["fleet"]
+        sc = st["scale"]
+        assert sc["ups_planned"] >= 1 and sc["ups_executed"] == 0
+        assert sc["spawn_failures"] >= 1
+        assert st["served"] == len(prompts) and st["shed"] == 0
+        dead = [r for r in st["per_replica"]
+                if r.get("spawned") is False]
+        assert len(dead) >= 1 and all(r["dead"] for r in dead)
+        assert sorted(tr._children) == [0]   # no half-spawned child
+    finally:
+        tr.close()
+
+
+@pytest.mark.slow
+def test_fleet_proc_churn_storm_bit_exact_slow():
+    """A poisson churn storm over real processes: seeded bursty
+    arrivals drive join/leave churn while a seeded kill lands on a
+    joiner — the full (policy, profile, trace) composition over the
+    multiproc wire stays bit-exact."""
+    from nvidia_terraform_modules_tpu.utils.traffic import (
+        fault_times,
+        poisson_trace,
+    )
+
+    cfg, params, prompts = _setup(n=16)
+    want = _want(n=16)
+    arrivals = tuple(poisson_trace(30.0, len(prompts), seed="churn-0"))
+    kill_at = fault_times(arrivals, 1, seed="churn-kill-0")[0]
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=3,
+                          up_backlog=2.0, down_backlog=0.4,
+                          cooldown_s=0.03, seed=0)
+    profile = FleetFaultProfile(
+        [FleetFault("kill_replica", target=1, at_s=kill_at)], seed=0)
+    tr = MultiProcTransport()
+    fleet = make_fleet(params, cfg, max_len=16, replicas=1, kv_block=4,
+                       est_token_s=0.02, autoscale=pol, faults=profile,
+                       steal=False, transport=tr, join_timeout_s=240.0)
+    try:
+        got = fleet(prompts, 6, slots=2, arrivals=arrivals)
+        _assert_all_equal(got, want, "proc churn storm:")
+        st = fleet.last_stats["fleet"]
+        assert st["served"] == len(prompts) and st["shed"] == 0
+    finally:
+        tr.close()

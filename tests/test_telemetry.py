@@ -1005,9 +1005,12 @@ def test_fleet_scale_gauge_counters_and_span_export(jax8, tmp_path):
         assert s["args"]["trigger"] in ("backlog", "deadline_slack")
         assert s["args"]["replica"].startswith("replica-")
         assert "warm" in s["args"]
+        # a capture distinguishes thread joins from process spawns
+        assert s["args"]["transport"] == "inproc"
     for s in downs:
         assert s["args"]["trigger"] == "low_load"
         assert s["args"]["replica"] in sc["scaled_down"]
+        assert s["args"]["transport"] == "inproc"
     xs = chrome_trace(reg.events)["traceEvents"]
     names = {e["name"] for e in xs if e["ph"] == "X"}
     assert {"fleet_scale", "fleet_route", "serve_request"} <= names
@@ -1024,13 +1027,16 @@ def test_fleet_scale_gauge_counters_and_span_export(jax8, tmp_path):
 
 
 def test_transport_frame_and_rtt_instruments_export(tmp_path):
-    """The transport seam's four instruments, golden-tested at the
+    """The transport seam's six instruments, golden-tested at the
     frame layer: ``transport_frames_total``/``transport_bytes_total``
     count every frame through the metered (router) side of a channel —
     both directions, bytes EXACT against a recomputation of the same
     frames — ``transport_rtt_ms`` records the replica-measured poll
-    round-trips and ``transport_retries_total`` the classified reply
-    retries. A disabled registry costs nothing (no-op instruments)."""
+    round-trips, ``transport_retries_total`` the classified reply
+    retries, ``transport_child_respawn_total`` each dead child
+    replaced by a fresh spawn and ``warm_chains_bytes_total`` the
+    warm-chain payload bytes shipped over the pipes. A disabled
+    registry costs nothing (no-op instruments)."""
     import multiprocessing as mp
     import pickle as _pickle
 
@@ -1076,10 +1082,20 @@ def test_transport_frame_and_rtt_instruments_export(tmp_path):
         assert math.isclose(hist.sum, 41.75)
         assert reg.counter("transport_retries_total").value == 2
 
+        metrics.respawn()
+        metrics.respawn()
+        metrics.warm_bytes(4096)
+        metrics.warm_bytes(0)                # empty prime: no count
+        assert reg.counter(
+            "transport_child_respawn_total").value == 2
+        assert reg.counter("warm_chains_bytes_total").value == 4096
+
         prom = reg.prometheus_text()
         assert "# TYPE transport_frames_total counter" in prom
         assert "# TYPE transport_bytes_total counter" in prom
         assert "# TYPE transport_retries_total counter" in prom
+        assert "# TYPE transport_child_respawn_total counter" in prom
+        assert "# TYPE warm_chains_bytes_total counter" in prom
         assert "transport_rtt_ms" in prom
     finally:
         router.close()
@@ -1091,3 +1107,5 @@ def test_transport_frame_and_rtt_instruments_export(tmp_path):
     off.frame(128)
     off.retries(5)
     off.rtt_ms([1.0])
+    off.respawn()
+    off.warm_bytes(1024)

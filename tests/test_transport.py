@@ -54,7 +54,13 @@ from nvidia_terraform_modules_tpu.models.fleet import (
 )
 from nvidia_terraform_modules_tpu.models.transport import (
     decode_block_payload,
+    decode_rng,
+    decode_warm_chains,
     encode_block_payload,
+    encode_rng,
+    encode_warm_chains,
+    start_parent_watchdog,
+    warm_chains_nbytes,
 )
 from nvidia_terraform_modules_tpu.utils.retry import RetryPolicy, retry_call
 from nvidia_terraform_modules_tpu.utils.traffic import shared_prefix_prompts
@@ -233,6 +239,115 @@ def test_block_payload_codec_verifies_transfer_crc():
         decode_block_payload(corrupt)
 
 
+def test_transport_rng_codec_roundtrip():
+    """Both PRNG key flavours survive the RUN-frame codec: a raw
+    ``PRNGKey`` uint32 vector roundtrips bit-equal, and a typed
+    ``jax.random.key`` rebuilds to identical key data — so the child's
+    (request, position)-derived sampling keys equal the parent's."""
+    raw = jax.random.PRNGKey(7)
+    back = decode_rng(pickle.loads(pickle.dumps(encode_rng(raw))))
+    assert jnp.array_equal(back, raw)
+
+    typed = jax.random.key(7)
+    back_t = decode_rng(pickle.loads(pickle.dumps(encode_rng(typed))))
+    assert jnp.array_equal(jax.random.key_data(back_t),
+                           jax.random.key_data(typed))
+    # the rebuilt keys DRAW identically — the property serving rests on
+    assert jnp.array_equal(jax.random.uniform(back_t, (4,)),
+                           jax.random.uniform(typed, (4,)))
+    assert encode_rng(None) is None and decode_rng(None) is None
+
+
+def test_transport_warm_chain_codec_drops_corrupt_chains_only():
+    """Warm-join framing: chains roundtrip bit-exact with per-chain
+    ``transfer_crc`` stamps, and a corrupt chain is dropped and counted
+    WITHOUT taking down its batch — one bad chain costs one chain."""
+    rng = np.random.default_rng(3)
+
+    def chain(seed, blocks=2):
+        r = np.random.default_rng(seed)
+        chunks = tuple(tuple(int(t) for t in r.integers(0, 64, 4))
+                       for _ in range(blocks))
+        payload = {
+            "k": [r.standard_normal((blocks, 4, 2, 8)).astype(np.float32)],
+            "v": [r.standard_normal((blocks, 4, 2, 8)).astype(np.float32)],
+        }
+        return chunks, payload
+
+    chains = [chain(0), chain(1), chain(2)]
+    wire = pickle.loads(pickle.dumps(encode_warm_chains(chains)))
+    assert warm_chains_nbytes(wire) == sum(
+        np.asarray(b).nbytes for _c, p in chains
+        for bufs in p.values() for b in bufs)
+    back, dropped = decode_warm_chains(wire)
+    assert dropped == 0 and len(back) == 3
+    for (c0, p0), (c1, p1) in zip(chains, back):
+        assert c0 == c1
+        for key in p0:
+            for a, b in zip(p0[key], p1[key]):
+                assert np.array_equal(a, b)
+
+    # flip one byte inside the MIDDLE chain's rows: that chain drops
+    # (billed), its neighbours still import bit-exact
+    buf = bytearray(wire[1][1]["data"][0])
+    buf[9] ^= 0x10
+    wire[1][1]["data"] = [bytes(buf)] + list(wire[1][1]["data"][1:])
+    back, dropped = decode_warm_chains(wire)
+    assert dropped == 1 and len(back) == 2
+    assert [c for c, _p in back] == [chains[0][0], chains[2][0]]
+
+
+def test_transport_parent_watchdog_fires_on_reparent():
+    """The orphan-reaper regression (simulated parent crash): the
+    child-side watchdog polls ``getppid`` and fires ``on_orphan`` the
+    moment the answer changes — the window where the parent died
+    between spawn and registry insert, which no parent-side close()
+    can cover. Injectable fakes keep the crash simulated."""
+    fired = threading.Event()
+    ppid = [4242]
+    thread, stop = start_parent_watchdog(
+        4242, poll_s=0.01, getppid=lambda: ppid[0],
+        on_orphan=fired.set)
+    try:
+        assert not fired.wait(0.08)      # parent alive: never fires
+        ppid[0] = 1                      # the crash: child reparented
+        assert fired.wait(2.0), "watchdog never noticed the reparent"
+        thread.join(2.0)
+        assert not thread.is_alive()     # fired exactly once, then done
+    finally:
+        stop.set()
+
+    # the stop event is the clean-shutdown path (no false orphaning)
+    quiet = threading.Event()
+    thread2, stop2 = start_parent_watchdog(
+        4242, poll_s=0.01, getppid=lambda: 4242, on_orphan=quiet.set)
+    stop2.set()
+    thread2.join(2.0)
+    assert not thread2.is_alive() and not quiet.is_set()
+
+
+def test_transport_atexit_close_reaps_via_weakref():
+    """The parent-side half of the orphan contract: the atexit hook
+    holds only a WEAK reference (a dead transport is a no-op, not a
+    resurrection), and a live one gets a real close()."""
+    from nvidia_terraform_modules_tpu.models.transport import _close_at_exit
+    import weakref
+
+    class _Rec:
+        closed = 0
+
+        def close(self):
+            _Rec.closed += 1
+
+    rec = _Rec()
+    ref = weakref.ref(rec)
+    _close_at_exit(ref)
+    assert _Rec.closed == 1
+    del rec
+    _close_at_exit(ref)                  # dead ref: silent no-op
+    assert _Rec.closed == 1
+
+
 # ------------------------------------------------- multi-proc fleet gates
 
 
@@ -249,32 +364,22 @@ def test_fleet_worker_hung_classification():
                    join_timeout_s=0.0)
 
 
-def test_fleet_multiproc_v1_refusals_are_loud():
-    """The multi-proc v1 scope boundary is explicit ValueErrors, not
-    silent degradation: no disaggregate, no autoscale, no sampler, no
-    per-call rng, and unknown transport names are refused."""
+def test_fleet_multiproc_refusals_are_loud():
+    """What the multi-proc transport still refuses, it refuses with
+    explicit ValueErrors: unknown transport names, non-positive
+    timeouts, and a RAW sampler callable (it does not pickle across
+    the process boundary — the error directs to the spec-dict form,
+    which IS accepted and normalised identically on both sides)."""
     cfg, params, prompts, max_len = _zipf_setup()
     with pytest.raises(ValueError, match="transport"):
         make_fleet(params, cfg, max_len=max_len, replicas=2,
                    transport="carrier-pigeon")
-    with pytest.raises(ValueError, match="disaggregate"):
-        make_fleet(params, cfg, max_len=max_len, replicas=2,
-                   transport="multiproc", disaggregate=True)
-    with pytest.raises(ValueError, match="autoscale|elastic"):
-        from nvidia_terraform_modules_tpu.models import AutoscalePolicy
+    from nvidia_terraform_modules_tpu.models import make_sampler
 
+    with pytest.raises(ValueError, match="spec|pickle"):
         make_fleet(params, cfg, max_len=max_len, replicas=2,
                    transport="multiproc",
-                   autoscale=AutoscalePolicy(min_replicas=1,
-                                             max_replicas=3))
-    with pytest.raises(ValueError, match="sampler|greedy"):
-        make_fleet(params, cfg, max_len=max_len, replicas=2,
-                   transport="multiproc",
-                   sampler=dict(top_k=2, temperature=0.5))
-    fleet = make_fleet(params, cfg, max_len=max_len, replicas=2,
-                       transport="multiproc")
-    with pytest.raises(ValueError, match="greedy-only|rng"):
-        fleet(prompts, 5, slots=4, rng=jax.random.PRNGKey(0))
+                   sampler=make_sampler(top_k=2, temperature=0.5))
     with pytest.raises(ValueError, match="reply_timeout_s"):
         MultiProcTransport(reply_timeout_s=0.0)
     with pytest.raises(ValueError, match="spawn_timeout_s"):
@@ -357,6 +462,69 @@ def test_fleet_multiproc_real_sigkill_redrives_bit_exact_tier1():
         assert st2["faults"]["killed"] == ["replica-0"]
         assert sorted(tr._children) == [1]
         assert tr._children[1][0].pid == survivor_pid
+    finally:
+        fleet.close()
+
+
+def test_fleet_multiproc_sampler_spec_and_rng_bit_match_tier1():
+    """The sampling half of the no-refusals acceptance gate: a sampler
+    SPEC dict plus a per-call rng run over real processes and the
+    sampled tokens bit-match the thread fleet — the spec normalises
+    through ``make_sampler`` identically on both sides of the wire,
+    the key ships as RUN-frame key data, and (request, position)-keyed
+    sampling is placement- AND process-invariant. Both key flavours
+    (raw ``PRNGKey``, typed ``key``) cross the boundary."""
+    cfg, params, prompts, max_len = _zipf_setup()
+    spec = dict(temperature=0.7, top_k=3)
+    rng = jax.random.PRNGKey(11)
+
+    fl_in = make_fleet(params, cfg, max_len=max_len, replicas=2,
+                       kv_block=4, share_prefix=True, sampler=spec)
+    want = fl_in(prompts, 5, slots=4, rng=rng)
+    assert all(w is not None for w in want)
+
+    fl_mp = make_fleet(params, cfg, max_len=max_len, replicas=2,
+                       kv_block=4, share_prefix=True, sampler=spec,
+                       transport="multiproc", join_timeout_s=240.0)
+    try:
+        _assert_all_equal(fl_mp(prompts, 5, slots=4, rng=rng),
+                          [jnp.asarray(w) for w in want], "sampled:")
+        st = fl_mp.last_stats["fleet"]
+        assert st["served"] == len(prompts) and st["shed"] == 0
+        # typed-key flavour over the SAME warm children: a typed key
+        # equal to PRNGKey(11)'s data reproduces the same tokens
+        typed = jax.random.wrap_key_data(rng)
+        _assert_all_equal(fl_mp(prompts, 5, slots=4, rng=typed),
+                          [jnp.asarray(w) for w in want], "typed key:")
+    finally:
+        fl_mp.close()
+
+
+def test_fleet_multiproc_disaggregate_bit_matches_inproc_tier1():
+    """The disaggregation half of the no-refusals gate: prefill
+    workers stay parent-side, the prefill→decode handoff rides the
+    ``kv_import`` RPC as a crc-stamped paged-block payload into a REAL
+    decode process — and the outputs bit-match solo greedy decode (the
+    in-proc disaggregated fleet's own gate, so disaggregated-over-
+    processes == colocated, transitively)."""
+    cfg, params, prompts, max_len = _zipf_setup()
+    want = _solo(params, prompts, 5, cfg)
+
+    tr = MultiProcTransport()
+    fleet = make_fleet(params, cfg, max_len=max_len, replicas=2,
+                       disaggregate=True, prefill_workers=1,
+                       kv_block=4, transport=tr, join_timeout_s=240.0)
+    try:
+        _assert_all_equal(fleet(prompts, 5, slots=4), want,
+                          "disagg multiproc:")
+        st = fleet.last_stats["fleet"]
+        assert st["mode"] == "disaggregated"
+        assert st["served"] == len(prompts) and st["shed"] == 0
+        # the split is real: ONE decode child process, prefill engine
+        # in the parent (the handoff payload crossed the wire, not
+        # the worker)
+        assert sorted(tr._children) == [0]
+        assert len(tr.pre_engines) == 1
     finally:
         fleet.close()
 
