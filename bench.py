@@ -1828,6 +1828,204 @@ def section_serve_fleet_transport() -> dict:
     }
 
 
+def section_serve_coldstart() -> dict:
+    """Cold-start annihilation (ISSUE 19): the persistent AOT compile
+    cache (``models/aotcache.py``) priced on the joiner's clock.
+
+    Two headline legs:
+
+    - ``serve_join_first_token_warm_vs_cold``: wall time from "the
+      joiner starts building its engine" to "the seeded trace's tokens
+      are on the host", cold (fresh cache — every step jit traces AND
+      compiles inside the window) vs warm (same engine config against
+      the populated cache — hits deserialize executables, the jit call
+      path is primed). Both joins run the IDENTICAL seeded schedule
+      and the outputs must bit-match exactly — the cache moves
+      compiles, never bits. The section activates its OWN fresh cache
+      dir at runtime (``AotCompileCache.activate`` overrides the
+      orchestrator's ``_cache_env`` banked dir), so "cold" is honest
+      even under the bench harness's persistent XLA cache.
+    - ``serve_fleet_autoscale_p99_warm``: the ISSUE 15 spike-burst
+      autoscale leg re-run with ``aot_cache=`` armed — the first call
+      populates the cache (base replica + joiners compile once), the
+      second call's joiners bring up entirely from hits, and the
+      arrival→completion p99 of THAT call is the number a warmed
+      node-pool scale-up actually serves. ``warm_compiles`` in the
+      scale ledger counts the bring-ups that warmed (deterministic);
+      ``warm_compile_errors`` must stay empty.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from nvidia_terraform_modules_tpu.models import (
+        BurnInConfig,
+        init_params,
+        make_serve_engine,
+    )
+    from nvidia_terraform_modules_tpu.models.fleet import (
+        AutoscalePolicy,
+        make_fleet,
+    )
+    from nvidia_terraform_modules_tpu.utils.traffic import (
+        ragged_lengths,
+        shared_prefix_prompts,
+        spike_trace,
+        trace_summary,
+    )
+
+    on = _on_tpu()
+    if on:
+        import dataclasses
+
+        cs_cfg = dataclasses.replace(_flagship_cfg(), attn="dense")
+    else:
+        # the serve_fleet CPU config: the signals here (compile-window
+        # wall clocks, hit counts, bit-match) are bring-up, not model
+        # time, and the fleet leg builds replicas× engines
+        cs_cfg = BurnInConfig(vocab=512, d_model=128, n_heads=4,
+                              d_ff=512, n_layers=2, seq_len=64,
+                              batch=4, dtype=jnp.float32, attn="dense")
+    seed = 0
+    slots = 4
+    kv_block = 16 if on else 4
+    n_req = 16 if on else 12
+    nlo, nhi, nmean = (8, 96, 32.0) if on else (2, 24, 8.0)
+    params = init_params(jax.random.PRNGKey(0), cs_cfg)
+    sync_outs = _serve_sync(jax, jnp)
+
+    def synced(outs):
+        sync_outs([o for o in outs if o is not None])
+
+    sp_pairs = shared_prefix_prompts(
+        n_req, seed, n_templates=3, template_len=4 * kv_block,
+        suffix_lo=2, suffix_hi=3 * kv_block, vocab=cs_cfg.vocab)
+    sp_prompts = [jnp.asarray(toks, jnp.int32) for _t, toks in sp_pairs]
+    sp_budgets = ragged_lengths(n_req, seed + 1, lo=nlo, hi=nhi,
+                                mean=nmean)
+    sp_max_len = max(int(p.shape[-1]) + n
+                     for p, n in zip(sp_prompts, sp_budgets))
+    join_prompts = sp_prompts[:4]
+    join_budget = max(sp_budgets[:4])
+    lens = tuple(sorted({int(p.shape[-1]) for p in join_prompts}))
+    root = tempfile.mkdtemp(prefix="bench_coldstart_")
+    fl_root = tempfile.mkdtemp(prefix="bench_coldstart_fleet_")
+    # the engines below ACTIVATE their own cache dirs (that's the
+    # point) — snapshot jax's persistent-cache config so the tier-1
+    # in-process callers (tests/test_bench.py) get it back; the
+    # subprocess path doesn't care
+    _cc_keys = ("jax_compilation_cache_dir",
+                "jax_persistent_cache_min_compile_time_secs",
+                "jax_persistent_cache_min_entry_size_bytes")
+    _cc_prev = {k: getattr(jax.config, k) for k in _cc_keys}
+    try:
+        # ---- cold join: fresh cache, build + first trace inside the
+        # timed window (make_serve_engine(aot_cache=...) activates the
+        # section's OWN dir, overriding the harness's banked XLA cache)
+        t0 = time.perf_counter()
+        eng_cold = make_serve_engine(params, cs_cfg, max_len=sp_max_len,
+                                     kv_block=kv_block, aot_cache=root)
+        cold_outs = eng_cold(join_prompts, join_budget, slots=slots)
+        synced(cold_outs)
+        cold_s = time.perf_counter() - t0
+        # populate the .gac entries against the now-banked XLA cache
+        # (this is the fleet-start warm a real deployment runs ONCE)
+        pop = eng_cold.warm(slots=slots, prompt_lens=lens,
+                            n_new=join_budget)
+        # converge: the FIRST re-probe demotes any executable the
+        # backend cannot reload (XLA:CPU serialized programs can
+        # reference jit-compiled fusion symbols — quarantined loudly,
+        # re-stored trace-only) so the timed warm join below measures
+        # the steady state every later joiner sees
+        eng_conv = make_serve_engine(params, cs_cfg, max_len=sp_max_len,
+                                     kv_block=kv_block, aot_cache=root)
+        conv = eng_conv.warm(slots=slots, prompt_lens=lens,
+                             n_new=join_budget)
+        # ---- warm join: same config, converged cache — probe-hit
+        # executables + primed call path, then the identical trace
+        t0 = time.perf_counter()
+        eng_warm = make_serve_engine(params, cs_cfg, max_len=sp_max_len,
+                                     kv_block=kv_block, aot_cache=root)
+        wst = eng_warm.warm(slots=slots, prompt_lens=lens,
+                            n_new=join_budget)
+        warm_outs = eng_warm(join_prompts, join_budget, slots=slots)
+        synced(warm_outs)
+        warm_s = time.perf_counter() - t0
+        bitmatch = all(
+            bool(jax.device_get(jnp.array_equal(c, w)))
+            for c, w in zip(cold_outs, warm_outs))
+        cache_stats = eng_warm.aot_cache.stats()
+
+        # ---- autoscale spike p99 with the cache armed: call 1
+        # populates (cold compiles, banked), call 2's joiners warm
+        # from hits — its p99 is the warmed scale-up tail
+        est_token_s = 0.02 if on else 0.01
+        g_budgets = ragged_lengths(n_req, seed + 2, lo=nlo, hi=nhi,
+                                   mean=nmean)
+        g_max_len = max(int(p.shape[-1]) + n
+                        for p, n in zip(sp_prompts, g_budgets))
+        rate = n_req / (est_token_s * sum(g_budgets))
+        as_arrivals = spike_trace(rate / 4, n_req, seed,
+                                  spike_every=30.0, spike_duration=1.0)
+        as_fleet = make_fleet(
+            params, cs_cfg, max_len=g_max_len, replicas=1,
+            kv_block=kv_block, est_token_s=est_token_s, steal=True,
+            aot_cache=fl_root,
+            autoscale=AutoscalePolicy(
+                min_replicas=1, max_replicas=3, up_backlog=2.0,
+                down_backlog=0.25, cooldown_s=0.0, seed=seed))
+        synced(as_fleet(sp_prompts, g_budgets, slots=slots))  # populate
+        sc_pop = as_fleet.last_stats["fleet"]["scale"]
+        synced(as_fleet(sp_prompts, g_budgets, slots=slots,
+                        arrivals=as_arrivals))
+        as_lat = as_fleet.last_stats["fleet"]["latency_ms"]
+        sc_warm = as_fleet.last_stats["fleet"]["scale"]
+
+        return {
+            "serve_coldstart_requests": len(join_prompts),
+            "serve_coldstart_budget": join_budget,
+            "serve_coldstart_trace": {
+                "kind": "spike", "seed": seed,
+                "rate": round(rate / 4, 3),
+                **trace_summary(as_arrivals)},
+            # the headline: join→first-token, warm strictly faster
+            "serve_join_first_token_cold_ms": round(cold_s * 1e3, 1),
+            "serve_join_first_token_warm_ms": round(warm_s * 1e3, 1),
+            "serve_join_first_token_warm_vs_cold": round(
+                cold_s / max(warm_s, 1e-9), 3),
+            # determinism-keyed: the cache moves compiles, never bits
+            "serve_coldstart_bitmatch": bitmatch,
+            "serve_coldstart_registered": wst["registered"],
+            "serve_coldstart_warm_hits": wst["hits"],
+            "serve_coldstart_warm_misses": wst["misses"],
+            "serve_coldstart_populate_misses": pop["misses"],
+            "serve_coldstart_demoted": conv["demoted"],
+            "serve_coldstart_quarantined": cache_stats["quarantined"],
+            # the warmed autoscale tail (wall) + its determinism keys
+            "serve_fleet_autoscale_p99_warm": as_lat["p99"],
+            "serve_fleet_autoscale_p50_warm": as_lat["p50"],
+            "serve_coldstart_autoscale_ups": sc_warm["ups_executed"],
+            "serve_coldstart_warm_compiles": sc_warm["warm_compiles"],
+            "serve_coldstart_populate_compiles":
+                sc_pop["warm_compiles"],
+            "serve_coldstart_warm_compile_errors":
+                sc_warm["warm_compile_errors"]
+                + sc_pop["warm_compile_errors"],
+        }
+    finally:
+        from nvidia_terraform_modules_tpu.models.aotcache import (
+            _reset_xla_cache,
+        )
+
+        for k, v in _cc_prev.items():
+            jax.config.update(k, v)
+        _reset_xla_cache()
+        shutil.rmtree(root, ignore_errors=True)
+        shutil.rmtree(fl_root, ignore_errors=True)
+
+
 def section_longctx() -> dict:
     """Long-context attention: pallas flash kernel vs XLA dense at S=4096 —
     the regime ring/flash attention exist for (O(S²) HBM traffic
@@ -2203,6 +2401,7 @@ SECTIONS = {
     "serve_engine": section_serve_engine,
     "serve_fleet": section_serve_fleet,
     "serve_fleet_transport": section_serve_fleet_transport,
+    "serve_coldstart": section_serve_coldstart,
     "longctx": section_longctx,
     "flash_bwd": section_flash_bwd,
     "checkpoint": section_checkpoint,
@@ -2240,6 +2439,10 @@ SECTION_TIMEOUT_S = {
     # on top of the parent's in-proc reference compile — spawn +
     # handshake + per-child compile, same many-compiles budget
     "serve_fleet_transport": 1500,
+    # the COLD leg deliberately compiles the whole step family inside
+    # its timed window against a fresh cache dir, then the autoscale
+    # leg compiles replicas× more to populate — same budget
+    "serve_coldstart": 1500,
     "longctx": 600,
     "flash_bwd": 600,
     # host-side I/O only (no XLA programs beyond init), but the flagship
@@ -2712,6 +2915,30 @@ def main() -> None:
                 "policy consumed the bounds, deterministically) and "
                 "the warm-join determinism keys; the tail RELIEF is "
                 "chip-scale, where decode time dwarfs bring-up.")
+        if "serve_join_first_token_warm_vs_cold" in merged:
+            expectations["serve_join_first_token_warm_vs_cold"] = (
+                "portable: jit tracing + XLA compilation dominate the "
+                "cold window on EVERY backend, so warm > cold holds on "
+                "CPU too (observed ~5x at tiny shapes). The CPU "
+                "backend supports executable serialization, so hits "
+                "deserialize rather than re-lower; on chip the same "
+                "hits skip 20-40 s compiles and the ratio grows with "
+                "program count. The determinism keys (bitmatch, hit/"
+                "miss counts, registered) replay exactly; the "
+                "millisecond values are wall clocks and do not.")
+        if "serve_fleet_autoscale_p99_warm" in merged:
+            expectations["serve_fleet_autoscale_p99_warm"] = (
+                "tiny CPU shapes: the warmed-join p99 still includes "
+                "host dispatch and pipe queueing, so compare it to "
+                "serve_fleet_autoscale_p99_under_spike (the unwarmed "
+                "twin in section_serve_fleet) directionally, not as a "
+                "gate — off-chip a joiner's bring-up is ms-scale "
+                "either way once the XLA cache banks. The portable "
+                "signals are warm_compiles == bring-ups (every join "
+                "warmed, deterministically) and the empty "
+                "warm_compile_errors list; the tail RELIEF is chip-"
+                "scale, where a cold joiner pays real compiles inside "
+                "the spike window.")
         if "serve_paged_kernel_vs_gather" in merged:
             expectations["serve_paged_kernel_vs_gather"] = (
                 "pallas interpret mode: the kernel side emulates the "

@@ -46,6 +46,13 @@ from .serving import (  # noqa: F401
     make_serve_engine,
     serve,
 )
+from .aotcache import (  # noqa: F401
+    AotCacheCorruptError,
+    AotCompileCache,
+    describe_avals,
+    engine_fingerprint,
+    warm_engine,
+)
 from .fleet import (  # noqa: F401
     AutoscalePolicy,
     FleetWorkerHung,
@@ -66,8 +73,10 @@ from .transport import (  # noqa: F401
 )
 from .hostkv import (  # noqa: F401
     HostBlockPool,
+    HostParamSnapshot,
     HostSpillCorruptError,
     IndexSpill,
+    SnapshotCorruptError,
     WarmChainStore,
 )
 from .speculative import (  # noqa: F401

@@ -1,0 +1,454 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""Persistent ahead-of-time compile cache for the serve engine — the
+cold-start half of second-scale elastic joins.
+
+PR 15/18 made elastic joins KV-warm (``WarmChainStore`` seeds the
+joiner's prefix working set), but a joiner still paid full jit
+tracing + XLA compilation for the engine's ENTIRE step family —
+admission buckets, chunk stream, lazy growth, the all-slots wave step,
+the speculative multi-step, and the paged-block handoff jits — and at
+spike time that compile wall is exactly the scale-up latency that
+decides ``serve_fleet_autoscale_p99_under_spike`` (ROADMAP item 4;
+*Automatic Full Compilation … to Cloud TPUs* is the whole-program-AOT
+direction this follows).
+
+This module closes it in three composed stages, all driven by
+:func:`warm_engine` at fleet start / replica bring-up:
+
+1. **AOT store** — every step jit the engine owns is enumerable via
+   ``engine.aot_registrations()`` as ``(name, fn, abstract args)``;
+   :func:`warm_engine` drives ``fn.lower(*args).compile()`` for the
+   whole family and records one crc-framed entry per registration in
+   an :class:`AotCompileCache`. Where the backend supports executable
+   serialization (``jax.experimental.serialize_executable``) the
+   compiled binary rides in the entry (``mode="serialized"``) and is
+   deserialize-VALIDATED on every later hit; where it does not, the
+   entry degrades to ``mode="traceonly"`` — the compile still happened
+   against the activated persistent XLA cache below, so later
+   bring-ups skip the XLA work even though the entry itself carries no
+   binary.
+2. **Persistent XLA cache** — :meth:`AotCompileCache.activate` points
+   ``jax_compilation_cache_dir`` at ``<cache_dir>/xla`` (thresholds
+   zeroed) so every compile — AOT-stage or call-path — lands on disk
+   and every later identical compile is a disk hit. This is what makes
+   the warm join fast ACROSS PROCESSES: a fleet child activates the
+   shared directory and its call-path compiles disk-hit the donor's.
+3. **Priming** — ``jax.jit(...).lower().compile()`` does NOT populate
+   the jit call-path cache (measured: a direct call after AOT compile
+   re-traces), so :func:`warm_engine` finishes by driving a tiny
+   seeded synthetic schedule through the engine's real ``run()``
+   (``engine.aot_prime``). Priming is the authoritative call-path
+   warm; with stage 2 active its compiles are disk hits, so it costs
+   trace time, not XLA time.
+
+Integrity is the checkpoint/hostkv crc discipline applied to compiled
+executables: every entry is ``GAC1``-framed with a crc32 over the
+pickled body AND stores its full un-hashed key — a corrupt, truncated,
+or stale (hash-collision / schema-drift) entry is QUARANTINED into
+``<cache_dir>/quarantine/`` and recompiled, never silently served
+(:class:`AotCacheCorruptError` classifies the failure for callers that
+probe directly). Keys hash an :func:`engine_fingerprint` covering the
+jax version, backend + device kind/count, mesh axes, model config,
+and every engine lever, plus the per-registration abstract signature
+(treedef + per-leaf ``dtype[shape]``) — differing levers, meshes, or
+dtypes can never share an executable. Writes are atomic
+(tmp + ``os.replace``), so concurrent warmers race only to duplicate
+identical bytes, harmlessly.
+
+Telemetry: ``aot_cache_hit_total`` / ``aot_cache_miss_total`` counters
+per registration probe, the ``engine_warmup_ms`` gauge on every
+:func:`warm_engine`, and ``join_first_token_ms`` set by the engine on
+the first prefill of a run (``models/serving.py``) — the gauge the
+cold-start bench legs and the fleet's ``warm_compile=`` span arg are
+read against.
+
+``tests/test_aotcache.py`` pins key separation per lever/mesh/dtype,
+the corrupt/truncated → quarantine + recompile path, the warmed ==
+unwarmed bit-match, and concurrent-warmer safety;
+``bench.py --section serve_coldstart`` carries the wall-clock gate
+(``serve_join_first_token_warm_vs_cold`` strictly > 1).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import struct
+from typing import Any
+
+import jax
+
+_MAGIC = b"GAC1"
+_HEADER = struct.Struct(">II")          # (len(body), crc32(body))
+_SUFFIX = ".gac"
+
+
+class AotCacheCorruptError(RuntimeError):
+    """A cache entry failed its magic / crc / key check — a CLASSIFIED
+    integrity failure (like ``HostSpillCorruptError``): the entry is
+    quarantined and the caller recompiles from source, never loads the
+    corrupt executable."""
+
+
+def _crc32(data: bytes) -> int:
+    import zlib
+
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def describe_avals(args: Any) -> str:
+    """Deterministic abstract signature of a registration's arguments:
+    the pytree structure plus per-leaf ``dtype[shape]`` (non-array
+    leaves — static strings, ints — by ``repr``). Two registrations
+    whose signatures differ can never share an entry, which is what
+    keeps a dtype or geometry change from serving a stale executable."""
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    parts = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            dims = "x".join(str(int(d)) for d in shape)
+            parts.append(f"{dtype}[{dims}]")
+        else:
+            parts.append(repr(leaf))
+    return f"{treedef}|{';'.join(parts)}"
+
+
+def engine_fingerprint(cfg, max_len: int, levers: dict, *,
+                       mesh=None) -> str:
+    """The cache SCOPE: everything outside a single registration that
+    may change generated code — jax version, backend platform + device
+    kind and count, mesh axes, the model config, and every engine
+    lever (sorted; values must be primitives — ``models/serving.py``
+    sanitises callables to qualnames before calling this, because a
+    ``repr`` carrying a memory address would split the key across
+    processes)."""
+    devs = jax.devices()
+    dev_desc = (f"{devs[0].platform}:"
+                f"{getattr(devs[0], 'device_kind', '?')}x{len(devs)}")
+    if mesh is None:
+        mesh_desc = "none"
+    else:
+        shape = getattr(mesh, "shape", None)
+        mesh_desc = (",".join(f"{k}={v}" for k, v in shape.items())
+                     if isinstance(shape, dict) else repr(shape))
+    lever_desc = ",".join(f"{k}={levers[k]!r}" for k in sorted(levers))
+    return (f"gac1|jax={jax.__version__}|dev={dev_desc}"
+            f"|mesh={mesh_desc}|cfg={cfg!r}|max_len={max_len}"
+            f"|{lever_desc}")
+
+
+def _serializer():
+    """The executable (de)serialization backend, or None where jax
+    does not ship it — callers degrade to trace-only entries."""
+    try:
+        from jax.experimental import serialize_executable
+    except ImportError:
+        return None
+    return serialize_executable
+
+
+class AotCompileCache:
+    """One directory of crc-framed compile entries + the activated
+    persistent XLA cache underneath it (``<path>/xla``).
+
+    Entry file format: ``GAC1`` magic, big-endian ``(len, crc32)``
+    header, pickled body ``{"key", "mode", "payload"}`` where ``key``
+    is the FULL un-hashed key (stale/collision detection), ``mode`` is
+    ``"serialized" | "traceonly"``, and ``payload`` is the
+    ``serialize_executable.serialize`` triple or None. File names are
+    the first 24 hex chars of sha256(key).
+
+    The cache object is picklable (it carries only its path), so a
+    multi-process fleet ships it to children through ``engine_kw`` and
+    every replica shares one on-disk store.
+    """
+
+    def __init__(self, path: str, *, telemetry=None):
+        self.path = str(path)
+        self._telemetry = telemetry
+        self._active: dict | None = None
+        self._seq = 0
+        os.makedirs(self.path, exist_ok=True)
+        os.makedirs(self.quarantine_dir, exist_ok=True)
+        os.makedirs(self.xla_dir, exist_ok=True)
+
+    # picklability: drop the registry handle and runtime activation
+    # state — a child re-activates against its own jax config
+    def __getstate__(self):
+        return {"path": self.path}
+
+    def __setstate__(self, state):
+        self.__init__(state["path"])
+
+    @property
+    def xla_dir(self) -> str:
+        return os.path.join(self.path, "xla")
+
+    @property
+    def quarantine_dir(self) -> str:
+        return os.path.join(self.path, "quarantine")
+
+    # ---- keys -------------------------------------------------------
+    def entry_key(self, scope: str, name: str, args: Any) -> str:
+        """Full key for one registration: the engine scope + the jit's
+        name + the abstract signature of its arguments."""
+        return f"{scope}::{name}::{describe_avals(args)}"
+
+    def _entry_path(self, key: str) -> str:
+        digest = hashlib.sha256(key.encode()).hexdigest()[:24]
+        return os.path.join(self.path, digest + _SUFFIX)
+
+    # ---- entries ----------------------------------------------------
+    def probe(self, key: str):
+        """Return the entry body dict for ``key`` or None. Any
+        integrity failure — bad magic, short read, crc mismatch,
+        unpicklable body, or a stored key that is not ``key`` (hash
+        collision / fingerprint drift) — QUARANTINES the file and
+        returns None so the caller recompiles."""
+        path = self._entry_path(key)
+        try:
+            with open(path, "rb") as fh:
+                raw = fh.read()
+        except FileNotFoundError:
+            return None
+        try:
+            body = self._decode(raw, key)
+        except AotCacheCorruptError as exc:
+            self.quarantine(path, str(exc))
+            return None
+        return body
+
+    def _decode(self, raw: bytes, key: str) -> dict:
+        if raw[:4] != _MAGIC:
+            raise AotCacheCorruptError(
+                f"bad magic {raw[:4]!r} (want {_MAGIC!r})")
+        if len(raw) < 4 + _HEADER.size:
+            raise AotCacheCorruptError(
+                f"truncated header ({len(raw)} bytes)")
+        length, crc = _HEADER.unpack_from(raw, 4)
+        body_raw = raw[4 + _HEADER.size:]
+        if len(body_raw) != length:
+            raise AotCacheCorruptError(
+                f"truncated body ({len(body_raw)} of {length} bytes)")
+        if _crc32(body_raw) != crc:
+            raise AotCacheCorruptError(
+                f"crc mismatch ({_crc32(body_raw):#010x} != {crc:#010x})")
+        try:
+            body = pickle.loads(body_raw)
+        except Exception as exc:  # noqa: BLE001 — classified below
+            raise AotCacheCorruptError(
+                f"body unpicklable: {exc!r}") from exc
+        if not isinstance(body, dict) or body.get("key") != key:
+            raise AotCacheCorruptError(
+                f"stale entry: stored key {str(body.get('key'))[:80]!r}… "
+                "does not match probe key")
+        return body
+
+    def store(self, key: str, mode: str, payload) -> str:
+        """Atomically write one entry; returns the mode actually
+        stored (a payload that refuses to pickle degrades the entry to
+        trace-only rather than failing the warm)."""
+        body = {"key": key, "mode": mode, "payload": payload}
+        try:
+            body_raw = pickle.dumps(body,
+                                    protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception as exc:  # noqa: BLE001 — degrade, don't fail
+            body = {"key": key, "mode": "traceonly", "payload": None,
+                    "degraded": repr(exc)}
+            body_raw = pickle.dumps(body,
+                                    protocol=pickle.HIGHEST_PROTOCOL)
+        path = self._entry_path(key)
+        tmp = f"{path}.tmp.{os.getpid()}.{self._seq}"
+        self._seq += 1
+        with open(tmp, "wb") as fh:
+            fh.write(_MAGIC)
+            fh.write(_HEADER.pack(len(body_raw), _crc32(body_raw)))
+            fh.write(body_raw)
+        os.replace(tmp, path)          # atomic: racers duplicate bytes
+        return body["mode"]
+
+    def quarantine(self, path: str, reason: str) -> None:
+        """Move a corrupt/stale entry aside (never delete — the bytes
+        are the postmortem) and remember why."""
+        dest = os.path.join(self.quarantine_dir, os.path.basename(path))
+        try:
+            os.replace(path, dest)
+        except FileNotFoundError:
+            pass                       # a racer already moved it
+        self.quarantine_reasons.append(reason)
+
+    def quarantine_key(self, key: str, reason: str) -> None:
+        self.quarantine(self._entry_path(key), reason)
+
+    @property
+    def quarantine_reasons(self) -> list:
+        reasons = getattr(self, "_quarantine_reasons", None)
+        if reasons is None:
+            reasons = self._quarantine_reasons = []
+        return reasons
+
+    # ---- persistent XLA cache --------------------------------------
+    def activate(self) -> None:
+        """Point jax's persistent compilation cache at ``<path>/xla``
+        (thresholds zeroed so every compile lands) and reset the
+        in-memory handle so the switch takes effect immediately.
+        Idempotent; :meth:`deactivate` restores the previous config."""
+        if self._active is not None:
+            return
+        keys = ("jax_compilation_cache_dir",
+                "jax_persistent_cache_min_compile_time_secs",
+                "jax_persistent_cache_min_entry_size_bytes")
+        prev = {k: getattr(jax.config, k) for k in keys}
+        jax.config.update("jax_compilation_cache_dir", self.xla_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        _reset_xla_cache()
+        self._active = prev
+
+    def deactivate(self) -> None:
+        if self._active is None:
+            return
+        for k, v in self._active.items():
+            jax.config.update(k, v)
+        self._active = None
+        _reset_xla_cache()
+
+    # ---- inspection -------------------------------------------------
+    def entries(self) -> list:
+        return sorted(f for f in os.listdir(self.path)
+                      if f.endswith(_SUFFIX))
+
+    def stats(self) -> dict:
+        return {
+            "path": self.path,
+            "entries": len(self.entries()),
+            "quarantined": len([f for f in
+                                os.listdir(self.quarantine_dir)
+                                if f.endswith(_SUFFIX)]),
+            "active": self._active is not None,
+        }
+
+
+def _reset_xla_cache() -> None:
+    # private jax surface, version-guarded: a missing reset just means
+    # the new directory applies to compiles after the next process
+    # start instead of immediately
+    try:
+        from jax._src import compilation_cache as _cc
+    except ImportError:
+        return
+    reset = getattr(_cc, "reset_cache", None)
+    if reset is not None:
+        reset()
+
+
+def warm_engine(engine, cache: AotCompileCache | None = None, *,
+                slots: int = 2, kv_blocks: int | None = None,
+                prompt_lens=(), n_new: int = 2, prime: bool = True,
+                telemetry=None) -> dict:
+    """Warm a serve engine's whole step family against ``cache``.
+
+    Stages (see the module docstring): probe-or-compile every
+    registration into the AOT store (hits validated by deserialize
+    where serialized), with the persistent XLA cache ACTIVATED so all
+    compiles land on disk; then prime the jit call path by driving a
+    seeded synthetic schedule through the engine's real ``run()``
+    (``prime=False`` skips it — the bring-up paths that run a real
+    schedule immediately afterwards warm themselves).
+
+    ``slots`` / ``kv_blocks`` / ``prompt_lens`` must match the
+    geometry the engine will serve (each prompt length is its own
+    admission compile — there is no length bucketing). Returns a stats
+    dict (``registered/hits/misses/serialized/traceonly/demoted/
+    quarantined/primed/errors/warm_ms``); a compile that fails to
+    lower (aval
+    drift) is recorded in ``errors`` and degrades gracefully — priming
+    still covers the call path. A total no-op returning
+    ``{"enabled": False}`` when the engine has no cache, so unwarmed
+    runs stay byte-identical."""
+    from ..telemetry import get_registry
+
+    reg = telemetry if telemetry is not None else get_registry()
+    clk0 = reg.clock()
+    if cache is None:
+        cache = getattr(engine, "aot_cache", None)
+    stats: dict[str, Any] = {
+        "enabled": cache is not None, "registered": 0, "hits": 0,
+        "misses": 0, "serialized": 0, "traceonly": 0,
+        "demoted": 0, "quarantined": 0, "primed": 0, "errors": [],
+    }
+    if cache is None:
+        return stats
+    cache.activate()
+    q0 = len(cache.quarantine_reasons)
+    se = _serializer()
+    scope = engine.aot_scope
+    c_hit = reg.counter("aot_cache_hit_total")
+    c_miss = reg.counter("aot_cache_miss_total")
+    regs = engine.aot_registrations(slots=slots, kv_blocks=kv_blocks,
+                                    prompt_lens=tuple(prompt_lens),
+                                    n_new=n_new)
+    for name, fn, args in regs:
+        stats["registered"] += 1
+        key = cache.entry_key(scope, name, args)
+        entry = cache.probe(key)
+        demote = False
+        if entry is not None and entry["mode"] == "serialized":
+            if se is None:
+                entry = None           # can't validate — recompile
+                demote = True
+                cache.quarantine_key(
+                    key, "serialized entry on a backend without "
+                    "serialize_executable")
+            else:
+                try:
+                    se.deserialize_and_load(*entry["payload"])
+                except Exception as exc:  # noqa: BLE001 — classified
+                    # a deserialize that fails once fails every
+                    # bring-up (e.g. XLA:CPU executables referencing
+                    # jit-compiled fusion symbols that do not survive
+                    # reload) — DEMOTE the recompile to trace-only so
+                    # the entry converges instead of quarantining
+                    # forever; the activated XLA disk cache still
+                    # banks the compile itself
+                    cache.quarantine_key(
+                        key, f"deserialize failed: {exc!r}")
+                    entry = None
+                    demote = True
+        if entry is not None:
+            stats["hits"] += 1
+            c_hit.inc()
+            continue
+        stats["misses"] += 1
+        c_miss.inc()
+        try:
+            compiled = fn.lower(*args).compile()
+        except Exception as exc:  # noqa: BLE001 — aval drift degrades
+            stats["errors"].append(f"{name}: {exc!r}")
+            continue
+        mode, payload = "traceonly", None
+        if se is not None and not demote:
+            try:
+                payload = se.serialize(compiled)
+                mode = "serialized"
+            except Exception as exc:  # noqa: BLE001 — backend limit
+                stats["errors"].append(
+                    f"{name}: serialize unsupported: {exc!r}")
+                payload = None
+        stored = cache.store(key, mode, payload)
+        stats[stored] += 1
+        if demote:
+            stats["demoted"] += 1
+    if prime:
+        stats["primed"] = int(engine.aot_prime(
+            slots=slots, kv_blocks=kv_blocks,
+            prompt_lens=tuple(prompt_lens), n_new=n_new))
+    stats["quarantined"] = len(cache.quarantine_reasons) - q0
+    warm_ms = round((reg.clock() - clk0) * 1e3, 3)
+    stats["warm_ms"] = warm_ms
+    reg.gauge("engine_warmup_ms").set(warm_ms)
+    return stats

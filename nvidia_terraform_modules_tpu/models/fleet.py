@@ -964,6 +964,17 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
     default the process registry) is shared with every engine, so
     ``fleet_route`` spans and the engines' serve spans land on ONE
     timeline.
+
+    Passing ``aot_cache=<dir>`` (an engine lever) additionally arms
+    COLD-START ANNIHILATION (``models/aotcache.py``): every replica
+    bring-up — base replica at fleet start, elastic joiner at its poll
+    boundary — AOT-warms the engine's whole step family against the
+    call's schedule shape through ``Transport.warm_replica`` before
+    its first wave (cache-hit executables deserialize in milliseconds;
+    misses compile once and persist for the NEXT joiner). The
+    ``fleet_scale`` span gains ``warm_compile=`` and the scale ledger
+    counts ``warm_compiles`` / ``warm_compile_errors``; a warm failure
+    is classified there and the replica launches cold, never dead.
     """
     if replicas < 1:
         raise ValueError(f"replicas must be >= 1, got {replicas}")
@@ -1478,6 +1489,39 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
         run_kw = dict(prompts=prompts, budgets=budgets, slots=slots,
                       eos_id=eos_id, rng=rng, kv_blocks=kv_blocks)
 
+        # the cold-start annihilation hook (models/aotcache.py): when
+        # the engines carry an ``aot_cache``, every bring-up — base
+        # replica at fleet start, elastic joiner at its poll boundary
+        # — AOT-warms the step family against THIS call's schedule
+        # shape before its first wave, so a warm join is cached
+        # executables + streamed weights + seeded warm chains. Warm
+        # is advisory: a warm failure is classified into the scale
+        # ledger and the replica launches cold, never dead.
+        aot_on = engine_kw.get("aot_cache") is not None
+        warm_kw = dict(
+            slots=slots, kv_blocks=kv_blocks,
+            prompt_lens=tuple(sorted({len(p) for p in prompts})),
+            n_new=max(budgets) if budgets else 2)
+        warm_compiles = [0]
+        warm_compile_errors: list[str] = []
+
+        def _warm_compile(i):
+            if not aot_on:
+                return False
+            try:
+                info = tr.warm_replica(i, warm_kw)
+            except Exception as exc:     # noqa: BLE001 — classified
+                warm_compile_errors.append(
+                    f"{type(exc).__name__}: {exc}")
+                return False
+            if info.get("error"):
+                warm_compile_errors.append(str(info["error"]))
+                return False
+            if info.get("registered"):
+                warm_compiles[0] += 1
+                return True
+            return False
+
         def _on_dec_error(label, exc):
             errors.append((label, exc))
             _abort_all()
@@ -1574,6 +1618,7 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
         # like fault kills)
         dec_handles: list[Any] = [None] * n_dec_run
         for i in range(n_dec):
+            _warm_compile(i)             # no-op without an aot_cache
             dec_handles[i] = tr.launch_decode(
                 i, dec_queues[i], run_kw, on_error=_on_dec_error)
         spawned: set[int] = set(range(n_dec))
@@ -1660,6 +1705,7 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                 warm_chains_primed[0] += len(chains)
             else:
                 cold_joins[0] += 1
+            warm_compiled = _warm_compile(i)
             dec_handles[i] = tr.launch_decode(
                 i, dec_queues[i], run_kw, on_error=_on_dec_error)
             spawned.add(i)
@@ -1671,6 +1717,7 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                               clk0 if clk0 is not None else tc, tc,
                               kind="up", replica=q.label,
                               trigger=trigger, warm=bool(chains),
+                              warm_compile=warm_compiled,
                               transport=tr.name)
             _set_size()
 
@@ -2231,6 +2278,8 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                     "warm_joins": warm_joins[0],
                     "cold_joins": cold_joins[0],
                     "warm_chains_primed": warm_chains_primed[0],
+                    "warm_compiles": warm_compiles[0],
+                    "warm_compile_errors": list(warm_compile_errors),
                     "spawn_retries": spawn_retries[0],
                     "spawn_failures": spawn_failures[0],
                     "scaled_down": sorted(scaled_down_labels),

@@ -409,6 +409,22 @@ def _request_key(rng, req, pos):
     return jax.random.fold_in(jax.random.fold_in(rng, req), pos)
 
 
+def _sampler_fingerprint(sampler) -> str:
+    """Deterministic sampler description for the AOT cache scope
+    (``models/aotcache.py``): None / a spec dict / a callable's
+    qualname — never a callable's ``repr``, whose memory address would
+    split the cache key across processes. Two DIFFERENT callables with
+    one qualname alias under this; the admission avals still separate
+    greedy from sampled, and priming recompiles anything stale."""
+    if sampler is None:
+        return "none"
+    if isinstance(sampler, dict):
+        return ("spec("
+                + ",".join(f"{k}={sampler[k]!r}" for k in sorted(sampler))
+                + ")")
+    return getattr(sampler, "__qualname__", type(sampler).__name__)
+
+
 def _make_pick(sampler):
     """The greedy-vs-sampled token pick shared by every admission path:
     ``pick(logits [1, T, V], idx, key) → token`` — argmax at ``idx``
@@ -469,8 +485,11 @@ def make_serve_step(params, cfg: BurnInConfig, sampler=None, *,
                                          paged_kernel=paged_kernel)
             return jnp.argmax(logits[:, -1], axis=-1), pool
 
-        return lambda tokens, active, pool: step(params, tokens, active,
-                                                 pool)
+        def wave(tokens, active, pool):
+            return step(params, tokens, active, pool)
+
+        wave._aot = step               # the inner jit, for AOT warming
+        return wave
 
     @functools.partial(jax.jit, donate_argnums=(6,))
     def sampled_step(p, tokens, active, req_ids, positions, rng, pool):
@@ -488,8 +507,12 @@ def make_serve_step(params, cfg: BurnInConfig, sampler=None, *,
             logits[:, -1], keys)
         return toks, pool
 
-    return lambda tokens, active, req_ids, positions, rng, pool: \
-        sampled_step(params, tokens, active, req_ids, positions, rng, pool)
+    def wave(tokens, active, req_ids, positions, rng, pool):
+        return sampled_step(params, tokens, active, req_ids, positions,
+                            rng, pool)
+
+    wave._aot = sampled_step           # the inner jit, for AOT warming
+    return wave
 
 
 def make_spec_step(params, cfg: BurnInConfig, k: int, *,
@@ -624,9 +647,13 @@ def make_spec_step(params, cfg: BurnInConfig, k: int, *,
             cond, body, s)
         return ctx, cur, n_out, fin, steps, blocked_of(pool, fin), pool
 
-    return lambda ctx, cur, n_out, n_new, eos_id, active, stop_count, \
-        granted_rows, pool: step(params, ctx, cur, n_out, n_new, eos_id,
-                                 active, stop_count, granted_rows, pool)
+    def wave(ctx, cur, n_out, n_new, eos_id, active, stop_count,
+             granted_rows, pool):
+        return step(params, ctx, cur, n_out, n_new, eos_id, active,
+                    stop_count, granted_rows, pool)
+
+    wave._aot = step                   # the inner jit, for AOT warming
+    return wave
 
 
 def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
@@ -641,7 +668,8 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                       paged_kernel: str = "auto",
                       host_spill: bool = False,
                       host_blocks: int | None = None,
-                      host_swap: str = "async"):
+                      host_swap: str = "async",
+                      aot_cache=None):
     """Reusable engine: compile once, run many schedules.
 
     The compiled pieces (per-bucket admissions, the all-slots paged
@@ -787,7 +815,29 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
     exceed the emitted output by the lag window when a count-cap
     retirement precedes the scan that would have seen an earlier eos
     (``run.last_stats["generated"]`` reports emitted tokens exactly).
+
+    ``aot_cache`` (a directory path or a
+    :class:`..aotcache.AotCompileCache`) plugs the engine into the
+    PERSISTENT AOT compile cache (``models/aotcache.py``): build
+    activates jax's on-disk XLA cache under it (sticky — every compile
+    this process makes lands on / loads from disk), and the returned
+    engine grows a warm surface — ``run.warm(slots=, kv_blocks=,
+    prompt_lens=)`` probes-or-compiles the WHOLE step family into
+    crc-framed cache entries and primes the jit call path with a tiny
+    seeded synthetic schedule, so a fleet joiner's bring-up pays disk
+    reads and trace time instead of XLA compile walls
+    (``engine_warmup_ms`` / ``join_first_token_ms`` gauges,
+    ``aot_cache_hit_total`` / ``aot_cache_miss_total`` counters).
+    Warming never changes output: a primed engine's runs are
+    byte-identical to an unprimed engine's (the priming run leaves no
+    cross-run state), and ``aot_cache=None`` engines are exactly the
+    pre-cache engine.
     """
+    # the AOT fingerprint reads the sampler BEFORE normalisation: a
+    # spec dict describes itself deterministically on every side of a
+    # process boundary, where the callable it builds would repr with a
+    # memory address and split the cache key per process
+    sampler_fp = _sampler_fingerprint(sampler)
     if isinstance(sampler, dict):
         # a sampler SPEC (dict(temperature=, top_k=, top_p=)) instead
         # of a callable: normalise through make_sampler here so the
@@ -839,6 +889,18 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
     from ..telemetry import get_registry
 
     reg = telemetry if telemetry is not None else get_registry()
+    # persistent AOT compile cache (models/aotcache.py): a string is a
+    # directory path — the form the multi-process transport ships,
+    # since the object pickles down to its path anyway. Activation is
+    # STICKY by design: a fleet child points jax's persistent XLA
+    # cache at the shared directory once at build, so every compile —
+    # warm-stage or call-path — lands on / loads from disk.
+    if isinstance(aot_cache, str):
+        from .aotcache import AotCompileCache
+
+        aot_cache = AotCompileCache(aot_cache, telemetry=reg)
+    if aot_cache is not None:
+        aot_cache.activate()
     pick = _make_pick(sampler)
     from .quantize import QTensor
 
@@ -889,6 +951,22 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 f"({max_len})")
         prefix_full_blocks = prefix_len // bs
         prefix_tail_rows = prefix_len % bs
+
+    # the AOT cache SCOPE: jax/backend/devices + cfg + every lever
+    # that changes generated code (models/aotcache.py). Computed even
+    # without an aot_cache so ``warm_engine(engine, cache)`` can warm
+    # an engine built before the cache existed.
+    from .aotcache import engine_fingerprint
+
+    aot_scope = engine_fingerprint(cfg, max_len, dict(
+        cache_dtype=cache_dtype, sampler=sampler_fp,
+        prefill_chunk=prefill_chunk, spec_k=spec_k, kv_block=kv_block,
+        policy=policy, aging=aging, share_prefix=share_prefix,
+        lazy_growth=lazy_growth, prefix_keep_blocks=prefix_keep_blocks,
+        paged_kernel=paged_kernel, host_spill=host_spill,
+        host_blocks=host_blocks, host_swap=host_swap,
+        prefix_len=prefix_len,
+        quant_weights=prefill_params is not params))
 
     # ---------------------------------------------------------- jits
     # shared helpers for the one-row (per-slot) view of the pool
@@ -1682,6 +1760,15 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         admission dispatch (None when telemetry is disabled)."""
         if reg.enabled:
             t1 = reg.clock()
+            if not getattr(run, "_join_noted", True):
+                # join→first-token: run() entry to the END of the
+                # run's FIRST admission dispatch — the cold-start
+                # gauge the warm-vs-cold bench legs and the fleet's
+                # ``warm_compile=`` span arg are read against (a
+                # joiner's first run() starts right after bring-up)
+                run._join_noted = True
+                reg.gauge("join_first_token_ms").set(
+                    round((t1 - run._join_clk0) * 1e3, 3))
             meta[req]["prefill_ms"] += round((t1 - start_clk) * 1e3, 3)
             args = {"prompt_len": prompt_len}
             if chunks is not None:
@@ -2143,6 +2230,10 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         # reset on entry: a failed run must not leave a prior run's
         # stats for an error-catching caller to misattribute
         run.last_stats = None
+        # join→first-token clock: armed here, fired by the run's first
+        # _note_prefill (telemetry only — None keeps the hook dead)
+        run._join_clk0 = reg.clock() if reg.enabled else None
+        run._join_noted = not reg.enabled
         if admission is not None:
             # an injected AdmissionSource OWNS order, timing and the
             # kv-import decision — the knobs that overlap it must be
@@ -2837,8 +2928,185 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 "(decode-side engines may still spill)")
         return _PrefillSession(kv_blocks)
 
+    # ------------------------------------------------ AOT warm surface
+    # (models/aotcache.py): the engine's step family, enumerable as
+    # (name, jit, abstract args) so warm_engine can compile the WHOLE
+    # family ahead of the first request and a fleet joiner pays disk
+    # reads instead of XLA walls. Everything below is inert unless
+    # something calls it — an unwarmed engine is the pre-cache engine.
+
+    def _tree_aval(tree):
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
+
+    def _pool_aval(slots_: int, kv_blocks_):
+        """Abstract pool matching ``_Run``'s geometry for ``slots_`` —
+        the default block count mirrors ``_Run.__init__``'s full
+        provisioning exactly, so a warm against the serving geometry
+        compiles the serving programs."""
+        need_prefix = (prefix_full_blocks
+                       + (1 if prefix_tail_rows else 0))
+        nb = (1 + need_prefix + slots_ * nt
+              if kv_blocks_ is None else int(kv_blocks_))
+        aval = jax.ShapeDtypeStruct
+        kv_shape = (nb, bs, cfg.kv_heads, cfg.head_dim)
+        buf_dtype = jnp.int8 if quant else cfg.dtype
+        pool = {
+            "k": [aval(kv_shape, buf_dtype)] * cfg.n_layers,
+            "v": [aval(kv_shape, buf_dtype)] * cfg.n_layers,
+            "block_tables": aval((slots_, nt), jnp.int32),
+            "pos": aval((slots_,), jnp.int32),
+        }
+        if quant:
+            pool["k_scale"] = [aval(kv_shape[:3],
+                                    jnp.float32)] * cfg.n_layers
+            pool["v_scale"] = [aval(kv_shape[:3],
+                                    jnp.float32)] * cfg.n_layers
+        return pool
+
+    def aot_registrations(*, slots: int = 4, kv_blocks=None,
+                          prompt_lens=(), n_new: int = 2):
+        """The engine's enumerable step family for the given serving
+        geometry. Each prompt length is its OWN admission compile
+        (there is no length bucketing), so ``prompt_lens`` should be
+        the lengths the schedule will actually admit. Registrations
+        cover the default (rules=None) steps — mesh-sharded runs
+        compile per rules object and warm through priming instead.
+        Admissions are registered at full length (a cross-request
+        prefix hit admits a shorter suffix — that variant warms on
+        first use; degradation here is one extra compile, never a
+        wrong executable)."""
+        del n_new                   # a runtime value, not a compile axis
+        from .decode import _select_prefill_impl
+
+        aval = jax.ShapeDtypeStruct
+        pool = _pool_aval(slots, kv_blocks)
+        p_pre = _tree_aval(prefill_params)
+        p_dec = _tree_aval(params)
+        i32 = aval((), jnp.int32)
+        key_av = aval((2,), jnp.uint32)
+        row_av = aval((nt,), jnp.int32)
+        tail_av = aval((2,), jnp.int32)
+        bool_s = aval((slots,), jnp.bool_)
+        i32_s = aval((slots,), jnp.int32)
+        lens = sorted({int(x) for x in prompt_lens})
+        regs = []
+        if prefill_chunk is None:
+            for length in lens:
+                impl = ("cached" if prefix is not None else
+                        _select_prefill_impl(cfg, length, "auto"))
+                regs.append((
+                    f"admit_full_L{length}", _admit_full,
+                    (p_pre, aval((1, length), jnp.int32), impl, i32,
+                     row_av, key_av, tail_av, i32, pool)))
+        else:
+            c = prefill_chunk
+            regs.append(("admit_table", _admit_table,
+                         (i32, row_av, tail_av, i32, pool)))
+            if spec_k is None:
+                regs.append(("chunk_step", _chunk_step,
+                             (p_pre, aval((1, c), jnp.int32), i32,
+                              pool)))
+                regs.append(("chunk_finish", _chunk_finish,
+                             (aval((c, cfg.vocab), cfg.dtype), i32,
+                              key_av, i32, pool, i32)))
+            else:
+                mc = max(1, (max_len - prefix_len) // c)
+                regs.append(("chunk_sweep", _chunk_sweep,
+                             (p_pre, aval((1, mc, c), jnp.int32), i32,
+                              i32, pool, i32, key_av, i32)))
+        if lazy_growth:
+            regs.append(("grow_table", _grow_table,
+                         (i32, i32, i32, pool)))
+        if prefix is not None:
+            regs.append(("prefix_fill", _prefix_fill,
+                         (p_pre, aval((1, prefix_len), jnp.int32),
+                          row_av, pool)))
+        if spec_k is not None:
+            ctx_av = aval((slots, max_len + spec_k + 1), jnp.int32)
+            for length in lens:
+                regs.append((
+                    f"spec_admit_row_L{length}", _spec_admit_row,
+                    (aval((length,), jnp.int32), i32, i32, ctx_av,
+                     i32_s, i32_s)))
+            spec_step = step_for("spec", cache_dtype != "int8", None)
+            regs.append(("spec_step", spec_step._aot,
+                         (p_dec, ctx_av, i32_s, i32_s, i32_s, i32,
+                          bool_s, i32, i32_s, pool)))
+        else:
+            step = step_for("plain", cache_dtype != "int8", None)
+            if sampler is None:
+                regs.append(("wave_step", step._aot,
+                             (p_dec, i32_s, bool_s, pool)))
+            else:
+                regs.append(("wave_step_sampled", step._aot,
+                             (p_dec, i32_s, bool_s, i32_s, i32_s,
+                              key_av, pool)))
+        # the fleet handoff pair (paging._xfer_jits): the crc-stamped
+        # prefill→decode block transfer, per distinct block count the
+        # given prompt lengths export
+        if lens:
+            from .paging import _xfer_jits
+
+            xfer_keys = ("k", "v") + (("k_scale", "v_scale")
+                                      if quant else ())
+            for nxf in sorted({blocks_for_rows(x, bs) for x in lens}):
+                bufs = [pool[k_][layer] for k_ in xfer_keys
+                        for layer in range(cfg.n_layers)]
+                payload = [aval((nxf,) + tuple(b.shape[1:]), b.dtype)
+                           for b in bufs]
+                ids = aval((nxf,), jnp.int32)
+                regs.append((f"xfer_export_N{nxf}",
+                             _xfer_jits()["export"], (bufs, ids)))
+                regs.append((f"xfer_import_N{nxf}",
+                             _xfer_jits()["import"],
+                             (bufs, ids, payload)))
+        return regs
+
+    def aot_prime(*, slots: int = 4, kv_blocks=None, prompt_lens=(),
+                  n_new: int = 2):
+        """Call-path warm: ``jit(...).lower().compile()`` does NOT
+        populate the jit call-path cache (a later direct call
+        re-traces), so drive ONE tiny seeded synthetic schedule
+        through the real ``run()``. With the persistent XLA cache
+        active its compiles are disk hits — trace time, not XLA time.
+        Leaves no cross-run state (every ``run()`` builds a fresh
+        ``_Run``), so a primed engine's later runs stay byte-identical
+        to an unprimed engine's."""
+        # clamp to the engine's real budget envelope: callers hand the
+        # SERVING schedule's lens/budgets (fleet warm_kw), and the
+        # longest prompt + the largest budget may not fit together —
+        # priming only needs the call path, not the full decode
+        lens = sorted({int(x) for x in prompt_lens
+                       if prefix_len + int(x) < max_len})
+        if not lens:
+            return 0
+        n_new = max(1, min(int(n_new), max_len - prefix_len - lens[-1]))
+        prompts = [np.arange(1, x + 1, dtype=np.int32) % cfg.vocab
+                   for x in lens]
+        run(prompts, n_new, slots=slots, kv_blocks=kv_blocks,
+            rng=jax.random.PRNGKey(0) if sampler is not None else None)
+        return len(prompts)
+
+    def warm(cache=None, *, slots: int = 4, kv_blocks=None,
+             prompt_lens=(), n_new: int = 2, prime: bool = True):
+        """One-call cold-start warm — see
+        :func:`..aotcache.warm_engine`. A no-op stats dict when the
+        engine has no cache and none is passed."""
+        from .aotcache import warm_engine
+
+        return warm_engine(
+            run, cache if cache is not None else aot_cache,
+            slots=slots, kv_blocks=kv_blocks, prompt_lens=prompt_lens,
+            n_new=n_new, prime=prime, telemetry=reg)
+
     run.last_stats = None
     run.prefill_session = prefill_session
+    run.aot_scope = aot_scope
+    run.aot_cache = aot_cache
+    run.aot_registrations = aot_registrations
+    run.aot_prime = aot_prime
+    run.warm = warm
     return run
 
 

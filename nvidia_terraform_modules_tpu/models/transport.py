@@ -318,6 +318,24 @@ class FrameChannel:
         if self._metrics is not None:
             self._metrics.frame(len(frame))
 
+    def send_raw(self, payload: bytes) -> None:
+        """Send PRE-PICKLED payload bytes: framing (seq/crc) is still
+        per-channel, but the pickle happened once upstream — how the
+        parent streams ONE shared donor-weight snapshot to N joiners
+        (``MultiProcTransport._param_wire``) instead of re-pickling
+        the weight tree per child. Billed like any frame, so the
+        stream shows up in ``transport_bytes_total``."""
+        frame = pack_frame(self._send_seq, payload)
+        try:
+            self._conn.send_bytes(frame)
+        except (BrokenPipeError, EOFError, OSError) as exc:
+            raise TransportDead(
+                f"{self.label}: peer closed while sending frame "
+                f"{self._send_seq}: {exc}") from exc
+        self._send_seq += 1
+        if self._metrics is not None:
+            self._metrics.frame(len(frame))
+
     def recv(self, timeout: float | None):
         """Bounded receive: ``timeout`` seconds (``None`` → one
         ``poll_s`` slice). :class:`TransportTimeout` when nothing
@@ -528,6 +546,15 @@ class Transport:
                       ) -> "ReplicaHandle":
         raise NotImplementedError
 
+    def warm_replica(self, i: int, warm_kw: dict) -> dict:
+        """AOT-warm replica ``i``'s engine ahead of its first run
+        (``models/aotcache.py`` — probe-or-compile the step family,
+        prime the call path). Returns the warm stats dict; ``{}`` on
+        transports that cannot warm, and engines without an
+        ``aot_cache`` return ``{"enabled": False}`` without running
+        anything, so the fleet's bit-match gates are untouched."""
+        return {}
+
     def close(self) -> None:
         """Release replica resources (no-op in-proc; terminates child
         processes multi-proc)."""
@@ -675,6 +702,11 @@ class InProcTransport(Transport):
         return _ThreadHandle(f"decode-{i}", self.dec_engines[i],
                              queue, run_kw, on_error)
 
+    def warm_replica(self, i, warm_kw):
+        engine = self.ensure_engine(i)
+        warm = getattr(engine, "warm", None)
+        return warm(**warm_kw) if warm is not None else {}
+
     def close(self) -> None:
         pass                             # nothing lives outside us
 
@@ -805,23 +837,54 @@ class _ChainSinkProxy:
                                encode_warm_chains(chains))
 
 
+def _recv_params(chan: FrameChannel, timeout_s: float):
+    """The donor weight stream: the FIRST frame into a fresh child is
+    ``("PARAMS", snapshot_wire)`` — one shared, crc-stamped host
+    snapshot the parent pickled once for every joiner
+    (``hostkv.HostParamSnapshot``). Every leaf crc is verified HERE,
+    before any engine exists; a corrupt stream is reclassified as
+    :class:`TransportCorruptFrame` so the child's classified-exit path
+    (``EXIT_PEER_DEAD``) fires and the parent's spawn retry respawns —
+    a joiner never builds on silently corrupt weights."""
+    from .hostkv import HostParamSnapshot, SnapshotCorruptError
+
+    msg = chan.recv(timeout_s)
+    if not (isinstance(msg, tuple) and msg and msg[0] == "PARAMS"):
+        raise TransportProtocolError(
+            f"{chan.label}: expected PARAMS as the first frame, "
+            f"got {msg!r:.80}")
+    try:
+        return HostParamSnapshot.decode(msg[1])
+    except SnapshotCorruptError as exc:
+        raise TransportCorruptFrame(
+            f"{chan.label}: donor weight stream corrupt: {exc}"
+        ) from exc
+
+
 def _replica_child_main(conn, index: int, params, cfg, max_len: int,
                         engine_kw: dict, reply_timeout_s: float,
                         parent_pid: int | None = None) -> None:
-    """The replica process: build the engine once, then serve RUN
-    frames until EXIT (children persist across fleet calls — compiles
-    amortise exactly like in-proc engines). Every recv is bounded; a
-    dead or desynchronised router stream exits ``EXIT_PEER_DEAD`` so
+    """The replica process: receive the donor weight stream, build the
+    engine once, then serve WARM/RUN frames until EXIT (children
+    persist across fleet calls — compiles amortise exactly like
+    in-proc engines). Every recv is bounded; a dead or desynchronised
+    router stream exits ``EXIT_PEER_DEAD`` so
     ``resilience.classify_exit`` reads a classified death, never a
-    hang. The parent-pid watchdog starts BEFORE the engine build — a
+    hang. The parent-pid watchdog starts BEFORE the params receive — a
     parent that crashes mid-spawn (before its registry insert) still
-    reaps this child."""
+    reaps this child. ``params`` rides the spawn args only for direct
+    (non-fleet) callers; the fleet passes None and streams."""
     if parent_pid is not None:
         start_parent_watchdog(parent_pid)
     chan = FrameChannel(conn, label=f"replica-{index}/child")
-    engine = make_serve_engine(params, cfg, max_len=max_len,
-                               **engine_kw)
     try:
+        if params is None:
+            # generous budget: the wire bytes are already in flight
+            # when we get here — this bounds a dead parent, not a slow
+            # stream
+            params = _recv_params(chan, max(reply_timeout_s, 60.0))
+        engine = make_serve_engine(params, cfg, max_len=max_len,
+                                   **engine_kw)
         chan.send(("READY", index, os.getpid()))
         while True:
             try:
@@ -832,6 +895,20 @@ def _replica_child_main(conn, index: int, params, cfg, max_len: int,
                 continue
             if msg[0] == "EXIT":
                 return
+            if msg[0] == "WARM":
+                # AOT warm (models/aotcache.py): probe-or-compile the
+                # step family + prime the call path BEFORE the first
+                # RUN — the whole point of the process fleet's warm
+                # joins. Failures ship home as stats, never kill the
+                # child: an unwarmed replica is slow, not wrong.
+                try:
+                    stats = engine.warm(**msg[1])
+                except Exception as exc:  # noqa: BLE001 — shipped home
+                    stats = {"enabled": False, "registered": 0,
+                             "hits": 0, "misses": 0,
+                             "error": f"{type(exc).__name__}: {exc}"}
+                chan.send(("WARMED", stats))
+                continue
             if msg[0] != "RUN":
                 raise TransportProtocolError(
                     f"replica-{index}: unexpected frame {msg[0]!r} "
@@ -1083,7 +1160,8 @@ class MultiProcTransport(Transport):
         self._key = None
         self._lock = threading.Lock()
         self._children: dict[int, tuple] = {}     # i -> (proc, chan)
-        self._params_np = None
+        self._params_wire: bytes | None = None    # pickled ONCE/config
+        self._params_nbytes = 0
         self._registry = None
         self._atexit_registered = False
         self.pre_engines: list = []
@@ -1113,7 +1191,7 @@ class MultiProcTransport(Transport):
         self._key = key
         self._params, self._cfg, self._max_len = params, cfg, max_len
         self._engine_kw = dict(engine_kw)
-        self._params_np = None           # re-snapshot lazily
+        self._params_wire = None         # re-snapshot lazily
         # disaggregated prefill workers stay PARENT-side in every
         # current transport: the handoff payload (crc-stamped paged
         # blocks riding the kv_import RPC) is the cross-boundary
@@ -1149,14 +1227,22 @@ class MultiProcTransport(Transport):
     def prefill_engine(self, i: int):
         return self.pre_engines[i]
 
-    def _snapshot_params(self):
-        if self._params_np is None:
-            import jax
+    def _param_wire(self) -> bytes:
+        """The donor weight stream, pickled ONCE per configure: one
+        immutable crc-stamped host snapshot
+        (``hostkv.HostParamSnapshot``) shared by every joiner — N
+        scale-ups used to re-``device_get`` and re-pickle the full
+        weight tree per child; now they frame the identical shared
+        bytes (``FrameChannel.send_raw``), billed per child in
+        ``transport_bytes_total``."""
+        if self._params_wire is None:
+            from .hostkv import HostParamSnapshot
 
-            # one host snapshot per configure: the child rebuilds its
-            # own device arrays from these at engine build
-            self._params_np = jax.device_get(self._params)
-        return self._params_np
+            snap = HostParamSnapshot(self._params)
+            self._params_wire = pickle.dumps(
+                ("PARAMS", snap.encode()), pickle.HIGHEST_PROTOCOL)
+            self._params_nbytes = snap.nbytes
+        return self._params_wire
 
     def _spawn(self, i: int):
         """Bring up replica ``i``: spawn + READY handshake, the whole
@@ -1166,7 +1252,7 @@ class MultiProcTransport(Transport):
         import multiprocessing as mp
 
         ctx = mp.get_context("spawn")
-        params_np = self._snapshot_params()
+        wire = self._param_wire()        # shared: built outside retry
         # the parent-side half of the orphan-reaper contract: close()
         # at interpreter exit reaps every REGISTERED child; the
         # child-side parent-pid watchdog (started before the engine
@@ -1180,7 +1266,7 @@ class MultiProcTransport(Transport):
             parent_conn, child_conn = ctx.Pipe(duplex=True)
             proc = ctx.Process(
                 target=_replica_child_main,
-                args=(child_conn, i, params_np, self._cfg,
+                args=(child_conn, i, None, self._cfg,
                       self._max_len, self._engine_kw,
                       self.reply_timeout_s, os.getpid()),
                 daemon=True, name=f"fleet-replica-{i}")
@@ -1189,6 +1275,10 @@ class MultiProcTransport(Transport):
             chan = FrameChannel(parent_conn, metrics=self.metrics,
                                 label=f"replica-{i}/router")
             try:
+                # weights ride the pipe, not the spawn args: the same
+                # pre-pickled snapshot bytes for every joiner, crc-
+                # verified child-side before its engine build
+                chan.send_raw(wire)
                 msg = chan.recv(self.spawn_timeout_s)
                 if msg[0] != "READY" or msg[1] != i:
                     raise TransportProtocolError(
@@ -1238,6 +1328,22 @@ class MultiProcTransport(Transport):
         }
         return _ProcHandle(self, i, proc, chan, queue, wire_kw,
                            on_error)
+
+    def warm_replica(self, i, warm_kw):
+        """AOT-warm replica ``i``'s child over the wire: ensure the
+        child is up (spawn + weight stream + READY), send WARM, wait
+        for WARMED under the spawn budget (compiles ARE the spawn
+        cost). The stats dict ships home; a child-side warm failure
+        arrives as ``{"error": ...}`` stats, never a dead child."""
+        proc, chan = self.ensure_engine(i)
+        del proc
+        chan.send(("WARM", dict(warm_kw)))
+        msg = chan.recv(self.spawn_timeout_s)
+        if msg[0] != "WARMED":
+            raise TransportProtocolError(
+                f"replica-{i}: unexpected frame {msg[0]!r} while "
+                f"waiting for WARMED")
+        return msg[1]
 
     def close(self) -> None:
         with self._lock:

@@ -752,6 +752,87 @@ def _run_smoketest(
                     checks["fleet_scale_error"] = str(exc)
                 ok &= checks["fleet_scale_ok"]
 
+            # cold-start gate (ISSUE 19): the AOT compile cache
+            # (models/aotcache.py) is contractually a COMPILE-TIME
+            # change — cached executables and a primed call path,
+            # never different bits — so a warmed engine on a shared-
+            # prefix wave must BIT-match the plain cold engine, and a
+            # SECOND bring-up against the same cache dir must land
+            # real probe hits (> 0) on this backend's serialization
+            # support (or its trace-only demotion). Gates the
+            # persistent cache on this host's real XLA before a
+            # fleet's joiners trust it for second-scale bring-up.
+            # Tiny, process-local; the cache dir is torn down and
+            # DEACTIVATED so later legs compile against the default
+            # config untouched.
+            if checks.get("serve_sched_ok"):
+                try:
+                    import shutil
+                    import tempfile
+
+                    from ..models.serving import make_serve_engine
+                    from ..utils.traffic import shared_prefix_prompts
+
+                    acfg = BurnInConfig(
+                        vocab=128, d_model=32, n_heads=4, d_ff=64,
+                        n_layers=2, seq_len=16, batch=2,
+                        dtype=jax.numpy.float32)
+                    aparams = init_params(jax.random.PRNGKey(21),
+                                          acfg)
+                    apairs = shared_prefix_prompts(
+                        6, seed=5, template_len=8, suffix_lo=1,
+                        suffix_hi=4, vocab=acfg.vocab)
+                    aprompts = [jax.numpy.asarray(p, jax.numpy.int32)
+                                for _t, p in apairs]
+                    abudgets = [3, 4, 2, 4, 3, 2]
+                    aml = max(int(p.shape[-1]) + n
+                              for p, n in zip(aprompts, abudgets))
+                    alens = tuple(sorted(
+                        {int(p.shape[-1]) for p in aprompts}))
+                    acold = make_serve_engine(
+                        aparams, acfg, max_len=aml, kv_block=4,
+                        share_prefix=True)
+                    a_outs = acold(aprompts, abudgets, slots=2)
+                    adir = tempfile.mkdtemp(prefix="smoke_aot_")
+                    try:
+                        aw1 = make_serve_engine(
+                            aparams, acfg, max_len=aml, kv_block=4,
+                            share_prefix=True, aot_cache=adir)
+                        ws1 = aw1.warm(slots=2, prompt_lens=alens,
+                                       n_new=max(abudgets))
+                        w_outs = aw1(aprompts, abudgets, slots=2)
+                        a_match = all(
+                            bool(jax.device_get(
+                                jax.numpy.array_equal(a, b)))
+                            for a, b in zip(w_outs, a_outs))
+                        aw2 = make_serve_engine(
+                            aparams, acfg, max_len=aml, kv_block=4,
+                            share_prefix=True, aot_cache=adir)
+                        ws2 = aw2.warm(slots=2, prompt_lens=alens,
+                                       n_new=max(abudgets))
+                        checks["aot_warm_ok"] = (
+                            a_match
+                            and ws1["enabled"] and ws2["enabled"]
+                            and ws1["registered"] >= 1
+                            and not ws1["errors"]
+                            and not ws2["errors"]
+                            and ws2["hits"] >= 1)
+                        checks["aot_warm_registered"] = \
+                            ws1["registered"]
+                        checks["aot_warm_second_hits"] = ws2["hits"]
+                        # restore the jax cache config in reverse
+                        # activation order (activate is sticky by
+                        # design — joiners keep compiling into the
+                        # fleet's dir — so the smoke leg unwinds it)
+                        aw2.aot_cache.deactivate()
+                        aw1.aot_cache.deactivate()
+                    finally:
+                        shutil.rmtree(adir, ignore_errors=True)
+                except Exception as exc:  # JSON contract > the type
+                    checks["aot_warm_ok"] = False
+                    checks["aot_warm_error"] = str(exc)
+                ok &= checks["aot_warm_ok"]
+
             # flash pipeline gate: the software-pipelined kernels
             # (ops/flash_attention.py, pipeline="on") are contractually a
             # SCHEDULING change — same sub-tile folds, same arithmetic —

@@ -70,9 +70,12 @@ Instrumented layers (all emit here when enabled):
                                       ``fleet_scale_down_total``
                                       counters, one ``fleet_scale`` span
                                       per executed scale event (args:
-                                      trigger, replica, warm, transport
-                                      — a capture distinguishes thread
-                                      joins from real process spawns)
+                                      trigger, replica, warm,
+                                      warm_compile, transport — a
+                                      capture distinguishes thread
+                                      joins from real process spawns,
+                                      and AOT-warmed bring-ups from
+                                      cold compiles)
 ``models/transport``                  ``transport_bytes_total`` /
                                       ``transport_frames_total`` counters
                                       (every frame through the router
@@ -92,6 +95,17 @@ Instrumented layers (all emit here when enabled):
                                       payload bytes over the pipes,
                                       both join-prime and close-publish
                                       directions)
+``models/aotcache``                   ``aot_cache_hit_total`` /
+                                      ``aot_cache_miss_total`` counters
+                                      (per step-family registration at
+                                      every ``warm_engine`` bring-up),
+                                      ``engine_warmup_ms`` gauge (the
+                                      whole probe-or-compile + prime
+                                      window) and — set by the engine's
+                                      first run after bring-up —
+                                      ``join_first_token_ms`` gauge
+                                      (the joiner's clock the ISSUE 19
+                                      warm-vs-cold gate prices)
 ``parallel/collectives``              ``hierarchical_psum`` ICI-vs-DCN
                                       phase spans (probe side) +
                                       ``jax.named_scope`` phase names in

@@ -1,0 +1,395 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""The cold-start cache gates (ISSUE 19): key separation, integrity
+quarantine, warmed == unwarmed bit-match, concurrent-warmer safety,
+and the donor weight snapshot's crc discipline.
+
+The AOT compile cache is contractually a COMPILE-TIME change — cached
+executables and a primed call path, never different bits — and these
+tests pin the contract's sharp edges:
+
+- **Key separation.** Differing levers, dtypes, geometries or jax
+  worlds can NEVER share an executable: the scope fingerprint and the
+  per-registration abstract signature split them. A cross-config cache
+  hit would be a silent wrong-program load — the worst failure mode a
+  compile cache has.
+- **Integrity → quarantine, loudly.** A corrupt, truncated, or stale
+  (key-mismatch) entry is moved into ``quarantine/`` with its reason
+  recorded and the caller recompiles; it is never served. Executables
+  the backend cannot RELOAD (deserialize failure) demote to trace-only
+  so the cache converges instead of quarantining forever.
+- **Bit-match.** A warmed engine's outputs equal an unwarmed engine's
+  on the same seeded trace — the serving twin of the checkpoint
+  restore-bit-match gate.
+- **Concurrency.** Two warmers racing on one directory duplicate
+  identical bytes harmlessly (atomic tmp + rename), and a later
+  bring-up hits on every entry.
+- **Donor weights.** ``HostParamSnapshot`` round-trips the param tree
+  exactly, classifies any leaf corruption as
+  ``SnapshotCorruptError`` (→ the transport's corrupt-frame retry
+  path), and ``MultiProcTransport`` pickles the snapshot ONCE per
+  configure — N joiners frame the same shared bytes.
+"""
+
+import contextlib
+import functools
+import pickle
+import shutil
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nvidia_terraform_modules_tpu.models import (
+    BurnInConfig,
+    init_params,
+    make_serve_engine,
+)
+from nvidia_terraform_modules_tpu.models.aotcache import (
+    AotCacheCorruptError,
+    AotCompileCache,
+    _reset_xla_cache,
+    describe_avals,
+    engine_fingerprint,
+)
+from nvidia_terraform_modules_tpu.models.hostkv import (
+    HostParamSnapshot,
+    SnapshotCorruptError,
+)
+
+CFG = dict(vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2,
+           seq_len=16, batch=2, dtype=jnp.float32)
+
+
+@functools.lru_cache(maxsize=None)
+def _setup():
+    cfg = BurnInConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompts = tuple(
+        jax.random.randint(jax.random.PRNGKey(40 + i), (3 + i % 3,), 0,
+                           cfg.vocab) for i in range(4))
+    return cfg, params, prompts
+
+
+@contextlib.contextmanager
+def _xla_config_guard():
+    """Restore jax's persistent-cache config no matter how many cache
+    objects a test activated against the same directory (each saves
+    its PREDECESSOR's config, so per-object deactivate ordering is not
+    a reliable restore — snapshot the real before-state instead)."""
+    keys = ("jax_compilation_cache_dir",
+            "jax_persistent_cache_min_compile_time_secs",
+            "jax_persistent_cache_min_entry_size_bytes")
+    prev = {k: getattr(jax.config, k) for k in keys}
+    try:
+        yield
+    finally:
+        for k, v in prev.items():
+            jax.config.update(k, v)
+        _reset_xla_cache()
+
+
+# ------------------------------------------------------ key separation
+
+
+def test_describe_avals_and_entry_key_separation_tier1():
+    """Two registrations whose dtypes, shapes, tree structures, names
+    or scopes differ can never share an entry file."""
+    a32 = jax.ShapeDtypeStruct((4, 8), jnp.float32)
+    a16 = jax.ShapeDtypeStruct((4, 8), jnp.bfloat16)
+    b32 = jax.ShapeDtypeStruct((8, 4), jnp.float32)
+    assert describe_avals((a32,)) != describe_avals((a16,))
+    assert describe_avals((a32,)) != describe_avals((b32,))
+    assert describe_avals((a32, a32)) != describe_avals(((a32, a32),))
+    # non-array statics separate by repr
+    assert describe_avals((a32, 3)) != describe_avals((a32, 4))
+    # equal inputs ⇒ equal signature (the determinism half)
+    assert describe_avals((a32, 3)) == describe_avals((a32, 3))
+
+    cache = AotCompileCache.__new__(AotCompileCache)
+    cache.path = "/nonexistent"
+    keys = {cache.entry_key(s, n, (a32,))
+            for s in ("scopeA", "scopeB") for n in ("wave", "admit")}
+    assert len(keys) == 4
+    assert cache.entry_key("s", "n", (a32,)) \
+        != cache.entry_key("s", "n", (a16,))
+
+
+def test_engine_fingerprint_separates_levers_and_geometry_tier1():
+    """The scope fingerprint splits on every lever, the model config,
+    and max_len — and is deterministic for identical inputs (no memory
+    addresses: it must agree ACROSS processes)."""
+    cfg, _params, _ = _setup()
+    cfg2 = BurnInConfig(**{**CFG, "dtype": jnp.bfloat16})
+    base = dict(cache_dtype="bf16", spec_k=0, kv_block=16)
+    fps = {
+        engine_fingerprint(cfg, 32, base),
+        engine_fingerprint(cfg, 48, base),
+        engine_fingerprint(cfg2, 32, base),
+        engine_fingerprint(cfg, 32, {**base, "cache_dtype": "int8"}),
+        engine_fingerprint(cfg, 32, {**base, "spec_k": 4}),
+        engine_fingerprint(cfg, 32, {**base, "kv_block": 4}),
+    }
+    assert len(fps) == 6
+    assert engine_fingerprint(cfg, 32, base) \
+        == engine_fingerprint(cfg, 32, dict(reversed(base.items())))
+    # the jax world is in scope: version + backend drift splits keys
+    assert f"jax={jax.__version__}" in engine_fingerprint(cfg, 32, base)
+
+
+def test_engine_scopes_differ_per_lever_tier1():
+    """End to end: engines differing in ONE lever share zero cache
+    scope — a lever flip can never be served the other's executable."""
+    cfg, params, _ = _setup()
+    scopes = set()
+    for kw in (dict(), dict(cache_dtype="int8"), dict(spec_k=2)):
+        eng = make_serve_engine(params, cfg, max_len=12, kv_block=4,
+                                **kw)
+        scopes.add(eng.aot_scope)
+    assert len(scopes) == 3
+
+
+# --------------------------------------------- integrity + quarantine
+
+
+def test_store_probe_roundtrip_and_corruption_quarantine_tier1(
+        tmp_path):
+    """The crc frame end to end: a stored entry probes back exactly;
+    a flipped byte, a truncation, a stale key and a foreign magic each
+    QUARANTINE the file (reason recorded, bytes preserved) and probe
+    as a miss the caller recompiles from."""
+    cache = AotCompileCache(str(tmp_path / "gac"))
+    key = cache.entry_key("scope", "wave", (3,))
+    assert cache.probe(key) is None                  # cold miss
+    assert cache.store(key, "traceonly", None) == "traceonly"
+    body = cache.probe(key)
+    assert body == {"key": key, "mode": "traceonly", "payload": None}
+    assert cache.entries() and cache.stats()["quarantined"] == 0
+
+    # corrupt one byte of the body → crc mismatch → quarantined
+    path = cache._entry_path(key)
+    raw = bytearray(open(path, "rb").read())
+    raw[-1] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+    assert cache.probe(key) is None
+    assert cache.stats()["quarantined"] == 1
+    assert any("crc mismatch" in r for r in cache.quarantine_reasons)
+
+    # recompile path: a fresh store fully recovers the key
+    cache.store(key, "traceonly", None)
+    assert cache.probe(key)["mode"] == "traceonly"
+
+    # truncation → quarantined with the lengths in the reason
+    whole = open(path, "rb").read()
+    open(path, "wb").write(whole[:7])
+    assert cache.probe(key) is None
+    assert any("truncated" in r for r in cache.quarantine_reasons)
+
+    # stale entry: key2's bytes parked under key1's file name (hash
+    # collision / fingerprint drift) — never served
+    key2 = cache.entry_key("scope", "admit", (3,))
+    cache.store(key2, "traceonly", None)
+    shutil.copyfile(cache._entry_path(key2), path)
+    assert cache.probe(key) is None
+    assert any("stale entry" in r for r in cache.quarantine_reasons)
+
+    # bad magic → classified, not a pickle error
+    open(path, "wb").write(b"NOPE" + b"\x00" * 16)
+    assert cache.probe(key) is None
+    assert any("bad magic" in r for r in cache.quarantine_reasons)
+    with pytest.raises(AotCacheCorruptError, match="bad magic"):
+        cache._decode(b"NOPE" + b"\x00" * 16, key)
+
+    # a payload that refuses to pickle degrades to trace-only loudly
+    assert cache.store(key, "serialized", lambda: None) == "traceonly"
+    assert cache.probe(key)["degraded"]
+
+
+def test_cache_pickles_by_path_tier1(tmp_path):
+    """The cache ships to fleet children via engine_kw: pickling keeps
+    only the path, and the clone probes the same on-disk entries."""
+    cache = AotCompileCache(str(tmp_path / "gac"))
+    key = cache.entry_key("s", "n", (1,))
+    cache.store(key, "traceonly", None)
+    clone = pickle.loads(pickle.dumps(cache))
+    assert clone.path == cache.path
+    assert clone.probe(key)["mode"] == "traceonly"
+    assert clone.stats()["active"] is False
+
+
+# ------------------------------------------------- warm ↔ cold parity
+
+
+def test_warm_engine_bitmatch_and_second_bringup_hits_tier1(tmp_path):
+    """THE acceptance gate: a warmed engine's outputs bit-match an
+    unwarmed engine's on the same seeded trace, and a later bring-up
+    against the populated cache lands hits on EVERY registration
+    (converged — any backend-unreloadable executable demoted to
+    trace-only on its first re-probe, never quarantined forever)."""
+    cfg, params, prompts = _setup()
+    cache_dir = str(tmp_path / "gac")
+    lens = tuple(sorted({int(p.shape[-1]) for p in prompts}))
+    plain = make_serve_engine(params, cfg, max_len=12, kv_block=4)
+    want = plain(prompts, 4, slots=2)
+    with _xla_config_guard():
+        warmed = make_serve_engine(params, cfg, max_len=12, kv_block=4,
+                                   aot_cache=cache_dir)
+        w1 = warmed.warm(slots=2, prompt_lens=lens, n_new=4)
+        assert w1["enabled"] and w1["registered"] >= 1
+        assert w1["misses"] == w1["registered"] and not w1["errors"]
+        assert w1["hits"] == 0 and w1["primed"] == len(lens)
+        assert w1["warm_ms"] > 0
+        got = warmed(prompts, 4, slots=2)
+        for i, (g, w) in enumerate(zip(got, want)):
+            assert jnp.array_equal(g, w), f"request {i} diverged"
+
+        # bring-ups 2..3: hits climb to registered and STAY there
+        # (demotion converges; nothing quarantines forever)
+        for _ in range(2):
+            eng = make_serve_engine(params, cfg, max_len=12,
+                                    kv_block=4, aot_cache=cache_dir)
+            wn = eng.warm(slots=2, prompt_lens=lens, n_new=4)
+        assert wn["hits"] == wn["registered"] and wn["misses"] == 0
+        assert not wn["errors"] and wn["demoted"] == 0
+        got2 = eng(prompts, 4, slots=2)
+        for i, (g, w) in enumerate(zip(got2, want)):
+            assert jnp.array_equal(g, w), f"warm request {i} diverged"
+
+
+def test_warm_engine_demotes_undeserializable_entry_tier1(tmp_path):
+    """A serialized entry the backend cannot reload (XLA:CPU programs
+    referencing jit-compiled fusion symbols; cross-version blobs) is
+    quarantined LOUDLY and its recompile is demoted to trace-only —
+    the next bring-up hits, instead of re-quarantining every join."""
+    cfg, params, _prompts = _setup()
+    cache_dir = str(tmp_path / "gac")
+    lens = (4,)
+    with _xla_config_guard():
+        eng = make_serve_engine(params, cfg, max_len=12, kv_block=4,
+                                aot_cache=cache_dir)
+        eng.warm(slots=2, prompt_lens=lens, n_new=2)
+        cache = eng.aot_cache
+        name, _fn, args = eng.aot_registrations(
+            slots=2, prompt_lens=lens)[0]
+        key = cache.entry_key(eng.aot_scope, name, args)
+        # a well-framed entry whose payload cannot deserialize
+        cache.store(key, "serialized", (b"not an executable", 0, 0))
+
+        eng2 = make_serve_engine(params, cfg, max_len=12, kv_block=4,
+                                 aot_cache=cache_dir)
+        w = eng2.warm(slots=2, prompt_lens=lens, n_new=2)
+        assert w["demoted"] >= 1 and w["quarantined"] >= 1
+        assert w["misses"] >= 1 and not w["errors"]
+        assert any("deserialize failed" in r
+                   for r in eng2.aot_cache.quarantine_reasons)
+        assert eng2.aot_cache.probe(key)["mode"] == "traceonly"
+
+        eng3 = make_serve_engine(params, cfg, max_len=12, kv_block=4,
+                                 aot_cache=cache_dir)
+        w3 = eng3.warm(slots=2, prompt_lens=lens, n_new=2)
+        assert w3["hits"] == w3["registered"] and w3["misses"] == 0
+
+
+def test_concurrent_warmers_do_not_race_tier1(tmp_path):
+    """Two warmers on one directory at once: atomic writes mean they
+    race only to duplicate identical bytes — both finish clean, and a
+    later bring-up hits every entry."""
+    cfg, params, prompts = _setup()
+    cache_dir = str(tmp_path / "gac")
+    lens = tuple(sorted({int(p.shape[-1]) for p in prompts}))
+    engines = [make_serve_engine(params, cfg, max_len=12, kv_block=4,
+                                 aot_cache=cache_dir)
+               for _ in range(2)]
+    results: list = [None, None]
+
+    def go(i):
+        results[i] = engines[i].warm(slots=2, prompt_lens=lens,
+                                     n_new=4)
+
+    with _xla_config_guard():
+        threads = [threading.Thread(target=go, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300.0)
+        assert all(r is not None for r in results), "warmer hung"
+        for r in results:
+            assert r["enabled"] and not r["errors"], r
+            assert r["hits"] + r["misses"] == r["registered"]
+
+        # converge (first re-probe may demote), then: all hits
+        for _ in range(2):
+            eng = make_serve_engine(params, cfg, max_len=12,
+                                    kv_block=4, aot_cache=cache_dir)
+            w = eng.warm(slots=2, prompt_lens=lens, n_new=4)
+        assert w["hits"] == w["registered"] and w["misses"] == 0, w
+
+
+# --------------------------------------------- donor weight streaming
+
+
+def test_host_param_snapshot_roundtrip_and_crc_tier1():
+    """The donor weight stream's integrity contract: an exact host
+    round-trip, and ANY leaf corruption or leaf-count drift classified
+    as SnapshotCorruptError — the transport's corrupt-frame retry
+    path, never a child building an engine on garbage weights."""
+    cfg, params, _ = _setup()
+    snap = HostParamSnapshot(params)
+    wire = snap.encode()
+    tree = HostParamSnapshot.decode(wire)
+    for a, b in zip(jax.tree.leaves(jax.device_get(params)),
+                    jax.tree.leaves(tree)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert snap.nbytes == sum(x.nbytes
+                              for x in jax.tree.leaves(snap.tree))
+
+    # one flipped element in one leaf → classified, with the leaf id
+    leaves, treedef = jax.tree.flatten(wire["tree"])
+    leaves = [np.array(x) for x in leaves]       # writable copies
+    leaves[1].flat[0] += 1
+    bad = dict(wire, tree=jax.tree.unflatten(treedef, leaves))
+    with pytest.raises(SnapshotCorruptError, match="leaf 1"):
+        HostParamSnapshot.decode(bad)
+
+    # crc-list drift (schema/version skew) → classified, not a zip
+    # silently dropping leaves
+    with pytest.raises(SnapshotCorruptError, match="leaf crcs"):
+        HostParamSnapshot.decode(dict(wire, crcs=wire["crcs"][:-1]))
+
+
+def test_multiproc_params_pickled_once_per_configure_tier1():
+    """The donor-streaming bugfix: MultiProcTransport builds the param
+    wire ONCE per configure — every joiner frames the same shared
+    bytes — and a reconfigure with new params re-snapshots."""
+    from nvidia_terraform_modules_tpu.models.transport import (
+        MultiProcTransport,
+    )
+
+    cfg, params, _ = _setup()
+    tr = MultiProcTransport()
+    tr.configure(params=params, cfg=cfg, max_len=12,
+                 engine_kw=dict(kv_block=4), registry=None,
+                 n_dec=2, n_pre=0)
+    try:
+        wire = tr._param_wire()
+        assert wire is tr._param_wire()          # cached, not rebuilt
+        assert tr._params_nbytes > 0
+        kind, payload = pickle.loads(wire)
+        assert kind == "PARAMS"
+        decoded = HostParamSnapshot.decode(payload)
+        for a, b in zip(jax.tree.leaves(jax.device_get(params)),
+                        jax.tree.leaves(decoded)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+        # a NEW configure invalidates the shared snapshot
+        params2 = init_params(jax.random.PRNGKey(1), cfg)
+        tr.configure(params=params2, cfg=cfg, max_len=12,
+                     engine_kw=dict(kv_block=4), registry=None,
+                     n_dec=2, n_pre=0)
+        assert tr._params_wire is None
+        assert tr._param_wire() is not wire
+    finally:
+        tr.close()

@@ -423,6 +423,89 @@ def test_section_serve_fleet_transport_deterministic_across_runs():
         assert a[key] == b[key], key
 
 
+def test_section_serve_coldstart_schema_and_gates():
+    """Tier-1 gate on the cold-start section (ISSUE 19): full schema,
+    the warmed join STRICTLY beats the cold join on the identical
+    seeded trace (the acceptance bar — the compile window is host
+    work, portable to CPU), outputs bit-match exactly (the cache moves
+    compiles, never bits), the converged cache serves EVERY
+    registration from a hit with zero misses, and the armed autoscale
+    leg's joiner bring-ups all warm-compiled with no errors."""
+    import jax
+
+    bench = _bench_mod()
+    prev_cc = jax.config.jax_compilation_cache_dir
+    out = bench.section_serve_coldstart()
+    # the section activates its own cache dirs; in-process callers
+    # must get jax's persistent-cache config back untouched
+    assert jax.config.jax_compilation_cache_dir == prev_cc
+    for key in ("serve_coldstart_requests", "serve_coldstart_budget",
+                "serve_coldstart_trace",
+                "serve_join_first_token_cold_ms",
+                "serve_join_first_token_warm_ms",
+                "serve_join_first_token_warm_vs_cold",
+                "serve_coldstart_bitmatch",
+                "serve_coldstart_registered",
+                "serve_coldstart_warm_hits",
+                "serve_coldstart_warm_misses",
+                "serve_coldstart_populate_misses",
+                "serve_coldstart_demoted",
+                "serve_coldstart_quarantined",
+                "serve_fleet_autoscale_p99_warm",
+                "serve_fleet_autoscale_p50_warm",
+                "serve_coldstart_autoscale_ups",
+                "serve_coldstart_warm_compiles",
+                "serve_coldstart_populate_compiles",
+                "serve_coldstart_warm_compile_errors"):
+        assert key in out, key
+    # the ISSUE 19 acceptance bar, gated tier-1
+    assert out["serve_join_first_token_warm_vs_cold"] > 1.0, out
+    assert out["serve_join_first_token_cold_ms"] > 0
+    assert out["serve_join_first_token_warm_ms"] > 0
+    assert out["serve_coldstart_bitmatch"] is True
+    # converged steady state: every registration a hit, zero misses,
+    # and the populate pass compiled them all (fresh dir per run)
+    assert out["serve_coldstart_registered"] >= 1
+    assert out["serve_coldstart_warm_hits"] \
+        == out["serve_coldstart_registered"]
+    assert out["serve_coldstart_warm_misses"] == 0
+    assert out["serve_coldstart_populate_misses"] \
+        == out["serve_coldstart_registered"]
+    # the armed fleet leg: base + joiner bring-ups warm-compiled, the
+    # spike actually scaled, and nothing errored silently OR loudly
+    assert out["serve_coldstart_warm_compile_errors"] == [], out
+    assert out["serve_coldstart_warm_compiles"] >= 1
+    assert out["serve_coldstart_populate_compiles"] >= 1
+    assert out["serve_coldstart_autoscale_ups"] >= 1
+    assert out["serve_fleet_autoscale_p99_warm"] \
+        >= out["serve_fleet_autoscale_p50_warm"] > 0
+    assert out["serve_coldstart_trace"]["kind"] == "spike"
+
+
+@pytest.mark.slow
+def test_section_serve_coldstart_deterministic_across_runs():
+    """The seed-determined cold-start fields replay exactly: the
+    bit-match verdict, the registration/hit/miss accounting on a fresh
+    cache dir per run, the demotion count (deserialize failures are
+    per-program deterministic), and the scale ledger. The wall clocks
+    (join windows, the warm p99) are excluded."""
+    bench = _bench_mod()
+    a = bench.section_serve_coldstart()
+    b = bench.section_serve_coldstart()
+    for key in ("serve_coldstart_requests", "serve_coldstart_budget",
+                "serve_coldstart_trace", "serve_coldstart_bitmatch",
+                "serve_coldstart_registered",
+                "serve_coldstart_warm_hits",
+                "serve_coldstart_warm_misses",
+                "serve_coldstart_populate_misses",
+                "serve_coldstart_demoted",
+                "serve_coldstart_autoscale_ups",
+                "serve_coldstart_warm_compiles",
+                "serve_coldstart_populate_compiles",
+                "serve_coldstart_warm_compile_errors"):
+        assert a[key] == b[key], key
+
+
 @pytest.mark.slow
 def test_section_serve_engine_deterministic_across_runs():
     """Two runs of the section agree on every seed-determined field

@@ -111,6 +111,14 @@ def test_burnin_level(jax8):
     assert r.checks["fleet_scale_ok"]
     assert r.checks["fleet_scale_warm_blocks"] >= 1
     assert r.checks["fleet_scale_joiner_hits"] > 0
+    # the cold-start gate (ISSUE 19): a warmed engine bit-matches the
+    # plain cold engine on a shared-prefix wave (the AOT cache moves
+    # compiles, never bits), and a second bring-up against the same
+    # cache dir lands real probe hits — the persistent cache proven
+    # on this backend's real serialization (or trace-only demotion)
+    assert r.checks["aot_warm_ok"], r.checks.get("aot_warm_error")
+    assert r.checks["aot_warm_registered"] >= 1
+    assert r.checks["aot_warm_second_hits"] >= 1
 
 
 @pytest.mark.slow

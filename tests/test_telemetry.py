@@ -1005,6 +1005,9 @@ def test_fleet_scale_gauge_counters_and_span_export(jax8, tmp_path):
         assert s["args"]["trigger"] in ("backlog", "deadline_slack")
         assert s["args"]["replica"].startswith("replica-")
         assert "warm" in s["args"]
+        # ISSUE 19: every up-span records whether the joiner AOT-
+        # warmed its step family (False here — no aot_cache lever)
+        assert s["args"]["warm_compile"] is False
         # a capture distinguishes thread joins from process spawns
         assert s["args"]["transport"] == "inproc"
     for s in downs:
@@ -1024,6 +1027,73 @@ def test_fleet_scale_gauge_counters_and_span_export(jax8, tmp_path):
     assert reg2.counter("fleet_scale_down_total").value == 0
     assert not [e for e in reg2.events
                 if e["kind"] == "span" and e["name"] == "fleet_scale"]
+
+
+def test_aot_warm_instruments_export(jax8, tmp_path):
+    """ISSUE 19's cold-start telemetry, golden-tested on one registry:
+    the populating bring-up bills ``aot_cache_miss_total`` per
+    registration and sets ``engine_warmup_ms``; priming (the engine's
+    first run) sets ``join_first_token_ms``; a second bring-up against
+    the same cache dir bills ``aot_cache_hit_total``; and all four
+    instruments land in the prometheus export. An engine without the
+    lever keeps every aot instrument silent on a fresh registry."""
+    import jax
+    import jax.numpy as jnp
+
+    from nvidia_terraform_modules_tpu.models import (
+        BurnInConfig,
+        init_params,
+        make_serve_engine,
+    )
+
+    cfg = BurnInConfig(vocab=64, d_model=32, n_heads=2, d_ff=64,
+                       n_layers=1, seq_len=16, batch=2)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    cache_dir = str(tmp_path / "gac")
+    reg = Registry(str(tmp_path / "t"))
+
+    eng = make_serve_engine(params, cfg, max_len=12, kv_block=4,
+                            aot_cache=cache_dir, telemetry=reg)
+    w1 = eng.warm(slots=2, prompt_lens=(4, 6), n_new=3)
+    assert w1["enabled"] and w1["registered"] >= 1
+    assert w1["misses"] == w1["registered"] and w1["hits"] == 0
+    assert reg.counter("aot_cache_miss_total").value == w1["misses"]
+    assert reg.counter("aot_cache_hit_total").value == 0
+    assert reg.gauge("engine_warmup_ms").value == w1["warm_ms"] > 0
+    # priming drove the engine's first run → the joiner's clock is set
+    assert reg.gauge("join_first_token_ms").value > 0
+
+    eng2 = make_serve_engine(params, cfg, max_len=12, kv_block=4,
+                             aot_cache=cache_dir, telemetry=reg)
+    w2 = eng2.warm(slots=2, prompt_lens=(4, 6), n_new=3)
+    assert w2["hits"] >= 1 and not w2["errors"]
+    assert reg.counter("aot_cache_hit_total").value == w2["hits"]
+
+    prom = reg.prometheus_text()
+    for line in ("# TYPE aot_cache_hit_total counter",
+                 f"aot_cache_hit_total {w2['hits']}",
+                 "# TYPE aot_cache_miss_total counter",
+                 "# TYPE engine_warmup_ms gauge",
+                 "# TYPE join_first_token_ms gauge"):
+        assert line in prom, line
+
+    # unwind the sticky cache activation so later tests compile
+    # against the default jax config
+    eng2.aot_cache.deactivate()
+    eng.aot_cache.deactivate()
+
+    # defaults off: no lever → every aot instrument stays silent
+    reg2 = Registry(str(tmp_path / "quiet"))
+    plain = make_serve_engine(params, cfg, max_len=12, kv_block=4,
+                              telemetry=reg2)
+    plain([jnp.arange(1, 5, dtype=jnp.int32)], 3, slots=2)
+    assert reg2.counter("aot_cache_hit_total").value == 0
+    assert reg2.counter("aot_cache_miss_total").value == 0
+    ws = plain.warm(slots=2, prompt_lens=(4,), n_new=2)
+    assert ws == {"enabled": False, "registered": 0, "hits": 0,
+                  "misses": 0, "serialized": 0, "traceonly": 0,
+                  "demoted": 0, "quarantined": 0, "primed": 0,
+                  "errors": []}
 
 
 def test_transport_frame_and_rtt_instruments_export(tmp_path):
