@@ -280,6 +280,19 @@ resource "kubernetes_job_v1" "tpu_smoketest" {
             }
           }
 
+          # durable disk tail for the serving prefix CDN: the burn-in's
+          # prefix_cdn_ok leg files prefix chains here and proves a
+          # restarted fleet comes back warm (README "Prefix CDN runbook")
+          dynamic "env" {
+            for_each = var.smoketest.disk_spill_dir != null ? {
+              TPU_PREFIX_DISK_SPILL = var.smoketest.disk_spill_dir
+            } : {}
+            content {
+              name  = env.key
+              value = env.value
+            }
+          }
+
           # libtpu's DCN transport for cross-slice collectives
           dynamic "env" {
             for_each = length(local.smoke_slice_order) > 1 ? {

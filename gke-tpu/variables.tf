@@ -306,6 +306,17 @@ variable "smoketest" {
     # PVC mount (or any pod-visible path you collect) — the bundled
     # single-file payload ignores it; the installable package honours it.
     telemetry_dir = optional(string)
+    # durable home for the serving prefix CDN's disk tail (sets
+    # TPU_PREFIX_DISK_SPILL in the smoketest pods): an absolute path on
+    # node-attached local SSD, the checkpoint PVC mount, or a GCS-fuse
+    # mounted bucket. The burn-in's prefix_cdn_ok leg files prefix
+    # chains there (models/hostkv.py DiskChainStore: crc-framed,
+    # tmp+fsync+rename) and proves a restarted fleet comes back warm
+    # from disk; see the "Prefix CDN runbook" in README.md. null skips
+    # the leg — and leaves serving-shaped pools one fleet restart away
+    # from a cold Zipf head (the tpu-serving-no-durable-prefix lint
+    # rule flags that posture when host-spill wiring is visible).
+    disk_spill_dir = optional(string)
   })
   default = {}
 

@@ -781,3 +781,98 @@ def check_spawn_no_retry_classify(ctx: PyContext):
                            f"spawn in utils/retry.retry_call with a "
                            f"bounded policy and classify exhaustion "
                            f"as the terminal (DEAD, redrive) error")
+
+
+# ---------------------------------------------- durable write atomicity
+
+# where durable serving-runtime state lives: model/engine persistence
+# (checkpoints, the AOT compile cache, the disk prefix tier, elastic
+# supervisor state) and the shared utils. tfsim's state files have
+# their own locking/backup discipline and are out of scope here.
+_DURABLE_SCOPE = ("models/", "utils/")
+# the atomic-durability idiom's signals: a scope that renames a tmp
+# file into place (os.replace/os.rename) — or at least fsyncs what it
+# wrote — has done the crash-safety work this rule checks for
+_ATOMIC_CALLS = {"os.replace", "os.rename", "os.fsync"}
+# never-atomic pathlib one-shots (no handle to fsync, no tmp+rename)
+_PATH_WRITES = {"write_bytes", "write_text"}
+
+
+def _write_mode(ctx: PyContext, fname: str, call: ast.Call):
+    """The constant mode string of an ``open``/``io.open`` call when it
+    WRITES (contains w/x/a), else None. Dynamic modes are skipped —
+    best-effort, like every rule here."""
+    if ctx.resolve(fname, call.func) not in ("open", "io.open"):
+        return None
+    mode = call.args[1] if len(call.args) >= 2 else next(
+        (kw.value for kw in call.keywords if kw.arg == "mode"), None)
+    if mode is None:
+        return None                      # default "r": a read
+    if not (isinstance(mode, ast.Constant)
+            and isinstance(mode.value, str)):
+        return None
+    return mode.value if set(mode.value) & set("wxa") else None
+
+
+def _scope_is_atomic(ctx: PyContext, fname: str, scope: ast.AST) -> bool:
+    return any(
+        isinstance(n, ast.Call)
+        and ctx.resolve(fname, n.func) in _ATOMIC_CALLS
+        for n in walk_scope(scope))
+
+
+def _tmp_marked(node: ast.AST) -> bool:
+    """True when the written path's expression names the TMP half of
+    the atomic idiom (``tmp = f"{path}.tmp.{pid}"``; the os.replace
+    that publishes it may live in an outer scope or a helper)."""
+    return "tmp" in ast.unparse(node).lower()
+
+
+@rule("graft-durable-write-no-atomic", severity="error",
+      family="durability",
+      summary="durable serving-runtime writes must be tmp+replace/fsync")
+def check_durable_write_no_atomic(ctx: PyContext):
+    """A serving-runtime file written WITHOUT the atomic durability
+    idiom is a torn-state bug waiting for a SIGKILL: a reader after
+    the crash sees a half-written frame where the contract (checkpoint
+    shards, the GAC1 AOT cache, the PCD1 disk prefix tier, supervisor
+    state) promises either the old record or the new one. Flags
+    write-mode ``open()`` calls (and the never-atomic
+    ``Path.write_bytes``/``write_text``) in the durable-scope files
+    whose function scope neither renames a tmp file into place
+    (``os.replace``/``os.rename``) nor fsyncs, and whose target path
+    is not itself the tmp half of the idiom. The blessed shape:
+    write ``f"{path}.tmp.{pid}"``, flush + ``os.fsync``, then
+    ``os.replace(tmp, path)``."""
+    for fname, tree in ctx.trees():
+        if not any(frag in fname for frag in _DURABLE_SCOPE):
+            continue
+        scopes = [tree] + [n for n in ctx.nodes(fname)
+                           if isinstance(n, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))]
+        for scope in scopes:
+            if _scope_is_atomic(ctx, fname, scope):
+                continue
+            for n in walk_scope(scope):
+                if not isinstance(n, ast.Call):
+                    continue
+                where = f"{fname}:{n.lineno}"
+                mode = _write_mode(ctx, fname, n)
+                if mode is not None and n.args \
+                        and not _tmp_marked(n.args[0]):
+                    yield (where,
+                           f"open(..., {mode!r}) writes durable "
+                           f"serving-runtime state in place — a crash "
+                           f"mid-write leaves a torn file where "
+                           f"readers expect old-or-new; write to a "
+                           f"tmp name, flush + os.fsync, then "
+                           f"os.replace(tmp, path) (the aotcache/"
+                           f"DiskChainStore idiom)")
+                elif isinstance(n.func, ast.Attribute) \
+                        and n.func.attr in _PATH_WRITES \
+                        and not _tmp_marked(n.func.value):
+                    yield (where,
+                           f".{n.func.attr}() writes durable state in "
+                           f"one unsynced shot — no handle to fsync, "
+                           f"no tmp+rename; use the atomic idiom "
+                           f"(tmp file + os.fsync + os.replace)")

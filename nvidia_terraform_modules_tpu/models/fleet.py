@@ -183,6 +183,14 @@ from .transport import InProcTransport, MultiProcTransport, Transport
 
 _ROUTINGS = ("affinity", "random")
 
+# prefix-CDN residency routing: how deep an affinity target's predicted
+# backlog may grow before a STORE-RESIDENT chain reroutes least-loaded
+# (any replica admits it warm from the shared store, so the override
+# costs no re-prefill); chains outside the store keep strict affinity.
+# affinity_queue_bound= overrides this for resident and non-resident
+# chains alike.
+_CDN_QUEUE_BOUND = 4
+
 
 def _blake_int(data: bytes) -> int:
     return int.from_bytes(
@@ -848,6 +856,8 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                autoscale: AutoscalePolicy | None = None,
                warm_join: bool = True,
                warm_blocks: int | None = None,
+               disk_spill: str | None = None,
+               cdn_blocks: int | None = None,
                transport: str | Transport = "inproc",
                join_timeout_s: float = 600.0,
                **engine_kw):
@@ -925,6 +935,26 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
     ``max(4·prefix_keep_blocks, 64)``), so the Zipf-head working set is
     inherited instead of re-prefilled; the first matching admission
     swaps each chain in through the ordinary crc-verified tiered path.
+
+    ``disk_spill=<dir>`` arms the DURABLE PREFIX CDN (requires
+    ``share_prefix`` + affinity routing; colocated only): ONE
+    fleet-shared :class:`~.hostkv.WarmChainStore` (``cdn_blocks``
+    rows, default as ``warm_blocks``) replaces the replicas' N private
+    host pools — in-proc replicas mount it directly (host footprint
+    N× the working set → 1×), process-isolated replicas run a private
+    host tier seeded from it at every bring-up — backed by a
+    crash-safe :class:`~.hostkv.DiskChainStore` under ``<dir>``
+    (crc-framed file per chain, atomic tmp+fsync+rename writes,
+    corrupt frames quarantined with a reason, unreachable disk =
+    degraded two-tier serving, never a crash). A fresh fleet over an
+    existing directory restores the store RAM-warm from disk, so the
+    Zipf-head template working set survives a FULL fleet restart; the
+    routing plan additionally consults the store's residency snapshot
+    — a store-resident chain may reroute from a backlogged affinity
+    target to the least-loaded replica, since any replica admits it
+    warm. ``disk_spill=None`` (default) reproduces the store-less
+    fleet byte for byte; stats gain a ``"cdn"`` record (store ledger,
+    residency reroutes, host-bytes bill).
     A scale-DOWN reuses the planned-drain machinery
     (``AdmissionSource.draining()``): in-flight work finishes, queued
     work moves, and the drained replica PUBLISHES its retained chains
@@ -1019,6 +1049,33 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
     if warm_blocks is not None and warm_blocks < 1:
         raise ValueError(
             f"warm_blocks must be >= 1, got {warm_blocks}")
+    if cdn_blocks is not None and cdn_blocks < 1:
+        raise ValueError(
+            f"cdn_blocks must be >= 1, got {cdn_blocks}")
+    cdn_on = disk_spill is not None
+    if cdn_on:
+        if routing != "affinity":
+            raise ValueError(
+                "disk_spill arms the prefix CDN — its residency map is "
+                "keyed on the affinity chain key; use routing='affinity'")
+        if disaggregate:
+            raise ValueError(
+                "disk_spill applies to colocated fleets — the prefix "
+                "CDN rides the decode replicas' tiered index (see "
+                "host_spill × disaggregate)")
+        if not engine_kw.get("share_prefix"):
+            raise ValueError(
+                "disk_spill is the prefix index's CDN tier — pass "
+                "share_prefix=True (there is nothing to publish "
+                "without an index)")
+        if engine_kw.get("host_spill") or \
+                engine_kw.get("shared_store") is not None:
+            raise ValueError(
+                "disk_spill owns the tier wiring: the fleet decides "
+                "per transport whether replicas mount the shared store "
+                "directly (in-proc) or run a seeded private host tier "
+                "(process-isolated) — drop host_spill/shared_store "
+                "from engine_kw")
     if join_timeout_s <= 0:
         raise ValueError(
             f"join_timeout_s must be > 0, got {join_timeout_s}")
@@ -1095,6 +1152,35 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
              for t, ts in res[f"kills_{side}"].items()]
             + [(ts, t, "drain")
                for t, ts in res[f"drains_{side}"].items()])
+    # durable prefix CDN (disk_spill=): ONE fleet-shared RAM store with
+    # a crash-safe disk tail behind it — built BEFORE the transport
+    # configures so the engine levers it implies are part of the
+    # engine key. Restore happens here too: a fresh fleet over an
+    # existing directory scans + verifies every PCD1 frame and comes
+    # up with the Zipf head RAM-warm (quarantining every bad frame).
+    cdn_store = None
+    disk_store = None
+    if cdn_on:
+        from .hostkv import DiskChainStore, WarmChainStore
+
+        cb = (cdn_blocks if cdn_blocks is not None
+              else warm_blocks if warm_blocks is not None
+              else max(4 * engine_kw.get("prefix_keep_blocks", 64), 64))
+        disk_store = DiskChainStore(disk_spill, telemetry=reg)
+        cdn_store = WarmChainStore(
+            cfg, cb, block_size=kv_block,
+            cache_dtype=engine_kw.get("cache_dtype", "bf16"),
+            disk=disk_store)
+        if tr.process_isolated:
+            # the store cannot cross the pickle boundary — children
+            # run their PRIVATE host tier and the parent-side store
+            # seeds it at every bring-up (set_warm below) and drains
+            # it back through the chain sink at every close
+            engine_kw = dict(engine_kw, host_spill=True)
+        else:
+            # replicas mount the shared store directly: N private
+            # host pools collapse to 1× the working set
+            engine_kw = dict(engine_kw, shared_store=cdn_store)
     # the transport owns engine construction and replica execution:
     # in-proc builds every engine eagerly here (registry shared so
     # router + engine spans stitch on one timeline; scale-up joiners
@@ -1113,7 +1199,11 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
     warm_on = (scale_on and warm_join and routing == "affinity"
                and bool(engine_kw.get("share_prefix"))
                and bool(engine_kw.get("host_spill")))
-    if warm_on:
+    if cdn_on:
+        # the CDN store IS the warm store: close/drain publishes land
+        # in it (write-through to disk), joiners take from it
+        warm_store = cdn_store
+    elif warm_on:
         from .hostkv import WarmChainStore
 
         wb = (warm_blocks if warm_blocks is not None
@@ -1133,7 +1223,8 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
         _c_scale_up = reg.counter("fleet_scale_up_total")
         _c_scale_down = reg.counter("fleet_scale_down_total")
 
-    def _plan(prompts, budgets, arrivals, deadlines, route_events):
+    def _plan(prompts, budgets, arrivals, deadlines, route_events,
+              cdn_res=None):
         """Deterministic routing + shed + SCALE plan — a pure function
         of the trace (prompt tokens, arrivals, budgets, deadlines),
         the route seed, the fault profile's capacity schedule AND the
@@ -1170,6 +1261,7 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
         ev = sorted(route_events)
         pending_ev: dict[int, list[tuple[float, str]]] = {}
         scale_events: list[dict] = []
+        res_routed = [0]
         last_scale = [float("-inf")]
         rnd_scale = (random.Random(f"fleet-scale-{autoscale.seed}")
                      if scale_on else None)
@@ -1291,9 +1383,10 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
             a = arr(req)
             advance(a)
             aff_ok = routing == "affinity"
+            root_key = None
             if routing == "affinity":
-                t_aff = ring_plan.target(
-                    affinity_key(prompts[req], kv_block))
+                root_key = affinity_key(prompts[req], kv_block)
+                t_aff = ring_plan.target(root_key)
                 if t_aff not in live:
                     # elastic churn can leave the ring's LAST entry a
                     # dead target (a ring never empties) — the plan
@@ -1310,6 +1403,21 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                 if backlog_t >= affinity_queue_bound:
                     t = least_loaded(a)
                     by_aff = by_aff and t == t_aff
+            elif (cdn_res is not None and root_key is not None
+                  and root_key in cdn_res):
+                # GLOBAL-residency override (prefix CDN): this chain is
+                # warm in the fleet-shared store, so EVERY replica can
+                # admit it without re-prefilling — a backlogged
+                # affinity target may be overridden least-loaded
+                # without losing the prefix. Chains NOT in the store
+                # keep strict affinity (their warmth lives in one
+                # replica's device index).
+                backlog_t = sum(1 for f in finishes[t_aff] if f > a)
+                if backlog_t >= _CDN_QUEUE_BOUND:
+                    t2 = least_loaded(a)
+                    if t2 != t_aff:
+                        t, by_aff = t2, False
+                        res_routed[0] += 1
             start = max(a, busy_until[t])
             finish = start + svc(req)
             if deadlines is not None and finish - a > deadlines[req]:
@@ -1336,7 +1444,8 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
             eval_policy(a)
         advance(float("inf"))
         plan = [(req, *placed[req]) for req in sorted(placed)]
-        return plan, sorted(shed), scale_events, len(busy_until)
+        return (plan, sorted(shed), scale_events, len(busy_until),
+                res_routed[0])
 
     def fleet(prompts: Sequence[Any], n_new, *, slots: int = 4,
               eos_id: int | None = None, rng=None, arrivals=None,
@@ -1374,9 +1483,14 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
         resolved_call = (faults.resolve(n_dec, n_pre, elastic_dec=True)
                          if scale_on and faults is not None
                          else resolved)
-        plan, shed, scale_events, n_total = _plan(
+        # the CDN residency SNAPSHOT is part of the plan's inputs: one
+        # read at call start (which chains the shared store holds, RAM
+        # or disk), so placements replay exactly for a given store
+        # state — the plan never races live publishes mid-call
+        plan, shed, scale_events, n_total, res_routed_n = _plan(
             prompts, budgets, arrivals, deadlines,
-            _route_events(resolved_call))
+            _route_events(resolved_call),
+            cdn_res=(cdn_store.residency() if cdn_on else None))
         if scale_on and resolved_call is not None:
             targeted = (set(resolved_call["kills_dec"])
                         | set(resolved_call["drains_dec"])
@@ -1617,6 +1731,21 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
         # reaches their event timestamp (poll-boundary execution,
         # like fault kills)
         dec_handles: list[Any] = [None] * n_dec_run
+        base_seeded = [0]
+        if cdn_on and tr.process_isolated:
+            # process-isolated CDN: the store cannot be mounted across
+            # the pickle boundary, so every BASE replica's private host
+            # tier is seeded with its keyspace share before launch —
+            # the disk-restored Zipf head rides the same crc-verified
+            # set_warm path elastic joiners use (take() copies; the
+            # store keeps its rows for the next bring-up)
+            ring_seed = HashRing(n_dec)
+            for i in range(n_dec):
+                chains = warm_store.take(
+                    lambda root, i=i: ring_seed.target(root) == i)
+                if chains:
+                    dec_queues[i].set_warm(chains)
+                    base_seeded[0] += len(chains)
         for i in range(n_dec):
             _warm_compile(i)             # no-op without an aot_cache
             dec_handles[i] = tr.launch_decode(
@@ -1698,7 +1827,8 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                 ring_run.add(i)
             chains = (warm_store.take(
                 lambda root: ring_run.target(root) == i)
-                if warm_store is not None else [])
+                if warm_store is not None
+                and (not cdn_on or tr.process_isolated) else [])
             if chains:
                 q.set_warm(chains)
                 warm_joins[0] += 1
@@ -2246,6 +2376,22 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
                 "spill": ({**spill_agg,
                            "swap_ms": round(spill_agg["swap_ms"], 3)}
                           if spill_on else None),
+                # durable prefix CDN (None when disk_spill is off —
+                # absence must not read as "an empty store"): the
+                # shared store's ledger (nested disk record carries
+                # quarantine reasons + degraded count), the residency
+                # reroutes this plan took, and the footprint bill —
+                # ONE shared pool vs what n replicas' private pools
+                # of the same capacity would pin
+                "cdn": (None if not cdn_on else {
+                    "residency_routed": res_routed_n,
+                    "base_seeded_chains": base_seeded[0],
+                    "host_bytes_shared":
+                        cdn_store.stats()["host_bytes"],
+                    "host_bytes_private_equiv":
+                        n_dec_run * cdn_store.stats()["host_bytes"],
+                    "store": cdn_store.stats(),
+                }),
                 "faults": (None if not fault_on else {
                     "profile_seed": faults.seed,
                     "replica_down": len(killed_labels),
@@ -2305,4 +2451,7 @@ def make_fleet(params, cfg: BurnInConfig, *, max_len: int,
     # make_fleet calls, and close() is how they reap them
     fleet.transport = tr
     fleet.close = tr.close
+    # the CDN store too (None without disk_spill): restart tests and
+    # ops tooling read residency()/stats() directly
+    fleet.cdn_store = cdn_store
     return fleet

@@ -44,6 +44,10 @@ off) across the scheduler-lever matrix.
 
 from __future__ import annotations
 
+import os
+import pickle
+import struct
+import threading
 import zlib
 from collections import OrderedDict
 from typing import Any, Sequence
@@ -150,6 +154,13 @@ class HostBlockPool:
     @property
     def high_water(self) -> int:
         return self._alloc.high_water
+
+    @property
+    def nbytes(self) -> int:
+        """Host bytes the pool's buffers pin — the footprint the
+        fleet-shared store bills as 1× against N× private pools."""
+        return int(sum(buf.nbytes for bufs in self._bufs.values()
+                       for buf in bufs))
 
     def stats(self) -> dict[str, int]:
         return {
@@ -293,6 +304,389 @@ class HostBlockPool:
             self._pool = None
 
 
+_PCD_MAGIC = b"PCD1"
+_PCD_HEADER = struct.Struct(">II")      # body length, crc32(body)
+_PCD_SUFFIX = ".pcd"
+
+# transient-IO retry: tiny deterministic backoff — a disk-tier op runs
+# on the serving path, so the budget is milliseconds, not the
+# control-plane's seconds; exhaustion degrades to the two-tier path
+# (billed), it never stalls or crashes the wave loop
+_DISK_RETRY_KW: dict[str, Any] = {}
+
+
+def _disk_retry(fn, what: str):
+    from ..utils.retry import RetriesExhausted, RetryPolicy, retry_call
+
+    if not _DISK_RETRY_KW:
+        _DISK_RETRY_KW["policy"] = RetryPolicy(
+            initial_s=0.005, multiplier=2.0, cap_s=0.02,
+            max_attempts=3, jitter=False)
+    try:
+        return True, retry_call(fn, policy=_DISK_RETRY_KW["policy"],
+                                what=what, retryable=(OSError,))
+    except RetriesExhausted:
+        return False, None
+
+
+class DiskChainCorruptError(RuntimeError):
+    """A disk-tier chain record failed its frame verification (bad
+    magic, truncated, crc mismatch, stale key, or a chunk chain that no
+    longer hashes to its filename) — a CLASSIFIED integrity failure:
+    the record is QUARANTINED with a reason and the chain is re-served
+    from a warmer tier or re-prefilled, never decoded from the corrupt
+    frame."""
+
+
+class DiskChainStore:
+    """Crash-safe DISK tier behind the fleet-shared
+    :class:`WarmChainStore`: one crc32-framed file per LEAF chain key
+    under sha-sharded dirs, holding the LRU long tail so the Zipf head
+    of template prefixes survives a FULL fleet restart.
+
+    This is the ``aotcache.py`` GAC1 discipline applied to KV chains:
+
+    - **filename** = the leaf ``paging.chain_key`` hex under
+      ``objects/<hex[:2]>/`` (content addressing — placement, routing
+      and durability all name a chain identically);
+    - **frame** = ``PCD1`` magic + ``(length, crc32)`` header + a
+      pickled record carrying the UN-hashed key, a persisted
+      monotonic ``seq`` (write order — the restore heat order; never
+      mtime, wallclock has no place in a deterministic restore), the
+      full chunk chain and the whole-chain block payload;
+    - **write** = tmp file + flush + ``os.fsync`` + ``os.replace`` —
+      a SIGKILL at ANY instant leaves either the old record or the new
+      one, never a torn frame (the fsync is the upgrade over the AOT
+      cache: a KV chain must survive power loss, not just process
+      death);
+    - **read** = verify EVERY frame — magic, header, body crc,
+      unpickle, record-key-vs-filename (stale key), and
+      ``chain_key(chunks) == key`` re-derivation — and QUARANTINE a
+      bad file under ``quarantine/`` with a reason, billed, never
+      silently served;
+    - **transient IO** is retried under the classified
+      ``utils/retry`` policy; exhaustion (and an unreadable/missing
+      store directory) flips the op to a MISS and bills ``degraded``
+      — the serving path shrinks to two tiers, it never crashes and
+      never imports garbage.
+    """
+
+    def __init__(self, path: str, *, telemetry=None):
+        self.path = os.path.abspath(str(path))
+        self.objects_dir = os.path.join(self.path, "objects")
+        self.quarantine_dir = os.path.join(self.path, "quarantine")
+        self._lock = threading.Lock()
+        self._reg = telemetry           # None → global registry, lazily
+        # leaf key → (chunks, seq, root key); node key → leaf key
+        self._catalog: dict[bytes, tuple[tuple, int, bytes]] = {}
+        self._node_leaf: dict[bytes, bytes] = {}
+        self._seq = 0
+        self._tmp_seq = 0
+        self.dead = False               # the whole tier is unreachable
+        self.stored_chains = 0
+        self.loaded_chains = 0
+        self.quarantined = 0
+        self.quarantine_reasons: list[str] = []
+        self.degraded = 0               # ops lost to transient-IO
+        #                                 exhaustion / a dead tier
+        ok, _ = _disk_retry(self._ensure_dirs, "disk tier mkdir")
+        if not ok:
+            self.dead = True
+            self._note_degraded()
+            return
+        with self._lock:
+            self._scan_locked()
+
+    def _registry(self):
+        if self._reg is None:
+            from ..telemetry import get_registry
+
+            self._reg = get_registry()
+        return self._reg
+
+    def _note_degraded(self) -> None:
+        """Bill one lost op: the local ledger plus the fleet counter
+        the prefix-CDN runbook watches (a NullRegistry absorbs the inc
+        when telemetry is off)."""
+        self.degraded += 1
+        self._registry().counter("prefix_disk_degraded_total").inc()
+
+    def _ensure_dirs(self) -> None:
+        os.makedirs(self.objects_dir, exist_ok=True)
+        os.makedirs(self.quarantine_dir, exist_ok=True)
+
+    # -------------------------------------------------------- framing
+
+    @staticmethod
+    def _chain_nodes(chunks) -> list[bytes]:
+        from .paging import chain_key
+
+        return [chain_key(chunks, k) for k in range(1, len(chunks) + 1)]
+
+    def _entry_path(self, leaf: bytes) -> str:
+        hexkey = leaf.hex()
+        return os.path.join(self.objects_dir, hexkey[:2],
+                            hexkey + _PCD_SUFFIX)
+
+    @staticmethod
+    def _encode(record: dict) -> bytes:
+        body = pickle.dumps(record, protocol=pickle.HIGHEST_PROTOCOL)
+        return (_PCD_MAGIC
+                + _PCD_HEADER.pack(len(body), zlib.crc32(body))
+                + body)
+
+    @staticmethod
+    def _decode(raw: bytes, leaf: bytes) -> dict:
+        """Verify one frame end to end; raises
+        :class:`DiskChainCorruptError` with the REASON (the quarantine
+        record's why) on any failure."""
+        if raw[:len(_PCD_MAGIC)] != _PCD_MAGIC:
+            raise DiskChainCorruptError("bad magic (foreign or "
+                                        "corrupt file)")
+        off = len(_PCD_MAGIC)
+        if len(raw) < off + _PCD_HEADER.size:
+            raise DiskChainCorruptError("truncated header")
+        length, crc = _PCD_HEADER.unpack_from(raw, off)
+        body = raw[off + _PCD_HEADER.size:]
+        if len(body) != length:
+            raise DiskChainCorruptError(
+                f"truncated body ({len(body)} bytes of {length})")
+        if zlib.crc32(body) != crc:
+            raise DiskChainCorruptError(
+                f"body crc mismatch (stored {crc:#010x}, "
+                f"read {zlib.crc32(body):#010x})")
+        try:
+            record = pickle.loads(body)
+        except Exception as exc:
+            raise DiskChainCorruptError(
+                f"unpicklable body ({type(exc).__name__})") from exc
+        if not isinstance(record, dict) or "key" not in record:
+            raise DiskChainCorruptError("foreign record shape")
+        if record["key"] != leaf:
+            raise DiskChainCorruptError(
+                "stale key: record names a different chain than its "
+                "filename (renamed or misplaced file)")
+        chunks = record.get("chunks") or ()
+        from .paging import chain_key
+
+        if not chunks or chain_key(chunks) != leaf:
+            raise DiskChainCorruptError(
+                "chunk chain no longer hashes to the record key")
+        payload = record.get("payload")
+        if not isinstance(payload, dict) or not payload:
+            raise DiskChainCorruptError("missing block payload")
+        n = len(chunks)
+        for k, bufs in payload.items():
+            for buf in bufs:
+                if int(np.asarray(buf).shape[0]) != n:
+                    raise DiskChainCorruptError(
+                        f"payload[{k!r}] carries "
+                        f"{int(np.asarray(buf).shape[0])} block rows "
+                        f"for a {n}-node chain")
+        return record
+
+    def _quarantine(self, fpath: str, reason: str) -> None:
+        """Move a bad file aside LOUDLY — the aotcache discipline: a
+        corrupt record must never be re-read as a miss-then-hit, and
+        the reason must survive for the post-mortem."""
+        name = os.path.basename(fpath)
+        why = f"{name}: {reason}"
+        ok, _ = _disk_retry(
+            lambda: os.replace(fpath,
+                               os.path.join(self.quarantine_dir, name)),
+            "disk tier quarantine")
+        if not ok:
+            self._note_degraded()
+        self.quarantined += 1
+        self.quarantine_reasons.append(why)
+        self._registry().counter("prefix_disk_quarantine_total").inc()
+
+    # ----------------------------------------------------------- scan
+
+    def _scan_locked(self) -> None:
+        """Restore-time walk: verify EVERY frame once, build the
+        in-RAM catalog (hottest = highest seq), quarantine every bad
+        file with a reason. An unreadable objects tree kills the whole
+        tier (degraded, never a crash)."""
+        def listing():
+            out = []
+            for shard in sorted(os.listdir(self.objects_dir)):
+                sdir = os.path.join(self.objects_dir, shard)
+                if not os.path.isdir(sdir):
+                    continue
+                for name in sorted(os.listdir(sdir)):
+                    if name.endswith(_PCD_SUFFIX):
+                        out.append(os.path.join(sdir, name))
+            return out
+
+        ok, files = _disk_retry(listing, "disk tier scan")
+        if not ok:
+            self.dead = True
+            self._note_degraded()
+            return
+        for fpath in files:
+            name = os.path.basename(fpath)[:-len(_PCD_SUFFIX)]
+            try:
+                leaf = bytes.fromhex(name)
+            except ValueError:
+                self._quarantine(fpath, "non-hex filename")
+                continue
+            ok, raw = _disk_retry(
+                lambda p=fpath: open(p, "rb").read(),
+                "disk tier read")
+            if not ok:
+                self._note_degraded()
+                continue
+            try:
+                record = self._decode(raw, leaf)
+            except DiskChainCorruptError as exc:
+                self._quarantine(fpath, str(exc))
+                continue
+            self._index_locked(leaf, record["chunks"],
+                               int(record["seq"]))
+        self._seq = 1 + max(
+            (seq for _c, seq, _r in self._catalog.values()), default=-1)
+
+    def _index_locked(self, leaf: bytes, chunks, seq: int) -> None:
+        chunks = tuple(tuple(c) for c in chunks)
+        nodes = self._chain_nodes(chunks)
+        self._catalog[leaf] = (chunks, seq, nodes[0])
+        for nk in nodes:
+            # any chain through a node carries identical rows up to it
+            # (content addressing), so the hottest writer wins the map
+            self._node_leaf[nk] = leaf
+
+    # ------------------------------------------------------ store side
+
+    def has(self, leaf: bytes) -> bool:
+        with self._lock:
+            return leaf in self._catalog
+
+    def put(self, chunks, payload: dict) -> bool:
+        """Durably file one whole chain (wire-format ``payload`` rows
+        covering every node root→leaf). Atomic: tmp + flush + fsync +
+        ``os.replace`` — a kill mid-write leaves the previous record
+        (or nothing), never a torn frame. Returns False (billed
+        ``degraded``) when the tier is dead or transient IO exhausts
+        its retries."""
+        if self.dead:
+            self._note_degraded()
+            return False
+        chunks = tuple(tuple(c) for c in chunks)
+        if not chunks:
+            return False
+        from .paging import chain_key
+
+        leaf = chain_key(chunks)
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+            self._tmp_seq += 1
+            tmp_seq = self._tmp_seq
+        record = {
+            "key": leaf,
+            "seq": seq,
+            "chunks": chunks,
+            "payload": {k: [np.asarray(b) for b in bufs]
+                        for k, bufs in payload.items()},
+        }
+        frame = self._encode(record)
+        fpath = self._entry_path(leaf)
+        tmp = f"{fpath}.tmp.{os.getpid()}.{tmp_seq}"
+
+        def write():
+            os.makedirs(os.path.dirname(fpath), exist_ok=True)
+            with open(tmp, "wb") as f:
+                f.write(frame)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, fpath)
+
+        ok, _ = _disk_retry(write, "disk tier write")
+        if not ok:
+            self._note_degraded()
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+        with self._lock:
+            self._index_locked(leaf, chunks, seq)
+            self.stored_chains += 1
+        return True
+
+    # ------------------------------------------------------- load side
+
+    def get(self, leaf: bytes):
+        """``(chunks, payload)`` for one verified chain, or ``None``
+        (miss, quarantined-corrupt, or degraded IO — all safe, never
+        an exception into the serving path)."""
+        if self.dead:
+            return None
+        with self._lock:
+            if leaf not in self._catalog:
+                return None
+        fpath = self._entry_path(leaf)
+        ok, raw = _disk_retry(lambda: open(fpath, "rb").read(),
+                              "disk tier read")
+        if not ok:
+            self._note_degraded()
+            return None
+        try:
+            record = self._decode(raw, leaf)
+        except DiskChainCorruptError as exc:
+            self._quarantine(fpath, str(exc))
+            self._forget(leaf)
+            return None
+        with self._lock:
+            self.loaded_chains += 1
+        return record["chunks"], record["payload"]
+
+    def _forget(self, leaf: bytes) -> None:
+        with self._lock:
+            ent = self._catalog.pop(leaf, None)
+            if ent is None:
+                return
+            for nk in self._chain_nodes(ent[0]):
+                if self._node_leaf.get(nk) == leaf:
+                    del self._node_leaf[nk]
+
+    def node_leaf(self, node_key: bytes) -> bytes | None:
+        """The leaf chain (if any) whose path runs through
+        ``node_key`` — the disk tier's answer to "do you hold this
+        prefix continuation?"."""
+        with self._lock:
+            return self._node_leaf.get(node_key)
+
+    def hot_first(self) -> list[bytes]:
+        """Leaf keys by DESCENDING persisted seq — the restore heat
+        order (latest-written ≈ hottest; deterministic, no mtime)."""
+        with self._lock:
+            return sorted(self._catalog,
+                          key=lambda k: -self._catalog[k][1])
+
+    def roots(self) -> dict[bytes, bytes]:
+        """ROOT chain key → leaf key for every filed chain — the
+        router's global-residency view of the disk tier (the root key
+        doubles as ``fleet.affinity_key``)."""
+        with self._lock:
+            return {root: leaf
+                    for leaf, (_c, _s, root) in self._catalog.items()}
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "path": self.path,
+                "dead": self.dead,
+                "chains": len(self._catalog),
+                "stored_chains": self.stored_chains,
+                "loaded_chains": self.loaded_chains,
+                "quarantined": self.quarantined,
+                "quarantine_reasons": list(self.quarantine_reasons),
+                "degraded": self.degraded,
+            }
+
+
 class WarmChainStore:
     """FLEET-SHARED host tier for warm replica bring-up: chain-keyed
     prefix chains in one :class:`HostBlockPool`, published by replicas
@@ -319,15 +713,33 @@ class WarmChainStore:
     DROPPED loudly (billed, never migrated). Thread-safe: replicas
     publish from their run threads, the router takes from its monitor
     thread. A take COPIES — the store keeps its rows, so any number
-    of joiners can inherit the same head."""
+    of joiners can inherit the same head.
+
+    LOCKING is per-chain by PINNING, not one store-wide hold: the
+    registry lock guards only the catalog maps and counters, and a
+    reader (:meth:`take` / :meth:`fetch`) pins its chain's rows (+1
+    node refcount, under the lock) before copying them OUTSIDE the
+    lock — eviction of a pinned chain unfiles the catalog entry but
+    the rows survive until the unpin, so a multi-megabyte crc-verified
+    copy never stalls a concurrent publisher or the wave loop
+    (lockwatch-armed in ``tests/test_paging.py``: zero cycles, zero
+    held-sleeps).
+
+    With a :class:`DiskChainStore` behind it (``disk=``) this is the
+    fleet's three-tier prefix CDN: publishes WRITE THROUGH to disk
+    (outside the lock), construction RESTORES the hottest head back
+    into RAM, and a RAM miss on :meth:`fetch` falls through to the
+    verified disk frame — so the Zipf head survives a FULL fleet
+    restart, and a dead/corrupt disk tier only shrinks the CDN back
+    to two tiers (billed ``degraded``), never crashes it."""
 
     def __init__(self, cfg: BurnInConfig, host_blocks: int, *,
-                 block_size: int, cache_dtype: str = "bf16"):
-        import threading
-
+                 block_size: int, cache_dtype: str = "bf16",
+                 disk: "DiskChainStore | None" = None):
         self.pool = HostBlockPool(cfg, host_blocks,
                                   block_size=block_size,
                                   cache_dtype=cache_dtype)
+        self.disk = disk
         self._lock = threading.Lock()
         # leaf chain key → chunks tuple, LRU order; rows are filed
         # PER CHAIN NODE (``_rows``: node chain key → [host id,
@@ -341,6 +753,13 @@ class WarmChainStore:
         self.store_full_drops = 0       # publishes the full pool refused
         self.corrupt_dropped = 0        # takes that failed their crc
         self.taken_chains = 0           # chains handed to joiners
+        self.fetch_hits = 0             # RAM-tier fetch() chains served
+        self.fetch_blocks = 0
+        self.disk_hit_chains = 0        # fetches the disk tier saved
+        self.disk_hit_blocks = 0
+        self.disk_restored = 0          # chains re-warmed at construction
+        if disk is not None:
+            self._restore_from_disk()
 
     def __len__(self) -> int:
         with self._lock:
@@ -363,7 +782,21 @@ class WarmChainStore:
                 self.pool.free([row[0]])
                 del self._rows[nk]
 
-    def publish(self, chains: Sequence[tuple]) -> int:
+    def _unpin_locked(self, node_keys) -> None:
+        """Drop one PIN reference per node (lock held): a pinned row
+        whose owning chains were all evicted mid-copy frees here —
+        the deferred half of per-chain locking."""
+        for nk in node_keys:
+            row = self._rows.get(nk)
+            if row is None:
+                continue
+            row[1] -= 1
+            if row[1] == 0:
+                self.pool.free([row[0]])
+                del self._rows[nk]
+
+    def publish(self, chains: Sequence[tuple], *,
+                to_disk: bool = True) -> int:
         """Store ``(chunks, payload)`` chains (``payload`` in
         ``export_block_rows`` wire format covering the whole chain),
         given HOTTEST-first (``PrefixIndex.export_chains``' MRU
@@ -378,10 +811,19 @@ class WarmChainStore:
         squeeze (the retention promise the runbook makes); a chain
         bigger than the whole pool is refused up front, never allowed
         to evict everything and then fail anyway. Returns chains
-        newly stored."""
+        newly stored in RAM.
+
+        With a disk tier, every chain in the batch not already filed
+        there WRITES THROUGH — including chains the full RAM pool
+        refused, which is exactly the LRU long tail the disk exists
+        for. Disk IO runs OUTSIDE the registry lock (atomic frames
+        need no coordination), so a slow disk never stalls a
+        concurrent publisher, take, or the wave loop."""
         stored = 0
+        to_write: list[tuple[tuple, dict]] = []
+        batch = list(chains)
         with self._lock:
-            for chunks, payload in reversed(list(chains)):
+            for chunks, payload in reversed(batch):
                 chunks = tuple(tuple(c) for c in chunks)
                 if not chunks:
                     continue
@@ -418,6 +860,16 @@ class WarmChainStore:
                 self._chains[leaf] = chunks
                 self.published_chains += 1
                 stored += 1
+        if to_disk and self.disk is not None:
+            from .paging import chain_key
+
+            for chunks, payload in batch:
+                chunks = tuple(tuple(c) for c in chunks)
+                if not chunks or self.disk.has(chain_key(chunks)):
+                    continue
+                to_write.append((chunks, payload))
+            for chunks, payload in to_write:
+                self.disk.put(chunks, payload)
         return stored
 
     def take(self, owns) -> list[tuple[tuple, dict]]:
@@ -429,24 +881,148 @@ class WarmChainStore:
         chain is discarded from the store and billed, never handed
         out. Chains are returned sorted by key (publish order is
         thread-timing; the joiner's seeding order must not be) and
-        stay in the store — takes copy."""
-        out: list[tuple[tuple, dict]] = []
+        stay in the store — takes copy.
+
+        The registry lock is held only to SELECT and PIN each chain's
+        rows; the crc-verified copies run unlocked (pinned rows cannot
+        be freed under the reader), so a joiner inheriting a large
+        head never stalls concurrent publishers."""
         with self._lock:
+            picked: list[tuple[bytes, tuple, list, list]] = []
             for key in sorted(self._chains):
                 chunks = self._chains[key]
                 node_keys = self._node_keys(chunks)
                 if not owns(node_keys[0]):
                     continue
-                hids = [self._rows[nk][0] for nk in node_keys]
-                try:
-                    payload = self.pool.load(hids)
-                except HostSpillCorruptError:
-                    self._drop_chain_locked(key)
+                for nk in node_keys:
+                    self._rows[nk][1] += 1       # pin
+                picked.append((key, chunks, node_keys,
+                               [self._rows[nk][0] for nk in node_keys]))
+        out: list[tuple[tuple, dict]] = []
+        for key, chunks, node_keys, hids in picked:
+            try:
+                payload = self.pool.load(hids)   # lock NOT held
+            except HostSpillCorruptError:
+                with self._lock:
+                    if self._chains.get(key) is not None:
+                        self._drop_chain_locked(key)
                     self.corrupt_dropped += 1
-                    continue
-                self._chains.move_to_end(key)
-                out.append((chunks, payload))
+                    self._unpin_locked(node_keys)
+                continue
+            with self._lock:
+                if key in self._chains:
+                    self._chains.move_to_end(key)
+                self._unpin_locked(node_keys)
                 self.taken_chains += 1
+            out.append((chunks, payload))
+        return out
+
+    def fetch(self, chunks, start: int = 0):
+        """Residency-aware admission swap-in: the LONGEST run of
+        consecutive node rows ``start..`` of this exact chunk chain,
+        as ``(n, payload, disk_hit)`` — ``payload`` in wire format
+        ready for ``paging.import_block_rows`` — or ``None`` when no
+        tier holds node ``start``. RAM rows are pinned-then-copied
+        (crc-verified, registry lock never held across the copy); a
+        RAM miss falls through to the DISK tier's verified frame, and
+        a disk hit PROMOTES the whole chain back into RAM so the next
+        requester pays the RAM price. Corrupt rows are dropped and
+        billed, never returned."""
+        chunks = tuple(tuple(c) for c in chunks)
+        if not 0 <= start < len(chunks):
+            return None
+        node_keys = self._node_keys(chunks)
+        with self._lock:
+            m = start
+            while m < len(node_keys) and node_keys[m] in self._rows:
+                m += 1
+            if m > start:
+                for nk in node_keys[start:m]:
+                    self._rows[nk][1] += 1       # pin
+                hids = [self._rows[nk][0] for nk in node_keys[start:m]]
+        if m == start:
+            return self._fetch_disk(chunks, node_keys, start)
+        try:
+            payload = self.pool.load(hids)       # lock NOT held
+        except HostSpillCorruptError:
+            with self._lock:
+                # the bad row may back several chains; every chain
+                # whose path runs through a pinned node is suspect
+                bad = set(node_keys[start:m])
+                for leaf in [lf for lf, ch in self._chains.items()
+                             if bad & set(self._node_keys(ch))]:
+                    self._drop_chain_locked(leaf)
+                    self.corrupt_dropped += 1
+                self._unpin_locked(node_keys[start:m])
+            return None
+        with self._lock:
+            self._unpin_locked(node_keys[start:m])
+            self.fetch_hits += 1
+            self.fetch_blocks += m - start
+        return m - start, payload, False
+
+    def _fetch_disk(self, chunks, node_keys, start: int):
+        """The RAM-miss half of :meth:`fetch`: look the wanted node up
+        in the disk catalog, read + verify its chain's frame, slice
+        the requested node range out of the full-chain payload, and
+        promote the chain into RAM (no disk re-write — it is already
+        durable). Every failure mode (missing, corrupt→quarantined,
+        degraded IO) is a miss, never an exception."""
+        if self.disk is None:
+            return None
+        leaf = self.disk.node_leaf(node_keys[start])
+        if leaf is None:
+            return None
+        rec = self.disk.get(leaf)
+        if rec is None:
+            return None
+        d_chunks, payload = rec
+        d_chunks = tuple(tuple(c) for c in d_chunks)
+        # serve the run of nodes where the filed chain and the request
+        # agree token-for-token (hash collisions are never trusted)
+        m = start
+        while (m < len(chunks) and m < len(d_chunks)
+               and chunks[m] == d_chunks[m]):
+            m += 1
+        if m == start or d_chunks[:start] != chunks[:start]:
+            return None
+        sliced = {k: [np.asarray(b)[start:m] for b in bufs]
+                  for k, bufs in payload.items()}
+        with self._lock:
+            self.disk_hit_chains += 1
+            self.disk_hit_blocks += m - start
+        self.publish([(d_chunks, payload)], to_disk=False)
+        return m - start, sliced, True
+
+    def _restore_from_disk(self) -> None:
+        """Construction-time restore: re-warm the RAM tier with the
+        disk catalog's hottest chains (persisted-seq order). RAM
+        capacity keeps the head and sheds the tail — which stays on
+        disk, one :meth:`fetch` away. Corrupt frames quarantine during
+        the reads; a dead tier restores nothing (degraded, billed on
+        the disk store)."""
+        records: list[tuple[tuple, dict]] = []
+        for leaf in self.disk.hot_first():
+            rec = self.disk.get(leaf)
+            if rec is not None:
+                records.append(rec)
+        self.disk_restored = self.publish(records, to_disk=False)
+
+    def residency(self) -> dict[bytes, str]:
+        """ROOT chain key → ``"ram"`` | ``"disk"`` for every chain any
+        tier holds — the router's GLOBAL prefix-residency view (the
+        root key doubles as ``fleet.affinity_key``, so placement can
+        ask "is this template's head warm somewhere?" without hashing
+        anything new)."""
+        from .paging import chain_key
+
+        out: dict[bytes, str] = {}
+        with self._lock:
+            for chunks in self._chains.values():
+                out[chain_key(chunks, 1)] = "ram"
+        if self.disk is not None:
+            for root in self.disk.roots():
+                out.setdefault(root, "disk")
         return out
 
     def clear(self) -> None:
@@ -456,15 +1032,23 @@ class WarmChainStore:
 
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "chains": len(self._chains),
                 "blocks_in_use": self.pool.in_use,
                 "host_blocks": self.pool.host_blocks,
+                "host_bytes": self.pool.nbytes,
                 "published_chains": self.published_chains,
                 "taken_chains": self.taken_chains,
                 "store_full_drops": self.store_full_drops,
                 "corrupt_dropped": self.corrupt_dropped,
+                "fetch_hits": self.fetch_hits,
+                "fetch_blocks": self.fetch_blocks,
+                "disk_hit_chains": self.disk_hit_chains,
+                "disk_hit_blocks": self.disk_hit_blocks,
+                "disk_restored": self.disk_restored,
             }
+        out["disk"] = self.disk.stats() if self.disk is not None else None
+        return out
 
 
 class IndexSpill:
@@ -485,6 +1069,54 @@ class IndexSpill:
 
     def free(self, host_ids: Sequence[int]) -> None:
         self.host.free(host_ids)
+
+
+class ChainSpill:
+    """CHAIN-LEVEL spill adapter: the prefix CDN's replacement for the
+    per-replica :class:`IndexSpill`/:class:`HostBlockPool` pair. When
+    ``PrefixIndex`` sees ``chain_level=True`` it hands evictions over
+    as WHOLE root→leaf chains (chunks + device blocks) instead of raw
+    block lists: the adapter exports the rows from the live device
+    pool and publishes them into the ONE fleet-shared
+    :class:`WarmChainStore` (which writes through to its disk tier) —
+    so N replicas retain ONE copy of the Zipf head instead of N
+    private pools, and the index keeps no ``tier="host"`` entries at
+    all (a later hit re-enters through ``WarmChainStore.fetch``).
+
+    ``free`` is refused loudly: in chain-level mode the index owns no
+    per-row host ids, so any call means a host-tier entry leaked into
+    a CDN engine — a wiring bug, never a runtime condition."""
+
+    chain_level = True
+
+    def __init__(self, store: WarmChainStore, pool_ref):
+        self.store = store
+        self._pool_ref = pool_ref
+        self.spilled_chains = 0
+
+    def store_chains(self, chains: Sequence[tuple]) -> int:
+        """Publish ``(chunks, dev_blocks)`` chains (root→leaf, device
+        tier) into the shared store. Best-effort like every spill —
+        the store bills capacity drops, the disk tier bills degraded
+        IO — so the eviction that called us always completes."""
+        from .paging import export_block_rows
+
+        recs = []
+        for chunks, dev_blocks in chains:
+            payload = export_block_rows(self._pool_ref(),
+                                        list(dev_blocks))
+            recs.append((tuple(tuple(c) for c in chunks),
+                         {k: [np.asarray(b) for b in bufs]
+                          for k, bufs in payload.items()}))
+        if recs:
+            self.store.publish(recs)
+            self.spilled_chains += len(recs)
+        return len(recs)
+
+    def free(self, host_ids: Sequence[int]) -> None:
+        raise ValueError(
+            "chain-level spill holds no per-index host rows — a "
+            "host-tier entry leaked into a shared-store engine")
 
 
 class SnapshotCorruptError(RuntimeError):

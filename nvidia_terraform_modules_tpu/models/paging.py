@@ -541,9 +541,16 @@ class PrefixIndex:
         ``tier="host"``, device references released), falling back to
         :meth:`_drop` when the host pool cannot hold the whole subtree
         (all-or-nothing — a half-spilled chain would strand the tail).
-        Returns device-tier entries released either way."""
+        Returns device-tier entries released either way.
+
+        A CHAIN-LEVEL spill adapter (``chain_level=True`` — the
+        fleet-shared prefix CDN, ``hostkv.ChainSpill``) takes whole
+        chains instead of raw blocks: see
+        :meth:`_evict_chain_level`."""
         if self.spill is None:
             return self._drop(key)
+        if getattr(self.spill, "chain_level", False):
+            return self._evict_chain_level(key)
         # collect the device-tier subtree in chain (parent-first) order
         sub: list[bytes] = []
         stack = [key]
@@ -575,6 +582,50 @@ class PrefixIndex:
             self._entries[k] = (hid, chunk, parent, "host")
         self.spilled_blocks += len(sub)
         return len(sub)
+
+    def _evict_chain_level(self, key: bytes) -> int:
+        """CHAIN-LEVEL eviction (the fleet-shared prefix CDN): publish
+        every root→leaf chain whose path runs through the evicted
+        subtree into the shared store — ancestors ride along so the
+        store files the WHOLE content-addressed chain (shared prefix
+        rows dedup by node key on its side) — then plain-DROP the
+        subtree. No ``tier="host"`` entry is ever created in this
+        mode; a later hit re-enters through ``WarmChainStore.fetch``
+        on the admission path. Publishing is best-effort (the store
+        bills its own capacity/disk drops), the eviction always
+        completes and always frees the device blocks."""
+        if key not in self._entries:
+            return 0
+        # ancestors root→parent-of-key: still indexed, still device
+        # tier (chain-level mode never files host entries)
+        prefix: list[tuple] = []        # (chunk, block) pairs
+        k = self._entries[key][2]
+        while k is not None:
+            ent = self._entries[k]
+            prefix.append((ent[1], ent[0]))
+            k = ent[2]
+        prefix.reverse()
+        chains: list[tuple[list, list]] = []
+        released = 0
+        stack: list[tuple[bytes, list]] = [(key, prefix)]
+        while stack:
+            k, path = stack.pop()
+            ent = self._entries.get(k)
+            if ent is None:
+                continue
+            path = path + [(ent[1], ent[0])]
+            released += 1
+            kids = [c for c in self._children.get(k, ())
+                    if c in self._entries]
+            if kids:
+                stack.extend((c, path) for c in kids)
+            else:
+                chains.append(([c for c, _b in path],
+                               [b for _c, b in path]))
+        self.spill.store_chains(chains)
+        self.spilled_blocks += released
+        self._drop(key)
+        return released
 
     def trim(self) -> int:
         """Enforce the LRU cap: evict least-recently-used

@@ -638,15 +638,20 @@ class SupervisedLoop:
                 age_s=round(failure.age_s, 1),
                 observed_by=self.process_id)
         if self.heartbeat_dir:
+            # atomic (tmp + os.replace): the supervisor reads this
+            # breadcrumb after our os._exit, so it must see the whole
+            # classification or none of it — never a torn JSON
+            dst = os.path.join(
+                self.heartbeat_dir,
+                f"peer_failure_p{self.process_id:05d}.json")
             try:
-                with open(os.path.join(
-                        self.heartbeat_dir,
-                        f"peer_failure_p{self.process_id:05d}.json"),
-                        "w") as fh:
+                tmp = f"{dst}.tmp.{os.getpid()}"
+                with open(tmp, "w") as fh:
                     json.dump({"process": failure.process,
                                "age_s": round(failure.age_s, 1),
                                "last_step": failure.last_step,
                                "observed_by": self.process_id}, fh)
+                os.replace(tmp, dst)
             except OSError:
                 pass
         os._exit(EXIT_PEER_DEAD)

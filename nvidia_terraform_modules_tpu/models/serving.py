@@ -669,6 +669,7 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                       host_spill: bool = False,
                       host_blocks: int | None = None,
                       host_swap: str = "async",
+                      shared_store=None,
                       aot_cache=None):
     """Reusable engine: compile once, run many schedules.
 
@@ -881,6 +882,17 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             "host_spill is the prefix index's second tier — enable "
             "share_prefix=True alongside it (there is nothing to spill "
             "without an index)")
+    if shared_store is not None:
+        if host_spill:
+            raise ValueError(
+                "shared_store replaces the private host tier — a "
+                "replica cannot spill both to its own HostBlockPool "
+                "and to the fleet CDN; drop host_spill")
+        if not share_prefix:
+            raise ValueError(
+                "shared_store is the prefix index's CDN tier — enable "
+                "share_prefix=True alongside it (there is nothing to "
+                "publish without an index)")
     if host_blocks is None:
         # default: room for several keep-caps' worth of templates — the
         # host tier exists precisely because the working set dwarfs the
@@ -965,6 +977,9 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         lazy_growth=lazy_growth, prefix_keep_blocks=prefix_keep_blocks,
         paged_kernel=paged_kernel, host_spill=host_spill,
         host_blocks=host_blocks, host_swap=host_swap,
+        # the CDN lever as a BOOLEAN: the store object's repr carries a
+        # memory address, which would split the cache key per process
+        shared_store=shared_store is not None,
         prefix_len=prefix_len,
         quant_weights=prefill_params is not params))
 
@@ -1221,12 +1236,21 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             # reads the LIVE pool through a closure because the wave
             # loop rebinds self.pool every dispatch
             self.host = host_pool
+            self.store = shared_store
             spill = None
             if self.host is not None:
                 from .hostkv import IndexSpill
 
                 self.host.reset()
                 spill = IndexSpill(self.host, lambda: self.pool)
+            elif self.store is not None:
+                # fleet-shared CDN tier: evictions hand over whole
+                # root→leaf CHAINS (tokens + rows) to the shared store
+                # — no per-index host ids, no "host" entries; re-entry
+                # happens at admission via _cdn_swap_in
+                from .hostkv import ChainSpill
+
+                spill = ChainSpill(self.store, lambda: self.pool)
             self.index = (PrefixIndex(self.alloc, prefix_keep_blocks,
                                       spill=spill)
                           if share_prefix else None)
@@ -1275,7 +1299,15 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                                  # published to a drain sink at close
                                  "warm_chains": 0, "warm_blocks": 0,
                                  "warm_dropped": 0,
-                                 "published_chains": 0}
+                                 "published_chains": 0,
+                                 # durable prefix CDN (shared_store):
+                                 # blocks swapped in from the shared
+                                 # store, the subset that came off the
+                                 # crash-safe DISK tail, and the
+                                 # disk-path latency share
+                                 "cdn_hit_blocks": 0,
+                                 "disk_hit_blocks": 0,
+                                 "disk_swap_ms": 0.0}
             self._toks: dict[int, list] = {}          # host prompt cache
             self._row_np: dict[int, Any] = {}
             if prefix is not None:
@@ -1321,6 +1353,14 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                 else:
                     shared = self.index.match(chunks)
                     dev_k = len(shared)
+                    if self.store is not None and dev_k < n_chunks:
+                        # CDN continuation: the fleet-shared store (RAM
+                        # tier, crash-safe disk tail behind it) may
+                        # hold the rest of the chain — swap it in and
+                        # REGISTER it so the next admission hits
+                        # device-resident
+                        shared = shared + self._cdn_swap_in(
+                            chunks, dev_k, shared)
                 cov = chunk_tokens_covered(len(shared), bs,
                                            prefix_tail_rows)
                 if prefill_chunk is not None:
@@ -1449,6 +1489,50 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
             self.prefix_stats["swapins"] += 1
             self.prefix_stats["swapped_blocks"] += len(blocks)
             self.prefix_stats["swap_ms"] += (time.monotonic() - t0) * 1e3
+            return blocks
+
+        def _cdn_swap_in(self, chunks: list, dev_k: int,
+                         shared: list[int]) -> list[int]:
+            """Swap a chain continuation in from the fleet-shared CDN
+            store: fetch the crc-verified rows (RAM pin-copy, or the
+            disk tail's PCD1-framed restore — the store promotes disk
+            hits to RAM itself), grant fresh device blocks, import the
+            rows and ``register`` the chain so the index holds one
+            reference past this request's retirement — the same
+            terminal refcounts the private host tier's swap-in +
+            ``promote`` leaves. Returns the now-device-resident blocks
+            carrying this request's reference — or ``[]`` on a store
+            miss, a corrupt drop (the store quarantined/dropped it
+            already) or an exhausted device pool (nothing to undo; the
+            request prefills from tokens — slow, never wrong)."""
+            from .paging import import_block_rows
+
+            t0 = time.monotonic()
+            clk0 = _clk()
+            got = self.store.fetch(chunks, start=dev_k)
+            if got is None:
+                return []
+            n, payload, from_disk = got
+            blocks = self._alloc_reclaiming(n)
+            if blocks is None:
+                return []
+            self.pool = import_block_rows(self.pool, blocks, payload)
+            # already-indexed dev nodes (the matched prefix) are
+            # skipped by register; the new nodes take one index
+            # reference each — rc 2 = this request + the index
+            self.index.register(chunks[:dev_k + n], shared + blocks)
+            ms = (time.monotonic() - t0) * 1e3
+            ps = self.prefix_stats
+            ps["swapins"] += 1
+            ps["swapped_blocks"] += n
+            ps["swap_ms"] += ms
+            ps["cdn_hit_blocks"] += n
+            if from_disk:
+                ps["disk_hit_blocks"] += n
+                ps["disk_swap_ms"] += ms
+                if reg.enabled:
+                    reg.emit_span("prefix_disk_swap", clk0, reg.clock(),
+                                  blocks=n)
             return blocks
 
         def prefetch_swap(self, req: int, prompt) -> None:
@@ -1701,6 +1785,13 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
         _g_spill = reg.gauge("prefix_spilled_blocks")
         _g_swapms = reg.gauge("prefix_swapin_ms")
         _g_hosthitf = reg.gauge("prefix_host_hit_frac")
+        # durable prefix CDN (shared_store): the disk tail's share of
+        # prompt blocks and its swap-in latency — the restart-warmth
+        # pair the gke-tpu prefix-CDN runbook reads alongside the
+        # prefix_disk_quarantine_total/degraded_total counters the
+        # store itself bills
+        _g_diskhitf = reg.gauge("prefix_disk_hit_frac")
+        _g_diskms = reg.gauge("prefix_disk_swapin_ms")
         # per-wave decode time: the paged-kernel lever's live signal
         # (the gather path scales with pool size, the kernel with live
         # tokens — watch this drop when paged_kernel engages). Honest
@@ -1724,6 +1815,13 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                     _g_hosthitf.set(round(ps["host_hit_blocks"]
                                           / max(ps["prompt_blocks"], 1),
                                           4))
+                if shared_store is not None:
+                    _g_spill.set(rstate.index.spilled_blocks)
+                    _g_swapms.set(round(ps["swap_ms"], 3))
+                    _g_diskhitf.set(round(ps["disk_hit_blocks"]
+                                          / max(ps["prompt_blocks"], 1),
+                                          4))
+                    _g_diskms.set(round(ps["disk_swap_ms"], 3))
             if lazy_growth:
                 _g_lazy.set(rstate.grown_lazy)
 
@@ -2207,6 +2305,21 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                     "host_high_water": (host.high_water
                                         if host is not None else 0),
                 },
+                # durable prefix CDN (shared_store): blocks served
+                # from the fleet-shared store, the disk tail's share,
+                # and the shared store's own ledger (nested "disk"
+                # record carries quarantine reasons + degraded count)
+                "cdn": {
+                    "enabled": rstate.store is not None,
+                    "hit_blocks": ps["cdn_hit_blocks"],
+                    "disk_hit_blocks": ps["disk_hit_blocks"],
+                    "disk_hit_frac": round(
+                        ps["disk_hit_blocks"]
+                        / max(ps["prompt_blocks"], 1), 4),
+                    "disk_swap_ms": round(ps["disk_swap_ms"], 3),
+                    "store": (rstate.store.stats()
+                              if rstate.store is not None else None),
+                },
                 # elastic-fleet state migration (zeros outside a
                 # scale event): bring-up chains seeded from the warm
                 # store vs dropped, and retained chains published to
@@ -2292,6 +2405,14 @@ def make_serve_engine(params, cfg: BurnInConfig, *, max_len: int,
                                      "corrupt_dropped": 0,
                                      "host_in_use": 0,
                                      "host_high_water": 0},
+                           "cdn": {"enabled": shared_store is not None,
+                                   "hit_blocks": 0,
+                                   "disk_hit_blocks": 0,
+                                   "disk_hit_frac": 0.0,
+                                   "disk_swap_ms": 0.0,
+                                   "store": (shared_store.stats()
+                                             if shared_store is not None
+                                             else None)},
                            "warm": {"seeded_chains": 0,
                                     "seeded_blocks": 0,
                                     "seed_dropped": 0,

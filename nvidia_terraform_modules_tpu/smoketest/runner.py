@@ -833,6 +833,90 @@ def _run_smoketest(
                     checks["aot_warm_error"] = str(exc)
                 ok &= checks["aot_warm_ok"]
 
+            # durable prefix CDN gate (ISSUE 20): the fleet-global
+            # content-addressed prefix tier with its crash-safe disk
+            # tail (disk_spill= → hostkv.DiskChainStore) is
+            # contractually a CACHING change — restored chains are
+            # crc-verified copies of the exported bytes, never
+            # different tokens — so an armed 2-replica fleet must
+            # BIT-match the single-engine baseline, and a RESTARTED
+            # fleet (a brand-new fleet over the same spill dir: every
+            # byte of RAM state gone, exactly a full-fleet crash) must
+            # come back WARM from disk (restored chains > 0 converting
+            # to store hits) and bit-match again, with zero frames
+            # quarantined. Gates the disk tier on this host's real
+            # filesystem/allocator before a preemptible serving pool
+            # trusts a restart to be warm. TPU_PREFIX_DISK_SPILL
+            # points the leg at a durable path (PVC / local-ssd —
+            # wired by the gke-tpu smoketest Job); unset, a temp dir
+            # proves the mechanism and is torn down.
+            if checks.get("kv_spill_ok"):
+                try:
+                    import shutil
+                    import tempfile
+
+                    from ..models.fleet import make_fleet
+                    from ..models.serving import make_serve_engine
+                    from ..utils.traffic import shared_prefix_prompts
+
+                    dcfg = BurnInConfig(
+                        vocab=128, d_model=32, n_heads=4, d_ff=64,
+                        n_layers=2, seq_len=16, batch=2,
+                        dtype=jax.numpy.float32)
+                    dparams = init_params(jax.random.PRNGKey(23), dcfg)
+                    dpairs = shared_prefix_prompts(
+                        6, seed=7, n_templates=2, template_len=8,
+                        suffix_lo=1, suffix_hi=4, vocab=dcfg.vocab)
+                    dprompts = [jax.numpy.asarray(p, jax.numpy.int32)
+                                for _t, p in dpairs]
+                    dbudgets = [3, 4, 2, 4, 3, 2]
+                    dml = max(int(p.shape[-1]) + n
+                              for p, n in zip(dprompts, dbudgets))
+                    dbase = make_serve_engine(dparams, dcfg,
+                                              max_len=dml, kv_block=4,
+                                              share_prefix=True)
+                    d_outs = dbase(dprompts, dbudgets, slots=2)
+                    spill_env = e.get("TPU_PREFIX_DISK_SPILL")
+                    ddir = spill_env or tempfile.mkdtemp(
+                        prefix="smoke_cdn_")
+                    try:
+                        def cdn_run():
+                            fl = make_fleet(
+                                dparams, dcfg, max_len=dml, replicas=2,
+                                kv_block=4, share_prefix=True,
+                                steal=False, disk_spill=ddir)
+                            outs = fl(dprompts, dbudgets, slots=2)
+                            m = all(
+                                o is not None
+                                and bool(jax.device_get(
+                                    jax.numpy.array_equal(o, b)))
+                                for o, b in zip(outs, d_outs))
+                            return (m, fl.cdn_store.disk_restored,
+                                    fl.last_stats["fleet"]["cdn"])
+                        m1, _r1, cdn1 = cdn_run()       # seeds disk
+                        # the restart: new fleet, same dir, cold RAM
+                        m2, restored, cdn2 = cdn_run()
+                        checks["prefix_cdn_ok"] = (
+                            m1 and m2 and restored > 0
+                            and cdn1["store"]["disk"]["stored_chains"]
+                            > 0
+                            and cdn2["store"]["fetch_blocks"] > 0
+                            and cdn2["store"]["disk"]["quarantined"]
+                            == 0
+                            and not cdn2["store"]["disk"]["dead"])
+                        checks["prefix_cdn_durable_dir"] = \
+                            bool(spill_env)
+                        checks["prefix_cdn_restored_chains"] = restored
+                        checks["prefix_cdn_hit_blocks"] = \
+                            cdn2["store"]["fetch_blocks"]
+                    finally:
+                        if spill_env is None:
+                            shutil.rmtree(ddir, ignore_errors=True)
+                except Exception as exc:  # JSON contract > the type
+                    checks["prefix_cdn_ok"] = False
+                    checks["prefix_cdn_error"] = str(exc)
+                ok &= checks["prefix_cdn_ok"]
+
             # flash pipeline gate: the software-pipelined kernels
             # (ops/flash_attention.py, pipeline="on") are contractually a
             # SCHEDULING change — same sub-tile folds, same arithmetic —

@@ -56,6 +56,21 @@ Instrumented layers (all emit here when enabled):
 ``models/serving`` / ``speculative``  per-request ``serve_prefill`` /
                                       ``serve_request`` spans, generated-
                                       and accepted-draft-token counters
+``models/serving`` / ``hostkv``       the prefix CDN's disk tail:
+(the three-tier prefix CDN)           ``prefix_disk_hit_frac`` (prompt
+                                      blocks served from disk) /
+                                      ``prefix_disk_swapin_ms`` gauges
+                                      and one ``prefix_disk_swap`` span
+                                      per disk-warm admission (engine
+                                      side); ``prefix_disk_quarantine_
+                                      total`` (corrupt/truncated/stale
+                                      frames moved aside with a reason)
+                                      and ``prefix_disk_degraded_total``
+                                      (ops lost to a dead tier or
+                                      transient-IO exhaustion) counters
+                                      billed by ``DiskChainStore`` at
+                                      event time — the runbook's
+                                      never-a-crash evidence
 ``models/fleet``                      one ``fleet_route`` span per request
                                       (args: chosen replica, affinity,
                                       shed) on the SAME registry the

@@ -592,7 +592,9 @@ def check_serving_no_host_ram(ctx: LintContext):
     sides are statically visible: a serving-shaped TPU pool on a
     floor-class machine AND host-spill wiring (a ``host_spill``/
     ``host_blocks``-style variable, module argument, or pod env var)
-    in the same module."""
+    in the same module. (The DURABILITY leg of the same posture is
+    ``tpu-serving-no-durable-prefix``: this rule sizes the RAM tier,
+    that one makes sure its disk tail survives a fleet restart.)"""
     wiring = _host_spill_wiring(ctx)
     if wiring is None:
         return
@@ -629,6 +631,104 @@ def check_serving_no_host_ram(ctx: LintContext):
                f"sizing arithmetic is in the gke-tpu README's tiered-"
                f"KV runbook; the failover twin is "
                f"tpu-spot-serving-no-headroom)")
+
+
+# identifier shapes that mark a DURABLE home for the prefix CDN's disk
+# tail as provisioned: the runtime's own knob (disk_spill= on
+# make_fleet), a prefix-cache bucket/volume variable, or the local-ssd
+# spellings GKE uses for node-attached NVMe
+_DURABLE_PREFIX_RE = re.compile(
+    r"disk[_-]?spill|prefix[_-]?(cache|cdn)|durable|"
+    r"(cache|spill)[_-]?(bucket|dir|path|volume)|local[_-]?ssd",
+    re.IGNORECASE)
+# node_config blocks that attach local SSD to the pool itself —
+# durable across pod restarts, which is the tier's survival domain
+_LOCAL_SSD_BLOCKS = ("ephemeral_storage_local_ssd_config",
+                     "local_nvme_ssd_block_config")
+
+
+def _durable_prefix_evidence(ctx: LintContext, r) -> str | None:
+    """The first evidence this module gives the prefix CDN's disk tail
+    somewhere durable to live, or None: a ``disk_spill``/
+    ``prefix_cache``-style variable, module argument, or pod env var;
+    a storage bucket resource; or local SSD attached to the pool
+    ``r`` itself."""
+    for nc in r.body.blocks_of("node_config"):
+        if nc.body.attr("local_ssd_count") is not None:
+            return f"{r.address} local_ssd_count"
+        for bt in _LOCAL_SSD_BLOCKS:
+            if nc.body.blocks_of(bt):
+                return f"{r.address} {bt}"
+    for res in ctx.mod.resources.values():
+        if res.type == "google_storage_bucket":
+            return res.address
+    for name in ctx.mod.variables:
+        if _DURABLE_PREFIX_RE.search(name):
+            return f'variable "{name}"'
+    for mc in ctx.mod.module_calls.values():
+        for a in mc.body.attributes:
+            if _DURABLE_PREFIX_RE.search(a.name):
+                return f'module "{mc.name}" argument "{a.name}"'
+    for res in ctx.mod.resources.values():
+        for node in A.walk(res.body):
+            if not (isinstance(node, A.Block) and node.type == "env"):
+                continue
+            na = node.body.attr("name")
+            val = ctx.resolve_literal(na.expr) if na is not None else None
+            if isinstance(val, str) and _DURABLE_PREFIX_RE.search(val):
+                return f'{res.address} env "{val}"'
+    return None
+
+
+@rule("tpu-serving-no-durable-prefix", severity="warning", family="tpu",
+      summary="serving pool wires the host-spill prefix tier but "
+              "provisions nothing durable for its disk tail — the "
+              "prefix working set dies with the fleet")
+def check_serving_no_durable_prefix(ctx: LintContext):
+    """The DURABILITY leg of the serving posture
+    (``tpu-spot-serving-no-headroom`` saves the traffic,
+    ``tpu-serving-no-host-ram`` saves the working set while the fleet
+    is UP — this rule saves it across a fleet-wide restart). The
+    prefix CDN's host tier (``models/hostkv.py``, ``host_spill=``) is
+    RAM: a node-pool upgrade, a zone drain, or a full fleet crash
+    vaporizes the entire Zipf head of shared-template prefixes, and
+    every user pays cold prefill again. The runtime's crash-safe disk
+    tail (``disk_spill=`` → ``DiskChainStore``) exists for exactly
+    this, but it needs a DURABLE home: node-attached local SSD, a
+    mounted volume, or a GCS bucket. Fires when a serving-shaped TPU
+    pool has host-spill wiring statically visible but the module
+    provisions no durable evidence (a ``disk_spill``/``prefix_cache``
+    -style variable, module argument, or pod env var; local SSD on the
+    pool; a storage bucket) — see the "Prefix CDN runbook" in
+    ``gke-tpu/README.md`` for tiers and degradation modes."""
+    wiring = _host_spill_wiring(ctx)
+    if wiring is None:
+        return
+    for r in ctx.mod.resources.values():
+        if r.type != "google_container_node_pool":
+            continue
+        shaped = _serving_shaped(ctx, r)
+        if shaped is None:
+            continue
+        ncs = r.body.blocks_of("node_config")
+        if not ncs:
+            continue
+        mt = _literal(ctx, ncs[0].body.attr("machine_type"))
+        if not isinstance(mt, str) or T.parse_machine_type(mt) is None:
+            continue
+        if _durable_prefix_evidence(ctx, r) is not None:
+            continue
+        yield (f"{r.file}:{r.line}",
+               f"{r.address}: serving-shaped ({shaped!r}) pool wires "
+               f"the host-spill prefix tier ({wiring}) with no "
+               f"durable home for its disk tail — host RAM dies with "
+               f"the fleet, so a full restart cold-starts every "
+               f"shared-template prefix; attach local SSD "
+               f"(local_ssd_count / ephemeral_storage_local_ssd_"
+               f"config), mount a volume, or point disk_spill at a "
+               f"bucket-backed path (prefix_disk_hit_frac shows the "
+               f"tail working; the RAM-sizing twin is "
+               f"tpu-serving-no-host-ram)")
 
 
 # identifier shapes that mark the serving runtime's ELASTIC control

@@ -761,7 +761,7 @@ def test_rule_catalog(tmp_path):
         "graft-wallclock-nondeterminism", "graft-silent-except",
         "graft-unlocked-shared-state", "graft-donated-reuse",
         "graft-lock-cycle", "graft-unbounded-recv",
-        "graft-spawn-no-retry-classify",
+        "graft-spawn-no-retry-classify", "graft-durable-write-no-atomic",
     }
     # disjoint from the HCL pack: one engine, two registries
     from nvidia_terraform_modules_tpu.tfsim.lint import engine as hcl
@@ -987,3 +987,94 @@ def test_combined_hcl_python_golden(tmp_path):
                   json.dumps(doc, indent=2, sort_keys=True) + "\n")
     _check_golden("combined_lint.sarif",
                   json.dumps(sarif, indent=2, sort_keys=True) + "\n")
+
+
+# ======================================= rule: durable-write-no-atomic
+
+def test_durable_write_no_atomic_positive(tmp_path):
+    fs = lint(tmp_path, {"models/store.py": """\
+        import json
+
+        def save(path, record):
+            with open(path, "w") as fh:
+                json.dump(record, fh)
+        """})
+    (f,) = hit(fs, "graft-durable-write-no-atomic")
+    assert f.severity == "error"
+    assert "src/models/store.py:4" in f.where
+    assert "os.replace" in f.message
+
+
+def test_durable_write_path_oneshot_positive(tmp_path):
+    # pathlib's one-shot writers have no handle to fsync and no
+    # tmp+rename — never atomic, always flagged in durable scope
+    fs = lint(tmp_path, {"models/cachefile.py": """\
+        def save(path, blob):
+            path.write_bytes(blob)
+
+        def note(path, text):
+            path.write_text(text)
+        """})
+    assert len(hit(fs, "graft-durable-write-no-atomic")) == 2
+
+
+def test_durable_write_tmp_replace_negative(tmp_path):
+    # the blessed idiom: write the tmp name, fsync, os.replace — the
+    # scope guard (os.replace) and the path marker both exempt it
+    fs = lint(tmp_path, {"models/store.py": """\
+        import os
+
+        def save(path, blob):
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as fh:
+                fh.write(blob)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+        """})
+    assert hit(fs, "graft-durable-write-no-atomic") == []
+
+
+def test_durable_write_tmp_path_split_scope_negative(tmp_path):
+    # tmp-marked path alone is enough: the os.replace that publishes
+    # it may live in a helper or the caller
+    fs = lint(tmp_path, {"models/store.py": """\
+        def stage(tmp_path, blob):
+            with open(tmp_path, "wb") as fh:
+                fh.write(blob)
+        """})
+    assert hit(fs, "graft-durable-write-no-atomic") == []
+
+
+def test_durable_write_reads_and_dynamic_modes_negative(tmp_path):
+    fs = lint(tmp_path, {"models/store.py": """\
+        def load(path, mode):
+            with open(path) as fh:          # default "r"
+                a = fh.read()
+            with open(path, "rb") as fh:    # explicit read
+                b = fh.read()
+            with open(path, mode) as fh:    # dynamic: best-effort skip
+                c = fh.read()
+            return a, b, c
+        """})
+    assert hit(fs, "graft-durable-write-no-atomic") == []
+
+
+def test_durable_write_out_of_scope_negative(tmp_path):
+    # tfsim's emitters and CLI report writers are outside the durable
+    # serving-runtime scope (they have their own discipline)
+    fs = lint(tmp_path, {"tfsim/emit.py": """\
+        def emit(path, text):
+            with open(path, "w") as fh:
+                fh.write(text)
+        """})
+    assert hit(fs, "graft-durable-write-no-atomic") == []
+
+
+def test_durable_write_suppression(tmp_path):
+    fs = lint(tmp_path, {"models/store.py": """\
+        def save(path, text):
+            with open(path, "w") as fh:  # graftlint: ignore[graft-durable-write-no-atomic] scratch file, never reread
+                fh.write(text)
+        """})
+    assert hit(fs, "graft-durable-write-no-atomic") == []

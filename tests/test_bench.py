@@ -506,6 +506,73 @@ def test_section_serve_coldstart_deterministic_across_runs():
         assert a[key] == b[key], key
 
 
+def test_section_serve_prefix_cdn_schema_and_gates():
+    """Tier-1 gate on the durable-prefix-CDN section (ISSUE 20): full
+    schema, the warm restart STRICTLY beats the cold restart to first
+    token on the identical roster (the acceptance bar — the win is
+    skipped template-head prefill work, portable to CPU), the two
+    restarts bit-match token for token (the tier moves bytes, never
+    bits), the seeding fleet demonstrably filed chains that the warm
+    build restored and the timed call converted to store hits, the
+    shared store bills replicas× → 1× host bytes, and a healthy dir
+    quarantines nothing."""
+    bench = _bench_mod()
+    out = bench.section_serve_prefix_cdn()
+    for key in ("serve_prefix_cdn_requests",
+                "serve_prefix_cdn_replicas",
+                "serve_prefix_cdn_templates",
+                "serve_prefix_cdn_template_blocks",
+                "serve_restart_cold_first_ms",
+                "serve_restart_warm_first_ms",
+                "serve_restart_warm_vs_cold",
+                "serve_prefix_cdn_bitmatch",
+                "serve_cdn_host_bytes_shared",
+                "serve_cdn_host_bytes_private_equiv",
+                "serve_cdn_host_footprint",
+                "serve_cdn_stored_chains",
+                "serve_cdn_restored_chains",
+                "serve_cdn_hit_blocks",
+                "serve_cdn_quarantined"):
+        assert key in out, key
+    # the ISSUE 20 acceptance bar, gated tier-1
+    assert out["serve_restart_warm_vs_cold"] > 1.0, out
+    assert out["serve_restart_cold_first_ms"] > 0
+    assert out["serve_restart_warm_first_ms"] > 0
+    assert out["serve_prefix_cdn_bitmatch"] is True
+    # the durability ledger: stored → restored → hit, nothing corrupt
+    assert out["serve_cdn_stored_chains"] > 0
+    assert out["serve_cdn_restored_chains"] > 0
+    assert out["serve_cdn_hit_blocks"] > 0
+    assert out["serve_cdn_quarantined"] == 0
+    # the N× → 1× host-RAM claim: ONE shared store for the whole fleet
+    assert out["serve_cdn_host_footprint"] \
+        == out["serve_prefix_cdn_replicas"]
+    assert out["serve_cdn_host_bytes_shared"] > 0
+
+
+@pytest.mark.slow
+def test_section_serve_prefix_cdn_deterministic_across_runs():
+    """The seed-determined CDN fields replay exactly — workload shape,
+    bit-match verdict, the stored/restored/hit ledger, the footprint
+    ratio. The first-token wall clocks are excluded."""
+    bench = _bench_mod()
+    a = bench.section_serve_prefix_cdn()
+    b = bench.section_serve_prefix_cdn()
+    for key in ("serve_prefix_cdn_requests",
+                "serve_prefix_cdn_replicas",
+                "serve_prefix_cdn_templates",
+                "serve_prefix_cdn_template_blocks",
+                "serve_prefix_cdn_bitmatch",
+                "serve_cdn_host_bytes_shared",
+                "serve_cdn_host_bytes_private_equiv",
+                "serve_cdn_host_footprint",
+                "serve_cdn_stored_chains",
+                "serve_cdn_restored_chains",
+                "serve_cdn_hit_blocks",
+                "serve_cdn_quarantined"):
+        assert a[key] == b[key], key
+
+
 @pytest.mark.slow
 def test_section_serve_engine_deterministic_across_runs():
     """Two runs of the section agree on every seed-determined field

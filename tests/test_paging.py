@@ -1090,3 +1090,69 @@ def test_drain_publish_never_double_counts_spill_dropped():
     assert idx.spill_dropped == 3
     idx.release()
     assert host.in_use == 0
+
+
+def test_warm_store_per_chain_pin_locking_lockwatch_armed():
+    """THE ISSUE 20 lock regression pin: ``WarmChainStore.take`` /
+    ``fetch`` hold the registry lock only to SELECT and PIN a chain's
+    rows — the crc-verified copy runs unlocked, so a joiner inheriting
+    a large chain can be parked mid-copy while a publisher files new
+    chains. Armed with the runtime lock watchdog: the interleaving
+    must produce zero ordering cycles and zero lock-held blocking
+    polls, and the copy must demonstrably run with the registry lock
+    free (the pre-fix behaviour held it across the whole copy)."""
+    import threading
+
+    from nvidia_terraform_modules_tpu.analysis import lockwatch
+    from nvidia_terraform_modules_tpu.models.hostkv import WarmChainStore
+
+    cfg, pool, a, host, idx = _tiered_setup(host_blocks=4, cap=0)
+
+    def pay(n):
+        return {k: [np.asarray(b)[:n] for b in bufs]
+                for k, bufs in host._bufs.items()}
+
+    with lockwatch.armed() as watch:
+        store = WarmChainStore(cfg, 8, block_size=4)
+        assert store.publish([(chain_chunks(list(range(8)), 4),
+                               pay(2))]) == 1
+        in_copy, resume = threading.Event(), threading.Event()
+        real_load = store.pool.load
+
+        def gated_load(hids):
+            # the copy itself: the registry lock MUST be free here —
+            # nobody holds it (we are the only taker), so a held
+            # lock could only mean take() kept it across the copy
+            assert not store._lock.locked(), \
+                "take() held the registry lock across the row copy"
+            in_copy.set()
+            assert resume.wait(5), "publisher never released the taker"
+            return real_load(hids)
+
+        store.pool.load = gated_load
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(store.take(lambda root: True)))
+        t.start()
+        assert in_copy.wait(5), "take() never reached its copy"
+        # the taker is parked INSIDE its copy; per-chain pinning means
+        # this publish files a brand-new chain without waiting for it
+        assert store.publish([(chain_chunks([7] * 8, 4), pay(2))]) == 1
+        resume.set()
+        t.join(5)
+        assert not t.is_alive()
+        store.pool.load = real_load
+    (chains,) = got
+    assert len(chains) == 1                      # snapshot: pre-publish
+    assert len(store) == 2                       # takes copy, never drain
+    # the watchdog really observed the store's locks, and the
+    # interleaving was clean: no cycles, no blocking poll under a lock
+    pkg = "nvidia_terraform_modules_tpu/"
+    assert any(n.startswith(pkg) for n in watch.lock_names)
+    assert watch.acquisitions > 0
+    cycles = [c for c in watch.cycles()
+              if any(n.startswith(pkg) for n in c)]
+    assert cycles == [], f"lock-order cycles: {cycles}"
+    held = [h for h in watch.held_sleeps if h[0].startswith(pkg)]
+    assert held == [], f"blocking poll under a lock: {held}"
+    idx.release()

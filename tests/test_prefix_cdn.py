@@ -1,0 +1,333 @@
+# SPDX-FileCopyrightText: Copyright (c) 2026 tpu-terraform-modules authors. All rights reserved.
+# SPDX-License-Identifier: Apache-2.0
+"""ISSUE 20 acceptance gates: the durable prefix CDN.
+
+The three-tier content-addressed prefix store (device paged pool →
+fleet-shared ``WarmChainStore`` RAM → crash-safe ``DiskChainStore``)
+must survive the chaos the serving runbook promises it survives:
+
+- a WHOLE-fleet SIGKILL (every replica process killed for real through
+  ``MultiProcTransport``) followed by a cold rebuild comes back with
+  the Zipf head warm from disk and bit-matches an undisturbed fleet;
+- seeded frame corruption (bitflip / truncation / stale key / foreign
+  magic) quarantines LOUDLY with a reason, imports zero corrupt rows,
+  and degrades serving to the cold path — never a crash;
+- ``disk_spill=None`` (the default) reproduces the stock fleet
+  byte-for-byte, and the armed fleet's shared store bills a 1× host
+  footprint against the N× private-pool equivalent.
+"""
+
+import functools
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nvidia_terraform_modules_tpu.models import (
+    BurnInConfig,
+    MultiProcTransport,
+    greedy_decode,
+    init_params,
+    make_fleet,
+)
+from nvidia_terraform_modules_tpu.models.hostkv import (
+    DiskChainStore,
+    WarmChainStore,
+)
+from nvidia_terraform_modules_tpu.models.serving import make_serve_engine
+from nvidia_terraform_modules_tpu.utils.traffic import shared_prefix_prompts
+
+CFG = dict(vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2,
+           seq_len=32, batch=2, dtype=jnp.float32)
+
+
+@functools.lru_cache(maxsize=None)
+def _zipf_setup(n=10):
+    cfg = BurnInConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(2), cfg)
+    pairs = shared_prefix_prompts(n, seed=0, n_templates=3,
+                                  template_len=8, suffix_lo=1,
+                                  suffix_hi=4, vocab=cfg.vocab)
+    prompts = tuple(jnp.asarray(p, jnp.int32) for _t, p in pairs)
+    max_len = max(int(p.shape[-1]) for p in prompts) + 7
+    return cfg, params, prompts, max_len
+
+
+def _solo(params, prompts, n_new, cfg):
+    return [greedy_decode(params, p[None, :], n_new, cfg)[0]
+            for p in prompts]
+
+
+def _assert_all_equal(outs, want, label=""):
+    for i, (g, w) in enumerate(zip(outs, want)):
+        assert g is not None, f"{label} request {i} unserved"
+        assert jnp.array_equal(jnp.asarray(g), w), \
+            f"{label} request {i} diverged"
+
+
+def _frames(spill_dir):
+    """Every filed ``.pcd`` frame under the sha-sharded objects tree,
+    sorted for determinism."""
+    out = []
+    objects = os.path.join(spill_dir, "objects")
+    for shard in sorted(os.listdir(objects)):
+        sdir = os.path.join(objects, shard)
+        if os.path.isdir(sdir):
+            out.extend(os.path.join(sdir, n)
+                       for n in sorted(os.listdir(sdir))
+                       if n.endswith(".pcd"))
+    return out
+
+
+# what each seeded corruption kind does to a frame, and the reason the
+# quarantine record must carry for it
+_CORRUPTIONS = {
+    "bitflip": "crc mismatch",
+    "truncate_body": "truncated body",
+    "truncate_header": "truncated header",
+    "stale_key": "stale key",
+    "bad_magic": "bad magic",
+}
+
+
+def _corrupt(fpath, kind, donor=None):
+    raw = open(fpath, "rb").read()
+    if kind == "bitflip":
+        buf = bytearray(raw)
+        buf[len(buf) - 8] ^= 0x40            # inside the pickled body
+        open(fpath, "wb").write(bytes(buf))
+    elif kind == "truncate_body":
+        open(fpath, "wb").write(raw[:len(raw) // 2])
+    elif kind == "truncate_header":
+        open(fpath, "wb").write(raw[:6])     # mid-header
+    elif kind == "bad_magic":
+        open(fpath, "wb").write(b"XXXX" + raw[4:])
+    elif kind == "stale_key":
+        # a well-formed frame filed under the WRONG chain key (a
+        # misplaced backup-restore, a botched rsync): every byte
+        # verifies, the identity does not — the record's embedded key
+        # must catch it on both the scan and the read path
+        open(fpath, "wb").write(open(donor, "rb").read())
+    else:                                    # pragma: no cover
+        raise AssertionError(kind)
+
+
+def _cdn_engine(params, cfg, max_len, store):
+    return make_serve_engine(params, cfg, max_len=max_len, kv_block=4,
+                             share_prefix=True, prefix_keep_blocks=0,
+                             shared_store=store)
+
+
+# ------------------------------------------------- whole-fleet restart
+
+
+def test_fleet_whole_kill_rebuild_disk_warm_bit_match_tier1(tmp_path):
+    """THE ISSUE 20 headline gate. An in-proc fleet writes the Zipf
+    head through to the disk tier while serving; a multi-proc fleet
+    over the SAME spill dir seeds its real replica processes from the
+    restored store and bit-matches; then every replica process is
+    SIGKILLed FOR REAL — no drain, no close-publish, exactly a
+    machine-room power cut — and a fleet rebuilt cold over the spill
+    dir comes back with the head warm from disk (``disk_restored`` >
+    0, store hits > 0) and bit-matches the undisturbed baseline. The
+    armed fleet also bills the 1× shared-store host footprint against
+    the N× private equivalent."""
+    cfg, params, prompts, max_len = _zipf_setup()
+    want = _solo(params, prompts, 5, cfg)
+    spill = str(tmp_path / "cdn")
+
+    fleet = make_fleet(params, cfg, max_len=max_len, replicas=2,
+                       kv_block=4, share_prefix=True, disk_spill=spill)
+    _assert_all_equal(fleet(prompts, 5, slots=4), want, "armed:")
+    cdn = fleet.last_stats["fleet"]["cdn"]
+    assert cdn["store"]["disk"]["stored_chains"] > 0
+    # host footprint: ONE shared store vs N private pools
+    assert cdn["host_bytes_private_equiv"] \
+        == 2 * cdn["host_bytes_shared"] > 0
+
+    fl_mp = make_fleet(params, cfg, max_len=max_len, replicas=2,
+                       kv_block=4, share_prefix=True, disk_spill=spill,
+                       transport=MultiProcTransport(),
+                       join_timeout_s=120.0)
+    tr = fl_mp.transport
+    try:
+        _assert_all_equal(fl_mp(prompts, 5, slots=4), want, "multiproc:")
+        # the base replicas were seeded from the disk-restored store
+        assert fl_mp.last_stats["fleet"]["cdn"]["base_seeded_chains"] > 0
+        assert fl_mp.cdn_store.disk_restored > 0
+        # the power cut: SIGKILL every replica process, no goodbyes
+        pids = [child[0].pid for child in tr._children.values()]
+        assert len(pids) == 2
+        for pid in pids:
+            os.kill(pid, signal.SIGKILL)
+        for proc, _chan in list(tr._children.values()):
+            proc.join(10.0)
+            assert not proc.is_alive()
+    finally:
+        fl_mp.close()                        # reaps corpses, no raise
+
+    # the rebuild: a cold fleet over the same dir — RAM state died
+    # with the processes, the crc-verified disk tail did not
+    rebuilt = make_fleet(params, cfg, max_len=max_len, replicas=2,
+                         kv_block=4, share_prefix=True,
+                         disk_spill=spill)
+    assert rebuilt.cdn_store.disk_restored > 0
+    _assert_all_equal(rebuilt(prompts, 5, slots=4), want, "rebuilt:")
+    store_stats = rebuilt.last_stats["fleet"]["cdn"]["store"]
+    assert store_stats["fetch_blocks"] > 0   # admissions hit the CDN
+    assert store_stats["disk"]["quarantined"] == 0
+
+
+def test_fleet_disk_spill_none_reproduces_stock_fleet_tier1(tmp_path):
+    """Defaults-off byte-match: ``disk_spill=None`` is the stock fleet
+    — no CDN stats record, no store mounted, outputs byte-identical to
+    both the armed fleet and solo greedy. The lever must never shift
+    tokens; it only changes where warm bytes live."""
+    cfg, params, prompts, max_len = _zipf_setup()
+    want = _solo(params, prompts, 5, cfg)
+
+    stock = make_fleet(params, cfg, max_len=max_len, replicas=2,
+                       kv_block=4, share_prefix=True)
+    _assert_all_equal(stock(prompts, 5, slots=4), want, "stock:")
+    assert stock.last_stats["fleet"]["cdn"] is None
+    assert getattr(stock, "cdn_store", None) is None
+
+    armed = make_fleet(params, cfg, max_len=max_len, replicas=2,
+                       kv_block=4, share_prefix=True,
+                       disk_spill=str(tmp_path / "cdn"))
+    _assert_all_equal(armed(prompts, 5, slots=4), want, "armed:")
+    assert armed.last_stats["fleet"]["cdn"] is not None
+
+
+def test_fleet_disk_spill_validation_is_loud():
+    """The lever refuses incoherent wiring up front: a CDN without the
+    prefix index has nothing to publish, and explicit host_spill/
+    shared_store in engine_kw would fight the tier wiring the lever
+    owns."""
+    cfg, params, prompts, max_len = _zipf_setup()
+    with pytest.raises(ValueError, match="share_prefix"):
+        make_fleet(params, cfg, max_len=max_len, replicas=2,
+                   kv_block=4, disk_spill="/tmp/x")
+    with pytest.raises(ValueError, match="disk_spill owns"):
+        make_fleet(params, cfg, max_len=max_len, replicas=2,
+                   kv_block=4, share_prefix=True, host_spill=True,
+                   disk_spill="/tmp/x")
+    with pytest.raises(ValueError, match="cdn_blocks"):
+        make_fleet(params, cfg, max_len=max_len, replicas=2,
+                   kv_block=4, share_prefix=True, disk_spill="/tmp/x",
+                   cdn_blocks=0)
+
+
+# --------------------------------------------------- seeded corruption
+
+
+def test_disk_corruption_quarantined_serving_degrades_tier1(tmp_path):
+    """The corruption gate, one of each kind: a bitflipped, a
+    truncated, and a stale-key frame are ALL quarantined with their
+    reasons at restart scan, zero corrupt rows reach any block table,
+    and serving over the gutted tier completes bit-exact (cold where
+    the chains died, warm where they survived) — never a crash."""
+    cfg, params, prompts, max_len = _zipf_setup()
+    want = _solo(params, prompts, 5, cfg)
+    spill = str(tmp_path / "cdn")
+
+    eng = _cdn_engine(params, cfg, max_len,
+                      WarmChainStore(cfg, 32, block_size=4,
+                                     disk=DiskChainStore(spill)))
+    _assert_all_equal(eng(prompts, 5, slots=4), want, "seed run:")
+    frames = _frames(spill)
+    assert len(frames) >= 3, "need ≥3 filed chains for the sweep"
+
+    # stale first: its donor (frames[1]) must still be intact
+    _corrupt(frames[0], "stale_key", donor=frames[1])
+    _corrupt(frames[1], "bitflip")
+    _corrupt(frames[2], "truncate_body")
+
+    disk2 = DiskChainStore(spill)
+    assert disk2.quarantined == 3
+    reasons = " | ".join(disk2.quarantine_reasons)
+    assert "crc mismatch" in reasons
+    assert "truncated body" in reasons
+    assert "stale key" in reasons
+    # the quarantine is PHYSICAL: bad frames moved aside, catalog
+    # holds only verified survivors
+    qdir = os.path.join(spill, "quarantine")
+    assert len(os.listdir(qdir)) == 3
+    assert disk2.stats()["chains"] == len(frames) - 3
+
+    # serving over the gutted tier: completes, bit-exact, no crash
+    eng2 = _cdn_engine(params, cfg, max_len,
+                       WarmChainStore(cfg, 32, block_size=4,
+                                      disk=disk2))
+    _assert_all_equal(eng2(prompts, 5, slots=4), want, "degraded:")
+
+
+def test_disk_dead_tier_degrades_to_two_tier_path_tier1(tmp_path):
+    """An unusable disk root (a FILE where the tier's directory should
+    be) kills the whole tier at construction: billed ``degraded``,
+    ``dead`` flagged, every put/get a safe no-op — and the engine over
+    the two remaining tiers serves bit-exact."""
+    cfg, params, prompts, max_len = _zipf_setup()
+    want = _solo(params, prompts, 5, cfg)
+    hostile = tmp_path / "not-a-dir"
+    hostile.write_text("x")
+
+    dead = DiskChainStore(str(hostile))
+    assert dead.dead and dead.degraded > 0
+    assert dead.put((tuple([1, 2, 3, 4]),), {}) is False
+    assert dead.get(b"\x00" * 16) is None
+
+    eng = _cdn_engine(params, cfg, max_len,
+                      WarmChainStore(cfg, 32, block_size=4, disk=dead))
+    _assert_all_equal(eng(prompts, 5, slots=4), want, "two-tier:")
+
+
+# --------------------------------------------- the slow sweep matrix
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize("tier", ["restore", "fallback"])
+@pytest.mark.parametrize("kind", sorted(_CORRUPTIONS))
+def test_corruption_matrix_slow(tmp_path, seed, tier, kind):
+    """seed × tier × corruption-kind: every kind quarantines with its
+    reason on BOTH read paths — the restart scan (``restore``: corrupt
+    before construction) and the RAM-miss fallback (``fallback``:
+    corrupt after construction, RAM tier cleared so the fetch must
+    read the frame) — and serving completes bit-exact either way."""
+    cfg = BurnInConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(10 + seed), cfg)
+    pairs = shared_prefix_prompts(8, seed=seed, n_templates=2,
+                                  template_len=8, suffix_lo=1,
+                                  suffix_hi=4, vocab=cfg.vocab)
+    prompts = tuple(jnp.asarray(p, jnp.int32) for _t, p in pairs)
+    max_len = max(int(p.shape[-1]) for p in prompts) + 7
+    want = _solo(params, prompts, 5, cfg)
+    spill = str(tmp_path / "cdn")
+
+    eng = _cdn_engine(params, cfg, max_len,
+                      WarmChainStore(cfg, 32, block_size=4,
+                                     disk=DiskChainStore(spill)))
+    _assert_all_equal(eng(prompts, 5, slots=4), want, "seed run:")
+    frames = _frames(spill)
+    assert len(frames) >= 2, "stale_key needs an intact donor frame"
+    victim = frames[0]
+    leaf = bytes.fromhex(os.path.basename(victim)[:-len(".pcd")])
+
+    if tier == "restore":
+        _corrupt(victim, kind, donor=frames[1])
+        disk2 = DiskChainStore(spill)
+    else:
+        disk2 = DiskChainStore(spill)
+        _corrupt(victim, kind, donor=frames[1])
+        assert disk2.get(leaf) is None   # the read hits the bad frame
+    assert disk2.quarantined == 1
+    assert _CORRUPTIONS[kind] in " ".join(disk2.quarantine_reasons)
+
+    store = WarmChainStore(cfg, 32, block_size=4, disk=disk2)
+    store.clear()                        # force the disk path
+    eng2 = _cdn_engine(params, cfg, max_len, store)
+    _assert_all_equal(eng2(prompts, 5, slots=4), want,
+                      f"{tier}/{kind}:")

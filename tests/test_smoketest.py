@@ -119,6 +119,16 @@ def test_burnin_level(jax8):
     assert r.checks["aot_warm_ok"], r.checks.get("aot_warm_error")
     assert r.checks["aot_warm_registered"] >= 1
     assert r.checks["aot_warm_second_hits"] >= 1
+    # the durable prefix CDN gate (ISSUE 20): an armed fleet
+    # bit-matches the single-engine baseline, and a RESTARTED fleet
+    # over the same spill dir comes back warm from the crc-verified
+    # disk tail (restored chains converting to store hits) and
+    # bit-matches again — the restart is caching, never different
+    # tokens, and zero frames quarantine on a healthy dir
+    assert r.checks["prefix_cdn_ok"], r.checks.get("prefix_cdn_error")
+    assert r.checks["prefix_cdn_restored_chains"] > 0
+    assert r.checks["prefix_cdn_hit_blocks"] > 0
+    assert r.checks["prefix_cdn_durable_dir"] is False
 
 
 @pytest.mark.slow

@@ -937,6 +937,99 @@ def test_tiered_kv_spill_gauges_export(jax8, tmp_path):
         assert line in prom, line
 
 
+def test_prefix_cdn_disk_instruments_export(jax8, tmp_path):
+    """ISSUE 20's prefix-CDN disk telemetry on one registry: a
+    disk-warm admission sets the ``prefix_disk_hit_frac`` /
+    ``prefix_disk_swapin_ms`` gauges (agreeing with ``last_stats``'s
+    cdn record) and emits one ``prefix_disk_swap`` span per swap-in;
+    ``DiskChainStore`` bills ``prefix_disk_quarantine_total`` (a
+    corrupt frame moved aside, with a reason) and
+    ``prefix_disk_degraded_total`` (an unusable tier) at event time;
+    everything lands in the Prometheus exposition."""
+    import glob
+    import os
+
+    import jax
+
+    from nvidia_terraform_modules_tpu.models import (
+        BurnInConfig,
+        init_params,
+    )
+    from nvidia_terraform_modules_tpu.models.hostkv import (
+        DiskChainStore,
+        WarmChainStore,
+    )
+    from nvidia_terraform_modules_tpu.models.serving import (
+        make_serve_engine,
+    )
+
+    cfg = BurnInConfig(vocab=64, d_model=32, n_heads=2, d_ff=64,
+                       n_layers=1, seq_len=16, batch=2)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    reg = Registry(str(tmp_path / "t"))
+    spill = str(tmp_path / "cdn")
+    tmpl = [jax.random.randint(jax.random.PRNGKey(80 + i), (8,), 0, 64)
+            for i in range(2)]
+    prompts = [jax.numpy.concatenate(
+        [tmpl[i % 2],
+         jax.random.randint(jax.random.PRNGKey(40 + i), (1 + i % 2,),
+                            0, 64)]) for i in range(6)]
+    store = WarmChainStore(cfg, 16, block_size=4,
+                           disk=DiskChainStore(spill, telemetry=reg))
+    engine = make_serve_engine(params, cfg, max_len=16, kv_block=4,
+                               share_prefix=True, prefix_keep_blocks=0,
+                               shared_store=store, telemetry=reg)
+    engine(prompts, 4, slots=1)
+    assert store.disk.stored_chains > 0
+
+    # the restart: a fresh store over the same dir, RAM tier cleared so
+    # the next admission MUST come from the verified disk frame
+    store2 = WarmChainStore(cfg, 16, block_size=4,
+                            disk=DiskChainStore(spill, telemetry=reg))
+    store2.clear()
+    engine2 = make_serve_engine(params, cfg, max_len=16, kv_block=4,
+                                share_prefix=True, prefix_keep_blocks=0,
+                                shared_store=store2, telemetry=reg)
+    engine2(prompts, 4, slots=1)
+    cdn = engine2.last_stats["prefix"]["cdn"]
+    assert cdn["disk_hit_blocks"] > 0
+    assert reg.gauge("prefix_disk_hit_frac").value \
+        == cdn["disk_hit_frac"] > 0
+    assert reg.gauge("prefix_disk_swapin_ms").value \
+        == cdn["disk_swap_ms"] >= 0
+    spans = [e for e in reg.events
+             if e["kind"] == "span" and e["name"] == "prefix_disk_swap"]
+    assert spans and all(s["args"]["blocks"] > 0 for s in spans)
+
+    # corruption: one bit flipped in one frame → the next scan
+    # quarantines it with a reason and bills the counter
+    before = reg.counter("prefix_disk_quarantine_total").value
+    victim = sorted(glob.glob(
+        os.path.join(spill, "objects", "*", "*.pcd")))[0]
+    raw = bytearray(open(victim, "rb").read())
+    raw[len(raw) // 2] ^= 0x40
+    open(victim, "wb").write(bytes(raw))
+    d3 = DiskChainStore(spill, telemetry=reg)
+    assert d3.quarantined == 1 and d3.quarantine_reasons
+    assert reg.counter("prefix_disk_quarantine_total").value \
+        == before + 1
+
+    # degradation: a tier whose root cannot even be a directory is
+    # dead — billed, never raised
+    hostile = tmp_path / "not-a-dir"
+    hostile.write_text("x")
+    dead = DiskChainStore(str(hostile), telemetry=reg)
+    assert dead.dead
+    assert reg.counter("prefix_disk_degraded_total").value > 0
+
+    prom = reg.prometheus_text()
+    for line in ("# TYPE prefix_disk_hit_frac gauge",
+                 "# TYPE prefix_disk_swapin_ms gauge",
+                 "# TYPE prefix_disk_quarantine_total counter",
+                 "# TYPE prefix_disk_degraded_total counter"):
+        assert line in prom, line
+
+
 def test_fleet_scale_gauge_counters_and_span_export(jax8, tmp_path):
     """ISSUE 15's elastic-fleet telemetry, golden-tested on one
     registry: the ``fleet_size`` gauge tracks the live replica count

@@ -1093,6 +1093,83 @@ def test_serving_no_host_ram_silent_without_wiring_or_floor(tmp_path):
     assert _lint_host_ram(_write(tmp_path, v4)) == []
 
 
+# --------------------------------------- durable prefix tail evidence
+# (`tpu-serving-no-durable-prefix`: a serving pool wiring the host-spill
+# prefix tier with nothing durable for the disk tail — the DURABILITY
+# leg next to no-host-ram's sizing leg)
+
+
+def _lint_durable(path):
+    from nvidia_terraform_modules_tpu.tfsim.lint import run_lint
+
+    return [f for f in run_lint(path)
+            if f.rule == "tpu-serving-no-durable-prefix"]
+
+
+def test_serving_no_durable_prefix_fires(tmp_path):
+    """Serving pool + host-spill wiring + no durable evidence: the
+    Zipf head lives only in RAM, a full restart cold-starts it — the
+    exact posture ISSUE 20's disk tail exists to fix. Fires on any
+    TPU machine (sizing is no-host-ram's job, durability is ours)."""
+    body = _SPILL_POOL % ("host_spill", "serve-v5e",
+                          "ct5lp-hightpu-4t", "")
+    findings = _lint_durable(_write(tmp_path, body))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.severity == "warning"
+    assert "no durable home" in f.message
+    assert 'variable "host_spill"' in f.message
+    assert "disk_spill" in f.message
+    assert "tpu-serving-no-host-ram" in f.message
+
+
+def test_serving_no_durable_prefix_satisfied_by_variable(tmp_path):
+    """A `disk_spill`/`prefix_cache`-style knob in the module API is
+    the durable evidence — the runtime's own lever, statically
+    visible."""
+    for extra in ('variable "disk_spill_dir" { type = string }',
+                  'variable "prefix_cache_bucket" { type = string }'):
+        body = extra + "\n" + _SPILL_POOL % (
+            "host_spill", "serve-v5e", "ct5lp-hightpu-4t", "")
+        assert _lint_durable(_write(tmp_path, body)) == []
+
+
+def test_serving_no_durable_prefix_satisfied_by_local_ssd(tmp_path):
+    """Local SSD attached to the POOL itself (either GKE spelling, or
+    a bare local_ssd_count) is node-durable — exactly where the
+    DiskChainStore's sha-sharded tree lives."""
+    for extra in ("    local_ssd_count = 1\n",
+                  "    ephemeral_storage_local_ssd_config {\n"
+                  "      local_ssd_count = 1\n    }\n"):
+        body = _SPILL_POOL % ("host_spill", "serve-v5e",
+                              "ct5lp-hightpu-4t", extra)
+        assert _lint_durable(_write(tmp_path, body)) == []
+
+
+def test_serving_no_durable_prefix_satisfied_by_bucket(tmp_path):
+    """A storage bucket resource in the module is durable evidence
+    (GCS-fuse mounted spill path)."""
+    body = (_SPILL_POOL % ("host_spill", "serve-v5e",
+                           "ct5lp-hightpu-4t", "")
+            + '\nresource "google_storage_bucket" "spill" {'
+            + '\n  name = "prefix-cdn"\n}\n')
+    assert _lint_durable(_write(tmp_path, body)) == []
+
+
+def test_serving_no_durable_prefix_silent_without_premise(tmp_path):
+    """No host-spill wiring → silent (nothing to persist); training
+    shape → silent; a CPU machine → silent (not this rule's pool)."""
+    no_wiring = _SPILL_POOL % ("flag", "serve-v5e",
+                               "ct5lp-hightpu-4t", "")
+    assert _lint_durable(_write(tmp_path, no_wiring)) == []
+    training = _SPILL_POOL % ("host_spill", "train-v5e",
+                              "ct5lp-hightpu-4t", "")
+    assert _lint_durable(_write(tmp_path, training)) == []
+    cpu = (_SPILL_POOL % ("host_spill", "serve-pool",
+                          "n2-standard-8", ""))
+    assert _lint_durable(_write(tmp_path, cpu)) == []
+
+
 # -------------------------------------- unused serving autoscaler range
 # (`tpu-serving-autoscaler-unused`: the INVERSE of the headroom rule —
 # a serving pool declaring autoscaler bounds that no workload consumes
